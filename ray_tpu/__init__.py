@@ -1,0 +1,223 @@
+"""ray_tpu — a TPU-native distributed AI runtime with Ray's capabilities.
+
+Public API (reference: python/ray/_private/worker.py — init :1366, get :2749,
+put :2916, wait :2981, remote :3369, shutdown :1996):
+
+    import ray_tpu
+
+    ray_tpu.init()
+
+    @ray_tpu.remote
+    def f(x):
+        return x * 2
+
+    ray_tpu.get(f.remote(2))  # -> 4
+
+    @ray_tpu.remote(num_tpus=4)
+    class TpuWorker:
+        def step(self, batch): ...
+"""
+
+from __future__ import annotations
+
+import inspect
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID
+from ray_tpu._private.task_spec import (
+    ActorDiedError,
+    ActorUnavailableError,
+    GetTimeoutError,
+    ObjectLostError,
+    RayTpuError,
+    TaskCancelledError,
+    TaskError,
+    WorkerCrashedError,
+)
+from ray_tpu._private.worker import DRIVER, CoreWorker, ObjectRef, get_global_worker, set_global_worker
+from ray_tpu.actor import ActorClass, ActorHandle
+from ray_tpu.remote_function import RemoteFunction
+
+__version__ = "0.1.0"
+
+_init_lock = threading.Lock()
+_local_node = None  # the in-process head Node when we started one
+
+
+def init(
+    address: Optional[Tuple[str, int]] = None,
+    *,
+    num_cpus: Optional[float] = None,
+    num_tpus: Optional[float] = None,
+    resources: Optional[Dict[str, float]] = None,
+    labels: Optional[Dict[str, str]] = None,
+    object_store_memory: Optional[int] = None,
+    ignore_reinit_error: bool = False,
+    _raylet_addr: Optional[Tuple[str, int]] = None,
+    _gcs_addr: Optional[Tuple[str, int]] = None,
+) -> CoreWorker:
+    """Start (or connect to) a cluster and attach this process as the driver."""
+    global _local_node
+    from ray_tpu._private import worker as worker_mod
+
+    with _init_lock:
+        if worker_mod._global_worker is not None:
+            if ignore_reinit_error:
+                return worker_mod._global_worker
+            raise RuntimeError("ray_tpu.init() already called; use shutdown() first")
+        if _raylet_addr is None:
+            if address is not None:
+                # Connect to an existing cluster: use the head node's raylet.
+                from ray_tpu._private.rpc import RpcClient
+
+                gcs = RpcClient(tuple(address))
+                nodes = gcs.call("GetAllNodeInfo", None)
+                head = next((n for n in nodes if n.get("is_head")), nodes[0] if nodes else None)
+                if head is None:
+                    raise RuntimeError("cluster has no nodes")
+                _raylet_addr = tuple(head["address"])
+                _gcs_addr = tuple(address)
+                gcs.close()
+            else:
+                from ray_tpu._private.node import Node
+
+                res = dict(resources or {})
+                if num_cpus is not None:
+                    res["CPU"] = float(num_cpus)
+                if num_tpus is not None:
+                    res["TPU"] = float(num_tpus)
+                _local_node = Node(
+                    head=True,
+                    resources=res or None,
+                    labels=labels,
+                    object_store_memory=object_store_memory,
+                )
+                _raylet_addr = _local_node.raylet_address
+                _gcs_addr = _local_node.gcs_address
+        w = CoreWorker(mode=DRIVER, raylet_addr=_raylet_addr, gcs_addr=_gcs_addr)
+        set_global_worker(w)
+        return w
+
+
+def is_initialized() -> bool:
+    from ray_tpu._private import worker as worker_mod
+
+    return worker_mod._global_worker is not None
+
+
+def shutdown():
+    global _local_node
+    from ray_tpu._private import worker as worker_mod
+
+    with _init_lock:
+        w = worker_mod._global_worker
+        if w is not None:
+            w.shutdown()
+            set_global_worker(None)
+        if _local_node is not None:
+            _local_node.shutdown()
+            _local_node = None
+
+
+def remote(*args, **kwargs):
+    """Decorator turning a function into a RemoteFunction / class into an ActorClass."""
+
+    def make(target):
+        if inspect.isclass(target):
+            return ActorClass(target, **kwargs)
+        return RemoteFunction(target, **kwargs)
+
+    if len(args) == 1 and callable(args[0]) and not kwargs:
+        return make(args[0])
+    if args:
+        raise TypeError("@ray_tpu.remote takes keyword options only, e.g. @ray_tpu.remote(num_tpus=4)")
+    return make
+
+
+def get(refs, timeout: Optional[float] = None):
+    return get_global_worker().get(refs, timeout=timeout)
+
+
+def put(value) -> ObjectRef:
+    return get_global_worker().put(value)
+
+
+def wait(refs, *, num_returns: int = 1, timeout: Optional[float] = None, fetch_local: bool = True):
+    return get_global_worker().wait(refs, num_returns=num_returns, timeout=timeout, fetch_local=fetch_local)
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True):
+    get_global_worker().kill_actor(actor._actor_id, no_restart=no_restart)
+
+
+def get_actor(name: str, namespace: str = "default") -> ActorHandle:
+    info = get_global_worker().get_named_actor(name, namespace)
+    return ActorHandle(info["actor_id"])
+
+
+def nodes():
+    return get_global_worker().gcs.call("GetAllNodeInfo", None)
+
+
+def cluster_resources() -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    for n in nodes():
+        if n["state"] != "ALIVE":
+            continue
+        for k, v in n["resources"]["total"].items():
+            out[k] = out.get(k, 0) + v
+    return out
+
+
+def available_resources() -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    for n in nodes():
+        if n["state"] != "ALIVE":
+            continue
+        for k, v in n["resources"]["available"].items():
+            out[k] = out.get(k, 0) + v
+    return out
+
+
+def get_tpu_ids() -> list:
+    """Chip indices assigned to this worker (reference analog: get_gpu_ids,
+    worker.py:1104), derived from TPU_VISIBLE_CHIPS set at lease binding."""
+    from ray_tpu._private.accelerators.tpu import TPUAcceleratorManager
+
+    ids = TPUAcceleratorManager.get_current_process_visible_accelerator_ids()
+    return [int(i) for i in ids] if ids else []
+
+
+def get_runtime_context():
+    from ray_tpu.runtime_context import RuntimeContext
+
+    return RuntimeContext(get_global_worker())
+
+
+__all__ = [
+    "init",
+    "shutdown",
+    "is_initialized",
+    "remote",
+    "get",
+    "put",
+    "wait",
+    "kill",
+    "get_actor",
+    "nodes",
+    "cluster_resources",
+    "available_resources",
+    "get_tpu_ids",
+    "get_runtime_context",
+    "ObjectRef",
+    "ActorHandle",
+    "RayTpuError",
+    "TaskError",
+    "ActorDiedError",
+    "ActorUnavailableError",
+    "ObjectLostError",
+    "GetTimeoutError",
+    "WorkerCrashedError",
+    "TaskCancelledError",
+]

@@ -1,0 +1,92 @@
+"""Cluster-wide flag system.
+
+TPU-native equivalent of the reference's ``RAY_CONFIG(type, name, default)``
+macro table (reference: src/ray/common/ray_config_def.h:18-22, 223 entries).
+Every entry is overridable per-process via a ``RAY_TPU_<name>`` environment
+variable, and the head node distributes its resolved config blob to all other
+components at registration time (reference: NodeManager::HandleGetSystemConfig,
+node_manager.cc:2384).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, fields
+
+
+def _coerce(raw: str, default):
+    if isinstance(default, bool):
+        return raw.lower() in ("1", "true", "yes")
+    return type(default)(raw)
+
+
+@dataclass
+class RayTpuConfig:
+    # --- timeouts / intervals (seconds) ---
+    heartbeat_interval_s: float = 0.5
+    health_check_failure_threshold: int = 10
+    resource_report_interval_s: float = 0.2
+    gcs_rpc_timeout_s: float = 30.0
+    rpc_connect_timeout_s: float = 10.0
+    worker_register_timeout_s: float = 30.0
+    actor_creation_timeout_s: float = 120.0
+    # --- object store ---
+    object_store_memory_bytes: int = 2 * 1024**3
+    object_store_spill_dir: str = "/tmp/ray_tpu_spill"
+    object_spilling_enabled: bool = True
+    # Inline (in-band) return threshold, like the reference's
+    # max_direct_call_object_size (ray_config_def.h).
+    max_inline_object_size: int = 100 * 1024
+    object_transfer_chunk_bytes: int = 8 * 1024**2
+    # --- scheduler ---
+    scheduler_top_k_fraction: float = 0.2
+    scheduler_top_k_absolute: int = 1
+    scheduler_spread_threshold: float = 0.5
+    # --- worker pool ---
+    num_prestart_workers: int = 0
+    maximum_startup_concurrency: int = 4
+    idle_worker_kill_timeout_s: float = 300.0
+    # --- retries / fault tolerance ---
+    task_max_retries_default: int = 3
+    actor_max_restarts_default: int = 0
+    lineage_reconstruction_enabled: bool = True
+    # --- task events / observability ---
+    task_events_enabled: bool = True
+    task_events_max_buffer: int = 10000
+    # --- testing / chaos ---
+    # Format mirrors RAY_testing_rpc_failure (reference: src/ray/rpc/rpc_chaos.h:23-35):
+    # "method1=max_failures:req_prob:resp_prob,method2=..."
+    testing_rpc_failure: str = ""
+
+    def __post_init__(self):
+        for f in fields(self):
+            raw = os.environ.get(f"RAY_TPU_{f.name}")
+            if raw is not None:
+                setattr(self, f.name, _coerce(raw, f.default))
+
+    def to_blob(self) -> str:
+        return json.dumps({f.name: getattr(self, f.name) for f in fields(self)})
+
+    @classmethod
+    def from_blob(cls, blob: str) -> "RayTpuConfig":
+        cfg = cls()
+        for k, v in json.loads(blob).items():
+            if hasattr(cfg, k):
+                setattr(cfg, k, v)
+        return cfg
+
+
+_global_config: RayTpuConfig | None = None
+
+
+def global_config() -> RayTpuConfig:
+    global _global_config
+    if _global_config is None:
+        _global_config = RayTpuConfig()
+    return _global_config
+
+
+def set_global_config(cfg: RayTpuConfig):
+    global _global_config
+    _global_config = cfg

@@ -1,0 +1,619 @@
+"""Global Control Service — the cluster control plane.
+
+TPU-native rebuild of the reference GCS server
+(reference: src/ray/gcs/gcs_server/gcs_server.h:91; actor manager
+gcs_actor_manager.h:333; actor scheduler gcs_actor_scheduler.h:115;
+placement groups gcs_placement_group_mgr.h:232; KV gcs_kv_manager.h;
+health checks gcs_health_check_manager.h; task events gcs_task_manager.h).
+
+One GCS per cluster, hosted in the head node process.  It owns cluster-level
+metadata only — node/actor/job/placement-group tables and the KV store.
+Object state stays with owners (SURVEY.md §1 cross-layer invariant).
+
+State can be snapshotted to disk and reloaded (reference: Redis persistence,
+gcs_server.h:121-122) for GCS fault tolerance.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private.config import RayTpuConfig, global_config
+from ray_tpu._private.ids import ActorID, JobID, NodeID, PlacementGroupID, WorkerID
+from ray_tpu._private.resources import NodeResources, ResourceSet
+from ray_tpu._private.rpc import ClientPool, RpcServer
+from ray_tpu._private.scheduler import ClusterResourceScheduler
+from ray_tpu._private.task_spec import ActorDiedError, TaskSpec
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class NodeInfo:
+    node_id: NodeID
+    address: Tuple[str, int]          # raylet RPC address
+    resources: NodeResources
+    state: str = "ALIVE"              # ALIVE | DRAINING | DEAD
+    last_report: float = field(default_factory=time.monotonic)
+    is_head: bool = False
+
+
+@dataclass
+class ActorInfo:
+    actor_id: ActorID
+    spec: TaskSpec                    # the creation task spec
+    state: str = "PENDING"            # PENDING | ALIVE | RESTARTING | DEAD
+    address: Optional[Tuple[str, int]] = None  # worker RPC address when alive
+    node_id: Optional[NodeID] = None
+    num_restarts: int = 0
+    death_cause: str = ""
+    name: Optional[str] = None
+    detached: bool = False
+    job_id: Optional[JobID] = None
+
+
+@dataclass
+class PlacementGroupInfo:
+    pg_id: PlacementGroupID
+    bundles: List[ResourceSet]
+    strategy: str
+    state: str = "PENDING"            # PENDING | CREATED | REMOVED | RESCHEDULING
+    bundle_nodes: List[Optional[NodeID]] = field(default_factory=list)
+    name: Optional[str] = None
+    soft_target_node_id: Optional[NodeID] = None
+
+
+class Pubsub:
+    """Push-based pubsub: GCS (or a raylet) pushes to subscriber RPC servers.
+
+    reference: src/ray/pubsub/publisher.h:309 — the reference uses long-polls;
+    we push directly since every process runs an RpcServer anyway.
+    """
+
+    def __init__(self, pool: ClientPool):
+        self._subs: Dict[str, List[Tuple[Tuple[str, int], str]]] = {}
+        self._pool = pool
+        self._lock = threading.Lock()
+
+    def subscribe(self, channel: str, subscriber_addr: Tuple[str, int], method: str = "PubsubMessage"):
+        with self._lock:
+            subs = self._subs.setdefault(channel, [])
+            key = (tuple(subscriber_addr), method)
+            if key not in subs:
+                subs.append(key)
+
+    def unsubscribe(self, channel: str, subscriber_addr: Tuple[str, int]):
+        with self._lock:
+            subs = self._subs.get(channel, [])
+            self._subs[channel] = [s for s in subs if s[0] != tuple(subscriber_addr)]
+
+    def publish(self, channel: str, message: Any):
+        with self._lock:
+            subs = list(self._subs.get(channel, []))
+        for addr, method in subs:
+            try:
+                self._pool.get(addr).notify(method, {"channel": channel, "message": message})
+            except Exception:  # noqa: BLE001
+                pass
+
+
+class GcsServer:
+    """All GCS managers behind one RpcServer."""
+
+    def __init__(self, host: str = "127.0.0.1", config: Optional[RayTpuConfig] = None):
+        self.config = config or global_config()
+        self.pool = ClientPool()
+        self.pubsub = Pubsub(self.pool)
+        self.nodes: Dict[NodeID, NodeInfo] = {}
+        self.actors: Dict[ActorID, ActorInfo] = {}
+        self.named_actors: Dict[Tuple[str, str], ActorID] = {}  # (namespace, name)
+        self.placement_groups: Dict[PlacementGroupID, PlacementGroupInfo] = {}
+        self.named_pgs: Dict[str, PlacementGroupID] = {}
+        self.jobs: Dict[JobID, dict] = {}
+        self.kv: Dict[str, bytes] = {}
+        self.scheduler = ClusterResourceScheduler()
+        self.task_events: deque = deque(maxlen=self.config.task_events_max_buffer)
+        self._lock = threading.RLock()
+        self._actor_queue: deque = deque()
+        self._actor_cv = threading.Condition(self._lock)
+        self._stopped = threading.Event()
+        self._job_counter = 0
+
+        self.server = RpcServer(host=host)
+        self.server.register_all(self)
+        self._threads = [
+            threading.Thread(target=self._actor_scheduling_loop, daemon=True, name="gcs-actor-sched"),
+            threading.Thread(target=self._health_check_loop, daemon=True, name="gcs-health"),
+        ]
+        for t in self._threads:
+            t.start()
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self.server.address
+
+    def shutdown(self):
+        self._stopped.set()
+        with self._lock:
+            self._actor_cv.notify_all()
+        self.server.shutdown()
+        self.pool.close_all()
+
+    # ------------------------------------------------------------------
+    # Node management (reference: gcs_node_manager.h / gcs_resource_manager)
+    # ------------------------------------------------------------------
+
+    def HandleRegisterNode(self, req):
+        node_id: NodeID = req["node_id"]
+        with self._lock:
+            info = NodeInfo(
+                node_id=node_id,
+                address=tuple(req["address"]),
+                resources=NodeResources(ResourceSet(req["resources"]), req.get("labels")),
+                is_head=req.get("is_head", False),
+            )
+            self.nodes[node_id] = info
+            self.scheduler.add_or_update_node(node_id, info.resources)
+            self._actor_cv.notify_all()
+        self.pubsub.publish("NODE", {"event": "alive", "node_id": node_id, "address": info.address})
+        return {"config_blob": self.config.to_blob(), "cluster_view": self._cluster_view()}
+
+    def HandleReportResources(self, req):
+        node_id: NodeID = req["node_id"]
+        with self._lock:
+            info = self.nodes.get(node_id)
+            if info is None or info.state == "DEAD":
+                return {"restart": True}  # raylet should re-register (GCS restarted)
+            info.last_report = time.monotonic()
+            self.scheduler.update_available(node_id, req["available"])
+            self._actor_cv.notify_all()
+        return {"cluster_view": self._cluster_view()}
+
+    def _cluster_view(self):
+        """Resource snapshot broadcast to raylets (the syncer plane;
+        reference: src/ray/common/ray_syncer/ray_syncer.h)."""
+        return {
+            nid: {**info.resources.snapshot(), "address": info.address, "state": info.state}
+            for nid, info in self.nodes.items()
+            if info.state != "DEAD"
+        }
+
+    def HandleGetClusterView(self, req):
+        with self._lock:
+            return self._cluster_view()
+
+    def HandleDrainNode(self, req):
+        node_id = req["node_id"]
+        with self._lock:
+            info = self.nodes.get(node_id)
+            if info:
+                info.state = "DRAINING"
+        return True
+
+    def HandleNodeDead(self, req):
+        self._mark_node_dead(req["node_id"], req.get("reason", "reported dead"))
+        return True
+
+    def HandleGetAllNodeInfo(self, req):
+        with self._lock:
+            return [
+                {
+                    "node_id": nid,
+                    "address": i.address,
+                    "state": i.state,
+                    "is_head": i.is_head,
+                    "resources": i.resources.snapshot(),
+                }
+                for nid, i in self.nodes.items()
+            ]
+
+    def _mark_node_dead(self, node_id: NodeID, reason: str):
+        with self._lock:
+            info = self.nodes.get(node_id)
+            if info is None or info.state == "DEAD":
+                return
+            info.state = "DEAD"
+            self.scheduler.remove_node(node_id)
+            dead_actors = [a for a in self.actors.values() if a.node_id == node_id and a.state in ("ALIVE", "PENDING")]
+        logger.warning("GCS: node %s dead (%s); %d actors affected", node_id, reason, len(dead_actors))
+        self.pubsub.publish("NODE", {"event": "dead", "node_id": node_id})
+        for a in dead_actors:
+            self._on_actor_worker_death(a.actor_id, f"node {node_id} died")
+
+    def _health_check_loop(self):
+        cfg = self.config
+        period = cfg.heartbeat_interval_s
+        while not self._stopped.wait(period):
+            cutoff = time.monotonic() - period * cfg.health_check_failure_threshold
+            with self._lock:
+                stale = [nid for nid, i in self.nodes.items() if i.state == "ALIVE" and i.last_report < cutoff and not i.is_head]
+            for nid in stale:
+                self._mark_node_dead(nid, "missed health checks")
+
+    # ------------------------------------------------------------------
+    # Jobs
+    # ------------------------------------------------------------------
+
+    def HandleRegisterJob(self, req):
+        with self._lock:
+            self._job_counter += 1
+            job_id = JobID(f"{self._job_counter:08x}")
+            self.jobs[job_id] = {"driver_addr": req.get("driver_addr"), "state": "RUNNING", "start": time.time()}
+        return job_id
+
+    def HandleJobFinished(self, req):
+        job_id = req["job_id"]
+        with self._lock:
+            if job_id in self.jobs:
+                self.jobs[job_id]["state"] = "FINISHED"
+            doomed = [
+                a.actor_id
+                for a in self.actors.values()
+                if a.job_id == job_id and not a.detached and a.state in ("ALIVE", "PENDING", "RESTARTING")
+            ]
+        for aid in doomed:
+            self._kill_actor(aid, no_restart=True, reason="job finished")
+        return True
+
+    # ------------------------------------------------------------------
+    # Internal KV (reference: gcs_kv_manager.h)
+    # ------------------------------------------------------------------
+
+    def HandleKVPut(self, req):
+        with self._lock:
+            existed = req["key"] in self.kv
+            if not req.get("overwrite", True) and existed:
+                return False
+            self.kv[req["key"]] = req["value"]
+        return not existed
+
+    def HandleKVGet(self, req):
+        with self._lock:
+            return self.kv.get(req["key"])
+
+    def HandleKVMultiGet(self, req):
+        with self._lock:
+            return {k: self.kv[k] for k in req["keys"] if k in self.kv}
+
+    def HandleKVDel(self, req):
+        with self._lock:
+            return self.kv.pop(req["key"], None) is not None
+
+    def HandleKVKeys(self, req):
+        prefix = req.get("prefix", "")
+        with self._lock:
+            return [k for k in self.kv if k.startswith(prefix)]
+
+    def HandleKVExists(self, req):
+        with self._lock:
+            return req["key"] in self.kv
+
+    # ------------------------------------------------------------------
+    # Pubsub endpoints
+    # ------------------------------------------------------------------
+
+    def HandleSubscribe(self, req):
+        self.pubsub.subscribe(req["channel"], tuple(req["subscriber_addr"]))
+        return True
+
+    def HandleUnsubscribe(self, req):
+        self.pubsub.unsubscribe(req["channel"], tuple(req["subscriber_addr"]))
+        return True
+
+    def HandlePublish(self, req):
+        self.pubsub.publish(req["channel"], req["message"])
+        return True
+
+    # ------------------------------------------------------------------
+    # Actor management (reference: gcs_actor_manager.h:333,352,361,439)
+    # ------------------------------------------------------------------
+
+    def HandleRegisterActor(self, req):
+        spec: TaskSpec = req["spec"]
+        actor_id = spec.actor_id
+        with self._lock:
+            if spec.actor_name:
+                key = (req.get("namespace", "default"), spec.actor_name)
+                if key in self.named_actors:
+                    existing = self.actors.get(self.named_actors[key])
+                    if existing is not None and existing.state != "DEAD":
+                        raise ValueError(f"actor name {spec.actor_name!r} already taken")
+                self.named_actors[key] = actor_id
+            info = ActorInfo(
+                actor_id=actor_id,
+                spec=spec,
+                name=spec.actor_name,
+                detached=spec.detached,
+                job_id=spec.job_id,
+            )
+            self.actors[actor_id] = info
+            self._actor_queue.append(actor_id)
+            self._actor_cv.notify_all()
+        return True
+
+    def HandleGetActorInfo(self, req):
+        with self._lock:
+            info = self.actors.get(req["actor_id"])
+            if info is None:
+                return None
+            return {
+                "actor_id": info.actor_id,
+                "state": info.state,
+                "address": info.address,
+                "node_id": info.node_id,
+                "death_cause": info.death_cause,
+                "name": info.name,
+            }
+
+    def HandleGetNamedActor(self, req):
+        key = (req.get("namespace", "default"), req["name"])
+        with self._lock:
+            actor_id = self.named_actors.get(key)
+            if actor_id is None:
+                return None
+            info = self.actors.get(actor_id)
+            if info is None or info.state == "DEAD":
+                return None
+            return {"actor_id": actor_id, "spec": info.spec, "address": info.address, "state": info.state}
+
+    def HandleListNamedActors(self, req):
+        with self._lock:
+            return [
+                {"namespace": ns, "name": name, "actor_id": aid}
+                for (ns, name), aid in self.named_actors.items()
+                if self.actors.get(aid) and self.actors[aid].state != "DEAD"
+            ]
+
+    def HandleListActors(self, req):
+        with self._lock:
+            return [
+                {
+                    "actor_id": a.actor_id,
+                    "state": a.state,
+                    "name": a.name,
+                    "node_id": a.node_id,
+                    "num_restarts": a.num_restarts,
+                    "class_name": a.spec.name,
+                }
+                for a in self.actors.values()
+            ]
+
+    def HandleKillActor(self, req):
+        self._kill_actor(req["actor_id"], req.get("no_restart", True), reason="ray.kill")
+        return True
+
+    def _kill_actor(self, actor_id: ActorID, no_restart: bool, reason: str):
+        with self._lock:
+            info = self.actors.get(actor_id)
+            if info is None:
+                return
+            addr = info.address
+            if no_restart:
+                info.spec.max_restarts = 0
+        if addr is not None:
+            try:
+                self.pool.get(addr).notify("KillActor", {"actor_id": actor_id, "reason": reason})
+            except Exception:  # noqa: BLE001
+                pass
+        self._on_actor_worker_death(actor_id, reason, force_dead=no_restart)
+
+    def HandleReportActorDeath(self, req):
+        """Raylet or a caller observed the actor's worker die."""
+        self._on_actor_worker_death(req["actor_id"], req.get("reason", "worker died"))
+        return True
+
+    def _on_actor_worker_death(self, actor_id: ActorID, reason: str, force_dead: bool = False):
+        with self._lock:
+            info = self.actors.get(actor_id)
+            if info is None or info.state == "DEAD":
+                return
+            can_restart = (not force_dead) and (
+                info.spec.max_restarts == -1 or info.num_restarts < info.spec.max_restarts
+            )
+            if can_restart:
+                info.state = "RESTARTING"
+                info.num_restarts += 1
+                info.address = None
+                info.node_id = None
+                self._actor_queue.append(actor_id)
+                self._actor_cv.notify_all()
+                state_msg = {"event": "restarting", "actor_id": actor_id, "num_restarts": info.num_restarts}
+            else:
+                info.state = "DEAD"
+                info.death_cause = reason
+                info.address = None
+                state_msg = {"event": "dead", "actor_id": actor_id, "reason": reason}
+        self.pubsub.publish(f"ACTOR:{actor_id.hex()}", state_msg)
+
+    # -- actor scheduling loop (reference: gcs_actor_scheduler.h:115) -----
+
+    def _actor_scheduling_loop(self):
+        while not self._stopped.is_set():
+            with self._lock:
+                while not self._actor_queue and not self._stopped.is_set():
+                    self._actor_cv.wait(timeout=1.0)
+                if self._stopped.is_set():
+                    return
+                actor_id = self._actor_queue.popleft()
+                info = self.actors.get(actor_id)
+                if info is None or info.state == "DEAD":
+                    continue
+                spec = info.spec
+                node_id = self.scheduler.get_best_schedulable_node(
+                    spec.resources, spec.strategy, requires_available=True
+                )
+                node = self.nodes.get(node_id) if node_id else None
+            if node is None:
+                # No feasible node right now; retry when resources change.
+                time.sleep(0.05)
+                with self._lock:
+                    self._actor_queue.append(actor_id)
+                continue
+            try:
+                self._create_actor_on_node(info, node)
+            except Exception as e:  # noqa: BLE001
+                logger.warning("GCS: actor %s creation on %s failed: %s", actor_id, node.node_id, e)
+                with self._lock:
+                    self._actor_queue.append(actor_id)
+                time.sleep(0.1)
+
+    def _create_actor_on_node(self, info: ActorInfo, node: NodeInfo):
+        """Lease a worker, then push the creation task
+        (reference: LeaseWorkerFromNode gcs_actor_scheduler.h:263,
+        CreateActorOnWorker :323)."""
+        raylet = self.pool.get(node.address)
+        lease = raylet.call(
+            "RequestWorkerLease",
+            {"spec": info.spec, "for_actor": True},
+            timeout=self.config.actor_creation_timeout_s,
+        )
+        if lease.get("rejected"):
+            raise RuntimeError(f"lease rejected: {lease.get('reason')}")
+        worker_addr = tuple(lease["worker_addr"])
+        reply = self.pool.get(worker_addr).call(
+            "CreateActor",
+            {"spec": info.spec, "lease": lease},
+            timeout=self.config.actor_creation_timeout_s,
+        )
+        if not reply.get("ok"):
+            raise RuntimeError(f"actor __init__ failed: {reply.get('error')}")
+        with self._lock:
+            info.state = "ALIVE"
+            info.address = worker_addr
+            info.node_id = node.node_id
+        self.pubsub.publish(
+            f"ACTOR:{info.actor_id.hex()}",
+            {"event": "alive", "actor_id": info.actor_id, "address": worker_addr},
+        )
+
+    # ------------------------------------------------------------------
+    # Placement groups (reference: gcs_placement_group_mgr.h:232; 2-phase
+    # prepare/commit node_manager.cc:1761,1777)
+    # ------------------------------------------------------------------
+
+    def HandleCreatePlacementGroup(self, req):
+        pg_id: PlacementGroupID = req["pg_id"]
+        bundles = [ResourceSet(b) for b in req["bundles"]]
+        strategy = req.get("strategy", "PACK")
+        name = req.get("name")
+        slice_label = req.get("slice_label")
+        with self._lock:
+            if name:
+                self.named_pgs[name] = pg_id
+            info = PlacementGroupInfo(pg_id=pg_id, bundles=bundles, strategy=strategy, name=name)
+            self.placement_groups[pg_id] = info
+        threading.Thread(
+            target=self._schedule_pg, args=(info, slice_label), daemon=True, name="gcs-pg-sched"
+        ).start()
+        return True
+
+    def _schedule_pg(self, info: PlacementGroupInfo, slice_label: Optional[str]):
+        deadline = time.monotonic() + 3600.0
+        while not self._stopped.is_set() and time.monotonic() < deadline:
+            with self._lock:
+                if info.state == "REMOVED":
+                    return
+                placement = self.scheduler.schedule_bundles(info.bundles, info.strategy, slice_label)
+            if placement is None:
+                time.sleep(0.1)
+                continue
+            if self._prepare_and_commit(info, placement):
+                with self._lock:
+                    info.state = "CREATED"
+                    info.bundle_nodes = placement
+                self.pubsub.publish(f"PG:{info.pg_id.hex()}", {"event": "created", "pg_id": info.pg_id})
+                return
+            time.sleep(0.1)
+
+    def _prepare_and_commit(self, info: PlacementGroupInfo, placement: List[NodeID]) -> bool:
+        by_node: Dict[NodeID, List[int]] = {}
+        for i, nid in enumerate(placement):
+            by_node.setdefault(nid, []).append(i)
+        prepared = []
+        try:
+            for nid, idxs in by_node.items():
+                node = self.nodes.get(nid)
+                if node is None or node.state != "ALIVE":
+                    raise RuntimeError(f"node {nid} unavailable")
+                ok = self.pool.get(node.address).call(
+                    "PrepareBundles",
+                    {"pg_id": info.pg_id, "bundles": {i: info.bundles[i].to_dict() for i in idxs}},
+                )
+                if not ok:
+                    raise RuntimeError(f"prepare rejected on {nid}")
+                prepared.append(nid)
+            for nid in by_node:
+                self.pool.get(self.nodes[nid].address).call("CommitBundles", {"pg_id": info.pg_id})
+            return True
+        except Exception as e:  # noqa: BLE001
+            logger.info("GCS: PG %s prepare/commit failed: %s", info.pg_id, e)
+            for nid in prepared:
+                node = self.nodes.get(nid)
+                if node is not None:
+                    try:
+                        self.pool.get(node.address).call("ReturnBundles", {"pg_id": info.pg_id})
+                    except Exception:  # noqa: BLE001
+                        pass
+            return False
+
+    def HandleGetPlacementGroup(self, req):
+        with self._lock:
+            info = self.placement_groups.get(req["pg_id"])
+            if info is None:
+                return None
+            return {
+                "pg_id": info.pg_id,
+                "state": info.state,
+                "bundle_nodes": list(info.bundle_nodes),
+                "strategy": info.strategy,
+                "bundles": [b.to_dict() for b in info.bundles],
+                "name": info.name,
+            }
+
+    def HandleGetNamedPlacementGroup(self, req):
+        with self._lock:
+            pg_id = self.named_pgs.get(req["name"])
+            if pg_id is None:
+                return None
+            info = self.placement_groups.get(pg_id)
+            if info is None or info.state == "REMOVED":
+                return None
+            return {"pg_id": pg_id, "bundles": [b.to_dict() for b in info.bundles], "state": info.state}
+
+    def HandleRemovePlacementGroup(self, req):
+        pg_id = req["pg_id"]
+        with self._lock:
+            info = self.placement_groups.get(pg_id)
+            if info is None:
+                return False
+            info.state = "REMOVED"
+            nodes = set(n for n in info.bundle_nodes if n is not None)
+        for nid in nodes:
+            with self._lock:
+                node = self.nodes.get(nid)
+            if node is not None:
+                try:
+                    self.pool.get(node.address).call("ReturnBundles", {"pg_id": pg_id})
+                except Exception:  # noqa: BLE001
+                    pass
+        self.pubsub.publish(f"PG:{pg_id.hex()}", {"event": "removed", "pg_id": pg_id})
+        return True
+
+    # ------------------------------------------------------------------
+    # Task events (reference: gcs_task_manager.h — observability sink)
+    # ------------------------------------------------------------------
+
+    def HandleAddTaskEvents(self, req):
+        with self._lock:
+            self.task_events.extend(req["events"])
+        return True
+
+    def HandleListTaskEvents(self, req):
+        limit = req.get("limit", 1000)
+        with self._lock:
+            return list(self.task_events)[-limit:]

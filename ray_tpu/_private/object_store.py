@@ -1,0 +1,377 @@
+"""Node-local shared-memory object store ("plasma" equivalent).
+
+TPU-native rebuild of the reference's Plasma store
+(reference: src/ray/object_manager/plasma/store.h:55, obj_lifecycle_mgr.h,
+eviction_policy.h).  One store lives inside each raylet process; worker
+processes create/seal objects through raylet RPC and then map the object's
+shared-memory segment directly for zero-copy reads (the reference passes mmap
+fds over a unix socket — we pass POSIX shm names, same zero-copy property).
+
+Differences from the reference, on purpose:
+- One POSIX shm segment per object instead of a dlmalloc arena.  A C++
+  arena-backed store is a planned native replacement; the segment-per-object
+  store has identical semantics and the same zero-copy read path.
+- Eviction = LRU over sealed, unpinned objects, with optional disk spilling
+  (reference: local_object_manager.h:43 SpillObjects) and restore-on-get.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from multiprocessing import shared_memory
+from typing import Dict, List, Optional, Set, Tuple
+
+from ray_tpu._private.config import global_config
+from ray_tpu._private.ids import ObjectID
+
+logger = logging.getLogger(__name__)
+
+
+_attach_lock = threading.Lock()
+
+
+def attach_shm(name: str) -> shared_memory.SharedMemory:
+    """Attach to an existing segment WITHOUT registering it in this process's
+    resource tracker — the creating store owns unlink; attachers must not
+    double-track (else Python warns about 'leaked' segments at exit).
+    Python 3.12 lacks SharedMemory(track=False), so registration is suppressed
+    by patching the tracker hook for the duration of the attach."""
+    from multiprocessing import resource_tracker
+
+    with _attach_lock:
+        orig = resource_tracker.register
+        resource_tracker.register = lambda *a, **k: None
+        try:
+            return shared_memory.SharedMemory(name=name)
+        finally:
+            resource_tracker.register = orig
+
+
+class ObjectStoreFullError(Exception):
+    pass
+
+
+class ObjectLostError(Exception):
+    pass
+
+
+@dataclass
+class _Entry:
+    shm: Optional[shared_memory.SharedMemory]
+    size: int
+    sealed: bool = False
+    pins: int = 0  # pin while mapped by readers / primary copy
+    last_access: float = field(default_factory=time.monotonic)
+    spilled_path: Optional[str] = None
+    is_primary: bool = True  # primary copy = created here; evict secondaries first
+
+
+class LocalObjectStore:
+    """The store proper. Thread-safe. Lives in the raylet process."""
+
+    def __init__(self, capacity_bytes: Optional[int] = None, node_id_hex: str = "node"):
+        cfg = global_config()
+        self._capacity = capacity_bytes or cfg.object_store_memory_bytes
+        self._spill_dir = os.path.join(cfg.object_store_spill_dir, node_id_hex)
+        self._spilling = cfg.object_spilling_enabled
+        self._entries: Dict[ObjectID, _Entry] = {}
+        self._used = 0
+        self._lock = threading.Lock()
+        self._seal_cv = threading.Condition(self._lock)
+        self._seal_callbacks: Dict[ObjectID, list] = {}
+        self._prefix = f"rtpu-{node_id_hex[:8]}-{os.getpid()}"
+
+    # -- creation ----------------------------------------------------------
+
+    def create(self, object_id: ObjectID, size: int) -> str:
+        """Reserve space; returns shm segment name for the writer to map."""
+        with self._lock:
+            if object_id in self._entries:
+                e = self._entries[object_id]
+                if e.sealed:
+                    raise FileExistsError(f"{object_id} already sealed")
+                return e.shm.name
+            self._evict_until(size)
+            name = f"{self._prefix}-{object_id.hex()[:16]}"
+            try:
+                shm = shared_memory.SharedMemory(name=name, create=True, size=max(size, 1))
+            except FileExistsError:
+                shm = shared_memory.SharedMemory(name=name)
+            self._entries[object_id] = _Entry(shm=shm, size=size)
+            self._used += size
+            return shm.name
+
+    def seal(self, object_id: ObjectID):
+        with self._lock:
+            e = self._entries.get(object_id)
+            if e is None:
+                raise KeyError(f"seal of unknown object {object_id}")
+            e.sealed = True
+            e.last_access = time.monotonic()
+            self._seal_cv.notify_all()
+            callbacks = self._seal_callbacks.pop(object_id, [])
+        for cb in callbacks:
+            try:
+                cb()
+            except Exception:  # noqa: BLE001
+                logger.exception("seal callback failed")
+
+    def on_sealed(self, object_id: ObjectID, callback) -> bool:
+        """Fire callback when sealed; returns True if already sealed (callback
+        NOT invoked in that case — caller handles the fast path)."""
+        with self._lock:
+            e = self._entries.get(object_id)
+            if e is not None and e.sealed:
+                return True
+            self._seal_callbacks.setdefault(object_id, []).append(callback)
+            return False
+
+    def cancel_seal_callback(self, object_id: ObjectID, callback):
+        with self._lock:
+            cbs = self._seal_callbacks.get(object_id)
+            if cbs and callback in cbs:
+                cbs.remove(callback)
+
+    def put_bytes(self, object_id: ObjectID, meta: bytes, raws) -> None:
+        """Store pre-serialized data directly (raylet-side put)."""
+        from ray_tpu._private import serialization
+
+        size = serialization.serialized_size(meta, raws)
+        name = self.create(object_id, size)
+        shm = attach_shm(name)
+        try:
+            serialization.write_to(shm.buf, meta, raws)
+        finally:
+            shm.close()
+        self.seal(object_id)
+
+    def put_raw(self, object_id: ObjectID, data: memoryview) -> None:
+        """Store an already-laid-out object region (object transfer receive)."""
+        name = self.create(object_id, data.nbytes)
+        shm = attach_shm(name)
+        try:
+            shm.buf[: data.nbytes] = data
+        finally:
+            shm.close()
+        self.seal(object_id)
+
+    # -- reads -------------------------------------------------------------
+
+    def get_shm_name(self, object_id: ObjectID, timeout: Optional[float] = None) -> Optional[Tuple[str, int]]:
+        """Block until sealed (or timeout); returns (shm_name, size).
+
+        Restores from spill if needed. Returns None on timeout.
+        """
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while True:
+                e = self._entries.get(object_id)
+                if e is not None and e.sealed:
+                    if e.shm is None:
+                        self._restore_locked(object_id, e)
+                    e.last_access = time.monotonic()
+                    e.pins += 1
+                    return (e.shm.name, e.size)
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return None
+                self._seal_cv.wait(timeout=remaining if remaining is not None else 1.0)
+
+    def unpin(self, object_id: ObjectID):
+        with self._lock:
+            e = self._entries.get(object_id)
+            if e is not None and e.pins > 0:
+                e.pins -= 1
+
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            e = self._entries.get(object_id)
+            return e is not None and e.sealed
+
+    def read_object_bytes(self, object_id: ObjectID, offset: int = 0, length: Optional[int] = None) -> Optional[bytes]:
+        """Copy out a chunk (for inter-node transfer)."""
+        got = self.get_shm_name(object_id)
+        if got is None:
+            return None
+        name, size = got
+        try:
+            shm = attach_shm(name)
+            try:
+                end = size if length is None else min(offset + length, size)
+                return bytes(shm.buf[offset:end])
+            finally:
+                shm.close()
+        finally:
+            self.unpin(object_id)
+
+    def object_size(self, object_id: ObjectID) -> Optional[int]:
+        with self._lock:
+            e = self._entries.get(object_id)
+            return e.size if e is not None and e.sealed else None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def mark_secondary(self, object_id: ObjectID):
+        with self._lock:
+            e = self._entries.get(object_id)
+            if e is not None:
+                e.is_primary = False
+
+    def free(self, object_id: ObjectID):
+        with self._lock:
+            self._free_locked(object_id)
+
+    def _free_locked(self, object_id: ObjectID):
+        e = self._entries.pop(object_id, None)
+        if e is None:
+            return
+        if e.shm is not None:
+            self._used -= e.size
+            try:
+                e.shm.close()
+                e.shm.unlink()
+            except FileNotFoundError:
+                pass
+        if e.spilled_path:
+            try:
+                os.unlink(e.spilled_path)
+            except OSError:
+                pass
+
+    def list_objects(self) -> List[ObjectID]:
+        with self._lock:
+            return [oid for oid, e in self._entries.items() if e.sealed]
+
+    def used_bytes(self) -> int:
+        with self._lock:
+            return self._used
+
+    def shutdown(self):
+        with self._lock:
+            for oid in list(self._entries):
+                self._free_locked(oid)
+
+    # -- eviction / spilling ----------------------------------------------
+    # reference: eviction_policy.h (LRU), local_object_manager.h:113 SpillObjects
+
+    def _evict_until(self, need: int):
+        if self._used + need <= self._capacity:
+            return
+        # Secondaries first, then spill primaries; LRU within each class.
+        candidates = sorted(
+            (
+                (e.is_primary, e.last_access, oid)
+                for oid, e in self._entries.items()
+                if e.sealed and e.pins == 0 and e.shm is not None
+            ),
+        )
+        for is_primary, _, oid in candidates:
+            if self._used + need <= self._capacity:
+                return
+            e = self._entries[oid]
+            if not is_primary:
+                self._free_locked(oid)
+            elif self._spilling:
+                self._spill_locked(oid, e)
+            else:
+                break
+        if self._used + need > self._capacity:
+            raise ObjectStoreFullError(
+                f"need {need}B, used {self._used}B of {self._capacity}B and nothing evictable"
+            )
+
+    def _spill_locked(self, object_id: ObjectID, e: _Entry):
+        os.makedirs(self._spill_dir, exist_ok=True)
+        path = os.path.join(self._spill_dir, object_id.hex())
+        with open(path, "wb") as f:
+            f.write(e.shm.buf[: e.size])
+        e.spilled_path = path
+        try:
+            e.shm.close()
+            e.shm.unlink()
+        except FileNotFoundError:
+            pass
+        e.shm = None
+        self._used -= e.size
+
+    def _restore_locked(self, object_id: ObjectID, e: _Entry):
+        if e.spilled_path is None:
+            raise ObjectLostError(f"{object_id} has neither memory nor spill copy")
+        self._evict_until(e.size)
+        name = f"{self._prefix}-{object_id.hex()[:16]}-r"
+        try:
+            shm = shared_memory.SharedMemory(name=name, create=True, size=max(e.size, 1))
+        except FileExistsError:
+            shm = shared_memory.SharedMemory(name=name)
+        with open(e.spilled_path, "rb") as f:
+            data = f.read()
+        shm.buf[: len(data)] = data
+        e.shm = shm
+        self._used += e.size
+
+
+class PlasmaClient:
+    """Worker-side view of the node's store: map-by-name zero-copy reads.
+
+    The worker asks its raylet for (shm_name, size) over RPC, then attaches
+    the segment directly — the data path never crosses the RPC socket
+    (reference: plasma client fd-passing, src/ray/object_manager/plasma/client.cc).
+    """
+
+    def __init__(self, raylet_client):
+        self._raylet = raylet_client
+        self._mapped: Dict[str, shared_memory.SharedMemory] = {}
+        self._lock = threading.Lock()
+
+    def put(self, object_id: ObjectID, obj, owner_addr=None) -> int:
+        from ray_tpu._private import serialization
+
+        meta, raws = serialization.dumps_with_buffers(obj)
+        size = serialization.serialized_size(meta, raws)
+        shm_name = self._raylet.call(
+            "PlasmaCreate", {"object_id": object_id, "size": size, "owner_addr": owner_addr}
+        )
+        shm = attach_shm(shm_name)
+        try:
+            serialization.write_to(shm.buf, meta, raws)
+        finally:
+            shm.close()
+        self._raylet.call("PlasmaSeal", {"object_id": object_id})
+        return size
+
+    def get(self, object_id: ObjectID, timeout: Optional[float] = None):
+        """Returns (found, value)."""
+        got = self._raylet.call(
+            "PlasmaGet", {"object_id": object_id, "timeout": timeout},
+            timeout=(timeout or 0) + global_config().gcs_rpc_timeout_s,
+        )
+        if got is None:
+            return False, None
+        shm_name, size = got
+        from ray_tpu._private import serialization
+
+        with self._lock:
+            shm = self._mapped.get(shm_name)
+            if shm is None:
+                shm = attach_shm(shm_name)
+                self._mapped[shm_name] = shm
+        value = serialization.read_from(shm.buf[:size])
+        # NOTE: value may alias shm; keep segment mapped for process lifetime.
+        # The store keeps its pin until the owner frees the object.
+        return True, value
+
+    def contains(self, object_id: ObjectID) -> bool:
+        return self._raylet.call("PlasmaContains", {"object_id": object_id})
+
+    def close(self):
+        with self._lock:
+            for shm in self._mapped.values():
+                try:
+                    shm.close()
+                except Exception:  # noqa: BLE001
+                    pass
+            self._mapped.clear()

@@ -1,0 +1,713 @@
+"""Per-node daemon: worker leasing, local scheduling, object management.
+
+TPU-native rebuild of the reference raylet
+(reference: src/ray/raylet/node_manager.cc — HandleRequestWorkerLease :1658,
+HandlePrepareBundleResources :1761, HandleCommitBundleResources :1777,
+HandleDrainRaylet :1893, worker death :873,980; worker_pool.h:274;
+local_task_manager.cc; object transfer: src/ray/object_manager/
+object_manager.h:120, pull_manager.h:49, push_manager.h:27).
+
+In this rebuild a "node" is a raylet object; multiple raylets can live in one
+OS process for testing (reference: python/ray/cluster_utils.py Cluster), while
+worker processes are always real subprocesses.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import sys
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private.config import RayTpuConfig, global_config
+from ray_tpu._private.ids import NodeID, ObjectID, PlacementGroupID, WorkerID
+from ray_tpu._private.object_store import LocalObjectStore
+from ray_tpu._private.resources import NodeResources, ResourceSet
+from ray_tpu._private.rpc import ClientPool, RpcClient, RpcServer
+from ray_tpu._private.scheduler import ClusterResourceScheduler, SchedulingStrategy
+from ray_tpu._private.task_spec import TaskSpec
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class _Worker:
+    worker_id: WorkerID
+    address: Tuple[str, int]
+    proc: Optional[subprocess.Popen]
+    dedicated_actor: Any = None          # ActorID when running an actor
+    lease_id: Optional[str] = None
+
+
+@dataclass
+class _Lease:
+    lease_id: str
+    worker: _Worker
+    demand: ResourceSet
+    instances: Dict[str, list]
+    pg_id: Optional[PlacementGroupID] = None
+    bundle_index: int = -1
+    for_actor: bool = False
+
+
+@dataclass
+class _PendingLease:
+    spec: TaskSpec
+    reply_token: Any
+    for_actor: bool
+    enqueue_time: float = field(default_factory=time.monotonic)
+
+
+@dataclass
+class _Bundle:
+    reserved: ResourceSet
+    available: ResourceSet
+    instances: Dict[str, list]
+    committed: bool = False
+
+
+class Raylet:
+    """One node's control daemon + object store host."""
+
+    def __init__(
+        self,
+        gcs_address: Tuple[str, int],
+        resources: Optional[Dict[str, float]] = None,
+        labels: Optional[Dict[str, str]] = None,
+        object_store_memory: Optional[int] = None,
+        is_head: bool = False,
+        node_id: Optional[NodeID] = None,
+        env: Optional[Dict[str, str]] = None,
+    ):
+        self.node_id = node_id or NodeID.random()
+        self.gcs_address = tuple(gcs_address)
+        self.pool = ClientPool()
+        self.gcs = self.pool.get(self.gcs_address)
+        self.is_head = is_head
+        self._worker_env = dict(env or {})
+
+        from ray_tpu._private.accelerators import detect_node_resources_and_labels
+
+        auto_res, auto_labels = detect_node_resources_and_labels()
+        res = {**auto_res, **(resources or {})}
+        all_labels = {**auto_labels, **(labels or {})}
+
+        self.store = LocalObjectStore(object_store_memory, self.node_id.hex())
+        self.local_resources = NodeResources(ResourceSet(res), all_labels)
+        self.cluster = ClusterResourceScheduler(self.node_id)
+        self.cluster.add_or_update_node(self.node_id, self.local_resources)
+
+        self.server = RpcServer()
+        self.server.register_all(self)
+
+        self._lock = threading.RLock()
+        self._dispatch_cv = threading.Condition(self._lock)
+        self._spawning_procs: Dict[int, subprocess.Popen] = {}
+        self._idle_workers: deque[_Worker] = deque()
+        self._all_workers: Dict[WorkerID, _Worker] = {}
+        self._starting = 0
+        self._pending_leases: deque[_PendingLease] = deque()
+        self._grants_waiting_worker: deque[Tuple[_PendingLease, ResourceSet, Dict[str, list], Optional[PlacementGroupID], int]] = deque()
+        self._leases: Dict[str, _Lease] = {}
+        self._bundles: Dict[PlacementGroupID, Dict[int, _Bundle]] = {}
+        self._draining = False
+        self._stopped = threading.Event()
+        self._lease_counter = 0
+        self._object_owners: Dict[ObjectID, Tuple[str, int]] = {}
+
+        # Register with GCS; receive cluster config + view.
+        reply = self.gcs.call(
+            "RegisterNode",
+            {
+                "node_id": self.node_id,
+                "address": self.server.address,
+                "resources": self.local_resources.total.to_dict(),
+                "labels": all_labels,
+                "is_head": is_head,
+            },
+        )
+        from ray_tpu._private import config as config_mod
+
+        config_mod.set_global_config(RayTpuConfig.from_blob(reply["config_blob"]))
+        self._apply_cluster_view(reply["cluster_view"])
+
+        self._threads = [
+            threading.Thread(target=self._report_loop, daemon=True, name="raylet-report"),
+            threading.Thread(target=self._dispatch_loop, daemon=True, name="raylet-dispatch"),
+            threading.Thread(target=self._worker_monitor_loop, daemon=True, name="raylet-monitor"),
+        ]
+        for t in self._threads:
+            t.start()
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self.server.address
+
+    def shutdown(self):
+        self._stopped.set()
+        with self._lock:
+            workers = list(self._all_workers.values())
+            self._dispatch_cv.notify_all()
+        for w in workers:
+            if w.proc is not None:
+                try:
+                    w.proc.terminate()
+                except Exception:  # noqa: BLE001
+                    pass
+        for w in workers:
+            if w.proc is not None:
+                try:
+                    w.proc.wait(timeout=3)
+                except Exception:  # noqa: BLE001
+                    try:
+                        w.proc.kill()
+                    except Exception:  # noqa: BLE001
+                        pass
+        self.server.shutdown()
+        self.store.shutdown()
+        self.pool.close_all()
+
+    # ------------------------------------------------------------------
+    # Cluster view sync (reference: ray_syncer.h — versioned gossip)
+    # ------------------------------------------------------------------
+
+    def _apply_cluster_view(self, view: dict):
+        with self._lock:
+            seen = set()
+            for nid, snap in view.items():
+                seen.add(nid)
+                if nid == self.node_id:
+                    continue
+                node = self.cluster.nodes.get(nid)
+                if node is None:
+                    node = NodeResources(ResourceSet(snap["total"]), snap.get("labels"))
+                    self.cluster.add_or_update_node(nid, node)
+                node.available = ResourceSet(snap["available"])
+                node.address = tuple(snap["address"])  # type: ignore[attr-defined]
+            for nid in list(self.cluster.nodes):
+                if nid != self.node_id and nid not in seen:
+                    self.cluster.remove_node(nid)
+
+    def _report_loop(self):
+        while not self._stopped.wait(global_config().resource_report_interval_s):
+            try:
+                with self._lock:
+                    avail = self.local_resources.available.to_dict()
+                reply = self.gcs.call("ReportResources", {"node_id": self.node_id, "available": avail})
+                if reply.get("restart"):
+                    # GCS restarted and lost us (reference: HandleNotifyGCSRestart
+                    # node_manager.cc:948): re-register.
+                    self.gcs.call(
+                        "RegisterNode",
+                        {
+                            "node_id": self.node_id,
+                            "address": self.server.address,
+                            "resources": self.local_resources.total.to_dict(),
+                            "labels": dict(self.local_resources.labels),
+                            "is_head": self.is_head,
+                        },
+                    )
+                elif "cluster_view" in reply:
+                    self._apply_cluster_view(reply["cluster_view"])
+                with self._lock:
+                    self._dispatch_cv.notify_all()
+            except Exception:  # noqa: BLE001
+                pass  # GCS temporarily unreachable; keep trying
+
+    # ------------------------------------------------------------------
+    # Worker pool (reference: worker_pool.h:274, worker_pool.cc)
+    # ------------------------------------------------------------------
+
+    def _spawn_worker(self):
+        self._starting += 1
+        env = {
+            **os.environ,
+            **self._worker_env,
+            "RAY_TPU_NODE_ID": self.node_id.hex(),
+            "RAY_TPU_RAYLET_HOST": self.server.address[0],
+            "RAY_TPU_RAYLET_PORT": str(self.server.address[1]),
+            "RAY_TPU_GCS_HOST": self.gcs_address[0],
+            "RAY_TPU_GCS_PORT": str(self.gcs_address[1]),
+        }
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.workers_main"],
+            env=env,
+            stdout=subprocess.DEVNULL if os.environ.get("RAY_TPU_WORKER_QUIET") else None,
+            stderr=None,
+        )
+        self._spawning_procs[proc.pid] = proc
+        threading.Thread(
+            target=self._watch_spawn, args=(proc,), daemon=True, name="raylet-spawnwatch"
+        ).start()
+
+    def _watch_spawn(self, proc):
+        """If a spawned worker exits before registering, decrement _starting."""
+        deadline = time.monotonic() + global_config().worker_register_timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                if proc.pid not in self._spawning_procs:
+                    return  # registered
+            if proc.poll() is not None:
+                with self._lock:
+                    if self._spawning_procs.pop(proc.pid, None) is not None:
+                        self._starting = max(0, self._starting - 1)
+                    self._dispatch_cv.notify_all()
+                return
+            time.sleep(0.05)
+
+    def HandleRegisterWorker(self, req):
+        pid = req.get("pid")
+        with self._lock:
+            proc = self._spawning_procs.pop(pid, None) if pid is not None else None
+            if proc is None and pid is not None:
+                proc = _PidHandle(pid)
+            worker = _Worker(worker_id=req["worker_id"], address=tuple(req["address"]), proc=proc)
+            self._all_workers[worker.worker_id] = worker
+            self._starting = max(0, self._starting - 1)
+            self._idle_workers.append(worker)
+            self._dispatch_cv.notify_all()
+        return {"node_id": self.node_id, "config_blob": global_config().to_blob()}
+
+    def _worker_monitor_loop(self):
+        """Detect worker-process death (reference: node_manager.cc:980)."""
+        while not self._stopped.wait(0.2):
+            dead = []
+            with self._lock:
+                for wid, w in list(self._all_workers.items()):
+                    if w.proc is not None and w.proc.poll() is not None:
+                        dead.append(w)
+                        del self._all_workers[wid]
+                        if w in self._idle_workers:
+                            self._idle_workers.remove(w)
+            for w in dead:
+                self._on_worker_death(w)
+
+    def _on_worker_death(self, w: _Worker):
+        logger.warning("raylet %s: worker %s died", self.node_id, w.worker_id)
+        with self._lock:
+            lease = self._leases.pop(w.lease_id, None) if w.lease_id else None
+            if lease is not None:
+                self._release_lease_resources(lease)
+            self._dispatch_cv.notify_all()
+        if w.dedicated_actor is not None:
+            try:
+                self.gcs.notify(
+                    "ReportActorDeath",
+                    {"actor_id": w.dedicated_actor, "reason": f"worker process {w.worker_id} exited"},
+                )
+            except Exception:  # noqa: BLE001
+                pass
+        try:
+            self.gcs.notify("Publish", {"channel": "WORKER_FAILURE", "message": {"worker_id": w.worker_id, "addr": w.address}})
+        except Exception:  # noqa: BLE001
+            pass
+
+    # ------------------------------------------------------------------
+    # Leasing + local scheduling
+    # (reference: HandleRequestWorkerLease node_manager.cc:1658,
+    #  ClusterTaskManager::QueueAndScheduleTask, LocalTaskManager dispatch)
+    # ------------------------------------------------------------------
+
+    def HandleRequestWorkerLease(self, req, reply_token=None):
+        spec: TaskSpec = req["spec"]
+        pending = _PendingLease(spec=spec, reply_token=reply_token, for_actor=req.get("for_actor", False))
+        with self._lock:
+            if self._draining:
+                self.server.send_reply(reply_token, {"rejected": True, "reason": "draining"})
+                return RpcServer.DELAYED_REPLY
+            self._pending_leases.append(pending)
+            self._dispatch_cv.notify_all()
+        return RpcServer.DELAYED_REPLY
+
+    def _dispatch_loop(self):
+        while not self._stopped.is_set():
+            with self._lock:
+                self._dispatch_cv.wait(timeout=0.2)
+                if self._stopped.is_set():
+                    return
+                self._try_dispatch_locked()
+                self._try_grant_waiting_locked()
+
+    def _try_dispatch_locked(self):
+        still_pending: deque[_PendingLease] = deque()
+        while self._pending_leases:
+            p = self._pending_leases.popleft()
+            spec = p.spec
+            strategy = spec.strategy or SchedulingStrategy()
+            if strategy.kind == "placement_group":
+                ok = self._try_dispatch_pg_locked(p)
+                if not ok:
+                    still_pending.append(p)
+                continue
+            # Pick best node cluster-wide; spill if it isn't us.
+            best = self.cluster.get_best_schedulable_node(spec.resources, strategy, prefer_node=self.node_id)
+            if best is None:
+                # Infeasible anywhere right now. If feasible on total of some
+                # node keep waiting, else reject.
+                if any(n.feasible(spec.resources) for n in self.cluster.nodes.values()):
+                    still_pending.append(p)
+                else:
+                    self.server.send_reply(
+                        p.reply_token,
+                        {"rejected": True, "reason": f"infeasible resources {spec.resources.to_dict()}"},
+                    )
+                continue
+            if best != self.node_id:
+                node = self.cluster.nodes.get(best)
+                addr = getattr(node, "address", None)
+                if addr is None:
+                    still_pending.append(p)
+                    continue
+                self.server.send_reply(p.reply_token, {"spillback": tuple(addr)})
+                continue
+            instances = self.local_resources.allocate(spec.resources)
+            if instances is None:
+                still_pending.append(p)
+                continue
+            self._grants_waiting_worker.append((p, spec.resources, instances, None, -1))
+        self._pending_leases = still_pending
+
+    def _try_dispatch_pg_locked(self, p: _PendingLease) -> bool:
+        strategy = p.spec.strategy
+        bundles = self._bundles.get(strategy.placement_group_id)
+        if not bundles:
+            # Bundle not on this node (caller routed here deliberately); reject
+            # so the caller re-resolves placement.
+            self.server.send_reply(p.reply_token, {"rejected": True, "reason": "no bundle on node"})
+            return True
+        indices = [strategy.bundle_index] if strategy.bundle_index >= 0 else sorted(bundles)
+        for i in indices:
+            b = bundles.get(i)
+            if b is None or not b.committed:
+                continue
+            if p.spec.resources.is_subset_of(b.available):
+                b.available = b.available - p.spec.resources
+                want = {
+                    name: int(p.spec.resources.get(name))
+                    for name in b.instances
+                    if int(p.spec.resources.get(name))
+                }
+                instances = {name: b.instances[name][:n] for name, n in want.items()}
+                self._grants_waiting_worker.append(
+                    (p, p.spec.resources, instances, strategy.placement_group_id, i)
+                )
+                return True
+        return False
+
+    def _try_grant_waiting_locked(self):
+        while self._grants_waiting_worker:
+            if not self._idle_workers:
+                deficit = len(self._grants_waiting_worker) - self._starting
+                can_start = global_config().maximum_startup_concurrency - self._starting
+                for _ in range(max(0, min(deficit, can_start))):
+                    self._spawn_worker()
+                return
+            p, demand, instances, pg_id, bundle_index = self._grants_waiting_worker.popleft()
+            worker = self._idle_workers.popleft()
+            self._lease_counter += 1
+            lease_id = f"{self.node_id.hex()[:8]}-{self._lease_counter}"
+            lease = _Lease(
+                lease_id=lease_id,
+                worker=worker,
+                demand=demand,
+                instances=instances,
+                pg_id=pg_id,
+                bundle_index=bundle_index,
+                for_actor=p.for_actor,
+            )
+            self._leases[lease_id] = lease
+            worker.lease_id = lease_id
+            if p.for_actor:
+                worker.dedicated_actor = p.spec.actor_id
+            self.server.send_reply(
+                p.reply_token,
+                {
+                    "worker_addr": worker.address,
+                    "worker_id": worker.worker_id,
+                    "lease_id": lease_id,
+                    "node_id": self.node_id,
+                    "resource_instances": instances,
+                    "raylet_addr": self.server.address,
+                },
+            )
+
+    def _release_lease_resources(self, lease: _Lease):
+        if lease.pg_id is not None:
+            bundles = self._bundles.get(lease.pg_id)
+            if bundles and lease.bundle_index in bundles:
+                b = bundles[lease.bundle_index]
+                b.available = (b.available + lease.demand)
+        else:
+            self.local_resources.release(lease.demand, lease.instances)
+
+    def HandleReturnWorker(self, req):
+        lease_id = req["lease_id"]
+        with self._lock:
+            lease = self._leases.pop(lease_id, None)
+            if lease is None:
+                return False
+            self._release_lease_resources(lease)
+            w = lease.worker
+            w.lease_id = None
+            if req.get("worker_exiting") or w.worker_id not in self._all_workers:
+                pass
+            else:
+                w.dedicated_actor = None
+                self._idle_workers.append(w)
+            self._dispatch_cv.notify_all()
+        return True
+
+    def HandleDrainRaylet(self, req):
+        with self._lock:
+            self._draining = True
+            pend = list(self._pending_leases)
+            self._pending_leases.clear()
+        for p in pend:
+            self.server.send_reply(p.reply_token, {"rejected": True, "reason": "draining"})
+        self.gcs.notify("DrainNode", {"node_id": self.node_id})
+        return True
+
+    # ------------------------------------------------------------------
+    # Placement-group bundles (reference: node_manager.cc:1761,1777,1794;
+    # placement_group_resource_manager.cc 2-phase)
+    # ------------------------------------------------------------------
+
+    def HandlePrepareBundles(self, req):
+        pg_id = req["pg_id"]
+        demands = {int(i): ResourceSet(r) for i, r in req["bundles"].items()}
+        with self._lock:
+            total = ResourceSet({})
+            for d in demands.values():
+                total = total + d
+            instances_all = self.local_resources.allocate(total)
+            if instances_all is None:
+                return False
+            bundles = self._bundles.setdefault(pg_id, {})
+            cursor = {k: 0 for k in instances_all}
+            for i, d in sorted(demands.items()):
+                inst: Dict[str, list] = {}
+                for name in instances_all:
+                    n = int(d.get(name))
+                    if n:
+                        inst[name] = instances_all[name][cursor[name] : cursor[name] + n]
+                        cursor[name] += n
+                bundles[i] = _Bundle(reserved=d, available=ResourceSet.from_raw(dict(d.items())), instances=inst)
+        return True
+
+    def HandleCommitBundles(self, req):
+        with self._lock:
+            for b in self._bundles.get(req["pg_id"], {}).values():
+                b.committed = True
+            self._dispatch_cv.notify_all()
+        return True
+
+    def HandleReturnBundles(self, req):
+        pg_id = req["pg_id"]
+        with self._lock:
+            bundles = self._bundles.pop(pg_id, None)
+            if not bundles:
+                return True
+            # Kill workers leased against this PG, then release reservation.
+            doomed = [l for l in self._leases.values() if l.pg_id == pg_id]
+            for lease in doomed:
+                self._leases.pop(lease.lease_id, None)
+            total = ResourceSet({})
+            instances: Dict[str, list] = {}
+            for b in bundles.values():
+                total = total + b.reserved
+                for name, ids in b.instances.items():
+                    instances.setdefault(name, []).extend(ids)
+            self.local_resources.release(total, instances)
+            self._dispatch_cv.notify_all()
+        for lease in doomed:
+            try:
+                self.pool.get(lease.worker.address).notify("Exit", {"reason": "placement group removed"})
+            except Exception:  # noqa: BLE001
+                pass
+        return True
+
+    # ------------------------------------------------------------------
+    # Plasma endpoints (worker-facing; reference: plasma/store.h)
+    # ------------------------------------------------------------------
+
+    def HandlePlasmaCreate(self, req):
+        oid = req["object_id"]
+        owner = req.get("owner_addr")
+        if owner is not None:
+            with self._lock:
+                self._object_owners[oid] = tuple(owner)
+        return self.store.create(oid, req["size"])
+
+    def HandlePlasmaSeal(self, req):
+        self.store.seal(req["object_id"])
+        return True
+
+    def HandlePlasmaContains(self, req):
+        return self.store.contains(req["object_id"])
+
+    def HandlePlasmaGet(self, req, reply_token=None):
+        oid = req["object_id"]
+        timeout = req.get("timeout")
+        got = self.store.get_shm_name(oid, timeout=0)
+        if got is not None:
+            return got
+
+        def on_seal():
+            value = self.store.get_shm_name(oid, timeout=0)
+            self.server.send_reply(reply_token, value)
+
+        already = self.store.on_sealed(oid, on_seal)
+        if already:
+            return self.store.get_shm_name(oid, timeout=0)
+        if timeout is not None:
+            def on_timeout():
+                self.store.cancel_seal_callback(oid, on_seal)
+                # Double-fire guard: if sealed raced the timer, on_seal already
+                # replied and cancel was a no-op on an absent entry.
+                if not self.store.contains(oid):
+                    self.server.send_reply(reply_token, None)
+            t = threading.Timer(timeout, on_timeout)
+            t.daemon = True
+            t.start()
+        return RpcServer.DELAYED_REPLY
+
+    def HandlePlasmaFree(self, req):
+        for oid in req["object_ids"]:
+            self.store.free(oid)
+            with self._lock:
+                self._object_owners.pop(oid, None)
+        return True
+
+    def HandleObjectSize(self, req):
+        return self.store.object_size(req["object_id"])
+
+    # ------------------------------------------------------------------
+    # Object transfer (reference: pull_manager.h:49 / push_manager.h:27 —
+    # chunked node-to-node transfer; ownership-based directory)
+    # ------------------------------------------------------------------
+
+    def HandlePullObject(self, req):
+        """Ensure object is in the local store, fetching remotely if needed."""
+        oid: ObjectID = req["object_id"]
+        if self.store.contains(oid):
+            return True
+        owner_addr = req.get("owner_addr")
+        if owner_addr is None:
+            return False
+        try:
+            loc = self.pool.get(tuple(owner_addr)).call("GetObjectLocations", {"object_id": oid})
+        except Exception:  # noqa: BLE001
+            return False
+        if loc is None:
+            return False
+        if "value_bytes" in loc:  # small object served inline by the owner
+            from ray_tpu._private import serialization
+
+            meta, raws = serialization.dumps_with_buffers(
+                serialization.loads_inline(loc["value_bytes"])
+            )
+            self.store.put_bytes(oid, meta, raws)
+            return True
+        for node_addr in loc.get("nodes", []):
+            if tuple(node_addr) == self.server.address:
+                continue
+            if self._fetch_from(tuple(node_addr), oid):
+                with self._lock:
+                    self._object_owners[oid] = tuple(owner_addr)
+                self.store.mark_secondary(oid)
+                try:
+                    self.pool.get(tuple(owner_addr)).notify(
+                        "AddObjectLocation", {"object_id": oid, "node_addr": self.server.address}
+                    )
+                except Exception:  # noqa: BLE001
+                    pass
+                return True
+        return False
+
+    def _fetch_from(self, node_addr: Tuple[str, int], oid: ObjectID) -> bool:
+        chunk = global_config().object_transfer_chunk_bytes
+        try:
+            cli = self.pool.get(node_addr)
+            size = cli.call("ObjectSize", {"object_id": oid})
+            if size is None:
+                return False
+            name = self.store.create(oid, size)
+            from ray_tpu._private.object_store import attach_shm
+
+            shm = attach_shm(name)
+            try:
+                off = 0
+                while off < size:
+                    data = cli.call(
+                        "ReadObjectChunk", {"object_id": oid, "offset": off, "length": chunk}
+                    )
+                    if data is None:
+                        return False
+                    shm.buf[off : off + len(data)] = data
+                    off += len(data)
+            finally:
+                shm.close()
+            self.store.seal(oid)
+            return True
+        except Exception:  # noqa: BLE001
+            logger.exception("fetch of %s from %s failed", oid, node_addr)
+            return False
+
+    def HandleReadObjectChunk(self, req):
+        return self.store.read_object_bytes(req["object_id"], req["offset"], req["length"])
+
+    # ------------------------------------------------------------------
+    # Introspection
+    # ------------------------------------------------------------------
+
+    def HandleGetNodeStats(self, req):
+        with self._lock:
+            return {
+                "node_id": self.node_id,
+                "num_workers": len(self._all_workers),
+                "idle_workers": len(self._idle_workers),
+                "pending_leases": len(self._pending_leases),
+                "active_leases": len(self._leases),
+                "resources": self.local_resources.snapshot(),
+                "object_store_used": self.store.used_bytes(),
+                "num_objects": len(self.store.list_objects()),
+            }
+
+
+class _PidHandle:
+    """Minimal Popen-like wrapper around a bare pid for liveness checks."""
+
+    def __init__(self, pid: int):
+        self.pid = pid
+
+    def poll(self):
+        try:
+            os.kill(self.pid, 0)
+            return None
+        except OSError:
+            return -1
+
+    def terminate(self):
+        try:
+            os.kill(self.pid, 15)
+        except OSError:
+            pass
+
+    def kill(self):
+        try:
+            os.kill(self.pid, 9)
+        except OSError:
+            pass
+
+    def wait(self, timeout=None):
+        deadline = time.monotonic() + (timeout or 0)
+        while self.poll() is None:
+            if timeout is not None and time.monotonic() > deadline:
+                raise subprocess.TimeoutExpired("pid", timeout)
+            time.sleep(0.05)
+        return -1

@@ -1,0 +1,388 @@
+"""Message-passing layer for all control-plane traffic.
+
+TPU-native equivalent of the reference's gRPC wrapper layer
+(reference: src/ray/rpc/grpc_server.h, client_call.h,
+retryable_grpc_client.cc).  We use length-prefixed pickled frames over TCP
+instead of gRPC+protobuf: every process (GCS, raylet, each worker) runs one
+``RpcServer`` on a background thread, so any process can both serve requests
+and receive pushed messages (the pubsub plane rides the same sockets).
+
+Deterministic fault injection mirrors the reference's RpcFailure chaos hooks
+(reference: src/ray/rpc/rpc_chaos.h:23-35, env RAY_testing_rpc_failure): set
+``RAY_TPU_testing_rpc_failure="Method=max_failures:req_prob:resp_prob"`` and
+matching calls will deterministically drop the request or the response.
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+import random
+import socket
+import socketserver
+import struct
+import threading
+import time
+from concurrent.futures import Future
+
+from ray_tpu._private.utils import DaemonExecutor
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from ray_tpu._private.config import global_config
+
+logger = logging.getLogger(__name__)
+
+_HEADER = struct.Struct("<QQ")  # (msg_id, payload_len)
+
+
+class RpcError(Exception):
+    pass
+
+
+class ConnectionLost(RpcError):
+    pass
+
+
+class RemoteError(RpcError):
+    """The handler on the remote side raised; carries the remote traceback."""
+
+    def __init__(self, message, remote_traceback=""):
+        super().__init__(message)
+        self.remote_traceback = remote_traceback
+
+
+# ---------------------------------------------------------------------------
+# Chaos injection (reference: src/ray/rpc/rpc_chaos.h)
+# ---------------------------------------------------------------------------
+
+
+class _RpcChaos:
+    """Deterministic request/response drop injection for tests."""
+
+    def __init__(self, spec: str):
+        self._rules: Dict[str, Tuple[int, float, float]] = {}
+        self._counts: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        self._rng = random.Random(0)
+        if spec:
+            for entry in spec.split(","):
+                method, params = entry.split("=")
+                max_failures, req_prob, resp_prob = params.split(":")
+                self._rules[method] = (int(max_failures), float(req_prob), float(resp_prob))
+
+    def check(self, method: str) -> str:
+        """Returns 'ok', 'drop_request' or 'drop_response'."""
+        if method not in self._rules:
+            return "ok"
+        with self._lock:
+            max_failures, req_prob, resp_prob = self._rules[method]
+            n = self._counts.get(method, 0)
+            if n >= max_failures:
+                return "ok"
+            r = self._rng.random()
+            if r < req_prob:
+                self._counts[method] = n + 1
+                return "drop_request"
+            if r < req_prob + resp_prob:
+                self._counts[method] = n + 1
+                return "drop_response"
+            return "ok"
+
+
+_chaos: Optional[_RpcChaos] = None
+
+
+def _get_chaos() -> _RpcChaos:
+    global _chaos
+    if _chaos is None:
+        _chaos = _RpcChaos(global_config().testing_rpc_failure)
+    return _chaos
+
+
+def reset_chaos_for_testing(spec: str):
+    global _chaos
+    _chaos = _RpcChaos(spec)
+
+
+# ---------------------------------------------------------------------------
+# Server
+# ---------------------------------------------------------------------------
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        chunk = sock.recv(min(n, 4 * 1024 * 1024))
+        if not chunk:
+            raise ConnectionLost("socket closed")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+class RpcServer:
+    """Serves registered handlers; one handler thread pool per server.
+
+    Handlers are ``fn(payload_dict) -> reply`` callables registered by method
+    name.  A handler may return ``DELAYED_REPLY`` and later call
+    ``server.send_reply(reply_token, value)`` — used for long-poll style
+    endpoints (object waits, pubsub long-polls), mirroring how the reference's
+    gRPC handlers hold ``SendReplyCallback`` for deferred replies.
+    """
+
+    DELAYED_REPLY = object()
+
+    def __init__(self, host: str = "127.0.0.1", num_threads: int = 16):
+        self._handlers: Dict[str, Callable] = {}
+        self._pool = DaemonExecutor(max_workers=num_threads, thread_name_prefix="rpc-handler")
+        self._lock = threading.Lock()
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                sock = self.request
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                send_lock = threading.Lock()
+                try:
+                    while True:
+                        header = _recv_exact(sock, _HEADER.size)
+                        msg_id, length = _HEADER.unpack(header)
+                        body = _recv_exact(sock, length)
+                        outer._pool.submit(outer._dispatch, sock, send_lock, msg_id, body)
+                except (ConnectionLost, ConnectionResetError, OSError):
+                    pass
+
+        class Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = Server((host, 0), Handler)
+        self._host, self._port = self._server.server_address
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True, name="rpc-server")
+        self._thread.start()
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return (self._host, self._port)
+
+    def register(self, method: str, fn: Callable):
+        self._handlers[method] = fn
+
+    def register_all(self, obj: Any, prefix: str = ""):
+        """Register every public method of ``obj`` named ``Handle*``."""
+        for name in dir(obj):
+            if name.startswith("Handle"):
+                self._handlers[prefix + name[len("Handle"):]] = getattr(obj, name)
+
+    def _dispatch(self, sock, send_lock, msg_id, body):
+        try:
+            method, payload = pickle.loads(body)
+        except Exception:
+            logger.exception("rpc: undecodable frame")
+            return
+        chaos = _get_chaos().check(method)
+        if chaos == "drop_request":
+            return  # server never saw it
+        handler = self._handlers.get(method)
+        reply_token = (sock, send_lock, msg_id)
+        try:
+            if handler is None:
+                raise RpcError(f"no handler for method {method!r}")
+            result = handler(payload) if handler.__code__.co_argcount <= (2 if hasattr(handler, "__self__") else 1) else handler(payload, reply_token)
+            if result is RpcServer.DELAYED_REPLY:
+                return
+            frame = pickle.dumps(("ok", result), protocol=5)
+        except Exception as e:  # noqa: BLE001
+            import traceback
+
+            frame = pickle.dumps(("err", (str(e), traceback.format_exc(), e)), protocol=5)
+        if chaos == "drop_response":
+            return
+        self._send_frame(sock, send_lock, msg_id, frame)
+
+    def send_reply(self, reply_token, value):
+        sock, send_lock, msg_id = reply_token
+        frame = pickle.dumps(("ok", value), protocol=5)
+        self._send_frame(sock, send_lock, msg_id, frame)
+
+    def send_error_reply(self, reply_token, exc: Exception):
+        sock, send_lock, msg_id = reply_token
+        frame = pickle.dumps(("err", (str(exc), "", exc)), protocol=5)
+        self._send_frame(sock, send_lock, msg_id, frame)
+
+    @staticmethod
+    def _send_frame(sock, send_lock, msg_id, frame):
+        try:
+            with send_lock:
+                sock.sendall(_HEADER.pack(msg_id, len(frame)) + frame)
+        except OSError:
+            pass  # client went away; nothing to do
+
+    def shutdown(self):
+        try:
+            self._server.shutdown()
+            self._server.server_close()
+        except Exception:  # noqa: BLE001
+            pass
+        self._pool.shutdown(wait=False, cancel_futures=True)
+
+
+# ---------------------------------------------------------------------------
+# Client
+# ---------------------------------------------------------------------------
+
+
+class RpcClient:
+    """Thread-safe client with concurrent in-flight requests and retry.
+
+    Mirrors the reference's RetryableGrpcClient (retryable_grpc_client.cc):
+    calls retry on connection loss up to a deadline, with exponential backoff.
+    """
+
+    def __init__(self, address: Tuple[str, int], connect_timeout: Optional[float] = None):
+        self._address = tuple(address)
+        self._sock: Optional[socket.socket] = None
+        self._send_lock = threading.Lock()
+        self._state_lock = threading.Lock()
+        self._futures: Dict[int, Future] = {}
+        self._next_id = 0
+        self._reader: Optional[threading.Thread] = None
+        self._closed = False
+        self._connect_timeout = connect_timeout or global_config().rpc_connect_timeout_s
+
+    @property
+    def address(self):
+        return self._address
+
+    def _ensure_connected(self):
+        with self._state_lock:
+            if self._sock is not None:
+                return
+            if self._closed:
+                raise ConnectionLost("client closed")
+            # Single attempt: callers that need to wait for a server to come
+            # up use RpcClient.call's retry loop; async callers want fast
+            # failure (e.g. the actor pipeline probing a dead incarnation).
+            try:
+                sock = socket.create_connection(self._address, timeout=self._connect_timeout)
+            except OSError:
+                raise ConnectionLost(f"cannot connect to {self._address}")
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            sock.settimeout(None)
+            self._sock = sock
+            self._reader = threading.Thread(target=self._read_loop, args=(sock,), daemon=True, name="rpc-client-reader")
+            self._reader.start()
+
+    def _read_loop(self, sock):
+        try:
+            while True:
+                header = _recv_exact(sock, _HEADER.size)
+                msg_id, length = _HEADER.unpack(header)
+                body = _recv_exact(sock, length)
+                fut = self._futures.pop(msg_id, None)
+                if fut is None:
+                    continue
+                status, value = pickle.loads(body)
+                if status == "ok":
+                    fut.set_result(value)
+                else:
+                    msg, tb, exc = value
+                    if isinstance(exc, Exception) and not isinstance(exc, RpcError):
+                        fut.set_exception(exc)
+                    else:
+                        fut.set_exception(RemoteError(msg, tb))
+        except (ConnectionLost, ConnectionResetError, OSError):
+            self._on_disconnect(sock)
+
+    def _on_disconnect(self, sock):
+        with self._state_lock:
+            if self._sock is sock:
+                self._sock = None
+        stale = list(self._futures.items())
+        self._futures.clear()
+        for _, fut in stale:
+            if not fut.done():
+                fut.set_exception(ConnectionLost(f"connection to {self._address} lost"))
+
+    def call_async(self, method: str, payload: Any = None) -> Future:
+        self._ensure_connected()
+        with self._state_lock:
+            self._next_id += 1
+            msg_id = self._next_id
+        fut: Future = Future()
+        self._futures[msg_id] = fut
+        frame = pickle.dumps((method, payload), protocol=5)
+        try:
+            with self._send_lock:
+                self._sock.sendall(_HEADER.pack(msg_id, len(frame)) + frame)
+        except (OSError, AttributeError):
+            self._futures.pop(msg_id, None)
+            with self._state_lock:
+                self._sock = None
+            raise ConnectionLost(f"send to {self._address} failed")
+        return fut
+
+    def call(self, method: str, payload: Any = None, timeout: Optional[float] = None,
+             retry_deadline: Optional[float] = None) -> Any:
+        """Synchronous call with transparent reconnect-and-retry."""
+        timeout = timeout if timeout is not None else global_config().gcs_rpc_timeout_s
+        deadline = time.monotonic() + (retry_deadline if retry_deadline is not None else timeout)
+        delay = 0.02
+        while True:
+            try:
+                fut = self.call_async(method, payload)
+                return fut.result(timeout=timeout)
+            except ConnectionLost:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(delay)
+                delay = min(delay * 2, 0.5)
+
+    def notify(self, method: str, payload: Any = None):
+        """Fire-and-forget (reply is still sent by the server, but ignored)."""
+        try:
+            fut = self.call_async(method, payload)
+            fut.add_done_callback(lambda f: f.exception())  # swallow
+        except ConnectionLost:
+            pass
+
+    def close(self):
+        with self._state_lock:
+            self._closed = True
+            sock = self._sock
+            self._sock = None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
+class ClientPool:
+    """Caches one RpcClient per address. Shared by a whole process."""
+
+    def __init__(self):
+        self._clients: Dict[Tuple[str, int], RpcClient] = {}
+        self._lock = threading.Lock()
+
+    def get(self, address: Tuple[str, int]) -> RpcClient:
+        address = tuple(address)
+        with self._lock:
+            cli = self._clients.get(address)
+            if cli is None:
+                cli = RpcClient(address)
+                self._clients[address] = cli
+            return cli
+
+    def invalidate(self, address: Tuple[str, int]):
+        with self._lock:
+            cli = self._clients.pop(tuple(address), None)
+        if cli is not None:
+            cli.close()
+
+    def close_all(self):
+        with self._lock:
+            clients = list(self._clients.values())
+            self._clients.clear()
+        for c in clients:
+            c.close()

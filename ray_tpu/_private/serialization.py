@@ -1,0 +1,90 @@
+"""Object serialization with zero-copy buffer support.
+
+TPU-native equivalent of the reference's serialization stack
+(reference: python/ray/_private/serialization.py + cloudpickle): cloudpickle
+for code/closures, pickle protocol 5 out-of-band buffers so large numpy/jax
+host arrays round-trip through the shared-memory store without copies on the
+read side.
+
+Wire layout of a stored object (one contiguous region in the store):
+
+    [8B meta_len][meta = pickle((inband, [len0, len1, ...]))]
+    [align64][buffer0][align64][buffer1]...
+
+Buffer offsets are recomputed by the reader from the lengths with the same
+alignment rule, so the layout needs no absolute offsets.  Buffers are 64-byte
+aligned so reconstructed numpy arrays are alignment-friendly.
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+from typing import List, Tuple
+
+import cloudpickle
+
+_ALIGN = 64
+_LEN = struct.Struct("<Q")
+
+
+def _aligned(x: int) -> int:
+    return (x + _ALIGN - 1) // _ALIGN * _ALIGN
+
+
+def dumps_with_buffers(obj) -> Tuple[bytes, List[memoryview]]:
+    """Returns (meta_bytes, raw_buffers). Total size via serialized_size."""
+    pbufs: List[pickle.PickleBuffer] = []
+    inband = cloudpickle.dumps(obj, protocol=5, buffer_callback=pbufs.append)
+    raws = []
+    for pb in pbufs:
+        try:
+            raws.append(pb.raw())
+        except BufferError:
+            # Non-contiguous buffer: copy to contiguous bytes.
+            raws.append(memoryview(bytes(pb)))
+    meta = pickle.dumps((inband, [r.nbytes for r in raws]), protocol=5)
+    return meta, raws
+
+
+def serialized_size(meta: bytes, raws) -> int:
+    offset = _LEN.size + len(meta)
+    for r in raws:
+        offset = _aligned(offset) + r.nbytes
+    return offset
+
+
+def write_to(view: memoryview, meta: bytes, raws) -> int:
+    """Serialize into ``view``; returns total bytes written."""
+    view[: _LEN.size] = _LEN.pack(len(meta))
+    offset = _LEN.size
+    view[offset : offset + len(meta)] = meta
+    offset += len(meta)
+    for r in raws:
+        offset = _aligned(offset)
+        n = r.nbytes
+        view[offset : offset + n] = r.cast("B")
+        offset += n
+    return offset
+
+
+def read_from(view: memoryview):
+    """Zero-copy deserialize from ``view`` (buffers alias the view)."""
+    (meta_len,) = _LEN.unpack(view[: _LEN.size])
+    inband, lengths = pickle.loads(view[_LEN.size : _LEN.size + meta_len])
+    offset = _LEN.size + meta_len
+    buffers = []
+    for n in lengths:
+        offset = _aligned(offset)
+        buffers.append(view[offset : offset + n])
+        offset += n
+    return pickle.loads(inband, buffers=buffers)
+
+
+def dumps_inline(obj) -> bytes:
+    """One-shot in-band serialization for small objects (RPC payloads)."""
+    return cloudpickle.dumps(obj, protocol=5)
+
+
+def loads_inline(data: bytes):
+    return pickle.loads(data)

@@ -1,0 +1,102 @@
+"""Task and actor specifications + user-facing error types.
+
+TPU-native equivalent of the reference's TaskSpecification
+(reference: src/ray/common/task/task_spec.h) and exception hierarchy
+(reference: python/ray/exceptions.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
+from ray_tpu._private.resources import ResourceSet
+from ray_tpu._private.scheduler import SchedulingStrategy
+
+
+@dataclass
+class TaskSpec:
+    task_id: TaskID
+    job_id: JobID
+    name: str
+    # Function payload: cloudpickled callable, cached in GCS KV by digest so
+    # repeated submissions ship only the 40-char key
+    # (reference: _private/function_manager.py export/import pattern).
+    function_digest: str
+    function_blob: Optional[bytes]  # present on first submission, else None
+    # Positional/kw args: values are either inline serialized bytes or ObjectIDs.
+    args: List[Tuple[str, Any]] = field(default_factory=list)  # ("value", bytes) | ("ref", (ObjectID, owner_addr))
+    kwargs: List[Tuple[str, str, Any]] = field(default_factory=list)  # (key, kind, payload)
+    num_returns: int = 1
+    resources: ResourceSet = field(default_factory=lambda: ResourceSet({"CPU": 1}))
+    strategy: SchedulingStrategy = field(default_factory=SchedulingStrategy)
+    max_retries: int = 3
+    retry_exceptions: bool = False
+    attempt: int = 0
+    owner_addr: Optional[Tuple[str, int]] = None
+    owner_worker_id: Optional[WorkerID] = None
+    runtime_env: Optional[dict] = None
+    # Actor fields
+    actor_id: Optional[ActorID] = None           # set for actor tasks
+    actor_creation: bool = False                 # this task creates an actor
+    actor_method: Optional[str] = None
+    sequence_number: int = 0                     # per-caller ordering for actor tasks
+    max_concurrency: int = 1
+    max_restarts: int = 0
+    max_task_retries: int = 0
+    detached: bool = False
+    actor_name: Optional[str] = None
+
+    def return_ids(self) -> List[ObjectID]:
+        return [ObjectID.from_task(self.task_id, i) for i in range(self.num_returns)]
+
+
+class RayTpuError(Exception):
+    pass
+
+
+class TaskError(RayTpuError):
+    """Wraps an exception raised by user task code; re-raised at ray.get."""
+
+    def __init__(self, cause: Exception, traceback_str: str, task_name: str = ""):
+        super().__init__(f"task {task_name!r} failed: {cause!r}")
+        self.cause = cause
+        self.traceback_str = traceback_str
+
+
+class WorkerCrashedError(RayTpuError):
+    pass
+
+
+class ActorDiedError(RayTpuError):
+    def __init__(self, actor_id=None, reason: str = ""):
+        super().__init__(f"actor {actor_id} died: {reason}")
+        self.actor_id = actor_id
+        self.reason = reason
+
+
+class ActorUnavailableError(RayTpuError):
+    pass
+
+
+class ObjectLostError(RayTpuError):
+    def __init__(self, object_id=None):
+        super().__init__(f"object {object_id} lost and could not be reconstructed")
+        self.object_id = object_id
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    pass
+
+
+class TaskCancelledError(RayTpuError):
+    pass
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    pass
+
+
+class PendingCallsLimitExceeded(RayTpuError):
+    pass

@@ -1,0 +1,65 @@
+"""Small shared utilities."""
+
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import Future
+from typing import Callable
+
+
+class DaemonExecutor:
+    """Minimal thread pool whose threads are daemonic, so interpreter exit is
+    never blocked by in-flight RPC waits (unlike concurrent.futures'
+    ThreadPoolExecutor, whose atexit hook joins worker threads)."""
+
+    def __init__(self, max_workers: int, thread_name_prefix: str = "daemon-pool"):
+        self._max = max_workers
+        self._prefix = thread_name_prefix
+        self._q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._threads: list[threading.Thread] = []
+        self._lock = threading.Lock()
+        self._idle = 0
+        self._shutdown = False
+
+    def submit(self, fn: Callable, *args, **kwargs) -> Future:
+        fut: Future = Future()
+        with self._lock:
+            if self._shutdown:
+                fut.set_exception(RuntimeError("executor shut down"))
+                return fut
+            self._q.put((fut, fn, args, kwargs))
+            if self._idle == 0 and len(self._threads) < self._max:
+                t = threading.Thread(
+                    target=self._run, daemon=True, name=f"{self._prefix}-{len(self._threads)}"
+                )
+                self._threads.append(t)
+                t.start()
+        return fut
+
+    def _run(self):
+        while True:
+            with self._lock:
+                self._idle += 1
+            item = self._q.get()
+            with self._lock:
+                self._idle -= 1
+            if item is None:
+                return
+            fut, fn, args, kwargs = item
+            if self._shutdown:
+                fut.cancel()
+                continue
+            if not fut.set_running_or_notify_cancel():
+                continue
+            try:
+                fut.set_result(fn(*args, **kwargs))
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+    def shutdown(self, wait: bool = False, cancel_futures: bool = False):
+        with self._lock:
+            self._shutdown = True
+            n = len(self._threads)
+        for _ in range(n):
+            self._q.put(None)

@@ -1,0 +1,1255 @@
+"""Per-process worker runtime: task submission/execution, ownership, memory.
+
+TPU-native rebuild of the reference CoreWorker
+(reference: src/ray/core_worker/core_worker.h:167 — SubmitTask :853,
+CreateActor :878, SubmitActorTask :935, Put :482, Get :656,
+ExecuteTask core_worker.cc:2804; TaskManager task_manager.h:170 for retries +
+lineage; ReferenceCounter reference_count.h:73 for distributed refcounting;
+NormalTaskSubmitter task_submission/normal_task_submitter.cc:29;
+ActorTaskSubmitter + sequence-numbered receiver queues
+task_execution/actor_scheduling_queue.cc).
+
+The cross-layer invariant is the reference's ownership model: the process
+that creates an ObjectRef owns it, holds its value (small objects) or its
+location directory (plasma objects), its lineage, and its reference count.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import threading
+import time
+import traceback
+from collections import defaultdict
+from ray_tpu._private.utils import DaemonExecutor
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from ray_tpu._private import serialization
+from ray_tpu._private.accelerators import bind_visible_accelerators
+from ray_tpu._private.config import global_config
+from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
+from ray_tpu._private.object_store import PlasmaClient
+from ray_tpu._private.rpc import ClientPool, ConnectionLost, RemoteError, RpcServer
+from ray_tpu._private.task_spec import (
+    ActorDiedError,
+    ActorUnavailableError,
+    GetTimeoutError,
+    ObjectLostError,
+    TaskCancelledError,
+    TaskError,
+    TaskSpec,
+    WorkerCrashedError,
+)
+
+logger = logging.getLogger(__name__)
+
+DRIVER = "driver"
+WORKER = "worker"
+
+
+class ObjectRef:
+    """A reference to a (possibly not-yet-computed) object.
+
+    Carries (object_id, owner address) in-band so any process can resolve it
+    by talking to the owner (reference: ownership model, reference_count.h:73).
+    """
+
+    __slots__ = ("id", "owner_addr", "_registered", "__weakref__")
+
+    def __init__(self, object_id: ObjectID, owner_addr: Tuple[str, int], _register: bool = True):
+        self.id = object_id
+        self.owner_addr = tuple(owner_addr) if owner_addr else None
+        self._registered = False
+        w = _global_worker
+        if _register and w is not None:
+            w.reference_counter.add_local_ref(self)
+            self._registered = True
+
+    def hex(self):
+        return self.id.hex()
+
+    def __repr__(self):
+        return f"ObjectRef({self.id.hex()[:16]})"
+
+    def __hash__(self):
+        return hash(self.id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and self.id == other.id
+
+    def __reduce__(self):
+        # Serializing a ref hands it to a borrower; note the handoff so the
+        # owner's count survives the transit (reference: reference_count.h:428).
+        w = _global_worker
+        if w is not None and not w.shutting_down:
+            w.reference_counter.on_ref_serialized(self)
+        return (_deserialize_ref, (self.id, self.owner_addr))
+
+    def __del__(self):
+        if not self._registered:
+            return
+        w = _global_worker
+        if w is not None and not w.shutting_down:
+            try:
+                w.reference_counter.remove_local_ref(self)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def future(self):
+        from concurrent.futures import Future
+
+        fut: Future = Future()
+
+        def run():
+            try:
+                fut.set_result(get(self))
+            except Exception as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        threading.Thread(target=run, daemon=True).start()
+        return fut
+
+
+def _deserialize_ref(object_id, owner_addr):
+    ref = ObjectRef(object_id, owner_addr, _register=True)
+    w = _global_worker
+    if w is not None and not w.shutting_down:
+        w.reference_counter.on_ref_deserialized(ref)
+    return ref
+
+
+class ReferenceCounter:
+    """Owner-side + borrower-side reference bookkeeping.
+
+    reference: src/ray/core_worker/reference_count.h:73 (owned counts),
+    :428,568-574 (borrower registration).  Owned objects are freed — memory
+    value dropped, plasma copies freed cluster-wide, lineage released — when
+    local refs + in-flight submissions + registered borrowers all reach zero.
+    """
+
+    def __init__(self, worker: "CoreWorker"):
+        self._w = worker
+        self._lock = threading.Lock()
+        self._local: Dict[ObjectID, int] = defaultdict(int)
+        self._owned_submitted: Dict[ObjectID, int] = defaultdict(int)  # args of in-flight tasks
+        self._borrowers: Dict[ObjectID, Set[Tuple[str, int]]] = defaultdict(set)
+        self._in_transit: Dict[ObjectID, int] = defaultdict(int)
+
+    # -- local handles ---------------------------------------------------
+
+    def add_local_ref(self, ref: ObjectRef):
+        with self._lock:
+            self._local[ref.id] += 1
+
+    def remove_local_ref(self, ref: ObjectRef):
+        owner_is_self = ref.owner_addr == self._w.address
+        with self._lock:
+            self._local[ref.id] -= 1
+            if self._local[ref.id] > 0:
+                return
+            del self._local[ref.id]
+        if owner_is_self:
+            self._maybe_free(ref.id)
+        else:
+            # Borrower released its last handle: tell the owner.
+            self._w.notify_owner(ref.owner_addr, "RemoveBorrower", {"object_id": ref.id, "borrower": self._w.address})
+
+    # -- transit / borrowers --------------------------------------------
+
+    def on_ref_serialized(self, ref: ObjectRef):
+        if ref.owner_addr == self._w.address:
+            with self._lock:
+                self._in_transit[ref.id] += 1
+        else:
+            # A borrower forwarding the ref: piggy-back a borrow registration.
+            self._w.notify_owner(ref.owner_addr, "AddBorrowerTransit", {"object_id": ref.id})
+
+    def on_ref_deserialized(self, ref: ObjectRef):
+        if ref.owner_addr != self._w.address:
+            self._w.notify_owner(ref.owner_addr, "AddBorrower", {"object_id": ref.id, "borrower": self._w.address})
+        else:
+            with self._lock:
+                if self._in_transit.get(ref.id, 0) > 0:
+                    self._in_transit[ref.id] -= 1
+
+    # owner-side handlers
+    def handle_add_borrower(self, object_id: ObjectID, borrower):
+        with self._lock:
+            self._borrowers[object_id].add(tuple(borrower))
+            if self._in_transit.get(object_id, 0) > 0:
+                self._in_transit[object_id] -= 1
+
+    def handle_add_borrower_transit(self, object_id: ObjectID):
+        with self._lock:
+            self._in_transit[object_id] += 1
+
+    def handle_remove_borrower(self, object_id: ObjectID, borrower):
+        with self._lock:
+            self._borrowers[object_id].discard(tuple(borrower))
+        self._maybe_free(object_id)
+
+    # -- task-arg pinning ------------------------------------------------
+
+    def add_submitted_ref(self, object_id: ObjectID):
+        with self._lock:
+            self._owned_submitted[object_id] += 1
+
+    def remove_submitted_ref(self, object_id: ObjectID):
+        with self._lock:
+            self._owned_submitted[object_id] -= 1
+            if self._owned_submitted[object_id] <= 0:
+                del self._owned_submitted[object_id]
+        self._maybe_free(object_id)
+
+    # -- freeing ---------------------------------------------------------
+
+    def _maybe_free(self, object_id: ObjectID):
+        with self._lock:
+            if (
+                self._local.get(object_id, 0) > 0
+                or self._owned_submitted.get(object_id, 0) > 0
+                or self._borrowers.get(object_id)
+                or self._in_transit.get(object_id, 0) > 0
+            ):
+                return
+            self._borrowers.pop(object_id, None)
+            self._in_transit.pop(object_id, None)
+        self._w.free_owned_object(object_id)
+
+
+class TaskManager:
+    """Owner-side task bookkeeping: pending set, retries, lineage.
+
+    reference: src/ray/core_worker/task_manager.h:170 (retries + lineage),
+    :489-493 (objects pending reconstruction).
+    """
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.cv = threading.Condition(self.lock)
+        self.pending: Dict[TaskID, TaskSpec] = {}
+        self.lineage: Dict[ObjectID, TaskSpec] = {}
+        self.reconstructing: Set[ObjectID] = set()
+
+    def add_pending(self, spec: TaskSpec):
+        with self.lock:
+            self.pending[spec.task_id] = spec
+            for oid in spec.return_ids():
+                self.lineage[oid] = spec
+
+    def complete(self, task_id: TaskID):
+        with self.lock:
+            self.pending.pop(task_id, None)
+            self.cv.notify_all()
+
+    def is_pending(self, task_id: TaskID) -> bool:
+        with self.lock:
+            return task_id in self.pending
+
+    def spec_for_object(self, object_id: ObjectID) -> Optional[TaskSpec]:
+        with self.lock:
+            return self.lineage.get(object_id)
+
+    def release_lineage(self, object_id: ObjectID):
+        with self.lock:
+            self.lineage.pop(object_id, None)
+
+
+class CoreWorker:
+    """One per process (driver or worker)."""
+
+    def __init__(
+        self,
+        mode: str,
+        raylet_addr: Tuple[str, int],
+        gcs_addr: Tuple[str, int],
+        job_id: Optional[JobID] = None,
+        node_id: Optional[NodeID] = None,
+    ):
+        self.mode = mode
+        self.worker_id = WorkerID.random()
+        self.shutting_down = False
+        self.pool = ClientPool()
+        self.raylet = self.pool.get(tuple(raylet_addr))
+        self.gcs = self.pool.get(tuple(gcs_addr))
+        self.node_id = node_id
+        self.plasma = PlasmaClient(self.raylet)
+        self.server = RpcServer()
+        self.server.register_all(self)
+
+        self.memory_store: Dict[ObjectID, Any] = {}
+        self.object_locations: Dict[ObjectID, Set[Tuple[str, int]]] = defaultdict(set)
+        self.object_errors: Dict[ObjectID, Exception] = {}
+        self._store_lock = threading.Lock()
+        self._store_cv = threading.Condition(self._store_lock)
+
+        self.reference_counter = ReferenceCounter(self)
+        self.task_manager = TaskManager()
+        self._submit_pool = DaemonExecutor(max_workers=8, thread_name_prefix="task-submit")
+        self._exec_pool = DaemonExecutor(max_workers=1, thread_name_prefix="task-exec")
+        self._published_fns: Set[str] = set()
+        self._fn_cache: Dict[str, Any] = {}
+        self._put_counter = 0
+        self._counter_lock = threading.Lock()
+        self._task_events: List[dict] = []
+
+        # Actor-related state (server side: this worker hosts an actor)
+        self.actor_id: Optional[ActorID] = None  # set when this worker hosts an actor
+        self._actor_instance = None
+        self._actor_spec: Optional[TaskSpec] = None
+        self._actor_lease: Optional[dict] = None
+        self._actor_exec_pool: Optional[DaemonExecutor] = None
+        self._actor_seq_lock = threading.Lock()
+        # per-caller ordered arrival queues (reference: ActorSchedulingQueue):
+        # caller -> {"epoch": int, "next": int, "pending": {(epoch, seq): item}}
+        self._actor_callers: Dict[str, dict] = {}
+        # Client-side actor handle state
+        self._actor_addr_cache: Dict[ActorID, Tuple[str, int]] = {}
+        self._actor_state_cache: Dict[ActorID, str] = {}
+        self._actor_pipelines: Dict[ActorID, "_ActorPipeline"] = {}
+        self._actor_lock = threading.Lock()
+        self._actor_cv = threading.Condition(self._actor_lock)
+
+        self.job_id = job_id
+        if mode == DRIVER:
+            self.job_id = self.gcs.call("RegisterJob", {"driver_addr": self.server.address})
+
+        self.current_task_id: Optional[TaskID] = None
+
+    # ------------------------------------------------------------------
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self.server.address
+
+    def shutdown(self):
+        self.shutting_down = True
+        if self.mode == DRIVER and self.job_id is not None:
+            try:
+                self.gcs.call("JobFinished", {"job_id": self.job_id}, timeout=5)
+            except Exception:  # noqa: BLE001
+                pass
+        self._submit_pool.shutdown(wait=False, cancel_futures=True)
+        self._exec_pool.shutdown(wait=False, cancel_futures=True)
+        self.server.shutdown()
+        self.plasma.close()
+        self.pool.close_all()
+
+    def notify_owner(self, owner_addr, method, payload):
+        if owner_addr is None or self.shutting_down:
+            return
+        try:
+            self.pool.get(tuple(owner_addr)).notify(method, payload)
+        except Exception:  # noqa: BLE001
+            pass
+
+    # ------------------------------------------------------------------
+    # Put / Get / Wait / Free
+    # ------------------------------------------------------------------
+
+    def put(self, value) -> ObjectRef:
+        with self._counter_lock:
+            self._put_counter += 1
+            oid = ObjectID.from_put(self.worker_id, self._put_counter)
+        self._store_value(oid, value)
+        return ObjectRef(oid, self.address)
+
+    def _store_value(self, oid: ObjectID, value):
+        """Store an owned value: small → memory store, large → local plasma."""
+        meta, raws = serialization.dumps_with_buffers(value)
+        size = serialization.serialized_size(meta, raws)
+        if size <= global_config().max_inline_object_size:
+            with self._store_lock:
+                self.memory_store[oid] = value
+                self._store_cv.notify_all()
+        else:
+            shm_name = self.raylet.call("PlasmaCreate", {"object_id": oid, "size": size, "owner_addr": self.address})
+            from ray_tpu._private.object_store import attach_shm
+
+            shm = attach_shm(shm_name)
+            try:
+                serialization.write_to(shm.buf, meta, raws)
+            finally:
+                shm.close()
+            self.raylet.call("PlasmaSeal", {"object_id": oid})
+            with self._store_lock:
+                self.object_locations[oid].add(tuple(self._raylet_addr()))
+                self._store_cv.notify_all()
+
+    def _raylet_addr(self):
+        return self.raylet.address
+
+    def get(self, refs, timeout: Optional[float] = None):
+        single = isinstance(refs, ObjectRef)
+        if single:
+            refs = [refs]
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out = [self._get_one(r, deadline) for r in refs]
+        for v in out:
+            if isinstance(v, TaskError):
+                raise v.cause from None
+            if isinstance(v, Exception) and isinstance(v, (ActorDiedError, ObjectLostError, WorkerCrashedError, TaskCancelledError)):
+                raise v
+        return out[0] if single else out
+
+    def _remaining(self, deadline):
+        if deadline is None:
+            return None
+        rem = deadline - time.monotonic()
+        if rem <= 0:
+            raise GetTimeoutError("ray_tpu.get timed out")
+        return rem
+
+    def _get_one(self, ref: ObjectRef, deadline):
+        oid = ref.id
+        owner_is_self = ref.owner_addr == self.address or ref.owner_addr is None
+        backoff = 0.001
+        while True:
+            # 1. local memory store
+            with self._store_lock:
+                if oid in self.memory_store:
+                    return self.memory_store[oid]
+                err = self.object_errors.get(oid)
+            if err is not None:
+                return err
+            # 2. local plasma
+            found, value = self._try_local_plasma(oid)
+            if found:
+                return value
+            if owner_is_self:
+                got = self._get_owned(oid, deadline)
+            else:
+                got = self._get_borrowed(ref, deadline)
+            if got is not _PENDING:
+                return got
+            self._remaining(deadline)
+            time.sleep(backoff)
+            backoff = min(backoff * 2, 0.05)
+
+    def _try_local_plasma(self, oid):
+        try:
+            if self.plasma.contains(oid):
+                return self.plasma.get(oid, timeout=0)
+        except Exception:  # noqa: BLE001
+            pass
+        return False, None
+
+    def _get_owned(self, oid: ObjectID, deadline):
+        # Value lives in plasma somewhere; pull to local store.
+        with self._store_lock:
+            locations = set(self.object_locations.get(oid, ()))
+        if locations:
+            ok = self.raylet.call(
+                "PullObject", {"object_id": oid, "owner_addr": self.address},
+                timeout=global_config().gcs_rpc_timeout_s,
+            )
+            if ok:
+                found, value = self._try_local_plasma(oid)
+                if found:
+                    return value
+            # All copies lost → lineage reconstruction
+            # (reference: object_recovery_manager.h:41).
+            if self._try_reconstruct(oid):
+                return _PENDING
+            return ObjectLostError(oid)
+        # No locations: task still running (or value in flight).
+        if self.task_manager.spec_for_object(oid) is not None or oid in self._pending_put_ids():
+            return _PENDING
+        return _PENDING  # puts in progress / unknown; caller enforces timeout
+
+    def _pending_put_ids(self):
+        return ()
+
+    def _try_reconstruct(self, oid: ObjectID) -> bool:
+        if not global_config().lineage_reconstruction_enabled:
+            return False
+        spec = self.task_manager.spec_for_object(oid)
+        if spec is None or spec.actor_id is not None:
+            return False
+        with self.task_manager.lock:
+            if oid in self.task_manager.reconstructing:
+                return True
+            if spec.max_retries <= 0:
+                return False
+            spec.max_retries -= 1
+            for roid in spec.return_ids():
+                self.task_manager.reconstructing.add(roid)
+        logger.info("reconstructing %s by re-executing task %s", oid, spec.name)
+        spec.attempt += 1
+        with self._store_lock:
+            for roid in spec.return_ids():
+                self.object_locations.pop(roid, None)
+        self.task_manager.add_pending(spec)
+        self._submit_pool.submit(self._submit_with_retries, spec)
+        return True
+
+    def _get_borrowed(self, ref: ObjectRef, deadline):
+        try:
+            loc = self.pool.get(ref.owner_addr).call(
+                "GetObjectLocations", {"object_id": ref.id}, timeout=global_config().gcs_rpc_timeout_s
+            )
+        except (ConnectionLost, RemoteError):
+            return ObjectLostError(ref.id)
+        if loc is None:
+            return _PENDING
+        if "error" in loc:
+            return loc["error"]
+        if "value_bytes" in loc:
+            value = serialization.loads_inline(loc["value_bytes"])
+            with self._store_lock:
+                self.memory_store[ref.id] = value
+            return value
+        ok = self.raylet.call(
+            "PullObject", {"object_id": ref.id, "owner_addr": ref.owner_addr},
+            timeout=global_config().gcs_rpc_timeout_s,
+        )
+        if ok:
+            found, value = self._try_local_plasma(ref.id)
+            if found:
+                return value
+        return _PENDING
+
+    def wait(self, refs: List[ObjectRef], num_returns=1, timeout=None, fetch_local=True):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        ready: List[ObjectRef] = []
+        pending = list(refs)
+        while True:
+            still = []
+            for r in pending:
+                if self._is_ready(r):
+                    ready.append(r)
+                else:
+                    still.append(r)
+            pending = still
+            if len(ready) >= num_returns or not pending:
+                return ready, pending
+            if deadline is not None and time.monotonic() >= deadline:
+                return ready, pending
+            time.sleep(0.005)
+
+    def _is_ready(self, ref: ObjectRef) -> bool:
+        with self._store_lock:
+            if ref.id in self.memory_store or ref.id in self.object_errors:
+                return True
+            if ref.owner_addr == self.address and self.object_locations.get(ref.id):
+                return True
+        if ref.owner_addr != self.address and ref.owner_addr is not None:
+            try:
+                loc = self.pool.get(ref.owner_addr).call("GetObjectLocations", {"object_id": ref.id}, timeout=5)
+                return loc is not None
+            except Exception:  # noqa: BLE001
+                return False
+        try:
+            return self.plasma.contains(ref.id)
+        except Exception:  # noqa: BLE001
+            return False
+
+    def free_owned_object(self, oid: ObjectID):
+        with self._store_lock:
+            self.memory_store.pop(oid, None)
+            self.object_errors.pop(oid, None)
+            locations = self.object_locations.pop(oid, set())
+        self.task_manager.release_lineage(oid)
+        for node_addr in locations:
+            try:
+                self.pool.get(node_addr).notify("PlasmaFree", {"object_ids": [oid]})
+            except Exception:  # noqa: BLE001
+                pass
+
+    # ------------------------------------------------------------------
+    # Owner-side handlers (object directory + refcounting RPCs)
+    # ------------------------------------------------------------------
+
+    def HandleGetObjectLocations(self, req):
+        oid = req["object_id"]
+        with self._store_lock:
+            if oid in self.object_errors:
+                return {"error": self.object_errors[oid]}
+            if oid in self.memory_store:
+                return {"value_bytes": serialization.dumps_inline(self.memory_store[oid])}
+            locs = self.object_locations.get(oid)
+            if locs:
+                return {"nodes": [list(a) for a in locs]}
+        return None  # still pending
+
+    def HandleAddObjectLocation(self, req):
+        with self._store_lock:
+            self.object_locations[req["object_id"]].add(tuple(req["node_addr"]))
+        return True
+
+    def HandleAddBorrower(self, req):
+        self.reference_counter.handle_add_borrower(req["object_id"], req["borrower"])
+        return True
+
+    def HandleAddBorrowerTransit(self, req):
+        self.reference_counter.handle_add_borrower_transit(req["object_id"])
+        return True
+
+    def HandleRemoveBorrower(self, req):
+        self.reference_counter.handle_remove_borrower(req["object_id"], req["borrower"])
+        return True
+
+    def HandlePubsubMessage(self, req):
+        channel, message = req["channel"], req["message"]
+        if channel.startswith("ACTOR:"):
+            actor_id = message.get("actor_id")
+            with self._actor_lock:
+                if message["event"] == "alive":
+                    self._actor_addr_cache[actor_id] = tuple(message["address"])
+                    self._actor_state_cache[actor_id] = "ALIVE"
+                elif message["event"] == "restarting":
+                    self._actor_addr_cache.pop(actor_id, None)
+                    self._actor_state_cache[actor_id] = "RESTARTING"
+                elif message["event"] == "dead":
+                    self._actor_addr_cache.pop(actor_id, None)
+                    self._actor_state_cache[actor_id] = "DEAD"
+                self._actor_cv.notify_all()
+        return True
+
+    # ------------------------------------------------------------------
+    # Task submission (reference: normal_task_submitter.cc:29 SubmitTask)
+    # ------------------------------------------------------------------
+
+    def submit_task(
+        self,
+        fn,
+        args,
+        kwargs,
+        *,
+        name=None,
+        num_returns=1,
+        resources=None,
+        strategy=None,
+        max_retries=None,
+        retry_exceptions=False,
+        runtime_env=None,
+    ):
+        from ray_tpu._private.resources import ResourceSet
+        from ray_tpu._private.scheduler import SchedulingStrategy
+
+        task_id = TaskID.random()
+        digest, blob = self._publish_function(fn)
+        spec = TaskSpec(
+            task_id=task_id,
+            job_id=self.job_id,
+            name=name or getattr(fn, "__name__", "task"),
+            function_digest=digest,
+            function_blob=blob,
+            args=[self._pack_arg(a) for a in args],
+            kwargs=[(k, *self._pack_arg(v)) for k, v in (kwargs or {}).items()],
+            num_returns=num_returns,
+            resources=ResourceSet(resources or {"CPU": 1}),
+            strategy=strategy or SchedulingStrategy(),
+            max_retries=max_retries if max_retries is not None else global_config().task_max_retries_default,
+            retry_exceptions=retry_exceptions,
+            owner_addr=self.address,
+            owner_worker_id=self.worker_id,
+            runtime_env=runtime_env,
+        )
+        self.task_manager.add_pending(spec)
+        self._pin_args(spec)
+        self._record_task_event(spec, "SUBMITTED")
+        self._submit_pool.submit(self._submit_with_retries, spec)
+        refs = [ObjectRef(oid, self.address) for oid in spec.return_ids()]
+        return refs[0] if num_returns == 1 else refs
+
+    def _publish_function(self, fn) -> Tuple[str, Optional[bytes]]:
+        blob = serialization.dumps_inline(fn)
+        digest = hashlib.sha1(blob).hexdigest()
+        if digest in self._published_fns:
+            return digest, None
+        # Publish to GCS KV so workers can fetch once and cache
+        # (reference: _private/function_manager.py export pattern).
+        try:
+            self.gcs.call("KVPut", {"key": f"fn:{digest}", "value": blob, "overwrite": False})
+            self._published_fns.add(digest)
+            return digest, None
+        except Exception:  # noqa: BLE001
+            return digest, blob
+
+    def _pack_arg(self, value):
+        if isinstance(value, ObjectRef):
+            return ("ref", (value.id, value.owner_addr))
+        data = serialization.dumps_inline(value)
+        if len(data) > global_config().max_inline_object_size:
+            ref = self.put(value)
+            self.reference_counter.add_local_ref(ref)  # hold until task done
+            return ("ref", (ref.id, ref.owner_addr))
+        return ("value", data)
+
+    def _pin_args(self, spec: TaskSpec):
+        for kind, payload in list(spec.args) + [(k2, p) for _, k2, p in spec.kwargs]:
+            if kind == "ref":
+                oid, owner = payload
+                if owner == self.address:
+                    self.reference_counter.add_submitted_ref(oid)
+
+    def _unpin_args(self, spec: TaskSpec):
+        for kind, payload in list(spec.args) + [(k2, p) for _, k2, p in spec.kwargs]:
+            if kind == "ref":
+                oid, owner = payload
+                if owner == self.address:
+                    self.reference_counter.remove_submitted_ref(oid)
+
+    def _submit_with_retries(self, spec: TaskSpec):
+        try:
+            while True:
+                try:
+                    self._submit_once(spec)
+                    return
+                except (ConnectionLost, WorkerCrashedError, RemoteError) as e:
+                    if spec.max_retries != -1 and spec.attempt >= max(spec.max_retries, 0):
+                        self._fail_task(spec, WorkerCrashedError(f"task {spec.name} failed after {spec.attempt + 1} attempts: {e}"))
+                        return
+                    spec.attempt += 1
+                    logger.info("retrying task %s (attempt %d): %s", spec.name, spec.attempt, e)
+                    time.sleep(min(0.05 * (2 ** min(spec.attempt, 6)), 2.0))
+        except Exception as e:  # noqa: BLE001
+            logger.exception("task %s submission failed", spec.name)
+            self._fail_task(spec, e)
+
+    def _submit_once(self, spec: TaskSpec):
+        lease, raylet_cli = self._acquire_lease(spec)
+        worker_addr = tuple(lease["worker_addr"])
+        try:
+            reply = self.pool.get(worker_addr).call(
+                "PushTask", {"spec": spec, "lease": lease}, timeout=None, retry_deadline=0
+            )
+        except ConnectionLost:
+            raise WorkerCrashedError(f"worker {worker_addr} died while running {spec.name}")
+        self._handle_task_reply(spec, reply, worker_addr)
+
+    def _acquire_lease(self, spec: TaskSpec):
+        """Request a worker lease, following spillback redirects
+        (reference: scheduling-key lease queues normal_task_submitter.h:40-77)."""
+        target = self.raylet
+        if spec.strategy and spec.strategy.kind == "placement_group":
+            target = self._resolve_pg_raylet(spec)
+        hops = 0
+        while True:
+            reply = target.call("RequestWorkerLease", {"spec": spec, "for_actor": False}, timeout=None)
+            if reply.get("rejected"):
+                raise RemoteError(f"lease rejected: {reply.get('reason')}")
+            if "spillback" in reply:
+                hops += 1
+                if hops > 16:
+                    raise RemoteError("lease spillback loop")
+                target = self.pool.get(tuple(reply["spillback"]))
+                continue
+            return reply, target
+
+    def _resolve_pg_raylet(self, spec: TaskSpec):
+        info = self.gcs.call("GetPlacementGroup", {"pg_id": spec.strategy.placement_group_id})
+        if info is None or info["state"] != "CREATED":
+            # Wait for the PG to become ready.
+            deadline = time.monotonic() + global_config().gcs_rpc_timeout_s
+            while time.monotonic() < deadline:
+                info = self.gcs.call("GetPlacementGroup", {"pg_id": spec.strategy.placement_group_id})
+                if info is not None and info["state"] == "CREATED":
+                    break
+                time.sleep(0.02)
+            else:
+                raise RemoteError("placement group not ready")
+        idx = spec.strategy.bundle_index if spec.strategy.bundle_index >= 0 else 0
+        node_id = info["bundle_nodes"][idx]
+        nodes = self.gcs.call("GetAllNodeInfo", None)
+        for n in nodes:
+            if n["node_id"] == node_id:
+                return self.pool.get(tuple(n["address"]))
+        raise RemoteError(f"placement group node {node_id} not found")
+
+    def _handle_task_reply(self, spec: TaskSpec, reply: dict, worker_addr):
+        if reply.get("status") == "error":
+            err = TaskError(reply["error"], reply.get("traceback", ""), spec.name)
+            if spec.retry_exceptions and spec.attempt < spec.max_retries:
+                spec.attempt += 1
+                self._submit_pool.submit(self._submit_with_retries, spec)
+                return
+            self._fail_task(spec, err)
+            return
+        for oid, kind, payload in reply["returns"]:
+            if kind == "inline":
+                with self._store_lock:
+                    self.memory_store[oid] = serialization.loads_inline(payload)
+                    self._store_cv.notify_all()
+            else:  # plasma: payload = node_addr
+                with self._store_lock:
+                    self.object_locations[oid].add(tuple(payload))
+                    self._store_cv.notify_all()
+        with self.task_manager.lock:
+            for oid in spec.return_ids():
+                self.task_manager.reconstructing.discard(oid)
+        self.task_manager.complete(spec.task_id)
+        self._unpin_args(spec)
+        self._record_task_event(spec, "FINISHED")
+
+    def _fail_task(self, spec: TaskSpec, error: Exception):
+        with self._store_lock:
+            for oid in spec.return_ids():
+                self.object_errors[oid] = error if isinstance(error, TaskError) else error
+                self._store_cv.notify_all()
+        self.task_manager.complete(spec.task_id)
+        self._unpin_args(spec)
+        self._record_task_event(spec, "FAILED")
+
+    def _record_task_event(self, spec: TaskSpec, state: str):
+        if not global_config().task_events_enabled:
+            return
+        ev = {
+            "task_id": spec.task_id.hex(),
+            "name": spec.name,
+            "state": state,
+            "time": time.time(),
+            "attempt": spec.attempt,
+            "job_id": spec.job_id.hex() if spec.job_id else None,
+            "actor_id": spec.actor_id.hex() if spec.actor_id else None,
+        }
+        self._task_events.append(ev)
+        if len(self._task_events) >= 100:
+            self.flush_task_events()
+
+    def flush_task_events(self):
+        events, self._task_events = self._task_events, []
+        if events:
+            try:
+                self.gcs.notify("AddTaskEvents", {"events": events})
+            except Exception:  # noqa: BLE001
+                pass
+
+    # ------------------------------------------------------------------
+    # Task execution (worker side; reference: core_worker.cc:2804
+    # ExecuteTask + _raylet.pyx task_execution_callback)
+    # ------------------------------------------------------------------
+
+    def HandlePushTask(self, req, reply_token=None):
+        self._exec_pool.submit(self._execute_task, req, reply_token)
+        return RpcServer.DELAYED_REPLY
+
+    def _execute_task(self, req, reply_token):
+        spec: TaskSpec = req["spec"]
+        lease: dict = req["lease"]
+        try:
+            bind_visible_accelerators(lease.get("resource_instances"))
+            fn = self._load_function(spec)
+            args = [self._unpack_arg(a) for a in spec.args]
+            kwargs = {k: self._unpack_arg((kind, p)) for k, kind, p in spec.kwargs}
+            self.current_task_id = spec.task_id
+            try:
+                result = fn(*args, **kwargs)
+            finally:
+                self.current_task_id = None
+            returns = self._pack_returns(spec, result)
+            self.server.send_reply(reply_token, {"status": "ok", "returns": returns})
+        except Exception as e:  # noqa: BLE001
+            self.server.send_reply(
+                reply_token,
+                {"status": "error", "error": e, "traceback": traceback.format_exc()},
+            )
+        finally:
+            try:
+                self.raylet.notify("ReturnWorker", {"lease_id": lease.get("lease_id")})
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _load_function(self, spec: TaskSpec):
+        if spec.function_digest in self._fn_cache:
+            return self._fn_cache[spec.function_digest]
+        blob = spec.function_blob
+        if blob is None:
+            blob = self.gcs.call("KVGet", {"key": f"fn:{spec.function_digest}"})
+            if blob is None:
+                raise RuntimeError(f"function {spec.function_digest} not found in GCS KV")
+        fn = serialization.loads_inline(blob)
+        self._fn_cache[spec.function_digest] = fn
+        return fn
+
+    def _unpack_arg(self, packed):
+        kind, payload = packed
+        if kind == "value":
+            return serialization.loads_inline(payload)
+        oid, owner = payload
+        ref = ObjectRef(oid, owner)
+        if owner != self.address:
+            self.reference_counter.on_ref_deserialized(ref)
+        return self.get(ref)
+
+    def _pack_returns(self, spec: TaskSpec, result):
+        if spec.num_returns == 1:
+            values = [result]
+        else:
+            values = list(result)
+            if len(values) != spec.num_returns:
+                raise ValueError(f"task {spec.name} declared {spec.num_returns} returns, produced {len(values)}")
+        out = []
+        for oid, value in zip(spec.return_ids(), values):
+            data = serialization.dumps_inline(value)
+            if len(data) <= global_config().max_inline_object_size:
+                out.append((oid, "inline", data))
+            else:
+                meta, raws = serialization.dumps_with_buffers(value)
+                size = serialization.serialized_size(meta, raws)
+                shm_name = self.raylet.call(
+                    "PlasmaCreate", {"object_id": oid, "size": size, "owner_addr": spec.owner_addr}
+                )
+                from ray_tpu._private.object_store import attach_shm
+
+                shm = attach_shm(shm_name)
+                try:
+                    serialization.write_to(shm.buf, meta, raws)
+                finally:
+                    shm.close()
+                self.raylet.call("PlasmaSeal", {"object_id": oid})
+                out.append((oid, "plasma", self.raylet.address))
+        return out
+
+    # ------------------------------------------------------------------
+    # Actors — client side (reference: core_worker.h:878,935)
+    # ------------------------------------------------------------------
+
+    def create_actor(self, cls, args, kwargs, *, name=None, num_returns=1, resources=None,
+                     strategy=None, max_restarts=0, max_task_retries=0, max_concurrency=1,
+                     lifetime=None, namespace="default", runtime_env=None):
+        from ray_tpu._private.resources import ResourceSet
+        from ray_tpu._private.scheduler import SchedulingStrategy
+
+        actor_id = ActorID.random()
+        digest, blob = self._publish_function(cls)
+        if blob is None and digest not in self._published_fns:
+            blob = serialization.dumps_inline(cls)
+        spec = TaskSpec(
+            task_id=TaskID.random(),
+            job_id=self.job_id,
+            name=getattr(cls, "__name__", "Actor"),
+            function_digest=digest,
+            function_blob=blob,
+            args=[self._pack_arg(a) for a in args],
+            kwargs=[(k, *self._pack_arg(v)) for k, v in (kwargs or {}).items()],
+            resources=ResourceSet(resources or {"CPU": 1}),
+            strategy=strategy or SchedulingStrategy(),
+            owner_addr=self.address,
+            owner_worker_id=self.worker_id,
+            actor_id=actor_id,
+            actor_creation=True,
+            max_restarts=max_restarts,
+            max_task_retries=max_task_retries,
+            max_concurrency=max_concurrency,
+            detached=(lifetime == "detached"),
+            actor_name=name,
+            runtime_env=runtime_env,
+        )
+        self.gcs.call("Subscribe", {"channel": f"ACTOR:{actor_id.hex()}", "subscriber_addr": self.server.address})
+        self.gcs.call("RegisterActor", {"spec": spec, "namespace": namespace})
+        return actor_id, spec
+
+    def _wait_actor_alive(self, actor_id: ActorID, timeout=None) -> Tuple[str, int]:
+        timeout = timeout or global_config().actor_creation_timeout_s
+        deadline = time.monotonic() + timeout
+        with self._actor_lock:
+            addr = self._actor_addr_cache.get(actor_id)
+            if addr:
+                return addr
+        while time.monotonic() < deadline:
+            info = self.gcs.call("GetActorInfo", {"actor_id": actor_id})
+            if info is None:
+                raise ActorDiedError(actor_id, "unknown actor")
+            if info["state"] == "ALIVE" and info["address"]:
+                addr = tuple(info["address"])
+                with self._actor_lock:
+                    self._actor_addr_cache[actor_id] = addr
+                return addr
+            if info["state"] == "DEAD":
+                raise ActorDiedError(actor_id, info.get("death_cause", ""))
+            with self._actor_lock:
+                self._actor_cv.wait(timeout=0.05)
+                addr = self._actor_addr_cache.get(actor_id)
+                if addr:
+                    return addr
+        raise GetTimeoutError(f"actor {actor_id} not alive after {timeout}s")
+
+    def submit_actor_task(self, actor_id: ActorID, method_name: str, args, kwargs,
+                          num_returns=1, max_task_retries=0):
+        spec = TaskSpec(
+            task_id=TaskID.random(),
+            job_id=self.job_id,
+            name=method_name,
+            function_digest="",
+            function_blob=None,
+            args=[self._pack_arg(a) for a in args],
+            kwargs=[(k, *self._pack_arg(v)) for k, v in (kwargs or {}).items()],
+            num_returns=num_returns,
+            owner_addr=self.address,
+            owner_worker_id=self.worker_id,
+            actor_id=actor_id,
+            actor_method=method_name,
+            max_retries=max_task_retries,
+        )
+        self.task_manager.add_pending(spec)
+        self._pin_args(spec)
+        with self._actor_lock:
+            pipeline = self._actor_pipelines.get(actor_id)
+            if pipeline is None:
+                pipeline = _ActorPipeline(self, actor_id)
+                self._actor_pipelines[actor_id] = pipeline
+        pipeline.submit(spec)
+        refs = [ObjectRef(oid, self.address) for oid in spec.return_ids()]
+        return refs[0] if num_returns == 1 else refs
+
+    def kill_actor(self, actor_id: ActorID, no_restart=True):
+        self.gcs.call("KillActor", {"actor_id": actor_id, "no_restart": no_restart})
+
+    def get_named_actor(self, name: str, namespace="default"):
+        info = self.gcs.call("GetNamedActor", {"name": name, "namespace": namespace})
+        if info is None:
+            raise ValueError(f"no actor named {name!r}")
+        self.gcs.call(
+            "Subscribe",
+            {"channel": f"ACTOR:{info['actor_id'].hex()}", "subscriber_addr": self.server.address},
+        )
+        return info
+
+    # ------------------------------------------------------------------
+    # Actors — server side (this worker hosts the actor)
+    # ------------------------------------------------------------------
+
+    def HandleCreateActor(self, req):
+        spec: TaskSpec = req["spec"]
+        lease: dict = req["lease"]
+        try:
+            bind_visible_accelerators(lease.get("resource_instances"))
+            cls = self._load_function(spec)
+            args = [self._unpack_arg(a) for a in spec.args]
+            kwargs = {k: self._unpack_arg((kind, p)) for k, kind, p in spec.kwargs}
+            instance = cls(*args, **kwargs)
+        except Exception as e:  # noqa: BLE001
+            return {"ok": False, "error": f"{e}\n{traceback.format_exc()}"}
+        self.actor_id = spec.actor_id
+        self._actor_instance = instance
+        self._actor_spec = spec
+        self._actor_lease = lease
+        self._actor_exec_pool = DaemonExecutor(
+            max_workers=max(spec.max_concurrency, 1), thread_name_prefix="actor-exec"
+        )
+        return {"ok": True, "address": self.server.address}
+
+    def HandlePushActorTask(self, req, reply_token=None):
+        """Ordered per-caller arrival queue (reference: ActorSchedulingQueue /
+        OutOfOrderActorSchedulingQueue).  The client pipeline sends tasks in
+        (epoch, seq) order on one socket; we buffer any dispatch-reorder and
+        submit to the execution pool strictly in order for max_concurrency==1.
+        """
+        if self._actor_instance is None:
+            raise ActorUnavailableError("no actor instance on this worker")
+        spec: TaskSpec = req["spec"]
+        if self._actor_spec is not None and self._actor_spec.max_concurrency > 1:
+            self._actor_exec_pool.submit(self._execute_actor_task, req, reply_token)
+            return RpcServer.DELAYED_REPLY
+        caller = spec.owner_worker_id.hex()
+        epoch, seq = req.get("epoch", 1), spec.sequence_number
+        with self._actor_seq_lock:
+            st = self._actor_callers.setdefault(caller, {"epoch": 0, "next": 0, "pending": {}})
+            if epoch < st["epoch"]:
+                return {"status": "error", "error": ActorUnavailableError("stale epoch"), "traceback": ""}
+            st["pending"][(epoch, seq)] = (req, reply_token)
+            if seq == 1 and epoch > st["epoch"]:
+                st["epoch"], st["next"] = epoch, 0
+                st["pending"] = {k: v for k, v in st["pending"].items() if k[0] >= epoch}
+            while (st["epoch"], st["next"] + 1) in st["pending"]:
+                st["next"] += 1
+                r, tok = st["pending"].pop((st["epoch"], st["next"]))
+                self._actor_exec_pool.submit(self._execute_actor_task, r, tok)
+        return RpcServer.DELAYED_REPLY
+
+    def _execute_actor_task(self, req, reply_token):
+        spec: TaskSpec = req["spec"]
+        try:
+            method = getattr(self._actor_instance, spec.actor_method)
+            args = [self._unpack_arg(a) for a in spec.args]
+            kwargs = {k: self._unpack_arg((kind, p)) for k, kind, p in spec.kwargs}
+            result = method(*args, **kwargs)
+            if hasattr(result, "__await__"):
+                import asyncio
+
+                result = asyncio.run(_await(result))
+            returns = self._pack_returns(spec, result)
+            self.server.send_reply(reply_token, {"status": "ok", "returns": returns})
+        except Exception as e:  # noqa: BLE001
+            self.server.send_reply(
+                reply_token, {"status": "error", "error": e, "traceback": traceback.format_exc()}
+            )
+
+    def HandleKillActor(self, req):
+        logger.info("actor %s killed: %s", req.get("actor_id"), req.get("reason"))
+        threading.Thread(target=self._exit_soon, daemon=True).start()
+        return True
+
+    def HandleExit(self, req):
+        threading.Thread(target=self._exit_soon, daemon=True).start()
+        return True
+
+    def _exit_soon(self):
+        time.sleep(0.05)
+        os._exit(0)
+
+    def HandlePing(self, req):
+        return {"worker_id": self.worker_id.hex(), "actor_id": self.actor_id.hex() if self.actor_id else None}
+
+
+async def _await(coro):
+    return await coro
+
+
+class _ActorPipeline:
+    """Per-actor ordered task sender (reference: ActorTaskSubmitter).
+
+    One daemon thread per (caller, actor): sends PushActorTask frames in
+    (epoch, seq) order over one socket — pipelined, replies handled by future
+    callbacks.  An epoch corresponds to one (actor incarnation, connection):
+    it advances whenever the actor's address changes (restart) or a send/reply
+    fails, at which point un-acked tasks are re-sequenced into the next epoch.
+    A task whose reply was lost may have executed — it is charged one retry
+    attempt; over-budget tasks fail with ActorUnavailableError.
+    """
+
+    def __init__(self, worker: CoreWorker, actor_id: ActorID):
+        self.w = worker
+        self.actor_id = actor_id
+        self.lock = threading.Lock()
+        self.cv = threading.Condition(self.lock)
+        self.queue: List[TaskSpec] = []
+        self.inflight: Dict[int, TaskSpec] = {}  # seq -> spec (current epoch)
+        self.epoch = 1
+        self.seq = 0
+        self.current_addr: Optional[Tuple[str, int]] = None
+        self.thread = threading.Thread(target=self._run, daemon=True, name=f"actor-pipeline-{actor_id.hex()[:8]}")
+        self.thread.start()
+
+    def submit(self, spec: TaskSpec):
+        with self.lock:
+            self.queue.append(spec)
+            self.cv.notify_all()
+
+    def _run(self):
+        while not self.w.shutting_down:
+            with self.lock:
+                while not self.queue and not self.w.shutting_down:
+                    self.cv.wait(timeout=1.0)
+                if self.w.shutting_down:
+                    return
+            try:
+                addr = self.w._wait_actor_alive(self.actor_id)
+            except ActorDiedError as e:
+                self._fail_all(e)
+                continue
+            except Exception as e:  # noqa: BLE001  (timeout waiting for alive)
+                self._fail_all(ActorUnavailableError(str(e)))
+                continue
+            with self.lock:
+                if addr != self.current_addr:
+                    # Actor restarted onto a new worker: new epoch; anything
+                    # still un-acked on the old incarnation is re-queued.
+                    self._rollover_locked(charge_inflight=True)
+                    self.current_addr = addr
+                if not self.queue:
+                    continue
+                spec = self.queue.pop(0)
+                self.seq += 1
+                seq, epoch = self.seq, self.epoch
+                spec.sequence_number = seq
+                self.inflight[seq] = spec
+            try:
+                fut = self.w.pool.get(addr).call_async("PushActorTask", {"spec": spec, "epoch": epoch})
+            except ConnectionLost:
+                self._on_failure(epoch, uncharged_seq=seq)
+                continue
+            fut.add_done_callback(lambda f, s=seq, sp=spec, e=epoch, a=addr: self._on_reply(f, s, sp, e, a))
+
+    def _rollover_locked(self, charge_inflight: bool, uncharged_seq: Optional[int] = None):
+        """Advance to the next epoch, re-queueing un-acked tasks. Lock held."""
+        resend = sorted(self.inflight.items())
+        self.inflight.clear()
+        self.epoch += 1
+        self.seq = 0
+        keep: List[TaskSpec] = []
+        dead: List[TaskSpec] = []
+        for s, sp in resend:
+            if charge_inflight and s != uncharged_seq:
+                sp.attempt += 1
+            if sp.max_retries == -1 or sp.attempt <= sp.max_retries:
+                keep.append(sp)
+            else:
+                dead.append(sp)
+        self.queue = keep + self.queue
+        self.cv.notify_all()
+        if dead:
+            threading.Thread(target=self._fail_specs, args=(dead,), daemon=True).start()
+
+    def _fail_specs(self, specs):
+        for sp in specs:
+            self.w._fail_task(
+                sp, ActorUnavailableError(f"actor task {sp.name} lost connection after {sp.attempt} attempt(s)")
+            )
+
+    def _on_failure(self, epoch: int, uncharged_seq: Optional[int] = None):
+        with self.lock:
+            if epoch != self.epoch:
+                return  # already rolled over
+            self.current_addr = None
+            with self.w._actor_lock:
+                self.w._actor_addr_cache.pop(self.actor_id, None)
+            self._rollover_locked(charge_inflight=True, uncharged_seq=uncharged_seq)
+
+    def _on_reply(self, fut, seq: int, spec: TaskSpec, epoch: int, addr):
+        exc = fut.exception()
+        with self.lock:
+            stale = epoch != self.epoch
+            if not stale:
+                if exc is None:
+                    self.inflight.pop(seq, None)
+            else:
+                if exc is not None:
+                    return  # old epoch already torn down
+                # Late success from a torn-down epoch: accept it and withdraw
+                # the duplicate resend if it hasn't executed yet.
+                if spec in self.queue:
+                    self.queue.remove(spec)
+                else:
+                    for s, sp in list(self.inflight.items()):
+                        if sp is spec:
+                            self.inflight.pop(s, None)
+        if exc is None:
+            try:
+                self.w._handle_task_reply(spec, fut.result(), addr)
+            except Exception:  # noqa: BLE001
+                logger.exception("actor task reply handling failed")
+        else:
+            self._on_failure(epoch)
+
+    def _fail_all(self, error: Exception):
+        with self.lock:
+            doomed = list(self.queue) + [sp for _, sp in sorted(self.inflight.items())]
+            self.queue.clear()
+            self.inflight.clear()
+            self.current_addr = None
+        for sp in doomed:
+            self.w._fail_task(sp, error)
+
+
+_PENDING = object()
+_global_worker: Optional[CoreWorker] = None
+
+
+def get_global_worker() -> CoreWorker:
+    if _global_worker is None:
+        raise RuntimeError("ray_tpu.init() has not been called")
+    return _global_worker
+
+
+def set_global_worker(worker: Optional[CoreWorker]):
+    global _global_worker
+    _global_worker = worker
+
+
+def get(refs, timeout=None):
+    return get_global_worker().get(refs, timeout=timeout)

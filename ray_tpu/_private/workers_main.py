@@ -1,0 +1,44 @@
+"""Worker process entrypoint (reference: python/ray/_private/workers/default_worker.py).
+
+Spawned by the raylet's worker pool; registers back over RPC and then serves
+PushTask / CreateActor / PushActorTask until told to exit or the raylet dies.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import time
+
+
+def main():
+    logging.basicConfig(level=os.environ.get("RAY_TPU_LOG_LEVEL", "WARNING"))
+    raylet_addr = (os.environ["RAY_TPU_RAYLET_HOST"], int(os.environ["RAY_TPU_RAYLET_PORT"]))
+    gcs_addr = (os.environ["RAY_TPU_GCS_HOST"], int(os.environ["RAY_TPU_GCS_PORT"]))
+
+    from ray_tpu._private.config import RayTpuConfig, set_global_config
+    from ray_tpu._private.ids import NodeID
+    from ray_tpu._private.worker import WORKER, CoreWorker, set_global_worker
+
+    node_id = NodeID(os.environ["RAY_TPU_NODE_ID"])
+    worker = CoreWorker(mode=WORKER, raylet_addr=raylet_addr, gcs_addr=gcs_addr, node_id=node_id)
+    set_global_worker(worker)
+    reply = worker.raylet.call(
+        "RegisterWorker",
+        {"worker_id": worker.worker_id, "address": worker.server.address, "pid": os.getpid()},
+    )
+    set_global_config(RayTpuConfig.from_blob(reply["config_blob"]))
+    worker.job_id = None
+
+    # Serve until the raylet goes away (orphan suicide) or we're told to exit.
+    while True:
+        time.sleep(2.0)
+        try:
+            worker.raylet.call("GetNodeStats", None, timeout=5, retry_deadline=5)
+        except Exception:  # noqa: BLE001
+            sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,95 @@
+"""Actor API: @ray_tpu.remote classes, handles, options.
+
+reference: python/ray/actor.py (ActorClass, options incl. max_restarts /
+max_task_retries :385-432, max_concurrency, lifetime="detached", name,
+num_gpus→num_tpus).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ray_tpu._private.ids import ActorID
+from ray_tpu.remote_function import _normalize_resources, _normalize_strategy
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", method_name: str):
+        self._handle = handle
+        self._method_name = method_name
+        self._num_returns = 1
+
+    def options(self, num_returns: int = 1):
+        m = ActorMethod(self._handle, self._method_name)
+        m._num_returns = num_returns
+        return m
+
+    def remote(self, *args, **kwargs):
+        from ray_tpu._private.worker import get_global_worker
+
+        w = get_global_worker()
+        return w.submit_actor_task(
+            self._handle._actor_id,
+            self._method_name,
+            args,
+            kwargs,
+            num_returns=self._num_returns,
+            max_task_retries=self._handle._max_task_retries,
+        )
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID, max_task_retries: int = 0):
+        self._actor_id = actor_id
+        self._max_task_retries = max_task_retries
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name)
+
+    def __repr__(self):
+        return f"ActorHandle({self._actor_id.hex()[:12]})"
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id, self._max_task_retries))
+
+    @property
+    def actor_id(self):
+        return self._actor_id
+
+
+class ActorClass:
+    def __init__(self, cls, **options):
+        self._cls = cls
+        self._options = options
+
+    def options(self, **new_options) -> "ActorClass":
+        return ActorClass(self._cls, **{**self._options, **new_options})
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        from ray_tpu._private.worker import get_global_worker
+
+        w = get_global_worker()
+        opts = self._options
+        actor_id, _spec = w.create_actor(
+            self._cls,
+            args,
+            kwargs,
+            name=opts.get("name"),
+            resources=_normalize_resources(opts),
+            strategy=_normalize_strategy(opts),
+            max_restarts=opts.get("max_restarts", 0),
+            max_task_retries=opts.get("max_task_retries", 0),
+            max_concurrency=opts.get("max_concurrency", 1),
+            lifetime=opts.get("lifetime"),
+            namespace=opts.get("namespace", "default"),
+            runtime_env=opts.get("runtime_env"),
+        )
+        return ActorHandle(actor_id, max_task_retries=opts.get("max_task_retries", 0))
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor class {self._cls.__name__!r} cannot be instantiated directly; "
+            f"use {self._cls.__name__}.remote()."
+        )

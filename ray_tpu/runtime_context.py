@@ -1,0 +1,37 @@
+"""Runtime context: who am I, where am I running.
+
+reference: python/ray/runtime_context.py.
+"""
+
+from __future__ import annotations
+
+
+class RuntimeContext:
+    def __init__(self, worker):
+        self._worker = worker
+
+    @property
+    def job_id(self):
+        return self._worker.job_id
+
+    @property
+    def node_id(self):
+        return self._worker.node_id
+
+    @property
+    def worker_id(self):
+        return self._worker.worker_id
+
+    @property
+    def actor_id(self):
+        return self._worker.actor_id
+
+    @property
+    def task_id(self):
+        return self._worker.current_task_id
+
+    def get_accelerator_ids(self):
+        from ray_tpu._private.accelerators.tpu import TPUAcceleratorManager
+
+        ids = TPUAcceleratorManager.get_current_process_visible_accelerator_ids()
+        return {"TPU": ids or []}

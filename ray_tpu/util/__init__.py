@@ -1,0 +1,28 @@
+"""ray_tpu.util — placement groups, scheduling strategies, actor pool, queue,
+collectives, metrics (reference: python/ray/util/)."""
+
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.placement_group import (
+    PlacementGroup,
+    get_placement_group,
+    placement_group,
+    remove_placement_group,
+)
+from ray_tpu.util.queue import Queue
+from ray_tpu.util.scheduling_strategies import (
+    NodeAffinitySchedulingStrategy,
+    NodeLabelSchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+)
+
+__all__ = [
+    "ActorPool",
+    "PlacementGroup",
+    "placement_group",
+    "remove_placement_group",
+    "get_placement_group",
+    "Queue",
+    "PlacementGroupSchedulingStrategy",
+    "NodeAffinitySchedulingStrategy",
+    "NodeLabelSchedulingStrategy",
+]

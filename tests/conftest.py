@@ -1,0 +1,54 @@
+"""Test configuration.
+
+- JAX runs on a virtual 8-device CPU mesh (multi-chip sharding tests without
+  TPU hardware), set BEFORE any jax import.
+- Mock-TPU-host fixtures mirror the reference's tests/accelerators/test_tpu.py
+  pattern: TPU topology simulated via env vars, no hardware needed.
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("RAY_TPU_DISABLE_METADATA_SERVER", "1")
+os.environ.setdefault("RAY_TPU_WORKER_QUIET", "1")
+
+import pytest
+
+
+@pytest.fixture
+def ray_start_regular():
+    """Single-node cluster with a driver attached (reference: conftest.py:589)."""
+    import ray_tpu
+
+    w = ray_tpu.init(num_cpus=4)
+    yield w
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_cluster():
+    """Factory for multi-node clusters (reference: conftest.py:679)."""
+    from ray_tpu.cluster_utils import Cluster
+
+    clusters = []
+
+    def factory(**kwargs):
+        c = Cluster(**kwargs)
+        clusters.append(c)
+        return c
+
+    yield factory
+    for c in clusters:
+        c.shutdown()
+
+
+@pytest.fixture
+def mock_tpu_host(monkeypatch):
+    """Simulate one v5p host with 4 chips (reference: tests/accelerators/test_tpu.py)."""
+    monkeypatch.setenv("RAY_TPU_NUM_CHIPS", "4")
+    monkeypatch.setenv("TPU_NAME", "test-slice-0")
+    monkeypatch.setenv("TPU_WORKER_ID", "0")
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5p-8")
+    monkeypatch.setenv("TPU_TOPOLOGY", "2x2x1")
+    yield

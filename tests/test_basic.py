@@ -1,0 +1,119 @@
+"""Core API tests: tasks, objects, put/get/wait.
+
+Mirrors reference test coverage in python/ray/tests/test_basic.py.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def test_put_get(ray_start_regular):
+    ref = ray_tpu.put(42)
+    assert ray_tpu.get(ref) == 42
+    big = np.arange(1_000_000, dtype=np.float64)  # 8MB -> plasma
+    ref2 = ray_tpu.put(big)
+    out = ray_tpu.get(ref2)
+    assert np.array_equal(out, big)
+
+
+def test_simple_task(ray_start_regular):
+    @ray_tpu.remote
+    def f(x):
+        return x * 2
+
+    assert ray_tpu.get(f.remote(21)) == 42
+
+
+def test_task_with_large_return(ray_start_regular):
+    @ray_tpu.remote
+    def make():
+        return np.ones((1000, 1000), dtype=np.float32)
+
+    out = ray_tpu.get(make.remote())
+    assert out.shape == (1000, 1000)
+    assert out.dtype == np.float32
+    assert float(out.sum()) == 1_000_000.0
+
+
+def test_task_chain_ref_args(ray_start_regular):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    r1 = add.remote(1, 2)
+    r2 = add.remote(r1, 10)
+    r3 = add.remote(r2, ray_tpu.put(100))
+    assert ray_tpu.get(r3) == 113
+
+
+def test_multiple_returns(ray_start_regular):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray_tpu.get([a, b, c]) == [1, 2, 3]
+
+
+def test_task_error_propagates(ray_start_regular):
+    @ray_tpu.remote(max_retries=0)
+    def boom():
+        raise ValueError("kaboom")
+
+    with pytest.raises(ValueError, match="kaboom"):
+        ray_tpu.get(boom.remote())
+
+
+def test_wait(ray_start_regular):
+    @ray_tpu.remote
+    def fast():
+        return "fast"
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(2)
+        return "slow"
+
+    rs = [slow.remote(), fast.remote()]
+    ready, pending = ray_tpu.wait(rs, num_returns=1, timeout=10)
+    assert len(ready) == 1 and len(pending) == 1
+    assert ray_tpu.get(ready[0]) == "fast"
+
+
+def test_get_timeout(ray_start_regular):
+    @ray_tpu.remote
+    def sleepy():
+        time.sleep(5)
+
+    with pytest.raises(ray_tpu.GetTimeoutError):
+        ray_tpu.get(sleepy.remote(), timeout=0.2)
+
+
+def test_parallel_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def sq(i):
+        return i * i
+
+    refs = [sq.remote(i) for i in range(20)]
+    assert ray_tpu.get(refs) == [i * i for i in range(20)]
+
+
+def test_nested_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def inner(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def outer(x):
+        return ray_tpu.get(inner.remote(x)) + 10
+
+    assert ray_tpu.get(outer.remote(0)) == 11
+
+
+def test_cluster_resources(ray_start_regular):
+    res = ray_tpu.cluster_resources()
+    assert res["CPU"] == 4.0

@@ -1,0 +1,79 @@
+"""Multi-node tests: scheduling across raylets, spillback, object transfer,
+placement groups, node death.
+
+Mirrors the reference's cluster_utils-based distributed tests
+(reference: python/ray/tests/test_multi_node*.py, test_placement_group*.py).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def test_two_node_scheduling(ray_start_cluster):
+    cluster = ray_start_cluster()
+    cluster.add_node(num_cpus=1, resources={"special": 1})
+    cluster.head_node  # head has autodetected CPU
+    cluster.connect_driver()
+
+    @ray_tpu.remote(resources={"special": 1}, num_cpus=1)
+    def where():
+        import os
+
+        return os.getpid()
+
+    # Must run on the second node (only holder of "special").
+    pid = ray_tpu.get(where.remote())
+    assert isinstance(pid, int)
+    res = ray_tpu.cluster_resources()
+    assert res.get("special") == 1
+
+
+def test_object_transfer_across_nodes(ray_start_cluster):
+    cluster = ray_start_cluster()
+    cluster.add_node(num_cpus=1, resources={"a": 1})
+    cluster.add_node(num_cpus=1, resources={"b": 1})
+    cluster.connect_driver()
+
+    @ray_tpu.remote(resources={"a": 1}, num_cpus=0)
+    def produce():
+        return np.arange(500_000, dtype=np.float64)  # 4MB -> plasma on node a
+
+    @ray_tpu.remote(resources={"b": 1}, num_cpus=0)
+    def consume(arr):
+        return float(arr.sum())
+
+    ref = produce.remote()
+    total = ray_tpu.get(consume.remote(ref))
+    assert total == float(np.arange(500_000, dtype=np.float64).sum())
+
+
+def test_driver_pulls_remote_object(ray_start_cluster):
+    cluster = ray_start_cluster()
+    cluster.add_node(num_cpus=1, resources={"far": 1})
+    cluster.connect_driver()
+
+    @ray_tpu.remote(resources={"far": 1}, num_cpus=0)
+    def produce():
+        return np.ones(300_000)  # 2.4MB
+
+    out = ray_tpu.get(produce.remote())
+    assert out.shape == (300_000,)
+
+
+def test_spread_strategy(ray_start_cluster):
+    cluster = ray_start_cluster(head_node_args={"num_cpus": 2})
+    cluster.add_node(num_cpus=2)
+    cluster.connect_driver()
+
+    @ray_tpu.remote(scheduling_strategy="SPREAD", num_cpus=1)
+    def node_of():
+        import os
+
+        return os.environ["RAY_TPU_NODE_ID"]
+
+    nodes = set(ray_tpu.get([node_of.remote() for _ in range(4)]))
+    assert len(nodes) == 2, f"SPREAD used only {nodes}"
