@@ -1,0 +1,100 @@
+"""Headline benchmark: Llama training-step MFU on the local TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline is measured MFU / 0.40 (the north-star ≥40% MFU target from
+BASELINE.md; the reference publishes no in-repo MFU numbers).
+
+Model is a ~1B-param Llama (dim 2048 / 16 layers, GQA 16:8, seq 2048) sized
+for a single 16 GiB chip: bf16 params + bf16 adam moments, per-layer remat,
+pallas flash attention.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+# peak bf16 FLOPs/s per chip by device kind
+_PEAK = {
+    "v5 lite": 197e12,  # v5e
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v4": 275e12,
+    "v6 lite": 918e12,  # trillium
+    "cpu": 1e12,  # nominal, for smoke runs off-TPU
+}
+
+
+def _peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "cpu").lower()
+    for k, v in _PEAK.items():
+        if k in kind:
+            return v
+    return 197e12
+
+
+def main():
+    from ray_tpu.models.llama import LlamaConfig, flops_per_token
+    from ray_tpu.parallel import make_train_step
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=32768, dim=2048, n_layers=16, n_heads=16, n_kv_heads=8,
+            ffn_dim=8192, max_seq_len=2048, param_dtype=jnp.bfloat16,
+        )
+        batch, seq, steps = 8, 2048, 10
+        optimizer = optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1,
+                                mu_dtype=jnp.bfloat16)
+    else:  # CPU smoke mode
+        cfg = LlamaConfig.tiny()
+        batch, seq, steps = 4, 128, 3
+        optimizer = optax.adamw(3e-4)
+
+    init_fn, step_fn = make_train_step(cfg, optimizer=optimizer)
+    state = init_fn(jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0, cfg.vocab_size)
+
+    # warmup / compile
+    state, metrics = step_fn(state, tokens)
+    jax.block_until_ready(state)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step_fn(state, tokens)
+    jax.block_until_ready(state)
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = batch * seq
+    tokens_per_sec = tokens_per_step * steps / dt
+    model_flops = flops_per_token(cfg, seq) * tokens_per_sec
+    peak = _peak_flops(jax.devices()[0])
+    mfu = model_flops / peak
+    loss = float(metrics["loss"])
+
+    result = {
+        "metric": "llama1b_train_mfu_1chip",
+        "value": round(mfu, 4),
+        "unit": "MFU",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "extra": {
+            "tokens_per_sec": round(tokens_per_sec, 1),
+            "step_time_s": round(dt / steps, 4),
+            "final_loss": round(loss, 4),
+            "params": cfg.num_params,
+            "device": getattr(jax.devices()[0], "device_kind", "cpu"),
+            "backend": jax.default_backend(),
+        },
+    }
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,10 @@
+"""Model families shipped with the framework.
+
+The reference framework ships models indirectly (vLLM engines, RLlib
+modules); here the flagship decoder-LM family is native jax so the trainer,
+benchmark, and serving paths share one sharding-aware implementation.
+"""
+
+from ray_tpu.models.llama import LlamaConfig, forward, init_params, loss_fn, param_specs
+
+__all__ = ["LlamaConfig", "forward", "init_params", "loss_fn", "param_specs"]

@@ -1,0 +1,274 @@
+"""Llama-family decoder LM, TPU-first.
+
+Design choices (vs the reference's torch/CUDA delegation):
+  - pure-functional params pytree; layers *stacked* on a leading axis and
+    iterated with `lax.scan` — one compiled layer body, O(1) compile time in
+    depth, and `jax.checkpoint` inside the scan body gives per-layer
+    rematerialisation (HBM ⇄ FLOPs trade, SURVEY.md "HBM bandwidth").
+  - GQA attention via ray_tpu.ops (pallas flash kernel on TPU; ring
+    attention over the "context" mesh axis for long sequences).
+  - sharding expressed as a PartitionSpec tree (param_specs) over the
+    canonical mesh axes (data/fsdp/context/tensor); XLA inserts all
+    collectives (all-gather for fsdp params, psum for tensor partials).
+  - matmuls in bf16 with fp32 accumulation (MXU native); norms/softmax fp32.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ray_tpu.ops.attention import multi_head_attention
+from ray_tpu.ops.norms import rms_norm
+from ray_tpu.ops.ring_attention import ring_attention
+from ray_tpu.ops.rope import apply_rope, rope_frequencies
+from ray_tpu.parallel.mesh import BATCH_AXES
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    ffn_dim: int = 14336
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    rms_norm_eps: float = 1e-5
+    tie_embeddings: bool = False
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.bfloat16
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @property
+    def num_params(self) -> int:
+        d, f, v = self.dim, self.ffn_dim, self.vocab_size
+        hq = self.n_heads * self.head_dim
+        hkv = self.n_kv_heads * self.head_dim
+        per_layer = d * hq + 2 * d * hkv + hq * d + 3 * d * f + 2 * d
+        head = 0 if self.tie_embeddings else d * v
+        return v * d + self.n_layers * per_layer + d + head
+
+    # ---- presets ----
+    @classmethod
+    def llama3_8b(cls, **kw) -> "LlamaConfig":
+        return cls(**kw)
+
+    @classmethod
+    def llama3_70b(cls, **kw) -> "LlamaConfig":
+        return cls(
+            dim=8192, n_layers=80, n_heads=64, n_kv_heads=8, ffn_dim=28672, **kw
+        )
+
+    @classmethod
+    def llama32_1b(cls, **kw) -> "LlamaConfig":
+        return cls(
+            dim=2048, n_layers=16, n_heads=32, n_kv_heads=8, ffn_dim=8192,
+            tie_embeddings=True, **kw
+        )
+
+    @classmethod
+    def tiny(cls, **kw) -> "LlamaConfig":
+        """Test-sized config: runs in milliseconds on a CPU mesh."""
+        kw.setdefault("vocab_size", 256)
+        kw.setdefault("dim", 128)
+        kw.setdefault("n_layers", 2)
+        kw.setdefault("n_heads", 4)
+        kw.setdefault("n_kv_heads", 2)
+        kw.setdefault("ffn_dim", 256)
+        kw.setdefault("max_seq_len", 128)
+        kw.setdefault("compute_dtype", jnp.float32)
+        return cls(**kw)
+
+
+def init_params(cfg: LlamaConfig, key: jax.Array) -> Params:
+    """Initialize a stacked-layers params pytree."""
+    d, f = cfg.dim, cfg.ffn_dim
+    hq = cfg.n_heads * cfg.head_dim
+    hkv = cfg.n_kv_heads * cfg.head_dim
+    L = cfg.n_layers
+    std = 0.02
+    out_std = std / math.sqrt(2 * L)
+    ks = jax.random.split(key, 10)
+    dt = cfg.param_dtype
+
+    def norm_(k, shape, s):
+        return (jax.random.normal(k, shape, jnp.float32) * s).astype(dt)
+
+    params: Params = {
+        "embed": norm_(ks[0], (cfg.vocab_size, d), std),
+        "layers": {
+            "attn_norm": jnp.ones((L, d), dt),
+            "wq": norm_(ks[1], (L, d, hq), std),
+            "wk": norm_(ks[2], (L, d, hkv), std),
+            "wv": norm_(ks[3], (L, d, hkv), std),
+            "wo": norm_(ks[4], (L, hq, d), out_std),
+            "mlp_norm": jnp.ones((L, d), dt),
+            "w_gate": norm_(ks[5], (L, d, f), std),
+            "w_up": norm_(ks[6], (L, d, f), std),
+            "w_down": norm_(ks[7], (L, f, d), out_std),
+        },
+        "final_norm": jnp.ones((d,), dt),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = norm_(ks[8], (d, cfg.vocab_size), std)
+    return params
+
+
+def param_specs(cfg: LlamaConfig) -> Params:
+    """PartitionSpec tree matching init_params' structure.
+
+    Megatron-style TP over the "tensor" axis; parameters additionally sharded
+    over "fsdp" on their non-tensor dim (XLA all-gathers per layer).
+    """
+    specs: Params = {
+        "embed": P("tensor", "fsdp"),
+        "layers": {
+            "attn_norm": P(None, None),
+            "wq": P(None, "fsdp", "tensor"),
+            "wk": P(None, "fsdp", "tensor"),
+            "wv": P(None, "fsdp", "tensor"),
+            "wo": P(None, "tensor", "fsdp"),
+            "mlp_norm": P(None, None),
+            "w_gate": P(None, "fsdp", "tensor"),
+            "w_up": P(None, "fsdp", "tensor"),
+            "w_down": P(None, "tensor", "fsdp"),
+        },
+        "final_norm": P(None),
+    }
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = P("fsdp", "tensor")
+    return specs
+
+
+def _constraint(x, spec, mesh):
+    if mesh is None:
+        return x
+    return lax.with_sharding_constraint(x, jax.sharding.NamedSharding(mesh, spec))
+
+
+def _layer(cfg: LlamaConfig, x, lp, cos, sin, mesh, context_parallel):
+    """One transformer block. x: [B, S, D]."""
+    b, s, d = x.shape
+    cdt = cfg.compute_dtype
+    seq_axis = "context" if context_parallel else None
+
+    h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+    q = (h @ lp["wq"].astype(cdt)).reshape(b, s, cfg.n_heads, cfg.head_dim)
+    k = (h @ lp["wk"].astype(cdt)).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+    v = (h @ lp["wv"].astype(cdt)).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+    q = _constraint(q, P(BATCH_AXES, seq_axis, "tensor", None), mesh)
+    k = _constraint(k, P(BATCH_AXES, seq_axis, "tensor", None), mesh)
+    if context_parallel:
+        # positions are global: offset by this shard's slot in the ring.
+        # rope is applied inside the shard_map so positions line up.
+        def attn_fn(q_, k_, v_):
+            idx = lax.axis_index("context")
+            s_local = q_.shape[1]
+            pos = idx * s_local + jnp.arange(s_local)
+            q_r = apply_rope(q_, cos, sin, positions=pos)
+            k_r = apply_rope(k_, cos, sin, positions=pos)
+            return ring_attention(q_r, k_r, v_, "context", causal=True)
+
+        attn = jax.shard_map(
+            attn_fn,
+            mesh=mesh,
+            axis_names={"context"},
+            in_specs=(P(None, "context"),) * 3,
+            out_specs=P(None, "context"),
+        )(q, k, v)
+    else:
+        q = apply_rope(q, cos[:s], sin[:s])
+        k = apply_rope(k, cos[:s], sin[:s])
+        attn = multi_head_attention(q, k, v, causal=True)
+    attn = attn.reshape(b, s, cfg.n_heads * cfg.head_dim)
+    x = x + (attn @ lp["wo"].astype(cdt))
+    x = _constraint(x, P(BATCH_AXES, seq_axis, None), mesh)
+
+    h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+    gate = h @ lp["w_gate"].astype(cdt)
+    up = h @ lp["w_up"].astype(cdt)
+    ffn = (jax.nn.silu(gate) * up) @ lp["w_down"].astype(cdt)
+    x = x + ffn
+    return _constraint(x, P(BATCH_AXES, seq_axis, None), mesh)
+
+
+def forward(
+    cfg: LlamaConfig,
+    params: Params,
+    tokens: jnp.ndarray,
+    *,
+    mesh: Optional[Mesh] = None,
+    context_parallel: bool = False,
+    rope_cache: Optional[tuple] = None,
+) -> jnp.ndarray:
+    """Token ids [B, S] -> logits [B, S, V] (fp32)."""
+    if rope_cache is None:
+        cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+        cos, sin = jnp.asarray(cos), jnp.asarray(sin)
+    else:
+        cos, sin = rope_cache
+    seq_axis = "context" if context_parallel else None
+    x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.compute_dtype)
+    x = _constraint(x, P(BATCH_AXES, seq_axis, None), mesh)
+
+    layer = partial(_layer, cfg, cos=cos, sin=sin, mesh=mesh, context_parallel=context_parallel)
+    if cfg.remat:
+        layer = jax.checkpoint(layer, policy=jax.checkpoint_policies.nothing_saveable)
+
+    def body(x, lp):
+        return layer(x, lp), None
+
+    x, _ = lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = (x @ head.astype(cfg.compute_dtype)).astype(jnp.float32)
+    return _constraint(logits, P(BATCH_AXES, seq_axis, "tensor"), mesh)
+
+
+def loss_fn(
+    cfg: LlamaConfig,
+    params: Params,
+    tokens: jnp.ndarray,
+    *,
+    loss_mask: Optional[jnp.ndarray] = None,
+    mesh: Optional[Mesh] = None,
+    context_parallel: bool = False,
+    rope_cache: Optional[tuple] = None,
+) -> jnp.ndarray:
+    """Next-token cross-entropy (mean over unmasked positions)."""
+    logits = forward(
+        cfg, params, tokens, mesh=mesh, context_parallel=context_parallel,
+        rope_cache=rope_cache,
+    )
+    targets = tokens[:, 1:]
+    logits = logits[:, :-1]
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    tgt_logit = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    nll = logz - tgt_logit
+    if loss_mask is not None:
+        m = loss_mask[:, 1:].astype(nll.dtype)
+        return jnp.sum(nll * m) / jnp.maximum(jnp.sum(m), 1.0)
+    return jnp.mean(nll)
+
+
+def flops_per_token(cfg: LlamaConfig, seq_len: int) -> float:
+    """Approximate training FLOPs/token (6N + attention term) for MFU math."""
+    n = cfg.num_params
+    attn = 12 * cfg.n_layers * cfg.dim * seq_len  # 2*2*3 * L * d * s (fwd+bwd, causal half)
+    return 6.0 * n + attn

@@ -1,0 +1,19 @@
+"""TPU-native ops: attention (reference + pallas flash), ring attention,
+norms, rotary embeddings.
+
+These are the compute hot-ops of the framework's model families. The
+reference framework (kangwangamd/ray) delegates compute to torch/CUDA
+engines; here the compute path is jax/XLA/pallas, designed for the MXU
+(large bf16 matmuls) and HBM bandwidth (fused elementwise, flash attention).
+"""
+
+from ray_tpu.ops.attention import multi_head_attention
+from ray_tpu.ops.norms import rms_norm
+from ray_tpu.ops.rope import apply_rope, rope_frequencies
+
+__all__ = [
+    "multi_head_attention",
+    "rms_norm",
+    "apply_rope",
+    "rope_frequencies",
+]

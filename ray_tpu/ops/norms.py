@@ -1,0 +1,20 @@
+"""Normalization ops.
+
+RMSNorm is the norm used by the Llama family. It is deliberately written as
+plain jnp: XLA fuses the reduction + rsqrt + scale into the neighbouring
+matmul's epilogue on TPU, so a hand-written pallas kernel buys nothing here
+(the op is bandwidth-bound and already single-pass after fusion).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    """RMSNorm over the last axis, computed in fp32 for stability."""
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    normed = x32 * jnp.reciprocal(jnp.sqrt(var + eps))
+    return (normed * weight.astype(jnp.float32)).astype(dtype)

@@ -1,0 +1,75 @@
+"""Rotary position embeddings (RoPE), Llama-3 style.
+
+Frequencies are precomputed once (host-side, outside jit) and passed in as
+an array so the jitted step has static shapes and no trig recomputation.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def rope_frequencies(
+    head_dim: int,
+    max_seq_len: int,
+    theta: float = 500000.0,
+    scaling: dict | None = None,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Return (cos, sin), each [max_seq_len, head_dim // 2], float32.
+
+    ``scaling`` optionally applies Llama-3.1-style NTK frequency scaling:
+    {"factor": 8.0, "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+     "original_max_position": 8192}.
+    """
+    inv_freq = 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float64) / head_dim))
+    if scaling:
+        factor = scaling["factor"]
+        low = scaling["low_freq_factor"]
+        high = scaling["high_freq_factor"]
+        orig = scaling["original_max_position"]
+        wavelen = 2 * np.pi / inv_freq
+        # three bands: leave high-freq alone, divide low-freq by factor,
+        # smoothly interpolate between.
+        smooth = (orig / wavelen - low) / (high - low)
+        smooth = np.clip(smooth, 0.0, 1.0)
+        scaled = inv_freq / factor
+        inv_freq = np.where(
+            wavelen < orig / high,
+            inv_freq,
+            np.where(wavelen > orig / low, scaled, (1 - smooth) * scaled + smooth * inv_freq),
+        )
+    t = np.arange(max_seq_len, dtype=np.float64)
+    freqs = np.outer(t, inv_freq)
+    return np.cos(freqs).astype(np.float32), np.sin(freqs).astype(np.float32)
+
+
+def apply_rope(
+    x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray, positions: jnp.ndarray | None = None
+) -> jnp.ndarray:
+    """Rotate pairs (x[..., :d/2], x[..., d/2:]) by position-dependent angles.
+
+    x: [batch, seq, heads, head_dim]. cos/sin: [max_seq, head_dim/2] (or
+    pre-gathered [batch, seq, head_dim/2] when ``positions`` is given).
+    Split-half convention (matches the neox/llama weight layout used by
+    ray_tpu.models.llama).
+    """
+    if positions is not None:
+        cos = jnp.take(cos, positions, axis=0)
+        sin = jnp.take(sin, positions, axis=0)
+    else:
+        seq = x.shape[1]
+        cos = cos[:seq]
+        sin = sin[:seq]
+    # broadcast to [*, seq, 1(heads), head_dim/2] against x [B, S, H, D/2]
+    if cos.ndim == 2:  # [S, half]
+        cos, sin = cos[None, :, None, :], sin[None, :, None, :]
+    elif cos.ndim == 3:  # [B, S, half] (positions gathered per batch)
+        cos, sin = cos[:, :, None, :], sin[:, :, None, :]
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    dtype = x.dtype
+    x1f, x2f = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    out1 = x1f * cos - x2f * sin
+    out2 = x2f * cos + x1f * sin
+    return jnp.concatenate([out1, out2], axis=-1).astype(dtype)

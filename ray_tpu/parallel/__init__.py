@@ -1,0 +1,25 @@
+"""Parallelism utilities: device meshes, sharding rules, train-step builders.
+
+The reference framework scales via per-rank NCCL process groups (torch DDP /
+DeepSpeed delegated — SURVEY.md §3.4, §5); the TPU-native design instead
+expresses every intra-slice parallelism (DP / FSDP / TP / CP) as a single
+`jax.sharding.Mesh` + PartitionSpec program compiled by XLA onto ICI, with
+DCN reserved for the data axis across slices.
+"""
+
+from ray_tpu.parallel.mesh import (
+    MESH_AXES,
+    MeshSpec,
+    batch_spec,
+    local_mesh,
+)
+from ray_tpu.parallel.train_step import TrainState, make_train_step
+
+__all__ = [
+    "MESH_AXES",
+    "MeshSpec",
+    "batch_spec",
+    "local_mesh",
+    "TrainState",
+    "make_train_step",
+]

@@ -1,0 +1,90 @@
+"""Device-mesh construction and canonical sharding axes.
+
+Canonical mesh axes (outermost to innermost, i.e. DCN-most to ICI-most):
+
+  data    — pure data parallelism; gradients all-reduced. Crosses slices
+            (DCN) in multi-slice deployments.
+  fsdp    — data parallelism with parameters/optimizer sharded over the axis
+            (XLA inserts per-layer all-gathers / reduce-scatters).
+  context — sequence (context) parallelism; ring attention rides neighbour
+            ICI links (ray_tpu.ops.ring_attention).
+  tensor  — megatron-style tensor parallelism; highest-traffic axis, mapped
+            to the innermost ICI dimension.
+
+Axis order in the mesh tuple encodes the physical hierarchy: `jax.make_mesh`
+lays later axes on nearer devices.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+MESH_AXES = ("data", "fsdp", "context", "tensor")
+
+# batch dims of activations/token arrays are sharded over both DP axes
+BATCH_AXES = ("data", "fsdp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Logical parallelism degrees. Product must equal the device count."""
+
+    data: int = 1
+    fsdp: int = 1
+    context: int = 1
+    tensor: int = 1
+
+    @property
+    def num_devices(self) -> int:
+        return self.data * self.fsdp * self.context * self.tensor
+
+    def build(self, devices: Optional[Sequence] = None) -> Mesh:
+        if devices is None:
+            devices = jax.devices()
+        shape = (self.data, self.fsdp, self.context, self.tensor)
+        if math.prod(shape) != len(devices):
+            raise ValueError(
+                f"mesh {shape} needs {math.prod(shape)} devices, have {len(devices)}"
+            )
+        try:
+            # Auto axis types: shardings flow via with_sharding_constraint +
+            # XLA propagation (jax >= 0.8 defaults new meshes to Explicit).
+            auto = (jax.sharding.AxisType.Auto,) * len(MESH_AXES)
+            return jax.make_mesh(shape, MESH_AXES, devices=devices, axis_types=auto)
+        except TypeError:
+            import numpy as np
+
+            return Mesh(np.asarray(devices).reshape(shape), MESH_AXES)
+
+    @classmethod
+    def for_devices(cls, n: int, *, tensor: int = 1, context: int = 1) -> "MeshSpec":
+        """A sensible default: given n devices, put the remainder on fsdp."""
+        rem, r = divmod(n, tensor * context)
+        if r:
+            raise ValueError(f"{n} devices not divisible by tensor*context={tensor * context}")
+        return cls(data=1, fsdp=rem, context=context, tensor=tensor)
+
+
+def batch_spec(*, context_sharded: bool = False) -> P:
+    """PartitionSpec for [batch, seq, ...] arrays."""
+    return P(BATCH_AXES, "context" if context_sharded else None)
+
+
+def local_mesh(spec: Optional[MeshSpec] = None) -> Mesh:
+    """Mesh over this process's local devices (single-host convenience)."""
+    if spec is None:
+        n = len(jax.local_devices())
+        spec = MeshSpec.for_devices(n)
+    return spec.build(jax.local_devices())
+
+
+def shard_pytree(tree, spec_tree, mesh: Mesh):
+    """Device-put a pytree according to a matching PartitionSpec tree."""
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, spec_tree
+    )

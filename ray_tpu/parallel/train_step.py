@@ -1,0 +1,126 @@
+"""Sharded training-step builder for the model families.
+
+Produces a jitted `(state, tokens) -> (state, metrics)` whose parameters,
+optimizer state, gradients, and activations all carry explicit shardings
+over the canonical mesh (data/fsdp/context/tensor) — XLA inserts the
+matching ICI collectives (reduce-scatter + all-gather for fsdp, psum for
+tensor partials, DCN all-reduce for the data axis).
+
+Reference analog: Ray Train's per-rank torch DDP loop
+(python/ray/train/_internal/backend_executor.py:460 start_training); here
+the "loop body" is a single compiled SPMD program instead of N processes
+calling NCCL.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_tpu.models import llama
+from ray_tpu.parallel.mesh import BATCH_AXES
+
+
+class TrainState(NamedTuple):
+    step: jnp.ndarray
+    params: Any
+    opt_state: Any
+
+
+def _opt_state_specs(optimizer, params_shapes, param_spec_tree):
+    """PartitionSpec tree for the optimizer state.
+
+    Optax states embed subtrees structurally identical to the params tree
+    (adam's mu/nu, sgd's trace, ...); those get the params' specs, every
+    other leaf (step counters, ...) is replicated.
+    """
+    opt_shapes = jax.eval_shape(optimizer.init, params_shapes)
+    pstruct = jax.tree.structure(params_shapes)
+
+    def is_params_like(node):
+        return jax.tree.structure(node) == pstruct
+
+    def map_node(node):
+        if is_params_like(node):
+            return param_spec_tree
+        return jax.tree.map(lambda _: P(), node)
+
+    return jax.tree.map(map_node, opt_shapes, is_leaf=is_params_like)
+
+
+def make_train_step(
+    cfg: llama.LlamaConfig,
+    mesh: Optional[Mesh] = None,
+    *,
+    optimizer: Optional[optax.GradientTransformation] = None,
+    learning_rate: float = 3e-4,
+    context_parallel: bool = False,
+    loss: Optional[Callable] = None,
+) -> tuple[Callable, Callable]:
+    """Returns (init_fn, step_fn).
+
+    init_fn(key) -> TrainState (sharded over `mesh` if given)
+    step_fn(state, tokens) -> (TrainState, metrics dict)
+    """
+    if optimizer is None:
+        optimizer = optax.adamw(
+            learning_rate, b1=0.9, b2=0.95, weight_decay=0.1, mu_dtype=jnp.float32
+        )
+    if loss is None:
+        loss = llama.loss_fn
+
+    from ray_tpu.ops.rope import rope_frequencies
+
+    cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+    rope_cache = (jnp.asarray(cos), jnp.asarray(sin))
+
+    pspecs = llama.param_specs(cfg)
+
+    def init_fn_raw(key):
+        params = llama.init_params(cfg, key)
+        return TrainState(jnp.zeros((), jnp.int32), params, optimizer.init(params))
+
+    def step_fn_raw(state, tokens):
+        def loss_of(p):
+            return loss(
+                cfg, p, tokens, mesh=mesh, context_parallel=context_parallel,
+                rope_cache=rope_cache,
+            )
+
+        loss_val, grads = jax.value_and_grad(loss_of)(state.params)
+        updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        metrics = {
+            "loss": loss_val,
+            "grad_norm": optax.global_norm(grads),
+            "step": state.step + 1,
+        }
+        return TrainState(state.step + 1, new_params, new_opt), metrics
+
+    if mesh is None:
+        return jax.jit(init_fn_raw), jax.jit(step_fn_raw, donate_argnums=0)
+
+    params_shapes = jax.eval_shape(lambda k: llama.init_params(cfg, k), jax.random.PRNGKey(0))
+    opt_specs = _opt_state_specs(optimizer, params_shapes, pspecs)
+    state_specs = TrainState(P(), pspecs, opt_specs)
+    state_shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), state_specs)
+    batch_sharding = NamedSharding(
+        mesh, P(BATCH_AXES, "context" if context_parallel else None)
+    )
+    metric_sharding = {
+        "loss": NamedSharding(mesh, P()),
+        "grad_norm": NamedSharding(mesh, P()),
+        "step": NamedSharding(mesh, P()),
+    }
+    init_fn = jax.jit(init_fn_raw, out_shardings=state_shardings)
+    step_fn = jax.jit(
+        step_fn_raw,
+        donate_argnums=0,
+        in_shardings=(state_shardings, batch_sharding),
+        out_shardings=(state_shardings, metric_sharding),
+    )
+    return init_fn, step_fn

@@ -13,6 +13,14 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ.setdefault("RAY_TPU_DISABLE_METADATA_SERVER", "1")
 os.environ.setdefault("RAY_TPU_WORKER_QUIET", "1")
 
+# The image's sitecustomize force-registers the axon TPU backend via
+# jax.config (overriding JAX_PLATFORMS), so pin CPU + 8 virtual devices
+# explicitly — tests must be hermetic and run without hardware.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
 import pytest
 
 

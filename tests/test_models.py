@@ -1,0 +1,105 @@
+"""Model + sharded-train-step tests on the 8-device virtual CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models.llama import LlamaConfig, forward, init_params, loss_fn, param_specs
+from ray_tpu.parallel import MeshSpec, make_train_step
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return LlamaConfig.tiny()
+
+
+@pytest.fixture(scope="module")
+def tokens(cfg):
+    return jax.random.randint(jax.random.PRNGKey(1), (8, 64), 0, cfg.vocab_size)
+
+
+def test_forward_shapes(cfg, tokens):
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    logits = forward(cfg, params, tokens)
+    assert logits.shape == (8, 64, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+
+
+def test_param_specs_structure_matches(cfg):
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    specs = param_specs(cfg)
+    assert jax.tree.structure(params) == jax.tree.structure(specs)
+
+
+def test_causality(cfg):
+    """Changing future tokens must not change past logits."""
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    t1 = jax.random.randint(jax.random.PRNGKey(1), (1, 32), 0, cfg.vocab_size)
+    t2 = t1.at[:, 20:].set((t1[:, 20:] + 7) % cfg.vocab_size)
+    l1 = forward(cfg, params, t1)
+    l2 = forward(cfg, params, t2)
+    np.testing.assert_allclose(np.asarray(l1[:, :20]), np.asarray(l2[:, :20]), atol=1e-4)
+    assert np.abs(np.asarray(l1[:, 20:]) - np.asarray(l2[:, 20:])).max() > 1e-3
+
+
+def test_loss_decreases(cfg, tokens):
+    init_fn, step_fn = make_train_step(cfg, learning_rate=1e-3)
+    state = init_fn(jax.random.PRNGKey(0))
+    losses = []
+    for _ in range(5):
+        state, m = step_fn(state, tokens)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0]
+
+
+@pytest.mark.parametrize(
+    "spec,cp",
+    [
+        (MeshSpec(data=2, fsdp=2, context=1, tensor=2), False),
+        (MeshSpec(data=1, fsdp=2, context=2, tensor=2), True),
+        (MeshSpec(data=1, fsdp=8, context=1, tensor=1), False),
+        (MeshSpec(data=1, fsdp=1, context=1, tensor=8), False),
+    ],
+)
+def test_sharded_step_matches_single_device(cfg, tokens, spec, cp):
+    mesh = spec.build()
+    init_fn, step_fn = make_train_step(cfg, mesh, context_parallel=cp)
+    state = init_fn(jax.random.PRNGKey(0))
+    state, m = step_fn(state, tokens)
+
+    init1, step1 = make_train_step(cfg)
+    s1 = init1(jax.random.PRNGKey(0))
+    s1, m1 = step1(s1, tokens)
+    assert abs(float(m["loss"]) - float(m1["loss"])) < 2e-3
+    assert abs(float(m["grad_norm"]) - float(m1["grad_norm"])) < 2e-2
+
+
+def test_tied_embeddings():
+    cfg = LlamaConfig.tiny(tie_embeddings=True)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    assert "lm_head" not in params
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab_size)
+    logits = forward(cfg, params, toks)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+
+
+def test_loss_mask(cfg, tokens):
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    full = loss_fn(cfg, params, tokens)
+    mask = jnp.ones_like(tokens)
+    masked = loss_fn(cfg, params, tokens, loss_mask=mask)
+    np.testing.assert_allclose(float(full), float(masked), rtol=1e-5)
+    half = jnp.concatenate([jnp.ones_like(tokens[:, :32]), jnp.zeros_like(tokens[:, 32:])], axis=1)
+    l_half = loss_fn(cfg, params, tokens, loss_mask=half)
+    assert l_half.shape == ()
+
+
+def test_graft_entry():
+    import __graft_entry__ as g
+
+    fn, args = g.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape[-1] == 256
+
+    g.dryrun_multichip(8)
