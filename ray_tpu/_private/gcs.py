@@ -123,6 +123,11 @@ class GcsServer:
         self._actor_cv = threading.Condition(self._lock)
         self._stopped = threading.Event()
         self._job_counter = 0
+        from ray_tpu._private.utils import DaemonExecutor
+
+        self._actor_create_pool = DaemonExecutor(
+            max_workers=32, thread_name_prefix="gcs-actor-create"
+        )
 
         self.server = RpcServer(host=host)
         self.server.register_all(self)
@@ -454,13 +459,24 @@ class GcsServer:
                 with self._lock:
                     self._actor_queue.append(actor_id)
                 continue
-            try:
-                self._create_actor_on_node(info, node)
-            except Exception as e:  # noqa: BLE001
-                logger.warning("GCS: actor %s creation on %s failed: %s", actor_id, node.node_id, e)
-                with self._lock:
-                    self._actor_queue.append(actor_id)
-                time.sleep(0.1)
+            # Creation happens off-loop so gang actors whose constructors
+            # rendezvous with each other can come up together (the reference's
+            # GcsActorScheduler leases/creates via async RPC for the same
+            # reason, gcs_actor_scheduler.h:263,323).
+            self._actor_create_pool.submit(self._create_actor_guarded, info, node)
+
+    def _create_actor_guarded(self, info: ActorInfo, node: NodeInfo):
+        try:
+            self._create_actor_on_node(info, node)
+        except Exception as e:  # noqa: BLE001
+            logger.warning(
+                "GCS: actor %s creation on %s failed: %s", info.actor_id, node.node_id, e
+            )
+            time.sleep(0.1)
+            with self._lock:
+                if info.state != "DEAD":
+                    self._actor_queue.append(info.actor_id)
+                    self._actor_cv.notify_all()
 
     def _create_actor_on_node(self, info: ActorInfo, node: NodeInfo):
         """Lease a worker, then push the creation task

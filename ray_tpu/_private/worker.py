@@ -1064,10 +1064,16 @@ class CoreWorker:
     def _execute_actor_task(self, req, reply_token):
         spec: TaskSpec = req["spec"]
         try:
-            method = getattr(self._actor_instance, spec.actor_method)
             args = [self._unpack_arg(a) for a in spec.args]
             kwargs = {k: self._unpack_arg((kind, p)) for k, kind, p in spec.kwargs}
-            result = method(*args, **kwargs)
+            if spec.actor_method == "__ray_tpu_call__":
+                # Hidden protocol: run fn(instance, *args, **kwargs) on the
+                # actor (used by collectives/train to inject gang setup).
+                fn, args = args[0], args[1:]
+                result = fn(self._actor_instance, *args, **kwargs)
+            else:
+                method = getattr(self._actor_instance, spec.actor_method)
+                result = method(*args, **kwargs)
             if hasattr(result, "__await__"):
                 import asyncio
 

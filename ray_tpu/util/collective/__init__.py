@@ -1,0 +1,43 @@
+"""ray_tpu.util.collective — collectives across actors/tasks.
+
+reference: python/ray/util/collective/ (API collective.py:150-652). Backends:
+``xla`` (jax.distributed + XLA collectives over ICI/DCN — the NCCL analog)
+and ``store`` (named-store-actor data plane — the gloo analog).
+"""
+
+from ray_tpu.util.collective.collective import (
+    allgather,
+    allreduce,
+    barrier,
+    broadcast,
+    create_collective_group,
+    destroy_collective_group,
+    get_collective_group_size,
+    get_rank,
+    init_collective_group,
+    is_group_initialized,
+    recv,
+    reduce,
+    reducescatter,
+    send,
+)
+from ray_tpu.util.collective.types import Backend, ReduceOp
+
+__all__ = [
+    "init_collective_group",
+    "create_collective_group",
+    "destroy_collective_group",
+    "is_group_initialized",
+    "get_rank",
+    "get_collective_group_size",
+    "allreduce",
+    "reduce",
+    "broadcast",
+    "allgather",
+    "reducescatter",
+    "barrier",
+    "send",
+    "recv",
+    "Backend",
+    "ReduceOp",
+]

@@ -1,0 +1,161 @@
+"""Collective API over actors/tasks.
+
+reference: python/ray/util/collective/collective.py — init_collective_group
+:150, create_collective_group :187, allreduce :295, barrier :335, reduce
+:348, broadcast :410, allgather :460, reducescatter :509, send/recv
+:568,631; GroupManager :60 with backend dispatch :81-96.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.util.collective.types import Backend, ReduceOp
+
+logger = logging.getLogger(__name__)
+
+
+class GroupManager:
+    """Process-local registry of the collective groups this process is in
+    (reference: collective.py:60)."""
+
+    def __init__(self):
+        self._groups: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def create_group(self, backend: str, world_size: int, rank: int, group_name: str):
+        backend = Backend.validate(backend)
+        with self._lock:
+            if group_name in self._groups:
+                raise RuntimeError(f"collective group {group_name!r} already exists")
+        if backend == Backend.XLA:
+            from ray_tpu.util.collective.collective_group.xla_group import XLAGroup
+
+            g = XLAGroup(world_size, rank, group_name)
+        else:
+            from ray_tpu.util.collective.collective_group.store_group import StoreGroup
+
+            g = StoreGroup(world_size, rank, group_name)
+        with self._lock:
+            self._groups[group_name] = g
+        return g
+
+    def get_group(self, group_name: str):
+        with self._lock:
+            return self._groups.get(group_name)
+
+    def destroy_group(self, group_name: str):
+        with self._lock:
+            g = self._groups.pop(group_name, None)
+        if g is not None:
+            g.destroy()
+
+
+_group_mgr = GroupManager()
+
+
+def init_collective_group(
+    world_size: int,
+    rank: int,
+    backend: str = Backend.STORE,
+    group_name: str = "default",
+):
+    """Join this process into a collective group; blocks until all ranks join
+    (reference: collective.py:150)."""
+    if not 0 <= rank < world_size:
+        raise ValueError(f"rank {rank} out of range for world_size {world_size}")
+    return _group_mgr.create_group(backend, world_size, rank, group_name)
+
+
+def create_collective_group(
+    actors: List[Any],
+    world_size: int,
+    ranks: List[int],
+    backend: str = Backend.STORE,
+    group_name: str = "default",
+):
+    """Driver-side declarative setup (reference: collective.py:187): registers
+    group metadata and invokes init on each actor via a hidden task, so actor
+    code can call collective ops without its own init call."""
+    import ray_tpu
+    from ray_tpu.actor import ActorMethod
+    from ray_tpu.util.collective.store import get_or_create_store
+
+    if len(actors) != len(ranks):
+        raise ValueError("actors and ranks must have equal length")
+    store = get_or_create_store()
+    ray_tpu.get(store.declare_group.remote(group_name, world_size, Backend.validate(backend)))
+    refs = [
+        ActorMethod(a, "__ray_tpu_call__").remote(
+            _init_in_actor, world_size, r, backend, group_name
+        )
+        for a, r in zip(actors, ranks)
+    ]
+    ray_tpu.get(refs)
+
+
+def _init_in_actor(instance, world_size, rank, backend, group_name):
+    init_collective_group(world_size, rank, backend=backend, group_name=group_name)
+
+
+def is_group_initialized(group_name: str = "default") -> bool:
+    return _group_mgr.get_group(group_name) is not None
+
+
+def destroy_collective_group(group_name: str = "default"):
+    _group_mgr.destroy_group(group_name)
+
+
+def get_rank(group_name: str = "default") -> int:
+    g = _group_mgr.get_group(group_name)
+    return g.rank if g else -1
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    g = _group_mgr.get_group(group_name)
+    return g.world_size if g else -1
+
+
+def _require_group(group_name: str):
+    g = _group_mgr.get_group(group_name)
+    if g is None:
+        raise RuntimeError(
+            f"collective group {group_name!r} is not initialized in this process; "
+            "call init_collective_group first"
+        )
+    return g
+
+
+def allreduce(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
+    return _require_group(group_name).allreduce(tensor, op)
+
+
+def reduce(tensor, dst_rank: int = 0, group_name: str = "default",
+           op: ReduceOp = ReduceOp.SUM):
+    return _require_group(group_name).reduce(tensor, dst_rank, op)
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    return _require_group(group_name).broadcast(tensor, src_rank)
+
+
+def allgather(tensor, group_name: str = "default"):
+    return _require_group(group_name).allgather(tensor)
+
+
+def reducescatter(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
+    return _require_group(group_name).reducescatter(tensor, op)
+
+
+def barrier(group_name: str = "default"):
+    _require_group(group_name).barrier()
+
+
+def send(tensor, dst_rank: int, group_name: str = "default"):
+    _require_group(group_name).send(tensor, dst_rank)
+
+
+def recv(src_rank: int, group_name: str = "default"):
+    return _require_group(group_name).recv(src_rank)

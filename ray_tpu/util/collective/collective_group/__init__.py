@@ -1,0 +1,3 @@
+from ray_tpu.util.collective.collective_group.base_group import BaseGroup
+
+__all__ = ["BaseGroup"]

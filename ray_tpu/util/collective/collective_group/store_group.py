@@ -1,0 +1,151 @@
+"""STORE backend: collectives via the named store actor + object store.
+
+The gloo analog (reference: gloo_collective_group.py:185): works between any
+ray_tpu actors/tasks with no accelerator coupling — used for control-plane
+collectives (ray.train.collective-style broadcast/barrier) and for tests.
+Every op is a contribute/collect round on the store actor keyed by a
+per-group monotonically increasing sequence number, so all ranks must issue
+collectives in the same order (the standard collective contract).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+import numpy as np
+
+from ray_tpu.util.collective.collective_group.base_group import BaseGroup
+from ray_tpu.util.collective.store import get_or_create_store, store_wait
+from ray_tpu.util.collective.types import ReduceOp
+
+_REDUCERS = {
+    ReduceOp.SUM: lambda xs: _tree_reduce(np.add, xs),
+    ReduceOp.PRODUCT: lambda xs: _tree_reduce(np.multiply, xs),
+    ReduceOp.MIN: lambda xs: _tree_reduce(np.minimum, xs),
+    ReduceOp.MAX: lambda xs: _tree_reduce(np.maximum, xs),
+}
+
+
+def _tree_reduce(op, xs):
+    acc = xs[0]
+    for x in xs[1:]:
+        acc = op(acc, x)
+    return acc
+
+
+def _to_numpy(tensor):
+    """numpy view of a tensor + a converter back to the original kind."""
+    if isinstance(tensor, np.ndarray):
+        return tensor, lambda a: a
+    mod = type(tensor).__module__
+    if mod.startswith("jax") or "ArrayImpl" in type(tensor).__name__:
+        import jax.numpy as jnp
+
+        return np.asarray(tensor), lambda a: jnp.asarray(a)
+    if mod.startswith("torch"):
+        return tensor.detach().cpu().numpy(), None  # converter built lazily below
+    return np.asarray(tensor), lambda a: a
+
+
+def _convert_back(result_np, original):
+    if isinstance(original, np.ndarray):
+        return result_np
+    mod = type(original).__module__
+    if mod.startswith("jax") or "ArrayImpl" in type(original).__name__:
+        import jax.numpy as jnp
+
+        return jnp.asarray(result_np)
+    if mod.startswith("torch"):
+        import torch
+
+        return torch.from_numpy(np.ascontiguousarray(result_np))
+    if isinstance(original, (int, float)):
+        return type(original)(result_np)
+    return result_np
+
+
+class StoreGroup(BaseGroup):
+    def __init__(self, world_size: int, rank: int, group_name: str):
+        super().__init__(world_size, rank, group_name)
+        self._store = get_or_create_store()
+        self._seq = 0
+        self._p2p_send_seq = {}
+        self._p2p_recv_seq = {}
+        # join barrier so ops can't start before all ranks exist
+        self._sync("join")
+
+    def _next_key(self, kind: str):
+        self._seq += 1
+        return (self._group_name, kind, self._seq)
+
+    def _sync(self, kind: str):
+        import ray_tpu
+
+        key = self._next_key(kind)
+        ray_tpu.get(self._store.barrier_arrive.remote(key, self._rank, self._world_size))
+        store_wait(self._store, "barrier_done", (key, self._rank, self._world_size))
+
+    def _exchange(self, kind: str, value) -> dict:
+        """All-to-all gather round: contribute own value, collect everyone's."""
+        import ray_tpu
+
+        key = self._next_key(kind)
+        ray_tpu.get(self._store.contribute.remote(key, self._rank, value))
+        return store_wait(self._store, "collect", (key, self._world_size, self._rank))
+
+    # -- collectives --------------------------------------------------------
+    def allreduce(self, tensor, op: ReduceOp = ReduceOp.SUM):
+        arr, _ = _to_numpy(tensor)
+        by_rank = self._exchange("allreduce", arr)
+        out = _REDUCERS[op]([by_rank[r] for r in range(self._world_size)])
+        return _convert_back(out, tensor)
+
+    def reduce(self, tensor, dst_rank: int = 0, op: ReduceOp = ReduceOp.SUM):
+        arr, _ = _to_numpy(tensor)
+        by_rank = self._exchange("reduce", arr)
+        if self._rank != dst_rank:
+            return tensor
+        out = _REDUCERS[op]([by_rank[r] for r in range(self._world_size)])
+        return _convert_back(out, tensor)
+
+    def broadcast(self, tensor, src_rank: int = 0):
+        arr, _ = _to_numpy(tensor) if tensor is not None else (None, None)
+        by_rank = self._exchange("broadcast", arr if self._rank == src_rank else None)
+        return _convert_back(by_rank[src_rank], tensor) if tensor is not None \
+            else by_rank[src_rank]
+
+    def allgather(self, tensor) -> List[Any]:
+        arr, _ = _to_numpy(tensor)
+        by_rank = self._exchange("allgather", arr)
+        return [_convert_back(by_rank[r], tensor) for r in range(self._world_size)]
+
+    def reducescatter(self, tensor, op: ReduceOp = ReduceOp.SUM):
+        arr, _ = _to_numpy(tensor)
+        if arr.shape[0] % self._world_size:
+            raise ValueError(
+                f"reducescatter dim0 {arr.shape[0]} not divisible by world size "
+                f"{self._world_size}"
+            )
+        by_rank = self._exchange("reducescatter", arr)
+        out = _REDUCERS[op]([by_rank[r] for r in range(self._world_size)])
+        shard = out.shape[0] // self._world_size
+        return _convert_back(out[self._rank * shard:(self._rank + 1) * shard], tensor)
+
+    def barrier(self):
+        self._sync("barrier")
+
+    # -- p2p ----------------------------------------------------------------
+    def send(self, tensor, dst_rank: int):
+        import ray_tpu
+
+        arr, _ = _to_numpy(tensor)
+        seq = self._p2p_send_seq.get(dst_rank, 0) + 1
+        self._p2p_send_seq[dst_rank] = seq
+        key = (self._group_name, "p2p", self._rank, dst_rank, seq)
+        ray_tpu.get(self._store.put.remote(key, arr))
+
+    def recv(self, src_rank: int):
+        seq = self._p2p_recv_seq.get(src_rank, 0) + 1
+        self._p2p_recv_seq[src_rank] = seq
+        key = (self._group_name, "p2p", src_rank, self._rank, seq)
+        return store_wait(self._store, "pop", (key,))

@@ -1,0 +1,235 @@
+"""XLA backend: process-level collectives riding ICI/DCN via XLA.
+
+The NCCL analog (reference: nccl_collective_group.py — cupy NCCL comms with
+Rendezvous via a named store actor :30-82). TPU-native design: the store
+actor publishes the jax.distributed coordinator address (instead of an
+ncclUniqueId); every member calls jax.distributed.initialize; collective ops
+are jitted shard_map programs over a one-axis mesh with ONE device per
+member process, so XLA lowers them to ICI collectives inside a slice and
+DCN collectives across slices.
+"""
+
+from __future__ import annotations
+
+import functools
+import socket
+from typing import Any, List
+
+import numpy as np
+
+from ray_tpu.util.collective.collective_group.base_group import BaseGroup
+from ray_tpu.util.collective.store import get_or_create_store, store_wait
+from ray_tpu.util.collective.types import ReduceOp
+
+_PSUM_OPS = {
+    ReduceOp.SUM: "psum",
+    ReduceOp.MAX: "pmax",
+    ReduceOp.MIN: "pmin",
+}
+
+
+def _shard_map(f, **kw):
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, **kw)
+    from jax.experimental.shard_map import shard_map as sm
+
+    return sm(f, **kw)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _host_ip() -> str:
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("8.8.8.8", 80))
+            return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+
+
+class XLAGroup(BaseGroup):
+    def __init__(self, world_size: int, rank: int, group_name: str):
+        super().__init__(world_size, rank, group_name)
+        import jax
+
+        self._ensure_process_group(world_size, rank, group_name)
+        # One device per member process: the collective contract is
+        # process-granular (each member contributes one tensor).
+        by_proc = {}
+        for d in jax.devices():
+            by_proc.setdefault(d.process_index, d)
+        if len(by_proc) < world_size:
+            if world_size == 1:
+                by_proc = {0: jax.devices()[0]}
+            else:
+                raise RuntimeError(
+                    f"xla group needs {world_size} jax processes, found {len(by_proc)}"
+                )
+        self._devices = [by_proc[p] for p in sorted(by_proc)[:world_size]]
+        self._mesh = jax.sharding.Mesh(np.array(self._devices), ("world",))
+        self._local_device = by_proc.get(jax.process_index(), self._devices[0])
+
+    @staticmethod
+    def _ensure_process_group(world_size: int, rank: int, group_name: str):
+        """Rendezvous + jax.distributed.initialize (idempotent)."""
+        import jax
+
+        if world_size <= 1 or jax.process_count() >= world_size:
+            return  # single process, or runtime already spans the group
+        store = get_or_create_store()
+        key = (group_name, "xla_coordinator")
+        if rank == 0:
+            import ray_tpu
+
+            addr = f"{_host_ip()}:{_free_port()}"
+            ray_tpu.get(store.put.remote(key, addr))
+        else:
+            addr = store_wait(store, "get", (key,))
+        jax.distributed.initialize(
+            coordinator_address=addr, num_processes=world_size, process_id=rank
+        )
+
+    # -- jitted collective programs (cached per shape/dtype/op) -------------
+    @functools.lru_cache(maxsize=None)
+    def _allreduce_fn(self, op_name: str):
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        def body(x):
+            # x: [1, ...] local row of the stacked [world, ...] array
+            return getattr(jax.lax, op_name)(x, "world")[0]
+
+        return jax.jit(
+            _shard_map(body, mesh=self._mesh, in_specs=P("world"), out_specs=P())
+        )
+
+    @functools.lru_cache(maxsize=None)
+    def _reducescatter_fn(self, op_name: str):
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        def body(x):
+            # x: [1, ...] local row; output: this rank's reduced shard
+            summed = getattr(jax.lax, op_name)(x, "world")[0]
+            shard = summed.shape[0] // self._world_size
+            idx = jax.lax.axis_index("world")
+            return jax.lax.dynamic_slice_in_dim(summed, idx * shard, shard, axis=0)
+
+        return jax.jit(
+            _shard_map(body, mesh=self._mesh, in_specs=P("world"), out_specs=P("world"))
+        )
+
+    def _global_stack(self, arr):
+        """Global [world, ...] array whose rank-th row is this process's arr."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        local = jax.device_put(arr[None, ...], self._local_device)
+        sharding = NamedSharding(self._mesh, P("world"))
+        return jax.make_array_from_single_device_arrays(
+            (self._world_size, *arr.shape), sharding, [local]
+        )
+
+    def _local_shard(self, garr):
+        """This process's shard of a 'world'-sharded global array."""
+        shards = [s for s in garr.addressable_shards if s.device == self._local_device]
+        return np.asarray(shards[0].data)
+
+    # -- collectives --------------------------------------------------------
+    def _reduce_impl(self, tensor, op: ReduceOp):
+        import jax
+
+        if op == ReduceOp.PRODUCT:
+            # no pprod in lax; log-space or gather-reduce. Gather-reduce:
+            rows = self.allgather(tensor)
+            out = rows[0]
+            for r in rows[1:]:
+                out = out * r
+            return out
+        arr = np.asarray(tensor)
+        garr = self._global_stack(arr)
+        out = self._allreduce_fn(_PSUM_OPS[op])(garr)
+        local = [s for s in out.addressable_shards if s.device == self._local_device]
+        return np.asarray(local[0].data) if local else np.asarray(jax.device_get(out))
+
+    def allreduce(self, tensor, op: ReduceOp = ReduceOp.SUM):
+        return self._reduce_impl(tensor, op)
+
+    def reduce(self, tensor, dst_rank: int = 0, op: ReduceOp = ReduceOp.SUM):
+        out = self._reduce_impl(tensor, op)
+        return out if self._rank == dst_rank else tensor
+
+    def broadcast(self, tensor, src_rank: int = 0):
+        import jax
+        from jax.experimental import multihost_utils
+
+        if self._world_size == 1:
+            return tensor
+        arr = np.asarray(tensor)
+        return np.asarray(
+            multihost_utils.broadcast_one_to_all(arr, is_source=self._rank == src_rank)
+        )
+
+    def allgather(self, tensor) -> List[Any]:
+        import jax
+
+        arr = np.asarray(tensor)
+        garr = self._global_stack(arr)
+        # all-gather = replicate the stacked array
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        rep = jax.jit(
+            lambda x: x, out_shardings=NamedSharding(self._mesh, P())
+        )(garr)
+        out = np.asarray(jax.device_get(rep))
+        return [out[r] for r in range(self._world_size)]
+
+    def reducescatter(self, tensor, op: ReduceOp = ReduceOp.SUM):
+        arr = np.asarray(tensor)
+        if arr.shape[0] % self._world_size:
+            raise ValueError(
+                f"reducescatter dim0 {arr.shape[0]} not divisible by {self._world_size}"
+            )
+        if op == ReduceOp.PRODUCT:
+            shard = arr.shape[0] // self._world_size
+            out = self._reduce_impl(tensor, op)
+            return out[self._rank * shard:(self._rank + 1) * shard]
+        garr = self._global_stack(arr)
+        out = self._reducescatter_fn(_PSUM_OPS[op])(garr)
+        return self._local_shard(out)
+
+    def barrier(self):
+        from jax.experimental import multihost_utils
+
+        if self._world_size == 1:
+            return
+        multihost_utils.sync_global_devices(f"ray_tpu_collective_{self._group_name}")
+
+    # -- p2p: store-relayed (host path). Device-to-device p2p inside one
+    # program should use shard_map ppermute; cross-program p2p has no public
+    # XLA API, so the host relay is the correct fallback. ------------------
+    def send(self, tensor, dst_rank: int):
+        import ray_tpu
+
+        store = get_or_create_store()
+        seq = getattr(self, "_send_seq", {}).get(dst_rank, 0) + 1
+        if not hasattr(self, "_send_seq"):
+            self._send_seq = {}
+        self._send_seq[dst_rank] = seq
+        key = (self._group_name, "xla_p2p", self._rank, dst_rank, seq)
+        ray_tpu.get(store.put.remote(key, np.asarray(tensor)))
+
+    def recv(self, src_rank: int):
+        store = get_or_create_store()
+        if not hasattr(self, "_recv_seq"):
+            self._recv_seq = {}
+        seq = self._recv_seq.get(src_rank, 0) + 1
+        self._recv_seq[src_rank] = seq
+        key = (self._group_name, "xla_p2p", src_rank, self._rank, seq)
+        return store_wait(store, "pop", (key,))

@@ -1,0 +1,117 @@
+"""Named store actor used for collective rendezvous + the STORE data plane.
+
+reference: python/ray/util/collective/collective_group/nccl_collective_group.py:30-82
+(Rendezvous via a named store actor holding the NCCLUniqueID; store name from
+const.py get_store_name). Here the same pattern serves (a) publishing the
+jax.distributed coordinator address for the XLA backend, and (b) the full
+data plane for the STORE backend.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional, Tuple
+
+STORE_ACTOR_NAME = "_ray_tpu_collective_store"
+
+
+class _CollectiveStoreActor:
+    """KV + barrier + gather primitives, garbage-collected by read counts."""
+
+    def __init__(self):
+        self._kv: Dict[Any, Any] = {}
+        self._gathers: Dict[Tuple, Dict[int, Any]] = {}
+        self._gather_reads: Dict[Tuple, set] = {}
+        self._barriers: Dict[Tuple, set] = {}
+        self._barrier_reads: Dict[Tuple, set] = {}
+        self._groups: Dict[str, dict] = {}
+
+    # -- group declaration / join ------------------------------------------
+    def declare_group(self, group_name: str, world_size: int, backend: str):
+        self._groups[group_name] = {"world_size": world_size, "backend": backend}
+        return True
+
+    def get_group(self, group_name: str):
+        return self._groups.get(group_name)
+
+    # -- plain KV (rendezvous) ---------------------------------------------
+    def put(self, key, value):
+        self._kv[key] = value
+        return True
+
+    def get(self, key):
+        return self._kv.get(key)
+
+    def pop(self, key):
+        return self._kv.pop(key, None)
+
+    # -- gather: world_size ranks each contribute; all read; then GC -------
+    def contribute(self, key: Tuple, rank: int, value):
+        self._gathers.setdefault(key, {})[rank] = value
+        return True
+
+    def collect(self, key: Tuple, world_size: int, reader_rank: int):
+        """Returns rank->value dict once all contributions are in, else None.
+        Entry is deleted after every rank has read it."""
+        entry = self._gathers.get(key)
+        if entry is None or len(entry) < world_size:
+            return None
+        reads = self._gather_reads.setdefault(key, set())
+        reads.add(reader_rank)
+        result = entry
+        if len(reads) >= world_size:
+            self._gathers.pop(key, None)
+            self._gather_reads.pop(key, None)
+        return result
+
+    # -- barrier -----------------------------------------------------------
+    def barrier_arrive(self, key: Tuple, rank: int, world_size: int) -> bool:
+        arrived = self._barriers.setdefault(key, set())
+        arrived.add(rank)
+        return len(arrived) >= world_size
+
+    def barrier_done(self, key: Tuple, rank: int, world_size: int) -> bool:
+        arrived = self._barriers.get(key)
+        if arrived is None or len(arrived) < world_size:
+            return False
+        reads = self._barrier_reads.setdefault(key, set())
+        reads.add(rank)
+        if len(reads) >= world_size:
+            self._barriers.pop(key, None)
+            self._barrier_reads.pop(key, None)
+        return True
+
+
+def get_or_create_store():
+    """Get the cluster-wide collective store actor, creating it if needed."""
+    import ray_tpu
+
+    try:
+        return ray_tpu.get_actor(STORE_ACTOR_NAME)
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        cls = ray_tpu.remote(_CollectiveStoreActor).options(
+            name=STORE_ACTOR_NAME, lifetime="detached", num_cpus=0
+        )
+        return cls.remote()
+    except Exception:  # noqa: BLE001
+        # Lost the creation race; the winner's actor is registered by now.
+        return ray_tpu.get_actor(STORE_ACTOR_NAME)
+
+
+def store_wait(store, method: str, args: tuple, timeout: Optional[float] = None,
+               poll_interval: float = 0.002):
+    """Poll a store method until it returns a non-None/True value."""
+    import ray_tpu
+
+    deadline = None if timeout is None else time.monotonic() + timeout
+    interval = poll_interval
+    while True:
+        out = ray_tpu.get(getattr(store, method).remote(*args))
+        if out is not None and out is not False:
+            return out
+        if deadline is not None and time.monotonic() > deadline:
+            raise TimeoutError(f"collective store wait timed out on {method}{args}")
+        time.sleep(interval)
+        interval = min(interval * 1.5, 0.05)

@@ -1,0 +1,41 @@
+"""Collective types: reduce ops, backend registry.
+
+reference: python/ray/util/collective/types.py (ReduceOp, Backend).
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class ReduceOp(enum.Enum):
+    SUM = "sum"
+    PRODUCT = "product"
+    MIN = "min"
+    MAX = "max"
+
+
+class Backend:
+    """Backend name constants (reference: collective.py:81-96 dispatch).
+
+    The reference dispatches MPI/GLOO/NCCL/TORCH_GLOO; the TPU-native set is:
+
+    - ``XLA``: jax.distributed process groups; data rides ICI/DCN via XLA
+      collectives over a one-axis device mesh (the NCCL analog).
+    - ``STORE``: named-store-actor rendezvous + object-store data plane —
+      control-plane collectives that work anywhere (the gloo analog).
+    """
+
+    XLA = "xla"
+    STORE = "store"
+
+    @staticmethod
+    def validate(name: str) -> str:
+        name = str(name).lower()
+        if name in ("nccl", "gloo", "torch_gloo", "mpi"):
+            # GPU-era names map onto the TPU-native equivalents so reference
+            # user code ports unchanged.
+            return Backend.XLA if name == "nccl" else Backend.STORE
+        if name not in (Backend.XLA, Backend.STORE):
+            raise ValueError(f"unknown collective backend {name!r}")
+        return name
