@@ -1,0 +1,82 @@
+"""Native (C++) components, built on demand with g++ and loaded via ctypes.
+
+The build is cached next to the source (``.so`` beside the ``.cc``); a failed
+toolchain falls back to the pure-Python implementations, so the package works
+everywhere and is merely faster where a compiler exists.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+logger = logging.getLogger(__name__)
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_build_lock = threading.Lock()
+_cache: dict = {}
+
+
+def _build(name: str, extra_flags=()) -> str | None:
+    src = os.path.join(_DIR, f"{name}.cc")
+    out = os.path.join(_DIR, f"lib{name}.so")
+    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+        return out
+    cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-shared", "-o", out, src,
+           "-lrt", *extra_flags]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return out
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
+            FileNotFoundError) as e:
+        stderr = getattr(e, "stderr", b"")
+        logger.warning("native build of %s failed (%s); using Python fallback",
+                       name, (stderr or b"").decode(errors="replace")[:500])
+        return None
+
+
+def load(name: str) -> ctypes.CDLL | None:
+    """Build (if needed) and dlopen a native component; None on failure."""
+    with _build_lock:
+        if name in _cache:
+            return _cache[name]
+        path = _build(name)
+        lib = None
+        if path is not None:
+            try:
+                lib = ctypes.CDLL(path)
+            except OSError as e:
+                logger.warning("dlopen %s failed: %s", path, e)
+        _cache[name] = lib
+        return lib
+
+
+def load_plasma() -> ctypes.CDLL | None:
+    lib = load("plasma_store")
+    if lib is None:
+        return None
+    lib.plasma_create.restype = ctypes.c_void_p
+    lib.plasma_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.plasma_destroy.argtypes = [ctypes.c_void_p]
+    lib.plasma_alloc.restype = ctypes.c_uint64
+    lib.plasma_alloc.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+    for fn in ("plasma_seal", "plasma_unpin", "plasma_contains",
+               "plasma_mark_secondary", "plasma_free"):
+        getattr(lib, fn).restype = ctypes.c_int
+        getattr(lib, fn).argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.plasma_get.restype = ctypes.c_int
+    lib.plasma_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                               ctypes.POINTER(ctypes.c_uint64),
+                               ctypes.POINTER(ctypes.c_uint64)]
+    lib.plasma_evict.restype = ctypes.c_int
+    lib.plasma_evict.argtypes = [ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int,
+                                 ctypes.c_char_p, ctypes.c_uint64]
+    for fn in ("plasma_used", "plasma_capacity", "plasma_num_objects"):
+        getattr(lib, fn).restype = ctypes.c_uint64
+        getattr(lib, fn).argtypes = [ctypes.c_void_p]
+    lib.plasma_base.restype = ctypes.c_void_p
+    lib.plasma_base.argtypes = [ctypes.c_void_p]
+    return lib

@@ -4,34 +4,47 @@ TPU-native rebuild of the reference's Plasma store
 (reference: src/ray/object_manager/plasma/store.h:55, obj_lifecycle_mgr.h,
 eviction_policy.h).  One store lives inside each raylet process; worker
 processes create/seal objects through raylet RPC and then map the object's
-shared-memory segment directly for zero-copy reads (the reference passes mmap
-fds over a unix socket — we pass POSIX shm names, same zero-copy property).
+shared memory directly for zero-copy reads (the reference passes mmap fds
+over a unix socket — we pass shm locators, same zero-copy property).
 
-Differences from the reference, on purpose:
-- One POSIX shm segment per object instead of a dlmalloc arena.  A C++
-  arena-backed store is a planned native replacement; the segment-per-object
-  store has identical semantics and the same zero-copy read path.
-- Eviction = LRU over sealed, unpinned objects, with optional disk spilling
-  (reference: local_object_manager.h:43 SpillObjects) and restore-on-get.
+Two storage backends behind one interface:
+
+- **Native arena (default when g++ exists).** The C++ component
+  (`_native/plasma_store.cc`) mmaps ONE posix-shm arena per node and runs a
+  first-fit coalescing free-list allocator inside it (the role dlmalloc
+  plays in the reference, plasma/dlmalloc.cc).  Objects are (offset, size)
+  into the arena; every client process maps the arena exactly once, so reads
+  cost zero syscalls after the first attach.
+- **Segment-per-object (pure-Python fallback).** One POSIX shm segment per
+  object; identical semantics, used when the native build is unavailable.
+
+Objects are addressed by *locators* ``(kind, shm_name, offset, size)`` with
+kind "arena" | "seg".  Eviction = LRU over sealed, unpinned objects, with
+optional disk spilling (reference: local_object_manager.h:43 SpillObjects)
+and restore-on-get.
 """
 
 from __future__ import annotations
 
+import ctypes
 import logging
 import os
 import threading
 import time
 from dataclasses import dataclass, field
 from multiprocessing import shared_memory
-from typing import Dict, List, Optional, Set, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from ray_tpu._private.config import global_config
 from ray_tpu._private.ids import ObjectID
 
 logger = logging.getLogger(__name__)
 
+Locator = Tuple[str, str, int, int]  # (kind, shm_name, offset, size)
 
 _attach_lock = threading.Lock()
+
+_UINT64_MAX = 2**64 - 1
 
 
 def attach_shm(name: str) -> shared_memory.SharedMemory:
@@ -61,8 +74,10 @@ class ObjectLostError(Exception):
 
 @dataclass
 class _Entry:
-    shm: Optional[shared_memory.SharedMemory]
+    locator: Optional[Locator]  # None while spilled out of memory
     size: int
+    shm: Optional[shared_memory.SharedMemory] = None  # segment backend only
+    native_key: Optional[bytes] = None  # arena-table key this block lives under
     sealed: bool = False
     pins: int = 0  # pin while mapped by readers / primary copy
     last_access: float = field(default_factory=time.monotonic)
@@ -85,25 +100,96 @@ class LocalObjectStore:
         self._seal_callbacks: Dict[ObjectID, list] = {}
         self._prefix = f"rtpu-{node_id_hex[:8]}-{os.getpid()}"
 
+        # native arena backend (reference: plasma/dlmalloc.cc arena)
+        self._native = None
+        self._arena_name = None
+        self._arena_view: Optional[memoryview] = None
+        if os.environ.get("RAY_TPU_NATIVE_PLASMA", "1") != "0":
+            self._init_native_arena()
+
+    def _init_native_arena(self):
+        try:
+            from ray_tpu._native import load_plasma
+
+            lib = load_plasma()
+        except Exception:  # noqa: BLE001
+            lib = None
+        if lib is None:
+            return
+        name = f"{self._prefix}-arena"
+        handle = lib.plasma_create(name.encode(), self._capacity)
+        if not handle:
+            logger.warning("native plasma arena creation failed; using segments")
+            return
+        self._native = (lib, ctypes.c_void_p(handle))
+        self._arena_name = name
+        base = lib.plasma_base(self._native[1])
+        self._arena_view = (ctypes.c_char * self._capacity).from_address(base)
+        logger.debug("native plasma arena %s (%d bytes)", name, self._capacity)
+
+    def _arena_buf(self, offset: int, size: int) -> memoryview:
+        return memoryview(self._arena_view)[offset:offset + size]
+
+    def buffer_for(self, e: _Entry) -> memoryview:
+        """Writable view of an in-memory entry (raylet-process IO)."""
+        kind, name, offset, size = e.locator
+        if kind == "arena":
+            return self._arena_buf(offset, size)
+        return e.shm.buf[:size]
+
     # -- creation ----------------------------------------------------------
 
-    def create(self, object_id: ObjectID, size: int) -> str:
-        """Reserve space; returns shm segment name for the writer to map."""
+    def create(self, object_id: ObjectID, size: int) -> Locator:
+        """Reserve space; returns the locator for the writer to map."""
         with self._lock:
             if object_id in self._entries:
                 e = self._entries[object_id]
                 if e.sealed:
                     raise FileExistsError(f"{object_id} already sealed")
-                return e.shm.name
-            self._evict_until(size)
-            name = f"{self._prefix}-{object_id.hex()[:16]}"
-            try:
-                shm = shared_memory.SharedMemory(name=name, create=True, size=max(size, 1))
-            except FileExistsError:
-                shm = shared_memory.SharedMemory(name=name)
-            self._entries[object_id] = _Entry(shm=shm, size=size)
+                return e.locator
+            locator, shm, key = self._alloc_locked(object_id, size)
+            self._entries[object_id] = _Entry(locator=locator, size=size, shm=shm,
+                                              native_key=key)
             self._used += size
-            return shm.name
+            return locator
+
+    def _alloc_locked(self, object_id: ObjectID, size: int, suffix: str = ""):
+        """Returns (locator, shm_or_None, native_key_or_None)."""
+        if self._native is not None:
+            lib, handle = self._native
+            key = (object_id.hex() + suffix).encode()
+            off = lib.plasma_alloc(handle, key, max(size, 1))
+            if off == _UINT64_MAX:
+                self._evict_until(size)
+                off = lib.plasma_alloc(handle, key, max(size, 1))
+            if off == _UINT64_MAX:
+                raise ObjectStoreFullError(
+                    f"need {size}B, used {self._used}B of {self._capacity}B "
+                    "and nothing evictable (arena)"
+                )
+            return ("arena", self._arena_name, off, size), None, key
+        self._evict_until(size)
+        name = f"{self._prefix}-{object_id.hex()[:16]}{suffix}"
+        try:
+            shm = shared_memory.SharedMemory(name=name, create=True, size=max(size, 1))
+        except FileExistsError:
+            shm = shared_memory.SharedMemory(name=name)
+        return ("seg", name, 0, size), shm, None
+
+    def _dealloc_locked(self, object_id: ObjectID, e: _Entry):
+        if e.locator is None:
+            return
+        if e.locator[0] == "arena" and self._native is not None:
+            lib, handle = self._native
+            lib.plasma_free(handle, e.native_key or object_id.hex().encode())
+        elif e.shm is not None:
+            try:
+                e.shm.close()
+                e.shm.unlink()
+            except FileNotFoundError:
+                pass
+            e.shm = None
+        e.locator = None
 
     def seal(self, object_id: ObjectID):
         with self._lock:
@@ -141,45 +227,54 @@ class LocalObjectStore:
         from ray_tpu._private import serialization
 
         size = serialization.serialized_size(meta, raws)
-        name = self.create(object_id, size)
-        shm = attach_shm(name)
-        try:
-            serialization.write_to(shm.buf, meta, raws)
-        finally:
-            shm.close()
+        self.create(object_id, size)
+        with self._lock:
+            e = self._entries[object_id]
+            buf = self.buffer_for(e)
+        serialization.write_to(buf, meta, raws)
         self.seal(object_id)
+
+    def write_into(self, object_id: ObjectID, offset: int, data) -> None:
+        """Write a chunk into a created (unsealed) object — transfer receive
+        path (reference: ObjectBufferPool chunk writes)."""
+        with self._lock:
+            e = self._entries.get(object_id)
+            if e is None or e.locator is None:
+                raise KeyError(f"write into unknown object {object_id}")
+            buf = self.buffer_for(e)
+        buf[offset:offset + len(data)] = data
 
     def put_raw(self, object_id: ObjectID, data: memoryview) -> None:
         """Store an already-laid-out object region (object transfer receive)."""
-        name = self.create(object_id, data.nbytes)
-        shm = attach_shm(name)
-        try:
-            shm.buf[: data.nbytes] = data
-        finally:
-            shm.close()
+        self.create(object_id, data.nbytes)
+        with self._lock:
+            e = self._entries[object_id]
+            buf = self.buffer_for(e)
+        buf[: data.nbytes] = data
         self.seal(object_id)
 
     # -- reads -------------------------------------------------------------
 
-    def get_shm_name(self, object_id: ObjectID, timeout: Optional[float] = None) -> Optional[Tuple[str, int]]:
-        """Block until sealed (or timeout); returns (shm_name, size).
-
-        Restores from spill if needed. Returns None on timeout.
-        """
+    def get_locator(self, object_id: ObjectID, timeout: Optional[float] = None) -> Optional[Locator]:
+        """Block until sealed (or timeout); returns the locator and pins the
+        entry. Restores from spill if needed. Returns None on timeout."""
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._lock:
             while True:
                 e = self._entries.get(object_id)
                 if e is not None and e.sealed:
-                    if e.shm is None:
+                    if e.locator is None:
                         self._restore_locked(object_id, e)
                     e.last_access = time.monotonic()
                     e.pins += 1
-                    return (e.shm.name, e.size)
+                    return e.locator
                 remaining = None if deadline is None else deadline - time.monotonic()
                 if remaining is not None and remaining <= 0:
                     return None
                 self._seal_cv.wait(timeout=remaining if remaining is not None else 1.0)
+
+    # kept for callers that used the old name
+    get_shm_name = get_locator
 
     def unpin(self, object_id: ObjectID):
         with self._lock:
@@ -194,17 +289,18 @@ class LocalObjectStore:
 
     def read_object_bytes(self, object_id: ObjectID, offset: int = 0, length: Optional[int] = None) -> Optional[bytes]:
         """Copy out a chunk (for inter-node transfer)."""
-        got = self.get_shm_name(object_id)
-        if got is None:
+        loc = self.get_locator(object_id)
+        if loc is None:
             return None
-        name, size = got
         try:
-            shm = attach_shm(name)
-            try:
-                end = size if length is None else min(offset + length, size)
-                return bytes(shm.buf[offset:end])
-            finally:
-                shm.close()
+            with self._lock:
+                e = self._entries.get(object_id)
+                if e is None or e.locator is None:
+                    return None
+                buf = self.buffer_for(e)
+            size = loc[3]
+            end = size if length is None else min(offset + length, size)
+            return bytes(buf[offset:end])
         finally:
             self.unpin(object_id)
 
@@ -229,13 +325,9 @@ class LocalObjectStore:
         e = self._entries.pop(object_id, None)
         if e is None:
             return
-        if e.shm is not None:
+        if e.locator is not None:
             self._used -= e.size
-            try:
-                e.shm.close()
-                e.shm.unlink()
-            except FileNotFoundError:
-                pass
+            self._dealloc_locked(object_id, e)
         if e.spilled_path:
             try:
                 os.unlink(e.spilled_path)
@@ -250,10 +342,18 @@ class LocalObjectStore:
         with self._lock:
             return self._used
 
+    def is_native(self) -> bool:
+        return self._native is not None
+
     def shutdown(self):
         with self._lock:
             for oid in list(self._entries):
                 self._free_locked(oid)
+            self._arena_view = None
+            if self._native is not None:
+                lib, handle = self._native
+                lib.plasma_destroy(handle)
+                self._native = None
 
     # -- eviction / spilling ----------------------------------------------
     # reference: eviction_policy.h (LRU), local_object_manager.h:113 SpillObjects
@@ -266,7 +366,7 @@ class LocalObjectStore:
             (
                 (e.is_primary, e.last_access, oid)
                 for oid, e in self._entries.items()
-                if e.sealed and e.pins == 0 and e.shm is not None
+                if e.sealed and e.pins == 0 and e.locator is not None
             ),
         )
         for is_primary, _, oid in candidates:
@@ -287,59 +387,90 @@ class LocalObjectStore:
     def _spill_locked(self, object_id: ObjectID, e: _Entry):
         os.makedirs(self._spill_dir, exist_ok=True)
         path = os.path.join(self._spill_dir, object_id.hex())
+        buf = self.buffer_for(e)
         with open(path, "wb") as f:
-            f.write(e.shm.buf[: e.size])
+            f.write(buf[: e.size])
         e.spilled_path = path
-        try:
-            e.shm.close()
-            e.shm.unlink()
-        except FileNotFoundError:
-            pass
-        e.shm = None
+        self._dealloc_locked(object_id, e)
         self._used -= e.size
 
     def _restore_locked(self, object_id: ObjectID, e: _Entry):
         if e.spilled_path is None:
             raise ObjectLostError(f"{object_id} has neither memory nor spill copy")
-        self._evict_until(e.size)
-        name = f"{self._prefix}-{object_id.hex()[:16]}-r"
-        try:
-            shm = shared_memory.SharedMemory(name=name, create=True, size=max(e.size, 1))
-        except FileExistsError:
-            shm = shared_memory.SharedMemory(name=name)
+        locator, shm, key = self._alloc_locked(object_id, e.size, suffix="-r")
+        e.locator = locator
+        e.shm = shm
+        e.native_key = key
+        self._used += e.size
         with open(e.spilled_path, "rb") as f:
             data = f.read()
-        shm.buf[: len(data)] = data
-        e.shm = shm
-        self._used += e.size
+        buf = self.buffer_for(e)
+        buf[: len(data)] = data
+
+
+# ---------------------------------------------------------------------------
+# Worker-side client
+# ---------------------------------------------------------------------------
+
+class _ShmCache:
+    """Process-wide cache of attached segments/arenas (map once, reuse)."""
+
+    def __init__(self):
+        self._mapped: Dict[str, shared_memory.SharedMemory] = {}
+        self._lock = threading.Lock()
+
+    def buf(self, locator: Locator) -> memoryview:
+        kind, name, offset, size = locator
+        with self._lock:
+            shm = self._mapped.get(name)
+            if shm is None:
+                shm = attach_shm(name)
+                self._mapped[name] = shm
+        return shm.buf[offset:offset + size]
+
+    def close(self):
+        with self._lock:
+            for shm in self._mapped.values():
+                try:
+                    shm.close()
+                except Exception:  # noqa: BLE001
+                    pass
+            self._mapped.clear()
+
+
+_client_cache = _ShmCache()
+
+
+def write_via_locator(locator: Locator, meta: bytes, raws) -> None:
+    """Worker-side write into a created (unsealed) object."""
+    from ray_tpu._private import serialization
+
+    serialization.write_to(_client_cache.buf(locator), meta, raws)
 
 
 class PlasmaClient:
-    """Worker-side view of the node's store: map-by-name zero-copy reads.
+    """Worker-side view of the node's store: map-by-locator zero-copy reads.
 
-    The worker asks its raylet for (shm_name, size) over RPC, then attaches
-    the segment directly — the data path never crosses the RPC socket
-    (reference: plasma client fd-passing, src/ray/object_manager/plasma/client.cc).
+    The worker asks its raylet for a locator over RPC, then maps the shared
+    memory directly — the data path never crosses the RPC socket (reference:
+    plasma client fd-passing, src/ray/object_manager/plasma/client.cc). With
+    the native arena backend the mapping happens ONCE per process for all
+    objects.
     """
 
     def __init__(self, raylet_client):
         self._raylet = raylet_client
-        self._mapped: Dict[str, shared_memory.SharedMemory] = {}
-        self._lock = threading.Lock()
+        self._cache = _client_cache
 
     def put(self, object_id: ObjectID, obj, owner_addr=None) -> int:
         from ray_tpu._private import serialization
 
         meta, raws = serialization.dumps_with_buffers(obj)
         size = serialization.serialized_size(meta, raws)
-        shm_name = self._raylet.call(
+        locator = self._raylet.call(
             "PlasmaCreate", {"object_id": object_id, "size": size, "owner_addr": owner_addr}
         )
-        shm = attach_shm(shm_name)
-        try:
-            serialization.write_to(shm.buf, meta, raws)
-        finally:
-            shm.close()
+        write_via_locator(tuple(locator), meta, raws)
         self._raylet.call("PlasmaSeal", {"object_id": object_id})
         return size
 
@@ -351,27 +482,15 @@ class PlasmaClient:
         )
         if got is None:
             return False, None
-        shm_name, size = got
         from ray_tpu._private import serialization
 
-        with self._lock:
-            shm = self._mapped.get(shm_name)
-            if shm is None:
-                shm = attach_shm(shm_name)
-                self._mapped[shm_name] = shm
-        value = serialization.read_from(shm.buf[:size])
-        # NOTE: value may alias shm; keep segment mapped for process lifetime.
-        # The store keeps its pin until the owner frees the object.
+        value = serialization.read_from(self._cache.buf(tuple(got)))
+        # NOTE: value may alias the mapping; segments stay mapped for process
+        # lifetime. The store keeps its pin until the owner frees the object.
         return True, value
 
     def contains(self, object_id: ObjectID) -> bool:
         return self._raylet.call("PlasmaContains", {"object_id": object_id})
 
     def close(self):
-        with self._lock:
-            for shm in self._mapped.values():
-                try:
-                    shm.close()
-                except Exception:  # noqa: BLE001
-                    pass
-            self._mapped.clear()
+        self._cache.close()

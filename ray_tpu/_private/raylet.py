@@ -636,22 +636,16 @@ class Raylet:
             size = cli.call("ObjectSize", {"object_id": oid})
             if size is None:
                 return False
-            name = self.store.create(oid, size)
-            from ray_tpu._private.object_store import attach_shm
-
-            shm = attach_shm(name)
-            try:
-                off = 0
-                while off < size:
-                    data = cli.call(
-                        "ReadObjectChunk", {"object_id": oid, "offset": off, "length": chunk}
-                    )
-                    if data is None:
-                        return False
-                    shm.buf[off : off + len(data)] = data
-                    off += len(data)
-            finally:
-                shm.close()
+            self.store.create(oid, size)
+            off = 0
+            while off < size:
+                data = cli.call(
+                    "ReadObjectChunk", {"object_id": oid, "offset": off, "length": chunk}
+                )
+                if data is None:
+                    return False
+                self.store.write_into(oid, off, data)
+                off += len(data)
             self.store.seal(oid)
             return True
         except Exception:  # noqa: BLE001

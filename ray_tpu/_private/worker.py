@@ -365,14 +365,10 @@ class CoreWorker:
                 self.memory_store[oid] = value
                 self._store_cv.notify_all()
         else:
-            shm_name = self.raylet.call("PlasmaCreate", {"object_id": oid, "size": size, "owner_addr": self.address})
-            from ray_tpu._private.object_store import attach_shm
+            locator = self.raylet.call("PlasmaCreate", {"object_id": oid, "size": size, "owner_addr": self.address})
+            from ray_tpu._private.object_store import write_via_locator
 
-            shm = attach_shm(shm_name)
-            try:
-                serialization.write_to(shm.buf, meta, raws)
-            finally:
-                shm.close()
+            write_via_locator(tuple(locator), meta, raws)
             self.raylet.call("PlasmaSeal", {"object_id": oid})
             with self._store_lock:
                 self.object_locations[oid].add(tuple(self._raylet_addr()))
@@ -890,16 +886,12 @@ class CoreWorker:
             else:
                 meta, raws = serialization.dumps_with_buffers(value)
                 size = serialization.serialized_size(meta, raws)
-                shm_name = self.raylet.call(
+                locator = self.raylet.call(
                     "PlasmaCreate", {"object_id": oid, "size": size, "owner_addr": spec.owner_addr}
                 )
-                from ray_tpu._private.object_store import attach_shm
+                from ray_tpu._private.object_store import write_via_locator
 
-                shm = attach_shm(shm_name)
-                try:
-                    serialization.write_to(shm.buf, meta, raws)
-                finally:
-                    shm.close()
+                write_via_locator(tuple(locator), meta, raws)
                 self.raylet.call("PlasmaSeal", {"object_id": oid})
                 out.append((oid, "plasma", self.raylet.address))
         return out

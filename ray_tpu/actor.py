@@ -44,7 +44,9 @@ class ActorHandle:
         self._max_task_retries = max_task_retries
 
     def __getattr__(self, name):
-        if name.startswith("_"):
+        # Dunders must miss (pickle/copy probe them); single-underscore names
+        # are legitimate actor methods (e.g. train's RayTrainWorker._execute).
+        if name.startswith("__"):
             raise AttributeError(name)
         return ActorMethod(self, name)
 

@@ -1,0 +1,41 @@
+"""ray_tpu.data — streaming distributed datasets.
+
+reference: python/ray/data/ (SURVEY §2.3, §3.5): lazy logical plans executed
+by a streaming executor over ray_tpu tasks/actor pools; blocks are Arrow
+tables in the object store.
+"""
+
+from ray_tpu.data.context import DataContext
+from ray_tpu.data.dataset import ActorPoolStrategy, Dataset
+from ray_tpu.data.datasource import Datasource
+from ray_tpu.data.read_api import (
+    from_arrow,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,  # noqa: A004
+    read_binary_files,
+    read_csv,
+    read_datasource,
+    read_json,
+    read_parquet,
+    read_text,
+)
+
+__all__ = [
+    "Dataset",
+    "DataContext",
+    "ActorPoolStrategy",
+    "Datasource",
+    "range",
+    "from_items",
+    "from_numpy",
+    "from_pandas",
+    "from_arrow",
+    "read_parquet",
+    "read_csv",
+    "read_json",
+    "read_text",
+    "read_binary_files",
+    "read_datasource",
+]

@@ -1,0 +1,237 @@
+"""Logical plan + streaming execution.
+
+reference: python/ray/data/_internal/logical/operators/ (logical ops),
+_internal/plan.py (ExecutionPlan — execute_to_iterator :413, execute :451),
+_internal/execution/streaming_executor.py:57 (StreamingExecutor — loop
+run :311, select_operator_to_run :443 backpressure-aware).
+
+Design: operators form a chain; execution streams ObjectRefs to blocks
+through the chain with a bounded number of in-flight tasks per operator
+(backpressure), yielding output refs as soon as they complete. Map-family
+stages fuse (reference: planner fusion) so one task runs read→map→map.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import logging
+from collections import deque
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# Logical operators
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class LogicalOp:
+    name: str
+
+
+@dataclasses.dataclass
+class InputData(LogicalOp):
+    """Leaf: pre-materialized block refs."""
+
+    refs: List[Any] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class Read(LogicalOp):
+    """Leaf: read tasks from a datasource (reference: logical/operators/read_operator.py)."""
+
+    read_tasks: List[Callable] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class MapBlocks(LogicalOp):
+    """block -> block transform (map_batches/map/filter/flat_map lower here)."""
+
+    fn: Callable = None
+    # actor-pool compute (reference: ActorPoolMapOperator actor_pool_map_operator.py:45)
+    compute: Optional[Any] = None
+    fn_constructor: Optional[Callable] = None
+    resources: Optional[Dict[str, float]] = None
+
+
+@dataclasses.dataclass
+class AllToAll(LogicalOp):
+    """Materializing barrier op: repartition/shuffle/sort (reference:
+    hash_shuffle.py; these need every input block)."""
+
+    fn: Callable = None  # List[ref] -> List[ref]
+
+
+# ---------------------------------------------------------------------------
+# Remote execution helpers (plain tasks; defined at module top level so
+# workers import them by reference)
+# ---------------------------------------------------------------------------
+
+def _run_read_task(read_task):
+    from ray_tpu.data.block import to_arrow
+
+    return to_arrow(read_task())
+
+
+def _run_fused(fns, first_input):
+    """Run a fused chain of block transforms; input is a block or a thunk."""
+    from ray_tpu.data.block import to_arrow
+
+    block = first_input() if callable(first_input) else first_input
+    block = to_arrow(block)
+    for fn in fns:
+        block = to_arrow(fn(block))
+    return block
+
+
+class _ActorPoolWorker:
+    """Actor holding a stateful callable (reference: actor_pool_map_operator)."""
+
+    def __init__(self, ctor):
+        self._fn = ctor()
+
+    def apply(self, fns_before, block):
+        from ray_tpu.data.block import to_arrow
+
+        block = block() if callable(block) else block
+        block = to_arrow(block)
+        for fn in fns_before:
+            block = to_arrow(fn(block))
+        return to_arrow(self._fn(block))
+
+
+# ---------------------------------------------------------------------------
+# Execution plan
+# ---------------------------------------------------------------------------
+
+class ExecutionPlan:
+    def __init__(self, ops: List[LogicalOp]):
+        self.ops = ops
+
+    def with_op(self, op: LogicalOp) -> "ExecutionPlan":
+        return ExecutionPlan(self.ops + [op])
+
+    # -- streaming execution ------------------------------------------------
+    def execute_iter(self, ctx) -> Iterator[Any]:
+        """Yield output block refs as they become available."""
+        stages = self._fuse(ctx)
+        stream: Iterator[Any] = iter(())
+        for kind, payload in stages:
+            if kind == "input":
+                stream = iter(payload)
+            elif kind == "tasks":
+                stream = self._stream_tasks(payload, stream, ctx)
+            elif kind == "actor_pool":
+                stream = self._stream_actor_pool(payload, stream, ctx)
+            elif kind == "barrier":
+                refs = list(stream)
+                stream = iter(payload(refs))
+        return stream
+
+    def execute(self, ctx) -> List[Any]:
+        return list(self.execute_iter(ctx))
+
+    # -- fusion -------------------------------------------------------------
+    def _fuse(self, ctx) -> List[Tuple[str, Any]]:
+        """Group the op chain into executable stages, fusing consecutive
+        task-based MapBlocks (and a leading Read) into single tasks."""
+        stages: List[Tuple[str, Any]] = []
+        pending_fns: List[Callable] = []
+        pending_sources: Optional[List[Callable]] = None  # read thunks
+
+        def flush():
+            nonlocal pending_fns, pending_sources
+            if pending_sources is not None:
+                fns = list(pending_fns)
+                srcs = list(pending_sources)
+                stages.append(("tasks", ("source", fns, srcs)))
+            elif pending_fns:
+                fns = list(pending_fns)
+                stages.append(("tasks", ("map", fns, None)))
+            pending_fns, pending_sources = [], None
+
+        for op in self.ops:
+            if isinstance(op, InputData):
+                flush()
+                stages.append(("input", op.refs))
+            elif isinstance(op, Read):
+                flush()
+                pending_sources = list(op.read_tasks)
+            elif isinstance(op, MapBlocks):
+                if op.compute is not None:
+                    # actor stage: carry any pending plain fns into it
+                    fns_before = list(pending_fns)
+                    srcs = pending_sources
+                    pending_fns, pending_sources = [], None
+                    if srcs is not None:
+                        stages.append(("tasks", ("source", fns_before, srcs)))
+                        fns_before = []
+                    stages.append(("actor_pool", (op, fns_before)))
+                else:
+                    pending_fns.append(op.fn)
+            elif isinstance(op, AllToAll):
+                flush()
+                stages.append(("barrier", op.fn))
+            else:
+                raise TypeError(f"unknown op {op}")
+        flush()
+        return stages
+
+    # -- task streaming with bounded in-flight window -----------------------
+    def _stream_tasks(self, payload, upstream: Iterator[Any], ctx) -> Iterator[Any]:
+        kind, fns, sources = payload
+        import ray_tpu
+
+        remote_opts = {"num_cpus": ctx.cpus_per_task}
+        fused = ray_tpu.remote(_run_fused).options(**remote_opts)
+
+        if kind == "source":
+            inputs: Iterator[Any] = iter(sources)
+            submit = lambda item: fused.remote(fns, item)  # noqa: E731
+        else:
+            inputs = upstream
+            submit = lambda ref: fused.remote(fns, ref)  # noqa: E731
+
+        window = ctx.max_tasks_in_flight
+        in_flight: deque = deque()
+        for item in inputs:
+            while len(in_flight) >= window:
+                yield in_flight.popleft()
+            in_flight.append(submit(item))
+        while in_flight:
+            yield in_flight.popleft()
+
+    def _stream_actor_pool(self, payload, upstream: Iterator[Any], ctx) -> Iterator[Any]:
+        op, fns_before = payload
+        import ray_tpu
+
+        compute = op.compute
+        pool_size = getattr(compute, "min_size", None) or getattr(compute, "size", 2)
+        opts = {"num_cpus": ctx.cpus_per_task}
+        if op.resources:
+            opts["resources"] = {k: v for k, v in op.resources.items() if k != "CPU"}
+            if "CPU" in op.resources:
+                opts["num_cpus"] = op.resources["CPU"]
+        worker_cls = ray_tpu.remote(_ActorPoolWorker).options(**opts)
+        actors = [worker_cls.remote(op.fn_constructor) for _ in range(pool_size)]
+        try:
+            free = deque(actors)
+            in_flight: deque = deque()  # (ref, actor)
+            for ref in upstream:
+                while not free:
+                    done_ref, actor = in_flight.popleft()
+                    yield done_ref
+                    free.append(actor)
+                actor = free.popleft()
+                in_flight.append((actor.apply.remote(fns_before, ref), actor))
+            while in_flight:
+                done_ref, actor = in_flight.popleft()
+                yield done_ref
+        finally:
+            for a in actors:
+                try:
+                    ray_tpu.kill(a)
+                except Exception:  # noqa: BLE001
+                    pass

@@ -1,0 +1,94 @@
+"""Blocks: the unit of data exchanged between operators.
+
+reference: python/ray/data/_internal/arrow_block.py / pandas_block.py —
+blocks are Arrow tables (canonical) or pandas DataFrames; operators exchange
+ObjectRefs to blocks, never the data itself (RefBundle pattern,
+execution/interfaces/).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Iterator, List, Optional, Union
+
+import numpy as np
+import pyarrow as pa
+
+
+Block = Union[pa.Table, "pandas.DataFrame", Dict[str, np.ndarray]]  # noqa: F821
+
+
+@dataclasses.dataclass
+class BlockMetadata:
+    """reference: data/block.py BlockMetadata (num_rows, size_bytes, schema)."""
+
+    num_rows: int
+    size_bytes: int
+    schema: Optional[pa.Schema] = None
+
+
+def to_arrow(block: Block) -> pa.Table:
+    if isinstance(block, pa.Table):
+        return block
+    import pandas as pd
+
+    if isinstance(block, pd.DataFrame):
+        return pa.Table.from_pandas(block, preserve_index=False)
+    if isinstance(block, dict):
+        return pa.table({k: pa.array(np.asarray(v)) for k, v in block.items()})
+    if isinstance(block, list):  # list of row-dicts
+        return pa.Table.from_pylist(block)
+    raise TypeError(f"cannot convert {type(block)} to an Arrow block")
+
+
+def block_metadata(block: Block) -> BlockMetadata:
+    t = to_arrow(block)
+    return BlockMetadata(num_rows=t.num_rows, size_bytes=t.nbytes, schema=t.schema)
+
+
+def block_to_batch(block: Block, batch_format: str):
+    """Materialize a block in the user-requested format
+    (reference: iter_batches batch_format semantics)."""
+    t = to_arrow(block)
+    if batch_format in ("pyarrow", "arrow"):
+        return t
+    if batch_format == "pandas":
+        return t.to_pandas()
+    if batch_format in ("numpy", "default"):
+        return {name: col.to_numpy(zero_copy_only=False) for name, col in
+                zip(t.column_names, t.columns)}
+    raise ValueError(f"unknown batch_format {batch_format!r}")
+
+
+def batch_to_block(batch: Any) -> pa.Table:
+    return to_arrow(batch)
+
+
+def iter_block_rows(block: Block) -> Iterator[Dict[str, Any]]:
+    t = to_arrow(block)
+    for row in t.to_pylist():
+        yield row
+
+
+def slice_block(block: Block, start: int, end: int) -> pa.Table:
+    t = to_arrow(block)
+    return t.slice(start, end - start)
+
+
+def even_split_ranges(total: int, n: int) -> List[tuple]:
+    """[(start, end)] splitting ``total`` rows into ``n`` near-equal pieces."""
+    n = max(1, n)
+    size, rem = divmod(total, n)
+    out, start = [], 0
+    for i in range(n):
+        end = start + size + (1 if i < rem else 0)
+        out.append((start, end))
+        start = end
+    return out
+
+
+def concat_blocks(blocks: List[Block]) -> pa.Table:
+    tables = [to_arrow(b) for b in blocks if to_arrow(b).num_rows > 0]
+    if not tables:
+        return pa.table({})
+    return pa.concat_tables(tables, promote_options="default")

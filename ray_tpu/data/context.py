@@ -1,0 +1,29 @@
+"""DataContext: per-process execution knobs.
+
+reference: python/ray/data/context.py (DataContext).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Optional
+
+
+@dataclasses.dataclass
+class DataContext:
+    target_max_block_size: int = 128 * 1024 * 1024
+    target_min_block_size: int = 1 * 1024 * 1024
+    max_tasks_in_flight: int = 16
+    cpus_per_task: float = 1.0
+    default_batch_format: str = "numpy"
+
+    _current: "Optional[DataContext]" = None
+    _lock = threading.Lock()
+
+    @classmethod
+    def get_current(cls) -> "DataContext":
+        with cls._lock:
+            if cls._current is None:
+                cls._current = DataContext()
+            return cls._current

@@ -1,0 +1,476 @@
+"""Dataset: lazy, distributed, streaming-executed collections of blocks.
+
+reference: python/ray/data/dataset.py — Dataset :166, map_batches :455;
+plan execution _internal/plan.py:413,451; streaming executor
+_internal/execution/streaming_executor.py:57.
+"""
+
+from __future__ import annotations
+
+import functools
+import itertools
+from typing import Any, Callable, Dict, Iterator, List, Optional, Union
+
+import numpy as np
+import pyarrow as pa
+
+from ray_tpu.data._internal.plan import (
+    AllToAll,
+    ExecutionPlan,
+    InputData,
+    LogicalOp,
+    MapBlocks,
+    Read,
+)
+from ray_tpu.data.context import DataContext
+
+
+class ActorPoolStrategy:
+    """reference: data ActorPoolStrategy (compute arg of map_batches)."""
+
+    def __init__(self, size: Optional[int] = None, min_size: Optional[int] = None,
+                 max_size: Optional[int] = None):
+        self.size = size
+        self.min_size = min_size or size or 2
+        self.max_size = max_size or size or self.min_size
+
+
+# -- block-transform builders (top-level so tasks pickle by reference) ------
+
+def _map_batches_block(fn, batch_format, batch_size, zero_copy, block):
+    from ray_tpu.data.block import batch_to_block, block_to_batch, concat_blocks, slice_block, to_arrow
+
+    t = to_arrow(block)
+    if batch_size is None or t.num_rows <= batch_size:
+        batches = [t] if t.num_rows else []
+    else:
+        batches = [slice_block(t, s, min(s + batch_size, t.num_rows))
+                   for s in range(0, t.num_rows, batch_size)]
+    outs = []
+    for b in batches:
+        out = fn(block_to_batch(b, batch_format))
+        outs.append(batch_to_block(out))
+    return concat_blocks(outs) if outs else t
+
+
+def _map_rows_block(fn, block):
+    from ray_tpu.data.block import iter_block_rows, to_arrow
+
+    rows = [fn(r) for r in iter_block_rows(block)]
+    return pa.Table.from_pylist(rows) if rows else to_arrow(block).slice(0, 0)
+
+
+def _flat_map_block(fn, block):
+    from ray_tpu.data.block import iter_block_rows, to_arrow
+
+    rows = [out for r in iter_block_rows(block) for out in fn(r)]
+    return pa.Table.from_pylist(rows) if rows else to_arrow(block).slice(0, 0)
+
+
+def _filter_block(fn, block):
+    from ray_tpu.data.block import iter_block_rows, to_arrow
+
+    rows = [r for r in iter_block_rows(block) if fn(r)]
+    return pa.Table.from_pylist(rows) if rows else to_arrow(block).slice(0, 0)
+
+
+def _add_column_block(name, fn, block):
+    from ray_tpu.data.block import to_arrow
+
+    t = to_arrow(block)
+    col = fn(t.to_pandas())
+    return t.append_column(name, pa.array(np.asarray(col)))
+
+
+def _drop_columns_block(cols, block):
+    from ray_tpu.data.block import to_arrow
+
+    t = to_arrow(block)
+    keep = [c for c in t.column_names if c not in cols]
+    return t.select(keep)
+
+
+def _select_columns_block(cols, block):
+    from ray_tpu.data.block import to_arrow
+
+    return to_arrow(block).select(cols)
+
+
+# -- all-to-all implementations --------------------------------------------
+
+def _repartition_refs(num_blocks: int, refs: List[Any]) -> List[Any]:
+    import ray_tpu
+    from ray_tpu.data.block import concat_blocks, even_split_ranges, slice_block
+
+    blocks = ray_tpu.get(list(refs))
+    merged = concat_blocks(blocks)
+    if merged.num_rows == 0:
+        return [ray_tpu.put(merged)]
+    return [ray_tpu.put(slice_block(merged, s, e))
+            for s, e in even_split_ranges(merged.num_rows, num_blocks)]
+
+
+def _shuffle_refs(seed: Optional[int], refs: List[Any]) -> List[Any]:
+    import ray_tpu
+    from ray_tpu.data.block import concat_blocks, even_split_ranges
+
+    blocks = ray_tpu.get(list(refs))
+    merged = concat_blocks(blocks)
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(merged.num_rows)
+    shuffled = merged.take(pa.array(perm))
+    return [ray_tpu.put(shuffled.slice(s, e - s))
+            for s, e in even_split_ranges(shuffled.num_rows, max(1, len(refs)))]
+
+
+def _sort_refs(key: str, descending: bool, refs: List[Any]) -> List[Any]:
+    import ray_tpu
+    from ray_tpu.data.block import concat_blocks
+
+    merged = concat_blocks(ray_tpu.get(list(refs)))
+    order = "descending" if descending else "ascending"
+    sorted_t = merged.sort_by([(key, order)])
+    return [ray_tpu.put(sorted_t)]
+
+
+class Dataset:
+    """reference: data/dataset.py:166."""
+
+    def __init__(self, plan: ExecutionPlan, ctx: Optional[DataContext] = None):
+        self._plan = plan
+        self._ctx = ctx or DataContext.get_current()
+
+    # -- transforms (lazy) --------------------------------------------------
+    def map_batches(
+        self,
+        fn: Union[Callable, type],
+        *,
+        batch_size: Optional[int] = None,
+        batch_format: Optional[str] = None,
+        compute: Optional[ActorPoolStrategy] = None,
+        fn_constructor_args: Optional[tuple] = None,
+        num_tpus: Optional[float] = None,
+        num_cpus: Optional[float] = None,
+        resources: Optional[Dict[str, float]] = None,
+        zero_copy_batch: bool = False,
+    ) -> "Dataset":
+        """reference: dataset.py:455. Callable-class fn + compute=ActorPoolStrategy
+        runs on an autoscaling actor pool (TPU workers via num_tpus)."""
+        batch_format = batch_format or self._ctx.default_batch_format
+        res: Dict[str, float] = dict(resources or {})
+        if num_cpus is not None:
+            res["CPU"] = num_cpus
+        if num_tpus is not None:
+            res["TPU"] = num_tpus
+        if isinstance(fn, type) or compute is not None:
+            if not isinstance(fn, type):
+                raise ValueError("compute=ActorPoolStrategy requires a callable class fn")
+            compute = compute or ActorPoolStrategy()
+            ctor_args = fn_constructor_args or ()
+
+            def make_callable(cls=fn, args=ctor_args, bf=batch_format, bs=batch_size):
+                inst = cls(*args)
+                return functools.partial(_map_batches_block, inst, bf, bs, False)
+
+            op = MapBlocks(
+                name=f"MapBatches({fn.__name__})",
+                fn=None,
+                compute=compute,
+                fn_constructor=make_callable,
+                resources=res or None,
+            )
+            return Dataset(self._plan.with_op(op), self._ctx)
+        op = MapBlocks(
+            name=f"MapBatches({getattr(fn, '__name__', 'fn')})",
+            fn=functools.partial(_map_batches_block, fn, batch_format, batch_size,
+                                 zero_copy_batch),
+            resources=res or None,
+        )
+        return Dataset(self._plan.with_op(op), self._ctx)
+
+    def map(self, fn: Callable[[Dict], Dict]) -> "Dataset":
+        return Dataset(self._plan.with_op(
+            MapBlocks(name="Map", fn=functools.partial(_map_rows_block, fn))), self._ctx)
+
+    def flat_map(self, fn: Callable[[Dict], List[Dict]]) -> "Dataset":
+        return Dataset(self._plan.with_op(
+            MapBlocks(name="FlatMap", fn=functools.partial(_flat_map_block, fn))), self._ctx)
+
+    def filter(self, fn: Callable[[Dict], bool]) -> "Dataset":
+        return Dataset(self._plan.with_op(
+            MapBlocks(name="Filter", fn=functools.partial(_filter_block, fn))), self._ctx)
+
+    def add_column(self, name: str, fn: Callable) -> "Dataset":
+        return Dataset(self._plan.with_op(
+            MapBlocks(name=f"AddColumn({name})",
+                      fn=functools.partial(_add_column_block, name, fn))), self._ctx)
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        return Dataset(self._plan.with_op(
+            MapBlocks(name="DropColumns",
+                      fn=functools.partial(_drop_columns_block, cols))), self._ctx)
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        return Dataset(self._plan.with_op(
+            MapBlocks(name="SelectColumns",
+                      fn=functools.partial(_select_columns_block, cols))), self._ctx)
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        return Dataset(self._plan.with_op(
+            AllToAll(name="Repartition",
+                     fn=functools.partial(_repartition_refs, num_blocks))), self._ctx)
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        return Dataset(self._plan.with_op(
+            AllToAll(name="RandomShuffle",
+                     fn=functools.partial(_shuffle_refs, seed))), self._ctx)
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        return Dataset(self._plan.with_op(
+            AllToAll(name="Sort",
+                     fn=functools.partial(_sort_refs, key, descending))), self._ctx)
+
+    def limit(self, n: int) -> "Dataset":
+        def _limit(refs):
+            import ray_tpu
+            from ray_tpu.data.block import slice_block
+
+            out, remaining = [], n
+            for ref in refs:
+                if remaining <= 0:
+                    break
+                b = ray_tpu.get(ref)
+                if b.num_rows <= remaining:
+                    out.append(ref)
+                    remaining -= b.num_rows
+                else:
+                    out.append(ray_tpu.put(slice_block(b, 0, remaining)))
+                    remaining = 0
+            return out
+
+        return Dataset(self._plan.with_op(AllToAll(name="Limit", fn=_limit)), self._ctx)
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        refs = self._materialize_refs()
+        for o in others:
+            refs.extend(o._materialize_refs())
+        return Dataset(ExecutionPlan([InputData(name="Union", refs=refs)]), self._ctx)
+
+    # -- split (for Train integration; reference: dataset.py split/streaming_split)
+    def split(self, n: int, *, equal: bool = True) -> List["Dataset"]:
+        from ray_tpu.data.block import even_split_ranges
+
+        refs = self.repartition(n)._materialize_refs()
+        return [
+            Dataset(ExecutionPlan([InputData(name="Split", refs=refs[s:e])]), self._ctx)
+            for s, e in even_split_ranges(len(refs), n)
+        ]
+
+    # -- execution ----------------------------------------------------------
+    def _materialize_refs(self) -> List[Any]:
+        return list(self._plan.execute_iter(self._ctx))
+
+    def materialize(self) -> "Dataset":
+        """Execute the plan, pin blocks (reference: dataset.materialize)."""
+        refs = self._materialize_refs()
+        return Dataset(ExecutionPlan([InputData(name="Materialized", refs=refs)]), self._ctx)
+
+    def iter_batches(
+        self,
+        *,
+        batch_size: Optional[int] = 256,
+        batch_format: Optional[str] = None,
+        drop_last: bool = False,
+    ) -> Iterator[Any]:
+        """Stream batches as blocks complete (reference: iterator over
+        execute_to_iterator, plan.py:413)."""
+        import ray_tpu
+        from ray_tpu.data.block import block_to_batch, concat_blocks, slice_block
+
+        batch_format = batch_format or self._ctx.default_batch_format
+        carry: Optional[pa.Table] = None
+        for ref in self._plan.execute_iter(self._ctx):
+            block = ray_tpu.get(ref)
+            if carry is not None and carry.num_rows:
+                block = concat_blocks([carry, block])
+                carry = None
+            if batch_size is None:
+                if block.num_rows:
+                    yield block_to_batch(block, batch_format)
+                continue
+            start = 0
+            while block.num_rows - start >= batch_size:
+                yield block_to_batch(
+                    slice_block(block, start, start + batch_size), batch_format)
+                start += batch_size
+            if start < block.num_rows:
+                carry = slice_block(block, start, block.num_rows)
+        if carry is not None and carry.num_rows and not drop_last:
+            yield block_to_batch(carry, batch_format)
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        import ray_tpu
+        from ray_tpu.data.block import iter_block_rows
+
+        for ref in self._plan.execute_iter(self._ctx):
+            yield from iter_block_rows(ray_tpu.get(ref))
+
+    def take(self, limit: int = 20) -> List[Dict[str, Any]]:
+        return list(itertools.islice(self.iter_rows(), limit))
+
+    def take_all(self) -> List[Dict[str, Any]]:
+        return list(self.iter_rows())
+
+    def count(self) -> int:
+        import ray_tpu
+
+        return sum(ray_tpu.get(ref).num_rows for ref in self._plan.execute_iter(self._ctx))
+
+    def schema(self) -> Optional[pa.Schema]:
+        import ray_tpu
+
+        for ref in self._plan.execute_iter(self._ctx):
+            return ray_tpu.get(ref).schema
+        return None
+
+    def columns(self) -> List[str]:
+        s = self.schema()
+        return list(s.names) if s else []
+
+    def num_blocks(self) -> int:
+        return len(self._materialize_refs())
+
+    def to_pandas(self):
+        import ray_tpu
+        from ray_tpu.data.block import concat_blocks
+
+        return concat_blocks(
+            ray_tpu.get(self._materialize_refs())).to_pandas()
+
+    def to_arrow(self) -> pa.Table:
+        import ray_tpu
+        from ray_tpu.data.block import concat_blocks
+
+        return concat_blocks(ray_tpu.get(self._materialize_refs()))
+
+    # -- aggregates ---------------------------------------------------------
+    def sum(self, on: str):
+        return self._agg("sum", on)
+
+    def min(self, on: str):
+        return self._agg("min", on)
+
+    def max(self, on: str):
+        return self._agg("max", on)
+
+    def mean(self, on: str):
+        import pyarrow.compute as pc
+
+        t = self.to_arrow()
+        return pc.mean(t.column(on)).as_py()
+
+    def std(self, on: str):
+        import pyarrow.compute as pc
+
+        t = self.to_arrow()
+        return pc.stddev(t.column(on), ddof=1).as_py()
+
+    def _agg(self, op: str, on: str):
+        import pyarrow.compute as pc
+
+        t = self.to_arrow()
+        return getattr(pc, op)(t.column(on)).as_py()
+
+    def groupby(self, key: str) -> "GroupedData":
+        return GroupedData(self, key)
+
+    # -- writes -------------------------------------------------------------
+    def write_parquet(self, path: str) -> List[str]:
+        return self._write(path, "parquet")
+
+    def write_csv(self, path: str) -> List[str]:
+        return self._write(path, "csv")
+
+    def write_json(self, path: str) -> List[str]:
+        return self._write(path, "json")
+
+    def _write(self, path: str, fmt: str) -> List[str]:
+        import os
+
+        import ray_tpu
+        from ray_tpu.data import datasource as ds
+
+        os.makedirs(path, exist_ok=True)
+        writer = {"parquet": ds.write_block_parquet, "csv": ds.write_block_csv,
+                  "json": ds.write_block_json}[fmt]
+        out = []
+        for i, ref in enumerate(self._plan.execute_iter(self._ctx)):
+            out.append(writer(ray_tpu.get(ref), path, i))
+        return out
+
+    def __repr__(self):
+        names = [op.name for op in self._plan.ops]
+        return f"Dataset(plan={' -> '.join(names)})"
+
+    def stats(self) -> str:
+        return repr(self)
+
+
+class GroupedData:
+    """reference: data/grouped_data.py (hash-aggregate based)."""
+
+    def __init__(self, ds: Dataset, key: str):
+        self._ds = ds
+        self._key = key
+
+    def _grouped(self, agg: str, on: Optional[str]):
+        t = self._ds.to_arrow()
+        import pyarrow.compute as pc  # noqa: F401
+
+        on = on or self._key
+        result = t.group_by(self._key).aggregate([(on, agg)])
+        return Dataset(
+            ExecutionPlan([InputData(name="GroupByAgg", refs=[_put_local(result)])]),
+            self._ds._ctx,
+        )
+
+    def count(self) -> Dataset:
+        return self._grouped("count", self._key)
+
+    def sum(self, on: str) -> Dataset:
+        return self._grouped("sum", on)
+
+    def min(self, on: str) -> Dataset:
+        return self._grouped("min", on)
+
+    def max(self, on: str) -> Dataset:
+        return self._grouped("max", on)
+
+    def mean(self, on: str) -> Dataset:
+        return self._grouped("mean", on)
+
+    def map_groups(self, fn: Callable) -> Dataset:
+        t = self._ds.to_arrow()
+        out_blocks = []
+        import pyarrow.compute as pc
+
+        keys = pc.unique(t.column(self._key))
+        for k in keys:
+            mask = pc.equal(t.column(self._key), k)
+            group = t.filter(mask)
+            from ray_tpu.data.block import to_arrow
+
+            out_blocks.append(to_arrow(fn(group)))
+        from ray_tpu.data.block import concat_blocks
+
+        merged = concat_blocks(out_blocks)
+        return Dataset(
+            ExecutionPlan([InputData(name="MapGroups", refs=[_put_local(merged)])]),
+            self._ds._ctx,
+        )
+
+
+def _put_local(block) -> Any:
+    import ray_tpu
+
+    return ray_tpu.put(block)

@@ -1,0 +1,169 @@
+"""Datasources: pluggable readers producing read tasks.
+
+reference: python/ray/data/datasource/ + _internal/datasource/ (~40 sources);
+the core contract is Datasource.get_read_tasks(parallelism) -> [callable
+returning a block] (reference: datasource/datasource.py).
+"""
+
+from __future__ import annotations
+
+import functools
+import glob as glob_mod
+import os
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+
+class Datasource:
+    def get_read_tasks(self, parallelism: int) -> List[Callable[[], pa.Table]]:
+        raise NotImplementedError
+
+    def estimate_inmemory_data_size(self) -> Optional[int]:
+        return None
+
+
+def _expand_paths(paths) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(
+                os.path.join(dp, f) for dp, _, fs in os.walk(p) for f in fs
+                if not f.startswith(".")
+            ))
+        elif any(c in p for c in "*?["):
+            out.extend(sorted(glob_mod.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files matched {paths!r}")
+    return out
+
+
+def _chunk(items: List[Any], n: int) -> List[List[Any]]:
+    n = max(1, min(n, len(items)))
+    size, rem = divmod(len(items), n)
+    chunks, start = [], 0
+    for i in range(n):
+        end = start + size + (1 if i < rem else 0)
+        chunks.append(items[start:end])
+        start = end
+    return [c for c in chunks if c]
+
+
+class RangeDatasource(Datasource):
+    """reference: read_api.py range()."""
+
+    def __init__(self, n: int, column: str = "id"):
+        self.n = n
+        self.column = column
+
+    def get_read_tasks(self, parallelism: int) -> List[Callable]:
+        from ray_tpu.data.block import even_split_ranges
+
+        n = self.n
+        parallelism = max(1, min(parallelism, n)) if n else 1
+        tasks = [functools.partial(_read_range, s, e, self.column)
+                 for s, e in even_split_ranges(n, parallelism) if e > s]
+        return tasks or [functools.partial(_read_range, 0, 0, self.column)]
+
+
+def _read_range(start: int, end: int, column: str) -> pa.Table:
+    return pa.table({column: np.arange(start, end, dtype=np.int64)})
+
+
+class ItemsDatasource(Datasource):
+    """reference: from_items (read_api.py)."""
+
+    def __init__(self, items: List[Any]):
+        self.items = list(items)
+
+    def get_read_tasks(self, parallelism: int) -> List[Callable]:
+        return [functools.partial(_items_to_block, chunk)
+                for chunk in _chunk(self.items, parallelism)] or \
+            [functools.partial(_items_to_block, [])]
+
+
+def _items_to_block(items: List[Any]) -> pa.Table:
+    if items and isinstance(items[0], dict):
+        return pa.Table.from_pylist(items)
+    return pa.table({"item": pa.array(items)})
+
+
+class FileDatasource(Datasource):
+    """One read task per file group."""
+
+    def __init__(self, paths, reader: Callable[[str], pa.Table]):
+        self.files = _expand_paths(paths)
+        self.reader = reader
+
+    def get_read_tasks(self, parallelism: int) -> List[Callable]:
+        return [functools.partial(_read_files, chunk, self.reader)
+                for chunk in _chunk(self.files, parallelism)]
+
+
+def _read_files(files: List[str], reader) -> pa.Table:
+    from ray_tpu.data.block import concat_blocks
+
+    return concat_blocks([reader(f) for f in files])
+
+
+def read_parquet_file(path: str) -> pa.Table:
+    import pyarrow.parquet as pq
+
+    return pq.read_table(path)
+
+
+def read_csv_file(path: str) -> pa.Table:
+    import pyarrow.csv as pacsv
+
+    return pacsv.read_csv(path)
+
+
+def read_json_file(path: str) -> pa.Table:
+    import pyarrow.json as pajson
+
+    return pajson.read_json(path)
+
+
+def read_text_file(path: str) -> pa.Table:
+    with open(path, "r") as f:
+        lines = [ln.rstrip("\n") for ln in f]
+    return pa.table({"text": lines})
+
+
+def read_binary_file(path: str) -> pa.Table:
+    with open(path, "rb") as f:
+        data = f.read()
+    return pa.table({"path": [path], "bytes": pa.array([data], pa.binary())})
+
+
+# -- writers (reference: data write_parquet/csv/json) -----------------------
+
+def write_block_parquet(block: pa.Table, path: str, index: int) -> str:
+    import pyarrow.parquet as pq
+
+    out = os.path.join(path, f"part-{index:05d}.parquet")
+    pq.write_table(block, out)
+    return out
+
+
+def write_block_csv(block: pa.Table, path: str, index: int) -> str:
+    import pyarrow.csv as pacsv
+
+    out = os.path.join(path, f"part-{index:05d}.csv")
+    pacsv.write_csv(block, out)
+    return out
+
+
+def write_block_json(block: pa.Table, path: str, index: int) -> str:
+    out = os.path.join(path, f"part-{index:05d}.jsonl")
+    import json
+
+    with open(out, "w") as f:
+        for row in block.to_pylist():
+            f.write(json.dumps(row, default=str) + "\n")
+    return out

@@ -1,0 +1,88 @@
+"""Read API: dataset constructors.
+
+reference: python/ray/data/read_api.py (read_* :242,796; range, from_items,
+from_pandas, from_numpy, from_arrow).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Union
+
+import numpy as np
+
+from ray_tpu.data._internal.plan import ExecutionPlan, InputData, Read
+from ray_tpu.data.context import DataContext
+from ray_tpu.data.dataset import Dataset
+from ray_tpu.data.datasource import (
+    Datasource,
+    FileDatasource,
+    ItemsDatasource,
+    RangeDatasource,
+    read_binary_file,
+    read_csv_file,
+    read_json_file,
+    read_parquet_file,
+    read_text_file,
+)
+
+DEFAULT_PARALLELISM = 8
+
+
+def read_datasource(datasource: Datasource, *, parallelism: int = -1) -> Dataset:
+    if parallelism <= 0:
+        parallelism = DEFAULT_PARALLELISM
+    tasks = datasource.get_read_tasks(parallelism)
+    plan = ExecutionPlan([Read(name=f"Read{type(datasource).__name__}", read_tasks=tasks)])
+    return Dataset(plan)
+
+
+def range(n: int, *, parallelism: int = -1) -> Dataset:  # noqa: A001
+    return read_datasource(RangeDatasource(n), parallelism=parallelism)
+
+
+def from_items(items: List[Any], *, parallelism: int = -1) -> Dataset:
+    return read_datasource(ItemsDatasource(items), parallelism=parallelism)
+
+
+def from_numpy(arrays: Union[np.ndarray, Dict[str, np.ndarray]],
+               column: str = "data") -> Dataset:
+    import pyarrow as pa
+
+    if isinstance(arrays, dict):
+        table = pa.table({k: pa.array(np.asarray(v)) for k, v in arrays.items()})
+    else:
+        table = pa.table({column: pa.array(np.asarray(arrays))})
+    return from_arrow(table)
+
+
+def from_pandas(df) -> Dataset:
+    import pyarrow as pa
+
+    return from_arrow(pa.Table.from_pandas(df, preserve_index=False))
+
+
+def from_arrow(table) -> Dataset:
+    import ray_tpu
+
+    ref = ray_tpu.put(table)
+    return Dataset(ExecutionPlan([InputData(name="FromArrow", refs=[ref])]))
+
+
+def read_parquet(paths, *, parallelism: int = -1) -> Dataset:
+    return read_datasource(FileDatasource(paths, read_parquet_file), parallelism=parallelism)
+
+
+def read_csv(paths, *, parallelism: int = -1) -> Dataset:
+    return read_datasource(FileDatasource(paths, read_csv_file), parallelism=parallelism)
+
+
+def read_json(paths, *, parallelism: int = -1) -> Dataset:
+    return read_datasource(FileDatasource(paths, read_json_file), parallelism=parallelism)
+
+
+def read_text(paths, *, parallelism: int = -1) -> Dataset:
+    return read_datasource(FileDatasource(paths, read_text_file), parallelism=parallelism)
+
+
+def read_binary_files(paths, *, parallelism: int = -1) -> Dataset:
+    return read_datasource(FileDatasource(paths, read_binary_file), parallelism=parallelism)

@@ -1,0 +1,45 @@
+"""LLM configs.
+
+reference: python/ray/llm/_internal (LLMConfig, engine config). The
+reference reads TP/PP degrees out of vLLM engine_kwargs
+(serve/deployments/llm/vllm/vllm_models.py:177-186); here the engine is the
+framework's own JAX engine and the degrees are mesh axes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass
+class GenerationConfig:
+    max_new_tokens: int = 64
+    temperature: float = 0.0  # 0 → greedy
+    top_k: int = 0  # 0 → disabled
+    seed: int = 0
+    stop_token_ids: tuple = ()
+
+
+@dataclasses.dataclass
+class LLMConfig:
+    """reference analog: llm/_internal LLMConfig + vLLM engine_kwargs."""
+
+    model_config: Any = None  # a models.llama.LlamaConfig (or compatible)
+    max_batch_size: int = 8
+    max_seq_len: Optional[int] = None  # default: model_config.max_seq_len
+    # parallelism degrees (mesh axes; the vllm_models.py:177-186 analog)
+    tensor_parallel_size: int = 1
+    data_parallel_size: int = 1
+    # serving
+    num_replicas: int = 1
+    chips_per_replica: Optional[int] = None
+
+    def resources_per_replica(self) -> Dict[str, float]:
+        chips = self.chips_per_replica
+        if chips is None:
+            chips = self.tensor_parallel_size * self.data_parallel_size
+        res: Dict[str, float] = {"CPU": 1.0}
+        if chips > 1 or self.chips_per_replica is not None:
+            res["TPU"] = float(chips)
+        return res

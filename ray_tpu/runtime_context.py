@@ -35,3 +35,19 @@ class RuntimeContext:
 
         ids = TPUAcceleratorManager.get_current_process_visible_accelerator_ids()
         return {"TPU": ids or []}
+
+    # reference-compat getter aliases (python/ray/runtime_context.py)
+    def get_job_id(self):
+        return self.job_id
+
+    def get_node_id(self):
+        return self.node_id
+
+    def get_worker_id(self):
+        return self.worker_id
+
+    def get_actor_id(self):
+        return self.actor_id
+
+    def get_task_id(self):
+        return self.task_id

@@ -1,0 +1,49 @@
+"""ray_tpu.serve — online model serving.
+
+reference: python/ray/serve/ (SURVEY §2.3, §3.6): controller reconcile loop,
+replica actors, power-of-two-choices routing, HTTP proxy, batching,
+queue-depth autoscaling.
+"""
+
+from ray_tpu.serve.api import (
+    Application,
+    Deployment,
+    delete,
+    deployment,
+    get_app_handle,
+    get_deployment_handle,
+    run,
+    shutdown,
+    status,
+)
+from ray_tpu.serve.batching import batch
+from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+
+__all__ = [
+    "deployment",
+    "Deployment",
+    "Application",
+    "run",
+    "delete",
+    "shutdown",
+    "status",
+    "get_app_handle",
+    "get_deployment_handle",
+    "DeploymentHandle",
+    "DeploymentResponse",
+    "batch",
+]
+
+
+def start_http_proxy(host: str = "127.0.0.1", port: int = 8000):
+    """Start the HTTP proxy in this process and route all deployed apps
+    (reference: serve.start + ProxyActor)."""
+    from ray_tpu.serve._private.proxy import start_proxy
+
+    return start_proxy(host, port)
+
+
+def add_route(route_prefix: str, handle: DeploymentHandle):
+    from ray_tpu.serve._private.proxy import register_route
+
+    register_route(route_prefix, handle)

@@ -1,0 +1,243 @@
+"""ServeController: the reconcile loop.
+
+reference: python/ray/serve/_private/controller.py:91 (ServeController actor),
+application_state.py:794 (ApplicationState.update), deployment_state.py:1391
+(DeploymentState; update :2827), deployment_scheduler.py:277.
+
+Design: a detached actor holding desired state (applications → deployments →
+target replica count) and actual state (replica actor handles). A background
+reconcile thread converges actual → desired: starts/stops replicas, performs
+autoscaling from replica queue stats, bumps a version counter consumed by
+routers long-poll style (long_poll.py:228 analog).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+CONTROLLER_NAME = "_serve_controller"
+
+
+def _cfg_hash(cfg: dict) -> str:
+    """Identity of a deployment's code+config (replicas restart when it
+    changes; num_replicas alone does not force a restart)."""
+    import hashlib
+    import pickle
+
+    key = (cfg.get("serialized_callable"), cfg.get("init_args"),
+           cfg.get("init_kwargs"), cfg.get("user_config"),
+           cfg.get("ray_actor_options"), cfg.get("max_ongoing_requests"))
+    return hashlib.sha1(pickle.dumps(key)).hexdigest()
+
+
+class ServeController:
+    def __init__(self):
+        # app -> deployment -> config dict
+        self._desired: Dict[str, Dict[str, dict]] = {}
+        # app -> deployment -> list of replica handles
+        self._replicas: Dict[str, Dict[str, List[Any]]] = {}
+        # app -> deployment -> config hash the replicas were started with
+        self._replica_cfg: Dict[str, Dict[str, str]] = {}
+        self._version = 0
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._reconcile_loop, daemon=True,
+                                        name="serve-reconcile")
+        self._thread.start()
+
+    # -- API used by serve.run / serve.delete -------------------------------
+    def deploy_application(self, app_name: str, deployments: List[dict]) -> bool:
+        with self._lock:
+            self._desired[app_name] = {d["name"]: d for d in deployments}
+            self._version += 1
+        return True
+
+    def delete_application(self, app_name: str) -> bool:
+        with self._lock:
+            self._desired.pop(app_name, None)
+            self._version += 1
+        return True
+
+    def get_version(self) -> int:
+        return self._version
+
+    def list_applications(self) -> List[str]:
+        with self._lock:
+            return list(self._desired)
+
+    def get_deployment_info(self, app_name: str, deployment_name: Optional[str] = None):
+        with self._lock:
+            app = self._desired.get(app_name)
+            if app is None:
+                return None
+            if deployment_name is None:
+                # the ingress deployment is the one marked, else the last
+                for d in app.values():
+                    if d.get("is_ingress"):
+                        return d
+                return list(app.values())[-1] if app else None
+            return app.get(deployment_name)
+
+    def get_replica_actor_ids(self, app_name: str, deployment_name: str) -> List[str]:
+        """Routers fetch replica actor ids + poll version (long-poll analog)."""
+        with self._lock:
+            reps = self._replicas.get(app_name, {}).get(deployment_name, [])
+            return [r._actor_id.hex() for r in reps]
+
+    def get_deployment_stats(self, app_name: str, deployment_name: str):
+        import ray_tpu
+
+        with self._lock:
+            reps = list(self._replicas.get(app_name, {}).get(deployment_name, []))
+        out = []
+        for r in reps:
+            try:
+                out.append(ray_tpu.get(r.stats.remote(), timeout=5))
+            except Exception:  # noqa: BLE001
+                out.append(None)
+        return out
+
+    def shutdown(self) -> bool:
+        with self._lock:
+            self._desired = {}
+            self._version += 1
+        self._stop.set()
+        # reconcile once more to tear down replicas
+        self._reconcile()
+        return True
+
+    # -- reconciliation ------------------------------------------------------
+    def _reconcile_loop(self):
+        while not self._stop.is_set():
+            try:
+                self._reconcile()
+                self._autoscale()
+            except Exception:  # noqa: BLE001
+                logger.exception("serve reconcile error")
+            time.sleep(0.1)
+
+    def _reconcile(self):
+        import ray_tpu
+
+        with self._lock:
+            desired = {app: dict(deps) for app, deps in self._desired.items()}
+        # stop replicas of deleted apps/deployments, and all replicas whose
+        # deployment config changed (code redeploy → rolling replace)
+        with self._lock:
+            for app in list(self._replicas):
+                for dep in list(self._replicas[app]):
+                    want = desired.get(app, {}).get(dep)
+                    reps = self._replicas[app][dep]
+                    target = want["num_replicas"] if want else 0
+                    if want is not None:
+                        stored = self._replica_cfg.get(app, {}).get(dep)
+                        if stored is not None and stored != _cfg_hash(want):
+                            # code/config changed → kill all; the start phase
+                            # below restarts replicas on the new code
+                            self._replica_cfg.get(app, {}).pop(dep, None)
+                            target = 0
+                    while len(reps) > target:
+                        victim = reps.pop()
+                        try:
+                            ray_tpu.kill(victim)
+                        except Exception:  # noqa: BLE001
+                            pass
+                    if not want:
+                        del self._replicas[app][dep]
+                        self._replica_cfg.get(app, {}).pop(dep, None)
+                        self._version += 1
+                if app not in desired and not self._replicas.get(app):
+                    self._replicas.pop(app, None)
+                    self._replica_cfg.pop(app, None)
+        # start missing replicas (actor creation happens outside the lock; the
+        # desired state is re-checked before committing so a concurrent
+        # shutdown()/delete can't leak freshly started replicas)
+        for app, deps in desired.items():
+            for dep_name, cfg in deps.items():
+                with self._lock:
+                    reps = self._replicas.setdefault(app, {}).setdefault(dep_name, [])
+                    missing = cfg["num_replicas"] - len(reps)
+                if missing <= 0:
+                    continue
+                new = [self._start_replica(app, cfg) for _ in range(missing)]
+                with self._lock:
+                    still_wanted = self._desired.get(app, {}).get(dep_name)
+                    target = still_wanted["num_replicas"] if still_wanted else 0
+                    keep = max(0, min(len(new), target - len(reps)))
+                    reps.extend(new[:keep])
+                    discard = new[keep:]
+                    if keep:
+                        self._replica_cfg.setdefault(app, {})[dep_name] = _cfg_hash(cfg)
+                    self._version += 1
+                for victim in discard:
+                    try:
+                        ray_tpu.kill(victim)
+                    except Exception:  # noqa: BLE001
+                        pass
+
+    def _start_replica(self, app: str, cfg: dict):
+        import ray_tpu
+        from ray_tpu.serve._private.replica import ServeReplica
+
+        opts = dict(cfg.get("ray_actor_options") or {})
+        opts.setdefault("num_cpus", 0.1)
+        opts["max_concurrency"] = max(cfg.get("max_ongoing_requests", 5), 2)
+        cls = ray_tpu.remote(ServeReplica).options(**opts)
+        return cls.remote(
+            cfg["name"], cfg["serialized_callable"], cfg.get("init_args"),
+            cfg.get("init_kwargs"), cfg.get("max_ongoing_requests", 5),
+            cfg.get("app_name", app),
+        )
+
+    def _autoscale(self):
+        """Queue-depth autoscaling (reference: autoscaling_state.py /
+        autoscaling_policy.py — target_ongoing_requests driven)."""
+        import ray_tpu
+
+        with self._lock:
+            items = [(app, dep, dict(cfg)) for app, deps in self._desired.items()
+                     for dep, cfg in deps.items() if cfg.get("autoscaling_config")]
+        for app, dep, cfg in items:
+            ac = cfg["autoscaling_config"]
+            with self._lock:
+                reps = list(self._replicas.get(app, {}).get(dep, []))
+            if not reps:
+                continue
+            total_ongoing = 0
+            for r in reps:
+                try:
+                    total_ongoing += ray_tpu.get(r.queue_len.remote(), timeout=2)
+                except Exception:  # noqa: BLE001
+                    pass
+            target_per_replica = ac.get("target_ongoing_requests", 2)
+            desired_n = max(
+                ac.get("min_replicas", 1),
+                min(ac.get("max_replicas", 10),
+                    round(total_ongoing / max(target_per_replica, 1e-9)) or
+                    ac.get("min_replicas", 1)),
+            )
+            with self._lock:
+                if self._desired.get(app, {}).get(dep):
+                    self._desired[app][dep]["num_replicas"] = desired_n
+
+
+def get_or_create_controller():
+    import ray_tpu
+
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        cls = ray_tpu.remote(ServeController).options(
+            name=CONTROLLER_NAME, lifetime="detached", num_cpus=0,
+            max_concurrency=16,
+        )
+        return cls.remote()
+    except Exception:  # noqa: BLE001
+        return ray_tpu.get_actor(CONTROLLER_NAME)

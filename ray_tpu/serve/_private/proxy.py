@@ -1,0 +1,100 @@
+"""HTTP proxy: routes requests to deployment handles.
+
+reference: python/ray/serve/_private/proxy.py (ProxyActor :1020, HTTPProxy
+:706, uvicorn ASGI http_util.py:23-31). TPU-native rebuild keeps it simple:
+a threaded stdlib HTTP server in the driver/controller process; the hot path
+(handle → replica actor) is identical to the reference's router path.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+
+
+class _ProxyState:
+    def __init__(self):
+        self.routes: Dict[str, object] = {}  # route_prefix -> DeploymentHandle
+        self.lock = threading.Lock()
+
+
+_state = _ProxyState()
+_server: Optional[ThreadingHTTPServer] = None
+_thread: Optional[threading.Thread] = None
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, fmt, *args):  # silence
+        pass
+
+    def _dispatch(self, body: Optional[bytes]):
+        with _state.lock:
+            routes = dict(_state.routes)
+        # longest-prefix match (reference: proxy route matching)
+        path = self.path.split("?")[0]
+        match = None
+        for prefix, handle in sorted(routes.items(), key=lambda kv: -len(kv[0])):
+            if path == prefix or path.startswith(prefix.rstrip("/") + "/") or prefix == "/":
+                match = handle
+                break
+        if match is None:
+            self.send_response(404)
+            self.end_headers()
+            self.wfile.write(b'{"error": "no route"}')
+            return
+        try:
+            payload = json.loads(body) if body else None
+        except json.JSONDecodeError:
+            payload = body.decode() if body else None
+        try:
+            if payload is None:
+                result = match.remote().result(timeout_s=60)
+            else:
+                result = match.remote(payload).result(timeout_s=60)
+            out = json.dumps(result, default=str).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.end_headers()
+            self.wfile.write(out)
+        except Exception as e:  # noqa: BLE001
+            self.send_response(500)
+            self.end_headers()
+            self.wfile.write(json.dumps({"error": str(e)}).encode())
+
+    def do_GET(self):
+        self._dispatch(None)
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length", 0))
+        self._dispatch(self.rfile.read(length) if length else None)
+
+
+def start_proxy(host: str = "127.0.0.1", port: int = 8000) -> Tuple[str, int]:
+    global _server, _thread
+    if _server is not None:
+        return _server.server_address
+    _server = ThreadingHTTPServer((host, port), _Handler)
+    _thread = threading.Thread(target=_server.serve_forever, daemon=True,
+                               name="serve-http-proxy")
+    _thread.start()
+    return _server.server_address
+
+
+def stop_proxy():
+    global _server, _thread
+    if _server is not None:
+        _server.shutdown()
+        _server = None
+        _thread = None
+
+
+def register_route(route_prefix: str, handle):
+    with _state.lock:
+        _state.routes[route_prefix] = handle
+
+
+def unregister_route(route_prefix: str):
+    with _state.lock:
+        _state.routes.pop(route_prefix, None)

@@ -1,0 +1,87 @@
+"""Replica actor: hosts one instance of a deployment.
+
+reference: python/ray/serve/_private/replica.py (Replica, 1919 lines —
+user-callable hosting, ongoing-request accounting for router probes).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+
+class ServeReplica:
+    """Hosts the user class/function; tracks queue length for the
+    power-of-two-choices router (reference: replica.py + pow_2_router.py)."""
+
+    def __init__(self, deployment_name: str, serialized_callable, init_args,
+                 init_kwargs, max_ongoing_requests: int = 5,
+                 app_name: str = "default"):
+        import pickle
+
+        target = pickle.loads(serialized_callable)
+
+        def resolve(v):
+            # bound sub-applications arrive as handle placeholders
+            if isinstance(v, dict) and "__serve_handle__" in v:
+                from ray_tpu.serve.handle import DeploymentHandle
+
+                return DeploymentHandle(app_name, v["__serve_handle__"])
+            return v
+
+        init_args = tuple(resolve(a) for a in (init_args or ()))
+        init_kwargs = {k: resolve(v) for k, v in (init_kwargs or {}).items()}
+        if isinstance(target, type):
+            self._callable = target(*init_args, **init_kwargs)
+        else:
+            self._callable = target
+        self._deployment = deployment_name
+        self._max_ongoing = max_ongoing_requests
+        self._ongoing = 0
+        self._total = 0
+        self._lock = threading.Lock()
+
+    def handle_request(self, method_name: str, args: tuple, kwargs: dict):
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            if method_name == "__call__":
+                target = self._callable
+                if not callable(target):
+                    raise TypeError(
+                        f"deployment {self._deployment!r} instance is not callable")
+            else:
+                target = getattr(self._callable, method_name)
+            out = target(*args, **kwargs)
+            if hasattr(out, "__await__"):
+                import asyncio
+
+                out = asyncio.run(_await_it(out))
+            return out
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
+    def queue_len(self) -> int:
+        """Probe used by the router (reference: pow_2_router.py:52)."""
+        return self._ongoing
+
+    def stats(self) -> Dict[str, Any]:
+        return {"ongoing": self._ongoing, "total": self._total,
+                "max_ongoing": self._max_ongoing}
+
+    def reconfigure(self, user_config) -> bool:
+        if hasattr(self._callable, "reconfigure"):
+            self._callable.reconfigure(user_config)
+        return True
+
+    def check_health(self) -> bool:
+        if hasattr(self._callable, "check_health"):
+            self._callable.check_health()
+        return True
+
+
+async def _await_it(coro):
+    return await coro

@@ -1,0 +1,119 @@
+"""DeploymentHandle + power-of-two-choices router.
+
+reference: python/ray/serve/handle.py (DeploymentHandle, DeploymentResponse)
+and _private/request_router/pow_2_router.py:27 — choose_replicas :52 probes
+the queue length of two random replicas and picks the shorter.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, List, Optional
+
+
+class DeploymentResponse:
+    """Future-like result of handle.remote() (reference: handle.py)."""
+
+    def __init__(self, ref):
+        self._ref = ref
+
+    def result(self, timeout_s: Optional[float] = None):
+        import ray_tpu
+
+        return ray_tpu.get(self._ref, timeout=timeout_s)
+
+    @property
+    def ref(self):
+        return self._ref
+
+
+class _Router:
+    """Caches the replica set; refreshes when the controller version bumps
+    (reference: LongPollClient long_poll.py:71)."""
+
+    def __init__(self, app_name: str, deployment_name: str):
+        self._app = app_name
+        self._dep = deployment_name
+        self._replicas: List[Any] = []
+        self._version = -1
+        self._lock = threading.Lock()
+
+    def _refresh(self):
+        import ray_tpu
+        from ray_tpu.actor import ActorHandle
+        from ray_tpu._private.ids import ActorID
+        from ray_tpu.serve._private.controller import get_or_create_controller
+
+        controller = get_or_create_controller()
+        version = ray_tpu.get(controller.get_version.remote())
+        if version == self._version and self._replicas:
+            return
+        deadline = time.monotonic() + 30.0
+        while True:
+            ids = ray_tpu.get(
+                controller.get_replica_actor_ids.remote(self._app, self._dep))
+            if ids:
+                break
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"no replicas for {self._app}/{self._dep} after 30s")
+            time.sleep(0.05)
+        with self._lock:
+            self._replicas = [ActorHandle(ActorID(h)) for h in ids]
+            self._version = version
+
+    def choose_replica(self):
+        """Power of two choices by queue-length probe (pow_2_router.py:52)."""
+        import ray_tpu
+
+        self._refresh()
+        with self._lock:
+            replicas = list(self._replicas)
+        if len(replicas) == 1:
+            return replicas[0]
+        a, b = random.sample(replicas, 2)
+        try:
+            qa, qb = ray_tpu.get([a.queue_len.remote(), b.queue_len.remote()],
+                                 timeout=5)
+        except Exception:  # noqa: BLE001
+            return a
+        return a if qa <= qb else b
+
+    def invalidate(self):
+        with self._lock:
+            self._version = -1
+
+
+class DeploymentHandle:
+    def __init__(self, app_name: str, deployment_name: str, method_name: str = "__call__"):
+        self._app = app_name
+        self._dep = deployment_name
+        self._method = method_name
+        self._router = _Router(app_name, deployment_name)
+
+    def options(self, method_name: str) -> "DeploymentHandle":
+        h = DeploymentHandle(self._app, self._dep, method_name)
+        h._router = self._router
+        return h
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return self.options(method_name=name)
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        last_err = None
+        for _ in range(3):
+            replica = self._router.choose_replica()
+            try:
+                ref = replica.handle_request.remote(self._method, args, kwargs)
+                return DeploymentResponse(ref)
+            except Exception as e:  # noqa: BLE001
+                last_err = e
+                self._router.invalidate()
+        raise last_err
+
+    def __reduce__(self):
+        return (DeploymentHandle, (self._app, self._dep, self._method))

@@ -1,0 +1,45 @@
+"""ray_tpu.train — SPMD gang training on TPU slices.
+
+reference: python/ray/train/ (SURVEY §2.3, §3.4). The JaxTrainer brings a
+gang of one-worker-per-TPU-host actors up with jax.distributed initialized,
+runs the user train loop on each, pumps ``report()`` results back, persists
+checkpoints (sharded via orbax), and restarts the whole gang on failure.
+"""
+
+from ray_tpu.train._checkpoint import Checkpoint, restore_sharded, save_sharded
+from ray_tpu.train._internal.session import (
+    TrainContext,
+    get_checkpoint,
+    get_context,
+    get_dataset_shard,
+    report,
+)
+from ray_tpu.train.backend import Backend, BackendConfig, JaxConfig
+from ray_tpu.train.config import (
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer, Result
+
+__all__ = [
+    "Checkpoint",
+    "save_sharded",
+    "restore_sharded",
+    "report",
+    "get_context",
+    "get_checkpoint",
+    "get_dataset_shard",
+    "TrainContext",
+    "Backend",
+    "BackendConfig",
+    "JaxConfig",
+    "ScalingConfig",
+    "RunConfig",
+    "FailureConfig",
+    "CheckpointConfig",
+    "DataParallelTrainer",
+    "JaxTrainer",
+    "Result",
+]

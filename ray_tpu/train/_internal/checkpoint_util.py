@@ -1,0 +1,50 @@
+"""Shared checkpoint persistence helpers (used by train's BackendExecutor and
+tune's TuneController)."""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+from typing import List, Optional, Tuple
+
+_CKPT_RE = re.compile(r"^checkpoint_(\d+)$")
+
+
+def persist_staged_checkpoint(src_path: str, dest: str) -> str:
+    """Move (if worker-staged) or copy a checkpoint dir to ``dest``,
+    replacing any stale contents at the destination."""
+    if os.path.abspath(src_path) == os.path.abspath(dest):
+        return dest
+    if os.path.exists(dest):
+        shutil.rmtree(dest)
+    if os.path.dirname(src_path).endswith(".staged"):
+        shutil.move(src_path, dest)
+    else:
+        shutil.copytree(src_path, dest)
+    return dest
+
+
+def existing_checkpoint_indices(run_dir: str) -> List[int]:
+    """Indices of checkpoint_NNNNNN dirs already in a run dir (so a restarted
+    gang continues the sequence instead of overwriting)."""
+    if not os.path.isdir(run_dir):
+        return []
+    out = []
+    for name in os.listdir(run_dir):
+        m = _CKPT_RE.match(name)
+        if m:
+            out.append(int(m.group(1)))
+    return sorted(out)
+
+
+def set_session_resume_checkpoint(path: str) -> bool:
+    """Runs inside a worker actor (via _execute): point the session's
+    latest_checkpoint at ``path`` so train.get_checkpoint() resumes from it."""
+    from ray_tpu.train._checkpoint import Checkpoint
+    from ray_tpu.train._internal import session as session_mod
+
+    s = session_mod.get_session()
+    if s is not None:
+        s.latest_checkpoint = Checkpoint(path)
+    return True

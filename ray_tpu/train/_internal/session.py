@@ -1,0 +1,136 @@
+"""Per-worker training session.
+
+reference: python/ray/train/_internal/session.py — the train_fn runs in a
+session thread; ``train.report(metrics, checkpoint)`` hands results to the
+polling driver (backend_executor.py:588 get_next_results).
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from typing import Any, Dict, Optional
+
+from ray_tpu.train._checkpoint import Checkpoint
+
+
+class TrainContext:
+    """What user code can ask about its place in the gang
+    (reference: ray.train.get_context())."""
+
+    def __init__(self, session: "_TrainSession"):
+        self._s = session
+
+    def get_world_size(self) -> int:
+        return self._s.world_size
+
+    def get_world_rank(self) -> int:
+        return self._s.world_rank
+
+    def get_local_rank(self) -> int:
+        return self._s.local_rank
+
+    def get_local_world_size(self) -> int:
+        return self._s.local_world_size
+
+    def get_node_rank(self) -> int:
+        return self._s.node_rank
+
+    def get_trial_name(self) -> str:
+        return self._s.run_name
+
+    def get_experiment_name(self) -> str:
+        return self._s.run_name
+
+    def get_storage_path(self) -> Optional[str]:
+        return self._s.storage_path
+
+
+class _TrainSession:
+    def __init__(self, *, world_size: int, world_rank: int, local_rank: int = 0,
+                 local_world_size: int = 1, node_rank: int = 0,
+                 run_name: str = "run", storage_path: Optional[str] = None,
+                 dataset_shards: Optional[Dict[str, Any]] = None):
+        self.world_size = world_size
+        self.world_rank = world_rank
+        self.local_rank = local_rank
+        self.local_world_size = local_world_size
+        self.node_rank = node_rank
+        self.run_name = run_name
+        self.storage_path = storage_path
+        self.dataset_shards = dataset_shards or {}
+        self.result_queue: "queue.Queue" = queue.Queue()
+        self.latest_checkpoint: Optional[Checkpoint] = None
+        self.finished = threading.Event()
+        self.error: Optional[BaseException] = None
+
+    def report(self, metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None):
+        # Persist worker-side BEFORE returning (the reference uploads from the
+        # worker in report(), train/_internal/storage.py) — the caller may
+        # delete its local checkpoint dir right after report() returns.
+        if checkpoint is not None and self.storage_path:
+            import shutil
+            import uuid
+
+            staged = os.path.join(self.storage_path, ".staged",
+                                  f"ckpt_{uuid.uuid4().hex[:8]}")
+            shutil.copytree(checkpoint.path, staged, dirs_exist_ok=True)
+            checkpoint = Checkpoint(staged)
+        self.result_queue.put({"metrics": dict(metrics), "checkpoint": checkpoint,
+                               "rank": self.world_rank})
+
+    def get_dataset_shard(self, name: str = "train"):
+        shard = self.dataset_shards.get(name)
+        if shard is None:
+            raise KeyError(f"no dataset shard named {name!r} was passed to the trainer")
+        return shard
+
+
+_session: Optional[_TrainSession] = None
+_session_lock = threading.Lock()
+
+
+def init_session(**kwargs) -> _TrainSession:
+    global _session
+    with _session_lock:
+        _session = _TrainSession(**kwargs)
+        return _session
+
+
+def get_session() -> Optional[_TrainSession]:
+    return _session
+
+
+def shutdown_session():
+    global _session
+    with _session_lock:
+        _session = None
+
+
+# -- public API (ray.train.report / get_context / get_checkpoint) -----------
+
+def report(metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None):
+    s = get_session()
+    if s is None:
+        raise RuntimeError("ray_tpu.train.report() called outside a training session")
+    s.report(metrics, checkpoint)
+
+
+def get_context() -> TrainContext:
+    s = get_session()
+    if s is None:
+        raise RuntimeError("not inside a training session")
+    return TrainContext(s)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    s = get_session()
+    return s.latest_checkpoint if s else None
+
+
+def get_dataset_shard(name: str = "train"):
+    s = get_session()
+    if s is None:
+        raise RuntimeError("not inside a training session")
+    return s.get_dataset_shard(name)

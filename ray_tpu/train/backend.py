@@ -1,0 +1,105 @@
+"""Backend plugin ABC + the JAX backend.
+
+reference: python/ray/train/backend.py — Backend :16 / BackendConfig :32 with
+hooks on_start :45, on_training_start :53, on_shutdown :49; the torch
+rendezvous analog is _TorchBackend (torch/config.py:154): worker-0 address →
+dist.init_process_group on every worker (:116). TPU-native: JaxConfig's
+on_start publishes worker-0's coordinator address and every worker calls
+jax.distributed.initialize — XLA then spans the gang's devices (SURVEY §3.4
+TPU mapping).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+class BackendConfig:
+    """Declarative config naming its Backend class (reference: backend.py:32)."""
+
+    @property
+    def backend_cls(self):
+        return Backend
+
+
+class Backend:
+    """Gang-setup hooks around the worker group (reference: backend.py:16)."""
+
+    def on_start(self, worker_group, backend_config: BackendConfig):  # noqa: B027
+        pass
+
+    def on_training_start(self, worker_group, backend_config: BackendConfig):  # noqa: B027
+        pass
+
+    def on_shutdown(self, worker_group, backend_config: BackendConfig):  # noqa: B027
+        pass
+
+
+# ---------------------------------------------------------------------------
+# JAX backend
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class JaxConfig(BackendConfig):
+    """Config for multi-host jax gangs.
+
+    distributed: None = auto (initialize jax.distributed iff >1 worker);
+    True/False force it. On TPU pods every worker must call
+    jax.distributed.initialize before touching devices.
+    """
+
+    distributed: Optional[bool] = None
+    coordinator_port: Optional[int] = None
+
+    @property
+    def backend_cls(self):
+        return _JaxBackend
+
+
+def _pick_coordinator(port: Optional[int]):
+    import socket
+
+    # NOT gethostbyname(gethostname()) — that resolves to 127.0.1.1 on many
+    # distros, which other hosts of the gang cannot reach.
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("8.8.8.8", 80))
+            host = s.getsockname()[0]
+    except OSError:
+        host = "127.0.0.1"
+    if port is None:
+        with socket.socket() as s:
+            s.bind(("", 0))
+            port = s.getsockname()[1]
+    return f"{host}:{port}"
+
+
+def _init_jax_distributed(coordinator: str, num_processes: int, process_id: int):
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return True
+
+
+class _JaxBackend(Backend):
+    def on_start(self, worker_group, backend_config: JaxConfig):
+        n = len(worker_group)
+        distributed = backend_config.distributed
+        if distributed is None:
+            distributed = n > 1
+        if not distributed:
+            return
+        coordinator = worker_group.execute_single(
+            0, _pick_coordinator, backend_config.coordinator_port
+        )
+        import ray_tpu
+
+        ray_tpu.get([
+            w._execute.remote(_init_jax_distributed, coordinator, n, i)
+            for i, w in enumerate(worker_group.workers)
+        ])

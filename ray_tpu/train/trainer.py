@@ -1,0 +1,161 @@
+"""Trainers: DataParallelTrainer + JaxTrainer.
+
+reference: python/ray/train/base_trainer.py:651 (fit), data_parallel_trainer.py:26;
+the controller loop mirrors Train v2's TrainController
+(v2/_internal/execution/controller/controller.py:93 — run :461 polling
+FailurePolicy each iteration :439). Elastic recovery restarts the whole gang
+(slice-granular — a partial TPU slice is useless, SURVEY hard-part #5) and
+resumes from the latest persisted checkpoint via train.get_checkpoint().
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.train._checkpoint import Checkpoint
+from ray_tpu.train._internal.backend_executor import BackendExecutor, TrainingFailedError
+from ray_tpu.train.backend import BackendConfig, JaxConfig
+from ray_tpu.train.config import (
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class Result:
+    """reference: ray.train.Result (air/result.py)."""
+
+    metrics: Dict[str, Any]
+    checkpoint: Optional[Checkpoint]
+    path: str
+    error: Optional[BaseException] = None
+    metrics_history: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+
+    def best_checkpoint(self) -> Optional[Checkpoint]:
+        return self.checkpoint
+
+
+class DataParallelTrainer:
+    """SPMD gang trainer: run train_fn on every worker of the gang
+    (reference: data_parallel_trainer.py:26)."""
+
+    _default_backend_config: BackendConfig = BackendConfig()
+
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        train_loop_config: Optional[Dict[str, Any]] = None,
+        backend_config: Optional[BackendConfig] = None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+        resume_from_checkpoint: Optional[Checkpoint] = None,
+    ):
+        self._train_fn = train_loop_per_worker
+        self._train_config = train_loop_config
+        self._backend_config = backend_config or self._default_backend_config
+        self._scaling = scaling_config or ScalingConfig()
+        self._run_config = run_config or RunConfig()
+        self._datasets = datasets or {}
+        self._resume_checkpoint = resume_from_checkpoint
+
+    # -- controller loop (v2-style) -----------------------------------------
+    def fit(self) -> Result:
+        name = self._run_config.name or f"train_{uuid.uuid4().hex[:8]}"
+        run_dir = os.path.join(self._run_config.resolved_storage_path(), name)
+        os.makedirs(run_dir, exist_ok=True)
+        failure_config = self._run_config.failure_config or FailureConfig()
+        max_failures = failure_config.max_failures
+        failures = 0
+        latest_ckpt = self._resume_checkpoint
+        history: List[Dict[str, Any]] = []
+
+        while True:
+            executor = BackendExecutor(
+                self._backend_config,
+                self._scaling,
+                run_dir,
+                self._run_config.checkpoint_config,
+            )
+            try:
+                shards = self._shard_datasets(self._scaling.total_workers)
+                executor.start(dataset_shards=shards)
+                self._push_resume_checkpoint(executor, latest_ckpt)
+                executor.start_training(self._train_fn, self._train_config)
+                final_metrics: Dict[str, Any] = {}
+                while True:
+                    results, finished, error = executor.poll()
+                    # persist same-round checkpoints before acting on an error
+                    for r in results:
+                        ckpt = executor.persist_checkpoint(r)
+                        if ckpt is not None:
+                            latest_ckpt = ckpt
+                        if r["rank"] == 0:
+                            final_metrics = r["metrics"]
+                            history.append(r["metrics"])
+                    if error:
+                        raise TrainingFailedError(error)
+                    if finished:
+                        break
+                executor.shutdown()
+                return Result(
+                    metrics=final_metrics, checkpoint=latest_ckpt, path=run_dir,
+                    metrics_history=history,
+                )
+            except TrainingFailedError as e:
+                executor.shutdown()
+                failures += 1
+                if failures > max_failures >= 0:
+                    return Result(
+                        metrics={}, checkpoint=latest_ckpt, path=run_dir, error=e,
+                        metrics_history=history,
+                    )
+                logger.warning(
+                    "training attempt %d failed (%s); restarting gang from %s",
+                    failures, e, latest_ckpt,
+                )
+                time.sleep(min(2.0 * failures, 10.0))
+
+    def _push_resume_checkpoint(self, executor: BackendExecutor,
+                                ckpt: Optional[Checkpoint]):
+        if ckpt is None or executor.worker_group is None:
+            return
+        from ray_tpu.train._internal.checkpoint_util import set_session_resume_checkpoint
+
+        executor.worker_group.execute(set_session_resume_checkpoint, ckpt.path)
+
+    def _shard_datasets(self, num_workers: int) -> Optional[List[Dict[str, Any]]]:
+        if not self._datasets:
+            return None
+        shards: List[Dict[str, Any]] = [dict() for _ in range(num_workers)]
+        for name, ds in self._datasets.items():
+            if hasattr(ds, "split"):
+                for i, piece in enumerate(ds.split(num_workers)):
+                    shards[i][name] = piece
+            else:
+                for i in range(num_workers):
+                    shards[i][name] = ds
+        return shards
+
+
+class JaxTrainer(DataParallelTrainer):
+    """DataParallelTrainer with the JAX backend: the gang comes up with
+    jax.distributed initialized so user code sees the slice's global devices
+    (reference analog: TorchTrainer + _TorchBackend, torch/config.py:154)."""
+
+    _default_backend_config = JaxConfig()
+
+    def __init__(self, train_loop_per_worker, *, jax_config: Optional[JaxConfig] = None,
+                 **kwargs):
+        kwargs.setdefault("backend_config", jax_config or JaxConfig())
+        super().__init__(train_loop_per_worker, **kwargs)

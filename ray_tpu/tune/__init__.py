@@ -1,0 +1,52 @@
+"""ray_tpu.tune — hyperparameter search over trial actors.
+
+reference: python/ray/tune/ (SURVEY §2.3): Tuner + controller event loop,
+variant generation, ASHA / median-stopping / PBT schedulers, trial-per-slice
+placement via TuneConfig.trial_resources (e.g. {"TPU": 4}).
+"""
+
+from ray_tpu.tune.experiment import Trial
+from ray_tpu.tune.result_grid import ResultGrid, TrialResult
+from ray_tpu.tune.schedulers import (
+    ASHAScheduler,
+    AsyncHyperBandScheduler,
+    FIFOScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from ray_tpu.tune.search.sample import (
+    choice,
+    grid_search,
+    loguniform,
+    quniform,
+    randint,
+    sample_from,
+    uniform,
+)
+from ray_tpu.tune.session import get_checkpoint, report
+from ray_tpu.tune.tuner import TuneConfig, TuneController, Tuner
+
+__all__ = [
+    "Tuner",
+    "TuneConfig",
+    "TuneController",
+    "Trial",
+    "ResultGrid",
+    "TrialResult",
+    "report",
+    "get_checkpoint",
+    "uniform",
+    "loguniform",
+    "quniform",
+    "randint",
+    "choice",
+    "grid_search",
+    "sample_from",
+    "TrialScheduler",
+    "FIFOScheduler",
+    "ASHAScheduler",
+    "AsyncHyperBandScheduler",
+    "MedianStoppingRule",
+    "PopulationBasedTraining",
+]

@@ -1,0 +1,43 @@
+"""Trial state.
+
+reference: python/ray/tune/experiment/trial.py (Trial status lifecycle
+PENDING/RUNNING/PAUSED/TERMINATED/ERROR).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import uuid
+from typing import Any, Dict, List, Optional
+
+
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+PAUSED = "PAUSED"
+TERMINATED = "TERMINATED"
+ERROR = "ERROR"
+
+
+@dataclasses.dataclass
+class Trial:
+    config: Dict[str, Any]
+    trial_id: str = dataclasses.field(default_factory=lambda: uuid.uuid4().hex[:8])
+    status: str = PENDING
+    metrics: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    metrics_history: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    error: Optional[str] = None
+    checkpoint_path: Optional[str] = None
+    training_iteration: int = 0
+    # PBT plumbing (set by the scheduler, consumed by the controller)
+    pbt_exploit_from: Optional["Trial"] = None
+    pbt_new_config: Optional[Dict[str, Any]] = None
+
+    def __hash__(self):
+        return hash(self.trial_id)
+
+    def __eq__(self, other):
+        return isinstance(other, Trial) and self.trial_id == other.trial_id
+
+    @property
+    def last_result(self) -> Dict[str, Any]:
+        return self.metrics

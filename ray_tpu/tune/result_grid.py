@@ -1,0 +1,65 @@
+"""ResultGrid: the outcome of Tuner.fit().
+
+reference: python/ray/tune/result_grid.py (get_best_result, get_dataframe).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class TrialResult:
+    trial_id: str
+    config: Dict[str, Any]
+    metrics: Dict[str, Any]
+    metrics_history: List[Dict[str, Any]]
+    error: Optional[str]
+    checkpoint_path: Optional[str]
+    path: str
+
+
+class ResultGrid:
+    def __init__(self, results: List[TrialResult], metric: Optional[str] = None,
+                 mode: str = "min"):
+        self._results = results
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self):
+        return len(self._results)
+
+    def __getitem__(self, i) -> TrialResult:
+        return self._results[i]
+
+    def __iter__(self):
+        return iter(self._results)
+
+    @property
+    def errors(self) -> List[str]:
+        return [r.error for r in self._results if r.error]
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> TrialResult:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        if metric is None:
+            raise ValueError("metric required (set TuneConfig.metric or pass one)")
+        candidates = [r for r in self._results if metric in r.metrics]
+        if not candidates:
+            raise RuntimeError("no trial reported the requested metric")
+        key = lambda r: r.metrics[metric]  # noqa: E731
+        return max(candidates, key=key) if mode == "max" else min(candidates, key=key)
+
+    def get_dataframe(self):
+        import pandas as pd
+
+        rows = []
+        for r in self._results:
+            row = dict(r.metrics)
+            row["trial_id"] = r.trial_id
+            for k, v in r.config.items():
+                row[f"config/{k}"] = v
+            rows.append(row)
+        return pd.DataFrame(rows)

@@ -1,0 +1,67 @@
+"""ASHA: asynchronous successive halving.
+
+reference: python/ray/tune/schedulers/async_hyperband.py
+(AsyncHyperBandScheduler/ASHAScheduler): rungs at grace_period *
+reduction_factor^k; a trial reaching a rung continues only if its metric is
+in the top 1/reduction_factor of results recorded at that rung.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Any, Dict, List
+
+from ray_tpu.tune.schedulers.trial_scheduler import TrialScheduler
+
+
+class AsyncHyperBandScheduler(TrialScheduler):
+    def __init__(
+        self,
+        metric: str = "loss",
+        mode: str = "min",
+        time_attr: str = "training_iteration",
+        max_t: int = 100,
+        grace_period: int = 1,
+        reduction_factor: float = 3,
+        brackets: int = 1,
+    ):
+        assert mode in ("min", "max")
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.max_t = max_t
+        self.grace = grace_period
+        self.rf = reduction_factor
+        # rung milestones: grace, grace*rf, grace*rf^2, ... < max_t
+        self.milestones: List[float] = []
+        t = grace_period
+        while t < max_t:
+            self.milestones.append(t)
+            t *= reduction_factor
+        # recorded metric values per rung
+        self._rungs: Dict[float, List[float]] = defaultdict(list)
+        self._trial_last_rung: Dict[Any, float] = {}
+
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> str:
+        t = result.get(self.time_attr)
+        value = result.get(self.metric)
+        if t is None or value is None:
+            return self.CONTINUE
+        if t >= self.max_t:
+            return self.STOP
+        value = float(value) if self.mode == "max" else -float(value)
+        decision = self.CONTINUE
+        for rung in self.milestones:
+            if t >= rung and self._trial_last_rung.get(trial, -1) < rung:
+                self._trial_last_rung[trial] = rung
+                recorded = self._rungs[rung]
+                recorded.append(value)
+                k = max(1, int(len(recorded) / self.rf))
+                top_k = sorted(recorded, reverse=True)[:k]
+                cutoff = top_k[-1]
+                if value < cutoff:
+                    decision = self.STOP
+        return decision
+
+
+ASHAScheduler = AsyncHyperBandScheduler

@@ -1,0 +1,46 @@
+"""Median stopping rule.
+
+reference: python/ray/tune/schedulers/median_stopping_rule.py: stop a trial
+at time t if its best result so far is worse than the median of other
+trials' running averages at t.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Any, Dict, List
+
+from ray_tpu.tune.schedulers.trial_scheduler import TrialScheduler
+
+
+class MedianStoppingRule(TrialScheduler):
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 time_attr: str = "training_iteration",
+                 grace_period: int = 1, min_samples_required: int = 3):
+        assert mode in ("min", "max")
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.grace = grace_period
+        self.min_samples = min_samples_required
+        self._trial_history: Dict[Any, List[float]] = defaultdict(list)
+
+    def _signed(self, v: float) -> float:
+        return float(v) if self.mode == "max" else -float(v)
+
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> str:
+        t = result.get(self.time_attr, 0)
+        value = result.get(self.metric)
+        if value is None:
+            return self.CONTINUE
+        self._trial_history[trial].append(self._signed(value))
+        if t < self.grace:
+            return self.CONTINUE
+        others = [sum(h) / len(h) for tr, h in self._trial_history.items()
+                  if tr is not trial and h]
+        if len(others) < self.min_samples:
+            return self.CONTINUE
+        others.sort()
+        median = others[len(others) // 2]
+        best = max(self._trial_history[trial])
+        return self.STOP if best < median else self.CONTINUE

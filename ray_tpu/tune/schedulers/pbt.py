@@ -1,0 +1,81 @@
+"""Population based training.
+
+reference: python/ray/tune/schedulers/pbt.py: at each perturbation_interval,
+bottom-quantile trials exploit (load the checkpoint + config of a
+top-quantile trial) and explore (mutate hyperparams by resample or
+perturbation factors 1.2/0.8).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.tune.schedulers.trial_scheduler import TrialScheduler
+from ray_tpu.tune.search.sample import Domain
+
+
+class PopulationBasedTraining(TrialScheduler):
+    def __init__(
+        self,
+        metric: str = "loss",
+        mode: str = "min",
+        time_attr: str = "training_iteration",
+        perturbation_interval: int = 5,
+        hyperparam_mutations: Optional[Dict[str, Any]] = None,
+        quantile_fraction: float = 0.25,
+        resample_probability: float = 0.25,
+        seed: Optional[int] = None,
+    ):
+        assert mode in ("min", "max")
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.resample_prob = resample_probability
+        self.rng = random.Random(seed)
+        self._last_perturb: Dict[Any, int] = {}
+        self._latest: Dict[Any, float] = {}
+
+    def _signed(self, v) -> float:
+        return float(v) if self.mode == "max" else -float(v)
+
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> str:
+        t = result.get(self.time_attr, 0)
+        value = result.get(self.metric)
+        if value is not None:
+            self._latest[trial] = self._signed(value)
+        if t - self._last_perturb.get(trial, 0) < self.interval:
+            return self.CONTINUE
+        self._last_perturb[trial] = t
+        ranked = sorted(self._latest, key=self._latest.get)  # worst..best
+        if len(ranked) < 2:
+            return self.CONTINUE
+        n_q = max(1, int(len(ranked) * self.quantile))
+        bottom, top = ranked[:n_q], ranked[-n_q:]
+        if trial in bottom:
+            donor = self.rng.choice(top)
+            # the controller performs the actual exploit/explore restart
+            trial.pbt_exploit_from = donor
+            trial.pbt_new_config = self._explore(dict(donor.config))
+            return self.PAUSE
+        return self.CONTINUE
+
+    def _explore(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        for key, spec in self.mutations.items():
+            if key not in config:
+                continue
+            if self.rng.random() < self.resample_prob or not isinstance(
+                    config[key], (int, float)):
+                if isinstance(spec, Domain):
+                    config[key] = spec.sample(self.rng)
+                elif isinstance(spec, (list, tuple)):
+                    config[key] = self.rng.choice(list(spec))
+                elif callable(spec):
+                    config[key] = spec()
+            else:
+                factor = 1.2 if self.rng.random() > 0.5 else 0.8
+                config[key] = type(config[key])(config[key] * factor)
+        return config

@@ -1,0 +1,31 @@
+"""Trial scheduler ABC + FIFO.
+
+reference: python/ray/tune/schedulers/trial_scheduler.py (TrialScheduler
+CONTINUE/PAUSE/STOP decisions, on_trial_result hook).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+
+class TrialScheduler:
+    CONTINUE = "CONTINUE"
+    PAUSE = "PAUSE"
+    STOP = "STOP"
+
+    def on_trial_add(self, trial) -> None:  # noqa: B027
+        pass
+
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> str:
+        return self.CONTINUE
+
+    def on_trial_complete(self, trial, result: Dict[str, Any]) -> None:  # noqa: B027
+        pass
+
+    def choose_trial_to_run(self, pending):  # first runnable by default
+        return pending[0] if pending else None
+
+
+class FIFOScheduler(TrialScheduler):
+    """Run every trial to completion (reference: trial_scheduler.py FIFO)."""

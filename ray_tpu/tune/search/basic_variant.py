@@ -1,0 +1,75 @@
+"""Variant generation: grid expansion × random sampling.
+
+reference: python/ray/tune/search/basic_variant.py (BasicVariantGenerator).
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+from typing import Any, Dict, Iterator, List, Tuple
+
+from ray_tpu.tune.search.sample import Domain, GridSearch
+
+
+def _find_special(space: Dict[str, Any], prefix: Tuple[str, ...] = ()):
+    """Walk the (possibly nested) param space; yield (path, spec) for grids
+    and domains."""
+    for k, v in space.items():
+        path = prefix + (k,)
+        if isinstance(v, dict) and "grid_search" in v and len(v) == 1:
+            yield path, GridSearch(v["grid_search"])
+        elif isinstance(v, GridSearch):
+            yield path, v
+        elif isinstance(v, Domain):
+            yield path, v
+        elif isinstance(v, dict):
+            yield from _find_special(v, path)
+
+
+def _set_path(d: Dict, path: Tuple[str, ...], value: Any):
+    for k in path[:-1]:
+        d = d.setdefault(k, {})
+    d[path[-1]] = value
+
+
+def _deep_copy_resolved(space: Dict[str, Any]) -> Dict[str, Any]:
+    out = {}
+    for k, v in space.items():
+        if isinstance(v, dict) and not ("grid_search" in v and len(v) == 1):
+            out[k] = _deep_copy_resolved(v)
+        else:
+            out[k] = v
+    return out
+
+
+class BasicVariantGenerator:
+    """Expands grid_search cartesian-product × num_samples random draws."""
+
+    def __init__(self, param_space: Dict[str, Any], num_samples: int = 1,
+                 seed: int | None = None):
+        self.space = param_space
+        self.num_samples = num_samples
+        self.rng = random.Random(seed)
+
+    def variants(self) -> Iterator[Dict[str, Any]]:
+        specials = list(_find_special(self.space))
+        grid_paths = [(p, s) for p, s in specials if isinstance(s, GridSearch)]
+        domain_paths = [(p, s) for p, s in specials if isinstance(s, Domain)]
+        grid_axes = [[(p, v) for v in s.values] for p, s in grid_paths] or [[]]
+        for _ in range(self.num_samples):
+            for combo in itertools.product(*grid_axes) if grid_paths else [()]:
+                cfg = _deep_copy_resolved(self.space)
+                for p, v in combo:
+                    _set_path(cfg, p, v)
+                for p, dom in domain_paths:
+                    _set_path(cfg, p, dom.sample(self.rng))
+                yield cfg
+
+    def count(self) -> int:
+        specials = list(_find_special(self.space))
+        n = 1
+        for _, s in specials:
+            if isinstance(s, GridSearch):
+                n *= len(s.values)
+        return n * self.num_samples

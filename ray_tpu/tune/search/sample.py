@@ -1,0 +1,101 @@
+"""Search-space primitives.
+
+reference: python/ray/tune/search/sample.py (uniform, loguniform, choice,
+randint, quniform, grid_search).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Callable, Dict, List, Sequence
+
+
+class Domain:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+class Uniform(Domain):
+    def __init__(self, low: float, high: float):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.uniform(self.low, self.high)
+
+
+class LogUniform(Domain):
+    def __init__(self, low: float, high: float):
+        import math
+
+        self.log_low, self.log_high = math.log(low), math.log(high)
+
+    def sample(self, rng):
+        import math
+
+        return math.exp(rng.uniform(self.log_low, self.log_high))
+
+
+class QUniform(Domain):
+    def __init__(self, low: float, high: float, q: float):
+        self.low, self.high, self.q = low, high, q
+
+    def sample(self, rng):
+        v = rng.uniform(self.low, self.high)
+        return round(v / self.q) * self.q
+
+
+class Randint(Domain):
+    def __init__(self, low: int, high: int):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.randrange(self.low, self.high)
+
+
+class Choice(Domain):
+    def __init__(self, categories: Sequence[Any]):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+class SampleFrom(Domain):
+    def __init__(self, fn: Callable):
+        self.fn = fn
+
+    def sample(self, rng):
+        return self.fn(None)
+
+
+class GridSearch:
+    def __init__(self, values: Sequence[Any]):
+        self.values = list(values)
+
+
+def uniform(low: float, high: float) -> Uniform:
+    return Uniform(low, high)
+
+
+def loguniform(low: float, high: float) -> LogUniform:
+    return LogUniform(low, high)
+
+
+def quniform(low: float, high: float, q: float) -> QUniform:
+    return QUniform(low, high, q)
+
+
+def randint(low: int, high: int) -> Randint:
+    return Randint(low, high)
+
+
+def choice(categories: Sequence[Any]) -> Choice:
+    return Choice(categories)
+
+
+def sample_from(fn: Callable) -> SampleFrom:
+    return SampleFrom(fn)
+
+
+def grid_search(values: Sequence[Any]) -> Dict[str, List[Any]]:
+    return {"grid_search": list(values)}

@@ -1,0 +1,20 @@
+"""Tune worker-side session: tune.report / tune.get_checkpoint.
+
+reference: ray.tune uses the shared ray.train session (train/_internal/session.py);
+here likewise — the tune trial actor hosts a train session underneath.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ray_tpu.train._checkpoint import Checkpoint
+from ray_tpu.train._internal import session as train_session
+
+
+def report(metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None):
+    train_session.report(metrics, checkpoint)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    return train_session.get_checkpoint()

@@ -1,0 +1,227 @@
+"""Tuner + TuneController.
+
+reference: python/ray/tune/tuner.py:43 (Tuner, fit :312) and
+tune/execution/tune_controller.py:68 — the event loop: start trials up to
+resource limits, poll running trials, feed results to the scheduler, act on
+CONTINUE/PAUSE/STOP, until all trials terminate. PBT exploit/explore is a
+checkpoint-restore restart with a mutated config (schedulers/pbt.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.train._internal.worker_group import RayTrainWorker
+from ray_tpu.train.config import RunConfig
+from ray_tpu.tune.experiment import (
+    ERROR,
+    PAUSED,
+    PENDING,
+    RUNNING,
+    TERMINATED,
+    Trial,
+)
+from ray_tpu.tune.result_grid import ResultGrid, TrialResult
+from ray_tpu.tune.schedulers.trial_scheduler import FIFOScheduler, TrialScheduler
+from ray_tpu.tune.search.basic_variant import BasicVariantGenerator
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class TuneConfig:
+    """reference: tune/tune_config.py (metric, mode, num_samples,
+    max_concurrent_trials, scheduler)."""
+
+    metric: Optional[str] = None
+    mode: str = "min"
+    num_samples: int = 1
+    max_concurrent_trials: Optional[int] = None
+    scheduler: Optional[TrialScheduler] = None
+    trial_resources: Optional[Dict[str, float]] = None
+    seed: Optional[int] = None
+
+
+class Tuner:
+    """reference: tune/tuner.py:43."""
+
+    def __init__(
+        self,
+        trainable: Callable,
+        *,
+        param_space: Optional[Dict[str, Any]] = None,
+        tune_config: Optional[TuneConfig] = None,
+        run_config: Optional[RunConfig] = None,
+    ):
+        self._trainable = trainable
+        self._param_space = param_space or {}
+        self._tune_config = tune_config or TuneConfig()
+        self._run_config = run_config or RunConfig()
+
+    def fit(self) -> ResultGrid:
+        controller = TuneController(
+            self._trainable, self._param_space, self._tune_config, self._run_config
+        )
+        return controller.run()
+
+
+class TuneController:
+    """reference: tune/execution/tune_controller.py:68."""
+
+    def __init__(self, trainable, param_space, tune_config: TuneConfig,
+                 run_config: RunConfig):
+        self._trainable = trainable
+        self._tc = tune_config
+        self._rc = run_config
+        name = run_config.name or f"tune_{uuid.uuid4().hex[:8]}"
+        self._exp_dir = os.path.join(run_config.resolved_storage_path(), name)
+        os.makedirs(self._exp_dir, exist_ok=True)
+        gen = BasicVariantGenerator(param_space, tune_config.num_samples,
+                                    seed=tune_config.seed)
+        self.trials: List[Trial] = [Trial(config=cfg) for cfg in gen.variants()]
+        self._scheduler = tune_config.scheduler or FIFOScheduler()
+        for t in self.trials:
+            self._scheduler.on_trial_add(t)
+        self._actors: Dict[str, Any] = {}  # trial_id -> actor handle
+
+    # -- trial actor management --------------------------------------------
+    def _start_trial(self, trial: Trial, resume_checkpoint: Optional[str] = None):
+        import ray_tpu
+
+        res = dict(self._tc.trial_resources or {"CPU": 1.0})
+        cls = ray_tpu.remote(RayTrainWorker).options(
+            num_cpus=res.get("CPU", 1.0),
+            resources={k: v for k, v in res.items() if k != "CPU"},
+            max_concurrency=4,
+        )
+        actor = cls.remote()
+        trial_dir = os.path.join(self._exp_dir, trial.trial_id)
+        os.makedirs(trial_dir, exist_ok=True)
+        ray_tpu.get(actor._setup_session.remote(
+            world_size=1, world_rank=0, run_name=trial.trial_id,
+            storage_path=trial_dir,
+        ))
+        if resume_checkpoint:
+            from ray_tpu.train._internal.checkpoint_util import (
+                set_session_resume_checkpoint,
+            )
+
+            ray_tpu.get(actor._execute.remote(
+                set_session_resume_checkpoint, resume_checkpoint))
+        ray_tpu.get(actor._start_training.remote(self._trainable, trial.config))
+        self._actors[trial.trial_id] = actor
+        trial.status = RUNNING
+
+    def _stop_trial(self, trial: Trial, status: str = TERMINATED):
+        import ray_tpu
+
+        actor = self._actors.pop(trial.trial_id, None)
+        if actor is not None:
+            try:
+                ray_tpu.kill(actor)
+            except Exception:  # noqa: BLE001
+                pass
+        trial.status = status
+
+    def _persist_checkpoint(self, trial: Trial, ckpt) -> Optional[str]:
+        if ckpt is None:
+            return None
+        from ray_tpu.train._internal.checkpoint_util import persist_staged_checkpoint
+
+        dest = os.path.join(self._exp_dir, trial.trial_id,
+                            f"checkpoint_{trial.training_iteration:06d}")
+        persist_staged_checkpoint(ckpt.path, dest)
+        trial.checkpoint_path = dest
+        return dest
+
+    # -- the event loop -----------------------------------------------------
+    def run(self) -> ResultGrid:
+        import ray_tpu
+
+        max_concurrent = self._tc.max_concurrent_trials or 8
+        try:
+            while True:
+                # start pending trials up to the concurrency cap
+                pending = [t for t in self.trials if t.status == PENDING]
+                while pending and len(self._actors) < max_concurrent:
+                    trial = self._scheduler.choose_trial_to_run(pending)
+                    if trial is None:
+                        break
+                    pending.remove(trial)
+                    self._start_trial(trial)
+                # poll running trials
+                for trial in [t for t in self.trials if t.status == RUNNING]:
+                    actor = self._actors.get(trial.trial_id)
+                    if actor is None:
+                        continue
+                    try:
+                        results, finished, err = ray_tpu.get(
+                            actor._poll_results.remote(0.05), timeout=30)
+                    except Exception as e:  # noqa: BLE001
+                        trial.error = str(e)
+                        self._stop_trial(trial, ERROR)
+                        continue
+                    if err:
+                        trial.error = err
+                        self._stop_trial(trial, ERROR)
+                        self._scheduler.on_trial_complete(trial, trial.metrics)
+                        continue
+                    decision = TrialScheduler.CONTINUE
+                    for r in results:
+                        trial.training_iteration += 1
+                        metrics = dict(r["metrics"])
+                        metrics.setdefault("training_iteration", trial.training_iteration)
+                        trial.metrics = metrics
+                        trial.metrics_history.append(metrics)
+                        self._persist_checkpoint(trial, r.get("checkpoint"))
+                        decision = self._scheduler.on_trial_result(trial, metrics)
+                        if decision != TrialScheduler.CONTINUE:
+                            break
+                    if decision == TrialScheduler.STOP:
+                        self._stop_trial(trial, TERMINATED)
+                        self._scheduler.on_trial_complete(trial, trial.metrics)
+                    elif decision == TrialScheduler.PAUSE:
+                        # PBT exploit/explore: restart from donor checkpoint
+                        self._handle_pbt_exploit(trial)
+                    elif finished:
+                        self._stop_trial(trial, TERMINATED)
+                        self._scheduler.on_trial_complete(trial, trial.metrics)
+                if not any(t.status in (PENDING, RUNNING, PAUSED) for t in self.trials):
+                    break
+                time.sleep(0.02)
+        finally:
+            for trial in self.trials:
+                if trial.trial_id in self._actors:
+                    self._stop_trial(trial, trial.status)
+        return self._build_result_grid()
+
+    def _handle_pbt_exploit(self, trial: Trial):
+        donor: Optional[Trial] = trial.pbt_exploit_from
+        new_config = trial.pbt_new_config or trial.config
+        trial.pbt_exploit_from = None
+        trial.pbt_new_config = None
+        self._stop_trial(trial, PAUSED)
+        trial.config = new_config
+        ckpt = donor.checkpoint_path if donor is not None else trial.checkpoint_path
+        logger.info("PBT exploit: trial %s <- donor %s (ckpt=%s)",
+                    trial.trial_id, donor.trial_id if donor else None, ckpt)
+        self._start_trial(trial, resume_checkpoint=ckpt)
+
+    def _build_result_grid(self) -> ResultGrid:
+        results = []
+        for t in self.trials:
+            results.append(TrialResult(
+                trial_id=t.trial_id,
+                config=t.config,
+                metrics=t.metrics,
+                metrics_history=t.metrics_history,
+                error=t.error,
+                checkpoint_path=t.checkpoint_path,
+                path=os.path.join(self._exp_dir, t.trial_id),
+            ))
+        return ResultGrid(results, metric=self._tc.metric, mode=self._tc.mode)

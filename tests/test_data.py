@@ -1,0 +1,180 @@
+"""Data library tests (reference: python/ray/data/tests/)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+
+
+@pytest.fixture
+def ray4(ray_start_regular):
+    yield ray_start_regular
+
+
+def test_range_count_take(ray4):
+    ds = rd.range(100)
+    assert ds.count() == 100
+    rows = ds.take(5)
+    assert [r["id"] for r in rows] == [0, 1, 2, 3, 4]
+
+
+def test_from_items_schema(ray4):
+    ds = rd.from_items([{"a": 1, "b": "x"}, {"a": 2, "b": "y"}])
+    assert ds.count() == 2
+    assert set(ds.columns()) == {"a", "b"}
+
+
+def test_map_batches_numpy(ray4):
+    ds = rd.range(64).map_batches(lambda b: {"id": b["id"] * 2}, batch_size=16)
+    out = ds.take_all()
+    assert [r["id"] for r in out] == [i * 2 for i in range(64)]
+
+
+def test_map_filter_flatmap(ray4):
+    ds = rd.range(10).map(lambda r: {"v": r["id"] + 1})
+    ds = ds.filter(lambda r: r["v"] % 2 == 0)
+    ds = ds.flat_map(lambda r: [{"v": r["v"]}, {"v": -r["v"]}])
+    vals = [r["v"] for r in ds.take_all()]
+    assert vals == [2, -2, 4, -4, 6, -6, 8, -8, 10, -10]
+
+
+def test_fused_stages_single_pass(ray4):
+    # read -> map -> map fuse into one task layer; result should stream
+    ds = rd.range(32, parallelism=4).map(lambda r: {"id": r["id"] + 1}) \
+        .map(lambda r: {"id": r["id"] * 10})
+    assert ds.sum("id") == sum((i + 1) * 10 for i in range(32))
+
+
+def test_repartition_and_num_blocks(ray4):
+    ds = rd.range(100, parallelism=4).repartition(10)
+    assert ds.num_blocks() == 10
+    assert ds.count() == 100
+
+
+def test_random_shuffle_preserves_rows(ray4):
+    ds = rd.range(50).random_shuffle(seed=7)
+    vals = sorted(r["id"] for r in ds.take_all())
+    assert vals == list(range(50))
+
+
+def test_sort(ray4):
+    ds = rd.from_items([{"k": v} for v in [3, 1, 2]]).sort("k")
+    assert [r["k"] for r in ds.take_all()] == [1, 2, 3]
+    ds = rd.from_items([{"k": v} for v in [3, 1, 2]]).sort("k", descending=True)
+    assert [r["k"] for r in ds.take_all()] == [3, 2, 1]
+
+
+def test_limit_and_iter_batches(ray4):
+    ds = rd.range(100).limit(30)
+    assert ds.count() == 30
+    batches = list(ds.iter_batches(batch_size=8))
+    sizes = [len(b["id"]) for b in batches]
+    assert sum(sizes) == 30
+    assert all(s == 8 for s in sizes[:-1])
+
+
+def test_iter_batches_pandas_format(ray4):
+    ds = rd.range(16)
+    batches = list(ds.iter_batches(batch_size=8, batch_format="pandas"))
+    import pandas as pd
+
+    assert isinstance(batches[0], pd.DataFrame)
+
+
+def test_aggregates(ray4):
+    ds = rd.from_items([{"x": float(i)} for i in range(10)])
+    assert ds.sum("x") == 45.0
+    assert ds.min("x") == 0.0
+    assert ds.max("x") == 9.0
+    assert ds.mean("x") == 4.5
+
+
+def test_groupby(ray4):
+    ds = rd.from_items([{"g": i % 2, "x": i} for i in range(10)])
+    out = {r["g"]: r["x_sum"] for r in ds.groupby("g").sum("x").take_all()}
+    assert out == {0: 20, 1: 25}
+
+
+def test_add_drop_select_columns(ray4):
+    ds = rd.from_items([{"a": 1, "b": 2}]).add_column("c", lambda df: df["a"] + df["b"])
+    row = ds.take(1)[0]
+    assert row["c"] == 3
+    assert ds.drop_columns(["b"]).columns() == ["a", "c"]
+    assert ds.select_columns(["a"]).columns() == ["a"]
+
+
+def test_actor_pool_map_batches(ray4):
+    class AddConst:
+        def __init__(self, c=100):
+            self.c = c
+
+        def __call__(self, batch):
+            return {"id": batch["id"] + self.c}
+
+    ds = rd.range(32, parallelism=4).map_batches(
+        AddConst, compute=rd.ActorPoolStrategy(size=2), fn_constructor_args=(100,),
+        num_cpus=0.5,
+    )
+    vals = sorted(r["id"] for r in ds.take_all())
+    assert vals == [i + 100 for i in range(32)]
+
+
+def test_split_for_train(ray4):
+    ds = rd.range(30)
+    parts = ds.split(3)
+    counts = [p.count() for p in parts]
+    assert sum(counts) == 30
+    assert all(c == 10 for c in counts)
+
+
+def test_write_read_parquet_roundtrip(ray4, tmp_path):
+    ds = rd.range(20)
+    out_dir = str(tmp_path / "pq")
+    files = ds.write_parquet(out_dir)
+    assert files
+    back = rd.read_parquet(out_dir)
+    assert back.count() == 20
+    assert sorted(r["id"] for r in back.take_all()) == list(range(20))
+
+
+def test_write_read_csv_json(ray4, tmp_path):
+    ds = rd.from_items([{"a": 1, "b": "x"}, {"a": 2, "b": "y"}])
+    csv_dir = str(tmp_path / "csv")
+    ds.write_csv(csv_dir)
+    assert rd.read_csv(csv_dir).count() == 2
+    json_dir = str(tmp_path / "json")
+    ds.write_json(json_dir)
+    assert rd.read_json(json_dir).count() == 2
+
+
+def test_read_text_binary(ray4, tmp_path):
+    p = tmp_path / "f.txt"
+    p.write_text("hello\nworld\n")
+    ds = rd.read_text(str(p))
+    assert [r["text"] for r in ds.take_all()] == ["hello", "world"]
+    ds2 = rd.read_binary_files(str(p))
+    assert ds2.take_all()[0]["bytes"] == b"hello\nworld\n"
+
+
+def test_from_numpy_pandas_arrow(ray4):
+    import pandas as pd
+    import pyarrow as pa
+
+    assert rd.from_numpy(np.arange(5)).count() == 5
+    assert rd.from_pandas(pd.DataFrame({"a": [1, 2]})).count() == 2
+    assert rd.from_arrow(pa.table({"a": [1, 2, 3]})).count() == 3
+
+
+def test_union(ray4):
+    a = rd.range(5)
+    b = rd.range(5).map(lambda r: {"id": r["id"] + 5})
+    assert sorted(r["id"] for r in a.union(b).take_all()) == list(range(10))
+
+
+def test_materialize(ray4):
+    ds = rd.range(10).map(lambda r: {"id": r["id"] * 2}).materialize()
+    assert ds.count() == 10
+    assert ds.count() == 10  # second pass reuses materialized blocks

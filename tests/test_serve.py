@@ -1,0 +1,155 @@
+"""Serve library tests (reference: python/ray/serve/tests/)."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def serve_cluster(ray_start_regular):
+    yield ray_start_regular
+    serve.shutdown()
+
+
+def test_deploy_and_call_function(serve_cluster):
+    @serve.deployment
+    def square(x):
+        return x * x
+
+    handle = serve.run(square.bind(), name="app1")
+    assert handle.remote(7).result(timeout_s=30) == 49
+
+
+def test_deploy_class_with_state(serve_cluster):
+    @serve.deployment(num_replicas=1)
+    class Counter:
+        def __init__(self, start):
+            self.n = start
+
+        def __call__(self, inc):
+            self.n += inc
+            return self.n
+
+    handle = serve.run(Counter.bind(10), name="app2")
+    assert handle.remote(1).result(timeout_s=30) == 11
+    assert handle.remote(2).result(timeout_s=30) == 13
+
+
+def test_multiple_replicas_route(serve_cluster):
+    @serve.deployment(num_replicas=2, ray_actor_options={"num_cpus": 0.1})
+    class Who:
+        def __call__(self, _):
+            import os
+
+            return os.getpid()
+
+    handle = serve.run(Who.bind(), name="app3")
+    pids = {handle.remote(None).result(timeout_s=30) for _ in range(12)}
+    assert len(pids) >= 1  # at least one replica answered; often both
+    # both replicas exist
+    stats = serve.status()["app3"]["replicas"]
+    assert len(stats) == 2
+
+
+def test_method_call_via_options(serve_cluster):
+    @serve.deployment
+    class Calc:
+        def add(self, ab):
+            return ab[0] + ab[1]
+
+        def mul(self, ab):
+            return ab[0] * ab[1]
+
+    handle = serve.run(Calc.bind(), name="app4")
+    assert handle.add.remote((2, 3)).result(timeout_s=30) == 5
+    assert handle.mul.remote((2, 3)).result(timeout_s=30) == 6
+
+
+def test_model_composition_nested_handles(serve_cluster):
+    @serve.deployment
+    class Preprocess:
+        def __call__(self, x):
+            return x + 1
+
+    @serve.deployment
+    class Model:
+        def __init__(self, pre):
+            self.pre = pre
+
+        def __call__(self, x):
+            y = self.pre.remote(x).result(timeout_s=30)
+            return y * 10
+
+    handle = serve.run(Model.bind(Preprocess.bind()), name="app5")
+    assert handle.remote(4).result(timeout_s=30) == 50
+
+
+def test_redeploy_updates(serve_cluster):
+    @serve.deployment
+    def v1(x):
+        return "v1"
+
+    @serve.deployment
+    def v2(x):
+        return "v2"
+
+    h1 = serve.run(v1.bind(), name="app6")
+    assert h1.remote(None).result(timeout_s=30) == "v1"
+    h2 = serve.run(v2.options(name="v1").bind(), name="app6")
+    time.sleep(0.5)
+    assert h2.remote(None).result(timeout_s=30) == "v2"
+
+
+def test_delete_application(serve_cluster):
+    @serve.deployment
+    def f(x):
+        return x
+
+    serve.run(f.bind(), name="app7")
+    assert "app7" in serve.status()
+    serve.delete("app7")
+    deadline = time.monotonic() + 10
+    while "app7" in serve.status() and time.monotonic() < deadline:
+        time.sleep(0.1)
+    assert "app7" not in serve.status()
+
+
+def test_http_proxy_end_to_end(serve_cluster):
+    @serve.deployment
+    def echo(payload):
+        return {"got": payload}
+
+    handle = serve.run(echo.bind(), name="app8")
+    host, port = serve.start_http_proxy(port=0)
+    serve.add_route("/echo", handle)
+    req = urllib.request.Request(
+        f"http://{host}:{port}/echo", data=json.dumps({"a": 1}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        body = json.loads(resp.read())
+    assert body == {"got": {"a": 1}}
+
+
+def test_batching(serve_cluster):
+    @serve.deployment
+    class Batched:
+        def __init__(self):
+            self.batch_sizes = []
+
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.05)
+        def __call__(self, xs):
+            self.batch_sizes.append(len(xs))
+            return [x * 2 for x in xs]
+
+        def sizes(self, _):
+            return self.batch_sizes
+
+    handle = serve.run(Batched.bind(), name="app9")
+    responses = [handle.remote(i) for i in range(8)]
+    results = sorted(r.result(timeout_s=30) for r in responses)
+    assert results == [i * 2 for i in range(8)]

@@ -1,0 +1,203 @@
+"""Train library tests (reference: python/ray/train/tests/)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import train
+from ray_tpu.train import (
+    CheckpointConfig,
+    DataParallelTrainer,
+    FailureConfig,
+    JaxTrainer,
+    RunConfig,
+    ScalingConfig,
+)
+
+
+@pytest.fixture
+def ray4(ray_start_regular):
+    yield ray_start_regular
+
+
+def test_basic_fit_reports_metrics(ray4, tmp_path):
+    def train_fn(config):
+        ctx = train.get_context()
+        for step in range(3):
+            train.report({"step": step, "rank": ctx.get_world_rank(),
+                          "world": ctx.get_world_size()})
+
+    trainer = DataParallelTrainer(
+        train_fn,
+        scaling_config=ScalingConfig(num_workers=2, resources_per_worker={"CPU": 0.5}),
+        run_config=RunConfig(name="t0", storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["step"] == 2
+    assert result.metrics["world"] == 2
+    assert len(result.metrics_history) == 3
+
+
+def test_train_loop_config_and_ranks(ray4, tmp_path):
+    def train_fn(config):
+        ctx = train.get_context()
+        train.report({
+            "lr": config["lr"],
+            "rank": ctx.get_world_rank(),
+            "local_rank": ctx.get_local_rank(),
+            "node_rank": ctx.get_node_rank(),
+        })
+
+    trainer = DataParallelTrainer(
+        train_fn,
+        train_loop_config={"lr": 0.1},
+        scaling_config=ScalingConfig(num_workers=2, resources_per_worker={"CPU": 0.5}),
+        run_config=RunConfig(name="t1", storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["lr"] == 0.1
+    # both workers are on the single test node → distinct local ranks
+    assert result.metrics["node_rank"] == 0
+
+
+def test_checkpoint_persist_and_keep_top_k(ray4, tmp_path):
+    def train_fn(config):
+        import tempfile
+
+        for step in range(4):
+            with tempfile.TemporaryDirectory() as d:
+                with open(os.path.join(d, "model.txt"), "w") as f:
+                    f.write(f"step={step}")
+                train.report({"score": float(step)},
+                             checkpoint=train.Checkpoint.from_directory(d))
+
+    trainer = DataParallelTrainer(
+        train_fn,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(
+            name="t2", storage_path=str(tmp_path),
+            checkpoint_config=CheckpointConfig(
+                num_to_keep=2, checkpoint_score_attribute="score",
+            ),
+        ),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    run_dir = os.path.join(str(tmp_path), "t2")
+    kept = sorted(d for d in os.listdir(run_dir) if d.startswith("checkpoint_"))
+    assert len(kept) == 2
+    assert result.checkpoint is not None
+    with open(os.path.join(result.checkpoint.path, "model.txt")) as f:
+        assert f.read() == "step=3"
+
+
+def test_failure_restart_resumes_from_checkpoint(ray4, tmp_path):
+    def train_fn(config):
+        import tempfile
+
+        ckpt = train.get_checkpoint()
+        start = 0
+        if ckpt is not None:
+            with open(os.path.join(ckpt.path, "state.txt")) as f:
+                start = int(f.read()) + 1
+        for step in range(start, 3):
+            with tempfile.TemporaryDirectory() as d:
+                with open(os.path.join(d, "state.txt"), "w") as f:
+                    f.write(str(step))
+                train.report({"step": step},
+                             checkpoint=train.Checkpoint.from_directory(d))
+            if step == 1 and ckpt is None:
+                raise RuntimeError("injected failure after step 1")
+
+    trainer = DataParallelTrainer(
+        train_fn,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(
+            name="t3", storage_path=str(tmp_path),
+            failure_config=FailureConfig(max_failures=1),
+        ),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["step"] == 2  # resumed at 2 after failing at 1
+
+
+def test_failure_exhausted_returns_error(ray4, tmp_path):
+    def train_fn(config):
+        raise ValueError("always fails")
+
+    trainer = DataParallelTrainer(
+        train_fn,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="t4", storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.error is not None
+    assert "always fails" in str(result.error)
+
+
+def test_jax_trainer_single_worker_trains(ray4, tmp_path):
+    """End-to-end: JaxTrainer running a real jitted train step per worker."""
+
+    def train_fn(config):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.models.llama import LlamaConfig
+        from ray_tpu.parallel import make_train_step
+
+        cfg = LlamaConfig.tiny()
+        init_fn, step_fn = make_train_step(cfg, optimizer=optax.adamw(1e-3))
+        state = init_fn(jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, cfg.vocab_size)
+        for _ in range(2):
+            state, metrics = step_fn(state, tokens)
+        train.report({"loss": float(metrics["loss"])})
+
+    trainer = JaxTrainer(
+        train_fn,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="t5", storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert 0 < result.metrics["loss"] < 20
+
+
+def test_train_collective_broadcast_barrier(ray4, tmp_path):
+    def train_fn(config):
+        from ray_tpu.train import collective as train_col
+
+        ctx = train.get_context()
+        value = {"payload": 42} if ctx.get_world_rank() == 0 else None
+        got = train_col.broadcast_from_rank_zero(value)
+        train_col.barrier()
+        train.report({"got": got["payload"]})
+
+    trainer = DataParallelTrainer(
+        train_fn,
+        scaling_config=ScalingConfig(num_workers=2, resources_per_worker={"CPU": 0.5}),
+        run_config=RunConfig(name="t6", storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["got"] == 42
+
+
+def test_sharded_checkpoint_roundtrip(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.train import restore_sharded, save_sharded
+
+    state = {"w": jnp.arange(16.0).reshape(4, 4), "b": jnp.ones((4,))}
+    path = os.path.join(str(tmp_path), "sharded")
+    save_sharded(state, path)
+    restored = restore_sharded(path)
+    np.testing.assert_allclose(np.asarray(restored["w"]), np.asarray(state["w"]))
+    np.testing.assert_allclose(np.asarray(restored["b"]), np.asarray(state["b"]))

@@ -1,0 +1,174 @@
+"""Tune library tests (reference: python/ray/tune/tests/)."""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.train import RunConfig
+from ray_tpu.tune import TuneConfig, Tuner
+
+
+@pytest.fixture
+def ray4(ray_start_regular):
+    yield ray_start_regular
+
+
+def test_grid_search_runs_all_variants(ray4, tmp_path):
+    def trainable(config):
+        tune.report({"score": config["a"] * 10 + config["b"]})
+
+    tuner = Tuner(
+        trainable,
+        param_space={"a": tune.grid_search([1, 2]), "b": tune.grid_search([3, 4])},
+        tune_config=TuneConfig(metric="score", mode="max",
+                               trial_resources={"CPU": 0.5}),
+        run_config=RunConfig(name="g", storage_path=str(tmp_path)),
+    )
+    results = tuner.fit()
+    assert len(results) == 4
+    assert not results.errors
+    best = results.get_best_result()
+    assert best.metrics["score"] == 24
+    assert best.config == {"a": 2, "b": 4}
+
+
+def test_random_sampling_num_samples(ray4, tmp_path):
+    def trainable(config):
+        tune.report({"v": config["x"]})
+
+    tuner = Tuner(
+        trainable,
+        param_space={"x": tune.uniform(0.0, 1.0)},
+        tune_config=TuneConfig(metric="v", mode="min", num_samples=5, seed=42,
+                               trial_resources={"CPU": 0.5}),
+        run_config=RunConfig(name="r", storage_path=str(tmp_path)),
+    )
+    results = tuner.fit()
+    assert len(results) == 5
+    xs = [r.config["x"] for r in results]
+    assert all(0.0 <= x <= 1.0 for x in xs)
+    assert len(set(xs)) == 5  # all distinct draws
+
+
+def test_trial_error_reported_not_fatal(ray4, tmp_path):
+    def trainable(config):
+        if config["i"] == 1:
+            raise RuntimeError("boom")
+        tune.report({"ok": 1})
+
+    tuner = Tuner(
+        trainable,
+        param_space={"i": tune.grid_search([0, 1, 2])},
+        tune_config=TuneConfig(metric="ok", mode="max",
+                               trial_resources={"CPU": 0.5}),
+        run_config=RunConfig(name="e", storage_path=str(tmp_path)),
+    )
+    results = tuner.fit()
+    assert len(results) == 3
+    assert len(results.errors) == 1
+    assert "boom" in results.errors[0]
+
+
+def test_asha_stops_bad_trials(ray4, tmp_path):
+    def trainable(config):
+        for step in range(20):
+            # trial quality determined by config: higher base → better score
+            tune.report({"score": config["base"] + step * 0.01,
+                         "training_iteration": step + 1})
+
+    scheduler = tune.ASHAScheduler(metric="score", mode="max", max_t=20,
+                                   grace_period=2, reduction_factor=2)
+    tuner = Tuner(
+        trainable,
+        param_space={"base": tune.grid_search([0.0, 1.0, 2.0, 3.0])},
+        tune_config=TuneConfig(metric="score", mode="max", scheduler=scheduler,
+                               max_concurrent_trials=2,
+                               trial_resources={"CPU": 0.5}),
+        run_config=RunConfig(name="asha", storage_path=str(tmp_path)),
+    )
+    results = tuner.fit()
+    assert len(results) == 4
+    best = results.get_best_result()
+    assert best.config["base"] == 3.0
+
+
+def test_checkpoint_saved_per_trial(ray4, tmp_path):
+    def trainable(config):
+        import tempfile
+
+        from ray_tpu.train import Checkpoint
+
+        with tempfile.TemporaryDirectory() as d:
+            with open(os.path.join(d, "w.txt"), "w") as f:
+                f.write(str(config["x"]))
+            tune.report({"x": config["x"]}, checkpoint=Checkpoint.from_directory(d))
+
+    tuner = Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([5, 7])},
+        tune_config=TuneConfig(metric="x", mode="max",
+                               trial_resources={"CPU": 0.5}),
+        run_config=RunConfig(name="c", storage_path=str(tmp_path)),
+    )
+    results = tuner.fit()
+    best = results.get_best_result()
+    assert best.checkpoint_path is not None
+    with open(os.path.join(best.checkpoint_path, "w.txt")) as f:
+        assert f.read() == "7"
+
+
+def test_pbt_exploits_and_mutates(ray4, tmp_path):
+    def trainable(config):
+        import tempfile
+
+        from ray_tpu.train import Checkpoint
+
+        # resume from exploited checkpoint if present
+        start = 0
+        ckpt = tune.get_checkpoint()
+        if ckpt is not None:
+            with open(os.path.join(ckpt.path, "step.txt")) as f:
+                start = int(f.read()) + 1
+        for step in range(start, 12):
+            score = config["lr"] * (step + 1)
+            with tempfile.TemporaryDirectory() as d:
+                with open(os.path.join(d, "step.txt"), "w") as f:
+                    f.write(str(step))
+                tune.report({"score": score, "training_iteration": step + 1},
+                            checkpoint=Checkpoint.from_directory(d))
+
+    scheduler = tune.PopulationBasedTraining(
+        metric="score", mode="max", perturbation_interval=4,
+        hyperparam_mutations={"lr": tune.uniform(0.1, 2.0)}, seed=3,
+    )
+    tuner = Tuner(
+        trainable,
+        param_space={"lr": tune.grid_search([0.1, 1.0])},
+        tune_config=TuneConfig(metric="score", mode="max", scheduler=scheduler,
+                               trial_resources={"CPU": 0.5}),
+        run_config=RunConfig(name="pbt", storage_path=str(tmp_path)),
+    )
+    results = tuner.fit()
+    assert len(results) == 2
+    assert not results.errors
+    best = results.get_best_result()
+    assert best.metrics["score"] > 0
+
+
+def test_variant_generator_counts():
+    from ray_tpu.tune.search.basic_variant import BasicVariantGenerator
+
+    gen = BasicVariantGenerator(
+        {"a": tune.grid_search([1, 2, 3]), "b": tune.uniform(0, 1)}, num_samples=2)
+    variants = list(gen.variants())
+    assert len(variants) == 6
+    assert gen.count() == 6
+    # nested spaces
+    gen2 = BasicVariantGenerator(
+        {"opt": {"lr": tune.grid_search([0.1, 0.2])}, "fixed": 5})
+    vs = list(gen2.variants())
+    assert len(vs) == 2
+    assert all(v["fixed"] == 5 for v in vs)
+    assert {v["opt"]["lr"] for v in vs} == {0.1, 0.2}
