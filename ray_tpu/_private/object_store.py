@@ -128,7 +128,9 @@ class LocalObjectStore:
         logger.debug("native plasma arena %s (%d bytes)", name, self._capacity)
 
     def _arena_buf(self, offset: int, size: int) -> memoryview:
-        return memoryview(self._arena_view)[offset:offset + size]
+        # ctypes char arrays expose format '<c', which rejects bytes slice
+        # assignment — cast to unsigned bytes first.
+        return memoryview(self._arena_view).cast("B")[offset:offset + size]
 
     def buffer_for(self, e: _Entry) -> memoryview:
         """Writable view of an in-memory entry (raylet-process IO)."""
