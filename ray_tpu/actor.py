@@ -37,6 +37,13 @@ class ActorMethod:
             max_task_retries=self._handle._max_task_retries,
         )
 
+    def bind(self, *args, **kwargs):
+        """Bind into a lazy DAG (reference: python/ray/dag — actor-method
+        .bind builds a ClassMethodNode instead of submitting)."""
+        from ray_tpu.dag.dag_node import ClassMethodNode
+
+        return ClassMethodNode(self._handle, self._method_name, args, kwargs)
+
 
 class ActorHandle:
     def __init__(self, actor_id: ActorID, max_task_retries: int = 0):

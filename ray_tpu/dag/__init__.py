@@ -1,0 +1,24 @@
+"""Lazy task DAGs + compiled graphs (reference: python/ray/dag/)."""
+
+from ray_tpu.dag.collective_node import allreduce
+from ray_tpu.dag.compiled_dag_node import CompiledDAG, CompiledDAGRef
+from ray_tpu.dag.dag_node import (
+    ClassMethodNode,
+    DAGNode,
+    FunctionNode,
+    InputAttributeNode,
+    InputNode,
+    MultiOutputNode,
+)
+
+__all__ = [
+    "allreduce",
+    "ClassMethodNode",
+    "CompiledDAG",
+    "CompiledDAGRef",
+    "DAGNode",
+    "FunctionNode",
+    "InputAttributeNode",
+    "InputNode",
+    "MultiOutputNode",
+]

@@ -1,0 +1,80 @@
+"""Collective operations as DAG nodes.
+
+TPU-native rebuild of the reference's collective nodes
+(reference: python/ray/dag/collective_node.py — allreduce across the bound
+actors' tensors, lowered to NCCL there; here the group backend is ``store``
+off-TPU and ``xla`` on TPU, where the op compiles to ICI collectives).
+
+Usage::
+
+    with InputNode() as inp:
+        grads = [w.grad.bind(inp) for w in workers]
+        reduced = allreduce.bind(grads)          # one node per worker
+        outs = [w.apply.bind(g) for w, g in zip(workers, reduced)]
+        dag = MultiOutputNode(outs)
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import List
+
+from ray_tpu.dag.dag_node import ClassMethodNode, DAGNode
+from ray_tpu.util.collective.types import ReduceOp
+
+_group_counter = itertools.count()
+
+
+_groups_created = set()
+
+
+def _interp_allreduce(instance, group_name, op, tensor):
+    """Hidden actor task used by interpreted-mode collective nodes."""
+    from ray_tpu.util import collective as col
+
+    return col.allreduce(tensor, group_name=group_name, op=op)
+
+
+class CollectiveOutputNode(ClassMethodNode):
+    """The post-allreduce value on ONE participating actor."""
+
+    def __init__(self, upstream: ClassMethodNode, group_name: str,
+                 op: ReduceOp, group_spec):
+        super().__init__(upstream._actor_handle, "__collective_allreduce__",
+                         (upstream,), {})
+        self._collective = (group_name, op)
+        self._collective_group_spec = group_spec
+
+    def _execute_impl(self, cache, input_value):
+        # Interpreted mode: lazily rendezvous the group, then run the op as a
+        # hidden task on each participating actor; the submissions are async,
+        # so all ranks enter the collective concurrently.
+        from ray_tpu.actor import ActorMethod
+        from ray_tpu.util import collective as col_lib
+
+        group_name, op = self._collective
+        if group_name not in _groups_created:
+            handles, backend = self._collective_group_spec
+            col_lib.create_collective_group(
+                handles, len(handles), list(range(len(handles))),
+                backend=backend, group_name=group_name)
+            _groups_created.add(group_name)
+        upstream_ref = cache[self._bound_args[0]._stable_uuid]
+        return ActorMethod(self._actor_handle, "__ray_tpu_call__").remote(
+            _interp_allreduce, group_name, op, upstream_ref)
+
+
+class _AllReduce:
+    def bind(self, nodes: List[DAGNode], op: ReduceOp = ReduceOp.SUM,
+             backend: str = "store") -> List[CollectiveOutputNode]:
+        if not nodes or not all(isinstance(n, ClassMethodNode) for n in nodes):
+            raise TypeError("allreduce.bind takes a list of actor-method nodes")
+        handles = [n._actor_handle for n in nodes]
+        if len({h._actor_id for h in handles}) != len(handles):
+            raise ValueError("allreduce participants must be distinct actors")
+        group_name = f"__dag_allreduce_{next(_group_counter)}"
+        spec = (handles, backend)
+        return [CollectiveOutputNode(n, group_name, op, spec) for n in nodes]
+
+
+allreduce = _AllReduce()

@@ -1,0 +1,410 @@
+"""Compiled DAG execution over pre-allocated shared-memory channels.
+
+TPU-native rebuild of the reference's Compiled Graphs (aDAG)
+(reference: python/ray/dag/compiled_dag_node.py:809 CompiledDAG,
+execution schedule dag_node_operation.py, channel wiring via
+experimental/channel/shared_memory_channel.py mutable plasma objects).
+
+Compilation:
+  1. topo-sort the graph; every compute node must be an actor method
+  2. allocate one single-slot ShmChannel per cross-process edge:
+     driver -> each consuming actor (the DAG input), producer-node ->
+     each distinct consumer actor, and each leaf -> driver
+  3. park one long-running exec-loop task on every participating actor
+     (injected via the worker's hidden ``__ray_tpu_call__`` protocol —
+     the reference's equivalent is a system-generated actor task)
+
+Steady state: ``execute()`` writes the input into each input channel and
+returns a ``CompiledDAGRef``; actors loop read-compute-write; ``get()``
+reads the leaf channels.  No scheduler, no RPC, no per-call allocation —
+the same property the reference gets from mutable plasma objects.
+
+Error semantics mirror the reference: an exception inside one node is
+wrapped, forwarded through downstream channels instead of that node's
+value, and re-raised at ``CompiledDAGRef.get()``; the DAG stays usable.
+
+Collective nodes (allreduce across the gang's actors) execute through
+``ray_tpu.util.collective`` inside the loop — on TPU actors the group
+backend is ``xla``, so the op lowers to ICI collectives.
+"""
+
+from __future__ import annotations
+
+import atexit
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.dag.dag_node import (
+    ClassMethodNode,
+    DAGNode,
+    InputAttributeNode,
+    InputNode,
+    MultiOutputNode,
+    _make_input_value,
+    extract_input,
+)
+from ray_tpu.experimental.channel import ChannelClosed, ChannelFull, ShmChannel
+
+logger = logging.getLogger(__name__)
+
+_DEFAULT_BUFFER = 16 * 1024 * 1024
+
+
+class _NodeError:
+    """An upstream node raised; travels the channels in place of a value."""
+
+    __slots__ = ("exc", "node_repr")
+
+    def __init__(self, exc: Exception, node_repr: str):
+        self.exc = exc
+        self.node_repr = node_repr
+
+
+class _CollectiveOp:
+    """Marker placed in a ClassMethodNode slot by collective_node.py."""
+
+
+def _actor_key(handle) -> str:
+    return handle._actor_id.hex()
+
+
+def _compiled_dag_actor_loop(instance, schedule, recv_list):
+    """Runs on the actor via ``__ray_tpu_call__``: loop until channels close.
+
+    schedule: ordered ops:
+      {"uuid", "method", "args": [spec], "kwargs": {k: spec},
+       "sends": [ShmChannel], "collective": None | (group_name, op)}
+      spec := ("const", v) | ("node", uuid) | ("input", extractor)
+    recv_list: ordered [(key, ShmChannel)] to read once per iteration;
+      key := "__input__" | producer node uuid
+    """
+    import numpy as np
+
+    for _, chan in recv_list:
+        chan.register_reader(0)
+    values: Dict[Any, Any] = {}
+    while True:
+        try:
+            for key, chan in recv_list:
+                values[key] = chan.read()
+        except ChannelClosed:
+            return "closed"
+
+        for op in schedule:
+            def resolve(spec):
+                kind, payload = spec
+                if kind == "const":
+                    return payload
+                if kind == "node":
+                    return values[payload]
+                inp = values["__input__"]
+                if isinstance(inp, _NodeError):
+                    return inp
+                return extract_input(inp, payload)
+
+            try:
+                args = [resolve(s) for s in op["args"]]
+                kwargs = {k: resolve(s) for k, s in op["kwargs"].items()}
+                err = next((a for a in list(args) + list(kwargs.values())
+                            if isinstance(a, _NodeError)), None)
+                if op["collective"] is not None:
+                    from ray_tpu.util import collective as col
+                    from ray_tpu.util.collective.types import ReduceOp
+
+                    group_name, col_op = op["collective"]
+                    # Pre-vote so an errored rank can't skip the collective
+                    # while healthy ranks block in it forever: every rank
+                    # always reaches this tiny MAX-allreduce, then all ranks
+                    # agree to run or skip the real one in lockstep.
+                    flag = col.allreduce(np.array([1.0 if err else 0.0]),
+                                         group_name=group_name,
+                                         op=ReduceOp.MAX)
+                    if float(flag[0]) != 0.0:
+                        result = err or _NodeError(
+                            RuntimeError("collective peer failed upstream"),
+                            op["method"])
+                    else:
+                        result = col.allreduce(args[0], group_name=group_name,
+                                               op=col_op)
+                elif err is not None:
+                    result = err
+                else:
+                    result = getattr(instance, op["method"])(*args, **kwargs)
+            except Exception as e:  # noqa: BLE001
+                logger.exception("compiled-dag node %s failed", op["method"])
+                result = _NodeError(e, op["method"])
+            values[op["uuid"]] = result
+            try:
+                for chan in op["sends"]:
+                    try:
+                        chan.write(result)
+                    except ChannelFull as e:
+                        chan.write(_NodeError(e, op["method"]))
+            except ChannelClosed:
+                return "closed"
+
+
+class CompiledDAGRef:
+    """Result handle for one ``execute()`` call (reference:
+    compiled_dag_ref.py). ``get()`` may be called once per ref."""
+
+    def __init__(self, dag: "CompiledDAG", idx: int):
+        self._dag = dag
+        self._idx = idx
+        self._consumed = False
+
+    def get(self, timeout: Optional[float] = None):
+        if self._consumed:
+            raise ValueError("CompiledDAGRef.get() may only be called once")
+        self._consumed = True
+        return self._dag._get_result(self._idx, timeout)
+
+    def __repr__(self):
+        return f"CompiledDAGRef(idx={self._idx})"
+
+
+class CompiledDAG:
+    def __init__(self, root: DAGNode, buffer_size_bytes: Optional[int] = None,
+                 max_inflight_executions: int = 100):
+        self._buffer = buffer_size_bytes or _DEFAULT_BUFFER
+        self._max_inflight = max_inflight_executions
+        self._lock = threading.Lock()
+        self._write_lock = threading.Lock()
+        self._result_cv = threading.Condition(self._lock)
+        self._exec_idx = 0
+        self._next_result_idx = 0
+        self._num_got = 0
+        self._result_cache: Dict[int, Any] = {}
+        self._torn_down = False
+        self._build(root)
+        # Drain leaf channels continuously so deep pipelined submission can't
+        # deadlock (driver blocked writing inputs while actors block writing
+        # undrained outputs); max_inflight bounds the cache instead.
+        self._drain_thread = threading.Thread(
+            target=self._drain_loop, daemon=True, name="compiled-dag-drain")
+        self._drain_thread.start()
+        atexit.register(self.teardown)
+
+    # -- compilation --------------------------------------------------------
+
+    def _build(self, root: DAGNode):
+        nodes = root._all_nodes()
+        self._leaves: List[DAGNode] = (
+            list(root._bound_args) if isinstance(root, MultiOutputNode) else [root]
+        )
+        self._multi_output = isinstance(root, MultiOutputNode)
+
+        compute_nodes = [n for n in nodes if isinstance(n, ClassMethodNode)]
+        if not compute_nodes:
+            raise ValueError("compiled DAGs need at least one actor-method node")
+        for n in nodes:
+            if not isinstance(n, (ClassMethodNode, InputNode, InputAttributeNode,
+                                  MultiOutputNode)):
+                raise TypeError(
+                    f"compiled DAGs support actor-method nodes only, got {n!r} "
+                    "(use .execute() for interpreted graphs with tasks)")
+        for leaf in self._leaves:
+            if not isinstance(leaf, ClassMethodNode):
+                raise TypeError("DAG outputs must be actor-method nodes")
+
+        # group compute nodes per actor, in topo order
+        self._actors: Dict[str, Any] = {}
+        per_actor_nodes: Dict[str, List[ClassMethodNode]] = {}
+        for n in compute_nodes:
+            k = _actor_key(n._actor_handle)
+            self._actors.setdefault(k, n._actor_handle)
+            per_actor_nodes.setdefault(k, []).append(n)
+
+        self._channels: List[ShmChannel] = []
+
+        def new_chan() -> ShmChannel:
+            ch = ShmChannel(num_readers=1, capacity=self._buffer)
+            self._channels.append(ch)
+            return ch
+
+        # edges: producer node -> consumer actors (dedup); input -> actors
+        edge_chan: Dict[Tuple[int, str], ShmChannel] = {}
+        input_actors: List[str] = []
+        for n in compute_nodes:
+            k = _actor_key(n._actor_handle)
+            if not n._upstream() and k not in input_actors:
+                # Nullary node: tie the actor to the input channel anyway so
+                # its loop runs once per execute() instead of free-running.
+                input_actors.append(k)
+            for up in n._upstream():
+                if isinstance(up, (InputNode, InputAttributeNode)):
+                    if k not in input_actors:
+                        input_actors.append(k)
+                elif isinstance(up, ClassMethodNode):
+                    up_k = _actor_key(up._actor_handle)
+                    if up_k != k and (up._stable_uuid, k) not in edge_chan:
+                        edge_chan[(up._stable_uuid, k)] = new_chan()
+
+        self._input_channels = {k: new_chan() for k in input_actors}
+
+        # leaf -> driver channels (a leaf consumed by the driver gets its own)
+        self._output_channels: List[Tuple[int, ShmChannel]] = []
+        for leaf in self._leaves:
+            ch = new_chan()
+            self._output_channels.append((leaf._stable_uuid, ch))
+
+        # per-actor schedule + recv lists
+        topo_index = {n._stable_uuid: i for i, n in enumerate(nodes)}
+        self._loop_refs = []
+        launch_plan: List[Tuple[str, list, list]] = []
+        for k, actor_nodes in per_actor_nodes.items():
+            actor_nodes.sort(key=lambda n: topo_index[n._stable_uuid])
+            local = {n._stable_uuid for n in actor_nodes}
+            recv: List[Tuple[Any, ShmChannel]] = []
+            if k in self._input_channels:
+                recv.append(("__input__", self._input_channels[k]))
+            recv_keys = set()
+            schedule = []
+            for n in actor_nodes:
+                def spec_of(v):
+                    if isinstance(v, (InputNode, InputAttributeNode)):
+                        ext = ("whole",) if isinstance(v, InputNode) else v._extractor
+                        return ("input", ext)
+                    if isinstance(v, ClassMethodNode):
+                        up_k = _actor_key(v._actor_handle)
+                        if up_k != k and v._stable_uuid not in recv_keys:
+                            recv_keys.add(v._stable_uuid)
+                            recv.append((v._stable_uuid,
+                                         edge_chan[(v._stable_uuid, k)]))
+                        return ("node", v._stable_uuid)
+                    if isinstance(v, DAGNode):
+                        raise TypeError(f"unsupported upstream {v!r}")
+                    return ("const", v)
+
+                sends = [ch for (uuid_key, consumer), ch in edge_chan.items()
+                         if uuid_key == n._stable_uuid]
+                sends += [ch for uuid_key, ch in self._output_channels
+                          if uuid_key == n._stable_uuid]
+                schedule.append({
+                    "uuid": n._stable_uuid,
+                    "method": n._method_name,
+                    "args": [spec_of(a) for a in n._bound_args],
+                    "kwargs": {kk: spec_of(v) for kk, v in n._bound_kwargs.items()},
+                    "sends": sends,
+                    "collective": getattr(n, "_collective", None),
+                })
+            # deterministic read order = producer topo order (both sides agree)
+            recv.sort(key=lambda kv: -1 if kv[0] == "__input__"
+                      else topo_index[kv[0]])
+            launch_plan.append((k, schedule, recv))
+
+        # collective groups must rendezvous BEFORE exec loops park on the
+        # actors' (single) execution thread
+        groups: Dict[str, Tuple[list, str]] = {}
+        for n in compute_nodes:
+            col = getattr(n, "_collective", None)
+            if col is not None:
+                spec = getattr(n, "_collective_group_spec", None)
+                if spec is not None:
+                    groups.setdefault(col[0], spec)
+        for group_name, (handles, backend) in groups.items():
+            from ray_tpu.util import collective as col_lib
+
+            col_lib.create_collective_group(
+                handles, len(handles), list(range(len(handles))),
+                backend=backend, group_name=group_name)
+
+        from ray_tpu.actor import ActorMethod
+
+        for k, schedule, recv in launch_plan:
+            ref = ActorMethod(self._actors[k], "__ray_tpu_call__").remote(
+                _compiled_dag_actor_loop, schedule, recv)
+            self._loop_refs.append(ref)
+
+        for _, ch in self._output_channels:
+            ch.register_reader(0)
+
+    # -- execution ----------------------------------------------------------
+
+    def execute(self, *args, **kwargs) -> CompiledDAGRef:
+        import pickle
+
+        with self._lock:
+            if self._torn_down:
+                raise RuntimeError("compiled DAG was torn down")
+            # In-flight = submitted minus retrieved-by-get(); bounds the
+            # result cache even when callers drop refs without get().
+            if self._exec_idx - self._num_got >= self._max_inflight:
+                raise RuntimeError(
+                    f"{self._max_inflight} executions in flight; call get() "
+                    "on earlier CompiledDAGRefs before submitting more")
+            value = _make_input_value(args, kwargs)
+            idx = self._exec_idx
+            self._exec_idx += 1
+        payload = pickle.dumps(value, protocol=5)  # serialize once, fan out
+        # Writes happen outside self._lock (they can block on backpressure and
+        # must not stall the drain thread) but under a dedicated lock so
+        # concurrent execute() calls stay index-ordered on every channel.
+        with self._write_lock:
+            for ch in self._input_channels.values():
+                ch.write_bytes(payload)
+        return CompiledDAGRef(self, idx)
+
+    def _drain_loop(self):
+        try:
+            while True:
+                outs = [ch.read() for _, ch in self._output_channels]
+                with self._result_cv:
+                    self._result_cache[self._next_result_idx] = (
+                        outs if self._multi_output else outs[0])
+                    self._next_result_idx += 1
+                    self._result_cv.notify_all()
+        except ChannelClosed:
+            with self._result_cv:
+                self._result_cv.notify_all()
+
+    def _get_result(self, idx: int, timeout: Optional[float]):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._result_cv:
+            while idx not in self._result_cache:
+                if self._torn_down:
+                    raise RuntimeError("compiled DAG was torn down")
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError(f"result {idx} not ready after {timeout}s")
+                self._result_cv.wait(timeout=remaining if remaining is None
+                                     else min(remaining, 0.5))
+            result = self._result_cache.pop(idx)
+            self._num_got += 1
+        for v in (result if isinstance(result, list) else [result]):
+            if isinstance(v, _NodeError):
+                raise v.exc
+        return result
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def teardown(self, wait: bool = True):
+        with self._result_cv:
+            if self._torn_down:
+                return
+            self._torn_down = True
+            self._result_cv.notify_all()
+        for ch in self._channels:
+            ch.close()
+        if wait:
+            import ray_tpu
+
+            for ref in self._loop_refs:
+                try:
+                    ray_tpu.get(ref, timeout=5)
+                except Exception:  # noqa: BLE001
+                    pass
+        for ch in self._channels:
+            ch.destroy()
+        try:
+            atexit.unregister(self.teardown)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def __del__(self):
+        try:
+            self.teardown(wait=False)
+        except Exception:  # noqa: BLE001
+            pass

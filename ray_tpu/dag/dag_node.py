@@ -1,0 +1,214 @@
+"""DAG node types for lazy task graphs and compiled graphs.
+
+TPU-native rebuild of the reference's Ray DAG API
+(reference: python/ray/dag/dag_node.py:34 DAGNode, input_node.py InputNode,
+class_node.py ClassMethodNode, output_node.py MultiOutputNode;
+experimental_compile at dag_node.py:280).
+
+Two execution modes:
+- ``node.execute(*args)`` — interpreted: walk the graph issuing ordinary
+  ``.remote()`` calls, returning an ObjectRef for the root.
+- ``node.experimental_compile()`` — compiled: pre-allocate single-slot
+  shared-memory channels along every edge and park a long-running exec loop
+  on each participating actor, so steady-state iterations bypass the RPC /
+  scheduling path entirely (see compiled_dag_node.py).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Optional, Tuple
+
+_node_counter = itertools.count()
+
+
+class DAGNode:
+    """Base: a lazily-evaluated operation with bound arguments."""
+
+    def __init__(self, args: tuple = (), kwargs: Optional[dict] = None):
+        self._bound_args = tuple(args)
+        self._bound_kwargs = dict(kwargs or {})
+        self._stable_uuid = next(_node_counter)
+
+    # -- graph introspection ------------------------------------------------
+
+    def _upstream(self) -> List["DAGNode"]:
+        ups = [a for a in self._bound_args if isinstance(a, DAGNode)]
+        ups += [v for v in self._bound_kwargs.values() if isinstance(v, DAGNode)]
+        return ups
+
+    def _all_nodes(self) -> List["DAGNode"]:
+        """All reachable nodes in topological order (inputs first)."""
+        order: List[DAGNode] = []
+        seen = set()
+
+        def visit(n: DAGNode):
+            if id(n) in seen:
+                return
+            seen.add(id(n))
+            for up in n._upstream():
+                visit(up)
+            order.append(n)
+
+        visit(self)
+        return order
+
+    # -- interpreted execution ---------------------------------------------
+
+    def execute(self, *args, **kwargs):
+        """Walk the graph issuing .remote() calls (reference: dag_node.py
+        execute -> _execute_impl per node type)."""
+        input_value = _make_input_value(args, kwargs)
+        cache: Dict[int, Any] = {}
+        for node in self._all_nodes():
+            cache[node._stable_uuid] = node._execute_impl(cache, input_value)
+        return cache[self._stable_uuid]
+
+    def _resolve_args(self, cache, resolve=None):
+        def r(v):
+            if isinstance(v, DAGNode):
+                return cache[v._stable_uuid]
+            return v
+
+        args = [r(a) for a in self._bound_args]
+        kwargs = {k: r(v) for k, v in self._bound_kwargs.items()}
+        return args, kwargs
+
+    def _execute_impl(self, cache, input_value):
+        raise NotImplementedError
+
+    # -- compiled execution -------------------------------------------------
+
+    def experimental_compile(self, *, buffer_size_bytes: Optional[int] = None,
+                             max_inflight_executions: int = 100):
+        from ray_tpu.dag.compiled_dag_node import CompiledDAG
+
+        return CompiledDAG(self, buffer_size_bytes=buffer_size_bytes,
+                           max_inflight_executions=max_inflight_executions)
+
+
+class _DAGInputData:
+    """Multi-arg input bundle, unpacked by InputAttributeNodes."""
+
+    __slots__ = ("args", "kwargs")
+
+    def __init__(self, args: tuple, kwargs: dict):
+        self.args = args
+        self.kwargs = kwargs
+
+
+def _make_input_value(args: tuple, kwargs: dict):
+    if len(args) == 1 and not kwargs:
+        return args[0]
+    return _DAGInputData(args, kwargs)
+
+
+def extract_input(value, extractor: Tuple):
+    kind = extractor[0]
+    if kind == "whole":
+        if isinstance(value, _DAGInputData):
+            raise ValueError(
+                "DAG was executed with multiple args/kwargs but a node binds "
+                "the whole InputNode; bind inp[i] / inp.key projections instead")
+        return value
+    if isinstance(value, _DAGInputData):
+        if kind == "arg":
+            return value.args[extractor[1]]
+        return value.kwargs[extractor[1]]
+    # single-value input: arg 0 is the value itself; keys index into it
+    if kind == "arg":
+        if extractor[1] == 0:
+            return value
+        raise IndexError(f"input has a single positional arg; got index {extractor[1]}")
+    return value[extractor[1]]
+
+
+class InputNode(DAGNode):
+    """The DAG's formal parameter (reference: python/ray/dag/input_node.py).
+
+    Used as a context manager::
+
+        with InputNode() as inp:
+            out = actor.fwd.bind(inp)
+    """
+
+    def __init__(self):
+        super().__init__()
+        self._attr_nodes: Dict[Tuple, "InputAttributeNode"] = {}
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def _attr(self, extractor: Tuple) -> "InputAttributeNode":
+        if extractor not in self._attr_nodes:
+            self._attr_nodes[extractor] = InputAttributeNode(self, extractor)
+        return self._attr_nodes[extractor]
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return self._attr(("key", name))
+
+    def __getitem__(self, key):
+        return self._attr(("arg", key) if isinstance(key, int) else ("key", key))
+
+    def _execute_impl(self, cache, input_value):
+        return extract_input(input_value, ("whole",))
+
+
+class InputAttributeNode(DAGNode):
+    """``inp[i]`` / ``inp.key`` projection of the DAG input."""
+
+    def __init__(self, input_node: InputNode, extractor: Tuple):
+        super().__init__(args=(input_node,))
+        self._extractor = extractor
+
+    def _execute_impl(self, cache, input_value):
+        return extract_input(input_value, self._extractor)
+
+
+class ClassMethodNode(DAGNode):
+    """An actor-method invocation bound into the graph."""
+
+    def __init__(self, actor_handle, method_name: str, args, kwargs):
+        super().__init__(args=args, kwargs=kwargs)
+        self._actor_handle = actor_handle
+        self._method_name = method_name
+
+    def __repr__(self):
+        return (f"ClassMethodNode({self._method_name} on "
+                f"{self._actor_handle._actor_id.hex()[:8]})")
+
+    def _execute_impl(self, cache, input_value):
+        from ray_tpu.actor import ActorMethod
+
+        args, kwargs = self._resolve_args(cache)
+        return ActorMethod(self._actor_handle, self._method_name).remote(*args, **kwargs)
+
+
+class FunctionNode(DAGNode):
+    """A remote-function invocation bound into the graph (interpreted-mode
+    only; compiled graphs require actor methods, as in the reference)."""
+
+    def __init__(self, remote_fn, args, kwargs):
+        super().__init__(args=args, kwargs=kwargs)
+        self._remote_fn = remote_fn
+
+    def _execute_impl(self, cache, input_value):
+        args, kwargs = self._resolve_args(cache)
+        return self._remote_fn.remote(*args, **kwargs)
+
+
+class MultiOutputNode(DAGNode):
+    """Bundles several leaves so execute()/compile() return a list
+    (reference: python/ray/dag/output_node.py)."""
+
+    def __init__(self, outputs: List[DAGNode]):
+        super().__init__(args=tuple(outputs))
+
+    def _execute_impl(self, cache, input_value):
+        args, _ = self._resolve_args(cache)
+        return args

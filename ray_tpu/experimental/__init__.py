@@ -1,0 +1,1 @@
+"""Experimental subsystems: compiled-graph channels, device-resident objects."""

@@ -1,0 +1,10 @@
+"""Compiled-graph channels (reference: python/ray/experimental/channel/)."""
+
+from ray_tpu.experimental.channel.shared_memory_channel import (
+    ChannelClosed,
+    ChannelFull,
+    IntraProcessChannel,
+    ShmChannel,
+)
+
+__all__ = ["ChannelClosed", "ChannelFull", "IntraProcessChannel", "ShmChannel"]

@@ -77,6 +77,12 @@ class RemoteFunction:
             runtime_env=opts.get("runtime_env"),
         )
 
+    def bind(self, *args, **kwargs):
+        """Bind into a lazy DAG (reference: python/ray/dag FunctionNode)."""
+        from ray_tpu.dag.dag_node import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
     def __call__(self, *args, **kwargs):
         raise TypeError(
             f"Remote function {self._fn.__name__!r} cannot be called directly; "

@@ -1,0 +1,259 @@
+"""Compiled graphs + lazy DAG tests (reference: python/ray/dag/tests/)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.dag import InputNode, MultiOutputNode, allreduce
+from ray_tpu.experimental.channel import ChannelClosed, ShmChannel
+
+
+# ---------------------------------------------------------------------------
+# channel unit tests (no cluster)
+# ---------------------------------------------------------------------------
+
+
+def test_shm_channel_roundtrip():
+    ch = ShmChannel(num_readers=1, capacity=1 << 20)
+    try:
+        ch.register_reader(0)
+        ch.write({"x": np.arange(10)})
+        out = ch.read(timeout=5)
+        assert list(out["x"]) == list(range(10))
+    finally:
+        ch.destroy()
+
+
+def test_shm_channel_backpressure_and_order():
+    ch = ShmChannel(num_readers=1, capacity=1 << 16)
+    got = []
+
+    def reader():
+        ch.register_reader(0)
+        for _ in range(20):
+            got.append(ch.read(timeout=10))
+
+    t = threading.Thread(target=reader)
+    t.start()
+    for i in range(20):
+        ch.write(i, timeout=10)
+    t.join(timeout=10)
+    assert got == list(range(20))
+    ch.destroy()
+
+
+def test_shm_channel_close_unblocks_reader():
+    ch = ShmChannel(num_readers=1)
+    ch.register_reader(0)
+    errs = []
+
+    def reader():
+        try:
+            ch.read(timeout=10)
+        except ChannelClosed:
+            errs.append("closed")
+
+    t = threading.Thread(target=reader)
+    t.start()
+    time.sleep(0.1)
+    ch.close()
+    t.join(timeout=5)
+    assert errs == ["closed"]
+    ch.destroy()
+
+
+# ---------------------------------------------------------------------------
+# DAG tests (cluster)
+# ---------------------------------------------------------------------------
+
+
+@ray_tpu.remote
+class Adder:
+    def __init__(self, bias):
+        self.bias = bias
+
+    def add(self, x):
+        return x + self.bias
+
+    def combine(self, a, b):
+        return a + b
+
+    def grad(self, x):
+        return np.full(4, float(x))
+
+    def boom(self, x):
+        raise ValueError("boom")
+
+
+def test_interpreted_dag(ray_start_regular):
+    a = Adder.remote(1)
+    b = Adder.remote(10)
+    with InputNode() as inp:
+        out = b.add.bind(a.add.bind(inp))
+    ref = out.execute(5)
+    assert ray_tpu.get(ref) == 16
+
+
+def test_interpreted_function_dag(ray_start_regular):
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
+    with InputNode() as inp:
+        out = double.bind(double.bind(inp))
+    assert ray_tpu.get(out.execute(3)) == 12
+
+
+def test_compiled_linear_chain(ray_start_regular):
+    a = Adder.remote(1)
+    b = Adder.remote(10)
+    with InputNode() as inp:
+        dag = b.add.bind(a.add.bind(inp))
+    compiled = dag.experimental_compile()
+    try:
+        for i in range(10):
+            assert compiled.execute(i).get(timeout=30) == i + 11
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_fan_out_multi_output(ray_start_regular):
+    a = Adder.remote(100)
+    b = Adder.remote(200)
+    with InputNode() as inp:
+        dag = MultiOutputNode([a.add.bind(inp), b.add.bind(inp)])
+    compiled = dag.experimental_compile()
+    try:
+        out = compiled.execute(7).get(timeout=30)
+        assert out == [107, 207]
+        out = compiled.execute(8).get(timeout=30)
+        assert out == [108, 208]
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_cross_actor_join_and_pipelining(ray_start_regular):
+    a = Adder.remote(1)
+    b = Adder.remote(2)
+    c = Adder.remote(0)
+    with InputNode() as inp:
+        dag = c.combine.bind(a.add.bind(inp), b.add.bind(inp))
+    compiled = dag.experimental_compile()
+    try:
+        refs = [compiled.execute(i) for i in range(3)]  # pipelined submits
+        assert [r.get(timeout=30) for r in refs] == [3, 5, 7]
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_multi_arg_input(ray_start_regular):
+    a = Adder.remote(0)
+    with InputNode() as inp:
+        dag = a.combine.bind(inp[0], inp[1])
+    compiled = dag.experimental_compile()
+    try:
+        assert compiled.execute(3, 4).get(timeout=30) == 7
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_dag_error_propagation(ray_start_regular):
+    a = Adder.remote(1)
+    b = Adder.remote(1)
+    with InputNode() as inp:
+        dag = b.add.bind(a.boom.bind(inp))
+    compiled = dag.experimental_compile()
+    try:
+        with pytest.raises(ValueError, match="boom"):
+            compiled.execute(1).get(timeout=30)
+        # DAG remains usable after an error
+        with pytest.raises(ValueError, match="boom"):
+            compiled.execute(2).get(timeout=30)
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_allreduce(ray_start_regular):
+    workers = [Adder.remote(0) for _ in range(2)]
+    with InputNode() as inp:
+        grads = [w.grad.bind(inp) for w in workers]
+        reduced = allreduce.bind(grads)
+        dag = MultiOutputNode(reduced)
+    compiled = dag.experimental_compile()
+    try:
+        out = compiled.execute(3.0).get(timeout=60)
+        for arr in out:
+            np.testing.assert_allclose(arr, np.full(4, 6.0))
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_collective_error_no_deadlock(ray_start_regular):
+    """One rank erroring upstream of an allreduce must not wedge the gang."""
+    workers = [Adder.remote(0) for _ in range(2)]
+    with InputNode() as inp:
+        g0 = workers[0].boom.bind(inp)       # errors
+        g1 = workers[1].grad.bind(inp)
+        reduced = allreduce.bind([g0, g1])
+        dag = MultiOutputNode(reduced)
+    compiled = dag.experimental_compile()
+    try:
+        with pytest.raises(Exception):
+            compiled.execute(1.0).get(timeout=60)
+        # gang stays in lockstep: a healthy follow-up round still works...
+        with pytest.raises(Exception):
+            compiled.execute(2.0).get(timeout=60)
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_nullary_node_stays_synced(ray_start_regular):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def tick(self):
+            self.n += 1
+            return self.n
+
+    c = Counter.remote()
+    with InputNode() as inp:
+        dag = MultiOutputNode([c.tick.bind()])
+    compiled = dag.experimental_compile()
+    try:
+        assert compiled.execute().get(timeout=30) == [1]
+        time.sleep(0.5)  # a free-running loop would advance the counter here
+        assert compiled.execute().get(timeout=30) == [2]
+    finally:
+        compiled.teardown()
+
+
+def test_interpreted_allreduce(ray_start_regular):
+    workers = [Adder.remote(0) for _ in range(2)]
+    with InputNode() as inp:
+        grads = [w.grad.bind(inp) for w in workers]
+        reduced = allreduce.bind(grads)
+        dag = MultiOutputNode(reduced)
+    refs = dag.execute(2.0)
+    out = ray_tpu.get(refs)
+    for arr in out:
+        np.testing.assert_allclose(arr, np.full(4, 4.0))
+
+
+def test_compiled_max_inflight(ray_start_regular):
+    a = Adder.remote(1)
+    with InputNode() as inp:
+        dag = a.add.bind(inp)
+    compiled = dag.experimental_compile(max_inflight_executions=5)
+    try:
+        refs = [compiled.execute(i) for i in range(5)]
+        with pytest.raises(RuntimeError, match="in flight"):
+            compiled.execute(99)
+        assert [r.get(timeout=30) for r in refs] == [1, 2, 3, 4, 5]
+        assert compiled.execute(10).get(timeout=30) == 11
+    finally:
+        compiled.teardown()
