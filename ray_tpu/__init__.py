@@ -195,6 +195,13 @@ def get_runtime_context():
     return RuntimeContext(get_global_worker())
 
 
+def timeline(filename=None):
+    """Export a Chrome trace of all task executions (reference: ray.timeline)."""
+    from ray_tpu._private.timeline import timeline as _timeline
+
+    return _timeline(filename)
+
+
 __all__ = [
     "init",
     "shutdown",
@@ -210,6 +217,7 @@ __all__ = [
     "available_resources",
     "get_tpu_ids",
     "get_runtime_context",
+    "timeline",
     "ObjectRef",
     "ActorHandle",
     "RayTpuError",

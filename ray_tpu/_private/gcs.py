@@ -118,6 +118,7 @@ class GcsServer:
         self.kv: Dict[str, bytes] = {}
         self.scheduler = ClusterResourceScheduler()
         self.task_events: deque = deque(maxlen=self.config.task_events_max_buffer)
+        self.metrics_by_reporter: Dict[str, dict] = {}
         self._lock = threading.RLock()
         self._actor_queue: deque = deque()
         self._actor_cv = threading.Condition(self._lock)
@@ -633,3 +634,65 @@ class GcsServer:
         limit = req.get("limit", 1000)
         with self._lock:
             return list(self.task_events)[-limit:]
+
+    # ------------------------------------------------------------------
+    # State-API listings + cluster metrics aggregate
+    # (reference: util/state/api.py sources; _private/metrics_agent.py)
+    # ------------------------------------------------------------------
+
+    def HandleListJobs(self, req):
+        with self._lock:
+            return [
+                {"job_id": jid.hex(), "state": j.get("state"), "start": j.get("start"),
+                 "driver_addr": j.get("driver_addr")}
+                for jid, j in self.jobs.items()
+            ]
+
+    def HandleListPlacementGroups(self, req):
+        with self._lock:
+            return [
+                {
+                    "pg_id": pg.pg_id,
+                    "name": pg.name,
+                    "state": pg.state,
+                    "strategy": pg.strategy,
+                    "bundles": [b.to_dict() for b in pg.bundles],
+                    "bundle_nodes": list(pg.bundle_nodes),
+                }
+                for pg in self.placement_groups.values()
+            ]
+
+    def HandleReportMetrics(self, req):
+        with self._lock:
+            self.metrics_by_reporter[req["reporter"]] = {
+                "points": req["points"], "time": req.get("time"),
+            }
+        return True
+
+    def HandleCollectMetrics(self, req):
+        """Aggregate across reporters: counters/histograms sum, gauges
+        newest-report-wins (by the reporter's push timestamp)."""
+        with self._lock:
+            snapshots = [
+                (s.get("time") or 0.0, s["points"])
+                for s in self.metrics_by_reporter.values()
+            ]
+        agg: dict = {}
+        gauge_time: dict = {}
+        for report_time, points in snapshots:
+            for p in points:
+                key = (p["name"], tuple(sorted(p.get("tags", {}).items())))
+                cur = agg.get(key)
+                if cur is None:
+                    agg[key] = dict(p)
+                    gauge_time[key] = report_time
+                elif p["kind"] == "counter":
+                    cur["value"] += p["value"]
+                elif p["kind"] == "histogram":
+                    cur["buckets"] = [a + b for a, b in zip(cur["buckets"], p["buckets"])]
+                    cur["sum"] += p["sum"]
+                    cur["count"] += p["count"]
+                elif report_time >= gauge_time[key]:
+                    cur["value"] = p["value"]
+                    gauge_time[key] = report_time
+        return list(agg.values())

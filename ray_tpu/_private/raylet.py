@@ -659,6 +659,28 @@ class Raylet:
     # Introspection
     # ------------------------------------------------------------------
 
+    def HandleListObjects(self, req):
+        """Per-object plasma listing for the state API (reference: `ray list objects`)."""
+        with self._lock:
+            oids = self.store.list_objects()
+            return [
+                {"object_id": oid.hex(), "size": self.store.object_size(oid)}
+                for oid in oids
+            ]
+
+    def HandleListWorkers(self, req):
+        """reference: `ray list workers` (worker pool state)."""
+        with self._lock:
+            idle = {w.worker_id for w in self._idle_workers}
+            return [
+                {"worker_id": w.worker_id.hex(),
+                 "pid": w.proc.pid if w.proc is not None else None,
+                 "address": w.address,
+                 "actor_id": w.dedicated_actor.hex() if w.dedicated_actor else None,
+                 "idle": w.worker_id in idle}
+                for w in self._all_workers.values()
+            ]
+
     def HandleGetNodeStats(self, req):
         with self._lock:
             return {

@@ -1,0 +1,49 @@
+"""Chrome-trace timeline export from the cluster task-event log.
+
+reference: ray.timeline() — task events buffered per-worker
+(src/ray/core_worker/task_event_buffer.cc) flow to the GCS task sink
+(gcs_task_manager.h) and render as a Chrome trace in the dashboard.
+Load the output at chrome://tracing or https://ui.perfetto.dev.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional
+
+
+def timeline(filename: Optional[str] = None) -> List[dict]:
+    """Build Chrome trace events; write JSON to ``filename`` if given."""
+    from ray_tpu._private.worker import get_global_worker
+    from ray_tpu.util.state import list_tasks
+
+    w = get_global_worker()
+    if w is None:
+        raise RuntimeError("ray_tpu.init() must be called before ray_tpu.timeline()")
+    w.flush_task_events()
+
+    events: List[dict] = []
+    for t in list_tasks(limit=100000):
+        start, end = t.get("start_time"), t.get("end_time")
+        if start is None:
+            continue
+        if end is None or end < start:
+            end = start
+        events.append({
+            "name": t["name"],
+            "cat": "actor_task" if t.get("actor_id") else "task",
+            "ph": "X",
+            "ts": start * 1e6,
+            "dur": (end - start) * 1e6,
+            "pid": t.get("node_id") or "driver",
+            "tid": t.get("pid") or 0,
+            "args": {
+                "task_id": t["task_id"],
+                "attempt": t.get("attempt", 0),
+                "state": t.get("state"),
+            },
+        })
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(events, f)
+    return events

@@ -790,7 +790,7 @@ class CoreWorker:
         self._unpin_args(spec)
         self._record_task_event(spec, "FAILED")
 
-    def _record_task_event(self, spec: TaskSpec, state: str):
+    def _record_task_event(self, spec: TaskSpec, state: str, extra: Optional[dict] = None):
         if not global_config().task_events_enabled:
             return
         ev = {
@@ -802,9 +802,18 @@ class CoreWorker:
             "job_id": spec.job_id.hex() if spec.job_id else None,
             "actor_id": spec.actor_id.hex() if spec.actor_id else None,
         }
+        if extra:
+            ev.update(extra)
         self._task_events.append(ev)
         if len(self._task_events) >= 100:
             self.flush_task_events()
+
+    def _record_exec_event(self, spec: TaskSpec):
+        """Executor-side RUNNING event with pid/node for timeline + state API."""
+        self._record_task_event(spec, "RUNNING", extra={
+            "pid": os.getpid(),
+            "node_id": self.node_id.hex() if self.node_id else None,
+        })
 
     def flush_task_events(self):
         events, self._task_events = self._task_events, []
@@ -827,6 +836,7 @@ class CoreWorker:
         spec: TaskSpec = req["spec"]
         lease: dict = req["lease"]
         try:
+            self._record_exec_event(spec)
             bind_visible_accelerators(lease.get("resource_instances"))
             fn = self._load_function(spec)
             args = [self._unpack_arg(a) for a in spec.args]
@@ -848,6 +858,7 @@ class CoreWorker:
                 self.raylet.notify("ReturnWorker", {"lease_id": lease.get("lease_id")})
             except Exception:  # noqa: BLE001
                 pass
+            self.flush_task_events()
 
     def _load_function(self, spec: TaskSpec):
         if spec.function_digest in self._fn_cache:
@@ -978,6 +989,7 @@ class CoreWorker:
             max_retries=max_task_retries,
         )
         self.task_manager.add_pending(spec)
+        self._record_task_event(spec, "SUBMITTED")
         self._pin_args(spec)
         with self._actor_lock:
             pipeline = self._actor_pipelines.get(actor_id)
@@ -1056,6 +1068,7 @@ class CoreWorker:
     def _execute_actor_task(self, req, reply_token):
         spec: TaskSpec = req["spec"]
         try:
+            self._record_exec_event(spec)
             args = [self._unpack_arg(a) for a in spec.args]
             kwargs = {k: self._unpack_arg((kind, p)) for k, kind, p in spec.kwargs}
             if spec.actor_method == "__ray_tpu_call__":
@@ -1076,6 +1089,8 @@ class CoreWorker:
             self.server.send_reply(
                 reply_token, {"status": "error", "error": e, "traceback": traceback.format_exc()}
             )
+        finally:
+            self.flush_task_events()
 
     def HandleKillActor(self, req):
         logger.info("actor %s killed: %s", req.get("actor_id"), req.get("reason"))

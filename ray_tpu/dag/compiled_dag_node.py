@@ -30,10 +30,10 @@ backend is ``xla``, so the op lowers to ICI collectives.
 
 from __future__ import annotations
 
-import atexit
 import logging
 import threading
 import time
+import weakref
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.dag.dag_node import (
@@ -70,80 +70,98 @@ def _actor_key(handle) -> str:
     return handle._actor_id.hex()
 
 
-def _compiled_dag_actor_loop(instance, schedule, recv_list):
+def _compiled_dag_actor_loop(instance, program):
     """Runs on the actor via ``__ray_tpu_call__``: loop until channels close.
 
-    schedule: ordered ops:
-      {"uuid", "method", "args": [spec], "kwargs": {k: spec},
+    program: ordered steps, one pass per DAG iteration:
+      {"kind": "recv", "key": "__input__" | producer uuid, "chan": ShmChannel}
+      {"kind": "op", "uuid", "method", "args": [spec], "kwargs": {k: spec},
        "sends": [ShmChannel], "collective": None | (group_name, op)}
       spec := ("const", v) | ("node", uuid) | ("input", extractor)
-    recv_list: ordered [(key, ShmChannel)] to read once per iteration;
-      key := "__input__" | producer node uuid
+
+    Each recv is scheduled immediately before the first op that needs it
+    (NOT all up-front): an actor that is revisited in one iteration
+    (A -> B -> A) sends its first op's output before blocking on the
+    channel that B feeds, so cyclic actor visit orders can't deadlock.
     """
     import numpy as np
 
-    for _, chan in recv_list:
-        chan.register_reader(0)
+    for step in program:
+        if step["kind"] == "recv":
+            step["chan"].register_reader(0)
     values: Dict[Any, Any] = {}
     while True:
         try:
-            for key, chan in recv_list:
-                values[key] = chan.read()
-        except ChannelClosed:
-            return "closed"
+            for step in program:
+                if step["kind"] == "recv":
+                    values[step["key"]] = step["chan"].read()
+                    continue
+                op = step
 
-        for op in schedule:
-            def resolve(spec):
-                kind, payload = spec
-                if kind == "const":
-                    return payload
-                if kind == "node":
-                    return values[payload]
-                inp = values["__input__"]
-                if isinstance(inp, _NodeError):
-                    return inp
-                return extract_input(inp, payload)
+                def resolve(spec):
+                    kind, payload = spec
+                    if kind == "const":
+                        return payload
+                    if kind == "node":
+                        return values[payload]
+                    inp = values["__input__"]
+                    if isinstance(inp, _NodeError):
+                        return inp
+                    return extract_input(inp, payload)
 
-            try:
-                args = [resolve(s) for s in op["args"]]
-                kwargs = {k: resolve(s) for k, s in op["kwargs"].items()}
-                err = next((a for a in list(args) + list(kwargs.values())
-                            if isinstance(a, _NodeError)), None)
-                if op["collective"] is not None:
-                    from ray_tpu.util import collective as col
-                    from ray_tpu.util.collective.types import ReduceOp
+                try:
+                    args = [resolve(s) for s in op["args"]]
+                    kwargs = {k: resolve(s) for k, s in op["kwargs"].items()}
+                    err = next((a for a in list(args) + list(kwargs.values())
+                                if isinstance(a, _NodeError)), None)
+                    if op["collective"] is not None:
+                        from ray_tpu.util import collective as col
+                        from ray_tpu.util.collective.types import ReduceOp
 
-                    group_name, col_op = op["collective"]
-                    # Pre-vote so an errored rank can't skip the collective
-                    # while healthy ranks block in it forever: every rank
-                    # always reaches this tiny MAX-allreduce, then all ranks
-                    # agree to run or skip the real one in lockstep.
-                    flag = col.allreduce(np.array([1.0 if err else 0.0]),
-                                         group_name=group_name,
-                                         op=ReduceOp.MAX)
-                    if float(flag[0]) != 0.0:
-                        result = err or _NodeError(
-                            RuntimeError("collective peer failed upstream"),
-                            op["method"])
+                        group_name, col_op = op["collective"]
+                        # Pre-vote so an errored rank can't skip the collective
+                        # while healthy ranks block in it forever: every rank
+                        # always reaches this tiny MAX-allreduce, then all ranks
+                        # agree to run or skip the real one in lockstep.
+                        flag = col.allreduce(np.array([1.0 if err else 0.0]),
+                                             group_name=group_name,
+                                             op=ReduceOp.MAX)
+                        if float(flag[0]) != 0.0:
+                            result = err or _NodeError(
+                                RuntimeError("collective peer failed upstream"),
+                                op["method"])
+                        else:
+                            result = col.allreduce(args[0], group_name=group_name,
+                                                   op=col_op)
+                    elif err is not None:
+                        result = err
                     else:
-                        result = col.allreduce(args[0], group_name=group_name,
-                                               op=col_op)
-                elif err is not None:
-                    result = err
-                else:
-                    result = getattr(instance, op["method"])(*args, **kwargs)
-            except Exception as e:  # noqa: BLE001
-                logger.exception("compiled-dag node %s failed", op["method"])
-                result = _NodeError(e, op["method"])
-            values[op["uuid"]] = result
-            try:
+                        result = getattr(instance, op["method"])(*args, **kwargs)
+                except Exception as e:  # noqa: BLE001
+                    logger.exception("compiled-dag node %s failed", op["method"])
+                    result = _NodeError(e, op["method"])
+                values[op["uuid"]] = result
                 for chan in op["sends"]:
                     try:
                         chan.write(result)
                     except ChannelFull as e:
                         chan.write(_NodeError(e, op["method"]))
-            except ChannelClosed:
-                return "closed"
+        except ChannelClosed:
+            return "closed"
+
+
+def _close_and_destroy_channels(channels):
+    """GC/exit-time cleanup; must not reference the CompiledDAG instance."""
+    for ch in channels:
+        try:
+            ch.close()
+        except Exception:  # noqa: BLE001
+            pass
+    for ch in channels:
+        try:
+            ch.destroy()
+        except Exception:  # noqa: BLE001
+            pass
 
 
 class CompiledDAGRef:
@@ -178,6 +196,7 @@ class CompiledDAG:
         self._num_got = 0
         self._result_cache: Dict[int, Any] = {}
         self._torn_down = False
+        self._drain_error: Optional[Exception] = None
         self._build(root)
         # Drain leaf channels continuously so deep pipelined submission can't
         # deadlock (driver blocked writing inputs while actors block writing
@@ -185,7 +204,11 @@ class CompiledDAG:
         self._drain_thread = threading.Thread(
             target=self._drain_loop, daemon=True, name="compiled-dag-drain")
         self._drain_thread.start()
-        atexit.register(self.teardown)
+        # weakref.finalize (not atexit.register(self.teardown)) so the DAG
+        # stays GC-able: runs at collection time or interpreter exit and only
+        # captures the channel list, never the instance.
+        self._finalizer = weakref.finalize(
+            self, _close_and_destroy_channels, self._channels)
 
     # -- compilation --------------------------------------------------------
 
@@ -250,29 +273,36 @@ class CompiledDAG:
             ch = new_chan()
             self._output_channels.append((leaf._stable_uuid, ch))
 
-        # per-actor schedule + recv lists
+        # per-actor interleaved programs: each recv is placed immediately
+        # before the first op that needs it, so revisited actors (A->B->A)
+        # publish earlier sends before blocking on later recvs
         topo_index = {n._stable_uuid: i for i, n in enumerate(nodes)}
         self._loop_refs = []
-        launch_plan: List[Tuple[str, list, list]] = []
+        launch_plan: List[Tuple[str, list]] = []
         for k, actor_nodes in per_actor_nodes.items():
             actor_nodes.sort(key=lambda n: topo_index[n._stable_uuid])
-            local = {n._stable_uuid for n in actor_nodes}
-            recv: List[Tuple[Any, ShmChannel]] = []
-            if k in self._input_channels:
-                recv.append(("__input__", self._input_channels[k]))
-            recv_keys = set()
-            schedule = []
+            received = set()
+            program: List[dict] = []
+            uses_input = False
             for n in actor_nodes:
+                pre_recvs: List[dict] = []
+
                 def spec_of(v):
+                    nonlocal uses_input
                     if isinstance(v, (InputNode, InputAttributeNode)):
                         ext = ("whole",) if isinstance(v, InputNode) else v._extractor
+                        if "__input__" not in received:
+                            received.add("__input__")
+                            pre_recvs.append({"kind": "recv", "key": "__input__",
+                                              "chan": self._input_channels[k]})
+                        uses_input = True
                         return ("input", ext)
                     if isinstance(v, ClassMethodNode):
                         up_k = _actor_key(v._actor_handle)
-                        if up_k != k and v._stable_uuid not in recv_keys:
-                            recv_keys.add(v._stable_uuid)
-                            recv.append((v._stable_uuid,
-                                         edge_chan[(v._stable_uuid, k)]))
+                        if up_k != k and v._stable_uuid not in received:
+                            received.add(v._stable_uuid)
+                            pre_recvs.append({"kind": "recv", "key": v._stable_uuid,
+                                              "chan": edge_chan[(v._stable_uuid, k)]})
                         return ("node", v._stable_uuid)
                     if isinstance(v, DAGNode):
                         raise TypeError(f"unsupported upstream {v!r}")
@@ -282,18 +312,25 @@ class CompiledDAG:
                          if uuid_key == n._stable_uuid]
                 sends += [ch for uuid_key, ch in self._output_channels
                           if uuid_key == n._stable_uuid]
-                schedule.append({
+                op = {
+                    "kind": "op",
                     "uuid": n._stable_uuid,
                     "method": n._method_name,
                     "args": [spec_of(a) for a in n._bound_args],
                     "kwargs": {kk: spec_of(v) for kk, v in n._bound_kwargs.items()},
                     "sends": sends,
                     "collective": getattr(n, "_collective", None),
-                })
-            # deterministic read order = producer topo order (both sides agree)
-            recv.sort(key=lambda kv: -1 if kv[0] == "__input__"
-                      else topo_index[kv[0]])
-            launch_plan.append((k, schedule, recv))
+                }
+                # deterministic recv order within an op = producer topo order
+                pre_recvs.sort(key=lambda s: -1 if s["key"] == "__input__"
+                               else topo_index[s["key"]])
+                program.extend(pre_recvs)
+                program.append(op)
+            if k in self._input_channels and not uses_input:
+                # Nullary actor paced by the input channel: read it first.
+                program.insert(0, {"kind": "recv", "key": "__input__",
+                                   "chan": self._input_channels[k]})
+            launch_plan.append((k, program))
 
         # collective groups must rendezvous BEFORE exec loops park on the
         # actors' (single) execution thread
@@ -313,9 +350,9 @@ class CompiledDAG:
 
         from ray_tpu.actor import ActorMethod
 
-        for k, schedule, recv in launch_plan:
+        for k, program in launch_plan:
             ref = ActorMethod(self._actors[k], "__ray_tpu_call__").remote(
-                _compiled_dag_actor_loop, schedule, recv)
+                _compiled_dag_actor_loop, program)
             self._loop_refs.append(ref)
 
         for _, ch in self._output_channels:
@@ -359,11 +396,20 @@ class CompiledDAG:
         except ChannelClosed:
             with self._result_cv:
                 self._result_cv.notify_all()
+        except Exception as e:  # noqa: BLE001 — surface to waiters, don't hang
+            logger.exception("compiled-dag drain thread failed")
+            with self._result_cv:
+                self._drain_error = e
+                self._result_cv.notify_all()
 
     def _get_result(self, idx: int, timeout: Optional[float]):
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._result_cv:
             while idx not in self._result_cache:
+                if self._drain_error is not None:
+                    raise RuntimeError(
+                        "compiled DAG result stream failed"
+                    ) from self._drain_error
                 if self._torn_down:
                     raise RuntimeError("compiled DAG was torn down")
                 remaining = None if deadline is None else deadline - time.monotonic()
@@ -398,10 +444,7 @@ class CompiledDAG:
                     pass
         for ch in self._channels:
             ch.destroy()
-        try:
-            atexit.unregister(self.teardown)
-        except Exception:  # noqa: BLE001
-            pass
+        self._finalizer.detach()
 
     def __del__(self):
         try:

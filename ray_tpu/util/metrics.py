@@ -1,0 +1,235 @@
+"""User-defined application metrics: Counter / Gauge / Histogram.
+
+TPU-native rebuild of the reference's metrics API
+(reference: python/ray/util/metrics.py; C++ registry src/ray/stats/metric.h:109,
+exposition pipeline _private/metrics_agent.py:29,57,346).
+
+Metrics are recorded into a process-local registry; each worker/driver
+periodically (and on flush) pushes snapshots to the GCS, which aggregates the
+latest value per (metric, tag-set, reporter).  ``prometheus_text()`` renders
+the cluster-wide aggregate in Prometheus exposition format — what the
+reference's per-node MetricsAgent serves to Prometheus.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_REGISTRY_LOCK = threading.Lock()
+_REGISTRY: Dict[str, "Metric"] = {}
+
+DEFAULT_HISTOGRAM_BOUNDARIES = [
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+]
+
+
+def _tag_key(tags: Optional[Dict[str, str]]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((tags or {}).items()))
+
+
+class Metric:
+    """Base class; subclasses implement the record semantics."""
+
+    _kind = "untyped"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Optional[Sequence[str]] = None):
+        if not name:
+            raise ValueError("metric name must be non-empty")
+        self._name = name
+        self._description = description
+        self._tag_keys = tuple(tag_keys or ())
+        self._default_tags: Dict[str, str] = {}
+        # Re-declaring a metric (e.g. inside a task that runs repeatedly on
+        # the same worker) adopts the existing state instead of resetting it.
+        with _REGISTRY_LOCK:
+            prior = _REGISTRY.get(name)
+            if prior is not None and prior._kind == self._kind:
+                self._lock = prior._lock
+                self._points = prior._points
+            else:
+                self._lock = threading.Lock()
+                self._points: Dict[Tuple[Tuple[str, str], ...], float] = {}
+            _REGISTRY[name] = self
+
+    @property
+    def info(self) -> Dict[str, object]:
+        return {
+            "name": self._name,
+            "description": self._description,
+            "tag_keys": self._tag_keys,
+            "default_tags": dict(self._default_tags),
+        }
+
+    def set_default_tags(self, default_tags: Dict[str, str]):
+        self._check_tags(default_tags)
+        self._default_tags = dict(default_tags)
+        return self
+
+    def _check_tags(self, tags: Optional[Dict[str, str]]):
+        for k in tags or ():
+            if k not in self._tag_keys:
+                raise ValueError(
+                    f"tag {k!r} not declared in tag_keys={self._tag_keys} of metric {self._name!r}"
+                )
+
+    def _merged(self, tags: Optional[Dict[str, str]]):
+        merged = dict(self._default_tags)
+        merged.update(tags or {})
+        return _tag_key(merged)
+
+    def _snapshot(self) -> List[dict]:
+        with self._lock:
+            return [
+                {"name": self._name, "kind": self._kind, "tags": dict(k), "value": v,
+                 "description": self._description}
+                for k, v in self._points.items()
+            ]
+
+
+class Counter(Metric):
+    """Monotonically increasing value (reference: util/metrics.py Counter)."""
+
+    _kind = "counter"
+
+    def inc(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None):
+        if value <= 0:
+            raise ValueError("Counter.inc() requires value > 0")
+        self._check_tags(tags)
+        key = self._merged(tags)
+        with self._lock:
+            self._points[key] = self._points.get(key, 0.0) + value
+
+
+class Gauge(Metric):
+    """Last-value-wins metric (reference: util/metrics.py Gauge)."""
+
+    _kind = "gauge"
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        self._check_tags(tags)
+        with self._lock:
+            self._points[self._merged(tags)] = float(value)
+
+
+class Histogram(Metric):
+    """Distribution metric with static bucket boundaries."""
+
+    _kind = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Optional[Sequence[float]] = None,
+                 tag_keys: Optional[Sequence[str]] = None):
+        with _REGISTRY_LOCK:
+            prior = _REGISTRY.get(name)
+        super().__init__(name, description, tag_keys)
+        self.boundaries = sorted(boundaries or DEFAULT_HISTOGRAM_BOUNDARIES)
+        if isinstance(prior, Histogram) and prior.boundaries == self.boundaries:
+            self._hist = prior._hist
+        else:
+            # per-tagset: (bucket counts, sum, count)
+            self._hist: Dict[Tuple, List] = {}
+
+    def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
+        self._check_tags(tags)
+        key = self._merged(tags)
+        with self._lock:
+            st = self._hist.get(key)
+            if st is None:
+                st = self._hist[key] = [[0] * (len(self.boundaries) + 1), 0.0, 0]
+            buckets, _, _ = st
+            for i, b in enumerate(self.boundaries):
+                if value <= b:
+                    buckets[i] += 1
+                    break
+            else:
+                buckets[-1] += 1
+            st[1] += value
+            st[2] += 1
+
+    def _snapshot(self) -> List[dict]:
+        with self._lock:
+            return [
+                {"name": self._name, "kind": "histogram", "tags": dict(k),
+                 "boundaries": list(self.boundaries), "buckets": list(st[0]),
+                 "sum": st[1], "count": st[2], "description": self._description}
+                for k, st in self._hist.items()
+            ]
+
+
+def collect_local() -> List[dict]:
+    """Snapshot every metric registered in this process."""
+    with _REGISTRY_LOCK:
+        metrics = list(_REGISTRY.values())
+    out: List[dict] = []
+    for m in metrics:
+        out.extend(m._snapshot())
+    return out
+
+
+def push_to_gcs():
+    """Push this process's metric snapshot to the GCS aggregate."""
+    from ray_tpu._private.worker import get_global_worker
+
+    w = get_global_worker()
+    if w is None:
+        return
+    points = collect_local()
+    if points:
+        w.gcs.notify(
+            "ReportMetrics",
+            {"reporter": f"{w.address[0]}:{w.address[1]}", "points": points,
+             "time": time.time()},
+        )
+
+
+def collect_cluster() -> List[dict]:
+    """Fetch the GCS-side cluster aggregate (all reporters, latest snapshot)."""
+    from ray_tpu._private.worker import get_global_worker
+
+    push_to_gcs()
+    w = get_global_worker()
+    if w is None:
+        return collect_local()
+    return w.gcs.call("CollectMetrics", {}) or []
+
+
+def _fmt_tags(tags: Dict[str, str]) -> str:
+    if not tags:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(tags.items()))
+    return "{" + inner + "}"
+
+
+def prometheus_text(points: Optional[List[dict]] = None) -> str:
+    """Render points in Prometheus exposition format (reference: metrics_agent.py:346)."""
+    if points is None:
+        points = collect_cluster()
+    by_name: Dict[str, List[dict]] = {}
+    for p in points:
+        by_name.setdefault(p["name"], []).append(p)
+    lines: List[str] = []
+    for name, ps in sorted(by_name.items()):
+        kind = ps[0]["kind"]
+        desc = ps[0].get("description", "")
+        if desc:
+            lines.append(f"# HELP {name} {desc}")
+        lines.append(f"# TYPE {name} {kind if kind != 'untyped' else 'gauge'}")
+        for p in ps:
+            tags = p.get("tags", {})
+            if kind == "histogram":
+                cum = 0
+                for b, c in zip(p["boundaries"], p["buckets"]):
+                    cum += c
+                    t = dict(tags, le=repr(b))
+                    lines.append(f"{name}_bucket{_fmt_tags(t)} {cum}")
+                cum += p["buckets"][-1]
+                t = dict(tags, le="+Inf")
+                lines.append(f"{name}_bucket{_fmt_tags(t)} {cum}")
+                lines.append(f"{name}_sum{_fmt_tags(tags)} {p['sum']}")
+                lines.append(f"{name}_count{_fmt_tags(tags)} {p['count']}")
+            else:
+                lines.append(f"{name}{_fmt_tags(tags)} {p['value']}")
+    return "\n".join(lines) + "\n"

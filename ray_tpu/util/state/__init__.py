@@ -1,0 +1,31 @@
+"""Cluster state introspection API.
+
+reference: python/ray/util/state/api.py (+ state_cli.py) — `ray list
+actors/tasks/objects/nodes/...` backed by GCS + per-node agents.
+"""
+
+from ray_tpu.util.state.api import (
+    StateApiClient,
+    list_actors,
+    list_jobs,
+    list_nodes,
+    list_objects,
+    list_placement_groups,
+    list_tasks,
+    list_workers,
+    summarize_actors,
+    summarize_tasks,
+)
+
+__all__ = [
+    "StateApiClient",
+    "list_actors",
+    "list_jobs",
+    "list_nodes",
+    "list_objects",
+    "list_placement_groups",
+    "list_tasks",
+    "list_workers",
+    "summarize_actors",
+    "summarize_tasks",
+]

@@ -1,0 +1,188 @@
+"""State API: list/summarize live cluster entities.
+
+reference: python/ray/util/state/api.py — list_actors/list_tasks/list_objects/
+list_nodes/list_placement_groups/list_jobs/list_workers + summaries; data
+sourced from the GCS (actors, nodes, PGs, jobs, task events) and from each
+raylet (objects, workers), exactly the reference's GCS + per-node-agent split.
+
+Filters are ``(key, op, value)`` tuples with op in {"=", "!="} — the subset
+the reference CLI uses most.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+Filter = Tuple[str, str, Any]
+
+
+def _apply_filters(rows: List[dict], filters: Optional[Sequence[Filter]]) -> List[dict]:
+    if not filters:
+        return rows
+    out = []
+    for r in rows:
+        ok = True
+        for key, op, value in filters:
+            have = r.get(key)
+            have_s = have.hex() if hasattr(have, "hex") and not isinstance(have, (str, bytes)) else have
+            if op == "=":
+                ok = have_s == value or have == value
+            elif op == "!=":
+                ok = have_s != value and have != value
+            else:
+                raise ValueError(f"unsupported filter op {op!r} (use '=' or '!=')")
+            if not ok:
+                break
+        if ok:
+            out.append(r)
+    return out
+
+
+class StateApiClient:
+    """Talks to the GCS of the connected cluster (reference: StateApiClient)."""
+
+    def __init__(self, worker=None):
+        if worker is None:
+            from ray_tpu._private.worker import get_global_worker
+
+            worker = get_global_worker()
+        if worker is None:
+            raise RuntimeError("ray_tpu.init() must be called before using the state API")
+        self._w = worker
+
+    # -- GCS-backed listings -------------------------------------------
+
+    def list_nodes(self, filters=None, limit: int = 10000) -> List[dict]:
+        rows = self._w.gcs.call("GetAllNodeInfo", {}) or []
+        return _apply_filters(rows, filters)[:limit]
+
+    def list_actors(self, filters=None, limit: int = 10000) -> List[dict]:
+        rows = self._w.gcs.call("ListActors", {}) or []
+        return _apply_filters(rows, filters)[:limit]
+
+    def list_placement_groups(self, filters=None, limit: int = 10000) -> List[dict]:
+        rows = self._w.gcs.call("ListPlacementGroups", {}) or []
+        return _apply_filters(rows, filters)[:limit]
+
+    def list_jobs(self, filters=None, limit: int = 10000) -> List[dict]:
+        rows = self._w.gcs.call("ListJobs", {}) or []
+        return _apply_filters(rows, filters)[:limit]
+
+    def list_tasks(self, filters=None, limit: int = 10000) -> List[dict]:
+        """Latest state per (task_id, attempt), folded from the task-event log
+        (reference: GcsTaskManager)."""
+        events = self._w.gcs.call("ListTaskEvents", {"limit": 100000}) or []
+        folded: Dict[Tuple[str, int], dict] = {}
+        for ev in events:
+            key = (ev["task_id"], ev.get("attempt", 0))
+            row = folded.setdefault(
+                key,
+                {
+                    "task_id": ev["task_id"],
+                    "attempt": ev.get("attempt", 0),
+                    "name": ev.get("name"),
+                    "job_id": ev.get("job_id"),
+                    "actor_id": ev.get("actor_id"),
+                    "state": None,
+                    "creation_time": None,
+                    "start_time": None,
+                    "end_time": None,
+                    "node_id": None,
+                    "pid": None,
+                },
+            )
+            state, t = ev["state"], ev["time"]
+            if state == "SUBMITTED":
+                row["creation_time"] = t
+            elif state == "RUNNING":
+                row["start_time"] = t
+                row["node_id"] = ev.get("node_id")
+                row["pid"] = ev.get("pid")
+            elif state in ("FINISHED", "FAILED"):
+                row["end_time"] = t
+            order = {"SUBMITTED": 0, "RUNNING": 1, "FINISHED": 2, "FAILED": 2}
+            if row["state"] is None or order.get(state, 0) >= order.get(row["state"], 0):
+                row["state"] = state
+        rows = sorted(folded.values(), key=lambda r: (r["creation_time"] or 0))
+        return _apply_filters(rows, filters)[:limit]
+
+    # -- raylet-backed listings ----------------------------------------
+
+    def _each_raylet(self, method: str, payload: dict) -> List[dict]:
+        out = []
+        for node in self.list_nodes():
+            if node.get("state") == "DEAD":
+                continue
+            try:
+                reply = self._w.pool.get(tuple(node["address"])).call(method, payload, timeout=5)
+            except Exception:  # noqa: BLE001
+                continue
+            for row in reply or []:
+                row["node_id"] = node["node_id"]
+                out.append(row)
+        return out
+
+    def list_objects(self, filters=None, limit: int = 10000) -> List[dict]:
+        rows = self._each_raylet("ListObjects", {})
+        return _apply_filters(rows, filters)[:limit]
+
+    def list_workers(self, filters=None, limit: int = 10000) -> List[dict]:
+        rows = self._each_raylet("ListWorkers", {})
+        return _apply_filters(rows, filters)[:limit]
+
+    # -- summaries ------------------------------------------------------
+
+    def summarize_tasks(self) -> Dict[str, Dict[str, int]]:
+        """Per-function-name count by state (reference: `ray summary tasks`)."""
+        summary: Dict[str, Dict[str, int]] = {}
+        for t in self.list_tasks(limit=100000):
+            by_state = summary.setdefault(t["name"] or "?", {})
+            by_state[t["state"]] = by_state.get(t["state"], 0) + 1
+        return summary
+
+    def summarize_actors(self) -> Dict[str, Dict[str, int]]:
+        summary: Dict[str, Dict[str, int]] = {}
+        for a in self.list_actors(limit=100000):
+            by_state = summary.setdefault(a.get("class_name") or "?", {})
+            by_state[a["state"]] = by_state.get(a["state"], 0) + 1
+        return summary
+
+
+def _client() -> StateApiClient:
+    return StateApiClient()
+
+
+def list_nodes(filters=None, limit: int = 10000):
+    return _client().list_nodes(filters, limit)
+
+
+def list_actors(filters=None, limit: int = 10000):
+    return _client().list_actors(filters, limit)
+
+
+def list_tasks(filters=None, limit: int = 10000):
+    return _client().list_tasks(filters, limit)
+
+
+def list_objects(filters=None, limit: int = 10000):
+    return _client().list_objects(filters, limit)
+
+
+def list_placement_groups(filters=None, limit: int = 10000):
+    return _client().list_placement_groups(filters, limit)
+
+
+def list_jobs(filters=None, limit: int = 10000):
+    return _client().list_jobs(filters, limit)
+
+
+def list_workers(filters=None, limit: int = 10000):
+    return _client().list_workers(filters, limit)
+
+
+def summarize_tasks():
+    return _client().summarize_tasks()
+
+
+def summarize_actors():
+    return _client().summarize_actors()

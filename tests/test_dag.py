@@ -257,3 +257,18 @@ def test_compiled_max_inflight(ray_start_regular):
         assert compiled.execute(10).get(timeout=30) == 11
     finally:
         compiled.teardown()
+
+
+def test_compiled_revisited_actor_no_deadlock(ray_start_regular):
+    """A -> B -> A in one iteration: A must send its first op's output
+    before blocking on the channel B feeds (interleaved recv schedule)."""
+    a = Adder.remote(1)
+    b = Adder.remote(10)
+    with InputNode() as inp:
+        dag = a.add.bind(b.add.bind(a.add.bind(inp)))
+    compiled = dag.experimental_compile()
+    try:
+        for i in range(5):
+            assert compiled.execute(i).get(timeout=30) == i + 12
+    finally:
+        compiled.teardown()

@@ -1,0 +1,168 @@
+"""State API, user metrics, and timeline export.
+
+reference test models: python/ray/tests/test_state_api.py,
+test_metrics_agent.py, test_advanced (ray.timeline).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def test_state_api_tasks_and_nodes(ray_start_regular):
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    refs = [f.remote(i) for i in range(5)]
+    assert ray_tpu.get(refs) == [1, 2, 3, 4, 5]
+    ray_tpu.get_runtime_context()  # touch
+
+    from ray_tpu.util.state import list_nodes, list_tasks, summarize_tasks
+
+    nodes = list_nodes()
+    assert len(nodes) == 1 and nodes[0]["state"] == "ALIVE"
+
+    # owner-side FINISHED events are flushed lazily; force the flush
+    from ray_tpu._private.worker import get_global_worker
+
+    get_global_worker().flush_task_events()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        tasks = [t for t in list_tasks() if t["name"] == "f"]
+        if len(tasks) == 5 and all(t["state"] == "FINISHED" for t in tasks):
+            break
+        time.sleep(0.05)
+    tasks = [t for t in list_tasks() if t["name"] == "f"]
+    assert len(tasks) == 5
+    assert all(t["state"] == "FINISHED" for t in tasks)
+    # executor-side RUNNING events carry pid + start_time
+    assert all(t["start_time"] is not None and t["pid"] for t in tasks)
+
+    summ = summarize_tasks()
+    assert summ["f"]["FINISHED"] == 5
+
+
+def test_state_api_actors_objects_workers(ray_start_regular):
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return "pong"
+
+    a = A.remote()
+    assert ray_tpu.get(a.ping.remote()) == "pong"
+
+    import numpy as np
+
+    big = ray_tpu.put(np.zeros(1 << 20, dtype=np.uint8))  # forces plasma
+
+    from ray_tpu.util.state import (
+        list_actors,
+        list_jobs,
+        list_objects,
+        list_placement_groups,
+        list_workers,
+        summarize_actors,
+    )
+
+    actors = list_actors([("state", "=", "ALIVE")])
+    assert len(actors) == 1 and actors[0]["class_name"].startswith("A")
+    assert summarize_actors()["A"]["ALIVE"] == 1
+
+    objs = list_objects()
+    assert any(o["size"] and o["size"] >= (1 << 20) for o in objs)
+
+    workers = list_workers()
+    assert len(workers) >= 1
+
+    jobs = list_jobs()
+    assert len(jobs) == 1 and jobs[0]["state"] == "RUNNING"
+
+    from ray_tpu.util.placement_group import placement_group
+
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    pg.ready(timeout=10)
+    pgs = list_placement_groups()
+    assert len(pgs) == 1 and pgs[0]["state"] == "CREATED"
+    del big
+
+
+def test_metrics_counter_gauge_histogram(ray_start_regular):
+    from ray_tpu.util.metrics import Counter, Gauge, Histogram, collect_cluster, prometheus_text
+
+    c = Counter("test_requests_total", description="reqs", tag_keys=("route",))
+    c.inc(1, tags={"route": "/a"})
+    c.inc(2, tags={"route": "/a"})
+    c.inc(5, tags={"route": "/b"})
+    with pytest.raises(ValueError):
+        c.inc(0)
+    with pytest.raises(ValueError):
+        c.inc(1, tags={"bogus": "x"})
+
+    g = Gauge("test_inflight", tag_keys=())
+    g.set(3.0)
+    g.set(7.0)
+
+    h = Histogram("test_latency_s", boundaries=[0.1, 1.0], tag_keys=())
+    for v in (0.05, 0.5, 5.0):
+        h.observe(v)
+
+    points = collect_cluster()
+    by_name = {}
+    for p in points:
+        by_name.setdefault(p["name"], []).append(p)
+    counts = {tuple(sorted(p["tags"].items())): p["value"] for p in by_name["test_requests_total"]}
+    assert counts[(("route", "/a"),)] == 3
+    assert counts[(("route", "/b"),)] == 5
+    assert by_name["test_inflight"][0]["value"] == 7.0
+    hist = by_name["test_latency_s"][0]
+    assert hist["buckets"] == [1, 1, 1] and hist["count"] == 3
+
+    text = prometheus_text(points)
+    assert '# TYPE test_requests_total counter' in text
+    assert 'test_requests_total{route="/a"} 3' in text
+    assert "test_latency_s_bucket" in text
+    assert "test_latency_s_count 3" in text
+
+
+def test_metrics_from_remote_task(ray_start_regular):
+    @ray_tpu.remote
+    def work():
+        from ray_tpu.util.metrics import Counter, push_to_gcs
+
+        c = Counter("remote_work_total")
+        c.inc(4)
+        push_to_gcs()
+        return True
+
+    assert ray_tpu.get(work.remote())
+    from ray_tpu.util.metrics import collect_cluster
+
+    points = [p for p in collect_cluster() if p["name"] == "remote_work_total"]
+    assert points and points[0]["value"] == 4
+
+
+def test_timeline_export(ray_start_regular, tmp_path):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(0.05)
+        return 1
+
+    ray_tpu.get([slow.remote() for _ in range(3)])
+    out = tmp_path / "trace.json"
+    deadline = time.monotonic() + 5
+    events = []
+    while time.monotonic() < deadline:
+        events = [e for e in ray_tpu.timeline(str(out)) if e["name"] == "slow"]
+        if len(events) == 3:
+            break
+        time.sleep(0.05)
+    assert len(events) == 3
+    for e in events:
+        assert e["ph"] == "X"
+        assert e["dur"] >= 0.04 * 1e6
+    import json
+
+    assert json.loads(out.read_text())
