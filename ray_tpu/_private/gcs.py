@@ -667,6 +667,11 @@ class GcsServer:
             self.metrics_by_reporter[req["reporter"]] = {
                 "points": req["points"], "time": req.get("time"),
             }
+            # bound memory across worker churn: evict stalest reporters
+            while len(self.metrics_by_reporter) > 512:
+                stalest = min(self.metrics_by_reporter,
+                              key=lambda r: self.metrics_by_reporter[r]["time"] or 0)
+                del self.metrics_by_reporter[stalest]
         return True
 
     def HandleCollectMetrics(self, req):
@@ -681,7 +686,10 @@ class GcsServer:
         gauge_time: dict = {}
         for report_time, points in snapshots:
             for p in points:
-                key = (p["name"], tuple(sorted(p.get("tags", {}).items())))
+                # histograms additionally keyed by boundaries so reporters
+                # with mismatched bucket layouts never get zip-truncated
+                key = (p["name"], tuple(sorted(p.get("tags", {}).items())),
+                       tuple(p.get("boundaries") or ()))
                 cur = agg.get(key)
                 if cur is None:
                     agg[key] = dict(p)

@@ -150,6 +150,36 @@ def _compiled_dag_actor_loop(instance, program):
             return "closed"
 
 
+def _dag_drain_loop(dag_ref, output_channels, multi_output):
+    """Drain thread body; holds the CompiledDAG only weakly (channels are
+    captured strongly — they don't reference the DAG).  When the DAG is
+    GC'd its finalizer closes the channels and the pending read unblocks."""
+    try:
+        while True:
+            outs = [ch.read() for _, ch in output_channels]
+            dag = dag_ref()
+            if dag is None:
+                return
+            with dag._result_cv:
+                dag._result_cache[dag._next_result_idx] = (
+                    outs if multi_output else outs[0])
+                dag._next_result_idx += 1
+                dag._result_cv.notify_all()
+            del dag
+    except ChannelClosed:
+        dag = dag_ref()
+        if dag is not None:
+            with dag._result_cv:
+                dag._result_cv.notify_all()
+    except Exception as e:  # noqa: BLE001 — surface to waiters, don't hang
+        logger.exception("compiled-dag drain thread failed")
+        dag = dag_ref()
+        if dag is not None:
+            with dag._result_cv:
+                dag._drain_error = e
+                dag._result_cv.notify_all()
+
+
 def _close_and_destroy_channels(channels):
     """GC/exit-time cleanup; must not reference the CompiledDAG instance."""
     for ch in channels:
@@ -200,9 +230,12 @@ class CompiledDAG:
         self._build(root)
         # Drain leaf channels continuously so deep pipelined submission can't
         # deadlock (driver blocked writing inputs while actors block writing
-        # undrained outputs); max_inflight bounds the cache instead.
+        # undrained outputs); max_inflight bounds the cache instead.  The
+        # thread references the DAG weakly so a dropped DAG stays GC-able.
         self._drain_thread = threading.Thread(
-            target=self._drain_loop, daemon=True, name="compiled-dag-drain")
+            target=_dag_drain_loop,
+            args=(weakref.ref(self), self._output_channels, self._multi_output),
+            daemon=True, name="compiled-dag-drain")
         self._drain_thread.start()
         # weakref.finalize (not atexit.register(self.teardown)) so the DAG
         # stays GC-able: runs at collection time or interpreter exit and only
@@ -383,24 +416,6 @@ class CompiledDAG:
             for ch in self._input_channels.values():
                 ch.write_bytes(payload)
         return CompiledDAGRef(self, idx)
-
-    def _drain_loop(self):
-        try:
-            while True:
-                outs = [ch.read() for _, ch in self._output_channels]
-                with self._result_cv:
-                    self._result_cache[self._next_result_idx] = (
-                        outs if self._multi_output else outs[0])
-                    self._next_result_idx += 1
-                    self._result_cv.notify_all()
-        except ChannelClosed:
-            with self._result_cv:
-                self._result_cv.notify_all()
-        except Exception as e:  # noqa: BLE001 — surface to waiters, don't hang
-            logger.exception("compiled-dag drain thread failed")
-            with self._result_cv:
-                self._drain_error = e
-                self._result_cv.notify_all()
 
     def _get_result(self, idx: int, timeout: Optional[float]):
         deadline = None if timeout is None else time.monotonic() + timeout

@@ -6,5 +6,6 @@ benchmark, and serving paths share one sharding-aware implementation.
 """
 
 from ray_tpu.models.llama import LlamaConfig, forward, init_params, loss_fn, param_specs
+from ray_tpu.models.moe import MoEConfig
 
-__all__ = ["LlamaConfig", "forward", "init_params", "loss_fn", "param_specs"]
+__all__ = ["LlamaConfig", "MoEConfig", "forward", "init_params", "loss_fn", "param_specs"]

@@ -6,6 +6,8 @@ Canonical mesh axes (outermost to innermost, i.e. DCN-most to ICI-most):
             (DCN) in multi-slice deployments.
   fsdp    — data parallelism with parameters/optimizer sharded over the axis
             (XLA inserts per-layer all-gathers / reduce-scatters).
+  expert  — expert parallelism for MoE layers; token dispatch/combine
+            lowers to XLA all-to-alls over this axis (ray_tpu.models.moe).
   context — sequence (context) parallelism; ring attention rides neighbour
             ICI links (ray_tpu.ops.ring_attention).
   tensor  — megatron-style tensor parallelism; highest-traffic axis, mapped
@@ -24,7 +26,7 @@ from typing import Optional, Sequence
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-MESH_AXES = ("data", "fsdp", "context", "tensor")
+MESH_AXES = ("data", "fsdp", "expert", "context", "tensor")
 
 # batch dims of activations/token arrays are sharded over both DP axes
 BATCH_AXES = ("data", "fsdp")
@@ -36,17 +38,18 @@ class MeshSpec:
 
     data: int = 1
     fsdp: int = 1
+    expert: int = 1
     context: int = 1
     tensor: int = 1
 
     @property
     def num_devices(self) -> int:
-        return self.data * self.fsdp * self.context * self.tensor
+        return self.data * self.fsdp * self.expert * self.context * self.tensor
 
     def build(self, devices: Optional[Sequence] = None) -> Mesh:
         if devices is None:
             devices = jax.devices()
-        shape = (self.data, self.fsdp, self.context, self.tensor)
+        shape = (self.data, self.fsdp, self.expert, self.context, self.tensor)
         if math.prod(shape) != len(devices):
             raise ValueError(
                 f"mesh {shape} needs {math.prod(shape)} devices, have {len(devices)}"

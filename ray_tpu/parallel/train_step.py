@@ -31,6 +31,16 @@ class TrainState(NamedTuple):
     opt_state: Any
 
 
+def _model_module(cfg):
+    """Model-family dispatch: each module exposes init_params / param_specs /
+    loss_fn / flops_per_token (+ optional ACTIVATION_BATCH_AXES)."""
+    from ray_tpu.models import moe as moe_mod
+
+    if isinstance(cfg, moe_mod.MoEConfig):
+        return moe_mod
+    return llama
+
+
 def _opt_state_specs(optimizer, params_shapes, param_spec_tree):
     """PartitionSpec tree for the optimizer state.
 
@@ -66,22 +76,24 @@ def make_train_step(
     init_fn(key) -> TrainState (sharded over `mesh` if given)
     step_fn(state, tokens) -> (TrainState, metrics dict)
     """
+    model = _model_module(cfg)
+    batch_axes = getattr(model, "ACTIVATION_BATCH_AXES", BATCH_AXES)
     if optimizer is None:
         optimizer = optax.adamw(
             learning_rate, b1=0.9, b2=0.95, weight_decay=0.1, mu_dtype=jnp.float32
         )
     if loss is None:
-        loss = llama.loss_fn
+        loss = model.loss_fn
 
     from ray_tpu.ops.rope import rope_frequencies
 
     cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
     rope_cache = (jnp.asarray(cos), jnp.asarray(sin))
 
-    pspecs = llama.param_specs(cfg)
+    pspecs = model.param_specs(cfg)
 
     def init_fn_raw(key):
-        params = llama.init_params(cfg, key)
+        params = model.init_params(cfg, key)
         return TrainState(jnp.zeros((), jnp.int32), params, optimizer.init(params))
 
     def step_fn_raw(state, tokens):
@@ -104,12 +116,12 @@ def make_train_step(
     if mesh is None:
         return jax.jit(init_fn_raw), jax.jit(step_fn_raw, donate_argnums=0)
 
-    params_shapes = jax.eval_shape(lambda k: llama.init_params(cfg, k), jax.random.PRNGKey(0))
+    params_shapes = jax.eval_shape(lambda k: model.init_params(cfg, k), jax.random.PRNGKey(0))
     opt_specs = _opt_state_specs(optimizer, params_shapes, pspecs)
     state_specs = TrainState(P(), pspecs, opt_specs)
     state_shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), state_specs)
     batch_sharding = NamedSharding(
-        mesh, P(BATCH_AXES, "context" if context_parallel else None)
+        mesh, P(batch_axes, "context" if context_parallel else None)
     )
     metric_sharding = {
         "loss": NamedSharding(mesh, P()),

@@ -122,15 +122,17 @@ class Histogram(Metric):
     def __init__(self, name: str, description: str = "",
                  boundaries: Optional[Sequence[float]] = None,
                  tag_keys: Optional[Sequence[str]] = None):
+        # _hist/boundaries must exist before super().__init__ publishes the
+        # instance into the registry (a concurrent collect_local() snapshots).
+        self.boundaries = sorted(boundaries or DEFAULT_HISTOGRAM_BOUNDARIES)
         with _REGISTRY_LOCK:
             prior = _REGISTRY.get(name)
-        super().__init__(name, description, tag_keys)
-        self.boundaries = sorted(boundaries or DEFAULT_HISTOGRAM_BOUNDARIES)
         if isinstance(prior, Histogram) and prior.boundaries == self.boundaries:
             self._hist = prior._hist
         else:
             # per-tagset: (bucket counts, sum, count)
             self._hist: Dict[Tuple, List] = {}
+        super().__init__(name, description, tag_keys)
 
     def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
         self._check_tags(tags)
@@ -178,10 +180,13 @@ def push_to_gcs():
         return
     points = collect_local()
     if points:
-        w.gcs.notify(
+        # call() (not notify) so the push is ordered before any subsequent
+        # CollectMetrics — collect_cluster() must see its own flush.
+        w.gcs.call(
             "ReportMetrics",
             {"reporter": f"{w.address[0]}:{w.address[1]}", "points": points,
              "time": time.time()},
+            timeout=10,
         )
 
 
@@ -196,10 +201,14 @@ def collect_cluster() -> List[dict]:
     return w.gcs.call("CollectMetrics", {}) or []
 
 
+def _escape_label(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
 def _fmt_tags(tags: Dict[str, str]) -> str:
     if not tags:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in sorted(tags.items()))
+    inner = ",".join(f'{k}="{_escape_label(v)}"' for k, v in sorted(tags.items()))
     return "{" + inner + "}"
 
 
