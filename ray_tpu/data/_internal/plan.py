@@ -216,20 +216,31 @@ class ExecutionPlan:
                 opts["num_cpus"] = op.resources["CPU"]
         worker_cls = ray_tpu.remote(_ActorPoolWorker).options(**opts)
         actors = [worker_cls.remote(op.fn_constructor) for _ in range(pool_size)]
+        yielded: List[Any] = []
         try:
             free = deque(actors)
             in_flight: deque = deque()  # (ref, actor)
             for ref in upstream:
                 while not free:
                     done_ref, actor = in_flight.popleft()
+                    yielded.append(done_ref)
                     yield done_ref
                     free.append(actor)
                 actor = free.popleft()
                 in_flight.append((actor.apply.remote(fns_before, ref), actor))
             while in_flight:
                 done_ref, actor = in_flight.popleft()
+                yielded.append(done_ref)
                 yield done_ref
         finally:
+            # Refs handed downstream may still be executing on the pool —
+            # killing an actor mid-task would fail the consumer's get with
+            # ActorDiedError.  Never-yielded in-flight work (consumer went
+            # away) is killed immediately; nobody will read it.
+            try:
+                ray_tpu.wait(yielded, num_returns=len(yielded), timeout=300)
+            except Exception:  # noqa: BLE001
+                pass
             for a in actors:
                 try:
                     ray_tpu.kill(a)

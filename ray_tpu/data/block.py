@@ -35,7 +35,13 @@ def to_arrow(block: Block) -> pa.Table:
     if isinstance(block, pd.DataFrame):
         return pa.Table.from_pandas(block, preserve_index=False)
     if isinstance(block, dict):
-        return pa.table({k: pa.array(np.asarray(v)) for k, v in block.items()})
+        cols = {}
+        for k, v in block.items():
+            if isinstance(v, (list, tuple)):
+                cols[k] = pa.array(list(v))  # ragged lists -> ListArray
+            else:
+                cols[k] = pa.array(np.asarray(v))
+        return pa.table(cols)
     if isinstance(block, list):  # list of row-dicts
         return pa.Table.from_pylist(block)
     raise TypeError(f"cannot convert {type(block)} to an Arrow block")
@@ -56,6 +62,9 @@ def block_to_batch(block: Block, batch_format: str):
         return t.to_pandas()
     if batch_format in ("numpy", "default"):
         return {name: col.to_numpy(zero_copy_only=False) for name, col in
+                zip(t.column_names, t.columns)}
+    if batch_format == "pydict":  # plain python lists (ragged-friendly)
+        return {name: col.to_pylist() for name, col in
                 zip(t.column_names, t.columns)}
     raise ValueError(f"unknown batch_format {batch_format!r}")
 

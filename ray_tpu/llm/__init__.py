@@ -1,0 +1,23 @@
+"""LLM toolkit: batch inference + serving on the framework's JAX engine.
+
+reference: python/ray/llm/ (~20.8k LoC) — batch Processor/stages and
+LLMServer deployments on vLLM.  Here the engine is framework-native
+(ray_tpu.llm.engine.JaxLLMEngine): KV-cache decode with continuous
+batching, jitted prefill/decode, mesh-based parallelism degrees.
+"""
+
+from ray_tpu.llm.batch import Processor, ProcessorConfig, build_llm_processor
+from ray_tpu.llm.config import GenerationConfig, LLMConfig
+from ray_tpu.llm.engine import JaxLLMEngine
+from ray_tpu.llm.serve import LLMServer, build_llm_deployment
+
+__all__ = [
+    "GenerationConfig",
+    "JaxLLMEngine",
+    "LLMConfig",
+    "LLMServer",
+    "Processor",
+    "ProcessorConfig",
+    "build_llm_deployment",
+    "build_llm_processor",
+]

@@ -40,6 +40,6 @@ class LLMConfig:
         if chips is None:
             chips = self.tensor_parallel_size * self.data_parallel_size
         res: Dict[str, float] = {"CPU": 1.0}
-        if chips > 1 or self.chips_per_replica is not None:
+        if chips > 0 and (chips > 1 or self.chips_per_replica is not None):
             res["TPU"] = float(chips)
         return res

@@ -1,0 +1,233 @@
+"""JAX LLM inference engine: KV-cache decode with continuous batching.
+
+The reference delegates serving to vLLM and reserves matching placement
+groups (reference: llm/_internal/serve/deployments/llm/vllm/vllm_models.py
+:177-186, :241-259).  Here the engine itself is framework-native and
+TPU-first:
+
+  - static-shape KV cache with `max_batch` sequence slots; one jitted
+    decode program advances EVERY active slot one token per step
+    (continuous batching — new requests join the running batch at any
+    step by prefilling into a free slot, no generation restart)
+  - prefill jitted per bucketed prompt length (powers of two) so arrival
+    order doesn't cause recompiles
+  - sampling (greedy / temperature / top-k) inside the jitted program;
+    only sampled token ids cross the host boundary each step
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import threading
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.llm.config import GenerationConfig, LLMConfig
+from ray_tpu.models import llama
+from ray_tpu.ops.rope import rope_frequencies
+
+
+@dataclasses.dataclass
+class _Request:
+    request_id: int
+    prompt: List[int]
+    gen: GenerationConfig
+    out_tokens: List[int] = dataclasses.field(default_factory=list)
+    done: bool = False
+    slot: int = -1
+    error: Optional[str] = None
+
+
+def _sample(logits, key, temps, top_ks):
+    """Sample [B] token ids from [B, V] logits with *per-slot* traced
+    sampling params — one compiled program serves any mix of greedy /
+    temperature / top-k callers sharing the decode batch.
+
+    temps [B] float32 (<= 0 -> greedy); top_ks [B] int32 (<= 0 -> off).
+    """
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    t = jnp.maximum(temps, 1e-6)[:, None]
+    scaled = logits / t
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+    idx = jnp.clip(top_ks - 1, 0, logits.shape[-1] - 1)
+    kth = jnp.take_along_axis(sorted_desc, idx[:, None], axis=-1)
+    masked = jnp.where((top_ks[:, None] > 0) & (scaled < kth), -1e30, scaled)
+    sampled = jax.random.categorical(key, masked, axis=-1).astype(jnp.int32)
+    return jnp.where(temps <= 0.0, greedy, sampled)
+
+
+class JaxLLMEngine:
+    """Single-process engine owning params + cache on device.
+
+    API: ``add_request() -> id``, ``step() -> {id: [new tokens]}``,
+    ``generate()`` (sync convenience driving step() to completion).
+    """
+
+    def __init__(self, config: LLMConfig, params=None, *, key=None):
+        self.config = config
+        cfg = config.model_config
+        if cfg is None:
+            raise ValueError("LLMConfig.model_config is required")
+        self.cfg = cfg
+        self.max_batch = config.max_batch_size
+        self.max_seq = config.max_seq_len or cfg.max_seq_len
+        if params is None:
+            params = llama.init_params(cfg, key or jax.random.PRNGKey(0))
+        self.params = params
+        cos, sin = rope_frequencies(cfg.head_dim, self.max_seq, cfg.rope_theta)
+        self._rope = (jnp.asarray(cos), jnp.asarray(sin))
+
+        self.cache = llama.init_kv_cache(cfg, self.max_batch, self.max_seq)
+        # host-side slot state
+        self._slot_req: List[Optional[_Request]] = [None] * self.max_batch
+        self._lengths = np.zeros(self.max_batch, np.int32)
+        self._next_tok = np.zeros(self.max_batch, np.int32)
+        self._slot_temp = np.zeros(self.max_batch, np.float32)
+        self._slot_topk = np.zeros(self.max_batch, np.int32)
+        self._pending: List[_Request] = []
+        self._requests: Dict[int, _Request] = {}
+        self._req_counter = 0
+        self._lock = threading.Lock()
+        self._key = jax.random.PRNGKey(config.model_config.vocab_size)
+
+        self._decode = jax.jit(self._decode_impl, donate_argnums=1)
+        self._prefill_cached: Dict[int, Callable] = {}
+        self._write_slot = jax.jit(llama.write_cache_slot, donate_argnums=0)
+
+    # -- jitted programs ------------------------------------------------
+
+    def _decode_impl(self, tokens, cache, lengths, key, temps, top_ks):
+        logits, cache = llama.decode_step(
+            self.cfg, self.params, tokens, cache, lengths, rope_cache=self._rope)
+        ids = _sample(logits, key, temps, top_ks)
+        return ids, cache
+
+    def _prefill_fn(self, bucket: int):
+        fn = self._prefill_cached.get(bucket)
+        if fn is None:
+            def run(tokens, length, key, temps, top_ks):
+                logits, kv = llama.prefill(
+                    self.cfg, self.params, tokens, rope_cache=self._rope)
+                last = logits[jnp.arange(tokens.shape[0]), length - 1]
+                ids = _sample(last, key, temps, top_ks)
+                return ids, kv
+
+            fn = self._prefill_cached[bucket] = jax.jit(run)
+        return fn
+
+    # -- request lifecycle ---------------------------------------------
+
+    def add_request(self, prompt: Sequence[int],
+                    gen: Optional[GenerationConfig] = None) -> int:
+        gen = gen or GenerationConfig()
+        if len(prompt) == 0:
+            raise ValueError("empty prompt")
+        if len(prompt) + gen.max_new_tokens > self.max_seq:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens ({gen.max_new_tokens})"
+                f" exceeds max_seq_len {self.max_seq}")
+        with self._lock:
+            self._req_counter += 1
+            req = _Request(self._req_counter, list(prompt), gen)
+            self._requests[req.request_id] = req
+            self._pending.append(req)
+            return req.request_id
+
+    def has_work(self) -> bool:
+        with self._lock:
+            return bool(self._pending) or any(
+                r is not None for r in self._slot_req)
+
+    def _admit_locked(self):
+        """Prefill pending requests into free slots (continuous batching)."""
+        for slot in range(self.max_batch):
+            if not self._pending or self._slot_req[slot] is not None:
+                continue
+            req = self._pending.pop(0)
+            plen = len(req.prompt)
+            bucket = 1 << max(3, math.ceil(math.log2(plen)))
+            bucket = min(bucket, self.max_seq)
+            tokens = np.zeros((1, bucket), np.int32)
+            tokens[0, :plen] = req.prompt
+            self._key, sub = jax.random.split(self._key)
+            ids, kv = self._prefill_fn(bucket)(
+                jnp.asarray(tokens), jnp.asarray([plen]), sub,
+                jnp.asarray([req.gen.temperature], jnp.float32),
+                jnp.asarray([req.gen.top_k], jnp.int32))
+            self.cache = self._write_slot(self.cache, kv, slot)
+            first = int(ids[0])
+            req.slot = slot
+            self._slot_req[slot] = req
+            self._lengths[slot] = plen
+            self._next_tok[slot] = first
+            self._slot_temp[slot] = req.gen.temperature
+            self._slot_topk[slot] = req.gen.top_k
+            self._emit_locked(req, first)
+
+    def _emit_locked(self, req: _Request, token: int):
+        req.out_tokens.append(token)
+        if (token in req.gen.stop_token_ids
+                or len(req.out_tokens) >= req.gen.max_new_tokens
+                or self._lengths[req.slot] + 1 >= self.max_seq):
+            req.done = True
+            self._slot_req[req.slot] = None
+            self._lengths[req.slot] = 0
+            req.slot = -1
+
+    def step(self) -> Dict[int, List[int]]:
+        """Admit pending, advance every active slot one token.
+
+        Returns {request_id: [tokens emitted this step]}.
+        """
+        emitted: Dict[int, List[int]] = {}
+        with self._lock:
+            before = {id(r): len(r.out_tokens)
+                      for r in self._requests.values()}
+            self._admit_locked()
+            active = [s for s in range(self.max_batch)
+                      if self._slot_req[s] is not None]
+            if active:
+                # one decode program for the whole batch; sampling params are
+                # traced per-slot arrays, so mixed greedy/temperature/top-k
+                # callers share a single forward
+                self._key, sub = jax.random.split(self._key)
+                ids, self.cache = self._decode(
+                    jnp.asarray(self._next_tok), self.cache,
+                    jnp.asarray(self._lengths), sub,
+                    jnp.asarray(self._slot_temp), jnp.asarray(self._slot_topk))
+                ids = np.asarray(ids)
+                for s in active:
+                    req = self._slot_req[s]
+                    self._lengths[s] += 1
+                    tok = int(ids[s])
+                    self._next_tok[s] = tok
+                    self._emit_locked(req, tok)
+            for req in list(self._requests.values()):
+                n0 = before.get(id(req), 0)
+                if len(req.out_tokens) > n0:
+                    emitted[req.request_id] = req.out_tokens[n0:]
+                if req.done:
+                    del self._requests[req.request_id]
+        return emitted
+
+    # -- sync convenience ----------------------------------------------
+
+    def generate(self, prompts: Sequence[Sequence[int]],
+                 gen: Optional[GenerationConfig] = None) -> List[List[int]]:
+        """Generate for a batch of prompts, driving step() to completion."""
+        ids = [self.add_request(p, gen) for p in prompts]
+        results: Dict[int, List[int]] = {i: [] for i in ids}
+        waiting = set(ids)
+        while waiting and self.has_work():
+            emitted = self.step()
+            for rid, toks in emitted.items():
+                if rid in results:
+                    results[rid].extend(toks)
+            with self._lock:
+                waiting = {rid for rid in waiting if rid in self._requests}
+        return [results[i] for i in ids]

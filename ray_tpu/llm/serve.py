@@ -1,0 +1,113 @@
+"""LLM serving: deployment wrapping the JAX engine with continuous batching.
+
+reference: python/ray/llm/_internal/serve/deployments/llm/ — LLMServer
+deployments on vLLM with per-replica placement groups sized from the
+engine's TP/PP degrees (vllm_models.py:177-186, :241-259).  Here the
+replica owns a JaxLLMEngine; concurrent requests enqueue into the engine
+and a background thread drives ``engine.step()``, so all in-flight
+requests share one decode batch (continuous batching across callers).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence  # noqa: F401
+
+from ray_tpu.llm.config import GenerationConfig, LLMConfig
+
+
+class LLMServer:
+    """Deployment callable; bind with serve: see ``build_llm_deployment``."""
+
+    def __init__(self, llm_config: LLMConfig, params=None):
+        from ray_tpu.llm.engine import JaxLLMEngine
+
+        self._engine = JaxLLMEngine(llm_config, params)
+        self._cv = threading.Condition()
+        self._done: Dict[int, List[int]] = {}
+        self._waiters: Dict[int, List[int]] = {}
+        self._stop = False
+        self._error: Optional[BaseException] = None
+        self._loop = threading.Thread(target=self._run, daemon=True,
+                                      name="llm-engine-loop")
+        self._loop.start()
+
+    def _run(self):
+        while not self._stop:
+            if not self._engine.has_work():
+                time.sleep(0.002)
+                continue
+            try:
+                emitted = self._engine.step()
+            except BaseException as e:  # noqa: BLE001 — fail waiters, not hang
+                with self._cv:
+                    self._error = e
+                    self._cv.notify_all()
+                return
+            if emitted:
+                with self._cv:
+                    for rid, toks in emitted.items():
+                        self._waiters.setdefault(rid, []).extend(toks)
+                    with self._engine._lock:
+                        live = set(self._engine._requests)
+                    for rid in list(self._waiters):
+                        if rid not in live:
+                            self._done[rid] = self._waiters.pop(rid)
+                    self._cv.notify_all()
+
+    def shutdown(self):
+        self._stop = True
+
+    def generate(self, prompt: Sequence[int],
+                 max_new_tokens: int = 64, temperature: float = 0.0,
+                 top_k: int = 0, stop_token_ids: Sequence[int] = ()) -> List[int]:
+        """Generate completion token ids for one prompt (sync; batching with
+        concurrent callers happens inside the engine)."""
+        gen = GenerationConfig(max_new_tokens=max_new_tokens,
+                               temperature=temperature, top_k=top_k,
+                               stop_token_ids=tuple(stop_token_ids))
+        rid = self._engine.add_request(list(prompt), gen)
+        with self._cv:
+            while rid not in self._done:
+                if self._error is not None:
+                    raise RuntimeError("LLM engine loop failed") from self._error
+                if self._stop:
+                    raise RuntimeError("LLM server shut down")
+                self._cv.wait(timeout=0.1)
+            return self._done.pop(rid)
+
+    def __call__(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        """HTTP-style entry: {"prompt": [ids], "max_new_tokens": n, ...}."""
+        toks = self.generate(
+            request["prompt"],
+            max_new_tokens=request.get("max_new_tokens", 64),
+            temperature=request.get("temperature", 0.0),
+            top_k=request.get("top_k", 0),
+            stop_token_ids=request.get("stop_token_ids", ()),
+        )
+        return {"tokens": toks}
+
+    def check_health(self) -> bool:
+        return self._loop.is_alive()
+
+
+def build_llm_deployment(llm_config: LLMConfig, params=None, *,
+                         name: str = "llm"):
+    """An Application serving ``llm_config`` (reference:
+    llm/_internal/serve build_openai_app / LLMServer deployment).
+
+    Replica resources follow the engine's parallelism degrees the way the
+    reference sizes placement groups from vLLM engine_kwargs.
+    """
+    from ray_tpu import serve
+
+    deployment = serve.deployment(
+        LLMServer,
+        name=name,
+        num_replicas=llm_config.num_replicas,
+        # concurrent callers share the engine's decode batch
+        max_ongoing_requests=max(8, llm_config.max_batch_size),
+        ray_actor_options={"resources": llm_config.resources_per_replica()},
+    )
+    return deployment.bind(llm_config, params)

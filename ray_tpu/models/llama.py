@@ -267,6 +267,125 @@ def loss_fn(
     return jnp.mean(nll)
 
 
+# ---------------------------------------------------------------------------
+# Incremental decoding (KV cache) — the compute path under ray_tpu.llm's
+# engine (reference analog: the vLLM engine Ray LLM delegates to,
+# llm/_internal/serve/deployments/llm/vllm/).  TPU-first: static cache
+# shapes [L, B, S_max, ...], per-slot scatter via .at[] (lowers to
+# dynamic-update-slice), one fused decode program for the whole batch.
+# ---------------------------------------------------------------------------
+
+
+def init_kv_cache(cfg: LlamaConfig, max_batch: int, max_seq: int,
+                  dtype=None) -> Dict[str, jnp.ndarray]:
+    """Static-shape KV cache for `max_batch` sequence slots."""
+    dtype = dtype or cfg.compute_dtype
+    shape = (cfg.n_layers, max_batch, max_seq, cfg.n_kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def prefill(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
+            rope_cache: Optional[tuple] = None):
+    """Full-sequence forward that also returns per-layer K/V.
+
+    tokens [B, S] -> (logits [B, S, V] fp32, kv {"k","v"} [L, B, S, kv, hd])
+    """
+    if rope_cache is None:
+        cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+        cos, sin = jnp.asarray(cos), jnp.asarray(sin)
+    else:
+        cos, sin = rope_cache
+    b, s = tokens.shape
+    cdt = cfg.compute_dtype
+    x = jnp.take(params["embed"], tokens, axis=0).astype(cdt)
+
+    def body(x, lp):
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        q = (h @ lp["wq"].astype(cdt)).reshape(b, s, cfg.n_heads, cfg.head_dim)
+        k = (h @ lp["wk"].astype(cdt)).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+        v = (h @ lp["wv"].astype(cdt)).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+        q = apply_rope(q, cos[:s], sin[:s])
+        k = apply_rope(k, cos[:s], sin[:s])
+        attn = multi_head_attention(q, k, v, causal=True)
+        attn = attn.reshape(b, s, cfg.n_heads * cfg.head_dim)
+        x = x + (attn @ lp["wo"].astype(cdt))
+        h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        ffn = (jax.nn.silu(h @ lp["w_gate"].astype(cdt))
+               * (h @ lp["w_up"].astype(cdt))) @ lp["w_down"].astype(cdt)
+        return x + ffn, (k, v)
+
+    x, (ks, vs) = lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = (x @ head.astype(cdt)).astype(jnp.float32)
+    return logits, {"k": ks, "v": vs}
+
+
+def write_cache_slot(cache: Dict[str, jnp.ndarray], kv: Dict[str, jnp.ndarray],
+                     slot: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+    """Write one prefilled sequence (batch dim 1) into cache slot `slot`."""
+    out = {}
+    for name in ("k", "v"):
+        out[name] = lax.dynamic_update_slice(
+            cache[name], kv[name].astype(cache[name].dtype),
+            (0, slot, 0, 0, 0))
+    return out
+
+
+def decode_step(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
+                cache: Dict[str, jnp.ndarray], lengths: jnp.ndarray,
+                rope_cache: Optional[tuple] = None):
+    """One-token decode for every cache slot.
+
+    tokens [B] int32 (the token at position lengths[b]); lengths [B] int32.
+    Returns (logits [B, V] fp32, updated cache).  Slots with lengths == 0
+    compute garbage but write only their own slot — callers mask them.
+    """
+    if rope_cache is None:
+        cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+        cos, sin = jnp.asarray(cos), jnp.asarray(sin)
+    else:
+        cos, sin = rope_cache
+    b = tokens.shape[0]
+    s_max = cache["k"].shape[2]
+    cdt = cfg.compute_dtype
+    group = cfg.n_heads // cfg.n_kv_heads
+    x = jnp.take(params["embed"], tokens, axis=0).astype(cdt)  # [B, d]
+    batch_idx = jnp.arange(b)
+    pos_mask = (jnp.arange(s_max)[None, :] <= lengths[:, None])  # [B, S]
+
+    def body(x, inp):
+        lp, ck, cv = inp  # ck/cv: [B, S, kv, hd]
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        q = (h @ lp["wq"].astype(cdt)).reshape(b, 1, cfg.n_heads, cfg.head_dim)
+        k = (h @ lp["wk"].astype(cdt)).reshape(b, 1, cfg.n_kv_heads, cfg.head_dim)
+        v = (h @ lp["wv"].astype(cdt)).reshape(b, 1, cfg.n_kv_heads, cfg.head_dim)
+        q = apply_rope(q, cos, sin, positions=lengths[:, None])[:, 0]  # [B,nh,hd]
+        k = apply_rope(k, cos, sin, positions=lengths[:, None])[:, 0]
+        ck = ck.at[batch_idx, lengths].set(k.astype(ck.dtype))
+        cv = cv.at[batch_idx, lengths].set(v[:, 0].astype(cv.dtype))
+        # GQA attention against the cache, masked to valid positions
+        qg = q.reshape(b, cfg.n_kv_heads, group, cfg.head_dim)
+        scores = jnp.einsum("bkgd,bskd->bkgs", qg.astype(jnp.float32),
+                            ck.astype(jnp.float32))
+        scores = scores / math.sqrt(cfg.head_dim)
+        scores = jnp.where(pos_mask[:, None, None, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("bkgs,bskd->bkgd", probs, cv.astype(jnp.float32))
+        attn = attn.reshape(b, cfg.n_heads * cfg.head_dim).astype(cdt)
+        x = x + attn @ lp["wo"].astype(cdt)
+        h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        ffn = (jax.nn.silu(h @ lp["w_gate"].astype(cdt))
+               * (h @ lp["w_up"].astype(cdt))) @ lp["w_down"].astype(cdt)
+        return x + ffn, (ck, cv)
+
+    x, (ks, vs) = lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = (x @ head.astype(cdt)).astype(jnp.float32)  # [B, V]
+    return logits, {"k": ks, "v": vs}
+
+
 def flops_per_token(cfg: LlamaConfig, seq_len: int) -> float:
     """Approximate training FLOPs/token (6N + attention term) for MFU math."""
     n = cfg.num_params
