@@ -61,6 +61,7 @@ class _PendingLease:
     reply_token: Any
     for_actor: bool
     enqueue_time: float = field(default_factory=time.monotonic)
+    warned_infeasible: bool = False
 
 
 @dataclass
@@ -347,15 +348,21 @@ class Raylet:
             # Pick best node cluster-wide; spill if it isn't us.
             best = self.cluster.get_best_schedulable_node(spec.resources, strategy, prefer_node=self.node_id)
             if best is None:
-                # Infeasible anywhere right now. If feasible on total of some
-                # node keep waiting, else reject.
-                if any(n.feasible(spec.resources) for n in self.cluster.nodes.values()):
-                    still_pending.append(p)
-                else:
-                    self.server.send_reply(
-                        p.reply_token,
-                        {"rejected": True, "reason": f"infeasible resources {spec.resources.to_dict()}"},
-                    )
+                # Not schedulable anywhere right now — keep it queued even if
+                # no current node could EVER fit it: queued demand is the
+                # autoscaler's scale-up signal (reference: infeasible tasks
+                # stay pending and appear in the GCS load report), and a new
+                # node may make it feasible.  Warn once so a cluster without
+                # an autoscaler doesn't hang silently.
+                if (not getattr(p, "warned_infeasible", False)
+                        and not any(n.feasible(spec.resources)
+                                    for n in self.cluster.nodes.values())):
+                    p.warned_infeasible = True
+                    logger.warning(
+                        "task %s demands %s, infeasible on every current node; "
+                        "it will hang unless the cluster scales up",
+                        spec.name, spec.resources.to_dict())
+                still_pending.append(p)
                 continue
             if best != self.node_id:
                 node = self.cluster.nodes.get(best)
@@ -688,6 +695,11 @@ class Raylet:
                 "num_workers": len(self._all_workers),
                 "idle_workers": len(self._idle_workers),
                 "pending_leases": len(self._pending_leases),
+                # resource shapes queued here — the autoscaler's demand signal
+                # (reference: autoscaler load reports via GCS)
+                "pending_demands": [
+                    p.spec.resources.to_dict() for p in self._pending_leases
+                ],
                 "active_leases": len(self._leases),
                 "resources": self.local_resources.snapshot(),
                 "object_store_used": self.store.used_bytes(),

@@ -322,11 +322,24 @@ class RpcClient:
             raise ConnectionLost(f"send to {self._address} failed")
         return fut
 
-    def call(self, method: str, payload: Any = None, timeout: Optional[float] = None,
+    _DEFAULT_TIMEOUT = object()
+
+    def call(self, method: str, payload: Any = None, timeout: Any = _DEFAULT_TIMEOUT,
              retry_deadline: Optional[float] = None) -> Any:
-        """Synchronous call with transparent reconnect-and-retry."""
-        timeout = timeout if timeout is not None else global_config().gcs_rpc_timeout_s
-        deadline = time.monotonic() + (retry_deadline if retry_deadline is not None else timeout)
+        """Synchronous call with transparent reconnect-and-retry.
+
+        timeout: seconds to wait for the reply; omitted -> the global GCS
+        RPC timeout; explicit ``None`` -> wait forever (lease requests and
+        task pushes legitimately block until resources free / tasks finish).
+        """
+        if timeout is RpcClient._DEFAULT_TIMEOUT:
+            timeout = global_config().gcs_rpc_timeout_s
+        if retry_deadline is not None:
+            deadline = time.monotonic() + retry_deadline
+        elif timeout is not None:
+            deadline = time.monotonic() + timeout
+        else:
+            deadline = float("inf")
         delay = 0.02
         while True:
             try:

@@ -386,7 +386,8 @@ class CoreWorker:
         for v in out:
             if isinstance(v, TaskError):
                 raise v.cause from None
-            if isinstance(v, Exception) and isinstance(v, (ActorDiedError, ObjectLostError, WorkerCrashedError, TaskCancelledError)):
+            if isinstance(v, (ActorDiedError, ActorUnavailableError, ObjectLostError,
+                              WorkerCrashedError, TaskCancelledError)):
                 raise v
         return out[0] if single else out
 
@@ -782,9 +783,16 @@ class CoreWorker:
         self._record_task_event(spec, "FINISHED")
 
     def _fail_task(self, spec: TaskSpec, error: Exception):
+        # Anything not already a raisable framework error gets wrapped in
+        # TaskError so ray_tpu.get RAISES it instead of returning it as the
+        # object's value (get only raises TaskError + the died/lost family).
+        if not isinstance(error, (TaskError, ActorDiedError, ObjectLostError,
+                                  WorkerCrashedError, TaskCancelledError,
+                                  ActorUnavailableError)):
+            error = TaskError(error, "", spec.name)
         with self._store_lock:
             for oid in spec.return_ids():
-                self.object_errors[oid] = error if isinstance(error, TaskError) else error
+                self.object_errors[oid] = error
                 self._store_cv.notify_all()
         self.task_manager.complete(spec.task_id)
         self._unpin_args(spec)

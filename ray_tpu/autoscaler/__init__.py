@@ -1,0 +1,22 @@
+"""Autoscaler: reconciler scaling node groups to pending resource demand.
+
+reference: python/ray/autoscaler/ — v1 StandardAutoscaler
+(_private/autoscaler.py:172) driven by load polling, v2 reconciler
+(v2/autoscaler.py:47, v2/scheduler.py:687) + NodeProvider plugins
+(including the GCP TPU provider, _private/gcp/node_provider.py:75-92).
+"""
+
+from ray_tpu.autoscaler.autoscaler import Autoscaler, NodeGroupSpec
+from ray_tpu.autoscaler.node_provider import (
+    InProcessNodeProvider,
+    NodeProvider,
+    TpuSliceNodeProvider,
+)
+
+__all__ = [
+    "Autoscaler",
+    "InProcessNodeProvider",
+    "NodeGroupSpec",
+    "NodeProvider",
+    "TpuSliceNodeProvider",
+]

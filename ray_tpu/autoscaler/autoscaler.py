@@ -1,0 +1,201 @@
+"""Autoscaler reconciler: demand-driven scale-up, idle-timeout scale-down.
+
+reference: autoscaler v2's reconcile loop (v2/autoscaler.py:47 Autoscaler,
+v2/scheduler.py:687 ResourceDemandScheduler) — each tick:
+
+  1. read pending resource demands (raylet lease queues, the analog of the
+     reference's GCS load report) and cluster capacity
+  2. bin-pack unmet demand against configured node-group types; launch the
+     cheapest covering groups (TPU groups are whole slices — atomic)
+  3. terminate groups whose nodes have all been idle past idle_timeout_s
+
+Runs inline (``reconcile_once``) for determinism in tests, or as a
+background thread (``start``) like the reference's monitor process.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ray_tpu.autoscaler.node_provider import NodeProvider
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class NodeGroupSpec:
+    """A launchable node-group type (reference: available_node_types in the
+    cluster YAML; for TPU, one group == one slice of `count` hosts)."""
+
+    name: str
+    node_resources: Dict[str, float]
+    count: int = 1  # nodes per group (slice hosts); atomic unit
+    min_groups: int = 0
+    max_groups: int = 10
+    labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+    def total(self, key: str) -> float:
+        return self.node_resources.get(key, 0.0) * self.count
+
+
+class Autoscaler:
+    def __init__(self, provider: NodeProvider, groups: List[NodeGroupSpec],
+                 *, worker=None, idle_timeout_s: float = 60.0,
+                 interval_s: float = 5.0):
+        if worker is None:
+            from ray_tpu._private.worker import get_global_worker
+
+            worker = get_global_worker()
+        self._w = worker
+        self._provider = provider
+        self._specs = {g.name: g for g in groups}
+        self._idle_timeout = idle_timeout_s
+        self._interval = interval_s
+        self._idle_since: Dict[str, float] = {}  # group_id -> first-idle ts
+        # demand shape -> last launch ts: a freshly launched group needs time
+        # to boot before its capacity absorbs the demand; don't launch again
+        # for the same shape within the cooldown
+        self._launch_cooldown_s = 30.0
+        self._recent_launches: Dict[tuple, float] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- observation ----------------------------------------------------
+
+    def _node_stats(self) -> Dict[str, dict]:
+        """node_id hex -> raylet stats for live nodes."""
+        stats = {}
+        for node in self._w.gcs.call("GetAllNodeInfo", {}) or []:
+            if node.get("state") == "DEAD":
+                continue
+            try:
+                s = self._w.pool.get(tuple(node["address"])).call(
+                    "GetNodeStats", {}, timeout=5)
+                stats[s["node_id"].hex()] = s
+            except Exception:  # noqa: BLE001
+                continue
+        return stats
+
+    def pending_demands(self, stats=None) -> List[Dict[str, float]]:
+        stats = stats if stats is not None else self._node_stats()
+        out: List[Dict[str, float]] = []
+        for s in stats.values():
+            out.extend(s.get("pending_demands") or [])
+        return out
+
+    # -- reconcile ------------------------------------------------------
+
+    def reconcile_once(self) -> Dict[str, list]:
+        """One tick; returns {"launched": [group names], "terminated": [ids]}."""
+        stats = self._node_stats()
+        launched, terminated = [], []
+
+        # 1. min_groups floors
+        live = self._provider.non_terminated_node_groups()
+        counts: Dict[str, int] = {}
+        for g in live.values():
+            counts[g["group_name"]] = counts.get(g["group_name"], 0) + 1
+        for spec in self._specs.values():
+            while counts.get(spec.name, 0) < spec.min_groups:
+                self._provider.create_node_group(
+                    spec.name, spec.node_resources, spec.count, spec.labels)
+                counts[spec.name] = counts.get(spec.name, 0) + 1
+                launched.append(spec.name)
+
+        # 2. unmet demand -> bin-pack group types (first-fit by shape)
+        demands = self.pending_demands(stats)
+        if demands:
+            now = time.monotonic()
+            for shape in self._aggregate(demands):
+                shape_key = tuple(sorted(shape.items()))
+                last = self._recent_launches.get(shape_key, -1e18)
+                if now - last < self._launch_cooldown_s:
+                    continue  # a group for this shape is still booting
+                spec = self._pick_group(shape)
+                if spec is None:
+                    logger.warning("autoscaler: infeasible demand %s", shape)
+                    continue
+                if counts.get(spec.name, 0) >= spec.max_groups:
+                    continue
+                self._provider.create_node_group(
+                    spec.name, spec.node_resources, spec.count, spec.labels)
+                counts[spec.name] = counts.get(spec.name, 0) + 1
+                launched.append(spec.name)
+                self._recent_launches[shape_key] = now
+
+        # 3. idle-timeout scale-down (above min_groups; whole groups only)
+        now = time.monotonic()
+        live = self._provider.non_terminated_node_groups()
+        for gid, g in live.items():
+            idle = all(
+                self._is_idle(stats.get(nid.hex() if hasattr(nid, "hex") else nid))
+                for nid in g["node_ids"])
+            if not idle:
+                self._idle_since.pop(gid, None)
+                continue
+            first = self._idle_since.setdefault(gid, now)
+            if (now - first >= self._idle_timeout
+                    and counts.get(g["group_name"], 0) >
+                    self._specs.get(g["group_name"],
+                                    NodeGroupSpec(g["group_name"], {})).min_groups):
+                self._provider.terminate_node_group(gid)
+                counts[g["group_name"]] -= 1
+                terminated.append(gid)
+                self._idle_since.pop(gid, None)
+        return {"launched": launched, "terminated": terminated}
+
+    @staticmethod
+    def _is_idle(stats: Optional[dict]) -> bool:
+        if stats is None:
+            return True  # unreachable/dead node -> reclaimable
+        return (stats.get("active_leases", 0) == 0
+                and stats.get("pending_leases", 0) == 0)
+
+    @staticmethod
+    def _aggregate(demands: List[Dict[str, float]]) -> List[Dict[str, float]]:
+        """Merge identical shapes; one launch decision per distinct shape
+        (the reference batches by shape too)."""
+        seen = {}
+        for d in demands:
+            seen[tuple(sorted(d.items()))] = d
+        return list(seen.values())
+
+    def _pick_group(self, shape: Dict[str, float]) -> Optional[NodeGroupSpec]:
+        """Smallest group type whose per-node (or per-group, for gang
+        resources like TPU) capacity covers the shape."""
+        candidates = []
+        for spec in self._specs.values():
+            per_node_ok = all(
+                spec.node_resources.get(k, 0.0) >= v
+                for k, v in shape.items() if k != "TPU")
+            tpu_need = shape.get("TPU", 0.0)
+            tpu_ok = (tpu_need == 0.0
+                      or spec.node_resources.get("TPU", 0.0) >= tpu_need
+                      or spec.total("TPU") >= tpu_need)
+            if per_node_ok and tpu_ok:
+                candidates.append(spec)
+        if not candidates:
+            return None
+        return min(candidates, key=lambda s: (s.total("TPU"), s.total("CPU")))
+
+    # -- background mode -------------------------------------------------
+
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="autoscaler")
+            self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+
+    def _loop(self):
+        while not self._stop.wait(self._interval):
+            try:
+                self.reconcile_once()
+            except Exception:  # noqa: BLE001
+                logger.exception("autoscaler reconcile failed")

@@ -1,0 +1,10 @@
+"""Dashboard: HTTP JSON API over cluster state.
+
+reference: python/ray/dashboard/ — DashboardHead (head.py:49) + per-node
+agents serving cluster status, actors/tasks/objects listings, job info,
+Prometheus metrics, and the Chrome-trace timeline.
+"""
+
+from ray_tpu.dashboard.head import DashboardHead, start_dashboard
+
+__all__ = ["DashboardHead", "start_dashboard"]

@@ -1,0 +1,150 @@
+"""Dashboard head: a threaded HTTP server exposing cluster state as JSON.
+
+reference: dashboard/head.py:49 (DashboardHead) + modules — node/actor/task
+listings (state API), jobs, /metrics Prometheus exposition
+(_private/metrics_agent.py), timeline (Chrome trace).  The React frontend
+is out of scope; every endpoint returns JSON (or Prometheus text), which is
+what the reference's frontend consumes too.
+
+Endpoints:
+  GET /api/version
+  GET /api/cluster_status   nodes + aggregate resources
+  GET /api/nodes            state API list_nodes
+  GET /api/actors           list_actors
+  GET /api/tasks            list_tasks (folded states)
+  GET /api/objects          list_objects
+  GET /api/placement_groups list_placement_groups
+  GET /api/jobs             submitted jobs (job manager) + driver jobs (GCS)
+  GET /api/timeline         Chrome trace events
+  GET /metrics              Prometheus exposition of cluster metrics
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+
+VERSION = "0.1.0"
+
+
+def _jsonable(obj: Any) -> Any:
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return _jsonable(dataclasses.asdict(obj))
+    if isinstance(obj, dict):
+        return {str(_jsonable(k)): _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v) for v in obj]
+    if hasattr(obj, "hex") and not isinstance(obj, (str, bytes, float, int)):
+        return obj.hex()
+    if isinstance(obj, bytes):
+        return obj.decode("utf-8", errors="replace")
+    return obj
+
+
+class DashboardHead:
+    """Serves the connected cluster's state over HTTP."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        head = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_GET(self):
+                try:
+                    body, ctype = head._route(self.path)
+                    code = 200 if body is not None else 404
+                except Exception as e:  # noqa: BLE001
+                    body, ctype, code = json.dumps(
+                        {"error": str(e)}).encode(), "application/json", 500
+                if body is None:
+                    body = b'{"error": "not found"}'
+                    ctype = "application/json"
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True, name="dashboard-head")
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def shutdown(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+    # -- routing --------------------------------------------------------
+
+    def _route(self, path: str):
+        path = path.split("?", 1)[0].rstrip("/") or "/"
+        if path == "/metrics":
+            from ray_tpu.util.metrics import prometheus_text
+
+            return prometheus_text().encode(), "text/plain; version=0.0.4"
+        data = self._api(path)
+        if data is None:
+            return None, None
+        return json.dumps(_jsonable(data)).encode(), "application/json"
+
+    def _api(self, path: str):
+        from ray_tpu.util import state
+
+        if path == "/api/version":
+            return {"version": VERSION}
+        if path == "/api/cluster_status":
+            import ray_tpu
+
+            return {
+                "nodes": state.list_nodes(),
+                "cluster_resources": ray_tpu.cluster_resources(),
+                "available_resources": ray_tpu.available_resources(),
+            }
+        if path == "/api/nodes":
+            return state.list_nodes()
+        if path == "/api/actors":
+            return state.list_actors()
+        if path == "/api/tasks":
+            return state.list_tasks()
+        if path == "/api/objects":
+            return state.list_objects()
+        if path == "/api/placement_groups":
+            return state.list_placement_groups()
+        if path == "/api/jobs":
+            out = {"driver_jobs": state.list_jobs(), "submissions": []}
+            try:
+                import ray_tpu
+                from ray_tpu.job.job_manager import _JOB_MANAGER_NAME
+
+                # existing manager only — a GET must not create one
+                mgr = ray_tpu.get_actor(_JOB_MANAGER_NAME)
+                out["submissions"] = ray_tpu.get(mgr.list_jobs.remote())
+            except Exception:  # noqa: BLE001 — no submissions yet
+                pass
+            return out
+        if path == "/api/timeline":
+            import ray_tpu
+
+            return ray_tpu.timeline()
+        return None
+
+
+_dashboard: Optional[DashboardHead] = None
+
+
+def start_dashboard(host: str = "127.0.0.1", port: int = 0) -> DashboardHead:
+    """Start (or return) the process-wide dashboard head."""
+    global _dashboard
+    if _dashboard is None:
+        _dashboard = DashboardHead(host, port)
+    return _dashboard

@@ -16,6 +16,7 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import logging
+import threading
 from collections import deque
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
@@ -235,14 +236,19 @@ class ExecutionPlan:
         finally:
             # Refs handed downstream may still be executing on the pool —
             # killing an actor mid-task would fail the consumer's get with
-            # ActorDiedError.  Never-yielded in-flight work (consumer went
-            # away) is killed immediately; nobody will read it.
-            try:
-                ray_tpu.wait(yielded, num_returns=len(yielded), timeout=300)
-            except Exception:  # noqa: BLE001
-                pass
-            for a in actors:
+            # ActorDiedError.  Reap asynchronously: generator close returns
+            # immediately (early-exit consumers don't stall) and the actors
+            # die once the yielded work drains.
+            def _reap(refs=list(yielded), pool=list(actors)):
                 try:
-                    ray_tpu.kill(a)
+                    ray_tpu.wait(refs, num_returns=len(refs), timeout=300)
                 except Exception:  # noqa: BLE001
                     pass
+                for a in pool:
+                    try:
+                        ray_tpu.kill(a)
+                    except Exception:  # noqa: BLE001
+                        pass
+
+            threading.Thread(target=_reap, daemon=True,
+                             name="actor-pool-reaper").start()

@@ -1,0 +1,15 @@
+"""Job submission API.
+
+reference: python/ray/dashboard/modules/job/ — JobManager
+(job_manager.py:60) + JobSubmissionClient (sdk.py:36): submit an
+entrypoint shell command to the cluster, track status, stream logs.
+"""
+
+from ray_tpu.job.job_manager import (
+    JobInfo,
+    JobStatus,
+    JobSubmissionClient,
+    job_manager_actor,
+)
+
+__all__ = ["JobInfo", "JobStatus", "JobSubmissionClient", "job_manager_actor"]

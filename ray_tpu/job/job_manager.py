@@ -1,0 +1,243 @@
+"""Job manager: entrypoint subprocesses supervised by actors.
+
+reference: dashboard/modules/job/job_manager.py:60 — each submitted job gets
+a JobSupervisor actor that spawns the entrypoint as a subprocess, captures
+its output, and reports a terminal JobStatus; job metadata lives in the GCS
+(KV in the reference, the manager actor's tables here).  The client mirrors
+JobSubmissionClient (sdk.py:36).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+
+class JobStatus:
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    STOPPED = "STOPPED"
+
+    TERMINAL = (SUCCEEDED, FAILED, STOPPED)
+
+
+@dataclasses.dataclass
+class JobInfo:
+    submission_id: str
+    entrypoint: str
+    status: str = JobStatus.PENDING
+    message: str = ""
+    start_time: Optional[float] = None
+    end_time: Optional[float] = None
+    metadata: Dict[str, str] = dataclasses.field(default_factory=dict)
+    runtime_env: Optional[Dict[str, Any]] = None
+
+
+class JobSupervisor:
+    """Actor supervising ONE job's entrypoint subprocess
+    (reference: job_manager.py JobSupervisor)."""
+
+    def __init__(self, submission_id: str, entrypoint: str,
+                 runtime_env: Optional[dict], metadata: Optional[dict]):
+        self._info = JobInfo(
+            submission_id=submission_id, entrypoint=entrypoint,
+            metadata=metadata or {}, runtime_env=runtime_env)
+        self._log_path = os.path.join(
+            tempfile.gettempdir(), f"ray_tpu_job_{submission_id}.log")
+        self._proc: Optional[subprocess.Popen] = None
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        env = dict(os.environ)
+        env.update((self._info.runtime_env or {}).get("env_vars", {}))
+        with self._lock:
+            # stop() may have landed before the subprocess ever spawned
+            if self._info.status == JobStatus.STOPPED:
+                return
+            self._info.status = JobStatus.RUNNING
+            self._info.start_time = time.time()
+        try:
+            with open(self._log_path, "wb") as log:
+                with self._lock:
+                    if self._info.status == JobStatus.STOPPED:
+                        return
+                    # Popen under the lock so stop() either sees the proc or
+                    # runs before it exists (and the checks above catch it)
+                    self._proc = subprocess.Popen(
+                        self._info.entrypoint, shell=True, stdout=log,
+                        stderr=subprocess.STDOUT, env=env,
+                        start_new_session=True)
+                rc = self._proc.wait()
+            with self._lock:
+                if self._info.status == JobStatus.STOPPED:
+                    pass
+                elif rc == 0:
+                    self._info.status = JobStatus.SUCCEEDED
+                else:
+                    self._info.status = JobStatus.FAILED
+                    self._info.message = f"entrypoint exited with code {rc}"
+                self._info.end_time = time.time()
+        except Exception as e:  # noqa: BLE001
+            with self._lock:
+                self._info.status = JobStatus.FAILED
+                self._info.message = str(e)
+                self._info.end_time = time.time()
+
+    def info(self) -> JobInfo:
+        with self._lock:
+            return dataclasses.replace(self._info)
+
+    def logs(self) -> str:
+        try:
+            with open(self._log_path, "r", errors="replace") as f:
+                return f.read()
+        except FileNotFoundError:
+            return ""
+
+    def stop(self) -> bool:
+        with self._lock:
+            if self._info.status in JobStatus.TERMINAL:
+                return False
+            self._info.status = JobStatus.STOPPED
+            self._info.end_time = time.time()
+        if self._proc is not None and self._proc.poll() is None:
+            import signal
+
+            try:  # kill the whole session (entrypoint may have children)
+                os.killpg(os.getpgid(self._proc.pid), signal.SIGTERM)
+            except Exception:  # noqa: BLE001
+                self._proc.terminate()
+        return True
+
+
+class JobManager:
+    """Actor owning the job table; one per cluster, named + detached
+    (reference: job_manager.py:60, head-node singleton)."""
+
+    def __init__(self):
+        self._supervisors: Dict[str, Any] = {}
+
+    def submit_job(self, entrypoint: str, submission_id: Optional[str] = None,
+                   runtime_env: Optional[dict] = None,
+                   metadata: Optional[dict] = None) -> str:
+        import ray_tpu
+
+        submission_id = submission_id or f"raysubmit_{uuid.uuid4().hex[:12]}"
+        if submission_id in self._supervisors:
+            raise ValueError(f"job {submission_id!r} already exists")
+        sup = ray_tpu.remote(JobSupervisor).options(num_cpus=0.1).remote(
+            submission_id, entrypoint, runtime_env, metadata)
+        self._supervisors[submission_id] = sup
+        return submission_id
+
+    def _sup(self, submission_id: str):
+        sup = self._supervisors.get(submission_id)
+        if sup is None:
+            raise ValueError(f"no job {submission_id!r}")
+        return sup
+
+    def get_job_info(self, submission_id: str) -> JobInfo:
+        import ray_tpu
+
+        return ray_tpu.get(self._sup(submission_id).info.remote())
+
+    def get_job_logs(self, submission_id: str) -> str:
+        import ray_tpu
+
+        return ray_tpu.get(self._sup(submission_id).logs.remote())
+
+    def stop_job(self, submission_id: str) -> bool:
+        import ray_tpu
+
+        return ray_tpu.get(self._sup(submission_id).stop.remote())
+
+    def list_jobs(self) -> List[JobInfo]:
+        import ray_tpu
+
+        return ray_tpu.get([s.info.remote() for s in self._supervisors.values()])
+
+
+_JOB_MANAGER_NAME = "_ray_tpu_job_manager"
+
+
+def job_manager_actor():
+    """Get or create the cluster's singleton JobManager actor."""
+    import ray_tpu
+
+    try:
+        return ray_tpu.get_actor(_JOB_MANAGER_NAME)
+    except ValueError:
+        pass
+    try:
+        return (ray_tpu.remote(JobManager)
+                .options(name=_JOB_MANAGER_NAME, lifetime="detached",
+                         num_cpus=0.1)
+                .remote())
+    except Exception:  # lost the creation race to another driver
+        return ray_tpu.get_actor(_JOB_MANAGER_NAME)
+
+
+class JobSubmissionClient:
+    """reference: dashboard/modules/job/sdk.py:36 (HTTP there, actor RPC
+    here — the cluster's RPC plane is already reachable from any driver)."""
+
+    def __init__(self, address: Optional[str] = None):
+        import ray_tpu
+
+        if not ray_tpu.is_initialized() and address is not None:
+            ray_tpu.init(address=address)
+        self._mgr = job_manager_actor()
+
+    def submit_job(self, *, entrypoint: str,
+                   submission_id: Optional[str] = None,
+                   runtime_env: Optional[dict] = None,
+                   metadata: Optional[dict] = None) -> str:
+        import ray_tpu
+
+        return ray_tpu.get(self._mgr.submit_job.remote(
+            entrypoint, submission_id, runtime_env, metadata))
+
+    def get_job_status(self, submission_id: str) -> str:
+        return self.get_job_info(submission_id).status
+
+    def get_job_info(self, submission_id: str) -> JobInfo:
+        import ray_tpu
+
+        return ray_tpu.get(self._mgr.get_job_info.remote(submission_id))
+
+    def get_job_logs(self, submission_id: str) -> str:
+        import ray_tpu
+
+        return ray_tpu.get(self._mgr.get_job_logs.remote(submission_id))
+
+    def stop_job(self, submission_id: str) -> bool:
+        import ray_tpu
+
+        return ray_tpu.get(self._mgr.stop_job.remote(submission_id))
+
+    def list_jobs(self) -> List[JobInfo]:
+        import ray_tpu
+
+        return ray_tpu.get(self._mgr.list_jobs.remote())
+
+    def wait_until_status(self, submission_id: str, statuses=JobStatus.TERMINAL,
+                          timeout: float = 60.0) -> str:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            st = self.get_job_status(submission_id)
+            if st in statuses:
+                return st
+            time.sleep(0.2)
+        raise TimeoutError(
+            f"job {submission_id} not in {statuses} after {timeout}s")

@@ -96,7 +96,9 @@ class JaxLLMEngine:
         self._key = jax.random.PRNGKey(config.model_config.vocab_size)
 
         self._decode = jax.jit(self._decode_impl, donate_argnums=1)
-        self._prefill_cached: Dict[int, Callable] = {}
+        # jax.jit caches per input shape, so bucketed prompt lengths reuse
+        # compilations automatically
+        self._prefill = jax.jit(self._prefill_impl)
         self._write_slot = jax.jit(llama.write_cache_slot, donate_argnums=0)
 
     # -- jitted programs ------------------------------------------------
@@ -107,18 +109,12 @@ class JaxLLMEngine:
         ids = _sample(logits, key, temps, top_ks)
         return ids, cache
 
-    def _prefill_fn(self, bucket: int):
-        fn = self._prefill_cached.get(bucket)
-        if fn is None:
-            def run(tokens, length, key, temps, top_ks):
-                logits, kv = llama.prefill(
-                    self.cfg, self.params, tokens, rope_cache=self._rope)
-                last = logits[jnp.arange(tokens.shape[0]), length - 1]
-                ids = _sample(last, key, temps, top_ks)
-                return ids, kv
-
-            fn = self._prefill_cached[bucket] = jax.jit(run)
-        return fn
+    def _prefill_impl(self, tokens, length, key, temps, top_ks):
+        logits, kv = llama.prefill(
+            self.cfg, self.params, tokens, rope_cache=self._rope)
+        last = logits[jnp.arange(tokens.shape[0]), length - 1]
+        ids = _sample(last, key, temps, top_ks)
+        return ids, kv
 
     # -- request lifecycle ---------------------------------------------
 
@@ -155,7 +151,7 @@ class JaxLLMEngine:
             tokens = np.zeros((1, bucket), np.int32)
             tokens[0, :plen] = req.prompt
             self._key, sub = jax.random.split(self._key)
-            ids, kv = self._prefill_fn(bucket)(
+            ids, kv = self._prefill(
                 jnp.asarray(tokens), jnp.asarray([plen]), sub,
                 jnp.asarray([req.gen.temperature], jnp.float32),
                 jnp.asarray([req.gen.top_k], jnp.int32))

@@ -21,6 +21,15 @@ from ray_tpu.train.config import (
     RunConfig,
     ScalingConfig,
 )
+from ray_tpu.train.policies import (
+    DefaultFailurePolicy,
+    ElasticScalingPolicy,
+    FailureDecision,
+    FailurePolicy,
+    FixedScalingPolicy,
+    ScalingDecision,
+    ScalingPolicy,
+)
 from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer, Result
 
 __all__ = [
@@ -42,4 +51,11 @@ __all__ = [
     "DataParallelTrainer",
     "JaxTrainer",
     "Result",
+    "FailurePolicy",
+    "DefaultFailurePolicy",
+    "FailureDecision",
+    "ScalingPolicy",
+    "ScalingDecision",
+    "FixedScalingPolicy",
+    "ElasticScalingPolicy",
 ]

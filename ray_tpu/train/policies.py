@@ -1,0 +1,105 @@
+"""Pluggable scaling + failure policies for the train controller.
+
+reference: Train v2 — TrainController holds a ScalingPolicy and a
+FailurePolicy (v2/_internal/execution/controller/controller.py:110-111,
+execution/scaling_policy/, execution/failure_handling/) and polls them
+each control-loop iteration.
+
+TPU semantics (SURVEY hard-parts #2/#5): gangs are slice-granular — an
+elastic resize picks a whole new gang size and restarts from the latest
+checkpoint (resharding forces recompilation anyway; in-place shrink of an
+SPMD mesh is never worth it).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import math
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+
+# -- failure ---------------------------------------------------------------
+
+
+class FailureDecision:
+    RETRY = "RETRY"
+    RAISE = "RAISE"
+
+
+class FailurePolicy:
+    """reference: v2 FailurePolicy ABC (failure_handling/)."""
+
+    def make_decision(self, failure_count: int, error: BaseException) -> str:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass
+class DefaultFailurePolicy(FailurePolicy):
+    """Retry up to max_failures (-1 = unlimited), then raise."""
+
+    max_failures: int = 0
+
+    def make_decision(self, failure_count: int, error: BaseException) -> str:
+        if self.max_failures < 0 or failure_count <= self.max_failures:
+            return FailureDecision.RETRY
+        return FailureDecision.RAISE
+
+
+# -- scaling ---------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ScalingDecision:
+    num_workers: int
+
+
+class ScalingPolicy:
+    """reference: v2 ScalingPolicy ABC (scaling_policy/)."""
+
+    def make_decision_for_non_running_worker_group(
+            self, target_workers: int) -> ScalingDecision:
+        """Called before each (re)start; returns the gang size to launch."""
+        raise NotImplementedError
+
+
+class FixedScalingPolicy(ScalingPolicy):
+    """Always the configured gang size (reference: v2 fixed policy)."""
+
+    def make_decision_for_non_running_worker_group(self, target_workers):
+        return ScalingDecision(num_workers=target_workers)
+
+
+@dataclasses.dataclass
+class ElasticScalingPolicy(ScalingPolicy):
+    """Size the gang to what the cluster can actually supply, in
+    slice-sized steps: num_workers is rounded DOWN to a multiple of
+    ``workers_per_slice`` (whole slices only — a partial slice is useless),
+    clamped to [min_workers, max_workers].
+    """
+
+    min_workers: int = 1
+    max_workers: int = 64
+    workers_per_slice: int = 1
+    resources_per_worker: Optional[dict] = None
+
+    def make_decision_for_non_running_worker_group(self, target_workers):
+        import ray_tpu
+
+        res = self.resources_per_worker or {"CPU": 1.0}
+        try:
+            avail = ray_tpu.available_resources()
+        except Exception:  # noqa: BLE001 — not connected; trust the target
+            return ScalingDecision(num_workers=target_workers)
+        fit = min(
+            (math.floor(avail.get(k, 0.0) / v) for k, v in res.items() if v > 0),
+            default=target_workers,
+        )
+        n = min(target_workers, max(fit, 0), self.max_workers)
+        n = (n // self.workers_per_slice) * self.workers_per_slice
+        n = max(n, self.min_workers)
+        if n != target_workers:
+            logger.info("elastic scaling: gang %d -> %d workers", target_workers, n)
+        return ScalingDecision(num_workers=n)

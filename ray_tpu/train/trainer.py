@@ -60,6 +60,8 @@ class DataParallelTrainer:
         run_config: Optional[RunConfig] = None,
         datasets: Optional[Dict[str, Any]] = None,
         resume_from_checkpoint: Optional[Checkpoint] = None,
+        scaling_policy: Optional["ScalingPolicy"] = None,
+        failure_policy: Optional["FailurePolicy"] = None,
     ):
         self._train_fn = train_loop_per_worker
         self._train_config = train_loop_config
@@ -68,27 +70,43 @@ class DataParallelTrainer:
         self._run_config = run_config or RunConfig()
         self._datasets = datasets or {}
         self._resume_checkpoint = resume_from_checkpoint
+        self._scaling_policy = scaling_policy
+        self._failure_policy = failure_policy
 
     # -- controller loop (v2-style) -----------------------------------------
     def fit(self) -> Result:
+        from ray_tpu.train.policies import (
+            DefaultFailurePolicy,
+            FailureDecision,
+            FixedScalingPolicy,
+        )
+
         name = self._run_config.name or f"train_{uuid.uuid4().hex[:8]}"
         run_dir = os.path.join(self._run_config.resolved_storage_path(), name)
         os.makedirs(run_dir, exist_ok=True)
         failure_config = self._run_config.failure_config or FailureConfig()
-        max_failures = failure_config.max_failures
+        failure_policy = self._failure_policy or DefaultFailurePolicy(
+            max_failures=failure_config.max_failures)
+        scaling_policy = self._scaling_policy or FixedScalingPolicy()
         failures = 0
         latest_ckpt = self._resume_checkpoint
         history: List[Dict[str, Any]] = []
 
         while True:
+            decision = scaling_policy.make_decision_for_non_running_worker_group(
+                self._scaling.total_workers)
+            scaling = self._scaling
+            if decision.num_workers != scaling.total_workers:
+                scaling = dataclasses.replace(
+                    scaling, num_workers=decision.num_workers, topology=None)
             executor = BackendExecutor(
                 self._backend_config,
-                self._scaling,
+                scaling,
                 run_dir,
                 self._run_config.checkpoint_config,
             )
             try:
-                shards = self._shard_datasets(self._scaling.total_workers)
+                shards = self._shard_datasets(scaling.total_workers)
                 executor.start(dataset_shards=shards)
                 self._push_resume_checkpoint(executor, latest_ckpt)
                 executor.start_training(self._train_fn, self._train_config)
@@ -115,7 +133,7 @@ class DataParallelTrainer:
             except TrainingFailedError as e:
                 executor.shutdown()
                 failures += 1
-                if failures > max_failures >= 0:
+                if failure_policy.make_decision(failures, e) == FailureDecision.RAISE:
                     return Result(
                         metrics={}, checkpoint=latest_ckpt, path=run_dir, error=e,
                         metrics_history=history,

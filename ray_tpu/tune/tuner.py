@@ -32,6 +32,8 @@ from ray_tpu.tune.search.basic_variant import BasicVariantGenerator
 
 logger = logging.getLogger(__name__)
 
+EXPERIMENT_STATE_FILE = "experiment_state.pkl"
+
 
 @dataclasses.dataclass
 class TuneConfig:
@@ -65,29 +67,116 @@ class Tuner:
 
     def fit(self) -> ResultGrid:
         controller = TuneController(
-            self._trainable, self._param_space, self._tune_config, self._run_config
+            self._trainable, self._param_space, self._tune_config, self._run_config,
+            restore_path=getattr(self, "_restore_path", None),
         )
         return controller.run()
+
+    @classmethod
+    def restore(cls, path: str, trainable: Callable,
+                *, tune_config: Optional[TuneConfig] = None,
+                run_config: Optional[RunConfig] = None) -> "Tuner":
+        """Resume an interrupted experiment from its directory
+        (reference: Tuner.restore + execution/experiment_state.py).
+
+        Terminated trials keep their results; unfinished trials restart
+        from their latest persisted checkpoint.
+        """
+        if not os.path.exists(os.path.join(path, EXPERIMENT_STATE_FILE)):
+            raise ValueError(f"no experiment snapshot under {path!r}")
+        if tune_config is None:
+            # metric/mode/scheduler travel with the snapshot
+            tune_config = TuneController._load_snapshot(path).get("tune_config")
+        run_config = run_config or RunConfig(
+            name=os.path.basename(path.rstrip("/")),
+            storage_path=os.path.dirname(path.rstrip("/")))
+        tuner = cls(trainable, tune_config=tune_config, run_config=run_config)
+        tuner._restore_path = path
+        return tuner
+
+    @staticmethod
+    def can_restore(path: str) -> bool:
+        return os.path.exists(os.path.join(path, EXPERIMENT_STATE_FILE))
 
 
 class TuneController:
     """reference: tune/execution/tune_controller.py:68."""
 
     def __init__(self, trainable, param_space, tune_config: TuneConfig,
-                 run_config: RunConfig):
+                 run_config: RunConfig, restore_path: Optional[str] = None):
         self._trainable = trainable
         self._tc = tune_config
         self._rc = run_config
-        name = run_config.name or f"tune_{uuid.uuid4().hex[:8]}"
-        self._exp_dir = os.path.join(run_config.resolved_storage_path(), name)
-        os.makedirs(self._exp_dir, exist_ok=True)
-        gen = BasicVariantGenerator(param_space, tune_config.num_samples,
-                                    seed=tune_config.seed)
-        self.trials: List[Trial] = [Trial(config=cfg) for cfg in gen.variants()]
+        if restore_path:
+            self._exp_dir = restore_path
+            self.trials = self._load_experiment_state(restore_path)
+        else:
+            name = run_config.name or f"tune_{uuid.uuid4().hex[:8]}"
+            self._exp_dir = os.path.join(run_config.resolved_storage_path(), name)
+            os.makedirs(self._exp_dir, exist_ok=True)
+            gen = BasicVariantGenerator(param_space, tune_config.num_samples,
+                                        seed=tune_config.seed)
+            self.trials = [Trial(config=cfg) for cfg in gen.variants()]
         self._scheduler = tune_config.scheduler or FIFOScheduler()
         for t in self.trials:
             self._scheduler.on_trial_add(t)
         self._actors: Dict[str, Any] = {}  # trial_id -> actor handle
+
+    # -- experiment snapshot/restore (reference: experiment_state.py) -------
+
+    def _save_experiment_state(self):
+        import pickle
+
+        # only rewrite when some trial actually changed state
+        signature = tuple((t.trial_id, t.status, t.training_iteration)
+                          for t in self.trials)
+        if signature == getattr(self, "_last_saved_signature", None):
+            return
+        rows = []
+        for t in self.trials:
+            rows.append({
+                "trial_id": t.trial_id, "config": t.config, "status": t.status,
+                "training_iteration": t.training_iteration, "metrics": t.metrics,
+                "metrics_history": t.metrics_history, "error": t.error,
+                "checkpoint_path": t.checkpoint_path,
+            })
+        tmp = os.path.join(self._exp_dir, EXPERIMENT_STATE_FILE + ".tmp")
+        with open(tmp, "wb") as f:
+            pickle.dump({"trials": rows, "tune_config": self._tc}, f)
+        os.replace(tmp, os.path.join(self._exp_dir, EXPERIMENT_STATE_FILE))
+        self._last_saved_signature = signature
+
+    @staticmethod
+    def _load_experiment_state(path: str) -> List[Trial]:
+        rows = TuneController._load_snapshot(path)["trials"]
+        trials = []
+        for row in rows:
+            t = Trial(config=row["config"])
+            t.trial_id = row["trial_id"]
+            t.training_iteration = row["training_iteration"]
+            t.metrics = row["metrics"]
+            t.metrics_history = row["metrics_history"]
+            t.checkpoint_path = row["checkpoint_path"]
+            if row["status"] == TERMINATED:
+                t.status = TERMINATED
+                t.error = row["error"]
+            else:
+                # unfinished trials resume from their last checkpoint with a
+                # clean slate — a stale error must not shadow the re-run
+                t.status = PENDING
+                t.error = None
+            trials.append(t)
+        return trials
+
+    @staticmethod
+    def _load_snapshot(path: str) -> dict:
+        import pickle
+
+        with open(os.path.join(path, EXPERIMENT_STATE_FILE), "rb") as f:
+            snap = pickle.load(f)
+        if isinstance(snap, list):  # pre-tune_config snapshot layout
+            snap = {"trials": snap, "tune_config": None}
+        return snap
 
     # -- trial actor management --------------------------------------------
     def _start_trial(self, trial: Trial, resume_checkpoint: Optional[str] = None):
@@ -153,7 +242,8 @@ class TuneController:
                     if trial is None:
                         break
                     pending.remove(trial)
-                    self._start_trial(trial)
+                    # restored trials resume from their persisted checkpoint
+                    self._start_trial(trial, resume_checkpoint=trial.checkpoint_path)
                 # poll running trials
                 for trial in [t for t in self.trials if t.status == RUNNING]:
                     actor = self._actors.get(trial.trial_id)
@@ -191,6 +281,7 @@ class TuneController:
                     elif finished:
                         self._stop_trial(trial, TERMINATED)
                         self._scheduler.on_trial_complete(trial, trial.metrics)
+                self._save_experiment_state()
                 if not any(t.status in (PENDING, RUNNING, PAUSED) for t in self.trials):
                     break
                 time.sleep(0.02)
@@ -198,6 +289,7 @@ class TuneController:
             for trial in self.trials:
                 if trial.trial_id in self._actors:
                     self._stop_trial(trial, trial.status)
+            self._save_experiment_state()
         return self._build_result_grid()
 
     def _handle_pbt_exploit(self, trial: Trial):

@@ -1,0 +1,197 @@
+"""Job submission, dashboard HTTP head, autoscaler reconciler.
+
+reference test models: dashboard/modules/job/tests, autoscaler v2 tests
+(fake provider), dashboard endpoint tests.
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+
+
+# -- job submission ----------------------------------------------------------
+
+
+def test_job_submit_success_and_logs(ray_start_regular):
+    from ray_tpu.job import JobStatus, JobSubmissionClient
+
+    client = JobSubmissionClient()
+    sid = client.submit_job(entrypoint="echo hello-from-job")
+    status = client.wait_until_status(sid, timeout=60)
+    assert status == JobStatus.SUCCEEDED
+    assert "hello-from-job" in client.get_job_logs(sid)
+    info = client.get_job_info(sid)
+    assert info.entrypoint == "echo hello-from-job"
+    assert info.start_time is not None and info.end_time is not None
+
+
+def test_job_failure_and_env_vars(ray_start_regular):
+    from ray_tpu.job import JobStatus, JobSubmissionClient
+
+    client = JobSubmissionClient()
+    sid = client.submit_job(entrypoint="exit 3")
+    assert client.wait_until_status(sid, timeout=60) == JobStatus.FAILED
+    assert "code 3" in client.get_job_info(sid).message
+
+    sid2 = client.submit_job(
+        entrypoint='sh -c "echo VAR=$MY_JOB_VAR"',
+        runtime_env={"env_vars": {"MY_JOB_VAR": "tpu42"}})
+    assert client.wait_until_status(sid2, timeout=60) == JobStatus.SUCCEEDED
+    assert "VAR=tpu42" in client.get_job_logs(sid2)
+
+
+def test_job_stop(ray_start_regular):
+    from ray_tpu.job import JobStatus, JobSubmissionClient
+
+    client = JobSubmissionClient()
+    sid = client.submit_job(entrypoint="sleep 60")
+    deadline = time.monotonic() + 30
+    while (client.get_job_status(sid) == JobStatus.PENDING
+           and time.monotonic() < deadline):
+        time.sleep(0.1)
+    assert client.stop_job(sid)
+    assert client.wait_until_status(sid, timeout=30) == JobStatus.STOPPED
+    jobs = client.list_jobs()
+    assert any(j.submission_id == sid for j in jobs)
+
+
+# -- dashboard ---------------------------------------------------------------
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def test_dashboard_endpoints(ray_start_regular):
+    from ray_tpu.dashboard import DashboardHead
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return "pong"
+
+    ray_tpu.get([f.remote() for _ in range(3)])
+    a = A.remote()
+    ray_tpu.get(a.ping.remote())
+
+    from ray_tpu.util.metrics import Counter
+
+    Counter("dash_test_total").inc(2)
+
+    head = DashboardHead()
+    try:
+        assert _get_json(head.url + "/api/version")["version"]
+        status = _get_json(head.url + "/api/cluster_status")
+        assert len(status["nodes"]) == 1
+        assert status["cluster_resources"]["CPU"] >= 1
+        assert len(_get_json(head.url + "/api/actors")) == 1
+        from ray_tpu._private.worker import get_global_worker
+
+        get_global_worker().flush_task_events()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            tasks = [t for t in _get_json(head.url + "/api/tasks")
+                     if t["name"] == "f"]
+            if len(tasks) == 3:
+                break
+            time.sleep(0.1)
+        assert len(tasks) == 3
+        timeline = _get_json(head.url + "/api/timeline")
+        assert isinstance(timeline, list)
+        with urllib.request.urlopen(head.url + "/metrics", timeout=10) as resp:
+            text = resp.read().decode()
+        assert "dash_test_total 2" in text
+        with urllib.request.urlopen(head.url + "/bogus", timeout=10) as resp_err:
+            pass
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+    finally:
+        head.shutdown()
+
+
+# -- autoscaler --------------------------------------------------------------
+
+
+def test_autoscaler_scales_up_on_demand_and_down_on_idle(ray_start_cluster):
+    from ray_tpu.autoscaler import Autoscaler, InProcessNodeProvider, NodeGroupSpec
+
+    cluster = ray_start_cluster()
+    cluster.add_node(num_cpus=1)
+    w = cluster.connect_driver()
+
+    provider = InProcessNodeProvider(cluster)
+    scaler = Autoscaler(
+        provider,
+        [NodeGroupSpec("cpu-worker", {"CPU": 2.0}, count=1, max_groups=3)],
+        worker=w, idle_timeout_s=0.5)
+
+    # demand a shape the head can't satisfy
+    @ray_tpu.remote
+    def busy():
+        time.sleep(1.0)
+        return ray_tpu.get_runtime_context().node_id
+
+    refs = [busy.options(num_cpus=2).remote() for _ in range(2)]
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline and not scaler.pending_demands():
+        time.sleep(0.1)
+    assert scaler.pending_demands(), "demand signal never appeared"
+
+    result = scaler.reconcile_once()
+    assert result["launched"], "no group launched for pending demand"
+    assert ray_tpu.get(refs, timeout=60)
+
+    # idle: groups terminate after the timeout
+    deadline = time.monotonic() + 30
+    terminated = []
+    while time.monotonic() < deadline and not terminated:
+        time.sleep(0.3)
+        terminated = scaler.reconcile_once()["terminated"]
+    assert terminated
+    assert not provider.non_terminated_node_groups()
+
+
+def test_autoscaler_tpu_slice_provider(ray_start_cluster):
+    from ray_tpu.autoscaler import Autoscaler, NodeGroupSpec, TpuSliceNodeProvider
+
+    cluster = ray_start_cluster()
+    cluster.add_node(num_cpus=1)
+    w = cluster.connect_driver()
+
+    provider = TpuSliceNodeProvider(cluster, chips_per_host=4, pod_type="v5p-16")
+    scaler = Autoscaler(
+        provider,
+        [NodeGroupSpec("v5p-16", {"CPU": 4.0, "TPU": 4.0}, count=2,
+                       max_groups=2)],
+        worker=w, idle_timeout_s=300)
+
+    @ray_tpu.remote
+    def on_tpu():
+        return True
+
+    ref = on_tpu.options(resources={"TPU": 4.0}).remote()
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline and not scaler.pending_demands():
+        time.sleep(0.1)
+    result = scaler.reconcile_once()
+    assert result["launched"] == ["v5p-16"]
+
+    groups = provider.non_terminated_node_groups()
+    assert len(groups) == 1
+    (slice_name, g), = groups.items()
+    assert g["count"] == 2  # whole slice, atomic
+    assert ray_tpu.get(ref, timeout=60)
+
+    # gang resources present: slice-name resource on all hosts, head marker
+    total = ray_tpu.cluster_resources()
+    assert total.get(slice_name) == 2.0
+    assert total.get("TPU-v5p-16-head") == 1.0

@@ -153,3 +153,36 @@ def test_batching(serve_cluster):
     responses = [handle.remote(i) for i in range(8)]
     results = sorted(r.result(timeout_s=30) for r in responses)
     assert results == [i * 2 for i in range(8)]
+
+
+def test_multiplexed_model_loading(serve_cluster):
+    """reference: serve/multiplex.py — per-replica LRU of loaded models."""
+
+    @serve.deployment
+    class MultiModel:
+        def __init__(self):
+            self.loads = []
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        def get_model(self, model_id: str):
+            self.loads.append(model_id)
+            return {"id": model_id, "scale": int(model_id[-1])}
+
+        def __call__(self, req):
+            model = self.get_model(req["model"])
+            assert serve.get_multiplexed_model_id() == req["model"]
+            return {"y": model["scale"] * req["x"], "loads": list(self.loads)}
+
+    handle = serve.run(MultiModel.bind(), name="mux")
+    try:
+        r1 = handle.remote({"model": "m1", "x": 5}).result(timeout_s=60)
+        assert r1["y"] == 5
+        r2 = handle.remote({"model": "m1", "x": 7}).result(timeout_s=60)
+        assert r2["y"] == 7
+        assert r2["loads"].count("m1") == 1  # cached, loaded once
+        handle.remote({"model": "m2", "x": 1}).result(timeout_s=60)
+        handle.remote({"model": "m3", "x": 1}).result(timeout_s=60)  # evicts m1
+        r4 = handle.remote({"model": "m1", "x": 2}).result(timeout_s=60)
+        assert r4["loads"].count("m1") == 2  # reloaded after LRU eviction
+    finally:
+        serve.delete("mux")

@@ -201,3 +201,43 @@ def test_sharded_checkpoint_roundtrip(tmp_path):
     restored = restore_sharded(path)
     np.testing.assert_allclose(np.asarray(restored["w"]), np.asarray(state["w"]))
     np.testing.assert_allclose(np.asarray(restored["b"]), np.asarray(state["b"]))
+
+
+def test_elastic_scaling_policy_sizes_gang(ray4, tmp_path):
+    """reference: v2 ScalingPolicy — gang sized to available resources in
+    slice-granular steps."""
+    from ray_tpu.train import ElasticScalingPolicy, JaxTrainer, ScalingConfig
+
+    policy = ElasticScalingPolicy(min_workers=1, max_workers=8,
+                                  workers_per_slice=1,
+                                  resources_per_worker={"CPU": 1.0})
+    seen = {}
+
+    def loop(config):
+        import ray_tpu.train as train
+
+        seen_size = train.get_context().get_world_size()
+        train.report({"world_size": seen_size})
+
+    trainer = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=16),  # more than the cluster has
+        run_config=__import__("ray_tpu.train", fromlist=["RunConfig"]).RunConfig(
+            name="elastic", storage_path=str(tmp_path)),
+        scaling_policy=policy,
+    )
+    result = trainer.fit()
+    assert result.error is None
+    # the 4-CPU test cluster can't fit 16 single-CPU workers
+    assert 1 <= result.metrics["world_size"] <= 4
+
+
+def test_failure_policy_decisions():
+    from ray_tpu.train import DefaultFailurePolicy, FailureDecision
+
+    p = DefaultFailurePolicy(max_failures=2)
+    assert p.make_decision(1, RuntimeError()) == FailureDecision.RETRY
+    assert p.make_decision(2, RuntimeError()) == FailureDecision.RETRY
+    assert p.make_decision(3, RuntimeError()) == FailureDecision.RAISE
+    unlimited = DefaultFailurePolicy(max_failures=-1)
+    assert unlimited.make_decision(99, RuntimeError()) == FailureDecision.RETRY

@@ -172,3 +172,52 @@ def test_variant_generator_counts():
     assert len(vs) == 2
     assert all(v["fixed"] == 5 for v in vs)
     assert {v["opt"]["lr"] for v in vs} == {0.1, 0.2}
+
+
+def test_tuner_restore_resumes_unfinished(ray4, tmp_path):
+    """Experiment snapshot + Tuner.restore (reference: Tuner.restore,
+    execution/experiment_state.py): terminated trials keep results,
+    unfinished trials resume from their checkpoint."""
+
+    def trainable(config):
+        import ray_tpu.tune as tune_mod
+
+        start = 0
+        ckpt = tune_mod.get_checkpoint()
+        if ckpt is not None:
+            import json as js
+            with open(os.path.join(ckpt.path, "state.json")) as f:
+                start = js.load(f)["iter"] + 1
+        for i in range(start, 3):
+            import json as js
+            import tempfile
+
+            d = tempfile.mkdtemp()
+            with open(os.path.join(d, "state.json"), "w") as f:
+                js.dump({"iter": i}, f)
+            from ray_tpu.train import Checkpoint
+
+            tune_mod.report({"iter": i, "val": config["x"] * 10 + i},
+                            checkpoint=Checkpoint.from_directory(d))
+            if config["x"] == 2 and i == 1 and not ckpt:
+                raise RuntimeError("simulated preemption")
+
+    tuner = Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([1, 2])},
+        tune_config=TuneConfig(metric="val", mode="max",
+                               trial_resources={"CPU": 0.5}),
+        run_config=RunConfig(name="resume_exp", storage_path=str(tmp_path)),
+    )
+    grid1 = tuner.fit()
+    exp_dir = os.path.join(str(tmp_path), "resume_exp")
+    assert Tuner.can_restore(exp_dir)
+    statuses = {r.config["x"]: r.error for r in grid1}
+    assert statuses[1] is None and statuses[2] is not None  # x=2 crashed
+
+    restored = Tuner.restore(exp_dir, trainable)
+    grid2 = restored.fit()
+    by_x = {r.config["x"]: r for r in grid2}
+    assert by_x[2].error is None
+    assert by_x[2].metrics["iter"] == 2  # resumed at 2, not restarted at 0
+    assert by_x[1].metrics["val"] == 12  # finished trial kept its result
