@@ -336,10 +336,12 @@ class RpcClient:
             timeout = global_config().gcs_rpc_timeout_s
         if retry_deadline is not None:
             deadline = time.monotonic() + retry_deadline
-        elif timeout is not None:
-            deadline = time.monotonic() + timeout
         else:
-            deadline = float("inf")
+            # timeout=None blocks forever on a HEALTHY connection, but the
+            # reconnect loop for a DEAD peer stays bounded — callers must
+            # see ConnectionLost, not retry into the void.
+            deadline = time.monotonic() + (
+                timeout if timeout is not None else global_config().gcs_rpc_timeout_s)
         delay = 0.02
         while True:
             try:

@@ -67,10 +67,15 @@ class Autoscaler:
     # -- observation ----------------------------------------------------
 
     def _node_stats(self) -> Dict[str, dict]:
-        """node_id hex -> raylet stats for live nodes."""
+        """node_id hex -> raylet stats for live nodes; also records which
+        node ids the GCS considers DEAD (self._dead_nodes)."""
         stats = {}
+        dead = set()
         for node in self._w.gcs.call("GetAllNodeInfo", {}) or []:
+            nid = node["node_id"]
+            nid = nid.hex() if hasattr(nid, "hex") else nid
             if node.get("state") == "DEAD":
+                dead.add(nid)
                 continue
             try:
                 s = self._w.pool.get(tuple(node["address"])).call(
@@ -78,6 +83,7 @@ class Autoscaler:
                 stats[s["node_id"].hex()] = s
             except Exception:  # noqa: BLE001
                 continue
+        self._dead_nodes = dead
         return stats
 
     def pending_demands(self, stats=None) -> List[Dict[str, float]]:
@@ -131,9 +137,16 @@ class Autoscaler:
         now = time.monotonic()
         live = self._provider.non_terminated_node_groups()
         for gid, g in live.items():
-            idle = all(
-                self._is_idle(stats.get(nid.hex() if hasattr(nid, "hex") else nid))
-                for nid in g["node_ids"])
+            idle = True
+            for nid in g["node_ids"]:
+                nid = nid.hex() if hasattr(nid, "hex") else nid
+                s = stats.get(nid)
+                if s is not None:
+                    idle = idle and self._is_idle(s)
+                else:
+                    # unreachable-for-stats is NOT idle (it may be busy);
+                    # only a GCS-declared-dead node is reclaimable
+                    idle = idle and nid in getattr(self, "_dead_nodes", ())
             if not idle:
                 self._idle_since.pop(gid, None)
                 continue
@@ -149,9 +162,7 @@ class Autoscaler:
         return {"launched": launched, "terminated": terminated}
 
     @staticmethod
-    def _is_idle(stats: Optional[dict]) -> bool:
-        if stats is None:
-            return True  # unreachable/dead node -> reclaimable
+    def _is_idle(stats: dict) -> bool:
         return (stats.get("active_leases", 0) == 0
                 and stats.get("pending_leases", 0) == 0)
 
@@ -169,14 +180,11 @@ class Autoscaler:
         resources like TPU) capacity covers the shape."""
         candidates = []
         for spec in self._specs.values():
-            per_node_ok = all(
-                spec.node_resources.get(k, 0.0) >= v
-                for k, v in shape.items() if k != "TPU")
-            tpu_need = shape.get("TPU", 0.0)
-            tpu_ok = (tpu_need == 0.0
-                      or spec.node_resources.get("TPU", 0.0) >= tpu_need
-                      or spec.total("TPU") >= tpu_need)
-            if per_node_ok and tpu_ok:
+            # feasibility is PER-NODE (raylet schedules a lease onto one
+            # node); a group whose total covers the shape but no single
+            # node does would never satisfy the demand
+            if all(spec.node_resources.get(k, 0.0) >= v
+                   for k, v in shape.items()):
                 candidates.append(spec)
         if not candidates:
             return None

@@ -241,7 +241,9 @@ class ExecutionPlan:
             # die once the yielded work drains.
             def _reap(refs=list(yielded), pool=list(actors)):
                 try:
-                    ray_tpu.wait(refs, num_returns=len(refs), timeout=300)
+                    # normal completion: everything already finished, returns
+                    # instantly; early-exit consumers bound the leak to 60s
+                    ray_tpu.wait(refs, num_returns=len(refs), timeout=60)
                 except Exception:  # noqa: BLE001
                     pass
                 for a in pool:

@@ -1,0 +1,28 @@
+"""RLlib-equivalent: scalable RL on the task/actor runtime, jax-native.
+
+reference: rllib/ (~195k LoC) — Algorithm (algorithms/algorithm.py:207) +
+AlgorithmConfig, EnvRunner actor groups (env/), Learner/LearnerGroup
+(core/learner/), RLModule (core/rl_module/).  The rebuild keeps that
+architecture with the compute jax-first: the RLModule is a functional
+params-pytree policy, the Learner's update is one jitted program (GAE +
+PPO clipped surrogate fused by XLA), EnvRunners are actors sampling
+vectorized numpy envs.
+"""
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig, PPO, PPOConfig
+from ray_tpu.rllib.core.rl_module import RLModule
+from ray_tpu.rllib.env import CartPoleEnv, EnvSpec
+from ray_tpu.rllib.env_runner import EnvRunner
+from ray_tpu.rllib.learner import PPOLearner
+
+__all__ = [
+    "Algorithm",
+    "AlgorithmConfig",
+    "CartPoleEnv",
+    "EnvRunner",
+    "EnvSpec",
+    "PPO",
+    "PPOConfig",
+    "PPOLearner",
+    "RLModule",
+]

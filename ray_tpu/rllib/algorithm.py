@@ -1,0 +1,169 @@
+"""Algorithm + AlgorithmConfig: the training driver.
+
+reference: rllib/algorithms/algorithm.py:207 (Algorithm.train iteration:
+sync weights -> sample EnvRunner group -> Learner update -> metrics) and
+AlgorithmConfig's builder pattern (.environment().env_runners().training()).
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class AlgorithmConfig:
+    env: Union[str, Callable, None] = None
+    num_env_runners: int = 2
+    num_envs_per_runner: int = 1
+    rollout_fragment_length: int = 200
+    lr: float = 3e-4
+    gamma: float = 0.99
+    hidden: tuple = (64, 64)
+    seed: int = 0
+
+    # builder-style setters (reference: AlgorithmConfig fluent API)
+    def environment(self, env) -> "AlgorithmConfig":
+        out = copy.copy(self)
+        out.env = env
+        return out
+
+    def env_runners(self, *, num_env_runners: Optional[int] = None,
+                    num_envs_per_runner: Optional[int] = None,
+                    rollout_fragment_length: Optional[int] = None) -> "AlgorithmConfig":
+        out = copy.copy(self)
+        if num_env_runners is not None:
+            out.num_env_runners = num_env_runners
+        if num_envs_per_runner is not None:
+            out.num_envs_per_runner = num_envs_per_runner
+        if rollout_fragment_length is not None:
+            out.rollout_fragment_length = rollout_fragment_length
+        return out
+
+    def training(self, **kwargs) -> "AlgorithmConfig":
+        out = copy.copy(self)
+        for k, v in kwargs.items():
+            if not hasattr(out, k):
+                raise ValueError(f"unknown training param {k!r}")
+            setattr(out, k, v)
+        return out
+
+    def build(self) -> "Algorithm":
+        return self.algo_class(self)  # type: ignore[attr-defined]
+
+
+@dataclasses.dataclass
+class PPOConfig(AlgorithmConfig):
+    lam: float = 0.95
+    clip_param: float = 0.2
+    vf_coef: float = 0.5
+    entropy_coef: float = 0.01
+    num_sgd_epochs: int = 6
+    minibatch_size: int = 256
+    max_grad_norm: float = 0.5
+
+    @property
+    def algo_class(self):
+        return PPO
+
+
+class Algorithm:
+    """Owns the learner + the EnvRunner actor group."""
+
+    def __init__(self, config: AlgorithmConfig):
+        import ray_tpu
+        from ray_tpu.rllib.env import make_env
+        from ray_tpu.rllib.env_runner import EnvRunner
+
+        self.config = config
+        if config.env is None:
+            raise ValueError("config.environment(env) is required")
+        probe = make_env(config.env)
+        self._spec = probe.spec
+        module_spec = {
+            "spec": {"obs_dim": probe.spec.obs_dim,
+                     "num_actions": probe.spec.num_actions},
+            "hidden": tuple(config.hidden),
+        }
+        self._learner = self._build_learner()
+        self._runners = [
+            ray_tpu.remote(EnvRunner).options(num_cpus=0.5).remote(
+                config.env, module_spec,
+                num_envs=config.num_envs_per_runner,
+                seed=config.seed + i,
+                rollout_fragment_length=config.rollout_fragment_length)
+            for i in range(config.num_env_runners)
+        ]
+        self._iteration = 0
+
+    def _build_learner(self):
+        raise NotImplementedError
+
+    def train(self) -> Dict[str, Any]:
+        """One iteration: sample the runner group, update, report metrics."""
+        import ray_tpu
+
+        params = self._learner.get_params()
+        params_ref = ray_tpu.put(jax_to_numpy(params))
+        batches = ray_tpu.get(
+            [r.sample.remote(params_ref) for r in self._runners])
+        merged = {
+            key: np.concatenate([b[key] for b in batches],
+                                axis=1 if batches[0][key].ndim > 1 else 0)
+            for key in ("obs", "actions", "rewards", "dones", "logp", "values")
+        }
+        merged["bootstrap_value"] = np.concatenate(
+            [b["bootstrap_value"] for b in batches], axis=0)
+        learn_stats = self._learner.update(merged)
+        stats = ray_tpu.get([r.episode_stats.remote() for r in self._runners])
+        rewards = [s["episode_reward_mean"] for s in stats if s["episodes_total"]]
+        self._iteration += 1
+        return {
+            "training_iteration": self._iteration,
+            "episode_reward_mean": float(np.mean(rewards)) if rewards else 0.0,
+            "episodes_total": float(sum(s["episodes_total"] for s in stats)),
+            "num_env_steps_sampled": self._iteration
+            * self.config.rollout_fragment_length
+            * self.config.num_env_runners * self.config.num_envs_per_runner,
+            **learn_stats,
+        }
+
+    def stop(self):
+        import ray_tpu
+
+        for r in self._runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def get_policy_params(self):
+        return self._learner.get_params()
+
+
+class PPO(Algorithm):
+    """reference: rllib/algorithms/ppo/ppo.py."""
+
+    def _build_learner(self):
+        from ray_tpu.rllib.core.rl_module import RLModule
+        from ray_tpu.rllib.learner import PPOLearner
+
+        cfg: PPOConfig = self.config  # type: ignore[assignment]
+        module = RLModule(self._spec, hidden=tuple(cfg.hidden))
+        return PPOLearner(
+            module, lr=cfg.lr, gamma=cfg.gamma, lam=cfg.lam,
+            clip_param=cfg.clip_param, vf_coef=cfg.vf_coef,
+            entropy_coef=cfg.entropy_coef, num_sgd_epochs=cfg.num_sgd_epochs,
+            minibatch_size=cfg.minibatch_size,
+            max_grad_norm=cfg.max_grad_norm, seed=cfg.seed)
+
+
+def jax_to_numpy(tree):
+    """Params cross process boundaries as numpy (no device buffers in
+    pickles; runners re-device them on their side)."""
+    import jax
+
+    return jax.tree.map(lambda x: np.asarray(x), tree)

@@ -1,0 +1,3 @@
+from ray_tpu.rllib.core.rl_module import RLModule
+
+__all__ = ["RLModule"]

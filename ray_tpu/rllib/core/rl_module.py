@@ -1,0 +1,53 @@
+"""RLModule: the policy/value network as a functional params pytree.
+
+reference: rllib/core/rl_module/ — the model abstraction Learners train
+and EnvRunners run inference on.  jax-native: params are a pytree, forward
+is a pure function, so the same module runs jitted on TPU in the Learner
+and as cheap CPU inference in the EnvRunners.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib.env import EnvSpec
+
+Params = Dict[str, Any]
+
+
+class RLModule:
+    """MLP actor-critic with categorical policy head."""
+
+    def __init__(self, spec: EnvSpec, hidden: Sequence[int] = (64, 64)):
+        self.spec = spec
+        self.hidden = tuple(hidden)
+
+    def init(self, key: jax.Array) -> Params:
+        sizes = (self.spec.obs_dim, *self.hidden)
+        params: Params = {"trunk": []}
+        for i, (fan_in, fan_out) in enumerate(zip(sizes[:-1], sizes[1:])):
+            key, sub = jax.random.split(key)
+            w = jax.random.normal(sub, (fan_in, fan_out)) * jnp.sqrt(2.0 / fan_in)
+            params["trunk"].append({"w": w, "b": jnp.zeros((fan_out,))})
+        key, k_pi, k_v = jax.random.split(key, 3)
+        params["pi"] = {
+            "w": jax.random.normal(k_pi, (sizes[-1], self.spec.num_actions)) * 0.01,
+            "b": jnp.zeros((self.spec.num_actions,)),
+        }
+        params["v"] = {
+            "w": jax.random.normal(k_v, (sizes[-1], 1)) * 1.0,
+            "b": jnp.zeros((1,)),
+        }
+        return params
+
+    def forward(self, params: Params, obs: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """obs [B, obs_dim] -> (logits [B, A], value [B])."""
+        x = obs
+        for layer in params["trunk"]:
+            x = jnp.tanh(x @ layer["w"] + layer["b"])
+        logits = x @ params["pi"]["w"] + params["pi"]["b"]
+        value = (x @ params["v"]["w"] + params["v"]["b"])[..., 0]
+        return logits, value

@@ -1,0 +1,99 @@
+"""Environment API + built-in envs.
+
+reference: rllib/env/ — gymnasium-style single-agent API (reset/step).
+CartPole is implemented in numpy so the test suite needs no gym install
+(mirrors the reference's testing pattern of cheap classic-control envs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class EnvSpec:
+    """What the RLModule needs to size its networks."""
+
+    obs_dim: int
+    num_actions: int
+
+
+class Env:
+    """Minimal single-agent episodic env interface (gymnasium-style)."""
+
+    spec: EnvSpec
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        raise NotImplementedError
+
+    def step(self, action: int) -> Tuple[np.ndarray, float, bool, Dict[str, Any]]:
+        raise NotImplementedError
+
+
+class CartPoleEnv(Env):
+    """Classic cart-pole balancing, physics per the standard formulation."""
+
+    spec = EnvSpec(obs_dim=4, num_actions=2)
+
+    GRAVITY = 9.8
+    CART_MASS = 1.0
+    POLE_MASS = 0.1
+    POLE_HALF_LENGTH = 0.5
+    FORCE = 10.0
+    DT = 0.02
+    THETA_LIMIT = 12 * 2 * np.pi / 360
+    X_LIMIT = 2.4
+    MAX_STEPS = 500
+
+    def __init__(self, seed: int = 0):
+        self._rng = np.random.RandomState(seed)
+        self._state = None
+        self._steps = 0
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        if seed is not None:
+            self._rng = np.random.RandomState(seed)
+        self._state = self._rng.uniform(-0.05, 0.05, size=4)
+        self._steps = 0
+        return self._state.astype(np.float32)
+
+    def step(self, action: int):
+        x, x_dot, theta, theta_dot = self._state
+        force = self.FORCE if action == 1 else -self.FORCE
+        cos_t, sin_t = np.cos(theta), np.sin(theta)
+        total_mass = self.CART_MASS + self.POLE_MASS
+        pole_ml = self.POLE_MASS * self.POLE_HALF_LENGTH
+        temp = (force + pole_ml * theta_dot ** 2 * sin_t) / total_mass
+        theta_acc = (self.GRAVITY * sin_t - cos_t * temp) / (
+            self.POLE_HALF_LENGTH * (4.0 / 3.0 - self.POLE_MASS * cos_t ** 2 / total_mass))
+        x_acc = temp - pole_ml * theta_acc * cos_t / total_mass
+        x = x + self.DT * x_dot
+        x_dot = x_dot + self.DT * x_acc
+        theta = theta + self.DT * theta_dot
+        theta_dot = theta_dot + self.DT * theta_acc
+        self._state = np.array([x, x_dot, theta, theta_dot])
+        self._steps += 1
+        done = bool(
+            abs(x) > self.X_LIMIT or abs(theta) > self.THETA_LIMIT
+            or self._steps >= self.MAX_STEPS)
+        return self._state.astype(np.float32), 1.0, done, {}
+
+
+_ENV_REGISTRY: Dict[str, Callable[[], Env]] = {"CartPole-v1": CartPoleEnv}
+
+
+def register_env(name: str, creator: Callable[[], Env]):
+    """reference: ray.tune.register_env / rllib env registry."""
+    _ENV_REGISTRY[name] = creator
+
+
+def make_env(name_or_creator) -> Env:
+    if callable(name_or_creator):
+        return name_or_creator()
+    try:
+        return _ENV_REGISTRY[name_or_creator]()
+    except KeyError:
+        raise ValueError(f"unknown env {name_or_creator!r}; register_env() it") from None

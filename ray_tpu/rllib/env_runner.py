@@ -1,0 +1,103 @@
+"""EnvRunner: actor that samples rollout fragments from its envs.
+
+reference: rllib/env/ EnvRunner groups — each runner owns env instances and
+a copy of the module params, samples fixed-length fragments, and reports
+episode statistics.  Inference here is plain numpy-on-CPU via the jax
+module (jitted once), which is the right split: learners burn the TPU,
+runners burn cheap CPU cores.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+def _tree_to_numpy(tree):
+    if isinstance(tree, dict):
+        return {k: _tree_to_numpy(v) for k, v in tree.items()}
+    if isinstance(tree, (list, tuple)):
+        return type(tree)(_tree_to_numpy(v) for v in tree)
+    return np.asarray(tree)
+
+
+class EnvRunner:
+    def __init__(self, env_creator, module_spec: dict, num_envs: int = 1,
+                 seed: int = 0, rollout_fragment_length: int = 200):
+        from ray_tpu.rllib.core.rl_module import RLModule
+        from ray_tpu.rllib.env import EnvSpec, make_env
+
+        self._envs = [make_env(env_creator) for _ in range(num_envs)]
+        self._module = RLModule(EnvSpec(**module_spec["spec"]),
+                                hidden=module_spec.get("hidden", (64, 64)))
+        self._fragment = rollout_fragment_length
+        self._rng = np.random.RandomState(seed)
+        self._obs = [env.reset(seed=seed * 1000 + i)
+                     for i, env in enumerate(self._envs)]
+        self._ep_return = [0.0] * num_envs
+        self._completed: List[float] = []
+
+    @staticmethod
+    def _fwd(params, obs: np.ndarray):
+        """Pure-numpy inference — per-env-step jax dispatch overhead would
+        dominate rollouts for these tiny MLPs; the module's math is mirrored
+        exactly (tanh trunk, linear heads) so runner logp matches what the
+        Learner recomputes."""
+        x = obs
+        for layer in params["trunk"]:
+            x = np.tanh(x @ np.asarray(layer["w"]) + np.asarray(layer["b"]))
+        logits = x @ np.asarray(params["pi"]["w"]) + np.asarray(params["pi"]["b"])
+        value = (x @ np.asarray(params["v"]["w"]) + np.asarray(params["v"]["b"]))[..., 0]
+        return logits, value
+
+    def sample(self, params) -> Dict[str, Any]:
+        """Collect one fragment per env; returns flat batch arrays."""
+        params = _tree_to_numpy(params)
+        n_envs = len(self._envs)
+        T = self._fragment
+        obs_buf = np.zeros((T, n_envs, self._module.spec.obs_dim), np.float32)
+        act_buf = np.zeros((T, n_envs), np.int64)
+        rew_buf = np.zeros((T, n_envs), np.float32)
+        done_buf = np.zeros((T, n_envs), np.bool_)
+        logp_buf = np.zeros((T, n_envs), np.float32)
+        val_buf = np.zeros((T, n_envs), np.float32)
+
+        for t in range(T):
+            obs = np.stack(self._obs)  # [n_envs, obs_dim]
+            logits, values = self._fwd(params, obs)
+            # sample categorically in numpy (cheap, avoids device roundtrip)
+            z = logits - logits.max(-1, keepdims=True)
+            probs = np.exp(z) / np.exp(z).sum(-1, keepdims=True)
+            actions = np.array([self._rng.choice(len(p), p=p) for p in probs])
+            logp = np.log(probs[np.arange(n_envs), actions] + 1e-9)
+
+            obs_buf[t] = obs
+            act_buf[t] = actions
+            val_buf[t] = values
+            logp_buf[t] = logp
+            for i, env in enumerate(self._envs):
+                nxt, rew, done, _ = env.step(int(actions[i]))
+                rew_buf[t, i] = rew
+                done_buf[t, i] = done
+                self._ep_return[i] += rew
+                if done:
+                    self._completed.append(self._ep_return[i])
+                    self._ep_return[i] = 0.0
+                    nxt = env.reset()
+                self._obs[i] = nxt
+
+        # bootstrap value for the unfinished tail of each env's fragment
+        _, last_values = self._fwd(params, np.stack(self._obs))
+        return {
+            "obs": obs_buf, "actions": act_buf, "rewards": rew_buf,
+            "dones": done_buf, "logp": logp_buf, "values": val_buf,
+            "bootstrap_value": np.asarray(last_values, np.float32),
+        }
+
+    def episode_stats(self, window: int = 100) -> Dict[str, float]:
+        recent = self._completed[-window:]
+        return {
+            "episodes_total": float(len(self._completed)),
+            "episode_reward_mean": float(np.mean(recent)) if recent else 0.0,
+        }

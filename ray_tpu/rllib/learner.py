@@ -1,0 +1,131 @@
+"""PPO Learner: one jitted update program (GAE + clipped surrogate).
+
+reference: rllib/core/learner/ + algorithms/ppo/ — the Learner owns the
+optimizer state and runs gradient updates over rollout batches.  jax-native:
+GAE is a lax.scan, the surrogate/value/entropy losses fuse into one XLA
+program, minibatch SGD epochs run inside the jit via lax.fori-style scans
+over shuffled index batches.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.core.rl_module import RLModule
+
+
+def compute_gae(rewards, values, dones, bootstrap_value, gamma, lam):
+    """Generalized advantage estimation over [T, B] fragments (lax.scan)."""
+    next_values = jnp.concatenate([values[1:], bootstrap_value[None]], axis=0)
+    not_done = 1.0 - dones.astype(jnp.float32)
+    deltas = rewards + gamma * next_values * not_done - values
+
+    def scan_fn(carry, inp):
+        delta, nd = inp
+        carry = delta + gamma * lam * nd * carry
+        return carry, carry
+
+    _, adv_rev = jax.lax.scan(
+        scan_fn, jnp.zeros_like(bootstrap_value), (deltas[::-1], not_done[::-1]))
+    advantages = adv_rev[::-1]
+    return advantages, advantages + values
+
+
+class PPOLearner:
+    def __init__(self, module: RLModule, *, lr: float = 3e-4,
+                 gamma: float = 0.99, lam: float = 0.95,
+                 clip_param: float = 0.2, vf_coef: float = 0.5,
+                 entropy_coef: float = 0.01, num_sgd_epochs: int = 6,
+                 minibatch_size: int = 256, max_grad_norm: float = 0.5,
+                 seed: int = 0):
+        self.module = module
+        self.gamma, self.lam = gamma, lam
+        self.clip = clip_param
+        self.vf_coef, self.ent_coef = vf_coef, entropy_coef
+        self.epochs, self.minibatch = num_sgd_epochs, minibatch_size
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(max_grad_norm), optax.adam(lr))
+        self._key = jax.random.PRNGKey(seed)
+        self.params = module.init(jax.random.PRNGKey(seed + 1))
+        self.opt_state = self.optimizer.init(self.params)
+        self._update = jax.jit(self._update_impl)
+
+    # -- jitted update --------------------------------------------------
+
+    def _loss(self, params, obs, actions, old_logp, advantages, returns):
+        logits, values = self.module.forward(params, obs)
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(logp_all, actions[:, None], axis=1)[:, 0]
+        ratio = jnp.exp(logp - old_logp)
+        clipped = jnp.clip(ratio, 1.0 - self.clip, 1.0 + self.clip)
+        policy_loss = -jnp.mean(jnp.minimum(ratio * advantages,
+                                            clipped * advantages))
+        value_loss = jnp.mean((values - returns) ** 2)
+        entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+        total = policy_loss + self.vf_coef * value_loss - self.ent_coef * entropy
+        return total, {"policy_loss": policy_loss, "value_loss": value_loss,
+                       "entropy": entropy}
+
+    def _update_impl(self, params, opt_state, key, batch):
+        obs, actions, old_logp, advantages, returns = (
+            batch["obs"], batch["actions"], batch["logp"],
+            batch["advantages"], batch["returns"])
+        n = obs.shape[0]
+        mb = min(self.minibatch, n)
+        num_mb = max(n // mb, 1)
+
+        def epoch_body(carry, epoch_key):
+            params, opt_state = carry
+            perm = jax.random.permutation(epoch_key, n)
+
+            def mb_body(carry, idx):
+                params, opt_state = carry
+                sel = jax.lax.dynamic_slice_in_dim(perm, idx * mb, mb)
+                (_, aux), grads = jax.value_and_grad(self._loss, has_aux=True)(
+                    params, obs[sel], actions[sel], old_logp[sel],
+                    advantages[sel], returns[sel])
+                updates, opt_state = self.optimizer.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return (params, opt_state), aux
+
+            (params, opt_state), aux = jax.lax.scan(
+                mb_body, (params, opt_state), jnp.arange(num_mb))
+            return (params, opt_state), jax.tree.map(jnp.mean, aux)
+
+        keys = jax.random.split(key, self.epochs)
+        (params, opt_state), aux = jax.lax.scan(
+            epoch_body, (params, opt_state), keys)
+        return params, opt_state, jax.tree.map(jnp.mean, aux)
+
+    # -- public API ------------------------------------------------------
+
+    def update(self, samples: Dict[str, np.ndarray]) -> Dict[str, float]:
+        """samples: stacked runner fragments [T, B, ...] (+ bootstrap [B])."""
+        rewards = jnp.asarray(samples["rewards"])
+        values = jnp.asarray(samples["values"])
+        dones = jnp.asarray(samples["dones"])
+        bootstrap = jnp.asarray(samples["bootstrap_value"])
+        advantages, returns = compute_gae(
+            rewards, values, dones, bootstrap, self.gamma, self.lam)
+        adv = (advantages - advantages.mean()) / (advantages.std() + 1e-8)
+
+        flat = {
+            "obs": jnp.asarray(samples["obs"]).reshape(-1, samples["obs"].shape[-1]),
+            "actions": jnp.asarray(samples["actions"]).reshape(-1),
+            "logp": jnp.asarray(samples["logp"]).reshape(-1),
+            "advantages": adv.reshape(-1),
+            "returns": returns.reshape(-1),
+        }
+        self._key, sub = jax.random.split(self._key)
+        self.params, self.opt_state, aux = self._update(
+            self.params, self.opt_state, sub, flat)
+        return {k: float(v) for k, v in aux.items()}
+
+    def get_params(self):
+        return self.params

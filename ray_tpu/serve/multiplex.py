@@ -17,6 +17,8 @@ from typing import Any, Callable
 _current_model_id: contextvars.ContextVar[str] = contextvars.ContextVar(
     "ray_tpu_multiplexed_model_id", default="")
 
+_INIT_LOCK = threading.Lock()
+
 
 def get_multiplexed_model_id() -> str:
     """reference: serve.get_multiplexed_model_id."""
@@ -97,8 +99,15 @@ def multiplexed(max_num_models_per_replica: int = 3):
         def wrapper(self, model_id: str):
             wrap = getattr(self, attr, None)
             if wrap is None:
-                wrap = _MultiplexWrapper(load_fn, max_num_models_per_replica)
-                setattr(self, attr, wrap)
+                # module-global lock (not a closure cell — the decorated
+                # class must stay cloudpickle-able): concurrent first calls
+                # agree on one wrapper
+                with _INIT_LOCK:
+                    wrap = getattr(self, attr, None)
+                    if wrap is None:
+                        wrap = _MultiplexWrapper(load_fn,
+                                                 max_num_models_per_replica)
+                        setattr(self, attr, wrap)
             set_multiplexed_model_id(model_id)
             return wrap.load(self, model_id)
 

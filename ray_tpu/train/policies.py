@@ -99,7 +99,9 @@ class ElasticScalingPolicy(ScalingPolicy):
         )
         n = min(target_workers, max(fit, 0), self.max_workers)
         n = (n // self.workers_per_slice) * self.workers_per_slice
-        n = max(n, self.min_workers)
+        # the floor is also slice-granular: never launch a partial slice
+        min_slices = -(-self.min_workers // self.workers_per_slice)
+        n = max(n, min_slices * self.workers_per_slice)
         if n != target_workers:
             logger.info("elastic scaling: gang %d -> %d workers", target_workers, n)
         return ScalingDecision(num_workers=n)

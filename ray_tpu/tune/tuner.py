@@ -140,9 +140,13 @@ class TuneController:
                 "metrics_history": t.metrics_history, "error": t.error,
                 "checkpoint_path": t.checkpoint_path,
             })
+        # the scheduler is live mutable state keyed by Trial OBJECTS — a
+        # pickled copy would revive ghost trials on restore; persist the
+        # config without it (restore builds a fresh scheduler)
+        saved_tc = dataclasses.replace(self._tc, scheduler=None)
         tmp = os.path.join(self._exp_dir, EXPERIMENT_STATE_FILE + ".tmp")
         with open(tmp, "wb") as f:
-            pickle.dump({"trials": rows, "tune_config": self._tc}, f)
+            pickle.dump({"trials": rows, "tune_config": saved_tc}, f)
         os.replace(tmp, os.path.join(self._exp_dir, EXPERIMENT_STATE_FILE))
         self._last_saved_signature = signature
 

@@ -110,10 +110,9 @@ def test_dashboard_endpoints(ray_start_regular):
         with urllib.request.urlopen(head.url + "/metrics", timeout=10) as resp:
             text = resp.read().decode()
         assert "dash_test_total 2" in text
-        with urllib.request.urlopen(head.url + "/bogus", timeout=10) as resp_err:
-            pass
-    except urllib.error.HTTPError as e:
-        assert e.code == 404
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(head.url + "/bogus", timeout=10)
+        assert exc_info.value.code == 404
     finally:
         head.shutdown()
 

@@ -1,0 +1,86 @@
+"""RLlib-equivalent tests (reference: rllib/algorithms/ppo tests —
+learning smoke on CartPole, GAE math, config builder)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def test_cartpole_env_physics():
+    from ray_tpu.rllib import CartPoleEnv
+
+    env = CartPoleEnv(seed=0)
+    obs = env.reset(seed=1)
+    assert obs.shape == (4,)
+    total, done, steps = 0.0, False, 0
+    while not done and steps < 600:
+        obs, rew, done, _ = env.step(steps % 2)
+        total += rew
+        steps += 1
+    assert done and 1 <= steps <= 500
+
+
+def test_gae_matches_manual():
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.learner import compute_gae
+
+    T, B = 4, 1
+    rewards = jnp.ones((T, B))
+    values = jnp.zeros((T, B))
+    dones = jnp.zeros((T, B), bool)
+    bootstrap = jnp.zeros((B,))
+    gamma, lam = 0.9, 1.0
+    adv, ret = compute_gae(rewards, values, dones, bootstrap, gamma, lam)
+    # with values=0, lam=1: advantage = discounted return-to-go
+    expected = [1 + 0.9 * (1 + 0.9 * (1 + 0.9)), 1 + 0.9 * (1 + 0.9), 1.9, 1.0]
+    np.testing.assert_allclose(np.asarray(adv[:, 0]), expected, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(ret), np.asarray(adv), rtol=1e-6)
+
+    # episode boundary stops credit flow
+    dones2 = dones.at[1, 0].set(True)
+    adv2, _ = compute_gae(rewards, values, dones2, bootstrap, gamma, lam)
+    np.testing.assert_allclose(np.asarray(adv2[:, 0]), [1 + 0.9, 1.0, 1.9, 1.0],
+                               rtol=1e-5)
+
+
+def test_config_builder_pattern():
+    from ray_tpu.rllib import PPOConfig
+
+    cfg = (PPOConfig()
+           .environment("CartPole-v1")
+           .env_runners(num_env_runners=3, rollout_fragment_length=64)
+           .training(lr=1e-3, clip_param=0.3))
+    assert cfg.env == "CartPole-v1"
+    assert cfg.num_env_runners == 3
+    assert cfg.rollout_fragment_length == 64
+    assert cfg.lr == 1e-3 and cfg.clip_param == 0.3
+    with pytest.raises(ValueError):
+        cfg.training(bogus=1)
+
+
+def test_ppo_learns_cartpole(ray_start_regular):
+    from ray_tpu.rllib import PPOConfig
+
+    algo = (PPOConfig()
+            .environment("CartPole-v1")
+            .env_runners(num_env_runners=2, num_envs_per_runner=4,
+                         rollout_fragment_length=128)
+            .training(lr=1e-3, num_sgd_epochs=8, minibatch_size=256,
+                      entropy_coef=0.01, seed=0)
+            .build())
+    try:
+        first = None
+        best = 0.0
+        for _ in range(15):
+            result = algo.train()
+            if first is None and result["episodes_total"]:
+                first = result["episode_reward_mean"]
+            best = max(best, result["episode_reward_mean"])
+        # untrained CartPole averages ~20; PPO should clearly improve
+        assert first is not None
+        assert best > max(60.0, first * 1.5), (first, best)
+        assert result["num_env_steps_sampled"] >= 15 * 128 * 2 * 4
+    finally:
+        algo.stop()
