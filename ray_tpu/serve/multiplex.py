@@ -17,8 +17,6 @@ from typing import Any, Callable
 _current_model_id: contextvars.ContextVar[str] = contextvars.ContextVar(
     "ray_tpu_multiplexed_model_id", default="")
 
-_INIT_LOCK = threading.Lock()
-
 
 def get_multiplexed_model_id() -> str:
     """reference: serve.get_multiplexed_model_id."""
@@ -99,15 +97,14 @@ def multiplexed(max_num_models_per_replica: int = 3):
         def wrapper(self, model_id: str):
             wrap = getattr(self, attr, None)
             if wrap is None:
-                # module-global lock (not a closure cell — the decorated
-                # class must stay cloudpickle-able): concurrent first calls
-                # agree on one wrapper
-                with _INIT_LOCK:
-                    wrap = getattr(self, attr, None)
-                    if wrap is None:
-                        wrap = _MultiplexWrapper(load_fn,
-                                                 max_num_models_per_replica)
-                        setattr(self, attr, wrap)
+                # atomic setdefault (GIL) — concurrent first calls agree on
+                # ONE wrapper; a lock here would make the decorated class
+                # unpicklable (cloudpickle captures referenced globals by
+                # value).  Losing candidates are discarded before any model
+                # load happens, so single-flight loading is preserved.
+                candidate = _MultiplexWrapper(load_fn,
+                                              max_num_models_per_replica)
+                wrap = self.__dict__.setdefault(attr, candidate)
             set_multiplexed_model_id(model_id)
             return wrap.load(self, model_id)
 
