@@ -20,7 +20,7 @@ import subprocess
 import sys
 import threading
 import time
-from collections import deque
+from collections import defaultdict, deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -42,6 +42,8 @@ class _Worker:
     proc: Optional[subprocess.Popen]
     dedicated_actor: Any = None          # ActorID when running an actor
     lease_id: Optional[str] = None
+    env_hash: str = ""                   # runtime-env pool key ("" = default)
+    idle_since: float = 0.0              # monotonic ts when last idled
 
 
 @dataclass
@@ -109,9 +111,12 @@ class Raylet:
         self._lock = threading.RLock()
         self._dispatch_cv = threading.Condition(self._lock)
         self._spawning_procs: Dict[int, subprocess.Popen] = {}
-        self._idle_workers: deque[_Worker] = deque()
+        # worker pool keyed by runtime-env hash (reference: WorkerPool keys
+        # idle workers by runtime env — dedicated workers per env)
+        self._idle_workers: Dict[str, deque] = defaultdict(deque)
         self._all_workers: Dict[WorkerID, _Worker] = {}
-        self._starting = 0
+        self._starting: Dict[str, int] = defaultdict(int)
+        self._env_failures: Dict[str, str] = {}  # env_hash -> error (poison)
         self._pending_leases: deque[_PendingLease] = deque()
         self._grants_waiting_worker: deque[Tuple[_PendingLease, ResourceSet, Dict[str, list], Optional[PlacementGroupID], int]] = deque()
         self._leases: Dict[str, _Lease] = {}
@@ -224,8 +229,8 @@ class Raylet:
     # Worker pool (reference: worker_pool.h:274, worker_pool.cc)
     # ------------------------------------------------------------------
 
-    def _spawn_worker(self):
-        self._starting += 1
+    def _spawn_worker(self, env_hash: str = "", runtime_env: Optional[dict] = None):
+        self._starting[env_hash] += 1
         env = {
             **os.environ,
             **self._worker_env,
@@ -235,6 +240,11 @@ class Raylet:
             "RAY_TPU_GCS_HOST": self.gcs_address[0],
             "RAY_TPU_GCS_PORT": str(self.gcs_address[1]),
         }
+        if runtime_env:
+            import json
+
+            env["RAY_TPU_RUNTIME_ENV"] = json.dumps(runtime_env)
+            env["RAY_TPU_RUNTIME_ENV_HASH"] = env_hash
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.workers_main"],
             env=env,
@@ -243,10 +253,11 @@ class Raylet:
         )
         self._spawning_procs[proc.pid] = proc
         threading.Thread(
-            target=self._watch_spawn, args=(proc,), daemon=True, name="raylet-spawnwatch"
+            target=self._watch_spawn, args=(proc, env_hash), daemon=True,
+            name="raylet-spawnwatch"
         ).start()
 
-    def _watch_spawn(self, proc):
+    def _watch_spawn(self, proc, env_hash: str):
         """If a spawned worker exits before registering, decrement _starting."""
         deadline = time.monotonic() + global_config().worker_register_timeout_s
         while time.monotonic() < deadline:
@@ -256,35 +267,55 @@ class Raylet:
             if proc.poll() is not None:
                 with self._lock:
                     if self._spawning_procs.pop(proc.pid, None) is not None:
-                        self._starting = max(0, self._starting - 1)
+                        self._starting[env_hash] = max(0, self._starting[env_hash] - 1)
                     self._dispatch_cv.notify_all()
                 return
             time.sleep(0.05)
 
     def HandleRegisterWorker(self, req):
         pid = req.get("pid")
+        env_hash = req.get("env_hash", "")
         with self._lock:
             proc = self._spawning_procs.pop(pid, None) if pid is not None else None
             if proc is None and pid is not None:
                 proc = _PidHandle(pid)
-            worker = _Worker(worker_id=req["worker_id"], address=tuple(req["address"]), proc=proc)
+            worker = _Worker(worker_id=req["worker_id"], address=tuple(req["address"]),
+                             proc=proc, env_hash=env_hash)
             self._all_workers[worker.worker_id] = worker
-            self._starting = max(0, self._starting - 1)
-            self._idle_workers.append(worker)
+            self._starting[env_hash] = max(0, self._starting[env_hash] - 1)
+            self._idle_workers[env_hash].append(worker)
             self._dispatch_cv.notify_all()
         return {"node_id": self.node_id, "config_blob": global_config().to_blob()}
 
     def _worker_monitor_loop(self):
-        """Detect worker-process death (reference: node_manager.cc:980)."""
+        """Detect worker-process death (reference: node_manager.cc:980);
+        reap dedicated runtime-env workers idle past the timeout so distinct
+        envs don't accumulate resident processes forever."""
         while not self._stopped.wait(0.2):
             dead = []
+            reap = []
+            now = time.monotonic()
             with self._lock:
                 for wid, w in list(self._all_workers.items()):
                     if w.proc is not None and w.proc.poll() is not None:
                         dead.append(w)
                         del self._all_workers[wid]
-                        if w in self._idle_workers:
-                            self._idle_workers.remove(w)
+                        pool = self._idle_workers.get(w.env_hash)
+                        if pool and w in pool:
+                            pool.remove(w)
+                for env_key, pool in self._idle_workers.items():
+                    if not env_key:
+                        continue  # the default pool is bounded by demand
+                    while pool and now - pool[0].idle_since > 60.0:
+                        w = pool.popleft()
+                        self._all_workers.pop(w.worker_id, None)
+                        reap.append(w)
+            for w in reap:
+                if w.proc is not None:
+                    try:
+                        w.proc.terminate()
+                    except Exception:  # noqa: BLE001
+                        pass
             for w in dead:
                 self._on_worker_death(w)
 
@@ -407,41 +438,72 @@ class Raylet:
         return False
 
     def _try_grant_waiting_locked(self):
+        from ray_tpu._private import runtime_env as renv
+
+        # Grants are matched to idle workers of the SAME runtime-env pool;
+        # unmatched grants trigger spawns for their env (reference:
+        # WorkerPool PopWorker with runtime-env-keyed idle pools).
+        remaining: deque = deque()
+        spawn_want: Dict[str, list] = {}
         while self._grants_waiting_worker:
-            if not self._idle_workers:
-                deficit = len(self._grants_waiting_worker) - self._starting
-                can_start = global_config().maximum_startup_concurrency - self._starting
-                for _ in range(max(0, min(deficit, can_start))):
-                    self._spawn_worker()
-                return
-            p, demand, instances, pg_id, bundle_index = self._grants_waiting_worker.popleft()
-            worker = self._idle_workers.popleft()
-            self._lease_counter += 1
-            lease_id = f"{self.node_id.hex()[:8]}-{self._lease_counter}"
-            lease = _Lease(
-                lease_id=lease_id,
-                worker=worker,
-                demand=demand,
-                instances=instances,
-                pg_id=pg_id,
-                bundle_index=bundle_index,
-                for_actor=p.for_actor,
-            )
-            self._leases[lease_id] = lease
-            worker.lease_id = lease_id
-            if p.for_actor:
-                worker.dedicated_actor = p.spec.actor_id
-            self.server.send_reply(
-                p.reply_token,
-                {
-                    "worker_addr": worker.address,
-                    "worker_id": worker.worker_id,
-                    "lease_id": lease_id,
-                    "node_id": self.node_id,
-                    "resource_instances": instances,
-                    "raylet_addr": self.server.address,
-                },
-            )
+            entry = self._grants_waiting_worker.popleft()
+            p = entry[0]
+            try:
+                env = renv.normalize(p.spec.runtime_env)
+                env_key = renv.env_hash(env)
+                poisoned = self._env_failures.get(env_key)
+                if poisoned is not None:
+                    raise RuntimeError(f"runtime_env setup failed: {poisoned}")
+                if not self._idle_workers.get(env_key):
+                    want = spawn_want.setdefault(env_key, [0, env])
+                    want[0] += 1
+                    remaining.append(entry)
+                    continue
+                self._grant_one_locked(entry, env_key)
+            except Exception as e:  # noqa: BLE001 — reject THIS grant only
+                self._release_lease_resources(_Lease(
+                    lease_id="", worker=None, demand=entry[1],
+                    instances=entry[2], pg_id=entry[3], bundle_index=entry[4]))
+                self.server.send_reply(
+                    p.reply_token, {"rejected": True, "reason": str(e)})
+        self._grants_waiting_worker = remaining
+        budget = (global_config().maximum_startup_concurrency
+                  - sum(self._starting.values()))
+        for env_key, (count, env) in spawn_want.items():
+            deficit = count - self._starting.get(env_key, 0)
+            for _ in range(max(0, min(deficit, budget))):
+                self._spawn_worker(env_key, env)
+                budget -= 1
+
+    def _grant_one_locked(self, entry, env_key: str):
+        p, demand, instances, pg_id, bundle_index = entry
+        worker = self._idle_workers[env_key].popleft()
+        self._lease_counter += 1
+        lease_id = f"{self.node_id.hex()[:8]}-{self._lease_counter}"
+        lease = _Lease(
+            lease_id=lease_id,
+            worker=worker,
+            demand=demand,
+            instances=instances,
+            pg_id=pg_id,
+            bundle_index=bundle_index,
+            for_actor=p.for_actor,
+        )
+        self._leases[lease_id] = lease
+        worker.lease_id = lease_id
+        if p.for_actor:
+            worker.dedicated_actor = p.spec.actor_id
+        self.server.send_reply(
+            p.reply_token,
+            {
+                "worker_addr": worker.address,
+                "worker_id": worker.worker_id,
+                "lease_id": lease_id,
+                "node_id": self.node_id,
+                "resource_instances": instances,
+                "raylet_addr": self.server.address,
+            },
+        )
 
     def _release_lease_resources(self, lease: _Lease):
         if lease.pg_id is not None:
@@ -451,6 +513,23 @@ class Raylet:
                 b.available = (b.available + lease.demand)
         else:
             self.local_resources.release(lease.demand, lease.instances)
+
+    def HandleReportWorkerEnvFailure(self, req):
+        """A spawned worker's runtime-env setup failed: poison the env so
+        waiting grants reject (RuntimeEnvSetupError analog) instead of
+        respawning crashing workers forever."""
+        env_hash = req.get("env_hash", "")
+        with self._lock:
+            self._env_failures[env_hash] = req.get("error", "runtime_env setup failed")
+            self._dispatch_cv.notify_all()
+
+        def _unpoison():  # allow retry later (package may get re-uploaded)
+            time.sleep(30.0)
+            with self._lock:
+                self._env_failures.pop(env_hash, None)
+
+        threading.Thread(target=_unpoison, daemon=True).start()
+        return True
 
     def HandleReturnWorker(self, req):
         lease_id = req["lease_id"]
@@ -465,7 +544,8 @@ class Raylet:
                 pass
             else:
                 w.dedicated_actor = None
-                self._idle_workers.append(w)
+                w.idle_since = time.monotonic()
+                self._idle_workers[w.env_hash].append(w)
             self._dispatch_cv.notify_all()
         return True
 
@@ -678,7 +758,7 @@ class Raylet:
     def HandleListWorkers(self, req):
         """reference: `ray list workers` (worker pool state)."""
         with self._lock:
-            idle = {w.worker_id for w in self._idle_workers}
+            idle = {w.worker_id for pool in self._idle_workers.values() for w in pool}
             return [
                 {"worker_id": w.worker_id.hex(),
                  "pid": w.proc.pid if w.proc is not None else None,
@@ -693,7 +773,7 @@ class Raylet:
             return {
                 "node_id": self.node_id,
                 "num_workers": len(self._all_workers),
-                "idle_workers": len(self._idle_workers),
+                "idle_workers": sum(len(p) for p in self._idle_workers.values()),
                 "pending_leases": len(self._pending_leases),
                 # resource shapes queued here — the autoscaler's demand signal
                 # (reference: autoscaler load reports via GCS)

@@ -1,0 +1,141 @@
+"""Runtime environments: per-task/actor env_vars, py_modules, working_dir.
+
+reference: python/ray/_private/runtime_env/ — envs are applied to DEDICATED
+worker processes (the raylet's WorkerPool keys workers by runtime-env hash
+and starts new ones with the env baked in), packages are content-addressed
+URIs cached in the GCS KV (uri_cache.py), and the per-node agent
+materializes them before the lease is granted.  Here the materialization
+runs in the worker bootstrap (workers_main) — same contract, one fewer
+process.
+
+Supported fields (the reference's core trio):
+  env_vars:    {name: value} exported before user code runs
+  py_modules:  local dirs/files zipped to the GCS KV (kv://pymod:<sha>),
+               extracted on the worker, prepended to sys.path
+  working_dir: local dir zipped likewise, extracted + chdir'd
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import sys
+import tempfile
+import zipfile
+from typing import Any, Dict, Optional
+
+_KV_PREFIX = "kv://"
+
+
+def normalize(runtime_env: Optional[dict]) -> Optional[dict]:
+    """Canonical form; None for empty (no dedicated worker needed)."""
+    if not runtime_env:
+        return None
+    out = {}
+    for key in ("env_vars", "py_modules", "working_dir"):
+        if runtime_env.get(key):
+            out[key] = runtime_env[key]
+    unknown = set(runtime_env) - {"env_vars", "py_modules", "working_dir"}
+    if unknown:
+        raise ValueError(f"unsupported runtime_env fields: {sorted(unknown)}")
+    return out or None
+
+
+def env_hash(runtime_env: Optional[dict]) -> str:
+    """Stable content hash; '' = the default (env-less) worker pool."""
+    if not runtime_env:
+        return ""
+    blob = json.dumps(runtime_env, sort_keys=True, default=str).encode()
+    return hashlib.sha1(blob).hexdigest()[:16]
+
+
+def _zip_path(path: str) -> bytes:
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        if os.path.isfile(path):
+            zf.write(path, os.path.basename(path))
+        else:
+            base = os.path.basename(os.path.normpath(path))
+            for root, _, files in os.walk(path):
+                for fname in files:
+                    full = os.path.join(root, fname)
+                    rel = os.path.join(base, os.path.relpath(full, path))
+                    zf.write(full, rel)
+    return buf.getvalue()
+
+
+def package(worker, runtime_env: Optional[dict]) -> Optional[dict]:
+    """Driver-side: upload local py_modules/working_dir to the GCS KV and
+    rewrite the env to content-addressed URIs (reference: uri_cache.py)."""
+    runtime_env = normalize(runtime_env)
+    if runtime_env is None:
+        return None
+    out = dict(runtime_env)
+
+    def upload(path: str) -> str:
+        data = _zip_path(path)
+        sha = hashlib.sha1(data).hexdigest()[:16]
+        key = f"pymod:{sha}"
+        if not worker.gcs.call("KVExists", {"key": key}):
+            worker.gcs.call("KVPut", {"key": key, "value": data})
+        return f"{_KV_PREFIX}{key}"
+
+    if "py_modules" in out:
+        mods = []
+        for m in out["py_modules"]:
+            mods.append(upload(m) if not str(m).startswith(_KV_PREFIX) else m)
+        out["py_modules"] = mods
+    wd = out.get("working_dir")
+    if wd and not str(wd).startswith(_KV_PREFIX):
+        out["working_dir"] = upload(wd)
+    return out
+
+
+def _materialize(gcs_client, uri: str) -> str:
+    """Fetch kv://pymod:<sha> into a cached extract dir; returns the dir.
+    Concurrent workers race safely: extract to a private temp dir, then
+    publish with one atomic rename (first one wins)."""
+    key = uri[len(_KV_PREFIX):]
+    base = os.path.join(tempfile.gettempdir(), "ray_tpu_runtime_env")
+    dest = os.path.join(base, key.replace(":", "_"))
+    if os.path.exists(dest):
+        return dest
+    data = gcs_client.call("KVGet", {"key": key})
+    if data is None:
+        raise RuntimeError(f"runtime_env package {uri} not found in GCS KV")
+    os.makedirs(base, exist_ok=True)
+    staging = tempfile.mkdtemp(prefix=".staging-", dir=base)
+    with zipfile.ZipFile(io.BytesIO(data)) as zf:
+        zf.extractall(staging)
+    try:
+        os.rename(staging, dest)
+    except OSError:  # another worker published first; use theirs
+        import shutil
+
+        shutil.rmtree(staging, ignore_errors=True)
+    return dest
+
+
+def apply_in_worker(gcs_client, runtime_env: Optional[dict]):
+    """Worker bootstrap: export env_vars, materialize packages, set paths.
+    Runs once per (dedicated) worker process before user code."""
+    if not runtime_env:
+        return
+    for name, value in (runtime_env.get("env_vars") or {}).items():
+        os.environ[name] = str(value)
+    for uri in runtime_env.get("py_modules") or ():
+        # a py_module dir is importable by its basename (reference semantics)
+        root = _materialize(gcs_client, uri)
+        if root not in sys.path:
+            sys.path.insert(0, root)
+    wd = runtime_env.get("working_dir")
+    if wd:
+        root = _materialize(gcs_client, wd)
+        entries = os.listdir(root)
+        target = (os.path.join(root, entries[0])
+                  if len(entries) == 1 and os.path.isdir(os.path.join(root, entries[0]))
+                  else root)
+        sys.path.insert(0, target)
+        os.chdir(target)

@@ -628,6 +628,7 @@ class CoreWorker:
 
         task_id = TaskID.random()
         digest, blob = self._publish_function(fn)
+        runtime_env = self._package_runtime_env(runtime_env)
         spec = TaskSpec(
             task_id=task_id,
             job_id=self.job_id,
@@ -651,6 +652,13 @@ class CoreWorker:
         self._submit_pool.submit(self._submit_with_retries, spec)
         refs = [ObjectRef(oid, self.address) for oid in spec.return_ids()]
         return refs[0] if num_returns == 1 else refs
+
+    def _package_runtime_env(self, runtime_env):
+        if not runtime_env:
+            return None
+        from ray_tpu._private import runtime_env as renv
+
+        return renv.package(self, runtime_env)
 
     def _publish_function(self, fn) -> Tuple[str, Optional[bytes]]:
         blob = serialization.dumps_inline(fn)
@@ -929,6 +937,7 @@ class CoreWorker:
         digest, blob = self._publish_function(cls)
         if blob is None and digest not in self._published_fns:
             blob = serialization.dumps_inline(cls)
+        runtime_env = self._package_runtime_env(runtime_env)
         spec = TaskSpec(
             task_id=TaskID.random(),
             job_id=self.job_id,

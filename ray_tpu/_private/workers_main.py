@@ -24,9 +24,35 @@ def main():
     node_id = NodeID(os.environ["RAY_TPU_NODE_ID"])
     worker = CoreWorker(mode=WORKER, raylet_addr=raylet_addr, gcs_addr=gcs_addr, node_id=node_id)
     set_global_worker(worker)
+
+    # Apply this worker's runtime env BEFORE serving any task (dedicated
+    # workers per env; reference: runtime-env agent materializes pre-lease).
+    env_hash = os.environ.get("RAY_TPU_RUNTIME_ENV_HASH", "")
+    env_json = os.environ.get("RAY_TPU_RUNTIME_ENV")
+    if env_json:
+        import json
+
+        from ray_tpu._private import runtime_env as renv
+
+        try:
+            renv.apply_in_worker(worker.gcs, json.loads(env_json))
+        except Exception as e:  # noqa: BLE001
+            # Tell the raylet so it fails the waiting leases instead of
+            # respawning crashing workers forever (reference:
+            # RuntimeEnvSetupError surfaces to the caller).
+            try:
+                worker.raylet.call(
+                    "ReportWorkerEnvFailure",
+                    {"env_hash": env_hash, "error": f"{type(e).__name__}: {e}"},
+                    timeout=10)
+            except Exception:  # noqa: BLE001
+                pass
+            sys.exit(1)
+
     reply = worker.raylet.call(
         "RegisterWorker",
-        {"worker_id": worker.worker_id, "address": worker.server.address, "pid": os.getpid()},
+        {"worker_id": worker.worker_id, "address": worker.server.address,
+         "pid": os.getpid(), "env_hash": env_hash},
     )
     set_global_config(RayTpuConfig.from_blob(reply["config_blob"]))
     worker.job_id = None

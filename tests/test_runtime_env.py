@@ -1,0 +1,92 @@
+"""Runtime environments: env_vars, py_modules, working_dir on dedicated
+workers (reference: python/ray/_private/runtime_env/ + tests)."""
+
+import os
+
+import pytest
+
+import ray_tpu
+
+
+def test_env_vars_on_dedicated_worker(ray_start_regular):
+    @ray_tpu.remote
+    def read_var():
+        return os.environ.get("MY_RUNTIME_VAR"), os.getpid()
+
+    val, env_pid = ray_tpu.get(
+        read_var.options(runtime_env={"env_vars": {"MY_RUNTIME_VAR": "tpu!"}}).remote())
+    assert val == "tpu!"
+
+    # default-pool workers must NOT see the env var (dedicated worker pools)
+    vals = ray_tpu.get([read_var.remote() for _ in range(4)])
+    for v, pid in vals:
+        if pid != env_pid:
+            assert v is None
+    # and an env-less call is never routed to the env worker with the var set
+    assert all(v is None for v, pid in vals if pid != env_pid)
+
+
+def test_same_env_reuses_worker(ray_start_regular):
+    env = {"env_vars": {"POOLED": "1"}}
+
+    @ray_tpu.remote
+    def pid():
+        return os.getpid()
+
+    pids = ray_tpu.get([pid.options(runtime_env=env).remote() for _ in range(3)])
+    # same env hash -> same dedicated worker pool (usually one worker)
+    assert len(set(pids)) <= 2
+
+
+def test_py_modules_import(ray_start_regular, tmp_path):
+    pkg = tmp_path / "mylib"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("MAGIC = 'from-py-module'\n")
+    (pkg / "helper.py").write_text("def double(x):\n    return 2 * x\n")
+
+    @ray_tpu.remote
+    def use_module():
+        import mylib
+        from mylib.helper import double
+
+        return mylib.MAGIC, double(21)
+
+    magic, doubled = ray_tpu.get(
+        use_module.options(runtime_env={"py_modules": [str(pkg)]}).remote())
+    assert magic == "from-py-module"
+    assert doubled == 42
+
+
+def test_working_dir(ray_start_regular, tmp_path):
+    wd = tmp_path / "jobdir"
+    wd.mkdir()
+    (wd / "data.txt").write_text("hello-working-dir")
+
+    @ray_tpu.remote
+    def read_file():
+        with open("data.txt") as f:
+            return f.read()
+
+    out = ray_tpu.get(
+        read_file.options(runtime_env={"working_dir": str(wd)}).remote())
+    assert out == "hello-working-dir"
+
+
+def test_actor_runtime_env(ray_start_regular):
+    @ray_tpu.remote
+    class EnvActor:
+        def var(self):
+            return os.environ.get("ACTOR_ENV_VAR")
+
+    a = EnvActor.options(
+        runtime_env={"env_vars": {"ACTOR_ENV_VAR": "actor-env"}}).remote()
+    assert ray_tpu.get(a.var.remote()) == "actor-env"
+
+
+def test_unknown_field_rejected(ray_start_regular):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    with pytest.raises(ValueError, match="unsupported runtime_env"):
+        ray_tpu.get(f.options(runtime_env={"conda": "myenv"}).remote())
