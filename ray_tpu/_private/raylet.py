@@ -116,7 +116,7 @@ class Raylet:
         self._idle_workers: Dict[str, deque] = defaultdict(deque)
         self._all_workers: Dict[WorkerID, _Worker] = {}
         self._starting: Dict[str, int] = defaultdict(int)
-        self._env_failures: Dict[str, str] = {}  # env_hash -> error (poison)
+        self._env_failures: Dict[str, tuple] = {}  # env_hash -> (error, expiry)
         self._pending_leases: deque[_PendingLease] = deque()
         self._grants_waiting_worker: deque[Tuple[_PendingLease, ResourceSet, Dict[str, list], Optional[PlacementGroupID], int]] = deque()
         self._leases: Dict[str, _Lease] = {}
@@ -280,7 +280,8 @@ class Raylet:
             if proc is None and pid is not None:
                 proc = _PidHandle(pid)
             worker = _Worker(worker_id=req["worker_id"], address=tuple(req["address"]),
-                             proc=proc, env_hash=env_hash)
+                             proc=proc, env_hash=env_hash,
+                             idle_since=time.monotonic())
             self._all_workers[worker.worker_id] = worker
             self._starting[env_hash] = max(0, self._starting[env_hash] - 1)
             self._idle_workers[env_hash].append(worker)
@@ -303,10 +304,11 @@ class Raylet:
                         pool = self._idle_workers.get(w.env_hash)
                         if pool and w in pool:
                             pool.remove(w)
+                kill_after = global_config().idle_worker_kill_timeout_s
                 for env_key, pool in self._idle_workers.items():
                     if not env_key:
                         continue  # the default pool is bounded by demand
-                    while pool and now - pool[0].idle_since > 60.0:
+                    while pool and now - pool[0].idle_since > kill_after:
                         w = pool.popleft()
                         self._all_workers.pop(w.worker_id, None)
                         reap.append(w)
@@ -453,7 +455,10 @@ class Raylet:
                 env_key = renv.env_hash(env)
                 poisoned = self._env_failures.get(env_key)
                 if poisoned is not None:
-                    raise RuntimeError(f"runtime_env setup failed: {poisoned}")
+                    error, expiry = poisoned
+                    if time.monotonic() < expiry:
+                        raise RuntimeError(f"runtime_env setup failed: {error}")
+                    del self._env_failures[env_key]  # backoff over; retry
                 if not self._idle_workers.get(env_key):
                     want = spawn_want.setdefault(env_key, [0, env])
                     want[0] += 1
@@ -520,15 +525,13 @@ class Raylet:
         respawning crashing workers forever."""
         env_hash = req.get("env_hash", "")
         with self._lock:
-            self._env_failures[env_hash] = req.get("error", "runtime_env setup failed")
+            # (error, expiry): re-poisoning extends the backoff; the grant
+            # loop checks expiry, so no timer thread is needed
+            self._env_failures[env_hash] = (
+                req.get("error", "runtime_env setup failed"),
+                time.monotonic() + 30.0,
+            )
             self._dispatch_cv.notify_all()
-
-        def _unpoison():  # allow retry later (package may get re-uploaded)
-            time.sleep(30.0)
-            with self._lock:
-                self._env_failures.pop(env_hash, None)
-
-        threading.Thread(target=_unpoison, daemon=True).start()
         return True
 
     def HandleReturnWorker(self, req):

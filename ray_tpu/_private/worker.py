@@ -290,6 +290,7 @@ class CoreWorker:
         self._submit_pool = DaemonExecutor(max_workers=8, thread_name_prefix="task-submit")
         self._exec_pool = DaemonExecutor(max_workers=1, thread_name_prefix="task-exec")
         self._published_fns: Set[str] = set()
+        self._runtime_env_cache: Dict[str, Optional[dict]] = {}
         self._fn_cache: Dict[str, Any] = {}
         self._put_counter = 0
         self._counter_lock = threading.Lock()
@@ -656,9 +657,17 @@ class CoreWorker:
     def _package_runtime_env(self, runtime_env):
         if not runtime_env:
             return None
+        import json
+
         from ray_tpu._private import runtime_env as renv
 
-        return renv.package(self, runtime_env)
+        # memoize: repeated submissions with the same env must not re-zip
+        # and re-upload (reference: packaged-URI cache, uri_cache.py)
+        cache_key = json.dumps(runtime_env, sort_keys=True, default=str)
+        cached = self._runtime_env_cache.get(cache_key)
+        if cached is None:
+            cached = self._runtime_env_cache[cache_key] = renv.package(self, runtime_env)
+        return cached
 
     def _publish_function(self, fn) -> Tuple[str, Optional[bytes]]:
         blob = serialization.dumps_inline(fn)

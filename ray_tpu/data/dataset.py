@@ -123,6 +123,129 @@ def _shuffle_refs(seed: Optional[int], refs: List[Any]) -> List[Any]:
             for s, e in even_split_ranges(shuffled.num_rows, max(1, len(refs)))]
 
 
+_AGG_COLUMN_NAMES = {
+    "count": lambda col: f"count({col})" if col else "count()",
+    "sum": lambda col: f"sum({col})",
+    "mean": lambda col: f"mean({col})",
+    "min": lambda col: f"min({col})",
+    "max": lambda col: f"max({col})",
+    "stddev": lambda col: f"std({col})",
+}
+
+
+def _groupby_agg_refs(key: str, aggs: List[tuple], refs: List[Any]) -> List[Any]:
+    """Arrow-native grouped aggregation (reference: grouped_data.py).
+
+    aggs: [(column, arrow_agg_name)] -> output columns named like the
+    reference's "sum(col)" convention.
+    """
+    import ray_tpu
+    from ray_tpu.data.block import concat_blocks
+
+    merged = concat_blocks(ray_tpu.get(list(refs)))
+    table = merged.group_by(key).aggregate(aggs)
+    renames = {}
+    for col, agg in aggs:
+        arrow_name = f"{col}_{agg}" if col else f"{agg}"
+        renames[arrow_name] = _AGG_COLUMN_NAMES.get(agg, lambda c: arrow_name)(col)
+    new_names = [renames.get(n, n) for n in table.column_names]
+    return [ray_tpu.put(table.rename_columns(new_names))]
+
+
+def _map_groups_block(fn, key, block):
+    import pyarrow as pa_mod
+
+    from ray_tpu.data.block import concat_blocks, to_arrow
+
+    t = to_arrow(block)
+    if t.num_rows == 0:
+        return t
+    t = t.sort_by([(key, "ascending")])
+    keys = t.column(key).to_pylist()
+    outs = []
+    start = 0
+    for i in range(1, len(keys) + 1):
+        if i == len(keys) or keys[i] != keys[start]:
+            group = t.slice(start, i - start)
+            result = fn(group.to_pylist())
+            if isinstance(result, dict):
+                result = [result]
+            if isinstance(result, list):
+                result = pa_mod.Table.from_pylist(result)
+            outs.append(to_arrow(result))
+            start = i
+    return concat_blocks(outs) if outs else t.slice(0, 0)
+
+
+def _hash_partition_refs(key: str, num_partitions: int, refs: List[Any]) -> List[Any]:
+    """Partition rows by hash(key) so every occurrence of a key lands in one
+    block — the shuffle half of a distributed groupby."""
+    import ray_tpu
+    from ray_tpu.data.block import concat_blocks
+
+    merged = concat_blocks(ray_tpu.get(list(refs)))
+    if merged.num_rows == 0:
+        return [ray_tpu.put(merged)]
+    keys = merged.column(key).to_pylist()
+    assignment = np.array([hash(k) % num_partitions for k in keys])
+    out = []
+    for part in range(num_partitions):
+        idx = np.nonzero(assignment == part)[0]
+        if len(idx):
+            out.append(ray_tpu.put(merged.take(pa.array(idx))))
+    return out or [ray_tpu.put(merged.slice(0, 0))]
+
+
+class GroupedData:
+    """reference: data/grouped_data.py — Dataset.groupby(key) handle."""
+
+    def __init__(self, ds: "Dataset", key: str):
+        self._ds = ds
+        self._key = key
+
+    def _agg(self, aggs: List[tuple]) -> "Dataset":
+        return Dataset(self._ds._plan.with_op(
+            AllToAll(name="GroupByAgg",
+                     fn=functools.partial(_groupby_agg_refs, self._key, aggs))),
+            self._ds._ctx)
+
+    def count(self) -> "Dataset":
+        return self._agg([(self._key, "count")])
+
+    def sum(self, on: str) -> "Dataset":
+        return self._agg([(on, "sum")])
+
+    def mean(self, on: str) -> "Dataset":
+        return self._agg([(on, "mean")])
+
+    def min(self, on: str) -> "Dataset":
+        return self._agg([(on, "min")])
+
+    def max(self, on: str) -> "Dataset":
+        return self._agg([(on, "max")])
+
+    def std(self, on: str) -> "Dataset":
+        return self._agg([(on, "stddev")])
+
+    def aggregate(self, *aggs: tuple) -> "Dataset":
+        """aggs: (column, arrow_aggregate_name) pairs, e.g. ("v", "sum")."""
+        return self._agg(list(aggs))
+
+    def map_groups(self, fn: Callable[[List[Dict]], Any],
+                   *, num_partitions: int = 8) -> "Dataset":
+        """Apply ``fn(rows_of_one_group) -> rows`` per group, in parallel
+        over hash partitions (reference: map_groups)."""
+        ds = Dataset(self._ds._plan.with_op(
+            AllToAll(name="HashPartition",
+                     fn=functools.partial(_hash_partition_refs, self._key,
+                                          num_partitions))),
+            self._ds._ctx)
+        return Dataset(ds._plan.with_op(
+            MapBlocks(name="MapGroups",
+                      fn=functools.partial(_map_groups_block, fn, self._key))),
+            ds._ctx)
+
+
 def _sort_refs(key: str, descending: bool, refs: List[Any]) -> List[Any]:
     import ray_tpu
     from ray_tpu.data.block import concat_blocks
@@ -224,6 +347,10 @@ class Dataset:
         return Dataset(self._plan.with_op(
             AllToAll(name="RandomShuffle",
                      fn=functools.partial(_shuffle_refs, seed))), self._ctx)
+
+    def groupby(self, key: str) -> "GroupedData":
+        """reference: dataset.py groupby -> GroupedData."""
+        return GroupedData(self, key)
 
     def sort(self, key: str, descending: bool = False) -> "Dataset":
         return Dataset(self._plan.with_op(
@@ -381,9 +508,6 @@ class Dataset:
         t = self.to_arrow()
         return getattr(pc, op)(t.column(on)).as_py()
 
-    def groupby(self, key: str) -> "GroupedData":
-        return GroupedData(self, key)
-
     # -- writes -------------------------------------------------------------
     def write_parquet(self, path: str) -> List[str]:
         return self._write(path, "parquet")
@@ -414,60 +538,6 @@ class Dataset:
 
     def stats(self) -> str:
         return repr(self)
-
-
-class GroupedData:
-    """reference: data/grouped_data.py (hash-aggregate based)."""
-
-    def __init__(self, ds: Dataset, key: str):
-        self._ds = ds
-        self._key = key
-
-    def _grouped(self, agg: str, on: Optional[str]):
-        t = self._ds.to_arrow()
-        import pyarrow.compute as pc  # noqa: F401
-
-        on = on or self._key
-        result = t.group_by(self._key).aggregate([(on, agg)])
-        return Dataset(
-            ExecutionPlan([InputData(name="GroupByAgg", refs=[_put_local(result)])]),
-            self._ds._ctx,
-        )
-
-    def count(self) -> Dataset:
-        return self._grouped("count", self._key)
-
-    def sum(self, on: str) -> Dataset:
-        return self._grouped("sum", on)
-
-    def min(self, on: str) -> Dataset:
-        return self._grouped("min", on)
-
-    def max(self, on: str) -> Dataset:
-        return self._grouped("max", on)
-
-    def mean(self, on: str) -> Dataset:
-        return self._grouped("mean", on)
-
-    def map_groups(self, fn: Callable) -> Dataset:
-        t = self._ds.to_arrow()
-        out_blocks = []
-        import pyarrow.compute as pc
-
-        keys = pc.unique(t.column(self._key))
-        for k in keys:
-            mask = pc.equal(t.column(self._key), k)
-            group = t.filter(mask)
-            from ray_tpu.data.block import to_arrow
-
-            out_blocks.append(to_arrow(fn(group)))
-        from ray_tpu.data.block import concat_blocks
-
-        merged = concat_blocks(out_blocks)
-        return Dataset(
-            ExecutionPlan([InputData(name="MapGroups", refs=[_put_local(merged)])]),
-            self._ds._ctx,
-        )
 
 
 def _put_local(block) -> Any:

@@ -94,7 +94,7 @@ def test_aggregates(ray4):
 
 def test_groupby(ray4):
     ds = rd.from_items([{"g": i % 2, "x": i} for i in range(10)])
-    out = {r["g"]: r["x_sum"] for r in ds.groupby("g").sum("x").take_all()}
+    out = {r["g"]: r["sum(x)"] for r in ds.groupby("g").sum("x").take_all()}
     assert out == {0: 20, 1: 25}
 
 
@@ -178,3 +178,41 @@ def test_materialize(ray4):
     ds = rd.range(10).map(lambda r: {"id": r["id"] * 2}).materialize()
     assert ds.count() == 10
     assert ds.count() == 10  # second pass reuses materialized blocks
+
+
+def test_groupby_aggregations(ray_start_regular):
+    import ray_tpu.data as rdata
+
+    ds = rdata.from_items([
+        {"k": "a", "v": 1}, {"k": "b", "v": 10}, {"k": "a", "v": 3},
+        {"k": "b", "v": 20}, {"k": "a", "v": 5},
+    ])
+    rows = {r["k"]: r for r in ds.groupby("k").sum("v").take_all()}
+    assert rows["a"]["sum(v)"] == 9 and rows["b"]["sum(v)"] == 30
+
+    rows = {r["k"]: r for r in ds.groupby("k").count().take_all()}
+    assert rows["a"]["count(k)"] == 3 and rows["b"]["count(k)"] == 2
+
+    rows = {r["k"]: r for r in ds.groupby("k").mean("v").take_all()}
+    assert rows["a"]["mean(v)"] == 3.0 and rows["b"]["mean(v)"] == 15.0
+
+    rows = {r["k"]: r for r in
+            ds.groupby("k").aggregate(("v", "min"), ("v", "max")).take_all()}
+    assert rows["a"]["min(v)"] == 1 and rows["a"]["max(v)"] == 5
+
+
+def test_groupby_map_groups(ray_start_regular):
+    import ray_tpu.data as rdata
+
+    ds = rdata.from_items(
+        [{"k": i % 3, "v": i} for i in range(12)])
+
+    def summarize(rows):
+        return {"k": rows[0]["k"], "n": len(rows),
+                "total": sum(r["v"] for r in rows)}
+
+    out = ds.groupby("k").map_groups(summarize, num_partitions=2).take_all()
+    by_k = {r["k"]: r for r in out}
+    assert len(by_k) == 3
+    assert by_k[0]["n"] == 4 and by_k[0]["total"] == 0 + 3 + 6 + 9
+    assert by_k[2]["total"] == 2 + 5 + 8 + 11
