@@ -657,16 +657,21 @@ class CoreWorker:
     def _package_runtime_env(self, runtime_env):
         if not runtime_env:
             return None
-        import json
-
         from ray_tpu._private import runtime_env as renv
 
-        # memoize: repeated submissions with the same env must not re-zip
-        # and re-upload (reference: packaged-URI cache, uri_cache.py)
-        cache_key = json.dumps(runtime_env, sort_keys=True, default=str)
+        normalized = renv.normalize(runtime_env)
+        if normalized is None:
+            return None
+        # Envs referencing LOCAL paths are re-packaged every submission —
+        # re-zipping is how content changes are detected (the zip is
+        # content-addressed, so unchanged dirs dedupe at the KV layer).
+        # Path-free envs (env_vars only) memoize on the canonical hash.
+        if "py_modules" in normalized or "working_dir" in normalized:
+            return renv.package(self, normalized)
+        cache_key = renv.env_hash(normalized)
         cached = self._runtime_env_cache.get(cache_key)
         if cached is None:
-            cached = self._runtime_env_cache[cache_key] = renv.package(self, runtime_env)
+            cached = self._runtime_env_cache[cache_key] = renv.package(self, normalized)
         return cached
 
     def _publish_function(self, fn) -> Tuple[str, Optional[bytes]]:

@@ -540,7 +540,3 @@ class Dataset:
         return repr(self)
 
 
-def _put_local(block) -> Any:
-    import ray_tpu
-
-    return ray_tpu.put(block)

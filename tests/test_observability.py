@@ -166,3 +166,31 @@ def test_timeline_export(ray_start_regular, tmp_path):
     import json
 
     assert json.loads(out.read_text())
+
+
+def test_tracing_spans_on_timeline(ray_start_regular):
+    """reference: util/tracing/tracing_helper.py — user spans land on the
+    same Chrome trace as tasks."""
+    import time as _time
+
+    from ray_tpu.util import tracing
+
+    with tracing.span("my-phase", attributes={"k": "v"}):
+        _time.sleep(0.03)
+
+    @tracing.trace_function
+    def heavy():
+        _time.sleep(0.02)
+        return 7
+
+    assert heavy() == 7
+
+    deadline = _time.monotonic() + 5
+    names = set()
+    while _time.monotonic() < deadline:
+        names = {e["name"] for e in ray_tpu.timeline()}
+        if "my-phase" in names and any("heavy" in n for n in names):
+            break
+        _time.sleep(0.05)
+    assert "my-phase" in names
+    assert any("heavy" in n for n in names)
