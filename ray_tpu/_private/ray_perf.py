@@ -1,0 +1,119 @@
+"""Core-runtime microbenchmarks.
+
+reference: python/ray/_private/ray_perf.py:122-290 — the named
+microbenchmark suite ("single client get calls", "1:1 actor calls sync",
+"n:n async actor calls", put/get throughput) run per release by
+release/microbenchmark/run_microbenchmark.py.
+
+Run: ``python -m ray_tpu._private.ray_perf [--fast]``
+Prints one line per benchmark: name, ops/s.  ``main(fast=True)`` trims
+iteration counts for CI smoke use.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List
+
+
+def timeit(name: str, fn: Callable[[], None], multiplier: int = 1,
+           *, min_time_s: float = 1.0, fast: bool = False) -> Dict[str, float]:
+    """Run fn repeatedly for ~min_time_s; report ops/s (reference:
+    ray_perf.py timeit)."""
+    if fast:
+        min_time_s = 0.2
+    fn()  # warmup
+    start = time.perf_counter()
+    count = 0
+    while time.perf_counter() - start < min_time_s:
+        fn()
+        count += 1
+    elapsed = time.perf_counter() - start
+    rate = count * multiplier / elapsed
+    print(f"{name:<45s} {rate:>12.1f} ops/s")
+    return {"name": name, "ops_per_s": rate}
+
+
+def main(fast: bool = False) -> List[Dict[str, float]]:
+    import numpy as np
+
+    import ray_tpu
+
+    results = []
+    ray_tpu.init(num_cpus=4)
+    try:
+        # -- puts/gets ---------------------------------------------------
+        small = b"x" * 1024
+
+        def put_small():
+            ray_tpu.put(small)
+
+        results.append(timeit("single client put (1KB, in-band)", put_small,
+                              fast=fast))
+
+        big = np.zeros(1 << 20, dtype=np.uint8)
+
+        def put_get_big():
+            ray_tpu.get(ray_tpu.put(big))
+
+        results.append(timeit("single client put+get (1MB, plasma)",
+                              put_get_big, fast=fast))
+
+        ref_cached = ray_tpu.put(big)
+
+        def get_big():
+            ray_tpu.get(ref_cached)
+
+        results.append(timeit("single client get (1MB, plasma hit)", get_big,
+                              fast=fast))
+
+        # -- tasks -------------------------------------------------------
+        @ray_tpu.remote
+        def tiny():
+            return b"ok"
+
+        def batch_tasks():
+            ray_tpu.get([tiny.remote() for _ in range(20)])
+
+        results.append(timeit("task submit+get (batch 20)", batch_tasks,
+                              multiplier=20, fast=fast))
+
+        # -- actors ------------------------------------------------------
+        @ray_tpu.remote
+        class Echo:
+            def ping(self, x=None):
+                return x
+
+        # fractional CPUs so the 1 + 4 actors fit the 4-CPU bench cluster
+        Echo = Echo.options(num_cpus=0.5)
+        actor = Echo.remote()
+        ray_tpu.get(actor.ping.remote())
+
+        def sync_call():
+            ray_tpu.get(actor.ping.remote())
+
+        results.append(timeit("1:1 actor calls sync", sync_call, fast=fast))
+
+        def pipelined_calls():
+            ray_tpu.get([actor.ping.remote() for _ in range(20)])
+
+        results.append(timeit("1:1 actor calls async (pipeline 20)",
+                              pipelined_calls, multiplier=20, fast=fast))
+
+        actors = [Echo.remote() for _ in range(4)]
+        ray_tpu.get([a.ping.remote() for a in actors])
+
+        def fan_out():
+            ray_tpu.get([a.ping.remote() for a in actors for _ in range(5)])
+
+        results.append(timeit("n:n actor calls (4 actors, pipeline 5)",
+                              fan_out, multiplier=20, fast=fast))
+    finally:
+        ray_tpu.shutdown()
+    return results
+
+
+if __name__ == "__main__":
+    import sys
+
+    main(fast="--fast" in sys.argv)

@@ -51,6 +51,28 @@ def env_hash(runtime_env: Optional[dict]) -> str:
     return hashlib.sha1(blob).hexdigest()[:16]
 
 
+def path_fingerprint(path: str) -> str:
+    """Cheap content fingerprint (relpath, size, mtime_ns per file) — the
+    driver's cache key for packaged local dirs; avoids re-zipping unchanged
+    trees on every submission while still catching edits."""
+    h = hashlib.sha1()
+    if os.path.isfile(path):
+        st = os.stat(path)
+        h.update(f"{os.path.basename(path)}:{st.st_size}:{st.st_mtime_ns}".encode())
+    else:
+        for root, dirs, files in os.walk(path):
+            dirs.sort()
+            for fname in sorted(files):
+                full = os.path.join(root, fname)
+                try:
+                    st = os.stat(full)
+                except OSError:
+                    continue
+                rel = os.path.relpath(full, path)
+                h.update(f"{rel}:{st.st_size}:{st.st_mtime_ns}".encode())
+    return h.hexdigest()[:16]
+
+
 def _zip_path(path: str) -> bytes:
     buf = io.BytesIO()
     with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:

@@ -41,6 +41,7 @@ def timeline(filename: Optional[str] = None) -> List[dict]:
                 "task_id": t["task_id"],
                 "attempt": t.get("attempt", 0),
                 "state": t.get("state"),
+                **(t.get("attributes") or {}),
             },
         })
     if filename:

@@ -662,13 +662,15 @@ class CoreWorker:
         normalized = renv.normalize(runtime_env)
         if normalized is None:
             return None
-        # Envs referencing LOCAL paths are re-packaged every submission —
-        # re-zipping is how content changes are detected (the zip is
-        # content-addressed, so unchanged dirs dedupe at the KV layer).
-        # Path-free envs (env_vars only) memoize on the canonical hash.
-        if "py_modules" in normalized or "working_dir" in normalized:
-            return renv.package(self, normalized)
-        cache_key = renv.env_hash(normalized)
+        # Memoize on the canonical env hash PLUS a stat fingerprint of every
+        # local path, so unchanged trees skip the re-zip while edits
+        # invalidate the cache (reference: uri_cache.py).
+        fingerprints = []
+        for path in list(normalized.get("py_modules") or []) + (
+                [normalized["working_dir"]] if normalized.get("working_dir") else []):
+            if not str(path).startswith("kv://"):
+                fingerprints.append(renv.path_fingerprint(str(path)))
+        cache_key = (renv.env_hash(normalized), tuple(fingerprints))
         cached = self._runtime_env_cache.get(cache_key)
         if cached is None:
             cached = self._runtime_env_cache[cache_key] = renv.package(self, normalized)

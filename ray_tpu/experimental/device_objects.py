@@ -30,13 +30,16 @@ _LOCK = threading.Lock()
 class DeviceRef:
     """In-band handle to a device-resident array (reference: RDT object ref).
 
-    Only metadata is serialized — never the array.
+    Only metadata is serialized — never the array.  ``owner_addr`` lets any
+    process (driver included) serve fetches; ``owner_actor_id`` is preferred
+    when set because actor addresses survive restarts via the GCS.
     """
 
     object_id: str
-    owner_actor_id: Optional[str]  # hex; None = driver-owned
+    owner_actor_id: Optional[str]  # hex; None = non-actor owner
     shape: Tuple[int, ...]
     dtype: str
+    owner_addr: Optional[Tuple[str, int]] = None
 
     def __repr__(self):
         return (f"DeviceRef({self.object_id[:8]}…, shape={self.shape}, "
@@ -54,6 +57,33 @@ def _current_actor_id() -> Optional[str]:
     return aid.hex() if aid is not None else None
 
 
+def _owner_addr_and_register() -> Optional[Tuple[str, int]]:
+    """This process's RPC address; also registers the fetch handler once so
+    any peer (driver/task worker owners included) can serve device_get."""
+    from ray_tpu._private.worker import get_global_worker
+
+    try:
+        w = get_global_worker()
+    except RuntimeError:
+        return None
+    if w is None:
+        return None
+    server = w.server
+    if "DeviceFetch" not in server._handlers:
+        server.register("DeviceFetch", _handle_device_fetch)
+    return tuple(w.address)
+
+
+def _handle_device_fetch(req):
+    import numpy as np
+
+    with _LOCK:
+        value = _STORE.get(req["object_id"])
+    if value is None:
+        raise KeyError(f"device object {req['object_id']} not found on owner")
+    return np.asarray(value)
+
+
 def device_put(array) -> DeviceRef:
     """Pin a jax.Array (or numpy array) in THIS process's device store."""
     import jax.numpy as jnp
@@ -64,6 +94,7 @@ def device_put(array) -> DeviceRef:
         owner_actor_id=_current_actor_id(),
         shape=tuple(array.shape),
         dtype=str(array.dtype),
+        owner_addr=_owner_addr_and_register(),
     )
     with _LOCK:
         _STORE[ref.object_id] = array
@@ -82,15 +113,18 @@ def device_get(ref: DeviceRef, *, group_name: Optional[str] = None,
     with _LOCK:
         if ref.object_id in _STORE:
             return _STORE[ref.object_id]
-    if ref.owner_actor_id is None:
-        raise ValueError(f"{ref}: not local and has no owning actor")
-    if group_name is not None and src_rank is not None:
+    if (group_name is None) != (src_rank is None):
+        raise ValueError(
+            "device_get needs BOTH group_name and src_rank for a collective "
+            "fetch — a silent host fallback would strand the paired "
+            "device_send and desync the group's p2p sequence")
+    if group_name is not None:
         import jax.numpy as jnp
 
         from ray_tpu.util import collective as col
 
         value = jnp.asarray(col.recv(src_rank, group_name=group_name))
-    else:
+    elif ref.owner_actor_id is not None:
         import jax.numpy as jnp
 
         import ray_tpu
@@ -102,6 +136,17 @@ def device_get(ref: DeviceRef, *, group_name: Optional[str] = None,
             ActorMethod(owner, "__ray_tpu_call__").remote(
                 _fetch_to_host, ref.object_id))
         value = jnp.asarray(host)
+    elif ref.owner_addr is not None:
+        import jax.numpy as jnp
+
+        from ray_tpu._private.worker import get_global_worker
+
+        w = get_global_worker()
+        host = w.pool.get(tuple(ref.owner_addr)).call(
+            "DeviceFetch", {"object_id": ref.object_id}, timeout=60)
+        value = jnp.asarray(host)
+    else:
+        raise ValueError(f"{ref}: not local and has no owner to fetch from")
     with _LOCK:
         _STORE[ref.object_id] = value  # cache locally (immutable objects)
     return value

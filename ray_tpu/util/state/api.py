@@ -98,6 +98,8 @@ class StateApiClient:
                 row["start_time"] = t
                 row["node_id"] = ev.get("node_id")
                 row["pid"] = ev.get("pid")
+                if ev.get("attributes"):
+                    row["attributes"] = ev["attributes"]
             elif state in ("FINISHED", "FAILED"):
                 row["end_time"] = t
             order = {"SUBMITTED": 0, "RUNNING": 1, "FINISHED": 2, "FAILED": 2}

@@ -23,27 +23,29 @@ def span(name: str, attributes: Optional[Dict[str, Any]] = None) -> Iterator[Non
     with tracing.span("preprocess-batch"):
         ...
     """
+    from ray_tpu._private.config import global_config
     from ray_tpu._private.worker import get_global_worker
 
     try:
         w = get_global_worker()
     except RuntimeError:
         w = None
+    enabled = w is not None and global_config().task_events_enabled
     span_id = uuid.uuid4().hex[:16]
     start = time.time()
     try:
         yield
     finally:
-        if w is not None:
-            node = w.node_id.hex() if w.node_id else None
+        if enabled:
+            actor_id = getattr(w, "actor_id", None)
             base = {
                 "task_id": f"span-{span_id}",
                 "name": name,
                 "attempt": 0,
                 "job_id": w.job_id.hex() if w.job_id else None,
-                "actor_id": None,
+                "actor_id": actor_id.hex() if actor_id else None,
                 "pid": os.getpid(),
-                "node_id": node,
+                "node_id": w.node_id.hex() if w.node_id else None,
             }
             w._task_events.append({**base, "state": "RUNNING", "time": start,
                                    **({"attributes": attributes} if attributes else {})})

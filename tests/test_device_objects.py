@@ -82,5 +82,27 @@ def test_fetch_missing_object_errors(ray_start_regular):
 
     bogus = DeviceRef(object_id="deadbeef" * 4, owner_actor_id=None,
                       shape=(1,), dtype="float32")
-    with pytest.raises(ValueError, match="no owning actor"):
+    with pytest.raises(ValueError, match="no owner"):
         device_get(bogus)
+    # partial collective kwargs must error, not silently fall back (a host
+    # fallback would strand the paired device_send)
+    with pytest.raises(ValueError, match="BOTH group_name and src_rank"):
+        device_get(bogus, group_name="g")
+
+
+def test_driver_owned_ref_fetched_by_actor(ray_start_regular):
+    import numpy as np
+
+    from ray_tpu.experimental.device_objects import device_get, device_put
+
+    ref = device_put(np.arange(6.0))  # driver-owned
+
+    @ray_tpu.remote
+    class Consumer:
+        def total(self, r):
+            import jax.numpy as jnp
+
+            return float(jnp.sum(device_get(r)))
+
+    c = Consumer.remote()
+    assert ray_tpu.get(c.total.remote(ref)) == 15.0
