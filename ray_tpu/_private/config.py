@@ -27,9 +27,7 @@ class RayTpuConfig:
     heartbeat_interval_s: float = 0.5
     health_check_failure_threshold: int = 10
     resource_report_interval_s: float = 0.2
-    # generous default: on starved CI hosts a jit compile in one worker can
-    # stall peers' replies for tens of seconds (override per deployment)
-    gcs_rpc_timeout_s: float = 90.0
+    gcs_rpc_timeout_s: float = 30.0
     rpc_connect_timeout_s: float = 10.0
     worker_register_timeout_s: float = 30.0
     actor_creation_timeout_s: float = 120.0

@@ -38,10 +38,11 @@ def timeline(filename: Optional[str] = None) -> List[dict]:
             "pid": t.get("node_id") or "driver",
             "tid": t.get("pid") or 0,
             "args": {
+                **(t.get("attributes") or {}),
+                # fixed diagnostic keys win over user attributes
                 "task_id": t["task_id"],
                 "attempt": t.get("attempt", 0),
                 "state": t.get("state"),
-                **(t.get("attributes") or {}),
             },
         })
     if filename:

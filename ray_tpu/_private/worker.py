@@ -516,7 +516,9 @@ class CoreWorker:
         while True:
             still = []
             for r in pending:
-                if self._is_ready(r):
+                # never exceed num_returns (reference semantics: extras stay
+                # pending even if already computed)
+                if len(ready) < num_returns and self._is_ready(r):
                     ready.append(r)
                 else:
                     still.append(r)

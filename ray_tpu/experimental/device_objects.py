@@ -68,9 +68,7 @@ def _owner_addr_and_register() -> Optional[Tuple[str, int]]:
         return None
     if w is None:
         return None
-    server = w.server
-    if "DeviceFetch" not in server._handlers:
-        server.register("DeviceFetch", _handle_device_fetch)
+    w.server.register("DeviceFetch", _handle_device_fetch)  # idempotent
     return tuple(w.address)
 
 

@@ -1,0 +1,179 @@
+"""multiprocessing.Pool API over the task runtime.
+
+reference: python/ray/util/multiprocessing/ — drop-in Pool whose workers
+are actors, so pools span the whole cluster instead of one machine.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable, List, Optional
+
+
+class AsyncResult:
+    """reference: multiprocessing.pool.AsyncResult."""
+
+    def __init__(self, refs, single: bool):
+        self._refs = refs
+        self._single = single
+
+    def get(self, timeout: Optional[float] = None):
+        import ray_tpu
+
+        out = ray_tpu.get(self._refs, timeout=timeout)
+        return out[0] if self._single and isinstance(out, list) else out
+
+    def wait(self, timeout: Optional[float] = None):
+        import ray_tpu
+
+        refs = self._refs if isinstance(self._refs, list) else [self._refs]
+        ray_tpu.wait(refs, num_returns=len(refs), timeout=timeout)
+
+    def ready(self) -> bool:
+        import ray_tpu
+
+        refs = self._refs if isinstance(self._refs, list) else [self._refs]
+        done, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=0)
+        return len(done) == len(refs)
+
+    def successful(self) -> bool:
+        try:
+            self.get(timeout=0)
+            return True
+        except Exception:  # noqa: BLE001
+            return False
+
+
+class _PoolWorker:
+    def run(self, fn, args):
+        return fn(*args)
+
+    def run_batch(self, fn, chunk):
+        return [fn(*args) for args in chunk]
+
+
+class Pool:
+    """reference: ray.util.multiprocessing.Pool — actor-backed pool."""
+
+    def __init__(self, processes: Optional[int] = None,
+                 ray_remote_args: Optional[dict] = None):
+        import ray_tpu
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        if processes is None:
+            processes = max(int(ray_tpu.cluster_resources().get("CPU", 2)), 1)
+        self._size = processes
+        opts = dict(ray_remote_args or {})
+        opts.setdefault("num_cpus", 1)
+        cls = ray_tpu.remote(_PoolWorker).options(**opts)
+        self._actors = [cls.remote() for _ in range(processes)]
+        self._rr = itertools.cycle(range(processes))
+        self._closed = False
+
+    # -- submission ----------------------------------------------------
+
+    def _check_open(self):
+        if self._closed:
+            raise ValueError("Pool not running")
+
+    def _normalize_args(self, args):
+        return args if isinstance(args, tuple) else (args,)
+
+    def apply(self, fn: Callable, args: tuple = (), kwds: Optional[dict] = None):
+        return self.apply_async(fn, args, kwds).get()
+
+    def apply_async(self, fn: Callable, args: tuple = (),
+                    kwds: Optional[dict] = None) -> AsyncResult:
+        self._check_open()
+        if kwds:
+            import functools
+
+            fn = functools.partial(fn, **kwds)
+        actor = self._actors[next(self._rr)]
+        return AsyncResult(actor.run.remote(fn, tuple(args)), single=False)
+
+    def map(self, fn: Callable, iterable: Iterable,
+            chunksize: Optional[int] = None) -> List[Any]:
+        return self.map_async(fn, iterable, chunksize).get()
+
+    def map_async(self, fn: Callable, iterable: Iterable,
+                  chunksize: Optional[int] = None) -> AsyncResult:
+        return self.starmap_async(fn, [(x,) for x in iterable], chunksize)
+
+    def starmap(self, fn: Callable, iterable: Iterable[tuple],
+                chunksize: Optional[int] = None) -> List[Any]:
+        return self.starmap_async(fn, iterable, chunksize).get()
+
+    def starmap_async(self, fn: Callable, iterable: Iterable[tuple],
+                      chunksize: Optional[int] = None) -> "_MapResult":
+        self._check_open()
+        items = [tuple(args) for args in iterable]
+        if chunksize is None:
+            chunksize = max(1, len(items) // (self._size * 4) or 1)
+        chunks = [items[i:i + chunksize] for i in range(0, len(items), chunksize)]
+        refs = [self._actors[next(self._rr)].run_batch.remote(fn, chunk)
+                for chunk in chunks]
+        return _MapResult(refs)
+
+    def imap(self, fn: Callable, iterable: Iterable, chunksize: int = 1):
+        """Ordered lazy iteration (reference: Pool.imap)."""
+        import ray_tpu
+
+        items = [(x,) for x in iterable]
+        chunks = [items[i:i + chunksize] for i in range(0, len(items), chunksize)]
+        refs = [self._actors[next(self._rr)].run_batch.remote(fn, chunk)
+                for chunk in chunks]
+        for ref in refs:
+            yield from ray_tpu.get(ref)
+
+    def imap_unordered(self, fn: Callable, iterable: Iterable, chunksize: int = 1):
+        import ray_tpu
+
+        items = [(x,) for x in iterable]
+        chunks = [items[i:i + chunksize] for i in range(0, len(items), chunksize)]
+        pending = [self._actors[next(self._rr)].run_batch.remote(fn, chunk)
+                   for chunk in chunks]
+        while pending:
+            done, pending = ray_tpu.wait(pending, num_returns=1)
+            for ref in done:
+                yield from ray_tpu.get(ref)
+
+    # -- lifecycle ------------------------------------------------------
+
+    def close(self):
+        self._closed = True
+
+    def terminate(self):
+        import ray_tpu
+
+        self._closed = True
+        for a in self._actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:  # noqa: BLE001
+                pass
+        self._actors = []
+
+    def join(self):
+        if not self._closed:
+            raise ValueError("join() before close()")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.terminate()
+
+
+class _MapResult(AsyncResult):
+    """Flattens chunked results."""
+
+    def __init__(self, refs):
+        super().__init__(refs, single=False)
+
+    def get(self, timeout: Optional[float] = None):
+        import ray_tpu
+
+        chunks = ray_tpu.get(self._refs, timeout=timeout)
+        return [x for chunk in chunks for x in chunk]

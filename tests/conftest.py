@@ -12,6 +12,9 @@ os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ.setdefault("RAY_TPU_DISABLE_METADATA_SERVER", "1")
 os.environ.setdefault("RAY_TPU_WORKER_QUIET", "1")
+# starved 1-CPU CI host: a jit compile in one worker can stall peers'
+# replies for tens of seconds; production keeps the 30s default
+os.environ.setdefault("RAY_TPU_gcs_rpc_timeout_s", "90")
 
 # The image's sitecustomize force-registers the axon TPU backend via
 # jax.config (overriding JAX_PLATFORMS), so pin CPU + 8 virtual devices

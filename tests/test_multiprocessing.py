@@ -1,0 +1,66 @@
+"""ray.util.multiprocessing Pool shim (reference: util/multiprocessing tests)."""
+
+import pytest
+
+import ray_tpu
+
+
+def _make_fns():
+    # defined via closure so cloudpickle ships them by value (tests/ is not
+    # importable from worker processes)
+    def square(x):
+        return x * x
+
+    def add(a, b):
+        return a + b
+
+    return square, add
+
+
+def test_pool_map_and_starmap(ray_start_regular):
+    from ray_tpu.util.multiprocessing import Pool
+    square, add = _make_fns()
+
+    with Pool(processes=2) as pool:
+        assert pool.map(square, range(10)) == [x * x for x in range(10)]
+        assert pool.starmap(add, [(1, 2), (3, 4), (5, 6)]) == [3, 7, 11]
+
+
+def test_pool_apply_and_async(ray_start_regular):
+    from ray_tpu.util.multiprocessing import Pool
+    square, add = _make_fns()
+
+    with Pool(processes=2) as pool:
+        assert pool.apply(add, (2, 3)) == 5
+        r = pool.apply_async(square, (7,))
+        r.wait(timeout=30)
+        assert r.ready() and r.successful()
+        assert r.get(timeout=30) == 49
+
+        res = pool.map_async(square, [1, 2, 3])
+        assert res.get(timeout=30) == [1, 4, 9]
+
+
+def test_pool_imap_variants(ray_start_regular):
+    from ray_tpu.util.multiprocessing import Pool
+    square, add = _make_fns()
+
+    with Pool(processes=2) as pool:
+        assert list(pool.imap(square, range(6), chunksize=2)) == [
+            0, 1, 4, 9, 16, 25]
+        assert sorted(pool.imap_unordered(square, range(6), chunksize=2)) == [
+            0, 1, 4, 9, 16, 25]
+
+
+def test_pool_close_semantics(ray_start_regular):
+    from ray_tpu.util.multiprocessing import Pool
+    square, add = _make_fns()
+
+    pool = Pool(processes=1)
+    with pytest.raises(ValueError):
+        pool.join()
+    pool.close()
+    pool.join()
+    with pytest.raises(ValueError):
+        pool.map(square, [1])
+    pool.terminate()
