@@ -54,6 +54,20 @@ def load(name: str) -> ctypes.CDLL | None:
         return lib
 
 
+def load_sched_policy() -> ctypes.CDLL | None:
+    lib = load("sched_policy")
+    if lib is None:
+        return None
+    lib.hybrid_choose.restype = ctypes.c_longlong
+    lib.hybrid_choose.argtypes = [
+        ctypes.POINTER(ctypes.c_ubyte), ctypes.POINTER(ctypes.c_ubyte),
+        ctypes.POINTER(ctypes.c_double), ctypes.c_longlong,
+        ctypes.c_longlong, ctypes.c_longlong, ctypes.c_double,
+        ctypes.c_ulonglong,
+    ]
+    return lib
+
+
 def load_plasma() -> ctypes.CDLL | None:
     lib = load("plasma_store")
     if lib is None:

@@ -42,6 +42,7 @@ class RayTpuConfig:
     # --- scheduler ---
     scheduler_top_k_fraction: float = 0.2
     scheduler_top_k_absolute: int = 1
+    enable_native_scheduler: bool = True  # C++ hybrid scorer (sched_policy.cc)
     scheduler_spread_threshold: float = 0.5
     # --- worker pool ---
     num_prestart_workers: int = 0

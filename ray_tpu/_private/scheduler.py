@@ -21,6 +21,21 @@ from ray_tpu._private.config import global_config
 from ray_tpu._private.ids import NodeID
 from ray_tpu._private.resources import NodeResources, ResourceSet
 
+_SCHED_LIB_CACHE: list = []
+
+
+def _sched_lib():
+    """Native hybrid scorer, loaded once; None -> pure-Python fallback.
+    Gated by the enable_native_scheduler config field so the toggle
+    distributes cluster-wide via the config blob like every other knob."""
+    if not global_config().enable_native_scheduler:
+        return None
+    if not _SCHED_LIB_CACHE:
+        from ray_tpu._native import load_sched_policy
+
+        _SCHED_LIB_CACHE.append(load_sched_policy())
+    return _SCHED_LIB_CACHE[0]
+
 
 @dataclass
 class SchedulingStrategy:
@@ -104,6 +119,9 @@ class ClusterResourceScheduler:
 
     def _hybrid(self, candidates, demand, prefer_node) -> Optional[NodeID]:
         cfg = global_config()
+        native = _sched_lib()
+        if native is not None:
+            return self._hybrid_native(native, cfg, candidates, demand, prefer_node)
         # Local-first: if the preferred node can run it right now, take it.
         for nid, n in candidates:
             if nid == prefer_node and n.can_allocate(demand):
@@ -114,6 +132,29 @@ class ClusterResourceScheduler:
         k = max(cfg.scheduler_top_k_absolute, int(len(scored) * cfg.scheduler_top_k_fraction))
         top = scored[: max(k, 1)]
         return self._rng.choice(top)[0]
+
+    def _hybrid_native(self, lib, cfg, candidates, demand, prefer_node) -> Optional[NodeID]:
+        """Native top-k scorer (ray_tpu/_native/sched_policy.cc); candidates
+        are already feasibility+label filtered, so feasible[i] is all-ones."""
+        import ctypes
+
+        n = len(candidates)
+        feasible = (ctypes.c_ubyte * n)(*([1] * n))
+        can_alloc = (ctypes.c_ubyte * n)(
+            *[1 if node.can_allocate(demand) else 0 for _, node in candidates])
+        util = (ctypes.c_double * n)(
+            *[node.utilization() for _, node in candidates])
+        prefer_idx = -1
+        if prefer_node is not None:
+            for i, (nid, _) in enumerate(candidates):
+                if nid == prefer_node:
+                    prefer_idx = i
+                    break
+        choice = lib.hybrid_choose(
+            feasible, can_alloc, util, n, prefer_idx,
+            cfg.scheduler_top_k_absolute, cfg.scheduler_top_k_fraction,
+            self._rng.getrandbits(63))
+        return candidates[choice][0] if choice >= 0 else None
 
     def _spread(self, candidates, demand) -> Optional[NodeID]:
         available = [(nid, n) for nid, n in candidates if n.can_allocate(demand)]

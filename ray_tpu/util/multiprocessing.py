@@ -13,15 +13,13 @@ from typing import Any, Callable, Iterable, List, Optional
 class AsyncResult:
     """reference: multiprocessing.pool.AsyncResult."""
 
-    def __init__(self, refs, single: bool):
+    def __init__(self, refs):
         self._refs = refs
-        self._single = single
 
     def get(self, timeout: Optional[float] = None):
         import ray_tpu
 
-        out = ray_tpu.get(self._refs, timeout=timeout)
-        return out[0] if self._single and isinstance(out, list) else out
+        return ray_tpu.get(self._refs, timeout=timeout)
 
     def wait(self, timeout: Optional[float] = None):
         import ray_tpu
@@ -37,6 +35,9 @@ class AsyncResult:
         return len(done) == len(refs)
 
     def successful(self) -> bool:
+        """stdlib semantics: ValueError while the result is not ready."""
+        if not self.ready():
+            raise ValueError("result is not ready")
         try:
             self.get(timeout=0)
             return True
@@ -91,7 +92,7 @@ class Pool:
 
             fn = functools.partial(fn, **kwds)
         actor = self._actors[next(self._rr)]
-        return AsyncResult(actor.run.remote(fn, tuple(args)), single=False)
+        return AsyncResult(actor.run.remote(fn, tuple(args)))
 
     def map(self, fn: Callable, iterable: Iterable,
             chunksize: Optional[int] = None) -> List[Any]:
@@ -117,27 +118,39 @@ class Pool:
         return _MapResult(refs)
 
     def imap(self, fn: Callable, iterable: Iterable, chunksize: int = 1):
-        """Ordered lazy iteration (reference: Pool.imap)."""
+        """Ordered iteration; work is submitted EAGERLY (reference Pool
+        semantics — results stream as you iterate)."""
         import ray_tpu
 
+        self._check_open()
         items = [(x,) for x in iterable]
         chunks = [items[i:i + chunksize] for i in range(0, len(items), chunksize)]
         refs = [self._actors[next(self._rr)].run_batch.remote(fn, chunk)
                 for chunk in chunks]
-        for ref in refs:
-            yield from ray_tpu.get(ref)
+
+        def _iter():
+            for ref in refs:
+                yield from ray_tpu.get(ref)
+
+        return _iter()
 
     def imap_unordered(self, fn: Callable, iterable: Iterable, chunksize: int = 1):
         import ray_tpu
 
+        self._check_open()
         items = [(x,) for x in iterable]
         chunks = [items[i:i + chunksize] for i in range(0, len(items), chunksize)]
         pending = [self._actors[next(self._rr)].run_batch.remote(fn, chunk)
                    for chunk in chunks]
-        while pending:
-            done, pending = ray_tpu.wait(pending, num_returns=1)
-            for ref in done:
-                yield from ray_tpu.get(ref)
+
+        def _iter():
+            nonlocal pending
+            while pending:
+                done, pending = ray_tpu.wait(pending, num_returns=1)
+                for ref in done:
+                    yield from ray_tpu.get(ref)
+
+        return _iter()
 
     # -- lifecycle ------------------------------------------------------
 
@@ -168,9 +181,6 @@ class Pool:
 
 class _MapResult(AsyncResult):
     """Flattens chunked results."""
-
-    def __init__(self, refs):
-        super().__init__(refs, single=False)
 
     def get(self, timeout: Optional[float] = None):
         import ray_tpu

@@ -1,0 +1,98 @@
+"""Native hybrid scheduling scorer (reference test model:
+raylet/scheduling/hybrid_scheduling_policy_test.cc)."""
+
+import pytest
+
+from ray_tpu._private.ids import NodeID
+from ray_tpu._private.resources import NodeResources, ResourceSet
+from ray_tpu._private.scheduler import ClusterResourceScheduler, _sched_lib
+
+
+def _node(cpu_total, cpu_avail):
+    n = NodeResources(ResourceSet({"CPU": cpu_total}))
+    n.available = ResourceSet({"CPU": cpu_avail})
+    return n
+
+
+@pytest.fixture
+def sched():
+    local = NodeID.random()
+    s = ClusterResourceScheduler(local)
+    return s, local
+
+
+def test_native_lib_builds():
+    assert _sched_lib() is not None, "native scorer failed to build"
+
+
+def test_prefer_local_when_it_fits(sched):
+    s, local = sched
+    other = NodeID.random()
+    s.add_or_update_node(local, _node(4, 4))
+    s.add_or_update_node(other, _node(4, 4))
+    for _ in range(10):
+        assert s.get_best_schedulable_node(
+            ResourceSet({"CPU": 1}), prefer_node=local) == local
+
+
+def test_spills_to_free_node_when_local_full(sched):
+    s, local = sched
+    other = NodeID.random()
+    s.add_or_update_node(local, _node(4, 0))   # full
+    s.add_or_update_node(other, _node(4, 4))   # free
+    for _ in range(10):
+        assert s.get_best_schedulable_node(
+            ResourceSet({"CPU": 1}), prefer_node=local) == other
+
+
+def test_queues_on_feasible_when_all_busy(sched):
+    s, local = sched
+    s.add_or_update_node(local, _node(4, 0))
+    assert s.get_best_schedulable_node(
+        ResourceSet({"CPU": 2}), prefer_node=local) == local
+
+
+def test_infeasible_returns_none(sched):
+    s, local = sched
+    s.add_or_update_node(local, _node(4, 4))
+    assert s.get_best_schedulable_node(ResourceSet({"CPU": 64})) is None
+
+
+def test_native_matches_python_on_deterministic_cases(sched):
+    """Native and Python paths agree whenever the choice is forced."""
+    import dataclasses
+
+    from ray_tpu._private import config as config_mod
+
+    s, local = sched
+    a, b = NodeID.random(), NodeID.random()
+    s.add_or_update_node(a, _node(4, 1))
+    s.add_or_update_node(b, _node(4, 0))
+    demand = ResourceSet({"CPU": 1})
+    native_choice = s.get_best_schedulable_node(demand)
+
+    prior = config_mod.global_config()
+    config_mod.set_global_config(
+        dataclasses.replace(prior, enable_native_scheduler=False))
+    try:
+        python_choice = s.get_best_schedulable_node(demand)
+    finally:
+        config_mod.set_global_config(prior)
+    assert native_choice == python_choice == a  # only a has room
+
+
+def test_top_k_respects_utilization(sched):
+    """With many nodes, picks stay within the low-utilization top-k."""
+    s, _ = sched
+    low = [NodeID.random() for _ in range(3)]
+    high = [NodeID.random() for _ in range(20)]
+    for nid in low:
+        s.add_or_update_node(nid, _node(10, 10))   # 0% used
+    for nid in high:
+        s.add_or_update_node(nid, _node(10, 1))    # 90% used
+    demand = ResourceSet({"CPU": 1})
+    picks = {s.get_best_schedulable_node(demand) for _ in range(30)}
+    # k = max(1, 0.2 * 23) = 4: the three 0%-utilized nodes plus at most
+    # one 90%-utilized tiebreak node are eligible
+    assert picks & set(low)
+    assert len(picks - set(low)) <= 1
