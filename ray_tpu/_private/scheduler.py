@@ -117,9 +117,13 @@ class ClusterResourceScheduler:
             if n.feasible(demand) and n.matches_labels(labels)
         ]
 
+    # below this node count the ctypes marshalling costs more than the
+    # Python sort it replaces; the native scorer pays off on big clusters
+    _NATIVE_MIN_NODES = 64
+
     def _hybrid(self, candidates, demand, prefer_node) -> Optional[NodeID]:
         cfg = global_config()
-        native = _sched_lib()
+        native = _sched_lib() if len(candidates) >= self._NATIVE_MIN_NODES else None
         if native is not None:
             return self._hybrid_native(native, cfg, candidates, demand, prefer_node)
         # Local-first: if the preferred node can run it right now, take it.

@@ -1170,6 +1170,14 @@ class _ActorPipeline:
         self.epoch = 1
         self.seq = 0
         self.current_addr: Optional[Tuple[str, int]] = None
+        # addr -> failure ts for incarnations we observed failing: the GCS
+        # keeps reporting a just-crashed actor ALIVE at its old address for
+        # a moment — resending there would burn retries before the restart.
+        # Entries EXPIRE (suspicion, not a verdict): a transient connection
+        # blip to a healthy actor or a restart reusing the port must not
+        # blacklist the address forever.
+        self.bad_addrs: Dict[tuple, float] = {}
+        self.BAD_ADDR_TTL_S = 5.0
         self.thread = threading.Thread(target=self._run, daemon=True, name=f"actor-pipeline-{actor_id.hex()[:8]}")
         self.thread.start()
 
@@ -1193,6 +1201,16 @@ class _ActorPipeline:
             except Exception as e:  # noqa: BLE001  (timeout waiting for alive)
                 self._fail_all(ActorUnavailableError(str(e)))
                 continue
+            suspect_ts = self.bad_addrs.get(tuple(addr))
+            if suspect_ts is not None:
+                if time.monotonic() - suspect_ts < self.BAD_ADDR_TTL_S:
+                    # probably a stale GCS view of a dead incarnation; wait
+                    # for the restart to publish a fresh address
+                    with self.w._actor_lock:
+                        self.w._actor_addr_cache.pop(self.actor_id, None)
+                    time.sleep(0.1)
+                    continue
+                del self.bad_addrs[tuple(addr)]  # suspicion expired; retry
             with self.lock:
                 if addr != self.current_addr:
                     # Actor restarted onto a new worker: new epoch; anything
@@ -1209,7 +1227,7 @@ class _ActorPipeline:
             try:
                 fut = self.w.pool.get(addr).call_async("PushActorTask", {"spec": spec, "epoch": epoch})
             except ConnectionLost:
-                self._on_failure(epoch, uncharged_seq=seq)
+                self._on_failure(epoch, addr, uncharged_seq=seq)
                 continue
             fut.add_done_callback(lambda f, s=seq, sp=spec, e=epoch, a=addr: self._on_reply(f, s, sp, e, a))
 
@@ -1239,8 +1257,9 @@ class _ActorPipeline:
                 sp, ActorUnavailableError(f"actor task {sp.name} lost connection after {sp.attempt} attempt(s)")
             )
 
-    def _on_failure(self, epoch: int, uncharged_seq: Optional[int] = None):
+    def _on_failure(self, epoch: int, addr, uncharged_seq: Optional[int] = None):
         with self.lock:
+            self.bad_addrs[tuple(addr)] = time.monotonic()
             if epoch != self.epoch:
                 return  # already rolled over
             self.current_addr = None
@@ -1272,7 +1291,7 @@ class _ActorPipeline:
             except Exception:  # noqa: BLE001
                 logger.exception("actor task reply handling failed")
         else:
-            self._on_failure(epoch)
+            self._on_failure(epoch, addr)
 
     def _fail_all(self, error: Exception):
         with self.lock:
