@@ -1201,16 +1201,19 @@ class _ActorPipeline:
             except Exception as e:  # noqa: BLE001  (timeout waiting for alive)
                 self._fail_all(ActorUnavailableError(str(e)))
                 continue
-            suspect_ts = self.bad_addrs.get(tuple(addr))
-            if suspect_ts is not None:
-                if time.monotonic() - suspect_ts < self.BAD_ADDR_TTL_S:
-                    # probably a stale GCS view of a dead incarnation; wait
-                    # for the restart to publish a fresh address
-                    with self.w._actor_lock:
-                        self.w._actor_addr_cache.pop(self.actor_id, None)
-                    time.sleep(0.1)
-                    continue
-                del self.bad_addrs[tuple(addr)]  # suspicion expired; retry
+            with self.lock:  # consistent with _on_failure's locked insert
+                suspect_ts = self.bad_addrs.get(tuple(addr))
+                suspect = (suspect_ts is not None
+                           and time.monotonic() - suspect_ts < self.BAD_ADDR_TTL_S)
+                if suspect_ts is not None and not suspect:
+                    del self.bad_addrs[tuple(addr)]  # suspicion expired; retry
+            if suspect:
+                # probably a stale GCS view of a dead incarnation; wait for
+                # the restart to publish a fresh address
+                with self.w._actor_lock:
+                    self.w._actor_addr_cache.pop(self.actor_id, None)
+                time.sleep(0.1)
+                continue
             with self.lock:
                 if addr != self.current_addr:
                     # Actor restarted onto a new worker: new epoch; anything
@@ -1259,9 +1262,11 @@ class _ActorPipeline:
 
     def _on_failure(self, epoch: int, addr, uncharged_seq: Optional[int] = None):
         with self.lock:
-            self.bad_addrs[tuple(addr)] = time.monotonic()
             if epoch != self.epoch:
-                return  # already rolled over
+                # late failure from a torn-down epoch: the address may now
+                # belong to the healthy restarted incarnation — don't suspect
+                return
+            self.bad_addrs[tuple(addr)] = time.monotonic()
             self.current_addr = None
             with self.w._actor_lock:
                 self.w._actor_addr_cache.pop(self.actor_id, None)

@@ -435,6 +435,45 @@ class Dataset:
         if carry is not None and carry.num_rows and not drop_last:
             yield block_to_batch(carry, batch_format)
 
+    def iter_jax_batches(
+        self,
+        *,
+        batch_size: Optional[int] = 256,
+        drop_last: bool = False,
+        dtypes: Optional[Dict[str, Any]] = None,
+        sharding: Optional[Any] = None,
+        device: Optional[Any] = None,
+    ) -> Iterator[Dict[str, Any]]:
+        """Stream batches as dicts of device-resident jax arrays — the
+        TPU-native analog of the reference's iter_torch_batches.
+
+        dtypes:   optional {column: jnp dtype} casts (host-side, pre-put)
+        sharding: a jax.sharding.Sharding applied to every column (e.g. a
+                  NamedSharding over the data axes for pjit'ed train steps)
+        device:   a single device (mutually exclusive with sharding)
+        """
+        if sharding is not None and device is not None:
+            raise ValueError("pass sharding or device, not both")
+        target = sharding if sharding is not None else device
+
+        def _gen():
+            import jax
+            import numpy as np
+
+            for batch in self.iter_batches(batch_size=batch_size,
+                                           batch_format="numpy",
+                                           drop_last=drop_last):
+                host = {}
+                for name, col in batch.items():
+                    if dtypes and name in dtypes:
+                        col = np.asarray(col).astype(dtypes[name])
+                    host[name] = col
+                # ONE device_put of the whole batch pytree, straight from
+                # host to the target layout — no default-device detour
+                yield jax.device_put(host, target)
+
+        return _gen()
+
     def iter_rows(self) -> Iterator[Dict[str, Any]]:
         import ray_tpu
         from ray_tpu.data.block import iter_block_rows

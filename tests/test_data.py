@@ -216,3 +216,33 @@ def test_groupby_map_groups(ray_start_regular):
     assert len(by_k) == 3
     assert by_k[0]["n"] == 4 and by_k[0]["total"] == 0 + 3 + 6 + 9
     assert by_k[2]["total"] == 2 + 5 + 8 + 11
+
+
+def test_iter_jax_batches(ray_start_regular):
+    import jax
+    import jax.numpy as jnp
+
+    import ray_tpu.data as rdata
+
+    ds = rdata.from_items([{"x": float(i), "y": i} for i in range(10)])
+    batches = list(ds.iter_jax_batches(batch_size=4, dtypes={"x": jnp.float32}))
+    assert len(batches) == 3  # 4 + 4 + 2
+    assert isinstance(batches[0]["x"], jax.Array)
+    assert batches[0]["x"].dtype == jnp.float32
+    assert batches[0]["x"].shape == (4,)
+    assert float(batches[2]["y"].sum()) == 8 + 9
+
+    # sharded placement over the test mesh's devices
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ray_tpu.parallel import MeshSpec
+
+    mesh = MeshSpec(data=2, fsdp=1).build(jax.devices()[:2])
+    shard = NamedSharding(mesh, P("data"))
+    sharded = list(ds.iter_jax_batches(batch_size=4, drop_last=True,
+                                       sharding=shard))
+    assert len(sharded) == 2
+    assert sharded[0]["y"].sharding == shard
+
+    with pytest.raises(ValueError, match="not both"):
+        next(ds.iter_jax_batches(sharding=shard, device=jax.devices()[0]))
