@@ -66,6 +66,38 @@ def init(
             if ignore_reinit_error:
                 return worker_mod._global_worker
             raise RuntimeError("ray_tpu.init() already called; use shutdown() first")
+        if address == "auto":
+            # resolved BEFORE the ray:// check so RAY_TPU_ADDRESS may point
+            # at either a head node or a client server
+            import os as _os
+
+            address = _os.environ.get("RAY_TPU_ADDRESS")
+            if not address:
+                raise ValueError(
+                    'init(address="auto") requires the RAY_TPU_ADDRESS '
+                    "environment variable (host:port of a running head node, "
+                    "or ray://host:port of a client server)")
+        if isinstance(address, str) and address.startswith("ray://"):
+            # Client (proxy) mode: drive the cluster through an in-cluster
+            # ClientServer (reference: python/ray/util/client/, ray:// URIs).
+            local_only = dict(num_cpus=num_cpus, num_tpus=num_tpus,
+                              resources=resources, labels=labels,
+                              object_store_memory=object_store_memory)
+            bad = [k for k, v in local_only.items() if v is not None]
+            if bad:
+                raise ValueError(
+                    f"{', '.join(bad)} cannot be combined with a ray:// "
+                    "address; cluster resources are configured where the "
+                    "cluster is started")
+            from ray_tpu.util.client import connect as _client_connect
+
+            cw = _client_connect(address)
+            set_global_worker(cw)
+            return cw
+        if isinstance(address, str):
+            from ray_tpu._private.utils import parse_host_port
+
+            address = parse_host_port(address)
         if _raylet_addr is None:
             if address is not None:
                 # Connect to an existing cluster: use the head node's raylet.

@@ -132,7 +132,7 @@ class RpcServer:
 
     DELAYED_REPLY = object()
 
-    def __init__(self, host: str = "127.0.0.1", num_threads: int = 16):
+    def __init__(self, host: str = "127.0.0.1", num_threads: int = 16, port: int = 0):
         self._handlers: Dict[str, Callable] = {}
         self._pool = DaemonExecutor(max_workers=num_threads, thread_name_prefix="rpc-handler")
         self._lock = threading.Lock()
@@ -156,7 +156,7 @@ class RpcServer:
             daemon_threads = True
             allow_reuse_address = True
 
-        self._server = Server((host, 0), Handler)
+        self._server = Server((host, port), Handler)
         self._host, self._port = self._server.server_address
         self._thread = threading.Thread(target=self._server.serve_forever, daemon=True, name="rpc-server")
         self._thread.start()

@@ -63,3 +63,16 @@ class DaemonExecutor:
             n = len(self._threads)
         for _ in range(n):
             self._q.put(None)
+
+
+def parse_host_port(address: str, default_host: str = "127.0.0.1"):
+    """Parse a 'host:port' string (one canonical place; init() and the
+    ray:// client both route here)."""
+    host, sep, port = address.rpartition(":")
+    if not sep or not port.isdigit():
+        raise ValueError(
+            f"address {address!r} must be 'host:port' "
+            "(or 'ray://host:port' for client mode)")
+    if host.startswith("[") and host.endswith("]"):
+        host = host[1:-1]  # bracketed IPv6 literal, e.g. [::1]:8000
+    return (host or default_host, int(port))

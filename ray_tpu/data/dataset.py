@@ -470,7 +470,18 @@ class Dataset:
                     host[name] = col
                 # ONE device_put of the whole batch pytree, straight from
                 # host to the target layout — no default-device detour
-                yield jax.device_put(host, target)
+                try:
+                    out = jax.device_put(host, target)
+                except ValueError as e:
+                    if sharding is None:
+                        raise
+                    n = len(next(iter(host.values()))) if host else 0
+                    raise ValueError(
+                        f"batch of {n} rows does not fit the requested "
+                        f"sharding (ragged final batch? pass drop_last=True, "
+                        f"or pick a batch_size dividing the row count): {e}"
+                    ) from e
+                yield out
 
         return _gen()
 

@@ -1,0 +1,22 @@
+"""Ray-Client-equivalent proxy mode (``ray://host:port``).
+
+reference: python/ray/util/client/ + ray_client.proto — a gRPC proxy server
+runs inside the cluster and external processes drive the full task/actor/
+object API through it.  Here the proxy rides the framework's own RPC layer
+(ray_tpu/_private/rpc.py) instead of gRPC.
+
+Usage, server side (a process on the cluster)::
+
+    from ray_tpu.util.client.server import ClientServer
+    srv = ClientServer(port=10001)        # init()s a local cluster if needed
+    srv.wait()                            # serve forever
+
+Client side (any machine that can reach the port)::
+
+    ray_tpu.init("ray://127.0.0.1:10001")
+    # full API: @remote fns, actors, get/put/wait, named actors, state.
+"""
+
+from ray_tpu.util.client.worker import ClientWorker, connect
+
+__all__ = ["ClientWorker", "connect"]

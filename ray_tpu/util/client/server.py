@@ -1,0 +1,298 @@
+"""Server half of the Ray-Client-equivalent proxy.
+
+reference: python/ray/util/client/server/ — the in-cluster server that holds
+real ObjectRefs/actor handles on behalf of remote clients and proxies API
+calls.  One shared in-cluster driver serves all sessions; each session's refs
+are pinned server-side until the client releases them (or the session is
+reaped after ``idle_timeout_s`` without traffic).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from collections import OrderedDict
+from typing import Any, Dict, Optional, Tuple
+
+from ray_tpu._private.utils import DaemonExecutor
+
+from ray_tpu._private import serialization
+from ray_tpu._private.rpc import RpcServer
+from ray_tpu._private.worker import ObjectRef
+
+
+class _Session:
+    def __init__(self, session_id: str):
+        self.id = session_id
+        self.refs: Dict[str, ObjectRef] = {}  # object_id hex -> pinned ref
+        self.actors: list = []  # (actor_id, detached)
+        self.last_seen = time.monotonic()
+        self.lock = threading.Lock()
+        # op-token -> reply, so a client resend after a connection blip
+        # returns the original result instead of re-running the mutation
+        self.op_cache: "OrderedDict[str, Any]" = OrderedDict()
+
+    def touch(self):
+        self.last_seen = time.monotonic()
+
+    def cached_op(self, token: Optional[str]):
+        if token is None:
+            return None
+        with self.lock:
+            return self.op_cache.get(token)
+
+    def cache_op(self, token: Optional[str], reply):
+        if token is None:
+            return
+        with self.lock:
+            self.op_cache[token] = reply
+            while len(self.op_cache) > 4096:
+                self.op_cache.popitem(last=False)
+
+    def pin(self, ref_or_refs):
+        refs = ref_or_refs if isinstance(ref_or_refs, list) else [ref_or_refs]
+        with self.lock:
+            for r in refs:
+                self.refs[r.id.hex()] = r
+        if isinstance(ref_or_refs, list):
+            return [(r.id, r.owner_addr) for r in ref_or_refs]
+        return (ref_or_refs.id, ref_or_refs.owner_addr)
+
+
+class ClientServer:
+    """Hosts remote client sessions over the framework RPC transport."""
+
+    def __init__(self, port: int = 10001, host: str = "127.0.0.1",
+                 address=None, idle_timeout_s: float = 300.0,
+                 auth_token: Optional[str] = None, **init_kwargs):
+        """``host`` defaults to loopback; to serve external clients bind an
+        explicit interface AND set ``auth_token`` (also via the
+        RAY_TPU_CLIENT_TOKEN env var) — the transport is pickle-based, so an
+        open unauthenticated port is remote code execution for anyone who
+        can reach it."""
+        import os
+
+        import ray_tpu
+
+        if auth_token is None:
+            auth_token = os.environ.get("RAY_TPU_CLIENT_TOKEN")
+        if host not in ("127.0.0.1", "localhost", "::1") and not auth_token:
+            raise ValueError(
+                f"refusing to bind ClientServer on {host!r} without an "
+                "auth_token (set one, or RAY_TPU_CLIENT_TOKEN)")
+        self._auth_token = auth_token
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(address=address, **init_kwargs)
+        self._worker = ray_tpu.get_global_worker()
+        self._sessions: Dict[str, _Session] = {}
+        self._connect_cache: "OrderedDict[str, str]" = OrderedDict()
+        self._lock = threading.Lock()
+        self._idle_timeout_s = idle_timeout_s
+        self._stopped = threading.Event()
+        self._server = RpcServer(host=host, port=port)
+        self._server.register_all(self, prefix="Client")
+        # Blocking get/wait calls run here so they can't starve the RPC
+        # handler pool (pings/releases must keep flowing while gets block).
+        self._blocking_pool = DaemonExecutor(max_workers=64,
+                                             thread_name_prefix="client-blocking")
+        self._reaper = threading.Thread(target=self._reap_loop, daemon=True,
+                                        name="client-server-reaper")
+        self._reaper.start()
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._server.address
+
+    def wait(self):
+        self._stopped.wait()
+
+    def shutdown(self):
+        self._stopped.set()
+        for sid in list(self._sessions):
+            self._drop_session(sid)
+        self._server.shutdown()
+        self._blocking_pool.shutdown(wait=False, cancel_futures=True)
+
+    # ------------------------------------------------------------------
+
+    def _session(self, payload) -> _Session:
+        s = self._sessions.get(payload["session"])
+        if s is None:
+            raise RuntimeError(f"unknown client session {payload.get('session')!r} "
+                               "(reaped after idle timeout? reconnect)")
+        s.touch()
+        return s
+
+    def _reap_loop(self):
+        while not self._stopped.wait(10.0):
+            now = time.monotonic()
+            for sid, s in list(self._sessions.items()):
+                if now - s.last_seen > self._idle_timeout_s:
+                    self._drop_session(sid)
+
+    def _drop_session(self, session_id: str):
+        with self._lock:
+            s = self._sessions.pop(session_id, None)
+        if s is None:
+            return
+        with s.lock:
+            s.refs.clear()
+        # Non-detached actors created by the session die with it, matching
+        # driver-exit semantics (reference: owned actors die with the owner).
+        for actor_id, detached in s.actors:
+            if not detached:
+                try:
+                    self._worker.kill_actor(actor_id, no_restart=True)
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def _resolve_ref(self, s: _Session, packed) -> ObjectRef:
+        object_id, owner_addr = packed
+        ref = s.refs.get(object_id.hex())
+        return ref if ref is not None else ObjectRef(object_id, owner_addr)
+
+    def _unpack_args(self, s: _Session, blob: bytes):
+        args, kwargs = serialization.loads_inline(blob)
+        return args, kwargs
+
+    # ------------------------------------------------------------------
+    # Handlers (registered as Client<Name>)
+    # ------------------------------------------------------------------
+
+    def HandleConnect(self, payload):
+        if self._auth_token and payload.get("auth") != self._auth_token:
+            raise PermissionError("client auth token missing or wrong")
+        token = payload.get("op")
+        with self._lock:
+            if token is not None and token in self._connect_cache:
+                session_id = self._connect_cache[token]
+            else:
+                session_id = uuid.uuid4().hex
+                self._sessions[session_id] = _Session(session_id)
+                if token is not None:
+                    self._connect_cache[token] = session_id
+                    while len(self._connect_cache) > 4096:
+                        self._connect_cache.popitem(last=False)
+        return {"session": session_id, "server_pid": __import__("os").getpid(),
+                "job_id": getattr(self._worker, "job_id", None)}
+
+    def HandleDisconnect(self, payload):
+        self._drop_session(payload["session"])
+        return True
+
+    def HandlePing(self, payload):
+        self._session(payload)
+        return True
+
+    def HandlePut(self, payload):
+        s = self._session(payload)
+        cached = s.cached_op(payload.get("op"))
+        if cached is not None:
+            return cached
+        value = serialization.loads_inline(payload["blob"])
+        reply = s.pin(self._worker.put(value))
+        s.cache_op(payload.get("op"), reply)
+        return reply
+
+    def HandleGet(self, payload, reply_token):
+        s = self._session(payload)
+        refs = [self._resolve_ref(s, p) for p in payload["refs"]]
+
+        def run():
+            try:
+                values = self._worker.get(refs, timeout=payload.get("timeout"))
+                if not isinstance(values, list):
+                    values = [values]
+                self._server.send_reply(
+                    reply_token, [serialization.dumps_inline(v) for v in values])
+            except Exception as e:  # noqa: BLE001
+                self._server.send_error_reply(reply_token, e)
+
+        self._blocking_pool.submit(run)
+        return RpcServer.DELAYED_REPLY
+
+    def HandleWait(self, payload, reply_token):
+        s = self._session(payload)
+        refs = [self._resolve_ref(s, p) for p in payload["refs"]]
+
+        def run():
+            try:
+                ready, not_ready = self._worker.wait(
+                    refs, num_returns=payload["num_returns"],
+                    timeout=payload.get("timeout"),
+                    fetch_local=payload.get("fetch_local", True))
+                self._server.send_reply(
+                    reply_token,
+                    ([r.id.hex() for r in ready], [r.id.hex() for r in not_ready]))
+            except Exception as e:  # noqa: BLE001
+                self._server.send_error_reply(reply_token, e)
+
+        self._blocking_pool.submit(run)
+        return RpcServer.DELAYED_REPLY
+
+    def HandleSubmitTask(self, payload):
+        s = self._session(payload)
+        cached = s.cached_op(payload.get("op"))
+        if cached is not None:
+            return cached
+        fn = serialization.loads_inline(payload["fn"])
+        args, kwargs = self._unpack_args(s, payload["args"])
+        refs = self._worker.submit_task(fn, args, kwargs, **payload["options"])
+        reply = s.pin(refs)
+        s.cache_op(payload.get("op"), reply)
+        return reply
+
+    def HandleCreateActor(self, payload):
+        s = self._session(payload)
+        cached = s.cached_op(payload.get("op"))
+        if cached is not None:
+            return cached
+        cls = serialization.loads_inline(payload["cls"])
+        args, kwargs = self._unpack_args(s, payload["args"])
+        options = payload["options"]
+        actor_id, _spec = self._worker.create_actor(cls, args, kwargs, **options)
+        s.actors.append((actor_id, options.get("lifetime") == "detached"))
+        s.cache_op(payload.get("op"), actor_id)
+        return actor_id
+
+    def HandleSubmitActorTask(self, payload):
+        s = self._session(payload)
+        cached = s.cached_op(payload.get("op"))
+        if cached is not None:
+            return cached
+        args, kwargs = self._unpack_args(s, payload["args"])
+        refs = self._worker.submit_actor_task(
+            payload["actor_id"], payload["method"], args, kwargs,
+            num_returns=payload["num_returns"],
+            max_task_retries=payload.get("max_task_retries", 0))
+        reply = s.pin(refs)
+        s.cache_op(payload.get("op"), reply)
+        return reply
+
+    def HandleKillActor(self, payload):
+        self._session(payload)
+        return self._worker.kill_actor(payload["actor_id"],
+                                       no_restart=payload.get("no_restart", True))
+
+    def HandleGetNamedActor(self, payload):
+        self._session(payload)
+        return self._worker.get_named_actor(payload["name"],
+                                            payload.get("namespace", "default"))
+
+    def HandleRelease(self, payload):
+        s = self._session(payload)
+        with s.lock:
+            for object_id in payload["ids"]:
+                s.refs.pop(object_id, None)
+        return True
+
+    def HandleFlushTaskEvents(self, payload):
+        self._session(payload)
+        self._worker.flush_task_events()
+        return True
+
+    def HandleGcsCall(self, payload):
+        """Forward control-plane reads/writes (nodes, state API, KV)."""
+        self._session(payload)
+        return self._worker.gcs.call(payload["method"], payload["payload"])

@@ -1,0 +1,229 @@
+"""Client half of the Ray-Client-equivalent proxy.
+
+reference: python/ray/util/client/worker.py — implements the same narrow
+worker surface the API layer (remote_function.py / actor.py / __init__.py)
+drives, but every call is forwarded to an in-cluster ClientServer which holds
+the real refs.  ``ray_tpu.init("ray://host:port")`` constructs one of these
+and installs it as the global worker, so the full public API works unchanged
+from outside the cluster.
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private import serialization
+from ray_tpu._private.ids import ActorID, WorkerID
+from ray_tpu._private.rpc import RpcClient
+from ray_tpu._private.worker import ObjectRef
+
+
+class _ClientReferenceCounter:
+    """Counts client-local refs; releases server pins when they hit zero."""
+
+    def __init__(self, worker: "ClientWorker"):
+        self._worker = worker
+        self._counts: Dict[bytes, int] = {}
+        self._lock = threading.Lock()
+
+    def add_local_ref(self, ref: ObjectRef):
+        key = ref.id.hex()
+        with self._lock:
+            self._counts[key] = self._counts.get(key, 0) + 1
+
+    def remove_local_ref(self, ref: ObjectRef):
+        key = ref.id.hex()
+        release = False
+        with self._lock:
+            n = self._counts.get(key, 0) - 1
+            if n <= 0:
+                self._counts.pop(key, None)
+                release = True
+            else:
+                self._counts[key] = n
+        if release:
+            self._worker._release([key])
+
+    # Serialization handoffs are tracked server-side (the server worker is
+    # the owner); the client only needs liveness of its own handles.
+    def on_ref_serialized(self, ref: ObjectRef):
+        pass
+
+    def on_ref_deserialized(self, ref: ObjectRef):
+        pass
+
+
+class _GcsProxy:
+    def __init__(self, worker: "ClientWorker"):
+        self._worker = worker
+
+    def call(self, method: str, payload=None, **_kw):
+        return self._worker._call("ClientGcsCall",
+                                  {"method": method, "payload": payload})
+
+
+class ClientWorker:
+    """Global-worker stand-in speaking to a remote ClientServer."""
+
+    def __init__(self, address: Tuple[str, int]):
+        self._rpc = RpcClient(tuple(address))
+        self.shutting_down = False
+        import os
+
+        # op token so a resend after a connection blip reuses the session
+        # instead of leaking an orphan server-side
+        reply = self._rpc.call("ClientConnect", {
+            "op": uuid.uuid4().hex,
+            "auth": os.environ.get("RAY_TPU_CLIENT_TOKEN"),
+        })
+        self._session = reply["session"]
+        # RuntimeContext surface (reference: runtime_context.py reads these
+        # off the global worker); tasks/actors never run in a client process.
+        self.job_id = reply.get("job_id")
+        self.node_id = None
+        self.worker_id = WorkerID.random()
+        self.actor_id = None
+        self.current_task_id = None
+        self.reference_counter = _ClientReferenceCounter(self)
+        self.gcs = _GcsProxy(self)
+        self._heartbeat_stop = threading.Event()
+        self._heartbeat = threading.Thread(target=self._heartbeat_loop,
+                                           daemon=True, name="client-heartbeat")
+        self._heartbeat.start()
+
+    # ------------------------------------------------------------------
+
+    def _call(self, method: str, payload: dict, timeout=RpcClient._DEFAULT_TIMEOUT):
+        payload["session"] = self._session
+        return self._rpc.call(method, payload, timeout=timeout)
+
+    def _release(self, ids: List[bytes]):
+        if self.shutting_down:
+            return
+        try:
+            self._rpc.notify("ClientRelease", {"session": self._session, "ids": ids})
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _heartbeat_loop(self):
+        while not self._heartbeat_stop.wait(30.0):
+            try:
+                self._call("ClientPing", {})
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _make_ref(self, packed) -> ObjectRef:
+        object_id, owner_addr = packed
+        return ObjectRef(object_id, owner_addr)
+
+    def _pack_refs(self, refs) -> list:
+        return [(r.id, r.owner_addr) for r in refs]
+
+    # ------------------------------------------------------------------
+    # CoreWorker surface used by the API layer
+    # ------------------------------------------------------------------
+
+    def put(self, value) -> ObjectRef:
+        packed = self._call("ClientPut",
+                            {"blob": serialization.dumps_inline(value),
+                             "op": uuid.uuid4().hex},
+                            timeout=None)
+        return self._make_ref(packed)
+
+    def get(self, refs, timeout: Optional[float] = None):
+        single = isinstance(refs, ObjectRef)
+        ref_list = [refs] if single else list(refs)
+        blobs = self._call(
+            "ClientGet",
+            {"refs": self._pack_refs(ref_list), "timeout": timeout},
+            timeout=None)
+        values = [serialization.loads_inline(b) for b in blobs]
+        return values[0] if single else values
+
+    def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
+        ready_ids, _ = self._call(
+            "ClientWait",
+            {"refs": self._pack_refs(refs), "num_returns": num_returns,
+             "timeout": timeout, "fetch_local": fetch_local},
+            timeout=None)
+        ready_set = set(ready_ids)
+        ready = [r for r in refs if r.id.hex() in ready_set]
+        not_ready = [r for r in refs if r.id.hex() not in ready_set]
+        return ready, not_ready
+
+    def submit_task(self, fn, args, kwargs, *, name=None, num_returns=1,
+                    resources=None, strategy=None, max_retries=None,
+                    retry_exceptions=False, runtime_env=None):
+        packed = self._call("ClientSubmitTask", {
+            "fn": serialization.dumps_inline(fn),
+            "args": serialization.dumps_inline((tuple(args), dict(kwargs or {}))),
+            "options": dict(name=name, num_returns=num_returns, resources=resources,
+                            strategy=strategy, max_retries=max_retries,
+                            retry_exceptions=retry_exceptions, runtime_env=runtime_env),
+            "op": uuid.uuid4().hex,
+        }, timeout=None)
+        if num_returns == 1:
+            return self._make_ref(packed)
+        return [self._make_ref(p) for p in packed]
+
+    def create_actor(self, cls, args, kwargs, *, name=None, num_returns=1,
+                     resources=None, strategy=None, max_restarts=0,
+                     max_task_retries=0, max_concurrency=1, lifetime=None,
+                     namespace="default", runtime_env=None):
+        actor_id = self._call("ClientCreateActor", {
+            "cls": serialization.dumps_inline(cls),
+            "args": serialization.dumps_inline((tuple(args), dict(kwargs or {}))),
+            "options": dict(name=name, resources=resources, strategy=strategy,
+                            max_restarts=max_restarts, max_task_retries=max_task_retries,
+                            max_concurrency=max_concurrency, lifetime=lifetime,
+                            namespace=namespace, runtime_env=runtime_env),
+            "op": uuid.uuid4().hex,
+        }, timeout=None)
+        return actor_id, None
+
+    def submit_actor_task(self, actor_id: ActorID, method_name: str, args, kwargs,
+                          num_returns=1, max_task_retries=0):
+        packed = self._call("ClientSubmitActorTask", {
+            "actor_id": actor_id,
+            "method": method_name,
+            "args": serialization.dumps_inline((tuple(args), dict(kwargs or {}))),
+            "num_returns": num_returns,
+            "max_task_retries": max_task_retries,
+            "op": uuid.uuid4().hex,
+        }, timeout=None)
+        if num_returns == 1:
+            return self._make_ref(packed)
+        return [self._make_ref(p) for p in packed]
+
+    def kill_actor(self, actor_id: ActorID, no_restart=True):
+        return self._call("ClientKillActor",
+                          {"actor_id": actor_id, "no_restart": no_restart})
+
+    def get_named_actor(self, name: str, namespace="default"):
+        return self._call("ClientGetNamedActor",
+                          {"name": name, "namespace": namespace})
+
+    def flush_task_events(self):
+        """ray_tpu.timeline() support: flush the in-cluster driver's buffer."""
+        return self._call("ClientFlushTaskEvents", {})
+
+    def shutdown(self):
+        self.shutting_down = True
+        self._heartbeat_stop.set()
+        try:
+            self._rpc.call("ClientDisconnect", {"session": self._session}, timeout=5)
+        except Exception:  # noqa: BLE001
+            pass
+        self._rpc.close()
+
+
+def connect(address) -> ClientWorker:
+    """Parse ``ray://host:port`` (or (host, port)) and open a client session."""
+    if isinstance(address, str):
+        from ray_tpu._private.utils import parse_host_port
+
+        address = address[len("ray://"):] if address.startswith("ray://") else address
+        address = parse_host_port(address)
+    return ClientWorker(tuple(address))

@@ -1,0 +1,117 @@
+"""Ray-Client-equivalent proxy mode (reference: python/ray/util/client/).
+
+The ClientServer runs in this process (attached to an in-process cluster);
+the client drives it from a subprocess via ray_tpu.init("ray://..."), which
+is the real topology (external process -> in-cluster proxy).
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+
+@pytest.fixture
+def client_server():
+    import ray_tpu
+    from ray_tpu.util.client.server import ClientServer
+
+    srv = ClientServer(port=0, host="127.0.0.1", num_cpus=4)
+    yield srv
+    srv.shutdown()
+    ray_tpu.shutdown()
+
+
+def _run_client(script: str, address):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    code = textwrap.dedent(script).replace("ADDR", f"ray://{address[0]}:{address[1]}")
+    proc = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                          text=True, timeout=120, env=env)
+    assert proc.returncode == 0, f"client failed:\n{proc.stdout}\n{proc.stderr}"
+    return proc.stdout
+
+
+def test_client_tasks_and_objects(client_server):
+    out = _run_client(
+        """
+        import ray_tpu
+
+        ray_tpu.init("ADDR")
+
+        @ray_tpu.remote
+        def add(a, b):
+            return a + b
+
+        # plain task
+        assert ray_tpu.get(add.remote(1, 2)) == 3
+        # ref args resolve server-side
+        ref = ray_tpu.put(10)
+        assert ray_tpu.get(add.remote(ref, 5)) == 15
+        # wait
+        refs = [add.remote(i, i) for i in range(4)]
+        ready, not_ready = ray_tpu.wait(refs, num_returns=4, timeout=30)
+        assert len(ready) == 4 and not not_ready
+        assert sorted(ray_tpu.get(ready)) == [0, 2, 4, 6]
+        # num_returns > 1
+        @ray_tpu.remote(num_returns=2)
+        def pair():
+            return 1, 2
+
+        r1, r2 = pair.remote()
+        assert ray_tpu.get([r1, r2]) == [1, 2]
+        print("TASKS_OK")
+        ray_tpu.shutdown()
+        """,
+        client_server.address)
+    assert "TASKS_OK" in out
+
+
+def test_client_actors_and_errors(client_server):
+    out = _run_client(
+        """
+        import ray_tpu
+
+        ray_tpu.init("ADDR")
+
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self, start):
+                self.n = start
+
+            def incr(self, k=1):
+                self.n += k
+                return self.n
+
+        c = Counter.remote(100)
+        assert ray_tpu.get(c.incr.remote()) == 101
+        assert ray_tpu.get(c.incr.remote(9)) == 110
+
+        # named actor lookup through the proxy
+        named = Counter.options(name="counter", lifetime="detached").remote(0)
+        ray_tpu.get(named.incr.remote())
+        h = ray_tpu.get_actor("counter")
+        assert ray_tpu.get(h.incr.remote()) == 2
+        ray_tpu.kill(h)
+
+        # errors propagate with the original exception type
+        @ray_tpu.remote
+        def boom():
+            raise ValueError("boom!")
+
+        try:
+            ray_tpu.get(boom.remote())
+            raise AssertionError("expected error")
+        except Exception as e:
+            assert "boom!" in str(e)
+
+        # cluster state through the gcs proxy
+        assert len(ray_tpu.nodes()) >= 1
+        assert ray_tpu.cluster_resources().get("CPU", 0) >= 4
+        print("ACTORS_OK")
+        ray_tpu.shutdown()
+        """,
+        client_server.address)
+    assert "ACTORS_OK" in out
