@@ -54,6 +54,7 @@ def init(
     labels: Optional[Dict[str, str]] = None,
     object_store_memory: Optional[int] = None,
     ignore_reinit_error: bool = False,
+    log_to_driver: bool = True,
     _raylet_addr: Optional[Tuple[str, int]] = None,
     _gcs_addr: Optional[Tuple[str, int]] = None,
 ) -> CoreWorker:
@@ -129,6 +130,8 @@ def init(
                 _gcs_addr = _local_node.gcs_address
         w = CoreWorker(mode=DRIVER, raylet_addr=_raylet_addr, gcs_addr=_gcs_addr)
         set_global_worker(w)
+        if log_to_driver and not __import__("os").environ.get("RAY_TPU_WORKER_QUIET"):
+            w.subscribe_worker_logs()
         return w
 
 

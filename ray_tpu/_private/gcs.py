@@ -26,7 +26,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from ray_tpu._private.config import RayTpuConfig, global_config
 from ray_tpu._private.ids import ActorID, JobID, NodeID, PlacementGroupID, WorkerID
 from ray_tpu._private.resources import NodeResources, ResourceSet
-from ray_tpu._private.rpc import ClientPool, RpcServer
+from ray_tpu._private.rpc import ClientPool, ConnectionLost, RpcServer
 from ray_tpu._private.scheduler import ClusterResourceScheduler
 from ray_tpu._private.task_spec import ActorDiedError, TaskSpec
 
@@ -78,6 +78,7 @@ class Pubsub:
     def __init__(self, pool: ClientPool):
         self._subs: Dict[str, List[Tuple[Tuple[str, int], str]]] = {}
         self._pool = pool
+        self._fails: Dict[Tuple[Tuple[str, int], str], int] = {}
         self._lock = threading.Lock()
 
     def subscribe(self, channel: str, subscriber_addr: Tuple[str, int], method: str = "PubsubMessage"):
@@ -96,16 +97,44 @@ class Pubsub:
         with self._lock:
             subs = list(self._subs.get(channel, []))
         for addr, method in subs:
+            key = (addr, method)
             try:
-                self._pool.get(addr).notify(method, {"channel": channel, "message": message})
+                fut = self._pool.get(addr).call_async(
+                    method, {"channel": channel, "message": message})
             except Exception:  # noqa: BLE001
-                pass
+                self._note_publish_result(channel, key, ok=False)
+                continue
+            # only UNREACHABILITY counts toward eviction — a handler that
+            # raises proves the peer is alive (the error frame came back)
+            fut.add_done_callback(
+                lambda f, key=key: self._note_publish_result(
+                    channel, key,
+                    ok=not isinstance(f.exception(), ConnectionLost)))
+
+    def _note_publish_result(self, channel: str, key, ok: bool):
+        """Evict subscribers that stay unreachable (dead drivers that never
+        unsubscribed), so publishing doesn't burn a connect attempt per dead
+        peer forever."""
+        evict = False
+        with self._lock:
+            if ok:
+                self._fails.pop(key, None)
+                return
+            n = self._fails.get(key, 0) + 1
+            self._fails[key] = n
+            if n >= 3:
+                self._subs[channel] = [
+                    s for s in self._subs.get(channel, []) if s != key]
+                self._fails.pop(key, None)
+                evict = True
+        if evict:
+            self._pool.invalidate(key[0])
 
 
 class GcsServer:
     """All GCS managers behind one RpcServer."""
 
-    def __init__(self, host: str = "127.0.0.1", config: Optional[RayTpuConfig] = None):
+    def __init__(self, host: str = "127.0.0.1", config: Optional[RayTpuConfig] = None, port: int = 0):
         self.config = config or global_config()
         self.pool = ClientPool()
         self.pubsub = Pubsub(self.pool)
@@ -130,7 +159,7 @@ class GcsServer:
             max_workers=32, thread_name_prefix="gcs-actor-create"
         )
 
-        self.server = RpcServer(host=host)
+        self.server = RpcServer(host=host, port=port)
         self.server.register_all(self)
         self._threads = [
             threading.Thread(target=self._actor_scheduling_loop, daemon=True, name="gcs-actor-sched"),

@@ -24,10 +24,12 @@ class Node:
         labels: Optional[Dict[str, str]] = None,
         object_store_memory: Optional[int] = None,
         env: Optional[Dict[str, str]] = None,
+        gcs_port: int = 0,
+        gcs_host: str = "127.0.0.1",
     ):
         self.gcs: Optional[GcsServer] = None
         if head:
-            self.gcs = GcsServer()
+            self.gcs = GcsServer(host=gcs_host, port=gcs_port)
             gcs_address = self.gcs.address
         assert gcs_address is not None, "worker node needs gcs_address"
         self.gcs_address = tuple(gcs_address)

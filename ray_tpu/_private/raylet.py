@@ -108,6 +108,11 @@ class Raylet:
         self.server = RpcServer()
         self.server.register_all(self)
 
+        from ray_tpu._private.log_monitor import LogMonitor
+
+        self._log_monitor = LogMonitor(self.gcs, self.server.address[0],
+                                       self.node_id.hex())
+
         self._lock = threading.RLock()
         self._dispatch_cv = threading.Condition(self._lock)
         self._spawning_procs: Dict[int, subprocess.Popen] = {}
@@ -156,6 +161,7 @@ class Raylet:
 
     def shutdown(self):
         self._stopped.set()
+        self._log_monitor.stop()
         with self._lock:
             workers = list(self._all_workers.values())
             self._dispatch_cv.notify_all()
@@ -245,12 +251,19 @@ class Raylet:
 
             env["RAY_TPU_RUNTIME_ENV"] = json.dumps(runtime_env)
             env["RAY_TPU_RUNTIME_ENV_HASH"] = env_hash
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu._private.workers_main"],
-            env=env,
-            stdout=subprocess.DEVNULL if os.environ.get("RAY_TPU_WORKER_QUIET") else None,
-            stderr=None,
-        )
+        # Workers write to per-process log files which the node's log monitor
+        # tails to the driver (reference: _private/log_monitor.py); unbuffered
+        # so prints land promptly.
+        env.setdefault("PYTHONUNBUFFERED", "1")
+        log_file = self._log_monitor.new_log_file()
+        with open(log_file, "ab") as lf:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu._private.workers_main"],
+                env=env,
+                stdout=lf,
+                stderr=subprocess.STDOUT,
+            )
+        self._log_monitor.register_pid(log_file, proc.pid)
         self._spawning_procs[proc.pid] = proc
         threading.Thread(
             target=self._watch_spawn, args=(proc, env_hash), daemon=True,
@@ -498,6 +511,10 @@ class Raylet:
         worker.lease_id = lease_id
         if p.for_actor:
             worker.dedicated_actor = p.spec.actor_id
+        if worker.proc is not None and p.spec.job_id is not None:
+            # job attribution for the log plane (approximate: a reused worker
+            # is re-tagged at its next lease, like the reference's log runtime)
+            self._log_monitor.set_job(worker.proc.pid, p.spec.job_id.hex())
         self.server.send_reply(
             p.reply_token,
             {

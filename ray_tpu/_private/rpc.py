@@ -132,10 +132,17 @@ class RpcServer:
 
     DELAYED_REPLY = object()
 
-    def __init__(self, host: str = "127.0.0.1", num_threads: int = 16, port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", num_threads: int = 16, port: int = 0,
+                 handshake_token: Optional[str] = None):
+        """``handshake_token``: require every connection to present this
+        token as a raw-bytes preamble BEFORE any frame is parsed — the frame
+        payloads are pickles, so an exposed port must authenticate ahead of
+        the first ``pickle.loads`` (used by the ray:// client server when
+        bound off-loopback)."""
         self._handlers: Dict[str, Callable] = {}
         self._pool = DaemonExecutor(max_workers=num_threads, thread_name_prefix="rpc-handler")
         self._lock = threading.Lock()
+        self._handshake = handshake_token.encode() if handshake_token else None
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -144,6 +151,14 @@ class RpcServer:
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 send_lock = threading.Lock()
                 try:
+                    if outer._handshake is not None:
+                        import hmac
+
+                        preamble = _recv_exact(sock, 4 + len(outer._handshake))
+                        if not hmac.compare_digest(
+                                preamble, b"RTPU" + outer._handshake):
+                            sock.close()
+                            return
                     while True:
                         header = _recv_exact(sock, _HEADER.size)
                         msg_id, length = _HEADER.unpack(header)
@@ -239,7 +254,9 @@ class RpcClient:
     calls retry on connection loss up to a deadline, with exponential backoff.
     """
 
-    def __init__(self, address: Tuple[str, int], connect_timeout: Optional[float] = None):
+    def __init__(self, address: Tuple[str, int], connect_timeout: Optional[float] = None,
+                 handshake_token: Optional[str] = None):
+        self._handshake = handshake_token.encode() if handshake_token else None
         self._address = tuple(address)
         self._sock: Optional[socket.socket] = None
         self._send_lock = threading.Lock()
@@ -269,6 +286,11 @@ class RpcClient:
                 raise ConnectionLost(f"cannot connect to {self._address}")
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             sock.settimeout(None)
+            if self._handshake is not None:
+                try:
+                    sock.sendall(b"RTPU" + self._handshake)
+                except OSError:
+                    raise ConnectionLost(f"handshake to {self._address} failed")
             self._sock = sock
             self._reader = threading.Thread(target=self._read_loop, args=(sock,), daemon=True, name="rpc-client-reader")
             self._reader.start()

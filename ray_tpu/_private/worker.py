@@ -314,10 +314,17 @@ class CoreWorker:
         self._actor_cv = threading.Condition(self._actor_lock)
 
         self.job_id = job_id
+        self.log_to_driver = False
         if mode == DRIVER:
             self.job_id = self.gcs.call("RegisterJob", {"driver_addr": self.server.address})
 
         self.current_task_id: Optional[TaskID] = None
+
+    def subscribe_worker_logs(self):
+        """Echo workers' stdout/stderr lines here (reference: log_to_driver)."""
+        self.log_to_driver = True
+        self.gcs.call("Subscribe", {"channel": "WORKER_LOGS",
+                                    "subscriber_addr": self.server.address})
 
     # ------------------------------------------------------------------
 
@@ -327,6 +334,13 @@ class CoreWorker:
 
     def shutdown(self):
         self.shutting_down = True
+        if self.log_to_driver:
+            try:
+                self.gcs.call("Unsubscribe",
+                              {"channel": "WORKER_LOGS",
+                               "subscriber_addr": self.server.address}, timeout=5)
+            except Exception:  # noqa: BLE001
+                pass
         if self.mode == DRIVER and self.job_id is not None:
             try:
                 self.gcs.call("JobFinished", {"job_id": self.job_id}, timeout=5)
@@ -593,6 +607,17 @@ class CoreWorker:
 
     def HandlePubsubMessage(self, req):
         channel, message = req["channel"], req["message"]
+        if channel == "WORKER_LOGS":
+            if self.log_to_driver and not self.shutting_down:
+                # echo only this job's workers (unattributed lines — a worker
+                # not yet leased — are shown by every driver)
+                job = message.get("job")
+                mine = getattr(self.job_id, "hex", lambda: None)()
+                if job is None or mine is None or job == mine:
+                    pid, ip = message.get("pid"), message.get("ip")
+                    for line in message.get("lines", ()):
+                        print(f"(pid={pid}, ip={ip}) {line}", flush=True)
+            return True
         if channel.startswith("ACTOR:"):
             actor_id = message.get("actor_id")
             with self._actor_lock:

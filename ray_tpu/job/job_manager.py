@@ -60,6 +60,17 @@ class JobSupervisor:
 
     def _run(self):
         env = dict(os.environ)
+        try:
+            # entrypoint drivers connect to THIS cluster via init("auto")
+            from ray_tpu._private.worker import get_global_worker
+
+            gcs_host, gcs_port = get_global_worker().gcs.address
+            # unconditional: a stale RAY_TPU_ADDRESS inherited from the
+            # node's shell must not point the job at some other cluster
+            # (runtime_env env_vars below may still override deliberately)
+            env["RAY_TPU_ADDRESS"] = f"{gcs_host}:{gcs_port}"
+        except Exception:  # noqa: BLE001 — driverless unit tests
+            pass
         env.update((self._info.runtime_env or {}).get("env_vars", {}))
         with self._lock:
             # stop() may have landed before the subprocess ever spawned

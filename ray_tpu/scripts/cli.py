@@ -1,0 +1,435 @@
+"""`python -m ray_tpu` — the cluster CLI.
+
+reference: the `ray` CLI (python/ray/scripts/scripts.py: start/stop/status),
+the state CLI (`ray list ...`, python/ray/util/state/state_cli.py) and the
+job CLI (dashboard/modules/job/cli.py), collapsed into one argparse tool:
+
+    python -m ray_tpu start --head --port 6380 [--num-cpus N] [--block]
+    python -m ray_tpu start --address HOST:6380          # join as worker
+    python -m ray_tpu status [--address ...]
+    python -m ray_tpu list actors|tasks|nodes|objects|workers|jobs|pgs
+    python -m ray_tpu summary tasks|actors
+    python -m ray_tpu timeline -o trace.json
+    python -m ray_tpu job submit -- python train.py
+    python -m ray_tpu job status|logs|stop <id>  /  job list
+    python -m ray_tpu stop
+
+Node processes started without --block daemonize themselves and record a
+session file under /tmp/ray_tpu/ which `stop` and address discovery read;
+`start --head` prints the RAY_TPU_ADDRESS to export so drivers can
+``ray_tpu.init("auto")``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+SESSION_DIR = Path(os.environ.get("RAY_TPU_SESSION_DIR", "/tmp/ray_tpu"))
+
+
+def _session_files():
+    return sorted(SESSION_DIR.glob("session_*.json"))
+
+
+def _live_sessions():
+    out = []
+    for f in _session_files():
+        try:
+            info = json.loads(f.read_text())
+            os.kill(info["pid"], 0)
+        except (OSError, ValueError, KeyError):
+            try:
+                f.unlink()
+            except OSError:
+                pass
+            continue
+        out.append((f, info))
+    return out
+
+
+def _resolve_address(args) -> str:
+    addr = getattr(args, "address", None) or os.environ.get("RAY_TPU_ADDRESS")
+    if not addr:
+        heads = [i for _, i in _live_sessions() if i.get("head")]
+        if heads:
+            addr = heads[0]["address"]
+    if not addr:
+        raise SystemExit("no cluster found: pass --address, set RAY_TPU_ADDRESS, "
+                         "or run `python -m ray_tpu start --head` first")
+    return addr
+
+
+def _connect(args):
+    import ray_tpu
+
+    ray_tpu.init(address=_resolve_address(args))
+    return ray_tpu
+
+
+# ---------------------------------------------------------------------------
+# start / stop / status
+# ---------------------------------------------------------------------------
+
+
+def cmd_start(args) -> int:
+    if not args.head and not args.address:
+        raise SystemExit("start needs --head or --address HOST:PORT")
+    if not args.block:
+        # Re-exec ourselves detached with --block; wait for the session file.
+        SESSION_DIR.mkdir(parents=True, exist_ok=True)
+        marker = SESSION_DIR / f"starting_{os.getpid()}_{int(time.time())}"
+        cmd = [sys.executable, "-m", "ray_tpu", "start", "--block",
+               "--_ready-file", str(marker)] + _reargs(args)
+        proc = subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL,
+                                start_new_session=True)
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if marker.exists():
+                info = json.loads(marker.read_text())
+                marker.unlink()
+                _print_started(info)
+                return 0
+            if proc.poll() is not None:
+                raise SystemExit(f"node process exited with {proc.returncode}")
+            time.sleep(0.2)
+        raise SystemExit("timed out waiting for the node to come up")
+
+    # --block: run the node in this process until signalled.
+    from ray_tpu._private.node import Node
+
+    resources = json.loads(args.resources) if args.resources else {}
+    if args.num_cpus is not None:
+        resources["CPU"] = float(args.num_cpus)
+    if args.num_tpus is not None:
+        resources["TPU"] = float(args.num_tpus)
+    labels = json.loads(args.labels) if args.labels else None
+
+    if args.head:
+        node = Node(head=True, resources=resources or None, labels=labels,
+                    gcs_host=args.host, gcs_port=args.port)
+        # advertise a routable address, never the wildcard bind host
+        address = f"{_advertise_host(args.host)}:{node.gcs_address[1]}"
+    else:
+        from ray_tpu._private.utils import parse_host_port
+
+        node = Node(head=False, gcs_address=parse_host_port(args.address),
+                    resources=resources or None, labels=labels)
+        address = args.address
+
+    info = {"pid": os.getpid(), "head": args.head, "address": address,
+            "node_id": node.node_id.hex()}
+
+    extra = []
+    if args.head and args.dashboard:
+        from ray_tpu.dashboard.head import start_dashboard
+
+        # the dashboard talks to the GCS through a driver connection
+        import ray_tpu
+
+        ray_tpu.init(address=address)
+        dash = start_dashboard(port=args.dashboard_port)
+        info["dashboard_url"] = dash.url
+        extra.append(dash)
+    if args.head and args.client_server_port is not None:
+        from ray_tpu.util.client.server import ClientServer
+
+        # bind where the GCS binds; off-loopback requires RAY_TPU_CLIENT_TOKEN
+        cs = ClientServer(port=args.client_server_port, host=args.host,
+                          address=address)
+        info["client_server"] = f"ray://{cs.address[0]}:{cs.address[1]}"
+        extra.append(cs)
+
+    SESSION_DIR.mkdir(parents=True, exist_ok=True)
+    session_file = SESSION_DIR / f"session_{os.getpid()}.json"
+    session_file.write_text(json.dumps(info))
+    if args._ready_file:
+        # atomic write: the parent polls exists() and must never read a
+        # half-written marker
+        tmp = Path(args._ready_file + ".tmp")
+        tmp.write_text(json.dumps(info))
+        os.replace(tmp, args._ready_file)
+
+    stop = {"flag": False}
+
+    def _sig(_n, _f):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGTERM, _sig)
+    signal.signal(signal.SIGINT, _sig)
+    try:
+        while not stop["flag"]:
+            time.sleep(0.5)
+    finally:
+        for e in extra:
+            try:
+                e.shutdown()
+            except Exception:  # noqa: BLE001
+                pass
+        node.shutdown()
+        try:
+            session_file.unlink()
+        except OSError:
+            pass
+    return 0
+
+
+def _advertise_host(bind_host: str) -> str:
+    """Connectable host for a given bind host (wildcards -> primary IP)."""
+    if bind_host not in ("0.0.0.0", "::"):
+        return bind_host
+    import socket
+
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect(("8.8.8.8", 80))  # no packets sent; picks the route
+            return s.getsockname()[0]
+        finally:
+            s.close()
+    except OSError:
+        return socket.gethostbyname(socket.gethostname())
+
+
+def _reargs(args) -> list:
+    """Re-serialize start flags for the daemonized child."""
+    out = []
+    if args.head:
+        out.append("--head")
+    if args.address:
+        out += ["--address", args.address]
+    out += ["--host", args.host, "--port", str(args.port)]
+    if args.num_cpus is not None:
+        out += ["--num-cpus", str(args.num_cpus)]
+    if args.num_tpus is not None:
+        out += ["--num-tpus", str(args.num_tpus)]
+    if args.resources:
+        out += ["--resources", args.resources]
+    if args.labels:
+        out += ["--labels", args.labels]
+    if args.dashboard:
+        out.append("--dashboard")
+    if args.dashboard_port:
+        out += ["--dashboard-port", str(args.dashboard_port)]
+    if args.client_server_port is not None:
+        out += ["--client-server-port", str(args.client_server_port)]
+    return out
+
+
+def _print_started(info):
+    print(f"started {'head' if info.get('head') else 'worker'} node "
+          f"(pid {info['pid']})")
+    print(f"  address: {info['address']}")
+    if info.get("dashboard_url"):
+        print(f"  dashboard: {info['dashboard_url']}")
+    if info.get("client_server"):
+        print(f"  client server: {info['client_server']}")
+    if info.get("head"):
+        print("connect drivers with:")
+        print(f'  export RAY_TPU_ADDRESS={info["address"]}  # then ray_tpu.init("auto")')
+
+
+def cmd_stop(args) -> int:
+    n = 0
+    for f, info in _live_sessions():
+        try:
+            os.kill(info["pid"], signal.SIGTERM)
+            n += 1
+            print(f"stopped pid {info['pid']} ({'head' if info.get('head') else 'worker'})")
+        except OSError:
+            pass
+    # give nodes a moment to unlink their session files
+    deadline = time.monotonic() + 10
+    while _live_sessions() and time.monotonic() < deadline:
+        time.sleep(0.2)
+    if not n:
+        print("no running nodes found")
+    return 0
+
+
+def cmd_status(args) -> int:
+    rt = _connect(args)
+    nodes = rt.nodes()
+    total, avail = rt.cluster_resources(), rt.available_resources()
+    print(f"nodes: {sum(1 for n in nodes if n['state'] == 'ALIVE')} alive / {len(nodes)} total")
+    print("resources (available / total):")
+    for k in sorted(total):
+        print(f"  {k:24s} {avail.get(k, 0):>10g} / {total[k]:g}")
+    for n in nodes:
+        mark = "head" if n.get("is_head") else "worker"
+        print(f"  node {n['node_id'].hex()[:12]} [{mark}] {n['state']}"
+              f" labels={n.get('labels') or {}}")
+    rt.shutdown()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# state listings
+# ---------------------------------------------------------------------------
+
+_LIST_KINDS = ("actors", "tasks", "nodes", "objects", "workers", "jobs", "pgs")
+
+
+def cmd_list(args) -> int:
+    rt = _connect(args)
+    from ray_tpu.util import state
+
+    fn = {"actors": state.list_actors, "tasks": state.list_tasks,
+          "nodes": state.list_nodes, "objects": state.list_objects,
+          "workers": state.list_workers, "jobs": state.list_jobs,
+          "pgs": state.list_placement_groups}[args.kind]
+    rows = fn(limit=args.limit)
+    for r in rows:
+        print(json.dumps(_jsonable(r), default=str))
+    print(f"# {len(rows)} {args.kind}", file=sys.stderr)
+    rt.shutdown()
+    return 0
+
+
+def cmd_summary(args) -> int:
+    rt = _connect(args)
+    from ray_tpu.util.state.api import StateApiClient
+
+    c = StateApiClient()
+    data = c.summarize_tasks() if args.kind == "tasks" else c.summarize_actors()
+    print(json.dumps(_jsonable(data), indent=2, default=str))
+    rt.shutdown()
+    return 0
+
+
+def _jsonable(obj):
+    if isinstance(obj, dict):
+        return {str(k): _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v) for v in obj]
+    if hasattr(obj, "hex") and not isinstance(obj, (bytes, str)):
+        try:
+            return obj.hex()
+        except TypeError:
+            pass
+    return obj
+
+
+def cmd_timeline(args) -> int:
+    rt = _connect(args)
+    events = rt.timeline(args.output)
+    print(f"wrote {len(events)} events to {args.output}")
+    rt.shutdown()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# jobs
+# ---------------------------------------------------------------------------
+
+
+def cmd_job(args) -> int:
+    rt = _connect(args)
+    from ray_tpu.job.job_manager import JobSubmissionClient
+
+    client = JobSubmissionClient()
+    if args.job_cmd == "submit":
+        entrypoint = " ".join(args.entrypoint)
+        runtime_env = json.loads(args.runtime_env) if args.runtime_env else None
+        sid = client.submit_job(entrypoint=entrypoint, runtime_env=runtime_env)
+        print(sid)
+        if args.wait:
+            status = client.get_job_status(sid)
+            while status in ("PENDING", "RUNNING"):
+                time.sleep(1.0)
+                status = client.get_job_status(sid)
+            print(status)
+            print(client.get_job_logs(sid), end="")
+            rt.shutdown()
+            return 0 if status == "SUCCEEDED" else 1
+    elif args.job_cmd == "status":
+        print(client.get_job_status(args.id))
+    elif args.job_cmd == "logs":
+        print(client.get_job_logs(args.id), end="")
+    elif args.job_cmd == "stop":
+        print("stopped" if client.stop_job(args.id) else "not running")
+    elif args.job_cmd == "list":
+        for info in client.list_jobs():
+            print(json.dumps({"submission_id": info.submission_id,
+                              "status": info.status,
+                              "entrypoint": info.entrypoint}, default=str))
+    rt.shutdown()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="ray_tpu", description=__doc__,
+                                formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("start", help="start a head or worker node")
+    sp.add_argument("--head", action="store_true")
+    sp.add_argument("--address", default=None, help="head HOST:PORT to join")
+    sp.add_argument("--host", default="127.0.0.1")
+    sp.add_argument("--port", type=int, default=0, help="GCS port (head only; 0=auto)")
+    sp.add_argument("--num-cpus", type=float, default=None)
+    sp.add_argument("--num-tpus", type=float, default=None)
+    sp.add_argument("--resources", default=None, help='JSON, e.g. \'{"TPU": 4}\'')
+    sp.add_argument("--labels", default=None, help="JSON node labels")
+    sp.add_argument("--dashboard", action="store_true")
+    sp.add_argument("--dashboard-port", type=int, default=0)
+    sp.add_argument("--client-server-port", type=int, default=None,
+                    help="also serve ray:// clients on this port (head only)")
+    sp.add_argument("--block", action="store_true", help="run in the foreground")
+    sp.add_argument("--_ready-file", dest="_ready_file", default=None,
+                    help=argparse.SUPPRESS)
+    sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("stop", help="stop all locally-started nodes")
+    sp.set_defaults(fn=cmd_stop)
+
+    for name, fn in (("status", cmd_status), ("timeline", cmd_timeline)):
+        sp = sub.add_parser(name)
+        sp.add_argument("--address", default=None)
+        if name == "timeline":
+            sp.add_argument("-o", "--output", default="timeline.json")
+        sp.set_defaults(fn=fn)
+
+    sp = sub.add_parser("list", help="list cluster state")
+    sp.add_argument("kind", choices=_LIST_KINDS)
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--limit", type=int, default=1000)
+    sp.set_defaults(fn=cmd_list)
+
+    sp = sub.add_parser("summary", help="summarize tasks/actors by state")
+    sp.add_argument("kind", choices=("tasks", "actors"))
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_summary)
+
+    sp = sub.add_parser("job", help="job submission")
+    jsub = sp.add_subparsers(dest="job_cmd", required=True)
+    j = jsub.add_parser("submit")
+    j.add_argument("--address", default=None)
+    j.add_argument("--runtime-env", default=None, help="JSON runtime env")
+    j.add_argument("--wait", action="store_true", help="block until done, print logs")
+    j.add_argument("entrypoint", nargs=argparse.REMAINDER,
+                   help="command, e.g. -- python train.py")
+    j.set_defaults(fn=cmd_job)
+    for name in ("status", "logs", "stop"):
+        j = jsub.add_parser(name)
+        j.add_argument("id")
+        j.add_argument("--address", default=None)
+        j.set_defaults(fn=cmd_job)
+    j = jsub.add_parser("list")
+    j.add_argument("--address", default=None)
+    j.set_defaults(fn=cmd_job)
+
+    args = p.parse_args(argv)
+    if getattr(args, "entrypoint", None):
+        args.entrypoint = [a for a in args.entrypoint if a != "--"]
+    return args.fn(args)

@@ -90,7 +90,11 @@ class ClientServer:
         self._lock = threading.Lock()
         self._idle_timeout_s = idle_timeout_s
         self._stopped = threading.Event()
-        self._server = RpcServer(host=host, port=port)
+        # Off-loopback the token doubles as a transport-level handshake,
+        # checked byte-for-byte BEFORE any frame is unpickled (the payloads
+        # are pickles; unauthenticated unpickling would be code execution).
+        self._server = RpcServer(host=host, port=port,
+                                 handshake_token=auth_token)
         self._server.register_all(self, prefix="Client")
         # Blocking get/wait calls run here so they can't starve the RPC
         # handler pool (pings/releases must keep flowing while gets block).
@@ -195,13 +199,47 @@ class ClientServer:
         s.cache_op(payload.get("op"), reply)
         return reply
 
+    def _session_alive(self, s: _Session) -> bool:
+        return not self._stopped.is_set() and s.id in self._sessions
+
+    def _poll_until(self, s: _Session, refs, num_returns, timeout):
+        """Wait in short slices so an abandoned call frees its pool thread
+        when the session dies, instead of wedging the blocking pool forever."""
+        uniq = list({r.id: r for r in refs}.values())
+        num_returns = min(num_returns, len(uniq))
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if not self._session_alive(s):
+                raise ConnectionError("client session closed while waiting")
+            slice_t = 2.0
+            if deadline is not None:
+                slice_t = min(2.0, max(0.0, deadline - time.monotonic()))
+            ready, not_ready = self._worker.wait(
+                uniq, num_returns=num_returns, timeout=slice_t)
+            if len(ready) >= num_returns:
+                return ready, not_ready
+            if deadline is not None and time.monotonic() >= deadline:
+                return ready, not_ready
+
     def HandleGet(self, payload, reply_token):
         s = self._session(payload)
         refs = [self._resolve_ref(s, p) for p in payload["refs"]]
 
         def run():
             try:
-                values = self._worker.get(refs, timeout=payload.get("timeout"))
+                timeout = payload.get("timeout")
+                start = time.monotonic()
+                ready, _ = self._poll_until(
+                    s, refs, len({r.id for r in refs}), timeout)
+                if len(ready) < len({r.id for r in refs}):
+                    from ray_tpu._private.task_spec import GetTimeoutError
+
+                    raise GetTimeoutError(f"get() timed out after {timeout}s")
+                # readiness consumed part of the budget; the data fetch gets
+                # the remainder (or forever, matching timeout=None semantics)
+                remaining = (None if timeout is None
+                             else max(0.1, timeout - (time.monotonic() - start)))
+                values = self._worker.get(refs, timeout=remaining)
                 if not isinstance(values, list):
                     values = [values]
                 self._server.send_reply(
@@ -218,10 +256,8 @@ class ClientServer:
 
         def run():
             try:
-                ready, not_ready = self._worker.wait(
-                    refs, num_returns=payload["num_returns"],
-                    timeout=payload.get("timeout"),
-                    fetch_local=payload.get("fetch_local", True))
+                ready, not_ready = self._poll_until(
+                    s, refs, payload["num_returns"], payload.get("timeout"))
                 self._server.send_reply(
                     reply_token,
                     ([r.id.hex() for r in ready], [r.id.hex() for r in not_ready]))
@@ -279,6 +315,26 @@ class ClientServer:
         self._session(payload)
         return self._worker.get_named_actor(payload["name"],
                                             payload.get("namespace", "default"))
+
+    def HandleRefDeserialized(self, payload):
+        """A ref nested inside a value was unpickled client-side; play the
+        borrowing worker's half of the transit protocol here and pin the ref
+        for the session (released via the normal ClientRelease path)."""
+        s = self._session(payload)
+        object_id, owner_addr = payload["ref"]
+        ref = ObjectRef(object_id, owner_addr)
+        self._worker.reference_counter.on_ref_deserialized(ref)
+        s.pin(ref)
+        return True
+
+    def HandleRefSerialized(self, payload):
+        """A session ref was pickled into client-side args; pre-balance the
+        transit count the server-side unpickle will consume."""
+        s = self._session(payload)
+        object_id, owner_addr = payload["ref"]
+        ref = s.refs.get(object_id.hex()) or ObjectRef(object_id, owner_addr)
+        self._worker.reference_counter.on_ref_serialized(ref)
+        return True
 
     def HandleRelease(self, payload):
         s = self._session(payload)

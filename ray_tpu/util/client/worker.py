@@ -46,13 +46,18 @@ class _ClientReferenceCounter:
         if release:
             self._worker._release([key])
 
-    # Serialization handoffs are tracked server-side (the server worker is
-    # the owner); the client only needs liveness of its own handles.
+    # The owner's serialize(+transit)/deserialize(-transit, +borrower)
+    # pairing must stay balanced when one side of the pair happens in the
+    # client process, so both events are forwarded to the server, which acts
+    # as the borrowing worker on this session's behalf.  Same-socket FIFO
+    # guarantees the notification lands before the op that carries the ref.
     def on_ref_serialized(self, ref: ObjectRef):
-        pass
+        self._worker._notify("ClientRefSerialized",
+                             {"ref": (ref.id, ref.owner_addr)})
 
     def on_ref_deserialized(self, ref: ObjectRef):
-        pass
+        self._worker._notify("ClientRefDeserialized",
+                             {"ref": (ref.id, ref.owner_addr)})
 
 
 class _GcsProxy:
@@ -68,15 +73,16 @@ class ClientWorker:
     """Global-worker stand-in speaking to a remote ClientServer."""
 
     def __init__(self, address: Tuple[str, int]):
-        self._rpc = RpcClient(tuple(address))
-        self.shutting_down = False
         import os
 
+        token = os.environ.get("RAY_TPU_CLIENT_TOKEN")
+        self._rpc = RpcClient(tuple(address), handshake_token=token)
+        self.shutting_down = False
         # op token so a resend after a connection blip reuses the session
         # instead of leaking an orphan server-side
         reply = self._rpc.call("ClientConnect", {
             "op": uuid.uuid4().hex,
-            "auth": os.environ.get("RAY_TPU_CLIENT_TOKEN"),
+            "auth": token,
         })
         self._session = reply["session"]
         # RuntimeContext surface (reference: runtime_context.py reads these
@@ -99,13 +105,17 @@ class ClientWorker:
         payload["session"] = self._session
         return self._rpc.call(method, payload, timeout=timeout)
 
-    def _release(self, ids: List[bytes]):
+    def _notify(self, method: str, payload: dict):
         if self.shutting_down:
             return
+        payload["session"] = self._session
         try:
-            self._rpc.notify("ClientRelease", {"session": self._session, "ids": ids})
+            self._rpc.notify(method, payload)
         except Exception:  # noqa: BLE001
             pass
+
+    def _release(self, ids: List[bytes]):
+        self._notify("ClientRelease", {"ids": ids})
 
     def _heartbeat_loop(self):
         while not self._heartbeat_stop.wait(30.0):

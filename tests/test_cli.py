@@ -1,0 +1,79 @@
+"""CLI lifecycle (reference: the `ray` CLI — start/stop/status/list/job).
+
+Drives `python -m ray_tpu` as real subprocesses against a daemonized head
+node, with an isolated session dir so parallel test runs don't collide.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+def _run(tmp_path, *argv, timeout=120, check=True):
+    env = dict(os.environ)
+    env["RAY_TPU_SESSION_DIR"] = str(tmp_path / "sessions")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.pop("RAY_TPU_ADDRESS", None)
+    p = subprocess.run([sys.executable, "-m", "ray_tpu", *argv],
+                       capture_output=True, text=True, timeout=timeout, env=env)
+    if check:
+        assert p.returncode == 0, f"{argv}:\n{p.stdout}\n{p.stderr}"
+    return p
+
+
+@pytest.fixture
+def head(tmp_path):
+    out = _run(tmp_path, "start", "--head", "--num-cpus", "4").stdout
+    addr = [ln.split(": ", 1)[1] for ln in out.splitlines()
+            if ln.strip().startswith("address:")][0]
+    yield tmp_path, addr
+    _run(tmp_path, "stop", timeout=60)
+
+
+def test_start_status_list_stop(head):
+    tmp_path, addr = head
+    out = _run(tmp_path, "status", "--address", addr).stdout
+    assert "1 alive" in out and "CPU" in out
+
+    out = _run(tmp_path, "list", "nodes", "--address", addr).stdout
+    rows = [json.loads(ln) for ln in out.splitlines() if ln.strip()]
+    assert len(rows) == 1 and rows[0]["state"] == "ALIVE"
+
+    # address discovery from the session dir (no --address)
+    out = _run(tmp_path, "status").stdout
+    assert "1 alive" in out
+
+
+def test_job_submit_wait(head):
+    tmp_path, addr = head
+    script = ("import ray_tpu; ray_tpu.init('auto'); "
+              "print(ray_tpu.get(ray_tpu.remote(lambda: 42).remote()))")
+    p = _run(tmp_path, "job", "submit", "--address", addr, "--wait", "--",
+             f"{sys.executable} -c \"{script}\"", timeout=180)
+    assert "SUCCEEDED" in p.stdout
+    assert "42" in p.stdout
+
+    out = _run(tmp_path, "job", "list", "--address", addr).stdout
+    jobs = [json.loads(ln) for ln in out.splitlines() if ln.strip()]
+    assert any(j["status"] == "SUCCEEDED" for j in jobs)
+
+
+def test_stop_kills_node(tmp_path):
+    _run(tmp_path, "start", "--head", "--num-cpus", "2")
+    sessions = list((tmp_path / "sessions").glob("session_*.json"))
+    assert sessions
+    pid = json.loads(sessions[0].read_text())["pid"]
+    _run(tmp_path, "stop", timeout=60)
+    import time
+
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        try:
+            os.kill(pid, 0)
+        except OSError:
+            return  # dead
+        time.sleep(0.3)
+    raise AssertionError(f"head pid {pid} still alive after stop")

@@ -107,6 +107,21 @@ def test_client_actors_and_errors(client_server):
         except Exception as e:
             assert "boom!" in str(e)
 
+        # refs nested inside values survive the proxy in both directions
+        # (transit-count protocol is proxied to the server)
+        @ray_tpu.remote
+        def make_nested():
+            return {"inner": ray_tpu.put(123)}
+
+        nested = ray_tpu.get(make_nested.remote())
+        assert ray_tpu.get(nested["inner"]) == 123
+
+        @ray_tpu.remote
+        def deref(d):
+            return ray_tpu.get(d["inner"]) + 1
+
+        assert ray_tpu.get(deref.remote(nested)) == 124
+
         # cluster state through the gcs proxy
         assert len(ray_tpu.nodes()) >= 1
         assert ray_tpu.cluster_resources().get("CPU", 0) >= 4

@@ -1,0 +1,122 @@
+"""Log plane: worker stdout/stderr → per-node files → driver echo.
+
+reference: python/ray/_private/log_monitor.py + log_to_driver behavior.
+Runs the driver in a subprocess because the suite-wide RAY_TPU_WORKER_QUIET=1
+deliberately disables streaming for every other test.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+
+def _run_driver(script: str) -> str:
+    env = dict(os.environ)
+    env.pop("RAY_TPU_WORKER_QUIET", None)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.setdefault("RAY_TPU_DISABLE_METADATA_SERVER", "1")
+    p = subprocess.run([sys.executable, "-c", textwrap.dedent(script)],
+                       capture_output=True, text=True, timeout=150, env=env)
+    assert p.returncode == 0, f"driver failed:\n{p.stdout}\n{p.stderr}"
+    return p.stdout
+
+
+def test_worker_prints_stream_to_driver():
+    out = _run_driver("""
+        import sys
+        import time
+
+        import ray_tpu
+
+        ray_tpu.init(num_cpus=2)
+
+        @ray_tpu.remote
+        def chatty(i):
+            print(f"hello-from-task-{i}")
+            print(f"stderr-side-{i}", file=sys.stderr)
+            return i
+
+        assert ray_tpu.get([chatty.remote(i) for i in range(2)]) == [0, 1]
+        # give the tailer one poll cycle + pubsub delivery
+        time.sleep(2.0)
+        ray_tpu.shutdown()
+        print("DRIVER_DONE")
+    """)
+    assert "DRIVER_DONE" in out
+    for i in range(2):
+        assert f"hello-from-task-{i}" in out, out
+        assert f"stderr-side-{i}" in out, out
+    # echoed lines carry the worker-attribution prefix
+    assert any(ln.startswith("(pid=") and "hello-from-task-" in ln
+               for ln in out.splitlines()), out
+
+
+def test_job_scoped_echo_between_drivers():
+    """Two drivers on one cluster each see only their own job's prints,
+    even when a worker is reused across jobs between monitor polls."""
+    import subprocess
+    import textwrap
+
+    env = dict(os.environ)
+    env.pop("RAY_TPU_WORKER_QUIET", None)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.setdefault("RAY_TPU_DISABLE_METADATA_SERVER", "1")
+    boot = textwrap.dedent("""
+        import subprocess, sys, textwrap
+        from ray_tpu._private.node import Node
+
+        node = Node(head=True, resources={"CPU": 4})
+        addr = f"{node.gcs_address[0]}:{node.gcs_address[1]}"
+        drv = textwrap.dedent('''
+            import sys, time, ray_tpu
+            tag = sys.argv[1]
+            ray_tpu.init(address="%s")
+
+            @ray_tpu.remote
+            def chat(t):
+                print("chat-" + t)
+                return t
+
+            assert ray_tpu.get(chat.remote(tag)) == tag
+            time.sleep(2.5)
+            ray_tpu.shutdown()
+        ''' % addr)
+        procs = [subprocess.Popen([sys.executable, "-c", drv, tag],
+                                  stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                                  text=True) for tag in ("alpha", "beta")]
+        outs = [p.communicate(timeout=150) for p in procs]
+        for p, (o, e) in zip(procs, outs):
+            assert p.returncode == 0, o + e
+        (oa, _), (ob, _) = outs
+        assert "chat-alpha" in oa and "chat-beta" not in oa, "ALPHA saw: " + oa
+        assert "chat-beta" in ob and "chat-alpha" not in ob, "BETA saw: " + ob
+        node.shutdown()
+        print("SCOPED_OK")
+    """)
+    p = subprocess.run([sys.executable, "-c", boot], capture_output=True,
+                       text=True, timeout=240, env=env)
+    assert p.returncode == 0, f"{p.stdout}\n{p.stderr}"
+    assert "SCOPED_OK" in p.stdout
+
+
+def test_log_to_driver_false_suppresses_echo():
+    out = _run_driver("""
+        import time
+
+        import ray_tpu
+
+        ray_tpu.init(num_cpus=2, log_to_driver=False)
+
+        @ray_tpu.remote
+        def quiet_task():
+            print("should-not-appear")
+            return 1
+
+        assert ray_tpu.get(quiet_task.remote()) == 1
+        time.sleep(1.5)
+        ray_tpu.shutdown()
+        print("DRIVER_DONE")
+    """)
+    assert "DRIVER_DONE" in out
+    assert "should-not-appear" not in out
