@@ -21,6 +21,7 @@ put :2916, wait :2981, remote :3369, shutdown :1996):
 from __future__ import annotations
 
 import inspect
+import os
 import threading
 from typing import Any, Dict, Optional, Tuple
 
@@ -70,9 +71,7 @@ def init(
         if address == "auto":
             # resolved BEFORE the ray:// check so RAY_TPU_ADDRESS may point
             # at either a head node or a client server
-            import os as _os
-
-            address = _os.environ.get("RAY_TPU_ADDRESS")
+            address = os.environ.get("RAY_TPU_ADDRESS")
             if not address:
                 raise ValueError(
                     'init(address="auto") requires the RAY_TPU_ADDRESS '
@@ -130,7 +129,7 @@ def init(
                 _gcs_addr = _local_node.gcs_address
         w = CoreWorker(mode=DRIVER, raylet_addr=_raylet_addr, gcs_addr=_gcs_addr)
         set_global_worker(w)
-        if log_to_driver and not __import__("os").environ.get("RAY_TPU_WORKER_QUIET"):
+        if log_to_driver and not os.environ.get("RAY_TPU_WORKER_QUIET"):
             w.subscribe_worker_logs()
         return w
 

@@ -114,7 +114,8 @@ class Pubsub:
     def _note_publish_result(self, channel: str, key, ok: bool):
         """Evict subscribers that stay unreachable (dead drivers that never
         unsubscribed), so publishing doesn't burn a connect attempt per dead
-        peer forever."""
+        peer forever.  Unreachability is an ADDRESS property: three strikes
+        drop the peer from every channel at once."""
         evict = False
         with self._lock:
             if ok:
@@ -123,9 +124,11 @@ class Pubsub:
             n = self._fails.get(key, 0) + 1
             self._fails[key] = n
             if n >= 3:
-                self._subs[channel] = [
-                    s for s in self._subs.get(channel, []) if s != key]
-                self._fails.pop(key, None)
+                addr = key[0]
+                for ch, subs in self._subs.items():
+                    self._subs[ch] = [s for s in subs if s[0] != addr]
+                self._fails = {k: v for k, v in self._fails.items()
+                               if k[0] != addr}
                 evict = True
         if evict:
             self._pool.invalidate(key[0])

@@ -430,6 +430,7 @@ def main(argv=None) -> int:
     j.set_defaults(fn=cmd_job)
 
     args = p.parse_args(argv)
-    if getattr(args, "entrypoint", None):
-        args.entrypoint = [a for a in args.entrypoint if a != "--"]
+    if getattr(args, "entrypoint", None) and args.entrypoint[0] == "--":
+        # strip only the LEADING separator; inner '--' belongs to the command
+        args.entrypoint = args.entrypoint[1:]
     return args.fn(args)

@@ -11,9 +11,18 @@ from ray_tpu.tune.schedulers import (
     ASHAScheduler,
     AsyncHyperBandScheduler,
     FIFOScheduler,
+    HyperBandScheduler,
     MedianStoppingRule,
+    PB2,
     PopulationBasedTraining,
     TrialScheduler,
+)
+from ray_tpu.tune.search import (
+    ConcurrencyLimiter,
+    RandomSearcher,
+    Repeater,
+    Searcher,
+    TPESearcher,
 )
 from ray_tpu.tune.search.sample import (
     choice,
@@ -49,4 +58,11 @@ __all__ = [
     "AsyncHyperBandScheduler",
     "MedianStoppingRule",
     "PopulationBasedTraining",
+    "HyperBandScheduler",
+    "PB2",
+    "Searcher",
+    "RandomSearcher",
+    "ConcurrencyLimiter",
+    "Repeater",
+    "TPESearcher",
 ]

@@ -4,6 +4,8 @@ from ray_tpu.tune.schedulers.trial_scheduler import (
 )
 from ray_tpu.tune.schedulers.async_hyperband import ASHAScheduler, AsyncHyperBandScheduler
 from ray_tpu.tune.schedulers.median_stopping import MedianStoppingRule
+from ray_tpu.tune.schedulers.hyperband import HyperBandScheduler
+from ray_tpu.tune.schedulers.pb2 import PB2
 from ray_tpu.tune.schedulers.pbt import PopulationBasedTraining
 
 __all__ = [
@@ -13,4 +15,6 @@ __all__ = [
     "AsyncHyperBandScheduler",
     "MedianStoppingRule",
     "PopulationBasedTraining",
+    "HyperBandScheduler",
+    "PB2",
 ]

@@ -1,0 +1,124 @@
+"""Synchronous HyperBand (reference: python/ray/tune/schedulers/hyperband.py).
+
+Trials are grouped into brackets; each bracket runs its trials to a rung
+budget, then halves synchronously: the bottom 1-1/eta fraction is stopped
+and survivors continue to the next rung (milestone *= eta).  Unlike ASHA
+(async_hyperband.py), a rung only halves when every live trial in the
+bracket reached the milestone, giving fair comparisons at the cost of
+stragglers."""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.tune.schedulers.trial_scheduler import TrialScheduler
+
+
+class _Bracket:
+    def __init__(self, min_t: int, max_t: int, eta: float):
+        self.eta = eta
+        self.max_t = max_t
+        self.milestone = min_t
+        self.trials: List[Any] = []
+        self.at_milestone: Dict[Any, float] = {}  # trial -> metric at rung
+        self.dropped: set = set()
+
+    def ready_to_halve(self) -> bool:
+        live = [t for t in self.trials if t not in self.dropped]
+        return live and all(t in self.at_milestone for t in live)
+
+    def halve(self) -> set:
+        """Returns the set of trials to stop; advances the milestone."""
+        ranked = sorted(self.at_milestone, key=self.at_milestone.get)
+        keep = max(1, int(len(ranked) / self.eta))
+        losers = set(ranked[:-keep]) if len(ranked) > keep else set()
+        self.dropped |= losers
+        self.at_milestone = {}
+        self.milestone = min(int(self.milestone * self.eta), self.max_t)
+        return losers
+
+
+class HyperBandScheduler(TrialScheduler):
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 time_attr: str = "training_iteration",
+                 max_t: int = 81, reduction_factor: float = 3):
+        assert mode in ("min", "max")
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.max_t = max_t
+        self.eta = reduction_factor
+        # bracket sizes follow the HyperBand schedule s = s_max..0
+        self._s_max = int(math.log(max_t, self.eta))
+        self._brackets: List[_Bracket] = []
+        self._bracket_of: Dict[Any, _Bracket] = {}
+        self._next_bracket_s = self._s_max
+
+    def _new_bracket(self) -> _Bracket:
+        s = self._next_bracket_s
+        self._next_bracket_s = s - 1 if s > 0 else self._s_max
+        min_t = max(1, int(self.max_t / (self.eta ** s)))
+        b = _Bracket(min_t, self.max_t, self.eta)
+        self._brackets.append(b)
+        return b
+
+    def _bracket_capacity(self, s: int) -> int:
+        return max(1, int(math.ceil((self._s_max + 1) * (self.eta ** s)
+                                    / (s + 1))))
+
+    def on_trial_add(self, trial):
+        for b in self._brackets:
+            s = round(math.log(self.max_t / b.milestone, self.eta)) if b.milestone else 0
+            if not b.at_milestone and not b.dropped \
+                    and len(b.trials) < self._bracket_capacity(max(s, 0)):
+                b.trials.append(trial)
+                self._bracket_of[trial] = b
+                return
+        b = self._new_bracket()
+        b.trials.append(trial)
+        self._bracket_of[trial] = b
+
+    def _signed(self, v) -> float:
+        return float(v) if self.mode == "max" else -float(v)
+
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> str:
+        b = self._bracket_of.get(trial)
+        if b is None:
+            return self.CONTINUE
+        if trial in b.dropped:
+            return self.STOP  # lost an earlier halving; stop at next report
+        t = result.get(self.time_attr, 0)
+        if t >= b.max_t:
+            return self.STOP
+        value = result.get(self.metric)
+        if t < b.milestone or value is None:
+            return self.CONTINUE
+        # reached the rung: park the score; once the whole rung is in, halve
+        b.at_milestone[trial] = self._signed(value)
+        if not b.ready_to_halve():
+            return self.CONTINUE
+        losers = b.halve()
+        # losers that aren't `trial` are stopped via their own next result;
+        # mark them so on_trial_result STOPs them immediately
+        return self.STOP if trial in losers else self.CONTINUE
+
+    def on_trial_complete(self, trial, result):
+        b = self._bracket_of.pop(trial, None)
+        if b is not None:
+            b.dropped.add(trial)
+            b.at_milestone.pop(trial, None)
+            if b.at_milestone and b.ready_to_halve():
+                b.halve()
+
+    def choose_trial_to_run(self, pending):
+        # prefer trials whose bracket is mid-rung (unblocks synchronous halving)
+        for t in pending:
+            b = self._bracket_of.get(t)
+            if b is not None and t not in b.dropped:
+                return t
+        return pending[0] if pending else None
+
+    def is_dropped(self, trial) -> bool:
+        b = self._bracket_of.get(trial)
+        return b is not None and trial in b.dropped

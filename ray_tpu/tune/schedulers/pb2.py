@@ -1,0 +1,114 @@
+"""PB2: Population Based Bandits (reference: python/ray/tune/schedulers/pb2.py).
+
+PBT's exploit step stays (bottom-quantile trials restart from a top-quantile
+donor's checkpoint); the explore step replaces PBT's random
+perturb/resample with a GP-bandit suggestion: fit a Gaussian process to
+(normalized hyperparams, time) -> reward-change observations and pick the
+candidate maximizing UCB = mu + kappa * sigma (Parker-Holder et al., 2020).
+The reference wraps GPy; here the GP (RBF kernel + Cholesky solve) is ~40
+lines of numpy."""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.tune.schedulers.pbt import PopulationBasedTraining
+
+import numpy as np
+
+
+class _GP:
+    """Minimal RBF-kernel GP regression (zero mean, unit signal)."""
+
+    def __init__(self, X: np.ndarray, y: np.ndarray,
+                 length_scale: float = 0.3, noise: float = 1e-2):
+        self.X = X
+        self.ls = length_scale
+        y = y.astype(np.float64)
+        self.y_mean = float(y.mean()) if len(y) else 0.0
+        self.y_std = float(y.std()) or 1.0
+        self.y = (y - self.y_mean) / self.y_std
+        K = self._k(X, X) + noise * np.eye(len(X))
+        self.L = np.linalg.cholesky(K)
+        self.alpha = np.linalg.solve(self.L.T, np.linalg.solve(self.L, self.y))
+
+    def _k(self, A: np.ndarray, B: np.ndarray) -> np.ndarray:
+        d2 = ((A[:, None, :] - B[None, :, :]) ** 2).sum(-1)
+        return np.exp(-0.5 * d2 / (self.ls ** 2))
+
+    def predict(self, Xs: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        Ks = self._k(Xs, self.X)
+        mu = Ks @ self.alpha
+        v = np.linalg.solve(self.L, Ks.T)
+        var = np.clip(1.0 - (v ** 2).sum(0), 1e-9, None)
+        return mu * self.y_std + self.y_mean, np.sqrt(var) * self.y_std
+
+
+class PB2(PopulationBasedTraining):
+    def __init__(self, *args, hyperparam_bounds: Optional[Dict[str, Tuple[float, float]]] = None,
+                 kappa: float = 2.0, n_candidates: int = 64, **kwargs):
+        """``hyperparam_bounds``: {name: (low, high)} continuous ranges the
+        GP searches over (PB2 is defined for continuous hyperparams; pass
+        categorical ones through ``hyperparam_mutations`` as in PBT)."""
+        super().__init__(*args, **kwargs)
+        self.bounds = hyperparam_bounds or {}
+        self.kappa = kappa
+        self.n_candidates = n_candidates
+        # (t, config values, reward delta) observations per the PB2 paper
+        self._data: List[Tuple[float, Dict[str, float], float]] = []
+        self._prev_score: Dict[Any, Tuple[float, float]] = {}  # trial -> (t, score)
+
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> str:
+        t = result.get(self.time_attr, 0)
+        value = result.get(self.metric)
+        if value is not None:
+            prev = self._prev_score.get(trial)
+            score = self._signed(value)
+            if prev is not None and t > prev[0]:
+                delta = (score - prev[1]) / (t - prev[0])
+                cfg = {k: float(trial.config[k]) for k in self.bounds
+                       if k in trial.config}
+                if cfg:
+                    self._data.append((float(t), cfg, delta))
+            self._prev_score[trial] = (t, score)
+        return super().on_trial_result(trial, result)
+
+    # -- GP-UCB explore ----------------------------------------------------
+
+    def _normalize(self, t: float, cfg: Dict[str, float]) -> List[float]:
+        tmax = max((d[0] for d in self._data), default=1.0) or 1.0
+        row = [t / tmax]
+        for k, (lo, hi) in sorted(self.bounds.items()):
+            span = (hi - lo) or 1.0
+            row.append((cfg.get(k, lo) - lo) / span)
+        return row
+
+    def _explore(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        config = dict(config)
+        if not self.bounds:
+            return super()._explore(config)
+        if len(self._data) < 4:
+            for k, (lo, hi) in self.bounds.items():
+                config[k] = self.rng.uniform(lo, hi)
+            return config
+        X = np.array([self._normalize(t, cfg) for t, cfg, _ in self._data])
+        y = np.array([d for _, _, d in self._data])
+        try:
+            gp = _GP(X, y)
+        except np.linalg.LinAlgError:
+            return super()._explore(config)
+        t_now = max(d[0] for d in self._data)
+        cands = []
+        for _ in range(self.n_candidates):
+            c = {k: self.rng.uniform(lo, hi) for k, (lo, hi) in self.bounds.items()}
+            cands.append(c)
+        Xs = np.array([self._normalize(t_now, c) for c in cands])
+        mu, sigma = gp.predict(Xs)
+        best = int(np.argmax(mu + self.kappa * sigma))
+        for k, v in cands[best].items():
+            # preserve int-typed hyperparams
+            config[k] = type(config.get(k, v))(v) if isinstance(
+                config.get(k), int) else v
+        return config

@@ -1,0 +1,22 @@
+"""Search algorithms (reference: python/ray/tune/search/)."""
+
+from ray_tpu.tune.search.basic_variant import BasicVariantGenerator
+from ray_tpu.tune.search.optuna import HyperOptSearch, OptunaSearch
+from ray_tpu.tune.search.searcher import (
+    ConcurrencyLimiter,
+    RandomSearcher,
+    Repeater,
+    Searcher,
+)
+from ray_tpu.tune.search.tpe import TPESearcher
+
+__all__ = [
+    "BasicVariantGenerator",
+    "Searcher",
+    "RandomSearcher",
+    "ConcurrencyLimiter",
+    "Repeater",
+    "TPESearcher",
+    "OptunaSearch",
+    "HyperOptSearch",
+]

@@ -1,0 +1,57 @@
+"""Optuna wrapper (reference: tune/search/optuna/optuna_search.py).
+
+optuna is not in this environment's image; the wrapper keeps API parity and
+degrades with a clear error pointing at the native [[TPESearcher]] (optuna's
+default sampler is TPE, so the native implementation is the drop-in)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ray_tpu.tune.search.searcher import Searcher
+
+
+class OptunaSearch(Searcher):
+    def __init__(self, space: Optional[Dict[str, Any]] = None,
+                 metric: Optional[str] = None, mode: str = "min", **kwargs):
+        try:
+            import optuna  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "optuna is not installed. Use ray_tpu.tune.search.tpe."
+                "TPESearcher — the native implementation of optuna's default "
+                "TPE sampler — or install optuna.") from e
+        # If optuna IS present, delegate to the native TPE over the same
+        # space (sampler parity) rather than shipping a second integration.
+        from ray_tpu.tune.search.tpe import TPESearcher
+
+        self._impl = TPESearcher(space, metric=metric, mode=mode, **kwargs)
+
+    def set_search_properties(self, metric, mode, config):
+        return self._impl.set_search_properties(metric, mode, config)
+
+    def suggest(self, trial_id):
+        return self._impl.suggest(trial_id)
+
+    def on_trial_result(self, trial_id, result):
+        self._impl.on_trial_result(trial_id, result)
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        self._impl.on_trial_complete(trial_id, result, error)
+
+
+class HyperOptSearch(OptunaSearch):
+    """reference: tune/search/hyperopt/hyperopt_search.py — hyperopt is also
+    TPE-based; same gating and native fallback."""
+
+    def __init__(self, space=None, metric=None, mode="min", **kwargs):
+        try:
+            import hyperopt  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "hyperopt is not installed. Use ray_tpu.tune.search.tpe."
+                "TPESearcher (hyperopt's algorithm is TPE) or install "
+                "hyperopt.") from e
+        from ray_tpu.tune.search.tpe import TPESearcher
+
+        self._impl = TPESearcher(space, metric=metric, mode=mode, **kwargs)

@@ -1,0 +1,171 @@
+"""Suggest-based search algorithms.
+
+reference: python/ray/tune/search/searcher.py (Searcher ABC),
+search/concurrency_limiter.py (ConcurrencyLimiter), search/repeater.py
+(Repeater) — the controller asks the searcher for configs one trial at a
+time and reports results back, unlike the up-front BasicVariantGenerator.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Dict, Optional
+
+from ray_tpu.tune.search.sample import Domain
+
+
+class Searcher:
+    """suggest(trial_id) returns a config dict, None ("wait, I need more
+    results before suggesting"), or Searcher.FINISHED (search exhausted)."""
+
+    FINISHED = "FINISHED"
+
+    metric: Optional[str] = None
+    mode: str = "min"
+
+    def set_search_properties(self, metric: Optional[str], mode: Optional[str],
+                              config: Dict[str, Any]) -> bool:
+        if metric:
+            self.metric = metric
+        if mode:
+            self.mode = mode
+        return True
+
+    def suggest(self, trial_id: str):
+        raise NotImplementedError
+
+    def on_trial_result(self, trial_id: str, result: Dict[str, Any]) -> None:  # noqa: B027
+        pass
+
+    def on_trial_complete(self, trial_id: str, result: Optional[Dict[str, Any]] = None,
+                          error: bool = False) -> None:  # noqa: B027
+        pass
+
+
+class RandomSearcher(Searcher):
+    """Independent random draws from the space — the suggest-mode analog of
+    BasicVariantGenerator's sampling half."""
+
+    def __init__(self, space: Optional[Dict[str, Any]] = None,
+                 seed: Optional[int] = None):
+        self._space = space or {}
+        self._rng = random.Random(seed)
+
+    def set_search_properties(self, metric, mode, config):
+        super().set_search_properties(metric, mode, config)
+        if config and not self._space:
+            self._space = config
+        return True
+
+    def suggest(self, trial_id: str):
+        return _sample_space(self._space, self._rng)
+
+
+def _sample_space(space: Dict[str, Any], rng: random.Random) -> Dict[str, Any]:
+    out = {}
+    for k, v in space.items():
+        if isinstance(v, Domain):
+            out[k] = v.sample(rng)
+        elif isinstance(v, dict) and "grid_search" in v and len(v) == 1:
+            out[k] = rng.choice(list(v["grid_search"]))
+        elif isinstance(v, dict):
+            out[k] = _sample_space(v, rng)
+        else:
+            out[k] = v
+    return out
+
+
+class ConcurrencyLimiter(Searcher):
+    """Caps in-flight suggestions (reference: search/concurrency_limiter.py).
+
+    suggest() returns None while ``max_concurrent`` suggested trials have not
+    yet completed, which makes the controller idle-wait instead of launching.
+    """
+
+    def __init__(self, searcher: Searcher, max_concurrent: int, batch: bool = False):
+        self.searcher = searcher
+        self.max_concurrent = max_concurrent
+        self.batch = batch
+        self._live: set = set()
+
+    def set_search_properties(self, metric, mode, config):
+        return self.searcher.set_search_properties(metric, mode, config)
+
+    def suggest(self, trial_id: str):
+        if len(self._live) >= self.max_concurrent:
+            return None
+        suggestion = self.searcher.suggest(trial_id)
+        if suggestion is not None and suggestion != Searcher.FINISHED:
+            self._live.add(trial_id)
+        return suggestion
+
+    def on_trial_result(self, trial_id, result):
+        self.searcher.on_trial_result(trial_id, result)
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        self._live.discard(trial_id)
+        self.searcher.on_trial_complete(trial_id, result, error)
+
+
+class Repeater(Searcher):
+    """Runs each suggested config ``repeat`` times and reports the MEAN
+    metric to the wrapped searcher — variance control for noisy objectives
+    (reference: search/repeater.py)."""
+
+    def __init__(self, searcher: Searcher, repeat: int = 3):
+        assert repeat >= 1
+        self.searcher = searcher
+        self.repeat = repeat
+        self._group_of: Dict[str, str] = {}       # trial_id -> group leader id
+        self._group_cfg: Dict[str, Dict] = {}     # leader id -> config
+        self._group_left: Dict[str, int] = {}     # leader id -> remaining to hand out
+        self._group_results: Dict[str, list] = {}  # leader id -> completed metrics
+
+    def set_search_properties(self, metric, mode, config):
+        ok = self.searcher.set_search_properties(metric, mode, config)
+        self.metric = self.searcher.metric
+        self.mode = self.searcher.mode
+        return ok
+
+    def suggest(self, trial_id: str):
+        # hand out pending repeats of an open group first
+        for leader, left in self._group_left.items():
+            if left > 0:
+                self._group_left[leader] = left - 1
+                self._group_of[trial_id] = leader
+                return dict(self._group_cfg[leader])
+        suggestion = self.searcher.suggest(trial_id)
+        if suggestion is None or suggestion == Searcher.FINISHED:
+            return suggestion
+        self._group_of[trial_id] = trial_id
+        self._group_cfg[trial_id] = suggestion
+        self._group_left[trial_id] = self.repeat - 1
+        self._group_results[trial_id] = []
+        return dict(suggestion)
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        leader = self._group_of.pop(trial_id, None)
+        if leader is None:
+            return
+        group = self._group_results.get(leader)
+        if group is None:
+            return
+        metric = self.metric or self.searcher.metric
+        if not error and result and metric in result:
+            group.append(result[metric])
+        # the group is done when all repeats were handed out AND none is
+        # still running
+        done = (self._group_left.get(leader, 0) == 0
+                and self._pending_in_group(leader) == 0)
+        if done:
+            mean = sum(group) / len(group) if group else None
+            agg = dict(result or {})
+            if mean is not None and metric:
+                agg[metric] = mean
+            self.searcher.on_trial_complete(leader, agg, error=not group)
+            self._group_cfg.pop(leader, None)
+            self._group_left.pop(leader, None)
+            self._group_results.pop(leader, None)
+
+    def _pending_in_group(self, leader: str) -> int:
+        return sum(1 for g in self._group_of.values() if g == leader)

@@ -1,0 +1,217 @@
+"""Native Tree-structured Parzen Estimator searcher.
+
+reference surface: python/ray/tune/search/optuna/optuna_search.py — the
+reference wraps optuna (whose default sampler is TPE); this environment has
+no optuna, so the TPE itself is implemented here on the framework's own
+Domain primitives (sample.py), and OptunaSearch/HyperOptSearch stay thin
+gated wrappers for API parity.
+
+Algorithm (Bergstra et al., NeurIPS 2011): after ``n_startup`` random
+trials, split observations at the ``gamma`` quantile into good/bad sets, fit
+a Parzen (Gaussian-kernel) density to each, and suggest the candidate
+maximizing l_good(x)/l_bad(x) among ``n_candidates`` draws from the good
+density.  Categorical/int dimensions use smoothed count ratios.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.tune.search.sample import (
+    Choice,
+    Domain,
+    LogUniform,
+    QUniform,
+    Randint,
+    Uniform,
+)
+from ray_tpu.tune.search.searcher import Searcher
+
+
+def _flatten(space: Dict[str, Any], prefix: Tuple[str, ...] = ()):
+    for k, v in space.items():
+        path = prefix + (k,)
+        if isinstance(v, Domain):
+            yield path, v
+        elif isinstance(v, dict) and "grid_search" in v and len(v) == 1:
+            yield path, Choice(list(v["grid_search"]))
+        elif isinstance(v, dict):
+            yield from _flatten(v, path)
+        else:
+            yield path, v  # constant
+
+
+def _set_path(d: Dict, path: Tuple[str, ...], value):
+    for k in path[:-1]:
+        d = d.setdefault(k, {})
+    d[path[-1]] = value
+
+
+class _Dim:
+    """One searchable dimension, normalized to a numeric or categorical view."""
+
+    def __init__(self, domain: Domain):
+        self.domain = domain
+        if isinstance(domain, Choice):
+            self.kind = "cat"
+            self.categories = domain.categories
+        elif isinstance(domain, Randint):
+            self.kind = "int"
+            self.low, self.high = domain.low, domain.high - 1
+        elif isinstance(domain, LogUniform):
+            self.kind = "log"
+            self.low, self.high = domain.log_low, domain.log_high
+        elif isinstance(domain, QUniform):
+            self.kind = "float"
+            self.low, self.high = domain.low, domain.high
+            self.q = domain.q
+        elif isinstance(domain, Uniform):
+            self.kind = "float"
+            self.low, self.high = domain.low, domain.high
+        else:  # SampleFrom / unknown: fall back to raw sampling, no model
+            self.kind = "raw"
+
+    # numeric encoding of an observed value
+    def encode(self, v) -> Optional[float]:
+        if self.kind == "cat":
+            try:
+                return float(self.categories.index(v))
+            except ValueError:
+                return None
+        if self.kind == "log":
+            return math.log(v) if v > 0 else None
+        if self.kind in ("int", "float"):
+            return float(v)
+        return None
+
+    def decode(self, x: float):
+        if self.kind == "cat":
+            return self.categories[int(round(x))]
+        if self.kind == "log":
+            return math.exp(min(max(x, self.low), self.high))
+        if self.kind == "int":
+            return int(round(min(max(x, self.low), self.high)))
+        v = min(max(x, self.low), self.high)
+        if hasattr(self, "q"):
+            v = round(v / self.q) * self.q
+        return v
+
+    def random(self, rng: random.Random):
+        return self.domain.sample(rng)
+
+
+class TPESearcher(Searcher):
+    def __init__(self, space: Optional[Dict[str, Any]] = None,
+                 metric: Optional[str] = None, mode: str = "min",
+                 n_startup: int = 10, gamma: float = 0.25,
+                 n_candidates: int = 24, seed: Optional[int] = None):
+        self.metric = metric
+        self.mode = mode
+        self.n_startup = n_startup
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        self._rng = random.Random(seed)
+        self._dims: List[Tuple[Tuple[str, ...], _Dim]] = []
+        self._constants: List[Tuple[Tuple[str, ...], Any]] = []
+        if space:
+            self._build(space)
+        self._suggested: Dict[str, Dict[Tuple[str, ...], Any]] = {}
+        self._obs: List[Tuple[Dict[Tuple[str, ...], Any], float]] = []
+
+    def _build(self, space: Dict[str, Any]):
+        for path, spec in _flatten(space):
+            if isinstance(spec, Domain):
+                self._dims.append((path, _Dim(spec)))
+            else:
+                self._constants.append((path, spec))
+
+    def set_search_properties(self, metric, mode, config):
+        super().set_search_properties(metric, mode, config)
+        if config and not self._dims and not self._constants:
+            self._build(config)
+        return True
+
+    # ------------------------------------------------------------------
+
+    def suggest(self, trial_id: str):
+        flat: Dict[Tuple[str, ...], Any] = {}
+        use_model = len(self._obs) >= self.n_startup
+        for path, dim in self._dims:
+            if use_model and dim.kind != "raw":
+                flat[path] = self._suggest_dim(path, dim)
+            else:
+                flat[path] = dim.random(self._rng)
+        self._suggested[trial_id] = flat
+        config: Dict[str, Any] = {}
+        for path, v in self._constants:
+            _set_path(config, path, v)
+        for path, v in flat.items():
+            _set_path(config, path, v)
+        return config
+
+    def _split(self) -> Tuple[list, list]:
+        ranked = sorted(self._obs, key=lambda o: o[1], reverse=True)  # best first
+        n_good = max(1, int(math.ceil(self.gamma * len(ranked))))
+        return ranked[:n_good], ranked[n_good:]
+
+    def _suggest_dim(self, path, dim: _Dim):
+        good, bad = self._split()
+        gx = [dim.encode(o[0][path]) for o in good if path in o[0]]
+        bx = [dim.encode(o[0][path]) for o in bad if path in o[0]]
+        gx = [x for x in gx if x is not None]
+        bx = [x for x in bx if x is not None]
+        if not gx:
+            return dim.random(self._rng)
+        if dim.kind == "cat":
+            n = len(dim.categories)
+            gcounts = [1.0] * n
+            for x in gx:
+                gcounts[int(x)] += 1
+            bcounts = [1.0] * n
+            for x in bx:
+                bcounts[int(x)] += 1
+            gsum, bsum = sum(gcounts), sum(bcounts)
+            scores = [(gcounts[i] / gsum) / (bcounts[i] / bsum) for i in range(n)]
+            # sample proportional to the good density, pick best ratio among draws
+            best_i = max(
+                self._rng.choices(range(n), weights=gcounts, k=self.n_candidates),
+                key=lambda i: scores[i])
+            return dim.categories[best_i]
+        # continuous / int / log: Parzen windows around good points
+        lo = min(gx + bx)
+        hi = max(gx + bx)
+        spread = (hi - lo) or abs(hi) or 1.0
+        bw = max(spread / max(len(gx), 1) ** 0.5, 1e-6 * spread)
+
+        def density(x: float, pts: List[float]) -> float:
+            if not pts:
+                return 1e-12
+            s = 0.0
+            for p in pts:
+                z = (x - p) / bw
+                s += math.exp(-0.5 * z * z)
+            return s / (len(pts) * bw)
+
+        best_x, best_score = None, -1.0
+        for _ in range(self.n_candidates):
+            center = self._rng.choice(gx)
+            x = self._rng.gauss(center, bw)
+            score = density(x, gx) / max(density(x, bx), 1e-12)
+            if score > best_score:
+                best_x, best_score = x, score
+        return dim.decode(best_x)
+
+    # ------------------------------------------------------------------
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        flat = self._suggested.pop(trial_id, None)
+        if flat is None or error or not result:
+            return
+        metric = self.metric
+        if metric is None or metric not in result:
+            return
+        value = float(result[metric])
+        signed = value if self.mode == "max" else -value
+        self._obs.append((flat, signed))

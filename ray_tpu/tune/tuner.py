@@ -45,6 +45,7 @@ class TuneConfig:
     num_samples: int = 1
     max_concurrent_trials: Optional[int] = None
     scheduler: Optional[TrialScheduler] = None
+    search_alg: Optional[Any] = None  # a tune.search.Searcher (suggest mode)
     trial_resources: Optional[Dict[str, float]] = None
     seed: Optional[int] = None
 
@@ -107,9 +108,19 @@ class TuneController:
         self._trainable = trainable
         self._tc = tune_config
         self._rc = run_config
+        self._search_alg = tune_config.search_alg
+        if self._search_alg is not None:
+            self._search_alg.set_search_properties(
+                tune_config.metric, tune_config.mode, param_space)
         if restore_path:
             self._exp_dir = restore_path
             self.trials = self._load_experiment_state(restore_path)
+        elif self._search_alg is not None:
+            # suggest mode: trials are created on demand in the run loop
+            name = run_config.name or f"tune_{uuid.uuid4().hex[:8]}"
+            self._exp_dir = os.path.join(run_config.resolved_storage_path(), name)
+            os.makedirs(self._exp_dir, exist_ok=True)
+            self.trials = []
         else:
             name = run_config.name or f"tune_{uuid.uuid4().hex[:8]}"
             self._exp_dir = os.path.join(run_config.resolved_storage_path(), name)
@@ -117,6 +128,7 @@ class TuneController:
             gen = BasicVariantGenerator(param_space, tune_config.num_samples,
                                         seed=tune_config.seed)
             self.trials = [Trial(config=cfg) for cfg in gen.variants()]
+        self._search_exhausted = self._search_alg is None
         self._scheduler = tune_config.scheduler or FIFOScheduler()
         for t in self.trials:
             self._scheduler.on_trial_add(t)
@@ -143,7 +155,9 @@ class TuneController:
         # the scheduler is live mutable state keyed by Trial OBJECTS — a
         # pickled copy would revive ghost trials on restore; persist the
         # config without it (restore builds a fresh scheduler)
-        saved_tc = dataclasses.replace(self._tc, scheduler=None)
+        # (search_alg likewise: live state keyed by trial ids; restore
+        # finishes the already-suggested trials instead)
+        saved_tc = dataclasses.replace(self._tc, scheduler=None, search_alg=None)
         tmp = os.path.join(self._exp_dir, EXPERIMENT_STATE_FILE + ".tmp")
         with open(tmp, "wb") as f:
             pickle.dump({"trials": rows, "tune_config": saved_tc}, f)
@@ -239,6 +253,7 @@ class TuneController:
         max_concurrent = self._tc.max_concurrent_trials or 8
         try:
             while True:
+                self._pull_suggestions(max_concurrent)
                 # start pending trials up to the concurrency cap
                 pending = [t for t in self.trials if t.status == PENDING]
                 while pending and len(self._actors) < max_concurrent:
@@ -259,11 +274,13 @@ class TuneController:
                     except Exception as e:  # noqa: BLE001
                         trial.error = str(e)
                         self._stop_trial(trial, ERROR)
+                        self._searcher_complete(trial, error=True)
                         continue
                     if err:
                         trial.error = err
                         self._stop_trial(trial, ERROR)
                         self._scheduler.on_trial_complete(trial, trial.metrics)
+                        self._searcher_complete(trial, error=True)
                         continue
                     decision = TrialScheduler.CONTINUE
                     for r in results:
@@ -273,20 +290,26 @@ class TuneController:
                         trial.metrics = metrics
                         trial.metrics_history.append(metrics)
                         self._persist_checkpoint(trial, r.get("checkpoint"))
+                        if self._search_alg is not None:
+                            self._search_alg.on_trial_result(trial.trial_id, metrics)
                         decision = self._scheduler.on_trial_result(trial, metrics)
                         if decision != TrialScheduler.CONTINUE:
                             break
                     if decision == TrialScheduler.STOP:
                         self._stop_trial(trial, TERMINATED)
                         self._scheduler.on_trial_complete(trial, trial.metrics)
+                        self._searcher_complete(trial, error=False)
                     elif decision == TrialScheduler.PAUSE:
                         # PBT exploit/explore: restart from donor checkpoint
                         self._handle_pbt_exploit(trial)
                     elif finished:
                         self._stop_trial(trial, TERMINATED)
                         self._scheduler.on_trial_complete(trial, trial.metrics)
+                        self._searcher_complete(trial, error=False)
                 self._save_experiment_state()
-                if not any(t.status in (PENDING, RUNNING, PAUSED) for t in self.trials):
+                if (self._search_exhausted
+                        and not any(t.status in (PENDING, RUNNING, PAUSED)
+                                    for t in self.trials)):
                     break
                 time.sleep(0.02)
         finally:
@@ -295,6 +318,43 @@ class TuneController:
                     self._stop_trial(trial, trial.status)
             self._save_experiment_state()
         return self._build_result_grid()
+
+    def _pull_suggestions(self, max_concurrent: int):
+        """Suggest mode: materialize trials from the searcher on demand
+        (reference: tune_controller + SearchGenerator)."""
+        from ray_tpu.tune.search.searcher import Searcher
+
+        if self._search_alg is None or self._search_exhausted:
+            return
+        while (len(self.trials) < self._tc.num_samples
+               and sum(1 for t in self.trials
+                       if t.status in (PENDING, RUNNING)) < max_concurrent):
+            trial = Trial(config={})
+            suggestion = self._search_alg.suggest(trial.trial_id)
+            if suggestion == Searcher.FINISHED:
+                self._search_exhausted = True
+                return
+            if suggestion is None:
+                # searcher wants to wait for running trials; if nothing is
+                # running it can never unblock — treat as exhausted
+                if not any(t.status in (PENDING, RUNNING) for t in self.trials):
+                    logger.warning("searcher returned None with no trials "
+                                   "in flight; ending search")
+                    self._search_exhausted = True
+                return
+            trial.config = suggestion
+            self.trials.append(trial)
+            self._scheduler.on_trial_add(trial)
+        if len(self.trials) >= self._tc.num_samples:
+            self._search_exhausted = True
+
+    def _searcher_complete(self, trial: Trial, error: bool):
+        if self._search_alg is not None:
+            try:
+                self._search_alg.on_trial_complete(
+                    trial.trial_id, trial.metrics, error=error)
+            except Exception:  # noqa: BLE001
+                logger.exception("search_alg.on_trial_complete failed")
 
     def _handle_pbt_exploit(self, trial: Trial):
         donor: Optional[Trial] = trial.pbt_exploit_from
