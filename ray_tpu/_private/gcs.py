@@ -119,7 +119,11 @@ class Pubsub:
         evict = False
         with self._lock:
             if ok:
-                self._fails.pop(key, None)
+                # reachability proven for the whole ADDRESS: clear every
+                # channel/method counter for it (eviction is address-wide)
+                addr = key[0]
+                self._fails = {k: v for k, v in self._fails.items()
+                               if k[0] != addr}
                 return
             n = self._fails.get(key, 0) + 1
             self._fails[key] = n

@@ -11,18 +11,36 @@ vectorized numpy envs.
 
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig, PPO, PPOConfig
 from ray_tpu.rllib.core.rl_module import RLModule
-from ray_tpu.rllib.env import CartPoleEnv, EnvSpec
+from ray_tpu.rllib.dqn import DQN, DQNConfig, DQNLearner
+from ray_tpu.rllib.env import CartPoleEnv, EnvSpec, PendulumEnv, register_env
 from ray_tpu.rllib.env_runner import EnvRunner
 from ray_tpu.rllib.learner import PPOLearner
+from ray_tpu.rllib.offline import BC, BCConfig, MARWIL, MARWILConfig
+from ray_tpu.rllib.replay import ReplayBuffer
+from ray_tpu.rllib.sac import SAC, SACConfig, SACLearner, SACModule
 
 __all__ = [
     "Algorithm",
     "AlgorithmConfig",
+    "BC",
+    "BCConfig",
     "CartPoleEnv",
+    "DQN",
+    "DQNConfig",
+    "DQNLearner",
     "EnvRunner",
     "EnvSpec",
+    "MARWIL",
+    "MARWILConfig",
+    "PendulumEnv",
     "PPO",
     "PPOConfig",
     "PPOLearner",
+    "ReplayBuffer",
     "RLModule",
+    "SAC",
+    "SACConfig",
+    "SACLearner",
+    "SACModule",
+    "register_env",
 ]

@@ -1,0 +1,142 @@
+"""DQN: double deep Q-learning with a target network.
+
+reference: rllib/algorithms/dqn/ — replay-based value learning.  jax-native:
+the update (double-DQN target, Huber loss, adam) is one jitted program; the
+RLModule's logits head doubles as the Q head, so the same module runs
+epsilon-greedy inference in the EnvRunners.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig, jax_to_numpy
+from ray_tpu.rllib.core.rl_module import RLModule
+from ray_tpu.rllib.replay import ReplayBuffer, fragments_to_transitions
+
+
+@dataclasses.dataclass
+class DQNConfig(AlgorithmConfig):
+    lr: float = 1e-3
+    buffer_size: int = 50_000
+    learning_starts: int = 1_000
+    train_batch_size: int = 64
+    num_updates_per_iteration: int = 64
+    target_update_freq: int = 8  # in updates
+    double_q: bool = True
+    epsilon_initial: float = 1.0
+    epsilon_final: float = 0.05
+    epsilon_decay_steps: int = 10_000
+
+    @property
+    def algo_class(self):
+        return DQN
+
+
+class DQNLearner:
+    def __init__(self, module: RLModule, *, lr: float, gamma: float,
+                 double_q: bool, target_update_freq: int, seed: int = 0):
+        self.module = module
+        self.gamma = gamma
+        self.double_q = double_q
+        self.target_update_freq = target_update_freq
+        self.optimizer = optax.adam(lr)
+        self.params = module.init(jax.random.PRNGKey(seed + 1))
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+        self.opt_state = self.optimizer.init(self.params)
+        self._updates = 0
+        self._update = jax.jit(self._update_impl)
+
+    def _loss(self, params, target_params, obs, actions, rewards, next_obs, dones):
+        q_all, _ = self.module.forward(params, obs)
+        q = jnp.take_along_axis(q_all, actions[:, None], axis=1)[:, 0]
+        next_q_target, _ = self.module.forward(target_params, next_obs)
+        if self.double_q:
+            next_q_online, _ = self.module.forward(params, next_obs)
+            best = jnp.argmax(next_q_online, axis=-1)
+        else:
+            best = jnp.argmax(next_q_target, axis=-1)
+        next_q = jnp.take_along_axis(next_q_target, best[:, None], axis=1)[:, 0]
+        y = rewards + self.gamma * (1.0 - dones.astype(jnp.float32)) * next_q
+        y = jax.lax.stop_gradient(y)
+        td = q - y
+        loss = jnp.mean(optax.huber_loss(td))
+        return loss, {"qf_loss": loss, "q_mean": jnp.mean(q),
+                      "td_error_abs": jnp.mean(jnp.abs(td))}
+
+    def _update_impl(self, params, target_params, opt_state, batch):
+        (_, aux), grads = jax.value_and_grad(self._loss, has_aux=True)(
+            params, target_params, batch["obs"], batch["actions"],
+            batch["rewards"], batch["next_obs"], batch["dones"])
+        updates, opt_state = self.optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, aux
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        jb = {k: jnp.asarray(v) for k, v in batch.items()}
+        self.params, self.opt_state, aux = self._update(
+            self.params, self.target_params, self.opt_state, jb)
+        self._updates += 1
+        if self._updates % self.target_update_freq == 0:
+            self.target_params = jax.tree.map(jnp.copy, self.params)
+        return {k: float(v) for k, v in aux.items()}
+
+    def get_params(self):
+        return self.params
+
+
+class DQN(Algorithm):
+    """reference: rllib/algorithms/dqn/dqn.py."""
+
+    def __init__(self, config: DQNConfig):
+        super().__init__(config)
+        self._replay = ReplayBuffer(config.buffer_size, seed=config.seed)
+        self._env_steps = 0
+
+    def _build_learner(self):
+        cfg: DQNConfig = self.config  # type: ignore[assignment]
+        module = RLModule(self._spec, hidden=tuple(cfg.hidden))
+        return DQNLearner(module, lr=cfg.lr, gamma=cfg.gamma,
+                          double_q=cfg.double_q,
+                          target_update_freq=cfg.target_update_freq,
+                          seed=cfg.seed)
+
+    def _epsilon(self) -> float:
+        cfg: DQNConfig = self.config  # type: ignore[assignment]
+        frac = min(1.0, self._env_steps / max(cfg.epsilon_decay_steps, 1))
+        return cfg.epsilon_initial + frac * (cfg.epsilon_final - cfg.epsilon_initial)
+
+    def train(self) -> Dict[str, Any]:
+        import ray_tpu
+
+        cfg: DQNConfig = self.config  # type: ignore[assignment]
+        params_ref = ray_tpu.put(jax_to_numpy(self._learner.get_params()))
+        eps = self._epsilon()
+        batches = ray_tpu.get(
+            [r.sample.remote(params_ref, eps) for r in self._runners])
+        for b in batches:
+            transitions = fragments_to_transitions(b)
+            self._replay.add_batch(transitions)
+            self._env_steps += len(transitions["obs"])
+        stats: Dict[str, float] = {}
+        if len(self._replay) >= cfg.learning_starts:
+            for _ in range(cfg.num_updates_per_iteration):
+                stats = self._learner.update(
+                    self._replay.sample(cfg.train_batch_size))
+        ep = ray_tpu.get([r.episode_stats.remote() for r in self._runners])
+        rewards = [s["episode_reward_mean"] for s in ep if s["episodes_total"]]
+        self._iteration += 1
+        return {
+            "training_iteration": self._iteration,
+            "episode_reward_mean": float(np.mean(rewards)) if rewards else 0.0,
+            "episodes_total": float(sum(s["episodes_total"] for s in ep)),
+            "num_env_steps_sampled": self._env_steps,
+            "epsilon": eps,
+            **stats,
+        }

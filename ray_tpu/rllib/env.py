@@ -18,7 +18,14 @@ class EnvSpec:
     """What the RLModule needs to size its networks."""
 
     obs_dim: int
-    num_actions: int
+    num_actions: int  # discrete action count (0 for continuous envs)
+    action_dim: int = 0  # continuous action dimensions (0 for discrete envs)
+    action_low: float = -1.0
+    action_high: float = 1.0
+
+    @property
+    def continuous(self) -> bool:
+        return self.action_dim > 0
 
 
 class Env:
@@ -82,7 +89,59 @@ class CartPoleEnv(Env):
         return self._state.astype(np.float32), 1.0, done, {}
 
 
-_ENV_REGISTRY: Dict[str, Callable[[], Env]] = {"CartPole-v1": CartPoleEnv}
+class PendulumEnv(Env):
+    """Torque-controlled inverted pendulum swing-up (continuous actions),
+    standard formulation: obs [cos th, sin th, thdot], reward
+    -(th^2 + 0.1 thdot^2 + 0.001 u^2), 200-step episodes."""
+
+    spec = EnvSpec(obs_dim=3, num_actions=0, action_dim=1,
+                   action_low=-2.0, action_high=2.0)
+
+    MAX_SPEED = 8.0
+    MAX_TORQUE = 2.0
+    DT = 0.05
+    G = 10.0
+    M = 1.0
+    L = 1.0
+    MAX_STEPS = 200
+
+    def __init__(self, seed: int = 0):
+        self._rng = np.random.RandomState(seed)
+        self._th = 0.0
+        self._thdot = 0.0
+        self._steps = 0
+
+    def _obs(self) -> np.ndarray:
+        return np.array([np.cos(self._th), np.sin(self._th), self._thdot],
+                        np.float32)
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        if seed is not None:
+            self._rng = np.random.RandomState(seed)
+        self._th = self._rng.uniform(-np.pi, np.pi)
+        self._thdot = self._rng.uniform(-1.0, 1.0)
+        self._steps = 0
+        return self._obs()
+
+    def step(self, action):
+        u = float(np.clip(np.asarray(action).reshape(-1)[0],
+                          -self.MAX_TORQUE, self.MAX_TORQUE))
+        th_norm = ((self._th + np.pi) % (2 * np.pi)) - np.pi
+        cost = th_norm ** 2 + 0.1 * self._thdot ** 2 + 0.001 * u ** 2
+        thdot = self._thdot + self.DT * (
+            3 * self.G / (2 * self.L) * np.sin(self._th)
+            + 3.0 / (self.M * self.L ** 2) * u)
+        self._thdot = float(np.clip(thdot, -self.MAX_SPEED, self.MAX_SPEED))
+        self._th = self._th + self.DT * self._thdot
+        self._steps += 1
+        done = self._steps >= self.MAX_STEPS
+        return self._obs(), -float(cost), done, {}
+
+
+_ENV_REGISTRY: Dict[str, Callable[[], Env]] = {
+    "CartPole-v1": CartPoleEnv,
+    "Pendulum-v1": PendulumEnv,
+}
 
 
 def register_env(name: str, creator: Callable[[], Env]):

@@ -51,12 +51,20 @@ class EnvRunner:
         value = (x @ np.asarray(params["v"]["w"]) + np.asarray(params["v"]["b"]))[..., 0]
         return logits, value
 
-    def sample(self, params) -> Dict[str, Any]:
-        """Collect one fragment per env; returns flat batch arrays."""
+    def sample(self, params, epsilon: Optional[float] = None) -> Dict[str, Any]:
+        """Collect one fragment per env; returns flat batch arrays.
+
+        ``epsilon``: when given, act epsilon-greedily over the logits head
+        (treated as Q-values) instead of sampling the softmax policy — the
+        value-based algorithms' exploration mode (reference:
+        rllib/utils/exploration/epsilon_greedy.py)."""
         params = _tree_to_numpy(params)
         n_envs = len(self._envs)
         T = self._fragment
         obs_buf = np.zeros((T, n_envs, self._module.spec.obs_dim), np.float32)
+        # successor states are only consumed by the replay-based algorithms
+        # (epsilon-greedy mode); the on-policy path shouldn't pay to ship them
+        next_obs_buf = np.zeros_like(obs_buf) if epsilon is not None else None
         act_buf = np.zeros((T, n_envs), np.int64)
         rew_buf = np.zeros((T, n_envs), np.float32)
         done_buf = np.zeros((T, n_envs), np.bool_)
@@ -66,11 +74,18 @@ class EnvRunner:
         for t in range(T):
             obs = np.stack(self._obs)  # [n_envs, obs_dim]
             logits, values = self._fwd(params, obs)
-            # sample categorically in numpy (cheap, avoids device roundtrip)
-            z = logits - logits.max(-1, keepdims=True)
-            probs = np.exp(z) / np.exp(z).sum(-1, keepdims=True)
-            actions = np.array([self._rng.choice(len(p), p=p) for p in probs])
-            logp = np.log(probs[np.arange(n_envs), actions] + 1e-9)
+            if epsilon is not None:
+                greedy = logits.argmax(-1)
+                rand = self._rng.randint(logits.shape[-1], size=n_envs)
+                explore = self._rng.rand(n_envs) < epsilon
+                actions = np.where(explore, rand, greedy)
+                logp = np.zeros(n_envs, np.float32)
+            else:
+                # sample categorically in numpy (cheap, avoids device roundtrip)
+                z = logits - logits.max(-1, keepdims=True)
+                probs = np.exp(z) / np.exp(z).sum(-1, keepdims=True)
+                actions = np.array([self._rng.choice(len(p), p=p) for p in probs])
+                logp = np.log(probs[np.arange(n_envs), actions] + 1e-9)
 
             obs_buf[t] = obs
             act_buf[t] = actions
@@ -80,6 +95,8 @@ class EnvRunner:
                 nxt, rew, done, _ = env.step(int(actions[i]))
                 rew_buf[t, i] = rew
                 done_buf[t, i] = done
+                if next_obs_buf is not None:
+                    next_obs_buf[t, i] = nxt  # pre-reset: the true successor
                 self._ep_return[i] += rew
                 if done:
                     self._completed.append(self._ep_return[i])
@@ -89,11 +106,15 @@ class EnvRunner:
 
         # bootstrap value for the unfinished tail of each env's fragment
         _, last_values = self._fwd(params, np.stack(self._obs))
-        return {
-            "obs": obs_buf, "actions": act_buf, "rewards": rew_buf,
-            "dones": done_buf, "logp": logp_buf, "values": val_buf,
+        out = {
+            "obs": obs_buf, "actions": act_buf,
+            "rewards": rew_buf, "dones": done_buf, "logp": logp_buf,
+            "values": val_buf,
             "bootstrap_value": np.asarray(last_values, np.float32),
         }
+        if next_obs_buf is not None:
+            out["next_obs"] = next_obs_buf
+        return out
 
     def episode_stats(self, window: int = 100) -> Dict[str, float]:
         recent = self._completed[-window:]

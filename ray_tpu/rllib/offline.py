@@ -1,0 +1,174 @@
+"""Offline RL: behavior cloning and MARWIL.
+
+reference: rllib/algorithms/bc/ and rllib/algorithms/marwil/ (+ rllib/offline/
+for data ingestion).  BC maximizes the data log-likelihood; MARWIL weights it
+by exponentiated advantages (monotone policy improvement over the behavior
+policy, Wang et al. 2018).  Data comes in as episode dicts or a
+ray_tpu.data.Dataset of transition rows — no environment needed to train.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithm import AlgorithmConfig
+from ray_tpu.rllib.core.rl_module import RLModule
+from ray_tpu.rllib.env import EnvSpec, make_env
+
+
+def episodes_to_batch(episodes: List[Dict[str, np.ndarray]], gamma: float) -> Dict[str, np.ndarray]:
+    """Concatenate episode dicts {obs [T,D], actions [T], rewards [T]} into a
+    flat batch with discounted returns-to-go (reference: offline/ jsons carry
+    per-timestep rows; returns are computed at load)."""
+    obs, actions, returns = [], [], []
+    for ep in episodes:
+        r = np.asarray(ep["rewards"], np.float32)
+        rtg = np.zeros_like(r)
+        acc = 0.0
+        for t in range(len(r) - 1, -1, -1):
+            acc = r[t] + gamma * acc
+            rtg[t] = acc
+        obs.append(np.asarray(ep["obs"], np.float32))
+        actions.append(np.asarray(ep["actions"], np.int64))
+        returns.append(rtg)
+    return {"obs": np.concatenate(obs), "actions": np.concatenate(actions),
+            "returns": np.concatenate(returns)}
+
+
+@dataclasses.dataclass
+class BCConfig(AlgorithmConfig):
+    lr: float = 1e-3
+    train_batch_size: int = 256
+    num_updates_per_iteration: int = 100
+    beta: float = 0.0  # 0 => pure BC; >0 => MARWIL advantage weighting
+    vf_coef: float = 1.0  # value head learns returns when beta > 0
+    offline_data: Optional[List[Dict[str, np.ndarray]]] = None
+
+    @property
+    def algo_class(self):
+        return BC
+
+
+@dataclasses.dataclass
+class MARWILConfig(BCConfig):
+    beta: float = 1.0
+
+    @property
+    def algo_class(self):
+        return MARWIL
+
+
+class BCLearner:
+    def __init__(self, module: RLModule, cfg: BCConfig):
+        self.module = module
+        self.beta = cfg.beta
+        self.vf_coef = cfg.vf_coef
+        self.optimizer = optax.adam(cfg.lr)
+        self.params = module.init(jax.random.PRNGKey(cfg.seed + 1))
+        self.opt_state = self.optimizer.init(self.params)
+        self._update = jax.jit(self._update_impl)
+
+    def _loss(self, params, obs, actions, returns):
+        logits, values = self.module.forward(params, obs)
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(logp_all, actions[:, None], axis=1)[:, 0]
+        if self.beta > 0:
+            adv = returns - values
+            # normalized exponential advantage weights, clipped for stability
+            w = jnp.exp(self.beta * jax.lax.stop_gradient(
+                adv / (jnp.abs(adv).mean() + 1e-8)))
+            w = jnp.clip(w, 0.0, 20.0)
+            policy_loss = -jnp.mean(w * logp)
+            value_loss = jnp.mean(adv ** 2)
+        else:
+            policy_loss = -jnp.mean(logp)
+            value_loss = jnp.asarray(0.0)
+        total = policy_loss + self.vf_coef * value_loss * (self.beta > 0)
+        return total, {"policy_loss": policy_loss, "value_loss": value_loss,
+                       "logp_mean": jnp.mean(logp)}
+
+    def _update_impl(self, params, opt_state, batch):
+        (_, aux), grads = jax.value_and_grad(self._loss, has_aux=True)(
+            params, batch["obs"], batch["actions"], batch["returns"])
+        updates, opt_state = self.optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, aux
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        jb = {k: jnp.asarray(v) for k, v in batch.items()}
+        self.params, self.opt_state, aux = self._update(
+            self.params, self.opt_state, jb)
+        return {k: float(v) for k, v in aux.items()}
+
+    def get_params(self):
+        return self.params
+
+
+class BC:
+    """Offline algorithm: no EnvRunners; train() consumes the dataset
+    (reference: rllib/algorithms/bc/bc.py)."""
+
+    def __init__(self, config: BCConfig):
+        self.config = config
+        if config.offline_data is None:
+            raise ValueError("BCConfig.offline_data is required "
+                             "(list of episode dicts)")
+        if config.env is not None:
+            self._spec = make_env(config.env).spec
+        else:
+            self._spec = EnvSpec(
+                obs_dim=int(np.asarray(config.offline_data[0]["obs"]).shape[-1]),
+                num_actions=int(max(np.asarray(ep["actions"]).max()
+                                    for ep in config.offline_data)) + 1)
+        self._batch = episodes_to_batch(config.offline_data, config.gamma)
+        self._module = RLModule(self._spec, hidden=tuple(config.hidden))
+        self._learner = BCLearner(self._module, config)
+        self._rng = np.random.RandomState(config.seed)
+        self._iteration = 0
+
+    def train(self) -> Dict[str, Any]:
+        cfg = self.config
+        n = len(self._batch["obs"])
+        stats: Dict[str, float] = {}
+        for _ in range(cfg.num_updates_per_iteration):
+            idx = self._rng.randint(n, size=min(cfg.train_batch_size, n))
+            stats = self._learner.update(
+                {k: v[idx] for k, v in self._batch.items()})
+        self._iteration += 1
+        return {"training_iteration": self._iteration, **stats}
+
+    def get_policy_params(self):
+        return self._learner.get_params()
+
+    def evaluate(self, num_episodes: int = 5, seed: int = 0) -> Dict[str, float]:
+        """Greedy-policy rollouts in the config env (requires config.env)."""
+        assert self.config.env is not None, "evaluate() needs config.env"
+        from ray_tpu.rllib.env_runner import EnvRunner
+
+        params = jax.tree.map(np.asarray, self._learner.get_params())
+        totals = []
+        for ep in range(num_episodes):
+            env = make_env(self.config.env)
+            obs = env.reset(seed=seed + ep)
+            total, done = 0.0, False
+            while not done:
+                logits, _ = EnvRunner._fwd(params, obs[None, :])
+                obs, rew, done, _ = env.step(int(logits[0].argmax()))
+                total += rew
+            totals.append(total)
+        return {"episode_reward_mean": float(np.mean(totals)),
+                "episodes": float(num_episodes)}
+
+    def stop(self):  # API parity with Algorithm
+        pass
+
+
+class MARWIL(BC):
+    """reference: rllib/algorithms/marwil/marwil.py — BC with exponential
+    advantage weighting (beta > 0)."""

@@ -1,0 +1,58 @@
+"""Replay buffer for off-policy algorithms.
+
+reference: rllib/utils/replay_buffers/ — a uniform-sampling circular buffer
+of transitions; kept in the driver process as flat numpy arrays (cheap
+appends, vectorized minibatch gathers feeding the jitted learner)."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+
+class ReplayBuffer:
+    def __init__(self, capacity: int, seed: int = 0):
+        self.capacity = capacity
+        self._store: Dict[str, np.ndarray] = {}
+        self._idx = 0
+        self._size = 0
+        self._rng = np.random.RandomState(seed)
+
+    def __len__(self) -> int:
+        return self._size
+
+    def add_batch(self, batch: Dict[str, np.ndarray]):
+        """batch values shaped [N, ...]; all keys must agree on N."""
+        n = len(next(iter(batch.values())))
+        if n > self.capacity:
+            # keep only the newest `capacity` rows
+            batch = {k: np.asarray(v)[n - self.capacity:] for k, v in batch.items()}
+            n = self.capacity
+        if not self._store:
+            for k, v in batch.items():
+                v = np.asarray(v)
+                self._store[k] = np.zeros((self.capacity, *v.shape[1:]), v.dtype)
+        # write with wraparound
+        first = min(n, self.capacity - self._idx)
+        for k, v in batch.items():
+            v = np.asarray(v)
+            self._store[k][self._idx:self._idx + first] = v[:first]
+            if n > first:
+                self._store[k][:n - first] = v[first:]
+        self._idx = (self._idx + n) % self.capacity
+        self._size = min(self._size + n, self.capacity)
+
+    def sample(self, batch_size: int) -> Dict[str, np.ndarray]:
+        idx = self._rng.randint(self._size, size=batch_size)
+        return {k: v[idx] for k, v in self._store.items()}
+
+
+def fragments_to_transitions(sample: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """Flatten an EnvRunner fragment batch [T, B, ...] into transitions
+    [T*B, ...] with (obs, actions, rewards, next_obs, dones)."""
+    out = {}
+    for k in ("obs", "actions", "rewards", "next_obs", "dones"):
+        v = np.asarray(sample[k])
+        out[k] = v.reshape(-1, *v.shape[2:])
+    return out

@@ -1,0 +1,317 @@
+"""SAC: soft actor-critic for continuous control.
+
+reference: rllib/algorithms/sac/ — off-policy maximum-entropy RL: a
+tanh-squashed Gaussian actor, twin Q critics with polyak-averaged targets,
+and automatic entropy-temperature tuning.  jax-native: critic/actor/alpha
+updates fuse into one jitted program per step; the runner mirrors the
+actor's sampling in numpy so rollouts stay off-device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig, jax_to_numpy
+from ray_tpu.rllib.env import EnvSpec, make_env
+from ray_tpu.rllib.replay import ReplayBuffer
+
+LOG_STD_MIN, LOG_STD_MAX = -10.0, 2.0
+
+
+def _mlp_init(key, sizes, out_dim, out_scale=0.01):
+    params = {"trunk": []}
+    dims = list(sizes)
+    for fan_in, fan_out in zip(dims[:-1], dims[1:]):
+        key, sub = jax.random.split(key)
+        params["trunk"].append({
+            "w": jax.random.normal(sub, (fan_in, fan_out)) * jnp.sqrt(2.0 / fan_in),
+            "b": jnp.zeros((fan_out,)),
+        })
+    key, sub = jax.random.split(key)
+    params["head"] = {
+        "w": jax.random.normal(sub, (dims[-1], out_dim)) * out_scale,
+        "b": jnp.zeros((out_dim,)),
+    }
+    return params
+
+
+def _mlp_fwd(params, x):
+    for layer in params["trunk"]:
+        x = jnp.tanh(x @ layer["w"] + layer["b"])
+    return x @ params["head"]["w"] + params["head"]["b"]
+
+
+class SACModule:
+    """Actor (mu, log_std) + twin critics over (obs, action)."""
+
+    def __init__(self, spec: EnvSpec, hidden=(64, 64)):
+        assert spec.continuous, "SAC needs a continuous-action env"
+        self.spec = spec
+        self.hidden = tuple(hidden)
+        self.scale = (spec.action_high - spec.action_low) / 2.0
+        self.center = (spec.action_high + spec.action_low) / 2.0
+
+    def init(self, key) -> Dict[str, Any]:
+        k_actor, k_q1, k_q2 = jax.random.split(key, 3)
+        obs, act = self.spec.obs_dim, self.spec.action_dim
+        return {
+            "actor": _mlp_init(k_actor, (obs, *self.hidden), 2 * act),
+            "q1": _mlp_init(k_q1, (obs + act, *self.hidden), 1, out_scale=1.0),
+            "q2": _mlp_init(k_q2, (obs + act, *self.hidden), 1, out_scale=1.0),
+        }
+
+    def actor_dist(self, actor_params, obs) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        out = _mlp_fwd(actor_params, obs)
+        mu, log_std = jnp.split(out, 2, axis=-1)
+        return mu, jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+
+    def sample_action(self, actor_params, obs, key):
+        """Returns (env_action, logp) with tanh-squash correction."""
+        mu, log_std = self.actor_dist(actor_params, obs)
+        std = jnp.exp(log_std)
+        eps = jax.random.normal(key, mu.shape)
+        pre = mu + std * eps
+        tanh_a = jnp.tanh(pre)
+        logp = (-0.5 * (eps ** 2 + 2 * log_std + jnp.log(2 * jnp.pi))
+                - jnp.log(self.scale * (1 - tanh_a ** 2) + 1e-6)).sum(-1)
+        return tanh_a * self.scale + self.center, logp
+
+    def q_values(self, params, obs, action):
+        x = jnp.concatenate([obs, (action - self.center) / self.scale], axis=-1)
+        return _mlp_fwd(params["q1"], x)[..., 0], _mlp_fwd(params["q2"], x)[..., 0]
+
+
+@dataclasses.dataclass
+class SACConfig(AlgorithmConfig):
+    lr: float = 3e-4
+    alpha_lr: float = 3e-4
+    buffer_size: int = 100_000
+    learning_starts: int = 1_000
+    train_batch_size: int = 128
+    num_updates_per_iteration: int = 64
+    tau: float = 0.005  # polyak target averaging
+    initial_alpha: float = 0.1
+    target_entropy: Optional[float] = None  # default: -action_dim
+
+    @property
+    def algo_class(self):
+        return SAC
+
+
+class SACLearner:
+    def __init__(self, module: SACModule, cfg: SACConfig):
+        self.module = module
+        self.gamma = cfg.gamma
+        self.tau = cfg.tau
+        self.target_entropy = (cfg.target_entropy
+                               if cfg.target_entropy is not None
+                               else -float(module.spec.action_dim))
+        self.optimizer = optax.adam(cfg.lr)
+        self.alpha_opt = optax.adam(cfg.alpha_lr)
+        self.params = module.init(jax.random.PRNGKey(cfg.seed + 1))
+        self.target_q = jax.tree.map(
+            jnp.copy, {"q1": self.params["q1"], "q2": self.params["q2"]})
+        self.opt_state = self.optimizer.init(self.params)
+        self.log_alpha = jnp.log(jnp.asarray(cfg.initial_alpha))
+        self.alpha_state = self.alpha_opt.init(self.log_alpha)
+        self._key = jax.random.PRNGKey(cfg.seed + 2)
+        self._update = jax.jit(self._update_impl)
+
+    def _update_impl(self, params, target_q, opt_state, log_alpha, alpha_state,
+                     key, batch):
+        obs, actions = batch["obs"], batch["actions"]
+        rewards, next_obs = batch["rewards"], batch["next_obs"]
+        dones = batch["dones"].astype(jnp.float32)
+        alpha = jnp.exp(log_alpha)
+        k_next, k_actor = jax.random.split(key)
+
+        # -- critic target: soft Bellman backup over fresh next actions
+        next_a, next_logp = self.module.sample_action(
+            params["actor"], next_obs, k_next)
+        tq1, tq2 = self.module.q_values(
+            {"q1": target_q["q1"], "q2": target_q["q2"]}, next_obs, next_a)
+        target_v = jnp.minimum(tq1, tq2) - alpha * next_logp
+        y = jax.lax.stop_gradient(rewards + self.gamma * (1 - dones) * target_v)
+
+        def critic_loss(p):
+            q1, q2 = self.module.q_values(p, obs, actions)
+            return jnp.mean((q1 - y) ** 2) + jnp.mean((q2 - y) ** 2), (q1, q2)
+
+        def actor_loss(p):
+            a, logp = self.module.sample_action(p["actor"], obs, k_actor)
+            q1, q2 = self.module.q_values(
+                jax.lax.stop_gradient({"q1": p["q1"], "q2": p["q2"]}), obs, a)
+            return jnp.mean(alpha * logp - jnp.minimum(q1, q2)), logp
+
+        def total_loss(p):
+            cl, (q1, _q2) = critic_loss(p)
+            al, logp = actor_loss(p)
+            return cl + al, (q1, logp)
+
+        (_, (q1, logp)), grads = jax.value_and_grad(total_loss, has_aux=True)(params)
+        updates, opt_state = self.optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+
+        # -- temperature: alpha tracks the entropy target
+        def alpha_loss(la):
+            return -jnp.mean(jnp.exp(la) * jax.lax.stop_gradient(
+                logp + self.target_entropy))
+
+        a_grad = jax.grad(alpha_loss)(log_alpha)
+        a_up, alpha_state = self.alpha_opt.update(a_grad, alpha_state)
+        log_alpha = optax.apply_updates(log_alpha, a_up)
+
+        # -- polyak target update
+        target_q = jax.tree.map(
+            lambda t, o: (1 - self.tau) * t + self.tau * o,
+            target_q, {"q1": params["q1"], "q2": params["q2"]})
+        aux = {"q_mean": jnp.mean(q1), "alpha": jnp.exp(log_alpha),
+               "actor_entropy": -jnp.mean(logp)}
+        return params, target_q, opt_state, log_alpha, alpha_state, aux
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        jb = {k: jnp.asarray(v) for k, v in batch.items()}
+        self._key, sub = jax.random.split(self._key)
+        (self.params, self.target_q, self.opt_state, self.log_alpha,
+         self.alpha_state, aux) = self._update(
+            self.params, self.target_q, self.opt_state, self.log_alpha,
+            self.alpha_state, sub, jb)
+        return {k: float(v) for k, v in aux.items()}
+
+    def get_params(self):
+        return self.params
+
+
+class ContinuousEnvRunner:
+    """Rollout actor mirroring the SAC actor's tanh-Gaussian sampling in
+    numpy (reference: rllib EnvRunner; the numpy mirror keeps per-step env
+    loops off-device, same as the discrete runner)."""
+
+    def __init__(self, env_creator, spec_kwargs: dict,
+                 num_envs: int = 1, seed: int = 0,
+                 rollout_fragment_length: int = 200):
+        self._envs = [make_env(env_creator) for _ in range(num_envs)]
+        spec = EnvSpec(**spec_kwargs)
+        self._scale = (spec.action_high - spec.action_low) / 2.0
+        self._center = (spec.action_high + spec.action_low) / 2.0
+        self._spec = spec
+        self._fragment = rollout_fragment_length
+        self._rng = np.random.RandomState(seed)
+        self._obs = [env.reset(seed=seed * 1000 + i)
+                     for i, env in enumerate(self._envs)]
+        self._ep_return = [0.0] * num_envs
+        self._completed: List[float] = []
+
+    @staticmethod
+    def _mlp(params, x):
+        for layer in params["trunk"]:
+            x = np.tanh(x @ np.asarray(layer["w"]) + np.asarray(layer["b"]))
+        return x @ np.asarray(params["head"]["w"]) + np.asarray(params["head"]["b"])
+
+    def sample(self, params, random_actions: bool = False) -> Dict[str, Any]:
+        n_envs, T = len(self._envs), self._fragment
+        act_dim = self._spec.action_dim
+        obs_buf = np.zeros((T, n_envs, self._spec.obs_dim), np.float32)
+        next_obs_buf = np.zeros_like(obs_buf)
+        act_buf = np.zeros((T, n_envs, act_dim), np.float32)
+        rew_buf = np.zeros((T, n_envs), np.float32)
+        done_buf = np.zeros((T, n_envs), np.bool_)
+
+        for t in range(T):
+            obs = np.stack(self._obs)
+            if random_actions:
+                actions = self._rng.uniform(
+                    self._spec.action_low, self._spec.action_high,
+                    size=(n_envs, act_dim))
+            else:
+                out = self._mlp(params["actor"], obs)
+                mu, log_std = np.split(out, 2, axis=-1)
+                std = np.exp(np.clip(log_std, LOG_STD_MIN, LOG_STD_MAX))
+                pre = mu + std * self._rng.randn(*mu.shape)
+                actions = np.tanh(pre) * self._scale + self._center
+            obs_buf[t] = obs
+            act_buf[t] = actions
+            for i, env in enumerate(self._envs):
+                nxt, rew, done, _ = env.step(actions[i])
+                rew_buf[t, i] = rew
+                done_buf[t, i] = done
+                next_obs_buf[t, i] = nxt
+                self._ep_return[i] += rew
+                if done:
+                    self._completed.append(self._ep_return[i])
+                    self._ep_return[i] = 0.0
+                    nxt = env.reset()
+                self._obs[i] = nxt
+        return {"obs": obs_buf, "next_obs": next_obs_buf, "actions": act_buf,
+                "rewards": rew_buf, "dones": done_buf}
+
+    def episode_stats(self, window: int = 100) -> Dict[str, float]:
+        recent = self._completed[-window:]
+        return {
+            "episodes_total": float(len(self._completed)),
+            "episode_reward_mean": float(np.mean(recent)) if recent else 0.0,
+        }
+
+
+class SAC(Algorithm):
+    """reference: rllib/algorithms/sac/sac.py."""
+
+    def __init__(self, config: SACConfig):
+        import ray_tpu
+
+        self.config = config
+        if config.env is None:
+            raise ValueError("config.environment(env) is required")
+        probe = make_env(config.env)
+        self._spec = probe.spec
+        self._learner = self._build_learner()
+        spec_kwargs = dataclasses.asdict(self._spec)
+        self._runners = [
+            ray_tpu.remote(ContinuousEnvRunner).options(num_cpus=0.5).remote(
+                config.env, spec_kwargs,
+                num_envs=config.num_envs_per_runner, seed=config.seed + i,
+                rollout_fragment_length=config.rollout_fragment_length)
+            for i in range(config.num_env_runners)
+        ]
+        self._iteration = 0
+        self._replay = ReplayBuffer(config.buffer_size, seed=config.seed)
+        self._env_steps = 0
+
+    def _build_learner(self):
+        cfg: SACConfig = self.config  # type: ignore[assignment]
+        return SACLearner(SACModule(self._spec, hidden=tuple(cfg.hidden)), cfg)
+
+    def train(self) -> Dict[str, Any]:
+        import ray_tpu
+
+        cfg: SACConfig = self.config  # type: ignore[assignment]
+        warmup = self._env_steps < cfg.learning_starts
+        params_ref = ray_tpu.put(jax_to_numpy(self._learner.get_params()))
+        batches = ray_tpu.get(
+            [r.sample.remote(params_ref, warmup) for r in self._runners])
+        for b in batches:
+            flat = {k: np.asarray(v).reshape(-1, *np.asarray(v).shape[2:])
+                    for k, v in b.items()}
+            self._replay.add_batch(flat)
+            self._env_steps += len(flat["obs"])
+        stats: Dict[str, float] = {}
+        if len(self._replay) >= cfg.learning_starts:
+            for _ in range(cfg.num_updates_per_iteration):
+                stats = self._learner.update(
+                    self._replay.sample(cfg.train_batch_size))
+        ep = ray_tpu.get([r.episode_stats.remote() for r in self._runners])
+        rewards = [s["episode_reward_mean"] for s in ep if s["episodes_total"]]
+        self._iteration += 1
+        return {
+            "training_iteration": self._iteration,
+            "episode_reward_mean": float(np.mean(rewards)) if rewards else 0.0,
+            "episodes_total": float(sum(s["episodes_total"] for s in ep)),
+            "num_env_steps_sampled": self._env_steps,
+            **stats,
+        }

@@ -94,8 +94,10 @@ class HyperBandScheduler(TrialScheduler):
         value = result.get(self.metric)
         if t < b.milestone or value is None:
             return self.CONTINUE
-        # reached the rung: park the score; once the whole rung is in, halve
-        b.at_milestone[trial] = self._signed(value)
+        # reached the rung: park the FIRST at-rung score (stragglers may keep
+        # training past the milestone; their later results must not shift the
+        # comparison budget); once the whole rung is in, halve
+        b.at_milestone.setdefault(trial, self._signed(value))
         if not b.ready_to_halve():
             return self.CONTINUE
         losers = b.halve()

@@ -73,7 +73,12 @@ class PB2(PopulationBasedTraining):
                 if cfg:
                     self._data.append((float(t), cfg, delta))
             self._prev_score[trial] = (t, score)
-        return super().on_trial_result(trial, result)
+        decision = super().on_trial_result(trial, result)
+        if decision == self.PAUSE:
+            # exploit restarts from the donor's checkpoint: the score jump
+            # to the donor's level is NOT evidence about the new config
+            self._prev_score.pop(trial, None)
+        return decision
 
     # -- GP-UCB explore ----------------------------------------------------
 
