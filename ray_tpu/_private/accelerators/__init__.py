@@ -10,10 +10,12 @@ import os
 from typing import Dict, List, Optional, Tuple
 
 from ray_tpu._private.accelerators.accelerator import AcceleratorManager
+from ray_tpu._private.accelerators.nvidia_gpu import NvidiaGPUAcceleratorManager
 from ray_tpu._private.accelerators.tpu import TPUAcceleratorManager
 
 _MANAGERS: Dict[str, AcceleratorManager] = {
     "TPU": TPUAcceleratorManager,
+    "GPU": NvidiaGPUAcceleratorManager,
 }
 
 
@@ -23,6 +25,12 @@ def get_all_accelerator_managers() -> List[AcceleratorManager]:
 
 def get_accelerator_manager(resource_name: str) -> Optional[AcceleratorManager]:
     return _MANAGERS.get(resource_name)
+
+
+def register_accelerator_manager(manager: AcceleratorManager):
+    """Third-party plugin hook (reference: the registry pattern at
+    accelerators/__init__.py:14-36 — one manager per vendor family)."""
+    _MANAGERS[manager.get_resource_name()] = manager
 
 
 def detect_node_resources_and_labels() -> Tuple[Dict[str, float], Dict[str, str]]:

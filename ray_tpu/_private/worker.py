@@ -908,6 +908,16 @@ class CoreWorker:
             returns = self._pack_returns(spec, result)
             self.server.send_reply(reply_token, {"status": "ok", "returns": returns})
         except Exception as e:  # noqa: BLE001
+            from ray_tpu.util import rpdb
+
+            if rpdb.post_mortem_enabled():
+                # RAY_TPU_POST_MORTEM=1: hold the crash frame open for a
+                # remote debugger before failing the task (reference:
+                # RAY_DEBUG_POST_MORTEM)
+                try:
+                    rpdb.post_mortem(label=f"post-mortem:{spec.name}")
+                except Exception:  # noqa: BLE001
+                    pass
             self.server.send_reply(
                 reply_token,
                 {"status": "error", "error": e, "traceback": traceback.format_exc()},

@@ -325,6 +325,35 @@ def cmd_timeline(args) -> int:
     return 0
 
 
+def cmd_debug(args) -> int:
+    rt = _connect(args)
+    from ray_tpu.util import rpdb
+
+    try:
+        sessions = rpdb.list_breakpoints()
+        if not sessions:
+            print("no open breakpoints")
+            return 0
+        for i, s in enumerate(sessions):
+            print(f"[{i}] {s['label']} pid={s['pid']} "
+                  f"{s['host']}:{s['port']}")
+        index = args.index
+        if index is None:
+            if len(sessions) > 1:
+                print("multiple breakpoints; pass an index", file=sys.stderr)
+                return 1
+            index = 0
+        if not 0 <= index < len(sessions):
+            print(f"index {index} out of range (0..{len(sessions) - 1})",
+                  file=sys.stderr)
+            return 1
+        s = sessions[index]
+        rpdb.connect(s["host"], s["port"])
+        return 0
+    finally:
+        rt.shutdown()
+
+
 # ---------------------------------------------------------------------------
 # jobs
 # ---------------------------------------------------------------------------
@@ -410,6 +439,12 @@ def main(argv=None) -> int:
     sp.add_argument("kind", choices=("tasks", "actors"))
     sp.add_argument("--address", default=None)
     sp.set_defaults(fn=cmd_summary)
+
+    sp = sub.add_parser("debug", help="list / attach to open remote breakpoints")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("index", nargs="?", type=int, default=None,
+                    help="breakpoint number to attach to (default: sole one)")
+    sp.set_defaults(fn=cmd_debug)
 
     sp = sub.add_parser("job", help="job submission")
     jsub = sp.add_subparsers(dest="job_cmd", required=True)

@@ -50,3 +50,11 @@ def add_route(route_prefix: str, handle: DeploymentHandle):
     from ray_tpu.serve._private.proxy import register_route
 
     register_route(route_prefix, handle)
+
+
+def start_rpc_proxy(host: str = "127.0.0.1", port: int = 0):
+    """Start the binary RPC ingress sharing the HTTP proxy's route table
+    (reference: the gRPC proxy, serve/_private/proxy.py:530)."""
+    from ray_tpu.serve._private.rpc_proxy import start_rpc_proxy as _start
+
+    return _start(host, port)

@@ -103,9 +103,17 @@ def deployment(target=None, **kwargs):
 # ---------------------------------------------------------------------------
 
 def run(app: Application, *, name: str = "default", route_prefix: str = "/",
-        blocking: bool = False) -> DeploymentHandle:
+        blocking: bool = False,
+        _local_testing_mode: bool = False) -> DeploymentHandle:
     """Deploy an application; returns a handle to its ingress deployment
-    (reference: api.py:665)."""
+    (reference: api.py:665).  ``_local_testing_mode=True`` runs every
+    deployment in-process with no cluster (reference:
+    serve/_private/local_testing_mode.py)."""
+    if _local_testing_mode:
+        from ray_tpu.serve._private.local_testing import run_local
+
+        return run_local(app, name)
+
     import ray_tpu
     from ray_tpu.serve._private.controller import get_or_create_controller
 
@@ -126,13 +134,25 @@ def run(app: Application, *, name: str = "default", route_prefix: str = "/",
 def delete(name: str = "default"):
     import ray_tpu
     from ray_tpu.serve._private.controller import get_or_create_controller
+    from ray_tpu.serve._private.local_testing import delete_local, get_local_app
 
+    if get_local_app(name) is not None:
+        delete_local(name)
+        return
     ray_tpu.get(get_or_create_controller().delete_application.remote(name))
 
 
 def get_app_handle(name: str = "default") -> DeploymentHandle:
     import ray_tpu
     from ray_tpu.serve._private.controller import get_or_create_controller
+    from ray_tpu.serve._private.local_testing import get_local_app
+
+    local = get_local_app(name)
+    if local is not None:
+        return local
+    if not ray_tpu.is_initialized():
+        raise ValueError(f"no serve application named {name!r} "
+                         "(no local app, and no cluster connected)")
 
     controller = get_or_create_controller()
     info = ray_tpu.get(controller.get_deployment_info.remote(name))

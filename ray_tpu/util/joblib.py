@@ -1,0 +1,68 @@
+"""joblib backend running on the distributed runtime.
+
+reference: python/ray/util/joblib/ — `register_ray()` adds a joblib
+parallel backend so scikit-learn-style `Parallel(n_jobs=...)` fan-outs run
+as cluster tasks.  Implemented the same way the reference does: subclass
+joblib's MultiprocessingBackend and hand it the framework's actor-backed
+Pool (ray_tpu.util.multiprocessing) instead of OS processes.
+
+    from ray_tpu.util.joblib import register_ray
+    import joblib
+
+    register_ray()
+    with joblib.parallel_backend("ray_tpu"):
+        results = joblib.Parallel()(joblib.delayed(f)(x) for x in xs)
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def register_ray():
+    """Register the 'ray_tpu' joblib backend (reference:
+    util/joblib/__init__.py register_ray)."""
+    import joblib
+
+    joblib.register_parallel_backend("ray_tpu", RayTpuBackend)
+
+
+try:
+    from joblib._parallel_backends import MultiprocessingBackend
+except ImportError:  # joblib absent: register_ray() will fail loudly instead
+    MultiprocessingBackend = object  # type: ignore[misc,assignment]
+
+
+class RayTpuBackend(MultiprocessingBackend):  # type: ignore[valid-type,misc]
+    """reference: util/joblib/ray_backend.py RayBackend."""
+
+    supports_sharedmem = False
+
+    def configure(self, n_jobs: int = 1, parallel=None, prefer=None,
+                  require=None, **memmapping_pool_kwargs):
+        import ray_tpu
+        from ray_tpu.util.multiprocessing import Pool
+
+        n_jobs = self.effective_n_jobs(n_jobs)
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        # eager validation, then hand joblib a live pool
+        self._pool = Pool(processes=n_jobs)
+        self.parallel = parallel
+        return n_jobs
+
+    def effective_n_jobs(self, n_jobs: Optional[int]) -> int:
+        import ray_tpu
+
+        if n_jobs == 0:
+            raise ValueError("n_jobs == 0 in Parallel has no meaning")
+        if n_jobs is None or n_jobs < 0:
+            if ray_tpu.is_initialized():
+                return max(int(ray_tpu.cluster_resources().get("CPU", 1)), 1)
+            import os
+
+            return os.cpu_count() or 1
+        return n_jobs
+
+    # terminate() is inherited: PoolManagerMixin closes + terminates the
+    # pool, MultiprocessingBackend resets batch stats.

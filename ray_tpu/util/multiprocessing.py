@@ -45,6 +45,50 @@ class AsyncResult:
             return False
 
 
+class _CallbackResult(AsyncResult):
+    """AsyncResult honoring the stdlib contract: the callback completes
+    BEFORE the result reads as ready, and one shared handler thread serves
+    every callback (stdlib Pool's _handle_results analog)."""
+
+    def __init__(self, refs):
+        super().__init__(refs)
+        import threading
+
+        self._event = threading.Event()
+        self._value = None
+        self._error: Optional[Exception] = None
+
+    def _resolve(self, callback, error_callback):
+        try:
+            self._value = super().get()
+            if callback is not None:
+                callback(self._value)
+        except Exception as e:  # noqa: BLE001
+            self._error = e
+            if error_callback is not None:
+                try:
+                    error_callback(e)
+                except Exception:  # noqa: BLE001
+                    pass
+        finally:
+            self._event.set()
+
+    def get(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            import ray_tpu
+
+            raise ray_tpu.GetTimeoutError("result not ready")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+    def wait(self, timeout: Optional[float] = None):
+        self._event.wait(timeout)
+
+    def ready(self) -> bool:
+        return self._event.is_set()
+
+
 class _PoolWorker:
     def run(self, fn, args):
         return fn(*args)
@@ -74,6 +118,28 @@ class Pool:
 
     # -- submission ----------------------------------------------------
 
+    def _callback_queue(self):
+        """One shared handler thread per pool drains every callback in
+        submission order (stdlib Pool _handle_results analog)."""
+        if getattr(self, "_cb_queue", None) is None:
+            import queue
+            import threading
+
+            self._cb_queue = queue.Queue()
+
+            def drain():
+                while True:
+                    item = self._cb_queue.get()
+                    if item is None:
+                        return
+                    result, callback, error_callback = item
+                    result._resolve(callback, error_callback)
+
+            self._cb_thread = threading.Thread(
+                target=drain, daemon=True, name="pool-callbacks")
+            self._cb_thread.start()
+        return self._cb_queue
+
     def _check_open(self):
         if self._closed:
             raise ValueError("Pool not running")
@@ -85,14 +151,21 @@ class Pool:
         return self.apply_async(fn, args, kwds).get()
 
     def apply_async(self, fn: Callable, args: tuple = (),
-                    kwds: Optional[dict] = None) -> AsyncResult:
+                    kwds: Optional[dict] = None,
+                    callback: Optional[Callable] = None,
+                    error_callback: Optional[Callable] = None) -> AsyncResult:
         self._check_open()
         if kwds:
             import functools
 
             fn = functools.partial(fn, **kwds)
         actor = self._actors[next(self._rr)]
-        return AsyncResult(actor.run.remote(fn, tuple(args)))
+        ref = actor.run.remote(fn, tuple(args))
+        if callback is None and error_callback is None:
+            return AsyncResult(ref)
+        result = _CallbackResult(ref)
+        self._callback_queue().put((result, callback, error_callback))
+        return result
 
     def map(self, fn: Callable, iterable: Iterable,
             chunksize: Optional[int] = None) -> List[Any]:
@@ -161,6 +234,9 @@ class Pool:
         import ray_tpu
 
         self._closed = True
+        if getattr(self, "_cb_queue", None) is not None:
+            self._cb_queue.put(None)  # stop the callback handler thread
+            self._cb_queue = None
         for a in self._actors:
             try:
                 ray_tpu.kill(a)

@@ -1,0 +1,105 @@
+"""Serve local testing mode + RPC ingress (reference:
+serve/_private/local_testing_mode.py and the gRPC proxy)."""
+
+import numpy as np
+import pytest
+
+
+# ---------------------------------------------------------------------------
+# local testing mode: NO cluster fixture on purpose
+# ---------------------------------------------------------------------------
+
+
+def test_local_testing_mode_runs_without_cluster():
+    import ray_tpu
+    from ray_tpu import serve
+
+    assert not ray_tpu.is_initialized()
+
+    @serve.deployment
+    class Preprocessor:
+        def __call__(self, x):
+            return x * 2
+
+    @serve.deployment(user_config={"bias": 10})
+    class Model:
+        def __init__(self, pre):
+            self.pre = pre
+            self.bias = 0
+
+        def reconfigure(self, cfg):
+            self.bias = cfg["bias"]
+
+        def __call__(self, x):
+            doubled = self.pre.remote(x).result()
+            return doubled + self.bias
+
+        def stats(self):
+            return "ok"
+
+    app = Model.bind(Preprocessor.bind())
+    handle = serve.run(app, _local_testing_mode=True)
+    assert not ray_tpu.is_initialized()  # truly clusterless
+
+    assert handle.remote(5).result() == 20  # 5*2 + 10 (user_config applied)
+    assert handle.options(method_name="stats").remote().result() == "ok"
+    assert handle.stats.remote().result() == "ok"
+
+    # registry: get_app_handle + delete work in local mode
+    again = serve.get_app_handle()
+    assert again.remote(1).result() == 12
+    serve.delete()
+    with pytest.raises(ValueError):
+        serve.get_app_handle()
+
+
+def test_local_mode_function_deployment():
+    from ray_tpu import serve
+
+    @serve.deployment
+    def square(x):
+        return x * x
+
+    h = serve.run(square.bind(), name="fn", _local_testing_mode=True)
+    assert h.remote(7).result() == 49
+    serve.delete("fn")
+
+
+# ---------------------------------------------------------------------------
+# RPC ingress against a real cluster
+# ---------------------------------------------------------------------------
+
+
+def test_rpc_proxy_roundtrips_python_values():
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve._private.rpc_proxy import ServeRpcClient, stop_rpc_proxy
+
+    ray_tpu.init(num_cpus=4)
+    try:
+        @serve.deployment
+        class Echo:
+            def __call__(self, arr, scale=1.0):
+                return {"sum": float(np.asarray(arr).sum() * scale),
+                        "shape": np.asarray(arr).shape}
+
+            def meta(self):
+                return "echo-meta"
+
+        handle = serve.run(Echo.bind(), route_prefix="/echo")
+        serve.add_route("/echo", handle)
+        addr = serve.start_rpc_proxy()
+
+        client = ServeRpcClient(addr)
+        assert "/echo" in client.routes()
+        # numpy arrays + kwargs survive the binary path (JSON couldn't)
+        out = client.call("/echo", np.arange(6).reshape(2, 3), scale=2.0)
+        assert out["sum"] == 30.0 and out["shape"] == (2, 3)
+        assert client.call("/echo", method="meta") == "echo-meta"
+        with pytest.raises(Exception):
+            client.call("/nosuchroute!", 1)
+        client.close()
+    finally:
+        stop_rpc_proxy()
+        serve.shutdown()
+        ray_tpu.shutdown()

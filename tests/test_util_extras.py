@@ -1,0 +1,155 @@
+"""Accelerator plugins, joblib backend, remote debugger.
+
+reference: _private/accelerators/ registry, util/joblib/, util/rpdb.py.
+"""
+
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+
+def test_gpu_accelerator_manager_registered():
+    from ray_tpu._private.accelerators import (
+        get_accelerator_manager,
+        get_all_accelerator_managers,
+        register_accelerator_manager,
+    )
+
+    gpu = get_accelerator_manager("GPU")
+    assert gpu is not None
+    assert gpu.get_resource_name() == "GPU"
+    assert gpu.get_visible_accelerator_ids_env_var() == "CUDA_VISIBLE_DEVICES"
+    # no GPUs in this image
+    assert gpu.get_current_node_num_accelerators() == 0
+    ok, _ = gpu.validate_resource_request_quantity(0.5)
+    assert ok
+
+    # visible-id carving writes the env var
+    old = os.environ.get("CUDA_VISIBLE_DEVICES")
+    try:
+        gpu.set_current_process_visible_accelerator_ids(["2", "3"])
+        assert os.environ["CUDA_VISIBLE_DEVICES"] == "2,3"
+        assert gpu.get_current_process_visible_accelerator_ids() == ["2", "3"]
+    finally:
+        if old is None:
+            os.environ.pop("CUDA_VISIBLE_DEVICES", None)
+        else:
+            os.environ["CUDA_VISIBLE_DEVICES"] = old
+
+    # third-party registration hook
+    class FakeNPU:
+        @staticmethod
+        def get_resource_name():
+            return "NPU"
+
+    register_accelerator_manager(FakeNPU)
+    assert get_accelerator_manager("NPU") is FakeNPU
+    assert FakeNPU in get_all_accelerator_managers()
+    from ray_tpu._private.accelerators import _MANAGERS
+
+    _MANAGERS.pop("NPU")
+
+
+def test_joblib_backend(ray_start_regular):
+    import joblib
+
+    from ray_tpu.util.joblib import register_ray
+
+    register_ray()
+    sq = lambda x: x * x  # noqa: E731 — closure pickles by value
+    with joblib.parallel_backend("ray_tpu", n_jobs=2):
+        results = joblib.Parallel()(joblib.delayed(sq)(i) for i in range(8))
+    assert results == [i * i for i in range(8)]
+
+    # errors propagate
+    def boom(_):
+        raise RuntimeError("joblib-boom")
+
+    with pytest.raises(RuntimeError, match="joblib-boom"):
+        with joblib.parallel_backend("ray_tpu", n_jobs=2):
+            joblib.Parallel()(joblib.delayed(boom)(i) for i in range(2))
+
+
+def test_pool_callback_completes_before_ready(ray_start_regular):
+    """stdlib contract: apply_async's callback finishes before .get()
+    returns / .ready() is True."""
+    from ray_tpu.util.multiprocessing import Pool
+
+    pool = Pool(processes=2)
+    try:
+        for _ in range(5):
+            results = []
+            r = pool.apply_async(lambda x: x + 1, (1,), callback=results.append)
+            assert r.get(timeout=60) == 2
+            assert results == [2]  # callback already ran
+            assert r.ready()
+
+        errors = []
+        r = pool.apply_async(lambda: 1 / 0, error_callback=errors.append)
+        with pytest.raises(ZeroDivisionError):
+            r.get(timeout=60)
+        assert len(errors) == 1 and isinstance(errors[0], ZeroDivisionError)
+    finally:
+        pool.terminate()
+
+
+def test_rpdb_breakpoint_and_cli_listing(ray_start_regular):
+    import ray_tpu
+    from ray_tpu.util import rpdb
+
+    @ray_tpu.remote
+    def task_with_breakpoint():
+        from ray_tpu.util import rpdb as worker_rpdb
+
+        x = 41
+        worker_rpdb.set_trace(label="unit-test")
+        return x + 1
+
+    ref = task_with_breakpoint.remote()
+
+    # wait for the breakpoint to be announced in the KV
+    deadline = time.monotonic() + 60
+    sessions = []
+    while time.monotonic() < deadline:
+        sessions = rpdb.list_breakpoints()
+        if sessions:
+            break
+        time.sleep(0.2)
+    assert sessions, "breakpoint never announced"
+    s = sessions[0]
+    assert s["label"] == "unit-test"
+
+    # attach, poke at the paused frame, continue
+    conn = socket.create_connection((s["host"], s["port"]), timeout=30)
+    f = conn.makefile("rw")
+
+    def send(cmd):
+        f.write(cmd + "\n")
+        f.flush()
+
+    # read until prompt, answer with p x then continue
+    send("p x")
+    send("c")
+    out = []
+    try:
+        conn.settimeout(30)
+        while True:
+            data = conn.recv(4096)
+            if not data:
+                break
+            out.append(data.decode("utf-8", "replace"))
+    except OSError:
+        pass
+    conn.close()
+    text = "".join(out)
+    assert "41" in text, text
+
+    assert ray_tpu.get(ref, timeout=60) == 42
+    # breakpoint withdrew its KV entry on continue
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline and rpdb.list_breakpoints():
+        time.sleep(0.2)
+    assert not rpdb.list_breakpoints()
