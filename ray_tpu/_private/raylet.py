@@ -109,9 +109,11 @@ class Raylet:
         self.server.register_all(self)
 
         from ray_tpu._private.log_monitor import LogMonitor
+        from ray_tpu.dashboard.agent import NodeStatsCollector
 
         self._log_monitor = LogMonitor(self.gcs, self.server.address[0],
                                        self.node_id.hex())
+        self._node_stats = NodeStatsCollector()
 
         self._lock = threading.RLock()
         self._dispatch_cv = threading.Condition(self._lock)
@@ -774,6 +776,51 @@ class Raylet:
                 {"object_id": oid.hex(), "size": self.store.object_size(oid)}
                 for oid in oids
             ]
+
+    # -- per-node agent endpoints (reference: dashboard/agent.py +
+    # modules/reporter/; hosted on the raylet's RPC server) --------------
+
+    def HandleAgentNodeStats(self, req):
+        with self._lock:
+            pids = [w.proc.pid for w in self._all_workers.values()
+                    if w.proc is not None]
+        return self._node_stats.collect(pids)
+
+    def _worker_addrs(self, pid=None):
+        with self._lock:
+            return [(w.proc.pid if w.proc else None, w.address)
+                    for w in self._all_workers.values()
+                    if w.address is not None
+                    and (pid is None or (w.proc and w.proc.pid == pid))]
+
+    def HandleAgentStacks(self, req):
+        """Stack traces of every worker on this node (reference: py-spy
+        dump via the reporter agent)."""
+        out = []
+        for pid, addr in self._worker_addrs(req.get("pid")):
+            try:
+                out.append(self.pool.get(tuple(addr)).call(
+                    "DumpStacks", {}, timeout=10))
+            except Exception as e:  # noqa: BLE001
+                out.append({"pid": pid, "error": str(e)})
+        return out
+
+    def HandleAgentProfile(self, req, reply_token):
+        """Sampling CPU profile of one worker (by pid)."""
+        addrs = self._worker_addrs(req.get("pid"))
+        if not addrs:
+            raise ValueError(f"no worker with pid {req.get('pid')}")
+        _, addr = addrs[0]
+        cli = self.pool.get(tuple(addr))
+        fut = cli.call_async("CpuProfile", {
+            "duration_s": req.get("duration_s", 5.0),
+            "interval_s": req.get("interval_s", 0.01),
+        })
+        server = self.server
+        fut.add_done_callback(
+            lambda f: server.send_error_reply(reply_token, f.exception())
+            if f.exception() else server.send_reply(reply_token, f.result()))
+        return RpcServer.DELAYED_REPLY
 
     def HandleListWorkers(self, req):
         """reference: `ray list workers` (worker pool state)."""

@@ -19,6 +19,7 @@ from __future__ import annotations
 import hashlib
 import logging
 import os
+import sys
 import threading
 import time
 import traceback
@@ -604,6 +605,59 @@ class CoreWorker:
     def HandleRemoveBorrower(self, req):
         self.reference_counter.handle_remove_borrower(req["object_id"], req["borrower"])
         return True
+
+    def HandleDumpStacks(self, req):
+        """Formatted stacks of every thread (reference: the reporter's
+        py-spy dump — same content, no ptrace needed from inside)."""
+        import traceback as tb
+
+        frames = sys._current_frames()
+        names = {t.ident: t.name for t in threading.enumerate()}
+        out = []
+        for ident, frame in frames.items():
+            out.append({
+                "thread": names.get(ident, str(ident)),
+                "stack": "".join(tb.format_stack(frame)),
+            })
+        return {"pid": os.getpid(), "threads": out}
+
+    def HandleCpuProfile(self, req, reply_token):
+        """Sampling CPU profile: sample every thread's top frames for
+        ``duration_s``, return (stack -> hit count) aggregated (reference:
+        reporter's py-spy record endpoint)."""
+        duration = min(float(req.get("duration_s", 5.0)), 60.0)
+        interval = max(float(req.get("interval_s", 0.01)), 0.001)
+        server = self.server
+
+        def run():
+            counts: Dict[str, int] = {}
+            end = time.monotonic() + duration
+            me = threading.get_ident()
+            n = 0
+            while time.monotonic() < end:
+                for ident, frame in sys._current_frames().items():
+                    if ident == me:
+                        continue
+                    # aggregate by function chain, not line numbers — a hot
+                    # loop must collapse into ONE bucket, not one per line
+                    chain = []
+                    f = frame
+                    while f is not None and len(chain) < 20:
+                        code = f.f_code
+                        chain.append(f"{code.co_filename}:{code.co_qualname}")
+                        f = f.f_back
+                    key = "\n".join(reversed(chain))
+                    counts[key] = counts.get(key, 0) + 1
+                n += 1
+                time.sleep(interval)
+            top = sorted(counts.items(), key=lambda kv: -kv[1])[:30]
+            server.send_reply(reply_token, {
+                "pid": os.getpid(), "samples": n,
+                "stacks": [{"count": c, "stack": s} for s, c in top],
+            })
+
+        threading.Thread(target=run, daemon=True, name="cpu-profiler").start()
+        return RpcServer.DELAYED_REPLY
 
     def HandlePubsubMessage(self, req):
         channel, message = req["channel"], req["message"]

@@ -136,6 +136,10 @@ class DashboardHead:
             import ray_tpu
 
             return ray_tpu.timeline()
+        if path == "/api/node_stats":
+            return state.node_stats()
+        if path == "/api/stacks":
+            return state.dump_stacks()
         return None
 
 

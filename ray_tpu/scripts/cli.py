@@ -325,6 +325,23 @@ def cmd_timeline(args) -> int:
     return 0
 
 
+def cmd_stack(args) -> int:
+    rt = _connect(args)
+    from ray_tpu.util import state
+
+    for worker in state.dump_stacks(pid=args.pid):
+        pid = worker.get("pid")
+        if "error" in worker:
+            print(f"== worker pid={pid}: <{worker['error']}>")
+            continue
+        print(f"== worker pid={pid} node={worker.get('node_id')}")
+        for t in worker.get("threads", []):
+            print(f"-- thread {t['thread']}")
+            print(t["stack"], end="")
+    rt.shutdown()
+    return 0
+
+
 def cmd_debug(args) -> int:
     rt = _connect(args)
     from ray_tpu.util import rpdb
@@ -439,6 +456,11 @@ def main(argv=None) -> int:
     sp.add_argument("kind", choices=("tasks", "actors"))
     sp.add_argument("--address", default=None)
     sp.set_defaults(fn=cmd_summary)
+
+    sp = sub.add_parser("stack", help="dump stack traces of every worker")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--pid", type=int, default=None)
+    sp.set_defaults(fn=cmd_stack)
 
     sp = sub.add_parser("debug", help="list / attach to open remote breakpoints")
     sp.add_argument("--address", default=None)

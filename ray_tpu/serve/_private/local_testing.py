@@ -93,10 +93,9 @@ def run_local(app, name: str = "default") -> LocalDeploymentHandle:
                   for k, v in spec["init_kwargs"].items()}
         if isinstance(target, type):
             instance = target(*args, **kwargs)
-        elif args or kwargs:
-            raise TypeError(f"function deployment {spec['name']!r} takes no "
-                            "init args")
         else:
+            # function deployments ignore bound init args, matching the
+            # cluster replica's behavior (replica.py) — parity over strictness
             instance = target
         if spec.get("user_config") is not None and hasattr(instance, "reconfigure"):
             instance.reconfigure(spec["user_config"])

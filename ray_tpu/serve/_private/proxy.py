@@ -25,20 +25,28 @@ _server: Optional[ThreadingHTTPServer] = None
 _thread: Optional[threading.Thread] = None
 
 
+def match_route(path: str):
+    """Longest-prefix route match, shared by every ingress (HTTP + RPC)."""
+    with _state.lock:
+        routes = dict(_state.routes)
+    for prefix, handle in sorted(routes.items(), key=lambda kv: -len(kv[0])):
+        if path == prefix or path.startswith(prefix.rstrip("/") + "/") or prefix == "/":
+            return handle
+    return None
+
+
+def list_routes():
+    with _state.lock:
+        return sorted(_state.routes)
+
+
 class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # silence
         pass
 
     def _dispatch(self, body: Optional[bytes]):
-        with _state.lock:
-            routes = dict(_state.routes)
-        # longest-prefix match (reference: proxy route matching)
         path = self.path.split("?")[0]
-        match = None
-        for prefix, handle in sorted(routes.items(), key=lambda kv: -len(kv[0])):
-            if path == prefix or path.startswith(prefix.rstrip("/") + "/") or prefix == "/":
-                match = handle
-                break
+        match = match_route(path)
         if match is None:
             self.send_response(404)
             self.end_headers()

@@ -34,18 +34,8 @@ class ServeRpcProxy:
 
     # ------------------------------------------------------------------
 
-    def _match(self, route: str):
-        with http_proxy._state.lock:
-            routes = dict(http_proxy._state.routes)
-        if route in routes:
-            return routes[route]
-        for prefix, handle in sorted(routes.items(), key=lambda kv: -len(kv[0])):
-            if route.startswith(prefix.rstrip("/") + "/") or prefix == "/":
-                return handle
-        return None
-
     def HandleServeRequest(self, payload, reply_token):
-        handle = self._match(payload["route"])
+        handle = http_proxy.match_route(payload["route"])
         if handle is None:
             raise ValueError(f"no serve route matches {payload['route']!r}")
         if payload.get("method") and payload["method"] != "__call__":
@@ -69,8 +59,7 @@ class ServeRpcProxy:
         return RpcServer.DELAYED_REPLY
 
     def HandleServeRoutes(self, payload):
-        with http_proxy._state.lock:
-            return sorted(http_proxy._state.routes)
+        return http_proxy.list_routes()
 
 
 _rpc_proxy: Optional[ServeRpcProxy] = None

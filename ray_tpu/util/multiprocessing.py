@@ -115,30 +115,35 @@ class Pool:
         self._actors = [cls.remote() for _ in range(processes)]
         self._rr = itertools.cycle(range(processes))
         self._closed = False
+        import threading
+
+        self._cb_lock = threading.Lock()
 
     # -- submission ----------------------------------------------------
 
     def _callback_queue(self):
         """One shared handler thread per pool drains every callback in
         submission order (stdlib Pool _handle_results analog)."""
-        if getattr(self, "_cb_queue", None) is None:
-            import queue
-            import threading
+        with self._cb_lock:
+            if getattr(self, "_cb_queue", None) is None:
+                import queue
+                import threading
 
-            self._cb_queue = queue.Queue()
+                q = queue.Queue()
+                self._cb_queue = q
 
-            def drain():
-                while True:
-                    item = self._cb_queue.get()
-                    if item is None:
-                        return
-                    result, callback, error_callback = item
-                    result._resolve(callback, error_callback)
+                def drain(q=q):  # bound locally: terminate() nulls the attr
+                    while True:
+                        item = q.get()
+                        if item is None:
+                            return
+                        result, callback, error_callback = item
+                        result._resolve(callback, error_callback)
 
-            self._cb_thread = threading.Thread(
-                target=drain, daemon=True, name="pool-callbacks")
-            self._cb_thread.start()
-        return self._cb_queue
+                self._cb_thread = threading.Thread(
+                    target=drain, daemon=True, name="pool-callbacks")
+                self._cb_thread.start()
+            return self._cb_queue
 
     def _check_open(self):
         if self._closed:

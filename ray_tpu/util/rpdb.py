@@ -128,6 +128,14 @@ class RemotePdb(pdb.Pdb):
 
     do_q = do_exit = do_quit
 
+    def do_EOF(self, arg):
+        """Client detached (Ctrl-D): quit AND clean up — pdb's default EOF
+        path skips do_quit, which would leak the KV entry + sockets."""
+        try:
+            return super().do_EOF(arg)
+        finally:
+            self.cleanup()
+
 
 def set_trace(frame=None, label: Optional[str] = None):
     """Open a remote breakpoint and block for a client (reference:

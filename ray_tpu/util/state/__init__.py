@@ -6,6 +6,9 @@ actors/tasks/objects/nodes/...` backed by GCS + per-node agents.
 
 from ray_tpu.util.state.api import (
     StateApiClient,
+    cpu_profile,
+    dump_stacks,
+    node_stats,
     list_actors,
     list_jobs,
     list_nodes,
@@ -19,6 +22,9 @@ from ray_tpu.util.state.api import (
 
 __all__ = [
     "StateApiClient",
+    "node_stats",
+    "dump_stacks",
+    "cpu_profile",
     "list_actors",
     "list_jobs",
     "list_nodes",

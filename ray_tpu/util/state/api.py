@@ -132,6 +132,57 @@ class StateApiClient:
         rows = self._each_raylet("ListWorkers", {})
         return _apply_filters(rows, filters)[:limit]
 
+    # -- per-node agent endpoints (reference: dashboard reporter) -------
+
+    def node_stats(self) -> List[dict]:
+        """CPU/memory/load + per-worker rss for every alive node."""
+        out = []
+        for node in self.list_nodes():
+            if node.get("state") == "DEAD":
+                continue
+            try:
+                stats = self._w.pool.get(tuple(node["address"])).call(
+                    "AgentNodeStats", {}, timeout=10)
+                stats["node_id"] = node["node_id"]
+                out.append(stats)
+            except Exception:  # noqa: BLE001
+                continue
+        return out
+
+    def dump_stacks(self, node_id=None, pid: Optional[int] = None) -> List[dict]:
+        """Stack traces from every worker (reference: `ray stack`)."""
+        out = []
+        for node in self.list_nodes():
+            if node.get("state") == "DEAD":
+                continue
+            if node_id is not None and node["node_id"] != node_id:
+                continue
+            try:
+                reply = self._w.pool.get(tuple(node["address"])).call(
+                    "AgentStacks", {"pid": pid}, timeout=30)
+            except Exception:  # noqa: BLE001
+                continue
+            for row in reply or []:
+                row["node_id"] = node["node_id"]
+                out.append(row)
+        return out
+
+    def cpu_profile(self, pid: int, node_id=None, duration_s: float = 5.0) -> dict:
+        """Sampling CPU profile of one worker (reference: reporter's
+        profiling endpoint)."""
+        for node in self.list_nodes():
+            if node.get("state") == "DEAD":
+                continue
+            if node_id is not None and node["node_id"] != node_id:
+                continue
+            try:
+                return self._w.pool.get(tuple(node["address"])).call(
+                    "AgentProfile", {"pid": pid, "duration_s": duration_s},
+                    timeout=duration_s + 30)
+            except Exception:  # noqa: BLE001
+                continue
+        raise ValueError(f"no worker with pid {pid} found on any node")
+
     # -- summaries ------------------------------------------------------
 
     def summarize_tasks(self) -> Dict[str, Dict[str, int]]:
@@ -188,3 +239,15 @@ def summarize_tasks():
 
 def summarize_actors():
     return _client().summarize_actors()
+
+
+def node_stats():
+    return _client().node_stats()
+
+
+def dump_stacks(node_id=None, pid=None):
+    return _client().dump_stacks(node_id, pid)
+
+
+def cpu_profile(pid, node_id=None, duration_s: float = 5.0):
+    return _client().cpu_profile(pid, node_id, duration_s)

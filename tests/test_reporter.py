@@ -1,0 +1,79 @@
+"""Per-node agent: stats, stacks, profiling (reference: dashboard
+modules/reporter + `ray stack`)."""
+
+import time
+
+
+def test_node_stats(ray_start_regular):
+    import ray_tpu
+    from ray_tpu.util import state
+
+    # spawn a worker so per-worker stats have a row
+    @ray_tpu.remote
+    def touch():
+        return 1
+
+    assert ray_tpu.get(touch.remote()) == 1
+    stats = state.node_stats()
+    assert len(stats) == 1
+    s = stats[0]
+    assert s["cpus"] >= 1
+    assert s["mem_total"] > 0 and s["mem_available"] > 0
+    assert isinstance(s["load_avg"], tuple) and len(s["load_avg"]) == 3
+    assert s["workers"], "no worker stats"
+    w = s["workers"][0]
+    assert w["rss"] > 0 and w["cpu_seconds"] >= 0
+
+
+def test_stack_dump_shows_running_task(ray_start_regular):
+    import ray_tpu
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    def very_recognizable_sleeper():
+        time.sleep(8)
+        return "done"
+
+    ref = very_recognizable_sleeper.remote()
+    # wait for it to be running, then grab stacks
+    found = False
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline and not found:
+        time.sleep(0.5)
+        for worker in state.dump_stacks():
+            for t in worker.get("threads", []):
+                if "very_recognizable_sleeper" in t["stack"]:
+                    found = True
+    assert found, "running task frame not in any stack dump"
+    assert ray_tpu.get(ref, timeout=60) == "done"
+
+
+def test_cpu_profile_catches_busy_function(ray_start_regular):
+    import ray_tpu
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    def burner_main_loop():
+        t_end = time.time() + 6
+        x = 0
+        while time.time() < t_end:
+            x += sum(i * i for i in range(200))
+        return x
+
+    ref = burner_main_loop.remote()
+    busy = []
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline and not busy:
+        time.sleep(0.5)
+        busy = [w for w in state.list_workers() if not w["idle"] and w["pid"]]
+    assert busy, "no busy worker appeared"
+    time.sleep(2.0)  # the lease may land before execution begins
+    hits = []
+    for w in busy:
+        prof = state.cpu_profile(w["pid"], duration_s=2.0)
+        assert prof["samples"] > 10
+        stacks = "".join(s["stack"] for s in prof["stacks"])
+        if "burner_main_loop" in stacks:
+            hits.append(w["pid"])
+    assert hits, "profiler never caught the burner's frames"
+    ray_tpu.get(ref, timeout=60)
