@@ -630,34 +630,43 @@ class CoreWorker:
         server = self.server
 
         def run():
-            counts: Dict[str, int] = {}
-            end = time.monotonic() + duration
-            me = threading.get_ident()
-            n = 0
-            while time.monotonic() < end:
-                for ident, frame in sys._current_frames().items():
-                    if ident == me:
-                        continue
-                    # aggregate by function chain, not line numbers — a hot
-                    # loop must collapse into ONE bucket, not one per line
-                    chain = []
-                    f = frame
-                    while f is not None and len(chain) < 20:
-                        code = f.f_code
-                        chain.append(f"{code.co_filename}:{code.co_qualname}")
-                        f = f.f_back
-                    key = "\n".join(reversed(chain))
-                    counts[key] = counts.get(key, 0) + 1
-                n += 1
-                time.sleep(interval)
-            top = sorted(counts.items(), key=lambda kv: -kv[1])[:30]
-            server.send_reply(reply_token, {
-                "pid": os.getpid(), "samples": n,
-                "stacks": [{"count": c, "stack": s} for s, c in top],
-            })
+            try:
+                self._cpu_profile_body(duration, interval, reply_token)
+            except Exception as e:  # noqa: BLE001 — the caller must hear back
+                try:
+                    server.send_error_reply(reply_token, e)
+                except Exception:  # noqa: BLE001
+                    pass
 
         threading.Thread(target=run, daemon=True, name="cpu-profiler").start()
         return RpcServer.DELAYED_REPLY
+
+    def _cpu_profile_body(self, duration, interval, reply_token):
+        counts: Dict[str, int] = {}
+        end = time.monotonic() + duration
+        me = threading.get_ident()
+        n = 0
+        while time.monotonic() < end:
+            for ident, frame in sys._current_frames().items():
+                if ident == me:
+                    continue
+                # aggregate by function chain, not line numbers — a hot
+                # loop must collapse into ONE bucket, not one per line
+                chain = []
+                f = frame
+                while f is not None and len(chain) < 20:
+                    code = f.f_code
+                    chain.append(f"{code.co_filename}:{code.co_qualname}")
+                    f = f.f_back
+                key = "\n".join(reversed(chain))
+                counts[key] = counts.get(key, 0) + 1
+            n += 1
+            time.sleep(interval)
+        top = sorted(counts.items(), key=lambda kv: -kv[1])[:30]
+        self.server.send_reply(reply_token, {
+            "pid": os.getpid(), "samples": n,
+            "stacks": [{"count": c, "stack": s} for s, c in top],
+        })
 
     def HandlePubsubMessage(self, req):
         channel, message = req["channel"], req["message"]

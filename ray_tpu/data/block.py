@@ -99,5 +99,7 @@ def even_split_ranges(total: int, n: int) -> List[tuple]:
 def concat_blocks(blocks: List[Block]) -> pa.Table:
     tables = [to_arrow(b) for b in blocks if to_arrow(b).num_rows > 0]
     if not tables:
-        return pa.table({})
+        # preserve the schema of all-empty inputs (joins and aggregations
+        # on an empty partition still need the columns)
+        return to_arrow(blocks[0]).slice(0, 0) if blocks else pa.table({})
     return pa.concat_tables(tables, promote_options="default")

@@ -246,6 +246,123 @@ class GroupedData:
             ds._ctx)
 
 
+def _stable_hash(v) -> int:
+    """Process-independent value hash: builtin hash() is salt-randomized
+    per worker, which would route the same key to different partitions on
+    different workers and silently drop join matches."""
+    import zlib
+
+    return zlib.crc32(repr(v).encode())
+
+
+def _hash_split_block(key: str, n: int, block) -> tuple:
+    """Split one block into n sub-blocks by key hash (runs as a task)."""
+    import pyarrow as pa
+
+    col = block.column(key).to_pylist()
+    buckets = [[] for _ in range(n)]
+    for i, v in enumerate(col):
+        buckets[_stable_hash(v) % n].append(i)
+    return tuple(block.take(pa.array(idx)) if idx else block.slice(0, 0)
+                 for idx in buckets)
+
+
+def _join_partition(on: str, right_on: str, how: str, left_refs, right_refs):
+    """Arrow (Acero) hash join of one aligned partition pair (runs as a
+    task; nested refs are fetched here, off the driver)."""
+    import ray_tpu
+    from ray_tpu.data.block import concat_blocks
+
+    left = concat_blocks(ray_tpu.get(list(left_refs)))
+    right = concat_blocks(ray_tpu.get(list(right_refs)))
+    arrow_how = {"inner": "inner", "left": "left outer",
+                 "right": "right outer", "outer": "full outer"}[how]
+    return left.join(right, keys=on, right_keys=right_on, join_type=arrow_how,
+                     right_suffix="_r")
+
+
+def _join_refs(on: str, right_on: str, how: str, num_partitions: int,
+               right_refs: List[Any], refs: List[Any]) -> List[Any]:
+    """Distributed hash join (reference: _internal/planner join.py):
+    hash-partition both sides by key with one task per block, then one
+    Acero join task per partition."""
+    import ray_tpu
+
+    split = ray_tpu.remote(_hash_split_block)
+    join = ray_tpu.remote(_join_partition)
+
+    def partition(side_refs, key):
+        parts = [[] for _ in range(num_partitions)]
+        for ref in side_refs:
+            out = split.options(num_returns=num_partitions).remote(
+                key, num_partitions, ref)
+            if num_partitions == 1:
+                out = [out]
+            for p, r in enumerate(out):
+                parts[p].append(r)
+        return parts
+
+    left_parts = partition(list(refs), on)
+    right_parts = partition(list(right_refs), right_on)
+    return [join.remote(on, right_on, how, left_parts[p], right_parts[p])
+            for p in range(num_partitions)]
+
+
+def _zip_refs(right_refs: List[Any], refs: List[Any]) -> List[Any]:
+    """Row-aligned column concatenation (reference: dataset.zip)."""
+    import ray_tpu
+    from ray_tpu.data.block import concat_blocks
+
+    left = concat_blocks(ray_tpu.get(list(refs)))
+    right = concat_blocks(ray_tpu.get(list(right_refs)))
+    if left.num_rows != right.num_rows:
+        raise ValueError(
+            f"zip() needs equal row counts, got {left.num_rows} vs "
+            f"{right.num_rows}")
+    out = left
+    for name in right.column_names:
+        col_name = f"{name}_1" if name in out.column_names else name
+        out = out.append_column(col_name, right.column(name))
+    return [ray_tpu.put(out)]
+
+
+def _random_sample_block(fraction: float, seed, block):
+    import random as _random
+
+    import pyarrow as pa
+
+    # per-block stream: the same Random(seed) for every block would select
+    # an identical index pattern in each, correlating the sample; mix in a
+    # content fingerprint so blocks draw independently yet deterministically
+    if seed is None:
+        rng = _random.Random()
+    else:
+        head = block.slice(0, min(4, block.num_rows)).to_pylist()
+        rng = _random.Random(seed * 1_000_003
+                             + block.num_rows * 97 + _stable_hash(head))
+    idx = [i for i in range(block.num_rows) if rng.random() < fraction]
+    return block.take(pa.array(idx, type=pa.int64()))
+
+
+def _skip_rows(refs: List[Any], n: int) -> List[Any]:
+    """Refs covering everything AFTER the first n rows."""
+    import ray_tpu
+    from ray_tpu.data.block import slice_block
+
+    out, to_skip = [], n
+    for ref in refs:
+        if to_skip <= 0:
+            out.append(ref)
+            continue
+        b = ray_tpu.get(ref)
+        if b.num_rows <= to_skip:
+            to_skip -= b.num_rows
+            continue
+        out.append(ray_tpu.put(slice_block(b, to_skip, b.num_rows)))
+        to_skip = 0
+    return out
+
+
 def _sort_refs(key: str, descending: bool, refs: List[Any]) -> List[Any]:
     import ray_tpu
     from ray_tpu.data.block import concat_blocks
@@ -376,6 +493,71 @@ class Dataset:
             return out
 
         return Dataset(self._plan.with_op(AllToAll(name="Limit", fn=_limit)), self._ctx)
+
+    def join(self, other: "Dataset", on: str, *, right_on: Optional[str] = None,
+             how: str = "inner", num_partitions: int = 8) -> "Dataset":
+        """Distributed hash join (reference: dataset join via
+        _internal/planner join.py; how in inner/left/right/outer)."""
+        if how not in ("inner", "left", "right", "outer"):
+            raise ValueError(f"unsupported join type {how!r}")
+        right_refs = other._materialize_refs()
+        return Dataset(self._plan.with_op(
+            AllToAll(name="Join",
+                     fn=functools.partial(_join_refs, on, right_on or on,
+                                          how, num_partitions, right_refs))),
+            self._ctx)
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Column-wise zip of two equal-length datasets (reference:
+        dataset.zip; clashing column names get a _1 suffix)."""
+        right_refs = other._materialize_refs()
+        return Dataset(self._plan.with_op(
+            AllToAll(name="Zip",
+                     fn=functools.partial(_zip_refs, right_refs))), self._ctx)
+
+    def random_sample(self, fraction: float,
+                      *, seed: Optional[int] = None) -> "Dataset":
+        """Bernoulli row sample (reference: dataset.random_sample)."""
+        if not 0.0 <= fraction <= 1.0:
+            raise ValueError("fraction must be in [0, 1]")
+        return Dataset(self._plan.with_op(
+            MapBlocks(name="RandomSample",
+                      fn=functools.partial(_random_sample_block, fraction,
+                                           seed))), self._ctx)
+
+    def unique(self, column: str) -> List[Any]:
+        """Distinct values of one column (reference: dataset.unique)."""
+        import pyarrow.compute as pc
+
+        seen = []
+        seen_set = set()
+        import ray_tpu
+
+        for ref in self._plan.execute_iter(self._ctx):
+            for v in pc.unique(ray_tpu.get(ref).column(column)).to_pylist():
+                if v not in seen_set:
+                    seen_set.add(v)
+                    seen.append(v)
+        return seen
+
+    def train_test_split(self, test_size: float, *, shuffle: bool = False,
+                         seed: Optional[int] = None):
+        """(train, test) datasets (reference: dataset.train_test_split)."""
+        if not 0.0 < test_size < 1.0:
+            raise ValueError("test_size must be in (0, 1)")
+        import ray_tpu
+
+        ds = self.random_shuffle(seed=seed) if shuffle else self
+        refs = ds._materialize_refs()  # execute the plan ONCE
+        rows = sum(ray_tpu.get(r).num_rows for r in refs)
+        n_test = int(rows * test_size)
+        train = Dataset(ExecutionPlan([InputData(name="Train", refs=refs)]),
+                        self._ctx).limit(rows - n_test)
+        # test = the tail: skip the first rows - n_test rows
+        test_refs = _skip_rows(refs, rows - n_test)
+        test = Dataset(ExecutionPlan([InputData(name="Test", refs=test_refs)]),
+                       self._ctx)
+        return train, test
 
     def union(self, *others: "Dataset") -> "Dataset":
         refs = self._materialize_refs()

@@ -1,0 +1,86 @@
+"""Data relational ops: join / zip / random_sample / unique /
+train_test_split (reference: data joins via _internal/planner, dataset.zip,
+random_sample, unique, train_test_split)."""
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_inner_and_outer_join(cluster):
+    import ray_tpu.data as rd
+
+    users = rd.from_items([{"uid": i, "name": f"u{i}"} for i in range(8)])
+    orders = rd.from_items(
+        [{"uid": i % 4, "amount": 10 * i} for i in range(10)])
+
+    inner = users.join(orders, on="uid", num_partitions=4)
+    rows = inner.take_all()
+    assert len(rows) == 10  # every order matches a user
+    assert all("name" in r and "amount" in r for r in rows)
+    for r in rows:
+        assert r["name"] == f"u{r['uid']}"
+
+    # left join keeps users without orders (uid 4..7 -> null amount)
+    left = users.join(orders, on="uid", how="left", num_partitions=4)
+    rows = left.take_all()
+    assert len(rows) == 10 + 4
+    unmatched = [r for r in rows if r["amount"] is None]
+    assert {r["uid"] for r in unmatched} == {4, 5, 6, 7}
+
+
+def test_join_right_on_different_key(cluster):
+    import ray_tpu.data as rd
+
+    a = rd.from_items([{"k": i, "x": i * i} for i in range(5)])
+    b = rd.from_items([{"j": i, "y": -i} for i in range(3, 8)])
+    joined = a.join(b, on="k", right_on="j", num_partitions=2)
+    rows = sorted(joined.take_all(), key=lambda r: r["k"])
+    assert [r["k"] for r in rows] == [3, 4]
+    assert rows[0]["y"] == -3
+
+
+def test_zip(cluster):
+    import ray_tpu.data as rd
+
+    a = rd.range(6)
+    b = rd.from_items([{"id": 100 + i} for i in range(6)])  # clashing name
+    z = a.zip(b)
+    rows = z.take_all()
+    assert len(rows) == 6
+    assert set(rows[0]) == {"id", "id_1"}
+
+    with pytest.raises(Exception, match="equal row counts"):
+        rd.range(3).zip(rd.range(5)).take_all()
+
+
+def test_random_sample_and_unique(cluster):
+    import ray_tpu.data as rd
+
+    ds = rd.range(1000)
+    sampled = ds.random_sample(0.2, seed=7)
+    n = sampled.count()
+    assert 100 < n < 320, n
+
+    dup = rd.from_items([{"v": i % 5} for i in range(50)])
+    assert sorted(dup.unique("v")) == [0, 1, 2, 3, 4]
+
+
+def test_train_test_split(cluster):
+    import ray_tpu.data as rd
+
+    ds = rd.range(100)
+    train, test = ds.train_test_split(0.25)
+    assert train.count() == 75
+    assert test.count() == 25
+    # rows are disjoint and complete
+    ids = sorted(r["id"] for r in train.take_all()) + sorted(
+        r["id"] for r in test.take_all())
+    assert sorted(ids) == list(range(100))
