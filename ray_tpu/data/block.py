@@ -97,7 +97,7 @@ def even_split_ranges(total: int, n: int) -> List[tuple]:
 
 
 def concat_blocks(blocks: List[Block]) -> pa.Table:
-    tables = [to_arrow(b) for b in blocks if to_arrow(b).num_rows > 0]
+    tables = [t for t in map(to_arrow, blocks) if t.num_rows > 0]
     if not tables:
         # preserve the schema of all-empty inputs (joins and aggregations
         # on an empty partition still need the columns)

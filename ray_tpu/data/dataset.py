@@ -292,12 +292,13 @@ def _join_refs(on: str, right_on: str, how: str, num_partitions: int,
     join = ray_tpu.remote(_join_partition)
 
     def partition(side_refs, key):
+        if num_partitions == 1:
+            return [list(side_refs)]  # no split needed (and num_returns=1
+            # would wrap the 1-tuple as a single object)
         parts = [[] for _ in range(num_partitions)]
         for ref in side_refs:
             out = split.options(num_returns=num_partitions).remote(
                 key, num_partitions, ref)
-            if num_partitions == 1:
-                out = [out]
             for p, r in enumerate(out):
                 parts[p].append(r)
         return parts
@@ -308,22 +309,53 @@ def _join_refs(on: str, right_on: str, how: str, num_partitions: int,
             for p in range(num_partitions)]
 
 
-def _zip_refs(right_refs: List[Any], refs: List[Any]) -> List[Any]:
-    """Row-aligned column concatenation (reference: dataset.zip)."""
-    import ray_tpu
-    from ray_tpu.data.block import concat_blocks
+def _block_num_rows(block) -> int:
+    return block.num_rows
 
-    left = concat_blocks(ray_tpu.get(list(refs)))
-    right = concat_blocks(ray_tpu.get(list(right_refs)))
-    if left.num_rows != right.num_rows:
-        raise ValueError(
-            f"zip() needs equal row counts, got {left.num_rows} vs "
-            f"{right.num_rows}")
-    out = left
-    for name in right.column_names:
+
+def _zip_partition(left_block, right_refs, right_counts, offset: int):
+    """Zip one left block against its aligned right row-range; fetches only
+    the overlapping right blocks (runs as a task)."""
+    import ray_tpu
+    from ray_tpu.data.block import concat_blocks, slice_block
+
+    cnt = left_block.num_rows
+    pieces, pos = [], 0
+    for ref, n in zip(right_refs, right_counts):
+        start, end = pos, pos + n
+        pos = end
+        if end <= offset or start >= offset + cnt:
+            continue
+        b = ray_tpu.get(ref)
+        pieces.append(slice_block(b, max(0, offset - start),
+                                  min(n, offset + cnt - start)))
+    right = concat_blocks(pieces) if pieces else None
+    out = left_block
+    for name in (right.column_names if right is not None else []):
         col_name = f"{name}_1" if name in out.column_names else name
         out = out.append_column(col_name, right.column(name))
-    return [ray_tpu.put(out)]
+    return out
+
+
+def _zip_refs(right_refs: List[Any], refs: List[Any]) -> List[Any]:
+    """Row-aligned column concatenation, one task per left block — neither
+    side is ever fully materialized in one process (reference: dataset.zip's
+    per-partition alignment)."""
+    import ray_tpu
+
+    nrows = ray_tpu.remote(_block_num_rows)
+    left_counts = ray_tpu.get([nrows.remote(r) for r in refs])
+    right_counts = ray_tpu.get([nrows.remote(r) for r in right_refs])
+    if sum(left_counts) != sum(right_counts):
+        raise ValueError(
+            f"zip() needs equal row counts, got {sum(left_counts)} vs "
+            f"{sum(right_counts)}")
+    zip_task = ray_tpu.remote(_zip_partition)
+    out, offset = [], 0
+    for ref, cnt in zip(refs, left_counts):
+        out.append(zip_task.remote(ref, list(right_refs), right_counts, offset))
+        offset += cnt
+    return out
 
 
 def _random_sample_block(fraction: float, seed, block):

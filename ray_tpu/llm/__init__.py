@@ -9,6 +9,7 @@ batching, jitted prefill/decode, mesh-based parallelism degrees.
 from ray_tpu.llm.batch import Processor, ProcessorConfig, build_llm_processor
 from ray_tpu.llm.config import GenerationConfig, LLMConfig
 from ray_tpu.llm.engine import JaxLLMEngine
+from ray_tpu.llm.openai_api import ByteTokenizer, OpenAICompatServer, build_openai_app
 from ray_tpu.llm.serve import LLMServer, build_llm_deployment
 
 __all__ = [
@@ -19,5 +20,8 @@ __all__ = [
     "Processor",
     "ProcessorConfig",
     "build_llm_deployment",
+    "build_openai_app",
+    "OpenAICompatServer",
+    "ByteTokenizer",
     "build_llm_processor",
 ]

@@ -1,0 +1,149 @@
+"""OpenAI-compatible serving surface.
+
+reference: python/ray/llm/_internal/serve/ — `build_openai_app` exposes
+/v1/completions and /v1/chat/completions over the serve HTTP proxy.  The
+engine speaks token ids, so the app carries a tokenizer: any object with
+``encode(str) -> List[int]`` / ``decode(List[int]) -> str`` (a transformers
+tokenizer qualifies); tests use the built-in byte-level one, which needs no
+vocab files.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Sequence
+
+from ray_tpu.llm.config import LLMConfig
+from ray_tpu.llm.serve import LLMServer
+
+
+class ByteTokenizer:
+    """Vocab-free reversible tokenizer: one token per utf-8 byte, plus bos.
+
+    Adequate for tests and smoke serving; swap in a transformers tokenizer
+    for real models (same duck type)."""
+
+    vocab_size = 257
+    bos_id = 256
+
+    def encode(self, text: str) -> List[int]:
+        return [self.bos_id] + list(text.encode("utf-8"))
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return bytes(i for i in ids if 0 <= i < 256).decode("utf-8", "replace")
+
+
+class OpenAICompatServer(LLMServer):
+    """LLMServer speaking the OpenAI request/response schemas."""
+
+    def __init__(self, llm_config: LLMConfig, params=None, tokenizer=None,
+                 model_id: str = "ray-tpu-llm"):
+        super().__init__(llm_config, params)
+        self._tok = tokenizer or ByteTokenizer()
+        self._model_id = model_id
+
+    # -- shared ---------------------------------------------------------
+
+    def _complete_text(self, text: str, req: Dict[str, Any]) -> Dict[str, Any]:
+        prompt_ids = self._tok.encode(text)
+        out_ids = self.generate(
+            prompt_ids,
+            max_new_tokens=int(req.get("max_tokens", 16)),
+            temperature=float(req.get("temperature", 0.0)),
+            top_k=int(req.get("top_k", 0)),
+            stop_token_ids=req.get("stop_token_ids", ()),
+        )
+        return {
+            "text": self._tok.decode(out_ids),
+            "prompt_tokens": len(prompt_ids),
+            "completion_tokens": len(out_ids),
+        }
+
+    def _usage(self, gens: List[Dict[str, Any]]) -> Dict[str, int]:
+        pt = sum(g["prompt_tokens"] for g in gens)
+        ct = sum(g["completion_tokens"] for g in gens)
+        return {"prompt_tokens": pt, "completion_tokens": ct,
+                "total_tokens": pt + ct}
+
+    # -- endpoints ------------------------------------------------------
+
+    def completions(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        """POST /v1/completions."""
+        prompts = request.get("prompt", "")
+        if isinstance(prompts, str):
+            prompts = [prompts]
+        choices, gens = [], []
+        for i, p in enumerate(prompts):
+            gen = self._complete_text(p, request)
+            gens.append(gen)
+            choices.append({"index": i, "text": gen["text"],
+                            "finish_reason": "length", "logprobs": None})
+        usage = self._usage(gens)
+        return {
+            "id": f"cmpl-{uuid.uuid4().hex[:24]}",
+            "object": "text_completion",
+            "created": int(time.time()),
+            "model": request.get("model", self._model_id),
+            "choices": choices,
+            "usage": usage,
+        }
+
+    def chat_completions(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        """POST /v1/chat/completions — messages rendered with a minimal
+        role-tagged template (real models bring their own via tokenizer
+        .apply_chat_template when present)."""
+        messages = request.get("messages", [])
+        if hasattr(self._tok, "apply_chat_template"):
+            text = self._tok.apply_chat_template(
+                messages, tokenize=False, add_generation_prompt=True)
+        else:
+            text = "".join(f"<{m.get('role', 'user')}>{m.get('content', '')}\n"
+                           for m in messages) + "<assistant>"
+        gen = self._complete_text(text, request)
+        return {
+            "id": f"chatcmpl-{uuid.uuid4().hex[:24]}",
+            "object": "chat.completion",
+            "created": int(time.time()),
+            "model": request.get("model", self._model_id),
+            "choices": [{
+                "index": 0,
+                "message": {"role": "assistant", "content": gen["text"]},
+                "finish_reason": "length",
+            }],
+            "usage": self._usage([gen]),
+        }
+
+    def models(self, _request=None) -> Dict[str, Any]:
+        """GET /v1/models."""
+        return {"object": "list",
+                "data": [{"id": self._model_id, "object": "model",
+                          "owned_by": "ray_tpu"}]}
+
+    def __call__(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        """The serve HTTP proxy posts the JSON body without the path, so
+        the endpoint is inferred from the payload shape: "messages" -> chat
+        completion, "prompt" -> completion, empty body -> model listing.
+        (Direct handle callers can use .completions/.chat_completions/
+        .models explicitly.)"""
+        if request and "messages" in request:
+            return self.chat_completions(request)
+        if request and "prompt" in request:
+            return self.completions(request)
+        return self.models(request)
+
+
+def build_openai_app(llm_config: LLMConfig, params=None, *, tokenizer=None,
+                     model_id: str = "ray-tpu-llm", name: str = "openai-llm"):
+    """Application + route prefix for OpenAI-style serving (reference:
+    llm/_internal/serve build_openai_app)."""
+    from ray_tpu import serve
+
+    deployment = serve.deployment(
+        OpenAICompatServer,
+        name=name,
+        num_replicas=llm_config.num_replicas,
+        max_ongoing_requests=max(8, llm_config.max_batch_size),
+        ray_actor_options={"resources": llm_config.resources_per_replica()},
+    )
+    return deployment.bind(llm_config, params, tokenizer, model_id)

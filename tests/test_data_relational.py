@@ -36,6 +36,16 @@ def test_inner_and_outer_join(cluster):
     assert {r["uid"] for r in unmatched} == {4, 5, 6, 7}
 
 
+def test_join_single_partition(cluster):
+    import ray_tpu.data as rd
+
+    a = rd.from_items([{"k": i, "x": i} for i in range(4)])
+    b = rd.from_items([{"k": i, "y": i * 10} for i in range(4)])
+    rows = sorted(a.join(b, on="k", num_partitions=1).take_all(),
+                  key=lambda r: r["k"])
+    assert [r["y"] for r in rows] == [0, 10, 20, 30]
+
+
 def test_join_right_on_different_key(cluster):
     import ray_tpu.data as rd
 
