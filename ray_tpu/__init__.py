@@ -36,7 +36,14 @@ from ray_tpu._private.task_spec import (
     TaskError,
     WorkerCrashedError,
 )
-from ray_tpu._private.worker import DRIVER, CoreWorker, ObjectRef, get_global_worker, set_global_worker
+from ray_tpu._private.worker import (
+    DRIVER,
+    CoreWorker,
+    ObjectRef,
+    ObjectRefGenerator,
+    get_global_worker,
+    set_global_worker,
+)
 from ray_tpu.actor import ActorClass, ActorHandle
 from ray_tpu.remote_function import RemoteFunction
 
@@ -253,6 +260,7 @@ __all__ = [
     "get_runtime_context",
     "timeline",
     "ObjectRef",
+    "ObjectRefGenerator",
     "ActorHandle",
     "RayTpuError",
     "TaskError",

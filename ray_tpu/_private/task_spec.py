@@ -49,6 +49,11 @@ class TaskSpec:
     actor_name: Optional[str] = None
 
     def return_ids(self) -> List[ObjectID]:
+        if self.num_returns == "streaming":
+            # index 0 is the stream's completion anchor (item count / error);
+            # yielded items take indices 1..n (reference: dynamic return ids
+            # of streaming generators)
+            return [ObjectID.from_task(self.task_id, 0)]
         return [ObjectID.from_task(self.task_id, i) for i in range(self.num_returns)]
 
 

@@ -113,6 +113,89 @@ class ObjectRef:
         return fut
 
 
+class ObjectRefGenerator:
+    """Iterator over a streaming task's yielded items (reference: the
+    ObjectRefGenerator of num_returns='streaming' tasks).  Each __next__
+    blocks until item i exists (or the stream completed/failed) and returns
+    an ObjectRef to it — so consumers overlap with the producer."""
+
+    def __init__(self, worker: "CoreWorker", spec):
+        self._w = worker
+        self._task_id = spec.task_id
+        self._name = spec.name
+        self._anchor = ObjectID.from_task(spec.task_id, 0)
+        self._i = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> "ObjectRef":
+        w = self._w
+        oid = ObjectID.from_task(self._task_id, self._i + 1)
+        missing_deadline = None
+        with w._store_lock:
+            while True:
+                if oid in w.memory_store or w.object_locations.get(oid):
+                    self._i += 1
+                    return ObjectRef(oid, w.address)
+                err = w.object_errors.get(self._anchor) or w.object_errors.get(oid)
+                if err is not None:
+                    # match ray_tpu.get semantics: raise the user's original
+                    # exception, not the TaskError wrapper
+                    if isinstance(err, TaskError):
+                        raise err.cause from None
+                    raise err
+                count = w.memory_store.get(self._anchor)
+                if count is not None:
+                    if self._i >= count:
+                        raise StopIteration
+                    # stream finished but item i hasn't landed: give the
+                    # in-flight delivery a grace window, then fail loudly
+                    # instead of hanging
+                    if missing_deadline is None:
+                        missing_deadline = time.monotonic() + 30.0
+                    elif time.monotonic() > missing_deadline:
+                        raise ObjectLostError(
+                            f"streamed item {self._i + 1} of "
+                            f"{self._name} never arrived")
+                w._store_cv.wait(timeout=1.0)
+
+    def completed(self) -> bool:
+        with self._w._store_lock:
+            return (self._anchor in self._w.memory_store
+                    or self._anchor in self._w.object_errors)
+
+    def close(self):
+        """Free the anchor and every UNCONSUMED item (also runs on GC of
+        the generator).  Consumed items were handed out as ObjectRefs and
+        stay governed by normal reference counting."""
+        w = self._w
+        if w is None or w.shutting_down:
+            return
+        self._w = None
+        with w._store_lock:
+            count = w.memory_store.pop(self._anchor, None)
+            w.object_errors.pop(self._anchor, None)
+            i = self._i + 1
+            while True:
+                oid = ObjectID.from_task(self._task_id, i)
+                found = (w.memory_store.pop(oid, None) is not None)
+                found |= bool(w.object_locations.pop(oid, None))
+                found |= (w.object_errors.pop(oid, None) is not None)
+                if not found and (count is None or i > count):
+                    break
+                i += 1
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+    def __repr__(self):
+        return f"ObjectRefGenerator({self._name}, next_index={self._i + 1})"
+
+
 def _deserialize_ref(object_id, owner_addr):
     ref = ObjectRef(object_id, owner_addr, _register=True)
     w = _global_worker
@@ -741,6 +824,8 @@ class CoreWorker:
         self._pin_args(spec)
         self._record_task_event(spec, "SUBMITTED")
         self._submit_pool.submit(self._submit_with_retries, spec)
+        if num_returns == "streaming":
+            return ObjectRefGenerator(self, spec)
         refs = [ObjectRef(oid, self.address) for oid in spec.return_ids()]
         return refs[0] if num_returns == 1 else refs
 
@@ -1015,29 +1100,65 @@ class CoreWorker:
         return self.get(ref)
 
     def _pack_returns(self, spec: TaskSpec, result):
+        if spec.num_returns == "streaming":
+            return self._stream_returns(spec, result)
         if spec.num_returns == 1:
             values = [result]
         else:
             values = list(result)
             if len(values) != spec.num_returns:
                 raise ValueError(f"task {spec.name} declared {spec.num_returns} returns, produced {len(values)}")
-        out = []
-        for oid, value in zip(spec.return_ids(), values):
-            data = serialization.dumps_inline(value)
-            if len(data) <= global_config().max_inline_object_size:
-                out.append((oid, "inline", data))
-            else:
-                meta, raws = serialization.dumps_with_buffers(value)
-                size = serialization.serialized_size(meta, raws)
-                locator = self.raylet.call(
-                    "PlasmaCreate", {"object_id": oid, "size": size, "owner_addr": spec.owner_addr}
-                )
-                from ray_tpu._private.object_store import write_via_locator
+        return [self._pack_one_return(oid, value, spec)
+                for oid, value in zip(spec.return_ids(), values)]
 
-                write_via_locator(tuple(locator), meta, raws)
-                self.raylet.call("PlasmaSeal", {"object_id": oid})
-                out.append((oid, "plasma", self.raylet.address))
-        return out
+    def _pack_one_return(self, oid: ObjectID, value, spec: TaskSpec):
+        data = serialization.dumps_inline(value)
+        if len(data) <= global_config().max_inline_object_size:
+            return (oid, "inline", data)
+        meta, raws = serialization.dumps_with_buffers(value)
+        size = serialization.serialized_size(meta, raws)
+        locator = self.raylet.call(
+            "PlasmaCreate", {"object_id": oid, "size": size, "owner_addr": spec.owner_addr}
+        )
+        from ray_tpu._private.object_store import write_via_locator
+
+        write_via_locator(tuple(locator), meta, raws)
+        self.raylet.call("PlasmaSeal", {"object_id": oid})
+        return (oid, "plasma", self.raylet.address)
+
+    def _stream_returns(self, spec: TaskSpec, result):
+        """Drive a streaming-generator task: each yielded item becomes its
+        own object, pushed to the owner AS PRODUCED; the reply carries only
+        the completion anchor (item count) at index 0 (reference: streaming
+        ObjectRefGenerator tasks)."""
+        if not hasattr(result, "__next__") and not hasattr(result, "__iter__"):
+            raise TypeError(
+                f"task {spec.name} declared num_returns='streaming' but "
+                f"returned non-iterable {type(result).__name__}")
+        count = 0
+        for item in result:
+            count += 1
+            entry = self._pack_one_return(
+                ObjectID.from_task(spec.task_id, count), item, spec)
+            # RELIABLE send: the anchor count rides the (retried) task reply,
+            # so a silently-dropped item would strand the consumer at that
+            # index forever — deliver each item with the same guarantees
+            self.pool.get(tuple(spec.owner_addr)).call(
+                "StreamingItem", {"item": entry},
+                timeout=global_config().gcs_rpc_timeout_s)
+        anchor = ObjectID.from_task(spec.task_id, 0)
+        return [self._pack_one_return(anchor, count, spec)]
+
+    def HandleStreamingItem(self, req):
+        """Owner side: store one streamed item as it arrives."""
+        oid, kind, payload = req["item"]
+        with self._store_lock:
+            if kind == "inline":
+                self.memory_store[oid] = serialization.loads_inline(payload)
+            else:
+                self.object_locations[oid].add(tuple(payload))
+            self._store_cv.notify_all()
+        return True
 
     # ------------------------------------------------------------------
     # Actors — client side (reference: core_worker.h:878,935)
@@ -1130,6 +1251,8 @@ class CoreWorker:
                 pipeline = _ActorPipeline(self, actor_id)
                 self._actor_pipelines[actor_id] = pipeline
         pipeline.submit(spec)
+        if num_returns == "streaming":
+            return ObjectRefGenerator(self, spec)
         refs = [ObjectRef(oid, self.address) for oid in spec.return_ids()]
         return refs[0] if num_returns == 1 else refs
 

@@ -310,27 +310,38 @@ def _join_refs(on: str, right_on: str, how: str, num_partitions: int,
 
 
 def _block_num_rows(block) -> int:
-    return block.num_rows
+    from ray_tpu.data.block import to_arrow
+
+    return to_arrow(block).num_rows
 
 
 def _zip_partition(left_block, right_refs, right_counts, offset: int):
     """Zip one left block against its aligned right row-range; fetches only
-    the overlapping right blocks (runs as a task)."""
+    the overlapping right blocks (runs as a task).  Blocks may be any
+    supported format (Table/DataFrame/dict/rows); output is Arrow."""
     import ray_tpu
-    from ray_tpu.data.block import concat_blocks, slice_block
+    from ray_tpu.data.block import concat_blocks, slice_block, to_arrow
 
-    cnt = left_block.num_rows
+    left = to_arrow(left_block)
+    cnt = left.num_rows
     pieces, pos = [], 0
     for ref, n in zip(right_refs, right_counts):
         start, end = pos, pos + n
         pos = end
         if end <= offset or start >= offset + cnt:
             continue
-        b = ray_tpu.get(ref)
+        b = to_arrow(ray_tpu.get(ref))
         pieces.append(slice_block(b, max(0, offset - start),
                                   min(n, offset + cnt - start)))
-    right = concat_blocks(pieces) if pieces else None
-    out = left_block
+    if pieces:
+        right = concat_blocks(pieces)
+    elif right_refs:
+        # empty left block: still emit the right columns (zero rows) so
+        # every output block shares one schema
+        right = to_arrow(ray_tpu.get(right_refs[0])).slice(0, 0)
+    else:
+        right = None
+    out = left
     for name in (right.column_names if right is not None else []):
         col_name = f"{name}_1" if name in out.column_names else name
         out = out.append_column(col_name, right.column(name))

@@ -47,17 +47,30 @@ class OpenAICompatServer(LLMServer):
 
     def _complete_text(self, text: str, req: Dict[str, Any]) -> Dict[str, Any]:
         prompt_ids = self._tok.encode(text)
+        max_tokens = int(req.get("max_tokens", 16))
         out_ids = self.generate(
             prompt_ids,
-            max_new_tokens=int(req.get("max_tokens", 16)),
+            max_new_tokens=max_tokens,
             temperature=float(req.get("temperature", 0.0)),
             top_k=int(req.get("top_k", 0)),
             stop_token_ids=req.get("stop_token_ids", ()),
         )
+        out_text = self._tok.decode(out_ids)
+        finish = "stop" if len(out_ids) < max_tokens else "length"
+        # OpenAI "stop" strings: truncate at the first occurrence
+        stops = req.get("stop") or []
+        if isinstance(stops, str):
+            stops = [stops]
+        cut = min((out_text.find(s) for s in stops
+                   if s and out_text.find(s) != -1), default=-1)
+        if cut != -1:
+            out_text = out_text[:cut]
+            finish = "stop"
         return {
-            "text": self._tok.decode(out_ids),
+            "text": out_text,
             "prompt_tokens": len(prompt_ids),
             "completion_tokens": len(out_ids),
+            "finish_reason": finish,
         }
 
     def _usage(self, gens: List[Dict[str, Any]]) -> Dict[str, int]:
@@ -78,7 +91,8 @@ class OpenAICompatServer(LLMServer):
             gen = self._complete_text(p, request)
             gens.append(gen)
             choices.append({"index": i, "text": gen["text"],
-                            "finish_reason": "length", "logprobs": None})
+                            "finish_reason": gen["finish_reason"],
+                            "logprobs": None})
         usage = self._usage(gens)
         return {
             "id": f"cmpl-{uuid.uuid4().hex[:24]}",
@@ -109,7 +123,7 @@ class OpenAICompatServer(LLMServer):
             "choices": [{
                 "index": 0,
                 "message": {"role": "assistant", "content": gen["text"]},
-                "finish_reason": "length",
+                "finish_reason": gen["finish_reason"],
             }],
             "usage": self._usage([gen]),
         }

@@ -77,6 +77,39 @@ class LLMServer:
                 self._cv.wait(timeout=0.1)
             return self._done.pop(rid)
 
+    def generate_stream(self, prompt: Sequence[int],
+                        max_new_tokens: int = 64, temperature: float = 0.0,
+                        top_k: int = 0, stop_token_ids: Sequence[int] = ()):
+        """Yield token chunks AS DECODED — pair with
+        ``.options(num_returns="streaming")`` on the actor method so callers
+        iterate an ObjectRefGenerator while decoding continues (reference:
+        vLLM streaming generate + serve streaming responses)."""
+        gen = GenerationConfig(max_new_tokens=max_new_tokens,
+                               temperature=temperature, top_k=top_k,
+                               stop_token_ids=tuple(stop_token_ids))
+        rid = self._engine.add_request(list(prompt), gen)
+        sent = 0
+        while True:
+            with self._cv:
+                while True:
+                    if self._error is not None:
+                        raise RuntimeError("LLM engine loop failed") from self._error
+                    if self._stop:
+                        raise RuntimeError("LLM server shut down")
+                    done = rid in self._done
+                    buf = self._done[rid] if done else self._waiters.get(rid, [])
+                    if len(buf) > sent or done:
+                        break
+                    self._cv.wait(timeout=0.1)
+                chunk = list(buf[sent:])
+                sent += len(chunk)
+                if done:
+                    self._done.pop(rid, None)
+            if chunk:
+                yield chunk
+            if done:
+                return
+
     def __call__(self, request: Dict[str, Any]) -> Dict[str, Any]:
         """HTTP-style entry: {"prompt": [ids], "max_new_tokens": n, ...}."""
         toks = self.generate(
