@@ -1,0 +1,151 @@
+"""Allreduce bandwidth benchmark (north-star metric #2: BASELINE.md's
+`ray.util.collective`-equivalent allreduce bandwidth over ICI).
+
+Two modes:
+
+- ``--mode mesh`` (default): jax-native — allreduce (psum) over ALL local
+  devices via shard_map on a 1-axis mesh, the path a TPU slice actually
+  uses (XLA compiles it onto ICI).  On a single chip this degenerates to a
+  copy; on a v5e-8/v5p slice it measures real ICI bandwidth.
+- ``--mode group``: drives the ray_tpu.util.collective API across actor
+  ranks (the reference library's shape), exercising the store/xla backends.
+
+Prints one JSON line per size:
+  {"metric": "allreduce_busbw", "bytes": N, "value": GB/s, ...}
+busbw uses the standard ring formula 2*(n-1)/n * size / time.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def bench_mesh(sizes_mb, dtype_name="bfloat16", iters=20):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devices = jax.devices()
+    n = len(devices)
+    mesh = Mesh(devices, ("x",))
+    dtype = jnp.dtype(dtype_name)
+
+    @jax.jit
+    def allreduce(x):
+        return jax.shard_map(
+            lambda s: jax.lax.psum(s, "x"),
+            mesh=mesh,
+            in_specs=P("x"),
+            out_specs=P(),  # replicated result
+        )(x)
+
+    results = []
+    for mb in sizes_mb:
+        count = int(mb * 2**20 / dtype.itemsize)
+        count -= count % max(n, 1)
+        x = jax.device_put(
+            jnp.ones((count,), dtype),
+            NamedSharding(mesh, P("x")))
+        allreduce(x).block_until_ready()  # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = allreduce(x)
+        out.block_until_ready()
+        dt = (time.perf_counter() - t0) / iters
+        size = count * dtype.itemsize
+        busbw = (2 * (n - 1) / max(n, 1)) * size / dt if n > 1 else size / dt
+        results.append({
+            "metric": "allreduce_busbw",
+            "mode": "mesh",
+            "devices": n,
+            "bytes": size,
+            "time_s": round(dt, 6),
+            "value": round(busbw / 1e9, 3),
+            "unit": "GB/s",
+        })
+    return results
+
+
+def bench_group(sizes_mb, world_size=2, iters=5):
+    """Collective-library mode: actor ranks allreduce numpy arrays through
+    ray_tpu.util.collective (store backend off-TPU)."""
+    import numpy as np
+
+    import ray_tpu
+
+    @ray_tpu.remote
+    class Rank:
+        def setup(self, world_size, rank):
+            from ray_tpu.util import collective
+
+            collective.init_collective_group(world_size, rank,
+                                             backend="store",
+                                             group_name="bench")
+            return rank
+
+        def run(self, nbytes, iters):
+            from ray_tpu.util import collective
+
+            x = np.ones(nbytes // 4, np.float32)
+            collective.allreduce(x, group_name="bench")  # warm
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                collective.allreduce(x, group_name="bench")
+            return (time.perf_counter() - t0) / iters
+
+    ranks = [Rank.remote() for _ in range(world_size)]
+    ray_tpu.get([r.setup.remote(world_size, i) for i, r in enumerate(ranks)])
+    results = []
+    for mb in sizes_mb:
+        nbytes = int(mb * 2**20)
+        times = ray_tpu.get([r.run.remote(nbytes, iters) for r in ranks])
+        dt = max(times)
+        busbw = (2 * (world_size - 1) / world_size) * nbytes / dt
+        results.append({
+            "metric": "allreduce_busbw",
+            "mode": "group",
+            "devices": world_size,
+            "bytes": nbytes,
+            "time_s": round(dt, 6),
+            "value": round(busbw / 1e9, 3),
+            "unit": "GB/s",
+        })
+    for r in ranks:
+        ray_tpu.kill(r)
+    return results
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--mode", choices=("mesh", "group"), default="mesh")
+    p.add_argument("--sizes-mb", default="1,8,64")
+    p.add_argument("--iters", type=int, default=20)
+    p.add_argument("--world-size", type=int, default=2)
+    args = p.parse_args(argv)
+    sizes = [float(s) for s in args.sizes_mb.split(",")]
+    if args.mode == "mesh":
+        results = bench_mesh(sizes, iters=args.iters)
+    else:
+        import ray_tpu
+
+        ray_tpu.init(num_cpus=max(4, args.world_size))
+        try:
+            results = bench_group(sizes, world_size=args.world_size,
+                                  iters=max(args.iters // 4, 1))
+        finally:
+            ray_tpu.shutdown()
+    for r in results:
+        print(json.dumps(r))
+    return results
+
+
+if __name__ == "__main__":
+    import os
+    import sys
+
+    # `python benchmarks/allreduce_bench.py` puts benchmarks/ (not the repo
+    # root) on sys.path; group mode needs the package importable
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    main()

@@ -176,6 +176,10 @@ class ObjectRefGenerator:
         with w._store_lock:
             count = w.memory_store.pop(self._anchor, None)
             w.object_errors.pop(self._anchor, None)
+            if count is None:
+                # producer still running: mark the stream closed so later
+                # items are dropped on arrival instead of stored forever
+                w._closed_streams.add(self._task_id)
             i = self._i + 1
             while True:
                 oid = ObjectID.from_task(self._task_id, i)
@@ -366,6 +370,9 @@ class CoreWorker:
         self.memory_store: Dict[ObjectID, Any] = {}
         self.object_locations: Dict[ObjectID, Set[Tuple[str, int]]] = defaultdict(set)
         self.object_errors: Dict[ObjectID, Exception] = {}
+        # streaming tasks whose consumer went away: late items are dropped
+        # instead of stored (guarded by _store_lock)
+        self._closed_streams: Set[TaskID] = set()
         self._store_lock = threading.Lock()
         self._store_cv = threading.Condition(self._store_lock)
 
@@ -965,7 +972,16 @@ class CoreWorker:
                 return
             self._fail_task(spec, err)
             return
+        abandoned_stream = False
+        if spec.num_returns == "streaming":
+            with self._store_lock:
+                # all items were delivered (reliably, in order) before this
+                # reply, so a closed stream is now fully finished
+                abandoned_stream = spec.task_id in self._closed_streams
+                self._closed_streams.discard(spec.task_id)
         for oid, kind, payload in reply["returns"]:
+            if abandoned_stream:
+                continue  # nobody will ever read the anchor
             if kind == "inline":
                 with self._store_lock:
                     self.memory_store[oid] = serialization.loads_inline(payload)
@@ -990,9 +1006,13 @@ class CoreWorker:
                                   ActorUnavailableError)):
             error = TaskError(error, "", spec.name)
         with self._store_lock:
-            for oid in spec.return_ids():
-                self.object_errors[oid] = error
-                self._store_cv.notify_all()
+            if (spec.num_returns == "streaming"
+                    and spec.task_id in self._closed_streams):
+                self._closed_streams.discard(spec.task_id)
+            else:
+                for oid in spec.return_ids():
+                    self.object_errors[oid] = error
+                    self._store_cv.notify_all()
         self.task_manager.complete(spec.task_id)
         self._unpin_args(spec)
         self._record_task_event(spec, "FAILED")
@@ -1144,15 +1164,18 @@ class CoreWorker:
             # so a silently-dropped item would strand the consumer at that
             # index forever — deliver each item with the same guarantees
             self.pool.get(tuple(spec.owner_addr)).call(
-                "StreamingItem", {"item": entry},
+                "StreamingItem", {"item": entry, "task_id": spec.task_id},
                 timeout=global_config().gcs_rpc_timeout_s)
         anchor = ObjectID.from_task(spec.task_id, 0)
         return [self._pack_one_return(anchor, count, spec)]
 
     def HandleStreamingItem(self, req):
-        """Owner side: store one streamed item as it arrives."""
+        """Owner side: store one streamed item as it arrives (dropped when
+        the consumer already abandoned the stream)."""
         oid, kind, payload = req["item"]
         with self._store_lock:
+            if req.get("task_id") in self._closed_streams:
+                return True
             if kind == "inline":
                 self.memory_store[oid] = serialization.loads_inline(payload)
             else:

@@ -330,15 +330,15 @@ def _zip_partition(left_block, right_refs, right_counts, offset: int):
         pos = end
         if end <= offset or start >= offset + cnt:
             continue
-        b = to_arrow(ray_tpu.get(ref))
-        pieces.append(slice_block(b, max(0, offset - start),
+        pieces.append(slice_block(ray_tpu.get(ref), max(0, offset - start),
                                   min(n, offset + cnt - start)))
     if pieces:
         right = concat_blocks(pieces)
     elif right_refs:
         # empty left block: still emit the right columns (zero rows) so
-        # every output block shares one schema
-        right = to_arrow(ray_tpu.get(right_refs[0])).slice(0, 0)
+        # every output block shares one schema.  (Costs one right-block
+        # fetch — rare, and schema lives only in the data itself.)
+        right = slice_block(ray_tpu.get(right_refs[0]), 0, 0)
     else:
         right = None
     out = left

@@ -73,6 +73,16 @@ class OpenAICompatServer(LLMServer):
             "finish_reason": finish,
         }
 
+    def _render_chat(self, messages: List[Dict[str, Any]]) -> str:
+        """ONE chat template for streaming and non-streaming: the
+        tokenizer's own (transformers) when it has one, else a minimal
+        role-tagged fallback."""
+        if hasattr(self._tok, "apply_chat_template"):
+            return self._tok.apply_chat_template(
+                messages, tokenize=False, add_generation_prompt=True)
+        return "".join(f"<{m.get('role', 'user')}>{m.get('content', '')}\n"
+                       for m in messages) + "<assistant>"
+
     def _usage(self, gens: List[Dict[str, Any]]) -> Dict[str, int]:
         pt = sum(g["prompt_tokens"] for g in gens)
         ct = sum(g["completion_tokens"] for g in gens)
@@ -107,14 +117,8 @@ class OpenAICompatServer(LLMServer):
         """POST /v1/chat/completions — messages rendered with a minimal
         role-tagged template (real models bring their own via tokenizer
         .apply_chat_template when present)."""
-        messages = request.get("messages", [])
-        if hasattr(self._tok, "apply_chat_template"):
-            text = self._tok.apply_chat_template(
-                messages, tokenize=False, add_generation_prompt=True)
-        else:
-            text = "".join(f"<{m.get('role', 'user')}>{m.get('content', '')}\n"
-                           for m in messages) + "<assistant>"
-        gen = self._complete_text(text, request)
+        gen = self._complete_text(self._render_chat(request.get("messages", [])),
+                                  request)
         return {
             "id": f"chatcmpl-{uuid.uuid4().hex[:24]}",
             "object": "chat.completion",
@@ -128,6 +132,66 @@ class OpenAICompatServer(LLMServer):
             "usage": self._usage([gen]),
         }
 
+    def _stream_chunks(self, request: Dict[str, Any], chat: bool):
+        """Generator of OpenAI SSE chunk objects; pair with
+        handle.options(stream=True) / a {"stream": true} HTTP body.
+        Multi-prompt completion requests stream each prompt in turn with
+        its own choice index."""
+        if chat:
+            texts = [self._render_chat(request.get("messages", []))]
+            rid = f"chatcmpl-{uuid.uuid4().hex[:24]}"
+            obj = "chat.completion.chunk"
+        else:
+            prompts = request.get("prompt", "")
+            texts = prompts if isinstance(prompts, list) else [prompts]
+            rid = f"cmpl-{uuid.uuid4().hex[:24]}"
+            obj = "text_completion"
+        created = int(time.time())
+        model = request.get("model", self._model_id)
+        head = {"id": rid, "object": obj, "created": created, "model": model}
+        stops = request.get("stop") or []
+        if isinstance(stops, str):
+            stops = [stops]
+        max_tokens = int(request.get("max_tokens", 16))
+        for index, text in enumerate(texts):
+            emitted_tokens = 0
+            all_ids: List[int] = []
+            sent_chars = 0
+            finish = None
+            for chunk in self.generate_stream(
+                    self._tok.encode(text),
+                    max_new_tokens=max_tokens,
+                    temperature=float(request.get("temperature", 0.0)),
+                    top_k=int(request.get("top_k", 0)),
+                    stop_token_ids=request.get("stop_token_ids", ())):
+                emitted_tokens += len(chunk)
+                all_ids.extend(chunk)
+                # incremental detokenization: decode the cumulative ids and
+                # emit only the stable delta — a multi-byte character split
+                # across chunks must not surface as replacement chars
+                full = self._tok.decode(all_ids)
+                stable = len(full) - (1 if full.endswith("�") else 0)
+                cut = min((full.find(s) for s in stops
+                           if s and full.find(s) != -1), default=-1)
+                if cut != -1:
+                    stable, finish = cut, "stop"
+                piece = full[sent_chars:stable]
+                sent_chars = max(sent_chars, stable)
+                if piece:
+                    choice = ({"index": index, "delta": {"content": piece},
+                               "finish_reason": None} if chat else
+                              {"index": index, "text": piece,
+                               "finish_reason": None})
+                    yield {**head, "choices": [choice]}
+                if finish == "stop":
+                    break
+            if finish is None:
+                finish = "stop" if emitted_tokens < max_tokens else "length"
+            final = ({"index": index, "delta": {}, "finish_reason": finish}
+                     if chat else
+                     {"index": index, "text": "", "finish_reason": finish})
+            yield {**head, "choices": [final]}
+
     def models(self, _request=None) -> Dict[str, Any]:
         """GET /v1/models."""
         return {"object": "list",
@@ -140,6 +204,8 @@ class OpenAICompatServer(LLMServer):
         completion, "prompt" -> completion, empty body -> model listing.
         (Direct handle callers can use .completions/.chat_completions/
         .models explicitly.)"""
+        if request and request.get("stream"):
+            return self._stream_chunks(request, chat="messages" in request)
         if request and "messages" in request:
             return self.chat_completions(request)
         if request and "prompt" in request:

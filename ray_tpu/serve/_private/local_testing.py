@@ -44,20 +44,26 @@ class LocalDeploymentResponse:
 class LocalDeploymentHandle:
     """Drives one in-process deployment instance (DeploymentHandle mirror)."""
 
-    def __init__(self, instance: Any, name: str, method_name: str = "__call__"):
+    def __init__(self, instance: Any, name: str, method_name: str = "__call__",
+                 stream: bool = False):
         self._instance = instance
         self._name = name
         self._method = method_name
+        self._stream = stream
 
-    def options(self, method_name: str) -> "LocalDeploymentHandle":
-        return LocalDeploymentHandle(self._instance, self._name, method_name)
+    def options(self, method_name: Optional[str] = None,
+                stream: Optional[bool] = None) -> "LocalDeploymentHandle":
+        return LocalDeploymentHandle(
+            self._instance, self._name,
+            method_name if method_name is not None else self._method,
+            stream if stream is not None else self._stream)
 
     def __getattr__(self, name):
         if name.startswith("_"):
             raise AttributeError(name)
         return self.options(method_name=name)
 
-    def remote(self, *args, **kwargs) -> LocalDeploymentResponse:
+    def remote(self, *args, **kwargs):
         if self._method == "__call__":
             target = self._instance
             if not callable(target):
@@ -65,6 +71,9 @@ class LocalDeploymentHandle:
                                 "is not callable")
         else:
             target = getattr(self._instance, self._method)
+        if self._stream:
+            out = target(*args, **kwargs)
+            return iter(out) if hasattr(out, "__next__") else iter([out])
         return LocalDeploymentResponse(_executor().submit(target, *args, **kwargs))
 
 

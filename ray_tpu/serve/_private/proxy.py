@@ -56,6 +56,8 @@ class _Handler(BaseHTTPRequestHandler):
             payload = json.loads(body) if body else None
         except json.JSONDecodeError:
             payload = body.decode() if body else None
+        if isinstance(payload, dict) and payload.get("stream"):
+            return self._dispatch_stream(match, payload)
         try:
             if payload is None:
                 result = match.remote().result(timeout_s=60)
@@ -70,6 +72,40 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_response(500)
             self.end_headers()
             self.wfile.write(json.dumps({"error": str(e)}).encode())
+
+    def _dispatch_stream(self, match, payload):
+        """Server-sent events: one `data:` frame per streamed item, then
+        `data: [DONE]` (the OpenAI SSE convention; reference: serve
+        streaming responses over the proxy)."""
+        try:
+            gen = match.options(stream=True).remote(payload)
+        except Exception as e:  # noqa: BLE001
+            self.send_response(500)
+            self.end_headers()
+            self.wfile.write(json.dumps({"error": str(e)}).encode())
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.end_headers()
+        try:
+            for item in gen:
+                self.wfile.write(b"data: "
+                                 + json.dumps(item, default=str).encode()
+                                 + b"\n\n")
+                self.wfile.flush()
+            self.wfile.write(b"data: [DONE]\n\n")
+        except BrokenPipeError:
+            pass  # client hung up mid-stream
+        except Exception as e:  # noqa: BLE001
+            try:
+                # error frame, then the [DONE] sentinel so protocol-following
+                # clients still see a terminated stream
+                self.wfile.write(b"data: "
+                                 + json.dumps({"error": str(e)}).encode()
+                                 + b"\n\ndata: [DONE]\n\n")
+            except OSError:
+                pass
 
     def do_GET(self):
         self._dispatch(None)

@@ -64,6 +64,28 @@ class ServeReplica:
             with self._lock:
                 self._ongoing -= 1
 
+    def handle_request_streaming(self, method_name: str, args: tuple,
+                                 kwargs: dict):
+        """Generator twin of handle_request (reference: serve streaming
+        responses): pair with num_returns='streaming' so callers iterate an
+        ObjectRefGenerator.  A non-generator result streams as one item."""
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            if method_name == "__call__":
+                target = self._callable
+            else:
+                target = getattr(self._callable, method_name)
+            out = target(*args, **kwargs)
+            if hasattr(out, "__next__"):
+                yield from out
+            else:
+                yield out
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
     def queue_len(self) -> int:
         """Probe used by the router (reference: pow_2_router.py:52)."""
         return self._ongoing

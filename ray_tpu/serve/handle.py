@@ -86,15 +86,36 @@ class _Router:
             self._version = -1
 
 
+class DeploymentResponseGenerator:
+    """Iterates a streaming deployment call's items as VALUES (reference:
+    handle.options(stream=True) -> DeploymentResponseGenerator)."""
+
+    def __init__(self, ref_gen):
+        self._gen = ref_gen
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        import ray_tpu
+
+        return ray_tpu.get(next(self._gen))
+
+
 class DeploymentHandle:
-    def __init__(self, app_name: str, deployment_name: str, method_name: str = "__call__"):
+    def __init__(self, app_name: str, deployment_name: str,
+                 method_name: str = "__call__", stream: bool = False):
         self._app = app_name
         self._dep = deployment_name
         self._method = method_name
+        self._stream = stream
         self._router = _Router(app_name, deployment_name)
 
-    def options(self, method_name: str) -> "DeploymentHandle":
-        h = DeploymentHandle(self._app, self._dep, method_name)
+    def options(self, method_name: Optional[str] = None,
+                stream: Optional[bool] = None) -> "DeploymentHandle":
+        h = DeploymentHandle(self._app, self._dep,
+                             method_name if method_name is not None else self._method,
+                             stream if stream is not None else self._stream)
         h._router = self._router
         return h
 
@@ -103,11 +124,16 @@ class DeploymentHandle:
             raise AttributeError(name)
         return self.options(method_name=name)
 
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
+    def remote(self, *args, **kwargs):
         last_err = None
         for _ in range(3):
             replica = self._router.choose_replica()
             try:
+                if self._stream:
+                    gen = replica.handle_request_streaming.options(
+                        num_returns="streaming").remote(
+                            self._method, args, kwargs)
+                    return DeploymentResponseGenerator(gen)
                 ref = replica.handle_request.remote(self._method, args, kwargs)
                 return DeploymentResponse(ref)
             except Exception as e:  # noqa: BLE001
@@ -116,4 +142,5 @@ class DeploymentHandle:
         raise last_err
 
     def __reduce__(self):
-        return (DeploymentHandle, (self._app, self._dep, self._method))
+        return (DeploymentHandle,
+                (self._app, self._dep, self._method, self._stream))

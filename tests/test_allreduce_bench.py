@@ -1,0 +1,25 @@
+"""Allreduce bandwidth benchmark harness (north-star metric #2)."""
+
+import sys
+
+
+def test_mesh_mode_virtual_devices():
+    sys.path.insert(0, "benchmarks")
+    from allreduce_bench import bench_mesh
+
+    results = bench_mesh([0.5], iters=3)
+    assert len(results) == 1
+    r = results[0]
+    assert r["devices"] == 8  # conftest pins an 8-device CPU mesh
+    assert r["value"] > 0 and r["time_s"] > 0
+    assert r["bytes"] <= 0.5 * 2**20
+
+
+def test_group_mode_over_actors(ray_start_regular):
+    sys.path.insert(0, "benchmarks")
+    from allreduce_bench import bench_group
+
+    results = bench_group([0.25], world_size=2, iters=2)
+    assert len(results) == 1
+    assert results[0]["devices"] == 2
+    assert results[0]["value"] > 0

@@ -78,6 +78,45 @@ def test_batched_prompts_usage_and_empty(openai_app):
     assert empty["usage"]["total_tokens"] == 0
 
 
+def test_sse_streaming(openai_app):
+    handle, base = openai_app
+    req = urllib.request.Request(
+        f"{base}/v1/completions",
+        data=json.dumps({"prompt": "stream me", "max_tokens": 6,
+                         "stream": True}).encode(),
+        headers={"Content-Type": "application/json"})
+    resp = urllib.request.urlopen(req, timeout=120)
+    assert resp.headers.get("Content-Type", "").startswith("text/event-stream")
+    chunks, done = [], False
+    for raw in resp:
+        line = raw.decode().strip()
+        if not line.startswith("data: "):
+            continue
+        body = line[len("data: "):]
+        if body == "[DONE]":
+            done = True
+            break
+        chunks.append(json.loads(body))
+    assert done, "no [DONE] sentinel"
+    assert len(chunks) >= 2  # at least one content chunk + the final one
+    assert chunks[0]["object"] == "text_completion"
+    assert chunks[-1]["choices"][0]["finish_reason"] in ("stop", "length")
+    assert all(c["id"] == chunks[0]["id"] for c in chunks)
+
+    # chat streaming uses delta chunks
+    req = urllib.request.Request(
+        f"{base}/v1/chat/completions",
+        data=json.dumps({"messages": [{"role": "user", "content": "hi"}],
+                         "max_tokens": 4, "stream": True}).encode(),
+        headers={"Content-Type": "application/json"})
+    resp = urllib.request.urlopen(req, timeout=120)
+    lines = [ln.decode().strip() for ln in resp if ln.strip()]
+    payloads = [json.loads(l[6:]) for l in lines
+                if l.startswith("data: ") and l != "data: [DONE]"]
+    assert payloads[0]["object"] == "chat.completion.chunk"
+    assert "delta" in payloads[0]["choices"][0]
+
+
 def test_models_and_direct_handle(openai_app):
     handle, _ = openai_app
     listing = handle.models.remote().result(timeout_s=60)

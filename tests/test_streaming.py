@@ -67,6 +67,43 @@ def test_streaming_requires_iterable(ray_start_regular):
         next(gen)
 
 
+def test_abandoned_stream_frees_storage(ray_start_regular):
+    """Dropping the generator mid-stream must not leak late items."""
+    import time as _time
+
+    import ray_tpu
+    from ray_tpu._private.ids import ObjectID
+
+    @ray_tpu.remote(num_returns="streaming")
+    def long_stream():
+        for i in range(30):
+            _time.sleep(0.05)
+            yield ("x" * 100, i)
+
+    gen = long_stream.remote()
+    first = ray_tpu.get(next(gen))
+    assert first[1] == 0
+    task_id = gen._task_id
+    gen.close()
+
+    # wait for the producer to finish, then confirm the owner kept nothing
+    w = ray_tpu.get_global_worker()
+    deadline = _time.monotonic() + 60
+    while _time.monotonic() < deadline:
+        with w._store_lock:
+            closed = task_id in w._closed_streams
+        if not closed:
+            break  # reply processed; stream fully settled
+        _time.sleep(0.2)
+    leaked = []
+    with w._store_lock:
+        for i in range(0, 31):
+            oid = ObjectID.from_task(task_id, i)
+            if i >= 2 and (oid in w.memory_store or w.object_locations.get(oid)):
+                leaked.append(i)
+    assert not leaked, f"items leaked after abandon: {leaked}"
+
+
 def test_llm_generate_stream(ray_start_regular):
     import dataclasses
 
