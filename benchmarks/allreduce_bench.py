@@ -27,6 +27,8 @@ def bench_mesh(sizes_mb, dtype_name="bfloat16", iters=20):
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+    from ray_tpu.util.collective.collective_group.xla_group import _shard_map
+
     devices = jax.devices()
     n = len(devices)
     mesh = Mesh(devices, ("x",))
@@ -34,7 +36,7 @@ def bench_mesh(sizes_mb, dtype_name="bfloat16", iters=20):
 
     @jax.jit
     def allreduce(x):
-        return jax.shard_map(
+        return _shard_map(
             lambda s: jax.lax.psum(s, "x"),
             mesh=mesh,
             in_specs=P("x"),

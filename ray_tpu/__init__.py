@@ -192,6 +192,13 @@ def kill(actor: ActorHandle, *, no_restart: bool = True):
     get_global_worker().kill_actor(actor._actor_id, no_restart=no_restart)
 
 
+def cancel(ref: ObjectRef, *, force: bool = False) -> bool:
+    """Cancel the task producing ``ref`` (reference: ray.cancel — queued
+    tasks are dropped, running ones interrupted; force kills the worker).
+    Pending results raise TaskCancelledError from get()."""
+    return get_global_worker().cancel_task(ref, force=force)
+
+
 def get_actor(name: str, namespace: str = "default") -> ActorHandle:
     info = get_global_worker().get_named_actor(name, namespace)
     return ActorHandle(info["actor_id"])
@@ -252,6 +259,7 @@ __all__ = [
     "put",
     "wait",
     "kill",
+    "cancel",
     "get_actor",
     "nodes",
     "cluster_resources",

@@ -777,6 +777,36 @@ class Raylet:
                 for oid in oids
             ]
 
+    def HandleCancelLease(self, req):
+        """Drop a still-queued task before it is granted a worker
+        (reference: ray.cancel on PENDING_SCHEDULING tasks)."""
+        task_id = req["task_id"]
+        with self._lock:
+            for p in list(self._pending_leases):
+                if p.spec.task_id == task_id:
+                    self._pending_leases.remove(p)
+                    self.server.send_reply(
+                        p.reply_token,
+                        {"rejected": True, "reason": "cancelled"})
+                    return True
+            remaining = deque()
+            cancelled = False
+            while self._grants_waiting_worker:
+                entry = self._grants_waiting_worker.popleft()
+                if not cancelled and entry[0].spec.task_id == task_id:
+                    cancelled = True
+                    self._release_lease_resources(_Lease(
+                        lease_id="", worker=None, demand=entry[1],
+                        instances=entry[2], pg_id=entry[3],
+                        bundle_index=entry[4]))
+                    self.server.send_reply(
+                        entry[0].reply_token,
+                        {"rejected": True, "reason": "cancelled"})
+                    continue
+                remaining.append(entry)
+            self._grants_waiting_worker = remaining
+            return cancelled
+
     # -- per-node agent endpoints (reference: dashboard/agent.py +
     # modules/reporter/; hosted on the raylet's RPC server) --------------
 

@@ -174,9 +174,11 @@ class ObjectRefGenerator:
             return
         self._w = None
         with w._store_lock:
+            finished = (self._anchor in w.memory_store
+                        or self._anchor in w.object_errors)
             count = w.memory_store.pop(self._anchor, None)
             w.object_errors.pop(self._anchor, None)
-            if count is None:
+            if not finished:
                 # producer still running: mark the stream closed so later
                 # items are dropped on arrival instead of stored forever
                 w._closed_streams.add(self._task_id)
@@ -373,6 +375,15 @@ class CoreWorker:
         # streaming tasks whose consumer went away: late items are dropped
         # instead of stored (guarded by _store_lock)
         self._closed_streams: Set[TaskID] = set()
+        # owner-side cancellation marks + where each in-flight task runs
+        self._cancelled_tasks: Set[TaskID] = set()
+        self._task_exec_addr: Dict[TaskID, Tuple[str, int]] = {}
+        self._task_lease_raylet: Dict[TaskID, Any] = {}
+        # executor-side: thread running the current normal task; the lock
+        # makes check-and-inject atomic against task completion so an async
+        # KeyboardInterrupt can never land in a LATER, uncancelled task
+        self._exec_thread_id: Optional[int] = None
+        self._exec_state_lock = threading.Lock()
         self._store_lock = threading.Lock()
         self._store_cv = threading.Condition(self._store_lock)
 
@@ -903,6 +914,11 @@ class CoreWorker:
                     self._submit_once(spec)
                     return
                 except (ConnectionLost, WorkerCrashedError, RemoteError) as e:
+                    if spec.task_id in self._cancelled_tasks:
+                        self._cancelled_tasks.discard(spec.task_id)
+                        self._fail_task(spec, TaskCancelledError(
+                            f"task {spec.name} was cancelled"))
+                        return
                     if spec.max_retries != -1 and spec.attempt >= max(spec.max_retries, 0):
                         self._fail_task(spec, WorkerCrashedError(f"task {spec.name} failed after {spec.attempt + 1} attempts: {e}"))
                         return
@@ -914,14 +930,21 @@ class CoreWorker:
             self._fail_task(spec, e)
 
     def _submit_once(self, spec: TaskSpec):
+        if spec.task_id in self._cancelled_tasks:
+            self._cancelled_tasks.discard(spec.task_id)
+            raise TaskCancelledError(f"task {spec.name} was cancelled")
         lease, raylet_cli = self._acquire_lease(spec)
         worker_addr = tuple(lease["worker_addr"])
+        self._task_exec_addr[spec.task_id] = worker_addr
         try:
             reply = self.pool.get(worker_addr).call(
                 "PushTask", {"spec": spec, "lease": lease}, timeout=None, retry_deadline=0
             )
         except ConnectionLost:
             raise WorkerCrashedError(f"worker {worker_addr} died while running {spec.name}")
+        finally:
+            self._task_exec_addr.pop(spec.task_id, None)
+            self._task_lease_raylet.pop(spec.task_id, None)
         self._handle_task_reply(spec, reply, worker_addr)
 
     def _acquire_lease(self, spec: TaskSpec):
@@ -932,6 +955,9 @@ class CoreWorker:
             target = self._resolve_pg_raylet(spec)
         hops = 0
         while True:
+            # remember where this task queues so cancel() can reach it
+            # (PG routing and spillback land on OTHER raylets)
+            self._task_lease_raylet[spec.task_id] = target
             reply = target.call("RequestWorkerLease", {"spec": spec, "for_actor": False}, timeout=None)
             if reply.get("rejected"):
                 raise RemoteError(f"lease rejected: {reply.get('reason')}")
@@ -963,7 +989,61 @@ class CoreWorker:
                 return self.pool.get(tuple(n["address"]))
         raise RemoteError(f"placement group node {node_id} not found")
 
+    def cancel_task(self, ref: "ObjectRef", force: bool = False) -> bool:
+        """Cancel the task that produces ``ref`` (reference: ray.cancel).
+
+        Queued tasks are removed from the raylet's queues; a RUNNING task
+        gets KeyboardInterrupt injected at its next bytecode boundary
+        (force=True kills the worker process instead).  Actor tasks are
+        cancelled owner-side only (the result errors; in-flight execution
+        may still finish server-side).  Returns False if already finished.
+        """
+        spec = self.task_manager.spec_for_object(ref.id)
+        if spec is None or not self.task_manager.is_pending(spec.task_id):
+            return False
+        self._cancelled_tasks.add(spec.task_id)
+        # in flight on a worker? interrupt it there
+        addr = self._task_exec_addr.get(spec.task_id)
+        if addr is not None:
+            try:
+                self.pool.get(tuple(addr)).notify(
+                    "CancelTask", {"task_id": spec.task_id, "force": force})
+            except Exception:  # noqa: BLE001
+                pass
+        # maybe still queued at a raylet (the one that took the lease
+        # request: PG routing / spillback may have left the local node)
+        try:
+            target = self._task_lease_raylet.get(spec.task_id, self.raylet)
+            target.notify("CancelLease", {"task_id": spec.task_id})
+        except Exception:  # noqa: BLE001
+            pass
+        return True
+
+    def HandleCancelTask(self, req):
+        """Executor side: interrupt the running task (reference: the
+        cancellation path raising KeyboardInterrupt in the worker)."""
+        task_id, force = req["task_id"], req.get("force", False)
+        with self._exec_state_lock:
+            if self.current_task_id != task_id:
+                return False  # finished (or not here): never hit a bystander
+            if force:
+                logger.warning("force-cancel: exiting worker for task %s",
+                               task_id)
+                os._exit(1)
+            if self._exec_thread_id is not None:
+                import ctypes
+
+                ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                    ctypes.c_ulong(self._exec_thread_id),
+                    ctypes.py_object(KeyboardInterrupt))
+        return True
+
     def _handle_task_reply(self, spec: TaskSpec, reply: dict, worker_addr):
+        if spec.task_id in self._cancelled_tasks:
+            self._cancelled_tasks.discard(spec.task_id)
+            self._fail_task(spec, TaskCancelledError(
+                f"task {spec.name} was cancelled"))
+            return
         if reply.get("status") == "error":
             err = TaskError(reply["error"], reply.get("traceback", ""), spec.name)
             if spec.retry_exceptions and spec.attempt < spec.max_retries:
@@ -1068,13 +1148,25 @@ class CoreWorker:
             fn = self._load_function(spec)
             args = [self._unpack_arg(a) for a in spec.args]
             kwargs = {k: self._unpack_arg((kind, p)) for k, kind, p in spec.kwargs}
-            self.current_task_id = spec.task_id
+            with self._exec_state_lock:
+                self.current_task_id = spec.task_id
+                self._exec_thread_id = threading.get_ident()
             try:
                 result = fn(*args, **kwargs)
             finally:
-                self.current_task_id = None
+                with self._exec_state_lock:
+                    self.current_task_id = None
+                    self._exec_thread_id = None
             returns = self._pack_returns(spec, result)
             self.server.send_reply(reply_token, {"status": "ok", "returns": returns})
+        except KeyboardInterrupt:
+            # injected by HandleCancelTask (reference: cancelled tasks raise
+            # TaskCancelledError at the caller)
+            self.server.send_reply(
+                reply_token,
+                {"status": "error",
+                 "error": TaskCancelledError(f"task {spec.name} was cancelled"),
+                 "traceback": ""})
         except Exception as e:  # noqa: BLE001
             from ray_tpu.util import rpdb
 

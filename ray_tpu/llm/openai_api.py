@@ -18,6 +18,18 @@ from ray_tpu.llm.config import LLMConfig
 from ray_tpu.llm.serve import LLMServer
 
 
+def _longest_stop_prefix(text: str, stops: List[str]) -> int:
+    """Length of the longest suffix of ``text`` that is a proper prefix of
+    any stop string (must be withheld until disambiguated)."""
+    best = 0
+    for s in stops:
+        for k in range(min(len(s) - 1, len(text)), 0, -1):
+            if text.endswith(s[:k]):
+                best = max(best, k)
+                break
+    return best
+
+
 class ByteTokenizer:
     """Vocab-free reversible tokenizer: one token per utf-8 byte, plus bos.
 
@@ -175,6 +187,11 @@ class OpenAICompatServer(LLMServer):
                            if s and full.find(s) != -1), default=-1)
                 if cut != -1:
                     stable, finish = cut, "stop"
+                else:
+                    # hold back any tail that could still grow into a stop
+                    # string (emitting "...E" then finding "END" next chunk
+                    # would leak text the non-streaming path truncates)
+                    stable -= _longest_stop_prefix(full[:stable], stops)
                 piece = full[sent_chars:stable]
                 sent_chars = max(sent_chars, stable)
                 if piece:

@@ -1,0 +1,84 @@
+"""Task cancellation (reference: ray.cancel — queued drop, running
+interrupt, force kill)."""
+
+import time
+
+import pytest
+
+
+def test_cancel_running_task(ray_start_regular):
+    import ray_tpu
+
+    @ray_tpu.remote
+    def busy_loop():
+        # pure-python loop: interruptible at bytecode boundaries
+        deadline = time.time() + 60
+        x = 0
+        while time.time() < deadline:
+            x += 1
+        return x
+
+    ref = busy_loop.remote()
+    time.sleep(4)  # worker spawn + execution start
+    assert ray_tpu.cancel(ref) is True
+    t0 = time.monotonic()
+    with pytest.raises(ray_tpu.TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)
+    assert time.monotonic() - t0 < 25  # did not wait the full 60s
+
+    # the worker survived non-force cancellation and serves new tasks
+    @ray_tpu.remote
+    def ping():
+        return "pong"
+
+    assert ray_tpu.get(ping.remote(), timeout=60) == "pong"
+
+
+def test_cancel_queued_task(ray_start_regular):
+    import ray_tpu
+
+    # the fixture cluster has 4 CPUs: fill them, then queue one more
+    @ray_tpu.remote
+    def hold(t):
+        time.sleep(t)
+        return "held"
+
+    holders = [hold.remote(12) for _ in range(4)]
+    time.sleep(3)
+    queued = hold.remote(1)
+    time.sleep(0.5)
+    assert ray_tpu.cancel(queued) is True
+    with pytest.raises(ray_tpu.TaskCancelledError):
+        ray_tpu.get(queued, timeout=30)
+    # holders complete normally
+    assert ray_tpu.get(holders, timeout=60) == ["held"] * 4
+
+
+def test_cancel_finished_task_is_noop(ray_start_regular):
+    import ray_tpu
+
+    @ray_tpu.remote
+    def quick():
+        return 7
+
+    ref = quick.remote()
+    assert ray_tpu.get(ref, timeout=60) == 7
+    assert ray_tpu.cancel(ref) is False  # already finished
+    assert ray_tpu.get(ref) == 7  # result unaffected
+
+
+def test_force_cancel_kills_worker(ray_start_regular):
+    import ray_tpu
+
+    @ray_tpu.remote(max_retries=0)
+    def stuck():
+        time.sleep(120)  # blocking sleep: only force can stop it promptly
+        return 1
+
+    ref = stuck.remote()
+    time.sleep(4)
+    assert ray_tpu.cancel(ref, force=True) is True
+    t0 = time.monotonic()
+    with pytest.raises((ray_tpu.TaskCancelledError, ray_tpu.WorkerCrashedError)):
+        ray_tpu.get(ref, timeout=60)
+    assert time.monotonic() - t0 < 45
