@@ -27,7 +27,7 @@ def bench_mesh(sizes_mb, dtype_name="bfloat16", iters=20):
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-    from ray_tpu.util.collective.collective_group.xla_group import _shard_map
+    from ray_tpu.util.jax_compat import shard_map as _shard_map
 
     devices = jax.devices()
     n = len(devices)

@@ -1002,6 +1002,11 @@ class CoreWorker:
         if spec is None or not self.task_manager.is_pending(spec.task_id):
             return False
         self._cancelled_tasks.add(spec.task_id)
+        # re-check: if completion raced past the mark, withdraw it — a stale
+        # mark would later poison lineage re-execution of this task_id
+        if not self.task_manager.is_pending(spec.task_id):
+            self._cancelled_tasks.discard(spec.task_id)
+            return False
         # in flight on a worker? interrupt it there
         addr = self._task_exec_addr.get(spec.task_id)
         if addr is not None:
@@ -1074,6 +1079,8 @@ class CoreWorker:
             for oid in spec.return_ids():
                 self.task_manager.reconstructing.discard(oid)
         self.task_manager.complete(spec.task_id)
+        self._cancelled_tasks.discard(spec.task_id)
+        self._task_lease_raylet.pop(spec.task_id, None)
         self._unpin_args(spec)
         self._record_task_event(spec, "FINISHED")
 
@@ -1094,6 +1101,9 @@ class CoreWorker:
                     self.object_errors[oid] = error
                     self._store_cv.notify_all()
         self.task_manager.complete(spec.task_id)
+        self._cancelled_tasks.discard(spec.task_id)
+        self._task_lease_raylet.pop(spec.task_id, None)
+        self._task_exec_addr.pop(spec.task_id, None)
         self._unpin_args(spec)
         self._record_task_event(spec, "FAILED")
 
@@ -1153,11 +1163,13 @@ class CoreWorker:
                 self._exec_thread_id = threading.get_ident()
             try:
                 result = fn(*args, **kwargs)
+                # return packing stays cancellable: a STREAMING task's user
+                # code runs inside _stream_returns' iteration, not fn()
+                returns = self._pack_returns(spec, result)
             finally:
                 with self._exec_state_lock:
                     self.current_task_id = None
                     self._exec_thread_id = None
-            returns = self._pack_returns(spec, result)
             self.server.send_reply(reply_token, {"status": "ok", "returns": returns})
         except KeyboardInterrupt:
             # injected by HandleCancelTask (reference: cancelled tasks raise

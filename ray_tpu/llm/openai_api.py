@@ -203,6 +203,17 @@ class OpenAICompatServer(LLMServer):
                 if finish == "stop":
                     break
             if finish is None:
+                # flush text held back as a potential stop-string prefix —
+                # generation ended, so it can no longer grow into one
+                full = self._tok.decode(all_ids)
+                tail = full[sent_chars:len(full)
+                            - (1 if full.endswith("�") else 0)]
+                if tail:
+                    choice = ({"index": index, "delta": {"content": tail},
+                               "finish_reason": None} if chat else
+                              {"index": index, "text": tail,
+                               "finish_reason": None})
+                    yield {**head, "choices": [choice]}
                 finish = "stop" if emitted_tokens < max_tokens else "length"
             final = ({"index": index, "delta": {}, "finish_reason": finish}
                      if chat else

@@ -28,14 +28,7 @@ _PSUM_OPS = {
 }
 
 
-def _shard_map(f, **kw):
-    import jax
-
-    if hasattr(jax, "shard_map"):
-        return jax.shard_map(f, **kw)
-    from jax.experimental.shard_map import shard_map as sm
-
-    return sm(f, **kw)
+from ray_tpu.util.jax_compat import shard_map as _shard_map  # noqa: E402
 
 
 def _free_port() -> int:

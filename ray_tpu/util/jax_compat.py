@@ -1,0 +1,15 @@
+"""jax version-compat helpers shared across the framework."""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, **kw):
+    """jax.shard_map moved out of jax.experimental across versions; one
+    resolution point for every caller (collective backends, benchmarks)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, **kw)
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(f, **kw)

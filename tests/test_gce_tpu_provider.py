@@ -1,0 +1,86 @@
+"""GCE TPU slice provider against a fake Cloud TPU API transport
+(reference pattern: tests/accelerators mock-host testing — no cloud
+needed)."""
+
+import pytest
+
+from ray_tpu.autoscaler.gce_tpu_provider import GCETpuNodeProvider
+
+
+class FakeTpuApi:
+    """Simulates the TPU v2 REST surface: create -> CREATING -> READY."""
+
+    def __init__(self, ready_after_polls=2, fail_node=None):
+        self.nodes = {}
+        self.polls = {}
+        self.ready_after = ready_after_polls
+        self.fail_node = fail_node
+        self.calls = []
+
+    def __call__(self, method, url, body=None):
+        self.calls.append((method, url))
+        if method == "POST":
+            node_id = url.split("nodeId=")[1]
+            assert body["acceleratorType"]
+            assert "startup-script" in body["metadata"]
+            self.nodes[node_id] = {"state": "CREATING", **body}
+            self.polls[node_id] = 0
+            return {"name": f"operations/{node_id}"}
+        if method == "GET" and url.endswith("/nodes"):
+            return {"nodes": [{"name": k, **v} for k, v in self.nodes.items()]}
+        if method == "GET":
+            node_id = url.rsplit("/", 1)[1]
+            self.polls[node_id] += 1
+            node = self.nodes[node_id]
+            if self.fail_node and self.fail_node in node_id:
+                node["state"] = "FAILED"
+            elif self.polls[node_id] >= self.ready_after:
+                node["state"] = "READY"
+            return dict(node)
+        if method == "DELETE":
+            node_id = url.rsplit("/", 1)[1]
+            self.nodes.pop(node_id, None)
+            return {}
+        raise AssertionError(f"unexpected {method} {url}")
+
+
+def _provider(api, **kw):
+    return GCETpuNodeProvider(
+        "proj", "us-central2-b", accelerator_type="v5p-8",
+        head_address="10.0.0.2:6380", transport=api,
+        poll_interval_s=0.01, ready_timeout_s=5, **kw)
+
+
+def test_create_wait_terminate_cycle():
+    api = FakeTpuApi()
+    provider = _provider(api)
+    gid = provider.create_node_group(
+        "v5p-workers", {"TPU": 8}, 1,
+        labels={"ray.io/tpu-slice-name": "s1"})
+    groups = provider.non_terminated_node_groups()
+    assert list(groups) == [gid]
+    node_id = groups[gid]["node_ids"][0]
+    assert api.nodes[node_id]["state"] == "READY"
+    # slice labels sanitized to GCE label rules
+    assert api.nodes[node_id]["labels"]["ray-tpu-group"] == "v5p-workers"
+    assert "ray-io-tpu-slice-name" in api.nodes[node_id]["labels"]
+    # startup script joins the head
+    assert "--address 10.0.0.2:6380" in api.nodes[node_id]["metadata"]["startup-script"]
+
+    provider.terminate_node_group(gid)
+    assert not provider.non_terminated_node_groups()
+    assert not api.nodes  # deleted at the API
+
+
+def test_failed_slice_raises():
+    api = FakeTpuApi(fail_node="doomed")
+    provider = _provider(api)
+    with pytest.raises(RuntimeError, match="FAILED"):
+        provider.create_node_group("doomed", {"TPU": 8}, 1)
+
+
+def test_list_api_nodes():
+    api = FakeTpuApi()
+    provider = _provider(api)
+    provider.create_node_group("g", {"TPU": 8}, 2)
+    assert len(provider.list_api_nodes()) == 2
