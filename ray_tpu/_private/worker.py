@@ -1244,10 +1244,20 @@ class CoreWorker:
         locator = self.raylet.call(
             "PlasmaCreate", {"object_id": oid, "size": size, "owner_addr": spec.owner_addr}
         )
-        from ray_tpu._private.object_store import write_via_locator
+        try:
+            from ray_tpu._private.object_store import write_via_locator
 
-        write_via_locator(tuple(locator), meta, raws)
-        self.raylet.call("PlasmaSeal", {"object_id": oid})
+            write_via_locator(tuple(locator), meta, raws)
+            self.raylet.call("PlasmaSeal", {"object_id": oid})
+        except BaseException:
+            # cancellation (KeyboardInterrupt) or a write failure between
+            # create and seal must not strand an unsealed allocation
+            try:
+                self.raylet.call("PlasmaFree", {"object_ids": [oid]},
+                                 timeout=10)
+            except Exception:  # noqa: BLE001
+                pass
+            raise
         return (oid, "plasma", self.raylet.address)
 
     def _stream_returns(self, spec: TaskSpec, result):

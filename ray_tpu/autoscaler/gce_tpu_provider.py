@@ -28,6 +28,13 @@ from ray_tpu.autoscaler.node_provider import NodeProvider
 TPU_API = "https://tpu.googleapis.com/v2"
 
 
+def _sanitize(name: str) -> str:
+    """GCE label values / node ids allow only [a-z0-9_-] (RFC1035-ish)."""
+    import re
+
+    return re.sub(r"[^a-z0-9_-]", "-", name.lower())[:60]
+
+
 def _metadata_token() -> str:
     """Access token from the GCE metadata server (works on any TPU VM)."""
     import urllib.request
@@ -97,31 +104,66 @@ class GCETpuNodeProvider(NodeProvider):
     def create_node_group(self, group_name: str,
                           node_resources: Dict[str, float], count: int,
                           labels: Optional[Dict[str, str]] = None) -> str:
-        """``count`` slices of ``accelerator_type`` (usually 1)."""
+        """``count`` slices of ``accelerator_type`` (usually 1).
+
+        Returns as soon as the create requests are accepted; readiness is
+        tracked on a background thread so an autoscaler reconcile tick is
+        never blocked for a multi-minute slice boot.  If any slice of the
+        group fails to come up, the WHOLE group is torn down (atomic gangs
+        — a partial slice group is useless) and its state reads "FAILED".
+        """
+        safe_group = _sanitize(group_name)
         node_ids = []
-        for _ in range(max(count, 1)):
-            node_id = f"{group_name}-{uuid.uuid4().hex[:8]}"
-            body = {
-                "acceleratorType": self._accelerator_type,
-                "runtimeVersion": self._runtime_version,
-                "metadata": {"startup-script": self._startup_script()},
-                "labels": {"ray-tpu-group": group_name,
-                           **{k.replace("/", "-").replace(".", "-").lower(): str(v)
-                              for k, v in (labels or {}).items()}},
-            }
-            if self._network:
-                body["networkConfig"] = {"network": self._network}
-            self._transport(
-                "POST", f"{TPU_API}/{self._parent()}/nodes?nodeId={node_id}",
-                body)
-            node_ids.append(node_id)
-        for node_id in node_ids:
-            self._wait_ready(node_id)
-        gid = f"{group_name}-{uuid.uuid4().hex[:6]}"
+        try:
+            for _ in range(max(count, 1)):
+                node_id = f"{safe_group}-{uuid.uuid4().hex[:8]}"
+                body = {
+                    "acceleratorType": self._accelerator_type,
+                    "runtimeVersion": self._runtime_version,
+                    "metadata": {"startup-script": self._startup_script()},
+                    "labels": {"ray-tpu-group": safe_group,
+                               **{_sanitize(k): _sanitize(str(v))
+                                  for k, v in (labels or {}).items()}},
+                }
+                if self._network:
+                    body["networkConfig"] = {"network": self._network}
+                self._transport(
+                    "POST",
+                    f"{TPU_API}/{self._parent()}/nodes?nodeId={node_id}",
+                    body)
+                node_ids.append(node_id)
+        except Exception:
+            self._delete_nodes(node_ids)  # no orphaned (billing!) slices
+            raise
+        gid = f"{safe_group}-{uuid.uuid4().hex[:6]}"
         with self._lock:
             self._groups[gid] = {"group_name": group_name, "count": count,
-                                 "node_ids": node_ids}
+                                 "node_ids": node_ids, "state": "CREATING"}
+        threading.Thread(target=self._track_readiness, args=(gid, node_ids),
+                         daemon=True, name=f"tpu-provision-{gid}").start()
         return gid
+
+    def _track_readiness(self, gid: str, node_ids: List[str]):
+        try:
+            for node_id in node_ids:
+                self._wait_ready(node_id)
+        except Exception:  # noqa: BLE001 — tear the whole gang down
+            self._delete_nodes(node_ids)
+            with self._lock:
+                if gid in self._groups:
+                    self._groups[gid]["state"] = "FAILED"
+                    self._groups[gid]["node_ids"] = []
+            return
+        with self._lock:
+            if gid in self._groups:
+                self._groups[gid]["state"] = "READY"
+
+    def _delete_nodes(self, node_ids: List[str]):
+        for node_id in node_ids:
+            try:
+                self._transport("DELETE", self._node_url(node_id))
+            except Exception:  # noqa: BLE001
+                pass
 
     def _wait_ready(self, node_id: str):
         deadline = time.monotonic() + self._ready_timeout_s
@@ -141,11 +183,7 @@ class GCETpuNodeProvider(NodeProvider):
             group = self._groups.pop(group_id, None)
         if not group:
             return
-        for node_id in group["node_ids"]:
-            try:
-                self._transport("DELETE", self._node_url(node_id))
-            except Exception:  # noqa: BLE001 — already gone is fine
-                pass
+        self._delete_nodes(group["node_ids"])
 
     def non_terminated_node_groups(self) -> Dict[str, dict]:
         with self._lock:

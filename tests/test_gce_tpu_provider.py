@@ -51,6 +51,18 @@ def _provider(api, **kw):
         poll_interval_s=0.01, ready_timeout_s=5, **kw)
 
 
+def _wait_state(provider, gid, state, timeout=10):
+    import time
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        g = provider.non_terminated_node_groups().get(gid)
+        if g and g["state"] == state:
+            return g
+        time.sleep(0.02)
+    raise AssertionError(f"group {gid} never reached {state}")
+
+
 def test_create_wait_terminate_cycle():
     api = FakeTpuApi()
     provider = _provider(api)
@@ -59,7 +71,9 @@ def test_create_wait_terminate_cycle():
         labels={"ray.io/tpu-slice-name": "s1"})
     groups = provider.non_terminated_node_groups()
     assert list(groups) == [gid]
-    node_id = groups[gid]["node_ids"][0]
+    # creation returns immediately; readiness lands on the tracker thread
+    group = _wait_state(provider, gid, "READY")
+    node_id = group["node_ids"][0]
     assert api.nodes[node_id]["state"] == "READY"
     # slice labels sanitized to GCE label rules
     assert api.nodes[node_id]["labels"]["ray-tpu-group"] == "v5p-workers"
@@ -72,15 +86,24 @@ def test_create_wait_terminate_cycle():
     assert not api.nodes  # deleted at the API
 
 
-def test_failed_slice_raises():
+def test_failed_slice_torn_down():
     api = FakeTpuApi(fail_node="doomed")
     provider = _provider(api)
-    with pytest.raises(RuntimeError, match="FAILED"):
-        provider.create_node_group("doomed", {"TPU": 8}, 1)
+    gid = provider.create_node_group("doomed", {"TPU": 8}, 1)
+    group = _wait_state(provider, gid, "FAILED")
+    assert group["node_ids"] == []
+    assert not api.nodes  # the failed slice was deleted at the API
 
 
-def test_list_api_nodes():
+def test_list_api_nodes_and_sanitization():
     api = FakeTpuApi()
     provider = _provider(api)
-    provider.create_node_group("g", {"TPU": 8}, 2)
+    gid = provider.create_node_group("V5P_Workers", {"TPU": 8}, 2,
+                                     labels={"Env": "Prod.East"})
+    _wait_state(provider, gid, "READY")
     assert len(provider.list_api_nodes()) == 2
+    node = provider.list_api_nodes()[0]
+    # group names and label keys/values are GCE-legal
+    assert node["labels"]["ray-tpu-group"] == "v5p_workers"
+    assert node["labels"]["env"] == "prod-east"
+    assert node["name"].startswith("v5p_workers-")

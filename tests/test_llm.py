@@ -104,12 +104,12 @@ def test_llm_serve_deployment(ray_start_regular, tiny_cfg):
     handle = serve.run(app, name="llm-app")
     try:
         resp = handle.remote({"prompt": [1, 2, 3], "max_new_tokens": 4}).result(
-            timeout_s=120)
+            timeout_s=240)
         assert len(resp["tokens"]) == 4
         # concurrent callers share the decode batch
         futs = [handle.remote({"prompt": [i + 1], "max_new_tokens": 3})
                 for i in range(4)]
-        outs = [f.result(timeout_s=120) for f in futs]
+        outs = [f.result(timeout_s=240) for f in futs]
         assert all(len(o["tokens"]) == 3 for o in outs)
     finally:
         serve.delete("llm-app")
