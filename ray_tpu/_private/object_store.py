@@ -443,6 +443,31 @@ class _ShmCache:
 _client_cache = _ShmCache()
 
 
+def plasma_create_write_seal(raylet_client, object_id: ObjectID, meta: bytes,
+                             raws, owner_addr) -> int:
+    """The create -> write -> seal sequence with guaranteed cleanup: any
+    failure (including an injected cancellation KeyboardInterrupt) between
+    create and seal frees the allocation instead of stranding it unsealed.
+    Single implementation for every producer path (put, task returns)."""
+    from ray_tpu._private import serialization
+
+    size = serialization.serialized_size(meta, raws)
+    locator = raylet_client.call(
+        "PlasmaCreate", {"object_id": object_id, "size": size,
+                         "owner_addr": owner_addr})
+    try:
+        write_via_locator(tuple(locator), meta, raws)
+        raylet_client.call("PlasmaSeal", {"object_id": object_id})
+    except BaseException:
+        try:
+            raylet_client.call("PlasmaFree", {"object_ids": [object_id]},
+                               timeout=10)
+        except Exception:  # noqa: BLE001
+            pass
+        raise
+    return size
+
+
 def write_via_locator(locator: Locator, meta: bytes, raws) -> None:
     """Worker-side write into a created (unsealed) object."""
     from ray_tpu._private import serialization
@@ -468,13 +493,8 @@ class PlasmaClient:
         from ray_tpu._private import serialization
 
         meta, raws = serialization.dumps_with_buffers(obj)
-        size = serialization.serialized_size(meta, raws)
-        locator = self._raylet.call(
-            "PlasmaCreate", {"object_id": object_id, "size": size, "owner_addr": owner_addr}
-        )
-        write_via_locator(tuple(locator), meta, raws)
-        self._raylet.call("PlasmaSeal", {"object_id": object_id})
-        return size
+        return plasma_create_write_seal(self._raylet, object_id, meta, raws,
+                                        owner_addr)
 
     def get(self, object_id: ObjectID, timeout: Optional[float] = None):
         """Returns (found, value)."""

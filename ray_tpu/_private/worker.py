@@ -482,11 +482,9 @@ class CoreWorker:
                 self.memory_store[oid] = value
                 self._store_cv.notify_all()
         else:
-            locator = self.raylet.call("PlasmaCreate", {"object_id": oid, "size": size, "owner_addr": self.address})
-            from ray_tpu._private.object_store import write_via_locator
+            from ray_tpu._private.object_store import plasma_create_write_seal
 
-            write_via_locator(tuple(locator), meta, raws)
-            self.raylet.call("PlasmaSeal", {"object_id": oid})
+            plasma_create_write_seal(self.raylet, oid, meta, raws, self.address)
             with self._store_lock:
                 self.object_locations[oid].add(tuple(self._raylet_addr()))
                 self._store_cv.notify_all()
@@ -1239,25 +1237,10 @@ class CoreWorker:
         data = serialization.dumps_inline(value)
         if len(data) <= global_config().max_inline_object_size:
             return (oid, "inline", data)
-        meta, raws = serialization.dumps_with_buffers(value)
-        size = serialization.serialized_size(meta, raws)
-        locator = self.raylet.call(
-            "PlasmaCreate", {"object_id": oid, "size": size, "owner_addr": spec.owner_addr}
-        )
-        try:
-            from ray_tpu._private.object_store import write_via_locator
+        from ray_tpu._private.object_store import plasma_create_write_seal
 
-            write_via_locator(tuple(locator), meta, raws)
-            self.raylet.call("PlasmaSeal", {"object_id": oid})
-        except BaseException:
-            # cancellation (KeyboardInterrupt) or a write failure between
-            # create and seal must not strand an unsealed allocation
-            try:
-                self.raylet.call("PlasmaFree", {"object_ids": [oid]},
-                                 timeout=10)
-            except Exception:  # noqa: BLE001
-                pass
-            raise
+        meta, raws = serialization.dumps_with_buffers(value)
+        plasma_create_write_seal(self.raylet, oid, meta, raws, spec.owner_addr)
         return (oid, "plasma", self.raylet.address)
 
     def _stream_returns(self, spec: TaskSpec, result):

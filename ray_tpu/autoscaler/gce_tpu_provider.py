@@ -29,10 +29,21 @@ TPU_API = "https://tpu.googleapis.com/v2"
 
 
 def _sanitize(name: str) -> str:
-    """GCE label values / node ids allow only [a-z0-9_-] (RFC1035-ish)."""
+    """GCE label keys/values allow [a-z0-9_-], max 63 chars."""
     import re
 
-    return re.sub(r"[^a-z0-9_-]", "-", name.lower())[:60]
+    return re.sub(r"[^a-z0-9_-]", "-", name.lower())[:63]
+
+
+def _sanitize_node_id(name: str) -> str:
+    """RFC1035 node ids: [a-z]([-a-z0-9]*[a-z0-9])?, max 63 chars — room is
+    left for the '-<8 hex>' suffix appended per slice."""
+    import re
+
+    s = re.sub(r"[^a-z0-9-]", "-", name.lower()).strip("-")
+    if not s or not s[0].isalpha():
+        s = f"tpu-{s}" if s else "tpu"
+    return s.rstrip("-")[:54] or "tpu"
 
 
 def _metadata_token() -> str:
@@ -113,10 +124,11 @@ class GCETpuNodeProvider(NodeProvider):
         — a partial slice group is useless) and its state reads "FAILED".
         """
         safe_group = _sanitize(group_name)
+        safe_id_prefix = _sanitize_node_id(group_name)
         node_ids = []
         try:
             for _ in range(max(count, 1)):
-                node_id = f"{safe_group}-{uuid.uuid4().hex[:8]}"
+                node_id = f"{safe_id_prefix}-{uuid.uuid4().hex[:8]}"
                 body = {
                     "acceleratorType": self._accelerator_type,
                     "runtimeVersion": self._runtime_version,
@@ -148,22 +160,33 @@ class GCETpuNodeProvider(NodeProvider):
             for node_id in node_ids:
                 self._wait_ready(node_id)
         except Exception:  # noqa: BLE001 — tear the whole gang down
-            self._delete_nodes(node_ids)
+            undeleted = self._delete_nodes(node_ids)
             with self._lock:
-                if gid in self._groups:
-                    self._groups[gid]["state"] = "FAILED"
-                    self._groups[gid]["node_ids"] = []
+                if undeleted:
+                    # a DELETE failed: keep the group (state FAILED) holding
+                    # the survivors so terminate_node_group can retry — an
+                    # untracked slice would bill forever
+                    if gid in self._groups:
+                        self._groups[gid]["state"] = "FAILED"
+                        self._groups[gid]["node_ids"] = undeleted
+                else:
+                    # fully torn down: forget the group entirely so the
+                    # autoscaler's min_groups floor launches a replacement
+                    self._groups.pop(gid, None)
             return
         with self._lock:
             if gid in self._groups:
                 self._groups[gid]["state"] = "READY"
 
-    def _delete_nodes(self, node_ids: List[str]):
+    def _delete_nodes(self, node_ids: List[str]) -> List[str]:
+        """Best-effort delete; returns the ids that could NOT be deleted."""
+        failed = []
         for node_id in node_ids:
             try:
                 self._transport("DELETE", self._node_url(node_id))
             except Exception:  # noqa: BLE001
-                pass
+                failed.append(node_id)
+        return failed
 
     def _wait_ready(self, node_id: str):
         deadline = time.monotonic() + self._ready_timeout_s
@@ -180,10 +203,17 @@ class GCETpuNodeProvider(NodeProvider):
 
     def terminate_node_group(self, group_id: str) -> None:
         with self._lock:
-            group = self._groups.pop(group_id, None)
+            group = self._groups.get(group_id)
         if not group:
             return
-        self._delete_nodes(group["node_ids"])
+        failed = self._delete_nodes(group["node_ids"])
+        with self._lock:
+            if failed:
+                # keep the survivors tracked so termination can be retried
+                group["node_ids"] = failed
+                group["state"] = "TERMINATING"
+            else:
+                self._groups.pop(group_id, None)
 
     def non_terminated_node_groups(self) -> Dict[str, dict]:
         with self._lock:

@@ -14,6 +14,7 @@ from ray_tpu.rllib.core.rl_module import RLModule
 from ray_tpu.rllib.dqn import DQN, DQNConfig, DQNLearner
 from ray_tpu.rllib.env import CartPoleEnv, EnvSpec, PendulumEnv, register_env
 from ray_tpu.rllib.env_runner import EnvRunner
+from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, IMPALALearner, vtrace
 from ray_tpu.rllib.learner import PPOLearner
 from ray_tpu.rllib.offline import BC, BCConfig, MARWIL, MARWILConfig
 from ray_tpu.rllib.replay import ReplayBuffer
@@ -29,6 +30,10 @@ __all__ = [
     "DQNConfig",
     "DQNLearner",
     "EnvRunner",
+    "IMPALA",
+    "IMPALAConfig",
+    "IMPALALearner",
+    "vtrace",
     "EnvSpec",
     "MARWIL",
     "MARWILConfig",

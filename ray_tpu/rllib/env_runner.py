@@ -111,6 +111,9 @@ class EnvRunner:
             "rewards": rew_buf, "dones": done_buf, "logp": logp_buf,
             "values": val_buf,
             "bootstrap_value": np.asarray(last_values, np.float32),
+            # piggybacked so async algorithms never queue a stats call
+            # behind a full in-flight fragment
+            "episode_stats": self.episode_stats(),
         }
         if next_obs_buf is not None:
             out["next_obs"] = next_obs_buf

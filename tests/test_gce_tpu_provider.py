@@ -86,12 +86,17 @@ def test_create_wait_terminate_cycle():
     assert not api.nodes  # deleted at the API
 
 
-def test_failed_slice_torn_down():
+def test_failed_slice_torn_down_and_forgotten():
+    import time
+
     api = FakeTpuApi(fail_node="doomed")
     provider = _provider(api)
     gid = provider.create_node_group("doomed", {"TPU": 8}, 1)
-    group = _wait_state(provider, gid, "FAILED")
-    assert group["node_ids"] == []
+    # fully-deleted failed gangs vanish so the autoscaler relaunches
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and gid in provider.non_terminated_node_groups():
+        time.sleep(0.02)
+    assert gid not in provider.non_terminated_node_groups()
     assert not api.nodes  # the failed slice was deleted at the API
 
 
@@ -103,7 +108,13 @@ def test_list_api_nodes_and_sanitization():
     _wait_state(provider, gid, "READY")
     assert len(provider.list_api_nodes()) == 2
     node = provider.list_api_nodes()[0]
-    # group names and label keys/values are GCE-legal
+    # labels keep underscores (legal); node ids are strict RFC1035
     assert node["labels"]["ray-tpu-group"] == "v5p_workers"
     assert node["labels"]["env"] == "prod-east"
-    assert node["name"].startswith("v5p_workers-")
+    assert node["name"].startswith("v5p-workers-")
+
+    from ray_tpu.autoscaler.gce_tpu_provider import _sanitize_node_id
+
+    assert _sanitize_node_id("9slices") == "tpu-9slices"  # must start a-z
+    assert _sanitize_node_id("A_B.C") == "a-b-c"
+    assert len(_sanitize_node_id("x" * 100) + "-deadbeef") <= 63

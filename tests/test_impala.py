@@ -1,0 +1,86 @@
+"""IMPALA / V-trace (reference: rllib/algorithms/impala)."""
+
+import numpy as np
+import pytest
+
+
+def test_vtrace_reduces_to_nstep_on_policy():
+    """With target == behavior policy (all rhos = 1), V-trace targets equal
+    the n-step discounted returns — the standard sanity identity."""
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib import vtrace
+
+    T, B, gamma = 5, 2, 0.9
+    rng = np.random.RandomState(0)
+    logp = jnp.asarray(rng.uniform(-2, -0.5, (T, B)).astype(np.float32))
+    rewards = jnp.asarray(rng.uniform(-1, 1, (T, B)).astype(np.float32))
+    values = jnp.asarray(rng.uniform(-1, 1, (T, B)).astype(np.float32))
+    bootstrap = jnp.asarray(rng.uniform(-1, 1, (B,)).astype(np.float32))
+    dones = jnp.zeros((T, B), bool)
+
+    vs, pg_adv = vtrace(logp, logp, rewards, values, bootstrap, dones, gamma)
+
+    # reference n-step return computed directly
+    expected = np.zeros((T, B), np.float32)
+    nxt = np.asarray(bootstrap)
+    for t in range(T - 1, -1, -1):
+        expected[t] = np.asarray(rewards)[t] + gamma * nxt
+        nxt = expected[t]
+    np.testing.assert_allclose(np.asarray(vs), expected, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(pg_adv),
+        np.asarray(rewards) + gamma * np.concatenate(
+            [np.asarray(vs)[1:], np.asarray(bootstrap)[None]]) - np.asarray(values),
+        rtol=1e-4, atol=1e-4)
+
+
+def test_vtrace_clips_off_policy_ratios():
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib import vtrace
+
+    T, B = 4, 1
+    behavior = jnp.full((T, B), -3.0)
+    target = jnp.full((T, B), 0.0)  # rho = e^3 >> clip
+    rewards = jnp.ones((T, B))
+    values = jnp.zeros((T, B))
+    bootstrap = jnp.zeros((B,))
+    dones = jnp.zeros((T, B), bool)
+    vs_clipped, _ = vtrace(behavior, target, rewards, values, bootstrap,
+                           dones, 0.9, clip_rho=1.0, clip_c=1.0)
+    # with clipping at 1 this reduces to the on-policy recursion; without
+    # clipping the huge rhos would explode the targets
+    vs_unclipped, _ = vtrace(behavior, target, rewards, values, bootstrap,
+                             dones, 0.9, clip_rho=1e9, clip_c=1e9)
+    assert float(jnp.max(jnp.abs(vs_clipped))) < 10
+    assert float(jnp.max(jnp.abs(vs_unclipped))) > 100
+
+
+def test_impala_learns_cartpole():
+    import ray_tpu
+    from ray_tpu.rllib import IMPALAConfig
+
+    ray_tpu.init(num_cpus=4)
+    try:
+        algo = (IMPALAConfig()
+                .environment("CartPole-v1")
+                .env_runners(num_env_runners=2, num_envs_per_runner=4,
+                             rollout_fragment_length=128)
+                .training(lr=1.2e-3, entropy_coef=0.005)
+                .build())
+        try:
+            result = {}
+            best_window = 0.0
+            for i in range(90):
+                result = algo.train()
+                best_window = max(best_window, result["episode_reward_mean"])
+            assert result["episodes_total"] > 100
+            assert "mean_rho" in result and result["mean_rho"] > 0
+            # random play hovers near ~20; the async learner must clearly
+            # outperform it at its best
+            assert best_window > 60, (best_window, result)
+        finally:
+            algo.stop()
+    finally:
+        ray_tpu.shutdown()
