@@ -1465,6 +1465,26 @@ class CoreWorker:
             self.server.send_reply(
                 reply_token, {"status": "error", "error": e, "traceback": traceback.format_exc()}
             )
+            from ray_tpu.actor import ActorExitException
+
+            if isinstance(e, ActorExitException):
+                # intentional exit (exit_actor): the reply above is already
+                # on the wire; now mark the actor dead-no-restart at the GCS
+                # BEFORE the process dies so the raylet's crash report can't
+                # trigger a restart.  Retry: the no-restart guarantee hinges
+                # on this landing.
+                deadline = time.monotonic() + 30
+                while True:
+                    try:
+                        self.kill_actor(self.actor_id, no_restart=True)
+                        break
+                    except Exception:  # noqa: BLE001
+                        if time.monotonic() > deadline:
+                            logger.error("exit_actor: KillActor never "
+                                         "reached the GCS; exiting anyway")
+                            break
+                        time.sleep(0.5)
+                os._exit(0)
         finally:
             self.flush_task_events()
 

@@ -13,6 +13,25 @@ from ray_tpu._private.ids import ActorID
 from ray_tpu.remote_function import _normalize_resources, _normalize_strategy
 
 
+class ActorExitException(Exception):
+    """Raised by exit_actor(); the in-flight call's reply carries it (so the
+    caller's get() raises it), then the process exits."""
+
+
+def exit_actor():
+    """Terminate the current actor from inside one of its methods
+    (reference: ray.actor.exit_actor).  The executor SENDS the in-flight
+    call's reply (carrying ActorExitException) first, then marks the actor
+    intentionally dead at the GCS and exits — no reply race, and the actor
+    is NOT restarted (intentional exits don't count against max_restarts)."""
+    from ray_tpu._private.worker import get_global_worker
+
+    w = get_global_worker()
+    if w is None or w.actor_id is None:
+        raise RuntimeError("exit_actor() called outside an actor method")
+    raise ActorExitException(0)
+
+
 class ActorMethod:
     def __init__(self, handle: "ActorHandle", method_name: str):
         self._handle = handle

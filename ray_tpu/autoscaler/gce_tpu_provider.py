@@ -179,12 +179,18 @@ class GCETpuNodeProvider(NodeProvider):
                 self._groups[gid]["state"] = "READY"
 
     def _delete_nodes(self, node_ids: List[str]) -> List[str]:
-        """Best-effort delete; returns the ids that could NOT be deleted."""
+        """Best-effort delete; returns the ids that could NOT be deleted.
+        An already-gone node (404 — e.g. preempted and reaped by GCE) counts
+        as deleted, otherwise a zombie group would block capacity forever."""
         failed = []
         for node_id in node_ids:
             try:
                 self._transport("DELETE", self._node_url(node_id))
-            except Exception:  # noqa: BLE001
+            except Exception as e:  # noqa: BLE001
+                msg = str(e).lower()
+                if getattr(e, "code", None) == 404 or "404" in msg \
+                        or "not found" in msg or "notfound" in msg:
+                    continue
                 failed.append(node_id)
         return failed
 

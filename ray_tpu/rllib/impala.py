@@ -13,6 +13,7 @@ the reference's grpc sample queues.
 from __future__ import annotations
 
 import dataclasses
+import logging
 from typing import Any, Dict
 
 import jax
@@ -22,6 +23,8 @@ import optax
 
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig, jax_to_numpy
 from ray_tpu.rllib.core.rl_module import RLModule
+
+logger = logging.getLogger(__name__)
 
 
 def vtrace(behavior_logp, target_logp, rewards, values, bootstrap_value,
@@ -149,9 +152,16 @@ class IMPALA(Algorithm):
                                 num_returns=1, timeout=120)
         stats: Dict[str, float] = {}
         batches = []
+        refill = []
         for ref in ready:
             runner = self._inflight.pop(ref)
-            batches.append((ray_tpu.get(ref), runner))
+            refill.append(runner)  # even on failure: a restarted runner
+            # must rejoin the pipeline, not silently drop out of it
+            try:
+                batches.append((ray_tpu.get(ref), runner))
+            except Exception as e:  # noqa: BLE001
+                logger.warning("IMPALA: dropping failed sample from a "
+                               "runner (%s); refilling it", e)
         for batch, runner in batches:
             stats = self._learner.update(
                 {k: v for k, v in batch.items() if k != "episode_stats"})
@@ -160,11 +170,11 @@ class IMPALA(Algorithm):
             # episode stats ride the sample itself: a separate stats call
             # would queue behind the runner's NEXT full fragment
             self._last_stats[id(runner)] = batch["episode_stats"]
-        if batches:
+        if refill:
             # refill ONLY the drained runners with the new weights: the
             # others keep sampling under their stale policies (the IMPALA
             # deal); a timed-out wait refills nothing
-            self._refill([r for _, r in batches])
+            self._refill(refill)
         ep = list(self._last_stats.values())
         rewards = [s["episode_reward_mean"] for s in ep if s["episodes_total"]]
         self._iteration += 1

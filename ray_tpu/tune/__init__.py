@@ -33,6 +33,12 @@ from ray_tpu.tune.search.sample import (
     sample_from,
     uniform,
 )
+from ray_tpu.tune.logger import (
+    Callback,
+    CSVLoggerCallback,
+    JsonLoggerCallback,
+    TBXLoggerCallback,
+)
 from ray_tpu.tune.session import get_checkpoint, report
 from ray_tpu.tune.tuner import TuneConfig, TuneController, Tuner
 
@@ -65,4 +71,8 @@ __all__ = [
     "ConcurrencyLimiter",
     "Repeater",
     "TPESearcher",
+    "Callback",
+    "CSVLoggerCallback",
+    "JsonLoggerCallback",
+    "TBXLoggerCallback",
 ]

@@ -1,0 +1,115 @@
+"""Trial loggers + callback hooks.
+
+reference: python/ray/tune/logger/ (CSV/JSON/TensorBoard trial loggers
+written into each trial dir by default) and tune/callback.py (Callback
+hooks driven by the controller's event loop).
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+
+class Callback:
+    """reference: tune/callback.py — controller-loop hooks."""
+
+    def on_trial_result(self, iteration: int, trial, result: Dict[str, Any]) -> None:  # noqa: B027
+        pass
+
+    def on_trial_complete(self, iteration: int, trial) -> None:  # noqa: B027
+        pass
+
+    def on_trial_error(self, iteration: int, trial) -> None:  # noqa: B027
+        pass
+
+
+def _trial_dir(trial) -> Optional[str]:
+    return getattr(trial, "local_dir", None)
+
+
+class JsonLoggerCallback(Callback):
+    """One JSON line per reported result -> <trial_dir>/result.json
+    (reference: tune/logger/json.py)."""
+
+    def on_trial_result(self, iteration, trial, result):
+        d = _trial_dir(trial)
+        if not d:
+            return
+        with open(os.path.join(d, "result.json"), "a") as f:
+            f.write(json.dumps({**result, "trial_id": trial.trial_id},
+                               default=str) + "\n")
+
+
+class CSVLoggerCallback(Callback):
+    """Tabular results -> <trial_dir>/progress.csv (reference:
+    tune/logger/csv.py).  Columns are fixed by the first result."""
+
+    def __init__(self):
+        self._writers: Dict[str, tuple] = {}  # trial_id -> (file, writer, fields)
+
+    def on_trial_result(self, iteration, trial, result):
+        d = _trial_dir(trial)
+        if not d:
+            return
+        entry = self._writers.get(trial.trial_id)
+        if entry is None:
+            fields = sorted(k for k, v in result.items()
+                            if isinstance(v, (int, float, str, bool)))
+            f = open(os.path.join(d, "progress.csv"), "a", newline="")
+            writer = csv.DictWriter(f, fieldnames=fields, extrasaction="ignore")
+            if f.tell() == 0:
+                writer.writeheader()
+            entry = (f, writer, fields)
+            self._writers[trial.trial_id] = entry
+        f, writer, _ = entry
+        writer.writerow({k: v for k, v in result.items()})
+        f.flush()
+
+    def on_trial_complete(self, iteration, trial):
+        entry = self._writers.pop(trial.trial_id, None)
+        if entry:
+            entry[0].close()
+
+    on_trial_error = on_trial_complete
+
+
+class TBXLoggerCallback(Callback):
+    """TensorBoard scalars (reference: tune/logger/tensorboardx.py); gated
+    on tensorboardX, which this image does not ship."""
+
+    def __init__(self):
+        try:
+            import tensorboardX  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "tensorboardX is not installed; the default CSV/JSON "
+                "loggers are always active") from e
+        self._writers: Dict[str, Any] = {}
+
+    def on_trial_result(self, iteration, trial, result):
+        from tensorboardX import SummaryWriter
+
+        w = self._writers.get(trial.trial_id)
+        if w is None:
+            w = self._writers[trial.trial_id] = SummaryWriter(_trial_dir(trial))
+        step = result.get("training_iteration", iteration)
+        for k, v in result.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                w.add_scalar(k, v, global_step=step)
+
+    def on_trial_complete(self, iteration, trial):
+        w = self._writers.pop(trial.trial_id, None)
+        if w:
+            w.close()
+
+    on_trial_error = on_trial_complete
+
+
+DEFAULT_CALLBACKS = (JsonLoggerCallback, CSVLoggerCallback)
+
+
+def default_callbacks() -> List[Callback]:
+    return [cls() for cls in DEFAULT_CALLBACKS]

@@ -46,6 +46,7 @@ class TuneConfig:
     max_concurrent_trials: Optional[int] = None
     scheduler: Optional[TrialScheduler] = None
     search_alg: Optional[Any] = None  # a tune.search.Searcher (suggest mode)
+    callbacks: Optional[List[Any]] = None  # extra tune.logger.Callback hooks
     trial_resources: Optional[Dict[str, float]] = None
     seed: Optional[int] = None
 
@@ -130,6 +131,11 @@ class TuneController:
             self.trials = [Trial(config=cfg) for cfg in gen.variants()]
         self._search_exhausted = self._search_alg is None
         self._scheduler = tune_config.scheduler or FIFOScheduler()
+        from ray_tpu.tune.logger import default_callbacks
+
+        # CSV + JSON trial loggers are always on (reference: tune's default
+        # logger callbacks); user callbacks run first
+        self._callbacks = list(tune_config.callbacks or []) + default_callbacks()
         for t in self.trials:
             self._scheduler.on_trial_add(t)
         self._actors: Dict[str, Any] = {}  # trial_id -> actor handle
@@ -157,7 +163,8 @@ class TuneController:
         # config without it (restore builds a fresh scheduler)
         # (search_alg likewise: live state keyed by trial ids; restore
         # finishes the already-suggested trials instead)
-        saved_tc = dataclasses.replace(self._tc, scheduler=None, search_alg=None)
+        saved_tc = dataclasses.replace(self._tc, scheduler=None,
+                                       search_alg=None, callbacks=None)
         tmp = os.path.join(self._exp_dir, EXPERIMENT_STATE_FILE + ".tmp")
         with open(tmp, "wb") as f:
             pickle.dump({"trials": rows, "tune_config": saved_tc}, f)
@@ -209,6 +216,7 @@ class TuneController:
         actor = cls.remote()
         trial_dir = os.path.join(self._exp_dir, trial.trial_id)
         os.makedirs(trial_dir, exist_ok=True)
+        trial.local_dir = trial_dir
         ray_tpu.get(actor._setup_session.remote(
             world_size=1, world_rank=0, run_name=trial.trial_id,
             storage_path=trial_dir,
@@ -234,6 +242,14 @@ class TuneController:
             except Exception:  # noqa: BLE001
                 pass
         trial.status = status
+        for cb in self._callbacks:
+            try:
+                if status == ERROR:
+                    cb.on_trial_error(trial.training_iteration, trial)
+                elif status == TERMINATED:
+                    cb.on_trial_complete(trial.training_iteration, trial)
+            except Exception:  # noqa: BLE001
+                logger.exception("tune callback failed")
 
     def _persist_checkpoint(self, trial: Trial, ckpt) -> Optional[str]:
         if ckpt is None:
@@ -292,6 +308,12 @@ class TuneController:
                         self._persist_checkpoint(trial, r.get("checkpoint"))
                         if self._search_alg is not None:
                             self._search_alg.on_trial_result(trial.trial_id, metrics)
+                        for cb in self._callbacks:
+                            try:
+                                cb.on_trial_result(trial.training_iteration,
+                                                   trial, metrics)
+                            except Exception:  # noqa: BLE001
+                                logger.exception("tune callback failed")
                         decision = self._scheduler.on_trial_result(trial, metrics)
                         if decision != TrialScheduler.CONTINUE:
                             break

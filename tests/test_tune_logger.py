@@ -1,0 +1,107 @@
+"""Tune trial loggers + callbacks (reference: tune/logger/, callback.py)
+and actor exit_actor."""
+
+import csv
+import json
+import os
+import time
+
+import pytest
+
+
+def test_default_loggers_and_custom_callback(ray_start_regular, tmp_path):
+    import ray_tpu
+    from ray_tpu import tune
+
+    events = []
+
+    class Recorder(tune.Callback):
+        def on_trial_result(self, iteration, trial, result):
+            events.append(("result", trial.trial_id, result["loss"]))
+
+        def on_trial_complete(self, iteration, trial):
+            events.append(("complete", trial.trial_id))
+
+    def trainable(config):
+        for step in range(3):
+            tune.report({"loss": float(config["x"] - step)})
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([1.0, 5.0])},
+        tune_config=tune.TuneConfig(metric="loss", mode="min",
+                                    callbacks=[Recorder()]),
+        run_config=ray_tpu.train.RunConfig(storage_path=str(tmp_path),
+                                           name="logged"),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 2
+
+    # custom callback saw every result + both completions
+    assert sum(1 for e in events if e[0] == "result") == 6
+    assert sum(1 for e in events if e[0] == "complete") == 2
+
+    # default CSV + JSON loggers wrote into each trial dir
+    for r in grid:
+        assert r.path
+        with open(os.path.join(r.path, "result.json")) as f:
+            lines = [json.loads(ln) for ln in f if ln.strip()]
+        assert len(lines) == 3
+        assert {ln["training_iteration"] for ln in lines} == {1, 2, 3}
+        with open(os.path.join(r.path, "progress.csv")) as f:
+            rows = list(csv.DictReader(f))
+        assert len(rows) == 3
+        assert "loss" in rows[0]
+
+    # TBX logger: gated on tensorboardX, functional when present
+    try:
+        import tensorboardX  # noqa: F401
+    except ImportError:
+        with pytest.raises(ImportError, match="tensorboardX"):
+            tune.TBXLoggerCallback()
+    else:
+        tbx = tune.TBXLoggerCallback()
+
+        class _T:
+            trial_id = "tbx-test"
+            local_dir = str(tmp_path / "tbx")
+
+        os.makedirs(_T.local_dir, exist_ok=True)
+        tbx.on_trial_result(1, _T, {"loss": 1.5, "training_iteration": 1})
+        tbx.on_trial_complete(1, _T)
+        assert any(f.startswith("events.") for f in os.listdir(_T.local_dir))
+
+
+def test_exit_actor(ray_start_regular):
+    import ray_tpu
+    from ray_tpu.actor import ActorExitException, exit_actor
+
+    @ray_tpu.remote(max_restarts=3)
+    class Quitter:
+        def ping(self):
+            return "alive"
+
+        def leave(self):
+            exit_actor()
+
+    q = Quitter.remote()
+    assert ray_tpu.get(q.ping.remote(), timeout=60) == "alive"
+    with pytest.raises(ActorExitException):
+        ray_tpu.get(q.leave.remote(), timeout=60)
+    # the reply precedes the exit by ~0.2s; wait for the death to land
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            ray_tpu.get(q.ping.remote(), timeout=5)
+            time.sleep(0.5)
+        except Exception:
+            break
+    else:
+        raise AssertionError("actor never exited")
+    # intentional exit: the actor must NOT come back despite max_restarts
+    # (a crash-restart would revive it within a few seconds)
+    end = time.monotonic() + 8
+    while time.monotonic() < end:
+        with pytest.raises(Exception):
+            ray_tpu.get(q.ping.remote(), timeout=5)
+        time.sleep(1.0)
