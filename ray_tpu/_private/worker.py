@@ -1484,6 +1484,7 @@ class CoreWorker:
                                          "reached the GCS; exiting anyway")
                             break
                         time.sleep(0.5)
+                self.flush_task_events()  # os._exit skips the finally below
                 os._exit(0)
         finally:
             self.flush_task_events()

@@ -188,8 +188,10 @@ class GCETpuNodeProvider(NodeProvider):
                 self._transport("DELETE", self._node_url(node_id))
             except Exception as e:  # noqa: BLE001
                 msg = str(e).lower()
-                if getattr(e, "code", None) == 404 or "404" in msg \
-                        or "not found" in msg or "notfound" in msg:
+                # precise already-gone detection only: a bare "404" substring
+                # would misread operation ids / byte counts in 5xx bodies
+                if getattr(e, "code", None) == 404 or "not found" in msg \
+                        or "notfound" in msg:
                     continue
                 failed.append(node_id)
         return failed

@@ -387,6 +387,154 @@ def _random_sample_block(fraction: float, seed, block):
     return block.take(pa.array(idx, type=pa.int64()))
 
 
+def _batches_over_refs(ref_iter, batch_size, batch_format, drop_last):
+    """Re-batch a stream of block refs into fixed-size batches (shared by
+    Dataset.iter_batches and streaming-split iterators)."""
+    import ray_tpu
+    from ray_tpu.data.block import block_to_batch, concat_blocks, slice_block
+
+    carry: Optional[pa.Table] = None
+    for ref in ref_iter:
+        block = ray_tpu.get(ref)
+        if carry is not None and carry.num_rows:
+            block = concat_blocks([carry, block])
+            carry = None
+        if batch_size is None:
+            if block.num_rows:
+                yield block_to_batch(block, batch_format)
+            continue
+        start = 0
+        while block.num_rows - start >= batch_size:
+            yield block_to_batch(
+                slice_block(block, start, start + batch_size), batch_format)
+            start += batch_size
+        if start < block.num_rows:
+            carry = slice_block(block, start, block.num_rows)
+    if carry is not None and carry.num_rows and not drop_last:
+        yield block_to_batch(carry, batch_format)
+
+
+class _SplitCoordinator:
+    """Actor executing the plan ONCE and handing blocks to n consumers
+    (reference: _internal/execution StreamSplitDataIterator coordinator)."""
+
+    WAIT = "__WAIT__"
+
+    def __init__(self, ds_blob: bytes, n: int, equal: bool):
+        import threading as _threading
+
+        import cloudpickle
+
+        self._ds = cloudpickle.loads(ds_blob)
+        self._n = n
+        self._equal = equal
+        self._lock = _threading.Lock()
+        self._epoch = 0
+        self._start_epoch_locked()
+
+    def _start_epoch_locked(self):
+        self._iter = self._ds._plan.execute_iter(self._ds._ctx)
+        self._buffers: List[List[Any]] = [[] for _ in range(self._n)]
+        self._counter = 0
+        self._done = False
+        self._finished: set = set()  # consumers that drained this epoch
+
+    def next_block(self, i: int, epoch: int):
+        """Next block ref for consumer ``i`` in its ``epoch``.  None =
+        epoch exhausted; WAIT = another consumer is still on the previous
+        epoch (retry shortly).  A new epoch re-executes the plan, so splits
+        are re-iterable across training epochs."""
+        with self._lock:
+            if epoch > self._epoch:
+                if len(self._finished) < self._n:
+                    return self.WAIT  # stragglers still draining
+                self._epoch = epoch
+                self._start_epoch_locked()
+            elif epoch < self._epoch:
+                return None  # stale epoch: it was fully consumed
+            while True:
+                if self._buffers[i]:
+                    return self._buffers[i].pop(0)
+                if self._done:
+                    self._finished.add(i)
+                    return None
+                try:
+                    ref = next(self._iter)
+                except StopIteration:
+                    self._done = True
+                    continue
+                if self._equal:
+                    # fixed round-robin: every consumer sees a near-equal,
+                    # disjoint block set regardless of consumption speed
+                    self._buffers[self._counter % self._n].append(ref)
+                    self._counter += 1
+                else:
+                    return ref  # first-come-first-served
+
+
+class _CoordinatorLifetime:
+    """Kills the coordinator actor when the ORIGIN process drops its last
+    split (remote copies deliberately don't carry this — see __reduce__)."""
+
+    def __init__(self, coordinator):
+        self._coordinator = coordinator
+
+    def __del__(self):
+        try:
+            import ray_tpu
+
+            ray_tpu.kill(self._coordinator)
+        except Exception:  # noqa: BLE001
+            pass
+
+
+class StreamSplit:
+    """One consumer's slice of a streaming_split (reference: DataIterator).
+    Each iter_* call is one epoch; the coordinator re-executes the plan
+    when every consumer finished the previous epoch."""
+
+    def __init__(self, coordinator, index: int, ctx, _lifetime=None):
+        self._coord = coordinator
+        self._index = index
+        self._ctx = ctx
+        self._epoch = 0
+        self._lifetime = _lifetime
+
+    def _ref_iter(self):
+        import time as _time
+
+        import ray_tpu
+        from ray_tpu.data.dataset import _SplitCoordinator
+
+        epoch = self._epoch
+        self._epoch += 1
+        while True:
+            ref = ray_tpu.get(self._coord.next_block.remote(self._index, epoch))
+            if ref is None:
+                return
+            if ref == _SplitCoordinator.WAIT:
+                _time.sleep(0.05)
+                continue
+            yield ref
+
+    def iter_batches(self, *, batch_size: Optional[int] = 256,
+                     batch_format: Optional[str] = None,
+                     drop_last: bool = False):
+        batch_format = batch_format or self._ctx.default_batch_format
+        yield from _batches_over_refs(self._ref_iter(), batch_size,
+                                      batch_format, drop_last)
+
+    def iter_rows(self):
+        import ray_tpu
+        from ray_tpu.data.block import iter_block_rows
+
+        for ref in self._ref_iter():
+            yield from iter_block_rows(ray_tpu.get(ref))
+
+    def __reduce__(self):
+        return (StreamSplit, (self._coord, self._index, self._ctx))
+
+
 def _skip_rows(refs: List[Any], n: int) -> List[Any]:
     """Refs covering everything AFTER the first n rows."""
     import ray_tpu
@@ -618,6 +766,22 @@ class Dataset:
             for s, e in even_split_ranges(len(refs), n)
         ]
 
+    def streaming_split(self, n: int, *, equal: bool = True) -> List[StreamSplit]:
+        """n coordinated iterators over ONE execution of this dataset
+        (reference: dataset.streaming_split for per-worker Train ingest).
+        equal=True assigns blocks round-robin (near-equal, disjoint);
+        equal=False hands blocks out first-come-first-served."""
+        import cloudpickle
+
+        import ray_tpu
+
+        coordinator = ray_tpu.remote(_SplitCoordinator).options(
+            num_cpus=0.1, max_concurrency=max(n + 1, 2)).remote(
+            cloudpickle.dumps(self), n, equal)
+        lifetime = _CoordinatorLifetime(coordinator)
+        return [StreamSplit(coordinator, i, self._ctx, _lifetime=lifetime)
+                for i in range(n)]
+
     # -- execution ----------------------------------------------------------
     def _materialize_refs(self) -> List[Any]:
         return list(self._plan.execute_iter(self._ctx))
@@ -636,29 +800,10 @@ class Dataset:
     ) -> Iterator[Any]:
         """Stream batches as blocks complete (reference: iterator over
         execute_to_iterator, plan.py:413)."""
-        import ray_tpu
-        from ray_tpu.data.block import block_to_batch, concat_blocks, slice_block
-
         batch_format = batch_format or self._ctx.default_batch_format
-        carry: Optional[pa.Table] = None
-        for ref in self._plan.execute_iter(self._ctx):
-            block = ray_tpu.get(ref)
-            if carry is not None and carry.num_rows:
-                block = concat_blocks([carry, block])
-                carry = None
-            if batch_size is None:
-                if block.num_rows:
-                    yield block_to_batch(block, batch_format)
-                continue
-            start = 0
-            while block.num_rows - start >= batch_size:
-                yield block_to_batch(
-                    slice_block(block, start, start + batch_size), batch_format)
-                start += batch_size
-            if start < block.num_rows:
-                carry = slice_block(block, start, block.num_rows)
-        if carry is not None and carry.num_rows and not drop_last:
-            yield block_to_batch(carry, batch_format)
+        yield from _batches_over_refs(
+            self._plan.execute_iter(self._ctx), batch_size, batch_format,
+            drop_last)
 
     def iter_jax_batches(
         self,

@@ -128,7 +128,8 @@ class IMPALA(Algorithm):
         super().__init__(config)
         self._inflight: Dict[Any, Any] = {}  # ref -> runner
         self._env_steps = 0
-        self._last_stats: Dict[int, dict] = {}  # runner index -> episode stats
+        self._last_stats: Dict[int, dict] = {}  # runner id -> episode stats
+        self._fail_counts: Dict[int, int] = {}  # runner id -> consecutive fails
 
     def _build_learner(self):
         cfg: IMPALAConfig = self.config  # type: ignore[assignment]
@@ -155,13 +156,28 @@ class IMPALA(Algorithm):
         refill = []
         for ref in ready:
             runner = self._inflight.pop(ref)
-            refill.append(runner)  # even on failure: a restarted runner
-            # must rejoin the pipeline, not silently drop out of it
             try:
-                batches.append((ray_tpu.get(ref), runner))
+                batch = ray_tpu.get(ref)
             except Exception as e:  # noqa: BLE001
-                logger.warning("IMPALA: dropping failed sample from a "
-                               "runner (%s); refilling it", e)
+                # a restarted runner rejoins the pipeline; one that keeps
+                # failing is dropped for good instead of warn-spinning
+                n = self._fail_counts.get(id(runner), 0) + 1
+                self._fail_counts[id(runner)] = n
+                if n >= 3:
+                    self._runners = [r for r in self._runners if r is not runner]
+                    logger.error("IMPALA: runner dropped after %d consecutive "
+                                 "failed samples (%s)", n, e)
+                    if not self._runners:
+                        raise RuntimeError(
+                            "IMPALA: every EnvRunner is dead") from e
+                else:
+                    logger.warning("IMPALA: failed sample (%s); refilling "
+                                   "the runner (strike %d/3)", e, n)
+                    refill.append(runner)
+                continue
+            self._fail_counts.pop(id(runner), None)
+            refill.append(runner)
+            batches.append((batch, runner))
         for batch, runner in batches:
             stats = self._learner.update(
                 {k: v for k, v in batch.items() if k != "episode_stats"})
