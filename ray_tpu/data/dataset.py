@@ -420,8 +420,10 @@ class _SplitCoordinator:
 
     WAIT = "__WAIT__"
 
-    def __init__(self, ds_blob: bytes, n: int, equal: bool):
+    def __init__(self, ds_blob: bytes, n: int, equal: bool,
+                 idle_timeout_s: float = 600.0):
         import threading as _threading
+        import time as _time
 
         import cloudpickle
 
@@ -431,6 +433,21 @@ class _SplitCoordinator:
         self._lock = _threading.Lock()
         self._epoch = 0
         self._start_epoch_locked()
+        # self-reaping: with consumers scattered across processes no single
+        # one can own the coordinator's lifetime; it exits after idling
+        self._last_access = _time.monotonic()
+        self._idle_timeout_s = idle_timeout_s
+        _threading.Thread(target=self._idle_reaper, daemon=True,
+                          name="split-coordinator-reaper").start()
+
+    def _idle_reaper(self):
+        import os as _os
+        import time as _time
+
+        while True:
+            _time.sleep(min(self._idle_timeout_s / 4, 30.0))
+            if _time.monotonic() - self._last_access > self._idle_timeout_s:
+                _os._exit(0)
 
     def _start_epoch_locked(self):
         self._iter = self._ds._plan.execute_iter(self._ds._ctx)
@@ -444,6 +461,9 @@ class _SplitCoordinator:
         epoch exhausted; WAIT = another consumer is still on the previous
         epoch (retry shortly).  A new epoch re-executes the plan, so splits
         are re-iterable across training epochs."""
+        import time as _time
+
+        self._last_access = _time.monotonic()
         with self._lock:
             if epoch > self._epoch:
                 if len(self._finished) < self._n:
@@ -471,21 +491,14 @@ class _SplitCoordinator:
                 else:
                     return ref  # first-come-first-served
 
-
-class _CoordinatorLifetime:
-    """Kills the coordinator actor when the ORIGIN process drops its last
-    split (remote copies deliberately don't carry this — see __reduce__)."""
-
-    def __init__(self, coordinator):
-        self._coordinator = coordinator
-
-    def __del__(self):
-        try:
-            import ray_tpu
-
-            ray_tpu.kill(self._coordinator)
-        except Exception:  # noqa: BLE001
-            pass
+    def finish(self, i: int, epoch: int):
+        """A consumer abandoned (or closed) its epoch-``epoch`` iterator:
+        count it as drained so the other consumers' next epoch can start
+        instead of livelocking on WAIT."""
+        with self._lock:
+            if epoch == self._epoch:
+                self._finished.add(i)
+        return True
 
 
 class StreamSplit:
@@ -493,12 +506,13 @@ class StreamSplit:
     Each iter_* call is one epoch; the coordinator re-executes the plan
     when every consumer finished the previous epoch."""
 
-    def __init__(self, coordinator, index: int, ctx, _lifetime=None):
+    def __init__(self, coordinator, index: int, ctx, _epoch: int = 0,
+                 wait_timeout_s: float = 600.0):
         self._coord = coordinator
         self._index = index
         self._ctx = ctx
-        self._epoch = 0
-        self._lifetime = _lifetime
+        self._epoch = _epoch
+        self._wait_timeout_s = wait_timeout_s
 
     def _ref_iter(self):
         import time as _time
@@ -508,14 +522,34 @@ class StreamSplit:
 
         epoch = self._epoch
         self._epoch += 1
-        while True:
-            ref = ray_tpu.get(self._coord.next_block.remote(self._index, epoch))
-            if ref is None:
-                return
-            if ref == _SplitCoordinator.WAIT:
-                _time.sleep(0.05)
-                continue
-            yield ref
+        exhausted = False
+        wait_deadline = None
+        try:
+            while True:
+                ref = ray_tpu.get(
+                    self._coord.next_block.remote(self._index, epoch))
+                if ref is None:
+                    exhausted = True
+                    return
+                if ref == _SplitCoordinator.WAIT:
+                    if wait_deadline is None:
+                        wait_deadline = _time.monotonic() + self._wait_timeout_s
+                    elif _time.monotonic() > wait_deadline:
+                        raise RuntimeError(
+                            "streaming_split: another consumer never "
+                            "finished the previous epoch (dead consumer?)")
+                    _time.sleep(0.05)
+                    continue
+                wait_deadline = None
+                yield ref
+        finally:
+            if not exhausted:
+                # abandoned mid-epoch (break / error): count this consumer
+                # as drained so peers' next epoch doesn't livelock
+                try:
+                    self._coord.finish.remote(self._index, epoch)
+                except Exception:  # noqa: BLE001
+                    pass
 
     def iter_batches(self, *, batch_size: Optional[int] = 256,
                      batch_format: Optional[str] = None,
@@ -532,7 +566,10 @@ class StreamSplit:
             yield from iter_block_rows(ray_tpu.get(ref))
 
     def __reduce__(self):
-        return (StreamSplit, (self._coord, self._index, self._ctx))
+        # _epoch travels: a re-serialized split must resume AT its epoch,
+        # not silently restart from 0 (which next_block reads as consumed)
+        return (StreamSplit, (self._coord, self._index, self._ctx,
+                              self._epoch, self._wait_timeout_s))
 
 
 def _skip_rows(refs: List[Any], n: int) -> List[Any]:
@@ -775,12 +812,12 @@ class Dataset:
 
         import ray_tpu
 
+        # the coordinator self-reaps after idling (consumers are scattered
+        # across processes, so no single one can own its lifetime)
         coordinator = ray_tpu.remote(_SplitCoordinator).options(
             num_cpus=0.1, max_concurrency=max(n + 1, 2)).remote(
             cloudpickle.dumps(self), n, equal)
-        lifetime = _CoordinatorLifetime(coordinator)
-        return [StreamSplit(coordinator, i, self._ctx, _lifetime=lifetime)
-                for i in range(n)]
+        return [StreamSplit(coordinator, i, self._ctx) for i in range(n)]
 
     # -- execution ----------------------------------------------------------
     def _materialize_refs(self) -> List[Any]:

@@ -1,5 +1,14 @@
 """Compiled-graph channels (reference: python/ray/experimental/channel/)."""
 
+from ray_tpu.experimental.channel.accelerator_context import (
+    get_accelerator_context,
+    register_accelerator_context,
+    set_accelerator_context,
+)
+from ray_tpu.experimental.channel.communicator import (
+    CollectiveGroupCommunicator,
+    Communicator,
+)
 from ray_tpu.experimental.channel.shared_memory_channel import (
     ChannelClosed,
     ChannelFull,
@@ -7,4 +16,14 @@ from ray_tpu.experimental.channel.shared_memory_channel import (
     ShmChannel,
 )
 
-__all__ = ["ChannelClosed", "ChannelFull", "IntraProcessChannel", "ShmChannel"]
+__all__ = [
+    "ChannelClosed",
+    "ChannelFull",
+    "IntraProcessChannel",
+    "ShmChannel",
+    "Communicator",
+    "CollectiveGroupCommunicator",
+    "get_accelerator_context",
+    "register_accelerator_context",
+    "set_accelerator_context",
+]

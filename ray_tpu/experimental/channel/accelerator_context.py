@@ -1,0 +1,74 @@
+"""AcceleratorContext: pluggable vendor registry for channel communicators.
+
+reference: python/ray/experimental/channel/accelerator_context.py:18,45,84 —
+the registry where a vendor (or a framework like this one) plugs its
+communicator; SURVEY §2.3 marks it as "the designed extension point where a
+TPU/XLA communicator would plug in", which is exactly what the default
+registration below does.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional, Type
+
+from ray_tpu.experimental.channel.communicator import (
+    CollectiveGroupCommunicator,
+    Communicator,
+)
+
+_lock = threading.Lock()
+_registry: Dict[str, Type[Communicator]] = {}
+_current: Optional[str] = None
+
+
+def register_accelerator_context(name: str,
+                                 communicator_cls: Type[Communicator]):
+    """Register a communicator implementation under a vendor/platform name
+    (reference: AcceleratorContext.register)."""
+    with _lock:
+        _registry[name] = communicator_cls
+
+
+def set_accelerator_context(name: str):
+    with _lock:
+        if name not in _registry:
+            raise ValueError(f"no accelerator context {name!r}; "
+                             f"registered: {sorted(_registry)}")
+        global _current
+        _current = name
+
+
+def _detect_default() -> str:
+    """tpu when a TPU backend is live, else cpu (both ride the collective
+    groups; the backend choice decides ICI vs store transport)."""
+    try:
+        import jax
+
+        if any(d.platform == "tpu" for d in jax.devices()):
+            return "tpu"
+    except Exception:  # noqa: BLE001
+        pass
+    return "cpu"
+
+
+def get_accelerator_context() -> Type[Communicator]:
+    """The communicator class for the current platform (reference:
+    AcceleratorContext.get)."""
+    with _lock:
+        name = _current or _detect_default()
+        cls = _registry.get(name)
+    if cls is None:
+        raise ValueError(f"no accelerator context registered for {name!r}")
+    return cls
+
+
+def current_context_name() -> str:
+    with _lock:
+        return _current or _detect_default()
+
+
+# default registrations: the TPU/XLA communicator plugs into the same
+# registry slot the reference reserves for vendors
+register_accelerator_context("cpu", CollectiveGroupCommunicator)
+register_accelerator_context("tpu", CollectiveGroupCommunicator)

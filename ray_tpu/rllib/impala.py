@@ -165,6 +165,14 @@ class IMPALA(Algorithm):
                 self._fail_counts[id(runner)] = n
                 if n >= 3:
                     self._runners = [r for r in self._runners if r is not runner]
+                    # a dropped runner must not keep skewing reported
+                    # metrics, leaking strike counts, or crash-looping
+                    self._last_stats.pop(id(runner), None)
+                    self._fail_counts.pop(id(runner), None)
+                    try:
+                        ray_tpu.kill(runner)
+                    except Exception:  # noqa: BLE001
+                        pass
                     logger.error("IMPALA: runner dropped after %d consecutive "
                                  "failed samples (%s)", n, e)
                     if not self._runners:

@@ -1,0 +1,69 @@
+"""Communicator ABC + AcceleratorContext registry (reference:
+experimental/channel/communicator.py, accelerator_context.py)."""
+
+import numpy as np
+import pytest
+
+
+def test_registry_and_platform_default():
+    from ray_tpu.experimental.channel import (
+        CollectiveGroupCommunicator,
+        Communicator,
+        get_accelerator_context,
+        register_accelerator_context,
+        set_accelerator_context,
+    )
+    from ray_tpu.experimental.channel.accelerator_context import (
+        current_context_name,
+    )
+
+    # cpu test env resolves to the collective-group communicator
+    assert current_context_name() in ("cpu", "tpu")
+    assert get_accelerator_context() is CollectiveGroupCommunicator
+
+    class VendorComm(Communicator):
+        pass
+
+    register_accelerator_context("vendor-x", VendorComm)
+    set_accelerator_context("vendor-x")
+    try:
+        assert get_accelerator_context() is VendorComm
+    finally:
+        set_accelerator_context("cpu")
+
+    with pytest.raises(ValueError, match="no accelerator context"):
+        set_accelerator_context("nonexistent")
+
+
+def test_communicator_collectives_across_actors(ray_start_regular):
+    import ray_tpu
+
+    @ray_tpu.remote
+    class Rank:
+        def __init__(self, world_size, rank):
+            from ray_tpu.experimental.channel import get_accelerator_context
+
+            cls = get_accelerator_context()
+            self.comm = cls(world_size, rank, group_name="comm-test")
+            self.rank = rank
+
+        def roundtrip(self):
+            comm = self.comm
+            assert comm.get_world_size() == 2
+            assert comm.get_rank() == self.rank
+            x = np.full(4, float(self.rank + 1), np.float32)
+            total = comm.allreduce(x.copy())
+            gathered = comm.allgather(np.array([float(self.rank)], np.float32))
+            bcast = comm.broadcast(
+                np.array([42.0], np.float32) if self.rank == 0
+                else np.zeros(1, np.float32))
+            comm.barrier()
+            return (total.tolist(), np.concatenate(gathered).tolist(),
+                    bcast.tolist())
+
+    ranks = [Rank.remote(2, i) for i in range(2)]
+    outs = ray_tpu.get([r.roundtrip.remote() for r in ranks], timeout=120)
+    for total, gathered, bcast in outs:
+        assert total == [3.0] * 4  # 1 + 2
+        assert gathered == [0.0, 1.0]
+        assert bcast == [42.0]

@@ -62,6 +62,36 @@ def test_streaming_split_is_reiterable_across_epochs(ray_start_regular):
     assert per_trainer[0][0] == per_trainer[0][1] == per_trainer[0][2]
 
 
+def test_streaming_split_early_abandon_no_livelock(ray_start_regular):
+    """A consumer breaking out mid-epoch must not block peers' next epoch."""
+    import ray_tpu
+    import ray_tpu.data as rd
+
+    ds = rd.range(40).repartition(4)
+    splits = ds.streaming_split(2, equal=True)
+
+    @ray_tpu.remote
+    class Partial:
+        def one_batch_per_epoch(self, split, epochs):
+            seen = 0
+            for _ in range(epochs):
+                for _batch in split.iter_batches(batch_size=5):
+                    seen += 1
+                    break  # abandon the rest of the epoch
+            return seen
+
+    @ray_tpu.remote
+    class Full:
+        def drain_epochs(self, split, epochs):
+            return [sum(1 for _ in split.iter_rows()) for _ in range(epochs)]
+
+    p, f = Partial.remote(), Full.remote()
+    partial_ref = p.one_batch_per_epoch.remote(splits[0], 3)
+    full_ref = f.drain_epochs.remote(splits[1], 3)
+    assert ray_tpu.get(partial_ref, timeout=120) == 3
+    assert ray_tpu.get(full_ref, timeout=120) == [20, 20, 20]
+
+
 def test_streaming_split_dynamic_load_balance(ray_start_regular):
     import ray_tpu.data as rd
 
