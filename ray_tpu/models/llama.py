@@ -224,7 +224,11 @@ def forward(
     else:
         cos, sin = rope_cache
     seq_axis = "context" if context_parallel else None
-    x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.compute_dtype)
+    # See models/moe.py: the table's fsdp sharding must not propagate through
+    # the token gather (involuntary-full-remat reshard otherwise). Vocab dim
+    # stays TP-sharded; the embed dim is all-gathered over fsdp for the gather.
+    emb = _constraint(params["embed"], P("tensor", None), mesh)
+    x = jnp.take(emb, tokens, axis=0).astype(cfg.compute_dtype)
     x = _constraint(x, P(BATCH_AXES, seq_axis, None), mesh)
 
     layer = partial(_layer, cfg, cos=cos, sin=sin, mesh=mesh, context_parallel=context_parallel)

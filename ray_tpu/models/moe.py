@@ -259,7 +259,14 @@ def forward(
         cos, sin = jnp.asarray(cos), jnp.asarray(sin)
     else:
         cos, sin = rope_cache
-    x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.compute_dtype)
+    # The embed dim of the table must not stay "fsdp"-sharded through the
+    # token gather: the gather output would inherit that sharding on its
+    # last dim and the reshard to batch sharding forces the SPMD partitioner
+    # into an involuntary full rematerialization (replicate-then-slice) in
+    # fwd AND bwd. Keep the vocab dim TP-sharded (XLA partitions the gather
+    # with a masked psum) but all-gather the embed dim over fsdp explicitly.
+    emb = _constraint(params["embed"], P("tensor", None), mesh)
+    x = jnp.take(emb, tokens, axis=0).astype(cfg.compute_dtype)
     x = _constraint(x, P(MOE_BATCH_AXES, None, None), mesh)
 
     layer = partial(_layer, cfg, cos=cos, sin=sin, mesh=mesh)
