@@ -31,6 +31,10 @@ class RayTpuConfig:
     rpc_connect_timeout_s: float = 10.0
     worker_register_timeout_s: float = 30.0
     actor_creation_timeout_s: float = 120.0
+    gcs_snapshot_interval_s: float = 1.0
+    # periodic re-subscribe heals pubsub across GCS restarts and transient
+    # connect-failure evictions (Subscribe is idempotent)
+    resubscribe_interval_s: float = 5.0
     # --- object store ---
     object_store_memory_bytes: int = 2 * 1024**3
     object_store_spill_dir: str = "/tmp/ray_tpu_spill"

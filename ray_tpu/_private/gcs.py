@@ -10,13 +10,22 @@ One GCS per cluster, hosted in the head node process.  It owns cluster-level
 metadata only — node/actor/job/placement-group tables and the KV store.
 Object state stays with owners (SURVEY.md §1 cross-layer invariant).
 
-State can be snapshotted to disk and reloaded (reference: Redis persistence,
-gcs_server.h:121-122) for GCS fault tolerance.
+Fault tolerance (reference: Redis persistence gcs_server.h:115-122; raylet
+re-registration on HandleNotifyGCSRestart node_manager.cc:948): when
+``persistence_path`` is set, the mutable tables (KV, jobs, actors, named
+actors, placement groups) are snapshotted to disk atomically whenever dirty
+and reloaded by a restarted GcsServer on the same address.  The node table is
+NOT persisted — raylets re-register when their resource report returns
+``{"restart": True}`` — and pubsub subscribers re-subscribe periodically, so
+a restarted GCS reconverges without any state handoff beyond the snapshot.
 """
 
 from __future__ import annotations
 
 import logging
+import os
+import pickle
+import tempfile
 import threading
 import time
 from collections import deque
@@ -141,8 +150,10 @@ class Pubsub:
 class GcsServer:
     """All GCS managers behind one RpcServer."""
 
-    def __init__(self, host: str = "127.0.0.1", config: Optional[RayTpuConfig] = None, port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", config: Optional[RayTpuConfig] = None,
+                 port: int = 0, persistence_path: Optional[str] = None):
         self.config = config or global_config()
+        self.persistence_path = persistence_path
         self.pool = ClientPool()
         self.pubsub = Pubsub(self.pool)
         self.nodes: Dict[NodeID, NodeInfo] = {}
@@ -166,12 +177,20 @@ class GcsServer:
             max_workers=32, thread_name_prefix="gcs-actor-create"
         )
 
+        self._dirty = threading.Event()
+        if self.persistence_path and os.path.exists(self.persistence_path):
+            self._load_snapshot()
+
         self.server = RpcServer(host=host, port=port)
         self.server.register_all(self)
         self._threads = [
             threading.Thread(target=self._actor_scheduling_loop, daemon=True, name="gcs-actor-sched"),
             threading.Thread(target=self._health_check_loop, daemon=True, name="gcs-health"),
         ]
+        if self.persistence_path:
+            self._threads.append(
+                threading.Thread(target=self._snapshot_loop, daemon=True, name="gcs-snapshot")
+            )
         for t in self._threads:
             t.start()
 
@@ -185,6 +204,72 @@ class GcsServer:
             self._actor_cv.notify_all()
         self.server.shutdown()
         self.pool.close_all()
+        if self.persistence_path and self._dirty.is_set():
+            try:
+                self.snapshot_now()
+            except Exception:  # noqa: BLE001
+                logger.exception("GCS: final snapshot failed")
+
+    # ------------------------------------------------------------------
+    # Persistence (reference: gcs_server.h:115-122 Redis table storage;
+    # here a pickled atomic file snapshot of the mutable tables)
+    # ------------------------------------------------------------------
+
+    _PERSISTED = ("kv", "jobs", "actors", "named_actors",
+                  "placement_groups", "named_pgs")
+
+    def _mark_dirty(self):
+        self._dirty.set()
+
+    def snapshot_now(self):
+        with self._lock:
+            # serialize while holding the lock: the table values are shared
+            # mutable dataclasses, and a torn ActorInfo (state set, address
+            # not yet) would be unrecoverable after reload
+            state = {name: dict(getattr(self, name)) for name in self._PERSISTED}
+            state["job_counter"] = self._job_counter
+            blob = pickle.dumps(state)
+        d = os.path.dirname(os.path.abspath(self.persistence_path)) or "."
+        os.makedirs(d, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=d, prefix=".gcs-snap-")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, self.persistence_path)  # atomic on POSIX
+            self._dirty.clear()  # only a durable snapshot clears the flag
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def _load_snapshot(self):
+        with open(self.persistence_path, "rb") as f:
+            state = pickle.load(f)
+        with self._lock:
+            for name in self._PERSISTED:
+                getattr(self, name).update(state.get(name, {}))
+            self._job_counter = state.get("job_counter", 0)
+            # actors that were mid-(re)schedule go back on the queue; ALIVE
+            # actors keep their worker address (their processes outlived us)
+            for info in self.actors.values():
+                if info.state in ("PENDING", "RESTARTING"):
+                    self._actor_queue.append(info.actor_id)
+        logger.info(
+            "GCS: restored %d actors, %d kv keys, %d jobs, %d PGs from %s",
+            len(self.actors), len(self.kv), len(self.jobs),
+            len(self.placement_groups), self.persistence_path,
+        )
+
+    def _snapshot_loop(self):
+        interval = self.config.gcs_snapshot_interval_s
+        while not self._stopped.wait(interval):
+            if self._dirty.is_set():
+                try:
+                    self.snapshot_now()
+                except Exception:  # noqa: BLE001
+                    logger.exception("GCS: periodic snapshot failed")
 
     # ------------------------------------------------------------------
     # Node management (reference: gcs_node_manager.h / gcs_resource_manager)
@@ -286,6 +371,7 @@ class GcsServer:
             self._job_counter += 1
             job_id = JobID(f"{self._job_counter:08x}")
             self.jobs[job_id] = {"driver_addr": req.get("driver_addr"), "state": "RUNNING", "start": time.time()}
+        self._mark_dirty()
         return job_id
 
     def HandleJobFinished(self, req):
@@ -298,6 +384,7 @@ class GcsServer:
                 for a in self.actors.values()
                 if a.job_id == job_id and not a.detached and a.state in ("ALIVE", "PENDING", "RESTARTING")
             ]
+        self._mark_dirty()
         for aid in doomed:
             self._kill_actor(aid, no_restart=True, reason="job finished")
         return True
@@ -312,6 +399,7 @@ class GcsServer:
             if not req.get("overwrite", True) and existed:
                 return False
             self.kv[req["key"]] = req["value"]
+        self._mark_dirty()
         return not existed
 
     def HandleKVGet(self, req):
@@ -324,7 +412,10 @@ class GcsServer:
 
     def HandleKVDel(self, req):
         with self._lock:
-            return self.kv.pop(req["key"], None) is not None
+            existed = self.kv.pop(req["key"], None) is not None
+        if existed:
+            self._mark_dirty()
+        return existed
 
     def HandleKVKeys(self, req):
         prefix = req.get("prefix", "")
@@ -376,6 +467,7 @@ class GcsServer:
             self.actors[actor_id] = info
             self._actor_queue.append(actor_id)
             self._actor_cv.notify_all()
+        self._mark_dirty()
         return True
 
     def HandleGetActorInfo(self, req):
@@ -470,6 +562,7 @@ class GcsServer:
                 info.death_cause = reason
                 info.address = None
                 state_msg = {"event": "dead", "actor_id": actor_id, "reason": reason}
+        self._mark_dirty()
         self.pubsub.publish(f"ACTOR:{actor_id.hex()}", state_msg)
 
     # -- actor scheduling loop (reference: gcs_actor_scheduler.h:115) -----
@@ -539,6 +632,7 @@ class GcsServer:
             info.state = "ALIVE"
             info.address = worker_addr
             info.node_id = node.node_id
+        self._mark_dirty()
         self.pubsub.publish(
             f"ACTOR:{info.actor_id.hex()}",
             {"event": "alive", "actor_id": info.actor_id, "address": worker_addr},
@@ -560,6 +654,7 @@ class GcsServer:
                 self.named_pgs[name] = pg_id
             info = PlacementGroupInfo(pg_id=pg_id, bundles=bundles, strategy=strategy, name=name)
             self.placement_groups[pg_id] = info
+        self._mark_dirty()
         threading.Thread(
             target=self._schedule_pg, args=(info, slice_label), daemon=True, name="gcs-pg-sched"
         ).start()
@@ -579,6 +674,7 @@ class GcsServer:
                 with self._lock:
                     info.state = "CREATED"
                     info.bundle_nodes = placement
+                self._mark_dirty()
                 self.pubsub.publish(f"PG:{info.pg_id.hex()}", {"event": "created", "pg_id": info.pg_id})
                 return
             time.sleep(0.1)
@@ -646,6 +742,7 @@ class GcsServer:
                 return False
             info.state = "REMOVED"
             nodes = set(n for n in info.bundle_nodes if n is not None)
+        self._mark_dirty()
         for nid in nodes:
             with self._lock:
                 node = self.nodes.get(nid)

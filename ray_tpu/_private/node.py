@@ -26,10 +26,12 @@ class Node:
         env: Optional[Dict[str, str]] = None,
         gcs_port: int = 0,
         gcs_host: str = "127.0.0.1",
+        gcs_persistence_path: Optional[str] = None,
     ):
         self.gcs: Optional[GcsServer] = None
         if head:
-            self.gcs = GcsServer(host=gcs_host, port=gcs_port)
+            self.gcs = GcsServer(host=gcs_host, port=gcs_port,
+                                 persistence_path=gcs_persistence_path)
             gcs_address = self.gcs.address
         assert gcs_address is not None, "worker node needs gcs_address"
         self.gcs_address = tuple(gcs_address)

@@ -142,6 +142,11 @@ class RpcServer:
         self._handlers: Dict[str, Callable] = {}
         self._pool = DaemonExecutor(max_workers=num_threads, thread_name_prefix="rpc-handler")
         self._lock = threading.Lock()
+        # live client connections: shutdown() must sever them, or peers keep
+        # sending into a dead server and wait out their full RPC timeout
+        # instead of seeing ConnectionLost and reconnecting (GCS restart path)
+        self._conns: set = set()
+        self._conn_lock = threading.Lock()
         self._handshake = handshake_token.encode() if handshake_token else None
         outer = self
 
@@ -150,6 +155,8 @@ class RpcServer:
                 sock = self.request
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 send_lock = threading.Lock()
+                with outer._conn_lock:
+                    outer._conns.add(sock)
                 try:
                     if outer._handshake is not None:
                         import hmac
@@ -166,6 +173,9 @@ class RpcServer:
                         outer._pool.submit(outer._dispatch, sock, send_lock, msg_id, body)
                 except (ConnectionLost, ConnectionResetError, OSError):
                     pass
+                finally:
+                    with outer._conn_lock:
+                        outer._conns.discard(sock)
 
         class Server(socketserver.ThreadingTCPServer):
             daemon_threads = True
@@ -239,6 +249,18 @@ class RpcServer:
             self._server.server_close()
         except Exception:  # noqa: BLE001
             pass
+        with self._conn_lock:
+            conns = list(self._conns)
+            self._conns.clear()
+        for sock in conns:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
         self._pool.shutdown(wait=False, cancel_futures=True)
 
 

@@ -421,12 +421,40 @@ class CoreWorker:
             self.job_id = self.gcs.call("RegisterJob", {"driver_addr": self.server.address})
 
         self.current_task_id: Optional[TaskID] = None
+        # pubsub subscriptions this worker holds; re-issued periodically so a
+        # restarted GCS (or a transient-failure eviction, gcs.py Pubsub
+        # 3-strike rule) cannot silently orphan a live subscriber
+        self._subscriptions: set = set()
+        self._sub_lock = threading.Lock()
+        threading.Thread(target=self._resubscribe_loop, daemon=True,
+                         name="pubsub-resubscribe").start()
+
+    def _gcs_subscribe(self, channel: str):
+        with self._sub_lock:
+            self._subscriptions.add(channel)
+        self.gcs.call("Subscribe", {"channel": channel,
+                                    "subscriber_addr": self.server.address})
+
+    def _resubscribe_loop(self):
+        interval = global_config().resubscribe_interval_s
+        while not self.shutting_down:
+            time.sleep(interval)
+            if self.shutting_down:
+                return
+            with self._sub_lock:
+                channels = list(self._subscriptions)
+            for ch in channels:
+                try:
+                    self.gcs.call("Subscribe", {
+                        "channel": ch, "subscriber_addr": self.server.address,
+                    }, timeout=2, retry_deadline=0.0)
+                except Exception:  # noqa: BLE001
+                    break  # GCS unreachable; retry the whole set next round
 
     def subscribe_worker_logs(self):
         """Echo workers' stdout/stderr lines here (reference: log_to_driver)."""
         self.log_to_driver = True
-        self.gcs.call("Subscribe", {"channel": "WORKER_LOGS",
-                                    "subscriber_addr": self.server.address})
+        self._gcs_subscribe("WORKER_LOGS")
 
     # ------------------------------------------------------------------
 
@@ -436,6 +464,8 @@ class CoreWorker:
 
     def shutdown(self):
         self.shutting_down = True
+        with self._sub_lock:
+            self._subscriptions.clear()
         if self.log_to_driver:
             try:
                 self.gcs.call("Unsubscribe",
@@ -792,6 +822,9 @@ class CoreWorker:
                 elif message["event"] == "dead":
                     self._actor_addr_cache.pop(actor_id, None)
                     self._actor_state_cache[actor_id] = "DEAD"
+                    # the channel is final: stop re-subscribing to it
+                    with self._sub_lock:
+                        self._subscriptions.discard(channel)
                 self._actor_cv.notify_all()
         return True
 
@@ -1316,7 +1349,7 @@ class CoreWorker:
             actor_name=name,
             runtime_env=runtime_env,
         )
-        self.gcs.call("Subscribe", {"channel": f"ACTOR:{actor_id.hex()}", "subscriber_addr": self.server.address})
+        self._gcs_subscribe(f"ACTOR:{actor_id.hex()}")
         self.gcs.call("RegisterActor", {"spec": spec, "namespace": namespace})
         return actor_id, spec
 
@@ -1383,10 +1416,7 @@ class CoreWorker:
         info = self.gcs.call("GetNamedActor", {"name": name, "namespace": namespace})
         if info is None:
             raise ValueError(f"no actor named {name!r}")
-        self.gcs.call(
-            "Subscribe",
-            {"channel": f"ACTOR:{info['actor_id'].hex()}", "subscriber_addr": self.server.address},
-        )
+        self._gcs_subscribe(f"ACTOR:{info['actor_id'].hex()}")
         return info
 
     # ------------------------------------------------------------------

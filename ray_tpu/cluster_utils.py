@@ -16,12 +16,28 @@ from ray_tpu._private.raylet import Raylet
 
 
 class Cluster:
-    def __init__(self, initialize_head: bool = True, head_node_args: Optional[dict] = None):
-        self.gcs = GcsServer()
+    def __init__(self, initialize_head: bool = True, head_node_args: Optional[dict] = None,
+                 gcs_args: Optional[dict] = None):
+        self._gcs_args = dict(gcs_args or {})
+        self.gcs = GcsServer(**self._gcs_args)
         self.nodes: list[Raylet] = []
         self.head_node: Optional[Raylet] = None
         if initialize_head:
             self.head_node = self.add_node(**(head_node_args or {}))
+
+    def kill_gcs(self):
+        """Stop the GCS process-equivalent, leaving raylets/workers running."""
+        self.gcs.shutdown()
+
+    def restart_gcs(self):
+        """Start a fresh GcsServer on the SAME address, reloading persisted
+        state (requires gcs_args={"persistence_path": ...}; reference:
+        gcs_server.h:115-122 + raylet re-registration node_manager.cc:948)."""
+        port = self.gcs.address[1]
+        args = dict(self._gcs_args)
+        args["port"] = port
+        self.gcs = GcsServer(**args)
+        return self.gcs
 
     @property
     def address(self):

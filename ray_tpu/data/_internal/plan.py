@@ -14,10 +14,7 @@ stages fuse (reference: planner fusion) so one task runs read→map→map.
 from __future__ import annotations
 
 import dataclasses
-import itertools
 import logging
-import threading
-from collections import deque
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 logger = logging.getLogger(__name__)
@@ -66,45 +63,7 @@ class AllToAll(LogicalOp):
 
 
 # ---------------------------------------------------------------------------
-# Remote execution helpers (plain tasks; defined at module top level so
-# workers import them by reference)
-# ---------------------------------------------------------------------------
-
-def _run_read_task(read_task):
-    from ray_tpu.data.block import to_arrow
-
-    return to_arrow(read_task())
-
-
-def _run_fused(fns, first_input):
-    """Run a fused chain of block transforms; input is a block or a thunk."""
-    from ray_tpu.data.block import to_arrow
-
-    block = first_input() if callable(first_input) else first_input
-    block = to_arrow(block)
-    for fn in fns:
-        block = to_arrow(fn(block))
-    return block
-
-
-class _ActorPoolWorker:
-    """Actor holding a stateful callable (reference: actor_pool_map_operator)."""
-
-    def __init__(self, ctor):
-        self._fn = ctor()
-
-    def apply(self, fns_before, block):
-        from ray_tpu.data.block import to_arrow
-
-        block = block() if callable(block) else block
-        block = to_arrow(block)
-        for fn in fns_before:
-            block = to_arrow(fn(block))
-        return to_arrow(self._fn(block))
-
-
-# ---------------------------------------------------------------------------
-# Execution plan
+# Execution plan (remote execution lives in streaming_executor.py)
 # ---------------------------------------------------------------------------
 
 class ExecutionPlan:
@@ -116,20 +75,15 @@ class ExecutionPlan:
 
     # -- streaming execution ------------------------------------------------
     def execute_iter(self, ctx) -> Iterator[Any]:
-        """Yield output block refs as they become available."""
-        stages = self._fuse(ctx)
-        stream: Iterator[Any] = iter(())
-        for kind, payload in stages:
-            if kind == "input":
-                stream = iter(payload)
-            elif kind == "tasks":
-                stream = self._stream_tasks(payload, stream, ctx)
-            elif kind == "actor_pool":
-                stream = self._stream_actor_pool(payload, stream, ctx)
-            elif kind == "barrier":
-                refs = list(stream)
-                stream = iter(payload(refs))
-        return stream
+        """Yield output block refs as they become available.
+
+        Execution is delegated to the backpressured StreamingExecutor
+        (streaming_executor.py — reference: streaming_executor.py:57);
+        stages produced by _fuse map 1:1 onto physical operators.
+        """
+        from ray_tpu.data._internal.streaming_executor import execute_streaming
+
+        return execute_streaming(self._fuse(ctx), ctx)
 
     def execute(self, ctx) -> List[Any]:
         return list(self.execute_iter(ctx))
@@ -179,78 +133,3 @@ class ExecutionPlan:
                 raise TypeError(f"unknown op {op}")
         flush()
         return stages
-
-    # -- task streaming with bounded in-flight window -----------------------
-    def _stream_tasks(self, payload, upstream: Iterator[Any], ctx) -> Iterator[Any]:
-        kind, fns, sources = payload
-        import ray_tpu
-
-        remote_opts = {"num_cpus": ctx.cpus_per_task}
-        fused = ray_tpu.remote(_run_fused).options(**remote_opts)
-
-        if kind == "source":
-            inputs: Iterator[Any] = iter(sources)
-            submit = lambda item: fused.remote(fns, item)  # noqa: E731
-        else:
-            inputs = upstream
-            submit = lambda ref: fused.remote(fns, ref)  # noqa: E731
-
-        window = ctx.max_tasks_in_flight
-        in_flight: deque = deque()
-        for item in inputs:
-            while len(in_flight) >= window:
-                yield in_flight.popleft()
-            in_flight.append(submit(item))
-        while in_flight:
-            yield in_flight.popleft()
-
-    def _stream_actor_pool(self, payload, upstream: Iterator[Any], ctx) -> Iterator[Any]:
-        op, fns_before = payload
-        import ray_tpu
-
-        compute = op.compute
-        pool_size = getattr(compute, "min_size", None) or getattr(compute, "size", 2)
-        opts = {"num_cpus": ctx.cpus_per_task}
-        if op.resources:
-            opts["resources"] = {k: v for k, v in op.resources.items() if k != "CPU"}
-            if "CPU" in op.resources:
-                opts["num_cpus"] = op.resources["CPU"]
-        worker_cls = ray_tpu.remote(_ActorPoolWorker).options(**opts)
-        actors = [worker_cls.remote(op.fn_constructor) for _ in range(pool_size)]
-        yielded: List[Any] = []
-        try:
-            free = deque(actors)
-            in_flight: deque = deque()  # (ref, actor)
-            for ref in upstream:
-                while not free:
-                    done_ref, actor = in_flight.popleft()
-                    yielded.append(done_ref)
-                    yield done_ref
-                    free.append(actor)
-                actor = free.popleft()
-                in_flight.append((actor.apply.remote(fns_before, ref), actor))
-            while in_flight:
-                done_ref, actor = in_flight.popleft()
-                yielded.append(done_ref)
-                yield done_ref
-        finally:
-            # Refs handed downstream may still be executing on the pool —
-            # killing an actor mid-task would fail the consumer's get with
-            # ActorDiedError.  Reap asynchronously: generator close returns
-            # immediately (early-exit consumers don't stall) and the actors
-            # die once the yielded work drains.
-            def _reap(refs=list(yielded), pool=list(actors)):
-                try:
-                    # normal completion: everything already finished, returns
-                    # instantly; early-exit consumers bound the leak to 60s
-                    ray_tpu.wait(refs, num_returns=len(refs), timeout=60)
-                except Exception:  # noqa: BLE001
-                    pass
-                for a in pool:
-                    try:
-                        ray_tpu.kill(a)
-                    except Exception:  # noqa: BLE001
-                        pass
-
-            threading.Thread(target=_reap, daemon=True,
-                             name="actor-pool-reaper").start()

@@ -17,6 +17,13 @@ class DataContext:
     max_tasks_in_flight: int = 16
     cpus_per_task: float = 1.0
     default_batch_format: str = "numpy"
+    # -- streaming executor (reference: execution/resource_manager.py +
+    # backpressure_policy/; ExecutionOptions.preserve_order)
+    op_memory_budget: int = 256 * 1024 * 1024  # bytes parked downstream of one op
+    output_queue_blocks: int = 16  # consumer-side bounded queue (blocks)
+    preserve_order: bool = True  # release outputs in data order (never gates submission)
+    tasks_per_actor: int = 2  # per-actor pipelining in actor pools
+    actor_idle_timeout_s: float = 30.0  # autoscaling pool scale-down
 
     _current: "Optional[DataContext]" = None
     _lock = threading.Lock()
