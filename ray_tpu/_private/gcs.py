@@ -223,24 +223,27 @@ class GcsServer:
 
     def snapshot_now(self):
         with self._lock:
-            # serialize while holding the lock: the table values are shared
-            # mutable dataclasses, and a torn ActorInfo (state set, address
-            # not yet) would be unrecoverable after reload
+            # clear-before-capture (under the lock): a mutation racing this
+            # snapshot re-sets the flag and gets picked up next round.
+            # Serialize while holding the lock too — the table values are
+            # shared mutable dataclasses, and a torn ActorInfo (state set,
+            # address not yet) would be unrecoverable after reload.
+            self._dirty.clear()
             state = {name: dict(getattr(self, name)) for name in self._PERSISTED}
             state["job_counter"] = self._job_counter
             blob = pickle.dumps(state)
         d = os.path.dirname(os.path.abspath(self.persistence_path)) or "."
-        os.makedirs(d, exist_ok=True)
-        fd, tmp = tempfile.mkstemp(dir=d, prefix=".gcs-snap-")
         try:
+            os.makedirs(d, exist_ok=True)
+            fd, tmp = tempfile.mkstemp(dir=d, prefix=".gcs-snap-")
             with os.fdopen(fd, "wb") as f:
                 f.write(blob)
             os.replace(tmp, self.persistence_path)  # atomic on POSIX
-            self._dirty.clear()  # only a durable snapshot clears the flag
         except BaseException:
+            self._dirty.set()  # not durable; retry next round
             try:
                 os.unlink(tmp)
-            except OSError:
+            except (OSError, UnboundLocalError):
                 pass
             raise
 

@@ -437,14 +437,31 @@ class CoreWorker:
 
     def _resubscribe_loop(self):
         interval = global_config().resubscribe_interval_s
+        rounds = 0
         while not self.shutting_down:
             time.sleep(interval)
             if self.shutting_down:
                 return
+            rounds += 1
             with self._sub_lock:
                 channels = list(self._subscriptions)
+            # bound the set: a 'dead' pubsub event can be missed (GCS restart,
+            # eviction), so periodically verify ACTOR channels against the
+            # authoritative table and drop finished ones
+            audit = rounds % 12 == 0
             for ch in channels:
                 try:
+                    if audit and ch.startswith("ACTOR:"):
+                        from ray_tpu._private.ids import ActorID
+
+                        info = self.gcs.call(
+                            "GetActorInfo",
+                            {"actor_id": ActorID(ch[len("ACTOR:"):])},
+                            timeout=2, retry_deadline=0.0)
+                        if info is None or info.get("state") == "DEAD":
+                            with self._sub_lock:
+                                self._subscriptions.discard(ch)
+                            continue
                     self.gcs.call("Subscribe", {
                         "channel": ch, "subscriber_addr": self.server.address,
                     }, timeout=2, retry_deadline=0.0)

@@ -195,6 +195,7 @@ class TaskPoolMapOperator(PhysicalOperator):
         super().__init__(name, ctx)
         self.fns = fns
         self.resources = resources
+        self._fused_fn = None  # built lazily once (needs a connected worker)
         self._in_flight: Dict[Any, Tuple[Any, int]] = {}  # meta_ref -> (block_ref, seq)
         if sources is not None:
             for i, src in enumerate(sources):
@@ -202,16 +203,18 @@ class TaskPoolMapOperator(PhysicalOperator):
             self.inputs_done = True
 
     def _remote_fn(self):
-        import ray_tpu
+        if self._fused_fn is None:
+            import ray_tpu
 
-        opts = {"num_cpus": self.ctx.cpus_per_task, "num_returns": 2}
-        if self.resources:
-            res = {k: v for k, v in self.resources.items() if k != "CPU"}
-            if res:
-                opts["resources"] = res
-            if "CPU" in self.resources:
-                opts["num_cpus"] = self.resources["CPU"]
-        return ray_tpu.remote(_run_fused_meta).options(**opts)
+            opts = {"num_cpus": self.ctx.cpus_per_task, "num_returns": 2}
+            if self.resources:
+                res = {k: v for k, v in self.resources.items() if k != "CPU"}
+                if res:
+                    opts["resources"] = res
+                if "CPU" in self.resources:
+                    opts["num_cpus"] = self.resources["CPU"]
+            self._fused_fn = ray_tpu.remote(_run_fused_meta).options(**opts)
+        return self._fused_fn
 
     def can_dispatch(self) -> bool:
         return bool(self.inputs) and len(self._in_flight) < self.ctx.max_tasks_in_flight
