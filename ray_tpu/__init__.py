@@ -31,6 +31,7 @@ from ray_tpu._private.task_spec import (
     ActorUnavailableError,
     GetTimeoutError,
     ObjectLostError,
+    OutOfMemoryError,
     RayTpuError,
     TaskCancelledError,
     TaskError,
@@ -276,6 +277,7 @@ __all__ = [
     "ActorUnavailableError",
     "ObjectLostError",
     "GetTimeoutError",
+    "OutOfMemoryError",
     "WorkerCrashedError",
     "TaskCancelledError",
 ]

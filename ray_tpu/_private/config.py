@@ -52,6 +52,9 @@ class RayTpuConfig:
     num_prestart_workers: int = 0
     maximum_startup_concurrency: int = 4
     idle_worker_kill_timeout_s: float = 300.0
+    # --- memory monitor (reference: memory_monitor.h:52) ---
+    memory_usage_threshold: float = 0.95  # node used-memory fraction
+    memory_monitor_refresh_ms: int = 250  # 0 disables the monitor
     # --- retries / fault tolerance ---
     task_max_retries_default: int = 3
     actor_max_restarts_default: int = 0

@@ -55,6 +55,8 @@ class _Lease:
     pg_id: Optional[PlacementGroupID] = None
     bundle_index: int = -1
     for_actor: bool = False
+    retriable: bool = False              # memory monitor may kill+retry
+    granted_at: float = 0.0
 
 
 @dataclass
@@ -131,6 +133,9 @@ class Raylet:
         self._draining = False
         self._stopped = threading.Event()
         self._lease_counter = 0
+        # worker address -> exit reason ("oom"); owners query this to turn a
+        # ConnectionLost into OutOfMemoryError (reference: memory_monitor.h:52)
+        self._exit_reasons: Dict[Tuple[str, int], str] = {}
         self._object_owners: Dict[ObjectID, Tuple[str, int]] = {}
 
         # Register with GCS; receive cluster config + view.
@@ -154,6 +159,9 @@ class Raylet:
             threading.Thread(target=self._dispatch_loop, daemon=True, name="raylet-dispatch"),
             threading.Thread(target=self._worker_monitor_loop, daemon=True, name="raylet-monitor"),
         ]
+        if global_config().memory_monitor_refresh_ms > 0:
+            self._threads.append(threading.Thread(
+                target=self._memory_monitor_loop, daemon=True, name="raylet-memmon"))
         for t in self._threads:
             t.start()
 
@@ -336,6 +344,76 @@ class Raylet:
             for w in dead:
                 self._on_worker_death(w)
 
+    # ------------------------------------------------------------------
+    # Memory monitor (reference: src/ray/common/memory_monitor.h:52 — sample
+    # node memory; over threshold, kill the most recently granted retriable
+    # task's worker so the owner retries it; the kill reason is queryable so
+    # the final surfaced error is OutOfMemoryError, not a generic crash)
+    # ------------------------------------------------------------------
+
+    def _memory_used_fraction(self) -> float:
+        try:
+            import psutil
+
+            return float(psutil.virtual_memory().percent) / 100.0
+        except ImportError:
+            # /proc fallback so the monitor still protects hosts w/o psutil
+            info = {}
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    k, _, v = line.partition(":")
+                    info[k] = int(v.strip().split()[0])
+            total = info.get("MemTotal", 0)
+            avail = info.get("MemAvailable", total)
+            return 1.0 - (avail / total) if total else 0.0
+
+    def _memory_monitor_loop(self):
+        cfg = global_config()
+        period = max(cfg.memory_monitor_refresh_ms, 50) / 1000.0
+        while not self._stopped.wait(period):
+            try:
+                frac = self._memory_used_fraction()
+            except Exception:  # noqa: BLE001
+                continue
+            threshold = global_config().memory_usage_threshold
+            if frac <= threshold:
+                continue
+            victim = None
+            with self._lock:
+                # prefer retriable task leases (they restart transparently);
+                # fall back to non-retriable ones — the owner then surfaces
+                # OutOfMemoryError immediately. Actors are never OOM-killed
+                # here (reference policy: workers running tasks first).
+                candidates = [l for l in self._leases.values()
+                              if l.retriable and l.worker.proc is not None]
+                if not candidates:
+                    candidates = [l for l in self._leases.values()
+                                  if not l.for_actor and l.worker.proc is not None]
+                if candidates:
+                    victim = max(candidates, key=lambda l: l.granted_at)
+                    self._exit_reasons[tuple(victim.worker.address)] = "oom"
+                    while len(self._exit_reasons) > 256:
+                        self._exit_reasons.pop(next(iter(self._exit_reasons)))
+            if victim is None:
+                continue
+            logger.warning(
+                "raylet %s: node memory %.1f%% > %.1f%%; killing newest "
+                "retriable task's worker %s (lease %s)",
+                self.node_id, frac * 100, threshold * 100,
+                victim.worker.worker_id, victim.lease_id)
+            try:
+                victim.worker.proc.kill()
+            except Exception:  # noqa: BLE001
+                pass
+            # cooldown before the next kill: gives the freed memory time to
+            # show in the next sample AND spaces out kills so a retried task
+            # is not immediately re-shot while external pressure persists
+            # (the owner also backs off harder on OOM retries)
+            self._stopped.wait(2.0)
+
+    def HandleGetWorkerExitReason(self, req):
+        return self._exit_reasons.get(tuple(req["worker_addr"]))
+
     def _on_worker_death(self, w: _Worker):
         logger.warning("raylet %s: worker %s died", self.node_id, w.worker_id)
         with self._lock:
@@ -508,6 +586,8 @@ class Raylet:
             pg_id=pg_id,
             bundle_index=bundle_index,
             for_actor=p.for_actor,
+            retriable=(not p.for_actor) and p.spec.max_retries != 0,
+            granted_at=time.monotonic(),
         )
         self._leases[lease_id] = lease
         worker.lease_id = lease_id

@@ -74,6 +74,12 @@ class WorkerCrashedError(RayTpuError):
     pass
 
 
+class OutOfMemoryError(RayTpuError):
+    """Task's worker was killed by the raylet MemoryMonitor (reference:
+    src/ray/common/memory_monitor.h:52 + OOM-retriable task kills)."""
+    pass
+
+
 class ActorDiedError(RayTpuError):
     def __init__(self, actor_id=None, reason: str = ""):
         super().__init__(f"actor {actor_id} died: {reason}")

@@ -40,6 +40,7 @@ from ray_tpu._private.task_spec import (
     ObjectLostError,
     TaskCancelledError,
     TaskError,
+    OutOfMemoryError,
     TaskSpec,
     WorkerCrashedError,
 )
@@ -454,13 +455,21 @@ class CoreWorker:
                     if audit and ch.startswith("ACTOR:"):
                         from ray_tpu._private.ids import ActorID
 
+                        actor_id = ActorID(ch[len("ACTOR:"):])
                         info = self.gcs.call(
-                            "GetActorInfo",
-                            {"actor_id": ActorID(ch[len("ACTOR:"):])},
+                            "GetActorInfo", {"actor_id": actor_id},
                             timeout=2, retry_deadline=0.0)
-                        if info is None or info.get("state") == "DEAD":
+                        # info None can be a registration in flight
+                        # (_create_actor subscribes BEFORE RegisterActor) —
+                        # only a positively-DEAD actor is dropped, and the
+                        # missed 'dead' event is applied to the caches
+                        if info is not None and info.get("state") == "DEAD":
                             with self._sub_lock:
                                 self._subscriptions.discard(ch)
+                            with self._actor_lock:
+                                self._actor_addr_cache.pop(actor_id, None)
+                                self._actor_state_cache[actor_id] = "DEAD"
+                                self._actor_cv.notify_all()
                             continue
                     self.gcs.call("Subscribe", {
                         "channel": ch, "subscriber_addr": self.server.address,
@@ -961,18 +970,24 @@ class CoreWorker:
                 try:
                     self._submit_once(spec)
                     return
-                except (ConnectionLost, WorkerCrashedError, RemoteError) as e:
+                except (ConnectionLost, WorkerCrashedError, OutOfMemoryError, RemoteError) as e:
                     if spec.task_id in self._cancelled_tasks:
                         self._cancelled_tasks.discard(spec.task_id)
                         self._fail_task(spec, TaskCancelledError(
                             f"task {spec.name} was cancelled"))
                         return
                     if spec.max_retries != -1 and spec.attempt >= max(spec.max_retries, 0):
-                        self._fail_task(spec, WorkerCrashedError(f"task {spec.name} failed after {spec.attempt + 1} attempts: {e}"))
+                        err_cls = OutOfMemoryError if isinstance(e, OutOfMemoryError) else WorkerCrashedError
+                        self._fail_task(spec, err_cls(f"task {spec.name} failed after {spec.attempt + 1} attempts: {e}"))
                         return
                     spec.attempt += 1
                     logger.info("retrying task %s (attempt %d): %s", spec.name, spec.attempt, e)
-                    time.sleep(min(0.05 * (2 ** min(spec.attempt, 6)), 2.0))
+                    if isinstance(e, OutOfMemoryError):
+                        # slower backoff: give node memory pressure time to
+                        # clear so retries aren't immediately re-killed
+                        time.sleep(min(1.0 * (2 ** min(spec.attempt, 5)), 30.0))
+                    else:
+                        time.sleep(min(0.05 * (2 ** min(spec.attempt, 6)), 2.0))
         except Exception as e:  # noqa: BLE001
             logger.exception("task %s submission failed", spec.name)
             self._fail_task(spec, e)
@@ -989,6 +1004,19 @@ class CoreWorker:
                 "PushTask", {"spec": spec, "lease": lease}, timeout=None, retry_deadline=0
             )
         except ConnectionLost:
+            # the leasing raylet knows WHY the worker went away (its memory
+            # monitor records OOM kills — reference memory_monitor.h:52)
+            reason = None
+            try:
+                reason = raylet_cli.call(
+                    "GetWorkerExitReason", {"worker_addr": worker_addr},
+                    timeout=2, retry_deadline=0.0)
+            except Exception:  # noqa: BLE001
+                pass
+            if reason == "oom":
+                raise OutOfMemoryError(
+                    f"worker {worker_addr} running {spec.name} was killed by "
+                    "the memory monitor (node memory over threshold)")
             raise WorkerCrashedError(f"worker {worker_addr} died while running {spec.name}")
         finally:
             self._task_exec_addr.pop(spec.task_id, None)

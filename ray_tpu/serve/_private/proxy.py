@@ -1,17 +1,31 @@
-"""HTTP proxy: routes requests to deployment handles.
+"""HTTP proxy: asyncio ingress routing requests to deployment handles.
 
 reference: python/ray/serve/_private/proxy.py (ProxyActor :1020, HTTPProxy
-:706, uvicorn ASGI http_util.py:23-31). TPU-native rebuild keeps it simple:
-a threaded stdlib HTTP server in the driver/controller process; the hot path
-(handle → replica actor) is identical to the reference's router path.
+:706) — the reference fronts deployments with a uvicorn ASGI server
+(http_util.py:23-31). TPU-native rebuild (round 2, replacing the stdlib
+ThreadingHTTPServer): a single asyncio event loop owns every connection
+(keep-alive, concurrent SSE streams), while blocking handle calls run on a
+bounded thread pool — overload queues work instead of erroring, and one
+stalled stream never starves other connections.
+
+The module-level surface (start_proxy/stop_proxy/register_route/
+unregister_route/match_route/list_routes) is shared with the gRPC-style RPC
+ingress and the local testing mode.
 """
 
 from __future__ import annotations
 
+import asyncio
 import json
+import logging
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+_MAX_BODY = 64 * 1024 * 1024
+_HANDLE_TIMEOUT_S = 60.0
 
 
 class _ProxyState:
@@ -21,8 +35,7 @@ class _ProxyState:
 
 
 _state = _ProxyState()
-_server: Optional[ThreadingHTTPServer] = None
-_thread: Optional[threading.Thread] = None
+_proxy: Optional["_AsyncProxy"] = None
 
 
 def match_route(path: str):
@@ -40,98 +53,253 @@ def list_routes():
         return sorted(_state.routes)
 
 
-class _Handler(BaseHTTPRequestHandler):
-    def log_message(self, fmt, *args):  # silence
-        pass
+class _BadRequest(Exception):
+    pass
 
-    def _dispatch(self, body: Optional[bytes]):
-        path = self.path.split("?")[0]
-        match = match_route(path)
-        if match is None:
-            self.send_response(404)
-            self.end_headers()
-            self.wfile.write(b'{"error": "no route"}')
+
+class _AsyncProxy:
+    """One event loop + bounded executor serving all proxy connections."""
+
+    def __init__(self, host: str, port: int, max_handle_threads: int = 64):
+        self._host = host
+        self._port = port
+        self._loop = asyncio.new_event_loop()
+        self._pool = ThreadPoolExecutor(
+            max_workers=max_handle_threads, thread_name_prefix="proxy-handle"
+        )
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._boot_error: Optional[BaseException] = None
+        started = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, args=(started,), daemon=True, name="serve-http-proxy"
+        )
+        self._thread.start()
+        started.wait(timeout=10)
+        if self._server is None:
+            err = self._boot_error
+            raise RuntimeError(f"proxy failed to start: {err}") from err
+        self.address: Tuple[str, int] = self._server.sockets[0].getsockname()[:2]
+
+    def _run(self, started: threading.Event):
+        asyncio.set_event_loop(self._loop)
+
+        async def boot():
+            try:
+                self._server = await asyncio.start_server(
+                    self._handle_conn, self._host, self._port
+                )
+            except BaseException as e:  # noqa: BLE001
+                self._boot_error = e
+            finally:
+                started.set()
+
+        self._loop.run_until_complete(boot())
+        if self._boot_error is not None:
             return
+        try:
+            self._loop.run_forever()
+        finally:
+            self._loop.close()
+
+    def stop(self):
+        def _shutdown():
+            if self._server is not None:
+                self._server.close()
+            self._loop.stop()
+
+        try:
+            self._loop.call_soon_threadsafe(_shutdown)
+            self._thread.join(timeout=5)
+        except RuntimeError:
+            pass
+        self._pool.shutdown(wait=False, cancel_futures=True)
+
+    # -- HTTP/1.1 ----------------------------------------------------------
+
+    async def _read_request(self, reader):
+        line = await reader.readline()
+        if not line or line in (b"\r\n", b"\n"):
+            return None
+        try:
+            method, target, _version = line.decode("latin1").split(" ", 2)
+        except ValueError:
+            raise _BadRequest("malformed request line")
+        headers = {}
+        while True:
+            h = await reader.readline()
+            if h in (b"\r\n", b"\n", b""):
+                break
+            if b":" in h:
+                k, v = h.split(b":", 1)
+                headers[k.decode("latin1").strip().lower()] = v.decode("latin1").strip()
+        try:
+            length = int(headers.get("content-length", 0) or 0)
+        except ValueError:
+            raise _BadRequest("bad content-length")
+        if length > _MAX_BODY:
+            raise _BadRequest("body too large")
+        body = await reader.readexactly(length) if length else b""
+        return method, target, headers, body
+
+    @staticmethod
+    def _response(status: int, body: bytes, content_type: str = "application/json",
+                  keep_alive: bool = True) -> bytes:
+        reason = {200: "OK", 404: "Not Found", 400: "Bad Request",
+                  500: "Internal Server Error"}.get(status, "OK")
+        conn = "keep-alive" if keep_alive else "close"
+        return (
+            f"HTTP/1.1 {status} {reason}\r\n"
+            f"Content-Type: {content_type}\r\n"
+            f"Content-Length: {len(body)}\r\n"
+            f"Connection: {conn}\r\n\r\n"
+        ).encode("latin1") + body
+
+    async def _handle_conn(self, reader, writer):
+        try:
+            while True:
+                try:
+                    req = await self._read_request(reader)
+                except (_BadRequest, asyncio.IncompleteReadError, ValueError):
+                    # ValueError: oversized header line (StreamReader limit)
+                    writer.write(self._response(400, b'{"error": "bad request"}',
+                                                keep_alive=False))
+                    await writer.drain()
+                    break
+                if req is None:
+                    break
+                method, target, headers, body = req
+                keep = await self._dispatch(writer, method, target, body)
+                if not keep:
+                    break
+        except (ConnectionResetError, BrokenPipeError, asyncio.CancelledError):
+            pass
+        except Exception:  # noqa: BLE001
+            logger.exception("proxy connection handler failed")
+        finally:
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    async def _dispatch(self, writer, method: str, target: str, body: bytes) -> bool:
+        path = target.split("?")[0]
+        handle = match_route(path)
+        if handle is None:
+            writer.write(self._response(404, b'{"error": "no route"}'))
+            await writer.drain()
+            return True
         try:
             payload = json.loads(body) if body else None
         except json.JSONDecodeError:
             payload = body.decode() if body else None
+
         if isinstance(payload, dict) and payload.get("stream"):
-            return self._dispatch_stream(match, payload)
-        try:
+            await self._dispatch_stream(writer, handle, payload)
+            return False  # SSE ends with connection close (no chunked TE)
+
+        loop = asyncio.get_running_loop()
+
+        def call():
             if payload is None:
-                result = match.remote().result(timeout_s=60)
-            else:
-                result = match.remote(payload).result(timeout_s=60)
+                return handle.remote().result(timeout_s=_HANDLE_TIMEOUT_S)
+            return handle.remote(payload).result(timeout_s=_HANDLE_TIMEOUT_S)
+
+        try:
+            result = await loop.run_in_executor(self._pool, call)
             out = json.dumps(result, default=str).encode()
-            self.send_response(200)
-            self.send_header("Content-Type", "application/json")
-            self.end_headers()
-            self.wfile.write(out)
+            writer.write(self._response(200, out))
         except Exception as e:  # noqa: BLE001
-            self.send_response(500)
-            self.end_headers()
-            self.wfile.write(json.dumps({"error": str(e)}).encode())
+            writer.write(self._response(500, json.dumps({"error": str(e)}).encode()))
+        await writer.drain()
+        return True
 
-    def _dispatch_stream(self, match, payload):
+    async def _dispatch_stream(self, writer, handle, payload):
         """Server-sent events: one `data:` frame per streamed item, then
-        `data: [DONE]` (the OpenAI SSE convention; reference: serve
-        streaming responses over the proxy)."""
-        try:
-            gen = match.options(stream=True).remote(payload)
-        except Exception as e:  # noqa: BLE001
-            self.send_response(500)
-            self.end_headers()
-            self.wfile.write(json.dumps({"error": str(e)}).encode())
-            return
-        self.send_response(200)
-        self.send_header("Content-Type", "text/event-stream")
-        self.send_header("Cache-Control", "no-cache")
-        self.end_headers()
-        try:
-            for item in gen:
-                self.wfile.write(b"data: "
-                                 + json.dumps(item, default=str).encode()
-                                 + b"\n\n")
-                self.wfile.flush()
-            self.wfile.write(b"data: [DONE]\n\n")
-        except BrokenPipeError:
-            pass  # client hung up mid-stream
-        except Exception as e:  # noqa: BLE001
+        `data: [DONE]` (the OpenAI SSE convention). The blocking generator is
+        drained on the executor; frames hop to the event loop via a queue so
+        many streams interleave on one loop."""
+        loop = asyncio.get_running_loop()
+        q: asyncio.Queue = asyncio.Queue(maxsize=256)
+        stop = threading.Event()
+        _END = object()
+
+        def put_from_thread(item) -> bool:
+            """Enqueue onto the loop, re-checking ``stop`` so an abandoned
+            stream can never park this thread on a full queue forever."""
+            while not stop.is_set():
+                fut = asyncio.run_coroutine_threadsafe(q.put(item), loop)
+                try:
+                    fut.result(timeout=0.5)
+                    return True
+                except TimeoutError:
+                    fut.cancel()
+                except Exception:  # noqa: BLE001 (loop closed, etc.)
+                    return False
+            return False
+
+        def pump():
             try:
-                # error frame, then the [DONE] sentinel so protocol-following
-                # clients still see a terminated stream
-                self.wfile.write(b"data: "
-                                 + json.dumps({"error": str(e)}).encode()
-                                 + b"\n\ndata: [DONE]\n\n")
-            except OSError:
-                pass
+                gen = handle.options(stream=True).remote(payload)
+                for item in gen:
+                    if stop.is_set():
+                        return
+                    frame = (b"data: " + json.dumps(item, default=str).encode()
+                             + b"\n\n")
+                    if not put_from_thread(frame):
+                        return
+                put_from_thread(b"data: [DONE]\n\n")
+            except Exception as e:  # noqa: BLE001
+                if not stop.is_set():
+                    err = (b"data: " + json.dumps({"error": str(e)}).encode()
+                           + b"\n\ndata: [DONE]\n\n")
+                    put_from_thread(err)
+            finally:
+                put_from_thread(_END)
 
-    def do_GET(self):
-        self._dispatch(None)
-
-    def do_POST(self):
-        length = int(self.headers.get("Content-Length", 0))
-        self._dispatch(self.rfile.read(length) if length else None)
+        writer.write(
+            b"HTTP/1.1 200 OK\r\n"
+            b"Content-Type: text/event-stream\r\n"
+            b"Cache-Control: no-cache\r\n"
+            b"Connection: close\r\n\r\n"
+        )
+        await writer.drain()
+        # one dedicated thread per live stream: streams are long-lived, so
+        # routing them through the bounded unary pool would let N streams
+        # starve every other request (the docstring's no-starvation claim)
+        t = threading.Thread(target=pump, daemon=True, name="proxy-sse-pump")
+        t.start()
+        try:
+            while True:
+                frame = await q.get()
+                if frame is _END:
+                    break
+                writer.write(frame)
+                await writer.drain()
+        except (ConnectionResetError, BrokenPipeError):
+            pass  # client hung up; stop pulling from the generator
+        finally:
+            stop.set()
+            # unblock a pump parked in q.put by draining leftovers
+            while not q.empty():
+                try:
+                    q.get_nowait()
+                except asyncio.QueueEmpty:
+                    break
 
 
 def start_proxy(host: str = "127.0.0.1", port: int = 8000) -> Tuple[str, int]:
-    global _server, _thread
-    if _server is not None:
-        return _server.server_address
-    _server = ThreadingHTTPServer((host, port), _Handler)
-    _thread = threading.Thread(target=_server.serve_forever, daemon=True,
-                               name="serve-http-proxy")
-    _thread.start()
-    return _server.server_address
+    global _proxy
+    if _proxy is not None:
+        return _proxy.address
+    _proxy = _AsyncProxy(host, port)
+    return _proxy.address
 
 
 def stop_proxy():
-    global _server, _thread
-    if _server is not None:
-        _server.shutdown()
-        _server = None
-        _thread = None
+    global _proxy
+    if _proxy is not None:
+        _proxy.stop()
+        _proxy = None
 
 
 def register_route(route_prefix: str, handle):

@@ -1,0 +1,80 @@
+"""MemoryMonitor: the raylet kills retriable tasks under memory pressure.
+
+reference: src/ray/common/memory_monitor.h:52 (node used-memory sampling +
+OOM-retriable task kills); the surfaced error after max retries is
+ray.exceptions.OutOfMemoryError in the reference — here
+ray_tpu.OutOfMemoryError.
+"""
+
+import time
+
+import psutil
+import pytest
+
+import ray_tpu
+from ray_tpu._private.config import RayTpuConfig, global_config, set_global_config
+
+
+@pytest.fixture
+def oom_cluster():
+    """Single-node cluster whose memory threshold sits just above current
+    node usage, so one deliberately-hungry task trips the monitor without
+    destabilising the host."""
+    saved = global_config()
+    cfg = RayTpuConfig()
+    used_frac = psutil.virtual_memory().percent / 100.0
+    cfg.memory_usage_threshold = min(used_frac + 0.03, 0.97)
+    cfg.memory_monitor_refresh_ms = 100
+    set_global_config(cfg)
+    w = ray_tpu.init(num_cpus=2)
+    yield w, cfg
+    ray_tpu.shutdown()
+    set_global_config(saved)
+
+
+@pytest.mark.slow
+def test_memory_hog_killed_and_error_surfaced(oom_cluster):
+    w, cfg = oom_cluster
+    headroom = psutil.virtual_memory().total * 0.05
+
+    @ray_tpu.remote
+    def hog(nbytes):
+        # allocate enough to cross the threshold, then linger so the
+        # monitor's next sample sees it
+        buf = bytearray(int(nbytes))
+        for i in range(0, len(buf), 4096):
+            buf[i] = 1  # fault the pages in
+        time.sleep(30)
+        return len(buf)
+
+    ref = hog.options(max_retries=1).remote(headroom)
+    with pytest.raises(ray_tpu.OutOfMemoryError):
+        ray_tpu.get(ref, timeout=120)
+
+
+@pytest.mark.slow
+def test_innocent_tasks_survive_oom_kill(oom_cluster):
+    """Only the newest retriable task is killed; other work completes."""
+    w, cfg = oom_cluster
+    headroom = psutil.virtual_memory().total * 0.05
+
+    @ray_tpu.remote
+    def steady(x):
+        time.sleep(1.0)
+        return x + 1
+
+    steady_refs = [steady.remote(i) for i in range(3)]
+    time.sleep(0.5)  # steady tasks lease first -> hog is the newest lease
+
+    @ray_tpu.remote
+    def hog(nbytes):
+        buf = bytearray(int(nbytes))
+        for i in range(0, len(buf), 4096):
+            buf[i] = 1
+        time.sleep(30)
+        return len(buf)
+
+    hog_ref = hog.options(max_retries=0).remote(headroom)
+    assert ray_tpu.get(steady_refs, timeout=120) == [1, 2, 3]
+    with pytest.raises(ray_tpu.OutOfMemoryError):
+        ray_tpu.get(hog_ref, timeout=120)

@@ -1,0 +1,131 @@
+"""Serve ingress under concurrency (VERDICT r1 missing #6).
+
+reference: the uvicorn ASGI proxy (serve/_private/proxy.py:706,
+http_util.py:23-31) holds hundreds of concurrent requests and SSE streams;
+the round-1 stdlib ThreadingHTTPServer answered 500 under contention (the
+LLM schema tests flaked mid-suite). Pinned here: a burst of concurrent
+requests ALL succeed (overload queues, never errors), keep-alive reuses one
+connection, and several SSE streams progress concurrently.
+"""
+
+import http.client
+import json
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def echo_app(ray_start_regular):
+    @serve.deployment(num_replicas=2)
+    class Echo:
+        def _tokens(self):
+            for i in range(5):
+                time.sleep(0.02)
+                yield {"tok": i}
+
+        def __call__(self, payload=None):
+            if isinstance(payload, dict) and payload.get("stream"):
+                return self._tokens()
+            time.sleep(0.05)
+            return {"echo": payload}
+
+    handle = serve.run(Echo.bind(), name="echo")
+    host, port = serve.start_http_proxy(port=0)
+    serve.add_route("/echo", handle)
+    yield host, port
+    serve.shutdown()
+
+
+def _post(host, port, path, payload, timeout=90):
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    body = json.dumps(payload)
+    conn.request("POST", path, body=body,
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+@pytest.mark.slow
+def test_concurrent_burst_no_errors(echo_app):
+    host, port = echo_app
+    n = 60
+    statuses = [None] * n
+
+    def worker(i):
+        try:
+            status, data = _post(host, port, "/echo", {"i": i})
+            statuses[i] = (status, json.loads(data))
+        except Exception as e:  # noqa: BLE001
+            statuses[i] = ("exc", str(e))
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(n)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    elapsed = time.monotonic() - t0
+    bad = [s for s in statuses if not (isinstance(s, tuple) and s[0] == 200)]
+    assert not bad, f"{len(bad)} failures (first: {bad[:3]}) in {elapsed:.1f}s"
+    assert all(s[1]["echo"]["i"] == i for i, s in enumerate(statuses))
+
+
+@pytest.mark.slow
+def test_keep_alive_reuses_connection(echo_app):
+    host, port = echo_app
+    conn = http.client.HTTPConnection(host, port, timeout=60)
+    for i in range(5):
+        conn.request("POST", "/echo", body=json.dumps({"i": i}),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert json.loads(resp.read())["echo"]["i"] == i
+        # keep-alive: server must not close between requests
+        assert resp.getheader("Connection", "").lower() == "keep-alive"
+    conn.close()
+
+
+@pytest.mark.slow
+def test_concurrent_sse_streams(echo_app):
+    host, port = echo_app
+    n = 8
+    results = [None] * n
+
+    def stream(i):
+        conn = http.client.HTTPConnection(host, port, timeout=90)
+        conn.request("POST", "/echo", body=json.dumps({"stream": True}),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        toks = []
+        buf = b""
+        while True:
+            chunk = resp.read(1)
+            if not chunk:
+                break
+            buf += chunk
+            while b"\n\n" in buf:
+                frame, buf = buf.split(b"\n\n", 1)
+                if frame.startswith(b"data: "):
+                    data = frame[len(b"data: "):]
+                    if data == b"[DONE]":
+                        conn.close()
+                        results[i] = toks
+                        return
+                    toks.append(json.loads(data))
+        results[i] = toks
+
+    threads = [threading.Thread(target=stream, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    for i, toks in enumerate(results):
+        assert toks is not None and [t["tok"] for t in toks] == list(range(5)), (i, toks)
