@@ -32,6 +32,9 @@ class RayTpuConfig:
     worker_register_timeout_s: float = 30.0
     actor_creation_timeout_s: float = 120.0
     gcs_snapshot_interval_s: float = 1.0
+    # grace for a finished stream's in-flight item delivery before the
+    # consumer declares it lost (ObjectRefGenerator)
+    streaming_item_grace_s: float = 30.0
     # periodic re-subscribe heals pubsub across GCS restarts and transient
     # connect-failure evictions (Subscribe is idempotent)
     resubscribe_interval_s: float = 5.0

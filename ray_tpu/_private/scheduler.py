@@ -14,6 +14,7 @@ spillback-based exactly like the reference.
 from __future__ import annotations
 
 import random
+import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -67,20 +68,31 @@ class ClusterResourceScheduler:
     def __init__(self, local_node_id: Optional[NodeID] = None):
         self.local_node_id = local_node_id
         self.nodes: Dict[NodeID, NodeResources] = {}
+        # guards the nodes MAP (RPC threads add/remove while the scheduling
+        # thread iterates — dict-size-changed races otherwise); the
+        # NodeResources values stay mutable-in-place (GIL-atomic swaps)
+        self._nodes_lock = threading.Lock()
         self._rng = random.Random(0xA11CE)
 
     # -- view maintenance --------------------------------------------------
 
     def add_or_update_node(self, node_id: NodeID, resources: NodeResources):
-        self.nodes[node_id] = resources
+        with self._nodes_lock:
+            self.nodes[node_id] = resources
 
     def update_available(self, node_id: NodeID, available: Dict[str, float]):
-        node = self.nodes.get(node_id)
+        with self._nodes_lock:
+            node = self.nodes.get(node_id)
         if node is not None:
             node.available = ResourceSet(available)
 
     def remove_node(self, node_id: NodeID):
-        self.nodes.pop(node_id, None)
+        with self._nodes_lock:
+            self.nodes.pop(node_id, None)
+
+    def _nodes_snapshot(self) -> Dict[NodeID, NodeResources]:
+        with self._nodes_lock:
+            return dict(self.nodes)
 
     # -- selection ---------------------------------------------------------
 
@@ -93,7 +105,8 @@ class ClusterResourceScheduler:
     ) -> Optional[NodeID]:
         strategy = strategy or SchedulingStrategy()
         if strategy.kind == "node_affinity":
-            node = self.nodes.get(strategy.node_id)
+            with self._nodes_lock:
+                node = self.nodes.get(strategy.node_id)
             if node is not None and node.feasible(demand):
                 if not requires_available or node.can_allocate(demand):
                     return strategy.node_id
@@ -113,7 +126,7 @@ class ClusterResourceScheduler:
     def _feasible(self, demand: ResourceSet, labels) -> List[Tuple[NodeID, NodeResources]]:
         return [
             (nid, n)
-            for nid, n in self.nodes.items()
+            for nid, n in self._nodes_snapshot().items()
             if n.feasible(demand) and n.matches_labels(labels)
         ]
 
@@ -186,7 +199,7 @@ class ClusterResourceScheduler:
         """
         nodes = {
             nid: _MutableNode(n)
-            for nid, n in self.nodes.items()
+            for nid, n in self._nodes_snapshot().items()
             if slice_label is None or n.labels.get("ray.io/tpu-slice-name") == slice_label
         }
         if strategy == "STRICT_PACK":

@@ -154,7 +154,8 @@ class ObjectRefGenerator:
                     # in-flight delivery a grace window, then fail loudly
                     # instead of hanging
                     if missing_deadline is None:
-                        missing_deadline = time.monotonic() + 30.0
+                        missing_deadline = (time.monotonic()
+                                            + global_config().streaming_item_grace_s)
                     elif time.monotonic() > missing_deadline:
                         raise ObjectLostError(
                             f"streamed item {self._i + 1} of "
@@ -174,6 +175,7 @@ class ObjectRefGenerator:
         if w is None or w.shutting_down:
             return
         self._w = None
+        plasma_nodes: Dict[Tuple, list] = {}
         with w._store_lock:
             finished = (self._anchor in w.memory_store
                         or self._anchor in w.object_errors)
@@ -187,11 +189,23 @@ class ObjectRefGenerator:
             while True:
                 oid = ObjectID.from_task(self._task_id, i)
                 found = (w.memory_store.pop(oid, None) is not None)
-                found |= bool(w.object_locations.pop(oid, None))
+                locs = w.object_locations.pop(oid, None)
+                if locs:
+                    found = True
+                    for addr in locs:
+                        plasma_nodes.setdefault(tuple(addr), []).append(oid)
                 found |= (w.object_errors.pop(oid, None) is not None)
                 if not found and (count is None or i > count):
                     break
                 i += 1
+        # unconsumed plasma-resident items: free them on their raylets the
+        # same way the normal release path does (otherwise the producer-side
+        # allocations linger until LRU pressure)
+        for addr, oids in plasma_nodes.items():
+            try:
+                w.pool.get(addr).notify("PlasmaFree", {"object_ids": oids})
+            except Exception:  # noqa: BLE001
+                pass
 
     def __del__(self):
         try:
@@ -385,7 +399,10 @@ class CoreWorker:
         # KeyboardInterrupt can never land in a LATER, uncancelled task
         self._exec_thread_id: Optional[int] = None
         self._exec_state_lock = threading.Lock()
-        self._store_lock = threading.Lock()
+        # RLock: ObjectRefGenerator.__del__ -> close() can be triggered by
+        # GC inside a _store_lock critical section (allocations happen under
+        # the lock); reentrancy beats a finalizer self-deadlock
+        self._store_lock = threading.RLock()
         self._store_cv = threading.Condition(self._store_lock)
 
         self.reference_counter = ReferenceCounter(self)
@@ -1228,6 +1245,7 @@ class CoreWorker:
     def _execute_task(self, req, reply_token):
         spec: TaskSpec = req["spec"]
         lease: dict = req["lease"]
+        replied = False
         try:
             self._record_exec_event(spec)
             bind_visible_accelerators(lease.get("resource_instances"))
@@ -1246,10 +1264,24 @@ class CoreWorker:
                 with self._exec_state_lock:
                     self.current_task_id = None
                     self._exec_thread_id = None
+            # a cancel KI injected during fn() may still be UNDELIVERED
+            # (PyThreadState_SetAsyncExc fires at a later bytecode check);
+            # give it a safe runway here so it cannot land mid-send_reply
+            # and produce a second reply on the same token
+            try:
+                for _ in range(2000):
+                    pass
+            except KeyboardInterrupt:
+                pass  # task already completed; the ok reply still goes out
             self.server.send_reply(reply_token, {"status": "ok", "returns": returns})
+            replied = True
         except KeyboardInterrupt:
-            # injected by HandleCancelTask (reference: cancelled tasks raise
-            # TaskCancelledError at the caller)
+            # injected by HandleCancelTask. PyThreadState_SetAsyncExc delivery
+            # is unbounded: the interrupt may land AFTER the ok reply was sent
+            # — swallow it then (a second reply on the same token would
+            # corrupt the caller's view of the task)
+            if replied:
+                return
             self.server.send_reply(
                 reply_token,
                 {"status": "error",
@@ -1273,7 +1305,7 @@ class CoreWorker:
         finally:
             try:
                 self.raylet.notify("ReturnWorker", {"lease_id": lease.get("lease_id")})
-            except Exception:  # noqa: BLE001
+            except BaseException:  # noqa: BLE001 (incl. late-delivered cancel KI)
                 pass
             self.flush_task_events()
 
@@ -1349,13 +1381,20 @@ class CoreWorker:
         the consumer already abandoned the stream)."""
         oid, kind, payload = req["item"]
         with self._store_lock:
-            if req.get("task_id") in self._closed_streams:
-                return True
-            if kind == "inline":
-                self.memory_store[oid] = serialization.loads_inline(payload)
-            else:
-                self.object_locations[oid].add(tuple(payload))
-            self._store_cv.notify_all()
+            closed = req.get("task_id") in self._closed_streams
+            if not closed:
+                if kind == "inline":
+                    self.memory_store[oid] = serialization.loads_inline(payload)
+                else:
+                    self.object_locations[oid].add(tuple(payload))
+                self._store_cv.notify_all()
+        if closed and kind != "inline":
+            # the consumer is gone; free the plasma copy immediately
+            try:
+                self.pool.get(tuple(payload)).notify(
+                    "PlasmaFree", {"object_ids": [oid]})
+            except Exception:  # noqa: BLE001
+                pass
         return True
 
     # ------------------------------------------------------------------

@@ -46,6 +46,7 @@ from ray_tpu.dag.dag_node import (
     extract_input,
 )
 from ray_tpu.experimental.channel import ChannelClosed, ChannelFull, ShmChannel
+from ray_tpu.experimental.channel.xla_tensor_channel import XlaTensorChannel
 
 logger = logging.getLogger(__name__)
 
@@ -280,6 +281,19 @@ class CompiledDAG:
             self._channels.append(ch)
             return ch
 
+        def new_edge_chan(up_node: ClassMethodNode):
+            # device-tensor edges (with_tensor_transport) move array leaves
+            # via the Communicator instead of the shm slot (reference:
+            # torch_tensor_accelerator_channel.py selected by type hint)
+            transport = getattr(up_node, "_tensor_transport", None)
+            if transport is None:
+                return new_chan()
+            ch = XlaTensorChannel(
+                group_name=f"dag-p2p-{up_node._stable_uuid}-{len(self._channels)}",
+                backend=transport, capacity=self._buffer)
+            self._channels.append(ch)
+            return ch
+
         # edges: producer node -> consumer actors (dedup); input -> actors
         edge_chan: Dict[Tuple[int, str], ShmChannel] = {}
         input_actors: List[str] = []
@@ -296,7 +310,7 @@ class CompiledDAG:
                 elif isinstance(up, ClassMethodNode):
                     up_k = _actor_key(up._actor_handle)
                     if up_k != k and (up._stable_uuid, k) not in edge_chan:
-                        edge_chan[(up._stable_uuid, k)] = new_chan()
+                        edge_chan[(up._stable_uuid, k)] = new_edge_chan(up)
 
         self._input_channels = {k: new_chan() for k in input_actors}
 

@@ -29,6 +29,19 @@ class DAGNode:
         self._bound_args = tuple(args)
         self._bound_kwargs = dict(kwargs or {})
         self._stable_uuid = next(_node_counter)
+        self._tensor_transport: Optional[str] = None
+
+    def with_tensor_transport(self, transport: str = "auto") -> "DAGNode":
+        """Move this node's output to downstream DAG actors through the
+        device-tensor channel: array leaves ride the registered Communicator
+        (xla/ICI on TPU, store off-TPU), structure rides shm (reference:
+        with_tensor_transport / TorchTensorType type hints ->
+        torch_tensor_accelerator_channel.py). transport: "auto" | "xla" |
+        "store" | "shm" ("shm" = plain shared-memory channel)."""
+        if transport not in ("auto", "xla", "store", "shm"):
+            raise ValueError(f"unknown tensor transport {transport!r}")
+        self._tensor_transport = None if transport == "shm" else transport
+        return self
 
     # -- graph introspection ------------------------------------------------
 

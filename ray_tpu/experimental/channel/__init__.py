@@ -15,12 +15,14 @@ from ray_tpu.experimental.channel.shared_memory_channel import (
     IntraProcessChannel,
     ShmChannel,
 )
+from ray_tpu.experimental.channel.xla_tensor_channel import XlaTensorChannel
 
 __all__ = [
     "ChannelClosed",
     "ChannelFull",
     "IntraProcessChannel",
     "ShmChannel",
+    "XlaTensorChannel",
     "Communicator",
     "CollectiveGroupCommunicator",
     "get_accelerator_context",

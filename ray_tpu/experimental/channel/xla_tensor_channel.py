@@ -1,0 +1,169 @@
+"""Device-tensor channel for compiled graphs.
+
+reference: python/ray/experimental/channel/torch_tensor_accelerator_channel.py
+— the reference moves tensors between DAG actors over NCCL p2p while the
+non-tensor structure rides the mutable-plasma metadata channel. TPU-native
+equivalent: array leaves of the value travel through the registered
+Communicator (AcceleratorContext — ``xla`` backend on TPU, where p2p between
+two processes' chips rides ICI via a two-device mesh program; ``store``
+backend off-TPU), and the pytree structure + scalars ride the ShmChannel.
+
+Selected per-edge by ``DAGNode.with_tensor_transport()`` at
+``experimental_compile`` time (reference: TorchTensorType type hints).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Optional
+
+import numpy as np
+
+from ray_tpu.experimental.channel.shared_memory_channel import ShmChannel
+
+logger = logging.getLogger(__name__)
+
+
+class _ArrayPlaceholder:
+    """Marks where an array leaf was removed from the pytree structure."""
+
+    __slots__ = ("index", "shape", "dtype")
+
+    def __init__(self, index: int, shape, dtype):
+        self.index = index
+        self.shape = shape
+        self.dtype = dtype
+
+
+def _is_array(x) -> bool:
+    if isinstance(x, np.ndarray):
+        return True
+    try:
+        import jax
+
+        return isinstance(x, jax.Array)
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def _split_arrays(value):
+    """(structure-with-placeholders, [np arrays]) — arrays in leaf order."""
+    import jax
+
+    arrays = []
+
+    def rep(x):
+        if _is_array(x):
+            arr = np.asarray(x)
+            ph = _ArrayPlaceholder(len(arrays), arr.shape, arr.dtype)
+            arrays.append(arr)
+            return ph
+        return x
+
+    structure = jax.tree_util.tree_map(rep, value)
+    return structure, arrays
+
+
+def _join_arrays(structure, arrays):
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda x: arrays[x.index] if isinstance(x, _ArrayPlaceholder) else x,
+        structure,
+        is_leaf=lambda x: isinstance(x, _ArrayPlaceholder),
+    )
+
+
+def _resolve_backend(backend: str) -> str:
+    if backend != "auto":
+        return backend
+    from ray_tpu.experimental.channel.accelerator_context import _detect_default
+
+    return "xla" if _detect_default() == "tpu" else "store"
+
+
+class XlaTensorChannel:
+    """One DAG edge: metadata via shm, array leaves via the Communicator.
+
+    Writer is rank 0, reader rank 1 of a dedicated 2-member collective
+    group; both sides lazily join at first use (store-actor rendezvous, the
+    same pattern as the reference's NCCL communicator bootstrap).
+    """
+
+    WRITER, READER = 0, 1
+
+    def __init__(self, group_name: str, backend: str = "auto",
+                 capacity: Optional[int] = None,
+                 _meta: Optional[ShmChannel] = None):
+        self._group = group_name
+        self._backend = backend
+        self._meta = _meta or ShmChannel(
+            num_readers=1, capacity=capacity or 1024 * 1024)
+        self._comm = None
+        self._role: Optional[int] = None
+        self._comm_lock = threading.Lock()
+
+    # channels travel by value descriptor, like ShmChannel
+    def __reduce__(self):
+        return (XlaTensorChannel, (self._group, self._backend, None, self._meta))
+
+    @property
+    def name(self):
+        return self._meta.name
+
+    def _communicator(self, role: int):
+        with self._comm_lock:
+            if self._comm is None:
+                from ray_tpu.experimental.channel.accelerator_context import (
+                    get_accelerator_context,
+                )
+
+                cls = get_accelerator_context()
+                self._comm = cls(2, role, backend=_resolve_backend(self._backend),
+                                 group_name=self._group)
+                self._role = role
+            return self._comm
+
+    # -- writer -------------------------------------------------------------
+
+    def write(self, value: Any, timeout: Optional[float] = None):
+        structure, arrays = _split_arrays(value)
+        # metadata first: the reader learns how many arrays to receive
+        self._meta.write((structure, len(arrays)), timeout)
+        if arrays:
+            comm = self._communicator(self.WRITER)
+            for arr in arrays:
+                comm.send(arr, self.READER)
+
+    # -- reader -------------------------------------------------------------
+
+    def register_reader(self, idx: int):
+        self._meta.register_reader(idx)
+
+    def read(self, timeout: Optional[float] = None) -> Any:
+        structure, n = self._meta.read(timeout)
+        if not n:
+            return structure
+        comm = self._communicator(self.READER)
+        arrays = [comm.recv(self.WRITER) for _ in range(n)]
+        return _join_arrays(structure, arrays)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    @property
+    def closed(self) -> bool:
+        return self._meta.closed
+
+    def close(self):
+        self._meta.close()
+
+    def destroy(self):
+        self._meta.destroy()
+        with self._comm_lock:
+            if self._comm is not None:
+                try:
+                    self._comm.destroy()
+                except Exception:  # noqa: BLE001
+                    pass
+                self._comm = None

@@ -23,13 +23,29 @@ logger = logging.getLogger(__name__)
 CONTROLLER_NAME = "_serve_controller"
 
 
+# serialized_callable bytes -> sha1 hex. Memoized: the reconcile loop
+# hashes every deployment each tick, and cloudpickle bytes are stable within
+# one controller process (the bytes object itself is stored once). Across
+# processes cloudpickle of identical source may differ — a redeploy from a
+# new driver then conservatively restarts replicas (reference behavior:
+# config-version based; use user_config for restart-free updates).
+_digest_cache: dict = {}
+
+
 def _cfg_hash(cfg: dict) -> str:
     """Identity of a deployment's code+config (replicas restart when it
     changes; num_replicas alone does not force a restart)."""
     import hashlib
     import pickle
 
-    key = (cfg.get("serialized_callable"), cfg.get("init_args"),
+    blob = cfg.get("serialized_callable") or b""
+    digest = _digest_cache.get(blob)
+    if digest is None:
+        digest = hashlib.sha1(blob).hexdigest()
+        if len(_digest_cache) > 4096:
+            _digest_cache.clear()
+        _digest_cache[blob] = digest
+    key = (digest, cfg.get("init_args"),
            cfg.get("init_kwargs"), cfg.get("user_config"),
            cfg.get("ray_actor_options"), cfg.get("max_ongoing_requests"))
     return hashlib.sha1(pickle.dumps(key)).hexdigest()

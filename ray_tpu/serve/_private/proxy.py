@@ -19,6 +19,7 @@ import asyncio
 import json
 import logging
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, Optional, Tuple
 
@@ -219,23 +220,28 @@ class _AsyncProxy:
         drained on the executor; frames hop to the event loop via a queue so
         many streams interleave on one loop."""
         loop = asyncio.get_running_loop()
-        q: asyncio.Queue = asyncio.Queue(maxsize=256)
+        q: asyncio.Queue = asyncio.Queue()  # soft-bounded by put_from_thread
         stop = threading.Event()
         _END = object()
 
         def put_from_thread(item) -> bool:
-            """Enqueue onto the loop, re-checking ``stop`` so an abandoned
-            stream can never park this thread on a full queue forever."""
-            while not stop.is_set():
-                fut = asyncio.run_coroutine_threadsafe(q.put(item), loop)
-                try:
-                    fut.result(timeout=0.5)
-                    return True
-                except TimeoutError:
-                    fut.cancel()
-                except Exception:  # noqa: BLE001 (loop closed, etc.)
+            """Enqueue onto the loop exactly once. call_soon_threadsafe +
+            put_nowait never blocks and never double-delivers (a blocking
+            q.put + cancel-on-timeout could complete AND be retried). The
+            soft capacity check bounds memory against a slow client; qsize
+            from another thread is approximate, which only overshoots by a
+            frame or two."""
+            while q.qsize() >= 256:
+                if stop.is_set():
                     return False
-            return False
+                time.sleep(0.02)
+            if stop.is_set():
+                return False
+            try:
+                loop.call_soon_threadsafe(q.put_nowait, item)
+                return True
+            except RuntimeError:  # loop closed
+                return False
 
         def pump():
             try:

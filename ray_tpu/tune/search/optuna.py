@@ -1,14 +1,20 @@
-"""Optuna wrapper (reference: tune/search/optuna/optuna_search.py).
+"""Optuna-COMPATIBLE searchers (reference: tune/search/optuna/optuna_search.py).
 
-optuna is not in this environment's image; the wrapper keeps API parity and
-degrades with a clear error pointing at the native [[TPESearcher]] (optuna's
-default sampler is TPE, so the native implementation is the drop-in)."""
+These are NOT bindings to the optuna/hyperopt packages: suggestions come
+from the native [[TPESearcher]] (the same TPE algorithm both packages
+default to). The import gate exists purely so code written against the
+reference fails with the same error when the package is missing; when the
+package IS present, a warning states that the native sampler is used.
+Count Tune's search parity on TPESearcher, not on these names."""
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Dict, Optional
 
 from ray_tpu.tune.search.searcher import Searcher
+
+logger = logging.getLogger(__name__)
 
 
 class OptunaSearch(Searcher):
@@ -21,8 +27,9 @@ class OptunaSearch(Searcher):
                 "optuna is not installed. Use ray_tpu.tune.search.tpe."
                 "TPESearcher — the native implementation of optuna's default "
                 "TPE sampler — or install optuna.") from e
-        # If optuna IS present, delegate to the native TPE over the same
-        # space (sampler parity) rather than shipping a second integration.
+        logger.warning(
+            "OptunaSearch delegates to the native TPESearcher (optuna's "
+            "default sampler); optuna's own samplers/pruners are not used.")
         from ray_tpu.tune.search.tpe import TPESearcher
 
         self._impl = TPESearcher(space, metric=metric, mode=mode, **kwargs)
@@ -52,6 +59,9 @@ class HyperOptSearch(OptunaSearch):
                 "hyperopt is not installed. Use ray_tpu.tune.search.tpe."
                 "TPESearcher (hyperopt's algorithm is TPE) or install "
                 "hyperopt.") from e
+        logger.warning(
+            "HyperOptSearch delegates to the native TPESearcher (the same "
+            "TPE algorithm); hyperopt itself is not used.")
         from ray_tpu.tune.search.tpe import TPESearcher
 
         self._impl = TPESearcher(space, metric=metric, mode=mode, **kwargs)

@@ -357,9 +357,10 @@ class TuneController:
                 self._search_exhausted = True
                 return
             if suggestion is None:
-                # searcher wants to wait for running trials; if nothing is
-                # running it can never unblock — treat as exhausted
-                if not any(t.status in (PENDING, RUNNING) for t in self.trials):
+                # searcher wants to wait for in-flight trials; PAUSED trials
+                # (PBT exploit models pauses) hold ConcurrencyLimiter slots
+                # and WILL resume, so they count as in flight too
+                if not any(t.status in (PENDING, RUNNING, PAUSED) for t in self.trials):
                     logger.warning("searcher returned None with no trials "
                                    "in flight; ending search")
                     self._search_exhausted = True
