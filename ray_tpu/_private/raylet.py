@@ -136,6 +136,8 @@ class Raylet:
         # worker address -> exit reason ("oom"); owners query this to turn a
         # ConnectionLost into OutOfMemoryError (reference: memory_monitor.h:52)
         self._exit_reasons: Dict[Tuple[str, int], str] = {}
+        # oid -> monotonic start of an in-flight inbound push (push plane)
+        self._push_receiving: Dict[ObjectID, float] = {}
         self._object_owners: Dict[ObjectID, Tuple[str, int]] = {}
 
         # Register with GCS; receive cluster config + view.
@@ -843,6 +845,129 @@ class Raylet:
 
     def HandleReadObjectChunk(self, req):
         return self.store.read_object_bytes(req["object_id"], req["offset"], req["length"])
+
+    # ------------------------------------------------------------------
+    # Push plane + broadcast fan-out (reference: push_manager.h:27 — the
+    # owner initiates chunked pushes instead of N nodes pull-storming one
+    # holder; broadcast propagates down a binary tree so every node uploads
+    # to at most two children: the 1-GiB/50-node envelope shape)
+    # ------------------------------------------------------------------
+
+    def _push_to(self, target_addr: Tuple[str, int], oid: ObjectID,
+                 owner_addr) -> bool:
+        """Sender-driven chunked upload of a local sealed object."""
+        chunk = global_config().object_transfer_chunk_bytes
+        size = self.store.object_size(oid)
+        if size is None:
+            return False
+        try:
+            cli = self.pool.get(tuple(target_addr))
+            begin = cli.call("ReceivePushBegin", {"object_id": oid, "size": size})
+            if begin == "have":
+                return True
+            off = 0
+            while off < size:
+                data = self.store.read_object_bytes(oid, off, chunk)
+                if data is None:
+                    return False
+                cli.call("ReceivePushChunk",
+                         {"object_id": oid, "offset": off, "data": data})
+                off += len(data)
+            cli.call("ReceivePushEnd",
+                     {"object_id": oid, "owner_addr": tuple(owner_addr) if owner_addr else None})
+            return True
+        except Exception:  # noqa: BLE001
+            logger.exception("push of %s to %s failed", oid, target_addr)
+            return False
+
+    _PUSH_STALE_S = 60.0
+
+    def HandleReceivePushBegin(self, req):
+        oid = req["object_id"]
+        if self.store.contains(oid):
+            return "have"
+        now = time.monotonic()
+        with self._lock:
+            started = self._push_receiving.get(oid)
+            if started is not None and now - started < self._PUSH_STALE_S:
+                return "busy"  # another push in flight; sender falls back
+            if started is not None:
+                # the previous sender died mid-push: reclaim the unsealed
+                # allocation so this node isn't blocked forever
+                try:
+                    self.store.free(oid)
+                except Exception:  # noqa: BLE001
+                    pass
+            self._push_receiving[oid] = now
+        self.store.create(oid, req["size"])
+        return "go"
+
+    def HandleReceivePushChunk(self, req):
+        self.store.write_into(req["object_id"], req["offset"], req["data"])
+        return True
+
+    def HandleReceivePushEnd(self, req):
+        oid = req["object_id"]
+        self.store.seal(oid)
+        self.store.mark_secondary(oid)
+        with self._lock:
+            self._push_receiving.pop(oid, None)
+        owner = req.get("owner_addr")
+        if owner:
+            with self._lock:
+                self._object_owners[oid] = tuple(owner)
+            try:
+                self.pool.get(tuple(owner)).notify(
+                    "AddObjectLocation",
+                    {"object_id": oid, "node_addr": self.server.address})
+            except Exception:  # noqa: BLE001
+                pass
+        return True
+
+    def HandleBroadcastObject(self, req):
+        """Push the object to the first node of each half of ``targets``,
+        then delegate the halves — a binary spanning tree rooted here.
+        Requires the object to be local (the parent pushed it first)."""
+        oid: ObjectID = req["object_id"]
+        owner = req.get("owner_addr")
+        targets = [tuple(t) for t in req.get("targets", [])
+                   if tuple(t) != self.server.address]
+        if not self.store.contains(oid):
+            return {"ok": False, "reason": "object not local"}
+        if not targets:
+            return {"ok": True, "pushed": 0}
+        pushed = 0
+        halves = [targets[0::2], targets[1::2]]
+        subcalls = []
+        for half in halves:
+            if not half:
+                continue
+            head, rest = half[0], half[1:]
+            if self._push_to(head, oid, owner):
+                pushed += 1
+                if rest:
+                    subcalls.append((head, rest))
+            else:
+                # absorb the failed head's subtree locally (flat fallback)
+                for t in rest:
+                    pushed += 1 if self._push_to(t, oid, owner) else 0
+        for head, rest in subcalls:
+            delegated = False
+            try:
+                sub = self.pool.get(head).call(
+                    "BroadcastObject",
+                    {"object_id": oid, "owner_addr": owner, "targets": rest},
+                    timeout=None)
+                if isinstance(sub, dict) and sub.get("ok"):
+                    pushed += sub.get("pushed", 0)
+                    delegated = True
+            except Exception:  # noqa: BLE001
+                logger.exception("broadcast delegation to %s failed", head)
+            if not delegated:
+                # absorb the orphaned subtree locally so no node is skipped
+                for t in rest:
+                    pushed += 1 if self._push_to(t, oid, owner) else 0
+        return {"ok": True, "pushed": pushed}
 
     # ------------------------------------------------------------------
     # Introspection

@@ -761,6 +761,40 @@ class CoreWorker:
                 return {"nodes": [list(a) for a in locs]}
         return None  # still pending
 
+    def broadcast_object(self, ref: "ObjectRef") -> int:
+        """Proactively replicate a plasma object to every ALIVE node via the
+        raylet push plane's spanning fan-out (reference: push_manager.h:27;
+        the 1-GiB broadcast envelope). Returns the number of pushes.
+        Inline (in-band) objects need no broadcast and return 0."""
+        deadline = time.monotonic() + global_config().gcs_rpc_timeout_s
+        while True:
+            if ref.owner_addr == self.address:
+                loc = self.HandleGetObjectLocations({"object_id": ref.id})
+            else:
+                loc = self.pool.get(tuple(ref.owner_addr)).call(
+                    "GetObjectLocations", {"object_id": ref.id})
+            if loc is not None:
+                break  # produced (inline or plasma)
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"broadcast_object: {ref.id} still pending after "
+                    "gcs_rpc_timeout_s — is its producing task running?")
+            time.sleep(0.05)
+        if not isinstance(loc, dict) or not loc.get("nodes"):
+            return 0
+        have = {tuple(a) for a in loc["nodes"]}
+        source = tuple(loc["nodes"][0])
+        nodes = self.gcs.call("GetAllNodeInfo", {})
+        targets = [tuple(n["address"]) for n in nodes
+                   if n["state"] == "ALIVE" and tuple(n["address"]) not in have]
+        if not targets:
+            return 0
+        rep = self.pool.get(source).call(
+            "BroadcastObject",
+            {"object_id": ref.id, "owner_addr": tuple(ref.owner_addr),
+             "targets": targets}, timeout=None)
+        return rep.get("pushed", 0) if isinstance(rep, dict) else 0
+
     def HandleAddObjectLocation(self, req):
         with self._store_lock:
             self.object_locations[req["object_id"]].add(tuple(req["node_addr"]))

@@ -1,1 +1,11 @@
 """Experimental subsystems: compiled-graph channels, device-resident objects."""
+
+
+def broadcast_object(ref) -> int:
+    """Replicate a plasma object to every ALIVE node through the raylet
+    push plane (owner-initiated chunked pushes down a binary spanning tree —
+    reference: src/ray/object_manager/push_manager.h:27). Returns the number
+    of nodes pushed to; in-band objects return 0."""
+    from ray_tpu import get_global_worker
+
+    return get_global_worker().broadcast_object(ref)

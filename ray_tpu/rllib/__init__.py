@@ -10,6 +10,17 @@ vectorized numpy envs.
 """
 
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig, PPO, PPOConfig
+from ray_tpu.rllib.appo import APPO, APPOConfig, APPOLearner
+from ray_tpu.rllib.connectors import (
+    ActionClip,
+    Connector,
+    ConnectorPipeline,
+    EpsilonGreedy,
+    FrameStack,
+    ObsNormalizer,
+    ObsScaler,
+    SoftmaxSample,
+)
 from ray_tpu.rllib.core.rl_module import RLModule
 from ray_tpu.rllib.dqn import DQN, DQNConfig, DQNLearner
 from ray_tpu.rllib.env import CartPoleEnv, EnvSpec, PendulumEnv, register_env
@@ -21,8 +32,19 @@ from ray_tpu.rllib.replay import ReplayBuffer
 from ray_tpu.rllib.sac import SAC, SACConfig, SACLearner, SACModule
 
 __all__ = [
+    "ActionClip",
     "Algorithm",
     "AlgorithmConfig",
+    "APPO",
+    "APPOConfig",
+    "APPOLearner",
+    "Connector",
+    "ConnectorPipeline",
+    "EpsilonGreedy",
+    "FrameStack",
+    "ObsNormalizer",
+    "ObsScaler",
+    "SoftmaxSample",
     "BC",
     "BCConfig",
     "CartPoleEnv",

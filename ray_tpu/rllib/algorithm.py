@@ -24,6 +24,13 @@ class AlgorithmConfig:
     gamma: float = 0.99
     hidden: tuple = (64, 64)
     seed: int = 0
+    # connector-pipeline factories, called once per runner (reference:
+    # AlgorithmConfig.env_to_module_connector / module_to_env_connector)
+    env_to_module_connector: Optional[Callable] = None
+    module_to_env_connector: Optional[Callable] = None
+    # module input dim when an env_to_module pipeline CHANGES dimensionality
+    # (e.g. FrameStack(k) => k * env_obs_dim); None = the raw env obs_dim
+    module_obs_dim: Optional[int] = None
 
     # builder-style setters (reference: AlgorithmConfig fluent API)
     def environment(self, env) -> "AlgorithmConfig":
@@ -33,7 +40,9 @@ class AlgorithmConfig:
 
     def env_runners(self, *, num_env_runners: Optional[int] = None,
                     num_envs_per_runner: Optional[int] = None,
-                    rollout_fragment_length: Optional[int] = None) -> "AlgorithmConfig":
+                    rollout_fragment_length: Optional[int] = None,
+                    env_to_module_connector: Optional[Callable] = None,
+                    module_to_env_connector: Optional[Callable] = None) -> "AlgorithmConfig":
         out = copy.copy(self)
         if num_env_runners is not None:
             out.num_env_runners = num_env_runners
@@ -41,6 +50,10 @@ class AlgorithmConfig:
             out.num_envs_per_runner = num_envs_per_runner
         if rollout_fragment_length is not None:
             out.rollout_fragment_length = rollout_fragment_length
+        if env_to_module_connector is not None:
+            out.env_to_module_connector = env_to_module_connector
+        if module_to_env_connector is not None:
+            out.module_to_env_connector = module_to_env_connector
         return out
 
     def training(self, **kwargs) -> "AlgorithmConfig":
@@ -84,17 +97,21 @@ class Algorithm:
         probe = make_env(config.env)
         self._spec = probe.spec
         module_spec = {
-            "spec": {"obs_dim": probe.spec.obs_dim,
+            "spec": {"obs_dim": config.module_obs_dim or probe.spec.obs_dim,
                      "num_actions": probe.spec.num_actions},
             "hidden": tuple(config.hidden),
         }
         self._learner = self._build_learner()
+        e2m = config.env_to_module_connector
+        m2e = config.module_to_env_connector
         self._runners = [
             ray_tpu.remote(EnvRunner).options(num_cpus=0.5).remote(
                 config.env, module_spec,
                 num_envs=config.num_envs_per_runner,
                 seed=config.seed + i,
-                rollout_fragment_length=config.rollout_fragment_length)
+                rollout_fragment_length=config.rollout_fragment_length,
+                env_to_module=e2m() if e2m is not None else None,
+                module_to_env=m2e() if m2e is not None else None)
             for i in range(config.num_env_runners)
         ]
         self._iteration = 0

@@ -1,0 +1,124 @@
+"""APPO: asynchronous PPO on the IMPALA actor-learner pipeline.
+
+reference: rllib/algorithms/appo/ — APPO keeps IMPALA's asynchrony (runners
+sample continuously under stale policies; the learner consumes whichever
+fragment lands first) but replaces IMPALA's plain policy gradient with the
+PPO clipped surrogate, computed on V-trace-corrected advantages against a
+periodically-synced TARGET policy, optionally with a KL penalty toward it.
+jax-native: the whole update (V-trace scan + clipped surrogate + adam) is
+one jitted program; the target sync is a pytree copy every
+``target_update_freq`` updates.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.core.rl_module import RLModule
+from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, vtrace
+
+
+@dataclasses.dataclass
+class APPOConfig(IMPALAConfig):
+    lr: float = 3e-4
+    clip_param: float = 0.3
+    use_kl_loss: bool = False
+    kl_coeff: float = 0.2
+    target_update_freq: int = 8  # learner updates between target syncs
+    max_grad_norm: float = 0.5
+
+    @property
+    def algo_class(self):
+        return APPO
+
+
+class APPOLearner:
+    def __init__(self, module: RLModule, cfg: APPOConfig):
+        self.module = module
+        self.cfg = cfg
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(cfg.max_grad_norm),
+            optax.adam(cfg.lr))
+        self.params = module.init(jax.random.PRNGKey(cfg.seed + 1))
+        self.target_params = jax.tree.map(lambda x: x, self.params)
+        self.opt_state = self.optimizer.init(self.params)
+        self._updates = 0
+        self._update = jax.jit(self._update_impl)
+
+    def _logp_values(self, params, batch):
+        T, B = batch["rewards"].shape
+        obs = batch["obs"].reshape(T * B, -1)
+        logits, values_flat = self.module.forward(params, obs)
+        logp_all = jax.nn.log_softmax(logits)
+        actions = batch["actions"].reshape(T * B)
+        logp = jnp.take_along_axis(
+            logp_all, actions[:, None], axis=1)[:, 0].reshape(T, B)
+        return logp, values_flat.reshape(T, B), logp_all
+
+    def _loss(self, params, target_params, batch):
+        cfg = self.cfg
+        logp, values, logp_all = self._logp_values(params, batch)
+        # V-trace targets/advantages from the TARGET policy (reference APPO:
+        # the target network decouples the correction from the live policy,
+        # keeping the surrogate's trust region meaningful under asynchrony)
+        tgt_logp, tgt_values, tgt_logp_all = self._logp_values(
+            target_params, batch)
+        vs, pg_adv = vtrace(
+            batch["logp"], jax.lax.stop_gradient(tgt_logp),
+            batch["rewards"], jax.lax.stop_gradient(tgt_values),
+            batch["bootstrap_value"], batch["dones"], cfg.gamma,
+            cfg.clip_rho, cfg.clip_c)
+        adv = (pg_adv - pg_adv.mean()) / (pg_adv.std() + 1e-8)
+        # PPO clipped surrogate against the BEHAVIOR logp from the runners
+        ratio = jnp.exp(logp - batch["logp"])
+        surr = jnp.minimum(
+            ratio * adv,
+            jnp.clip(ratio, 1 - cfg.clip_param, 1 + cfg.clip_param) * adv)
+        policy_loss = -jnp.mean(surr)
+        value_loss = 0.5 * jnp.mean((values - vs) ** 2)
+        entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+        total = (policy_loss + cfg.vf_coef * value_loss
+                 - cfg.entropy_coef * entropy)
+        aux = {"policy_loss": policy_loss, "value_loss": value_loss,
+               "entropy": entropy, "mean_ratio": jnp.mean(ratio)}
+        if cfg.use_kl_loss:
+            kl = jnp.mean(jnp.sum(
+                jnp.exp(tgt_logp_all) * (tgt_logp_all - logp_all), axis=-1))
+            total = total + cfg.kl_coeff * kl
+            aux["kl_to_target"] = kl
+        return total, aux
+
+    def _update_impl(self, params, target_params, opt_state, batch):
+        (_, aux), grads = jax.value_and_grad(self._loss, has_aux=True)(
+            params, target_params, batch)
+        updates, opt_state = self.optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, aux
+
+    def update(self, samples: Dict[str, np.ndarray]) -> Dict[str, float]:
+        jb = {k: jnp.asarray(v) for k, v in samples.items()}
+        self.params, self.opt_state, aux = self._update(
+            self.params, self.target_params, self.opt_state, jb)
+        self._updates += 1
+        if self._updates % self.cfg.target_update_freq == 0:
+            self.target_params = jax.tree.map(lambda x: x, self.params)
+        return {k: float(v) for k, v in aux.items()}
+
+    def get_params(self):
+        return self.params
+
+
+class APPO(IMPALA):
+    """The async train loop is IMPALA's verbatim (one in-flight fragment per
+    runner, per-runner refill with fresh weights); only the learner differs
+    (reference: appo.py subclasses Impala the same way)."""
+
+    def _build_learner(self):
+        cfg: APPOConfig = self.config  # type: ignore[assignment]
+        return APPOLearner(RLModule(self._spec, hidden=tuple(cfg.hidden)), cfg)

@@ -1,0 +1,197 @@
+"""Connector pipelines: pluggable transforms on the env<->module boundary.
+
+reference: rllib/connectors/ (ConnectorV2) — env-to-module pipelines
+preprocess observations before inference; module-to-env pipelines turn
+module outputs into environment actions. Both are ordered lists of small
+stateful callables that live INSIDE the EnvRunner (they ship to the runner
+actor at construction and run in its process, like the reference's
+connector state on EnvRunners).
+
+Env-to-module connectors: ``(obs [N, D]) -> obs' [N, D']``.
+Module-to-env connectors: ``(ctx dict) -> ctx`` where ctx carries
+``logits``, ``actions``, ``logp``, and ``rng`` — a connector typically
+fills or rewrites ``actions``/``logp``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+
+class Connector:
+    """Base class; connectors must be picklable (they travel to runners)."""
+
+    def __call__(self, x):
+        raise NotImplementedError
+
+    def transform(self, x):
+        """Apply WITHOUT advancing internal state — used for observations
+        seen out-of-stream (TD successor states, fragment-boundary
+        bootstraps) so stateful connectors don't double-ingest. Stateless
+        connectors inherit __call__."""
+        return self(x)
+
+
+class ConnectorPipeline(Connector):
+    """Ordered composition (reference: ConnectorPipelineV2)."""
+
+    def __init__(self, connectors: Optional[Sequence[Connector]] = None):
+        self.connectors: List[Connector] = list(connectors or [])
+
+    def append(self, connector: Connector) -> "ConnectorPipeline":
+        self.connectors.append(connector)
+        return self
+
+    def __call__(self, x):
+        for c in self.connectors:
+            x = c(x)
+        return x
+
+    def transform(self, x):
+        for c in self.connectors:
+            x = c.transform(x)
+        return x
+
+    def __len__(self):
+        return len(self.connectors)
+
+
+# ---------------------------------------------------------------------------
+# env-to-module
+# ---------------------------------------------------------------------------
+
+
+class ObsNormalizer(Connector):
+    """Running mean/std normalization (reference: MeanStdFilter connector).
+
+    State is per-runner (each runner tracks its own stream), matching the
+    reference's default of non-synchronized connector state.
+    """
+
+    def __init__(self, clip: float = 10.0, eps: float = 1e-8):
+        self.clip = clip
+        self.eps = eps
+        self.count = 0
+        self.mean: Optional[np.ndarray] = None
+        self.m2: Optional[np.ndarray] = None
+
+    def __call__(self, obs: np.ndarray) -> np.ndarray:
+        obs = np.asarray(obs, np.float32)
+        batch = obs.reshape(-1, obs.shape[-1])
+        if self.mean is None:
+            self.mean = np.zeros(batch.shape[-1], np.float64)
+            self.m2 = np.zeros(batch.shape[-1], np.float64)
+        for row in batch:  # Welford; fragment sizes are small
+            self.count += 1
+            delta = row - self.mean
+            self.mean += delta / self.count
+            self.m2 += delta * (row - self.mean)
+        return self.transform(obs)
+
+    def transform(self, obs: np.ndarray) -> np.ndarray:
+        obs = np.asarray(obs, np.float32)
+        if self.mean is None:
+            return obs
+        std = np.sqrt(self.m2 / max(self.count - 1, 1)) + self.eps
+        out = (obs - self.mean.astype(np.float32)) / std.astype(np.float32)
+        return np.clip(out, -self.clip, self.clip)
+
+
+class ObsScaler(Connector):
+    """Fixed affine transform (reference: simple lambda connectors)."""
+
+    def __init__(self, scale: float = 1.0, offset: float = 0.0):
+        self.scale = scale
+        self.offset = offset
+
+    def __call__(self, obs: np.ndarray) -> np.ndarray:
+        return (np.asarray(obs, np.float32) + self.offset) * self.scale
+
+
+class FrameStack(Connector):
+    """Concatenate the last ``k`` observations per env row (reference:
+    FrameStackingEnvToModule). Expects a fixed number of env rows."""
+
+    def __init__(self, k: int = 4):
+        self.k = k
+        self._hist: Optional[List[np.ndarray]] = None
+
+    def __call__(self, obs: np.ndarray) -> np.ndarray:
+        obs = np.asarray(obs, np.float32)
+        if self._hist is None or self._hist[0].shape != obs.shape:
+            self._hist = [obs] * self.k
+        else:
+            self._hist = self._hist[1:] + [obs]
+        return np.concatenate(self._hist, axis=-1)
+
+    def transform(self, obs: np.ndarray) -> np.ndarray:
+        """Peek: the window as if ``obs`` were appended, without shifting."""
+        obs = np.asarray(obs, np.float32)
+        if self._hist is None or self._hist[0].shape != obs.shape:
+            return np.concatenate([obs] * self.k, axis=-1)
+        return np.concatenate(self._hist[1:] + [obs], axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# module-to-env
+# ---------------------------------------------------------------------------
+
+
+class SoftmaxSample(Connector):
+    """Categorical sampling from the logits head with logp (the on-policy
+    default — reference: GetActions connector)."""
+
+    def __call__(self, ctx: dict) -> dict:
+        logits = ctx["logits"]
+        rng: np.random.RandomState = ctx["rng"]
+        z = logits - logits.max(-1, keepdims=True)
+        probs = np.exp(z) / np.exp(z).sum(-1, keepdims=True)
+        n = logits.shape[0]
+        actions = np.array([rng.choice(len(p), p=p) for p in probs])
+        ctx["actions"] = actions
+        ctx["logp"] = np.log(probs[np.arange(n), actions] + 1e-9)
+        return ctx
+
+
+class EpsilonGreedy(Connector):
+    """Value-based exploration over the logits-as-Q head (reference:
+    rllib/utils/exploration/epsilon_greedy.py). ``epsilon`` may be updated
+    by the algorithm through the runner (ctx carries the live value)."""
+
+    def __init__(self, epsilon: float = 0.05):
+        self.epsilon = epsilon
+
+    def __call__(self, ctx: dict) -> dict:
+        logits = ctx["logits"]
+        rng: np.random.RandomState = ctx["rng"]
+        eps = ctx.get("epsilon", self.epsilon)
+        n = logits.shape[0]
+        greedy = logits.argmax(-1)
+        rand = rng.randint(logits.shape[-1], size=n)
+        explore = rng.rand(n) < eps
+        ctx["actions"] = np.where(explore, rand, greedy)
+        ctx["logp"] = np.zeros(n, np.float32)
+        return ctx
+
+
+class ActionClip(Connector):
+    """Clamp integer actions into the valid range (safety tail connector)."""
+
+    def __init__(self, num_actions: int):
+        self.num_actions = num_actions
+
+    def __call__(self, ctx: dict) -> dict:
+        if "actions" in ctx:
+            ctx["actions"] = np.clip(ctx["actions"], 0, self.num_actions - 1)
+        return ctx
+
+
+def default_module_to_env(epsilon: Optional[float] = None) -> ConnectorPipeline:
+    """The pipeline EnvRunner uses when none is configured — reproduces the
+    pre-connector behavior exactly (softmax sampling, or epsilon-greedy for
+    the value-based algorithms)."""
+    if epsilon is not None:
+        return ConnectorPipeline([EpsilonGreedy(epsilon)])
+    return ConnectorPipeline([SoftmaxSample()])

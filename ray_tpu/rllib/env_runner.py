@@ -24,7 +24,8 @@ def _tree_to_numpy(tree):
 
 class EnvRunner:
     def __init__(self, env_creator, module_spec: dict, num_envs: int = 1,
-                 seed: int = 0, rollout_fragment_length: int = 200):
+                 seed: int = 0, rollout_fragment_length: int = 200,
+                 env_to_module=None, module_to_env=None):
         from ray_tpu.rllib.core.rl_module import RLModule
         from ray_tpu.rllib.env import EnvSpec, make_env
 
@@ -33,6 +34,10 @@ class EnvRunner:
                                 hidden=module_spec.get("hidden", (64, 64)))
         self._fragment = rollout_fragment_length
         self._rng = np.random.RandomState(seed)
+        # connector pipelines (reference: rllib/connectors/ — state lives on
+        # the runner); None -> identity / default action sampling
+        self._env_to_module = env_to_module
+        self._module_to_env = module_to_env
         self._obs = [env.reset(seed=seed * 1000 + i)
                      for i, env in enumerate(self._envs)]
         self._ep_return = [0.0] * num_envs
@@ -61,7 +66,14 @@ class EnvRunner:
         params = _tree_to_numpy(params)
         n_envs = len(self._envs)
         T = self._fragment
-        obs_buf = np.zeros((T, n_envs, self._module.spec.obs_dim), np.float32)
+        # buffers are sized from the CONNECTOR-TRANSFORMED obs so pipelines
+        # that change dimensionality (FrameStack) work; the module must be
+        # built with the matching obs_dim (AlgorithmConfig.module_obs_dim)
+        probe = np.stack(self._obs)
+        if self._env_to_module is not None:
+            probe = self._env_to_module.transform(probe)
+        obs_dim = probe.shape[-1]
+        obs_buf = np.zeros((T, n_envs, obs_dim), np.float32)
         # successor states are only consumed by the replay-based algorithms
         # (epsilon-greedy mode); the on-policy path shouldn't pay to ship them
         next_obs_buf = np.zeros_like(obs_buf) if epsilon is not None else None
@@ -71,41 +83,51 @@ class EnvRunner:
         logp_buf = np.zeros((T, n_envs), np.float32)
         val_buf = np.zeros((T, n_envs), np.float32)
 
+        from ray_tpu.rllib.connectors import default_module_to_env
+
+        m2e = self._module_to_env or default_module_to_env(epsilon)
         for t in range(T):
-            obs = np.stack(self._obs)  # [n_envs, obs_dim]
+            raw_obs = np.stack(self._obs)  # [n_envs, obs_dim]
+            obs = (self._env_to_module(raw_obs)
+                   if self._env_to_module is not None else raw_obs)
             logits, values = self._fwd(params, obs)
+            ctx = {"logits": logits, "rng": self._rng}
             if epsilon is not None:
-                greedy = logits.argmax(-1)
-                rand = self._rng.randint(logits.shape[-1], size=n_envs)
-                explore = self._rng.rand(n_envs) < epsilon
-                actions = np.where(explore, rand, greedy)
-                logp = np.zeros(n_envs, np.float32)
-            else:
-                # sample categorically in numpy (cheap, avoids device roundtrip)
-                z = logits - logits.max(-1, keepdims=True)
-                probs = np.exp(z) / np.exp(z).sum(-1, keepdims=True)
-                actions = np.array([self._rng.choice(len(p), p=p) for p in probs])
-                logp = np.log(probs[np.arange(n_envs), actions] + 1e-9)
+                ctx["epsilon"] = epsilon
+            ctx = m2e(ctx)
+            actions, logp = ctx["actions"], ctx["logp"]
 
             obs_buf[t] = obs
             act_buf[t] = actions
             val_buf[t] = values
             logp_buf[t] = logp
+            nxt_rows = []
             for i, env in enumerate(self._envs):
                 nxt, rew, done, _ = env.step(int(actions[i]))
                 rew_buf[t, i] = rew
                 done_buf[t, i] = done
-                if next_obs_buf is not None:
-                    next_obs_buf[t, i] = nxt  # pre-reset: the true successor
+                nxt_rows.append(np.asarray(nxt, np.float32))
                 self._ep_return[i] += rew
                 if done:
                     self._completed.append(self._ep_return[i])
                     self._ep_return[i] = 0.0
                     nxt = env.reset()
                 self._obs[i] = nxt
+            if next_obs_buf is not None:
+                # pre-reset true successors, through the SAME transform as
+                # obs (state-free: no double-ingestion of boundary frames)
+                rows = np.stack(nxt_rows)
+                if self._env_to_module is not None:
+                    rows = self._env_to_module.transform(rows)
+                next_obs_buf[t] = rows
 
         # bootstrap value for the unfinished tail of each env's fragment
-        _, last_values = self._fwd(params, np.stack(self._obs))
+        # (transform(): the same obs re-enter the stream at the next
+        # sample()'s t=0, which is where the stateful update belongs)
+        tail = np.stack(self._obs)
+        if self._env_to_module is not None:
+            tail = self._env_to_module.transform(tail)
+        _, last_values = self._fwd(params, tail)
         out = {
             "obs": obs_buf, "actions": act_buf,
             "rewards": rew_buf, "dones": done_buf, "logp": logp_buf,

@@ -1,10 +1,13 @@
 """Offline RL: behavior cloning and MARWIL.
 
 reference: rllib/algorithms/bc/ and rllib/algorithms/marwil/ (+ rllib/offline/
-for data ingestion).  BC maximizes the data log-likelihood; MARWIL weights it
-by exponentiated advantages (monotone policy improvement over the behavior
-policy, Wang et al. 2018).  Data comes in as episode dicts or a
-ray_tpu.data.Dataset of transition rows — no environment needed to train.
+for data ingestion at scale).  BC maximizes the data log-likelihood; MARWIL
+weights it by exponentiated advantages (monotone policy improvement over the
+behavior policy, Wang et al. 2018).  ``offline_data`` accepts either a list
+of episode dicts or a ``ray_tpu.data.Dataset`` of transition rows
+({obs, actions, rewards, eps_id}) — the Dataset path streams blocks through
+the Data executor (reference: rllib/offline/offline_data.py reading via Ray
+Data), so parquet/json corpora ingest without materializing on the driver.
 """
 
 from __future__ import annotations
@@ -20,6 +23,30 @@ import optax
 from ray_tpu.rllib.algorithm import AlgorithmConfig
 from ray_tpu.rllib.core.rl_module import RLModule
 from ray_tpu.rllib.env import EnvSpec, make_env
+
+
+def dataset_to_batch(ds, gamma: float) -> Dict[str, np.ndarray]:
+    """Stream a ray_tpu.data.Dataset of transition rows into the flat
+    training batch. Rows carry {obs, actions, rewards, eps_id}; returns-to-go
+    are computed per episode after grouping by eps_id (reference:
+    rllib/offline/ JSON readers emit per-timestep rows the same way)."""
+    episodes: Dict[Any, Dict[str, list]] = {}
+    order: List[Any] = []
+    for batch in ds.iter_batches(batch_size=4096, batch_format="numpy"):
+        eps = np.asarray(batch["eps_id"])
+        for i in range(len(eps)):
+            key = eps[i].item() if hasattr(eps[i], "item") else eps[i]
+            ep = episodes.get(key)
+            if ep is None:
+                ep = episodes[key] = {"obs": [], "actions": [], "rewards": []}
+                order.append(key)
+            ep["obs"].append(np.asarray(batch["obs"][i], np.float32))
+            ep["actions"].append(int(np.asarray(batch["actions"][i])))
+            ep["rewards"].append(float(np.asarray(batch["rewards"][i])))
+    return episodes_to_batch(
+        [{"obs": np.stack(e["obs"]), "actions": np.asarray(e["actions"]),
+          "rewards": np.asarray(e["rewards"])} for e in
+         (episodes[k] for k in order)], gamma)
 
 
 def episodes_to_batch(episodes: List[Dict[str, np.ndarray]], gamma: float) -> Dict[str, np.ndarray]:
@@ -117,16 +144,20 @@ class BC:
     def __init__(self, config: BCConfig):
         self.config = config
         if config.offline_data is None:
-            raise ValueError("BCConfig.offline_data is required "
-                             "(list of episode dicts)")
+            raise ValueError("BCConfig.offline_data is required (a list of "
+                             "episode dicts or a ray_tpu.data.Dataset)")
+        if hasattr(config.offline_data, "iter_batches"):
+            # ray_tpu.data.Dataset of transition rows: stream it through the
+            # Data executor (reference: rllib/offline/ via Ray Data)
+            self._batch = dataset_to_batch(config.offline_data, config.gamma)
+        else:
+            self._batch = episodes_to_batch(config.offline_data, config.gamma)
         if config.env is not None:
             self._spec = make_env(config.env).spec
         else:
             self._spec = EnvSpec(
-                obs_dim=int(np.asarray(config.offline_data[0]["obs"]).shape[-1]),
-                num_actions=int(max(np.asarray(ep["actions"]).max()
-                                    for ep in config.offline_data)) + 1)
-        self._batch = episodes_to_batch(config.offline_data, config.gamma)
+                obs_dim=int(self._batch["obs"].shape[-1]),
+                num_actions=int(self._batch["actions"].max()) + 1)
         self._module = RLModule(self._spec, hidden=tuple(config.hidden))
         self._learner = BCLearner(self._module, config)
         self._rng = np.random.RandomState(config.seed)

