@@ -61,6 +61,13 @@ class Autoscaler:
         # for the same shape within the cooldown
         self._launch_cooldown_s = 30.0
         self._recent_launches: Dict[tuple, float] = {}
+        # v2 instance lifecycle state machine (reference:
+        # autoscaler/v2/instance_manager/): every launch goes through
+        # QUEUED->REQUESTED->ALLOCATED->RAY_RUNNING with bounded retries,
+        # so provider flakes are policy, not ad-hoc exception handling
+        from ray_tpu.autoscaler.instance_manager import InstanceManager
+
+        self._im = InstanceManager(provider)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -71,12 +78,14 @@ class Autoscaler:
         node ids the GCS considers DEAD (self._dead_nodes)."""
         stats = {}
         dead = set()
+        alive = set()
         for node in self._w.gcs.call("GetAllNodeInfo", {}) or []:
             nid = node["node_id"]
             nid = nid.hex() if hasattr(nid, "hex") else nid
             if node.get("state") == "DEAD":
                 dead.add(nid)
                 continue
+            alive.add(nid)
             try:
                 s = self._w.pool.get(tuple(node["address"])).call(
                     "GetNodeStats", {}, timeout=5)
@@ -84,6 +93,10 @@ class Autoscaler:
             except Exception:  # noqa: BLE001
                 continue
         self._dead_nodes = dead
+        # GCS-ALIVE is the liveness authority for the instance manager: a
+        # node that merely failed a stats RPC must NOT look dead (the IM
+        # would terminate its whole gang)
+        self._alive_nodes = alive
         return stats
 
     def pending_demands(self, stats=None) -> List[Dict[str, float]]:
@@ -99,15 +112,24 @@ class Autoscaler:
         """One tick; returns {"launched": [group names], "terminated": [ids]}."""
         stats = self._node_stats()
         launched, terminated = [], []
+        alive_ids = set(getattr(self, "_alive_nodes", stats.keys()))
+        # drive in-flight instances through the state machine first, so this
+        # tick's counts see their progress (and failures retry on policy)
+        self._im.reconcile(alive_ids)
+        self._im.gc()
 
         # 1. min_groups floors
         live = self._provider.non_terminated_node_groups()
         counts: Dict[str, int] = {}
         for g in live.values():
             counts[g["group_name"]] = counts.get(g["group_name"], 0) + 1
+        # count instances still in flight (QUEUED/REQUESTED retries) that the
+        # provider doesn't show yet — double-launch prevention
+        for name, n in self._im.counts_by_group(pending_only=True).items():
+            counts[name] = counts.get(name, 0) + n
         for spec in self._specs.values():
             while counts.get(spec.name, 0) < spec.min_groups:
-                self._provider.create_node_group(
+                self._im.request(
                     spec.name, spec.node_resources, spec.count, spec.labels)
                 counts[spec.name] = counts.get(spec.name, 0) + 1
                 launched.append(spec.name)
@@ -127,7 +149,7 @@ class Autoscaler:
                     continue
                 if counts.get(spec.name, 0) >= spec.max_groups:
                     continue
-                self._provider.create_node_group(
+                self._im.request(
                     spec.name, spec.node_resources, spec.count, spec.labels)
                 counts[spec.name] = counts.get(spec.name, 0) + 1
                 launched.append(spec.name)
@@ -155,10 +177,16 @@ class Autoscaler:
                     and counts.get(g["group_name"], 0) >
                     self._specs.get(g["group_name"],
                                     NodeGroupSpec(g["group_name"], {})).min_groups):
-                self._provider.terminate_node_group(gid)
+                # route through the state machine when it owns the group
+                # (graceful TERMINATING->TERMINATED); direct otherwise
+                if not self._im.terminate_by_provider_id(gid):
+                    self._provider.terminate_node_group(gid)
                 counts[g["group_name"]] -= 1
                 terminated.append(gid)
                 self._idle_since.pop(gid, None)
+        # QUEUED instances become provider groups on the NEXT im.reconcile;
+        # run it again so a launch decided this tick is visible to callers
+        self._im.reconcile(alive_ids)
         return {"launched": launched, "terminated": terminated}
 
     @staticmethod

@@ -1,0 +1,213 @@
+"""Instance lifecycle state machine for autoscaler v2.
+
+reference: python/ray/autoscaler/v2/instance_manager/ — v2 tracks every
+cloud instance through an explicit status graph instead of issuing provider
+calls ad hoc, so provider flakes (create throttling, slow boots, zombie
+allocations) are handled by policy: bounded retries with backoff, boot
+timeouts, and deterministic cleanup. Here the tracked unit is a node GROUP
+(a whole TPU slice — atomic gangs, SURVEY hard-part #2).
+
+Status graph (reference: instance_manager/common.py InstanceStatus):
+
+    QUEUED -> REQUESTED -> ALLOCATED -> RAY_RUNNING
+       ^          |            |             |
+       |     (create err)  (boot timeout)   idle/terminate
+       +-- ALLOCATION_FAILED   +-------> TERMINATING -> TERMINATED
+           (retry w/ backoff; max_retries => FAILED terminal)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional, Set
+
+from ray_tpu.autoscaler.node_provider import NodeProvider
+
+logger = logging.getLogger(__name__)
+
+QUEUED = "QUEUED"
+REQUESTED = "REQUESTED"
+ALLOCATED = "ALLOCATED"
+RAY_RUNNING = "RAY_RUNNING"
+ALLOCATION_FAILED = "ALLOCATION_FAILED"
+TERMINATING = "TERMINATING"
+TERMINATED = "TERMINATED"
+FAILED = "FAILED"
+
+_TERMINAL = (TERMINATED, FAILED)
+
+
+@dataclasses.dataclass
+class Instance:
+    instance_id: str
+    group_name: str
+    node_resources: Dict[str, float]
+    count: int
+    labels: Dict[str, str]
+    status: str = QUEUED
+    status_since: float = dataclasses.field(default_factory=time.monotonic)
+    provider_id: Optional[str] = None  # the provider's group id once created
+    retries: int = 0
+    last_error: str = ""
+
+    def to(self, status: str, error: str = ""):
+        logger.info("instance %s (%s): %s -> %s %s", self.instance_id,
+                    self.group_name, self.status, status,
+                    f"({error})" if error else "")
+        self.status = status
+        self.status_since = time.monotonic()
+        if error:
+            self.last_error = error
+
+
+class InstanceManager:
+    """Drives every instance toward RAY_RUNNING / TERMINATED.
+
+    ``reconcile(alive_node_ids)`` is the only mutation point; call it from
+    the autoscaler loop with the GCS's ALIVE node ids (hex strings).
+    """
+
+    def __init__(self, provider: NodeProvider, *, max_retries: int = 3,
+                 retry_backoff_s: float = 5.0, boot_timeout_s: float = 600.0):
+        self._provider = provider
+        self._max_retries = max_retries
+        self._retry_backoff = retry_backoff_s
+        self._boot_timeout = boot_timeout_s
+        self._instances: Dict[str, Instance] = {}
+        self._lock = threading.Lock()
+
+    # -- intents ------------------------------------------------------------
+
+    def request(self, group_name: str, node_resources: Dict[str, float],
+                count: int, labels: Optional[Dict[str, str]] = None) -> str:
+        inst = Instance(
+            instance_id=f"inst-{uuid.uuid4().hex[:8]}",
+            group_name=group_name, node_resources=dict(node_resources),
+            count=count, labels=dict(labels or {}))
+        with self._lock:
+            self._instances[inst.instance_id] = inst
+        return inst.instance_id
+
+    def terminate(self, instance_id: str):
+        with self._lock:
+            inst = self._instances.get(instance_id)
+        if inst is not None and inst.status not in _TERMINAL:
+            inst.to(TERMINATING)
+
+    def terminate_by_provider_id(self, provider_id: str) -> bool:
+        with self._lock:
+            for inst in self._instances.values():
+                if inst.provider_id == provider_id and inst.status not in _TERMINAL:
+                    inst.to(TERMINATING)
+                    return True
+        return False
+
+    # -- views --------------------------------------------------------------
+
+    def instances(self, statuses: Optional[Set[str]] = None) -> List[Instance]:
+        with self._lock:
+            out = list(self._instances.values())
+        if statuses is not None:
+            out = [i for i in out if i.status in statuses]
+        return out
+
+    def counts_by_group(self, pending_only: bool = False) -> Dict[str, int]:
+        """Non-terminal instances per group (pending_only: not yet running —
+        the launch-dedup signal the reconciler needs)."""
+        # ALLOCATED groups already appear in the provider listing (that is
+        # the REQUESTED->ALLOCATED condition), so counting them as pending
+        # would double-count against min/max_groups
+        wanted = ({QUEUED, REQUESTED, ALLOCATION_FAILED}
+                  if pending_only else
+                  {QUEUED, REQUESTED, ALLOCATED, ALLOCATION_FAILED,
+                   RAY_RUNNING})
+        counts: Dict[str, int] = {}
+        for i in self.instances(wanted):
+            counts[i.group_name] = counts.get(i.group_name, 0) + 1
+        return counts
+
+    # -- the state machine ----------------------------------------------------
+
+    def reconcile(self, alive_node_ids: Set[str]) -> None:
+        now = time.monotonic()
+        try:
+            groups = self._provider.non_terminated_node_groups()
+        except Exception:  # noqa: BLE001
+            logger.exception("provider listing failed; skipping reconcile")
+            return
+        for inst in self.instances():
+            try:
+                self._step(inst, alive_node_ids, now, groups)
+            except Exception as e:  # noqa: BLE001
+                logger.exception("instance %s reconcile step failed",
+                                 inst.instance_id)
+                inst.to(ALLOCATION_FAILED, str(e))
+
+    def _step(self, inst: Instance, alive: Set[str], now: float,
+              groups: Dict[str, dict]):
+        if inst.status == QUEUED:
+            try:
+                inst.provider_id = self._provider.create_node_group(
+                    inst.group_name, inst.node_resources, inst.count,
+                    inst.labels)
+                inst.to(REQUESTED)
+            except Exception as e:  # noqa: BLE001
+                inst.to(ALLOCATION_FAILED, str(e))
+        elif inst.status == ALLOCATION_FAILED:
+            if inst.retries >= self._max_retries:
+                inst.to(FAILED, f"gave up after {inst.retries} retries: "
+                                f"{inst.last_error}")
+                return
+            # exponential backoff before re-queueing the create
+            if now - inst.status_since >= self._retry_backoff * (2 ** inst.retries):
+                inst.retries += 1
+                inst.to(QUEUED)
+        elif inst.status == REQUESTED:
+            if inst.provider_id in groups:
+                inst.to(ALLOCATED)
+            elif now - inst.status_since > self._boot_timeout:
+                inst.to(ALLOCATION_FAILED, "provider never surfaced the group")
+        elif inst.status == ALLOCATED:
+            g = groups.get(inst.provider_id)
+            if g is None:
+                # the allocation vanished under us (preemption): retry fresh
+                inst.to(ALLOCATION_FAILED, "allocation disappeared")
+                return
+            ids = {n.hex() if hasattr(n, "hex") else str(n)
+                   for n in g.get("node_ids", [])}
+            if ids and ids.issubset(alive):
+                inst.to(RAY_RUNNING)
+            elif now - inst.status_since > self._boot_timeout:
+                inst.to(TERMINATING, "nodes never registered with the GCS")
+        elif inst.status == RAY_RUNNING:
+            g = groups.get(inst.provider_id)
+            if g is None:
+                inst.to(TERMINATED, "group gone (external termination)")
+                return
+            ids = {n.hex() if hasattr(n, "hex") else str(n)
+                   for n in g.get("node_ids", [])}
+            if ids and not (ids & alive):
+                # the whole gang died (slice preempted / hosts crashed)
+                inst.to(TERMINATING, "all nodes dead in GCS")
+        elif inst.status == TERMINATING:
+            if inst.provider_id is not None:
+                try:
+                    self._provider.terminate_node_group(inst.provider_id)
+                except Exception as e:  # noqa: BLE001
+                    logger.warning("terminate of %s failed (%s); retrying "
+                                   "next tick", inst.provider_id, e)
+                    return
+            inst.to(TERMINATED)
+
+    def gc(self, keep_terminal: int = 64):
+        """Drop old terminal records so long-lived clusters stay bounded."""
+        with self._lock:
+            terminal = sorted(
+                (i for i in self._instances.values() if i.status in _TERMINAL),
+                key=lambda i: i.status_since)
+            for i in terminal[:max(0, len(terminal) - keep_terminal)]:
+                del self._instances[i.instance_id]

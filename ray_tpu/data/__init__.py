@@ -10,16 +10,24 @@ from ray_tpu.data.dataset import ActorPoolStrategy, Dataset
 from ray_tpu.data.datasource import Datasource
 from ray_tpu.data.read_api import (
     from_arrow,
+    from_huggingface,
     from_items,
     from_numpy,
     from_pandas,
+    from_torch,
     range,  # noqa: A004
     read_binary_files,
     read_csv,
     read_datasource,
+    read_images,
     read_json,
+    read_numpy,
+    read_orc,
     read_parquet,
+    read_sql,
     read_text,
+    read_tfrecords,
+    read_webdataset,
 )
 
 __all__ = [
@@ -38,4 +46,12 @@ __all__ = [
     "read_text",
     "read_binary_files",
     "read_datasource",
+    "read_numpy",
+    "read_orc",
+    "read_images",
+    "read_sql",
+    "read_tfrecords",
+    "read_webdataset",
+    "from_torch",
+    "from_huggingface",
 ]

@@ -3,6 +3,10 @@
 reference: python/ray/data/datasource/ + _internal/datasource/ (~40 sources);
 the core contract is Datasource.get_read_tasks(parallelism) -> [callable
 returning a block] (reference: datasource/datasource.py).
+
+Paths may be local, globs, directories, or any fsspec URI (gs://, s3://,
+http://, ...) — the reference reaches cloud storage through pyarrow/fsspec
+filesystems the same way (datasource/path_util.py).
 """
 
 from __future__ import annotations
@@ -24,12 +28,38 @@ class Datasource:
         return None
 
 
+def _is_remote(path: str) -> bool:
+    return "://" in path and not path.startswith("file://")
+
+
+def _open(path: str, mode: str = "rb"):
+    """Open a local path or any fsspec URI (gs://, s3://, http://, ...)."""
+    if _is_remote(path):
+        import fsspec
+
+        return fsspec.open(path, mode).open()
+    return open(path, mode)
+
+
 def _expand_paths(paths) -> List[str]:
     if isinstance(paths, str):
         paths = [paths]
     out: List[str] = []
     for p in paths:
-        if os.path.isdir(p):
+        if _is_remote(p):
+            import fsspec
+
+            fs, _ = fsspec.core.url_to_fs(p)
+            proto = p.split("://", 1)[0]
+            if any(c in p for c in "*?["):
+                out.extend(sorted(f"{proto}://{m}" for m in fs.glob(p)))
+            elif fs.isdir(p):
+                out.extend(sorted(
+                    f"{proto}://{f}" for f in fs.find(p)
+                    if not f.rsplit("/", 1)[-1].startswith(".")))
+            else:
+                out.append(p)
+        elif os.path.isdir(p):
             out.extend(sorted(
                 os.path.join(dp, f) for dp, _, fs in os.walk(p) for f in fs
                 if not f.startswith(".")
@@ -114,56 +144,189 @@ def _read_files(files: List[str], reader) -> pa.Table:
 def read_parquet_file(path: str) -> pa.Table:
     import pyarrow.parquet as pq
 
+    if _is_remote(path):
+        with _open(path) as f:
+            return pq.read_table(f)
     return pq.read_table(path)
 
 
 def read_csv_file(path: str) -> pa.Table:
     import pyarrow.csv as pacsv
 
+    if _is_remote(path):
+        with _open(path) as f:
+            return pacsv.read_csv(f)
+    # path string keeps pyarrow's extension-based compression inference
     return pacsv.read_csv(path)
 
 
 def read_json_file(path: str) -> pa.Table:
     import pyarrow.json as pajson
 
+    if _is_remote(path):
+        with _open(path) as f:
+            return pajson.read_json(f)
     return pajson.read_json(path)
 
 
 def read_text_file(path: str) -> pa.Table:
-    with open(path, "r") as f:
-        lines = [ln.rstrip("\n") for ln in f]
+    with _open(path, "rb") as f:
+        lines = [ln.decode("utf-8", "replace").rstrip("\n")
+                 for ln in f.read().splitlines()]
     return pa.table({"text": lines})
 
 
 def read_binary_file(path: str) -> pa.Table:
-    with open(path, "rb") as f:
+    with _open(path, "rb") as f:
         data = f.read()
     return pa.table({"path": [path], "bytes": pa.array([data], pa.binary())})
 
 
+def read_numpy_file(path: str) -> pa.Table:
+    """.npy -> one "data" column of rows; .npz -> one column per array
+    (reference: datasource/numpy_datasource.py)."""
+    with _open(path, "rb") as f:
+        loaded = np.load(f, allow_pickle=False)
+        if hasattr(loaded, "files"):  # npz archive
+            cols = {name: list(loaded[name]) for name in loaded.files}
+            return pa.table({k: pa.array(v) for k, v in cols.items()})
+        arr = np.asarray(loaded)
+    return pa.table({"data": pa.array(list(arr))})
+
+
+def read_orc_file(path: str) -> pa.Table:
+    from pyarrow import orc
+
+    with _open(path, "rb") as f:
+        return orc.ORCFile(f).read()
+
+
+def read_image_file(path: str) -> pa.Table:
+    """One row per image: raw HWC uint8 bytes + shape + path (reference:
+    datasource/image_datasource.py; kept as bytes+shape instead of nested
+    lists so blocks stay compact and zero-copy restorable)."""
+    from PIL import Image
+
+    with _open(path, "rb") as f:
+        img = np.asarray(Image.open(f).convert("RGB"), np.uint8)
+    return pa.table({
+        "path": [path],
+        "image": pa.array([img.tobytes()], pa.binary()),
+        "height": [img.shape[0]], "width": [img.shape[1]],
+        "channels": [img.shape[2]],
+    })
+
+
+def read_tfrecords_file(path: str) -> pa.Table:
+    """TFRecord framing without tensorflow: each record is
+    [len u64][len_crc u32][data][data_crc u32]; rows carry the raw bytes
+    (reference: read_tfrecords — feature parsing is the consumer's job
+    here, the tf.train.Example proto dependency stays out)."""
+    import struct as _struct
+
+    records = []
+    with _open(path, "rb") as f:
+        while True:
+            header = f.read(12)
+            if len(header) < 12:
+                break
+            (length,) = _struct.unpack("<Q", header[:8])
+            data = f.read(length)
+            f.read(4)  # data crc
+            if len(data) < length:
+                break
+            records.append(data)
+    return pa.table({"bytes": pa.array(records, pa.binary())})
+
+
+def read_webdataset_file(path: str) -> pa.Table:
+    """One tar shard -> rows grouped by sample key (reference:
+    datasource/webdataset_datasource.py): members `key.ext` become columns
+    `ext` of binary payloads."""
+    import tarfile
+
+    samples: Dict[str, Dict[str, bytes]] = {}
+    order: List[str] = []
+    with _open(path, "rb") as f:
+        with tarfile.open(fileobj=f) as tar:
+            for member in tar:
+                if not member.isfile():
+                    continue
+                name = member.name.rsplit("/", 1)[-1]
+                key, _, ext = name.partition(".")
+                if key not in samples:
+                    samples[key] = {}
+                    order.append(key)
+                samples[key][ext or "bin"] = tar.extractfile(member).read()
+    cols: Dict[str, list] = {"__key__": order}
+    exts = sorted({e for s in samples.values() for e in s})
+    for e in exts:
+        cols[e] = [samples[k].get(e) for k in order]
+    return pa.table({k: (pa.array(v, pa.binary()) if k != "__key__"
+                         else pa.array(v)) for k, v in cols.items()})
+
+
+class SQLDatasource(Datasource):
+    """reference: datasource/sql_datasource.py — a connection FACTORY (the
+    connection itself can't travel to workers) + a query; one read task
+    (relational engines parallelize server-side)."""
+
+    def __init__(self, sql: str, connection_factory: Callable[[], Any]):
+        self.sql = sql
+        self.connection_factory = connection_factory
+
+    def get_read_tasks(self, parallelism: int) -> List[Callable]:
+        return [functools.partial(_read_sql, self.sql, self.connection_factory)]
+
+
+def _read_sql(sql: str, connection_factory) -> pa.Table:
+    conn = connection_factory()
+    try:
+        cur = conn.cursor()
+        cur.execute(sql)
+        names = [d[0] for d in cur.description]
+        rows = cur.fetchall()
+    finally:
+        conn.close()
+    cols = {n: [r[i] for r in rows] for i, n in enumerate(names)}
+    return pa.table(cols)
+
+
 # -- writers (reference: data write_parquet/csv/json) -----------------------
+
+def _out_path(path: str, name: str) -> str:
+    if _is_remote(path):
+        return path.rstrip("/") + "/" + name
+    os.makedirs(path, exist_ok=True)
+    return os.path.join(path, name)
+
 
 def write_block_parquet(block: pa.Table, path: str, index: int) -> str:
     import pyarrow.parquet as pq
 
-    out = os.path.join(path, f"part-{index:05d}.parquet")
-    pq.write_table(block, out)
+    out = _out_path(path, f"part-{index:05d}.parquet")
+    if _is_remote(out):
+        with _open(out, "wb") as f:
+            pq.write_table(block, f)
+    else:
+        pq.write_table(block, out)
     return out
 
 
 def write_block_csv(block: pa.Table, path: str, index: int) -> str:
     import pyarrow.csv as pacsv
 
-    out = os.path.join(path, f"part-{index:05d}.csv")
-    pacsv.write_csv(block, out)
+    out = _out_path(path, f"part-{index:05d}.csv")
+    with _open(out, "wb") as f:
+        pacsv.write_csv(block, f)
     return out
 
 
 def write_block_json(block: pa.Table, path: str, index: int) -> str:
-    out = os.path.join(path, f"part-{index:05d}.jsonl")
+    out = _out_path(path, f"part-{index:05d}.jsonl")
     import json
 
-    with open(out, "w") as f:
+    with _open(out, "wb") as f:
         for row in block.to_pylist():
-            f.write(json.dumps(row, default=str) + "\n")
+            f.write((json.dumps(row, default=str) + "\n").encode())
     return out

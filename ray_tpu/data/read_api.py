@@ -18,11 +18,17 @@ from ray_tpu.data.datasource import (
     FileDatasource,
     ItemsDatasource,
     RangeDatasource,
+    SQLDatasource,
     read_binary_file,
     read_csv_file,
+    read_image_file,
     read_json_file,
+    read_numpy_file,
+    read_orc_file,
     read_parquet_file,
     read_text_file,
+    read_tfrecords_file,
+    read_webdataset_file,
 )
 
 DEFAULT_PARALLELISM = 8
@@ -86,3 +92,64 @@ def read_text(paths, *, parallelism: int = -1) -> Dataset:
 
 def read_binary_files(paths, *, parallelism: int = -1) -> Dataset:
     return read_datasource(FileDatasource(paths, read_binary_file), parallelism=parallelism)
+
+
+def read_numpy(paths, *, parallelism: int = -1) -> Dataset:
+    """reference: read_api.py read_numpy (.npy / .npz files)."""
+    return read_datasource(FileDatasource(paths, read_numpy_file), parallelism=parallelism)
+
+
+def read_orc(paths, *, parallelism: int = -1) -> Dataset:
+    """reference: read_api.py read_orc (pyarrow ORC)."""
+    return read_datasource(FileDatasource(paths, read_orc_file), parallelism=parallelism)
+
+
+def read_images(paths, *, parallelism: int = -1) -> Dataset:
+    """reference: read_api.py read_images — rows of raw HWC uint8 bytes +
+    shape columns (decode with np.frombuffer(...).reshape(h, w, c))."""
+    return read_datasource(FileDatasource(paths, read_image_file), parallelism=parallelism)
+
+
+def read_tfrecords(paths, *, parallelism: int = -1) -> Dataset:
+    """reference: read_api.py read_tfrecords — rows carry the raw record
+    bytes (no tensorflow dependency; parse Examples downstream)."""
+    return read_datasource(FileDatasource(paths, read_tfrecords_file), parallelism=parallelism)
+
+
+def read_webdataset(paths, *, parallelism: int = -1) -> Dataset:
+    """reference: read_api.py read_webdataset — tar shards of key-grouped
+    samples; one column per member extension."""
+    return read_datasource(FileDatasource(paths, read_webdataset_file), parallelism=parallelism)
+
+
+def read_sql(sql: str, connection_factory, *, parallelism: int = -1) -> Dataset:
+    """reference: read_api.py read_sql(sql, connection_factory) — DB-API 2
+    connections (sqlite3, psycopg2, ...)."""
+    return read_datasource(SQLDatasource(sql, connection_factory), parallelism=parallelism)
+
+
+def from_torch(torch_dataset, *, parallelism: int = -1) -> Dataset:
+    """reference: read_api.py from_torch — map-style torch datasets; tensor
+    values land as numpy."""
+    import builtins
+
+    def to_np(v):
+        if hasattr(v, "numpy"):
+            return v.numpy()
+        if isinstance(v, dict):
+            return {k: to_np(x) for k, x in v.items()}
+        if isinstance(v, (list, tuple)):
+            return type(v)(to_np(x) for x in v)
+        return v
+
+    items = []
+    for i in builtins.range(len(torch_dataset)):  # module-level range() is the Dataset ctor
+        row = to_np(torch_dataset[i])
+        items.append(row if isinstance(row, dict) else {"item": row})
+    return from_items(items, parallelism=parallelism)
+
+
+def from_huggingface(hf_dataset, *, parallelism: int = -1) -> Dataset:
+    """reference: read_api.py from_huggingface — any iterable of row dicts
+    with column_names (datasets.Dataset satisfies this)."""
+    return from_items(list(hf_dataset), parallelism=parallelism)

@@ -1,0 +1,149 @@
+"""Datasource breadth (VERDICT r1 missing #8).
+
+reference: python/ray/data/datasource/ + _internal/datasource/ — numpy, ORC,
+images, TFRecords, webdataset tar shards, SQL, torch/huggingface ingestion,
+and fsspec URI paths for reads AND writes.
+"""
+
+import json
+import os
+import sqlite3
+import struct
+import tarfile
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from ray_tpu import data as rdata
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_read_numpy(cluster, tmp_path):
+    np.save(tmp_path / "a.npy", np.arange(10))
+    np.savez(tmp_path / "b.npz", x=np.ones(3), y=np.zeros(3))
+    ds = rdata.read_numpy(str(tmp_path / "a.npy"))
+    assert sorted(r["data"] for r in ds.take_all()) == list(range(10))
+    ds2 = rdata.read_numpy(str(tmp_path / "b.npz"))
+    rows = ds2.take_all()
+    assert len(rows) == 3 and rows[0]["x"] == 1.0 and rows[0]["y"] == 0.0
+
+
+def test_read_orc(cluster, tmp_path):
+    from pyarrow import orc
+
+    t = pa.table({"a": [1, 2, 3], "b": ["x", "y", "z"]})
+    orc.write_table(t, str(tmp_path / "t.orc"))
+    ds = rdata.read_orc(str(tmp_path / "t.orc"))
+    assert sorted(r["a"] for r in ds.take_all()) == [1, 2, 3]
+
+
+def test_read_images(cluster, tmp_path):
+    from PIL import Image
+
+    arr = np.zeros((4, 6, 3), np.uint8)
+    arr[..., 0] = 255  # red
+    Image.fromarray(arr).save(tmp_path / "img.png")
+    ds = rdata.read_images(str(tmp_path / "img.png"))
+    (row,) = ds.take_all()
+    img = np.frombuffer(row["image"], np.uint8).reshape(
+        row["height"], row["width"], row["channels"])
+    assert img.shape == (4, 6, 3) and img[0, 0, 0] == 255
+
+
+def test_read_tfrecords(cluster, tmp_path):
+    # write the TFRecord framing by hand (no tensorflow in the image)
+    payloads = [b"alpha", b"beta", b"gamma"]
+    with open(tmp_path / "t.tfrecord", "wb") as f:
+        for p in payloads:
+            f.write(struct.pack("<Q", len(p)))
+            f.write(b"\x00" * 4)  # length crc (unchecked)
+            f.write(p)
+            f.write(b"\x00" * 4)  # data crc
+    ds = rdata.read_tfrecords(str(tmp_path / "t.tfrecord"))
+    assert [r["bytes"] for r in ds.take_all()] == payloads
+
+
+def test_read_webdataset(cluster, tmp_path):
+    shard = tmp_path / "shard-000.tar"
+    with tarfile.open(shard, "w") as tar:
+        for key in ("s1", "s2"):
+            for ext, payload in (("txt", f"{key}-text".encode()),
+                                 ("json", json.dumps({"k": key}).encode())):
+                import io
+
+                info = tarfile.TarInfo(f"{key}.{ext}")
+                info.size = len(payload)
+                tar.addfile(info, io.BytesIO(payload))
+    ds = rdata.read_webdataset(str(shard))
+    rows = sorted(ds.take_all(), key=lambda r: r["__key__"])
+    assert [r["__key__"] for r in rows] == ["s1", "s2"]
+    assert rows[0]["txt"] == b"s1-text"
+    assert json.loads(rows[1]["json"]) == {"k": "s2"}
+
+
+def test_read_sql(cluster, tmp_path):
+    db = str(tmp_path / "t.db")
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE users (id INTEGER, name TEXT)")
+    conn.executemany("INSERT INTO users VALUES (?, ?)",
+                     [(1, "ada"), (2, "bob")])
+    conn.commit()
+    conn.close()
+    ds = rdata.read_sql("SELECT id, name FROM users ORDER BY id",
+                        lambda: sqlite3.connect(db))
+    assert [r["name"] for r in ds.take_all()] == ["ada", "bob"]
+
+
+def test_from_torch(cluster):
+    import torch
+
+    class TDS(torch.utils.data.Dataset):
+        def __len__(self):
+            return 5
+
+        def __getitem__(self, i):
+            return {"x": torch.tensor([i, i + 1]), "label": i % 2}
+
+    ds = rdata.from_torch(TDS())
+    rows = sorted(ds.take_all(), key=lambda r: r["label"] + r["x"][0])
+    assert len(rows) == 5
+    assert list(rows[0]["x"]) == [0, 1]
+
+
+def test_fsspec_memory_uri_plumbing():
+    """Remote-style URIs flow through path expansion, readers, and writers
+    (memory:// stands in for gs:// — identical fsspec plumbing). Exercised
+    driver-side: memory:// is per-process, so a worker can't see it; real
+    remote stores are shared and work through the normal task path."""
+    import fsspec
+    import pyarrow.parquet as pq
+
+    from ray_tpu.data.datasource import (
+        _expand_paths,
+        read_parquet_file,
+        write_block_parquet,
+    )
+
+    fs = fsspec.filesystem("memory")
+    t = pa.table({"v": [1, 2, 3, 4]})
+    with fs.open("/src/x.parquet", "wb") as f:
+        pq.write_table(t, f)
+    # glob + dir expansion over the remote filesystem (fsspec normalizes
+    # memory:// paths to a leading slash; the URI still resolves)
+    (expanded,) = _expand_paths("memory://src/*.parquet")
+    assert expanded.endswith("src/x.parquet") and expanded.startswith("memory://")
+    assert read_parquet_file(expanded).num_rows == 4
+    out = read_parquet_file("memory://src/x.parquet")
+    assert out.column("v").to_pylist() == [1, 2, 3, 4]
+    # remote write path
+    written = write_block_parquet(t, "memory://dst", 0)
+    assert read_parquet_file(written).num_rows == 4
