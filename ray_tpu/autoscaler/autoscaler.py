@@ -120,11 +120,14 @@ class Autoscaler:
 
         # 1. min_groups floors
         live = self._provider.non_terminated_node_groups()
-        counts: Dict[str, int] = {}
+        live_counts: Dict[str, int] = {}
         for g in live.values():
-            counts[g["group_name"]] = counts.get(g["group_name"], 0) + 1
-        # count instances still in flight (QUEUED/REQUESTED retries) that the
-        # provider doesn't show yet — double-launch prevention
+            live_counts[g["group_name"]] = live_counts.get(g["group_name"], 0) + 1
+        # LAUNCH decisions also count instances still in flight
+        # (QUEUED/REQUESTED retries the provider doesn't show yet) — double-
+        # launch prevention; the TERMINATION floor below must NOT (a stuck
+        # phantom launch would authorize killing the only live group)
+        counts = dict(live_counts)
         for name, n in self._im.counts_by_group(pending_only=True).items():
             counts[name] = counts.get(name, 0) + n
         for spec in self._specs.values():
@@ -174,7 +177,7 @@ class Autoscaler:
                 continue
             first = self._idle_since.setdefault(gid, now)
             if (now - first >= self._idle_timeout
-                    and counts.get(g["group_name"], 0) >
+                    and live_counts.get(g["group_name"], 0) >
                     self._specs.get(g["group_name"],
                                     NodeGroupSpec(g["group_name"], {})).min_groups):
                 # route through the state machine when it owns the group
@@ -182,6 +185,7 @@ class Autoscaler:
                 if not self._im.terminate_by_provider_id(gid):
                     self._provider.terminate_node_group(gid)
                 counts[g["group_name"]] -= 1
+                live_counts[g["group_name"]] -= 1
                 terminated.append(gid)
                 self._idle_since.pop(gid, None)
         # QUEUED instances become provider groups on the NEXT im.reconcile;

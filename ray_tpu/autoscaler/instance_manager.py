@@ -145,7 +145,11 @@ class InstanceManager:
             except Exception as e:  # noqa: BLE001
                 logger.exception("instance %s reconcile step failed",
                                  inst.instance_id)
-                inst.to(ALLOCATION_FAILED, str(e))
+                # only pre-running states demote to the retry path; a
+                # RAY_RUNNING instance must never be torn down by a
+                # transient step error
+                if inst.status in (QUEUED, REQUESTED, ALLOCATION_FAILED):
+                    inst.to(ALLOCATION_FAILED, str(e))
 
     def _step(self, inst: Instance, alive: Set[str], now: float,
               groups: Dict[str, dict]):
@@ -164,6 +168,15 @@ class InstanceManager:
                 return
             # exponential backoff before re-queueing the create
             if now - inst.status_since >= self._retry_backoff * (2 ** inst.retries):
+                if inst.provider_id is not None:
+                    # a create may have SUCCEEDED even though the group never
+                    # surfaced (eventual consistency) — terminate the stale
+                    # allocation before requesting a fresh one or it leaks
+                    try:
+                        self._provider.terminate_node_group(inst.provider_id)
+                    except Exception:  # noqa: BLE001
+                        pass
+                    inst.provider_id = None
                 inst.retries += 1
                 inst.to(QUEUED)
         elif inst.status == REQUESTED:

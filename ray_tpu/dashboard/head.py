@@ -1,10 +1,12 @@
-"""Dashboard head: a threaded HTTP server exposing cluster state as JSON.
+"""Dashboard head: a threaded HTTP server exposing cluster state as JSON
+plus a zero-build web UI at ``/``.
 
 reference: dashboard/head.py:49 (DashboardHead) + modules — node/actor/task
 listings (state API), jobs, /metrics Prometheus exposition
-(_private/metrics_agent.py), timeline (Chrome trace).  The React frontend
-is out of scope; every endpoint returns JSON (or Prometheus text), which is
-what the reference's frontend consumes too.
+(_private/metrics_agent.py), timeline (Chrome trace).  The reference ships
+a React app (dashboard/client/); this rebuild serves a single static page
+(index.html, vanilla JS polling the same JSON endpoints) — no node/webpack
+toolchain in the TPU image.
 
 Endpoints:
   GET /api/version
@@ -88,6 +90,13 @@ class DashboardHead:
 
     def _route(self, path: str):
         path = path.split("?", 1)[0].rstrip("/") or "/"
+        if path in ("/", "/index.html"):
+            import os
+
+            ui = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "index.html")
+            with open(ui, "rb") as f:
+                return f.read(), "text/html; charset=utf-8"
         if path == "/metrics":
             from ray_tpu.util.metrics import prometheus_text
 

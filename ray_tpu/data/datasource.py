@@ -252,8 +252,11 @@ def read_webdataset_file(path: str) -> pa.Table:
             for member in tar:
                 if not member.isfile():
                     continue
-                name = member.name.rsplit("/", 1)[-1]
-                key, _, ext = name.partition(".")
+                dirname, _, base = member.name.rpartition("/")
+                stem, _, ext = base.partition(".")
+                # webdataset convention: the key keeps the directory prefix
+                # (train/0001 and val/0001 are DIFFERENT samples)
+                key = f"{dirname}/{stem}" if dirname else stem
                 if key not in samples:
                     samples[key] = {}
                     order.append(key)

@@ -9,6 +9,7 @@ batching, jitted prefill/decode, mesh-based parallelism degrees.
 from ray_tpu.llm.batch import Processor, ProcessorConfig, build_llm_processor
 from ray_tpu.llm.config import GenerationConfig, LLMConfig
 from ray_tpu.llm.engine import JaxLLMEngine
+from ray_tpu.llm.lora import LoRAConfig, LoRAManager, init_lora, merge_lora
 from ray_tpu.llm.openai_api import ByteTokenizer, OpenAICompatServer, build_openai_app
 from ray_tpu.llm.serve import LLMServer, build_llm_deployment
 
@@ -17,6 +18,10 @@ __all__ = [
     "JaxLLMEngine",
     "LLMConfig",
     "LLMServer",
+    "LoRAConfig",
+    "LoRAManager",
+    "init_lora",
+    "merge_lora",
     "Processor",
     "ProcessorConfig",
     "build_llm_deployment",

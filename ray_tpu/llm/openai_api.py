@@ -47,13 +47,20 @@ class ByteTokenizer:
 
 
 class OpenAICompatServer(LLMServer):
-    """LLMServer speaking the OpenAI request/response schemas."""
+    """LLMServer speaking the OpenAI request/response schemas. LoRA
+    adapters appear as additional model ids (reference: ray.llm serves each
+    adapter under its own model id via multiplexing)."""
 
     def __init__(self, llm_config: LLMConfig, params=None, tokenizer=None,
-                 model_id: str = "ray-tpu-llm"):
-        super().__init__(llm_config, params)
+                 model_id: str = "ray-tpu-llm",
+                 lora_adapters=None):
+        super().__init__(llm_config, params, lora_adapters)
         self._tok = tokenizer or ByteTokenizer()
         self._model_id = model_id
+
+    def _adapter_of(self, req: Dict[str, Any]):
+        model = req.get("model")
+        return model if model in self.lora_model_ids() else None
 
     # -- shared ---------------------------------------------------------
 
@@ -66,6 +73,7 @@ class OpenAICompatServer(LLMServer):
             temperature=float(req.get("temperature", 0.0)),
             top_k=int(req.get("top_k", 0)),
             stop_token_ids=req.get("stop_token_ids", ()),
+            model=self._adapter_of(req),
         )
         out_text = self._tok.decode(out_ids)
         finish = "stop" if len(out_ids) < max_tokens else "length"
@@ -175,7 +183,8 @@ class OpenAICompatServer(LLMServer):
                     max_new_tokens=max_tokens,
                     temperature=float(request.get("temperature", 0.0)),
                     top_k=int(request.get("top_k", 0)),
-                    stop_token_ids=request.get("stop_token_ids", ())):
+                    stop_token_ids=request.get("stop_token_ids", ()),
+                    model=self._adapter_of(request)):
                 emitted_tokens += len(chunk)
                 all_ids.extend(chunk)
                 # incremental detokenization: decode the cumulative ids and
@@ -224,7 +233,10 @@ class OpenAICompatServer(LLMServer):
         """GET /v1/models."""
         return {"object": "list",
                 "data": [{"id": self._model_id, "object": "model",
-                          "owned_by": "ray_tpu"}]}
+                          "owned_by": "ray_tpu"}] + [
+                    {"id": mid, "object": "model", "owned_by": "ray_tpu",
+                     "parent": self._model_id}
+                    for mid in self.lora_model_ids()]}
 
     def __call__(self, request: Dict[str, Any]) -> Dict[str, Any]:
         """The serve HTTP proxy posts the JSON body without the path, so
@@ -242,7 +254,8 @@ class OpenAICompatServer(LLMServer):
 
 
 def build_openai_app(llm_config: LLMConfig, params=None, *, tokenizer=None,
-                     model_id: str = "ray-tpu-llm", name: str = "openai-llm"):
+                     model_id: str = "ray-tpu-llm", name: str = "openai-llm",
+                     lora_adapters=None):
     """Application + route prefix for OpenAI-style serving (reference:
     llm/_internal/serve build_openai_app)."""
     from ray_tpu import serve
@@ -254,4 +267,5 @@ def build_openai_app(llm_config: LLMConfig, params=None, *, tokenizer=None,
         max_ongoing_requests=max(8, llm_config.max_batch_size),
         ray_actor_options={"resources": llm_config.resources_per_replica()},
     )
-    return deployment.bind(llm_config, params, tokenizer, model_id)
+    return deployment.bind(llm_config, params, tokenizer, model_id,
+                           lora_adapters)

@@ -18,68 +18,136 @@ from ray_tpu.llm.config import GenerationConfig, LLMConfig
 
 
 class LLMServer:
-    """Deployment callable; bind with serve: see ``build_llm_deployment``."""
+    """Deployment callable; bind with serve: see ``build_llm_deployment``.
 
-    def __init__(self, llm_config: LLMConfig, params=None):
+    Multi-LoRA (reference: ray.llm's vLLM LoRA serving): ``lora_adapters``
+    maps model ids to adapter pytrees (llm/lora.py). Each adapter gets its
+    own engine over MERGED weights, created lazily on first request and all
+    driven by the one loop — batched decode stays a single jitted program
+    per engine, the right TPU trade (no per-slot adapter gathers)."""
+
+    def __init__(self, llm_config: LLMConfig, params=None,
+                 lora_adapters: Optional[Dict[str, Any]] = None):
         from ray_tpu.llm.engine import JaxLLMEngine
 
+        self._config = llm_config
         self._engine = JaxLLMEngine(llm_config, params)
+        self._engines: Dict[Optional[str], Any] = {None: self._engine}
+        self._engine_order: list = []  # adapter LRU (base never evicted)
+        self._lora = None
+        if lora_adapters:
+            from ray_tpu.llm.lora import LoRAManager
+
+            self._lora = LoRAManager(self._engine.params)
+            for name, adapter in lora_adapters.items():
+                self._lora.register(name, adapter)
+        self._engines_lock = threading.Lock()
         self._cv = threading.Condition()
-        self._done: Dict[int, List[int]] = {}
-        self._waiters: Dict[int, List[int]] = {}
+        self._done: Dict[Any, List[int]] = {}
+        self._waiters: Dict[Any, List[int]] = {}
         self._stop = False
         self._error: Optional[BaseException] = None
         self._loop = threading.Thread(target=self._run, daemon=True,
                                       name="llm-engine-loop")
         self._loop.start()
 
+    def lora_model_ids(self) -> List[str]:
+        return self._lora.adapter_names() if self._lora else []
+
+    _MAX_ADAPTER_ENGINES = 4
+
+    def _engine_for(self, model: Optional[str]):
+        """(engine_key, engine): base for None/unknown ids, a lazily-built
+        merged-weights engine for registered adapters. The merge + engine
+        compile happens OUTSIDE _engines_lock (the _run loop takes it every
+        iteration — holding it through an XLA compile would freeze every
+        in-flight stream); idle adapter engines are LRU-evicted so HBM
+        stays bounded by _MAX_ADAPTER_ENGINES, not by adapters-ever-used."""
+        if not model or self._lora is None or model not in self._lora.adapter_names():
+            return None, self._engine
+        with self._engines_lock:
+            eng = self._engines.get(model)
+            if eng is not None:
+                self._engine_order.remove(model)
+                self._engine_order.append(model)
+                return model, eng
+        from ray_tpu.llm.engine import JaxLLMEngine
+
+        built = JaxLLMEngine(self._config, self._lora.params_for(model))
+        with self._engines_lock:
+            eng = self._engines.setdefault(model, built)  # racing build: first wins
+            if model in self._engine_order:
+                self._engine_order.remove(model)
+            self._engine_order.append(model)
+            # evict idle adapter engines beyond the cap (never the base, and
+            # never one with requests in flight)
+            extra = len(self._engine_order) - self._MAX_ADAPTER_ENGINES
+            for name in list(self._engine_order):
+                if extra <= 0:
+                    break
+                if name != model and not self._engines[name].has_work():
+                    del self._engines[name]
+                    self._engine_order.remove(name)
+                    extra -= 1
+            return model, eng
+
     def _run(self):
         while not self._stop:
-            if not self._engine.has_work():
+            with self._engines_lock:
+                engines = list(self._engines.items())
+            worked = False
+            for key, engine in engines:
+                if not engine.has_work():
+                    continue
+                worked = True
+                try:
+                    emitted = engine.step()
+                except BaseException as e:  # noqa: BLE001 — fail waiters, not hang
+                    with self._cv:
+                        self._error = e
+                        self._cv.notify_all()
+                    return
+                if emitted:
+                    with self._cv:
+                        for rid, toks in emitted.items():
+                            self._waiters.setdefault((key, rid), []).extend(toks)
+                        with engine._lock:
+                            live = set(engine._requests)
+                        for wkey in list(self._waiters):
+                            if wkey[0] == key and wkey[1] not in live:
+                                self._done[wkey] = self._waiters.pop(wkey)
+                        self._cv.notify_all()
+            if not worked:
                 time.sleep(0.002)
-                continue
-            try:
-                emitted = self._engine.step()
-            except BaseException as e:  # noqa: BLE001 — fail waiters, not hang
-                with self._cv:
-                    self._error = e
-                    self._cv.notify_all()
-                return
-            if emitted:
-                with self._cv:
-                    for rid, toks in emitted.items():
-                        self._waiters.setdefault(rid, []).extend(toks)
-                    with self._engine._lock:
-                        live = set(self._engine._requests)
-                    for rid in list(self._waiters):
-                        if rid not in live:
-                            self._done[rid] = self._waiters.pop(rid)
-                    self._cv.notify_all()
 
     def shutdown(self):
         self._stop = True
 
     def generate(self, prompt: Sequence[int],
                  max_new_tokens: int = 64, temperature: float = 0.0,
-                 top_k: int = 0, stop_token_ids: Sequence[int] = ()) -> List[int]:
+                 top_k: int = 0, stop_token_ids: Sequence[int] = (),
+                 model: Optional[str] = None) -> List[int]:
         """Generate completion token ids for one prompt (sync; batching with
-        concurrent callers happens inside the engine)."""
+        concurrent callers happens inside the engine). ``model`` selects a
+        registered LoRA adapter (None/base id -> base weights)."""
         gen = GenerationConfig(max_new_tokens=max_new_tokens,
                                temperature=temperature, top_k=top_k,
                                stop_token_ids=tuple(stop_token_ids))
-        rid = self._engine.add_request(list(prompt), gen)
+        key, engine = self._engine_for(model)
+        wkey = (key, engine.add_request(list(prompt), gen))
         with self._cv:
-            while rid not in self._done:
+            while wkey not in self._done:
                 if self._error is not None:
                     raise RuntimeError("LLM engine loop failed") from self._error
                 if self._stop:
                     raise RuntimeError("LLM server shut down")
                 self._cv.wait(timeout=0.1)
-            return self._done.pop(rid)
+            return self._done.pop(wkey)
 
     def generate_stream(self, prompt: Sequence[int],
                         max_new_tokens: int = 64, temperature: float = 0.0,
-                        top_k: int = 0, stop_token_ids: Sequence[int] = ()):
+                        top_k: int = 0, stop_token_ids: Sequence[int] = (),
+                        model: Optional[str] = None):
         """Yield token chunks AS DECODED — pair with
         ``.options(num_returns="streaming")`` on the actor method so callers
         iterate an ObjectRefGenerator while decoding continues (reference:
@@ -87,7 +155,8 @@ class LLMServer:
         gen = GenerationConfig(max_new_tokens=max_new_tokens,
                                temperature=temperature, top_k=top_k,
                                stop_token_ids=tuple(stop_token_ids))
-        rid = self._engine.add_request(list(prompt), gen)
+        key, engine = self._engine_for(model)
+        wkey = (key, engine.add_request(list(prompt), gen))
         sent = 0
         while True:
             with self._cv:
@@ -96,15 +165,15 @@ class LLMServer:
                         raise RuntimeError("LLM engine loop failed") from self._error
                     if self._stop:
                         raise RuntimeError("LLM server shut down")
-                    done = rid in self._done
-                    buf = self._done[rid] if done else self._waiters.get(rid, [])
+                    done = wkey in self._done
+                    buf = self._done[wkey] if done else self._waiters.get(wkey, [])
                     if len(buf) > sent or done:
                         break
                     self._cv.wait(timeout=0.1)
                 chunk = list(buf[sent:])
                 sent += len(chunk)
                 if done:
-                    self._done.pop(rid, None)
+                    self._done.pop(wkey, None)
             if chunk:
                 yield chunk
             if done:
@@ -118,6 +187,7 @@ class LLMServer:
             temperature=request.get("temperature", 0.0),
             top_k=request.get("top_k", 0),
             stop_token_ids=request.get("stop_token_ids", ()),
+            model=request.get("model"),
         )
         return {"tokens": toks}
 
@@ -126,7 +196,8 @@ class LLMServer:
 
 
 def build_llm_deployment(llm_config: LLMConfig, params=None, *,
-                         name: str = "llm"):
+                         name: str = "llm",
+                         lora_adapters: Optional[Dict[str, Any]] = None):
     """An Application serving ``llm_config`` (reference:
     llm/_internal/serve build_openai_app / LLMServer deployment).
 
@@ -143,4 +214,4 @@ def build_llm_deployment(llm_config: LLMConfig, params=None, *,
         max_ongoing_requests=max(8, llm_config.max_batch_size),
         ray_actor_options={"resources": llm_config.resources_per_replica()},
     )
-    return deployment.bind(llm_config, params)
+    return deployment.bind(llm_config, params, lora_adapters)

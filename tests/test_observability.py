@@ -194,3 +194,23 @@ def test_tracing_spans_on_timeline(ray_start_regular):
         _time.sleep(0.05)
     assert "my-phase" in names
     assert any("heavy" in n for n in names)
+
+
+def test_dashboard_web_ui(ray_start_regular):
+    """The head serves the zero-build UI at / (reference: dashboard/client/
+    React app; here a single static page over the same JSON endpoints)."""
+    import json
+    import urllib.request
+
+    from ray_tpu.dashboard.head import start_dashboard
+
+    d = start_dashboard(port=0)
+    try:
+        html = urllib.request.urlopen(d.url + "/", timeout=10).read().decode()
+        assert "ray_tpu dashboard" in html
+        assert "/api/cluster_status" in html  # the page polls the real API
+        status = json.loads(urllib.request.urlopen(
+            d.url + "/api/cluster_status", timeout=10).read())
+        assert status["nodes"]
+    finally:
+        d.shutdown()
