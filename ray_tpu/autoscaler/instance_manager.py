@@ -162,6 +162,11 @@ class InstanceManager:
             except Exception as e:  # noqa: BLE001
                 inst.to(ALLOCATION_FAILED, str(e))
         elif inst.status == ALLOCATION_FAILED:
+            if inst.provider_id is not None and inst.provider_id in groups:
+                # the create DID land, just after the timeout (eventual
+                # consistency): recover the allocation instead of churning
+                inst.to(ALLOCATED)
+                return
             if inst.retries >= self._max_retries:
                 inst.to(FAILED, f"gave up after {inst.retries} retries: "
                                 f"{inst.last_error}")

@@ -125,6 +125,8 @@ class LoRAManager:
     def register(self, name: str, adapter: Dict[str, Any]):
         self._adapters[name] = adapter
         self._merged.pop(name, None)
+        if name in self._order:
+            self._order.remove(name)
 
     def adapter_names(self):
         return sorted(self._adapters)

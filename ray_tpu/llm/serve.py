@@ -33,14 +33,9 @@ class LLMServer:
         self._config = llm_config
         self._engine = JaxLLMEngine(llm_config, params)
         self._engines: Dict[Optional[str], Any] = {None: self._engine}
+        self._engine_gen: Dict[Optional[str], int] = {None: 0}
         self._engine_order: list = []  # adapter LRU (base never evicted)
-        self._lora = None
-        if lora_adapters:
-            from ray_tpu.llm.lora import LoRAManager
-
-            self._lora = LoRAManager(self._engine.params)
-            for name, adapter in lora_adapters.items():
-                self._lora.register(name, adapter)
+        self._adapters: Dict[str, Any] = dict(lora_adapters or {})
         self._engines_lock = threading.Lock()
         self._cv = threading.Condition()
         self._done: Dict[Any, List[int]] = {}
@@ -52,44 +47,65 @@ class LLMServer:
         self._loop.start()
 
     def lora_model_ids(self) -> List[str]:
-        return self._lora.adapter_names() if self._lora else []
+        return sorted(self._adapters)
 
     _MAX_ADAPTER_ENGINES = 4
 
-    def _engine_for(self, model: Optional[str]):
-        """(engine_key, engine): base for None/unknown ids, a lazily-built
-        merged-weights engine for registered adapters. The merge + engine
-        compile happens OUTSIDE _engines_lock (the _run loop takes it every
-        iteration — holding it through an XLA compile would freeze every
-        in-flight stream); idle adapter engines are LRU-evicted so HBM
-        stays bounded by _MAX_ADAPTER_ENGINES, not by adapters-ever-used."""
-        if not model or self._lora is None or model not in self._lora.adapter_names():
-            return None, self._engine
-        with self._engines_lock:
-            eng = self._engines.get(model)
-            if eng is not None:
-                self._engine_order.remove(model)
-                self._engine_order.append(model)
-                return model, eng
-        from ray_tpu.llm.engine import JaxLLMEngine
+    def _submit(self, model: Optional[str], prompt, gen):
+        """Resolve the engine for ``model`` and enqueue the request under
+        ONE _engines_lock critical section, returning the waiter key.
 
-        built = JaxLLMEngine(self._config, self._lora.params_for(model))
-        with self._engines_lock:
-            eng = self._engines.setdefault(model, built)  # racing build: first wins
-            if model in self._engine_order:
-                self._engine_order.remove(model)
-            self._engine_order.append(model)
-            # evict idle adapter engines beyond the cap (never the base, and
-            # never one with requests in flight)
-            extra = len(self._engine_order) - self._MAX_ADAPTER_ENGINES
-            for name in list(self._engine_order):
-                if extra <= 0:
-                    break
-                if name != model and not self._engines[name].has_work():
-                    del self._engines[name]
-                    self._engine_order.remove(name)
-                    extra -= 1
-            return model, eng
+        Invariants this protects (each was a bug once):
+          - the merge + XLA compile happens OUTSIDE the lock (the _run loop
+            takes it every iteration; compiling under it would freeze every
+            in-flight stream);
+          - add_request runs while holding the lock, so the eviction scan
+            (which only removes engines with has_work() false, also under
+            the lock) can never orphan a just-submitted request;
+          - waiter keys carry the engine's BUILD GENERATION: a rebuilt
+            engine restarts its request-id counter, and without the gen a
+            new request could collide with an abandoned one's buffers."""
+        if not model or model not in self._adapters:
+            return (None, 0, self._engine.add_request(prompt, gen))
+        built = None
+        while True:
+            with self._engines_lock:
+                eng = self._engines.get(model)
+                if eng is None and built is not None:
+                    self._engine_gen[model] = self._engine_gen.get(model, 0) + 1
+                    self._engines[model] = eng = built
+                if eng is not None:
+                    rid = eng.add_request(prompt, gen)
+                    if model in self._engine_order:
+                        self._engine_order.remove(model)
+                    self._engine_order.append(model)
+                    self._evict_idle_locked(keep=model)
+                    return (model, self._engine_gen[model], rid)
+            # build outside the lock: merged weights are owned solely by the
+            # engine map (single LRU bounds HBM)
+            from ray_tpu.llm.engine import JaxLLMEngine
+            from ray_tpu.llm.lora import merge_lora
+
+            built = JaxLLMEngine(
+                self._config, merge_lora(self._engine.params,
+                                         self._adapters[model]))
+
+    def _evict_idle_locked(self, keep):
+        extra = len(self._engine_order) - self._MAX_ADAPTER_ENGINES
+        for name in list(self._engine_order):
+            if extra <= 0:
+                break
+            if name != keep and not self._engines[name].has_work():
+                del self._engines[name]
+                self._engine_order.remove(name)
+                extra -= 1
+                # drop the evicted engine's stale result buffers (abandoned
+                # streams otherwise leak and could confuse a rebuilt engine)
+                with self._cv:
+                    for wkey in [k for k in self._done if k[0] == name]:
+                        del self._done[wkey]
+                    for wkey in [k for k in self._waiters if k[0] == name]:
+                        del self._waiters[wkey]
 
     def _run(self):
         while not self._stop:
@@ -100,6 +116,7 @@ class LLMServer:
                 if not engine.has_work():
                     continue
                 worked = True
+                gen_id = self._engine_gen.get(key, 0)
                 try:
                     emitted = engine.step()
                 except BaseException as e:  # noqa: BLE001 — fail waiters, not hang
@@ -110,11 +127,13 @@ class LLMServer:
                 if emitted:
                     with self._cv:
                         for rid, toks in emitted.items():
-                            self._waiters.setdefault((key, rid), []).extend(toks)
+                            self._waiters.setdefault(
+                                (key, gen_id, rid), []).extend(toks)
                         with engine._lock:
                             live = set(engine._requests)
                         for wkey in list(self._waiters):
-                            if wkey[0] == key and wkey[1] not in live:
+                            if (wkey[0] == key and wkey[1] == gen_id
+                                    and wkey[2] not in live):
                                 self._done[wkey] = self._waiters.pop(wkey)
                         self._cv.notify_all()
             if not worked:
@@ -133,8 +152,7 @@ class LLMServer:
         gen = GenerationConfig(max_new_tokens=max_new_tokens,
                                temperature=temperature, top_k=top_k,
                                stop_token_ids=tuple(stop_token_ids))
-        key, engine = self._engine_for(model)
-        wkey = (key, engine.add_request(list(prompt), gen))
+        wkey = self._submit(model, list(prompt), gen)
         with self._cv:
             while wkey not in self._done:
                 if self._error is not None:
@@ -155,8 +173,7 @@ class LLMServer:
         gen = GenerationConfig(max_new_tokens=max_new_tokens,
                                temperature=temperature, top_k=top_k,
                                stop_token_ids=tuple(stop_token_ids))
-        key, engine = self._engine_for(model)
-        wkey = (key, engine.add_request(list(prompt), gen))
+        wkey = self._submit(model, list(prompt), gen)
         sent = 0
         while True:
             with self._cv:

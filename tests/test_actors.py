@@ -9,6 +9,8 @@ import pytest
 
 import ray_tpu
 
+pytestmark = pytest.mark.slow  # module lane: see pytest.ini
+
 
 @ray_tpu.remote
 class Counter:

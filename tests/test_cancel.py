@@ -5,6 +5,8 @@ import time
 
 import pytest
 
+pytestmark = pytest.mark.slow  # module lane: see pytest.ini
+
 
 def test_cancel_running_task(ray_start_regular):
     import ray_tpu

@@ -11,6 +11,8 @@ import sys
 
 import pytest
 
+pytestmark = pytest.mark.slow  # module lane: see pytest.ini
+
 
 def _run(tmp_path, *argv, timeout=120, check=True):
     env = dict(os.environ)

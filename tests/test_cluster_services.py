@@ -12,6 +12,8 @@ import pytest
 
 import ray_tpu
 
+pytestmark = pytest.mark.slow  # module lane: see pytest.ini
+
 
 # -- job submission ----------------------------------------------------------
 

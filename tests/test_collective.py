@@ -11,6 +11,8 @@ import pytest
 import ray_tpu
 from ray_tpu.util import collective as col
 
+pytestmark = pytest.mark.slow  # module lane: see pytest.ini
+
 
 def _make_worker_class():
     # Defined inside a function so cloudpickle ships the class by value

@@ -10,6 +10,8 @@ import ray_tpu
 from ray_tpu.dag import InputNode, MultiOutputNode, allreduce
 from ray_tpu.experimental.channel import ChannelClosed, ShmChannel
 
+pytestmark = pytest.mark.slow  # module lane: see pytest.ini
+
 
 # ---------------------------------------------------------------------------
 # channel unit tests (no cluster)

@@ -8,6 +8,8 @@ import pytest
 import ray_tpu
 from ray_tpu import data as rd
 
+pytestmark = pytest.mark.slow  # module lane: see pytest.ini
+
 
 @pytest.fixture
 def ray4(ray_start_regular):

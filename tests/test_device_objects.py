@@ -9,6 +9,8 @@ import pytest
 
 import ray_tpu
 
+pytestmark = pytest.mark.slow  # module lane: see pytest.ini
+
 
 def test_device_ref_local_roundtrip():
     from ray_tpu.experimental.device_objects import (

@@ -13,6 +13,8 @@ import pytest
 
 import ray_tpu
 
+pytestmark = pytest.mark.slow  # module lane: see pytest.ini
+
 
 def _wait_node_count(w, n, timeout=20):
     deadline = time.monotonic() + timeout

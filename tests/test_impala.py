@@ -3,6 +3,8 @@
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # module lane: see pytest.ini
+
 
 def test_vtrace_reduces_to_nstep_on_policy():
     """With target == behavior policy (all rhos = 1), V-trace targets equal

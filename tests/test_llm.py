@@ -18,6 +18,8 @@ from ray_tpu.llm import (
 )
 from ray_tpu.models.llama import LlamaConfig
 
+pytestmark = pytest.mark.slow  # module lane: see pytest.ini
+
 
 @pytest.fixture(scope="module")
 def tiny_cfg():

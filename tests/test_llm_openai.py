@@ -6,6 +6,8 @@ import urllib.request
 
 import pytest
 
+pytestmark = pytest.mark.slow  # module lane: see pytest.ini
+
 
 @pytest.fixture(scope="module")
 def openai_app():

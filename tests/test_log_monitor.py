@@ -10,6 +10,10 @@ import subprocess
 import sys
 import textwrap
 
+import pytest
+
+pytestmark = pytest.mark.slow  # module lane: see pytest.ini
+
 
 def _run_driver(script: str) -> str:
     env = dict(os.environ)

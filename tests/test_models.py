@@ -8,6 +8,8 @@ import pytest
 from ray_tpu.models.llama import LlamaConfig, forward, init_params, loss_fn, param_specs
 from ray_tpu.parallel import MeshSpec, make_train_step
 
+pytestmark = pytest.mark.slow  # module lane: see pytest.ini
+
 
 @pytest.fixture(scope="module")
 def cfg():

@@ -4,6 +4,8 @@ import pytest
 
 import ray_tpu
 
+pytestmark = pytest.mark.slow  # module lane: see pytest.ini
+
 
 def _make_fns():
     # defined via closure so cloudpickle ships them by value (tests/ is not

@@ -10,6 +10,8 @@ import pytest
 
 import ray_tpu
 
+pytestmark = pytest.mark.slow  # module lane: see pytest.ini
+
 
 def test_state_api_tasks_and_nodes(ray_start_regular):
     @ray_tpu.remote

@@ -17,6 +17,8 @@ from ray_tpu.ops.norms import rms_norm
 from ray_tpu.ops.ring_attention import ring_attention
 from ray_tpu.ops.rope import apply_rope, rope_frequencies
 
+pytestmark = pytest.mark.slow  # module lane: see pytest.ini
+
 
 def _qkv(B=2, S=256, Hq=4, Hkv=2, D=64, dtype=jnp.float32):
     ks = jax.random.split(jax.random.PRNGKey(0), 3)

@@ -3,6 +3,10 @@ modules/reporter + `ray stack`)."""
 
 import time
 
+import pytest
+
+pytestmark = pytest.mark.slow  # module lane: see pytest.ini
+
 
 def test_node_stats(ray_start_regular):
     import ray_tpu

@@ -7,6 +7,8 @@ algorithm a handful of iterations — but each must beat its untrained self.
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # module lane: see pytest.ini
+
 
 @pytest.fixture(scope="module")
 def cluster():

@@ -7,6 +7,8 @@ import pytest
 
 import ray_tpu
 
+pytestmark = pytest.mark.slow  # module lane: see pytest.ini
+
 
 def test_env_vars_on_dedicated_worker(ray_start_regular):
     @ray_tpu.remote

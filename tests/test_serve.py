@@ -9,6 +9,8 @@ import pytest
 import ray_tpu
 from ray_tpu import serve
 
+pytestmark = pytest.mark.slow  # module lane: see pytest.ini
+
 
 @pytest.fixture
 def serve_cluster(ray_start_regular):

@@ -24,6 +24,8 @@ from ray_tpu.data import ActorPoolStrategy
 from ray_tpu.data._internal import streaming_executor as se
 from ray_tpu.data.context import DataContext
 
+pytestmark = pytest.mark.slow  # module lane: see pytest.ini
+
 
 @pytest.fixture
 def ctx(ray_start_regular):

@@ -3,6 +3,8 @@ over one shared execution)."""
 
 import pytest
 
+pytestmark = pytest.mark.slow  # module lane: see pytest.ini
+
 
 def test_streaming_split_equal_covers_disjointly(ray_start_regular):
     import ray_tpu

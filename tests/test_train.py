@@ -16,6 +16,8 @@ from ray_tpu.train import (
     ScalingConfig,
 )
 
+pytestmark = pytest.mark.slow  # module lane: see pytest.ini
+
 
 @pytest.fixture
 def ray4(ray_start_regular):

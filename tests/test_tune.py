@@ -9,6 +9,8 @@ from ray_tpu import tune
 from ray_tpu.train import RunConfig
 from ray_tpu.tune import TuneConfig, Tuner
 
+pytestmark = pytest.mark.slow  # module lane: see pytest.ini
+
 
 @pytest.fixture
 def ray4(ray_start_regular):

@@ -8,6 +8,8 @@ import time
 
 import pytest
 
+pytestmark = pytest.mark.slow  # module lane: see pytest.ini
+
 
 def test_default_loggers_and_custom_callback(ray_start_regular, tmp_path):
     import ray_tpu

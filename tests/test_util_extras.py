@@ -10,6 +10,8 @@ import time
 
 import pytest
 
+pytestmark = pytest.mark.slow  # module lane: see pytest.ini
+
 
 def test_gpu_accelerator_manager_registered():
     from ray_tpu._private.accelerators import (
