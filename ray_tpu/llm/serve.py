@@ -40,6 +40,9 @@ class LLMServer:
         self._cv = threading.Condition()
         self._done: Dict[Any, List[int]] = {}
         self._waiters: Dict[Any, List[int]] = {}
+        # wkeys some caller is still consuming — eviction cleanup must not
+        # delete their results out from under them (guarded by _cv's lock)
+        self._active_waiters: set = set()
         self._stop = False
         self._error: Optional[BaseException] = None
         self._loop = threading.Thread(target=self._run, daemon=True,
@@ -66,7 +69,10 @@ class LLMServer:
             engine restarts its request-id counter, and without the gen a
             new request could collide with an abandoned one's buffers."""
         if not model or model not in self._adapters:
-            return (None, 0, self._engine.add_request(prompt, gen))
+            wkey = (None, 0, self._engine.add_request(prompt, gen))
+            with self._cv:
+                self._active_waiters.add(wkey)
+            return wkey
         built = None
         while True:
             with self._engines_lock:
@@ -76,11 +82,14 @@ class LLMServer:
                     self._engines[model] = eng = built
                 if eng is not None:
                     rid = eng.add_request(prompt, gen)
+                    wkey = (model, self._engine_gen[model], rid)
+                    with self._cv:
+                        self._active_waiters.add(wkey)
                     if model in self._engine_order:
                         self._engine_order.remove(model)
                     self._engine_order.append(model)
                     self._evict_idle_locked(keep=model)
-                    return (model, self._engine_gen[model], rid)
+                    return wkey
             # build outside the lock: merged weights are owned solely by the
             # engine map (single LRU bounds HBM)
             from ray_tpu.llm.engine import JaxLLMEngine
@@ -99,12 +108,15 @@ class LLMServer:
                 del self._engines[name]
                 self._engine_order.remove(name)
                 extra -= 1
-                # drop the evicted engine's stale result buffers (abandoned
-                # streams otherwise leak and could confuse a rebuilt engine)
+                # drop the evicted engine's ABANDONED result buffers only:
+                # a finished-but-unclaimed result may still have a live
+                # caller between cv polls — never delete under a waiter
                 with self._cv:
-                    for wkey in [k for k in self._done if k[0] == name]:
+                    for wkey in [k for k in self._done
+                                 if k[0] == name and k not in self._active_waiters]:
                         del self._done[wkey]
-                    for wkey in [k for k in self._waiters if k[0] == name]:
+                    for wkey in [k for k in self._waiters
+                                 if k[0] == name and k not in self._active_waiters]:
                         del self._waiters[wkey]
 
     def _run(self):
@@ -153,14 +165,18 @@ class LLMServer:
                                temperature=temperature, top_k=top_k,
                                stop_token_ids=tuple(stop_token_ids))
         wkey = self._submit(model, list(prompt), gen)
-        with self._cv:
-            while wkey not in self._done:
-                if self._error is not None:
-                    raise RuntimeError("LLM engine loop failed") from self._error
-                if self._stop:
-                    raise RuntimeError("LLM server shut down")
-                self._cv.wait(timeout=0.1)
-            return self._done.pop(wkey)
+        try:
+            with self._cv:
+                while wkey not in self._done:
+                    if self._error is not None:
+                        raise RuntimeError("LLM engine loop failed") from self._error
+                    if self._stop:
+                        raise RuntimeError("LLM server shut down")
+                    self._cv.wait(timeout=0.1)
+                return self._done.pop(wkey)
+        finally:
+            with self._cv:
+                self._active_waiters.discard(wkey)
 
     def generate_stream(self, prompt: Sequence[int],
                         max_new_tokens: int = 64, temperature: float = 0.0,
@@ -175,26 +191,30 @@ class LLMServer:
                                stop_token_ids=tuple(stop_token_ids))
         wkey = self._submit(model, list(prompt), gen)
         sent = 0
-        while True:
-            with self._cv:
-                while True:
-                    if self._error is not None:
-                        raise RuntimeError("LLM engine loop failed") from self._error
-                    if self._stop:
-                        raise RuntimeError("LLM server shut down")
-                    done = wkey in self._done
-                    buf = self._done[wkey] if done else self._waiters.get(wkey, [])
-                    if len(buf) > sent or done:
-                        break
-                    self._cv.wait(timeout=0.1)
-                chunk = list(buf[sent:])
-                sent += len(chunk)
+        try:
+            while True:
+                with self._cv:
+                    while True:
+                        if self._error is not None:
+                            raise RuntimeError("LLM engine loop failed") from self._error
+                        if self._stop:
+                            raise RuntimeError("LLM server shut down")
+                        done = wkey in self._done
+                        buf = self._done[wkey] if done else self._waiters.get(wkey, [])
+                        if len(buf) > sent or done:
+                            break
+                        self._cv.wait(timeout=0.1)
+                    chunk = list(buf[sent:])
+                    sent += len(chunk)
+                    if done:
+                        self._done.pop(wkey, None)
+                if chunk:
+                    yield chunk
                 if done:
-                    self._done.pop(wkey, None)
-            if chunk:
-                yield chunk
-            if done:
-                return
+                    return
+        finally:
+            with self._cv:
+                self._active_waiters.discard(wkey)
 
     def __call__(self, request: Dict[str, Any]) -> Dict[str, Any]:
         """HTTP-style entry: {"prompt": [ids], "max_new_tokens": n, ...}."""

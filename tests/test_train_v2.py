@@ -1,0 +1,76 @@
+"""Train v2: the control loop in its own process (VERDICT r1 §2.3 partial —
+"no separate v2 API/controller process split").
+
+reference: python/ray/train/v2/ — TrainController
+(controller/controller.py:93) runs outside the driver; v2 trainers launch
+it, poll status, and can re-attach to a named detached controller after a
+driver restart.
+"""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.train import ScalingConfig
+
+pytestmark = pytest.mark.slow  # module lane: see pytest.ini
+
+
+def _make_loop():
+    # defined in a function so cloudpickle serializes it by value (test
+    # modules are not importable from the controller/worker processes)
+    def _loop(config):
+        from ray_tpu import train
+
+        for step in range(3):
+            train.report({"step": step, "loss": 1.0 / (step + 1)})
+
+    return _loop
+
+
+@pytest.fixture
+def cluster():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_v2_fit_runs_in_controller_process(cluster):
+    from ray_tpu.train.v2 import JaxTrainer
+
+    trainer = JaxTrainer(
+        _make_loop(), scaling_config=ScalingConfig(num_workers=2, use_tpu=False))
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["step"] == 2
+    assert len(result.metrics_history) == 3
+
+
+def test_v2_fit_async_status_and_result(cluster):
+    from ray_tpu.train.v2 import JaxTrainer
+
+    trainer = JaxTrainer(
+        _make_loop(), scaling_config=ScalingConfig(num_workers=1, use_tpu=False))
+    handle = trainer.fit_async()
+    st = handle.status()
+    assert st["state"] in ("RUNNING", "FINISHED")
+    result = handle.result(timeout=300)
+    assert result.error is None
+    assert handle.status()["state"] == "FINISHED"
+    assert handle.status()["iterations"] == 3
+
+
+def test_v2_detached_controller_attach(cluster):
+    """A named detached controller outlives the handle; attach() re-joins
+    and retrieves the result (the driver-restart story)."""
+    from ray_tpu.train.v2 import JaxTrainer
+
+    trainer = JaxTrainer(
+        _make_loop(), detached_name="train-v2-ctl",
+        scaling_config=ScalingConfig(num_workers=1, use_tpu=False))
+    handle = trainer.fit_async()
+    del handle  # "driver" loses its handle
+
+    attached = JaxTrainer.attach("train-v2-ctl")
+    result = attached.result(timeout=300)
+    assert result.error is None and result.metrics["step"] == 2
+    ray_tpu.kill(attached._actor)
