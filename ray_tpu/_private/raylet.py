@@ -1040,22 +1040,34 @@ class Raylet:
                 out.append({"pid": pid, "error": str(e)})
         return out
 
-    def HandleAgentProfile(self, req, reply_token):
-        """Sampling CPU profile of one worker (by pid)."""
-        addrs = self._worker_addrs(req.get("pid"))
+    def _proxy_worker_call(self, pid, method: str, payload: dict, reply_token):
+        """Forward an agent endpoint to the worker owning ``pid`` with a
+        delayed reply (shared by the profiler endpoints)."""
+        addrs = self._worker_addrs(pid)
         if not addrs:
-            raise ValueError(f"no worker with pid {req.get('pid')}")
+            raise ValueError(f"no worker with pid {pid}")
         _, addr = addrs[0]
-        cli = self.pool.get(tuple(addr))
-        fut = cli.call_async("CpuProfile", {
-            "duration_s": req.get("duration_s", 5.0),
-            "interval_s": req.get("interval_s", 0.01),
-        })
+        fut = self.pool.get(tuple(addr)).call_async(method, payload)
         server = self.server
         fut.add_done_callback(
             lambda f: server.send_error_reply(reply_token, f.exception())
             if f.exception() else server.send_reply(reply_token, f.result()))
         return RpcServer.DELAYED_REPLY
+
+    def HandleAgentProfile(self, req, reply_token):
+        """Sampling CPU profile of one worker (by pid)."""
+        return self._proxy_worker_call(req.get("pid"), "CpuProfile", {
+            "duration_s": req.get("duration_s", 5.0),
+            "interval_s": req.get("interval_s", 0.01),
+        }, reply_token)
+
+    def HandleAgentJaxProfile(self, req, reply_token):
+        """JAX/XPlane trace of one worker (by pid) — the TPU profiler
+        analog of the reporter's py-spy endpoint."""
+        return self._proxy_worker_call(req.get("pid"), "JaxProfile", {
+            "duration_s": req.get("duration_s", 3.0),
+            "logdir": req.get("logdir"),
+        }, reply_token)
 
     def HandleListWorkers(self, req):
         """reference: `ray list workers` (worker pool state)."""

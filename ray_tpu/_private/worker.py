@@ -20,6 +20,7 @@ import hashlib
 import logging
 import os
 import sys
+import tempfile
 import threading
 import time
 import traceback
@@ -873,6 +874,41 @@ class CoreWorker:
             "pid": os.getpid(), "samples": n,
             "stacks": [{"count": c, "stack": s} for s, c in top],
         })
+
+    def HandleJaxProfile(self, req, reply_token):
+        """Capture a JAX profiler trace (XPlane) for ``duration_s``
+        (reference: the GPU profilers shipped as runtime-env plugins,
+        _private/runtime_env/nsight.py; the TPU-native analog is the jax
+        profiler — SURVEY §5 tracing). Returns the trace directory + files;
+        open with TensorBoard or xprof."""
+        duration = min(float(req.get("duration_s", 3.0)), 60.0)
+        logdir = req.get("logdir") or os.path.join(
+            tempfile.gettempdir(), f"ray-tpu-jaxprof-{os.getpid()}-{int(time.time())}")
+        server = self.server
+
+        def run():
+            try:
+                import jax
+
+                os.makedirs(logdir, exist_ok=True)
+                jax.profiler.start_trace(logdir)
+                time.sleep(duration)
+                jax.profiler.stop_trace()
+                files = []
+                for dp, _, fs in os.walk(logdir):
+                    files.extend(os.path.join(dp, f) for f in fs)
+                server.send_reply(reply_token, {
+                    "pid": os.getpid(), "logdir": logdir,
+                    "files": sorted(files),
+                })
+            except Exception as e:  # noqa: BLE001 — the caller must hear back
+                try:
+                    server.send_error_reply(reply_token, e)
+                except Exception:  # noqa: BLE001
+                    pass
+
+        threading.Thread(target=run, daemon=True, name="jax-profiler").start()
+        return RpcServer.DELAYED_REPLY
 
     def HandlePubsubMessage(self, req):
         channel, message = req["channel"], req["message"]

@@ -69,10 +69,8 @@ class LLMServer:
             engine restarts its request-id counter, and without the gen a
             new request could collide with an abandoned one's buffers."""
         if not model or model not in self._adapters:
-            wkey = (None, 0, self._engine.add_request(prompt, gen))
-            with self._cv:
-                self._active_waiters.add(wkey)
-            return wkey
+            # base engine is never evicted, so its waiters need no registry
+            return (None, 0, self._engine.add_request(prompt, gen))
         built = None
         while True:
             with self._engines_lock:

@@ -77,6 +77,9 @@ class TrainControllerActor:
     def get_result(self):
         with self._lock:
             if self._result is None:
+                if self._state == ERRORED:
+                    raise RuntimeError(
+                        f"training controller failed: {self._error}")
                 raise RuntimeError(f"training still {self._state}")
             return self._result
 

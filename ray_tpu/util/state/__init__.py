@@ -7,6 +7,7 @@ actors/tasks/objects/nodes/...` backed by GCS + per-node agents.
 from ray_tpu.util.state.api import (
     StateApiClient,
     cpu_profile,
+    jax_profile,
     dump_stacks,
     node_stats,
     list_actors,
@@ -25,6 +26,7 @@ __all__ = [
     "node_stats",
     "dump_stacks",
     "cpu_profile",
+    "jax_profile",
     "list_actors",
     "list_jobs",
     "list_nodes",

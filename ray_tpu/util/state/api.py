@@ -183,6 +183,32 @@ class StateApiClient:
                 continue
         raise ValueError(f"no worker with pid {pid} found on any node")
 
+    def jax_profile(self, pid: int, node_id=None, duration_s: float = 3.0,
+                    logdir: Optional[str] = None) -> dict:
+        """Capture a JAX profiler (XPlane) trace on one worker; open the
+        returned logdir with TensorBoard/xprof (SURVEY §5: the TPU analog of
+        the reference's GPU profiler plugins)."""
+        last_error: Optional[Exception] = None
+        for node in self.list_nodes():
+            if node.get("state") == "DEAD":
+                continue
+            if node_id is not None and node["node_id"] != node_id:
+                continue
+            try:
+                return self._w.pool.get(tuple(node["address"])).call(
+                    "AgentJaxProfile",
+                    {"pid": pid, "duration_s": duration_s, "logdir": logdir},
+                    timeout=duration_s + 60)
+            except Exception as e:  # noqa: BLE001 — try other nodes, keep why
+                # the node that HOSTED the pid fails with the real cause;
+                # other nodes fail with 'no worker with pid' noise — never
+                # let the noise overwrite the cause
+                if last_error is None or "no worker with pid" in str(last_error):
+                    last_error = e
+        raise ValueError(
+            f"no worker with pid {pid} found on any node"
+            + (f" (last error: {last_error})" if last_error else ""))
+
     # -- summaries ------------------------------------------------------
 
     def summarize_tasks(self) -> Dict[str, Dict[str, int]]:
@@ -251,3 +277,7 @@ def dump_stacks(node_id=None, pid=None):
 
 def cpu_profile(pid, node_id=None, duration_s: float = 5.0):
     return _client().cpu_profile(pid, node_id, duration_s)
+
+
+def jax_profile(pid, node_id=None, duration_s: float = 3.0, logdir=None):
+    return _client().jax_profile(pid, node_id, duration_s, logdir)

@@ -81,3 +81,49 @@ def test_cpu_profile_catches_busy_function(ray_start_regular):
             hits.append(w["pid"])
     assert hits, "profiler never caught the burner's frames"
     ray_tpu.get(ref, timeout=60)
+
+
+def test_jax_profile_capture(ray_start_regular):
+    """JAX/XPlane trace of a worker running jitted compute (SURVEY §5: the
+    TPU analog of the reference's GPU profiler runtime-env plugins)."""
+    import time as _time
+
+    import ray_tpu
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    class Burner:
+        def pid(self):
+            import os
+
+            return os.getpid()
+
+        def burn(self, seconds):
+            import time as t
+
+            import jax
+            import jax.numpy as jnp
+
+            f = jax.jit(lambda x: (x @ x).sum())
+            x = jnp.ones((128, 128))
+            end = t.monotonic() + seconds
+            while t.monotonic() < end:
+                f(x).block_until_ready()
+            return True
+
+    b = Burner.remote()
+    pid = ray_tpu.get(b.pid.remote())
+    ref = b.burn.remote(12.0)
+    _time.sleep(1.0)  # let the burn start
+    out = None
+    for attempt in range(3):  # the 1-core CI box can lag worker registration
+        try:
+            out = state.jax_profile(pid, duration_s=2.0)
+            break
+        except ValueError:
+            if attempt == 2:
+                raise
+            _time.sleep(2.0)
+    assert out["pid"] == pid
+    assert any(f.endswith(".xplane.pb") for f in out["files"]), out["files"]
+    assert ray_tpu.get(ref, timeout=120) is True
