@@ -98,29 +98,100 @@ def _select_columns_block(cols, block):
 
 # -- all-to-all implementations --------------------------------------------
 
-def _repartition_refs(num_blocks: int, refs: List[Any]) -> List[Any]:
-    import ray_tpu
-    from ray_tpu.data.block import concat_blocks, even_split_ranges, slice_block
+# -- distributed all-to-all kernels (reference: _internal/planner hash
+# shuffle / sort / repartition — map tasks partition each block, reduce
+# tasks own one output partition; NOTHING materializes on the driver) ------
 
-    blocks = ray_tpu.get(list(refs))
-    merged = concat_blocks(blocks)
+def _block_num_rows(block) -> int:
+    from ray_tpu.data.block import to_arrow
+
+    return to_arrow(block).num_rows
+
+
+def _gather_slices(slices, *blocks):
+    """One output block from [(block_idx, start, end), ...] over inputs."""
+    from ray_tpu.data.block import concat_blocks, to_arrow
+
+    tables = [to_arrow(blocks[i]).slice(s, e - s) for i, s, e in slices]
+    return concat_blocks(tables) if tables else concat_blocks(list(blocks)).slice(0, 0)
+
+
+def _repartition_refs(num_blocks: int, refs: List[Any]) -> List[Any]:
+    """Equal-row repartition without driver materialization: count rows per
+    block (tiny tasks), compute global ranges, then one gather task per
+    OUTPUT block reading only the input slices it needs."""
+    import ray_tpu
+    from ray_tpu.data.block import even_split_ranges
+
+    refs = list(refs)
+    if not refs:
+        return refs
+    count = ray_tpu.remote(_block_num_rows)
+    counts = ray_tpu.get([count.remote(r) for r in refs])
+    offsets = [0]
+    for c in counts:
+        offsets.append(offsets[-1] + c)
+    total = offsets[-1]
+    gather = ray_tpu.remote(_gather_slices)
+    if total == 0:
+        return [gather.remote([], refs[0]) for _ in range(num_blocks)]
+    out = []
+    for g_start, g_end in even_split_ranges(total, num_blocks):
+        specs, needed = [], []
+        for i, c in enumerate(counts):
+            b_start, b_end = offsets[i], offsets[i + 1]
+            lo, hi = max(g_start, b_start), min(g_end, b_end)
+            if lo < hi:
+                specs.append((len(needed), lo - b_start, hi - b_start))
+                needed.append(refs[i])
+        # an empty range still yields a (schema-preserving) empty block so
+        # repartition(n) returns exactly n blocks — zip/per-worker splits
+        # depend on the shape
+        out.append(gather.remote(specs, *needed) if specs
+                   else gather.remote([], refs[0]))
+    return out
+
+
+def _random_split_block(seed: Optional[int], block_idx: int, num_parts: int, block):
+    """Map side of the distributed shuffle: assign each row a random output
+    partition (seeded per input block for determinism)."""
+    from ray_tpu.data.block import to_arrow
+
+    t = to_arrow(block)
+    rng = np.random.default_rng(None if seed is None else seed * 1_000_003 + block_idx)
+    assign = rng.integers(0, num_parts, t.num_rows)
+    parts = tuple(t.take(pa.array(np.nonzero(assign == p)[0]))
+                  for p in range(num_parts))
+    return parts if num_parts > 1 else parts[0]
+
+
+def _merge_shuffle_parts(seed: Optional[int], part_idx: int, *parts):
+    """Reduce side: concat this partition's pieces + a local permutation."""
+    from ray_tpu.data.block import concat_blocks
+
+    merged = concat_blocks(list(parts))
     if merged.num_rows == 0:
-        return [ray_tpu.put(merged)]
-    return [ray_tpu.put(slice_block(merged, s, e))
-            for s, e in even_split_ranges(merged.num_rows, num_blocks)]
+        return merged
+    rng = np.random.default_rng(None if seed is None else seed * 7_000_003 + part_idx)
+    return merged.take(pa.array(rng.permutation(merged.num_rows)))
 
 
 def _shuffle_refs(seed: Optional[int], refs: List[Any]) -> List[Any]:
     import ray_tpu
-    from ray_tpu.data.block import concat_blocks, even_split_ranges
 
-    blocks = ray_tpu.get(list(refs))
-    merged = concat_blocks(blocks)
-    rng = np.random.default_rng(seed)
-    perm = rng.permutation(merged.num_rows)
-    shuffled = merged.take(pa.array(perm))
-    return [ray_tpu.put(shuffled.slice(s, e - s))
-            for s, e in even_split_ranges(shuffled.num_rows, max(1, len(refs)))]
+    refs = list(refs)
+    num_parts = max(1, len(refs))
+    if num_parts == 1:
+        merge = ray_tpu.remote(_merge_shuffle_parts)
+        return [merge.remote(seed, 0, *refs)] if refs else refs
+    split = ray_tpu.remote(_random_split_block)
+    parts: List[List[Any]] = [[] for _ in range(num_parts)]
+    for i, ref in enumerate(refs):
+        outs = split.options(num_returns=num_parts).remote(seed, i, num_parts, ref)
+        for p, r in enumerate(outs):
+            parts[p].append(r)
+    merge = ray_tpu.remote(_merge_shuffle_parts)
+    return [merge.remote(seed, p, *parts[p]) for p in range(num_parts)]
 
 
 _AGG_COLUMN_NAMES = {
@@ -591,14 +662,86 @@ def _skip_rows(refs: List[Any], n: int) -> List[Any]:
     return out
 
 
-def _sort_refs(key: str, descending: bool, refs: List[Any]) -> List[Any]:
-    import ray_tpu
+def _sample_key(key: str, n: int, block):
+    from ray_tpu.data.block import to_arrow
+
+    t = to_arrow(block)
+    if t.num_rows == 0:
+        return []
+    idx = np.linspace(0, t.num_rows - 1, min(n, t.num_rows)).astype(np.int64)
+    # nulls never become cut points (Arrow sorts place them at the end)
+    return [v for v in t.column(key).take(pa.array(idx)).to_pylist()
+            if v is not None]
+
+
+def _range_split_block(key: str, bounds: List[Any], null_part: int, block):
+    """Map side of the sample sort: range-partition by the cut points.
+    Null keys go to ``null_part`` so they land at the GLOBAL end after the
+    per-partition Arrow sort (which also places nulls last)."""
+    from ray_tpu.data.block import to_arrow
+
+    t = to_arrow(block)
+    num_parts = len(bounds) + 1
+    if t.num_rows == 0:
+        empty = t.slice(0, 0)
+        return tuple(empty for _ in range(num_parts)) if num_parts > 1 else empty
+    raw = t.column(key).to_pylist()
+    null_mask = np.array([v is None for v in raw])
+    vals = np.asarray([0 if v is None else v for v in raw]) \
+        if null_mask.any() else np.asarray(raw)
+    assign = np.searchsorted(np.asarray(bounds), vals, side="right")
+    if null_mask.any():
+        assign = np.where(null_mask, null_part, assign)
+    parts = tuple(t.take(pa.array(np.nonzero(assign == p)[0]))
+                  for p in range(num_parts))
+    return parts if num_parts > 1 else parts[0]
+
+
+def _sort_merge_parts(key: str, descending: bool, *parts):
     from ray_tpu.data.block import concat_blocks
 
-    merged = concat_blocks(ray_tpu.get(list(refs)))
+    merged = concat_blocks(list(parts))
     order = "descending" if descending else "ascending"
-    sorted_t = merged.sort_by([(key, order)])
-    return [ray_tpu.put(sorted_t)]
+    return merged.sort_by([(key, order)])
+
+
+def _sort_refs(key: str, descending: bool, refs: List[Any]) -> List[Any]:
+    """Distributed sample sort (reference: planner sort — sample -> range
+    partition -> per-partition sort; only the tiny samples touch the
+    driver). Output blocks are globally ordered ascending, reversed for
+    descending."""
+    import ray_tpu
+
+    refs = list(refs)
+    num_parts = len(refs)
+    merge = ray_tpu.remote(_sort_merge_parts)
+    if num_parts <= 1:
+        return [merge.remote(key, descending, *refs)] if refs else refs
+    sample = ray_tpu.remote(_sample_key)
+    samples = sorted(
+        v for vs in ray_tpu.get([sample.remote(key, 20, r) for r in refs])
+        for v in vs)
+    if not samples:
+        return [merge.remote(key, descending, *refs)]
+    # P-1 cut points from the pooled samples
+    bounds = [samples[(i + 1) * len(samples) // num_parts]
+              for i in range(num_parts - 1)]
+    bounds = [b for i, b in enumerate(bounds) if i == 0 or b != bounds[i - 1]]
+    split = ray_tpu.remote(_range_split_block)
+    n_out = len(bounds) + 1
+    # global null placement: ascending ends at the last partition; for
+    # descending the output order is reversed, so nulls ride partition 0
+    null_part = 0 if descending else n_out - 1
+    parts: List[List[Any]] = [[] for _ in range(n_out)]
+    for ref in refs:
+        outs = split.options(num_returns=n_out).remote(key, bounds, null_part, ref)
+        if n_out == 1:
+            parts[0].append(outs)
+        else:
+            for p, r in enumerate(outs):
+                parts[p].append(r)
+    out = [merge.remote(key, descending, *parts[p]) for p in range(n_out)]
+    return out[::-1] if descending else out
 
 
 class Dataset:

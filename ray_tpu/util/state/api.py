@@ -167,27 +167,11 @@ class StateApiClient:
                 out.append(row)
         return out
 
-    def cpu_profile(self, pid: int, node_id=None, duration_s: float = 5.0) -> dict:
-        """Sampling CPU profile of one worker (reference: reporter's
-        profiling endpoint)."""
-        for node in self.list_nodes():
-            if node.get("state") == "DEAD":
-                continue
-            if node_id is not None and node["node_id"] != node_id:
-                continue
-            try:
-                return self._w.pool.get(tuple(node["address"])).call(
-                    "AgentProfile", {"pid": pid, "duration_s": duration_s},
-                    timeout=duration_s + 30)
-            except Exception:  # noqa: BLE001
-                continue
-        raise ValueError(f"no worker with pid {pid} found on any node")
-
-    def jax_profile(self, pid: int, node_id=None, duration_s: float = 3.0,
-                    logdir: Optional[str] = None) -> dict:
-        """Capture a JAX profiler (XPlane) trace on one worker; open the
-        returned logdir with TensorBoard/xprof (SURVEY §5: the TPU analog of
-        the reference's GPU profiler plugins)."""
+    def _agent_call_by_pid(self, method: str, payload: dict, *, pid,
+                           node_id, timeout: float) -> dict:
+        """Try every live node's agent endpoint for ``pid``; the hosting
+        node's real error must never be overwritten by other nodes'
+        'no worker with pid' noise."""
         last_error: Optional[Exception] = None
         for node in self.list_nodes():
             if node.get("state") == "DEAD":
@@ -196,18 +180,30 @@ class StateApiClient:
                 continue
             try:
                 return self._w.pool.get(tuple(node["address"])).call(
-                    "AgentJaxProfile",
-                    {"pid": pid, "duration_s": duration_s, "logdir": logdir},
-                    timeout=duration_s + 60)
-            except Exception as e:  # noqa: BLE001 — try other nodes, keep why
-                # the node that HOSTED the pid fails with the real cause;
-                # other nodes fail with 'no worker with pid' noise — never
-                # let the noise overwrite the cause
+                    method, payload, timeout=timeout)
+            except Exception as e:  # noqa: BLE001
                 if last_error is None or "no worker with pid" in str(last_error):
                     last_error = e
         raise ValueError(
             f"no worker with pid {pid} found on any node"
             + (f" (last error: {last_error})" if last_error else ""))
+
+    def cpu_profile(self, pid: int, node_id=None, duration_s: float = 5.0) -> dict:
+        """Sampling CPU profile of one worker (reference: reporter's
+        profiling endpoint)."""
+        return self._agent_call_by_pid(
+            "AgentProfile", {"pid": pid, "duration_s": duration_s},
+            pid=pid, node_id=node_id, timeout=duration_s + 30)
+
+    def jax_profile(self, pid: int, node_id=None, duration_s: float = 3.0,
+                    logdir: Optional[str] = None) -> dict:
+        """Capture a JAX profiler (XPlane) trace on one worker; open the
+        returned logdir with TensorBoard/xprof (SURVEY §5: the TPU analog of
+        the reference's GPU profiler plugins)."""
+        return self._agent_call_by_pid(
+            "AgentJaxProfile",
+            {"pid": pid, "duration_s": duration_s, "logdir": logdir},
+            pid=pid, node_id=node_id, timeout=duration_s + 60)
 
     # -- summaries ------------------------------------------------------
 

@@ -94,3 +94,25 @@ def test_train_test_split(cluster):
     ids = sorted(r["id"] for r in train.take_all()) + sorted(
         r["id"] for r in test.take_all())
     assert sorted(ids) == list(range(100))
+
+
+def test_distributed_sort_with_nulls(cluster):
+    """Null sort keys survive the distributed sample sort (nulls land at the
+    global end, both directions — Arrow sort_by semantics)."""
+    import ray_tpu
+    from ray_tpu import data as rd
+
+    rows = [{"k": v} for v in [5, None, 1, 4, None, 2, 3, 0]]
+    ds = rd.from_items(rows, parallelism=4)
+    got = [r["k"] for r in ds.sort("k").take_all()]
+    assert got == [0, 1, 2, 3, 4, 5, None, None]
+    got_desc = [r["k"] for r in ds.sort("k", descending=True).take_all()]
+    assert got_desc == [5, 4, 3, 2, 1, 0, None, None]
+
+
+def test_repartition_more_blocks_than_rows(cluster):
+    from ray_tpu import data as rd
+
+    ds = rd.from_items([{"v": i} for i in range(3)], parallelism=2).repartition(8)
+    assert ds.num_blocks() == 8
+    assert sorted(r["v"] for r in ds.take_all()) == [0, 1, 2]
