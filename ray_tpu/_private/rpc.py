@@ -220,14 +220,29 @@ class RpcServer:
         except Exception as e:  # noqa: BLE001
             import traceback
 
-            frame = pickle.dumps(("err", (str(e), traceback.format_exc(), e)), protocol=5)
+            tb = traceback.format_exc()
+            try:
+                frame = pickle.dumps(("err", (str(e), tb, e)), protocol=5)
+            except Exception:  # noqa: BLE001 — e itself unpicklable: a reply
+                # MUST still go out or callers with timeout=None hang forever
+                frame = pickle.dumps(
+                    ("err", (str(e), tb,
+                             RpcError(f"{type(e).__name__}: {e} "
+                                      "(original exception unpicklable)"))),
+                    protocol=5)
         if chaos == "drop_response":
             return
         self._send_frame(sock, send_lock, msg_id, frame)
 
     def send_reply(self, reply_token, value):
         sock, send_lock, msg_id = reply_token
-        frame = pickle.dumps(("ok", value), protocol=5)
+        try:
+            frame = pickle.dumps(("ok", value), protocol=5)
+        except Exception as e:  # noqa: BLE001 — a reply MUST go out, or
+            # callers with timeout=None block forever
+            frame = pickle.dumps(
+                ("err", (f"reply unpicklable: {e}", "",
+                         RpcError(f"reply unpicklable: {e}"))), protocol=5)
         self._send_frame(sock, send_lock, msg_id, frame)
 
     def send_error_reply(self, reply_token, exc: Exception):
@@ -326,7 +341,14 @@ class RpcClient:
                 fut = self._futures.pop(msg_id, None)
                 if fut is None:
                     continue
-                status, value = pickle.loads(body)
+                try:
+                    status, value = pickle.loads(body)
+                except Exception as e:  # noqa: BLE001 — e.g. an exception
+                    # class importable only on the server; fail THIS call,
+                    # not the whole connection
+                    fut.set_exception(RemoteError(
+                        f"undecodable reply: {e}", ""))
+                    continue
                 if status == "ok":
                     fut.set_result(value)
                 else:

@@ -42,6 +42,7 @@ from ray_tpu._private.task_spec import (
     TaskCancelledError,
     TaskError,
     OutOfMemoryError,
+    RayTpuError,
     TaskSpec,
     WorkerCrashedError,
 )
@@ -50,6 +51,20 @@ logger = logging.getLogger(__name__)
 
 DRIVER = "driver"
 WORKER = "worker"
+
+
+def _picklable_error(e: BaseException) -> BaseException:
+    """The reply crosses the wire pickled; an exception holding locks/
+    sockets/local classes would otherwise kill the reply and hang callers.
+    Preserve the message and type name in a plain substitute."""
+    import pickle as _pickle
+
+    try:
+        _pickle.dumps(e)
+        return e
+    except Exception:  # noqa: BLE001
+        return RayTpuError(f"{type(e).__name__}: {e} (original exception "
+                           "unpicklable; see traceback)")
 
 
 class ObjectRef:
@@ -1370,7 +1385,8 @@ class CoreWorker:
                     pass
             self.server.send_reply(
                 reply_token,
-                {"status": "error", "error": e, "traceback": traceback.format_exc()},
+                {"status": "error", "error": _picklable_error(e),
+                 "traceback": traceback.format_exc()},
             )
         finally:
             try:

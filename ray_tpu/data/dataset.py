@@ -675,26 +675,32 @@ def _sample_key(key: str, n: int, block):
 
 
 def _range_split_block(key: str, bounds: List[Any], null_part: int, block):
-    """Map side of the sample sort: range-partition by the cut points.
-    Null keys go to ``null_part`` so they land at the GLOBAL end after the
-    per-partition Arrow sort (which also places nulls last)."""
+    """Map side of the sample sort: range-partition by the cut points
+    (always >= 2 partitions). Null keys go to ``null_part`` so they land at
+    the GLOBAL end after the per-partition Arrow sort (which also places
+    nulls last). Comparisons run only over NON-NULL values, so any
+    orderable Arrow type (ints, strings, timestamps, decimals) works."""
     from ray_tpu.data.block import to_arrow
 
     t = to_arrow(block)
     num_parts = len(bounds) + 1
     if t.num_rows == 0:
         empty = t.slice(0, 0)
-        return tuple(empty for _ in range(num_parts)) if num_parts > 1 else empty
-    raw = t.column(key).to_pylist()
-    null_mask = np.array([v is None for v in raw])
-    vals = np.asarray([0 if v is None else v for v in raw]) \
-        if null_mask.any() else np.asarray(raw)
-    assign = np.searchsorted(np.asarray(bounds), vals, side="right")
-    if null_mask.any():
-        assign = np.where(null_mask, null_part, assign)
+        return tuple(empty for _ in range(num_parts))
+    col = t.column(key).combine_chunks()
+    null_mask = np.asarray(col.is_null())
+    nonnull = col.drop_null()
+    try:
+        vals = nonnull.to_numpy(zero_copy_only=False)
+    except (pa.ArrowInvalid, ValueError, TypeError):
+        vals = np.asarray(nonnull.to_pylist(), dtype=object)
+    assign = np.full(t.num_rows, null_part, dtype=np.int64)
+    if len(vals):
+        assign[~null_mask] = np.searchsorted(
+            np.asarray(bounds), vals, side="right")
     parts = tuple(t.take(pa.array(np.nonzero(assign == p)[0]))
                   for p in range(num_parts))
-    return parts if num_parts > 1 else parts[0]
+    return parts
 
 
 def _sort_merge_parts(key: str, descending: bool, *parts):
@@ -728,18 +734,15 @@ def _sort_refs(key: str, descending: bool, refs: List[Any]) -> List[Any]:
               for i in range(num_parts - 1)]
     bounds = [b for i, b in enumerate(bounds) if i == 0 or b != bounds[i - 1]]
     split = ray_tpu.remote(_range_split_block)
-    n_out = len(bounds) + 1
+    n_out = len(bounds) + 1  # >= 2: the dedup above always keeps bounds[0]
     # global null placement: ascending ends at the last partition; for
     # descending the output order is reversed, so nulls ride partition 0
     null_part = 0 if descending else n_out - 1
     parts: List[List[Any]] = [[] for _ in range(n_out)]
     for ref in refs:
         outs = split.options(num_returns=n_out).remote(key, bounds, null_part, ref)
-        if n_out == 1:
-            parts[0].append(outs)
-        else:
-            for p, r in enumerate(outs):
-                parts[p].append(r)
+        for p, r in enumerate(outs):
+            parts[p].append(r)
     out = [merge.remote(key, descending, *parts[p]) for p in range(n_out)]
     return out[::-1] if descending else out
 

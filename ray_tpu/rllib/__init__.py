@@ -22,6 +22,7 @@ from ray_tpu.rllib.connectors import (
     SoftmaxSample,
 )
 from ray_tpu.rllib.core.rl_module import RLModule
+from ray_tpu.rllib.cql import CQL, CQLConfig, CQLLearner
 from ray_tpu.rllib.dqn import DQN, DQNConfig, DQNLearner
 from ray_tpu.rllib.env import CartPoleEnv, EnvSpec, PendulumEnv, register_env
 from ray_tpu.rllib.env_runner import EnvRunner
@@ -40,6 +41,9 @@ __all__ = [
     "APPOLearner",
     "Connector",
     "ConnectorPipeline",
+    "CQL",
+    "CQLConfig",
+    "CQLLearner",
     "EpsilonGreedy",
     "FrameStack",
     "ObsNormalizer",

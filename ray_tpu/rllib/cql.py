@@ -1,0 +1,191 @@
+"""CQL: conservative Q-learning for offline RL (discrete actions).
+
+reference: rllib/algorithms/cql/ — offline Q-learning whose loss penalizes
+out-of-distribution actions: alongside the TD error, minimize
+``logsumexp_a Q(s, a) - Q(s, a_data)`` so the learned Q never overestimates
+actions the dataset never took (Kumar et al., 2020). The reference builds
+CQL on SAC for continuous control; this rebuild targets the discrete-action
+module (Q-values = the logits head), which is the standard discrete-CQL
+formulation and matches the rest of the jax algorithm family.
+
+Offline data comes in as episode dicts or a ``ray_tpu.data.Dataset`` of
+transition rows, like BC/MARWIL (rllib/offline.py); transitions (s, a, r,
+s', done) are derived inside episodes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithm import AlgorithmConfig
+from ray_tpu.rllib.core.rl_module import RLModule
+from ray_tpu.rllib.env import EnvSpec, make_env
+
+
+def episodes_to_transitions(episodes: List[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:
+    """(obs, actions, rewards, next_obs, dones) from per-episode arrays."""
+    obs, acts, rews, nxt, dones = [], [], [], [], []
+    for ep in episodes:
+        o = np.asarray(ep["obs"], np.float32)
+        a = np.asarray(ep["actions"], np.int64)
+        r = np.asarray(ep["rewards"], np.float32)
+        T = len(r)
+        obs.append(o)
+        acts.append(a)
+        rews.append(r)
+        # terminal transition's successor is its own obs — the done mask
+        # zeroes the bootstrap, so the value never flows
+        nxt.append(np.concatenate([o[1:], o[-1:]], axis=0))
+        d = np.zeros(T, np.float32)
+        d[-1] = 1.0
+        dones.append(d)
+    return {"obs": np.concatenate(obs), "actions": np.concatenate(acts),
+            "rewards": np.concatenate(rews), "next_obs": np.concatenate(nxt),
+            "dones": np.concatenate(dones)}
+
+
+@dataclasses.dataclass
+class CQLConfig(AlgorithmConfig):
+    lr: float = 3e-4
+    alpha: float = 1.0  # conservative-penalty weight
+    train_batch_size: int = 256
+    num_updates_per_iteration: int = 200
+    target_update_freq: int = 50
+    offline_data: Any = None  # episode dicts or a ray_tpu.data.Dataset
+
+    @property
+    def algo_class(self):
+        return CQL
+
+
+class CQLLearner:
+    def __init__(self, module: RLModule, cfg: CQLConfig):
+        self.module = module
+        self.cfg = cfg
+        self.optimizer = optax.adam(cfg.lr)
+        self.params = module.init(jax.random.PRNGKey(cfg.seed + 1))
+        self.target_params = jax.tree.map(lambda x: x, self.params)
+        self.opt_state = self.optimizer.init(self.params)
+        self._updates = 0
+        self._update = jax.jit(self._update_impl)
+
+    def _loss(self, params, target_params, batch):
+        q_all, _ = self.module.forward(params, batch["obs"])  # [B, A]
+        q_data = jnp.take_along_axis(
+            q_all, batch["actions"][:, None], axis=1)[:, 0]
+        q_next, _ = self.module.forward(target_params, batch["next_obs"])
+        target = batch["rewards"] + self.cfg.gamma * (
+            1.0 - batch["dones"]) * jnp.max(q_next, axis=-1)
+        td_loss = jnp.mean((q_data - jax.lax.stop_gradient(target)) ** 2)
+        # the conservative term: push down unseen actions' Q, push up data's
+        cql_gap = jnp.mean(jax.nn.logsumexp(q_all, axis=-1) - q_data)
+        total = td_loss + self.cfg.alpha * cql_gap
+        return total, {"td_loss": td_loss, "cql_gap": cql_gap,
+                       "q_data_mean": jnp.mean(q_data)}
+
+    def _update_impl(self, params, target_params, opt_state, batch):
+        (_, aux), grads = jax.value_and_grad(self._loss, has_aux=True)(
+            params, target_params, batch)
+        updates, opt_state = self.optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, aux
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        jb = {k: jnp.asarray(v) for k, v in batch.items()}
+        self.params, self.opt_state, aux = self._update(
+            self.params, self.target_params, self.opt_state, jb)
+        self._updates += 1
+        if self._updates % self.cfg.target_update_freq == 0:
+            self.target_params = jax.tree.map(lambda x: x, self.params)
+        return {k: float(v) for k, v in aux.items()}
+
+    def get_params(self):
+        return self.params
+
+
+class CQL:
+    """Offline algorithm: no EnvRunners (reference: cql/cql.py over the
+    offline data path); train() samples minibatches of stored transitions."""
+
+    def __init__(self, config: CQLConfig):
+        self.config = config
+        if config.offline_data is None:
+            raise ValueError("CQLConfig.offline_data is required (episode "
+                             "dicts or a ray_tpu.data.Dataset)")
+        episodes = self._as_episodes(config.offline_data)
+        self._batch = episodes_to_transitions(episodes)
+        if config.env is not None:
+            self._spec = make_env(config.env).spec
+        else:
+            self._spec = EnvSpec(
+                obs_dim=int(self._batch["obs"].shape[-1]),
+                num_actions=int(self._batch["actions"].max()) + 1)
+        self._module = RLModule(self._spec, hidden=tuple(config.hidden))
+        self._learner = CQLLearner(self._module, config)
+        self._rng = np.random.RandomState(config.seed)
+        self._iteration = 0
+
+    @staticmethod
+    def _as_episodes(data) -> List[Dict[str, np.ndarray]]:
+        if not hasattr(data, "iter_batches"):
+            return list(data)
+        # Dataset of transition rows {obs, actions, rewards, eps_id}: group
+        # into episodes the same way BC/MARWIL ingest (rllib/offline.py)
+        episodes: Dict[Any, Dict[str, list]] = {}
+        order: List[Any] = []
+        for batch in data.iter_batches(batch_size=4096, batch_format="numpy"):
+            eps = np.asarray(batch["eps_id"])
+            for i in range(len(eps)):
+                key = eps[i].item() if hasattr(eps[i], "item") else eps[i]
+                ep = episodes.get(key)
+                if ep is None:
+                    ep = episodes[key] = {"obs": [], "actions": [], "rewards": []}
+                    order.append(key)
+                ep["obs"].append(np.asarray(batch["obs"][i], np.float32))
+                ep["actions"].append(int(np.asarray(batch["actions"][i])))
+                ep["rewards"].append(float(np.asarray(batch["rewards"][i])))
+        return [{"obs": np.stack(e["obs"]), "actions": np.asarray(e["actions"]),
+                 "rewards": np.asarray(e["rewards"])}
+                for e in (episodes[k] for k in order)]
+
+    def train(self) -> Dict[str, Any]:
+        cfg = self.config
+        n = len(self._batch["obs"])
+        stats: Dict[str, float] = {}
+        for _ in range(cfg.num_updates_per_iteration):
+            idx = self._rng.randint(n, size=min(cfg.train_batch_size, n))
+            stats = self._learner.update(
+                {k: v[idx] for k, v in self._batch.items()})
+        self._iteration += 1
+        return {"training_iteration": self._iteration, **stats}
+
+    def get_policy_params(self):
+        return self._learner.get_params()
+
+    def evaluate(self, num_episodes: int = 5, seed: int = 0) -> Dict[str, float]:
+        """Greedy-Q rollouts in the config env (requires config.env)."""
+        assert self.config.env is not None, "evaluate() needs config.env"
+        from ray_tpu.rllib.env_runner import EnvRunner
+
+        params = jax.tree.map(np.asarray, self._learner.get_params())
+        totals = []
+        for ep in range(num_episodes):
+            env = make_env(self.config.env)
+            obs = env.reset(seed=seed + ep)
+            total, done = 0.0, False
+            while not done:
+                q, _ = EnvRunner._fwd(params, obs[None, :])
+                obs, rew, done, _ = env.step(int(q[0].argmax()))
+                total += rew
+            totals.append(total)
+        return {"episode_reward_mean": float(np.mean(totals)),
+                "episodes": float(num_episodes)}
+
+    def stop(self):  # API parity with Algorithm
+        pass

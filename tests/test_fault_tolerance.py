@@ -122,3 +122,22 @@ def test_actor_tasks_resume_after_restart_mid_calls(ray_start_regular, tmp_path)
     # subsequent calls retry onto the restarted incarnation
     results = ray_tpu.get([a.work.remote(i) for i in range(3)], timeout=120)
     assert [r[0] for r in results] == [0, 1, 2]
+
+
+def test_unpicklable_task_exception_still_replies(ray_start_regular):
+    """A task raising an exception that cannot pickle must surface an error
+    (with the original message), not hang the caller forever: the worker's
+    RPC layer replaces the unpicklable payload with an RpcError reply."""
+    import ray_tpu
+
+    @ray_tpu.remote
+    def boom():
+        class Unpicklable(Exception):  # local class: by-reference pickling fails
+            def __init__(self):
+                super().__init__("kaboom-unpicklable")
+                self.lock = __import__("threading").Lock()
+
+        raise Unpicklable()
+
+    with pytest.raises(Exception, match="kaboom-unpicklable"):
+        ray_tpu.get(boom.options(max_retries=0).remote(), timeout=60)
