@@ -247,7 +247,15 @@ class RpcServer:
 
     def send_error_reply(self, reply_token, exc: Exception):
         sock, send_lock, msg_id = reply_token
-        frame = pickle.dumps(("err", (str(exc), "", exc)), protocol=5)
+        try:
+            frame = pickle.dumps(("err", (str(exc), "", exc)), protocol=5)
+        except Exception:  # noqa: BLE001 — same guard as send_reply: a
+            # reply MUST go out even when the exception can't pickle
+            frame = pickle.dumps(
+                ("err", (str(exc), "",
+                         RpcError(f"{type(exc).__name__}: {exc} "
+                                  "(original exception unpicklable)"))),
+                protocol=5)
         self._send_frame(sock, send_lock, msg_id, frame)
 
     @staticmethod

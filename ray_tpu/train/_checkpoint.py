@@ -17,11 +17,19 @@ import uuid
 from typing import Any, Dict, Iterator, Optional
 
 
+from ray_tpu.train._internal.checkpoint_util import (
+    is_remote_path as _is_remote,
+    normalize_local_path as _normalize_local,
+)
+
+
 class Checkpoint:
-    """A reference to a directory holding checkpoint data."""
+    """A reference to a directory holding checkpoint data — local or any
+    fsspec URI (reference: Checkpoint = directory + fsspec URI)."""
 
     def __init__(self, path: str):
-        self.path = os.path.abspath(os.fspath(path))
+        p = os.fspath(path)
+        self.path = p if _is_remote(p) else os.path.abspath(_normalize_local(p))
 
     @classmethod
     def from_directory(cls, path) -> "Checkpoint":
@@ -30,31 +38,63 @@ class Checkpoint:
     def as_directory(self):
         @contextlib.contextmanager
         def cm() -> Iterator[str]:
-            yield self.path
+            if not _is_remote(self.path):
+                yield self.path
+                return
+            from ray_tpu.train._internal.checkpoint_util import download_dir
+
+            tmp = os.path.join(tempfile.gettempdir(),
+                               f"ckpt_dl_{uuid.uuid4().hex[:8]}")
+            try:
+                yield download_dir(self.path, tmp)
+            finally:
+                shutil.rmtree(tmp, ignore_errors=True)
 
         return cm()
 
     def to_directory(self, path: Optional[str] = None) -> str:
         dest = path or os.path.join(tempfile.gettempdir(), f"ckpt_{uuid.uuid4().hex[:8]}")
+        if _is_remote(self.path):
+            from ray_tpu.train._internal.checkpoint_util import download_dir
+
+            return download_dir(self.path, dest)
         if os.path.abspath(dest) != self.path:
             shutil.copytree(self.path, dest, dirs_exist_ok=True)
         return dest
 
+    def _meta_path(self) -> str:
+        if _is_remote(self.path):
+            return self.path.rstrip("/") + "/.metadata.json"
+        return os.path.join(self.path, ".metadata.json")
+
     def update_metadata(self, metadata: Dict[str, Any]):
         import json
 
-        meta_path = os.path.join(self.path, ".metadata.json")
         existing = self.get_metadata()
         existing.update(metadata)
-        with open(meta_path, "w") as f:
+        if _is_remote(self.path):
+            import fsspec
+
+            with fsspec.open(self._meta_path(), "w") as f:
+                json.dump(existing, f)
+            return
+        with open(self._meta_path(), "w") as f:
             json.dump(existing, f)
 
     def get_metadata(self) -> Dict[str, Any]:
         import json
 
-        meta_path = os.path.join(self.path, ".metadata.json")
-        if os.path.exists(meta_path):
-            with open(meta_path) as f:
+        meta = self._meta_path()
+        if _is_remote(self.path):
+            import fsspec
+
+            fs, p = fsspec.core.url_to_fs(meta)
+            if fs.exists(p):
+                with fs.open(p) as f:
+                    return json.load(f)
+            return {}
+        if os.path.exists(meta):
+            with open(meta) as f:
                 return json.load(f)
         return {}
 

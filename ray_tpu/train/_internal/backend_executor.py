@@ -142,10 +142,13 @@ class BackendExecutor:
         ckpt: Optional[Checkpoint] = result.get("checkpoint")
         if ckpt is None:
             return None
-        from ray_tpu.train._internal.checkpoint_util import persist_staged_checkpoint
+        from ray_tpu.train._internal.checkpoint_util import (
+            join_path,
+            persist_staged_checkpoint,
+        )
 
         self._ckpt_counter += 1
-        dest = os.path.join(self._run_dir, f"checkpoint_{self._ckpt_counter:06d}")
+        dest = join_path(self._run_dir, f"checkpoint_{self._ckpt_counter:06d}")
         persist_staged_checkpoint(ckpt.path, dest)
         persisted = Checkpoint(dest)
         score_attr = self._ckpt_config.checkpoint_score_attribute
@@ -155,8 +158,10 @@ class BackendExecutor:
         if keep is not None and len(self._saved_checkpoints) > keep:
             reverse = self._ckpt_config.checkpoint_score_order == "max"
             self._saved_checkpoints.sort(key=lambda t: t[0], reverse=reverse)
+            from ray_tpu.train._internal.checkpoint_util import rmtree_any
+
             for _, path in self._saved_checkpoints[keep:]:
-                shutil.rmtree(path, ignore_errors=True)
+                rmtree_any(path)
             self._saved_checkpoints = self._saved_checkpoints[:keep]
         return persisted
 

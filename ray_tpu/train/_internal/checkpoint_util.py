@@ -1,5 +1,10 @@
 """Shared checkpoint persistence helpers (used by train's BackendExecutor and
-tune's TuneController)."""
+tune's TuneController).
+
+Storage paths may be local or any fsspec URI (gs://, s3://, ...) — the
+reference persists checkpoints through fsspec the same way
+(train/_internal/storage.py).
+"""
 
 from __future__ import annotations
 
@@ -11,9 +16,74 @@ from typing import List, Optional, Tuple
 _CKPT_RE = re.compile(r"^checkpoint_(\d+)$")
 
 
+def is_remote_path(path: str) -> bool:
+    return "://" in str(path) and not str(path).startswith("file://")
+
+
+def normalize_local_path(path: str) -> str:
+    """Strip the canonical fsspec local scheme: file:///x -> /x (callers
+    then treat it as a plain local path)."""
+    p = str(path)
+    if p.startswith("file://"):
+        return p[len("file://"):] or "/"
+    return p
+
+
+def join_path(base: str, *names: str) -> str:
+    if is_remote_path(base):
+        return "/".join([str(base).rstrip("/")] + [n.strip("/") for n in names])
+    return os.path.join(base, *names)
+
+
+def makedirs_any(path: str) -> None:
+    if is_remote_path(path):
+        import fsspec
+
+        fs, p = fsspec.core.url_to_fs(path)
+        fs.makedirs(p, exist_ok=True)
+    else:
+        os.makedirs(path, exist_ok=True)
+
+
+def rmtree_any(path: str) -> None:
+    if is_remote_path(path):
+        import fsspec
+
+        fs, p = fsspec.core.url_to_fs(path)
+        try:
+            fs.rm(p, recursive=True)
+        except FileNotFoundError:
+            pass
+    else:
+        shutil.rmtree(path, ignore_errors=True)
+
+
+def upload_dir(local_src: str, dest: str) -> None:
+    import fsspec
+
+    fs, p = fsspec.core.url_to_fs(dest)
+    fs.makedirs(p, exist_ok=True)
+    fs.put(local_src.rstrip("/") + "/", p, recursive=True)
+
+
+def download_dir(src: str, local_dest: str) -> str:
+    import fsspec
+
+    fs, p = fsspec.core.url_to_fs(src)
+    os.makedirs(local_dest, exist_ok=True)
+    fs.get(p.rstrip("/") + "/", local_dest.rstrip("/") + "/", recursive=True)
+    return local_dest
+
+
 def persist_staged_checkpoint(src_path: str, dest: str) -> str:
-    """Move (if worker-staged) or copy a checkpoint dir to ``dest``,
-    replacing any stale contents at the destination."""
+    """Move (if worker-staged) or copy a local checkpoint dir to ``dest``
+    (local path or fsspec URI), replacing any stale contents."""
+    if is_remote_path(dest):
+        rmtree_any(dest)
+        upload_dir(src_path, dest)
+        if os.path.dirname(src_path).endswith(".staged"):
+            shutil.rmtree(src_path, ignore_errors=True)
+        return dest
     if os.path.abspath(src_path) == os.path.abspath(dest):
         return dest
     if os.path.exists(dest):
@@ -28,10 +98,21 @@ def persist_staged_checkpoint(src_path: str, dest: str) -> str:
 def existing_checkpoint_indices(run_dir: str) -> List[int]:
     """Indices of checkpoint_NNNNNN dirs already in a run dir (so a restarted
     gang continues the sequence instead of overwriting)."""
-    if not os.path.isdir(run_dir):
+    if is_remote_path(run_dir):
+        import fsspec
+
+        fs, p = fsspec.core.url_to_fs(run_dir)
+        try:
+            names = [n.rstrip("/").rsplit("/", 1)[-1]
+                     for n in fs.ls(p, detail=False)]
+        except FileNotFoundError:
+            return []
+    elif os.path.isdir(run_dir):
+        names = os.listdir(run_dir)
+    else:
         return []
     out = []
-    for name in os.listdir(run_dir):
+    for name in names:
         m = _CKPT_RE.match(name)
         if m:
             out.append(int(m.group(1)))

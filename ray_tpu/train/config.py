@@ -90,5 +90,12 @@ class RunConfig:
     checkpoint_config: Optional[CheckpointConfig] = None
 
     def resolved_storage_path(self) -> str:
+        from ray_tpu.train._internal.checkpoint_util import (
+            is_remote_path,
+            normalize_local_path,
+        )
+
         base = self.storage_path or os.path.expanduser("~/ray_tpu_results")
-        return os.path.abspath(base)
+        if is_remote_path(base):
+            return base  # fsspec URI (gs://, s3://, ...): not a local path
+        return os.path.abspath(normalize_local_path(base))

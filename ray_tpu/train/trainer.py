@@ -81,9 +81,11 @@ class DataParallelTrainer:
             FixedScalingPolicy,
         )
 
+        from ray_tpu.train._internal.checkpoint_util import join_path, makedirs_any
+
         name = self._run_config.name or f"train_{uuid.uuid4().hex[:8]}"
-        run_dir = os.path.join(self._run_config.resolved_storage_path(), name)
-        os.makedirs(run_dir, exist_ok=True)
+        run_dir = join_path(self._run_config.resolved_storage_path(), name)
+        makedirs_any(run_dir)
         failure_config = self._run_config.failure_config or FailureConfig()
         failure_policy = self._failure_policy or DefaultFailurePolicy(
             max_failures=failure_config.max_failures)

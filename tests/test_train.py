@@ -243,3 +243,39 @@ def test_failure_policy_decisions():
     assert p.make_decision(3, RuntimeError()) == FailureDecision.RAISE
     unlimited = DefaultFailurePolicy(max_failures=-1)
     assert unlimited.make_decision(99, RuntimeError()) == FailureDecision.RETRY
+
+
+def test_checkpoints_to_fsspec_uri(ray4):
+    """storage_path may be an fsspec URI (reference: checkpoints persist via
+    fsspec, train/_internal/storage.py). memory:// stands in for gs://;
+    validated driver-side (memory filesystems are per-process)."""
+    import fsspec
+
+    from ray_tpu import train
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+    def loop(config):
+        import os
+        import tempfile
+
+        d = tempfile.mkdtemp()
+        with open(os.path.join(d, "model.txt"), "w") as f:
+            f.write("weights-v1")
+        train.report({"step": 1}, checkpoint=train.Checkpoint.from_directory(d))
+
+    result = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1, use_tpu=False),
+        run_config=RunConfig(name="fs-run", storage_path="memory://ckpts"),
+    ).fit()
+    assert result.error is None
+    ckpt = result.checkpoint
+    assert ckpt is not None and ckpt.path.startswith("memory://")
+    with ckpt.as_directory() as local:
+        import os
+
+        with open(os.path.join(local, "model.txt")) as f:
+            assert f.read() == "weights-v1"
+    fs = fsspec.filesystem("memory")
+    listing = fs.ls("/ckpts/fs-run", detail=False)
+    assert any("checkpoint_" in p for p in listing), (listing, fs.find("/ckpts"))
