@@ -120,6 +120,20 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return b"".join(chunks)
 
 
+def _err_frame(exc: BaseException, tb: str) -> bytes:
+    """Wire frame for an error reply. A reply MUST always go out (callers
+    may wait with timeout=None), so an unpicklable exception is replaced by
+    an RpcError carrying its type and message."""
+    try:
+        return pickle.dumps(("err", (str(exc), tb, exc)), protocol=5)
+    except Exception:  # noqa: BLE001
+        return pickle.dumps(
+            ("err", (str(exc), tb,
+                     RpcError(f"{type(exc).__name__}: {exc} "
+                              "(original exception unpicklable)"))),
+            protocol=5)
+
+
 class RpcServer:
     """Serves registered handlers; one handler thread pool per server.
 
@@ -220,16 +234,7 @@ class RpcServer:
         except Exception as e:  # noqa: BLE001
             import traceback
 
-            tb = traceback.format_exc()
-            try:
-                frame = pickle.dumps(("err", (str(e), tb, e)), protocol=5)
-            except Exception:  # noqa: BLE001 — e itself unpicklable: a reply
-                # MUST still go out or callers with timeout=None hang forever
-                frame = pickle.dumps(
-                    ("err", (str(e), tb,
-                             RpcError(f"{type(e).__name__}: {e} "
-                                      "(original exception unpicklable)"))),
-                    protocol=5)
+            frame = _err_frame(e, traceback.format_exc())
         if chaos == "drop_response":
             return
         self._send_frame(sock, send_lock, msg_id, frame)
@@ -240,23 +245,12 @@ class RpcServer:
             frame = pickle.dumps(("ok", value), protocol=5)
         except Exception as e:  # noqa: BLE001 — a reply MUST go out, or
             # callers with timeout=None block forever
-            frame = pickle.dumps(
-                ("err", (f"reply unpicklable: {e}", "",
-                         RpcError(f"reply unpicklable: {e}"))), protocol=5)
+            frame = _err_frame(RpcError(f"reply unpicklable: {e}"), "")
         self._send_frame(sock, send_lock, msg_id, frame)
 
     def send_error_reply(self, reply_token, exc: Exception):
         sock, send_lock, msg_id = reply_token
-        try:
-            frame = pickle.dumps(("err", (str(exc), "", exc)), protocol=5)
-        except Exception:  # noqa: BLE001 — same guard as send_reply: a
-            # reply MUST go out even when the exception can't pickle
-            frame = pickle.dumps(
-                ("err", (str(exc), "",
-                         RpcError(f"{type(exc).__name__}: {exc} "
-                                  "(original exception unpicklable)"))),
-                protocol=5)
-        self._send_frame(sock, send_lock, msg_id, frame)
+        self._send_frame(sock, send_lock, msg_id, _err_frame(exc, ""))
 
     @staticmethod
     def _send_frame(sock, send_lock, msg_id, frame):

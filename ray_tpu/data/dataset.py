@@ -1038,6 +1038,37 @@ class Dataset:
 
         return _gen()
 
+    def iter_torch_batches(
+        self,
+        *,
+        batch_size: Optional[int] = 256,
+        drop_last: bool = False,
+        dtypes: Optional[Dict[str, Any]] = None,
+        device: Optional[str] = None,
+    ) -> Iterator[Dict[str, Any]]:
+        """Stream batches as dicts of torch tensors (reference:
+        dataset.iter_torch_batches; the jax analog is iter_jax_batches).
+
+        dtypes: optional {column: torch dtype}; device: torch device string.
+        """
+
+        def _gen():
+            import torch
+
+            for batch in self.iter_batches(batch_size=batch_size,
+                                           batch_format="numpy",
+                                           drop_last=drop_last):
+                out = {}
+                for name, col in batch.items():
+                    t = torch.as_tensor(np.asarray(col))
+                    want = dtypes.get(name) if dtypes else None
+                    if want is not None or device is not None:
+                        t = t.to(device=device, dtype=want)  # one copy
+                    out[name] = t
+                yield out
+
+        return _gen()
+
     def iter_rows(self) -> Iterator[Dict[str, Any]]:
         import ray_tpu
         from ray_tpu.data.block import iter_block_rows
@@ -1140,6 +1171,23 @@ class Dataset:
         return f"Dataset(plan={' -> '.join(names)})"
 
     def stats(self) -> str:
-        return repr(self)
+        """Human-readable execution stats of the MOST RECENT execution of
+        this process (reference: dataset.stats() — per-operator wall/tasks;
+        here the streaming executor's operator counters)."""
+        from ray_tpu.data._internal import streaming_executor as se
+
+        lines = [repr(self)]
+        ex = se.LAST_EXECUTOR
+        if ex is None:
+            return lines[0] + "\n(no execution yet)"
+        for name, st in ex.stats().items():
+            parts = [f"tasks={st['tasks_submitted']}",
+                     f"peak_in_flight={st['peak_outstanding']}",
+                     f"peak_queued_bytes={st['peak_downstream_bytes']}"]
+            if "peak_pool_size" in st:
+                parts.append(f"peak_pool={st['peak_pool_size']}")
+                parts.append(f"scale_downs={st.get('scale_down_events', 0)}")
+            lines.append(f"  {name}: " + ", ".join(parts))
+        return "\n".join(lines)
 
 

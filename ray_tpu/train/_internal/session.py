@@ -71,10 +71,19 @@ class _TrainSession:
         # delete its local checkpoint dir right after report() returns.
         if checkpoint is not None and self.storage_path:
             import shutil
+            import tempfile
             import uuid
 
-            staged = os.path.join(self.storage_path, ".staged",
-                                  f"ckpt_{uuid.uuid4().hex[:8]}")
+            from ray_tpu.train._internal.checkpoint_util import is_remote_path
+
+            if is_remote_path(self.storage_path):
+                # remote run dir: stage locally; the driver-side persist
+                # uploads from here (same-machine staging — the in-process
+                # cluster model; multi-host gangs upload via save_sharded)
+                base = os.path.join(tempfile.gettempdir(), "ray_tpu.staged")
+            else:
+                base = os.path.join(self.storage_path, ".staged")
+            staged = os.path.join(base, f"ckpt_{uuid.uuid4().hex[:8]}")
             shutil.copytree(checkpoint.path, staged, dirs_exist_ok=True)
             checkpoint = Checkpoint(staged)
         self.result_queue.put({"metrics": dict(metrics), "checkpoint": checkpoint,

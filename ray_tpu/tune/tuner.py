@@ -119,12 +119,30 @@ class TuneController:
         elif self._search_alg is not None:
             # suggest mode: trials are created on demand in the run loop
             name = run_config.name or f"tune_{uuid.uuid4().hex[:8]}"
-            self._exp_dir = os.path.join(run_config.resolved_storage_path(), name)
+            base = run_config.resolved_storage_path()
+            from ray_tpu.train._internal.checkpoint_util import is_remote_path
+
+            if is_remote_path(base):
+                raise ValueError(
+                    "Tune experiment storage does not support remote fsspec "
+                    "URIs yet (experiment state uses local atomic renames); "
+                    "use a local or NFS storage_path. Train's checkpoint "
+                    "storage_path DOES support remote URIs.")
+            self._exp_dir = os.path.join(base, name)
             os.makedirs(self._exp_dir, exist_ok=True)
             self.trials = []
         else:
             name = run_config.name or f"tune_{uuid.uuid4().hex[:8]}"
-            self._exp_dir = os.path.join(run_config.resolved_storage_path(), name)
+            base = run_config.resolved_storage_path()
+            from ray_tpu.train._internal.checkpoint_util import is_remote_path
+
+            if is_remote_path(base):
+                raise ValueError(
+                    "Tune experiment storage does not support remote fsspec "
+                    "URIs yet (experiment state uses local atomic renames); "
+                    "use a local or NFS storage_path. Train's checkpoint "
+                    "storage_path DOES support remote URIs.")
+            self._exp_dir = os.path.join(base, name)
             os.makedirs(self._exp_dir, exist_ok=True)
             gen = BasicVariantGenerator(param_space, tune_config.num_samples,
                                         seed=tune_config.seed)

@@ -147,3 +147,21 @@ def test_fsspec_memory_uri_plumbing():
     # remote write path
     written = write_block_parquet(t, "memory://dst", 0)
     assert read_parquet_file(written).num_rows == 4
+
+
+def test_iter_torch_batches(cluster):
+    import torch
+
+    ds = rdata.range(10, parallelism=2)
+    batches = list(ds.iter_torch_batches(batch_size=4, dtypes={"id": torch.float32}))
+    assert all(isinstance(b["id"], torch.Tensor) for b in batches)
+    assert batches[0]["id"].dtype == torch.float32
+    total = torch.cat([b["id"] for b in batches])
+    assert sorted(total.tolist()) == [float(i) for i in range(10)]
+
+
+def test_dataset_stats_reports_operators(cluster):
+    ds = rdata.range(20, parallelism=4).map_batches(lambda b: b, batch_size=None)
+    ds.take_all()
+    s = ds.stats()
+    assert "tasks=" in s and "peak_in_flight=" in s
