@@ -1060,7 +1060,10 @@ class Dataset:
                                            drop_last=drop_last):
                 out = {}
                 for name, col in batch.items():
-                    t = torch.as_tensor(np.asarray(col))
+                    arr = np.asarray(col)
+                    if not arr.flags.writeable:
+                        arr = arr.copy()  # arrow-backed buffers are read-only
+                    t = torch.as_tensor(arr)
                     want = dtypes.get(name) if dtypes else None
                     if want is not None or device is not None:
                         t = t.to(device=device, dtype=want)  # one copy
