@@ -7,6 +7,8 @@ headline metric is Llama training-step MFU on the local TPU chip and
   - ``allreduce``: bus bandwidth of a shard_map psum over all local devices
     (north-star metric #2 — on one chip this is the on-chip copy path; on a
     slice it rides ICI; benchmarks/allreduce_bench.py has the multi-size CLI)
+  - ``moe``: train MFU of the second model family (Mixtral-style sparse
+    MoE, active-params accounting)
   - ``dryrun_8b``: the Llama-3-8B config traced + jit-lowered over a virtual
     8-device fsdp×tp mesh in a subprocess (shape/sharding exercise, no
     execution) plus the analytic per-chip HBM footprint on the v5p-128
@@ -123,6 +125,47 @@ def _dryrun_8b() -> dict:
     return out
 
 
+def _bench_moe(on_tpu: bool) -> dict:
+    """Second model family: Mixtral-style sparse MoE train MFU (active-
+    params accounting — the convention; the GShard dense dispatch executes
+    ~1.25x active expert FLOPs, so hardware utilization is higher)."""
+    try:
+        from ray_tpu.models.moe import MoEConfig, flops_per_token as moe_fpt
+        from ray_tpu.parallel import make_train_step
+
+        if on_tpu:
+            cfg = MoEConfig(
+                vocab_size=32768, dim=2048, n_layers=8, n_heads=16,
+                n_kv_heads=8, ffn_dim=4096, n_experts=8, experts_per_token=2,
+                max_seq_len=1024, param_dtype=jnp.bfloat16)
+            batch, seq, steps = 8, 1024, 6
+            optimizer = optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1,
+                                    mu_dtype=jnp.bfloat16)
+        else:
+            cfg = MoEConfig.tiny()
+            batch, seq, steps = 4, 64, 2
+            optimizer = optax.adamw(3e-4)
+        init_fn, step_fn = make_train_step(cfg, optimizer=optimizer)
+        state = init_fn(jax.random.PRNGKey(0))
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (batch, seq), 0, cfg.vocab_size)
+        state, metrics = step_fn(state, tokens)
+        float(metrics["loss"])  # block: compile + warm
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = step_fn(state, tokens)
+        loss = float(metrics["loss"])  # host read forces the chain
+        dt = (time.perf_counter() - t0) / steps
+        tps = batch * seq / dt
+        mfu = moe_fpt(cfg, seq) * tps / _peak_flops(jax.devices()[0])
+        return {"mfu_active": round(mfu, 4), "tokens_per_sec": round(tps, 1),
+                "step_time_s": round(dt, 4), "final_loss": round(loss, 4),
+                "active_params": cfg.num_active_params,
+                "total_params": cfg.num_params}
+    except Exception as e:  # noqa: BLE001
+        return {"error": str(e)[:200]}
+
+
 def main():
     from ray_tpu.models.llama import LlamaConfig, flops_per_token
     from ray_tpu.parallel import make_train_step
@@ -162,6 +205,13 @@ def main():
     mfu = model_flops / peak
     loss = float(metrics["loss"])
 
+    # free the llama state BEFORE the extra benches — the MoE model needs
+    # the HBM the 1B params+moments occupy
+    import gc
+
+    del state, metrics, tokens, step_fn, init_fn
+    gc.collect()
+
     result = {
         "metric": "llama1b_train_mfu_1chip",
         "value": round(mfu, 4),
@@ -175,6 +225,7 @@ def main():
             "device": getattr(jax.devices()[0], "device_kind", "cpu"),
             "backend": jax.default_backend(),
             "allreduce": _bench_allreduce(on_tpu),
+            "moe": _bench_moe(on_tpu),
             "dryrun_8b": _dryrun_8b(),
         },
     }
