@@ -150,11 +150,12 @@ def _bench_moe(on_tpu: bool) -> dict:
         tokens = jax.random.randint(
             jax.random.PRNGKey(1), (batch, seq), 0, cfg.vocab_size)
         state, metrics = step_fn(state, tokens)
-        float(metrics["loss"])  # block: compile + warm
+        jax.block_until_ready(state)  # compile + warm, full step drained
         t0 = time.perf_counter()
         for _ in range(steps):
             state, metrics = step_fn(state, tokens)
         loss = float(metrics["loss"])  # host read forces the chain
+        jax.block_until_ready(state)
         dt = (time.perf_counter() - t0) / steps
         tps = batch * seq / dt
         mfu = moe_fpt(cfg, seq) * tps / _peak_flops(jax.devices()[0])

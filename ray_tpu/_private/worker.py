@@ -466,8 +466,15 @@ class CoreWorker:
     def _gcs_subscribe(self, channel: str):
         with self._sub_lock:
             self._subscriptions.add(channel)
-        self.gcs.call("Subscribe", {"channel": channel,
-                                    "subscriber_addr": self.server.address})
+        try:
+            self.gcs.call("Subscribe", {"channel": channel,
+                                        "subscriber_addr": self.server.address},
+                          timeout=5, retry_deadline=0.0)
+        except Exception:  # noqa: BLE001 — a lost Subscribe must not fail
+            # the caller (actor creation, log echo): the periodic
+            # resubscribe loop re-issues it within resubscribe_interval_s,
+            # and actor state falls back to GCS polling meanwhile
+            pass
 
     def _resubscribe_loop(self):
         interval = global_config().resubscribe_interval_s

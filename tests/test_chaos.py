@@ -1,0 +1,77 @@
+"""Deterministic RPC chaos (reference: src/ray/rpc/rpc_chaos.h:23-35 +
+RAY_testing_rpc_failure, used by test_gcs_fault_tolerance.py et al.).
+
+The self-healing loops must ride out injected drops: resource-report
+responses vanish (the raylet's report loop retries next tick), Subscribe
+requests vanish (the periodic resubscribe heals pubsub), and task
+workloads complete regardless.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.config import RayTpuConfig, global_config, set_global_config
+from ray_tpu._private.rpc import reset_chaos_for_testing
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture
+def chaos_cluster():
+    saved = global_config()
+    cfg = RayTpuConfig()
+    # drop the first 5 ReportResources responses and the first 3 Subscribe
+    # requests, everywhere in this process tree (workers inherit the blob)
+    cfg.testing_rpc_failure = "ReportResources=5:0.0:1.0,Subscribe=3:1.0:0.0"
+    cfg.resubscribe_interval_s = 0.5
+    # short RPC timeout so a dropped response costs the report loop ~2s,
+    # not the 90s CI default
+    cfg.gcs_rpc_timeout_s = 2.0
+    set_global_config(cfg)
+    reset_chaos_for_testing(cfg.testing_rpc_failure)
+    # a worker node too: head nodes are exempt from health-check death, so
+    # the liveness assertion below needs a non-head node to mean anything
+    cluster = Cluster(head_node_args={"num_cpus": 1})
+    cluster.add_node(num_cpus=1)
+    w = cluster.connect_driver()
+    yield w
+    cluster.shutdown()
+    set_global_config(saved)
+    reset_chaos_for_testing("")
+
+
+@pytest.mark.slow
+def test_workload_survives_rpc_drops(chaos_cluster):
+    w = chaos_cluster
+
+    @ray_tpu.remote
+    def mul(x):
+        return x * 3
+
+    assert ray_tpu.get([mul.remote(i) for i in range(8)], timeout=120) == [
+        i * 3 for i in range(8)]
+
+    # report-response drops never mark a node dead (the GCS processed the
+    # request; only the reply vanished) — including the non-head worker node
+    nodes = w.gcs.call("GetAllNodeInfo", {})
+    assert len(nodes) == 2
+    assert all(n["state"] == "ALIVE" for n in nodes)
+
+    # dropped Subscribe requests heal via the periodic resubscribe: actor
+    # lifecycle events still reach this driver
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return "pong"
+
+    a = A.remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=120) == "pong"
+    time.sleep(1.5)  # a couple of resubscribe rounds
+    ray_tpu.kill(a)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if w._actor_state_cache.get(a._actor_id) == "DEAD":
+            break
+        time.sleep(0.2)
+    assert w._actor_state_cache.get(a._actor_id) == "DEAD"
