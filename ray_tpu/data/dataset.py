@@ -747,6 +747,47 @@ def _sort_refs(key: str, descending: bool, refs: List[Any]) -> List[Any]:
     return out[::-1] if descending else out
 
 
+def _prefetch(gen, n):
+    """Run ``gen`` on a background thread, buffering up to ``n`` items
+    (reference: prefetch_batches on the batch iterators). Errors re-raise at
+    the consumer; abandoning the iterator stops the producer promptly."""
+    import queue as _queue
+    import threading as _threading
+
+    q = _queue.Queue(maxsize=max(1, n))
+    END, stop = object(), _threading.Event()
+
+    def put_or_stop(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.2)
+                return True
+            except _queue.Full:
+                continue
+        return False  # consumer abandoned the iterator
+
+    def pump():
+        try:
+            for item in gen:
+                if not put_or_stop(item):
+                    return
+            put_or_stop(END)
+        except BaseException as e:  # noqa: BLE001 — surface at the consumer
+            put_or_stop(e)
+
+    _threading.Thread(target=pump, daemon=True, name="batch-prefetch").start()
+    try:
+        while True:
+            item = q.get()
+            if item is END:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()
+
+
 class Dataset:
     """reference: data/dataset.py:166."""
 
@@ -980,13 +1021,19 @@ class Dataset:
         batch_size: Optional[int] = 256,
         batch_format: Optional[str] = None,
         drop_last: bool = False,
+        prefetch_batches: int = 1,
     ) -> Iterator[Any]:
         """Stream batches as blocks complete (reference: iterator over
-        execute_to_iterator, plan.py:413)."""
+        execute_to_iterator, plan.py:413). ``prefetch_batches`` runs batch
+        preparation on a background thread so it overlaps the caller's
+        consumption (0 disables)."""
         batch_format = batch_format or self._ctx.default_batch_format
-        yield from _batches_over_refs(
+        gen = _batches_over_refs(
             self._plan.execute_iter(self._ctx), batch_size, batch_format,
             drop_last)
+        if prefetch_batches and prefetch_batches > 0:
+            gen = _prefetch(gen, prefetch_batches)
+        yield from gen
 
     def iter_jax_batches(
         self,
@@ -996,6 +1043,7 @@ class Dataset:
         dtypes: Optional[Dict[str, Any]] = None,
         sharding: Optional[Any] = None,
         device: Optional[Any] = None,
+        prefetch_batches: int = 1,
     ) -> Iterator[Dict[str, Any]]:
         """Stream batches as dicts of device-resident jax arrays — the
         TPU-native analog of the reference's iter_torch_batches.
@@ -1004,6 +1052,8 @@ class Dataset:
         sharding: a jax.sharding.Sharding applied to every column (e.g. a
                   NamedSharding over the data axes for pjit'ed train steps)
         device:   a single device (mutually exclusive with sharding)
+        prefetch_batches: device_put of upcoming batches overlaps the
+                  caller's step (the classic TPU input-pipeline overlap)
         """
         if sharding is not None and device is not None:
             raise ValueError("pass sharding or device, not both")
@@ -1036,7 +1086,10 @@ class Dataset:
                     ) from e
                 yield out
 
-        return _gen()
+        gen = _gen()
+        if prefetch_batches and prefetch_batches > 0:
+            gen = _prefetch(gen, prefetch_batches)
+        return gen
 
     def iter_torch_batches(
         self,
@@ -1045,6 +1098,7 @@ class Dataset:
         drop_last: bool = False,
         dtypes: Optional[Dict[str, Any]] = None,
         device: Optional[str] = None,
+        prefetch_batches: int = 1,
     ) -> Iterator[Dict[str, Any]]:
         """Stream batches as dicts of torch tensors (reference:
         dataset.iter_torch_batches; the jax analog is iter_jax_batches).
@@ -1070,7 +1124,10 @@ class Dataset:
                     out[name] = t
                 yield out
 
-        return _gen()
+        gen = _gen()
+        if prefetch_batches and prefetch_batches > 0:
+            gen = _prefetch(gen, prefetch_batches)
+        return gen
 
     def iter_rows(self) -> Iterator[Dict[str, Any]]:
         import ray_tpu

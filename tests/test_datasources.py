@@ -165,3 +165,51 @@ def test_dataset_stats_reports_operators(cluster):
     ds.take_all()
     s = ds.stats()
     assert "tasks=" in s and "peak_in_flight=" in s
+
+
+def test_prefetch_overlaps_and_preserves_results(cluster):
+    import threading
+    import time as _time
+
+    def slow_map(b):
+        return {"id": b["id"] * 2}
+
+    ds = rdata.range(12, parallelism=4).map_batches(slow_map, batch_size=None)
+    out = []
+    pump_seen = False
+    for batch in ds.iter_batches(batch_size=4, prefetch_batches=2):
+        pump_seen = pump_seen or any(
+            t.name == "batch-prefetch" for t in threading.enumerate())
+        _time.sleep(0.05)  # consumer "step": producer runs ahead meanwhile
+        out.extend(int(v) for v in batch["id"])
+    assert sorted(out) == [i * 2 for i in range(12)]
+    assert pump_seen  # prefetch genuinely ran on a background thread
+
+    # prefetch=0 disables the background thread path
+    n = sum(len(b["id"]) for b in ds.iter_batches(batch_size=4, prefetch_batches=0))
+    assert n == 12
+
+
+def test_prefetch_abandonment_stops_producer(cluster):
+    import threading
+    import time as _time
+
+    ds = rdata.range(8, parallelism=4)
+    it = iter(ds.iter_batches(batch_size=2, prefetch_batches=1))
+    next(it)
+    it.close()  # abandon with the buffer full
+    deadline = _time.monotonic() + 10
+    while _time.monotonic() < deadline:
+        if not any(t.name == "batch-prefetch" for t in threading.enumerate()):
+            break
+        _time.sleep(0.1)
+    assert not any(t.name == "batch-prefetch" for t in threading.enumerate())
+
+
+def test_prefetch_propagates_errors(cluster):
+    def boom(b):
+        raise RuntimeError("prefetch-boom")
+
+    ds = rdata.range(4, parallelism=2).map_batches(boom, batch_size=None)
+    with pytest.raises(Exception):
+        list(ds.iter_batches(batch_size=None, prefetch_batches=2))
