@@ -29,18 +29,78 @@ from typing import Any, Dict, Optional
 _KV_PREFIX = "kv://"
 
 
+_SUPPORTED = ("env_vars", "py_modules", "working_dir", "pip")
+
+
 def normalize(runtime_env: Optional[dict]) -> Optional[dict]:
     """Canonical form; None for empty (no dedicated worker needed)."""
     if not runtime_env:
         return None
     out = {}
-    for key in ("env_vars", "py_modules", "working_dir"):
+    for key in _SUPPORTED:
         if runtime_env.get(key):
             out[key] = runtime_env[key]
-    unknown = set(runtime_env) - {"env_vars", "py_modules", "working_dir"}
+    unknown = set(runtime_env) - set(_SUPPORTED)
     if unknown:
         raise ValueError(f"unsupported runtime_env fields: {sorted(unknown)}")
+    if "pip" in out:
+        pip = out["pip"]
+        if isinstance(pip, dict):  # reference accepts {"packages": [...]}
+            pip = pip.get("packages", [])
+        if isinstance(pip, str):
+            raise ValueError(
+                "runtime_env['pip'] must be a list of requirement strings "
+                "(requirements-file paths are not supported: the image is "
+                "immutable, so this field validates rather than installs)")
+        out["pip"] = sorted(str(p) for p in pip)
     return out or None
+
+
+def check_pip_requirements(packages) -> None:
+    """This deployment's images are IMMUTABLE (decision recorded in
+    PARITY.md): runtime_env["pip"] VALIDATES that the requirements are
+    already satisfied by the baked image instead of installing — a missing
+    or mismatched package fails worker setup with a clear error rather
+    than silently running against the wrong environment (reference:
+    _private/runtime_env/pip.py installs; same user-visible contract of
+    "my task ran with these packages or it didn't run")."""
+    import importlib.metadata as im
+
+    try:
+        from packaging.requirements import InvalidRequirement, Requirement
+        from packaging.version import Version
+    except ImportError:  # presence-only fallback
+        Requirement = None
+
+    problems = []
+    for req in packages:
+        req = str(req)
+        if Requirement is None:
+            name = req.split(";")[0].split("[")[0]
+            for sep in ("==", ">=", "<=", "~=", "!=", ">", "<"):
+                name = name.split(sep)[0]
+            try:
+                im.version(name.strip())
+            except im.PackageNotFoundError:
+                problems.append(f"{name.strip()}: not installed in the immutable image")
+            continue
+        try:
+            r = Requirement(req)
+        except InvalidRequirement as e:
+            problems.append(f"{req!r}: unparseable requirement ({e})")
+            continue
+        try:
+            have = im.version(r.name)
+        except im.PackageNotFoundError:
+            problems.append(f"{r.name}: not installed in the immutable image")
+            continue
+        if r.specifier and not r.specifier.contains(Version(have), prereleases=True):
+            problems.append(f"{r.name}: image has {have}, requirement is {r.specifier}")
+    if problems:
+        raise RuntimeError(
+            "runtime_env['pip'] cannot install into the immutable TPU image; "
+            "these requirements are unsatisfied: " + "; ".join(problems)
+            + ". Bake them into the image or drop the pin.")
 
 
 def env_hash(runtime_env: Optional[dict]) -> str:
@@ -145,6 +205,8 @@ def apply_in_worker(gcs_client, runtime_env: Optional[dict]):
     Runs once per (dedicated) worker process before user code."""
     if not runtime_env:
         return
+    if runtime_env.get("pip"):
+        check_pip_requirements(runtime_env["pip"])
     for name, value in (runtime_env.get("env_vars") or {}).items():
         os.environ[name] = str(value)
     for uri in runtime_env.get("py_modules") or ():

@@ -1065,7 +1065,8 @@ class Dataset:
 
             for batch in self.iter_batches(batch_size=batch_size,
                                            batch_format="numpy",
-                                           drop_last=drop_last):
+                                           drop_last=drop_last,
+                                           prefetch_batches=0):
                 host = {}
                 for name, col in batch.items():
                     if dtypes and name in dtypes:
@@ -1111,7 +1112,8 @@ class Dataset:
 
             for batch in self.iter_batches(batch_size=batch_size,
                                            batch_format="numpy",
-                                           drop_last=drop_last):
+                                           drop_last=drop_last,
+                                           prefetch_batches=0):
                 out = {}
                 for name, col in batch.items():
                     arr = np.asarray(col)

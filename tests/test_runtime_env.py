@@ -94,3 +94,32 @@ def test_unknown_field_rejected(ray_start_regular):
 
     with pytest.raises(ValueError, match="unsupported runtime_env"):
         ray_tpu.get(f.options(runtime_env={"conda": "myenv"}).remote())
+
+
+def test_pip_validation_immutable_image(ray_start_regular):
+    """runtime_env['pip'] validates against the baked image (install is a
+    recorded non-goal: the image is immutable — PARITY.md): satisfied
+    requirements run; unsatisfied ones fail the task with a clear error."""
+    import pytest as _pytest
+
+    import ray_tpu
+
+    @ray_tpu.remote
+    def ok():
+        import numpy
+
+        return numpy.__version__
+
+    assert ray_tpu.get(
+        ok.options(runtime_env={"pip": ["numpy", "jax>=0.4"]}).remote(),
+        timeout=120)
+
+    @ray_tpu.remote
+    def nope():
+        return 1
+
+    with _pytest.raises(Exception, match="not installed in the immutable"):
+        ray_tpu.get(
+            nope.options(runtime_env={"pip": ["definitely-not-a-package"]},
+                         max_retries=0).remote(),
+            timeout=120)
