@@ -50,7 +50,12 @@ class _Router:
         version = ray_tpu.get(controller.get_version.remote())
         if version == self._version and self._replicas:
             return
-        deadline = time.monotonic() + 30.0
+        # replicas that compile jitted programs at startup (LLM engines) can
+        # take minutes on a loaded host: wait as long as actor creation may
+        from ray_tpu._private.config import global_config
+
+        wait_s = global_config().actor_creation_timeout_s
+        deadline = time.monotonic() + wait_s
         while True:
             ids = ray_tpu.get(
                 controller.get_replica_actor_ids.remote(self._app, self._dep))
@@ -58,7 +63,7 @@ class _Router:
                 break
             if time.monotonic() > deadline:
                 raise RuntimeError(
-                    f"no replicas for {self._app}/{self._dep} after 30s")
+                    f"no replicas for {self._app}/{self._dep} after {wait_s:.0f}s")
             time.sleep(0.05)
         with self._lock:
             self._replicas = [ActorHandle(ActorID(h)) for h in ids]
