@@ -75,6 +75,7 @@ class PlacementGroupInfo:
     bundle_nodes: List[Optional[NodeID]] = field(default_factory=list)
     name: Optional[str] = None
     soft_target_node_id: Optional[NodeID] = None
+    slice_label: Optional[str] = None  # persisted so a GCS restart can resume scheduling
 
 
 class Pubsub:
@@ -259,6 +260,19 @@ class GcsServer:
             for info in self.actors.values():
                 if info.state in ("PENDING", "RESTARTING"):
                     self._actor_queue.append(info.actor_id)
+            # PGs that were mid-schedule lost their _schedule_pg thread with the
+            # old process; without a respawn they'd stay PENDING forever and
+            # creation waiters would hang (unbounded when waiting on autoscaled
+            # capacity).
+            pending_pgs = [pg for pg in self.placement_groups.values()
+                           if pg.state in ("PENDING", "RESCHEDULING")]
+        for pg in pending_pgs:
+            threading.Thread(
+                # getattr: snapshots written before slice_label existed restore
+                # PlacementGroupInfo dicts without the field
+                target=self._schedule_pg, args=(pg, getattr(pg, "slice_label", None)),
+                daemon=True, name="gcs-pg-resched",
+            ).start()
         logger.info(
             "GCS: restored %d actors, %d kv keys, %d jobs, %d PGs from %s",
             len(self.actors), len(self.kv), len(self.jobs),
@@ -655,7 +669,8 @@ class GcsServer:
         with self._lock:
             if name:
                 self.named_pgs[name] = pg_id
-            info = PlacementGroupInfo(pg_id=pg_id, bundles=bundles, strategy=strategy, name=name)
+            info = PlacementGroupInfo(pg_id=pg_id, bundles=bundles, strategy=strategy,
+                                      name=name, slice_label=slice_label)
             self.placement_groups[pg_id] = info
         self._mark_dirty()
         threading.Thread(

@@ -1356,15 +1356,18 @@ class CoreWorker:
                 with self._exec_state_lock:
                     self.current_task_id = None
                     self._exec_thread_id = None
-            # a cancel KI injected during fn() may still be UNDELIVERED
-            # (PyThreadState_SetAsyncExc fires at a later bytecode check);
-            # give it a safe runway here so it cannot land mid-send_reply
-            # and produce a second reply on the same token
-            try:
-                for _ in range(2000):
-                    pass
-            except KeyboardInterrupt:
-                pass  # task already completed; the ok reply still goes out
+                    # deterministic cancel barrier: HandleCancelTask only
+                    # injects under this lock while current_task_id matches,
+                    # so after this block no NEW KI can arrive; an already-
+                    # injected-but-undelivered KI is expunged here (NULL
+                    # clears the pending async exc), so it can never land
+                    # mid-send_reply and produce a second reply on the token.
+                    # A KI delivered before the clear propagates out of this
+                    # finally and takes the single cancelled-reply path.
+                    import ctypes
+
+                    ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                        ctypes.c_ulong(threading.get_ident()), None)
             self.server.send_reply(reply_token, {"status": "ok", "returns": returns})
             replied = True
         except KeyboardInterrupt:

@@ -33,6 +33,13 @@ class Connector:
         connectors inherit __call__."""
         return self(x)
 
+    def reset_rows(self, mask: np.ndarray, reset_obs: np.ndarray) -> None:
+        """Episode-boundary signal: ``mask[i]`` is True for env rows that
+        just auto-reset; ``reset_obs`` is the post-reset raw observation
+        batch. Per-row stateful connectors (FrameStack) drop the previous
+        episode's history for those rows (reference: FrameStackingEnvToModule
+        resets on episode start). Stateless connectors ignore it."""
+
 
 class ConnectorPipeline(Connector):
     """Ordered composition (reference: ConnectorPipelineV2)."""
@@ -53,6 +60,11 @@ class ConnectorPipeline(Connector):
         for c in self.connectors:
             x = c.transform(x)
         return x
+
+    def reset_rows(self, mask: np.ndarray, reset_obs: np.ndarray) -> None:
+        for c in self.connectors:
+            c.reset_rows(mask, reset_obs)
+            reset_obs = c.transform(reset_obs)
 
     def __len__(self):
         return len(self.connectors)
@@ -132,6 +144,19 @@ class FrameStack(Connector):
         if self._hist is None or self._hist[0].shape != obs.shape:
             return np.concatenate([obs] * self.k, axis=-1)
         return np.concatenate(self._hist[1:] + [obs], axis=-1)
+
+    def reset_rows(self, mask: np.ndarray, reset_obs: np.ndarray) -> None:
+        """Refill the history of just-reset env rows with their reset
+        observation so the first k-1 stacked frames of a new episode never
+        contain the previous episode's observations."""
+        if self._hist is None:
+            return
+        reset_obs = np.asarray(reset_obs, np.float32)
+        if self._hist[0].shape != reset_obs.shape:
+            return
+        mask = np.asarray(mask, np.bool_)
+        # copy-on-write: frames are shared between window positions
+        self._hist = [np.where(mask[..., None], reset_obs, h) for h in self._hist]
 
 
 # ---------------------------------------------------------------------------

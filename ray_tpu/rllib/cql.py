@@ -29,7 +29,16 @@ from ray_tpu.rllib.env import EnvSpec, make_env
 
 
 def episodes_to_transitions(episodes: List[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:
-    """(obs, actions, rewards, next_obs, dones) from per-episode arrays."""
+    """(obs, actions, rewards, next_obs, dones) from per-episode arrays.
+
+    An episode may carry ``dones`` (or a ``truncated`` flag for its end):
+    a time-limit-truncated fragment is NOT terminal, so its last transition
+    keeps a live bootstrap — the TD target uses max_a Q(s_T, a) — instead of
+    being wrongly zeroed. ``final_obs`` (the observation after the last
+    action), when provided, is the bootstrap state; otherwise the last in-
+    episode obs approximates it. Without any of these fields the episode is
+    treated as ending in a true terminal (the prior behavior).
+    """
     obs, acts, rews, nxt, dones = [], [], [], [], []
     for ep in episodes:
         o = np.asarray(ep["obs"], np.float32)
@@ -39,12 +48,17 @@ def episodes_to_transitions(episodes: List[Dict[str, np.ndarray]]) -> Dict[str, 
         obs.append(o)
         acts.append(a)
         rews.append(r)
-        # terminal transition's successor is its own obs — the done mask
-        # zeroes the bootstrap, so the value never flows
-        nxt.append(np.concatenate([o[1:], o[-1:]], axis=0))
-        d = np.zeros(T, np.float32)
-        d[-1] = 1.0
+        if "dones" in ep:
+            d = np.asarray(ep["dones"], np.float32)
+        else:
+            d = np.zeros(T, np.float32)
+            # truncated fragments bootstrap; true terminals zero the target
+            d[-1] = 0.0 if ep.get("truncated", False) else 1.0
         dones.append(d)
+        final = ep.get("final_obs")
+        final = (np.asarray(final, np.float32)[None]
+                 if final is not None else o[-1:])
+        nxt.append(np.concatenate([o[1:], final], axis=0))
     return {"obs": np.concatenate(obs), "actions": np.concatenate(acts),
             "rewards": np.concatenate(rews), "next_obs": np.concatenate(nxt),
             "dones": np.concatenate(dones)}
@@ -145,14 +159,26 @@ class CQL:
                 key = eps[i].item() if hasattr(eps[i], "item") else eps[i]
                 ep = episodes.get(key)
                 if ep is None:
-                    ep = episodes[key] = {"obs": [], "actions": [], "rewards": []}
+                    ep = episodes[key] = {"obs": [], "actions": [], "rewards": [],
+                                          "dones": []}
                     order.append(key)
                 ep["obs"].append(np.asarray(batch["obs"][i], np.float32))
                 ep["actions"].append(int(np.asarray(batch["actions"][i])))
                 ep["rewards"].append(float(np.asarray(batch["rewards"][i])))
-        return [{"obs": np.stack(e["obs"]), "actions": np.asarray(e["actions"]),
-                 "rewards": np.asarray(e["rewards"])}
-                for e in (episodes[k] for k in order)]
+                if "dones" in batch:
+                    ep["dones"].append(float(np.asarray(batch["dones"][i])))
+
+        def _pack(e):
+            out = {"obs": np.stack(e["obs"]), "actions": np.asarray(e["actions"]),
+                   "rewards": np.asarray(e["rewards"])}
+            # only trust a dones column that covered EVERY row of the episode;
+            # shards that inconsistently carry it would otherwise misalign
+            # dones[i] with its transition
+            if e["dones"] and len(e["dones"]) == len(e["rewards"]):
+                out["dones"] = np.asarray(e["dones"], np.float32)
+            return out
+
+        return [_pack(episodes[k]) for k in order]
 
     def train(self) -> Dict[str, Any]:
         cfg = self.config

@@ -115,11 +115,17 @@ class EnvRunner:
                 self._obs[i] = nxt
             if next_obs_buf is not None:
                 # pre-reset true successors, through the SAME transform as
-                # obs (state-free: no double-ingestion of boundary frames)
+                # obs (state-free: no double-ingestion of boundary frames).
+                # Must run BEFORE reset_rows so the boundary transition's
+                # successor stacks the OLD episode's history, not reset frames.
                 rows = np.stack(nxt_rows)
                 if self._env_to_module is not None:
                     rows = self._env_to_module.transform(rows)
                 next_obs_buf[t] = rows
+            if done_buf[t].any() and self._env_to_module is not None:
+                # per-row episode boundary: stateful connectors (FrameStack)
+                # must not leak the previous episode's frames into the new one
+                self._env_to_module.reset_rows(done_buf[t], np.stack(self._obs))
 
         # bootstrap value for the unfinished tail of each env's fragment
         # (transform(): the same obs re-enter the stream at the next

@@ -133,6 +133,12 @@ class _AsyncProxy:
             if b":" in h:
                 k, v = h.split(b":", 1)
                 headers[k.decode("latin1").strip().lower()] = v.decode("latin1").strip()
+        if "chunked" in headers.get("transfer-encoding", "").lower():
+            # decode the chunk stream in full — leaving it unread would make
+            # the keep-alive loop re-parse raw chunks as the next request and
+            # corrupt connection framing
+            body = await self._read_chunked(reader)
+            return method, target, headers, body
         try:
             length = int(headers.get("content-length", 0) or 0)
         except ValueError:
@@ -141,6 +147,32 @@ class _AsyncProxy:
             raise _BadRequest("body too large")
         body = await reader.readexactly(length) if length else b""
         return method, target, headers, body
+
+    @staticmethod
+    async def _read_chunked(reader) -> bytes:
+        chunks = []
+        total = 0
+        while True:
+            size_line = await reader.readline()
+            if not size_line:
+                # EOF mid-stream is a truncated body, not a terminating chunk
+                raise asyncio.IncompleteReadError(partial=b"".join(chunks), expected=None)
+            try:
+                size = int(size_line.split(b";", 1)[0].strip(), 16)
+            except ValueError:
+                raise _BadRequest("bad chunk size")
+            if size == 0:
+                # consume the trailer section up to its terminating blank line
+                while True:
+                    t = await reader.readline()
+                    if t in (b"\r\n", b"\n", b""):
+                        break
+                return b"".join(chunks)
+            total += size
+            if total > _MAX_BODY:
+                raise _BadRequest("body too large")
+            chunks.append(await reader.readexactly(size))
+            await reader.readexactly(2)  # trailing CRLF
 
     @staticmethod
     def _response(status: int, body: bytes, content_type: str = "application/json",

@@ -26,6 +26,51 @@ jax.config.update("jax_num_cpu_devices", 8)
 
 import pytest
 
+# ---------------------------------------------------------------------------
+# Per-test watchdog (no pytest-timeout in the image): SIGALRM covers the whole
+# runtest protocol — fixtures included, where the one observed core-lane hang
+# class lives — dumping ALL thread stacks before failing the test, so a hang
+# leaves evidence instead of a silent dead lane.
+# ---------------------------------------------------------------------------
+
+_DEFAULT_TIMEOUT_S = 60
+_SLOW_TIMEOUT_S = 900
+
+
+class _TestTimeout(BaseException):
+    # BaseException (like KeyboardInterrupt): the codebase under test is full
+    # of `except Exception` retry loops that would otherwise swallow the
+    # one-shot watchdog raise and leave the lane hung again
+    pass
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_protocol(item, nextitem):
+    import faulthandler
+    import signal
+    import sys
+
+    timeout = _DEFAULT_TIMEOUT_S
+    if item.get_closest_marker("slow") or item.get_closest_marker("stress"):
+        timeout = _SLOW_TIMEOUT_S
+    m = item.get_closest_marker("timeout")
+    if m is not None:
+        timeout = int(m.args[0])
+
+    def _on_alarm(signum, frame):
+        sys.stderr.write(f"\n=== watchdog: {item.nodeid} exceeded {timeout}s; "
+                         "all thread stacks follow ===\n")
+        faulthandler.dump_traceback(file=sys.stderr)
+        raise _TestTimeout(f"{item.nodeid} exceeded per-test timeout of {timeout}s")
+
+    old = signal.signal(signal.SIGALRM, _on_alarm)
+    signal.alarm(timeout)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+
 
 @pytest.fixture
 def ray_start_regular():

@@ -114,3 +114,32 @@ def test_gcs_restart_requeues_pending_actor(tmp_path):
         del wref
     finally:
         cluster.shutdown()
+
+
+@pytest.mark.slow
+def test_gcs_restart_resumes_pending_placement_group(tmp_path):
+    """A PG persisted while still PENDING gets its scheduling thread back after
+    a GCS restart — it must reach CREATED once capacity appears instead of
+    hanging forever (the restored snapshot re-spawns _schedule_pg)."""
+    from ray_tpu.util.placement_group import placement_group
+
+    snap = str(tmp_path / "gcs-state.bin")
+    cluster = Cluster(
+        head_node_args={"num_cpus": 1},
+        gcs_args={"persistence_path": snap},
+    )
+    try:
+        cluster.connect_driver()
+
+        pg = placement_group([{"gizmo": 1}], strategy="PACK", name="pending-pg")
+        time.sleep(0.5)  # let CreatePlacementGroup land (PG stays PENDING)
+        cluster.gcs.snapshot_now()
+        cluster.kill_gcs()
+        cluster.restart_gcs()
+
+        # capacity arrives only after the restart; the restored scheduling
+        # thread must pick it up
+        cluster.add_node(num_cpus=1, resources={"gizmo": 1})
+        assert pg.ready(timeout=60)
+    finally:
+        cluster.shutdown()

@@ -49,6 +49,46 @@ def test_frame_stack_concatenates_history():
     assert np.all(out[:, -4:] == 2.0) and np.all(out[:, :4] == 1.0)
 
 
+def test_frame_stack_resets_rows_at_episode_boundary():
+    from ray_tpu.rllib import FrameStack
+
+    fs = FrameStack(k=3)
+    old = np.ones((2, 4), np.float32)
+    for _ in range(3):
+        fs(old)  # both rows' windows full of the old episode
+    # env row 0 auto-resets to a fresh observation; row 1 continues
+    reset = np.stack([7 * np.ones(4, np.float32), old[1]])
+    fs.reset_rows(np.array([True, False]), reset)
+    nxt = np.stack([7 * np.ones(4, np.float32), 2 * np.ones(4, np.float32)])
+    out = fs(nxt)
+    # row 0: no frame from the previous episode survives
+    assert np.all(out[0] == 7.0)
+    # row 1: history untouched (old, old, new)
+    assert np.all(out[1, :8] == 1.0) and np.all(out[1, -4:] == 2.0)
+
+
+def test_cql_truncated_episode_keeps_bootstrap():
+    from ray_tpu.rllib.cql import episodes_to_transitions
+
+    ep = {
+        "obs": np.arange(6, dtype=np.float32).reshape(3, 2),
+        "actions": np.zeros(3, np.int64),
+        "rewards": np.ones(3, np.float32),
+    }
+    # default: last step is a true terminal
+    term = episodes_to_transitions([dict(ep)])
+    assert term["dones"][-1] == 1.0
+    # time-limit truncation: bootstrap stays live and uses final_obs
+    trunc = episodes_to_transitions(
+        [dict(ep, truncated=True, final_obs=np.array([9.0, 9.0], np.float32))])
+    assert trunc["dones"][-1] == 0.0
+    assert np.all(trunc["next_obs"][-1] == 9.0)
+    # explicit per-step dones are honored verbatim
+    explicit = episodes_to_transitions(
+        [dict(ep, dones=np.array([0.0, 0.0, 0.0], np.float32))])
+    assert explicit["dones"][-1] == 0.0
+
+
 def test_pipeline_composition_and_sampling():
     from ray_tpu.rllib import ActionClip, ConnectorPipeline, ObsScaler, SoftmaxSample
 

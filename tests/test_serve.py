@@ -137,6 +137,47 @@ def test_http_proxy_end_to_end(serve_cluster):
     assert body == {"got": {"a": 1}}
 
 
+def test_http_proxy_chunked_body_keepalive(serve_cluster):
+    """A Transfer-Encoding: chunked body is decoded in full and keep-alive
+    framing survives — the chunk stream must not be re-parsed as the next
+    request (ray_tpu/serve/_private/proxy.py _read_chunked)."""
+    import socket
+
+    @serve.deployment
+    def echo(payload):
+        return {"got": payload}
+
+    handle = serve.run(echo.bind(), name="app-chunked")
+    host, port = serve.start_http_proxy(port=0)
+    serve.add_route("/echoc", handle)
+
+    payload = json.dumps({"a": 1}).encode()
+    half = len(payload) // 2
+    chunked = (
+        f"{half:x}\r\n".encode() + payload[:half] + b"\r\n"
+        + f"{len(payload) - half:x}\r\n".encode() + payload[half:] + b"\r\n"
+        + b"0\r\n\r\n"
+    )
+    with socket.create_connection((host, port), timeout=30) as s:
+        s.sendall(b"POST /echoc HTTP/1.1\r\nHost: x\r\n"
+                  b"Content-Type: application/json\r\n"
+                  b"Transfer-Encoding: chunked\r\n\r\n" + chunked)
+        # second request on the SAME connection proves framing stayed intact
+        s.sendall(b"POST /echoc HTTP/1.1\r\nHost: x\r\n"
+                  b"Content-Type: application/json\r\n"
+                  + f"Content-Length: {len(payload)}\r\n\r\n".encode() + payload)
+        buf = b""
+        deadline = time.monotonic() + 30
+        while buf.count(b"{\"got\"") < 2 and time.monotonic() < deadline:
+            s.settimeout(max(0.1, deadline - time.monotonic()))
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            buf += chunk
+    assert buf.count(b"HTTP/1.1 200") == 2, buf[:500]
+    assert buf.count(json.dumps({"got": {"a": 1}}).encode()) == 2
+
+
 def test_batching(serve_cluster):
     @serve.deployment
     class Batched:
