@@ -82,7 +82,19 @@ class JaxLLMEngine:
         cos, sin = rope_frequencies(cfg.head_dim, self.max_seq, cfg.rope_theta)
         self._rope = (jnp.asarray(cos), jnp.asarray(sin))
 
+        # --- tensor parallelism: a real mesh, not just a chip reservation ---
+        # (reference: vllm_models.py:177-186 wires TP from engine_kwargs into
+        # the engine; here TP is a jax mesh axis and GSPMD partitions the
+        # prefill/decode programs from the param + cache shardings alone)
+        self.mesh = self._build_tp_mesh(config.tensor_parallel_size)
         self.cache = llama.init_kv_cache(cfg, self.max_batch, self.max_seq)
+        if self.mesh is not None:
+            from ray_tpu.parallel.mesh import shard_pytree
+
+            self.params = shard_pytree(
+                self.params, llama.inference_param_specs(cfg), self.mesh)
+            self.cache = shard_pytree(
+                self.cache, llama.kv_cache_spec(), self.mesh)
         # host-side slot state
         self._slot_req: List[Optional[_Request]] = [None] * self.max_batch
         self._lengths = np.zeros(self.max_batch, np.int32)
@@ -100,6 +112,28 @@ class JaxLLMEngine:
         # compilations automatically
         self._prefill = jax.jit(self._prefill_impl)
         self._write_slot = jax.jit(llama.write_cache_slot, donate_argnums=0)
+
+    def _build_tp_mesh(self, tp: int):
+        """Validate the TP degree and build a `tensor`-axis mesh over tp
+        devices; TP=1 stays mesh-free (single-device fast path)."""
+        if tp <= 1:
+            return None
+        cfg = self.cfg
+        devices = jax.devices()
+        if len(devices) < tp:
+            raise ValueError(
+                f"tensor_parallel_size={tp} but only {len(devices)} visible "
+                f"device(s) — a TP engine must never silently compute on one "
+                f"chip while reserving {tp}")
+        for name, dim in (("n_heads", cfg.n_heads), ("n_kv_heads", cfg.n_kv_heads),
+                          ("ffn_dim", cfg.ffn_dim), ("vocab_size", cfg.vocab_size)):
+            if dim % tp:
+                raise ValueError(
+                    f"tensor_parallel_size={tp} does not divide model "
+                    f"{name}={dim}")
+        from ray_tpu.parallel.mesh import MeshSpec
+
+        return MeshSpec(tensor=tp).build(devices[:tp])
 
     # -- jitted programs ------------------------------------------------
 

@@ -156,6 +156,46 @@ def param_specs(cfg: LlamaConfig) -> Params:
     return specs
 
 
+def inference_param_specs(cfg: LlamaConfig) -> Params:
+    """TP-only PartitionSpec tree for serving (no fsdp axis: inference has no
+    optimizer state to shard, and per-layer fsdp all-gathers would serialize
+    the latency-critical decode step).
+
+    Megatron layout over the "tensor" axis: attention/FFN projections are
+    column-sharded on their output dim and row-sharded back (XLA inserts the
+    psum), the embedding table is vocab-sharded, and the LM head column-
+    sharded so logits come out vocab-sharded too.
+    reference: llm/_internal/serve/deployments/llm/vllm/vllm_models.py:177-186
+    (TP degree wired from engine_kwargs into the vLLM engine).
+    """
+    specs: Params = {
+        "embed": P("tensor", None),
+        "layers": {
+            "attn_norm": P(None, None),
+            "wq": P(None, None, "tensor"),
+            "wk": P(None, None, "tensor"),
+            "wv": P(None, None, "tensor"),
+            "wo": P(None, "tensor", None),
+            "mlp_norm": P(None, None),
+            "w_gate": P(None, None, "tensor"),
+            "w_up": P(None, None, "tensor"),
+            "w_down": P(None, "tensor", None),
+        },
+        "final_norm": P(None),
+    }
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = P(None, "tensor")
+    return specs
+
+
+def kv_cache_spec() -> Dict[str, P]:
+    """KV cache [L, B, S, n_kv, hd] shards the kv-head axis over "tensor",
+    matching wk/wv column sharding — cache writes and attention reads then
+    never reshard."""
+    spec = P(None, None, None, "tensor", None)
+    return {"k": spec, "v": spec}
+
+
 def _constraint(x, spec, mesh):
     if mesh is None:
         return x

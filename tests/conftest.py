@@ -55,7 +55,7 @@ def pytest_runtest_protocol(item, nextitem):
         timeout = _SLOW_TIMEOUT_S
     m = item.get_closest_marker("timeout")
     if m is not None:
-        timeout = int(m.args[0])
+        timeout = int(m.args[0] if m.args else m.kwargs["seconds"])
 
     def _on_alarm(signum, frame):
         sys.stderr.write(f"\n=== watchdog: {item.nodeid} exceeded {timeout}s; "
