@@ -34,13 +34,22 @@ BATCH_AXES = ("data", "fsdp")
 
 @dataclasses.dataclass(frozen=True)
 class MeshSpec:
-    """Logical parallelism degrees. Product must equal the device count."""
+    """Logical parallelism degrees. Product must equal the device count.
+
+    ``num_slices > 1`` builds a hybrid ICI×DCN mesh: devices are grouped
+    into slices (TPU ICI domains) and the ``data`` axis is laid out with
+    slices outermost, so ONLY data-parallel gradient reduction crosses the
+    slow DCN links while fsdp/expert/context/tensor collectives stay on
+    intra-slice ICI (SURVEY §5 item (b); reference slice machinery:
+    python/ray/_private/accelerators/tpu.py:316-334).
+    """
 
     data: int = 1
     fsdp: int = 1
     expert: int = 1
     context: int = 1
     tensor: int = 1
+    num_slices: int = 1  # DCN granules; `data` must be a multiple of it
 
     @property
     def num_devices(self) -> int:
@@ -54,6 +63,8 @@ class MeshSpec:
             raise ValueError(
                 f"mesh {shape} needs {math.prod(shape)} devices, have {len(devices)}"
             )
+        if self.num_slices > 1:
+            return self._build_hybrid(devices, shape)
         try:
             # Auto axis types: shardings flow via with_sharding_constraint +
             # XLA propagation (jax >= 0.8 defaults new meshes to Explicit).
@@ -63,6 +74,27 @@ class MeshSpec:
             import numpy as np
 
             return Mesh(np.asarray(devices).reshape(shape), MESH_AXES)
+
+    def _build_hybrid(self, devices: Sequence, shape) -> Mesh:
+        """ICI×DCN mesh: per-slice shape × across-slice shape."""
+        if self.data % self.num_slices:
+            raise ValueError(
+                f"data={self.data} must be a multiple of num_slices="
+                f"{self.num_slices}: DCN-crossing parallelism is data-parallel "
+                f"over slices (fsdp/context/tensor must stay on ICI)")
+        from jax.experimental import mesh_utils
+
+        ici = (self.data // self.num_slices, self.fsdp, self.expert,
+               self.context, self.tensor)
+        dcn = (self.num_slices, 1, 1, 1, 1)
+        # real TPU slices carry distinguishing slice_index values; virtual/CPU
+        # multi-process deployments (all slice_index 0 or absent) use the
+        # process as the DCN granule instead
+        slice_ids = {getattr(d, "slice_index", None) for d in devices}
+        use_slice_index = len(slice_ids) == self.num_slices and None not in slice_ids
+        arr = mesh_utils.create_hybrid_device_mesh(
+            ici, dcn, devices=devices, process_is_granule=not use_slice_index)
+        return Mesh(arr, MESH_AXES)
 
     @classmethod
     def for_devices(cls, n: int, *, tensor: int = 1, context: int = 1) -> "MeshSpec":

@@ -47,10 +47,21 @@ class JaxConfig(BackendConfig):
     distributed: None = auto (initialize jax.distributed iff >1 worker);
     True/False force it. On TPU pods every worker must call
     jax.distributed.initialize before touching devices.
+
+    multislice: None = auto — when the gang's workers sit on more than one
+    TPU slice (distinct TPU names from the accelerator manager), each worker
+    gets the megascale env (MEGASCALE_NUM_SLICES / _SLICE_ID /
+    _COORDINATOR_ADDRESS) before jax.distributed.initialize so the runtime
+    brings DCN transport up between slices; pair with
+    ``MeshSpec(num_slices=N)`` so only the data axis crosses DCN.
     """
 
     distributed: Optional[bool] = None
     coordinator_port: Optional[int] = None
+    multislice: Optional[bool] = None
+    # megascale DCN transport runs its own coordinator service — it must NOT
+    # share the jax.distributed coordination port on the slice-0 host
+    megascale_port: int = 8080
 
     @property
     def backend_cls(self):
@@ -86,6 +97,23 @@ def _init_jax_distributed(coordinator: str, num_processes: int, process_id: int)
     return True
 
 
+def _get_slice_name():
+    from ray_tpu._private.accelerators.tpu import TPUAcceleratorManager
+
+    return TPUAcceleratorManager.get_current_node_tpu_name()
+
+
+def _set_multislice_env(num_slices: int, slice_id: int, coordinator: str):
+    """megascale contract: the TPU runtime reads these at jax.distributed
+    init time to bring up DCN transport between slices."""
+    import os
+
+    os.environ["MEGASCALE_NUM_SLICES"] = str(num_slices)
+    os.environ["MEGASCALE_SLICE_ID"] = str(slice_id)
+    os.environ["MEGASCALE_COORDINATOR_ADDRESS"] = coordinator
+    return True
+
+
 class _JaxBackend(Backend):
     def on_start(self, worker_group, backend_config: JaxConfig):
         n = len(worker_group)
@@ -99,6 +127,29 @@ class _JaxBackend(Backend):
         )
         import ray_tpu
 
+        # multislice detection: group workers by their TPU slice name; >1
+        # distinct slice means gradients will cross DCN and the runtime
+        # needs the megascale env on every worker BEFORE distributed init
+        multislice = backend_config.multislice
+        if multislice is None or multislice:
+            slice_names = worker_group.execute(_get_slice_name)
+            distinct = [s for s in dict.fromkeys(slice_names) if s is not None]
+            if multislice and len(distinct) <= 1:
+                raise ValueError(
+                    "JaxConfig(multislice=True) but the gang's workers do not "
+                    f"report >1 distinct TPU slice name (got {distinct or 'none'})"
+                    " — megascale slice ids cannot be assigned. Check TPU_NAME /"
+                    " the GCE metadata server on the workers.")
+            if len(distinct) > 1:
+                slice_ids = {name: i for i, name in enumerate(distinct)}
+                ms_coord = (f"{coordinator.rsplit(':', 1)[0]}"
+                            f":{backend_config.megascale_port}")
+                ray_tpu.get([
+                    w._execute.remote(
+                        _set_multislice_env, len(distinct),
+                        slice_ids.get(slice_names[i], 0), ms_coord)
+                    for i, w in enumerate(worker_group.workers)
+                ])
         ray_tpu.get([
             w._execute.remote(_init_jax_distributed, coordinator, n, i)
             for i, w in enumerate(worker_group.workers)

@@ -54,8 +54,9 @@ def pytest_runtest_protocol(item, nextitem):
     if item.get_closest_marker("slow") or item.get_closest_marker("stress"):
         timeout = _SLOW_TIMEOUT_S
     m = item.get_closest_marker("timeout")
-    if m is not None:
-        timeout = int(m.args[0] if m.args else m.kwargs["seconds"])
+    if m is not None and (m.args or m.kwargs):
+        timeout = int(m.args[0] if m.args
+                      else m.kwargs.get("seconds", m.kwargs.get("timeout", timeout)))
 
     def _on_alarm(signum, frame):
         sys.stderr.write(f"\n=== watchdog: {item.nodeid} exceeded {timeout}s; "

@@ -1,0 +1,114 @@
+"""Multi-slice (DCN) hybrid meshes (VERDICT r2 directive #2).
+
+MeshSpec(num_slices=N) builds an ICI×DCN mesh with the data axis outermost
+across slices; _JaxBackend detects multi-slice gangs from per-worker TPU
+names and sets the megascale env before jax.distributed.initialize.
+
+reference: SURVEY §5 item (b); python/ray/_private/accelerators/
+tpu.py:316-334 (slice metadata the reference exposes for exactly this).
+"""
+
+import numpy as np
+import pytest
+
+from ray_tpu.parallel.mesh import MeshSpec
+
+
+def test_num_slices_requires_data_multiple():
+    # 2*2*2 = 8 devices (matches the CPU mesh) but data=2 cannot span 4 slices
+    with pytest.raises(ValueError, match="multiple of num_slices"):
+        MeshSpec(data=2, fsdp=2, tensor=2, num_slices=4).build()
+
+
+def test_num_slices_in_spec_roundtrip():
+    spec = MeshSpec(data=4, tensor=2, num_slices=2)
+    assert spec.num_devices == 8
+    assert spec.num_slices == 2
+
+
+@pytest.mark.slow
+def test_hybrid_mesh_two_virtual_slices():
+    """Full 2-process × 4-device dryrun: hybrid mesh builds with the data
+    axis crossing the process (DCN) boundary, the train step compiles with
+    a DCN-crossing gradient reduction, and both slices agree on the loss."""
+    import __graft_entry__
+
+    __graft_entry__._dryrun_multislice(8)
+
+
+@pytest.mark.slow
+def test_jax_backend_sets_multislice_env(ray_start_regular):
+    """A gang whose workers sit on two distinct TPU slices gets the
+    megascale env (NUM_SLICES / per-worker SLICE_ID / coordinator) set on
+    every worker before jax.distributed.initialize."""
+    import os
+
+    from ray_tpu.train._internal.worker_group import WorkerGroup
+    from ray_tpu.train.backend import JaxConfig, _JaxBackend
+
+    wg = WorkerGroup(num_workers=2, resources_per_worker={"CPU": 1.0})
+    try:
+        # simulate two slices: each worker reports a different TPU name
+        def set_name(name):
+            os.environ["TPU_NAME"] = name
+            # the workers run off-TPU; multi-process CPU jax needs the
+            # platform pinned and gloo collectives configured BEFORE the
+            # backend comes up
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            jax.config.update("jax_num_cpu_devices", 2)
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+            return True
+
+        for i, w in enumerate(wg.workers):
+            import ray_tpu
+            ray_tpu.get(w._execute.remote(set_name, f"slice-{i}"))
+
+        _JaxBackend().on_start(wg, JaxConfig(distributed=True))
+
+        def read_env():
+            return {k: os.environ.get(k) for k in
+                    ("MEGASCALE_NUM_SLICES", "MEGASCALE_SLICE_ID",
+                     "MEGASCALE_COORDINATOR_ADDRESS")}
+
+        envs = wg.execute(read_env)
+        assert [e["MEGASCALE_NUM_SLICES"] for e in envs] == ["2", "2"]
+        assert sorted(e["MEGASCALE_SLICE_ID"] for e in envs) == ["0", "1"]
+        assert all(e["MEGASCALE_COORDINATOR_ADDRESS"] for e in envs)
+
+        # jax.distributed actually came up across the gang
+        def global_process_count():
+            import jax
+            return jax.process_count()
+
+        assert wg.execute(global_process_count) == [2, 2]
+    finally:
+        wg.shutdown()
+
+
+@pytest.mark.slow
+def test_jax_backend_single_slice_no_megascale(ray_start_regular):
+    """Same-slice gangs must NOT get megascale env (it would make the TPU
+    runtime wait for DCN peers that don't exist)."""
+    import os
+
+    from ray_tpu.train._internal.worker_group import WorkerGroup
+    from ray_tpu.train.backend import JaxConfig, _JaxBackend
+
+    wg = WorkerGroup(num_workers=2, resources_per_worker={"CPU": 1.0})
+    try:
+        def set_name():
+            os.environ["TPU_NAME"] = "one-slice"
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            return True
+
+        wg.execute(set_name)
+        _JaxBackend().on_start(wg, JaxConfig(distributed=True))
+
+        def read_env():
+            return os.environ.get("MEGASCALE_NUM_SLICES")
+
+        assert wg.execute(read_env) == [None, None]
+    finally:
+        wg.shutdown()
