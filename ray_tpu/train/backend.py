@@ -141,13 +141,24 @@ class _JaxBackend(Backend):
                     " — megascale slice ids cannot be assigned. Check TPU_NAME /"
                     " the GCE metadata server on the workers.")
             if len(distinct) > 1:
+                if any(s is None for s in slice_names):
+                    raise ValueError(
+                        "multi-slice gang has workers with unresolvable TPU "
+                        f"slice names ({slice_names}) — a defaulted slice id "
+                        "would give megascale an inconsistent topology. Check "
+                        "TPU_NAME / the GCE metadata server on those workers.")
+                host, port = coordinator.rsplit(":", 1)
+                if int(port) == backend_config.megascale_port:
+                    raise ValueError(
+                        f"megascale_port {backend_config.megascale_port} "
+                        "collides with the jax.distributed coordinator port — "
+                        "the two coordinator services cannot share host:port")
                 slice_ids = {name: i for i, name in enumerate(distinct)}
-                ms_coord = (f"{coordinator.rsplit(':', 1)[0]}"
-                            f":{backend_config.megascale_port}")
+                ms_coord = f"{host}:{backend_config.megascale_port}"
                 ray_tpu.get([
                     w._execute.remote(
                         _set_multislice_env, len(distinct),
-                        slice_ids.get(slice_names[i], 0), ms_coord)
+                        slice_ids[slice_names[i]], ms_coord)
                     for i, w in enumerate(worker_group.workers)
                 ])
         ray_tpu.get([
