@@ -9,10 +9,11 @@ headline metric is Llama training-step MFU on the local TPU chip and
     slice it rides ICI; benchmarks/allreduce_bench.py has the multi-size CLI)
   - ``moe``: train MFU of the second model family (Mixtral-style sparse
     MoE, active-params accounting)
-  - ``dryrun_8b``: the Llama-3-8B config traced + jit-lowered over a virtual
-    8-device fsdp×tp mesh in a subprocess (shape/sharding exercise, no
-    execution) plus the analytic per-chip HBM footprint on the v5p-128
-    target layout (fsdp=64 × tp=2)
+  - ``dryrun_8b``: the Llama-3-8B config traced, lowered AND compiled over a
+    virtual 8-device fsdp×tp mesh in a subprocess — XLA accepts the SPMD
+    program and reports real per-chip memory (compiled.memory_analysis()),
+    scaled to the v5p-128 target layout (fsdp=64 × tp=2) against its 95 GB
+    HBM budget
 
 vs_baseline is measured MFU / 0.40 (the ≥40% MFU north-star; the reference
 publishes no in-repo MFU numbers).
@@ -89,11 +90,21 @@ mesh = MeshSpec(fsdp=4, tensor=2).build(jax.devices())
 init_fn, step_fn = make_train_step(cfg, mesh)
 state_shape = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
 tokens = jax.ShapeDtypeStruct((8, 8192), jnp.int32)
-lowered = step_fn.lower(state_shape, tokens)  # full SPMD lowering, no compile
+lowered = step_fn.lower(state_shape, tokens)  # full SPMD lowering
+compiled = lowered.compile()                  # XLA accepts the program
+ma = compiled.memory_analysis()               # real per-device byte counts
 print(json.dumps({
     "ok": True,
+    "compiled": True,
     "params": cfg.num_params,
     "lowered_mb": len(lowered.as_text()) // 2**20,
+    "mem_per_chip": {
+        "arguments_gb": round(ma.argument_size_in_bytes / 2**30, 3),
+        "temp_gb": round(ma.temp_size_in_bytes / 2**30, 3),
+        "output_gb": round(ma.output_size_in_bytes / 2**30, 3),
+        "peak_gb": round(ma.peak_memory_in_bytes / 2**30, 3),
+        "mesh": "fsdp=4 x tp=2 (8 devices)",
+    },
 }))
 """
 
@@ -116,12 +127,14 @@ def _dryrun_8b() -> dict:
         return {"error": str(e)[:200]}
     if not out.get("ok"):
         return {"error": (proc.stderr or "")[-200:]}
-    # analytic HBM footprint on the v5p-128 target layout (fsdp=64, tp=2):
-    # bf16 params + bf16 grads + bf16 mu + fp32 nu, sharded over 128 chips
-    n = LlamaConfig.llama3_8b().num_params
-    state_bytes = n * (2 + 2 + 2 + 4)
-    out["hbm_state_gb_per_chip_v5p128"] = round(state_bytes / 128 / 2**30, 3)
-    out["hbm_state_gb_total"] = round(state_bytes / 2**30, 1)
+    # scale the COMPILED per-chip argument bytes (the sharded train state,
+    # measured by XLA on the fsdp=4 x tp=2 mesh) to the v5p-128 target
+    # (fsdp=64 x tp=2): state shards linearly with chip count
+    mem = out.get("mem_per_chip", {})
+    if mem.get("arguments_gb"):
+        per_chip_128 = mem["arguments_gb"] * 8 / 128
+        out["hbm_state_gb_per_chip_v5p128"] = round(per_chip_128, 3)
+        out["fits_v5p_hbm_95gb"] = per_chip_128 < 95.0
     return out
 
 

@@ -60,6 +60,11 @@ class MoEConfig:
     # formulation is what GSPMD lowers to expert all-to-alls).
     # "ragged" / "dense" force one implementation.
     dispatch: str = "auto"
+
+    def __post_init__(self):
+        if self.dispatch not in ("auto", "ragged", "dense"):
+            raise ValueError(
+                f"dispatch={self.dispatch!r} — must be 'auto', 'ragged' or 'dense'")
     max_seq_len: int = 8192
     rope_theta: float = 1e6
     rms_norm_eps: float = 1e-5

@@ -165,11 +165,43 @@ def test_moe_expert_parallel_matches_single_device(moe_cfg, tokens, spec):
     state = init_fn(jax.random.PRNGKey(0))
     state, m = step_fn(state, tokens)
 
-    init1, step1 = make_train_step(moe_cfg)
+    # pin dense dispatch: the auto default would run ragged (no capacity
+    # drops) unmeshed, which is a different model from the meshed GShard
+    # path (see moe_block's NOTE)
+    import dataclasses as dc
+
+    cfg_dense = dc.replace(moe_cfg, dispatch="dense")
+    init1, step1 = make_train_step(cfg_dense)
     s1 = init1(jax.random.PRNGKey(0))
     s1, m1 = step1(s1, tokens)
     assert abs(float(m["loss"]) - float(m1["loss"])) < 2e-3
     assert abs(float(m["grad_norm"]) - float(m1["grad_norm"])) < 2e-2
+
+
+def test_moe_ragged_matches_dense_with_ample_capacity(moe_cfg, tokens):
+    """The sorted/ragged grouped-matmul dispatch computes the same function
+    as the GShard dense dispatch when no token is dropped (capacity ample):
+    logits and aux loss agree to float tolerance."""
+    import dataclasses as dc
+
+    from ray_tpu.models import moe
+
+    params = moe.init_params(moe_cfg, jax.random.PRNGKey(2))
+    cfg_r = dc.replace(moe_cfg, dispatch="ragged")
+    cfg_d = dc.replace(moe_cfg, dispatch="dense", capacity_factor=8.0)
+    lr, ar = moe.forward(cfg_r, params, tokens)
+    ld, ad = moe.forward(cfg_d, params, tokens)
+    assert float(jnp.abs(lr - ld).max()) < 1e-4
+    assert abs(float(ar) - float(ad)) < 1e-6
+
+
+def test_moe_dispatch_validated():
+    import pytest as _pytest
+
+    from ray_tpu.models.moe import MoEConfig
+
+    with _pytest.raises(ValueError, match="dispatch"):
+        MoEConfig.tiny(dispatch="raggd")
 
 
 def test_moe_capacity_drops_overflow(moe_cfg):
@@ -180,7 +212,8 @@ def test_moe_capacity_drops_overflow(moe_cfg):
 
     from ray_tpu.models import moe
 
-    cfg = dc.replace(moe_cfg, capacity_factor=0.05)
+    # force the dense path: ragged has no capacity bound to exercise
+    cfg = dc.replace(moe_cfg, capacity_factor=0.05, dispatch="dense")
     params = moe.init_params(cfg, jax.random.PRNGKey(0))
     tok = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, cfg.vocab_size)
     logits, aux = moe.forward(cfg, params, tok)
