@@ -45,7 +45,7 @@ from ray_tpu._private.worker import (
     get_global_worker,
     set_global_worker,
 )
-from ray_tpu.actor import ActorClass, ActorHandle
+from ray_tpu.actor import ActorClass, ActorHandle, method
 from ray_tpu.remote_function import RemoteFunction
 
 __version__ = "0.1.0"

@@ -43,6 +43,12 @@ class TaskSpec:
     actor_method: Optional[str] = None
     sequence_number: int = 0                     # per-caller ordering for actor tasks
     max_concurrency: int = 1
+    # named concurrency groups (reference: src/ray/core_worker/task_execution/
+    # concurrency_group_manager.h; python/ray/actor.py:384-447): the creation
+    # spec carries name -> max_concurrency, each actor task may carry the
+    # group it dispatches to (per-call override or @ray_tpu.method default)
+    concurrency_groups: Optional[Dict[str, int]] = None
+    concurrency_group: Optional[str] = None
     max_restarts: int = 0
     max_task_retries: int = 0
     detached: bool = False

@@ -438,6 +438,7 @@ class CoreWorker:
         self._actor_spec: Optional[TaskSpec] = None
         self._actor_lease: Optional[dict] = None
         self._actor_exec_pool: Optional[DaemonExecutor] = None
+        self._actor_group_pools: Dict[str, "DaemonExecutor"] = {}
         self._actor_seq_lock = threading.Lock()
         # per-caller ordered arrival queues (reference: ActorSchedulingQueue):
         # caller -> {"epoch": int, "next": int, "pending": {(epoch, seq): item}}
@@ -1499,7 +1500,8 @@ class CoreWorker:
 
     def create_actor(self, cls, args, kwargs, *, name=None, num_returns=1, resources=None,
                      strategy=None, max_restarts=0, max_task_retries=0, max_concurrency=1,
-                     lifetime=None, namespace="default", runtime_env=None):
+                     concurrency_groups=None, lifetime=None, namespace="default",
+                     runtime_env=None):
         from ray_tpu._private.resources import ResourceSet
         from ray_tpu._private.scheduler import SchedulingStrategy
 
@@ -1525,6 +1527,7 @@ class CoreWorker:
             max_restarts=max_restarts,
             max_task_retries=max_task_retries,
             max_concurrency=max_concurrency,
+            concurrency_groups=dict(concurrency_groups) if concurrency_groups else None,
             detached=(lifetime == "detached"),
             actor_name=name,
             runtime_env=runtime_env,
@@ -1559,7 +1562,7 @@ class CoreWorker:
         raise GetTimeoutError(f"actor {actor_id} not alive after {timeout}s")
 
     def submit_actor_task(self, actor_id: ActorID, method_name: str, args, kwargs,
-                          num_returns=1, max_task_retries=0):
+                          num_returns=1, max_task_retries=0, concurrency_group=None):
         spec = TaskSpec(
             task_id=TaskID.random(),
             job_id=self.job_id,
@@ -1574,6 +1577,7 @@ class CoreWorker:
             actor_id=actor_id,
             actor_method=method_name,
             max_retries=max_task_retries,
+            concurrency_group=concurrency_group,
         )
         self.task_manager.add_pending(spec)
         self._record_task_event(spec, "SUBMITTED")
@@ -1621,7 +1625,26 @@ class CoreWorker:
         self._actor_exec_pool = DaemonExecutor(
             max_workers=max(spec.max_concurrency, 1), thread_name_prefix="actor-exec"
         )
+        # named concurrency groups: each gets its OWN pool so a saturated
+        # group (e.g. blocked user methods) can never starve another (e.g.
+        # health checks). reference: concurrency_group_manager.h — per-group
+        # executors with dispatch by the task's group.
+        self._actor_group_pools = {
+            name: DaemonExecutor(max_workers=max(int(n), 1),
+                                 thread_name_prefix=f"actor-cg-{name}")
+            for name, n in (spec.concurrency_groups or {}).items()
+        }
         return {"ok": True, "address": self.server.address}
+
+    def _resolve_concurrency_group(self, spec) -> Optional[str]:
+        """Per-call override wins, else the @ray_tpu.method declaration on
+        the actor class, else None (the default ordered path)."""
+        if spec.concurrency_group is not None:
+            return spec.concurrency_group
+        if spec.actor_method and self._actor_instance is not None:
+            fn = getattr(type(self._actor_instance), spec.actor_method, None)
+            return getattr(fn, "_ray_tpu_concurrency_group", None)
+        return None
 
     def HandlePushActorTask(self, req, reply_token=None):
         """Ordered per-caller arrival queue (reference: ActorSchedulingQueue /
@@ -1633,7 +1656,8 @@ class CoreWorker:
             raise ActorUnavailableError("no actor instance on this worker")
         spec: TaskSpec = req["spec"]
         if self._actor_spec is not None and self._actor_spec.max_concurrency > 1:
-            self._actor_exec_pool.submit(self._execute_actor_task, req, reply_token)
+            self._dispatch_actor_task(
+                self._resolve_concurrency_group(spec), req, reply_token)
             return RpcServer.DELAYED_REPLY
         caller = spec.owner_worker_id.hex()
         epoch, seq = req.get("epoch", 1), spec.sequence_number
@@ -1645,11 +1669,35 @@ class CoreWorker:
             if seq == 1 and epoch > st["epoch"]:
                 st["epoch"], st["next"] = epoch, 0
                 st["pending"] = {k: v for k, v in st["pending"].items() if k[0] >= epoch}
+            # every task (any group) flows through the per-caller seq window
+            # so the arrival order is gapless; at RELEASE each task goes to
+            # ITS pool — group tasks run concurrently in theirs and never
+            # wait behind (or block) the default group's single slot
             while (st["epoch"], st["next"] + 1) in st["pending"]:
                 st["next"] += 1
                 r, tok = st["pending"].pop((st["epoch"], st["next"]))
-                self._actor_exec_pool.submit(self._execute_actor_task, r, tok)
+                self._dispatch_actor_task(
+                    self._resolve_concurrency_group(r["spec"]), r, tok)
         return RpcServer.DELAYED_REPLY
+
+    def _dispatch_actor_task(self, group, req, reply_token):
+        """Route a released actor task to its group's pool (default pool when
+        group is None). An unknown group errors HERE — after the task's
+        (epoch, seq) slot was consumed by the ordered queue — so the
+        rejection can never wedge the caller's sequence window."""
+        if group is not None:
+            pool = self._actor_group_pools.get(group)
+            if pool is None:
+                self.server.send_reply(reply_token, {
+                    "status": "error",
+                    "error": ValueError(
+                        f"unknown concurrency group {group!r} "
+                        f"(declared: {sorted(self._actor_group_pools)})"),
+                    "traceback": ""})
+                return
+            pool.submit(self._execute_actor_task, req, reply_token)
+            return
+        self._actor_exec_pool.submit(self._execute_actor_task, req, reply_token)
 
     def _execute_actor_task(self, req, reply_token):
         spec: TaskSpec = req["spec"]

@@ -32,15 +32,33 @@ def exit_actor():
     raise ActorExitException(0)
 
 
+def method(*, concurrency_group: Optional[str] = None):
+    """Method decorator declaring per-method actor options (reference:
+    python/ray/actor.py @ray.method). ``concurrency_group`` names one of the
+    groups declared in ``@ray_tpu.remote(concurrency_groups={...})``; the
+    executor dispatches the method to that group's thread pool. (num_returns
+    stays a per-call option — ``actor.f.options(num_returns=n)`` — because
+    handles resolved by name don't carry class metadata.)"""
+
+    def wrap(fn):
+        if concurrency_group is not None:
+            fn._ray_tpu_concurrency_group = concurrency_group
+        return fn
+
+    return wrap
+
+
 class ActorMethod:
     def __init__(self, handle: "ActorHandle", method_name: str):
         self._handle = handle
         self._method_name = method_name
         self._num_returns = 1
+        self._concurrency_group: Optional[str] = None
 
-    def options(self, num_returns: int = 1):
+    def options(self, num_returns: int = 1, concurrency_group: Optional[str] = None):
         m = ActorMethod(self._handle, self._method_name)
         m._num_returns = num_returns
+        m._concurrency_group = concurrency_group
         return m
 
     def remote(self, *args, **kwargs):
@@ -54,6 +72,7 @@ class ActorMethod:
             kwargs,
             num_returns=self._num_returns,
             max_task_retries=self._handle._max_task_retries,
+            concurrency_group=self._concurrency_group,
         )
 
     def bind(self, *args, **kwargs):
@@ -110,6 +129,7 @@ class ActorClass:
             max_restarts=opts.get("max_restarts", 0),
             max_task_retries=opts.get("max_task_retries", 0),
             max_concurrency=opts.get("max_concurrency", 1),
+            concurrency_groups=opts.get("concurrency_groups"),
             lifetime=opts.get("lifetime"),
             namespace=opts.get("namespace", "default"),
             runtime_env=opts.get("runtime_env"),

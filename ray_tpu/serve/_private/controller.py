@@ -203,6 +203,9 @@ class ServeController:
         opts = dict(cfg.get("ray_actor_options") or {})
         opts.setdefault("num_cpus", 0.1)
         opts["max_concurrency"] = max(cfg.get("max_ongoing_requests", 5), 2)
+        # router probes + health checks stay responsive even when every
+        # user-request slot is blocked
+        opts["concurrency_groups"] = {"system": 4}
         cls = ray_tpu.remote(ServeReplica).options(**opts)
         return cls.remote(
             cfg["name"], cfg["serialized_callable"], cfg.get("init_args"),

@@ -10,6 +10,8 @@ import threading
 import time
 from typing import Any, Dict, Optional, Tuple
 
+from ray_tpu.actor import method as _actor_method
+
 
 class ServeReplica:
     """Hosts the user class/function; tracks queue length for the
@@ -86,10 +88,17 @@ class ServeReplica:
             with self._lock:
                 self._ongoing -= 1
 
+    # control-plane methods ride the "system" concurrency group: a replica
+    # whose user methods are all blocked must still answer router probes and
+    # controller health checks (reference: the reference replica's dedicated
+    # control/system concurrency groups, python/ray/serve/_private/replica.py)
+
+    @_actor_method(concurrency_group="system")
     def queue_len(self) -> int:
         """Probe used by the router (reference: pow_2_router.py:52)."""
         return self._ongoing
 
+    @_actor_method(concurrency_group="system")
     def stats(self) -> Dict[str, Any]:
         return {"ongoing": self._ongoing, "total": self._total,
                 "max_ongoing": self._max_ongoing}
@@ -99,6 +108,7 @@ class ServeReplica:
             self._callable.reconfigure(user_config)
         return True
 
+    @_actor_method(concurrency_group="system")
     def check_health(self) -> bool:
         if hasattr(self._callable, "check_health"):
             self._callable.check_health()

@@ -301,7 +301,8 @@ class ClientServer:
         refs = self._worker.submit_actor_task(
             payload["actor_id"], payload["method"], args, kwargs,
             num_returns=payload["num_returns"],
-            max_task_retries=payload.get("max_task_retries", 0))
+            max_task_retries=payload.get("max_task_retries", 0),
+            concurrency_group=payload.get("concurrency_group"))
         reply = s.pin(refs)
         s.cache_op(payload.get("op"), reply)
         return reply

@@ -180,27 +180,29 @@ class ClientWorker:
 
     def create_actor(self, cls, args, kwargs, *, name=None, num_returns=1,
                      resources=None, strategy=None, max_restarts=0,
-                     max_task_retries=0, max_concurrency=1, lifetime=None,
-                     namespace="default", runtime_env=None):
+                     max_task_retries=0, max_concurrency=1, concurrency_groups=None,
+                     lifetime=None, namespace="default", runtime_env=None):
         actor_id = self._call("ClientCreateActor", {
             "cls": serialization.dumps_inline(cls),
             "args": serialization.dumps_inline((tuple(args), dict(kwargs or {}))),
             "options": dict(name=name, resources=resources, strategy=strategy,
                             max_restarts=max_restarts, max_task_retries=max_task_retries,
-                            max_concurrency=max_concurrency, lifetime=lifetime,
+                            max_concurrency=max_concurrency,
+                            concurrency_groups=concurrency_groups, lifetime=lifetime,
                             namespace=namespace, runtime_env=runtime_env),
             "op": uuid.uuid4().hex,
         }, timeout=None)
         return actor_id, None
 
     def submit_actor_task(self, actor_id: ActorID, method_name: str, args, kwargs,
-                          num_returns=1, max_task_retries=0):
+                          num_returns=1, max_task_retries=0, concurrency_group=None):
         packed = self._call("ClientSubmitActorTask", {
             "actor_id": actor_id,
             "method": method_name,
             "args": serialization.dumps_inline((tuple(args), dict(kwargs or {}))),
             "num_returns": num_returns,
             "max_task_retries": max_task_retries,
+            "concurrency_group": concurrency_group,
             "op": uuid.uuid4().hex,
         }, timeout=None)
         if num_returns == 1:
