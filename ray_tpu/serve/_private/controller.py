@@ -55,13 +55,28 @@ class ServeController:
     def __init__(self):
         # app -> deployment -> config dict
         self._desired: Dict[str, Dict[str, dict]] = {}
-        # app -> deployment -> list of replica handles
-        self._replicas: Dict[str, Dict[str, List[Any]]] = {}
-        # app -> deployment -> config hash the replicas were started with
-        self._replica_cfg: Dict[str, Dict[str, str]] = {}
+        # app -> deployment -> list of replica records
+        # {"h": ActorHandle, "hash": cfg-hash the replica was started with}
+        # — per-replica versioning is what makes rolling redeploys possible
+        # (reference: deployment_state.py:1003 DeploymentReplica lifecycle)
+        self._replicas: Dict[str, Dict[str, List[dict]]] = {}
+        # replicas flipped out of service but possibly still running requests:
+        # (handle, hard-kill deadline); killed when queue_len reaches 0 or the
+        # graceful_shutdown_timeout_s deadline passes
+        self._draining: List[list] = []
         self._version = 0
         self._lock = threading.RLock()
         self._stop = threading.Event()
+        # replica startup (spawn + health gate, up to actor_creation_timeout_s)
+        # runs OFF the reconcile thread so one slow/unschedulable deployment
+        # can never stall drains, deletes, or other deployments
+        from ray_tpu._private.utils import DaemonExecutor
+
+        self._start_pool = DaemonExecutor(max_workers=4,
+                                          thread_name_prefix="serve-start")
+        self._starting: set = set()            # (app, dep) with a start in flight
+        self._start_backoff: Dict[tuple, float] = {}  # (app, dep, hash) -> retry-at
+        self._start_fails: Dict[tuple, int] = {}      # (app, dep, hash) -> streak
         self._thread = threading.Thread(target=self._reconcile_loop, daemon=True,
                                         name="serve-reconcile")
         self._thread.start()
@@ -100,10 +115,12 @@ class ServeController:
             return app.get(deployment_name)
 
     def get_replica_actor_ids(self, app_name: str, deployment_name: str) -> List[str]:
-        """Routers fetch replica actor ids + poll version (long-poll analog)."""
+        """Routers fetch replica actor ids + poll version (long-poll analog).
+        Draining replicas are already excluded — they finish their in-flight
+        requests but receive no new ones."""
         with self._lock:
             reps = self._replicas.get(app_name, {}).get(deployment_name, [])
-            return [r._actor_id.hex() for r in reps]
+            return [r["h"]._actor_id.hex() for r in reps]
 
     def get_deployment_stats(self, app_name: str, deployment_name: str):
         import ray_tpu
@@ -113,7 +130,7 @@ class ServeController:
         out = []
         for r in reps:
             try:
-                out.append(ray_tpu.get(r.stats.remote(), timeout=5))
+                out.append(ray_tpu.get(r["h"].stats.remote(), timeout=5))
             except Exception:  # noqa: BLE001
                 out.append(None)
         return out
@@ -123,8 +140,18 @@ class ServeController:
             self._desired = {}
             self._version += 1
         self._stop.set()
-        # reconcile once more to tear down replicas
+        # reconcile once more to tear down replicas, then hard-kill anything
+        # still draining — shutdown does not wait out drain deadlines
         self._reconcile()
+        import ray_tpu
+
+        with self._lock:
+            items, self._draining = self._draining, []
+        for entry in items:
+            try:
+                ray_tpu.kill(entry[0])
+            except Exception:  # noqa: BLE001
+                pass
         return True
 
     # -- reconciliation ------------------------------------------------------
@@ -140,61 +167,176 @@ class ServeController:
     def _reconcile(self):
         import ray_tpu
 
+        self._drain_step()
         with self._lock:
             desired = {app: dict(deps) for app, deps in self._desired.items()}
-        # stop replicas of deleted apps/deployments, and all replicas whose
-        # deployment config changed (code redeploy → rolling replace)
+        # Phase 1 (under the lock): retire replicas — deleted apps/deployments
+        # drain entirely; scale-downs drain the excess; a code/config change
+        # drains OLD-version replicas only once a full NEW-version set is in
+        # service (graceful rolling redeploy — old replicas keep serving while
+        # the new set starts, then finish their in-flight requests off-router).
         with self._lock:
             for app in list(self._replicas):
                 for dep in list(self._replicas[app]):
                     want = desired.get(app, {}).get(dep)
-                    reps = self._replicas[app][dep]
-                    target = want["num_replicas"] if want else 0
-                    if want is not None:
-                        stored = self._replica_cfg.get(app, {}).get(dep)
-                        if stored is not None and stored != _cfg_hash(want):
-                            # code/config changed → kill all; the start phase
-                            # below restarts replicas on the new code
-                            self._replica_cfg.get(app, {}).pop(dep, None)
-                            target = 0
-                    while len(reps) > target:
-                        victim = reps.pop()
-                        try:
-                            ray_tpu.kill(victim)
-                        except Exception:  # noqa: BLE001
-                            pass
+                    recs = self._replicas[app][dep]
                     if not want:
+                        self._begin_drain(recs)
+                        recs.clear()
                         del self._replicas[app][dep]
-                        self._replica_cfg.get(app, {}).pop(dep, None)
+                        self._version += 1
+                        continue
+                    new_hash = _cfg_hash(want)
+                    target = want["num_replicas"]
+                    cur = [r for r in recs if r["hash"] == new_hash]
+                    old = [r for r in recs if r["hash"] != new_hash]
+                    if old and len(cur) >= target:
+                        # the new-version set is complete: flip the router
+                        # (version bump) and drain the old code
+                        for r in old:
+                            recs.remove(r)
+                        self._begin_drain(old)
+                        self._version += 1
+                    excess = cur[target:]
+                    if excess:
+                        for r in excess:
+                            recs.remove(r)
+                        self._begin_drain(excess)
                         self._version += 1
                 if app not in desired and not self._replicas.get(app):
                     self._replicas.pop(app, None)
-                    self._replica_cfg.pop(app, None)
-        # start missing replicas (actor creation happens outside the lock; the
-        # desired state is re-checked before committing so a concurrent
-        # shutdown()/delete can't leak freshly started replicas)
+        # Phase 2: kick off async starts for missing NEW-version replicas
+        # (one in-flight start batch per deployment; backoff after failures)
         for app, deps in desired.items():
             for dep_name, cfg in deps.items():
+                new_hash = _cfg_hash(cfg)
+                key = (app, dep_name)
                 with self._lock:
-                    reps = self._replicas.setdefault(app, {}).setdefault(dep_name, [])
-                    missing = cfg["num_replicas"] - len(reps)
-                if missing <= 0:
-                    continue
-                new = [self._start_replica(app, cfg) for _ in range(missing)]
-                with self._lock:
-                    still_wanted = self._desired.get(app, {}).get(dep_name)
-                    target = still_wanted["num_replicas"] if still_wanted else 0
-                    keep = max(0, min(len(new), target - len(reps)))
-                    reps.extend(new[:keep])
-                    discard = new[keep:]
+                    recs = self._replicas.setdefault(app, {}).setdefault(dep_name, [])
+                    missing = cfg["num_replicas"] - sum(
+                        1 for r in recs if r["hash"] == new_hash)
+                    if (missing <= 0 or key in self._starting
+                            or time.monotonic() < self._start_backoff.get(
+                                (app, dep_name, new_hash), 0.0)):
+                        continue
+                    self._starting.add(key)
+                self._start_pool.submit(
+                    self._start_missing, app, dep_name, cfg, new_hash, missing)
+
+    def _start_missing(self, app, dep_name, cfg, new_hash, missing):
+        """Spawn `missing` replicas and health-gate them (off the reconcile
+        thread). A replica joins the router only once its actor is up and
+        check_health passes; the old version keeps serving through this
+        window on a redeploy. Desired state is re-checked (and the records
+        list re-fetched) under the lock before committing, so a concurrent
+        shutdown()/delete/redeploy can't leak replicas onto an orphaned list."""
+        import ray_tpu
+        from ray_tpu._private.config import global_config
+
+        try:
+            started = [self._start_replica(app, cfg) for _ in range(missing)]
+            deadline = time.monotonic() + global_config().actor_creation_timeout_s
+            healthy, bad = [], []
+            refs = [h.check_health.remote() for h in started]
+            for h, ref in zip(started, refs):
+                try:
+                    ray_tpu.get(ref, timeout=max(1.0, deadline - time.monotonic()))
+                    healthy.append(h)
+                except Exception:  # noqa: BLE001
+                    bad.append(h)
+            grace = cfg.get("graceful_shutdown_timeout_s", 20.0)
+            fail_key = (app, dep_name, new_hash)
+            with self._lock:
+                still = self._desired.get(app, {}).get(dep_name)
+                keep = 0
+                if still is not None and _cfg_hash(still) == new_hash:
+                    recs = self._replicas.setdefault(app, {}).setdefault(dep_name, [])
+                    cur_n = sum(1 for r in recs if r["hash"] == new_hash)
+                    keep = max(0, min(len(healthy), still["num_replicas"] - cur_n))
+                    recs.extend({"h": h, "hash": new_hash, "grace": grace}
+                                for h in healthy[:keep])
                     if keep:
-                        self._replica_cfg.setdefault(app, {})[dep_name] = _cfg_hash(cfg)
-                    self._version += 1
-                for victim in discard:
-                    try:
-                        ray_tpu.kill(victim)
-                    except Exception:  # noqa: BLE001
-                        pass
+                        self._version += 1
+                discard = healthy[keep:] + bad
+                if bad:
+                    self._start_fails[fail_key] = self._start_fails.get(fail_key, 0) + 1
+                    self._start_backoff[fail_key] = time.monotonic() + 5.0
+                    if self._start_fails[fail_key] >= 2 and still is not None:
+                        # start-first rollout can deadlock when the OLD
+                        # replicas pin the resources the new ones need: after
+                        # two failed batches fall back to stop-first — drain
+                        # the old version now so the next attempt can schedule
+                        recs = self._replicas.get(app, {}).get(dep_name, [])
+                        old = [r for r in recs if r["hash"] != new_hash]
+                        if old:
+                            logger.warning(
+                                "serve: %s/%s new-version replicas failed to "
+                                "start twice; falling back to stop-first "
+                                "rollout (draining %d old replicas)",
+                                app, dep_name, len(old))
+                            for r in old:
+                                recs.remove(r)
+                            self._begin_drain(old)
+                            self._version += 1
+                else:
+                    self._start_fails.pop(fail_key, None)
+                    self._start_backoff.pop(fail_key, None)
+            for victim in discard:
+                try:
+                    ray_tpu.kill(victim)
+                except Exception:  # noqa: BLE001
+                    pass
+        except Exception:  # noqa: BLE001
+            logger.exception("serve: replica start batch failed for %s/%s",
+                             app, dep_name)
+        finally:
+            with self._lock:
+                self._starting.discard((app, dep_name))
+
+    def _begin_drain(self, recs):
+        """Queue replicas for graceful stop (caller holds the lock): they are
+        already off the router; killed once idle or past their deadline (the
+        grace recorded when the replica started)."""
+        now = time.monotonic()
+        # third field: consecutive idle probes — a replica is only killed
+        # after TWO idle reads ≥1 tick apart, so a request routed just before
+        # the flip has a tick to land and show up in queue_len
+        self._draining.extend(
+            [r["h"], now + float(r.get("grace", 20.0)), 0] for r in recs)
+
+    def _drain_step(self):
+        """One pass over draining replicas: kill the idle and the overdue.
+        queue_len rides the replica's 'system' concurrency group, so a
+        replica still busy with user requests answers the probe."""
+        import ray_tpu
+
+        with self._lock:
+            items = list(self._draining)
+        if not items:
+            return
+        finished = []
+        for entry in items:
+            h, deadline, idle_streak = entry
+            kill_it = time.monotonic() > deadline
+            if not kill_it:
+                try:
+                    if ray_tpu.get(h.queue_len.remote(), timeout=2) == 0:
+                        entry[2] = idle_streak + 1
+                    else:
+                        entry[2] = 0
+                    kill_it = entry[2] >= 2
+                except Exception:  # noqa: BLE001
+                    kill_it = True  # unreachable replica: nothing to drain
+            if kill_it:
+                try:
+                    ray_tpu.kill(h)
+                except Exception:  # noqa: BLE001
+                    pass
+                finished.append(id(entry))
+        if finished:
+            with self._lock:
+                self._draining = [x for x in self._draining
+                                  if id(x) not in finished]
 
     def _start_replica(self, app: str, cfg: dict):
         import ray_tpu
@@ -230,7 +372,7 @@ class ServeController:
             total_ongoing = 0
             for r in reps:
                 try:
-                    total_ongoing += ray_tpu.get(r.queue_len.remote(), timeout=2)
+                    total_ongoing += ray_tpu.get(r["h"].queue_len.remote(), timeout=2)
                 except Exception:  # noqa: BLE001
                     pass
             target_per_replica = ac.get("target_ongoing_requests", 2)

@@ -46,6 +46,7 @@ class Application:
             "ray_actor_options": d.ray_actor_options,
             "autoscaling_config": d.autoscaling_config,
             "user_config": d.user_config,
+            "graceful_shutdown_timeout_s": d.graceful_shutdown_timeout_s,
         })
         return {"__serve_handle__": d.name}
 
@@ -57,7 +58,8 @@ class Deployment:
                  num_replicas: int = 1, max_ongoing_requests: int = 5,
                  ray_actor_options: Optional[dict] = None,
                  autoscaling_config: Optional[dict] = None,
-                 user_config: Any = None):
+                 user_config: Any = None,
+                 graceful_shutdown_timeout_s: float = 20.0):
         self._target = target
         self.name = name or getattr(target, "__name__", "deployment")
         self.num_replicas = num_replicas
@@ -65,6 +67,7 @@ class Deployment:
         self.ray_actor_options = ray_actor_options
         self.autoscaling_config = autoscaling_config
         self.user_config = user_config
+        self.graceful_shutdown_timeout_s = graceful_shutdown_timeout_s
 
     @property
     def serialized_callable(self) -> bytes:
@@ -79,6 +82,7 @@ class Deployment:
             ray_actor_options=self.ray_actor_options,
             autoscaling_config=self.autoscaling_config,
             user_config=self.user_config,
+            graceful_shutdown_timeout_s=self.graceful_shutdown_timeout_s,
         )
         merged.update(kwargs)
         return Deployment(self._target, **merged)

@@ -16,13 +16,27 @@ from typing import Any, List, Optional
 class DeploymentResponse:
     """Future-like result of handle.remote() (reference: handle.py)."""
 
-    def __init__(self, ref):
+    def __init__(self, ref, resubmit=None):
         self._ref = ref
+        self._resubmit = resubmit
 
     def result(self, timeout_s: Optional[float] = None):
         import ray_tpu
+        from ray_tpu._private.task_spec import (
+            ActorDiedError, ActorUnavailableError, WorkerCrashedError)
 
-        return ray_tpu.get(self._ref, timeout=timeout_s)
+        try:
+            return ray_tpu.get(self._ref, timeout=timeout_s)
+        except (ActorDiedError, ActorUnavailableError, WorkerCrashedError):
+            # the replica died under us — most commonly a drained old-version
+            # replica during a rolling redeploy. Re-route once through the
+            # (refreshed) router so redeploys lose zero requests.
+            if self._resubmit is None:
+                raise
+            resubmit, self._resubmit = self._resubmit, None
+            resp = resubmit()
+            self._ref = resp._ref
+            return ray_tpu.get(self._ref, timeout=timeout_s)
 
     @property
     def ref(self):
@@ -95,16 +109,33 @@ class DeploymentResponseGenerator:
     """Iterates a streaming deployment call's items as VALUES (reference:
     handle.options(stream=True) -> DeploymentResponseGenerator)."""
 
-    def __init__(self, ref_gen):
+    def __init__(self, ref_gen, resubmit=None):
         self._gen = ref_gen
+        self._resubmit = resubmit
+        self._yielded = 0
 
     def __iter__(self):
         return self
 
     def __next__(self):
         import ray_tpu
+        from ray_tpu._private.task_spec import (
+            ActorDiedError, ActorUnavailableError, WorkerCrashedError)
 
-        return ray_tpu.get(next(self._gen))
+        try:
+            out = ray_tpu.get(next(self._gen))
+        except (ActorDiedError, ActorUnavailableError, WorkerCrashedError):
+            # replica died before the stream produced anything (e.g. drained
+            # during a redeploy): re-route once. Mid-stream deaths are NOT
+            # retried — replaying would duplicate already-yielded items.
+            if self._yielded or self._resubmit is None:
+                raise
+            resubmit, self._resubmit = self._resubmit, None
+            fresh = resubmit()
+            self._gen = fresh._gen
+            out = ray_tpu.get(next(self._gen))
+        self._yielded += 1
+        return out
 
 
 class DeploymentHandle:
@@ -134,13 +165,17 @@ class DeploymentHandle:
         for _ in range(3):
             replica = self._router.choose_replica()
             try:
+                def resubmit(h=self, a=args, kw=kwargs):
+                    h._router.invalidate()
+                    return h.remote(*a, **kw)
+
                 if self._stream:
                     gen = replica.handle_request_streaming.options(
                         num_returns="streaming").remote(
                             self._method, args, kwargs)
-                    return DeploymentResponseGenerator(gen)
+                    return DeploymentResponseGenerator(gen, resubmit)
                 ref = replica.handle_request.remote(self._method, args, kwargs)
-                return DeploymentResponse(ref)
+                return DeploymentResponse(ref, resubmit)
             except Exception as e:  # noqa: BLE001
                 last_err = e
                 self._router.invalidate()

@@ -103,8 +103,16 @@ def test_redeploy_updates(serve_cluster):
     h1 = serve.run(v1.bind(), name="app6")
     assert h1.remote(None).result(timeout_s=30) == "v1"
     h2 = serve.run(v2.options(name="v1").bind(), name="app6")
-    time.sleep(0.5)
-    assert h2.remote(None).result(timeout_s=30) == "v2"
+    # rolling redeploy: the old version serves until the new replica passes
+    # its health gate, then the router flips — poll for the flip
+    deadline = time.monotonic() + 60
+    out = None
+    while time.monotonic() < deadline:
+        out = h2.remote(None).result(timeout_s=30)
+        if out == "v2":
+            break
+        time.sleep(0.3)
+    assert out == "v2"
 
 
 def test_delete_application(serve_cluster):
