@@ -234,16 +234,22 @@ class ServeController:
         from ray_tpu._private.config import global_config
 
         try:
+            from ray_tpu._private.task_spec import GetTimeoutError
+
             started = [self._start_replica(app, cfg) for _ in range(missing)]
             deadline = time.monotonic() + global_config().actor_creation_timeout_s
             healthy, bad = [], []
+            hard_errors = 0  # failures that are NOT scheduling timeouts
             refs = [h.check_health.remote() for h in started]
             for h, ref in zip(started, refs):
                 try:
                     ray_tpu.get(ref, timeout=max(1.0, deadline - time.monotonic()))
                     healthy.append(h)
+                except GetTimeoutError:
+                    bad.append(h)  # likely unschedulable (resources pinned)
                 except Exception:  # noqa: BLE001
                     bad.append(h)
+                    hard_errors += 1  # the new code itself is broken
             grace = cfg.get("graceful_shutdown_timeout_s", 20.0)
             fail_key = (app, dep_name, new_hash)
             with self._lock:
@@ -261,17 +267,22 @@ class ServeController:
                 if bad:
                     self._start_fails[fail_key] = self._start_fails.get(fail_key, 0) + 1
                     self._start_backoff[fail_key] = time.monotonic() + 5.0
-                    if self._start_fails[fail_key] >= 2 and still is not None:
+                    if (self._start_fails[fail_key] >= 2 and still is not None
+                            and hard_errors == 0):
                         # start-first rollout can deadlock when the OLD
                         # replicas pin the resources the new ones need: after
-                        # two failed batches fall back to stop-first — drain
-                        # the old version now so the next attempt can schedule
+                        # two batches that failed purely by TIMEOUT (never
+                        # scheduled), fall back to stop-first — drain the old
+                        # version so the next attempt can schedule. A batch
+                        # with any hard error means the NEW code is broken:
+                        # keep the old version serving (a bad redeploy must
+                        # degrade to stale code, not a full outage).
                         recs = self._replicas.get(app, {}).get(dep_name, [])
                         old = [r for r in recs if r["hash"] != new_hash]
                         if old:
                             logger.warning(
-                                "serve: %s/%s new-version replicas failed to "
-                                "start twice; falling back to stop-first "
+                                "serve: %s/%s new-version replicas timed out "
+                                "starting twice; falling back to stop-first "
                                 "rollout (draining %d old replicas)",
                                 app, dep_name, len(old))
                             for r in old:
@@ -314,16 +325,27 @@ class ServeController:
             items = list(self._draining)
         if not items:
             return
+        # probe all replicas concurrently under ONE shared deadline — N wedged
+        # replicas must not stall the reconcile loop N*timeout seconds
+        probes = {}
+        for entry in items:
+            try:
+                probes[id(entry)] = entry[0].queue_len.remote()
+            except Exception:  # noqa: BLE001
+                probes[id(entry)] = None
+        gather_deadline = time.monotonic() + 2.0
         finished = []
         for entry in items:
             h, deadline, idle_streak = entry
             kill_it = time.monotonic() > deadline
             if not kill_it:
+                ref = probes[id(entry)]
                 try:
-                    if ray_tpu.get(h.queue_len.remote(), timeout=2) == 0:
-                        entry[2] = idle_streak + 1
-                    else:
-                        entry[2] = 0
+                    if ref is None:
+                        raise RuntimeError("probe submit failed")
+                    qlen = ray_tpu.get(
+                        ref, timeout=max(0.1, gather_deadline - time.monotonic()))
+                    entry[2] = idle_streak + 1 if qlen == 0 else 0
                     kill_it = entry[2] >= 2
                 except Exception:  # noqa: BLE001
                     kill_it = True  # unreachable replica: nothing to drain

@@ -84,20 +84,30 @@ class Tuner:
         Terminated trials keep their results; unfinished trials restart
         from their latest persisted checkpoint.
         """
-        if not os.path.exists(os.path.join(path, EXPERIMENT_STATE_FILE)):
+        if not Tuner.can_restore(path):
             raise ValueError(f"no experiment snapshot under {path!r}")
         if tune_config is None:
-            # metric/mode/scheduler travel with the snapshot
-            tune_config = TuneController._load_snapshot(path).get("tune_config")
+            # metric/mode/scheduler travel with the snapshot; peek at JUST
+            # the state file (the controller downloads the full experiment —
+            # checkpoints included — exactly once, in its restore branch)
+            tune_config = TuneController._peek_snapshot(path).get("tune_config")
         run_config = run_config or RunConfig(
             name=os.path.basename(path.rstrip("/")),
-            storage_path=os.path.dirname(path.rstrip("/")))
+            storage_path=os.path.dirname(path.rstrip("/")) or ".")
         tuner = cls(trainable, tune_config=tune_config, run_config=run_config)
         tuner._restore_path = path
         return tuner
 
     @staticmethod
     def can_restore(path: str) -> bool:
+        from ray_tpu.train._internal.checkpoint_util import (
+            is_remote_path, join_path)
+
+        if is_remote_path(path):
+            import fsspec
+
+            fs, p = fsspec.core.url_to_fs(join_path(path, EXPERIMENT_STATE_FILE))
+            return fs.exists(p)
         return os.path.exists(os.path.join(path, EXPERIMENT_STATE_FILE))
 
 
@@ -113,37 +123,21 @@ class TuneController:
         if self._search_alg is not None:
             self._search_alg.set_search_properties(
                 tune_config.metric, tune_config.mode, param_space)
+        self._remote_exp_dir: Optional[str] = None
+        self._failed_syncs: set = set()
         if restore_path:
-            self._exp_dir = restore_path
-            self.trials = self._load_experiment_state(restore_path)
+            from ray_tpu.train._internal.checkpoint_util import is_remote_path
+
+            if is_remote_path(restore_path):
+                self._remote_exp_dir = restore_path.rstrip("/")
+            self._exp_dir = self._materialize_exp_dir(restore_path)
+            self.trials = self._load_experiment_state(self._exp_dir)
         elif self._search_alg is not None:
             # suggest mode: trials are created on demand in the run loop
-            name = run_config.name or f"tune_{uuid.uuid4().hex[:8]}"
-            base = run_config.resolved_storage_path()
-            from ray_tpu.train._internal.checkpoint_util import is_remote_path
-
-            if is_remote_path(base):
-                raise ValueError(
-                    "Tune experiment storage does not support remote fsspec "
-                    "URIs yet (experiment state uses local atomic renames); "
-                    "use a local or NFS storage_path. Train's checkpoint "
-                    "storage_path DOES support remote URIs.")
-            self._exp_dir = os.path.join(base, name)
-            os.makedirs(self._exp_dir, exist_ok=True)
+            self._exp_dir = self._setup_exp_dir(run_config)
             self.trials = []
         else:
-            name = run_config.name or f"tune_{uuid.uuid4().hex[:8]}"
-            base = run_config.resolved_storage_path()
-            from ray_tpu.train._internal.checkpoint_util import is_remote_path
-
-            if is_remote_path(base):
-                raise ValueError(
-                    "Tune experiment storage does not support remote fsspec "
-                    "URIs yet (experiment state uses local atomic renames); "
-                    "use a local or NFS storage_path. Train's checkpoint "
-                    "storage_path DOES support remote URIs.")
-            self._exp_dir = os.path.join(base, name)
-            os.makedirs(self._exp_dir, exist_ok=True)
+            self._exp_dir = self._setup_exp_dir(run_config)
             gen = BasicVariantGenerator(param_space, tune_config.num_samples,
                                         seed=tune_config.seed)
             self.trials = [Trial(config=cfg) for cfg in gen.variants()]
@@ -158,6 +152,78 @@ class TuneController:
             self._scheduler.on_trial_add(t)
         self._actors: Dict[str, Any] = {}  # trial_id -> actor handle
 
+    # -- experiment storage setup (local staging + remote sync) --------------
+    # Remote (fsspec URI) storage works the reference's way
+    # (tune/execution/experiment_state.py:129,253 — sync up/down): the live
+    # experiment dir stays LOCAL (atomic renames, trial logger files), and
+    # the DRIVER mirrors the state file + persisted trial checkpoints to the
+    # remote URI. Driver-side-only fsspec keeps this testable against
+    # per-process filesystems (memory://) and matches the reference syncer.
+
+    @staticmethod
+    def _staging_root() -> str:
+        return os.path.join(os.path.expanduser("~"), ".ray_tpu", "tune_staging")
+
+    def _setup_exp_dir(self, run_config: RunConfig) -> str:
+        from ray_tpu.train._internal.checkpoint_util import (
+            is_remote_path, join_path, makedirs_any)
+
+        name = run_config.name or f"tune_{uuid.uuid4().hex[:8]}"
+        base = run_config.resolved_storage_path()
+        if is_remote_path(base):
+            self._remote_exp_dir = join_path(base, name)
+            makedirs_any(self._remote_exp_dir)
+            base = self._staging_root()
+        exp_dir = os.path.join(base, name)
+        os.makedirs(exp_dir, exist_ok=True)
+        return exp_dir
+
+    @staticmethod
+    def _materialize_exp_dir(path: str) -> str:
+        """Local experiment dir for ``path`` — downloads a remote experiment
+        into the staging area (sync-down; reference: experiment_state.py:253)."""
+        from ray_tpu.train._internal.checkpoint_util import (
+            download_dir, is_remote_path)
+
+        if not is_remote_path(path):
+            return path
+        local = os.path.join(TuneController._staging_root(),
+                             path.rstrip("/").rsplit("/", 1)[-1])
+        download_dir(path, local)
+        return local
+
+    def _sync_up(self, local_path: str) -> None:
+        """Mirror a file/dir under the experiment dir to the remote URI.
+        Failures queue the path for retry at the next state save — a
+        checkpoint must never be recorded in the remote state file without
+        its directory eventually reaching the remote too."""
+        if self._remote_exp_dir is None:
+            return
+        from ray_tpu.train._internal.checkpoint_util import join_path, upload_dir
+
+        rel = os.path.relpath(local_path, self._exp_dir)
+        dest = join_path(self._remote_exp_dir, *rel.split(os.sep))
+        try:
+            if os.path.isdir(local_path):
+                upload_dir(local_path, dest)
+            else:
+                import fsspec
+
+                fs, p = fsspec.core.url_to_fs(dest)
+                fs.makedirs(p.rsplit("/", 1)[0], exist_ok=True)
+                fs.put(local_path, p)
+            self._failed_syncs.discard(local_path)
+        except Exception:  # noqa: BLE001
+            logger.exception("tune: sync-up of %s failed (queued for retry)", rel)
+            self._failed_syncs.add(local_path)
+
+    def _retry_failed_syncs(self) -> None:
+        for path in list(getattr(self, "_failed_syncs", ())):
+            if os.path.exists(path):
+                self._sync_up(path)
+            else:
+                self._failed_syncs.discard(path)
+
     # -- experiment snapshot/restore (reference: experiment_state.py) -------
 
     def _save_experiment_state(self):
@@ -170,11 +236,22 @@ class TuneController:
             return
         rows = []
         for t in self.trials:
+            ckpt = t.checkpoint_path
+            if ckpt:
+                # persist checkpoints relative to the experiment dir so a
+                # restore on another machine (remote storage sync-down into a
+                # different staging root) resolves them
+                try:
+                    rel = os.path.relpath(ckpt, self._exp_dir)
+                    if not rel.startswith(".."):
+                        ckpt = rel
+                except ValueError:
+                    pass
             rows.append({
                 "trial_id": t.trial_id, "config": t.config, "status": t.status,
                 "training_iteration": t.training_iteration, "metrics": t.metrics,
                 "metrics_history": t.metrics_history, "error": t.error,
-                "checkpoint_path": t.checkpoint_path,
+                "checkpoint_path": ckpt,
             })
         # the scheduler is live mutable state keyed by Trial OBJECTS — a
         # pickled copy would revive ghost trials on restore; persist the
@@ -186,8 +263,11 @@ class TuneController:
         tmp = os.path.join(self._exp_dir, EXPERIMENT_STATE_FILE + ".tmp")
         with open(tmp, "wb") as f:
             pickle.dump({"trials": rows, "tune_config": saved_tc}, f)
-        os.replace(tmp, os.path.join(self._exp_dir, EXPERIMENT_STATE_FILE))
+        state_file = os.path.join(self._exp_dir, EXPERIMENT_STATE_FILE)
+        os.replace(tmp, state_file)
         self._last_saved_signature = signature
+        self._retry_failed_syncs()  # e.g. a checkpoint whose upload failed
+        self._sync_up(state_file)
 
     @staticmethod
     def _load_experiment_state(path: str) -> List[Trial]:
@@ -199,7 +279,12 @@ class TuneController:
             t.training_iteration = row["training_iteration"]
             t.metrics = row["metrics"]
             t.metrics_history = row["metrics_history"]
-            t.checkpoint_path = row["checkpoint_path"]
+            ckpt = row["checkpoint_path"]
+            if ckpt and not os.path.isabs(ckpt):
+                # relative snapshot entries resolve against the (possibly
+                # just-downloaded) experiment dir
+                ckpt = os.path.join(path, ckpt)
+            t.checkpoint_path = ckpt
             if row["status"] == TERMINATED:
                 t.status = TERMINATED
                 t.error = row["error"]
@@ -218,6 +303,25 @@ class TuneController:
         with open(os.path.join(path, EXPERIMENT_STATE_FILE), "rb") as f:
             snap = pickle.load(f)
         if isinstance(snap, list):  # pre-tune_config snapshot layout
+            snap = {"trials": snap, "tune_config": None}
+        return snap
+
+    @staticmethod
+    def _peek_snapshot(path: str) -> dict:
+        """Read ONLY the experiment state file from a local or remote
+        experiment dir — no checkpoint download."""
+        import pickle
+
+        from ray_tpu.train._internal.checkpoint_util import (
+            is_remote_path, join_path)
+
+        if not is_remote_path(path):
+            return TuneController._load_snapshot(path)
+        import fsspec
+
+        with fsspec.open(join_path(path, EXPERIMENT_STATE_FILE), "rb") as f:
+            snap = pickle.load(f)
+        if isinstance(snap, list):
             snap = {"trials": snap, "tune_config": None}
         return snap
 
@@ -278,6 +382,7 @@ class TuneController:
                             f"checkpoint_{trial.training_iteration:06d}")
         persist_staged_checkpoint(ckpt.path, dest)
         trial.checkpoint_path = dest
+        self._sync_up(dest)  # mirror to remote experiment storage
         return dest
 
     # -- the event loop -----------------------------------------------------
