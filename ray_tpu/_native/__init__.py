@@ -20,13 +20,28 @@ _build_lock = threading.Lock()
 _cache: dict = {}
 
 
+def _sanitize_mode() -> bool:
+    """RAY_TPU_NATIVE_SANITIZE=1 builds/loads ASAN-instrumented variants
+    (lib<name>.asan.so). The process must run with libasan preloaded
+    (LD_PRELOAD) — tests/test_native_asan.py drives the native test suite
+    that way. reference: the reference CI's .bazelrc asan/tsan configs
+    (.bazelrc:114-134 in the upstream repo)."""
+    return os.environ.get("RAY_TPU_NATIVE_SANITIZE") == "1"
+
+
 def _build(name: str, extra_flags=()) -> str | None:
     src = os.path.join(_DIR, f"{name}.cc")
-    out = os.path.join(_DIR, f"lib{name}.so")
+    if _sanitize_mode():
+        out = os.path.join(_DIR, f"lib{name}.asan.so")
+        flags = ["-O1", "-g", "-fno-omit-frame-pointer", "-fsanitize=address",
+                 *extra_flags]
+    else:
+        out = os.path.join(_DIR, f"lib{name}.so")
+        flags = ["-O2", *extra_flags]
     if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
         return out
-    cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-shared", "-o", out, src,
-           "-lrt", *extra_flags]
+    cmd = ["g++", "-std=c++17", "-fPIC", "-shared", "-o", out, src,
+           "-lrt", *flags]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         return out
