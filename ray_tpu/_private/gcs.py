@@ -596,10 +596,33 @@ class GcsServer:
                 if info is None or info.state == "DEAD":
                     continue
                 spec = info.spec
-                node_id = self.scheduler.get_best_schedulable_node(
-                    spec.resources, spec.strategy, requires_available=True
-                )
+                strategy = spec.strategy
+                if strategy is not None and strategy.kind == "placement_group":
+                    # a PG actor runs on its bundle's node — the bundle
+                    # already RESERVED the resources there, so availability-
+                    # based selection would never pick a fully-reserved node
+                    # (reference: gcs_actor_scheduler.h — leases against the
+                    # bundle, not free capacity)
+                    try:
+                        node_id = self._pg_bundle_node(strategy)
+                    except ValueError as e:
+                        # unsatisfiable forever (e.g. bundle index out of
+                        # range): fail the actor, don't requeue
+                        info.state = "DEAD"
+                        info.death_cause = str(e)
+                        self._mark_dirty()
+                        dead_msg = {"event": "dead", "actor_id": info.actor_id,
+                                    "reason": str(e)}
+                        node_id = None
+                else:
+                    node_id = self.scheduler.get_best_schedulable_node(
+                        spec.resources, strategy, requires_available=True
+                    )
                 node = self.nodes.get(node_id) if node_id else None
+            if info.state == "DEAD":
+                # publish outside the lock (pubsub pushes over RPC)
+                self.pubsub.publish(f"ACTOR:{info.actor_id.hex()}", dead_msg)
+                continue
             if node is None:
                 # No feasible node right now; retry when resources change.
                 time.sleep(0.05)
@@ -611,6 +634,28 @@ class GcsServer:
             # GcsActorScheduler leases/creates via async RPC for the same
             # reason, gcs_actor_scheduler.h:263,323).
             self._actor_create_pool.submit(self._create_actor_guarded, info, node)
+
+    def _pg_bundle_node(self, strategy) -> Optional[NodeID]:
+        """Node hosting the strategy's bundle (None while the PG is not yet
+        CREATED — the actor requeues until it is). Caller holds self._lock.
+        Raises ValueError for a bundle index the PG doesn't have — that can
+        never become schedulable and must fail the actor, not requeue."""
+        pg = self.placement_groups.get(strategy.placement_group_id)
+        if pg is None or pg.state != "CREATED" or not pg.bundle_nodes:
+            return None
+        idx = strategy.bundle_index
+        if idx >= len(pg.bundle_nodes):
+            raise ValueError(
+                f"placement_group_bundle_index={idx} out of range for a "
+                f"{len(pg.bundle_nodes)}-bundle placement group")
+        if idx >= 0:
+            return pg.bundle_nodes[idx]
+        # bundle_index -1: any bundle; rotate so -1 actors spread over bundles
+        nodes = [n for n in pg.bundle_nodes if n is not None]
+        if not nodes:
+            return None
+        self._pg_rr = getattr(self, "_pg_rr", 0) + 1
+        return nodes[self._pg_rr % len(nodes)]
 
     def _create_actor_guarded(self, info: ActorInfo, node: NodeInfo):
         try:

@@ -57,6 +57,7 @@ class _Lease:
     for_actor: bool = False
     retriable: bool = False              # memory monitor may kill+retry
     granted_at: float = 0.0
+    cpu_released: bool = False           # worker blocked in get(): CPU lent out
 
 
 @dataclass
@@ -635,12 +636,67 @@ class Raylet:
             self._dispatch_cv.notify_all()
         return True
 
+    def HandleNotifyWorkerBlocked(self, req):
+        """An executing worker is blocked in get() on objects that queued
+        tasks may need to produce: lend its CPU back so those tasks can run
+        — without this, N tasks blocked on each other's outputs across N
+        CPUs deadlock (reference: node_manager.cc HandleNotifyWorkerBlocked /
+        the blocked-worker CPU release)."""
+        lease_id = req["lease_id"]
+        with self._lock:
+            lease = self._leases.get(lease_id)
+            if lease is None or lease.cpu_released or lease.for_actor:
+                return False
+            cpu = lease.demand.get("CPU")
+            if not cpu:
+                return False
+            lease.cpu_released = True
+            self._credit_cpu(lease, cpu)
+            self._dispatch_cv.notify_all()
+        return True
+
+    def HandleNotifyWorkerUnblocked(self, req):
+        """get() returned: take the CPU back immediately. Availability may go
+        transiently negative (the lent CPU is in use) — matching reference
+        semantics, where a resumed worker briefly oversubscribes; balance
+        restores when either lease returns."""
+        lease_id = req["lease_id"]
+        with self._lock:
+            lease = self._leases.get(lease_id)
+            if lease is None or not lease.cpu_released:
+                return False
+            lease.cpu_released = False
+            self._credit_cpu(lease, -lease.demand.get("CPU"))
+        return True
+
+    def _credit_cpu(self, lease: _Lease, cpu: float):
+        """Add (or, negative, subtract) CPU to the pool the lease draws from.
+        Caller holds self._lock."""
+        delta = ResourceSet({"CPU": cpu})
+        if lease.pg_id is not None:
+            bundles = self._bundles.get(lease.pg_id)
+            if bundles and lease.bundle_index in bundles:
+                b = bundles[lease.bundle_index]
+                # signed addition: bundle availability has no clamp to dodge
+                b.available = b.available + delta
+        elif cpu >= 0:
+            self.local_resources.release(delta)
+        else:
+            self.local_resources.available = (
+                self.local_resources.available - ResourceSet({"CPU": -cpu}))
+
     def HandleReturnWorker(self, req):
         lease_id = req["lease_id"]
         with self._lock:
             lease = self._leases.pop(lease_id, None)
             if lease is None:
                 return False
+            if lease.cpu_released:
+                # the lent CPU was never reclaimed (task finished while
+                # "blocked"); take it back first so the full release below
+                # doesn't double-credit
+                lease.cpu_released = False
+                self._credit_cpu(lease, -lease.demand.get("CPU"))
             self._release_lease_resources(lease)
             w = lease.worker
             w.lease_id = None

@@ -439,6 +439,9 @@ class CoreWorker:
         self._actor_lease: Optional[dict] = None
         self._actor_exec_pool: Optional[DaemonExecutor] = None
         self._actor_group_pools: Dict[str, "DaemonExecutor"] = {}
+        # lease held by the normal task currently executing on this worker
+        # (for the blocked-in-get CPU release; actors never lend theirs)
+        self._exec_lease_id: Optional[str] = None
         self._actor_seq_lock = threading.Lock()
         # per-caller ordered arrival queues (reference: ActorSchedulingQueue):
         # caller -> {"epoch": int, "next": int, "pending": {(epoch, seq): item}}
@@ -589,12 +592,40 @@ class CoreWorker:
     def _raylet_addr(self):
         return self.raylet.address
 
+    def _blocked_lease_id(self, refs) -> Optional[str]:
+        """Non-None when THIS call runs inside a normal task's execution
+        thread and some ref isn't already local — the raylet should lend the
+        task's CPU out while we block (deadlock avoidance: the producer of
+        the awaited object may be queued behind us)."""
+        if (self._exec_lease_id is None
+                or self._exec_thread_id != threading.get_ident()):
+            return None
+        with self._store_lock:
+            if all(r.id in self.memory_store or r.id in self.object_errors
+                   for r in refs):
+                return None
+        return self._exec_lease_id
+
     def get(self, refs, timeout: Optional[float] = None):
         single = isinstance(refs, ObjectRef)
         if single:
             refs = [refs]
-        deadline = None if timeout is None else time.monotonic() + timeout
-        out = [self._get_one(r, deadline) for r in refs]
+        blocked_lease = self._blocked_lease_id(refs)
+        if blocked_lease is not None:
+            try:
+                self.raylet.notify("NotifyWorkerBlocked", {"lease_id": blocked_lease})
+            except Exception:  # noqa: BLE001
+                blocked_lease = None
+        try:
+            deadline = None if timeout is None else time.monotonic() + timeout
+            out = [self._get_one(r, deadline) for r in refs]
+        finally:
+            if blocked_lease is not None:
+                try:
+                    self.raylet.notify("NotifyWorkerUnblocked",
+                                       {"lease_id": blocked_lease})
+                except Exception:  # noqa: BLE001
+                    pass
         for v in out:
             if isinstance(v, TaskError):
                 raise v.cause from None
@@ -1343,12 +1374,17 @@ class CoreWorker:
             self._record_exec_event(spec)
             bind_visible_accelerators(lease.get("resource_instances"))
             fn = self._load_function(spec)
-            args = [self._unpack_arg(a) for a in spec.args]
-            kwargs = {k: self._unpack_arg((kind, p)) for k, kind, p in spec.kwargs}
+            # exec state is live BEFORE arg unpacking: fetching a ref arg
+            # blocks in get(), and the blocked-CPU release (deadlock
+            # avoidance) needs the lease id; cancellation covering the fetch
+            # matches the reference (tasks are cancellable while pulling deps)
             with self._exec_state_lock:
                 self.current_task_id = spec.task_id
                 self._exec_thread_id = threading.get_ident()
+                self._exec_lease_id = lease.get("lease_id")
             try:
+                args = [self._unpack_arg(a) for a in spec.args]
+                kwargs = {k: self._unpack_arg((kind, p)) for k, kind, p in spec.kwargs}
                 result = fn(*args, **kwargs)
                 # return packing stays cancellable: a STREAMING task's user
                 # code runs inside _stream_returns' iteration, not fn()
@@ -1357,6 +1393,7 @@ class CoreWorker:
                 with self._exec_state_lock:
                     self.current_task_id = None
                     self._exec_thread_id = None
+                    self._exec_lease_id = None
                     # deterministic cancel barrier: HandleCancelTask only
                     # injects under this lock while current_task_id matches,
                     # so after this block no NEW KI can arrive; an already-
