@@ -30,6 +30,17 @@ from ray_tpu.train.config import (
 logger = logging.getLogger(__name__)
 
 
+class _ElasticRegrow(Exception):
+    """Control-flow signal: the ScalingPolicy observed capacity for a larger
+    gang mid-run; stop the current gang (checkpoint already persisted) and
+    restart bigger. Not a failure — never counted against max_failures."""
+
+    def __init__(self, current: int, target: int):
+        super().__init__(f"elastic regrow {current} -> {target}")
+        self.current = current
+        self.target = target
+
+
 @dataclasses.dataclass
 class Result:
     """reference: ray.train.Result (air/result.py)."""
@@ -93,14 +104,29 @@ class DataParallelTrainer:
         failures = 0
         latest_ckpt = self._resume_checkpoint
         history: List[Dict[str, Any]] = []
+        pending_growth: Optional[int] = None  # size a mid-run regrow observed
+        growth_muted_until = 0.0              # backoff after a failed regrow
 
         while True:
             decision = scaling_policy.make_decision_for_non_running_worker_group(
                 self._scaling.total_workers)
+            n_workers = decision.num_workers
+            # ANY attempt right after an elective regrow is regrow-flavored
+            # (even when the policy independently agrees on the bigger size):
+            # a placement failure must fall back, never kill a healthy run
+            attempt_is_regrow = pending_growth is not None
+            if pending_growth is not None:
+                # the freed gang's resources may not be visible in the
+                # cluster view yet — trust the size the running-group hook
+                # just observed (a PG-ready timeout below self-corrects an
+                # overestimate without counting as a training failure)
+                n_workers = max(n_workers,
+                                min(pending_growth, self._scaling.total_workers))
+                pending_growth = None
             scaling = self._scaling
-            if decision.num_workers != scaling.total_workers:
+            if n_workers != scaling.total_workers:
                 scaling = dataclasses.replace(
-                    scaling, num_workers=decision.num_workers, topology=None)
+                    scaling, num_workers=n_workers, topology=None)
             executor = BackendExecutor(
                 self._backend_config,
                 scaling,
@@ -113,6 +139,7 @@ class DataParallelTrainer:
                 self._push_resume_checkpoint(executor, latest_ckpt)
                 executor.start_training(self._train_fn, self._train_config)
                 final_metrics: Dict[str, Any] = {}
+                growth_check_at = time.monotonic()
                 while True:
                     results, finished, error = executor.poll()
                     # persist same-round checkpoints before acting on an error
@@ -127,13 +154,44 @@ class DataParallelTrainer:
                         raise TrainingFailedError(error)
                     if finished:
                         break
+                    # elastic growth (reference: the v2 controller polls its
+                    # ScalingPolicy each loop iteration — controller.py:439):
+                    # when new capacity fits a bigger gang AND a checkpoint
+                    # exists to resume from, checkpoint-and-regrow
+                    interval = getattr(scaling_policy, "growth_poll_interval_s", 5.0)
+                    now = time.monotonic()
+                    if (latest_ckpt is not None and now >= growth_muted_until
+                            and now - growth_check_at >= interval):
+                        growth_check_at = now
+                        grown = scaling_policy.make_decision_for_running_worker_group(
+                            scaling.total_workers, self._scaling.total_workers)
+                        if grown.num_workers > scaling.total_workers:
+                            raise _ElasticRegrow(scaling.total_workers,
+                                                 grown.num_workers)
                 executor.shutdown()
                 return Result(
                     metrics=final_metrics, checkpoint=latest_ckpt, path=run_dir,
                     metrics_history=history,
                 )
+            except _ElasticRegrow as g:
+                # not a failure: stop after the checkpoint already persisted,
+                # restart at the larger size the policy just observed
+                executor.shutdown()
+                pending_growth = g.target
+                logger.info(
+                    "elastic regrow: restarting gang %d -> %d workers from %s",
+                    g.current, g.target, latest_ckpt)
             except TrainingFailedError as e:
                 executor.shutdown()
+                if attempt_is_regrow and "did not become ready" in str(e):
+                    # the observed capacity evaporated before the bigger gang
+                    # could place — fall back to the policy's own sizing and
+                    # mute growth probes briefly so we don't thrash
+                    growth_muted_until = time.monotonic() + 60.0
+                    logger.warning(
+                        "elastic regrow to %d workers could not place; "
+                        "resuming at policy size (growth muted 60s)", n_workers)
+                    continue
                 failures += 1
                 if failure_policy.make_decision(failures, e) == FailureDecision.RAISE:
                     return Result(
