@@ -24,6 +24,13 @@ from ray_tpu.rllib.connectors import (
 from ray_tpu.rllib.core.rl_module import RLModule
 from ray_tpu.rllib.cql import CQL, CQLConfig, CQLLearner
 from ray_tpu.rllib.dqn import DQN, DQNConfig, DQNLearner
+from ray_tpu.rllib.dreamerv3 import (
+    DreamerEnvRunner,
+    DreamerV3,
+    DreamerV3Config,
+    DreamerV3Learner,
+    SequenceReplay,
+)
 from ray_tpu.rllib.env import CartPoleEnv, EnvSpec, PendulumEnv, register_env
 from ray_tpu.rllib.env_runner import EnvRunner
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, IMPALALearner, vtrace
@@ -55,6 +62,11 @@ __all__ = [
     "DQN",
     "DQNConfig",
     "DQNLearner",
+    "DreamerEnvRunner",
+    "DreamerV3",
+    "DreamerV3Config",
+    "DreamerV3Learner",
+    "SequenceReplay",
     "EnvRunner",
     "IMPALA",
     "IMPALAConfig",

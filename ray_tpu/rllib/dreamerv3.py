@@ -1,0 +1,809 @@
+"""DreamerV3: model-based RL — world model + actor-critic trained in dreams.
+
+reference: rllib/algorithms/dreamerv3/ (config surface dreamerv3.py:100-123,
+learner losses dreamerv3_learner.py, RSSM torch/models/) — design only; this
+is a jax-native rebuild where the ENTIRE update (world-model sequence scan,
+imagination rollout, actor + critic losses, three optimizers) fuses into ONE
+jitted XLA program:
+
+- RSSM: GRU deterministic state + categorical stochastic latents with
+  straight-through gradients and 1% uniform mixing ("unimix").
+- symlog predictions + twohot discrete-regression heads for reward/value
+  (the paper's robustness tricks, which also make everything fixed-shape
+  and branch-free — exactly what XLA wants).
+- Imagination is a lax.scan over the prior; lambda-returns a reverse scan.
+- Return normalization via EMA of the 5th-95th percentile range; critic
+  stabilized by an EMA "slow" critic regularizer.
+
+Discrete action spaces (the reference's primary DreamerV3 target class).
+Replay rows use the arrival convention: row t = (obs_t, prev_action_t,
+reward_t, is_first_t, cont_t) where reward_t was received upon ARRIVING at
+obs_t and cont_t=0 marks obs_t terminal; reset rows carry is_first=1.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig, jax_to_numpy
+
+
+# ---------------------------------------------------------------------------
+# numerics: symlog / twohot (paper eqs. 2-3, 9-10)
+# ---------------------------------------------------------------------------
+
+
+def symlog(x):
+    import jax.numpy as jnp
+
+    return jnp.sign(x) * jnp.log1p(jnp.abs(x))
+
+
+def symexp(x):
+    import jax.numpy as jnp
+
+    return jnp.sign(x) * (jnp.exp(jnp.abs(x)) - 1.0)
+
+
+def twohot(x, bins):
+    """Encode scalars as a two-hot distribution over a fixed bin support."""
+    import jax.numpy as jnp
+
+    x = jnp.clip(x, bins[0], bins[-1])
+    k = jnp.clip(jnp.searchsorted(bins, x, side="right") - 1, 0, len(bins) - 2)
+    lo, hi = bins[k], bins[k + 1]
+    frac = jnp.where(hi > lo, (x - lo) / (hi - lo), 0.0)
+    onehot_lo = jax_nn_one_hot(k, len(bins))
+    onehot_hi = jax_nn_one_hot(k + 1, len(bins))
+    return onehot_lo * (1.0 - frac)[..., None] + onehot_hi * frac[..., None]
+
+
+def jax_nn_one_hot(idx, n):
+    import jax
+
+    return jax.nn.one_hot(idx, n)
+
+
+# ---------------------------------------------------------------------------
+# params: plain pytrees (repo style — no flax), layernorm+silu MLPs
+# ---------------------------------------------------------------------------
+
+
+def _dense_init(key, nin, nout, zero=False):
+    import jax
+    import jax.numpy as jnp
+
+    if zero:
+        w = jnp.zeros((nin, nout), jnp.float32)
+    else:
+        w = (jax.random.truncated_normal(key, -2.0, 2.0, (nin, nout))
+             * (1.0 / np.sqrt(nin))).astype(jnp.float32)
+    return {"w": w, "b": jnp.zeros((nout,), jnp.float32)}
+
+
+def _dense(p, x):
+    return x @ p["w"] + p["b"]
+
+
+def _norm_init(n):
+    import jax.numpy as jnp
+
+    return {"g": jnp.ones((n,), jnp.float32), "o": jnp.zeros((n,), jnp.float32)}
+
+
+def _norm(p, x):
+    import jax.numpy as jnp
+
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) / jnp.sqrt(var + 1e-5) * p["g"] + p["o"]
+
+
+def _mlp_init(key, nin, hidden: List[int]):
+    import jax
+
+    keys = jax.random.split(key, len(hidden))
+    layers, d = [], nin
+    for k, h in zip(keys, hidden):
+        layers.append({"lin": _dense_init(k, d, h), "norm": _norm_init(h)})
+        d = h
+    return layers
+
+
+def _mlp(layers, x):
+    import jax
+
+    for layer in layers:
+        x = jax.nn.silu(_norm(layer["norm"], _dense(layer["lin"], x)))
+    return x
+
+
+# ---------------------------------------------------------------------------
+# config
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class DreamerV3Config(AlgorithmConfig):
+    """reference config surface: dreamerv3.py:100-123 (model_size replaced by
+    explicit dims — the XS..XL table is a sizing convenience, not structure)."""
+
+    # model dims
+    units: int = 256
+    deter: int = 256
+    stoch: int = 32
+    classes: int = 32
+    num_bins: int = 255
+    bin_range: float = 20.0
+    unimix: float = 0.01
+    free_bits: float = 1.0
+    # training (paper defaults; reference dreamerv3.py:107-123)
+    batch_size_B: int = 16
+    batch_length_T: int = 64
+    horizon_H: int = 15
+    gamma: float = 0.997
+    gae_lambda: float = 0.95
+    entropy_scale: float = 3e-4
+    return_normalization_decay: float = 0.99
+    world_model_lr: float = 1e-4
+    actor_lr: float = 3e-5
+    critic_lr: float = 3e-5
+    world_model_grad_clip: float = 1000.0
+    actor_grad_clip: float = 100.0
+    critic_grad_clip: float = 100.0
+    slow_critic_decay: float = 0.98
+    training_ratio: float = 512.0  # replayed steps per sampled step
+    buffer_size: int = 100_000
+    learning_starts: int = 1024  # env steps before updates begin
+
+    @property
+    def algo_class(self):
+        return DreamerV3
+
+
+# ---------------------------------------------------------------------------
+# world model + policy (functional core shared by learner and runners)
+# ---------------------------------------------------------------------------
+
+
+class DreamerModel:
+    """Pure functions over a params pytree; sizes are static attributes so
+    every method traces into fixed-shape XLA programs."""
+
+    def __init__(self, obs_dim: int, num_actions: int, cfg: DreamerV3Config):
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.cfg = cfg
+        self.zdim = cfg.stoch * cfg.classes
+        import jax.numpy as jnp
+
+        self.bins = jnp.linspace(-cfg.bin_range, cfg.bin_range, cfg.num_bins)
+
+    def init(self, key):
+        import jax
+
+        c = self.cfg
+        ks = iter(jax.random.split(key, 24))
+        feat = c.deter + self.zdim
+        return {
+            "enc": _mlp_init(next(ks), self.obs_dim, [c.units, c.units]),
+            # GRU input: [z, onehot(a)] -> units, then gated update of h
+            "gru_in": _mlp_init(next(ks), self.zdim + self.num_actions, [c.units]),
+            "gru": {"lin": _dense_init(next(ks), c.units + c.deter, 3 * c.deter),
+                    "norm": _norm_init(3 * c.deter)},
+            "prior": _mlp_init(next(ks), c.deter, [c.units]),
+            "prior_out": _dense_init(next(ks), c.units, self.zdim),
+            "post": _mlp_init(next(ks), c.deter + c.units, [c.units]),
+            "post_out": _dense_init(next(ks), c.units, self.zdim),
+            "dec": _mlp_init(next(ks), feat, [c.units, c.units]),
+            "dec_out": _dense_init(next(ks), c.units, self.obs_dim),
+            "rew": _mlp_init(next(ks), feat, [c.units]),
+            "rew_out": _dense_init(next(ks), c.units, c.num_bins, zero=True),
+            "cont": _mlp_init(next(ks), feat, [c.units]),
+            "cont_out": _dense_init(next(ks), c.units, 1),
+            "actor": _mlp_init(next(ks), feat, [c.units, c.units]),
+            "actor_out": _dense_init(next(ks), c.units, self.num_actions,
+                                     zero=True),
+            "critic": _mlp_init(next(ks), feat, [c.units, c.units]),
+            "critic_out": _dense_init(next(ks), c.units, c.num_bins, zero=True),
+        }
+
+    # -- RSSM pieces ----------------------------------------------------
+
+    def _logits(self, raw):
+        """unimix: mix 1% uniform into the categorical (paper sec. 4)."""
+        import jax
+        import jax.numpy as jnp
+
+        c = self.cfg
+        raw = raw.reshape(raw.shape[:-1] + (c.stoch, c.classes))
+        probs = jax.nn.softmax(raw, -1)
+        probs = (1.0 - c.unimix) * probs + c.unimix / c.classes
+        return jnp.log(probs)
+
+    def _sample_st(self, logits, key):
+        """Straight-through categorical sample -> flat [.., stoch*classes]."""
+        import jax
+        import jax.numpy as jnp
+
+        idx = jax.random.categorical(key, logits, -1)
+        onehot = jax.nn.one_hot(idx, self.cfg.classes)
+        probs = jnp.exp(logits)
+        sample = onehot + probs - jax.lax.stop_gradient(probs)
+        return sample.reshape(sample.shape[:-2] + (self.zdim,))
+
+    def gru_step(self, p, h, z, action_onehot):
+        """h' = GRU(h, [z, a]) — layernorm gates, -1 update-gate bias so the
+        state initially persists (danijar-style recurrence, built fresh)."""
+        import jax
+        import jax.numpy as jnp
+
+        x = _mlp(p["gru_in"], jnp.concatenate([z, action_onehot], -1))
+        parts = _norm(p["gru"]["norm"],
+                      _dense(p["gru"]["lin"], jnp.concatenate([x, h], -1)))
+        reset, cand, update = jnp.split(parts, 3, -1)
+        reset = jax.nn.sigmoid(reset)
+        update = jax.nn.sigmoid(update - 1.0)
+        cand = jnp.tanh(reset * cand)
+        return update * cand + (1.0 - update) * h
+
+    def prior_logits(self, p, h):
+        return self._logits(_dense(p["prior_out"], _mlp(p["prior"], h)))
+
+    def post_logits(self, p, h, embed):
+        import jax.numpy as jnp
+
+        x = _mlp(p["post"], jnp.concatenate([h, embed], -1))
+        return self._logits(_dense(p["post_out"], x))
+
+    def encode(self, p, obs):
+        return _mlp(p["enc"], symlog(obs))
+
+    def feat(self, h, z):
+        import jax.numpy as jnp
+
+        return jnp.concatenate([h, z], -1)
+
+    def head_scalar(self, p, prefix, feat):
+        """Twohot head -> (logits, expected scalar via symexp)."""
+        import jax
+
+        logits = _dense(p[prefix + "_out"], _mlp(p[prefix], feat))
+        value = symexp(jax.nn.softmax(logits, -1) @ self.bins)
+        return logits, value
+
+    def actor_logits(self, p, feat):
+        import jax
+        import jax.numpy as jnp
+
+        raw = _dense(p["actor_out"], _mlp(p["actor"], feat))
+        probs = jax.nn.softmax(raw, -1)
+        c = self.cfg
+        probs = (1.0 - c.unimix) * probs + c.unimix / self.num_actions
+        return jnp.log(probs)
+
+    # -- observe (posterior) step, shared by learner scan and runners ----
+
+    def observe_step(self, p, h, z, prev_action, is_first, obs, key):
+        import jax
+        import jax.numpy as jnp
+
+        mask = (1.0 - is_first.astype(jnp.float32))[..., None]
+        h = h * mask
+        z = z * mask
+        a = jax.nn.one_hot(prev_action, self.num_actions) * mask
+        h = self.gru_step(p, h, z, a)
+        embed = self.encode(p, obs)
+        post = self.post_logits(p, h, embed)
+        z = self._sample_st(post, key)
+        return h, z, post
+
+
+# ---------------------------------------------------------------------------
+# learner: one jitted update
+# ---------------------------------------------------------------------------
+
+
+class DreamerV3Learner:
+    """reference: dreamerv3_learner.py — world-model, actor, and critic each
+    own an optimizer; losses per the paper (eqs. 4-12)."""
+
+    def __init__(self, model: DreamerModel, cfg: DreamerV3Config, seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.model = model
+        self.cfg = cfg
+        self.params = model.init(jax.random.PRNGKey(seed + 1))
+        self.slow_critic = jax.tree.map(
+            jnp.copy, {"critic": self.params["critic"],
+                       "critic_out": self.params["critic_out"]})
+        self._split = {
+            "world": ("enc", "gru_in", "gru", "prior", "prior_out", "post",
+                      "post_out", "dec", "dec_out", "rew", "rew_out", "cont",
+                      "cont_out"),
+            "actor": ("actor", "actor_out"),
+            "critic": ("critic", "critic_out"),
+        }
+        self.opts = {
+            "world": optax.chain(optax.clip_by_global_norm(cfg.world_model_grad_clip),
+                                 optax.adam(cfg.world_model_lr, eps=1e-8)),
+            "actor": optax.chain(optax.clip_by_global_norm(cfg.actor_grad_clip),
+                                 optax.adam(cfg.actor_lr, eps=1e-5)),
+            "critic": optax.chain(optax.clip_by_global_norm(cfg.critic_grad_clip),
+                                  optax.adam(cfg.critic_lr, eps=1e-5)),
+        }
+        self.opt_state = {
+            name: opt.init({k: self.params[k] for k in self._split[name]})
+            for name, opt in self.opts.items()
+        }
+        # EMA of the imagined-return 5%-95% range (paper eq. 11).
+        # Explicit dtype: a weak-typed 0.0 would retrace the whole update
+        # once when the first returned (strong-typed) value replaces it.
+        self.ret_range = jnp.zeros((), jnp.float32)
+        self._key = jax.random.PRNGKey(seed)
+        self._update = jax.jit(self._update_impl)
+        self._n_updates = 0
+
+    # -- losses ----------------------------------------------------------
+
+    def _kl(self, lhs_logits, rhs_logits):
+        """KL( lhs || rhs ) summed over categorical groups -> [B, T]."""
+        import jax.numpy as jnp
+
+        p = jnp.exp(lhs_logits)
+        return (p * (lhs_logits - rhs_logits)).sum(-1).sum(-1)
+
+    def _world_loss(self, wparams, aparams_all, batch, key):
+        """Runs the posterior scan and returns (loss, (states, aux))."""
+        import jax
+        import jax.numpy as jnp
+
+        m, c = self.model, self.cfg
+        p = {**wparams, **aparams_all}  # heads only read world params
+        obs = batch["obs"]          # [B, T, D]
+        B, T = obs.shape[:2]
+        obs_t = jnp.swapaxes(obs, 0, 1)                     # [T, B, D]
+        act_t = jnp.swapaxes(batch["prev_action"], 0, 1)    # [T, B]
+        first_t = jnp.swapaxes(batch["is_first"], 0, 1)
+
+        h0 = jnp.zeros((B, c.deter))
+        z0 = jnp.zeros((B, m.zdim))
+        keys = jax.random.split(key, T)
+
+        def step(carry, inp):
+            h, z = carry
+            o, a, f, k = inp
+            h, z, post = m.observe_step(p, h, z, a, f, o, k)
+            prior = m.prior_logits(p, h)
+            return (h, z), (h, z, post, prior)
+
+        _, (hs, zs, posts, priors) = jax.lax.scan(
+            step, (h0, z0), (obs_t, act_t, first_t, keys))
+        # back to [B, T, ...]
+        hs, zs = jnp.swapaxes(hs, 0, 1), jnp.swapaxes(zs, 0, 1)
+        posts, priors = jnp.swapaxes(posts, 0, 1), jnp.swapaxes(priors, 0, 1)
+
+        feat = m.feat(hs, zs)
+        # decoder: symlog MSE (paper: symlog predictions for vector obs)
+        dec = _dense(p["dec_out"], _mlp(p["dec"], feat))
+        recon_loss = 0.5 * ((dec - symlog(obs)) ** 2).sum(-1)
+        # reward: twohot CE against symlog(reward)
+        rew_logits, _ = m.head_scalar(p, "rew", feat)
+        rew_target = twohot(symlog(batch["reward"]), m.bins)
+        rew_loss = -(rew_target * jax.nn.log_softmax(rew_logits, -1)).sum(-1)
+        # continue: bernoulli
+        cont_logit = _dense(p["cont_out"], _mlp(p["cont"], feat))[..., 0]
+        cont = batch["cont"]
+        cont_loss = (jax.nn.softplus(cont_logit) - cont * cont_logit)
+        # KL with free bits (clip at 1 nat, paper eq. 5)
+        sg = jax.lax.stop_gradient
+        dyn_loss = jnp.maximum(c.free_bits, self._kl(sg(posts), priors))
+        rep_loss = jnp.maximum(c.free_bits, self._kl(posts, sg(priors)))
+        loss = (recon_loss + rew_loss + cont_loss
+                + 0.5 * dyn_loss + 0.1 * rep_loss).mean()
+        aux = {"world_loss": loss, "recon_loss": recon_loss.mean(),
+               "reward_loss": rew_loss.mean(), "cont_loss": cont_loss.mean(),
+               "kl_dyn": dyn_loss.mean(), "kl_rep": rep_loss.mean()}
+        return loss, ((hs, zs), aux)
+
+    def _imagine(self, params, h0, z0, key):
+        """Roll the prior H steps under the actor; returns time-major
+        trajectories of features/actions/logits incl. the start state."""
+        import jax
+        import jax.numpy as jnp
+
+        m, c = self.model, self.cfg
+        keys = jax.random.split(key, c.horizon_H)
+
+        def step(carry, k):
+            h, z = carry
+            feat = m.feat(h, z)
+            logits = m.actor_logits(params, feat)
+            ka, kz = jax.random.split(k)
+            a = jax.random.categorical(ka, logits, -1)
+            h2 = m.gru_step(params, h, z, jax.nn.one_hot(a, m.num_actions))
+            z2 = m._sample_st(m.prior_logits(params, h2), kz)
+            return (h2, z2), (a, logits, h2, z2)
+
+        (_, _), (acts, logits, hs, zs) = jax.lax.scan(step, (h0, z0), keys)
+        feats = m.feat(jnp.concatenate([h0[None], hs], 0),
+                       jnp.concatenate([z0[None], zs], 0))  # [H+1, N, F]
+        return feats, acts, logits
+
+    def _ac_loss(self, ac_params, world_params, slow_critic, feats, acts,
+                 act_logits, ret_range):
+        """Actor + critic losses over one imagined trajectory batch."""
+        import jax
+        import jax.numpy as jnp
+
+        m, c = self.model, self.cfg
+        sg = jax.lax.stop_gradient
+        p = {**world_params, **ac_params}
+        # rewards/continues predicted at arrived states 1..H
+        _, rew = m.head_scalar(p, "rew", feats[1:])
+        cont_logit = _dense(p["cont_out"], _mlp(p["cont"], feats))[..., 0]
+        cont = jax.nn.sigmoid(cont_logit)           # [H+1, N]
+        disc = c.gamma * cont
+        # trajectory weights: product of discounts of VISITED states
+        w = jnp.cumprod(
+            jnp.concatenate([jnp.ones_like(disc[:1]), disc[1:]], 0), 0)  # [H+1, N]
+        critic_logits, values = m.head_scalar(p, "critic", feats)  # [H+1, N]
+        _, slow_values = m.head_scalar(
+            {**world_params, **slow_critic}, "critic", feats)
+
+        # lambda returns (reverse scan), targets for states 0..H-1
+        def back(carry, inp):
+            r, d, v = inp
+            carry = r + d * ((1.0 - c.gae_lambda) * v + c.gae_lambda * carry)
+            return carry, carry
+
+        _, rets = jax.lax.scan(
+            back, values[-1],
+            (rew[::-1], disc[1:][::-1], values[1:][::-1]))
+        rets = rets[::-1]                                        # [H, N]
+
+        # return normalization (paper eq. 11-12)
+        lo = jnp.percentile(rets, 5.0)
+        hi = jnp.percentile(rets, 95.0)
+        new_range = (c.return_normalization_decay * ret_range
+                     + (1 - c.return_normalization_decay) * (hi - lo))
+        scale = jnp.maximum(1.0, new_range)
+
+        adv = sg((rets - values[:-1]) / scale)
+        logp = jnp.take_along_axis(act_logits, acts[..., None], -1)[..., 0]
+        entropy = -(jnp.exp(act_logits) * act_logits).sum(-1)
+        actor_loss = -(logp * adv + c.entropy_scale * entropy)
+        actor_loss = (actor_loss * sg(w[:-1])).mean()
+
+        target = twohot(symlog(sg(rets)), m.bins)
+        ce = -(target * jax.nn.log_softmax(critic_logits[:-1], -1)).sum(-1)
+        # slow-critic regularizer: stay close to the EMA critic's prediction
+        slow_target = twohot(symlog(sg(slow_values[:-1])), m.bins)
+        ce_slow = -(slow_target * jax.nn.log_softmax(critic_logits[:-1], -1)).sum(-1)
+        critic_loss = ((ce + ce_slow) * sg(w[:-1])).mean()
+
+        loss = actor_loss + critic_loss
+        aux = {"actor_loss": actor_loss, "critic_loss": critic_loss,
+               "return_mean": rets.mean(), "value_mean": values.mean(),
+               "entropy": entropy.mean(), "return_range": new_range}
+        return loss, aux
+
+    # -- the single fused update ----------------------------------------
+
+    def _update_impl(self, params, opt_state, slow_critic, ret_range, key, batch):
+        import jax
+        import optax
+
+        c = self.cfg
+        kw, ki, ka = jax.random.split(key, 3)
+        world_keys = self._split["world"]
+        wparams = {k: params[k] for k in world_keys}
+        rest = {k: v for k, v in params.items() if k not in world_keys}
+
+        (wl, ((hs, zs), waux)), wgrads = jax.value_and_grad(
+            self._world_loss, has_aux=True)(wparams, rest, batch, kw)
+        wupd, opt_w = self.opts["world"].update(
+            wgrads, opt_state["world"], wparams)
+        wparams = optax.apply_updates(wparams, wupd)
+        params = {**params, **wparams}
+
+        # imagine from every posterior state, gradients cut at the start
+        sg = jax.lax.stop_gradient
+        h0 = sg(hs.reshape(-1, c.deter))
+        z0 = sg(zs.reshape(-1, self.model.zdim))
+
+        ac_keys = self._split["actor"] + self._split["critic"]
+        ac_params = {k: params[k] for k in ac_keys}
+        world_ro = sg({k: v for k, v in params.items() if k not in ac_keys})
+
+        def ac_loss_fn(ac_params):
+            feats, acts, logits = self._imagine(
+                {**world_ro, **ac_params}, h0, z0, ki)
+            return self._ac_loss(ac_params, world_ro, slow_critic,
+                                 feats, acts, logits, ret_range)
+
+        (_, aaux), agrads = jax.value_and_grad(ac_loss_fn, has_aux=True)(ac_params)
+        for name in ("actor", "critic"):
+            keys = self._split[name]
+            g = {k: agrads[k] for k in keys}
+            pp = {k: params[k] for k in keys}
+            upd, new_os = self.opts[name].update(g, opt_state[name], pp)
+            pp = optax.apply_updates(pp, upd)
+            params = {**params, **pp}
+            opt_state = {**opt_state, name: new_os}
+        opt_state = {**opt_state, "world": opt_w}
+
+        # slow critic EMA
+        d = c.slow_critic_decay
+        slow_critic = jax.tree.map(
+            lambda s, q: d * s + (1 - d) * q, slow_critic,
+            {"critic": params["critic"], "critic_out": params["critic_out"]})
+        return (params, opt_state, slow_critic, aaux.pop("return_range"),
+                {**waux, **aaux})
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        import jax
+        import jax.numpy as jnp
+
+        jb = {k: jnp.asarray(v) for k, v in batch.items()}
+        self._key, sub = jax.random.split(self._key)
+        (self.params, self.opt_state, self.slow_critic, self.ret_range,
+         aux) = self._update(self.params, self.opt_state, self.slow_critic,
+                             self.ret_range, sub, jb)
+        self._n_updates += 1
+        return {k: float(v) for k, v in aux.items()}
+
+    def get_params(self):
+        return self.params
+
+
+# ---------------------------------------------------------------------------
+# sequence replay
+# ---------------------------------------------------------------------------
+
+
+class SequenceReplay:
+    """Per-env contiguous streams; samples fixed-length subsequences.
+
+    reference: dreamerv3's EpisodeReplayBuffer (replay_buffer_config,
+    dreamerv3.py:103-106) — here each source env id owns one ring of rows
+    (appended across fragments, which ARE time-contiguous because runners
+    persist env + latent state between sample() calls)."""
+
+    def __init__(self, capacity: int, seed: int = 0):
+        self.capacity = capacity
+        self._streams: Dict[Any, Dict[str, List[np.ndarray]]] = {}
+        self._rng = np.random.RandomState(seed)
+        self._size = 0
+
+    def add_fragment(self, source: Any, rows: Dict[str, np.ndarray]):
+        """rows: dict of [T, n_envs, ...]; one stream per (source, env idx)."""
+        T, n_envs = rows["reward"].shape[:2]
+        for i in range(n_envs):
+            stream = self._streams.setdefault(
+                (source, i), {k: [] for k in rows})
+            for k, v in rows.items():
+                stream[k].extend(np.asarray(x) for x in v[:, i])
+        self._size += T * n_envs
+        # evict oldest rows per stream, round-robin, to stay under capacity
+        per = max(self.capacity // max(len(self._streams), 1), 1)
+        for stream in self._streams.values():
+            n = len(stream["reward"])
+            if n > per:
+                for k in stream:
+                    del stream[k][: n - per]
+                self._size -= n - per
+
+    def __len__(self):
+        return self._size
+
+    def sample(self, batch_size: int, length: int) -> Optional[Dict[str, np.ndarray]]:
+        eligible = [s for s in self._streams.values()
+                    if len(s["reward"]) >= length]
+        if not eligible:
+            return None
+        out: Dict[str, List[np.ndarray]] = {k: [] for k in eligible[0]}
+        for _ in range(batch_size):
+            s = eligible[self._rng.randint(len(eligible))]
+            start = self._rng.randint(len(s["reward"]) - length + 1)
+            for k in out:
+                out[k].append(np.stack(s[k][start:start + length]))
+        return {k: np.stack(v) for k, v in out.items()}  # [B, L, ...]
+
+
+# ---------------------------------------------------------------------------
+# env runner (recurrent: carries latent state across steps)
+# ---------------------------------------------------------------------------
+
+
+class DreamerEnvRunner:
+    """Samples fragments with the latent-state policy; rows in the arrival
+    convention (module docstring). jax-on-CPU inference, jitted once — the
+    RSSM recurrence is not worth mirroring in numpy by hand."""
+
+    def __init__(self, env_creator, model_spec: dict, num_envs: int = 1,
+                 seed: int = 0, rollout_fragment_length: int = 64):
+        import jax
+
+        # rollouts burn cheap CPU cores; never claim the (possibly shared)
+        # TPU from a sampling actor — learners own the accelerator
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except RuntimeError:
+            pass  # backend already initialized in this process
+
+        from ray_tpu.rllib.env import make_env
+
+        cfg = DreamerV3Config(**model_spec["cfg"])
+        self._envs = [make_env(env_creator) for _ in range(num_envs)]
+        self._model = DreamerModel(model_spec["obs_dim"],
+                                   model_spec["num_actions"], cfg)
+        self._T = rollout_fragment_length
+        self._key = jax.random.PRNGKey(seed)
+        n = num_envs
+        self._h = np.zeros((n, cfg.deter), np.float32)
+        self._z = np.zeros((n, self._model.zdim), np.float32)
+        self._prev_action = np.zeros((n,), np.int64)
+        self._pending = {
+            "obs": np.stack([env.reset(seed=seed * 1000 + i)
+                             for i, env in enumerate(self._envs)]),
+            "reward": np.zeros((n,), np.float32),
+            "is_first": np.ones((n,), np.bool_),
+            "cont": np.ones((n,), np.float32),
+        }
+        self._needs_reset = np.zeros((n,), np.bool_)
+        self._ep_return = [0.0] * n
+        self._completed: List[float] = []
+
+        def policy_step(params, h, z, prev_action, is_first, obs, key):
+            h, z, post = self._model.observe_step(
+                params, h, z, prev_action, is_first, obs, key)
+            logits = self._model.actor_logits(
+                params, self._model.feat(h, z))
+            a = jax.random.categorical(
+                jax.random.fold_in(key, 1), logits, -1)
+            return h, z, a
+
+        self._policy_step = jax.jit(policy_step)
+
+    def sample(self, params) -> Dict[str, Any]:
+        import jax
+
+        n = len(self._envs)
+        T = self._T
+        rows = {
+            "obs": np.zeros((T, n) + self._pending["obs"].shape[1:], np.float32),
+            "prev_action": np.zeros((T, n), np.int64),
+            "reward": np.zeros((T, n), np.float32),
+            "is_first": np.zeros((T, n), np.bool_),
+            "cont": np.zeros((T, n), np.float32),
+        }
+        for t in range(T):
+            rows["obs"][t] = self._pending["obs"]
+            rows["prev_action"][t] = self._prev_action
+            rows["reward"][t] = self._pending["reward"]
+            rows["is_first"][t] = self._pending["is_first"]
+            rows["cont"][t] = self._pending["cont"]
+
+            self._key, sub = jax.random.split(self._key)
+            h, z, actions = self._policy_step(
+                params, self._h, self._z, self._prev_action,
+                self._pending["is_first"], self._pending["obs"], sub)
+            self._h, self._z = np.asarray(h), np.asarray(z)
+            actions = np.asarray(actions)
+
+            next_pending = {"obs": self._pending["obs"].copy(),
+                            "reward": np.zeros((n,), np.float32),
+                            "is_first": np.zeros((n,), np.bool_),
+                            "cont": np.ones((n,), np.float32)}
+            for i, env in enumerate(self._envs):
+                if self._needs_reset[i]:
+                    # terminal row was just recorded; start a fresh episode
+                    next_pending["obs"][i] = env.reset()
+                    next_pending["is_first"][i] = True
+                    self._prev_action[i] = 0
+                    self._needs_reset[i] = False
+                    continue
+                obs2, rew, done, _ = env.step(int(actions[i]))
+                self._ep_return[i] += rew
+                next_pending["obs"][i] = obs2
+                next_pending["reward"][i] = rew
+                next_pending["cont"][i] = 0.0 if done else 1.0
+                self._prev_action[i] = actions[i]
+                if done:
+                    self._completed.append(self._ep_return[i])
+                    self._ep_return[i] = 0.0
+                    self._needs_reset[i] = True
+            self._pending = next_pending
+        return {"rows": rows, "episode_stats": self.episode_stats()}
+
+    def episode_stats(self, window: int = 100) -> Dict[str, float]:
+        recent = self._completed[-window:]
+        return {
+            "episodes_total": float(len(self._completed)),
+            "episode_reward_mean": float(np.mean(recent)) if recent else 0.0,
+        }
+
+
+# ---------------------------------------------------------------------------
+# algorithm
+# ---------------------------------------------------------------------------
+
+
+class DreamerV3(Algorithm):
+    """reference: rllib/algorithms/dreamerv3/dreamerv3.py — train() samples
+    the runner group into replay, then runs enough learner updates to hold
+    `training_ratio` replayed-to-sampled steps."""
+
+    def __init__(self, config: DreamerV3Config):
+        import ray_tpu
+        from ray_tpu.rllib.env import make_env
+
+        self.config = config
+        if config.env is None:
+            raise ValueError("config.environment(env) is required")
+        probe = make_env(config.env)
+        if probe.spec.continuous:
+            raise ValueError("DreamerV3 here supports discrete action spaces")
+        self._spec = probe.spec
+        self._model = DreamerModel(probe.spec.obs_dim,
+                                   probe.spec.num_actions, config)
+        self._learner = DreamerV3Learner(self._model, config, seed=config.seed)
+        model_spec = {
+            "obs_dim": probe.spec.obs_dim,
+            "num_actions": probe.spec.num_actions,
+            "cfg": dataclasses.asdict(config),
+        }
+        self._runners = [
+            ray_tpu.remote(DreamerEnvRunner).options(num_cpus=0.5).remote(
+                config.env, model_spec,
+                num_envs=config.num_envs_per_runner,
+                seed=config.seed + i,
+                rollout_fragment_length=config.rollout_fragment_length)
+            for i in range(config.num_env_runners)
+        ]
+        self._replay = SequenceReplay(config.buffer_size, seed=config.seed)
+        self._env_steps = 0
+        self._replayed_steps = 0.0
+        self._iteration = 0
+
+    def _build_learner(self):  # Algorithm ABC hook; built in __init__
+        return self._learner
+
+    def train(self) -> Dict[str, Any]:
+        import ray_tpu
+
+        cfg = self.config
+        params_ref = ray_tpu.put(jax_to_numpy(self._learner.get_params()))
+        results = ray_tpu.get(
+            [r.sample.remote(params_ref) for r in self._runners])
+        for i, res in enumerate(results):
+            self._replay.add_fragment(i, res["rows"])
+            self._env_steps += (cfg.rollout_fragment_length
+                                * cfg.num_envs_per_runner)
+        stats: Dict[str, float] = {}
+        if self._env_steps >= cfg.learning_starts:
+            target = cfg.training_ratio * self._env_steps
+            per_update = cfg.batch_size_B * cfg.batch_length_T
+            while self._replayed_steps < target:
+                batch = self._replay.sample(cfg.batch_size_B, cfg.batch_length_T)
+                if batch is None:
+                    break
+                stats = self._learner.update(batch)
+                self._replayed_steps += per_update
+        ep = [res["episode_stats"] for res in results]
+        rewards = [s["episode_reward_mean"] for s in ep if s["episodes_total"]]
+        self._iteration += 1
+        return {
+            "training_iteration": self._iteration,
+            "episode_reward_mean": float(np.mean(rewards)) if rewards else 0.0,
+            "episodes_total": float(sum(s["episodes_total"] for s in ep)),
+            "num_env_steps_sampled": self._env_steps,
+            "num_updates": self._learner._n_updates,
+            **stats,
+        }
