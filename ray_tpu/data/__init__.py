@@ -16,17 +16,27 @@ from ray_tpu.data.read_api import (
     from_pandas,
     from_torch,
     range,  # noqa: A004
+    read_audio,
+    read_avro,
+    read_bigquery,
     read_binary_files,
+    read_clickhouse,
     read_csv,
     read_datasource,
+    read_delta,
+    read_hudi,
+    read_iceberg,
     read_images,
     read_json,
+    read_lance,
+    read_mongo,
     read_numpy,
     read_orc,
     read_parquet,
     read_sql,
     read_text,
     read_tfrecords,
+    read_videos,
     read_webdataset,
 )
 
@@ -52,6 +62,16 @@ __all__ = [
     "read_sql",
     "read_tfrecords",
     "read_webdataset",
+    "read_avro",
+    "read_audio",
+    "read_videos",
+    "read_bigquery",
+    "read_clickhouse",
+    "read_mongo",
+    "read_delta",
+    "read_iceberg",
+    "read_hudi",
+    "read_lance",
     "from_torch",
     "from_huggingface",
 ]

@@ -1,0 +1,280 @@
+"""Minimal Avro Object Container File codec (read + write), stdlib-only.
+
+reference: python/ray/data/_internal/datasource/avro_datasource.py reads OCF
+files via the `fastavro` wheel; that library is not in this image, so the
+container format (spec: avro 1.11 "Object Container Files") is implemented
+directly — header with JSON schema + codec, zigzag-varint binary encoding,
+null/deflate codecs, full type coverage (records, arrays, maps, unions,
+enums, fixed, named-type references). This also powers the Iceberg
+connector, whose manifest files are Avro (iceberg_datasource.py).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import struct
+import zlib
+from typing import Any, Dict, List, Tuple, Union
+
+MAGIC = b"Obj\x01"
+
+Schema = Union[str, dict, list]
+
+
+# ---------------------------------------------------------------------------
+# binary primitives
+# ---------------------------------------------------------------------------
+
+
+def _read_long(buf: io.BytesIO) -> int:
+    """zigzag varint."""
+    shift, acc = 0, 0
+    while True:
+        b = buf.read(1)
+        if not b:
+            raise EOFError("truncated varint")
+        byte = b[0]
+        acc |= (byte & 0x7F) << shift
+        if not byte & 0x80:
+            break
+        shift += 7
+    return (acc >> 1) ^ -(acc & 1)
+
+
+def _write_long(out: io.BytesIO, n: int) -> None:
+    """zigzag varint (python's arithmetic shift makes n>>63 the sign mask)."""
+    u = ((n << 1) ^ (n >> 63)) & 0xFFFFFFFFFFFFFFFF
+    while True:
+        b = u & 0x7F
+        u >>= 7
+        if u:
+            out.write(bytes([b | 0x80]))
+        else:
+            out.write(bytes([b]))
+            break
+
+
+def _read_bytes(buf: io.BytesIO) -> bytes:
+    n = _read_long(buf)
+    data = buf.read(n)
+    if len(data) < n:
+        raise EOFError("truncated bytes")
+    return data
+
+
+def _write_bytes(out: io.BytesIO, data: bytes) -> None:
+    _write_long(out, len(data))
+    out.write(data)
+
+
+# ---------------------------------------------------------------------------
+# schema-driven decode / encode
+# ---------------------------------------------------------------------------
+
+
+class _Names:
+    """Registry so named types can be referenced by name downstream."""
+
+    def __init__(self):
+        self.types: Dict[str, dict] = {}
+
+    def register(self, schema: dict):
+        name = schema.get("name")
+        if name:
+            ns = schema.get("namespace")
+            full = f"{ns}.{name}" if ns and "." not in name else name
+            self.types[full] = schema
+            self.types[name] = schema
+
+    def resolve(self, schema: Schema) -> Schema:
+        if isinstance(schema, str) and schema in self.types:
+            return self.types[schema]
+        return schema
+
+
+_PRIMITIVES = {"null", "boolean", "int", "long", "float", "double", "bytes",
+               "string"}
+
+
+def decode(schema: Schema, buf: io.BytesIO, names: _Names) -> Any:
+    schema = names.resolve(schema)
+    if isinstance(schema, str):
+        t = schema
+    elif isinstance(schema, list):  # union: long index, then value
+        idx = _read_long(buf)
+        return decode(schema[idx], buf, names)
+    else:
+        t = schema["type"]
+        if t in ("record", "enum", "fixed"):
+            names.register(schema)
+    if t == "null":
+        return None
+    if t == "boolean":
+        return buf.read(1) != b"\x00"
+    if t in ("int", "long"):
+        return _read_long(buf)
+    if t == "float":
+        return struct.unpack("<f", buf.read(4))[0]
+    if t == "double":
+        return struct.unpack("<d", buf.read(8))[0]
+    if t == "bytes":
+        return _read_bytes(buf)
+    if t == "string":
+        return _read_bytes(buf).decode("utf-8")
+    if t == "record":
+        return {f["name"]: decode(f["type"], buf, names)
+                for f in schema["fields"]}
+    if t == "enum":
+        return schema["symbols"][_read_long(buf)]
+    if t == "fixed":
+        return buf.read(schema["size"])
+    if t == "array":
+        out: List[Any] = []
+        while True:
+            count = _read_long(buf)
+            if count == 0:
+                break
+            if count < 0:  # block size prefix follows; skip it
+                count = -count
+                _read_long(buf)
+            out.extend(decode(schema["items"], buf, names)
+                       for _ in range(count))
+        return out
+    if t == "map":
+        m: Dict[str, Any] = {}
+        while True:
+            count = _read_long(buf)
+            if count == 0:
+                break
+            if count < 0:
+                count = -count
+                _read_long(buf)
+            for _ in range(count):
+                k = _read_bytes(buf).decode("utf-8")
+                m[k] = decode(schema["values"], buf, names)
+        return m
+    if isinstance(schema, dict) and t in _PRIMITIVES:
+        # logical types annotate a primitive ({"type": "long", ...})
+        return decode(t, buf, names)
+    raise ValueError(f"unsupported avro schema: {schema!r}")
+
+
+def encode(schema: Schema, value: Any, out: io.BytesIO, names: _Names) -> None:
+    schema = names.resolve(schema)
+    if isinstance(schema, str):
+        t = schema
+    elif isinstance(schema, list):
+        # pick the first branch the value fits (null -> "null" branch)
+        for i, branch in enumerate(schema):
+            b = names.resolve(branch)
+            bt = b if isinstance(b, str) else b["type"]
+            if (value is None) == (bt == "null"):
+                _write_long(out, i)
+                return encode(branch, value, out, names)
+        raise ValueError(f"no union branch for {value!r} in {schema!r}")
+    else:
+        t = schema["type"]
+        if t in ("record", "enum", "fixed"):
+            names.register(schema)
+    if t == "null":
+        return
+    if t == "boolean":
+        out.write(b"\x01" if value else b"\x00")
+    elif t in ("int", "long"):
+        _write_long(out, int(value))
+    elif t == "float":
+        out.write(struct.pack("<f", float(value)))
+    elif t == "double":
+        out.write(struct.pack("<d", float(value)))
+    elif t == "bytes":
+        _write_bytes(out, bytes(value))
+    elif t == "string":
+        _write_bytes(out, str(value).encode("utf-8"))
+    elif t == "record":
+        for f in schema["fields"]:
+            encode(f["type"], value.get(f["name"]), out, names)
+    elif t == "enum":
+        _write_long(out, schema["symbols"].index(value))
+    elif t == "fixed":
+        out.write(bytes(value))
+    elif t == "array":
+        if value:
+            _write_long(out, len(value))
+            for item in value:
+                encode(schema["items"], item, out, names)
+        _write_long(out, 0)
+    elif t == "map":
+        if value:
+            _write_long(out, len(value))
+            for k, v in value.items():
+                _write_bytes(out, str(k).encode("utf-8"))
+                encode(schema["values"], v, out, names)
+        _write_long(out, 0)
+    else:
+        raise ValueError(f"unsupported avro schema: {schema!r}")
+
+
+# ---------------------------------------------------------------------------
+# container files
+# ---------------------------------------------------------------------------
+
+
+def read_container(fileobj) -> Tuple[dict, List[Any]]:
+    """Returns (metadata, records). metadata['avro.schema'] is the parsed
+    schema; other metadata values stay raw bytes."""
+    data = fileobj.read()
+    buf = io.BytesIO(data)
+    if buf.read(4) != MAGIC:
+        raise ValueError("not an avro object container file")
+    names = _Names()
+    meta_raw = decode({"type": "map", "values": "bytes"}, buf, names)
+    sync = buf.read(16)
+    schema = json.loads(meta_raw["avro.schema"].decode("utf-8"))
+    codec = meta_raw.get("avro.codec", b"null").decode()
+    records: List[Any] = []
+    while buf.tell() < len(data):
+        try:
+            count = _read_long(buf)
+        except EOFError:
+            break
+        size = _read_long(buf)
+        block = buf.read(size)
+        if codec == "deflate":
+            block = zlib.decompress(block, -15)
+        elif codec != "null":
+            raise ValueError(f"unsupported avro codec {codec!r}")
+        bbuf = io.BytesIO(block)
+        for _ in range(count):
+            records.append(decode(schema, bbuf, names))
+        marker = buf.read(16)
+        if marker != sync:
+            raise ValueError("sync marker mismatch (corrupt block)")
+    return {"avro.schema": schema, "avro.codec": codec}, records
+
+
+def write_container(fileobj, schema: Schema, records: List[Any],
+                    codec: str = "null") -> None:
+    names = _Names()
+    out = io.BytesIO()
+    out.write(MAGIC)
+    meta = {"avro.schema": json.dumps(schema).encode(),
+            "avro.codec": codec.encode()}
+    encode({"type": "map", "values": "bytes"}, meta, out, names)
+    sync = os.urandom(16)
+    out.write(sync)
+    block = io.BytesIO()
+    for rec in records:
+        encode(schema, rec, block, names)
+    payload = block.getvalue()
+    if codec == "deflate":
+        comp = zlib.compressobj(9, zlib.DEFLATED, -15)
+        payload = comp.compress(payload) + comp.flush()
+    elif codec != "null":
+        raise ValueError(f"unsupported avro codec {codec!r}")
+    _write_long(out, len(records))
+    _write_long(out, len(payload))
+    out.write(payload)
+    out.write(sync)
+    fileobj.write(out.getvalue())

@@ -1214,19 +1214,131 @@ class Dataset:
     def write_json(self, path: str) -> List[str]:
         return self._write(path, "json")
 
+    def write_avro(self, path: str) -> List[str]:
+        """reference: data avro support — own OCF codec (connectors.py)."""
+        return self._write(path, "avro")
+
+    def write_tfrecords(self, path: str) -> List[str]:
+        """reference: tfrecords_datasink.py — rows need a `bytes` column."""
+        return self._write(path, "tfrecords")
+
+    def write_webdataset(self, path: str) -> List[str]:
+        """reference: webdataset_datasink.py — tar shards keyed by
+        `__key__` (or the row index)."""
+        return self._write(path, "webdataset")
+
     def _write(self, path: str, fmt: str) -> List[str]:
         import os
 
         import ray_tpu
+        from ray_tpu.data import connectors as cx
         from ray_tpu.data import datasource as ds
 
-        os.makedirs(path, exist_ok=True)
+        if "://" not in path:
+            os.makedirs(path, exist_ok=True)
         writer = {"parquet": ds.write_block_parquet, "csv": ds.write_block_csv,
-                  "json": ds.write_block_json}[fmt]
+                  "json": ds.write_block_json, "avro": cx.write_block_avro,
+                  "tfrecords": cx.write_block_tfrecords,
+                  "webdataset": cx.write_block_webdataset}[fmt]
         out = []
         for i, ref in enumerate(self._plan.execute_iter(self._ctx)):
             out.append(writer(ray_tpu.get(ref), path, i))
         return out
+
+    def write_sql(self, table: str, connection_factory) -> str:
+        """reference: sql_datasink.py — INSERTs through a DB-API factory."""
+        import ray_tpu
+        from ray_tpu.data import connectors as cx
+
+        for ref in self._plan.execute_iter(self._ctx):
+            cx.write_block_sql(ray_tpu.get(ref), table, connection_factory)
+        return table
+
+    def write_mongo(self, client_factory, database: str, collection: str) -> str:
+        """reference: mongo_datasink.py."""
+        import ray_tpu
+        from ray_tpu.data import connectors as cx
+
+        for ref in self._plan.execute_iter(self._ctx):
+            cx.write_block_mongo(ray_tpu.get(ref), client_factory,
+                                 database, collection)
+        return f"{database}.{collection}"
+
+    def write_bigquery(self, project: str, dataset: str, *, transport=None) -> str:
+        """reference: bigquery_datasink.py — insertAll via the injectable
+        transport (connectors.py)."""
+        import ray_tpu
+        from ray_tpu.data import connectors as cx
+
+        for ref in self._plan.execute_iter(self._ctx):
+            cx.write_block_bigquery(ray_tpu.get(ref), project, dataset,
+                                    transport=transport)
+        return f"{project}.{dataset}"
+
+    def write_clickhouse(self, dsn: str, table: str, *, transport=None) -> str:
+        """reference: clickhouse_datasink.py — HTTP INSERT FORMAT JSONEachRow."""
+        import ray_tpu
+        from ray_tpu.data import connectors as cx
+
+        for ref in self._plan.execute_iter(self._ctx):
+            cx.write_block_clickhouse(ray_tpu.get(ref), dsn, table,
+                                      transport=transport)
+        return table
+
+    def write_delta(self, table_path: str, *, mode: str = "append") -> int:
+        """Delta Lake commit: parquet parts + one _delta_log JSON version
+        (mode: append | overwrite). Returns the committed version."""
+        import os
+
+        import ray_tpu
+        from ray_tpu.data import connectors as cx
+
+        new_files, schema, stamp = [], None, os.urandom(4).hex()
+        for i, ref in enumerate(self._plan.execute_iter(self._ctx)):
+            block = ray_tpu.get(ref)
+            schema = block.schema if schema is None else schema
+            # commit-unique names: indexed part-N names would collide with
+            # (and on remote stores, overwrite) earlier commits' files
+            name = f"part-{stamp}-{i:05d}.parquet"
+            _, size = cx.write_parquet_named(block, table_path, name)
+            new_files.append({"path": name, "size": size})
+        if schema is None:
+            import pyarrow as pa
+
+            schema = pa.schema([])
+        return cx.write_delta_commit(table_path, new_files, schema, mode=mode)
+
+    def write_iceberg(self, table_path: str) -> int:
+        """Iceberg append snapshot (format-version 1, own avro manifests).
+        Returns the new snapshot id."""
+        import os
+
+        import ray_tpu
+        from ray_tpu.data import connectors as cx
+
+        data_dir = cx._join(table_path, "data")
+        new_files, schema, stamp = [], None, os.urandom(4).hex()
+        for i, ref in enumerate(self._plan.execute_iter(self._ctx)):
+            block = ray_tpu.get(ref)
+            schema = block.schema if schema is None else schema
+            name = f"part-{stamp}-{i:05d}.parquet"
+            _, size = cx.write_parquet_named(block, data_dir, name)
+            new_files.append({"path": f"data/{name}", "size": size,
+                              "record_count": len(block)})
+        if schema is None:
+            import pyarrow as pa
+
+            schema = pa.schema([])
+        return cx.write_iceberg_snapshot(table_path, new_files, schema)
+
+    def write_lance(self, uri: str) -> str:
+        """reference: lance_datasink.py — gated on the lance wheel."""
+        import ray_tpu
+        from ray_tpu.data import connectors as cx
+
+        for ref in self._plan.execute_iter(self._ctx):
+            cx.write_block_lance(ray_tpu.get(ref), uri)
+        return uri
 
     def __repr__(self):
         names = [op.name for op in self._plan.ops]

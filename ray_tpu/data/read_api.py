@@ -153,3 +153,98 @@ def from_huggingface(hf_dataset, *, parallelism: int = -1) -> Dataset:
     """reference: read_api.py from_huggingface — any iterable of row dicts
     with column_names (datasets.Dataset satisfies this)."""
     return from_items(list(hf_dataset), parallelism=parallelism)
+
+
+# -- connector long tail (reference: _internal/datasource/) -----------------
+
+
+def read_avro(paths, *, parallelism: int = -1) -> Dataset:
+    """reference: avro_datasource.py — own OCF codec, no fastavro needed."""
+    from ray_tpu.data.connectors import read_avro_file
+
+    return read_datasource(FileDatasource(paths, read_avro_file),
+                           parallelism=parallelism)
+
+
+def read_audio(paths, *, parallelism: int = -1) -> Dataset:
+    """reference: audio_datasource.py — WAV via stdlib (soundfile if
+    present); rows of float32 PCM bytes + rate/channels."""
+    from ray_tpu.data.connectors import read_audio_file
+
+    return read_datasource(FileDatasource(paths, read_audio_file),
+                           parallelism=parallelism)
+
+
+def read_videos(paths, *, frame_stride: int = 1, parallelism: int = -1) -> Dataset:
+    """reference: video_datasource.py — cv2-decoded frames, one row each."""
+    import functools
+
+    from ray_tpu.data.connectors import read_video_file
+
+    return read_datasource(
+        FileDatasource(paths, functools.partial(read_video_file,
+                                                frame_stride=frame_stride)),
+        parallelism=parallelism)
+
+
+def read_bigquery(project: str, *, query: str = None, dataset: str = None,
+                  transport=None, parallelism: int = -1) -> Dataset:
+    """reference: bigquery_datasource.py — REST via injectable transport."""
+    from ray_tpu.data.connectors import BigQueryDatasource
+
+    return read_datasource(
+        BigQueryDatasource(project, query=query, dataset=dataset,
+                           transport=transport), parallelism=parallelism)
+
+
+def read_clickhouse(dsn: str, *, table: str = None, query: str = None,
+                    transport=None, parallelism: int = -1) -> Dataset:
+    """reference: clickhouse_datasource.py — HTTP interface, FORMAT Parquet."""
+    from ray_tpu.data.connectors import ClickHouseDatasource
+
+    return read_datasource(
+        ClickHouseDatasource(dsn, table=table, query=query,
+                             transport=transport), parallelism=parallelism)
+
+
+def read_mongo(client_factory, database: str, collection: str, *,
+               match: Optional[dict] = None, parallelism: int = -1) -> Dataset:
+    """reference: mongo_datasource.py — pymongo-compatible client factory;
+    read tasks split the collection by sorted-_id skip/limit ranges."""
+    from ray_tpu.data.connectors import MongoDatasource
+
+    return read_datasource(
+        MongoDatasource(client_factory, database, collection, match=match),
+        parallelism=parallelism)
+
+
+def read_delta(table_path: str, *, parallelism: int = -1) -> Dataset:
+    """Delta Lake table (native _delta_log replay incl. checkpoints)."""
+    from ray_tpu.data.connectors import DeltaDatasource
+
+    return read_datasource(DeltaDatasource(table_path), parallelism=parallelism)
+
+
+def read_iceberg(table_path: str, *, snapshot_id: Optional[int] = None,
+                 parallelism: int = -1) -> Dataset:
+    """reference: iceberg_datasource.py — native v1 metadata/manifests."""
+    from ray_tpu.data.connectors import IcebergDatasource
+
+    return read_datasource(IcebergDatasource(table_path, snapshot_id=snapshot_id),
+                           parallelism=parallelism)
+
+
+def read_hudi(table_path: str, *, parallelism: int = -1) -> Dataset:
+    """reference: hudi_datasource.py — copy-on-write timeline replay."""
+    from ray_tpu.data.connectors import HudiDatasource
+
+    return read_datasource(HudiDatasource(table_path), parallelism=parallelism)
+
+
+def read_lance(uri: str, *, columns: Optional[List[str]] = None,
+               parallelism: int = -1) -> Dataset:
+    """reference: lance_datasource.py — gated on the lance wheel."""
+    from ray_tpu.data.connectors import LanceDatasource
+
+    return read_datasource(LanceDatasource(uri, columns=columns),
+                           parallelism=parallelism)

@@ -1,0 +1,401 @@
+"""Connector long tail (VERDICT r2 missing #6).
+
+reference: python/ray/data/_internal/datasource/ — avro, BigQuery,
+ClickHouse, MongoDB, Delta Lake, Iceberg, Hudi, Lance, audio, video, plus
+the sql/tfrecords/webdataset sinks. REST stores run against mock transports
+(the gce_tpu_provider test pattern); table formats round-trip on disk.
+"""
+
+import io
+import json
+import os
+import sqlite3
+import wave
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from ray_tpu import data as rdata
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+# -- avro -------------------------------------------------------------------
+
+
+def test_avro_roundtrip(cluster, tmp_path):
+    ds = rdata.from_items([{"id": i, "name": f"n{i}", "score": i * 0.5}
+                           for i in range(20)])
+    ds.write_avro(str(tmp_path / "av"))
+    back = rdata.read_avro(str(tmp_path / "av"))
+    rows = sorted(back.take_all(), key=lambda r: r["id"])
+    assert len(rows) == 20
+    assert rows[3] == {"id": 3, "name": "n3", "score": 1.5}
+
+
+def test_avro_nested_and_deflate(tmp_path):
+    from ray_tpu.data._internal import avro
+
+    schema = {"type": "record", "name": "r", "fields": [
+        {"name": "xs", "type": {"type": "array", "items": "long"}},
+        {"name": "m", "type": {"type": "map", "values": "string"}},
+        {"name": "inner", "type": ["null", {"type": "record", "name": "i",
+                                            "fields": [{"name": "v", "type": "double"}]}]},
+    ]}
+    recs = [{"xs": [1, 2], "m": {"a": "b"}, "inner": {"v": 2.5}},
+            {"xs": [], "m": {}, "inner": None}]
+    p = tmp_path / "x.avro"
+    with open(p, "wb") as f:
+        avro.write_container(f, schema, recs, codec="deflate")
+    # decode directly (arrow struct columns merge keys across rows, so the
+    # table view of a sparse map isn't list-of-dicts-identical)
+    with open(p, "rb") as f:
+        _, decoded = avro.read_container(f)
+    assert decoded == recs
+    from ray_tpu.data.connectors import read_avro_file
+
+    t = read_avro_file(str(p))
+    assert t.column("xs").to_pylist() == [[1, 2], []]
+
+
+# -- BigQuery (mock transport) ---------------------------------------------
+
+
+def _make_bq_transport():
+    """Mimics jobs.query + getQueryResults paging + insertAll. Defined as a
+    closure factory: transports travel to read workers by value."""
+    inserted = []
+
+    def transport(method, url, body=None):
+        if url.endswith("/queries") and method == "POST":
+            assert body["useLegacySql"] is False
+            return {
+                "schema": {"fields": [
+                    {"name": "id", "type": "INTEGER"},
+                    {"name": "name", "type": "STRING"},
+                    {"name": "tags", "type": "STRING", "mode": "REPEATED"},
+                ]},
+                "rows": [{"f": [{"v": "1"}, {"v": "a"},
+                                {"v": [{"v": "x"}, {"v": "y"}]}]}],
+                "jobReference": {"jobId": "j1"},
+                "pageToken": "p2",
+            }
+        if "pageToken=p2" in url:
+            return {"rows": [{"f": [{"v": "2"}, {"v": "b"}, {"v": []}]}]}
+        if url.endswith("/insertAll"):
+            inserted.extend(body["rows"])
+            return {}
+        raise AssertionError(f"unexpected {method} {url}")
+
+    return transport, inserted
+
+
+def test_bigquery_read_paged(cluster):
+    transport, _ = _make_bq_transport()
+    ds = rdata.read_bigquery("proj", dataset="d.t", transport=transport)
+    rows = sorted(ds.take_all(), key=lambda r: r["id"])
+    assert rows == [{"id": 1, "name": "a", "tags": ["x", "y"]},
+                    {"id": 2, "name": "b", "tags": []}]
+
+
+def test_bigquery_write(cluster):
+    transport, inserted = _make_bq_transport()
+    ds = rdata.from_items([{"id": i} for i in range(700)])
+    ds.write_bigquery("proj", "d.t", transport=transport)
+    assert len(inserted) == 700
+    assert inserted[0] == {"json": {"id": 0}}
+
+
+# -- ClickHouse (mock transport) -------------------------------------------
+
+
+def test_clickhouse_roundtrip(cluster):
+    stored = {}
+
+    def transport(url, data, headers=None):
+        q = data.decode()
+        if q.startswith("INSERT INTO t FORMAT JSONEachRow"):
+            rows = [json.loads(ln) for ln in q.splitlines()[1:] if ln]
+            stored.setdefault("rows", []).extend(rows)
+            return b""
+        assert q.endswith(" FORMAT Parquet")
+        table = pa.Table.from_pylist(stored.get("rows", []))
+        buf = io.BytesIO()
+        pq.write_table(table, buf)
+        return buf.getvalue()
+
+    ds = rdata.from_items([{"id": i, "v": i * 2} for i in range(10)])
+    ds.write_clickhouse("http://ch:8123", "t", transport=transport)
+    back = rdata.read_clickhouse("http://ch:8123", table="t",
+                                 transport=transport)
+    rows = sorted(back.take_all(), key=lambda r: r["id"])
+    assert len(rows) == 10 and rows[4] == {"id": 4, "v": 8}
+
+
+# -- MongoDB (fake pymongo-compatible client) -------------------------------
+
+
+def _make_mongo_factory(store):
+    """pymongo-compatible fake, defined in a closure so the factory travels
+    to read workers by value (a carried COPY of `store` — fine for reads)."""
+
+    def factory():
+        class Cursor:
+            def __init__(self, docs):
+                self.docs = docs
+
+            def sort(self, key, direction):
+                return self
+
+            def skip(self, n):
+                self.docs = self.docs[n:]
+                return self
+
+            def limit(self, n):
+                self.docs = self.docs[:n]
+                return self
+
+            def __iter__(self):
+                return iter(self.docs)
+
+        class Coll:
+            def count_documents(self, match):
+                return len(store)
+
+            def find(self, match):
+                return Cursor(sorted(store, key=lambda d: d["_id"]))
+
+            def insert_many(self, rows):
+                store.extend(rows)
+
+        class Client:
+            def __getitem__(self, db):
+                return {"c": Coll()}
+
+            def close(self):
+                pass
+
+        return Client()
+
+    return factory
+
+
+def test_mongo_read_parallel(cluster):
+    factory = _make_mongo_factory([{"_id": i, "v": i * i} for i in range(17)])
+    ds = rdata.read_mongo(factory, "db", "c", parallelism=4)
+    rows = sorted(ds.take_all(), key=lambda r: int(r["_id"]))
+    assert len(rows) == 17
+    assert rows[3]["v"] == 9
+    assert rows[3]["_id"] == "3"  # _id stringified (ObjectId-safe)
+
+
+def test_mongo_write(cluster):
+    store = []
+    factory = _make_mongo_factory(store)
+    ds = rdata.from_items([{"v": 100 + i} for i in range(5)])
+    ds.write_mongo(factory, "db", "c")
+    assert len(store) == 5
+
+
+# -- SQL sink ---------------------------------------------------------------
+
+
+def test_sql_sink_roundtrip(cluster, tmp_path):
+    db = str(tmp_path / "x.db")
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE t (id INTEGER, name TEXT)")
+    conn.commit()
+    conn.close()
+
+    def factory():
+        return sqlite3.connect(db)
+
+    ds = rdata.from_items([{"id": i, "name": f"n{i}"} for i in range(12)])
+    ds.write_sql("t", factory)
+    back = rdata.read_sql("SELECT * FROM t ORDER BY id", factory)
+    rows = back.take_all()
+    assert len(rows) == 12 and rows[5] == {"id": 5, "name": "n5"}
+
+
+# -- Delta Lake -------------------------------------------------------------
+
+
+def test_delta_append_and_overwrite(cluster, tmp_path):
+    table = str(tmp_path / "dl")
+    v0 = rdata.from_items([{"id": i} for i in range(5)]).write_delta(table)
+    v1 = rdata.from_items([{"id": i} for i in range(5, 8)]).write_delta(table)
+    assert (v0, v1) == (0, 1)
+    rows = sorted(r["id"] for r in rdata.read_delta(table).take_all())
+    assert rows == list(range(8))
+    v2 = rdata.from_items([{"id": 99}]).write_delta(table, mode="overwrite")
+    assert v2 == 2
+    assert [r["id"] for r in rdata.read_delta(table).take_all()] == [99]
+
+
+def test_delta_partition_values_and_checkpoint(cluster, tmp_path):
+    """Hand-built table: checkpoint parquet + later JSON commit + partition
+    columns materialized from partitionValues."""
+    table = tmp_path / "dl2"
+    log = table / "_delta_log"
+    log.mkdir(parents=True)
+    # data file (partition col `p` NOT in the file, per delta spec)
+    pq.write_table(pa.table({"id": [1, 2]}), table / "f1.parquet")
+    pq.write_table(pa.table({"id": [3]}), table / "f2.parquet")
+    # checkpoint at version 0 holds f1 + a removed ghost
+    ckpt = pa.Table.from_pylist([
+        {"add": {"path": "f1.parquet", "partitionValues": {"p": "x"},
+                 "size": 1}, "remove": None},
+        {"add": {"path": "ghost.parquet", "partitionValues": {},
+                 "size": 1}, "remove": None},
+        {"add": None, "remove": {"path": "ghost.parquet"}},
+    ])
+    pq.write_table(ckpt, log / f"{0:020d}.checkpoint.parquet")
+    (log / "_last_checkpoint").write_text(json.dumps({"version": 0}))
+    with open(log / f"{1:020d}.json", "w") as f:
+        f.write(json.dumps({"add": {"path": "f2.parquet",
+                                    "partitionValues": {"p": "y"}}}) + "\n")
+    rows = sorted(rdata.read_delta(str(table)).take_all(),
+                  key=lambda r: r["id"])
+    assert [r["p"] for r in rows] == ["x", "x", "y"]
+
+
+# -- Iceberg ----------------------------------------------------------------
+
+
+def test_iceberg_snapshots(cluster, tmp_path):
+    table = str(tmp_path / "ice")
+    s1 = rdata.from_items([{"id": i} for i in range(4)]).write_iceberg(table)
+    rows = sorted(r["id"] for r in rdata.read_iceberg(table).take_all())
+    assert rows == [0, 1, 2, 3]
+    s2 = rdata.from_items([{"id": 10}]).write_iceberg(table)
+    assert s2 != s1
+    # append carries previous manifests forward; time travel to s1 sees
+    # only the first batch
+    rows_now = sorted(r["id"] for r in rdata.read_iceberg(table).take_all())
+    assert rows_now == [0, 1, 2, 3, 10]
+    rows_s1 = sorted(r["id"] for r in
+                     rdata.read_iceberg(table, snapshot_id=s1).take_all())
+    assert rows_s1 == [0, 1, 2, 3]
+
+
+# -- Hudi -------------------------------------------------------------------
+
+
+def test_hudi_cow_latest_slice(cluster, tmp_path):
+    table = tmp_path / "hudi"
+    hoodie = table / ".hoodie"
+    hoodie.mkdir(parents=True)
+    (table / "p1").mkdir()
+    pq.write_table(pa.table({"id": [1, 2]}), table / "p1" / "fg1_0_t1.parquet")
+    pq.write_table(pa.table({"id": [1, 2, 3]}), table / "p1" / "fg1_0_t2.parquet")
+    pq.write_table(pa.table({"id": [9]}), table / "p1" / "fg2_0_t1.parquet")
+    (hoodie / "t1.commit").write_text(json.dumps({"partitionToWriteStats": {
+        "p1": [{"fileId": "fg1", "path": "p1/fg1_0_t1.parquet"},
+               {"fileId": "fg2", "path": "p1/fg2_0_t1.parquet"}]}}))
+    # t2 rewrites file group fg1 (copy-on-write update)
+    (hoodie / "t2.commit").write_text(json.dumps({"partitionToWriteStats": {
+        "p1": [{"fileId": "fg1", "path": "p1/fg1_0_t2.parquet"}]}}))
+    rows = sorted(r["id"] for r in rdata.read_hudi(str(table)).take_all())
+    assert rows == [1, 2, 3, 9]  # latest fg1 slice + fg2
+    # clustering: a replacecommit retires fg1+fg2 into a new file group
+    pq.write_table(pa.table({"id": [1, 2, 3, 9]}),
+                   table / "p1" / "fg3_0_t3.parquet")
+    (hoodie / "t3.replacecommit").write_text(json.dumps({
+        "partitionToReplaceFileIds": {"p1": ["fg1", "fg2"]},
+        "partitionToWriteStats": {
+            "p1": [{"fileId": "fg3", "path": "p1/fg3_0_t3.parquet"}]}}))
+    rows = sorted(r["id"] for r in rdata.read_hudi(str(table)).take_all())
+    assert rows == [1, 2, 3, 9]  # same data, no duplicates
+
+
+# -- Lance (gated) ----------------------------------------------------------
+
+
+def test_lance_gated():
+    with pytest.raises(ImportError, match="lance"):
+        rdata.read_lance("/tmp/nope.lance")
+
+
+# -- audio / video ----------------------------------------------------------
+
+
+def test_read_audio_wav(cluster, tmp_path):
+    rate = 8000
+    t = np.linspace(0, 1, rate, endpoint=False)
+    sig = (np.sin(2 * np.pi * 440 * t) * 32000).astype(np.int16)
+    p = tmp_path / "tone.wav"
+    with wave.open(str(p), "wb") as w:
+        w.setnchannels(1)
+        w.setsampwidth(2)
+        w.setframerate(rate)
+        w.writeframes(sig.tobytes())
+    rows = rdata.read_audio(str(p)).take_all()
+    assert len(rows) == 1
+    r = rows[0]
+    assert r["sample_rate"] == rate and r["channels"] == 1
+    pcm = np.frombuffer(r["audio"], np.float32)
+    assert pcm.shape[0] == rate
+    np.testing.assert_allclose(pcm[:10], sig[:10] / 32768.0, atol=1e-4)
+
+
+def test_read_videos(cluster, tmp_path):
+    import cv2
+
+    p = str(tmp_path / "v.avi")
+    w = cv2.VideoWriter(p, cv2.VideoWriter_fourcc(*"MJPG"), 5, (32, 24))
+    if not w.isOpened():
+        pytest.skip("cv2 has no MJPG encoder in this build")
+    for i in range(6):
+        frame = np.full((24, 32, 3), i * 40, np.uint8)
+        w.write(frame)
+    w.release()
+    rows = rdata.read_videos(p, frame_stride=2).take_all()
+    assert len(rows) == 3
+    assert rows[0]["height"] == 24 and rows[0]["width"] == 32
+    assert [r["frame_index"] for r in rows] == [0, 2, 4]
+    f0 = np.frombuffer(rows[1]["frame"], np.uint8).reshape(24, 32, 3)
+    assert 60 <= int(f0.mean()) <= 100  # mjpeg-lossy gray level ~80
+
+
+# -- tfrecords / webdataset sinks ------------------------------------------
+
+
+def test_tfrecords_sink_roundtrip(cluster, tmp_path):
+    payloads = [b"alpha", b"beta", b"gamma"]
+    ds = rdata.from_items([{"bytes": p} for p in payloads])
+    ds.write_tfrecords(str(tmp_path / "tfr"))
+    back = rdata.read_tfrecords(str(tmp_path / "tfr"))
+    assert sorted(r["bytes"] for r in back.take_all()) == sorted(payloads)
+
+
+def test_tfrecords_crc_is_masked_crc32c(tmp_path):
+    from ray_tpu.data.connectors import _masked_crc
+
+    # known vector: crc32c("123456789") == 0xE3069283
+    from ray_tpu.data.connectors import _crc32c
+
+    assert _crc32c(b"123456789") == 0xE3069283
+    crc = 0xE3069283
+    assert _masked_crc(b"123456789") == (((crc >> 15) | (crc << 17))
+                                         + 0xA282EAD8) & 0xFFFFFFFF
+
+
+def test_webdataset_sink_roundtrip(cluster, tmp_path):
+    ds = rdata.from_items([
+        {"__key__": "s1", "txt": "hello", "cls": "0"},
+        {"__key__": "s2", "txt": "world", "cls": "1"},
+    ])
+    ds.write_webdataset(str(tmp_path / "wds"))
+    back = rdata.read_webdataset(str(tmp_path / "wds"))
+    rows = sorted(back.take_all(), key=lambda r: r["__key__"])
+    assert [r["__key__"] for r in rows] == ["s1", "s2"]
+    assert rows[0]["txt"] == b"hello" and rows[1]["cls"] == b"1"
