@@ -167,6 +167,11 @@ class GcsServer:
         self.scheduler = ClusterResourceScheduler()
         self.task_events: deque = deque(maxlen=self.config.task_events_max_buffer)
         self.metrics_by_reporter: Dict[str, dict] = {}
+        # cluster event log (reference: dashboard/modules/event/ +
+        # src/ray/gcs/gcs_server event aggregation): bounded ring of
+        # structured events surfaced by the dashboard and the state API
+        self.events: deque = deque(maxlen=1000)
+        self._event_seq = 0
         self._lock = threading.RLock()
         self._actor_queue: deque = deque()
         self._actor_cv = threading.Condition(self._lock)
@@ -305,6 +310,8 @@ class GcsServer:
             self.scheduler.add_or_update_node(node_id, info.resources)
             self._actor_cv.notify_all()
         self.pubsub.publish("NODE", {"event": "alive", "node_id": node_id, "address": info.address})
+        self._record_event("INFO", "gcs", f"node {node_id} joined",
+                           node_id=node_id, address=info.address)
         return {"config_blob": self.config.to_blob(), "cluster_view": self._cluster_view()}
 
     def HandleReportResources(self, req):
@@ -366,6 +373,8 @@ class GcsServer:
             dead_actors = [a for a in self.actors.values() if a.node_id == node_id and a.state in ("ALIVE", "PENDING")]
         logger.warning("GCS: node %s dead (%s); %d actors affected", node_id, reason, len(dead_actors))
         self.pubsub.publish("NODE", {"event": "dead", "node_id": node_id})
+        self._record_event("WARNING", "gcs", f"node {node_id} dead: {reason}",
+                           node_id=node_id, affected_actors=len(dead_actors))
         for a in dead_actors:
             self._on_actor_worker_death(a.actor_id, f"node {node_id} died")
 
@@ -389,6 +398,8 @@ class GcsServer:
             job_id = JobID(f"{self._job_counter:08x}")
             self.jobs[job_id] = {"driver_addr": req.get("driver_addr"), "state": "RUNNING", "start": time.time()}
         self._mark_dirty()
+        self._record_event("INFO", "gcs", f"job {job_id} started",
+                           job_id=job_id)
         return job_id
 
     def HandleJobFinished(self, req):
@@ -581,6 +592,15 @@ class GcsServer:
                 state_msg = {"event": "dead", "actor_id": actor_id, "reason": reason}
         self._mark_dirty()
         self.pubsub.publish(f"ACTOR:{actor_id.hex()}", state_msg)
+        if state_msg["event"] == "restarting":
+            self._record_event(
+                "WARNING", "gcs",
+                f"actor {actor_id} restarting ({reason}), "
+                f"attempt {state_msg['num_restarts']}", actor_id=actor_id)
+        else:
+            self._record_event("ERROR", "gcs",
+                               f"actor {actor_id} died: {reason}",
+                               actor_id=actor_id)
 
     # -- actor scheduling loop (reference: gcs_actor_scheduler.h:115) -----
 
@@ -820,6 +840,41 @@ class GcsServer:
     # ------------------------------------------------------------------
     # Task events (reference: gcs_task_manager.h — observability sink)
     # ------------------------------------------------------------------
+
+    # ------------------------------------------------------------------
+    # Cluster events (reference: dashboard/modules/event/ aggregator)
+    # ------------------------------------------------------------------
+
+    def _record_event(self, severity: str, source: str, message: str,
+                      **metadata):
+        with self._lock:
+            self._event_seq += 1
+            self.events.append({
+                "event_id": self._event_seq,
+                "ts": time.time(),
+                "severity": severity,
+                "source": source,
+                "message": message,
+                "metadata": {k: str(v) for k, v in metadata.items()},
+            })
+
+    def HandleRecordEvent(self, req):
+        self._record_event(req.get("severity", "INFO"),
+                           req.get("source", "user"), req["message"],
+                           **(req.get("metadata") or {}))
+        return True
+
+    def HandleListEvents(self, req):
+        severity = req.get("severity")
+        source = req.get("source")
+        after_id = req.get("after_id", 0)
+        limit = req.get("limit", 1000)
+        with self._lock:
+            rows = [e for e in self.events
+                    if e["event_id"] > after_id
+                    and (severity is None or e["severity"] == severity)
+                    and (source is None or e["source"] == source)]
+        return rows[-limit:]
 
     def HandleAddTaskEvents(self, req):
         with self._lock:

@@ -1,0 +1,140 @@
+"""Prometheus + Grafana wiring (reference: dashboard/modules/metrics/).
+
+The reference writes prometheus scrape configs and Grafana provisioning +
+dashboard JSONs into the session directory (modules/metrics/install_and_start
+templates); operators point their Prometheus/Grafana at those files. Same
+contract here: ``generate_configs(out_dir, metrics_url)`` materializes
+
+    out_dir/prometheus.yml
+    out_dir/grafana/provisioning/datasources/ray_tpu.yml
+    out_dir/grafana/provisioning/dashboards/ray_tpu.yml
+    out_dir/grafana/dashboards/{cluster,serve,events}.json
+
+against the core metric names exported by the dashboard head's /metrics
+(see head.py core_metrics_text): ray_tpu_nodes, ray_tpu_actors,
+ray_tpu_resource_total/available, ray_tpu_tasks, ray_tpu_serve_replicas,
+ray_tpu_serve_requests_total, ray_tpu_events_total, plus any user metrics
+from ray_tpu.util.metrics.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List
+
+
+def _panel(panel_id: int, title: str, exprs: List[str], x: int, y: int,
+           kind: str = "timeseries", unit: str = "short") -> dict:
+    return {
+        "id": panel_id,
+        "title": title,
+        "type": kind,
+        "datasource": {"type": "prometheus", "uid": "ray_tpu_prom"},
+        "gridPos": {"h": 8, "w": 12, "x": x, "y": y},
+        "fieldConfig": {"defaults": {"unit": unit}, "overrides": []},
+        "targets": [{"expr": e, "refId": chr(ord("A") + i),
+                     "legendFormat": "__auto"} for i, e in enumerate(exprs)],
+    }
+
+
+def _dashboard(uid: str, title: str, panels: List[dict]) -> dict:
+    return {
+        "uid": uid,
+        "title": title,
+        "timezone": "browser",
+        "schemaVersion": 39,
+        "refresh": "10s",
+        "time": {"from": "now-30m", "to": "now"},
+        "panels": panels,
+    }
+
+
+def cluster_dashboard() -> dict:
+    return _dashboard("ray-tpu-cluster", "ray_tpu cluster", [
+        _panel(1, "Nodes", ["ray_tpu_nodes"], 0, 0),
+        _panel(2, "Actors by state", ["ray_tpu_actors"], 12, 0),
+        _panel(3, "Logical resources",
+               ["ray_tpu_resource_total", "ray_tpu_resource_available"], 0, 8),
+        _panel(4, "Tasks by state", ["ray_tpu_tasks"], 12, 8),
+        _panel(5, "TPU chips",
+               ['ray_tpu_resource_total{resource="TPU"}',
+                'ray_tpu_resource_available{resource="TPU"}'], 0, 16),
+        _panel(6, "Placement groups", ["ray_tpu_placement_groups"], 12, 16),
+    ])
+
+
+def serve_dashboard() -> dict:
+    return _dashboard("ray-tpu-serve", "ray_tpu serve", [
+        _panel(1, "Replicas", ["ray_tpu_serve_replicas"], 0, 0),
+        _panel(2, "Request rate",
+               ["rate(ray_tpu_serve_requests_total[5m])"], 12, 0, unit="reqps"),
+        _panel(3, "Queue depth", ["ray_tpu_serve_queued"], 0, 8),
+        _panel(4, "Apps", ["ray_tpu_serve_apps"], 12, 8),
+    ])
+
+
+def events_dashboard() -> dict:
+    return _dashboard("ray-tpu-events", "ray_tpu events", [
+        _panel(1, "Events by severity",
+               ["increase(ray_tpu_events_total[5m])"], 0, 0),
+    ])
+
+
+def generate_configs(out_dir: str, metrics_url: str) -> Dict[str, str]:
+    """Write all configs; returns {name: path}."""
+    host_port = metrics_url.split("//", 1)[-1].rstrip("/")
+    written: Dict[str, str] = {}
+    os.makedirs(out_dir, exist_ok=True)
+
+    prom = (
+        "global:\n"
+        "  scrape_interval: 10s\n"
+        "scrape_configs:\n"
+        "  - job_name: ray_tpu\n"
+        "    metrics_path: /metrics\n"
+        "    static_configs:\n"
+        f"      - targets: ['{host_port}']\n"
+    )
+    p = os.path.join(out_dir, "prometheus.yml")
+    with open(p, "w") as f:
+        f.write(prom)
+    written["prometheus"] = p
+
+    ds_dir = os.path.join(out_dir, "grafana", "provisioning", "datasources")
+    os.makedirs(ds_dir, exist_ok=True)
+    p = os.path.join(ds_dir, "ray_tpu.yml")
+    with open(p, "w") as f:
+        f.write(
+            "apiVersion: 1\n"
+            "datasources:\n"
+            "  - name: ray_tpu_prom\n"
+            "    uid: ray_tpu_prom\n"
+            "    type: prometheus\n"
+            "    url: http://localhost:9090\n"
+            "    isDefault: true\n")
+    written["datasource"] = p
+
+    prov_dir = os.path.join(out_dir, "grafana", "provisioning", "dashboards")
+    os.makedirs(prov_dir, exist_ok=True)
+    dash_dir = os.path.join(out_dir, "grafana", "dashboards")
+    os.makedirs(dash_dir, exist_ok=True)
+    p = os.path.join(prov_dir, "ray_tpu.yml")
+    with open(p, "w") as f:
+        f.write(
+            "apiVersion: 1\n"
+            "providers:\n"
+            "  - name: ray_tpu\n"
+            "    type: file\n"
+            "    options:\n"
+            f"      path: {dash_dir}\n")
+    written["provider"] = p
+
+    for name, dash in (("cluster", cluster_dashboard()),
+                       ("serve", serve_dashboard()),
+                       ("events", events_dashboard())):
+        p = os.path.join(dash_dir, f"{name}.json")
+        with open(p, "w") as f:
+            json.dump(dash, f, indent=2)
+        written[f"dashboard_{name}"] = p
+    return written

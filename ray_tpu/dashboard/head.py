@@ -100,7 +100,8 @@ class DashboardHead:
         if path == "/metrics":
             from ray_tpu.util.metrics import prometheus_text
 
-            return prometheus_text().encode(), "text/plain; version=0.0.4"
+            body = prometheus_text() + self._core_metrics_text()
+            return body.encode(), "text/plain; version=0.0.4"
         data = self._api(path)
         if data is None:
             return None, None
@@ -149,7 +150,152 @@ class DashboardHead:
             return state.node_stats()
         if path == "/api/stacks":
             return state.dump_stacks()
+        if path == "/api/events":
+            return state.list_cluster_events()
+        if path == "/api/serve":
+            return self._serve_view()
+        if path == "/api/train":
+            return self._train_view()
+        if path == "/api/data":
+            return self._data_view()
+        if path == "/api/grafana":
+            return self._grafana_view()
         return None
+
+    # -- per-library views (reference: dashboard/modules/{serve,train,data})
+
+    def _serve_view(self):
+        import time as _time
+
+        import ray_tpu
+        from ray_tpu.serve._private.controller import CONTROLLER_NAME
+
+        # TTL cache: the UI poll and every /metrics scrape share one
+        # snapshot, so replica-stats probes run at most once per window
+        cached = getattr(self, "_serve_cache", None)
+        if cached is not None and _time.monotonic() - cached[0] < 5.0:
+            return cached[1]
+
+        try:
+            ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
+        except Exception:  # noqa: BLE001 — serve not running
+            view = {"running": False, "applications": {}}
+            self._serve_cache = (_time.monotonic(), view)
+            return view
+        apps = {}
+        for app in ray_tpu.get(ctrl.list_applications.remote()):
+            desc = ray_tpu.get(ctrl.describe_application.remote(app))
+            stats = {}
+            for name in desc:
+                reps = ray_tpu.get(ctrl.get_deployment_stats.remote(app, name))
+                stats[name] = [r for r in reps if r]
+            apps[app] = {"deployments": desc, "stats": stats}
+        view = {"running": True, "applications": apps}
+        self._serve_cache = (_time.monotonic(), view)
+        return view
+
+    def _train_view(self):
+        """Every live TrainControllerActor's status (v2 runs)."""
+        import ray_tpu
+        from ray_tpu.util import state
+
+        runs = []
+        for a in state.list_actors():
+            if a.get("class_name") == "TrainControllerActor" and \
+                    a.get("state") == "ALIVE":
+                try:
+                    handle = ray_tpu.get_actor(a["name"]) if a.get("name") \
+                        else None
+                    status = (ray_tpu.get(handle.get_status.remote(),
+                                          timeout=5) if handle else {})
+                except Exception:  # noqa: BLE001
+                    status = {}
+                runs.append({"actor_id": a["actor_id"], "name": a.get("name"),
+                             "status": status})
+        return {"runs": runs}
+
+    def _data_view(self):
+        """Published streaming-executor runs (data:stats:* in the GCS KV)."""
+        import json as _json
+
+        from ray_tpu._private.worker import get_global_worker
+
+        w = get_global_worker()
+        if w is None:
+            return {"runs": []}
+        keys = w.gcs.call("KVKeys", {"prefix": "data:stats:"}) or []
+        blobs = w.gcs.call("KVMultiGet", {"keys": sorted(keys)[-50:]}) or {}
+        return {"runs": [_json.loads(v) for v in blobs.values()]}
+
+    def _grafana_view(self):
+        """Generate (once) and report the Prometheus/Grafana config files."""
+        import tempfile
+
+        from ray_tpu.dashboard import grafana
+
+        if not hasattr(self, "_grafana_paths"):
+            out = getattr(self, "metrics_config_dir", None) or \
+                tempfile.mkdtemp(prefix="ray_tpu_metrics_")
+            self._grafana_paths = grafana.generate_configs(out, self.url)
+        return self._grafana_paths
+
+    # -- core metric exposition (reference: dashboard/modules/metrics +
+    #    src/ray/stats/metric_defs.cc) — computed at scrape time
+
+    def _core_metrics_text(self) -> str:
+        from collections import Counter as _Counter
+
+        import ray_tpu
+        from ray_tpu.util import state
+
+        lines = []
+
+        def gauge(name, value, **tags):
+            t = ",".join(f'{k}="{v}"' for k, v in tags.items())
+            lines.append(f"{name}{{{t}}} {value}" if t else f"{name} {value}")
+
+        try:
+            nodes = state.list_nodes()
+            by_state = _Counter(n.get("state", "ALIVE") for n in nodes)
+            for s, c in by_state.items():
+                gauge("ray_tpu_nodes", c, state=s)
+            for res, v in ray_tpu.cluster_resources().items():
+                gauge("ray_tpu_resource_total", v, resource=res)
+            for res, v in ray_tpu.available_resources().items():
+                gauge("ray_tpu_resource_available", v, resource=res)
+            actors = _Counter(a.get("state") for a in state.list_actors())
+            for s, c in actors.items():
+                gauge("ray_tpu_actors", c, state=s)
+            pgs = _Counter(p.get("state")
+                           for p in state.list_placement_groups())
+            for s, c in pgs.items():
+                gauge("ray_tpu_placement_groups", c, state=s)
+            tasks = _Counter(t.get("state") for t in state.list_tasks())
+            for s, c in tasks.items():
+                gauge("ray_tpu_tasks", c, state=s)
+            events = _Counter(e["severity"]
+                              for e in state.list_cluster_events())
+            for s, c in events.items():
+                gauge("ray_tpu_events_total", c, severity=s)
+        except Exception:  # noqa: BLE001 — scrape must not 500 mid-shutdown
+            pass
+        try:
+            serve = self._serve_view()
+            if serve["running"]:
+                gauge("ray_tpu_serve_apps", len(serve["applications"]))
+                for app, dep in serve["applications"].items():
+                    for name, reps in dep.get("stats", {}).items():
+                        gauge("ray_tpu_serve_replicas", len(reps),
+                              app=app, deployment=name)
+                        gauge("ray_tpu_serve_requests_total",
+                              sum(r.get("total", 0) for r in reps),
+                              app=app, deployment=name)
+                        gauge("ray_tpu_serve_queued",
+                              sum(r.get("ongoing", 0) for r in reps),
+                              app=app, deployment=name)
+        except Exception:  # noqa: BLE001
+            pass
+        return "\n" + "\n".join(lines) + "\n" if lines else ""
 
 
 _dashboard: Optional[DashboardHead] = None

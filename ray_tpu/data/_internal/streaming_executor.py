@@ -468,6 +468,38 @@ class StreamingExecutor:
 
     def shutdown(self) -> None:
         self._stop.set()
+        self._publish_stats()
+
+    def _publish_stats(self) -> None:
+        """Best-effort: record this run's per-operator stats in the GCS KV
+        (``data:stats:*``) so the dashboard's Data view can list executions
+        cluster-wide (reference: dashboard/modules/data/)."""
+        if getattr(self, "_stats_published", False):
+            return
+        self._stats_published = True
+        try:
+            import json
+            import time as _time
+
+            from ray_tpu._private.worker import get_global_worker
+
+            w = get_global_worker()
+            if w is None:
+                return
+            name = " -> ".join(op.name for op in self.ops)
+            key = f"data:stats:{_time.time():.3f}"
+            blob = json.dumps({"pipeline": name, "ts": _time.time(),
+                               "operators": self.stats()}).encode()
+            w.gcs.call("KVPut", {"key": key, "value": blob})
+            # bounded history: drop the oldest entries beyond 100 so
+            # per-epoch pipelines can't grow the GCS KV (and its persisted
+            # snapshot) forever
+            keys = sorted(w.gcs.call("KVKeys", {"prefix": "data:stats:"})
+                          or [])
+            for old in keys[:-100]:
+                w.gcs.call("KVDel", {"key": old})
+        except Exception:  # noqa: BLE001 — observability must never break a run
+            pass
 
     def stats(self) -> Dict[str, Any]:
         out = {}

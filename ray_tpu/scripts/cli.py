@@ -274,7 +274,8 @@ def cmd_status(args) -> int:
 # state listings
 # ---------------------------------------------------------------------------
 
-_LIST_KINDS = ("actors", "tasks", "nodes", "objects", "workers", "jobs", "pgs")
+_LIST_KINDS = ("actors", "tasks", "nodes", "objects", "workers", "jobs",
+               "pgs", "events")
 
 
 def cmd_list(args) -> int:
@@ -284,7 +285,8 @@ def cmd_list(args) -> int:
     fn = {"actors": state.list_actors, "tasks": state.list_tasks,
           "nodes": state.list_nodes, "objects": state.list_objects,
           "workers": state.list_workers, "jobs": state.list_jobs,
-          "pgs": state.list_placement_groups}[args.kind]
+          "pgs": state.list_placement_groups,
+          "events": state.list_cluster_events}[args.kind]
     rows = fn(limit=args.limit)
     for r in rows:
         print(json.dumps(_jsonable(r), default=str))

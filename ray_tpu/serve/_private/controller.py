@@ -101,6 +101,22 @@ class ServeController:
         with self._lock:
             return list(self._desired)
 
+    def describe_application(self, app_name: str) -> dict:
+        """Dashboard view: deployments with desired/live replica counts
+        (reference: dashboard/modules/serve/)."""
+        with self._lock:
+            app = self._desired.get(app_name, {})
+            live = self._replicas.get(app_name, {})
+            return {
+                name: {
+                    "num_replicas": cfg.get("num_replicas", 1),
+                    "is_ingress": bool(cfg.get("is_ingress")),
+                    "live_replicas": len(live.get(name, [])),
+                    "version_hash": _cfg_hash(cfg),
+                }
+                for name, cfg in app.items()
+            }
+
     def get_deployment_info(self, app_name: str, deployment_name: Optional[str] = None):
         with self._lock:
             app = self._desired.get(app_name)
@@ -123,14 +139,22 @@ class ServeController:
             return [r["h"]._actor_id.hex() for r in reps]
 
     def get_deployment_stats(self, app_name: str, deployment_name: str):
+        import time as _time
+
         import ray_tpu
 
         with self._lock:
             reps = list(self._replicas.get(app_name, {}).get(deployment_name, []))
+        # submit all probes first, then collect under ONE shared deadline —
+        # serial per-replica timeouts would make a scrape of a deployment
+        # with dead replicas take 5s x replicas
+        refs = [r["h"].stats.remote() for r in reps]
+        deadline = _time.monotonic() + 5
         out = []
-        for r in reps:
+        for ref in refs:
             try:
-                out.append(ray_tpu.get(r["h"].stats.remote(), timeout=5))
+                out.append(ray_tpu.get(
+                    ref, timeout=max(0.1, deadline - _time.monotonic())))
             except Exception:  # noqa: BLE001
                 out.append(None)
         return out

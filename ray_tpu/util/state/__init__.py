@@ -11,12 +11,14 @@ from ray_tpu.util.state.api import (
     dump_stacks,
     node_stats,
     list_actors,
+    list_cluster_events,
     list_jobs,
     list_nodes,
     list_objects,
     list_placement_groups,
     list_tasks,
     list_workers,
+    record_event,
     summarize_actors,
     summarize_tasks,
 )
@@ -28,12 +30,14 @@ __all__ = [
     "cpu_profile",
     "jax_profile",
     "list_actors",
+    "list_cluster_events",
     "list_jobs",
     "list_nodes",
     "list_objects",
     "list_placement_groups",
     "list_tasks",
     "list_workers",
+    "record_event",
     "summarize_actors",
     "summarize_tasks",
 ]

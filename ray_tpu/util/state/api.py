@@ -56,6 +56,21 @@ class StateApiClient:
         rows = self._w.gcs.call("GetAllNodeInfo", {}) or []
         return _apply_filters(rows, filters)[:limit]
 
+    def list_cluster_events(self, filters=None, limit: int = 1000,
+                            severity: Optional[str] = None,
+                            after_id: int = 0) -> List[dict]:
+        """reference: dashboard/modules/event/ aggregated cluster events."""
+        rows = self._w.gcs.call("ListEvents", {
+            "severity": severity, "after_id": after_id, "limit": limit}) or []
+        return _apply_filters(rows, filters)[:limit]
+
+    def record_event(self, message: str, *, severity: str = "INFO",
+                     source: str = "user", **metadata) -> None:
+        """Append a user event to the cluster event log."""
+        self._w.gcs.call("RecordEvent", {
+            "severity": severity, "source": source, "message": message,
+            "metadata": metadata})
+
     def list_actors(self, filters=None, limit: int = 10000) -> List[dict]:
         rows = self._w.gcs.call("ListActors", {}) or []
         return _apply_filters(rows, filters)[:limit]
@@ -257,6 +272,17 @@ def list_workers(filters=None, limit: int = 10000):
 
 def summarize_tasks():
     return _client().summarize_tasks()
+
+
+def list_cluster_events(filters=None, limit: int = 1000, severity=None,
+                        after_id: int = 0):
+    return _client().list_cluster_events(filters, limit, severity, after_id)
+
+
+def record_event(message: str, *, severity: str = "INFO", source: str = "user",
+                 **metadata):
+    return _client().record_event(message, severity=severity, source=source,
+                                  **metadata)
 
 
 def summarize_actors():

@@ -1,0 +1,166 @@
+"""Dashboard depth (VERDICT r2 missing #7): event aggregator, per-library
+views (serve/train/data), core metric exposition, Grafana/Prometheus wiring.
+
+reference: dashboard/modules/event/, modules/{serve,train,data}/,
+modules/metrics/ (Grafana dashboard + prometheus config generation).
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def ray4():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def _get(url, text=False):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        body = resp.read()
+    return body.decode() if text else json.loads(body)
+
+
+def test_cluster_events_record_and_list(ray4):
+    from ray_tpu.util import state
+
+    state.record_event("deploy started", severity="INFO", source="ci",
+                       build="abc123")
+    state.record_event("bad thing", severity="ERROR", source="ci")
+    events = state.list_cluster_events()
+    # node registration from init() is in the log too
+    assert any("joined" in e["message"] for e in events)
+    mine = [e for e in events if e["source"] == "ci"]
+    assert len(mine) == 2
+    assert mine[0]["metadata"]["build"] == "abc123"
+    errs = state.list_cluster_events(severity="ERROR")
+    assert all(e["severity"] == "ERROR" for e in errs)
+    assert any(e["message"] == "bad thing" for e in errs)
+    # incremental poll: after_id skips everything already seen
+    last = events[-1]["event_id"]
+    assert state.list_cluster_events(after_id=last) == []
+
+
+def test_actor_death_emits_event(ray4):
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    class Crash:
+        def die(self):
+            import os
+
+            os._exit(1)
+
+    a = Crash.remote()
+    try:
+        ray_tpu.get(a.die.remote())
+    except Exception:  # noqa: BLE001 — expected
+        pass
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        events = state.list_cluster_events()
+        if any("actor" in e["message"] and e["severity"] in ("ERROR", "WARNING")
+               for e in events):
+            return
+        time.sleep(0.2)
+    raise AssertionError(f"no actor-death event in {events}")
+
+
+def test_dashboard_views_and_metrics(ray4):
+    from ray_tpu.dashboard import DashboardHead
+
+    # produce a data execution so /api/data has something to show
+    from ray_tpu import data as rdata
+
+    assert rdata.range(100, parallelism=4).sum("id") == 4950
+
+    head = DashboardHead()
+    try:
+        events = _get(head.url + "/api/events")
+        assert any(e["source"] == "gcs" for e in events)
+
+        serve_view = _get(head.url + "/api/serve")
+        assert serve_view == {"running": False, "applications": {}}
+
+        train_view = _get(head.url + "/api/train")
+        assert train_view == {"runs": []}
+
+        data_view = _get(head.url + "/api/data")
+        assert len(data_view["runs"]) >= 1
+        run = data_view["runs"][-1]
+        assert "Read" in run["pipeline"]
+        assert any(st["tasks_submitted"] >= 1
+                   for st in run["operators"].values())
+
+        metrics = _get(head.url + "/metrics", text=True)
+        assert 'ray_tpu_nodes{state="ALIVE"} 1' in metrics
+        assert 'ray_tpu_resource_total{resource="CPU"}' in metrics
+        assert "ray_tpu_events_total" in metrics
+    finally:
+        head.shutdown()
+
+
+def test_serve_view_with_running_app(ray4):
+    from ray_tpu import serve
+    from ray_tpu.dashboard import DashboardHead
+
+    @serve.deployment(num_replicas=2, ray_actor_options={"num_cpus": 0.1})
+    def hello():
+        return "hi"
+
+    handle = serve.run(hello.bind(), name="dashapp")
+    assert handle.remote().result(timeout_s=30) == "hi"
+    head = DashboardHead()
+    try:
+        view = _get(head.url + "/api/serve")
+        assert view["running"]
+        app = view["applications"]["dashapp"]
+        dep = app["deployments"]["hello"]
+        assert dep["num_replicas"] == 2
+        assert dep["live_replicas"] == 2
+        stats = app["stats"]["hello"]
+        assert sum(s["total"] for s in stats) >= 1
+        metrics = _get(head.url + "/metrics", text=True)
+        assert 'ray_tpu_serve_replicas{app="dashapp",deployment="hello"} 2' \
+            in metrics
+    finally:
+        head.shutdown()
+        serve.shutdown()
+
+
+def test_grafana_config_generation(tmp_path):
+    from ray_tpu.dashboard import grafana
+
+    written = grafana.generate_configs(str(tmp_path), "http://127.0.0.1:8265")
+    assert (tmp_path / "prometheus.yml").exists()
+    prom = (tmp_path / "prometheus.yml").read_text()
+    assert "127.0.0.1:8265" in prom and "job_name: ray_tpu" in prom
+    for name in ("cluster", "serve", "events"):
+        p = tmp_path / "grafana" / "dashboards" / f"{name}.json"
+        assert p.exists(), written
+        dash = json.loads(p.read_text())
+        assert dash["panels"], name
+        for panel in dash["panels"]:
+            assert panel["targets"][0]["expr"].startswith(("ray_tpu_",
+                                                           "rate(", "increase("))
+    assert (tmp_path / "grafana" / "provisioning" / "datasources"
+            / "ray_tpu.yml").exists()
+
+
+def test_grafana_endpoint(ray4):
+    from ray_tpu.dashboard import DashboardHead
+
+    head = DashboardHead()
+    try:
+        paths = _get(head.url + "/api/grafana")
+        assert "prometheus" in paths
+        with open(paths["dashboard_cluster"]) as f:
+            assert json.load(f)["uid"] == "ray-tpu-cluster"
+    finally:
+        head.shutdown()
