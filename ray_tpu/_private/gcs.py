@@ -172,6 +172,10 @@ class GcsServer:
         # structured events surfaced by the dashboard and the state API
         self.events: deque = deque(maxlen=1000)
         self._event_seq = 0
+        # monotonic per-severity totals — the ring above evicts, so metric
+        # consumers (Prometheus rate/increase) need counters that never
+        # decrease
+        self._event_counts: Dict[str, int] = {}
         self._lock = threading.RLock()
         self._actor_queue: deque = deque()
         self._actor_cv = threading.Condition(self._lock)
@@ -849,6 +853,8 @@ class GcsServer:
                       **metadata):
         with self._lock:
             self._event_seq += 1
+            self._event_counts[severity] = \
+                self._event_counts.get(severity, 0) + 1
             self.events.append({
                 "event_id": self._event_seq,
                 "ts": time.time(),
@@ -863,6 +869,10 @@ class GcsServer:
                            req.get("source", "user"), req["message"],
                            **(req.get("metadata") or {}))
         return True
+
+    def HandleGetEventCounts(self, req):
+        with self._lock:
+            return dict(self._event_counts)
 
     def HandleListEvents(self, req):
         severity = req.get("severity")

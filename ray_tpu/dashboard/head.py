@@ -196,22 +196,37 @@ class DashboardHead:
 
     def _train_view(self):
         """Every live TrainControllerActor's status (v2 runs)."""
+        import time as _time
+
         import ray_tpu
         from ray_tpu.util import state
 
+        controllers = [a for a in state.list_actors()
+                       if a.get("class_name") == "TrainControllerActor"
+                       and a.get("state") == "ALIVE"]
+        # submit all probes first, collect under ONE shared deadline
+        # (serial 5s-per-controller would stall the dashboard thread)
+        probes = []
+        for a in controllers:
+            ref = None
+            try:
+                if a.get("name"):
+                    ref = ray_tpu.get_actor(a["name"]).get_status.remote()
+            except Exception:  # noqa: BLE001
+                pass
+            probes.append((a, ref))
+        deadline = _time.monotonic() + 5
         runs = []
-        for a in state.list_actors():
-            if a.get("class_name") == "TrainControllerActor" and \
-                    a.get("state") == "ALIVE":
+        for a, ref in probes:
+            status = {}
+            if ref is not None:
                 try:
-                    handle = ray_tpu.get_actor(a["name"]) if a.get("name") \
-                        else None
-                    status = (ray_tpu.get(handle.get_status.remote(),
-                                          timeout=5) if handle else {})
+                    status = ray_tpu.get(
+                        ref, timeout=max(0.1, deadline - _time.monotonic()))
                 except Exception:  # noqa: BLE001
-                    status = {}
-                runs.append({"actor_id": a["actor_id"], "name": a.get("name"),
-                             "status": status})
+                    pass
+            runs.append({"actor_id": a["actor_id"], "name": a.get("name"),
+                         "status": status})
         return {"runs": runs}
 
     def _data_view(self):
@@ -273,9 +288,14 @@ class DashboardHead:
             tasks = _Counter(t.get("state") for t in state.list_tasks())
             for s, c in tasks.items():
                 gauge("ray_tpu_tasks", c, state=s)
-            events = _Counter(e["severity"]
-                              for e in state.list_cluster_events())
-            for s, c in events.items():
+            # monotonic totals from the GCS (the event ring evicts, so a
+            # count over list_cluster_events would DECREASE and break
+            # Prometheus rate()/increase() semantics)
+            from ray_tpu._private.worker import get_global_worker
+
+            w = get_global_worker()
+            counts = (w.gcs.call("GetEventCounts", {}) or {}) if w else {}
+            for s, c in counts.items():
                 gauge("ray_tpu_events_total", c, severity=s)
         except Exception:  # noqa: BLE001 — scrape must not 500 mid-shutdown
             pass

@@ -486,8 +486,12 @@ class StreamingExecutor:
             w = get_global_worker()
             if w is None:
                 return
+            import os as _os
+
             name = " -> ".join(op.name for op in self.ops)
-            key = f"data:stats:{_time.time():.3f}"
+            # pid+object-id uniquifier: two executors finishing in the same
+            # millisecond must not overwrite each other's record
+            key = f"data:stats:{_time.time():.3f}:{_os.getpid()}:{id(self):x}"
             blob = json.dumps({"pipeline": name, "ts": _time.time(),
                                "operators": self.stats()}).encode()
             w.gcs.call("KVPut", {"key": key, "value": blob})

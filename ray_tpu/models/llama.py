@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ray_tpu.ops.attention import multi_head_attention
@@ -50,6 +51,14 @@ class LlamaConfig:
     param_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.bfloat16
     remat: bool = True
+    # what the per-layer checkpoint keeps for the backward pass:
+    #   "full" — nothing_saveable: minimum HBM, one extra fwd of recompute
+    #   "attn" — keep the attention block's output (checkpoint_name'd):
+    #            +B*S*D bf16 per layer of HBM buys skipping the flash-
+    #            attention recompute in bwd — the best FLOPs/byte trade here
+    #   "dots" — dots_with_no_batch_dims_saveable: every GEMM output kept;
+    #            fastest bwd, fits only when activations are small vs HBM
+    remat_policy: str = "full"
 
     @property
     def head_dim(self) -> int:
@@ -202,6 +211,20 @@ def _constraint(x, spec, mesh):
     return lax.with_sharding_constraint(x, jax.sharding.NamedSharding(mesh, spec))
 
 
+def _remat_policy(cfg):
+    policies = {
+        "full": jax.checkpoint_policies.nothing_saveable,
+        "attn": jax.checkpoint_policies.save_only_these_names("attn_out"),
+        "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    }
+    try:
+        return policies[cfg.remat_policy]
+    except KeyError:
+        raise ValueError(
+            f"remat_policy={cfg.remat_policy!r} — must be one of {sorted(policies)}"
+        ) from None
+
+
 def _layer(cfg: LlamaConfig, x, lp, cos, sin, mesh, context_parallel):
     """One transformer block. x: [B, S, D]."""
     b, s, d = x.shape
@@ -237,6 +260,7 @@ def _layer(cfg: LlamaConfig, x, lp, cos, sin, mesh, context_parallel):
         k = apply_rope(k, cos[:s], sin[:s])
         attn = multi_head_attention(q, k, v, causal=True)
     attn = attn.reshape(b, s, cfg.n_heads * cfg.head_dim)
+    attn = checkpoint_name(attn, "attn_out")
     x = x + (attn @ lp["wo"].astype(cdt))
     x = _constraint(x, P(BATCH_AXES, seq_axis, None), mesh)
 
@@ -273,7 +297,7 @@ def forward(
 
     layer = partial(_layer, cfg, cos=cos, sin=sin, mesh=mesh, context_parallel=context_parallel)
     if cfg.remat:
-        layer = jax.checkpoint(layer, policy=jax.checkpoint_policies.nothing_saveable)
+        layer = jax.checkpoint(layer, policy=_remat_policy(cfg))
 
     def body(x, lp):
         return layer(x, lp), None

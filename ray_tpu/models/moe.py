@@ -29,6 +29,7 @@ from typing import Any, Dict, Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ray_tpu.ops.attention import multi_head_attention
@@ -71,6 +72,7 @@ class MoEConfig:
     param_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.bfloat16
     remat: bool = True
+    remat_policy: str = "full"  # "full" | "attn" | "dots" (see llama.py)
 
     @property
     def head_dim(self) -> int:
@@ -208,11 +210,23 @@ def moe_block_ragged(cfg: MoEConfig, x, lp):
     xt = x.reshape(t, d)
     top_w, top_idx, aux = _router(cfg, xt, lp)
 
-    flat_e = top_idx.reshape(-1)                   # [T*k] expert assignment
-    order = jnp.argsort(flat_e)                    # stable: ties keep token order
+    # group token-expert pairs by expert with a COUNTING sort: expert ids
+    # live in [0, E), so a cumsum of one-hots gives each pair's rank within
+    # its expert in O(N·E) vector ops — the general argsort is a bitonic
+    # O(N log²N) sort on TPU and showed up in step profiles
+    n = t * k
+    flat_e = top_idx.reshape(-1)                   # [N] expert assignment
+    onehot = jax.nn.one_hot(flat_e, cfg.n_experts, dtype=jnp.int32)
+    rank = ((jnp.cumsum(onehot, axis=0) - onehot) * onehot).sum(-1)  # [N]
+    group_sizes = onehot.sum(0)                    # [E]
+    offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(group_sizes)[:-1]])
+    pos = rank + offsets[flat_e]                   # destination sorted slot
+    # inverse permutation: sorted slot -> source pair (stable, like argsort)
+    order = jnp.zeros((n,), jnp.int32).at[pos].set(
+        jnp.arange(n, dtype=jnp.int32))
     tok = order // k                               # source token per sorted slot
-    sx = jnp.take(xt, tok, axis=0).astype(cdt)     # [T*k, d] gather
-    group_sizes = jnp.bincount(flat_e, length=cfg.n_experts).astype(jnp.int32)
+    sx = jnp.take(xt, tok, axis=0).astype(cdt)     # [N, d] gather
 
     gate = lax.ragged_dot(sx, lp["w_gate"].astype(cdt), group_sizes)
     up = lax.ragged_dot(sx, lp["w_up"].astype(cdt), group_sizes)
@@ -291,6 +305,7 @@ def _layer(cfg: MoEConfig, carry, lp, cos, sin, mesh):
     kk = apply_rope(kk, cos[:s], sin[:s])
     attn = multi_head_attention(q, kk, v, causal=True)
     attn = attn.reshape(b, s, cfg.n_heads * cfg.head_dim)
+    attn = checkpoint_name(attn, "attn_out")
     x = x + (attn @ lp["wo"].astype(cdt))
     x = _constraint(x, P(MOE_BATCH_AXES, None, None), mesh)
 
@@ -327,9 +342,11 @@ def forward(
     x = jnp.take(emb, tokens, axis=0).astype(cfg.compute_dtype)
     x = _constraint(x, P(MOE_BATCH_AXES, None, None), mesh)
 
+    from ray_tpu.models.llama import _remat_policy
+
     layer = partial(_layer, cfg, cos=cos, sin=sin, mesh=mesh)
     if cfg.remat:
-        layer = jax.checkpoint(layer, policy=jax.checkpoint_policies.nothing_saveable)
+        layer = jax.checkpoint(layer, policy=_remat_policy(cfg))
 
     def body(carry, lp):
         return layer(carry, lp), None
