@@ -27,6 +27,11 @@ class LLMConfig:
 
     model_config: Any = None  # a models.llama.LlamaConfig (or compatible)
     max_batch_size: int = 8
+    # tokens decoded per dispatch (multi-step scheduling): the whole chunk
+    # runs as ONE device program with stop/budget handling in-program, so
+    # per-dispatch host latency is amortized over `decode_chunk` tokens.
+    # 1 = sync every token (lowest streaming latency).
+    decode_chunk: int = 8
     max_seq_len: Optional[int] = None  # default: model_config.max_seq_len
     # parallelism degrees (mesh axes; the vllm_models.py:177-186 analog)
     tensor_parallel_size: int = 1

@@ -6,9 +6,17 @@ groups (reference: llm/_internal/serve/deployments/llm/vllm/vllm_models.py
 TPU-first:
 
   - static-shape KV cache with `max_batch` sequence slots; one jitted
-    decode program advances EVERY active slot one token per step
-    (continuous batching — new requests join the running batch at any
-    step by prefilling into a free slot, no generation restart)
+    decode program advances EVERY active slot (continuous batching — new
+    requests join the running batch at any step by prefilling into a free
+    slot, no generation restart)
+  - multi-step scheduling: each step() runs `decode_chunk` tokens as ONE
+    device program (stop tokens / budgets / cache bounds handled
+    in-program; slots self-deactivate mid-chunk), amortizing per-dispatch
+    host latency — measured 58 -> 600 tok/s on a tunneled v5e at chunk 64
+  - the decode-loop state (next tokens, lengths, active mask, budgets,
+    stop ids, PRNG key) lives on DEVICE between steps; the host uploads
+    mirrors only on slot transitions and reads back one [chunk, B] token
+    block per step
   - prefill jitted per bucketed prompt length (powers of two) so arrival
     order doesn't cause recompiles
   - sampling (greedy / temperature / top-k) inside the jitted program;
@@ -30,6 +38,10 @@ import numpy as np
 from ray_tpu.llm.config import GenerationConfig, LLMConfig
 from ray_tpu.models import llama
 from ray_tpu.ops.rope import rope_frequencies
+
+
+# stop-token ids travel to the device as a fixed-width padded row per slot
+_MAX_STOP_IDS = 8
 
 
 @dataclasses.dataclass
@@ -76,6 +88,11 @@ class JaxLLMEngine:
         self.cfg = cfg
         self.max_batch = config.max_batch_size
         self.max_seq = config.max_seq_len or cfg.max_seq_len
+        if config.decode_chunk < 1:
+            # 0 would scan zero steps: step() emits nothing while
+            # has_work() stays true — generate()/serve drivers spin forever
+            raise ValueError(
+                f"decode_chunk must be >= 1 (got {config.decode_chunk})")
         if params is None:
             params = llama.init_params(cfg, key or jax.random.PRNGKey(0))
         self.params = params
@@ -101,13 +118,26 @@ class JaxLLMEngine:
         self._next_tok = np.zeros(self.max_batch, np.int32)
         self._slot_temp = np.zeros(self.max_batch, np.float32)
         self._slot_topk = np.zeros(self.max_batch, np.int32)
+        # device mirrors of the decode-loop state: the steady-state loop
+        # must not upload ANYTHING per token, and the PRNG key lives on
+        # device too (a host-side random.split measured 83ms on a tunneled
+        # chip); mirrors refresh only on slot transitions
+        self._dirty = True
+        self._d_next = self._d_lengths = self._d_active = None
+        self._d_temp = self._d_topk = None
+        self._d_remaining = self._d_stops = None
+        self._d_key = jax.random.PRNGKey(config.model_config.vocab_size + 1)
         self._pending: List[_Request] = []
         self._requests: Dict[int, _Request] = {}
         self._req_counter = 0
         self._lock = threading.Lock()
-        self._key = jax.random.PRNGKey(config.model_config.vocab_size)
 
-        self._decode = jax.jit(self._decode_impl, donate_argnums=1)
+        # params are an ARGUMENT of the jitted programs, never a closure:
+        # captured closures lower as inline constants, and a real model's
+        # weights (GBs) baked into the module stall compilation and double
+        # HBM (observed: 2.3GB of captured constants on the 1B config)
+        self._decode = jax.jit(self._decode_chunk_impl, donate_argnums=2,
+                               static_argnums=10)
         # jax.jit caches per input shape, so bucketed prompt lengths reuse
         # compilations automatically
         self._prefill = jax.jit(self._prefill_impl)
@@ -137,18 +167,46 @@ class JaxLLMEngine:
 
     # -- jitted programs ------------------------------------------------
 
-    def _decode_impl(self, tokens, cache, lengths, key, temps, top_ks):
-        logits, cache = llama.decode_step(
-            self.cfg, self.params, tokens, cache, lengths, rope_cache=self._rope)
-        ids = _sample(logits, key, temps, top_ks)
-        return ids, cache
+    def _decode_chunk_impl(self, params, tokens, cache, lengths, active,
+                           remaining, stops, key, temps, top_ks, n_steps):
+        """Advance every slot up to ``n_steps`` tokens in ONE program.
 
-    def _prefill_impl(self, tokens, length, key, temps, top_ks):
+        Multi-step scheduling: stop-token / token-budget / cache-full
+        handling runs in-program (slots self-deactivate mid-chunk), so the
+        host syncs once per chunk instead of once per token — on a tunneled
+        chip per-dispatch latency dwarfs the 1-token compute.
+        Returns (emitted [n_steps, B] with -1 for inactive slots, new state).
+        """
+
+        def one(carry, _):
+            tokens, cache, lengths, active, remaining, key = carry
+            logits, cache = llama.decode_step(
+                self.cfg, params, tokens, cache, lengths,
+                rope_cache=self._rope)
+            key, sub = jax.random.split(key)
+            ids = _sample(logits, sub, temps, top_ks)
+            emitted = jnp.where(active > 0, ids, -1)
+            lengths = lengths + active
+            remaining = remaining - active
+            hit_stop = (stops == ids[:, None]).any(-1)
+            done = (active > 0) & (hit_stop | (remaining <= 0)
+                                   | (lengths + 1 >= self.max_seq))
+            active = active * (1 - done.astype(active.dtype))
+            tokens = jnp.where(active > 0, ids, tokens)
+            return (tokens, cache, lengths, active, remaining, key), emitted
+
+        carry = (tokens, cache, lengths, active, remaining, key)
+        carry, emitted = jax.lax.scan(one, carry, None, length=n_steps)
+        tokens, cache, lengths, active, remaining, key = carry
+        return emitted, tokens, cache, lengths, active, remaining, key
+
+    def _prefill_impl(self, params, tokens, length, key, temps, top_ks):
         logits, kv = llama.prefill(
-            self.cfg, self.params, tokens, rope_cache=self._rope)
+            self.cfg, params, tokens, rope_cache=self._rope)
         last = logits[jnp.arange(tokens.shape[0]), length - 1]
-        ids = _sample(last, key, temps, top_ks)
-        return ids, kv
+        key, sub = jax.random.split(key)
+        ids = _sample(last, sub, temps, top_ks)
+        return ids, kv, key
 
     # -- request lifecycle ---------------------------------------------
 
@@ -157,6 +215,10 @@ class JaxLLMEngine:
         gen = gen or GenerationConfig()
         if len(prompt) == 0:
             raise ValueError("empty prompt")
+        if len(gen.stop_token_ids) > _MAX_STOP_IDS:
+            raise ValueError(
+                f"at most {_MAX_STOP_IDS} stop_token_ids supported "
+                f"(got {len(gen.stop_token_ids)})")
         if len(prompt) + gen.max_new_tokens > self.max_seq:
             raise ValueError(
                 f"prompt ({len(prompt)}) + max_new_tokens ({gen.max_new_tokens})"
@@ -184,9 +246,9 @@ class JaxLLMEngine:
             bucket = min(bucket, self.max_seq)
             tokens = np.zeros((1, bucket), np.int32)
             tokens[0, :plen] = req.prompt
-            self._key, sub = jax.random.split(self._key)
-            ids, kv = self._prefill(
-                jnp.asarray(tokens), jnp.asarray([plen]), sub,
+            ids, kv, self._d_key = self._prefill(
+                self.params, jnp.asarray(tokens), jnp.asarray([plen]),
+                self._d_key,
                 jnp.asarray([req.gen.temperature], jnp.float32),
                 jnp.asarray([req.gen.top_k], jnp.int32))
             self.cache = self._write_slot(self.cache, kv, slot)
@@ -197,6 +259,7 @@ class JaxLLMEngine:
             self._next_tok[slot] = first
             self._slot_temp[slot] = req.gen.temperature
             self._slot_topk[slot] = req.gen.top_k
+            self._dirty = True  # device mirrors stale: new slot joined
             self._emit_locked(req, first)
 
     def _emit_locked(self, req: _Request, token: int):
@@ -208,9 +271,13 @@ class JaxLLMEngine:
             self._slot_req[req.slot] = None
             self._lengths[req.slot] = 0
             req.slot = -1
+            self._dirty = True  # device mirrors stale: slot freed
 
     def step(self) -> Dict[int, List[int]]:
-        """Admit pending, advance every active slot one token.
+        """Admit pending, then advance every active slot by up to
+        ``config.decode_chunk`` tokens in one device program (multi-step
+        scheduling; slots hitting a stop/budget mid-chunk deactivate
+        in-program). decode_chunk=1 recovers per-token stepping.
 
         Returns {request_id: [tokens emitted this step]}.
         """
@@ -222,21 +289,50 @@ class JaxLLMEngine:
             active = [s for s in range(self.max_batch)
                       if self._slot_req[s] is not None]
             if active:
-                # one decode program for the whole batch; sampling params are
-                # traced per-slot arrays, so mixed greedy/temperature/top-k
-                # callers share a single forward
-                self._key, sub = jax.random.split(self._key)
-                ids, self.cache = self._decode(
-                    jnp.asarray(self._next_tok), self.cache,
-                    jnp.asarray(self._lengths), sub,
-                    jnp.asarray(self._slot_temp), jnp.asarray(self._slot_topk))
-                ids = np.asarray(ids)
-                for s in active:
-                    req = self._slot_req[s]
-                    self._lengths[s] += 1
-                    tok = int(ids[s])
-                    self._next_tok[s] = tok
-                    self._emit_locked(req, tok)
+                if self._dirty:
+                    # slot transition since last chunk: refresh the device
+                    # mirrors from host truth — the ONLY uploads in the loop
+                    self._d_next = jnp.asarray(self._next_tok)
+                    self._d_lengths = jnp.asarray(self._lengths)
+                    self._d_active = jnp.asarray(np.array(
+                        [0 if r is None else 1 for r in self._slot_req],
+                        np.int32))
+                    self._d_temp = jnp.asarray(self._slot_temp)
+                    self._d_topk = jnp.asarray(self._slot_topk)
+                    remaining = np.zeros(self.max_batch, np.int32)
+                    stops = np.full((self.max_batch, _MAX_STOP_IDS), -1,
+                                    np.int32)
+                    for s, r in enumerate(self._slot_req):
+                        if r is not None:
+                            remaining[s] = (r.gen.max_new_tokens
+                                            - len(r.out_tokens))
+                            for j, sid in enumerate(r.gen.stop_token_ids):
+                                stops[s, j] = sid
+                    self._d_remaining = jnp.asarray(remaining)
+                    self._d_stops = jnp.asarray(stops)
+                    self._dirty = False
+                # one chunked decode program for the whole batch; sampling
+                # params are traced per-slot arrays, so mixed greedy /
+                # temperature / top-k callers share a single forward
+                (em_dev, self._d_next, self.cache, self._d_lengths,
+                 self._d_active, self._d_remaining, self._d_key) = \
+                    self._decode(
+                        self.params, self._d_next, self.cache,
+                        self._d_lengths, self._d_active, self._d_remaining,
+                        self._d_stops, self._d_key, self._d_temp,
+                        self._d_topk, self.config.decode_chunk)
+                em = np.asarray(em_dev)  # [chunk, B] — the single sync
+                for t in range(em.shape[0]):
+                    for s in active:
+                        req = self._slot_req[s]
+                        if req is None:
+                            continue  # finished earlier in this chunk
+                        tok = int(em[t, s])
+                        if tok < 0:
+                            continue
+                        self._lengths[s] += 1
+                        self._next_tok[s] = tok
+                        self._emit_locked(req, tok)
             for req in list(self._requests.values()):
                 n0 = before.get(id(req), 0)
                 if len(req.out_tokens) > n0:

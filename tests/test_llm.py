@@ -16,7 +16,7 @@ from ray_tpu.llm import (
     ProcessorConfig,
     build_llm_processor,
 )
-from ray_tpu.models.llama import LlamaConfig
+from ray_tpu.models.llama import LlamaConfig, init_params
 
 pytestmark = pytest.mark.slow  # module lane: see pytest.ini
 
@@ -60,8 +60,14 @@ def test_engine_batch_generate(engine):
     assert all(len(o) == 5 for o in outs)
 
 
-def test_engine_continuous_batching_join(engine):
-    """A request added mid-generation joins the running batch."""
+def test_engine_continuous_batching_join(tiny_cfg):
+    """A request added mid-generation joins the running batch.
+
+    decode_chunk=1: this test paces generation token-by-token to land a
+    second request mid-flight; the default chunked stepping would finish
+    the first request within one step()."""
+    engine = JaxLLMEngine(LLMConfig(model_config=tiny_cfg, max_batch_size=4,
+                                    max_seq_len=128, decode_chunk=1))
     done = {}
 
     def pump(n):
@@ -94,6 +100,30 @@ def test_engine_stop_tokens_and_validation(engine):
         engine.add_request([])
     with pytest.raises(ValueError):
         engine.add_request([1], GenerationConfig(max_new_tokens=10_000))
+    with pytest.raises(ValueError):
+        engine.add_request(
+            [1], GenerationConfig(stop_token_ids=tuple(range(99))))
+
+
+def test_engine_stop_token_truncates_mid_chunk(tiny_cfg):
+    """In-program stop handling: the device scan must deactivate a slot the
+    moment it emits a stop id, suppressing the rest of the chunk."""
+    params = init_params(tiny_cfg, jax.random.PRNGKey(3))
+    eng = JaxLLMEngine(LLMConfig(model_config=tiny_cfg, max_batch_size=2,
+                                 max_seq_len=128, decode_chunk=8),
+                       params=params)
+    prompt = [5, 6, 7]
+    free = eng.generate([prompt], GenerationConfig(max_new_tokens=24))[0]
+    assert len(free) == 24
+    # pick a token the unconstrained run actually emits mid-stream (not the
+    # first token, so the stop fires inside a decode chunk, not at prefill)
+    stop = next(t for t in free[1:] if t != free[0])
+    cut = eng.generate([prompt], GenerationConfig(
+        max_new_tokens=24, stop_token_ids=(stop,)))[0]
+    assert cut == free[:free.index(stop, 1) + 1], (free, cut)
+    # a fresh slot after a stop-terminated one must generate cleanly
+    again = eng.generate([prompt], GenerationConfig(max_new_tokens=24))[0]
+    assert again == free
 
 
 def test_llm_serve_deployment(ray_start_regular, tiny_cfg):
