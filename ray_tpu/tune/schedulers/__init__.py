@@ -3,6 +3,7 @@ from ray_tpu.tune.schedulers.trial_scheduler import (
     TrialScheduler,
 )
 from ray_tpu.tune.schedulers.async_hyperband import ASHAScheduler, AsyncHyperBandScheduler
+from ray_tpu.tune.schedulers.bohb import HyperBandForBOHB
 from ray_tpu.tune.schedulers.median_stopping import MedianStoppingRule
 from ray_tpu.tune.schedulers.hyperband import HyperBandScheduler
 from ray_tpu.tune.schedulers.pb2 import PB2
@@ -13,6 +14,7 @@ __all__ = [
     "FIFOScheduler",
     "ASHAScheduler",
     "AsyncHyperBandScheduler",
+    "HyperBandForBOHB",
     "MedianStoppingRule",
     "PopulationBasedTraining",
     "HyperBandScheduler",

@@ -1,0 +1,26 @@
+"""HyperBand for BOHB (reference: python/ray/tune/schedulers/hb_bohb.py).
+
+Same bracket/rung structure as the synchronous HyperBandScheduler; the BOHB
+variant changes the FILL ORDER: trials are admitted to the OLDEST
+still-filling bracket and the runner is steered to finish earlier brackets
+first, so low-budget rungs complete early and the ``BOHBSearcher``'s
+per-budget KDE models (search/bohb.py) get observations before the later,
+larger-budget brackets are suggested — the information flow BOHB's model
+fitting depends on.
+"""
+
+from __future__ import annotations
+
+from ray_tpu.tune.schedulers.hyperband import HyperBandScheduler
+
+
+class HyperBandForBOHB(HyperBandScheduler):
+    def choose_trial_to_run(self, pending):
+        # earliest bracket first (the base class picks any mid-rung trial):
+        # finishing bracket k's rungs before starting k+1 maximizes the
+        # observations available to the searcher's budget models
+        for b in self._brackets:
+            for t in pending:
+                if self._bracket_of.get(t) is b and t not in b.dropped:
+                    return t
+        return super().choose_trial_to_run(pending)

@@ -8,6 +8,7 @@ from ray_tpu.tune.search.searcher import (
     Repeater,
     Searcher,
 )
+from ray_tpu.tune.search.bohb import BOHBSearcher
 from ray_tpu.tune.search.tpe import TPESearcher
 
 __all__ = [
@@ -17,6 +18,7 @@ __all__ = [
     "ConcurrencyLimiter",
     "Repeater",
     "TPESearcher",
+    "BOHBSearcher",
     "OptunaSearch",
     "HyperOptSearch",
 ]

@@ -1,0 +1,65 @@
+"""BOHB searcher: Bayesian optimization over HyperBand budgets.
+
+reference surface: python/ray/tune/search/bohb/bohb_search.py — the
+reference wraps hpbandster's KDE machinery; this environment has no
+hpbandster, so BOHB's model (Falkner et al., ICML 2018) is built natively
+on the in-repo TPE: observations are bucketed by the BUDGET they were
+measured at (the scheduler's rung milestones in ``time_attr`` units), and
+suggestions come from the model of the LARGEST budget with enough
+observations — low-budget rungs bootstrap the model, high-budget rungs
+refine it, exactly BOHB's information flow.
+
+Pairs with ``HyperBandForBOHB`` (schedulers/bohb.py); works standalone too
+(every report lands in the budget bucket of its training_iteration).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ray_tpu.tune.search.tpe import TPESearcher
+
+
+class BOHBSearcher(TPESearcher):
+    def __init__(self, space: Optional[Dict[str, Any]] = None,
+                 metric: Optional[str] = None, mode: str = "min",
+                 time_attr: str = "training_iteration",
+                 n_startup: int = 8, gamma: float = 0.25,
+                 n_candidates: int = 24, seed: Optional[int] = None):
+        super().__init__(space, metric=metric, mode=mode, n_startup=n_startup,
+                         gamma=gamma, n_candidates=n_candidates, seed=seed)
+        self.time_attr = time_attr
+        # budget -> [(flat config, signed metric)], latest report per trial
+        self._by_budget: Dict[int, Dict[str, tuple]] = {}
+
+    # -- observation routing -------------------------------------------
+
+    def _record(self, trial_id: str, result: Dict[str, Any]):
+        flat = self._suggested.get(trial_id)
+        if flat is None or not result or self.metric not in result:
+            return
+        budget = int(result.get(self.time_attr, 1))
+        value = float(result[self.metric])
+        signed = value if self.mode == "max" else -value
+        self._by_budget.setdefault(budget, {})[trial_id] = (flat, signed)
+
+    def on_trial_result(self, trial_id: str, result: Dict[str, Any]) -> None:
+        self._record(trial_id, result)
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        if not error and result:
+            self._record(trial_id, result)
+        self._suggested.pop(trial_id, None)
+
+    # -- model selection ------------------------------------------------
+
+    def suggest(self, trial_id: str):
+        # fit on the largest budget with >= n_startup observations
+        # (BOHB's "use the highest-fidelity model that is trustworthy")
+        self._obs = []
+        for budget in sorted(self._by_budget, reverse=True):
+            obs = list(self._by_budget[budget].values())
+            if len(obs) >= self.n_startup:
+                self._obs = obs
+                break
+        return super().suggest(trial_id)
