@@ -171,11 +171,25 @@ class DreamerV3Config(AlgorithmConfig):
 
 class DreamerModel:
     """Pure functions over a params pytree; sizes are static attributes so
-    every method traces into fixed-shape XLA programs."""
+    every method traces into fixed-shape XLA programs.
 
-    def __init__(self, obs_dim: int, num_actions: int, cfg: DreamerV3Config):
+    Discrete action spaces use one-hot action inputs + a categorical actor
+    (reinforce gradients); continuous spaces (action_dim > 0) feed raw
+    action vectors to the RSSM and use a tanh-normal actor trained by
+    REPARAMETERIZED gradients through the imagined dynamics (the paper's
+    split: straight-through for discrete, backprop for continuous)."""
+
+    def __init__(self, obs_dim: int, num_actions: int, cfg: DreamerV3Config,
+                 action_dim: int = 0, action_low: float = -1.0,
+                 action_high: float = 1.0):
         self.obs_dim = obs_dim
         self.num_actions = num_actions
+        self.action_dim = action_dim
+        self.continuous = action_dim > 0
+        self.act_scale = (action_high - action_low) / 2.0
+        self.act_center = (action_high + action_low) / 2.0
+        # width of the action vector entering the sequence model
+        self.act_width = action_dim if self.continuous else num_actions
         self.cfg = cfg
         self.zdim = cfg.stoch * cfg.classes
         import jax.numpy as jnp
@@ -191,7 +205,7 @@ class DreamerModel:
         return {
             "enc": _mlp_init(next(ks), self.obs_dim, [c.units, c.units]),
             # GRU input: [z, onehot(a)] -> units, then gated update of h
-            "gru_in": _mlp_init(next(ks), self.zdim + self.num_actions, [c.units]),
+            "gru_in": _mlp_init(next(ks), self.zdim + self.act_width, [c.units]),
             "gru": {"lin": _dense_init(next(ks), c.units + c.deter, 3 * c.deter),
                     "norm": _norm_init(3 * c.deter)},
             "prior": _mlp_init(next(ks), c.deter, [c.units]),
@@ -205,8 +219,10 @@ class DreamerModel:
             "cont": _mlp_init(next(ks), feat, [c.units]),
             "cont_out": _dense_init(next(ks), c.units, 1),
             "actor": _mlp_init(next(ks), feat, [c.units, c.units]),
-            "actor_out": _dense_init(next(ks), c.units, self.num_actions,
-                                     zero=True),
+            "actor_out": _dense_init(
+                next(ks), c.units,
+                2 * self.action_dim if self.continuous else self.num_actions,
+                zero=True),
             "critic": _mlp_init(next(ks), feat, [c.units, c.units]),
             "critic_out": _dense_init(next(ks), c.units, c.num_bins, zero=True),
         }
@@ -275,6 +291,43 @@ class DreamerModel:
         value = symexp(jax.nn.softmax(logits, -1) @ self.bins)
         return logits, value
 
+    def action_input(self, a):
+        """Action as the RSSM input vector: one-hot (discrete) or raw."""
+        import jax
+
+        if self.continuous:
+            return a
+        return jax.nn.one_hot(a, self.num_actions)
+
+    def actor_dist(self, p, feat):
+        """Continuous actor: tanh-normal. Returns (mean, std) of the base
+        normal; actions are tanh(mean + std*eps) scaled to the bounds."""
+        import jax
+        import jax.numpy as jnp
+
+        raw = _dense(p["actor_out"], _mlp(p["actor"], feat))
+        mean, log_std = jnp.split(raw, 2, -1)
+        std = jax.nn.softplus(log_std) + 0.1
+        return mean, std
+
+    def sample_action(self, p, feat, key):
+        """Continuous: reparameterized tanh-normal sample (gradients flow
+        to the actor through the action). Returns (action, logp)."""
+        import jax
+        import jax.numpy as jnp
+
+        mean, std = self.actor_dist(p, feat)
+        eps = jax.random.normal(key, mean.shape)
+        pre = mean + std * eps
+        squashed = jnp.tanh(pre)
+        action = squashed * self.act_scale + self.act_center
+        base_logp = (-0.5 * (eps ** 2) - jnp.log(std)
+                     - 0.5 * jnp.log(2 * jnp.pi)).sum(-1)
+        # tanh + scale change of variables
+        logp = base_logp - jnp.log(
+            self.act_scale * (1.0 - squashed ** 2) + 1e-6).sum(-1)
+        return action, logp
+
     def actor_logits(self, p, feat):
         import jax
         import jax.numpy as jnp
@@ -294,7 +347,7 @@ class DreamerModel:
         mask = (1.0 - is_first.astype(jnp.float32))[..., None]
         h = h * mask
         z = z * mask
-        a = jax.nn.one_hot(prev_action, self.num_actions) * mask
+        a = self.action_input(prev_action) * mask
         h = self.gru_step(p, h, z, a)
         embed = self.encode(p, obs)
         post = self.post_logits(p, h, embed)
@@ -413,7 +466,10 @@ class DreamerV3Learner:
 
     def _imagine(self, params, h0, z0, key):
         """Roll the prior H steps under the actor; returns time-major
-        trajectories of features/actions/logits incl. the start state."""
+        trajectories of features/actions/policy-extras incl. the start
+        state. Discrete: extras are categorical logits (reinforce).
+        Continuous: extras are per-step logp of the REPARAMETERIZED sample,
+        whose gradient path through the dynamics trains the actor."""
         import jax
         import jax.numpy as jnp
 
@@ -423,20 +479,25 @@ class DreamerV3Learner:
         def step(carry, k):
             h, z = carry
             feat = m.feat(h, z)
-            logits = m.actor_logits(params, feat)
             ka, kz = jax.random.split(k)
-            a = jax.random.categorical(ka, logits, -1)
-            h2 = m.gru_step(params, h, z, jax.nn.one_hot(a, m.num_actions))
+            if m.continuous:
+                a, extra = m.sample_action(params, feat, ka)
+                a_in = a
+            else:
+                extra = m.actor_logits(params, feat)
+                a = jax.random.categorical(ka, extra, -1)
+                a_in = jax.nn.one_hot(a, m.num_actions)
+            h2 = m.gru_step(params, h, z, a_in)
             z2 = m._sample_st(m.prior_logits(params, h2), kz)
-            return (h2, z2), (a, logits, h2, z2)
+            return (h2, z2), (a, extra, h2, z2)
 
-        (_, _), (acts, logits, hs, zs) = jax.lax.scan(step, (h0, z0), keys)
+        (_, _), (acts, extras, hs, zs) = jax.lax.scan(step, (h0, z0), keys)
         feats = m.feat(jnp.concatenate([h0[None], hs], 0),
                        jnp.concatenate([z0[None], zs], 0))  # [H+1, N, F]
-        return feats, acts, logits
+        return feats, acts, extras
 
     def _ac_loss(self, ac_params, world_params, slow_critic, feats, acts,
-                 act_logits, ret_range):
+                 act_extras, ret_range):
         """Actor + critic losses over one imagined trajectory batch."""
         import jax
         import jax.numpy as jnp
@@ -452,7 +513,17 @@ class DreamerV3Learner:
         # trajectory weights: product of discounts of VISITED states
         w = jnp.cumprod(
             jnp.concatenate([jnp.ones_like(disc[:1]), disc[1:]], 0), 0)  # [H+1, N]
-        critic_logits, values = m.head_scalar(p, "critic", feats)  # [H+1, N]
+        # continuous actors train by BACKPROP THROUGH THE DYNAMICS: the
+        # return estimate must therefore read values through a stopped
+        # critic (else the actor objective would also push critic weights
+        # toward optimism), and the critic regression must read features
+        # through sg (else its loss backpropagates into the actor via the
+        # reparameterized actions). Discrete feats carry no actor gradient,
+        # so both reductions are no-ops there.
+        critic_in = sg(feats) if m.continuous else feats
+        critic_logits, _ = m.head_scalar(p, "critic", critic_in)
+        _, values = m.head_scalar(
+            {**world_params, **sg(ac_params)}, "critic", feats)
         _, slow_values = m.head_scalar(
             {**world_params, **slow_critic}, "critic", feats)
 
@@ -474,11 +545,20 @@ class DreamerV3Learner:
                      + (1 - c.return_normalization_decay) * (hi - lo))
         scale = jnp.maximum(1.0, new_range)
 
-        adv = sg((rets - values[:-1]) / scale)
-        logp = jnp.take_along_axis(act_logits, acts[..., None], -1)[..., 0]
-        entropy = -(jnp.exp(act_logits) * act_logits).sum(-1)
-        actor_loss = -(logp * adv + c.entropy_scale * entropy)
-        actor_loss = (actor_loss * sg(w[:-1])).mean()
+        if m.continuous:
+            # reparameterized objective: maximize normalized lambda-returns
+            # directly (gradients flow through imagined actions); entropy
+            # bonus from the stochastic -logp estimator
+            entropy = -act_extras                       # [H, N]
+            actor_loss = -(rets / scale + c.entropy_scale * entropy)
+            actor_loss = (actor_loss * sg(w[:-1])).mean()
+        else:
+            adv = sg((rets - values[:-1]) / scale)
+            logp = jnp.take_along_axis(
+                act_extras, acts[..., None], -1)[..., 0]
+            entropy = -(jnp.exp(act_extras) * act_extras).sum(-1)
+            actor_loss = -(logp * adv + c.entropy_scale * entropy)
+            actor_loss = (actor_loss * sg(w[:-1])).mean()
 
         target = twohot(symlog(sg(rets)), m.bins)
         ce = -(target * jax.nn.log_softmax(critic_logits[:-1], -1)).sum(-1)
@@ -641,14 +721,21 @@ class DreamerEnvRunner:
 
         cfg = DreamerV3Config(**model_spec["cfg"])
         self._envs = [make_env(env_creator) for _ in range(num_envs)]
-        self._model = DreamerModel(model_spec["obs_dim"],
-                                   model_spec["num_actions"], cfg)
+        self._model = DreamerModel(
+            model_spec["obs_dim"], model_spec["num_actions"], cfg,
+            action_dim=model_spec.get("action_dim", 0),
+            action_low=model_spec.get("action_low", -1.0),
+            action_high=model_spec.get("action_high", 1.0))
         self._T = rollout_fragment_length
         self._key = jax.random.PRNGKey(seed)
         n = num_envs
         self._h = np.zeros((n, cfg.deter), np.float32)
         self._z = np.zeros((n, self._model.zdim), np.float32)
-        self._prev_action = np.zeros((n,), np.int64)
+        if self._model.continuous:
+            self._prev_action = np.zeros(
+                (n, self._model.action_dim), np.float32)
+        else:
+            self._prev_action = np.zeros((n,), np.int64)
         self._pending = {
             "obs": np.stack([env.reset(seed=seed * 1000 + i)
                              for i, env in enumerate(self._envs)]),
@@ -663,10 +750,13 @@ class DreamerEnvRunner:
         def policy_step(params, h, z, prev_action, is_first, obs, key):
             h, z, post = self._model.observe_step(
                 params, h, z, prev_action, is_first, obs, key)
-            logits = self._model.actor_logits(
-                params, self._model.feat(h, z))
-            a = jax.random.categorical(
-                jax.random.fold_in(key, 1), logits, -1)
+            feat = self._model.feat(h, z)
+            ka = jax.random.fold_in(key, 1)
+            if self._model.continuous:
+                a, _ = self._model.sample_action(params, feat, ka)
+            else:
+                a = jax.random.categorical(
+                    ka, self._model.actor_logits(params, feat), -1)
             return h, z, a
 
         self._policy_step = jax.jit(policy_step)
@@ -676,9 +766,12 @@ class DreamerEnvRunner:
 
         n = len(self._envs)
         T = self._T
+        act_shape = ((T, n, self._model.action_dim)
+                     if self._model.continuous else (T, n))
+        act_dtype = np.float32 if self._model.continuous else np.int64
         rows = {
             "obs": np.zeros((T, n) + self._pending["obs"].shape[1:], np.float32),
-            "prev_action": np.zeros((T, n), np.int64),
+            "prev_action": np.zeros(act_shape, act_dtype),
             "reward": np.zeros((T, n), np.float32),
             "is_first": np.zeros((T, n), np.bool_),
             "cont": np.zeros((T, n), np.float32),
@@ -709,7 +802,9 @@ class DreamerEnvRunner:
                     self._prev_action[i] = 0
                     self._needs_reset[i] = False
                     continue
-                obs2, rew, done, _ = env.step(int(actions[i]))
+                act = (actions[i] if self._model.continuous
+                       else int(actions[i]))
+                obs2, rew, done, _ = env.step(act)
                 self._ep_return[i] += rew
                 next_pending["obs"][i] = obs2
                 next_pending["reward"][i] = rew
@@ -748,15 +843,19 @@ class DreamerV3(Algorithm):
         if config.env is None:
             raise ValueError("config.environment(env) is required")
         probe = make_env(config.env)
-        if probe.spec.continuous:
-            raise ValueError("DreamerV3 here supports discrete action spaces")
         self._spec = probe.spec
-        self._model = DreamerModel(probe.spec.obs_dim,
-                                   probe.spec.num_actions, config)
+        self._model = DreamerModel(
+            probe.spec.obs_dim, probe.spec.num_actions, config,
+            action_dim=probe.spec.action_dim,
+            action_low=probe.spec.action_low,
+            action_high=probe.spec.action_high)
         self._learner = DreamerV3Learner(self._model, config, seed=config.seed)
         model_spec = {
             "obs_dim": probe.spec.obs_dim,
             "num_actions": probe.spec.num_actions,
+            "action_dim": probe.spec.action_dim,
+            "action_low": probe.spec.action_low,
+            "action_high": probe.spec.action_high,
             "cfg": dataclasses.asdict(config),
         }
         self._runners = [

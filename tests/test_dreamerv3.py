@@ -108,6 +108,69 @@ def test_sequence_replay_contiguity():
         assert (diffs == 2).all(), row  # stride-2 within an env column
 
 
+def test_dreamerv3_continuous_pendulum_improves(cluster):
+    """Continuous control: tanh-normal actor trained by reparameterized
+    gradients through the dreamed dynamics (reference: dreamerv3 supports
+    continuous action spaces). Bar is modest on a CI box: the dreamed
+    policy must clearly beat its untrained self on Pendulum."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib import PendulumEnv
+    from ray_tpu.rllib.dreamerv3 import DreamerV3, DreamerV3Config
+
+    cfg = DreamerV3Config(
+        env="Pendulum-v1", num_env_runners=2, num_envs_per_runner=1,
+        rollout_fragment_length=64,
+        units=64, deter=128, stoch=8, classes=8, num_bins=41,
+        batch_size_B=8, batch_length_T=32, horizon_H=15,
+        world_model_lr=3e-4, actor_lr=3e-4, critic_lr=1e-4,
+        entropy_scale=1e-2,  # dense-torque task: a weak bonus collapses std
+        training_ratio=64.0, learning_starts=256, seed=0)
+    algo = DreamerV3(cfg)
+
+    def evaluate(n=4, seed=900):
+        model, params = algo._model, algo.get_policy_params()
+
+        @jax.jit
+        def step_fn(params, h, z, prev_a, first, obs, key):
+            h, z, _ = model.observe_step(params, h, z, prev_a, first, obs, key)
+            mean, _ = model.actor_dist(params, model.feat(h, z))
+            a = jnp.tanh(mean) * model.act_scale + model.act_center
+            return h, z, a
+
+        totals = []
+        for ep in range(n):
+            env = PendulumEnv()
+            obs = env.reset(seed=seed + ep)
+            h = jnp.zeros((1, model.cfg.deter))
+            z = jnp.zeros((1, model.zdim))
+            prev_a = jnp.zeros((1, 1))
+            first = jnp.ones((1,), bool)
+            key = jax.random.PRNGKey(ep)
+            done, total = False, 0.0
+            while not done:
+                key, sub = jax.random.split(key)
+                h, z, a = step_fn(params, h, z, prev_a, first,
+                                  jnp.asarray(obs)[None], sub)
+                obs, rew, done, _ = env.step(np.asarray(a)[0])
+                total += rew
+                prev_a = a
+                first = jnp.zeros((1,), bool)
+            totals.append(total)
+        return float(np.mean(totals))
+
+    try:
+        untrained = evaluate()
+        for _ in range(45):
+            last = algo.train()
+        trained = evaluate()
+        assert np.isfinite(last["world_loss"]), last
+        assert trained > untrained + 100, (untrained, trained, last)
+    finally:
+        algo.stop()
+
+
 def test_dreamerv3_learns_cartpole(cluster):
     from ray_tpu.rllib.dreamerv3 import DreamerV3
 
