@@ -1,0 +1,44 @@
+"""Core API walkthrough: tasks, actors, objects, placement groups.
+
+Run: python examples/core_walkthrough.py
+"""
+import ray_tpu
+
+
+@ray_tpu.remote
+def square(x):
+    return x * x
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self):
+        self.n = 0
+
+    def incr(self, k=1):
+        self.n += k
+        return self.n
+
+
+def main():
+    ray_tpu.init(num_cpus=2)
+    # tasks + objects
+    refs = [square.remote(i) for i in range(8)]
+    assert ray_tpu.get(refs) == [i * i for i in range(8)]
+    big = ray_tpu.put(list(range(10_000)))
+    ready, pending = ray_tpu.wait([big], num_returns=1)
+    assert ready and not pending
+    # actors
+    c = Counter.remote()
+    assert ray_tpu.get([c.incr.remote() for _ in range(5)])[-1] == 5
+    # placement group gang reservation
+    from ray_tpu.util.placement_group import placement_group
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    ray_tpu.get(pg.ready())
+    print("resources:", ray_tpu.cluster_resources())
+    ray_tpu.shutdown()
+    print("OK: core_walkthrough")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,24 @@
+"""ray_tpu.rllib: PPO on CartPole with EnvRunner actors.
+
+Run: python examples/rllib_ppo.py
+"""
+import ray_tpu
+from ray_tpu.rllib import PPOConfig
+
+
+def main():
+    ray_tpu.init(num_cpus=3)
+    algo = (PPOConfig(num_env_runners=2, rollout_fragment_length=100)
+            .environment("CartPole-v1")
+            .build())
+    for i in range(3):
+        result = algo.train()
+        print(f"iter {i}: reward_mean={result['episode_reward_mean']:.1f} "
+              f"episodes={result['episodes_total']:.0f}")
+    algo.stop()
+    ray_tpu.shutdown()
+    print("OK: rllib_ppo")
+
+
+if __name__ == "__main__":
+    main()

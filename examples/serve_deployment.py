@@ -1,0 +1,37 @@
+"""ray_tpu.serve: deploy a model behind HTTP with autoscaled replicas.
+
+Run: python examples/serve_deployment.py
+"""
+import json
+import urllib.request
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@serve.deployment(num_replicas=2, ray_actor_options={"num_cpus": 0.1})
+class Doubler:
+    def __call__(self, request):
+        return {"doubled": request["x"] * 2}
+
+
+def main():
+    ray_tpu.init(num_cpus=2)
+    handle = serve.run(Doubler.bind(), name="app")
+    # direct handle call
+    assert handle.remote({"x": 21}).result(timeout_s=60)["doubled"] == 42
+    # HTTP ingress
+    host, port = serve.start_http_proxy(port=0)
+    serve.add_route("/app", handle)
+    req = urllib.request.Request(
+        f"http://{host}:{port}/app", data=json.dumps({"x": 4}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        assert json.loads(resp.read())["doubled"] == 8
+    serve.shutdown()
+    ray_tpu.shutdown()
+    print("OK: serve_deployment")
+
+
+if __name__ == "__main__":
+    main()

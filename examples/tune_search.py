@@ -1,0 +1,37 @@
+"""ray_tpu.tune: ASHA early stopping + TPE search over a toy objective.
+
+Run: python examples/tune_search.py
+"""
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune.schedulers import ASHAScheduler
+from ray_tpu.tune.search import ConcurrencyLimiter, TPESearcher
+
+
+def trainable(config):
+    for i in range(1, 8):
+        loss = (config["lr"] * 100 - 3) ** 2 + 1.0 / i
+        tune.report({"loss": loss, "training_iteration": i})
+
+
+def main():
+    ray_tpu.init(num_cpus=3)
+    space = {"lr": tune.loguniform(1e-4, 1e-1)}
+    tuner = tune.Tuner(
+        trainable,
+        param_space=space,
+        tune_config=tune.TuneConfig(
+            metric="loss", mode="min", num_samples=8,
+            search_alg=ConcurrencyLimiter(
+                TPESearcher(dict(space), metric="loss", mode="min",
+                            n_startup=4), max_concurrent=3),
+            scheduler=ASHAScheduler(metric="loss", mode="min", max_t=8)),
+    )
+    best = tuner.fit().get_best_result(metric="loss", mode="min")
+    print("best lr:", best.config["lr"], "loss:", best.metrics["loss"])
+    ray_tpu.shutdown()
+    print("OK: tune_search")
+
+
+if __name__ == "__main__":
+    main()
