@@ -13,7 +13,7 @@ def main():
     ds = (rdata.range(1000, parallelism=8)
           .map_batches(lambda b: {"id": b["id"], "bucket": b["id"] % 10})
           .filter(lambda row: row["id"] % 2 == 0))
-    counts = ds.groupby("bucket").count().to_pylist()
+    counts = ds.groupby("bucket").count().take_all()
     count_col = next(c for c in counts[0] if c != "bucket")
     assert sum(c[count_col] for c in counts) == 500
     out = tempfile.mkdtemp()

@@ -31,7 +31,7 @@ import json
 import posixpath
 import struct
 import zlib
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 import pyarrow as pa
@@ -167,7 +167,9 @@ def _bq_default_transport(method: str, url: str,
 
     from ray_tpu.autoscaler.gce_tpu_provider import _metadata_token
 
-    data = json.dumps(body).encode() if body is not None else None
+    # default=str: datetime/date/Decimal cells serialize as their string
+    # forms (the REST API parses those); bytes are base64'd by the caller.
+    data = json.dumps(body, default=str).encode() if body is not None else None
     req = urllib.request.Request(url, data=data, method=method, headers={
         "Authorization": f"Bearer {_metadata_token()}",
         "Content-Type": "application/json",
@@ -261,10 +263,24 @@ def write_block_bigquery(block: pa.Table, project: str, dataset: str,
     ds, _, table = dataset.partition(".")
     url = (f"{_BQ_API}/projects/{project}/datasets/{ds}/tables/{table}"
            "/insertAll")
+    def _cell(v):
+        # BYTES travel base64-encoded in the REST JSON convention; recurse so
+        # bytes nested in list/struct cells never reach json.dumps's
+        # default=str (which would store a Python repr, not the payload)
+        if isinstance(v, bytes):
+            import base64
+
+            return base64.b64encode(v).decode("ascii")
+        if isinstance(v, list):
+            return [_cell(x) for x in v]
+        if isinstance(v, dict):
+            return {k: _cell(x) for k, x in v.items()}
+        return v
+
     rows = block.to_pylist()
     for i in range(0, len(rows), 500):
         resp = transport("POST", url, {"rows": [
-            {"json": {k: v for k, v in r.items()}}
+            {"json": {k: _cell(v) for k, v in r.items()}}
             for r in rows[i:i + 500]]})
         if resp.get("insertErrors"):
             raise RuntimeError(f"BigQuery insert errors: {resp['insertErrors'][:3]}")
@@ -408,7 +424,7 @@ def write_block_sql(block: pa.Table, table: str, connection_factory,
     return table
 
 
-def write_parquet_named(block: pa.Table, dir_path: str, name: str) -> str:
+def write_parquet_named(block: pa.Table, dir_path: str, name: str) -> Tuple[str, int]:
     """Write one parquet file under an exact name (local or fsspec URI) and
     return (path, size). Table-format sinks need commit-unique names — the
     indexed part-N names of write_block_parquet would collide across
@@ -450,8 +466,20 @@ def _delta_active_files(table_path: str) -> List[Dict[str, Any]]:
         with _open(ckpt_path, "rb") as f:
             ckpt = json.loads(f.read())
         v = int(ckpt["version"])
-        table = _read_parquet_at(
-            _join(log_dir, f"{v:020d}.checkpoint.parquet"))
+        parts = ckpt.get("parts")
+        if parts:
+            # multi-part checkpoint (Spark writes these for large tables):
+            # N.checkpoint.M.P.parquet, one file per 1-based part index
+            part_tables = [
+                _read_parquet_at(_join(
+                    log_dir,
+                    f"{v:020d}.checkpoint.{i:010d}.{int(parts):010d}.parquet"))
+                for i in range(1, int(parts) + 1)
+            ]
+            table = pa.concat_tables(part_tables)
+        else:
+            table = _read_parquet_at(
+                _join(log_dir, f"{v:020d}.checkpoint.parquet"))
         for row in table.to_pylist():
             add = row.get("add")
             if add and add.get("path"):

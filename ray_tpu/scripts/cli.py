@@ -168,16 +168,37 @@ def cmd_start(args) -> int:
         while not stop["flag"]:
             time.sleep(0.5)
     finally:
+        # Hard deadline on teardown: a hung shutdown (stuck worker join, dead
+        # RPC peer) must never leak this daemon — the round-3 audit found one
+        # alive 40+ min after its `stop`. The session file is unlinked FIRST
+        # so a watchdog exit can't strand a live-looking session record.
+        try:
+            session_file.unlink()
+        except OSError:
+            pass
+        import threading
+
+        def _watchdog_fire():
+            # Take the worker subprocesses down too: they share this group
+            # when we are the (daemonized) group leader. A plain os._exit
+            # would orphan them — the leak class this watchdog exists for.
+            try:
+                if os.getpgid(0) == os.getpid():
+                    os.killpg(0, signal.SIGKILL)
+            except OSError:
+                pass
+            os._exit(1)
+
+        killer = threading.Timer(20.0, _watchdog_fire)
+        killer.daemon = True
+        killer.start()
         for e in extra:
             try:
                 e.shutdown()
             except Exception:  # noqa: BLE001
                 pass
         node.shutdown()
-        try:
-            session_file.unlink()
-        except OSError:
-            pass
+        killer.cancel()
     return 0
 
 
@@ -237,19 +258,52 @@ def _print_started(info):
 
 
 def cmd_stop(args) -> int:
-    n = 0
+    """SIGTERM every session pid, wait for confirmed death, escalate to
+    SIGKILL of the whole process group (daemons are session leaders, so the
+    group kill also reaps worker subprocesses that outlived their raylet)."""
+    victims = []
     for f, info in _live_sessions():
         try:
             os.kill(info["pid"], signal.SIGTERM)
-            n += 1
+            victims.append((f, info["pid"]))
             print(f"stopped pid {info['pid']} ({'head' if info.get('head') else 'worker'})")
         except OSError:
             pass
-    # give nodes a moment to unlink their session files
-    deadline = time.monotonic() + 10
-    while _live_sessions() and time.monotonic() < deadline:
+    # wait for death, not just session-file unlink: the round-3 audit found a
+    # daemon that outlived a clean-exiting `stop` by 40+ minutes
+    pending = {pid: f for f, pid in victims}
+    deadline = time.monotonic() + 30
+    while pending and time.monotonic() < deadline:
+        for pid in list(pending):
+            try:
+                os.kill(pid, 0)
+            except OSError:
+                f = pending.pop(pid)
+                try:
+                    f.unlink()
+                except OSError:
+                    pass
         time.sleep(0.2)
-    if not n:
+    for pid, f in pending.items():
+        print(f"pid {pid} ignored SIGTERM for 30s; killing")
+        try:
+            # Group-kill only daemonized nodes (start_new_session=True makes
+            # them their own group leader); a `--block` node shares its
+            # caller's group and a killpg would take out innocent siblings.
+            if os.getpgid(pid) == pid:
+                os.killpg(pid, signal.SIGKILL)
+            else:
+                os.kill(pid, signal.SIGKILL)
+        except OSError:
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except OSError:
+                pass
+        try:
+            f.unlink()
+        except OSError:
+            pass
+    if not victims:
         print("no running nodes found")
     return 0
 

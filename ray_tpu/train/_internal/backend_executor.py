@@ -110,7 +110,7 @@ class BackendExecutor:
             strategy=self._scaling.placement_strategy,
             tpu_slice=self._scaling.tpu_slice,
         )
-        if not pg.ready(timeout=120.0):
+        if not pg.wait(timeout_seconds=120.0):
             raise TrainingFailedError(
                 f"placement group with {num_workers}x{resources} bundles "
                 "did not become ready within 120s (insufficient cluster resources?)"

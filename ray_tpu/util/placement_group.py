@@ -27,11 +27,33 @@ class PlacementGroup:
     def bundle_count(self) -> int:
         return len(self.bundle_specs)
 
-    def ready(self, timeout: Optional[float] = None) -> bool:
+    def ready(self):
+        """ObjectRef that resolves to this PG once all bundles are reserved.
+
+        reference parity (python/ray/util/placement_group.py:146-164):
+        ``ray_tpu.get(pg.ready())`` blocks until placement succeeds. Use
+        :meth:`wait` for the boolean/polling form.
+        """
+        import ray_tpu
+
+        pg = self
+
+        @ray_tpu.remote(num_cpus=0)
+        def _pg_ready():
+            if not pg.wait():
+                raise RuntimeError(
+                    f"placement group {pg.id.hex()} was removed before "
+                    "placement completed")
+            return pg
+
+        return _pg_ready.remote()
+
+    def wait(self, timeout_seconds: Optional[float] = None) -> bool:
+        deadline = (None if timeout_seconds is None
+                    else time.monotonic() + timeout_seconds)
         from ray_tpu._private.worker import get_global_worker
 
         w = get_global_worker()
-        deadline = None if timeout is None else time.monotonic() + timeout
         while True:
             info = w.gcs.call("GetPlacementGroup", {"pg_id": self.id})
             if info is not None and info["state"] == "CREATED":
@@ -41,9 +63,6 @@ class PlacementGroup:
             if deadline is not None and time.monotonic() > deadline:
                 return False
             time.sleep(0.02)
-
-    def wait(self, timeout_seconds: Optional[float] = None) -> bool:
-        return self.ready(timeout=timeout_seconds)
 
     def bundle_nodes(self):
         from ray_tpu._private.worker import get_global_worker

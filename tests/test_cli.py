@@ -26,13 +26,29 @@ def _run(tmp_path, *argv, timeout=120, check=True):
     return p
 
 
+def _assert_dead(pid, what, grace=15):
+    import time
+
+    deadline = time.monotonic() + grace
+    while time.monotonic() < deadline:
+        try:
+            os.kill(pid, 0)
+        except OSError:
+            return
+        time.sleep(0.3)
+    raise AssertionError(f"{what} pid {pid} still alive after stop")
+
+
 @pytest.fixture
 def head(tmp_path):
     out = _run(tmp_path, "start", "--head", "--num-cpus", "4").stdout
     addr = [ln.split(": ", 1)[1] for ln in out.splitlines()
             if ln.strip().startswith("address:")][0]
+    pid = int(out.split("pid ", 1)[1].split(")")[0])
     yield tmp_path, addr
     _run(tmp_path, "stop", timeout=60)
+    # `stop` exiting 0 is not proof of death (round-3 audit: leaked daemon)
+    _assert_dead(pid, "head")
 
 
 def test_start_status_list_stop(head):
@@ -69,13 +85,4 @@ def test_stop_kills_node(tmp_path):
     assert sessions
     pid = json.loads(sessions[0].read_text())["pid"]
     _run(tmp_path, "stop", timeout=60)
-    import time
-
-    deadline = time.monotonic() + 15
-    while time.monotonic() < deadline:
-        try:
-            os.kill(pid, 0)
-        except OSError:
-            return  # dead
-        time.sleep(0.3)
-    raise AssertionError(f"head pid {pid} still alive after stop")
+    _assert_dead(pid, "head")

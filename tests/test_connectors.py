@@ -115,6 +115,20 @@ def test_bigquery_write(cluster):
     assert inserted[0] == {"json": {"id": 0}}
 
 
+def test_bigquery_write_bytes_base64(cluster):
+    """BYTES cells travel base64-encoded (the REST JSON convention);
+    datetimes survive the default transport's json.dumps via default=str."""
+    import base64
+    import json as _json
+
+    transport, inserted = _make_bq_transport()
+    ds = rdata.from_items([{"id": 1, "blob": b"\x00\xffhi"}])
+    ds.write_bigquery("proj", "d.t", transport=transport)
+    assert inserted[0]["json"]["blob"] == base64.b64encode(b"\x00\xffhi").decode()
+    # the encoded row is json-serializable as the default transport requires
+    _json.dumps(inserted[0])
+
+
 # -- ClickHouse (mock transport) -------------------------------------------
 
 
@@ -266,6 +280,26 @@ def test_delta_partition_values_and_checkpoint(cluster, tmp_path):
     rows = sorted(rdata.read_delta(str(table)).take_all(),
                   key=lambda r: r["id"])
     assert [r["p"] for r in rows] == ["x", "x", "y"]
+
+
+def test_delta_multipart_checkpoint(cluster, tmp_path):
+    """Spark writes large checkpoints split into parts
+    (N.checkpoint.M.P.parquet + a 'parts' field in _last_checkpoint)."""
+    table = tmp_path / "dl3"
+    log = table / "_delta_log"
+    log.mkdir(parents=True)
+    pq.write_table(pa.table({"id": [1, 2]}), table / "f1.parquet")
+    pq.write_table(pa.table({"id": [3]}), table / "f2.parquet")
+    part1 = pa.Table.from_pylist([
+        {"add": {"path": "f1.parquet", "size": 1}, "remove": None}])
+    part2 = pa.Table.from_pylist([
+        {"add": {"path": "f2.parquet", "size": 1}, "remove": None}])
+    pq.write_table(part1, log / f"{0:020d}.checkpoint.{1:010d}.{2:010d}.parquet")
+    pq.write_table(part2, log / f"{0:020d}.checkpoint.{2:010d}.{2:010d}.parquet")
+    (log / "_last_checkpoint").write_text(
+        json.dumps({"version": 0, "parts": 2}))
+    rows = sorted(r["id"] for r in rdata.read_delta(str(table)).take_all())
+    assert rows == [1, 2, 3]
 
 
 # -- Iceberg ----------------------------------------------------------------

@@ -140,6 +140,6 @@ def test_gcs_restart_resumes_pending_placement_group(tmp_path):
         # capacity arrives only after the restart; the restored scheduling
         # thread must pick it up
         cluster.add_node(num_cpus=1, resources={"gizmo": 1})
-        assert pg.ready(timeout=60)
+        assert pg.wait(timeout_seconds=60)
     finally:
         cluster.shutdown()

@@ -85,7 +85,7 @@ def test_state_api_actors_objects_workers(ray_start_regular):
     from ray_tpu.util.placement_group import placement_group
 
     pg = placement_group([{"CPU": 1}], strategy="PACK")
-    pg.ready(timeout=10)
+    pg.wait(timeout_seconds=10)
     pgs = list_placement_groups()
     assert len(pgs) == 1 and pgs[0]["state"] == "CREATED"
     del big
