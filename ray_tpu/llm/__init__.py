@@ -1,22 +1,28 @@
 """LLM toolkit: batch inference + serving on the framework's JAX engine.
 
 reference: python/ray/llm/ (~20.8k LoC) — batch Processor/stages and
-LLMServer deployments on vLLM.  Here the engine is framework-native
-(ray_tpu.llm.engine.JaxLLMEngine): KV-cache decode with continuous
-batching, jitted prefill/decode, mesh-based parallelism degrees.
+LLMServer deployments on vLLM.  Here the engine is framework-native:
+KV-cache decode with continuous batching, jitted prefill/decode, mesh-based
+parallelism degrees.  Two cache layouts behind ``make_engine``:
+PagedJaxLLMEngine (block-pool KV, chunked prefill, prefix caching — the
+default) and JaxLLMEngine (static per-slot cache).
 """
 
 from ray_tpu.llm.batch import Processor, ProcessorConfig, build_llm_processor
 from ray_tpu.llm.config import GenerationConfig, LLMConfig
-from ray_tpu.llm.engine import JaxLLMEngine
+from ray_tpu.llm.engine import JaxLLMEngine, make_engine
+from ray_tpu.llm.paged import BlockManager, PagedJaxLLMEngine
 from ray_tpu.llm.lora import LoRAConfig, LoRAManager, init_lora, merge_lora
 from ray_tpu.llm.openai_api import ByteTokenizer, OpenAICompatServer, build_openai_app
 from ray_tpu.llm.serve import LLMServer, build_llm_deployment
 
 __all__ = [
+    "BlockManager",
     "GenerationConfig",
     "JaxLLMEngine",
     "LLMConfig",
+    "PagedJaxLLMEngine",
+    "make_engine",
     "LLMServer",
     "LoRAConfig",
     "LoRAManager",

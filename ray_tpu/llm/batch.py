@@ -30,9 +30,9 @@ class _EngineStage:
 
     def __init__(self, llm_config: LLMConfig, max_new_tokens: int,
                  temperature: float):
-        from ray_tpu.llm.engine import JaxLLMEngine
+        from ray_tpu.llm.engine import make_engine
 
-        self._engine = JaxLLMEngine(llm_config)
+        self._engine = make_engine(llm_config)
         self._gen = GenerationConfig(max_new_tokens=max_new_tokens,
                                      temperature=temperature)
 

@@ -33,6 +33,20 @@ class LLMConfig:
     # 1 = sync every token (lowest streaming latency).
     decode_chunk: int = 8
     max_seq_len: Optional[int] = None  # default: model_config.max_seq_len
+    # --- KV cache layout (reference capability boundary: paged attention /
+    # chunked prefill / prefix caching come from vLLM engine_kwargs,
+    # vllm_models.py:177-186; here the engine provides them natively) ---
+    # "paged": block-pool cache, HBM ∝ actual request lengths, memory-based
+    # admission, chunked prefill, prefix caching. "static": per-slot
+    # max_seq_len cache (lowest bookkeeping overhead for tiny batches).
+    kv_cache: str = "paged"
+    block_size: int = 16
+    # pool size in blocks; None → half the HBM the static cache would use
+    num_blocks: Optional[int] = None
+    # prompt tokens prefilled per step (multiple of block_size); long
+    # prompts interleave with decode instead of stalling it
+    prefill_chunk: int = 256
+    enable_prefix_caching: bool = True
     # parallelism degrees (mesh axes; the vllm_models.py:177-186 analog)
     tensor_parallel_size: int = 1
     data_parallel_size: int = 1

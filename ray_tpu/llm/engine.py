@@ -42,6 +42,10 @@ from ray_tpu.ops.rope import rope_frequencies
 
 # stop-token ids travel to the device as a fixed-width padded row per slot
 _MAX_STOP_IDS = 8
+# top-k sampling cap: the kth threshold comes from lax.top_k(logits, 64)
+# instead of a full [B, V] sort — the sort was milliseconds per decode step
+# at V=32k on TPU, the top-64 is microseconds
+_MAX_TOP_K = 64
 
 
 @dataclasses.dataclass
@@ -65,12 +69,49 @@ def _sample(logits, key, temps, top_ks):
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     t = jnp.maximum(temps, 1e-6)[:, None]
     scaled = logits / t
-    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
-    idx = jnp.clip(top_ks - 1, 0, logits.shape[-1] - 1)
-    kth = jnp.take_along_axis(sorted_desc, idx[:, None], axis=-1)
+    # kth-largest via a capped top-k (not a full [B, V] sort — V=32k sorts
+    # cost milliseconds per step on TPU; see _MAX_TOP_K)
+    kmax = min(_MAX_TOP_K, logits.shape[-1])
+    topv, _ = jax.lax.top_k(scaled, kmax)
+    idx = jnp.clip(top_ks - 1, 0, kmax - 1)
+    kth = jnp.take_along_axis(topv, idx[:, None], axis=-1)
     masked = jnp.where((top_ks[:, None] > 0) & (scaled < kth), -1e30, scaled)
     sampled = jax.random.categorical(key, masked, axis=-1).astype(jnp.int32)
     return jnp.where(temps <= 0.0, greedy, sampled)
+
+
+def build_tp_mesh(cfg, tp: int):
+    """Validate the TP degree and build a `tensor`-axis mesh over tp
+    devices; TP=1 stays mesh-free (single-device fast path)."""
+    if tp <= 1:
+        return None
+    devices = jax.devices()
+    if len(devices) < tp:
+        raise ValueError(
+            f"tensor_parallel_size={tp} but only {len(devices)} visible "
+            f"device(s) — a TP engine must never silently compute on one "
+            f"chip while reserving {tp}")
+    for name, dim in (("n_heads", cfg.n_heads), ("n_kv_heads", cfg.n_kv_heads),
+                      ("ffn_dim", cfg.ffn_dim), ("vocab_size", cfg.vocab_size)):
+        if dim % tp:
+            raise ValueError(
+                f"tensor_parallel_size={tp} does not divide model "
+                f"{name}={dim}")
+    from ray_tpu.parallel.mesh import MeshSpec
+
+    return MeshSpec(tensor=tp).build(devices[:tp])
+
+
+def make_engine(config: "LLMConfig", params=None, *, key=None):
+    """Engine factory: ``config.kv_cache`` picks paged (default) or static."""
+    if config.kv_cache == "paged":
+        from ray_tpu.llm.paged import PagedJaxLLMEngine
+
+        return PagedJaxLLMEngine(config, params, key=key)
+    if config.kv_cache == "static":
+        return JaxLLMEngine(config, params, key=key)
+    raise ValueError(
+        f"kv_cache must be 'paged' or 'static' (got {config.kv_cache!r})")
 
 
 class JaxLLMEngine:
@@ -144,26 +185,7 @@ class JaxLLMEngine:
         self._write_slot = jax.jit(llama.write_cache_slot, donate_argnums=0)
 
     def _build_tp_mesh(self, tp: int):
-        """Validate the TP degree and build a `tensor`-axis mesh over tp
-        devices; TP=1 stays mesh-free (single-device fast path)."""
-        if tp <= 1:
-            return None
-        cfg = self.cfg
-        devices = jax.devices()
-        if len(devices) < tp:
-            raise ValueError(
-                f"tensor_parallel_size={tp} but only {len(devices)} visible "
-                f"device(s) — a TP engine must never silently compute on one "
-                f"chip while reserving {tp}")
-        for name, dim in (("n_heads", cfg.n_heads), ("n_kv_heads", cfg.n_kv_heads),
-                          ("ffn_dim", cfg.ffn_dim), ("vocab_size", cfg.vocab_size)):
-            if dim % tp:
-                raise ValueError(
-                    f"tensor_parallel_size={tp} does not divide model "
-                    f"{name}={dim}")
-        from ray_tpu.parallel.mesh import MeshSpec
-
-        return MeshSpec(tensor=tp).build(devices[:tp])
+        return build_tp_mesh(self.cfg, tp)
 
     # -- jitted programs ------------------------------------------------
 
@@ -219,6 +241,10 @@ class JaxLLMEngine:
             raise ValueError(
                 f"at most {_MAX_STOP_IDS} stop_token_ids supported "
                 f"(got {len(gen.stop_token_ids)})")
+        if gen.top_k > _MAX_TOP_K:
+            raise ValueError(
+                f"top_k is capped at {_MAX_TOP_K} (got {gen.top_k}) — the "
+                "kth threshold comes from a fixed-width lax.top_k")
         if len(prompt) + gen.max_new_tokens > self.max_seq:
             raise ValueError(
                 f"prompt ({len(prompt)}) + max_new_tokens ({gen.max_new_tokens})"
@@ -273,11 +299,12 @@ class JaxLLMEngine:
             req.slot = -1
             self._dirty = True  # device mirrors stale: slot freed
 
-    def step(self) -> Dict[int, List[int]]:
+    def step(self, decode: bool = True) -> Dict[int, List[int]]:
         """Admit pending, then advance every active slot by up to
         ``config.decode_chunk`` tokens in one device program (multi-step
         scheduling; slots hitting a stop/budget mid-chunk deactivate
         in-program). decode_chunk=1 recovers per-token stepping.
+        ``decode=False`` runs admission/prefill only (ramp control).
 
         Returns {request_id: [tokens emitted this step]}.
         """
@@ -288,7 +315,7 @@ class JaxLLMEngine:
             self._admit_locked()
             active = [s for s in range(self.max_batch)
                       if self._slot_req[s] is not None]
-            if active:
+            if active and decode:
                 if self._dirty:
                     # slot transition since last chunk: refresh the device
                     # mirrors from host truth — the ONLY uploads in the loop

@@ -1,0 +1,529 @@
+"""Paged-KV LLM engine: block-table cache, chunked prefill, prefix caching.
+
+The reference gets paged attention / chunked prefill / prefix caching by
+delegating serving to vLLM (reference: llm/_internal/serve/deployments/llm/
+vllm/vllm_models.py:177-186 passes engine_kwargs straight through); a
+TPU-native rebuild provides the equivalent itself:
+
+  - the KV cache is a POOL of fixed-size HBM blocks shared by every request
+    (`models/llama.py init_paged_kv_cache`); a request's HBM cost is
+    proportional to its ACTUAL length, not max_seq — admission is
+    memory-based (free blocks), not slot-count
+  - the device sees a padded block TABLE [B, W] per decode chunk, W bucketed
+    to the max blocks any active slot uses: short batches read a SMALLER
+    attention span than the static engine ever could
+  - long prompts prefill in `prefill_chunk`-token pieces interleaved with
+    decode chunks, so one long prompt never stalls the running batch
+    (`models/llama.py prefill_chunk_paged` reads earlier chunks back from
+    the pool — no growing inter-chunk state)
+  - full prompt blocks are chain-hashed and shared across requests
+    (refcounted; matches capped at plen-1 so sampling always has a logit)
+  - pool exhaustion preempts the youngest running request by RECOMPUTE:
+    its blocks are freed and it requeues with prompt+generated as the new
+    prompt (emitted tokens are never re-emitted)
+
+All device programs are static-shape (jit cache keyed on the (B, W, C)
+buckets); block gathers/scatters are XLA gather/scatter on the block axis.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import math
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.llm.config import GenerationConfig, LLMConfig
+from ray_tpu.llm.engine import _MAX_STOP_IDS, _MAX_TOP_K, _Request, _sample
+from ray_tpu.models import llama
+from ray_tpu.ops.rope import rope_frequencies
+
+
+class BlockManager:
+    """Host-side allocator + prefix cache over the device block pool."""
+
+    def __init__(self, num_blocks: int, block_size: int,
+                 prefix_caching: bool = True):
+        self.num_blocks = num_blocks
+        self.bs = block_size
+        self.prefix_caching = prefix_caching
+        # block 0 is the SINK: inactive decode slots' zero-padded table rows
+        # make the device scatter land there, so it is never allocated —
+        # a live request's data can never be corrupted by an idle slot
+        # insertion-ordered free set: oldest-freed reused first, so cached
+        # (freed but hash-registered) blocks survive as long as possible
+        self.free: "collections.OrderedDict[int, None]" = collections.OrderedDict(
+            (i, None) for i in range(1, num_blocks))
+        self.ref = [0] * num_blocks
+        self.hash_of: Dict[int, int] = {}   # block -> chain hash
+        self.by_hash: Dict[int, int] = {}   # chain hash -> block
+
+    def num_free(self) -> int:
+        return len(self.free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        if n > len(self.free):
+            return None
+        out = []
+        for _ in range(n):
+            b, _ = self.free.popitem(last=False)
+            h = self.hash_of.pop(b, None)  # repurposed: stale cache entry out
+            if h is not None and self.by_hash.get(h) == b:
+                del self.by_hash[h]
+            self.ref[b] = 1
+            out.append(b)
+        return out
+
+    def release(self, blocks: Sequence[int]):
+        for b in blocks:
+            self.ref[b] -= 1
+            assert self.ref[b] >= 0, f"double free of block {b}"
+            if self.ref[b] == 0:
+                # back to the free set but still hash-registered: a future
+                # match_prefix can revive it until alloc repurposes it
+                self.free[b] = None
+
+    def match_prefix(self, prompt: Sequence[int]) -> Tuple[List[int], int]:
+        """Longest run of cached full blocks covering < len(prompt) tokens
+        (the last token is always recomputed so sampling has a logit).
+        Matched blocks are ref'd for the caller."""
+        if not self.prefix_caching:
+            return [], 0
+        ids: List[int] = []
+        h: Optional[int] = None
+        limit = (len(prompt) - 1) // self.bs
+        for i in range(limit):
+            h = hash((h, tuple(prompt[i * self.bs:(i + 1) * self.bs])))
+            b = self.by_hash.get(h)
+            if b is None:
+                break
+            ids.append(b)
+        for b in ids:
+            if self.ref[b] == 0:
+                self.free.pop(b, None)  # revive a cached-free block
+            self.ref[b] += 1
+        return ids, len(ids) * self.bs
+
+    def register(self, prompt: Sequence[int], blocks: Sequence[int]):
+        """Register this sequence's full PROMPT blocks for future sharing."""
+        if not self.prefix_caching:
+            return
+        h: Optional[int] = None
+        for i in range(len(prompt) // self.bs):
+            h = hash((h, tuple(prompt[i * self.bs:(i + 1) * self.bs])))
+            b = blocks[i]
+            if h not in self.by_hash and b not in self.hash_of:
+                self.by_hash[h] = b
+                self.hash_of[b] = h
+
+
+@dataclasses.dataclass
+class _PagedReq(_Request):
+    blocks: List[int] = dataclasses.field(default_factory=list)
+    prefill_pos: int = 0      # prompt tokens already in the pool
+    admitted_order: int = 0   # preemption picks the youngest
+
+
+def _bucket_pow2(n: int, lo: int = 1) -> int:
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+class PagedJaxLLMEngine:
+    """Drop-in engine with the static engine's API over a paged KV pool."""
+
+    def __init__(self, config: LLMConfig, params=None, *, key=None):
+        self.config = config
+        cfg = config.model_config
+        if cfg is None:
+            raise ValueError("LLMConfig.model_config is required")
+        self.cfg = cfg
+        self.max_batch = config.max_batch_size
+        self.max_seq = config.max_seq_len or cfg.max_seq_len
+        self.bs = config.block_size
+        if config.decode_chunk < 1:
+            raise ValueError(
+                f"decode_chunk must be >= 1 (got {config.decode_chunk})")
+        if config.prefill_chunk % self.bs:
+            raise ValueError(
+                f"prefill_chunk ({config.prefill_chunk}) must be a multiple "
+                f"of block_size ({self.bs})")
+        nb = config.num_blocks
+        if nb is None:
+            # default pool: half the HBM the static cache would have used —
+            # the demonstrable economics win; override via config.num_blocks
+            nb = max(4, (self.max_batch * self.max_seq) // (2 * self.bs))
+        self.num_blocks = nb
+        self.max_blocks_per_seq = math.ceil(self.max_seq / self.bs)
+        self.blocks = BlockManager(nb, self.bs, config.enable_prefix_caching)
+
+        if params is None:
+            params = llama.init_params(cfg, key or jax.random.PRNGKey(0))
+        self.params = params
+        cos, sin = rope_frequencies(cfg.head_dim, self.max_seq, cfg.rope_theta)
+        self._rope = (jnp.asarray(cos), jnp.asarray(sin))
+
+        from ray_tpu.llm.engine import build_tp_mesh
+
+        self.mesh = build_tp_mesh(cfg, config.tensor_parallel_size)
+        self.pool = llama.init_paged_kv_cache(cfg, nb, self.bs)
+        if self.mesh is not None:
+            from ray_tpu.parallel.mesh import shard_pytree
+
+            self.params = shard_pytree(
+                self.params, llama.inference_param_specs(cfg), self.mesh)
+            self.pool = shard_pytree(
+                self.pool, llama.paged_kv_cache_spec(), self.mesh)
+
+        # host slot state (mirrors the static engine)
+        self._slot_req: List[Optional[_PagedReq]] = [None] * self.max_batch
+        self._lengths = np.zeros(self.max_batch, np.int32)
+        self._next_tok = np.zeros(self.max_batch, np.int32)
+        self._slot_temp = np.zeros(self.max_batch, np.float32)
+        self._slot_topk = np.zeros(self.max_batch, np.int32)
+        self._dirty = True
+        self._d_next = self._d_lengths = self._d_active = None
+        self._d_temp = self._d_topk = None
+        self._d_remaining = self._d_stops = None
+        self._d_key = jax.random.PRNGKey(cfg.vocab_size + 1)
+        self._pending: "collections.deque[_PagedReq]" = collections.deque()
+        self._requests: Dict[int, _PagedReq] = {}
+        self._req_counter = 0
+        self._admit_counter = 0
+        self._lock = threading.Lock()
+
+        self._decode = jax.jit(self._decode_chunk_impl, donate_argnums=2,
+                               static_argnums=11)
+        self._prefill_chunk = jax.jit(self._prefill_chunk_impl,
+                                      donate_argnums=2)
+
+    # -- jitted programs ------------------------------------------------
+
+    def _decode_chunk_impl(self, params, tokens, pool, table, lengths, active,
+                           remaining, stops, key, temps, top_ks, n_steps):
+        """Multi-step paged decode (mirrors the static engine's program; the
+        host guarantees every active slot's table covers lengths + n_steps
+        tokens of appends)."""
+
+        def one(carry, _):
+            tokens, pool, lengths, active, remaining, key = carry
+            logits, pool = llama.decode_step_paged(
+                self.cfg, params, tokens, pool, table, lengths,
+                rope_cache=self._rope)
+            key, sub = jax.random.split(key)
+            ids = _sample(logits, sub, temps, top_ks)
+            emitted = jnp.where(active > 0, ids, -1)
+            lengths = lengths + active
+            remaining = remaining - active
+            hit_stop = (stops == ids[:, None]).any(-1)
+            done = (active > 0) & (hit_stop | (remaining <= 0)
+                                   | (lengths + 1 >= self.max_seq))
+            active = active * (1 - done.astype(active.dtype))
+            tokens = jnp.where(active > 0, ids, tokens)
+            return (tokens, pool, lengths, active, remaining, key), emitted
+
+        carry = (tokens, pool, lengths, active, remaining, key)
+        carry, emitted = jax.lax.scan(one, carry, None, length=n_steps)
+        tokens, pool, lengths, active, remaining, key = carry
+        return emitted, tokens, pool, lengths, active, remaining, key
+
+    def _prefill_chunk_impl(self, params, tokens, pool, table, p0,
+                            sample_idx, key, temp, top_k):
+        """One chunk; also samples the token at chunk-local position
+        ``sample_idx`` (the caller uses it only on the final chunk)."""
+        logits, pool = llama.prefill_chunk_paged(
+            self.cfg, params, tokens, pool, table, p0, rope_cache=self._rope)
+        key, sub = jax.random.split(key)
+        ids = _sample(logits[:, sample_idx], sub, temp, top_k)
+        return ids, pool, key
+
+    # -- request lifecycle ---------------------------------------------
+
+    def add_request(self, prompt: Sequence[int],
+                    gen: Optional[GenerationConfig] = None) -> int:
+        gen = gen or GenerationConfig()
+        if len(prompt) == 0:
+            raise ValueError("empty prompt")
+        if len(gen.stop_token_ids) > _MAX_STOP_IDS:
+            raise ValueError(
+                f"at most {_MAX_STOP_IDS} stop_token_ids supported "
+                f"(got {len(gen.stop_token_ids)})")
+        if gen.top_k > _MAX_TOP_K:
+            raise ValueError(
+                f"top_k is capped at {_MAX_TOP_K} (got {gen.top_k}) — the "
+                "kth threshold comes from a fixed-width lax.top_k")
+        if len(prompt) + gen.max_new_tokens > self.max_seq:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens ({gen.max_new_tokens})"
+                f" exceeds max_seq_len {self.max_seq}")
+        worst = math.ceil((len(prompt) + gen.max_new_tokens + 1) / self.bs)
+        if worst > self.num_blocks - 1:  # block 0 is the sink
+            raise ValueError(
+                f"request needs up to {worst} KV blocks but the pool has "
+                f"{self.num_blocks} — raise num_blocks or lower max_new_tokens")
+        with self._lock:
+            self._req_counter += 1
+            req = _PagedReq(self._req_counter, list(prompt), gen)
+            self._requests[req.request_id] = req
+            self._pending.append(req)
+            return req.request_id
+
+    def has_work(self) -> bool:
+        with self._lock:
+            return bool(self._pending) or any(
+                r is not None for r in self._slot_req)
+
+    # -- admission / prefill -------------------------------------------
+
+    def _admit_locked(self):
+        """Memory-based admission: a pending request enters when the pool
+        has blocks for its full (chunk-padded) prompt plus one decode block
+        — proportional to ACTUAL prompt length, never max_seq.  Reserving
+        the prompt up front (instead of chunk-by-chunk) makes the system
+        livelock-free: a mid-prefill request can never stall on allocation,
+        so every admitted request reaches the preemptible decode state."""
+        for slot in range(self.max_batch):
+            if not self._pending or self._slot_req[slot] is not None:
+                continue
+            req = self._pending[0]
+            shared, matched = self.blocks.match_prefix(req.prompt)
+            # chunks are block-aligned and the final one pads only to a block
+            # multiple, so prefill writes exactly ceil(rem/bs) blocks; +1 is
+            # the first decode write's spare
+            need = math.ceil((len(req.prompt) - matched) / self.bs) + 1
+            fresh = self.blocks.alloc(need)
+            if fresh is None:
+                self.blocks.release(shared)
+                return  # pool full: keep FIFO order, retry next step
+            self._pending.popleft()
+            req.slot = slot
+            req.blocks = shared + fresh
+            req.prefill_pos = matched
+            self._admit_counter += 1
+            req.admitted_order = self._admit_counter
+            self._slot_req[slot] = req
+
+    def _prefill_step_locked(self):
+        """Advance at most ONE chunk of ONE mid-prefill slot per step, so
+        prefill interleaves with decode instead of stalling it.  Blocks were
+        reserved at admission — no allocation can fail here."""
+        for slot in range(self.max_batch):
+            req = self._slot_req[slot]
+            if req is None or req.prefill_pos >= len(req.prompt):
+                continue
+            plen = len(req.prompt)
+            remaining = plen - req.prefill_pos
+            c = min(self.config.prefill_chunk, _pad_to(remaining, self.bs))
+            need = math.ceil((req.prefill_pos + c) / self.bs)
+            assert need <= len(req.blocks), (
+                f"prefill chunk not covered: need {need} blocks, "
+                f"have {len(req.blocks)} (admission reserve bug)")
+            p0 = req.prefill_pos
+            take = min(c, remaining)
+            tokens = np.zeros((1, c), np.int32)
+            tokens[0, :take] = req.prompt[p0:p0 + take]
+            w = _bucket_pow2(len(req.blocks))
+            table = np.zeros((1, w), np.int32)
+            table[0, :len(req.blocks)] = req.blocks
+            is_last = p0 + take >= plen
+            sample_idx = (plen - 1 - p0) if is_last else 0
+            ids, self.pool, self._d_key = self._prefill_chunk(
+                self.params, jnp.asarray(tokens), self.pool,
+                jnp.asarray(table), jnp.int32(p0), jnp.int32(sample_idx),
+                self._d_key,
+                jnp.asarray([req.gen.temperature], np.float32),
+                jnp.asarray([req.gen.top_k], np.int32))
+            req.prefill_pos = p0 + take
+            if is_last:
+                # trim chunk-padding blocks; decode's ensure pass re-allocates
+                keep = math.ceil(plen / self.bs)
+                if len(req.blocks) > keep:
+                    self.blocks.release(req.blocks[keep:])
+                    del req.blocks[keep:]
+                self.blocks.register(req.prompt, req.blocks)
+                first = int(ids[0])
+                self._lengths[slot] = plen
+                self._next_tok[slot] = first
+                self._slot_temp[slot] = req.gen.temperature
+                self._slot_topk[slot] = req.gen.top_k
+                self._dirty = True
+                self._emit_locked(req, first)
+            return  # one chunk per step
+
+    def _emit_locked(self, req: _PagedReq, token: int):
+        req.out_tokens.append(token)
+        if (token in req.gen.stop_token_ids
+                or len(req.out_tokens) >= req.gen.max_new_tokens
+                or self._lengths[req.slot] + 1 >= self.max_seq):
+            req.done = True
+            self._free_slot_locked(req)
+
+    def _free_slot_locked(self, req: _PagedReq):
+        self.blocks.release(req.blocks)
+        req.blocks = []
+        self._slot_req[req.slot] = None
+        self._lengths[req.slot] = 0
+        req.slot = -1
+        self._dirty = True
+
+    def _preempt_locked(self, exclude_slot: int = -1) -> bool:
+        """Evict the youngest decode-active request by recompute: free its
+        blocks, requeue with prompt+generated as the new prompt.  The OLDEST
+        active request is never evicted — it always wins block contention,
+        so it completes and the system makes progress (no preemption
+        livelock)."""
+        candidates = [r for r in self._slot_req
+                      if r is not None and r.slot != exclude_slot
+                      and r.prefill_pos >= len(r.prompt)]
+        if len(candidates) < 2:
+            return False  # never evict the sole (oldest) runner
+        oldest = min(c.admitted_order for c in candidates)
+        victim = max((c for c in candidates if c.admitted_order > oldest),
+                     key=lambda c: c.admitted_order, default=None)
+        if victim is None:
+            return False
+        victim.prompt = victim.prompt + victim.out_tokens
+        victim.prefill_pos = 0
+        self._free_slot_locked(victim)
+        victim.done = False
+        self._pending.appendleft(victim)
+        self._dirty = True
+        return True
+
+    # -- decode ---------------------------------------------------------
+
+    def _ensure_decode_blocks_locked(self, chunk: int) -> List[int]:
+        """Every decode-active slot's table must cover lengths + chunk + 1
+        appends before dispatch (allocation is host-side; the device program
+        is static). Returns the decode-active slot list."""
+        active = []
+        for s in range(self.max_batch):
+            req = self._slot_req[s]
+            if req is None or req.prefill_pos < len(req.prompt):
+                continue
+            while True:
+                need = math.ceil((int(self._lengths[s]) + chunk + 1) / self.bs)
+                need = min(need, self.max_blocks_per_seq)
+                deficit = need - len(req.blocks)
+                if deficit <= 0:
+                    active.append(s)
+                    break
+                fresh = self.blocks.alloc(deficit)
+                if fresh is not None:
+                    req.blocks.extend(fresh)
+                    active.append(s)
+                    break
+                if not self._preempt_locked():
+                    # can't evict anyone else; run without this slot rather
+                    # than deadlock (it keeps its blocks and retries)
+                    break
+                if self._slot_req[s] is None:
+                    break  # we ourselves were the youngest and got evicted
+        return [s for s in active if self._slot_req[s] is not None]
+
+    def _trim_locked(self):
+        """Return over-allocated chunk blocks (sequence stopped early)."""
+        for s in range(self.max_batch):
+            req = self._slot_req[s]
+            if req is None or req.prefill_pos < len(req.prompt):
+                continue
+            keep = max(1, math.ceil((int(self._lengths[s]) + 1) / self.bs))
+            if len(req.blocks) > keep:
+                self.blocks.release(req.blocks[keep:])
+                del req.blocks[keep:]
+
+    def step(self, decode: bool = True) -> Dict[int, List[int]]:
+        """One engine step: admit, one prefill chunk, one decode chunk.
+        ``decode=False`` runs admission/prefill only (ramp control)."""
+        emitted: Dict[int, List[int]] = {}
+        with self._lock:
+            before = {id(r): len(r.out_tokens)
+                      for r in self._requests.values()}
+            self._admit_locked()
+            self._prefill_step_locked()
+            chunk = self.config.decode_chunk
+            active = (self._ensure_decode_blocks_locked(chunk)
+                      if decode else [])
+            if active:
+                if self._dirty:
+                    self._refresh_mirrors_locked()
+                w = _bucket_pow2(max(len(self._slot_req[s].blocks)
+                                     for s in active))
+                table = np.zeros((self.max_batch, w), np.int32)
+                for s in active:
+                    blks = self._slot_req[s].blocks
+                    table[s, :len(blks)] = blks
+                (em_dev, self._d_next, self.pool, self._d_lengths,
+                 self._d_active, self._d_remaining, self._d_key) = \
+                    self._decode(
+                        self.params, self._d_next, self.pool,
+                        jnp.asarray(table), self._d_lengths, self._d_active,
+                        self._d_remaining, self._d_stops, self._d_key,
+                        self._d_temp, self._d_topk, chunk)
+                em = np.asarray(em_dev)
+                for t in range(em.shape[0]):
+                    for s in active:
+                        req = self._slot_req[s]
+                        if req is None:
+                            continue
+                        tok = int(em[t, s])
+                        if tok < 0:
+                            continue
+                        self._lengths[s] += 1
+                        self._next_tok[s] = tok
+                        self._emit_locked(req, tok)
+                self._trim_locked()
+            for req in list(self._requests.values()):
+                n0 = before.get(id(req), 0)
+                if len(req.out_tokens) > n0:
+                    emitted[req.request_id] = req.out_tokens[n0:]
+                if req.done:
+                    del self._requests[req.request_id]
+        return emitted
+
+    def _refresh_mirrors_locked(self):
+        decode_ready = [
+            0 if (r is None or r.prefill_pos < len(r.prompt)) else 1
+            for r in self._slot_req]
+        self._d_next = jnp.asarray(self._next_tok)
+        self._d_lengths = jnp.asarray(self._lengths)
+        self._d_active = jnp.asarray(np.array(decode_ready, np.int32))
+        self._d_temp = jnp.asarray(self._slot_temp)
+        self._d_topk = jnp.asarray(self._slot_topk)
+        remaining = np.zeros(self.max_batch, np.int32)
+        stops = np.full((self.max_batch, _MAX_STOP_IDS), -1, np.int32)
+        for s, r in enumerate(self._slot_req):
+            if r is not None and decode_ready[s]:
+                remaining[s] = r.gen.max_new_tokens - len(r.out_tokens)
+                for j, sid in enumerate(r.gen.stop_token_ids):
+                    stops[s, j] = sid
+        self._d_remaining = jnp.asarray(remaining)
+        self._d_stops = jnp.asarray(stops)
+        self._dirty = False
+
+    # -- sync convenience ----------------------------------------------
+
+    def generate(self, prompts: Sequence[Sequence[int]],
+                 gen: Optional[GenerationConfig] = None) -> List[List[int]]:
+        ids = [self.add_request(p, gen) for p in prompts]
+        results: Dict[int, List[int]] = {i: [] for i in ids}
+        waiting = set(ids)
+        while waiting and self.has_work():
+            emitted = self.step()
+            for rid, toks in emitted.items():
+                if rid in results:
+                    results[rid].extend(toks)
+            with self._lock:
+                waiting = {rid for rid in waiting if rid in self._requests}
+        return [results[i] for i in ids]
+
+
+def _pad_to(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m

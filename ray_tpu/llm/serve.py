@@ -28,10 +28,10 @@ class LLMServer:
 
     def __init__(self, llm_config: LLMConfig, params=None,
                  lora_adapters: Optional[Dict[str, Any]] = None):
-        from ray_tpu.llm.engine import JaxLLMEngine
+        from ray_tpu.llm.engine import make_engine
 
         self._config = llm_config
-        self._engine = JaxLLMEngine(llm_config, params)
+        self._engine = make_engine(llm_config, params)
         self._engines: Dict[Optional[str], Any] = {None: self._engine}
         self._engine_gen: Dict[Optional[str], int] = {None: 0}
         self._engine_order: list = []  # adapter LRU (base never evicted)
@@ -90,10 +90,10 @@ class LLMServer:
                     return wkey
             # build outside the lock: merged weights are owned solely by the
             # engine map (single LRU bounds HBM)
-            from ray_tpu.llm.engine import JaxLLMEngine
+            from ray_tpu.llm.engine import make_engine
             from ray_tpu.llm.lora import merge_lora
 
-            built = JaxLLMEngine(
+            built = make_engine(
                 self._config, merge_lora(self._engine.params,
                                          self._adapters[model]))
 

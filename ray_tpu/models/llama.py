@@ -454,6 +454,157 @@ def decode_step(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
     return logits, {"k": ks, "v": vs}
 
 
+# ---------------------------------------------------------------------------
+# Paged KV cache programs (reference capability boundary: the paged-attention
+# engine Ray LLM gets by delegating to vLLM, vllm_models.py:177-186 — here
+# TPU-native).  The cache is a POOL of fixed-size blocks
+# [L, num_blocks, block_size, kv, hd]; each sequence owns a host-side list of
+# block ids, shipped to the device as a padded block TABLE [B, W].  All shapes
+# static: W is bucketed, gathers/scatters are jnp advanced indexing (XLA
+# gather/scatter on the block axis), so the programs recompile only per
+# (B, W) bucket.  Sharding: the kv-head axis shards over "tensor" exactly as
+# the dense cache (kv_cache_spec), block/table axes replicated.
+# ---------------------------------------------------------------------------
+
+
+def init_paged_kv_cache(cfg: LlamaConfig, num_blocks: int, block_size: int,
+                        dtype=None) -> Dict[str, jnp.ndarray]:
+    """Block-pool KV cache shared by all sequences; HBM ∝ blocks in use."""
+    dtype = dtype or cfg.compute_dtype
+    shape = (cfg.n_layers, num_blocks, block_size, cfg.n_kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def paged_kv_cache_spec() -> Dict[str, P]:
+    spec = P(None, None, None, "tensor", None)
+    return {"k": spec, "v": spec}
+
+
+def _paged_attend(cfg: LlamaConfig, q, pk, pv, table, span_mask):
+    """GQA attention of q [B, T, nh, hd] against pooled KV gathered through a
+    block table [B, W] -> span W*bs.  span_mask [B, T, W*bs] True = visible."""
+    b, t = q.shape[:2]
+    bs = pk.shape[1]
+    group = cfg.n_heads // cfg.n_kv_heads
+    w = table.shape[1]
+    ck = pk[table].reshape(b, w * bs, cfg.n_kv_heads, cfg.head_dim)
+    cv = pv[table].reshape(b, w * bs, cfg.n_kv_heads, cfg.head_dim)
+    qg = q.reshape(b, t, cfg.n_kv_heads, group, cfg.head_dim)
+    scores = jnp.einsum("btkgd,bskd->bkgts", qg.astype(jnp.float32),
+                        ck.astype(jnp.float32)) / math.sqrt(cfg.head_dim)
+    scores = jnp.where(span_mask[:, None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    attn = jnp.einsum("bkgts,bskd->btkgd", probs, cv.astype(jnp.float32))
+    return attn.reshape(b, t, cfg.n_heads * cfg.head_dim)
+
+
+def decode_step_paged(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
+                      pool: Dict[str, jnp.ndarray], table: jnp.ndarray,
+                      lengths: jnp.ndarray,
+                      rope_cache: Optional[tuple] = None):
+    """One-token decode for every slot, KV in a paged pool.
+
+    tokens [B] int32; table [B, W] block ids covering each slot's sequence
+    (host guarantees coverage through position lengths[b]); lengths [B].
+    Returns (logits [B, V] fp32, updated pool).
+    """
+    if rope_cache is None:
+        cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+        cos, sin = jnp.asarray(cos), jnp.asarray(sin)
+    else:
+        cos, sin = rope_cache
+    b = tokens.shape[0]
+    bs = pool["k"].shape[2]
+    w = table.shape[1]
+    cdt = cfg.compute_dtype
+    bidx = jnp.arange(b)
+    cur_blk = table[bidx, lengths // bs]  # [B] physical block of the write
+    cur_off = lengths % bs
+    span_mask = (jnp.arange(w * bs)[None, None, :]
+                 <= lengths[:, None, None])  # [B, 1, W*bs]
+    x = jnp.take(params["embed"], tokens, axis=0).astype(cdt)
+
+    def body(x, inp):
+        lp, pk, pv = inp  # pk/pv: [NB, bs, kv, hd]
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        q = (h @ lp["wq"].astype(cdt)).reshape(b, 1, cfg.n_heads, cfg.head_dim)
+        k = (h @ lp["wk"].astype(cdt)).reshape(b, 1, cfg.n_kv_heads, cfg.head_dim)
+        v = (h @ lp["wv"].astype(cdt)).reshape(b, 1, cfg.n_kv_heads, cfg.head_dim)
+        q = apply_rope(q, cos, sin, positions=lengths[:, None])
+        k = apply_rope(k, cos, sin, positions=lengths[:, None])[:, 0]
+        pk = pk.at[cur_blk, cur_off].set(k.astype(pk.dtype))
+        pv = pv.at[cur_blk, cur_off].set(v[:, 0].astype(pv.dtype))
+        attn = _paged_attend(cfg, q, pk, pv, table, span_mask)[:, 0]
+        x = x + (attn.astype(cdt) @ lp["wo"].astype(cdt))
+        h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        ffn = (jax.nn.silu(h @ lp["w_gate"].astype(cdt))
+               * (h @ lp["w_up"].astype(cdt))) @ lp["w_down"].astype(cdt)
+        return x + ffn, (pk, pv)
+
+    x, (ks, vs) = lax.scan(body, x, (params["layers"], pool["k"], pool["v"]))
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = (x @ head.astype(cdt)).astype(jnp.float32)
+    return logits, {"k": ks, "v": vs}
+
+
+def prefill_chunk_paged(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
+                        pool: Dict[str, jnp.ndarray], table: jnp.ndarray,
+                        p0: jnp.ndarray,
+                        rope_cache: Optional[tuple] = None):
+    """Prefill ONE chunk of a single sequence into its pool blocks.
+
+    tokens [1, C] (C a multiple of block_size; tail garbage-padded — padded
+    positions write blocks the sequence owns and are masked by length
+    thereafter); p0 = global position of tokens[0, 0] (multiple of
+    block_size); table [1, W] covers positions [0, p0 + C).  Attention is
+    causal over the whole prefix: earlier chunks' KV is read back from the
+    pool, so chunked prefill needs no growing-activation state between
+    chunks (chunk compute is O(C * (p0 + C))).
+    Returns (logits [1, C, V] fp32, updated pool).
+    """
+    if rope_cache is None:
+        cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+        cos, sin = jnp.asarray(cos), jnp.asarray(sin)
+    else:
+        cos, sin = rope_cache
+    b, c = tokens.shape
+    bs = pool["k"].shape[2]
+    w = table.shape[1]
+    cdt = cfg.compute_dtype
+    positions = p0 + jnp.arange(c)  # [C] global positions
+    # the C/bs physical blocks this chunk writes
+    chunk_blocks = lax.dynamic_slice(table[0], (p0 // bs,), (c // bs,))
+    span_mask = (jnp.arange(w * bs)[None, None, :]
+                 <= positions[None, :, None])  # [1, C, W*bs] causal
+    x = jnp.take(params["embed"], tokens, axis=0).astype(cdt)
+
+    def body(x, inp):
+        lp, pk, pv = inp
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        q = (h @ lp["wq"].astype(cdt)).reshape(b, c, cfg.n_heads, cfg.head_dim)
+        k = (h @ lp["wk"].astype(cdt)).reshape(b, c, cfg.n_kv_heads, cfg.head_dim)
+        v = (h @ lp["wv"].astype(cdt)).reshape(b, c, cfg.n_kv_heads, cfg.head_dim)
+        q = apply_rope(q, cos, sin, positions=positions[None, :])
+        k = apply_rope(k, cos, sin, positions=positions[None, :])
+        pk = pk.at[chunk_blocks].set(
+            k[0].reshape(c // bs, bs, cfg.n_kv_heads, cfg.head_dim).astype(pk.dtype))
+        pv = pv.at[chunk_blocks].set(
+            v[0].reshape(c // bs, bs, cfg.n_kv_heads, cfg.head_dim).astype(pv.dtype))
+        attn = _paged_attend(cfg, q, pk, pv, table, span_mask)
+        x = x + (attn.astype(cdt) @ lp["wo"].astype(cdt))
+        h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        ffn = (jax.nn.silu(h @ lp["w_gate"].astype(cdt))
+               * (h @ lp["w_up"].astype(cdt))) @ lp["w_down"].astype(cdt)
+        return x + ffn, (pk, pv)
+
+    x, (ks, vs) = lax.scan(body, x, (params["layers"], pool["k"], pool["v"]))
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = (x @ head.astype(cdt)).astype(jnp.float32)
+    return logits, {"k": ks, "v": vs}
+
+
 def flops_per_token(cfg: LlamaConfig, seq_len: int) -> float:
     """Approximate training FLOPs/token (6N + attention term) for MFU math."""
     n = cfg.num_params

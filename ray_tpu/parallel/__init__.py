@@ -13,6 +13,7 @@ from ray_tpu.parallel.mesh import (
     batch_spec,
     local_mesh,
 )
+from ray_tpu.parallel.pipeline import make_pipeline_loss, pipeline_param_specs
 from ray_tpu.parallel.train_step import TrainState, make_train_step
 
 __all__ = [
@@ -21,5 +22,7 @@ __all__ = [
     "batch_spec",
     "local_mesh",
     "TrainState",
+    "make_pipeline_loss",
     "make_train_step",
+    "pipeline_param_specs",
 ]

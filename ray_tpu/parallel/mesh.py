@@ -2,6 +2,10 @@
 
 Canonical mesh axes (outermost to innermost, i.e. DCN-most to ICI-most):
 
+  pipeline — pipeline parallelism; layer stacks sharded by stage, microbatch
+            activations handed off with `ppermute` (parallel/pipeline.py).
+            Outermost: stage handoffs are point-to-point and latency-tolerant,
+            so they ride DCN across slices (SURVEY §5 item (b)).
   data    — pure data parallelism; gradients all-reduced. Crosses slices
             (DCN) in multi-slice deployments.
   fsdp    — data parallelism with parameters/optimizer sharded over the axis
@@ -26,7 +30,7 @@ from typing import Optional, Sequence
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-MESH_AXES = ("data", "fsdp", "expert", "context", "tensor")
+MESH_AXES = ("pipeline", "data", "fsdp", "expert", "context", "tensor")
 
 # batch dims of activations/token arrays are sharded over both DP axes
 BATCH_AXES = ("data", "fsdp")
@@ -49,21 +53,37 @@ class MeshSpec:
     expert: int = 1
     context: int = 1
     tensor: int = 1
-    num_slices: int = 1  # DCN granules; `data` must be a multiple of it
+    pipeline: int = 1
+    # DCN data-parallel granules; `data` must be a multiple of it. With
+    # pipeline > 1 the total slice count is pipeline * num_slices (stages
+    # are DCN-level too — handoffs are p2p and latency-tolerant).
+    num_slices: int = 1
 
     @property
     def num_devices(self) -> int:
-        return self.data * self.fsdp * self.expert * self.context * self.tensor
+        return (self.pipeline * self.data * self.fsdp * self.expert
+                * self.context * self.tensor)
 
     def build(self, devices: Optional[Sequence] = None) -> Mesh:
         if devices is None:
             devices = jax.devices()
-        shape = (self.data, self.fsdp, self.expert, self.context, self.tensor)
+        shape = (self.pipeline, self.data, self.fsdp, self.expert,
+                 self.context, self.tensor)
         if math.prod(shape) != len(devices):
             raise ValueError(
                 f"mesh {shape} needs {math.prod(shape)} devices, have {len(devices)}"
             )
-        if self.num_slices > 1:
+        if self.data % self.num_slices:
+            raise ValueError(
+                f"data={self.data} must be a multiple of num_slices="
+                f"{self.num_slices}: DCN-crossing parallelism is data-parallel "
+                f"over slices (fsdp/context/tensor must stay on ICI)")
+        # hybrid (slice-aware) layout whenever an axis is declared DCN-level
+        # AND the devices actually span multiple granules; a single-process
+        # CPU/test mesh takes the plain path (there is no DCN to align to)
+        granules = {(getattr(d, "slice_index", None), d.process_index)
+                    for d in devices}
+        if (self.num_slices > 1 or self.pipeline > 1) and len(granules) > 1:
             return self._build_hybrid(devices, shape)
         try:
             # Auto axis types: shardings flow via with_sharding_constraint +
@@ -77,21 +97,17 @@ class MeshSpec:
 
     def _build_hybrid(self, devices: Sequence, shape) -> Mesh:
         """ICI×DCN mesh: per-slice shape × across-slice shape."""
-        if self.data % self.num_slices:
-            raise ValueError(
-                f"data={self.data} must be a multiple of num_slices="
-                f"{self.num_slices}: DCN-crossing parallelism is data-parallel "
-                f"over slices (fsdp/context/tensor must stay on ICI)")
         from jax.experimental import mesh_utils
 
-        ici = (self.data // self.num_slices, self.fsdp, self.expert,
+        ici = (1, self.data // self.num_slices, self.fsdp, self.expert,
                self.context, self.tensor)
-        dcn = (self.num_slices, 1, 1, 1, 1)
+        dcn = (self.pipeline, self.num_slices, 1, 1, 1, 1)
         # real TPU slices carry distinguishing slice_index values; virtual/CPU
         # multi-process deployments (all slice_index 0 or absent) use the
         # process as the DCN granule instead
+        n_granules = self.pipeline * self.num_slices
         slice_ids = {getattr(d, "slice_index", None) for d in devices}
-        use_slice_index = len(slice_ids) == self.num_slices and None not in slice_ids
+        use_slice_index = len(slice_ids) == n_granules and None not in slice_ids
         arr = mesh_utils.create_hybrid_device_mesh(
             ici, dcn, devices=devices, process_is_granule=not use_slice_index)
         return Mesh(arr, MESH_AXES)

@@ -70,11 +70,18 @@ def make_train_step(
     learning_rate: float = 3e-4,
     context_parallel: bool = False,
     loss: Optional[Callable] = None,
+    pipeline_microbatches: Optional[int] = None,
 ) -> tuple[Callable, Callable]:
     """Returns (init_fn, step_fn).
 
     init_fn(key) -> TrainState (sharded over `mesh` if given)
     step_fn(state, tokens) -> (TrainState, metrics dict)
+
+    A mesh with a `pipeline` axis > 1 switches to the GPipe microbatch
+    schedule (parallel/pipeline.py): layer stacks shard by stage, the global
+    batch splits into `pipeline_microbatches` (default 2*pp), and autodiff
+    reverses the schedule for the backward.  Reference PP surface:
+    vllm_models.py:181-191 (degree folded into placement sizing).
     """
     model = _model_module(cfg)
     batch_axes = getattr(model, "ACTIVATION_BATCH_AXES", BATCH_AXES)
@@ -82,6 +89,15 @@ def make_train_step(
         optimizer = optax.adamw(
             learning_rate, b1=0.9, b2=0.95, weight_decay=0.1, mu_dtype=jnp.float32
         )
+    pp = mesh.shape.get("pipeline", 1) if mesh is not None else 1
+    if pp > 1 and loss is None:
+        if model is not llama:
+            raise NotImplementedError(
+                "pipeline parallelism is wired for the llama family; MoE "
+                "pipelines need an expert-aware stage split")
+        from ray_tpu.parallel.pipeline import make_pipeline_loss
+
+        loss = make_pipeline_loss(pipeline_microbatches or 2 * pp)
     if loss is None:
         loss = model.loss_fn
 
@@ -90,7 +106,12 @@ def make_train_step(
     cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
     rope_cache = (jnp.asarray(cos), jnp.asarray(sin))
 
-    pspecs = model.param_specs(cfg)
+    if pp > 1:
+        from ray_tpu.parallel.pipeline import pipeline_param_specs
+
+        pspecs = pipeline_param_specs(cfg)
+    else:
+        pspecs = model.param_specs(cfg)
 
     def init_fn_raw(key):
         params = model.init_params(cfg, key)

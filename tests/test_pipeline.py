@@ -1,0 +1,96 @@
+"""Pipeline parallelism: stage-sharded layers + GPipe microbatch schedule.
+
+Done-criterion (VERDICT r3 #3): a pipeline=2 mesh trains with loss matching
+pipeline=1 within fp tolerance; the degree composes with fsdp/tensor.
+reference PP surface: vllm_models.py:181-191.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models.llama import LlamaConfig
+from ray_tpu.parallel import MeshSpec, make_train_step
+from ray_tpu.parallel.pipeline import make_pipeline_loss, pipeline_param_specs
+
+pytestmark = pytest.mark.slow  # module lane: see pytest.ini
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    # fp32: the pp=1 vs pp=2 comparison must not hinge on bf16 rounding
+    return LlamaConfig.tiny(n_layers=4, compute_dtype=jnp.float32,
+                            max_seq_len=32)
+
+
+def _tokens(cfg, batch=8, seq=16, seed=0):
+    return jnp.asarray(
+        np.random.RandomState(seed).randint(1, cfg.vocab_size, (batch, seq)),
+        jnp.int32)
+
+
+def test_pipeline_loss_matches_single_stage(cfg):
+    """The pipelined forward is the same math as the plain forward: the
+    microbatch-mean CE must match llama.loss_fn up to fp reordering."""
+    from ray_tpu.models import llama
+
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = _tokens(cfg)
+    want = float(llama.loss_fn(cfg, params, tokens))
+
+    mesh = MeshSpec(pipeline=2, fsdp=4).build()
+    loss = make_pipeline_loss(num_microbatches=4)
+    got = float(jax.jit(
+        lambda p, t: loss(cfg, p, t, mesh=mesh))(params, tokens))
+    # microbatch mean-of-means == global mean (equal microbatch sizes);
+    # tolerance covers fp32 reduction-order differences only
+    assert got == pytest.approx(want, rel=2e-5)
+
+
+def test_train_step_pipeline_matches_no_pipeline(cfg):
+    """One full optimizer step on a pipeline=2 mesh tracks the pipeline=1
+    loss trajectory (documented fp tolerance, not bit-equality: gradient
+    reduction orders differ)."""
+    tokens = _tokens(cfg)
+
+    def run(spec, **kw):
+        mesh = spec.build()
+        init_fn, step_fn = make_train_step(cfg, mesh, learning_rate=3e-4, **kw)
+        state = init_fn(jax.random.PRNGKey(1))
+        losses = []
+        for _ in range(3):
+            state, metrics = step_fn(state, tokens)
+            losses.append(float(metrics["loss"]))
+        return losses
+
+    base = run(MeshSpec(fsdp=8))
+    piped = run(MeshSpec(pipeline=2, fsdp=4), pipeline_microbatches=4)
+    assert piped == pytest.approx(base, rel=1e-4)
+    # and the loss actually went down (it trained)
+    assert piped[-1] < piped[0]
+
+
+def test_pipeline_composes_with_tensor(cfg):
+    tokens = _tokens(cfg)
+    mesh = MeshSpec(pipeline=2, fsdp=2, tensor=2).build()
+    init_fn, step_fn = make_train_step(cfg, mesh, pipeline_microbatches=2)
+    state = init_fn(jax.random.PRNGKey(1))
+    state, metrics = step_fn(state, tokens)
+    assert np.isfinite(metrics["loss"])
+
+
+def test_pipeline_param_specs_shard_layers(cfg):
+    specs = pipeline_param_specs(cfg)
+    assert specs["layers"]["wq"][0] == "pipeline"
+    assert specs["embed"][0] != "pipeline"
+
+
+def test_pipeline_validation(cfg):
+    mesh = MeshSpec(pipeline=2, fsdp=4).build()
+    loss = make_pipeline_loss(num_microbatches=3)
+    with pytest.raises(ValueError, match="divisible"):
+        from ray_tpu.models import llama
+
+        loss(cfg, llama.init_params(cfg, jax.random.PRNGKey(0)),
+             _tokens(cfg, batch=8), mesh=mesh)
