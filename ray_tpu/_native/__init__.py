@@ -20,20 +20,32 @@ _build_lock = threading.Lock()
 _cache: dict = {}
 
 
-def _sanitize_mode() -> bool:
-    """RAY_TPU_NATIVE_SANITIZE=1 builds/loads ASAN-instrumented variants
-    (lib<name>.asan.so). The process must run with libasan preloaded
-    (LD_PRELOAD) — tests/test_native_asan.py drives the native test suite
-    that way. reference: the reference CI's .bazelrc asan/tsan configs
+def _sanitize_mode() -> str | None:
+    """RAY_TPU_NATIVE_SANITIZE selects an instrumented build/load variant:
+    "1"/"address" -> ASAN (lib<name>.asan.so), "thread" -> TSAN
+    (lib<name>.tsan.so). The process must run with the matching runtime
+    preloaded (LD_PRELOAD) — tests/test_native_asan.py and
+    tests/test_native_tsan.py drive the native suite both ways.
+    reference: the reference CI's .bazelrc asan/tsan configs
     (.bazelrc:114-134 in the upstream repo)."""
-    return os.environ.get("RAY_TPU_NATIVE_SANITIZE") == "1"
+    v = os.environ.get("RAY_TPU_NATIVE_SANITIZE")
+    if v in ("1", "address"):
+        return "address"
+    if v == "thread":
+        return "thread"
+    return None
 
 
 def _build(name: str, extra_flags=()) -> str | None:
     src = os.path.join(_DIR, f"{name}.cc")
-    if _sanitize_mode():
+    mode = _sanitize_mode()
+    if mode == "address":
         out = os.path.join(_DIR, f"lib{name}.asan.so")
         flags = ["-O1", "-g", "-fno-omit-frame-pointer", "-fsanitize=address",
+                 *extra_flags]
+    elif mode == "thread":
+        out = os.path.join(_DIR, f"lib{name}.tsan.so")
+        flags = ["-O1", "-g", "-fno-omit-frame-pointer", "-fsanitize=thread",
                  *extra_flags]
     else:
         out = os.path.join(_DIR, f"lib{name}.so")

@@ -41,6 +41,10 @@ class RayTpuConfig:
     # --- object store ---
     object_store_memory_bytes: int = 2 * 1024**3
     object_store_spill_dir: str = "/tmp/ray_tpu_spill"
+    # remote spill target: any fsspec URI (gs://bucket/spill, memory://...);
+    # empty -> local object_store_spill_dir (reference:
+    # _private/external_storage.py:72,398 — URI-addressed external storage)
+    object_spill_uri: str = ""
     object_spilling_enabled: bool = True
     # Inline (in-band) return threshold, like the reference's
     # max_direct_call_object_size (ray_config_def.h).

@@ -212,6 +212,10 @@ class GcsServer:
         self._stopped.set()
         with self._lock:
             self._actor_cv.notify_all()
+        # stop feeding creation workers AND unblock the parked ones; their
+        # in-flight RPCs abort fast because pool.close_all() marks every
+        # client closed (rpc.py: closed clients never reconnect-retry)
+        self._actor_create_pool.shutdown(cancel_futures=True)
         self.server.shutdown()
         self.pool.close_all()
         if self.persistence_path and self._dirty.is_set():
@@ -685,6 +689,8 @@ class GcsServer:
         try:
             self._create_actor_on_node(info, node)
         except Exception as e:  # noqa: BLE001
+            if self._stopped.is_set():
+                return  # shutdown aborted the RPC; don't requeue, just exit
             logger.warning(
                 "GCS: actor %s creation on %s failed: %s", info.actor_id, node.node_id, e
             )

@@ -91,7 +91,16 @@ class LocalObjectStore:
     def __init__(self, capacity_bytes: Optional[int] = None, node_id_hex: str = "node"):
         cfg = global_config()
         self._capacity = capacity_bytes or cfg.object_store_memory_bytes
-        self._spill_dir = os.path.join(cfg.object_store_spill_dir, node_id_hex)
+        from ray_tpu._private.external_storage import storage_for
+
+        # every node spills under its own subtree — URI or plain path alike
+        # (nodes hold copies of the SAME object id; a shared flat dir would
+        # let one node's free unlink another node's spill copy)
+        spill_uri = cfg.object_spill_uri
+        if spill_uri:
+            spill_uri = f"{spill_uri.rstrip('/')}/{node_id_hex}"
+        self._spill_storage = storage_for(
+            spill_uri, os.path.join(cfg.object_store_spill_dir, node_id_hex))
         self._spilling = cfg.object_spilling_enabled
         self._entries: Dict[ObjectID, _Entry] = {}
         self._used = 0
@@ -331,10 +340,7 @@ class LocalObjectStore:
             self._used -= e.size
             self._dealloc_locked(object_id, e)
         if e.spilled_path:
-            try:
-                os.unlink(e.spilled_path)
-            except OSError:
-                pass
+            self._spill_storage.delete(e.spilled_path)
 
     def list_objects(self) -> List[ObjectID]:
         with self._lock:
@@ -387,12 +393,9 @@ class LocalObjectStore:
             )
 
     def _spill_locked(self, object_id: ObjectID, e: _Entry):
-        os.makedirs(self._spill_dir, exist_ok=True)
-        path = os.path.join(self._spill_dir, object_id.hex())
         buf = self.buffer_for(e)
-        with open(path, "wb") as f:
-            f.write(buf[: e.size])
-        e.spilled_path = path
+        e.spilled_path = self._spill_storage.spill(object_id.hex(),
+                                                   buf[: e.size])
         self._dealloc_locked(object_id, e)
         self._used -= e.size
 
@@ -404,8 +407,7 @@ class LocalObjectStore:
         e.shm = shm
         e.native_key = key
         self._used += e.size
-        with open(e.spilled_path, "rb") as f:
-            data = f.read()
+        data = self._spill_storage.restore(e.spilled_path)
         buf = self.buffer_for(e)
         buf[: len(data)] = data
 

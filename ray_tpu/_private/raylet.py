@@ -177,7 +177,17 @@ class Raylet:
         self._log_monitor.stop()
         with self._lock:
             workers = list(self._all_workers.values())
+            # mid-spawn workers haven't registered yet and would outlive us
+            # retrying RegisterWorker against a dead socket (caught by the
+            # lane hygiene test); kill them before they ever serve
+            spawning = list(self._spawning_procs.values())
+            self._spawning_procs.clear()
             self._dispatch_cv.notify_all()
+        for proc in spawning:
+            try:
+                proc.kill()
+            except Exception:  # noqa: BLE001
+                pass
         for w in workers:
             if w.proc is not None:
                 try:

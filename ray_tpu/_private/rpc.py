@@ -416,7 +416,11 @@ class RpcClient:
                 fut = self.call_async(method, payload)
                 return fut.result(timeout=timeout)
             except ConnectionLost:
-                if time.monotonic() > deadline:
+                # a client closed() by our own shutdown must fail NOW: the
+                # reconnect loop would otherwise keep a pool thread alive
+                # (retrying a dead peer) for the full deadline — the leaked
+                # 'gcs-actor-create' threads the lane hygiene test caught
+                if self._closed or time.monotonic() > deadline:
                     raise
                 time.sleep(delay)
                 delay = min(delay * 2, 0.5)

@@ -49,11 +49,22 @@ def main():
                 pass
             sys.exit(1)
 
-    reply = worker.raylet.call(
-        "RegisterWorker",
-        {"worker_id": worker.worker_id, "address": worker.server.address,
-         "pid": os.getpid(), "env_hash": env_hash},
-    )
+    from concurrent.futures import TimeoutError as FutTimeout
+
+    from ray_tpu._private.rpc import ConnectionLost
+
+    try:
+        reply = worker.raylet.call(
+            "RegisterWorker",
+            {"worker_id": worker.worker_id, "address": worker.server.address,
+             "pid": os.getpid(), "env_hash": env_hash},
+            timeout=15, retry_deadline=15)
+    except (ConnectionLost, FutTimeout, TimeoutError):
+        # raylet died while we were booting: exit NOW instead of retrying
+        # into the long default RPC deadline (orphan prevention). Other
+        # failures propagate loudly — a healthy raylet rejecting us is a
+        # bug that must leave a traceback, not a silent exit 0.
+        sys.exit(0)
     set_global_config(RayTpuConfig.from_blob(reply["config_blob"]))
     worker.job_id = None
 

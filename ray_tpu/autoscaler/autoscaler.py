@@ -104,6 +104,27 @@ class Autoscaler:
         out: List[Dict[str, float]] = []
         for s in stats.values():
             out.extend(s.get("pending_demands") or [])
+        # explicit request_resources() floor (reference:
+        # ray.autoscaler.sdk.request_resources): bundles that current
+        # capacity cannot hold are demand, queue state notwithstanding
+        from ray_tpu.autoscaler.sdk import requested_resources
+
+        floor = requested_resources(self._w)
+        if floor:
+            # first-fit the floor against per-node TOTALS (the floor sizes
+            # the cluster, not this instant's free capacity)
+            nodes = [dict(s.get("resources", {}).get("total", {}))
+                     for s in stats.values()]
+            for bundle in floor:
+                placed = False
+                for node in nodes:
+                    if all(node.get(k, 0.0) >= v for k, v in bundle.items()):
+                        for k, v in bundle.items():
+                            node[k] = node.get(k, 0.0) - v
+                        placed = True
+                        break
+                if not placed:
+                    out.append(dict(bundle))
         return out
 
     # -- reconcile ------------------------------------------------------

@@ -1,0 +1,345 @@
+"""Cluster launcher: ``ray_tpu up / down <cluster.yaml>``.
+
+reference: autoscaler/_private/commands.py:222 (create_or_update_cluster),
+command_runner.py:159 (SSHCommandRunner), gcp/tpu_command_runner.py:148
+(TPUCommandRunner — one command fanned out to EVERY worker of a TPU pod,
+the gang-bootstrap primitive TPU deployments need).
+
+Providers:
+  - ``local``: nodes are daemonized processes on this machine (the
+    operator-facing analog of the in-process test cluster) — the head and
+    each worker run via the CLI's own ``start`` daemonization, the cluster
+    state lives in an isolated session dir keyed by cluster name, and
+    ``down`` reuses the CLI's kill-confirmed stop path.
+  - ``gce_tpu``: TPU-VM slices via GCETpuNodeProvider + SSH command
+    runners fanned out per pod (every host of a slice must run the same
+    bootstrap — SURVEY hard-part #2).
+
+The yaml surface mirrors the reference's cluster.yaml (cluster_name,
+provider, head_node, worker_node_groups, setup/head_setup/worker_setup
+commands).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# config
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class WorkerGroupConfig:
+    name: str
+    count: int = 1
+    resources: Dict[str, float] = dataclasses.field(default_factory=dict)
+    labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class ClusterConfig:
+    cluster_name: str
+    provider: Dict[str, Any]
+    head_node: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    worker_node_groups: List[WorkerGroupConfig] = dataclasses.field(
+        default_factory=list)
+    setup_commands: List[str] = dataclasses.field(default_factory=list)
+    head_setup_commands: List[str] = dataclasses.field(default_factory=list)
+    worker_setup_commands: List[str] = dataclasses.field(default_factory=list)
+
+    @property
+    def state_dir(self) -> Path:
+        root = os.environ.get("RAY_TPU_CLUSTER_STATE_DIR",
+                              os.path.expanduser("~/.ray_tpu/clusters"))
+        return Path(root) / self.cluster_name
+
+
+def load_cluster_config(path: str) -> ClusterConfig:
+    import yaml
+
+    with open(path) as f:
+        raw = yaml.safe_load(f) or {}
+    if not raw.get("cluster_name"):
+        raise ValueError(f"{path}: cluster_name is required")
+    provider = raw.get("provider") or {}
+    if provider.get("type") not in ("local", "gce_tpu"):
+        raise ValueError(
+            f"{path}: provider.type must be 'local' or 'gce_tpu' "
+            f"(got {provider.get('type')!r})")
+    groups = []
+    for g in raw.get("worker_node_groups") or []:
+        if not g.get("name"):
+            raise ValueError(f"{path}: every worker group needs a name")
+        groups.append(WorkerGroupConfig(
+            name=g["name"], count=int(g.get("count", 1)),
+            resources={k: float(v)
+                       for k, v in (g.get("resources") or {}).items()},
+            labels=dict(g.get("labels") or {})))
+    return ClusterConfig(
+        cluster_name=raw["cluster_name"],
+        provider=provider,
+        head_node=raw.get("head_node") or {},
+        worker_node_groups=groups,
+        setup_commands=list(raw.get("setup_commands") or []),
+        head_setup_commands=list(raw.get("head_setup_commands") or []),
+        worker_setup_commands=list(raw.get("worker_setup_commands") or []),
+    )
+
+
+# ---------------------------------------------------------------------------
+# command runners (reference: command_runner.py:159, tpu_command_runner.py:148)
+# ---------------------------------------------------------------------------
+
+
+class CommandRunner:
+    """Runs shell commands 'on a node'."""
+
+    def run(self, cmd: str, *, timeout: float = 300.0) -> Tuple[int, str]:
+        raise NotImplementedError
+
+
+class LocalCommandRunner(CommandRunner):
+    def __init__(self, env: Optional[Dict[str, str]] = None):
+        self._env = env
+
+    def run(self, cmd: str, *, timeout: float = 300.0) -> Tuple[int, str]:
+        env = dict(os.environ)
+        if self._env:
+            env.update(self._env)
+        p = subprocess.run(cmd, shell=True, capture_output=True, text=True,
+                           timeout=timeout, env=env)
+        return p.returncode, (p.stdout + p.stderr)
+
+
+class SSHCommandRunner(CommandRunner):
+    """reference: command_runner.py:159 — ssh with sane non-interactive
+    options; key/user from the provider's auth config."""
+
+    def __init__(self, ip: str, user: str = "ubuntu",
+                 key_path: Optional[str] = None):
+        self.ip = ip
+        self.user = user
+        self.key_path = key_path
+
+    def run(self, cmd: str, *, timeout: float = 300.0) -> Tuple[int, str]:
+        argv = ["ssh", "-o", "StrictHostKeyChecking=no",
+                "-o", "UserKnownHostsFile=/dev/null",
+                "-o", "ConnectTimeout=15"]
+        if self.key_path:
+            argv += ["-i", self.key_path]
+        argv += [f"{self.user}@{self.ip}", cmd]
+        p = subprocess.run(argv, capture_output=True, text=True,
+                           timeout=timeout)
+        return p.returncode, (p.stdout + p.stderr)
+
+
+class TPUPodCommandRunner(CommandRunner):
+    """Fan a command out to EVERY worker of a TPU pod in parallel
+    (reference: gcp/tpu_command_runner.py:148) — a pod bootstrap that skips
+    a host leaves a broken gang, so failures aggregate and raise."""
+
+    def __init__(self, runners: List[CommandRunner]):
+        self.runners = list(runners)
+
+    def run(self, cmd: str, *, timeout: float = 300.0) -> Tuple[int, str]:
+        results: List[Optional[Tuple[int, str]]] = [None] * len(self.runners)
+
+        def worker(i, r):
+            try:
+                results[i] = r.run(cmd, timeout=timeout)
+            except Exception as e:  # noqa: BLE001
+                results[i] = (255, f"{type(e).__name__}: {e}")
+
+        threads = [threading.Thread(target=worker, args=(i, r), daemon=True)
+                   for i, r in enumerate(self.runners)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout + 30)
+        code = max(r[0] for r in results if r is not None)
+        out = "\n".join(f"[worker {i}] rc={r[0]}\n{r[1]}"
+                        for i, r in enumerate(results) if r is not None)
+        return code, out
+
+
+# ---------------------------------------------------------------------------
+# local provider: daemonized node processes on this machine
+# ---------------------------------------------------------------------------
+
+
+def _cli_env(state_dir: Path) -> Dict[str, str]:
+    env = dict(os.environ)
+    env["RAY_TPU_SESSION_DIR"] = str(state_dir / "sessions")
+    env.pop("RAY_TPU_ADDRESS", None)
+    return env
+
+
+def _run_cli(state_dir: Path, *argv: str, timeout: float = 180.0) -> str:
+    p = subprocess.run([sys.executable, "-m", "ray_tpu", *argv],
+                       capture_output=True, text=True, timeout=timeout,
+                       env=_cli_env(state_dir))
+    if p.returncode != 0:
+        raise RuntimeError(
+            f"ray_tpu {' '.join(argv)} failed ({p.returncode}):\n"
+            f"{p.stdout}\n{p.stderr}")
+    return p.stdout
+
+
+def _local_up(cfg: ClusterConfig) -> Dict[str, Any]:
+    state_dir = cfg.state_dir
+    state_dir.mkdir(parents=True, exist_ok=True)
+    head_res = cfg.head_node.get("resources") or {}
+    argv = ["start", "--head"]
+    if "CPU" in head_res:
+        argv += ["--num-cpus", str(head_res["CPU"])]
+    extra = {k: float(v) for k, v in head_res.items() if k != "CPU"}
+    if extra:
+        argv += ["--resources", json.dumps(extra)]
+    out = _run_cli(state_dir, *argv)
+    address = [ln.split(": ", 1)[1] for ln in out.splitlines()
+               if ln.strip().startswith("address:")][0]
+    workers = []
+    for group in cfg.worker_node_groups:
+        for i in range(group.count):
+            wargv = ["start", "--address", address]
+            res = dict(group.resources)
+            if "CPU" in res:
+                wargv += ["--num-cpus", str(res.pop("CPU"))]
+            if res:
+                wargv += ["--resources", json.dumps(res)]
+            if group.labels:
+                wargv += ["--labels", json.dumps(group.labels)]
+            wout = _run_cli(state_dir, *wargv)
+            pid = int(wout.split("pid ", 1)[1].split(")")[0])
+            workers.append({"group": group.name, "index": i, "pid": pid})
+    return {"address": address, "workers": workers}
+
+
+def _local_down(cfg: ClusterConfig):
+    _run_cli(cfg.state_dir, "stop")
+
+
+# ---------------------------------------------------------------------------
+# public entry points (reference: commands.py:222 create_or_update_cluster)
+# ---------------------------------------------------------------------------
+
+
+def create_or_update_cluster(config_path: str, *,
+                             no_setup: bool = False) -> Dict[str, Any]:
+    cfg = load_cluster_config(config_path)
+    ptype = cfg.provider["type"]
+    if ptype == "local":
+        info = _local_up(cfg)
+        runners: Dict[str, CommandRunner] = {
+            "head": LocalCommandRunner(_cli_env(cfg.state_dir))}
+        worker_runners = [LocalCommandRunner(_cli_env(cfg.state_dir))
+                          for _ in info["workers"]]
+    else:
+        info = _gce_up(cfg)
+        auth = cfg.provider.get("auth") or {}
+        runners = {"head": SSHCommandRunner(
+            info["head_ip"], user=auth.get("ssh_user", "ubuntu"),
+            key_path=auth.get("ssh_private_key"))}
+        worker_runners = [
+            SSHCommandRunner(ip, user=auth.get("ssh_user", "ubuntu"),
+                             key_path=auth.get("ssh_private_key"))
+            for ip in info.get("worker_ips", [])]
+    pod = TPUPodCommandRunner(worker_runners) if worker_runners else None
+    if not no_setup:
+        for cmd in cfg.setup_commands:
+            _check(runners["head"].run(cmd), cmd, "head")
+            if pod:
+                _check(pod.run(cmd), cmd, "workers")
+        for cmd in cfg.head_setup_commands:
+            _check(runners["head"].run(cmd), cmd, "head")
+        if pod:
+            for cmd in cfg.worker_setup_commands:
+                _check(pod.run(cmd), cmd, "workers")
+    state = {"config_path": os.path.abspath(config_path),
+             "provider": ptype, "up_at": time.time(), **info}
+    cfg.state_dir.mkdir(parents=True, exist_ok=True)
+    (cfg.state_dir / "cluster_state.json").write_text(json.dumps(state))
+    return state
+
+
+def teardown_cluster(config_path: str):
+    cfg = load_cluster_config(config_path)
+    if cfg.provider["type"] == "local":
+        _local_down(cfg)
+    else:
+        _gce_down(cfg)
+    try:
+        (cfg.state_dir / "cluster_state.json").unlink()
+    except OSError:
+        pass
+
+
+def get_head_address(config_path: str) -> str:
+    cfg = load_cluster_config(config_path)
+    state_file = cfg.state_dir / "cluster_state.json"
+    if not state_file.exists():
+        raise RuntimeError(
+            f"cluster {cfg.cluster_name!r} is not up (no state file)")
+    return json.loads(state_file.read_text())["address"]
+
+
+def _check(result: Tuple[int, str], cmd: str, where: str):
+    code, out = result
+    if code != 0:
+        raise RuntimeError(
+            f"setup command failed on {where} (rc={code}): {cmd}\n{out}")
+
+
+# ---------------------------------------------------------------------------
+# gce_tpu provider wiring (real transport; hermetic under injected transport)
+# ---------------------------------------------------------------------------
+
+
+def _gce_up(cfg: ClusterConfig) -> Dict[str, Any]:
+    from ray_tpu.autoscaler.gce_tpu_provider import GCETpuNodeProvider
+
+    p = cfg.provider
+    provider = GCETpuNodeProvider(
+        p["project"], p["zone"],
+        accelerator_type=p.get("accelerator_type", "v5p-8"),
+        runtime_version=p.get("runtime_version", "tpu-ubuntu2204-base"),
+        transport=p.get("_transport"))  # injectable for tests
+    head_res = cfg.head_node.get("resources") or {"CPU": 4.0}
+    head_gid = provider.create_node_group("head", head_res, 1)
+    groups = [{"gid": head_gid, "name": "head"}]
+    for group in cfg.worker_node_groups:
+        gid = provider.create_node_group(
+            group.name, dict(group.resources), group.count,
+            labels=group.labels)
+        groups.append({"gid": gid, "name": group.name})
+    nodes = provider.list_api_nodes()
+    ips = [n.get("networkEndpoints", [{}])[0].get("ipAddress", "")
+           for n in nodes]
+    return {"address": f"{ips[0]}:6379" if ips else "",
+            "head_ip": ips[0] if ips else "",
+            "worker_ips": ips[1:], "groups": groups}
+
+
+def _gce_down(cfg: ClusterConfig):
+    from ray_tpu.autoscaler.gce_tpu_provider import GCETpuNodeProvider
+
+    p = cfg.provider
+    provider = GCETpuNodeProvider(
+        p["project"], p["zone"],
+        accelerator_type=p.get("accelerator_type", "v5p-8"),
+        runtime_version=p.get("runtime_version", "tpu-ubuntu2204-base"),
+        transport=p.get("_transport"))
+    state_file = cfg.state_dir / "cluster_state.json"
+    if state_file.exists():
+        state = json.loads(state_file.read_text())
+        for g in state.get("groups", []):
+            provider.terminate_node_group(g["gid"])

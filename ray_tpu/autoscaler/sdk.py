@@ -1,0 +1,51 @@
+"""Autoscaler SDK: programmatic resource requests.
+
+reference: ray.autoscaler.sdk.request_resources — a demand FLOOR the
+autoscaler honors independently of the scheduler's pending queues (e.g.
+pre-provision a slice before a burst arrives).  The request is stored in
+the GCS KV; the reconciler merges whatever part of it current capacity
+cannot hold into its demand list each tick.  Calling with no arguments
+clears the floor.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+_KV_KEY = "autoscaler:requested_resources"
+
+
+def request_resources(*, num_cpus: Optional[int] = None,
+                      bundles: Optional[List[Dict[str, float]]] = None,
+                      _worker=None) -> None:
+    """Set (or clear) the explicit cluster-shape floor."""
+    if _worker is None:
+        from ray_tpu._private.worker import get_global_worker
+
+        _worker = get_global_worker()
+    req: List[Dict[str, float]] = [dict(b) for b in (bundles or [])]
+    if num_cpus:
+        req.append({"CPU": float(num_cpus)})
+    if req:
+        _worker.gcs.call("KVPut", {"key": _KV_KEY,
+                                   "value": json.dumps(req).encode()})
+    else:
+        _worker.gcs.call("KVDel", {"key": _KV_KEY})
+
+
+def requested_resources(worker) -> List[Dict[str, float]]:
+    """The floor currently stored in the GCS KV ([] when unset)."""
+    try:
+        blob = worker.gcs.call("KVGet", {"key": _KV_KEY})
+    except Exception:  # noqa: BLE001
+        return []
+    if not blob:
+        return []
+    if isinstance(blob, (bytes, bytearray)):
+        blob = blob.decode()
+    try:
+        out = json.loads(blob)
+    except (TypeError, ValueError):
+        return []
+    return [dict(b) for b in out if isinstance(b, dict)]

@@ -38,9 +38,17 @@ class InputData(LogicalOp):
 
 @dataclasses.dataclass
 class Read(LogicalOp):
-    """Leaf: read tasks from a datasource (reference: logical/operators/read_operator.py)."""
+    """Leaf: read tasks from a datasource (reference: logical/operators/read_operator.py).
+
+    `datasource`/`parallelism` let the optimizer RE-plan tasks with pushed
+    columns/predicates (reference: logical/rules/); `read_tasks` is the
+    materialized plan actually executed."""
 
     read_tasks: List[Callable] = dataclasses.field(default_factory=list)
+    datasource: Optional[Any] = None
+    parallelism: int = 0
+    columns: Optional[List[str]] = None
+    predicate: Optional[List[tuple]] = None
 
 
 @dataclasses.dataclass
@@ -52,6 +60,11 @@ class MapBlocks(LogicalOp):
     compute: Optional[Any] = None
     fn_constructor: Optional[Callable] = None
     resources: Optional[Dict[str, float]] = None
+    # optimizer metadata (reference: logical/rules/ projection / predicate
+    # pushdown): a SelectColumns op carries `projection`; a filter(expr=)
+    # op carries `predicate` [(col, op, val)] — opaque fns carry neither
+    projection: Optional[List[str]] = None
+    predicate: Optional[List[tuple]] = None
 
 
 @dataclasses.dataclass
@@ -88,6 +101,50 @@ class ExecutionPlan:
     def execute(self, ctx) -> List[Any]:
         return list(self.execute_iter(ctx))
 
+    # -- optimizer (reference: _internal/logical/rules/) --------------------
+    def optimized_ops(self) -> List[LogicalOp]:
+        """Projection + predicate pushdown into pushdown-capable reads.
+
+        Rules applied to fixpoint on Read-adjacent ops:
+          - a MapBlocks carrying `predicate` folds into the Read (and
+            disappears: the parquet filter is exact, not just row-group
+            pruning)
+          - a MapBlocks carrying `projection` narrows Read.columns (and
+            disappears)
+        Opaque fns stop the scan — the optimizer can't see through them.
+        """
+        ops = list(self.ops)
+        changed = True
+        while changed:
+            changed = False
+            for i, op in enumerate(ops):
+                if not isinstance(op, Read) or op.datasource is None:
+                    continue
+                supported = tuple(getattr(op.datasource, "supports_pushdown",
+                                          tuple)())
+                if i + 1 >= len(ops) or not supported:
+                    continue
+                nxt = ops[i + 1]
+                if not isinstance(nxt, MapBlocks):
+                    continue
+                if nxt.predicate and "predicate" in supported:
+                    new = dataclasses.replace(
+                        op, predicate=(op.predicate or []) + list(nxt.predicate))
+                elif nxt.projection and "columns" in supported:
+                    cols = (nxt.projection if op.columns is None
+                            else [c for c in op.columns
+                                  if c in nxt.projection])
+                    new = dataclasses.replace(op, columns=cols)
+                else:
+                    continue
+                new.read_tasks = new.datasource.get_read_tasks(
+                    new.parallelism, columns=new.columns,
+                    predicate=new.predicate)
+                ops[i:i + 2] = [new]
+                changed = True
+                break
+        return ops
+
     # -- fusion -------------------------------------------------------------
     def _fuse(self, ctx) -> List[Tuple[str, Any]]:
         """Group the op chain into executable stages, fusing consecutive
@@ -107,7 +164,7 @@ class ExecutionPlan:
                 stages.append(("tasks", ("map", fns, None)))
             pending_fns, pending_sources = [], None
 
-        for op in self.ops:
+        for op in self.optimized_ops():
             if isinstance(op, InputData):
                 flush()
                 stages.append(("input", op.refs))

@@ -96,6 +96,51 @@ def _select_columns_block(cols, block):
     return to_arrow(block).select(cols)
 
 
+_FILTER_OPS = ("<=", ">=", "==", "!=", "<", ">")
+
+
+def _parse_filter_expr(expr: str) -> tuple:
+    """``"col <op> literal"`` -> (col, op, value); literals are ints,
+    floats, or quoted strings."""
+    for op in _FILTER_OPS:
+        if op in expr:
+            col, _, lit = expr.partition(op)
+            col, lit = col.strip(), lit.strip()
+            if not col or not lit:
+                break
+            if lit[0] in "'\"" and lit[-1] == lit[0]:
+                val: Any = lit[1:-1]
+            else:
+                try:
+                    val = int(lit)
+                except ValueError:
+                    try:
+                        val = float(lit)
+                    except ValueError:
+                        raise ValueError(
+                            f"unsupported literal in filter expr: {expr!r}"
+                        ) from None
+            return (col, op, val)
+    raise ValueError(
+        f"filter expr must be 'column <op> literal' with op in "
+        f"{_FILTER_OPS}: {expr!r}")
+
+
+def _predicate_block(pred, block):
+    """Exact row filter for a (col, op, val) predicate (the block-level
+    fallback when the source can't absorb the pushdown)."""
+    import pyarrow.compute as pc
+
+    from ray_tpu.data.block import to_arrow
+
+    col, op, val = pred
+    t = to_arrow(block)
+    c = t[col]
+    fns = {"==": pc.equal, "!=": pc.not_equal, "<": pc.less,
+           "<=": pc.less_equal, ">": pc.greater, ">=": pc.greater_equal}
+    return t.filter(fns[op](c, val))
+
+
 # -- all-to-all implementations --------------------------------------------
 
 # -- distributed all-to-all kernels (reference: _internal/planner hash
@@ -851,7 +896,21 @@ class Dataset:
         return Dataset(self._plan.with_op(
             MapBlocks(name="FlatMap", fn=functools.partial(_flat_map_block, fn))), self._ctx)
 
-    def filter(self, fn: Callable[[Dict], bool]) -> "Dataset":
+    def filter(self, fn: Optional[Callable[[Dict], bool]] = None, *,
+               expr: Optional[str] = None) -> "Dataset":
+        """Row filter: a Python callable OR a simple comparison expression
+        (``"col > 5"``, ``"name == 'x'"``).  Expressions are optimizer-
+        visible and push into pushdown-capable sources (parquet row-group
+        pruning; reference: logical/rules/ predicate pushdown) — callables
+        are opaque and always run as a block transform."""
+        if (fn is None) == (expr is None):
+            raise ValueError("filter() takes exactly one of fn or expr")
+        if expr is not None:
+            pred = _parse_filter_expr(expr)
+            return Dataset(self._plan.with_op(
+                MapBlocks(name=f"Filter({expr})",
+                          fn=functools.partial(_predicate_block, pred),
+                          predicate=[pred])), self._ctx)
         return Dataset(self._plan.with_op(
             MapBlocks(name="Filter", fn=functools.partial(_filter_block, fn))), self._ctx)
 
@@ -868,7 +927,8 @@ class Dataset:
     def select_columns(self, cols: List[str]) -> "Dataset":
         return Dataset(self._plan.with_op(
             MapBlocks(name="SelectColumns",
-                      fn=functools.partial(_select_columns_block, cols))), self._ctx)
+                      fn=functools.partial(_select_columns_block, cols),
+                      projection=list(cols))), self._ctx)
 
     def repartition(self, num_blocks: int) -> "Dataset":
         return Dataset(self._plan.with_op(

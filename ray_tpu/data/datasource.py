@@ -124,30 +124,55 @@ def _items_to_block(items: List[Any]) -> pa.Table:
 
 
 class FileDatasource(Datasource):
-    """One read task per file group."""
+    """One read task per file group.
 
-    def __init__(self, paths, reader: Callable[[str], pa.Table]):
+    ``pushdown``: which optimizer rewrites this reader honors — parquet
+    supports both columns and predicate (reference: logical/rules/)."""
+
+    def __init__(self, paths, reader: Callable[[str], pa.Table],
+                 pushdown: tuple = ()):
         self.files = _expand_paths(paths)
         self.reader = reader
+        self.pushdown = tuple(pushdown)
 
-    def get_read_tasks(self, parallelism: int) -> List[Callable]:
-        return [functools.partial(_read_files, chunk, self.reader)
+    def supports_pushdown(self) -> tuple:
+        return self.pushdown
+
+    def get_read_tasks(self, parallelism: int, *, columns=None,
+                       predicate=None) -> List[Callable]:
+        return [functools.partial(_read_files, chunk, self.reader,
+                                  columns, predicate)
                 for chunk in _chunk(self.files, parallelism)]
 
 
-def _read_files(files: List[str], reader) -> pa.Table:
+def _read_files(files: List[str], reader, columns=None,
+                predicate=None) -> pa.Table:
     from ray_tpu.data.block import concat_blocks
 
-    return concat_blocks([reader(f) for f in files])
+    kw = {}
+    if columns is not None:
+        kw["columns"] = columns
+    if predicate is not None:
+        kw["predicate"] = predicate
+    return concat_blocks([reader(f, **kw) for f in files])
 
 
-def read_parquet_file(path: str) -> pa.Table:
+def read_parquet_file(path: str, columns=None, predicate=None) -> pa.Table:
+    """Parquet read with optimizer pushdown: `columns` prunes at the column
+    chunks, `predicate` [(col, op, val), ...] prunes row groups by stats and
+    filters rows (reference: logical/rules/ projection+predicate pushdown;
+    executed here by pyarrow's read_table columns=/filters=)."""
     import pyarrow.parquet as pq
 
+    kw = {}
+    if columns is not None:
+        kw["columns"] = list(columns)
+    if predicate:
+        kw["filters"] = [tuple(p) for p in predicate]
     if _is_remote(path):
         with _open(path) as f:
-            return pq.read_table(f)
-    return pq.read_table(path)
+            return pq.read_table(f, **kw)
+    return pq.read_table(path, **kw)
 
 
 def read_csv_file(path: str) -> pa.Table:

@@ -38,7 +38,9 @@ def read_datasource(datasource: Datasource, *, parallelism: int = -1) -> Dataset
     if parallelism <= 0:
         parallelism = DEFAULT_PARALLELISM
     tasks = datasource.get_read_tasks(parallelism)
-    plan = ExecutionPlan([Read(name=f"Read{type(datasource).__name__}", read_tasks=tasks)])
+    plan = ExecutionPlan([Read(name=f"Read{type(datasource).__name__}",
+                               read_tasks=tasks, datasource=datasource,
+                               parallelism=parallelism)])
     return Dataset(plan)
 
 
@@ -75,7 +77,11 @@ def from_arrow(table) -> Dataset:
 
 
 def read_parquet(paths, *, parallelism: int = -1) -> Dataset:
-    return read_datasource(FileDatasource(paths, read_parquet_file), parallelism=parallelism)
+    # parquet honors both optimizer pushdown rules (columns + predicate)
+    return read_datasource(
+        FileDatasource(paths, read_parquet_file,
+                       pushdown=("columns", "predicate")),
+        parallelism=parallelism)
 
 
 def read_csv(paths, *, parallelism: int = -1) -> Dataset:

@@ -47,8 +47,10 @@ class LLMConfig:
     # prompts interleave with decode instead of stalling it
     prefill_chunk: int = 256
     enable_prefix_caching: bool = True
-    # parallelism degrees (mesh axes; the vllm_models.py:177-186 analog)
+    # parallelism degrees (mesh axes; the vllm_models.py:177-186 analog —
+    # pipeline degree folded into placement sizing per vllm_models.py:181-191)
     tensor_parallel_size: int = 1
+    pipeline_parallel_size: int = 1
     data_parallel_size: int = 1
     # serving
     num_replicas: int = 1
@@ -57,7 +59,8 @@ class LLMConfig:
     def resources_per_replica(self) -> Dict[str, float]:
         chips = self.chips_per_replica
         if chips is None:
-            chips = self.tensor_parallel_size * self.data_parallel_size
+            chips = (self.tensor_parallel_size * self.pipeline_parallel_size
+                     * self.data_parallel_size)
         res: Dict[str, float] = {"CPU": 1.0}
         if chips > 0 and (chips > 1 or self.chips_per_replica is not None):
             res["TPU"] = float(chips)

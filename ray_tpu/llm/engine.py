@@ -81,25 +81,67 @@ def _sample(logits, key, temps, top_ks):
 
 
 def build_tp_mesh(cfg, tp: int):
-    """Validate the TP degree and build a `tensor`-axis mesh over tp
-    devices; TP=1 stays mesh-free (single-device fast path)."""
-    if tp <= 1:
+    return build_engine_mesh(cfg, tp, 1)
+
+
+def build_engine_mesh(cfg, tp: int, pp: int):
+    """Validate the TP × PP degrees and build a `pipeline`×`tensor` mesh.
+
+    TP=PP=1 stays mesh-free (single-device fast path).  PP shards the
+    STACKED layer dim of params and KV cache over `pipeline`
+    (vllm_models.py:181-191 folds the degree into placement; here it is a
+    real mesh axis): each stage holds L/pp layers' weights + cache — the
+    way to serve a model whose layers don't fit one chip/slice.  The
+    layer scan crosses stage boundaries with XLA-inserted transfers of the
+    [B, D] activation (tiny for decode); stages run sequentially within
+    one step — PP here buys MEMORY reach, microbatch overlap is the
+    training path's job (parallel/pipeline.py)."""
+    if tp <= 1 and pp <= 1:
         return None
     devices = jax.devices()
-    if len(devices) < tp:
+    if len(devices) < tp * pp:
         raise ValueError(
-            f"tensor_parallel_size={tp} but only {len(devices)} visible "
-            f"device(s) — a TP engine must never silently compute on one "
-            f"chip while reserving {tp}")
-    for name, dim in (("n_heads", cfg.n_heads), ("n_kv_heads", cfg.n_kv_heads),
-                      ("ffn_dim", cfg.ffn_dim), ("vocab_size", cfg.vocab_size)):
-        if dim % tp:
-            raise ValueError(
-                f"tensor_parallel_size={tp} does not divide model "
-                f"{name}={dim}")
+            f"tensor_parallel_size={tp} x pipeline_parallel_size={pp} needs "
+            f"{tp * pp} devices but only {len(devices)} visible device(s) — "
+            f"an engine must never silently compute on fewer chips than it "
+            f"reserves")
+    if cfg.n_layers % max(pp, 1):
+        raise ValueError(
+            f"pipeline_parallel_size={pp} does not divide n_layers={cfg.n_layers}")
+    if tp > 1:
+        for name, dim in (("n_heads", cfg.n_heads),
+                          ("n_kv_heads", cfg.n_kv_heads),
+                          ("ffn_dim", cfg.ffn_dim),
+                          ("vocab_size", cfg.vocab_size)):
+            if dim % tp:
+                raise ValueError(
+                    f"tensor_parallel_size={tp} does not divide model "
+                    f"{name}={dim}")
     from ray_tpu.parallel.mesh import MeshSpec
 
-    return MeshSpec(tensor=tp).build(devices[:tp])
+    return MeshSpec(pipeline=pp, tensor=tp).build(devices[:tp * pp])
+
+
+def pp_param_specs(specs: dict, pp: int) -> dict:
+    """Shard the stacked-layer dim of inference params over `pipeline`."""
+    if pp <= 1:
+        return specs
+    from jax.sharding import PartitionSpec as P
+
+    specs = dict(specs)
+    specs["layers"] = jax.tree.map(
+        lambda s: P(*(("pipeline",) + tuple(s)[1:])), specs["layers"],
+        is_leaf=lambda x: isinstance(x, P))
+    return specs
+
+
+def pp_cache_spec(spec: dict, pp: int) -> dict:
+    """KV caches/pools are [L, ...]: shard dim 0 over `pipeline` too."""
+    if pp <= 1:
+        return spec
+    from jax.sharding import PartitionSpec as P
+
+    return {k: P(*(("pipeline",) + tuple(s)[1:])) for k, s in spec.items()}
 
 
 def make_engine(config: "LLMConfig", params=None, *, key=None):
@@ -144,15 +186,19 @@ class JaxLLMEngine:
         # (reference: vllm_models.py:177-186 wires TP from engine_kwargs into
         # the engine; here TP is a jax mesh axis and GSPMD partitions the
         # prefill/decode programs from the param + cache shardings alone)
-        self.mesh = self._build_tp_mesh(config.tensor_parallel_size)
+        pp = config.pipeline_parallel_size
+        self.mesh = build_engine_mesh(cfg, config.tensor_parallel_size, pp)
         self.cache = llama.init_kv_cache(cfg, self.max_batch, self.max_seq)
         if self.mesh is not None:
             from ray_tpu.parallel.mesh import shard_pytree
 
             self.params = shard_pytree(
-                self.params, llama.inference_param_specs(cfg), self.mesh)
+                self.params,
+                pp_param_specs(llama.inference_param_specs(cfg), pp),
+                self.mesh)
             self.cache = shard_pytree(
-                self.cache, llama.kv_cache_spec(), self.mesh)
+                self.cache, pp_cache_spec(llama.kv_cache_spec(), pp),
+                self.mesh)
         # host-side slot state
         self._slot_req: List[Optional[_Request]] = [None] * self.max_batch
         self._lengths = np.zeros(self.max_batch, np.int32)

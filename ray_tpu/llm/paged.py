@@ -170,17 +170,25 @@ class PagedJaxLLMEngine:
         cos, sin = rope_frequencies(cfg.head_dim, self.max_seq, cfg.rope_theta)
         self._rope = (jnp.asarray(cos), jnp.asarray(sin))
 
-        from ray_tpu.llm.engine import build_tp_mesh
+        from ray_tpu.llm.engine import (
+            build_engine_mesh,
+            pp_cache_spec,
+            pp_param_specs,
+        )
 
-        self.mesh = build_tp_mesh(cfg, config.tensor_parallel_size)
+        pp = config.pipeline_parallel_size
+        self.mesh = build_engine_mesh(cfg, config.tensor_parallel_size, pp)
         self.pool = llama.init_paged_kv_cache(cfg, nb, self.bs)
         if self.mesh is not None:
             from ray_tpu.parallel.mesh import shard_pytree
 
             self.params = shard_pytree(
-                self.params, llama.inference_param_specs(cfg), self.mesh)
+                self.params,
+                pp_param_specs(llama.inference_param_specs(cfg), pp),
+                self.mesh)
             self.pool = shard_pytree(
-                self.pool, llama.paged_kv_cache_spec(), self.mesh)
+                self.pool, pp_cache_spec(llama.paged_kv_cache_spec(), pp),
+                self.mesh)
 
         # host slot state (mirrors the static engine)
         self._slot_req: List[Optional[_PagedReq]] = [None] * self.max_batch

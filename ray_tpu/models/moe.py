@@ -56,16 +56,21 @@ class MoEConfig:
     capacity_factor: float = 1.25
     aux_loss_coef: float = 0.01
     # "auto": sorted/ragged grouped matmul when unsharded (the single-chip
-    # fast path — no capacity padding, no O(T²) dispatch einsums, no token
-    # dropping), GShard capacity-dense dispatch under a mesh (its einsum
-    # formulation is what GSPMD lowers to expert all-to-alls).
+    # DROP-FREE path — no capacity padding, no O(T²) dispatch einsums),
+    # GShard capacity-dense dispatch under a mesh (its einsum formulation
+    # is what GSPMD lowers to expert all-to-alls).
+    # "sorted_capacity": counting-sort dispatch + padded batched-matmul
+    # FFN — the fastest single-chip path (measured 64% vs ragged_dot's 45%
+    # MXU at bench shapes; see moe_block_sorted_capacity) at the standard
+    # capacity_factor token-dropping tradeoff.
     # "ragged" / "dense" force one implementation.
     dispatch: str = "auto"
 
     def __post_init__(self):
-        if self.dispatch not in ("auto", "ragged", "dense"):
+        valid = ("auto", "ragged", "dense", "sorted_capacity")
+        if self.dispatch not in valid:
             raise ValueError(
-                f"dispatch={self.dispatch!r} — must be 'auto', 'ragged' or 'dense'")
+                f"dispatch={self.dispatch!r} — must be one of {valid}")
     max_seq_len: int = 8192
     rope_theta: float = 1e6
     rms_norm_eps: float = 1e-5
@@ -238,6 +243,57 @@ def moe_block_ragged(cfg: MoEConfig, x, lp):
     return y.reshape(b, s, d), aux
 
 
+def moe_block_sorted_capacity(cfg: MoEConfig, x, lp):
+    """Counting-sort dispatch + PADDED batched-matmul expert FFN.
+
+    Measured on v5e (round 4): at the bench shapes (T*k=64k rows over 8
+    experts of d=2048/f=4096) the 3-matmul FFN runs 64.2% MXU as a batched
+    einsum over equal [E, cap, d] groups vs 44.6% through lax.ragged_dot —
+    the ragged kernel, not routing or dispatch, is the exact path's MFU
+    ceiling.  This path buys the batched kernel with the STANDARD capacity
+    tradeoff (GShard/Switch): pairs ranked past ``capacity_factor * T*k/E``
+    within their expert are dropped (contribute zero).  Dispatch stays the
+    O(N·E) counting sort + index scatter/gather — none of the [T, E, cap]
+    one-hot einsums that sank the dense path to 0.26 MFU.
+    x: [B, S, d] -> ([B, S, d], aux_loss scalar).
+    """
+    b, s, d = x.shape
+    cdt = cfg.compute_dtype
+    e, k = cfg.n_experts, cfg.experts_per_token
+    t = b * s
+    n = t * k
+    cap = int(math.ceil(cfg.capacity_factor * n / e))
+    cap = min(t, ((cap + 127) // 128) * 128)  # MXU-tile multiple
+
+    xt = x.reshape(t, d)
+    top_w, top_idx, aux = _router(cfg, xt, lp)
+
+    flat_e = top_idx.reshape(-1)                           # [N]
+    onehot = jax.nn.one_hot(flat_e, e, dtype=jnp.int32)
+    rank = ((jnp.cumsum(onehot, axis=0) - onehot) * onehot).sum(-1)  # [N]
+    keep = rank < cap
+    trash = e * cap                                        # overflow row
+    dst = jnp.where(keep, flat_e * cap + rank, trash)      # [N] unique slots
+    pair_tok = jnp.arange(n, dtype=jnp.int32) // k
+    sx = jnp.take(xt, pair_tok, axis=0).astype(cdt)        # [N, d]
+    buf = jnp.zeros((e * cap + 1, d), cdt).at[dst].set(sx)
+    xg = buf[:e * cap].reshape(e, cap, d)
+
+    gate = jnp.einsum("ecd,edf->ecf", xg, lp["w_gate"].astype(cdt))
+    up = jnp.einsum("ecd,edf->ecf", xg, lp["w_up"].astype(cdt))
+    out = jnp.einsum("ecf,efd->ecd", jax.nn.silu(gate) * up,
+                     lp["w_down"].astype(cdt))
+
+    # fill-mode gather: overflow slots (dst == e*cap) read zeros without a
+    # concatenate copy of the [E*cap, d] output
+    pair_out = out.reshape(e * cap, d).at[dst].get(
+        mode="fill", fill_value=0)
+    w_pair = (top_w.reshape(-1) * keep).astype(pair_out.dtype)
+    y = jnp.zeros((t, d), pair_out.dtype).at[pair_tok].add(
+        pair_out * w_pair[:, None])
+    return y.reshape(b, s, d), aux
+
+
 def moe_block(cfg: MoEConfig, x, lp, mesh):
     """Capacity-bounded top-k MoE FFN (GShard-style dense dispatch).
 
@@ -249,6 +305,8 @@ def moe_block(cfg: MoEConfig, x, lp, mesh):
     not a bitwise repro of a meshed run. Force dispatch="dense" when
     reproducing meshed numerics on one chip (see MoEConfig.dispatch).
     """
+    if cfg.dispatch == "sorted_capacity":
+        return moe_block_sorted_capacity(cfg, x, lp)
     if cfg.dispatch == "ragged" or (cfg.dispatch == "auto" and mesh is None):
         return moe_block_ragged(cfg, x, lp)
     b, s, d = x.shape

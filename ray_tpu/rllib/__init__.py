@@ -35,6 +35,15 @@ from ray_tpu.rllib.env import CartPoleEnv, EnvSpec, PendulumEnv, register_env
 from ray_tpu.rllib.env_runner import EnvRunner
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, IMPALALearner, vtrace
 from ray_tpu.rllib.learner import PPOLearner
+from ray_tpu.rllib.multi_agent import (
+    MultiAgentCartPole,
+    MultiAgentEnv,
+    MultiAgentEnvRunner,
+    MultiAgentPPO,
+    MultiAgentPPOConfig,
+    MultiRLModule,
+    register_multi_agent_env,
+)
 from ray_tpu.rllib.offline import BC, BCConfig, MARWIL, MARWILConfig
 from ray_tpu.rllib.replay import ReplayBuffer
 from ray_tpu.rllib.sac import SAC, SACConfig, SACLearner, SACModule
@@ -69,6 +78,13 @@ __all__ = [
     "SequenceReplay",
     "EnvRunner",
     "IMPALA",
+    "MultiAgentCartPole",
+    "MultiAgentEnv",
+    "MultiAgentEnvRunner",
+    "MultiAgentPPO",
+    "MultiAgentPPOConfig",
+    "MultiRLModule",
+    "register_multi_agent_env",
     "IMPALAConfig",
     "IMPALALearner",
     "vtrace",

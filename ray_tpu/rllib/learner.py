@@ -58,24 +58,28 @@ class PPOLearner:
 
     # -- jitted update --------------------------------------------------
 
-    def _loss(self, params, obs, actions, old_logp, advantages, returns):
+    def _loss(self, params, obs, actions, old_logp, advantages, returns, w):
+        """``w`` [n] row weights: 1 for live rows, 0 for padding (multi-agent
+        streams where the agent was already done; see multi_agent.py)."""
         logits, values = self.module.forward(params, obs)
         logp_all = jax.nn.log_softmax(logits)
         logp = jnp.take_along_axis(logp_all, actions[:, None], axis=1)[:, 0]
         ratio = jnp.exp(logp - old_logp)
         clipped = jnp.clip(ratio, 1.0 - self.clip, 1.0 + self.clip)
-        policy_loss = -jnp.mean(jnp.minimum(ratio * advantages,
-                                            clipped * advantages))
-        value_loss = jnp.mean((values - returns) ** 2)
-        entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+        denom = jnp.maximum(w.sum(), 1.0)
+        policy_loss = -(jnp.minimum(ratio * advantages,
+                                    clipped * advantages) * w).sum() / denom
+        value_loss = ((values - returns) ** 2 * w).sum() / denom
+        neg_ent = jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1)
+        entropy = -(neg_ent * w).sum() / denom
         total = policy_loss + self.vf_coef * value_loss - self.ent_coef * entropy
         return total, {"policy_loss": policy_loss, "value_loss": value_loss,
                        "entropy": entropy}
 
     def _update_impl(self, params, opt_state, key, batch):
-        obs, actions, old_logp, advantages, returns = (
+        obs, actions, old_logp, advantages, returns, w = (
             batch["obs"], batch["actions"], batch["logp"],
-            batch["advantages"], batch["returns"])
+            batch["advantages"], batch["returns"], batch["mask"])
         n = obs.shape[0]
         mb = min(self.minibatch, n)
         num_mb = max(n // mb, 1)
@@ -89,7 +93,7 @@ class PPOLearner:
                 sel = jax.lax.dynamic_slice_in_dim(perm, idx * mb, mb)
                 (_, aux), grads = jax.value_and_grad(self._loss, has_aux=True)(
                     params, obs[sel], actions[sel], old_logp[sel],
-                    advantages[sel], returns[sel])
+                    advantages[sel], returns[sel], w[sel])
                 updates, opt_state = self.optimizer.update(grads, opt_state, params)
                 params = optax.apply_updates(params, updates)
                 return (params, opt_state), aux
@@ -111,9 +115,15 @@ class PPOLearner:
         values = jnp.asarray(samples["values"])
         dones = jnp.asarray(samples["dones"])
         bootstrap = jnp.asarray(samples["bootstrap_value"])
+        mask = (jnp.asarray(samples["mask"], jnp.float32)
+                if "mask" in samples else jnp.ones_like(rewards))
         advantages, returns = compute_gae(
             rewards, values, dones, bootstrap, self.gamma, self.lam)
-        adv = (advantages - advantages.mean()) / (advantages.std() + 1e-8)
+        # masked normalization: padding rows must not pollute the statistics
+        denom = jnp.maximum(mask.sum(), 1.0)
+        mean = (advantages * mask).sum() / denom
+        var = (((advantages - mean) ** 2) * mask).sum() / denom
+        adv = (advantages - mean) / (jnp.sqrt(var) + 1e-8)
 
         flat = {
             "obs": jnp.asarray(samples["obs"]).reshape(-1, samples["obs"].shape[-1]),
@@ -121,6 +131,7 @@ class PPOLearner:
             "logp": jnp.asarray(samples["logp"]).reshape(-1),
             "advantages": adv.reshape(-1),
             "returns": returns.reshape(-1),
+            "mask": mask.reshape(-1),
         }
         self._key, sub = jax.random.split(self._key)
         self.params, self.opt_state, aux = self._update(
@@ -129,3 +140,11 @@ class PPOLearner:
 
     def get_params(self):
         return self.params
+
+    def set_state(self, state):
+        """Restore params + optimizer state (checkpoint round-trip)."""
+        self.params = jax.tree.map(jnp.asarray, state["params"])
+        self.opt_state = jax.tree.map(jnp.asarray, state["opt_state"])
+
+    def get_state(self):
+        return {"params": self.params, "opt_state": self.opt_state}

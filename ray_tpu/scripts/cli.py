@@ -466,6 +466,25 @@ def cmd_job(args) -> int:
     return 0
 
 
+def cmd_up(args) -> int:
+    from ray_tpu.autoscaler.launcher import create_or_update_cluster
+
+    state = create_or_update_cluster(args.config, no_setup=args.no_setup)
+    print(f"cluster up: {state['address']}")
+    print(f"  workers: {len(state.get('workers', state.get('worker_ips', [])))}")
+    print("connect drivers with:")
+    print(f"  export RAY_TPU_ADDRESS={state['address']}")
+    return 0
+
+
+def cmd_down(args) -> int:
+    from ray_tpu.autoscaler.launcher import teardown_cluster
+
+    teardown_cluster(args.config)
+    print("cluster down")
+    return 0
+
+
 # ---------------------------------------------------------------------------
 
 
@@ -494,6 +513,18 @@ def main(argv=None) -> int:
 
     sp = sub.add_parser("stop", help="stop all locally-started nodes")
     sp.set_defaults(fn=cmd_stop)
+
+    sp = sub.add_parser(
+        "up", help="launch a cluster from a cluster.yaml "
+        "(reference: `ray up`, commands.py:222)")
+    sp.add_argument("config", help="path to cluster.yaml")
+    sp.add_argument("--no-setup", action="store_true",
+                    help="skip setup_commands")
+    sp.set_defaults(fn=cmd_up)
+
+    sp = sub.add_parser("down", help="tear down a cluster from its yaml")
+    sp.add_argument("config", help="path to cluster.yaml")
+    sp.set_defaults(fn=cmd_down)
 
     for name, fn in (("status", cmd_status), ("timeline", cmd_timeline)):
         sp = sub.add_parser(name)

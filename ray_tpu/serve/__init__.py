@@ -16,11 +16,14 @@ from ray_tpu.serve.api import (
     shutdown,
     status,
 )
+from ray_tpu.serve.asgi import build_asgi_deployment, ingress
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 
 __all__ = [
+    "ingress",
+    "build_asgi_deployment",
     "multiplexed",
     "get_multiplexed_model_id",
     "deployment",
@@ -46,10 +49,13 @@ def start_http_proxy(host: str = "127.0.0.1", port: int = 8000):
     return start_proxy(host, port)
 
 
-def add_route(route_prefix: str, handle: DeploymentHandle):
+def add_route(route_prefix: str, handle: DeploymentHandle, *,
+              asgi: bool = False):
+    """``asgi=True`` mounts a serve.ingress(app) deployment: raw requests
+    forwarded, websocket upgrades enabled (reference: serve/api.py:174)."""
     from ray_tpu.serve._private.proxy import register_route
 
-    register_route(route_prefix, handle)
+    register_route(route_prefix, handle, asgi=asgi)
 
 
 def start_rpc_proxy(host: str = "127.0.0.1", port: int = 0):

@@ -32,6 +32,7 @@ _HANDLE_TIMEOUT_S = 60.0
 class _ProxyState:
     def __init__(self):
         self.routes: Dict[str, object] = {}  # route_prefix -> DeploymentHandle
+        self.asgi: Dict[str, bool] = {}      # route_prefix -> mounts ASGI app
         self.lock = threading.Lock()
 
 
@@ -41,11 +42,17 @@ _proxy: Optional["_AsyncProxy"] = None
 
 def match_route(path: str):
     """Longest-prefix route match, shared by every ingress (HTTP + RPC)."""
+    return (match_route_full(path) or (None,) * 3)[0]
+
+
+def match_route_full(path: str):
+    """(handle, route_prefix, is_asgi) or None."""
     with _state.lock:
         routes = dict(_state.routes)
+        asgi = dict(_state.asgi)
     for prefix, handle in sorted(routes.items(), key=lambda kv: -len(kv[0])):
         if path == prefix or path.startswith(prefix.rstrip("/") + "/") or prefix == "/":
-            return handle
+            return handle, prefix, asgi.get(prefix, False)
     return None
 
 
@@ -201,7 +208,12 @@ class _AsyncProxy:
                 if req is None:
                     break
                 method, target, headers, body = req
-                keep = await self._dispatch(writer, method, target, body)
+                if headers.get("upgrade", "").lower() == "websocket":
+                    await self._handle_websocket(
+                        reader, writer, method, target, headers)
+                    break  # ws owns the connection until close
+                keep = await self._dispatch(writer, method, target, headers,
+                                            body)
                 if not keep:
                     break
         except (ConnectionResetError, BrokenPipeError, asyncio.CancelledError):
@@ -214,13 +226,18 @@ class _AsyncProxy:
             except Exception:  # noqa: BLE001
                 pass
 
-    async def _dispatch(self, writer, method: str, target: str, body: bytes) -> bool:
+    async def _dispatch(self, writer, method: str, target: str,
+                        headers: Dict[str, str], body: bytes) -> bool:
         path = target.split("?")[0]
-        handle = match_route(path)
-        if handle is None:
+        matched = match_route_full(path)
+        if matched is None:
             writer.write(self._response(404, b'{"error": "no route"}'))
             await writer.drain()
             return True
+        handle, prefix, is_asgi = matched
+        if is_asgi:
+            return await self._dispatch_asgi(
+                writer, handle, prefix, method, target, headers, body)
         try:
             payload = json.loads(body) if body else None
         except json.JSONDecodeError:
@@ -325,6 +342,202 @@ class _AsyncProxy:
                     break
 
 
+    # -- ASGI app forwarding (reference: serve/api.py:174 @serve.ingress) --
+
+    async def _dispatch_asgi(self, writer, handle, prefix, method, target,
+                             headers, body) -> bool:
+        path = target.split("?")[0]
+        query = target.split("?", 1)[1] if "?" in target else ""
+        sub_path = path[len(prefix.rstrip("/")):] or "/"
+        request = {"method": method, "path": sub_path, "root_path":
+                   prefix.rstrip("/"), "query": query, "headers": headers,
+                   "body": body}
+        loop = asyncio.get_running_loop()
+
+        def call():
+            return handle.remote(request).result(timeout_s=_HANDLE_TIMEOUT_S)
+
+        try:
+            resp = await loop.run_in_executor(self._pool, call)
+            rbody = resp.get("body", b"")
+            hdrs = [(k, v) for k, v in resp.get("headers", [])
+                    if k.lower() not in ("content-length", "connection",
+                                         "transfer-encoding")]
+            head = [f"HTTP/1.1 {resp.get('status', 200)} X"]
+            for k, v in hdrs:
+                head.append(f"{k}: {v}")
+            head.append(f"Content-Length: {len(rbody)}")
+            head.append("Connection: keep-alive")
+            writer.write(("\r\n".join(head) + "\r\n\r\n").encode("latin1")
+                         + bytes(rbody))
+        except Exception as e:  # noqa: BLE001
+            writer.write(self._response(
+                500, json.dumps({"error": str(e)}).encode()))
+        await writer.drain()
+        return True
+
+    # -- websockets (reference: serve/_private/http_util.py:335-351) -------
+
+    async def _handle_websocket(self, reader, writer, method, target,
+                                headers):
+        import base64
+        import hashlib
+        import uuid
+
+        path = target.split("?")[0]
+        matched = match_route_full(path)
+        key = headers.get("sec-websocket-key")
+        if matched is None or not matched[2] or not key:
+            writer.write(self._response(
+                404 if matched is None else 400,
+                b'{"error": "no websocket route"}', keep_alive=False))
+            await writer.drain()
+            return
+        handle, prefix, _ = matched
+        cid = uuid.uuid4().hex
+        loop = asyncio.get_running_loop()
+        # the whole session is PINNED to one replica: the ASGI websocket
+        # session object lives there (handle.pinned() docstring)
+        pinned = handle.pinned()
+
+        def call(payload):
+            return pinned.remote(payload).result(timeout_s=_HANDLE_TIMEOUT_S)
+
+        sub_path = path[len(prefix.rstrip("/")):] or "/"
+        connect = {"__ws__": "connect", "id": cid, "path": sub_path,
+                   "root_path": prefix.rstrip("/"), "headers": headers,
+                   "method": "GET"}
+        try:
+            resp = await loop.run_in_executor(self._pool, call, connect)
+        except Exception:  # noqa: BLE001
+            writer.write(self._response(500, b'{"error": "ws connect"}',
+                                        keep_alive=False))
+            await writer.drain()
+            return
+        if not resp.get("accepted"):
+            writer.write(self._response(403, b'{"error": "rejected"}',
+                                        keep_alive=False))
+            await writer.drain()
+            return
+        accept = base64.b64encode(hashlib.sha1(
+            key.encode() + b"258EAFA5-E914-47DA-95CA-C5AB0DC85B11").digest())
+        writer.write(b"HTTP/1.1 101 Switching Protocols\r\n"
+                     b"Upgrade: websocket\r\nConnection: Upgrade\r\n"
+                     b"Sec-WebSocket-Accept: " + accept + b"\r\n\r\n")
+        await writer.drain()
+        for m in resp.get("messages", []):
+            writer.write(_ws_frame(m))
+        await writer.drain()
+        try:
+            while True:
+                frame = await _ws_read_message(reader)
+                if frame is None or frame[0] == 0x8:  # EOF / close
+                    break
+                opcode, payload = frame
+                if opcode == 0x9:  # ping -> pong
+                    writer.write(_ws_raw_frame(0xA, payload))
+                    await writer.drain()
+                    continue
+                if opcode == 0xA:  # unsolicited pong keepalive: ignore
+                    continue
+                msg = {"__ws__": "message", "id": cid}
+                if opcode == 0x1:
+                    msg["text"] = payload.decode("utf-8", "replace")
+                else:
+                    msg["bytes"] = payload
+                resp = await loop.run_in_executor(self._pool, call, msg)
+                for m in resp.get("messages", []):
+                    writer.write(_ws_frame(m))
+                await writer.drain()
+                if resp.get("closed"):
+                    break
+        except (ConnectionResetError, BrokenPipeError,
+                asyncio.IncompleteReadError):
+            pass
+        finally:
+            try:
+                await loop.run_in_executor(
+                    self._pool, call, {"__ws__": "disconnect", "id": cid})
+            except Exception:  # noqa: BLE001
+                pass
+            try:
+                writer.write(_ws_raw_frame(0x8, b""))
+                await writer.drain()
+            except Exception:  # noqa: BLE001
+                pass
+
+
+def _ws_raw_frame(opcode: int, payload: bytes) -> bytes:
+    """Server->client frame (unmasked, RFC 6455)."""
+    head = bytes([0x80 | opcode])
+    n = len(payload)
+    if n < 126:
+        head += bytes([n])
+    elif n < 1 << 16:
+        head += bytes([126]) + n.to_bytes(2, "big")
+    else:
+        head += bytes([127]) + n.to_bytes(8, "big")
+    return head + payload
+
+
+def _ws_frame(message: dict) -> bytes:
+    if message.get("text") is not None:
+        return _ws_raw_frame(0x1, message["text"].encode())
+    return _ws_raw_frame(0x2, bytes(message.get("bytes", b"")))
+
+
+async def _ws_read_frame(reader):
+    """Read one client frame; returns (fin, opcode, unmasked payload) or
+    None at EOF."""
+    try:
+        b1b2 = await reader.readexactly(2)
+    except asyncio.IncompleteReadError:
+        return None
+    fin = bool(b1b2[0] & 0x80)
+    opcode = b1b2[0] & 0x0F
+    masked = b1b2[1] & 0x80
+    n = b1b2[1] & 0x7F
+    if n == 126:
+        n = int.from_bytes(await reader.readexactly(2), "big")
+    elif n == 127:
+        n = int.from_bytes(await reader.readexactly(8), "big")
+    if n > _MAX_BODY:
+        return None
+    mask = await reader.readexactly(4) if masked else b"\x00" * 4
+    payload = bytearray(await reader.readexactly(n))
+    if masked:
+        for i in range(n):
+            payload[i] ^= mask[i & 3]
+    return fin, opcode, bytes(payload)
+
+
+async def _ws_read_message(reader):
+    """Read one complete MESSAGE, reassembling FIN=0 fragments +
+    continuation (0x0) frames (RFC 6455 §5.4). Control frames (ping/pong/
+    close) may interleave inside a fragmented message and are returned
+    immediately. Returns (opcode, payload) or None at EOF."""
+    data_opcode = None
+    parts = []
+    while True:
+        frame = await _ws_read_frame(reader)
+        if frame is None:
+            return None
+        fin, opcode, payload = frame
+        if opcode >= 0x8:  # control frame: never fragmented
+            return opcode, payload
+        if opcode in (0x1, 0x2):
+            data_opcode = opcode
+            parts = [payload]
+        elif opcode == 0x0:
+            if data_opcode is None:
+                return None  # stray continuation: protocol error -> close
+            parts.append(payload)
+        if fin and data_opcode is not None:
+            return data_opcode, b"".join(parts)
+        if sum(len(p) for p in parts) > _MAX_BODY:
+            return None
+
+
 def start_proxy(host: str = "127.0.0.1", port: int = 8000) -> Tuple[str, int]:
     global _proxy
     if _proxy is not None:
@@ -340,11 +553,15 @@ def stop_proxy():
         _proxy = None
 
 
-def register_route(route_prefix: str, handle):
+def register_route(route_prefix: str, handle, *, asgi: bool = False):
+    """``asgi=True``: the deployment mounts an ASGI app (serve/asgi.py) —
+    the proxy forwards raw requests and enables websocket upgrades."""
     with _state.lock:
         _state.routes[route_prefix] = handle
+        _state.asgi[route_prefix] = asgi
 
 
 def unregister_route(route_prefix: str):
     with _state.lock:
         _state.routes.pop(route_prefix, None)
+        _state.asgi.pop(route_prefix, None)

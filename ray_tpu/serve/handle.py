@@ -181,6 +181,27 @@ class DeploymentHandle:
                 self._router.invalidate()
         raise last_err
 
+    def pinned(self) -> "PinnedReplicaHandle":
+        """Choose one replica NOW; every subsequent call lands on it.
+
+        Stateful per-connection protocols (ASGI websocket sessions,
+        serve/asgi.py) must talk to the replica holding their session —
+        the pow-2 router would scatter the calls. A dead pinned replica
+        fails the call (the session died with it; reference behaviour:
+        websockets drop on replica loss)."""
+        return PinnedReplicaHandle(self._router.choose_replica(),
+                                   self._method)
+
     def __reduce__(self):
         return (DeploymentHandle,
                 (self._app, self._dep, self._method, self._stream))
+
+
+class PinnedReplicaHandle:
+    def __init__(self, replica, method_name: str = "__call__"):
+        self._replica = replica
+        self._method = method_name
+
+    def remote(self, *args, **kwargs) -> "DeploymentResponse":
+        ref = self._replica.handle_request.remote(self._method, args, kwargs)
+        return DeploymentResponse(ref, None)

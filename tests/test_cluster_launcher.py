@@ -1,0 +1,151 @@
+"""Cluster launcher: `ray_tpu up/down <cluster.yaml>` end to end.
+
+Done-criterion (VERDICT r3 #6): up a 2-node local cluster from yaml, submit
+a job against it, down it clean.  reference: autoscaler/_private/
+commands.py:222, command_runner.py:159, gcp/tpu_command_runner.py:148.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+pytestmark = pytest.mark.slow  # module lane: see pytest.ini
+
+YAML = """
+cluster_name: launchertest
+provider:
+  type: local
+head_node:
+  resources: {CPU: 2}
+worker_node_groups:
+  - name: cpu-workers
+    count: 2
+    resources: {CPU: 2, bonus: 1}
+    labels: {tier: worker}
+setup_commands:
+  - "echo setup-ran > @MARKER@"
+"""
+
+
+def _run(tmp_path, *argv, timeout=240):
+    env = dict(os.environ)
+    env["RAY_TPU_CLUSTER_STATE_DIR"] = str(tmp_path / "state")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.pop("RAY_TPU_ADDRESS", None)
+    p = subprocess.run([sys.executable, "-m", "ray_tpu", *argv],
+                       capture_output=True, text=True, timeout=timeout,
+                       env=env)
+    assert p.returncode == 0, f"{argv}:\n{p.stdout}\n{p.stderr}"
+    return p.stdout
+
+
+def test_up_submit_down(tmp_path):
+    marker = tmp_path / "setup_marker.txt"
+    cfg = tmp_path / "cluster.yaml"
+    cfg.write_text(YAML.replace("@MARKER@", str(marker)))
+
+    out = _run(tmp_path, "up", str(cfg))
+    assert "cluster up:" in out
+    address = [ln for ln in out.splitlines() if "RAY_TPU_ADDRESS=" in ln][0]
+    address = address.split("=", 1)[1].strip()
+    try:
+        # setup command ran through the command runner
+        assert marker.read_text().strip() == "setup-ran"
+
+        # the cluster really has head + 2 workers with the yaml resources
+        status = _run(tmp_path, "status", "--address", address)
+        assert "3 alive" in status
+        assert "bonus" in status
+
+        # submit a job that uses a worker-group resource end to end
+        script = ("import ray_tpu; ray_tpu.init('auto'); "
+                  "f = ray_tpu.remote(lambda: 'on-worker')"
+                  ".options(resources={'bonus': 1}); "
+                  "print(ray_tpu.get(f.remote()))")
+        job = _run(tmp_path, "job", "submit", "--address", address, "--wait",
+                   "--", f"{sys.executable} -c \"{script}\"", timeout=300)
+        assert "SUCCEEDED" in job and "on-worker" in job
+    finally:
+        _run(tmp_path, "down", str(cfg))
+
+    # down is clean: every node pid from the state dir is dead
+    sessions = list((tmp_path / "state" / "launchertest" / "sessions")
+                    .glob("session_*.json"))
+    assert sessions == [], f"sessions survived down: {sessions}"
+
+
+def test_yaml_validation(tmp_path):
+    from ray_tpu.autoscaler.launcher import load_cluster_config
+
+    bad = tmp_path / "bad.yaml"
+    bad.write_text("cluster_name: x\nprovider: {type: bogus}\n")
+    with pytest.raises(ValueError, match="provider.type"):
+        load_cluster_config(str(bad))
+    bad.write_text("provider: {type: local}\n")
+    with pytest.raises(ValueError, match="cluster_name"):
+        load_cluster_config(str(bad))
+
+
+def test_tpu_pod_command_runner_fanout():
+    """One command must reach every pod worker; one failure fails the gang."""
+    from ray_tpu.autoscaler.launcher import (
+        CommandRunner,
+        TPUPodCommandRunner,
+    )
+
+    class FakeRunner(CommandRunner):
+        def __init__(self, rc):
+            self.rc = rc
+            self.saw = []
+
+        def run(self, cmd, *, timeout=300.0):
+            self.saw.append(cmd)
+            return self.rc, f"rc={self.rc}"
+
+    good = [FakeRunner(0) for _ in range(4)]
+    pod = TPUPodCommandRunner(good)
+    code, out = pod.run("bootstrap")
+    assert code == 0 and all(r.saw == ["bootstrap"] for r in good)
+
+    code, out = TPUPodCommandRunner(good[:2] + [FakeRunner(7)]).run("x")
+    assert code == 7 and "[worker 2]" in out
+
+
+def test_gce_provider_path_with_mock_transport(tmp_path, monkeypatch):
+    """The gce_tpu provider path drives the real GCE provider through an
+    injected transport (hermetic: no cloud calls)."""
+    import yaml
+
+    from ray_tpu.autoscaler import launcher as mod
+
+    calls = []
+
+    def transport(method, url, body=None):
+        calls.append((method, url))
+        if method == "POST":
+            return {"name": "op"}
+        if "/operations/" in url or url.endswith("op"):
+            return {"done": True}
+        if url.endswith("/nodes") or "/nodes?" in url:
+            return {"nodes": []}
+        return {"state": "READY",
+                "networkEndpoints": [{"ipAddress": "10.0.0.5"}]}
+
+    monkeypatch.setenv("RAY_TPU_CLUSTER_STATE_DIR", str(tmp_path / "state"))
+    cfg_path = tmp_path / "gce.yaml"
+    cfg_path.write_text(yaml.safe_dump({
+        "cluster_name": "gcetest",
+        "provider": {"type": "gce_tpu", "project": "p", "zone": "z"},
+        "worker_node_groups": [
+            {"name": "tpus", "count": 1, "resources": {"TPU": 4}}],
+    }))
+    cfg = mod.load_cluster_config(str(cfg_path))
+    cfg.provider["_transport"] = transport
+    monkeypatch.setattr(mod, "load_cluster_config", lambda p: cfg)
+    state = mod.create_or_update_cluster(str(cfg_path), no_setup=True)
+    assert any(m == "POST" for m, _ in calls)
+    mod.teardown_cluster(str(cfg_path))

@@ -161,6 +161,37 @@ def test_autoscaler_scales_up_on_demand_and_down_on_idle(ray_start_cluster):
     assert not provider.non_terminated_node_groups()
 
 
+def test_request_resources_sdk(ray_start_cluster):
+    """Explicit demand floor (reference: ray.autoscaler.sdk
+    .request_resources): the autoscaler provisions for requested bundles
+    even with nothing queued, and the floor clears."""
+    from ray_tpu.autoscaler import Autoscaler, InProcessNodeProvider, NodeGroupSpec
+    from ray_tpu.autoscaler.sdk import request_resources
+
+    cluster = ray_start_cluster()
+    cluster.add_node(num_cpus=1)
+    w = cluster.connect_driver()
+
+    provider = InProcessNodeProvider(cluster)
+    scaler = Autoscaler(
+        provider,
+        [NodeGroupSpec("cpu-worker", {"CPU": 4.0}, count=1, max_groups=3)],
+        worker=w, idle_timeout_s=3600)
+
+    assert not scaler.pending_demands()  # nothing queued
+    request_resources(bundles=[{"CPU": 4.0}], _worker=w)
+    assert {"CPU": 4.0} in scaler.pending_demands()
+    result = scaler.reconcile_once()
+    assert result["launched"] == ["cpu-worker"]
+    # capacity now satisfies the floor: no repeat launches
+    assert not scaler.pending_demands()
+    # clearing removes the floor entirely
+    request_resources(_worker=w)
+    assert not scaler.pending_demands()
+    provider.terminate_node_group(
+        list(provider.non_terminated_node_groups())[0])
+
+
 def test_autoscaler_tpu_slice_provider(ray_start_cluster):
     from ray_tpu.autoscaler import Autoscaler, NodeGroupSpec, TpuSliceNodeProvider
 

@@ -213,3 +213,81 @@ def test_prefetch_propagates_errors(cluster):
     ds = rdata.range(4, parallelism=2).map_batches(boom, batch_size=None)
     with pytest.raises(Exception):
         list(ds.iter_batches(batch_size=None, prefetch_batches=2))
+
+
+# -- optimizer pushdown (reference: _internal/logical/rules/) ---------------
+
+
+def _parquet_table(tmp_path, name="t.parquet", rows=100):
+    import pyarrow.parquet as pq
+
+    t = pa.table({
+        "id": np.arange(rows),
+        "val": np.arange(rows) * 2.0,
+        "tag": [f"tag{i % 3}" for i in range(rows)],
+    })
+    path = str(tmp_path / name)
+    pq.write_table(t, path, row_group_size=10)
+    return path
+
+
+def test_projection_pushdown_into_parquet(cluster, tmp_path):
+    path = _parquet_table(tmp_path)
+    ds = rdata.read_parquet(path).select_columns(["id"])
+    ops = ds._plan.optimized_ops()
+    # the SelectColumns op was absorbed into the Read
+    assert len(ops) == 1 and ops[0].columns == ["id"]
+    rows = ds.take_all()
+    assert len(rows) == 100 and set(rows[0]) == {"id"}
+
+
+def test_predicate_pushdown_into_parquet(cluster, tmp_path):
+    path = _parquet_table(tmp_path)
+    ds = rdata.read_parquet(path).filter(expr="id >= 90")
+    ops = ds._plan.optimized_ops()
+    assert len(ops) == 1 and ops[0].predicate == [("id", ">=", 90)]
+    rows = sorted(r["id"] for r in ds.take_all())
+    assert rows == list(range(90, 100))
+
+
+def test_pushdown_chain_and_string_predicate(cluster, tmp_path):
+    path = _parquet_table(tmp_path)
+    ds = (rdata.read_parquet(path)
+          .filter(expr="tag == 'tag1'")
+          .select_columns(["id", "tag"]))
+    ops = ds._plan.optimized_ops()
+    assert len(ops) == 1
+    assert ops[0].predicate == [("tag", "==", "tag1")]
+    assert ops[0].columns == ["id", "tag"]
+    rows = ds.take_all()
+    assert all(r["tag"] == "tag1" and set(r) == {"id", "tag"} for r in rows)
+    assert len(rows) == 33  # ids 1, 4, ..., 97
+
+
+def test_expr_filter_without_pushdown_source(cluster):
+    """Expression filters on non-pushdown sources run as exact block
+    filters — same rows, no plan rewrite."""
+    ds = rdata.range(50).filter(expr="id < 5")
+    ops = ds._plan.optimized_ops()
+    assert len(ops) == 2  # Read + Filter survive
+    assert sorted(r["id"] for r in ds.take_all()) == [0, 1, 2, 3, 4]
+
+
+def test_opaque_fn_blocks_pushdown(cluster, tmp_path):
+    path = _parquet_table(tmp_path)
+    ds = (rdata.read_parquet(path)
+          .filter(lambda r: r["id"] % 2 == 0)      # opaque: stops the scan
+          .select_columns(["id"]))
+    ops = ds._plan.optimized_ops()
+    assert len(ops) == 3  # nothing absorbed
+    rows = ds.take_all()
+    assert len(rows) == 50 and set(rows[0]) == {"id"}
+
+
+def test_filter_expr_validation(cluster):
+    with pytest.raises(ValueError, match="exactly one"):
+        rdata.range(5).filter(lambda r: True, expr="id > 1")
+    with pytest.raises(ValueError, match="filter expr"):
+        rdata.range(5).filter(expr="no operator here")
+    with pytest.raises(ValueError, match="literal"):
+        rdata.range(5).filter(expr="id > unquoted")

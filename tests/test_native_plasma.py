@@ -129,3 +129,41 @@ def test_store_uses_native_backend(ray_start_regular):
 
     out2 = ray_tpu.get(double.remote(ref), timeout=60)
     np.testing.assert_array_equal(out2, big * 2)
+
+
+def test_concurrent_hammer(lib):
+    """Threads racing alloc/seal/get/unpin/free against one arena: the
+    store's internal mutex must hold up — this is the workload that gives
+    the TSAN lane (tests/test_native_tsan.py) real interleavings to check."""
+    import threading
+
+    name = f"hammer-arena-{os.getpid()}"
+    h = ctypes.c_void_p(lib.plasma_create(name.encode(), 4 << 20))
+    assert h
+    errors = []
+
+    def worker(wid):
+        try:
+            for i in range(200):
+                key = f"w{wid}-o{i}".encode()
+                off = lib.plasma_alloc(h, key, 512)
+                if off == 2**64 - 1:
+                    continue  # arena full: other threads hold the space
+                assert lib.plasma_seal(h, key) == 0
+                o, s = ctypes.c_uint64(), ctypes.c_uint64()
+                assert lib.plasma_get(h, key, ctypes.byref(o),
+                                      ctypes.byref(s)) == 0
+                assert s.value == 512
+                assert lib.plasma_unpin(h, key) == 0  # get's pin
+                if i % 3 == 0:
+                    lib.plasma_free(h, key)
+        except Exception as e:  # noqa: BLE001
+            errors.append(f"w{wid}: {type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=worker, args=(w,)) for w in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+    lib.plasma_destroy(h)
+    assert not errors, errors

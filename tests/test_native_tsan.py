@@ -1,0 +1,58 @@
+"""TSAN lane for the native components (closes the sanitizer gap vs the
+reference's .bazelrc:114-121 tsan config; the ASAN lane is
+tests/test_native_asan.py).
+
+Builds lib*.tsan.so (-fsanitize=thread) and runs the native test suite —
+including the concurrent plasma hammer in test_native_plasma.py, which is
+what gives TSAN actual interleavings to check — in a subprocess with
+libtsan preloaded.  Any data race report fails the lane.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+
+def _lib_path(name):
+    try:
+        out = subprocess.run(["g++", f"-print-file-name={name}"],
+                             capture_output=True, text=True, timeout=30)
+        path = out.stdout.strip()
+        return path if os.path.isabs(path) and os.path.exists(path) else None
+    except (FileNotFoundError, subprocess.TimeoutExpired):
+        return None
+
+
+def test_native_suite_under_tsan():
+    libtsan = _lib_path("libtsan.so") or _lib_path("libtsan.so.2")
+    if libtsan is None:
+        pytest.skip("no g++/libtsan on this host")
+    env = dict(os.environ)
+    prev_preload = env.get("LD_PRELOAD")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    supp = os.path.join(repo, "tests", "tsan.supp")
+    env.update({
+        "RAY_TPU_NATIVE_SANITIZE": "thread",
+        "LD_PRELOAD": libtsan + (":" + prev_preload if prev_preload else ""),
+        # exitcode=66 on report: the assert below must see a hard failure,
+        # not a warning scrolled past in the log. Suppressions scope the
+        # lane to THIS repo's native code (CPython is uninstrumented and
+        # its socket teardown self-reports; see tests/tsan.supp).
+        "TSAN_OPTIONS": f"halt_on_error=1:exitcode=66:suppressions={supp}",
+        "JAX_PLATFORMS": "cpu",
+    })
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider",
+         "tests/test_native_plasma.py", "tests/test_native_sched.py"],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=540)
+    output = proc.stdout + proc.stderr
+    assert "ThreadSanitizer" not in output, output[-4000:]
+    assert proc.returncode == 0, output[-4000:]
+    assert " skipped" not in output, output[-2000:]
+    assert " passed" in output, output[-2000:]
+    assert os.path.exists(os.path.join(
+        repo, "ray_tpu", "_native", "libplasma_store.tsan.so"))

@@ -1,0 +1,102 @@
+"""RLlib multi-agent: MultiAgentEnv protocol, policy mapping, per-policy
+learners, checkpoint round-trip.
+
+Done-criterion (VERDICT r3 #5): a 2-policy env where BOTH policies improve
+and checkpoints round-trip.  reference: rllib/env/multi_agent_env.py:30,
+rllib/core/rl_module/multi_rl_module.py:48.
+"""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def _config():
+    from ray_tpu.rllib import MultiAgentPPOConfig
+
+    return (MultiAgentPPOConfig(
+        num_env_runners=2, num_envs_per_runner=2,
+        rollout_fragment_length=128, minibatch_size=256,
+        lr=3e-4, seed=0)
+        .environment("MultiAgentCartPole")
+        .multi_agent(policies=("left_brain", "right_brain"),
+                     policy_mapping_fn=lambda aid: (
+                         "left_brain" if aid == "agent_0" else "right_brain")))
+
+
+def test_multi_agent_env_protocol():
+    from ray_tpu.rllib import MultiAgentCartPole
+
+    env = MultiAgentCartPole(num_agents=2, seed=0)
+    obs = env.reset(seed=1)
+    assert set(obs) == {"agent_0", "agent_1"}
+    obs, rew, done, _ = env.step({"agent_0": 0, "agent_1": 1})
+    assert set(rew) == {"agent_0", "agent_1"}
+    assert done["__all__"] is False
+    # drive agent_0 to failure: it must drop out while agent_1 continues
+    for _ in range(200):
+        acts = {a: 0 for a in obs}
+        obs, rew, done, _ = env.step(acts)
+        if done.get("__all__"):
+            break
+    assert done["__all__"] is True
+
+
+def test_multi_agent_ppo_both_policies_improve(cluster):
+    algo = _config().build()
+    first = None
+    result = None
+    for _ in range(12):
+        result = algo.train()
+        if first is None and all(
+                result[f"{p}/episode_reward_mean"] > 0
+                for p in ("left_brain", "right_brain")):
+            first = {p: result[f"{p}/episode_reward_mean"]
+                     for p in ("left_brain", "right_brain")}
+    algo.stop()
+    assert first is not None, "no episodes completed"
+    for p in ("left_brain", "right_brain"):
+        assert result[f"{p}/episode_reward_mean"] > max(
+            1.25 * first[p], first[p] + 15.0), (
+            f"{p}: {first[p]} -> {result[f'{p}/episode_reward_mean']}")
+
+
+def test_multi_agent_checkpoint_roundtrip(cluster, tmp_path):
+    import jax
+
+    algo = _config().build()
+    algo.train()
+    path = algo.save_checkpoint(str(tmp_path / "ckpt"))
+    want = algo.get_policy_params()
+    algo.stop()
+
+    algo2 = _config().build()
+    algo2.load_checkpoint(path)
+    got = algo2.get_policy_params()
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b)), want, got)
+    # the restored algorithm keeps training (optimizer state restored too)
+    out = algo2.train()
+    assert np.isfinite(out["left_brain/policy_loss"])
+    algo2.stop()
+
+
+def test_policy_mapping_validation():
+    from ray_tpu.rllib import MultiAgentPPOConfig
+
+    cfg = (MultiAgentPPOConfig(seed=0)
+           .environment("MultiAgentCartPole")
+           .multi_agent(policies=("a",),
+                        policy_mapping_fn=lambda aid: "BOGUS"))
+    with pytest.raises(ValueError, match="unknown ids"):
+        cfg.build()

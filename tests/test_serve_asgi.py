@@ -1,0 +1,210 @@
+"""Serve ASGI embedding + websockets.
+
+Done-criterion (VERDICT r3 #8): an ASGI app (no wheel needed) served
+through a replica with its own routes, plus a websocket echo test.
+reference: python/ray/serve/api.py:174 (@serve.ingress),
+serve/_private/http_util.py:335-351 (websocket proxying).
+"""
+
+import base64
+import hashlib
+import json
+import socket
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4)
+    yield
+    import ray_tpu.serve as serve
+
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def _make_demo_app():
+    """A bare ASGI callable with its own routing — no framework wheel.
+    Built by a factory so the callable is function-LOCAL: cloudpickle then
+    ships it by value to replicas (a module-level fn would pickle as a
+    reference to this test module, unimportable in workers)."""
+
+    async def demo_app(scope, receive, send):
+        if scope["type"] == "http":
+            await receive()
+            if scope["path"] == "/hello":
+                body = json.dumps({
+                    "msg": "hi", "method": scope["method"],
+                    "root": scope["root_path"],
+                    "q": scope["query_string"].decode()}).encode()
+                status = 200
+            elif scope["path"] == "/teapot":
+                body, status = b"short and stout", 418
+            else:
+                body, status = b"nope", 404
+            await send({"type": "http.response.start", "status": status,
+                        "headers": [(b"content-type", b"application/json"),
+                                    (b"x-app", b"demo")]})
+            await send({"type": "http.response.body", "body": body})
+        elif scope["type"] == "websocket":
+            await receive()  # websocket.connect
+            await send({"type": "websocket.accept"})
+            while True:
+                event = await receive()
+                if event["type"] == "websocket.disconnect":
+                    break
+                if event.get("text") == "quit":
+                    await send({"type": "websocket.close", "code": 1000})
+                    break
+                if event.get("text") is not None:
+                    await send({"type": "websocket.send",
+                                "text": f"echo:{event['text']}"})
+                else:
+                    await send({"type": "websocket.send",
+                                "bytes": bytes(reversed(event["bytes"]))})
+
+    return demo_app
+
+
+def _http(host, port, request: bytes) -> bytes:
+    with socket.create_connection((host, port), timeout=30) as s:
+        s.sendall(request)
+        s.settimeout(30)
+        out = b""
+        while b"\r\n\r\n" not in out or len(out) < _expected_len(out):
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            out += chunk
+        return out
+
+
+def _expected_len(buf: bytes) -> int:
+    head, _, _body = buf.partition(b"\r\n\r\n")
+    for line in head.split(b"\r\n"):
+        if line.lower().startswith(b"content-length:"):
+            return len(head) + 4 + int(line.split(b":")[1])
+    return len(buf) + 1
+
+
+@pytest.fixture(scope="module")
+def asgi_route(cluster):
+    import ray_tpu.serve as serve
+
+    @serve.deployment
+    @serve.ingress(_make_demo_app())
+    class DemoApp:
+        pass
+
+    handle = serve.run(DemoApp.bind(), name="asgiapp")
+    host, port = serve.start_http_proxy(port=0)
+    serve.add_route("/app", handle, asgi=True)
+    return host, port
+
+
+def test_asgi_app_own_routes(asgi_route):
+    host, port = asgi_route
+    raw = _http(host, port,
+                b"GET /app/hello?x=1 HTTP/1.1\r\nHost: t\r\n"
+                b"Connection: close\r\n\r\n")
+    head, _, body = raw.partition(b"\r\n\r\n")
+    assert b"200" in head.split(b"\r\n")[0]
+    assert b"x-app: demo" in head.lower()
+    data = json.loads(body)
+    assert data == {"msg": "hi", "method": "GET", "root": "/app", "q": "x=1"}
+
+    raw = _http(host, port, b"GET /app/teapot HTTP/1.1\r\nHost: t\r\n\r\n")
+    assert b"418" in raw.split(b"\r\n")[0]
+    raw = _http(host, port, b"GET /app/missing HTTP/1.1\r\nHost: t\r\n\r\n")
+    assert b"404" in raw.split(b"\r\n")[0]
+
+
+def _ws_client_frame(opcode: int, payload: bytes) -> bytes:
+    mask = b"\x11\x22\x33\x44"
+    masked = bytes(b ^ mask[i & 3] for i, b in enumerate(payload))
+    n = len(payload)
+    assert n < 126
+    return bytes([0x80 | opcode, 0x80 | n]) + mask + masked
+
+
+def _ws_read(sock) -> tuple:
+    head = sock.recv(2)
+    opcode = head[0] & 0x0F
+    n = head[1] & 0x7F
+    assert not head[1] & 0x80  # server frames are unmasked
+    if n == 126:
+        n = int.from_bytes(sock.recv(2), "big")
+    payload = b""
+    while len(payload) < n:
+        payload += sock.recv(n - len(payload))
+    return opcode, payload
+
+
+def test_websocket_echo(asgi_route):
+    host, port = asgi_route
+    key = base64.b64encode(b"0123456789abcdef").decode()
+    with socket.create_connection((host, port), timeout=60) as s:
+        s.sendall((f"GET /app/ws HTTP/1.1\r\nHost: t\r\n"
+                   f"Upgrade: websocket\r\nConnection: Upgrade\r\n"
+                   f"Sec-WebSocket-Key: {key}\r\n"
+                   f"Sec-WebSocket-Version: 13\r\n\r\n").encode())
+        s.settimeout(60)
+        head = b""
+        while b"\r\n\r\n" not in head:
+            head += s.recv(4096)
+        assert b"101" in head.split(b"\r\n")[0]
+        want = base64.b64encode(hashlib.sha1(
+            key.encode() + b"258EAFA5-E914-47DA-95CA-C5AB0DC85B11").digest())
+        assert want in head
+
+        s.sendall(_ws_client_frame(0x1, b"hello"))
+        opcode, payload = _ws_read(s)
+        assert (opcode, payload) == (0x1, b"echo:hello")
+
+        s.sendall(_ws_client_frame(0x2, b"\x01\x02\x03"))
+        opcode, payload = _ws_read(s)
+        assert (opcode, payload) == (0x2, b"\x03\x02\x01")
+
+        # ping -> pong handled at the proxy
+        s.sendall(_ws_client_frame(0x9, b"pp"))
+        opcode, payload = _ws_read(s)
+        assert (opcode, payload) == (0xA, b"pp")
+
+        # fragmented text message (FIN=0 + continuation) reassembles
+        def _frag(opcode, payload, fin):
+            mask = b"\x01\x02\x03\x04"
+            masked = bytes(b ^ mask[i & 3] for i, b in enumerate(payload))
+            return (bytes([(0x80 if fin else 0) | opcode,
+                           0x80 | len(payload)]) + mask + masked)
+
+        s.sendall(_frag(0x1, b"fra", fin=False))
+        s.sendall(_frag(0x0, b"gment", fin=True))
+        opcode, payload = _ws_read(s)
+        assert (opcode, payload) == (0x1, b"echo:fragment")
+
+        # app-initiated close propagates
+        s.sendall(_ws_client_frame(0x1, b"quit"))
+        opcode, _ = _ws_read(s)
+        assert opcode == 0x8
+
+
+def test_non_asgi_route_rejects_websocket(asgi_route, cluster):
+    import ray_tpu.serve as serve
+
+    @serve.deployment
+    class Plain:
+        def __call__(self, payload=None):
+            return {"ok": True}
+
+    handle = serve.run(Plain.bind(), name="plainapp")
+    serve.add_route("/plain", handle)
+    host, port = asgi_route
+    raw = _http(host, port,
+                b"GET /plain HTTP/1.1\r\nHost: t\r\nUpgrade: websocket\r\n"
+                b"Sec-WebSocket-Key: eHh4eHh4eHh4eHh4eHh4eA==\r\n\r\n")
+    assert b"400" in raw.split(b"\r\n")[0]

@@ -1,0 +1,66 @@
+"""Lane hygiene: the FULL core lane must end with zero leaked runtime state.
+
+Collected last (zz): after every other module's init()/shutdown() cycles,
+no framework thread and no framework subprocess may survive.  This is the
+permanent regression guard for the round-3 audit findings (a leaked
+`start --block` daemon outliving its teardown, and nondeterministic
+late-lane starvation attributed to state surviving in-process shutdowns).
+reference pattern: python/ray/tests/conftest.py:589 teardown guarantees.
+"""
+
+import os
+import subprocess
+import threading
+import time
+
+# every thread the framework spawns carries one of these name prefixes
+_FRAMEWORK_THREADS = (
+    "raylet-", "gcs-", "rpc-", "pubsub-", "actor-pipeline-",
+    "batch-prefetch", "proxy-", "train-fn", "cpu-profiler", "jax-profiler",
+)
+
+
+def _framework_threads():
+    return sorted(
+        t.name for t in threading.enumerate()
+        if t is not threading.current_thread()
+        and t.name.startswith(_FRAMEWORK_THREADS))
+
+
+def test_no_leaked_framework_threads():
+    """shutdown() must join (or flag down) everything it started; polling
+    loops exit within their interval — give them a bounded grace window."""
+    deadline = time.monotonic() + 30
+    bad = _framework_threads()
+    while bad and time.monotonic() < deadline:
+        time.sleep(0.5)
+        bad = _framework_threads()
+    assert not bad, (
+        f"framework threads survived every shutdown() in the lane: {bad}")
+
+
+def test_no_leaked_framework_processes():
+    """No worker subprocess or CLI daemon may outlive its session (orphan
+    suicide takes up to ~7s: raylet-liveness poll 2s + RPC timeout 5s)."""
+    me = os.getpid()
+
+    def offenders():
+        out = subprocess.run(["ps", "-eo", "pid,ppid,args"],
+                             capture_output=True, text=True).stdout
+        rows = []
+        for line in out.splitlines()[1:]:
+            parts = line.split(None, 2)
+            if len(parts) < 3 or int(parts[0]) == me:
+                continue
+            args = parts[2]
+            if ("ray_tpu._private.workers_main" in args
+                    or ("-m ray_tpu" in args and "--block" in args)):
+                rows.append(line.strip())
+        return rows
+
+    deadline = time.monotonic() + 30
+    bad = offenders()
+    while bad and time.monotonic() < deadline:
+        time.sleep(1.0)
+        bad = offenders()
+    assert not bad, f"framework processes survived the lane: {bad}"
