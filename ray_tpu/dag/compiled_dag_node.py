@@ -214,6 +214,39 @@ class CompiledDAGRef:
         return f"CompiledDAGRef(idx={self._idx})"
 
 
+class CompiledDAGFuture:
+    """Awaitable result of ``execute_async`` (reference:
+    compiled_dag_node.py:2631 / CompiledDAGFuture). Awaiting it never
+    blocks the event loop: the blocking ``get()`` runs on the loop's
+    default executor.  Re-awaitable: the first await resolves through the
+    single-consume ref, later awaits replay the cached outcome."""
+
+    _PENDING = object()
+
+    def __init__(self, ref: "CompiledDAGRef"):
+        self._ref = ref
+        self._result = self._PENDING
+        self._error: Optional[BaseException] = None
+
+    def __await__(self):
+        import asyncio
+
+        async def resolve():
+            if self._result is self._PENDING and self._error is None:
+                loop = asyncio.get_running_loop()
+                try:
+                    self._result = await loop.run_in_executor(
+                        None, self._ref.get)
+                except BaseException as e:  # noqa: BLE001
+                    self._error = e
+                    self._result = None
+            if self._error is not None:
+                raise self._error
+            return self._result
+
+        return resolve().__await__()
+
+
 class CompiledDAG:
     def __init__(self, root: DAGNode, buffer_size_bytes: Optional[int] = None,
                  max_inflight_executions: int = 100):
@@ -430,6 +463,24 @@ class CompiledDAG:
             for ch in self._input_channels.values():
                 ch.write_bytes(payload)
         return CompiledDAGRef(self, idx)
+
+    async def execute_async(self, *args, **kwargs) -> CompiledDAGFuture:
+        """Non-blocking submission from an async driver (reference:
+        compiled_dag_node.py:2631 execute_async): input writes (which can
+        block on channel backpressure) run on the executor, so an asyncio
+        serving loop can overlap many in-flight DAG invocations:
+
+            fut1 = await dag.execute_async(x1)
+            fut2 = await dag.execute_async(x2)   # overlaps with fut1
+            r1, r2 = await fut1, await fut2
+        """
+        import asyncio
+        import functools
+
+        loop = asyncio.get_running_loop()
+        ref = await loop.run_in_executor(
+            None, functools.partial(self.execute, *args, **kwargs))
+        return CompiledDAGFuture(ref)
 
     def _get_result(self, idx: int, timeout: Optional[float]):
         deadline = None if timeout is None else time.monotonic() + timeout

@@ -397,8 +397,9 @@ class _AsyncProxy:
         cid = uuid.uuid4().hex
         loop = asyncio.get_running_loop()
         # the whole session is PINNED to one replica: the ASGI websocket
-        # session object lives there (handle.pinned() docstring)
-        pinned = handle.pinned()
+        # session object lives there (handle.pinned() docstring). pinned()
+        # itself does blocking router RPCs — keep it off the event loop
+        pinned = await loop.run_in_executor(self._pool, handle.pinned)
 
         def call(payload):
             return pinned.remote(payload).result(timeout_s=_HANDLE_TIMEOUT_S)
@@ -428,9 +429,10 @@ class _AsyncProxy:
         for m in resp.get("messages", []):
             writer.write(_ws_frame(m))
         await writer.drain()
+        assembler = _WsMessageAssembler()
         try:
             while True:
-                frame = await _ws_read_message(reader)
+                frame = await assembler.next_message(reader)
                 if frame is None or frame[0] == 0x8:  # EOF / close
                     break
                 opcode, payload = frame
@@ -503,39 +505,50 @@ async def _ws_read_frame(reader):
         n = int.from_bytes(await reader.readexactly(8), "big")
     if n > _MAX_BODY:
         return None
-    mask = await reader.readexactly(4) if masked else b"\x00" * 4
-    payload = bytearray(await reader.readexactly(n))
-    if masked:
-        for i in range(n):
-            payload[i] ^= mask[i & 3]
+    mask = await reader.readexactly(4) if masked else None
+    payload = await reader.readexactly(n)
+    if masked and n:
+        # bulk XOR via big ints — a per-byte Python loop would stall the
+        # event loop for hundreds of ms on large frames
+        full_mask = (mask * (n // 4 + 1))[:n]
+        payload = (int.from_bytes(payload, "big")
+                   ^ int.from_bytes(full_mask, "big")).to_bytes(n, "big")
     return fin, opcode, bytes(payload)
 
 
-async def _ws_read_message(reader):
-    """Read one complete MESSAGE, reassembling FIN=0 fragments +
-    continuation (0x0) frames (RFC 6455 §5.4). Control frames (ping/pong/
-    close) may interleave inside a fragmented message and are returned
-    immediately. Returns (opcode, payload) or None at EOF."""
-    data_opcode = None
-    parts = []
-    while True:
-        frame = await _ws_read_frame(reader)
-        if frame is None:
-            return None
-        fin, opcode, payload = frame
-        if opcode >= 0x8:  # control frame: never fragmented
-            return opcode, payload
-        if opcode in (0x1, 0x2):
-            data_opcode = opcode
-            parts = [payload]
-        elif opcode == 0x0:
-            if data_opcode is None:
-                return None  # stray continuation: protocol error -> close
-            parts.append(payload)
-        if fin and data_opcode is not None:
-            return data_opcode, b"".join(parts)
-        if sum(len(p) for p in parts) > _MAX_BODY:
-            return None
+class _WsMessageAssembler:
+    """Reassembles FIN=0 fragments + continuation (0x0) frames into
+    messages (RFC 6455 §5.4). Control frames (ping/pong/close) may
+    interleave inside a fragmented message: they are returned immediately
+    while the fragment accumulator PERSISTS across calls."""
+
+    def __init__(self):
+        self._data_opcode = None
+        self._parts = []
+
+    async def next_message(self, reader):
+        """(opcode, payload) — a control frame or a complete data message;
+        None at EOF / protocol error / oversized message."""
+        while True:
+            frame = await _ws_read_frame(reader)
+            if frame is None:
+                return None
+            fin, opcode, payload = frame
+            if opcode >= 0x8:  # control frame: never fragmented
+                return opcode, payload
+            if opcode in (0x1, 0x2):
+                self._data_opcode = opcode
+                self._parts = [payload]
+            elif opcode == 0x0:
+                if self._data_opcode is None:
+                    return None  # stray continuation: protocol error
+                self._parts.append(payload)
+            if fin and self._data_opcode is not None:
+                msg = (self._data_opcode, b"".join(self._parts))
+                self._data_opcode, self._parts = None, []
+                return msg
+            if sum(len(p) for p in self._parts) > _MAX_BODY:
+                return None
 
 
 def start_proxy(host: str = "127.0.0.1", port: int = 8000) -> Tuple[str, int]:

@@ -127,7 +127,15 @@ class ASGIDriver:
         accepted = any(m["type"] == "websocket.accept" for m in sends)
         closed = any(m["type"] == "websocket.close" for m in sends)
         if not accepted or closed:
+            # the app coroutine may still be parked on receive(): cancel it
+            # or every rejected connect leaks a task on the replica loop
             self._ws.pop(cid, None)
+            session.task.cancel()
+            try:
+                self._loop.run_until_complete(
+                    asyncio.gather(session.task, return_exceptions=True))
+            except Exception:  # noqa: BLE001
+                pass
         return {"accepted": accepted and not closed,
                 "messages": _outbound(sends)}
 

@@ -274,3 +274,29 @@ def test_compiled_revisited_actor_no_deadlock(ray_start_regular):
             assert compiled.execute(i).get(timeout=30) == i + 12
     finally:
         compiled.teardown()
+
+
+def test_compiled_execute_async(ray_start_regular):
+    """Async driver overlap (reference: compiled_dag_node.py:2631
+    execute_async): an asyncio loop submits several invocations without
+    blocking and awaits their futures out of order."""
+    import asyncio
+
+    a = Adder.remote(1)
+    b = Adder.remote(10)
+    with InputNode() as inp:
+        out = b.add.bind(a.add.bind(inp))
+    compiled = out.experimental_compile()
+    try:
+        async def driver():
+            futs = [await compiled.execute_async(i) for i in range(5)]
+            # await out of submission order: results stay index-matched
+            results = [await futs[i] for i in (4, 0, 2, 1, 3)]
+            # futures are re-awaitable (cached outcome)
+            assert await futs[0] == 11
+            return results
+
+        got = asyncio.run(driver())
+        assert got == [15, 11, 13, 12, 14]
+    finally:
+        compiled.teardown()

@@ -183,7 +183,12 @@ def test_websocket_echo(asgi_route):
                            0x80 | len(payload)]) + mask + masked)
 
         s.sendall(_frag(0x1, b"fra", fin=False))
+        # a ping INTERLEAVED inside the fragmented message (RFC 6455 §5.4)
+        # must not drop the accumulated fragments
+        s.sendall(_frag(0x9, b"mid", fin=True))
         s.sendall(_frag(0x0, b"gment", fin=True))
+        opcode, payload = _ws_read(s)
+        assert (opcode, payload) == (0xA, b"mid")  # pong first
         opcode, payload = _ws_read(s)
         assert (opcode, payload) == (0x1, b"echo:fragment")
 
