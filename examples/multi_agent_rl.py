@@ -1,0 +1,37 @@
+"""Multi-agent RL: two policies learning side by side.
+
+MultiAgentEnv dict protocol + policy mapping + per-policy PPO learners
+(ray_tpu/rllib/multi_agent.py; reference: rllib/env/multi_agent_env.py:30).
+"""
+
+import ray_tpu
+from ray_tpu.rllib import MultiAgentPPOConfig
+
+
+def main():
+    ray_tpu.init(num_cpus=4)
+    algo = (MultiAgentPPOConfig(
+        num_env_runners=2, num_envs_per_runner=1,
+        rollout_fragment_length=64, minibatch_size=128, seed=0)
+        .environment("MultiAgentCartPole")
+        .multi_agent(
+            policies=("left", "right"),
+            policy_mapping_fn=lambda aid: ("left" if aid == "agent_0"
+                                           else "right"))
+        ).build()
+    result = None
+    for _ in range(3):
+        result = algo.train()
+    assert "left/policy_loss" in result and "right/policy_loss" in result
+    path = algo.save_checkpoint("/tmp/ma_example_ckpt")
+    algo.load_checkpoint(path)  # round-trips params + optimizer state
+    algo.stop()
+    ray_tpu.shutdown()
+    print(f"trained 2 policies: left reward "
+          f"{result['left/episode_reward_mean']:.1f}, right "
+          f"{result['right/episode_reward_mean']:.1f}")
+    print("OK: multi_agent_rl")
+
+
+if __name__ == "__main__":
+    main()

@@ -217,32 +217,38 @@ class CompiledDAGRef:
 class CompiledDAGFuture:
     """Awaitable result of ``execute_async`` (reference:
     compiled_dag_node.py:2631 / CompiledDAGFuture). Awaiting it never
-    blocks the event loop: the blocking ``get()`` runs on the loop's
-    default executor.  Re-awaitable: the first await resolves through the
-    single-consume ref, later awaits replay the cached outcome."""
+    blocks the event loop: the blocking ``get()`` runs once on a shared
+    daemon pool; every await — concurrent, repeated, or after a cancelled
+    wait_for — observes that single resolution (a cancelled awaiter
+    cancels only its own wait, never the underlying get)."""
 
-    _PENDING = object()
+    _pool = None
+    _pool_lock = threading.Lock()
 
     def __init__(self, ref: "CompiledDAGRef"):
         self._ref = ref
-        self._result = self._PENDING
-        self._error: Optional[BaseException] = None
+        self._cf = None
+        self._lock = threading.Lock()
+
+    @classmethod
+    def _resolver_pool(cls):
+        with cls._pool_lock:
+            if cls._pool is None:
+                from ray_tpu._private.utils import DaemonExecutor
+
+                cls._pool = DaemonExecutor(
+                    max_workers=16, thread_name_prefix="dag-async-resolve")
+            return cls._pool
 
     def __await__(self):
         import asyncio
 
+        with self._lock:
+            if self._cf is None:
+                self._cf = self._resolver_pool().submit(self._ref.get)
+
         async def resolve():
-            if self._result is self._PENDING and self._error is None:
-                loop = asyncio.get_running_loop()
-                try:
-                    self._result = await loop.run_in_executor(
-                        None, self._ref.get)
-                except BaseException as e:  # noqa: BLE001
-                    self._error = e
-                    self._result = None
-            if self._error is not None:
-                raise self._error
-            return self._result
+            return await asyncio.wrap_future(self._cf)
 
         return resolve().__await__()
 

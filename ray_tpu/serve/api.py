@@ -132,6 +132,14 @@ def run(app: Application, *, name: str = "default", route_prefix: str = "/",
     handle = DeploymentHandle(name, deployments[-1]["name"])
     # wait for replicas to come up
     handle._router._refresh()
+    # auto-register the HTTP route in THIS process's proxy route table
+    # (reference api.py:665 behavior: serve.run makes the app reachable);
+    # ASGI ingress deployments (serve/asgi.py) are flagged so the proxy
+    # forwards raw requests and allows websocket upgrades
+    from ray_tpu.serve._private.proxy import register_route
+
+    is_asgi = bool(getattr(app.deployment._target, "_IS_ASGI", False))
+    register_route(route_prefix, handle, asgi=is_asgi)
     return handle
 
 
@@ -187,7 +195,20 @@ def status() -> Dict[str, Any]:
 def shutdown():
     import ray_tpu
     from ray_tpu.serve._private.controller import CONTROLLER_NAME
+    from ray_tpu.serve._private.proxy import _state, stop_proxy
+    from ray_tpu.serve._private.rpc_proxy import stop_rpc_proxy
 
+    # ingress first: the process-wide proxy (and its executor threads) must
+    # not outlive serve — the lane hygiene guard caught 41 leaked
+    # proxy-handle threads from a proxy that survived its tests
+    for stop in (stop_proxy, stop_rpc_proxy):
+        try:
+            stop()
+        except Exception:  # noqa: BLE001
+            pass
+    with _state.lock:
+        _state.routes.clear()
+        _state.asgi.clear()
     try:
         controller = ray_tpu.get_actor(CONTROLLER_NAME)
     except Exception:  # noqa: BLE001

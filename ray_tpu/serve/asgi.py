@@ -130,12 +130,7 @@ class ASGIDriver:
             # the app coroutine may still be parked on receive(): cancel it
             # or every rejected connect leaks a task on the replica loop
             self._ws.pop(cid, None)
-            session.task.cancel()
-            try:
-                self._loop.run_until_complete(
-                    asyncio.gather(session.task, return_exceptions=True))
-            except Exception:  # noqa: BLE001
-                pass
+            self._reap(session)
         return {"accepted": accepted and not closed,
                 "messages": _outbound(sends)}
 
@@ -161,13 +156,18 @@ class ASGIDriver:
         if session is not None:
             session.feed({"type": "websocket.disconnect", "code": 1000})
             self._pump(session)
-            session.task.cancel()
-            try:
-                self._loop.run_until_complete(
-                    asyncio.gather(session.task, return_exceptions=True))
-            except Exception:  # noqa: BLE001
-                pass
+            self._reap(session)
         return {"closed": True, "messages": []}
+
+    def _reap(self, session: "_WsSession"):
+        """Cancel + drain a session's app coroutine (no task may outlive
+        its connection on the replica loop)."""
+        session.task.cancel()
+        try:
+            self._loop.run_until_complete(
+                asyncio.gather(session.task, return_exceptions=True))
+        except Exception:  # noqa: BLE001
+            pass
 
     def _pump(self, session: "_WsSession") -> List[dict]:
         """Run the loop until the app parks on receive() (or finishes);

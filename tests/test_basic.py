@@ -79,7 +79,7 @@ def test_wait(ray_start_regular):
         return "slow"
 
     rs = [slow.remote(), fast.remote()]
-    ready, pending = ray_tpu.wait(rs, num_returns=1, timeout=10)
+    ready, pending = ray_tpu.wait(rs, num_returns=1, timeout=60)
     assert len(ready) == 1 and len(pending) == 1
     assert ray_tpu.get(ready[0]) == "fast"
 
