@@ -108,6 +108,7 @@ class LocalObjectStore:
         self._seal_cv = threading.Condition(self._lock)
         self._seal_callbacks: Dict[ObjectID, list] = {}
         self._prefix = f"rtpu-{node_id_hex[:8]}-{os.getpid()}"
+        self._shutdown = False
 
         # native arena backend (reference: plasma/dlmalloc.cc arena)
         self._native = None
@@ -268,10 +269,13 @@ class LocalObjectStore:
 
     def get_locator(self, object_id: ObjectID, timeout: Optional[float] = None) -> Optional[Locator]:
         """Block until sealed (or timeout); returns the locator and pins the
-        entry. Restores from spill if needed. Returns None on timeout."""
+        entry. Restores from spill if needed. Returns None on timeout or
+        store shutdown (a waiter must never outlive the store — leaked
+        rpc-handler threads parked here were caught by the lane hygiene
+        guard)."""
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._lock:
-            while True:
+            while not self._shutdown:
                 e = self._entries.get(object_id)
                 if e is not None and e.sealed:
                     if e.locator is None:
@@ -283,6 +287,7 @@ class LocalObjectStore:
                 if remaining is not None and remaining <= 0:
                     return None
                 self._seal_cv.wait(timeout=remaining if remaining is not None else 1.0)
+            return None
 
     # kept for callers that used the old name
     get_shm_name = get_locator
@@ -355,6 +360,8 @@ class LocalObjectStore:
 
     def shutdown(self):
         with self._lock:
+            self._shutdown = True
+            self._seal_cv.notify_all()  # release every parked get_locator
             for oid in list(self._entries):
                 self._free_locked(oid)
             self._arena_view = None

@@ -24,7 +24,7 @@ import tempfile
 import threading
 import time
 import traceback
-from collections import defaultdict
+from collections import defaultdict, deque
 from ray_tpu._private.utils import DaemonExecutor
 from typing import Any, Dict, List, Optional, Set, Tuple
 
@@ -257,25 +257,49 @@ class ReferenceCounter:
         self._owned_submitted: Dict[ObjectID, int] = defaultdict(int)  # args of in-flight tasks
         self._borrowers: Dict[ObjectID, Set[Tuple[str, int]]] = defaultdict(set)
         self._in_transit: Dict[ObjectID, int] = defaultdict(int)
+        # GC-deferred releases. ObjectRef.__del__ runs whenever the garbage
+        # collector does — including INSIDE add_local_ref's critical section
+        # (a dict insert can allocate -> trigger gc -> run __del__): taking
+        # self._lock there self-deadlocks the thread. Round-4 root cause of
+        # the silent core-lane hang (caught by the faulthandler dead-man
+        # switch: main thread parked in remove_local_ref under
+        # add_local_ref, watchdog exception swallowed as unraisable inside
+        # __del__). deque.append is atomic and allocation-light — the only
+        # thing a finalizer may do here.
+        self._pending_removals: deque = deque()
 
     # -- local handles ---------------------------------------------------
 
     def add_local_ref(self, ref: ObjectRef):
+        self.drain_deferred()
         with self._lock:
             self._local[ref.id] += 1
 
     def remove_local_ref(self, ref: ObjectRef):
-        owner_is_self = ref.owner_addr == self._w.address
-        with self._lock:
-            self._local[ref.id] -= 1
-            if self._local[ref.id] > 0:
+        """Finalizer-safe: defers the real work (see _pending_removals)."""
+        self._pending_removals.append((ref.id, ref.owner_addr))
+
+    def drain_deferred(self):
+        """Apply deferred releases. Called from regular (non-finalizer)
+        code paths; never from __del__."""
+        while True:
+            try:
+                oid, owner_addr = self._pending_removals.popleft()
+            except IndexError:
                 return
-            del self._local[ref.id]
-        if owner_is_self:
-            self._maybe_free(ref.id)
-        else:
-            # Borrower released its last handle: tell the owner.
-            self._w.notify_owner(ref.owner_addr, "RemoveBorrower", {"object_id": ref.id, "borrower": self._w.address})
+            owner_is_self = owner_addr == self._w.address
+            with self._lock:
+                self._local[oid] -= 1
+                if self._local[oid] > 0:
+                    continue
+                del self._local[oid]
+            if owner_is_self:
+                self._maybe_free(oid)
+            else:
+                # Borrower released its last handle: tell the owner.
+                self._w.notify_owner(owner_addr, "RemoveBorrower",
+                                     {"object_id": oid,
+                                      "borrower": self._w.address})
 
     # -- transit / borrowers --------------------------------------------
 
@@ -488,6 +512,12 @@ class CoreWorker:
             if self.shutting_down:
                 return
             rounds += 1
+            # idle-time flush of GC-deferred ref releases (objects freed
+            # even when no new refs are being created to trigger a drain)
+            try:
+                self.reference_counter.drain_deferred()
+            except Exception:  # noqa: BLE001
+                pass
             with self._sub_lock:
                 channels = list(self._subscriptions)
             # bound the set: a 'dead' pubsub event can be missed (GCS restart,

@@ -248,7 +248,9 @@ class CompiledDAGFuture:
                 self._cf = self._resolver_pool().submit(self._ref.get)
 
         async def resolve():
-            return await asyncio.wrap_future(self._cf)
+            # shield: cancelling ONE awaiter (wait_for timeout) must not
+            # cancel the shared underlying get() other awaiters depend on
+            return await asyncio.shield(asyncio.wrap_future(self._cf))
 
         return resolve().__await__()
 

@@ -47,6 +47,11 @@ class LLMConfig:
     # prompts interleave with decode instead of stalling it
     prefill_chunk: int = 256
     enable_prefix_caching: bool = True
+    # True -> the pallas TPU paged-attention kernel for decode (single-chip
+    # TPU, head_dim % 128 == 0). Default off: the XLA block-gather measured
+    # faster at 1k-3k context on v5e (see PagedJaxLLMEngine); the kernel is
+    # numerics-verified and available for regimes where profiles disagree.
+    paged_attention_kernel: Optional[bool] = None
     # parallelism degrees (mesh axes; the vllm_models.py:177-186 analog —
     # pipeline degree folded into placement sizing per vllm_models.py:181-191)
     tensor_parallel_size: int = 1

@@ -472,13 +472,15 @@ def decode_step(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
 # ---------------------------------------------------------------------------
 # Paged KV cache programs (reference capability boundary: the paged-attention
 # engine Ray LLM gets by delegating to vLLM, vllm_models.py:177-186 — here
-# TPU-native).  The cache is a POOL of fixed-size blocks
-# [L, num_blocks, block_size, kv, hd]; each sequence owns a host-side list of
-# block ids, shipped to the device as a padded block TABLE [B, W].  All shapes
-# static: W is bucketed, gathers/scatters are jnp advanced indexing (XLA
-# gather/scatter on the block axis), so the programs recompile only per
-# (B, W) bucket.  Sharding: the kv-head axis shards over "tensor" exactly as
-# the dense cache (kv_cache_spec), block/table axes replicated.
+# TPU-native).  The cache is a POOL of fixed-size blocks laid out
+# [L, kv, num_blocks, block_size, hd] — the TPU paged-attention kernel's
+# native page layout; each sequence owns a host-side list of block ids,
+# shipped to the device as a padded block TABLE [B, W].  All shapes static:
+# W is bucketed, so programs recompile only per (B, W) bucket.  Decode
+# attention runs the pallas TPU paged-attention kernel (reads ONLY the live
+# pages per sequence) on single-chip TPU, or an XLA block-gather fallback
+# (CPU tests, sharded meshes).  Sharding: the kv-head axis shards over
+# "tensor" exactly as the dense cache, block/table axes replicated.
 # ---------------------------------------------------------------------------
 
 
@@ -486,44 +488,64 @@ def init_paged_kv_cache(cfg: LlamaConfig, num_blocks: int, block_size: int,
                         dtype=None) -> Dict[str, jnp.ndarray]:
     """Block-pool KV cache shared by all sequences; HBM ∝ blocks in use."""
     dtype = dtype or cfg.compute_dtype
-    shape = (cfg.n_layers, num_blocks, block_size, cfg.n_kv_heads, cfg.head_dim)
+    shape = (cfg.n_layers, cfg.n_kv_heads, num_blocks, block_size, cfg.head_dim)
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
 def paged_kv_cache_spec() -> Dict[str, P]:
-    spec = P(None, None, None, "tensor", None)
+    spec = P(None, "tensor", None, None, None)
     return {"k": spec, "v": spec}
 
 
 def _paged_attend(cfg: LlamaConfig, q, pk, pv, table, span_mask):
     """GQA attention of q [B, T, nh, hd] against pooled KV gathered through a
-    block table [B, W] -> span W*bs.  span_mask [B, T, W*bs] True = visible."""
+    block table [B, W] -> span W*bs.  pk/pv [kv, NB, bs, hd];
+    span_mask [B, T, W*bs] True = visible.  (XLA fallback path.)"""
     b, t = q.shape[:2]
-    bs = pk.shape[1]
+    bs = pk.shape[2]
     group = cfg.n_heads // cfg.n_kv_heads
     w = table.shape[1]
-    ck = pk[table].reshape(b, w * bs, cfg.n_kv_heads, cfg.head_dim)
-    cv = pv[table].reshape(b, w * bs, cfg.n_kv_heads, cfg.head_dim)
+    ck = pk[:, table].reshape(cfg.n_kv_heads, b, w * bs, cfg.head_dim)
+    cv = pv[:, table].reshape(cfg.n_kv_heads, b, w * bs, cfg.head_dim)
     qg = q.reshape(b, t, cfg.n_kv_heads, group, cfg.head_dim)
     # bf16 operands, fp32 accumulate: no full-span fp32 cache copies
-    scores = jnp.einsum("btkgd,bskd->bkgts", qg, ck,
+    scores = jnp.einsum("btkgd,kbsd->bkgts", qg, ck,
                         preferred_element_type=jnp.float32)
     scores = scores / math.sqrt(cfg.head_dim)
     scores = jnp.where(span_mask[:, None, None], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
-    attn = jnp.einsum("bkgts,bskd->btkgd", probs.astype(ck.dtype), cv,
+    attn = jnp.einsum("bkgts,kbsd->btkgd", probs.astype(ck.dtype), cv,
                       preferred_element_type=jnp.float32)
     return attn.reshape(b, t, cfg.n_heads * cfg.head_dim)
+
+
+def paged_kernel_supported(cfg: LlamaConfig) -> bool:
+    """Whether the pallas TPU paged-attention kernel applies: TPU backend,
+    MXU-native head_dim, and the kernel import available."""
+    if jax.default_backend() != "tpu":
+        return False
+    if cfg.head_dim % 128:
+        return False
+    try:
+        from jax.experimental.pallas.ops.tpu.paged_attention import (  # noqa: F401
+            paged_attention,
+        )
+    except ImportError:
+        return False
+    return True
 
 
 def decode_step_paged(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
                       pool: Dict[str, jnp.ndarray], table: jnp.ndarray,
                       lengths: jnp.ndarray,
-                      rope_cache: Optional[tuple] = None):
+                      rope_cache: Optional[tuple] = None,
+                      use_kernel: bool = False):
     """One-token decode for every slot, KV in a paged pool.
 
     tokens [B] int32; table [B, W] block ids covering each slot's sequence
     (host guarantees coverage through position lengths[b]); lengths [B].
+    ``use_kernel`` (static): pallas TPU paged-attention — reads ONLY each
+    sequence's live pages instead of materializing the XLA block gather.
     Returns (logits [B, V] fp32, updated pool).
     """
     if rope_cache is None:
@@ -532,7 +554,7 @@ def decode_step_paged(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
     else:
         cos, sin = rope_cache
     b = tokens.shape[0]
-    bs = pool["k"].shape[2]
+    bs = pool["k"].shape[3]
     w = table.shape[1]
     cdt = cfg.compute_dtype
     bidx = jnp.arange(b)
@@ -547,16 +569,33 @@ def decode_step_paged(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
         # is sized to live tokens — far smaller than a dense cache — so the
         # per-step restack is cheap, while a carried pool pays a [li]
         # dynamic-index copy per layer (measured net slower on v5e)
-        lp, pk, pv = inp  # pk/pv: [NB, bs, kv, hd]
+        lp, pk, pv = inp  # pk/pv: [kv, NB, bs, hd]
         h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
         q = (h @ lp["wq"].astype(cdt)).reshape(b, 1, cfg.n_heads, cfg.head_dim)
         k = (h @ lp["wk"].astype(cdt)).reshape(b, 1, cfg.n_kv_heads, cfg.head_dim)
         v = (h @ lp["wv"].astype(cdt)).reshape(b, 1, cfg.n_kv_heads, cfg.head_dim)
         q = apply_rope(q, cos, sin, positions=lengths[:, None])
         k = apply_rope(k, cos, sin, positions=lengths[:, None])[:, 0]
-        pk = pk.at[cur_blk, cur_off].set(k.astype(pk.dtype))
-        pv = pv.at[cur_blk, cur_off].set(v[:, 0].astype(pv.dtype))
-        attn = _paged_attend(cfg, q, pk, pv, table, span_mask)[:, 0]
+        pk = pk.at[:, cur_blk, cur_off].set(
+            k.transpose(1, 0, 2).astype(pk.dtype))
+        pv = pv.at[:, cur_blk, cur_off].set(
+            v[:, 0].transpose(1, 0, 2).astype(pv.dtype))
+        if use_kernel:
+            from jax.experimental.pallas.ops.tpu.paged_attention import (
+                paged_attention,
+            )
+
+            # kernel computes raw q·k (no internal scaling) over the first
+            # `lengths` positions — the freshly-written token at position
+            # `lengths` is included via lengths + 1
+            ppcb = min(w, 4)
+            attn = paged_attention(
+                (q[:, 0] / math.sqrt(cfg.head_dim)).astype(pk.dtype),
+                pk, pv, lengths + 1, table,
+                pages_per_compute_block=ppcb)
+            attn = attn.reshape(b, cfg.n_heads * cfg.head_dim)
+        else:
+            attn = _paged_attend(cfg, q, pk, pv, table, span_mask)[:, 0]
         x = x + (attn.astype(cdt) @ lp["wo"].astype(cdt))
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
         ffn = (jax.nn.silu(h @ lp["w_gate"].astype(cdt))
@@ -591,7 +630,7 @@ def prefill_chunk_paged(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
     else:
         cos, sin = rope_cache
     b, c = tokens.shape
-    bs = pool["k"].shape[2]
+    bs = pool["k"].shape[3]
     w = table.shape[1]
     cdt = cfg.compute_dtype
     positions = p0 + jnp.arange(c)  # [C] global positions
@@ -602,17 +641,20 @@ def prefill_chunk_paged(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
     x = jnp.take(params["embed"], tokens, axis=0).astype(cdt)
 
     def body(x, inp):
-        lp, pk, pv = inp
+        lp, pk, pv = inp  # pk/pv: [kv, NB, bs, hd]
         h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
         q = (h @ lp["wq"].astype(cdt)).reshape(b, c, cfg.n_heads, cfg.head_dim)
         k = (h @ lp["wk"].astype(cdt)).reshape(b, c, cfg.n_kv_heads, cfg.head_dim)
         v = (h @ lp["wv"].astype(cdt)).reshape(b, c, cfg.n_kv_heads, cfg.head_dim)
         q = apply_rope(q, cos, sin, positions=positions[None, :])
         k = apply_rope(k, cos, sin, positions=positions[None, :])
-        pk = pk.at[chunk_blocks].set(
-            k[0].reshape(c // bs, bs, cfg.n_kv_heads, cfg.head_dim).astype(pk.dtype))
-        pv = pv.at[chunk_blocks].set(
-            v[0].reshape(c // bs, bs, cfg.n_kv_heads, cfg.head_dim).astype(pv.dtype))
+        # [1, C, kv, hd] -> [kv, C/bs, bs, hd] page-major writes
+        pk = pk.at[:, chunk_blocks].set(
+            k[0].reshape(c // bs, bs, cfg.n_kv_heads, cfg.head_dim)
+            .transpose(2, 0, 1, 3).astype(pk.dtype))
+        pv = pv.at[:, chunk_blocks].set(
+            v[0].reshape(c // bs, bs, cfg.n_kv_heads, cfg.head_dim)
+            .transpose(2, 0, 1, 3).astype(pv.dtype))
         attn = _paged_attend(cfg, q, pk, pv, table, span_mask)
         x = x + (attn.astype(cdt) @ lp["wo"].astype(cdt))
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)

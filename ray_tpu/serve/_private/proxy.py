@@ -578,3 +578,9 @@ def unregister_route(route_prefix: str):
     with _state.lock:
         _state.routes.pop(route_prefix, None)
         _state.asgi.pop(route_prefix, None)
+
+
+def clear_routes():
+    with _state.lock:
+        _state.routes.clear()
+        _state.asgi.clear()

@@ -140,7 +140,12 @@ def run(app: Application, *, name: str = "default", route_prefix: str = "/",
 
     is_asgi = bool(getattr(app.deployment._target, "_IS_ASGI", False))
     register_route(route_prefix, handle, asgi=is_asgi)
+    _app_routes[name] = route_prefix
     return handle
+
+
+# app name -> auto-registered route prefix (so delete() can unregister)
+_app_routes: Dict[str, str] = {}
 
 
 def delete(name: str = "default"):
@@ -151,6 +156,13 @@ def delete(name: str = "default"):
     if get_local_app(name) is not None:
         delete_local(name)
         return
+    # drop the auto-registered HTTP route: a stale route would forward
+    # requests to dead replicas instead of returning 404
+    prefix = _app_routes.pop(name, None)
+    if prefix is not None:
+        from ray_tpu.serve._private.proxy import unregister_route
+
+        unregister_route(prefix)
     ray_tpu.get(get_or_create_controller().delete_application.remote(name))
 
 
@@ -195,7 +207,7 @@ def status() -> Dict[str, Any]:
 def shutdown():
     import ray_tpu
     from ray_tpu.serve._private.controller import CONTROLLER_NAME
-    from ray_tpu.serve._private.proxy import _state, stop_proxy
+    from ray_tpu.serve._private.proxy import clear_routes, stop_proxy
     from ray_tpu.serve._private.rpc_proxy import stop_rpc_proxy
 
     # ingress first: the process-wide proxy (and its executor threads) must
@@ -206,9 +218,8 @@ def shutdown():
             stop()
         except Exception:  # noqa: BLE001
             pass
-    with _state.lock:
-        _state.routes.clear()
-        _state.asgi.clear()
+    clear_routes()
+    _app_routes.clear()
     try:
         controller = ray_tpu.get_actor(CONTROLLER_NAME)
     except Exception:  # noqa: BLE001

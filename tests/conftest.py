@@ -27,6 +27,30 @@ jax.config.update("jax_num_cpu_devices", 8)
 import pytest
 
 # ---------------------------------------------------------------------------
+# Whole-session dead-man's switch: a C-level faulthandler watchdog thread
+# dumps EVERY thread's stack to stderr if no progress for 10 minutes.
+# Unlike the per-test SIGALRM below, this fires even when the main thread
+# cannot run Python signal handlers (GIL-independent, covers the inter-test
+# gaps pytest runs outside any item protocol — the round-4 investigation
+# caught a silent futex hang exactly there, with alarm unset and no signal
+# deliverable). repeat=True re-arms so a wedged lane leaves periodic
+# evidence instead of a blank log.
+# ---------------------------------------------------------------------------
+
+import faulthandler as _fh
+
+_fh.dump_traceback_later(600, repeat=True, exit=False)
+
+
+@pytest.hookimpl(hookwrapper=True, trylast=True)
+def pytest_runtest_makereport(item, call):
+    # progress heartbeat: every completed phase re-arms the dead-man's
+    # switch, so it only fires after 10 min of NO lane progress at all
+    _fh.dump_traceback_later(600, repeat=True, exit=False)
+    yield
+
+
+# ---------------------------------------------------------------------------
 # Per-test watchdog (no pytest-timeout in the image): SIGALRM covers the whole
 # runtest protocol — fixtures included, where the one observed core-lane hang
 # class lives — dumping ALL thread stacks before failing the test, so a hang
