@@ -35,7 +35,8 @@ def test_registry_and_platform_default():
         set_accelerator_context("nonexistent")
 
 
-def test_communicator_collectives_across_actors(ray_start_regular):
+@pytest.mark.timeout(180)  # 3 actor spawns + rendezvous: tight at 60s on a
+def test_communicator_collectives_across_actors(ray_start_regular):  # loaded box
     import ray_tpu
 
     @ray_tpu.remote
