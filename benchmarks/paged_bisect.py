@@ -1,0 +1,178 @@
+"""Bisect the real paged decode chunk: which part of the model step costs.
+
+Reproduces the engine's _decode_chunk_impl shape exactly (scan of CHUNK
+token-steps, each a full decode_step_paged) and swaps out one component at
+a time.  Compare against the static engine's chunk on the same model.
+"""
+import math
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.models import llama
+from ray_tpu.ops.rope import rope_frequencies
+
+import os
+
+CHUNK = 32
+B = 32
+W_BLOCKS = int(os.environ.get("W_BLOCKS", "8"))
+MEAN_LEN = W_BLOCKS * 32 - 32
+BSZ = 32  # block size
+NB = 1200
+
+
+def fence(x):
+    return float(jnp.ravel(jax.tree_util.tree_leaves(x)[0])[0])
+
+
+def timeit(fn, args, reps=4):
+    args = list(args)
+    args[2] = jax.tree.map(jnp.copy, args[2])  # fresh pool (donation-safe)
+    emitted, newpool = fn(*args)
+    args[2] = newpool
+    fence(emitted)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        emitted, newpool = fn(*args)
+        args[2] = newpool
+    fence(emitted)
+    return (time.perf_counter() - t0) / reps / CHUNK * 1000  # ms/token-step
+
+
+def main():
+    cfg = llama.LlamaConfig(
+        vocab_size=32768, dim=2048, n_layers=16, n_heads=16,
+        n_kv_heads=8, ffn_dim=8192, max_seq_len=1024,
+        param_dtype=jnp.bfloat16)
+    key = jax.random.PRNGKey(0)
+    params = llama.init_params(cfg, key)
+    cos, sin = rope_frequencies(cfg.head_dim, 1024, cfg.rope_theta)
+    rope = (jnp.asarray(cos), jnp.asarray(sin))
+    pool = llama.init_paged_kv_cache(cfg, NB, BSZ)
+    W = W_BLOCKS
+    print(f"W={W} span={W*BSZ} mean_len={MEAN_LEN}")
+    table = jnp.asarray(
+        np.stack([np.arange(1 + i * W, 1 + (i + 1) * W) for i in range(B)]),
+        jnp.int32)
+    tokens = jnp.ones((B,), jnp.int32)
+    lengths = jnp.full((B,), MEAN_LEN, jnp.int32)
+
+    from ray_tpu.llm.engine import _sample
+
+    def chunk_of(step_fn, sample=True):
+        def impl(params, tokens, pool, table, lengths, key):
+            def one(carry, _):
+                tokens, pool, lengths, key = carry
+                logits, pool = step_fn(params, tokens, pool, table, lengths)
+                key, sub = jax.random.split(key)
+                if sample:
+                    ids = _sample(logits, sub,
+                                  jnp.zeros((B,), jnp.float32),
+                                  jnp.full((B,), 50, jnp.int32))
+                else:
+                    ids = jnp.argmax(logits, -1).astype(jnp.int32)
+                return (ids, pool, lengths + 1, key), ids
+            carry, emitted = jax.lax.scan(
+                one, (tokens, pool, lengths, key), None, length=CHUNK)
+            return emitted, carry[1]
+        return jax.jit(impl, donate_argnums=2)
+
+    # full real step
+    def full_step(params, tokens, pool, table, lengths):
+        return llama.decode_step_paged(cfg, params, tokens, pool, table,
+                                       lengths, rope_cache=rope)
+
+    print(f"full paged chunk     : "
+          f"{timeit(chunk_of(full_step), (params, tokens, pool, table, lengths, key)):7.3f} ms/tok-step")
+
+    # fused pallas kernel attention
+    def kern_step(params, tokens, pool, table, lengths):
+        return llama.decode_step_paged(cfg, params, tokens, pool, table,
+                                       lengths, rope_cache=rope,
+                                       use_kernel=True)
+
+    print(f"  ... pallas kernel  : "
+          f"{timeit(chunk_of(kern_step), (params, tokens, pool, table, lengths, key)):7.3f}")
+
+    # argmax instead of top_k sampling
+    print(f"  ... argmax sample  : "
+          f"{timeit(chunk_of(full_step, sample=False), (params, tokens, pool, table, lengths, key)):7.3f}")
+
+    # no attention: skip gather/attend entirely (keep writes)
+    def step_noattn(params, tokens, pool, table, lengths):
+        cdt = cfg.compute_dtype
+        b = tokens.shape[0]
+        bs = pool["k"].shape[2]
+        bidx = jnp.arange(b)
+        cur_blk = table[bidx, lengths // bs]
+        cur_off = lengths % bs
+        x = jnp.take(params["embed"], tokens, axis=0).astype(cdt)
+        from ray_tpu.ops.norms import rms_norm
+        from ray_tpu.ops.rope import apply_rope
+        def body(carry, inp):
+            x, pk, pv = carry
+            lp, li = inp
+            h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+            q = (h @ lp["wq"].astype(cdt)).reshape(b, 1, cfg.n_heads, cfg.head_dim)
+            k = (h @ lp["wk"].astype(cdt)).reshape(b, 1, cfg.n_kv_heads, cfg.head_dim)
+            v = (h @ lp["wv"].astype(cdt)).reshape(b, 1, cfg.n_kv_heads, cfg.head_dim)
+            q = apply_rope(q, *rope, positions=lengths[:, None])
+            k = apply_rope(k, *rope, positions=lengths[:, None])[:, 0]
+            pk = pk.at[li, cur_blk, cur_off].set(
+                k.reshape(b, -1).astype(pk.dtype))
+            pv = pv.at[li, cur_blk, cur_off].set(
+                v[:, 0].reshape(b, -1).astype(pv.dtype))
+            attn = q[:, 0].reshape(b, cfg.n_heads * cfg.head_dim)
+            x = x + (attn.astype(cdt) @ lp["wo"].astype(cdt))
+            h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+            ffn = (jax.nn.silu(h @ lp["w_gate"].astype(cdt))
+                   * (h @ lp["w_up"].astype(cdt))) @ lp["w_down"].astype(cdt)
+            return (x + ffn, pk, pv), None
+        (x, ks, vs), _ = jax.lax.scan(
+            body, (x, pool["k"], pool["v"]),
+            (params["layers"], jnp.arange(cfg.n_layers)))
+        x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+        head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+        logits = (x @ head.astype(cdt)).astype(jnp.float32)
+        return logits, {"k": ks, "v": vs}
+
+    print(f"  ... no attention   : "
+          f"{timeit(chunk_of(step_noattn), (params, tokens, pool, table, lengths, key)):7.3f}")
+
+    # no pool at all: pure weights pass (pool untouched, passes through)
+    def step_nopool(params, tokens, pool, table, lengths):
+        cdt = cfg.compute_dtype
+        b = tokens.shape[0]
+        x = jnp.take(params["embed"], tokens, axis=0).astype(cdt)
+        from ray_tpu.ops.norms import rms_norm
+        def body(x, lp):
+            h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+            q = h @ lp["wq"].astype(cdt)
+            x = x + (q @ lp["wo"].astype(cdt))
+            h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+            ffn = (jax.nn.silu(h @ lp["w_gate"].astype(cdt))
+                   * (h @ lp["w_up"].astype(cdt))) @ lp["w_down"].astype(cdt)
+            return x + ffn, None
+        x, _ = jax.lax.scan(body, x, params["layers"])
+        x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+        head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+        logits = (x @ head.astype(cdt)).astype(jnp.float32)
+        return logits, pool
+
+    print(f"  ... weights only   : "
+          f"{timeit(chunk_of(step_nopool), (params, tokens, pool, table, lengths, key)):7.3f}")
+
+    # static engine comparison on same model
+    cache = llama.init_kv_cache(cfg, B, 1024)
+    def static_step(params, tokens, cache, _table, lengths):
+        return llama.decode_step(cfg, params, tokens, cache, lengths,
+                                 rope_cache=rope)
+    print(f"static chunk         : "
+          f"{timeit(chunk_of(static_step), (params, tokens, cache, table, lengths, key)):7.3f}")
+
+
+if __name__ == "__main__":
+    main()

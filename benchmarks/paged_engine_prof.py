@@ -1,0 +1,83 @@
+"""Instrument the REAL paged engine's step() to split device vs host time.
+
+Also reports W-bucket transitions (recompiles) and per-phase host costs.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.llm.config import GenerationConfig, LLMConfig
+from ray_tpu.llm.engine import make_engine
+from ray_tpu.models.llama import LlamaConfig, init_params
+
+
+def main():
+    mcfg = LlamaConfig(
+        vocab_size=32768, dim=2048, n_layers=16, n_heads=16,
+        n_kv_heads=8, ffn_dim=8192, max_seq_len=1024,
+        param_dtype=jnp.bfloat16)
+    params = init_params(mcfg, jax.random.PRNGKey(0))
+    batch, chunk = 32, 32
+    eng = make_engine(
+        LLMConfig(model_config=mcfg, max_batch_size=batch,
+                  decode_chunk=chunk, kv_cache="paged",
+                  block_size=32, prefill_chunk=128), params=params)
+
+    # instrument the jitted decode: time dispatch separately
+    inner = eng._decode
+    stats = {"dispatch": 0.0, "fence": 0.0, "calls": 0, "ws": []}
+
+    def timed_decode(*args):
+        t0 = time.perf_counter()
+        out = inner(*args)
+        stats["dispatch"] += time.perf_counter() - t0
+        stats["calls"] += 1
+        stats["ws"].append(args[3].shape[1])
+        return out
+
+    eng._decode = timed_decode
+
+    orig_asarray = np.asarray
+    prompts = [[(7 * i + j) % 1000 + 1 for j in range(128)]
+               for i in range(batch)]
+    gen = GenerationConfig(max_new_tokens=256, temperature=0.0)
+    eng.generate(prompts[:1], GenerationConfig(max_new_tokens=chunk + 1))
+    for p in prompts:
+        eng.add_request(p, gen)
+    while True:
+        live = [r for r in eng._slot_req if r is not None]
+        if (len(live) == batch and not eng._pending and
+                all(r.prefill_pos >= len(r.prompt) for r in live)):
+            break
+        eng.step(decode=False)
+
+    rem = min(r.gen.max_new_tokens - len(r.out_tokens)
+              for r in eng._slot_req if r is not None)
+    steps = max(1, (rem - 1) // chunk - 1)
+    stats["dispatch"] = 0.0
+    stats["calls"] = 0
+    stats["ws"] = []
+    tokens = 0
+    step_times = []
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        ts = time.perf_counter()
+        tokens += sum(len(t) for t in eng.step().values())
+        step_times.append(time.perf_counter() - ts)
+    dt = time.perf_counter() - t0
+    print(f"steps={steps} tokens={tokens} total={dt*1000:.1f}ms "
+          f"-> {1000*dt/(steps*chunk):.2f} ms/tok-step, "
+          f"{tokens/dt:.0f} tok/s")
+    print(f"dispatch(incl device wait inside asarray? no): "
+          f"{1000*stats['dispatch']/steps:.2f} ms/engine-step "
+          f"({1000*stats['dispatch']/(steps*chunk):.3f} ms/tok)")
+    print(f"W buckets seen: {sorted(set(stats['ws']))}")
+    print("per-step ms:", [f"{s*1000:.0f}" for s in step_times])
+    host = dt - stats["dispatch"]
+    print(f"host+fence remainder: {1000*host/(steps*chunk):.2f} ms/tok-step")
+
+
+if __name__ == "__main__":
+    main()

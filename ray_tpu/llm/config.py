@@ -48,10 +48,12 @@ class LLMConfig:
     prefill_chunk: int = 256
     enable_prefix_caching: bool = True
     # True -> the pallas TPU paged-attention kernel for decode (single-chip
-    # TPU, head_dim % 128 == 0). Default off: the XLA block-gather measured
-    # faster at 1k-3k context on v5e (see PagedJaxLLMEngine); the kernel is
-    # numerics-verified and available for regimes where profiles disagree.
-    paged_attention_kernel: Optional[bool] = None
+    # TPU, head_dim % 128 == 0, pp == 1). None = auto: ON where supported
+    # (measured v5e b32: ties the XLA block-gather at span 256, 2.2x faster
+    # at span 1024 — benchmarks/paged_bisect.py). True forces it (raises
+    # off-TPU); False forces the gather path; "interpret" is a test hook
+    # that runs the kernel in pallas interpret mode off-TPU.
+    paged_attention_kernel: Optional[Any] = None
     # parallelism degrees (mesh axes; the vllm_models.py:177-186 analog —
     # pipeline degree folded into placement sizing per vllm_models.py:181-191)
     tensor_parallel_size: int = 1

@@ -207,21 +207,35 @@ class PagedJaxLLMEngine:
         self._admit_counter = 0
         self._lock = threading.Lock()
 
-        # pallas TPU paged-attention kernel (reads only each sequence's
-        # live pages; numerics verified to 7e-3 of a dense fp32 reference).
-        # Default OFF: measured on v5e with the 1B model it does not beat
-        # the XLA block-gather at 1k context (1070 -> 799 tok/s, batch 32 —
-        # it pays its launch cost x layers x chunk inside the scan) nor at
-        # 3k (92 vs 88 tok/s); flip on per-config for regimes where a
-        # profile shows the gather dominating. Single-chip only (a sharded
-        # pool would need a shard_map'd kernel).
-        supported = llama.paged_kernel_supported(cfg) and self.mesh is None
-        want = bool(config.paged_attention_kernel)
-        if want and not supported:
+        # fused pallas paged-attention kernel (ray_tpu/ops/paged_attention):
+        # DMAs only each sequence's live pages — no gather materialization.
+        # Default ON where it wins (measured v5e b32: ties the XLA gather at
+        # span 256, 2.2x faster at span 1024 — benchmarks/paged_bisect.py).
+        # Composes with TP via shard_map (kv heads over "tensor"); PP still
+        # uses the gather path (the layer scan spans all stages, so a
+        # pipeline-sharded pool cannot feed per-shard page DMAs).
+        self._kernel_interpret = False
+        supported = (llama.paged_kernel_supported(cfg)
+                     and config.pipeline_parallel_size <= 1)
+        want = config.paged_attention_kernel
+        if want is None:
+            self._use_kernel = supported
+        elif want == "interpret":
+            # explicit test hook: run the kernel in pallas interpret mode
+            # off-TPU (exercises the TP shard_map plumbing on the virtual
+            # CPU mesh).  Never chosen implicitly — interpret speed would
+            # be a silent production footgun.
+            if config.pipeline_parallel_size > 1:
+                raise ValueError(
+                    "paged_attention_kernel needs pipeline_parallel_size == 1")
+            self._use_kernel = True
+            self._kernel_interpret = jax.default_backend() != "tpu"
+        elif want and not supported:
             raise ValueError(
-                "paged_attention_kernel=True needs a single-chip TPU "
-                "backend and head_dim % 128 == 0")
-        self._use_kernel = want and supported
+                "paged_attention_kernel=True needs a TPU backend, "
+                "head_dim % 128 == 0, and pipeline_parallel_size == 1")
+        else:
+            self._use_kernel = bool(want)
         self._decode = jax.jit(self._decode_chunk_impl, donate_argnums=2,
                                static_argnums=11)
         self._prefill_chunk = jax.jit(self._prefill_chunk_impl,
@@ -239,7 +253,8 @@ class PagedJaxLLMEngine:
             tokens, pool, lengths, active, remaining, key = carry
             logits, pool = llama.decode_step_paged(
                 self.cfg, params, tokens, pool, table, lengths,
-                rope_cache=self._rope, use_kernel=self._use_kernel)
+                rope_cache=self._rope, use_kernel=self._use_kernel,
+                mesh=self.mesh, kernel_interpret=self._kernel_interpret)
             key, sub = jax.random.split(key)
             ids = _sample(logits, sub, temps, top_ks)
             emitted = jnp.where(active > 0, ids, -1)

@@ -473,14 +473,22 @@ def decode_step(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
 # Paged KV cache programs (reference capability boundary: the paged-attention
 # engine Ray LLM gets by delegating to vLLM, vllm_models.py:177-186 — here
 # TPU-native).  The cache is a POOL of fixed-size blocks laid out
-# [L, kv, num_blocks, block_size, hd] — the TPU paged-attention kernel's
-# native page layout; each sequence owns a host-side list of block ids,
-# shipped to the device as a padded block TABLE [B, W].  All shapes static:
-# W is bucketed, so programs recompile only per (B, W) bucket.  Decode
-# attention runs the pallas TPU paged-attention kernel (reads ONLY the live
-# pages per sequence) on single-chip TPU, or an XLA block-gather fallback
-# (CPU tests, sharded meshes).  Sharding: the kv-head axis shards over
-# "tensor" exactly as the dense cache, block/table axes replicated.
+# [L, num_blocks, block_size, kv*hd]: block-major, so one block is a
+# contiguous [bs, kv*hd] slab — a table gather moves whole slabs, a pallas
+# page DMA lands on perfect (sublane, lane) tiles with zero padding, and a
+# kv head is a lane-aligned column slice; each sequence owns a host-side
+# list of block ids, shipped to the device as a padded block TABLE [B, W].
+# All shapes static: W is bucketed, so programs recompile only per (B, W)
+# bucket.
+#
+# The pool rides the layer scan as CARRY; every per-layer touch is a SINGLE
+# fused XLA gather/scatter whose leading index is the (scalar) layer id —
+# `pool[li, table]` / `pool.at[li, blk, off].set(...)` — so no layer slice
+# is ever materialized and the pool is never restacked.  (The previous
+# xs/ys design restacked the full pool every token-step: measured 6.8 ms of
+# the 11.5 ms/token-step at b32 on v5e — see benchmarks/paged_bisect.py.)
+# Sharding: the kv-head axis shards over "tensor" exactly as the dense
+# cache, layer axis over "pipeline", block/table axes replicated.
 # ---------------------------------------------------------------------------
 
 
@@ -488,47 +496,44 @@ def init_paged_kv_cache(cfg: LlamaConfig, num_blocks: int, block_size: int,
                         dtype=None) -> Dict[str, jnp.ndarray]:
     """Block-pool KV cache shared by all sequences; HBM ∝ blocks in use."""
     dtype = dtype or cfg.compute_dtype
-    shape = (cfg.n_layers, cfg.n_kv_heads, num_blocks, block_size, cfg.head_dim)
+    shape = (cfg.n_layers, num_blocks, block_size,
+             cfg.n_kv_heads * cfg.head_dim)
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
 def paged_kv_cache_spec() -> Dict[str, P]:
-    spec = P(None, "tensor", None, None, None)
+    # the folded kv*hd dim shards over "tensor" as contiguous head groups
+    spec = P(None, None, None, "tensor")
     return {"k": spec, "v": spec}
 
 
-def _paged_attend(cfg: LlamaConfig, q, pk, pv, table, span_mask):
-    """GQA attention of q [B, T, nh, hd] against pooled KV gathered through a
-    block table [B, W] -> span W*bs.  pk/pv [kv, NB, bs, hd];
-    span_mask [B, T, W*bs] True = visible.  (XLA fallback path.)"""
+def _paged_attend(cfg: LlamaConfig, q, ck, cv, span_mask):
+    """GQA attention of q [B, T, nh, hd] against gathered spans ck/cv
+    [B, S, kv, hd]; span_mask [B, T, S] True = visible."""
     b, t = q.shape[:2]
-    bs = pk.shape[2]
     group = cfg.n_heads // cfg.n_kv_heads
-    w = table.shape[1]
-    ck = pk[:, table].reshape(cfg.n_kv_heads, b, w * bs, cfg.head_dim)
-    cv = pv[:, table].reshape(cfg.n_kv_heads, b, w * bs, cfg.head_dim)
     qg = q.reshape(b, t, cfg.n_kv_heads, group, cfg.head_dim)
     # bf16 operands, fp32 accumulate: no full-span fp32 cache copies
-    scores = jnp.einsum("btkgd,kbsd->bkgts", qg, ck,
+    scores = jnp.einsum("btkgd,bskd->bkgts", qg, ck,
                         preferred_element_type=jnp.float32)
     scores = scores / math.sqrt(cfg.head_dim)
     scores = jnp.where(span_mask[:, None, None], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
-    attn = jnp.einsum("bkgts,kbsd->btkgd", probs.astype(ck.dtype), cv,
+    attn = jnp.einsum("bkgts,bskd->btkgd", probs.astype(ck.dtype), cv,
                       preferred_element_type=jnp.float32)
     return attn.reshape(b, t, cfg.n_heads * cfg.head_dim)
 
 
 def paged_kernel_supported(cfg: LlamaConfig) -> bool:
-    """Whether the pallas TPU paged-attention kernel applies: TPU backend,
-    MXU-native head_dim, and the kernel import available."""
+    """Whether the fused pallas paged-attention kernel applies: TPU backend,
+    lane-aligned head_dim, and the kernel import available."""
     if jax.default_backend() != "tpu":
         return False
     if cfg.head_dim % 128:
         return False
     try:
-        from jax.experimental.pallas.ops.tpu.paged_attention import (  # noqa: F401
-            paged_attention,
+        from ray_tpu.ops.paged_attention import (  # noqa: F401
+            paged_decode_attention,
         )
     except ImportError:
         return False
@@ -539,14 +544,18 @@ def decode_step_paged(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
                       pool: Dict[str, jnp.ndarray], table: jnp.ndarray,
                       lengths: jnp.ndarray,
                       rope_cache: Optional[tuple] = None,
-                      use_kernel: bool = False):
+                      use_kernel: bool = False, mesh=None,
+                      kernel_interpret: bool = False):
     """One-token decode for every slot, KV in a paged pool.
 
     tokens [B] int32; table [B, W] block ids covering each slot's sequence
     (host guarantees coverage through position lengths[b]); lengths [B].
-    ``use_kernel`` (static): pallas TPU paged-attention — reads ONLY each
-    sequence's live pages instead of materializing the XLA block gather.
-    Returns (logits [B, V] fp32, updated pool).
+    ``use_kernel`` (static): pallas fused paged-attention — reads ONLY each
+    sequence's live pages instead of materializing the XLA block gather
+    (measured on v5e b32: 5.2 vs 5.3 ms/token-step at span 256, 8.0 vs 17.4
+    at span 1024 — benchmarks/paged_bisect.py).  With ``mesh``, the kernel
+    runs under shard_map with kv heads sharded over the "tensor" axis, so
+    it composes with TP.  Returns (logits [B, V] fp32, updated pool).
     """
     if rope_cache is None:
         cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
@@ -554,55 +563,62 @@ def decode_step_paged(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
     else:
         cos, sin = rope_cache
     b = tokens.shape[0]
-    bs = pool["k"].shape[3]
+    bs = pool["k"].shape[2]
     w = table.shape[1]
     cdt = cfg.compute_dtype
     bidx = jnp.arange(b)
     cur_blk = table[bidx, lengths // bs]  # [B] physical block of the write
     cur_off = lengths % bs
-    span_mask = (jnp.arange(w * bs)[None, None, :]
-                 <= lengths[:, None, None])  # [B, 1, W*bs]
+    if not use_kernel:  # the kernel masks from `lengths` internally
+        span_mask = (jnp.arange(w * bs)[None, None, :]
+                     <= lengths[:, None, None])  # [B, 1, W*bs]
     x = jnp.take(params["embed"], tokens, axis=0).astype(cdt)
 
-    def body(x, inp):
-        # pool scans as xs/ys (NOT the dense decode's carry-DUS): the pool
-        # is sized to live tokens — far smaller than a dense cache — so the
-        # per-step restack is cheap, while a carried pool pays a [li]
-        # dynamic-index copy per layer (measured net slower on v5e)
-        lp, pk, pv = inp  # pk/pv: [kv, NB, bs, hd]
+    def body(carry, inp):
+        # pool rides the CARRY; the scalar layer id fuses into every
+        # gather/scatter's index vector, so no [li] slice is materialized
+        # and no per-step restack happens (see module comment)
+        x, pk_all, pv_all = carry
+        lp, li = inp
         h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
         q = (h @ lp["wq"].astype(cdt)).reshape(b, 1, cfg.n_heads, cfg.head_dim)
         k = (h @ lp["wk"].astype(cdt)).reshape(b, 1, cfg.n_kv_heads, cfg.head_dim)
         v = (h @ lp["wv"].astype(cdt)).reshape(b, 1, cfg.n_kv_heads, cfg.head_dim)
         q = apply_rope(q, cos, sin, positions=lengths[:, None])
         k = apply_rope(k, cos, sin, positions=lengths[:, None])[:, 0]
-        pk = pk.at[:, cur_blk, cur_off].set(
-            k.transpose(1, 0, 2).astype(pk.dtype))
-        pv = pv.at[:, cur_blk, cur_off].set(
-            v[:, 0].transpose(1, 0, 2).astype(pv.dtype))
+        pk_all = pk_all.at[li, cur_blk, cur_off].set(
+            k.reshape(b, -1).astype(pk_all.dtype))
+        pv_all = pv_all.at[li, cur_blk, cur_off].set(
+            v[:, 0].reshape(b, -1).astype(pv_all.dtype))
         if use_kernel:
-            from jax.experimental.pallas.ops.tpu.paged_attention import (
-                paged_attention,
-            )
+            from ray_tpu.ops.paged_attention import paged_decode_attention
 
-            # kernel computes raw q·k (no internal scaling) over the first
-            # `lengths` positions — the freshly-written token at position
-            # `lengths` is included via lengths + 1
-            ppcb = min(w, 4)
-            attn = paged_attention(
-                (q[:, 0] / math.sqrt(cfg.head_dim)).astype(pk.dtype),
-                pk, pv, lengths + 1, table,
-                pages_per_compute_block=ppcb)
-            attn = attn.reshape(b, cfg.n_heads * cfg.head_dim)
+            kern = partial(paged_decode_attention,
+                           interpret=kernel_interpret)
+            if mesh is not None and mesh.shape.get("tensor", 1) > 1:
+                from jax.experimental.shard_map import shard_map
+
+                t = P(None, None, None, "tensor")
+                kern = shard_map(
+                    kern, mesh=mesh,
+                    in_specs=(P(None, "tensor", None), t, t, P(), P(), P()),
+                    out_specs=P(None, "tensor"), check_rep=False)
+            attn = kern(q[:, 0], pk_all, pv_all, li, table, lengths)
         else:
-            attn = _paged_attend(cfg, q, pk, pv, table, span_mask)[:, 0]
+            ck = pk_all[li, table].reshape(b, w * bs, cfg.n_kv_heads,
+                                           cfg.head_dim)
+            cv = pv_all[li, table].reshape(b, w * bs, cfg.n_kv_heads,
+                                           cfg.head_dim)
+            attn = _paged_attend(cfg, q, ck, cv, span_mask)[:, 0]
         x = x + (attn.astype(cdt) @ lp["wo"].astype(cdt))
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
         ffn = (jax.nn.silu(h @ lp["w_gate"].astype(cdt))
                * (h @ lp["w_up"].astype(cdt))) @ lp["w_down"].astype(cdt)
-        return x + ffn, (pk, pv)
+        return (x + ffn, pk_all, pv_all), None
 
-    x, (ks, vs) = lax.scan(body, x, (params["layers"], pool["k"], pool["v"]))
+    (x, ks, vs), _ = lax.scan(
+        body, (x, pool["k"], pool["v"]),
+        (params["layers"], jnp.arange(cfg.n_layers)))
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     logits = (x @ head.astype(cdt)).astype(jnp.float32)
@@ -630,7 +646,7 @@ def prefill_chunk_paged(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
     else:
         cos, sin = rope_cache
     b, c = tokens.shape
-    bs = pool["k"].shape[3]
+    bs = pool["k"].shape[2]
     w = table.shape[1]
     cdt = cfg.compute_dtype
     positions = p0 + jnp.arange(c)  # [C] global positions
@@ -640,29 +656,32 @@ def prefill_chunk_paged(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
                  <= positions[None, :, None])  # [1, C, W*bs] causal
     x = jnp.take(params["embed"], tokens, axis=0).astype(cdt)
 
-    def body(x, inp):
-        lp, pk, pv = inp  # pk/pv: [kv, NB, bs, hd]
+    def body(carry, inp):
+        x, pk_all, pv_all = carry  # pools [L, NB, bs, kv*hd] as carry
+        lp, li = inp
         h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
         q = (h @ lp["wq"].astype(cdt)).reshape(b, c, cfg.n_heads, cfg.head_dim)
         k = (h @ lp["wk"].astype(cdt)).reshape(b, c, cfg.n_kv_heads, cfg.head_dim)
         v = (h @ lp["wv"].astype(cdt)).reshape(b, c, cfg.n_kv_heads, cfg.head_dim)
         q = apply_rope(q, cos, sin, positions=positions[None, :])
         k = apply_rope(k, cos, sin, positions=positions[None, :])
-        # [1, C, kv, hd] -> [kv, C/bs, bs, hd] page-major writes
-        pk = pk.at[:, chunk_blocks].set(
-            k[0].reshape(c // bs, bs, cfg.n_kv_heads, cfg.head_dim)
-            .transpose(2, 0, 1, 3).astype(pk.dtype))
-        pv = pv.at[:, chunk_blocks].set(
-            v[0].reshape(c // bs, bs, cfg.n_kv_heads, cfg.head_dim)
-            .transpose(2, 0, 1, 3).astype(pv.dtype))
-        attn = _paged_attend(cfg, q, pk, pv, table, span_mask)
+        # [1, C, kv, hd] -> [C/bs, bs, kv*hd] block-major slab writes
+        pk_all = pk_all.at[li, chunk_blocks].set(
+            k[0].reshape(c // bs, bs, -1).astype(pk_all.dtype))
+        pv_all = pv_all.at[li, chunk_blocks].set(
+            v[0].reshape(c // bs, bs, -1).astype(pv_all.dtype))
+        ck = pk_all[li, table].reshape(b, w * bs, cfg.n_kv_heads, cfg.head_dim)
+        cv = pv_all[li, table].reshape(b, w * bs, cfg.n_kv_heads, cfg.head_dim)
+        attn = _paged_attend(cfg, q, ck, cv, span_mask)
         x = x + (attn.astype(cdt) @ lp["wo"].astype(cdt))
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
         ffn = (jax.nn.silu(h @ lp["w_gate"].astype(cdt))
                * (h @ lp["w_up"].astype(cdt))) @ lp["w_down"].astype(cdt)
-        return x + ffn, (pk, pv)
+        return (x + ffn, pk_all, pv_all), None
 
-    x, (ks, vs) = lax.scan(body, x, (params["layers"], pool["k"], pool["v"]))
+    (x, ks, vs), _ = lax.scan(
+        body, (x, pool["k"], pool["v"]),
+        (params["layers"], jnp.arange(cfg.n_layers)))
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     logits = (x @ head.astype(cdt)).astype(jnp.float32)
