@@ -25,6 +25,11 @@ def main():
                   decode_chunk=chunk, kv_cache="paged",
                   block_size=32, prefill_chunk=128), params=params)
 
+    import time as _t
+    t0 = _t.perf_counter()
+    eng.warmup()
+    print(f"warmup (all W buckets): {_t.perf_counter()-t0:.1f}s")
+
     # instrument the jitted decode: time dispatch separately
     inner = eng._decode
     stats = {"dispatch": 0.0, "fence": 0.0, "calls": 0, "ws": []}

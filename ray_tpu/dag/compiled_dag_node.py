@@ -217,40 +217,42 @@ class CompiledDAGRef:
 class CompiledDAGFuture:
     """Awaitable result of ``execute_async`` (reference:
     compiled_dag_node.py:2631 / CompiledDAGFuture). Awaiting it never
-    blocks the event loop: the blocking ``get()`` runs once on a shared
-    daemon pool; every await — concurrent, repeated, or after a cancelled
-    wait_for — observes that single resolution (a cancelled awaiter
-    cancels only its own wait, never the underlying get)."""
-
-    _pool = None
-    _pool_lock = threading.Lock()
+    blocks the event loop: the blocking ``get()`` runs once on the owning
+    DAG's async pool (per-DAG, sized against max_inflight_executions, and
+    drained by teardown — never a process-global pool that could starve
+    unrelated run_in_executor users); every await — concurrent, repeated,
+    or after a cancelled wait_for — observes that single resolution (a
+    cancelled awaiter cancels only its own wait, never the underlying
+    get)."""
 
     def __init__(self, ref: "CompiledDAGRef"):
         self._ref = ref
         self._cf = None
         self._lock = threading.Lock()
 
-    @classmethod
-    def _resolver_pool(cls):
-        with cls._pool_lock:
-            if cls._pool is None:
-                from ray_tpu._private.utils import DaemonExecutor
-
-                cls._pool = DaemonExecutor(
-                    max_workers=16, thread_name_prefix="dag-async-resolve")
-            return cls._pool
-
     def __await__(self):
         import asyncio
 
         with self._lock:
             if self._cf is None:
-                self._cf = self._resolver_pool().submit(self._ref.get)
+                self._cf = self._ref._dag._async_pool.submit(self._ref.get)
 
         async def resolve():
-            # shield: cancelling ONE awaiter (wait_for timeout) must not
-            # cancel the shared underlying get() other awaiters depend on
-            return await asyncio.shield(asyncio.wrap_future(self._cf))
+            try:
+                # shield: cancelling ONE awaiter (wait_for timeout) must not
+                # cancel the shared underlying get() other awaiters depend on
+                return await asyncio.shield(asyncio.wrap_future(self._cf))
+            except asyncio.CancelledError:
+                if not self._cf.cancelled():
+                    raise  # this awaiter itself was cancelled
+                # teardown drained the pool before our queued get() ran:
+                # resolve inline — a cached result returns immediately,
+                # otherwise get() raises the proper teardown error
+                return self._ref.get()
+            except RuntimeError as e:
+                if "executor shut down" in str(e) and not self._ref._consumed:
+                    return self._ref.get()
+                raise
 
         return resolve().__await__()
 
@@ -269,6 +271,14 @@ class CompiledDAG:
         self._result_cache: Dict[int, Any] = {}
         self._torn_down = False
         self._drain_error: Optional[Exception] = None
+        # per-DAG pool for execute_async writes + future resolution (lazy
+        # threads): asyncio's shared default executor must never absorb
+        # backpressure-blocking channel writes (ADVICE r4)
+        from ray_tpu._private.utils import DaemonExecutor
+
+        self._async_pool = DaemonExecutor(
+            max_workers=min(max_inflight_executions, 16),
+            thread_name_prefix="dag-async")
         self._build(root)
         # Drain leaf channels continuously so deep pipelined submission can't
         # deadlock (driver blocked writing inputs while actors block writing
@@ -487,7 +497,7 @@ class CompiledDAG:
 
         loop = asyncio.get_running_loop()
         ref = await loop.run_in_executor(
-            None, functools.partial(self.execute, *args, **kwargs))
+            self._async_pool, functools.partial(self.execute, *args, **kwargs))
         return CompiledDAGFuture(ref)
 
     def _get_result(self, idx: int, timeout: Optional[float]):
@@ -532,6 +542,8 @@ class CompiledDAG:
                     pass
         for ch in self._channels:
             ch.destroy()
+        # torn_down + notify woke any pool-resident get()s; release threads
+        self._async_pool.shutdown(wait=False)
         self._finalizer.detach()
 
     def __del__(self):

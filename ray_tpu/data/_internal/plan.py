@@ -128,6 +128,14 @@ class ExecutionPlan:
                 if not isinstance(nxt, MapBlocks):
                     continue
                 if nxt.predicate and "predicate" in supported:
+                    if op.columns is not None and any(
+                            p[0] not in op.columns for p in nxt.predicate):
+                        # a predicate on a column the Read no longer emits:
+                        # the unoptimized block path raises KeyError there,
+                        # so folding (where pyarrow would happily filter on
+                        # a non-projected column) would change observable
+                        # semantics — keep the op unfused
+                        continue
                     new = dataclasses.replace(
                         op, predicate=(op.predicate or []) + list(nxt.predicate))
                 elif nxt.projection and "columns" in supported:

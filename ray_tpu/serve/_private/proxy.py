@@ -534,8 +534,13 @@ class _WsMessageAssembler:
             if frame is None:
                 return None
             fin, opcode, payload = frame
-            if opcode >= 0x8:  # control frame: never fragmented
+            if opcode in (0x8, 0x9, 0xA):  # control frame: never fragmented
                 return opcode, payload
+            if opcode not in (0x0, 0x1, 0x2):
+                # reserved opcode (0x3-0x7, 0xB-0xF): RFC 6455 §5.2 requires
+                # failing the connection — otherwise a FIN=1 reserved frame
+                # arriving mid-fragment would falsely complete the message
+                return None
             if opcode in (0x1, 0x2):
                 self._data_opcode = opcode
                 self._parts = [payload]

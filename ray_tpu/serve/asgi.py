@@ -18,8 +18,12 @@ response and echo/chat patterns are exact, unsolicited push is batched.
 from __future__ import annotations
 
 import asyncio
+import logging
+import os
 import threading
 from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
 
 
 def ingress(app):
@@ -169,17 +173,37 @@ class ASGIDriver:
         except Exception:  # noqa: BLE001
             pass
 
+    #: apps may legitimately await things other than receive() between
+    #: frames (outbound I/O, short timers) — those awaits complete under
+    #: asyncio.wait below.  An app still un-parked after this long is cut
+    #: off for this pump with a warning (its later sends surface on the
+    #: next inbound event).
+    _PUMP_TIMEOUT_S = float(os.environ.get("RAY_TPU_ASGI_PUMP_TIMEOUT_S", "5"))
+
     def _pump(self, session: "_WsSession") -> List[dict]:
         """Run the loop until the app parks on receive() (or finishes);
         returns and clears the send events produced meanwhile."""
 
         async def until_parked():
-            for _ in range(100_000):  # bounded: a spinning app can't hang us
-                if session.task.done():
-                    break
+            deadline = self._loop.time() + self._PUMP_TIMEOUT_S
+            while not session.task.done():
                 if session.parked.is_set() and session.inbox.empty():
                     break
-                await asyncio.sleep(0)
+                remaining = deadline - self._loop.time()
+                if remaining <= 0:
+                    logger.warning(
+                        "ASGI websocket app did not park on receive() "
+                        "within %.1fs (awaiting something else?); replies "
+                        "produced later will be delivered on the next "
+                        "inbound event", self._PUMP_TIMEOUT_S)
+                    break
+                waiter = asyncio.ensure_future(session.parked.wait())
+                try:
+                    await asyncio.wait({session.task, waiter},
+                                       timeout=remaining,
+                                       return_when=asyncio.FIRST_COMPLETED)
+                finally:
+                    waiter.cancel()
 
         self._loop.run_until_complete(until_parked())
         sends, session.sends = session.sends, []
@@ -191,7 +215,8 @@ class _WsSession:
         self.loop = loop
         self.inbox: asyncio.Queue = asyncio.Queue()
         self.sends: List[dict] = []
-        self.parked = threading.Event()
+        # asyncio.Event so _pump can await parking instead of spinning
+        self.parked = asyncio.Event()
         session = self
 
         async def receive():
