@@ -1,0 +1,44 @@
+"""Isolate the paged prefill chunk program's device cost on the 1B model."""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.models import llama
+from ray_tpu.ops.rope import rope_frequencies
+
+
+def main():
+    cfg = llama.LlamaConfig(
+        vocab_size=32768, dim=2048, n_layers=16, n_heads=16,
+        n_kv_heads=8, ffn_dim=8192, max_seq_len=1024,
+        param_dtype=jnp.bfloat16)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    cos, sin = rope_frequencies(cfg.head_dim, 1024, cfg.rope_theta)
+    rope = (jnp.asarray(cos), jnp.asarray(sin))
+    NB, BS = 512, 32
+    pool = llama.init_paged_kv_cache(cfg, NB, BS)
+
+    fn = jax.jit(
+        lambda p, t, pool, tab, p0: llama.prefill_chunk_paged(
+            cfg, p, t, pool, tab, p0, rope_cache=rope),
+        donate_argnums=2)
+
+    for c, w in ((128, 8), (128, 16), (32, 8), (64, 8)):
+        tokens = jnp.ones((1, c), jnp.int32)
+        table = jnp.asarray(np.arange(1, w + 1)[None, :], jnp.int32)
+        logits, pool = fn(params, tokens, pool, table, jnp.int32(0))
+        float(logits[0, 0, 0])  # fence after compile
+        t0 = time.perf_counter()
+        reps = 16
+        for i in range(reps):
+            logits, pool = fn(params, tokens, pool, table, jnp.int32(0))
+        float(logits[0, 0, 0])
+        dt = (time.perf_counter() - t0) / reps * 1000
+        print(f"prefill chunk c={c:4d} w={w:3d}: {dt:7.2f} ms "
+              f"({c / dt * 1000:.0f} tok/s/slot)")
+
+
+if __name__ == "__main__":
+    main()

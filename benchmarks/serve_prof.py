@@ -1,0 +1,154 @@
+"""Instrument the serving path: where does the 32-client ramp time go?"""
+import json
+import threading
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_log_compiles", True)
+import logging
+logging.getLogger("jax._src.interpreters.pxla").setLevel(logging.WARNING)
+logging.getLogger("jax").setLevel(logging.WARNING)
+
+from ray_tpu.llm.config import LLMConfig
+from ray_tpu.models.llama import LlamaConfig, init_params
+
+
+class _BenchTokenizer:
+    def encode(self, text):
+        return [ord(c) for c in text]
+
+    def decode(self, ids):
+        return "".join(chr(33 + i % 94) for i in ids)
+
+
+def main():
+    mcfg = LlamaConfig(
+        vocab_size=32768, dim=2048, n_layers=16, n_heads=16,
+        n_kv_heads=8, ffn_dim=8192, max_seq_len=1024,
+        param_dtype=jnp.bfloat16)
+    params = init_params(mcfg, jax.random.PRNGKey(0))
+    lcfg = LLMConfig(model_config=mcfg, max_batch_size=32, decode_chunk=16,
+                     kv_cache="paged", block_size=32, prefill_chunk=128,
+                     prefill_budget_tokens=512, max_seq_len=1024)
+
+    from ray_tpu import serve
+    from ray_tpu.llm import build_openai_app
+
+    app = build_openai_app(lcfg, params, tokenizer=_BenchTokenizer(),
+                           model_id="bench-llm")
+    handle = serve.run(app, route_prefix="/v1", _local_testing_mode=True)
+    serve.add_route("/v1", handle)
+    host, port = serve.start_http_proxy(port=0)
+    base = f"http://{host}:{port}"
+
+    # instrument the engine loop
+    from ray_tpu.serve._private.local_testing import get_local_app
+    inst = get_local_app("default")._instance
+    eng = inst._engine
+    steps = []
+    orig_step = eng.step
+
+    def timed_step(decode=True):
+        t0 = time.perf_counter()
+        mid_prefill = sum(1 for r in eng._slot_req
+                          if r is not None and r.prefill_pos < len(r.prompt))
+        pend = len(eng._pending)
+        out = orig_step(decode)
+        steps.append((time.perf_counter() - t0, mid_prefill, pend,
+                      sum(len(v) for v in out.values())))
+        return out
+
+    eng.step = timed_step
+
+    # fine-grained: time prefill dispatch, decode dispatch, collects
+    import numpy as _np
+    phase = {"prefill_disp": 0.0, "decode_disp": 0.0, "collect": 0.0,
+             "resolve": 0.0, "admit": 0.0}
+
+    def wrap(name, fn):
+        def inner(*a, **kw):
+            t0 = time.perf_counter()
+            try:
+                return fn(*a, **kw)
+            finally:
+                phase[name] += time.perf_counter() - t0
+        return inner
+
+    eng._prefill_chunk = wrap("prefill_disp", eng._prefill_chunk)
+    eng._decode = wrap("decode_disp", eng._decode)
+    eng._collect_locked = wrap("collect", eng._collect_locked)
+    eng._resolve_first_tokens_locked = wrap(
+        "resolve", eng._resolve_first_tokens_locked)
+    eng._admit_locked = wrap("admit", eng._admit_locked)
+
+    prompt_lens = [32, 64, 128, 256]
+
+    def one_client(i, out):
+        plen = prompt_lens[i % 4]
+        prompt = "".join(chr(33 + (7 * i + j) % 90) for j in range(plen))
+        body = json.dumps({"model": "bench-llm", "prompt": prompt,
+                           "stream": True, "max_tokens": 96,
+                           "temperature": 1.0, "top_k": 50}).encode()
+        req = urllib.request.Request(f"{base}/v1/completions", data=body,
+                                     headers={"Content-Type": "application/json"})
+        t_start = time.perf_counter()
+        first = None
+        ntok = 0
+        with urllib.request.urlopen(req, timeout=600) as resp:
+            for raw in resp:
+                line = raw.decode("utf-8", "replace").strip()
+                if not line.startswith("data: ") or line == "data: [DONE]":
+                    continue
+                try:
+                    obj = json.loads(line[6:])
+                except ValueError:
+                    continue
+                text = (obj.get("choices") or [{}])[0].get("text") or ""
+                if text:
+                    if first is None:
+                        first = time.perf_counter() - t_start
+                    ntok += len(text)
+        out[i] = (first, ntok)
+
+    warm = {}
+    for i in range(4):
+        one_client(i, warm)
+    print("warm done; steps so far:", len(steps))
+    steps.clear()
+    print("==== LOAD PHASE START (compiles below are mid-window) ====",
+          flush=True)
+
+    results = {}
+    threads = [threading.Thread(target=one_client, args=(i, results))
+               for i in range(32)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+        time.sleep(0.01)
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    serve.shutdown()
+
+    tot = sum(n for _, n in results.values())
+    print(f"wall {wall:.1f}s tokens {tot} -> {tot/wall:.0f} tok/s")
+    ttfts = sorted(f for f, _ in results.values() if f)
+    print(f"ttft p50 {ttfts[len(ttfts)//2]:.2f} min {ttfts[0]:.2f} max {ttfts[-1]:.2f}")
+    print(f"engine steps {len(steps)}, step time sum {sum(s[0] for s in steps):.1f}s")
+    slow = sorted(steps, key=lambda s: -s[0])[:10]
+    print("slowest steps (dt, mid_prefill, pending, emitted):")
+    for s in slow:
+        print(f"  {s[0]*1000:7.0f} ms  prefill={s[1]:2d} pend={s[2]:2d} emit={s[3]}")
+    import collections
+    hist = collections.Counter()
+    for dt, mp, pend, em in steps:
+        hist[("prefill" if mp else "decode", em > 0)] += 1
+    print(hist)
+    print("phase totals (s):", {k: round(v, 2) for k, v in phase.items()})
+
+
+if __name__ == "__main__":
+    main()

@@ -121,6 +121,19 @@ class Raylet:
         self._lock = threading.RLock()
         self._dispatch_cv = threading.Condition(self._lock)
         self._spawning_procs: Dict[int, subprocess.Popen] = {}
+        # warm zygote for fast worker forks; starts in the background at
+        # init so the first spawn (under the dispatch lock) never waits
+        self._zygote = None
+        if (global_config().enable_worker_zygote
+                and sys.platform == "linux"):
+            from ray_tpu._private.zygote import ZygoteClient
+
+            base_env = {**os.environ, **self._worker_env}
+            base_env.setdefault("PYTHONUNBUFFERED", "1")
+            self._zygote = ZygoteClient(
+                state_dir=self._log_monitor.log_dir,
+                worker_env=base_env,
+                log_sink=self._log_monitor.new_log_file())
         # worker pool keyed by runtime-env hash (reference: WorkerPool keys
         # idle workers by runtime env — dedicated workers per env)
         self._idle_workers: Dict[str, deque] = defaultdict(deque)
@@ -203,6 +216,8 @@ class Raylet:
                         w.proc.kill()
                     except Exception:  # noqa: BLE001
                         pass
+        if self._zygote is not None:
+            self._zygote.shutdown()
         self.server.shutdown()
         self.store.shutdown()
         self.pool.close_all()
@@ -279,13 +294,15 @@ class Raylet:
         # so prints land promptly.
         env.setdefault("PYTHONUNBUFFERED", "1")
         log_file = self._log_monitor.new_log_file()
-        with open(log_file, "ab") as lf:
-            proc = subprocess.Popen(
-                [sys.executable, "-m", "ray_tpu._private.workers_main"],
-                env=env,
-                stdout=lf,
-                stderr=subprocess.STDOUT,
-            )
+        proc = self._zygote_spawn(env, log_file)
+        if proc is None:
+            with open(log_file, "ab") as lf:
+                proc = subprocess.Popen(
+                    [sys.executable, "-m", "ray_tpu._private.workers_main"],
+                    env=env,
+                    stdout=lf,
+                    stderr=subprocess.STDOUT,
+                )
         self._log_monitor.register_pid(log_file, proc.pid)
         self._spawning_procs[proc.pid] = proc
         threading.Thread(
@@ -293,10 +310,26 @@ class Raylet:
             name="raylet-spawnwatch"
         ).start()
 
+    def _zygote_spawn(self, env: dict, log_file: str):
+        """Fork a worker off the warm zygote (fast path: ~50 ms vs ~2.3 s
+        full interpreter startup on this image — see zygote.py). Returns a
+        Popen-like handle or None to use the subprocess fallback.  Never
+        blocks on zygote startup: spawn() returns None while it warms
+        (this runs under the dispatch lock)."""
+        if self._zygote is None:
+            return None
+        pid = self._zygote.spawn(env, log_file)
+        return _PidHandle(pid) if pid else None
+
     def _watch_spawn(self, proc, env_hash: str):
-        """If a spawned worker exits before registering, decrement _starting."""
-        deadline = time.monotonic() + global_config().worker_register_timeout_s
-        while time.monotonic() < deadline:
+        """If a spawned worker exits before registering, decrement _starting.
+
+        No deadline: the watcher runs until the worker registers or its
+        process dies (workers retry registration up to 90 s against a
+        swamped raylet — a timed-out watcher would leak the _starting
+        budget forever if the worker died after the window).  The thread
+        is a daemon and exits with the raylet."""
+        while not self._stopped.is_set():
             with self._lock:
                 if proc.pid not in self._spawning_procs:
                     return  # registered

@@ -54,11 +54,16 @@ def main():
     from ray_tpu._private.rpc import ConnectionLost
 
     try:
+        # 90 s: a zygote fork-burst (1,000 actors in seconds) can swamp a
+        # 1-core raylet's reply queue well past 15 s while it is perfectly
+        # alive.  A DEAD raylet surfaces as ConnectionLost immediately
+        # (connection refused), so the long timeout never delays orphan
+        # prevention.
         reply = worker.raylet.call(
             "RegisterWorker",
             {"worker_id": worker.worker_id, "address": worker.server.address,
              "pid": os.getpid(), "env_hash": env_hash},
-            timeout=15, retry_deadline=15)
+            timeout=90, retry_deadline=90)
     except (ConnectionLost, FutTimeout, TimeoutError):
         # raylet died while we were booting: exit NOW instead of retrying
         # into the long default RPC deadline (orphan prevention). Other
@@ -68,13 +73,20 @@ def main():
     set_global_config(RayTpuConfig.from_blob(reply["config_blob"]))
     worker.job_id = None
 
-    # Serve until the raylet goes away (orphan suicide) or we're told to exit.
+    # Serve until the raylet goes away (orphan suicide) or we're told to
+    # exit.  A slow reply is NOT death (load spikes starve the raylet on
+    # small hosts): only consecutive failures trigger suicide.
+    misses = 0
     while True:
         time.sleep(2.0)
         try:
-            worker.raylet.call("GetNodeStats", None, timeout=5, retry_deadline=5)
+            worker.raylet.call("GetNodeStats", None, timeout=30,
+                               retry_deadline=30)
+            misses = 0
         except Exception:  # noqa: BLE001
-            sys.exit(0)
+            misses += 1
+            if misses >= 2:
+                sys.exit(0)
 
 
 if __name__ == "__main__":

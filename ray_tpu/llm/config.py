@@ -46,6 +46,11 @@ class LLMConfig:
     # prompt tokens prefilled per step (multiple of block_size); long
     # prompts interleave with decode instead of stalling it
     prefill_chunk: int = 256
+    # prompt tokens the engine may prefill per STEP across all slots (the
+    # vLLM max_num_batched_tokens analog). None = prefill_chunk (one
+    # chunk's worth). Raise for burst-arrival serving: a 32-client burst
+    # otherwise ramps one chunk per step, serializing admission.
+    prefill_budget_tokens: Optional[int] = None
     enable_prefix_caching: bool = True
     # True -> the pallas TPU paged-attention kernel for decode (single-chip
     # TPU, head_dim % 128 == 0, pp == 1). None = auto: ON where supported

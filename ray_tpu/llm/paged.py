@@ -136,6 +136,21 @@ def _bucket_pow2(n: int, lo: int = 1) -> int:
     return b
 
 
+def _prefill_plan(plen: int, matched: int, chunk: int, bs: int):
+    """Simulate the chunked-prefill loop: chunk widths are POW2-BUCKETED
+    multiples of block_size (so the jit cache holds log2(chunk/bs) prefill
+    programs per table bucket, not one per prefix-cache offset — an
+    arbitrary-width chunk measured a 7.2 s XLA compile inside the serving
+    window).  Returns the max block index any chunk's table must cover."""
+    pos, cover = matched, matched // bs
+    while pos < plen:
+        rem = plen - pos
+        c = min(chunk, _bucket_pow2(_pad_to(rem, bs), lo=bs))
+        cover = max(cover, math.ceil((pos + c) / bs))
+        pos += min(c, rem)
+    return cover
+
+
 class PagedJaxLLMEngine:
     """Drop-in engine with the static engine's API over a paged KV pool."""
 
@@ -162,6 +177,13 @@ class PagedJaxLLMEngine:
             nb = max(4, (self.max_batch * self.max_seq) // (2 * self.bs))
         self.num_blocks = nb
         self.max_blocks_per_seq = math.ceil(self.max_seq / self.bs)
+        # FIXED prefill table width: per-request widths would key a jit
+        # program per (chunk, width) combo, and prefix-cache hits reach
+        # widths no warmup predicted — measured as multi-second XLA
+        # compiles inside the serving window.  One width = at most
+        # log2(prefill_chunk/bs) prefill programs, all warmed at init.
+        # The masked overhang costs ~16% chunk compute at max_seq 1024.
+        self._prefill_w = _bucket_pow2(self.max_blocks_per_seq + 2)
         self.blocks = BlockManager(nb, self.bs, config.enable_prefix_caching)
 
         if params is None:
@@ -212,6 +234,12 @@ class PagedJaxLLMEngine:
         # (~100 ms on a tunneled chip, ~3 ms/token-step at chunk 32).
         # (em_dev, active_slots): collected lazily by _drain_locked().
         self._inflight: Optional[Tuple[jnp.ndarray, List[int]]] = None
+        # a finished prefill's sampled first token stays a DEVICE future
+        # until the next drain point: a synchronous int(ids[0]) per request
+        # serialized a ~100 ms readback behind every queued program
+        # (measured: engine prefill 1,493 tok/s vs 13,000 tok/s for the
+        # chunk program itself).  (slot, req, ids_future) tuples.
+        self._first_pending: List[Tuple[int, _PagedReq, jnp.ndarray]] = []
 
         # fused pallas paged-attention kernel (ray_tpu/ops/paged_attention):
         # DMAs only each sequence's live pages — no gather materialization.
@@ -338,10 +366,13 @@ class PagedJaxLLMEngine:
                 continue
             req = self._pending[0]
             shared, matched = self.blocks.match_prefix(req.prompt)
-            # chunks are block-aligned and the final one pads only to a block
-            # multiple, so prefill writes exactly ceil(rem/bs) blocks; +1 is
-            # the first decode write's spare
-            need = math.ceil((len(req.prompt) - matched) / self.bs) + 1
+            # reserve every block any (pow2-bucketed) prefill chunk's table
+            # must cover — chunk padding may reach past the prompt's own
+            # blocks (trimmed at prefill end); +1 is the first decode
+            # write's spare
+            cover = _prefill_plan(len(req.prompt), matched,
+                                  self.config.prefill_chunk, self.bs)
+            need = cover - len(shared) + 1
             fresh = self.blocks.alloc(need)
             if fresh is None:
                 self.blocks.release(shared)
@@ -355,16 +386,25 @@ class PagedJaxLLMEngine:
             self._slot_req[slot] = req
 
     def _prefill_step_locked(self):
-        """Advance at most ONE chunk of ONE mid-prefill slot per step, so
-        prefill interleaves with decode instead of stalling it.  Blocks were
-        reserved at admission — no allocation can fail here."""
+        """Advance mid-prefill slots, one chunk per slot, until the step's
+        token budget (config.prefill_budget_tokens, default one chunk) is
+        spent — so prefill interleaves with decode at a bounded per-step
+        cost (the vLLM max_num_batched_tokens analog), while a burst of
+        arrivals still ramps many slots per step.  Prefill dispatches are
+        pipelined: only a FINAL chunk's sampled token syncs the host.
+        Blocks were reserved at admission — no allocation can fail here."""
+        budget = (self.config.prefill_budget_tokens
+                  or self.config.prefill_chunk)
         for slot in range(self.max_batch):
+            if budget <= 0:
+                return
             req = self._slot_req[slot]
             if req is None or req.prefill_pos >= len(req.prompt):
                 continue
             plen = len(req.prompt)
             remaining = plen - req.prefill_pos
-            c = min(self.config.prefill_chunk, _pad_to(remaining, self.bs))
+            c = min(self.config.prefill_chunk,
+                    _bucket_pow2(_pad_to(remaining, self.bs), lo=self.bs))
             need = math.ceil((req.prefill_pos + c) / self.bs)
             assert need <= len(req.blocks), (
                 f"prefill chunk not covered: need {need} blocks, "
@@ -373,8 +413,7 @@ class PagedJaxLLMEngine:
             take = min(c, remaining)
             tokens = np.zeros((1, c), np.int32)
             tokens[0, :take] = req.prompt[p0:p0 + take]
-            w = _bucket_pow2(len(req.blocks))
-            table = np.zeros((1, w), np.int32)
+            table = np.zeros((1, self._prefill_w), np.int32)
             table[0, :len(req.blocks)] = req.blocks
             is_last = p0 + take >= plen
             sample_idx = (plen - 1 - p0) if is_last else 0
@@ -392,14 +431,12 @@ class PagedJaxLLMEngine:
                     self.blocks.release(req.blocks[keep:])
                     del req.blocks[keep:]
                 self.blocks.register(req.prompt, req.blocks)
-                first = int(ids[0])
                 self._lengths[slot] = plen
-                self._next_tok[slot] = first
                 self._slot_temp[slot] = req.gen.temperature
                 self._slot_topk[slot] = req.gen.top_k
+                self._first_pending.append((slot, req, ids))
                 self._dirty = True
-                self._emit_locked(req, first)
-            return  # one chunk per step
+            budget -= take
 
     def _emit_locked(self, req: _PagedReq, token: int):
         req.out_tokens.append(token)
@@ -521,13 +558,26 @@ class PagedJaxLLMEngine:
                 self._emit_locked(req, tok)
         self._trim_locked(margin=margin)
 
+    def _resolve_first_tokens_locked(self):
+        """Book pending first-token futures (one sync covers them all —
+        their programs finished long before the drain that calls this)."""
+        pending, self._first_pending = self._first_pending, []
+        for slot, req, ids in pending:
+            if self._slot_req[slot] is not req:
+                continue  # preempted before its first token surfaced:
+                # recompute will re-sample it (it was never emitted)
+            first = int(np.asarray(ids)[0])
+            self._next_tok[slot] = first
+            self._emit_locked(req, first)
+
     def _drain_locked(self):
-        """Collect the in-flight decode chunk, if any."""
-        if self._inflight is None:
-            return
-        em_dev, active = self._inflight
-        self._inflight = None
-        self._collect_locked(em_dev, active, margin=0)
+        """Collect the in-flight decode chunk, if any, and any pending
+        first tokens."""
+        if self._inflight is not None:
+            em_dev, active = self._inflight
+            self._inflight = None
+            self._collect_locked(em_dev, active, margin=0)
+        self._resolve_first_tokens_locked()
 
     def step(self, decode: bool = True) -> Dict[int, List[int]]:
         """One engine step: admit, one prefill chunk, one decode chunk.
@@ -542,11 +592,15 @@ class PagedJaxLLMEngine:
         emitted: Dict[int, List[int]] = {}
         with self._lock:
             before = self._emit_snapshot_locked()
-            steady = (not self._pending and not self._dirty and
-                      not any(r is not None and r.prefill_pos < len(r.prompt)
-                              for r in self._slot_req))
-            if not steady:
-                self._drain_locked()
+            if self._pending or any(
+                    r is not None and r.prefill_pos < len(r.prompt)
+                    for r in self._slot_req):
+                # admission + prefill run WITHOUT draining the in-flight
+                # decode chunk: a new slot's fresh blocks are disjoint from
+                # every in-flight table row (its own row was zeros → sink),
+                # and prefill dispatches chain after the decode on the pool
+                # dataflow.  Only a final prefill chunk (_dirty → refresh)
+                # forces a drain, below.
                 self._admit_locked()
                 self._prefill_step_locked()
             chunk = self.config.decode_chunk
@@ -609,6 +663,7 @@ class PagedJaxLLMEngine:
         return emitted
 
     def _refresh_mirrors_locked(self):
+        self._resolve_first_tokens_locked()  # _next_tok must be current
         decode_ready = [
             0 if (r is None or r.prefill_pos < len(r.prompt)) else 1
             for r in self._slot_req]
@@ -669,6 +724,20 @@ class PagedJaxLLMEngine:
                 if w >= w_cap:
                     break
                 w *= 2
+            # prefill programs: one per pow2 chunk width (table width is
+            # fixed), so this covers EVERY prefill shape serving can hit
+            c = self.bs
+            while True:
+                c = min(c, self.config.prefill_chunk)
+                ids, self.pool, _ = self._prefill_chunk(
+                    self.params, jnp.zeros((1, c), jnp.int32), self.pool,
+                    jnp.zeros((1, self._prefill_w), jnp.int32),
+                    jnp.int32(0), jnp.int32(0), key,
+                    jnp.zeros(1, jnp.float32), jnp.zeros(1, jnp.int32))
+                np.asarray(ids)
+                if c >= self.config.prefill_chunk:
+                    break
+                c *= 2
 
     # -- sync convenience ----------------------------------------------
 

@@ -17,6 +17,12 @@ from typing import Any, Dict, List, Optional, Sequence  # noqa: F401
 from ray_tpu.llm.config import GenerationConfig, LLMConfig
 
 
+def _jax_backend() -> str:
+    import jax
+
+    return jax.default_backend()
+
+
 class LLMServer:
     """Deployment callable; bind with serve: see ``build_llm_deployment``.
 
@@ -32,6 +38,12 @@ class LLMServer:
 
         self._config = llm_config
         self._engine = make_engine(llm_config, params)
+        if hasattr(self._engine, "warmup") and _jax_backend() == "tpu":
+            # compile every decode (B, W) bucket before serving traffic —
+            # a bucket transition otherwise costs a multi-second XLA
+            # compile inside the latency path (vLLM warms shapes at
+            # startup the same way)
+            self._engine.warmup()
         self._engines: Dict[Optional[str], Any] = {None: self._engine}
         self._engine_gen: Dict[Optional[str], int] = {None: 0}
         self._engine_order: list = []  # adapter LRU (base never evicted)

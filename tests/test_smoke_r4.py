@@ -1,0 +1,82 @@
+"""Core-lane smokes for the round-4 feature surfaces (VERDICT r4 weak #7).
+
+The full suites live in the slow lane (they compile real XLA programs);
+these tiny-config smokes run in the default core lane so import-level or
+API-surface breakage in any round-4 subsystem fails per-commit, not per
+slow-lane run.  Kept deliberately minimal: one paged generate, one
+pipeline loss, one multi-agent env/module step, one launcher yaml parse.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+
+def test_paged_generate_smoke():
+    from ray_tpu.llm import GenerationConfig, LLMConfig, make_engine
+    from ray_tpu.models.llama import LlamaConfig
+
+    cfg = LlamaConfig.tiny(n_layers=1, dim=64, ffn_dim=128, max_seq_len=32)
+    eng = make_engine(LLMConfig(model_config=cfg, max_batch_size=2,
+                                max_seq_len=32, block_size=8,
+                                prefill_chunk=8, decode_chunk=2))
+    out = eng.generate([[1, 2, 3, 4, 5]],
+                       GenerationConfig(max_new_tokens=3))
+    assert len(out) == 1 and len(out[0]) == 3
+    assert all(0 <= t < cfg.vocab_size for t in out[0])
+
+
+def test_pipeline_loss_smoke():
+    from ray_tpu.models.llama import LlamaConfig, init_params
+    from ray_tpu.parallel.mesh import MeshSpec
+    from ray_tpu.parallel.pipeline import make_pipeline_loss
+
+    cfg = LlamaConfig.tiny(n_layers=2, dim=64, ffn_dim=128, max_seq_len=16)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    mesh = MeshSpec(pipeline=1).build(jax.devices()[:1])
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                cfg.vocab_size)
+    loss = make_pipeline_loss(num_microbatches=2)(
+        cfg, params, tokens, mesh=mesh)
+    assert np.isfinite(float(loss))
+
+
+def test_multi_agent_step_smoke():
+    from ray_tpu.rllib.multi_agent import (
+        MultiAgentCartPole,
+        MultiRLModule,
+        make_multi_agent_env,
+    )
+
+    env = make_multi_agent_env("MultiAgentCartPole")
+    assert isinstance(env, MultiAgentCartPole)
+    obs = env.reset(seed=0)
+    assert set(obs) == set(env.agents)
+    module = MultiRLModule(env.specs, hidden=(8,))
+    assert set(module.modules) == set(env.agents)
+    obs, rew, done, _ = env.step({a: 0 for a in env.agents})
+    assert "__all__" in done and set(rew) == set(env.agents)
+
+
+def test_launcher_yaml_smoke(tmp_path):
+    from ray_tpu.autoscaler.launcher import load_cluster_config
+
+    path = tmp_path / "cluster.yaml"
+    path.write_text("""
+cluster_name: smoke
+provider:
+  type: local
+head_node:
+  num_cpus: 1
+worker_node_groups:
+  - name: workers
+    count: 2
+    resources: {CPU: 1}
+""")
+    cfg = load_cluster_config(str(path))
+    assert cfg.cluster_name == "smoke"
+    assert cfg.worker_node_groups[0].count == 2
+    bad = tmp_path / "bad.yaml"
+    bad.write_text("cluster_name: x\nprovider: {type: bogus}\n")
+    with pytest.raises(ValueError, match="provider.type"):
+        load_cluster_config(str(bad))

@@ -33,22 +33,25 @@ def cluster():
 
 
 @pytest.mark.stress
-def test_thousand_queued_tasks_drain(cluster):
-    """1k tasks queued on one node all complete (envelope: 1M+ at 64 cores;
-    the queue/dispatch/refcount machinery is what's exercised)."""
+def test_ten_thousand_queued_tasks_drain(cluster):
+    """10k tasks queued on one node all complete (envelope: 1M+ at 64
+    cores; the queue/dispatch/refcount machinery is what's exercised —
+    round 5 scaled 10x on the zygote-forked worker pool)."""
 
     @ray_tpu.remote
     def bump(x):
         return x + 1
 
-    refs = [bump.remote(i) for i in range(1000)]
+    refs = [bump.remote(i) for i in range(10_000)]
     out = ray_tpu.get(refs, timeout=600)
-    assert out == [i + 1 for i in range(1000)]
+    assert out == [i + 1 for i in range(10_000)]
 
 
 @pytest.mark.stress
-def test_hundred_actor_fanout(cluster):
-    """100 concurrent lightweight actors (envelope: 40k+ cluster-wide)."""
+def test_thousand_actor_fanout(cluster):
+    """1,000 concurrent lightweight actors (envelope: 40k+ cluster-wide).
+    Feasible on one host because workers fork off the warm zygote
+    (~50 ms/spawn vs 2.3 s full interpreter startup)."""
 
     @ray_tpu.remote
     class Cell:
@@ -58,40 +61,40 @@ def test_hundred_actor_fanout(cluster):
         def get(self):
             return self.v
 
-    cells = [Cell.options(num_cpus=0.01).remote(i) for i in range(100)]
+    cells = [Cell.options(num_cpus=0.001).remote(i) for i in range(1000)]
     vals = ray_tpu.get([c.get.remote() for c in cells], timeout=600)
-    assert vals == list(range(100))
+    assert vals == list(range(1000))
     for c in cells:
         ray_tpu.kill(c)
 
 
 @pytest.mark.stress
 def test_many_object_args_single_task(cluster):
-    """500 object args to one task (envelope: 10000+)."""
+    """2,000 object args to one task (envelope: 10000+)."""
 
     @ray_tpu.remote
     def total(*parts):
         return sum(parts)
 
-    parts = [ray_tpu.put(i) for i in range(500)]
-    assert ray_tpu.get(total.remote(*parts), timeout=600) == sum(range(500))
+    parts = [ray_tpu.put(i) for i in range(2000)]
+    assert ray_tpu.get(total.remote(*parts), timeout=600) == sum(range(2000))
 
 
 @pytest.mark.stress
 def test_many_plasma_objects_one_get(cluster):
-    """1000 plasma objects in a single ray.get (envelope: 10000+)."""
-    arrs = [ray_tpu.put(np.full(16 * 1024, i, np.uint32)) for i in range(1000)]
+    """5,000 plasma objects in a single ray.get (envelope: 10000+)."""
+    arrs = [ray_tpu.put(np.full(16 * 1024, i, np.uint32)) for i in range(5000)]
     out = ray_tpu.get(arrs, timeout=600)
     assert all(int(o[0]) == i for i, o in enumerate(out))
 
 
 @pytest.mark.stress
 def test_many_returns_single_task(cluster):
-    """300 returns from one task (envelope: 3000+)."""
+    """1,000 returns from one task (envelope: 3000+)."""
 
     @ray_tpu.remote
     def fan():
-        return tuple(range(300))
+        return tuple(range(1000))
 
-    refs = fan.options(num_returns=300).remote()
-    assert ray_tpu.get(refs, timeout=600) == list(range(300))
+    refs = fan.options(num_returns=1000).remote()
+    assert ray_tpu.get(refs, timeout=600) == list(range(1000))
