@@ -1139,6 +1139,25 @@ class Raylet:
                 out.append({"pid": pid, "error": str(e)})
         return out
 
+    def HandleAgentNativeStacks(self, req):
+        """Native (C/XLA-frame) stacks of a worker on this node — the key
+        difference from AgentStacks: a worker WEDGED inside an XLA
+        dispatch or the native arena still answers, because the dump
+        rides a C-level signal handler, not an RPC the wedged worker
+        must serve (reference: the reporter agent's py-spy dump)."""
+        from ray_tpu._private.native_stack import dump_native_stacks
+
+        pid = req.get("pid")
+        if pid is None:
+            raise ValueError("AgentNativeStacks needs a pid")
+        pid = int(pid)
+        # only signal workers THIS raylet owns: SIGUSR2's default
+        # disposition is termination, so an unrelated process with the
+        # same pid on another node must never receive it
+        if not any(p == pid for p, _ in self._worker_addrs(pid)):
+            return None
+        return {"pid": pid, "stacks": dump_native_stacks(pid)}
+
     def _proxy_worker_call(self, pid, method: str, payload: dict, reply_token):
         """Forward an agent endpoint to the worker owning ``pid`` with a
         delayed reply (shared by the profiler endpoints)."""

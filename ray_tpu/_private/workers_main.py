@@ -25,6 +25,17 @@ def main():
     worker = CoreWorker(mode=WORKER, raylet_addr=raylet_addr, gcs_addr=gcs_addr, node_id=node_id)
     set_global_worker(worker)
 
+    # native stack dumps (C-level SIGUSR2 handler): a worker wedged inside
+    # an XLA dispatch still yields frames to `ray_tpu.util.state
+    # .dump_native_stacks` — best-effort, the Python endpoints don't
+    # depend on it
+    try:
+        from ray_tpu._private.native_stack import install as _nsinstall
+
+        _nsinstall()
+    except Exception:  # noqa: BLE001
+        pass
+
     # Apply this worker's runtime env BEFORE serving any task (dedicated
     # workers per env; reference: runtime-env agent materializes pre-lease).
     env_hash = os.environ.get("RAY_TPU_RUNTIME_ENV_HASH", "")

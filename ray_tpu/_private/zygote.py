@@ -71,6 +71,15 @@ def serve(sock_path: str) -> None:
     import ray_tpu._private.worker  # noqa: F401
     from ray_tpu._private import workers_main
 
+    try:
+        # compile the native stack-dump component once here: children then
+        # dlopen the cached .so instead of each paying a g++ build
+        from ray_tpu import _native
+
+        _native.load("stack_dump")
+    except Exception:  # noqa: BLE001
+        pass
+
     def _reap(_sig, _frm):
         while True:
             try:

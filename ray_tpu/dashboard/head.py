@@ -89,7 +89,11 @@ class DashboardHead:
     # -- routing --------------------------------------------------------
 
     def _route(self, path: str):
-        path = path.split("?", 1)[0].rstrip("/") or "/"
+        from urllib.parse import parse_qs, urlsplit
+
+        parts = urlsplit(path)
+        query = parse_qs(parts.query)
+        path = parts.path.rstrip("/") or "/"
         if path in ("/", "/index.html"):
             import os
 
@@ -102,12 +106,12 @@ class DashboardHead:
 
             body = prometheus_text() + self._core_metrics_text()
             return body.encode(), "text/plain; version=0.0.4"
-        data = self._api(path)
+        data = self._api(path, query)
         if data is None:
             return None, None
         return json.dumps(_jsonable(data)).encode(), "application/json"
 
-    def _api(self, path: str):
+    def _api(self, path: str, query=None):
         from ray_tpu.util import state
 
         if path == "/api/version":
@@ -150,6 +154,10 @@ class DashboardHead:
             return state.node_stats()
         if path == "/api/stacks":
             return state.dump_stacks()
+        if path == "/api/native_stacks":
+            # /api/native_stacks?pid=N — C/XLA frames of a wedged worker
+            pid = int((query or {}).get("pid", ["0"])[0])
+            return state.dump_native_stacks(pid)
         if path == "/api/events":
             return state.list_cluster_events()
         if path == "/api/serve":

@@ -8,6 +8,7 @@ from ray_tpu.util.state.api import (
     StateApiClient,
     cpu_profile,
     jax_profile,
+    dump_native_stacks,
     dump_stacks,
     node_stats,
     list_actors,
@@ -26,6 +27,7 @@ from ray_tpu.util.state.api import (
 __all__ = [
     "StateApiClient",
     "node_stats",
+    "dump_native_stacks",
     "dump_stacks",
     "cpu_profile",
     "jax_profile",

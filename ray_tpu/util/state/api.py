@@ -182,6 +182,26 @@ class StateApiClient:
                 out.append(row)
         return out
 
+    def dump_native_stacks(self, pid: int, node_id=None) -> List[dict]:
+        """Native (C/XLA) frames of one worker's threads, even when it is
+        wedged inside a native call where the Python-level ``dump_stacks``
+        shows nothing (reference: reporter agent py-spy integration)."""
+        out = []
+        for node in self.list_nodes():
+            if node.get("state") == "DEAD":
+                continue
+            if node_id is not None and node["node_id"] != node_id:
+                continue
+            try:
+                reply = self._w.pool.get(tuple(node["address"])).call(
+                    "AgentNativeStacks", {"pid": pid}, timeout=30)
+            except Exception:  # noqa: BLE001
+                continue
+            if reply:
+                reply["node_id"] = node["node_id"]
+                out.append(reply)
+        return out
+
     def _agent_call_by_pid(self, method: str, payload: dict, *, pid,
                            node_id, timeout: float) -> dict:
         """Try every live node's agent endpoint for ``pid``; the hosting
@@ -295,6 +315,10 @@ def node_stats():
 
 def dump_stacks(node_id=None, pid=None):
     return _client().dump_stacks(node_id, pid)
+
+
+def dump_native_stacks(pid, node_id=None):
+    return _client().dump_native_stacks(pid, node_id)
 
 
 def cpu_profile(pid, node_id=None, duration_s: float = 5.0):
