@@ -9,6 +9,7 @@ from ray_tpu.tune.search.searcher import (
     Searcher,
 )
 from ray_tpu.tune.search.bohb import BOHBSearcher
+from ray_tpu.tune.search.gp import GPSearcher
 from ray_tpu.tune.search.tpe import TPESearcher
 
 __all__ = [
@@ -17,6 +18,7 @@ __all__ = [
     "RandomSearcher",
     "ConcurrencyLimiter",
     "Repeater",
+    "GPSearcher",
     "TPESearcher",
     "BOHBSearcher",
     "OptunaSearch",
