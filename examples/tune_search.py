@@ -1,11 +1,12 @@
-"""ray_tpu.tune: ASHA early stopping + TPE search over a toy objective.
+"""ray_tpu.tune: ASHA early stopping + TPE search over a toy objective,
+plus the native GP-EI Bayesian searcher in ask-tell mode.
 
 Run: python examples/tune_search.py
 """
 import ray_tpu
 from ray_tpu import tune
 from ray_tpu.tune.schedulers import ASHAScheduler
-from ray_tpu.tune.search import ConcurrencyLimiter, TPESearcher
+from ray_tpu.tune.search import ConcurrencyLimiter, GPSearcher, TPESearcher
 
 
 def trainable(config):
@@ -30,6 +31,18 @@ def main():
     best = tuner.fit().get_best_result(metric="loss", mode="min")
     print("best lr:", best.config["lr"], "loss:", best.metrics["loss"])
     ray_tpu.shutdown()
+
+    # GP-EI Bayesian optimization, ask-tell (no cluster needed)
+    gp = GPSearcher({"x": tune.uniform(-5, 5)}, metric="loss", mode="min",
+                    n_startup=4, seed=0)
+    best_x = None
+    for i in range(16):
+        cfg = gp.suggest(f"t{i}")
+        loss = (cfg["x"] - 2.0) ** 2
+        gp.on_trial_complete(f"t{i}", {"loss": loss})
+        if best_x is None or loss < (best_x - 2.0) ** 2:
+            best_x = cfg["x"]
+    print("GP-EI best x:", round(best_x, 3), "(optimum 2.0)")
     print("OK: tune_search")
 
 
