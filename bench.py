@@ -422,6 +422,22 @@ def _bench_llm_decode(on_tpu: bool) -> dict:
         out["best_batch"] = best["batch"]
         out["best_engine"] = best["engine"]
         out["pct_of_roofline_best"] = best["pct_of_roofline"]
+        if on_tpu:
+            # long-context point (prompt 640, mean span ~768 of max_seq
+            # 1024): the regime where the fused paged-attention kernel's
+            # page-exact reads matter most — round 4's gather-based paged
+            # engine was 2-3x SLOWER than static here.  Isolated try: a
+            # failure here must not discard the completed sweep above.
+            lc = {}
+            for kind, ch, nb in (("paged", 32, 1000), ("static", 64, None)):
+                try:
+                    r = _decode_once(mcfg, params, 32, 640, 256, ch, kind,
+                                     num_blocks=nb)
+                    lc[kind] = {"tok_per_sec": r["tok_per_sec"],
+                                "ms_per_step": r["ms_per_step"]}
+                except Exception as e:  # noqa: BLE001
+                    lc[kind] = {"error": str(e)[:160]}
+            out["long_context_b32_prompt640"] = lc
         return out
     except Exception as e:  # noqa: BLE001
         return {"error": str(e)[:200]}

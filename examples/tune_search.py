@@ -35,13 +35,13 @@ def main():
     # GP-EI Bayesian optimization, ask-tell (no cluster needed)
     gp = GPSearcher({"x": tune.uniform(-5, 5)}, metric="loss", mode="min",
                     n_startup=4, seed=0)
-    best_x = None
+    best_x, best_loss = None, None
     for i in range(16):
         cfg = gp.suggest(f"t{i}")
         loss = (cfg["x"] - 2.0) ** 2
         gp.on_trial_complete(f"t{i}", {"loss": loss})
-        if best_x is None or loss < (best_x - 2.0) ** 2:
-            best_x = cfg["x"]
+        if best_loss is None or loss < best_loss:
+            best_x, best_loss = cfg["x"], loss
     print("GP-EI best x:", round(best_x, 3), "(optimum 2.0)")
     print("OK: tune_search")
 

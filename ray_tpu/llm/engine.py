@@ -218,6 +218,10 @@ class JaxLLMEngine:
         self._requests: Dict[int, _Request] = {}
         self._req_counter = 0
         self._lock = threading.Lock()
+        # one decode chunk may stay in flight (collected next step): its
+        # readback overlaps the next chunk's compute, like the paged
+        # engine.  (em_dev, active_slots).
+        self._inflight = None
 
         # params are an ARGUMENT of the jitted programs, never a closure:
         # captured closures lower as inline constants, and a real model's
@@ -304,8 +308,8 @@ class JaxLLMEngine:
 
     def has_work(self) -> bool:
         with self._lock:
-            return bool(self._pending) or any(
-                r is not None for r in self._slot_req)
+            return (bool(self._pending) or self._inflight is not None
+                    or any(r is not None for r in self._slot_req))
 
     def _admit_locked(self):
         """Prefill pending requests into free slots (continuous batching)."""
@@ -354,15 +358,23 @@ class JaxLLMEngine:
 
         Returns {request_id: [tokens emitted this step]}.
         """
-        emitted: Dict[int, List[int]] = {}
         with self._lock:
             before = {id(r): len(r.out_tokens)
                       for r in self._requests.values()}
-            self._admit_locked()
+            if self._pending:
+                # admission prefills synchronously; its cache writes chain
+                # after any in-flight chunk on the cache dataflow, and the
+                # new slot was inactive in that chunk (garbage rows are
+                # overwritten by the decode step that first uses them)
+                self._admit_locked()
             active = [s for s in range(self.max_batch)
                       if self._slot_req[s] is not None]
             if active and decode:
                 if self._dirty:
+                    self._collect_inflight_locked()
+                    active = [s for s in range(self.max_batch)
+                              if self._slot_req[s] is not None]
+                if self._dirty and active:
                     # slot transition since last chunk: refresh the device
                     # mirrors from host truth — the ONLY uploads in the loop
                     self._d_next = jnp.asarray(self._next_tok)
@@ -384,9 +396,12 @@ class JaxLLMEngine:
                     self._d_remaining = jnp.asarray(remaining)
                     self._d_stops = jnp.asarray(stops)
                     self._dirty = False
+            if active and decode:
                 # one chunked decode program for the whole batch; sampling
                 # params are traced per-slot arrays, so mixed greedy /
-                # temperature / top-k callers share a single forward
+                # temperature / top-k callers share a single forward.
+                # PIPELINED: the chunk dispatched here is collected next
+                # step, its readback riding under this dispatch's compute.
                 (em_dev, self._d_next, self.cache, self._d_lengths,
                  self._d_active, self._d_remaining, self._d_key) = \
                     self._decode(
@@ -394,25 +409,51 @@ class JaxLLMEngine:
                         self._d_lengths, self._d_active, self._d_remaining,
                         self._d_stops, self._d_key, self._d_temp,
                         self._d_topk, self.config.decode_chunk)
-                em = np.asarray(em_dev)  # [chunk, B] — the single sync
-                for t in range(em.shape[0]):
-                    for s in active:
-                        req = self._slot_req[s]
-                        if req is None:
-                            continue  # finished earlier in this chunk
-                        tok = int(em[t, s])
-                        if tok < 0:
-                            continue
-                        self._lengths[s] += 1
-                        self._next_tok[s] = tok
-                        self._emit_locked(req, tok)
-            for req in list(self._requests.values()):
-                n0 = before.get(id(req), 0)
-                if len(req.out_tokens) > n0:
-                    emitted[req.request_id] = req.out_tokens[n0:]
-                if req.done:
-                    del self._requests[req.request_id]
+                prev, self._inflight = self._inflight, (em_dev, active)
+                if prev is not None:
+                    self._book_chunk_locked(*prev)
+            else:
+                self._collect_inflight_locked()
+            emitted = self._gather_emitted_locked(before)
         return emitted
+
+    def _book_chunk_locked(self, em_dev, active):
+        em = np.asarray(em_dev)  # [chunk, B] — the single sync
+        for t in range(em.shape[0]):
+            for s in active:
+                req = self._slot_req[s]
+                if req is None:
+                    continue  # finished earlier in this chunk
+                tok = int(em[t, s])
+                if tok < 0:
+                    continue
+                self._lengths[s] += 1
+                self._next_tok[s] = tok
+                self._emit_locked(req, tok)
+
+    def _collect_inflight_locked(self):
+        if self._inflight is not None:
+            em_dev, active = self._inflight
+            self._inflight = None
+            self._book_chunk_locked(em_dev, active)
+
+    def _gather_emitted_locked(self, before):
+        emitted: Dict[int, List[int]] = {}
+        for req in list(self._requests.values()):
+            n0 = before.get(id(req), 0)
+            if len(req.out_tokens) > n0:
+                emitted[req.request_id] = req.out_tokens[n0:]
+            if req.done:
+                del self._requests[req.request_id]
+        return emitted
+
+    def flush(self) -> Dict[int, List[int]]:
+        """Collect any in-flight decode chunk and return its tokens."""
+        with self._lock:
+            before = {id(r): len(r.out_tokens)
+                      for r in self._requests.values()}
+            self._collect_inflight_locked()
+            return self._gather_emitted_locked(before)
 
     # -- sync convenience ----------------------------------------------
 
@@ -429,4 +470,7 @@ class JaxLLMEngine:
                     results[rid].extend(toks)
             with self._lock:
                 waiting = {rid for rid in waiting if rid in self._requests}
+        # the last booking step may have dispatched one more (all-inactive)
+        # chunk: collect it so has_work() is False on a drained engine
+        self.flush()
         return [results[i] for i in ids]

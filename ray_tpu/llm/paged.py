@@ -725,17 +725,23 @@ class PagedJaxLLMEngine:
                     break
                 w *= 2
             # prefill programs: one per pow2 chunk width (table width is
-            # fixed), so this covers EVERY prefill shape serving can hit
+            # fixed), so this covers EVERY prefill shape serving can hit.
+            # Serving caps chunks at the bucketed max prompt width AND the
+            # fixed table's coverage — warm only reachable widths.
+            c_cap = min(self.config.prefill_chunk,
+                        self._prefill_w * self.bs,
+                        _bucket_pow2(_pad_to(self.max_seq, self.bs),
+                                     lo=self.bs))
             c = self.bs
             while True:
-                c = min(c, self.config.prefill_chunk)
+                c = min(c, c_cap)
                 ids, self.pool, _ = self._prefill_chunk(
                     self.params, jnp.zeros((1, c), jnp.int32), self.pool,
                     jnp.zeros((1, self._prefill_w), jnp.int32),
                     jnp.int32(0), jnp.int32(0), key,
                     jnp.zeros(1, jnp.float32), jnp.zeros(1, jnp.int32))
                 np.asarray(ids)
-                if c >= self.config.prefill_chunk:
+                if c >= c_cap:
                     break
                 c *= 2
 
@@ -753,6 +759,9 @@ class PagedJaxLLMEngine:
                     results[rid].extend(toks)
             with self._lock:
                 waiting = {rid for rid in waiting if rid in self._requests}
+        # the last booking step may have dispatched one more (all-inactive)
+        # chunk: collect it so has_work() is False on a drained engine
+        self.flush()
         return [results[i] for i in ids]
 
 
