@@ -645,14 +645,17 @@ def _bench_serving(on_tpu: bool) -> dict:
             all_arrivals.sort()
             ts = [t for t, _ in all_arrivals]
             ns = [n for _, n in all_arrivals]
-            import bisect
-
-            acc = 0.0
+            acc = 0
             j = 0
             for i, t in enumerate(ts):
-                j = bisect.bisect_left(ts, t - 1.0)
-                window = sum(ns[j:i + 1])
-                steady_rate = max(steady_rate, window / 1.0)
+                acc += ns[i]
+                while ts[j] < t - 1.0:
+                    acc -= ns[j]
+                    j += 1
+                # short bursts: divide by the span actually covered, not a
+                # full second (else tiny configs report bogus overhead)
+                span = max(min(1.0, t - ts[0]), 1e-3)
+                steady_rate = max(steady_rate, acc / span)
         return {
             "clients": n_clients, "prompt_lens": prompt_lens,
             "new_tokens": new_tokens, "decode_chunk": chunk,

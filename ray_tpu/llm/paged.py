@@ -54,24 +54,33 @@ class BlockManager:
         self.prefix_caching = prefix_caching
         # block 0 is the SINK: inactive decode slots' zero-padded table rows
         # make the device scatter land there, so it is never allocated —
-        # a live request's data can never be corrupted by an idle slot
-        # insertion-ordered free set: oldest-freed reused first, so cached
-        # (freed but hash-registered) blocks survive as long as possible
-        self.free: "collections.OrderedDict[int, None]" = collections.OrderedDict(
-            (i, None) for i in range(1, num_blocks))
+        # a live request's data can never be corrupted by an idle slot.
+        # TWO insertion-ordered free sets: plain (not hash-registered) and
+        # cached (freed but revivable by match_prefix).  alloc drains plain
+        # first, so prefix-cache entries are evicted only under real
+        # pressure, oldest first — LRU-preserving allocation (the vLLM
+        # free-list policy; without the split, pipelining's margin allocs
+        # churned cached blocks while plain ones sat free).
+        self.free_plain: "collections.OrderedDict[int, None]" = (
+            collections.OrderedDict((i, None) for i in range(1, num_blocks)))
+        self.free_cached: "collections.OrderedDict[int, None]" = (
+            collections.OrderedDict())
         self.ref = [0] * num_blocks
         self.hash_of: Dict[int, int] = {}   # block -> chain hash
         self.by_hash: Dict[int, int] = {}   # chain hash -> block
 
     def num_free(self) -> int:
-        return len(self.free)
+        return len(self.free_plain) + len(self.free_cached)
 
     def alloc(self, n: int) -> Optional[List[int]]:
-        if n > len(self.free):
+        if n > self.num_free():
             return None
         out = []
         for _ in range(n):
-            b, _ = self.free.popitem(last=False)
+            if self.free_plain:
+                b, _ = self.free_plain.popitem(last=False)
+            else:
+                b, _ = self.free_cached.popitem(last=False)
             h = self.hash_of.pop(b, None)  # repurposed: stale cache entry out
             if h is not None and self.by_hash.get(h) == b:
                 del self.by_hash[h]
@@ -84,9 +93,12 @@ class BlockManager:
             self.ref[b] -= 1
             assert self.ref[b] >= 0, f"double free of block {b}"
             if self.ref[b] == 0:
-                # back to the free set but still hash-registered: a future
-                # match_prefix can revive it until alloc repurposes it
-                self.free[b] = None
+                # still hash-registered blocks stay revivable by
+                # match_prefix until allocation pressure evicts them
+                if b in self.hash_of:
+                    self.free_cached[b] = None
+                else:
+                    self.free_plain[b] = None
 
     def match_prefix(self, prompt: Sequence[int]) -> Tuple[List[int], int]:
         """Longest run of cached full blocks covering < len(prompt) tokens
@@ -105,7 +117,8 @@ class BlockManager:
             ids.append(b)
         for b in ids:
             if self.ref[b] == 0:
-                self.free.pop(b, None)  # revive a cached-free block
+                self.free_cached.pop(b, None)  # revive a cached-free block
+                self.free_plain.pop(b, None)
             self.ref[b] += 1
         return ids, len(ids) * self.bs
 

@@ -226,3 +226,24 @@ def test_oversized_request_rejected(tiny_cfg, tiny_params):
                   block_size=8, num_blocks=4), params=tiny_params)
     with pytest.raises(ValueError, match="blocks"):
         eng.add_request(list(range(1, 60)), _gen(max_new_tokens=60))
+
+
+def test_block_manager_evicts_cached_last():
+    """Allocation drains plain free blocks before repurposing cached
+    (prefix-registered) ones — LRU-preserving allocation, so cache entries
+    die only under real pressure (the vLLM free-list policy)."""
+    bm = BlockManager(num_blocks=10, block_size=4)
+    prompt = list(range(1, 9))  # 2 full blocks
+    owned = bm.alloc(2)
+    bm.register(prompt, owned)
+    bm.release(owned)  # cached-free now
+    # plenty of plain free blocks remain: allocs must not touch the cache
+    taken = bm.alloc(7)
+    ids, n = bm.match_prefix(prompt + [99])
+    assert n == 8, "cached blocks were repurposed despite plain free ones"
+    bm.release(ids)
+    bm.release(taken)
+    # under REAL pressure the cached blocks are evictable
+    everything = bm.alloc(9)
+    assert everything is not None and bm.match_prefix(prompt + [99]) == ([], 0)
+    bm.release(everything)
