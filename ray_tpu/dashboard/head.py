@@ -76,6 +76,12 @@ class DashboardHead:
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True, name="dashboard-head")
         self._thread.start()
+        # opt-in usage telemetry (reference: usage_stats_head.py); no-op
+        # unless RAY_TPU_USAGE_STATS_ENABLED=1
+        from ray_tpu.dashboard.usage_stats import UsageStatsReporter
+
+        self._usage_reporter = UsageStatsReporter()
+        self._usage_reporter.start()
 
     @property
     def url(self) -> str:
@@ -83,6 +89,7 @@ class DashboardHead:
         return f"http://{host}:{port}"
 
     def shutdown(self):
+        self._usage_reporter.stop()
         self._server.shutdown()
         self._server.server_close()
 

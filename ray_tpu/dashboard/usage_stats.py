@@ -1,0 +1,158 @@
+"""Usage telemetry + export-event sinks (opt-in, off by default).
+
+reference: dashboard/modules/usage_stats/usage_stats_head.py (periodic
+usage reports to a collector URL) and src/ray/protobuf/export_*.proto
+(structured event export for external observability pipelines).  Both are
+fleet-observability plumbing: a cluster periodically summarizes what it
+is (version, nodes, resources, which libraries are in use) and ships
+that plus its event stream to operator-configured sinks.
+
+Here the same contract, privacy-first and zero-egress-safe:
+
+  - DISABLED unless ``RAY_TPU_USAGE_STATS_ENABLED=1`` (the reference
+    ships enabled-by-default telemetry; this deployment's images are
+    zero-egress, so opt-in is the only sane default)
+  - sinks: always a local JSON file (``usage_stats.json`` in the session
+    temp dir or ``RAY_TPU_USAGE_STATS_FILE``); additionally an HTTP POST
+    when ``RAY_TPU_USAGE_STATS_URL`` is set (injectable transport, like
+    the BigQuery/ClickHouse connectors)
+  - export events: ``export_cluster_events(path)`` appends the cluster
+    event stream as JSONL — the export_*.proto capability without a
+    proto toolchain (recorded decision: pickle/JSON wire formats)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+from typing import Any, Dict, Optional
+
+_LIBRARIES = ("data", "train", "tune", "serve", "llm", "rllib", "dag")
+
+
+def usage_stats_enabled() -> bool:
+    return os.environ.get("RAY_TPU_USAGE_STATS_ENABLED", "0") == "1"
+
+
+def _library_usage() -> Dict[str, bool]:
+    """Which ray_tpu libraries this process has imported (the reference
+    tracks library usage the same way: by recording import touchpoints)."""
+    return {lib: f"ray_tpu.{lib}" in sys.modules for lib in _LIBRARIES}
+
+
+def collect_usage_report() -> Dict[str, Any]:
+    """One usage snapshot (schema mirrors the reference's UsageStats)."""
+    report: Dict[str, Any] = {
+        "schema_version": "0.1",
+        "source": "ray_tpu",
+        "collected_at": time.time(),
+        "python_version": sys.version.split()[0],
+        "platform": sys.platform,
+        "library_usage": _library_usage(),
+    }
+    try:
+        from ray_tpu.util import state
+
+        nodes = state.list_nodes()
+        report["num_nodes"] = len(
+            [n for n in nodes if n.get("state") != "DEAD"])
+        total: Dict[str, float] = {}
+        for n in nodes:
+            # node rows carry {"resources": {"total": {...}, ...}}
+            res = (n.get("resources") or {}).get("total") or {}
+            for k, v in res.items():
+                total[k] = total.get(k, 0.0) + float(v)
+        report["total_resources"] = total
+    except Exception:  # noqa: BLE001 — no cluster: process-local report
+        report["num_nodes"] = 0
+        report["total_resources"] = {}
+    return report
+
+
+def default_report_path() -> str:
+    return os.environ.get(
+        "RAY_TPU_USAGE_STATS_FILE",
+        os.path.join(tempfile.gettempdir(), "ray_tpu_usage_stats.json"))
+
+
+def write_usage_report(report: Optional[Dict[str, Any]] = None, *,
+                       transport=None) -> Dict[str, Any]:
+    """Write one report to the configured sinks; returns the report.
+    ``transport``: injectable callable(url, payload_bytes) for tests."""
+    report = report or collect_usage_report()
+    path = default_report_path()
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(report, f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+    url = os.environ.get("RAY_TPU_USAGE_STATS_URL")
+    if url:
+        payload = json.dumps(report).encode()
+        try:
+            if transport is not None:
+                transport(url, payload)
+            else:
+                import urllib.request
+
+                req = urllib.request.Request(
+                    url, data=payload,
+                    headers={"Content-Type": "application/json"})
+                urllib.request.urlopen(req, timeout=10).read()
+        except Exception:  # noqa: BLE001 — telemetry must never break work
+            pass
+    return report
+
+
+def export_cluster_events(path: str, *, since_ts: float = 0.0) -> int:
+    """Append the cluster event stream to ``path`` as JSONL (the
+    export_*.proto event-sink capability); returns events written."""
+    from ray_tpu.util import state
+
+    events = state.list_cluster_events()
+    n = 0
+    with open(path, "a") as f:
+        for ev in events:
+            if float(ev.get("ts", 0)) < since_ts:  # events carry 'ts'
+                continue
+            f.write(json.dumps(ev, default=str) + "\n")
+            n += 1
+    return n
+
+
+class UsageStatsReporter:
+    """Background periodic reporter (started by the dashboard head when
+    enabled; interval via RAY_TPU_USAGE_STATS_INTERVAL_S, default 300)."""
+
+    def __init__(self, interval_s: Optional[float] = None):
+        if interval_s is None:
+            interval_s = float(
+                os.environ.get("RAY_TPU_USAGE_STATS_INTERVAL_S", "300"))
+        self.interval_s = max(1.0, interval_s)  # 0 would busy-loop
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        if not usage_stats_enabled() or self._thread is not None:
+            return
+        # telemetry must never break (or block) work: every report —
+        # including the immediate first one — runs guarded on the
+        # background thread, never on the caller's (DashboardHead.__init__)
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="usage-stats")
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            try:
+                write_usage_report()
+            except Exception:  # noqa: BLE001
+                pass
+            if self._stop.wait(self.interval_s):
+                return
+
+    def stop(self):
+        self._stop.set()
