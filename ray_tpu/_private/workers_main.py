@@ -14,6 +14,19 @@ import time
 
 def main():
     logging.basicConfig(level=os.environ.get("RAY_TPU_LOG_LEVEL", "WARNING"))
+    # honor JAX_PLATFORMS in workers: TPU-tunnel images force-register
+    # their backend via sitecustomize in EVERY interpreter and IGNORE the
+    # env var, so a CPU test lane's workers would still claim (or hang on)
+    # the tunnel.  jax.config is the binding that actually works; jax is
+    # already imported by the sitecustomize, so this is cheap.
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", plat)
+        except Exception:  # noqa: BLE001 — never block worker boot on this
+            pass
     raylet_addr = (os.environ["RAY_TPU_RAYLET_HOST"], int(os.environ["RAY_TPU_RAYLET_PORT"]))
     gcs_addr = (os.environ["RAY_TPU_GCS_HOST"], int(os.environ["RAY_TPU_GCS_PORT"]))
 

@@ -9,7 +9,13 @@
 import os
 
 os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# ASSIGN, not setdefault: TPU-tunnel images ship JAX_PLATFORMS=axon in the
+# ambient env, which a setdefault would keep — and WORKER processes (which
+# honor the env var via workers_main) would then initialize the tunnel
+# backend inside hermetic CPU-lane tests, claiming (or hanging on) the
+# chip.  RAY_TPU_TEST_ON_TPU=1 opts out for on-hardware runs.
+if os.environ.get("RAY_TPU_TEST_ON_TPU") != "1":
+    os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("RAY_TPU_DISABLE_METADATA_SERVER", "1")
 os.environ.setdefault("RAY_TPU_WORKER_QUIET", "1")
 # starved 1-CPU CI host: a jit compile in one worker can stall peers'
