@@ -767,6 +767,18 @@ def _bench_core_perf() -> dict:
         return {"error": str(e)[:200]}
 
 
+def _collective_metrics_snapshot() -> dict:
+    """This process's built-in collective metric points (see
+    runtime_metrics.collective_snapshot): {op/wsN: {bytes_total, ops,
+    mean_latency_s, busbw_gbps}}."""
+    try:
+        from ray_tpu._private import runtime_metrics
+
+        return runtime_metrics.collective_snapshot()
+    except Exception as e:  # noqa: BLE001
+        return {"error": str(e)[:200]}
+
+
 def _probe_backend(timeout_s: float = 240.0):
     """Resolve the backend and run one tiny op under a watchdog.
 
@@ -865,6 +877,10 @@ def main():
             "serving": _bench_serving(on_tpu),
             "core_perf": _bench_core_perf(),
             "dryrun_8b": _dryrun_8b(),
+            # built-in collective telemetry recorded during the benches above
+            # (per-op bytes / mean latency / derived bus bandwidth), so
+            # BENCH_*.json carries bandwidth numbers without extra plumbing
+            "collective_metrics": _collective_metrics_snapshot(),
         },
     }
     print(json.dumps(result))

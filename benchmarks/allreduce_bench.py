@@ -27,6 +27,7 @@ def bench_mesh(sizes_mb, dtype_name="bfloat16", iters=20):
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+    from ray_tpu._private import runtime_metrics
     from ray_tpu.util.jax_compat import shard_map as _shard_map
 
     devices = jax.devices()
@@ -58,6 +59,10 @@ def bench_mesh(sizes_mb, dtype_name="bfloat16", iters=20):
         dt = (time.perf_counter() - t0) / iters
         size = count * dtype.itemsize
         busbw = (2 * (n - 1) / max(n, 1)) * size / dt if n > 1 else size / dt
+        # book the measured op into the built-in collective metrics so
+        # bench.py's JSON line (and any scrape) picks the numbers up for free
+        runtime_metrics.record_collective(
+            "allreduce", "xla_mesh", n, size, dt, dtype_name)
         results.append({
             "metric": "allreduce_busbw",
             "mode": "mesh",

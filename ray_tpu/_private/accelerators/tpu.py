@@ -122,6 +122,15 @@ class TPUAcceleratorManager(AcceleratorManager):
         else:
             os.environ.pop(TPU_CHIPS_PER_HOST_BOUNDS_ENV, None)
             os.environ.pop(TPU_HOST_BOUNDS_ENV, None)
+        try:
+            # built-in gauge: chips this worker process has carved for itself
+            # (the per-node total/claimed view lives in the raylet's
+            # ray_tpu_tpu_chips gauges)
+            from ray_tpu._private import runtime_metrics
+
+            runtime_metrics.TPU_PROCESS_CHIPS.set(num)
+        except Exception:  # noqa: BLE001
+            pass
 
     # -- pod metadata (reference: tpu.py:240-334) ------------------------
 

@@ -38,6 +38,17 @@ class RayTpuConfig:
     # periodic re-subscribe heals pubsub across GCS restarts and transient
     # connect-failure evictions (Subscribe is idempotent)
     resubscribe_interval_s: float = 5.0
+    # --- built-in runtime metrics (_private/runtime_metrics.py) ---
+    # min seconds between piggybacked metric pushes to the GCS per process
+    metrics_report_interval_s: float = 2.0
+    # a spawned worker that never registers is killed and its _starting slot
+    # reclaimed after this deadline; must sit comfortably above the worker's
+    # 90 s registration retry window
+    worker_spawn_timeout_s: float = 180.0
+    # zygote socket ops under the dispatch lock get this budget before the
+    # spawn falls back to the Popen path (a wedged zygote must not stall
+    # dispatch)
+    zygote_spawn_timeout_s: float = 2.0
     # --- object store ---
     object_store_memory_bytes: int = 2 * 1024**3
     object_store_spill_dir: str = "/tmp/ray_tpu_spill"

@@ -32,6 +32,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
+from ray_tpu._private import runtime_metrics
 from ray_tpu._private.config import RayTpuConfig, global_config
 from ray_tpu._private.ids import ActorID, JobID, NodeID, PlacementGroupID, WorkerID
 from ray_tpu._private.resources import NodeResources, ResourceSet
@@ -193,6 +194,13 @@ class GcsServer:
 
         self.server = RpcServer(host=host, port=port)
         self.server.register_all(self)
+        # built-in runtime metrics: per-method RPC latency rides the server's
+        # dispatch observer; a GCS hosted in a worker-less process pushes its
+        # registry through the in-process adapter below
+        self.server.observer = runtime_metrics.observe_gcs_rpc
+        from ray_tpu.util import metrics as _metrics
+
+        _metrics.set_fallback_gcs(_LocalGcsChannel(self))
         self._threads = [
             threading.Thread(target=self._actor_scheduling_loop, daemon=True, name="gcs-actor-sched"),
             threading.Thread(target=self._health_check_loop, daemon=True, name="gcs-health"),
@@ -393,6 +401,10 @@ class GcsServer:
             cutoff = time.monotonic() - period * cfg.health_check_failure_threshold
             with self._lock:
                 stale = [nid for nid, i in self.nodes.items() if i.state == "ALIVE" and i.last_report < cutoff and not i.is_head]
+                runtime_metrics.set_gcs_sink_sizes(
+                    len(self.task_events), len(self.metrics_by_reporter),
+                    len(self.events))
+            runtime_metrics.maybe_push()
             for nid in stale:
                 self._mark_node_dead(nid, "missed health checks")
 
@@ -931,8 +943,12 @@ class GcsServer:
 
     def HandleReportMetrics(self, req):
         with self._lock:
+            # "time" (reporter wall clock) orders gauge newest-wins between
+            # reporters; "recv" (GCS-local monotonic) drives staleness —
+            # cross-host clock skew must not expire a live node's gauges
             self.metrics_by_reporter[req["reporter"]] = {
                 "points": req["points"], "time": req.get("time"),
+                "recv": time.monotonic(),
             }
             # bound memory across worker churn: evict stalest reporters
             while len(self.metrics_by_reporter) > 512:
@@ -941,18 +957,30 @@ class GcsServer:
                 del self.metrics_by_reporter[stalest]
         return True
 
+    # gauges from reporters silent this long are dropped from the aggregate:
+    # a dead node/worker must stop asserting its last chip counts / store
+    # bytes (counters and histograms are events that HAPPENED — they stay)
+    _GAUGE_STALE_S = 30.0
+
     def HandleCollectMetrics(self, req):
         """Aggregate across reporters: counters/histograms sum, gauges
-        newest-report-wins (by the reporter's push timestamp)."""
+        newest-report-wins (by the reporter's push timestamp) and only from
+        recently-live reporters."""
         with self._lock:
             snapshots = [
-                (s.get("time") or 0.0, s["points"])
+                (s.get("time") or 0.0, s.get("recv", 0.0), s["points"])
                 for s in self.metrics_by_reporter.values()
             ]
+        gauge_cutoff = time.monotonic() - max(
+            self._GAUGE_STALE_S,
+            10 * global_config().metrics_report_interval_s)
         agg: dict = {}
         gauge_time: dict = {}
-        for report_time, points in snapshots:
+        for report_time, recv_time, points in snapshots:
+            stale = recv_time < gauge_cutoff
             for p in points:
+                if stale and p["kind"] == "gauge":
+                    continue
                 # histograms additionally keyed by boundaries so reporters
                 # with mismatched bucket layouts never get zip-truncated
                 key = (p["name"], tuple(sorted(p.get("tags", {}).items())),
@@ -971,3 +999,15 @@ class GcsServer:
                     cur["value"] = p["value"]
                     gauge_time[key] = report_time
         return list(agg.values())
+
+
+class _LocalGcsChannel:
+    """In-process GCS channel for metric pushes from a worker-less head
+    process (matches the RpcClient .call surface used by metrics.py; no
+    socket hop for a server talking to itself)."""
+
+    def __init__(self, gcs: GcsServer):
+        self._gcs = gcs
+
+    def call(self, method: str, payload, timeout=None, **_kw):
+        return getattr(self._gcs, f"Handle{method}")(payload)

@@ -35,6 +35,7 @@ from dataclasses import dataclass, field
 from multiprocessing import shared_memory
 from typing import Dict, List, Optional, Tuple
 
+from ray_tpu._private import runtime_metrics
 from ray_tpu._private.config import global_config
 from ray_tpu._private.ids import ObjectID
 
@@ -163,6 +164,7 @@ class LocalObjectStore:
             self._entries[object_id] = _Entry(locator=locator, size=size, shm=shm,
                                               native_key=key)
             self._used += size
+            runtime_metrics.add_stored_bytes(size)
             return locator
 
     def _alloc_locked(self, object_id: ObjectID, size: int, suffix: str = ""):
@@ -351,6 +353,12 @@ class LocalObjectStore:
         with self._lock:
             return [oid for oid, e in self._entries.items() if e.sealed]
 
+    def num_sealed(self) -> int:
+        """Sealed-object count without materializing the id list (gauge
+        refresh path)."""
+        with self._lock:
+            return sum(1 for e in self._entries.values() if e.sealed)
+
     def used_bytes(self) -> int:
         with self._lock:
             return self._used
@@ -405,6 +413,7 @@ class LocalObjectStore:
                                                    buf[: e.size])
         self._dealloc_locked(object_id, e)
         self._used -= e.size
+        runtime_metrics.add_spilled_bytes(e.size)
 
     def _restore_locked(self, object_id: ObjectID, e: _Entry):
         if e.spilled_path is None:
@@ -423,6 +432,7 @@ class LocalObjectStore:
             raise ObjectLostError(
                 f"{object_id}: spill copy truncated ({n} of {e.size} bytes "
                 f"at {e.spilled_path})")
+        runtime_metrics.add_restored_bytes(e.size)
 
 
 # ---------------------------------------------------------------------------

@@ -24,6 +24,7 @@ from collections import defaultdict, deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
+from ray_tpu._private import runtime_metrics
 from ray_tpu._private.config import RayTpuConfig, global_config
 from ray_tpu._private.ids import NodeID, ObjectID, PlacementGroupID, WorkerID
 from ray_tpu._private.object_store import LocalObjectStore
@@ -121,6 +122,32 @@ class Raylet:
         self._lock = threading.RLock()
         self._dispatch_cv = threading.Condition(self._lock)
         self._spawning_procs: Dict[int, subprocess.Popen] = {}
+        # pid -> (spawn monotonic ts, "zygote"|"popen") for spawn latency
+        self._spawn_started: Dict[int, Tuple[float, str]] = {}
+        # pid -> kill monotonic ts for spawns reclaimed by the timeout
+        # watcher: a racing RegisterWorker from one of these must be refused
+        # (the process is being SIGKILLed; accepting it would put a dead
+        # worker in the pool and double-decrement _starting).  Entries
+        # expire after _SPAWN_REFUSE_S so a recycled OS pid can register.
+        self._spawn_timed_out: Dict[int, float] = {}
+        # built-in runtime metrics: worker-less head processes push through
+        # this raylet's GCS client; gauge families whose tag-sets churn
+        # (pending shapes, worker states) zero out vanished series
+        from ray_tpu.util import metrics as _metrics
+
+        _metrics.set_fallback_gcs(self.gcs)
+        # one refresh immediately at startup (negative-infinity analog), then
+        # paced at metrics_report_interval_s by the report loop
+        self._last_gauge_refresh = float("-inf")
+        self._pending_shape_gauges = runtime_metrics.TaggedGaugeSet(
+            runtime_metrics.PENDING_TASKS, "shape")
+        self._worker_state_gauges = runtime_metrics.TaggedGaugeSet(
+            runtime_metrics.WORKERS, "state")
+        node_tag = self.node_id.hex()[:8]
+        self._store_used_gauge = runtime_metrics.STORE_USED_BYTES.with_tags(
+            {"node": node_tag})
+        self._store_objects_gauge = runtime_metrics.STORE_OBJECTS.with_tags(
+            {"node": node_tag})
         # warm zygote for fast worker forks; starts in the background at
         # init so the first spawn (under the dispatch lock) never waits
         self._zygote = None
@@ -243,11 +270,45 @@ class Raylet:
                 if nid != self.node_id and nid not in seen:
                     self.cluster.remove_node(nid)
 
+    def _update_node_gauges_locked(self):
+        """Refresh this node's built-in gauges (called from the report loop
+        under self._lock; every read here is O(pool size))."""
+        from collections import Counter as _Counter
+
+        shapes = _Counter(
+            runtime_metrics.shape_str(p.spec.resources.to_dict())
+            for p in self._pending_leases)
+        self._pending_shape_gauges.set_all(dict(shapes))
+        self._worker_state_gauges.set_all({
+            "starting": sum(self._starting.values()),
+            "idle": sum(len(p) for p in self._idle_workers.values()),
+            "busy": len(self._leases),
+            "total": len(self._all_workers),
+        })
+        total_tpu = self.local_resources.total.get("TPU")
+        if total_tpu:
+            runtime_metrics.set_tpu_chips(
+                self.node_id.hex()[:8], total_tpu,
+                total_tpu - self.local_resources.available.get("TPU"))
+        self._store_used_gauge.set(self.store.used_bytes())
+        self._store_objects_gauge.set(self.store.num_sealed())
+
     def _report_loop(self):
         while not self._stopped.wait(global_config().resource_report_interval_s):
             try:
+                interval = global_config().metrics_report_interval_s
+                now = time.monotonic()
                 with self._lock:
                     avail = self.local_resources.available.to_dict()
+                    # gauge refresh is O(pool+queue+objects): pace it at the
+                    # metrics interval, not every 0.2 s report tick.  Own
+                    # clock, NOT the process-global push throttle — other
+                    # pushers (driver collect_cluster, task flushes) reset
+                    # that one constantly and would starve the refresh.
+                    if now - self._last_gauge_refresh >= interval:
+                        self._last_gauge_refresh = now
+                        self._update_node_gauges_locked()
+                runtime_metrics.maybe_push()
                 reply = self.gcs.call("ReportResources", {"node_id": self.node_id, "available": avail})
                 if reply.get("restart"):
                     # GCS restarted and lost us (reference: HandleNotifyGCSRestart
@@ -294,8 +355,11 @@ class Raylet:
         # so prints land promptly.
         env.setdefault("PYTHONUNBUFFERED", "1")
         log_file = self._log_monitor.new_log_file()
+        spawn_t0 = time.monotonic()
         proc = self._zygote_spawn(env, log_file)
+        method = "zygote"
         if proc is None:
+            method = "popen"
             with open(log_file, "ab") as lf:
                 proc = subprocess.Popen(
                     [sys.executable, "-m", "ray_tpu._private.workers_main"],
@@ -303,8 +367,10 @@ class Raylet:
                     stdout=lf,
                     stderr=subprocess.STDOUT,
                 )
+        runtime_metrics.inc_spawn(method)
         self._log_monitor.register_pid(log_file, proc.pid)
         self._spawning_procs[proc.pid] = proc
+        self._spawn_started[proc.pid] = (spawn_t0, method)
         threading.Thread(
             target=self._watch_spawn, args=(proc, env_hash), daemon=True,
             name="raylet-spawnwatch"
@@ -322,30 +388,82 @@ class Raylet:
         return _PidHandle(pid) if pid else None
 
     def _watch_spawn(self, proc, env_hash: str):
-        """If a spawned worker exits before registering, decrement _starting.
+        """If a spawned worker exits — or wedges — before registering,
+        reclaim its _starting slot.
 
-        No deadline: the watcher runs until the worker registers or its
-        process dies (workers retry registration up to 90 s against a
-        swamped raylet — a timed-out watcher would leak the _starting
-        budget forever if the worker died after the window).  The thread
-        is a daemon and exits with the raylet."""
+        The deadline (worker_spawn_timeout_s, default 3 min) sits well above
+        the worker's 90 s registration retry window, so a slow-but-alive
+        worker always registers first; a worker stuck before registration
+        (hung import, stalled zygote child) is killed on expiry instead of
+        pinning a maximum_startup_concurrency slot and this poll thread
+        forever.  Timeouts are counted in
+        ray_tpu_raylet_worker_spawn_timeout_total so the leak is visible."""
+        deadline = time.monotonic() + global_config().worker_spawn_timeout_s
         while not self._stopped.is_set():
             with self._lock:
                 if proc.pid not in self._spawning_procs:
                     return  # registered
-            if proc.poll() is not None:
+            dead = proc.poll() is not None
+            expired = not dead and time.monotonic() > deadline
+            if dead or expired:
                 with self._lock:
-                    if self._spawning_procs.pop(proc.pid, None) is not None:
+                    # pop-under-lock decides ownership: HandleRegisterWorker
+                    # pops the same key, so whoever pops it acts and
+                    # _starting is decremented exactly once; a registration
+                    # racing an expiry is REFUSED via _spawn_timed_out (the
+                    # process is being killed — accepting would pool a
+                    # corpse)
+                    owned = self._spawning_procs.pop(proc.pid, None) is not None
+                    if owned:
                         self._starting[env_hash] = max(0, self._starting[env_hash] - 1)
+                        self._spawn_started.pop(proc.pid, None)
+                        if expired:
+                            now = time.monotonic()
+                            self._spawn_timed_out = {
+                                p: t for p, t in self._spawn_timed_out.items()
+                                if now - t < self._SPAWN_REFUSE_S}
+                            self._spawn_timed_out[proc.pid] = now
                     self._dispatch_cv.notify_all()
+                if owned and expired:
+                    try:
+                        proc.kill()
+                    except Exception:  # noqa: BLE001
+                        pass
+                    runtime_metrics.inc_spawn_timeout()
+                    logger.warning(
+                        "raylet %s: spawned worker pid %s never "
+                        "registered within %.0f s; killed",
+                        self.node_id, proc.pid,
+                        global_config().worker_spawn_timeout_s)
                 return
             time.sleep(0.05)
+
+    # refusal window for timed-out spawn pids: far longer than the SIGKILL→
+    # register race it guards against, far shorter than OS pid recycling
+    _SPAWN_REFUSE_S = 60.0
 
     def HandleRegisterWorker(self, req):
         pid = req.get("pid")
         env_hash = req.get("env_hash", "")
         with self._lock:
+            killed_at = self._spawn_timed_out.get(pid) if pid is not None else None
+            if (killed_at is not None
+                    and time.monotonic() - killed_at < self._SPAWN_REFUSE_S):
+                # the watcher already reclaimed this spawn's slot and is
+                # killing the process; refusing here keeps the pool free of
+                # dead workers and _starting single-decremented
+                self._spawn_timed_out.pop(pid, None)
+                raise RuntimeError(
+                    f"worker pid {pid} exceeded the spawn deadline and was "
+                    "reclaimed; registration refused")
+            if killed_at is not None:
+                self._spawn_timed_out.pop(pid, None)  # expired: pid recycled
             proc = self._spawning_procs.pop(pid, None) if pid is not None else None
+            started = self._spawn_started.pop(pid, None) if pid is not None else None
+        if started is not None:
+            runtime_metrics.observe_spawn(
+                started[1], time.monotonic() - started[0])
+        with self._lock:
             if proc is None and pid is not None:
                 proc = _PidHandle(pid)
             worker = _Worker(worker_id=req["worker_id"], address=tuple(req["address"]),
@@ -503,8 +621,10 @@ class Raylet:
                 self._dispatch_cv.wait(timeout=0.2)
                 if self._stopped.is_set():
                     return
+                t0 = time.perf_counter()
                 self._try_dispatch_locked()
                 self._try_grant_waiting_locked()
+                runtime_metrics.observe_dispatch(time.perf_counter() - t0)
 
     def _try_dispatch_locked(self):
         still_pending: deque[_PendingLease] = deque()
@@ -542,6 +662,7 @@ class Raylet:
                 if addr is None:
                     still_pending.append(p)
                     continue
+                runtime_metrics.inc_spillback()
                 self.server.send_reply(p.reply_token, {"spillback": tuple(addr)})
                 continue
             instances = self.local_resources.allocate(spec.resources)
@@ -621,6 +742,8 @@ class Raylet:
 
     def _grant_one_locked(self, entry, env_key: str):
         p, demand, instances, pg_id, bundle_index = entry
+        runtime_metrics.observe_schedule_latency(
+            time.monotonic() - p.enqueue_time)
         worker = self._idle_workers[env_key].popleft()
         self._lease_counter += 1
         lease_id = f"{self.node_id.hex()[:8]}-{self._lease_counter}"
@@ -1119,6 +1242,17 @@ class Raylet:
             pids = [w.proc.pid for w in self._all_workers.values()
                     if w.proc is not None]
         return self._node_stats.collect(pids)
+
+    def HandleAgentMetrics(self, req):
+        """Per-node Prometheus exposition: this raylet process's local metric
+        registry (reference: the per-node MetricsAgent's /metrics).  The
+        head's /metrics stays the cluster-wide aggregate; this is the
+        node-scoped view the dashboard/state API surface per node."""
+        from ray_tpu.util.metrics import collect_local, prometheus_text
+
+        with self._lock:
+            self._update_node_gauges_locked()
+        return prometheus_text(collect_local())
 
     def _worker_addrs(self, pid=None):
         with self._lock:

@@ -154,6 +154,9 @@ class RpcServer:
         the first ``pickle.loads`` (used by the ray:// client server when
         bound off-loopback)."""
         self._handlers: Dict[str, Callable] = {}
+        # optional fn(method, seconds) timing every synchronous handler
+        # dispatch — the GCS hangs its per-method RPC latency histogram here
+        self.observer: Optional[Callable[[str, float], None]] = None
         self._pool = DaemonExecutor(max_workers=num_threads, thread_name_prefix="rpc-handler")
         self._lock = threading.Lock()
         # live client connections: shutdown() must sever them, or peers keep
@@ -227,7 +230,14 @@ class RpcServer:
         try:
             if handler is None:
                 raise RpcError(f"no handler for method {method!r}")
+            observer = self.observer
+            t0 = time.perf_counter() if observer is not None else 0.0
             result = handler(payload) if handler.__code__.co_argcount <= (2 if hasattr(handler, "__self__") else 1) else handler(payload, reply_token)
+            if observer is not None:
+                try:
+                    observer(method, time.perf_counter() - t0)
+                except Exception:  # noqa: BLE001 — metrics never fail an RPC
+                    pass
             if result is RpcServer.DELAYED_REPLY:
                 return
             frame = pickle.dumps(("ok", result), protocol=5)

@@ -1,0 +1,391 @@
+"""Built-in runtime metrics: the canonical metric set every layer records.
+
+TPU-native analog of the reference's C++ stats registry
+(reference: src/ray/stats/metric_defs.cc — ray_scheduler_*, ray_raylet_*,
+ray_object_store_*, ray_grpc_server_* families; exposition via the per-node
+MetricsAgent, _private/metrics_agent.py).  This module declares every
+built-in family ONCE and hands the hot paths constant-cost bound recorders
+(util/metrics.py BoundCounter/BoundGauge/BoundHistogram): recording is a
+lock + one dict update, flushes piggyback on the existing periodic GCS
+pushes (metrics.maybe_push), so instrumentation never adds an RPC to a hot
+path.
+
+Naming: ``ray_tpu_<layer>_<what>[_<unit>]``; layers are scheduler, raylet,
+gcs, object_store, task, collective, tpu, serve, data.  The full family
+list lives in FAMILIES (used by docs and the exposure test).
+
+Tag cardinality discipline: tags are bounded sets (op names, worker states,
+resource-shape strings, deployment names) — never ids of unbounded spaces
+(task ids, object ids).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+# latency boundaries tuned for control-plane work: 100 µs .. 30 s
+_LATENCY_BOUNDS = [0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.25,
+                   0.5, 1.0, 2.5, 5.0, 10.0, 30.0]
+# worker spawn spans 50 ms (zygote fork) .. minutes (cold Popen + imports)
+_SPAWN_BOUNDS = [0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+                 60.0, 180.0]
+
+# ---------------------------------------------------------------------------
+# Declarations (one per family; zero-point metrics emit nothing, so
+# declaring everything in every process is free until a layer records)
+# ---------------------------------------------------------------------------
+
+# -- scheduler --------------------------------------------------------------
+SCHEDULE_LATENCY = Histogram(
+    "ray_tpu_scheduler_schedule_latency_seconds",
+    "Lease enqueue to worker grant, per granted lease",
+    boundaries=_LATENCY_BOUNDS, tag_keys=())
+PENDING_TASKS = Gauge(
+    "ray_tpu_scheduler_pending_tasks",
+    "Lease requests queued on this raylet, by resource shape",
+    tag_keys=("shape",))
+SPILLBACKS = Counter(
+    "ray_tpu_scheduler_spillbacks_total",
+    "Lease requests redirected to another node")
+
+# -- raylet -----------------------------------------------------------------
+WORKER_SPAWN_LATENCY = Histogram(
+    "ray_tpu_raylet_worker_spawn_seconds",
+    "Worker process spawn to registration",
+    boundaries=_SPAWN_BOUNDS, tag_keys=("method",))
+WORKER_SPAWNS = Counter(
+    "ray_tpu_raylet_worker_spawns_total",
+    "Worker spawns by method (zygote fork vs full Popen)",
+    tag_keys=("method",))
+WORKER_SPAWN_TIMEOUTS = Counter(
+    "ray_tpu_raylet_worker_spawn_timeout_total",
+    "Spawned workers killed for never registering within the deadline")
+ZYGOTE_FALLBACKS = Counter(
+    "ray_tpu_raylet_zygote_fallback_total",
+    "Zygote spawn attempts that fell back to the Popen path")
+WORKERS = Gauge(
+    "ray_tpu_raylet_workers",
+    "Worker pool population by state",
+    tag_keys=("state",))
+DISPATCH_SECONDS = Histogram(
+    "ray_tpu_raylet_dispatch_seconds",
+    "One dispatch-loop pass (queue scan + grant matching); sustained high "
+    "values mean the loop lags lease traffic",
+    boundaries=_LATENCY_BOUNDS, tag_keys=())
+
+# -- gcs --------------------------------------------------------------------
+GCS_RPC_LATENCY = Histogram(
+    "ray_tpu_gcs_rpc_latency_seconds",
+    "GCS handler execution time per RPC method",
+    boundaries=_LATENCY_BOUNDS, tag_keys=("method",))
+GCS_SINK_SIZE = Gauge(
+    "ray_tpu_gcs_sink_size",
+    "GCS observability sink populations (task events, metric reporters, "
+    "cluster events)",
+    tag_keys=("sink",))
+
+# -- object store -----------------------------------------------------------
+STORE_STORED_BYTES = Counter(
+    "ray_tpu_object_store_stored_bytes_total",
+    "Bytes admitted into the plasma store (creates, incl. transfer receives)")
+STORE_SPILLED_BYTES = Counter(
+    "ray_tpu_object_store_spilled_bytes_total",
+    "Bytes spilled to external storage")
+STORE_RESTORED_BYTES = Counter(
+    "ray_tpu_object_store_restored_bytes_total",
+    "Bytes restored from spilled copies")
+STORE_USED_BYTES = Gauge(
+    "ray_tpu_object_store_used_bytes",
+    "Plasma bytes resident per node",
+    tag_keys=("node",))
+STORE_OBJECTS = Gauge(
+    "ray_tpu_object_store_objects",
+    "Objects resident per node",
+    tag_keys=("node",))
+
+# -- task (worker) ----------------------------------------------------------
+TASK_SUBMIT_TO_START = Histogram(
+    "ray_tpu_task_submit_to_start_seconds",
+    "Owner-side submit to lease-granted (scheduling + spillback latency)",
+    boundaries=_LATENCY_BOUNDS, tag_keys=())
+TASK_EXECUTION = Histogram(
+    "ray_tpu_task_execution_seconds",
+    "User-function wall time on the executing worker",
+    boundaries=_LATENCY_BOUNDS, tag_keys=("kind",))
+TASK_SERIALIZED_BYTES = Counter(
+    "ray_tpu_task_serialized_bytes_total",
+    "Inline-serialized task payload bytes by direction",
+    tag_keys=("direction",))
+
+# -- collective -------------------------------------------------------------
+COLLECTIVE_LATENCY = Histogram(
+    "ray_tpu_collective_op_seconds",
+    "Collective op wall time",
+    boundaries=_LATENCY_BOUNDS,
+    tag_keys=("op", "backend", "world_size", "dtype"))
+COLLECTIVE_BYTES = Counter(
+    "ray_tpu_collective_bytes_total",
+    "Per-rank payload bytes moved through collectives",
+    tag_keys=("op", "backend", "world_size", "dtype"))
+COLLECTIVE_BUS_BW = Gauge(
+    "ray_tpu_collective_bus_bandwidth_gbps",
+    "Derived bus bandwidth of the most recent op (NCCL-tests busbw "
+    "convention: allreduce scales payload by 2(n-1)/n)",
+    tag_keys=("op", "backend", "world_size", "dtype"))
+
+# -- tpu --------------------------------------------------------------------
+TPU_CHIPS = Gauge(
+    "ray_tpu_tpu_chips",
+    "TPU chips per node by claim state",
+    tag_keys=("node", "state"))
+TPU_PROCESS_CHIPS = Gauge(
+    "ray_tpu_tpu_process_chips",
+    "TPU chips bound to this worker process via visible-chip carving")
+
+# -- serve ------------------------------------------------------------------
+SERVE_REQUEST_LATENCY = Histogram(
+    "ray_tpu_serve_request_latency_seconds",
+    "Replica-side request handling latency",
+    boundaries=_LATENCY_BOUNDS, tag_keys=("app", "deployment"))
+SERVE_REQUESTS = Counter(
+    "ray_tpu_serve_replica_requests_total",
+    "Requests handled by replicas (rate() = per-deployment QPS)",
+    tag_keys=("app", "deployment"))
+
+# -- data -------------------------------------------------------------------
+DATA_ROWS = Counter(
+    "ray_tpu_data_rows_total",
+    "Rows emitted by streaming-executor operators (rate() = rows/s)",
+    tag_keys=("operator",))
+DATA_BACKPRESSURE = Counter(
+    "ray_tpu_data_backpressure_total",
+    "Dispatches deferred by the per-operator memory budget",
+    tag_keys=("operator",))
+
+FAMILIES = (
+    SCHEDULE_LATENCY, PENDING_TASKS, SPILLBACKS,
+    WORKER_SPAWN_LATENCY, WORKER_SPAWNS, WORKER_SPAWN_TIMEOUTS,
+    ZYGOTE_FALLBACKS, WORKERS, DISPATCH_SECONDS,
+    GCS_RPC_LATENCY, GCS_SINK_SIZE,
+    STORE_STORED_BYTES, STORE_SPILLED_BYTES, STORE_RESTORED_BYTES,
+    STORE_USED_BYTES, STORE_OBJECTS,
+    TASK_SUBMIT_TO_START, TASK_EXECUTION, TASK_SERIALIZED_BYTES,
+    COLLECTIVE_LATENCY, COLLECTIVE_BYTES, COLLECTIVE_BUS_BW,
+    TPU_CHIPS, TPU_PROCESS_CHIPS,
+    SERVE_REQUEST_LATENCY, SERVE_REQUESTS,
+    DATA_ROWS, DATA_BACKPRESSURE,
+)
+
+# ---------------------------------------------------------------------------
+# Bound fast paths for untagged hot-loop metrics
+# ---------------------------------------------------------------------------
+
+_schedule_latency = SCHEDULE_LATENCY.with_tags()
+_dispatch_seconds = DISPATCH_SECONDS.with_tags()
+_spillbacks = SPILLBACKS.with_tags()
+_submit_to_start = TASK_SUBMIT_TO_START.with_tags()
+_stored_bytes = STORE_STORED_BYTES.with_tags()
+_spilled_bytes = STORE_SPILLED_BYTES.with_tags()
+_restored_bytes = STORE_RESTORED_BYTES.with_tags()
+_spawn_timeouts = WORKER_SPAWN_TIMEOUTS.with_tags()
+_zygote_fallbacks = ZYGOTE_FALLBACKS.with_tags()
+
+# dynamic-tag recorders are bound once per tag-set and cached; the key
+# spaces are small (rpc method names, op × world-size, deployment names)
+_BOUND_CACHE: Dict[Tuple, object] = {}
+_BOUND_LOCK = threading.Lock()
+_BOUND_CACHE_MAX = 4096  # runaway-cardinality backstop
+
+
+def _bound(metric, **tags):
+    key = (metric._name, tuple(sorted(tags.items())))
+    b = _BOUND_CACHE.get(key)
+    if b is None:
+        with _BOUND_LOCK:
+            b = _BOUND_CACHE.get(key)
+            if b is None:
+                if len(_BOUND_CACHE) >= _BOUND_CACHE_MAX:
+                    _BOUND_CACHE.clear()
+                b = _BOUND_CACHE[key] = metric.with_tags(tags)
+    return b
+
+
+# ---------------------------------------------------------------------------
+# Recording helpers (what the instrumented layers call)
+# ---------------------------------------------------------------------------
+
+
+def observe_schedule_latency(seconds: float) -> None:
+    _schedule_latency.observe(seconds)
+
+
+def observe_dispatch(seconds: float) -> None:
+    _dispatch_seconds.observe(seconds)
+
+
+def inc_spillback() -> None:
+    _spillbacks.inc()
+
+
+class TaggedGaugeSet:
+    """Gauge family whose live tag-set changes over time (pending resource
+    shapes, worker states): setting a new snapshot zeroes tags that vanished,
+    so stale series don't report their last value forever."""
+
+    def __init__(self, gauge: Gauge, tag_key: str):
+        self._gauge = gauge
+        self._tag_key = tag_key
+        self._seen: set = set()
+
+    def set_all(self, values: Dict[str, float]) -> None:
+        for name in self._seen - set(values):
+            _bound(self._gauge, **{self._tag_key: name}).set(0.0)
+        for name, v in values.items():
+            _bound(self._gauge, **{self._tag_key: name}).set(v)
+        self._seen = set(values)
+
+
+def shape_str(resources: Dict[str, float]) -> str:
+    """Canonical resource-shape tag: 'CPU:1,TPU:4' (sorted, compact)."""
+    return ",".join(f"{k}:{v:g}" for k, v in sorted(resources.items())) or "none"
+
+
+def observe_spawn(method: str, seconds: float) -> None:
+    _bound(WORKER_SPAWN_LATENCY, method=method).observe(seconds)
+
+
+def inc_spawn(method: str) -> None:
+    _bound(WORKER_SPAWNS, method=method).inc()
+
+
+def inc_spawn_timeout() -> None:
+    _spawn_timeouts.inc()
+
+
+def inc_zygote_fallback() -> None:
+    _zygote_fallbacks.inc()
+
+
+def observe_gcs_rpc(method: str, seconds: float) -> None:
+    _bound(GCS_RPC_LATENCY, method=method).observe(seconds)
+
+
+def set_gcs_sink_sizes(task_events: int, reporters: int, events: int) -> None:
+    _bound(GCS_SINK_SIZE, sink="task_events").set(task_events)
+    _bound(GCS_SINK_SIZE, sink="metric_reporters").set(reporters)
+    _bound(GCS_SINK_SIZE, sink="cluster_events").set(events)
+
+
+def add_stored_bytes(n: int) -> None:
+    _stored_bytes.inc(n)
+
+
+def add_spilled_bytes(n: int) -> None:
+    _spilled_bytes.inc(n)
+
+
+def add_restored_bytes(n: int) -> None:
+    _restored_bytes.inc(n)
+
+
+def observe_submit_to_start(seconds: float) -> None:
+    _submit_to_start.observe(seconds)
+
+
+def observe_task_execution(seconds: float, kind: str = "task") -> None:
+    _bound(TASK_EXECUTION, kind=kind).observe(seconds)
+
+
+def add_serialized_bytes(direction: str, n: int) -> None:
+    if n > 0:
+        _bound(TASK_SERIALIZED_BYTES, direction=direction).inc(n)
+
+
+# busbw convention (NCCL-tests): factor × payload / time
+_BUSBW_FACTOR = {
+    "allreduce": lambda n: 2.0 * (n - 1) / n,
+    "reducescatter": lambda n: (n - 1) / n,
+    "allgather": lambda n: (n - 1) / n,
+    "reduce": lambda n: 1.0,
+    "broadcast": lambda n: 1.0,
+    "send": lambda n: 1.0,
+    "recv": lambda n: 1.0,
+}
+
+
+def record_collective(op: str, backend: str, world_size: int, nbytes: int,
+                      seconds: float, dtype: str = "") -> None:
+    """One collective op: payload bytes, latency, derived bus bandwidth."""
+    tags = {"op": op, "backend": backend, "world_size": str(world_size),
+            "dtype": dtype}
+    _bound(COLLECTIVE_LATENCY, **tags).observe(seconds)
+    if nbytes > 0:
+        _bound(COLLECTIVE_BYTES, **tags).inc(nbytes)
+        if seconds > 0 and world_size > 0:
+            factor = _BUSBW_FACTOR.get(op, lambda n: 1.0)(max(world_size, 1))
+            _bound(COLLECTIVE_BUS_BW, **tags).set(
+                factor * nbytes / seconds / 1e9)
+
+
+def set_tpu_chips(node: str, total: float, claimed: float) -> None:
+    _bound(TPU_CHIPS, node=node, state="total").set(total)
+    _bound(TPU_CHIPS, node=node, state="claimed").set(claimed)
+
+
+def add_data_rows(operator: str, n: int) -> None:
+    if n > 0:
+        _bound(DATA_ROWS, operator=operator).inc(n)
+
+
+def inc_data_backpressure(operator: str) -> None:
+    _bound(DATA_BACKPRESSURE, operator=operator).inc()
+
+
+# ---------------------------------------------------------------------------
+# Snapshots for bench integration
+# ---------------------------------------------------------------------------
+
+
+def collective_snapshot() -> dict:
+    """Summarize this process's collective metric points for bench.py's JSON
+    line.  Keys carry the FULL tag-set (op/backend/world_size/dtype) so two
+    series (e.g. float32 grads and bfloat16 params) never blend into one
+    internally-inconsistent entry: per key, total bytes, op count, mean
+    latency, and the last derived bus bandwidth."""
+    def _key(tags: Dict[str, str]) -> str:
+        return "{}/{}/ws{}/{}".format(
+            tags.get("op", "?"), tags.get("backend", "?"),
+            tags.get("world_size", "?"), tags.get("dtype") or "na")
+
+    out: Dict[str, dict] = {}
+    for p in COLLECTIVE_BYTES._snapshot():
+        d = out.setdefault(_key(p["tags"]), {})
+        d["bytes_total"] = d.get("bytes_total", 0.0) + p["value"]
+    for p in COLLECTIVE_BUS_BW._snapshot():
+        out.setdefault(_key(p["tags"]), {})["busbw_gbps"] = p["value"]
+    for p in COLLECTIVE_LATENCY._snapshot():
+        d = out.setdefault(_key(p["tags"]), {})
+        d["ops"] = d.get("ops", 0) + p["count"]
+        d["latency_sum_s"] = d.get("latency_sum_s", 0.0) + p["sum"]
+    for d in out.values():
+        if d.get("ops"):
+            d["mean_latency_s"] = d.pop("latency_sum_s", 0.0) / d["ops"]
+        else:
+            d.pop("latency_sum_s", None)
+    return out
+
+
+def maybe_push(min_interval_s: Optional[float] = None) -> bool:
+    """Piggyback flush (see util/metrics.maybe_push)."""
+    from ray_tpu._private.config import global_config
+    from ray_tpu.util import metrics
+
+    if min_interval_s is None:
+        min_interval_s = global_config().metrics_report_interval_s
+    return metrics.maybe_push(min_interval_s)
+
+
+__all__ = [n for n in dir() if not n.startswith("_")]

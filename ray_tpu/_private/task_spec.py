@@ -34,6 +34,9 @@ class TaskSpec:
     max_retries: int = 3
     retry_exceptions: bool = False
     attempt: int = 0
+    # owner-side submit time (monotonic, OWNER clock only): consumed by the
+    # owner when the lease is granted to derive submit→start latency
+    submit_ts: float = 0.0
     owner_addr: Optional[Tuple[str, int]] = None
     owner_worker_id: Optional[WorkerID] = None
     runtime_env: Optional[dict] = None

@@ -28,7 +28,7 @@ from collections import defaultdict, deque
 from ray_tpu._private.utils import DaemonExecutor
 from typing import Any, Dict, List, Optional, Set, Tuple
 
-from ray_tpu._private import serialization
+from ray_tpu._private import runtime_metrics, serialization
 from ray_tpu._private.accelerators import bind_visible_accelerators
 from ray_tpu._private.config import global_config
 from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
@@ -518,6 +518,9 @@ class CoreWorker:
                 self.reference_counter.drain_deferred()
             except Exception:  # noqa: BLE001
                 pass
+            # piggybacked metrics flush: runtime + user metrics recorded in
+            # this process reach the GCS aggregate without their own loop
+            runtime_metrics.maybe_push()
             with self._sub_lock:
                 channels = list(self._subscriptions)
             # bound the set: a 'dead' pubsub event can be missed (GCS restart,
@@ -564,6 +567,14 @@ class CoreWorker:
 
     def shutdown(self):
         self.shutting_down = True
+        try:  # final metrics flush: short-lived workers' points must land.
+            # Short timeout, no reconnect-retry — teardown must not stall
+            # behind a GCS that died first (FT tests kill it deliberately).
+            from ray_tpu.util import metrics as _metrics
+
+            _metrics.push_to_gcs(timeout=2, retry_deadline=0.0)
+        except Exception:  # noqa: BLE001
+            pass
         with self._sub_lock:
             self._subscriptions.clear()
         if self.log_to_driver:
@@ -1065,6 +1076,7 @@ class CoreWorker:
             owner_addr=self.address,
             owner_worker_id=self.worker_id,
             runtime_env=runtime_env,
+            submit_ts=time.monotonic(),
         )
         self.task_manager.add_pending(spec)
         self._pin_args(spec)
@@ -1115,6 +1127,7 @@ class CoreWorker:
         if isinstance(value, ObjectRef):
             return ("ref", (value.id, value.owner_addr))
         data = serialization.dumps_inline(value)
+        runtime_metrics.add_serialized_bytes("args", len(data))
         if len(data) > global_config().max_inline_object_size:
             ref = self.put(value)
             self.reference_counter.add_local_ref(ref)  # hold until task done
@@ -1168,6 +1181,11 @@ class CoreWorker:
             self._cancelled_tasks.discard(spec.task_id)
             raise TaskCancelledError(f"task {spec.name} was cancelled")
         lease, raylet_cli = self._acquire_lease(spec)
+        if spec.submit_ts and spec.attempt == 0:
+            # first attempt only: retries would fold prior execution time and
+            # backoff sleeps into what is documented as scheduling latency
+            runtime_metrics.observe_submit_to_start(
+                time.monotonic() - spec.submit_ts)
         worker_addr = tuple(lease["worker_addr"])
         self._task_exec_addr[spec.task_id] = worker_addr
         try:
@@ -1415,7 +1433,10 @@ class CoreWorker:
             try:
                 args = [self._unpack_arg(a) for a in spec.args]
                 kwargs = {k: self._unpack_arg((kind, p)) for k, kind, p in spec.kwargs}
+                exec_t0 = time.perf_counter()
                 result = fn(*args, **kwargs)
+                runtime_metrics.observe_task_execution(
+                    time.perf_counter() - exec_t0, kind="task")
                 # return packing stays cancellable: a STREAMING task's user
                 # code runs inside _stream_returns' iteration, not fn()
                 returns = self._pack_returns(spec, result)
@@ -1472,6 +1493,7 @@ class CoreWorker:
             except BaseException:  # noqa: BLE001 (incl. late-delivered cancel KI)
                 pass
             self.flush_task_events()
+            runtime_metrics.maybe_push()
 
     def _load_function(self, spec: TaskSpec):
         if spec.function_digest in self._fn_cache:
@@ -1509,6 +1531,7 @@ class CoreWorker:
 
     def _pack_one_return(self, oid: ObjectID, value, spec: TaskSpec):
         data = serialization.dumps_inline(value)
+        runtime_metrics.add_serialized_bytes("returns", len(data))
         if len(data) <= global_config().max_inline_object_size:
             return (oid, "inline", data)
         from ray_tpu._private.object_store import plasma_create_write_seal
@@ -1772,6 +1795,7 @@ class CoreWorker:
             self._record_exec_event(spec)
             args = [self._unpack_arg(a) for a in spec.args]
             kwargs = {k: self._unpack_arg((kind, p)) for k, kind, p in spec.kwargs}
+            exec_t0 = time.perf_counter()
             if spec.actor_method == "__ray_tpu_call__":
                 # Hidden protocol: run fn(instance, *args, **kwargs) on the
                 # actor (used by collectives/train to inject gang setup).
@@ -1780,6 +1804,8 @@ class CoreWorker:
             else:
                 method = getattr(self._actor_instance, spec.actor_method)
                 result = method(*args, **kwargs)
+            runtime_metrics.observe_task_execution(
+                time.perf_counter() - exec_t0, kind="actor")
             if hasattr(result, "__await__"):
                 import asyncio
 
@@ -1813,6 +1839,7 @@ class CoreWorker:
                 os._exit(0)
         finally:
             self.flush_task_events()
+            runtime_metrics.maybe_push()
 
     def HandleKillActor(self, req):
         logger.info("actor %s killed: %s", req.get("actor_id"), req.get("reason"))

@@ -23,8 +23,12 @@ them with a SIGCHLD handler; the raylet's liveness checks
 (``_PidHandle.poll`` → ``kill(pid, 0)``) then see death promptly.
 
 Protocol (unix socket, one JSON line per connection):
-  request:  {"env": {...}, "log_file": "/path"}  |  {"shutdown": true}
+  request:  {"env": {...}, "log_file": "/path", "deadline": unix_ts}
+            |  {"shutdown": true}
   reply:    {"pid": 1234}  |  {"error": "..."}
+``deadline`` (optional) is the wall-clock instant the CLIENT stops
+waiting; the zygote drops requests already past it instead of forking a
+worker nobody tracks (the client has Popen-fallen-back by then).
 """
 
 from __future__ import annotations
@@ -34,6 +38,7 @@ import os
 import signal
 import socket
 import sys
+import time
 
 
 def _recv_line(conn: socket.socket) -> bytes:
@@ -49,13 +54,17 @@ def _recv_line(conn: socket.socket) -> bytes:
     return buf
 
 
-def _reply(conn: socket.socket, obj) -> None:
+def _reply(conn: socket.socket, obj) -> bool:
     """Best-effort reply: a client that already hung up (spawn timeout)
-    must never take the zygote loop down with BrokenPipeError."""
+    must never take the zygote loop down with BrokenPipeError.  Returns
+    whether the reply was delivered — the fork path kills the child when it
+    wasn't, since an unannounced pid would become an untracked duplicate of
+    the client's Popen fallback."""
     try:
         conn.sendall(json.dumps(obj).encode() + b"\n")
+        return True
     except OSError:
-        pass
+        return False
     finally:
         try:
             conn.close()
@@ -119,6 +128,14 @@ def serve(sock_path: str) -> None:
         if req.get("shutdown"):
             conn.close()
             break
+        # stale-request guard: the client stops waiting at its (short)
+        # socket deadline and Popen-falls-back; forking anyway would add an
+        # untracked duplicate worker.  Same-host wall clock, so the
+        # comparison is skew-free.
+        deadline = req.get("deadline")
+        if deadline is not None and time.time() > deadline:
+            conn.close()
+            continue
         try:
             pid = os.fork()
         except OSError as e:
@@ -145,7 +162,14 @@ def serve(sock_path: str) -> None:
 
                 traceback.print_exc()
                 os._exit(1)
-        _reply(conn, {"pid": pid})
+        if not _reply(conn, {"pid": pid}):
+            # the raylet gave up on this request (short spawn timeout) and
+            # already took the Popen path: reap the orphan before it can
+            # register as an untracked extra worker
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except OSError:
+                pass
     srv.close()
     try:
         os.unlink(sock_path)
@@ -226,23 +250,59 @@ class ZygoteClient:
 
     def spawn(self, env: dict, log_file: str):
         """Fork one worker; returns its pid, or None to use the fallback
-        (zygote still warming, dead, or wedged)."""
+        (zygote still warming, dead, or wedged).
+
+        The socket budget is SHORT (zygote_spawn_timeout_s, default 2 s):
+        this runs under the raylet's dispatch lock, so a wedged-but-alive
+        zygote must cost at most one short timeout before the Popen path
+        takes over — never the 15 s a generous timeout allowed.  Fallbacks
+        are counted (ray_tpu_raylet_zygote_fallback_total) so a sick zygote
+        is visible instead of silently degrading every spawn to ~2.3 s."""
         with self._lock:
             proc = self._proc
         if proc is None or proc.poll() is not None:
             self.start_async()  # warm it for next time
             return None
+        conn = None
         try:
+            from ray_tpu._private.config import global_config
+
+            budget = max(global_config().zygote_spawn_timeout_s, 0.1)
             conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-            conn.settimeout(15.0)
+            conn.settimeout(budget)
             conn.connect(self._sock_path)
+            # deadline rides the request: once we stop waiting, the zygote
+            # must NOT fork a duplicate of the Popen fallback (and a fork
+            # whose reply can't be delivered is killed zygote-side)
             conn.sendall(json.dumps(
-                {"env": env, "log_file": log_file}).encode() + b"\n")
+                {"env": env, "log_file": log_file,
+                 "deadline": time.time() + budget}).encode() + b"\n")
             reply = json.loads(_recv_line(conn) or b"{}")
-            conn.close()
-            return reply.get("pid")
+            pid = reply.get("pid")
+            if pid is None:
+                self._note_fallback()
+            return pid
         except Exception:  # noqa: BLE001
+            self._note_fallback()
             return None
+        finally:
+            # deterministic close: the zygote detects an abandoned request
+            # by its reply send failing, so the socket must die NOW, not at
+            # a later GC
+            if conn is not None:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+    @staticmethod
+    def _note_fallback():
+        try:
+            from ray_tpu._private import runtime_metrics
+
+            runtime_metrics.inc_zygote_fallback()
+        except Exception:  # noqa: BLE001
+            pass
 
     def shutdown(self):
         with self._lock:

@@ -8,13 +8,14 @@ contract here: ``generate_configs(out_dir, metrics_url)`` materializes
     out_dir/prometheus.yml
     out_dir/grafana/provisioning/datasources/ray_tpu.yml
     out_dir/grafana/provisioning/dashboards/ray_tpu.yml
-    out_dir/grafana/dashboards/{cluster,serve,events}.json
+    out_dir/grafana/dashboards/{cluster,serve,events,runtime}.json
 
 against the core metric names exported by the dashboard head's /metrics
 (see head.py core_metrics_text): ray_tpu_nodes, ray_tpu_actors,
 ray_tpu_resource_total/available, ray_tpu_tasks, ray_tpu_serve_replicas,
-ray_tpu_serve_requests_total, ray_tpu_events_total, plus any user metrics
-from ray_tpu.util.metrics.
+ray_tpu_serve_requests_total, ray_tpu_events_total, plus the built-in
+runtime families from _private/runtime_metrics.py (runtime.json panels)
+and any user metrics from ray_tpu.util.metrics.
 """
 
 from __future__ import annotations
@@ -81,6 +82,50 @@ def events_dashboard() -> dict:
     ])
 
 
+def runtime_dashboard() -> dict:
+    """Built-in runtime metric families (_private/runtime_metrics.py):
+    scheduler, worker pool, object store, task, collective, GCS, data."""
+    return _dashboard("ray-tpu-runtime", "ray_tpu runtime", [
+        _panel(1, "Scheduling latency p50/p99",
+               ['histogram_quantile(0.5, rate(ray_tpu_scheduler_schedule_latency_seconds_bucket[5m]))',
+                'histogram_quantile(0.99, rate(ray_tpu_scheduler_schedule_latency_seconds_bucket[5m]))'],
+               0, 0, unit="s"),
+        _panel(2, "Pending tasks by resource shape",
+               ["ray_tpu_scheduler_pending_tasks"], 12, 0),
+        _panel(3, "Worker pool by state", ["ray_tpu_raylet_workers"], 0, 8),
+        _panel(4, "Worker spawn p50 by method",
+               ['histogram_quantile(0.5, rate(ray_tpu_raylet_worker_spawn_seconds_bucket[5m]))',
+                'rate(ray_tpu_raylet_zygote_fallback_total[5m])',
+                'rate(ray_tpu_raylet_worker_spawn_timeout_total[5m])'],
+               12, 8, unit="s"),
+        _panel(5, "Object store bytes",
+               ["ray_tpu_object_store_used_bytes",
+                "rate(ray_tpu_object_store_spilled_bytes_total[5m])",
+                "rate(ray_tpu_object_store_restored_bytes_total[5m])"],
+               0, 16, unit="bytes"),
+        _panel(6, "Task execution p50/p99",
+               ['histogram_quantile(0.5, rate(ray_tpu_task_execution_seconds_bucket[5m]))',
+                'histogram_quantile(0.99, rate(ray_tpu_task_execution_seconds_bucket[5m]))'],
+               12, 16, unit="s"),
+        _panel(7, "Collective bus bandwidth",
+               ["ray_tpu_collective_bus_bandwidth_gbps"], 0, 24, unit="GBs"),
+        _panel(8, "Collective bytes rate",
+               ["rate(ray_tpu_collective_bytes_total[5m])"], 12, 24,
+               unit="Bps"),
+        _panel(9, "GCS RPC latency p99 by method",
+               ['histogram_quantile(0.99, rate(ray_tpu_gcs_rpc_latency_seconds_bucket[5m]))'],
+               0, 32, unit="s"),
+        _panel(10, "Serve request latency p50/p99",
+               ['histogram_quantile(0.5, rate(ray_tpu_serve_request_latency_seconds_bucket[5m]))',
+                'histogram_quantile(0.99, rate(ray_tpu_serve_request_latency_seconds_bucket[5m]))'],
+               12, 32, unit="s"),
+        _panel(11, "Data rows/s",
+               ["rate(ray_tpu_data_rows_total[5m])"], 0, 40, unit="rowsps"),
+        _panel(12, "TPU chips (total vs claimed)",
+               ["ray_tpu_tpu_chips"], 12, 40),
+    ])
+
+
 def generate_configs(out_dir: str, metrics_url: str) -> Dict[str, str]:
     """Write all configs; returns {name: path}."""
     host_port = metrics_url.split("//", 1)[-1].rstrip("/")
@@ -132,7 +177,8 @@ def generate_configs(out_dir: str, metrics_url: str) -> Dict[str, str]:
 
     for name, dash in (("cluster", cluster_dashboard()),
                        ("serve", serve_dashboard()),
-                       ("events", events_dashboard())):
+                       ("events", events_dashboard()),
+                       ("runtime", runtime_dashboard())):
         p = os.path.join(dash_dir, f"{name}.json")
         with open(p, "w") as f:
             json.dump(dash, f, indent=2)

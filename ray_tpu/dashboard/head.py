@@ -159,6 +159,12 @@ class DashboardHead:
             return ray_tpu.timeline()
         if path == "/api/node_stats":
             return state.node_stats()
+        if path == "/api/node_metrics":
+            # per-node Prometheus exposition (each raylet's metrics agent);
+            # /metrics stays the cluster-wide aggregate.  ?node_id=<hex>
+            # narrows to one node.
+            nid = (query or {}).get("node_id", [None])[0]
+            return state.node_metrics(nid)
         if path == "/api/stacks":
             return state.dump_stacks()
         if path == "/api/native_stacks":

@@ -41,6 +41,8 @@ import time
 from collections import deque
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
+from ray_tpu._private import runtime_metrics
+
 logger = logging.getLogger(__name__)
 
 _END = ("__end__", None)
@@ -152,6 +154,7 @@ class PhysicalOperator:
     def _emit(self, bundle: RefBundle) -> None:
         self.outputs.append(bundle)
         self.output_bytes += bundle.nbytes
+        runtime_metrics.add_data_rows(self.name, bundle.num_rows)
 
     # -- lifecycle
     def outstanding(self) -> int:
@@ -573,6 +576,7 @@ class StreamingExecutor:
         under = self._downstream_bytes(idx) < self.ctx.op_memory_budget
         if under:
             return True
+        runtime_metrics.inc_data_backpressure(self.ops[idx].name)
         # Liveness rule: with preserve_order, the reorder hold can fill the
         # budget while waiting for one specific seq — grant a single task to
         # the idle operator that holds exactly that seq (inputs are a

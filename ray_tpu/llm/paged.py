@@ -627,9 +627,20 @@ class PagedJaxLLMEngine:
                 if self._dirty:
                     self._drain_locked()
                     self._refresh_mirrors_locked()
-                    # drain may have finished requests; rebuild
-                    active = [s for s in active
-                              if self._slot_req[s] is not None]
+                    # the drain invalidated the ensure pass above: it
+                    # advances lengths AND _trim_locked(margin=0) releases
+                    # the margin blocks just reserved, so dispatching with
+                    # the old `active` would scatter KV into sink block 0
+                    # on any append crossing a block boundary (ADVICE r5
+                    # high).  Re-run coverage from scratch — _inflight is
+                    # now None, so one in-flight chunk's margin suffices.
+                    active = self._ensure_decode_blocks_locked(chunk + 1)
+                    if self._dirty:
+                        # the re-run preempted someone: mirrors are stale
+                        # again (no drain needed — nothing is in flight)
+                        self._refresh_mirrors_locked()
+                        active = [s for s in active
+                                  if self._slot_req[s] is not None]
             if active:
                 w = _bucket_pow2(max(len(self._slot_req[s].blocks)
                                      for s in active))

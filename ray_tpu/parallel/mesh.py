@@ -88,9 +88,11 @@ class MeshSpec:
         try:
             # Auto axis types: shardings flow via with_sharding_constraint +
             # XLA propagation (jax >= 0.8 defaults new meshes to Explicit).
+            # Older jax lacks AxisType (AttributeError) or the axis_types
+            # kwarg (TypeError) — both take the plain-Mesh path.
             auto = (jax.sharding.AxisType.Auto,) * len(MESH_AXES)
             return jax.make_mesh(shape, MESH_AXES, devices=devices, axis_types=auto)
-        except TypeError:
+        except (TypeError, AttributeError):
             import numpy as np
 
             return Mesh(np.asarray(devices).reshape(shape), MESH_AXES)

@@ -43,8 +43,22 @@ class ServeReplica:
         self._ongoing = 0
         self._total = 0
         self._lock = threading.Lock()
+        # built-in per-deployment request metrics (latency histogram +
+        # monotonic request counter; rate() of the counter is QPS) — bound
+        # once here, recorded per request at constant cost
+        from ray_tpu._private import runtime_metrics
+
+        self._latency_metric = runtime_metrics.SERVE_REQUEST_LATENCY.with_tags(
+            {"app": app_name, "deployment": deployment_name})
+        self._requests_metric = runtime_metrics.SERVE_REQUESTS.with_tags(
+            {"app": app_name, "deployment": deployment_name})
+
+    def _record_request(self, t0: float):
+        self._latency_metric.observe(time.perf_counter() - t0)
+        self._requests_metric.inc()
 
     def handle_request(self, method_name: str, args: tuple, kwargs: dict):
+        t0 = time.perf_counter()
         with self._lock:
             self._ongoing += 1
             self._total += 1
@@ -65,12 +79,14 @@ class ServeReplica:
         finally:
             with self._lock:
                 self._ongoing -= 1
+            self._record_request(t0)
 
     def handle_request_streaming(self, method_name: str, args: tuple,
                                  kwargs: dict):
         """Generator twin of handle_request (reference: serve streaming
         responses): pair with num_returns='streaming' so callers iterate an
         ObjectRefGenerator.  A non-generator result streams as one item."""
+        t0 = time.perf_counter()
         with self._lock:
             self._ongoing += 1
             self._total += 1
@@ -87,6 +103,7 @@ class ServeReplica:
         finally:
             with self._lock:
                 self._ongoing -= 1
+            self._record_request(t0)
 
     # control-plane methods ride the "system" concurrency group: a replica
     # whose user methods are all blocked must still answer router probes and

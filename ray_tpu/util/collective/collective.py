@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Any, Dict, List, Optional
 
 from ray_tpu.util.collective.types import Backend, ReduceOp
@@ -128,34 +129,83 @@ def _require_group(group_name: str):
     return g
 
 
+# -- per-op built-in telemetry (reference direction: PAPERS.md "Collective
+# Communication for 100k+ GPUs" — straggler hunting needs per-op bytes /
+# latency / bandwidth).  The payload size comes from the tensor's own
+# ``nbytes`` (jax/numpy/torch all expose it) — never np.asarray(), which
+# would COPY device arrays to host on the hot path.
+
+
+def _tensor_meta(tensor):
+    nbytes = getattr(tensor, "nbytes", None)
+    if nbytes is None:
+        try:
+            import numpy as _np
+
+            nbytes = _np.asarray(tensor).nbytes  # small host values only
+        except Exception:  # noqa: BLE001
+            nbytes = 0
+    return int(nbytes or 0), str(getattr(tensor, "dtype", ""))
+
+
+def _record_op(op: str, group, tensor, seconds: float):
+    try:
+        from ray_tpu._private import runtime_metrics
+
+        nbytes, dtype = _tensor_meta(tensor) if tensor is not None else (0, "")
+        backend = type(group).__name__.replace("Group", "").lower()
+        runtime_metrics.record_collective(
+            op, backend, group.world_size, nbytes, seconds, dtype)
+    except Exception:  # noqa: BLE001 — telemetry must never fail a
+        pass  # completed collective (the result is already computed)
+
+
+def _timed(op: str, group, tensor, fn):
+    t0 = time.perf_counter()
+    out = fn()
+    _record_op(op, group, tensor, time.perf_counter() - t0)
+    return out
+
+
 def allreduce(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
-    return _require_group(group_name).allreduce(tensor, op)
+    g = _require_group(group_name)
+    return _timed("allreduce", g, tensor, lambda: g.allreduce(tensor, op))
 
 
 def reduce(tensor, dst_rank: int = 0, group_name: str = "default",
            op: ReduceOp = ReduceOp.SUM):
-    return _require_group(group_name).reduce(tensor, dst_rank, op)
+    g = _require_group(group_name)
+    return _timed("reduce", g, tensor, lambda: g.reduce(tensor, dst_rank, op))
 
 
 def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
-    return _require_group(group_name).broadcast(tensor, src_rank)
+    g = _require_group(group_name)
+    return _timed("broadcast", g, tensor, lambda: g.broadcast(tensor, src_rank))
 
 
 def allgather(tensor, group_name: str = "default"):
-    return _require_group(group_name).allgather(tensor)
+    g = _require_group(group_name)
+    return _timed("allgather", g, tensor, lambda: g.allgather(tensor))
 
 
 def reducescatter(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
-    return _require_group(group_name).reducescatter(tensor, op)
+    g = _require_group(group_name)
+    return _timed("reducescatter", g, tensor, lambda: g.reducescatter(tensor, op))
 
 
 def barrier(group_name: str = "default"):
-    _require_group(group_name).barrier()
+    g = _require_group(group_name)
+    _timed("barrier", g, None, g.barrier)
 
 
 def send(tensor, dst_rank: int, group_name: str = "default"):
-    _require_group(group_name).send(tensor, dst_rank)
+    g = _require_group(group_name)
+    _timed("send", g, tensor, lambda: g.send(tensor, dst_rank))
 
 
 def recv(src_rank: int, group_name: str = "default"):
-    return _require_group(group_name).recv(src_rank)
+    g = _require_group(group_name)
+    t0 = time.perf_counter()
+    out = g.recv(src_rank)
+    _record_op("recv", g, out, time.perf_counter() - t0)
+    return out

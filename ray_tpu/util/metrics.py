@@ -13,6 +13,9 @@ reference's per-node MetricsAgent serves to Prometheus.
 
 from __future__ import annotations
 
+import bisect
+import os
+import socket as _socket
 import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -102,6 +105,10 @@ class Counter(Metric):
         with self._lock:
             self._points[key] = self._points.get(key, 0.0) + value
 
+    def with_tags(self, tags: Optional[Dict[str, str]] = None) -> "BoundCounter":
+        self._check_tags(tags)
+        return BoundCounter(self, self._merged(tags))
+
 
 class Gauge(Metric):
     """Last-value-wins metric (reference: util/metrics.py Gauge)."""
@@ -112,6 +119,10 @@ class Gauge(Metric):
         self._check_tags(tags)
         with self._lock:
             self._points[self._merged(tags)] = float(value)
+
+    def with_tags(self, tags: Optional[Dict[str, str]] = None) -> "BoundGauge":
+        self._check_tags(tags)
+        return BoundGauge(self, self._merged(tags))
 
 
 class Histogram(Metric):
@@ -160,6 +171,75 @@ class Histogram(Metric):
                 for k, st in self._hist.items()
             ]
 
+    def with_tags(self, tags: Optional[Dict[str, str]] = None) -> "BoundHistogram":
+        self._check_tags(tags)
+        return BoundHistogram(self, self._merged(tags))
+
+
+# ---------------------------------------------------------------------------
+# Bound recorders — the constant-cost hot path for built-in runtime metrics
+# (reference: the C++ stats fast path, src/ray/stats/metric.h Record()).
+# The tag-set is resolved ONCE at bind time; each record is a registry
+# check, a lock, and one dict/list update, so instrumenting a dispatch loop
+# costs O(100ns)/point.  The registry check (one unlocked dict read) keeps
+# a long-lived recorder valid across ANY re-declaration of its metric —
+# including a Histogram re-declared with different boundaries, which swaps
+# in a fresh state dict the old instance no longer feeds.
+# ---------------------------------------------------------------------------
+
+
+class BoundCounter:
+    __slots__ = ("_m", "_key")
+
+    def __init__(self, metric: Counter, key):
+        self._m, self._key = metric, key
+
+    def inc(self, value: float = 1.0):
+        m = self._m
+        cur = _REGISTRY.get(m._name)
+        if cur is not m and type(cur) is type(m):
+            self._m = m = cur
+        with m._lock:
+            m._points[self._key] = m._points.get(self._key, 0.0) + value
+
+
+class BoundGauge:
+    __slots__ = ("_m", "_key")
+
+    def __init__(self, metric: Gauge, key):
+        self._m, self._key = metric, key
+
+    def set(self, value: float):
+        m = self._m
+        cur = _REGISTRY.get(m._name)
+        if cur is not m and type(cur) is type(m):
+            self._m = m = cur
+        with m._lock:
+            m._points[self._key] = float(value)
+
+
+class BoundHistogram:
+    __slots__ = ("_m", "_key", "_bounds")
+
+    def __init__(self, metric: Histogram, key):
+        self._m, self._key = metric, key
+        self._bounds = metric.boundaries
+
+    def observe(self, value: float):
+        m = self._m
+        cur = _REGISTRY.get(m._name)
+        if cur is not m and type(cur) is type(m):
+            self._m = m = cur
+            self._bounds = cur.boundaries
+        i = bisect.bisect_left(self._bounds, value)
+        with m._lock:
+            st = m._hist.get(self._key)
+            if st is None:
+                st = m._hist[self._key] = [[0] * (len(self._bounds) + 1), 0.0, 0]
+            st[0][i] += 1
+            st[1] += value
+            st[2] += 1
+
 
 def collect_local() -> List[dict]:
     """Snapshot every metric registered in this process."""
@@ -171,34 +251,87 @@ def collect_local() -> List[dict]:
     return out
 
 
-def push_to_gcs():
-    """Push this process's metric snapshot to the GCS aggregate."""
+_REPORTER_ID: Optional[str] = None
+# GCS channel for processes that host runtime components but no CoreWorker
+# (a head-node raylet/GCS process): anything with .call(method, payload,
+# timeout=). First registration wins; a worker, when present, is preferred.
+_FALLBACK_GCS = None
+_PUSH_LOCK = threading.Lock()
+_LAST_PUSH = 0.0
+
+
+def reporter_id() -> str:
+    """Stable per-PROCESS reporter identity.  Every pusher in one process
+    (driver worker, in-process raylets, in-process GCS) reports under the
+    SAME name, so the GCS stores one latest full-registry snapshot per
+    process and counters are never double-aggregated."""
+    global _REPORTER_ID
+    if _REPORTER_ID is None:
+        _REPORTER_ID = f"{_socket.gethostname()}:{os.getpid()}"
+    return _REPORTER_ID
+
+
+def set_fallback_gcs(client) -> None:
+    """Register a GCS channel for metric pushes from worker-less processes.
+    No-op if one is already registered."""
+    global _FALLBACK_GCS
+    if _FALLBACK_GCS is None:
+        _FALLBACK_GCS = client
+
+
+def _gcs_channel():
     from ray_tpu._private.worker import get_global_worker
 
     w = get_global_worker()
-    if w is None:
+    if w is not None:
+        return w.gcs
+    return _FALLBACK_GCS
+
+
+def push_to_gcs(timeout: float = 10, **call_kwargs):
+    """Push this process's metric snapshot to the GCS aggregate.
+    ``call_kwargs`` pass through to the channel's .call (e.g.
+    ``retry_deadline=0.0`` for a no-reconnect teardown flush)."""
+    gcs = _gcs_channel()
+    if gcs is None:
         return
     points = collect_local()
     if points:
         # call() (not notify) so the push is ordered before any subsequent
         # CollectMetrics — collect_cluster() must see its own flush.
-        w.gcs.call(
+        gcs.call(
             "ReportMetrics",
-            {"reporter": f"{w.address[0]}:{w.address[1]}", "points": points,
-             "time": time.time()},
-            timeout=10,
+            {"reporter": reporter_id(), "points": points, "time": time.time()},
+            timeout=timeout, **call_kwargs,
         )
+        global _LAST_PUSH
+        _LAST_PUSH = time.monotonic()
+
+
+def maybe_push(min_interval_s: float = 2.0) -> bool:
+    """Throttled, never-raises push — the hook the runtime piggybacks on its
+    existing periodic loops (raylet report loop, worker resubscribe loop,
+    task-completion flush).  Returns True if a push went out."""
+    global _LAST_PUSH
+    now = time.monotonic()
+    with _PUSH_LOCK:
+        if now - _LAST_PUSH < min_interval_s:
+            return False
+        _LAST_PUSH = now  # claim the slot before the RPC (other threads skip)
+    try:
+        push_to_gcs()
+        return True
+    except Exception:  # noqa: BLE001 — metrics must never take a loop down
+        return False
 
 
 def collect_cluster() -> List[dict]:
     """Fetch the GCS-side cluster aggregate (all reporters, latest snapshot)."""
-    from ray_tpu._private.worker import get_global_worker
-
     push_to_gcs()
-    w = get_global_worker()
-    if w is None:
+    gcs = _gcs_channel()
+    if gcs is None:
         return collect_local()
-    return w.gcs.call("CollectMetrics", {}) or []
+    return gcs.call("CollectMetrics", {}) or []
 
 
 def _escape_label(v: str) -> str:

@@ -10,6 +10,7 @@ from ray_tpu.util.state.api import (
     jax_profile,
     dump_native_stacks,
     dump_stacks,
+    node_metrics,
     node_stats,
     list_actors,
     list_cluster_events,
@@ -26,6 +27,7 @@ from ray_tpu.util.state.api import (
 
 __all__ = [
     "StateApiClient",
+    "node_metrics",
     "node_stats",
     "dump_native_stacks",
     "dump_stacks",

@@ -152,14 +152,41 @@ class StateApiClient:
     def node_stats(self) -> List[dict]:
         """CPU/memory/load + per-worker rss for every alive node."""
         out = []
-        for node in self.list_nodes():
-            if node.get("state") == "DEAD":
-                continue
+        for node in self._alive_nodes():
             try:
                 stats = self._w.pool.get(tuple(node["address"])).call(
                     "AgentNodeStats", {}, timeout=10)
                 stats["node_id"] = node["node_id"]
                 out.append(stats)
+            except Exception:  # noqa: BLE001
+                continue
+        return out
+
+    def _alive_nodes(self, node_id=None):
+        """Alive nodes, optionally narrowed to one id (NodeID or hex str) —
+        the shared filter for every per-node agent endpoint."""
+        want = None
+        if node_id is not None:
+            want = node_id.hex() if hasattr(node_id, "hex") else str(node_id)
+        for node in self.list_nodes():
+            if node.get("state") == "DEAD":
+                continue
+            nid = node["node_id"]
+            nid_hex = nid.hex() if hasattr(nid, "hex") else str(nid)
+            if want is not None and nid_hex != want:
+                continue
+            yield node
+
+    def node_metrics(self, node_id=None) -> List[dict]:
+        """Per-node Prometheus exposition text from each raylet's metrics
+        agent endpoint (reference: the per-node MetricsAgent /metrics; the
+        head's /metrics is the cluster aggregate)."""
+        out = []
+        for node in self._alive_nodes(node_id):
+            try:
+                text = self._w.pool.get(tuple(node["address"])).call(
+                    "AgentMetrics", {}, timeout=10)
+                out.append({"node_id": node["node_id"], "metrics": text})
             except Exception:  # noqa: BLE001
                 continue
         return out
@@ -311,6 +338,10 @@ def summarize_actors():
 
 def node_stats():
     return _client().node_stats()
+
+
+def node_metrics(node_id=None):
+    return _client().node_metrics(node_id)
 
 
 def dump_stacks(node_id=None, pid=None):

@@ -115,6 +115,49 @@ def test_chunked_prefill_long_prompt(tiny_cfg, tiny_params):
     assert len(prompt) > paged.config.prefill_chunk
 
 
+def test_prefill_completes_while_decode_pipelines(tiny_cfg, tiny_params):
+    """Regression (ADVICE r5 high, paged.py step() _dirty path): request B's
+    final prefill chunk sets _dirty while request A has an IN-FLIGHT decode
+    chunk.  The drain that follows advances A's lengths and trims A's blocks
+    back to lengths+1 coverage — invalidating the margin the earlier ensure
+    pass reserved.  Without re-running _ensure_decode_blocks_locked after
+    the drain, A's next chunk dispatches with an under-sized table and any
+    append crossing a block boundary scatters KV into sink block 0: silent
+    KV loss, diverging tokens.  Greedy token parity with solo runs is the
+    oracle."""
+    def make():
+        # block_size == decode_chunk == 4: every decode chunk crosses a
+        # block boundary, so stale coverage cannot hide
+        return PagedJaxLLMEngine(
+            LLMConfig(model_config=tiny_cfg, max_batch_size=2,
+                      max_seq_len=128, block_size=4, prefill_chunk=8,
+                      decode_chunk=4), params=tiny_params)
+
+    pa = list(np.random.RandomState(11).randint(1, 255, size=7))
+    pb = list(np.random.RandomState(12).randint(1, 255, size=5))
+    ref = make()
+    want_a = ref.generate([pa], _gen(max_new_tokens=24))[0]
+    want_b = ref.generate([pb], _gen(max_new_tokens=24))[0]
+
+    eng = make()
+    out = {}
+
+    def drain_into(emitted):
+        for rid, toks in emitted.items():
+            out.setdefault(rid, []).extend(toks)
+
+    ra = eng.add_request(pa, _gen(max_new_tokens=24))
+    for _ in range(4):  # A prefills, then reaches pipelined steady state
+        drain_into(eng.step())
+    assert eng._inflight is not None  # the scenario requires pipelining
+    rb = eng.add_request(pb, _gen(max_new_tokens=24))
+    while eng.has_work():
+        drain_into(eng.step())
+    drain_into(eng.flush())
+    assert out[ra] == want_a
+    assert out[rb] == want_b
+
+
 def test_prefix_cache_reuse(tiny_cfg, tiny_params):
     """A second request sharing a long prompt prefix skips prefill for the
     shared full blocks and still decodes the same tokens."""

@@ -1,0 +1,348 @@
+"""Built-in runtime metrics: exposition format, GCS aggregation, the
+end-to-end family sweep, recording overhead, and the spawn-path guards
+(watch-spawn deadline, zygote fallback timeout).
+
+reference: src/ray/stats/metric_defs.cc (the built-in metric set) +
+_private/metrics_agent.py (Prometheus exposition / aggregation).
+"""
+
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    collect_local,
+    prometheus_text,
+)
+
+
+# ---------------------------------------------------------------------------
+# prometheus_text coverage (satellite: cumulative buckets, +Inf, escaping,
+# re-declaration adoption)
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_cumulative_buckets_and_inf():
+    h = Histogram("t_cum_hist", boundaries=[0.1, 1.0, 10.0])
+    for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+        h.observe(v)
+    text = prometheus_text([p for p in collect_local()
+                            if p["name"] == "t_cum_hist"])
+    # buckets are CUMULATIVE: le=0.1 -> 1, le=1.0 -> 3, le=10.0 -> 4, +Inf -> 5
+    assert 't_cum_hist_bucket{le="0.1"} 1' in text
+    assert 't_cum_hist_bucket{le="1.0"} 3' in text
+    assert 't_cum_hist_bucket{le="10.0"} 4' in text
+    assert 't_cum_hist_bucket{le="+Inf"} 5' in text
+    assert "t_cum_hist_count 5" in text
+    assert "t_cum_hist_sum 56.05" in text
+    assert "# TYPE t_cum_hist histogram" in text
+
+
+def test_label_escaping():
+    c = Counter("t_escape_total", tag_keys=("path",))
+    nasty = 'a\\b"c\nd'
+    c.inc(3, tags={"path": nasty})
+    text = prometheus_text([p for p in collect_local()
+                            if p["name"] == "t_escape_total"])
+    # backslash, quote, and newline must all be escaped per the exposition
+    # format — a raw newline inside a label would corrupt the scrape
+    assert 't_escape_total{path="a\\\\b\\"c\\nd"} 3' in text
+    assert "\nd\"" not in text  # no raw newline leaked into the label
+
+
+def test_histogram_redeclaration_adopts_state():
+    h1 = Histogram("t_redecl_hist", boundaries=[1.0, 2.0])
+    h1.observe(1.5)
+    # same name + same boundaries: the new instance ADOPTS the prior state
+    h2 = Histogram("t_redecl_hist", boundaries=[1.0, 2.0])
+    snap = {p["name"]: p for p in h2._snapshot()}
+    assert snap["t_redecl_hist"]["count"] == 1
+    h2.observe(1.7)
+    assert h1._snapshot()[0]["count"] == 2  # shared state both ways
+    # different boundaries: a fresh layout must NOT inherit mismatched buckets
+    h3 = Histogram("t_redecl_hist", boundaries=[5.0])
+    assert h3._snapshot() == []
+
+
+def test_counter_redeclaration_and_bound_recorder_survival():
+    c1 = Counter("t_redecl_total")
+    bound = c1.with_tags()
+    bound.inc(2)
+    c2 = Counter("t_redecl_total")
+    c2.inc(3)
+    # the bound recorder keeps feeding the adopted state
+    bound.inc(5)
+    pts = [p for p in collect_local() if p["name"] == "t_redecl_total"]
+    assert pts[0]["value"] == 10
+
+
+def test_bound_histogram_survives_boundary_redeclaration():
+    h1 = Histogram("t_rebound_hist", boundaries=[1.0, 2.0])
+    bound = h1.with_tags()
+    bound.observe(1.5)
+    # re-declare with DIFFERENT boundaries: fresh state; the bound recorder
+    # must follow the registry instead of feeding the orphaned dict
+    Histogram("t_rebound_hist", boundaries=[10.0])
+    bound.observe(3.0)
+    pts = [p for p in collect_local() if p["name"] == "t_rebound_hist"]
+    assert len(pts) == 1
+    assert pts[0]["boundaries"] == [10.0]
+    assert pts[0]["count"] == 1 and pts[0]["buckets"] == [1, 0]
+
+
+def test_tagged_gauge_set_zeroes_vanished_series():
+    from ray_tpu._private import runtime_metrics as rm
+
+    g = Gauge("t_shapes", tag_keys=("shape",))
+    ts = rm.TaggedGaugeSet(g, "shape")
+    ts.set_all({"CPU:1": 3, "CPU:2": 1})
+    ts.set_all({"CPU:1": 2})
+    pts = {tuple(p["tags"].items()): p["value"] for p in collect_local()
+           if p["name"] == "t_shapes"}
+    assert pts[(("shape", "CPU:1"),)] == 2
+    assert pts[(("shape", "CPU:2"),)] == 0  # vanished -> zeroed, not stale
+
+
+# ---------------------------------------------------------------------------
+# GCS aggregation across reporters
+# ---------------------------------------------------------------------------
+
+
+def test_multi_reporter_aggregation(ray_start_regular):
+    w = ray_start_regular
+    bounds = [1.0, 2.0]
+
+    def push(reporter, counter, gauge, buckets, t):
+        w.gcs.call("ReportMetrics", {"reporter": reporter, "time": t, "points": [
+            {"name": "t_agg_total", "kind": "counter", "tags": {}, "value": counter},
+            {"name": "t_agg_gauge", "kind": "gauge", "tags": {}, "value": gauge},
+            {"name": "t_agg_hist", "kind": "histogram", "tags": {},
+             "boundaries": bounds, "buckets": buckets,
+             "sum": float(sum(buckets)), "count": sum(buckets)},
+        ]})
+
+    now = time.time()
+    push("rep-a", 5, 1.0, [1, 0, 1], now - 10)
+    push("rep-b", 7, 2.0, [0, 2, 0], now)
+    agg = {p["name"]: p for p in w.gcs.call("CollectMetrics", {})
+           if p["name"].startswith("t_agg")}
+    assert agg["t_agg_total"]["value"] == 12          # counters sum
+    assert agg["t_agg_gauge"]["value"] == 2.0         # newest report wins
+    assert agg["t_agg_hist"]["buckets"] == [1, 2, 1]  # buckets sum
+    assert agg["t_agg_hist"]["count"] == 4
+    # mismatched boundary layouts aggregate separately (never zip-truncated)
+    w.gcs.call("ReportMetrics", {"reporter": "rep-c", "time": now, "points": [
+        {"name": "t_agg_hist", "kind": "histogram", "tags": {},
+         "boundaries": [9.0], "buckets": [3, 0], "sum": 3.0, "count": 3}]})
+    hists = [p for p in w.gcs.call("CollectMetrics", {})
+             if p["name"] == "t_agg_hist"]
+    assert sorted(tuple(p["boundaries"]) for p in hists) == [(1.0, 2.0), (9.0,)]
+
+
+def test_gauge_aggregation_through_collect_cluster(ray_start_regular):
+    from ray_tpu.util.metrics import collect_cluster
+
+    g = Gauge("t_cc_gauge")
+    g.set(41.0)
+    g.set(42.0)
+    pts = [p for p in collect_cluster() if p["name"] == "t_cc_gauge"]
+    assert pts and pts[0]["value"] == 42.0
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: the built-in families light up from a real CPU-lane workload
+# (tasks + plasma + one collective + a serve replica), per the acceptance
+# criterion: >= 12 distinct families spanning scheduler, raylet, object
+# store, collective, and serve namespaces with correct Prometheus types.
+# ---------------------------------------------------------------------------
+
+
+def _serve_echo(x):
+    return x + 1
+
+
+@pytest.mark.timeout(180)
+def test_builtin_families_exposed_end_to_end(ray_start_regular):
+    import pickle
+
+    from ray_tpu.serve._private.replica import ServeReplica
+    from ray_tpu.util import collective
+    from ray_tpu.util.metrics import collect_cluster
+
+    # tasks (scheduler + raylet + task namespaces; spawn metrics ride along)
+    @ray_tpu.remote
+    def sq(x):
+        return x * x
+
+    assert ray_tpu.get([sq.remote(i) for i in range(4)]) == [0, 1, 4, 9]
+    # plasma object (object_store namespace)
+    ref = ray_tpu.put(b"x" * 200_000)
+    assert len(ray_tpu.get(ref)) == 200_000
+    # one collective through the instrumented API (collective namespace)
+    collective.init_collective_group(1, 0, backend="store",
+                                     group_name="t_metrics_grp")
+    try:
+        out = collective.allreduce(np.ones(1024, np.float32),
+                                   group_name="t_metrics_grp")
+        assert float(out.sum()) == 1024.0
+    finally:
+        collective.destroy_collective_group("t_metrics_grp")
+    # a replica handling one request (serve namespace) — the instrumented
+    # path is the ServeReplica class itself, no actor round-trip needed
+    replica = ServeReplica("echo_dep", pickle.dumps(_serve_echo), (), {})
+    assert replica.handle_request("__call__", (1,), {}) == 2
+
+    points = collect_cluster()
+    families = sorted({p["name"] for p in points
+                       if p["name"].startswith("ray_tpu_")})
+    assert len(families) >= 12, families
+    namespaces = {f.split("_", 3)[2] for f in families}
+    # ray_tpu_<layer>_...: the acceptance namespaces must all be lit
+    for ns in ("scheduler", "raylet", "object", "collective", "serve", "gcs",
+               "task"):
+        assert any(f.startswith(f"ray_tpu_{ns}") for f in families), (
+            ns, families)
+
+    text = prometheus_text(points)
+    assert "# TYPE ray_tpu_raylet_worker_spawns_total counter" in text
+    assert "# TYPE ray_tpu_object_store_used_bytes gauge" in text
+    assert "# TYPE ray_tpu_task_execution_seconds histogram" in text
+    assert "# TYPE ray_tpu_collective_bus_bandwidth_gbps gauge" in text
+    assert 'ray_tpu_serve_replica_requests_total{app="default",deployment="echo_dep"} 1' in text
+
+
+def test_node_metrics_exposition(ray_start_regular):
+    """Per-node /metrics: each raylet serves its process-local registry
+    through the agent endpoint; the head's /metrics stays the aggregate."""
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    def noop():
+        return 1
+
+    assert ray_tpu.get(noop.remote()) == 1
+    rows = state.node_metrics()
+    assert rows and all("metrics" in r for r in rows)
+    text = rows[0]["metrics"]
+    assert "ray_tpu_raylet_workers" in text
+    assert "# TYPE ray_tpu_raylet_dispatch_seconds histogram" in text
+
+
+# ---------------------------------------------------------------------------
+# Recording overhead budget (satellite: the microbench gate)
+# ---------------------------------------------------------------------------
+
+
+def test_recording_overhead_under_budget():
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from benchmarks.metrics_overhead_bench import run
+
+    per_shape = run()
+    enforced = {k: v for k, v in per_shape.items()
+                if not k.startswith("unbound")}
+    # generous CI budget (the point is catching order-of-magnitude
+    # regressions; idle-host numbers are ~0.2-1 us — O(100ns)-ish)
+    budget_ns = 25_000
+    assert max(enforced.values()) < budget_ns, per_shape
+
+
+# ---------------------------------------------------------------------------
+# Spawn-path guards (satellites: watch-spawn deadline, zygote fallback)
+# ---------------------------------------------------------------------------
+
+
+class _FakeProc:
+    def __init__(self, pid=999_999):
+        self.pid = pid
+        self.killed = False
+
+    def poll(self):
+        return None  # alive (wedged) forever
+
+    def kill(self):
+        self.killed = True
+
+
+def test_watch_spawn_deadline_reclaims_starting_slot(monkeypatch):
+    """A spawned worker that wedges before registering is killed on the
+    deadline, its _starting slot reclaimed, and the timeout counted."""
+    from collections import defaultdict
+
+    from ray_tpu._private import runtime_metrics as rm
+    from ray_tpu._private.config import global_config
+    from ray_tpu._private.ids import NodeID
+    from ray_tpu._private.raylet import Raylet
+
+    monkeypatch.setattr(global_config(), "worker_spawn_timeout_s", 0.3)
+
+    class Host:
+        node_id = NodeID.random()
+        _stopped = threading.Event()
+        _lock = threading.RLock()
+        _starting = defaultdict(int)
+        _spawn_started = {}
+        _spawning_procs = {}
+        _spawn_timed_out = {}
+        _SPAWN_REFUSE_S = 60.0
+
+    Host._dispatch_cv = threading.Condition(Host._lock)
+    proc = _FakeProc()
+    Host._spawning_procs[proc.pid] = proc
+    Host._starting[""] = 1
+    before = sum(p["value"] for p in rm.WORKER_SPAWN_TIMEOUTS._snapshot()) \
+        if rm.WORKER_SPAWN_TIMEOUTS._snapshot() else 0
+
+    t0 = time.monotonic()
+    Raylet._watch_spawn(Host, proc, "")
+    assert time.monotonic() - t0 < 5.0  # returned promptly after deadline
+    assert proc.killed
+    assert Host._starting[""] == 0
+    assert proc.pid not in Host._spawning_procs
+    after = sum(p["value"] for p in rm.WORKER_SPAWN_TIMEOUTS._snapshot())
+    assert after == before + 1
+
+
+def test_zygote_spawn_times_out_and_falls_back(tmp_path, monkeypatch):
+    """A wedged-but-alive zygote (accepts, never replies) must cost at most
+    the short zygote_spawn_timeout_s before spawn() returns None — never
+    stall the dispatch loop for the old 15 s — and the fallback is counted."""
+    from ray_tpu._private import runtime_metrics as rm
+    from ray_tpu._private.config import global_config
+    from ray_tpu._private.zygote import ZygoteClient
+
+    monkeypatch.setattr(global_config(), "zygote_spawn_timeout_s", 0.3)
+    sock_path = str(tmp_path / "wedged.sock")
+    srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    srv.bind(sock_path)
+    srv.listen(4)
+    conns = []
+    threading.Thread(
+        target=lambda: conns.append(srv.accept()), daemon=True).start()
+
+    client = ZygoteClient.__new__(ZygoteClient)
+    client._sock_path = sock_path
+    client._proc = _FakeProc()
+    client._lock = threading.Lock()
+    client._starting = False
+    client._stopped = False
+
+    before = sum(p["value"] for p in rm.ZYGOTE_FALLBACKS._snapshot()) \
+        if rm.ZYGOTE_FALLBACKS._snapshot() else 0
+    t0 = time.monotonic()
+    pid = client.spawn({"K": "V"}, str(tmp_path / "log"))
+    dt = time.monotonic() - t0
+    srv.close()
+    assert pid is None
+    assert dt < 3.0  # short budget, not the old 15 s stall
+    after = sum(p["value"] for p in rm.ZYGOTE_FALLBACKS._snapshot())
+    assert after == before + 1
