@@ -767,6 +767,19 @@ def _bench_core_perf() -> dict:
         return {"error": str(e)[:200]}
 
 
+def _trace_summary_snapshot() -> dict:
+    """Process-local tracing telemetry (enabled flags, spans emitted, last
+    trace id + its critical-path summary when a cluster is connected) — so
+    BENCH_*.json records whether the run was traced and what the causal
+    breakdown looked like, alongside collective_metrics."""
+    try:
+        from ray_tpu.util import tracing
+
+        return tracing.trace_summary_snapshot()
+    except Exception as e:  # noqa: BLE001
+        return {"error": str(e)[:200]}
+
+
 def _collective_metrics_snapshot() -> dict:
     """This process's built-in collective metric points (see
     runtime_metrics.collective_snapshot): {op/wsN: {bytes_total, ops,
@@ -881,6 +894,7 @@ def main():
             # (per-op bytes / mean latency / derived bus bandwidth), so
             # BENCH_*.json carries bandwidth numbers without extra plumbing
             "collective_metrics": _collective_metrics_snapshot(),
+            "trace_summary": _trace_summary_snapshot(),
         },
     }
     print(json.dumps(result))

@@ -1,0 +1,157 @@
+"""Per-task overhead microbench for distributed tracing (util/tracing.py).
+
+The trace context rides the task submit/execute hot path (capture into the
+TaskSpec on submit, restore around execution, span events into the task
+sink), so its cost must stay bounded — and with tracing DISABLED
+(task_events_enabled=False or tracing_enabled=False) the fast path must be
+near zero: one config read plus one thread-local read.
+
+Mirrors benchmarks/metrics_overhead_bench.py: measures ns/record for every
+tracing shape against two budgets and prints one JSON line:
+
+  {"metric": "tracing_record_overhead", "value": <worst enabled ns>,
+   "unit": "ns", "budget_ns": ..., "disabled_worst_ns": ...,
+   "disabled_budget_ns": ..., "extra": {per-shape ns}}
+
+Exit status 1 if any enabled shape exceeds TRACING_OVERHEAD_BUDGET_NS
+(default 100 µs — an enabled submit mints two uuid4 ids, measured ~3-8 µs)
+or any disabled shape exceeds TRACING_DISABLED_BUDGET_NS (default 5 µs;
+measured ~0.2-1 µs).  Budgets are deliberately loose: they catch
+order-of-magnitude regressions, not CI scheduler noise.
+
+The bench runs clusterless: a stub worker absorbs span events the way
+CoreWorker._task_events does, so only the recording layer is measured
+(GCS flush cost is the metrics pipeline's, already piggybacked).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def _bench(fn, n: int = 100_000) -> float:
+    """ns per call, best of 3 runs (min defends against CI noise)."""
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            fn()
+        best = min(best, (time.perf_counter() - t0) / n)
+    return best * 1e9
+
+
+class _StubWorker:
+    """Just enough CoreWorker surface for span recording."""
+
+    job_id = None
+    actor_id = None
+    node_id = None
+
+    def __init__(self):
+        self._task_events = []
+
+    def append_task_events(self, events, flush=False):
+        self._task_events.extend(events)
+        if flush or len(self._task_events) >= 100:
+            self.flush_task_events()
+
+    def flush_task_events(self):
+        self._task_events.clear()
+
+
+def run() -> tuple:
+    from ray_tpu._private import worker as worker_mod
+    from ray_tpu._private.config import global_config
+    from ray_tpu._private.task_spec import TaskSpec
+    from ray_tpu._private.ids import TaskID
+    from ray_tpu.util import tracing
+
+    cfg = global_config()
+    stub = _StubWorker()
+    prev_worker = worker_mod._global_worker
+    worker_mod.set_global_worker(stub)
+
+    spec = TaskSpec(task_id=TaskID.random(), job_id=None, name="bench",
+                    function_digest="", function_blob=None,
+                    trace_id=tracing.new_trace_id(),
+                    span_id=tracing.new_span_id())
+
+    def span_enabled():
+        with tracing.span("bench"):
+            pass
+
+    def span_disabled():
+        with tracing.span("bench"):
+            pass
+
+    def emit_no_ctx():
+        # the built-in hot-path guard (collectives/engine/data when the
+        # caller isn't traced): a thread-local read, nothing recorded
+        tracing.emit_span("bench", 0.0, 0.0)
+
+    ctx_ids = (tracing.new_trace_id(), tracing.new_span_id())
+
+    def capture_and_restore():
+        # per-task cost for a TRACED submission: owner-side capture under
+        # an active context + executor-side restore (untraced submissions
+        # take the capture_disabled fast path)
+        with tracing.activate(*ctx_ids):
+            tracing.capture_for_submit()
+        with tracing.activate_from_spec(spec):
+            pass
+
+    def capture_disabled():
+        tracing.capture_for_submit()
+
+    prev_events, prev_tracing = cfg.task_events_enabled, cfg.tracing_enabled
+    try:
+        cfg.task_events_enabled = True
+        cfg.tracing_enabled = True
+        enabled = {
+            "span_enabled": _bench(span_enabled, 20_000),
+            "capture_and_restore_enabled": _bench(capture_and_restore, 50_000),
+            "emit_span_no_active_ctx": _bench(emit_no_ctx),
+        }
+        # the acceptance gate: task_events_enabled=False must restore the
+        # near-zero fast path (tracing_enabled=False takes the same branch)
+        cfg.task_events_enabled = False
+        disabled = {
+            "span_disabled": _bench(span_disabled),
+            "capture_disabled": _bench(capture_disabled),
+            "emit_span_disabled": _bench(emit_no_ctx),
+        }
+    finally:
+        cfg.task_events_enabled = prev_events
+        cfg.tracing_enabled = prev_tracing
+        worker_mod.set_global_worker(prev_worker)
+    return ({k: round(v, 1) for k, v in enabled.items()},
+            {k: round(v, 1) for k, v in disabled.items()})
+
+
+def main() -> int:
+    budget_ns = float(os.environ.get("TRACING_OVERHEAD_BUDGET_NS", 100_000))
+    disabled_budget_ns = float(
+        os.environ.get("TRACING_DISABLED_BUDGET_NS", 5_000))
+    enabled, disabled = run()
+    worst = max(enabled.values())
+    disabled_worst = max(disabled.values())
+    out = {
+        "metric": "tracing_record_overhead",
+        "value": worst,
+        "unit": "ns",
+        "budget_ns": budget_ns,
+        "disabled_worst_ns": disabled_worst,
+        "disabled_budget_ns": disabled_budget_ns,
+        "ok": worst <= budget_ns and disabled_worst <= disabled_budget_ns,
+        "extra": {**enabled, **disabled},
+    }
+    print(json.dumps(out))
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    sys.exit(main())

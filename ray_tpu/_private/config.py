@@ -84,6 +84,14 @@ class RayTpuConfig:
     # --- task events / observability ---
     task_events_enabled: bool = True
     task_events_max_buffer: int = 10000
+    # distributed tracing (util/tracing.py): context propagation through
+    # TaskSpec + raylet phase events + serve traceparent.  ANDed with
+    # task_events_enabled — turning either off restores the near-zero
+    # per-task fast path (benchmarks/tracing_overhead_bench.py).
+    # Span events share the bounded task sink (task_events_max_buffer
+    # ring): heavy traced traffic evicts the oldest events; hot-path
+    # emitters (engine step phases) self-rate-limit for this reason.
+    tracing_enabled: bool = True
     # --- testing / chaos ---
     # Format mirrors RAY_testing_rpc_failure (reference: src/ray/rpc/rpc_chaos.h:23-35):
     # "method1=max_failures:req_prob:resp_prob,method2=..."

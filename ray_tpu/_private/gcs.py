@@ -911,8 +911,14 @@ class GcsServer:
 
     def HandleListTaskEvents(self, req):
         limit = req.get("limit", 1000)
+        trace_id = req.get("trace_id")
         with self._lock:
-            return list(self.task_events)[-limit:]
+            if trace_id is not None:
+                rows = [e for e in self.task_events
+                        if e.get("trace_id") == trace_id]
+            else:
+                rows = list(self.task_events)
+        return rows[-limit:]
 
     # ------------------------------------------------------------------
     # State-API listings + cluster metrics aggregate

@@ -180,6 +180,12 @@ class Raylet:
         # oid -> monotonic start of an in-flight inbound push (push plane)
         self._push_receiving: Dict[ObjectID, float] = {}
         self._object_owners: Dict[ObjectID, Tuple[str, int]] = {}
+        # raylet-side task phase events (QUEUED at lease request, SCHEDULED
+        # at grant) for the GCS task sink — the queueing/dispatch phases of
+        # state.summarize_trace().  Flushed by the report loop; own lock so
+        # recording under the dispatch lock never does I/O.
+        self._task_events: List[dict] = []
+        self._task_events_lock = threading.Lock()
 
         # Register with GCS; receive cluster config + view.
         reply = self.gcs.call(
@@ -309,6 +315,7 @@ class Raylet:
                         self._last_gauge_refresh = now
                         self._update_node_gauges_locked()
                 runtime_metrics.maybe_push()
+                self._flush_task_events()
                 reply = self.gcs.call("ReportResources", {"node_id": self.node_id, "available": avail})
                 if reply.get("restart"):
                     # GCS restarted and lost us (reference: HandleNotifyGCSRestart
@@ -604,6 +611,48 @@ class Raylet:
     #  ClusterTaskManager::QueueAndScheduleTask, LocalTaskManager dispatch)
     # ------------------------------------------------------------------
 
+    def _record_task_event(self, spec: TaskSpec, state: str):
+        """Buffer a phase event for the GCS task sink (never blocks: the
+        report loop flushes).  Gated like every other task event."""
+        if not global_config().task_events_enabled:
+            return
+        ev = {
+            "task_id": spec.task_id.hex(),
+            "name": spec.name,
+            "state": state,
+            "time": time.time(),
+            "attempt": spec.attempt,
+            "job_id": spec.job_id.hex() if spec.job_id else None,
+            "actor_id": spec.actor_id.hex() if spec.actor_id else None,
+            "node_id": self.node_id.hex(),
+        }
+        if getattr(spec, "trace_id", None) is not None:
+            ev["trace_id"] = spec.trace_id
+            ev["span_id"] = spec.span_id
+            ev["parent_span_id"] = spec.parent_span_id
+        with self._task_events_lock:
+            self._task_events.append(ev)
+            if len(self._task_events) > 5000:  # GCS unreachable: shed oldest
+                del self._task_events[:1000]
+
+    def _flush_task_events(self):
+        with self._task_events_lock:
+            events, self._task_events = self._task_events, []
+        if not events:
+            return
+        try:
+            # call (not notify): notify swallows delivery failure, which
+            # would silently drop every QUEUED/SCHEDULED phase recorded
+            # during a GCS restart.  On failure the batch is re-queued —
+            # the record-side 5000 cap bounds it while the GCS is down.
+            self.gcs.call("AddTaskEvents", {"events": events},
+                          timeout=5, retry_deadline=0.0)
+        except Exception:  # noqa: BLE001
+            with self._task_events_lock:
+                self._task_events[:0] = events
+                if len(self._task_events) > 5000:
+                    del self._task_events[:len(self._task_events) - 5000]
+
     def HandleRequestWorkerLease(self, req, reply_token=None):
         spec: TaskSpec = req["spec"]
         pending = _PendingLease(spec=spec, reply_token=reply_token, for_actor=req.get("for_actor", False))
@@ -611,6 +660,10 @@ class Raylet:
             if self._draining:
                 self.server.send_reply(reply_token, {"rejected": True, "reason": "draining"})
                 return RpcServer.DELAYED_REPLY
+            # record QUEUED only once the task actually queues here — a
+            # draining raylet's rejection must not stamp a phase the
+            # retried lease will re-stamp on another node
+            self._record_task_event(spec, "QUEUED")
             self._pending_leases.append(pending)
             self._dispatch_cv.notify_all()
         return RpcServer.DELAYED_REPLY
@@ -744,6 +797,7 @@ class Raylet:
         p, demand, instances, pg_id, bundle_index = entry
         runtime_metrics.observe_schedule_latency(
             time.monotonic() - p.enqueue_time)
+        self._record_task_event(p.spec, "SCHEDULED")
         worker = self._idle_workers[env_key].popleft()
         self._lease_counter += 1
         lease_id = f"{self.node_id.hex()[:8]}-{self._lease_counter}"

@@ -179,6 +179,34 @@ def materialize_uv_env(spec: dict) -> str:
             shutil.rmtree(staging, ignore_errors=True)
             return False
 
+    def published_result() -> str:
+        """Resolve against whatever a CONCURRENT build published: losing
+        the rename race must adopt the winner's verdict, not return this
+        build's (now-deleted) staging dir.  A published dest always
+        carries a marker (publish() writes it before the rename):
+        ``.ready`` → the winner's venv; ``.validate_only`` → the winner
+        proved the baked image satisfies the pins, so use it ('').  A
+        markerless dest means the rename failed for a NON-race reason
+        (e.g. a tmp cleaner pruned the parent) — fail loudly rather than
+        silently run against the baked image unvalidated."""
+        if os.path.exists(os.path.join(dest, ".ready")):
+            return site_dir(dest)
+        if os.path.exists(os.path.join(dest, ".validate_only")):
+            return ""
+        raise RuntimeError(
+            f"runtime_env['uv'] could not publish the built environment "
+            f"to {dest} and no concurrent build published one either — "
+            "is the temp directory being cleaned concurrently?")
+
+    def peer_ready() -> Optional[str]:
+        """A peer's finished venv, if one was published while we failed."""
+        if os.path.exists(os.path.join(dest, ".ready")):
+            import shutil
+
+            shutil.rmtree(staging, ignore_errors=True)
+            return site_dir(dest)
+        return None
+
     try:
         subprocess.run(["uv", "venv", "--quiet", staging], check=True,
                        capture_output=True, text=True, timeout=120)
@@ -191,11 +219,18 @@ def materialize_uv_env(spec: dict) -> str:
         p = subprocess.run(install, capture_output=True, text=True,
                            timeout=600)
         if p.returncode != 0:
-            # offline resolution failed: accept the baked image IF it
-            # already satisfies the pins, else surface both failures
+            # offline resolution failed: accept a peer's finished venv, or
+            # the baked image IF it already satisfies the pins, else
+            # surface both failures
+            peer = peer_ready()
+            if peer is not None:
+                return peer
             try:
                 check_pip_requirements(packages)
             except RuntimeError as image_err:
+                peer = peer_ready()  # a peer may have published meanwhile
+                if peer is not None:
+                    return peer
                 raise RuntimeError(
                     "runtime_env['uv'] could not build the environment: uv "
                     f"failed ({(p.stderr or p.stdout).strip()[-400:]}) and "
@@ -205,13 +240,18 @@ def materialize_uv_env(spec: dict) -> str:
                     "packages into the image.") from None
             # cache the negative so the rest of the pool skips the doomed
             # venv+install at bootstrap
-            publish(".validate_only")
-            return ""
-        publish(".ready")
-        return site_dir(dest)
+            if publish(".validate_only"):
+                return ""
+            return published_result()
+        if publish(".ready"):
+            return site_dir(dest)
+        return published_result()
     except subprocess.CalledProcessError as e:
         import shutil
 
+        peer = peer_ready()
+        if peer is not None:
+            return peer
         shutil.rmtree(staging, ignore_errors=True)
         raise RuntimeError(
             "runtime_env['uv'] venv creation failed: "
@@ -219,6 +259,9 @@ def materialize_uv_env(spec: dict) -> str:
     except (subprocess.TimeoutExpired, FileNotFoundError) as e:
         import shutil
 
+        peer = peer_ready()
+        if peer is not None:
+            return peer
         shutil.rmtree(staging, ignore_errors=True)
         raise RuntimeError(
             f"runtime_env['uv'] setup failed: {e} — is uv on PATH?"

@@ -37,6 +37,13 @@ class TaskSpec:
     # owner-side submit time (monotonic, OWNER clock only): consumed by the
     # owner when the lease is granted to derive submit→start latency
     submit_ts: float = 0.0
+    # distributed-trace context (reference: tracing_helper serializing the
+    # OpenTelemetry context into the spec): trace_id is the whole causal
+    # chain's id, span_id is THIS task's span, parent_span_id is the
+    # submitter's active span.  None when tracing is disabled.
+    trace_id: Optional[str] = None
+    span_id: Optional[str] = None
+    parent_span_id: Optional[str] = None
     owner_addr: Optional[Tuple[str, int]] = None
     owner_worker_id: Optional[WorkerID] = None
     runtime_env: Optional[dict] = None

@@ -29,6 +29,7 @@ from ray_tpu._private.utils import DaemonExecutor
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ray_tpu._private import runtime_metrics, serialization
+from ray_tpu.util import tracing
 from ray_tpu._private.accelerators import bind_visible_accelerators
 from ray_tpu._private.config import global_config
 from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
@@ -455,6 +456,10 @@ class CoreWorker:
         self._put_counter = 0
         self._counter_lock = threading.Lock()
         self._task_events: List[dict] = []
+        # guards the buffer against concurrent writers (actor concurrency
+        # groups, proxy executor threads emitting spans): an unlocked
+        # append racing flush's swap-and-serialize would drop events
+        self._task_events_lock = threading.Lock()
 
         # Actor-related state (server side: this worker hosts an actor)
         self.actor_id: Optional[ActorID] = None  # set when this worker hosts an actor
@@ -521,6 +526,13 @@ class CoreWorker:
             # piggybacked metrics flush: runtime + user metrics recorded in
             # this process reach the GCS aggregate without their own loop
             runtime_metrics.maybe_push()
+            # piggybacked span flush: a process that executes no tasks
+            # (HTTP proxy host, idle driver) still publishes buffered
+            # trace spans within one resubscribe tick
+            try:
+                self.flush_task_events()
+            except Exception:  # noqa: BLE001
+                pass
             with self._sub_lock:
                 channels = list(self._subscriptions)
             # bound the set: a 'dead' pubsub event can be missed (GCS restart,
@@ -1060,6 +1072,7 @@ class CoreWorker:
         task_id = TaskID.random()
         digest, blob = self._publish_function(fn)
         runtime_env = self._package_runtime_env(runtime_env)
+        trace_id, parent_span_id, span_id = tracing.capture_for_submit()
         spec = TaskSpec(
             task_id=task_id,
             job_id=self.job_id,
@@ -1077,6 +1090,9 @@ class CoreWorker:
             owner_worker_id=self.worker_id,
             runtime_env=runtime_env,
             submit_ts=time.monotonic(),
+            trace_id=trace_id,
+            span_id=span_id,
+            parent_span_id=parent_span_id,
         )
         self.task_manager.add_pending(spec)
         self._pin_args(spec)
@@ -1384,11 +1400,18 @@ class CoreWorker:
             "job_id": spec.job_id.hex() if spec.job_id else None,
             "actor_id": spec.actor_id.hex() if spec.actor_id else None,
         }
+        if spec.trace_id is not None:
+            ev["trace_id"] = spec.trace_id
+            ev["span_id"] = spec.span_id
+            ev["parent_span_id"] = spec.parent_span_id
+        if state == "SUBMITTED":
+            # owner-side pid/node: timeline() places the submit slice (and
+            # the outgoing flow-event arrow) on the submitting process
+            ev["pid"] = os.getpid()
+            ev["node_id"] = self.node_id.hex() if self.node_id else None
         if extra:
             ev.update(extra)
-        self._task_events.append(ev)
-        if len(self._task_events) >= 100:
-            self.flush_task_events()
+        self.append_task_events([ev])
 
     def _record_exec_event(self, spec: TaskSpec):
         """Executor-side RUNNING event with pid/node for timeline + state API."""
@@ -1397,8 +1420,19 @@ class CoreWorker:
             "node_id": self.node_id.hex() if self.node_id else None,
         })
 
+    def append_task_events(self, events: List[dict], flush: bool = False):
+        """Buffer task/span events; one batched flush per >=100 events
+        (or on demand).  The single entry point for every writer — task
+        lifecycle here, spans via tracing.emit_span."""
+        with self._task_events_lock:
+            self._task_events.extend(events)
+            flush = flush or len(self._task_events) >= 100
+        if flush:
+            self.flush_task_events()
+
     def flush_task_events(self):
-        events, self._task_events = self._task_events, []
+        with self._task_events_lock:
+            events, self._task_events = self._task_events, []
         if events:
             try:
                 self.gcs.notify("AddTaskEvents", {"events": events})
@@ -1431,15 +1465,21 @@ class CoreWorker:
                 self._exec_thread_id = threading.get_ident()
                 self._exec_lease_id = lease.get("lease_id")
             try:
-                args = [self._unpack_arg(a) for a in spec.args]
-                kwargs = {k: self._unpack_arg((kind, p)) for k, kind, p in spec.kwargs}
-                exec_t0 = time.perf_counter()
-                result = fn(*args, **kwargs)
-                runtime_metrics.observe_task_execution(
-                    time.perf_counter() - exec_t0, kind="task")
-                # return packing stays cancellable: a STREAMING task's user
-                # code runs inside _stream_returns' iteration, not fn()
-                returns = self._pack_returns(spec, result)
+                # the submitter's trace context wraps arg fetch + user code +
+                # return packing: nested submissions and spans chain under
+                # THIS task's span (reference: tracing_helper restoring the
+                # serialized context in the executor)
+                with tracing.activate_from_spec(spec):
+                    args = [self._unpack_arg(a) for a in spec.args]
+                    kwargs = {k: self._unpack_arg((kind, p)) for k, kind, p in spec.kwargs}
+                    exec_t0 = time.perf_counter()
+                    result = fn(*args, **kwargs)
+                    runtime_metrics.observe_task_execution(
+                        time.perf_counter() - exec_t0, kind="task")
+                    # return packing stays cancellable: a STREAMING task's
+                    # user code runs inside _stream_returns' iteration, not
+                    # fn()
+                    returns = self._pack_returns(spec, result)
             finally:
                 with self._exec_state_lock:
                     self.current_task_id = None
@@ -1600,6 +1640,7 @@ class CoreWorker:
         if blob is None and digest not in self._published_fns:
             blob = serialization.dumps_inline(cls)
         runtime_env = self._package_runtime_env(runtime_env)
+        trace_id, parent_span_id, span_id = tracing.capture_for_submit()
         spec = TaskSpec(
             task_id=TaskID.random(),
             job_id=self.job_id,
@@ -1621,6 +1662,9 @@ class CoreWorker:
             detached=(lifetime == "detached"),
             actor_name=name,
             runtime_env=runtime_env,
+            trace_id=trace_id,
+            span_id=span_id,
+            parent_span_id=parent_span_id,
         )
         self._gcs_subscribe(f"ACTOR:{actor_id.hex()}")
         self.gcs.call("RegisterActor", {"spec": spec, "namespace": namespace})
@@ -1653,6 +1697,7 @@ class CoreWorker:
 
     def submit_actor_task(self, actor_id: ActorID, method_name: str, args, kwargs,
                           num_returns=1, max_task_retries=0, concurrency_group=None):
+        trace_id, parent_span_id, span_id = tracing.capture_for_submit()
         spec = TaskSpec(
             task_id=TaskID.random(),
             job_id=self.job_id,
@@ -1668,6 +1713,9 @@ class CoreWorker:
             actor_method=method_name,
             max_retries=max_task_retries,
             concurrency_group=concurrency_group,
+            trace_id=trace_id,
+            span_id=span_id,
+            parent_span_id=parent_span_id,
         )
         self.task_manager.add_pending(spec)
         self._record_task_event(spec, "SUBMITTED")
@@ -1703,9 +1751,10 @@ class CoreWorker:
         try:
             bind_visible_accelerators(lease.get("resource_instances"))
             cls = self._load_function(spec)
-            args = [self._unpack_arg(a) for a in spec.args]
-            kwargs = {k: self._unpack_arg((kind, p)) for k, kind, p in spec.kwargs}
-            instance = cls(*args, **kwargs)
+            with tracing.activate_from_spec(spec):
+                args = [self._unpack_arg(a) for a in spec.args]
+                kwargs = {k: self._unpack_arg((kind, p)) for k, kind, p in spec.kwargs}
+                instance = cls(*args, **kwargs)
         except Exception as e:  # noqa: BLE001
             return {"ok": False, "error": f"{e}\n{traceback.format_exc()}"}
         self.actor_id = spec.actor_id
@@ -1793,24 +1842,26 @@ class CoreWorker:
         spec: TaskSpec = req["spec"]
         try:
             self._record_exec_event(spec)
-            args = [self._unpack_arg(a) for a in spec.args]
-            kwargs = {k: self._unpack_arg((kind, p)) for k, kind, p in spec.kwargs}
-            exec_t0 = time.perf_counter()
-            if spec.actor_method == "__ray_tpu_call__":
-                # Hidden protocol: run fn(instance, *args, **kwargs) on the
-                # actor (used by collectives/train to inject gang setup).
-                fn, args = args[0], args[1:]
-                result = fn(self._actor_instance, *args, **kwargs)
-            else:
-                method = getattr(self._actor_instance, spec.actor_method)
-                result = method(*args, **kwargs)
-            runtime_metrics.observe_task_execution(
-                time.perf_counter() - exec_t0, kind="actor")
-            if hasattr(result, "__await__"):
-                import asyncio
+            with tracing.activate_from_spec(spec):
+                args = [self._unpack_arg(a) for a in spec.args]
+                kwargs = {k: self._unpack_arg((kind, p)) for k, kind, p in spec.kwargs}
+                exec_t0 = time.perf_counter()
+                if spec.actor_method == "__ray_tpu_call__":
+                    # Hidden protocol: run fn(instance, *args, **kwargs) on
+                    # the actor (used by collectives/train to inject gang
+                    # setup).
+                    fn, args = args[0], args[1:]
+                    result = fn(self._actor_instance, *args, **kwargs)
+                else:
+                    method = getattr(self._actor_instance, spec.actor_method)
+                    result = method(*args, **kwargs)
+                runtime_metrics.observe_task_execution(
+                    time.perf_counter() - exec_t0, kind="actor")
+                if hasattr(result, "__await__"):
+                    import asyncio
 
-                result = asyncio.run(_await(result))
-            returns = self._pack_returns(spec, result)
+                    result = asyncio.run(_await(result))
+                returns = self._pack_returns(spec, result)
             self.server.send_reply(reply_token, {"status": "ok", "returns": returns})
         except Exception as e:  # noqa: BLE001
             self.server.send_reply(

@@ -18,6 +18,7 @@ Endpoints:
   GET /api/placement_groups list_placement_groups
   GET /api/jobs             submitted jobs (job manager) + driver jobs (GCS)
   GET /api/timeline         Chrome trace events
+  GET /api/trace/<trace_id> one distributed trace: spans + critical path
   GET /metrics              Prometheus exposition of cluster metrics
 """
 
@@ -157,6 +158,20 @@ class DashboardHead:
             import ray_tpu
 
             return ray_tpu.timeline()
+        if path.startswith("/api/trace/"):
+            # /api/trace/<trace_id> — every span of one distributed trace
+            # plus the critical-path summary (util/tracing.py context)
+            trace_id = path[len("/api/trace/"):]
+            if not trace_id:
+                return None
+            client = state.StateApiClient()
+            spans = client.get_trace(trace_id)
+            return {
+                "trace_id": trace_id,
+                "spans": spans,
+                # reuse the fetched spans — no second event-log fold
+                "summary": client.summarize_trace(trace_id, spans=spans),
+            }
         if path == "/api/node_stats":
             return state.node_stats()
         if path == "/api/node_metrics":

@@ -42,6 +42,7 @@ from collections import deque
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 from ray_tpu._private import runtime_metrics
+from ray_tpu.util import tracing
 
 logger = logging.getLogger(__name__)
 
@@ -156,6 +157,22 @@ class PhysicalOperator:
         self.output_bytes += bundle.nbytes
         runtime_metrics.add_data_rows(self.name, bundle.num_rows)
 
+    # -- tracing (op spans, children of the trace run() was called under)
+    def _trace_t0(self) -> float:
+        """Dispatch-time stamp for the op span; 0.0 when untraced (one
+        thread-local read on the scheduling hot path)."""
+        return time.time() if tracing.context_active() else 0.0
+
+    def _emit_op_span(self, t0: float, num_rows: int = -1) -> None:
+        if t0 <= 0.0:
+            return
+        try:
+            tracing.emit_span(
+                f"data:{self.name}", t0, time.time(), kind="data",
+                attributes=({"num_rows": num_rows} if num_rows >= 0 else None))
+        except Exception:  # noqa: BLE001 — tracing never fails a pipeline
+            pass
+
     # -- lifecycle
     def outstanding(self) -> int:
         return 0
@@ -199,7 +216,7 @@ class TaskPoolMapOperator(PhysicalOperator):
         self.fns = fns
         self.resources = resources
         self._fused_fn = None  # built lazily once (needs a connected worker)
-        self._in_flight: Dict[Any, Tuple[Any, int]] = {}  # meta_ref -> (block_ref, seq)
+        self._in_flight: Dict[Any, Tuple[Any, int, float]] = {}  # meta_ref -> (block_ref, seq, trace_t0)
         if sources is not None:
             for i, src in enumerate(sources):
                 self.add_input(RefBundle(src, 0, -1, seq=i))
@@ -225,7 +242,7 @@ class TaskPoolMapOperator(PhysicalOperator):
     def dispatch(self, executor):
         bundle = self._pop_input()
         block_ref, meta_ref = self._remote_fn().remote(self.fns, bundle.ref)
-        self._in_flight[meta_ref] = (block_ref, bundle.seq)
+        self._in_flight[meta_ref] = (block_ref, bundle.seq, self._trace_t0())
         self.tasks_submitted += 1
         self.peak_outstanding = max(self.peak_outstanding, len(self._in_flight))
         return meta_ref, meta_ref
@@ -233,8 +250,9 @@ class TaskPoolMapOperator(PhysicalOperator):
     def on_task_done(self, token) -> None:
         import ray_tpu
 
-        block_ref, seq = self._in_flight.pop(token)
+        block_ref, seq, t0 = self._in_flight.pop(token)
         nbytes, num_rows = ray_tpu.get(token)
+        self._emit_op_span(t0, num_rows)
         self._emit(RefBundle(block_ref, nbytes, num_rows, seq=seq))
 
     def outstanding(self) -> int:
@@ -243,7 +261,7 @@ class TaskPoolMapOperator(PhysicalOperator):
     def shutdown(self) -> None:
         import ray_tpu
 
-        for meta_ref, (block_ref, _) in self._in_flight.items():
+        for meta_ref, (block_ref, *_rest) in self._in_flight.items():
             try:
                 ray_tpu.cancel(block_ref)
             except Exception:  # noqa: BLE001
@@ -276,7 +294,7 @@ class ActorPoolMapOperator(PhysicalOperator):
         self.max_size = max(self.min_size, max_size)
         self.resources = resources
         self.pool: List[_PoolActor] = []
-        self._in_flight: Dict[Any, Tuple[Any, int, _PoolActor]] = {}
+        self._in_flight: Dict[Any, Tuple[Any, int, _PoolActor, float]] = {}
         self.peak_pool_size = 0
         self.scale_down_events = 0
         self._started = False
@@ -338,7 +356,8 @@ class ActorPoolMapOperator(PhysicalOperator):
         )
         actor.in_flight += 1
         actor.last_active = time.monotonic()
-        self._in_flight[meta_ref] = (block_ref, bundle.seq, actor)
+        self._in_flight[meta_ref] = (block_ref, bundle.seq, actor,
+                                     self._trace_t0())
         self.tasks_submitted += 1
         self.peak_outstanding = max(self.peak_outstanding, len(self._in_flight))
         return meta_ref, meta_ref
@@ -346,10 +365,11 @@ class ActorPoolMapOperator(PhysicalOperator):
     def on_task_done(self, token) -> None:
         import ray_tpu
 
-        block_ref, seq, actor = self._in_flight.pop(token)
+        block_ref, seq, actor, t0 = self._in_flight.pop(token)
         actor.in_flight -= 1
         actor.last_active = time.monotonic()
         nbytes, num_rows = ray_tpu.get(token)
+        self._emit_op_span(t0, num_rows)
         self._emit(RefBundle(block_ref, nbytes, num_rows, seq=seq))
 
     def maintain(self, now: float) -> None:
@@ -453,6 +473,10 @@ class StreamingExecutor:
 
     # -- public API
     def run(self) -> Iterator[Any]:
+        # carry the consumer thread's trace context into the scheduling
+        # thread: op tasks and op spans chain under the span/task that
+        # started the pipeline
+        self._trace_ctx = tracing.current_context()
         self._thread = threading.Thread(
             target=self._loop_guard, name="ray_tpu-data-executor", daemon=True
         )
@@ -541,19 +565,24 @@ class StreamingExecutor:
                         pass
 
     def _loop_guard(self) -> None:
-        try:
-            self._loop()
-            self._post_final(_END)
-        except _Cancelled:
-            pass  # consumer closed the iterator; nothing to report
-        except BaseException as e:  # noqa: BLE001
-            self._post_final(("error", e), evict=True)
-        finally:
-            for op in self.ops:
-                try:
-                    op.shutdown()
-                except Exception:  # noqa: BLE001
-                    logger.exception("operator %s shutdown failed", op.name)
+        import contextlib
+
+        ctx = getattr(self, "_trace_ctx", None)
+        with (tracing.activate(*ctx) if ctx else contextlib.nullcontext()):
+            try:
+                self._loop()
+                self._post_final(_END)
+            except _Cancelled:
+                pass  # consumer closed the iterator; nothing to report
+            except BaseException as e:  # noqa: BLE001
+                self._post_final(("error", e), evict=True)
+            finally:
+                for op in self.ops:
+                    try:
+                        op.shutdown()
+                    except Exception:  # noqa: BLE001
+                        logger.exception("operator %s shutdown failed",
+                                         op.name)
 
     def _downstream_bytes(self, idx: int) -> int:
         op = self.ops[idx]

@@ -28,6 +28,7 @@ from __future__ import annotations
 import dataclasses
 import math
 import threading
+import time
 from functools import partial
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
@@ -222,6 +223,8 @@ class JaxLLMEngine:
         # readback overlaps the next chunk's compute, like the paged
         # engine.  (em_dev, active_slots).
         self._inflight = None
+        # monotonic ts of the last traced step's phase spans (rate limit)
+        self._last_phase_span = float("-inf")
 
         # params are an ARGUMENT of the jitted programs, never a closure:
         # captured closures lower as inline constants, and a real model's
@@ -358,6 +361,17 @@ class JaxLLMEngine:
 
         Returns {request_id: [tokens emitted this step]}.
         """
+        from ray_tpu.util import tracing
+
+        # PhaseRecorder: spans stamped under the lock, emitted after
+        # release (an emit_span GCS flush must not stall the decode path).
+        # Rate-limited per engine (~5 span sets/s) so a steady traced
+        # serving loop can't cycle the bounded GCS task sink.
+        rec = tracing.PhaseRecorder()
+        now = time.monotonic()
+        traced = rec.active and now - self._last_phase_span >= 0.2
+        if traced:
+            self._last_phase_span = now
         with self._lock:
             before = {id(r): len(r.out_tokens)
                       for r in self._requests.values()}
@@ -366,7 +380,10 @@ class JaxLLMEngine:
                 # after any in-flight chunk on the cache dataflow, and the
                 # new slot was inactive in that chunk (garbage rows are
                 # overwritten by the decode step that first uses them)
+                t_pf = time.time() if traced else 0.0
                 self._admit_locked()
+                if traced:
+                    rec.stamp("engine.admit_prefill", t_pf)
             active = [s for s in range(self.max_batch)
                       if self._slot_req[s] is not None]
             if active and decode:
@@ -402,6 +419,7 @@ class JaxLLMEngine:
                 # temperature / top-k callers share a single forward.
                 # PIPELINED: the chunk dispatched here is collected next
                 # step, its readback riding under this dispatch's compute.
+                t_dec = time.time() if traced else 0.0
                 (em_dev, self._d_next, self.cache, self._d_lengths,
                  self._d_active, self._d_remaining, self._d_key) = \
                     self._decode(
@@ -412,9 +430,14 @@ class JaxLLMEngine:
                 prev, self._inflight = self._inflight, (em_dev, active)
                 if prev is not None:
                     self._book_chunk_locked(*prev)
+                if traced:
+                    rec.stamp("engine.decode", t_dec,
+                              {"active_slots": len(active),
+                               "chunk": self.config.decode_chunk})
             else:
                 self._collect_inflight_locked()
             emitted = self._gather_emitted_locked(before)
+        rec.emit()
         return emitted
 
     def _book_chunk_locked(self, em_dev, active):

@@ -32,6 +32,7 @@ import collections
 import dataclasses
 import math
 import threading
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -164,6 +165,38 @@ def _prefill_plan(plen: int, matched: int, chunk: int, bs: int):
     return cover
 
 
+def _prefill_cover_worst(plen: int, chunk: int, bs: int) -> int:
+    """Max block index any prefill chunk of a ``plen``-token prompt can
+    touch, over every possible prefix-cache offset.  Intermediate chunks
+    never reach past plen; only the FINAL chunk's pow2 bucket overshoots,
+    and a prefix hit merely shifts its start to another block boundary —
+    so scanning block-aligned final-chunk starts bounds it exactly."""
+    worst = 0
+    lo = max(0, plen - chunk)
+    start = ((lo + bs - 1) // bs) * bs
+    for pos in range(start, plen, bs):
+        c = min(chunk, _bucket_pow2(_pad_to(plen - pos, bs), lo=bs))
+        worst = max(worst, math.ceil((pos + c) / bs))
+    return worst
+
+
+def _prefill_table_width(max_seq: int, chunk: int, bs: int) -> int:
+    """True worst-case prefill table width: 1 (decode spare, reserved at
+    admission) + the max block index any chunk dispatch can touch.
+
+    ``max_blocks_per_seq + 2`` was NOT an upper bound: the final chunk's
+    pow2 bucket can overshoot the prompt by up to ~chunk/2 tokens (e.g.
+    max_seq=992, bs=16, chunk=256, plen=897 → the pos=768 chunk buckets
+    to 256 wide and covers 1024 tokens = 65 blocks, past
+    bucket_pow2(62+2)=64 — a broadcast ValueError mid-serve).  Only the
+    last ~2*chunk prompt lengths can attain the max (any shorter plen
+    covers ≤ plen + chunk, below the plen=max_seq floor), keeping the
+    scan O(chunk²/bs) at engine init."""
+    return 1 + max(
+        _prefill_cover_worst(plen, chunk, bs)
+        for plen in range(max(1, max_seq - 2 * chunk), max_seq + 1))
+
+
 class PagedJaxLLMEngine:
     """Drop-in engine with the static engine's API over a paged KV pool."""
 
@@ -196,7 +229,11 @@ class PagedJaxLLMEngine:
         # compiles inside the serving window.  One width = at most
         # log2(prefill_chunk/bs) prefill programs, all warmed at init.
         # The masked overhang costs ~16% chunk compute at max_seq 1024.
-        self._prefill_w = _bucket_pow2(self.max_blocks_per_seq + 2)
+        # Width = the simulated worst case over every prompt length and
+        # chunk start (see _prefill_table_width) — pow2 chunk bucketing
+        # can cover past max_blocks_per_seq + 2.
+        self._prefill_w = _prefill_table_width(
+            self.max_seq, config.prefill_chunk, self.bs)
         self.blocks = BlockManager(nb, self.bs, config.enable_prefix_caching)
 
         if params is None:
@@ -247,6 +284,8 @@ class PagedJaxLLMEngine:
         # (~100 ms on a tunneled chip, ~3 ms/token-step at chunk 32).
         # (em_dev, active_slots): collected lazily by _drain_locked().
         self._inflight: Optional[Tuple[jnp.ndarray, List[int]]] = None
+        # monotonic ts of the last traced step's phase spans (rate limit)
+        self._last_phase_span = float("-inf")
         # a finished prefill's sampled first token stays a DEVICE future
         # until the next drain point: a synchronous int(ids[0]) per request
         # serialized a ~100 ms readback behind every queued program
@@ -349,6 +388,11 @@ class PagedJaxLLMEngine:
                 f"prompt ({len(prompt)}) + max_new_tokens ({gen.max_new_tokens})"
                 f" exceeds max_seq_len {self.max_seq}")
         worst = math.ceil((len(prompt) + gen.max_new_tokens + 1) / self.bs)
+        # admission reserves cover+1 blocks (chunk-bucket overhang included,
+        # any prefix offset) — an infeasible reserve must fail HERE, not
+        # retry forever in _admit_locked
+        worst = max(worst, 1 + _prefill_cover_worst(
+            len(prompt), self.config.prefill_chunk, self.bs))
         if worst > self.num_blocks - 1:  # block 0 is the sink
             raise ValueError(
                 f"request needs up to {worst} KV blocks but the pool has "
@@ -603,6 +647,19 @@ class PagedJaxLLMEngine:
         the lagged view.  ``decode=False`` runs admission/prefill only
         (ramp control)."""
         emitted: Dict[int, List[int]] = {}
+        # engine phases become children of the active trace (a serve
+        # request / task span); untraced steps pay one thread-local read.
+        # PhaseRecorder: stamped under the lock, emitted after release.
+        from ray_tpu.util import tracing
+
+        rec = tracing.PhaseRecorder()
+        # per-engine rate limit (~5 span sets/s): a steady traced serving
+        # loop must not cycle the bounded GCS task sink with per-step
+        # spans — phase durations are steady-state, sampling keeps signal
+        now = time.monotonic()
+        traced = rec.active and now - self._last_phase_span >= 0.2
+        if traced:
+            self._last_phase_span = now
         with self._lock:
             before = self._emit_snapshot_locked()
             if self._pending or any(
@@ -614,8 +671,11 @@ class PagedJaxLLMEngine:
                 # and prefill dispatches chain after the decode on the pool
                 # dataflow.  Only a final prefill chunk (_dirty → refresh)
                 # forces a drain, below.
+                t_pf = time.time() if traced else 0.0
                 self._admit_locked()
                 self._prefill_step_locked()
+                if traced:
+                    rec.stamp("paged.admit_prefill", t_pf)
             chunk = self.config.decode_chunk
             if decode:
                 # margin covers this dispatch plus one still in flight
@@ -642,6 +702,7 @@ class PagedJaxLLMEngine:
                         active = [s for s in active
                                   if self._slot_req[s] is not None]
             if active:
+                t_dec = time.time() if traced else 0.0
                 w = _bucket_pow2(max(len(self._slot_req[s].blocks)
                                      for s in active))
                 table = np.zeros((self.max_batch, w), np.int32)
@@ -661,9 +722,13 @@ class PagedJaxLLMEngine:
                     # latency rides under the new dispatch.  The device is
                     # up to `chunk` appends ahead of the collected view.
                     self._collect_locked(*prev, margin=chunk)
+                if traced:
+                    rec.stamp("paged.decode", t_dec,
+                              {"active_slots": len(active), "chunk": chunk})
             else:
                 self._drain_locked()
             emitted = self._gather_emitted_locked(before)
+        rec.emit()
         return emitted
 
     def flush(self) -> Dict[int, List[int]]:

@@ -183,14 +183,16 @@ class _AsyncProxy:
 
     @staticmethod
     def _response(status: int, body: bytes, content_type: str = "application/json",
-                  keep_alive: bool = True) -> bytes:
+                  keep_alive: bool = True, extra_headers=None) -> bytes:
         reason = {200: "OK", 404: "Not Found", 400: "Bad Request",
                   500: "Internal Server Error"}.get(status, "OK")
         conn = "keep-alive" if keep_alive else "close"
+        extras = "".join(f"{k}: {v}\r\n" for k, v in (extra_headers or ()))
         return (
             f"HTTP/1.1 {status} {reason}\r\n"
             f"Content-Type: {content_type}\r\n"
             f"Content-Length: {len(body)}\r\n"
+            f"{extras}"
             f"Connection: {conn}\r\n\r\n"
         ).encode("latin1") + body
 
@@ -228,6 +230,8 @@ class _AsyncProxy:
 
     async def _dispatch(self, writer, method: str, target: str,
                         headers: Dict[str, str], body: bytes) -> bool:
+        from ray_tpu.util import tracing
+
         path = target.split("?")[0]
         matched = match_route_full(path)
         if matched is None:
@@ -235,39 +239,63 @@ class _AsyncProxy:
             await writer.drain()
             return True
         handle, prefix, is_asgi = matched
+        # W3C trace context: continue the caller's trace (or root a new
+        # one) so the handle call — and everything it causes: replica,
+        # engine steps, collectives — lands in one distributed trace.
+        # The request span's id goes back out as a traceparent header.
+        # Per-request rooting is deliberate: the request IS the trace
+        # unit, and its span volume is the same order as the lifecycle
+        # events its actor task already feeds the bounded sink; durable
+        # aggregates live in the metrics plane, the sink is recent-window
+        # by design.  Disable via tracing_enabled=False.
+        ctx3 = tracing.ingest(headers.get("traceparent"))
+        trace_headers = ([("traceparent",
+                           tracing.format_traceparent(ctx3[0], ctx3[1]))]
+                         if ctx3 else None)
         if is_asgi:
             return await self._dispatch_asgi(
-                writer, handle, prefix, method, target, headers, body)
+                writer, handle, prefix, method, target, headers, body,
+                ctx3=ctx3, trace_headers=trace_headers)
         try:
             payload = json.loads(body) if body else None
         except json.JSONDecodeError:
             payload = body.decode() if body else None
 
         if isinstance(payload, dict) and payload.get("stream"):
-            await self._dispatch_stream(writer, handle, payload)
+            await self._dispatch_stream(writer, handle, payload,
+                                        ctx3=ctx3,
+                                        trace_headers=trace_headers)
             return False  # SSE ends with connection close (no chunked TE)
 
         loop = asyncio.get_running_loop()
 
         def call():
-            if payload is None:
-                return handle.remote().result(timeout_s=_HANDLE_TIMEOUT_S)
-            return handle.remote(payload).result(timeout_s=_HANDLE_TIMEOUT_S)
+            with tracing.activate_span(
+                    ctx3, f"HTTP {method} {path}", kind="server",
+                    attributes={"http.method": method, "http.path": path}):
+                if payload is None:
+                    return handle.remote().result(timeout_s=_HANDLE_TIMEOUT_S)
+                return handle.remote(payload).result(timeout_s=_HANDLE_TIMEOUT_S)
 
         try:
             result = await loop.run_in_executor(self._pool, call)
             out = json.dumps(result, default=str).encode()
-            writer.write(self._response(200, out))
+            writer.write(self._response(200, out, extra_headers=trace_headers))
         except Exception as e:  # noqa: BLE001
-            writer.write(self._response(500, json.dumps({"error": str(e)}).encode()))
+            writer.write(self._response(
+                500, json.dumps({"error": str(e)}).encode(),
+                extra_headers=trace_headers))
         await writer.drain()
         return True
 
-    async def _dispatch_stream(self, writer, handle, payload):
+    async def _dispatch_stream(self, writer, handle, payload, ctx3=None,
+                               trace_headers=None):
         """Server-sent events: one `data:` frame per streamed item, then
         `data: [DONE]` (the OpenAI SSE convention). The blocking generator is
         drained on the executor; frames hop to the event loop via a queue so
         many streams interleave on one loop."""
+        from ray_tpu.util import tracing
+
         loop = asyncio.get_running_loop()
         q: asyncio.Queue = asyncio.Queue()  # soft-bounded by put_from_thread
         stop = threading.Event()
@@ -294,15 +322,18 @@ class _AsyncProxy:
 
         def pump():
             try:
-                gen = handle.options(stream=True).remote(payload)
-                for item in gen:
-                    if stop.is_set():
-                        return
-                    frame = (b"data: " + json.dumps(item, default=str).encode()
-                             + b"\n\n")
-                    if not put_from_thread(frame):
-                        return
-                put_from_thread(b"data: [DONE]\n\n")
+                with tracing.activate_span(ctx3, "HTTP stream",
+                                           kind="server"):
+                    gen = handle.options(stream=True).remote(payload)
+                    for item in gen:
+                        if stop.is_set():
+                            return
+                        frame = (b"data: "
+                                 + json.dumps(item, default=str).encode()
+                                 + b"\n\n")
+                        if not put_from_thread(frame):
+                            return
+                    put_from_thread(b"data: [DONE]\n\n")
             except Exception as e:  # noqa: BLE001
                 if not stop.is_set():
                     err = (b"data: " + json.dumps({"error": str(e)}).encode()
@@ -311,11 +342,13 @@ class _AsyncProxy:
             finally:
                 put_from_thread(_END)
 
+        trace_head = "".join(f"{k}: {v}\r\n" for k, v in (trace_headers or ()))
         writer.write(
             b"HTTP/1.1 200 OK\r\n"
             b"Content-Type: text/event-stream\r\n"
             b"Cache-Control: no-cache\r\n"
-            b"Connection: close\r\n\r\n"
+            + trace_head.encode("latin1")
+            + b"Connection: close\r\n\r\n"
         )
         await writer.drain()
         # one dedicated thread per live stream: streams are long-lived, so
@@ -345,7 +378,10 @@ class _AsyncProxy:
     # -- ASGI app forwarding (reference: serve/api.py:174 @serve.ingress) --
 
     async def _dispatch_asgi(self, writer, handle, prefix, method, target,
-                             headers, body) -> bool:
+                             headers, body, ctx3=None,
+                             trace_headers=None) -> bool:
+        from ray_tpu.util import tracing
+
         path = target.split("?")[0]
         query = target.split("?", 1)[1] if "?" in target else ""
         sub_path = path[len(prefix.rstrip("/")):] or "/"
@@ -355,14 +391,22 @@ class _AsyncProxy:
         loop = asyncio.get_running_loop()
 
         def call():
-            return handle.remote(request).result(timeout_s=_HANDLE_TIMEOUT_S)
+            with tracing.activate_span(
+                    ctx3, f"HTTP {method} {path}", kind="server",
+                    attributes={"http.method": method, "http.path": path}):
+                return handle.remote(request).result(timeout_s=_HANDLE_TIMEOUT_S)
 
         try:
             resp = await loop.run_in_executor(self._pool, call)
             rbody = resp.get("body", b"")
+            reserved = ("content-length", "connection", "transfer-encoding")
+            if trace_headers:
+                # replace (never duplicate) an app-supplied traceparent with
+                # the ingress span's; with tracing off the app's survives
+                reserved += ("traceparent",)
             hdrs = [(k, v) for k, v in resp.get("headers", [])
-                    if k.lower() not in ("content-length", "connection",
-                                         "transfer-encoding")]
+                    if k.lower() not in reserved]
+            hdrs.extend(trace_headers or ())
             head = [f"HTTP/1.1 {resp.get('status', 200)} X"]
             for k, v in hdrs:
                 head.append(f"{k}: {v}")
@@ -372,7 +416,8 @@ class _AsyncProxy:
                          + bytes(rbody))
         except Exception as e:  # noqa: BLE001
             writer.write(self._response(
-                500, json.dumps({"error": str(e)}).encode()))
+                500, json.dumps({"error": str(e)}).encode(),
+                extra_headers=trace_headers))
         await writer.drain()
         return True
 

@@ -70,11 +70,18 @@ class ServeReplica:
                         f"deployment {self._deployment!r} instance is not callable")
             else:
                 target = getattr(self._callable, method_name)
-            out = target(*args, **kwargs)
-            if hasattr(out, "__await__"):
-                import asyncio
+            # child of the actor task's span (which chains to the proxy's
+            # ingress span via the TaskSpec trace context): user-callable
+            # time vs serve plumbing, separable on the trace
+            from ray_tpu.util import tracing
 
-                out = asyncio.run(_await_it(out))
+            with tracing.span(f"serve:{self._deployment}.{method_name}",
+                              kind="serve"):
+                out = target(*args, **kwargs)
+                if hasattr(out, "__await__"):
+                    import asyncio
+
+                    out = asyncio.run(_await_it(out))
             return out
         finally:
             with self._lock:
@@ -95,11 +102,15 @@ class ServeReplica:
                 target = self._callable
             else:
                 target = getattr(self._callable, method_name)
-            out = target(*args, **kwargs)
-            if hasattr(out, "__next__"):
-                yield from out
-            else:
-                yield out
+            from ray_tpu.util import tracing
+
+            with tracing.span(f"serve:{self._deployment}.{method_name}",
+                              kind="serve"):
+                out = target(*args, **kwargs)
+                if hasattr(out, "__next__"):
+                    yield from out
+                else:
+                    yield out
         finally:
             with self._lock:
                 self._ongoing -= 1

@@ -160,10 +160,31 @@ def _record_op(op: str, group, tensor, seconds: float):
         pass  # completed collective (the result is already computed)
 
 
+def _trace_op(op: str, group, tensor, seconds: float):
+    """Span child of the active trace (serve request / task / user span) —
+    per-op latency attribution on the causal timeline.  The guard is one
+    thread-local read, so untraced ops pay ~nothing."""
+    try:
+        from ray_tpu.util import tracing
+
+        if not tracing.context_active():
+            return
+        nbytes, dtype = _tensor_meta(tensor) if tensor is not None else (0, "")
+        end = time.time()
+        tracing.emit_span(
+            f"collective:{op}", end - seconds, end, kind="collective",
+            attributes={"world_size": group.world_size, "nbytes": nbytes,
+                        "dtype": dtype})
+    except Exception:  # noqa: BLE001 — telemetry must never fail an op
+        pass
+
+
 def _timed(op: str, group, tensor, fn):
     t0 = time.perf_counter()
     out = fn()
-    _record_op(op, group, tensor, time.perf_counter() - t0)
+    dt = time.perf_counter() - t0
+    _record_op(op, group, tensor, dt)
+    _trace_op(op, group, tensor, dt)
     return out
 
 
@@ -207,5 +228,7 @@ def recv(src_rank: int, group_name: str = "default"):
     g = _require_group(group_name)
     t0 = time.perf_counter()
     out = g.recv(src_rank)
-    _record_op("recv", g, out, time.perf_counter() - t0)
+    dt = time.perf_counter() - t0
+    _record_op("recv", g, out, dt)
+    _trace_op("recv", g, out, dt)
     return out

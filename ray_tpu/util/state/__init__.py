@@ -10,6 +10,7 @@ from ray_tpu.util.state.api import (
     jax_profile,
     dump_native_stacks,
     dump_stacks,
+    get_trace,
     node_metrics,
     node_stats,
     list_actors,
@@ -23,6 +24,7 @@ from ray_tpu.util.state.api import (
     record_event,
     summarize_actors,
     summarize_tasks,
+    summarize_trace,
 )
 
 __all__ = [
@@ -44,4 +46,6 @@ __all__ = [
     "record_event",
     "summarize_actors",
     "summarize_tasks",
+    "get_trace",
+    "summarize_trace",
 ]

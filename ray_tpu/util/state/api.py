@@ -83,10 +83,12 @@ class StateApiClient:
         rows = self._w.gcs.call("ListJobs", {}) or []
         return _apply_filters(rows, filters)[:limit]
 
-    def list_tasks(self, filters=None, limit: int = 10000) -> List[dict]:
-        """Latest state per (task_id, attempt), folded from the task-event log
-        (reference: GcsTaskManager)."""
-        events = self._w.gcs.call("ListTaskEvents", {"limit": 100000}) or []
+    @staticmethod
+    def _fold_task_events(events: List[dict]) -> List[dict]:
+        """Latest state per (task_id, attempt), folded from the task-event
+        log (reference: GcsTaskManager).  Per-attempt phase timestamps:
+        creation (owner SUBMITTED), queued/scheduled (raylet), start
+        (executor RUNNING), end (owner FINISHED/FAILED)."""
         folded: Dict[Tuple[str, int], dict] = {}
         for ev in events:
             key = (ev["task_id"], ev.get("attempt", 0))
@@ -100,15 +102,31 @@ class StateApiClient:
                     "actor_id": ev.get("actor_id"),
                     "state": None,
                     "creation_time": None,
+                    "queued_time": None,
+                    "scheduled_time": None,
                     "start_time": None,
                     "end_time": None,
                     "node_id": None,
                     "pid": None,
+                    "submit_pid": None,
+                    "submit_node_id": None,
                 },
             )
+            if ev.get("trace_id"):
+                row["trace_id"] = ev["trace_id"]
+                row["span_id"] = ev.get("span_id")
+                row["parent_span_id"] = ev.get("parent_span_id")
+            if ev.get("kind"):
+                row["kind"] = ev["kind"]
             state, t = ev["state"], ev["time"]
             if state == "SUBMITTED":
                 row["creation_time"] = t
+                row["submit_pid"] = ev.get("pid")
+                row["submit_node_id"] = ev.get("node_id")
+            elif state == "QUEUED":
+                row["queued_time"] = t
+            elif state == "SCHEDULED":
+                row["scheduled_time"] = t
             elif state == "RUNNING":
                 row["start_time"] = t
                 row["node_id"] = ev.get("node_id")
@@ -117,11 +135,180 @@ class StateApiClient:
                     row["attributes"] = ev["attributes"]
             elif state in ("FINISHED", "FAILED"):
                 row["end_time"] = t
-            order = {"SUBMITTED": 0, "RUNNING": 1, "FINISHED": 2, "FAILED": 2}
+            order = {"SUBMITTED": 0, "QUEUED": 1, "SCHEDULED": 2,
+                     "RUNNING": 3, "FINISHED": 4, "FAILED": 4}
             if row["state"] is None or order.get(state, 0) >= order.get(row["state"], 0):
                 row["state"] = state
-        rows = sorted(folded.values(), key=lambda r: (r["creation_time"] or 0))
+        return sorted(folded.values(),
+                      key=lambda r: (r["creation_time"] or r["start_time"] or 0))
+
+    def list_tasks(self, filters=None, limit: int = 10000) -> List[dict]:
+        """Latest state per (task_id, attempt), folded from the task-event log
+        (reference: GcsTaskManager)."""
+        events = self._w.gcs.call("ListTaskEvents", {"limit": 100000}) or []
+        rows = self._fold_task_events(events)
         return _apply_filters(rows, filters)[:limit]
+
+    # -- distributed traces (tentpole: util/tracing.py context) ---------
+
+    def get_trace(self, trace_id: str) -> List[dict]:
+        """Every span of one trace, folded per (span_id, attempt): task
+        spans carry phase timestamps (creation/queued/scheduled/start/end),
+        custom spans (tracing.span, collectives, engine phases) carry
+        start/end + kind."""
+        # flush this process's buffered span events first (like timeline()):
+        # a just-closed driver-side span must be queryable immediately
+        try:
+            self._w.flush_task_events()
+        except Exception:  # noqa: BLE001
+            pass
+        events = self._w.gcs.call(
+            "ListTaskEvents", {"limit": 100000, "trace_id": trace_id}) or []
+        rows = self._fold_task_events(events)
+        out = []
+        for r in rows:
+            if not r.get("span_id"):
+                continue
+            kind = r.get("kind")
+            if kind is None:
+                kind = "actor_task" if r.get("actor_id") else "task"
+            out.append({
+                "trace_id": trace_id,
+                "span_id": r["span_id"],
+                "parent_span_id": r.get("parent_span_id"),
+                "name": r.get("name"),
+                "kind": kind,
+                "attempt": r.get("attempt", 0),
+                "task_id": r.get("task_id"),
+                "state": r.get("state"),
+                "submitted": r.get("creation_time"),
+                "queued": r.get("queued_time"),
+                "scheduled": r.get("scheduled_time"),
+                "start": r.get("start_time"),
+                "end": r.get("end_time"),
+                "node_id": r.get("node_id"),
+                "pid": r.get("pid"),
+                # span payloads: collective bytes/world_size, engine
+                # active_slots/chunk, data num_rows
+                "attributes": r.get("attributes"),
+            })
+        return out
+
+    @staticmethod
+    def _span_begin(s: dict):
+        for k in ("submitted", "queued", "scheduled", "start"):
+            if s.get(k) is not None:
+                return s[k]
+        return None
+
+    @staticmethod
+    def _span_end(s: dict):
+        for k in ("end", "start", "scheduled", "queued", "submitted"):
+            if s.get(k) is not None:
+                return s[k]
+        return None
+
+    def summarize_trace(self, trace_id: str,
+                        spans: Optional[List[dict]] = None) -> dict:
+        """Critical-path walk of one trace.
+
+        From the root span, repeatedly descend into the latest-ending
+        child; a cursor sweeps wall-clock time once, so the per-phase
+        attribution (submit rpc / queueing / spawn+dispatch / execution /
+        collective) telescopes to exactly the root span's duration —
+        "where did this request's time go?".  Pass ``spans`` (a
+        ``get_trace`` result) to avoid re-fetching the event log.
+        """
+        from collections import defaultdict
+
+        if spans is None:
+            spans = self.get_trace(trace_id)
+        # latest attempt wins per span_id (retries reuse the span)
+        by_id: Dict[str, dict] = {}
+        for s in spans:
+            cur = by_id.get(s["span_id"])
+            if cur is None or s["attempt"] >= cur["attempt"]:
+                by_id[s["span_id"]] = s
+        if not by_id:
+            return {"trace_id": trace_id, "num_spans": 0,
+                    "wall_clock_s": 0.0, "phases_s": {}, "critical_path": []}
+        children = defaultdict(list)
+        for s in by_id.values():
+            parent = s.get("parent_span_id")
+            if parent and parent in by_id:
+                children[parent].append(s)
+        roots = [s for s in by_id.values()
+                 if not s.get("parent_span_id")
+                 or s["parent_span_id"] not in by_id]
+        root = min(roots, key=lambda s: self._span_begin(s) or float("inf"))
+        # partial traces (the bounded event sink can evict a trace's older
+        # RUNNING/SUBMITTED events while later ones survive) may leave the
+        # root — or every span — with no begin timestamp; anchor the walk
+        # at the earliest timestamp present instead of epoch 0
+        begins = [b for s in by_id.values()
+                  for b in (self._span_begin(s),) if b is not None]
+        if not begins:
+            return {"trace_id": trace_id, "num_spans": len(by_id),
+                    "wall_clock_s": 0.0, "phases_s": {}, "critical_path": [],
+                    "partial": True}
+
+        phases: Dict[str, float] = defaultdict(float)
+
+        def bucket_of(s: dict) -> str:
+            return "collective" if s.get("kind") == "collective" else "execution"
+
+        # build the latest-ending-child chain ITERATIVELY: a continuation-
+        # style trace can nest deeper than the interpreter recursion limit
+        path: List[dict] = [root]
+        seen = {root["span_id"]}
+        while True:
+            kids = children.get(path[-1]["span_id"]) or []
+            kid = max(kids, key=lambda c: self._span_end(c) or 0.0,
+                      default=None)
+            if kid is None or kid["span_id"] in seen:
+                break
+            path.append(kid)
+            seen.add(kid["span_id"])
+
+        begin = self._span_begin(root) or min(begins)
+        cursor = begin
+        # descend: each span's pre-execution phases, with the gap up to a
+        # child's begin charged to the PARENT's execution bucket
+        for i, s in enumerate(path):
+            if i > 0:
+                kb = self._span_begin(s)
+                if kb is not None and kb > cursor:
+                    phases[bucket_of(path[i - 1])] += kb - cursor
+                    cursor = kb
+            for phase, key in (("submit", "queued"),
+                               ("queueing", "scheduled"),
+                               ("spawn", "start")):
+                t = s.get(key)
+                if t is not None and t > cursor:
+                    phases[phase] += t - cursor
+                    cursor = t
+        # ascend: close each span leaf-first, charging the remainder to its
+        # own bucket — together the cursor sweeps [begin, finish] exactly
+        # once, so the phase sums telescope to the wall clock
+        for s in reversed(path):
+            e = self._span_end(s)
+            if e is not None and e > cursor:
+                phases[bucket_of(s)] += e - cursor
+                cursor = e
+        finish = cursor
+        return {
+            "trace_id": trace_id,
+            "num_spans": len(by_id),
+            "wall_clock_s": finish - begin,
+            "phases_s": dict(phases),
+            "critical_path": [
+                {"span_id": s["span_id"], "name": s.get("name"),
+                 "kind": s.get("kind"), "task_id": s.get("task_id"),
+                 "begin": self._span_begin(s), "end": self._span_end(s),
+                 "node_id": s.get("node_id"), "pid": s.get("pid")}
+                for s in path
+            ],
+        }
 
     # -- raylet-backed listings ----------------------------------------
 
@@ -299,6 +486,14 @@ def list_actors(filters=None, limit: int = 10000):
 
 def list_tasks(filters=None, limit: int = 10000):
     return _client().list_tasks(filters, limit)
+
+
+def get_trace(trace_id: str):
+    return _client().get_trace(trace_id)
+
+
+def summarize_trace(trace_id: str):
+    return _client().summarize_trace(trace_id)
 
 
 def list_objects(filters=None, limit: int = 10000):

@@ -263,6 +263,35 @@ def test_make_engine_factory(tiny_cfg):
                                     prefill_chunk=24))
 
 
+def test_prefill_table_width_covers_chunk_overhang(tiny_cfg, tiny_params):
+    """Regression (ISSUE 2 satellite): the fixed prefill table width must
+    cover the pow2 chunk bucket's overshoot.  At max_seq=992, bs=16,
+    chunk=256, a plen=897 prompt's final chunk (pos=768) buckets to 256
+    tokens and covers 65 blocks — past the old width
+    bucket_pow2(max_blocks_per_seq + 2) = 64, which raised a broadcast
+    ValueError mid-serve at the table-row write."""
+    from ray_tpu.llm.paged import (
+        _bucket_pow2,
+        _prefill_plan,
+        _prefill_table_width,
+    )
+
+    # the failing geometry, arithmetically: plan says 65 slots (cover+1),
+    # the old formula provided 64 (max_blocks_per_seq = ceil(992/16) = 62)
+    old_width = _bucket_pow2(62 + 2)
+    assert _prefill_plan(897, 0, 256, 16) + 1 > old_width
+    assert _prefill_table_width(992, 256, 16) >= _prefill_plan(897, 0, 256, 16) + 1
+
+    # end to end at the failing geometry: generation must not raise
+    eng = PagedJaxLLMEngine(
+        LLMConfig(model_config=tiny_cfg, max_batch_size=1, max_seq_len=992,
+                  block_size=16, prefill_chunk=256, num_blocks=96,
+                  enable_prefix_caching=False), params=tiny_params)
+    prompt = list(np.random.RandomState(0).randint(1, 255, size=897))
+    outs = eng.generate([prompt], _gen(max_new_tokens=2))
+    assert len(outs[0]) == 2
+
+
 def test_oversized_request_rejected(tiny_cfg, tiny_params):
     eng = PagedJaxLLMEngine(
         LLMConfig(model_config=tiny_cfg, max_batch_size=2, max_seq_len=128,

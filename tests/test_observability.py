@@ -198,6 +198,269 @@ def test_tracing_spans_on_timeline(ray_start_regular):
     assert any("heavy" in n for n in names)
 
 
+def _wait_trace(trace_id, want_names, timeout=15, phases=False):
+    """Poll the GCS trace sink until every span in ``want_names`` has
+    landed with an end timestamp (events flush asynchronously; with
+    ``phases=True`` also wait for the raylet's QUEUED/SCHEDULED events,
+    which ride the 0.2 s report tick)."""
+    from ray_tpu._private.worker import get_global_worker
+    from ray_tpu.util import state
+
+    get_global_worker().flush_task_events()
+    deadline = time.monotonic() + timeout
+    spans = []
+    while time.monotonic() < deadline:
+        spans = state.get_trace(trace_id)
+        by_name = {s["name"]: s for s in spans}
+        ok = all(n in by_name and by_name[n].get("end") is not None
+                 for n in want_names)
+        if ok and phases:
+            ok = all(by_name[n].get("queued") is not None
+                     and by_name[n].get("scheduled") is not None
+                     for n in want_names
+                     if by_name[n].get("submitted") is not None)
+        if ok:
+            return spans
+        time.sleep(0.1)
+    return spans
+
+
+def test_trace_nested_task_propagation(ray_start_regular):
+    """One trace_id spans driver span -> outer task -> nested inner task,
+    with parent/child span linkage and raylet phase timestamps."""
+    from ray_tpu.util import tracing
+
+    @ray_tpu.remote
+    def inner(x):
+        time.sleep(0.02)
+        return x + 1
+
+    @ray_tpu.remote
+    def outer(x):
+        return ray_tpu.get(inner.remote(x)) + 10
+
+    with tracing.span("request") as sp:
+        assert ray_tpu.get(outer.remote(1)) == 12
+    assert sp is not None and sp.trace_id
+
+    spans = _wait_trace(sp.trace_id, {"request", "outer", "inner"},
+                        phases=True)
+    by_name = {s["name"]: s for s in spans}
+    assert {"request", "outer", "inner"} <= set(by_name)
+    # every span shares ONE trace
+    assert all(s["trace_id"] == sp.trace_id for s in spans)
+    # causal chain: driver span -> outer -> inner
+    assert by_name["outer"]["parent_span_id"] == by_name["request"]["span_id"]
+    assert by_name["inner"]["parent_span_id"] == by_name["outer"]["span_id"]
+    # per-attempt phase timestamps: owner SUBMITTED, raylet QUEUED/SCHEDULED,
+    # executor RUNNING, owner FINISHED — in causal order
+    for name in ("outer", "inner"):
+        s = by_name[name]
+        assert s["submitted"] is not None
+        assert s["queued"] is not None and s["queued"] >= s["submitted"] - 1e-3
+        assert s["scheduled"] is not None and s["scheduled"] >= s["queued"] - 1e-3
+        assert s["start"] is not None and s["end"] is not None
+        assert s["end"] >= s["start"]
+
+
+def test_trace_actor_call_chaining(ray_start_regular):
+    """Actor method calls submitted inside a span chain under it."""
+    from ray_tpu.util import tracing
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self):
+            self.n += 1
+            return self.n
+
+    c = Counter.remote()
+    assert ray_tpu.get(c.bump.remote()) == 1  # warm (creation outside span)
+
+    with tracing.span("actor-request") as sp:
+        assert ray_tpu.get(c.bump.remote()) == 2
+
+    spans = _wait_trace(sp.trace_id, {"actor-request", "bump"})
+    by_name = {s["name"]: s for s in spans}
+    assert by_name["bump"]["parent_span_id"] == by_name["actor-request"]["span_id"]
+    assert by_name["bump"]["kind"] == "actor_task"
+    assert by_name["bump"]["trace_id"] == sp.trace_id
+
+
+def test_timeline_flow_events_pair_submit_to_execute(ray_start_regular):
+    """timeline() emits matched ph:"s"/"f" flow events linking each submit
+    slice (driver pid) to its execute slice (worker pid)."""
+
+    @ray_tpu.remote
+    def f():
+        time.sleep(0.02)
+        return 1
+
+    ray_tpu.get([f.remote() for _ in range(3)])
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        events = ray_tpu.timeline()
+        flows = [e for e in events if e.get("cat") == "task_flow"]
+        starts = {e["id"]: e for e in flows if e["ph"] == "s"}
+        finishes = {e["id"]: e for e in flows if e["ph"] == "f"}
+        if len(set(starts) & set(finishes)) >= 3:
+            break
+        time.sleep(0.1)
+    matched = set(starts) & set(finishes)
+    assert len(matched) >= 3
+    exec_slices = {(e["pid"], e["tid"]): e for e in events
+                   if e.get("cat") in ("task", "actor_task")}
+    submit_slices = [e for e in events if e.get("cat") == "task_submit"]
+    assert submit_slices, "driver-side submit slices missing"
+    for fid in matched:
+        s, fin = starts[fid], finishes[fid]
+        # every "f" lands on a real execute slice's (pid, tid) row and
+        # never before its paired "s" (the arrow points forward in time)
+        assert (fin["pid"], fin["tid"]) in exec_slices
+        assert fin["ts"] >= s["ts"]
+        # the "s" sits on a different process row than the "f" (driver vs
+        # worker) — the cross-pid link is the point
+        assert (s["pid"], s["tid"]) != (fin["pid"], fin["tid"])
+
+
+def test_summarize_trace_critical_path(ray_start_regular):
+    """The critical-path walk attributes the root span's entire duration
+    to phases: their sum must be within 5% of the trace wall clock."""
+    from ray_tpu.util import state, tracing
+
+    @ray_tpu.remote
+    def leaf():
+        time.sleep(0.05)
+        return 1
+
+    @ray_tpu.remote
+    def mid():
+        return ray_tpu.get(leaf.remote()) + 1
+
+    with tracing.span("root") as sp:
+        assert ray_tpu.get(mid.remote()) == 2
+
+    _wait_trace(sp.trace_id, {"root", "mid", "leaf"})
+    summ = state.summarize_trace(sp.trace_id)
+    assert summ["num_spans"] >= 3
+    names = [s["name"] for s in summ["critical_path"]]
+    assert names[0] == "root"
+    assert "mid" in names and "leaf" in names
+    wall = summ["wall_clock_s"]
+    assert wall > 0
+    total = sum(summ["phases_s"].values())
+    assert abs(total - wall) <= 0.05 * wall, (total, wall, summ["phases_s"])
+    # the nested sleeps are execution time on the critical path
+    assert summ["phases_s"].get("execution", 0.0) >= 0.04
+
+
+def test_serve_traceparent_roundtrip(ray_start_regular):
+    """The HTTP proxy ingests a W3C traceparent, reports the request span
+    back in the response header, and the replica handler chains into the
+    same trace."""
+    import json
+    import urllib.request
+
+    from ray_tpu import serve
+    from ray_tpu.util import tracing
+
+    try:
+
+        @serve.deployment
+        def echo(payload):
+            return {"got": payload}
+
+        handle = serve.run(echo.bind(), name="traced-app")
+        host, port = serve.start_http_proxy(port=0)
+        serve.add_route("/traced", handle)
+
+        trace_id = tracing.new_trace_id()
+        parent = tracing.new_span_id()
+        req = urllib.request.Request(
+            f"http://{host}:{port}/traced",
+            data=json.dumps({"a": 1}).encode(),
+            headers={"Content-Type": "application/json",
+                     "traceparent": f"00-{trace_id}-{parent}-01"})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            body = json.loads(resp.read())
+            tp = resp.headers.get("traceparent")
+        assert body == {"got": {"a": 1}}
+        parsed = tracing.parse_traceparent(tp)
+        assert parsed is not None and parsed[0] == trace_id
+
+        spans = _wait_trace(trace_id, set())
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            spans = _wait_trace(trace_id, set(), timeout=0.1)
+            if (any(s["name"].startswith("HTTP") for s in spans)
+                    and any(s["name"].startswith("serve:") for s in spans)):
+                break
+            time.sleep(0.1)
+        http = [s for s in spans if s["name"].startswith("HTTP")]
+        assert http, [s["name"] for s in spans]
+        # the ingress span continues the EXTERNAL trace under its parent
+        assert http[0]["parent_span_id"] == parent
+        assert http[0]["span_id"] == parsed[1]
+        assert any(s["name"].startswith("serve:") for s in spans)
+    finally:
+        serve.shutdown()
+
+
+def test_tracing_disabled_specs_carry_no_context(ray_start_regular):
+    """tracing_enabled=False: submissions stamp no trace ids and span()
+    records nothing (the near-zero fast path of the overhead bench)."""
+    from ray_tpu._private.config import global_config
+    from ray_tpu._private.worker import get_global_worker
+    from ray_tpu.util import tracing
+
+    cfg = global_config()
+    cfg.tracing_enabled = False
+    try:
+        with tracing.span("invisible") as sp:
+            assert sp is None
+            assert tracing.capture_for_submit() == (None, None, None)
+        w = get_global_worker()
+        assert not any(e.get("name") == "invisible" for e in w._task_events)
+    finally:
+        cfg.tracing_enabled = True
+
+
+def test_dashboard_trace_endpoint(ray_start_regular):
+    """/api/trace/<id> serves the spans + critical-path summary."""
+    import json
+    import urllib.request
+
+    from ray_tpu.dashboard.head import start_dashboard
+    from ray_tpu.util import tracing
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    with tracing.span("dash-root") as sp:
+        assert ray_tpu.get(f.remote()) == 1
+    _wait_trace(sp.trace_id, {"dash-root", "f"})
+
+    d = start_dashboard(port=0)
+    try:
+        data = json.loads(urllib.request.urlopen(
+            d.url + f"/api/trace/{sp.trace_id}", timeout=10).read())
+        assert data["trace_id"] == sp.trace_id
+        names = {s["name"] for s in data["spans"]}
+        assert {"dash-root", "f"} <= names
+        assert data["summary"]["num_spans"] >= 2
+    finally:
+        d.shutdown()
+        # the head is a process-wide singleton: clear it so later tests
+        # (test_dashboard_web_ui) start a fresh one instead of reusing a
+        # shut-down server
+        import ray_tpu.dashboard.head as _head
+
+        _head._dashboard = None
+
+
 def test_dashboard_web_ui(ray_start_regular):
     """The head serves the zero-build UI at / (reference: dashboard/client/
     React app; here a single static page over the same JSON endpoints)."""

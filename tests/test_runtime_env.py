@@ -223,3 +223,114 @@ def test_uv_validate_only_fallback(ray_start_regular):
 
     assert ray_tpu.get(
         ok.options(runtime_env={"uv": ["numpy"]}).remote(), timeout=180)
+
+
+# -- materialize_uv_env publish-race repair (ISSUE 2 satellite) -------------
+# Clusterless unit tests: fake the uv subprocess and force the atomic
+# rename to lose against a simulated concurrent build.
+
+
+class _FakeProc:
+    def __init__(self, returncode=0):
+        self.returncode = returncode
+        self.stdout = ""
+        self.stderr = "fake uv failure" if returncode else ""
+
+
+def _patch_uv(monkeypatch, install_rc=0, on_install=None):
+    """Fake `uv venv` / `uv pip install`; both run instantly."""
+    import subprocess
+
+    def fake_run(cmd, **kw):
+        if "venv" in cmd:
+            return _FakeProc(0)
+        if on_install is not None:
+            on_install()
+        if kw.get("check") and install_rc:
+            raise subprocess.CalledProcessError(install_rc, cmd)
+        return _FakeProc(install_rc)
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+
+
+def test_uv_publish_race_validate_only_winner(monkeypatch):
+    """A successful build losing the rename race to a concurrent
+    .validate_only publish must return '' (the winner's verdict: the
+    baked image satisfies the pins) — NOT a site dir with no packages."""
+    import os
+    import uuid
+
+    from ray_tpu._private import runtime_env as renv
+
+    _patch_uv(monkeypatch, install_rc=0)
+    real_rename = os.rename
+
+    def losing_rename(src, dst):
+        if os.path.basename(os.path.dirname(dst)) == "ray_tpu_uv_envs":
+            # simulate the peer publishing first: dest appears with the
+            # validate-only marker, then our rename fails
+            os.makedirs(dst, exist_ok=True)
+            open(os.path.join(dst, ".validate_only"), "w").close()
+            raise OSError("dest exists")
+        return real_rename(src, dst)
+
+    monkeypatch.setattr(os, "rename", losing_rename)
+    out = renv.materialize_uv_env(
+        {"packages": [f"fakepkg-{uuid.uuid4().hex}==1.0"]})
+    assert out == ""
+
+
+def test_uv_publish_race_ready_winner(monkeypatch):
+    """Losing the rename race to a peer's .ready publish adopts the
+    peer's venv site dir."""
+    import os
+    import sys
+    import uuid
+
+    from ray_tpu._private import runtime_env as renv
+
+    _patch_uv(monkeypatch, install_rc=0)
+    real_rename = os.rename
+
+    def losing_rename(src, dst):
+        if os.path.basename(os.path.dirname(dst)) == "ray_tpu_uv_envs":
+            os.makedirs(dst, exist_ok=True)
+            open(os.path.join(dst, ".ready"), "w").close()
+            raise OSError("dest exists")
+        return real_rename(src, dst)
+
+    monkeypatch.setattr(os, "rename", losing_rename)
+    out = renv.materialize_uv_env(
+        {"packages": [f"fakepkg-{uuid.uuid4().hex}==1.0"]})
+    v = f"python{sys.version_info.major}.{sys.version_info.minor}"
+    assert out.endswith(os.path.join("lib", v, "site-packages"))
+    assert os.path.exists(os.path.join(os.path.dirname(
+        os.path.dirname(os.path.dirname(out))), ".ready"))
+
+
+def test_uv_install_failure_adopts_peer_ready(monkeypatch):
+    """An install failure must not raise when a peer already published
+    .ready for the same env — the peer's venv is used instead."""
+    import hashlib
+    import json as _json
+    import os
+    import tempfile
+    import uuid
+
+    from ray_tpu._private import runtime_env as renv
+
+    packages = [f"fakepkg-{uuid.uuid4().hex}==1.0"]
+    key = hashlib.sha1(_json.dumps(
+        {"packages": packages, "find_links": None},
+        sort_keys=True).encode()).hexdigest()[:16]
+    dest = os.path.join(tempfile.gettempdir(), "ray_tpu_uv_envs", key)
+
+    def peer_publishes():
+        # the peer lands .ready between our initial check and the failure
+        os.makedirs(dest, exist_ok=True)
+        open(os.path.join(dest, ".ready"), "w").close()
+
+    _patch_uv(monkeypatch, install_rc=1, on_install=peer_publishes)
+    out = renv.materialize_uv_env({"packages": packages})
+    assert out and ".ready" not in out
+    assert os.path.exists(os.path.join(dest, ".ready"))
