@@ -66,11 +66,21 @@ def _bench_allreduce(on_tpu: bool) -> dict:
     devices > 1 (the real multichip figure lives in MULTICHIP_r*.json)."""
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     try:
-        from benchmarks.allreduce_bench import bench_mesh
+        from benchmarks.allreduce_bench import bench_mesh, bench_mesh_compressed
 
         size_mb = 64 if on_tpu else 1
         res = bench_mesh([size_mb], iters=10 if on_tpu else 3)[0]
         out = {"bytes": res["bytes"], "devices": res["devices"]}
+        try:
+            # compressed-collective probe (PR 3): the EQuARX int8 two-phase
+            # program at the same size — effective busbw + wire reduction
+            qres = bench_mesh_compressed([max(size_mb, 4)], "int8",
+                                         iters=5 if on_tpu else 3)[0]
+            out["int8"] = {k: qres[k] for k in
+                           ("value", "bytes", "wire_bytes",
+                            "wire_reduction_x", "rel_error") if k in qres}
+        except Exception as e:  # noqa: BLE001
+            out["int8"] = {"error": str(e)[:200]}
         if res["devices"] > 1:
             out["busbw_gbps"] = res["value"]
             if on_tpu:
@@ -792,6 +802,18 @@ def _collective_metrics_snapshot() -> dict:
         return {"error": str(e)[:200]}
 
 
+def _compression_snapshot() -> dict:
+    """Compressed-collective accounting recorded during the benches (see
+    runtime_metrics.compression_snapshot): logical vs wire byte totals,
+    savings ratio, last quant error per op/algorithm/scheme/group."""
+    try:
+        from ray_tpu._private import runtime_metrics
+
+        return runtime_metrics.compression_snapshot()
+    except Exception as e:  # noqa: BLE001
+        return {"error": str(e)[:200]}
+
+
 def _probe_backend(timeout_s: float = 240.0):
     """Resolve the backend and run one tiny op under a watchdog.
 
@@ -894,6 +916,7 @@ def main():
             # (per-op bytes / mean latency / derived bus bandwidth), so
             # BENCH_*.json carries bandwidth numbers without extra plumbing
             "collective_metrics": _collective_metrics_snapshot(),
+            "compressed_collective": _compression_snapshot(),
             "trace_summary": _trace_summary_snapshot(),
         },
     }

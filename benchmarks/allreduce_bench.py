@@ -10,6 +10,12 @@ Two modes:
 - ``--mode group``: drives the ray_tpu.util.collective API across actor
   ranks (the reference library's shape), exercising the store/xla backends.
 
+``--compression bf16,int8,hier,hier_int8`` sweeps the compressed-collective
+programs (util/collective/compression.py) over the same devices: bf16 is
+the stock psum, int8 the EQuARX-style two-phase quantized allreduce, hier
+the two-level (slice,intra) algorithm, hier_int8 both.  Compressed rows
+carry wire vs logical bytes and the reduction ratio alongside busbw.
+
 Prints one JSON line per size:
   {"metric": "allreduce_busbw", "bytes": N, "value": GB/s, ...}
 busbw uses the standard ring formula 2*(n-1)/n * size / time.
@@ -75,6 +81,94 @@ def bench_mesh(sizes_mb, dtype_name="bfloat16", iters=20):
     return results
 
 
+def bench_mesh_compressed(sizes_mb, variant="int8", iters=10, block_size=256):
+    """Compressed-collective sweep over all local devices: each device is
+    one 'rank' contributing a per-rank payload of the given size.
+
+    variant: "int8" (flat EQuARX two-phase), "hier" (hierarchical, no
+    codec), "hier_int8" (hierarchical with the int8 DCN phase).  Reported
+    busbw is EFFECTIVE (logical bytes / time) so rows compare directly
+    against the bf16 rows; wire_bytes tracks what the transport carried.
+    """
+    import numpy as np
+
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from ray_tpu._private import runtime_metrics
+    from ray_tpu.util.collective import compression as comp
+    from ray_tpu.util.collective.collective_group import xla_group as xg
+
+    devices = jax.devices()
+    world = len(devices)
+    results = []
+    hier = variant.startswith("hier")
+    quant = variant.endswith("int8")
+    scheme = comp.SCHEME_INT8 if quant else comp.SCHEME_NONE
+    nslices = 2 if (hier and world % 2 == 0 and world >= 4) else 1
+    if hier and nslices == 1:
+        return [{"metric": "allreduce_busbw", "mode": "mesh",
+                 "compression": variant,
+                 "error": f"{world} devices cannot split into slices"}]
+    for mb in sizes_mb:
+        per_rank = int(mb * 2**20 / 4)  # f32 elements per rank
+        granule = world * block_size
+        per_rank -= per_rank % granule
+        rows = [np.random.default_rng(r).standard_normal(per_rank)
+                .astype(np.float32) for r in range(world)]
+        logical = per_rank * 4
+        if hier:
+            ss = world // nslices
+            mesh2 = Mesh(np.array(devices).reshape(nslices, ss),
+                         ("slice", "intra"))
+            fn = xg.build_hierarchical_allreduce(
+                mesh2, nslices, ss, scheme, block_size)
+            garr = jax.device_put(
+                np.stack(rows).reshape(nslices, ss, per_rank),
+                NamedSharding(mesh2, P("slice", "intra")))
+            args = (garr,)
+            wire, inter = comp.estimate_wire_bytes(
+                "hierarchical", scheme, logical, world, ss, block_size)
+        else:
+            mesh = Mesh(np.array(devices), ("world",))
+            fn = xg.build_quantized_allreduce(mesh, "world", world, block_size)
+            pairs = [comp.quantize_blocks(r, block_size) for r in rows]
+            sharding = NamedSharding(mesh, P("world"))
+            garr_c = jax.device_put(np.stack([p[0] for p in pairs]), sharding)
+            garr_s = jax.device_put(np.stack([p[1] for p in pairs]), sharding)
+            args = (garr_c, garr_s)
+            wire, inter = comp.estimate_wire_bytes(
+                "flat", scheme, logical, world, block_size=block_size)
+        out = fn(*args)
+        out.block_until_ready()  # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        out.block_until_ready()
+        dt = (time.perf_counter() - t0) / iters
+        busbw = (2 * (world - 1) / max(world, 1)) * logical / dt
+        # quality figure: reduced output vs exact f32 sum
+        exact = np.sum(np.stack(rows), axis=0)
+        rel = comp.relative_error(exact, np.asarray(out)[:per_rank])
+        runtime_metrics.record_collective_compression(
+            "allreduce", "xla_mesh", world, "bench", logical, int(wire),
+            "hierarchical" if hier else "flat", scheme, rel, int(inter))
+        results.append({
+            "metric": "allreduce_busbw",
+            "mode": "mesh",
+            "compression": variant,
+            "devices": world,
+            "bytes": logical,
+            "wire_bytes": int(wire),
+            "wire_reduction_x": round(logical / wire, 3) if wire else None,
+            "rel_error": round(rel, 6),
+            "time_s": round(dt, 6),
+            "value": round(busbw / 1e9, 3),
+            "unit": "GB/s",
+        })
+    return results
+
+
 def bench_group(sizes_mb, world_size=2, iters=5):
     """Collective-library mode: actor ranks allreduce numpy arrays through
     ray_tpu.util.collective (store backend off-TPU)."""
@@ -130,10 +224,20 @@ def main(argv=None):
     p.add_argument("--sizes-mb", default="1,8,64")
     p.add_argument("--iters", type=int, default=20)
     p.add_argument("--world-size", type=int, default=2)
+    p.add_argument("--compression", default="bf16",
+                   help="comma list of bf16,int8,hier,hier_int8 (mesh mode)")
     args = p.parse_args(argv)
     sizes = [float(s) for s in args.sizes_mb.split(",")]
     if args.mode == "mesh":
-        results = bench_mesh(sizes, iters=args.iters)
+        results = []
+        for variant in [v.strip() for v in args.compression.split(",") if v.strip()]:
+            if variant == "bf16":
+                results += bench_mesh(sizes, iters=args.iters)
+            elif variant in ("int8", "hier", "hier_int8"):
+                results += bench_mesh_compressed(sizes, variant,
+                                                 iters=args.iters)
+            else:
+                raise SystemExit(f"unknown --compression variant {variant!r}")
     else:
         import ray_tpu
 

@@ -29,7 +29,8 @@ from typing import Any, Dict, Optional
 _KV_PREFIX = "kv://"
 
 
-_SUPPORTED = ("env_vars", "py_modules", "working_dir", "pip", "uv")
+_SUPPORTED = ("env_vars", "py_modules", "working_dir", "pip", "uv",
+              "worker_process_setup_hook")
 
 
 def normalize(runtime_env: Optional[dict]) -> Optional[dict]:
@@ -76,6 +77,16 @@ def normalize(runtime_env: Optional[dict]) -> Optional[dict]:
         if find_links:
             spec["find_links"] = str(find_links)
         out["uv"] = spec
+    hook = out.get("worker_process_setup_hook")
+    if hook is not None and not (
+            callable(hook)
+            or (isinstance(hook, dict) and hook.get("kv"))
+            or (isinstance(hook, str) and hook.startswith(_KV_PREFIX))):
+        raise ValueError(
+            "runtime_env['worker_process_setup_hook'] must be a callable "
+            "(it is shipped through the function registry and run once per "
+            "worker before its first task), or an already-packaged kv:// "
+            "function URI")
     return out or None
 
 
@@ -337,7 +348,26 @@ def package(worker, runtime_env: Optional[dict]) -> Optional[dict]:
     wd = out.get("working_dir")
     if wd and not str(wd).startswith(_KV_PREFIX):
         out["working_dir"] = upload(wd)
+    hook = out.get("worker_process_setup_hook")
+    if callable(hook):
+        # Ship the callable through the function registry (the same fn:<sha>
+        # KV namespace task functions use), so the spawned worker fetches it
+        # once and the env stays a JSON-serializable pool key (the raylet
+        # hashes it and exports it via RAY_TPU_RUNTIME_ENV).
+        out["worker_process_setup_hook"] = {
+            "kv": _KV_PREFIX + publish_setup_hook(worker, hook)}
     return out
+
+
+def publish_setup_hook(worker, hook) -> str:
+    """Serialize + publish a setup-hook callable; returns its fn:<sha> key."""
+    from ray_tpu._private import serialization
+
+    blob = serialization.dumps_inline(hook)
+    key = f"fn:{hashlib.sha1(blob).hexdigest()}"
+    if not worker.gcs.call("KVExists", {"key": key}):
+        worker.gcs.call("KVPut", {"key": key, "value": blob})
+    return key
 
 
 def _materialize(gcs_client, uri: str) -> str:
@@ -401,3 +431,24 @@ def apply_in_worker(gcs_client, runtime_env: Optional[dict]):
                   else root)
         sys.path.insert(0, target)
         os.chdir(target)
+    hook = runtime_env.get("worker_process_setup_hook")
+    if hook:
+        # Runs ONCE per worker process, after every other env field is in
+        # place (env_vars exported, py_modules/working_dir on sys.path) and
+        # BEFORE the worker registers for its first task (reference:
+        # ray.init(runtime_env={"worker_process_setup_hook": fn}) —
+        # _private/runtime_env/setup_hook.py ships the callable via the
+        # function manager).  A raising hook fails worker setup loudly, so
+        # leases surface the error instead of running half-configured.
+        from ray_tpu._private import serialization
+
+        if callable(hook):
+            fn = hook  # same-process application (driver-mode envs, tests)
+        else:
+            uri = hook["kv"] if isinstance(hook, dict) else hook
+            blob = gcs_client.call("KVGet", {"key": uri[len(_KV_PREFIX):]})
+            if blob is None:
+                raise RuntimeError(
+                    f"worker_process_setup_hook {uri} not found in GCS KV")
+            fn = serialization.loads_inline(blob)
+        fn()

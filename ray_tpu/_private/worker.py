@@ -24,6 +24,7 @@ import tempfile
 import threading
 import time
 import traceback
+import weakref
 from collections import defaultdict, deque
 from ray_tpu._private.utils import DaemonExecutor
 from typing import Any, Dict, List, Optional, Set, Tuple
@@ -52,6 +53,18 @@ logger = logging.getLogger(__name__)
 
 DRIVER = "driver"
 WORKER = "worker"
+
+# content digests of worker_process_setup_hook callables, memoized per live
+# object (see _package_runtime_env)
+_setup_hook_digests: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def _weakrefable(obj) -> bool:
+    try:
+        weakref.ref(obj)
+        return True
+    except TypeError:
+        return False
 
 
 def _picklable_error(e: BaseException) -> BaseException:
@@ -1119,7 +1132,27 @@ class CoreWorker:
                 [normalized["working_dir"]] if normalized.get("working_dir") else []):
             if not str(path).startswith("kv://"):
                 fingerprints.append(renv.path_fingerprint(str(path)))
-        cache_key = (renv.env_hash(normalized), tuple(fingerprints))
+        hook = normalized.get("worker_process_setup_hook")
+        if callable(hook):
+            # identify the callable by its pickled content, not its repr
+            # (json default=str embeds the object address — two different
+            # hooks could collide after GC address reuse); drop the live
+            # object from the hashed dict for the same reason.  The digest
+            # is memoized per live object (weak, so GC'd hooks free their
+            # entry and address reuse can't alias) — re-pickling the hook
+            # on every submit would put tens of µs on the hot submit path.
+            digest = _setup_hook_digests.get(hook) if _weakrefable(hook) else None
+            if digest is None:
+                digest = hashlib.sha1(
+                    serialization.dumps_inline(hook)).hexdigest()[:16]
+                if _weakrefable(hook):
+                    _setup_hook_digests[hook] = digest
+            fingerprints.append(digest)
+            hashed = {k: v for k, v in normalized.items()
+                      if k != "worker_process_setup_hook"}
+        else:
+            hashed = normalized
+        cache_key = (renv.env_hash(hashed), tuple(fingerprints))
         cached = self._runtime_env_cache.get(cache_key)
         if cached is None:
             cached = self._runtime_env_cache[cache_key] = renv.package(self, normalized)

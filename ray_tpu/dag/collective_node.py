@@ -28,22 +28,24 @@ _group_counter = itertools.count()
 _groups_created = set()
 
 
-def _interp_allreduce(instance, group_name, op, tensor):
+def _interp_allreduce(instance, group_name, op, compression, tensor):
     """Hidden actor task used by interpreted-mode collective nodes."""
     from ray_tpu.util import collective as col
 
-    return col.allreduce(tensor, group_name=group_name, op=op)
+    return col.allreduce(tensor, group_name=group_name, op=op,
+                         compression=compression)
 
 
 class CollectiveOutputNode(ClassMethodNode):
     """The post-allreduce value on ONE participating actor."""
 
     def __init__(self, upstream: ClassMethodNode, group_name: str,
-                 op: ReduceOp, group_spec):
+                 op: ReduceOp, group_spec, compression=None):
         super().__init__(upstream._actor_handle, "__collective_allreduce__",
                          (upstream,), {})
         self._collective = (group_name, op)
         self._collective_group_spec = group_spec
+        self._collective_compression = compression
 
     def _execute_impl(self, cache, input_value):
         # Interpreted mode: lazily rendezvous the group, then run the op as a
@@ -61,12 +63,17 @@ class CollectiveOutputNode(ClassMethodNode):
             _groups_created.add(group_name)
         upstream_ref = cache[self._bound_args[0]._stable_uuid]
         return ActorMethod(self._actor_handle, "__ray_tpu_call__").remote(
-            _interp_allreduce, group_name, op, upstream_ref)
+            _interp_allreduce, group_name, op,
+            self._collective_compression, upstream_ref)
 
 
 class _AllReduce:
     def bind(self, nodes: List[DAGNode], op: ReduceOp = ReduceOp.SUM,
-             backend: str = "store") -> List[CollectiveOutputNode]:
+             backend: str = "store",
+             compression=None) -> List[CollectiveOutputNode]:
+        """``compression`` ('int8' / dict / CompressionSpec) rides every
+        participant's allreduce call — gradient-sync DAGs opt into the
+        quantized wire without touching actor code."""
         if not nodes or not all(isinstance(n, ClassMethodNode) for n in nodes):
             raise TypeError("allreduce.bind takes a list of actor-method nodes")
         handles = [n._actor_handle for n in nodes]
@@ -74,7 +81,8 @@ class _AllReduce:
             raise ValueError("allreduce participants must be distinct actors")
         group_name = f"__dag_allreduce_{next(_group_counter)}"
         spec = (handles, backend)
-        return [CollectiveOutputNode(n, group_name, op, spec) for n in nodes]
+        return [CollectiveOutputNode(n, group_name, op, spec, compression)
+                for n in nodes]
 
 
 allreduce = _AllReduce()

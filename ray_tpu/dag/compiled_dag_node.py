@@ -133,7 +133,8 @@ def _compiled_dag_actor_loop(instance, program):
                                 op["method"])
                         else:
                             result = col.allreduce(args[0], group_name=group_name,
-                                                   op=col_op)
+                                                   op=col_op,
+                                                   compression=op.get("compression"))
                     elif err is not None:
                         result = err
                     else:
@@ -341,7 +342,8 @@ class CompiledDAG:
                 return new_chan()
             ch = XlaTensorChannel(
                 group_name=f"dag-p2p-{up_node._stable_uuid}-{len(self._channels)}",
-                backend=transport, capacity=self._buffer)
+                backend=transport, capacity=self._buffer,
+                compression=getattr(up_node, "_tensor_compression", None))
             self._channels.append(ch)
             return ch
 
@@ -418,6 +420,7 @@ class CompiledDAG:
                     "kwargs": {kk: spec_of(v) for kk, v in n._bound_kwargs.items()},
                     "sends": sends,
                     "collective": getattr(n, "_collective", None),
+                    "compression": getattr(n, "_collective_compression", None),
                 }
                 # deterministic recv order within an op = producer topo order
                 pre_recvs.sort(key=lambda s: -1 if s["key"] == "__input__"

@@ -30,17 +30,31 @@ class DAGNode:
         self._bound_kwargs = dict(kwargs or {})
         self._stable_uuid = next(_node_counter)
         self._tensor_transport: Optional[str] = None
+        self._tensor_compression = None
 
-    def with_tensor_transport(self, transport: str = "auto") -> "DAGNode":
+    def with_tensor_transport(self, transport: str = "auto",
+                              compression=None) -> "DAGNode":
         """Move this node's output to downstream DAG actors through the
         device-tensor channel: array leaves ride the registered Communicator
         (xla/ICI on TPU, store off-TPU), structure rides shm (reference:
         with_tensor_transport / TorchTensorType type hints ->
         torch_tensor_accelerator_channel.py). transport: "auto" | "xla" |
-        "store" | "shm" ("shm" = plain shared-memory channel)."""
+        "store" | "shm" ("shm" = plain shared-memory channel).
+
+        ``compression`` ('int8' / dict / CompressionSpec) is a LOSSY opt-in:
+        large float leaves on this edge travel as block-quantized int8
+        codes + scales (collective-layer codec); small/integer leaves and
+        the structure always go full-precision."""
         if transport not in ("auto", "xla", "store", "shm"):
             raise ValueError(f"unknown tensor transport {transport!r}")
+        if compression is not None and transport == "shm":
+            # validate BEFORE assigning: a caught error must not leave the
+            # node half-switched onto the shm channel
+            raise ValueError(
+                "tensor compression requires a device-tensor transport "
+                "(auto/xla/store), not the plain shm channel")
         self._tensor_transport = None if transport == "shm" else transport
+        self._tensor_compression = compression
         return self
 
     # -- graph introspection ------------------------------------------------

@@ -95,7 +95,8 @@ class XlaTensorChannel:
 
     def __init__(self, group_name: str, backend: str = "auto",
                  capacity: Optional[int] = None,
-                 _meta: Optional[ShmChannel] = None):
+                 _meta: Optional[ShmChannel] = None,
+                 compression=None):
         self._group = group_name
         self._backend = backend
         self._meta = _meta or ShmChannel(
@@ -103,10 +104,20 @@ class XlaTensorChannel:
         self._comm = None
         self._role: Optional[int] = None
         self._comm_lock = threading.Lock()
+        # LOSSY opt-in: large float array leaves travel as int8 codes +
+        # per-block scales (same codec as the collective layer); None =
+        # full-precision transfers (the stock path, byte-identical).
+        from ray_tpu.util.collective import compression as comp
+
+        self._compression = comp.resolve_spec(compression)
+        if self._compression is not None and \
+                self._compression.scheme == comp.SCHEME_NONE:
+            self._compression = None
 
     # channels travel by value descriptor, like ShmChannel
     def __reduce__(self):
-        return (XlaTensorChannel, (self._group, self._backend, None, self._meta))
+        return (XlaTensorChannel, (self._group, self._backend, None,
+                                   self._meta, self._compression))
 
     @property
     def name(self):
@@ -128,13 +139,48 @@ class XlaTensorChannel:
     # -- writer -------------------------------------------------------------
 
     def write(self, value: Any, timeout: Optional[float] = None):
+        from ray_tpu.util.collective import compression as comp
+
         structure, arrays = _split_arrays(value)
-        # metadata first: the reader learns how many arrays to receive
-        self._meta.write((structure, len(arrays)), timeout)
-        if arrays:
+        spec = self._compression
+        # per-leaf quantization plan: (shape, dtype_str) for leaves going
+        # compressed, None for full-precision leaves
+        qinfos = [None] * len(arrays)
+        payloads: list = []
+        for i, arr in enumerate(arrays):
+            if (spec is not None and comp.is_float_dtype(arr.dtype)
+                    and arr.nbytes >= spec.min_bytes):
+                codes, scales = comp.quantize_blocks(arr, spec.block_size)
+                qinfos[i] = (arr.shape, arr.dtype.name, spec.block_size)
+                payloads.append((codes, scales))
+                self._record_wire(arr.nbytes, comp.wire_nbytes(codes, scales))
+            else:
+                payloads.append(arr)
+        # metadata first: the reader learns how many arrays to receive and
+        # which of them arrive quantized
+        self._meta.write((structure, len(arrays), qinfos), timeout)
+        if payloads:
             comm = self._communicator(self.WRITER)
-            for arr in arrays:
-                comm.send(arr, self.READER)
+            for qi, payload in zip(qinfos, payloads):
+                if qi is None:
+                    comm.send(payload, self.READER)
+                else:
+                    comm.send(payload[0], self.READER)  # int8 codes
+                    comm.send(payload[1], self.READER)  # f32 scales
+
+    def _record_wire(self, logical: int, wire: int):
+        try:
+            from ray_tpu._private import runtime_metrics
+
+            # quant_error=-1: the writer never dequantizes its own payload,
+            # so the round-trip error is unmeasured here (the sentinel
+            # suppresses the gauge rather than asserting a lossy transfer
+            # was exact)
+            runtime_metrics.record_collective_compression(
+                "channel", self._backend, 2, self._group, int(logical),
+                int(wire), "flat", "int8", quant_error=-1.0)
+        except Exception:  # noqa: BLE001 — telemetry must never fail a write
+            pass
 
     # -- reader -------------------------------------------------------------
 
@@ -142,11 +188,26 @@ class XlaTensorChannel:
         self._meta.register_reader(idx)
 
     def read(self, timeout: Optional[float] = None) -> Any:
-        structure, n = self._meta.read(timeout)
+        from ray_tpu.util.collective import compression as comp
+
+        structure, n, qinfos = self._meta.read(timeout)
         if not n:
             return structure
         comm = self._communicator(self.READER)
-        arrays = [comm.recv(self.WRITER) for _ in range(n)]
+        arrays = []
+        for qi in qinfos:
+            if qi is None:
+                arrays.append(comm.recv(self.WRITER))
+                continue
+            shape, dtype_name, block_size = qi
+            codes = comm.recv(self.WRITER)
+            scales = comm.recv(self.WRITER)
+            count = 1
+            for d in shape:
+                count *= d
+            arrays.append(comp.dequantize_blocks(
+                codes, scales, count, block_size,
+                dtype=comp.dtype_from_name(dtype_name)).reshape(shape))
         return _join_arrays(structure, arrays)
 
     # -- lifecycle ----------------------------------------------------------

@@ -248,7 +248,9 @@ def _layer(cfg: LlamaConfig, x, lp, cos, sin, mesh, context_parallel):
             k_r = apply_rope(k_, cos, sin, positions=pos)
             return ring_attention(q_r, k_r, v_, "context", causal=True)
 
-        attn = jax.shard_map(
+        from ray_tpu.util.jax_compat import shard_map as _shard_map
+
+        attn = _shard_map(
             attn_fn,
             mesh=mesh,
             axis_names={"context"},

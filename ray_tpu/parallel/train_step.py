@@ -71,6 +71,7 @@ def make_train_step(
     context_parallel: bool = False,
     loss: Optional[Callable] = None,
     pipeline_microbatches: Optional[int] = None,
+    grad_compression=None,
 ) -> tuple[Callable, Callable]:
     """Returns (init_fn, step_fn).
 
@@ -82,6 +83,13 @@ def make_train_step(
     batch splits into `pipeline_microbatches` (default 2*pp), and autodiff
     reverses the schedule for the backward.  Reference PP surface:
     vllm_models.py:181-191 (degree folded into placement sizing).
+
+    ``grad_compression`` ('int8', a dict, or a CompressionSpec) chains the
+    block-quantized gradient codec before the optimizer inside the jitted
+    step — the compressed-collective story for gradient sync (EQuARX-style;
+    with ``error_feedback`` the residual tree rides the optimizer state and
+    inherits the params' shardings).  Leaves under the spec's ``min_bytes``
+    pass through untouched.
     """
     model = _model_module(cfg)
     batch_axes = getattr(model, "ACTIVATION_BATCH_AXES", BATCH_AXES)
@@ -89,6 +97,11 @@ def make_train_step(
         optimizer = optax.adamw(
             learning_rate, b1=0.9, b2=0.95, weight_decay=0.1, mu_dtype=jnp.float32
         )
+    if grad_compression is not None:
+        from ray_tpu.util.collective import compression as _comp
+
+        optimizer = optax.chain(
+            _comp.compress_gradients(grad_compression), optimizer)
     pp = mesh.shape.get("pipeline", 1) if mesh is not None else 1
     if pp > 1 and loss is None:
         if model is not llama:

@@ -62,12 +62,24 @@ def init_collective_group(
     rank: int,
     backend: str = Backend.STORE,
     group_name: str = "default",
+    compression=None,
 ):
     """Join this process into a collective group; blocks until all ranks join
-    (reference: collective.py:150)."""
+    (reference: collective.py:150).  ``compression`` sets the group-wide
+    default ('int8', a CompressionSpec/dict, or None) — per-call
+    ``compression=`` on an op overrides it; every member must pass the same
+    value or ranks would disagree on the wire format."""
     if not 0 <= rank < world_size:
         raise ValueError(f"rank {rank} out of range for world_size {world_size}")
-    return _group_mgr.create_group(backend, world_size, rank, group_name)
+    from ray_tpu.util.collective import compression as comp
+
+    # validate BEFORE the blocking rendezvous: a bad spec must not leave a
+    # registered group behind (is_group_initialized would say True and a
+    # corrected retry would hit the stale group)
+    spec = comp.resolve_spec(compression)
+    g = _group_mgr.create_group(backend, world_size, rank, group_name)
+    g.default_compression = spec
+    return g
 
 
 def create_collective_group(
@@ -76,29 +88,36 @@ def create_collective_group(
     ranks: List[int],
     backend: str = Backend.STORE,
     group_name: str = "default",
+    compression=None,
 ):
     """Driver-side declarative setup (reference: collective.py:187): registers
     group metadata and invokes init on each actor via a hidden task, so actor
-    code can call collective ops without its own init call."""
+    code can call collective ops without its own init call.  ``compression``
+    becomes the group default on every member (one declaration point, so
+    ranks can't disagree on the wire format)."""
     import ray_tpu
     from ray_tpu.actor import ActorMethod
+    from ray_tpu.util.collective import compression as comp
     from ray_tpu.util.collective.store import get_or_create_store
 
     if len(actors) != len(ranks):
         raise ValueError("actors and ranks must have equal length")
+    spec = comp.resolve_spec(compression)  # validate on the driver, loudly
     store = get_or_create_store()
     ray_tpu.get(store.declare_group.remote(group_name, world_size, Backend.validate(backend)))
     refs = [
         ActorMethod(a, "__ray_tpu_call__").remote(
-            _init_in_actor, world_size, r, backend, group_name
+            _init_in_actor, world_size, r, backend, group_name, spec
         )
         for a, r in zip(actors, ranks)
     ]
     ray_tpu.get(refs)
 
 
-def _init_in_actor(instance, world_size, rank, backend, group_name):
-    init_collective_group(world_size, rank, backend=backend, group_name=group_name)
+def _init_in_actor(instance, world_size, rank, backend, group_name,
+                   compression=None):
+    init_collective_group(world_size, rank, backend=backend,
+                          group_name=group_name, compression=compression)
 
 
 def is_group_initialized(group_name: str = "default") -> bool:
@@ -160,10 +179,11 @@ def _record_op(op: str, group, tensor, seconds: float):
         pass  # completed collective (the result is already computed)
 
 
-def _trace_op(op: str, group, tensor, seconds: float):
+def _trace_op(op: str, group, tensor, seconds: float, extra=None):
     """Span child of the active trace (serve request / task / user span) —
     per-op latency attribution on the causal timeline.  The guard is one
-    thread-local read, so untraced ops pay ~nothing."""
+    thread-local read, so untraced ops pay ~nothing.  ``extra`` merges
+    additional attributes (the compressed path's algorithm/wire figures)."""
     try:
         from ray_tpu.util import tracing
 
@@ -171,10 +191,13 @@ def _trace_op(op: str, group, tensor, seconds: float):
             return
         nbytes, dtype = _tensor_meta(tensor) if tensor is not None else (0, "")
         end = time.time()
+        attributes = {"world_size": group.world_size, "nbytes": nbytes,
+                      "dtype": dtype}
+        if extra:
+            attributes.update(extra)
         tracing.emit_span(
             f"collective:{op}", end - seconds, end, kind="collective",
-            attributes={"world_size": group.world_size, "nbytes": nbytes,
-                        "dtype": dtype})
+            attributes=attributes)
     except Exception:  # noqa: BLE001 — telemetry must never fail an op
         pass
 
@@ -188,9 +211,50 @@ def _timed(op: str, group, tensor, fn):
     return out
 
 
-def allreduce(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
+def _record_compression(op: str, group, stats):
+    """Book a compression-enabled op's logical-vs-wire accounting.  Called
+    only when the backend filled last_op_stats — the stock path books
+    nothing here, keeping compression-off metric output byte-identical."""
+    try:
+        from ray_tpu._private import runtime_metrics
+
+        backend = type(group).__name__.replace("Group", "").lower()
+        runtime_metrics.record_collective_compression(
+            op, backend, group.world_size, group.group_name,
+            stats.logical_bytes, stats.wire_bytes, stats.algorithm,
+            stats.scheme, stats.quant_error, stats.inter_slice_bytes)
+    except Exception:  # noqa: BLE001 — telemetry must never fail an op
+        pass
+
+
+def allreduce(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM,
+              compression=None):
+    """Allreduce ``tensor`` across the group.
+
+    ``compression``: None inherits the group default; 'none' forces the
+    stock path; 'int8' / a dict / a CompressionSpec enables the
+    block-quantized and/or hierarchical algorithms for this call (large
+    float SUM payloads only — everything else falls back untouched).
+    """
     g = _require_group(group_name)
-    return _timed("allreduce", g, tensor, lambda: g.allreduce(tensor, op))
+    spec = compression if compression is not None else g.default_compression
+    if spec is None:
+        return _timed("allreduce", g, tensor, lambda: g.allreduce(tensor, op))
+    t0 = time.perf_counter()
+    out = g.allreduce(tensor, op, compression=spec)
+    dt = time.perf_counter() - t0
+    _record_op("allreduce", g, tensor, dt)
+    stats = g.last_op_stats
+    if stats is not None:
+        _record_compression("allreduce", g, stats)
+        extra = {"algorithm": stats.algorithm, "scheme": stats.scheme,
+                 "wire_bytes": stats.wire_bytes}
+        if stats.quant_error >= 0.0:  # negative = unmeasured sentinel
+            extra["quant_error"] = round(stats.quant_error, 6)
+        _trace_op("allreduce", g, tensor, dt, extra=extra)
+    else:
+        _trace_op("allreduce", g, tensor, dt)
+    return out
 
 
 def reduce(tensor, dst_rank: int = 0, group_name: str = "default",

@@ -16,6 +16,18 @@ class BaseGroup(ABC):
         self._world_size = world_size
         self._rank = rank
         self._group_name = group_name
+        # per-group compression default (set by init_collective_group); a
+        # per-call compression= overrides.  None = stock uncompressed path.
+        self.default_compression = None
+        # OpStats of the most recent compression-enabled op (None when the
+        # stock path ran) — read by the API layer for metrics/spans.
+        self.last_op_stats = None
+
+    def _topology_num_slices(self) -> int:
+        """How many latency domains (TPU slices / hosts) the group spans —
+        drives the hierarchical-algorithm auto policy.  Backends with real
+        topology knowledge override."""
+        return 1
 
     @property
     def rank(self) -> int:
@@ -30,10 +42,13 @@ class BaseGroup(ABC):
         return self._group_name
 
     def destroy(self):  # noqa: B027
-        pass
+        from ray_tpu.util.collective import compression
+
+        compression.error_feedback.clear_group(self._group_name)
 
     @abstractmethod
-    def allreduce(self, tensor, op: ReduceOp = ReduceOp.SUM): ...
+    def allreduce(self, tensor, op: ReduceOp = ReduceOp.SUM,
+                  compression=None): ...
 
     @abstractmethod
     def reduce(self, tensor, dst_rank: int = 0, op: ReduceOp = ReduceOp.SUM): ...

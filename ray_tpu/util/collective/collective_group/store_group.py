@@ -14,6 +14,7 @@ from typing import Any, List
 
 import numpy as np
 
+from ray_tpu.util.collective import compression as comp
 from ray_tpu.util.collective.collective_group.base_group import BaseGroup
 from ray_tpu.util.collective.store import get_or_create_store, store_wait
 from ray_tpu.util.collective.types import ReduceOp
@@ -93,12 +94,119 @@ class StoreGroup(BaseGroup):
         ray_tpu.get(self._store.contribute.remote(key, self._rank, value))
         return store_wait(self._store, "collect", (key, self._world_size, self._rank))
 
+    def _exchange_sub(self, kind: str, subrank: int, count: int, value) -> dict:
+        """Gather round inside a subgroup (hierarchical phases): the kind
+        string embeds the subgroup id, so concurrent subgroups never share a
+        key; every rank runs every phase exactly once, keeping the per-group
+        sequence counter aligned across all ranks."""
+        import ray_tpu
+
+        key = self._next_key(kind)
+        ray_tpu.get(self._store.contribute.remote(key, subrank, value))
+        return store_wait(self._store, "collect", (key, count, subrank))
+
     # -- collectives --------------------------------------------------------
-    def allreduce(self, tensor, op: ReduceOp = ReduceOp.SUM):
+    def allreduce(self, tensor, op: ReduceOp = ReduceOp.SUM, compression=None):
+        self.last_op_stats = None
         arr, _ = _to_numpy(tensor)
-        by_rank = self._exchange("allreduce", arr)
-        out = _REDUCERS[op]([by_rank[r] for r in range(self._world_size)])
-        return _convert_back(out, tensor)
+        plan = self._plan(arr, op, compression)
+        if plan.is_stock:
+            by_rank = self._exchange("allreduce", arr)
+            out = _REDUCERS[op]([by_rank[r] for r in range(self._world_size)])
+            return _convert_back(out, tensor)
+        if plan.algorithm == comp.ALG_HIERARCHICAL:
+            out, stats = self._hierarchical_allreduce(arr, op, plan)
+        else:
+            out, stats = self._quantized_allreduce(arr, plan)
+        self.last_op_stats = stats
+        return _convert_back(out.astype(arr.dtype, copy=False), tensor)
+
+    def _plan(self, arr: np.ndarray, op: ReduceOp, compression) -> comp.Plan:
+        spec = comp.resolve_spec(compression)
+        plan = comp.choose_plan(arr.nbytes, self._world_size, spec,
+                                num_slices=self._topology_num_slices())
+        if plan.scheme != comp.SCHEME_NONE and (
+                op != ReduceOp.SUM or not comp.is_float_dtype(arr.dtype)):
+            # quantization is only meaningful for float SUM-reductions;
+            # keep the (lossless) algorithm choice, drop the codec
+            import dataclasses as _dc
+
+            plan = _dc.replace(plan, scheme=comp.SCHEME_NONE)
+        return plan
+
+    def _quantized_allreduce(self, arr: np.ndarray, plan: comp.Plan):
+        """Flat quantized: every rank contributes int8 codes + per-block
+        scales instead of the raw float payload; each rank dequantizes all
+        contributions and sums — all ranks see bit-identical results."""
+        spec = plan.spec
+        n = arr.size
+        codes, scales, _deq, qerr = comp.ef_quantize(
+            self._group_name, "allreduce", arr, spec)
+        by_rank = self._exchange("allreduce_q", (codes, scales))
+        acc = np.zeros(n, np.float32)
+        for r in range(self._world_size):
+            c_r, s_r = by_rank[r]
+            acc += comp.dequantize_blocks(c_r, s_r, n, spec.block_size)
+        stats = comp.OpStats(
+            logical_bytes=int(arr.nbytes),
+            wire_bytes=comp.wire_nbytes(codes, scales),
+            algorithm=comp.ALG_FLAT, scheme=plan.scheme, quant_error=qerr)
+        return acc.reshape(arr.shape), stats
+
+    def _hierarchical_allreduce(self, arr: np.ndarray, op: ReduceOp,
+                                plan: comp.Plan):
+        """Two-level algorithm (TACCL-shaped): intra-slice reduce-scatter,
+        inter-slice exchange on 1/slice shards (optionally quantized — this
+        is the DCN phase the algorithm exists to shrink), intra-slice
+        allgather.  Slices are contiguous rank blocks of ``slice_size``."""
+        spec = plan.spec
+        ss = plan.slice_size
+        nslices = self._world_size // ss
+        sid, idx = self._rank // ss, self._rank % ss
+        flat = comp.pad_to_multiple(arr.ravel(), ss)
+        shard_n = flat.size // ss
+        lo, hi = idx * shard_n, (idx + 1) * shard_n
+
+        # phase 1 — intra-slice reduce-scatter: exchange full payloads
+        # inside the slice, each member reduces its own shard
+        by_idx = self._exchange_sub(f"hier_rs_s{sid}", idx, ss, flat)
+        my_shard = _REDUCERS[op]([by_idx[j][lo:hi] for j in range(ss)])
+        wire_intra = int(flat.nbytes)
+
+        # phase 2 — inter-slice allreduce of the shard among same-index
+        # members across slices (1/slice of the payload crosses DCN)
+        quantized = plan.scheme == comp.SCHEME_INT8
+        if quantized:
+            codes, scales, _deq, qerr = comp.ef_quantize(
+                self._group_name, "allreduce_hier", my_shard, spec)
+            by_slice = self._exchange_sub(
+                f"hier_x_i{idx}", sid, nslices, (codes, scales))
+            acc = np.zeros(shard_n, np.float32)
+            for s in range(nslices):
+                c_s, s_s = by_slice[s]
+                acc += comp.dequantize_blocks(c_s, s_s, shard_n,
+                                              spec.block_size)
+            global_shard = acc.astype(flat.dtype, copy=False)
+            wire_inter = comp.wire_nbytes(codes, scales)
+        else:
+            qerr = 0.0
+            by_slice = self._exchange_sub(
+                f"hier_x_i{idx}", sid, nslices, my_shard)
+            global_shard = _REDUCERS[op](
+                [by_slice[s] for s in range(nslices)])
+            wire_inter = int(my_shard.nbytes)
+
+        # phase 3 — intra-slice allgather of the globally-reduced shards
+        by_idx3 = self._exchange_sub(f"hier_ag_s{sid}", idx, ss, global_shard)
+        out = np.concatenate([by_idx3[j] for j in range(ss)])[:arr.size]
+        wire_intra += int(global_shard.nbytes)
+
+        stats = comp.OpStats(
+            logical_bytes=int(arr.nbytes),
+            wire_bytes=wire_intra + wire_inter,
+            algorithm=comp.ALG_HIERARCHICAL, scheme=plan.scheme,
+            quant_error=qerr, inter_slice_bytes=wire_inter)
+        return out.reshape(arr.shape), stats
 
     def reduce(self, tensor, dst_rank: int = 0, op: ReduceOp = ReduceOp.SUM):
         arr, _ = _to_numpy(tensor)

@@ -7,9 +7,47 @@ import jax
 
 def shard_map(f, **kw):
     """jax.shard_map moved out of jax.experimental across versions; one
-    resolution point for every caller (collective backends, benchmarks)."""
+    resolution point for every caller (collective backends, models,
+    benchmarks).
+
+    The new API's ``axis_names={...}`` (partial-manual: only the named axes
+    are manual inside the body) is translated for the old experimental API
+    into its ``auto=`` complement (every OTHER mesh axis stays automatic) —
+    this is what lets the context-parallel and pipeline paths run on jax
+    0.4.x images."""
     if hasattr(jax, "shard_map"):
         return jax.shard_map(f, **kw)
     from jax.experimental.shard_map import shard_map as _sm
 
+    axis_names = kw.pop("axis_names", None)
+    if axis_names is not None:
+        mesh = kw.get("mesh")
+        kw["auto"] = frozenset(mesh.axis_names) - frozenset(axis_names)
+        # axis_names call sites target the new typed-replication (vma)
+        # checker; the old check_rep pass lacks rules for several
+        # primitives they use (checkpoint_name, ppermute carries), so it
+        # must be off for the translation to be usable
+        kw.setdefault("check_rep", False)
     return _sm(f, **kw)
+
+
+def axis_size(axis_name) -> int:
+    """lax.axis_size compat: old jax constant-folds ``psum(1, axis)`` to the
+    static axis size (the pre-axis_size idiom), so both paths return an int
+    usable for Python-level loop bounds."""
+    from jax import lax
+
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
+
+
+def pcast(x, axes, to="varying"):
+    """lax.pcast (typed-replication cast, jax >= 0.6) compat: the old
+    shard_map has no varying-manual-axes typing, so the cast is simply the
+    identity there."""
+    from jax import lax
+
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, axes, to=to)
+    return x

@@ -151,3 +151,128 @@ def test_backend_aliases():
     assert Backend.validate("gloo") == Backend.STORE
     with pytest.raises(ValueError):
         Backend.validate("bogus")
+
+
+def _make_compression_worker_class():
+    class _CompWorker:
+        def __init__(self, rank, world_size, group_name, compression):
+            col.init_collective_group(
+                world_size, rank, backend="store", group_name=group_name,
+                compression=compression)
+            self.group_name = group_name
+
+        def allreduce(self, value, compression=None):
+            out = col.allreduce(np.asarray(value, np.float32),
+                                self.group_name, compression=compression)
+            from ray_tpu.util.collective.collective import _group_mgr
+
+            s = _group_mgr.get_group(self.group_name).last_op_stats
+            stats = None if s is None else {
+                "algorithm": s.algorithm, "scheme": s.scheme,
+                "logical_bytes": s.logical_bytes, "wire_bytes": s.wire_bytes,
+                "inter_slice_bytes": s.inter_slice_bytes}
+            return out, stats
+
+        def compression_snapshot(self):
+            from ray_tpu._private import runtime_metrics
+
+            return runtime_metrics.compression_snapshot()
+
+    return _CompWorker
+
+
+@pytest.fixture
+def comp_workers(ray_start_regular):
+    spec = {"scheme": "int8", "min_bytes": 1024}
+    W = ray_tpu.remote(_make_compression_worker_class()).options(num_cpus=0)
+    workers = [W.remote(r, 4, "gcomp", spec) for r in range(4)]
+    yield workers
+
+
+def _rel(a, b):
+    return np.linalg.norm(np.asarray(a) - np.asarray(b)) / np.linalg.norm(b)
+
+
+def test_store_quantized_allreduce_matches_flat(comp_workers):
+    """Flat int8 (group default): all ranks agree, within the documented
+    2% tolerance of the exact sum, and wire bytes shrink >=3.5x."""
+    rng = np.random.default_rng(7)
+    data = [rng.standard_normal(65536).astype(np.float32) for _ in range(4)]
+    ref = np.sum(np.stack(data), axis=0)
+    outs = ray_tpu.get([w.allreduce.remote(d)
+                        for w, d in zip(comp_workers, data)], timeout=120)
+    first = outs[0][0]
+    for out, stats in outs:
+        assert _rel(out, ref) < 0.02
+        np.testing.assert_array_equal(out, first)  # rank agreement is exact
+        assert stats["algorithm"] == "flat" and stats["scheme"] == "int8"
+        assert stats["logical_bytes"] / stats["wire_bytes"] >= 3.5
+
+
+def test_store_hierarchical_allreduce_matches_flat(comp_workers):
+    """Per-call hierarchical override: matches the exact sum within
+    tolerance; the DCN phase carries ~1/slice of the (quantized) payload."""
+    rng = np.random.default_rng(8)
+    data = [rng.standard_normal(65536).astype(np.float32) for _ in range(4)]
+    ref = np.sum(np.stack(data), axis=0)
+    spec = {"scheme": "int8", "min_bytes": 1024, "slice_size": 2}
+    outs = ray_tpu.get([w.allreduce.remote(d, spec)
+                        for w, d in zip(comp_workers, data)], timeout=120)
+    for out, stats in outs:
+        assert _rel(out, ref) < 0.02
+        assert stats["algorithm"] == "hierarchical"
+        assert 0 < stats["inter_slice_bytes"] < stats["logical_bytes"] / 2
+
+
+def test_store_hierarchical_lossless_matches_exactly(comp_workers):
+    """Hierarchical with scheme=none is a reordered float sum — allclose
+    to the flat result at float32 tolerance."""
+    rng = np.random.default_rng(9)
+    data = [rng.standard_normal(16384).astype(np.float32) for _ in range(4)]
+    ref = np.sum(np.stack(data), axis=0)
+    spec = {"scheme": "none", "min_bytes": 1024, "slice_size": 2,
+            "hierarchical": True}
+    outs = ray_tpu.get([w.allreduce.remote(d, spec)
+                        for w, d in zip(comp_workers, data)], timeout=120)
+    for out, stats in outs:
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-4)
+        assert stats["algorithm"] == "hierarchical"
+        assert stats["scheme"] == "none"
+
+
+def test_store_compression_disabled_byte_identical(comp_workers):
+    """compression='none' per-call override forces the stock path: results
+    are BIT-identical to the uncompressed exchange and no stats are set."""
+    data = [np.full(4096, float(r + 1), np.float32) for r in range(4)]
+    ref = np.sum(np.stack(data), axis=0)
+    outs = ray_tpu.get([w.allreduce.remote(d, "none")
+                        for w, d in zip(comp_workers, data)], timeout=120)
+    for out, stats in outs:
+        np.testing.assert_array_equal(out, ref)
+        assert stats is None
+
+
+def test_store_small_message_policy_and_nonsum_fallback(comp_workers):
+    """Below min_bytes the group default stays on the stock path (exact
+    result, no compression stats)."""
+    data = [np.arange(8, dtype=np.float32) * (r + 1) for r in range(4)]
+    ref = np.sum(np.stack(data), axis=0)
+    outs = ray_tpu.get([w.allreduce.remote(d)
+                        for w, d in zip(comp_workers, data)], timeout=120)
+    for out, stats in outs:
+        np.testing.assert_array_equal(out, ref)
+        assert stats is None
+
+
+def test_compression_metrics_recorded_on_workers(comp_workers):
+    rng = np.random.default_rng(10)
+    data = [rng.standard_normal(65536).astype(np.float32) for _ in range(4)]
+    ray_tpu.get([w.allreduce.remote(d)
+                 for w, d in zip(comp_workers, data)], timeout=120)
+    snaps = ray_tpu.get([w.compression_snapshot.remote()
+                         for w in comp_workers], timeout=60)
+    for snap in snaps:
+        keys = [k for k in snap if k.endswith("/gcomp")]
+        assert keys, snap
+        entry = snap[keys[0]]
+        assert entry["wire_reduction_x"] >= 3.5

@@ -1,0 +1,363 @@
+"""Compression-aware collectives (ISSUE 3): codec bounds, policy, error
+feedback, the EQuARX-style quantized and hierarchical XLA programs on the
+virtual 8-device CPU mesh, and the metric families.
+
+Everything here is in-process CPU (no cluster), so the module stays in the
+tier-1 lane; the cross-actor store-backend coverage lives in
+test_collective.py (slow lane, needs worker processes).
+"""
+
+import numpy as np
+import pytest
+
+from ray_tpu.util.collective import compression as comp
+
+# ---------------------------------------------------------------------------
+# codec
+# ---------------------------------------------------------------------------
+
+
+def test_codec_roundtrip_error_bound():
+    """Per-block symmetric int8: per-element error <= scale/2 =
+    maxabs/254 per block, checked elementwise against the actual scales."""
+    rng = np.random.default_rng(0)
+    for shape in [(1000,), (37,), (256,), (4, 100), (3, 5, 7)]:
+        x = (rng.standard_normal(shape) * 10).astype(np.float32)
+        codes, scales = comp.quantize_blocks(x, 256)
+        deq = comp.dequantize_blocks(codes, scales, x.size, 256)
+        err = np.abs(x.ravel() - deq)
+        bound = np.repeat(scales / 2 + 1e-7, 256)[:x.size]
+        assert (err <= bound).all()
+        # relative L2 for Gaussian data lands well under 1%
+        assert comp.relative_error(x, deq) < 0.01
+
+
+def test_codec_zero_blocks_exact():
+    x = np.zeros(512, np.float32)
+    codes, scales = comp.quantize_blocks(x, 256)
+    assert (scales == 0).all()
+    np.testing.assert_array_equal(
+        comp.dequantize_blocks(codes, scales, 512, 256), x)
+
+
+def test_codec_wire_reduction_at_4mib():
+    """Acceptance gate: >=3.5x wire-bytes reduction at >=4 MiB payloads."""
+    n = 4 * 2**20 // 4  # 4 MiB of f32
+    x = np.random.default_rng(1).standard_normal(n).astype(np.float32)
+    codes, scales = comp.quantize_blocks(x, 256)
+    logical = x.nbytes
+    wire = comp.wire_nbytes(codes, scales)
+    assert logical / wire >= 3.5, (logical, wire)
+
+
+def test_codec_bf16_input():
+    import jax.numpy as jnp
+
+    x = np.asarray(jnp.arange(512, dtype=jnp.bfloat16))
+    codes, scales = comp.quantize_blocks(x, 256)
+    deq = comp.dequantize_blocks(codes, scales, 512, 256)
+    assert comp.relative_error(np.asarray(x, np.float32), deq) < 0.02
+
+
+def test_jnp_codec_matches_numpy():
+    import jax.numpy as jnp
+
+    x = np.random.default_rng(2).standard_normal(1024).astype(np.float32)
+    c_np, s_np = comp.quantize_blocks(x, 256)
+    c_j, s_j = comp.jnp_quantize_blocks(jnp.asarray(x), 256)
+    np.testing.assert_array_equal(c_np, np.asarray(c_j))
+    np.testing.assert_allclose(s_np, np.asarray(s_j), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# policy
+# ---------------------------------------------------------------------------
+
+
+def test_policy_small_messages_stay_stock():
+    spec = comp.CompressionSpec()
+    plan = comp.choose_plan(spec.min_bytes - 1, 8, spec)
+    assert plan.is_stock
+    plan = comp.choose_plan(spec.min_bytes, 8, spec)
+    assert plan.scheme == comp.SCHEME_INT8 and not plan.is_stock
+
+
+def test_policy_disabled_and_single_rank():
+    assert comp.choose_plan(1 << 30, 8, None).is_stock
+    assert comp.choose_plan(1 << 30, 1, comp.CompressionSpec()).is_stock
+
+
+def test_policy_hierarchical_selection():
+    # explicit slice_size forces the hierarchy
+    plan = comp.choose_plan(1 << 20, 8, comp.CompressionSpec(slice_size=4))
+    assert plan.algorithm == comp.ALG_HIERARCHICAL and plan.slice_size == 4
+    # auto: topology with >1 slice goes hierarchical at sliced world size
+    plan = comp.choose_plan(1 << 20, 8, comp.CompressionSpec(), num_slices=2)
+    assert plan.algorithm == comp.ALG_HIERARCHICAL and plan.slice_size == 4
+    # flat topology stays flat
+    plan = comp.choose_plan(1 << 20, 8, comp.CompressionSpec())
+    assert plan.algorithm == comp.ALG_FLAT
+    # invalid slice_size (doesn't divide world) refuses the hierarchy
+    plan = comp.choose_plan(1 << 20, 8, comp.CompressionSpec(slice_size=3))
+    assert plan.algorithm == comp.ALG_FLAT
+    # hierarchical=False wins over topology
+    plan = comp.choose_plan(
+        1 << 20, 8, comp.CompressionSpec(hierarchical=False), num_slices=2)
+    assert plan.algorithm == comp.ALG_FLAT
+
+
+def test_spec_resolution():
+    assert comp.resolve_spec(None) is None
+    assert comp.resolve_spec("int8").scheme == comp.SCHEME_INT8
+    none_spec = comp.resolve_spec("none")
+    assert none_spec.scheme == comp.SCHEME_NONE
+    assert none_spec.hierarchical is False
+    d = comp.resolve_spec({"scheme": "int8", "block_size": 128})
+    assert d.block_size == 128
+    with pytest.raises(ValueError):
+        comp.resolve_spec("zstd")
+    with pytest.raises(ValueError):
+        comp.CompressionSpec(scheme="int4")
+    with pytest.raises(TypeError):
+        comp.resolve_spec(17)
+
+
+# ---------------------------------------------------------------------------
+# error feedback
+# ---------------------------------------------------------------------------
+
+
+def test_error_feedback_store_roundtrip():
+    store = comp.ErrorFeedbackStore()
+    x = np.random.default_rng(3).standard_normal(512).astype(np.float32)
+    key = store.key("g", "allreduce", x)
+    folded = store.fold(key, x)
+    np.testing.assert_array_equal(folded, x)  # first round: no residual
+    codes, scales = comp.quantize_blocks(folded, 256)
+    deq = comp.dequantize_blocks(codes, scales, 512, 256)
+    store.update(key, folded, deq)
+    np.testing.assert_allclose(store.get(key), folded - deq)
+    folded2 = store.fold(key, x)
+    np.testing.assert_allclose(folded2, x + (folded - deq), rtol=1e-6)
+    store.clear_group("g")
+    assert store.get(key) is None
+
+
+def test_error_feedback_mean_converges():
+    """EF's defining property: the RUNNING MEAN of dequantized outputs
+    converges to the true value (the carried residual re-enters later
+    rounds instead of being lost), beating EF-off on a coarse codec."""
+    rng = np.random.default_rng(4)
+    x = rng.standard_normal(256).astype(np.float32) * 0.01
+    store = comp.ErrorFeedbackStore()
+    key = store.key("g", "op", x)
+
+    def roundtrip(v):
+        c, s = comp.quantize_blocks(v, 256)
+        return comp.dequantize_blocks(c, s, 256, 256)
+
+    ef_sum = np.zeros_like(x)
+    plain_sum = np.zeros_like(x)
+    rounds = 50
+    for _ in range(rounds):
+        folded = store.fold(key, x)
+        deq = roundtrip(folded)
+        store.update(key, folded, deq)
+        ef_sum += deq
+        plain_sum += roundtrip(x)
+    ef_err = np.linalg.norm(ef_sum / rounds - x)
+    plain_err = np.linalg.norm(plain_sum / rounds - x)
+    assert ef_err <= plain_err * 0.75, (ef_err, plain_err)
+
+
+def test_grad_compression_transform_toy_convergence():
+    """Satellite acceptance: error-feedback compressed training on a toy
+    CPU model tracks the uncompressed loss curve within 1%."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    rng = np.random.default_rng(5)
+    w_true = rng.standard_normal(64).astype(np.float32)
+    X = rng.standard_normal((256, 64)).astype(np.float32)
+    y = X @ w_true
+
+    def loss_fn(w):
+        return jnp.mean((X @ w - y) ** 2)
+
+    def train(tx, steps=40):
+        w = jnp.zeros(64)
+        state = tx.init(w)
+        losses = []
+        grad = jax.jit(jax.grad(loss_fn))
+        for _ in range(steps):
+            g = grad(w)
+            updates, state = tx.update(g, state, w)
+            w = optax.apply_updates(w, updates)
+            losses.append(float(loss_fn(w)))
+        return np.array(losses)
+
+    base = train(optax.sgd(1e-2))
+    spec = {"scheme": "int8", "min_bytes": 0, "block_size": 64,
+            "error_feedback": True}
+    compressed = train(optax.chain(
+        comp.compress_gradients(spec), optax.sgd(1e-2)))
+    # final loss within 1% of the uncompressed curve (absolute floor for
+    # the near-zero converged regime)
+    assert abs(compressed[-1] - base[-1]) <= max(0.01 * base[-1], 1e-4), (
+        compressed[-1], base[-1])
+
+
+def test_grad_compression_none_is_identity():
+    import jax.numpy as jnp
+    import optax
+
+    tx = comp.compress_gradients("none")
+    g = {"w": jnp.arange(8.0)}
+    out, _ = tx.update(g, tx.init(g))
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.arange(8.0))
+
+
+def test_grad_compression_small_leaves_pass_through():
+    import jax.numpy as jnp
+
+    tx = comp.compress_gradients({"scheme": "int8", "min_bytes": 1 << 20})
+    g = {"w": jnp.linspace(0.0, 1.0, 300)}  # 1.2 KB << min_bytes
+    out, _ = tx.update(g, tx.init(g))
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.asarray(g["w"]))
+
+
+# ---------------------------------------------------------------------------
+# XLA programs on the virtual 8-device CPU mesh (conftest pins 8 devices)
+# ---------------------------------------------------------------------------
+
+
+def _mesh_and_rows(n_per_rank=8192):
+    import jax
+
+    devices = jax.devices()[:8]
+    if len(devices) < 8:
+        pytest.skip("needs the 8-device virtual CPU mesh")
+    rng = np.random.default_rng(6)
+    rows = [rng.standard_normal(n_per_rank).astype(np.float32)
+            for _ in range(8)]
+    return devices, rows
+
+
+def test_quantized_allreduce_program_matches_flat():
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from ray_tpu.util.collective.collective_group import xla_group as xg
+
+    devices, rows = _mesh_and_rows()
+    mesh = Mesh(np.array(devices), ("world",))
+    bs = 256
+    fn = xg.build_quantized_allreduce(mesh, "world", 8, bs, "float32")
+    pairs = [comp.quantize_blocks(r, bs) for r in rows]
+    sharding = NamedSharding(mesh, P("world"))
+    out = np.asarray(fn(
+        jax.device_put(np.stack([p[0] for p in pairs]), sharding),
+        jax.device_put(np.stack([p[1] for p in pairs]), sharding)))
+    ref = np.sum(np.stack(rows), axis=0)
+    assert comp.relative_error(ref, out) < 0.02  # documented int8 tolerance
+
+
+def test_hierarchical_allreduce_program_matches_flat():
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from ray_tpu.util.collective.collective_group import xla_group as xg
+
+    devices, rows = _mesh_and_rows()
+    mesh2 = Mesh(np.array(devices).reshape(2, 4), ("slice", "intra"))
+    x = np.stack(rows).reshape(2, 4, -1)
+    gx = jax.device_put(x, NamedSharding(mesh2, P("slice", "intra")))
+    ref = np.sum(np.stack(rows), axis=0)
+    # lossless variant: numerically a reordered float sum
+    out = np.asarray(xg.build_hierarchical_allreduce(
+        mesh2, 2, 4, comp.SCHEME_NONE, 256, "float32")(gx))
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-4)
+    # quantized DCN phase: documented int8 tolerance
+    out8 = np.asarray(xg.build_hierarchical_allreduce(
+        mesh2, 2, 4, comp.SCHEME_INT8, 256, "float32")(gx))
+    assert comp.relative_error(ref, out8) < 0.02
+
+
+def test_xla_group_solo_compression_falls_back():
+    """world_size=1: the policy keeps even an explicit int8 request on the
+    stock path (nothing to compress across), and the result is exact."""
+    from ray_tpu.util.collective.collective_group.xla_group import XLAGroup
+
+    g = XLAGroup(1, 0, "solo-comp")
+    x = np.arange(64 * 1024, dtype=np.float32)  # above min_bytes
+    out = g.allreduce(x, compression=comp.CompressionSpec(min_bytes=0))
+    np.testing.assert_array_equal(np.asarray(out), x)
+    assert g.last_op_stats is None
+    g.destroy()
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+
+
+def test_compression_metric_families_and_snapshot():
+    from ray_tpu._private import runtime_metrics as rtm
+    from ray_tpu.util.metrics import collect_local, prometheus_text
+
+    rtm.record_collective_compression(
+        "allreduce", "store", 4, "metrics-test-g", 4_000_000, 1_040_000,
+        "hierarchical", "int8", 0.0071, 130_000)
+    snap = rtm.compression_snapshot()
+    key = "allreduce/store/ws4/hierarchical/int8/metrics-test-g"
+    assert key in snap
+    assert snap[key]["logical_bytes"] == 4_000_000
+    assert snap[key]["wire_bytes"] == 1_040_000
+    assert snap[key]["wire_reduction_x"] == pytest.approx(3.846, abs=0.01)
+    assert snap[key]["quant_error"] == pytest.approx(0.0071)
+    text = prometheus_text([p for p in collect_local()
+                            if "collective" in p["name"]])
+    assert "ray_tpu_collective_wire_bytes_total" in text
+    assert "ray_tpu_collective_logical_bytes_total" in text
+    assert "ray_tpu_collective_inter_slice_bytes_total" in text
+    assert 'group="metrics-test-g"' in text
+    assert 'algorithm="hierarchical"' in text
+
+
+def test_disabled_path_records_no_compression_metrics():
+    """Compression off => zero new metric points (byte-identical metric
+    output to the pre-compression runtime)."""
+    from ray_tpu._private import runtime_metrics as rtm
+    from ray_tpu.util.collective.collective_group.xla_group import XLAGroup
+
+    before = {k: dict(v) for k, v in rtm.compression_snapshot().items()}
+    g = XLAGroup(1, 0, "solo-nometrics")
+    g.allreduce(np.ones(1024, np.float32))
+    g.destroy()
+    assert rtm.compression_snapshot() == before
+
+
+def test_grad_compression_ef_handles_tuple_pytree_nodes():
+    """Regression: pytrees containing tuple/NamedTuple nodes must come
+    back with identical structure (the old pair-unzip misread structural
+    tuples as (update, residual) pairs and dropped fields)."""
+    from typing import NamedTuple
+
+    import jax
+    import jax.numpy as jnp
+
+    class NT(NamedTuple):
+        a: object
+        b: object
+
+    tx = comp.compress_gradients({"scheme": "int8", "min_bytes": 0,
+                                  "block_size": 64, "error_feedback": True})
+    g = {"w": jnp.linspace(0.0, 1.0, 128),
+         "nt": NT(a=jnp.ones(128) * 0.3, b=jnp.ones(128) * 0.7)}
+    state = tx.init(g)
+    out, state2 = tx.update(g, state)
+    assert jax.tree.structure(out) == jax.tree.structure(g)
+    assert jax.tree.structure(state2.residual) == jax.tree.structure(g)
+    np.testing.assert_allclose(np.asarray(out["nt"].a), 0.3, rtol=0.02)
+    np.testing.assert_allclose(np.asarray(out["nt"].b), 0.7, rtol=0.02)

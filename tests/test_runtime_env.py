@@ -334,3 +334,56 @@ def test_uv_install_failure_adopts_peer_ready(monkeypatch):
     out = renv.materialize_uv_env({"packages": packages})
     assert out and ".ready" not in out
     assert os.path.exists(os.path.join(dest, ".ready"))
+
+
+def test_worker_process_setup_hook(ray_start_regular):
+    """VERDICT directive #8: a callable shipped via the function registry
+    runs once per worker before its first task — env vars and logging
+    config it sets are visible inside tasks on that worker."""
+
+    def hook():
+        import logging
+
+        os.environ["RT_HOOK_SENTINEL"] = "configured"
+        logging.getLogger("rt-hook-test").setLevel(logging.CRITICAL)
+
+    @ray_tpu.remote
+    def probe():
+        import logging
+
+        return (os.environ.get("RT_HOOK_SENTINEL"),
+                logging.getLogger("rt-hook-test").level)
+
+    env = {"worker_process_setup_hook": hook}
+    out = ray_tpu.get(probe.options(runtime_env=env).remote(), timeout=90)
+    assert out == ("configured", 50)
+    # once per worker: a second task on the same env pool reuses the
+    # already-configured worker (no re-run needed, state persists)
+    out2 = ray_tpu.get(probe.options(runtime_env=env).remote(), timeout=90)
+    assert out2 == ("configured", 50)
+    # the env-less default pool is untouched
+    assert ray_tpu.get(probe.remote(), timeout=90)[0] is None
+
+
+def test_worker_process_setup_hook_with_env_vars(ray_start_regular):
+    """The hook runs AFTER env_vars are exported, so it can read/extend
+    them (ordering contract of apply_in_worker)."""
+
+    def hook():
+        os.environ["RT_HOOK_DERIVED"] = os.environ.get("RT_BASE", "") + "+hook"
+
+    @ray_tpu.remote
+    def probe():
+        return os.environ.get("RT_HOOK_DERIVED")
+
+    env = {"env_vars": {"RT_BASE": "base"},
+           "worker_process_setup_hook": hook}
+    assert ray_tpu.get(probe.options(runtime_env=env).remote(),
+                       timeout=90) == "base+hook"
+
+
+def test_worker_process_setup_hook_rejects_non_callable():
+    from ray_tpu._private import runtime_env as renv
+
+    with pytest.raises(ValueError):
+        renv.normalize({"worker_process_setup_hook": 42})

@@ -98,3 +98,50 @@ def test_jax_arrays_roundtrip(ray_start_regular):
         assert res["n"] == 8
     finally:
         dag.teardown()
+
+
+@pytest.mark.slow
+def test_compressed_tensor_edge(ray_start_regular):
+    """with_tensor_transport(compression=...): large float leaves travel
+    quantized (within the documented int8 tolerance), small/integer leaves
+    and the structure stay exact."""
+    a, b = Stage.remote(), Stage.remote()
+    spec = {"scheme": "int8", "min_bytes": 1024}
+    with InputNode() as inp:
+        mid = a.scale.bind(inp).with_tensor_transport("store", compression=spec)
+        out = b.reduce_sum.bind(mid)
+    dag = out.experimental_compile()
+    try:
+        big = np.random.default_rng(11).standard_normal(8192).astype(np.float32)
+        batch = {"x": big, "tag": "q", "n": 3}
+        res = dag.execute(batch).get(timeout=60)
+        exact = float(np.sum(big * 2))
+        assert res["total"] == pytest.approx(exact, rel=0.02)
+        assert res["total"] != exact  # it really went through the codec
+        assert res["tag"] == "q" and res["n"] == 4  # metadata exact
+    finally:
+        dag.teardown()
+
+
+@pytest.mark.slow
+def test_compressed_edge_small_leaves_exact(ray_start_regular):
+    """Leaves under min_bytes bypass the codec even on a compressed edge."""
+    a, b = Stage.remote(), Stage.remote()
+    with InputNode() as inp:
+        mid = a.scale.bind(inp).with_tensor_transport(
+            "store", compression={"scheme": "int8", "min_bytes": 1 << 20})
+        out = b.reduce_sum.bind(mid)
+    dag = out.experimental_compile()
+    try:
+        batch = {"x": np.arange(64, dtype=np.float32), "tag": "s", "n": 0}
+        res = dag.execute(batch).get(timeout=60)
+        assert res["total"] == float(np.sum(batch["x"] * 2))  # bit-exact
+    finally:
+        dag.teardown()
+
+
+def test_compression_requires_tensor_transport():
+    from ray_tpu.dag.dag_node import DAGNode
+
+    with pytest.raises(ValueError):
+        DAGNode().with_tensor_transport("shm", compression="int8")
