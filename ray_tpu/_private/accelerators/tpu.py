@@ -25,7 +25,8 @@ from __future__ import annotations
 import glob
 import logging
 import os
-from typing import Dict, List, Optional, Tuple
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
 
 from ray_tpu._private.accelerators.accelerator import AcceleratorManager
 
@@ -233,16 +234,136 @@ class TPUAcceleratorManager(AcceleratorManager):
 def _gce_metadata(key: str) -> Optional[str]:
     """GCE metadata server lookup (reference: tpu.py:67-87). Short timeout;
     returns None off-GCE."""
+    return _gce_metadata_path(f"instance/attributes/{key}")
+
+
+def _gce_metadata_path(path: str, timeout: float = 0.5) -> Optional[str]:
+    """Fetch an arbitrary computeMetadata/v1 path (the maintenance endpoints
+    — ``instance/preempted``, ``instance/maintenance-event`` — live OUTSIDE
+    instance/attributes/).  Returns None off-GCE or on any error."""
     if os.environ.get("RAY_TPU_DISABLE_METADATA_SERVER"):
         return None
     try:
         import urllib.request
 
         req = urllib.request.Request(
-            f"http://metadata.google.internal/computeMetadata/v1/instance/attributes/{key}",
+            f"http://metadata.google.internal/computeMetadata/v1/{path}",
             headers={"Metadata-Flavor": "Google"},
         )
-        with urllib.request.urlopen(req, timeout=0.5) as resp:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
             return resp.read().decode()
     except Exception:  # noqa: BLE001
         return None
+
+
+# ---------------------------------------------------------------------------
+# Maintenance / preemption watcher (reference direction: GCE announces VM
+# termination through the metadata server — instance/preempted flips to TRUE
+# on Spot reclamation, instance/maintenance-event announces host maintenance;
+# watching them is how a preemption becomes a *graceful drain* instead of an
+# unexplained node death)
+# ---------------------------------------------------------------------------
+
+PREEMPTED_PATH = "instance/preempted"
+MAINTENANCE_EVENT_PATH = "instance/maintenance-event"
+
+# drain windows the platform effectively grants: a Spot preemption delivers
+# ACPI shutdown ~30 s out; announced host maintenance gives a longer runway
+_PREEMPTED_DEADLINE_S = 30.0
+_MAINTENANCE_DEADLINE_S = 60.0
+
+
+def get_maintenance_notice(
+        fetch: Optional[Callable[[str], Optional[str]]] = None,
+) -> Optional[Dict[str, object]]:
+    """One poll of the GCE maintenance endpoints.
+
+    Returns ``{"kind": ..., "deadline_s": ...}`` when the platform has
+    announced this VM is going away, else None.  ``fetch`` injects the
+    metadata transport for tests (called with the metadata path)."""
+    fetch = fetch or _gce_metadata_path
+    preempted = fetch(PREEMPTED_PATH)
+    if preempted and preempted.strip().upper() == "TRUE":
+        return {"kind": "preempted", "deadline_s": _PREEMPTED_DEADLINE_S}
+    event = fetch(MAINTENANCE_EVENT_PATH)
+    if event and event.strip() and event.strip().upper() != "NONE":
+        return {"kind": event.strip(), "deadline_s": _MAINTENANCE_DEADLINE_S}
+    return None
+
+
+def parse_testing_notice(spec: str) -> Optional[Dict[str, float]]:
+    """Parse the ``testing_preemption_notice`` chaos knob:
+    ``"<delay_s>:<kind>:<deadline_s>"`` (kind and deadline optional)."""
+    if not spec:
+        return None
+    parts = str(spec).split(":")
+    try:
+        delay = float(parts[0])
+    except (ValueError, IndexError):
+        logger.warning("unparseable testing_preemption_notice %r", spec)
+        return None
+    kind = parts[1] if len(parts) > 1 and parts[1] else "preempted"
+    try:
+        deadline = float(parts[2]) if len(parts) > 2 else _PREEMPTED_DEADLINE_S
+    except ValueError:
+        deadline = _PREEMPTED_DEADLINE_S
+    return {"delay_s": delay, "kind": kind, "deadline_s": deadline}
+
+
+class TpuMaintenanceWatcher:
+    """Background poller turning a platform maintenance announcement into one
+    ``on_notice({"kind", "deadline_s"})`` callback.
+
+    The transport is injectable (``fetch``) and ``testing_notice`` ("<delay>:
+    <kind>:<deadline>") synthesizes a deterministic notice without any
+    metadata server — the chaos-style test hook, like ``testing_rpc_failure``.
+    The callback fires at most once; the watcher then exits."""
+
+    def __init__(self, on_notice: Callable[[dict], None],
+                 poll_interval_s: Optional[float] = None,
+                 fetch: Optional[Callable[[str], Optional[str]]] = None,
+                 testing_notice: Optional[str] = None):
+        if poll_interval_s is None:
+            from ray_tpu._private.config import global_config
+
+            poll_interval_s = global_config().maintenance_poll_interval_s
+        self._on_notice = on_notice
+        self._poll_interval = max(float(poll_interval_s), 0.05)
+        self._fetch = fetch
+        self._testing = parse_testing_notice(testing_notice or "")
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.fired = False
+
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="tpu-maintenance-watch")
+            self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+
+    def poll_once(self) -> Optional[dict]:
+        return get_maintenance_notice(self._fetch)
+
+    def _run(self):
+        if self._testing is not None:
+            if not self._stop.wait(self._testing["delay_s"]):
+                self._fire({"kind": self._testing["kind"],
+                            "deadline_s": self._testing["deadline_s"]})
+            return
+        while not self._stop.wait(self._poll_interval):
+            notice = self.poll_once()
+            if notice is not None:
+                self._fire(notice)
+                return
+
+    def _fire(self, notice: dict):
+        self.fired = True
+        logger.warning("TPU maintenance notice: %s (deadline %.0f s)",
+                       notice.get("kind"), notice.get("deadline_s", 0.0))
+        try:
+            self._on_notice(notice)
+        except Exception:  # noqa: BLE001
+            logger.exception("maintenance notice callback failed")

@@ -81,6 +81,19 @@ class RayTpuConfig:
     task_max_retries_default: int = 3
     actor_max_restarts_default: int = 0
     lineage_reconstruction_enabled: bool = True
+    # a pushed task unacknowledged this long is probed on the executing
+    # worker (HasTask); a definitively-lost push is resent on the same
+    # lease instead of hanging the owner forever
+    task_push_ack_timeout_s: float = 10.0
+    # --- preemption / drain (maintenance watcher + graceful drain) ---
+    # how often the TPU maintenance watcher polls the GCE metadata server
+    maintenance_poll_interval_s: float = 1.0
+    # default drain window when a drain request carries no deadline (GCE
+    # preemption gives ~30 s; planned maintenance announces more)
+    drain_deadline_s: float = 60.0
+    # store-backend collective groups: member-liveness poll period; a dead
+    # or draining member aborts the group's pending ops within ~this bound
+    collective_abort_poll_interval_s: float = 0.5
     # --- task events / observability ---
     task_events_enabled: bool = True
     task_events_max_buffer: int = 10000
@@ -96,6 +109,13 @@ class RayTpuConfig:
     # Format mirrors RAY_testing_rpc_failure (reference: src/ray/rpc/rpc_chaos.h:23-35):
     # "method1=max_failures:req_prob:resp_prob,method2=..."
     testing_rpc_failure: str = ""
+    # Deterministic preemption injection for the maintenance watcher
+    # (chaos-style, like testing_rpc_failure): "<delay_s>:<kind>:<deadline_s>"
+    # e.g. "0.5:preempted:30" — after 0.5 s the watcher reports a synthetic
+    # preemption notice with a 30 s deadline.  Empty disables.  Tests that
+    # want to preempt ONE node of a cluster pass the same spec to that
+    # node's Raylet directly (testing_preemption_notice=...) instead.
+    testing_preemption_notice: str = ""
 
     def __post_init__(self):
         for f in fields(self):

@@ -51,6 +51,13 @@ class NodeInfo:
     state: str = "ALIVE"              # ALIVE | DRAINING | DEAD
     last_report: float = field(default_factory=time.monotonic)
     is_head: bool = False
+    # drain lifecycle (preemption / maintenance): why the node is draining,
+    # the wall-clock deadline the platform announced, when the drain started
+    # (monotonic, for the drain-latency metric), and why the node died
+    drain_reason: str = ""
+    drain_deadline: float = 0.0
+    drain_started: float = 0.0
+    death_reason: str = ""
 
 
 @dataclass
@@ -355,11 +362,56 @@ class GcsServer:
             return self._cluster_view()
 
     def HandleDrainNode(self, req):
+        """Begin a node's graceful drain (reference: gcs_node_manager drain +
+        autoscaler v2 drain protocol).  Grows reason + deadline: the node is
+        excluded from all new placement, a node-draining event goes out over
+        pubsub, and actors with restart budget are proactively restarted on
+        surviving nodes instead of waiting for health-check death."""
         node_id = req["node_id"]
+        reason = req.get("reason", "drain requested")
+        deadline = float(req.get("deadline") or 0.0)  # unix ts; 0 = unknown
         with self._lock:
             info = self.nodes.get(node_id)
-            if info:
-                info.state = "DRAINING"
+            if info is None:
+                return False
+            if info.state != "ALIVE":
+                return True  # already draining/dead: idempotent
+            info.state = "DRAINING"
+            info.drain_reason = reason
+            info.drain_deadline = deadline
+            info.drain_started = time.monotonic()
+            # still in the cluster view (running leases keep their booking)
+            # but invisible to every new scheduling/placement decision
+            self.scheduler.set_draining(node_id)
+            restartable = [
+                a.actor_id for a in self.actors.values()
+                if a.node_id == node_id and a.state == "ALIVE"
+                and (a.spec.max_restarts == -1
+                     or a.num_restarts < a.spec.max_restarts)
+                # pinned actors are excluded: a PG actor's bundle and a
+                # hard-node-affinity actor's target are ON this very node —
+                # killing them can't relocate them (the restart would wedge
+                # in RESTARTING once the node is excluded); their owners
+                # (train controller, the pinning caller) handle the drain
+                and (a.spec.strategy is None
+                     or (a.spec.strategy.kind != "placement_group"
+                         and not (a.spec.strategy.kind == "node_affinity"
+                                  and not a.spec.strategy.soft)))
+            ]
+        runtime_metrics.inc_node_drain(reason)
+        logger.warning("GCS: node %s draining (%s); %d restartable actors "
+                       "to relocate", node_id, reason, len(restartable))
+        self.pubsub.publish("NODE", {"event": "draining", "node_id": node_id,
+                                     "reason": reason, "deadline": deadline})
+        self._record_event("WARNING", "gcs",
+                           f"node {node_id} draining: {reason}",
+                           node_id=node_id, reason=reason, deadline=deadline)
+        # proactive restart: kill-with-restart-budget relocates the actor NOW
+        # (the scheduler already excludes this node), instead of burning the
+        # drain window waiting for the node to die under it
+        for aid in restartable:
+            self._kill_actor(aid, no_restart=False,
+                             reason=f"node {node_id} draining")
         return True
 
     def HandleNodeDead(self, req):
@@ -375,6 +427,10 @@ class GcsServer:
                     "state": i.state,
                     "is_head": i.is_head,
                     "resources": i.resources.snapshot(),
+                    "draining": i.state == "DRAINING",
+                    "drain_reason": i.drain_reason,
+                    "drain_deadline": i.drain_deadline,
+                    "death_reason": i.death_reason,
                 }
                 for nid, i in self.nodes.items()
             ]
@@ -384,9 +440,15 @@ class GcsServer:
             info = self.nodes.get(node_id)
             if info is None or info.state == "DEAD":
                 return
+            was_draining = info.state == "DRAINING"
             info.state = "DEAD"
+            info.death_reason = reason
             self.scheduler.remove_node(node_id)
             dead_actors = [a for a in self.actors.values() if a.node_id == node_id and a.state in ("ALIVE", "PENDING")]
+        if was_draining and info.drain_started:
+            # drain latency: DRAINING -> DEAD("drained"), the graceful window
+            runtime_metrics.observe_drain_latency(
+                time.monotonic() - info.drain_started)
         logger.warning("GCS: node %s dead (%s); %d actors affected", node_id, reason, len(dead_actors))
         self.pubsub.publish("NODE", {"event": "dead", "node_id": node_id})
         self._record_event("WARNING", "gcs", f"node {node_id} dead: {reason}",
@@ -400,13 +462,20 @@ class GcsServer:
         while not self._stopped.wait(period):
             cutoff = time.monotonic() - period * cfg.health_check_failure_threshold
             with self._lock:
-                stale = [nid for nid, i in self.nodes.items() if i.state == "ALIVE" and i.last_report < cutoff and not i.is_head]
+                # DRAINING nodes are swept too: a draining node that dies
+                # ungracefully (preempted before the drain finished) must
+                # not linger in DRAINING forever — it goes DEAD("drained")
+                stale = [(nid, i.state) for nid, i in self.nodes.items()
+                         if i.state in ("ALIVE", "DRAINING")
+                         and i.last_report < cutoff and not i.is_head]
                 runtime_metrics.set_gcs_sink_sizes(
                     len(self.task_events), len(self.metrics_by_reporter),
                     len(self.events))
             runtime_metrics.maybe_push()
-            for nid in stale:
-                self._mark_node_dead(nid, "missed health checks")
+            for nid, state in stale:
+                self._mark_node_dead(
+                    nid, "drained" if state == "DRAINING"
+                    else "missed health checks")
 
     # ------------------------------------------------------------------
     # Jobs
@@ -593,6 +662,12 @@ class GcsServer:
         with self._lock:
             info = self.actors.get(actor_id)
             if info is None or info.state == "DEAD":
+                return
+            if info.state == "RESTARTING" and not force_dead:
+                # duplicate death report for the same incarnation (a drain's
+                # proactive kill is followed by the raylet's worker-death
+                # report): the restart is already queued — a second charge
+                # would burn restart budget AND double-schedule the actor
                 return
             can_restart = (not force_dead) and (
                 info.spec.max_restarts == -1 or info.num_restarts < info.spec.max_restarts

@@ -90,6 +90,7 @@ class Raylet:
         is_head: bool = False,
         node_id: Optional[NodeID] = None,
         env: Optional[Dict[str, str]] = None,
+        testing_preemption_notice: Optional[str] = None,
     ):
         self.node_id = node_id or NodeID.random()
         self.gcs_address = tuple(gcs_address)
@@ -172,6 +173,13 @@ class Raylet:
         self._leases: Dict[str, _Lease] = {}
         self._bundles: Dict[PlacementGroupID, Dict[int, _Bundle]] = {}
         self._draining = False
+        self._drain_reason = ""
+        self._drain_deadline_ts: Optional[float] = None   # wall clock
+        self._drain_deadline_mono: float = 0.0
+        # set once the drain finished and NodeDead("drained") went out: the
+        # report loop must stop, or the GCS's {"restart": True} reply would
+        # resurrect the dead node as a fresh ALIVE registration
+        self._drain_complete = threading.Event()
         self._stopped = threading.Event()
         self._lease_counter = 0
         # worker address -> exit reason ("oom"); owners query this to turn a
@@ -214,12 +222,39 @@ class Raylet:
         for t in self._threads:
             t.start()
 
+        # Preemption/maintenance watcher: on TPU hosts poll the GCE metadata
+        # server (instance/preempted, maintenance-event) and turn a platform
+        # notice into a graceful self-drain; testing_preemption_notice (the
+        # per-node arg or the cluster config knob) injects a deterministic
+        # synthetic notice for tests.
+        self._maintenance_watcher = None
+        notice_spec = (testing_preemption_notice
+                       if testing_preemption_notice is not None
+                       else global_config().testing_preemption_notice)
+        from ray_tpu._private.accelerators.tpu import (
+            TPUAcceleratorManager,
+            TpuMaintenanceWatcher,
+        )
+
+        watch_hardware = (
+            TPUAcceleratorManager.get_current_node_num_accelerators() > 0
+            and not os.environ.get("RAY_TPU_DISABLE_METADATA_SERVER"))
+        if notice_spec or watch_hardware:
+            self._maintenance_watcher = TpuMaintenanceWatcher(
+                on_notice=self._on_maintenance_notice,
+                poll_interval_s=global_config().maintenance_poll_interval_s,
+                testing_notice=notice_spec or None,
+            )
+            self._maintenance_watcher.start()
+
     @property
     def address(self) -> Tuple[str, int]:
         return self.server.address
 
     def shutdown(self):
         self._stopped.set()
+        if self._maintenance_watcher is not None:
+            self._maintenance_watcher.stop()
         self._log_monitor.stop()
         with self._lock:
             workers = list(self._all_workers.values())
@@ -272,6 +307,9 @@ class Raylet:
                     self.cluster.add_or_update_node(nid, node)
                 node.available = ResourceSet(snap["available"])
                 node.address = tuple(snap["address"])  # type: ignore[attr-defined]
+                # DRAINING peers stay in the view (their running leases are
+                # real) but take no spillback from this node's dispatch
+                self.cluster.set_draining(nid, snap.get("state") == "DRAINING")
             for nid in list(self.cluster.nodes):
                 if nid != self.node_id and nid not in seen:
                     self.cluster.remove_node(nid)
@@ -301,6 +339,11 @@ class Raylet:
 
     def _report_loop(self):
         while not self._stopped.wait(global_config().resource_report_interval_s):
+            if self._drain_complete.is_set():
+                # drained-to-death: reporting again would make the GCS reply
+                # {"restart": True} and resurrect this node as a fresh ALIVE
+                # registration
+                continue
             try:
                 interval = global_config().metrics_report_interval_s
                 now = time.monotonic()
@@ -930,14 +973,107 @@ class Raylet:
         return True
 
     def HandleDrainRaylet(self, req):
+        req = req or {}
+        return self._initiate_drain(
+            reason=req.get("reason", "drain requested"),
+            deadline_s=req.get("deadline_s"),
+            source=req.get("source", "rpc"),
+        )
+
+    def HandleGetDrainInfo(self, req):
+        """Workers poll this to expose ``preemption_deadline()`` through the
+        runtime context (reference direction: the drain deadline hint the
+        autoscaler v2 drain protocol carries)."""
         with self._lock:
+            return {
+                "draining": self._draining,
+                "reason": self._drain_reason,
+                "deadline": self._drain_deadline_ts,
+            }
+
+    def _on_maintenance_notice(self, notice: dict):
+        """Maintenance watcher callback: the platform announced this host is
+        going away — start the graceful drain with the announced window."""
+        self._initiate_drain(
+            reason=f"preemption: {notice.get('kind', 'maintenance')}",
+            deadline_s=notice.get("deadline_s"),
+            source="maintenance-watcher",
+        )
+
+    def _initiate_drain(self, reason: str, deadline_s: Optional[float] = None,
+                        source: str = "rpc") -> bool:
+        """Graceful drain: stop taking work, tell the GCS (reason+deadline),
+        let running leases finish, then announce NodeDead("drained").
+
+        reference: HandleDrainRaylet node_manager.cc:1893 grown into the full
+        preemption lifecycle — queued leases are rejected so owners resubmit
+        to surviving nodes; running work gets until the deadline."""
+        if deadline_s is None:
+            deadline_s = global_config().drain_deadline_s
+        with self._lock:
+            if self._draining:
+                return True  # idempotent: first notice wins
             self._draining = True
+            self._drain_reason = reason
+            self._drain_deadline_ts = time.time() + deadline_s
+            self._drain_deadline_mono = time.monotonic() + deadline_s
             pend = list(self._pending_leases)
             self._pending_leases.clear()
+            # local view: never spill new work onto ourselves again
+            self.cluster.set_draining(self.node_id)
+        logger.warning(
+            "raylet %s draining (%s, via %s): deadline in %.0f s, "
+            "%d queued leases rejected",
+            self.node_id, reason, source, deadline_s, len(pend))
         for p in pend:
             self.server.send_reply(p.reply_token, {"rejected": True, "reason": "draining"})
-        self.gcs.notify("DrainNode", {"node_id": self.node_id})
+        # the announcement must land — a silently lost DrainNode would leave
+        # the GCS placing new work here and charging the eventual death as a
+        # failure — so it retries off-thread until delivered (or the drain
+        # window plus slack expires)
+        threading.Thread(target=self._announce_drain, args=(reason, source),
+                         daemon=True, name="raylet-drain-announce").start()
+        threading.Thread(target=self._drain_monitor, daemon=True,
+                         name="raylet-drain").start()
         return True
+
+    def _announce_drain(self, reason: str, source: str):
+        payload = {
+            "node_id": self.node_id, "reason": reason,
+            "deadline": self._drain_deadline_ts, "source": source,
+        }
+        give_up = self._drain_deadline_mono + 30.0
+        while not self._stopped.is_set() and time.monotonic() < give_up:
+            try:
+                self.gcs.call("DrainNode", payload,
+                              timeout=5, retry_deadline=0.0)
+                return
+            except Exception:  # noqa: BLE001 — GCS down/restarting; retry
+                self._stopped.wait(1.0)
+        logger.warning("raylet %s: DrainNode announcement never reached "
+                       "the GCS", self.node_id)
+
+    def _drain_monitor(self):
+        """Wait for running leases to finish (or the deadline), then report
+        this node DEAD("drained") and go silent."""
+        while not self._stopped.is_set():
+            with self._lock:
+                idle = (not self._leases and not self._grants_waiting_worker
+                        and not self._pending_leases)
+            if idle or time.monotonic() >= self._drain_deadline_mono:
+                break
+            time.sleep(0.1)
+        if self._stopped.is_set():
+            return
+        self._drain_complete.set()
+        try:
+            self.gcs.call("NodeDead",
+                          {"node_id": self.node_id, "reason": "drained"},
+                          timeout=5, retry_deadline=5.0)
+        except Exception:  # noqa: BLE001 — the health sweep converges on
+            pass  # DEAD("drained") from staleness if this never lands
+        logger.warning("raylet %s drain complete: reported NodeDead(drained)",
+                       self.node_id)
 
     # ------------------------------------------------------------------
     # Placement-group bundles (reference: node_manager.cc:1761,1777,1794;
@@ -1392,6 +1528,7 @@ class Raylet:
         with self._lock:
             return {
                 "node_id": self.node_id,
+                "draining": self._draining,
                 "num_workers": len(self._all_workers),
                 "idle_workers": sum(len(p) for p in self._idle_workers.values()),
                 "pending_leases": len(self._pending_leases),

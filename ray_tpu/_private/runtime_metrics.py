@@ -87,6 +87,20 @@ GCS_SINK_SIZE = Gauge(
     "cluster events)",
     tag_keys=("sink",))
 
+# -- preemption / drain lifecycle -------------------------------------------
+# drains can take anywhere from seconds (idle node) to the full platform
+# window (minutes of running-lease runout)
+_DRAIN_BOUNDS = [0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0,
+                 600.0]
+NODE_DRAINS = Counter(
+    "ray_tpu_node_drains_total",
+    "Nodes entering the DRAINING state, by drain reason",
+    tag_keys=("reason",))
+NODE_DRAIN_LATENCY = Histogram(
+    "ray_tpu_node_drain_latency_seconds",
+    "Graceful-drain duration: DRAINING to DEAD(drained)",
+    boundaries=_DRAIN_BOUNDS, tag_keys=())
+
 # -- object store -----------------------------------------------------------
 STORE_STORED_BYTES = Counter(
     "ray_tpu_object_store_stored_bytes_total",
@@ -165,6 +179,11 @@ COLLECTIVE_ALGORITHM = Counter(
     "ray_tpu_collective_algorithm_total",
     "Collective ops by the algorithm/scheme the selection policy chose",
     tag_keys=("op", "backend", "algorithm", "scheme"))
+COLLECTIVE_ABORTS = Counter(
+    "ray_tpu_collective_aborts_total",
+    "Collective groups aborted promptly on member death/drain (pending ops "
+    "raise CollectiveAbortError instead of hanging to timeout)",
+    tag_keys=("backend", "group"))
 
 # -- tpu --------------------------------------------------------------------
 TPU_CHIPS = Gauge(
@@ -200,13 +219,14 @@ FAMILIES = (
     WORKER_SPAWN_LATENCY, WORKER_SPAWNS, WORKER_SPAWN_TIMEOUTS,
     ZYGOTE_FALLBACKS, WORKERS, DISPATCH_SECONDS,
     GCS_RPC_LATENCY, GCS_SINK_SIZE,
+    NODE_DRAINS, NODE_DRAIN_LATENCY,
     STORE_STORED_BYTES, STORE_SPILLED_BYTES, STORE_RESTORED_BYTES,
     STORE_USED_BYTES, STORE_OBJECTS,
     TASK_SUBMIT_TO_START, TASK_EXECUTION, TASK_SERIALIZED_BYTES,
     COLLECTIVE_LATENCY, COLLECTIVE_BYTES, COLLECTIVE_BUS_BW,
     COLLECTIVE_LOGICAL_BYTES, COLLECTIVE_WIRE_BYTES,
     COLLECTIVE_INTER_SLICE_BYTES, COLLECTIVE_QUANT_ERROR,
-    COLLECTIVE_ALGORITHM,
+    COLLECTIVE_ALGORITHM, COLLECTIVE_ABORTS,
     TPU_CHIPS, TPU_PROCESS_CHIPS,
     SERVE_REQUEST_LATENCY, SERVE_REQUESTS,
     DATA_ROWS, DATA_BACKPRESSURE,
@@ -304,6 +324,21 @@ def inc_zygote_fallback() -> None:
 
 def observe_gcs_rpc(method: str, seconds: float) -> None:
     _bound(GCS_RPC_LATENCY, method=method).observe(seconds)
+
+
+def inc_node_drain(reason: str) -> None:
+    _bound(NODE_DRAINS, reason=reason).inc()
+
+
+_drain_latency = NODE_DRAIN_LATENCY.with_tags()
+
+
+def observe_drain_latency(seconds: float) -> None:
+    _drain_latency.observe(seconds)
+
+
+def inc_collective_abort(backend: str, group: str) -> None:
+    _bound(COLLECTIVE_ABORTS, backend=backend, group=group).inc()
 
 
 def set_gcs_sink_sizes(task_events: int, reporters: int, events: int) -> None:

@@ -68,6 +68,10 @@ class ClusterResourceScheduler:
     def __init__(self, local_node_id: Optional[NodeID] = None):
         self.local_node_id = local_node_id
         self.nodes: Dict[NodeID, NodeResources] = {}
+        # nodes announced as DRAINING (preemption / maintenance): still in
+        # the view (running leases keep their resources booked) but excluded
+        # from every placement decision — new work must land on survivors
+        self._draining: set = set()
         # guards the nodes MAP (RPC threads add/remove while the scheduling
         # thread iterates — dict-size-changed races otherwise); the
         # NodeResources values stay mutable-in-place (GIL-atomic swaps)
@@ -89,10 +93,23 @@ class ClusterResourceScheduler:
     def remove_node(self, node_id: NodeID):
         with self._nodes_lock:
             self.nodes.pop(node_id, None)
+            self._draining.discard(node_id)
+
+    def set_draining(self, node_id: NodeID, draining: bool = True):
+        with self._nodes_lock:
+            if draining:
+                self._draining.add(node_id)
+            else:
+                self._draining.discard(node_id)
+
+    def is_draining(self, node_id: NodeID) -> bool:
+        with self._nodes_lock:
+            return node_id in self._draining
 
     def _nodes_snapshot(self) -> Dict[NodeID, NodeResources]:
         with self._nodes_lock:
-            return dict(self.nodes)
+            return {nid: n for nid, n in self.nodes.items()
+                    if nid not in self._draining}
 
     # -- selection ---------------------------------------------------------
 
@@ -107,6 +124,8 @@ class ClusterResourceScheduler:
         if strategy.kind == "node_affinity":
             with self._nodes_lock:
                 node = self.nodes.get(strategy.node_id)
+                if strategy.node_id in self._draining:
+                    node = None  # a draining node takes no new work
             if node is not None and node.feasible(demand):
                 if not requires_available or node.can_allocate(demand):
                     return strategy.node_id

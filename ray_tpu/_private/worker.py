@@ -501,6 +501,13 @@ class CoreWorker:
             self.job_id = self.gcs.call("RegisterJob", {"driver_addr": self.server.address})
 
         self.current_task_id: Optional[TaskID] = None
+        # (task_id hex, attempt) of pushes received but not yet replied —
+        # the owner's lost-push probe (HasTask) reads this; entries clear
+        # when the reply goes out
+        self._received_pushes: set = set()
+        self._received_pushes_lock = threading.Lock()
+        # cached GetDrainInfo from the local raylet: (expires_mono, info)
+        self._drain_info_cache: Optional[Tuple[float, Optional[dict]]] = None
         # pubsub subscriptions this worker holds; re-issued periodically so a
         # restarted GCS (or a transient-failure eviction, gcs.py Pubsub
         # 3-strike rule) cannot silently orphan a live subscriber
@@ -619,6 +626,28 @@ class CoreWorker:
         self.server.shutdown()
         self.plasma.close()
         self.pool.close_all()
+
+    def get_preemption_deadline(self) -> Optional[float]:
+        """Wall-clock deadline (unix seconds) by which this worker's node
+        will be gone, or None when the node is not draining.  Exposed as
+        ``get_runtime_context().preemption_deadline()`` so long-running user
+        code (training steps, batch jobs) can checkpoint ahead of a
+        preemption instead of dying with the node.  The raylet's drain state
+        is polled with a ~1 s cache, so calling this every step is cheap."""
+        now = time.monotonic()
+        cached = self._drain_info_cache
+        if cached is not None and now < cached[0]:
+            info = cached[1]
+        else:
+            try:
+                info = self.raylet.call("GetDrainInfo", {},
+                                        timeout=2, retry_deadline=0.0)
+            except Exception:  # noqa: BLE001
+                info = None
+            self._drain_info_cache = (now + 1.0, info)
+        if info and info.get("draining"):
+            return info.get("deadline")
+        return None
 
     def notify_owner(self, owner_addr, method, payload):
         if owner_addr is None or self.shutting_down:
@@ -1238,9 +1267,8 @@ class CoreWorker:
         worker_addr = tuple(lease["worker_addr"])
         self._task_exec_addr[spec.task_id] = worker_addr
         try:
-            reply = self.pool.get(worker_addr).call(
-                "PushTask", {"spec": spec, "lease": lease}, timeout=None, retry_deadline=0
-            )
+            reply = self._push_task_with_ack(
+                self.pool.get(worker_addr), spec, lease)
         except ConnectionLost:
             # the leasing raylet knows WHY the worker went away (its memory
             # monitor records OOM kills — reference memory_monitor.h:52)
@@ -1260,6 +1288,49 @@ class CoreWorker:
             self._task_exec_addr.pop(spec.task_id, None)
             self._task_lease_raylet.pop(spec.task_id, None)
         self._handle_task_reply(spec, reply, worker_addr)
+
+    def _push_task_with_ack(self, cli, spec: TaskSpec, lease: dict):
+        """Push the task and wait for its (possibly hours-long) reply, with a
+        lost-push heal: if the push frame vanished in flight (chaos drop,
+        kernel buffer teardown), the unacknowledged owner used to block
+        forever on the timeout=None call.  Now, after task_push_ack_timeout_s
+        without a reply, the worker is probed (HasTask); a worker that never
+        saw this (task, attempt) gets the push RESENT on the same lease —
+        duplicates are impossible because the worker registers receipt before
+        executing and ignores repeat frames for a live attempt."""
+        from concurrent.futures import FIRST_COMPLETED
+        from concurrent.futures import wait as _futures_wait
+
+        payload = {"spec": spec, "lease": lease}
+        futs = [cli.call_async("PushTask", payload)]
+        interval = max(global_config().task_push_ack_timeout_s, 0.1)
+        confirmed = False
+        while True:
+            done, _ = _futures_wait(
+                futs, timeout=None if confirmed else interval,
+                return_when=FIRST_COMPLETED)
+            if done:
+                ok = [f for f in done if f.exception() is None]
+                return (ok[0] if ok else next(iter(done))).result()
+            try:
+                seen = cli.call(
+                    "HasTask",
+                    {"task_id": spec.task_id.hex(), "attempt": spec.attempt},
+                    timeout=5, retry_deadline=0.0)
+            except Exception:  # noqa: BLE001 — probe inconclusive; a dead
+                continue  # socket surfaces ConnectionLost on the futures
+            if seen:
+                confirmed = True  # delivered; now just a long-running task
+            elif not any(f.done() for f in futs):
+                # Not-seen AND no reply: genuinely lost.  (A finished task
+                # also reads not-seen, but its reply frame precedes the probe
+                # reply on the same FIFO socket, so a done future is visible
+                # HERE before a completion-caused False — resending cannot
+                # duplicate an executed task.)
+                logger.warning(
+                    "push of task %s (attempt %d) to %s was lost; resending",
+                    spec.name, spec.attempt, cli.address)
+                futs.append(cli.call_async("PushTask", payload))
 
     def _acquire_lease(self, spec: TaskSpec):
         """Request a worker lease, following spillback redirects
@@ -1478,8 +1549,23 @@ class CoreWorker:
     # ------------------------------------------------------------------
 
     def HandlePushTask(self, req, reply_token=None):
+        spec: TaskSpec = req["spec"]
+        key = (spec.task_id.hex(), spec.attempt)
+        with self._received_pushes_lock:
+            if key in self._received_pushes:
+                # duplicate of a live attempt (the owner's lost-push probe
+                # resent it while the original frame was still in the server
+                # backlog): the first frame's reply settles the owner
+                return RpcServer.DELAYED_REPLY
+            self._received_pushes.add(key)
         self._exec_pool.submit(self._execute_task, req, reply_token)
         return RpcServer.DELAYED_REPLY
+
+    def HandleHasTask(self, req):
+        """Owner-side lost-push probe: has this (task, attempt) been
+        received here?  (push heal — see _push_task_with_ack)."""
+        with self._received_pushes_lock:
+            return (req["task_id"], req.get("attempt", 0)) in self._received_pushes
 
     def _execute_task(self, req, reply_token):
         spec: TaskSpec = req["spec"]
@@ -1561,6 +1647,9 @@ class CoreWorker:
                  "traceback": traceback.format_exc()},
             )
         finally:
+            with self._received_pushes_lock:
+                self._received_pushes.discard(
+                    (spec.task_id.hex(), spec.attempt))
             try:
                 self.raylet.notify("ReturnWorker", {"lease_id": lease.get("lease_id")})
             except BaseException:  # noqa: BLE001 (incl. late-delivered cancel KI)
@@ -1781,6 +1870,9 @@ class CoreWorker:
     def HandleCreateActor(self, req):
         spec: TaskSpec = req["spec"]
         lease: dict = req["lease"]
+        # identity is live DURING __init__: constructor code (e.g. collective
+        # group membership registration) must see which actor it runs in
+        self.actor_id = spec.actor_id
         try:
             bind_visible_accelerators(lease.get("resource_instances"))
             cls = self._load_function(spec)
@@ -1789,8 +1881,8 @@ class CoreWorker:
                 kwargs = {k: self._unpack_arg((kind, p)) for k, kind, p in spec.kwargs}
                 instance = cls(*args, **kwargs)
         except Exception as e:  # noqa: BLE001
+            self.actor_id = None
             return {"ok": False, "error": f"{e}\n{traceback.format_exc()}"}
-        self.actor_id = spec.actor_id
         self._actor_instance = instance
         self._actor_spec = spec
         self._actor_lease = lease

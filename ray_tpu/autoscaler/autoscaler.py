@@ -68,6 +68,9 @@ class Autoscaler:
         from ray_tpu.autoscaler.instance_manager import InstanceManager
 
         self._im = InstanceManager(provider)
+        # RAY_RUNNING instances already granted a preemption replacement —
+        # one replacement group per drained slice, not one per tick
+        self._preempt_replaced: set = set()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -79,12 +82,15 @@ class Autoscaler:
         stats = {}
         dead = set()
         alive = set()
+        draining = set()
         for node in self._w.gcs.call("GetAllNodeInfo", {}) or []:
             nid = node["node_id"]
             nid = nid.hex() if hasattr(nid, "hex") else nid
             if node.get("state") == "DEAD":
                 dead.add(nid)
                 continue
+            if node.get("state") == "DRAINING":
+                draining.add(nid)
             alive.add(nid)
             try:
                 s = self._w.pool.get(tuple(node["address"])).call(
@@ -93,6 +99,9 @@ class Autoscaler:
             except Exception:  # noqa: BLE001
                 continue
         self._dead_nodes = dead
+        # DRAINING nodes are the preemption-replacement signal: their gang
+        # gets a replacement group launched BEFORE the platform takes them
+        self._draining_nodes = draining
         # GCS-ALIVE is the liveness authority for the instance manager: a
         # node that merely failed a stats RPC must NOT look dead (the IM
         # would terminate its whole gang)
@@ -158,6 +167,39 @@ class Autoscaler:
                 counts[spec.name] = counts.get(spec.name, 0) + 1
                 launched.append(spec.name)
 
+        # 1.5 preemption replacement: a RAY_RUNNING group with a node in
+        # DRAINING (or DEAD) is going away — launch its replacement NOW so
+        # the new slice boots inside the drain window, not after the death
+        # (the preemptible-capacity economics of arxiv 2605.25645 only work
+        # if reclaimed slices are replaced proactively)
+        doomed_nodes = (set(getattr(self, "_draining_nodes", ()))
+                        | set(getattr(self, "_dead_nodes", ())))
+        if doomed_nodes:
+            from ray_tpu.autoscaler.instance_manager import RAY_RUNNING
+
+            for inst in self._im.instances({RAY_RUNNING}):
+                if inst.instance_id in self._preempt_replaced:
+                    continue
+                g = live.get(inst.provider_id)
+                if g is None:
+                    continue
+                ids = {n.hex() if hasattr(n, "hex") else str(n)
+                       for n in g.get("node_ids", [])}
+                if not (ids & doomed_nodes):
+                    continue
+                spec = self._specs.get(inst.group_name)
+                if spec is None:
+                    continue
+                self._im.request(
+                    spec.name, spec.node_resources, spec.count, spec.labels)
+                counts[spec.name] = counts.get(spec.name, 0) + 1
+                launched.append(spec.name)
+                self._preempt_replaced.add(inst.instance_id)
+                logger.warning(
+                    "autoscaler: group %s (%s) preempted/draining; "
+                    "replacement %s requested", inst.provider_id,
+                    inst.group_name, spec.name)
+
         # 2. unmet demand -> bin-pack group types (first-fit by shape)
         demands = self.pending_demands(stats)
         if demands:
@@ -212,6 +254,9 @@ class Autoscaler:
         # QUEUED instances become provider groups on the NEXT im.reconcile;
         # run it again so a launch decided this tick is visible to callers
         self._im.reconcile(alive_ids)
+        # replacement bookkeeping stays bounded: forget instances the IM gc'd
+        self._preempt_replaced &= {
+            i.instance_id for i in self._im.instances()}
         return {"launched": launched, "terminated": terminated}
 
     @staticmethod

@@ -62,6 +62,7 @@ class Cluster:
             object_store_memory=object_store_memory,
             is_head=self.head_node is None,
             env=env,
+            **kwargs,  # e.g. testing_preemption_notice targets ONE node
         )
         self.nodes.append(node)
         if self.head_node is None:

@@ -36,6 +36,13 @@ class RuntimeContext:
         ids = TPUAcceleratorManager.get_current_process_visible_accelerator_ids()
         return {"TPU": ids or []}
 
+    def preemption_deadline(self):
+        """Wall-clock deadline (unix seconds) by which this process's node
+        will be preempted/maintenance-cycled, or None when the node is not
+        draining.  Long-running steps use it to checkpoint ahead of the
+        platform taking the host (cheap: ~1 s-cached raylet poll)."""
+        return self._worker.get_preemption_deadline()
+
     # reference-compat getter aliases (python/ray/runtime_context.py)
     def get_job_id(self):
         return self.job_id

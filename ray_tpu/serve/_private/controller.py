@@ -192,6 +192,7 @@ class ServeController:
         import ray_tpu
 
         self._drain_step()
+        self._drain_nodes_step()
         with self._lock:
             desired = {app: dict(deps) for app, deps in self._desired.items()}
         # Phase 1 (under the lock): retire replicas — deleted apps/deployments
@@ -327,6 +328,56 @@ class ServeController:
         finally:
             with self._lock:
                 self._starting.discard((app, dep_name))
+
+    def _drain_nodes_step(self):
+        """Preemption-aware replica drain: replicas on a DRAINING node are
+        flipped out of the router (version bump) and queued through the
+        existing rollout-drain machinery — they finish their in-flight
+        requests while the reconcile loop starts replacements on surviving
+        nodes (the scheduler already excludes DRAINING nodes)."""
+        now = time.monotonic()
+        if now < getattr(self, "_next_node_poll", 0.0):
+            return
+        self._next_node_poll = now + 1.0
+        import ray_tpu
+        from ray_tpu._private.worker import get_global_worker
+
+        try:
+            nodes = ray_tpu.nodes() or []
+        except Exception:  # noqa: BLE001
+            return
+        draining = {n["node_id"].hex() for n in nodes
+                    if n.get("state") == "DRAINING"}
+        if not draining:
+            return
+        try:
+            actors = get_global_worker().gcs.call(
+                "ListActors", {}, timeout=2, retry_deadline=0.0) or []
+        except Exception:  # noqa: BLE001
+            return
+        node_of = {
+            a["actor_id"].hex(): (a["node_id"].hex() if a["node_id"] else None)
+            for a in actors
+        }
+        moved = 0
+        with self._lock:
+            for app, deps in self._replicas.items():
+                for dep, recs in deps.items():
+                    victims = [
+                        r for r in recs
+                        if node_of.get(r["h"]._actor_id.hex()) in draining
+                    ]
+                    if victims:
+                        for r in victims:
+                            recs.remove(r)
+                        self._begin_drain(victims)
+                        self._version += 1
+                        moved += len(victims)
+        if moved:
+            logger.warning(
+                "serve: moved %d replica(s) off draining node(s) %s "
+                "(graceful: in-flight requests finish; replacements "
+                "starting on survivors)", moved, sorted(draining))
 
     def _begin_drain(self, recs):
         """Queue replicas for graceful stop (caller holds the lock): they are

@@ -62,6 +62,11 @@ class BackendExecutor:
         # rank assignment: sort by node so local ranks pack per host
         infos = self.worker_group.call("_node_info")
         node_ids = [i["node_id"] for i in infos]
+        # which nodes host this gang — the trainer's drain watch compares
+        # these against GCS node states to catch preemption notices mid-run
+        self.worker_node_ids = [
+            nid.hex() if hasattr(nid, "hex") else str(nid) for nid in node_ids
+        ]
         local_rank: Dict[str, int] = {}
         node_rank: Dict[str, int] = {}
         import ray_tpu

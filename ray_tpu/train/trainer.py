@@ -41,6 +41,19 @@ class _ElasticRegrow(Exception):
         self.target = target
 
 
+class _PreemptionDrain(Exception):
+    """Control-flow signal: a node hosting gang workers announced a drain
+    (preemption / maintenance).  Treated exactly like an elastic resize:
+    stop after the latest persisted checkpoint and restart the gang on
+    surviving nodes (the scheduler already excludes DRAINING nodes).  The
+    platform announced this in advance — NOT a failure, never counted
+    against max_failures."""
+
+    def __init__(self, nodes):
+        super().__init__(f"gang nodes draining: {sorted(nodes)}")
+        self.nodes = list(nodes)
+
+
 @dataclasses.dataclass
 class Result:
     """reference: ray.train.Result (air/result.py)."""
@@ -140,6 +153,7 @@ class DataParallelTrainer:
                 executor.start_training(self._train_fn, self._train_config)
                 final_metrics: Dict[str, Any] = {}
                 growth_check_at = time.monotonic()
+                drain_check_at = time.monotonic()
                 while True:
                     results, finished, error = executor.poll()
                     # persist same-round checkpoints before acting on an error
@@ -154,6 +168,16 @@ class DataParallelTrainer:
                         raise TrainingFailedError(error)
                     if finished:
                         break
+                    # preemption watch: a drain notice on a gang node is
+                    # handled like an elastic resize — this round's
+                    # checkpoints are already persisted above, so restart
+                    # from them on the surviving nodes
+                    now = time.monotonic()
+                    if now - drain_check_at >= 1.0:
+                        drain_check_at = now
+                        draining = self._gang_draining_nodes(executor)
+                        if draining:
+                            raise _PreemptionDrain(draining)
                     # elastic growth (reference: the v2 controller polls its
                     # ScalingPolicy each loop iteration — controller.py:439):
                     # when new capacity fits a bigger gang AND a checkpoint
@@ -173,6 +197,15 @@ class DataParallelTrainer:
                     metrics=final_metrics, checkpoint=latest_ckpt, path=run_dir,
                     metrics_history=history,
                 )
+            except _PreemptionDrain as d:
+                # the platform announced the node is going away: restart the
+                # gang on survivors from the latest checkpoint — the drain
+                # was announced in advance, so no max_failures credit burns
+                executor.shutdown()
+                logger.warning(
+                    "preemption drain on gang node(s) %s: restarting gang "
+                    "from %s (not counted against max_failures)",
+                    d.nodes, latest_ckpt)
             except _ElasticRegrow as g:
                 # not a failure: stop after the checkpoint already persisted,
                 # restart at the larger size the policy just observed
@@ -203,6 +236,24 @@ class DataParallelTrainer:
                     failures, e, latest_ckpt,
                 )
                 time.sleep(min(2.0 * failures, 10.0))
+
+    @staticmethod
+    def _gang_draining_nodes(executor: BackendExecutor):
+        """Gang-hosting nodes currently DRAINING in the GCS (hex ids)."""
+        gang = set(getattr(executor, "worker_node_ids", None) or ())
+        if not gang:
+            return []
+        try:
+            import ray_tpu
+
+            states = {
+                (n["node_id"].hex() if hasattr(n["node_id"], "hex")
+                 else str(n["node_id"])): n["state"]
+                for n in ray_tpu.nodes() or []
+            }
+        except Exception:  # noqa: BLE001 — GCS unreachable; check next tick
+            return []
+        return [nid for nid in gang if states.get(nid) == "DRAINING"]
 
     def _push_resume_checkpoint(self, executor: BackendExecutor,
                                 ckpt: Optional[Checkpoint]):

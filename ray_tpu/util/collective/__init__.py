@@ -21,9 +21,14 @@ from ray_tpu.util.collective.collective import (
     reducescatter,
     send,
 )
-from ray_tpu.util.collective.types import Backend, ReduceOp
+from ray_tpu.util.collective.types import (
+    Backend,
+    CollectiveAbortError,
+    ReduceOp,
+)
 
 __all__ = [
+    "CollectiveAbortError",
     "init_collective_group",
     "create_collective_group",
     "destroy_collective_group",

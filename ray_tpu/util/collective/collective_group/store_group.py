@@ -16,8 +16,12 @@ import numpy as np
 
 from ray_tpu.util.collective import compression as comp
 from ray_tpu.util.collective.collective_group.base_group import BaseGroup
-from ray_tpu.util.collective.store import get_or_create_store, store_wait
-from ray_tpu.util.collective.types import ReduceOp
+from ray_tpu.util.collective.store import (
+    check_abort,
+    get_or_create_store,
+    store_wait,
+)
+from ray_tpu.util.collective.types import CollectiveAbortError, ReduceOp
 
 _REDUCERS = {
     ReduceOp.SUM: lambda xs: _tree_reduce(np.add, xs),
@@ -72,8 +76,60 @@ class StoreGroup(BaseGroup):
         self._seq = 0
         self._p2p_send_seq = {}
         self._p2p_recv_seq = {}
+        # set to the abort reason once the group is poisoned; every
+        # subsequent op raises immediately until the group is re-initialized
+        self._aborted: str | None = None
+        # register identity for the store's liveness monitor: a member
+        # dying (or its node draining) aborts the whole group promptly
+        self._join_membership()
         # join barrier so ops can't start before all ranks exist
         self._sync("join")
+
+    def _join_membership(self):
+        import ray_tpu
+
+        member = {"actor_id": None, "node_id": None}
+        try:
+            from ray_tpu._private.worker import get_global_worker
+
+            w = get_global_worker()
+            if w.actor_id is not None:
+                member["actor_id"] = w.actor_id.hex()
+            if w.node_id is not None:
+                member["node_id"] = w.node_id.hex()
+        except Exception:  # noqa: BLE001 — driver-less test contexts
+            pass
+        ray_tpu.get(self._store.join_member.remote(
+            self._group_name, self._rank, member))
+
+    def _abort(self, reason: str):
+        """Poison this group locally, book the abort metric, and raise."""
+        if self._aborted is None:
+            self._aborted = reason
+            try:
+                from ray_tpu._private import runtime_metrics
+
+                runtime_metrics.inc_collective_abort("store", self._group_name)
+            except Exception:  # noqa: BLE001
+                pass
+        raise CollectiveAbortError(
+            f"collective group {self._group_name!r} aborted: {reason}; "
+            "re-init the group to continue")
+
+    def _check_live(self):
+        if self._aborted is not None:
+            raise CollectiveAbortError(
+                f"collective group {self._group_name!r} is poisoned "
+                f"({self._aborted}); re-init the group to continue")
+
+    def _guard(self, fn):
+        """Run one store round; turn an abort sentinel/error into the
+        poisoned state."""
+        self._check_live()
+        try:
+            return fn()
+        except CollectiveAbortError as e:
+            self._abort(str(e))
 
     def _next_key(self, kind: str):
         self._seq += 1
@@ -82,17 +138,27 @@ class StoreGroup(BaseGroup):
     def _sync(self, kind: str):
         import ray_tpu
 
-        key = self._next_key(kind)
-        ray_tpu.get(self._store.barrier_arrive.remote(key, self._rank, self._world_size))
-        store_wait(self._store, "barrier_done", (key, self._rank, self._world_size))
+        def run():
+            key = self._next_key(kind)
+            check_abort(ray_tpu.get(self._store.barrier_arrive.remote(
+                key, self._rank, self._world_size)))
+            store_wait(self._store, "barrier_done",
+                       (key, self._rank, self._world_size))
+
+        self._guard(run)
 
     def _exchange(self, kind: str, value) -> dict:
         """All-to-all gather round: contribute own value, collect everyone's."""
         import ray_tpu
 
-        key = self._next_key(kind)
-        ray_tpu.get(self._store.contribute.remote(key, self._rank, value))
-        return store_wait(self._store, "collect", (key, self._world_size, self._rank))
+        def run():
+            key = self._next_key(kind)
+            check_abort(ray_tpu.get(
+                self._store.contribute.remote(key, self._rank, value)))
+            return store_wait(self._store, "collect",
+                              (key, self._world_size, self._rank))
+
+        return self._guard(run)
 
     def _exchange_sub(self, kind: str, subrank: int, count: int, value) -> dict:
         """Gather round inside a subgroup (hierarchical phases): the kind
@@ -101,9 +167,13 @@ class StoreGroup(BaseGroup):
         sequence counter aligned across all ranks."""
         import ray_tpu
 
-        key = self._next_key(kind)
-        ray_tpu.get(self._store.contribute.remote(key, subrank, value))
-        return store_wait(self._store, "collect", (key, count, subrank))
+        def run():
+            key = self._next_key(kind)
+            check_abort(ray_tpu.get(
+                self._store.contribute.remote(key, subrank, value)))
+            return store_wait(self._store, "collect", (key, count, subrank))
+
+        return self._guard(run)
 
     # -- collectives --------------------------------------------------------
     def allreduce(self, tensor, op: ReduceOp = ReduceOp.SUM, compression=None):
@@ -247,13 +317,30 @@ class StoreGroup(BaseGroup):
         import ray_tpu
 
         arr, _ = _to_numpy(tensor)
-        seq = self._p2p_send_seq.get(dst_rank, 0) + 1
-        self._p2p_send_seq[dst_rank] = seq
-        key = (self._group_name, "p2p", self._rank, dst_rank, seq)
-        ray_tpu.get(self._store.put.remote(key, arr))
+
+        def run():
+            seq = self._p2p_send_seq.get(dst_rank, 0) + 1
+            self._p2p_send_seq[dst_rank] = seq
+            key = (self._group_name, "p2p", self._rank, dst_rank, seq)
+            check_abort(ray_tpu.get(self._store.put.remote(key, arr)))
+
+        self._guard(run)
 
     def recv(self, src_rank: int):
-        seq = self._p2p_recv_seq.get(src_rank, 0) + 1
-        self._p2p_recv_seq[src_rank] = seq
-        key = (self._group_name, "p2p", src_rank, self._rank, seq)
-        return store_wait(self._store, "pop", (key,))
+        def run():
+            seq = self._p2p_recv_seq.get(src_rank, 0) + 1
+            self._p2p_recv_seq[src_rank] = seq
+            key = (self._group_name, "p2p", src_rank, self._rank, seq)
+            return store_wait(self._store, "pop", (key,))
+
+        return self._guard(run)
+
+    def destroy(self):
+        import ray_tpu
+
+        try:
+            ray_tpu.get(self._store.leave_group.remote(
+                self._group_name, self._rank))
+        except Exception:  # noqa: BLE001 — store may already be gone
+            pass
+        super().destroy()

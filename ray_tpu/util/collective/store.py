@@ -5,14 +5,36 @@ reference: python/ray/util/collective/collective_group/nccl_collective_group.py:
 const.py get_store_name). Here the same pattern serves (a) publishing the
 jax.distributed coordinator address for the XLA backend, and (b) the full
 data plane for the STORE backend.
+
+Prompt abort (preemption-aware fault tolerance): group members register
+their identity (actor id + node id) on join; a background monitor inside
+the store actor polls the GCS and, when a member dies or its node starts
+DRAINING, poisons the group — every blocked ``store_wait`` (and every write)
+sees the abort sentinel within seconds and raises ``CollectiveAbortError``
+instead of hanging to the stock timeout.
 """
 
 from __future__ import annotations
 
+import logging
+import threading
 import time
 from typing import Any, Dict, Optional, Tuple
 
+from ray_tpu.util.collective.types import CollectiveAbortError
+
+logger = logging.getLogger(__name__)
+
 STORE_ACTOR_NAME = "_ray_tpu_collective_store"
+
+# sentinel value store methods return for a poisoned group — store_wait and
+# the write-side helpers turn it into a CollectiveAbortError at the caller
+ABORT_SENTINEL = "__ray_tpu_collective_abort__"
+
+
+def is_abort(value) -> bool:
+    return (isinstance(value, tuple) and len(value) == 2
+            and value[0] == ABORT_SENTINEL)
 
 
 class _CollectiveStoreActor:
@@ -25,34 +47,177 @@ class _CollectiveStoreActor:
         self._barriers: Dict[Tuple, set] = {}
         self._barrier_reads: Dict[Tuple, set] = {}
         self._groups: Dict[str, dict] = {}
+        # group_name -> abort reason (poisoned until re-declared)
+        self._aborts: Dict[str, str] = {}
+        # group_name -> rank -> {"actor_id": hex|None, "node_id": hex|None}
+        self._members: Dict[str, Dict[int, dict]] = {}
+        self._monitor_started = False
 
     # -- group declaration / join ------------------------------------------
     def declare_group(self, group_name: str, world_size: int, backend: str):
         self._groups[group_name] = {"world_size": world_size, "backend": backend}
+        # a fresh declaration is an explicit re-init: clear the poison and
+        # any stale state the aborted incarnation left behind
+        if group_name in self._aborts:
+            self._aborts.pop(group_name, None)
+            self._clear_group_state(group_name)
+        self._members.pop(group_name, None)
         return True
 
     def get_group(self, group_name: str):
         return self._groups.get(group_name)
 
+    def join_member(self, group_name: str, rank: int, member: dict):
+        """A rank announces its identity so the liveness monitor can abort
+        the group promptly when this member dies or its node drains."""
+        self._members.setdefault(group_name, {})[rank] = dict(member or {})
+        self._ensure_monitor()
+        return True
+
+    def leave_group(self, group_name: str, rank: int):
+        members = self._members.get(group_name)
+        if members is not None:
+            members.pop(rank, None)
+            if not members:
+                self._members.pop(group_name, None)
+        return True
+
+    # -- abort plumbing -----------------------------------------------------
+    def abort_group(self, group_name: str, reason: str):
+        """Poison the group: blocked waiters see the sentinel on their next
+        poll, and the group's in-flight state is dropped so a re-init starts
+        from a clean slate."""
+        if group_name in self._aborts:
+            return True
+        self._aborts[group_name] = reason
+        self._members.pop(group_name, None)
+        self._clear_group_state(group_name)
+        return True
+
+    def get_abort(self, group_name: str) -> Optional[str]:
+        return self._aborts.get(group_name)
+
+    def _clear_group_state(self, group_name: str):
+        """Drop gathers/barriers/p2p entries keyed by this group (every
+        collective key is a tuple whose [0] is the group name)."""
+        def _keep(key) -> bool:
+            return not (isinstance(key, tuple) and key and key[0] == group_name)
+
+        self._gathers = {k: v for k, v in self._gathers.items() if _keep(k)}
+        self._gather_reads = {k: v for k, v in self._gather_reads.items() if _keep(k)}
+        self._barriers = {k: v for k, v in self._barriers.items() if _keep(k)}
+        self._barrier_reads = {k: v for k, v in self._barrier_reads.items() if _keep(k)}
+        self._kv = {k: v for k, v in self._kv.items() if _keep(k)}
+
+    def _abort_for(self, key):
+        """Sentinel when ``key`` belongs to a poisoned group, else None."""
+        if not self._aborts:
+            return None
+        if isinstance(key, tuple) and key:
+            reason = self._aborts.get(key[0])
+            if reason is not None:
+                return (ABORT_SENTINEL, reason)
+        return None
+
+    # -- liveness monitor ---------------------------------------------------
+    def _ensure_monitor(self):
+        if self._monitor_started:
+            return
+        try:
+            from ray_tpu._private.worker import get_global_worker
+
+            get_global_worker()  # only meaningful inside a live worker
+        except Exception:  # noqa: BLE001 — unit tests instantiate the class
+            return  # bare; they drive _check_members directly
+        self._monitor_started = True
+        threading.Thread(target=self._monitor_loop, daemon=True,
+                         name="collective-store-monitor").start()
+
+    def _monitor_loop(self):
+        from ray_tpu._private.config import global_config
+        from ray_tpu._private.worker import get_global_worker
+
+        interval = global_config().collective_abort_poll_interval_s
+        while True:
+            time.sleep(interval)
+            if not self._members:
+                continue
+            try:
+                w = get_global_worker()
+                nodes = w.gcs.call("GetAllNodeInfo", {},
+                                   timeout=2, retry_deadline=0.0) or []
+                actors = w.gcs.call("ListActors", {},
+                                    timeout=2, retry_deadline=0.0) or []
+            except Exception:  # noqa: BLE001 — GCS unreachable; retry
+                continue
+            try:
+                node_states = {n["node_id"].hex(): n["state"] for n in nodes}
+                actor_states = {a["actor_id"].hex(): a["state"]
+                                for a in actors}
+                self._check_members(node_states, actor_states)
+            except Exception:  # noqa: BLE001 — the monitor must survive
+                # races with join/leave mutations; a dead monitor would
+                # silently restore the hang-to-timeout behavior
+                logger.exception("collective store liveness check failed")
+
+    def _check_members(self, node_states: Dict[str, str],
+                       actor_states: Dict[str, str]):
+        """Abort every group with a dead/restarting member or a member on a
+        draining/dead node (pure: callable from tests with synthetic maps).
+        Iterates over copies — join_member/leave_group mutate these dicts
+        from the actor's RPC threads while the monitor thread scans."""
+        for group_name, members in list(self._members.items()):
+            for rank, m in list(members.items()):
+                aid, nid = m.get("actor_id"), m.get("node_id")
+                if aid is not None and actor_states.get(aid) in ("DEAD",
+                                                                "RESTARTING"):
+                    self.abort_group(
+                        group_name,
+                        f"rank {rank} (actor {aid[:8]}) is "
+                        f"{actor_states[aid]}")
+                    break
+                if nid is not None and node_states.get(nid) in ("DRAINING",
+                                                                "DEAD"):
+                    self.abort_group(
+                        group_name,
+                        f"rank {rank}'s node {nid[:8]} is "
+                        f"{node_states[nid]}")
+                    break
+
     # -- plain KV (rendezvous) ---------------------------------------------
     def put(self, key, value):
+        hit = self._abort_for(key)
+        if hit is not None:
+            return hit
         self._kv[key] = value
         return True
 
     def get(self, key):
+        hit = self._abort_for(key)
+        if hit is not None:
+            return hit
         return self._kv.get(key)
 
     def pop(self, key):
+        hit = self._abort_for(key)
+        if hit is not None:
+            return hit
         return self._kv.pop(key, None)
 
     # -- gather: world_size ranks each contribute; all read; then GC -------
     def contribute(self, key: Tuple, rank: int, value):
+        hit = self._abort_for(key)
+        if hit is not None:
+            return hit
         self._gathers.setdefault(key, {})[rank] = value
         return True
 
     def collect(self, key: Tuple, world_size: int, reader_rank: int):
         """Returns rank->value dict once all contributions are in, else None.
         Entry is deleted after every rank has read it."""
+        hit = self._abort_for(key)
+        if hit is not None:
+            return hit
         entry = self._gathers.get(key)
         if entry is None or len(entry) < world_size:
             return None
@@ -65,12 +230,18 @@ class _CollectiveStoreActor:
         return result
 
     # -- barrier -----------------------------------------------------------
-    def barrier_arrive(self, key: Tuple, rank: int, world_size: int) -> bool:
+    def barrier_arrive(self, key: Tuple, rank: int, world_size: int):
+        hit = self._abort_for(key)
+        if hit is not None:
+            return hit
         arrived = self._barriers.setdefault(key, set())
         arrived.add(rank)
         return len(arrived) >= world_size
 
-    def barrier_done(self, key: Tuple, rank: int, world_size: int) -> bool:
+    def barrier_done(self, key: Tuple, rank: int, world_size: int):
+        hit = self._abort_for(key)
+        if hit is not None:
+            return hit
         arrived = self._barriers.get(key)
         if arrived is None or len(arrived) < world_size:
             return False
@@ -100,15 +271,28 @@ def get_or_create_store():
         return ray_tpu.get_actor(STORE_ACTOR_NAME)
 
 
+def check_abort(value):
+    """Raise CollectiveAbortError when a store reply is the abort sentinel;
+    otherwise pass the value through."""
+    if is_abort(value):
+        raise CollectiveAbortError(f"collective group aborted: {value[1]}")
+    return value
+
+
 def store_wait(store, method: str, args: tuple, timeout: Optional[float] = None,
                poll_interval: float = 0.002):
-    """Poll a store method until it returns a non-None/True value."""
+    """Poll a store method until it returns a non-None/True value.
+
+    Raises CollectiveAbortError as soon as the group is poisoned (member
+    death/drain) — promptly, not at the stock timeout."""
     import ray_tpu
 
     deadline = None if timeout is None else time.monotonic() + timeout
     interval = poll_interval
     while True:
         out = ray_tpu.get(getattr(store, method).remote(*args))
+        if is_abort(out):
+            raise CollectiveAbortError(f"collective group aborted: {out[1]}")
         if out is not None and out is not False:
             return out
         if deadline is not None and time.monotonic() > deadline:

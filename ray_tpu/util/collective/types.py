@@ -15,6 +15,15 @@ class ReduceOp(enum.Enum):
     MAX = "max"
 
 
+class CollectiveAbortError(RuntimeError):
+    """A pending collective was aborted because a group member died or its
+    node began draining (preemption).  Raised within seconds instead of
+    letting ``store_wait`` hang to its full timeout; the group stays
+    poisoned — every subsequent op raises immediately — until the group is
+    re-initialized (reference direction: fault-aware collectives, arxiv
+    2510.20171)."""
+
+
 class Backend:
     """Backend name constants (reference: collective.py:81-96 dispatch).
 

@@ -41,6 +41,82 @@ def chaos_cluster():
     reset_chaos_for_testing("")
 
 
+@pytest.fixture
+def chaos_config():
+    """Bare config save/restore for chaos cases that build their own
+    clusters."""
+    saved = global_config()
+    yield
+    set_global_config(saved)
+    reset_chaos_for_testing("")
+
+
+@pytest.mark.slow
+def test_push_task_drops_healed_by_resend(chaos_config):
+    """Dropped PushTask requests (the owner's task push never reaches the
+    worker) are healed by the ack-probe: after task_push_ack_timeout_s the
+    owner probes HasTask and resends on the same lease — tasks complete
+    instead of hanging the owner forever."""
+    cfg = RayTpuConfig()
+    cfg.testing_rpc_failure = "PushTask=3:1.0:0.0"  # drop first 3 pushes
+    cfg.task_push_ack_timeout_s = 1.0
+    set_global_config(cfg)
+    reset_chaos_for_testing(cfg.testing_rpc_failure)
+    cluster = Cluster(head_node_args={"num_cpus": 2})
+    w = cluster.connect_driver()
+    try:
+        @ray_tpu.remote
+        def double(x):
+            return x * 2
+
+        t0 = time.monotonic()
+        out = ray_tpu.get([double.remote(i) for i in range(6)], timeout=120)
+        assert out == [i * 2 for i in range(6)]
+        # healing is probe-paced, not retry-backoff-paced: well under the
+        # 90 s the dropped pushes would otherwise cost
+        assert time.monotonic() - t0 < 60
+    finally:
+        cluster.shutdown()
+
+
+@pytest.mark.slow
+def test_node_dead_notification_drop_heals_via_health_sweep(chaos_config):
+    """A dropped NodeDead notification must not leave the node ALIVE
+    forever: the GCS health-check sweep converges it to DEAD."""
+    cfg = RayTpuConfig()
+    cfg.testing_rpc_failure = "NodeDead=1:1.0:0.0"  # drop the notification
+    cfg.heartbeat_interval_s = 0.1
+    cfg.health_check_failure_threshold = 5
+    set_global_config(cfg)
+    reset_chaos_for_testing(cfg.testing_rpc_failure)
+    cluster = Cluster(head_node_args={"num_cpus": 1})
+    b = cluster.add_node(num_cpus=1)
+    w = cluster.connect_driver()
+    try:
+        # the node dies; its death notification is chaos-dropped
+        cluster.nodes.remove(b)
+        b.shutdown()
+        w.gcs.notify("NodeDead", {"node_id": b.node_id, "reason": "killed"})
+
+        def b_row():
+            for n in w.gcs.call("GetAllNodeInfo", {}):
+                if n["node_id"] == b.node_id:
+                    return n
+            return None
+
+        assert b_row()["state"] == "ALIVE"  # the drop really happened
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            row = b_row()
+            if row["state"] == "DEAD":
+                break
+            time.sleep(0.1)
+        assert b_row()["state"] == "DEAD"
+        assert b_row()["death_reason"] == "missed health checks"
+    finally:
+        cluster.shutdown()
+
+
 @pytest.mark.slow
 def test_workload_survives_rpc_drops(chaos_cluster):
     w = chaos_cluster

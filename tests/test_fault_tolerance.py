@@ -81,6 +81,40 @@ def test_task_retry_after_worker_crash(ray_start_regular):
     assert out == 2
 
 
+def test_actor_restart_across_node_death(ray_start_cluster):
+    """Satellite (ISSUE 4): an actor with max_restarts > 0 whose NODE dies
+    restarts on a surviving node, and in-flight calls carrying
+    max_task_retries succeed against the new incarnation."""
+    cluster = ray_start_cluster()  # head
+    b = cluster.add_node(num_cpus=2, resources={"spot": 2})
+    w = cluster.connect_driver()
+    _wait_node_count(w, 2)
+
+    @ray_tpu.remote
+    class Svc:
+        def where(self):
+            return ray_tpu.get_runtime_context().get_node_id().hex()
+
+        def slow_where(self):
+            time.sleep(1.5)
+            return ray_tpu.get_runtime_context().get_node_id().hex()
+
+    a = Svc.options(max_restarts=1, max_task_retries=3, num_cpus=0,
+                    resources={"spot": 1}).remote()
+    assert ray_tpu.get(a.where.remote(), timeout=60) == b.node_id.hex()
+
+    # replacement capacity first, then kill the node with calls in flight
+    c = cluster.add_node(num_cpus=2, resources={"spot": 2})
+    inflight = [a.slow_where.remote() for _ in range(3)]
+    time.sleep(0.3)  # let them reach the doomed incarnation
+    cluster.remove_node(b)
+
+    # in-flight calls are retried onto the restarted incarnation
+    outs = ray_tpu.get(inflight, timeout=120)
+    assert set(outs) == {c.node_id.hex()}
+    assert ray_tpu.get(a.where.remote(), timeout=60) == c.node_id.hex()
+
+
 def test_no_retry_surfaces_crash(ray_start_regular):
     import os
 

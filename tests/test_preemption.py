@@ -1,0 +1,688 @@
+"""Preemption-aware fault tolerance (ISSUE 4).
+
+Tier-1 lane: everything here is driven by INJECTED preemption notices and
+synthetic liveness maps — no real GCE metadata server, no TPU hardware.
+Cluster-scale drain scenarios (train gang restart, serve replica drain,
+chaos interplay) are marked ``slow``.
+
+reference direction: fault-aware collectives + proactive failure handling
+(arxiv 2510.20171); preemptible-capacity economics (arxiv 2605.25645).
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.accelerators.tpu import (
+    TpuMaintenanceWatcher,
+    get_maintenance_notice,
+    parse_testing_notice,
+)
+from ray_tpu._private.config import RayTpuConfig, global_config, set_global_config
+from ray_tpu._private.ids import NodeID
+from ray_tpu._private.resources import NodeResources, ResourceSet
+from ray_tpu._private.scheduler import ClusterResourceScheduler, SchedulingStrategy
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util.collective.store import (
+    _CollectiveStoreActor,
+    check_abort,
+    is_abort,
+)
+from ray_tpu.util.collective.types import CollectiveAbortError
+
+
+def _hex(nid):
+    return nid.hex() if hasattr(nid, "hex") else str(nid)
+
+
+def _node_row(w, node_id):
+    for n in ray_tpu.nodes():
+        if _hex(n["node_id"]) == _hex(node_id):
+            return n
+    return None
+
+
+def _wait_for(predicate, timeout=30, interval=0.05, desc="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        out = predicate()
+        if out:
+            return out
+        time.sleep(interval)
+    raise TimeoutError(f"{desc} not reached within {timeout}s")
+
+
+# ---------------------------------------------------------------------------
+# Maintenance watcher (unit: injectable transport + chaos knob)
+# ---------------------------------------------------------------------------
+
+
+def test_parse_testing_notice():
+    assert parse_testing_notice("") is None
+    assert parse_testing_notice("0.5:preempted:30") == {
+        "delay_s": 0.5, "kind": "preempted", "deadline_s": 30.0}
+    # kind/deadline default
+    out = parse_testing_notice("1.5")
+    assert out["delay_s"] == 1.5 and out["kind"] == "preempted"
+    assert parse_testing_notice("garbage") is None
+
+
+def test_maintenance_notice_injected_transport():
+    # no notice
+    assert get_maintenance_notice(fetch=lambda p: None) is None
+    assert get_maintenance_notice(fetch=lambda p: "NONE") is None
+    # Spot preemption flips instance/preempted to TRUE
+    got = get_maintenance_notice(
+        fetch=lambda p: "TRUE" if p.endswith("preempted") else None)
+    assert got["kind"] == "preempted" and got["deadline_s"] > 0
+    # announced host maintenance
+    got = get_maintenance_notice(
+        fetch=lambda p: "TERMINATE_ON_HOST_MAINTENANCE"
+        if p.endswith("maintenance-event") else None)
+    assert got["kind"] == "TERMINATE_ON_HOST_MAINTENANCE"
+
+
+def test_watcher_fires_injected_notice_once():
+    fired = []
+    w = TpuMaintenanceWatcher(on_notice=fired.append,
+                              testing_notice="0.05:preempted:17")
+    w.start()
+    _wait_for(lambda: fired, timeout=5, desc="watcher fire")
+    assert fired == [{"kind": "preempted", "deadline_s": 17.0}]
+    time.sleep(0.15)
+    assert len(fired) == 1  # at most once
+    w.stop()
+
+
+def test_watcher_polls_injected_transport():
+    flag = threading.Event()
+    fired = []
+
+    def fetch(path):
+        if path.endswith("preempted") and flag.is_set():
+            return "TRUE"
+        return None
+
+    w = TpuMaintenanceWatcher(on_notice=fired.append, poll_interval_s=0.05,
+                              fetch=fetch)
+    w.start()
+    time.sleep(0.2)
+    assert not fired  # nothing announced yet
+    flag.set()
+    _wait_for(lambda: fired, timeout=5, desc="watcher fire")
+    assert fired[0]["kind"] == "preempted"
+    w.stop()
+
+
+# ---------------------------------------------------------------------------
+# Scheduler: DRAINING nodes take no new work
+# ---------------------------------------------------------------------------
+
+
+def test_scheduler_excludes_draining_nodes():
+    sched = ClusterResourceScheduler()
+    n1, n2 = NodeID.random(), NodeID.random()
+    sched.add_or_update_node(n1, NodeResources(ResourceSet({"CPU": 4})))
+    sched.add_or_update_node(n2, NodeResources(ResourceSet({"CPU": 4})))
+    demand = ResourceSet({"CPU": 1})
+
+    sched.set_draining(n1)
+    for _ in range(16):
+        assert sched.get_best_schedulable_node(demand) == n2
+    # placement groups avoid draining nodes too
+    assert sched.schedule_bundles([demand], "PACK") == [n2]
+    assert sched.schedule_bundles([demand, demand], "STRICT_SPREAD") is None
+    # hard node-affinity to a draining node is unsatisfiable
+    hard = SchedulingStrategy(kind="node_affinity", node_id=n1, soft=False)
+    assert sched.get_best_schedulable_node(demand, hard) is None
+    # drain is reversible (e.g. maintenance cancelled)
+    sched.set_draining(n1, False)
+    assert sched.schedule_bundles([demand, demand], "STRICT_SPREAD") is not None
+    # a removed node drops its draining mark
+    sched.set_draining(n1)
+    sched.remove_node(n1)
+    assert not sched.is_draining(n1)
+
+
+# ---------------------------------------------------------------------------
+# Collective store abort (unit: synthetic liveness maps)
+# ---------------------------------------------------------------------------
+
+
+def test_store_abort_poisons_group_state():
+    s = _CollectiveStoreActor()
+    s.declare_group("g", 2, "store")
+    s.join_member("g", 0, {"actor_id": "aaaa", "node_id": "n1"})
+    s.join_member("g", 1, {"actor_id": "bbbb", "node_id": "n2"})
+    assert s.contribute(("g", "allreduce", 1), 0, 1.0) is True
+
+    # healthy sweep: nothing happens
+    s._check_members({"n1": "ALIVE", "n2": "ALIVE"},
+                     {"aaaa": "ALIVE", "bbbb": "ALIVE"})
+    assert s.get_abort("g") is None
+
+    # a member's node starts draining -> group poisoned promptly
+    s._check_members({"n1": "ALIVE", "n2": "DRAINING"},
+                     {"aaaa": "ALIVE", "bbbb": "ALIVE"})
+    assert "DRAINING" in s.get_abort("g")
+    # every group-keyed primitive returns the sentinel now
+    assert is_abort(s.collect(("g", "allreduce", 1), 2, 0))
+    assert is_abort(s.contribute(("g", "x", 2), 0, 1))
+    assert is_abort(s.barrier_arrive(("g", "b", 3), 0, 2))
+    assert is_abort(s.barrier_done(("g", "b", 3), 0, 2))
+    assert is_abort(s.put(("g", "p2p", 0, 1, 1), 1))
+    assert is_abort(s.pop(("g", "p2p", 0, 1, 1)))
+    with pytest.raises(CollectiveAbortError):
+        check_abort(s.collect(("g", "allreduce", 1), 2, 0))
+    # in-flight state was dropped
+    assert s._gathers == {} and s._barriers == {}
+    # non-group keys (XLA rendezvous, unrelated KV) are untouched
+    assert s.put("plain", 5) is True and s.get("plain") == 5
+
+    # explicit re-declaration (re-init) clears the poison
+    s.declare_group("g", 2, "store")
+    assert s.get_abort("g") is None
+    assert s.contribute(("g", "allreduce", 1), 0, 1.0) is True
+
+
+def test_store_abort_on_member_actor_death():
+    s = _CollectiveStoreActor()
+    s.declare_group("g2", 2, "store")
+    s.join_member("g2", 0, {"actor_id": "aaaa", "node_id": None})
+    s.join_member("g2", 1, {"actor_id": "bbbb", "node_id": None})
+    s._check_members({}, {"aaaa": "ALIVE", "bbbb": "RESTARTING"})
+    assert "RESTARTING" in s.get_abort("g2")
+
+
+# ---------------------------------------------------------------------------
+# Injected notice drives the node drain lifecycle end to end
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.timeout(180)
+def test_injected_notice_drain_lifecycle():
+    """A synthetic preemption notice on ONE node: the node drains, new work
+    lands on survivors, and the node reaches DEAD("drained") in the GCS with
+    its drain metadata observable (satellite: drain observability)."""
+    cluster = Cluster(head_node_args={"num_cpus": 2})
+    b = cluster.add_node(num_cpus=2,
+                         testing_preemption_notice="0.3:preempted:10")
+    w = cluster.connect_driver()
+    try:
+        @ray_tpu.remote
+        def where():
+            return ray_tpu.get_runtime_context().get_node_id().hex()
+
+        row = _wait_for(
+            lambda: (_node_row(w, b.node_id) or {}).get("state") == "DEAD"
+            and _node_row(w, b.node_id),
+            timeout=60, desc="node B DEAD")
+        assert row["death_reason"] == "drained"
+        assert "preemption" in row["drain_reason"]
+        assert row["drain_deadline"] > 0
+
+        # new work avoids the drained node entirely
+        outs = ray_tpu.get([where.remote() for _ in range(4)], timeout=90)
+        assert set(outs) == {cluster.head_node.node_id.hex()}
+    finally:
+        cluster.shutdown()
+
+
+@pytest.mark.timeout(180)
+def test_preemption_deadline_visible_to_workers():
+    """Running workers on a draining node see the deadline through
+    get_runtime_context().preemption_deadline() (the checkpoint-ahead
+    hint)."""
+    cluster = Cluster(head_node_args={"num_cpus": 1})
+    b = cluster.add_node(num_cpus=1, resources={"pin": 1})
+    w = cluster.connect_driver()
+    try:
+        @ray_tpu.remote
+        class OnB:
+            def deadline(self):
+                return ray_tpu.get_runtime_context().preemption_deadline()
+
+        a = OnB.options(resources={"pin": 1}, num_cpus=0).remote()
+        assert ray_tpu.get(a.deadline.remote(), timeout=60) is None
+
+        w.pool.get(tuple(b.address)).call(
+            "DrainRaylet",
+            {"reason": "scheduled maintenance", "deadline_s": 60.0})
+        _wait_for(
+            lambda: (_node_row(w, b.node_id) or {}).get("state") == "DRAINING",
+            timeout=30, desc="node B DRAINING")
+
+        # the cached raylet poll refreshes within ~1 s
+        deadline = _wait_for(
+            lambda: ray_tpu.get(a.deadline.remote(), timeout=30),
+            timeout=30, desc="worker sees preemption deadline")
+        assert abs(deadline - (time.time() + 60.0)) < 15.0
+    finally:
+        cluster.shutdown()
+
+
+@pytest.mark.timeout(180)
+def test_health_sweep_marks_stale_draining_node_dead():
+    """Regression (satellite 1): a DRAINING node that dies ungracefully used
+    to linger in DRAINING forever because the health sweep only considered
+    ALIVE nodes.  It must reach DEAD("drained")."""
+    saved = global_config()
+    cfg = RayTpuConfig()
+    cfg.heartbeat_interval_s = 0.1
+    cfg.health_check_failure_threshold = 5
+    set_global_config(cfg)
+    cluster = Cluster(head_node_args={"num_cpus": 1})
+    try:
+        b = cluster.add_node(num_cpus=1)
+        w = cluster.connect_driver()
+        # GCS-side drain only (no raylet cooperation), then the node dies
+        # ungracefully: no NodeDead ever arrives
+        cluster.gcs.HandleDrainNode(
+            {"node_id": b.node_id, "reason": "test-drain"})
+        assert (_node_row(w, b.node_id) or {}).get("state") == "DRAINING"
+        cluster.nodes.remove(b)
+        b.shutdown()
+
+        row = _wait_for(
+            lambda: (_node_row(w, b.node_id) or {}).get("state") == "DEAD"
+            and _node_row(w, b.node_id),
+            timeout=30, desc="stale draining node swept DEAD")
+        assert row["death_reason"] == "drained"
+    finally:
+        cluster.shutdown()
+        set_global_config(saved)
+
+
+@pytest.mark.timeout(300)
+def test_drain_rejected_leases_resubmitted_to_survivors():
+    """Satellite 2: queued leases a draining raylet rejects with
+    {"rejected": True, "reason": "draining"} are resubmitted by their owners
+    and complete on surviving nodes."""
+    cluster = Cluster(head_node_args={"num_cpus": 1})
+    b = cluster.add_node(num_cpus=1, resources={"slot": 1})
+    w = cluster.connect_driver()
+    try:
+        @ray_tpu.remote
+        def occupant(path):
+            # holds B's only slot until the flag file appears
+            import time as _t
+            while not os.path.exists(path):
+                _t.sleep(0.05)
+            return ray_tpu.get_runtime_context().get_node_id().hex()
+
+        @ray_tpu.remote
+        def queued():
+            return ray_tpu.get_runtime_context().get_node_id().hex()
+
+        import tempfile
+
+        flag = os.path.join(tempfile.mkdtemp(), "release")
+        occ_ref = occupant.options(resources={"slot": 1}).remote(flag)
+        # wait until the occupant actually holds B's slot, then queue more
+        _wait_for(
+            # zero-valued resources drop out of the snapshot dict: the
+            # occupant holds the slot once the key vanishes
+            lambda: (_node_row(w, b.node_id) or {})["resources"]
+            ["available"].get("slot", 0.0) == 0.0,
+            timeout=60, desc="occupant holds B's slot")
+        queued_refs = [
+            queued.options(resources={"slot": 1}, max_retries=20).remote()
+            for _ in range(2)
+        ]
+        time.sleep(0.5)  # let the queued leases reach B's pending queue
+
+        # drain B: its queued leases are rejected; owners must resubmit
+        w.pool.get(tuple(b.address)).call(
+            "DrainRaylet", {"reason": "preemption", "deadline_s": 60.0})
+        _wait_for(
+            lambda: (_node_row(w, b.node_id) or {}).get("state") == "DRAINING",
+            timeout=30, desc="node B DRAINING")
+
+        # a surviving node with the needed resource appears
+        c = cluster.add_node(num_cpus=1, resources={"slot": 2})
+        outs = ray_tpu.get(queued_refs, timeout=120)
+        assert set(outs) == {c.node_id.hex()}, outs
+
+        # the in-flight occupant finishes gracefully on B
+        open(flag, "w").close()
+        assert ray_tpu.get(occ_ref, timeout=60) == b.node_id.hex()
+
+        # with its last lease returned, B completes the drain
+        row = _wait_for(
+            lambda: (_node_row(w, b.node_id) or {}).get("state") == "DEAD"
+            and _node_row(w, b.node_id),
+            timeout=90, desc="node B drained to DEAD")
+        assert row["death_reason"] == "drained"
+    finally:
+        cluster.shutdown()
+
+
+@pytest.mark.timeout(180)
+def test_drain_relocates_restartable_actors():
+    """Actors with restart budget are proactively restarted on survivors
+    when their node drains — instead of waiting for health-check death."""
+    cluster = Cluster(head_node_args={"num_cpus": 1})
+    b = cluster.add_node(num_cpus=1, resources={"spot": 1})
+    w = cluster.connect_driver()
+    try:
+        @ray_tpu.remote
+        class Svc:
+            def where(self):
+                return ray_tpu.get_runtime_context().get_node_id().hex()
+
+        a = Svc.options(max_restarts=1, max_task_retries=2, num_cpus=0,
+                        resources={"spot": 0.1}).remote()
+        assert ray_tpu.get(a.where.remote(), timeout=60) == b.node_id.hex()
+
+        # capacity for the relocation, then the drain notice
+        c = cluster.add_node(num_cpus=1, resources={"spot": 1})
+        w.pool.get(tuple(b.address)).call(
+            "DrainRaylet", {"reason": "preemption", "deadline_s": 60.0})
+
+        def relocated():
+            try:
+                out = ray_tpu.get(a.where.remote(), timeout=60)
+            except Exception:  # noqa: BLE001 — mid-restart transient
+                return None
+            return out if out == c.node_id.hex() else None
+
+        assert _wait_for(relocated, timeout=90, interval=0.5,
+                         desc="actor relocated to survivor") == c.node_id.hex()
+    finally:
+        cluster.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Cluster-scale drain scenarios (slow lane)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_collective_abort_prompt_on_node_drain():
+    """Acceptance: pending store-backend collectives abort well under the
+    stock timeout when a member's node starts draining, and the group stays
+    poisoned until re-init."""
+    cluster = Cluster(head_node_args={"num_cpus": 2})
+    b = cluster.add_node(num_cpus=2, resources={"spot": 1})
+    ray_tpu_w = cluster.connect_driver()
+    try:
+        def make_worker():
+            class W:
+                def __init__(self, rank, world):
+                    from ray_tpu.util import collective as col
+
+                    col.init_collective_group(world, rank, backend="store",
+                                              group_name="gd")
+
+                def allreduce(self, v):
+                    import numpy as np
+
+                    from ray_tpu.util import collective as col
+
+                    return col.allreduce(np.asarray(v, dtype="float32"), "gd")
+            return W
+
+        W = ray_tpu.remote(make_worker())
+        a = W.options(num_cpus=0.1).remote(0, 2)
+        bw = W.options(num_cpus=0.1, resources={"spot": 0.1}).remote(1, 2)
+        outs = ray_tpu.get(
+            [a.allreduce.remote([1.0]), bw.allreduce.remote([2.0])],
+            timeout=120)
+        assert [float(o[0]) for o in outs] == [3.0, 3.0]
+
+        # rank 0 pends on a collective rank 1 will never join (its node is
+        # draining and the whole gang member set is now suspect)
+        pend = a.allreduce.remote([5.0])
+        t0 = time.monotonic()
+        ray_tpu_w.pool.get(tuple(b.address)).call(
+            "DrainRaylet", {"reason": "preemption", "deadline_s": 120.0})
+        with pytest.raises(CollectiveAbortError):
+            ray_tpu.get(pend, timeout=60)
+        elapsed = time.monotonic() - t0
+        # promptness: seconds, not the stock (infinite/60 s+) wait
+        assert elapsed < 20.0, f"abort took {elapsed:.1f}s"
+
+        # poisoned until re-init: next op raises immediately
+        t0 = time.monotonic()
+        with pytest.raises(CollectiveAbortError):
+            ray_tpu.get(a.allreduce.remote([6.0]), timeout=60)
+        assert time.monotonic() - t0 < 10.0
+    finally:
+        cluster.shutdown()
+
+
+@pytest.mark.slow
+def test_train_gang_drain_checkpoint_restart_no_failures():
+    """Acceptance: an injected preemption notice mid-training makes the gang
+    checkpoint-restart onto surviving capacity with failures == 0 (the drain
+    is NOT charged against max_failures)."""
+    import tempfile
+
+    from ray_tpu import train
+    from ray_tpu.train import (
+        DataParallelTrainer,
+        FailureConfig,
+        RunConfig,
+        ScalingConfig,
+    )
+
+    tmp = tempfile.mkdtemp()
+    starts_log = os.path.join(tmp, "gang_starts.log")
+
+    cluster = Cluster(head_node_args={"num_cpus": 0})
+    b = cluster.add_node(num_cpus=2)
+    w = cluster.connect_driver()
+    try:
+        def train_fn(config):
+            import os as _os
+            import tempfile as _tf
+            import time as _t
+
+            from ray_tpu import train as _train
+
+            ctx = _train.get_context()
+            if ctx.get_world_rank() == 0:
+                with open(config["starts_log"], "a") as f:
+                    f.write("start\n")
+            ckpt = _train.get_checkpoint()
+            start = 0
+            if ckpt is not None:
+                with open(_os.path.join(ckpt.path, "state.txt")) as f:
+                    start = int(f.read()) + 1
+            for step in range(start, 8):
+                _t.sleep(0.3)
+                with _tf.TemporaryDirectory() as d:
+                    with open(_os.path.join(d, "state.txt"), "w") as f:
+                        f.write(str(step))
+                    _train.report(
+                        {"step": step},
+                        checkpoint=_train.Checkpoint.from_directory(d))
+
+        trainer = DataParallelTrainer(
+            train_fn,
+            train_loop_config={"starts_log": starts_log},
+            scaling_config=ScalingConfig(num_workers=2,
+                                         resources_per_worker={"CPU": 1}),
+            run_config=RunConfig(
+                name="preempt", storage_path=tmp,
+                failure_config=FailureConfig(max_failures=0),
+            ),
+        )
+
+        result_box = {}
+
+        def run_fit():
+            result_box["result"] = trainer.fit()
+
+        fit_thread = threading.Thread(target=run_fit, daemon=True)
+        fit_thread.start()
+
+        # once training demonstrably started (first checkpoint persisted),
+        # inject the preemption notice on the gang's node
+        _wait_for(lambda: os.path.exists(starts_log), timeout=120,
+                  desc="gang started")
+        _wait_for(
+            lambda: any(p.startswith("checkpoint_")
+                        for p in os.listdir(os.path.join(tmp, "preempt"))),
+            timeout=120, desc="first checkpoint persisted")
+
+        watcher = TpuMaintenanceWatcher(
+            on_notice=b._on_maintenance_notice,
+            testing_notice="0.0:preempted:45")
+        watcher.start()
+
+        # replacement capacity appears once the drain is visible
+        _wait_for(
+            lambda: (_node_row(w, b.node_id) or {}).get("state") == "DRAINING",
+            timeout=60, desc="node B DRAINING")
+        cluster.add_node(num_cpus=2)
+
+        fit_thread.join(timeout=420)
+        assert not fit_thread.is_alive(), "fit() never finished"
+        result = result_box["result"]
+        # max_failures=0: ANY charged failure would surface as result.error
+        assert result.error is None, f"drain was charged as a failure: {result.error}"
+        assert result.metrics["step"] == 7
+        with open(starts_log) as f:
+            starts = f.read().count("start")
+        assert starts >= 2, "gang never restarted for the drain"
+
+        # the drained node reaches DEAD("drained") once its leases return
+        row = _wait_for(
+            lambda: (_node_row(w, b.node_id) or {}).get("state") == "DEAD"
+            and _node_row(w, b.node_id),
+            timeout=120, desc="node B drained to DEAD")
+        assert row["death_reason"] == "drained"
+    finally:
+        cluster.shutdown()
+
+
+@pytest.mark.slow
+def test_serve_replicas_drain_off_draining_node_zero_drops():
+    """Acceptance: serve replicas on a draining node finish their in-flight
+    requests (zero drops) while the controller starts replacements on
+    surviving nodes."""
+    from ray_tpu import serve
+
+    cluster = Cluster(head_node_args={"num_cpus": 2})
+    b = cluster.add_node(num_cpus=2, resources={"rep": 1})
+    w = cluster.connect_driver()
+    try:
+        @serve.deployment(num_replicas=1, max_ongoing_requests=8,
+                          ray_actor_options={"resources": {"rep": 0.1}})
+        class Slow:
+            def __call__(self, x):
+                import time as _t
+
+                _t.sleep(0.8)
+                return ("ok", x,
+                        ray_tpu.get_runtime_context().get_node_id().hex())
+
+        handle = serve.run(Slow.bind(), name="drainapp")
+        warm = handle.remote(0).result(timeout_s=120)
+        assert warm[0] == "ok" and warm[2] == b.node_id.hex()
+
+        # in-flight burst, then the drain notice lands mid-flight
+        responses = [handle.remote(i + 1) for i in range(6)]
+        time.sleep(0.2)
+        # replacement capacity on a survivor
+        c = cluster.add_node(num_cpus=2, resources={"rep": 1})
+        w.pool.get(tuple(b.address)).call(
+            "DrainRaylet", {"reason": "preemption", "deadline_s": 60.0})
+
+        # zero drops: every in-flight request completes
+        outs = [r.result(timeout_s=120) for r in responses]
+        assert [o[0] for o in outs] == ["ok"] * 6
+        assert sorted(o[1] for o in outs) == [1, 2, 3, 4, 5, 6]
+
+        # traffic continues on the replacement replica on the survivor
+        def on_c():
+            out = handle.remote(99).result(timeout_s=60)
+            return out[2] == c.node_id.hex() and out
+        moved = _wait_for(on_c, timeout=120, interval=0.5,
+                          desc="replacement replica serving on survivor")
+        assert moved[0] == "ok"
+    finally:
+        try:
+            from ray_tpu import serve as _serve
+
+            _serve.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        cluster.shutdown()
+
+
+@pytest.mark.slow
+def test_autoscaler_replaces_preempted_group():
+    """The instance manager launches a replacement node group while the
+    preempted one is still draining."""
+    from ray_tpu.autoscaler.autoscaler import Autoscaler, NodeGroupSpec
+    from ray_tpu.autoscaler.instance_manager import RAY_RUNNING
+
+    cluster = Cluster(head_node_args={"num_cpus": 1})
+    w = cluster.connect_driver()
+    try:
+        class Provider:
+            """Minimal in-test provider: a 'group' is one cluster node."""
+
+            def __init__(self):
+                self.groups = {}
+                self._n = 0
+
+            def create_node_group(self, name, resources, count, labels):
+                self._n += 1
+                gid = f"grp-{self._n}"
+                node = cluster.add_node(
+                    num_cpus=resources.get("CPU", 1))
+                self.groups[gid] = {"group_name": name,
+                                    "node_ids": [node.node_id],
+                                    "node": node}
+                return gid
+
+            def non_terminated_node_groups(self):
+                return {gid: {"group_name": g["group_name"],
+                              "node_ids": list(g["node_ids"])}
+                        for gid, g in self.groups.items()}
+
+            def terminate_node_group(self, gid):
+                g = self.groups.pop(gid, None)
+                if g and g["node"] in cluster.nodes:
+                    node = g["node"]
+                    cluster.nodes.remove(node)
+                    node.shutdown()
+
+        provider = Provider()
+        spec = NodeGroupSpec(name="tpu-slice", node_resources={"CPU": 1},
+                             count=1, min_groups=0, max_groups=4)
+        asc = Autoscaler(provider, [spec], worker=w, idle_timeout_s=3600)
+
+        gid = provider.create_node_group("tpu-slice", {"CPU": 1}, 1, {})
+        inst_id = asc._im.request("tpu-slice", {"CPU": 1}, 1, {})
+        inst = asc._im.instances()[0]
+        inst.provider_id = gid
+        inst.to(RAY_RUNNING)
+
+        asc.reconcile_once()
+        assert len(provider.groups) == 1  # healthy: no replacement
+
+        # the group's node starts draining (GCS-side announcement: the node
+        # is idle, so a full raylet drain would finish instantly — the
+        # autoscaler must react DURING the announced window)
+        node = provider.groups[gid]["node"]
+        cluster.gcs.HandleDrainNode(
+            {"node_id": node.node_id, "reason": "preemption",
+             "deadline": time.time() + 60.0})
+        assert (_node_row(w, node.node_id) or {}).get("state") == "DRAINING"
+
+        out = asc.reconcile_once()
+        assert "tpu-slice" in out["launched"]
+        assert len(provider.groups) == 2  # replacement requested+created
+        # and only once: further ticks don't stack replacements
+        asc.reconcile_once()
+        assert len(provider.groups) == 2
+        assert inst_id in asc._preempt_replaced
+    finally:
+        cluster.shutdown()
