@@ -732,6 +732,25 @@ t0 = time.perf_counter()
 ray_tpu.get([bump.remote(i) for i in range(3000)], timeout=300)
 out["tasks_per_sec"] = round(3000 / (time.perf_counter() - t0), 1)
 
+# lease fast-path A/B (ISSUE 5): same 3000-task flood with the owner-side
+# lease cache disabled — the delta is reuse + pipelining + batched grants
+from ray_tpu._private.config import global_config as _gc
+from ray_tpu._private.worker import get_global_worker as _gw
+_gc().worker_lease_reuse_enabled = False
+_gw()._submitter.release_all_leases()
+t0 = time.perf_counter()
+ray_tpu.get([bump.remote(i) for i in range(3000)], timeout=300)
+out["tasks_per_sec_lease_reuse_off"] = round(3000 / (time.perf_counter() - t0), 1)
+_gc().worker_lease_reuse_enabled = True
+
+t0 = time.perf_counter()
+for i in range(500):
+    ray_tpu.get(bump.remote(i))
+out["tasks_serial_per_sec"] = round(500 / (time.perf_counter() - t0), 1)
+
+from ray_tpu._private import runtime_metrics as _rm
+out["lease_fast_path"] = _rm.lease_snapshot()
+
 c = Counter.remote()
 ray_tpu.get(c.inc.remote())
 t0 = time.perf_counter()

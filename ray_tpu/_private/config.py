@@ -77,6 +77,33 @@ class RayTpuConfig:
     # --- memory monitor (reference: memory_monitor.h:52) ---
     memory_usage_threshold: float = 0.95  # node used-memory fraction
     memory_monitor_refresh_ms: int = 250  # 0 disables the monitor
+    # --- owner-side lease cache / pipelined submission (fast path) ---
+    # reference: scheduling-key lease queues, normal_task_submitter.h:40-77.
+    # Granted worker leases are kept by the owner after a task finishes and
+    # reused for the next task of the same scheduling key, with up to this
+    # many tasks pushed (pipelined) per leased worker; the worker executes
+    # FIFO.  1 restores one-task-per-push (still one lease per task batch).
+    max_tasks_in_flight_per_worker: int = 10
+    # a cached lease with no in-flight tasks is returned to its raylet
+    # after this long (holding it longer trades cross-key resource
+    # availability for reuse hit rate)
+    worker_lease_idle_timeout_s: float = 1.0
+    # raylet-side lease time-to-live: the owner extends held leases at
+    # ~ttl/4; a lease not extended (owner dead, extension RPCs lost) is
+    # reclaimed once its worker's task queue is empty
+    worker_lease_ttl_s: float = 10.0
+    # master switch for the owner-side lease cache + pipelining; off makes
+    # every task acquire and return its own lease (the pre-fast-path
+    # behavior, kept for A/B benchmarking)
+    worker_lease_reuse_enabled: bool = True
+    # --- rpc framing ---
+    # pickle-protocol-5 out-of-band frames: payload buffers (task arg/return
+    # blobs, object chunks) are written to the socket as separate iovecs
+    # instead of being copied into one joined frame
+    rpc_oob_frames_enabled: bool = True
+    # wrap inline arg/return blobs at least this large in PickleBuffer so
+    # they ride the out-of-band path (tiny blobs aren't worth the iovec)
+    rpc_oob_min_buffer_bytes: int = 4096
     # --- retries / fault tolerance ---
     task_max_retries_default: int = 3
     actor_max_restarts_default: int = 0

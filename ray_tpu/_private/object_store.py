@@ -536,6 +536,24 @@ class PlasmaClient:
         # lifetime. The store keeps its pin until the owner frees the object.
         return True, value
 
+    def get_batch(self, object_ids) -> Dict[ObjectID, object]:
+        """Resolve many locally-sealed objects in ONE raylet round-trip
+        (PlasmaGetBatch); objects not local yet are simply absent from the
+        result — callers fall back to the per-object path for those."""
+        object_ids = list(object_ids)
+        if not object_ids:
+            return {}
+        from ray_tpu._private import serialization
+
+        locators = self._raylet.call(
+            "PlasmaGetBatch", {"object_ids": object_ids},
+            timeout=global_config().gcs_rpc_timeout_s)
+        out: Dict[ObjectID, object] = {}
+        for oid, loc in zip(object_ids, locators):
+            if loc is not None:
+                out[oid] = serialization.read_from(self._cache.buf(tuple(loc)))
+        return out
+
     def contains(self, object_id: ObjectID) -> bool:
         return self._raylet.call("PlasmaContains", {"object_id": object_id})
 

@@ -78,6 +78,41 @@ def main(fast: bool = False) -> List[Dict[str, float]]:
         results.append(timeit("task submit+get (batch 20)", batch_tasks,
                               multiplier=20, fast=fast))
 
+        # -- lease fast path A/B (ISSUE 5) -------------------------------
+        # same workload with the owner-side lease cache on vs off; the
+        # delta is what lease reuse + pipelining + batched grants buy
+        from ray_tpu._private.config import global_config
+        from ray_tpu._private.worker import get_global_worker
+
+        def batch_100():
+            ray_tpu.get([tiny.remote() for _ in range(100)])
+
+        results.append(timeit("tasks/s (lease reuse on, batch 100)",
+                              batch_100, multiplier=100, fast=fast))
+
+        cfg = global_config()
+        cfg.worker_lease_reuse_enabled = False
+        get_global_worker()._submitter.release_all_leases()
+        try:
+            results.append(timeit("tasks/s (lease reuse off, batch 100)",
+                                  batch_100, multiplier=100, fast=fast))
+        finally:
+            cfg.worker_lease_reuse_enabled = True
+
+        # single-worker pipelining: every task binds to ONE leased worker
+        # (CPU:4 on the 4-CPU bench cluster), so depth comes purely from
+        # max_tasks_in_flight_per_worker
+        @ray_tpu.remote(num_cpus=4)
+        def tiny4():
+            return b"ok"
+
+        def pipelined_tasks():
+            ray_tpu.get([tiny4.remote() for _ in range(20)])
+
+        ray_tpu.get(tiny4.remote())  # spawn + warm the single lease
+        results.append(timeit("1:1 pipelined submission (batch 20)",
+                              pipelined_tasks, multiplier=20, fast=fast))
+
         # -- actors ------------------------------------------------------
         @ray_tpu.remote
         class Echo:

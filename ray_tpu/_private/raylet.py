@@ -59,6 +59,9 @@ class _Lease:
     retriable: bool = False              # memory monitor may kill+retry
     granted_at: float = 0.0
     cpu_released: bool = False           # worker blocked in get(): CPU lent out
+    reusable: bool = False               # owner-side lease cache may keep it
+    expires_at: float = float("inf")     # reusable: reclaimed past this unless
+    #                                      extended (ExtendLease) or busy
 
 
 @dataclass
@@ -66,8 +69,28 @@ class _PendingLease:
     spec: TaskSpec
     reply_token: Any
     for_actor: bool
+    count: int = 1                       # batched request: leases wanted
+    batched: bool = False                # reply shape: {"leases": [...]}
     enqueue_time: float = field(default_factory=time.monotonic)
     warned_infeasible: bool = False
+
+
+class _LeaseBatch:
+    """Accumulates the grants of ONE RequestWorkerLease call (one reply
+    token) while its allocated units wait for workers.  The single reply
+    goes out when every allocated unit either got a worker or failed."""
+
+    def __init__(self, pending: _PendingLease, expected: int):
+        self.pending = pending
+        self.expected = expected
+        self.leases: List[dict] = []
+        self.failures: List[str] = []
+        # partial grant: where the next-best capacity for the ungranted
+        # remainder lives (the owner re-requests there)
+        self.spill_addr: Optional[Tuple[str, int]] = None
+
+    def settled(self) -> bool:
+        return len(self.leases) + len(self.failures) >= self.expected
 
 
 @dataclass
@@ -169,7 +192,8 @@ class Raylet:
         self._starting: Dict[str, int] = defaultdict(int)
         self._env_failures: Dict[str, tuple] = {}  # env_hash -> (error, expiry)
         self._pending_leases: deque[_PendingLease] = deque()
-        self._grants_waiting_worker: deque[Tuple[_PendingLease, ResourceSet, Dict[str, list], Optional[PlacementGroupID], int]] = deque()
+        # (pending, demand, instances, pg_id, bundle_index, batch)
+        self._grants_waiting_worker: deque[tuple] = deque()
         self._leases: Dict[str, _Lease] = {}
         self._bundles: Dict[PlacementGroupID, Dict[int, _Bundle]] = {}
         self._draining = False
@@ -529,10 +553,28 @@ class Raylet:
         """Detect worker-process death (reference: node_manager.cc:980);
         reap dedicated runtime-env workers idle past the timeout so distinct
         envs don't accumulate resident processes forever."""
+        last_reclaim = 0.0
+        reclaim_thread: Optional[threading.Thread] = None
         while not self._stopped.wait(0.2):
             dead = []
             reap = []
             now = time.monotonic()
+            if now - last_reclaim >= max(
+                    global_config().worker_lease_ttl_s / 4.0, 0.25):
+                last_reclaim = now
+                if reclaim_thread is None or not reclaim_thread.is_alive():
+                    # off-thread, single-flight: the reclaim probes leased
+                    # workers with blocking RPCs — the 0.2s death poll must
+                    # not stall behind them
+                    def _reclaim():
+                        try:
+                            self._reclaim_expired_leases()
+                        except Exception:  # noqa: BLE001
+                            pass
+                    reclaim_thread = threading.Thread(
+                        target=_reclaim, daemon=True,
+                        name="raylet-lease-reclaim")
+                    reclaim_thread.start()
             with self._lock:
                 for wid, w in list(self._all_workers.items()):
                     if w.proc is not None and w.proc.poll() is not None:
@@ -698,15 +740,22 @@ class Raylet:
 
     def HandleRequestWorkerLease(self, req, reply_token=None):
         spec: TaskSpec = req["spec"]
-        pending = _PendingLease(spec=spec, reply_token=reply_token, for_actor=req.get("for_actor", False))
+        count = min(max(1, int(req.get("num_leases", 1))), 256)
+        pending = _PendingLease(
+            spec=spec, reply_token=reply_token,
+            for_actor=req.get("for_actor", False),
+            count=count, batched="num_leases" in req)
         with self._lock:
             if self._draining:
                 self.server.send_reply(reply_token, {"rejected": True, "reason": "draining"})
                 return RpcServer.DELAYED_REPLY
             # record QUEUED only once the task actually queues here — a
             # draining raylet's rejection must not stamp a phase the
-            # retried lease will re-stamp on another node
-            self._record_task_event(spec, "QUEUED")
+            # retried lease will re-stamp on another node.  Batched (fast
+            # path) requests carry one representative spec for N tasks, so
+            # per-task phases are stamped owner-side instead.
+            if not pending.batched:
+                self._record_task_event(spec, "QUEUED")
             self._pending_leases.append(pending)
             self._dispatch_cv.notify_all()
         return RpcServer.DELAYED_REPLY
@@ -729,13 +778,16 @@ class Raylet:
             spec = p.spec
             strategy = spec.strategy or SchedulingStrategy()
             if strategy.kind == "placement_group":
-                ok = self._try_dispatch_pg_locked(p)
-                if not ok:
+                if not self._try_dispatch_pg_locked(p):
                     still_pending.append(p)
                 continue
-            # Pick best node cluster-wide; spill if it isn't us.
-            best = self.cluster.get_best_schedulable_node(spec.resources, strategy, prefer_node=self.node_id)
-            if best is None:
+            # Pick the best node per unit against one snapshot; allocate the
+            # local prefix here, spill the request if the FIRST unit belongs
+            # elsewhere (the owner re-requests any ungranted remainder).
+            placements = self.cluster.get_best_schedulable_nodes(
+                spec.resources, strategy, count=p.count,
+                prefer_node=self.node_id)
+            if not placements:
                 # Not schedulable anywhere right now — keep it queued even if
                 # no current node could EVER fit it: queued demand is the
                 # autoscaler's scale-up signal (reference: infeasible tasks
@@ -752,8 +804,8 @@ class Raylet:
                         spec.name, spec.resources.to_dict())
                 still_pending.append(p)
                 continue
-            if best != self.node_id:
-                node = self.cluster.nodes.get(best)
+            if placements[0] != self.node_id:
+                node = self.cluster.nodes.get(placements[0])
                 addr = getattr(node, "address", None)
                 if addr is None:
                     still_pending.append(p)
@@ -761,11 +813,30 @@ class Raylet:
                 runtime_metrics.inc_spillback()
                 self.server.send_reply(p.reply_token, {"spillback": tuple(addr)})
                 continue
-            instances = self.local_resources.allocate(spec.resources)
-            if instances is None:
+            allocs = []
+            for nid in placements:
+                if nid != self.node_id:
+                    break
+                instances = self.local_resources.allocate(spec.resources)
+                if instances is None:
+                    break
+                allocs.append(instances)
+            if not allocs:
                 still_pending.append(p)
                 continue
-            self._grants_waiting_worker.append((p, spec.resources, instances, None, -1))
+            batch = _LeaseBatch(p, expected=len(allocs))
+            if len(allocs) < len(placements):
+                nxt = placements[len(allocs)]
+                if nxt != self.node_id:
+                    node = self.cluster.nodes.get(nxt)
+                    addr = getattr(node, "address", None)
+                    if addr is not None:
+                        batch.spill_addr = tuple(addr)
+            if p.batched and len(allocs) > 1:
+                runtime_metrics.inc_lease_batch_granted(len(allocs))
+            for instances in allocs:
+                self._grants_waiting_worker.append(
+                    (p, spec.resources, instances, None, -1, batch))
         self._pending_leases = still_pending
 
     def _try_dispatch_pg_locked(self, p: _PendingLease) -> bool:
@@ -777,23 +848,33 @@ class Raylet:
             self.server.send_reply(p.reply_token, {"rejected": True, "reason": "no bundle on node"})
             return True
         indices = [strategy.bundle_index] if strategy.bundle_index >= 0 else sorted(bundles)
-        for i in indices:
-            b = bundles.get(i)
-            if b is None or not b.committed:
-                continue
-            if p.spec.resources.is_subset_of(b.available):
-                b.available = b.available - p.spec.resources
-                want = {
-                    name: int(p.spec.resources.get(name))
-                    for name in b.instances
-                    if int(p.spec.resources.get(name))
-                }
-                instances = {name: b.instances[name][:n] for name, n in want.items()}
-                self._grants_waiting_worker.append(
-                    (p, p.spec.resources, instances, strategy.placement_group_id, i)
-                )
-                return True
-        return False
+        allocs = []
+        for _ in range(p.count):
+            got = None
+            for i in indices:
+                b = bundles.get(i)
+                if b is None or not b.committed:
+                    continue
+                if p.spec.resources.is_subset_of(b.available):
+                    b.available = b.available - p.spec.resources
+                    want = {
+                        name: int(p.spec.resources.get(name))
+                        for name in b.instances
+                        if int(p.spec.resources.get(name))
+                    }
+                    instances = {name: b.instances[name][:n] for name, n in want.items()}
+                    got = (instances, strategy.placement_group_id, i)
+                    break
+            if got is None:
+                break
+            allocs.append(got)
+        if not allocs:
+            return False
+        batch = _LeaseBatch(p, expected=len(allocs))
+        for instances, pg_id, i in allocs:
+            self._grants_waiting_worker.append(
+                (p, p.spec.resources, instances, pg_id, i, batch))
+        return True
 
     def _try_grant_waiting_locked(self):
         from ray_tpu._private import runtime_env as renv
@@ -805,7 +886,7 @@ class Raylet:
         spawn_want: Dict[str, list] = {}
         while self._grants_waiting_worker:
             entry = self._grants_waiting_worker.popleft()
-            p = entry[0]
+            p, batch = entry[0], entry[5]
             try:
                 env = renv.normalize(p.spec.runtime_env)
                 env_key = renv.env_hash(env)
@@ -825,8 +906,8 @@ class Raylet:
                 self._release_lease_resources(_Lease(
                     lease_id="", worker=None, demand=entry[1],
                     instances=entry[2], pg_id=entry[3], bundle_index=entry[4]))
-                self.server.send_reply(
-                    p.reply_token, {"rejected": True, "reason": str(e)})
+                batch.failures.append(str(e))
+                self._maybe_reply_batch_locked(batch)
         self._grants_waiting_worker = remaining
         budget = (global_config().maximum_startup_concurrency
                   - sum(self._starting.values()))
@@ -837,13 +918,16 @@ class Raylet:
                 budget -= 1
 
     def _grant_one_locked(self, entry, env_key: str):
-        p, demand, instances, pg_id, bundle_index = entry
+        p, demand, instances, pg_id, bundle_index, batch = entry
         runtime_metrics.observe_schedule_latency(
             time.monotonic() - p.enqueue_time)
-        self._record_task_event(p.spec, "SCHEDULED")
+        if not p.batched:
+            self._record_task_event(p.spec, "SCHEDULED")
         worker = self._idle_workers[env_key].popleft()
         self._lease_counter += 1
         lease_id = f"{self.node_id.hex()[:8]}-{self._lease_counter}"
+        cfg = global_config()
+        reusable = (not p.for_actor) and cfg.worker_lease_reuse_enabled
         lease = _Lease(
             lease_id=lease_id,
             worker=worker,
@@ -854,6 +938,9 @@ class Raylet:
             for_actor=p.for_actor,
             retriable=(not p.for_actor) and p.spec.max_retries != 0,
             granted_at=time.monotonic(),
+            reusable=reusable,
+            expires_at=(time.monotonic() + cfg.worker_lease_ttl_s
+                        if reusable else float("inf")),
         )
         self._leases[lease_id] = lease
         worker.lease_id = lease_id
@@ -863,17 +950,110 @@ class Raylet:
             # job attribution for the log plane (approximate: a reused worker
             # is re-tagged at its next lease, like the reference's log runtime)
             self._log_monitor.set_job(worker.proc.pid, p.spec.job_id.hex())
-        self.server.send_reply(
-            p.reply_token,
-            {
-                "worker_addr": worker.address,
-                "worker_id": worker.worker_id,
-                "lease_id": lease_id,
-                "node_id": self.node_id,
-                "resource_instances": instances,
-                "raylet_addr": self.server.address,
-            },
-        )
+        batch.leases.append({
+            "worker_addr": worker.address,
+            "worker_id": worker.worker_id,
+            "lease_id": lease_id,
+            "node_id": self.node_id,
+            "resource_instances": instances,
+            "raylet_addr": self.server.address,
+            "reusable": reusable,
+            "ttl_s": cfg.worker_lease_ttl_s if reusable else None,
+        })
+        self._maybe_reply_batch_locked(batch)
+
+    def _maybe_reply_batch_locked(self, batch: _LeaseBatch):
+        """Send the ONE reply of a (possibly batched) lease request once
+        every allocated unit has settled (got a worker or failed)."""
+        if not batch.settled():
+            return
+        p = batch.pending
+        if not batch.leases:
+            self.server.send_reply(
+                p.reply_token,
+                {"rejected": True,
+                 "reason": batch.failures[0] if batch.failures else "no grant"})
+            return
+        if p.batched:
+            reply = {"leases": batch.leases}
+            if batch.spill_addr is not None:
+                reply["spillback"] = batch.spill_addr
+            self.server.send_reply(p.reply_token, reply)
+        else:
+            self.server.send_reply(p.reply_token, batch.leases[0])
+
+    # -- lease TTL: extension + idle reclaim ---------------------------------
+
+    def HandleExtendLease(self, req):
+        """Owner-side lease-cache keep-alive: extend every held lease's TTL
+        in one call; the reply carries which leases no longer exist (TTL
+        already reclaimed them) and whether this node is draining, so the
+        owner invalidates promptly instead of discovering via dead pushes."""
+        ids = req.get("lease_ids") or []
+        now = time.monotonic()
+        ttl = global_config().worker_lease_ttl_s
+        valid, invalid = [], []
+        with self._lock:
+            for lid in ids:
+                lease = self._leases.get(lid)
+                if lease is None:
+                    invalid.append(lid)
+                    continue
+                if not self._draining:
+                    lease.expires_at = now + ttl
+                valid.append(lid)
+            return {"valid": valid, "invalid": invalid,
+                    "draining": self._draining}
+
+    def _reclaim_expired_leases(self):
+        """Reusable leases whose TTL lapsed (owner dead, extensions lost):
+        probe the worker's queue — still flowing tasks extend, an empty
+        queue revokes (worker back to the idle pool, owner told via the
+        LeaseRevoked mark so any straggler push is refused)."""
+        now = time.monotonic()
+        with self._lock:
+            expired = [l for l in self._leases.values()
+                       if l.reusable and not l.for_actor
+                       and now > l.expires_at]
+        ttl = global_config().worker_lease_ttl_s
+        for lease in expired:
+            busy = True
+            try:
+                state = self.pool.get(lease.worker.address).call(
+                    "LeaseState", {"lease_id": lease.lease_id},
+                    timeout=2, retry_deadline=0.0)
+                busy = bool(state and state.get("queued"))
+            except Exception:  # noqa: BLE001 — unreachable worker: the
+                continue  # death monitor owns that case
+            with self._lock:
+                live = self._leases.get(lease.lease_id)
+                if live is not lease or now <= live.expires_at:
+                    continue
+                if busy:
+                    # tasks flow: the owner is alive even if its extension
+                    # RPCs are being lost — keep extending
+                    lease.expires_at = time.monotonic() + ttl
+                    continue
+                self._leases.pop(lease.lease_id, None)
+                if lease.cpu_released:
+                    lease.cpu_released = False
+                    self._credit_cpu(lease, -lease.demand.get("CPU"))
+                self._release_lease_resources(lease)
+                w = lease.worker
+                w.lease_id = None
+                if w.worker_id in self._all_workers:
+                    w.dedicated_actor = None
+                    w.idle_since = time.monotonic()
+                    self._idle_workers[w.env_hash].append(w)
+                self._dispatch_cv.notify_all()
+            runtime_metrics.inc_lease_revoked()
+            logger.info("raylet %s: reclaimed idle expired lease %s",
+                        self.node_id, lease.lease_id)
+            try:
+                self.pool.get(lease.worker.address).notify(
+                    "LeaseRevoked", {"lease_id": lease.lease_id})
+            except Exception:  # noqa: BLE001
+                pass
 
     def _release_lease_resources(self, lease: _Lease):
         if lease.pg_id is not None:
@@ -1019,6 +1199,18 @@ class Raylet:
             self._drain_deadline_mono = time.monotonic() + deadline_s
             pend = list(self._pending_leases)
             self._pending_leases.clear()
+            # allocated-but-unstaffed grants (waiting on a worker spawn)
+            # must flush too: staffing them AFTER the drain notice would
+            # push fresh tasks onto a dying node
+            grants = list(self._grants_waiting_worker)
+            self._grants_waiting_worker.clear()
+            for entry in grants:
+                self._release_lease_resources(_Lease(
+                    lease_id="", worker=None, demand=entry[1],
+                    instances=entry[2], pg_id=entry[3],
+                    bundle_index=entry[4]))
+                entry[5].failures.append("draining")
+                self._maybe_reply_batch_locked(entry[5])
             # local view: never spill new work onto ourselves again
             self.cluster.set_draining(self.node_id)
         logger.warning(
@@ -1179,6 +1371,15 @@ class Raylet:
             t.start()
         return RpcServer.DELAYED_REPLY
 
+    def HandlePlasmaGetBatch(self, req):
+        """Resolve N objects' locators in ONE round-trip (the
+        ``ray_tpu.get(list)`` fast path — N local plasma hits used to cost
+        N ``PlasmaGet`` calls).  Non-blocking: an object not sealed here
+        yet resolves to None and the caller falls back to the per-object
+        waiting path."""
+        return [self.store.get_locator(oid, timeout=0)
+                for oid in req["object_ids"]]
+
     def HandlePlasmaFree(self, req):
         for oid in req["object_ids"]:
             self.store.free(oid)
@@ -1256,7 +1457,15 @@ class Raylet:
             return False
 
     def HandleReadObjectChunk(self, req):
-        return self.store.read_object_bytes(req["object_id"], req["offset"], req["length"])
+        from ray_tpu._private.rpc import oob_wrap
+
+        data = self.store.read_object_bytes(
+            req["object_id"], req["offset"], req["length"])
+        # one copy total: read_object_bytes copies out of the store (the
+        # entry may be evicted after); the out-of-band frame path then
+        # writes that copy straight to the socket instead of pickling it
+        # in-band (a second copy)
+        return oob_wrap(data) if data is not None else None
 
     # ------------------------------------------------------------------
     # Push plane + broadcast fan-out (reference: push_manager.h:27 — the
@@ -1277,13 +1486,16 @@ class Raylet:
             begin = cli.call("ReceivePushBegin", {"object_id": oid, "size": size})
             if begin == "have":
                 return True
+            from ray_tpu._private.rpc import oob_wrap
+
             off = 0
             while off < size:
                 data = self.store.read_object_bytes(oid, off, chunk)
                 if data is None:
                     return False
                 cli.call("ReceivePushChunk",
-                         {"object_id": oid, "offset": off, "data": data})
+                         {"object_id": oid, "offset": off,
+                          "data": oob_wrap(data)})
                 off += len(data)
             cli.call("ReceivePushEnd",
                      {"object_id": oid, "owner_addr": tuple(owner_addr) if owner_addr else None})
@@ -1400,7 +1612,7 @@ class Raylet:
         task_id = req["task_id"]
         with self._lock:
             for p in list(self._pending_leases):
-                if p.spec.task_id == task_id:
+                if not p.batched and p.spec.task_id == task_id:
                     self._pending_leases.remove(p)
                     self.server.send_reply(
                         p.reply_token,
@@ -1410,15 +1622,18 @@ class Raylet:
             cancelled = False
             while self._grants_waiting_worker:
                 entry = self._grants_waiting_worker.popleft()
-                if not cancelled and entry[0].spec.task_id == task_id:
+                # batched fast-path requests carry a representative spec for
+                # many tasks — only a dedicated (non-batched) grant can be
+                # cancelled by task id
+                if (not cancelled and not entry[0].batched
+                        and entry[0].spec.task_id == task_id):
                     cancelled = True
                     self._release_lease_resources(_Lease(
                         lease_id="", worker=None, demand=entry[1],
                         instances=entry[2], pg_id=entry[3],
                         bundle_index=entry[4]))
-                    self.server.send_reply(
-                        entry[0].reply_token,
-                        {"rejected": True, "reason": "cancelled"})
+                    entry[5].failures.append("cancelled")
+                    self._maybe_reply_batch_locked(entry[5])
                     continue
                 remaining.append(entry)
             self._grants_waiting_worker = remaining

@@ -120,6 +120,29 @@ STORE_OBJECTS = Gauge(
     "Objects resident per node",
     tag_keys=("node",))
 
+# -- owner-side lease cache / pipelined submission --------------------------
+LEASE_REQUESTS = Counter(
+    "ray_tpu_task_lease_requests_total",
+    "Owner-side RequestWorkerLease RPCs issued (a batched request counts "
+    "once regardless of how many leases it asks for)")
+LEASE_REUSE = Counter(
+    "ray_tpu_task_lease_reuse_total",
+    "Task-to-lease assignments by lease provenance: 'hit' rode a cached "
+    "lease, 'new' waited for a fresh grant",
+    tag_keys=("outcome",))
+TASKS_IN_FLIGHT = Gauge(
+    "ray_tpu_task_in_flight",
+    "Normal tasks pushed to leased workers and awaiting their reply "
+    "(owner-side view)")
+LEASE_BATCH_GRANTED = Counter(
+    "ray_tpu_raylet_lease_batch_granted_total",
+    "Leases granted by this raylet through batched RequestWorkerLease "
+    "calls (num_leases > 1)")
+LEASES_REVOKED = Counter(
+    "ray_tpu_raylet_leases_revoked_total",
+    "Reusable leases reclaimed by the raylet (TTL expiry with an empty "
+    "worker queue — owner dead or its extensions lost)")
+
 # -- task (worker) ----------------------------------------------------------
 TASK_SUBMIT_TO_START = Histogram(
     "ray_tpu_task_submit_to_start_seconds",
@@ -222,6 +245,8 @@ FAMILIES = (
     NODE_DRAINS, NODE_DRAIN_LATENCY,
     STORE_STORED_BYTES, STORE_SPILLED_BYTES, STORE_RESTORED_BYTES,
     STORE_USED_BYTES, STORE_OBJECTS,
+    LEASE_REQUESTS, LEASE_REUSE, TASKS_IN_FLIGHT, LEASE_BATCH_GRANTED,
+    LEASES_REVOKED,
     TASK_SUBMIT_TO_START, TASK_EXECUTION, TASK_SERIALIZED_BYTES,
     COLLECTIVE_LATENCY, COLLECTIVE_BYTES, COLLECTIVE_BUS_BW,
     COLLECTIVE_LOGICAL_BYTES, COLLECTIVE_WIRE_BYTES,
@@ -361,6 +386,54 @@ def add_restored_bytes(n: int) -> None:
 
 def observe_submit_to_start(seconds: float) -> None:
     _submit_to_start.observe(seconds)
+
+
+_lease_requests = LEASE_REQUESTS.with_tags()
+_lease_reuse_hit = LEASE_REUSE.with_tags({"outcome": "hit"})
+_lease_reuse_new = LEASE_REUSE.with_tags({"outcome": "new"})
+_tasks_in_flight = TASKS_IN_FLIGHT.with_tags()
+_lease_batch_granted = LEASE_BATCH_GRANTED.with_tags()
+_leases_revoked = LEASES_REVOKED.with_tags()
+
+
+def inc_lease_request() -> None:
+    _lease_requests.inc()
+
+
+def add_lease_reuse(outcome: str, n: int = 1) -> None:
+    (_lease_reuse_hit if outcome == "hit" else _lease_reuse_new).inc(n)
+
+
+def set_tasks_in_flight(n: int) -> None:
+    _tasks_in_flight.set(n)
+
+
+def inc_lease_batch_granted(n: int) -> None:
+    if n > 0:
+        _lease_batch_granted.inc(n)
+
+
+def inc_lease_revoked() -> None:
+    _leases_revoked.inc()
+
+
+def lease_snapshot() -> dict:
+    """Process-local lease fast-path accounting: requests issued, reuse
+    hit/new assignment counts and the derived hit rate.  Hermetic (reads
+    this process's counters only) — the perf-smoke budget test and
+    bench.py's core_perf block both read it."""
+    requests = sum(dict(LEASE_REQUESTS._points).values())
+    hit = hits = 0.0
+    for tags_key, v in dict(LEASE_REUSE._points).items():
+        if ("outcome", "hit") in tags_key:
+            hit += v
+        hits += v
+    return {
+        "lease_requests": requests,
+        "assignments": hits,
+        "reuse_hits": hit,
+        "reuse_hit_rate": (hit / hits) if hits else 0.0,
+    }
 
 
 def observe_task_execution(seconds: float, kind: str = "task") -> None:

@@ -192,6 +192,66 @@ class ClusterResourceScheduler:
             self._rng.getrandbits(63))
         return candidates[choice][0] if choice >= 0 else None
 
+    def get_best_schedulable_nodes(
+        self,
+        demand: ResourceSet,
+        strategy: Optional[SchedulingStrategy] = None,
+        count: int = 1,
+        prefer_node: Optional[NodeID] = None,
+    ) -> List[NodeID]:
+        """Batch placement for batched lease requests: up to ``count`` node
+        picks for identical ``demand`` units, scored against ONE snapshot
+        with capacity decremented per pick (so a batch doesn't pile onto a
+        node that only fits one unit).  Returns fewer than ``count`` when
+        capacity runs out — and an empty list only when the demand is
+        infeasible everywhere (callers keep it queued, like the single-node
+        path)."""
+        strategy = strategy or SchedulingStrategy()
+        if count <= 1 or strategy.kind == "node_affinity":
+            nid = self.get_best_schedulable_node(demand, strategy,
+                                                 prefer_node=prefer_node)
+            return [nid] if nid is not None else []
+        prefer_node = prefer_node or self.local_node_id
+        scratch = {
+            nid: _MutableNode(n)
+            for nid, n in self._nodes_snapshot().items()
+            if n.feasible(demand) and n.matches_labels(strategy.labels)
+        }
+        if not scratch:
+            return []
+        picks: List[NodeID] = []
+        pick_counts: Dict[NodeID, int] = {}
+        spread = strategy.kind == "spread"
+        for _ in range(count):
+            if (not spread and prefer_node in scratch
+                    and scratch[prefer_node].try_one(demand)):
+                picks.append(prefer_node)
+                pick_counts[prefer_node] = pick_counts.get(prefer_node, 0) + 1
+                continue
+            fitting = [(nid, mn) for nid, mn in scratch.items()
+                       if demand.is_subset_of(mn.remaining)]
+            if not fitting:
+                break
+            if spread:
+                # spread semantics must hold WITHIN the batch too: rank by
+                # how many units this batch already put on the node first
+                nid, mn = min(fitting, key=lambda kv: (
+                    pick_counts.get(kv[0], 0), kv[1].node.utilization(),
+                    kv[0].hex()))
+            else:
+                nid, mn = min(fitting, key=lambda kv: (
+                    kv[1].node.utilization(), kv[0].hex()))
+            mn.try_one(demand)
+            picks.append(nid)
+            pick_counts[nid] = pick_counts.get(nid, 0) + 1
+        if not picks:
+            # nothing can run NOW but the shape is feasible: queue one unit
+            # on the least-utilized feasible node (hybrid-policy fallback)
+            nid = self.get_best_schedulable_node(demand, strategy,
+                                                 prefer_node=prefer_node)
+            return [nid] if nid is not None else []
+        return picks
+
     def _spread(self, candidates, demand) -> Optional[NodeID]:
         available = [(nid, n) for nid, n in candidates if n.can_allocate(demand)]
         pool = available or candidates

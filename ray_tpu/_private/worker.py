@@ -26,7 +26,7 @@ import time
 import traceback
 import weakref
 from collections import defaultdict, deque
-from ray_tpu._private.utils import DaemonExecutor
+from ray_tpu._private.utils import DaemonExecutor, fast_getpid
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ray_tpu._private import runtime_metrics, serialization
@@ -463,6 +463,19 @@ class CoreWorker:
         self.task_manager = TaskManager()
         self._submit_pool = DaemonExecutor(max_workers=8, thread_name_prefix="task-submit")
         self._exec_pool = DaemonExecutor(max_workers=1, thread_name_prefix="task-exec")
+        # executor-side pipelined-push state: pushed tasks queue FIFO in
+        # _exec_pool; the registry below lets a CancelTask reach a task
+        # still QUEUED behind another (prompt cancelled reply, executor
+        # skips it), LeaseState answers the raylet's TTL reclaim probe,
+        # and _stale_leases refuses pushes on revoked leases
+        self._queue_lock = threading.Lock()
+        self._queued_tokens: Dict[TaskID, tuple] = {}  # -> (token, attempt, lease_id)
+        self._lease_task_counts: Dict[str, int] = {}
+        self._stale_leases: Set[str] = set()
+        self._stale_lease_order: deque = deque()
+        # owner-side lease cache + pipelined submission (the normal-task
+        # fast path; see NormalTaskSubmitter below)
+        self._submitter = NormalTaskSubmitter(self)
         self._published_fns: Set[str] = set()
         self._runtime_env_cache: Dict[str, Optional[dict]] = {}
         self._fn_cache: Dict[str, Any] = {}
@@ -473,6 +486,8 @@ class CoreWorker:
         # groups, proxy executor threads emitting spans): an unlocked
         # append racing flush's swap-and-serialize would drop events
         self._task_events_lock = threading.Lock()
+        self._last_event_flush = 0.0
+        self._event_flush_timer_armed = False
 
         # Actor-related state (server side: this worker hosts an actor)
         self.actor_id: Optional[ActorID] = None  # set when this worker hosts an actor
@@ -599,6 +614,10 @@ class CoreWorker:
 
     def shutdown(self):
         self.shutting_down = True
+        try:  # cached leases go back to their raylets (TTL covers misses)
+            self._submitter.release_all_leases()
+        except Exception:  # noqa: BLE001
+            pass
         try:  # final metrics flush: short-lived workers' points must land.
             # Short timeout, no reconnect-retry — teardown must not stall
             # behind a GCS that died first (FT tests kill it deliberately).
@@ -713,7 +732,8 @@ class CoreWorker:
                 blocked_lease = None
         try:
             deadline = None if timeout is None else time.monotonic() + timeout
-            out = [self._get_one(r, deadline) for r in refs]
+            prefetched = self._prefetch_local_plasma(refs) if len(refs) > 1 else None
+            out = [self._get_one(r, deadline, prefetched) for r in refs]
         finally:
             if blocked_lease is not None:
                 try:
@@ -737,8 +757,34 @@ class CoreWorker:
             raise GetTimeoutError("ray_tpu.get timed out")
         return rem
 
-    def _get_one(self, ref: ObjectRef, deadline):
+    def _prefetch_local_plasma(self, refs):
+        """Batch-resolve locally-sealed plasma objects in ONE raylet
+        round-trip (PlasmaGetBatch) — ``ray_tpu.get(list)`` of N local
+        plasma objects used to pay N PlasmaGet calls.  Objects not local
+        (or inline) fall through to the per-object path."""
+        with self._store_lock:
+            # only objects with a KNOWN plasma location (or borrowed refs,
+            # which may be plasma) are worth a batch probe — owned tasks
+            # whose inline results are still in flight would turn the probe
+            # into a wasted round-trip per get
+            want = [r.id for r in refs
+                    if r.id not in self.memory_store
+                    and r.id not in self.object_errors
+                    and (self.object_locations.get(r.id)
+                         or (r.owner_addr is not None
+                             and r.owner_addr != self.address))]
+        if len(want) < 2:
+            return None
+        try:
+            resolved = self.plasma.get_batch(want)
+        except Exception:  # noqa: BLE001 — fall back to per-object gets
+            return None
+        return resolved or None
+
+    def _get_one(self, ref: ObjectRef, deadline, prefetched=None):
         oid = ref.id
+        if prefetched is not None and oid in prefetched:
+            return prefetched.pop(oid)
         owner_is_self = ref.owner_addr == self.address or ref.owner_addr is None
         backoff = 0.001
         while True:
@@ -749,10 +795,16 @@ class CoreWorker:
                 err = self.object_errors.get(oid)
             if err is not None:
                 return err
-            # 2. local plasma
-            found, value = self._try_local_plasma(oid)
-            if found:
-                return value
+            # 2. local plasma — skip the contains-RPC for owned objects
+            # with no known plasma location: their value arrives inline via
+            # the task reply, and probing the raylet every wait-loop pass
+            # made each pending get pay an extra round-trip
+            with self._store_lock:
+                has_loc = bool(self.object_locations.get(oid))
+            if has_loc or not owner_is_self:
+                found, value = self._try_local_plasma(oid)
+                if found:
+                    return value
             if owner_is_self:
                 got = self._get_owned(oid, deadline)
             else:
@@ -760,7 +812,15 @@ class CoreWorker:
             if got is not _PENDING:
                 return got
             self._remaining(deadline)
-            time.sleep(backoff)
+            # wait on the store condition instead of sleeping blind: a task
+            # reply (inline value or plasma location) notifies _store_cv, so
+            # a just-finished task wakes its getter immediately instead of
+            # after a full backoff cycle
+            with self._store_lock:
+                if (oid not in self.memory_store
+                        and oid not in self.object_errors
+                        and not self.object_locations.get(oid)):
+                    self._store_cv.wait(timeout=backoff)
             backoff = min(backoff * 2, 0.05)
 
     def _try_local_plasma(self, oid):
@@ -817,7 +877,7 @@ class CoreWorker:
             for roid in spec.return_ids():
                 self.object_locations.pop(roid, None)
         self.task_manager.add_pending(spec)
-        self._submit_pool.submit(self._submit_with_retries, spec)
+        self._submitter.submit(spec)
         return True
 
     def _get_borrowed(self, ref: ObjectRef, deadline):
@@ -1139,7 +1199,7 @@ class CoreWorker:
         self.task_manager.add_pending(spec)
         self._pin_args(spec)
         self._record_task_event(spec, "SUBMITTED")
-        self._submit_pool.submit(self._submit_with_retries, spec)
+        self._submitter.submit(spec)
         if num_returns == "streaming":
             return ObjectRefGenerator(self, spec)
         refs = [ObjectRef(oid, self.address) for oid in spec.return_ids()]
@@ -1187,9 +1247,21 @@ class CoreWorker:
             cached = self._runtime_env_cache[cache_key] = renv.package(self, normalized)
         return cached
 
+    _fn_digest_cache: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
     def _publish_function(self, fn) -> Tuple[str, Optional[bytes]]:
+        # memoize the (pickle, sha1) per live callable: re-serializing the
+        # same function on every submit cost ~200µs/task on the hot path.
+        # Weak keying means a GC'd function frees its entry, so id reuse
+        # can never alias two digests.
+        if _weakrefable(fn):
+            digest = self._fn_digest_cache.get(fn)
+            if digest is not None and digest in self._published_fns:
+                return digest, None
         blob = serialization.dumps_inline(fn)
         digest = hashlib.sha1(blob).hexdigest()
+        if _weakrefable(fn):
+            self._fn_digest_cache[fn] = digest
         if digest in self._published_fns:
             return digest, None
         # Publish to GCS KV so workers can fetch once and cache
@@ -1201,7 +1273,7 @@ class CoreWorker:
         except Exception:  # noqa: BLE001
             return digest, blob
 
-    def _pack_arg(self, value):
+    def _pack_arg(self, value, oob: bool = True):
         if isinstance(value, ObjectRef):
             return ("ref", (value.id, value.owner_addr))
         data = serialization.dumps_inline(value)
@@ -1210,6 +1282,14 @@ class CoreWorker:
             ref = self.put(value)
             self.reference_counter.add_local_ref(ref)  # hold until task done
             return ("ref", (ref.id, ref.owner_addr))
+        if oob:
+            # large-ish inline blobs ride the rpc layer's out-of-band frame
+            # path (zero-copy to the socket).  oob=False for specs that are
+            # re-pickled in transit (actor creation goes driver→GCS→worker;
+            # a received memoryview cannot be pickled again).
+            from ray_tpu._private.rpc import oob_wrap
+
+            return ("value", oob_wrap(data))
         return ("value", data)
 
     def _pin_args(self, spec: TaskSpec):
@@ -1225,134 +1305,6 @@ class CoreWorker:
                 oid, owner = payload
                 if owner == self.address:
                     self.reference_counter.remove_submitted_ref(oid)
-
-    def _submit_with_retries(self, spec: TaskSpec):
-        try:
-            while True:
-                try:
-                    self._submit_once(spec)
-                    return
-                except (ConnectionLost, WorkerCrashedError, OutOfMemoryError, RemoteError) as e:
-                    if spec.task_id in self._cancelled_tasks:
-                        self._cancelled_tasks.discard(spec.task_id)
-                        self._fail_task(spec, TaskCancelledError(
-                            f"task {spec.name} was cancelled"))
-                        return
-                    if spec.max_retries != -1 and spec.attempt >= max(spec.max_retries, 0):
-                        err_cls = OutOfMemoryError if isinstance(e, OutOfMemoryError) else WorkerCrashedError
-                        self._fail_task(spec, err_cls(f"task {spec.name} failed after {spec.attempt + 1} attempts: {e}"))
-                        return
-                    spec.attempt += 1
-                    logger.info("retrying task %s (attempt %d): %s", spec.name, spec.attempt, e)
-                    if isinstance(e, OutOfMemoryError):
-                        # slower backoff: give node memory pressure time to
-                        # clear so retries aren't immediately re-killed
-                        time.sleep(min(1.0 * (2 ** min(spec.attempt, 5)), 30.0))
-                    else:
-                        time.sleep(min(0.05 * (2 ** min(spec.attempt, 6)), 2.0))
-        except Exception as e:  # noqa: BLE001
-            logger.exception("task %s submission failed", spec.name)
-            self._fail_task(spec, e)
-
-    def _submit_once(self, spec: TaskSpec):
-        if spec.task_id in self._cancelled_tasks:
-            self._cancelled_tasks.discard(spec.task_id)
-            raise TaskCancelledError(f"task {spec.name} was cancelled")
-        lease, raylet_cli = self._acquire_lease(spec)
-        if spec.submit_ts and spec.attempt == 0:
-            # first attempt only: retries would fold prior execution time and
-            # backoff sleeps into what is documented as scheduling latency
-            runtime_metrics.observe_submit_to_start(
-                time.monotonic() - spec.submit_ts)
-        worker_addr = tuple(lease["worker_addr"])
-        self._task_exec_addr[spec.task_id] = worker_addr
-        try:
-            reply = self._push_task_with_ack(
-                self.pool.get(worker_addr), spec, lease)
-        except ConnectionLost:
-            # the leasing raylet knows WHY the worker went away (its memory
-            # monitor records OOM kills — reference memory_monitor.h:52)
-            reason = None
-            try:
-                reason = raylet_cli.call(
-                    "GetWorkerExitReason", {"worker_addr": worker_addr},
-                    timeout=2, retry_deadline=0.0)
-            except Exception:  # noqa: BLE001
-                pass
-            if reason == "oom":
-                raise OutOfMemoryError(
-                    f"worker {worker_addr} running {spec.name} was killed by "
-                    "the memory monitor (node memory over threshold)")
-            raise WorkerCrashedError(f"worker {worker_addr} died while running {spec.name}")
-        finally:
-            self._task_exec_addr.pop(spec.task_id, None)
-            self._task_lease_raylet.pop(spec.task_id, None)
-        self._handle_task_reply(spec, reply, worker_addr)
-
-    def _push_task_with_ack(self, cli, spec: TaskSpec, lease: dict):
-        """Push the task and wait for its (possibly hours-long) reply, with a
-        lost-push heal: if the push frame vanished in flight (chaos drop,
-        kernel buffer teardown), the unacknowledged owner used to block
-        forever on the timeout=None call.  Now, after task_push_ack_timeout_s
-        without a reply, the worker is probed (HasTask); a worker that never
-        saw this (task, attempt) gets the push RESENT on the same lease —
-        duplicates are impossible because the worker registers receipt before
-        executing and ignores repeat frames for a live attempt."""
-        from concurrent.futures import FIRST_COMPLETED
-        from concurrent.futures import wait as _futures_wait
-
-        payload = {"spec": spec, "lease": lease}
-        futs = [cli.call_async("PushTask", payload)]
-        interval = max(global_config().task_push_ack_timeout_s, 0.1)
-        confirmed = False
-        while True:
-            done, _ = _futures_wait(
-                futs, timeout=None if confirmed else interval,
-                return_when=FIRST_COMPLETED)
-            if done:
-                ok = [f for f in done if f.exception() is None]
-                return (ok[0] if ok else next(iter(done))).result()
-            try:
-                seen = cli.call(
-                    "HasTask",
-                    {"task_id": spec.task_id.hex(), "attempt": spec.attempt},
-                    timeout=5, retry_deadline=0.0)
-            except Exception:  # noqa: BLE001 — probe inconclusive; a dead
-                continue  # socket surfaces ConnectionLost on the futures
-            if seen:
-                confirmed = True  # delivered; now just a long-running task
-            elif not any(f.done() for f in futs):
-                # Not-seen AND no reply: genuinely lost.  (A finished task
-                # also reads not-seen, but its reply frame precedes the probe
-                # reply on the same FIFO socket, so a done future is visible
-                # HERE before a completion-caused False — resending cannot
-                # duplicate an executed task.)
-                logger.warning(
-                    "push of task %s (attempt %d) to %s was lost; resending",
-                    spec.name, spec.attempt, cli.address)
-                futs.append(cli.call_async("PushTask", payload))
-
-    def _acquire_lease(self, spec: TaskSpec):
-        """Request a worker lease, following spillback redirects
-        (reference: scheduling-key lease queues normal_task_submitter.h:40-77)."""
-        target = self.raylet
-        if spec.strategy and spec.strategy.kind == "placement_group":
-            target = self._resolve_pg_raylet(spec)
-        hops = 0
-        while True:
-            # remember where this task queues so cancel() can reach it
-            # (PG routing and spillback land on OTHER raylets)
-            self._task_lease_raylet[spec.task_id] = target
-            reply = target.call("RequestWorkerLease", {"spec": spec, "for_actor": False}, timeout=None)
-            if reply.get("rejected"):
-                raise RemoteError(f"lease rejected: {reply.get('reason')}")
-            if "spillback" in reply:
-                hops += 1
-                if hops > 16:
-                    raise RemoteError("lease spillback loop")
-                target = self.pool.get(tuple(reply["spillback"]))
-                continue
-            return reply, target
 
     def _resolve_pg_raylet(self, spec: TaskSpec):
         info = self.gcs.call("GetPlacementGroup", {"pg_id": spec.strategy.placement_group_id})
@@ -1392,6 +1344,10 @@ class CoreWorker:
         if not self.task_manager.is_pending(spec.task_id):
             self._cancelled_tasks.discard(spec.task_id)
             return False
+        # still queued owner-side (never pushed to a worker)? drop it here
+        if spec.actor_id is None and self._submitter.try_cancel_queued(
+                spec.task_id):
+            return True
         # in flight on a worker? interrupt it there
         addr = self._task_exec_addr.get(spec.task_id)
         if addr is not None:
@@ -1411,21 +1367,36 @@ class CoreWorker:
 
     def HandleCancelTask(self, req):
         """Executor side: interrupt the running task (reference: the
-        cancellation path raising KeyboardInterrupt in the worker)."""
+        cancellation path raising KeyboardInterrupt in the worker).  A task
+        still QUEUED behind another on a (reused) lease is cancelled
+        promptly: its reply goes out NOW and the executor skips it when it
+        reaches the front of the FIFO."""
         task_id, force = req["task_id"], req.get("force", False)
         with self._exec_state_lock:
-            if self.current_task_id != task_id:
-                return False  # finished (or not here): never hit a bystander
-            if force:
-                logger.warning("force-cancel: exiting worker for task %s",
-                               task_id)
-                os._exit(1)
-            if self._exec_thread_id is not None:
-                import ctypes
+            if self.current_task_id == task_id:
+                if force:
+                    logger.warning("force-cancel: exiting worker for task %s",
+                                   task_id)
+                    os._exit(1)
+                if self._exec_thread_id is not None:
+                    import ctypes
 
-                ctypes.pythonapi.PyThreadState_SetAsyncExc(
-                    ctypes.c_ulong(self._exec_thread_id),
-                    ctypes.py_object(KeyboardInterrupt))
+                    ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                        ctypes.c_ulong(self._exec_thread_id),
+                        ctypes.py_object(KeyboardInterrupt))
+                return True
+        with self._queue_lock:
+            queued = self._queued_tokens.pop(task_id, None)
+        if queued is None:
+            return False  # finished (or not here): never hit a bystander
+        reply_token, attempt, lease_id = queued
+        self.server.send_reply(reply_token, {
+            "status": "error",
+            "error": TaskCancelledError("task was cancelled while queued"),
+            "traceback": ""})
+        with self._received_pushes_lock:
+            self._received_pushes.discard((task_id.hex(), attempt))
+        self._finish_lease_task(lease_id)
         return True
 
     def _handle_task_reply(self, spec: TaskSpec, reply: dict, worker_addr):
@@ -1438,7 +1409,7 @@ class CoreWorker:
             err = TaskError(reply["error"], reply.get("traceback", ""), spec.name)
             if spec.retry_exceptions and spec.attempt < spec.max_retries:
                 spec.attempt += 1
-                self._submit_pool.submit(self._submit_with_retries, spec)
+                self._submitter.submit(spec)
                 return
             self._fail_task(spec, err)
             return
@@ -1511,7 +1482,7 @@ class CoreWorker:
         if state == "SUBMITTED":
             # owner-side pid/node: timeline() places the submit slice (and
             # the outgoing flow-event arrow) on the submitting process
-            ev["pid"] = os.getpid()
+            ev["pid"] = fast_getpid()
             ev["node_id"] = self.node_id.hex() if self.node_id else None
         if extra:
             ev.update(extra)
@@ -1520,7 +1491,7 @@ class CoreWorker:
     def _record_exec_event(self, spec: TaskSpec):
         """Executor-side RUNNING event with pid/node for timeline + state API."""
         self._record_task_event(spec, "RUNNING", extra={
-            "pid": os.getpid(),
+            "pid": fast_getpid(),
             "node_id": self.node_id.hex() if self.node_id else None,
         })
 
@@ -1537,11 +1508,38 @@ class CoreWorker:
     def flush_task_events(self):
         with self._task_events_lock:
             events, self._task_events = self._task_events, []
+            self._last_event_flush = time.monotonic()
         if events:
             try:
                 self.gcs.notify("AddTaskEvents", {"events": events})
             except Exception:  # noqa: BLE001
                 pass
+
+    def maybe_flush_task_events(self, min_interval_s: float = 0.5):
+        """Paced flush for per-task hot paths: one GCS notify per interval
+        instead of one per executed task (the pre-fast-path behavior cost a
+        control-plane RPC per task).  append_task_events still force-flushes
+        at 100 buffered events; a skipped flush arms a one-shot timer so a
+        burst's trailing events still land within the interval."""
+        with self._task_events_lock:
+            if not self._task_events:
+                return
+            remaining = min_interval_s - (time.monotonic()
+                                          - self._last_event_flush)
+            if remaining > 0:
+                if not self._event_flush_timer_armed:
+                    self._event_flush_timer_armed = True
+                    t = threading.Timer(remaining, self._deferred_event_flush)
+                    t.daemon = True
+                    t.start()
+                return
+        self.flush_task_events()
+
+    def _deferred_event_flush(self):
+        with self._task_events_lock:
+            self._event_flush_timer_armed = False
+        if not self.shutting_down:
+            self.flush_task_events()
 
     # ------------------------------------------------------------------
     # Task execution (worker side; reference: core_worker.cc:2804
@@ -1550,6 +1548,7 @@ class CoreWorker:
 
     def HandlePushTask(self, req, reply_token=None):
         spec: TaskSpec = req["spec"]
+        lease: dict = req["lease"]
         key = (spec.task_id.hex(), spec.attempt)
         with self._received_pushes_lock:
             if key in self._received_pushes:
@@ -1558,18 +1557,99 @@ class CoreWorker:
                 # backlog): the first frame's reply settles the owner
                 return RpcServer.DELAYED_REPLY
             self._received_pushes.add(key)
+        lease_id = lease.get("lease_id")
+        with self._queue_lock:
+            if lease_id in self._stale_leases:
+                # the raylet revoked this lease (TTL reclaim / drain): the
+                # owner must resubmit through a fresh lease
+                with self._received_pushes_lock:
+                    self._received_pushes.discard(key)
+                return {"status": "lease_invalid"}
+            self._queued_tokens[spec.task_id] = (reply_token, spec.attempt,
+                                                 lease_id)
+            if lease_id:
+                self._lease_task_counts[lease_id] = (
+                    self._lease_task_counts.get(lease_id, 0) + 1)
+        req["_recv_ts"] = time.monotonic()
         self._exec_pool.submit(self._execute_task, req, reply_token)
         return RpcServer.DELAYED_REPLY
 
+    def _finish_lease_task(self, lease_id: Optional[str]):
+        with self._queue_lock:
+            if not lease_id:
+                return
+            n = self._lease_task_counts.get(lease_id, 0) - 1
+            if n > 0:
+                self._lease_task_counts[lease_id] = n
+            else:
+                self._lease_task_counts.pop(lease_id, None)
+
     def HandleHasTask(self, req):
         """Owner-side lost-push probe: has this (task, attempt) been
-        received here?  (push heal — see _push_task_with_ack)."""
+        received here?  (push heal — see NormalTaskSubmitter
+        ._probe_stale_pushes)."""
         with self._received_pushes_lock:
             return (req["task_id"], req.get("attempt", 0)) in self._received_pushes
+
+    def HandleLeaseState(self, req):
+        """Raylet TTL-reclaim probe: how many tasks of this lease are still
+        queued or running here?  Non-zero answers extend the lease."""
+        with self._queue_lock:
+            return {"queued": self._lease_task_counts.get(req["lease_id"], 0)}
+
+    def HandleStealTask(self, req):
+        """Owner-side work stealing (reference: the normal-task submitter's
+        work-stealing mode): give a task still QUEUED behind another back
+        to the owner, who re-pushes it on an idle lease.  A task already
+        running (or finished) is not stealable."""
+        task_id = req["task_id"]
+        with self._queue_lock:
+            queued = self._queued_tokens.pop(task_id, None)
+        if queued is None:
+            return False
+        reply_token, attempt, lease_id = queued
+        self.server.send_reply(reply_token, {"status": "stolen"})
+        with self._received_pushes_lock:
+            self._received_pushes.discard((task_id.hex(), attempt))
+        self._finish_lease_task(lease_id)
+        return True
+
+    def HandleLeaseRevoked(self, req):
+        """The raylet reclaimed a lease this worker served: refuse any
+        straggler push carrying it (the owner resubmits through a fresh
+        lease).  The mark set is bounded — old marks only matter for the
+        race window between reclaim and the owner noticing."""
+        lease_id = req.get("lease_id")
+        if lease_id:
+            with self._queue_lock:
+                self._stale_leases.add(lease_id)
+                self._stale_lease_order.append(lease_id)
+                while len(self._stale_lease_order) > 256:
+                    self._stale_leases.discard(
+                        self._stale_lease_order.popleft())
+        return True
 
     def _execute_task(self, req, reply_token):
         spec: TaskSpec = req["spec"]
         lease: dict = req["lease"]
+        lease_id = lease.get("lease_id")
+        with self._queue_lock:
+            if self._queued_tokens.pop(spec.task_id, None) is None:
+                # cancelled while queued: the cancel path already replied
+                # and cleaned up — never execute it
+                return
+            stale = lease_id in self._stale_leases
+        if stale:
+            # lease revoked while this push sat in the FIFO: the owner
+            # resubmits through a fresh lease; the task must not run on
+            # resources the raylet already released
+            self.server.send_reply(reply_token, {"status": "lease_invalid"})
+            with self._received_pushes_lock:
+                self._received_pushes.discard((spec.task_id.hex(), spec.attempt))
+            self._finish_lease_task(lease_id)
+            return
+        recv_ts = req.get("_recv_ts")
+        queued_s = (time.monotonic() - recv_ts) if recv_ts else 0.0
         replied = False
         try:
             self._record_exec_event(spec)
@@ -1616,7 +1696,9 @@ class CoreWorker:
 
                     ctypes.pythonapi.PyThreadState_SetAsyncExc(
                         ctypes.c_ulong(threading.get_ident()), None)
-            self.server.send_reply(reply_token, {"status": "ok", "returns": returns})
+            self.server.send_reply(
+                reply_token,
+                {"status": "ok", "returns": returns, "queued_s": queued_s})
             replied = True
         except KeyboardInterrupt:
             # injected by HandleCancelTask. PyThreadState_SetAsyncExc delivery
@@ -1650,11 +1732,16 @@ class CoreWorker:
             with self._received_pushes_lock:
                 self._received_pushes.discard(
                     (spec.task_id.hex(), spec.attempt))
-            try:
-                self.raylet.notify("ReturnWorker", {"lease_id": lease.get("lease_id")})
-            except BaseException:  # noqa: BLE001 (incl. late-delivered cancel KI)
-                pass
-            self.flush_task_events()
+            self._finish_lease_task(lease_id)
+            if not lease.get("reusable"):
+                # legacy single-task lease: the worker returns itself; a
+                # REUSABLE lease stays with the owner's cache (returned by
+                # the owner on idleness, or TTL-reclaimed by the raylet)
+                try:
+                    self.raylet.notify("ReturnWorker", {"lease_id": lease_id})
+                except BaseException:  # noqa: BLE001 (incl. late cancel KI)
+                    pass
+            self.maybe_flush_task_events()
             runtime_metrics.maybe_push()
 
     def _load_function(self, spec: TaskSpec):
@@ -1695,7 +1782,11 @@ class CoreWorker:
         data = serialization.dumps_inline(value)
         runtime_metrics.add_serialized_bytes("returns", len(data))
         if len(data) <= global_config().max_inline_object_size:
-            return (oid, "inline", data)
+            from ray_tpu._private.rpc import oob_wrap
+
+            # the reply crosses ONE hop (executor → owner) and the owner
+            # deserializes immediately: safe for the out-of-band frame path
+            return (oid, "inline", oob_wrap(data))
         from ray_tpu._private.object_store import plasma_create_write_seal
 
         meta, raws = serialization.dumps_with_buffers(value)
@@ -1769,8 +1860,9 @@ class CoreWorker:
             name=getattr(cls, "__name__", "Actor"),
             function_digest=digest,
             function_blob=blob,
-            args=[self._pack_arg(a) for a in args],
-            kwargs=[(k, *self._pack_arg(v)) for k, v in (kwargs or {}).items()],
+            args=[self._pack_arg(a, oob=False) for a in args],
+            kwargs=[(k, *self._pack_arg(v, oob=False))
+                    for k, v in (kwargs or {}).items()],
             resources=ResourceSet(resources or {"CPU": 1}),
             strategy=strategy or SchedulingStrategy(),
             owner_addr=self.address,
@@ -2014,7 +2106,7 @@ class CoreWorker:
                 self.flush_task_events()  # os._exit skips the finally below
                 os._exit(0)
         finally:
-            self.flush_task_events()
+            self.maybe_flush_task_events()
             runtime_metrics.maybe_push()
 
     def HandleKillActor(self, req):
@@ -2196,6 +2288,822 @@ class _ActorPipeline:
             self.current_addr = None
         for sp in doomed:
             self.w._fail_task(sp, error)
+
+
+class _InflightPush:
+    """One pushed-but-unreplied task on a cached lease."""
+
+    __slots__ = ("spec", "futs", "pushed_at", "confirmed", "settled",
+                 "steal_requested", "sched_delay")
+
+    def __init__(self, spec: TaskSpec):
+        self.spec = spec
+        self.futs: list = []
+        self.pushed_at = 0.0
+        self.confirmed = False   # HasTask probe saw it (long-running task)
+        self.settled = False     # a reply (or failure) was consumed
+        self.steal_requested = False
+        self.sched_delay = None  # owner-side submit→assignment, attempt 0
+
+
+class _CachedLease:
+    """A granted worker lease held by the owner for reuse (one worker)."""
+
+    __slots__ = ("key", "lease", "lease_id", "worker_addr", "raylet_cli",
+                 "worker_cli", "inflight", "idle_since", "valid",
+                 "no_assign", "used", "exit_reason")
+
+    def __init__(self, key, lease: dict, raylet_cli, worker_cli):
+        self.key = key
+        self.lease = lease
+        self.lease_id = lease.get("lease_id")
+        self.worker_addr = tuple(lease["worker_addr"])
+        self.raylet_cli = raylet_cli
+        self.worker_cli = worker_cli
+        self.inflight: Dict[TaskID, _InflightPush] = {}
+        self.idle_since = time.monotonic()
+        self.valid = True
+        self.no_assign = False   # draining raylet: finish in-flight, no new
+        self.used = False        # a task was assigned at least once
+        self.exit_reason: Optional[str] = None
+
+
+class _KeyState:
+    """Per-scheduling-key submission state (queue + cached leases)."""
+
+    __slots__ = ("queue", "leases", "requested", "saturated", "saturated_at",
+                 "spread")
+
+    def __init__(self, spread: bool = False):
+        self.queue: deque = deque()
+        self.leases: List[_CachedLease] = []
+        self.requested = 0       # lease units with an outstanding request
+        # SPREAD-strategy keys bypass the cache: reusing a lease would
+        # funnel tasks to one node, defeating the strategy's purpose —
+        # every task gets a fresh (raylet-distributed) lease instead
+        self.spread = spread
+        # the last batched request came back SHORT (cluster capacity for
+        # this key is exhausted): pipeline onto held leases instead of
+        # queueing tasks owner-side for grants that won't come.  Cleared
+        # when a lease is dropped (capacity may exist again) and re-probed
+        # periodically while tasks still queue (the cluster may grow).
+        self.saturated = False
+        self.saturated_at = 0.0
+
+
+class NormalTaskSubmitter:
+    """Owner-side fast path for normal (non-actor) task submission.
+
+    reference: the scheduling-key lease queues of NormalTaskSubmitter
+    (normal_task_submitter.h:40-77).  Tasks are grouped by scheduling key
+    (resource shape + runtime-env fingerprint + strategy); granted worker
+    leases are CACHED per key and reused after a task finishes, with up to
+    ``max_tasks_in_flight_per_worker`` tasks pipelined per leased worker
+    (the worker executes FIFO), so the steady-state cost of a task is one
+    PushTask round-trip instead of lease-request + push + return.  Lease
+    demand is BATCHED: a key with N queued tasks asks for up to N leases
+    (capped at 256) in ONE RequestWorkerLease call instead of N per-task
+    RPCs — parallelism first; a short grant marks the key saturated,
+    which engages pipelining and periodic re-probes.  Idle leases are
+    returned after
+    ``worker_lease_idle_timeout_s``; the raylet additionally reclaims
+    leases whose TTL lapses unextended (owner death / lost extensions),
+    after which a straggler push is refused with ``lease_invalid`` and the
+    task resubmits through a fresh lease — never silently dropped.
+
+    Fault paths: a dead worker fails ONLY its own queue (each task charged
+    one retry attempt), lost pushes heal through the per-task HasTask
+    ack-probe, and a draining raylet flips its leases to no-assign within
+    one extension interval so new tasks land on survivors.
+    """
+
+    def __init__(self, worker: "CoreWorker"):
+        self.w = worker
+        self.lock = threading.Lock()
+        self.states: Dict[tuple, _KeyState] = {}
+        # id(env) → (env, hash): the strong ref to env PINS the id — a
+        # freed dict's id can be reused by a different env, so the entry
+        # must keep its key's referent alive to stay sound
+        self._env_key_cache: Dict[int, Tuple[dict, str]] = {}
+        self._retries: list = []          # heap of (due, seq, spec)
+        self._retry_seq = 0
+        self._inflight_total = 0
+        self._last_extend = 0.0
+        # assignment → wire decoupling: _pump enqueues, the pusher thread
+        # drains.  While one (expensive, ~100µs on this kernel) sendmsg is
+        # in flight, concurrent submits pile up behind it and the next
+        # drain coalesces them into one vectored write per lease — burst
+        # submission pays ~one syscall per WORKER, not per task.
+        self._send_q: deque = deque()
+        self._send_ev = threading.Event()
+        self._pusher = threading.Thread(
+            target=self._pusher_loop, daemon=True,
+            name="task-submitter-push")
+        self._pusher.start()
+        self._thread = threading.Thread(
+            target=self._maintenance_loop, daemon=True,
+            name="task-submitter-maint")
+        self._thread.start()
+
+    # -- scheduling key -------------------------------------------------
+
+    def _key_for(self, spec: TaskSpec) -> tuple:
+        from ray_tpu._private.scheduler import SchedulingStrategy
+
+        strat = spec.strategy or SchedulingStrategy()
+        env = spec.runtime_env
+        if not env:
+            env_key = ""
+        else:
+            entry = self._env_key_cache.get(id(env))
+            if entry is not None and entry[0] is env:
+                env_key = entry[1]
+            else:
+                from ray_tpu._private import runtime_env as renv
+
+                if len(self._env_key_cache) > 4096:
+                    self._env_key_cache.clear()
+                env_key = renv.env_hash(renv.normalize(env))
+                self._env_key_cache[id(env)] = (env, env_key)
+        return (
+            tuple(sorted(spec.resources.to_dict().items())),
+            env_key,
+            strat.kind,
+            strat.node_id,
+            strat.soft,
+            str(strat.placement_group_id)
+            if strat.placement_group_id is not None else None,
+            strat.bundle_index,
+            tuple(sorted((strat.labels or {}).items())),
+        )
+
+    # -- submission -----------------------------------------------------
+
+    def submit(self, spec: TaskSpec):
+        w = self.w
+        if w.shutting_down:
+            w._fail_task(spec, WorkerCrashedError("worker shutting down"))
+            return
+        key = self._key_for(spec)
+        with self.lock:
+            st = self.states.get(key)
+            if st is None:
+                st = self.states[key] = _KeyState(spread=(key[2] == "spread"))
+            st.queue.append(spec)
+        if spec.trace_id is not None:
+            # per-task QUEUED/SCHEDULED phases moved owner-side with the
+            # lease cache (the raylet only sees one representative spec per
+            # batch); stamped for traced tasks — the tracing timeline needs
+            # them, the untraced hot path shouldn't pay 2 events per task
+            w._record_task_event(spec, "QUEUED")
+        self._pump(key)
+
+    def _pump(self, key):
+        """Assign queued tasks to cached leases; request leases for the
+        remainder.  Parallelism first: while the cluster may still grant
+        leases (not saturated, no request in flight) each lease takes ONE
+        task and the rest wait for fresh grants — a long task must not
+        trap a later one behind it when a free worker was available.
+        Pipelining (depth up to max_tasks_in_flight_per_worker) engages
+        while a request is outstanding and once the raylet's grant came
+        back short (capacity exhausted — queueing owner-side would just
+        idle the workers we DO hold)."""
+        cfg = global_config()
+        max_if = max(1, cfg.max_tasks_in_flight_per_worker)
+        pushes = []
+        requests: List[int] = []
+        with self.lock:
+            st = self.states.get(key)
+            if st is None:
+                return
+            # depth 1 until the raylet has demonstrated capacity exhaustion
+            # (short grant): pipelining a task behind a possibly-long one
+            # is only right when no free worker could be granted anyway
+            depth = max_if if (st.saturated and not st.spread) else 1
+            while st.queue:
+                best = None
+                best_n = None
+                for lease in st.leases:
+                    if not lease.valid or lease.no_assign:
+                        continue
+                    limit = depth if lease.lease.get("reusable") else 1
+                    n = len(lease.inflight)
+                    if n < limit and (best_n is None or n < best_n):
+                        best, best_n = lease, n
+                if best is None:
+                    break
+                spec = st.queue.popleft()
+                entry = _InflightPush(spec)
+                best.inflight[spec.task_id] = entry
+                self._inflight_total += 1
+                pushes.append((best, spec, entry, best.used))
+                best.used = True
+            if st.queue:
+                if st.spread:
+                    # fresh lease per task, requests covering the queue:
+                    # the raylet's spread policy does the distributing
+                    deficit = min(len(st.queue), 64) - st.requested
+                    if deficit > 0:
+                        st.requested += deficit
+                        requests.append(deficit)
+                elif cfg.worker_lease_reuse_enabled:
+                    # ONE outstanding batched request per key: ask for a
+                    # lease per queued task; the raylet grants what fits
+                    # and the short grant flips this key to saturated.
+                    # Saturated keys re-probe every few seconds (the
+                    # cluster may have grown) without stalling pipelining.
+                    now = time.monotonic()
+                    reprobe = (st.saturated
+                               and now - st.saturated_at > 5.0)
+                    if st.requested == 0 and (not st.saturated or reprobe):
+                        if reprobe:
+                            st.saturated_at = now
+                        count = min(len(st.queue), 256)
+                        st.requested = count
+                        requests.append(count)
+                else:
+                    # legacy A/B mode: per-task lease requests
+                    deficit = min(len(st.queue), 8) - st.requested
+                    if deficit > 0:
+                        st.requested += deficit
+                        requests.extend([1] * deficit)
+        if pushes:
+            now = time.monotonic()
+            for lease, spec, entry, reused in pushes:
+                runtime_metrics.add_lease_reuse("hit" if reused else "new")
+                if spec.submit_ts and spec.attempt == 0:
+                    # submit→start is completed at reply time by adding the
+                    # worker-reported FIFO wait: a task pipelined behind a
+                    # long one must not report ~0 scheduling latency
+                    entry.sched_delay = now - spec.submit_ts
+                if spec.trace_id is not None:
+                    self.w._record_task_event(spec, "SCHEDULED")
+                self._send_q.append((lease, spec, entry))
+            self._send_ev.set()
+        for count in requests:
+            self.w._submit_pool.submit(self._request_leases, key, count)
+
+    def _pusher_loop(self):
+        while True:
+            self._send_ev.wait(timeout=0.5)
+            if self.w.shutting_down:
+                return
+            self._send_ev.clear()
+            items = []
+            while True:
+                try:
+                    items.append(self._send_q.popleft())
+                except IndexError:
+                    break
+            if not items:
+                continue
+            by_lease: Dict[int, tuple] = {}
+            for lease, spec, entry in items:
+                by_lease.setdefault(id(lease), (lease, []))[1].append(
+                    (spec, entry))
+            for lease, group in by_lease.values():
+                try:
+                    self._push_batch(lease, group)
+                except Exception:  # noqa: BLE001 — one bad batch must not
+                    # kill the (only) pusher thread: every later submission
+                    # would enqueue forever with no error
+                    logger.exception("push batch of %d tasks failed",
+                                     len(group))
+
+    def _push_batch(self, lease: _CachedLease, items):
+        """Push every (spec, entry) bound to this lease in ONE vectored
+        socket write — pipelined tasks to the same worker share a syscall."""
+        w = self.w
+        for spec, _ in items:
+            w._task_exec_addr[spec.task_id] = lease.worker_addr
+            w._task_lease_raylet[spec.task_id] = lease.raylet_cli
+        try:
+            futs = lease.worker_cli.call_async_batch(
+                [("PushTask", {"spec": spec, "lease": lease.lease})
+                 for spec, _ in items])
+        except Exception as e:  # noqa: BLE001 — ConnectionLost, or a spec
+            # that won't encode: fail over per task (retries are charged;
+            # a deterministic encode error exhausts them and surfaces)
+            with self.lock:
+                for spec, entry in items:
+                    if (not entry.settled
+                            and lease.inflight.pop(spec.task_id, None)
+                            is not None):
+                        entry.settled = True
+                        self._inflight_total -= 1
+            for spec, _ in items:
+                try:
+                    self._on_push_error(lease, spec, e)
+                except Exception:  # noqa: BLE001
+                    logger.exception("push failover failed for %s", spec.name)
+            return
+        now = time.monotonic()
+        for (spec, entry), fut in zip(items, futs):
+            entry.futs.append(fut)
+            entry.pushed_at = now
+            fut.add_done_callback(
+                lambda f, l=lease, s=spec: self._on_reply(l, s, f))
+
+    # -- reply / failure handling ---------------------------------------
+
+    def _on_reply(self, lease: _CachedLease, spec: TaskSpec, fut):
+        exc = fut.exception()
+        with self.lock:
+            entry = lease.inflight.get(spec.task_id)
+            if entry is None or entry.settled:
+                return  # duplicate resend reply; the first one settled it
+            entry.settled = True
+            lease.inflight.pop(spec.task_id, None)
+            self._inflight_total -= 1
+            if not lease.inflight:
+                lease.idle_since = time.monotonic()
+        w = self.w
+        w._task_exec_addr.pop(spec.task_id, None)
+        if exc is not None:
+            self._on_push_error(lease, spec, exc)
+            return
+        reply = fut.result()
+        if isinstance(reply, dict) and reply.get("status") == "lease_invalid":
+            # raylet reclaimed the lease under us (TTL after lost
+            # extensions): the task never ran — resubmit uncharged
+            self._invalidate_lease(lease)
+            self.submit(spec)
+            return
+        if isinstance(reply, dict) and reply.get("status") == "stolen":
+            # work stealing: the task was pulled back off a backlogged
+            # worker's queue — resubmit uncharged; the idle lease that
+            # initiated the steal picks it up
+            self.submit(spec)
+            return
+        if not lease.lease.get("reusable"):
+            self._invalidate_lease(lease)
+        else:
+            with self.lock:
+                st = self.states.get(lease.key)
+                spread = st.spread if st is not None else False
+            if spread:
+                self._invalidate_lease(lease, return_worker=True)
+        if entry.sched_delay is not None and isinstance(reply, dict):
+            # owner-side submit→assignment plus the worker-reported FIFO
+            # wait (both intervals local to one clock — no cross-host skew)
+            runtime_metrics.observe_submit_to_start(
+                entry.sched_delay + float(reply.get("queued_s") or 0.0))
+        try:
+            w._handle_task_reply(spec, reply, lease.worker_addr)
+        except Exception:  # noqa: BLE001
+            logger.exception("task reply handling failed for %s", spec.name)
+        self._pump(lease.key)
+        self._rebalance(lease.key)
+
+    def _lease_exit_reason(self, lease: _CachedLease) -> str:
+        if lease.exit_reason is None:
+            try:
+                lease.exit_reason = lease.raylet_cli.call(
+                    "GetWorkerExitReason",
+                    {"worker_addr": lease.worker_addr},
+                    timeout=2, retry_deadline=0.0) or ""
+            except Exception:  # noqa: BLE001
+                lease.exit_reason = ""
+        return lease.exit_reason
+
+    def _on_push_error(self, lease: _CachedLease, spec: TaskSpec, exc):
+        """The leased worker died (or its socket did): fail over ONLY the
+        tasks on this lease — each is charged one attempt and retried
+        through a fresh lease, exactly once per death (no duplicates: the
+        worker is gone, nothing queued there survives)."""
+        w = self.w
+        w._task_exec_addr.pop(spec.task_id, None)
+        reason = self._lease_exit_reason(lease)
+        self._invalidate_lease(lease)
+        if spec.task_id in w._cancelled_tasks:
+            w._cancelled_tasks.discard(spec.task_id)
+            w._fail_task(spec, TaskCancelledError(
+                f"task {spec.name} was cancelled"))
+            return
+        if reason == "oom":
+            err: Exception = OutOfMemoryError(
+                f"worker {lease.worker_addr} running {spec.name} was killed "
+                "by the memory monitor (node memory over threshold)")
+        else:
+            err = WorkerCrashedError(
+                f"worker {lease.worker_addr} died while running {spec.name}: "
+                f"{exc}")
+        self._retry_or_fail(spec, err)
+
+    def _retry_or_fail(self, spec: TaskSpec, err: Exception):
+        w = self.w
+        if spec.max_retries != -1 and spec.attempt >= max(spec.max_retries, 0):
+            err_cls = (OutOfMemoryError if isinstance(err, OutOfMemoryError)
+                       else WorkerCrashedError)
+            w._fail_task(spec, err_cls(
+                f"task {spec.name} failed after {spec.attempt + 1} "
+                f"attempts: {err}"))
+            return
+        spec.attempt += 1
+        logger.info("retrying task %s (attempt %d): %s",
+                    spec.name, spec.attempt, err)
+        if isinstance(err, OutOfMemoryError):
+            # slower backoff: give node memory pressure time to clear so
+            # retries aren't immediately re-killed
+            delay = min(1.0 * (2 ** min(spec.attempt, 5)), 30.0)
+        else:
+            delay = min(0.05 * (2 ** min(spec.attempt, 6)), 2.0)
+        import heapq
+
+        with self.lock:
+            self._retry_seq += 1
+            heapq.heappush(self._retries,
+                           (time.monotonic() + delay, self._retry_seq, spec))
+
+    # -- lease lifecycle -------------------------------------------------
+
+    def _invalidate_lease(self, lease: _CachedLease,
+                          return_worker: bool = False):
+        with self.lock:
+            if not lease.valid:
+                return
+            lease.valid = False
+            st = self.states.get(lease.key)
+            if st is not None:
+                if lease in st.leases:
+                    st.leases.remove(lease)
+                # a dropped lease frees resources: the next pump may get
+                # fresh grants again
+                st.saturated = False
+        if return_worker:
+            try:
+                lease.raylet_cli.notify("ReturnWorker",
+                                        {"lease_id": lease.lease_id})
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _request_leases(self, key, count: int):
+        try:
+            self._request_leases_body(key, count)
+        except Exception:  # noqa: BLE001
+            logger.exception("lease request for key %s failed", key)
+        finally:
+            with self.lock:
+                st = self.states.get(key)
+                if st is not None:
+                    st.requested = max(0, st.requested - count)
+            self._pump(key)
+            self._rebalance(key)
+
+    def _request_leases_body(self, key, count: int):
+        w = self.w
+        with self.lock:
+            st = self.states.get(key)
+            spec = st.queue[0] if st and st.queue else None
+        if spec is None:
+            return
+        runtime_metrics.inc_lease_request()
+        target = w.raylet
+        hops = 0
+        rejections = 0
+        while not w.shutting_down:
+            try:
+                if (hops == 0 and spec.strategy
+                        and spec.strategy.kind == "placement_group"):
+                    target = w._resolve_pg_raylet(spec)
+                reply = target.call(
+                    "RequestWorkerLease",
+                    {"spec": spec, "for_actor": False, "num_leases": count},
+                    timeout=None)
+            except (ConnectionLost, RemoteError) as e:
+                reply = {"rejected": True, "reason": str(e)}
+            if "spillback" in reply and "leases" not in reply:
+                hops += 1
+                if hops > 16:
+                    reply = {"rejected": True, "reason": "lease spillback loop"}
+                else:
+                    target = w.pool.get(tuple(reply["spillback"]))
+                    continue
+            if reply.get("rejected"):
+                rejections += 1
+                survivors = self._charge_rejection(
+                    key, reply.get("reason", ""))
+                if not survivors:
+                    return
+                time.sleep(min(0.05 * (2 ** min(rejections, 6)), 2.0))
+                target = w.raylet
+                hops = 0
+                with self.lock:
+                    st = self.states.get(key)
+                    spec = st.queue[0] if st and st.queue else None
+                if spec is None:
+                    return
+                continue
+            leases = reply.get("leases") or [reply]
+            spill = reply.get("spillback") if "leases" in reply else None
+            with self.lock:
+                st = self.states.get(key)
+                if st is None:
+                    st = self.states[key] = _KeyState(spread=(key[2] == "spread"))
+                if spill is None:
+                    # final grant of this round: short means the cluster
+                    # can't serve more leases for this key right now
+                    st.saturated = len(leases) < count
+                    st.saturated_at = time.monotonic()
+                for ld in leases:
+                    st.leases.append(_CachedLease(
+                        key, ld,
+                        raylet_cli=w.pool.get(tuple(ld["raylet_addr"])),
+                        worker_cli=w.pool.get(tuple(ld["worker_addr"]))))
+            if spill is not None and len(leases) < count:
+                # partial local grant + a pointer at the node holding the
+                # next-best capacity: keep requesting the remainder there
+                hops += 1
+                if hops > 16:
+                    return
+                count -= len(leases)
+                target = w.pool.get(tuple(spill))
+                self._pump(key)
+                continue
+            return
+
+    def _charge_rejection(self, key, reason: str) -> int:
+        """A rejected lease request charges every queued task of the key
+        one attempt (mirroring the per-task retry accounting the old
+        per-task lease path had); over-budget tasks fail with the
+        rejection reason.  Returns how many tasks survive to retry."""
+        w = self.w
+        with self.lock:
+            st = self.states.get(key)
+            if st is None:
+                return 0
+            specs = list(st.queue)
+            st.queue.clear()
+        survivors, doomed, cancelled = [], [], []
+        for sp in specs:
+            if sp.task_id in w._cancelled_tasks:
+                cancelled.append(sp)
+            elif sp.max_retries != -1 and sp.attempt >= max(sp.max_retries, 0):
+                doomed.append(sp)
+            else:
+                sp.attempt += 1
+                survivors.append(sp)
+        with self.lock:
+            st = self.states.get(key)
+            if st is not None:
+                st.queue.extendleft(reversed(survivors))
+        for sp in cancelled:
+            w._cancelled_tasks.discard(sp.task_id)
+            w._fail_task(sp, TaskCancelledError(
+                f"task {sp.name} was cancelled"))
+        for sp in doomed:
+            w._fail_task(sp, WorkerCrashedError(
+                f"task {sp.name} failed after {sp.attempt + 1} attempts: "
+                f"lease rejected: {reason}"))
+        return len(survivors)
+
+    def _rebalance(self, key):
+        """Work stealing (reference: the submitter's work-stealing mode):
+        when a lease idles with nothing queued owner-side while a peer
+        lease has tasks stacked behind a running one, pull the most
+        recently pushed (least likely to have started) task back — the
+        worker refuses if it already started.  Prevents the pipelining
+        gamble from stranding short tasks behind a long one once capacity
+        frees up elsewhere."""
+        steals = []
+        with self.lock:
+            st = self.states.get(key)
+            if st is None or st.queue:
+                return
+            idle = [l for l in st.leases
+                    if l.valid and not l.no_assign and not l.inflight
+                    and l.lease.get("reusable")]
+            if not idle:
+                return
+            victims = sorted(
+                (l for l in st.leases if l.valid and len(l.inflight) > 1),
+                key=lambda l: -len(l.inflight))
+            vi = 0
+            for _ in idle:
+                while vi < len(victims):
+                    victim = victims[vi]
+                    candidates = [e for e in victim.inflight.values()
+                                  if not e.steal_requested and not e.settled]
+                    if len(victim.inflight) <= 1 or not candidates:
+                        vi += 1
+                        continue
+                    # most recently pushed = deepest in the worker's FIFO,
+                    # least likely to have started
+                    entry = max(candidates, key=lambda e: e.pushed_at)
+                    entry.steal_requested = True
+                    steals.append((victim, entry.spec.task_id))
+                    break
+                else:
+                    break
+        for victim, task_id in steals:
+            try:
+                victim.worker_cli.notify("StealTask", {"task_id": task_id})
+            except Exception:  # noqa: BLE001
+                pass
+
+    # -- owner-side cancellation ----------------------------------------
+
+    def try_cancel_queued(self, task_id: TaskID) -> bool:
+        """Remove a task still queued owner-side (never pushed); fails it
+        with TaskCancelledError.  Returns False when it already left the
+        queue (pushed or finished)."""
+        found = None
+        with self.lock:
+            for st in self.states.values():
+                for sp in st.queue:
+                    if sp.task_id == task_id:
+                        st.queue.remove(sp)
+                        found = sp
+                        break
+                if found is not None:
+                    break
+            if found is None:
+                for i, (_, _, sp) in enumerate(self._retries):
+                    if sp.task_id == task_id:
+                        import heapq
+
+                        self._retries.pop(i)
+                        heapq.heapify(self._retries)
+                        found = sp
+                        break
+        if found is None:
+            return False
+        self.w._cancelled_tasks.discard(task_id)
+        self.w._fail_task(found, TaskCancelledError(
+            f"task {found.name} was cancelled"))
+        return True
+
+    # -- maintenance -----------------------------------------------------
+
+    def _maintenance_loop(self):
+        import heapq
+
+        while True:
+            time.sleep(0.1)
+            w = self.w
+            if w.shutting_down:
+                self.release_all_leases()
+                return
+            try:
+                now = time.monotonic()
+                due = []
+                with self.lock:
+                    while self._retries and self._retries[0][0] <= now:
+                        due.append(heapq.heappop(self._retries)[2])
+                for spec in due:
+                    self.submit(spec)
+                self._retire_idle_leases(now)
+                # liveness sweep: a key whose queue outlived its leases
+                # (drain flipped them no-assign, retire dropped them, no
+                # reply left to re-pump) must still get lease requests —
+                # the saturation re-probe only fires inside _pump
+                with self.lock:
+                    queued_keys = [k for k, st in self.states.items()
+                                   if st.queue]
+                for key in queued_keys:
+                    self._pump(key)
+                cfg = global_config()
+                interval = max(0.5, cfg.worker_lease_ttl_s / 4.0)
+                if now - self._last_extend >= interval:
+                    self._last_extend = now
+                    self._extend_leases()
+                self._probe_stale_pushes(now)
+                runtime_metrics.set_tasks_in_flight(self._inflight_total)
+            except Exception:  # noqa: BLE001
+                logger.exception("task-submitter maintenance pass failed")
+
+    def _retire_idle_leases(self, now: float):
+        cfg = global_config()
+        idle_after = cfg.worker_lease_idle_timeout_s
+        retire = []
+        with self.lock:
+            for key, st in list(self.states.items()):
+                for lease in list(st.leases):
+                    if lease.inflight:
+                        continue
+                    if (lease.no_assign or not lease.valid
+                            or not lease.lease.get("reusable")
+                            or not cfg.worker_lease_reuse_enabled
+                            or now - lease.idle_since > idle_after):
+                        lease.valid = False
+                        st.leases.remove(lease)
+                        retire.append(lease)
+                        # a dropped lease frees resources: the next pump
+                        # may get fresh grants (mirrors _invalidate_lease)
+                        st.saturated = False
+                if not st.leases and not st.queue and not st.requested:
+                    del self.states[key]
+        for lease in retire:
+            try:
+                lease.raylet_cli.notify("ReturnWorker",
+                                        {"lease_id": lease.lease_id})
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _extend_leases(self):
+        """One ExtendLease call per raylet covering every held lease; the
+        reply doubles as the invalidation/drain poll — a draining raylet
+        flips its leases to no-assign HERE, so the owner stops pushing
+        within one extension interval."""
+        with self.lock:
+            by_raylet: Dict[Any, List[_CachedLease]] = {}
+            for st in self.states.values():
+                for lease in st.leases:
+                    if lease.valid and lease.lease.get("reusable"):
+                        by_raylet.setdefault(lease.raylet_cli, []).append(lease)
+        repump = set()
+        for cli, leases in by_raylet.items():
+            try:
+                reply = cli.call(
+                    "ExtendLease",
+                    {"lease_ids": [l.lease_id for l in leases]},
+                    timeout=2, retry_deadline=0.0)
+            except Exception:  # noqa: BLE001 — unreachable raylet: its
+                continue  # TTL reclaim converges; pushes surface errors
+            if not isinstance(reply, dict):
+                continue
+            invalid = set(reply.get("invalid") or ())
+            draining = bool(reply.get("draining"))
+            for lease in leases:
+                if lease.lease_id in invalid:
+                    self._invalidate_lease(lease)
+                    repump.add(lease.key)
+                elif draining and not lease.no_assign:
+                    with self.lock:
+                        lease.no_assign = True
+                    repump.add(lease.key)
+        for key in repump:
+            self._pump(key)
+
+    def _probe_stale_pushes(self, now: float):
+        """Lost-push heal (owner side of the PR-4 HasTask protocol), per
+        pipelined task: a push unacknowledged past task_push_ack_timeout_s
+        is probed; a worker that never saw this (task, attempt) gets the
+        push RESENT on the same lease.  Duplicates are impossible: the
+        worker registers receipt before executing and ignores repeat
+        frames for a live attempt, and a finished task's reply frame
+        precedes the probe reply on the same FIFO socket."""
+        timeout = max(global_config().task_push_ack_timeout_s, 0.1)
+        probes = []
+        with self.lock:
+            for st in self.states.values():
+                for lease in st.leases:
+                    for entry in lease.inflight.values():
+                        if (not entry.confirmed and not entry.settled
+                                and entry.pushed_at
+                                and now - entry.pushed_at > timeout):
+                            probes.append((lease, entry))
+        for lease, entry in probes:
+            spec = entry.spec
+            try:
+                seen = lease.worker_cli.call(
+                    "HasTask",
+                    {"task_id": spec.task_id.hex(), "attempt": spec.attempt},
+                    timeout=5, retry_deadline=0.0)
+            except Exception:  # noqa: BLE001 — probe inconclusive; a dead
+                continue  # socket surfaces ConnectionLost on the futures
+            if entry.settled:
+                continue
+            if seen:
+                entry.confirmed = True
+            elif not any(f.done() for f in entry.futs):
+                logger.warning(
+                    "push of task %s (attempt %d) to %s was lost; resending",
+                    spec.name, spec.attempt, lease.worker_addr)
+                try:
+                    fut = lease.worker_cli.call_async(
+                        "PushTask", {"spec": spec, "lease": lease.lease})
+                except ConnectionLost:
+                    continue
+                entry.futs.append(fut)
+                entry.pushed_at = now
+                fut.add_done_callback(
+                    lambda f, l=lease, s=spec: self._on_reply(l, s, f))
+
+    def release_all_leases(self):
+        """Best-effort return of every cached lease (shutdown path); the
+        raylet's TTL reclaim covers anything the notifies miss."""
+        with self.lock:
+            leases = [l for st in self.states.values() for l in st.leases]
+            for st in self.states.values():
+                st.leases.clear()
+        for lease in leases:
+            lease.valid = False
+            try:
+                lease.raylet_cli.notify("ReturnWorker",
+                                        {"lease_id": lease.lease_id})
+            except Exception:  # noqa: BLE001
+                pass
+
+    def stats(self) -> dict:
+        with self.lock:
+            return {
+                "keys": len(self.states),
+                "cached_leases": sum(len(st.leases)
+                                     for st in self.states.values()),
+                "queued": sum(len(st.queue) for st in self.states.values()),
+                "in_flight": self._inflight_total,
+            }
 
 
 _PENDING = object()

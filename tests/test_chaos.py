@@ -151,3 +151,90 @@ def test_workload_survives_rpc_drops(chaos_cluster):
             break
         time.sleep(0.2)
     assert w._actor_state_cache.get(a._actor_id) == "DEAD"
+
+
+@pytest.mark.slow
+def test_lease_keepalive_drops_heal_via_ttl_reclaim(chaos_config):
+    """Chaos-drop every ReturnWorker and ExtendLease RPC: the raylet never
+    hears from the owner again after the grant, so the lease TTL lapses and
+    the raylet idle-reclaims the worker back into its pool.  The owner sees
+    invalidation (ExtendLease 'invalid' reply or a lease_invalid push
+    refusal), not a hang — later tasks acquire fresh leases and complete."""
+    cfg = RayTpuConfig()
+    cfg.testing_rpc_failure = "ReturnWorker=100:1.0:0.0,ExtendLease=100:1.0:0.0"
+    cfg.worker_lease_ttl_s = 1.5
+    cfg.worker_lease_idle_timeout_s = 0.3
+    cfg.gcs_rpc_timeout_s = 5.0
+    set_global_config(cfg)
+    reset_chaos_for_testing(cfg.testing_rpc_failure)
+    cluster = Cluster(head_node_args={"num_cpus": 2})
+    w = cluster.connect_driver()
+    head = cluster.head_node
+    try:
+        @ray_tpu.remote
+        def mul(x):
+            return x * 5
+
+        assert ray_tpu.get([mul.remote(i) for i in range(4)],
+                           timeout=120) == [i * 5 for i in range(4)]
+
+        # idle leases cannot be returned (ReturnWorker dropped) nor extended
+        # (ExtendLease dropped): the raylet must TTL-reclaim them
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            with head._lock:
+                reusable = [l for l in head._leases.values() if l.reusable]
+            if not reusable:
+                break
+            time.sleep(0.2)
+        with head._lock:
+            assert not [l for l in head._leases.values() if l.reusable], (
+                "raylet never reclaimed unreachable-owner leases")
+
+        # the owner is NOT hung: fresh submissions get fresh leases
+        assert ray_tpu.get([mul.remote(i) for i in range(4)],
+                           timeout=120) == [i * 5 for i in range(4)]
+    finally:
+        cluster.shutdown()
+
+
+@pytest.mark.slow
+def test_drain_invalidates_cached_leases_promptly(chaos_config):
+    """A node holding CACHED (idle or busy) leases drains: the owner's next
+    ExtendLease poll reports draining and the owner stops pushing there
+    within the poll interval — subsequent tasks land on survivors."""
+    cfg = RayTpuConfig()
+    cfg.worker_lease_ttl_s = 2.0  # extension poll every ~0.5s
+    set_global_config(cfg)
+    reset_chaos_for_testing("")
+    cluster = Cluster(head_node_args={"num_cpus": 1})
+    b = cluster.add_node(num_cpus=1, resources={"side": 1})
+    w = cluster.connect_driver()
+    try:
+        @ray_tpu.remote(resources={"side": 0.001})
+        def where():
+            return ray_tpu.get_runtime_context().get_node_id().hex()
+
+        # warm a cached lease on B (the only node with 'side')
+        assert ray_tpu.get(where.remote(), timeout=120) == b.node_id.hex()
+
+        # drain B, give the owner one extension interval to notice, then
+        # prove it stopped pushing: B takes no further work even while its
+        # drain window is still open
+        w.pool.get(tuple(b.address)).call(
+            "DrainRaylet", {"reason": "test", "deadline_s": 60.0})
+        time.sleep(1.5)
+        with w._submitter.lock:
+            stale = [l for st in w._submitter.states.values()
+                     for l in st.leases
+                     if l.worker_addr[1] and not l.no_assign and l.valid
+                     and l.raylet_cli.address == tuple(b.address)]
+        assert not stale, "owner still considers B's leases assignable"
+
+        # B carried the only 'side' resource: resubmitted work must wait
+        # for a survivor that has it
+        c = cluster.add_node(num_cpus=1, resources={"side": 1})
+        outs = ray_tpu.get([where.remote() for _ in range(3)], timeout=120)
+        assert set(outs) == {c.node_id.hex()}
+    finally:
+        cluster.shutdown()

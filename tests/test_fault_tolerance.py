@@ -175,3 +175,126 @@ def test_unpicklable_task_exception_still_replies(ray_start_regular):
 
     with pytest.raises(Exception, match="kaboom-unpicklable"):
         ray_tpu.get(boom.options(max_retries=0).remote(), timeout=60)
+
+
+# ---------------------------------------------------------------------------
+# Lease-reuse fault paths (ISSUE 5 satellite): cached/pipelined leases must
+# preserve every fault-tolerance invariant of the per-task lease path.
+# ---------------------------------------------------------------------------
+
+
+def test_pipelined_worker_death_retries_each_task_once(ray_start_regular,
+                                                       tmp_path):
+    """Kill a worker holding a cached lease with k tasks pipelined: all k
+    are retried exactly once (the started task re-runs; the queued-behind
+    ones run for the first time) — no duplicates, proven via a
+    side-effect counter per task index."""
+    import os
+    import time as _t
+
+    import ray_tpu
+
+    flag = str(tmp_path / "release")
+    marks = str(tmp_path)
+
+    # the side-effect counter is the filesystem (shared with the workers):
+    # every execution of task i appends one line to exec-<i>.  No
+    # ray_tpu.get inside the task — a blocked-in-get task lends its CPU
+    # back and the raylet would grant MORE leases, defeating the pipeline.
+    @ray_tpu.remote(num_cpus=4)  # whole-node shape: ONE lease, pure pipeline
+    def step(i, marks, flag):
+        with open(os.path.join(marks, f"exec-{i}"), "a") as f:
+            f.write("x\n")
+        if i == 0:
+            while not os.path.exists(flag):
+                _t.sleep(0.05)
+        return i
+
+    def executions(i):
+        p = os.path.join(marks, f"exec-{i}")
+        if not os.path.exists(p):
+            return 0
+        with open(p) as f:
+            return len(f.readlines())
+
+    k = 5
+    refs = [step.remote(i, marks, flag) for i in range(k)]
+
+    # wait until task 0 is running and the rest are pipelined behind it
+    from ray_tpu._private.worker import get_global_worker
+    w = get_global_worker()
+    deadline = _t.monotonic() + 60
+    while _t.monotonic() < deadline:
+        if executions(0) == 1 and w._submitter.stats()["in_flight"] >= k:
+            break
+        _t.sleep(0.1)
+    assert executions(0) == 1
+    assert all(executions(i) == 0 for i in range(1, k))
+
+    # find and kill the worker holding the cached lease
+    victim_pid = None
+    with w._submitter.lock:
+        addrs = {l.worker_addr
+                 for st in w._submitter.states.values() for l in st.leases
+                 if l.inflight}
+    for row in w.raylet.call("ListWorkers", {}):
+        if tuple(row["address"]) in addrs:
+            victim_pid = row["pid"]
+    assert victim_pid is not None
+    os.kill(victim_pid, 9)
+    open(flag, "w").close()
+
+    assert sorted(ray_tpu.get(refs, timeout=120)) == list(range(k))
+    # task 0 started twice (killed mid-run, retried); tasks 1..k-1 were
+    # only queued in the dead worker, so they execute exactly once
+    assert executions(0) == 2
+    assert all(executions(i) == 1 for i in range(1, k)), [
+        executions(i) for i in range(k)]
+
+
+def test_cancel_task_queued_behind_on_reused_lease(ray_start_regular,
+                                                   tmp_path):
+    """A task queued IN THE WORKER behind another on a reused lease is
+    cancelled promptly — the cancelled reply arrives while the blocker is
+    still running, not after it finishes."""
+    import os
+    import time as _t
+
+    import ray_tpu
+
+    flag = str(tmp_path / "release")
+
+    @ray_tpu.remote(num_cpus=4)  # one lease: followers queue behind
+    def blocker(flag):
+        while not os.path.exists(flag):
+            _t.sleep(0.05)
+        return "done"
+
+    @ray_tpu.remote(num_cpus=4)
+    def follower():
+        return "ran"
+
+    ray_tpu.get(follower.remote(), timeout=60)  # warm the lease
+    b = blocker.remote(flag)
+    f1 = follower.remote()
+    f2 = follower.remote()
+    # wait until the followers are pushed (pipelined behind the blocker)
+    from ray_tpu._private.worker import get_global_worker
+    w = get_global_worker()
+    deadline = _t.monotonic() + 60
+    while _t.monotonic() < deadline:
+        if w._submitter.stats()["in_flight"] >= 3:
+            break
+        _t.sleep(0.05)
+
+    t0 = _t.monotonic()
+    assert ray_tpu.cancel(f1) is True
+    with pytest.raises(ray_tpu.TaskCancelledError):
+        ray_tpu.get(f1, timeout=30)
+    # the cancel resolved while the blocker still ran — prompt, not queued
+    assert _t.monotonic() - t0 < 10
+    assert not os.path.exists(flag)
+
+    open(flag, "w").close()
+    assert ray_tpu.get(b, timeout=60) == "done"
+    assert ray_tpu.get(f2, timeout=60) == "ran"

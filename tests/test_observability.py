@@ -34,7 +34,12 @@ def test_state_api_tasks_and_nodes(ray_start_regular):
     deadline = time.monotonic() + 5
     while time.monotonic() < deadline:
         tasks = [t for t in list_tasks() if t["name"] == "f"]
-        if len(tasks) == 5 and all(t["state"] == "FINISHED" for t in tasks):
+        # executor-side RUNNING events ride a paced flush (≤0.5 s behind):
+        # wait for them too, not just the owner-side FINISHED state
+        if (len(tasks) == 5
+                and all(t["state"] == "FINISHED" for t in tasks)
+                and all(t["start_time"] is not None and t["pid"]
+                        for t in tasks)):
             break
         time.sleep(0.05)
     tasks = [t for t in list_tasks() if t["name"] == "f"]

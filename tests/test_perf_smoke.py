@@ -1,5 +1,9 @@
 """Microbenchmark suite smoke (reference: _private/ray_perf.py runs per
-release; here we assert the harness runs and reports sane rates)."""
+release; here we assert the harness runs and reports sane rates) plus the
+hermetic lease fast-path budget guard (ISSUE 5): steady-state submission
+must reuse cached leases instead of paying a lease RPC per task."""
+
+import math
 
 
 def test_ray_perf_fast_mode():
@@ -7,5 +11,44 @@ def test_ray_perf_fast_mode():
 
     results = main(fast=True)
     by_name = {r["name"]: r["ops_per_s"] for r in results}
-    assert len(results) == 7
+    assert len(results) == 10
     assert all(v > 0 for v in by_name.values())
+
+
+def test_lease_reuse_rpc_budget():
+    """Counted via the owner-side lease metrics (hermetic — no wall-clock):
+    in steady state the reuse path issues ≤1 RequestWorkerLease RPC per
+    max_tasks_in_flight_per_worker tasks, and the reuse hit rate exceeds
+    90% — cached leases serve nearly every submission."""
+    import ray_tpu
+    from ray_tpu._private import runtime_metrics
+    from ray_tpu._private.config import global_config
+
+    ray_tpu.init(num_cpus=4)
+    try:
+        @ray_tpu.remote
+        def tiny():
+            return 1
+
+        # warm: spawn workers, populate the lease cache
+        ray_tpu.get([tiny.remote() for _ in range(8)])
+
+        before = runtime_metrics.lease_snapshot()
+        n_tasks = 200
+        for _ in range(10):
+            ray_tpu.get([tiny.remote() for _ in range(20)])
+        after = runtime_metrics.lease_snapshot()
+
+        requests = after["lease_requests"] - before["lease_requests"]
+        assignments = after["assignments"] - before["assignments"]
+        hits = after["reuse_hits"] - before["reuse_hits"]
+        assert assignments >= n_tasks
+        max_if = global_config().max_tasks_in_flight_per_worker
+        budget = math.ceil(n_tasks / max_if)
+        assert requests <= budget, (
+            f"{requests} lease RPCs for {n_tasks} tasks exceeds the "
+            f"≤1-per-{max_if}-tasks budget ({budget})")
+        hit_rate = hits / assignments
+        assert hit_rate > 0.90, f"lease reuse hit rate {hit_rate:.2%} ≤ 90%"
+    finally:
+        ray_tpu.shutdown()

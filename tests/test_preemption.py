@@ -307,7 +307,11 @@ def test_drain_rejected_leases_resubmitted_to_survivors():
     try:
         @ray_tpu.remote
         def occupant(path):
-            # holds B's only slot until the flag file appears
+            # holds B's only slot until the flag file appears; the marker
+            # proves it is RUNNING (resources are allocated while its
+            # worker still spawns, and a draining raylet flushes unstaffed
+            # grants — waiting on the GCS resource row alone races that)
+            open(path + ".started", "w").close()
             import time as _t
             while not os.path.exists(path):
                 _t.sleep(0.05)
@@ -321,13 +325,10 @@ def test_drain_rejected_leases_resubmitted_to_survivors():
 
         flag = os.path.join(tempfile.mkdtemp(), "release")
         occ_ref = occupant.options(resources={"slot": 1}).remote(flag)
-        # wait until the occupant actually holds B's slot, then queue more
-        _wait_for(
-            # zero-valued resources drop out of the snapshot dict: the
-            # occupant holds the slot once the key vanishes
-            lambda: (_node_row(w, b.node_id) or {})["resources"]
-            ["available"].get("slot", 0.0) == 0.0,
-            timeout=60, desc="occupant holds B's slot")
+        # wait until the occupant is actually RUNNING on B (its worker
+        # spawned and the task started), then queue more
+        _wait_for(lambda: os.path.exists(flag + ".started"),
+                  timeout=60, desc="occupant running on B")
         queued_refs = [
             queued.options(resources={"slot": 1}, max_retries=20).remote()
             for _ in range(2)
