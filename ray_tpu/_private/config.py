@@ -121,6 +121,21 @@ class RayTpuConfig:
     # store-backend collective groups: member-liveness poll period; a dead
     # or draining member aborts the group's pending ops within ~this bound
     collective_abort_poll_interval_s: float = 0.5
+    # --- flight recorder / hang diagnosis (_private/flight_recorder.py) ---
+    # always-on per-process ring buffer of step phases, collective
+    # entry/exit marks, checkpoint/restore and lease/task transitions;
+    # ~O(100ns) per record, fixed memory (capacity entries), readable
+    # post-mortem via the agent endpoints and dumped on worker crash
+    flight_recorder_enabled: bool = True
+    flight_recorder_capacity: int = 2048
+    # no training progress / a collective member missing for this long
+    # triggers the hang sweep (state.diagnose names the blocking member);
+    # a pending collective round younger than this is NOT flagged, so a
+    # healthy slow step never false-positives
+    hang_detect_timeout_s: float = 30.0
+    # per-member collective arrival-lag EWMA smoothing (straggler scores:
+    # ray_tpu_collective_straggler_lag_seconds)
+    straggler_ewma_alpha: float = 0.2
     # --- task events / observability ---
     task_events_enabled: bool = True
     task_events_max_buffer: int = 10000

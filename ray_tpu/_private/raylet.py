@@ -122,6 +122,15 @@ class Raylet:
         self.is_head = is_head
         self._worker_env = dict(env or {})
 
+        # bind the flight-recorder hot path NOW: a raylet-only process
+        # (`ray_tpu start` node) has no CoreWorker to do it, and a lazily
+        # created recorder would silently drop the drain/lease-reclaim
+        # marks recorded below until the first AgentFlightRecorder read —
+        # losing exactly the events a later diagnose sweep needs
+        from ray_tpu._private import flight_recorder
+
+        flight_recorder.get_recorder()
+
         from ray_tpu._private.accelerators import detect_node_resources_and_labels
 
         auto_res, auto_labels = detect_node_resources_and_labels()
@@ -1047,6 +1056,9 @@ class Raylet:
                     self._idle_workers[w.env_hash].append(w)
                 self._dispatch_cv.notify_all()
             runtime_metrics.inc_lease_revoked()
+            from ray_tpu._private import flight_recorder
+
+            flight_recorder.record("lease", "reclaim", lease.lease_id)
             logger.info("raylet %s: reclaimed idle expired lease %s",
                         self.node_id, lease.lease_id)
             try:
@@ -1190,6 +1202,9 @@ class Raylet:
         to surviving nodes; running work gets until the deadline."""
         if deadline_s is None:
             deadline_s = global_config().drain_deadline_s
+        from ray_tpu._private import flight_recorder
+
+        flight_recorder.record("drain", reason, f"deadline:{deadline_s:g}s")
         with self._lock:
             if self._draining:
                 return True  # idempotent: first notice wins
@@ -1676,6 +1691,82 @@ class Raylet:
                     "DumpStacks", {}, timeout=10))
             except Exception as e:  # noqa: BLE001
                 out.append({"pid": pid, "error": str(e)})
+        return out
+
+    def HandleAgentFlightRecorder(self, req):
+        """Flight-recorder tails of this node's workers (and this raylet):
+        the last N seconds of step phases, collective entry/exit marks and
+        task/lease transitions per process.  Live workers answer over RPC
+        (served off their RPC thread, so a wedged exec thread still
+        replies); a worker that died is read from its crash-dump file —
+        the post-mortem half of the recorder."""
+        from ray_tpu._private import flight_recorder
+
+        seconds = req.get("seconds")
+        limit = req.get("limit")
+        payload = {"seconds": seconds, "limit": limit}
+        out = [{"pid": os.getpid(), "role": "raylet",
+                "entries": flight_recorder.tail(seconds=seconds, limit=limit)}]
+        live_pids = set()
+        # total probe budget below the state client's 15s call timeout:
+        # several workers wedged in native code (GIL held, RPC thread
+        # mute) each burn their full per-worker timeout, and serially
+        # that would time out the WHOLE node out of the diagnose report
+        deadline = time.monotonic() + 10.0
+        for pid, addr in self._worker_addrs(req.get("pid")):
+            live_pids.add(pid)
+            try:
+                remaining = deadline - time.monotonic()
+                if remaining < 0.5:
+                    raise TimeoutError(
+                        "node probe budget exhausted (earlier workers "
+                        "unresponsive)")
+                row = self.pool.get(tuple(addr)).call(
+                    "FlightRecorderTail", payload,
+                    timeout=min(5.0, remaining))
+                row["role"] = "worker"
+                out.append(row)
+            except Exception as e:  # noqa: BLE001
+                row = {"pid": pid, "role": "worker", "error": str(e)}
+                # same freshness horizon as the dead-file scan below: a
+                # recycled pid must not surface a prior process's dump as
+                # this worker's crash_dump
+                dump = (flight_recorder.read_dump(
+                    pid, max_age_s=max(seconds or 0, 600.0))
+                    if pid else None)
+                if dump is not None:
+                    row["crash_dump"] = dump[-limit:] if limit else dump
+                out.append(row)
+        # workers already reaped from the pool left only their dump files:
+        # scan the dump dir for recent .flight files no live worker owns
+        # (bounded to the request window — the per-uid dir outlives
+        # clusters, so unbounded scans would resurrect last week's crash)
+        try:
+            base = os.path.dirname(flight_recorder.dump_path())
+            horizon = time.time() - max(seconds or 0, 600.0)
+            want_pid = req.get("pid")
+            for fn in sorted(os.listdir(base)):
+                if not fn.endswith(".flight"):
+                    continue
+                try:
+                    pid = int(fn[:-len(".flight")])
+                except ValueError:
+                    continue
+                if pid in live_pids or (want_pid and pid != want_pid):
+                    continue
+                path = os.path.join(base, fn)
+                try:
+                    if os.path.getmtime(path) < horizon:
+                        continue
+                except OSError:
+                    continue
+                dump = flight_recorder.read_dump(pid)
+                if dump:
+                    out.append({"pid": pid, "role": "dead-worker",
+                                "crash_dump":
+                                    dump[-limit:] if limit else dump})
+        except OSError:  # dump dir unreadable/absent: live rows only
+            pass
         return out
 
     def HandleAgentNativeStacks(self, req):

@@ -207,6 +207,36 @@ COLLECTIVE_ABORTS = Counter(
     "Collective groups aborted promptly on member death/drain (pending ops "
     "raise CollectiveAbortError instead of hanging to timeout)",
     tag_keys=("backend", "group"))
+# hang / straggler diagnosis (flight recorder + arrival monitor): rank is a
+# bounded tag (collective world sizes are small, user-chosen groups)
+COLLECTIVE_STRAGGLER_LAG = Gauge(
+    "ray_tpu_collective_straggler_lag_seconds",
+    "Per-member collective arrival-lag EWMA (seconds behind the round's "
+    "first arrival; persistently high = this rank is the straggler)",
+    tag_keys=("group", "rank"))
+HANG_SWEEPS = Counter(
+    "ray_tpu_hang_sweeps_total",
+    "Cluster-wide hang-diagnosis sweeps triggered (watchdog or explicit "
+    "state.diagnose), by trigger source",
+    tag_keys=("source",))
+
+# -- train goodput ledger ---------------------------------------------------
+# job wall-clock classified into buckets that sum exactly to the wall (the
+# cost-accounting view of arxiv 2605.25645); run names are user-chosen and
+# bounded, like serve deployment names.  A gauge mirroring the ledger's
+# authoritative bucket values — NOT a counter: reclassification (input_wait
+# carved out of productive_step) moves already-accrued seconds between
+# buckets, which monotonic counters cannot represent without breaking the
+# buckets-sum-to-wall-clock invariant on the metric surface
+TRAIN_GOODPUT_SECONDS = Gauge(
+    "ray_tpu_train_goodput_seconds",
+    "Train-controller wall-clock by bucket: productive_step, checkpoint, "
+    "restore, preemption_recovery, input_wait, stall (sums to wall-clock)",
+    tag_keys=("run", "bucket"))
+TRAIN_GOODPUT_RATIO = Gauge(
+    "ray_tpu_train_goodput_ratio",
+    "productive_step share of the run's wall-clock so far",
+    tag_keys=("run",))
 
 # -- tpu --------------------------------------------------------------------
 TPU_CHIPS = Gauge(
@@ -252,6 +282,8 @@ FAMILIES = (
     COLLECTIVE_LOGICAL_BYTES, COLLECTIVE_WIRE_BYTES,
     COLLECTIVE_INTER_SLICE_BYTES, COLLECTIVE_QUANT_ERROR,
     COLLECTIVE_ALGORITHM, COLLECTIVE_ABORTS,
+    COLLECTIVE_STRAGGLER_LAG, HANG_SWEEPS,
+    TRAIN_GOODPUT_SECONDS, TRAIN_GOODPUT_RATIO,
     TPU_CHIPS, TPU_PROCESS_CHIPS,
     SERVE_REQUEST_LATENCY, SERVE_REQUESTS,
     DATA_ROWS, DATA_BACKPRESSURE,
@@ -364,6 +396,43 @@ def observe_drain_latency(seconds: float) -> None:
 
 def inc_collective_abort(backend: str, group: str) -> None:
     _bound(COLLECTIVE_ABORTS, backend=backend, group=group).inc()
+
+
+def set_straggler_lag(group: str, rank: int, lag_s: float) -> None:
+    _bound(COLLECTIVE_STRAGGLER_LAG, group=group, rank=str(rank)).set(lag_s)
+
+
+def inc_hang_sweep(source: str) -> None:
+    _bound(HANG_SWEEPS, source=source).inc()
+
+
+def set_goodput_seconds(run: str, bucket: str, total_seconds: float) -> None:
+    """Mirror one bucket's authoritative ledger value (set, not inc — the
+    ledger owns the accounting; the metric is a view of it)."""
+    _bound(TRAIN_GOODPUT_SECONDS, run=run, bucket=bucket).set(total_seconds)
+
+
+def set_goodput_ratio(run: str, ratio: float) -> None:
+    _bound(TRAIN_GOODPUT_RATIO, run=run).set(ratio)
+
+
+def goodput_metrics_snapshot() -> dict:
+    """This process's goodput gauge points for bench.py's JSON line:
+    per run, seconds by bucket + the derived goodput ratio (the gauges
+    mirror each ledger's buckets, so these sum to wall-clock exactly)."""
+    out: dict = {}
+    for p in TRAIN_GOODPUT_SECONDS._snapshot():
+        t = p["tags"]
+        run = out.setdefault(t.get("run", "?"), {"buckets_s": {}})
+        b = t.get("bucket", "?")
+        run["buckets_s"][b] = run["buckets_s"].get(b, 0.0) + p["value"]
+    for run, d in out.items():
+        total = sum(d["buckets_s"].values())
+        if total > 0:
+            d["wall_clock_s"] = round(total, 6)
+            d["goodput_ratio"] = round(
+                d["buckets_s"].get("productive_step", 0.0) / total, 4)
+    return out
 
 
 def set_gcs_sink_sizes(task_events: int, reporters: int, events: int) -> None:

@@ -29,7 +29,7 @@ from collections import defaultdict, deque
 from ray_tpu._private.utils import DaemonExecutor, fast_getpid
 from typing import Any, Dict, List, Optional, Set, Tuple
 
-from ray_tpu._private import runtime_metrics, serialization
+from ray_tpu._private import flight_recorder, runtime_metrics, serialization
 from ray_tpu.util import tracing
 from ray_tpu._private.accelerators import bind_visible_accelerators
 from ray_tpu._private.config import global_config
@@ -488,6 +488,9 @@ class CoreWorker:
         self._task_events_lock = threading.Lock()
         self._last_event_flush = 0.0
         self._event_flush_timer_armed = False
+        # bind the flight-recorder hot path now (rebinds module-level
+        # ``record`` from the disabled stub to the live ring)
+        flight_recorder.get_recorder()
 
         # Actor-related state (server side: this worker hosts an actor)
         self.actor_id: Optional[ActorID] = None  # set when this worker hosts an actor
@@ -1036,6 +1039,16 @@ class CoreWorker:
                 "stack": "".join(tb.format_stack(frame)),
             })
         return {"pid": os.getpid(), "threads": out}
+
+    def HandleFlightRecorderTail(self, req):
+        """The last N seconds of this process's flight recorder (step
+        phases, collective entry/exit marks, task transitions) — the
+        live-read half of the post-mortem pair (crash dumps cover dead
+        workers).  Served from the RPC thread, so a worker whose EXEC
+        thread is wedged still answers."""
+        return {"pid": os.getpid(),
+                "entries": flight_recorder.tail(
+                    seconds=req.get("seconds"), limit=req.get("limit"))}
 
     def HandleCpuProfile(self, req, reply_token):
         """Sampling CPU profile: sample every thread's top frames for
@@ -1651,6 +1664,8 @@ class CoreWorker:
         recv_ts = req.get("_recv_ts")
         queued_s = (time.monotonic() - recv_ts) if recv_ts else 0.0
         replied = False
+        flight_recorder.record("task", spec.name,
+                               f"start:{spec.task_id.hex()[:8]}a{spec.attempt}")
         try:
             self._record_exec_event(spec)
             bind_visible_accelerators(lease.get("resource_instances"))
@@ -1729,6 +1744,9 @@ class CoreWorker:
                  "traceback": traceback.format_exc()},
             )
         finally:
+            flight_recorder.record(
+                "task", spec.name,
+                f"end:{spec.task_id.hex()[:8]}a{spec.attempt}")
             with self._received_pushes_lock:
                 self._received_pushes.discard(
                     (spec.task_id.hex(), spec.attempt))
@@ -2057,6 +2075,8 @@ class CoreWorker:
 
     def _execute_actor_task(self, req, reply_token):
         spec: TaskSpec = req["spec"]
+        flight_recorder.record("actor_task", spec.name or spec.actor_method,
+                               f"start:a{spec.attempt}")
         try:
             self._record_exec_event(spec)
             with tracing.activate_from_spec(spec):
@@ -2106,6 +2126,8 @@ class CoreWorker:
                 self.flush_task_events()  # os._exit skips the finally below
                 os._exit(0)
         finally:
+            flight_recorder.record("actor_task",
+                                   spec.name or spec.actor_method, "end")
             self.maybe_flush_task_events()
             runtime_metrics.maybe_push()
 
@@ -2723,6 +2745,7 @@ class NormalTaskSubmitter:
             if not lease.valid:
                 return
             lease.valid = False
+            flight_recorder.record("lease", "invalidate", lease.lease_id)
             st = self.states.get(lease.key)
             if st is not None:
                 if lease in st.leases:
@@ -2806,6 +2829,8 @@ class NormalTaskSubmitter:
                     st.saturated = len(leases) < count
                     st.saturated_at = time.monotonic()
                 for ld in leases:
+                    flight_recorder.record("lease", "grant",
+                                           ld.get("lease_id"))
                     st.leases.append(_CachedLease(
                         key, ld,
                         raylet_cli=w.pool.get(tuple(ld["raylet_addr"])),

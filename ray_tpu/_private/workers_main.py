@@ -49,6 +49,17 @@ def main():
     except Exception:  # noqa: BLE001
         pass
 
+    # flight-recorder post-mortem dump (crash / exit / SIGUSR2 when the C
+    # handler above didn't claim the signal): the <pid>.flight file lands
+    # alongside the native stack dump, so a dead worker's last seconds of
+    # step phases / collective marks / task transitions stay readable
+    try:
+        from ray_tpu._private.flight_recorder import install_dump as _frinstall
+
+        _frinstall()
+    except Exception:  # noqa: BLE001
+        pass
+
     # Apply this worker's runtime env BEFORE serving any task (dedicated
     # workers per env; reference: runtime-env agent materializes pre-lease).
     env_hash = os.environ.get("RAY_TPU_RUNTIME_ENV_HASH", "")

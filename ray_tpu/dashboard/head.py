@@ -19,6 +19,9 @@ Endpoints:
   GET /api/jobs             submitted jobs (job manager) + driver jobs (GCS)
   GET /api/timeline         Chrome trace events
   GET /api/trace/<trace_id> one distributed trace: spans + critical path
+  GET /api/flight_recorder  per-process flight-recorder tails [?pid=&seconds=]
+  GET /api/diagnose         cluster hang sweep (blocking members, stragglers)
+  GET /api/goodput          train wall-clock by bucket per run [?run=]
   GET /metrics              Prometheus exposition of cluster metrics
 """
 
@@ -186,6 +189,27 @@ class DashboardHead:
             # /api/native_stacks?pid=N — C/XLA frames of a wedged worker
             pid = int((query or {}).get("pid", ["0"])[0])
             return state.dump_native_stacks(pid)
+        if path == "/api/flight_recorder":
+            # ?pid=N&seconds=S — per-process flight-recorder tails (live
+            # workers over RPC, dead ones from their crash-dump files)
+            q = query or {}
+            pid = q.get("pid", [None])[0]
+            seconds = q.get("seconds", [None])[0]
+            return state.flight_recorder(
+                pid=int(pid) if pid else None,
+                seconds=float(seconds) if seconds else None)
+        if path == "/api/diagnose":
+            # one cluster-wide hang sweep: blocking collective members,
+            # straggler scores, recorder tails, cross-linked trace ids
+            q = query or {}
+            timeout = q.get("hang_timeout_s", [None])[0]
+            return state.diagnose(
+                hang_timeout_s=float(timeout) if timeout else None,
+                source="dashboard")
+        if path == "/api/goodput":
+            # published goodput ledgers: wall-clock by bucket per train run
+            run = (query or {}).get("run", [None])[0]
+            return state.goodput(run)
         if path == "/api/events":
             return state.list_cluster_events()
         if path == "/api/serve":

@@ -154,6 +154,10 @@ class BackendExecutor:
 
         self._ckpt_counter += 1
         dest = join_path(self._run_dir, f"checkpoint_{self._ckpt_counter:06d}")
+        from ray_tpu._private import flight_recorder
+
+        flight_recorder.record("checkpoint", "persist",
+                               os.path.basename(dest))
         persist_staged_checkpoint(ckpt.path, dest)
         persisted = Checkpoint(dest)
         score_attr = self._ckpt_config.checkpoint_score_attribute

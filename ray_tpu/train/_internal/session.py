@@ -66,6 +66,14 @@ class _TrainSession:
         self.error: Optional[BaseException] = None
 
     def report(self, metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None):
+        # flight recorder: a report IS a step boundary — the last thing a
+        # hung worker's tail shows is which step it finished (and whether a
+        # checkpoint stage ran) before it stopped arriving
+        from ray_tpu._private import flight_recorder
+
+        flight_recorder.record(
+            "step", "report",
+            f"rank{self.world_rank}" + (":ckpt" if checkpoint else ""))
         # Persist worker-side BEFORE returning (the reference uploads from the
         # worker in report(), train/_internal/storage.py) — the caller may
         # delete its local checkpoint dir right after report() returns.

@@ -107,9 +107,23 @@ class DataParallelTrainer:
 
         from ray_tpu.train._internal.checkpoint_util import join_path, makedirs_any
 
+        from ray_tpu._private.config import global_config
+        from ray_tpu.train._internal.goodput import GoodputLedger, register
+        from ray_tpu.train._internal.watchdog import StepWatchdog
+
         name = self._run_config.name or f"train_{uuid.uuid4().hex[:8]}"
         run_dir = join_path(self._run_config.resolved_storage_path(), name)
         makedirs_any(run_dir)
+        # goodput ledger: every second of fit() lands in exactly one bucket
+        # (buckets sum to the wall-clock); published to the GCS KV for
+        # state.goodput()/the dashboard.  Gang bring-up counts as restore.
+        ledger = register(GoodputLedger(name, job_id=self._job_id_hex()))
+        ledger.start("restore")
+        self.goodput_ledger = ledger
+        # step watchdog: no reported result for hang_detect_timeout_s
+        # triggers ONE cluster-wide diagnosis sweep per stall episode
+        watchdog = StepWatchdog(global_config().hang_detect_timeout_s)
+        self.last_diagnosis = None
         failure_config = self._run_config.failure_config or FailureConfig()
         failure_policy = self._failure_policy or DefaultFailurePolicy(
             max_failures=failure_config.max_failures)
@@ -151,23 +165,53 @@ class DataParallelTrainer:
                 executor.start(dataset_shards=shards)
                 self._push_resume_checkpoint(executor, latest_ckpt)
                 executor.start_training(self._train_fn, self._train_config)
+                ledger.mark("productive_step")
+                watchdog.notify_progress()
                 final_metrics: Dict[str, Any] = {}
                 growth_check_at = time.monotonic()
                 drain_check_at = time.monotonic()
                 while True:
                     results, finished, error = executor.poll()
+                    if results:
+                        watchdog.notify_progress()
+                        if ledger.current == "stall":
+                            # progress resumed: close the stall span
+                            ledger.mark("productive_step")
                     # persist same-round checkpoints before acting on an error
+                    round_input_wait = 0.0
                     for r in results:
+                        if r.get("checkpoint") is not None:
+                            ledger.mark("checkpoint")
                         ckpt = executor.persist_checkpoint(r)
                         if ckpt is not None:
                             latest_ckpt = ckpt
+                        ledger.mark("productive_step")
+                        # workers report data starvation as input_wait_s;
+                        # ranks wait CONCURRENTLY, but the ledger is one
+                        # wall-clock timeline — the round's input-bound
+                        # time is the slowest worker's wait, so take the
+                        # max over ranks (summing would drain productive
+                        # by up to world_size x)
+                        iw = (r.get("metrics") or {}).get("input_wait_s")
+                        if iw:
+                            round_input_wait = max(round_input_wait,
+                                                   float(iw))
                         if r["rank"] == 0:
                             final_metrics = r["metrics"]
                             history.append(r["metrics"])
+                    if round_input_wait > 0:
+                        # carve once per round (the sum stays exact —
+                        # reclassify moves accrued seconds)
+                        ledger.reclassify("productive_step", "input_wait",
+                                          round_input_wait)
                     if error:
                         raise TrainingFailedError(error)
                     if finished:
                         break
+                    if watchdog.check():
+                        ledger.mark("stall")
+                        self._run_hang_sweep(watchdog)
+                    ledger.publish()
                     # preemption watch: a drain notice on a gang node is
                     # handled like an elastic resize — this round's
                     # checkpoints are already persisted above, so restart
@@ -193,6 +237,8 @@ class DataParallelTrainer:
                             raise _ElasticRegrow(scaling.total_workers,
                                                  grown.num_workers)
                 executor.shutdown()
+                ledger.stop()
+                ledger.publish(force=True)
                 return Result(
                     metrics=final_metrics, checkpoint=latest_ckpt, path=run_dir,
                     metrics_history=history,
@@ -201,6 +247,7 @@ class DataParallelTrainer:
                 # the platform announced the node is going away: restart the
                 # gang on survivors from the latest checkpoint — the drain
                 # was announced in advance, so no max_failures credit burns
+                ledger.mark("preemption_recovery")
                 executor.shutdown()
                 logger.warning(
                     "preemption drain on gang node(s) %s: restarting gang "
@@ -209,12 +256,14 @@ class DataParallelTrainer:
             except _ElasticRegrow as g:
                 # not a failure: stop after the checkpoint already persisted,
                 # restart at the larger size the policy just observed
+                ledger.mark("restore")
                 executor.shutdown()
                 pending_growth = g.target
                 logger.info(
                     "elastic regrow: restarting gang %d -> %d workers from %s",
                     g.current, g.target, latest_ckpt)
             except TrainingFailedError as e:
+                ledger.mark("restore")
                 executor.shutdown()
                 if attempt_is_regrow and "did not become ready" in str(e):
                     # the observed capacity evaporated before the bigger gang
@@ -227,6 +276,8 @@ class DataParallelTrainer:
                     continue
                 failures += 1
                 if failure_policy.make_decision(failures, e) == FailureDecision.RAISE:
+                    ledger.stop()
+                    ledger.publish(force=True)
                     return Result(
                         metrics={}, checkpoint=latest_ckpt, path=run_dir, error=e,
                         metrics_history=history,
@@ -236,6 +287,51 @@ class DataParallelTrainer:
                     failures, e, latest_ckpt,
                 )
                 time.sleep(min(2.0 * failures, 10.0))
+
+    @staticmethod
+    def _job_id_hex():
+        try:
+            from ray_tpu._private.worker import get_global_worker
+
+            jid = get_global_worker().job_id
+            return jid.hex() if jid is not None else None
+        except Exception:  # noqa: BLE001 — clusterless unit contexts
+            return None
+
+    def _run_hang_sweep(self, watchdog):
+        """One cluster-wide diagnosis sweep (fires once per stall episode):
+        fold the arrival monitor's pending rounds, every process's flight-
+        recorder tail, and the blocking workers' stacks into one report
+        that names who is blocking what."""
+        from ray_tpu._private import flight_recorder
+
+        stalled = watchdog.stalled_for_s()
+        flight_recorder.record("step", "watchdog",
+                               f"stall:{stalled:.1f}s")
+        logger.warning(
+            "no training progress for %.1fs (hang_detect_timeout_s=%.1fs): "
+            "running cluster hang sweep", stalled, watchdog.timeout_s)
+        try:
+            from ray_tpu.util import state
+
+            report = state.diagnose(source="watchdog")
+            self.last_diagnosis = report
+            for b in report.get("blocking") or []:
+                logger.error(
+                    "hang diagnosis: collective group %r op %r seq %s is "
+                    "blocked on rank %s (actor %s, node %s, pid %s) — "
+                    "waiting %.1fs", b.get("group"), b.get("op"),
+                    b.get("seq"), b.get("rank"), b.get("actor_id"),
+                    b.get("node_id"), b.get("pid"), b.get("waiting_s"))
+            try:
+                state.record_event(
+                    f"train hang sweep: {len(report.get('blocking') or [])} "
+                    f"blocking member(s) after {stalled:.1f}s without "
+                    "progress", severity="WARNING", source="train")
+            except Exception:  # noqa: BLE001
+                pass
+        except Exception:  # noqa: BLE001 — diagnosis must never kill training
+            logger.exception("hang sweep failed")
 
     @staticmethod
     def _gang_draining_nodes(executor: BackendExecutor):
@@ -259,7 +355,11 @@ class DataParallelTrainer:
                                 ckpt: Optional[Checkpoint]):
         if ckpt is None or executor.worker_group is None:
             return
+        from ray_tpu._private import flight_recorder
         from ray_tpu.train._internal.checkpoint_util import set_session_resume_checkpoint
+
+        flight_recorder.record("restore", "resume_checkpoint",
+                               os.path.basename(ckpt.path))
 
         executor.worker_group.execute(set_session_resume_checkpoint, ckpt.path)
 

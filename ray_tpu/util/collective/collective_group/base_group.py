@@ -22,6 +22,25 @@ class BaseGroup(ABC):
         # OpStats of the most recent compression-enabled op (None when the
         # stock path ran) — read by the API layer for metrics/spans.
         self.last_op_stats = None
+        # host-side op counter for flight-recorder entry/exit marks: the
+        # hang sweep compares members' last-entered (op, seq) to name the
+        # member that never arrived
+        self._fr_seq = 0
+
+    def _mark(self, op: str, phase: str, seq: int = None):
+        """Flight-recorder collective mark: (group, op, seq, member rank).
+        ``enter`` is recorded BEFORE the op blocks, so a member wedged
+        inside the collective still shows where it is."""
+        from ray_tpu._private import flight_recorder
+
+        if seq is None:
+            if phase == "enter":
+                self._fr_seq += 1
+            seq = self._fr_seq
+        flight_recorder.record(
+            "collective", f"{self._group_name}:{op}",
+            f"{phase}:seq{seq}:rank{self._rank}/{self._world_size}")
+        return seq
 
     def _topology_num_slices(self) -> int:
         """How many latency domains (TPU slices / hosts) the group spans —

@@ -140,10 +140,12 @@ class StoreGroup(BaseGroup):
 
         def run():
             key = self._next_key(kind)
+            self._mark(kind, "enter", seq=key[2])
             check_abort(ray_tpu.get(self._store.barrier_arrive.remote(
                 key, self._rank, self._world_size)))
             store_wait(self._store, "barrier_done",
                        (key, self._rank, self._world_size))
+            self._mark(kind, "exit", seq=key[2])
 
         self._guard(run)
 
@@ -153,25 +155,35 @@ class StoreGroup(BaseGroup):
 
         def run():
             key = self._next_key(kind)
+            self._mark(kind, "enter", seq=key[2])
             check_abort(ray_tpu.get(
                 self._store.contribute.remote(key, self._rank, value)))
-            return store_wait(self._store, "collect",
-                              (key, self._world_size, self._rank))
+            out = store_wait(self._store, "collect",
+                             (key, self._world_size, self._rank))
+            self._mark(kind, "exit", seq=key[2])
+            return out
 
         return self._guard(run)
 
-    def _exchange_sub(self, kind: str, subrank: int, count: int, value) -> dict:
+    def _exchange_sub(self, kind: str, subrank: int, count: int, value,
+                      member_ranks=None) -> dict:
         """Gather round inside a subgroup (hierarchical phases): the kind
         string embeds the subgroup id, so concurrent subgroups never share a
         key; every rank runs every phase exactly once, keeping the per-group
-        sequence counter aligned across all ranks."""
+        sequence counter aligned across all ranks.  ``member_ranks`` names
+        the subgroup's GROUP-GLOBAL ranks so the store's arrival monitor
+        (hang diagnosis, straggler EWMAs) never sees subranks."""
         import ray_tpu
 
         def run():
             key = self._next_key(kind)
+            self._mark(kind, "enter", seq=key[2])
             check_abort(ray_tpu.get(
-                self._store.contribute.remote(key, subrank, value)))
-            return store_wait(self._store, "collect", (key, count, subrank))
+                self._store.contribute.remote(key, subrank, value,
+                                              self._rank, member_ranks)))
+            out = store_wait(self._store, "collect", (key, count, subrank))
+            self._mark(kind, "exit", seq=key[2])
+            return out
 
         return self._guard(run)
 
@@ -233,13 +245,18 @@ class StoreGroup(BaseGroup):
         ss = plan.slice_size
         nslices = self._world_size // ss
         sid, idx = self._rank // ss, self._rank % ss
+        # global-rank membership of each subgroup this rank exchanges in —
+        # the arrival monitor is keyed by global rank, never subrank
+        slice_ranks = [sid * ss + j for j in range(ss)]
+        cross_ranks = [s * ss + idx for s in range(nslices)]
         flat = comp.pad_to_multiple(arr.ravel(), ss)
         shard_n = flat.size // ss
         lo, hi = idx * shard_n, (idx + 1) * shard_n
 
         # phase 1 — intra-slice reduce-scatter: exchange full payloads
         # inside the slice, each member reduces its own shard
-        by_idx = self._exchange_sub(f"hier_rs_s{sid}", idx, ss, flat)
+        by_idx = self._exchange_sub(f"hier_rs_s{sid}", idx, ss, flat,
+                                    member_ranks=slice_ranks)
         my_shard = _REDUCERS[op]([by_idx[j][lo:hi] for j in range(ss)])
         wire_intra = int(flat.nbytes)
 
@@ -250,7 +267,8 @@ class StoreGroup(BaseGroup):
             codes, scales, _deq, qerr = comp.ef_quantize(
                 self._group_name, "allreduce_hier", my_shard, spec)
             by_slice = self._exchange_sub(
-                f"hier_x_i{idx}", sid, nslices, (codes, scales))
+                f"hier_x_i{idx}", sid, nslices, (codes, scales),
+                member_ranks=cross_ranks)
             acc = np.zeros(shard_n, np.float32)
             for s in range(nslices):
                 c_s, s_s = by_slice[s]
@@ -261,13 +279,15 @@ class StoreGroup(BaseGroup):
         else:
             qerr = 0.0
             by_slice = self._exchange_sub(
-                f"hier_x_i{idx}", sid, nslices, my_shard)
+                f"hier_x_i{idx}", sid, nslices, my_shard,
+                member_ranks=cross_ranks)
             global_shard = _REDUCERS[op](
                 [by_slice[s] for s in range(nslices)])
             wire_inter = int(my_shard.nbytes)
 
         # phase 3 — intra-slice allgather of the globally-reduced shards
-        by_idx3 = self._exchange_sub(f"hier_ag_s{sid}", idx, ss, global_shard)
+        by_idx3 = self._exchange_sub(f"hier_ag_s{sid}", idx, ss, global_shard,
+                                     member_ranks=slice_ranks)
         out = np.concatenate([by_idx3[j] for j in range(ss)])[:arr.size]
         wire_intra += int(global_shard.nbytes)
 

@@ -273,21 +273,29 @@ class XLAGroup(BaseGroup):
 
     def allreduce(self, tensor, op: ReduceOp = ReduceOp.SUM, compression=None):
         self.last_op_stats = None
-        spec = comp.resolve_spec(compression)
-        if spec is not None and op == ReduceOp.SUM and \
-                comp.is_float_dtype(getattr(tensor, "dtype", None)):
-            # plan from metadata only — np.asarray would device_get the
-            # tensor, and the plan usually says "stock" (small payloads,
-            # compression='none'), where that copy is pure waste
-            nbytes = int(getattr(tensor, "nbytes", 0) or 0)
-            plan = comp.choose_plan(nbytes, self._world_size, spec,
-                                    num_slices=self._topology_num_slices())
-            if not plan.is_stock:
-                arr = np.asarray(tensor)
-                if plan.algorithm == comp.ALG_HIERARCHICAL:
-                    return self._hierarchical_allreduce(arr, plan)
-                return self._quantized_allreduce(arr, plan)
-        return self._reduce_impl(tensor, op)
+        # host-side entry stamp BEFORE the program dispatch: a member
+        # wedged inside the XLA collective (waiting on a peer) still shows
+        # its last-entered (op, seq) in the flight recorder, which is what
+        # the hang sweep compares across members
+        seq = self._mark("allreduce", "enter")
+        try:
+            spec = comp.resolve_spec(compression)
+            if spec is not None and op == ReduceOp.SUM and \
+                    comp.is_float_dtype(getattr(tensor, "dtype", None)):
+                # plan from metadata only — np.asarray would device_get the
+                # tensor, and the plan usually says "stock" (small payloads,
+                # compression='none'), where that copy is pure waste
+                nbytes = int(getattr(tensor, "nbytes", 0) or 0)
+                plan = comp.choose_plan(nbytes, self._world_size, spec,
+                                        num_slices=self._topology_num_slices())
+                if not plan.is_stock:
+                    arr = np.asarray(tensor)
+                    if plan.algorithm == comp.ALG_HIERARCHICAL:
+                        return self._hierarchical_allreduce(arr, plan)
+                    return self._quantized_allreduce(arr, plan)
+            return self._reduce_impl(tensor, op)
+        finally:
+            self._mark("allreduce", "exit", seq=seq)
 
     def _quantized_allreduce(self, arr, plan: comp.Plan):
         """EQuARX two-phase path: host codec quantizes the local payload
@@ -390,9 +398,13 @@ class XLAGroup(BaseGroup):
         if self._world_size == 1:
             return tensor
         arr = np.asarray(tensor)
-        return np.asarray(
-            multihost_utils.broadcast_one_to_all(arr, is_source=self._rank == src_rank)
-        )
+        seq = self._mark("broadcast", "enter")
+        try:
+            return np.asarray(
+                multihost_utils.broadcast_one_to_all(
+                    arr, is_source=self._rank == src_rank))
+        finally:
+            self._mark("broadcast", "exit", seq=seq)
 
     def allgather(self, tensor) -> List[Any]:
         import jax
@@ -427,7 +439,12 @@ class XLAGroup(BaseGroup):
 
         if self._world_size == 1:
             return
-        multihost_utils.sync_global_devices(f"ray_tpu_collective_{self._group_name}")
+        seq = self._mark("barrier", "enter")
+        try:
+            multihost_utils.sync_global_devices(
+                f"ray_tpu_collective_{self._group_name}")
+        finally:
+            self._mark("barrier", "exit", seq=seq)
 
     # -- p2p ----------------------------------------------------------------
     # Device path: when the group spans a real multi-process jax runtime,

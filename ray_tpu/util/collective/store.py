@@ -52,6 +52,127 @@ class _CollectiveStoreActor:
         # group_name -> rank -> {"actor_id": hex|None, "node_id": hex|None}
         self._members: Dict[str, Dict[int, dict]] = {}
         self._monitor_started = False
+        # -- per-member arrival monitor (hang & straggler diagnosis) -------
+        # every collective round's key records who arrived when; a round
+        # stuck with missing ranks is the hang signature, and completed
+        # rounds feed a per-(group, rank) arrival-lag EWMA (persistent
+        # stragglers score high).  Injectable clock for hermetic tests.
+        self._clock = time.monotonic
+        # key -> {"first": t, "by_rank": {rank: t}, "expected": int|None}
+        self._arrivals: Dict[Tuple, dict] = {}
+        self._lag_ewma: Dict[Tuple[str, int], float] = {}
+
+    # -- arrival monitor ----------------------------------------------------
+    def _stamp_arrival(self, key: Tuple, rank: int,
+                       expected: Optional[int] = None,
+                       expected_ranks=None):
+        """``rank`` is always the member's GROUP-GLOBAL rank (subgroup
+        rounds translate their subranks before stamping — lag EWMAs and
+        blocking-member resolution are keyed by global rank).
+        ``expected_ranks`` names the global ranks a subgroup round waits
+        for; plain rounds use ``expected`` (a count over range(world))."""
+        if not (isinstance(key, tuple) and len(key) >= 2):
+            return
+        now = self._clock()
+        ent = self._arrivals.get(key)
+        if ent is None:
+            ent = self._arrivals[key] = {
+                "first": now, "by_rank": {}, "expected": expected,
+                "ranks": None}
+        ent["by_rank"].setdefault(rank, now)
+        if expected_ranks is not None:
+            ent["ranks"] = list(expected_ranks)
+            ent["expected"] = len(ent["ranks"])
+        elif expected is not None:
+            ent["expected"] = expected
+        exp = ent["expected"]
+        if exp is not None and len(ent["by_rank"]) >= exp:
+            self._complete_round(key, ent)
+
+    def _note_expected(self, key: Tuple, expected: int):
+        """collect() polls carry the round's world size — a round whose
+        contribute side never learned it (gather rounds) gets it from the
+        first waiting reader, so missing ranks become computable."""
+        ent = self._arrivals.get(key)
+        if ent is not None and ent.get("expected") is None:
+            ent["expected"] = expected
+            if len(ent["by_rank"]) >= expected:
+                self._complete_round(key, ent)
+
+    def _complete_round(self, key: Tuple, ent: dict):
+        """All members arrived: fold per-member lag (vs the round's first
+        arrival) into the persistent straggler EWMA and drop the entry."""
+        self._arrivals.pop(key, None)
+        group = key[0]
+        first = ent["first"]
+        try:
+            from ray_tpu._private.config import global_config
+
+            alpha = global_config().straggler_ewma_alpha
+        except Exception:  # noqa: BLE001
+            alpha = 0.2
+        for rank, t in ent["by_rank"].items():
+            lag = max(t - first, 0.0)
+            k = (group, rank)
+            prev = self._lag_ewma.get(k)
+            ewma = lag if prev is None else (alpha * lag + (1 - alpha) * prev)
+            self._lag_ewma[k] = ewma
+            try:
+                from ray_tpu._private import runtime_metrics
+
+                runtime_metrics.set_straggler_lag(group, rank, ewma)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def straggler_report(self, group_name: Optional[str] = None) -> dict:
+        """Live arrival view for ``state.diagnose()``: per group, the
+        pending rounds (kind+seq, who arrived, who is missing, how long the
+        round has waited) and the persistent per-rank arrival-lag EWMA.
+        Missing ranks are resolved against the round's expected count when
+        known, else the group's declared world size."""
+        now = self._clock()
+        groups: Dict[str, dict] = {}
+
+        def _group_entry(g: str) -> dict:
+            return groups.setdefault(g, {
+                "pending": [],
+                "lag_ewma_s": {},
+                "members": dict(self._members.get(g, {})),
+                "world_size": (self._groups.get(g) or {}).get("world_size"),
+                "aborted": self._aborts.get(g),
+            })
+
+        for key, ent in list(self._arrivals.items()):
+            g = key[0]
+            if group_name is not None and g != group_name:
+                continue
+            d = _group_entry(g)
+            expected = ent.get("expected") or d["world_size"]
+            arrived = sorted(ent["by_rank"])
+            ranks = ent.get("ranks")
+            if ranks:  # subgroup round: members are named, not range()
+                missing = sorted(set(ranks) - set(arrived))
+            else:
+                missing = (sorted(set(range(expected)) - set(arrived))
+                           if expected else [])
+            d["pending"].append({
+                "op": key[1] if len(key) > 1 else "?",
+                "seq": key[2] if len(key) > 2 else None,
+                "waiting_s": round(now - ent["first"], 3),
+                "arrived": arrived,
+                "missing": missing,
+                "expected": expected,
+            })
+        for (g, rank), ewma in self._lag_ewma.items():
+            if group_name is not None and g != group_name:
+                continue
+            _group_entry(g)["lag_ewma_s"][rank] = round(ewma, 4)
+        # groups with members but no activity still appear (identity map
+        # is what diagnose uses to name a missing member's actor/node)
+        for g in list(self._members):
+            if group_name is None or g == group_name:
+                _group_entry(g)
+        return {"groups": groups}
 
     # -- group declaration / join ------------------------------------------
     def declare_group(self, group_name: str, world_size: int, backend: str):
@@ -62,6 +183,14 @@ class _CollectiveStoreActor:
             self._aborts.pop(group_name, None)
             self._clear_group_state(group_name)
         self._members.pop(group_name, None)
+        # a re-declared group restarts its seq counters: stale pending
+        # rounds can never complete and their keys would collide with the
+        # new incarnation's first rounds (lag EWMAs survive — rank identity
+        # is stable across re-inits, and the persistent-straggler score is
+        # exactly the cross-restart signal)
+        self._arrivals = {k: v for k, v in self._arrivals.items()
+                          if not (isinstance(k, tuple) and k
+                                  and k[0] == group_name)}
         return True
 
     def get_group(self, group_name: str):
@@ -108,6 +237,7 @@ class _CollectiveStoreActor:
         self._barriers = {k: v for k, v in self._barriers.items() if _keep(k)}
         self._barrier_reads = {k: v for k, v in self._barrier_reads.items() if _keep(k)}
         self._kv = {k: v for k, v in self._kv.items() if _keep(k)}
+        self._arrivals = {k: v for k, v in self._arrivals.items() if _keep(k)}
 
     def _abort_for(self, key):
         """Sentinel when ``key`` belongs to a poisoned group, else None."""
@@ -205,11 +335,19 @@ class _CollectiveStoreActor:
         return self._kv.pop(key, None)
 
     # -- gather: world_size ranks each contribute; all read; then GC -------
-    def contribute(self, key: Tuple, rank: int, value):
+    def contribute(self, key: Tuple, rank: int, value,
+                   arrival_rank=None, expected_ranks=None):
+        """``rank`` keys the gathered value (a subrank inside hierarchical
+        subgroup rounds); ``arrival_rank`` is the contributor's group-global
+        rank for the arrival monitor, with ``expected_ranks`` naming the
+        global ranks the round waits for — so diagnose/straggler EWMAs
+        always speak global ranks."""
         hit = self._abort_for(key)
         if hit is not None:
             return hit
         self._gathers.setdefault(key, {})[rank] = value
+        self._stamp_arrival(key, arrival_rank if arrival_rank is not None
+                            else rank, expected_ranks=expected_ranks)
         return True
 
     def collect(self, key: Tuple, world_size: int, reader_rank: int):
@@ -218,6 +356,7 @@ class _CollectiveStoreActor:
         hit = self._abort_for(key)
         if hit is not None:
             return hit
+        self._note_expected(key, world_size)
         entry = self._gathers.get(key)
         if entry is None or len(entry) < world_size:
             return None
@@ -236,6 +375,7 @@ class _CollectiveStoreActor:
             return hit
         arrived = self._barriers.setdefault(key, set())
         arrived.add(rank)
+        self._stamp_arrival(key, rank, expected=world_size)
         return len(arrived) >= world_size
 
     def barrier_done(self, key: Tuple, rank: int, world_size: int):

@@ -7,6 +7,9 @@ actors/tasks/objects/nodes/...` backed by GCS + per-node agents.
 from ray_tpu.util.state.api import (
     StateApiClient,
     cpu_profile,
+    diagnose,
+    flight_recorder,
+    goodput,
     jax_profile,
     dump_native_stacks,
     dump_stacks,
@@ -31,6 +34,9 @@ __all__ = [
     "StateApiClient",
     "node_metrics",
     "node_stats",
+    "diagnose",
+    "flight_recorder",
+    "goodput",
     "dump_native_stacks",
     "dump_stacks",
     "cpu_profile",

@@ -11,6 +11,7 @@ the reference CLI uses most.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 Filter = Tuple[str, str, Any]
@@ -416,6 +417,183 @@ class StateApiClient:
                 out.append(reply)
         return out
 
+    def flight_recorder(self, node_id=None, pid: Optional[int] = None,
+                        seconds: Optional[float] = None,
+                        limit: Optional[int] = 200) -> List[dict]:
+        """Flight-recorder tails from every (or one) node: per process, the
+        last seconds of step phases, collective entry/exit marks, task and
+        lease transitions.  Dead workers come back as their crash-dump
+        contents (the `<pid>.flight` file written next to the native stack
+        dump)."""
+        out = []
+        for node in self._alive_nodes(node_id):
+            try:
+                reply = self._w.pool.get(tuple(node["address"])).call(
+                    "AgentFlightRecorder",
+                    {"pid": pid, "seconds": seconds, "limit": limit},
+                    timeout=15)
+            except Exception:  # noqa: BLE001
+                continue
+            for row in reply or []:
+                row["node_id"] = node["node_id"]
+                out.append(row)
+        return out
+
+    # -- hang & straggler diagnosis (tentpole) -------------------------
+
+    def diagnose(self, hang_timeout_s: Optional[float] = None,
+                 include_stacks: bool = True,
+                 source: str = "api") -> dict:
+        """One cluster-wide hang sweep: "why is my job stuck right now?"
+
+        Folds three sources into one report:
+          1. the collective store's arrival monitor — pending rounds whose
+             missing ranks have kept the group waiting past
+             ``hang_detect_timeout_s`` name the blocking member (rank +
+             actor + node, identity captured at join), the op, and the seq
+             it never entered; completed-round arrival-lag EWMAs are the
+             persistent-straggler scores;
+          2. every process's flight-recorder tail (what each worker was
+             doing in the last seconds; entries recorded under a tracing
+             context carry trace_ids, cross-linking to state.get_trace());
+          3. stack dumps of the blocking workers (python-level; callers can
+             follow up with dump_native_stacks/cpu_profile for wedged ones).
+
+        A healthy cluster returns ``hung=False`` with empty ``blocking`` —
+        pending rounds younger than the timeout are listed under
+        ``pending_young`` but never flagged.
+        """
+        from ray_tpu._private import runtime_metrics
+        from ray_tpu._private.config import global_config
+
+        if hang_timeout_s is None:
+            hang_timeout_s = global_config().hang_detect_timeout_s
+        runtime_metrics.inc_hang_sweep(source)
+        report: dict = {
+            "time": time.time(),
+            "hang_timeout_s": hang_timeout_s,
+            "hung": False,
+            "blocking": [],
+            "pending_young": [],
+            "stragglers": {},
+            "aborted_groups": {},
+            "trace_ids": [],
+        }
+
+        # -- 1. collective arrival monitor --------------------------------
+        store_rep = None
+        try:
+            import ray_tpu
+            from ray_tpu.util.collective.store import STORE_ACTOR_NAME
+
+            store = ray_tpu.get_actor(STORE_ACTOR_NAME)
+            store_rep = ray_tpu.get(store.straggler_report.remote(),
+                                    timeout=15)
+        except Exception:  # noqa: BLE001 — no store actor = no collectives
+            pass
+
+        # actor -> (node, pid) so a blocking member is named as a process,
+        # not just a rank
+        actor_nodes: Dict[str, str] = {}
+        actor_pids: Dict[str, Optional[int]] = {}
+        if store_rep and any(g.get("pending") or g.get("members")
+                             for g in store_rep["groups"].values()):
+            for a in self.list_actors():
+                aid = a.get("actor_id")
+                aid = aid.hex() if hasattr(aid, "hex") else str(aid)
+                nid = a.get("node_id")
+                if nid is not None:
+                    actor_nodes[aid] = (nid.hex() if hasattr(nid, "hex")
+                                        else str(nid))
+            for wrow in self.list_workers():
+                if wrow.get("actor_id"):
+                    actor_pids[wrow["actor_id"]] = wrow.get("pid")
+
+        if store_rep:
+            for group, g in store_rep["groups"].items():
+                if g.get("lag_ewma_s"):
+                    report["stragglers"][group] = g["lag_ewma_s"]
+                if g.get("aborted"):
+                    report["aborted_groups"][group] = g["aborted"]
+                members = g.get("members") or {}
+                for round_ in g.get("pending") or []:
+                    rows = []
+                    for rank in round_.get("missing") or []:
+                        m = members.get(rank) or members.get(str(rank)) or {}
+                        aid = m.get("actor_id")
+                        rows.append({
+                            "group": group,
+                            "op": round_["op"],
+                            "seq": round_["seq"],
+                            "rank": rank,
+                            "actor_id": aid,
+                            "node_id": m.get("node_id")
+                            or actor_nodes.get(aid),
+                            "pid": actor_pids.get(aid),
+                            "waiting_s": round_["waiting_s"],
+                        })
+                    if round_["waiting_s"] >= hang_timeout_s and rows:
+                        report["blocking"].extend(rows)
+                    else:
+                        report["pending_young"].append(
+                            {"group": group, **round_})
+        if report["blocking"]:
+            report["hung"] = True
+
+        # -- 2. flight-recorder tails (every process's last seconds) ------
+        tails = self.flight_recorder(seconds=max(hang_timeout_s * 2, 30.0),
+                                     limit=100)
+        report["flight_recorder"] = tails
+        trace_ids: List[str] = []
+        for row in tails:
+            for e in row.get("entries") or []:
+                tid = e.get("trace_id")
+                if tid and tid not in trace_ids:
+                    trace_ids.append(tid)
+        report["trace_ids"] = trace_ids[-16:]
+
+        # -- 3. stacks of the blocking workers ----------------------------
+        if include_stacks and report["blocking"]:
+            stacks = []
+            for b in report["blocking"]:
+                if b.get("pid") is None:
+                    continue
+                try:
+                    stacks.extend(self.dump_stacks(pid=b["pid"]))
+                except Exception:  # noqa: BLE001
+                    continue
+            report["stacks"] = stacks
+        return report
+
+    # -- goodput ledger (train controller wall-clock accounting) --------
+
+    def goodput(self, run: Optional[str] = None) -> dict:
+        """Published goodput ledgers: per run, wall-clock split into
+        productive_step / checkpoint / restore / preemption_recovery /
+        input_wait / stall buckets (summing exactly to the wall) plus the
+        derived goodput ratio.  ``run`` narrows to one run name; also
+        accepts a job id recorded in the ledger."""
+        from ray_tpu.train._internal.goodput import GOODPUT_KV_PREFIX
+
+        out: Dict[str, dict] = {}
+        keys = self._w.gcs.call(
+            "KVKeys", {"prefix": GOODPUT_KV_PREFIX}) or []
+        for k in keys:
+            blob = self._w.gcs.call("KVGet", {"key": k})
+            if not blob:
+                continue
+            try:
+                import json
+
+                snap = json.loads(blob)
+            except Exception:  # noqa: BLE001
+                continue
+            name = k[len(GOODPUT_KV_PREFIX):]
+            if run is not None and run not in (name, snap.get("job_id")):
+                continue
+            out[name] = snap
+        return out
+
     def _agent_call_by_pid(self, method: str, payload: dict, *, pid,
                            node_id, timeout: float) -> dict:
         """Try every live node's agent endpoint for ``pid``; the hosting
@@ -541,6 +719,19 @@ def node_metrics(node_id=None):
 
 def dump_stacks(node_id=None, pid=None):
     return _client().dump_stacks(node_id, pid)
+
+
+def flight_recorder(node_id=None, pid=None, seconds=None, limit=200):
+    return _client().flight_recorder(node_id, pid, seconds, limit)
+
+
+def diagnose(hang_timeout_s=None, include_stacks: bool = True,
+             source: str = "api"):
+    return _client().diagnose(hang_timeout_s, include_stacks, source)
+
+
+def goodput(run=None):
+    return _client().goodput(run)
 
 
 def dump_native_stacks(pid, node_id=None):
