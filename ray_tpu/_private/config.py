@@ -147,6 +147,31 @@ class RayTpuConfig:
     # ring): heavy traced traffic evicts the oldest events; hot-path
     # emitters (engine step phases) self-rate-limit for this reason.
     tracing_enabled: bool = True
+    # --- serve: cache-aware routing / disaggregated LLM serving ---
+    # master switch for prefix-digest routing in DeploymentHandle: the
+    # router reads per-replica prefix digests (published to the GCS KV by
+    # replicas whose callable exposes prefix_digest()) and routes a request
+    # to the replica holding the longest matching KV prefix chain, falling
+    # back to power-of-two-choices on cold prefixes / overloaded winners
+    serve_prefix_routing_enabled: bool = True
+    # queue-length probe results (and digest-carried queue depths) are
+    # cached this long per replica, so steady-state routing costs zero
+    # probe RPCs at high QPS (<= 2 probes per replica per TTL window)
+    serve_route_probe_ttl_s: float = 0.25
+    # router-side digest refresh period (one KVKeys + KVGets per handle per
+    # interval, amortized over every request routed in between)
+    serve_prefix_digest_ttl_s: float = 1.0
+    # replica-side publish throttle: a changed digest is pushed to the GCS
+    # KV at most this often (version-bumped; unchanged digests are skipped)
+    serve_prefix_digest_interval_s: float = 1.0
+    # digest size cap: the newest N chain hashes (~16 KB JSON at 1024) —
+    # compact by design; replicas holding more advertise the newest chains
+    serve_prefix_digest_max_hashes: int = 1024
+    # a prefix-routing winner whose (cached) queue length exceeds the
+    # shorter pow-2 candidate by more than this many requests is considered
+    # overloaded and routing falls back to pow-2 (cache affinity must not
+    # create hot spots)
+    serve_prefix_overload_slack: int = 8
     # --- testing / chaos ---
     # Format mirrors RAY_testing_rpc_failure (reference: src/ray/rpc/rpc_chaos.h:23-35):
     # "method1=max_failures:req_prob:resp_prob,method2=..."

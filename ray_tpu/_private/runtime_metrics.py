@@ -256,6 +256,45 @@ SERVE_REQUESTS = Counter(
     "ray_tpu_serve_replica_requests_total",
     "Requests handled by replicas (rate() = per-deployment QPS)",
     tag_keys=("app", "deployment"))
+# tiered prefix cache (paged engine HBM chain-hash -> host RAM -> plasma)
+# + cache-aware routing.  Tier / stage / transport are tiny fixed sets.
+# Hit/miss unit is one KV BLOCK (block_size tokens): rate(hits)/(rate(hits)
+# + rate(misses)) is the live prefix-cache hit rate; recorded only when
+# prefix caching is enabled — the disabled path books nothing.
+SERVE_PREFIX_CACHE_HITS = Counter(
+    "ray_tpu_serve_prefix_cache_hits_total",
+    "Prompt KV blocks served from the prefix cache, by tier "
+    "(hbm = chain-hash pool match, host = host-RAM revival, plasma = "
+    "object-store revival, router = routed to the replica already holding "
+    "the chain)",
+    tag_keys=("tier",))
+SERVE_PREFIX_CACHE_MISSES = Counter(
+    "ray_tpu_serve_prefix_cache_misses_total",
+    "Prompt KV blocks that had to be prefilled fresh (no tier held them)",
+    tag_keys=("tier",))
+SERVE_PREFIX_CACHE_EVICTIONS = Counter(
+    "ray_tpu_serve_prefix_cache_evictions_total",
+    "Cached KV blocks evicted from a tier under pressure (an hbm eviction "
+    "that demotes to host RAM still counts here)",
+    tag_keys=("tier",))
+# prefill -> decode KV-block handoff (disaggregated serving)
+KV_HANDOFF_BYTES = Counter(
+    "ray_tpu_kv_handoff_bytes_total",
+    "KV-cache bytes handed from prefill to decode replicas, by transport "
+    "(object = plasma/inline actor-call payload, channel = device-tensor "
+    "channel, channel_int8 = quantized channel)",
+    tag_keys=("transport",))
+KV_HANDOFF_LATENCY = Histogram(
+    "ray_tpu_kv_handoff_latency_seconds",
+    "Wall time of one KV handoff leg: receive + pool scatter under the "
+    "plain transport tag (one observation per handoff — the authoritative "
+    "count); export gather + transfer enqueue under <transport>_export",
+    boundaries=_LATENCY_BOUNDS, tag_keys=("transport",))
+SERVE_DISAGG_QUEUE_DEPTH = Gauge(
+    "ray_tpu_serve_disagg_queue_depth",
+    "Live requests per disaggregated serving stage (prefill = queued + "
+    "mid-prefill, decode = decode-active slots)",
+    tag_keys=("stage",))
 
 # -- data -------------------------------------------------------------------
 DATA_ROWS = Counter(
@@ -286,6 +325,9 @@ FAMILIES = (
     TRAIN_GOODPUT_SECONDS, TRAIN_GOODPUT_RATIO,
     TPU_CHIPS, TPU_PROCESS_CHIPS,
     SERVE_REQUEST_LATENCY, SERVE_REQUESTS,
+    SERVE_PREFIX_CACHE_HITS, SERVE_PREFIX_CACHE_MISSES,
+    SERVE_PREFIX_CACHE_EVICTIONS,
+    KV_HANDOFF_BYTES, KV_HANDOFF_LATENCY, SERVE_DISAGG_QUEUE_DEPTH,
     DATA_ROWS, DATA_BACKPRESSURE,
 )
 
@@ -567,6 +609,80 @@ def record_collective_compression(op: str, backend: str, world_size: int,
                world_size=str(world_size), group=group).set(quant_error)
     _bound(COLLECTIVE_ALGORITHM, op=op, backend=backend,
            algorithm=algorithm, scheme=scheme).inc()
+
+
+def add_prefix_cache_hits(tier: str, n: int = 1) -> None:
+    if n > 0:
+        _bound(SERVE_PREFIX_CACHE_HITS, tier=tier).inc(n)
+
+
+def add_prefix_cache_misses(n: int = 1, tier: str = "all") -> None:
+    if n > 0:
+        _bound(SERVE_PREFIX_CACHE_MISSES, tier=tier).inc(n)
+
+
+def add_prefix_cache_evictions(tier: str, n: int = 1) -> None:
+    if n > 0:
+        _bound(SERVE_PREFIX_CACHE_EVICTIONS, tier=tier).inc(n)
+
+
+def record_kv_handoff(transport: str, nbytes: int, seconds: float) -> None:
+    """One prefill->decode KV handoff leg.  Senders book latency only
+    (nbytes=0) under "<transport>_export"; the receiver books the moved
+    bytes under the plain transport tag — it is the one side that knows
+    the true wire size for every transport — so per-transport bytes,
+    handoff count and effective bandwidth each count a handoff exactly
+    once even when both stages share a process."""
+    if nbytes > 0:
+        _bound(KV_HANDOFF_BYTES, transport=transport).inc(nbytes)
+    _bound(KV_HANDOFF_LATENCY, transport=transport).observe(seconds)
+
+
+def set_disagg_queue_depth(stage: str, n: int) -> None:
+    _bound(SERVE_DISAGG_QUEUE_DEPTH, stage=stage).set(n)
+
+
+def prefix_cache_snapshot() -> dict:
+    """Process-local tiered prefix-cache accounting for bench.py and the
+    perf tests: per-tier hit/miss/eviction block counts plus the derived
+    overall hit rate.  Hermetic — reads this process's counters only."""
+    out: dict = {"hits": {}, "misses": 0.0, "evictions": {}}
+    for tags_key, v in dict(SERVE_PREFIX_CACHE_HITS._points).items():
+        tier = dict(tags_key).get("tier", "?")
+        out["hits"][tier] = out["hits"].get(tier, 0.0) + v
+    for _tags_key, v in dict(SERVE_PREFIX_CACHE_MISSES._points).items():
+        out["misses"] += v
+    for tags_key, v in dict(SERVE_PREFIX_CACHE_EVICTIONS._points).items():
+        tier = dict(tags_key).get("tier", "?")
+        out["evictions"][tier] = out["evictions"].get(tier, 0.0) + v
+    hits = sum(out["hits"].values())
+    total = hits + out["misses"]
+    out["hit_rate"] = (hits / total) if total else 0.0
+    return out
+
+
+def kv_handoff_snapshot() -> dict:
+    """Process-local KV-handoff accounting: per-transport bytes, handoff
+    count, mean latency and the derived effective bandwidth (bytes moved /
+    time spent handing off — the busbw analog for the handoff plane)."""
+    out: dict = {}
+    for tags_key, v in dict(KV_HANDOFF_BYTES._points).items():
+        t = dict(tags_key).get("transport", "?")
+        out.setdefault(t, {})["bytes_total"] = (
+            out.get(t, {}).get("bytes_total", 0.0) + v)
+    for p in KV_HANDOFF_LATENCY._snapshot():
+        t = p["tags"].get("transport", "?")
+        d = out.setdefault(t, {})
+        d["handoffs"] = d.get("handoffs", 0) + p["count"]
+        d["latency_sum_s"] = d.get("latency_sum_s", 0.0) + p["sum"]
+    for d in out.values():
+        n = d.get("handoffs", 0)
+        lat = d.pop("latency_sum_s", 0.0)
+        if n:
+            d["mean_latency_s"] = lat / n
+        if lat > 0 and d.get("bytes_total"):
+            d["effective_gbps"] = d["bytes_total"] / lat / 1e9
+    return out
 
 
 def set_tpu_chips(node: str, total: float, claimed: float) -> None:

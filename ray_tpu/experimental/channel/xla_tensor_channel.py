@@ -104,6 +104,13 @@ class XlaTensorChannel:
         self._comm = None
         self._role: Optional[int] = None
         self._comm_lock = threading.Lock()
+        # wire accounting for the most recent transfer on this side
+        # (quantized leaves count codes + scales, not the logical array):
+        # consumers that meter the channel plane — the disaggregated KV
+        # handoff records ray_tpu_kv_handoff_bytes from this — read it
+        # after write()/read() instead of re-deriving payload sizes
+        self.last_write_nbytes = 0
+        self.last_read_nbytes = 0
         # LOSSY opt-in: large float array leaves travel as int8 codes +
         # per-block scales (same codec as the collective layer); None =
         # full-precision transfers (the stock path, byte-identical).
@@ -159,14 +166,18 @@ class XlaTensorChannel:
         # metadata first: the reader learns how many arrays to receive and
         # which of them arrive quantized
         self._meta.write((structure, len(arrays), qinfos), timeout)
+        wire = 0
         if payloads:
             comm = self._communicator(self.WRITER)
             for qi, payload in zip(qinfos, payloads):
                 if qi is None:
                     comm.send(payload, self.READER)
+                    wire += payload.nbytes
                 else:
                     comm.send(payload[0], self.READER)  # int8 codes
                     comm.send(payload[1], self.READER)  # f32 scales
+                    wire += comp.wire_nbytes(payload[0], payload[1])
+        self.last_write_nbytes = wire
 
     def _record_wire(self, logical: int, wire: int):
         try:
@@ -192,22 +203,28 @@ class XlaTensorChannel:
 
         structure, n, qinfos = self._meta.read(timeout)
         if not n:
+            self.last_read_nbytes = 0
             return structure
         comm = self._communicator(self.READER)
         arrays = []
+        wire = 0
         for qi in qinfos:
             if qi is None:
-                arrays.append(comm.recv(self.WRITER))
+                got = comm.recv(self.WRITER)
+                wire += got.nbytes
+                arrays.append(got)
                 continue
             shape, dtype_name, block_size = qi
             codes = comm.recv(self.WRITER)
             scales = comm.recv(self.WRITER)
+            wire += comp.wire_nbytes(codes, scales)
             count = 1
             for d in shape:
                 count *= d
             arrays.append(comp.dequantize_blocks(
                 codes, scales, count, block_size,
                 dtype=comp.dtype_from_name(dtype_name)).reshape(shape))
+        self.last_read_nbytes = wire
         return _join_arrays(structure, arrays)
 
     # -- lifecycle ----------------------------------------------------------

@@ -10,14 +10,25 @@ default) and JaxLLMEngine (static per-slot cache).
 
 from ray_tpu.llm.batch import Processor, ProcessorConfig, build_llm_processor
 from ray_tpu.llm.config import GenerationConfig, LLMConfig
+from ray_tpu.llm.disagg import (
+    DecodeServer,
+    DisaggLLMServer,
+    PrefillServer,
+    build_disagg_llm_deployment,
+)
 from ray_tpu.llm.engine import JaxLLMEngine, make_engine
-from ray_tpu.llm.paged import BlockManager, PagedJaxLLMEngine
+from ray_tpu.llm.paged import BlockAllocator, BlockManager, PagedJaxLLMEngine
 from ray_tpu.llm.lora import LoRAConfig, LoRAManager, init_lora, merge_lora
 from ray_tpu.llm.openai_api import ByteTokenizer, OpenAICompatServer, build_openai_app
 from ray_tpu.llm.serve import LLMServer, build_llm_deployment
 
 __all__ = [
+    "BlockAllocator",
     "BlockManager",
+    "DecodeServer",
+    "DisaggLLMServer",
+    "PrefillServer",
+    "build_disagg_llm_deployment",
     "GenerationConfig",
     "JaxLLMEngine",
     "LLMConfig",

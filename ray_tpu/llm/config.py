@@ -52,6 +52,17 @@ class LLMConfig:
     # otherwise ramps one chunk per step, serializing admission.
     prefill_budget_tokens: Optional[int] = None
     enable_prefix_caching: bool = True
+    # --- tiered prefix cache (paged engine) ---
+    # host-RAM tier under the HBM chain-hash pool: full prompt blocks
+    # evicted from HBM under pressure demote here (one small device
+    # readback per eviction) and revive without recompute on a later
+    # match.  0 disables the tier ladder entirely.
+    host_kv_cache_bytes: int = 64 * 1024**2
+    # third tier: blocks evicted from host RAM spill to the plasma object
+    # store (cluster-visible, survives engine HBM churn), capped at this
+    # many blocks.  0 (default) disables; requires an initialized ray_tpu
+    # worker — without one the host tier simply drops its evictions.
+    plasma_kv_cache_blocks: int = 0
     # True -> the pallas TPU paged-attention kernel for decode (single-chip
     # TPU, head_dim % 128 == 0, pp == 1). None = auto: ON where supported
     # (measured v5e b32: ties the XLA block-gather at span 256, 2.2x faster

@@ -478,6 +478,12 @@ class JaxLLMEngine:
             self._collect_inflight_locked()
             return self._gather_emitted_locked(before)
 
+    def prefix_digest(self, max_hashes: Optional[int] = None) -> Dict:
+        """Uniform engine surface for the cache-aware serve router: the
+        static cache has no sharable prefix blocks, so its digest is empty
+        (the router then treats every prompt as cold and uses pow-2)."""
+        return {"block_size": 0, "hashes": []}
+
     # -- sync convenience ----------------------------------------------
 
     def generate(self, prompts: Sequence[Sequence[int]],

@@ -41,18 +41,25 @@ import numpy as np
 
 from ray_tpu.llm.config import GenerationConfig, LLMConfig
 from ray_tpu.llm.engine import _MAX_STOP_IDS, _MAX_TOP_K, _Request, _sample
+from ray_tpu._private.prefix_hash import chain_hash, prefix_chain_hashes
 from ray_tpu.models import llama
 from ray_tpu.ops.rope import rope_frequencies
 
 
 class BlockManager:
-    """Host-side allocator + prefix cache over the device block pool."""
+    """Host-side allocator + prefix cache over the device block pool.
+
+    ``on_evict(block, chain_hash)`` fires when allocation pressure
+    repurposes a hash-registered (cached) block, BEFORE its registration is
+    dropped — the tier ladder's demotion hook: the engine copies the
+    block's KV to the host-RAM tier while the pool still holds it."""
 
     def __init__(self, num_blocks: int, block_size: int,
-                 prefix_caching: bool = True):
+                 prefix_caching: bool = True, on_evict=None):
         self.num_blocks = num_blocks
         self.bs = block_size
         self.prefix_caching = prefix_caching
+        self.on_evict = on_evict
         # block 0 is the SINK: inactive decode slots' zero-padded table rows
         # make the device scatter land there, so it is never allocated —
         # a live request's data can never be corrupted by an idle slot.
@@ -84,6 +91,11 @@ class BlockManager:
                 b, _ = self.free_cached.popitem(last=False)
             h = self.hash_of.pop(b, None)  # repurposed: stale cache entry out
             if h is not None and self.by_hash.get(h) == b:
+                if self.on_evict is not None:
+                    try:
+                        self.on_evict(b, h)  # demote before the data is lost
+                    except Exception:  # noqa: BLE001 — tiering is best-effort
+                        pass
                 del self.by_hash[h]
             self.ref[b] = 1
             out.append(b)
@@ -111,7 +123,7 @@ class BlockManager:
         h: Optional[int] = None
         limit = (len(prompt) - 1) // self.bs
         for i in range(limit):
-            h = hash((h, tuple(prompt[i * self.bs:(i + 1) * self.bs])))
+            h = chain_hash(h, prompt[i * self.bs:(i + 1) * self.bs])
             b = self.by_hash.get(h)
             if b is None:
                 break
@@ -129,11 +141,123 @@ class BlockManager:
             return
         h: Optional[int] = None
         for i in range(len(prompt) // self.bs):
-            h = hash((h, tuple(prompt[i * self.bs:(i + 1) * self.bs])))
+            h = chain_hash(h, prompt[i * self.bs:(i + 1) * self.bs])
             b = blocks[i]
             if h not in self.by_hash and b not in self.hash_of:
                 self.by_hash[h] = b
                 self.hash_of[b] = h
+
+    def adopt(self, block: int, h: int):
+        """Register a chain hash for an already-allocated block (a tier
+        revival: the caller just uploaded the cached KV into ``block``)."""
+        if not self.prefix_caching:
+            return
+        if h not in self.by_hash and block not in self.hash_of:
+            self.by_hash[h] = block
+            self.hash_of[block] = h
+
+
+# the reference/vLLM name for this role; the serve layer and ISSUE docs use
+# it — one object, two names
+BlockAllocator = BlockManager
+
+
+class HostBlockCache:
+    """Tiers 2+3 of the prefix-cache ladder: host-RAM LRU of full KV
+    blocks keyed by chain hash, spilling to the plasma object store.
+
+    HBM (tier 1) evictions demote here; ``get`` revives through host RAM
+    first, then plasma (promoting the block back up).  Byte-capped LRU;
+    plasma entries are ObjectRefs whose payloads live in the store (freed
+    when the ref is dropped).  Thread-safe: the engine calls under its own
+    lock, but the serve digest publisher reads concurrently."""
+
+    def __init__(self, capacity_bytes: int, plasma_blocks: int = 0):
+        self._cap = max(0, capacity_bytes)
+        self._plasma_cap = max(0, plasma_blocks)
+        self._entries: "collections.OrderedDict[int, Tuple]" = (
+            collections.OrderedDict())  # hash -> (k_np, v_np)
+        self._bytes = 0
+        self._plasma: "collections.OrderedDict[int, object]" = (
+            collections.OrderedDict())  # hash -> ObjectRef
+        self._lock = threading.Lock()
+
+    def __len__(self):
+        with self._lock:
+            return len(self._entries) + len(self._plasma)
+
+    @property
+    def nbytes(self) -> int:
+        return self._bytes
+
+    def hashes(self) -> List[int]:
+        with self._lock:
+            return list(self._plasma) + list(self._entries)
+
+    def put(self, h: int, k, v):
+        """Demote one block's KV into the host tier (LRU-evicting over the
+        byte cap into plasma, or dropping when plasma is off/full)."""
+        if self._cap <= 0:
+            return
+        from ray_tpu._private import runtime_metrics
+
+        nbytes = k.nbytes + v.nbytes
+        spill = []
+        with self._lock:
+            if h in self._entries:
+                self._entries.move_to_end(h)
+                return
+            self._plasma.pop(h, None)  # promoted copy supersedes the spill
+            self._entries[h] = (k, v)
+            self._bytes += nbytes
+            while self._bytes > self._cap and len(self._entries) > 1:
+                eh, (ek, ev) = self._entries.popitem(last=False)
+                self._bytes -= ek.nbytes + ev.nbytes
+                spill.append((eh, ek, ev))
+        for eh, ek, ev in spill:
+            runtime_metrics.add_prefix_cache_evictions("host")
+            self._spill_to_plasma(eh, ek, ev)
+
+    def _spill_to_plasma(self, h: int, k, v):
+        from ray_tpu._private import runtime_metrics
+
+        if self._plasma_cap <= 0:
+            return
+        try:
+            import ray_tpu
+
+            if not ray_tpu.is_initialized():
+                return
+            ref = ray_tpu.put((k, v))
+        except Exception:  # noqa: BLE001 — tiering is best-effort
+            return
+        with self._lock:
+            self._plasma[h] = ref
+            while len(self._plasma) > self._plasma_cap:
+                self._plasma.popitem(last=False)
+                runtime_metrics.add_prefix_cache_evictions("plasma")
+
+    def get(self, h: int):
+        """(k, v, tier) for a cached block, or None.  A plasma hit is
+        promoted back into the host tier (it is about to be hot)."""
+        with self._lock:
+            got = self._entries.get(h)
+            if got is not None:
+                self._entries.move_to_end(h)
+                return got[0], got[1], "host"
+            ref = self._plasma.get(h)
+        if ref is None:
+            return None
+        try:
+            import ray_tpu
+
+            k, v = ray_tpu.get(ref, timeout=5)
+        except Exception:  # noqa: BLE001 — lost spill: treat as a miss
+            with self._lock:
+                self._plasma.pop(h, None)
+            return None
+        self.put(h, k, v)
+        return k, v, "plasma"
 
 
 @dataclasses.dataclass
@@ -234,7 +358,17 @@ class PagedJaxLLMEngine:
         # can cover past max_blocks_per_seq + 2.
         self._prefill_w = _prefill_table_width(
             self.max_seq, config.prefill_chunk, self.bs)
-        self.blocks = BlockManager(nb, self.bs, config.enable_prefix_caching)
+        # tier ladder under the HBM chain-hash pool: HBM evictions demote
+        # full prompt blocks to host RAM (and optionally plasma); a later
+        # prefix match revives them by pool upload instead of recompute
+        self._host_cache: Optional[HostBlockCache] = None
+        if config.enable_prefix_caching and config.host_kv_cache_bytes > 0:
+            self._host_cache = HostBlockCache(
+                config.host_kv_cache_bytes, config.plasma_kv_cache_blocks)
+        self.blocks = BlockManager(
+            nb, self.bs, config.enable_prefix_caching,
+            on_evict=(self._demote_block if self._host_cache is not None
+                      else None))
 
         if params is None:
             params = llama.init_params(cfg, key or jax.random.PRNGKey(0))
@@ -326,6 +460,18 @@ class PagedJaxLLMEngine:
                                static_argnums=11)
         self._prefill_chunk = jax.jit(self._prefill_chunk_impl,
                                       donate_argnums=2)
+        # tier revival: scatter one host-cached block back into the pool
+        # (fixed shapes -> exactly one compile)
+        self._upload_block = jax.jit(
+            lambda pool, b, k, v: {"k": pool["k"].at[:, b].set(k),
+                                   "v": pool["v"].at[:, b].set(v)},
+            donate_argnums=0)
+        # disaggregated handoff import: scatter a request's blocks (padded
+        # to a pow2 count; pad rows land in sink block 0) into the pool
+        self._import_blocks = jax.jit(
+            lambda pool, idx, k, v: {"k": pool["k"].at[:, idx].set(k),
+                                     "v": pool["v"].at[:, idx].set(v)},
+            donate_argnums=0)
 
     # -- jitted programs ------------------------------------------------
 
@@ -409,6 +555,86 @@ class PagedJaxLLMEngine:
             return (bool(self._pending) or self._inflight is not None
                     or any(r is not None for r in self._slot_req))
 
+    # -- tiered prefix cache --------------------------------------------
+
+    def _demote_block(self, block: int, h: int):
+        """BlockManager eviction hook: copy the repurposed cached block's
+        KV to the host tier before the pool overwrites it.  One small
+        device->host readback per eviction — off the steady decode path
+        (it only fires under real allocation pressure); free blocks are
+        never written by in-flight programs, so the read is consistent."""
+        from ray_tpu._private import runtime_metrics
+
+        k = np.asarray(self.pool["k"][:, block])
+        v = np.asarray(self.pool["v"][:, block])
+        self._host_cache.put(h, k, v)
+        runtime_metrics.add_prefix_cache_evictions("hbm")
+
+    def _match_prefix_tiered(self, prompt: Sequence[int]):
+        """HBM chain match, then extend the chain through the host/plasma
+        tiers: each tier hit allocates a pool block, uploads the cached KV
+        and re-registers the link, so the revived prefix is an ordinary
+        HBM match for every later request.
+
+        Returns ``(shared, matched, (hbm_hits, misses, revived_tiers))``:
+        NO metrics are booked here — the caller books them only on a
+        SUCCESSFUL admission.  A pool-full head-of-line request re-matches
+        every step, so booking per attempt would fabricate phantom counts;
+        and a block revived on a failed attempt re-matches as an ordinary
+        HBM hit on the retry (adopt registered it), so hits + misses must
+        always sum to the prompt's block count per admission."""
+        shared, matched = self.blocks.match_prefix(prompt)
+        if not self.blocks.prefix_caching:
+            return shared, matched, (0, 0, ())
+        limit = (len(prompt) - 1) // self.bs
+        hbm_hits = len(shared)
+        revived = []
+        if self._host_cache is not None and len(shared) < limit:
+            chain = prefix_chain_hashes(prompt, self.bs, limit=limit)
+            i = len(shared)
+            while i < limit:
+                got = self._host_cache.get(chain[i])
+                if got is None:
+                    break
+                fresh = self.blocks.alloc(1)
+                if fresh is None:
+                    break  # pool full: revival loses to live requests
+                k, v, tier = got
+                b = fresh[0]
+                kd = self.pool["k"].dtype
+                self.pool = self._upload_block(
+                    self.pool, jnp.int32(b),
+                    jnp.asarray(np.asarray(k, dtype=kd)),
+                    jnp.asarray(np.asarray(v, dtype=kd)))
+                self.blocks.adopt(b, chain[i])
+                shared.append(b)
+                revived.append(tier)
+                i += 1
+        return (shared, len(shared) * self.bs,
+                (hbm_hits, limit - len(shared), tuple(revived)))
+
+    def prefix_digest(self, max_hashes: Optional[int] = None) -> Dict:
+        """Compact summary of the prefix chains this engine can serve
+        without recompute (HBM registrations + host/plasma tiers), newest
+        last.  The serve router compares request chains against it
+        (cache-aware routing); hashes are stable across processes
+        (_private/prefix_hash.py)."""
+        if not self.config.enable_prefix_caching:
+            return {"block_size": self.bs, "hashes": []}
+        if max_hashes is None:
+            from ray_tpu._private.config import global_config
+
+            max_hashes = global_config().serve_prefix_digest_max_hashes
+        with self._lock:
+            hashes = list(self.blocks.by_hash)
+        if self._host_cache is not None:
+            seen = set(hashes)
+            hashes = [h for h in self._host_cache.hashes()
+                      if h not in seen] + hashes
+        if len(hashes) > max_hashes:
+            hashes = hashes[-max_hashes:]
+        return {"block_size": self.bs, "hashes": hashes}
+
     # -- admission / prefill -------------------------------------------
 
     def _admit_locked(self):
@@ -422,7 +648,7 @@ class PagedJaxLLMEngine:
             if not self._pending or self._slot_req[slot] is not None:
                 continue
             req = self._pending[0]
-            shared, matched = self.blocks.match_prefix(req.prompt)
+            shared, matched, hit_miss = self._match_prefix_tiered(req.prompt)
             # reserve every block any (pow2-bucketed) prefill chunk's table
             # must cover — chunk padding may reach past the prompt's own
             # blocks (trimmed at prefill end); +1 is the first decode
@@ -434,6 +660,14 @@ class PagedJaxLLMEngine:
             if fresh is None:
                 self.blocks.release(shared)
                 return  # pool full: keep FIFO order, retry next step
+            if self.blocks.prefix_caching:
+                from ray_tpu._private import runtime_metrics
+
+                hbm_hits, misses, revived = hit_miss
+                runtime_metrics.add_prefix_cache_hits("hbm", hbm_hits)
+                for tier in revived:
+                    runtime_metrics.add_prefix_cache_hits(tier)
+                runtime_metrics.add_prefix_cache_misses(misses)
             self._pending.popleft()
             req.slot = slot
             req.blocks = shared + fresh
@@ -737,6 +971,114 @@ class PagedJaxLLMEngine:
             before = self._emit_snapshot_locked()
             self._drain_locked()
             return self._gather_emitted_locked(before)
+
+    # -- disaggregated prefill/decode handoff ---------------------------
+
+    def export_request(self, request_id: int) -> Dict:
+        """Export a prefill-complete request's KV blocks + first sampled
+        token and release its slot (the prefill stage of a disaggregated
+        deployment).  The request's registered prompt blocks stay revivable
+        in this engine's prefix cache, so the prefill replica keeps serving
+        chain hits for the prompt it just handed off.
+
+        Returns {prompt, first_token, k, v, block_size}: k/v are host
+        arrays [L, nblocks, block_size, kv_dim] covering exactly the
+        prompt.  Raises if the request isn't in the exportable state
+        (prefill incomplete, or already finished — a 1-token budget
+        completes on the first emit and frees its partial block)."""
+        with self._lock:
+            self._drain_locked()  # resolve the final chunk's sampled token
+            req = self._requests.get(request_id)
+            if req is None or req.done or req.slot < 0:
+                raise KeyError(
+                    f"request {request_id} is not exportable (finished or "
+                    "unknown — use max_new_tokens >= 2 for prefill-stage "
+                    "requests)")
+            if req.prefill_pos < len(req.prompt):
+                raise RuntimeError(
+                    f"request {request_id} prefill incomplete "
+                    f"({req.prefill_pos}/{len(req.prompt)})")
+            if not req.out_tokens:
+                raise RuntimeError(
+                    f"request {request_id} first token unresolved")
+            blocks = list(req.blocks)
+            barr = jnp.asarray(np.asarray(blocks, np.int32))
+            # one gather program + readback; [L, nb, bs, D]
+            k = np.asarray(self.pool["k"][:, barr])
+            v = np.asarray(self.pool["v"][:, barr])
+            out = {"prompt": list(req.prompt),
+                   "first_token": int(req.out_tokens[0]),
+                   "k": k, "v": v, "block_size": self.bs}
+            req.done = True
+            self._free_slot_locked(req)
+            del self._requests[request_id]
+            return out
+
+    def import_request(self, prompt: Sequence[int], first_token: int,
+                       k, v, gen: Optional[GenerationConfig] = None):
+        """Admit a request directly into the decode state from handed-off
+        KV (the decode stage of a disaggregated deployment): allocates
+        pool blocks, scatters the KV in, registers the prompt's chain for
+        prefix sharing, and emits ``first_token`` as the request's first
+        output token.
+
+        Returns {request_id, emitted, done} or None when no slot/blocks
+        are free right now — the caller falls back to a plain
+        ``add_request`` (recompute; the prefix cache usually absorbs most
+        of it).  Never queues: a queued import would pin host copies of
+        KV that recompute could regenerate."""
+        gen = gen or GenerationConfig()
+        plen = len(prompt)
+        if plen == 0:
+            raise ValueError("empty prompt")
+        if plen + gen.max_new_tokens > self.max_seq:
+            raise ValueError(
+                f"prompt ({plen}) + max_new_tokens ({gen.max_new_tokens})"
+                f" exceeds max_seq_len {self.max_seq}")
+        nb = int(k.shape[1])
+        if nb != math.ceil(plen / self.bs):
+            raise ValueError(
+                f"handoff covers {nb} blocks but a {plen}-token prompt "
+                f"needs {math.ceil(plen / self.bs)} at block_size {self.bs}")
+        with self._lock:
+            slot = next((s for s in range(self.max_batch)
+                         if self._slot_req[s] is None), None)
+            if slot is None:
+                return None
+            blocks = self.blocks.alloc(nb)
+            if blocks is None:
+                return None
+            pad = _bucket_pow2(nb)
+            kd = self.pool["k"].dtype
+            idx = np.zeros(pad, np.int32)
+            idx[:nb] = blocks  # pad rows scatter into sink block 0
+            kp = np.zeros((k.shape[0], pad) + tuple(k.shape[2:]), dtype=kd)
+            vp = np.zeros_like(kp)
+            kp[:, :nb] = np.asarray(k, dtype=kd)
+            vp[:, :nb] = np.asarray(v, dtype=kd)
+            self.pool = self._import_blocks(
+                self.pool, jnp.asarray(idx), jnp.asarray(kp),
+                jnp.asarray(vp))
+            self._req_counter += 1
+            req = _PagedReq(self._req_counter, list(prompt), gen)
+            req.slot = slot
+            req.blocks = list(blocks)
+            req.prefill_pos = plen
+            self._admit_counter += 1
+            req.admitted_order = self._admit_counter
+            self._requests[req.request_id] = req
+            self._slot_req[slot] = req
+            self.blocks.register(req.prompt, req.blocks)
+            self._lengths[slot] = plen
+            self._next_tok[slot] = first_token
+            self._slot_temp[slot] = gen.temperature
+            self._slot_topk[slot] = gen.top_k
+            self._dirty = True
+            # the prefill stage sampled this token; it counts as output
+            # token #1 exactly as in the monolithic flow
+            self._emit_locked(req, int(first_token))
+            return {"request_id": req.request_id,
+                    "emitted": [int(first_token)], "done": req.done}
 
     def _emit_snapshot_locked(self) -> Dict[int, int]:
         return {id(r): len(r.out_tokens) for r in self._requests.values()}

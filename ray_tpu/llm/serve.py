@@ -64,6 +64,73 @@ class LLMServer:
     def lora_model_ids(self) -> List[str]:
         return sorted(self._adapters)
 
+    def prefix_digest(self) -> Dict[str, Any]:
+        """Cache-aware routing surface (serve/handle.py): the base engine's
+        prefix-chain digest plus the adapter ids this replica has loaded
+        (LoRA affinity) and the live request depth.  Published to the GCS
+        KV by the hosting replica (throttled, versioned)."""
+        digest = getattr(self._engine, "prefix_digest", lambda: {})() or {}
+        with self._engines_lock:
+            engines = list(self._engines.values())
+            models = [m for m in self._engine_order]
+        qlen = 0
+        for eng in engines:
+            try:
+                with eng._lock:
+                    qlen += len(eng._requests)
+            except Exception:  # noqa: BLE001
+                pass
+        digest["models"] = models
+        digest["qlen"] = qlen
+        return digest
+
+    def _wait_done(self, wkey) -> List[int]:
+        """Block until ``wkey``'s request finishes; return all its tokens."""
+        try:
+            with self._cv:
+                while wkey not in self._done:
+                    if self._error is not None:
+                        raise RuntimeError(
+                            "LLM engine loop failed") from self._error
+                    if self._stop:
+                        raise RuntimeError("LLM server shut down")
+                    self._cv.wait(timeout=0.1)
+                return self._done.pop(wkey)
+        finally:
+            with self._cv:
+                self._active_waiters.discard(wkey)
+
+    def _iter_tokens(self, wkey):
+        """Yield ``wkey``'s token chunks as they decode (generate_stream's
+        engine-side loop, shared with the disaggregated decode stage)."""
+        sent = 0
+        try:
+            while True:
+                with self._cv:
+                    while True:
+                        if self._error is not None:
+                            raise RuntimeError(
+                                "LLM engine loop failed") from self._error
+                        if self._stop:
+                            raise RuntimeError("LLM server shut down")
+                        done = wkey in self._done
+                        buf = (self._done[wkey] if done
+                               else self._waiters.get(wkey, []))
+                        if len(buf) > sent or done:
+                            break
+                        self._cv.wait(timeout=0.1)
+                    chunk = list(buf[sent:])
+                    sent += len(chunk)
+                    if done:
+                        self._done.pop(wkey, None)
+                if chunk:
+                    yield chunk
+                if done:
+                    return
+        finally:
+            with self._cv:
+                self._active_waiters.discard(wkey)
+
     _MAX_ADAPTER_ENGINES = 4
 
     def _submit(self, model: Optional[str], prompt, gen):
@@ -175,18 +242,7 @@ class LLMServer:
                                temperature=temperature, top_k=top_k,
                                stop_token_ids=tuple(stop_token_ids))
         wkey = self._submit(model, list(prompt), gen)
-        try:
-            with self._cv:
-                while wkey not in self._done:
-                    if self._error is not None:
-                        raise RuntimeError("LLM engine loop failed") from self._error
-                    if self._stop:
-                        raise RuntimeError("LLM server shut down")
-                    self._cv.wait(timeout=0.1)
-                return self._done.pop(wkey)
-        finally:
-            with self._cv:
-                self._active_waiters.discard(wkey)
+        return self._wait_done(wkey)
 
     def generate_stream(self, prompt: Sequence[int],
                         max_new_tokens: int = 64, temperature: float = 0.0,
@@ -200,31 +256,7 @@ class LLMServer:
                                temperature=temperature, top_k=top_k,
                                stop_token_ids=tuple(stop_token_ids))
         wkey = self._submit(model, list(prompt), gen)
-        sent = 0
-        try:
-            while True:
-                with self._cv:
-                    while True:
-                        if self._error is not None:
-                            raise RuntimeError("LLM engine loop failed") from self._error
-                        if self._stop:
-                            raise RuntimeError("LLM server shut down")
-                        done = wkey in self._done
-                        buf = self._done[wkey] if done else self._waiters.get(wkey, [])
-                        if len(buf) > sent or done:
-                            break
-                        self._cv.wait(timeout=0.1)
-                    chunk = list(buf[sent:])
-                    sent += len(chunk)
-                    if done:
-                        self._done.pop(wkey, None)
-                if chunk:
-                    yield chunk
-                if done:
-                    return
-        finally:
-            with self._cv:
-                self._active_waiters.discard(wkey)
+        yield from self._iter_tokens(wkey)
 
     def __call__(self, request: Dict[str, Any]) -> Dict[str, Any]:
         """HTTP-style entry: {"prompt": [ids], "max_new_tokens": n, ...}."""

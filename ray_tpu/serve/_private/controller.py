@@ -176,6 +176,8 @@ class ServeController:
                 ray_tpu.kill(entry[0])
             except Exception:  # noqa: BLE001
                 pass
+        self._del_digest_rows(
+            entry[3] if len(entry) > 3 else None for entry in items)
         return True
 
     # -- reconciliation ------------------------------------------------------
@@ -206,7 +208,7 @@ class ServeController:
                     want = desired.get(app, {}).get(dep)
                     recs = self._replicas[app][dep]
                     if not want:
-                        self._begin_drain(recs)
+                        self._begin_drain(recs, app, dep)
                         recs.clear()
                         del self._replicas[app][dep]
                         self._version += 1
@@ -220,13 +222,13 @@ class ServeController:
                         # (version bump) and drain the old code
                         for r in old:
                             recs.remove(r)
-                        self._begin_drain(old)
+                        self._begin_drain(old, app, dep)
                         self._version += 1
                     excess = cur[target:]
                     if excess:
                         for r in excess:
                             recs.remove(r)
-                        self._begin_drain(excess)
+                        self._begin_drain(excess, app, dep)
                         self._version += 1
                 if app not in desired and not self._replicas.get(app):
                     self._replicas.pop(app, None)
@@ -312,7 +314,7 @@ class ServeController:
                                 app, dep_name, len(old))
                             for r in old:
                                 recs.remove(r)
-                            self._begin_drain(old)
+                            self._begin_drain(old, app, dep_name)
                             self._version += 1
                 else:
                     self._start_fails.pop(fail_key, None)
@@ -370,7 +372,7 @@ class ServeController:
                     if victims:
                         for r in victims:
                             recs.remove(r)
-                        self._begin_drain(victims)
+                        self._begin_drain(victims, app, dep)
                         self._version += 1
                         moved += len(victims)
         if moved:
@@ -379,16 +381,45 @@ class ServeController:
                 "(graceful: in-flight requests finish; replacements "
                 "starting on survivors)", moved, sorted(draining))
 
-    def _begin_drain(self, recs):
+    def _begin_drain(self, recs, app: str = None, dep: str = None):
         """Queue replicas for graceful stop (caller holds the lock): they are
         already off the router; killed once idle or past their deadline (the
-        grace recorded when the replica started)."""
+        grace recorded when the replica started).  Their prefix-digest KV
+        rows are deleted up front — a draining replica must stop attracting
+        cache-affinity traffic immediately (routers also drop rows whose
+        replica left the live set, so this is belt and braces for the
+        digest-TTL window) — and AGAIN after the kill (the replica's publish
+        thread keeps running through the drain and would otherwise re-create
+        the row as its last in-flight requests change the depth, orphaning
+        one KV row per drained replica forever)."""
         now = time.monotonic()
+        keys = {}
+        if app is not None and dep is not None:
+            from ray_tpu.serve.handle import digest_kv_key
+
+            keys = {id(r): digest_kv_key(app, dep, r["h"]._actor_id.hex())
+                    for r in recs}
         # third field: consecutive idle probes — a replica is only killed
         # after TWO idle reads ≥1 tick apart, so a request routed just before
-        # the flip has a tick to land and show up in queue_len
+        # the flip has a tick to land and show up in queue_len; fourth: the
+        # digest KV key to clean up once the replica is dead
         self._draining.extend(
-            [r["h"], now + float(r.get("grace", 20.0)), 0] for r in recs)
+            [r["h"], now + float(r.get("grace", 20.0)), 0, keys.get(id(r))]
+            for r in recs)
+        self._del_digest_rows(keys.values())
+
+    @staticmethod
+    def _del_digest_rows(keys):
+        try:
+            from ray_tpu._private.worker import get_global_worker
+
+            gcs = get_global_worker().gcs
+            for key in keys:
+                if key:
+                    gcs.call("KVDel", {"key": key},
+                             timeout=2, retry_deadline=0.0)
+        except Exception:  # noqa: BLE001 — cleanup is best-effort
+            pass
 
     def _drain_step(self):
         """One pass over draining replicas: kill the idle and the overdue.
@@ -410,8 +441,9 @@ class ServeController:
                 probes[id(entry)] = None
         gather_deadline = time.monotonic() + 2.0
         finished = []
+        killed_keys = []
         for entry in items:
-            h, deadline, idle_streak = entry
+            h, deadline, idle_streak = entry[0], entry[1], entry[2]
             kill_it = time.monotonic() > deadline
             if not kill_it:
                 ref = probes[id(entry)]
@@ -430,7 +462,11 @@ class ServeController:
                 except Exception:  # noqa: BLE001
                     pass
                 finished.append(id(entry))
+                killed_keys.append(entry[3] if len(entry) > 3 else None)
         if finished:
+            # the replicas are dead: their publish threads can no longer
+            # resurrect the digest rows, so this delete is final
+            self._del_digest_rows(killed_keys)
             with self._lock:
                 self._draining = [x for x in self._draining
                                   if id(x) not in finished]

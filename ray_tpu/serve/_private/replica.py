@@ -39,10 +39,19 @@ class ServeReplica:
         else:
             self._callable = target
         self._deployment = deployment_name
+        self._app = app_name
         self._max_ongoing = max_ongoing_requests
         self._ongoing = 0
         self._total = 0
         self._lock = threading.Lock()
+        # cache-aware routing: a callable exposing prefix_digest() gets its
+        # digest published to the GCS KV (compact, throttled, versioned) so
+        # DeploymentHandle routers can route prompts to the replica already
+        # holding the longest KV prefix chain (serve/handle.py)
+        self._digest_stop = threading.Event()
+        if hasattr(self._callable, "prefix_digest"):
+            threading.Thread(target=self._publish_digest_loop, daemon=True,
+                             name="serve-prefix-digest").start()
         # built-in per-deployment request metrics (latency histogram +
         # monotonic request counter; rate() of the counter is QPS) — bound
         # once here, recorded per request at constant cost
@@ -56,6 +65,52 @@ class ServeReplica:
     def _record_request(self, t0: float):
         self._latency_metric.observe(time.perf_counter() - t0)
         self._requests_metric.inc()
+
+    def _publish_digest_loop(self):
+        """Throttled, versioned digest publication.  The version bumps only
+        when the digest content changes; an unchanged digest (same chains,
+        same depth) costs no KV write.  Best-effort end to end: a GCS blip
+        or a teardown-time race must never take the replica down."""
+        import json
+
+        from ray_tpu._private.config import global_config
+        from ray_tpu.serve.handle import digest_kv_key
+
+        try:
+            import ray_tpu
+
+            actor_id = ray_tpu.get_runtime_context().actor_id
+            if actor_id is None:
+                return  # local mode: no router reads the KV either
+            key = digest_kv_key(self._app, self._deployment, actor_id.hex())
+            from ray_tpu._private.worker import get_global_worker
+
+            gcs = get_global_worker().gcs
+        except Exception:  # noqa: BLE001
+            return
+        version = 0
+        last_fp = None
+        interval = global_config().serve_prefix_digest_interval_s
+        while not self._digest_stop.wait(interval):
+            try:
+                digest = self._callable.prefix_digest() or {}
+                fp = (len(digest.get("hashes") or ()),
+                      (digest.get("hashes") or [None])[-1],
+                      tuple(digest.get("models") or ()),
+                      digest.get("qlen"))
+                if fp == last_fp:
+                    continue
+                last_fp = fp
+                version += 1
+                gcs.call("KVPut", {"key": key, "value": json.dumps({
+                    "v": version, "ts": time.time(),
+                    "block_size": digest.get("block_size", 0),
+                    "hashes": list(digest.get("hashes") or ()),
+                    "models": list(digest.get("models") or ()),
+                    "qlen": digest.get("qlen"),
+                })}, timeout=5)
+            except Exception:  # noqa: BLE001 — publication is best-effort
+                continue
 
     def handle_request(self, method_name: str, args: tuple, kwargs: dict):
         t0 = time.perf_counter()

@@ -1,16 +1,92 @@
-"""DeploymentHandle + power-of-two-choices router.
+"""DeploymentHandle + cache-aware router over power-of-two-choices.
 
 reference: python/ray/serve/handle.py (DeploymentHandle, DeploymentResponse)
 and _private/request_router/pow_2_router.py:27 — choose_replicas :52 probes
 the queue length of two random replicas and picks the shorter.
+
+Beyond the reference: **cache-aware routing**.  Replicas whose callable
+exposes ``prefix_digest()`` (LLM servers: the paged engine's chain-hash
+set, loaded LoRA adapter ids, live depth) publish a compact, throttled,
+versioned digest to the GCS KV (serve/_private/replica.py).  The router
+reads all of a deployment's digests (TTL-cached, two KV RPCs per refresh
+window), computes the request prompt's chain hashes with the SAME stable
+hash the engine registers (llm/prefix_hash.py), and routes to the replica
+holding the longest matching prefix chain — composing with LoRA adapter
+affinity (serve/multiplex.py model ids).  Cold prefixes, overloaded
+winners (cached queue length beyond ``serve_prefix_overload_slack`` of the
+field), and digest staleness (rows whose replica left the live set) all
+fall back to pow-2-choices; a drained winner rides the existing
+resubmit-once path, so degradation never drops a request.
+
+Queue-length probes are TTL-cached (``serve_route_probe_ttl_s``) and fed
+by digest rows for free, so steady-state routing costs zero probe RPCs at
+high QPS.
 """
 
 from __future__ import annotations
 
+import json
 import random
 import threading
 import time
-from typing import Any, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private.prefix_hash import (
+    longest_chain_match,
+    prefix_chain_hashes,
+)
+
+# GCS KV namespace for per-replica prefix digests (replica.py publishes,
+# the router and controller cleanup consume)
+DIGEST_KV_PREFIX = "serveprefix:"
+# chain links the router hashes per candidate block size — bounds the
+# route-decision cost on very long prompts (64 blocks x bs>=16 covers
+# >1k-token prefixes, far past typical shared-prefix lengths)
+_MAX_ROUTE_CHAIN = 64
+
+
+def digest_kv_key(app: str, deployment: str, actor_hex: str) -> str:
+    return f"{DIGEST_KV_PREFIX}{app}:{deployment}:{actor_hex}"
+
+
+def _extract_prompt(args: tuple, kwargs: dict):
+    """(prompt_token_ids | None, model_id | None) from a handle call.
+
+    Only token-id prompts are routable — chain hashes are over token ids,
+    and text prompts tokenize inside the replica.  Accepts the LLM serving
+    shapes: ``prompt=[ids]`` kwarg, a request dict carrying ``prompt`` /
+    ``model``, or a leading list-of-ints positional."""
+
+    def _ids(x):
+        if (isinstance(x, (list, tuple)) and x
+                and all(isinstance(t, int) for t in x)):
+            return list(x)
+        return None
+
+    prompt = model = None
+    req = kwargs if "prompt" in kwargs else None
+    if req is None and args:
+        # ONLY the leading positional: scanning further would latch onto
+        # a later int list (stop_token_ids) when the first argument is a
+        # non-list prompt encoding, and route on a meaningless chain
+        a0 = args[0]
+        if isinstance(a0, dict) and "prompt" in a0:
+            req = a0
+        else:
+            prompt = _ids(a0)
+    if req is not None:
+        prompt = _ids(req.get("prompt"))
+        model = req.get("model") or None
+    if model is None:
+        model = kwargs.get("model") or None
+    return prompt, model
+
+
+def _resolve_refs(refs, timeout):
+    """Seam for tests (probe-RPC counting): resolve queue-length refs."""
+    import ray_tpu
+
+    return ray_tpu.get(refs, timeout=timeout)
 
 
 class DeploymentResponse:
@@ -53,6 +129,22 @@ class _Router:
         self._replicas: List[Any] = []
         self._version = -1
         self._lock = threading.Lock()
+        # queue-length cache: actor_hex -> (qlen, monotonic ts); fed by
+        # probe RPCs AND by digest rows (which carry the replica's depth)
+        self._qcache: Dict[str, Tuple[float, float]] = {}
+        # per-replica prefix digests: actor_hex -> {held, block_size,
+        # models, v}; refreshed from the GCS KV at most once per TTL
+        self._digests: Dict[str, dict] = {}
+        self._digest_ts = float("-inf")
+        # probe-RPC accounting (hermetic test seam: the TTL cache must
+        # keep this sub-RPC per request at high QPS)
+        self.probe_rpcs = 0
+        # replicas a caller observed dead (actor_hex -> mark ts): excluded
+        # from routing until the controller's live set catches up.  Without
+        # this, cache affinity is actively harmful under a hard kill — the
+        # dead replica stays the digest winner and every resubmit would
+        # re-route straight back to it.
+        self._dead: Dict[str, float] = {}
 
     def _refresh(self):
         import ray_tpu
@@ -83,26 +175,182 @@ class _Router:
             self._replicas = [ActorHandle(ActorID(h)) for h in ids]
             self._version = version
 
-    def choose_replica(self):
-        """Power of two choices by queue-length probe (pow_2_router.py:52)."""
-        import ray_tpu
-
+    def choose_replica(self, args: tuple = (), kwargs: Optional[dict] = None):
+        """Cache-aware choice with pow-2 fallback: route to the replica
+        holding the longest matching prefix chain for the request's prompt
+        (composing with LoRA adapter affinity), unless the prefix is cold,
+        digests are absent, or the winner is overloaded — then power of
+        two choices by (cached) queue length."""
         self._refresh()
         with self._lock:
             replicas = list(self._replicas)
+            if self._dead:
+                now = time.monotonic()
+                self._dead = {h: ts for h, ts in self._dead.items()
+                              if now - ts < 30.0}
+                live = [r for r in replicas
+                        if r._actor_id.hex() not in self._dead]
+                # all marked dead: the marks are probably stale — routing
+                # to a maybe-dead replica beats failing outright
+                replicas = live or replicas
         if len(replicas) == 1:
             return replicas[0]
+        from ray_tpu._private.config import global_config
+
+        cfg = global_config()
+        if cfg.serve_prefix_routing_enabled:
+            chosen = self._prefix_choice(replicas, args, kwargs or {}, cfg)
+            if chosen is not None:
+                return chosen
+        return self._pow2_choice(replicas, cfg)
+
+    # -- cache-aware path ---------------------------------------------------
+
+    def _fetch_digests(self, cfg):
+        """TTL-refresh the deployment's digest rows from the GCS KV (one
+        KVKeys + one KVMultiGet per window, amortized over every request
+        routed in between).  Row qlen feeds the probe cache for free."""
+        now = time.monotonic()
+        if now - self._digest_ts < cfg.serve_prefix_digest_ttl_s:
+            return
+        self._digest_ts = now
+        try:
+            from ray_tpu._private.worker import get_global_worker
+
+            gcs = get_global_worker().gcs
+            prefix = f"{DIGEST_KV_PREFIX}{self._app}:{self._dep}:"
+            keys = gcs.call("KVKeys", {"prefix": prefix}, timeout=2) or []
+            blobs = gcs.call("KVMultiGet", {"keys": keys}, timeout=2) or {}
+            rows: Dict[str, dict] = {}
+            for key, blob in blobs.items():
+                try:
+                    d = json.loads(blob)
+                    hex_ = key[len(prefix):]
+                    rows[hex_] = {
+                        "held": set(d.get("hashes") or ()),
+                        "block_size": int(d.get("block_size") or 0),
+                        "models": set(d.get("models") or ()),
+                        "v": d.get("v", 0),
+                    }
+                    if d.get("qlen") is not None:
+                        with self._lock:
+                            self._qcache[hex_] = (float(d["qlen"]), now)
+                except Exception:  # noqa: BLE001 — one bad row, not all
+                    continue
+            self._digests = rows
+        except Exception:  # noqa: BLE001 — no GCS (local mode): stay pow-2
+            self._digests = {}
+
+    def _prefix_choice(self, replicas, args, kwargs, cfg):
+        """The longest-matching-prefix winner, or None for pow-2 fallback.
+        Stale digest rows (replicas no longer in the live set) are ignored
+        — the live set is the controller's, so a drained winner can't be
+        chosen from a stale row."""
+        prompt, model = _extract_prompt(args, kwargs)
+        if prompt is None and model is None:
+            return None
+        self._fetch_digests(cfg)
+        if not self._digests:
+            return None
+        by_hex = {r._actor_id.hex(): r for r in replicas}
+        chains: Dict[int, list] = {}  # block_size -> request chain hashes
+        best_key = (False, 0)
+        best_hex = None
+        for hex_, row in self._digests.items():
+            if hex_ not in by_hex:
+                continue  # stale digest: replica drained or replaced
+            matched = 0
+            if prompt is not None and row["block_size"] > 0:
+                bs = row["block_size"]
+                chain = chains.get(bs)
+                if chain is None:
+                    chain = chains[bs] = prefix_chain_hashes(
+                        prompt, bs, limit=_MAX_ROUTE_CHAIN)
+                matched = longest_chain_match(chain, row["held"])
+            has_model = bool(model) and model in row["models"]
+            # adapter affinity dominates (a cold adapter costs a merge +
+            # compile); prefix length breaks ties
+            key = (has_model, matched)
+            if key > best_key:
+                best_key, best_hex = key, hex_
+        if best_hex is None or best_key == (False, 0):
+            return None  # cold prefix (and no adapter affinity)
+        # overload guard: a cache winner far deeper than the field's
+        # shortest known queue loses its affinity claim.  Freshness horizon
+        # is a full digest window + probe TTL: in the zero-RPC steady state
+        # the qcache is refreshed only by the digest fetch (every
+        # serve_prefix_digest_ttl_s), so gating on the probe TTL alone
+        # would leave the guard inert most of each window — exactly the
+        # affinity hot spot it exists to prevent
+        horizon = cfg.serve_prefix_digest_ttl_s + cfg.serve_route_probe_ttl_s
+        with self._lock:
+            known = {h: q for h, (q, ts) in self._qcache.items()
+                     if h in by_hex and time.monotonic() - ts < horizon}
+        if known:
+            floor = min(known.values())
+            if known.get(best_hex, floor) > floor + \
+                    cfg.serve_prefix_overload_slack:
+                return None
+        return by_hex[best_hex]
+
+    # -- pow-2 fallback -----------------------------------------------------
+
+    def _qlen_pair(self, a, b, cfg):
+        """Queue lengths for the two candidates, probing only the ones
+        whose cached value is older than the TTL (both fresh -> zero
+        RPCs)."""
+        now = time.monotonic()
+        ttl = cfg.serve_route_probe_ttl_s
+        out = {}
+        stale = []
+        with self._lock:
+            for r in (a, b):
+                hex_ = r._actor_id.hex()
+                got = self._qcache.get(hex_)
+                if got is not None and now - got[1] < ttl:
+                    out[hex_] = got[0]
+                else:
+                    stale.append(r)
+        if stale:
+            refs = []
+            for r in stale:
+                refs.append(r.queue_len.remote())
+                self.probe_rpcs += 1
+            vals = _resolve_refs(refs, timeout=5)
+            with self._lock:
+                for r, q in zip(stale, vals):
+                    hex_ = r._actor_id.hex()
+                    out[hex_] = q
+                    self._qcache[hex_] = (float(q), now)
+        return out[a._actor_id.hex()], out[b._actor_id.hex()]
+
+    def _pow2_choice(self, replicas, cfg):
+        """Power of two choices by queue length (pow_2_router.py:52), over
+        the TTL probe cache."""
         a, b = random.sample(replicas, 2)
         try:
-            qa, qb = ray_tpu.get([a.queue_len.remote(), b.queue_len.remote()],
-                                 timeout=5)
+            qa, qb = self._qlen_pair(a, b, cfg)
         except Exception:  # noqa: BLE001
             return a
         return a if qa <= qb else b
 
+    def mark_dead(self, replica):
+        """A caller saw this replica die mid-call: exclude it from routing
+        until the controller's live set reflects the death (the marks
+        self-expire, so a restarted actor id isn't shunned forever)."""
+        try:
+            hex_ = replica._actor_id.hex()
+        except AttributeError:
+            return
+        with self._lock:
+            self._dead[hex_] = time.monotonic()
+            self._qcache.pop(hex_, None)
+
     def invalidate(self):
         with self._lock:
             self._version = -1
+            self._qcache.clear()
+        self._digest_ts = float("-inf")
 
 
 class DeploymentResponseGenerator:
@@ -163,9 +411,12 @@ class DeploymentHandle:
     def remote(self, *args, **kwargs):
         last_err = None
         for _ in range(3):
-            replica = self._router.choose_replica()
+            replica = self._router.choose_replica(args, kwargs)
             try:
-                def resubmit(h=self, a=args, kw=kwargs):
+                def resubmit(h=self, a=args, kw=kwargs, r=replica):
+                    # the caller observed r dead: shun it so the re-route
+                    # (and cache affinity in particular) picks a survivor
+                    h._router.mark_dead(r)
                     h._router.invalidate()
                     return h.remote(*a, **kw)
 
@@ -178,6 +429,7 @@ class DeploymentHandle:
                 return DeploymentResponse(ref, resubmit)
             except Exception as e:  # noqa: BLE001
                 last_err = e
+                self._router.mark_dead(replica)
                 self._router.invalidate()
         raise last_err
 

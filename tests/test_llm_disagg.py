@@ -1,0 +1,547 @@
+"""Disaggregated prefill/decode serving + cluster-wide tiered prefix cache.
+
+ISSUE 7 acceptance: token parity through the export/import handoff, the
+host-RAM tier ladder reviving evicted chains, the disaggregated serve app
+end to end (object and channel transports), per-replica digest publication
+to the GCS KV, cache-aware routing against it, and the chaos guarantees —
+digest staleness / a killed winner degrade to pow-2 with zero dropped
+requests.
+"""
+
+import json
+import time
+
+import jax
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.llm import (
+    DecodeServer,
+    GenerationConfig,
+    LLMConfig,
+    LLMServer,
+    PagedJaxLLMEngine,
+    PrefillServer,
+    build_disagg_llm_deployment,
+)
+from ray_tpu.models.llama import LlamaConfig, init_params
+
+pytestmark = pytest.mark.slow  # module lane: see pytest.ini
+
+
+@pytest.fixture(scope="module")
+def tiny_cfg():
+    # fp32: token identity across the handoff must not hinge on rounding
+    return LlamaConfig.tiny(compute_dtype=jax.numpy.float32)
+
+
+@pytest.fixture(scope="module")
+def tiny_params(tiny_cfg):
+    return init_params(tiny_cfg, jax.random.PRNGKey(0))
+
+
+def _lcfg(tiny_cfg, **kw):
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("prefill_chunk", 16)
+    kw.setdefault("decode_chunk", 4)
+    kw.setdefault("num_blocks", 24)
+    return LLMConfig(model_config=tiny_cfg, **kw)
+
+
+def _prompt(seed, n):
+    return list(np.random.RandomState(seed).randint(1, 255, size=n))
+
+
+# ---------------------------------------------------------------------------
+# engine-level handoff
+# ---------------------------------------------------------------------------
+
+
+def _drive_prefill(eng, rid):
+    deadline = time.monotonic() + 120
+    while True:
+        eng.step(decode=False)
+        with eng._lock:
+            r = eng._requests.get(rid)
+            ready = (r is not None and r.slot >= 0
+                     and r.prefill_pos >= len(r.prompt) and r.out_tokens)
+        if ready:
+            return
+        assert time.monotonic() < deadline, "prefill never completed"
+
+
+def test_export_import_token_parity(tiny_cfg, tiny_params):
+    """Prefill on engine A, hand the KV to engine B, decode there: the
+    token stream must be identical to the monolithic engine's (the
+    handoff is data movement, not math)."""
+    gen = GenerationConfig(max_new_tokens=6)
+    mono = PagedJaxLLMEngine(_lcfg(tiny_cfg), params=tiny_params)
+    prompt = _prompt(7, 37)
+    want = mono.generate([prompt], gen)[0]
+
+    pre = PagedJaxLLMEngine(_lcfg(tiny_cfg), params=tiny_params)
+    dec = PagedJaxLLMEngine(_lcfg(tiny_cfg), params=tiny_params)
+    rid = pre.add_request(prompt, gen)
+    _drive_prefill(pre, rid)
+    h = pre.export_request(rid)
+    assert h["first_token"] == want[0]
+    assert h["k"].shape[1] == 5  # ceil(37/8) blocks, prompt-exact
+    res = dec.import_request(h["prompt"], h["first_token"], h["k"], h["v"],
+                             gen)
+    assert res is not None and res["emitted"] == [want[0]]
+    rid2 = res["request_id"]
+    toks = list(res["emitted"])
+    for _ in range(64):
+        toks.extend(dec.step().get(rid2, []))
+        with dec._lock:
+            alive = rid2 in dec._requests
+        if not alive:
+            break
+    toks.extend(dec.flush().get(rid2, []))
+    assert toks == want
+    # the prefill replica kept the prompt's chain: a repeat prompt matches
+    shared, matched = pre.blocks.match_prefix(prompt + [1])
+    assert matched == 32  # 4 full blocks revived from the freed request
+    pre.blocks.release(shared)
+
+
+def test_export_keeps_prefix_chain_and_import_registers(tiny_cfg,
+                                                        tiny_params):
+    gen = GenerationConfig(max_new_tokens=4)
+    pre = PagedJaxLLMEngine(_lcfg(tiny_cfg), params=tiny_params)
+    dec = PagedJaxLLMEngine(_lcfg(tiny_cfg), params=tiny_params)
+    prompt = _prompt(3, 33)
+    rid = pre.add_request(prompt, gen)
+    _drive_prefill(pre, rid)
+    h = pre.export_request(rid)
+    res = dec.import_request(h["prompt"], h["first_token"], h["k"], h["v"],
+                             gen)
+    assert res is not None
+    # both sides now hold the prompt's chain (cluster-wide sharing)
+    for eng in (pre, dec):
+        digest = eng.prefix_digest()
+        assert digest["block_size"] == 8
+        assert len(digest["hashes"]) >= 4
+
+
+def test_import_without_capacity_returns_none(tiny_cfg, tiny_params):
+    gen = GenerationConfig(max_new_tokens=4)
+    pre = PagedJaxLLMEngine(_lcfg(tiny_cfg), params=tiny_params)
+    # decode pool too small for the handoff's blocks
+    dec = PagedJaxLLMEngine(_lcfg(tiny_cfg, num_blocks=4),
+                            params=tiny_params)
+    prompt = _prompt(5, 33)
+    rid = pre.add_request(prompt, gen)
+    _drive_prefill(pre, rid)
+    h = pre.export_request(rid)
+    assert h["k"].shape[1] == 5  # needs 5 blocks; pool has 3 usable
+    assert dec.import_request(h["prompt"], h["first_token"], h["k"],
+                              h["v"], gen) is None
+
+
+def test_host_tier_revive_token_parity(tiny_cfg, tiny_params):
+    """Chains evicted from the HBM pool demote to host RAM and revive on a
+    later match with identical tokens (the tier ladder is lossless)."""
+    from ray_tpu._private import runtime_metrics as rm
+
+    gen = GenerationConfig(max_new_tokens=4)
+    eng = PagedJaxLLMEngine(_lcfg(tiny_cfg, max_batch_size=2,
+                                  num_blocks=13, max_seq_len=128),
+                            params=tiny_params)
+    pa = _prompt(1, 33)
+    want = eng.generate([pa], gen)[0]
+    for s in range(2, 7):  # churn the 12-block pool
+        eng.generate([_prompt(s, 33)], gen)
+    assert len(eng._host_cache) > 0, "no demotions under pool churn"
+    before = rm.prefix_cache_snapshot()
+    got = eng.generate([pa], gen)[0]
+    after = rm.prefix_cache_snapshot()
+    assert got == want
+    assert after["hits"].get("host", 0) > before["hits"].get("host", 0)
+
+
+def test_plasma_tier_spill_and_revive(tiny_cfg, tiny_params,
+                                      ray_start_regular):
+    """With the plasma tier enabled, host-tier evictions spill to the
+    object store and still revive with token parity."""
+    gen = GenerationConfig(max_new_tokens=4)
+    # host tier sized for ~2 blocks -> churn pushes chains to plasma
+    layer_bytes = None
+    eng = PagedJaxLLMEngine(
+        _lcfg(tiny_cfg, max_batch_size=2, num_blocks=13, max_seq_len=128,
+              host_kv_cache_bytes=20_000, plasma_kv_cache_blocks=64),
+        params=tiny_params)
+    assert layer_bytes is None  # silence lints; sizing is config-driven
+    pa = _prompt(1, 33)
+    want = eng.generate([pa], gen)[0]
+    for s in range(2, 8):
+        eng.generate([_prompt(s, 33)], gen)
+    assert len(eng._host_cache._plasma) > 0, "nothing spilled to plasma"
+    got = eng.generate([pa], gen)[0]
+    assert got == want
+    from ray_tpu._private import runtime_metrics as rm
+
+    snap = rm.prefix_cache_snapshot()
+    assert snap["hits"].get("plasma", 0) + snap["hits"].get("host", 0) > 0
+
+
+def test_import_seed_prepends_before_raced_loop_tokens(tiny_cfg,
+                                                       tiny_params):
+    """Between ``import_request`` releasing the engine lock and the waiter
+    seeding, the server's engine loop can step the engine and buffer the
+    request's SECOND token first — the seed must prepend the
+    prefill-sampled first token, not append it after (regression: appended
+    seeds delivered [t2, t1, ...] to the stream)."""
+    decode = DecodeServer(_lcfg(tiny_cfg), tiny_params)
+    try:
+        pre = PagedJaxLLMEngine(_lcfg(tiny_cfg), params=tiny_params)
+        prompt = _prompt(11, 21)
+        gen = GenerationConfig(max_new_tokens=4)
+        rid = pre.add_request(prompt, gen)
+        _drive_prefill(pre, rid)
+        h = pre.export_request(rid)
+        # simulate the raced loop: a later token already sits in the
+        # waiter buffer the import is about to seed (request ids are
+        # allocated sequentially, so the key is predictable)
+        wkey_next = (None, 0, decode._engine._req_counter + 1)
+        with decode._cv:
+            decode._waiters[wkey_next] = [999]
+        wkey = decode._import_handoff(h, gen)
+        assert wkey == wkey_next
+        toks = decode._wait_done(wkey)
+        assert toks[:2] == [h["first_token"], 999], toks
+    finally:
+        decode.shutdown()
+
+
+def test_pool_full_admission_retry_books_no_phantom_metrics(tiny_cfg,
+                                                            tiny_params):
+    """A head-of-line request that can't admit re-runs the prefix match
+    every engine step; hit/miss metrics must be booked once per ADMISSION,
+    not once per attempt (regression: metric counters inflated by
+    thousands under allocation pressure, corrupting the hit rate)."""
+    from ray_tpu._private import runtime_metrics as rm
+
+    eng = PagedJaxLLMEngine(_lcfg(tiny_cfg, max_batch_size=2, num_blocks=12),
+                            params=tiny_params)
+    # request 1 (49-token prompt + decode growth) holds 7-8 of the 11
+    # usable blocks, so request 2's 6-block reserve can't admit until it
+    # finishes
+    r1 = eng.add_request(_prompt(1, 49), GenerationConfig(max_new_tokens=14))
+    for _ in range(32):
+        eng.step()
+        with eng._lock:
+            req = eng._requests.get(r1)
+            if req is not None and req.prefill_pos >= 49:
+                break
+    eng.add_request(_prompt(2, 33), GenerationConfig(max_new_tokens=4))
+    before = rm.prefix_cache_snapshot()
+    retries = 0
+    while True:
+        with eng._lock:
+            blocked = bool(eng._pending) and r1 in eng._requests
+        if not blocked:
+            break
+        eng.step()  # each step retries (and fails) admission of request 2
+        retries += 1
+        assert retries < 200, "request 1 never finished"
+    mid = rm.prefix_cache_snapshot()
+    assert retries > 2, "admission was never under pressure"
+    assert mid["misses"] == before["misses"], (
+        f"{mid['misses'] - before['misses']} phantom misses booked over "
+        f"{retries} blocked admission retries")
+    # drain: request 2 admits once -> its misses book exactly once
+    for _ in range(200):
+        eng.step()
+        if not eng.has_work():
+            break
+    after = rm.prefix_cache_snapshot()
+    assert after["misses"] == before["misses"] + 4  # (33-1)//8 cold blocks
+
+
+# ---------------------------------------------------------------------------
+# the disaggregated serve app
+# ---------------------------------------------------------------------------
+
+
+def test_disagg_app_local_mode_parity_and_stream(tiny_cfg, tiny_params):
+    lcfg = _lcfg(tiny_cfg)
+    app = build_disagg_llm_deployment(lcfg, tiny_params, name="dlm")
+    h = serve.run(app, name="disagg-local", _local_testing_mode=True)
+    try:
+        mono = LLMServer(lcfg, tiny_params)
+        try:
+            prompt = _prompt(3, 21)
+            want = mono.generate(prompt, max_new_tokens=6)
+            got = h.generate.remote(
+                prompt=prompt, max_new_tokens=6).result(timeout_s=120)
+            assert got == want
+            chunks = list(h.options(stream=True).generate_stream.remote(
+                prompt=prompt, max_new_tokens=6))
+            assert [t for c in chunks for t in c] == want
+            # dict entry point (proxy-compatible)
+            out = h.remote({"prompt": prompt,
+                            "max_new_tokens": 6}).result(timeout_s=120)
+            assert out["tokens"] == want
+        finally:
+            mono.shutdown()
+    finally:
+        serve.delete("disagg-local")
+
+
+def test_disagg_recompute_fallback_zero_drop(tiny_cfg, tiny_params):
+    """A degraded handoff (no KV) must still serve the request — the
+    decode stage recomputes.  This is the zero-drop path the chaos
+    acceptance leans on."""
+    lcfg = _lcfg(tiny_cfg)
+    decode = DecodeServer(lcfg, tiny_params)
+    try:
+        prompt = _prompt(9, 21)
+        mono = LLMServer(lcfg, tiny_params)
+        try:
+            want = mono.generate(prompt, max_new_tokens=5)
+        finally:
+            mono.shutdown()
+        degraded = {"prompt": prompt, "first_token": None, "k": None,
+                    "v": None, "block_size": lcfg.block_size}
+        got = decode.decode_from_handoff(degraded, max_new_tokens=5)
+        assert got == want
+    finally:
+        decode.shutdown()
+
+
+def test_mismatched_stage_configs_fall_back_to_recompute(tiny_cfg,
+                                                         tiny_params):
+    """Per-stage config overrides can give prefill and decode different
+    block sizes; the shape-mismatched handoff must degrade to decode-side
+    recompute, not error the request (regression: import_request's
+    ValueError propagated uncaught and failed 100% of requests)."""
+    gen_kw = dict(max_new_tokens=5)
+    prompt = _prompt(21, 21)
+    mono = LLMServer(_lcfg(tiny_cfg), tiny_params)
+    try:
+        want = mono.generate(prompt, **gen_kw)
+    finally:
+        mono.shutdown()
+    pre = PrefillServer(_lcfg(tiny_cfg), tiny_params)          # bs=8
+    decode = DecodeServer(_lcfg(tiny_cfg, block_size=16), tiny_params)
+    try:
+        h = pre.prefill(prompt, **gen_kw)
+        assert h["k"] is not None and h["block_size"] == 8
+        got = decode.decode_from_handoff(h, **gen_kw)
+        assert got == want  # greedy tokens are block-size independent
+    finally:
+        decode.shutdown()
+
+
+def test_prefill_server_queue_depth_and_digest(tiny_cfg, tiny_params):
+    pre = PrefillServer(_lcfg(tiny_cfg), tiny_params)
+    assert pre.queue_depth() == 0
+    h = pre.prefill(_prompt(2, 21), max_new_tokens=8)
+    assert h["first_token"] is not None and h["k"] is not None
+    assert pre.queue_depth() == 0  # returned to idle
+    d = pre.prefix_digest()
+    assert d["block_size"] == 8 and len(d["hashes"]) >= 2
+    assert d["qlen"] == 0
+
+
+@pytest.mark.timeout(300)
+def test_disagg_channel_transport_cluster(tiny_cfg, tiny_params,
+                                          ray_start_regular):
+    """KV handoff over the device-tensor channel plane between real
+    replica actors (store communicator off-TPU; ICI p2p on real slices),
+    int8-quantized — the wire carries codes+scales, and the decode output
+    still matches greedy decode from the full-precision handoff (fp32
+    tiny model: quantization error does not flip the tiny argmax here)."""
+    lcfg = _lcfg(tiny_cfg)
+    app = build_disagg_llm_deployment(
+        lcfg, tiny_params, name="dlm-chan", transport="channel")
+    h = serve.run(app, name="disagg-chan")
+    try:
+        prompt = _prompt(3, 21)
+        mono = LLMServer(lcfg, tiny_params)
+        try:
+            want = mono.generate(prompt, max_new_tokens=5)
+        finally:
+            mono.shutdown()
+        got = h.generate.remote(
+            prompt=prompt, max_new_tokens=5).result(timeout_s=240)
+        assert got == want
+    finally:
+        serve.delete("disagg-chan")
+        serve.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# digest publication + cache-aware routing + chaos (cluster)
+# ---------------------------------------------------------------------------
+
+
+def _digest_echo_cls():
+    """Lightweight deployment with a controllable prefix digest — the
+    router mechanics don't require a real engine.  Built inside a factory
+    so cloudpickle ships the class BY VALUE to replica workers (a
+    module-level test class would pickle by reference to a module the
+    workers can't import)."""
+
+    class DigestEcho:
+        def __init__(self, hashes, block_size=8, marker="m"):
+            self._hashes = list(hashes)
+            self._marker = marker
+
+        def prefix_digest(self):
+            return {"block_size": 8, "hashes": list(self._hashes),
+                    "models": [], "qlen": 0}
+
+        def __call__(self, request):
+            return self._marker
+
+        def check_health(self):
+            return True
+
+    return DigestEcho
+
+
+def _wait_digest_rows(app, dep, n, timeout=30):
+    from ray_tpu._private.worker import get_global_worker
+    from ray_tpu.serve.handle import DIGEST_KV_PREFIX
+
+    gcs = get_global_worker().gcs
+    prefix = f"{DIGEST_KV_PREFIX}{app}:{dep}:"
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        keys = gcs.call("KVKeys", {"prefix": prefix}, timeout=5) or []
+        if len(keys) >= n:
+            return keys
+        time.sleep(0.25)
+    raise AssertionError(f"digest rows never appeared for {app}/{dep}")
+
+
+def _claiming_echo_cls():
+    """Two-replica deployment where exactly ONE replica (first to claim a
+    KV flag atomically) publishes the warm chain — so cache-aware routing
+    has a distinguishable winner.  Class built in a factory: cloudpickle
+    ships it by value to replica workers."""
+
+    class ClaimingEcho:
+        def __init__(self, hashes, claim_key):
+            from ray_tpu._private.worker import get_global_worker
+
+            won = get_global_worker().gcs.call(
+                "KVPut", {"key": claim_key, "value": "1",
+                          "overwrite": False}, timeout=10)
+            self._holder = bool(won)
+            self._hashes = list(hashes) if self._holder else []
+
+        def prefix_digest(self):
+            return {"block_size": 8, "hashes": list(self._hashes),
+                    "models": [], "qlen": 0}
+
+        def __call__(self, request):
+            return "holder" if self._holder else "other"
+
+        def check_health(self):
+            return True
+
+    return ClaimingEcho
+
+
+@pytest.mark.timeout(300)
+def test_digest_published_and_cache_aware_routing(ray_start_regular):
+    """Replicas publish digests to the GCS KV (throttled, versioned); a
+    fresh handle routes a warm prompt to the replica holding the chain
+    and a cold prompt across the whole pool (pow-2)."""
+    from ray_tpu._private.prefix_hash import prefix_chain_hashes
+    from ray_tpu.serve.handle import DIGEST_KV_PREFIX
+
+    warm = list(range(64))
+    chain = prefix_chain_hashes(warm, 8)
+    dep = serve.deployment(_claiming_echo_cls(), name="echo",
+                           num_replicas=2)
+    app = dep.bind(chain, "digest-claim-1")
+    try:
+        h = serve.run(app, name="digest-app")
+        keys = _wait_digest_rows("digest-app", "echo", 2)
+        from ray_tpu._private.worker import get_global_worker
+
+        rows = [json.loads(get_global_worker().gcs.call(
+            "KVGet", {"key": k}, timeout=5)) for k in keys]
+        assert all(r["block_size"] == 8 and r["v"] >= 1 for r in rows)
+        held = [set(r["hashes"]) for r in rows]
+        assert set(chain) in held, "holder never published its chain"
+        assert set() in held, "non-holder published a chain it lacks"
+        # the warm prompt routes to the holder EVERY time (no pow-2
+        # coin-flips), proving digest-driven affinity end to end
+        h._router._digest_ts = float("-inf")
+        for _ in range(8):
+            got = h.remote({"prompt": warm}).result(timeout_s=60)
+            assert got == "holder"
+        assert h._router._digests, "router fetched no digests from the KV"
+        # teardown cleans the KV: the controller deletes digest rows at
+        # drain start AND after the kill (the replica's publish thread
+        # could re-create the row between the two — regression: one
+        # orphaned serveprefix:* row per drained replica, forever)
+        serve.delete("digest-app")
+        gcs = get_global_worker().gcs
+        deadline = time.monotonic() + 60
+        left = keys
+        while time.monotonic() < deadline:
+            left = gcs.call("KVKeys", {
+                "prefix": f"{DIGEST_KV_PREFIX}digest-app:"}, timeout=5) or []
+            if not left:
+                break
+            time.sleep(0.5)
+        assert not left, f"digest rows orphaned after delete: {left}"
+    finally:
+        serve.delete("digest-app")
+        serve.shutdown()
+
+
+@pytest.mark.timeout(300)
+def test_chaos_stale_digest_and_dead_winner_zero_drops(ray_start_regular):
+    """Chaos acceptance: a stale digest row pointing at a vanished replica
+    and a killed cache-winner must both degrade to pow-2 with ZERO dropped
+    requests (the handle's resubmit-once path reroutes)."""
+    from ray_tpu._private.prefix_hash import prefix_chain_hashes
+    from ray_tpu._private.worker import get_global_worker
+    from ray_tpu.serve.handle import digest_kv_key
+
+    warm = list(range(64))
+    chain = prefix_chain_hashes(warm, 8)
+    dep = serve.deployment(_digest_echo_cls(), name="echo2",
+                           num_replicas=2)
+    app = dep.bind(chain, marker="ok")
+    try:
+        h = serve.run(app, name="chaos-app")
+        _wait_digest_rows("chaos-app", "echo2", 2)
+        gcs = get_global_worker().gcs
+        # (1) staleness: plant a digest row for a nonexistent replica that
+        # holds the longest chain — the router must ignore it (not in the
+        # live set) and still serve every request
+        fake_key = digest_kv_key("chaos-app", "echo2", "f" * 8)
+        gcs.call("KVPut", {"key": fake_key, "value": json.dumps({
+            "v": 99, "ts": time.time(), "block_size": 8,
+            "hashes": chain, "models": [], "qlen": 0})}, timeout=5)
+        h._router._digest_ts = float("-inf")
+        for _ in range(10):
+            assert h.remote({"prompt": warm}).result(timeout_s=60) == "ok"
+        # (2) dead winner: kill the replica the router currently prefers,
+        # keep the stale digest around, and hammer it — resubmission +
+        # dead-marking + pow-2 fallback must keep every request alive
+        victim = h._router.choose_replica((), {"prompt": warm})
+        ray_tpu.kill(victim)
+        failures = 0
+        for _ in range(20):
+            try:
+                got = h.remote({"prompt": warm}).result(timeout_s=60)
+                assert got == "ok"
+            except Exception:  # noqa: BLE001
+                failures += 1
+        assert failures == 0, f"{failures}/20 requests dropped"
+    finally:
+        serve.delete("chaos-app")
+        serve.shutdown()

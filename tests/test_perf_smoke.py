@@ -31,6 +31,61 @@ def test_ray_perf_fast_mode():
     assert all(v > 0 for v in by_name.values())
 
 
+def test_cache_aware_route_decision_budget():
+    """Hermetic route-decision cost gate (ISSUE 7): one cache-aware choice
+    — chain-hash the prompt, scan every replica's digest, apply the
+    overload guard, fall through to pow-2 when cold — must stay far below
+    a queue-probe RPC, or routing overhead would eat the TTFT win at high
+    QPS.  Budget is CI-loose (order-of-magnitude guard): 2 ms/decision vs
+    ~50 µs idle-host; no RPCs are permitted at all (counted, not timed)."""
+    import time
+
+    import ray_tpu.serve.handle as H
+    from ray_tpu._private.prefix_hash import prefix_chain_hashes
+
+    class _Id:
+        def __init__(self, h):
+            self._h = h
+
+        def hex(self):
+            return self._h
+
+    class _Rep:
+        def __init__(self, h):
+            self._actor_id = _Id(h)
+
+    router = H._Router("app", "dep")
+    router._refresh = lambda: None
+    router._digest_ts = time.monotonic() + 3600  # digests are warm
+    reps = [_Rep(f"r{i}") for i in range(8)]
+    router._replicas = reps
+    warm_prompt = [(7 * j) % 251 for j in range(512)]
+    bs = 16
+    chain = prefix_chain_hashes(warm_prompt, bs)
+    digests = {}
+    for i, r in enumerate(reps):
+        held = set(chain[: (i * len(chain)) // len(reps)])
+        held.update(range(10_000 + i * 2000, 10_000 + i * 2000 + 1024))
+        digests[r._actor_id.hex()] = {
+            "held": held, "block_size": bs, "models": set(), "v": 1}
+    router._digests = digests
+    now = time.monotonic()
+    router._qcache = {r._actor_id.hex(): (0.0, now + 3600) for r in reps}
+
+    cold_prompt = [13] * 512
+    n = 300
+    t0 = time.perf_counter()
+    for i in range(n):
+        # alternate warm (digest win) and cold (full scan + pow-2 fallback)
+        router.choose_replica((), {"prompt": warm_prompt if i % 2 else
+                                   cold_prompt})
+    per_decision = (time.perf_counter() - t0) / n
+    assert router.probe_rpcs == 0, (
+        f"{router.probe_rpcs} probe RPCs leaked into warm-cache routing")
+    assert per_decision < 0.002, (
+        f"route decision {per_decision * 1e6:.0f}µs exceeds the 2ms budget")
+
+
 def test_lease_reuse_rpc_budget():
     """Counted via the owner-side lease metrics (hermetic — no wall-clock):
     in steady state the reuse path issues ≤1 RequestWorkerLease RPC per

@@ -1,0 +1,457 @@
+"""Tiered prefix cache + cache-aware routing: host-side units (tier-1).
+
+ISSUE 7: the BlockAllocator's two-tier free-set eviction semantics get
+direct coverage (previously only exercised through engine tests), the
+stable chain hash that the engine and router must agree on, the host-RAM
+tier ladder, the router's digest matching / probe-RPC budget, and the new
+metric families' exposure.  Everything here is hermetic: no cluster, no
+jax device work beyond module import.
+"""
+
+import time
+
+import pytest
+
+from ray_tpu._private.prefix_hash import (
+    chain_hash,
+    longest_chain_match,
+    prefix_chain_hashes,
+)
+from ray_tpu.llm.paged import BlockAllocator, BlockManager, HostBlockCache
+
+# ---------------------------------------------------------------------------
+# stable chain hash
+# ---------------------------------------------------------------------------
+
+
+def test_chain_hash_stable_across_processes():
+    """The router compares owner-side chains against replica digests from
+    OTHER processes — the hash must be a fixed function of the tokens, not
+    of interpreter state.  Pinned to a precomputed value: a drift here
+    silently zeroes the cluster-wide cache hit rate."""
+    assert chain_hash(None, [1, 2, 3, 4]) == chain_hash(None, [1, 2, 3, 4])
+    h1 = chain_hash(None, [1, 2, 3, 4])
+    h2 = chain_hash(h1, [5, 6, 7, 8])
+    assert h1 != h2
+    # regression pin (blake2b over the documented encoding)
+    assert h1 == 0x75E57E978130DD97, hex(h1)
+
+
+def test_prefix_chain_hashes_convention():
+    # (len-1)//bs links: the last token is always recomputed
+    assert prefix_chain_hashes([1] * 8, 4) == [
+        chain_hash(None, [1, 1, 1, 1])]
+    assert len(prefix_chain_hashes([1] * 9, 4)) == 2
+    assert prefix_chain_hashes([1, 2], 4) == []
+    assert prefix_chain_hashes([], 4) == []
+    assert len(prefix_chain_hashes(list(range(100)), 4, limit=3)) == 3
+
+
+def test_longest_chain_match_leading_run_only():
+    c = prefix_chain_hashes(list(range(32)), 4)
+    held = set(c[:3]) | {c[5]}  # a gap: link 3 missing
+    assert longest_chain_match(c, held) == 3
+    assert longest_chain_match(c, set()) == 0
+    assert longest_chain_match(c, set(c)) == len(c)
+
+
+def test_block_manager_chain_matches_router_chain():
+    """The BlockAllocator registration and the router-side helper must
+    produce identical hashes for identical prompts (one scheme, two
+    call sites)."""
+    bm = BlockManager(num_blocks=16, block_size=4)
+    prompt = list(range(50, 62))  # 3 full blocks
+    blocks = bm.alloc(3)
+    bm.register(prompt, blocks)
+    chain = prefix_chain_hashes(prompt + [99], 4)
+    assert len(chain) == 3
+    assert all(h in bm.by_hash for h in chain)
+
+
+# ---------------------------------------------------------------------------
+# BlockAllocator two-tier free-set eviction semantics (ISSUE 7 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_alloc_drains_plain_before_cached():
+    """Cached (hash-registered) free blocks are repurposed only after the
+    plain free set is exhausted — prefix-cache entries survive routine
+    allocation churn."""
+    bm = BlockAllocator(num_blocks=9, block_size=4)  # 8 usable
+    prompt = list(range(1, 9))
+    cached = bm.alloc(2)
+    bm.register(prompt, cached)
+    bm.release(cached)
+    assert set(bm.free_cached) == set(cached)
+    # 6 plain blocks remain; allocate exactly those
+    got = bm.alloc(6)
+    assert set(got).isdisjoint(cached), "cached blocks churned too early"
+    assert bm.match_prefix(prompt + [0])[0] == cached  # chain intact
+    bm.release(bm.match_prefix(prompt + [0])[0])  # undo the test ref...
+    bm.release(cached)
+    # now only cached blocks are free: the next alloc must repurpose them
+    got2 = bm.alloc(2)
+    assert set(got2) == set(cached)
+    assert bm.by_hash == {} and not any(
+        b in bm.hash_of for b in cached)
+
+
+def test_match_prefix_revives_freed_but_registered_chain():
+    bm = BlockAllocator(num_blocks=16, block_size=4)
+    prompt = list(range(10, 22))
+    blocks = bm.alloc(3)
+    bm.register(prompt, blocks)
+    bm.release(blocks)  # refcount 0, still registered -> free_cached
+    assert all(bm.ref[b] == 0 for b in blocks)
+    free_before = bm.num_free()
+    ids, n = bm.match_prefix(prompt + [7])
+    assert ids == blocks and n == 12
+    # revived: re-ref'd and REMOVED from the free sets
+    assert all(bm.ref[b] == 1 for b in blocks)
+    assert bm.num_free() == free_before - 3
+    assert not any(b in bm.free_cached or b in bm.free_plain
+                   for b in blocks)
+
+
+def test_stale_hash_entries_purged_on_repurpose():
+    """A repurposed block must drop BOTH directions of its registration
+    (hash_of and by_hash) — a stale by_hash entry would hand a future
+    match a block now holding someone else's KV."""
+    evictions = []
+    bm = BlockAllocator(num_blocks=5, block_size=4,
+                        on_evict=lambda b, h: evictions.append((b, h)))
+    prompt = list(range(30, 38))
+    blocks = bm.alloc(2)
+    bm.register(prompt, blocks)
+    registered_hashes = list(bm.by_hash)
+    bm.release(blocks)
+    taken = bm.alloc(4)  # 4 usable: forces the cached pair to repurpose
+    assert set(blocks) <= set(taken)
+    assert bm.by_hash == {} and bm.hash_of == {}
+    assert bm.match_prefix(prompt + [0]) == ([], 0)
+    # the demotion hook saw each evicted (block, hash) pair exactly once
+    assert sorted(h for _, h in evictions) == sorted(registered_hashes)
+
+
+def test_on_evict_not_fired_for_plain_blocks():
+    fired = []
+    bm = BlockAllocator(num_blocks=8, block_size=4,
+                        on_evict=lambda b, h: fired.append(b))
+    a = bm.alloc(3)
+    bm.release(a)
+    bm.alloc(5)
+    assert fired == []  # nothing was ever registered
+
+
+# ---------------------------------------------------------------------------
+# HostBlockCache (tiers 2+3)
+# ---------------------------------------------------------------------------
+
+
+def _np_block(fill, nbytes=64):
+    import numpy as np
+
+    n = nbytes // 8
+    return (np.full(n, fill, np.float32).reshape(1, n),
+            np.full(n, -fill, np.float32).reshape(1, n))
+
+
+def test_host_cache_lru_byte_cap():
+    hc = HostBlockCache(capacity_bytes=3 * 64)  # room for 3 blocks
+    for i in range(5):
+        k, v = _np_block(i)
+        hc.put(100 + i, k, v)
+    # oldest two evicted (plasma off -> dropped)
+    assert hc.get(100) is None and hc.get(101) is None
+    got = hc.get(104)
+    assert got is not None and got[2] == "host"
+    assert float(got[0][0, 0]) == 4.0
+    # a get refreshes recency: 102 touched, then inserting one more evicts
+    # 103 (the least recently used), not 102
+    assert hc.get(102) is not None
+    hc.put(200, *_np_block(9))
+    assert hc.get(103) is None
+    assert hc.get(102) is not None
+
+
+def test_host_cache_zero_capacity_disabled():
+    hc = HostBlockCache(capacity_bytes=0)
+    hc.put(1, *_np_block(1))
+    assert hc.get(1) is None and len(hc) == 0
+
+
+def test_host_cache_hashes_for_digest():
+    hc = HostBlockCache(capacity_bytes=10 * 64)
+    for i in range(3):
+        hc.put(i, *_np_block(i))
+    assert set(hc.hashes()) == {0, 1, 2}
+
+
+# ---------------------------------------------------------------------------
+# metric families: declared, exposed, silent when idle
+# ---------------------------------------------------------------------------
+
+
+def test_prefix_cache_metric_families_exposed():
+    from ray_tpu._private import runtime_metrics as rm
+    from ray_tpu.util.metrics import collect_local, prometheus_text
+
+    names = {m._name for m in rm.FAMILIES}
+    for want in ("ray_tpu_serve_prefix_cache_hits_total",
+                 "ray_tpu_serve_prefix_cache_misses_total",
+                 "ray_tpu_serve_prefix_cache_evictions_total",
+                 "ray_tpu_kv_handoff_bytes_total",
+                 "ray_tpu_kv_handoff_latency_seconds",
+                 "ray_tpu_serve_disagg_queue_depth"):
+        assert want in names, want
+    rm.add_prefix_cache_hits("hbm", 3)
+    rm.add_prefix_cache_misses(2)
+    rm.add_prefix_cache_evictions("host", 1)
+    rm.record_kv_handoff("object", 1024, 0.01)
+    rm.set_disagg_queue_depth("prefill", 4)
+    text = prometheus_text(collect_local())
+    assert 'ray_tpu_serve_prefix_cache_hits_total{tier="hbm"} 3' in text
+    assert 'ray_tpu_kv_handoff_bytes_total{transport="object"} 1024' in text
+    assert 'ray_tpu_serve_disagg_queue_depth{stage="prefill"} 4' in text
+    snap = rm.prefix_cache_snapshot()
+    assert snap["hits"]["hbm"] >= 3 and snap["misses"] >= 2
+    hs = rm.kv_handoff_snapshot()
+    assert hs["object"]["bytes_total"] >= 1024
+    assert hs["object"]["effective_gbps"] > 0
+
+
+def test_disabled_prefix_caching_records_nothing():
+    """enable_prefix_caching=False must keep the metric surface silent —
+    byte-identical to the pre-tiering runtime (ISSUE acceptance)."""
+    from ray_tpu._private import runtime_metrics as rm
+
+    bm = BlockManager(num_blocks=8, block_size=4, prefix_caching=False)
+    before_h = dict(rm.SERVE_PREFIX_CACHE_HITS._points)
+    before_m = dict(rm.SERVE_PREFIX_CACHE_MISSES._points)
+    blocks = bm.alloc(3)
+    bm.register([1] * 12, blocks)
+    assert bm.match_prefix([1] * 12) == ([], 0)
+    bm.release(blocks)
+    bm.alloc(7)
+    assert dict(rm.SERVE_PREFIX_CACHE_HITS._points) == before_h
+    assert dict(rm.SERVE_PREFIX_CACHE_MISSES._points) == before_m
+
+
+# ---------------------------------------------------------------------------
+# cache-aware router: digest matching + probe-RPC budget (no cluster)
+# ---------------------------------------------------------------------------
+
+
+class _FakeId:
+    def __init__(self, hex_):
+        self._h = hex_
+
+    def hex(self):
+        return self._h
+
+
+class _FakeMethod:
+    def __init__(self, replica):
+        self._replica = replica
+
+    def remote(self):
+        self._replica.probes += 1
+        return ("qref", self._replica)
+
+
+class _FakeReplica:
+    def __init__(self, hex_, qlen=0):
+        self._actor_id = _FakeId(hex_)
+        self.qlen = qlen
+        self.probes = 0
+
+    @property
+    def queue_len(self):
+        return _FakeMethod(self)
+
+
+@pytest.fixture
+def router(monkeypatch):
+    import ray_tpu.serve.handle as H
+
+    r = H._Router("app", "dep")
+    monkeypatch.setattr(r, "_refresh", lambda: None)
+    # resolve fake refs without a cluster
+    monkeypatch.setattr(
+        H, "_resolve_refs",
+        lambda refs, timeout: [ref[1].qlen for ref in refs])
+    # digests: injected by tests; never fetched from a (nonexistent) GCS
+    r._digest_ts = time.monotonic() + 3600
+    return r
+
+
+def _digest_row(prompt, bs, models=(), qlen=None):
+    return {"held": set(prefix_chain_hashes(prompt, bs)),
+            "block_size": bs, "models": set(models), "v": 1,
+            "qlen": qlen}
+
+
+def test_router_routes_to_longest_prefix_holder(router):
+    a, b = _FakeReplica("aa"), _FakeReplica("bb")
+    router._replicas = [a, b]
+    warm = list(range(64))
+    router._digests = {
+        "aa": _digest_row(warm[:17], 8),          # holds 2 chain links
+        "bb": _digest_row(warm, 8),               # holds the full chain
+    }
+    for _ in range(10):
+        chosen = router.choose_replica((), {"prompt": warm})
+        assert chosen is b
+    # no probe RPCs were needed to make the affinity choice
+    assert a.probes == 0 and b.probes == 0
+
+
+def test_router_cold_prefix_falls_back_to_pow2(router):
+    a, b = _FakeReplica("aa", qlen=5), _FakeReplica("bb", qlen=0)
+    router._replicas = [a, b]
+    router._digests = {"aa": _digest_row(list(range(32)), 8)}
+    cold = [999] * 40
+    chosen = router.choose_replica((), {"prompt": cold})
+    assert chosen is b  # pow-2 picked the shorter queue
+
+
+def test_router_stale_digest_row_ignored(router):
+    """A digest row for a drained/replaced replica (not in the live set)
+    must not attract traffic — the winner comes from the live set only."""
+    a, b = _FakeReplica("aa"), _FakeReplica("bb")
+    router._replicas = [a, b]
+    warm = list(range(48))
+    router._digests = {
+        "gone": _digest_row(warm, 8),             # stale: replica left
+        "aa": _digest_row(warm[:17], 8),
+    }
+    assert router.choose_replica((), {"prompt": warm}) is a
+
+
+def test_router_overloaded_winner_falls_back(router):
+    from ray_tpu._private.config import global_config
+
+    a, b = _FakeReplica("aa", qlen=0), _FakeReplica("bb", qlen=100)
+    router._replicas = [a, b]
+    warm = list(range(48))
+    router._digests = {"bb": _digest_row(warm, 8)}
+    now = time.monotonic()
+    slack = global_config().serve_prefix_overload_slack
+    router._qcache = {"aa": (0.0, now), "bb": (float(slack + 50), now)}
+    # b holds the chain but is far deeper than the field: pow-2 wins
+    chosen = router.choose_replica((), {"prompt": warm})
+    assert chosen is a
+
+
+def test_overload_guard_live_across_digest_window(router):
+    """In the zero-RPC steady state the qcache is refreshed only by the
+    digest fetch (once per serve_prefix_digest_ttl_s) — the overload
+    guard must honor entries that old, not just probe-TTL-fresh ones
+    (regression: the guard was inert ~75% of every digest window and the
+    hot replica kept winning on affinity)."""
+    from ray_tpu._private.config import global_config
+
+    cfg = global_config()
+    a, b = _FakeReplica("aa", qlen=0), _FakeReplica("bb", qlen=100)
+    router._replicas = [a, b]
+    warm = list(range(48))
+    router._digests = {"bb": _digest_row(warm, 8)}
+    # entries older than the probe TTL but within the digest window —
+    # exactly what a digest-fed cache looks like mid-window
+    age = cfg.serve_route_probe_ttl_s + 0.1
+    assert age < cfg.serve_prefix_digest_ttl_s + cfg.serve_route_probe_ttl_s
+    ts = time.monotonic() - age
+    slack = cfg.serve_prefix_overload_slack
+    router._qcache = {"aa": (0.0, ts), "bb": (float(slack + 50), ts)}
+    assert router.choose_replica((), {"prompt": warm}) is a
+
+
+def test_router_lora_affinity_dominates_prefix(router):
+    a, b = _FakeReplica("aa"), _FakeReplica("bb")
+    router._replicas = [a, b]
+    warm = list(range(48))
+    router._digests = {
+        "aa": _digest_row(warm, 8),                        # prefix winner
+        "bb": _digest_row(warm[:9], 8, models=("ada",)),   # adapter holder
+    }
+    req = {"prompt": warm, "model": "ada"}
+    assert router.choose_replica((req,), {}) is b
+    # without the model the prefix holder wins again
+    assert router.choose_replica((), {"prompt": warm}) is a
+
+
+def test_extract_prompt_only_leading_positional():
+    """Only the LEADING positional may be the routing prompt — scanning
+    further latched onto stop_token_ids when the first argument was a
+    non-list prompt encoding, routing on a meaningless chain."""
+    from ray_tpu.serve.handle import _extract_prompt
+
+    assert _extract_prompt(("text prompt", 64, 0.0, 0, [2, 3]), {}) == \
+        (None, None)
+    assert _extract_prompt(([5, 6, 7],), {}) == ([5, 6, 7], None)
+    assert _extract_prompt(({"prompt": [1, 2], "model": "m"},), {}) == \
+        ([1, 2], "m")
+    assert _extract_prompt((), {"prompt": [9, 9]}) == ([9, 9], None)
+
+
+def test_pow2_probe_rpcs_cached_within_ttl(router):
+    """ISSUE 7 satellite: the pow-2 hot path previously paid two
+    queue-length RPCs per request; with the TTL cache a burst costs at
+    most one probe per replica per TTL window."""
+    reps = [_FakeReplica(f"r{i}", qlen=i) for i in range(4)]
+    router._replicas = reps
+    router._digests = {}
+    n = 50
+    for _ in range(n):
+        router.choose_replica((), {})
+    total_probes = sum(r.probes for r in reps)
+    assert total_probes == router.probe_rpcs
+    assert total_probes <= len(reps), (
+        f"{total_probes} probe RPCs for {n} routes — TTL cache not used")
+    # TTL expiry triggers a fresh probe round
+    router._qcache = {h: (q, time.monotonic() - 10)
+                     for h, (q, _) in router._qcache.items()}
+    router.choose_replica((), {})
+    assert router.probe_rpcs > total_probes
+
+
+def test_digest_qlen_feeds_probe_cache(router):
+    """Digest rows carry the replica's depth; the router reuses them as
+    probe results (the satellite's 'reuse the digest rows' clause)."""
+    import ray_tpu.serve.handle as H
+
+    a, b = _FakeReplica("aa", qlen=9), _FakeReplica("bb", qlen=9)
+    router._replicas = [a, b]
+
+    calls = {"n": 0}
+
+    class _GCS:
+        def call(self, method, payload, timeout=None):
+            calls["n"] += 1
+            prefix = f"{H.DIGEST_KV_PREFIX}app:dep:"
+            if method == "KVKeys":
+                return [prefix + "aa", prefix + "bb"]
+            import json
+
+            row = {"v": 1, "block_size": 8, "hashes": [], "models": [],
+                   "qlen": 2}
+            return {k: json.dumps(row) for k in payload["keys"]}
+
+    class _W:
+        gcs = _GCS()
+
+    import ray_tpu._private.worker as worker_mod
+
+    orig = worker_mod.get_global_worker
+    worker_mod.get_global_worker = lambda: _W()
+    try:
+        router._digest_ts = float("-inf")  # allow one fetch
+        from ray_tpu._private.config import global_config
+
+        router._fetch_digests(global_config())
+    finally:
+        worker_mod.get_global_worker = orig
+    assert calls["n"] == 2  # KVKeys + KVMultiGet, one window
+    # both replicas' depths came from the digest — pow-2 needs no RPC
+    router.choose_replica((), {})
+    assert a.probes == 0 and b.probes == 0
