@@ -974,6 +974,58 @@ def _bench_core_perf() -> dict:
         return {"error": str(e)[:200]}
 
 
+def _bench_control_plane() -> dict:
+    """GCS<->raylet sync + pubsub fan-out cost vs cluster size (ISSUE 8):
+    in-process mega-cluster harness (real GCS, skeleton raylets) at
+    50/200/1000 nodes.  Per row: steady-state delta bytes per raylet-tick
+    and GCS handler µs/tick (both should be ~flat in N), convergence lag
+    after a churn burst (tick rounds), the full-broadcast A/B (the
+    pre-delta O(N)/tick behavior), and tree-vs-flat pubsub root sends per
+    control event."""
+    from ray_tpu._private.sim_cluster import MegaClusterHarness
+
+    rows = []
+    for n in (50, 200, 1000):
+        h = MegaClusterHarness(num_nodes=n, fanout=4)
+        try:
+            t0 = time.perf_counter()
+            h.build()
+            build_s = time.perf_counter() - t0
+            h.tick_all()  # settle
+            steady = h.tick_all(rounds=3)
+            # churn burst: ~1% of the cluster moves, then converge
+            movers = max(1, n // 100)
+            for s in h.skeletons[:movers]:
+                h.drain_node(s)
+            h.kill_node(h.skeletons[movers])
+            h.add_nodes(1)
+            lag = h.converge(max_rounds=5)
+            full = h.tick_all(rounds=1, force_full=True)
+            tree = h.publish_probe()
+            h.gcs.config.pubsub_tree_fanout = 0
+            flat = h.publish_probe()
+            rows.append({
+                "nodes": n,
+                "build_s": round(build_s, 3),
+                "steady_delta_bytes_per_tick": round(
+                    steady["delta_bytes"] / steady["ticks"], 1),
+                "steady_gcs_us_per_tick": round(
+                    steady["gcs_handler_s"] / steady["ticks"] * 1e6, 2),
+                "convergence_lag_rounds": lag,
+                "full_bytes_per_tick": round(
+                    full["full_bytes"] / full["ticks"], 1),
+                "full_vs_delta_x": round(
+                    (full["full_bytes"] / full["ticks"])
+                    / max(steady["delta_bytes"] / steady["ticks"], 1e-9), 1),
+                "pubsub_root_sends_tree": tree["root_sends"],
+                "pubsub_root_sends_flat": flat["root_sends"],
+                "pubsub_delivered": tree["delivered"],
+            })
+        finally:
+            h.close()
+    return {"rows": rows}
+
+
 def _trace_summary_snapshot() -> dict:
     """Process-local tracing telemetry (enabled flags, spans emitted, last
     trace id + its critical-path summary when a cluster is connected) — so
@@ -1183,6 +1235,7 @@ def main():
         ("serving", lambda: _bench_serving(on_tpu), 900.0),
         ("serving_disagg", lambda: _bench_serving_disagg(on_tpu), 900.0),
         ("core_perf", _bench_core_perf, 600.0),
+        ("control_plane", _bench_control_plane, 600.0),
         ("dryrun_8b", _dryrun_8b, 900.0),
     )
     if not partial:

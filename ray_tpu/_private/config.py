@@ -61,6 +61,21 @@ class RayTpuConfig:
     # max_direct_call_object_size (ray_config_def.h).
     max_inline_object_size: int = 100 * 1024
     object_transfer_chunk_bytes: int = 8 * 1024**2
+    # --- cluster-view sync (versioned delta protocol; reference:
+    # src/ray/common/ray_syncer/ray_syncer.h versioned gossip) ---
+    # how many node-state mutations the GCS changelog ring remembers; a
+    # raylet whose known version fell behind the ring gets one full
+    # snapshot instead of a delta (then rides deltas again).  At the 0.2s
+    # report tick this covers minutes of heavy churn.
+    cluster_view_changelog_len: int = 4096
+    # --- pubsub tree fan-out (control channels: NODE events / drain
+    # notices) ---
+    # branching factor of the raylet relay tree the GCS publishes through:
+    # the GCS sends O(fanout) RelayPublish frames per event and relays
+    # re-publish to their subtree, so GCS-side publish work stays O(fanout)
+    # instead of O(nodes).  0 = flat (direct push to every raylet, the A/B
+    # baseline); the payload is pickled once per publish either way.
+    pubsub_tree_fanout: int = 4
     # --- scheduler ---
     scheduler_top_k_fraction: float = 0.2
     scheduler_top_k_absolute: int = 1

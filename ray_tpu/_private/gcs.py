@@ -35,8 +35,15 @@ from typing import Any, Dict, List, Optional, Tuple
 from ray_tpu._private import runtime_metrics
 from ray_tpu._private.config import RayTpuConfig, global_config
 from ray_tpu._private.ids import ActorID, JobID, NodeID, PlacementGroupID, WorkerID
+from ray_tpu._private.cluster_view import tree_partition
 from ray_tpu._private.resources import NodeResources, ResourceSet
-from ray_tpu._private.rpc import ClientPool, ConnectionLost, RpcServer
+from ray_tpu._private.rpc import (
+    ClientPool,
+    ConnectionLost,
+    RpcServer,
+    encode_frame,
+    oob_wrap,
+)
 from ray_tpu._private.scheduler import ClusterResourceScheduler
 from ray_tpu._private.task_spec import ActorDiedError, TaskSpec
 
@@ -91,13 +98,42 @@ class Pubsub:
 
     reference: src/ray/pubsub/publisher.h:309 — the reference uses long-polls;
     we push directly since every process runs an RpcServer anyway.
+
+    Two delivery planes share ``publish``:
+
+    - **flat subscribers** (drivers/workers that called ``Subscribe``): the
+      message is encoded ONCE per publish (``rpc.encode_frame``) and the
+      identical frame is shipped to every subscriber — flat fan-out used to
+      re-pickle the same payload N times.
+    - **raylet relay tree** (control channels in ``TREE_CHANNELS``): every
+      ALIVE raylet is a relay target; the GCS pushes ``RelayPublish`` to
+      O(``pubsub_tree_fanout``) tree heads, each carrying the once-pickled
+      payload frame plus the addresses of its subtree, and relays
+      re-publish downward (the ``experimental.broadcast_object`` binary-
+      tree shape applied to control traffic).  A relay that turns out dead
+      is dropped from the tree and its subtree is delivered by direct GCS
+      push, so one dead relay costs one publish of direct sends, not a
+      silent dark subtree.
     """
 
-    def __init__(self, pool: ClientPool):
+    # control channels fanned out through the raylet relay tree (node
+    # lifecycle + drain notices; ACTOR:*/PG:* stay flat — their subscriber
+    # sets are owners, not the whole cluster)
+    TREE_CHANNELS = ("NODE",)
+
+    def __init__(self, pool: ClientPool, config: Optional[RayTpuConfig] = None):
         self._subs: Dict[str, List[Tuple[Tuple[str, int], str]]] = {}
         self._pool = pool
+        self._config = config
         self._fails: Dict[Tuple[Tuple[str, int], str], int] = {}
         self._lock = threading.Lock()
+        # relay targets (alive raylets), insertion-ordered so the tree
+        # shape is deterministic between publishes
+        self._relays: Dict[Tuple[str, int], None] = {}
+
+    def _fanout(self) -> int:
+        cfg = self._config or global_config()
+        return cfg.pubsub_tree_fanout
 
     def subscribe(self, channel: str, subscriber_addr: Tuple[str, int], method: str = "PubsubMessage"):
         with self._lock:
@@ -111,23 +147,72 @@ class Pubsub:
             subs = self._subs.get(channel, [])
             self._subs[channel] = [s for s in subs if s[0] != tuple(subscriber_addr)]
 
+    def add_relay(self, addr: Tuple[str, int]):
+        with self._lock:
+            self._relays[tuple(addr)] = None
+
+    def remove_relay(self, addr: Tuple[str, int]):
+        with self._lock:
+            self._relays.pop(tuple(addr), None)
+
     def publish(self, channel: str, message: Any):
         with self._lock:
             subs = list(self._subs.get(channel, []))
+            relays = (list(self._relays)
+                      if channel in self.TREE_CHANNELS else [])
+        # flat plane: one encoded frame per method, reused by-reference
+        # across every subscriber sharing it
+        by_method: Dict[str, list] = {}
         for addr, method in subs:
-            key = (addr, method)
-            try:
-                fut = self._pool.get(addr).call_async(
-                    method, {"channel": channel, "message": message})
-            except Exception:  # noqa: BLE001
-                self._note_publish_result(channel, key, ok=False)
-                continue
-            # only UNREACHABILITY counts toward eviction — a handler that
-            # raises proves the peer is alive (the error frame came back)
-            fut.add_done_callback(
-                lambda f, key=key: self._note_publish_result(
-                    channel, key,
-                    ok=not isinstance(f.exception(), ConnectionLost)))
+            by_method.setdefault(method, []).append(addr)
+        for method, addrs in by_method.items():
+            parts = encode_frame(method, {"channel": channel,
+                                          "message": message})
+            for addr in addrs:
+                key = (addr, method)
+                try:
+                    fut = self._pool.get(addr).call_async_frame(parts)
+                except Exception:  # noqa: BLE001
+                    self._note_publish_result(channel, key, ok=False)
+                    continue
+                # only UNREACHABILITY counts toward eviction — a handler
+                # that raises proves the peer is alive (the error frame
+                # came back)
+                fut.add_done_callback(
+                    lambda f, key=key: self._note_publish_result(
+                        channel, key,
+                        ok=not isinstance(f.exception(), ConnectionLost)))
+        if relays:
+            inner = pickle.dumps({"channel": channel, "message": message},
+                                 protocol=5)
+            for group in tree_partition(relays, self._fanout()):
+                self._relay_send(inner, group[0], group[1:], "root")
+
+    def _relay_send(self, inner: bytes, head: Tuple[str, int],
+                    subtree: List[Tuple[str, int]], role: str):
+        try:
+            fut = self._pool.get(head).call_async(
+                "RelayPublish", {"frame": oob_wrap(inner),
+                                 "subtree": subtree})
+        except Exception:  # noqa: BLE001
+            self._on_relay_failure(inner, head, subtree)
+            return
+        runtime_metrics.inc_relay_publish(role)
+        fut.add_done_callback(
+            lambda f, head=head, subtree=subtree:
+            self._on_relay_failure(inner, head, subtree)
+            if isinstance(f.exception(), ConnectionLost) else None)
+
+    def _on_relay_failure(self, inner: bytes, head: Tuple[str, int],
+                          subtree: List[Tuple[str, int]]):
+        """A relay was unreachable: drop it from the tree and deliver its
+        subtree by direct push so THIS publish still reaches everyone
+        below it.  Eviction is not a death sentence — a live raylet that
+        merely hiccuped is re-added on its next resource report (the
+        liveness proof), so only relays that stopped reporting stay out."""
+        self.remove_relay(head)
+        for t in subtree:
+            self._relay_send(inner, t, [], "fallback")
 
     def _note_publish_result(self, channel: str, key, ok: bool):
         """Evict subscribers that stay unreachable (dead drivers that never
@@ -164,8 +249,23 @@ class GcsServer:
         self.config = config or global_config()
         self.persistence_path = persistence_path
         self.pool = ClientPool()
-        self.pubsub = Pubsub(self.pool)
+        self.pubsub = Pubsub(self.pool, self.config)
         self.nodes: Dict[NodeID, NodeInfo] = {}
+        # versioned cluster-view sync (reference: ray_syncer.h versioned
+        # gossip): every node-state mutation bumps _view_version, replaces
+        # that node's cached snap dict (snaps are replaced, never mutated,
+        # so readers outside the lock see consistent entries), and appends
+        # to the bounded changelog ring.  ReportResources serves changes-
+        # since-known-version off the ring; full snapshots come from the
+        # _view_cache (version, view, pickled_len) triple rebuilt lazily.
+        self._view_version = 0
+        self._node_snaps: Dict[NodeID, dict] = {}
+        # pickled size per snap, computed ONCE per mutation so delta
+        # replies can be metered without re-serializing per reporter
+        self._snap_sizes: Dict[NodeID, int] = {}
+        self._view_changelog: deque = deque(
+            maxlen=max(16, self.config.cluster_view_changelog_len))
+        self._view_cache: Optional[Tuple[int, dict, int]] = None
         self.actors: Dict[ActorID, ActorInfo] = {}
         self.named_actors: Dict[Tuple[str, str], ActorID] = {}  # (namespace, name)
         self.placement_groups: Dict[PlacementGroupID, PlacementGroupInfo] = {}
@@ -320,6 +420,79 @@ class GcsServer:
     # Node management (reference: gcs_node_manager.h / gcs_resource_manager)
     # ------------------------------------------------------------------
 
+    # a steady-state sync reply is {"view_version": int} — book its wire
+    # cost as this constant instead of pickling every empty reply
+    _EMPTY_SYNC_BYTES = len(pickle.dumps({"view_version": 1 << 62},
+                                         protocol=5))
+
+    def _bump_view_locked(self, node_id: NodeID):
+        """One node-state mutation: new version, fresh snap (or tombstone —
+        DEAD/removed nodes leave the snap table, and their absence at delta
+        time IS the tombstone), changelog entry.  Caller holds self._lock."""
+        self._view_version += 1
+        info = self.nodes.get(node_id)
+        if info is None or info.state == "DEAD":
+            self._node_snaps.pop(node_id, None)
+            self._snap_sizes.pop(node_id, None)
+        else:
+            snap = {
+                **info.resources.snapshot(),
+                "address": info.address, "state": info.state,
+            }
+            self._node_snaps[node_id] = snap
+            self._snap_sizes[node_id] = len(pickle.dumps(snap, protocol=5))
+        self._view_changelog.append((self._view_version, node_id))
+        runtime_metrics.set_gcs_sync_version(self._view_version)
+
+    def _view_snapshot(self) -> Tuple[int, dict, int]:
+        """Cached full cluster view: (version, {nid: snap}, payload_len).
+
+        The lock covers only O(N) pointer/integer work (snap-table copy +
+        size sum off the per-mutation _snap_sizes) — nothing is pickled
+        here, so a registration burst can't stall _actor_cv waiters behind
+        snapshot serialization.  Snap dicts are replaced (never mutated)
+        on change, so the copied view stays internally consistent.  The
+        cache-store race is benign: any (version, view) pair captured
+        under the lock is a valid snapshot to serve."""
+        cache = self._view_cache
+        if cache is not None and cache[0] == self._view_version:
+            return cache
+        with self._lock:
+            version = self._view_version
+            view = dict(self._node_snaps)
+            nbytes = self._EMPTY_SYNC_BYTES + sum(self._snap_sizes.values())
+        cache = (version, view, nbytes)
+        self._view_cache = cache
+        return cache
+
+    def _view_delta_locked(self, known: int) -> Optional[dict]:
+        """Changes since ``known``, or None when only a full snapshot can
+        answer (version gap / changelog overflow / future version from a
+        previous GCS incarnation).  Caller holds self._lock; cost is
+        O(changes since known), not O(cluster size)."""
+        v = self._view_version
+        if known == v:
+            return {"view_version": v}
+        if not (0 <= known < v):
+            return None
+        if not self._view_changelog or self._view_changelog[0][0] > known + 1:
+            return None  # ring no longer reaches back to `known`
+        delta: Dict[NodeID, dict] = {}
+        tombstones: List[NodeID] = []
+        seen = set()
+        for ver, nid in reversed(self._view_changelog):
+            if ver <= known:
+                break
+            if nid in seen:
+                continue
+            seen.add(nid)
+            snap = self._node_snaps.get(nid)
+            if snap is None:
+                tombstones.append(nid)
+            else:
+                delta[nid] = snap
+        return {"view_version": v, "delta": delta, "tombstones": tombstones}
+
     def HandleRegisterNode(self, req):
         node_id: NodeID = req["node_id"]
         with self._lock:
@@ -331,35 +504,57 @@ class GcsServer:
             )
             self.nodes[node_id] = info
             self.scheduler.add_or_update_node(node_id, info.resources)
+            self._bump_view_locked(node_id)
             self._actor_cv.notify_all()
+        self.pubsub.add_relay(info.address)
         self.pubsub.publish("NODE", {"event": "alive", "node_id": node_id, "address": info.address})
         self._record_event("INFO", "gcs", f"node {node_id} joined",
                            node_id=node_id, address=info.address)
-        return {"config_blob": self.config.to_blob(), "cluster_view": self._cluster_view()}
+        version, view, nbytes = self._view_snapshot()
+        runtime_metrics.add_gcs_sync_bytes("full", nbytes)
+        return {"config_blob": self.config.to_blob(),
+                "cluster_view": view, "view_version": version}
 
     def HandleReportResources(self, req):
         node_id: NodeID = req["node_id"]
+        known = req.get("known_version", -1)
         with self._lock:
             info = self.nodes.get(node_id)
             if info is None or info.state == "DEAD":
                 return {"restart": True}  # raylet should re-register (GCS restarted)
             info.last_report = time.monotonic()
-            self.scheduler.update_available(node_id, req["available"])
-            self._actor_cv.notify_all()
-        return {"cluster_view": self._cluster_view()}
-
-    def _cluster_view(self):
-        """Resource snapshot broadcast to raylets (the syncer plane;
-        reference: src/ray/common/ray_syncer/ray_syncer.h)."""
-        return {
-            nid: {**info.resources.snapshot(), "address": info.address, "state": info.state}
-            for nid, info in self.nodes.items()
-            if info.state != "DEAD"
-        }
+            address = info.address
+            available = req["available"]
+            if info.resources.available.to_dict() != available:
+                # only REAL availability changes bump the version (and wake
+                # actor scheduling); an unchanged report is version-silent,
+                # which is what makes the steady-state delta empty
+                self.scheduler.update_available(node_id, available)
+                self._bump_view_locked(node_id)
+                self._actor_cv.notify_all()
+            reply = self._view_delta_locked(known)
+        # a report IS a liveness proof: re-admit this raylet to the pubsub
+        # relay tree if a transient send failure evicted it (idempotent
+        # dict set; dead relays stop reporting and stay out)
+        self.pubsub.add_relay(address)
+        if reply is None:
+            version, view, nbytes = self._view_snapshot()
+            runtime_metrics.add_gcs_sync_bytes("full", nbytes)
+            return {"view_version": version, "cluster_view": view}
+        # byte accounting without re-pickling the reply per reporter: the
+        # per-snap sizes were computed once at mutation time; tombstones
+        # are bare node ids (~the empty-frame constant each)
+        nbytes = self._EMPTY_SYNC_BYTES
+        for nid in reply.get("delta", ()):
+            nbytes += self._snap_sizes.get(nid, 0)
+        nbytes += self._EMPTY_SYNC_BYTES * len(reply.get("tombstones", ()))
+        runtime_metrics.add_gcs_sync_bytes("delta", nbytes)
+        return reply
 
     def HandleGetClusterView(self, req):
-        with self._lock:
-            return self._cluster_view()
+        version, view, nbytes = self._view_snapshot()
+        runtime_metrics.add_gcs_sync_bytes("full", nbytes)
+        return view
 
     def HandleDrainNode(self, req):
         """Begin a node's graceful drain (reference: gcs_node_manager drain +
@@ -383,6 +578,7 @@ class GcsServer:
             # still in the cluster view (running leases keep their booking)
             # but invisible to every new scheduling/placement decision
             self.scheduler.set_draining(node_id)
+            self._bump_view_locked(node_id)
             restartable = [
                 a.actor_id for a in self.actors.values()
                 if a.node_id == node_id and a.state == "ALIVE"
@@ -444,7 +640,9 @@ class GcsServer:
             info.state = "DEAD"
             info.death_reason = reason
             self.scheduler.remove_node(node_id)
+            self._bump_view_locked(node_id)  # snap leaves: the tombstone
             dead_actors = [a for a in self.actors.values() if a.node_id == node_id and a.state in ("ALIVE", "PENDING")]
+        self.pubsub.remove_relay(info.address)
         if was_draining and info.drain_started:
             # drain latency: DRAINING -> DEAD("drained"), the graceful window
             runtime_metrics.observe_drain_latency(

@@ -228,6 +228,17 @@ class Raylet:
         self._task_events: List[dict] = []
         self._task_events_lock = threading.Lock()
 
+        # versioned cluster-view mirror (delta sync): the report loop sends
+        # known_version and applies snapshot/delta replies through the
+        # shared protocol in _private/cluster_view.py
+        self._view_version = -1
+        self._view_store = Raylet._SchedulerViewStore(self)
+        # tree-pubsub control messages seen (tests + diagnosability)
+        self._node_events_seen = 0
+        # report-loop failure visibility: counter + throttled warning so a
+        # flapping GCS link shows up instead of vanishing into a bare pass
+        self._last_report_warn = float("-inf")
+
         # Register with GCS; receive cluster config + view.
         reply = self.gcs.call(
             "RegisterNode",
@@ -242,7 +253,7 @@ class Raylet:
         from ray_tpu._private import config as config_mod
 
         config_mod.set_global_config(RayTpuConfig.from_blob(reply["config_blob"]))
-        self._apply_cluster_view(reply["cluster_view"])
+        self._apply_sync_reply(reply)
 
         self._threads = [
             threading.Thread(target=self._report_loop, daemon=True, name="raylet-report"),
@@ -324,28 +335,112 @@ class Raylet:
         self.pool.close_all()
 
     # ------------------------------------------------------------------
-    # Cluster view sync (reference: ray_syncer.h — versioned gossip)
+    # Cluster view sync (reference: ray_syncer.h — versioned gossip).
+    # The protocol (snapshot-sweeps vs delta+tombstones, version tracking)
+    # lives in _private/cluster_view.py, shared with the mega-cluster
+    # harness's skeleton raylets; this raylet contributes the store that
+    # maps view entries onto its ClusterResourceScheduler.
     # ------------------------------------------------------------------
 
-    def _apply_cluster_view(self, view: dict):
+    def _apply_sync_reply(self, reply: dict):
+        from ray_tpu._private.cluster_view import apply_sync_reply
+
         with self._lock:
-            seen = set()
-            for nid, snap in view.items():
-                seen.add(nid)
-                if nid == self.node_id:
-                    continue
-                node = self.cluster.nodes.get(nid)
-                if node is None:
-                    node = NodeResources(ResourceSet(snap["total"]), snap.get("labels"))
-                    self.cluster.add_or_update_node(nid, node)
-                node.available = ResourceSet(snap["available"])
-                node.address = tuple(snap["address"])  # type: ignore[attr-defined]
-                # DRAINING peers stay in the view (their running leases are
-                # real) but take no spillback from this node's dispatch
-                self.cluster.set_draining(nid, snap.get("state") == "DRAINING")
-            for nid in list(self.cluster.nodes):
-                if nid != self.node_id and nid not in seen:
-                    self.cluster.remove_node(nid)
+            self._view_version = apply_sync_reply(
+                reply, self._view_store, self.node_id, self._view_version)
+
+    class _SchedulerViewStore:
+        """cluster_view.ViewStore over a raylet's scheduler (lock held by
+        the caller for the whole apply)."""
+
+        def __init__(self, raylet: "Raylet"):
+            self._raylet = raylet
+
+        def upsert(self, nid, snap):
+            cluster = self._raylet.cluster
+            node = cluster.nodes.get(nid)
+            if node is None:
+                node = NodeResources(ResourceSet(snap["total"]), snap.get("labels"))
+                cluster.add_or_update_node(nid, node)
+            node.available = ResourceSet(snap["available"])
+            node.address = tuple(snap["address"])  # type: ignore[attr-defined]
+            # DRAINING peers stay in the view (their running leases are
+            # real) but take no spillback from this node's dispatch
+            cluster.set_draining(nid, snap.get("state") == "DRAINING")
+
+        def remove(self, nid):
+            self._raylet.cluster.remove_node(nid)
+
+        def ids(self):
+            return list(self._raylet.cluster.nodes)
+
+    # ------------------------------------------------------------------
+    # Tree pubsub relay (control channels; reference: the broadcast-tree
+    # shape of experimental.broadcast_object applied to control traffic)
+    # ------------------------------------------------------------------
+
+    def HandleRelayPublish(self, req):
+        """One hop of a tree-fanned control publish: forward the once-
+        pickled frame to this relay's subtree, then deliver locally."""
+        import pickle as _pickle
+
+        frame = req.get("frame")
+        if not isinstance(frame, (bytes, bytearray)):
+            frame = bytes(frame)  # OOB transit hands us a memoryview
+        subtree = [tuple(a) for a in (req.get("subtree") or ())]
+        if subtree:
+            self._relay_forward(frame, subtree)
+        try:
+            msg = _pickle.loads(frame)
+            self._on_control_message(msg.get("channel"), msg.get("message"))
+        except Exception:  # noqa: BLE001 — a malformed frame must not
+            pass           # poison the relay plane
+        return True
+
+    def _relay_forward(self, frame: bytes, subtree):
+        from ray_tpu._private.cluster_view import tree_partition
+        from ray_tpu._private.rpc import ConnectionLost, oob_wrap
+
+        def send(head, rest, role):
+            try:
+                fut = self.pool.get(head).call_async(
+                    "RelayPublish", {"frame": oob_wrap(frame),
+                                     "subtree": rest})
+            except Exception:  # noqa: BLE001 — dead child: deliver its
+                # subtree directly so this publish still reaches it
+                for t in rest:
+                    send(t, [], "fallback")
+                return
+            runtime_metrics.inc_relay_publish(role)
+            if rest:
+                fut.add_done_callback(
+                    lambda f, rest=rest:
+                    [send(t, [], "fallback") for t in rest]
+                    if isinstance(f.exception(), ConnectionLost) else None)
+            else:
+                fut.add_done_callback(lambda f: f.exception())  # swallow
+
+        for group in tree_partition(subtree, global_config().pubsub_tree_fanout):
+            send(group[0], group[1:], "relay")
+
+    def _on_control_message(self, channel, message):
+        """Local delivery of a tree-published control message.  The
+        versioned view sync stays authoritative — pubsub only lets a
+        raylet act on drain/death a few ticks earlier (both applications
+        are idempotent, and node ids are per-incarnation so stale events
+        can't hit a re-registered node)."""
+        self._node_events_seen += 1
+        if channel != "NODE" or not isinstance(message, dict):
+            return
+        nid = message.get("node_id")
+        if nid is None or nid == self.node_id:
+            return
+        event = message.get("event")
+        with self._lock:
+            if event == "draining":
+                self.cluster.set_draining(nid, True)
+            elif event == "dead":
+                self.cluster.remove_node(nid)
 
     def _update_node_gauges_locked(self):
         """Refresh this node's built-in gauges (called from the report loop
@@ -392,11 +487,15 @@ class Raylet:
                         self._update_node_gauges_locked()
                 runtime_metrics.maybe_push()
                 self._flush_task_events()
-                reply = self.gcs.call("ReportResources", {"node_id": self.node_id, "available": avail})
+                reply = self.gcs.call(
+                    "ReportResources",
+                    {"node_id": self.node_id, "available": avail,
+                     "known_version": self._view_version})
                 if reply.get("restart"):
                     # GCS restarted and lost us (reference: HandleNotifyGCSRestart
-                    # node_manager.cc:948): re-register.
-                    self.gcs.call(
+                    # node_manager.cc:948): re-register; the register reply
+                    # carries a fresh full snapshot + version.
+                    reply = self.gcs.call(
                         "RegisterNode",
                         {
                             "node_id": self.node_id,
@@ -406,12 +505,23 @@ class Raylet:
                             "is_head": self.is_head,
                         },
                     )
-                elif "cluster_view" in reply:
-                    self._apply_cluster_view(reply["cluster_view"])
+                self._apply_sync_reply(reply)
                 with self._lock:
                     self._dispatch_cv.notify_all()
-            except Exception:  # noqa: BLE001
-                pass  # GCS temporarily unreachable; keep trying
+            except Exception as e:  # noqa: BLE001
+                # GCS temporarily unreachable; keep trying — but visibly:
+                # count every failed tick and warn at most once per 30s so
+                # a flapping link is diagnosable without log spam
+                runtime_metrics.inc_report_failure()
+                now = time.monotonic()
+                if now - self._last_report_warn >= 30.0:
+                    self._last_report_warn = now
+                    logger.warning(
+                        "raylet %s: resource report to GCS %s failed (%s: "
+                        "%s); retrying every %.1fs",
+                        self.node_id.hex()[:8], self.gcs_address,
+                        type(e).__name__, e,
+                        global_config().resource_report_interval_s)
 
     # ------------------------------------------------------------------
     # Worker pool (reference: worker_pool.h:274, worker_pool.cc)

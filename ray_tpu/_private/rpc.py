@@ -118,6 +118,16 @@ def oob_wrap(data):
     return data
 
 
+def encode_frame(method: str, payload: Any) -> List:
+    """Pre-encode one request body for ``RpcClient.call_async_frame``.
+
+    The pubsub plane uses this to pickle a publish payload ONCE and ship
+    the identical frame to every subscriber (flat fan-out used to
+    re-pickle the same message N times); the returned parts list is
+    read-only and safe to hand to many clients concurrently."""
+    return encode_body((method, payload))
+
+
 def _body_len(parts: List) -> int:
     return sum(memoryview(p).nbytes for p in parts)
 
@@ -569,13 +579,18 @@ class RpcClient:
                 fut.set_exception(ConnectionLost(f"connection to {self._address} lost"))
 
     def call_async(self, method: str, payload: Any = None) -> Future:
+        return self.call_async_frame(encode_body((method, payload)))
+
+    def call_async_frame(self, parts: List) -> Future:
+        """Send a body pre-encoded by ``encode_frame`` — the pickle-once
+        publish seam (``call_async`` is this plus a per-call encode; the
+        frame parts are shared by-reference across every recipient)."""
         self._ensure_connected()
         with self._state_lock:
             self._next_id += 1
             msg_id = self._next_id
         fut: Future = Future()
         self._futures[msg_id] = fut
-        parts = encode_body((method, payload))
         try:
             with self._send_lock:
                 _sendall_parts(

@@ -86,6 +86,34 @@ GCS_SINK_SIZE = Gauge(
     "GCS observability sink populations (task events, metric reporters, "
     "cluster events)",
     tag_keys=("sink",))
+# cluster-view sync (versioned delta protocol): the cost the control plane
+# ships per report tick.  kind=full is a whole-cluster snapshot (register,
+# version gap, changelog overflow); kind=delta is changed-nodes-only — in
+# steady state a delta reply is a constant-size empty frame, so
+# rate(delta) staying flat as the cluster grows is the scalability proof.
+GCS_SYNC_BYTES = Counter(
+    "ray_tpu_gcs_sync_bytes_total",
+    "Cluster-view sync payload bytes shipped by the GCS, by reply kind "
+    "(full snapshot vs versioned delta)",
+    tag_keys=("kind",))
+GCS_SYNC_VERSION = Gauge(
+    "ray_tpu_gcs_sync_version",
+    "Monotonic cluster-view version at the GCS: bumps once per node-state "
+    "mutation (register, availability change, DRAINING, DEAD); deltas ship "
+    "only mutations since each reporter's known version")
+# tree pubsub: RelayPublish sends by role.  root = GCS fan-out (O(fanout)
+# per event in tree mode, O(nodes) in flat mode — the A/B axis), relay =
+# raylet re-publish into its subtree, fallback = direct delivery around a
+# dead relay.
+PUBSUB_RELAY_PUBLISHES = Counter(
+    "ray_tpu_pubsub_relay_publishes_total",
+    "Tree-pubsub RelayPublish sends by role (root = GCS fan-out, relay = "
+    "raylet subtree re-publish, fallback = direct push around a dead relay)",
+    tag_keys=("role",))
+RAYLET_REPORT_FAILURES = Counter(
+    "ray_tpu_raylet_report_failures_total",
+    "Resource-report ticks that failed to reach the GCS (paired with a "
+    "throttled raylet warning, so a flapping GCS link is diagnosable)")
 
 # -- preemption / drain lifecycle -------------------------------------------
 # drains can take anywhere from seconds (idle node) to the full platform
@@ -311,6 +339,8 @@ FAMILIES = (
     WORKER_SPAWN_LATENCY, WORKER_SPAWNS, WORKER_SPAWN_TIMEOUTS,
     ZYGOTE_FALLBACKS, WORKERS, DISPATCH_SECONDS,
     GCS_RPC_LATENCY, GCS_SINK_SIZE,
+    GCS_SYNC_BYTES, GCS_SYNC_VERSION, PUBSUB_RELAY_PUBLISHES,
+    RAYLET_REPORT_FAILURES,
     NODE_DRAINS, NODE_DRAIN_LATENCY,
     STORE_STORED_BYTES, STORE_SPILLED_BYTES, STORE_RESTORED_BYTES,
     STORE_USED_BYTES, STORE_OBJECTS,
@@ -474,6 +504,49 @@ def goodput_metrics_snapshot() -> dict:
             d["wall_clock_s"] = round(total, 6)
             d["goodput_ratio"] = round(
                 d["buckets_s"].get("productive_step", 0.0) / total, 4)
+    return out
+
+
+_sync_bytes_full = GCS_SYNC_BYTES.with_tags({"kind": "full"})
+_sync_bytes_delta = GCS_SYNC_BYTES.with_tags({"kind": "delta"})
+_sync_version = GCS_SYNC_VERSION.with_tags()
+_report_failures = RAYLET_REPORT_FAILURES.with_tags()
+
+
+def add_gcs_sync_bytes(kind: str, n: int) -> None:
+    if n > 0:
+        (_sync_bytes_full if kind == "full" else _sync_bytes_delta).inc(n)
+
+
+def set_gcs_sync_version(v: int) -> None:
+    _sync_version.set(v)
+
+
+def inc_relay_publish(role: str, n: int = 1) -> None:
+    if n > 0:
+        _bound(PUBSUB_RELAY_PUBLISHES, role=role).inc(n)
+
+
+def inc_report_failure() -> None:
+    _report_failures.inc()
+
+
+def sync_snapshot() -> dict:
+    """Process-local cluster-view sync accounting: bytes shipped by reply
+    kind, relay-publish sends by role, and the current view version.
+    Hermetic (this process's counters only) — the perf-smoke delta-budget
+    gate and bench.py's control_plane section both read it."""
+    out = {"full_bytes": 0.0, "delta_bytes": 0.0, "relay_publishes": {},
+           "version": 0.0}
+    for tags_key, v in dict(GCS_SYNC_BYTES._points).items():
+        kind = dict(tags_key).get("kind", "?")
+        out[f"{kind}_bytes"] = out.get(f"{kind}_bytes", 0.0) + v
+    for tags_key, v in dict(PUBSUB_RELAY_PUBLISHES._points).items():
+        role = dict(tags_key).get("role", "?")
+        out["relay_publishes"][role] = (
+            out["relay_publishes"].get(role, 0.0) + v)
+    for p in GCS_SYNC_VERSION._snapshot():
+        out["version"] = p["value"]
     return out
 
 

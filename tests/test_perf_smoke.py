@@ -86,6 +86,31 @@ def test_cache_aware_route_decision_budget():
         f"route decision {per_decision * 1e6:.0f}µs exceeds the 2ms budget")
 
 
+def test_delta_sync_bytes_flat_in_cluster_size():
+    """Hermetic control-plane budget gate (ISSUE 8): steady-state sync
+    traffic per raylet per tick must NOT grow with cluster size — the
+    whole point of versioned delta sync.  Counter-based via
+    ray_tpu_gcs_sync_bytes_total{kind=delta} (no wall clock): at fixed
+    churn (none), the per-tick delta reply is a constant-size frame, so
+    the per-raylet byte rate at 200 nodes equals the rate at 50."""
+    from ray_tpu._private.sim_cluster import MegaClusterHarness
+
+    per_tick = {}
+    for n in (50, 200):
+        h = MegaClusterHarness(num_nodes=n)
+        try:
+            h.build()
+            h.tick_all()  # settle to the current version
+            steady = h.tick_all(rounds=5)
+            assert steady["full_bytes"] == 0, (
+                "steady state must never need a full snapshot")
+            per_tick[n] = steady["delta_bytes"] / steady["ticks"]
+        finally:
+            h.close()
+    assert per_tick[200] <= per_tick[50] * 1.1 + 2, (
+        f"steady-state delta bytes/tick grew with cluster size: {per_tick}")
+
+
 def test_lease_reuse_rpc_budget():
     """Counted via the owner-side lease metrics (hermetic — no wall-clock):
     in steady state the reuse path issues ≤1 RequestWorkerLease RPC per
