@@ -675,12 +675,22 @@ def _bench_serving(on_tpu: bool) -> dict:
                 # full second (else tiny configs report bogus overhead)
                 span = max(min(1.0, t - ts[0]), 1e-3)
                 steady_rate = max(steady_rate, acc / span)
+        # sketch-derived tails (serving SLO layer): the proxy's lifecycle
+        # ledger booked every request into the mergeable TTFT/ITL sketches
+        # — report p50/p95/p99 off them (the cluster-foldable figures)
+        # alongside the client-side measurement they must agree with
+        slo_snap = _slo_snapshot()
+        slo_dep = next(iter((slo_snap.get("deployments") or {}).values()),
+                       {})
         return {
             "clients": n_clients, "prompt_lens": prompt_lens,
             "new_tokens": new_tokens, "decode_chunk": chunk,
             "failed_clients": n_clients - len(results),
-            "ttft_s": _percentiles(ttfts),
-            "inter_token_s": _percentiles(itls),
+            "ttft_s": _percentiles(ttfts, ps=(50, 95, 99)),
+            "inter_token_s": _percentiles(itls, ps=(50, 95, 99)),
+            "ttft_sketch_s": slo_dep.get("ttft"),
+            "inter_token_sketch_s": slo_dep.get("itl"),
+            "slo": slo_snap,
             "aggregate_tok_per_sec": round(agg, 1),
             "steady_1s_peak_tok_per_sec": round(steady_rate, 1),
             "engine_direct_tok_per_sec": direct["tok_per_sec"],
@@ -757,10 +767,18 @@ def _bench_serving_disagg(on_tpu: bool) -> dict:
         prompts = [shared + [(7 * i + j) % 90 + 33 for j in range(tail_len)]
                    for i in range(n_clients)]
 
-        def run_clients(handle):
+        def run_clients(handle, slo_dep=None):
+            from ray_tpu.serve._private import slo as _slo
+
             results: dict = {}
 
             def one(i):
+                # handle-level A/B has no HTTP ingress: the clients drive
+                # the SLO lifecycle ledger directly, so TTFT/ITL tails
+                # come off the SAME mergeable sketches the proxy path uses
+                tracker = (_slo.start_request(slo_dep,
+                                              tenant=f"t{i % 2}")
+                           if slo_dep else _slo.NOOP_TRACKER)
                 try:
                     t0 = time.perf_counter()
                     first, count = None, 0
@@ -772,9 +790,11 @@ def _bench_serving_disagg(on_tpu: bool) -> dict:
                         if first is None:
                             first = time.perf_counter() - t0
                         count += len(toks)
+                        tracker.tokens(len(toks))
                     results[i] = (first, count, time.perf_counter() - t0)
+                    tracker.finish("ok")
                 except Exception:  # noqa: BLE001 — count, don't kill
-                    pass
+                    tracker.finish("error")
 
             threads = [threading.Thread(target=one, args=(i,))
                        for i in range(n_clients)]
@@ -792,8 +812,8 @@ def _bench_serving_disagg(on_tpu: bool) -> dict:
                     if r[0] is not None and r[1] > 1]
             return {
                 "failed_clients": n_clients - len(results),
-                "ttft_s": _percentiles(ttfts),
-                "inter_token_s": _percentiles(itls),
+                "ttft_s": _percentiles(ttfts, ps=(50, 95, 99)),
+                "inter_token_s": _percentiles(itls, ps=(50, 95, 99)),
                 "aggregate_tok_per_sec": round(toks / wall, 1),
             }
 
@@ -801,7 +821,14 @@ def _bench_serving_disagg(on_tpu: bool) -> dict:
             h = serve.run(app, name=name, _local_testing_mode=True)
             try:
                 run_clients(h)  # warm: compiles + primes the prefix cache
-                return run_clients(h)
+                out = run_clients(h, slo_dep=name)
+                from ray_tpu.serve._private import slo as _slo
+
+                dep = (_slo.get_ledger().snapshot()["deployments"]
+                       .get(name) or {})
+                out["ttft_sketch_s"] = dep.get("ttft")
+                out["inter_token_sketch_s"] = dep.get("itl")
+                return out
             finally:
                 serve.delete(name)
 
@@ -819,6 +846,13 @@ def _bench_serving_disagg(on_tpu: bool) -> dict:
         disagg["prefix_cache_hit_rate"] = round(
             hits / max(hits + misses, 1), 4)
         disagg["kv_handoff"] = runtime_metrics.kv_handoff_snapshot()
+        # engine-side stage tails (queue_wait/prefill/handoff/decode) from
+        # the SLO layer's stage sketches — the handle-level A/B has no HTTP
+        # ingress, so stages are the request-level view here
+        disagg["stage_sketch_s"] = {
+            dep: d.get("stages")
+            for dep, d in (_slo_snapshot().get("deployments") or {}).items()
+            if d.get("stages")}
 
         # -- decode-replica scaling: 1 -> 2 decode engines, one prefill --
         # (in-process engines on this box — on a pod each DecodeServer is
@@ -1096,6 +1130,23 @@ def _kv_handoff_snapshot() -> dict:
         return {"error": str(e)[:200]}
 
 
+def _slo_snapshot() -> dict:
+    """Serving SLO fold of THIS process's ledger (the serving benches run
+    local-mode, so ingress + replicas share the process): per deployment,
+    sketch percentiles (overall/tenant/stage), status counts, burn rates,
+    breach list — the same shape state.serving_slo() serves cluster-wide."""
+    try:
+        from ray_tpu.serve._private import slo
+
+        if slo._ledger is None:
+            return {}
+        snap = slo.get_ledger().snapshot()
+        snap.pop("time", None)
+        return snap
+    except Exception as e:  # noqa: BLE001
+        return {"error": str(e)[:200]}
+
+
 def _run_guarded(fn, timeout_s: float):
     """Run one bench section on a watchdog thread: ``(value, alive)``.
 
@@ -1258,6 +1309,7 @@ def main():
         "goodput": _goodput_snapshot(),
         "prefix_cache": _prefix_cache_snapshot(),
         "kv_handoff": _kv_handoff_snapshot(),
+        "slo": _slo_snapshot(),
     })
 
     result = {

@@ -1,0 +1,109 @@
+"""Per-token recording-cost microbench for the serving SLO layer.
+
+The lifecycle ledger sits INSIDE the serving hot path: ``tracker.tokens``
+runs once per SSE frame at full decode rate, and the engine's stage
+recorders run under the step lock.  This bench measures ns/record for the
+enabled and disabled paths and enforces the ISSUE 9 budgets:
+
+  - enabled per-token record  < 5 µs   (SLO_OVERHEAD_ENABLED_NS)
+  - disabled per-token record < 0.5 µs (SLO_OVERHEAD_DISABLED_NS)
+  - 64-replica sketch fold    < 250 ms (SLO_MERGE_BUDGET_MS)
+
+(CI-loose: the budgets catch order-of-magnitude regressions, not scheduler
+noise; measured on an idle host the enabled path is ~1-2 µs, disabled
+~0.1 µs, and the 64-way fold a few ms.)
+
+Prints one JSON line:
+  {"metric": "slo_record_overhead", "value": <enabled ns/token>, ...}
+Exit status 1 if any budget is exceeded.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def _bench(fn, n: int = 100_000) -> float:
+    """ns per call, best of 3 runs (min defends against CI noise)."""
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            fn()
+        best = min(best, (time.perf_counter() - t0) / n)
+    return best * 1e9
+
+
+def run() -> dict:
+    import random
+
+    from ray_tpu._private.latency_sketch import LatencySketch, merge_points
+    from ray_tpu.serve._private import slo
+
+    out: dict = {}
+
+    # -- enabled per-token path (tracker.tokens: clock + weighted sketch
+    # insert through the bound runtime-metrics recorder) -------------------
+    ledger = slo.ServingSLOLedger()
+    tracker = ledger.start_request("bench", "bench-tenant")
+    tracker.first_token()
+    out["tokens_enabled_ns"] = round(_bench(lambda: tracker.tokens(1)), 1)
+    out["stage_enabled_ns"] = round(_bench(
+        lambda: ledger.record_stage("bench", "decode", 0.01), 50_000), 1)
+
+    # -- disabled path (the NOOP tracker every hook sees when
+    # serve_slo_enabled=False) ---------------------------------------------
+    noop = slo.NOOP_TRACKER
+    out["tokens_disabled_ns"] = round(_bench(lambda: noop.tokens(1)), 1)
+
+    # -- raw sketch insert (the primitive everything sits on) --------------
+    sk = LatencySketch()
+    vals = [random.lognormvariate(-3, 1) for _ in range(256)]
+    it = iter(range(10**9))
+    out["sketch_add_ns"] = round(_bench(
+        lambda: sk.add(vals[next(it) & 255])), 1)
+
+    # -- 64-replica fold: the state.serving_slo() aggregation cost for a
+    # large fleet (64 sketches x 10k samples each) -------------------------
+    points = []
+    for r in range(64):
+        s = LatencySketch()
+        for _ in range(10_000):
+            s.add(random.lognormvariate(-3, 1))
+        points.append(s.to_point())
+    t0 = time.perf_counter()
+    merged = merge_points(points)
+    out["merge_64_ms"] = round((time.perf_counter() - t0) * 1e3, 2)
+    out["merge_64_count"] = merged["count"]
+    return out
+
+
+def main() -> int:
+    enabled_budget = float(os.environ.get("SLO_OVERHEAD_ENABLED_NS", 5_000))
+    disabled_budget = float(os.environ.get("SLO_OVERHEAD_DISABLED_NS", 500))
+    merge_budget = float(os.environ.get("SLO_MERGE_BUDGET_MS", 250))
+    extra = run()
+    ok = (extra["tokens_enabled_ns"] <= enabled_budget
+          and extra["stage_enabled_ns"] <= enabled_budget
+          and extra["tokens_disabled_ns"] <= disabled_budget
+          and extra["merge_64_ms"] <= merge_budget)
+    out = {
+        "metric": "slo_record_overhead",
+        "value": extra["tokens_enabled_ns"],
+        "unit": "ns",
+        "budget_enabled_ns": enabled_budget,
+        "budget_disabled_ns": disabled_budget,
+        "budget_merge_ms": merge_budget,
+        "ok": ok,
+        "extra": extra,
+    }
+    print(json.dumps(out))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    sys.exit(main())

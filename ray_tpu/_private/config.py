@@ -187,6 +187,28 @@ class RayTpuConfig:
     # overloaded and routing falls back to pow-2 (cache affinity must not
     # create hot spots)
     serve_prefix_overload_slack: int = 8
+    # --- serve: request-level SLO layer (serve/_private/slo.py) ---
+    # master switch for the per-request lifecycle ledger, latency sketches,
+    # per-tenant metering and burn-rate monitoring.  Off => the whole layer
+    # books NOTHING (no sketch inserts, no KV writes, no flight-recorder
+    # events) and the per-token cost is one no-op method call
+    serve_slo_enabled: bool = True
+    # default per-deployment SLO targets; serve.deployment(slo_config={...})
+    # overrides per deployment (keys: slo_ttft_ms, slo_itl_ms,
+    # slo_availability)
+    serve_slo_ttft_ms: float = 2000.0
+    serve_slo_itl_ms: float = 200.0
+    serve_slo_availability: float = 0.99
+    # burn-rate gauge + KV snapshot publish throttle (piggybacks on request
+    # completions — an idle deployment publishes nothing)
+    serve_slo_publish_interval_s: float = 2.0
+    # per-process recent-requests forensics ring (state.recent_requests());
+    # each KV snapshot ships the newest serve_slo_recent_publish of them
+    serve_slo_recent_capacity: int = 256
+    serve_slo_recent_publish: int = 64
+    # burn rate above this is reported as a breach by state.serving_slo()
+    # (1.0 = consuming error budget exactly as fast as the SLO allows)
+    serve_slo_burn_alert: float = 1.0
     # --- testing / chaos ---
     # Format mirrors RAY_testing_rpc_failure (reference: src/ray/rpc/rpc_chaos.h:23-35):
     # "method1=max_failures:req_prob:resp_prob,method2=..."

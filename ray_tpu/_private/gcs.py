@@ -1260,10 +1260,11 @@ class GcsServer:
             for p in points:
                 if stale and p["kind"] == "gauge":
                     continue
-                # histograms additionally keyed by boundaries so reporters
-                # with mismatched bucket layouts never get zip-truncated
+                # histograms additionally keyed by boundaries (mismatched
+                # bucket layouts never get zip-truncated); sketches by
+                # their relative accuracy (mismatched gammas don't merge)
                 key = (p["name"], tuple(sorted(p.get("tags", {}).items())),
-                       tuple(p.get("boundaries") or ()))
+                       tuple(p.get("boundaries") or ()), p.get("accuracy"))
                 cur = agg.get(key)
                 if cur is None:
                     agg[key] = dict(p)
@@ -1274,6 +1275,22 @@ class GcsServer:
                     cur["buckets"] = [a + b for a, b in zip(cur["buckets"], p["buckets"])]
                     cur["sum"] += p["sum"]
                     cur["count"] += p["count"]
+                elif p["kind"] == "sketch":
+                    # lossless fold: same-gamma log buckets add, so the
+                    # aggregate's quantiles are those of the combined
+                    # stream (the property plain histograms lack)
+                    bins = dict((int(i), int(c)) for i, c in cur.get("bins", ()))
+                    for i, c in p.get("bins", ()):
+                        bins[int(i)] = bins.get(int(i), 0) + int(c)
+                    cur["bins"] = sorted(bins.items())
+                    cur["zero"] = cur.get("zero", 0) + p.get("zero", 0)
+                    cur["sum"] += p["sum"]
+                    if cur.get("count") and p.get("count"):
+                        cur["min"] = min(cur["min"], p["min"])
+                        cur["max"] = max(cur["max"], p["max"])
+                    elif p.get("count"):
+                        cur["min"], cur["max"] = p["min"], p["max"]
+                    cur["count"] = cur.get("count", 0) + p.get("count", 0)
                 elif report_time >= gauge_time[key]:
                     cur["value"] = p["value"]
                     gauge_time[key] = report_time

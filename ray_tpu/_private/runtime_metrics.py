@@ -24,7 +24,7 @@ from __future__ import annotations
 import threading
 from typing import Dict, Optional, Tuple
 
-from ray_tpu.util.metrics import Counter, Gauge, Histogram
+from ray_tpu.util.metrics import Counter, Gauge, Histogram, Sketch
 
 # latency boundaries tuned for control-plane work: 100 µs .. 30 s
 _LATENCY_BOUNDS = [0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.25,
@@ -323,6 +323,49 @@ SERVE_DISAGG_QUEUE_DEPTH = Gauge(
     "Live requests per disaggregated serving stage (prefill = queued + "
     "mid-prefill, decode = decode-active slots)",
     tag_keys=("stage",))
+# -- serving SLO layer (request lifecycle ledger, serve/_private/slo.py) ----
+# Mergeable quantile sketches (kind=sketch, lossless cluster fold through
+# the GCS aggregate): TTFT and per-token inter-token latency at the ingress
+# split by tenant; per-stage durations replica/engine-side.  Tenant ids are
+# a bounded operator-assigned set (like deployment names); the SLO layer
+# caps the tag value length.  Recorded only when serve_slo_enabled — the
+# disabled path books nothing anywhere in the lifecycle.
+SERVE_TTFT = Sketch(
+    "ray_tpu_serve_ttft_seconds",
+    "Time to first token per request at the serving ingress (sketch: "
+    "cluster-mergeable p50/p99 within 2% relative error)",
+    relative_accuracy=0.01, tag_keys=("deployment", "tenant"))
+SERVE_ITL = Sketch(
+    "ray_tpu_serve_itl_seconds",
+    "Per-token inter-token latency during streamed decode at the serving "
+    "ingress (one weighted insert per SSE frame)",
+    relative_accuracy=0.01, tag_keys=("deployment", "tenant"))
+SERVE_STAGE_SECONDS = Sketch(
+    "ray_tpu_serve_stage_seconds",
+    "Per-request serving-stage durations: proxy_queue (executor wait), "
+    "queue_wait (engine admission), prefill, handoff (P/D import leg), "
+    "decode (first token to completion), total",
+    relative_accuracy=0.01, tag_keys=("deployment", "stage"))
+SERVE_ROUTE_DECISIONS = Counter(
+    "ray_tpu_serve_route_decisions_total",
+    "Cache-aware router outcomes per routed request (prefix_hit = longest-"
+    "chain affinity won, pow2_cold = no chain matched, overload_divert = "
+    "affinity winner over the overload slack, stale_row = the would-be "
+    "winner's digest row left the live set, shun_resubmit = re-route after "
+    "a caller observed the replica dead)",
+    tag_keys=("reason",))
+SERVE_SLO_REQUESTS = Counter(
+    "ray_tpu_serve_slo_requests_total",
+    "Requests reaching a terminal lifecycle state at the serving ingress "
+    "(ok / error / aborted = client disconnect / shed = admission refusal)",
+    tag_keys=("deployment", "tenant", "status"))
+SERVE_SLO_BURN_RATE = Gauge(
+    "ray_tpu_serve_slo_burn_rate",
+    "SLO error-budget burn rate per deployment, objective (ttft / itl / "
+    "availability) and trailing window (5m / 1h): breach fraction over the "
+    "window divided by the budget (1 - slo_availability); >1 burns budget "
+    "faster than the SLO allows",
+    tag_keys=("deployment", "window", "objective"))
 
 # -- data -------------------------------------------------------------------
 DATA_ROWS = Counter(
@@ -358,6 +401,8 @@ FAMILIES = (
     SERVE_PREFIX_CACHE_HITS, SERVE_PREFIX_CACHE_MISSES,
     SERVE_PREFIX_CACHE_EVICTIONS,
     KV_HANDOFF_BYTES, KV_HANDOFF_LATENCY, SERVE_DISAGG_QUEUE_DEPTH,
+    SERVE_TTFT, SERVE_ITL, SERVE_STAGE_SECONDS, SERVE_ROUTE_DECISIONS,
+    SERVE_SLO_REQUESTS, SERVE_SLO_BURN_RATE,
     DATA_ROWS, DATA_BACKPRESSURE,
 )
 
@@ -713,6 +758,84 @@ def record_kv_handoff(transport: str, nbytes: int, seconds: float) -> None:
 
 def set_disagg_queue_depth(stage: str, n: int) -> None:
     _bound(SERVE_DISAGG_QUEUE_DEPTH, stage=stage).set(n)
+
+
+# -- serving SLO layer ------------------------------------------------------
+
+
+def observe_ttft(deployment: str, tenant: str, seconds: float) -> None:
+    _bound(SERVE_TTFT, deployment=deployment, tenant=tenant).observe(seconds)
+
+
+def observe_itl(deployment: str, tenant: str, seconds: float,
+                n: int = 1) -> None:
+    """One weighted insert per SSE frame: ``seconds`` is the per-token
+    inter-token latency, ``n`` the tokens the frame carried."""
+    _bound(SERVE_ITL, deployment=deployment, tenant=tenant).observe(
+        seconds, n)
+
+
+def observe_serve_stage(deployment: str, stage: str, seconds: float) -> None:
+    _bound(SERVE_STAGE_SECONDS, deployment=deployment, stage=stage).observe(
+        seconds)
+
+
+def inc_route_decision(reason: str) -> None:
+    _bound(SERVE_ROUTE_DECISIONS, reason=reason).inc()
+
+
+def inc_slo_request(deployment: str, tenant: str, status: str) -> None:
+    _bound(SERVE_SLO_REQUESTS, deployment=deployment, tenant=tenant,
+           status=status).inc()
+
+
+def set_slo_burn_rate(deployment: str, window: str, objective: str,
+                      rate: float) -> None:
+    _bound(SERVE_SLO_BURN_RATE, deployment=deployment, window=window,
+           objective=objective).set(rate)
+
+
+def route_decision_snapshot() -> dict:
+    """Process-local router forensics: decision counts by reason."""
+    out: dict = {}
+    for tags_key, v in dict(SERVE_ROUTE_DECISIONS._points).items():
+        reason = dict(tags_key).get("reason", "?")
+        out[reason] = out.get(reason, 0.0) + v
+    return out
+
+
+def serving_sketch_snapshot() -> dict:
+    """Process-local serving latency sketches for bench.py and the perf
+    tests: per deployment, TTFT/ITL percentiles overall and split by
+    tenant, plus per-stage percentiles.  Hermetic — this process's
+    sketches only (cluster-wide folds go through state.serving_slo())."""
+    from ray_tpu._private.latency_sketch import merge_points, summary
+
+    out: dict = {}
+
+    def _fold(metric, field, split_key):
+        by_dep: dict = {}
+        for p in metric._snapshot():
+            dep = p["tags"].get("deployment", "?")
+            by_dep.setdefault(dep, []).append(p)
+        for dep, points in by_dep.items():
+            d = out.setdefault(dep, {})
+            merged = merge_points(points)
+            if merged:
+                d[field] = summary(merged)
+            per = d.setdefault(f"{field}_by_{split_key}", {})
+            for p in points:
+                per[p["tags"].get(split_key, "?")] = summary(p)
+
+    _fold(SERVE_TTFT, "ttft", "tenant")
+    _fold(SERVE_ITL, "itl", "tenant")
+    _fold(SERVE_STAGE_SECONDS, "stage", "stage")
+    for dep, d in out.items():
+        # stage merge across stages is meaningless; keep the split only
+        d.pop("stage", None)
+        if "stage_by_stage" in d:
+            d["stages"] = d.pop("stage_by_stage")
+    return out
 
 
 def prefix_cache_snapshot() -> dict:

@@ -8,7 +8,7 @@ contract here: ``generate_configs(out_dir, metrics_url)`` materializes
     out_dir/prometheus.yml
     out_dir/grafana/provisioning/datasources/ray_tpu.yml
     out_dir/grafana/provisioning/dashboards/ray_tpu.yml
-    out_dir/grafana/dashboards/{cluster,serve,events,runtime}.json
+    out_dir/grafana/dashboards/{cluster,serve,slo,events,runtime}.json
 
 against the core metric names exported by the dashboard head's /metrics
 (see head.py core_metrics_text): ray_tpu_nodes, ray_tpu_actors,
@@ -72,6 +72,39 @@ def serve_dashboard() -> dict:
                ["rate(ray_tpu_serve_requests_total[5m])"], 12, 0, unit="reqps"),
         _panel(3, "Queue depth", ["ray_tpu_serve_queued"], 0, 8),
         _panel(4, "Apps", ["ray_tpu_serve_apps"], 12, 8),
+    ])
+
+
+def slo_dashboard() -> dict:
+    """Serving SLO page (serve/_private/slo.py): sketch-derived tail
+    latencies per deployment and tenant, error-budget burn rates per
+    window/objective, route-decision forensics, terminal statuses."""
+    return _dashboard("ray-tpu-slo", "ray_tpu serving SLOs", [
+        _panel(1, "TTFT p50/p99 by deployment",
+               ['ray_tpu_serve_ttft_seconds{quantile="0.5"}',
+                'ray_tpu_serve_ttft_seconds{quantile="0.99"}'],
+               0, 0, unit="s"),
+        _panel(2, "Inter-token latency p50/p99 by deployment",
+               ['ray_tpu_serve_itl_seconds{quantile="0.5"}',
+                'ray_tpu_serve_itl_seconds{quantile="0.99"}'],
+               12, 0, unit="s"),
+        _panel(3, "SLO burn rate (5m/1h by objective; >1 = burning budget)",
+               ["ray_tpu_serve_slo_burn_rate"], 0, 8),
+        _panel(4, "Requests by terminal status (ok/error/aborted/shed)",
+               ["rate(ray_tpu_serve_slo_requests_total[5m])"], 12, 8,
+               unit="reqps"),
+        _panel(5, "Per-tenant TTFT p99",
+               ['ray_tpu_serve_ttft_seconds{quantile="0.99"}'], 0, 16,
+               unit="s"),
+        _panel(6, "Router decisions by reason",
+               ["rate(ray_tpu_serve_route_decisions_total[5m])"], 12, 16,
+               unit="reqps"),
+        _panel(7, "Serving stage p99 (queue_wait/prefill/handoff/decode)",
+               ['ray_tpu_serve_stage_seconds{quantile="0.99"}'], 0, 24,
+               unit="s"),
+        _panel(8, "Prefix-cache hit rate vs disagg queue depth",
+               ["rate(ray_tpu_serve_prefix_cache_hits_total[5m])",
+                "ray_tpu_serve_disagg_queue_depth"], 12, 24),
     ])
 
 
@@ -177,6 +210,7 @@ def generate_configs(out_dir: str, metrics_url: str) -> Dict[str, str]:
 
     for name, dash in (("cluster", cluster_dashboard()),
                        ("serve", serve_dashboard()),
+                       ("slo", slo_dashboard()),
                        ("events", events_dashboard()),
                        ("runtime", runtime_dashboard())):
         p = os.path.join(dash_dir, f"{name}.json")

@@ -22,6 +22,8 @@ Endpoints:
   GET /api/flight_recorder  per-process flight-recorder tails [?pid=&seconds=]
   GET /api/diagnose         cluster hang sweep (blocking members, stragglers)
   GET /api/goodput          train wall-clock by bucket per run [?run=]
+  GET /api/slo              serving SLO report: percentiles, burn rates, breaches
+  GET /api/recent_requests  newest completed serve requests [?limit=&tenant=]
   GET /metrics              Prometheus exposition of cluster metrics
 """
 
@@ -210,6 +212,20 @@ class DashboardHead:
             # published goodput ledgers: wall-clock by bucket per train run
             run = (query or {}).get("run", [None])[0]
             return state.goodput(run)
+        if path == "/api/slo":
+            # cluster serving SLO report: sketch percentiles (per
+            # deployment/tenant/stage), burn rates per window/objective,
+            # breach list.  ?deployment=<name> narrows.
+            dep = (query or {}).get("deployment", [None])[0]
+            return state.serving_slo(dep)
+        if path == "/api/recent_requests":
+            # overload forensics: newest completed requests cluster-wide
+            # [?limit=&deployment=&tenant=]
+            q = query or {}
+            return state.recent_requests(
+                limit=int(q.get("limit", ["100"])[0]),
+                deployment=q.get("deployment", [None])[0],
+                tenant=q.get("tenant", [None])[0])
         if path == "/api/events":
             return state.list_cluster_events()
         if path == "/api/serve":

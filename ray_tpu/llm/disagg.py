@@ -66,6 +66,14 @@ class PrefillServer:
         self._inflight = 0
         self._lock = threading.Lock()
 
+    def set_slo_label(self, name: str) -> None:
+        """Serving SLO threading (serve/_private/replica.py): engine-side
+        lifecycle stages book under the prefill deployment's name."""
+        try:
+            self._engine.slo_label = name
+        except Exception:  # noqa: BLE001
+            pass
+
     def prefix_digest(self) -> Dict[str, Any]:
         digest = self._engine.prefix_digest()
         digest["models"] = []
@@ -225,8 +233,14 @@ class DecodeServer(LLMServer):
         nbytes = (chan.last_read_nbytes
                   if (chan is not None and transport.startswith("channel"))
                   else (k.nbytes + v.nbytes))
-        runtime_metrics.record_kv_handoff(
-            transport, nbytes, time.perf_counter() - t0)
+        handoff_s = time.perf_counter() - t0
+        runtime_metrics.record_kv_handoff(transport, nbytes, handoff_s)
+        # lifecycle stage under the decode deployment's label (the receiver
+        # leg is the authoritative per-handoff observation, matching the
+        # kv_handoff metric convention)
+        from ray_tpu.serve._private import slo
+
+        slo.record_stage(self._slo_label, "handoff", handoff_s)
         wkey = (None, 0, res["request_id"])
         # seed the waiter with the prefill-sampled first token: the engine
         # emitted it before the loop's next snapshot, so the loop alone
@@ -297,6 +311,10 @@ class DisaggLLMServer:
         self._decode = decode_handle
         self._transport = transport
         self._compression = handoff_compression
+        self._slo_label: Optional[str] = None
+
+    def set_slo_label(self, name: str) -> None:
+        self._slo_label = name
 
     def _make_channel(self):
         from ray_tpu.experimental.channel.xla_tensor_channel import (

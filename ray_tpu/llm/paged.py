@@ -265,6 +265,11 @@ class _PagedReq(_Request):
     blocks: List[int] = dataclasses.field(default_factory=list)
     prefill_pos: int = 0      # prompt tokens already in the pool
     admitted_order: int = 0   # preemption picks the youngest
+    # request-lifecycle stamps (serving SLO layer; only read when the
+    # engine carries an slo_label — direct engine use books nothing)
+    t_enqueue: float = 0.0
+    t_admit: float = 0.0
+    t_first_emit: float = 0.0
 
 
 def _bucket_pow2(n: int, lo: int = 1) -> int:
@@ -412,6 +417,10 @@ class PagedJaxLLMEngine:
         self._req_counter = 0
         self._admit_counter = 0
         self._lock = threading.Lock()
+        # serving SLO layer: the hosting deployment's name, set via the
+        # replica's set_slo_label threading (serve/_private/replica.py).
+        # None (direct engine use) books no lifecycle stages at all.
+        self.slo_label: Optional[str] = None
         # one decode chunk may stay IN FLIGHT while the host books the
         # previous chunk's tokens: the readback of chunk N overlaps chunk
         # N+1's device compute, hiding the dispatch+fence round trip
@@ -546,6 +555,8 @@ class PagedJaxLLMEngine:
         with self._lock:
             self._req_counter += 1
             req = _PagedReq(self._req_counter, list(prompt), gen)
+            if self.slo_label is not None:
+                req.t_enqueue = time.monotonic()
             self._requests[req.request_id] = req
             self._pending.append(req)
             return req.request_id
@@ -675,6 +686,17 @@ class PagedJaxLLMEngine:
             self._admit_counter += 1
             req.admitted_order = self._admit_counter
             self._slot_req[slot] = req
+            if self.slo_label is not None and req.t_enqueue:
+                # first admission only: a preempted request re-queues with
+                # t_admit already set — its queue_wait was booked once
+                if not req.t_admit:
+                    from ray_tpu.serve._private import slo
+
+                    req.t_admit = time.monotonic()
+                    slo.record_stage(self.slo_label, "queue_wait",
+                                     req.t_admit - req.t_enqueue)
+                else:
+                    req.t_admit = time.monotonic()
 
     def _prefill_step_locked(self):
         """Advance mid-prefill slots, one chunk per slot, until the step's
@@ -716,6 +738,11 @@ class PagedJaxLLMEngine:
                 jnp.asarray([req.gen.top_k], np.int32))
             req.prefill_pos = p0 + take
             if is_last:
+                if self.slo_label is not None and req.t_admit:
+                    from ray_tpu.serve._private import slo
+
+                    slo.record_stage(self.slo_label, "prefill",
+                                     time.monotonic() - req.t_admit)
                 # trim chunk-padding blocks; decode's ensure pass re-allocates
                 keep = math.ceil(plen / self.bs)
                 if len(req.blocks) > keep:
@@ -731,10 +758,17 @@ class PagedJaxLLMEngine:
 
     def _emit_locked(self, req: _PagedReq, token: int):
         req.out_tokens.append(token)
+        if self.slo_label is not None and not req.t_first_emit:
+            req.t_first_emit = time.monotonic()
         if (token in req.gen.stop_token_ids
                 or len(req.out_tokens) >= req.gen.max_new_tokens
                 or self._lengths[req.slot] + 1 >= self.max_seq):
             req.done = True
+            if self.slo_label is not None and req.t_first_emit:
+                from ray_tpu.serve._private import slo
+
+                slo.record_stage(self.slo_label, "decode",
+                                 time.monotonic() - req.t_first_emit)
             self._free_slot_locked(req)
 
     def _free_slot_locked(self, req: _PagedReq):
@@ -971,6 +1005,37 @@ class PagedJaxLLMEngine:
             before = self._emit_snapshot_locked()
             self._drain_locked()
             return self._gather_emitted_locked(before)
+
+    def cancel_request(self, request_id: int) -> bool:
+        """Abort a live request and return its slot + blocks to the pool
+        NOW (a disconnected streaming client must not keep decoding to
+        max_new_tokens for nobody).  Safe at any lifecycle point: queued,
+        mid-prefill, or decode-active.  Returns False if the request
+        already finished (or never existed)."""
+        from ray_tpu._private import flight_recorder
+
+        with self._lock:
+            req = self._requests.get(request_id)
+            if req is None:
+                return False
+            del self._requests[request_id]
+            if req in self._pending:
+                try:
+                    self._pending.remove(req)
+                except ValueError:
+                    pass
+            elif req.slot >= 0:
+                # the in-flight decode chunk may still WRITE blocks this
+                # request owns — never free them under it (the same
+                # argument as preemption's drain)
+                if self._inflight is not None:
+                    self._drain_locked()
+                if req.slot >= 0 and self._slot_req[req.slot] is req:
+                    self._free_slot_locked(req)
+            req.done = True
+            flight_recorder.record("request", self.slo_label or "paged",
+                                   (request_id, "cancel"))
+            return True
 
     # -- disaggregated prefill/decode handoff ---------------------------
 

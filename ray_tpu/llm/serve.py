@@ -55,14 +55,33 @@ class LLMServer:
         # wkeys some caller is still consuming — eviction cleanup must not
         # delete their results out from under them (guarded by _cv's lock)
         self._active_waiters: set = set()
+        # wkeys aborted mid-stream (client disconnect): one trailing
+        # emission batch may still surface after the engine cancel — it
+        # must not recreate the popped waiter entry as a leaked _done row
+        # (guarded by _cv's lock; bounded by the clear-cap below)
+        self._aborted: set = set()
         self._stop = False
         self._error: Optional[BaseException] = None
         self._loop = threading.Thread(target=self._run, daemon=True,
                                       name="llm-engine-loop")
         self._loop.start()
 
+    _slo_label: Optional[str] = None
+
     def lora_model_ids(self) -> List[str]:
         return sorted(self._adapters)
+
+    def set_slo_label(self, name: str) -> None:
+        """Serving SLO layer threading (serve/_private/replica.py): label
+        this server's engines with the hosting deployment's name so
+        engine-side lifecycle stages (queue_wait, prefill, decode) book
+        under it.  Unlabeled servers (direct library use) book nothing."""
+        self._slo_label = name
+        for eng in list(self._engines.values()):
+            try:
+                eng.slo_label = name
+            except Exception:  # noqa: BLE001 — static engine variants
+                pass
 
     def prefix_digest(self) -> Dict[str, Any]:
         """Cache-aware routing surface (serve/handle.py): the base engine's
@@ -102,8 +121,14 @@ class LLMServer:
 
     def _iter_tokens(self, wkey):
         """Yield ``wkey``'s token chunks as they decode (generate_stream's
-        engine-side loop, shared with the disaggregated decode stage)."""
+        engine-side loop, shared with the disaggregated decode stage).
+
+        Closing the generator BEFORE exhaustion (the caller's client
+        disconnected — the proxy closes the stream chain) aborts the
+        engine-side request: its slot and KV blocks return to the pool
+        immediately instead of decoding to max_new_tokens for nobody."""
         sent = 0
+        completed = False
         try:
             while True:
                 with self._cv:
@@ -126,10 +151,38 @@ class LLMServer:
                 if chunk:
                     yield chunk
                 if done:
+                    completed = True
                     return
         finally:
+            if not completed:
+                self._abort_wkey(wkey)
             with self._cv:
                 self._active_waiters.discard(wkey)
+
+    def _abort_wkey(self, wkey) -> None:
+        """Cancel ``wkey``'s engine request and drop its buffers (stream
+        abandoned mid-decode).  Best-effort: a request that finished in
+        the race just cleans its unclaimed buffers."""
+        model, gen_id, rid = wkey
+        try:
+            if model is None:
+                eng = self._engine
+            else:
+                with self._engines_lock:
+                    eng = (self._engines.get(model)
+                           if self._engine_gen.get(model, 0) == gen_id
+                           else None)
+            cancel = getattr(eng, "cancel_request", None)
+            if cancel is not None:
+                cancel(rid)
+        except Exception:  # noqa: BLE001 — abort must never mask the close
+            pass
+        with self._cv:
+            self._waiters.pop(wkey, None)
+            self._done.pop(wkey, None)
+            self._aborted.add(wkey)
+            if len(self._aborted) > 4096:  # never-seen-again backstop
+                self._aborted.clear()
 
     _MAX_ADAPTER_ENGINES = 4
 
@@ -156,6 +209,11 @@ class LLMServer:
                 eng = self._engines.get(model)
                 if eng is None and built is not None:
                     self._engine_gen[model] = self._engine_gen.get(model, 0) + 1
+                    if self._slo_label is not None:
+                        try:
+                            built.slo_label = self._slo_label
+                        except Exception:  # noqa: BLE001
+                            pass
                     self._engines[model] = eng = built
                 if eng is not None:
                     rid = eng.add_request(prompt, gen)
@@ -216,14 +274,21 @@ class LLMServer:
                 if emitted:
                     with self._cv:
                         for rid, toks in emitted.items():
-                            self._waiters.setdefault(
-                                (key, gen_id, rid), []).extend(toks)
+                            wk = (key, gen_id, rid)
+                            if wk in self._aborted:
+                                self._aborted.discard(wk)
+                                continue
+                            self._waiters.setdefault(wk, []).extend(toks)
                         with engine._lock:
                             live = set(engine._requests)
                         for wkey in list(self._waiters):
                             if (wkey[0] == key and wkey[1] == gen_id
                                     and wkey[2] not in live):
-                                self._done[wkey] = self._waiters.pop(wkey)
+                                buf = self._waiters.pop(wkey)
+                                if wkey in self._aborted:
+                                    self._aborted.discard(wkey)
+                                else:
+                                    self._done[wkey] = buf
                         self._cv.notify_all()
             if not worked:
                 time.sleep(0.002)

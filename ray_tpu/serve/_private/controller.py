@@ -86,13 +86,57 @@ class ServeController:
         with self._lock:
             self._desired[app_name] = {d["name"]: d for d in deployments}
             self._version += 1
+        # distribute explicit SLO targets cluster-wide (serve/_private/
+        # slo.py): ingress ledgers and state.serving_slo() read these rows;
+        # deployments without slo_config use the config defaults
+        self._put_slo_conf(deployments)
         return True
 
     def delete_application(self, app_name: str) -> bool:
         with self._lock:
-            self._desired.pop(app_name, None)
+            app = self._desired.pop(app_name, None)
             self._version += 1
+        if app:
+            self._del_slo_conf(app.values())
         return True
+
+    @staticmethod
+    def _put_slo_conf(deployments) -> None:
+        try:
+            import json as _json
+
+            from ray_tpu.serve._private.slo import conf_kv_key
+            from ray_tpu._private.worker import get_global_worker
+
+            gcs = get_global_worker().gcs
+            for d in deployments:
+                if d.get("slo_config"):
+                    gcs.call("KVPut", {
+                        "key": conf_kv_key(d["name"]),
+                        "value": _json.dumps(d["slo_config"]),
+                    }, timeout=2, retry_deadline=0.0)
+                else:
+                    # a redeploy that DROPPED slo_config must fall back to
+                    # the config defaults — a stale row would keep judging
+                    # breaches against targets the operator removed
+                    gcs.call("KVDel", {"key": conf_kv_key(d["name"])},
+                             timeout=2, retry_deadline=0.0)
+        except Exception:  # noqa: BLE001 — targets fall back to defaults
+            pass
+
+    @staticmethod
+    def _del_slo_conf(deployments) -> None:
+        try:
+            from ray_tpu.serve._private.slo import conf_kv_key
+            from ray_tpu._private.worker import get_global_worker
+
+            gcs = get_global_worker().gcs
+            for d in deployments:
+                if d.get("slo_config"):
+                    gcs.call("KVDel", {"key": conf_kv_key(d["name"])},
+                             timeout=2, retry_deadline=0.0)
+        except Exception:  # noqa: BLE001 — cleanup is best-effort
+            pass
 
     def get_version(self) -> int:
         return self._version

@@ -108,6 +108,16 @@ def run_local(app, name: str = "default") -> LocalDeploymentHandle:
             instance = target
         if spec.get("user_config") is not None and hasattr(instance, "reconfigure"):
             instance.reconfigure(spec["user_config"])
+        # serving SLO layer: same threading the cluster replica does
+        # (deployment label for engine-side stages, local SLO targets)
+        if hasattr(instance, "set_slo_label"):
+            try:
+                instance.set_slo_label(spec["name"])
+            except Exception:  # noqa: BLE001
+                pass
+        from ray_tpu.serve._private import slo
+
+        slo.register_targets(spec["name"], spec.get("slo_config"))
         instances[spec["name"]] = instance
     ingress = deployments[-1]["name"]
     handle = LocalDeploymentHandle(instances[ingress], ingress)

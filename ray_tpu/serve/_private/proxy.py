@@ -228,8 +228,15 @@ class _AsyncProxy:
             except Exception:  # noqa: BLE001
                 pass
 
+    @staticmethod
+    def _deployment_of(handle) -> str:
+        # DeploymentHandle carries _dep; LocalDeploymentHandle carries _name
+        return (getattr(handle, "_dep", None)
+                or getattr(handle, "_name", None) or "app")
+
     async def _dispatch(self, writer, method: str, target: str,
                         headers: Dict[str, str], body: bytes) -> bool:
+        from ray_tpu.serve._private import slo
         from ray_tpu.util import tracing
 
         path = target.split("?")[0]
@@ -261,16 +268,29 @@ class _AsyncProxy:
         except json.JSONDecodeError:
             payload = body.decode() if body else None
 
+        # request-level SLO lifecycle (serve/_private/slo.py): every
+        # ingress request gets a tracker carrying the tenant id (x-tenant
+        # header / request-dict field / default); the NOOP tracker makes
+        # the disabled path one empty call per hook
+        tracker = slo.start_request(
+            self._deployment_of(handle),
+            tenant=slo.extract_tenant(headers=headers, payload=payload),
+            trace_id=ctx3[0] if ctx3 else None)
+
         if isinstance(payload, dict) and payload.get("stream"):
             await self._dispatch_stream(writer, handle, payload,
                                         ctx3=ctx3,
-                                        trace_headers=trace_headers)
+                                        trace_headers=trace_headers,
+                                        tracker=tracker)
             return False  # SSE ends with connection close (no chunked TE)
 
         loop = asyncio.get_running_loop()
+        t_queued = time.perf_counter()
 
         def call():
-            with tracing.activate_span(
+            slo.record_stage(tracker.deployment or None, "proxy_queue",
+                             time.perf_counter() - t_queued)
+            with slo.activate(tracker), tracing.activate_span(
                     ctx3, f"HTTP {method} {path}", kind="server",
                     attributes={"http.method": method, "http.path": path}):
                 if payload is None:
@@ -279,9 +299,11 @@ class _AsyncProxy:
 
         try:
             result = await loop.run_in_executor(self._pool, call)
+            tracker.finish("ok")
             out = json.dumps(result, default=str).encode()
             writer.write(self._response(200, out, extra_headers=trace_headers))
         except Exception as e:  # noqa: BLE001
+            tracker.finish("error")
             writer.write(self._response(
                 500, json.dumps({"error": str(e)}).encode(),
                 extra_headers=trace_headers))
@@ -289,12 +311,21 @@ class _AsyncProxy:
         return True
 
     async def _dispatch_stream(self, writer, handle, payload, ctx3=None,
-                               trace_headers=None):
+                               trace_headers=None, tracker=None):
         """Server-sent events: one `data:` frame per streamed item, then
         `data: [DONE]` (the OpenAI SSE convention). The blocking generator is
         drained on the executor; frames hop to the event loop via a queue so
-        many streams interleave on one loop."""
+        many streams interleave on one loop.
+
+        Lifecycle: the first data frame books TTFT, every later frame books
+        weighted per-token ITL samples; a client disconnect mid-stream is a
+        terminal ``aborted`` event (and closing the generator propagates to
+        the engine, which frees the request's slot)."""
+        from ray_tpu.serve._private import slo
         from ray_tpu.util import tracing
+
+        if tracker is None:
+            tracker = slo.NOOP_TRACKER
 
         loop = asyncio.get_running_loop()
         q: asyncio.Queue = asyncio.Queue()  # soft-bounded by put_from_thread
@@ -322,24 +353,48 @@ class _AsyncProxy:
 
         def pump():
             try:
-                with tracing.activate_span(ctx3, "HTTP stream",
-                                           kind="server"):
+                with slo.activate(tracker), tracing.activate_span(
+                        ctx3, "HTTP stream", kind="server"):
                     gen = handle.options(stream=True).remote(payload)
-                    for item in gen:
-                        if stop.is_set():
-                            return
-                        frame = (b"data: "
-                                 + json.dumps(item, default=str).encode()
-                                 + b"\n\n")
-                        if not put_from_thread(frame):
-                            return
-                    put_from_thread(b"data: [DONE]\n\n")
+                    completed = False
+                    try:
+                        for item in gen:
+                            if stop.is_set():
+                                return
+                            # lifecycle: first frame = TTFT, then weighted
+                            # ITL (a frame may carry a chunk of tokens)
+                            tracker.tokens(
+                                len(item) if isinstance(item, (list, tuple))
+                                else 1)
+                            frame = (b"data: "
+                                     + json.dumps(item, default=str).encode()
+                                     + b"\n\n")
+                            if not put_from_thread(frame):
+                                return
+                        completed = True
+                        tracker.finish("ok")
+                        put_from_thread(b"data: [DONE]\n\n")
+                    finally:
+                        # abandoned mid-stream ONLY (client gone): close
+                        # the generator NOW so the engine-side request is
+                        # cancelled and its slot frees, instead of decoding
+                        # to max_new_tokens for nobody.  An exhausted
+                        # stream must NOT close — cluster-mode close issues
+                        # a cancel RPC, pure waste on every happy path.
+                        if not completed:
+                            close = getattr(gen, "close", None)
+                            if close is not None:
+                                close()
             except Exception as e:  # noqa: BLE001
+                tracker.finish("error")
                 if not stop.is_set():
                     err = (b"data: " + json.dumps({"error": str(e)}).encode()
                            + b"\n\ndata: [DONE]\n\n")
                     put_from_thread(err)
             finally:
+                # terminal state for a disconnected client (finish() is
+                # first-wins: a completed stream stays "ok")
+                tracker.abort()
                 put_from_thread(_END)
 
         trace_head = "".join(f"{k}: {v}\r\n" for k, v in (trace_headers or ()))

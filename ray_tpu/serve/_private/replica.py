@@ -52,6 +52,15 @@ class ServeReplica:
         if hasattr(self._callable, "prefix_digest"):
             threading.Thread(target=self._publish_digest_loop, daemon=True,
                              name="serve-prefix-digest").start()
+        # serving SLO layer: thread the deployment name into the hosted
+        # callable so engine-side lifecycle stages (queue_wait, prefill,
+        # decode) book under it (llm/serve.py set_slo_label); callables
+        # without the hook just don't produce stage rows
+        if hasattr(self._callable, "set_slo_label"):
+            try:
+                self._callable.set_slo_label(deployment_name)
+            except Exception:  # noqa: BLE001 — metering must not fail init
+                pass
         # built-in per-deployment request metrics (latency histogram +
         # monotonic request counter; rate() of the counter is QPS) — bound
         # once here, recorded per request at constant cost
@@ -65,6 +74,12 @@ class ServeReplica:
     def _record_request(self, t0: float):
         self._latency_metric.observe(time.perf_counter() - t0)
         self._requests_metric.inc()
+        # throttled SLO snapshot publication for replica processes: stage
+        # sketches recorded inside the engine step loop reach the GCS KV
+        # here, per handled request and OUTSIDE any engine lock
+        from ray_tpu.serve._private import slo
+
+        slo.maybe_publish()
 
     def _publish_digest_loop(self):
         """Throttled, versioned digest publication.  The version bumps only

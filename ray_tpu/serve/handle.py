@@ -180,7 +180,12 @@ class _Router:
         holding the longest matching prefix chain for the request's prompt
         (composing with LoRA adapter affinity), unless the prefix is cold,
         digests are absent, or the winner is overloaded — then power of
-        two choices by (cached) queue length."""
+        two choices by (cached) queue length.
+
+        Every decision books its reason (prefix_hit / pow2_cold /
+        overload_divert / stale_row) to
+        ``ray_tpu_serve_route_decisions_total`` and to the active request's
+        lifecycle — cache-router regressions were previously invisible."""
         self._refresh()
         with self._lock:
             replicas = list(self._replicas)
@@ -196,10 +201,13 @@ class _Router:
         if len(replicas) == 1:
             return replicas[0]
         from ray_tpu._private.config import global_config
+        from ray_tpu.serve._private import slo
 
         cfg = global_config()
         if cfg.serve_prefix_routing_enabled:
-            chosen = self._prefix_choice(replicas, args, kwargs or {}, cfg)
+            chosen, reason = self._prefix_choice(replicas, args, kwargs or {},
+                                                 cfg)
+            slo.note_route(reason)
             if chosen is not None:
                 return chosen
         return self._pow2_choice(replicas, cfg)
@@ -242,23 +250,25 @@ class _Router:
             self._digests = {}
 
     def _prefix_choice(self, replicas, args, kwargs, cfg):
-        """The longest-matching-prefix winner, or None for pow-2 fallback.
-        Stale digest rows (replicas no longer in the live set) are ignored
-        — the live set is the controller's, so a drained winner can't be
-        chosen from a stale row."""
+        """(winner, reason): the longest-matching-prefix winner with reason
+        ``prefix_hit``, or (None, fallback-reason) for pow-2.  Stale digest
+        rows (replicas no longer in the live set) are ignored — the live
+        set is the controller's, so a drained winner can't be chosen from a
+        stale row; when the STALE row would have won, the fallback books
+        ``stale_row`` so digest-lag regressions are visible."""
         prompt, model = _extract_prompt(args, kwargs)
         if prompt is None and model is None:
-            return None
+            return None, "pow2_cold"
         self._fetch_digests(cfg)
         if not self._digests:
-            return None
+            return None, "pow2_cold"
         by_hex = {r._actor_id.hex(): r for r in replicas}
         chains: Dict[int, list] = {}  # block_size -> request chain hashes
         best_key = (False, 0)
         best_hex = None
-        for hex_, row in self._digests.items():
-            if hex_ not in by_hex:
-                continue  # stale digest: replica drained or replaced
+        stale_best = (False, 0)
+
+        def _score(row):
             matched = 0
             if prompt is not None and row["block_size"] > 0:
                 bs = row["block_size"]
@@ -267,14 +277,26 @@ class _Router:
                     chain = chains[bs] = prefix_chain_hashes(
                         prompt, bs, limit=_MAX_ROUTE_CHAIN)
                 matched = longest_chain_match(chain, row["held"])
-            has_model = bool(model) and model in row["models"]
             # adapter affinity dominates (a cold adapter costs a merge +
             # compile); prefix length breaks ties
-            key = (has_model, matched)
+            return (bool(model) and model in row["models"], matched)
+
+        for hex_, row in self._digests.items():
+            if hex_ not in by_hex:
+                # stale digest: replica drained or replaced — track what it
+                # WOULD have scored for the fallback reason
+                key = _score(row)
+                if key > stale_best:
+                    stale_best = key
+                continue
+            key = _score(row)
             if key > best_key:
                 best_key, best_hex = key, hex_
         if best_hex is None or best_key == (False, 0):
-            return None  # cold prefix (and no adapter affinity)
+            # cold prefix (and no adapter affinity); if a stale row held
+            # the chain, the miss is digest lag, not a cold cache
+            return None, ("stale_row" if stale_best > best_key
+                          else "pow2_cold")
         # overload guard: a cache winner far deeper than the field's
         # shortest known queue loses its affinity claim.  Freshness horizon
         # is a full digest window + probe TTL: in the zero-RPC steady state
@@ -290,8 +312,8 @@ class _Router:
             floor = min(known.values())
             if known.get(best_hex, floor) > floor + \
                     cfg.serve_prefix_overload_slack:
-                return None
-        return by_hex[best_hex]
+                return None, "overload_divert"
+        return by_hex[best_hex], "prefix_hit"
 
     # -- pow-2 fallback -----------------------------------------------------
 
@@ -385,6 +407,24 @@ class DeploymentResponseGenerator:
         self._yielded += 1
         return out
 
+    def close(self):
+        """Abandon the stream (client disconnect): cancel the replica-side
+        generator task (best-effort — KeyboardInterrupt at the executing
+        worker unwinds the replica generator, whose close propagates to the
+        engine and frees the request's slot).  Completion still frees
+        everything if the cancel is lost."""
+        gen, self._gen = self._gen, iter(())
+        try:
+            anchor = getattr(gen, "_anchor", None)
+            w = getattr(gen, "_w", None)
+            if anchor is not None and w is not None:
+                import ray_tpu
+                from ray_tpu._private.worker import ObjectRef
+
+                ray_tpu.cancel(ObjectRef(anchor, w.address), force=False)
+        except Exception:  # noqa: BLE001 — best-effort; completion also frees
+            pass
+
 
 class DeploymentHandle:
     def __init__(self, app_name: str, deployment_name: str,
@@ -409,6 +449,11 @@ class DeploymentHandle:
         return self.options(method_name=name)
 
     def remote(self, *args, **kwargs):
+        from ray_tpu.serve._private import slo
+
+        # handle-kwarg tenant attribution for the active request lifecycle
+        # (callers not fronted by HTTP pass tenant= / {"tenant": ...})
+        slo.note_request_args(args, kwargs)
         last_err = None
         for _ in range(3):
             replica = self._router.choose_replica(args, kwargs)
@@ -416,6 +461,7 @@ class DeploymentHandle:
                 def resubmit(h=self, a=args, kw=kwargs, r=replica):
                     # the caller observed r dead: shun it so the re-route
                     # (and cache affinity in particular) picks a survivor
+                    slo.note_route("shun_resubmit")
                     h._router.mark_dead(r)
                     h._router.invalidate()
                     return h.remote(*a, **kw)

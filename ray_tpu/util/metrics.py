@@ -1,4 +1,4 @@
-"""User-defined application metrics: Counter / Gauge / Histogram.
+"""User-defined application metrics: Counter / Gauge / Histogram / Sketch.
 
 TPU-native rebuild of the reference's metrics API
 (reference: python/ray/util/metrics.py; C++ registry src/ray/stats/metric.h:109,
@@ -9,6 +9,14 @@ periodically (and on flush) pushes snapshots to the GCS, which aggregates the
 latest value per (metric, tag-set, reporter).  ``prometheus_text()`` renders
 the cluster-wide aggregate in Prometheus exposition format — what the
 reference's per-node MetricsAgent serves to Prometheus.
+
+``Sketch`` (beyond the reference) is a DDSketch-style quantile sketch
+(_private/latency_sketch.py): log-bucketed, constant memory, bounded
+relative error at ANY quantile, and — unlike Histogram — LOSSLESSLY
+mergeable across reporters, so p99s computed from the GCS aggregate equal
+the p99 of the combined stream.  Sketch points ride the same throttled
+ReportMetrics push; Prometheus rendering is the summary convention
+(``name{quantile="0.99"}``).
 """
 
 from __future__ import annotations
@@ -176,6 +184,56 @@ class Histogram(Metric):
         return BoundHistogram(self, self._merged(tags))
 
 
+class Sketch(Metric):
+    """Mergeable quantile sketch metric (DDSketch-style; see
+    _private/latency_sketch.py).  Use for latency distributions whose TAIL
+    must stay accurate after folding across replicas/nodes — serve TTFT and
+    inter-token latency are the canonical users."""
+
+    _kind = "sketch"
+
+    def __init__(self, name: str, description: str = "",
+                 relative_accuracy: float = 0.01,
+                 tag_keys: Optional[Sequence[str]] = None):
+        self.relative_accuracy = float(relative_accuracy)
+        with _REGISTRY_LOCK:
+            prior = _REGISTRY.get(name)
+        if (isinstance(prior, Sketch)
+                and prior.relative_accuracy == self.relative_accuracy):
+            self._sketches = prior._sketches
+        else:
+            # per-tagset LatencySketch
+            self._sketches: Dict[Tuple, object] = {}
+        super().__init__(name, description, tag_keys)
+
+    def _sketch_for(self, key):
+        st = self._sketches.get(key)
+        if st is None:
+            from ray_tpu._private.latency_sketch import LatencySketch
+
+            st = self._sketches[key] = LatencySketch(self.relative_accuracy)
+        return st
+
+    def observe(self, value: float, n: int = 1,
+                tags: Optional[Dict[str, str]] = None):
+        self._check_tags(tags)
+        key = self._merged(tags)
+        with self._lock:
+            self._sketch_for(key).add(value, n)
+
+    def _snapshot(self) -> List[dict]:
+        with self._lock:
+            return [
+                dict({"name": self._name, "kind": "sketch", "tags": dict(k),
+                      "description": self._description}, **st.to_point())
+                for k, st in self._sketches.items()
+            ]
+
+    def with_tags(self, tags: Optional[Dict[str, str]] = None) -> "BoundSketch":
+        self._check_tags(tags)
+        return BoundSketch(self, self._merged(tags))
+
+
 # ---------------------------------------------------------------------------
 # Bound recorders — the constant-cost hot path for built-in runtime metrics
 # (reference: the C++ stats fast path, src/ray/stats/metric.h Record()).
@@ -239,6 +297,27 @@ class BoundHistogram:
             st[0][i] += 1
             st[1] += value
             st[2] += 1
+
+
+class BoundSketch:
+    __slots__ = ("_m", "_key")
+
+    def __init__(self, metric: Sketch, key):
+        self._m, self._key = metric, key
+
+    def observe(self, value: float, n: int = 1):
+        m = self._m
+        cur = _REGISTRY.get(m._name)
+        if cur is not m and type(cur) is type(m):
+            self._m = m = cur
+        with m._lock:
+            st = m._sketches.get(self._key)
+            if st is None:
+                from ray_tpu._private.latency_sketch import LatencySketch
+
+                st = m._sketches[self._key] = LatencySketch(
+                    m.relative_accuracy)
+            st.add(value, n)
 
 
 def collect_local() -> List[dict]:
@@ -358,10 +437,23 @@ def prometheus_text(points: Optional[List[dict]] = None) -> str:
         desc = ps[0].get("description", "")
         if desc:
             lines.append(f"# HELP {name} {desc}")
-        lines.append(f"# TYPE {name} {kind if kind != 'untyped' else 'gauge'}")
+        prom_kind = {"untyped": "gauge", "sketch": "summary"}.get(kind, kind)
+        lines.append(f"# TYPE {name} {prom_kind}")
         for p in ps:
             tags = p.get("tags", {})
-            if kind == "histogram":
+            if kind == "sketch":
+                # summary convention: quantiles computed off the mergeable
+                # sketch bins (so cluster-aggregate p99 is a TRUE p99, not
+                # an average of per-replica p99s)
+                from ray_tpu._private.latency_sketch import point_quantiles
+
+                qs = (0.5, 0.9, 0.95, 0.99)
+                for q, v in zip(qs, point_quantiles(p, qs)):
+                    t = dict(tags, quantile=repr(q))
+                    lines.append(f"{name}{_fmt_tags(t)} {v}")
+                lines.append(f"{name}_sum{_fmt_tags(tags)} {p['sum']}")
+                lines.append(f"{name}_count{_fmt_tags(tags)} {p['count']}")
+            elif kind == "histogram":
                 cum = 0
                 for b, c in zip(p["boundaries"], p["buckets"]):
                     cum += c

@@ -594,6 +594,76 @@ class StateApiClient:
             out[name] = snap
         return out
 
+    # -- serving SLO layer (request-level ledger + burn-rate monitoring) --
+
+    def _slo_rows(self) -> list:
+        """Fetch every process's published ``slo:*`` snapshot row."""
+        import json
+
+        from ray_tpu.serve._private.slo import SLO_KV_PREFIX
+
+        rows = []
+        keys = self._w.gcs.call("KVKeys", {"prefix": SLO_KV_PREFIX}) or []
+        blobs = self._w.gcs.call("KVMultiGet", {"keys": keys}) or {}
+        for blob in blobs.values():
+            if not blob:
+                continue
+            try:
+                rows.append(json.loads(blob))
+            except Exception:  # noqa: BLE001 — one bad row, not all
+                continue
+        return rows
+
+    def serving_slo(self, deployment: Optional[str] = None) -> dict:
+        """Cluster-wide serving SLO report: per deployment, TTFT/ITL
+        percentiles (lossless sketch merge across every ingress — the p99
+        is the TRUE p99 of the combined request stream), split by tenant,
+        per-stage percentiles (queue_wait/prefill/handoff/decode), terminal
+        status counts, effective SLO targets, and multi-window (5m/1h)
+        burn rates with the breach list ranked worst-first.  A single slow
+        replica shows up here as the deployment's burn rate crossing the
+        alert threshold."""
+        import json
+
+        from ray_tpu.serve._private import slo as slo_mod
+
+        conf_rows = {}
+        try:
+            keys = self._w.gcs.call(
+                "KVKeys", {"prefix": slo_mod.SLO_CONF_KV_PREFIX}) or []
+            blobs = self._w.gcs.call("KVMultiGet", {"keys": keys}) or {}
+            for key, blob in blobs.items():
+                try:
+                    conf_rows[key[len(slo_mod.SLO_CONF_KV_PREFIX):]] = (
+                        json.loads(blob))
+                except Exception:  # noqa: BLE001
+                    continue
+        except Exception:  # noqa: BLE001 — defaults still apply
+            pass
+        report = slo_mod.fold_rows(self._slo_rows(), conf_rows=conf_rows)
+        if deployment is not None:
+            report["deployments"] = {
+                k: v for k, v in report["deployments"].items()
+                if k == deployment}
+            report["breaches"] = [b for b in report["breaches"]
+                                  if b["deployment"] == deployment]
+        return report
+
+    def recent_requests(self, limit: int = 100,
+                        deployment: Optional[str] = None,
+                        tenant: Optional[str] = None) -> List[dict]:
+        """Overload forensics: the newest completed requests cluster-wide
+        (tenant, status, route reason, TTFT, mean/max ITL, duration,
+        trace_id cross-link), folded from every ingress's recent ring."""
+        from ray_tpu.serve._private import slo as slo_mod
+
+        rows = slo_mod.fold_recent(self._slo_rows(), limit=limit * 4)
+        if deployment is not None:
+            rows = [r for r in rows if r.get("deployment") == deployment]
+        if tenant is not None:
+            rows = [r for r in rows if r.get("tenant") == tenant]
+        return rows[-limit:]
+
     def _agent_call_by_pid(self, method: str, payload: dict, *, pid,
                            node_id, timeout: float) -> dict:
         """Try every live node's agent endpoint for ``pid``; the hosting
@@ -732,6 +802,14 @@ def diagnose(hang_timeout_s=None, include_stacks: bool = True,
 
 def goodput(run=None):
     return _client().goodput(run)
+
+
+def serving_slo(deployment=None):
+    return _client().serving_slo(deployment)
+
+
+def recent_requests(limit: int = 100, deployment=None, tenant=None):
+    return _client().recent_requests(limit, deployment, tenant)
 
 
 def dump_native_stacks(pid, node_id=None):
