@@ -1097,6 +1097,17 @@ def _compression_snapshot() -> dict:
         return {"error": str(e)[:200]}
 
 
+def _plan_snapshot() -> dict:
+    """Collective-planner decision counts recorded during the benches
+    (runtime_metrics.plan_snapshot): "algorithm/reason" -> count."""
+    try:
+        from ray_tpu._private import runtime_metrics
+
+        return runtime_metrics.plan_snapshot()
+    except Exception as e:  # noqa: BLE001
+        return {"error": str(e)[:200]}
+
+
 def _goodput_snapshot() -> dict:
     """Goodput ledgers this process created (the headline train loop runs
     under one) — wall-clock by bucket + derived ratio per run."""
@@ -1305,6 +1316,7 @@ def main():
         # BENCH_*.json carries bandwidth numbers without extra plumbing
         "collective_metrics": _collective_metrics_snapshot(),
         "compressed_collective": _compression_snapshot(),
+        "collective_plan": _plan_snapshot(),
         "trace_summary": _trace_summary_snapshot(),
         "goodput": _goodput_snapshot(),
         "prefix_cache": _prefix_cache_snapshot(),

@@ -169,6 +169,161 @@ def bench_mesh_compressed(sizes_mb, variant="int8", iters=10, block_size=256):
     return results
 
 
+def bench_mesh_algorithms(sizes_mb, algorithm, iters=10):
+    """Planner-algorithm sweep (ISSUE 10): drive the explicit ring /
+    recursive-halving-doubling tree / lossless hierarchical programs over
+    all local devices and report busbw per algorithm alongside what the
+    planner WOULD choose for that size (so rows double as a decision
+    audit).  ``algorithm``: "ring" | "tree" | "hier"."""
+    import numpy as np
+
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from ray_tpu._private import runtime_metrics
+    from ray_tpu.util.collective import compression as comp
+    from ray_tpu.util.collective import planner as pl
+    from ray_tpu.util.collective.collective_group import xla_group as xg
+
+    devices = jax.devices()
+    world = len(devices)
+    results = []
+    if algorithm == "tree" and world & (world - 1):
+        return [{"metric": "allreduce_busbw", "mode": "mesh",
+                 "algorithm": "tree",
+                 "error": f"{world} devices is not a power of two"}]
+    if algorithm == "hier" and not (world % 2 == 0 and world >= 4):
+        return [{"metric": "allreduce_busbw", "mode": "mesh",
+                 "algorithm": "hier",
+                 "error": f"{world} devices cannot split into slices"}]
+    topo = pl.Topology.flat(world, link=pl.LINK_HOST)
+    spec = comp.CompressionSpec(scheme="none", min_bytes=0)
+    for mb in sizes_mb:
+        per_rank = int(mb * 2**20 / 4)
+        per_rank -= per_rank % max(world * 2, 1)
+        rows = [np.random.default_rng(r).standard_normal(per_rank)
+                .astype(np.float32) for r in range(world)]
+        logical = per_rank * 4
+        if algorithm == "hier":
+            ss = world // 2
+            mesh2 = Mesh(np.array(devices).reshape(2, ss),
+                         ("slice", "intra"))
+            fn = xg.build_hierarchical_allreduce(
+                mesh2, 2, ss, comp.SCHEME_NONE)
+            garr = jax.device_put(
+                np.stack(rows).reshape(2, ss, per_rank),
+                NamedSharding(mesh2, P("slice", "intra")))
+            alg_name = comp.ALG_HIERARCHICAL
+            wire, _ = comp.estimate_wire_bytes(alg_name, comp.SCHEME_NONE,
+                                               logical, world, ss)
+        else:
+            mesh = Mesh(np.array(devices), ("world",))
+            builder = (xg.build_ring_allreduce if algorithm == "ring"
+                       else xg.build_tree_allreduce)
+            fn = builder(mesh, "world", world)
+            garr = jax.device_put(np.stack(rows),
+                                  NamedSharding(mesh, P("world")))
+            alg_name = (comp.ALG_RING if algorithm == "ring"
+                        else comp.ALG_TREE)
+            wire, _ = comp.estimate_wire_bytes(alg_name, comp.SCHEME_NONE,
+                                               logical, world)
+        out = fn(garr)
+        out.block_until_ready()  # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(garr)
+        out.block_until_ready()
+        dt = (time.perf_counter() - t0) / iters
+        busbw = (2 * (world - 1) / max(world, 1)) * logical / dt
+        planned = pl.plan_allreduce(logical, topo, spec)
+        pl.record_plan(alg_name, "bench_forced")
+        runtime_metrics.record_collective(
+            "allreduce", "xla_mesh", world, logical, dt, "float32")
+        results.append({
+            "metric": "allreduce_busbw",
+            "mode": "mesh",
+            "algorithm": algorithm,
+            "devices": world,
+            "bytes": logical,
+            "wire_bytes": int(wire),
+            "time_s": round(dt, 6),
+            "value": round(busbw / 1e9, 3),
+            "planner_choice": planned.algorithm,
+            "planner_reason": planned.reason,
+            "unit": "GB/s",
+        })
+    return results
+
+
+def bench_bucketed_overlap(sizes_mb, bucket_mb, iters=10):
+    """Bucketed-vs-fused A/B over the local mesh (ISSUE 10): one fused
+    psum of S against K optimization_barrier-chained per-bucket psums of
+    S/K — the communication half of the overlapped-gradient-sync trick,
+    isolated from any model."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from ray_tpu.util.jax_compat import shard_map as _shard_map
+
+    devices = jax.devices()
+    world = len(devices)
+    mesh = Mesh(np.array(devices), ("x",))
+    results = []
+    for mb in sizes_mb:
+        count = int(mb * 2**20 / 4)
+        k = max(int(mb / max(bucket_mb, 1e-9) + 0.5), 1)
+        count -= count % max(world * k, 1)
+        chunk = count // k
+        x = jax.device_put(
+            jnp.arange(count, dtype=jnp.float32) % 97,
+            NamedSharding(mesh, P("x")))
+
+        @jax.jit
+        def fused(v):
+            return _shard_map(lambda s: jax.lax.psum(s, "x"), mesh=mesh,
+                              in_specs=P("x"), out_specs=P())(v)
+
+        @jax.jit
+        def bucketed(v):
+            def body(s):
+                outs = []
+                token = jnp.zeros((), jnp.float32)
+                for j in range(k):
+                    c = jax.lax.psum(s[j * chunk // world:
+                                       (j + 1) * chunk // world], "x")
+                    c, token = jax.lax.optimization_barrier((c, token))
+                    outs.append(c)
+                return jnp.concatenate(outs)
+
+            return _shard_map(body, mesh=mesh, in_specs=P("x"),
+                              out_specs=P())(v)
+
+        def timeit(fn):
+            fn(x).block_until_ready()
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = fn(x)
+            out.block_until_ready()
+            return (time.perf_counter() - t0) / iters
+
+        t_fused, t_bucketed = timeit(fused), timeit(bucketed)
+        results.append({
+            "metric": "bucketed_allreduce_ab",
+            "devices": world,
+            "bytes": count * 4,
+            "bucket_mb": bucket_mb,
+            "buckets": k,
+            "fused_s": round(t_fused, 6),
+            "bucketed_s": round(t_bucketed, 6),
+            "bucketed_over_fused": round(t_bucketed / t_fused, 3)
+            if t_fused > 0 else None,
+        })
+    return results
+
+
 def bench_group(sizes_mb, world_size=2, iters=5):
     """Collective-library mode: actor ranks allreduce numpy arrays through
     ray_tpu.util.collective (store backend off-TPU)."""
@@ -226,6 +381,11 @@ def main(argv=None):
     p.add_argument("--world-size", type=int, default=2)
     p.add_argument("--compression", default="bf16",
                    help="comma list of bf16,int8,hier,hier_int8 (mesh mode)")
+    p.add_argument("--algorithm", default="",
+                   help="comma list of ring,tree,hier — planner-algorithm "
+                        "sweep over the explicit lossless programs")
+    p.add_argument("--bucket-mb", type=float, default=None,
+                   help="bucketed-vs-fused psum A/B at this bucket size")
     args = p.parse_args(argv)
     sizes = [float(s) for s in args.sizes_mb.split(",")]
     if args.mode == "mesh":
@@ -238,6 +398,13 @@ def main(argv=None):
                                                  iters=args.iters)
             else:
                 raise SystemExit(f"unknown --compression variant {variant!r}")
+        for alg in [a.strip() for a in args.algorithm.split(",") if a.strip()]:
+            if alg not in ("ring", "tree", "hier"):
+                raise SystemExit(f"unknown --algorithm variant {alg!r}")
+            results += bench_mesh_algorithms(sizes, alg, iters=args.iters)
+        if args.bucket_mb is not None:
+            results += bench_bucketed_overlap(sizes, args.bucket_mb,
+                                              iters=args.iters)
     else:
         import ray_tpu
 

@@ -230,6 +230,12 @@ COLLECTIVE_ALGORITHM = Counter(
     "ray_tpu_collective_algorithm_total",
     "Collective ops by the algorithm/scheme the selection policy chose",
     tag_keys=("op", "backend", "algorithm", "scheme"))
+COLLECTIVE_PLAN = Counter(
+    "ray_tpu_collective_plan_total",
+    "Planner decisions by chosen algorithm and reason (latency_bound, "
+    "bandwidth_bound, dcn_boundary, unaligned_slices, ...) — booked only "
+    "when a compression spec is in force; the stock path records nothing",
+    tag_keys=("algorithm", "reason"))
 COLLECTIVE_ABORTS = Counter(
     "ray_tpu_collective_aborts_total",
     "Collective groups aborted promptly on member death/drain (pending ops "
@@ -393,7 +399,7 @@ FAMILIES = (
     COLLECTIVE_LATENCY, COLLECTIVE_BYTES, COLLECTIVE_BUS_BW,
     COLLECTIVE_LOGICAL_BYTES, COLLECTIVE_WIRE_BYTES,
     COLLECTIVE_INTER_SLICE_BYTES, COLLECTIVE_QUANT_ERROR,
-    COLLECTIVE_ALGORITHM, COLLECTIVE_ABORTS,
+    COLLECTIVE_ALGORITHM, COLLECTIVE_PLAN, COLLECTIVE_ABORTS,
     COLLECTIVE_STRAGGLER_LAG, HANG_SWEEPS,
     TRAIN_GOODPUT_SECONDS, TRAIN_GOODPUT_RATIO,
     TPU_CHIPS, TPU_PROCESS_CHIPS,
@@ -727,6 +733,22 @@ def record_collective_compression(op: str, backend: str, world_size: int,
                world_size=str(world_size), group=group).set(quant_error)
     _bound(COLLECTIVE_ALGORITHM, op=op, backend=backend,
            algorithm=algorithm, scheme=scheme).inc()
+
+
+def inc_collective_plan(algorithm: str, reason: str) -> None:
+    """One collective-planner decision (only spec-in-force paths book)."""
+    _bound(COLLECTIVE_PLAN, algorithm=algorithm, reason=reason).inc()
+
+
+def plan_snapshot() -> dict:
+    """Planner-decision counts for bench.py / the multichip dryrun:
+    "algorithm/reason" -> count."""
+    out: Dict[str, float] = {}
+    for p in COLLECTIVE_PLAN._snapshot():
+        t = p["tags"]
+        key = "{}/{}".format(t.get("algorithm", "?"), t.get("reason", "?"))
+        out[key] = out.get(key, 0.0) + p["value"]
+    return out
 
 
 def add_prefix_cache_hits(tier: str, n: int = 1) -> None:

@@ -1,0 +1,132 @@
+"""Gradient-bucket partitioning for compute-overlapped gradient sync.
+
+The DDP/large-trainer standard (arxiv 2510.20171 documents it as the trick
+100k-GPU training cannot ship without): instead of one fused end-of-step
+gradient allreduce, partition the gradient pytree into size-targeted
+buckets and launch each bucket's collective as its gradients materialize,
+so communication overlaps the remaining backward compute.
+
+The partition must be a PURE function of the gradient tree's structure and
+leaf shapes — every rank derives it independently and the sequences must
+match exactly (the collective-ordering contract), which is what
+``test_overlap_grad_sync`` pins with tree-equality across fresh
+derivations.
+
+Ordering: REVERSE materialization order.  Backward runs last layer first,
+so the bucket holding the last layer's gradients is complete earliest and
+its sync launches while earlier layers are still differentiating; we
+approximate materialization order with the flattened-tree leaf order
+(parameter/layer order) reversed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Sequence, Tuple
+
+DEFAULT_BUCKET_BYTES = 4 << 20  # 4 MiB — the DDP default neighborhood
+
+
+def _leaf_nbytes(leaf) -> int:
+    """Size of one leaf in bytes from shape/dtype metadata only (works on
+    jax.ShapeDtypeStruct, concrete arrays, and numpy)."""
+    size = 1
+    for d in getattr(leaf, "shape", ()):
+        size *= int(d)
+    itemsize = getattr(getattr(leaf, "dtype", None), "itemsize", 4)
+    return size * int(itemsize)
+
+
+def partition_buckets(tree: Any,
+                      bucket_bytes: int = DEFAULT_BUCKET_BYTES
+                      ) -> List[Tuple[int, ...]]:
+    """Partition a pytree's leaves into size-targeted buckets.
+
+    Returns a list of index tuples over the tree's FLATTENED leaf order
+    (``jax.tree.leaves`` order); buckets appear in launch order = reverse
+    leaf order (last layer first).  Every leaf lands in exactly one
+    bucket; a bucket closes once it reaches ``bucket_bytes`` (a single
+    oversized leaf forms its own bucket — leaves are never split, so
+    shardings and EF residual shapes stay leaf-aligned).
+
+    ``tree`` may hold concrete arrays or ShapeDtypeStructs — only
+    shape/dtype metadata is read, so the partition computed at trace/build
+    time from ``eval_shape`` matches the runtime one exactly.
+    """
+    import jax
+
+    if bucket_bytes <= 0:
+        raise ValueError(f"bucket_bytes must be positive, got {bucket_bytes}")
+    leaves = jax.tree.leaves(tree)
+    buckets: List[Tuple[int, ...]] = []
+    cur: List[int] = []
+    cur_bytes = 0
+    for idx in reversed(range(len(leaves))):
+        nb = _leaf_nbytes(leaves[idx])
+        cur.append(idx)
+        cur_bytes += nb
+        if cur_bytes >= bucket_bytes:
+            buckets.append(tuple(cur))
+            cur, cur_bytes = [], 0
+    if cur:
+        buckets.append(tuple(cur))
+    return buckets
+
+
+def bucket_summary(tree: Any,
+                   bucket_bytes: int = DEFAULT_BUCKET_BYTES) -> dict:
+    """Operator-facing view of a partition: bucket count, per-bucket bytes,
+    and the size target — for plan_explain-style debugging and bench."""
+    import jax
+
+    leaves = jax.tree.leaves(tree)
+    buckets = partition_buckets(tree, bucket_bytes)
+    sizes = [sum(_leaf_nbytes(leaves[i]) for i in b) for b in buckets]
+    return {
+        "bucket_bytes_target": int(bucket_bytes),
+        "num_buckets": len(buckets),
+        "num_leaves": len(leaves),
+        "bucket_nbytes": sizes,
+        "total_nbytes": sum(sizes),
+    }
+
+
+def flatten_bucket(arrays: Sequence, indices: Tuple[int, ...]):
+    """Concatenate one bucket's leaves into a single flat vector (the
+    store-path wire payload) plus the split metadata to undo it.
+
+    The payload dtype is numpy's promotion over the bucket's leaves —
+    NEVER a hard f32 cast: int64 counters must sum exactly and f64
+    gradients must keep their precision through a lossless round (the
+    int8 codec, when a spec asks for it, applies downstream to float
+    payloads only)."""
+    import numpy as np
+
+    parts = [np.ascontiguousarray(arrays[i]).ravel() for i in indices]
+    splits = [p.size for p in parts]
+    if not parts:
+        return np.zeros(0, np.float32), splits
+    dtypes = {p.dtype for p in parts}
+    if len(dtypes) == 1:
+        dt = parts[0].dtype
+    else:
+        try:
+            dt = np.result_type(*parts)
+        except TypeError:  # extension dtypes (bf16) mixed with others
+            dt = np.dtype(np.float32)
+    return np.concatenate([p.astype(dt, copy=False) for p in parts]), splits
+
+
+def unflatten_bucket(flat, indices: Tuple[int, ...], splits, like_arrays):
+    """Inverse of :func:`flatten_bucket`: scatter the reduced flat vector
+    back into per-leaf arrays shaped/typed like ``like_arrays``."""
+    import numpy as np
+
+    out = {}
+    off = 0
+    for i, n in zip(indices, splits):
+        ref = like_arrays[i]
+        out[i] = np.asarray(flat[off:off + n]).reshape(
+            getattr(ref, "shape", (n,))).astype(
+                getattr(ref, "dtype", np.float32), copy=False)
+        off += n
+    return out

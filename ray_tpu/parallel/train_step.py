@@ -72,6 +72,8 @@ def make_train_step(
     loss: Optional[Callable] = None,
     pipeline_microbatches: Optional[int] = None,
     grad_compression=None,
+    overlap_grad_sync: bool = False,
+    bucket_bytes: int = 4 << 20,
 ) -> tuple[Callable, Callable]:
     """Returns (init_fn, step_fn).
 
@@ -90,6 +92,20 @@ def make_train_step(
     with ``error_feedback`` the residual tree rides the optimizer state and
     inherits the params' shardings).  Leaves under the spec's ``min_bytes``
     pass through untouched.
+
+    ``overlap_grad_sync`` partitions the gradient pytree into
+    ``bucket_bytes``-targeted buckets (parallel/bucketing.py; stable
+    ordering = reverse materialization order, last layer first) and
+    sequences each bucket's gradient sync behind its own
+    ``jax.lax.optimization_barrier`` stage, chained by a token.  The
+    barriers hand XLA's latency-hiding scheduler explicit per-bucket
+    boundaries: bucket k's collectives and downstream optimizer work can
+    interleave with the backward compute still producing bucket k+1,
+    instead of one fused end-of-step sync region.  Numerically the stage
+    is an identity — overlap on/off is bit-comparable at equal precision
+    (pinned by test_overlap_grad_sync); with ``grad_compression`` the
+    codec still runs per leaf inside the optimizer chain, residuals
+    params-like as before.
     """
     model = _model_module(cfg)
     batch_axes = getattr(model, "ACTIVATION_BATCH_AXES", BATCH_AXES)
@@ -126,6 +142,32 @@ def make_train_step(
     else:
         pspecs = model.param_specs(cfg)
 
+    # bucket partition for overlapped sync: a pure function of the params
+    # tree's SHAPES (eval_shape — zero FLOPs), so every process derives the
+    # identical sequence (the collective-ordering contract)
+    grad_buckets = None
+    if overlap_grad_sync:
+        from ray_tpu.parallel.bucketing import partition_buckets
+
+        shapes = jax.eval_shape(
+            lambda k: model.init_params(cfg, k), jax.random.PRNGKey(0))
+        grad_buckets = partition_buckets(shapes, bucket_bytes)
+
+    def _bucketed_sync(grads):
+        """Per-bucket optimization_barrier chain (identity values, token-
+        sequenced): bucket 0 holds the LAST layer's grads — complete
+        first in backward — so its sync stage is schedulable while the
+        rest of the backward still runs."""
+        leaves, treedef = jax.tree.flatten(grads)
+        out = list(leaves)
+        token = jnp.zeros((), jnp.float32)
+        for bucket in grad_buckets:
+            vals = tuple(out[i] for i in bucket)
+            vals, token = jax.lax.optimization_barrier((vals, token))
+            for i, v in zip(bucket, vals):
+                out[i] = v
+        return jax.tree.unflatten(treedef, out)
+
     def init_fn_raw(key):
         params = model.init_params(cfg, key)
         return TrainState(jnp.zeros((), jnp.int32), params, optimizer.init(params))
@@ -138,6 +180,8 @@ def make_train_step(
             )
 
         loss_val, grads = jax.value_and_grad(loss_of)(state.params)
+        if grad_buckets is not None:
+            grads = _bucketed_sync(grads)
         updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         metrics = {

@@ -41,3 +41,26 @@ def broadcast_from_rank_zero(data: Any = None) -> Any:
 def barrier() -> None:
     """Block until every worker arrives (reference: collectives.py:88)."""
     col.barrier(_ensure_group())
+
+
+def allreduce_gradients(grads: Any, *, bucket_bytes: int = 4 << 20,
+                        compression=None, average: bool = True) -> Any:
+    """Bucketed, pipelined gradient sync across the training gang — the
+    DDP-style overlap on the trainer's store path: the gradient pytree
+    partitions into size-targeted buckets (reverse materialization order)
+    and bucket k+1's store round is issued while bucket k's result
+    uploads (``collective.allreduce_pytree``).  ``compression`` composes
+    per bucket (error-feedback residuals keyed per bucket).  Returns the
+    summed — or, by default, world-size-averaged — gradient tree."""
+    group = _ensure_group()
+    out = col.allreduce_pytree(grads, group_name=group,
+                               bucket_bytes=bucket_bytes,
+                               compression=compression)
+    if not average:
+        return out
+    world = float(get_session().world_size)
+    if world <= 1:
+        return out
+    import jax
+
+    return jax.tree.map(lambda a: a / world, out)

@@ -8,6 +8,7 @@ and ``store`` (named-store-actor data plane — the gloo analog).
 from ray_tpu.util.collective.collective import (
     allgather,
     allreduce,
+    allreduce_pytree,
     barrier,
     broadcast,
     create_collective_group,
@@ -16,6 +17,7 @@ from ray_tpu.util.collective.collective import (
     get_rank,
     init_collective_group,
     is_group_initialized,
+    plan_explain,
     recv,
     reduce,
     reducescatter,
@@ -36,6 +38,8 @@ __all__ = [
     "get_rank",
     "get_collective_group_size",
     "allreduce",
+    "allreduce_pytree",
+    "plan_explain",
     "reduce",
     "broadcast",
     "allgather",

@@ -257,6 +257,83 @@ def allreduce(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM,
     return out
 
 
+def allreduce_pytree(tree, group_name: str = "default",
+                     op: ReduceOp = ReduceOp.SUM,
+                     bucket_bytes: int = 4 << 20, compression=None):
+    """Bucketed, pipelined allreduce of a whole gradient pytree (the
+    trainer-path overlap: bucket k+1's round is issued while bucket k's
+    result uploads).
+
+    The tree partitions into size-targeted buckets (reverse
+    materialization order — parallel/bucketing.py; deterministic, so all
+    ranks issue identical sequences).  On the store backend the buckets
+    ride ``StoreGroup.allreduce_bucketed`` (contributions fired without
+    waiting); other backends fall back to per-bucket ``allreduce`` calls.
+    ``compression`` composes per bucket (error-feedback residuals keyed
+    per bucket).  Returns the reduced tree.
+    """
+    import numpy as np
+
+    from ray_tpu.parallel.bucketing import (
+        flatten_bucket,
+        partition_buckets,
+        unflatten_bucket,
+    )
+
+    g = _require_group(group_name)
+    spec = compression if compression is not None else g.default_compression
+    import jax
+
+    leaves, treedef = jax.tree.flatten(tree)
+    arrays = [np.asarray(leaf) for leaf in leaves]
+    buckets = partition_buckets(tree, bucket_bytes)
+    payloads, metas = [], []
+    for b in buckets:
+        flat, splits = flatten_bucket(arrays, b)
+        payloads.append(flat)
+        metas.append((b, splits))
+    t0 = time.perf_counter()
+    if hasattr(g, "allreduce_bucketed"):
+        reduced = g.allreduce_bucketed(payloads, op, compression=spec)
+    else:
+        reduced = [g.allreduce(p, op, compression=spec) for p in payloads]
+    dt = time.perf_counter() - t0
+    out = list(arrays)
+    for flat, (b, splits) in zip(reduced, metas):
+        for i, leaf in unflatten_bucket(flat, b, splits, arrays).items():
+            out[i] = leaf
+    total = int(sum(a.nbytes for a in arrays))
+    _record_op("allreduce", g, None, dt)
+    stats = getattr(g, "last_op_stats", None)
+    if stats is not None:
+        _record_compression("allreduce", g, stats)
+        _trace_op("allreduce", g, None, dt,
+                  extra={"algorithm": stats.algorithm,
+                         "scheme": stats.scheme,
+                         "wire_bytes": stats.wire_bytes,
+                         "nbytes": total, "buckets": len(buckets)})
+    else:
+        _trace_op("allreduce", g, None, dt,
+                  extra={"nbytes": total, "buckets": len(buckets)})
+    return jax.tree.unflatten(treedef, out)
+
+
+def plan_explain(nbytes: int, group_name: str = "default",
+                 compression=None) -> dict:
+    """Why would the planner pick what it picks for an ``nbytes`` payload
+    on this group's real topology?  Returns the candidate cost table, the
+    chosen algorithm, and the reason (see planner.plan_explain)."""
+    g = _require_group(group_name)
+    if hasattr(g, "plan_explain"):
+        return g.plan_explain(nbytes, compression=compression)
+    from ray_tpu.util.collective import compression as comp
+    from ray_tpu.util.collective import planner as _planner
+
+    spec = comp.resolve_spec(compression) or g.default_compression
+    return _planner.plan_explain(
+        nbytes, _planner.Topology.flat(g.world_size), spec)
+
+
 def reduce(tensor, dst_rank: int = 0, group_name: str = "default",
            op: ReduceOp = ReduceOp.SUM):
     g = _require_group(group_name)

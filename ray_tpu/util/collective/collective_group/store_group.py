@@ -10,11 +10,13 @@ collectives in the same order (the standard collective contract).
 
 from __future__ import annotations
 
+import time
 from typing import Any, List
 
 import numpy as np
 
 from ray_tpu.util.collective import compression as comp
+from ray_tpu.util.collective import planner as topo_planner
 from ray_tpu.util.collective.collective_group.base_group import BaseGroup
 from ray_tpu.util.collective.store import (
     check_abort,
@@ -84,6 +86,78 @@ class StoreGroup(BaseGroup):
         self._join_membership()
         # join barrier so ops can't start before all ranks exist
         self._sync("join")
+        # explicit topology for the planner, built AFTER the join barrier
+        # (all members' node identities are registered by then) and cached
+        # for the group's lifetime — membership change means re-init,
+        # which builds a fresh group and re-derives/re-probes
+        self._topology = self._build_topology()
+
+    def _build_topology(self) -> topo_planner.Topology:
+        """Topology from group-member node identity: ranks sharing a node
+        form one latency domain (the "slice" of the store backend's
+        hierarchical algorithm); the store round-trip probe calibrates
+        the link β term.  Unknown node ids (driver-less tests) collapse
+        to a single domain."""
+        import ray_tpu
+
+        ids = [None] * self._world_size
+        try:
+            members = ray_tpu.get(
+                self._store.get_members.remote(self._group_name))
+            for rank, m in (members or {}).items():
+                if 0 <= rank < self._world_size:
+                    ids[rank] = (m or {}).get("node_id")
+        except Exception:  # noqa: BLE001 — topology is advisory
+            pass
+        slice_ids = tuple(i if i is not None else "?unknown" for i in ids)
+        if all(i == "?unknown" for i in slice_ids):
+            slice_ids = tuple([0] * self._world_size)
+        kw = {}
+        bw = self._probe_link_bandwidth()
+        if bw is not None:
+            kw["intra_bw"] = bw
+            kw["inter_bw"] = bw
+        return topo_planner.Topology.from_slice_ids(
+            slice_ids, intra_link=topo_planner.LINK_HOST,
+            inter_link=topo_planner.LINK_DCN, **kw)
+
+    def _probe_link_bandwidth(self):
+        """One-shot store-link probe (~1 ms): round-trip a 64 KiB payload
+        through the store actor and derive bytes/s — every byte a store
+        collective moves crosses this link, so it is the β term for every
+        algorithm on this backend.  Failures fall back to defaults."""
+        if self._world_size <= 1:
+            return None
+        try:
+            import ray_tpu
+
+            payload = np.ones(16384, np.float32)  # 64 KiB
+            key = (self._group_name, "_bwprobe", self._rank)
+            t0 = time.perf_counter()
+            ray_tpu.get(self._store.put.remote(key, payload))
+            ray_tpu.get(self._store.pop.remote(key))
+            dt = time.perf_counter() - t0
+            if dt <= 0:
+                return None
+            return 2 * payload.nbytes / dt
+        except Exception:  # noqa: BLE001 — probe is advisory, never fatal
+            return None
+
+    def topology(self) -> topo_planner.Topology:
+        return self._topology
+
+    # algorithms this backend implements (no tree: pairwise exchange
+    # rounds through a central store pay w·α per round, never winning)
+    _PLANNABLE = (comp.ALG_FLAT, comp.ALG_RING, comp.ALG_HIERARCHICAL)
+
+    def plan_explain(self, nbytes: int, compression=None) -> dict:
+        """Debug surface: the planner's candidate table for a payload of
+        ``nbytes`` on this group's real topology."""
+        spec = comp.resolve_spec(compression)
+        if spec is None:
+            spec = self.default_compression
+        return topo_planner.plan_explain(nbytes, self._topology, spec,
+                                         allowed=self._PLANNABLE)
 
     def _join_membership(self):
         import ray_tpu
@@ -198,15 +272,25 @@ class StoreGroup(BaseGroup):
             return _convert_back(out, tensor)
         if plan.algorithm == comp.ALG_HIERARCHICAL:
             out, stats = self._hierarchical_allreduce(arr, op, plan)
-        else:
+        elif plan.algorithm == comp.ALG_RING:
+            out, stats = self._ring_allreduce(arr, op, plan)
+        elif plan.scheme == comp.SCHEME_INT8:
             out, stats = self._quantized_allreduce(arr, plan)
+        else:
+            # a lossless algorithm this backend doesn't implement must
+            # NEVER fall into the quantized path — run the stock exchange
+            by_rank = self._exchange("allreduce", arr)
+            out = _REDUCERS[op]([by_rank[r] for r in range(self._world_size)])
+            return _convert_back(out, tensor)
         self.last_op_stats = stats
         return _convert_back(out.astype(arr.dtype, copy=False), tensor)
 
     def _plan(self, arr: np.ndarray, op: ReduceOp, compression) -> comp.Plan:
         spec = comp.resolve_spec(compression)
-        plan = comp.choose_plan(arr.nbytes, self._world_size, spec,
-                                num_slices=self._topology_num_slices())
+        plan = topo_planner.plan_allreduce(arr.nbytes, self._topology, spec,
+                                           allowed=self._PLANNABLE)
+        if spec is not None:
+            topo_planner.record_plan(plan.algorithm, plan.reason)
         if plan.scheme != comp.SCHEME_NONE and (
                 op != ReduceOp.SUM or not comp.is_float_dtype(arr.dtype)):
             # quantization is only meaningful for float SUM-reductions;
@@ -215,6 +299,56 @@ class StoreGroup(BaseGroup):
 
             plan = _dc.replace(plan, scheme=comp.SCHEME_NONE)
         return plan
+
+    def _ring_allreduce(self, arr: np.ndarray, op: ReduceOp,
+                        plan: comp.Plan):
+        """Chunked ring (reduce-scatter + allgather through the store):
+        the payload splits into ``world`` chunks; every rank contributes
+        ALL chunks up front (uploads pipeline instead of serializing in
+        one giant round trip), but each chunk's reduction is owned by one
+        rank, which alone downloads that chunk's ``world`` contributions
+        — per-rank download drops from (n-1)·S (flat exchange) to ~2·S.
+        The reduced chunks then allgather in one ordinary round."""
+        import ray_tpu
+
+        w = self._world_size
+
+        def run():
+            flat = comp.pad_to_multiple(arr.ravel(), w)
+            cs = flat.size // w
+            # every rank derives the SAME key sequence (loop order is part
+            # of the collective contract, like any op ordering)
+            rs_keys = [self._next_key(f"ring_rs_c{j}") for j in range(w)]
+            ag_key = self._next_key("ring_ag")
+            self._mark("ring_allreduce", "enter", seq=ag_key[2])
+            # phase 1a — contribute all chunks WITHOUT waiting: uploads
+            # overlap each other and the collect below
+            refs = [self._store.contribute.remote(
+                rs_keys[j], self._rank, flat[j * cs:(j + 1) * cs])
+                for j in range(w)]
+            # phase 1b — reduce the one chunk this rank owns (single
+            # reader: the store GCs the entry on our read)
+            for v in ray_tpu.get(refs):
+                check_abort(v)
+            by_rank = store_wait(
+                self._store, "collect", (rs_keys[self._rank], w, self._rank, 1))
+            mine = _REDUCERS[op]([by_rank[r] for r in range(w)])
+            # phase 2 — allgather the reduced chunks
+            check_abort(ray_tpu.get(self._store.contribute.remote(
+                ag_key, self._rank, mine)))
+            by_owner = store_wait(self._store, "collect",
+                                  (ag_key, w, self._rank))
+            out = np.concatenate(
+                [by_owner[r] for r in range(w)])[:arr.size]
+            self._mark("ring_allreduce", "exit", seq=ag_key[2])
+            wire, _ = comp.estimate_wire_bytes(
+                comp.ALG_RING, comp.SCHEME_NONE, int(flat.nbytes), w)
+            stats = comp.OpStats(
+                logical_bytes=int(arr.nbytes), wire_bytes=wire,
+                algorithm=comp.ALG_RING, scheme=comp.SCHEME_NONE)
+            return out.reshape(arr.shape), stats
+
+        return self._guard(run)
 
     def _quantized_allreduce(self, arr: np.ndarray, plan: comp.Plan):
         """Flat quantized: every rank contributes int8 codes + per-block
@@ -297,6 +431,87 @@ class StoreGroup(BaseGroup):
             algorithm=comp.ALG_HIERARCHICAL, scheme=plan.scheme,
             quant_error=qerr, inter_slice_bytes=wire_inter)
         return out.reshape(arr.shape), stats
+
+    def allreduce_bucketed(self, arrays: List[np.ndarray],
+                           op: ReduceOp = ReduceOp.SUM, compression=None):
+        """Pipelined bucketed allreduce (the DDP overlap trick on the
+        store transport): ``arrays`` is the deterministic bucket sequence
+        (identical on every rank — the bucket partition is a pure function
+        of the gradient tree); bucket k+1's contribution is ISSUED while
+        bucket k's round is still uploading/collecting, so store round
+        trips overlap instead of serializing end-to-end.
+
+        Per-bucket compression composes with PR 3's codec: the
+        error-feedback residual keys embed the bucket index (op string
+        ``allreduce_b<k>``), so each bucket carries its own residual.
+        Returns the reduced arrays in bucket order; ``last_op_stats``
+        aggregates the whole sequence.
+        """
+        import ray_tpu
+
+        self.last_op_stats = None
+        w = self._world_size
+        spec = comp.resolve_spec(compression)
+        if spec is None:
+            spec = self.default_compression
+
+        def run():
+            staged = []  # (key, ref, quantized, qmeta...)
+            logical = wire = 0
+            qerr = 0.0
+            for k, arr in enumerate(arrays):
+                a = np.ascontiguousarray(arr)
+                quantize = (spec is not None
+                            and spec.scheme == comp.SCHEME_INT8
+                            and op == ReduceOp.SUM
+                            and comp.is_float_dtype(a.dtype)
+                            and a.nbytes >= spec.min_bytes and w > 1)
+                if spec is not None:
+                    topo_planner.record_plan(
+                        comp.ALG_FLAT,
+                        "bucketed_pipeline" if w > 1 else "solo")
+                if quantize:
+                    codes, scales, _deq, e = comp.ef_quantize(
+                        self._group_name, f"allreduce_b{k}", a, spec)
+                    payload = (codes, scales)
+                    wire += comp.wire_nbytes(codes, scales)
+                    qerr = max(qerr, e)
+                else:
+                    payload = a
+                    wire += int(a.nbytes)
+                logical += int(a.nbytes)
+                key = self._next_key(f"bucket_ar_b{k}")
+                self._mark("bucket_allreduce", "enter", seq=key[2])
+                # fire-and-continue: the next bucket's upload overlaps
+                # this round's completion
+                ref = self._store.contribute.remote(key, self._rank, payload)
+                staged.append((key, ref, quantize, a))
+            outs = []
+            for key, ref, quantize, a in staged:
+                check_abort(ray_tpu.get(ref))
+                by_rank = store_wait(self._store, "collect",
+                                     (key, w, self._rank))
+                if quantize:
+                    acc = np.zeros(a.size, np.float32)
+                    for r in range(w):
+                        c_r, s_r = by_rank[r]
+                        acc += comp.dequantize_blocks(
+                            c_r, s_r, a.size, spec.block_size)
+                    out = acc.reshape(a.shape).astype(a.dtype, copy=False)
+                else:
+                    out = _REDUCERS[op]([by_rank[r] for r in range(w)])
+                self._mark("bucket_allreduce", "exit", seq=key[2])
+                outs.append(out)
+            if spec is not None:
+                self.last_op_stats = comp.OpStats(
+                    logical_bytes=logical, wire_bytes=wire,
+                    algorithm=comp.ALG_FLAT,
+                    scheme=(comp.SCHEME_INT8 if any(s[2] for s in staged)
+                            else comp.SCHEME_NONE),
+                    quant_error=qerr)
+            return outs
+
+        return self._guard(run)
 
     def reduce(self, tensor, dst_rank: int = 0, op: ReduceOp = ReduceOp.SUM):
         arr, _ = _to_numpy(tensor)

@@ -12,11 +12,13 @@ DCN collectives across slices.
 from __future__ import annotations
 
 import socket
+import time
 from typing import Any, List
 
 import numpy as np
 
 from ray_tpu.util.collective import compression as comp
+from ray_tpu.util.collective import planner as topo_planner
 from ray_tpu.util.collective.collective_group.base_group import BaseGroup
 from ray_tpu.util.collective.store import get_or_create_store, store_wait
 from ray_tpu.util.collective.types import ReduceOp
@@ -136,6 +138,83 @@ def build_hierarchical_allreduce(mesh2d, num_slices: int, slice_size: int,
         body, mesh=mesh2d, in_specs=P("slice", "intra"), out_specs=P()))
 
 
+def build_ring_allreduce(mesh, axis_name: str, world_size: int):
+    """Bandwidth-optimal ring decomposition as an explicit program:
+    reduce-scatter (psum_scatter — XLA lowers it to the neighbor ring) then
+    all_gather.  2(n-1) neighbor steps moving 2(n-1)/n·S per link — the
+    large-message winner on every link class.
+
+    Input is the stacked [world, n] float payload sharded along
+    ``axis_name`` with ``n % world_size == 0`` (pad host-side); output is
+    the reduced [n], identical on every rank.
+    """
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    def body(x):
+        v = x[0]  # [n] — this rank's payload
+        shard = jax.lax.psum_scatter(v, axis_name, scatter_dimension=0,
+                                     tiled=True)
+        return jax.lax.all_gather(shard, axis_name, axis=0, tiled=True)
+
+    return jax.jit(_shard_map_unchecked(
+        body, mesh=mesh, in_specs=(P(axis_name),), out_specs=P()))
+
+
+def build_tree_allreduce(mesh, axis_name: str, world_size: int):
+    """Recursive halving-doubling ("tree"): log2(n) pairwise-exchange
+    rounds of halving payloads (reduce-scatter), then log2(n) doubling
+    rounds (allgather).  Latency 2·log2(n)·α vs the ring's 2(n-1)·α — the
+    small-message winner; its non-neighbor pairs pay link contention at
+    size, which the planner's cost model charges.
+
+    Power-of-two worlds only (the planner never selects tree otherwise).
+    Input/output contract matches :func:`build_ring_allreduce`.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    if world_size & (world_size - 1):
+        raise ValueError(
+            f"tree allreduce needs a power-of-two world, got {world_size}")
+
+    def body(x):
+        v = x[0]  # [n], n % world_size == 0
+        idx = jax.lax.axis_index(axis_name)
+        cur = v
+        # phase 1 — reduce-scatter by recursive halving: at mask m, keep
+        # the half matching your bit (MSB first), send the other to the
+        # partner rank^m, add what it sent you.  After all rounds rank r
+        # holds the reduced segment r (bits MSB->LSB spell the offset).
+        mask = world_size // 2
+        perms = []
+        while mask >= 1:
+            perms.append([(i, i ^ mask) for i in range(world_size)])
+            mask //= 2
+        for perm in perms:
+            m = (perm[0][0] ^ perm[0][1])
+            half = cur.shape[0] // 2
+            lo, hi = cur[:half], cur[half:]
+            bit = (idx & m) != 0
+            send = jnp.where(bit, lo, hi)
+            keep = jnp.where(bit, hi, lo)
+            recv = jax.lax.ppermute(send, axis_name, perm)
+            cur = keep + recv
+        # phase 2 — allgather by recursive doubling (reverse masks):
+        # concatenate in bit order so segments land back in sequence
+        for perm in reversed(perms):
+            m = (perm[0][0] ^ perm[0][1])
+            bit = (idx & m) != 0
+            recv = jax.lax.ppermute(cur, axis_name, perm)
+            cur = jnp.where(bit, jnp.concatenate([recv, cur]),
+                            jnp.concatenate([cur, recv]))
+        return cur
+
+    return jax.jit(_shard_map_unchecked(
+        body, mesh=mesh, in_specs=(P(axis_name),), out_specs=P()))
+
+
 def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("", 0))
@@ -175,6 +254,57 @@ class XLAGroup(BaseGroup):
         # per-instance program cache (NOT functools.lru_cache on methods —
         # that pins self and its Mesh forever, VERDICT r1 weak #4)
         self._fn_cache = {}
+        # explicit topology descriptor for the planner: per-rank slice ids
+        # from device metadata, link bandwidth refined by a one-shot probe.
+        # Built LAZILY on the first planner use — only spec-in-force calls
+        # read it, and the probe compiles a small psum the stock path never
+        # needs (a no-spec group's init must not pay a compile).  Cached
+        # for the group's lifetime; XLA membership is fixed, a re-init
+        # builds a fresh group and re-probes.
+        self._topology = None
+
+    def _build_topology(self) -> topo_planner.Topology:
+        """Topology from the real device list: ``slice_index`` is the
+        latency-domain id (multislice TPU pods report it; CPU/single-slice
+        devices collapse to one domain), the platform picks the link
+        class, and a one-shot probe calibrates the intra-link β term."""
+        slice_ids = tuple(
+            getattr(d, "slice_index", None) or 0 for d in self._devices)
+        on_tpu = getattr(self._devices[0], "platform", "cpu") == "tpu"
+        intra = topo_planner.LINK_ICI if on_tpu else topo_planner.LINK_HOST
+        kw = {}
+        bw = self._probe_link_bandwidth()
+        if bw is not None:
+            kw["intra_bw"] = bw
+        return topo_planner.Topology.from_slice_ids(
+            slice_ids, intra_link=intra, inter_link=topo_planner.LINK_DCN,
+            **kw)
+
+    def _probe_link_bandwidth(self):
+        """One-shot link probe at group init: time a small psum over the
+        group mesh and derive effective bus bandwidth (bytes/s).  Collective
+        — every member runs it inside its own __init__, which is already
+        a synchronized rendezvous.  Solo groups (and any probe failure)
+        fall back to the planner's per-class defaults."""
+        if self._world_size <= 1:
+            return None
+        try:
+            n = 8192  # 32 KiB/rank: big enough to measure, sub-ms to move
+            arr = np.ones(n, np.float32)
+            fn = self._allreduce_fn(_PSUM_OPS[ReduceOp.SUM])
+            garr = self._global_stack(arr)
+            import jax
+
+            jax.block_until_ready(fn(garr))  # compile + warm
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(garr))
+            dt = time.perf_counter() - t0
+            if dt <= 0:
+                return None
+            w = self._world_size
+            return 2 * (w - 1) / w * arr.nbytes / dt
+        except Exception:  # noqa: BLE001 — probe is advisory, never fatal
+            return None
 
     @staticmethod
     def _ensure_process_group(world_size: int, rank: int, group_name: str):
@@ -268,8 +398,25 @@ class XLAGroup(BaseGroup):
     def _topology_num_slices(self) -> int:
         """Distinct TPU slices the group's devices sit on (drives the
         hierarchical auto policy; 1 on CPU / single-slice)."""
-        return len({getattr(d, "slice_index", None) or 0
-                    for d in self._devices})
+        return self.topology().num_slices
+
+    def topology(self) -> topo_planner.Topology:
+        if self._topology is None:
+            self._topology = self._build_topology()
+        return self._topology
+
+    def plan_explain(self, nbytes: int, compression=None) -> dict:
+        """Debug surface: the planner's candidate table for a payload of
+        ``nbytes`` on this group's real topology."""
+        spec = comp.resolve_spec(compression)
+        if spec is None:
+            spec = self.default_compression
+        return topo_planner.plan_explain(nbytes, self.topology(), spec,
+                                         allowed=self._PLANNABLE)
+
+    # algorithms this backend implements (the planner picks among these)
+    _PLANNABLE = (comp.ALG_FLAT, comp.ALG_RING, comp.ALG_TREE,
+                  comp.ALG_HIERARCHICAL)
 
     def allreduce(self, tensor, op: ReduceOp = ReduceOp.SUM, compression=None):
         self.last_op_stats = None
@@ -286,16 +433,51 @@ class XLAGroup(BaseGroup):
                 # tensor, and the plan usually says "stock" (small payloads,
                 # compression='none'), where that copy is pure waste
                 nbytes = int(getattr(tensor, "nbytes", 0) or 0)
-                plan = comp.choose_plan(nbytes, self._world_size, spec,
-                                        num_slices=self._topology_num_slices())
+                plan = topo_planner.plan_allreduce(
+                    nbytes, self.topology(), spec, allowed=self._PLANNABLE)
+                topo_planner.record_plan(plan.algorithm, plan.reason)
                 if not plan.is_stock:
                     arr = np.asarray(tensor)
                     if plan.algorithm == comp.ALG_HIERARCHICAL:
                         return self._hierarchical_allreduce(arr, plan)
+                    if plan.algorithm in (comp.ALG_RING, comp.ALG_TREE):
+                        return self._decomposed_allreduce(arr, plan)
                     return self._quantized_allreduce(arr, plan)
             return self._reduce_impl(tensor, op)
         finally:
             self._mark("allreduce", "exit", seq=seq)
+
+    def _decomposed_allreduce(self, arr, plan: comp.Plan):
+        """Planner-built lossless variants: explicit ring (psum_scatter +
+        all_gather) or recursive-halving-doubling tree instead of the
+        stock fused psum — per-size schedule control the planner selects
+        by link class and message size."""
+        import jax
+
+        # the ring/tree decompositions are LOSSLESS: keep the payload's own
+        # float dtype (an f64 tensor must not round-trip through f32 on a
+        # path the stock psum previously ran at full precision)
+        n = arr.size
+        flat = np.ascontiguousarray(arr).ravel()
+        padded = comp.pad_to_multiple(flat, self._world_size)
+        key = (plan.algorithm, padded.size, str(padded.dtype))
+        fn = self._fn_cache.get(key)
+        if fn is None:
+            builder = (build_ring_allreduce
+                       if plan.algorithm == comp.ALG_RING
+                       else build_tree_allreduce)
+            fn = builder(self._mesh, "world", self._world_size)
+            self._fn_cache[key] = fn
+        out = fn(self._global_stack(padded))
+        result = np.asarray(jax.device_get(out))[:n]
+        wire, inter = comp.estimate_wire_bytes(
+            plan.algorithm, comp.SCHEME_NONE, int(padded.nbytes),
+            self._world_size)
+        self.last_op_stats = comp.OpStats(
+            logical_bytes=int(arr.nbytes), wire_bytes=wire,
+            algorithm=plan.algorithm, scheme=comp.SCHEME_NONE,
+            inter_slice_bytes=inter)
+        return result.reshape(arr.shape).astype(arr.dtype, copy=False)
 
     def _quantized_allreduce(self, arr, plan: comp.Plan):
         """EQuARX two-phase path: host codec quantizes the local payload

@@ -40,6 +40,8 @@ _SCHEMES = (SCHEME_NONE, SCHEME_INT8)
 
 ALG_FLAT = "flat"
 ALG_HIERARCHICAL = "hierarchical"
+ALG_RING = "ring"            # bandwidth-optimal reduce-scatter + allgather
+ALG_TREE = "tree"            # recursive halving-doubling (pow2 worlds)
 
 DEFAULT_BLOCK_SIZE = 256
 # below this the op is latency-bound: int8 would save microseconds of wire
@@ -110,12 +112,13 @@ def resolve_spec(compression) -> Optional[CompressionSpec]:
 
 @dataclasses.dataclass(frozen=True)
 class Plan:
-    """The policy's verdict for ONE collective call."""
+    """The planner's verdict for ONE collective call."""
 
-    algorithm: str                       # flat | hierarchical
+    algorithm: str                       # flat | ring | tree | hierarchical
     scheme: str                          # none | int8
     slice_size: int = 1                  # members per slice when hierarchical
     spec: Optional[CompressionSpec] = None
+    reason: str = ""                     # why the planner picked this
 
     @property
     def is_stock(self) -> bool:
@@ -126,56 +129,42 @@ class Plan:
 _STOCK_PLAN = Plan(ALG_FLAT, SCHEME_NONE)
 
 
-def _infer_slice_size(world_size: int, slice_size: Optional[int]) -> int:
-    """Largest valid intra-slice group: the explicit setting if it divides
-    the world, else the divisor nearest sqrt(world) (balanced two-level
-    tree, the TACCL sketch for symmetric hierarchies)."""
-    if slice_size and world_size % slice_size == 0 and slice_size < world_size:
-        return slice_size
-    if slice_size:
-        return 1  # explicit but invalid -> refuse hierarchy rather than guess
-    best = 1
-    root = int(world_size ** 0.5)
-    for d in range(root, 0, -1):
-        if world_size % d == 0 and 1 < d < world_size:
-            best = d
-            break
-    return best
-
-
 def choose_plan(nbytes: int, world_size: int,
                 spec: Optional[CompressionSpec], *,
-                num_slices: int = 1) -> Plan:
-    """Message-size + topology selection (TACCL-flavored).
+                num_slices: int = 1, topology=None) -> Plan:
+    """Message-size + topology selection, delegated to the planner
+    (``util/collective/planner.py`` — TACCL-flavored α-β cost model over
+    an explicit topology descriptor).
 
     - no spec, or payload under ``min_bytes``: flat + uncompressed (the
       stock path, byte-identical to compression-off).
-    - hierarchical when the spec forces it, or when auto and the topology
-      reports >1 slice (multislice ICI x DCN) or an explicit slice_size.
-    - quantization per the spec's scheme (large SUM payloads only; the op
-      check lives in the backend, which falls back for non-SUM).
+    - hierarchical when the spec forces a valid slice_size, or when auto
+      and the topology's domains form aligned contiguous blocks; a
+      multi-domain topology whose domains CANNOT be slice-aligned refuses
+      the hierarchy (reason ``unaligned_slices``) instead of guessing.
+    - ring / tree for large lossless payloads per the link-class cost
+      model; quantization per the spec's scheme (large SUM payloads only;
+      the op check lives in the backend, which falls back for non-SUM).
+
+    ``topology`` is the explicit descriptor backends build from device /
+    node metadata; ``num_slices`` remains as the metadata-only fallback
+    (contiguous equal slices assumed — exactly what it meant before).
     """
-    if spec is None or world_size <= 1:
-        return _STOCK_PLAN
-    if nbytes < spec.min_bytes:
-        return _STOCK_PLAN
-    scheme = spec.scheme
-    hier = spec.hierarchical
-    if hier is None:
-        hier = num_slices > 1 or spec.slice_size is not None
-    slice_size = 1
-    if hier:
-        want = spec.slice_size
-        if want is None and num_slices > 1 and world_size % num_slices == 0:
-            want = world_size // num_slices
-        slice_size = _infer_slice_size(world_size, want)
-        if slice_size <= 1 or slice_size >= world_size:
-            hier = False
-            slice_size = 1
-    algorithm = ALG_HIERARCHICAL if hier else ALG_FLAT
-    if algorithm == ALG_FLAT and scheme == SCHEME_NONE:
-        return _STOCK_PLAN
-    return Plan(algorithm, scheme, slice_size, spec)
+    from ray_tpu.util.collective import planner as _planner
+
+    if topology is None:
+        if num_slices > 1 and world_size % num_slices == 0:
+            ss = world_size // num_slices
+            topology = _planner.Topology.from_slice_ids(
+                tuple(r // ss for r in range(world_size)))
+        elif num_slices > 1:
+            # uneven domain report with no real descriptor: refuse the
+            # hierarchy downstream rather than invent a slice boundary
+            topology = _planner.Topology.from_slice_ids(
+                tuple(min(r, num_slices - 1) for r in range(world_size)))
+        else:
+            topology = _planner.Topology.flat(world_size)
+    return _planner.plan_allreduce(nbytes, topology, spec)
 
 
 # ---------------------------------------------------------------------------
@@ -221,6 +210,12 @@ def estimate_wire_bytes(algorithm: str, scheme: str, logical_bytes: int,
         shard = logical_bytes // max(slice_size, 1)
         inter = int8_bytes(shard) if scheme == SCHEME_INT8 else shard
         return logical_bytes + shard + inter, inter
+    if algorithm in (ALG_RING, ALG_TREE):
+        # reduce-scatter + allgather decompositions (explicit ring, or
+        # recursive halving-doubling): each rank moves (n-1)/n·S per
+        # phase, twice — lossless, so the scheme never changes the volume
+        w = max(world_size, 1)
+        return 2 * (w - 1) * logical_bytes // w, 0
     if scheme == SCHEME_INT8:
         one = int8_bytes(logical_bytes)
         return one + one // max(world_size, 1), 0

@@ -203,6 +203,12 @@ class _CollectiveStoreActor:
         self._ensure_monitor()
         return True
 
+    def get_members(self, group_name: str) -> Dict[int, dict]:
+        """rank -> {"actor_id", "node_id"} for a joined group — the
+        topology source for the store backend's planner (ranks sharing a
+        node form one latency domain)."""
+        return dict(self._members.get(group_name, {}))
+
     def leave_group(self, group_name: str, rank: int):
         members = self._members.get(group_name)
         if members is not None:
@@ -350,9 +356,13 @@ class _CollectiveStoreActor:
                             else rank, expected_ranks=expected_ranks)
         return True
 
-    def collect(self, key: Tuple, world_size: int, reader_rank: int):
+    def collect(self, key: Tuple, world_size: int, reader_rank: int,
+                expected_readers: Optional[int] = None):
         """Returns rank->value dict once all contributions are in, else None.
-        Entry is deleted after every rank has read it."""
+        Entry is deleted after every expected reader has read it —
+        ``world_size`` readers by default; chunked-ring rounds have a
+        single reader per chunk key (the chunk's owner) and pass
+        ``expected_readers=1`` so their entries GC immediately."""
         hit = self._abort_for(key)
         if hit is not None:
             return hit
@@ -363,7 +373,7 @@ class _CollectiveStoreActor:
         reads = self._gather_reads.setdefault(key, set())
         reads.add(reader_rank)
         result = entry
-        if len(reads) >= world_size:
+        if len(reads) >= (expected_readers or world_size):
             self._gathers.pop(key, None)
             self._gather_reads.pop(key, None)
         return result

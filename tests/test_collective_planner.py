@@ -1,0 +1,350 @@
+"""Topology-aware collective planner (ISSUE 10): the topology descriptor,
+the α-β decision matrix over (message size, world, link class), the
+slice-alignment refusal with its counted reason, the estimate_wire_bytes
+pin against measured wire bytes, plan_explain, and the ring/tree XLA
+programs on the virtual 8-device CPU mesh.
+
+Everything here is in-process CPU (no cluster), so the module stays in the
+tier-1 lane; cross-actor store-backend planner coverage (chunked ring,
+bucketed pipeline) lives in test_collective.py (slow lane).
+"""
+
+import numpy as np
+import pytest
+
+from ray_tpu.util.collective import compression as comp
+from ray_tpu.util.collective import planner as pl
+
+# ---------------------------------------------------------------------------
+# topology descriptor
+# ---------------------------------------------------------------------------
+
+
+def test_topology_from_slice_ids_normalizes():
+    t = pl.Topology.from_slice_ids(("nodeB", "nodeB", "nodeA", "nodeA"))
+    assert t.world_size == 4
+    assert t.slice_ids == (0, 0, 1, 1)  # first-seen order, hash-stable
+    assert t.num_slices == 2
+    assert t.slice_groups() == {0: (0, 1), 1: (2, 3)}
+
+
+def test_topology_flat_single_domain():
+    t = pl.Topology.flat(8, link=pl.LINK_ICI)
+    assert t.num_slices == 1
+    assert t.aligned_slice_size() is None
+    # single domain: ANY valid partition is aligned (no boundary to cross)
+    assert t.slice_aligned(4)
+    assert not t.slice_aligned(3)  # must still divide the world
+
+
+def test_topology_aligned_slice_size():
+    assert pl.Topology.from_slice_ids(
+        (0, 0, 0, 0, 1, 1, 1, 1)).aligned_slice_size() == 4
+    # uneven domains: 8 ranks over 3 slices cannot align
+    assert pl.Topology.from_slice_ids(
+        (0, 0, 0, 1, 1, 1, 2, 2)).aligned_slice_size() is None
+    # interleaved placement: equal sizes but non-contiguous ranks
+    assert pl.Topology.from_slice_ids(
+        (0, 1, 0, 1, 0, 1, 0, 1)).aligned_slice_size() is None
+
+
+def test_topology_slice_ids_length_checked():
+    with pytest.raises(ValueError):
+        pl.Topology(world_size=4, slice_ids=(0, 0))
+
+
+# ---------------------------------------------------------------------------
+# decision matrix: (size, world, topology) -> algorithm.  These pin the
+# planner's REGIMES, not exact crossover bytes (the α-β seeds may be
+# recalibrated); each case sits far inside its regime.
+# ---------------------------------------------------------------------------
+
+_LOSSLESS = comp.CompressionSpec(scheme="none", min_bytes=0)
+
+
+@pytest.mark.parametrize(
+    "nbytes,topology,spec,want_alg,want_reason",
+    [
+        # tiny lossless on ICI: one fused op beats any decomposition
+        (16 << 10, pl.Topology.flat(8, link=pl.LINK_ICI), _LOSSLESS,
+         comp.ALG_FLAT, "latency_bound"),
+        # mid-size pow2: recursive halving-doubling (log n steps)
+        (128 << 10, pl.Topology.flat(8, link=pl.LINK_ICI), _LOSSLESS,
+         comp.ALG_TREE, "latency_bound"),
+        # large: bandwidth-optimal ring
+        (16 << 20, pl.Topology.flat(8, link=pl.LINK_ICI), _LOSSLESS,
+         comp.ALG_RING, "bandwidth_bound"),
+        # non-pow2 world: tree is never legal, large goes ring
+        (16 << 20, pl.Topology.flat(6, link=pl.LINK_ICI), _LOSSLESS,
+         comp.ALG_RING, "bandwidth_bound"),
+        # store/host link, large: ring also wins over the full exchange
+        (64 << 20, pl.Topology.flat(4, link=pl.LINK_HOST), _LOSSLESS,
+         comp.ALG_RING, "bandwidth_bound"),
+        # 2 aligned slices + int8: 3-phase hierarchy over the DCN boundary
+        (1 << 20, pl.Topology.from_slice_ids((0, 0, 0, 0, 1, 1, 1, 1)),
+         comp.CompressionSpec(), comp.ALG_HIERARCHICAL, "dcn_boundary"),
+        # flat topology + int8: the EQuARX two-phase program
+        (1 << 20, pl.Topology.flat(8, link=pl.LINK_ICI),
+         comp.CompressionSpec(), comp.ALG_FLAT, "quantized_two_phase"),
+    ])
+def test_decision_matrix(nbytes, topology, spec, want_alg, want_reason):
+    plan = pl.plan_allreduce(nbytes, topology, spec)
+    assert plan.algorithm == want_alg, (plan, want_alg)
+    assert plan.reason == want_reason, (plan, want_reason)
+    if want_alg == comp.ALG_HIERARCHICAL:
+        assert plan.slice_size == topology.aligned_slice_size()
+
+
+def test_unaligned_slices_refuse_hierarchy():
+    """Satellite: uneven/interleaved domains must REFUSE the hierarchy
+    (the old sqrt fallback grouped ranks across a real slice boundary and
+    ran the "ICI" phase over DCN) — and the refusal is the counted
+    reason."""
+    spec = comp.CompressionSpec()
+    for ids in [(0, 0, 0, 1, 1, 1, 2, 2),      # 3 uneven slices over 8
+                (0, 1, 0, 1, 0, 1, 0, 1)]:     # interleaved equal slices
+        plan = pl.plan_allreduce(1 << 20, pl.Topology.from_slice_ids(ids),
+                                 spec)
+        assert plan.algorithm != comp.ALG_HIERARCHICAL
+        assert plan.reason == "unaligned_slices"
+    # explicit slice_size that would cross an interleaved boundary: refused
+    plan = pl.plan_allreduce(
+        1 << 20, pl.Topology.from_slice_ids((0, 1, 0, 1, 0, 1, 0, 1)),
+        comp.CompressionSpec(slice_size=4))
+    assert plan.algorithm != comp.ALG_HIERARCHICAL
+    assert plan.reason == "unaligned_slices"
+    # explicit slice_size on a SINGLE domain stays legal (no boundary)
+    plan = pl.plan_allreduce(1 << 20, pl.Topology.flat(8),
+                             comp.CompressionSpec(slice_size=4))
+    assert plan.algorithm == comp.ALG_HIERARCHICAL
+    assert plan.slice_size == 4
+
+
+def test_choose_plan_uneven_num_slices_refuses():
+    """The metadata-only entry point (choose_plan without a descriptor)
+    inherits the refusal: num_slices not dividing world can no longer
+    produce a divisor-guess hierarchy."""
+    plan = comp.choose_plan(1 << 20, 8, comp.CompressionSpec(), num_slices=3)
+    assert plan.algorithm == comp.ALG_FLAT
+    assert plan.reason == "unaligned_slices"
+    # dividing num_slices still goes hierarchical, as before
+    plan = comp.choose_plan(1 << 20, 8, comp.CompressionSpec(), num_slices=2)
+    assert plan.algorithm == comp.ALG_HIERARCHICAL
+    assert plan.slice_size == 4
+
+
+def test_unaligned_refusal_reason_is_counted():
+    from ray_tpu._private import runtime_metrics as rtm
+
+    before = rtm.plan_snapshot().get("flat/unaligned_slices", 0)
+    plan = pl.plan_allreduce(
+        1 << 20, pl.Topology.from_slice_ids((0, 0, 0, 1, 1, 1, 2, 2)),
+        comp.CompressionSpec())
+    pl.record_plan(plan.algorithm, plan.reason)  # what every backend calls
+    snap = rtm.plan_snapshot()
+    assert snap.get("flat/unaligned_slices", 0) == before + 1
+    from ray_tpu.util.metrics import collect_local, prometheus_text
+
+    text = prometheus_text([p for p in collect_local()
+                            if p["name"] == "ray_tpu_collective_plan_total"])
+    assert 'reason="unaligned_slices"' in text
+
+
+def test_stock_reasons():
+    t = pl.Topology.flat(8)
+    assert pl.plan_allreduce(1 << 20, t, None).reason == "no_spec"
+    assert pl.plan_allreduce(
+        1 << 20, pl.Topology.flat(1), comp.CompressionSpec()).reason == "solo"
+    assert pl.plan_allreduce(
+        1 << 10, t, comp.CompressionSpec()).reason == "below_min_bytes"
+    # the documented force-stock escape hatch stays byte-identical stock
+    plan = pl.plan_allreduce(64 << 20, t, comp.resolve_spec("none"))
+    assert plan.is_stock and plan.reason == "forced_stock"
+
+
+def test_backend_allowed_sets():
+    """The store backend implements no tree: its allowed set must steer
+    the tree regime to the next-best algorithm, never an unimplementable
+    plan (review regression: an un-allowed lossless tree plan used to
+    fall into the store's QUANTIZED dispatch branch)."""
+    from ray_tpu.util.collective.collective_group.store_group import \
+        StoreGroup
+
+    store_allowed = StoreGroup._PLANNABLE
+    assert comp.ALG_TREE not in store_allowed
+    # sweep the whole size range over both link classes and plausible
+    # probed bandwidths: no (size, topology) may ever emit tree
+    for link in (pl.LINK_ICI, pl.LINK_HOST):
+        for bw in (1e8, 1e9, 4e10):
+            t = pl.Topology.flat(8, link=link, intra_bw=bw)
+            for kb in (16, 64, 128, 512, 2048, 65536):
+                plan = pl.plan_allreduce(kb << 10, t, _LOSSLESS,
+                                         allowed=store_allowed)
+                assert plan.algorithm in store_allowed, (link, bw, kb, plan)
+                assert plan.scheme == comp.SCHEME_NONE  # lossless stays so
+
+
+def test_plan_cache_hit_returns_same_object():
+    t = pl.Topology.flat(8, link=pl.LINK_ICI)
+    a = pl.plan_allreduce(1 << 20, t, _LOSSLESS)
+    b = pl.plan_allreduce(1 << 20, t, _LOSSLESS)
+    assert a is b  # dict hit, not a re-derivation
+    # a topology version bump (probe refresh / membership change) misses
+    t2 = pl.Topology.flat(8, link=pl.LINK_ICI, version=1)
+    c = pl.plan_allreduce(1 << 20, t2, _LOSSLESS)
+    assert c is not a and c.algorithm == a.algorithm
+
+
+def test_plan_explain_surface():
+    t = pl.Topology.from_slice_ids((0, 0, 0, 0, 1, 1, 1, 1))
+    spec = comp.CompressionSpec()
+    info = pl.plan_explain(1 << 20, t, spec)
+    assert info["chosen"] == comp.ALG_HIERARCHICAL
+    assert info["reason"] == "dcn_boundary"
+    assert info["slice_size"] == 4
+    assert info["topology"]["num_slices"] == 2
+    assert info["topology"]["aligned_slice_size"] == 4
+    costs = info["modeled_cost_s"]
+    assert set(costs) >= {"flat", "ring", "tree", "hierarchical"}
+    # the model's whole job: the hierarchy must beat every flat-world
+    # schedule once a DCN boundary splits the group
+    assert costs["hierarchical"] < min(costs["flat"], costs["ring"])
+    # and explain() agrees with the actual plan
+    assert info["chosen"] == pl.plan_allreduce(1 << 20, t, spec).algorithm
+
+
+# ---------------------------------------------------------------------------
+# estimate_wire_bytes pinned to measured wire bytes (satellite): the "ONE
+# formula" docstring is now enforced — estimates match wire_nbytes on real
+# arrays exactly when sizes land on codec granules (the documented tail
+# padding is the only divergence, excluded by construction here).
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mib", [0.25, 1, 4])
+def test_estimate_wire_bytes_matches_measured(mib):
+    bs, world, ss = 256, 8, 4
+    n = int(mib * (1 << 20)) // 4          # f32 elements
+    n -= n % (world * bs * ss)             # land on every granule at once
+    x = np.random.default_rng(0).standard_normal(n).astype(np.float32)
+    logical = x.nbytes
+
+    # flat int8 (EQuARX two-phase): codes+scales once, plus the 1/world
+    # requantized shard re-sent in the allgather
+    codes, scales = comp.quantize_blocks(x, bs)
+    measured = comp.wire_nbytes(codes, scales)
+    est, inter = comp.estimate_wire_bytes(comp.ALG_FLAT, comp.SCHEME_INT8,
+                                          logical, world, block_size=bs)
+    assert est == measured + measured // world
+    assert inter == 0
+
+    # hierarchical int8: full payload intra + reduced shard intra + the
+    # quantized 1/ss shard across the DCN boundary
+    shard = x[: n // ss]
+    c2, s2 = comp.quantize_blocks(shard, bs)
+    m_inter = comp.wire_nbytes(c2, s2)
+    est_h, inter_h = comp.estimate_wire_bytes(
+        comp.ALG_HIERARCHICAL, comp.SCHEME_INT8, logical, world, ss, bs)
+    assert inter_h == m_inter
+    assert est_h == logical + shard.nbytes + m_inter
+
+    # hierarchical lossless: shard crosses uncompressed
+    est_hl, inter_hl = comp.estimate_wire_bytes(
+        comp.ALG_HIERARCHICAL, comp.SCHEME_NONE, logical, world, ss, bs)
+    assert inter_hl == shard.nbytes
+    assert est_hl == logical + 2 * shard.nbytes
+
+    # ring/tree decompositions: 2(n-1)/n of the payload per rank
+    est_r, _ = comp.estimate_wire_bytes(comp.ALG_RING, comp.SCHEME_NONE,
+                                        logical, world)
+    assert est_r == 2 * (world - 1) * logical // world
+    assert est_r == comp.estimate_wire_bytes(
+        comp.ALG_TREE, comp.SCHEME_NONE, logical, world)[0]
+
+
+# ---------------------------------------------------------------------------
+# planner-built XLA programs on the virtual 8-device CPU mesh
+# ---------------------------------------------------------------------------
+
+
+def _mesh_and_rows(n_per_rank=4096):
+    import jax
+
+    devices = jax.devices()[:8]
+    if len(devices) < 8:
+        pytest.skip("needs the 8-device virtual CPU mesh")
+    rng = np.random.default_rng(7)
+    # integer-valued floats: every reduction order sums EXACTLY, so the
+    # ring/tree programs can be checked bit-identical against psum
+    rows = [rng.integers(-64, 64, n_per_rank).astype(np.float32)
+            for _ in range(8)]
+    return devices, rows
+
+
+def test_ring_allreduce_program_exact():
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from ray_tpu.util.collective.collective_group import xla_group as xg
+
+    devices, rows = _mesh_and_rows()
+    mesh = Mesh(np.array(devices), ("world",))
+    g = jax.device_put(np.stack(rows), NamedSharding(mesh, P("world")))
+    out = np.asarray(xg.build_ring_allreduce(mesh, "world", 8)(g))
+    np.testing.assert_array_equal(out, np.sum(np.stack(rows), axis=0))
+
+
+def test_tree_allreduce_program_exact():
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from ray_tpu.util.collective.collective_group import xla_group as xg
+
+    devices, rows = _mesh_and_rows()
+    mesh = Mesh(np.array(devices), ("world",))
+    g = jax.device_put(np.stack(rows), NamedSharding(mesh, P("world")))
+    out = np.asarray(xg.build_tree_allreduce(mesh, "world", 8)(g))
+    np.testing.assert_array_equal(out, np.sum(np.stack(rows), axis=0))
+
+
+def test_tree_allreduce_rejects_non_pow2():
+    import jax
+    from jax.sharding import Mesh
+
+    from ray_tpu.util.collective.collective_group import xla_group as xg
+
+    devices = jax.devices()[:6]
+    if len(devices) < 6:
+        pytest.skip("needs >= 6 virtual CPU devices")
+    mesh = Mesh(np.array(devices), ("world",))
+    with pytest.raises(ValueError):
+        xg.build_tree_allreduce(mesh, "world", 6)
+
+
+def test_xla_group_routes_planned_lossless_algorithms():
+    """A solo XLA group plans stock (reason solo) and still books the
+    decision — the spec-in-force counter discipline — while the result
+    stays exact."""
+    from ray_tpu._private import runtime_metrics as rtm
+    from ray_tpu.util.collective.collective_group.xla_group import XLAGroup
+
+    g = XLAGroup(1, 0, "solo-planner")
+    before = rtm.plan_snapshot().get("flat/solo", 0)
+    x = np.arange(128 * 1024, dtype=np.float32)
+    out = g.allreduce(x, compression={"scheme": "none", "min_bytes": 0})
+    np.testing.assert_array_equal(np.asarray(out), x)
+    assert rtm.plan_snapshot().get("flat/solo", 0) == before + 1
+    g.destroy()
+
+
+def test_xla_group_no_spec_books_no_plan_points():
+    """No compression spec => the planner counter stays silent (the stock
+    path's metric output remains byte-identical)."""
+    from ray_tpu._private import runtime_metrics as rtm
+    from ray_tpu.util.collective.collective_group.xla_group import XLAGroup
+
+    g = XLAGroup(1, 0, "solo-noplan")
+    before = dict(rtm.plan_snapshot())
+    g.allreduce(np.ones(256 * 1024, np.float32))
+    assert rtm.plan_snapshot() == before
+    g.destroy()

@@ -166,3 +166,44 @@ def test_lease_reuse_rpc_budget():
         assert hit_rate > 0.90, f"lease reuse hit rate {hit_rate:.2%} ≤ 90%"
     finally:
         ray_tpu.shutdown()
+
+
+def test_planner_decision_budget():
+    """Hermetic planner cost gate (ISSUE 10): a CACHED plan decision sits
+    on the allreduce hot path (once per collective call), so it must stay
+    far below the op itself — budget 5 µs/decision (idle-host ~0.3-0.6 µs
+    dict hit; CI-loose headroom, no RPCs, no wall-clock racing)."""
+    import time
+
+    from ray_tpu.util.collective import compression as comp
+    from ray_tpu.util.collective import planner as pl
+
+    topo = pl.Topology.from_slice_ids((0, 0, 0, 0, 1, 1, 1, 1))
+    spec = comp.CompressionSpec()
+    pl.plan_allreduce(4 << 20, topo, spec)  # warm the cache
+    n = 5000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        pl.plan_allreduce(4 << 20, topo, spec)
+    per = (time.perf_counter() - t0) / n
+    assert per < 5e-6, f"cached plan decision {per * 1e6:.2f}µs > 5µs budget"
+
+
+def test_overlap_off_emits_zero_new_metric_families():
+    """Overlap/planner off (the defaults) books NOTHING into the new
+    ray_tpu_collective_plan_total family — fused-step metric output stays
+    byte-identical to the pre-planner runtime."""
+    import jax
+
+    from ray_tpu._private import runtime_metrics as rtm
+    from ray_tpu.models.llama import LlamaConfig
+    from ray_tpu.parallel import make_train_step
+
+    before = dict(rtm.plan_snapshot())
+    cfg = LlamaConfig.tiny()
+    init_fn, step_fn = make_train_step(cfg)  # overlap_grad_sync defaults off
+    st = init_fn(jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0,
+                                cfg.vocab_size)
+    st, _ = step_fn(st, tokens)
+    assert rtm.plan_snapshot() == before
