@@ -365,6 +365,22 @@ SERVE_SLO_REQUESTS = Counter(
     "Requests reaching a terminal lifecycle state at the serving ingress "
     "(ok / error / aborted = client disconnect / shed = admission refusal)",
     tag_keys=("deployment", "tenant", "status"))
+# draft-model speculative decoding (paged engine).  Booked ONLY when a
+# speculative_config is in force — the disabled path (the default) books
+# nothing, the same invariant as the rest of the SLO layer.  deployment =
+# the serving deployment's label ("engine" for direct engine use).
+# accepted/proposed over a window is the live acceptance rate; accepted
+# alone is the decode tokens that cost ZERO extra target forwards.
+SERVE_SPECDEC_PROPOSED = Counter(
+    "ray_tpu_serve_specdec_proposed_tokens_total",
+    "Draft-model tokens proposed for target verification (k per slot per "
+    "speculative step)",
+    tag_keys=("deployment",))
+SERVE_SPECDEC_ACCEPTED = Counter(
+    "ray_tpu_serve_specdec_accepted_tokens_total",
+    "Drafted tokens accepted by target verification (each one is a decode "
+    "token emitted without its own target forward pass)",
+    tag_keys=("deployment",))
 SERVE_SLO_BURN_RATE = Gauge(
     "ray_tpu_serve_slo_burn_rate",
     "SLO error-budget burn rate per deployment, objective (ttft / itl / "
@@ -409,6 +425,7 @@ FAMILIES = (
     KV_HANDOFF_BYTES, KV_HANDOFF_LATENCY, SERVE_DISAGG_QUEUE_DEPTH,
     SERVE_TTFT, SERVE_ITL, SERVE_STAGE_SECONDS, SERVE_ROUTE_DECISIONS,
     SERVE_SLO_REQUESTS, SERVE_SLO_BURN_RATE,
+    SERVE_SPECDEC_PROPOSED, SERVE_SPECDEC_ACCEPTED,
     DATA_ROWS, DATA_BACKPRESSURE,
 )
 
@@ -900,6 +917,36 @@ def kv_handoff_snapshot() -> dict:
             d["mean_latency_s"] = lat / n
         if lat > 0 and d.get("bytes_total"):
             d["effective_gbps"] = d["bytes_total"] / lat / 1e9
+    return out
+
+
+def add_specdec_tokens(deployment: str, proposed: int,
+                       accepted: int) -> None:
+    """One speculative collect's drafted/accepted token counts.  Callers
+    only exist when a speculative_config is in force — the disabled path
+    books nothing (the documented invariant)."""
+    if proposed > 0:
+        _bound(SERVE_SPECDEC_PROPOSED, deployment=deployment).inc(proposed)
+    if accepted > 0:
+        _bound(SERVE_SPECDEC_ACCEPTED, deployment=deployment).inc(accepted)
+
+
+def specdec_snapshot() -> dict:
+    """Process-local speculative-decoding accounting for bench.py and the
+    perf tests: per-deployment proposed/accepted token counts plus the
+    derived acceptance rate.  Hermetic — this process's counters only."""
+    out: dict = {}
+    for tags_key, v in dict(SERVE_SPECDEC_PROPOSED._points).items():
+        dep = dict(tags_key).get("deployment", "?")
+        out.setdefault(dep, {})["proposed"] = (
+            out.get(dep, {}).get("proposed", 0.0) + v)
+    for tags_key, v in dict(SERVE_SPECDEC_ACCEPTED._points).items():
+        dep = dict(tags_key).get("deployment", "?")
+        out.setdefault(dep, {})["accepted"] = (
+            out.get(dep, {}).get("accepted", 0.0) + v)
+    for d in out.values():
+        p = d.get("proposed", 0.0)
+        d["acceptance_rate"] = (d.get("accepted", 0.0) / p) if p else 0.0
     return out
 
 

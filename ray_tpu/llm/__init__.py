@@ -9,7 +9,7 @@ default) and JaxLLMEngine (static per-slot cache).
 """
 
 from ray_tpu.llm.batch import Processor, ProcessorConfig, build_llm_processor
-from ray_tpu.llm.config import GenerationConfig, LLMConfig
+from ray_tpu.llm.config import GenerationConfig, LLMConfig, SpeculativeConfig
 from ray_tpu.llm.disagg import (
     DecodeServer,
     DisaggLLMServer,
@@ -41,6 +41,7 @@ __all__ = [
     "merge_lora",
     "Processor",
     "ProcessorConfig",
+    "SpeculativeConfig",
     "build_llm_deployment",
     "build_openai_app",
     "OpenAICompatServer",

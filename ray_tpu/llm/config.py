@@ -22,6 +22,42 @@ class GenerationConfig:
 
 
 @dataclasses.dataclass
+class SpeculativeConfig:
+    """Draft-model speculative decoding (paged engine only).
+
+    A small draft model proposes ``num_speculative_tokens`` tokens per
+    slot per step; the target model batch-verifies all of them in ONE
+    forward pass (standard rejection sampling at temperature > 0; exact
+    longest-agreeing-prefix at temperature 0 — greedy output is
+    bit-identical to non-speculative decode).  The draft's KV lives in
+    its own block pool sharing the BlockManager machinery; draft-pool
+    exhaustion degrades the affected request to non-speculative decode
+    (zero drops).
+    """
+
+    # a models.llama.LlamaConfig for the draft model (same vocab as the
+    # target; typically far fewer layers / smaller dim)
+    draft_model_config: Any = None
+    # k: drafted tokens verified per target forward (per slot per step).
+    # Each step emits between 1 (all rejected) and k+1 (all accepted +
+    # the bonus token) tokens per slot.
+    num_speculative_tokens: int = 4
+    # draft KV pool size in blocks; None → the target pool's block count
+    # (draft blocks are much smaller — draft layers/kv dims)
+    draft_num_blocks: Optional[int] = None
+    # multi-LoRA extension: per-adapter draft choice.  Maps a serve
+    # model id to overrides applied when that adapter's engine is built:
+    #   {"enabled": False}              — this adapter decodes without
+    #                                     speculation
+    #   {"num_speculative_tokens": k}   — per-adapter k
+    #   {"draft_adapter": <lora tree>}  — a LoRA adapter (llm/lora.py)
+    #                                     merged into the DRAFT model for
+    #                                     this id (draft tracks the tuned
+    #                                     target, keeping acceptance up)
+    per_adapter: Optional[Dict[str, Dict[str, Any]]] = None
+
+
+@dataclasses.dataclass
 class LLMConfig:
     """reference analog: llm/_internal LLMConfig + vLLM engine_kwargs."""
 
@@ -47,10 +83,20 @@ class LLMConfig:
     # prompts interleave with decode instead of stalling it
     prefill_chunk: int = 256
     # prompt tokens the engine may prefill per STEP across all slots (the
-    # vLLM max_num_batched_tokens analog). None = prefill_chunk (one
-    # chunk's worth). Raise for burst-arrival serving: a 32-client burst
-    # otherwise ramps one chunk per step, serializing admission.
+    # vLLM max_num_batched_tokens analog): chunked-prefill scheduling
+    # interleaves bounded prefill chunks with decode steps under this
+    # budget, so a long prompt cannot starve decode ITL inside continuous
+    # batching. None = prefill_chunk (one chunk's worth). Raise for
+    # burst-arrival serving: a 32-client burst otherwise ramps one chunk
+    # per step, serializing admission.
+    prefill_token_budget: Optional[int] = None
+    # deprecated alias for prefill_token_budget (pre-ISSUE-11 name); the
+    # new knob wins when both are set
     prefill_budget_tokens: Optional[int] = None
+    # draft-model speculative decoding (paged engine only; see
+    # SpeculativeConfig). None disables — the disabled path is untouched:
+    # no draft pool, no extra device programs, no metrics booked.
+    speculative_config: Optional[SpeculativeConfig] = None
     enable_prefix_caching: bool = True
     # --- tiered prefix cache (paged engine) ---
     # host-RAM tier under the HBM chain-hash pool: full prompt blocks

@@ -55,10 +55,19 @@ class PrefillServer:
     batch competing for the dispatch queue."""
 
     def __init__(self, llm_config: LLMConfig, params=None):
+        import dataclasses
+
         from ray_tpu.llm.engine import make_engine
 
         if llm_config.kv_cache != "paged":
             raise ValueError("disaggregated serving requires kv_cache='paged'")
+        if llm_config.speculative_config is not None:
+            # prefill never decodes: a draft pool here would burn HBM and
+            # every prompt would pay a pointless draft prefill.  The
+            # DECODE stage is the speculative consumer — import_request
+            # seeds its draft KV by recompute at draft size.
+            llm_config = dataclasses.replace(llm_config,
+                                             speculative_config=None)
         self._config = llm_config
         self._engine = make_engine(llm_config, params)
         if hasattr(self._engine, "warmup") and _jax_backend() == "tpu":
@@ -390,14 +399,20 @@ def build_disagg_llm_deployment(
         decode_config: Optional[LLMConfig] = None,
         prefill_autoscaling: Optional[dict] = None,
         decode_autoscaling: Optional[dict] = None,
-        lora_adapters: Optional[Dict[str, Any]] = None):
+        lora_adapters: Optional[Dict[str, Any]] = None,
+        draft_params=None):
     """An Application serving ``llm_config`` as separately autoscaled
     prefill and decode deployments behind one ingress (the disaggregated
     analog of ``build_llm_deployment``).  ``prefill_config`` /
     ``decode_config`` override the per-stage engine shapes (a prefill pool
     mostly needs prompt-sized residency; decode wants the full pool);
     ``*_autoscaling`` are the standard serve autoscaling_config dicts, so
-    the controller scales each stage on its own queue depth."""
+    the controller scales each stage on its own queue depth.
+
+    With ``llm_config.speculative_config`` set, the DECODE stage is the
+    speculative consumer (``draft_params`` feeds its draft model; every
+    imported handoff seeds the draft KV by recompute at draft size); the
+    prefill stage strips speculation — it never decodes."""
     from ray_tpu import serve
 
     pre_cfg = prefill_config or llm_config
@@ -415,7 +430,7 @@ def build_disagg_llm_deployment(
         max_ongoing_requests=max(8, dec_cfg.max_batch_size),
         autoscaling_config=decode_autoscaling,
         ray_actor_options={"resources": dec_cfg.resources_per_replica()},
-    ).bind(dec_cfg, params, lora_adapters)
+    ).bind(dec_cfg, params, lora_adapters, draft_params)
     ingress = serve.deployment(
         DisaggLLMServer, name=name, num_replicas=1,
         max_ongoing_requests=4 * max(8, dec_cfg.max_batch_size),

@@ -60,15 +60,15 @@ class _Request:
     error: Optional[str] = None
 
 
-def _sample(logits, key, temps, top_ks):
-    """Sample [B] token ids from [B, V] logits with *per-slot* traced
-    sampling params — one compiled program serves any mix of greedy /
-    temperature / top-k callers sharing the decode batch.
-
-    temps [B] float32 (<= 0 -> greedy); top_ks [B] int32 (<= 0 -> off).
-    """
-    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    t = jnp.maximum(temps, 1e-6)[:, None]
+def _masked_scaled(logits, temps, top_ks):
+    """Temperature-scaled, top-k-masked logits [B, V] — the categorical
+    branch's pre-softmax shape, shared by sampling and the speculative
+    verifier (target/draft distributions MUST match what non-speculative
+    sampling would draw from).  temps <= 0 rows divide by 1.0 (a benign
+    placeholder — those rows are greedy and never read the scaled value;
+    the old ``max(temps, 1e-6)`` scaled logits by 1e6, a needless
+    overflow hazard on the never-used branch)."""
+    t = jnp.where(temps > 0.0, temps, 1.0)[:, None]
     scaled = logits / t
     # kth-largest via a capped top-k (not a full [B, V] sort — V=32k sorts
     # cost milliseconds per step on TPU; see _MAX_TOP_K)
@@ -76,9 +76,39 @@ def _sample(logits, key, temps, top_ks):
     topv, _ = jax.lax.top_k(scaled, kmax)
     idx = jnp.clip(top_ks - 1, 0, kmax - 1)
     kth = jnp.take_along_axis(topv, idx[:, None], axis=-1)
-    masked = jnp.where((top_ks[:, None] > 0) & (scaled < kth), -1e30, scaled)
+    return jnp.where((top_ks[:, None] > 0) & (scaled < kth), -1e30, scaled)
+
+
+def _sample(logits, key, temps, top_ks):
+    """Sample [B] token ids from [B, V] logits with *per-slot* traced
+    sampling params — one compiled program serves any mix of greedy /
+    temperature / top-k callers sharing the decode batch.
+
+    temps [B] float32 (<= 0 -> greedy); top_ks [B] int32 (<= 0 -> off).
+
+    temperature <= 0 is EXACT argmax of the raw logits: no temperature
+    scaling, no top-k perturbation, and no dependence on ``key`` (the
+    categorical draw happens on the other branch of the select; greedy
+    rows ignore it entirely) — the enabling precondition for speculative
+    decoding's greedy bit-parity pin (tests/test_specdec.py).
+    """
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    masked = _masked_scaled(logits, temps, top_ks)
     sampled = jax.random.categorical(key, masked, axis=-1).astype(jnp.int32)
     return jnp.where(temps <= 0.0, greedy, sampled)
+
+
+def _sample_dist(logits, temps, top_ks):
+    """The probability distribution [B, V] that ``_sample`` draws from:
+    post temperature/top-k softmax for temps > 0 rows, an exact one-hot
+    at the argmax for greedy rows.  The one-hot form makes speculative
+    rejection sampling COLLAPSE to exact greedy verification — accept iff
+    the draft token is the target argmax, corrections/bonus tokens are
+    the argmax — with no separate greedy branch in the verifier."""
+    probs = jax.nn.softmax(_masked_scaled(logits, temps, top_ks), axis=-1)
+    one_hot = jax.nn.one_hot(jnp.argmax(logits, axis=-1), logits.shape[-1],
+                             dtype=probs.dtype)
+    return jnp.where(temps[:, None] <= 0.0, one_hot, probs)
 
 
 def build_tp_mesh(cfg, tp: int):
@@ -145,13 +175,24 @@ def pp_cache_spec(spec: dict, pp: int) -> dict:
     return {k: P(*(("pipeline",) + tuple(s)[1:])) for k, s in spec.items()}
 
 
-def make_engine(config: "LLMConfig", params=None, *, key=None):
-    """Engine factory: ``config.kv_cache`` picks paged (default) or static."""
+def make_engine(config: "LLMConfig", params=None, *, key=None,
+                draft_params=None):
+    """Engine factory: ``config.kv_cache`` picks paged (default) or static.
+
+    ``draft_params``: params for ``config.speculative_config``'s draft
+    model (paged engine only; None with speculation configured random-
+    initializes the draft — fine for tests, acceptance-rate ~0 in prod).
+    """
     if config.kv_cache == "paged":
         from ray_tpu.llm.paged import PagedJaxLLMEngine
 
-        return PagedJaxLLMEngine(config, params, key=key)
+        return PagedJaxLLMEngine(config, params, key=key,
+                                 draft_params=draft_params)
     if config.kv_cache == "static":
+        if config.speculative_config is not None:
+            raise ValueError(
+                "speculative_config requires kv_cache='paged' (the static "
+                "engine has no block pool for the draft KV)")
         return JaxLLMEngine(config, params, key=key)
     raise ValueError(
         f"kv_cache must be 'paged' or 'static' (got {config.kv_cache!r})")

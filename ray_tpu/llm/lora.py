@@ -83,6 +83,40 @@ def merge_lora(params: Dict[str, Any], adapter: Dict[str, Any]) -> Dict[str, Any
     return out
 
 
+def adapter_speculation(spec_cfg, model_id: Optional[str]):
+    """Resolve speculative decoding for one multi-LoRA model id (the
+    per-adapter draft choice, ``SpeculativeConfig.per_adapter``).
+
+    Returns ``(effective_spec_cfg, draft_adapter)``:
+
+      - ``(None, None)`` — speculation off for this adapter (no global
+        spec config, or an explicit ``{"enabled": False}`` override);
+      - ``(cfg, None)`` — the global config applies unchanged (possibly
+        with a per-adapter ``num_speculative_tokens``);
+      - ``(cfg, adapter)`` — additionally merge ``adapter`` (a LoRA tree
+        targeting the DRAFT model) into the draft weights for this id,
+        so a tuned target keeps its draft aligned (acceptance rate is a
+        property of the model PAIR — serving a LoRA target against the
+        base draft silently halves the speedup).
+    """
+    if spec_cfg is None:
+        return None, None
+    over = (spec_cfg.per_adapter or {}).get(model_id) if model_id else None
+    if not over:
+        return spec_cfg, None
+    if not over.get("enabled", True):
+        return None, None
+    eff = spec_cfg
+    k = over.get("num_speculative_tokens")
+    if k is not None:
+        if int(k) < 1:
+            # an explicit 0 means "don't speculate for this adapter" —
+            # swallowing it (falsy-zero) would silently keep the global k
+            return None, None
+        eff = dataclasses.replace(spec_cfg, num_speculative_tokens=int(k))
+    return eff, over.get("draft_adapter")
+
+
 def lora_param_specs(cfg: LlamaConfig, lora: LoRAConfig):
     """PartitionSpec tree for adapter params: rank dims replicated (tiny),
     model dims following the base layout so merges stay local."""

@@ -40,7 +40,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from ray_tpu.llm.config import GenerationConfig, LLMConfig
-from ray_tpu.llm.engine import _MAX_STOP_IDS, _MAX_TOP_K, _Request, _sample
+from ray_tpu.llm.engine import (
+    _MAX_STOP_IDS,
+    _MAX_TOP_K,
+    _Request,
+    _sample,
+    _sample_dist,
+)
 from ray_tpu._private.prefix_hash import chain_hash, prefix_chain_hashes
 from ray_tpu.models import llama
 from ray_tpu.ops.rope import rope_frequencies
@@ -270,6 +276,19 @@ class _PagedReq(_Request):
     t_enqueue: float = 0.0
     t_admit: float = 0.0
     t_first_emit: float = 0.0
+    # --- speculative decoding (engine._spec is not None) ---
+    # draft-pool blocks mirroring this request's KV in the draft model's
+    # pool; draft_prefill_pos tracks the draft's own chunked prefill
+    # (a target prefix-cache hit does not help the draft — it recomputes
+    # the matched region, cheap at draft size)
+    draft_blocks: List[int] = dataclasses.field(default_factory=list)
+    draft_prefill_pos: int = 0
+    # False = this request decodes non-speculatively (draft-pool
+    # exhaustion degrade, or a per-adapter opt-out) — zero drops
+    spec_enabled: bool = False
+    # acceptance bookkeeping (per-request speedup/acceptance metering)
+    spec_proposed: int = 0
+    spec_accepted: int = 0
 
 
 def _bucket_pow2(n: int, lo: int = 1) -> int:
@@ -277,6 +296,52 @@ def _bucket_pow2(n: int, lo: int = 1) -> int:
     while b < n:
         b *= 2
     return b
+
+
+def _spec_accept(pdist, qdist, drafted, key):
+    """Rejection-sampling core of speculative verification (traced).
+
+    pdist [B, k+1, V]: target distributions at each window position;
+    qdist [B, k, V]: the draft distributions that generated ``drafted``
+    [B, k] (zeroed rows disable speculation for that slot — acceptance
+    is forced off and the correction residual degenerates to the target
+    distribution itself).  Returns ``(a [B], corr [B])``: the count of
+    leading accepted proposals and the correction token sampled from
+    ``normalize(max(p_a - q_a, 0))`` — which, with ``q`` zero-padded at
+    index k, IS the bonus-token draw from ``p_k`` on full acceptance.
+
+    The standard speculative-sampling guarantee holds position-wise: the
+    emitted token at each position is distributed exactly as the target
+    distribution (pinned empirically in tests/test_specdec.py).  Greedy
+    rows (one-hot dists from engine._sample_dist) collapse to exact
+    longest-agreeing-prefix verification with argmax corrections."""
+    b, k = drafted.shape
+    key, ku, kr = jax.random.split(key, 3)
+    u = jax.random.uniform(ku, (b, k))
+    p_d = jnp.take_along_axis(pdist[:, :k], drafted[..., None], -1)[..., 0]
+    q_d = jnp.take_along_axis(qdist, drafted[..., None], -1)[..., 0]
+    # q_d > 0: a token the draft could not have drawn is never accepted
+    # (a genuinely drafted token always has q_d > 0 — the categorical
+    # cannot pick a zero-probability id — so this changes nothing on the
+    # real path; it is what makes a ZEROED q row force a = 0, pinning
+    # degraded slots' corrections to the position-0 target distribution)
+    accept = (u * q_d < p_d) & (q_d > 0)
+    a = jnp.cumprod(accept.astype(jnp.int32), axis=1).sum(1)  # [B] 0..k
+    q_pad = jnp.concatenate(
+        [qdist, jnp.zeros((b, 1, qdist.shape[-1]), qdist.dtype)], axis=1)
+    p_a = jnp.take_along_axis(
+        pdist, a[:, None, None].repeat(pdist.shape[-1], -1), 1)[:, 0]
+    q_a = jnp.take_along_axis(
+        q_pad, a[:, None, None].repeat(q_pad.shape[-1], -1), 1)[:, 0]
+    resid = jnp.maximum(p_a - q_a, 0.0)
+    resid = jnp.where(resid.sum(-1, keepdims=True) > 0, resid, p_a)
+    # exact-zero residual entries get -inf weight (NOT log(x+eps): the
+    # greedy one-hot path must have literally zero probability of
+    # drawing a non-argmax token — the bit-parity pin)
+    corr = jax.random.categorical(
+        kr, jnp.where(resid > 0, jnp.log(resid), -jnp.inf),
+        axis=-1).astype(jnp.int32)
+    return a, corr
 
 
 def _prefill_plan(plen: int, matched: int, chunk: int, bs: int):
@@ -327,9 +392,20 @@ def _prefill_table_width(max_seq: int, chunk: int, bs: int) -> int:
 
 
 class PagedJaxLLMEngine:
-    """Drop-in engine with the static engine's API over a paged KV pool."""
+    """Drop-in engine with the static engine's API over a paged KV pool.
 
-    def __init__(self, config: LLMConfig, params=None, *, key=None):
+    With ``config.speculative_config`` set, decode runs draft-model
+    speculative: a small draft proposes k tokens per slot per step and
+    the target verifies all k in ONE forward window (rejection sampling
+    at temperature > 0; exact longest-agreeing-prefix at temperature 0 —
+    greedy output is bit-identical to non-speculative decode).  The
+    draft's KV lives in its own block pool under the same BlockManager
+    machinery; draft-pool exhaustion degrades the affected request to
+    plain decode (zero drops).
+    """
+
+    def __init__(self, config: LLMConfig, params=None, *, key=None,
+                 draft_params=None):
         self.config = config
         cfg = config.model_config
         if cfg is None:
@@ -425,8 +501,9 @@ class PagedJaxLLMEngine:
         # previous chunk's tokens: the readback of chunk N overlaps chunk
         # N+1's device compute, hiding the dispatch+fence round trip
         # (~100 ms on a tunneled chip, ~3 ms/token-step at chunk 32).
-        # (em_dev, active_slots): collected lazily by _drain_locked().
-        self._inflight: Optional[Tuple[jnp.ndarray, List[int]]] = None
+        # (em_dev, active_slots, spec_slots): collected lazily by
+        # _drain_locked(); spec_slots is () on the non-speculative path.
+        self._inflight: Optional[Tuple] = None
         # monotonic ts of the last traced step's phase spans (rate limit)
         self._last_phase_span = float("-inf")
         # a finished prefill's sampled first token stays a DEVICE future
@@ -482,6 +559,72 @@ class PagedJaxLLMEngine:
                                      "v": pool["v"].at[:, idx].set(v)},
             donate_argnums=0)
 
+        # --- draft-model speculative decoding ---------------------------
+        # The disabled path (speculative_config=None) stops HERE: no draft
+        # pool, no extra programs, and step() pays one `is None` test.
+        self._spec = config.speculative_config
+        self._spec_k = 0
+        if self._spec is not None:
+            dcfg = self._spec.draft_model_config
+            if dcfg is None:
+                raise ValueError(
+                    "speculative_config.draft_model_config is required")
+            if dcfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab_size {dcfg.vocab_size} != target "
+                    f"{cfg.vocab_size} — verification compares token ids")
+            k = int(self._spec.num_speculative_tokens)
+            if k < 1:
+                raise ValueError(
+                    f"num_speculative_tokens must be >= 1 (got {k})")
+            self._spec_k = k
+            self._draft_cfg = dcfg
+            if draft_params is None:
+                draft_params = llama.init_params(
+                    dcfg, key or jax.random.PRNGKey(1))
+            self._draft_params = draft_params
+            dcos, dsin = rope_frequencies(dcfg.head_dim, self.max_seq,
+                                          dcfg.rope_theta)
+            self._draft_rope = (jnp.asarray(dcos), jnp.asarray(dsin))
+            dnb = self._spec.draft_num_blocks or nb
+            self._draft_num_blocks = dnb
+            # no prefix caching in the draft pool: draft KV is never
+            # shared across requests (recompute at draft size is cheap,
+            # and chain bookkeeping would double the admission work)
+            self.draft_blocks = BlockManager(dnb, self.bs,
+                                             prefix_caching=False)
+            self._draft_pool = llama.init_paged_kv_cache(dcfg, dnb, self.bs)
+            if self.mesh is not None:
+                from ray_tpu.parallel.mesh import shard_pytree
+
+                self._draft_params = shard_pytree(
+                    self._draft_params,
+                    pp_param_specs(llama.inference_param_specs(dcfg), pp),
+                    self.mesh)
+                self._draft_pool = shard_pytree(
+                    self._draft_pool,
+                    pp_cache_spec(llama.paged_kv_cache_spec(), pp),
+                    self.mesh)
+            self._d_spec = None  # device mirror of per-slot spec enable
+            # draft chunked prefill: same chunk/table geometry as the
+            # target (block_size is shared, so the fixed width carries)
+            self._draft_prefill = jax.jit(
+                lambda p, tok, pool, tab, p0: llama.prefill_chunk_paged(
+                    self._draft_cfg, p, tok, pool, tab, p0,
+                    rope_cache=self._draft_rope)[1],
+                donate_argnums=2)
+            self._draft_propose = jax.jit(self._draft_propose_impl,
+                                          donate_argnums=2)
+            self._spec_verify = jax.jit(self._spec_verify_impl,
+                                        donate_argnums=4)
+            # engine-lifetime acceptance totals (bench / specdec_stats)
+            self._spec_proposed_total = 0
+            self._spec_accepted_total = 0
+            # finished requests' (proposed, accepted) for the serving
+            # layer's per-request acceptance rows (bounded)
+            self._spec_finished: "collections.OrderedDict[int, Tuple[int, int]]" = (
+                collections.OrderedDict())
+
     # -- jitted programs ------------------------------------------------
 
     def _decode_chunk_impl(self, params, tokens, pool, table, lengths, active,
@@ -523,6 +666,112 @@ class PagedJaxLLMEngine:
         ids = _sample(logits[:, sample_idx], sub, temp, top_k)
         return ids, pool, key
 
+    def _draft_propose_impl(self, params, tokens, pool, table, lengths,
+                            key, temps, top_ks):
+        """k+1 autoregressive draft steps per slot: step j feeds the
+        running token at position lengths+j and samples the next proposal.
+        Steps 0..k-1 yield the k proposals; step k exists only to WRITE
+        the last proposal's draft KV (on full acceptance the next cycle
+        starts at lengths+k+1, and the draft's attention span must cover
+        position lengths+k — without the extra step the draft pool would
+        silently fall one token behind after every full accept).
+
+        Returns (drafted [k, B], qdist [k, B, V] — the exact per-step
+        sampling distributions, for rejection sampling — updated pool,
+        key).  Positions clamp at max_seq-1: a slot that close to the
+        end finishes this cycle, and the clamped writes only ever clobber
+        draft KV of a sequence about to free its slot."""
+        k = self._spec_k
+
+        def one(carry, j):
+            tok, pool, key = carry
+            cur = jnp.minimum(lengths + j, self.max_seq - 1)
+            logits, pool = llama.decode_step_paged(
+                self._draft_cfg, params, tok, pool, table, cur,
+                rope_cache=self._draft_rope)
+            key, sub = jax.random.split(key)
+            ids = _sample(logits, sub, temps, top_ks)
+            q = _sample_dist(logits, temps, top_ks)
+            return (ids, pool, key), (ids, q)
+
+        (_, pool, key), (drafted, qdist) = jax.lax.scan(
+            one, (tokens, pool, key), jnp.arange(k + 1))
+        return drafted[:k], qdist[:k], pool, key
+
+    def _spec_verify_impl(self, params, tokens, drafted, qdist, pool, table,
+                          lengths, active, remaining, stops, key, temps,
+                          top_ks, spec):
+        """Verify k drafted tokens per slot in ONE target forward.
+
+        The window [t0, d_1..d_k] runs through ``decode_window_paged``
+        (KV written at positions lengths..lengths+k; rejected positions'
+        KV goes stale and is overwritten by later steps — attention masks
+        by length, so stale KV is never read).  Acceptance is standard
+        rejection sampling — accept d_j iff u*q(d_j) < p(d_j), correction
+        from normalize(max(p-q, 0)), bonus from p_k on full acceptance —
+        where greedy rows' distributions are exact argmax one-hots
+        (engine._sample_dist), which COLLAPSES the same arithmetic to
+        exact longest-agreeing-prefix verification: greedy output is
+        bit-identical to non-speculative decode.  Slots with spec=0
+        (degraded / draft disabled) force zero acceptances and a zeroed
+        draft distribution, making their single emission an exact plain
+        decode step.  Stop-token / budget / max_seq handling mirrors the
+        non-speculative scan ORDER-EXACTLY over the emission sequence.
+
+        Returns (emitted [k+1, B] (-1 padded), accepted [B] — the TRUE
+        per-slot acceptance count, BEFORE stop/budget/max_seq truncation
+        of the emission window, so metered acceptance measures draft
+        quality rather than conflating it with a request's final-cycle
+        truncation — next tokens, pool, lengths, active, remaining,
+        key); the emitted matrix matches the chunked decode program's
+        contract, so collection reuses the pipeline."""
+        k = self._spec_k
+        b = tokens.shape[0]
+        window = jnp.concatenate([tokens[:, None], drafted.T], axis=1)
+        logits, pool = llama.decode_window_paged(
+            self.cfg, params, window, pool, table, lengths,
+            rope_cache=self._rope, pos_limit=self.max_seq)
+        # per-position target distributions under each slot's sampling
+        # params — exactly what non-speculative _sample would draw from
+        pdist = jax.vmap(lambda lg: _sample_dist(lg, temps, top_ks),
+                         in_axes=1, out_axes=1)(logits)  # [B, k+1, V]
+        d = drafted.T  # [B, k]
+        # zero the draft distribution for non-spec slots: acceptance is
+        # forced off (u*0 < p never accepts a q-impossible token... and
+        # the explicit mask below makes it unconditional) AND the
+        # correction residual max(p - 0, 0) becomes p itself — their one
+        # emission is an exact plain decode sample
+        q = qdist.transpose(1, 0, 2) * (spec[:, None, None] > 0)
+        key, ka = jax.random.split(key)
+        a, corr = _spec_accept(pdist, q, d, ka)
+        idx = jnp.arange(k + 1)[None, :]
+        # candidate emission j: accepted draft for j < a, correction at a
+        e = jnp.where(idx < a[:, None],
+                      jnp.pad(d, ((0, 0), (0, 1))), corr[:, None])
+        # sequential stop/budget/max_seq semantics, mirroring the
+        # non-speculative scan: emission j implies lengths+j+1 written
+        # tokens and remaining-(j+1) budget; the first done truncates
+        base = (idx <= a[:, None]) & (active[:, None] > 0)
+        hit_stop = (stops[:, None, :] == e[..., None]).any(-1)
+        done_at = (hit_stop
+                   | (remaining[:, None] - (idx + 1) <= 0)
+                   | (lengths[:, None] + idx + 2 >= self.max_seq))
+        stopped_before = jnp.cumsum(
+            jnp.pad((base & done_at).astype(jnp.int32),
+                    ((0, 0), (1, 0)))[:, :-1], axis=1) > 0
+        valid = base & ~stopped_before
+        emitted = jnp.where(valid, e, -1).astype(jnp.int32).T  # [k+1, B]
+        n_emit = valid.sum(1)
+        new_len = lengths + n_emit
+        new_rem = remaining - n_emit
+        done = (valid & done_at).any(1)
+        new_active = active * (1 - done.astype(active.dtype))
+        last = jnp.take_along_axis(
+            e, jnp.maximum(n_emit - 1, 0)[:, None], 1)[:, 0]
+        new_tok = jnp.where(new_active > 0, last, tokens).astype(jnp.int32)
+        return (emitted, a.astype(jnp.int32), new_tok, pool, new_len,
+                new_active, new_rem, key)
+
     # -- request lifecycle ---------------------------------------------
 
     def add_request(self, prompt: Sequence[int],
@@ -555,6 +804,7 @@ class PagedJaxLLMEngine:
         with self._lock:
             self._req_counter += 1
             req = _PagedReq(self._req_counter, list(prompt), gen)
+            req.spec_enabled = self._spec is not None
             if self.slo_label is not None:
                 req.t_enqueue = time.monotonic()
             self._requests[req.request_id] = req
@@ -646,6 +896,27 @@ class PagedJaxLLMEngine:
             hashes = hashes[-max_hashes:]
         return {"block_size": self.bs, "hashes": hashes}
 
+    # -- speculative decoding surfaces ----------------------------------
+
+    def specdec_stats(self) -> Optional[Dict[str, float]]:
+        """Engine-lifetime acceptance totals, or None with speculation
+        off (the same books-nothing shape as the metric families)."""
+        if self._spec is None:
+            return None
+        with self._lock:
+            p, a = self._spec_proposed_total, self._spec_accepted_total
+        return {"k": self._spec_k, "proposed": p, "accepted": a,
+                "acceptance_rate": (a / p) if p else 0.0}
+
+    def specdec_request_stats(self, request_id: int):
+        """(proposed, accepted) for a FINISHED request, or None (unknown
+        id, speculation off, or the request never speculated) — the
+        serving layer attaches this to the request's SLO recent-row."""
+        if self._spec is None:
+            return None
+        with self._lock:
+            return self._spec_finished.get(request_id)
+
     # -- admission / prefill -------------------------------------------
 
     def _admit_locked(self):
@@ -671,6 +942,19 @@ class PagedJaxLLMEngine:
             if fresh is None:
                 self.blocks.release(shared)
                 return  # pool full: keep FIFO order, retry next step
+            if req.spec_enabled:
+                # the draft prefills the WHOLE prompt (no prefix cache in
+                # the draft pool), so it needs the full chunk-padded cover
+                dcover = _prefill_plan(len(req.prompt), 0,
+                                       self.config.prefill_chunk, self.bs)
+                dfresh = self.draft_blocks.alloc(dcover + 1)
+                if dfresh is None:
+                    # draft-pool exhaustion degrades THIS request to
+                    # plain decode — never blocks admission (zero drops)
+                    req.spec_enabled = False
+                else:
+                    req.draft_blocks = dfresh
+                    req.draft_prefill_pos = 0
             if self.blocks.prefix_caching:
                 from ray_tpu._private import runtime_metrics
 
@@ -698,63 +982,132 @@ class PagedJaxLLMEngine:
                 else:
                     req.t_admit = time.monotonic()
 
+    def _decode_ready(self, req: _PagedReq) -> bool:
+        """A slot joins the decode batch only when its target prefill —
+        and, when speculating, its draft prefill — covers the prompt."""
+        plen = len(req.prompt)
+        if req.prefill_pos < plen:
+            return False
+        return not req.spec_enabled or req.draft_prefill_pos >= plen
+
+    def _draft_prefill_chunk_locked(self, req: _PagedReq):
+        """Dispatch one draft prefill chunk (same pow2 chunk geometry and
+        fixed table width as the target — block_size is shared)."""
+        plen = len(req.prompt)
+        remaining = plen - req.draft_prefill_pos
+        c = min(self.config.prefill_chunk,
+                _bucket_pow2(_pad_to(remaining, self.bs), lo=self.bs))
+        p0 = req.draft_prefill_pos
+        need = math.ceil((p0 + c) / self.bs)
+        assert need <= len(req.draft_blocks), (
+            f"draft prefill chunk not covered: need {need} blocks, "
+            f"have {len(req.draft_blocks)} (draft admission reserve bug)")
+        take = min(c, remaining)
+        tokens = np.zeros((1, c), np.int32)
+        tokens[0, :take] = req.prompt[p0:p0 + take]
+        table = np.zeros((1, self._prefill_w), np.int32)
+        table[0, :len(req.draft_blocks)] = req.draft_blocks
+        self._draft_pool = self._draft_prefill(
+            self._draft_params, jnp.asarray(tokens), self._draft_pool,
+            jnp.asarray(table), jnp.int32(p0))
+        req.draft_prefill_pos = p0 + take
+        if req.draft_prefill_pos >= plen:
+            # trim chunk-padding draft blocks down to the prompt cover
+            keep = math.ceil(plen / self.bs)
+            if len(req.draft_blocks) > keep:
+                self.draft_blocks.release(req.draft_blocks[keep:])
+                del req.draft_blocks[keep:]
+            self._dirty = True
+
     def _prefill_step_locked(self):
         """Advance mid-prefill slots, one chunk per slot, until the step's
-        token budget (config.prefill_budget_tokens, default one chunk) is
-        spent — so prefill interleaves with decode at a bounded per-step
-        cost (the vLLM max_num_batched_tokens analog), while a burst of
-        arrivals still ramps many slots per step.  Prefill dispatches are
-        pipelined: only a FINAL chunk's sampled token syncs the host.
-        Blocks were reserved at admission — no allocation can fail here."""
-        budget = (self.config.prefill_budget_tokens
-                  or self.config.prefill_chunk)
-        for slot in range(self.max_batch):
-            if budget <= 0:
-                return
-            req = self._slot_req[slot]
-            if req is None or req.prefill_pos >= len(req.prompt):
-                continue
-            plen = len(req.prompt)
-            remaining = plen - req.prefill_pos
-            c = min(self.config.prefill_chunk,
-                    _bucket_pow2(_pad_to(remaining, self.bs), lo=self.bs))
-            need = math.ceil((req.prefill_pos + c) / self.bs)
-            assert need <= len(req.blocks), (
-                f"prefill chunk not covered: need {need} blocks, "
-                f"have {len(req.blocks)} (admission reserve bug)")
-            p0 = req.prefill_pos
-            take = min(c, remaining)
-            tokens = np.zeros((1, c), np.int32)
-            tokens[0, :take] = req.prompt[p0:p0 + take]
-            table = np.zeros((1, self._prefill_w), np.int32)
-            table[0, :len(req.blocks)] = req.blocks
-            is_last = p0 + take >= plen
-            sample_idx = (plen - 1 - p0) if is_last else 0
-            ids, self.pool, self._d_key = self._prefill_chunk(
-                self.params, jnp.asarray(tokens), self.pool,
-                jnp.asarray(table), jnp.int32(p0), jnp.int32(sample_idx),
-                self._d_key,
-                jnp.asarray([req.gen.temperature], np.float32),
-                jnp.asarray([req.gen.top_k], np.int32))
-            req.prefill_pos = p0 + take
-            if is_last:
-                if self.slo_label is not None and req.t_admit:
-                    from ray_tpu.serve._private import slo
+        token budget (config.prefill_token_budget, default one chunk) is
+        spent — chunked-prefill scheduling: prefill interleaves with
+        decode at a bounded per-step cost (the vLLM
+        max_num_batched_tokens analog) so a long prompt can never starve
+        decode ITL, while a burst of arrivals still ramps many slots per
+        step.  Prefill dispatches are pipelined: only a FINAL chunk's
+        sampled token syncs the host.  Blocks were reserved at admission
+        — no allocation can fail here.
 
-                    slo.record_stage(self.slo_label, "prefill",
-                                     time.monotonic() - req.t_admit)
-                # trim chunk-padding blocks; decode's ensure pass re-allocates
-                keep = math.ceil(plen / self.bs)
-                if len(req.blocks) > keep:
-                    self.blocks.release(req.blocks[keep:])
-                    del req.blocks[keep:]
-                self.blocks.register(req.prompt, req.blocks)
-                self._lengths[slot] = plen
-                self._slot_temp[slot] = req.gen.temperature
-                self._slot_topk[slot] = req.gen.top_k
-                self._first_pending.append((slot, req, ids))
-                self._dirty = True
-            budget -= take
+        With speculation, the draft model prefills the same prompt into
+        its own pool: after each target chunk the draft catches up to the
+        target position (draft chunks ride outside the token budget —
+        the budget bounds TARGET compute, and draft chunks are a small
+        fraction of it; a target prefix-cache hit makes the draft replay
+        the matched region, still cheap at draft size)."""
+        budget = (self.config.prefill_token_budget
+                  or self.config.prefill_budget_tokens
+                  or self.config.prefill_chunk)
+        progress = True
+        while budget > 0 and progress:
+            # round-robin over mid-prefill slots, one chunk each, until
+            # the budget is spent: a burst of arrivals ramps many slots
+            # per step AND a lone long prompt can use the whole budget
+            # (multiple chunks per step) instead of silently pacing at
+            # one chunk regardless of the knob
+            progress = False
+            for slot in range(self.max_batch):
+                if budget <= 0:
+                    return
+                req = self._slot_req[slot]
+                if req is None or self._decode_ready(req):
+                    continue
+                plen = len(req.prompt)
+                if req.prefill_pos >= plen:
+                    # target done, draft lagging: catch up (defensive —
+                    # the frontier loop below keeps them in lockstep)
+                    while req.draft_prefill_pos < plen:
+                        self._draft_prefill_chunk_locked(req)
+                    continue
+                remaining = plen - req.prefill_pos
+                c = min(self.config.prefill_chunk,
+                        _bucket_pow2(_pad_to(remaining, self.bs),
+                                     lo=self.bs))
+                need = math.ceil((req.prefill_pos + c) / self.bs)
+                assert need <= len(req.blocks), (
+                    f"prefill chunk not covered: need {need} blocks, "
+                    f"have {len(req.blocks)} (admission reserve bug)")
+                p0 = req.prefill_pos
+                take = min(c, remaining)
+                tokens = np.zeros((1, c), np.int32)
+                tokens[0, :take] = req.prompt[p0:p0 + take]
+                table = np.zeros((1, self._prefill_w), np.int32)
+                table[0, :len(req.blocks)] = req.blocks
+                is_last = p0 + take >= plen
+                sample_idx = (plen - 1 - p0) if is_last else 0
+                ids, self.pool, self._d_key = self._prefill_chunk(
+                    self.params, jnp.asarray(tokens), self.pool,
+                    jnp.asarray(table), jnp.int32(p0),
+                    jnp.int32(sample_idx), self._d_key,
+                    jnp.asarray([req.gen.temperature], np.float32),
+                    jnp.asarray([req.gen.top_k], np.int32))
+                req.prefill_pos = p0 + take
+                # the draft tracks the target's prefill frontier
+                while (req.spec_enabled
+                       and req.draft_prefill_pos < min(req.prefill_pos,
+                                                       plen)):
+                    self._draft_prefill_chunk_locked(req)
+                progress = True
+                if is_last:
+                    if self.slo_label is not None and req.t_admit:
+                        from ray_tpu.serve._private import slo
+
+                        slo.record_stage(self.slo_label, "prefill",
+                                         time.monotonic() - req.t_admit)
+                    # trim chunk-padding blocks; decode's ensure pass
+                    # re-allocates
+                    keep = math.ceil(plen / self.bs)
+                    if len(req.blocks) > keep:
+                        self.blocks.release(req.blocks[keep:])
+                        del req.blocks[keep:]
+                    self.blocks.register(req.prompt, req.blocks)
+                    self._lengths[slot] = plen
+                    self._slot_temp[slot] = req.gen.temperature
+                    self._slot_topk[slot] = req.gen.top_k
+                    self._first_pending.append((slot, req, ids))
+                    self._dirty = True
+                budget -= take
 
     def _emit_locked(self, req: _PagedReq, token: int):
         req.out_tokens.append(token)
@@ -769,11 +1122,22 @@ class PagedJaxLLMEngine:
 
                 slo.record_stage(self.slo_label, "decode",
                                  time.monotonic() - req.t_first_emit)
+            if self._spec is not None and req.spec_proposed:
+                # retain per-request acceptance for the serving layer's
+                # recent-request rows (bounded ring; read via
+                # specdec_request_stats after the request is gone)
+                self._spec_finished[req.request_id] = (
+                    req.spec_proposed, req.spec_accepted)
+                while len(self._spec_finished) > 1024:
+                    self._spec_finished.popitem(last=False)
             self._free_slot_locked(req)
 
     def _free_slot_locked(self, req: _PagedReq):
         self.blocks.release(req.blocks)
         req.blocks = []
+        if req.draft_blocks:
+            self.draft_blocks.release(req.draft_blocks)
+            req.draft_blocks = []
         self._slot_req[req.slot] = None
         self._lengths[req.slot] = 0
         req.slot = -1
@@ -797,7 +1161,11 @@ class PagedJaxLLMEngine:
             return False
         victim.prompt = victim.prompt + victim.out_tokens
         victim.prefill_pos = 0
+        victim.draft_prefill_pos = 0
         self._free_slot_locked(victim)
+        # recompute re-prefills the draft pool too, so a request degraded
+        # by earlier draft-pool pressure gets a fresh chance to speculate
+        victim.spec_enabled = self._spec is not None
         victim.done = False
         self._pending.appendleft(victim)
         self._dirty = True
@@ -815,7 +1183,7 @@ class PagedJaxLLMEngine:
             active = []
             for s in range(self.max_batch):
                 req = self._slot_req[s]
-                if req is None or req.prefill_pos < len(req.prompt):
+                if req is None or not self._decode_ready(req):
                     continue
                 while True:
                     need = math.ceil(
@@ -823,6 +1191,7 @@ class PagedJaxLLMEngine:
                     need = min(need, self.max_blocks_per_seq)
                     deficit = need - len(req.blocks)
                     if deficit <= 0:
+                        self._ensure_draft_blocks_locked(req, need)
                         active.append(s)
                         break
                     fresh = self.blocks.alloc(deficit)
@@ -851,6 +1220,27 @@ class PagedJaxLLMEngine:
                     break
         return [s for s in active if self._slot_req[s] is not None]
 
+    def _ensure_draft_blocks_locked(self, req: _PagedReq, need: int):
+        """Draft-pool coverage for a decode-ready speculating slot.
+        Exhaustion NEVER preempts or stalls anyone: the request simply
+        degrades to plain decode (spec_enabled=False, its draft blocks
+        returned to the pool) — the documented zero-drop behavior.  A
+        degraded request stays degraded for this residency (its draft KV
+        is gone; recompute after preemption re-enables speculation)."""
+        if not req.spec_enabled:
+            return
+        deficit = need - len(req.draft_blocks)
+        if deficit <= 0:
+            return
+        fresh = self.draft_blocks.alloc(deficit)
+        if fresh is not None:
+            req.draft_blocks.extend(fresh)
+            return
+        self.draft_blocks.release(req.draft_blocks)
+        req.draft_blocks = []
+        req.spec_enabled = False
+        self._dirty = True  # the device spec mask must refresh
+
     def _trim_locked(self, margin: int = 0):
         """Return over-allocated chunk blocks (sequence stopped early).
         ``margin``: appends the device may still make (an in-flight chunk)
@@ -864,12 +1254,42 @@ class PagedJaxLLMEngine:
             if len(req.blocks) > keep:
                 self.blocks.release(req.blocks[keep:])
                 del req.blocks[keep:]
+            if req.draft_blocks and len(req.draft_blocks) > keep:
+                self.draft_blocks.release(req.draft_blocks[keep:])
+                del req.draft_blocks[keep:]
 
-    def _collect_locked(self, em_dev, active: List[int], margin: int):
+    def _collect_locked(self, em_dev, active: List[int], margin: int,
+                        spec_slots: Sequence[int] = (), acc_dev=None):
         """Book one finished decode chunk's tokens into host state
         (lengths, next token, done transitions, block trims).  ``margin``:
-        appends another still-in-flight chunk may make beyond this one."""
+        appends another still-in-flight chunk may make beyond this one.
+        ``spec_slots``: slots that ran this chunk WITH speculation —
+        their acceptance is metered from ``acc_dev`` (the verifier's TRUE
+        per-slot accepted counts; deriving accepted from the emission
+        matrix would conflate draft rejection with stop/budget/max_seq
+        truncation of a request's final cycle and bias acceptance low
+        exactly for short generations) BEFORE the emit loop, so a
+        request finishing mid-collect reports final stats at its
+        terminal booking.  Dead slots (zero emissions) book nothing."""
         em = np.asarray(em_dev)  # fences this chunk (a later one may run on)
+        if spec_slots:
+            acc = np.asarray(acc_dev)
+            proposed = accepted = 0
+            k = self._spec_k
+            for s in spec_slots:
+                req = self._slot_req[s]
+                if int((em[:, s] >= 0).sum()) <= 0:
+                    continue
+                got = min(int(acc[s]), k)
+                proposed += k
+                accepted += got
+                if req is not None:
+                    req.spec_proposed += k
+                    req.spec_accepted += got
+            if proposed:
+                self._spec_proposed_total += proposed
+                self._spec_accepted_total += accepted
+                self._book_specdec(proposed, accepted)
         for t in range(em.shape[0]):
             for s in active:
                 req = self._slot_req[s]
@@ -882,6 +1302,23 @@ class PagedJaxLLMEngine:
                 self._next_tok[s] = tok
                 self._emit_locked(req, tok)
         self._trim_locked(margin=margin)
+
+    def _book_specdec(self, proposed: int, accepted: int):
+        """Meter drafted/accepted token counts into the runtime-metrics
+        families and the serving SLO ledger.  Only ever called with
+        speculation configured — the disabled path books NOTHING (the
+        same invariant as the PR 9 lifecycle layer)."""
+        from ray_tpu._private import runtime_metrics
+
+        dep = self.slo_label or "engine"
+        runtime_metrics.add_specdec_tokens(dep, proposed, accepted)
+        if self.slo_label is not None:
+            from ray_tpu.serve._private import slo
+
+            # ledger-side fold (state.serving_slo()); records under the
+            # process ledger's lock only — never an RPC under step()'s
+            # engine lock
+            slo.note_specdec(self.slo_label, proposed, accepted)
 
     def _resolve_first_tokens_locked(self):
         """Book pending first-token futures (one sync covers them all —
@@ -899,9 +1336,10 @@ class PagedJaxLLMEngine:
         """Collect the in-flight decode chunk, if any, and any pending
         first tokens."""
         if self._inflight is not None:
-            em_dev, active = self._inflight
+            em_dev, active, spec_slots, acc_dev = self._inflight
             self._inflight = None
-            self._collect_locked(em_dev, active, margin=0)
+            self._collect_locked(em_dev, active, margin=0,
+                                 spec_slots=spec_slots, acc_dev=acc_dev)
         self._resolve_first_tokens_locked()
 
     def step(self, decode: bool = True) -> Dict[int, List[int]]:
@@ -931,7 +1369,7 @@ class PagedJaxLLMEngine:
         with self._lock:
             before = self._emit_snapshot_locked()
             if self._pending or any(
-                    r is not None and r.prefill_pos < len(r.prompt)
+                    r is not None and not self._decode_ready(r)
                     for r in self._slot_req):
                 # admission + prefill run WITHOUT draining the in-flight
                 # decode chunk: a new slot's fresh blocks are disjoint from
@@ -945,9 +1383,13 @@ class PagedJaxLLMEngine:
                 if traced:
                     rec.stamp("paged.admit_prefill", t_pf)
             chunk = self.config.decode_chunk
+            # device appends per dispatch: a speculative cycle writes up
+            # to k+1 positions (k drafted + the bonus slot), a plain
+            # chunk writes `chunk`
+            app = (self._spec_k + 1) if self._spec is not None else chunk
             if decode:
                 # margin covers this dispatch plus one still in flight
-                margin = chunk + 1 + (chunk if self._inflight else 0)
+                margin = app + 1 + (app if self._inflight else 0)
                 active = self._ensure_decode_blocks_locked(margin)
             else:
                 active = []
@@ -962,7 +1404,7 @@ class PagedJaxLLMEngine:
                     # on any append crossing a block boundary (ADVICE r5
                     # high).  Re-run coverage from scratch — _inflight is
                     # now None, so one in-flight chunk's margin suffices.
-                    active = self._ensure_decode_blocks_locked(chunk + 1)
+                    active = self._ensure_decode_blocks_locked(app + 1)
                     if self._dirty:
                         # the re-run preempted someone: mirrors are stale
                         # again (no drain needed — nothing is in flight)
@@ -977,27 +1419,93 @@ class PagedJaxLLMEngine:
                 for s in active:
                     blks = self._slot_req[s].blocks
                     table[s, :len(blks)] = blks
-                (em_dev, self._d_next, self.pool, self._d_lengths,
-                 self._d_active, self._d_remaining, self._d_key) = \
-                    self._decode(
-                        self.params, self._d_next, self.pool,
-                        jnp.asarray(table), self._d_lengths, self._d_active,
-                        self._d_remaining, self._d_stops, self._d_key,
-                        self._d_temp, self._d_topk, chunk)
-                prev, self._inflight = self._inflight, (em_dev, active)
+                if self._spec is not None:
+                    em_dev, acc_dev, spec_slots = self._spec_step_locked(
+                        table, active)
+                    prev, self._inflight = (
+                        self._inflight,
+                        (em_dev, active, spec_slots, acc_dev))
+                else:
+                    (em_dev, self._d_next, self.pool, self._d_lengths,
+                     self._d_active, self._d_remaining, self._d_key) = \
+                        self._decode(
+                            self.params, self._d_next, self.pool,
+                            jnp.asarray(table), self._d_lengths,
+                            self._d_active, self._d_remaining,
+                            self._d_stops, self._d_key,
+                            self._d_temp, self._d_topk, chunk)
+                    prev, self._inflight = (self._inflight,
+                                            (em_dev, active, (), None))
                 if prev is not None:
                     # collect chunk N while chunk N+1 computes: the fence
                     # latency rides under the new dispatch.  The device is
-                    # up to `chunk` appends ahead of the collected view.
-                    self._collect_locked(*prev, margin=chunk)
+                    # up to `app` appends ahead of the collected view.
+                    self._collect_locked(prev[0], prev[1], margin=app,
+                                         spec_slots=prev[2],
+                                         acc_dev=prev[3])
                 if traced:
                     rec.stamp("paged.decode", t_dec,
-                              {"active_slots": len(active), "chunk": chunk})
+                              {"active_slots": len(active), "chunk": chunk,
+                               "spec_k": self._spec_k})
             else:
                 self._drain_locked()
             emitted = self._gather_emitted_locked(before)
         rec.emit()
         return emitted
+
+    def _spec_step_locked(self, table, active: List[int]):
+        """One speculative decode cycle: draft proposes k tokens per
+        slot (k+1 small autoregressive steps), the target verifies all
+        of them in ONE window forward.  Two dispatches, zero host syncs
+        — the emission matrix is collected on the NEXT step exactly like
+        a plain pipelined chunk.  Returns (em_dev [k+1, B], acc_dev [B]
+        true acceptance counts, spec_slots).
+
+        Slots whose requests are degraded (draft-pool exhaustion /
+        per-adapter opt-out) ride the same verify program with a zeroed
+        spec mask: zero acceptances, and their single emission is an
+        exact plain decode sample — mixed batches need no second
+        program.  A FULLY degraded batch instead falls back to the
+        ordinary chunked decode program at k+1 steps (the same appends
+        bound the ensure margin reserved): paying the (k+1)-wide verify
+        window for one token per slot would make 'degraded' far slower
+        than plain decode, the opposite of what degradation promises."""
+        k = self._spec_k
+        b = self.max_batch
+        spec_slots = tuple(
+            s for s in active
+            if self._slot_req[s] is not None
+            and self._slot_req[s].spec_enabled)
+        if not spec_slots:
+            (em_dev, self._d_next, self.pool, self._d_lengths,
+             self._d_active, self._d_remaining, self._d_key) = \
+                self._decode(
+                    self.params, self._d_next, self.pool,
+                    jnp.asarray(table), self._d_lengths, self._d_active,
+                    self._d_remaining, self._d_stops, self._d_key,
+                    self._d_temp, self._d_topk, k + 1)
+            return em_dev, None, ()
+        # the draft table reuses the TARGET table's bucketed width:
+        # block counts track each other (same ensure/trim formulas),
+        # and one shared width means one propose compile per verify
+        # bucket — warmup() covers both with a single shape grid
+        dtable = np.zeros((b, table.shape[1]), np.int32)
+        for s in spec_slots:
+            blks = self._slot_req[s].draft_blocks
+            dtable[s, :len(blks)] = blks
+        (drafted, qdist, self._draft_pool, self._d_key) = \
+            self._draft_propose(
+                self._draft_params, self._d_next, self._draft_pool,
+                jnp.asarray(dtable), self._d_lengths, self._d_key,
+                self._d_temp, self._d_topk)
+        (em_dev, acc_dev, self._d_next, self.pool, self._d_lengths,
+         self._d_active, self._d_remaining, self._d_key) = \
+            self._spec_verify(
+                self.params, self._d_next, drafted, qdist, self.pool,
+                jnp.asarray(table), self._d_lengths, self._d_active,
+                self._d_remaining, self._d_stops, self._d_key,
+                self._d_temp, self._d_topk, self._d_spec)
+        return em_dev, acc_dev, spec_slots
 
     def flush(self) -> Dict[int, List[int]]:
         """Collect any in-flight decode chunk and return its tokens."""
@@ -1135,6 +1643,25 @@ class PagedJaxLLMEngine:
             self._slot_req[slot] = req
             self.blocks.register(req.prompt, req.blocks)
             self._lengths[slot] = plen
+            # seed the DRAFT model's KV for the handed-off prefix by
+            # recomputing it at draft size (the handoff carries only the
+            # target's KV — draft layers/dims differ, so there is nothing
+            # to scatter).  Without this, every disagg handoff would
+            # decode at acceptance-rate ~0: the draft's attention span
+            # over the prompt would be garbage.  Chunked like ordinary
+            # draft prefill; draft-pool exhaustion degrades to plain
+            # decode exactly as elsewhere.
+            if self._spec is not None:
+                req.spec_enabled = True
+                dcover = _prefill_plan(plen, 0, self.config.prefill_chunk,
+                                       self.bs)
+                dfresh = self.draft_blocks.alloc(dcover + 1)
+                if dfresh is None:
+                    req.spec_enabled = False
+                else:
+                    req.draft_blocks = dfresh
+                    while req.draft_prefill_pos < plen:
+                        self._draft_prefill_chunk_locked(req)
             self._next_tok[slot] = first_token
             self._slot_temp[slot] = gen.temperature
             self._slot_topk[slot] = gen.top_k
@@ -1161,8 +1688,13 @@ class PagedJaxLLMEngine:
     def _refresh_mirrors_locked(self):
         self._resolve_first_tokens_locked()  # _next_tok must be current
         decode_ready = [
-            0 if (r is None or r.prefill_pos < len(r.prompt)) else 1
+            0 if (r is None or not self._decode_ready(r)) else 1
             for r in self._slot_req]
+        if self._spec is not None:
+            self._d_spec = jnp.asarray(np.array(
+                [1 if (decode_ready[s] and r is not None and r.spec_enabled)
+                 else 0
+                 for s, r in enumerate(self._slot_req)], np.int32))
         self._d_next = jnp.asarray(self._next_tok)
         self._d_lengths = jnp.asarray(self._lengths)
         self._d_active = jnp.asarray(np.array(decode_ready, np.int32))
@@ -1208,15 +1740,53 @@ class PagedJaxLLMEngine:
                 # pool would double peak HBM exactly when num_blocks is
                 # sized to fill it.  All-zero tables + active=0 mean every
                 # warmup write lands in sink block 0 (garbage by design).
-                out = self._decode(
-                    self.params, jnp.zeros(b, jnp.int32), self.pool,
-                    jnp.zeros((b, w), jnp.int32), jnp.zeros(b, jnp.int32),
-                    jnp.zeros(b, jnp.int32), jnp.zeros(b, jnp.int32),
-                    jnp.full((b, _MAX_STOP_IDS), -1, jnp.int32), key,
-                    jnp.zeros(b, jnp.float32), jnp.zeros(b, jnp.int32),
-                    chunk)
-                self.pool = out[2]
-                np.asarray(out[0])  # force compile + run to completion
+                if self._spec is not None:
+                    # speculative serving dispatches verify (per target-
+                    # table bucket) + propose (per draft-table bucket),
+                    # never the chunked decode program — warm what runs
+                    k, v = self._spec_k, self.cfg.vocab_size
+                    out = self._spec_verify(
+                        self.params, jnp.zeros(b, jnp.int32),
+                        jnp.zeros((k, b), jnp.int32),
+                        jnp.zeros((k, b, v), jnp.float32), self.pool,
+                        jnp.zeros((b, w), jnp.int32),
+                        jnp.zeros(b, jnp.int32), jnp.zeros(b, jnp.int32),
+                        jnp.zeros(b, jnp.int32),
+                        jnp.full((b, _MAX_STOP_IDS), -1, jnp.int32), key,
+                        jnp.zeros(b, jnp.float32), jnp.zeros(b, jnp.int32),
+                        jnp.zeros(b, jnp.int32))
+                    self.pool = out[3]  # (emitted, accepted, tokens, pool..)
+                    np.asarray(out[0])
+                    pout = self._draft_propose(
+                        self._draft_params, jnp.zeros(b, jnp.int32),
+                        self._draft_pool, jnp.zeros((b, w), jnp.int32),
+                        jnp.zeros(b, jnp.int32), key,
+                        jnp.zeros(b, jnp.float32), jnp.zeros(b, jnp.int32))
+                    self._draft_pool = pout[2]
+                    np.asarray(pout[0])
+                    # fully-degraded fallback: chunked decode at k+1
+                    # steps — a mid-serve degrade must not compile
+                    dout = self._decode(
+                        self.params, jnp.zeros(b, jnp.int32), self.pool,
+                        jnp.zeros((b, w), jnp.int32),
+                        jnp.zeros(b, jnp.int32), jnp.zeros(b, jnp.int32),
+                        jnp.zeros(b, jnp.int32),
+                        jnp.full((b, _MAX_STOP_IDS), -1, jnp.int32), key,
+                        jnp.zeros(b, jnp.float32), jnp.zeros(b, jnp.int32),
+                        k + 1)
+                    self.pool = dout[2]
+                    np.asarray(dout[0])
+                else:
+                    out = self._decode(
+                        self.params, jnp.zeros(b, jnp.int32), self.pool,
+                        jnp.zeros((b, w), jnp.int32),
+                        jnp.zeros(b, jnp.int32),
+                        jnp.zeros(b, jnp.int32), jnp.zeros(b, jnp.int32),
+                        jnp.full((b, _MAX_STOP_IDS), -1, jnp.int32), key,
+                        jnp.zeros(b, jnp.float32), jnp.zeros(b, jnp.int32),
+                        chunk)
+                    self.pool = out[2]
+                    np.asarray(out[0])  # force compile + run to completion
                 if w >= w_cap:
                     break
                 w *= 2
@@ -1237,6 +1807,12 @@ class PagedJaxLLMEngine:
                     jnp.int32(0), jnp.int32(0), key,
                     jnp.zeros(1, jnp.float32), jnp.zeros(1, jnp.int32))
                 np.asarray(ids)
+                if self._spec is not None:
+                    self._draft_pool = self._draft_prefill(
+                        self._draft_params, jnp.zeros((1, c), jnp.int32),
+                        self._draft_pool,
+                        jnp.zeros((1, self._prefill_w), jnp.int32),
+                        jnp.int32(0))
                 if c >= c_cap:
                     break
                 c *= 2

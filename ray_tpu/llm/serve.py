@@ -33,11 +33,18 @@ class LLMServer:
     per engine, the right TPU trade (no per-slot adapter gathers)."""
 
     def __init__(self, llm_config: LLMConfig, params=None,
-                 lora_adapters: Optional[Dict[str, Any]] = None):
+                 lora_adapters: Optional[Dict[str, Any]] = None,
+                 draft_params=None):
         from ray_tpu.llm.engine import make_engine
 
         self._config = llm_config
-        self._engine = make_engine(llm_config, params)
+        self._engine = make_engine(llm_config, params,
+                                   draft_params=draft_params)
+        # the MATERIALIZED draft weights (the engine random-initializes
+        # when draft_params is None): per-adapter draft merges apply to
+        # what actually runs, not the constructor argument
+        self._draft_params = getattr(self._engine, "_draft_params",
+                                     draft_params)
         if hasattr(self._engine, "warmup") and _jax_backend() == "tpu":
             # compile every decode (B, W) bucket before serving traffic —
             # a bucket transition otherwise costs a multi-second XLA
@@ -114,10 +121,41 @@ class LLMServer:
                     if self._stop:
                         raise RuntimeError("LLM server shut down")
                     self._cv.wait(timeout=0.1)
-                return self._done.pop(wkey)
+                buf = self._done.pop(wkey)
+            self._note_specdec(wkey)
+            return buf
         finally:
             with self._cv:
                 self._active_waiters.discard(wkey)
+
+    def _note_specdec(self, wkey) -> None:
+        """Attach a finished request's speculative acceptance (engine-side
+        per-request stats) to the active SLO tracker's recent-row.  A
+        no-op for non-speculative engines, unknown ids, or callers with
+        no tracker context — never raises into the serving path.
+
+        Tracker context is thread-local and ingress-side (see
+        slo.note_specdec_request): the row field lands for local-mode
+        streaming and handle-level callers under ``slo.activate``; a
+        cluster-mode replica process has no tracker and relies on the
+        ledger fold + metric families for the acceptance signal."""
+        model, gen_id, rid = wkey
+        try:
+            if model is None:
+                eng = self._engine
+            else:
+                with self._engines_lock:
+                    eng = (self._engines.get(model)
+                           if self._engine_gen.get(model, 0) == gen_id
+                           else None)
+            stats = getattr(eng, "specdec_request_stats",
+                            lambda _rid: None)(rid)
+        except Exception:  # noqa: BLE001
+            stats = None
+        if stats:
+            from ray_tpu.serve._private import slo
+
+            slo.note_specdec_request(stats[0], stats[1])
 
     def _iter_tokens(self, wkey):
         """Yield ``wkey``'s token chunks as they decode (generate_stream's
@@ -152,6 +190,7 @@ class LLMServer:
                     yield chunk
                 if done:
                     completed = True
+                    self._note_specdec(wkey)
                     return
         finally:
             if not completed:
@@ -227,12 +266,27 @@ class LLMServer:
                     return wkey
             # build outside the lock: merged weights are owned solely by the
             # engine map (single LRU bounds HBM)
-            from ray_tpu.llm.engine import make_engine
-            from ray_tpu.llm.lora import merge_lora
+            import dataclasses
 
+            from ray_tpu.llm.engine import make_engine
+            from ray_tpu.llm.lora import adapter_speculation, merge_lora
+
+            # per-adapter draft choice (the multi-LoRA extension of
+            # speculative decoding): an adapter may opt out, override k,
+            # or carry its own draft-model LoRA so the draft tracks the
+            # tuned target
+            spec_cfg, draft_adapter = adapter_speculation(
+                self._config.speculative_config, model)
+            cfg = self._config
+            if spec_cfg is not self._config.speculative_config:
+                cfg = dataclasses.replace(cfg, speculative_config=spec_cfg)
+            dparams = self._draft_params
+            if spec_cfg is not None and draft_adapter is not None:
+                dparams = merge_lora(self._draft_params, draft_adapter)
             built = make_engine(
-                self._config, merge_lora(self._engine.params,
-                                         self._adapters[model]))
+                cfg, merge_lora(self._engine.params,
+                                self._adapters[model]),
+                draft_params=dparams)
 
     def _evict_idle_locked(self, keep):
         extra = len(self._engine_order) - self._MAX_ADAPTER_ENGINES
@@ -341,12 +395,15 @@ class LLMServer:
 
 def build_llm_deployment(llm_config: LLMConfig, params=None, *,
                          name: str = "llm",
-                         lora_adapters: Optional[Dict[str, Any]] = None):
+                         lora_adapters: Optional[Dict[str, Any]] = None,
+                         draft_params=None):
     """An Application serving ``llm_config`` (reference:
     llm/_internal/serve build_openai_app / LLMServer deployment).
 
     Replica resources follow the engine's parallelism degrees the way the
     reference sizes placement groups from vLLM engine_kwargs.
+    ``draft_params``: weights for ``llm_config.speculative_config``'s
+    draft model (ignored without a speculative config).
     """
     from ray_tpu import serve
 
@@ -358,4 +415,4 @@ def build_llm_deployment(llm_config: LLMConfig, params=None, *,
         max_ongoing_requests=max(8, llm_config.max_batch_size),
         ray_actor_options={"resources": llm_config.resources_per_replica()},
     )
-    return deployment.bind(llm_config, params, lora_adapters)
+    return deployment.bind(llm_config, params, lora_adapters, draft_params)

@@ -627,6 +627,91 @@ def decode_step_paged(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
     return logits, {"k": ks, "v": vs}
 
 
+def decode_window_paged(cfg: LlamaConfig, params: Params,
+                        tokens: jnp.ndarray, pool: Dict[str, jnp.ndarray],
+                        table: jnp.ndarray, lengths: jnp.ndarray,
+                        rope_cache: Optional[tuple] = None,
+                        pos_limit: Optional[int] = None):
+    """Multi-token decode window for every slot (speculative verification).
+
+    tokens [B, T]: per-slot window starting at positions ``lengths[b]``
+    (token j lands at global position lengths[b] + j).  Writes each
+    window token's KV into the pool at its position — positions at or
+    past ``pos_limit`` (the engine's max_seq) redirect to sink block 0
+    instead of clamping, so a near-the-end slot can never clobber its own
+    live KV with a duplicate scatter index — then attends causally over
+    the table span (window KV is read back from the pool at its global
+    flat index, exactly like chunked prefill).  The host guarantees
+    table coverage of positions < pos_limit through lengths + T.
+
+    Gather path only: the pallas paged-attention kernel is single-query
+    decode, and T here is the small speculative window (k+1 <= ~8) — the
+    gather's overhead is one chunk-sized span read, the same trade
+    chunked prefill already makes.  Returns (logits [B, T, V] fp32,
+    updated pool).
+    """
+    if rope_cache is None:
+        cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
+                                    cfg.rope_theta)
+        cos, sin = jnp.asarray(cos), jnp.asarray(sin)
+    else:
+        cos, sin = rope_cache
+    b, t = tokens.shape
+    bs = pool["k"].shape[2]
+    w = table.shape[1]
+    cdt = cfg.compute_dtype
+    limit = pos_limit if pos_limit is not None else w * bs
+    positions = lengths[:, None] + jnp.arange(t)[None, :]  # [B, T] global
+    ok = positions < limit
+    safe = jnp.minimum(positions, limit - 1)  # rope-table safe
+    bidx = jnp.arange(b)[:, None]
+    blk = jnp.where(ok, table[bidx, safe // bs], 0)  # invalid -> sink
+    off = safe % bs
+    # flat span index == global position (the table row is the sequence's
+    # blocks in order); window token j sees prefix + window tokens <= j
+    span_mask = (jnp.arange(w * bs)[None, None, :]
+                 <= positions[:, :, None])  # [B, T, W*bs] causal
+    x = jnp.take(params["embed"], tokens, axis=0).astype(cdt)
+
+    def body(carry, inp):
+        x, pk_all, pv_all = carry
+        lp, li = inp
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        q = (h @ lp["wq"].astype(cdt)).reshape(b, t, cfg.n_heads,
+                                               cfg.head_dim)
+        k = (h @ lp["wk"].astype(cdt)).reshape(b, t, cfg.n_kv_heads,
+                                               cfg.head_dim)
+        v = (h @ lp["wv"].astype(cdt)).reshape(b, t, cfg.n_kv_heads,
+                                               cfg.head_dim)
+        q = apply_rope(q, cos, sin, positions=safe)
+        k = apply_rope(k, cos, sin, positions=safe)
+        # [B, T] fancy-index scatter; duplicate sink indices collide with
+        # garbage values only (no slot's table references block 0 inside
+        # its live span)
+        pk_all = pk_all.at[li, blk, off].set(
+            k.reshape(b, t, -1).astype(pk_all.dtype))
+        pv_all = pv_all.at[li, blk, off].set(
+            v.reshape(b, t, -1).astype(pv_all.dtype))
+        ck = pk_all[li, table].reshape(b, w * bs, cfg.n_kv_heads,
+                                       cfg.head_dim)
+        cv = pv_all[li, table].reshape(b, w * bs, cfg.n_kv_heads,
+                                       cfg.head_dim)
+        attn = _paged_attend(cfg, q, ck, cv, span_mask)
+        x = x + (attn.astype(cdt) @ lp["wo"].astype(cdt))
+        h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        ffn = (jax.nn.silu(h @ lp["w_gate"].astype(cdt))
+               * (h @ lp["w_up"].astype(cdt))) @ lp["w_down"].astype(cdt)
+        return (x + ffn, pk_all, pv_all), None
+
+    (x, ks, vs), _ = lax.scan(
+        body, (x, pool["k"], pool["v"]),
+        (params["layers"], jnp.arange(cfg.n_layers)))
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = (x @ head.astype(cdt)).astype(jnp.float32)
+    return logits, {"k": ks, "v": vs}
+
+
 def prefill_chunk_paged(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
                         pool: Dict[str, jnp.ndarray], table: jnp.ndarray,
                         p0: jnp.ndarray,

@@ -272,6 +272,9 @@ class _NoopTracker:
     def shed(self):
         return None
 
+    def specdec(self, proposed, accepted):
+        return None
+
 
 NOOP_TRACKER = _NoopTracker()
 
@@ -284,7 +287,7 @@ class RequestTracker:
     __slots__ = ("_ledger", "rid", "deployment", "tenant", "trace_id",
                  "t_ingress", "t_wall", "route_reason", "t_first",
                  "_t_last_tok", "itl_sum", "itl_n", "itl_max", "tok_count",
-                 "status", "_done")
+                 "status", "_done", "spec_proposed", "spec_accepted")
 
     def __init__(self, ledger: "ServingSLOLedger", rid: int, deployment: str,
                  tenant: str, trace_id: Optional[str]):
@@ -304,6 +307,8 @@ class RequestTracker:
         self.tok_count = 0
         self.status: Optional[str] = None
         self._done = False
+        self.spec_proposed = 0
+        self.spec_accepted = 0
         flight_recorder.record("request", deployment,
                                (rid, "ingress", tenant))
 
@@ -356,6 +361,16 @@ class RequestTracker:
             self.itl_max = itl
         runtime_metrics.observe_itl(self.deployment, self.tenant, itl, n)
 
+    def specdec(self, proposed: int, accepted: int) -> None:
+        """Attach the request's speculative-decoding acceptance (drafted
+        vs accepted token counts, from the engine's per-request stats) —
+        surfaces as ``specdec_accept_rate`` on the recent-request row.
+        Requests that never speculated (layer off, degraded, non-paged
+        engine) never call this, so their rows carry no field."""
+        if proposed > 0:
+            self.spec_proposed = int(proposed)
+            self.spec_accepted = int(accepted)
+
     def finish(self, status: str = "ok") -> None:
         if self._done:
             return
@@ -398,6 +413,9 @@ class ServingSLOLedger:
         cfg = global_config()
         self._recent_cap = int(cfg.serve_slo_recent_capacity)
         self._recent: List[dict] = []
+        # deployment -> [proposed, accepted] speculative-decoding token
+        # totals (engine-side bookings; empty unless speculation runs)
+        self._specdec: Dict[str, List[int]] = {}
         self._publish_interval = float(cfg.serve_slo_publish_interval_s)
         self._recent_publish = int(cfg.serve_slo_recent_publish)
         self._last_publish = float("-inf")
@@ -452,6 +470,9 @@ class ServingSLOLedger:
             if tr.itl_n:
                 row["itl_mean_s"] = round(tr.itl_sum / tr.itl_n, 6)
                 row["itl_max_s"] = round(tr.itl_max, 6)
+            if tr.spec_proposed:
+                row["specdec_accept_rate"] = round(
+                    tr.spec_accepted / tr.spec_proposed, 4)
             if tr.trace_id:
                 row["trace_id"] = tr.trace_id
             self._recent.append(row)
@@ -464,6 +485,16 @@ class ServingSLOLedger:
         if w is None:
             w = self._windows[(deployment, objective)] = _Windows()
         return w
+
+    def note_specdec(self, deployment: str, proposed: int,
+                     accepted: int) -> None:
+        """Engine-side speculative acceptance booking (per collect, under
+        the ledger lock only — the engine calls this from its step lock,
+        so like record_stage there is deliberately no publish attempt)."""
+        with self._lock:
+            tot = self._specdec.setdefault(deployment, [0, 0])
+            tot[0] += int(proposed)
+            tot[1] += int(accepted)
 
     def record_stage(self, deployment: str, stage: str,
                      seconds: float) -> None:
@@ -514,8 +545,12 @@ class ServingSLOLedger:
             status = {d: {t: dict(s) for t, s in ts.items()}
                       for d, ts in self._status.items()}
             recent = list(self._recent[-self._recent_publish:])
-        return {"time": self.wall(), "points": points, "windows": windows,
-                "status": status, "recent": recent}
+            specdec = {d: list(t) for d, t in self._specdec.items()}
+        row = {"time": self.wall(), "points": points, "windows": windows,
+               "status": status, "recent": recent}
+        if specdec:
+            row["specdec"] = specdec
+        return row
 
     def snapshot(self) -> dict:
         """Local fold (bench.py, local-testing mode): same shape as
@@ -604,7 +639,12 @@ def fold_rows(rows: List[dict], now_wall: Optional[float] = None,
     groups: Dict[tuple, List[dict]] = {}
     window_buckets: Dict[str, Dict[str, Dict[int, List[int]]]] = {}
     status: Dict[str, Dict[str, Dict[str, int]]] = {}
+    specdec: Dict[str, List[int]] = {}
     for row in rows:
+        for dep, (p, a) in (row.get("specdec") or {}).items():
+            tot = specdec.setdefault(dep, [0, 0])
+            tot[0] += int(p)
+            tot[1] += int(a)
         for p in row.get("points", ()):
             tags = p.get("tags", {})
             dep = tags.get("deployment", "?")
@@ -645,11 +685,15 @@ def fold_rows(rows: List[dict], now_wall: Optional[float] = None,
     # union of sources: a deployment whose requests ALL failed before a
     # first token has window buckets and status counts but zero sketch
     # points — the hard-down case must still fold (and breach)
-    for dep in set(by_dep) | set(window_buckets) | set(status):
+    for dep in set(by_dep) | set(window_buckets) | set(status) | set(specdec):
         d = by_dep.setdefault(dep, {"tenants": {}, "stages": {}})
         targets = targets_for(dep, kv_rows=conf_rows)
         d["targets"] = targets
         d["status"] = status.get(dep, {})
+        if dep in specdec:
+            p, a = specdec[dep]
+            d["specdec"] = {"proposed": p, "accepted": a,
+                            "acceptance_rate": (a / p) if p else 0.0}
         rates = _window_burn_rates(window_buckets.get(dep, {}), targets,
                                    now_wall)
         d["burn_rate"] = {}
@@ -717,6 +761,35 @@ def record_stage(deployment: Optional[str], stage: str,
     if deployment is None or not enabled():
         return
     get_ledger().record_stage(deployment, stage, seconds)
+
+
+def note_specdec(deployment: Optional[str], proposed: int,
+                 accepted: int) -> None:
+    """Engine-side speculative acceptance fold (``set_slo_label``
+    threading, like record_stage).  No label or disabled layer => books
+    nothing."""
+    if deployment is None or not enabled():
+        return
+    get_ledger().note_specdec(deployment, proposed, accepted)
+
+
+def note_specdec_request(proposed: int, accepted: int) -> None:
+    """Attach a finished request's speculative acceptance to the active
+    tracker (the serving path reads the engine's per-request stats at
+    stream completion) — surfaces as the recent-row acceptance field.
+
+    Scope: trackers are thread-local and ingress-side, so the field
+    reaches the row only when the completion is consumed ON the thread
+    that activated the tracker — local-testing-mode streaming, or
+    handle-level callers wrapping consumption in ``slo.activate(tr)``.
+    A cluster-mode replica runs in another process (current_tracker()
+    is None there) and books nothing here; the CLUSTER-wide acceptance
+    signals are the per-deployment ledger fold (``note_specdec`` →
+    ``state.serving_slo()`` ``deployments[dep]["specdec"]``) and the
+    ``ray_tpu_serve_specdec_*`` families, which work everywhere."""
+    tr = current_tracker()
+    if tr is not None:
+        tr.specdec(proposed, accepted)
 
 
 def maybe_publish() -> bool:

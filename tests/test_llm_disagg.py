@@ -24,6 +24,7 @@ from ray_tpu.llm import (
     LLMServer,
     PagedJaxLLMEngine,
     PrefillServer,
+    SpeculativeConfig,
     build_disagg_llm_deployment,
 )
 from ray_tpu.models.llama import LlamaConfig, init_params
@@ -334,6 +335,40 @@ def test_mismatched_stage_configs_fall_back_to_recompute(tiny_cfg,
         assert h["k"] is not None and h["block_size"] == 8
         got = decode.decode_from_handoff(h, **gen_kw)
         assert got == want  # greedy tokens are block-size independent
+    finally:
+        decode.shutdown()
+
+
+def test_disagg_handoff_seeds_speculative_draft(tiny_cfg, tiny_params):
+    """ISSUE 11 satellite regression: a handoff imported into a
+    speculative DecodeServer seeds the draft engine's KV for the
+    handed-off prefix (recompute at draft size).  Without the seeding,
+    every disagg handoff silently decoded at acceptance-rate ~0 — the
+    speedup evaporated exactly on the topology spec-dec exists for.
+    Greedy parity AND high acceptance (draft == target params) are the
+    oracles; the prefill stage strips speculation (it never decodes)."""
+    spec = SpeculativeConfig(draft_model_config=tiny_cfg,
+                             num_speculative_tokens=3)
+    lcfg = _lcfg(tiny_cfg, speculative_config=spec)
+    gen_kw = dict(max_new_tokens=8)
+    prompt = _prompt(33, 21)
+    mono = LLMServer(_lcfg(tiny_cfg), tiny_params)
+    try:
+        want = mono.generate(prompt, **gen_kw)
+    finally:
+        mono.shutdown()
+    pre = PrefillServer(lcfg, tiny_params)
+    # prefill-only engines never speculate: no draft pool was built
+    assert pre._engine._spec is None
+    decode = DecodeServer(lcfg, tiny_params, draft_params=tiny_params)
+    try:
+        h = pre.prefill(prompt, **gen_kw)
+        assert h["k"] is not None
+        got = decode.decode_from_handoff(h, **gen_kw)
+        assert got == want  # greedy bit-parity through handoff + spec-dec
+        stats = decode._engine.specdec_stats()
+        assert stats["proposed"] > 0
+        assert stats["acceptance_rate"] > 0.5, stats
     finally:
         decode.shutdown()
 

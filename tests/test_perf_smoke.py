@@ -207,3 +207,57 @@ def test_overlap_off_emits_zero_new_metric_families():
                                 cfg.vocab_size)
     st, _ = step_fn(st, tokens)
     assert rtm.plan_snapshot() == before
+
+
+def test_specdec_disabled_path_budget_and_byte_identity():
+    """Speculative decoding off (the default) must cost the non-spec
+    engine NOTHING measurable and change NOTHING observable (ISSUE 11):
+
+      - the disabled-path additions to the step loop are two Python
+        branch evaluations (`self._spec is None` + the appends-per-step
+        select) — gated at < 1 µs per step, orders of magnitude under
+        the ~ms step itself;
+      - a spec-disabled paged engine's greedy output stays byte-identical
+        to the static engine's (whose decode path this PR did not touch
+        beyond the shared ``_sample``, itself pinned to exact argmax in
+        tests/test_specdec.py) — the pre-PR output pin;
+      - the specdec metric families book nothing.
+    """
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu._private import runtime_metrics as rtm
+    from ray_tpu.llm import GenerationConfig, JaxLLMEngine, LLMConfig, \
+        PagedJaxLLMEngine
+    from ray_tpu.models.llama import LlamaConfig, init_params
+
+    cfg = LlamaConfig.tiny(vocab_size=48, dim=32, n_layers=1, n_heads=2,
+                           n_kv_heads=1, ffn_dim=64, max_seq_len=48,
+                           compute_dtype=jnp.float32)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    before = rtm.specdec_snapshot()
+    paged = PagedJaxLLMEngine(
+        LLMConfig(model_config=cfg, max_batch_size=2, max_seq_len=48,
+                  block_size=8, prefill_chunk=16, decode_chunk=4),
+        params=params)
+    assert paged._spec is None and paged._spec_k == 0
+    # micro-gate the added per-step branch cost on the live engine
+    n = 100_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        app = (paged._spec_k + 1) if paged._spec is not None \
+            else paged.config.decode_chunk
+    dt_ns = (time.perf_counter() - t0) / n * 1e9
+    assert app == 4 and dt_ns < 1_000, dt_ns
+    # byte-identity pin vs the untouched static decode path
+    prompts = [list(np.random.RandomState(s).randint(1, 47, size=7))
+               for s in (0, 1)]
+    static = JaxLLMEngine(
+        LLMConfig(model_config=cfg, kv_cache="static", max_batch_size=2,
+                  max_seq_len=48), params=params)
+    gen = GenerationConfig(max_new_tokens=8)
+    assert paged.generate(prompts, gen) == static.generate(prompts, gen)
+    assert rtm.specdec_snapshot() == before
