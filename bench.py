@@ -1274,6 +1274,39 @@ def _slo_snapshot() -> dict:
         return {"error": str(e)[:200]}
 
 
+def _static_analysis_snapshot() -> dict:
+    """One graftlint pass over ray_tpu/ (ISSUE 12): findings by rule,
+    baseline size, and the pass wall time — so BENCH_*.json trends the
+    repo's own invariant-health alongside its perf.  Local AST work only
+    (~1.3 s, no cluster, cannot hang)."""
+    try:
+        import time as _time
+
+        from ray_tpu._private.analysis import baseline as _baseline
+        from ray_tpu._private.analysis.engine import run_analysis
+
+        root = os.path.dirname(os.path.abspath(__file__))
+        t0 = _time.perf_counter()
+        findings, eng = run_analysis(root)
+        wall = _time.perf_counter() - t0
+        entries = _baseline.load(
+            os.path.join(root, _baseline.DEFAULT_BASELINE))
+        new, baselined, stale = _baseline.apply(findings, entries)
+        by_rule: dict = {}
+        for f in findings:
+            by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+        return {
+            "files": len(eng.files_seen),
+            "pass_wall_s": round(wall, 3),
+            "findings_by_rule": by_rule,
+            "new_findings": len(new),
+            "baseline_size": len(entries),
+            "stale_baseline": len(stale),
+        }
+    except Exception as e:  # noqa: BLE001
+        return {"error": str(e)[:200]}
+
+
 def _run_guarded(fn, timeout_s: float):
     """Run one bench section on a watchdog thread: ``(value, alive)``.
 
@@ -1439,6 +1472,7 @@ def main():
         "kv_handoff": _kv_handoff_snapshot(),
         "specdec": _specdec_snapshot(),
         "slo": _slo_snapshot(),
+        "static_analysis": _static_analysis_snapshot(),
     })
 
     result = {

@@ -1,0 +1,111 @@
+"""graftlint + lock-witness cost bench (ISSUE 12 perf budgets).
+
+Two budgets, both cheap to regress accidentally and both load-bearing:
+
+  1. full-repo analysis wall time: the tier-1 gate runs the whole pass on
+     every lane, so it must stay under 15 s on this box (measured ~1.3 s;
+     the budget catches an accidental quadratic rule, not CI noise).
+  2. witness-OFF lock acquisition: make_lock with the knob off must return
+     a RAW threading lock — the acquisition path is byte-identical to
+     pre-witness code, so the added cost budget is <100 ns and the
+     measured delta should be ~0.  The bench compares acquire/release of
+     make_lock("x") against a plain threading.Lock() and budgets the
+     DIFFERENCE (absolute lock cost varies with the box; the delta is the
+     witness's doing).
+
+Prints one JSON line:
+  {"metric": "lint_overhead", "value": <pass wall s>, "unit": "s",
+   "extra": {...}}
+
+Exit status 1 on any budget breach.
+Overrides: LINT_PASS_BUDGET_S, WITNESS_OFF_BUDGET_NS.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _bench_lock(lock, n: int = 300_000) -> float:
+    """ns per acquire+release pair, best of 3."""
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            lock.acquire()
+            lock.release()
+        best = min(best, (time.perf_counter() - t0) / n)
+    return best * 1e9
+
+
+def run() -> dict:
+    from ray_tpu._private.analysis import lock_witness as lw
+    from ray_tpu._private.analysis.engine import run_analysis
+    from ray_tpu._private.config import global_config
+
+    out: dict = {}
+
+    # -- 1. full-repo pass wall time ------------------------------------
+    t0 = time.perf_counter()
+    findings, eng = run_analysis(REPO_ROOT)
+    out["pass_wall_s"] = round(time.perf_counter() - t0, 3)
+    out["files"] = len(eng.files_seen)
+    by_rule: dict = {}
+    for f in findings:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    out["findings_by_rule"] = by_rule
+
+    # -- 2. witness-off acquisition cost (the <100 ns budget) ------------
+    assert not global_config().lock_witness_enabled
+    raw = threading.Lock()
+    factory = lw.make_lock("bench-off")
+    assert isinstance(factory, type(raw)), "witness off must hand out raw locks"
+    out["raw_lock_ns"] = round(_bench_lock(raw), 1)
+    out["factory_lock_off_ns"] = round(_bench_lock(factory), 1)
+    out["witness_off_delta_ns"] = round(
+        out["factory_lock_off_ns"] - out["raw_lock_ns"], 1)
+
+    # context figure (not budgeted): what the witness costs when ON
+    global_config().lock_witness_enabled = True
+    try:
+        lw.reset_for_testing()
+        out["witness_on_ns"] = round(_bench_lock(lw.make_lock("bench-on")), 1)
+    finally:
+        global_config().lock_witness_enabled = False
+        lw.reset_for_testing()
+    return out
+
+
+def main() -> int:
+    sys.path.insert(0, REPO_ROOT)
+    pass_budget_s = float(os.environ.get("LINT_PASS_BUDGET_S", "15"))
+    off_budget_ns = float(os.environ.get("WITNESS_OFF_BUDGET_NS", "100"))
+    extra = run()
+    failures = []
+    if extra["pass_wall_s"] > pass_budget_s:
+        failures.append(
+            f"full pass {extra['pass_wall_s']}s > {pass_budget_s}s")
+    if extra["witness_off_delta_ns"] > off_budget_ns:
+        failures.append(
+            f"witness-off delta {extra['witness_off_delta_ns']}ns > "
+            f"{off_budget_ns}ns")
+    print(json.dumps({
+        "metric": "lint_overhead",
+        "value": extra["pass_wall_s"],
+        "unit": "s",
+        "budget_pass_s": pass_budget_s,
+        "budget_witness_off_ns": off_budget_ns,
+        "failures": failures,
+        "extra": extra,
+    }))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -112,6 +112,9 @@ def init(
                 from ray_tpu._private.rpc import RpcClient
 
                 gcs = RpcClient(tuple(address))
+                # graftlint: allow(blocking-under-lock) — init is one-shot
+                # and serialized by design: a concurrent init() must wait
+                # for the first one's cluster handshake either way
                 nodes = gcs.call("GetAllNodeInfo", None)
                 head = next((n for n in nodes if n.get("is_head")), nodes[0] if nodes else None)
                 if head is None:

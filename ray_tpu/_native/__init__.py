@@ -70,6 +70,9 @@ def load(name: str) -> ctypes.CDLL | None:
     with _build_lock:
         if name in _cache:
             return _cache[name]
+        # graftlint: allow(blocking-under-lock) — the lock EXISTS to
+        # single-flight the g++ compile; waiters need its artifact and
+        # cannot proceed until it lands in _cache
         path = _build(name)
         lib = None
         if path is not None:

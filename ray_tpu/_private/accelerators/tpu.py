@@ -130,7 +130,7 @@ class TPUAcceleratorManager(AcceleratorManager):
             from ray_tpu._private import runtime_metrics
 
             runtime_metrics.TPU_PROCESS_CHIPS.set(num)
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — gauge set is telemetry; must never fail chip carving
             pass
 
     # -- pod metadata (reference: tpu.py:240-334) ------------------------

@@ -1,0 +1,45 @@
+"""graftlint: a runtime-aware static analyzer for this repo's own invariants.
+
+Every review round in CHANGES.md has hand-found the same bug classes:
+blocking work under a lock (PR 9's synchronous KVPut in ``tracker.finish``),
+unlocked double-reads racing state deletion (PR 5's ``_on_reply``), swallowed
+exceptions (PR 8's bare-``pass`` in ``Raylet._report_loop``), and drift
+between recorded metrics and the FAMILIES registry.  With 250+ ``with
+self._lock`` sites across ~50 lock-using files, these invariants need a tool,
+not reviewer memory — the same correctness-tooling posture that motivates
+continuous failure handling at 100k+-GPU scale (arxiv 2510.20171) applied to
+the control plane's own code.
+
+Layout:
+  engine.py            single-pass AST walker + rule plugin protocol
+  rules_concurrency.py blocking-under-lock, lock-order-cycle, thread-hygiene
+  rules_hygiene.py     swallowed-exception
+  rules_registry.py    metric-registry-drift, config-knob-drift
+  baseline.py          grandfathered-finding baseline (shrink-only)
+  lock_witness.py      dynamic lock-order witness (runtime corroboration)
+
+CLI: ``python -m ray_tpu.scripts.lint`` (``--explain <rule>``, ``--diff``).
+Gate: ``tests/test_static_analysis.py`` runs the full pass over ``ray_tpu/``
+and fails on any non-baselined finding.
+
+This ``__init__`` stays import-light: the wired runtime modules (raylet,
+gcs, worker, ...) import ``analysis.lock_witness`` directly at process
+boot for ``make_lock``/``make_rlock``, and must not pay for the analyzer
+machinery.
+"""
+
+from __future__ import annotations
+
+__all__ = ["run_analysis", "all_rules", "Finding", "Severity"]
+
+
+def __getattr__(name):
+    if name in ("run_analysis", "Finding", "Severity", "Engine"):
+        from ray_tpu._private.analysis import engine
+
+        return getattr(engine, name)
+    if name == "all_rules":
+        from ray_tpu._private.analysis.engine import all_rules
+
+        return all_rules
+    raise AttributeError(name)

@@ -1,0 +1,95 @@
+"""Grandfathered-finding baseline: shrink-only, justification-carrying.
+
+``tools/graftlint_baseline.json`` is the checked-in set of findings the
+repo has accepted, each with a WRITTEN justification.  The contract:
+
+  - additions are forbidden — the tier-1 gate fails on any finding not in
+    the baseline, so new code ships clean or carries an in-source reasoned
+    pragma (which is reviewable where the code is);
+  - the baseline only shrinks — a stale entry (no longer matching a live
+    finding) fails the gate too, so fixed findings are deleted from the
+    file in the same PR;
+  - high-severity rules (blocking-under-lock, lock-order-cycle,
+    swallowed-exception) ship at an EMPTY baseline: those are bug classes
+    prior PRs actually had to fix in production paths, so every instance
+    is either fixed or justified at the site, never grandfathered.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Iterable, List, Tuple
+
+from ray_tpu._private.analysis.engine import Finding, Severity
+
+DEFAULT_BASELINE = os.path.join("tools", "graftlint_baseline.json")
+HIGH_SEVERITY_RULES = ("blocking-under-lock", "lock-order-cycle",
+                       "swallowed-exception")
+
+
+def load(path: str) -> Dict[str, dict]:
+    """key -> {"rule":..., "justification":...}; missing file = empty."""
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as f:
+        blob = json.load(f)
+    return dict(blob.get("entries", {}))
+
+
+def save(path: str, entries: Dict[str, dict]) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": 1,
+                   "comment": "graftlint grandfathered findings — "
+                              "shrink-only; every entry needs a "
+                              "justification; high-severity rules must "
+                              "stay empty (see analysis/baseline.py)",
+                   "entries": dict(sorted(entries.items()))},
+                  f, indent=2, sort_keys=False)
+        f.write("\n")
+
+
+def apply(findings: Iterable[Finding],
+          entries: Dict[str, dict]) -> Tuple[List[Finding], List[Finding],
+                                             List[str]]:
+    """(new, baselined, stale_keys): findings not covered by the baseline,
+    findings it grandfathers, and entries matching nothing (must be
+    deleted — the baseline only shrinks)."""
+    findings = list(findings)
+    live_keys = {f.key for f in findings}
+    new = [f for f in findings if f.key not in entries]
+    baselined = [f for f in findings if f.key in entries]
+    stale = [k for k in entries if k not in live_keys]
+    return new, baselined, stale
+
+
+def violations(entries: Dict[str, dict]) -> List[str]:
+    """Baseline-hygiene problems: unjustified entries and high-severity
+    grandfathering (both forbidden).  The high-severity ban checks the
+    recorded severity AND the known-high rule list, so a high finding from
+    an unlisted rule (parse-error) can't be grandfathered either."""
+    out = []
+    for key, meta in sorted(entries.items()):
+        just = str(meta.get("justification", "")).strip()
+        if not just or just.upper().startswith("TODO"):
+            out.append(f"baseline entry without justification: {key}")
+        rule = meta.get("rule") or key.split(":", 1)[0]
+        if rule in HIGH_SEVERITY_RULES \
+                or meta.get("severity") == Severity.HIGH:
+            out.append(f"high-severity finding grandfathered (forbidden, "
+                       f"fix the code instead): {key}")
+    return out
+
+
+def make_entries(findings: Iterable[Finding],
+                 justification: str = "TODO: justify") -> Dict[str, dict]:
+    """Baseline candidates from current findings: NEVER high severity —
+    those are fixed or justified in-source, whatever rule produced them."""
+    out: Dict[str, dict] = {}
+    for f in findings:
+        if f.severity != Severity.HIGH:
+            out[f.key] = {"rule": f.rule, "severity": f.severity,
+                          "message": f.message,
+                          "justification": justification}
+    return out

@@ -1,0 +1,358 @@
+"""Concurrency rules: the lock-discipline bug classes prior PRs shipped.
+
+blocking-under-lock — PR 9's synchronous KVPut inside ``tracker.finish``
+stalled every in-flight connection because the request lock was held across
+a GCS round-trip; PR 2's engine-step spans had to learn "stamp under the
+lock but emit after release" for the same reason.  The rule flags lexically
+lock-guarded bodies that issue RPC ``call``/``call_async``, KV ops,
+``time.sleep``, subprocess spawns, socket receives/sends, or plasma gets —
+including through one level of same-file helper calls (the intraprocedural
+closure that caught the PR 9 shape, where the blocking op hid inside a
+method called from the locked region).
+
+lock-order-cycle — a static per-class acquisition-order graph built from
+nested ``with`` scopes (lockdep classes, not instances); any cycle is an
+AB/BA inversion waiting for the right interleaving.  The dynamic
+lock-order witness (analysis/lock_witness.py) corroborates this rule's
+lexical approximation at runtime in the stress/chaos lanes.
+
+thread-hygiene — threads created without an explicit ``daemon=`` inherit
+the creator's daemon flag (shutdown behavior then depends on WHERE the
+thread was created), and unnamed threads make every hang report and flight
+recorder tail harder to read.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu._private.analysis.engine import (
+    Engine, FileContext, Finding, Rule, Severity, lockish_name)
+
+# NOTE: condition-variable waits (cv.wait / wait_for) release the lock
+# they are called on, so they are deliberately NOT in the blocking set —
+# _blocking_reason has no branch for them, which IS the exemption
+_SOCKET_BLOCKING = ("sendall", "recv", "recv_into", "recvfrom", "accept")
+_KV_METHODS = ("KVPut", "KVGet", "KVMultiGet", "KVDel", "KVKeys")
+_PLASMA_BLOCKING = ("batch_get", "get_object", "get_objects")
+
+
+def _call_name(call: ast.Call) -> Tuple[Optional[str], Optional[str]]:
+    """(receiver-ish, method) for attribute calls, (None, name) for bare."""
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        base = f.value.id if isinstance(f.value, ast.Name) else None
+        return base, f.attr
+    if isinstance(f, ast.Name):
+        return None, f.id
+    return None, None
+
+
+def _blocking_reason(call: ast.Call) -> Optional[str]:
+    """Why this call blocks, or None.  Lexical matching tuned to this
+    repo's idiom (rpc.Client.call / gcs.call, time.sleep, subprocess,
+    socket receive loops, plasma batch gets)."""
+    base, attr = _call_name(call)
+    if attr is None:
+        return None
+    if attr == "sleep" and base in ("time", None):
+        return "time.sleep"
+    if attr in ("call", "call_async"):
+        rpc = ""
+        if call.args and isinstance(call.args[0], ast.Constant) \
+                and isinstance(call.args[0].value, str):
+            rpc = f'("{call.args[0].value}")'
+        return f"RPC .{attr}{rpc}"
+    if attr in _KV_METHODS:
+        return f"GCS KV .{attr}"
+    if base == "subprocess":
+        return f"subprocess.{attr}"
+    if attr == "Popen":
+        return "subprocess.Popen"
+    if attr in _SOCKET_BLOCKING:
+        return f"socket .{attr}"
+    if attr in _PLASMA_BLOCKING:
+        return f"plasma .{attr}"
+    if base == "ray_tpu" and attr == "get":
+        return "ray_tpu.get"
+    return None
+
+
+class _HelperIndex:
+    """Same-file def index for the one-level call closure: class-qualified
+    method defs + module-level function defs, built during the single walk."""
+
+    def __init__(self):
+        self.methods: Dict[Tuple[str, str], ast.AST] = {}   # (class, name)
+        self.functions: Dict[str, ast.AST] = {}
+
+    def add(self, ctx: FileContext, node: ast.AST) -> None:
+        # only REAL defs: a def nested inside a method is a closure, not
+        # the class's method — indexing it would let it shadow (or stand
+        # in for) the method of the same name during resolution
+        if ctx.func_stack:
+            return
+        if ctx.class_stack:
+            self.methods[(ctx.class_name(), node.name)] = node
+        else:
+            self.functions[node.name] = node
+
+    def resolve(self, cls: str, call: ast.Call) -> Optional[ast.AST]:
+        f = call.func
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+                and f.value.id in ("self", "cls"):
+            return self.methods.get((cls, f.attr))
+        if isinstance(f, ast.Name):
+            return self.functions.get(f.id)
+        return None
+
+
+def _own_body_nodes(fn: ast.AST):
+    """Walk a function body excluding nested def/lambda bodies (those do
+    not execute during this call)."""
+    stack = list(getattr(fn, "body", []))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+class BlockingUnderLock(Rule):
+    id = "blocking-under-lock"
+    severity = Severity.HIGH
+    summary = ("RPC / KV / sleep / subprocess / socket / plasma work "
+               "lexically inside a with-<lock> body (one helper level deep)")
+    hint = ("snapshot state under the lock, release it, then do the "
+            "blocking work (the PR 9 KVPut fix pattern); or justify with "
+            "# graftlint: allow(blocking-under-lock) — reason")
+    doc = """\
+Holding a process-wide lock across a network round-trip turns one slow peer
+into a stall of every thread that touches that lock.  PR 9 shipped exactly
+this: tracker.finish issued a synchronous KVPut to the GCS while holding
+the request-table lock, so one slow GCS push stalled every in-flight
+connection's token stream.  PR 2's tracing had the same shape (span emit
+under the engine-step lock).
+
+The rule flags, inside any `with <lock>:` body (lock = Name/Attribute whose
+identifier mentions lock/cv/mutex/cond):
+  - RPC client calls: .call(...), .call_async(...) (the first string arg
+    is named in the finding, so "KVPut under lock" reads directly)
+  - direct GCS KV methods: KVPut/KVGet/KVMultiGet/KVDel/KVKeys
+  - time.sleep
+  - subprocess.* / Popen
+  - blocking socket ops: sendall/recv/recv_into/recvfrom/accept
+  - plasma gets: batch_get/get_object(s), ray_tpu.get
+and follows same-file helper calls one level deep (self.m() / m()), so the
+blocking op can't hide one frame down.  Condition .wait() is exempt (it
+releases the lock).  Nested def/lambda bodies are exempt (they run later).
+
+Fix pattern: compute + stamp under the lock, copy what the blocking call
+needs, release, then block.  When the lock scope is load-bearing (e.g. the
+blocking call IS the protected resource), suppress with a reasoned pragma.
+"""
+
+    def __init__(self):
+        self._index = _HelperIndex()
+        # (call node, class name, held lock name) pending helper closure
+        self._pending: List[Tuple[ast.Call, str, str]] = []
+
+    def begin_file(self, ctx: FileContext) -> None:
+        self._index = _HelperIndex()
+        self._pending = []
+
+    def visit_FunctionDef(self, node, ctx: FileContext) -> None:
+        self._index.add(ctx, node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call, ctx: FileContext) -> None:
+        if not ctx.lock_stack:
+            return
+        lock = ctx.lock_stack[-1][0]
+        reason = _blocking_reason(node)
+        if reason is not None:
+            ctx.emit(self, node,
+                     f"{reason} while holding {lock}")
+            return
+        # not blocking itself: remember for the one-level helper closure
+        self._pending.append((node, ctx.class_name(), lock))
+
+    def end_file(self, ctx: FileContext) -> None:
+        for call, cls, lock in self._pending:
+            fn = self._index.resolve(cls, call)
+            if fn is None:
+                continue
+            for n in _own_body_nodes(fn):
+                if isinstance(n, ast.Call):
+                    reason = _blocking_reason(n)
+                    if reason is not None:
+                        if ctx.allowed(self.id, n.lineno):
+                            continue
+                        ctx.emit(self, call,
+                                 f"{reason} at line {n.lineno} inside "
+                                 f"helper {fn.name}() called while "
+                                 f"holding {lock}")
+                        break
+        self._pending = []
+
+
+class LockOrderCycle(Rule):
+    id = "lock-order-cycle"
+    severity = Severity.HIGH
+    summary = ("cycle in the per-class static lock acquisition graph "
+               "(nested with scopes, one helper level deep)")
+    hint = ("pick one global order for these locks and take them in that "
+            "order everywhere; the dynamic witness "
+            "(RAY_TPU_lock_witness_enabled=1) names the offending stacks")
+    doc = """\
+Two code paths that take the same pair of locks in opposite orders deadlock
+under the right interleaving.  The rule builds a per-class acquisition
+graph: every `with a: ... with b:` nesting (including one level of
+same-file helper calls: `with a: self.m()` where m takes b) adds edge
+a -> b for that class; any cycle in the graph is reported with every
+participating edge site.  Classes are lockdep-style lock *classes* — two
+instances of one class count as one node, the conservative (and usually
+intended) discipline.
+
+The static graph is lexical, so it cannot see cross-class nesting through
+dynamic calls; the runtime lock-order witness
+(ray_tpu/_private/analysis/lock_witness.py, RAY_TPU_lock_witness_enabled=1)
+builds the same graph from real acquisitions across ALL classes and
+records/raises on the first cycle-forming acquisition, surfaced through
+state.diagnose().  Static for coverage, dynamic for truth.
+"""
+
+    def __init__(self):
+        # scope -> {(a, b) -> (path, line)}
+        self._edges: Dict[str, Dict[Tuple[str, str], Tuple[str, int]]] = {}
+        self._index = _HelperIndex()
+        self._pending: List[Tuple[ast.Call, str, str, FileContext]] = []
+
+    def begin_file(self, ctx: FileContext) -> None:
+        self._index = _HelperIndex()
+        self._pending = []
+
+    def visit_FunctionDef(self, node, ctx: FileContext) -> None:
+        self._index.add(ctx, node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _add_edge(self, scope: str, a: str, b: str, rel: str,
+                  line: int) -> None:
+        if a == b:
+            return  # reentrant same-name nesting: RLock territory, not order
+        self._edges.setdefault(scope, {}).setdefault((a, b), (rel, line))
+
+    @staticmethod
+    def _scope(ctx: FileContext) -> str:
+        """Lockdep scope: the class, or — for free-function code — the
+        FILE.  One global '<module>' scope would merge unrelated
+        same-named module locks across every file into false cycles."""
+        if ctx.class_stack:
+            return ctx.class_name()
+        return f"<module {ctx.rel}>"
+
+    def visit_With(self, node: ast.With, ctx: FileContext) -> None:
+        names = [n for n in (lockish_name(i.context_expr)
+                             for i in node.items) if n]
+        if not names:
+            return
+        scope = self._scope(ctx)
+        for held, _ in ctx.lock_stack:
+            for name in names:
+                self._add_edge(scope, held, name, ctx.rel, node.lineno)
+        # multi-item with: left-to-right acquisition order
+        for i, a in enumerate(names):
+            for b in names[i + 1:]:
+                self._add_edge(scope, a, b, ctx.rel, node.lineno)
+
+    def visit_Call(self, node: ast.Call, ctx: FileContext) -> None:
+        if ctx.lock_stack:
+            self._pending.append(
+                (node, self._scope(ctx), ctx.lock_stack[-1][0], ctx))
+
+    def end_file(self, ctx: FileContext) -> None:
+        for call, cls, held, _ in self._pending:
+            fn = self._index.resolve(cls, call)
+            if fn is None:
+                continue
+            for n in _own_body_nodes(fn):
+                if isinstance(n, ast.With):
+                    for item in n.items:
+                        name = lockish_name(item.context_expr)
+                        if name:
+                            self._add_edge(cls, held, name, ctx.rel,
+                                           call.lineno)
+        self._pending = []
+
+    def finalize(self, engine: Engine) -> List[Finding]:
+        findings: List[Finding] = []
+        for scope, edges in self._edges.items():
+            adj: Dict[str, List[str]] = {}
+            for (a, b) in edges:
+                adj.setdefault(a, []).append(b)
+            seen_cycles = set()
+            for start in sorted(adj):
+                # DFS from each node looking for a path back to it
+                stack = [(start, [start])]
+                while stack:
+                    cur, path = stack.pop()
+                    for nxt in adj.get(cur, ()):  # pragma: no branch
+                        if nxt == start and len(path) > 1:
+                            cyc = tuple(sorted(set(path)))
+                            if cyc in seen_cycles:
+                                continue
+                            seen_cycles.add(cyc)
+                            cycle_path = path + [start]
+                            sites = []
+                            for a, b in zip(cycle_path, cycle_path[1:]):
+                                rel, line = edges[(a, b)]
+                                sites.append(f"{rel}:{line}")
+                            rel0, line0 = edges[(cycle_path[0],
+                                                 cycle_path[1])]
+                            findings.append(Finding(
+                                rule=self.id, severity=self.severity,
+                                path=rel0, line=line0,
+                                message=(
+                                    f"lock-order cycle in {scope}: "
+                                    + " -> ".join(cycle_path)
+                                    + " (edges at " + ", ".join(sites) + ")"),
+                                hint=self.hint))
+                        elif nxt not in path:
+                            stack.append((nxt, path + [nxt]))
+        return findings
+
+
+class ThreadHygiene(Rule):
+    id = "thread-hygiene"
+    severity = Severity.MEDIUM
+    summary = "threading.Thread(...) without explicit daemon= and name="
+    hint = ("pass name=\"<component>-<purpose>\" (hang reports and witness "
+            "stacks read thread names) and an explicit daemon= (inherited "
+            "daemon-ness makes shutdown depend on the creating thread)")
+    doc = """\
+An unnamed thread shows up as Thread-37 in every hang report, stack dump
+and lock-witness cycle, which is useless at 3am.  A thread without an
+explicit daemon flag inherits it from its creator, so the same code started
+from the raylet's main thread vs one of its daemon loops gets different
+shutdown semantics.  Every Thread(...) construction must pass both.
+"""
+
+    def visit_Call(self, node: ast.Call, ctx: FileContext) -> None:
+        f = node.func
+        is_thread = (
+            (isinstance(f, ast.Attribute) and f.attr == "Thread"
+             and isinstance(f.value, ast.Name)
+             and f.value.id == "threading")
+            or (isinstance(f, ast.Name) and f.id == "Thread"))
+        if not is_thread:
+            return
+        kw = {k.arg for k in node.keywords}
+        missing = [k for k in ("daemon", "name") if k not in kw]
+        if missing:
+            ctx.emit(self, node,
+                     "thread created without explicit "
+                     + " / ".join(f"{m}=" for m in missing))

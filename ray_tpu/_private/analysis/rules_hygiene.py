@@ -1,0 +1,93 @@
+"""Hygiene rules: swallowed exceptions.
+
+PR 8's review found ``Raylet._report_loop`` eating every exception with a
+bare ``pass`` — a flapping GCS link was completely invisible until the
+health sweep declared the node dead.  The fix (a throttled warning + the
+``ray_tpu_raylet_report_failures_total`` counter) is the pattern this rule
+enforces: a broad except may swallow, but only with a written reason, a log
+line, or a counted metric — silent-and-unexplained is the only banned shape.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ray_tpu._private.analysis.engine import FileContext, Rule, Severity
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    if isinstance(t, ast.Name) and t.id in ("Exception", "BaseException"):
+        return True
+    if isinstance(t, ast.Tuple):
+        return any(isinstance(e, ast.Name)
+                   and e.id in ("Exception", "BaseException")
+                   for e in t.elts)
+    return False
+
+
+def _is_trivial_body(handler: ast.ExceptHandler) -> bool:
+    """pass / continue / break / bare ellipsis — nothing observed, nothing
+    counted, nothing logged.  This is the whole observation test: ANY
+    statement beyond these (a log call, a metric inc, a re-raise, fallback
+    work) makes the body non-trivial and the handler unflagged."""
+    for stmt in handler.body:
+        if isinstance(stmt, (ast.Pass, ast.Continue, ast.Break)):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+            continue  # docstring/ellipsis
+        return False
+    return True
+
+
+class SwallowedException(Rule):
+    id = "swallowed-exception"
+    severity = Severity.HIGH
+    summary = ("broad except (bare / Exception / BaseException) that "
+               "swallows silently without logging, a counted metric, or a "
+               "written reason")
+    hint = ("log it (throttled if hot), count it "
+            "(runtime_metrics.inc_*), or justify the swallow in the "
+            "suppression comment: # noqa: BLE001 — <why silence is correct>")
+    doc = """\
+PR 8's Raylet._report_loop swallowed every report-tick failure with a bare
+pass: a flapping GCS link produced zero evidence until the health sweep
+declared the node dead minutes later.  The fix — a throttled warning plus
+ray_tpu_raylet_report_failures_total — is the enforced pattern.
+
+Flagged: a broad except handler (bare `except:`, `except Exception:`,
+`except BaseException:`, or a tuple containing either) whose body is
+trivial (pass/continue/break/ellipsis) and that neither logs (logger.*),
+counts a metric (inc_*/observe_*/.inc()/.observe()), records to the flight
+recorder, nor re-raises.
+
+Not flagged: handlers that observe the exception one of those ways, and
+handlers carrying a REASONED suppression — the repo's established
+`# noqa: BLE001 — reason` idiom, or the allow(swallowed-exception) pragma
+with a reason.  The reason text is the contract: every silent swallow in the
+tree states why silence is correct at the site, so reviewers (and the next
+static-analysis pass) can audit the claim instead of re-deriving it.
+A bare `# noqa: BLE001` with no reason does NOT suppress: that is exactly
+the unexplained swallow the rule exists to ban.
+"""
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler,
+                            ctx: FileContext) -> None:
+        if not _is_broad(node):
+            return
+        if not _is_trivial_body(node):
+            # a handler that logs, counts, re-raises, or does real
+            # fallback work is a design choice, not a silent swallow;
+            # only nothing-at-all is flagged
+            return
+        # a written reason on the handler line or the trivial body line is
+        # the accepted suppression (both placements are established idiom)
+        if ctx.reasoned_comment(node.lineno):
+            return
+        if node.body and ctx.reasoned_comment(node.body[0].lineno):
+            return
+        what = "bare except" if node.type is None else "broad except"
+        ctx.emit(self, node,
+                 f"{what} swallows silently (no log, no metric, no reason)")

@@ -209,6 +209,15 @@ class RayTpuConfig:
     # burn rate above this is reported as a breach by state.serving_slo()
     # (1.0 = consuming error budget exactly as fast as the SLO allows)
     serve_slo_burn_alert: float = 1.0
+    # --- lock-order witness (_private/analysis/lock_witness.py) ---
+    # test/chaos-lane knob: locks built through make_lock/make_rlock become
+    # lockdep-style witnesses that record per-thread acquisition stacks,
+    # maintain the global acquired-while-holding edge set, and record the
+    # first cycle-forming acquisition (both stacks) into the flight
+    # recorder + state.diagnose().  Off (the default) the factories return
+    # raw threading locks — the acquisition path is byte-identical to
+    # pre-witness code (benchmarks/lint_overhead_bench.py)
+    lock_witness_enabled: bool = False
     # --- testing / chaos ---
     # Format mirrors RAY_testing_rpc_failure (reference: src/ray/rpc/rpc_chaos.h:23-35):
     # "method1=max_failures:req_prob:resp_prob,method2=..."

@@ -32,6 +32,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
+from ray_tpu._private.analysis.lock_witness import make_lock, make_rlock
 from ray_tpu._private import runtime_metrics
 from ray_tpu._private.config import RayTpuConfig, global_config
 from ray_tpu._private.ids import ActorID, JobID, NodeID, PlacementGroupID, WorkerID
@@ -126,7 +127,7 @@ class Pubsub:
         self._pool = pool
         self._config = config
         self._fails: Dict[Tuple[Tuple[str, int], str], int] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("Pubsub._lock")
         # relay targets (alive raylets), insertion-ordered so the tree
         # shape is deterministic between publishes
         self._relays: Dict[Tuple[str, int], None] = {}
@@ -284,7 +285,7 @@ class GcsServer:
         # consumers (Prometheus rate/increase) need counters that never
         # decrease
         self._event_counts: Dict[str, int] = {}
-        self._lock = threading.RLock()
+        self._lock = make_rlock("GcsServer._lock")
         self._actor_queue: deque = deque()
         self._actor_cv = threading.Condition(self._lock)
         self._stopped = threading.Event()
@@ -847,7 +848,7 @@ class GcsServer:
         if addr is not None:
             try:
                 self.pool.get(addr).notify("KillActor", {"actor_id": actor_id, "reason": reason})
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — raylet gone: worker-death path reaps the actor anyway
                 pass
         self._on_actor_worker_death(actor_id, reason, force_dead=no_restart)
 
@@ -1084,7 +1085,7 @@ class GcsServer:
                 if node is not None:
                     try:
                         self.pool.get(node.address).call("ReturnBundles", {"pg_id": info.pg_id})
-                    except Exception:  # noqa: BLE001
+                    except Exception:  # noqa: BLE001 — best-effort rollback; node death releases its bundles
                         pass
             return False
 
@@ -1127,7 +1128,7 @@ class GcsServer:
             if node is not None:
                 try:
                     self.pool.get(node.address).call("ReturnBundles", {"pg_id": pg_id})
-                except Exception:  # noqa: BLE001
+                except Exception:  # noqa: BLE001 — best-effort return; node death releases its bundles
                     pass
         self.pubsub.publish(f"PG:{pg_id.hex()}", {"event": "removed", "pg_id": pg_id})
         return True

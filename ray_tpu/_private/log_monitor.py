@@ -13,10 +13,13 @@ benchmark harnesses); files are written either way.
 
 from __future__ import annotations
 
+import logging
 import os
 import tempfile
 import threading
 from typing import Dict, Optional
+
+logger = logging.getLogger(__name__)
 
 
 class LogMonitor:
@@ -60,8 +63,8 @@ class LogMonitor:
             if path is not None:
                 try:
                     self._drain_file(path, pid)
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception:  # noqa: BLE001 — drain is best-effort;
+                    pass            # the tail resumes under the new job
         self._jobs[pid] = job_hex
 
     def stop(self):
@@ -69,8 +72,8 @@ class LogMonitor:
         # final drain, then drop the node-local tmp dir on clean shutdown
         try:
             self._quiet or self._poll_once()
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception:  # noqa: BLE001 — final drain on a dying
+            pass            # monitor; nothing left to tell
         import shutil
 
         shutil.rmtree(self.log_dir, ignore_errors=True)
@@ -131,8 +134,12 @@ class LogMonitor:
                 "message": {"ip": self._ip, "pid": pid, "job": job,
                             "lines": text},
             })
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception:  # noqa: BLE001 — this batch of lines is dropped;
+            # the tailer keeps running and the next poll publishes fresh
+            # ones.  Debug, not warning: a GCS outage would otherwise log
+            # once per poll tick per worker file.
+            logger.debug("worker-log publish failed (pid=%s); dropping %d "
+                         "line(s) this tick", pid, len(text))
 
     def _forget(self, path: str, pid):
         """Stop tracking an exited worker's log (the file stays on disk

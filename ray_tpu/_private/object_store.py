@@ -35,6 +35,7 @@ from dataclasses import dataclass, field
 from multiprocessing import shared_memory
 from typing import Dict, List, Optional, Tuple
 
+from ray_tpu._private.analysis.lock_witness import make_lock
 from ray_tpu._private import runtime_metrics
 from ray_tpu._private.config import global_config
 from ray_tpu._private.ids import ObjectID
@@ -43,7 +44,7 @@ logger = logging.getLogger(__name__)
 
 Locator = Tuple[str, str, int, int]  # (kind, shm_name, offset, size)
 
-_attach_lock = threading.Lock()
+_attach_lock = make_lock("object_store._attach_lock")
 
 _UINT64_MAX = 2**64 - 1
 
@@ -105,7 +106,7 @@ class LocalObjectStore:
         self._spilling = cfg.object_spilling_enabled
         self._entries: Dict[ObjectID, _Entry] = {}
         self._used = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("LocalObjectStore._lock")
         self._seal_cv = threading.Condition(self._lock)
         self._seal_callbacks: Dict[ObjectID, list] = {}
         self._prefix = f"rtpu-{node_id_hex[:8]}-{os.getpid()}"
@@ -444,7 +445,7 @@ class _ShmCache:
 
     def __init__(self):
         self._mapped: Dict[str, shared_memory.SharedMemory] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("_ShmCache._lock")
 
     def buf(self, locator: Locator) -> memoryview:
         kind, name, offset, size = locator
@@ -460,7 +461,7 @@ class _ShmCache:
             for shm in self._mapped.values():
                 try:
                     shm.close()
-                except Exception:  # noqa: BLE001
+                except Exception:  # noqa: BLE001 — teardown; a torn mapping is already unusable
                     pass
             self._mapped.clear()
 
@@ -487,7 +488,7 @@ def plasma_create_write_seal(raylet_client, object_id: ObjectID, meta: bytes,
         try:
             raylet_client.call("PlasmaFree", {"object_ids": [object_id]},
                                timeout=10)
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — rollback; the original error re-raises below
             pass
         raise
     return size

@@ -24,6 +24,7 @@ from collections import defaultdict, deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
+from ray_tpu._private.analysis.lock_witness import make_lock, make_rlock
 from ray_tpu._private import runtime_metrics
 from ray_tpu._private.config import RayTpuConfig, global_config
 from ray_tpu._private.ids import NodeID, ObjectID, PlacementGroupID, WorkerID
@@ -152,7 +153,7 @@ class Raylet:
                                        self.node_id.hex())
         self._node_stats = NodeStatsCollector()
 
-        self._lock = threading.RLock()
+        self._lock = make_rlock("Raylet._lock")
         self._dispatch_cv = threading.Condition(self._lock)
         self._spawning_procs: Dict[int, subprocess.Popen] = {}
         # pid -> (spawn monotonic ts, "zygote"|"popen") for spawn latency
@@ -226,7 +227,7 @@ class Raylet:
         # state.summarize_trace().  Flushed by the report loop; own lock so
         # recording under the dispatch lock never does I/O.
         self._task_events: List[dict] = []
-        self._task_events_lock = threading.Lock()
+        self._task_events_lock = make_lock("Raylet._task_events_lock")
 
         # versioned cluster-view mirror (delta sync): the report loop sends
         # known_version and applies snapshot/delta replies through the
@@ -311,13 +312,13 @@ class Raylet:
         for proc in spawning:
             try:
                 proc.kill()
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — mid-spawn proc may already be dead (the goal)
                 pass
         for w in workers:
             if w.proc is not None:
                 try:
                     w.proc.terminate()
-                except Exception:  # noqa: BLE001
+                except Exception:  # noqa: BLE001 — already-dead proc is the desired state
                     pass
         for w in workers:
             if w.proc is not None:
@@ -326,7 +327,7 @@ class Raylet:
                 except Exception:  # noqa: BLE001
                     try:
                         w.proc.kill()
-                    except Exception:  # noqa: BLE001
+                    except Exception:  # noqa: BLE001 — kill after failed wait; already-dead is fine
                         pass
         if self._zygote is not None:
             self._zygote.shutdown()
@@ -620,7 +621,7 @@ class Raylet:
                 if owned and expired:
                     try:
                         proc.kill()
-                    except Exception:  # noqa: BLE001
+                    except Exception:  # noqa: BLE001 — already-exited proc is the desired outcome
                         pass
                     runtime_metrics.inc_spawn_timeout()
                     logger.warning(
@@ -688,7 +689,7 @@ class Raylet:
                     def _reclaim():
                         try:
                             self._reclaim_expired_leases()
-                        except Exception:  # noqa: BLE001
+                        except Exception:  # noqa: BLE001 — reclaim retries on the next death-poll tick
                             pass
                     reclaim_thread = threading.Thread(
                         target=_reclaim, daemon=True,
@@ -714,7 +715,7 @@ class Raylet:
                 if w.proc is not None:
                     try:
                         w.proc.terminate()
-                    except Exception:  # noqa: BLE001
+                    except Exception:  # noqa: BLE001 — already-dead proc is the desired state
                         pass
             for w in dead:
                 self._on_worker_death(w)
@@ -748,7 +749,7 @@ class Raylet:
         while not self._stopped.wait(period):
             try:
                 frac = self._memory_used_fraction()
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — transient /proc read failure; next tick retries
                 continue
             threshold = global_config().memory_usage_threshold
             if frac <= threshold:
@@ -778,7 +779,7 @@ class Raylet:
                 victim.worker.worker_id, victim.lease_id)
             try:
                 victim.worker.proc.kill()
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — victim already exited is the desired outcome
                 pass
             # cooldown before the next kill: gives the freed memory time to
             # show in the next sample AND spaces out kills so a retried task
@@ -802,11 +803,11 @@ class Raylet:
                     "ReportActorDeath",
                     {"actor_id": w.dedicated_actor, "reason": f"worker process {w.worker_id} exited"},
                 )
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — GCS down: the health sweep declares the death
                 pass
         try:
             self.gcs.notify("Publish", {"channel": "WORKER_FAILURE", "message": {"worker_id": w.worker_id, "addr": w.address}})
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — GCS down: subscribers learn via the health sweep
             pass
 
     # ------------------------------------------------------------------
@@ -1174,7 +1175,7 @@ class Raylet:
             try:
                 self.pool.get(lease.worker.address).notify(
                     "LeaseRevoked", {"lease_id": lease.lease_id})
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — worker gone: the lease is reclaimed either way
                 pass
 
     def _release_lease_resources(self, lease: _Lease):
@@ -1447,7 +1448,7 @@ class Raylet:
         for lease in doomed:
             try:
                 self.pool.get(lease.worker.address).notify("Exit", {"reason": "placement group removed"})
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — worker gone is the goal; exit notice is advisory
                 pass
         return True
 
@@ -1553,7 +1554,7 @@ class Raylet:
                     self.pool.get(tuple(owner_addr)).notify(
                         "AddObjectLocation", {"object_id": oid, "node_addr": self.server.address}
                     )
-                except Exception:  # noqa: BLE001
+                except Exception:  # noqa: BLE001 — owner gone: the secondary copy GCs via LRU
                     pass
                 return True
         return False
@@ -1645,7 +1646,7 @@ class Raylet:
                 # allocation so this node isn't blocked forever
                 try:
                     self.store.free(oid)
-                except Exception:  # noqa: BLE001
+                except Exception:  # noqa: BLE001 — unsealed alloc may already be gone
                     pass
             self._push_receiving[oid] = now
         self.store.create(oid, req["size"])
@@ -1669,7 +1670,7 @@ class Raylet:
                 self.pool.get(tuple(owner)).notify(
                     "AddObjectLocation",
                     {"object_id": oid, "node_addr": self.server.address})
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — owner gone: location add is advisory
                 pass
         return True
 

@@ -461,6 +461,9 @@ class RpcServer:
     def _send_frame(sock, send_lock, msg_id, parts):
         try:
             with send_lock:
+                # graftlint: allow(blocking-under-lock) — the send lock
+                # exists to serialize frame writes on this socket;
+                # interleaved sendalls would corrupt the wire framing
                 _sendall_parts(
                     sock, [_HEADER.pack(msg_id, _body_len(parts)), *parts])
         except OSError:
@@ -470,7 +473,7 @@ class RpcServer:
         try:
             self._server.shutdown()
             self._server.server_close()
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — server already down is the goal of shutdown
             pass
         with self._conn_lock:
             conns = list(self._conns)
@@ -533,6 +536,9 @@ class RpcClient:
             sock.settimeout(None)
             if self._handshake is not None:
                 try:
+                    # graftlint: allow(blocking-under-lock) — reconnect is
+                    # single-flight under the state lock by design: other
+                    # senders need this socket before they can proceed
                     sock.sendall(b"RTPU" + self._handshake)
                 except OSError:
                     raise ConnectionLost(f"handshake to {self._address} failed")
@@ -593,6 +599,9 @@ class RpcClient:
         self._futures[msg_id] = fut
         try:
             with self._send_lock:
+                # graftlint: allow(blocking-under-lock) — the send lock
+                # serializes frame writes; interleaving would corrupt
+                # the wire framing
                 _sendall_parts(
                     self._sock,
                     [_HEADER.pack(msg_id, _body_len(parts)), *parts])
@@ -626,6 +635,8 @@ class RpcClient:
                 parts.extend(body)
         try:
             with self._send_lock:
+                # graftlint: allow(blocking-under-lock) — see send_parts:
+                # the send lock is the wire-framing serializer
                 _sendall_parts(self._sock, parts)
         except (OSError, AttributeError):
             for msg_id in ids:

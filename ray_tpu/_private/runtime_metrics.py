@@ -24,6 +24,7 @@ from __future__ import annotations
 import threading
 from typing import Dict, Optional, Tuple
 
+from ray_tpu._private.analysis.lock_witness import make_lock
 from ray_tpu.util.metrics import Counter, Gauge, Histogram, Sketch
 
 # latency boundaries tuned for control-plane work: 100 µs .. 30 s
@@ -446,7 +447,7 @@ _zygote_fallbacks = ZYGOTE_FALLBACKS.with_tags()
 # dynamic-tag recorders are bound once per tag-set and cached; the key
 # spaces are small (rpc method names, op × world-size, deployment names)
 _BOUND_CACHE: Dict[Tuple, object] = {}
-_BOUND_LOCK = threading.Lock()
+_BOUND_LOCK = make_lock("runtime_metrics._BOUND_LOCK")
 _BOUND_CACHE_MAX = 4096  # runaway-cardinality backstop
 
 

@@ -26,6 +26,7 @@ import time
 import traceback
 import weakref
 from collections import defaultdict, deque
+from ray_tpu._private.analysis.lock_witness import make_lock, make_rlock
 from ray_tpu._private.utils import DaemonExecutor, fast_getpid
 from typing import Any, Dict, List, Optional, Set, Tuple
 
@@ -126,7 +127,7 @@ class ObjectRef:
         if w is not None and not w.shutting_down:
             try:
                 w.reference_counter.remove_local_ref(self)
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — __del__ during teardown: refcount is moot
                 pass
 
     def future(self):
@@ -140,7 +141,8 @@ class ObjectRef:
             except Exception as e:  # noqa: BLE001
                 fut.set_exception(e)
 
-        threading.Thread(target=run, daemon=True).start()
+        threading.Thread(target=run, daemon=True,
+                         name="objectref-future-wait").start()
         return fut
 
 
@@ -234,13 +236,13 @@ class ObjectRefGenerator:
         for addr, oids in plasma_nodes.items():
             try:
                 w.pool.get(addr).notify("PlasmaFree", {"object_ids": oids})
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — raylet gone: its plasma copies died with it
                 pass
 
     def __del__(self):
         try:
             self.close()
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — __del__: close is best-effort by contract
             pass
 
     def __repr__(self):
@@ -266,7 +268,7 @@ class ReferenceCounter:
 
     def __init__(self, worker: "CoreWorker"):
         self._w = worker
-        self._lock = threading.Lock()
+        self._lock = make_lock("ReferenceCounter._lock")
         self._local: Dict[ObjectID, int] = defaultdict(int)
         self._owned_submitted: Dict[ObjectID, int] = defaultdict(int)  # args of in-flight tasks
         self._borrowers: Dict[ObjectID, Set[Tuple[str, int]]] = defaultdict(set)
@@ -386,7 +388,7 @@ class TaskManager:
     """
 
     def __init__(self):
-        self.lock = threading.Lock()
+        self.lock = make_lock("TaskManager.lock")
         self.cv = threading.Condition(self.lock)
         self.pending: Dict[TaskID, TaskSpec] = {}
         self.lineage: Dict[ObjectID, TaskSpec] = {}
@@ -452,11 +454,11 @@ class CoreWorker:
         # makes check-and-inject atomic against task completion so an async
         # KeyboardInterrupt can never land in a LATER, uncancelled task
         self._exec_thread_id: Optional[int] = None
-        self._exec_state_lock = threading.Lock()
+        self._exec_state_lock = make_lock("CoreWorker._exec_state_lock")
         # RLock: ObjectRefGenerator.__del__ -> close() can be triggered by
         # GC inside a _store_lock critical section (allocations happen under
         # the lock); reentrancy beats a finalizer self-deadlock
-        self._store_lock = threading.RLock()
+        self._store_lock = make_rlock("CoreWorker._store_lock")
         self._store_cv = threading.Condition(self._store_lock)
 
         self.reference_counter = ReferenceCounter(self)
@@ -468,7 +470,7 @@ class CoreWorker:
         # still QUEUED behind another (prompt cancelled reply, executor
         # skips it), LeaseState answers the raylet's TTL reclaim probe,
         # and _stale_leases refuses pushes on revoked leases
-        self._queue_lock = threading.Lock()
+        self._queue_lock = make_lock("CoreWorker._queue_lock")
         self._queued_tokens: Dict[TaskID, tuple] = {}  # -> (token, attempt, lease_id)
         self._lease_task_counts: Dict[str, int] = {}
         self._stale_leases: Set[str] = set()
@@ -480,12 +482,12 @@ class CoreWorker:
         self._runtime_env_cache: Dict[str, Optional[dict]] = {}
         self._fn_cache: Dict[str, Any] = {}
         self._put_counter = 0
-        self._counter_lock = threading.Lock()
+        self._counter_lock = make_lock("CoreWorker._counter_lock")
         self._task_events: List[dict] = []
         # guards the buffer against concurrent writers (actor concurrency
         # groups, proxy executor threads emitting spans): an unlocked
         # append racing flush's swap-and-serialize would drop events
-        self._task_events_lock = threading.Lock()
+        self._task_events_lock = make_lock("CoreWorker._task_events_lock")
         self._last_event_flush = 0.0
         self._event_flush_timer_armed = False
         # bind the flight-recorder hot path now (rebinds module-level
@@ -502,7 +504,7 @@ class CoreWorker:
         # lease held by the normal task currently executing on this worker
         # (for the blocked-in-get CPU release; actors never lend theirs)
         self._exec_lease_id: Optional[str] = None
-        self._actor_seq_lock = threading.Lock()
+        self._actor_seq_lock = make_lock("CoreWorker._actor_seq_lock")
         # per-caller ordered arrival queues (reference: ActorSchedulingQueue):
         # caller -> {"epoch": int, "next": int, "pending": {(epoch, seq): item}}
         self._actor_callers: Dict[str, dict] = {}
@@ -510,7 +512,7 @@ class CoreWorker:
         self._actor_addr_cache: Dict[ActorID, Tuple[str, int]] = {}
         self._actor_state_cache: Dict[ActorID, str] = {}
         self._actor_pipelines: Dict[ActorID, "_ActorPipeline"] = {}
-        self._actor_lock = threading.Lock()
+        self._actor_lock = make_lock("CoreWorker._actor_lock")
         self._actor_cv = threading.Condition(self._actor_lock)
 
         self.job_id = job_id
@@ -523,14 +525,14 @@ class CoreWorker:
         # the owner's lost-push probe (HasTask) reads this; entries clear
         # when the reply goes out
         self._received_pushes: set = set()
-        self._received_pushes_lock = threading.Lock()
+        self._received_pushes_lock = make_lock("CoreWorker._received_pushes_lock")
         # cached GetDrainInfo from the local raylet: (expires_mono, info)
         self._drain_info_cache: Optional[Tuple[float, Optional[dict]]] = None
         # pubsub subscriptions this worker holds; re-issued periodically so a
         # restarted GCS (or a transient-failure eviction, gcs.py Pubsub
         # 3-strike rule) cannot silently orphan a live subscriber
         self._subscriptions: set = set()
-        self._sub_lock = threading.Lock()
+        self._sub_lock = make_lock("CoreWorker._sub_lock")
         threading.Thread(target=self._resubscribe_loop, daemon=True,
                          name="pubsub-resubscribe").start()
 
@@ -559,7 +561,7 @@ class CoreWorker:
             # even when no new refs are being created to trigger a drain)
             try:
                 self.reference_counter.drain_deferred()
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — deferred releases retry next resubscribe tick
                 pass
             # piggybacked metrics flush: runtime + user metrics recorded in
             # this process reach the GCS aggregate without their own loop
@@ -569,7 +571,7 @@ class CoreWorker:
             # trace spans within one resubscribe tick
             try:
                 self.flush_task_events()
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — span flush retries next tick; events are lossy
                 pass
             with self._sub_lock:
                 channels = list(self._subscriptions)
@@ -619,7 +621,7 @@ class CoreWorker:
         self.shutting_down = True
         try:  # cached leases go back to their raylets (TTL covers misses)
             self._submitter.release_all_leases()
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — teardown: TTL reclaims leases the release misses
             pass
         try:  # final metrics flush: short-lived workers' points must land.
             # Short timeout, no reconnect-retry — teardown must not stall
@@ -627,7 +629,7 @@ class CoreWorker:
             from ray_tpu.util import metrics as _metrics
 
             _metrics.push_to_gcs(timeout=2, retry_deadline=0.0)
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — teardown races GCS death by design (see above)
             pass
         with self._sub_lock:
             self._subscriptions.clear()
@@ -636,12 +638,12 @@ class CoreWorker:
                 self.gcs.call("Unsubscribe",
                               {"channel": "WORKER_LOGS",
                                "subscriber_addr": self.server.address}, timeout=5)
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — teardown: a dead GCS needs no unsubscribe
                 pass
         if self.mode == DRIVER and self.job_id is not None:
             try:
                 self.gcs.call("JobFinished", {"job_id": self.job_id}, timeout=5)
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — teardown: the job finishes implicitly if GCS died
                 pass
         self._submit_pool.shutdown(wait=False, cancel_futures=True)
         self._exec_pool.shutdown(wait=False, cancel_futures=True)
@@ -676,7 +678,7 @@ class CoreWorker:
             return
         try:
             self.pool.get(tuple(owner_addr)).notify(method, payload)
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — owner gone: nothing left to notify
             pass
 
     # ------------------------------------------------------------------
@@ -742,7 +744,7 @@ class CoreWorker:
                 try:
                     self.raylet.notify("NotifyWorkerUnblocked",
                                        {"lease_id": blocked_lease})
-                except Exception:  # noqa: BLE001
+                except Exception:  # noqa: BLE001 — raylet gone: the blocked lease died with it
                     pass
         for v in out:
             if isinstance(v, TaskError):
@@ -830,7 +832,7 @@ class CoreWorker:
         try:
             if self.plasma.contains(oid):
                 return self.plasma.get(oid, timeout=0)
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — local probe; a miss falls back to remote fetch
             pass
         return False, None
 
@@ -955,7 +957,7 @@ class CoreWorker:
         for node_addr in locations:
             try:
                 self.pool.get(node_addr).notify("PlasmaFree", {"object_ids": [oid]})
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — node gone: its plasma store died with it
                 pass
 
     # ------------------------------------------------------------------
@@ -1064,7 +1066,7 @@ class CoreWorker:
             except Exception as e:  # noqa: BLE001 — the caller must hear back
                 try:
                     server.send_error_reply(reply_token, e)
-                except Exception:  # noqa: BLE001
+                except Exception:  # noqa: BLE001 — error reply to a caller that already went away
                     pass
 
         threading.Thread(target=run, daemon=True, name="cpu-profiler").start()
@@ -1126,7 +1128,7 @@ class CoreWorker:
             except Exception as e:  # noqa: BLE001 — the caller must hear back
                 try:
                     server.send_error_reply(reply_token, e)
-                except Exception:  # noqa: BLE001
+                except Exception:  # noqa: BLE001 — error reply to a caller that already went away
                     pass
 
         threading.Thread(target=run, daemon=True, name="jax-profiler").start()
@@ -1367,14 +1369,14 @@ class CoreWorker:
             try:
                 self.pool.get(tuple(addr)).notify(
                     "CancelTask", {"task_id": spec.task_id, "force": force})
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — executor gone: the in-flight task died with it
                 pass
         # maybe still queued at a raylet (the one that took the lease
         # request: PG routing / spillback may have left the local node)
         try:
             target = self._task_lease_raylet.get(spec.task_id, self.raylet)
             target.notify("CancelLease", {"task_id": spec.task_id})
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — raylet gone: the queued lease died with it
             pass
         return True
 
@@ -1525,7 +1527,7 @@ class CoreWorker:
         if events:
             try:
                 self.gcs.notify("AddTaskEvents", {"events": events})
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — task events are lossy by contract (bounded sink)
                 pass
 
     def maybe_flush_task_events(self, min_interval_s: float = 0.5):
@@ -1736,7 +1738,7 @@ class CoreWorker:
                 # RAY_DEBUG_POST_MORTEM)
                 try:
                     rpdb.post_mortem(label=f"post-mortem:{spec.name}")
-                except Exception:  # noqa: BLE001
+                except Exception:  # noqa: BLE001 — debugger hold is best-effort; the task still fails below
                     pass
             self.server.send_reply(
                 reply_token,
@@ -1851,7 +1853,7 @@ class CoreWorker:
             try:
                 self.pool.get(tuple(payload)).notify(
                     "PlasmaFree", {"object_ids": [oid]})
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — consumer and copy both gone is fine
                 pass
         return True
 
@@ -2133,11 +2135,13 @@ class CoreWorker:
 
     def HandleKillActor(self, req):
         logger.info("actor %s killed: %s", req.get("actor_id"), req.get("reason"))
-        threading.Thread(target=self._exit_soon, daemon=True).start()
+        threading.Thread(target=self._exit_soon, daemon=True,
+                         name="worker-kill-actor-exit").start()
         return True
 
     def HandleExit(self, req):
-        threading.Thread(target=self._exit_soon, daemon=True).start()
+        threading.Thread(target=self._exit_soon, daemon=True,
+                         name="worker-exit").start()
         return True
 
     def _exit_soon(self):
@@ -2167,7 +2171,7 @@ class _ActorPipeline:
     def __init__(self, worker: CoreWorker, actor_id: ActorID):
         self.w = worker
         self.actor_id = actor_id
-        self.lock = threading.Lock()
+        self.lock = make_lock("_ActorPipeline.lock")
         self.cv = threading.Condition(self.lock)
         self.queue: List[TaskSpec] = []
         self.inflight: Dict[int, TaskSpec] = {}  # seq -> spec (current epoch)
@@ -2256,7 +2260,9 @@ class _ActorPipeline:
         self.queue = keep + self.queue
         self.cv.notify_all()
         if dead:
-            threading.Thread(target=self._fail_specs, args=(dead,), daemon=True).start()
+            threading.Thread(target=self._fail_specs, args=(dead,),
+                             daemon=True,
+                             name="actor-pipeline-fail-specs").start()
 
     def _fail_specs(self, specs):
         for sp in specs:
@@ -2401,7 +2407,7 @@ class NormalTaskSubmitter:
 
     def __init__(self, worker: "CoreWorker"):
         self.w = worker
-        self.lock = threading.Lock()
+        self.lock = make_lock("NormalTaskSubmitter.lock")
         self.states: Dict[tuple, _KeyState] = {}
         # id(env) → (env, hash): the strong ref to env PINS the id — a
         # freed dict's id can be reused by a different env, so the entry
@@ -2757,7 +2763,7 @@ class NormalTaskSubmitter:
             try:
                 lease.raylet_cli.notify("ReturnWorker",
                                         {"lease_id": lease.lease_id})
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — raylet gone: TTL reclaim covers the lease
                 pass
 
     def _request_leases(self, key, count: int):
@@ -2923,7 +2929,7 @@ class NormalTaskSubmitter:
         for victim, task_id in steals:
             try:
                 victim.worker_cli.notify("StealTask", {"task_id": task_id})
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — victim gone: the steal becomes moot
                 pass
 
     # -- owner-side cancellation ----------------------------------------
@@ -3022,7 +3028,7 @@ class NormalTaskSubmitter:
             try:
                 lease.raylet_cli.notify("ReturnWorker",
                                         {"lease_id": lease.lease_id})
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — raylet gone: TTL reclaim covers the lease
                 pass
 
     def _extend_leases(self):
@@ -3117,7 +3123,7 @@ class NormalTaskSubmitter:
             try:
                 lease.raylet_cli.notify("ReturnWorker",
                                         {"lease_id": lease.lease_id})
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — raylet gone: TTL reclaim covers the lease
                 pass
 
     def stats(self) -> dict:

@@ -46,7 +46,7 @@ def main():
         from ray_tpu._private.native_stack import install as _nsinstall
 
         _nsinstall()
-    except Exception:  # noqa: BLE001
+    except Exception:  # noqa: BLE001 — optional native component; Python paths stand alone
         pass
 
     # flight-recorder post-mortem dump (crash / exit / SIGUSR2 when the C
@@ -57,7 +57,7 @@ def main():
         from ray_tpu._private.flight_recorder import install_dump as _frinstall
 
         _frinstall()
-    except Exception:  # noqa: BLE001
+    except Exception:  # noqa: BLE001 — post-mortem dump hooks are best-effort by design
         pass
 
     # Apply this worker's runtime env BEFORE serving any task (dedicated
@@ -80,7 +80,7 @@ def main():
                     "ReportWorkerEnvFailure",
                     {"env_hash": env_hash, "error": f"{type(e).__name__}: {e}"},
                     timeout=10)
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — raylet unreachable: the spawn timeout reaps us
                 pass
             sys.exit(1)
 

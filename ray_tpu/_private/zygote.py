@@ -86,7 +86,7 @@ def serve(sock_path: str) -> None:
         from ray_tpu import _native
 
         _native.load("stack_dump")
-    except Exception:  # noqa: BLE001
+    except Exception:  # noqa: BLE001 — warm-cache build is an optimization only
         pass
 
     def _reap(_sig, _frm):
@@ -188,14 +188,14 @@ class ZygoteClient:
     calls spawn under its lock)."""
 
     def __init__(self, state_dir: str, worker_env: dict, log_sink):
-        import threading
+        from ray_tpu._private.analysis.lock_witness import make_lock
 
         self._sock_path = os.path.join(
             state_dir, f"zygote-{os.getpid()}.sock")
         self._env = worker_env
         self._log_sink = log_sink  # file path for the zygote's own output
         self._proc = None
-        self._lock = threading.Lock()
+        self._lock = make_lock("ZygoteClient._lock")
         self._starting = False
         self._stopped = False
         self.start_async()
@@ -242,7 +242,7 @@ class ZygoteClient:
                     proc.terminate()
                 else:
                     self._proc = proc
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — boot failure falls back to Popen spawns (counted there)
             pass
         finally:
             with self._lock:
@@ -301,7 +301,7 @@ class ZygoteClient:
             from ray_tpu._private import runtime_metrics
 
             runtime_metrics.inc_zygote_fallback()
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — fallback counter is telemetry; never block a spawn
             pass
 
     def shutdown(self):
@@ -316,11 +316,11 @@ class ZygoteClient:
             conn.connect(self._sock_path)
             conn.sendall(b'{"shutdown": true}\n')
             conn.close()
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — zygote already dead: terminate below still runs
             pass
         try:
             proc.terminate()
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — already-exited zygote is the desired state
             pass
 
 

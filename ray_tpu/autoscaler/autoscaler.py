@@ -96,7 +96,7 @@ class Autoscaler:
                 s = self._w.pool.get(tuple(node["address"])).call(
                     "GetNodeStats", {}, timeout=5)
                 stats[s["node_id"].hex()] = s
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — unreachable node: skip its stats this round
                 continue
         self._dead_nodes = dead
         # DRAINING nodes are the preemption-replacement signal: their gang

@@ -179,8 +179,13 @@ class InstanceManager:
                     # allocation before requesting a fresh one or it leaks
                     try:
                         self._provider.terminate_node_group(inst.provider_id)
-                    except Exception:  # noqa: BLE001
-                        pass
+                    except Exception as e:  # noqa: BLE001 — a failed
+                        # terminate LEAKS the stale allocation until the
+                        # provider reconciles; that must be visible
+                        logger.warning(
+                            "terminate of stale node group %s failed (%s); "
+                            "allocation may leak until provider reconcile",
+                            inst.provider_id, e)
                     inst.provider_id = None
                 inst.retries += 1
                 inst.to(QUEUED)

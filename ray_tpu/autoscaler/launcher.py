@@ -159,7 +159,8 @@ class TPUPodCommandRunner(CommandRunner):
             except Exception as e:  # noqa: BLE001
                 results[i] = (255, f"{type(e).__name__}: {e}")
 
-        threads = [threading.Thread(target=worker, args=(i, r), daemon=True)
+        threads = [threading.Thread(target=worker, args=(i, r), daemon=True,
+                                    name=f"launcher-runner-{i}")
                    for i, r in enumerate(self.runners)]
         for t in threads:
             t.start()

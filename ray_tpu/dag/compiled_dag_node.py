@@ -187,12 +187,12 @@ def _close_and_destroy_channels(channels):
     for ch in channels:
         try:
             ch.close()
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — GC-time close; channel may be half-torn
             pass
     for ch in channels:
         try:
             ch.destroy()
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — GC-time destroy; peer may already be gone
             pass
 
 
@@ -541,7 +541,7 @@ class CompiledDAG:
             for ref in self._loop_refs:
                 try:
                     ray_tpu.get(ref, timeout=5)
-                except Exception:  # noqa: BLE001
+                except Exception:  # noqa: BLE001 — teardown drain; the loop task erroring is expected
                     pass
         for ch in self._channels:
             ch.destroy()
@@ -552,5 +552,5 @@ class CompiledDAG:
     def __del__(self):
         try:
             self.teardown(wait=False)
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — __del__: teardown is best-effort
             pass

@@ -288,7 +288,7 @@ class DashboardHead:
             try:
                 if a.get("name"):
                     ref = ray_tpu.get_actor(a["name"]).get_status.remote()
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — controller gone: its probe row stays empty
                 pass
             probes.append((a, ref))
         deadline = _time.monotonic() + 5
@@ -299,7 +299,7 @@ class DashboardHead:
                 try:
                     status = ray_tpu.get(
                         ref, timeout=max(0.1, deadline - _time.monotonic()))
-                except Exception:  # noqa: BLE001
+                except Exception:  # noqa: BLE001 — probe timeout: render partial status
                     pass
             runs.append({"actor_id": a["actor_id"], "name": a.get("name"),
                          "status": status})
@@ -389,7 +389,7 @@ class DashboardHead:
                         gauge("ray_tpu_serve_queued",
                               sum(r.get("ongoing", 0) for r in reps),
                               app=app, deployment=name)
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — serve rows are optional; scrape must not 500
             pass
         return "\n" + "\n".join(lines) + "\n" if lines else ""
 

@@ -24,12 +24,15 @@ Here the same contract, privacy-first and zero-egress-safe:
 from __future__ import annotations
 
 import json
+import logging
 import os
 import sys
 import tempfile
 import threading
 import time
 from typing import Any, Dict, Optional
+
+logger = logging.getLogger(__name__)
 
 _LIBRARIES = ("data", "train", "tune", "serve", "llm", "rllib", "dag")
 
@@ -149,8 +152,10 @@ class UsageStatsReporter:
         while True:
             try:
                 write_usage_report()
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as e:  # noqa: BLE001 — telemetry must never
+                # break work, but a report that fails EVERY interval
+                # should at least be debuggable
+                logger.debug("usage report failed: %s", e)
             if self._stop.wait(self.interval_s):
                 return
 

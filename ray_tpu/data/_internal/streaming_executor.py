@@ -264,7 +264,7 @@ class TaskPoolMapOperator(PhysicalOperator):
         for meta_ref, (block_ref, *_rest) in self._in_flight.items():
             try:
                 ray_tpu.cancel(block_ref)
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — cancel of a finished ref is fine
                 pass
         self._in_flight.clear()
 
@@ -385,7 +385,7 @@ class ActorPoolMapOperator(PhysicalOperator):
                 self.scale_down_events += 1
                 try:
                     ray_tpu.kill(a.handle)
-                except Exception:  # noqa: BLE001
+                except Exception:  # noqa: BLE001 — already-dead actor is the goal
                     pass
 
     def outstanding(self) -> int:
@@ -401,7 +401,7 @@ class ActorPoolMapOperator(PhysicalOperator):
         for a in self.pool:
             try:
                 ray_tpu.kill(a.handle)
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — already-dead actor is the goal
                 pass
         self.pool.clear()
 

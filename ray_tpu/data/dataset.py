@@ -664,7 +664,7 @@ class StreamSplit:
                 # as drained so peers' next epoch doesn't livelock
                 try:
                     self._coord.finish.remote(self._index, epoch)
-                except Exception:  # noqa: BLE001
+                except Exception:  # noqa: BLE001 — coordinator gone: epoch accounting died with it
                     pass
 
     def iter_batches(self, *, batch_size: Optional[int] = 256,

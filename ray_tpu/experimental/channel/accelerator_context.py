@@ -47,7 +47,7 @@ def _detect_default() -> str:
 
         if any(d.platform == "tpu" for d in jax.devices()):
             return "tpu"
-    except Exception:  # noqa: BLE001
+    except Exception:  # noqa: BLE001 — no jax / no TPU: cpu is the answer
         pass
     return "cpu"
 

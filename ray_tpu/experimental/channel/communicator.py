@@ -99,5 +99,5 @@ class CollectiveGroupCommunicator(Communicator):
     def destroy(self) -> None:
         try:
             self._collective.destroy_collective_group(self._group_name)
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — group may already be destroyed by a peer
             pass

@@ -196,7 +196,7 @@ class ShmChannel:
     def __del__(self):
         try:
             self._shm.close()
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — __del__: close is best-effort
             pass
 
 

@@ -242,6 +242,6 @@ class XlaTensorChannel:
             if self._comm is not None:
                 try:
                     self._comm.destroy()
-                except Exception:  # noqa: BLE001
+                except Exception:  # noqa: BLE001 — peer may have destroyed the group first
                     pass
                 self._comm = None

@@ -55,7 +55,9 @@ class JobSupervisor:
             tempfile.gettempdir(), f"ray_tpu_job_{submission_id}.log")
         self._proc: Optional[subprocess.Popen] = None
         self._lock = threading.Lock()
-        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"job-runner-{submission_id}")
         self._thread.start()
 
     def _run(self):
@@ -85,6 +87,8 @@ class JobSupervisor:
                         return
                     # Popen under the lock so stop() either sees the proc or
                     # runs before it exists (and the checks above catch it)
+                    # graftlint: allow(blocking-under-lock) — that stop()
+                    # race is exactly what the lock scope buys here
                     self._proc = subprocess.Popen(
                         self._info.entrypoint, shell=True, stdout=log,
                         stderr=subprocess.STDOUT, env=env,

@@ -80,7 +80,7 @@ class PrefillServer:
         lifecycle stages book under the prefill deployment's name."""
         try:
             self._engine.slo_label = name
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — engine variants without SLO threading are legal
             pass
 
     def prefix_digest(self) -> Dict[str, Any]:
@@ -213,8 +213,8 @@ class DecodeServer(LLMServer):
             transport = "channel_int8" if spec is not None else "channel"
             try:
                 chan.register_reader(0)
-            except Exception:  # noqa: BLE001 — already registered
-                pass
+            except Exception:  # noqa: BLE001 — reader already registered
+                pass           # by a prior handoff on this channel
             try:
                 k, v = chan.read(timeout=_HANDOFF_TIMEOUT_S)
             except Exception:  # noqa: BLE001 — lost channel: recompute
@@ -276,7 +276,7 @@ class DecodeServer(LLMServer):
             with self._engine._lock:
                 n = len(self._engine._requests)
             runtime_metrics.set_disagg_queue_depth("decode", n)
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — depth gauge is telemetry; engine may be mid-swap
             pass
 
     @staticmethod

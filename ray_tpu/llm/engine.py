@@ -36,6 +36,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ray_tpu._private.analysis.lock_witness import make_lock
 from ray_tpu.llm.config import GenerationConfig, LLMConfig
 from ray_tpu.models import llama
 from ray_tpu.ops.rope import rope_frequencies
@@ -259,7 +260,7 @@ class JaxLLMEngine:
         self._pending: List[_Request] = []
         self._requests: Dict[int, _Request] = {}
         self._req_counter = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("JaxLLMEngine._lock")
         # one decode chunk may stay in flight (collected next step): its
         # readback overlaps the next chunk's compute, like the paged
         # engine.  (em_dev, active_slots).

@@ -39,6 +39,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ray_tpu._private.analysis.lock_witness import make_lock
 from ray_tpu.llm.config import GenerationConfig, LLMConfig
 from ray_tpu.llm.engine import (
     _MAX_STOP_IDS,
@@ -186,7 +187,7 @@ class HostBlockCache:
         self._bytes = 0
         self._plasma: "collections.OrderedDict[int, object]" = (
             collections.OrderedDict())  # hash -> ObjectRef
-        self._lock = threading.Lock()
+        self._lock = make_lock("HostBlockCache._lock")
 
     def __len__(self):
         with self._lock:
@@ -492,7 +493,7 @@ class PagedJaxLLMEngine:
         self._requests: Dict[int, _PagedReq] = {}
         self._req_counter = 0
         self._admit_counter = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("PagedJaxLLMEngine._lock")
         # serving SLO layer: the hosting deployment's name, set via the
         # replica's set_slo_label threading (serve/_private/replica.py).
         # None (direct engine use) books no lifecycle stages at all.

@@ -104,7 +104,7 @@ class LLMServer:
             try:
                 with eng._lock:
                     qlen += len(eng._requests)
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — engine variants without a request table are legal
                 pass
         digest["models"] = models
         digest["qlen"] = qlen
@@ -251,7 +251,7 @@ class LLMServer:
                     if self._slo_label is not None:
                         try:
                             built.slo_label = self._slo_label
-                        except Exception:  # noqa: BLE001
+                        except Exception:  # noqa: BLE001 — engine variants without SLO threading are legal
                             pass
                     self._engines[model] = eng = built
                 if eng is not None:

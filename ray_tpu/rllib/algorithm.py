@@ -154,7 +154,7 @@ class Algorithm:
         for r in self._runners:
             try:
                 ray_tpu.kill(r)
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — already-dead runner is the goal
                 pass
 
     def get_policy_params(self):

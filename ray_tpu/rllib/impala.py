@@ -171,7 +171,7 @@ class IMPALA(Algorithm):
                     self._fail_counts.pop(id(runner), None)
                     try:
                         ray_tpu.kill(runner)
-                    except Exception:  # noqa: BLE001
+                    except Exception:  # noqa: BLE001 — already-dead runner is the goal
                         pass
                     logger.error("IMPALA: runner dropped after %d consecutive "
                                  "failed samples (%s)", n, e)

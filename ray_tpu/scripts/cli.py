@@ -195,7 +195,7 @@ def cmd_start(args) -> int:
         for e in extra:
             try:
                 e.shutdown()
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — stop is best-effort; the watchdog hard-kills anyway
                 pass
         node.shutdown()
         killer.cancel()

@@ -1,0 +1,213 @@
+"""``python -m ray_tpu.scripts.lint`` — graftlint, the repo's own analyzer.
+
+Runs the rule set in ray_tpu/_private/analysis/ over the tree (default:
+the ray_tpu/ package) in one AST pass per file, applies the shrink-only
+baseline (tools/graftlint_baseline.json), and exits non-zero on any
+non-baselined finding or baseline-hygiene violation.
+
+    python -m ray_tpu.scripts.lint                 # full pass, baseline on
+    python -m ray_tpu.scripts.lint path/to/file.py
+    python -m ray_tpu.scripts.lint --diff          # only files changed vs git
+    python -m ray_tpu.scripts.lint --explain blocking-under-lock
+    python -m ray_tpu.scripts.lint --list-rules
+    python -m ray_tpu.scripts.lint --json          # machine-readable output
+    python -m ray_tpu.scripts.lint --update-baseline  # regenerate (review
+                                                      # the diff: shrink-only)
+
+Suppression is in-source and reasoned (see --explain output per rule):
+
+    # graftlint: allow(rule-id) — why the invariant holds at this site
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import List
+
+from ray_tpu._private.analysis import baseline as baseline_mod
+from ray_tpu._private.analysis.engine import (
+    Severity, all_rules, run_analysis)
+
+
+def _repo_root() -> str:
+    """The repo root: the directory holding the ray_tpu package."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.dirname(os.path.dirname(here))
+
+
+def _diff_paths(root: str) -> List[str]:
+    """Changed + staged + untracked .py files under ray_tpu/ (the --diff
+    lane: lint what this PR touches, not the world)."""
+    out: set = set()
+    for args in (["git", "diff", "--name-only", "HEAD"],
+                 ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            p = subprocess.run(args, cwd=root, capture_output=True,
+                               text=True, timeout=30)
+        except (OSError, subprocess.SubprocessError):
+            continue
+        for line in p.stdout.splitlines():
+            line = line.strip()
+            if line.endswith(".py") and line.startswith("ray_tpu/"):
+                full = os.path.join(root, line)
+                if os.path.exists(full):
+                    out.add(full)
+    return sorted(out)
+
+
+def _explain(rule_id: str) -> int:
+    for rule in all_rules():
+        if rule.id == rule_id:
+            print(f"{rule.id} [{rule.severity}]")
+            print(f"  {rule.summary}\n")
+            print(rule.doc.rstrip() or "  (no extended doc)")
+            return 0
+    print(f"unknown rule: {rule_id}", file=sys.stderr)
+    print("known rules: " + ", ".join(r.id for r in all_rules()),
+          file=sys.stderr)
+    return 2
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m ray_tpu.scripts.lint",
+        description="graftlint: runtime-aware static analysis of this repo")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: ray_tpu/)")
+    ap.add_argument("--diff", action="store_true",
+                    help="lint only files changed vs git HEAD "
+                         "(+ staged/untracked)")
+    ap.add_argument("--explain", metavar="RULE",
+                    help="print a rule's rationale, matched shapes and "
+                         "fix pattern")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--json", action="store_true",
+                    help="emit findings as JSON lines")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline path (default: tools/"
+                         "graftlint_baseline.json under the repo root)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, grandfathered or not")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from current findings "
+                         "(high-severity rules are never baselined)")
+    ap.add_argument("--severity", choices=("high", "medium", "low"),
+                    default="low",
+                    help="minimum severity to report (default: low = all)")
+    args = ap.parse_args(argv)
+
+    if args.explain:
+        return _explain(args.explain)
+    if args.list_rules:
+        for rule in all_rules():
+            print(f"{rule.id:24s} {rule.severity:7s} {rule.summary}")
+        return 0
+
+    root = _repo_root()
+    partial = False
+    if args.diff:
+        paths = _diff_paths(root)
+        if not paths:
+            print("graftlint: no changed python files under ray_tpu/")
+            return 0
+        partial = True
+    elif args.paths:
+        # relative paths resolve against the REPO ROOT first (the tree
+        # this tool lints and the baseline is keyed to), falling back to
+        # the CWD — `lint ray_tpu` must mean the package from anywhere
+        paths = []
+        for p in args.paths:
+            if not os.path.isabs(p):
+                cand = os.path.join(root, p)
+                p = cand if os.path.exists(cand) else os.path.abspath(p)
+            paths.append(p)
+        partial = paths != [os.path.join(root, "ray_tpu")]
+    else:
+        paths = [os.path.join(root, "ray_tpu")]
+
+    t0 = time.perf_counter()
+    findings, eng = run_analysis(root, paths, partial=partial)
+    wall_s = time.perf_counter() - t0
+    if not eng.files_seen:
+        # a typo'd path must FAIL, not stay green while linting nothing
+        print(f"graftlint: no python files found under {paths} — "
+              f"check the path", file=sys.stderr)
+        return 2
+
+    baseline_path = args.baseline or os.path.join(
+        root, baseline_mod.DEFAULT_BASELINE)
+
+    if args.update_baseline:
+        if partial:
+            # a partial rewrite would silently delete every entry (and
+            # its hand-written justification) for files outside the
+            # subset — same hazard the severity filter guards against
+            print("graftlint: --update-baseline requires a full run "
+                  "(no --diff / explicit paths)", file=sys.stderr)
+            return 2
+        # the FULL finding set, never the severity-filtered view — a
+        # filtered update would silently delete every entry below the
+        # filter from the baseline
+        entries = baseline_mod.make_entries(findings)
+        baseline_mod.save(baseline_path, entries)
+        skipped = len(findings) - len(entries)
+        print(f"graftlint: wrote {len(entries)} entries to {baseline_path}"
+              + (f" ({skipped} high-severity findings NOT baselined — fix "
+                 f"or justify those in-source)" if skipped else ""))
+        return 0
+
+    entries = {} if args.no_baseline else baseline_mod.load(baseline_path)
+    # baseline matching + staleness run on the FULL finding set (a
+    # --severity high run must not report medium/low entries as stale);
+    # --severity filters only what is REPORTED and gated
+    new, baselined, stale = baseline_mod.apply(findings, entries)
+    if partial:
+        # a partial run can only judge staleness for files it walked;
+        # entries for everything else are simply out of scope
+        seen = set(eng.files_seen)
+        stale = [k for k in stale
+                 if k.split(":", 2)[1] in seen] if stale else stale
+    hygiene = [] if args.no_baseline else baseline_mod.violations(entries)
+    max_order = Severity.ORDER[args.severity]
+    new = [f for f in new if Severity.ORDER.get(f.severity, 9) <= max_order]
+
+    if args.json:
+        for f in new:
+            print(json.dumps({"rule": f.rule, "severity": f.severity,
+                              "path": f.path, "line": f.line,
+                              "message": f.message, "hint": f.hint,
+                              "key": f.key}))
+        for k in stale:
+            print(json.dumps({"rule": "stale-baseline", "key": k}))
+        for h in hygiene:
+            print(json.dumps({"rule": "baseline-hygiene", "message": h}))
+    else:
+        for f in new:
+            print(f.render())
+        for k in stale:
+            print(f"stale baseline entry (finding fixed? delete it from "
+                  f"{os.path.relpath(baseline_path, root)}): {k}")
+        for h in hygiene:
+            print(f"baseline hygiene: {h}")
+
+    by_rule: dict = {}
+    for f in findings:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    summary = (f"graftlint: {len(eng.files_seen)} files in {wall_s:.2f}s — "
+               f"{len(new)} finding(s), {len(baselined)} baselined, "
+               f"{len(stale)} stale")
+    if by_rule:
+        summary += " [" + ", ".join(
+            f"{r}:{n}" for r, n in sorted(by_rule.items())) + "]"
+    # --json stdout is JSON lines ONLY; the human summary goes to stderr
+    print(summary, file=sys.stderr if args.json else sys.stdout)
+    return 1 if (new or stale or hygiene) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,6 +18,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from ray_tpu._private.analysis.lock_witness import make_rlock
+
 logger = logging.getLogger(__name__)
 
 CONTROLLER_NAME = "_serve_controller"
@@ -65,7 +67,7 @@ class ServeController:
         # graceful_shutdown_timeout_s deadline passes
         self._draining: List[list] = []
         self._version = 0
-        self._lock = threading.RLock()
+        self._lock = make_rlock("ServeController._lock")
         self._stop = threading.Event()
         # replica startup (spawn + health gate, up to actor_creation_timeout_s)
         # runs OFF the reconcile thread so one slow/unschedulable deployment
@@ -218,7 +220,7 @@ class ServeController:
         for entry in items:
             try:
                 ray_tpu.kill(entry[0])
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — already-dead replica is the goal
                 pass
         self._del_digest_rows(
             entry[3] if len(entry) > 3 else None for entry in items)
@@ -366,7 +368,7 @@ class ServeController:
             for victim in discard:
                 try:
                     ray_tpu.kill(victim)
-                except Exception:  # noqa: BLE001
+                except Exception:  # noqa: BLE001 — already-dead victim is the goal
                     pass
         except Exception:  # noqa: BLE001
             logger.exception("serve: replica start batch failed for %s/%s",
@@ -503,7 +505,7 @@ class ServeController:
             if kill_it:
                 try:
                     ray_tpu.kill(h)
-                except Exception:  # noqa: BLE001
+                except Exception:  # noqa: BLE001 — already-dead replica is the goal
                     pass
                 finished.append(id(entry))
                 killed_keys.append(entry[3] if len(entry) > 3 else None)
@@ -550,7 +552,7 @@ class ServeController:
             for r in reps:
                 try:
                     total_ongoing += ray_tpu.get(r["h"].queue_len.remote(), timeout=2)
-                except Exception:  # noqa: BLE001
+                except Exception:  # noqa: BLE001 — unreachable replica counts as zero ongoing
                     pass
             target_per_replica = ac.get("target_ongoing_requests", 2)
             desired_n = max(
@@ -569,7 +571,7 @@ def get_or_create_controller():
 
     try:
         return ray_tpu.get_actor(CONTROLLER_NAME)
-    except Exception:  # noqa: BLE001
+    except Exception:  # noqa: BLE001 — no controller yet: create below
         pass
     try:
         cls = ray_tpu.remote(ServeController).options(

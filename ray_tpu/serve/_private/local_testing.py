@@ -113,7 +113,7 @@ def run_local(app, name: str = "default") -> LocalDeploymentHandle:
         if hasattr(instance, "set_slo_label"):
             try:
                 instance.set_slo_label(spec["name"])
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — instances without SLO threading are legal
                 pass
         from ray_tpu.serve._private import slo
 

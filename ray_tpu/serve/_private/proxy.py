@@ -225,7 +225,7 @@ class _AsyncProxy:
         finally:
             try:
                 writer.close()
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — client socket already gone
                 pass
 
     @staticmethod
@@ -560,12 +560,12 @@ class _AsyncProxy:
             try:
                 await loop.run_in_executor(
                     self._pool, call, {"__ws__": "disconnect", "id": cid})
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — replica gone: disconnect notice is advisory
                 pass
             try:
                 writer.write(_ws_raw_frame(0x8, b""))
                 await writer.drain()
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — client gone; the close frame is a courtesy
                 pass
 
 

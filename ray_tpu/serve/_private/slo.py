@@ -56,6 +56,7 @@ import time
 from contextlib import contextmanager
 from typing import Any, Dict, List, Optional
 
+from ray_tpu._private.analysis.lock_witness import make_lock
 from ray_tpu._private import flight_recorder, runtime_metrics
 from ray_tpu._private.latency_sketch import merge_points, summary
 
@@ -123,7 +124,7 @@ def default_targets() -> Dict[str, float]:
 # deployment -> explicit slo_config (local-mode registration and the
 # controller-side cache; cluster-wide distribution rides the GCS KV)
 _local_targets: Dict[str, Dict[str, float]] = {}
-_targets_lock = threading.Lock()
+_targets_lock = make_lock("slo._targets_lock")
 
 
 def register_targets(deployment: str,
@@ -402,7 +403,7 @@ class ServingSLOLedger:
     def __init__(self, clock=None, wall=None):
         self.clock = clock or time.monotonic
         self.wall = wall or time.time
-        self._lock = threading.Lock()
+        self._lock = make_lock("ServingSLOLedger._lock")
         self._rids = itertools.count(1)
         # (deployment, objective) -> _Windows
         self._windows: Dict[tuple, _Windows] = {}
@@ -580,7 +581,7 @@ class ServingSLOLedger:
         def _bg():
             try:
                 self._publish()
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — publish retries on the next completion
                 pass
 
         threading.Thread(target=_bg, daemon=True,
@@ -725,7 +726,7 @@ def fold_recent(rows: List[dict], limit: int = 100) -> List[dict]:
 # ---------------------------------------------------------------------------
 
 _ledger: Optional[ServingSLOLedger] = None
-_ledger_lock = threading.Lock()
+_ledger_lock = make_lock("slo._ledger_lock")
 
 
 def get_ledger() -> ServingSLOLedger:

@@ -170,7 +170,7 @@ class ASGIDriver:
         try:
             self._loop.run_until_complete(
                 asyncio.gather(session.task, return_exceptions=True))
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — reap drains a cancelled task; errors are expected
             pass
 
     #: apps may legitimately await things other than receive() between

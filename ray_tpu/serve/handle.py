@@ -31,6 +31,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from ray_tpu._private.analysis.lock_witness import make_lock
 from ray_tpu._private.prefix_hash import (
     longest_chain_match,
     prefix_chain_hashes,
@@ -128,7 +129,7 @@ class _Router:
         self._dep = deployment_name
         self._replicas: List[Any] = []
         self._version = -1
-        self._lock = threading.Lock()
+        self._lock = make_lock("_Router._lock")
         # queue-length cache: actor_hex -> (qlen, monotonic ts); fed by
         # probe RPCs AND by digest rows (which carry the replica's depth)
         self._qcache: Dict[str, Tuple[float, float]] = {}

@@ -63,7 +63,7 @@ class _MultiplexWrapper:
                     if callable(del_fn):
                         try:
                             del_fn()
-                        except Exception:  # noqa: BLE001
+                        except Exception:  # noqa: BLE001 — user __del__ must not break eviction
                             pass
             return model
         finally:

@@ -178,7 +178,7 @@ class BackendExecutor:
         if self.worker_group is not None:
             try:
                 self._backend.on_shutdown(self.worker_group, self._backend_config)
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — backend hook is user code; shutdown proceeds
                 pass
             self.worker_group.shutdown()
             self.worker_group = None
@@ -187,6 +187,6 @@ class BackendExecutor:
 
             try:
                 remove_placement_group(self._pg)
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — PG may already be gone with the cluster
                 pass
             self._pg = None

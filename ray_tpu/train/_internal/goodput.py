@@ -109,7 +109,7 @@ class GoodputLedger:
             for b in buckets:
                 runtime_metrics.set_goodput_seconds(
                     self.run, b, self.buckets[b])
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — gauge mirror is telemetry; the ledger stays authoritative
             pass
 
     def _accrue(self, now: float) -> None:
@@ -157,7 +157,7 @@ class GoodputLedger:
 
             runtime_metrics.set_goodput_ratio(self.run,
                                               snap["goodput_ratio"])
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — gauge mirror is telemetry; the ledger stays authoritative
             pass
         return snap
 

@@ -150,6 +150,6 @@ class WorkerGroup:
         for w in self.workers:
             try:
                 ray_tpu.kill(w)
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception:  # noqa: BLE001 — already-dead worker is
+                pass            # the goal of shutdown
         self.workers = []

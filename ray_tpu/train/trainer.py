@@ -328,7 +328,7 @@ class DataParallelTrainer:
                     f"train hang sweep: {len(report.get('blocking') or [])} "
                     f"blocking member(s) after {stalled:.1f}s without "
                     "progress", severity="WARNING", source="train")
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — event record is advisory; diagnosis already logged
                 pass
         except Exception:  # noqa: BLE001 — diagnosis must never kill training
             logger.exception("hang sweep failed")

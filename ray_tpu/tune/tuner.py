@@ -361,7 +361,7 @@ class TuneController:
         if actor is not None:
             try:
                 ray_tpu.kill(actor)
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — already-dead trial actor is the goal
                 pass
         trial.status = status
         for cb in self._callbacks:

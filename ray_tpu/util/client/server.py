@@ -148,7 +148,7 @@ class ClientServer:
             if not detached:
                 try:
                     self._worker.kill_actor(actor_id, no_restart=True)
-                except Exception:  # noqa: BLE001
+                except Exception:  # noqa: BLE001 — actor already died with its session
                     pass
 
     def _resolve_ref(self, s: _Session, packed) -> ObjectRef:

@@ -111,7 +111,7 @@ class ClientWorker:
         payload["session"] = self._session
         try:
             self._rpc.notify(method, payload)
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — fire-and-forget notify; server may be gone
             pass
 
     def _release(self, ids: List[bytes]):
@@ -121,7 +121,7 @@ class ClientWorker:
         while not self._heartbeat_stop.wait(30.0):
             try:
                 self._call("ClientPing", {})
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — ping fails while the server restarts; loop retries
                 pass
 
     def _make_ref(self, packed) -> ObjectRef:
@@ -226,7 +226,7 @@ class ClientWorker:
         self._heartbeat_stop.set()
         try:
             self._rpc.call("ClientDisconnect", {"session": self._session}, timeout=5)
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — server gone: the disconnect is implicit
             pass
         self._rpc.close()
 

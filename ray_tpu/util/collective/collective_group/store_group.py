@@ -184,7 +184,7 @@ class StoreGroup(BaseGroup):
                 from ray_tpu._private import runtime_metrics
 
                 runtime_metrics.inc_collective_abort("store", self._group_name)
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — abort metric is telemetry; the raise below is the point
                 pass
         raise CollectiveAbortError(
             f"collective group {self._group_name!r} aborted: {reason}; "

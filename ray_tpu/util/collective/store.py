@@ -121,7 +121,7 @@ class _CollectiveStoreActor:
                 from ray_tpu._private import runtime_metrics
 
                 runtime_metrics.set_straggler_lag(group, rank, ewma)
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — lag gauge is telemetry; the monitor stays correct
                 pass
 
     def straggler_report(self, group_name: Optional[str] = None) -> dict:
@@ -409,7 +409,7 @@ def get_or_create_store():
 
     try:
         return ray_tpu.get_actor(STORE_ACTOR_NAME)
-    except Exception:  # noqa: BLE001
+    except Exception:  # noqa: BLE001 — no store yet: create below
         pass
     try:
         cls = ray_tpu.remote(_CollectiveStoreActor).options(

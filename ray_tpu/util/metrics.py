@@ -28,7 +28,9 @@ import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
-_REGISTRY_LOCK = threading.Lock()
+from ray_tpu._private.analysis.lock_witness import make_lock
+
+_REGISTRY_LOCK = make_lock("metrics._REGISTRY_LOCK")
 _REGISTRY: Dict[str, "Metric"] = {}
 
 DEFAULT_HISTOGRAM_BOUNDARIES = [
@@ -61,7 +63,7 @@ class Metric:
                 self._lock = prior._lock
                 self._points = prior._points
             else:
-                self._lock = threading.Lock()
+                self._lock = make_lock("Metric._lock")
                 self._points: Dict[Tuple[Tuple[str, str], ...], float] = {}
             _REGISTRY[name] = self
 
@@ -335,7 +337,7 @@ _REPORTER_ID: Optional[str] = None
 # (a head-node raylet/GCS process): anything with .call(method, payload,
 # timeout=). First registration wins; a worker, when present, is preferred.
 _FALLBACK_GCS = None
-_PUSH_LOCK = threading.Lock()
+_PUSH_LOCK = make_lock("metrics._PUSH_LOCK")
 _LAST_PUSH = 0.0
 
 

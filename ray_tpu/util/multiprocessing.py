@@ -68,7 +68,7 @@ class _CallbackResult(AsyncResult):
             if error_callback is not None:
                 try:
                     error_callback(e)
-                except Exception:  # noqa: BLE001
+                except Exception:  # noqa: BLE001 — user error_callback raised; the original error is kept
                     pass
         finally:
             self._event.set()
@@ -245,7 +245,7 @@ class Pool:
         for a in self._actors:
             try:
                 ray_tpu.kill(a)
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — already-dead actor is the goal
                 pass
         self._actors = []
 

@@ -43,7 +43,7 @@ def _default_bind_host() -> str:
 
         if _global_worker is not None:
             return _global_worker.server.address[0]
-    except Exception:  # noqa: BLE001
+    except Exception:  # noqa: BLE001 — no worker yet: loopback is the right default
         pass
     return "127.0.0.1"
 
@@ -86,7 +86,7 @@ class RemotePdb(pdb.Pdb):
             from ray_tpu._private.worker import _global_worker
 
             _global_worker.gcs.call("KVDel", {"key": self._key}, timeout=5)
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — GCS gone: the session key dies with it
             pass
 
     def _accept(self, label: str):

@@ -161,7 +161,7 @@ class StateApiClient:
         # a just-closed driver-side span must be queryable immediately
         try:
             self._w.flush_task_events()
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — flush is best-effort; stale spans still list
             pass
         events = self._w.gcs.call(
             "ListTaskEvents", {"limit": 100000, "trace_id": trace_id}) or []
@@ -320,7 +320,7 @@ class StateApiClient:
                 continue
             try:
                 reply = self._w.pool.get(tuple(node["address"])).call(method, payload, timeout=5)
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — unreachable raylet: return the rows we have
                 continue
             for row in reply or []:
                 row["node_id"] = node["node_id"]
@@ -346,7 +346,7 @@ class StateApiClient:
                     "AgentNodeStats", {}, timeout=10)
                 stats["node_id"] = node["node_id"]
                 out.append(stats)
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — unreachable node: skip its stats
                 continue
         return out
 
@@ -375,7 +375,7 @@ class StateApiClient:
                 text = self._w.pool.get(tuple(node["address"])).call(
                     "AgentMetrics", {}, timeout=10)
                 out.append({"node_id": node["node_id"], "metrics": text})
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — unreachable node: skip its metrics
                 continue
         return out
 
@@ -390,7 +390,7 @@ class StateApiClient:
             try:
                 reply = self._w.pool.get(tuple(node["address"])).call(
                     "AgentStacks", {"pid": pid}, timeout=30)
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — unreachable node: skip its stacks
                 continue
             for row in reply or []:
                 row["node_id"] = node["node_id"]
@@ -410,7 +410,7 @@ class StateApiClient:
             try:
                 reply = self._w.pool.get(tuple(node["address"])).call(
                     "AgentNativeStacks", {"pid": pid}, timeout=30)
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — unreachable node: skip its native stacks
                 continue
             if reply:
                 reply["node_id"] = node["node_id"]
@@ -432,7 +432,7 @@ class StateApiClient:
                     "AgentFlightRecorder",
                     {"pid": pid, "seconds": seconds, "limit": limit},
                     timeout=15)
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — unreachable node: skip its recorder tail
                 continue
             for row in reply or []:
                 row["node_id"] = node["node_id"]
@@ -560,9 +560,22 @@ class StateApiClient:
                     continue
                 try:
                     stacks.extend(self.dump_stacks(pid=b["pid"]))
-                except Exception:  # noqa: BLE001
+                except Exception:  # noqa: BLE001 — stack dump is enrichment; the report stands without it
                     continue
             report["stacks"] = stacks
+
+        # -- 4. lock-order witness (test/chaos lanes) ---------------------
+        # when RAY_TPU_lock_witness_enabled=1 the driver's own witnessed
+        # locks have been building the acquired-while-holding graph; any
+        # recorded cycle (with both acquisition stacks) rides the hang
+        # report, so an inversion surfaces the same way a hang does
+        from ray_tpu._private.analysis import lock_witness
+
+        lw = lock_witness.report()
+        if lw.get("enabled"):
+            report["lock_witness"] = lw
+            if lw.get("cycles"):
+                report["hung"] = True
         return report
 
     # -- goodput ledger (train controller wall-clock accounting) --------
@@ -586,7 +599,7 @@ class StateApiClient:
                 import json
 
                 snap = json.loads(blob)
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — malformed snapshot row: skip it
                 continue
             name = k[len(GOODPUT_KV_PREFIX):]
             if run is not None and run not in (name, snap.get("job_id")):
@@ -636,7 +649,7 @@ class StateApiClient:
                 try:
                     conf_rows[key[len(slo_mod.SLO_CONF_KV_PREFIX):]] = (
                         json.loads(blob))
-                except Exception:  # noqa: BLE001
+                except Exception:  # noqa: BLE001 — malformed SLO conf row: skip it
                     continue
         except Exception:  # noqa: BLE001 — defaults still apply
             pass

@@ -1,0 +1,902 @@
+"""graftlint + lock-order witness (ISSUE 12).
+
+Tier-1 lanes:
+  - per-rule positive/negative fixture snippets (engine on temp files, no
+    cluster);
+  - the full-repo gate: one pass over ray_tpu/ must produce ZERO
+    non-baselined findings, the baseline must be justified + shrink-only
+    with high-severity rules EMPTY, and the pass must fit the perf budget;
+  - a synthetic violation injected into a fixture-copied module must fail
+    the gate (the gate actually gates);
+  - the dynamic lock-order witness: a seeded A->B / B->A inversion across
+    two threads is caught and named with BOTH stacks; witness-off returns
+    raw threading locks (zero added cost by construction);
+  - a chaos-style cluster run with the witness enabled proving no cycles
+    in the real raylet/gcs/worker paths, surfaced through state.diagnose().
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+from ray_tpu._private.analysis import baseline as baseline_mod
+from ray_tpu._private.analysis import lock_witness as lw
+from ray_tpu._private.analysis.engine import Engine, Severity, all_rules
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_on_snippet(tmp_path, source, rules=None, rel="ray_tpu/mod.py"):
+    """Write one fixture module under a fake repo root and lint it."""
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    eng = Engine(str(tmp_path), rules if rules is not None else all_rules())
+    return eng.run([str(path)])
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ---------------------------------------------------------------------------
+# blocking-under-lock
+# ---------------------------------------------------------------------------
+
+
+def test_blocking_under_lock_positive(tmp_path):
+    fs = run_on_snippet(tmp_path, """
+        import time
+        class C:
+            def bad(self):
+                with self._lock:
+                    time.sleep(1)
+    """)
+    assert any(f.rule == "blocking-under-lock" for f in fs)
+    f = next(f for f in fs if f.rule == "blocking-under-lock")
+    assert f.severity == Severity.HIGH
+    assert "time.sleep" in f.message and "_lock" in f.message
+
+
+def test_blocking_under_lock_rpc_names_the_method(tmp_path):
+    fs = run_on_snippet(tmp_path, """
+        class C:
+            def bad(self):
+                with self._lock:
+                    self.gcs.call("KVPut", {"k": 1})
+    """)
+    msgs = [f.message for f in fs if f.rule == "blocking-under-lock"]
+    assert msgs and 'KVPut' in msgs[0]
+
+
+def test_blocking_under_lock_helper_closure_one_level(tmp_path):
+    fs = run_on_snippet(tmp_path, """
+        class C:
+            def _flush(self):
+                self.gcs.call("KVPut", {"k": 1})
+            def finish(self):
+                with self._lock:
+                    self._flush()
+    """)
+    msgs = [f.message for f in fs if f.rule == "blocking-under-lock"]
+    assert msgs and "_flush" in msgs[0]
+
+
+def test_blocking_under_lock_negatives(tmp_path):
+    fs = run_on_snippet(tmp_path, """
+        import time
+        class C:
+            def ok_outside(self):
+                with self._lock:
+                    self.x = 1
+                time.sleep(0.1)
+            def ok_nested_def(self):
+                with self._lock:
+                    def later():
+                        time.sleep(1)
+                    self.cb = later
+            def ok_cv_wait(self):
+                with self._cv:
+                    self._cv.wait(timeout=1)
+            def ok_pragma(self):
+                with self._lock:
+                    # graftlint: allow(blocking-under-lock) — the lock IS
+                    # the spawn serializer here
+                    time.sleep(1)
+    """)
+    assert not [f for f in fs if f.rule == "blocking-under-lock"]
+
+
+# ---------------------------------------------------------------------------
+# lock-order-cycle
+# ---------------------------------------------------------------------------
+
+
+def test_lock_order_cycle_positive(tmp_path):
+    fs = run_on_snippet(tmp_path, """
+        class C:
+            def ab(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        pass
+            def ba(self):
+                with self._b_lock:
+                    with self._a_lock:
+                        pass
+    """)
+    cyc = [f for f in fs if f.rule == "lock-order-cycle"]
+    assert cyc and "_a_lock" in cyc[0].message and "_b_lock" in cyc[0].message
+    assert cyc[0].severity == Severity.HIGH
+
+
+def test_lock_order_cycle_through_helper(tmp_path):
+    fs = run_on_snippet(tmp_path, """
+        class C:
+            def take_b(self):
+                with self._b_lock:
+                    pass
+            def ab(self):
+                with self._a_lock:
+                    self.take_b()
+            def ba(self):
+                with self._b_lock:
+                    with self._a_lock:
+                        pass
+    """)
+    assert any(f.rule == "lock-order-cycle" for f in fs)
+
+
+def test_lock_order_module_scope_is_per_file(tmp_path):
+    """Free-function lock graphs are scoped per FILE: unrelated module
+    locks that merely share a name must not merge into a false cycle."""
+    a = tmp_path / "ray_tpu" / "mod_a.py"
+    a.parent.mkdir(parents=True, exist_ok=True)
+    a.write_text(textwrap.dedent("""
+        def f():
+            with _cache_lock:
+                with _push_lock:
+                    pass
+    """))
+    b = tmp_path / "ray_tpu" / "mod_b.py"
+    b.write_text(textwrap.dedent("""
+        def g():
+            with _push_lock:
+                with _cache_lock:
+                    pass
+    """))
+    eng = Engine(str(tmp_path), all_rules())
+    fs = eng.run([str(tmp_path / "ray_tpu")])
+    assert not [f for f in fs if f.rule == "lock-order-cycle"]
+
+
+def test_cli_json_stdout_is_pure_json(tmp_path, capsys):
+    import json as _json
+
+    from ray_tpu.scripts import lint
+
+    bad = tmp_path / "bad.py"
+    bad.write_text("import time\n\ndef f(l):\n    with l.some_lock:\n"
+                   "        time.sleep(1)\n")
+    rc = lint.main([str(bad), "--no-baseline", "--json"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    rows = [_json.loads(line) for line in out.splitlines() if line.strip()]
+    assert any(r.get("rule") == "blocking-under-lock" for r in rows)
+
+
+def test_lock_order_no_cycle_consistent_order(tmp_path):
+    fs = run_on_snippet(tmp_path, """
+        class C:
+            def one(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        pass
+            def two(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        pass
+        class D:  # same names in ANOTHER class: separate lockdep scope
+            def three(self):
+                with self._b_lock:
+                    with self._a_lock:
+                        pass
+    """)
+    assert not [f for f in fs if f.rule == "lock-order-cycle"]
+
+
+# ---------------------------------------------------------------------------
+# swallowed-exception
+# ---------------------------------------------------------------------------
+
+
+def test_swallowed_exception_positive(tmp_path):
+    fs = run_on_snippet(tmp_path, """
+        def f():
+            try:
+                g()
+            except Exception:  # noqa: BLE001
+                pass
+    """)
+    sw = [f for f in fs if f.rule == "swallowed-exception"]
+    assert sw and sw[0].severity == Severity.HIGH
+
+
+def test_swallowed_exception_bare_except(tmp_path):
+    fs = run_on_snippet(tmp_path, """
+        def f():
+            try:
+                g()
+            except:
+                pass
+    """)
+    assert any(f.rule == "swallowed-exception" for f in fs)
+
+
+def test_swallowed_exception_negatives(tmp_path):
+    fs = run_on_snippet(tmp_path, """
+        import logging
+        logger = logging.getLogger(__name__)
+        def reasoned():
+            try:
+                g()
+            except Exception:  # noqa: BLE001 — peer gone; its death path reaps
+                pass
+        def body_reason():
+            try:
+                g()
+            except Exception:  # noqa: BLE001
+                continue_token = None  # noqa marker above, reason here
+        def logs():
+            try:
+                g()
+            except Exception:
+                logger.warning("g failed")
+        def narrow():
+            try:
+                g()
+            except ValueError:
+                pass
+        def reraises():
+            try:
+                g()
+            except Exception:
+                raise
+    """)
+    assert not [f for f in fs if f.rule == "swallowed-exception"]
+
+
+# ---------------------------------------------------------------------------
+# thread-hygiene
+# ---------------------------------------------------------------------------
+
+
+def test_thread_hygiene(tmp_path):
+    fs = run_on_snippet(tmp_path, """
+        import threading
+        def bad():
+            threading.Thread(target=f).start()
+        def half(t):
+            threading.Thread(target=f, daemon=True).start()
+        def good():
+            threading.Thread(target=f, daemon=True, name="x-loop").start()
+    """)
+    th = [f for f in fs if f.rule == "thread-hygiene"]
+    assert len(th) == 2
+    assert "daemon=" in th[0].message and "name=" in th[0].message
+
+
+# ---------------------------------------------------------------------------
+# metric-registry-drift
+# ---------------------------------------------------------------------------
+
+_MINI_REGISTRY = """
+    from ray_tpu.util.metrics import Counter, Gauge
+
+    GOOD = Counter("ray_tpu_good_total", "recorded and registered",
+                   tag_keys=("kind",))
+    ORPHAN = Counter("ray_tpu_orphan_total", "declared, not in FAMILIES")
+    DEAD = Gauge("ray_tpu_dead", "in FAMILIES, never recorded")
+
+    FAMILIES = (GOOD, DEAD)
+
+    def inc_good(kind):
+        _bound(GOOD, kind=kind).inc()
+
+    def bad_tags(kind):
+        _bound(GOOD, wrong=kind).inc()
+"""
+
+
+def test_metric_registry_drift(tmp_path):
+    caller = tmp_path / "ray_tpu" / "caller.py"
+    caller.parent.mkdir(parents=True, exist_ok=True)
+    caller.write_text("def use():\n    inc_good('x')\n")
+    fs = run_on_snippet(tmp_path, _MINI_REGISTRY,
+                        rel="ray_tpu/_private/runtime_metrics.py")
+    eng = Engine(str(tmp_path), all_rules())
+    fs = eng.run([str(tmp_path / "ray_tpu")])
+    msgs = [f.message for f in fs if f.rule == "metric-registry-drift"]
+    assert any("ORPHAN" in m and "not listed in FAMILIES" in m for m in msgs)
+    assert any("DEAD" in m and "never-recorded" in m for m in msgs)
+    assert any("wrong" in m and "declares" in m for m in msgs), msgs
+    assert not any("GOOD" in m and "never-recorded" in m for m in msgs)
+
+
+def test_metric_family_outside_registry(tmp_path):
+    fs = run_on_snippet(tmp_path, """
+        from ray_tpu.util.metrics import Counter
+        ROGUE = Counter("ray_tpu_rogue_total", "constructed outside")
+    """)
+    msgs = [f.message for f in fs if f.rule == "metric-registry-drift"]
+    assert any("outside the registry" in m for m in msgs)
+
+
+# ---------------------------------------------------------------------------
+# config-knob-drift
+# ---------------------------------------------------------------------------
+
+_MINI_CONFIG = """
+    from dataclasses import dataclass
+
+    @dataclass
+    class RayTpuConfig:
+        real_knob: float = 1.0
+"""
+
+
+def test_config_knob_drift(tmp_path):
+    cfg = tmp_path / "ray_tpu" / "_private" / "config.py"
+    cfg.parent.mkdir(parents=True, exist_ok=True)
+    cfg.write_text(textwrap.dedent(_MINI_CONFIG))
+    fs = run_on_snippet(tmp_path, """
+        from ray_tpu._private.config import global_config
+        def ok():
+            return global_config().real_knob
+        def ok_alias():
+            cfg = global_config()
+            return cfg.real_knob
+        def bad():
+            return global_config().tpyo_knob
+        def bad_alias():
+            cfg = global_config()
+            return cfg.another_typo
+        def unrelated():
+            cfg = SomethingElse()
+            return cfg.not_a_knob_read
+    """)
+    eng = Engine(str(tmp_path), all_rules())
+    fs = eng.run([str(tmp_path / "ray_tpu")])
+    msgs = [f.message for f in fs if f.rule == "config-knob-drift"]
+    assert any("tpyo_knob" in m for m in msgs)
+    assert any("another_typo" in m for m in msgs)
+    assert not any("real_knob" in m for m in msgs)
+    assert not any("not_a_knob_read" in m for m in msgs)
+
+
+# ---------------------------------------------------------------------------
+# engine plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_bare_allow_pragma_is_a_finding(tmp_path):
+    fs = run_on_snippet(tmp_path, """
+        import time
+        class C:
+            def f(self):
+                with self._lock:
+                    # graftlint: allow(blocking-under-lock)
+                    time.sleep(1)
+    """)
+    assert any(f.rule == "bare-allow" for f in fs)
+
+
+def test_findings_sorted_and_keyed(tmp_path):
+    fs = run_on_snippet(tmp_path, """
+        import time
+        class C:
+            def f(self):
+                with self._lock:
+                    time.sleep(1)
+    """)
+    f = next(f for f in fs if f.rule == "blocking-under-lock")
+    assert f.key == f"blocking-under-lock:{f.path}:{f.line}"
+    orders = [Severity.ORDER[f.severity] for f in fs]
+    assert orders == sorted(orders)
+
+
+# ---------------------------------------------------------------------------
+# full-repo gate (the tier-1 contract)
+# ---------------------------------------------------------------------------
+
+
+def test_full_repo_gate_clean_and_fast():
+    """The whole tree lints clean against the checked-in baseline, the
+    baseline is justified + shrink-only with EMPTY high-severity rules,
+    and one full pass fits the 15 s perf budget."""
+    t0 = time.perf_counter()
+    eng = Engine(REPO_ROOT, all_rules())
+    findings = eng.run([os.path.join(REPO_ROOT, "ray_tpu")])
+    wall = time.perf_counter() - t0
+    entries = baseline_mod.load(
+        os.path.join(REPO_ROOT, baseline_mod.DEFAULT_BASELINE))
+    new, baselined, stale = baseline_mod.apply(findings, entries)
+    assert not new, "non-baselined graftlint findings:\n" + "\n".join(
+        f.render() for f in new)
+    assert not stale, f"stale baseline entries (shrink the file): {stale}"
+    assert not baseline_mod.violations(entries)
+    for key, meta in entries.items():
+        rule = meta.get("rule") or key.split(":", 1)[0]
+        assert rule not in baseline_mod.HIGH_SEVERITY_RULES
+    assert eng.files_seen, "gate ran over nothing"
+    assert wall < 15.0, f"full graftlint pass took {wall:.1f}s (budget 15s)"
+
+
+def test_gate_fails_on_synthetic_violation(tmp_path):
+    """Copy a real module, inject a blocking-under-lock + a silent swallow,
+    and prove the gate reports both as non-baselined findings."""
+    src = open(os.path.join(
+        REPO_ROOT, "ray_tpu", "_private", "log_monitor.py")).read()
+    injected = src + textwrap.dedent("""
+
+        class _SyntheticViolation:
+            def bad(self):
+                with self._lock:
+                    time.sleep(10)
+
+            def worse(self):
+                try:
+                    self.bad()
+                except Exception:  # noqa: BLE001
+                    pass
+    """)
+    mod = tmp_path / "ray_tpu" / "_private" / "log_monitor.py"
+    mod.parent.mkdir(parents=True, exist_ok=True)
+    mod.write_text(injected)
+    eng = Engine(str(tmp_path), all_rules())
+    findings = eng.run([str(mod)])
+    new, _, _ = baseline_mod.apply(findings, {})
+    got = {f.rule for f in new}
+    assert "blocking-under-lock" in got and "swallowed-exception" in got
+
+
+def test_swallowed_exception_tool_markers_do_not_suppress(tmp_path):
+    """Tool markers are instructions to tools, not written reasons: a
+    '# pragma: no cover' / '# type: ignore' / '# TODO' / too-terse
+    comment must not defeat the rule."""
+    fs = run_on_snippet(tmp_path, """
+        def a():
+            try:
+                g()
+            except Exception:  # pragma: no cover
+                pass
+        def b():
+            try:
+                g()
+            except Exception:  # type: ignore
+                pass
+        def c():
+            try:
+                g()
+            except Exception:
+                pass  # TODO
+        def d():
+            try:
+                g()
+            except Exception:  # noqa: BLE001 — fine
+                pass
+    """)
+    assert len([f for f in fs if f.rule == "swallowed-exception"]) == 4
+
+
+def test_cli_errors_on_nonexistent_path(tmp_path, capsys):
+    from ray_tpu.scripts import lint
+
+    assert lint.main([str(tmp_path / "no_such_dir")]) == 2
+
+
+def test_cli_parse_error_is_shown_not_swallowed(tmp_path, capsys):
+    """A syntax-error-only target must surface the parse-error finding
+    (exit 1), not claim 'no python files found'."""
+    from ray_tpu.scripts import lint
+
+    bad = tmp_path / "broken.py"
+    bad.write_text("def broken(:\n")
+    assert lint.main([str(bad), "--no-baseline"]) == 1
+    out = capsys.readouterr().out
+    assert "parse-error" in out
+
+
+def test_cli_update_baseline_refuses_partial_runs(tmp_path, capsys):
+    from ray_tpu.scripts import lint
+
+    mod = tmp_path / "m.py"
+    mod.write_text("x = 1\n")
+    assert lint.main([str(mod), "--update-baseline",
+                      "--baseline", str(tmp_path / "bl.json")]) == 2
+    assert not (tmp_path / "bl.json").exists()
+
+
+def test_finalize_findings_honor_allow_pragma(tmp_path):
+    """Repo-level rules emit from finalize(); their findings must still
+    respect the in-source allow() pragma at the flagged line."""
+    reg = textwrap.dedent(_MINI_REGISTRY).replace(
+        "def bad_tags(kind):\n",
+        "def bad_tags(kind):\n"
+        "    # graftlint: allow(metric-registry-drift) — intentional"
+        " alternate key set for the A/B lane\n")
+    assert "allow(metric-registry-drift)" in reg
+    caller = tmp_path / "ray_tpu" / "caller.py"
+    caller.parent.mkdir(parents=True, exist_ok=True)
+    caller.write_text("def use():\n    inc_good('x')\n")
+    reg_path = tmp_path / "ray_tpu" / "_private" / "runtime_metrics.py"
+    reg_path.parent.mkdir(parents=True, exist_ok=True)
+    reg_path.write_text(reg)
+    eng = Engine(str(tmp_path), all_rules())
+    fs = eng.run([str(tmp_path / "ray_tpu")])
+    msgs = [f.message for f in fs if f.rule == "metric-registry-drift"]
+    assert not any("wrong" in m for m in msgs), msgs
+    # un-pragma'd shapes still fire
+    assert any("ORPHAN" in m for m in msgs)
+
+
+def test_todo_justification_fails_hygiene():
+    entries = {"config-knob-drift:ray_tpu/x.py:9": {
+        "rule": "config-knob-drift", "severity": "medium",
+        "justification": "TODO: justify"}}
+    assert any("without justification" in m
+               for m in baseline_mod.violations(entries))
+
+
+def test_helper_index_ignores_closures(tmp_path):
+    """A def nested inside a method is a closure, not the class's method:
+    it must not shadow the real method during helper resolution."""
+    fs = run_on_snippet(tmp_path, """
+        import time
+        class C:
+            def helper(self):
+                self.x = 1  # harmless
+            def other(self):
+                def helper():
+                    time.sleep(1)
+                self.cb = helper
+            def locked(self):
+                with self._lock:
+                    self.helper()
+    """)
+    assert not [f for f in fs if f.rule == "blocking-under-lock"]
+
+
+def test_config_alias_scope_is_per_file(tmp_path):
+    """A module-level global_config() alias in one file must not turn an
+    unrelated `cfg` local in a LATER file into flag-table reads."""
+    a = tmp_path / "ray_tpu" / "a_first.py"
+    a.parent.mkdir(parents=True, exist_ok=True)
+    a.write_text("from ray_tpu._private.config import global_config\n"
+                 "cfg = global_config()\n")
+    b = tmp_path / "ray_tpu" / "b_second.py"
+    b.write_text("def g(f):\n"
+                 "    cfg = load_json(f)\n"
+                 "    return cfg.retries\n")
+    cfgpy = tmp_path / "ray_tpu" / "_private" / "config.py"
+    cfgpy.parent.mkdir(parents=True, exist_ok=True)
+    cfgpy.write_text(textwrap.dedent(_MINI_CONFIG))
+    eng = Engine(str(tmp_path), all_rules())
+    fs = eng.run([str(tmp_path / "ray_tpu")])
+    assert not [f for f in fs
+                if f.rule == "config-knob-drift" and "retries" in f.message]
+
+
+def test_make_entries_never_baselines_high_severity(tmp_path):
+    """No high-severity finding is baselineable — including parse-error,
+    which is high by severity but not in the named rule list."""
+    bad = tmp_path / "ray_tpu" / "broken.py"
+    bad.parent.mkdir(parents=True, exist_ok=True)
+    bad.write_text("def broken(:\n")
+    eng = Engine(str(tmp_path), all_rules())
+    findings = eng.run([str(bad)])
+    assert any(f.rule == "parse-error" and f.severity == Severity.HIGH
+               for f in findings)
+    entries = baseline_mod.make_entries(findings)
+    assert not entries, "high-severity findings must not be baselined"
+    fabricated = {"parse-error:ray_tpu/broken.py:1": {
+        "rule": "parse-error", "severity": "high", "justification": "x"}}
+    assert any("high-severity" in m
+               for m in baseline_mod.violations(fabricated))
+
+
+def test_baseline_hygiene_rules():
+    bad = {
+        "blocking-under-lock:ray_tpu/x.py:1": {
+            "rule": "blocking-under-lock", "justification": "because"},
+        "config-knob-drift:ray_tpu/y.py:2": {
+            "rule": "config-knob-drift", "justification": ""},
+    }
+    msgs = baseline_mod.violations(bad)
+    assert any("high-severity" in m for m in msgs)
+    assert any("without justification" in m for m in msgs)
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def test_cli_list_and_explain(capsys):
+    from ray_tpu.scripts import lint
+
+    assert lint.main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in ("blocking-under-lock", "lock-order-cycle",
+                "swallowed-exception", "metric-registry-drift",
+                "config-knob-drift", "thread-hygiene"):
+        assert rid in out
+    assert lint.main(["--explain", "blocking-under-lock"]) == 0
+    out = capsys.readouterr().out
+    assert "KVPut" in out  # the PR 9 story is part of the rationale
+    assert lint.main(["--explain", "nonsense-rule"]) == 2
+
+
+def test_cli_full_pass_exits_zero(capsys):
+    from ray_tpu.scripts import lint
+
+    assert lint.main([]) == 0
+    out = capsys.readouterr().out
+    assert "0 finding(s)" in out
+
+
+def test_cli_flags_violation(tmp_path, capsys):
+    from ray_tpu.scripts import lint
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        import time
+        class C:
+            def f(self):
+                with self._lock:
+                    time.sleep(1)
+    """))
+    assert lint.main([str(bad), "--no-baseline"]) == 1
+    assert "blocking-under-lock" in capsys.readouterr().out
+
+
+@pytest.mark.slow
+def test_cli_module_entrypoint():
+    p = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.lint", "--list-rules"],
+        capture_output=True, text=True, timeout=120, cwd=REPO_ROOT)
+    assert p.returncode == 0 and "blocking-under-lock" in p.stdout
+
+
+# ---------------------------------------------------------------------------
+# dynamic lock-order witness
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def witness_on():
+    from ray_tpu._private.config import global_config
+
+    cfg = global_config()
+    old = cfg.lock_witness_enabled
+    cfg.lock_witness_enabled = True
+    lw.reset_for_testing()
+    yield
+    cfg.lock_witness_enabled = old
+    lw.reset_for_testing()
+
+
+def test_import_does_not_freeze_env_overrides():
+    """Module-level make_lock() calls must NOT construct the config
+    singleton at import: a RAY_TPU_* env var set after `import ray_tpu`
+    but before init() has to still take effect (chaos injection, witness
+    enable, thresholds all rely on this)."""
+    p = subprocess.run([sys.executable, "-c", (
+        "import os, ray_tpu\n"
+        "os.environ['RAY_TPU_testing_rpc_failure'] = 'Foo=1:0.5:0.5'\n"
+        "from ray_tpu._private.config import global_config\n"
+        "assert global_config().testing_rpc_failure == 'Foo=1:0.5:0.5', \\\n"
+        "    'env override frozen at import time'\n"
+        "print('OK')\n")],
+        capture_output=True, text=True, timeout=120, cwd=REPO_ROOT,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert p.returncode == 0 and "OK" in p.stdout, p.stderr[-2000:]
+
+
+def test_engine_dedups_overlapping_paths(tmp_path):
+    mod = tmp_path / "ray_tpu" / "m.py"
+    mod.parent.mkdir(parents=True, exist_ok=True)
+    mod.write_text("import time\n\ndef f(l):\n    with l.a_lock:\n"
+                   "        time.sleep(1)\n")
+    eng = Engine(str(tmp_path), all_rules())
+    fs = eng.run([str(tmp_path / "ray_tpu"), str(mod)])
+    assert len([f for f in fs if f.rule == "blocking-under-lock"]) == 1
+    assert eng.files_seen.count("ray_tpu/m.py") == 1
+
+
+def test_witness_off_returns_raw_locks():
+    assert isinstance(lw.make_lock("x"), type(threading.Lock()))
+    assert isinstance(lw.make_rlock("x"), type(threading.RLock()))
+    assert lw.report() == {"enabled": False}
+
+
+def test_witness_catches_seeded_inversion_with_both_stacks(witness_on):
+    """The ISSUE's acceptance shape: A->B in one thread, B->A in another;
+    the cycle is named with BOTH acquisition stacks."""
+    a, b = lw.make_lock("SeedA"), lw.make_lock("SeedB")
+
+    def t1():
+        with a:
+            with b:
+                pass
+
+    def t2():
+        with b:
+            # the attempt alone forms the edge — sequence the threads so
+            # the test never actually deadlocks
+            with a:
+                pass
+
+    th1 = threading.Thread(target=t1, name="seed-ab", daemon=True)
+    th1.start()
+    th1.join(timeout=10)
+    th2 = threading.Thread(target=t2, name="seed-ba", daemon=True)
+    th2.start()
+    th2.join(timeout=10)
+
+    rep = lw.report()
+    assert rep["enabled"] and rep["cycles"], rep
+    cyc = rep["cycles"][0]
+    assert set(cyc["cycle"]) == {"SeedA", "SeedB"}
+    stacks = cyc["stacks"]
+    assert "SeedA->SeedB" in stacks and "SeedB->SeedA" in stacks
+    assert stacks["SeedA->SeedB"]["thread"] == "seed-ab"
+    assert stacks["SeedB->SeedA"]["thread"] == "seed-ba"
+    for ev in stacks.values():
+        assert ev["stack"], "cycle edge recorded without a stack"
+    # the cycle also rode the flight recorder
+    from ray_tpu._private import flight_recorder as fr
+
+    tail = fr.tail(limit=50)
+    assert any(r.get("kind") == "lock_witness" and r.get("name") == "cycle"
+               for r in tail)
+
+
+def test_witness_raises_on_cycle_when_configured(witness_on):
+    a, b = lw.make_lock("RaiseA"), lw.make_lock("RaiseB")
+    with a:
+        with b:
+            pass
+    lw.set_raise_on_cycle(True)
+    with b:
+        with pytest.raises(lw.LockCycleError) as ei:
+            a.acquire()
+        assert "RaiseA" in str(ei.value) and "RaiseB" in str(ei.value)
+    assert not a.locked(), "failed witness acquire must not leave A held"
+
+
+def test_witness_rlock_reentrancy_no_self_edge(witness_on):
+    r = lw.make_rlock("Reent")
+    with r:
+        with r:  # reentrant: no self-edge, no bookkeeping confusion
+            pass
+    rep = lw.report()
+    assert rep["cycles"] == [] and rep["edges"] == 0
+
+
+def test_witness_condition_compat(witness_on):
+    """Condition(witnessed lock) works for both variants (wait releases,
+    notify wakes, re-acquire rebooks)."""
+    for mk, name in ((lw.make_lock, "CvL"), (lw.make_rlock, "CvR")):
+        lock = mk(name)
+        cv = threading.Condition(lock)
+        hits = []
+
+        def waiter():
+            with cv:
+                cv.wait(timeout=5)
+                hits.append(1)
+
+        t = threading.Thread(target=waiter, daemon=True, name="cv-waiter")
+        t.start()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            with cv:
+                cv.notify_all()
+            if hits:
+                break
+            time.sleep(0.01)
+        t.join(timeout=5)
+        assert hits, f"Condition({name}) waiter never woke"
+    assert lw.report()["cycles"] == []
+
+
+def test_witness_trylock_books_no_edge(witness_on):
+    """A non-blocking acquire cannot deadlock, so it must not create
+    lockdep edges — Condition's default _is_owned probe is exactly such a
+    trylock, and notifying a Condition(plain witnessed Lock) while an
+    inner lock is held must not manufacture a false cycle."""
+    lock = lw.make_lock("CvOuter")
+    inner = lw.make_lock("CvInner")
+    cv = threading.Condition(lock)
+    with lock:
+        with inner:
+            cv.notify_all()  # _is_owned -> lock.acquire(False) under inner
+    rep = lw.report()
+    assert rep["cycles"] == [], [c["cycle"] for c in rep["cycles"]]
+    # explicit trylock while holding another lock: also edge-free
+    with inner:
+        assert not lock.locked()
+        got = lock.acquire(blocking=False)
+        assert got
+        lock.release()
+    assert lw.report()["cycles"] == []
+
+
+def test_witness_ordered_nesting_is_clean(witness_on):
+    a, b = lw.make_lock("OrdA"), lw.make_lock("OrdB")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    rep = lw.report()
+    assert rep["edges"] == 1 and rep["cycles"] == []
+
+
+# ---------------------------------------------------------------------------
+# chaos lane: witness on over the real raylet/gcs/worker paths
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.timeout(300)
+def test_witness_no_cycles_in_real_cluster_paths(witness_on, monkeypatch):
+    """Run a real single-node cluster (GCS + raylet + core worker in this
+    process, witnessed locks everywhere make_lock is wired) through task,
+    actor and object traffic; the witness must observe a healthy
+    acquisition graph — zero cycles — and diagnose() must carry the
+    section."""
+    monkeypatch.setenv("RAY_TPU_lock_witness_enabled", "1")
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=2)
+    try:
+        @ray_tpu.remote
+        def f(x):
+            return x + 1
+
+        assert ray_tpu.get([f.remote(i) for i in range(20)]) == \
+            list(range(1, 21))
+
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def bump(self):
+                self.n += 1
+                return self.n
+
+        c = Counter.remote()
+        assert ray_tpu.get([c.bump.remote() for _ in range(10)])[-1] == 10
+
+        oid = ray_tpu.put(b"x" * 200_000)  # plasma path
+        assert len(ray_tpu.get(oid)) == 200_000
+
+        rep = lw.report()
+        assert rep["enabled"]
+        assert rep["acquisitions"] > 0, "witness saw no lock traffic"
+        assert rep["cycles"] == [], (
+            "lock-order cycle in real runtime paths: "
+            f"{[c['cycle'] for c in rep['cycles']]}")
+
+        from ray_tpu.util import state
+
+        diag = state.diagnose(hang_timeout_s=5.0, include_stacks=False)
+        assert diag.get("lock_witness", {}).get("enabled") is True
+        assert diag["lock_witness"]["cycles"] == []
+    finally:
+        ray_tpu.shutdown()
