@@ -1113,6 +1113,116 @@ def _bench_core_perf() -> dict:
         return {"error": str(e)[:200]}
 
 
+_DATA_INGEST_SCRIPT = r"""
+import json, os, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["RAY_TPU_DISABLE_METADATA_SERVER"] = "1"
+os.environ.setdefault("RAY_TPU_WORKER_QUIET", "1")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+import ray_tpu
+import ray_tpu.data as rd
+from ray_tpu.data._internal.ingest import DataShard
+from ray_tpu._private import runtime_metrics as _rm
+from ray_tpu.train._internal.goodput import GoodputLedger
+
+ray_tpu.init(num_cpus=4)
+
+COLS = 1024
+BLOCK_ROWS = 2 * 1024 * 1024  # 8 MiB float32 per block (1-D rows)
+BLOCKS = 8                    # 64 MiB per epoch
+BATCH = 256 * 1024            # divides BLOCK_ROWS: zero-copy slices only
+
+def make_ds():
+    return rd.range(BLOCKS, parallelism=BLOCKS).map_batches(
+        lambda b: {"x": np.ones(BLOCK_ROWS, np.float32)}, batch_size=None)
+
+# a step heavy enough to dominate the producer leg (as a real train step
+# does): ~6 GFLOP per batch.  The XLA matmuls release the GIL, so the
+# prefetch thread's block resolution + device_put genuinely overlap the
+# step even on CPU hosts.
+w = jnp.ones((COLS, 4096), jnp.float32)
+
+def step(batch):
+    x = batch["x"].reshape(-1, COLS)
+    for _ in range(3):
+        acc = (x @ w).sum()
+    acc.block_until_ready()
+
+RAMP = 4  # first batches wait on plan spin-up; steady state starts after
+
+def consume(prefetch_on):
+    (split,) = make_ds().streaming_split(1, equal=True)
+    shard = DataShard(split, name="bench", drain_probe=lambda: False)
+    led = GoodputLedger("bench_data_ingest" + ("_on" if prefetch_on else "_off"))
+    led.start("restore")
+    rows = 0
+    it = shard.iter_jax_batches(
+        batch_size=BATCH, drop_last=True,
+        prefetch_batches=2 if prefetch_on else 0)
+    led.mark("productive_step")
+    wall0 = time.perf_counter()
+    ramp_wait = ramp_wall = 0.0
+    for i, batch in enumerate(it):
+        step(batch)
+        rows += batch["x"].shape[0]
+        if i + 1 == RAMP:
+            ramp_wait = shard.wait_seconds()
+            ramp_wall = time.perf_counter() - wall0
+    wall = time.perf_counter() - wall0
+    led.stop()  # accrue the loop into productive_step BEFORE carving
+    led.reclassify("productive_step", "input_wait", shard.wait_seconds())
+    snap = led.snapshot()
+    steady_wait = shard.wait_seconds() - ramp_wait
+    steady_wall = wall - ramp_wall
+    return {
+        "rows": rows,
+        "rows_per_sec": round(rows / wall, 1),
+        "bytes_per_sec": round(rows * 4 / wall, 1),
+        "wall_s": round(wall, 3),
+        "input_wait_s": round(shard.wait_seconds(), 4),
+        "input_wait_fraction": round(
+            snap["buckets_s"]["input_wait"] / max(snap["wall_clock_s"], 1e-9), 5),
+        "input_wait_fraction_steady": round(
+            steady_wait / max(steady_wall, 1e-9), 5),
+        "ledger_buckets_s": {k: round(v, 4)
+                             for k, v in snap["buckets_s"].items()},
+    }
+
+out = {}
+consume(True)  # warm: spawn workers, compile the step
+out["prefetch_on"] = consume(True)
+out["prefetch_off"] = consume(False)
+on, off = out["prefetch_on"], out["prefetch_off"]
+out["prefetch_speedup_x"] = round(
+    on["rows_per_sec"] / max(off["rows_per_sec"], 1e-9), 3)
+out["ingest"] = _rm.ingest_snapshot()
+ray_tpu.shutdown()
+print("DATA_INGEST " + json.dumps(out))
+"""
+
+
+def _bench_data_ingest() -> dict:
+    """Streaming data plane end-to-end (ISSUE 13): a synthetic fat-column
+    stream flows datasource -> plasma blocks -> zero-copy host views ->
+    double-buffered device prefetch, consumed by a jitted "step" under a
+    real goodput ledger.  Reports rows/s, bytes/s, the ledger's bucket
+    split (input_wait from MEASURED buffer-empty waits), the prefetch
+    on/off A/B, and the process ingest counters (view vs copied bytes,
+    backpressure events).  Subprocess for the same reason as core_perf."""
+    try:
+        p = subprocess.run([sys.executable, "-c", _DATA_INGEST_SCRIPT],
+                           capture_output=True, text=True, timeout=420)
+        for line in p.stdout.splitlines():
+            if line.startswith("DATA_INGEST "):
+                return json.loads(line[len("DATA_INGEST "):])
+        return {"error": (p.stdout + p.stderr)[-300:]}
+    except Exception as e:  # noqa: BLE001
+        return {"error": str(e)[:200]}
+
+
 def _bench_control_plane() -> dict:
     """GCS<->raylet sync + pubsub fan-out cost vs cluster size (ISSUE 8):
     in-process mega-cluster harness (real GCS, skeleton raylets) at
@@ -1242,6 +1352,17 @@ def _kv_handoff_snapshot() -> dict:
         from ray_tpu._private import runtime_metrics
 
         return runtime_metrics.kv_handoff_snapshot()
+    except Exception as e:  # noqa: BLE001
+        return {"error": str(e)[:200]}
+
+
+def _ingest_snapshot() -> dict:
+    """Data-plane ingest counters recorded in THIS process (rows, view vs
+    copied bytes, buffer-empty waits, backpressure events)."""
+    try:
+        from ray_tpu._private import runtime_metrics
+
+        return runtime_metrics.ingest_snapshot()
     except Exception as e:  # noqa: BLE001
         return {"error": str(e)[:200]}
 
@@ -1446,6 +1567,7 @@ def main():
         ("serving", lambda: _bench_serving(on_tpu), 900.0),
         ("serving_disagg", lambda: _bench_serving_disagg(on_tpu), 900.0),
         ("core_perf", _bench_core_perf, 600.0),
+        ("data_ingest", _bench_data_ingest, 600.0),
         ("control_plane", _bench_control_plane, 600.0),
         ("dryrun_8b", _dryrun_8b, 900.0),
     )
@@ -1468,6 +1590,7 @@ def main():
         "collective_plan": _plan_snapshot(),
         "trace_summary": _trace_summary_snapshot(),
         "goodput": _goodput_snapshot(),
+        "ingest": _ingest_snapshot(),
         "prefix_cache": _prefix_cache_snapshot(),
         "kv_handoff": _kv_handoff_snapshot(),
         "specdec": _specdec_snapshot(),

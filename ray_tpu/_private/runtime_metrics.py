@@ -399,6 +399,40 @@ DATA_BACKPRESSURE = Counter(
     "ray_tpu_data_backpressure_total",
     "Dispatches deferred by the per-operator memory budget",
     tag_keys=("operator",))
+# train-ingest data plane (data/_internal/ingest.py + the streaming-split
+# coordinator): the datasource -> plasma -> host-view -> device pipeline
+# feeding the trainer.  kind on the bytes counter distinguishes zero-copy
+# views over plasma buffers from host memcpys (ragged batch boundaries,
+# null/bit-packed columns) — the zero-copy invariant is perf-smoke-gated
+# on the copy side staying at zero for aligned fixed-dtype streams.
+DATA_INGEST_ROWS = Counter(
+    "ray_tpu_data_ingest_rows_total",
+    "Rows delivered to a consumer by the ingest iterators (rate() = "
+    "ingest rows/s)",
+    tag_keys=("source",))
+DATA_INGEST_BYTES = Counter(
+    "ray_tpu_data_ingest_bytes_total",
+    "Host-batch bytes delivered by the ingest iterators, split by kind: "
+    "view = numpy views aliasing plasma shared memory (zero-copy), "
+    "copy = host memcpys (ragged batch boundaries, chunked/null columns)",
+    tag_keys=("source", "kind"))
+DATA_INGEST_BUFFER = Gauge(
+    "ray_tpu_data_ingest_buffer_occupancy",
+    "Prefetch buffer occupancy per pipeline stage (host = decoded host "
+    "batches, device = device-resident batches awaiting hand-off)",
+    tag_keys=("stage",))
+DATA_INGEST_BACKPRESSURE = Counter(
+    "ray_tpu_data_ingest_backpressure_total",
+    "Ingest backpressure events: split = the streaming-split coordinator "
+    "parked a producer pull because a consumer's buffer hit its cap, "
+    "host/device = a full prefetch buffer parked the producer thread",
+    tag_keys=("stage",))
+DATA_INGEST_WAIT = Counter(
+    "ray_tpu_data_ingest_wait_seconds_total",
+    "Seconds a consumer spent blocked on an EMPTY ingest buffer (real "
+    "buffer-empty waits; the source of the goodput ledger's input_wait "
+    "bucket)",
+    tag_keys=("source",))
 
 FAMILIES = (
     SCHEDULE_LATENCY, PENDING_TASKS, SPILLBACKS,
@@ -428,6 +462,8 @@ FAMILIES = (
     SERVE_SLO_REQUESTS, SERVE_SLO_BURN_RATE,
     SERVE_SPECDEC_PROPOSED, SERVE_SPECDEC_ACCEPTED,
     DATA_ROWS, DATA_BACKPRESSURE,
+    DATA_INGEST_ROWS, DATA_INGEST_BYTES, DATA_INGEST_BUFFER,
+    DATA_INGEST_BACKPRESSURE, DATA_INGEST_WAIT,
 )
 
 # ---------------------------------------------------------------------------
@@ -963,6 +999,48 @@ def add_data_rows(operator: str, n: int) -> None:
 
 def inc_data_backpressure(operator: str) -> None:
     _bound(DATA_BACKPRESSURE, operator=operator).inc()
+
+
+def add_ingest_rows(source: str, n: int) -> None:
+    if n > 0:
+        _bound(DATA_INGEST_ROWS, source=source).inc(n)
+
+
+def add_ingest_bytes(source: str, kind: str, n: int) -> None:
+    if n > 0:
+        _bound(DATA_INGEST_BYTES, source=source, kind=kind).inc(n)
+
+
+def set_ingest_buffer(stage: str, n: int) -> None:
+    _bound(DATA_INGEST_BUFFER, stage=stage).set(n)
+
+
+def inc_ingest_backpressure(stage: str) -> None:
+    _bound(DATA_INGEST_BACKPRESSURE, stage=stage).inc()
+
+
+def add_ingest_wait(source: str, seconds: float) -> None:
+    if seconds > 0:
+        _bound(DATA_INGEST_WAIT, source=source).inc(seconds)
+
+
+def ingest_snapshot() -> dict:
+    """Process-local data-plane accounting for bench.py and the perf
+    gates: ingest rows, view vs copied bytes per source, buffer-empty
+    wait seconds, and backpressure event counts.  Hermetic — this
+    process's counters only."""
+    out: dict = {"rows": {}, "bytes": {}, "wait_s": {}, "backpressure": {}}
+    for tags_key, v in dict(DATA_INGEST_ROWS._points).items():
+        out["rows"][dict(tags_key).get("source", "?")] = v
+    for tags_key, v in dict(DATA_INGEST_BYTES._points).items():
+        t = dict(tags_key)
+        d = out["bytes"].setdefault(t.get("source", "?"), {})
+        d[t.get("kind", "?")] = d.get(t.get("kind", "?"), 0.0) + v
+    for tags_key, v in dict(DATA_INGEST_WAIT._points).items():
+        out["wait_s"][dict(tags_key).get("source", "?")] = v
+    for tags_key, v in dict(DATA_INGEST_BACKPRESSURE._points).items():
+        out["backpressure"][dict(tags_key).get("stage", "?")] = v
+    return out
 
 
 # ---------------------------------------------------------------------------

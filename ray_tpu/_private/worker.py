@@ -767,6 +767,16 @@ class CoreWorker:
         round-trip (PlasmaGetBatch) — ``ray_tpu.get(list)`` of N local
         plasma objects used to pay N PlasmaGet calls.  Objects not local
         (or inline) fall through to the per-object path."""
+        return self.resolve_plasma_batch(refs, min_batch=2)
+
+    def resolve_plasma_batch(self, refs, min_batch: int = 1):
+        """The data plane's zero-copy view path: resolve every locally-
+        sealed plasma object among ``refs`` in ONE raylet round-trip
+        (PlasmaGetBatch), returning ``{ObjectID: value}`` or None.  Values
+        reconstruct as protocol-5 buffer views over the store's shared
+        memory — numpy/Arrow payloads alias the mapping, no host copy.
+        Objects not yet local or sealed are simply absent from the result;
+        callers fall back to the ordinary per-object get for those."""
         with self._store_lock:
             # only objects with a KNOWN plasma location (or borrowed refs,
             # which may be plasma) are worth a batch probe — owned tasks
@@ -778,7 +788,7 @@ class CoreWorker:
                     and (self.object_locations.get(r.id)
                          or (r.owner_addr is not None
                              and r.owner_addr != self.address))]
-        if len(want) < 2:
+        if len(want) < min_batch:
             return None
         try:
             resolved = self.plasma.get_batch(want)

@@ -1,0 +1,548 @@
+"""Train-ingest data plane: datasource -> plasma -> host views -> device.
+
+The high-throughput path that feeds a training step at device speed
+(ROADMAP item 4; the input-stall goodput tax of arxiv 2510.20171):
+
+  - **windowed block resolution** (`resolved_blocks`): a small ref
+    lookahead resolves every locally-sealed plasma block in ONE raylet
+    round-trip (the PlasmaGetBatch path from the lease fast-path PR);
+    resolved blocks are Arrow tables whose buffers ALIAS the store's
+    shared memory (protocol-5 out-of-band reconstruction), so host
+    batches are numpy views — no pickle of the payload, no memcpy.
+  - **host prefetch with honest wait stamping** (`HostPrefetcher`): a
+    named producer thread keeps a bounded buffer of decoded host batches;
+    the consumer's buffer-EMPTY seconds are measured with an injectable
+    clock and surfaced (``ray_tpu_data_ingest_wait_seconds_total`` +
+    the per-session ``input_wait_s`` the goodput ledger reclassifies).
+  - **double-buffered device prefetch** (`DevicePrefetcher`): batch N+1's
+    ``device_put``/reshard runs on the prefetch thread while the caller
+    steps on batch N; the staged hand-off passes the batch through a
+    jitted ``jax.lax.optimization_barrier`` identity with the INPUT
+    donated, so the staging buffers are reused instead of doubling
+    footprint (the same barrier staging the overlapped-grad-sync PR
+    proved out).
+  - **DataShard**: the per-worker wrapper ``session.get_dataset_shard``
+    returns — iterators feed the double buffer, stamp ``input_wait_s``
+    from real buffer-empty waits into the session, and release their
+    remaining blocks back to the streaming-split coordinator when the
+    host's preemption drain fires (elastic re-shard: survivors take over
+    the drained consumer's assignment, no row lost or duplicated).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, Iterable, Iterator, Optional
+
+from ray_tpu._private import runtime_metrics
+
+MASK_COLUMN = "mask"
+
+_PARTIAL_BATCH_MODES = ("error", "pad", "drop")
+
+
+# ---------------------------------------------------------------------------
+# Windowed zero-copy block resolution
+# ---------------------------------------------------------------------------
+
+def resolved_blocks(ref_iter: Iterable[Any], window: int = 4) -> Iterator[Any]:
+    """Yield blocks for ``ref_iter`` in order, resolving locally-sealed
+    plasma objects across a ``window``-ref lookahead in one raylet
+    round-trip.  The head ref, when not yet local, falls back to the
+    ordinary (blocking) get — later sealed refs in the window still ride
+    the batch, so a straggler producer never serializes the whole
+    window behind per-object RPCs."""
+    from collections import deque
+
+    import ray_tpu
+    from ray_tpu._private.worker import get_global_worker
+
+    if window is None or window <= 1:
+        for ref in ref_iter:
+            yield ray_tpu.get(ref)
+        return
+    it = iter(ref_iter)
+    pend: deque = deque()
+    ready: Dict[Any, Any] = {}
+    done = False
+    while True:
+        while not done and len(pend) < window:
+            try:
+                pend.append(next(it))
+            except StopIteration:
+                done = True
+        if not pend:
+            return
+        head = pend[0]
+        if head.id not in ready:
+            w = get_global_worker()
+            resolved = None
+            if w is not None:
+                try:
+                    resolved = w.resolve_plasma_batch(
+                        [r for r in pend if r.id not in ready])
+                except Exception:  # noqa: BLE001 — view fast path only; the per-object get below is authoritative
+                    resolved = None
+            if resolved:
+                ready.update(resolved)
+        if head.id in ready:
+            value = ready.pop(head.id)
+        else:
+            value = ray_tpu.get(head)
+        pend.popleft()
+        yield value
+
+
+# ---------------------------------------------------------------------------
+# Host-side prefetch with buffer-empty wait stamping
+# ---------------------------------------------------------------------------
+
+class HostPrefetcher:
+    """Bounded background producer + wait-stamped consumer.
+
+    The producer thread pumps ``gen`` into a ``depth``-bounded queue; the
+    consumer measures every second it spends blocked on an EMPTY buffer
+    (the honest definition of input wait — time the training loop wanted
+    data and none was staged).  ``on_wait`` receives each wait interval;
+    ``wait_seconds()`` is the running total.  Errors re-raise at the
+    consumer; closing/abandoning the iterator stops the producer."""
+
+    _END = object()
+
+    def __init__(self, gen: Iterable[Any], depth: int = 2, *,
+                 source: str = "ingest",
+                 clock: Callable[[], float] = time.perf_counter,
+                 on_wait: Optional[Callable[[float], None]] = None,
+                 stage: str = "host"):
+        self._gen = gen
+        self._depth = max(1, depth)
+        self._source = source
+        self._clock = clock
+        self._on_wait = on_wait
+        self._stage = stage
+        self._q: queue.Queue = queue.Queue(maxsize=self._depth)
+        self._stop = threading.Event()
+        self._wait_s = 0.0
+        self._waits = 0
+        self._thread = threading.Thread(
+            target=self._pump, daemon=True,
+            name=f"ray_tpu-data-ingest-{stage}")
+        self._thread.start()
+
+    def wait_seconds(self) -> float:
+        return self._wait_s
+
+    def wait_events(self) -> int:
+        return self._waits
+
+    def close(self) -> None:
+        self._stop.set()
+
+    def _put(self, item) -> bool:
+        parked = False
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                if not parked:
+                    parked = True
+                    runtime_metrics.inc_ingest_backpressure(self._stage)
+                continue
+        return False  # consumer abandoned the iterator
+
+    def _pump(self) -> None:
+        try:
+            for item in self._gen:
+                if not self._put(item):
+                    close = getattr(self._gen, "close", None)
+                    if close is not None:
+                        close()
+                    return
+            self._put(self._END)
+        except BaseException as e:  # noqa: BLE001 — surface at the consumer
+            self._put(e)
+
+    def __iter__(self):
+        try:
+            while True:
+                try:
+                    item = self._q.get_nowait()
+                except queue.Empty:
+                    t0 = self._clock()
+                    item = self._q.get()
+                    dt = self._clock() - t0
+                    if dt > 0:
+                        self._wait_s += dt
+                        self._waits += 1
+                        runtime_metrics.add_ingest_wait(self._source, dt)
+                        if self._on_wait is not None:
+                            self._on_wait(dt)
+                runtime_metrics.set_ingest_buffer(self._stage, self._q.qsize())
+                if item is self._END:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            self._stop.set()
+
+
+# ---------------------------------------------------------------------------
+# Partial-batch policy (the ragged-final-batch fix)
+# ---------------------------------------------------------------------------
+
+def apply_partial_batch(batch: Dict[str, Any], batch_size: Optional[int],
+                        partial_batch: str) -> Optional[Dict[str, Any]]:
+    """Resolve a final batch shorter than ``batch_size``:
+
+    - ``"error"``: return it unchanged (a sharding mismatch downstream
+      raises, today's behavior);
+    - ``"drop"``: return None (caller skips it);
+    - ``"pad"``: zero-pad every column to ``batch_size`` rows and add a
+      float32 ``mask`` column (1.0 = real row, 0.0 = padding) so loss
+      masking stays exact.
+    """
+    import numpy as np
+
+    if partial_batch not in _PARTIAL_BATCH_MODES:
+        raise ValueError(
+            f"partial_batch must be one of {_PARTIAL_BATCH_MODES}, "
+            f"got {partial_batch!r}")
+    if batch_size is None or not batch:
+        return batch
+    rows = len(next(iter(batch.values())))
+    if rows >= batch_size or partial_batch == "error":
+        return batch
+    if partial_batch == "drop":
+        return None
+    if MASK_COLUMN in batch:
+        raise ValueError(
+            f"partial_batch='pad' adds a {MASK_COLUMN!r} column but the "
+            "batch already has one — rename it or use drop_last")
+    out: Dict[str, Any] = {}
+    pad_rows = batch_size - rows
+    for name, col in batch.items():
+        arr = np.asarray(col)
+        pad = np.zeros((pad_rows,) + arr.shape[1:], dtype=arr.dtype)
+        out[name] = np.concatenate([arr, pad], axis=0)
+        runtime_metrics.add_ingest_bytes("partial_pad", "copy", arr.nbytes)
+    mask = np.zeros(batch_size, dtype=np.float32)
+    mask[:rows] = 1.0
+    out[MASK_COLUMN] = mask
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Double-buffered device prefetch
+# ---------------------------------------------------------------------------
+
+_stage_lock = threading.Lock()
+_staged_barrier = None  # jitted donating optimization_barrier identity
+_stage_disabled = False
+
+
+def _stage_on_device(dev_batch):
+    """Pass the freshly-transferred batch through a jitted
+    ``optimization_barrier`` identity with the input DONATED: XLA gets an
+    explicit staging boundary for the transfer and may alias the staging
+    buffers into the hand-off instead of holding both.  CPU backends
+    ignore donation — skip there (and on any refusal) rather than warn
+    per batch."""
+    global _staged_barrier, _stage_disabled
+    import jax
+
+    if _stage_disabled:
+        return dev_batch
+    try:
+        if jax.default_backend() == "cpu":
+            _stage_disabled = True
+            return dev_batch
+        with _stage_lock:
+            if _staged_barrier is None:
+                _staged_barrier = jax.jit(
+                    lambda b: jax.lax.optimization_barrier(b),
+                    donate_argnums=0)
+        return _staged_barrier(dev_batch)
+    except Exception:  # noqa: BLE001 — staging is an optimization; the raw device_put result is correct
+        _stage_disabled = True
+        return dev_batch
+
+
+class DeviceStager:
+    """Casts + ``device_put`` + staged barrier hand-off for one batch
+    (the per-batch transfer leg, shared by the overlapped and the
+    synchronous paths)."""
+
+    def __init__(self, target: Any, *, dtypes: Optional[Dict[str, Any]] = None,
+                 sharding: Any = None):
+        self._dtypes = dtypes
+        self._target = target
+        self._sharding = sharding
+
+    def to_device(self, host: Dict[str, Any]):
+        import jax
+        import numpy as np
+
+        if self._dtypes:
+            # copy=False: a column already at the target dtype stays a
+            # zero-copy view instead of paying a host memcpy per batch
+            host = {
+                name: (np.asarray(col).astype(self._dtypes[name], copy=False)
+                       if name in self._dtypes else col)
+                for name, col in host.items()
+            }
+        try:
+            dev = jax.device_put(host, self._target)
+        except ValueError as e:
+            if self._sharding is None:
+                raise
+            n = len(next(iter(host.values()))) if host else 0
+            raise ValueError(
+                f"batch of {n} rows does not fit the requested sharding "
+                f"(ragged final batch? pass drop_last=True, "
+                f"partial_batch='pad'|'drop', or a batch_size dividing "
+                f"the row count): {e}") from e
+        return _stage_on_device(dev)
+
+
+def staged_batches(host_iter: Iterable[Dict[str, Any]], stager: DeviceStager,
+                   batch_size: Optional[int], partial_batch: str):
+    """Host batches -> partial-batch policy -> staged device batches (the
+    one consume loop shared by the overlapped and synchronous paths)."""
+    for host in host_iter:
+        batch = apply_partial_batch(host, batch_size, partial_batch)
+        if batch is None:  # partial_batch="drop"
+            continue
+        yield stager.to_device(batch)
+
+
+class DevicePrefetcher:
+    """Double-buffered device-side prefetch over a host-batch iterator.
+
+    The producer thread runs ``device_put`` (plus dtype casts and the
+    staged barrier hand-off) for batch N+1 while the caller steps on
+    batch N — the classic TPU input-pipeline overlap.  ``depth`` bounds
+    the device-resident batches (2 = double buffering).  NOTE: the
+    prefetch thread starts at construction — wrap in a generator to stay
+    lazy (the iter_jax_batches entry points do)."""
+
+    def __init__(self, host_iter: Iterable[Dict[str, Any]], target: Any, *,
+                 dtypes: Optional[Dict[str, Any]] = None,
+                 depth: int = 2,
+                 batch_size: Optional[int] = None,
+                 partial_batch: str = "error",
+                 source: str = "ingest",
+                 clock: Callable[[], float] = time.perf_counter,
+                 on_wait: Optional[Callable[[float], None]] = None,
+                 sharding: Any = None):
+        stager = DeviceStager(target, dtypes=dtypes, sharding=sharding)
+        self._prefetch = HostPrefetcher(
+            staged_batches(host_iter, stager, batch_size, partial_batch),
+            depth=max(1, depth),
+            source=source, clock=clock, on_wait=on_wait, stage="device")
+
+    def wait_seconds(self) -> float:
+        return self._prefetch.wait_seconds()
+
+    def __iter__(self):
+        return iter(self._prefetch)
+
+    def close(self) -> None:
+        self._prefetch.close()
+
+
+# ---------------------------------------------------------------------------
+# The per-worker train shard
+# ---------------------------------------------------------------------------
+
+def _default_drain_probe() -> Callable[[], bool]:
+    """True once this host announced a preemption/maintenance drain
+    (PR 4's lifecycle; the runtime context caches the raylet poll ~1s)."""
+    def probe() -> bool:
+        try:
+            import ray_tpu
+
+            return ray_tpu.get_runtime_context().preemption_deadline() \
+                is not None
+        except Exception:  # noqa: BLE001 — clusterless unit contexts have no drain source
+            return False
+    return probe
+
+
+class DataShard:
+    """What ``session.get_dataset_shard`` hands the training loop.
+
+    Wraps a streaming-split consumer (or any shard exposing
+    ``iter_batches``): iterators resolve blocks through the zero-copy
+    window, prefetch on named threads, stamp real buffer-empty waits into
+    the owning session's ``input_wait_s`` (the goodput ledger carves that
+    into the ``input_wait`` bucket), and — when the host's preemption
+    drain fires mid-epoch — hand the shard's remaining blocks back to
+    the coordinator so surviving consumers finish the epoch with every
+    row delivered exactly once."""
+
+    def __init__(self, shard: Any, *, name: str = "train",
+                 session: Any = None,
+                 drain_probe: Optional[Callable[[], bool]] = None,
+                 clock: Callable[[], float] = time.perf_counter):
+        self._shard = shard
+        self._name = name
+        self._session = session
+        self._drain_probe = (drain_probe if drain_probe is not None
+                             else _default_drain_probe())
+        self._clock = clock
+        self._wait_s = 0.0
+        self.drained = False
+
+    # everything we don't wrap (count, schema, iter_rows, ...) passes through
+    def __getattr__(self, item):
+        return getattr(self._shard, item)
+
+    def wait_seconds(self) -> float:
+        return self._wait_s
+
+    def _note_wait(self, dt: float) -> None:
+        self._wait_s += dt
+        if self._session is not None:
+            try:
+                self._session.note_input_wait(dt)
+            except Exception:  # noqa: BLE001 — wait stamping is telemetry; ingestion continues
+                pass
+
+    def _block_iter(self):
+        """Ref->block stream with the drain hook: when the probe fires,
+        the CURRENT (unresolved) ref and everything the coordinator still
+        holds for this consumer are reassigned to survivors; in-flight
+        resolved blocks drain to the caller, so rows are delivered exactly
+        once across the gang."""
+        from ray_tpu.data.context import DataContext
+
+        ctx = getattr(self._shard, "_ctx", None) or DataContext.get_current()
+        window = ctx.ingest_resolve_window
+        release = getattr(self._shard, "release", None)
+        probe = self._drain_probe
+
+        def refs():
+            it = self._shard.iter_blocks()
+            try:
+                for ref in it:
+                    if probe is not None and probe():
+                        self.drained = True
+                        if release is not None:
+                            release([ref])
+                        return
+                    yield ref
+            finally:
+                close = getattr(it, "close", None)
+                if close is not None:
+                    close()
+
+        yield from resolved_blocks(refs(), window=window)
+
+    def _host_iter(self, batch_size, batch_format, drop_last):
+        """Raw host-batch generator — NO wait stamping (production time
+        here may be overlapped by a downstream prefetch thread; only
+        consumer-side buffer-empty time is input wait)."""
+        from ray_tpu.data.context import DataContext
+        from ray_tpu.data.dataset import _batches_over_blocks
+
+        ctx = getattr(self._shard, "_ctx", None) or DataContext.get_current()
+        batch_format = batch_format or ctx.default_batch_format
+        if hasattr(self._shard, "iter_blocks"):
+            return _batches_over_blocks(
+                self._block_iter(), batch_size, batch_format, drop_last,
+                source=self._name)
+        # plain Dataset shard: its own iterator already resolves refs
+        return self._shard.iter_batches(
+            batch_size=batch_size, batch_format=batch_format,
+            drop_last=drop_last,
+            **({"prefetch_batches": 0}
+               if "prefetch_batches" in _kwargs_of(
+                   self._shard.iter_batches) else {}))
+
+    def iter_batches(self, *, batch_size: Optional[int] = 256,
+                     batch_format: Optional[str] = None,
+                     drop_last: bool = False,
+                     prefetch_batches: int = 2) -> Iterator[Any]:
+        if prefetch_batches and prefetch_batches > 0:
+            def lazy():  # nothing (plan execution included) runs pre-next()
+                gen = self._host_iter(batch_size, batch_format, drop_last)
+                yield from HostPrefetcher(
+                    gen, depth=prefetch_batches, source=self._name,
+                    clock=self._clock, on_wait=self._note_wait,
+                    stage="host")
+            return lazy()
+        # synchronous: there is no overlap, so time spent producing the
+        # next batch IS starvation — stamp it
+        gen = self._host_iter(batch_size, batch_format, drop_last)
+        return _waited_iter(gen, self._clock, self._note_wait, self._name)
+
+    def iter_jax_batches(self, *, batch_size: Optional[int] = 256,
+                         drop_last: bool = False,
+                         dtypes: Optional[Dict[str, Any]] = None,
+                         sharding: Any = None, device: Any = None,
+                         partial_batch: str = "error",
+                         prefetch_batches: Optional[int] = None
+                         ) -> Iterator[Dict[str, Any]]:
+        """Device-resident batches through the double buffer: the next
+        batch's transfer overlaps the caller's step; buffer-empty waits
+        land in the session's ``input_wait_s``."""
+        from ray_tpu.data.context import DataContext
+
+        if sharding is not None and device is not None:
+            raise ValueError("pass sharding or device, not both")
+        ctx = getattr(self._shard, "_ctx", None) or DataContext.get_current()
+        depth = (getattr(ctx, "device_prefetch_depth", 2)
+                 if prefetch_batches is None else prefetch_batches)
+        target = sharding if sharding is not None else device
+        if depth and depth > 0:
+            def lazy():
+                # the raw host gen feeds the device thread; only the
+                # CONSUMER's device-buffer-empty time is input wait
+                host = self._host_iter(batch_size, "numpy", drop_last)
+                yield from DevicePrefetcher(
+                    host, target, dtypes=dtypes, depth=depth,
+                    batch_size=batch_size, partial_batch=partial_batch,
+                    source=self._name, clock=self._clock,
+                    on_wait=self._note_wait, sharding=sharding)
+            return lazy()
+
+        # synchronous fallback (prefetch 0): no overlap — production time
+        # is starvation, stamped by the iter_batches(prefetch 0) path
+        def sync_gen():
+            host = self.iter_batches(
+                batch_size=batch_size, batch_format="numpy",
+                drop_last=drop_last, prefetch_batches=0)
+            stager = DeviceStager(target, dtypes=dtypes, sharding=sharding)
+            yield from staged_batches(host, stager, batch_size,
+                                      partial_batch)
+        return sync_gen()
+
+
+def _kwargs_of(fn) -> set:
+    import inspect
+
+    try:
+        return set(inspect.signature(fn).parameters)
+    except (TypeError, ValueError):
+        return set()
+
+
+def _waited_iter(gen, clock, on_wait, source):
+    """Unprefetched iterator that still stamps time blocked in the
+    upstream generator as input wait (prefetch_batches=0 path)."""
+    it = iter(gen)
+    while True:
+        t0 = clock()
+        try:
+            item = next(it)
+        except StopIteration:
+            return
+        dt = clock() - t0
+        if dt > 0:
+            runtime_metrics.add_ingest_wait(source, dt)
+            on_wait(dt)
+        yield item

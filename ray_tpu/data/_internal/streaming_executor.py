@@ -468,8 +468,21 @@ class StreamingExecutor:
         self._release_hold: Dict[int, RefBundle] = {}  # preserve_order only
         self._release_fifo: deque = deque()  # ready to hand to the consumer
         self._held_bytes = 0
+        # bytes parked in the CONSUMER queue still count against the
+        # terminal op's budget: a trainer that stops consuming parks the
+        # producers instead of filling the store with output_queue_blocks
+        # more blocks (end-to-end backpressure).  Updated from both the
+        # consumer thread (run) and the scheduling thread (_drain_release)
+        # — += / -= are NOT atomic across the GIL, so take the lock (one
+        # acquisition per BLOCK, nowhere near the hot path).
+        self._outq_bytes = 0
+        self._outq_lock = threading.Lock()
         # stats
         self.peak_downstream_bytes: Dict[str, int] = {op.name: 0 for op in ops}
+
+    def _outq_add(self, n: int) -> None:
+        with self._outq_lock:
+            self._outq_bytes += n
 
     # -- public API
     def run(self) -> Iterator[Any]:
@@ -485,6 +498,7 @@ class StreamingExecutor:
             while True:
                 kind, val = self._out_q.get()
                 if kind == "bundle":
+                    self._outq_add(-val.nbytes)
                     yield val.ref
                 elif kind == "error":
                     raise val
@@ -560,7 +574,9 @@ class StreamingExecutor:
             except queue.Full:
                 if evict:
                     try:
-                        self._out_q.get_nowait()
+                        kind, val = self._out_q.get_nowait()
+                        if kind == "bundle":
+                            self._outq_add(-val.nbytes)
                     except queue.Empty:
                         pass
 
@@ -595,7 +611,7 @@ class StreamingExecutor:
                 # wedges once the barrier holds `budget` bytes)
                 total += nxt.input_bytes
         else:
-            total += self._held_bytes
+            total += self._held_bytes + self._outq_bytes
         peak = self.peak_downstream_bytes
         if total > peak.get(op.name, 0):
             peak[op.name] = total
@@ -661,6 +677,7 @@ class StreamingExecutor:
                 break
             self._release_fifo.popleft()
             self._held_bytes -= b.nbytes
+            self._outq_add(b.nbytes)
             moved = True
         return moved
 

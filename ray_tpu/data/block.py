@@ -73,6 +73,42 @@ def batch_to_block(batch: Any) -> pa.Table:
     return to_arrow(batch)
 
 
+def numpy_batch_accounted(block: Block, source: str) -> Dict[str, np.ndarray]:
+    """Numpy batch with zero-copy accounting: each fixed-dtype single-chunk
+    column without nulls comes back as a VIEW over the Arrow buffer (which,
+    for plasma-resident blocks, aliases the store's shared memory — no
+    pickle round-trip, no host memcpy); everything else (multi-chunk
+    columns from ragged batch boundaries, nulls, bit-packed bools, strings)
+    is materialized with a copy.  Both paths are booked into the
+    ``ray_tpu_data_ingest_bytes_total{kind=view|copy}`` family so the
+    zero-copy invariant is enforceable from counters alone."""
+    from ray_tpu._private import runtime_metrics
+
+    t = to_arrow(block)
+    out: Dict[str, np.ndarray] = {}
+    viewed = copied = 0
+    for name, col in zip(t.column_names, t.columns):
+        if col.num_chunks == 1:
+            arr, chunk_copy = col.chunk(0), 0
+        else:
+            arr, chunk_copy = col.combine_chunks(), col.nbytes
+            if isinstance(arr, pa.ChunkedArray):
+                arr = arr.chunk(0) if arr.num_chunks else pa.array(
+                    [], type=col.type)
+        try:
+            np_col = arr.to_numpy(zero_copy_only=True)
+            viewed += 0 if chunk_copy else arr.nbytes
+            copied += chunk_copy  # combine_chunks materialized a copy
+        except (pa.ArrowInvalid, ValueError, TypeError):
+            np_col = arr.to_numpy(zero_copy_only=False)
+            copied += max(arr.nbytes, chunk_copy)
+        out[name] = np_col
+    runtime_metrics.add_ingest_bytes(source, "view", viewed)
+    runtime_metrics.add_ingest_bytes(source, "copy", copied)
+    runtime_metrics.add_ingest_rows(source, t.num_rows)
+    return out
+
+
 def iter_block_rows(block: Block) -> Iterator[Dict[str, Any]]:
     t = to_arrow(block)
     for row in t.to_pylist():

@@ -24,6 +24,19 @@ class DataContext:
     preserve_order: bool = True  # release outputs in data order (never gates submission)
     tasks_per_actor: int = 2  # per-actor pipelining in actor pools
     actor_idle_timeout_s: float = 30.0  # autoscaling pool scale-down
+    # -- train-ingest data plane (data/_internal/ingest.py) ------------------
+    # consumer-side ref lookahead: locally-sealed plasma blocks in the window
+    # resolve in ONE raylet round-trip (the PlasmaGetBatch path) instead of
+    # one RPC per block
+    ingest_resolve_window: int = 4
+    # per-consumer block cap in the streaming-split coordinator: a slow
+    # consumer's round-robin assignment parks the producer pull (PARKED
+    # backpressure) instead of buffering the whole stream in the store
+    split_buffer_blocks: int = 16
+    # device-side double buffer depth for iter_jax_batches: batch N+1's
+    # device_put overlaps the caller's step on batch N (2 = classic double
+    # buffering; 0 disables the prefetch thread entirely)
+    device_prefetch_depth: int = 2
 
     _current: "Optional[DataContext]" = None
     _lock = threading.Lock()

@@ -503,41 +503,99 @@ def _random_sample_block(fraction: float, seed, block):
     return block.take(pa.array(idx, type=pa.int64()))
 
 
-def _batches_over_refs(ref_iter, batch_size, batch_format, drop_last):
-    """Re-batch a stream of block refs into fixed-size batches (shared by
-    Dataset.iter_batches and streaming-split iterators)."""
-    import ray_tpu
-    from ray_tpu.data.block import block_to_batch, concat_blocks, slice_block
+def _batches_over_blocks(block_iter, batch_size, batch_format, drop_last,
+                         source: Optional[str] = None):
+    """Re-batch a stream of BLOCKS into fixed-size batches.
 
-    carry: Optional[pa.Table] = None
-    for ref in ref_iter:
-        block = ray_tpu.get(ref)
-        if carry is not None and carry.num_rows:
-            block = concat_blocks([carry, block])
-            carry = None
+    Batches fully contained in one block are zero-copy slices (views over
+    the block's buffers — for plasma-resident blocks, views over the
+    store's shared memory); only a batch straddling a block boundary
+    concatenates (the "copy only at ragged batch boundaries" invariant,
+    provable from the ingest byte counters).  ``source`` enables the
+    accounting numpy converter for the ingest metric families."""
+    from ray_tpu.data.block import (
+        block_to_batch,
+        concat_blocks,
+        numpy_batch_accounted,
+        slice_block,
+        to_arrow,
+    )
+
+    def emit(tbl):
+        if source is not None and batch_format in ("numpy", "default"):
+            return numpy_batch_accounted(tbl, source)
+        return block_to_batch(tbl, batch_format)
+
+    pending: List[pa.Table] = []  # head may already be a partial slice
+    pending_rows = 0
+    for block in block_iter:
+        t = to_arrow(block)
         if batch_size is None:
-            if block.num_rows:
-                yield block_to_batch(block, batch_format)
+            if t.num_rows:
+                yield emit(t)
             continue
-        start = 0
-        while block.num_rows - start >= batch_size:
-            yield block_to_batch(
-                slice_block(block, start, start + batch_size), batch_format)
-            start += batch_size
-        if start < block.num_rows:
-            carry = slice_block(block, start, block.num_rows)
-    if carry is not None and carry.num_rows and not drop_last:
-        yield block_to_batch(carry, batch_format)
+        if t.num_rows:
+            pending.append(t)
+            pending_rows += t.num_rows
+        while pending_rows >= batch_size:
+            head = pending[0]
+            if head.num_rows > batch_size:
+                yield emit(slice_block(head, 0, batch_size))
+                pending[0] = slice_block(head, batch_size, head.num_rows)
+            elif head.num_rows == batch_size:
+                yield emit(pending.pop(0))
+            else:  # batch straddles blocks: the one copying boundary
+                parts, need = [], batch_size
+                while need > 0:
+                    h = pending[0]
+                    if h.num_rows <= need:
+                        parts.append(pending.pop(0))
+                        need -= h.num_rows
+                    else:
+                        parts.append(slice_block(h, 0, need))
+                        pending[0] = slice_block(h, need, h.num_rows)
+                        need = 0
+                yield emit(concat_blocks(parts))
+            pending_rows -= batch_size
+    if pending_rows and not drop_last:
+        yield emit(concat_blocks(pending) if len(pending) > 1
+                   else pending[0])
+
+
+def _batches_over_refs(ref_iter, batch_size, batch_format, drop_last,
+                       source: Optional[str] = None,
+                       window: Optional[int] = None):
+    """Re-batch a stream of block refs into fixed-size batches (shared by
+    Dataset.iter_batches and streaming-split iterators).  Refs resolve
+    through the windowed zero-copy path: locally-sealed plasma blocks in
+    the lookahead window resolve in ONE raylet round-trip and reconstruct
+    as buffer views over the store's shared memory."""
+    from ray_tpu.data._internal.ingest import resolved_blocks
+
+    yield from _batches_over_blocks(
+        resolved_blocks(ref_iter, window=window or 1), batch_size,
+        batch_format, drop_last, source=source)
 
 
 class _SplitCoordinator:
     """Actor executing the plan ONCE and handing blocks to n consumers
-    (reference: _internal/execution StreamSplitDataIterator coordinator)."""
+    (reference: _internal/execution StreamSplitDataIterator coordinator).
+
+    Per-consumer buffers are CAPPED (``DataContext.split_buffer_blocks``):
+    when the round-robin target's buffer is full, the producer pull parks
+    (``PARKED``) instead of buffering the whole stream against a slow
+    consumer — end-to-end backpressure, the executor's own op budget
+    upstream and this cap downstream bound the store bytes one split
+    pipeline can hold.  ``reassign`` is the elastic re-shard hook: a
+    drained consumer's remaining blocks move to the surviving consumers,
+    no row lost or duplicated."""
 
     WAIT = "__WAIT__"
+    PARKED = "__PARKED__"
 
     def __init__(self, ds_blob: bytes, n: int, equal: bool,
-                 idle_timeout_s: float = 600.0):
+                 idle_timeout_s: float = 600.0,
+                 max_buffered_blocks: Optional[int] = None):
         import threading as _threading
         import time as _time
 
@@ -546,12 +604,23 @@ class _SplitCoordinator:
         self._ds = cloudpickle.loads(ds_blob)
         self._n = n
         self._equal = equal
+        self._cap = (max_buffered_blocks
+                     or getattr(self._ds._ctx, "split_buffer_blocks", 16))
         self._lock = _threading.Lock()
         self._epoch = 0
+        # {consumer: epoch it detached in} — persists ACROSS epochs so a
+        # gone consumer's round-robin share keeps flowing to survivors; a
+        # replacement polling a LATER epoch reattaches itself
+        self._detached: Dict[int, int] = {}
         self._start_epoch_locked()
         # self-reaping: with consumers scattered across processes no single
-        # one can own the coordinator's lifetime; it exits after idling
+        # one can own the coordinator's lifetime; it exits after idling.
+        # In-flight next_block calls (which can legitimately block for a
+        # long time while the plan produces its first blocks) pin the
+        # coordinator alive — only true idleness reaps.
         self._last_access = _time.monotonic()
+        self._inflight = 0
+        self._access_lock = _threading.Lock()  # inflight counter only
         self._idle_timeout_s = idle_timeout_s
         _threading.Thread(target=self._idle_reaper, daemon=True,
                           name="split-coordinator-reaper").start()
@@ -562,7 +631,9 @@ class _SplitCoordinator:
 
         while True:
             _time.sleep(min(self._idle_timeout_s / 4, 30.0))
-            if _time.monotonic() - self._last_access > self._idle_timeout_s:
+            if (self._inflight == 0
+                    and _time.monotonic() - self._last_access
+                    > self._idle_timeout_s):
                 _os._exit(0)
 
     def _start_epoch_locked(self):
@@ -571,29 +642,74 @@ class _SplitCoordinator:
         self._counter = 0
         self._done = False
         self._finished: set = set()  # consumers that drained this epoch
+        self._returned: List[Any] = []  # equal=False give-backs
+
+    def _next_target_locked(self) -> Optional[int]:
+        """Round-robin target of the next pulled block, skipping detached
+        consumers (their assignment flows to survivors)."""
+        for _ in range(self._n):
+            t = self._counter % self._n
+            if t not in self._detached:
+                return t
+            self._counter += 1
+        return None
 
     def next_block(self, i: int, epoch: int):
         """Next block ref for consumer ``i`` in its ``epoch``.  None =
         epoch exhausted; WAIT = another consumer is still on the previous
-        epoch (retry shortly).  A new epoch re-executes the plan, so splits
-        are re-iterable across training epochs."""
+        epoch (retry shortly); PARKED = backpressure (a peer's buffer is
+        at its cap — retry, the producer is deliberately paused).  A new
+        epoch re-executes the plan, so splits are re-iterable across
+        training epochs."""
         import time as _time
 
         self._last_access = _time.monotonic()
+        with self._access_lock:
+            self._inflight += 1
+        try:
+            return self._next_block(i, epoch)
+        finally:
+            with self._access_lock:
+                self._inflight -= 1
+            self._last_access = _time.monotonic()
+
+    def _next_block(self, i: int, epoch: int):
         with self._lock:
             if epoch > self._epoch:
-                if len(self._finished) < self._n:
+                if len(self._finished | set(self._detached)) < self._n:
                     return self.WAIT  # stragglers still draining
                 self._epoch = epoch
                 self._start_epoch_locked()
             elif epoch < self._epoch:
                 return None  # stale epoch: it was fully consumed
+            if i in self._detached:
+                if self._detached[i] == self._epoch:
+                    return None  # detached THIS epoch: its share moved on
+                del self._detached[i]  # a later epoch: the rank rejoined
             while True:
                 if self._buffers[i]:
                     return self._buffers[i].pop(0)
+                if not self._equal and self._returned:
+                    return self._returned.pop(0)
                 if self._done:
                     self._finished.add(i)
                     return None
+                if self._equal:
+                    target = self._next_target_locked()
+                    if target is None:
+                        self._done = True
+                        continue
+                    if (target != i and target not in self._finished
+                            and len(self._buffers[target]) >= self._cap):
+                        # a slow peer's assignment is full: park the
+                        # producer pull instead of buffering the stream.
+                        # A FINISHED peer (abandoned mid-epoch) never
+                        # drains its buffer, so its cap must not park the
+                        # survivors — its assignment buffers as before.
+                        from ray_tpu._private import runtime_metrics
+
+                        runtime_metrics.inc_ingest_backpressure("split")
+                        return self.PARKED
                 try:
                     ref = next(self._iter)
                 except StopIteration:
@@ -602,7 +718,7 @@ class _SplitCoordinator:
                 if self._equal:
                     # fixed round-robin: every consumer sees a near-equal,
                     # disjoint block set regardless of consumption speed
-                    self._buffers[self._counter % self._n].append(ref)
+                    self._buffers[target].append(ref)
                     self._counter += 1
                 else:
                     return ref  # first-come-first-served
@@ -616,6 +732,48 @@ class _SplitCoordinator:
                 self._finished.add(i)
         return True
 
+    def reassign(self, i: int, epoch: int, unread_refs=()):
+        """Elastic re-shard (preemption drain moved consumer ``i`` away):
+        everything still assigned to ``i`` — its coordinator buffer plus
+        any refs it pulled but never consumed — is redistributed round-
+        robin over the consumers still active in this epoch, and ``i`` is
+        detached (future round-robin skips it; the epoch can complete
+        without it).  Returns the number of blocks moved.  Exactly-once:
+        a block is either consumed by ``i`` before the drain or delivered
+        to exactly one survivor, never both."""
+        with self._lock:
+            if epoch != self._epoch:
+                return 0  # the epoch already rolled; nothing left to move
+            blocks = list(self._buffers[i]) + list(unread_refs)
+            self._buffers[i] = []
+            self._detached[i] = self._epoch
+            self._finished.add(i)
+            if not blocks:
+                return 0
+            if not self._equal:
+                self._returned.extend(blocks)
+                return len(blocks)
+            active = [j for j in range(self._n)
+                      if j not in self._detached and j not in self._finished]
+            if not active:
+                # every survivor already drained this epoch: the blocks
+                # are undeliverable within it (nobody will pull again).
+                # A multi-epoch loop re-delivers them from the next
+                # epoch's fresh plan execution; a single-epoch run has
+                # lost them — say so loudly instead of silently.
+                import logging as _logging
+
+                _logging.getLogger(__name__).warning(
+                    "streaming_split reassign: consumer %d drained with "
+                    "%d block(s) left but every surviving consumer "
+                    "already finished the epoch — these blocks are only "
+                    "re-delivered if the split is iterated again",
+                    i, len(blocks))
+                return 0
+            for k, ref in enumerate(blocks):
+                self._buffers[active[k % len(active)]].append(ref)
+            return len(blocks)
+
 
 class StreamSplit:
     """One consumer's slice of a streaming_split (reference: DataIterator).
@@ -628,7 +786,24 @@ class StreamSplit:
         self._index = index
         self._ctx = ctx
         self._epoch = _epoch
+        self._active_epoch: Optional[int] = None
         self._wait_timeout_s = wait_timeout_s
+
+    def _coord_call(self, method, *args):
+        """One coordinator round-trip with the self-reap translated: the
+        coordinator exits after ``idle_timeout_s`` without consumers, so a
+        late (re)connect must fail with a nameable cause, not an opaque
+        actor-death error (or a hang inside a retry loop)."""
+        import ray_tpu
+        from ray_tpu import ActorDiedError, ActorUnavailableError
+
+        try:
+            return ray_tpu.get(getattr(self._coord, method).remote(*args))
+        except (ActorDiedError, ActorUnavailableError) as e:
+            raise RuntimeError(
+                "streaming_split coordinator is gone — it self-reaps "
+                "after idling (idle_timeout_s, default 600s); recreate "
+                f"the splits with Dataset.streaming_split: {e}") from None
 
     def _ref_iter(self):
         import time as _time
@@ -638,12 +813,12 @@ class StreamSplit:
 
         epoch = self._epoch
         self._epoch += 1
+        self._active_epoch = epoch
         exhausted = False
         wait_deadline = None
         try:
             while True:
-                ref = ray_tpu.get(
-                    self._coord.next_block.remote(self._index, epoch))
+                ref = self._coord_call("next_block", self._index, epoch)
                 if ref is None:
                     exhausted = True
                     return
@@ -656,9 +831,23 @@ class StreamSplit:
                             "finished the previous epoch (dead consumer?)")
                     _time.sleep(0.05)
                     continue
+                if ref == _SplitCoordinator.PARKED:
+                    # backpressure: a peer's buffer is at its cap and the
+                    # producer pull is parked — not a liveness problem
+                    # unless it persists past the same deadline
+                    if wait_deadline is None:
+                        wait_deadline = _time.monotonic() + self._wait_timeout_s
+                    elif _time.monotonic() > wait_deadline:
+                        raise RuntimeError(
+                            "streaming_split: backpressured for the whole "
+                            "wait timeout (a peer stopped consuming "
+                            "without detaching?)")
+                    _time.sleep(0.02)
+                    continue
                 wait_deadline = None
                 yield ref
         finally:
+            self._active_epoch = None
             if not exhausted:
                 # abandoned mid-epoch (break / error): count this consumer
                 # as drained so peers' next epoch doesn't livelock
@@ -667,12 +856,30 @@ class StreamSplit:
                 except Exception:  # noqa: BLE001 — coordinator gone: epoch accounting died with it
                     pass
 
+    def iter_blocks(self):
+        """Public block-ref iterator for the ingest layer (one epoch)."""
+        return self._ref_iter()
+
+    def release(self, unread_refs=()) -> int:
+        """Elastic re-shard hand-back: detach this consumer from its
+        CURRENT epoch, returning ``unread_refs`` (pulled but never
+        consumed) plus whatever the coordinator still holds for it to the
+        surviving consumers.  Returns the number of blocks moved."""
+        epoch = (self._active_epoch if self._active_epoch is not None
+                 else self._epoch - 1)
+        if epoch < 0:
+            return 0
+        return self._coord_call("reassign", self._index, epoch,
+                                list(unread_refs))
+
     def iter_batches(self, *, batch_size: Optional[int] = 256,
                      batch_format: Optional[str] = None,
                      drop_last: bool = False):
         batch_format = batch_format or self._ctx.default_batch_format
-        yield from _batches_over_refs(self._ref_iter(), batch_size,
-                                      batch_format, drop_last)
+        yield from _batches_over_refs(
+            self._ref_iter(), batch_size, batch_format, drop_last,
+            source="split",
+            window=getattr(self._ctx, "ingest_resolve_window", 4))
 
     def iter_rows(self):
         import ray_tpu
@@ -790,47 +997,6 @@ def _sort_refs(key: str, descending: bool, refs: List[Any]) -> List[Any]:
             parts[p].append(r)
     out = [merge.remote(key, descending, *parts[p]) for p in range(n_out)]
     return out[::-1] if descending else out
-
-
-def _prefetch(gen, n):
-    """Run ``gen`` on a background thread, buffering up to ``n`` items
-    (reference: prefetch_batches on the batch iterators). Errors re-raise at
-    the consumer; abandoning the iterator stops the producer promptly."""
-    import queue as _queue
-    import threading as _threading
-
-    q = _queue.Queue(maxsize=max(1, n))
-    END, stop = object(), _threading.Event()
-
-    def put_or_stop(item) -> bool:
-        while not stop.is_set():
-            try:
-                q.put(item, timeout=0.2)
-                return True
-            except _queue.Full:
-                continue
-        return False  # consumer abandoned the iterator
-
-    def pump():
-        try:
-            for item in gen:
-                if not put_or_stop(item):
-                    return
-            put_or_stop(END)
-        except BaseException as e:  # noqa: BLE001 — surface at the consumer
-            put_or_stop(e)
-
-    _threading.Thread(target=pump, daemon=True, name="batch-prefetch").start()
-    try:
-        while True:
-            item = q.get()
-            if item is END:
-                return
-            if isinstance(item, BaseException):
-                raise item
-            yield item
-    finally:
-        stop.set()
 
 
 class Dataset:
@@ -1050,11 +1216,17 @@ class Dataset:
             for s, e in even_split_ranges(len(refs), n)
         ]
 
-    def streaming_split(self, n: int, *, equal: bool = True) -> List[StreamSplit]:
+    def streaming_split(self, n: int, *, equal: bool = True,
+                        idle_timeout_s: float = 600.0) -> List[StreamSplit]:
         """n coordinated iterators over ONE execution of this dataset
         (reference: dataset.streaming_split for per-worker Train ingest).
         equal=True assigns blocks round-robin (near-equal, disjoint);
-        equal=False hands blocks out first-come-first-served."""
+        equal=False hands blocks out first-come-first-served.  Per-consumer
+        coordinator buffers are capped (DataContext.split_buffer_blocks)
+        so a slow consumer parks the producer instead of buffering the
+        stream; a consumer drained away mid-epoch hands its remaining
+        blocks to survivors via ``StreamSplit.release`` (elastic
+        re-shard)."""
         import cloudpickle
 
         import ray_tpu
@@ -1063,7 +1235,7 @@ class Dataset:
         # across processes, so no single one can own its lifetime)
         coordinator = ray_tpu.remote(_SplitCoordinator).options(
             num_cpus=0.1, max_concurrency=max(n + 1, 2)).remote(
-            cloudpickle.dumps(self), n, equal)
+            cloudpickle.dumps(self), n, equal, idle_timeout_s)
         return [StreamSplit(coordinator, i, self._ctx) for i in range(n)]
 
     # -- execution ----------------------------------------------------------
@@ -1086,13 +1258,21 @@ class Dataset:
         """Stream batches as blocks complete (reference: iterator over
         execute_to_iterator, plan.py:413). ``prefetch_batches`` runs batch
         preparation on a background thread so it overlaps the caller's
-        consumption (0 disables)."""
+        consumption (0 disables).  Blocks resolve through the windowed
+        zero-copy path (locally-sealed plasma blocks in one raylet
+        round-trip); numpy batches of fixed-dtype columns are READ-ONLY
+        views over the store's shared memory — ``arr.copy()`` before
+        mutating in place."""
         batch_format = batch_format or self._ctx.default_batch_format
         gen = _batches_over_refs(
             self._plan.execute_iter(self._ctx), batch_size, batch_format,
-            drop_last)
+            drop_last, source="iter",
+            window=getattr(self._ctx, "ingest_resolve_window", 4))
         if prefetch_batches and prefetch_batches > 0:
-            gen = _prefetch(gen, prefetch_batches)
+            from ray_tpu.data._internal.ingest import HostPrefetcher
+
+            gen = iter(HostPrefetcher(gen, depth=prefetch_batches,
+                                      source="iter", stage="host"))
         yield from gen
 
     def iter_jax_batches(
@@ -1103,54 +1283,53 @@ class Dataset:
         dtypes: Optional[Dict[str, Any]] = None,
         sharding: Optional[Any] = None,
         device: Optional[Any] = None,
-        prefetch_batches: int = 1,
+        prefetch_batches: int = 2,
+        partial_batch: str = "error",
     ) -> Iterator[Dict[str, Any]]:
         """Stream batches as dicts of device-resident jax arrays — the
-        TPU-native analog of the reference's iter_torch_batches.
+        TPU-native analog of the reference's iter_torch_batches, now with
+        a REAL device-side double buffer: the prefetch thread runs the
+        next batch's ``device_put``/reshard (staged through a donated
+        ``optimization_barrier`` identity) while the caller steps.
 
         dtypes:   optional {column: jnp dtype} casts (host-side, pre-put)
         sharding: a jax.sharding.Sharding applied to every column (e.g. a
                   NamedSharding over the data axes for pjit'ed train steps)
         device:   a single device (mutually exclusive with sharding)
-        prefetch_batches: device_put of upcoming batches overlaps the
-                  caller's step (the classic TPU input-pipeline overlap)
+        prefetch_batches: device-resident buffer depth (2 = classic double
+                  buffering; 0 = synchronous device_put, no overlap)
+        partial_batch: what to do with a final batch that doesn't fill
+                  ``batch_size``: "error" (today's behavior — a sharding
+                  mismatch raises), "drop", or "pad" (zero-pad to
+                  ``batch_size`` and add a float32 ``mask`` column)
         """
+        from ray_tpu.data._internal.ingest import (
+            DevicePrefetcher,
+            DeviceStager,
+            staged_batches,
+        )
+
         if sharding is not None and device is not None:
             raise ValueError("pass sharding or device, not both")
         target = sharding if sharding is not None else device
 
-        def _gen():
-            import jax
-            import numpy as np
+        def _gen():  # lazy: nothing executes before the first next()
+            host = self.iter_batches(batch_size=batch_size,
+                                     batch_format="numpy",
+                                     drop_last=drop_last,
+                                     prefetch_batches=0)
+            if prefetch_batches and prefetch_batches > 0:
+                yield from DevicePrefetcher(
+                    host, target, dtypes=dtypes, depth=prefetch_batches,
+                    batch_size=batch_size, partial_batch=partial_batch,
+                    source="iter", sharding=sharding)
+            else:
+                stager = DeviceStager(target, dtypes=dtypes,
+                                      sharding=sharding)
+                yield from staged_batches(host, stager, batch_size,
+                                          partial_batch)
 
-            for batch in self.iter_batches(batch_size=batch_size,
-                                           batch_format="numpy",
-                                           drop_last=drop_last,
-                                           prefetch_batches=0):
-                host = {}
-                for name, col in batch.items():
-                    if dtypes and name in dtypes:
-                        col = np.asarray(col).astype(dtypes[name])
-                    host[name] = col
-                # ONE device_put of the whole batch pytree, straight from
-                # host to the target layout — no default-device detour
-                try:
-                    out = jax.device_put(host, target)
-                except ValueError as e:
-                    if sharding is None:
-                        raise
-                    n = len(next(iter(host.values()))) if host else 0
-                    raise ValueError(
-                        f"batch of {n} rows does not fit the requested "
-                        f"sharding (ragged final batch? pass drop_last=True, "
-                        f"or pick a batch_size dividing the row count): {e}"
-                    ) from e
-                yield out
-
-        gen = _gen()
-        if prefetch_batches and prefetch_batches > 0:
-            gen = _prefetch(gen, prefetch_batches)
-        return gen
+        return _gen()
 
     def iter_torch_batches(
         self,
@@ -1186,10 +1365,14 @@ class Dataset:
                     out[name] = t
                 yield out
 
-        gen = _gen()
         if prefetch_batches and prefetch_batches > 0:
-            gen = _prefetch(gen, prefetch_batches)
-        return gen
+            from ray_tpu.data._internal.ingest import HostPrefetcher
+
+            def lazy():
+                yield from HostPrefetcher(_gen(), depth=prefetch_batches,
+                                          source="torch", stage="host")
+            return lazy()
+        return _gen()
 
     def iter_rows(self) -> Iterator[Dict[str, Any]]:
         import ray_tpu

@@ -64,6 +64,26 @@ class _TrainSession:
         self.latest_checkpoint: Optional[Checkpoint] = None
         self.finished = threading.Event()
         self.error: Optional[BaseException] = None
+        # real buffer-empty seconds stamped by the ingest iterators
+        # (data/_internal/ingest.DataShard); report() attaches the
+        # accumulated value as input_wait_s so the driver's goodput ledger
+        # reclassifies MEASURED starvation, not whatever user code happens
+        # to report
+        self._input_wait_s = 0.0
+        self._input_wait_lock = threading.Lock()
+        self._wrapped_shards: Dict[str, Any] = {}
+
+    def note_input_wait(self, seconds: float) -> None:
+        """Accumulate measured data-starvation seconds since the last
+        report (called by the ingest iterators' buffer-empty stamps)."""
+        if seconds > 0:
+            with self._input_wait_lock:
+                self._input_wait_s += seconds
+
+    def consume_input_wait(self) -> float:
+        with self._input_wait_lock:
+            v, self._input_wait_s = self._input_wait_s, 0.0
+            return v
 
     def report(self, metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None):
         # flight recorder: a report IS a step boundary — the last thing a
@@ -94,14 +114,28 @@ class _TrainSession:
             staged = os.path.join(base, f"ckpt_{uuid.uuid4().hex[:8]}")
             shutil.copytree(checkpoint.path, staged, dirs_exist_ok=True)
             checkpoint = Checkpoint(staged)
-        self.result_queue.put({"metrics": dict(metrics), "checkpoint": checkpoint,
+        metrics = dict(metrics)
+        iw = self.consume_input_wait()
+        if iw > 0 and "input_wait_s" not in metrics:
+            # measured buffer-empty seconds ride every report; an explicit
+            # user-reported value wins (back-compat)
+            metrics["input_wait_s"] = iw
+        self.result_queue.put({"metrics": metrics, "checkpoint": checkpoint,
                                "rank": self.world_rank})
 
     def get_dataset_shard(self, name: str = "train"):
         shard = self.dataset_shards.get(name)
         if shard is None:
             raise KeyError(f"no dataset shard named {name!r} was passed to the trainer")
-        return shard
+        if not hasattr(shard, "iter_batches"):
+            return shard  # opaque shard object: hand it through untouched
+        wrapped = self._wrapped_shards.get(name)
+        if wrapped is None or wrapped._shard is not shard:
+            from ray_tpu.data._internal.ingest import DataShard
+
+            wrapped = DataShard(shard, name=name, session=self)
+            self._wrapped_shards[name] = wrapped
+        return wrapped
 
 
 _session: Optional[_TrainSession] = None

@@ -1,0 +1,569 @@
+"""Streaming data plane (ISSUE 13): zero-copy host batches, double-buffered
+device prefetch, measured input_wait -> goodput ledger, elastic re-shard,
+end-to-end backpressure.
+
+Hermetic tests drive the batch assembler, prefetchers, session stamping and
+the split coordinator in-process (injected clocks, no wall-clock racing);
+the cluster tests prove the plasma view path and the executor's
+consumer-queue backpressure on a real single-node cluster.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from ray_tpu._private import runtime_metrics as rtm
+
+
+def _bytes_snap(source):
+    s = rtm.ingest_snapshot()["bytes"].get(source, {})
+    return s.get("view", 0.0), s.get("copy", 0.0)
+
+
+# ---------------------------------------------------------------------------
+# Batch assembly: views for aligned batches, copies only at ragged bounds
+# ---------------------------------------------------------------------------
+
+def test_batch_assembly_aligned_batches_are_views():
+    from ray_tpu.data.dataset import _batches_over_blocks
+
+    blocks = [pa.table({"x": np.arange(8, dtype=np.float32) + 8 * i,
+                        "y": np.arange(8, dtype=np.int64)})
+              for i in range(4)]
+    v0, c0 = _bytes_snap("al")
+    batches = list(_batches_over_blocks(iter(blocks), 4, "numpy", False,
+                                        source="al"))
+    v1, c1 = _bytes_snap("al")
+    assert len(batches) == 8
+    assert c1 - c0 == 0, "aligned stream must not memcpy"
+    assert v1 - v0 == 4 * 8 * (4 + 8)  # f32 + i64 per block
+    for b in batches:
+        # numpy views over arrow buffers: read-only, non-owning
+        assert b["x"].base is not None
+        assert not b["x"].flags.writeable
+    got = np.concatenate([b["x"] for b in batches])
+    assert got.tolist() == [float(i) for i in range(32)]
+
+
+def test_batch_assembly_ragged_copies_only_at_boundaries():
+    from ray_tpu.data.dataset import _batches_over_blocks
+
+    blocks = [pa.table({"x": np.arange(10, dtype=np.float32) + 10 * i})
+              for i in range(4)]
+    v0, c0 = _bytes_snap("rg")
+    batches = list(_batches_over_blocks(iter(blocks), 7, "numpy", False,
+                                        source="rg"))
+    v1, c1 = _bytes_snap("rg")
+    assert [len(b["x"]) for b in batches] == [7, 7, 7, 7, 7, 5]
+    # copies confined to the straddling batches, never the whole stream
+    assert 0 < c1 - c0 < (v1 - v0) + (c1 - c0)
+    got = sorted(float(v) for b in batches for v in b["x"])
+    assert got == [float(i) for i in range(40)]
+
+
+def test_numpy_batch_accounted_nulls_and_strings_copy():
+    from ray_tpu.data.block import numpy_batch_accounted
+
+    t = pa.table({
+        "ok": np.arange(6, dtype=np.float64),
+        "holes": pa.array([1.0, None, 3.0, None, 5.0, 6.0]),
+        "s": pa.array(["a", "b", "c", "d", "e", "f"]),
+    })
+    v0, c0 = _bytes_snap("mix")
+    out = numpy_batch_accounted(t, "mix")
+    v1, c1 = _bytes_snap("mix")
+    assert out["ok"].base is not None and len(out["holes"]) == 6
+    assert v1 - v0 == 6 * 8          # only the clean fixed-dtype column
+    assert c1 - c0 > 0               # nulls + strings had to materialize
+
+
+def test_drop_last_and_empty_blocks():
+    from ray_tpu.data.dataset import _batches_over_blocks
+
+    blocks = [pa.table({"x": np.arange(5, dtype=np.int64)}),
+              pa.table({"x": np.array([], dtype=np.int64)}),
+              pa.table({"x": np.arange(4, dtype=np.int64)})]
+    batches = list(_batches_over_blocks(iter(blocks), 4, "numpy", True))
+    assert [len(b["x"]) for b in batches] == [4, 4]  # trailing 1 dropped
+    batches = list(_batches_over_blocks(iter(blocks), 4, "numpy", False))
+    assert [len(b["x"]) for b in batches] == [4, 4, 1]
+
+
+# ---------------------------------------------------------------------------
+# Host prefetcher: order, errors, deterministic wait stamping, backpressure
+# ---------------------------------------------------------------------------
+
+def test_host_prefetcher_order_and_error_propagation():
+    from ray_tpu.data._internal.ingest import HostPrefetcher
+
+    def gen():
+        for i in range(5):
+            yield i
+        raise ValueError("kaput")
+
+    pf = HostPrefetcher(gen(), depth=2, source="hp")
+    got = []
+    with pytest.raises(ValueError, match="kaput"):
+        for item in pf:
+            got.append(item)
+    assert got == [0, 1, 2, 3, 4]
+
+
+def test_host_prefetcher_wait_stamped_with_injected_clock():
+    from ray_tpu.data._internal.ingest import HostPrefetcher
+
+    state = {"t": 0.0}
+    gate = threading.Event()
+    waits = []
+
+    def gen():
+        yield "a"
+        gate.wait(10)  # producer parks until the test releases it
+        yield "b"
+
+    pf = HostPrefetcher(gen(), depth=2, source="hpw",
+                        clock=lambda: state["t"], on_wait=waits.append)
+    it = iter(pf)
+    assert next(it) == "a"  # clock frozen at 0: any startup wait stamps 0
+
+    def release():
+        state["t"] = 7.5  # happens-before gate.set() on this thread
+        gate.set()
+
+    threading.Timer(0.3, release).start()
+    assert next(it) == "b"  # blocks with t0=0.0; wakes after t=7.5
+    assert pf.wait_seconds() == pytest.approx(7.5)
+    assert sum(waits) == pytest.approx(7.5)
+    assert list(it) == []
+
+
+def test_host_prefetcher_backpressure_parks_producer():
+    from ray_tpu.data._internal.ingest import HostPrefetcher
+
+    produced = []
+
+    def gen():
+        for i in range(10):
+            produced.append(i)
+            yield i
+
+    before = rtm.ingest_snapshot()["backpressure"].get("bp-test", 0)
+    pf = HostPrefetcher(gen(), depth=1, source="hb", stage="bp-test")
+    it = iter(pf)
+    assert next(it) == 0
+    # depth 1: producer holds at most queue(1) + one in flight
+    deadline = time.monotonic() + 5
+    while len(produced) < 2 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    time.sleep(0.3)  # give an unbounded producer time to run away
+    assert len(produced) <= 3, "producer ran past the bounded buffer"
+    after = rtm.ingest_snapshot()["backpressure"].get("bp-test", 0)
+    assert after > before, "parked producer must book a backpressure event"
+    assert list(it) == list(range(1, 10))
+
+
+# ---------------------------------------------------------------------------
+# Partial-batch policy (ragged-final-batch fix)
+# ---------------------------------------------------------------------------
+
+def test_partial_batch_modes():
+    from ray_tpu.data._internal.ingest import apply_partial_batch
+
+    b = {"x": np.arange(3, dtype=np.float32), "y": np.arange(3)}
+    padded = apply_partial_batch(dict(b), 5, "pad")
+    assert len(padded["x"]) == 5 and len(padded["y"]) == 5
+    assert padded["mask"].tolist() == [1.0, 1.0, 1.0, 0.0, 0.0]
+    assert padded["x"][3:].tolist() == [0.0, 0.0]
+    assert apply_partial_batch(dict(b), 5, "drop") is None
+    same = apply_partial_batch(dict(b), 5, "error")
+    assert len(same["x"]) == 3  # unchanged: downstream sharding raises
+    # full batches pass through untouched in every mode
+    full = {"x": np.arange(5, dtype=np.float32)}
+    assert apply_partial_batch(dict(full), 5, "pad")["x"].shape == (5,)
+    with pytest.raises(ValueError, match="mask"):
+        apply_partial_batch({"x": np.arange(2), "mask": np.arange(2)}, 4,
+                            "pad")
+    with pytest.raises(ValueError, match="partial_batch"):
+        apply_partial_batch(dict(b), 5, "bogus")
+
+
+def test_iter_jax_partial_batch_at_failing_geometry():
+    """11 rows / batch 4 over a 2-device data sharding: the final batch of
+    3 rows does not divide the mesh — exactly the mid-epoch raise this
+    satellite fixes."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from ray_tpu.data._internal.ingest import DevicePrefetcher
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("data",))
+    shard = NamedSharding(mesh, P("data"))
+
+    def hgen():
+        for start in (0, 4, 8):
+            n = min(4, 11 - start)
+            yield {"x": np.arange(start, start + n).astype(np.float32)}
+
+    with pytest.raises(ValueError, match="partial_batch"):
+        list(DevicePrefetcher(hgen(), shard, depth=2, batch_size=4,
+                              partial_batch="error", source="pb",
+                              sharding=shard))
+    dropped = list(DevicePrefetcher(hgen(), shard, depth=2, batch_size=4,
+                                    partial_batch="drop", source="pb",
+                                    sharding=shard))
+    assert len(dropped) == 2 and dropped[0]["x"].shape == (4,)
+    padded = list(DevicePrefetcher(hgen(), shard, depth=2, batch_size=4,
+                                   partial_batch="pad", source="pb",
+                                   sharding=shard))
+    assert len(padded) == 3
+    last = padded[-1]
+    assert last["x"].sharding == shard and last["x"].shape == (4,)
+    assert np.asarray(last["mask"]).tolist() == [1.0, 1.0, 1.0, 0.0]
+    assert np.asarray(last["x"]).tolist() == [8.0, 9.0, 10.0, 0.0]
+
+
+def test_device_prefetcher_runs_ahead_of_consumer():
+    """Double buffering means the producer transfers batch N+1 (and stages
+    N+2) while the caller still holds batch N."""
+    from ray_tpu.data._internal.ingest import DevicePrefetcher
+
+    produced = []
+
+    def hgen():
+        for i in range(6):
+            produced.append(i)
+            yield {"x": np.full(4, i, np.float32)}
+
+    dp = DevicePrefetcher(hgen(), None, depth=2, batch_size=4, source="da")
+    it = iter(dp)
+    first = next(it)
+    assert int(np.asarray(first["x"])[0]) == 0
+    deadline = time.monotonic() + 5
+    while len(produced) < 3 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert len(produced) >= 3, "prefetch thread did not run ahead"
+    rest = [int(np.asarray(b["x"])[0]) for b in it]
+    assert rest == [1, 2, 3, 4, 5]
+
+
+# ---------------------------------------------------------------------------
+# Goodput wiring: measured waits -> session -> ledger, sum invariant exact
+# ---------------------------------------------------------------------------
+
+def test_buffer_empty_waits_land_in_ledger_input_wait_exactly():
+    from ray_tpu.data._internal.ingest import DataShard
+    from ray_tpu.train._internal.goodput import GoodputLedger
+    from ray_tpu.train._internal.session import _TrainSession
+
+    state = {"t": 0.0}
+    gate = threading.Event()
+
+    class FakeShard:
+        def iter_batches(self, **kw):
+            def gen():
+                yield {"x": np.zeros(4, np.float32)}
+                gate.wait(10)
+                yield {"x": np.ones(4, np.float32)}
+            return gen()
+
+    session = _TrainSession(world_size=1, world_rank=0)
+    shard = DataShard(FakeShard(), name="gw", session=session,
+                      drain_probe=lambda: False, clock=lambda: state["t"])
+    it = shard.iter_batches(batch_size=4, batch_format="numpy",
+                            prefetch_batches=2)
+
+    def release():
+        state["t"] = 7.5  # happens-before gate.set()
+        gate.set()
+
+    first = next(it)
+    threading.Timer(0.3, release).start()
+    second = next(it)
+    assert list(it) == []
+    assert shard.wait_seconds() == pytest.approx(7.5)
+
+    # report() attaches the measured wait and resets the accumulator
+    session.report({"loss": 0.5})
+    row = session.result_queue.get_nowait()
+    assert row["metrics"]["input_wait_s"] == pytest.approx(7.5)
+    session.report({"loss": 0.4})
+    row2 = session.result_queue.get_nowait()
+    assert "input_wait_s" not in row2["metrics"]
+    # an explicit user-reported value wins
+    session.note_input_wait(2.0)
+    session.report({"input_wait_s": 9.0})
+    row3 = session.result_queue.get_nowait()
+    assert row3["metrics"]["input_wait_s"] == 9.0
+
+    # ledger: the carved seconds land in input_wait EXACTLY, sum invariant
+    lstate = {"t": 0.0}
+    led = GoodputLedger("gw", clock=lambda: lstate["t"])
+    led.start("restore")
+    lstate["t"] = 2.0
+    led.mark("productive_step")
+    lstate["t"] = 12.0
+    led.stop()
+    moved = led.reclassify("productive_step", "input_wait",
+                           row["metrics"]["input_wait_s"])
+    assert moved == pytest.approx(7.5)
+    snap = led.snapshot()
+    assert snap["buckets_s"]["input_wait"] == pytest.approx(7.5)
+    assert snap["buckets_s"]["productive_step"] == pytest.approx(2.5)
+    assert snap["buckets_s"]["restore"] == pytest.approx(2.0)
+    assert sum(snap["buckets_s"].values()) == snap["wall_clock_s"] == 12.0
+
+
+def test_session_get_dataset_shard_wraps_and_caches():
+    from ray_tpu.data._internal.ingest import DataShard
+    from ray_tpu.train._internal.session import _TrainSession
+
+    class FakeShard:
+        def iter_batches(self, **kw):
+            return iter(())
+
+    fake = FakeShard()
+    s = _TrainSession(world_size=1, world_rank=0,
+                      dataset_shards={"train": fake, "opaque": object()})
+    shard = s.get_dataset_shard("train")
+    assert isinstance(shard, DataShard)
+    assert s.get_dataset_shard("train") is shard  # cached wrapper
+    assert not isinstance(s.get_dataset_shard("opaque"), DataShard)
+    with pytest.raises(KeyError):
+        s.get_dataset_shard("nope")
+
+
+# ---------------------------------------------------------------------------
+# Split coordinator: elastic re-shard + PARKED backpressure (in-process)
+# ---------------------------------------------------------------------------
+
+def _make_coordinator(items, n, equal=True, cap=None):
+    import cloudpickle
+
+    from ray_tpu.data.dataset import _SplitCoordinator
+
+    class _Ctx:
+        split_buffer_blocks = cap or 64
+
+    class _Plan:
+        def __init__(self, it):
+            self._items = list(it)
+
+        def execute_iter(self, ctx):
+            return iter(list(self._items))
+
+    class _Ds:
+        def __init__(self, it):
+            self._plan = _Plan(it)
+            self._ctx = _Ctx()
+
+    return _SplitCoordinator(cloudpickle.dumps(_Ds(items)), n, equal,
+                             3600.0, max_buffered_blocks=cap)
+
+
+def test_injected_drain_elastic_reshard_exactly_once():
+    """The acceptance invariant: consumer 2 is drained mid-epoch (its
+    coordinator buffer + one pulled-but-unconsumed block reassigned);
+    every block is delivered exactly once across the surviving
+    consumers."""
+    coord = _make_coordinator(range(30), 3)
+    delivered = {0: [], 1: [], 2: []}
+
+    def pop(i):
+        r = coord.next_block(i, 0)
+        assert r not in (coord.WAIT, coord.PARKED)
+        return r
+
+    # everyone consumes a little
+    for _ in range(4):
+        delivered[0].append(pop(0))
+    for _ in range(3):
+        delivered[1].append(pop(1))
+    c2_pulled = [pop(2), pop(2), pop(2)]
+    delivered[2] = c2_pulled[:2]          # consumed two...
+    unread = c2_pulled[2:]                # ...one pulled but never consumed
+
+    # the drain: c2's remaining assignment moves to survivors
+    moved = coord.reassign(2, 0, unread)
+    assert moved >= 1
+    assert coord.next_block(2, 0) is None  # detached consumer sees the end
+
+    # survivors drain the epoch
+    for i in (0, 1):
+        while True:
+            r = coord.next_block(i, 0)
+            if r is None:
+                break
+            assert r != coord.WAIT
+            delivered[i].append(r)
+    everything = delivered[0] + delivered[1] + delivered[2]
+    assert sorted(everything) == list(range(30))
+    assert len(everything) == len(set(everything)) == 30
+
+    # the NEXT epoch reattaches everyone (gang restart after the drain)
+    got = [coord.next_block(0, 1), coord.next_block(1, 1),
+           coord.next_block(2, 1)]
+    assert all(g is not None and g != coord.WAIT for g in got)
+
+
+def test_reassign_stale_epoch_is_a_noop():
+    coord = _make_coordinator(range(6), 2)
+    while coord.next_block(0, 0) is not None:
+        pass
+    while coord.next_block(1, 0) is not None:
+        pass
+    assert coord.next_block(0, 1) is not None  # epoch rolled
+    assert coord.reassign(1, 0, ["ghost"]) == 0  # stale: nothing moves
+
+
+def test_split_backpressure_parks_producer_at_buffer_cap():
+    before = rtm.ingest_snapshot()["backpressure"].get("split", 0)
+    coord = _make_coordinator(range(20), 2, cap=2)
+    got = []
+    parked = False
+    for _ in range(8):
+        r = coord.next_block(0, 0)
+        if r == coord.PARKED:
+            parked = True
+            break
+        got.append(r)
+    assert parked, "slow peer's full buffer must park the producer"
+    after = rtm.ingest_snapshot()["backpressure"].get("split", 0)
+    assert after > before
+    # the slow consumer draining its buffer un-parks the stream
+    assert coord.next_block(1, 0) is not None
+    assert coord.next_block(1, 0) is not None
+    r = coord.next_block(0, 0)
+    assert r not in (None, coord.WAIT, coord.PARKED)
+    # end to end: draining INTERLEAVED (backpressure forces peers to take
+    # turns), everything still arrives exactly once
+    rest = []
+    finished = set()
+    spins = 0
+    while len(finished) < 2:
+        for i in (0, 1):
+            if i in finished:
+                continue
+            nxt = coord.next_block(i, 0)
+            if nxt is None:
+                finished.add(i)
+            elif nxt in (coord.WAIT, coord.PARKED):
+                spins += 1
+                assert spins < 10_000, "livelocked under backpressure"
+            else:
+                rest.append(nxt)
+    # (got + the two c1 pops + r + rest) covers all 20 blocks exactly once
+    total = got + [r] + rest
+    assert len(total) == 18 and len(set(total)) == 18
+
+
+def test_abandoned_peer_buffer_cap_does_not_park_survivors():
+    """A consumer that abandoned its epoch (finish) stops draining its
+    buffer; its cap must not PARK the surviving consumer — the survivor
+    drains its own disjoint half to completion."""
+    coord = _make_coordinator(range(40), 2, cap=2)
+    assert coord.next_block(1, 0) is not None  # c1 takes one block...
+    coord.finish(1, 0)                         # ...then abandons the epoch
+    got = []
+    spins = 0
+    while True:
+        r = coord.next_block(0, 0)
+        if r is None:
+            break
+        if r in (coord.WAIT, coord.PARKED):
+            spins += 1
+            assert spins < 1000, "survivor parked behind the abandoned peer"
+            continue
+        got.append(r)
+    # the survivor still saw its full round-robin half
+    assert len(got) == 20 and len(set(got)) == 20
+
+
+def test_fewer_blocks_than_consumers_terminates_cleanly():
+    """equal=True with 2 blocks and 4 consumers: the empty-assignment
+    consumers see an immediate end-of-epoch, and the next epoch starts
+    once everyone (including them) finished — nobody waits on the
+    self-reaping coordinator."""
+    coord = _make_coordinator(range(2), 4)
+    rows = {i: [] for i in range(4)}
+    for epoch in range(3):
+        finished = set()
+        spins = 0
+        while len(finished) < 4:
+            for i in range(4):
+                if i in finished:
+                    continue
+                r = coord.next_block(i, epoch)
+                if r is None:
+                    finished.add(i)
+                elif r == coord.WAIT or r == coord.PARKED:
+                    spins += 1
+                    assert spins < 1000, "livelocked on WAIT"
+                else:
+                    rows[i].append(r)
+    assert sorted(rows[0] + rows[1] + rows[2] + rows[3]) == [0, 0, 0, 1, 1, 1]
+
+
+# ---------------------------------------------------------------------------
+# Cluster: plasma view path end-to-end + executor consumer-queue backpressure
+# ---------------------------------------------------------------------------
+
+@pytest.mark.timeout(180)
+def test_zero_copy_plasma_views_end_to_end(ray_start_regular):
+    """Blocks produced by read tasks live in plasma; consuming them as
+    aligned numpy batches books ZERO copied bytes — the batch arrays are
+    views over the store's shared memory."""
+    import ray_tpu.data as rd
+
+    ds = rd.range(200_000, parallelism=4)
+    v0, c0 = _bytes_snap("iter")
+    total = 0
+    for b in ds.iter_batches(batch_size=12_500, batch_format="numpy",
+                             prefetch_batches=0):
+        total += len(b["id"])
+        assert b["id"].base is not None
+        assert not b["id"].flags.writeable
+    v1, c1 = _bytes_snap("iter")
+    assert total == 200_000
+    assert c1 - c0 == 0, "plasma-resident aligned stream must not memcpy"
+    assert v1 - v0 == 200_000 * 8
+
+
+@pytest.mark.timeout(180)
+def test_stalled_consumer_bounds_store_bytes_at_op_budget(ray_start_regular):
+    """The end-to-end backpressure invariant: a consumer that stops
+    reading parks the producers — bytes parked downstream of the terminal
+    operator (output buffers + release queue + the CONSUMER queue, the
+    gap this PR closes) stay at the op memory budget instead of growing
+    with output_queue_blocks."""
+    from ray_tpu.data._internal import streaming_executor as se
+    from ray_tpu.data.context import DataContext
+    import ray_tpu.data as rd
+
+    saved = DataContext.get_current()
+    ctx = DataContext()
+    DataContext._current = ctx
+    try:
+        block = 80_000  # ~10k f64 rows
+        ctx.op_memory_budget = 3 * block
+        ctx.max_tasks_in_flight = 2
+        ctx.output_queue_blocks = 32  # pre-fix: 32 more blocks leak here
+        n = 12
+        ds = rd.range(n, parallelism=n).map_batches(
+            lambda b: {"x": np.zeros(block // 8, np.float64)},
+            batch_size=None)
+        it = iter(ds.iter_batches(batch_size=None, prefetch_batches=0))
+        next(it)
+        time.sleep(2.5)  # stalled consumer: producers must park
+        stats = se.LAST_EXECUTOR.stats()
+        (map_stats,) = [v for k, v in stats.items()
+                        if k.startswith("ReadMap")]
+        bound = ctx.op_memory_budget + ctx.max_tasks_in_flight * block
+        assert 0 < map_stats["peak_downstream_bytes"] <= bound, map_stats
+        assert bound < n * block / 2
+        got = 1 + sum(1 for _ in it)
+        assert got == n
+    finally:
+        DataContext._current = saved

@@ -179,7 +179,8 @@ def test_prefetch_overlaps_and_preserves_results(cluster):
     pump_seen = False
     for batch in ds.iter_batches(batch_size=4, prefetch_batches=2):
         pump_seen = pump_seen or any(
-            t.name == "batch-prefetch" for t in threading.enumerate())
+            t.name.startswith("ray_tpu-data-ingest")
+            for t in threading.enumerate())
         _time.sleep(0.05)  # consumer "step": producer runs ahead meanwhile
         out.extend(int(v) for v in batch["id"])
     assert sorted(out) == [i * 2 for i in range(12)]

@@ -94,6 +94,81 @@ def test_streaming_split_early_abandon_no_livelock(ray_start_regular):
     assert ray_tpu.get(full_ref, timeout=120) == [20, 20, 20]
 
 
+def test_elastic_reshard_on_injected_drain_exactly_once(ray_start_regular):
+    """ISSUE 13 acceptance: consumer 2's drain probe fires mid-epoch; its
+    remaining blocks (coordinator buffer + the pulled-but-unresolved ref)
+    move to the survivors — every row delivered exactly once across the
+    gang, none lost, none duplicated."""
+    import threading
+
+    import ray_tpu.data as rd
+    from ray_tpu.data._internal.ingest import DataShard
+
+    total_rows = 300
+    ds = rd.range(total_rows).repartition(30)
+    splits = ds.streaming_split(3, equal=True)
+    seen = {i: [] for i in range(3)}
+    consumed = {"n": 0}
+    drained = {}
+
+    def probe():  # the injected drain: fires after 2 batches on consumer 2
+        return consumed["n"] >= 2
+
+    def consume(i, split):
+        shard = DataShard(split, name=f"c{i}",
+                          drain_probe=probe if i == 2 else (lambda: False))
+        for b in shard.iter_batches(batch_size=10, batch_format="numpy",
+                                    prefetch_batches=0):
+            seen[i].extend(int(v) for v in b["id"])
+            if i == 2:
+                consumed["n"] += 1
+        drained[i] = shard.drained
+
+    threads = [threading.Thread(target=consume, args=(i, s), daemon=True,
+                                name=f"reshard-consumer-{i}")
+               for i, s in enumerate(splits)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+    assert not any(t.is_alive() for t in threads)
+    rows = seen[0] + seen[1] + seen[2]
+    assert sorted(rows) == list(range(total_rows))
+    # the drained consumer stopped early (in-flight window tail only);
+    # the survivors picked its remaining assignment up
+    assert drained == {0: False, 1: False, 2: True}
+    assert len(seen[2]) < 100
+    assert len(seen[0]) + len(seen[1]) == total_rows - len(seen[2])
+
+
+def test_coordinator_self_reap_raises_cleanly(ray_start_regular):
+    """A consumer reconnecting after the coordinator's idle self-reap must
+    get a RuntimeError naming the reap, not a hang."""
+    import time
+
+    import ray_tpu.data as rd
+
+    ds = rd.range(20).repartition(2)
+    splits = ds.streaming_split(2, equal=True, idle_timeout_s=3.0)
+    for s in splits:
+        assert len([r for r in s.iter_rows()]) == 10
+    time.sleep(8)  # idle past the reap (reaper polls every timeout/4)
+    with pytest.raises(RuntimeError, match="self-reap"):
+        list(splits[0].iter_rows())
+
+
+def test_long_first_block_does_not_trip_the_reaper(ray_start_regular):
+    """An in-flight next_block blocked on slow production pins the
+    coordinator alive — the reaper only fires on true idleness."""
+    import ray_tpu.data as rd
+
+    ds = rd.range(8, parallelism=2).map_batches(
+        lambda b: (__import__("time").sleep(2.5), b)[1], batch_size=None)
+    (split,) = ds.streaming_split(1, idle_timeout_s=2.0)
+    rows = [r for r in split.iter_rows()]
+    assert len(rows) == 8
+
+
 def test_streaming_split_dynamic_load_balance(ray_start_regular):
     import ray_tpu.data as rd
 
