@@ -38,6 +38,22 @@ def chain_hash(prev: Optional[int], tokens: Sequence[int]) -> int:
     return int.from_bytes(h.digest(), "little")
 
 
+def content_hash(data, *, extra: bytes = b"") -> int:
+    """Keyed blake2b over a raw byte buffer (bytes / memoryview / anything
+    exposing the buffer protocol, e.g. a C-contiguous numpy array).
+
+    Shared by the checkpoint subsystem's per-leaf delta hashing
+    (train/_internal/snapshot.py): two leaves with identical bytes AND
+    identical ``extra`` (shape/dtype/shard-index framing, so a reshaped or
+    re-typed view never aliases) hash equal across processes and machines —
+    the same stability contract as :func:`chain_hash`.  Returns an unsigned
+    64-bit int (JSON-safe)."""
+    h = hashlib.blake2b(_SEED, digest_size=8)
+    h.update(extra)
+    h.update(data)
+    return int.from_bytes(h.digest(), "little")
+
+
 def prefix_chain_hashes(prompt: Sequence[int], block_size: int,
                         limit: Optional[int] = None) -> List[int]:
     """Chain hashes of the full blocks a prefix-cache match may cover:

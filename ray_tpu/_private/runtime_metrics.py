@@ -434,6 +434,28 @@ DATA_INGEST_WAIT = Counter(
     "bucket)",
     tag_keys=("source",))
 
+# -- train checkpoint/snapshot subsystem (train/_internal/snapshot.py) ------
+# async per-shard snapshots: bytes actually written per persistence kind
+# (full = periodic whole-state snapshot, delta = changed-leaves-only write,
+# replica = host-RAM copy pushed to the ring neighbor), the step-blocking
+# stall the pipeline could NOT hide (backpressure + device→host staging —
+# the <1%-of-step-time acceptance surface), and whether a snapshot is
+# draining on the background thread right now.
+TRAIN_SNAPSHOT_BYTES = Counter(
+    "ray_tpu_train_snapshot_bytes_total",
+    "Checkpoint-subsystem bytes written by kind: full = periodic full "
+    "snapshot, delta = changed leaves only, replica = peer host-RAM push",
+    tag_keys=("kind",))
+TRAIN_SNAPSHOT_STALL = Counter(
+    "ray_tpu_train_snapshot_stall_seconds_total",
+    "Training-thread seconds spent inside SnapshotManager.save(): "
+    "at-most-one-in-flight backpressure plus the device→host staging copy "
+    "— the checkpoint-induced step stall the async pipeline could not hide")
+TRAIN_SNAPSHOT_INFLIGHT = Gauge(
+    "ray_tpu_train_snapshot_inflight",
+    "Snapshots currently draining on the background persistence thread "
+    "(0 or 1: the manager enforces at-most-one-in-flight)")
+
 FAMILIES = (
     SCHEDULE_LATENCY, PENDING_TASKS, SPILLBACKS,
     WORKER_SPAWN_LATENCY, WORKER_SPAWNS, WORKER_SPAWN_TIMEOUTS,
@@ -464,6 +486,7 @@ FAMILIES = (
     DATA_ROWS, DATA_BACKPRESSURE,
     DATA_INGEST_ROWS, DATA_INGEST_BYTES, DATA_INGEST_BUFFER,
     DATA_INGEST_BACKPRESSURE, DATA_INGEST_WAIT,
+    TRAIN_SNAPSHOT_BYTES, TRAIN_SNAPSHOT_STALL, TRAIN_SNAPSHOT_INFLIGHT,
 )
 
 # ---------------------------------------------------------------------------
@@ -609,6 +632,39 @@ def goodput_metrics_snapshot() -> dict:
             d["wall_clock_s"] = round(total, 6)
             d["goodput_ratio"] = round(
                 d["buckets_s"].get("productive_step", 0.0) / total, 4)
+    return out
+
+
+_snapshot_stall = TRAIN_SNAPSHOT_STALL.with_tags()
+_snapshot_inflight = TRAIN_SNAPSHOT_INFLIGHT.with_tags()
+
+
+def inc_snapshot_bytes(kind: str, n: int) -> None:
+    """Bytes the checkpoint subsystem wrote, by persistence kind
+    (full / delta / replica)."""
+    _bound(TRAIN_SNAPSHOT_BYTES, kind=kind).inc(float(n))
+
+
+def add_snapshot_stall(seconds: float) -> None:
+    if seconds > 0:
+        _snapshot_stall.inc(seconds)
+
+
+def set_snapshot_inflight(n: int) -> None:
+    _snapshot_inflight.set(float(n))
+
+
+def snapshot_metrics_snapshot() -> dict:
+    """Process-local checkpoint-subsystem counters for bench.py's
+    ``checkpoint`` block: bytes by kind + total training-thread stall."""
+    out: dict = {"bytes_total": {}}
+    for p in TRAIN_SNAPSHOT_BYTES._snapshot():
+        k = p["tags"].get("kind", "?")
+        out["bytes_total"][k] = out["bytes_total"].get(k, 0.0) + p["value"]
+    for p in TRAIN_SNAPSHOT_STALL._snapshot():
+        out["stall_seconds"] = out.get("stall_seconds", 0.0) + p["value"]
+    for p in TRAIN_SNAPSHOT_INFLIGHT._snapshot():
+        out["inflight"] = p["value"]
     return out
 
 

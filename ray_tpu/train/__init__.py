@@ -13,6 +13,7 @@ from ray_tpu.train._internal.session import (
     get_context,
     get_dataset_shard,
     report,
+    restore_state,
 )
 from ray_tpu.train.backend import Backend, BackendConfig, JaxConfig
 from ray_tpu.train.config import (
@@ -37,6 +38,7 @@ __all__ = [
     "save_sharded",
     "restore_sharded",
     "report",
+    "restore_state",
     "get_context",
     "get_checkpoint",
     "get_dataset_shard",

@@ -36,6 +36,11 @@ class Checkpoint:
         return cls(path)
 
     def as_directory(self):
+        """Context manager over a local view of the checkpoint.  For a
+        remote checkpoint the download lands in a unique ``ckpt_dl_*``
+        temp dir that is removed however the block exits — normal exit,
+        exception, early ``break``/``return`` (generator close), or a
+        download that dies mid-transfer — so no temp dirs leak."""
         @contextlib.contextmanager
         def cm() -> Iterator[str]:
             if not _is_remote(self.path):
@@ -43,8 +48,9 @@ class Checkpoint:
                 return
             from ray_tpu.train._internal.checkpoint_util import download_dir
 
-            tmp = os.path.join(tempfile.gettempdir(),
-                               f"ckpt_dl_{uuid.uuid4().hex[:8]}")
+            # eager unique creation: collision-free under concurrent
+            # callers, and the finally below owns it from the first byte
+            tmp = tempfile.mkdtemp(prefix="ckpt_dl_")
             try:
                 yield download_dir(self.path, tmp)
             finally:
@@ -53,13 +59,35 @@ class Checkpoint:
         return cm()
 
     def to_directory(self, path: Optional[str] = None) -> str:
-        dest = path or os.path.join(tempfile.gettempdir(), f"ckpt_{uuid.uuid4().hex[:8]}")
-        if _is_remote(self.path):
-            from ray_tpu.train._internal.checkpoint_util import download_dir
+        """Materialize the checkpoint at ``path`` (or a fresh temp dir).
 
-            return download_dir(self.path, dest)
-        if os.path.abspath(dest) != self.path:
-            shutil.copytree(self.path, dest, dirs_exist_ok=True)
+        Collision-free under concurrent callers sharing one dest on one
+        host: each caller stages into a unique sibling and commits by
+        rename, so ``dest`` only ever holds one caller's COMPLETE copy —
+        never an interleaving of two mid-flight downloads."""
+        from ray_tpu.train._internal.checkpoint_util import commit_dir_atomic
+
+        dest = path or os.path.join(tempfile.gettempdir(),
+                                    f"ckpt_{uuid.uuid4().hex[:8]}")
+        if not _is_remote(self.path) and os.path.abspath(dest) == self.path:
+            return dest
+        # replace a PRE-EXISTING dest (stale materialization), but accept a
+        # concurrent caller's copy committed while we staged — same
+        # checkpoint, and retiring it would yank the dir from under their
+        # readers
+        replace = os.path.isdir(dest)
+        tmp = f"{dest}.tmp-{uuid.uuid4().hex[:8]}"
+        try:
+            if _is_remote(self.path):
+                from ray_tpu.train._internal.checkpoint_util import download_dir
+
+                download_dir(self.path, tmp)
+            else:
+                shutil.copytree(self.path, tmp)
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        commit_dir_atomic(tmp, dest, replace=replace)
         return dest
 
     def _meta_path(self) -> str:

@@ -35,7 +35,11 @@ class BackendExecutor:
         scaling_config: ScalingConfig,
         run_dir: str,
         checkpoint_config: Optional[CheckpointConfig] = None,
+        replica_holders: Optional[List[Any]] = None,
     ):
+        # ring of ReplicaHolder actors (owned by the trainer, so they
+        # outlive this executor and a drained gang's restart)
+        self._replica_holders = replica_holders or []
         self._backend_config = backend_config
         self._backend = backend_config.backend_cls()
         self._scaling = scaling_config
@@ -69,8 +73,13 @@ class BackendExecutor:
         ]
         local_rank: Dict[str, int] = {}
         node_rank: Dict[str, int] = {}
+        import uuid
+
         import ray_tpu
 
+        # one id per gang attempt: snapshot rank-manifests from a crashed
+        # or resized earlier attempt can never merge with this gang's
+        gang_id = uuid.uuid4().hex
         setup_refs = []
         for rank, (w, nid) in enumerate(zip(self.worker_group.workers, node_ids)):
             lr = local_rank.get(nid, 0)
@@ -88,6 +97,9 @@ class BackendExecutor:
                     run_name=os.path.basename(self._run_dir),
                     storage_path=self._run_dir,
                     dataset_shards=shards,
+                    checkpoint_config=self._ckpt_config,
+                    replica_holders=self._replica_holders,
+                    gang_id=gang_id,
                 )
             )
         ray_tpu.get(setup_refs)
@@ -144,6 +156,17 @@ class BackendExecutor:
     def persist_checkpoint(self, result: Dict[str, Any]) -> Optional[Checkpoint]:
         """Copy a reported checkpoint into the run dir, enforce num_to_keep
         (reference: checkpoint_manager.py keep-top-k)."""
+        snap_dir = result.get("snapshot_dir")
+        if snap_dir is not None:
+            # async snapshot already committed worker-side (manifest-last
+            # atomic rename; retention ran there too with delta-chain
+            # protection) — the driver only records the newest restorable
+            # dir so gang restarts resume from it
+            from ray_tpu._private import flight_recorder
+
+            flight_recorder.record("checkpoint", "snapshot_committed",
+                                   os.path.basename(snap_dir))
+            return Checkpoint(snap_dir)
         ckpt: Optional[Checkpoint] = result.get("checkpoint")
         if ckpt is None:
             return None

@@ -75,23 +75,114 @@ def download_dir(src: str, local_dest: str) -> str:
     return local_dest
 
 
+def commit_dir_atomic(tmp: str, dest: str, replace: bool = True) -> None:
+    """Move a FULLY-staged sibling dir into place.  ``dest`` is never
+    observable partially written: every committed copy is complete, and a
+    caller losing a concurrent race accepts the winner's complete copy
+    (and cleans up its own staging) rather than fighting over the slot.
+
+    ``replace=False`` (concurrent callers staging the SAME content, e.g.
+    to_directory): an existing dest is accepted as-is — no retire/swap, so
+    a reader of the winner's copy never sees the dest vanish mid-read."""
+    import uuid as uuid_mod
+
+    try:
+        os.rename(tmp, dest)  # fast path: dest absent
+        return
+    except FileNotFoundError:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise  # dest's parent is gone — NOT a race; don't claim success
+    except OSError:
+        pass  # dest occupied
+    if not replace and os.path.isdir(dest):
+        shutil.rmtree(tmp, ignore_errors=True)
+        return
+    old = f"{dest}.old-{uuid_mod.uuid4().hex[:8]}"
+    try:
+        os.rename(dest, old)  # retire the previous complete contents
+    except FileNotFoundError:
+        # dest vanished under a concurrent committer mid-swap: retry the
+        # fast path once; if their complete commit landed, accept it
+        try:
+            os.rename(tmp, dest)
+            return
+        except OSError:
+            if os.path.isdir(dest):
+                shutil.rmtree(tmp, ignore_errors=True)
+                return
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+    except OSError:
+        # environmental failure (permissions, I/O): the previous dest is
+        # intact — surface it rather than discarding the staged copy and
+        # reporting success
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    try:
+        os.rename(tmp, dest)
+    except OSError:
+        if os.path.isdir(dest):
+            # a concurrent complete commit took the slot in the window
+            shutil.rmtree(tmp, ignore_errors=True)
+            shutil.rmtree(old, ignore_errors=True)
+            return
+        os.rename(old, dest)  # roll the previous contents back
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    shutil.rmtree(old, ignore_errors=True)
+
+
 def persist_staged_checkpoint(src_path: str, dest: str) -> str:
     """Move (if worker-staged) or copy a local checkpoint dir to ``dest``
-    (local path or fsspec URI), replacing any stale contents."""
+    (local path or fsspec URI).
+
+    Crash-safe replacement: the bytes are fully staged NEXT TO the
+    destination first, then committed by rename (local) or by retiring the
+    old prefix only after the new upload completed (remote) — a crash
+    mid-persist leaves the previous checkpoint intact and restorable (the
+    old rmtree-then-copy order left a corrupt "latest" instead)."""
+    import uuid as uuid_mod
+
+    staged_src = os.path.dirname(src_path).endswith(".staged")
     if is_remote_path(dest):
-        rmtree_any(dest)
-        upload_dir(src_path, dest)
-        if os.path.dirname(src_path).endswith(".staged"):
+        import fsspec
+
+        tag = uuid_mod.uuid4().hex[:8]
+        staging = dest.rstrip("/") + f".staging-{tag}"
+        upload_dir(src_path, staging)  # a crash here never touches dest
+        fs, p_dest = fsspec.core.url_to_fs(dest)
+        _, p_stage = fsspec.core.url_to_fs(staging)
+        # retire-by-rename, never rm-then-upload: at every instant at
+        # least one COMPLETE copy exists under some name (a crash between
+        # the mvs leaves the previous checkpoint at .retired-* and the new
+        # one at .staging-* — recoverable, nothing destroyed)
+        retired = None
+        if fs.exists(p_dest):
+            retired = f"{p_dest}.retired-{tag}"
+            fs.mv(p_dest, retired, recursive=True)
+        fs.mv(p_stage, p_dest, recursive=True)
+        if retired is not None:
+            try:
+                fs.rm(retired, recursive=True)
+            except FileNotFoundError:
+                pass
+        if staged_src:
             shutil.rmtree(src_path, ignore_errors=True)
         return dest
     if os.path.abspath(src_path) == os.path.abspath(dest):
         return dest
-    if os.path.exists(dest):
-        shutil.rmtree(dest)
-    if os.path.dirname(src_path).endswith(".staged"):
-        shutil.move(src_path, dest)
-    else:
-        shutil.copytree(src_path, dest)
+    tmp = f"{dest}.tmp-{uuid_mod.uuid4().hex[:8]}"
+    try:
+        if staged_src:
+            shutil.move(src_path, tmp)
+        else:
+            shutil.copytree(src_path, tmp)
+    except BaseException:
+        # a crash/kill mid-copy leaves only the staging dir; the previous
+        # dest is untouched and still restores
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    commit_dir_atomic(tmp, dest)
     return dest
 
 

@@ -70,7 +70,12 @@ class RayTrainWorker:
                 results.append(s.result_queue.get_nowait())
         except queue_mod.Empty:
             pass
-        finished = s.finished.is_set() and s.result_queue.empty()
+        # a finished train_fn with an async snapshot still draining is NOT
+        # finished: killing the worker now would abandon the final
+        # snapshot mid-persist (crash-safe, but needlessly lost) and drop
+        # its commit notification
+        finished = (s.finished.is_set() and s.result_queue.empty()
+                    and s.persistence_idle())
         err = None
         if s.error is not None:
             import traceback

@@ -73,11 +73,31 @@ class FailureConfig:
 
 @dataclasses.dataclass
 class CheckpointConfig:
-    """reference: air/config.py CheckpointConfig (num_to_keep, attr ordering)."""
+    """reference: air/config.py CheckpointConfig (num_to_keep, attr ordering).
+
+    TPU-native extension — the continuous async snapshot subsystem
+    (train/_internal/snapshot.py), engaged when the train loop reports
+    state pytrees (``train.report(metrics, state=...)``):
+
+    - ``full_snapshot_interval``: every Nth snapshot writes ALL leaves;
+      the ones between are deltas that reference unchanged leaves in an
+      earlier manifest, so the interval bounds how long a delta chain can
+      grow (and how much retention must protect).
+    - ``optimizer_state_interval``: optimizer-state leaves (top-level key
+      in ``optimizer_key_prefixes``) are written every Nth snapshot only;
+      in between, delta manifests reference the last written version even
+      if it changed — params are still captured every snapshot.
+    - ``peer_replicas``: push each member's newest host-RAM shard copy to
+      a ring neighbor so a preempted member restores from peer RAM inside
+      the drain window instead of from storage.
+    """
 
     num_to_keep: Optional[int] = None
     checkpoint_score_attribute: Optional[str] = None
     checkpoint_score_order: str = "max"
+    full_snapshot_interval: int = 8
+    optimizer_state_interval: int = 1
+    peer_replicas: bool = False
 
 
 @dataclasses.dataclass

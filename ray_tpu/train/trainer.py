@@ -96,6 +96,10 @@ class DataParallelTrainer:
         self._resume_checkpoint = resume_from_checkpoint
         self._scaling_policy = scaling_policy
         self._failure_policy = failure_policy
+        # warm peer-replica ring (CheckpointConfig.peer_replicas): holder
+        # actors are owned HERE, not by the executor, so a drained gang's
+        # restart still finds its neighbors' host-RAM shard copies
+        self._replica_holders: List[Any] = []
 
     # -- controller loop (v2-style) -----------------------------------------
     def fit(self) -> Result:
@@ -154,11 +158,15 @@ class DataParallelTrainer:
             if n_workers != scaling.total_workers:
                 scaling = dataclasses.replace(
                     scaling, num_workers=n_workers, topology=None)
+            ckpt_cfg = self._run_config.checkpoint_config
+            if ckpt_cfg is not None and ckpt_cfg.peer_replicas:
+                self._ensure_replica_holders(scaling.total_workers)
             executor = BackendExecutor(
                 self._backend_config,
                 scaling,
                 run_dir,
                 self._run_config.checkpoint_config,
+                replica_holders=list(self._replica_holders),
             )
             try:
                 shards = self._shard_datasets(scaling.total_workers)
@@ -180,6 +188,18 @@ class DataParallelTrainer:
                     # persist same-round checkpoints before acting on an error
                     round_input_wait = 0.0
                     for r in results:
+                        if r.get("snapshot_error") is not None:
+                            # a background persist died (possibly the
+                            # FINAL snapshot, with no later save() to
+                            # raise from): the run continues, but the
+                            # operator must know the latest checkpoint is
+                            # older than they think
+                            logger.error(
+                                "async snapshot step %s failed on rank %s: "
+                                "%s — latest restorable checkpoint is older",
+                                r["metrics"].get("snapshot_step"),
+                                r["rank"], r["snapshot_error"])
+                            continue
                         if r.get("checkpoint") is not None:
                             ledger.mark("checkpoint")
                         ckpt = executor.persist_checkpoint(r)
@@ -196,7 +216,10 @@ class DataParallelTrainer:
                         if iw:
                             round_input_wait = max(round_input_wait,
                                                    float(iw))
-                        if r["rank"] == 0:
+                        if r["rank"] == 0 and r.get("snapshot_dir") is None:
+                            # snapshot-commit notifications ride the same
+                            # queue but are not step results — they must
+                            # not displace the last reported metrics
                             final_metrics = r["metrics"]
                             history.append(r["metrics"])
                     if round_input_wait > 0:
@@ -237,6 +260,7 @@ class DataParallelTrainer:
                             raise _ElasticRegrow(scaling.total_workers,
                                                  grown.num_workers)
                 executor.shutdown()
+                self._shutdown_replica_holders()
                 ledger.stop()
                 ledger.publish(force=True)
                 return Result(
@@ -276,6 +300,7 @@ class DataParallelTrainer:
                     continue
                 failures += 1
                 if failure_policy.make_decision(failures, e) == FailureDecision.RAISE:
+                    self._shutdown_replica_holders()
                     ledger.stop()
                     ledger.publish(force=True)
                     return Result(
@@ -287,6 +312,47 @@ class DataParallelTrainer:
                     failures, e, latest_ckpt,
                 )
                 time.sleep(min(2.0 * failures, 10.0))
+
+    def _ensure_replica_holders(self, n_workers: int):
+        """Grow the ring of ReplicaHolder actors to the gang size.  Holder
+        i receives rank (i-1)'s newest host-RAM shard copy; holders are
+        spread round-robin over the currently-alive nodes (soft affinity —
+        placement never fails over it) so a replica generally lands on a
+        DIFFERENT node than the member it protects and survives that
+        node's preemption.  A holder that still dies with its node just
+        contributes nothing: the gather path skips unreachable holders
+        and restore falls back to storage."""
+        import ray_tpu
+        from ray_tpu.train._internal.snapshot import ReplicaHolder
+        from ray_tpu.util.scheduling_strategies import (
+            NodeAffinitySchedulingStrategy,
+        )
+
+        node_ids = []
+        try:
+            node_ids = [n["node_id"] for n in ray_tpu.nodes() or []
+                        if n.get("state") == "ALIVE"]
+        except Exception:  # noqa: BLE001 — placement hint only
+            pass
+        holder_cls = ray_tpu.remote(ReplicaHolder)
+        while len(self._replica_holders) < n_workers:
+            opts = {"num_cpus": 0}
+            if node_ids:
+                nid = node_ids[len(self._replica_holders) % len(node_ids)]
+                opts["scheduling_strategy"] = NodeAffinitySchedulingStrategy(
+                    nid, soft=True)
+            self._replica_holders.append(
+                holder_cls.options(**opts).remote())
+
+    def _shutdown_replica_holders(self):
+        import ray_tpu
+
+        for h in self._replica_holders:
+            try:
+                ray_tpu.kill(h)
+            except Exception:  # noqa: BLE001 — holder may already be gone
+                pass
+        self._replica_holders = []
 
     @staticmethod
     def _job_id_hex():
