@@ -1223,6 +1223,119 @@ def _bench_data_ingest() -> dict:
         return {"error": str(e)[:200]}
 
 
+_RL_THROUGHPUT_SCRIPT = r"""
+import json, os, time
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["RAY_TPU_DISABLE_METADATA_SERVER"] = "1"
+os.environ.setdefault("RAY_TPU_WORKER_QUIET", "1")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import ray_tpu
+from ray_tpu._private import runtime_metrics as _rm
+from ray_tpu.rllib import AnakinConfig, IMPALAConfig
+
+out = {}
+
+# -- Anakin: co-located fully-jitted rollout+update over all host devices --
+cfg = AnakinConfig(env="CartPole-v1", num_envs=256, unroll_length=32,
+                   updates_per_iter=4, seed=0)
+algo = cfg.algo_class(cfg)
+algo.train()  # compile + warm
+n = 0
+t0 = time.perf_counter()
+for _ in range(6):
+    r = algo.train()
+    n += algo.steps_per_iter
+dt = time.perf_counter() - t0
+algo.stop()
+D = r["num_devices"]
+out["anakin"] = {
+    "env_steps_per_sec": round(n / dt, 1),
+    "env_steps_per_sec_per_device": round(n / dt / D, 1),
+    "num_devices": D,
+    "num_envs_per_device": cfg.num_envs,
+    "unroll_length": cfg.unroll_length,
+    "episode_reward_mean": round(r["episode_reward_mean"], 2),
+}
+
+# -- Sebulba vs the synchronous-path A/B on a real local cluster ----------
+ray_tpu.init(num_cpus=4)
+
+def run_impala(iters, **training):
+    algo = (IMPALAConfig()
+            .environment("CartPole-v1")
+            .env_runners(num_env_runners=3, num_envs_per_runner=16,
+                         rollout_fragment_length=256)
+            .training(lr=1.2e-3, **training)
+            .build())
+    try:
+        r = algo.train()  # compile + staff the pipeline
+        steps0 = r["num_env_steps_sampled"]
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            r = algo.train()
+        dt = time.perf_counter() - t0
+        steps = r["num_env_steps_sampled"] - steps0
+        row = {"env_steps_per_sec": round(steps / dt, 1),
+               "episode_reward_mean": round(r["episode_reward_mean"], 2)}
+        if getattr(algo, "_sebulba", None) is not None:
+            s = algo._sebulba.stats()
+            g = algo._sebulba.goodput()
+            row.update({
+                "policy_lag_mean": round(s["policy_lag_mean"], 2),
+                "policy_lag_max": s["policy_lag_max"],
+                "sample_queue_depth": s["sample_queue_depth"],
+                "sample_queue_capacity": s["sample_queue_capacity"],
+                "fragments_consumed": s["fragments_consumed"],
+                "fragments_dropped": s["fragments_dropped"],
+                "channel_bytes": s["channel_bytes"],
+                "channel_busbw_gbps": round(
+                    s["channel_bytes"] / dt / 1e9, 4),
+                "learner_goodput_ratio": round(
+                    g["buckets_s"]["productive_step"]
+                    / max(g["wall_clock_s"], 1e-9), 4),
+            })
+        return row
+    finally:
+        algo.stop()
+
+ITERS = 40
+out["sync_baseline"] = run_impala(ITERS)
+out["sebulba"] = run_impala(ITERS, execution="sebulba",
+                            sample_queue_capacity=8, pipeline_depth=2)
+out["sebulba_channel"] = run_impala(
+    ITERS, execution="sebulba", fragment_transport="channel",
+    sample_queue_capacity=8, pipeline_depth=2)
+out["sebulba_vs_sync_x"] = round(
+    out["sebulba"]["env_steps_per_sec"]
+    / max(out["sync_baseline"]["env_steps_per_sec"], 1e-9), 3)
+out["rl"] = _rm.rl_snapshot()
+ray_tpu.shutdown()
+print("RL_THROUGHPUT " + json.dumps(out))
+"""
+
+
+def _bench_rl_throughput() -> dict:
+    """Podracer-class RL execution paths (ISSUE 15): Anakin env-steps/s per
+    device (rollout+V-trace update fused into one jitted program over the 8
+    virtual host devices), and the decoupled Sebulba path A/B'd against the
+    synchronous sample-the-group baseline on a real local cluster —
+    env-steps/s, sample-queue occupancy, measured policy lag,
+    fragment-channel busbw, and the learner's goodput split.  Subprocess
+    for the same reason as core_perf (cluster runtime on CPU keeps the TPU
+    bench process clean)."""
+    try:
+        p = subprocess.run([sys.executable, "-c", _RL_THROUGHPUT_SCRIPT],
+                           capture_output=True, text=True, timeout=420)
+        for line in p.stdout.splitlines():
+            if line.startswith("RL_THROUGHPUT "):
+                return json.loads(line[len("RL_THROUGHPUT "):])
+        return {"error": (p.stdout + p.stderr)[-300:]}
+    except Exception as e:  # noqa: BLE001
+        return {"error": str(e)[:200]}
+
+
 def _bench_checkpoint() -> dict:
     """Continuous async checkpointing (ISSUE 14) at the ~1GiB acceptance
     geometry: per-step stall sync vs async (same snapshot machinery, one
@@ -1391,6 +1504,16 @@ def _ingest_snapshot() -> dict:
         from ray_tpu._private import runtime_metrics
 
         return runtime_metrics.ingest_snapshot()
+    except Exception as e:  # noqa: BLE001
+        return {"error": str(e)[:200]}
+
+
+def _rl_snapshot() -> dict:
+    """RL execution-path counters recorded during the benches above."""
+    try:
+        from ray_tpu._private.runtime_metrics import rl_snapshot
+
+        return rl_snapshot()
     except Exception as e:  # noqa: BLE001
         return {"error": str(e)[:200]}
 
@@ -1595,6 +1718,7 @@ def main():
         ("serving", lambda: _bench_serving(on_tpu), 900.0),
         ("serving_disagg", lambda: _bench_serving_disagg(on_tpu), 900.0),
         ("core_perf", _bench_core_perf, 600.0),
+        ("rl_throughput", _bench_rl_throughput, 600.0),
         ("data_ingest", _bench_data_ingest, 600.0),
         ("checkpoint", _bench_checkpoint, 900.0),
         ("control_plane", _bench_control_plane, 600.0),
@@ -1620,6 +1744,7 @@ def main():
         "trace_summary": _trace_summary_snapshot(),
         "goodput": _goodput_snapshot(),
         "ingest": _ingest_snapshot(),
+        "rl": _rl_snapshot(),
         "prefix_cache": _prefix_cache_snapshot(),
         "kv_handoff": _kv_handoff_snapshot(),
         "specdec": _specdec_snapshot(),

@@ -456,6 +456,30 @@ TRAIN_SNAPSHOT_INFLIGHT = Gauge(
     "Snapshots currently draining on the background persistence thread "
     "(0 or 1: the manager enforces at-most-one-in-flight)")
 
+# -- rllib RL execution paths (rllib/anakin.py, rllib/sebulba.py) -----------
+# Podracer-class throughput accounting: env-steps by execution path (anakin =
+# co-located fully-jitted rollout+update, sebulba = decoupled EnvRunner
+# actors streaming fragments to the learner, sync = the synchronous
+# sample-the-group baseline), the Sebulba bounded sample queue's live depth
+# (the backpressure surface between continuous samplers and the learner),
+# and the measured policy lag (learner version minus the behavior version a
+# fragment was sampled under — the staleness V-trace is correcting).
+RL_ENV_STEPS = Counter(
+    "ray_tpu_rl_env_steps_total",
+    "Environment transitions consumed by an RL execution path (rate() = "
+    "env-steps/s), by path: anakin / sebulba / async / sync",
+    tag_keys=("path",))
+RL_SAMPLE_QUEUE_DEPTH = Gauge(
+    "ray_tpu_rl_sample_queue_depth",
+    "Fragments buffered in the Sebulba learner's bounded sample queue "
+    "(capacity caps runner-ahead-of-learner staleness)")
+RL_POLICY_LAG = Histogram(
+    "ray_tpu_rl_policy_lag_updates",
+    "Learner updates between a fragment's behavior policy version and the "
+    "learner version that consumed it (0 = on-policy; V-trace's importance "
+    "ratios correct the rest)",
+    boundaries=[0.0, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0], tag_keys=())
+
 FAMILIES = (
     SCHEDULE_LATENCY, PENDING_TASKS, SPILLBACKS,
     WORKER_SPAWN_LATENCY, WORKER_SPAWNS, WORKER_SPAWN_TIMEOUTS,
@@ -487,6 +511,7 @@ FAMILIES = (
     DATA_INGEST_ROWS, DATA_INGEST_BYTES, DATA_INGEST_BUFFER,
     DATA_INGEST_BACKPRESSURE, DATA_INGEST_WAIT,
     TRAIN_SNAPSHOT_BYTES, TRAIN_SNAPSHOT_STALL, TRAIN_SNAPSHOT_INFLIGHT,
+    RL_ENV_STEPS, RL_SAMPLE_QUEUE_DEPTH, RL_POLICY_LAG,
 )
 
 # ---------------------------------------------------------------------------
@@ -1078,6 +1103,38 @@ def inc_ingest_backpressure(stage: str) -> None:
 def add_ingest_wait(source: str, seconds: float) -> None:
     if seconds > 0:
         _bound(DATA_INGEST_WAIT, source=source).inc(seconds)
+
+
+def add_rl_env_steps(path: str, n: int) -> None:
+    if n > 0:
+        _bound(RL_ENV_STEPS, path=path).inc(n)
+
+
+def set_rl_queue_depth(n: int) -> None:
+    _bound(RL_SAMPLE_QUEUE_DEPTH).set(n)
+
+
+def observe_rl_policy_lag(lag: float) -> None:
+    _bound(RL_POLICY_LAG).observe(max(0.0, float(lag)))
+
+
+def rl_snapshot() -> dict:
+    """Process-local RL execution-path accounting for bench.py and the
+    perf gates: env steps per path, the Sebulba sample queue's last
+    depth, and the policy-lag distribution (count / sum / mean).
+    Hermetic — this process's counters only."""
+    out: dict = {"env_steps": {}, "queue_depth": 0.0,
+                 "policy_lag": {"count": 0.0, "sum": 0.0, "mean": 0.0}}
+    for tags_key, v in dict(RL_ENV_STEPS._points).items():
+        out["env_steps"][dict(tags_key).get("path", "?")] = v
+    for _tags_key, v in dict(RL_SAMPLE_QUEUE_DEPTH._points).items():
+        out["queue_depth"] = v
+    for _tags_key, st in dict(RL_POLICY_LAG._hist).items():
+        # histogram state is [bucket counts, sum, count]
+        s, cnt = float(st[1]), float(st[2])
+        out["policy_lag"] = {"count": cnt, "sum": s,
+                             "mean": (s / cnt) if cnt else 0.0}
+    return out
 
 
 def ingest_snapshot() -> dict:

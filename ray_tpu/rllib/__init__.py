@@ -10,6 +10,7 @@ vectorized numpy envs.
 """
 
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig, PPO, PPOConfig
+from ray_tpu.rllib.anakin import Anakin, AnakinConfig, build_anakin_fns
 from ray_tpu.rllib.appo import APPO, APPOConfig, APPOLearner
 from ray_tpu.rllib.connectors import (
     ActionClip,
@@ -31,10 +32,20 @@ from ray_tpu.rllib.dreamerv3 import (
     DreamerV3Learner,
     SequenceReplay,
 )
-from ray_tpu.rllib.env import CartPoleEnv, EnvSpec, PendulumEnv, register_env
+from ray_tpu.rllib.env import (
+    CartPoleEnv,
+    EnvSpec,
+    JaxCartPoleEnv,
+    JaxEnv,
+    PendulumEnv,
+    make_jax_env,
+    register_env,
+    register_jax_env,
+)
 from ray_tpu.rllib.env_runner import EnvRunner
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, IMPALALearner, vtrace
 from ray_tpu.rllib.learner import PPOLearner
+from ray_tpu.rllib.sebulba import SebulbaExecutor
 from ray_tpu.rllib.multi_agent import (
     MultiAgentCartPole,
     MultiAgentEnv,
@@ -52,6 +63,14 @@ __all__ = [
     "ActionClip",
     "Algorithm",
     "AlgorithmConfig",
+    "Anakin",
+    "AnakinConfig",
+    "build_anakin_fns",
+    "JaxCartPoleEnv",
+    "JaxEnv",
+    "make_jax_env",
+    "register_jax_env",
+    "SebulbaExecutor",
     "APPO",
     "APPOConfig",
     "APPOLearner",

@@ -87,37 +87,47 @@ class Algorithm:
     """Owns the learner + the EnvRunner actor group."""
 
     def __init__(self, config: AlgorithmConfig):
-        import ray_tpu
         from ray_tpu.rllib.env import make_env
-        from ray_tpu.rllib.env_runner import EnvRunner
 
         self.config = config
         if config.env is None:
             raise ValueError("config.environment(env) is required")
         probe = make_env(config.env)
         self._spec = probe.spec
-        module_spec = {
+        self._module_spec = {
             "spec": {"obs_dim": config.module_obs_dim or probe.spec.obs_dim,
                      "num_actions": probe.spec.num_actions},
             "hidden": tuple(config.hidden),
         }
         self._learner = self._build_learner()
-        e2m = config.env_to_module_connector
-        m2e = config.module_to_env_connector
-        self._runners = [
-            ray_tpu.remote(EnvRunner).options(num_cpus=0.5).remote(
-                config.env, module_spec,
-                num_envs=config.num_envs_per_runner,
-                seed=config.seed + i,
-                rollout_fragment_length=config.rollout_fragment_length,
-                env_to_module=e2m() if e2m is not None else None,
-                module_to_env=m2e() if m2e is not None else None)
-            for i in range(config.num_env_runners)
-        ]
+        self._runners = self._build_runners()
         self._iteration = 0
 
     def _build_learner(self):
         raise NotImplementedError
+
+    def _build_runners(self):
+        """The EnvRunner actor group.  Note Anakin does NOT flow through
+        here (or through Algorithm.__init__ at all) — it owns its __init__
+        wholesale and keeps an empty runner list, because its envs live
+        inside the jitted device program."""
+        import ray_tpu
+        from ray_tpu.rllib.env_runner import EnvRunner
+
+        config = self.config
+        e2m = config.env_to_module_connector
+        m2e = config.module_to_env_connector
+        return [
+            ray_tpu.remote(EnvRunner).options(num_cpus=0.5).remote(
+                config.env, self._module_spec,
+                num_envs=config.num_envs_per_runner,
+                seed=config.seed + i,
+                rollout_fragment_length=config.rollout_fragment_length,
+                env_to_module=e2m() if e2m is not None else None,
+                module_to_env=m2e() if m2e is not None else None,
+                inference=getattr(config, "runner_inference", "numpy"))
+            for i in range(config.num_env_runners)
+        ]
 
     def train(self) -> Dict[str, Any]:
         """One iteration: sample the runner group, update, report metrics."""
@@ -135,6 +145,11 @@ class Algorithm:
         merged["bootstrap_value"] = np.concatenate(
             [b["bootstrap_value"] for b in batches], axis=0)
         learn_stats = self._learner.update(merged)
+        from ray_tpu._private import runtime_metrics
+
+        runtime_metrics.add_rl_env_steps(
+            "sync", int(merged["rewards"].shape[0]
+                        * merged["rewards"].shape[1]))
         stats = ray_tpu.get([r.episode_stats.remote() for r in self._runners])
         rewards = [s["episode_reward_mean"] for s in stats if s["episodes_total"]]
         self._iteration += 1

@@ -1,0 +1,301 @@
+"""Anakin: co-located, fully-jitted RL (rollout + V-trace update, one program).
+
+reference: the Podracer architectures (arxiv 2104.06272) — Anakin puts the
+environment INSIDE the accelerator program: envs are pure jax step
+functions, so one jitted program runs ``lax.scan`` over (env step →
+inference → store transition), vmapped over a batch of envs, and the
+V-trace update consumes the trajectory without a single host round-trip.
+``jax.pmap`` replicates that program over every local chip (gradients
+pmean-reduced over the ``batch`` axis), which is how the paper saturates a
+TPU with millions of env-steps/s on classic-control envs.
+
+Two loss heads share the machinery, mirroring impala.py / appo.py:
+``loss="impala"`` is the plain V-trace policy gradient; ``loss="appo"`` is
+the PPO clipped surrogate on V-trace-corrected advantages.  Because the
+rollout runs under the CURRENT params, behavior == target policy (rhos =
+1): V-trace degenerates to n-step returns exactly as the paper's on-policy
+special case, and the same jitted program is also the bit-reference for the
+off-policy Sebulba/IMPALA math.
+
+Everything jit-relevant flows in as ARGUMENTS (params, env state, PRNG
+keys) — never closed-over constants — so weight updates can never retrigger
+compilation (the same compile-safety rule env_runner.py enforces for the
+decoupled path).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+
+
+@dataclasses.dataclass
+class AnakinConfig(AlgorithmConfig):
+    """Knobs for the co-located path.  ``num_env_runners`` /
+    ``rollout_fragment_length`` are ignored — there are no runner actors;
+    instead ``num_envs`` envs per device unroll ``unroll_length`` steps per
+    update, and ``updates_per_iter`` updates are scanned inside ONE jitted
+    call per ``train()``."""
+
+    num_envs: int = 64            # env batch per device (vmapped)
+    unroll_length: int = 16       # T: scan steps per update
+    updates_per_iter: int = 8     # updates fused into one device program
+    num_devices: Optional[int] = None  # None = every local jax device
+    loss: str = "impala"          # "impala" | "appo"
+    lr: float = 6e-4
+    vf_coef: float = 0.5
+    entropy_coef: float = 0.01
+    clip_rho: float = 1.0
+    clip_c: float = 1.0
+    clip_param: float = 0.3       # appo surrogate clip
+    max_grad_norm: float = 40.0
+
+    @property
+    def algo_class(self):
+        return Anakin
+
+
+def build_anakin_fns(module, env, cfg: AnakinConfig):
+    """(init_fn, update_fn) — the pure jax core, exposed for tests.
+
+    ``init_fn(key) -> (params, opt_state, carry)`` and
+    ``update_fn(params, opt_state, carry, key, axis_name=None)
+    -> (params, opt_state, carry, aux)`` run ONE rollout+update.  The
+    Anakin class scans ``updates_per_iter`` of these inside jit and pmaps
+    the scan over devices; tests drive ``update_fn`` step-by-step from the
+    host to prove the fused program computes the same thing.
+
+    carry = (env_state pytree [N, ...], obs [N, obs_dim], ep_return [N],
+    completed_return_sum, completed_count) — episode statistics live inside
+    the program so reporting them costs no extra host transfer.
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.rllib.impala import vtrace
+
+    N, T = cfg.num_envs, cfg.unroll_length
+    optimizer = optax.chain(
+        optax.clip_by_global_norm(cfg.max_grad_norm),
+        optax.rmsprop(cfg.lr, decay=0.99, eps=0.1) if cfg.loss == "impala"
+        else optax.adam(cfg.lr))
+
+    v_reset = jax.vmap(env.reset)
+    v_step = jax.vmap(env.step)
+    v_observe = jax.vmap(env.observe)
+
+    def init_fn(key):
+        k_params, k_envs = jax.random.split(key)
+        params = module.init(k_params)
+        opt_state = optimizer.init(params)
+        env_state = v_reset(jax.random.split(k_envs, N))
+        carry = (env_state, v_observe(env_state),
+                 jnp.zeros((N,), jnp.float32),
+                 jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32))
+        return params, opt_state, carry
+
+    def _one_step(params, c, key):
+        env_state, obs, ep_ret, c_sum, c_cnt = c
+        logits, value = module.forward(params, obs)
+        k_act, k_reset = jax.random.split(key)
+        actions = jax.random.categorical(k_act, logits)
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(logp_all, actions[:, None], axis=1)[:, 0]
+        stepped, next_obs, reward, done = v_step(env_state, actions)
+        # auto-reset under the done mask: reset randomness stays keyed
+        fresh = v_reset(jax.random.split(k_reset, N))
+
+        def sel(a, b):
+            return jnp.where(done.reshape((-1,) + (1,) * (a.ndim - 1)), a, b)
+
+        env_state = jax.tree.map(sel, fresh, stepped)
+        next_obs = jnp.where(done[:, None], v_observe(env_state), next_obs)
+        ep_ret = ep_ret + reward
+        c_sum = c_sum + jnp.sum(jnp.where(done, ep_ret, 0.0))
+        c_cnt = c_cnt + jnp.sum(done.astype(jnp.float32))
+        ep_ret = jnp.where(done, 0.0, ep_ret)
+        del value  # _loss recomputes values under the grad trace; carrying
+        # behavior values through the scan would be dead [T, N] output
+        tr = {"obs": obs, "actions": actions, "rewards": reward,
+              "dones": done, "logp": logp}
+        return (env_state, next_obs, ep_ret, c_sum, c_cnt), tr
+
+    def _loss(params, traj, bootstrap_value):
+        obs = traj["obs"].reshape(T * N, -1)
+        logits, values_flat = module.forward(params, obs)
+        logp_all = jax.nn.log_softmax(logits)
+        actions = traj["actions"].reshape(T * N)
+        target_logp = jnp.take_along_axis(
+            logp_all, actions[:, None], axis=1)[:, 0].reshape(T, N)
+        values = values_flat.reshape(T, N)
+        vs, pg_adv = vtrace(
+            traj["logp"], target_logp, traj["rewards"], values,
+            bootstrap_value, traj["dones"], cfg.gamma,
+            cfg.clip_rho, cfg.clip_c)
+        if cfg.loss == "appo":
+            adv = (pg_adv - pg_adv.mean()) / (pg_adv.std() + 1e-8)
+            ratio = jnp.exp(target_logp - traj["logp"])
+            surr = jnp.minimum(
+                ratio * adv,
+                jnp.clip(ratio, 1 - cfg.clip_param, 1 + cfg.clip_param) * adv)
+            policy_loss = -jnp.mean(surr)
+        else:
+            policy_loss = -jnp.mean(target_logp * pg_adv)
+        value_loss = 0.5 * jnp.mean((values - vs) ** 2)
+        entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+        total = (policy_loss + cfg.vf_coef * value_loss
+                 - cfg.entropy_coef * entropy)
+        return total, {"policy_loss": policy_loss, "value_loss": value_loss,
+                       "entropy": entropy}
+
+    def update_fn(params, opt_state, carry, key, axis_name=None):
+        k_roll, _ = jax.random.split(key)
+
+        def scan_step(c, k):
+            return _one_step(params, c, k)
+
+        carry, traj = jax.lax.scan(scan_step, carry,
+                                   jax.random.split(k_roll, T))
+        _, bootstrap_value = module.forward(params, carry[1])
+        (_, aux), grads = jax.value_and_grad(_loss, has_aux=True)(
+            params, traj, bootstrap_value)
+        if axis_name is not None:
+            grads = jax.lax.pmean(grads, axis_name)
+            aux = jax.tree.map(lambda x: jax.lax.pmean(x, axis_name), aux)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, carry, aux
+
+    return init_fn, update_fn
+
+
+class Anakin(Algorithm):
+    """Algorithm driver for the co-located path: no EnvRunner actors — the
+    env batch lives inside the pmapped program.  ``train()`` dispatches ONE
+    device call covering ``updates_per_iter`` rollout+update cycles across
+    every device and reads back only scalar stats."""
+
+    def __init__(self, config: AnakinConfig):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.rllib.core.rl_module import RLModule
+        from ray_tpu.rllib.env import make_jax_env
+
+        if config.env is None:
+            raise ValueError("config.environment(env) is required")
+        if config.loss not in ("impala", "appo"):
+            raise ValueError(f"AnakinConfig.loss must be 'impala' or 'appo', "
+                             f"got {config.loss!r}")
+        self.config = config
+        self._env = make_jax_env(config.env)
+        self._spec = self._env.spec
+        self._module = RLModule(self._spec, hidden=tuple(config.hidden))
+        devices = jax.local_devices()
+        if config.num_devices is not None:
+            devices = devices[:config.num_devices]
+        self._devices = devices
+        D = len(devices)
+        self._runners = []  # no actor group: Algorithm.stop() is a no-op
+        self._iteration = 0
+        self._env_steps = 0
+        self._last_wall: Optional[float] = None
+        self._steps_per_sec = 0.0
+
+        init_fn, update_fn = build_anakin_fns(self._module, self._env, config)
+
+        def update_many(params, opt_state, carry, key):
+            def body(c, k):
+                params, opt_state, carry = c
+                params, opt_state, carry, aux = update_fn(
+                    params, opt_state, carry, k, axis_name="batch")
+                return (params, opt_state, carry), aux
+
+            keys = jax.random.split(key, config.updates_per_iter)
+            (params, opt_state, carry), aux = jax.lax.scan(
+                body, (params, opt_state, carry), keys)
+            return params, opt_state, carry, jax.tree.map(jnp.mean, aux)
+
+        self._pmapped = jax.pmap(update_many, axis_name="batch",
+                                 devices=devices)
+
+        # per-device init: params replicated, env states/keys distinct
+        key = jax.random.PRNGKey(config.seed)
+        params, opt_state, _ = init_fn(key)
+        self._params = jax.device_put_replicated(params, devices)
+        self._opt_state = jax.device_put_replicated(opt_state, devices)
+        carries = [init_fn(jax.random.PRNGKey(config.seed + 1 + d))[2]
+                   for d in range(D)]
+        self._carry = jax.tree.map(
+            lambda *xs: jax.device_put_sharded(list(xs), devices), *carries)
+        self._keys = jax.random.split(
+            jax.random.PRNGKey(config.seed + 4242), D)
+        # completed-episode totals live HOST-side (python floats, exact to
+        # 2^53); the device-carry accumulators are zeroed every train() so
+        # the float32 scalars can never saturate at 2^24 on long runs
+        self._episodes_total = 0.0
+
+    @property
+    def steps_per_iter(self) -> int:
+        cfg = self.config
+        return (cfg.num_envs * cfg.unroll_length * cfg.updates_per_iter
+                * len(self._devices))
+
+    def train(self) -> Dict[str, Any]:
+        import jax
+
+        from ray_tpu._private import flight_recorder, runtime_metrics
+
+        t0 = time.perf_counter()
+        # per-iteration keys derive from the fixed per-device base via
+        # fold_in(iteration): streams never collide with the update keys the
+        # device program splits off internally
+        iter_keys = jax.vmap(
+            jax.random.fold_in, in_axes=(0, None))(self._keys,
+                                                   self._iteration)
+        self._params, self._opt_state, self._carry, aux = self._pmapped(
+            self._params, self._opt_state, self._carry, iter_keys)
+        aux = jax.tree.map(lambda x: float(np.asarray(x)[0]), aux)
+        # episodes completed THIS iteration, then the device accumulators
+        # are zeroed (bounded per-iteration magnitudes keep f32 exact; the
+        # running total is a host float)
+        c_sum = float(np.sum(np.asarray(self._carry[3])))
+        c_cnt = float(np.sum(np.asarray(self._carry[4])))
+        self._episodes_total += c_cnt
+        self._carry = self._carry[:3] + (
+            jax.numpy.zeros_like(self._carry[3]),
+            jax.numpy.zeros_like(self._carry[4]))
+        dt = time.perf_counter() - t0
+        n = self.steps_per_iter
+        self._env_steps += n
+        self._steps_per_sec = n / max(dt, 1e-9)
+        self._iteration += 1
+        runtime_metrics.add_rl_env_steps("anakin", n)
+        flight_recorder.record(
+            "rl", "anakin_iter",
+            {"iter": self._iteration, "steps": n,
+             "steps_per_sec": round(self._steps_per_sec, 1)})
+        return {
+            "training_iteration": self._iteration,
+            "episode_reward_mean": (c_sum / c_cnt) if c_cnt else 0.0,
+            "episodes_total": self._episodes_total,
+            "num_env_steps_sampled": self._env_steps,
+            "env_steps_per_sec": self._steps_per_sec,
+            "num_devices": len(self._devices),
+            **aux,
+        }
+
+    def get_policy_params(self):
+        """Host copy of the (replicated) params from device 0."""
+        import jax
+
+        return jax.tree.map(lambda x: np.asarray(x[0]), self._params)
+
+    def stop(self):
+        pass  # no actor group to tear down

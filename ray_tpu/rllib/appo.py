@@ -102,9 +102,11 @@ class APPOLearner:
         return params, opt_state, aux
 
     def update(self, samples: Dict[str, np.ndarray]) -> Dict[str, float]:
-        jb = {k: jnp.asarray(v) for k, v in samples.items()}
+        from ray_tpu.rllib.learner import device_batch
+
         self.params, self.opt_state, aux = self._update(
-            self.params, self.target_params, self.opt_state, jb)
+            self.params, self.target_params, self.opt_state,
+            device_batch(samples))
         self._updates += 1
         if self._updates % self.cfg.target_update_freq == 0:
             self.target_params = jax.tree.map(lambda x: x, self.params)
@@ -113,11 +115,29 @@ class APPOLearner:
     def get_params(self):
         return self.params
 
+    def get_state(self):
+        return {"params": self.params, "target_params": self.target_params,
+                "opt_state": self.opt_state, "updates": self._updates}
+
+    def set_state(self, state):
+        """Restore params + target + optimizer state (checkpoint
+        round-trip; the target sync counter restores too, so the
+        periodicity survives a restart)."""
+        self.params = jax.tree.map(jnp.asarray, state["params"])
+        self.target_params = jax.tree.map(jnp.asarray, state["target_params"])
+        self.opt_state = jax.tree.map(jnp.asarray, state["opt_state"])
+        self._updates = int(state.get("updates", 0))
+
 
 class APPO(IMPALA):
-    """The async train loop is IMPALA's verbatim (one in-flight fragment per
-    runner, per-runner refill with fresh weights); only the learner differs
-    (reference: appo.py subclasses Impala the same way)."""
+    """The execution paths are IMPALA's verbatim — ``execution="async"``
+    (one in-flight fragment per runner, per-runner refill with fresh
+    weights) or ``execution="sebulba"`` (decoupled continuous sampling
+    through the bounded queue) — only the learner differs (reference:
+    appo.py subclasses Impala the same way).  Under Sebulba the V-trace
+    correction runs against the TARGET policy while the surrogate clips
+    against the measured-stale behavior logp, which is exactly the
+    asynchrony APPO's trust region was designed for."""
 
     def _build_learner(self):
         cfg: APPOConfig = self.config  # type: ignore[assignment]

@@ -138,10 +138,103 @@ class PendulumEnv(Env):
         return self._obs(), -float(cost), done, {}
 
 
+class JaxEnv:
+    """Functional env for the Anakin path: state is a pytree, ``reset`` /
+    ``step`` / ``observe`` are pure jax functions, so a whole rollout can
+    live inside one jitted program (vmapped over an env batch, scanned over
+    time; reference: the Podracer paper's Anakin architecture, arxiv
+    2104.06272).  Termination does NOT auto-reset — the rollout loop
+    selects between the stepped and a freshly-reset state under the done
+    mask, so reset randomness stays under the caller's PRNG key."""
+
+    spec: EnvSpec
+
+    def reset(self, key):
+        """key -> state pytree."""
+        raise NotImplementedError
+
+    def observe(self, state):
+        """state -> obs [obs_dim] float32."""
+        raise NotImplementedError
+
+    def step(self, state, action):
+        """(state, action) -> (next_state, obs, reward, done)."""
+        raise NotImplementedError
+
+
+class JaxCartPoleEnv(JaxEnv):
+    """Pure-jax twin of :class:`CartPoleEnv` — same constants, same update
+    order, same termination rule, reward 1.0 every step, so episode return
+    equals episode length exactly like the numpy env (float32 vs the numpy
+    env's float64 intermediate math is the only difference)."""
+
+    spec = EnvSpec(obs_dim=4, num_actions=2)
+
+    def reset(self, key):
+        import jax
+
+        phys = jax.random.uniform(key, (4,), minval=-0.05, maxval=0.05)
+        import jax.numpy as jnp
+
+        return {"phys": phys.astype(jnp.float32),
+                "steps": jnp.zeros((), jnp.int32)}
+
+    def observe(self, state):
+        return state["phys"]
+
+    def step(self, state, action):
+        import jax.numpy as jnp
+
+        C = CartPoleEnv
+        x, x_dot, theta, theta_dot = (state["phys"][0], state["phys"][1],
+                                      state["phys"][2], state["phys"][3])
+        force = jnp.where(action == 1, C.FORCE, -C.FORCE)
+        cos_t, sin_t = jnp.cos(theta), jnp.sin(theta)
+        total_mass = C.CART_MASS + C.POLE_MASS
+        pole_ml = C.POLE_MASS * C.POLE_HALF_LENGTH
+        temp = (force + pole_ml * theta_dot ** 2 * sin_t) / total_mass
+        theta_acc = (C.GRAVITY * sin_t - cos_t * temp) / (
+            C.POLE_HALF_LENGTH
+            * (4.0 / 3.0 - C.POLE_MASS * cos_t ** 2 / total_mass))
+        x_acc = temp - pole_ml * theta_acc * cos_t / total_mass
+        x = x + C.DT * x_dot
+        x_dot = x_dot + C.DT * x_acc
+        theta = theta + C.DT * theta_dot
+        theta_dot = theta_dot + C.DT * theta_acc
+        steps = state["steps"] + 1
+        nxt = {"phys": jnp.stack([x, x_dot, theta, theta_dot]).astype(jnp.float32),
+               "steps": steps}
+        done = ((jnp.abs(x) > C.X_LIMIT) | (jnp.abs(theta) > C.THETA_LIMIT)
+                | (steps >= C.MAX_STEPS))
+        return nxt, nxt["phys"], jnp.float32(1.0), done
+
+
 _ENV_REGISTRY: Dict[str, Callable[[], Env]] = {
     "CartPole-v1": CartPoleEnv,
     "Pendulum-v1": PendulumEnv,
 }
+
+# jax twins keyed by the SAME names as their numpy siblings, so an
+# AnakinConfig can take the env id the synchronous path already uses
+_JAX_ENV_REGISTRY: Dict[str, Callable[[], JaxEnv]] = {
+    "CartPole-v1": JaxCartPoleEnv,
+}
+
+
+def register_jax_env(name: str, creator: Callable[[], JaxEnv]):
+    """Register a functional jax env for the Anakin execution path."""
+    _JAX_ENV_REGISTRY[name] = creator
+
+
+def make_jax_env(name_or_creator) -> JaxEnv:
+    if callable(name_or_creator) and not isinstance(name_or_creator, str):
+        return name_or_creator()
+    try:
+        return _JAX_ENV_REGISTRY[name_or_creator]()
+    except KeyError:
+        raise ValueError(
+            f"no jax env registered under {name_or_creator!r}; the Anakin "
+            "path needs a functional JaxEnv (register_jax_env() it)") from None
 
 
 def register_env(name: str, creator: Callable[[], Env]):

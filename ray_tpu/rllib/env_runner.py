@@ -2,9 +2,21 @@
 
 reference: rllib/env/ EnvRunner groups — each runner owns env instances and
 a copy of the module params, samples fixed-length fragments, and reports
-episode statistics.  Inference here is plain numpy-on-CPU via the jax
-module (jitted once), which is the right split: learners burn the TPU,
-runners burn cheap CPU cores.
+episode statistics.  Inference is plain numpy-on-CPU by default (per-step
+jax dispatch would dominate rollouts for tiny MLPs); ``inference="jit"``
+switches to a jitted policy function for wide env batches.
+
+Compile safety (the Sebulba contract): weights flow into the jitted policy
+as ARGUMENTS — never closed-over constants — so ``set_weights`` can never
+retrigger compilation.  The runner counts traces (``compile_count()``) and
+a regression test pins the count at 1 across repeated weight updates.
+
+For the decoupled Sebulba path the runner also keeps the latest broadcast
+weights + version locally (``set_weights``), stamps every fragment with the
+behavior ``policy_version`` it was sampled under (the learner measures
+policy lag from it and V-trace corrects the off-policyness), and can stream
+fragments through a single-slot shm/tensor channel instead of the object
+store (``attach_channels``).
 """
 
 from __future__ import annotations
@@ -25,7 +37,8 @@ def _tree_to_numpy(tree):
 class EnvRunner:
     def __init__(self, env_creator, module_spec: dict, num_envs: int = 1,
                  seed: int = 0, rollout_fragment_length: int = 200,
-                 env_to_module=None, module_to_env=None):
+                 env_to_module=None, module_to_env=None,
+                 inference: str = "numpy"):
         from ray_tpu.rllib.core.rl_module import RLModule
         from ray_tpu.rllib.env import EnvSpec, make_env
 
@@ -42,6 +55,77 @@ class EnvRunner:
                      for i, env in enumerate(self._envs)]
         self._ep_return = [0.0] * num_envs
         self._completed: List[float] = []
+        # Sebulba state: broadcast weights + behavior version, optional
+        # fragment/weights channels, jitted-inference plumbing
+        if inference not in ("numpy", "jit"):
+            raise ValueError(f"inference must be 'numpy' or 'jit', "
+                             f"got {inference!r}")
+        self._inference = inference
+        self._params = None
+        self._weights_version = -1
+        self._fragment_channel = None
+        self._weights_channel = None
+        self._policy_traces = 0
+        self._jit_policy = None
+        if inference == "jit":
+            import jax
+
+            def policy(params, obs):
+                # host-side counter bumps ONLY while tracing: the compiled
+                # program never re-enters Python, so this counts compiles
+                self._policy_traces += 1
+                return self._module.forward(params, obs)
+
+            self._jit_policy = jax.jit(policy)
+
+    # -- Sebulba weight plane ------------------------------------------------
+
+    def set_weights(self, params, version: int = 0) -> int:
+        """Adopt broadcast weights; fragments sampled after this carry
+        ``policy_version=version``.  Params are normalized to numpy host
+        arrays once here — the jit path re-devices them per fragment, which
+        keeps this method cheap and the policy function argument-driven."""
+        self._params = _tree_to_numpy(params)
+        self._weights_version = int(version)
+        return self._weights_version
+
+    def get_weights_version(self) -> int:
+        return self._weights_version
+
+    def compile_count(self) -> int:
+        """Times the jitted policy function was TRACED (jit cache misses).
+        Stays at 1 across any number of set_weights calls — the regression
+        surface for the params-as-arguments contract."""
+        return self._policy_traces
+
+    def attach_channels(self, fragment_channel=None, weights_channel=None):
+        """Wire the single-slot channels for streamed fragments / weight
+        broadcasts (Sebulba ``transport="channel"``).  The runner is the
+        fragment channel's writer and the weights channel's (index-0)
+        reader."""
+        self._fragment_channel = fragment_channel
+        if weights_channel is not None:
+            weights_channel.register_reader(0)
+        self._weights_channel = weights_channel
+
+    def _poll_weights_channel(self):
+        """Non-blocking drain of the weights channel (at most one pending
+        version: the writer blocks until this side consumes)."""
+        if self._weights_channel is None:
+            return
+        try:
+            params, version = self._weights_channel.read(timeout=0.0)
+        except TimeoutError:
+            return  # no fresh broadcast — keep sampling under stale weights
+        except Exception:  # noqa: BLE001 — closed channel mid-drain: keep current weights, the executor is tearing down
+            return
+        self.set_weights(params, version)
+
+    def _infer(self, params, obs: np.ndarray):
+        if self._inference == "jit":
+            logits, values = self._jit_policy(params, obs)
+            return np.asarray(logits), np.asarray(values)
+        return self._fwd(params, obs)
 
     @staticmethod
     def _fwd(params, obs: np.ndarray):
@@ -56,14 +140,31 @@ class EnvRunner:
         value = (x @ np.asarray(params["v"]["w"]) + np.asarray(params["v"]["b"]))[..., 0]
         return logits, value
 
-    def sample(self, params, epsilon: Optional[float] = None) -> Dict[str, Any]:
+    def sample(self, params=None, epsilon: Optional[float] = None,
+               to_channel: bool = False) -> Dict[str, Any]:
         """Collect one fragment per env; returns flat batch arrays.
 
         ``epsilon``: when given, act epsilon-greedily over the logits head
         (treated as Q-values) instead of sampling the softmax policy — the
         value-based algorithms' exploration mode (reference:
-        rllib/utils/exploration/epsilon_greedy.py)."""
-        params = _tree_to_numpy(params)
+        rllib/utils/exploration/epsilon_greedy.py).
+
+        ``params=None`` samples under the latest ``set_weights`` broadcast
+        (the Sebulba continuous mode — stale by design, stamped with its
+        behavior version); ``to_channel=True`` streams the fragment through
+        the attached channel and returns only a small stub."""
+        if params is None:
+            self._poll_weights_channel()
+            if self._params is None:
+                raise RuntimeError(
+                    "sample(params=None) before any set_weights broadcast")
+            params = self._params
+        else:
+            params = _tree_to_numpy(params)
+        if self._inference == "jit":
+            import jax
+
+            params = jax.tree.map(jax.numpy.asarray, params)
         n_envs = len(self._envs)
         T = self._fragment
         # buffers are sized from the CONNECTOR-TRANSFORMED obs so pipelines
@@ -90,7 +191,7 @@ class EnvRunner:
             raw_obs = np.stack(self._obs)  # [n_envs, obs_dim]
             obs = (self._env_to_module(raw_obs)
                    if self._env_to_module is not None else raw_obs)
-            logits, values = self._fwd(params, obs)
+            logits, values = self._infer(params, obs)
             ctx = {"logits": logits, "rng": self._rng}
             if epsilon is not None:
                 ctx["epsilon"] = epsilon
@@ -133,7 +234,7 @@ class EnvRunner:
         tail = np.stack(self._obs)
         if self._env_to_module is not None:
             tail = self._env_to_module.transform(tail)
-        _, last_values = self._fwd(params, tail)
+        _, last_values = self._infer(params, tail)
         out = {
             "obs": obs_buf, "actions": act_buf,
             "rewards": rew_buf, "dones": done_buf, "logp": logp_buf,
@@ -142,9 +243,31 @@ class EnvRunner:
             # piggybacked so async algorithms never queue a stats call
             # behind a full in-flight fragment
             "episode_stats": self.episode_stats(),
+            # behavior version: -1 = explicit-params mode (the synchronous
+            # and seed-async paths, always on-policy at sample time)
+            "policy_version": self._weights_version,
         }
         if next_obs_buf is not None:
             out["next_obs"] = next_obs_buf
+        if to_channel:
+            if self._fragment_channel is None:
+                raise RuntimeError("to_channel=True without attach_channels")
+            # single-slot backpressure: block until the learner side reads
+            # the previous fragment, however long that takes — a stalled
+            # learner must PARK this runner (object-transport semantics),
+            # never fail the sample and strike out a healthy runner.  The
+            # loop ends when the executor tears the channel down
+            # (ChannelClosed propagates and the stub task fails, which is
+            # the correct signal by then).
+            while True:
+                try:
+                    self._fragment_channel.write(out, timeout=5.0)
+                    break
+                except TimeoutError:
+                    continue
+            return {"episode_stats": out["episode_stats"],
+                    "policy_version": out["policy_version"],
+                    "streamed": True}
         return out
 
     def episode_stats(self, window: int = 100) -> Dict[str, float]:

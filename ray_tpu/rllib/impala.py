@@ -61,6 +61,18 @@ class IMPALAConfig(AlgorithmConfig):
     clip_rho: float = 1.0
     clip_c: float = 1.0
     max_grad_norm: float = 40.0
+    # execution path: "async" = the one-in-flight-fragment-per-runner loop
+    # below; "sebulba" = the decoupled continuous-sampling executor
+    # (sebulba.py: bounded sample queue, weights broadcast, measured policy
+    # lag).  The V-trace learner is identical either way.
+    execution: str = "async"
+    # -- sebulba knobs (ignored under "async") -------------------------------
+    sample_queue_capacity: int = 8      # staleness cap between actor/learner
+    pipeline_depth: int = 2             # in-flight sample calls per runner
+    broadcast_interval_updates: int = 1  # learner updates per weight fan-out
+    max_policy_lag: int | None = None   # drop fragments staler than this
+    fragment_transport: str = "object"  # "object" | "channel" (tensor chans)
+    runner_inference: str = "numpy"     # "numpy" | "jit" (wide env batches)
 
     @property
     def algo_class(self):
@@ -108,13 +120,22 @@ class IMPALALearner:
         return params, opt_state, aux
 
     def update(self, samples: Dict[str, np.ndarray]) -> Dict[str, float]:
-        jb = {k: jnp.asarray(v) for k, v in samples.items()}
+        from ray_tpu.rllib.learner import device_batch
+
         self.params, self.opt_state, aux = self._update(
-            self.params, self.opt_state, jb)
+            self.params, self.opt_state, device_batch(samples))
         return {k: float(v) for k, v in aux.items()}
 
     def get_params(self):
         return self.params
+
+    def get_state(self):
+        return {"params": self.params, "opt_state": self.opt_state}
+
+    def set_state(self, state):
+        """Restore params + optimizer state (checkpoint round-trip)."""
+        self.params = jax.tree.map(jnp.asarray, state["params"])
+        self.opt_state = jax.tree.map(jnp.asarray, state["opt_state"])
 
 
 class IMPALA(Algorithm):
@@ -125,11 +146,30 @@ class IMPALA(Algorithm):
     freshly-updated weights."""
 
     def __init__(self, config: IMPALAConfig):
+        if config.execution not in ("async", "sebulba"):
+            raise ValueError(f"IMPALAConfig.execution must be 'async' or "
+                             f"'sebulba', got {config.execution!r}")
         super().__init__(config)
         self._inflight: Dict[Any, Any] = {}  # ref -> runner
         self._env_steps = 0
         self._last_stats: Dict[int, dict] = {}  # runner id -> episode stats
         self._fail_counts: Dict[int, int] = {}  # runner id -> consecutive fails
+        self._sebulba = None
+        if config.execution == "sebulba":
+            from ray_tpu.rllib.sebulba import SebulbaExecutor
+
+            self._sebulba = SebulbaExecutor(
+                self._runners, self._learner, config,
+                on_runner_dropped=self._kill_runner).start()
+
+    def _kill_runner(self, runner):
+        import ray_tpu
+
+        self._runners = [r for r in self._runners if r is not runner]
+        try:
+            ray_tpu.kill(runner)
+        except Exception:  # noqa: BLE001 — already-dead runner is the goal
+            pass
 
     def _build_learner(self):
         cfg: IMPALAConfig = self.config  # type: ignore[assignment]
@@ -147,6 +187,11 @@ class IMPALA(Algorithm):
     def train(self) -> Dict[str, Any]:
         import ray_tpu
 
+        if self._sebulba is not None:
+            out = self._sebulba.train_iteration()
+            self._iteration += 1
+            out["training_iteration"] = self._iteration
+            return out
         if not self._inflight:
             self._refill(self._runners)
         ready, _ = ray_tpu.wait(list(self._inflight),
@@ -186,11 +231,14 @@ class IMPALA(Algorithm):
             self._fail_counts.pop(id(runner), None)
             refill.append(runner)
             batches.append((batch, runner))
+        from ray_tpu._private import runtime_metrics
+
         for batch, runner in batches:
-            stats = self._learner.update(
-                {k: v for k, v in batch.items() if k != "episode_stats"})
-            self._env_steps += (batch["rewards"].shape[0]
-                                * batch["rewards"].shape[1])
+            # raw fragment straight in: learner.device_batch drops metadata
+            stats = self._learner.update(batch)
+            n = int(batch["rewards"].shape[0] * batch["rewards"].shape[1])
+            self._env_steps += n
+            runtime_metrics.add_rl_env_steps("async", n)
             # episode stats ride the sample itself: a separate stats call
             # would queue behind the runner's NEXT full fragment
             self._last_stats[id(runner)] = batch["episode_stats"]
@@ -211,5 +259,7 @@ class IMPALA(Algorithm):
         }
 
     def stop(self):
+        if self._sebulba is not None:
+            self._sebulba.stop()
         self._inflight.clear()
         super().stop()

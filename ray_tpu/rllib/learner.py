@@ -20,6 +20,16 @@ import optax
 from ray_tpu.rllib.core.rl_module import RLModule
 
 
+def device_batch(samples: Dict[str, Any]) -> Dict[str, "jnp.ndarray"]:
+    """jnp-ify a runner fragment's array values, dropping metadata keys
+    (episode_stats, policy_version, ...) — the one place the "learners
+    consume arrays only" rule lives, so every learner can be handed a raw
+    fragment from any execution path."""
+    return {k: jnp.asarray(v) for k, v in samples.items()
+            if isinstance(v, (np.ndarray, jnp.ndarray))
+            or hasattr(v, "__jax_array__")}
+
+
 def compute_gae(rewards, values, dones, bootstrap_value, gamma, lam):
     """Generalized advantage estimation over [T, B] fragments (lax.scan)."""
     next_values = jnp.concatenate([values[1:], bootstrap_value[None]], axis=0)

@@ -1,0 +1,484 @@
+"""Sebulba: decoupled actor–learner RL over the task/actor core.
+
+reference: the Podracer architectures (arxiv 2104.06272) — Sebulba splits
+acting from learning: EnvRunner actors sample CONTINUOUSLY under stale
+broadcast policies while the learner consumes whichever fragment lands
+first, and V-trace (impala.py) corrects the measured off-policyness.  Where
+the paper streams over TPU interconnect, this implementation streams over
+the runtime's own fast paths:
+
+- fragments ride repeated actor calls whose leases are cached and pipelined
+  by the owner-side submitter (the PR-5 lease fast path: ≤1 lease RPC per
+  ``max_tasks_in_flight_per_worker`` fragments — perf-smoke-gated), or
+  optionally through single-slot tensor channels
+  (``fragment_transport="channel"``: pytree leaves over the communicator,
+  structure over shm — the same plane the disaggregated KV handoff uses),
+  with weights broadcast back the same way;
+- a BOUNDED sample queue between the collector and the learner caps
+  runner-ahead-of-learner staleness (queue full → the collector blocks →
+  finished fragments park in flight → runners idle: backpressure, not
+  unbounded buffering);
+- every fragment carries the behavior policy version it was sampled under;
+  the learner books the lag (``ray_tpu_rl_policy_lag_updates``), optionally
+  drops fragments beyond ``max_policy_lag``, and V-trace's importance
+  ratios (behavior logp recorded by the stale policy) do the correction;
+- a runner death or drain is tolerated elastically: its in-flight fragment
+  is dropped EXACTLY once, the survivors keep the learner fed, and a
+  persistent offender is dropped from the group (the impala.py strike
+  rule);
+- the learner's wall-clock is ledgered (goodput: queue-empty time is
+  ``input_wait``, update time ``productive_step``) and fragment/stall
+  events land in the flight recorder, so ``state.diagnose()`` can name a
+  stalled runner.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue as _queue
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu._private import flight_recorder, runtime_metrics
+from ray_tpu._private.analysis.lock_witness import make_lock
+
+logger = logging.getLogger(__name__)
+
+_STRIKE_LIMIT = 3  # consecutive failures before a runner is dropped for good
+
+
+class SebulbaExecutor:
+    """Owns the continuous-sampling pipeline between an EnvRunner group and
+    a learner.  Built by IMPALA/APPO when ``config.execution="sebulba"``.
+
+    The collector thread keeps ``pipeline_depth`` sample calls in flight
+    per runner (params flow via ``set_weights`` broadcasts, so sample calls
+    carry no payload and reuse cached leases), pushes finished fragments
+    into the bounded queue, and resubmits immediately — runners never wait
+    for the learner.  ``train_iteration()`` (the learner side) pops
+    fragments, meters policy lag, updates, and broadcasts fresh weights
+    every ``broadcast_interval_updates`` updates.
+    """
+
+    def __init__(self, runners: List[Any], learner, config,
+                 on_runner_dropped=None):
+        from ray_tpu.train._internal.goodput import GoodputLedger
+
+        self._runners: Dict[int, Any] = dict(enumerate(runners))
+        self._learner = learner
+        self._cfg = config
+        self._on_runner_dropped = on_runner_dropped
+        self._capacity = max(1, int(config.sample_queue_capacity))
+        self._depth = max(1, int(config.pipeline_depth))
+        self._queue: _queue.Queue = _queue.Queue(maxsize=self._capacity)
+        self._lock = make_lock("sebulba.SebulbaExecutor._lock")
+        self._inflight: Dict[Any, int] = {}  # ref -> runner idx
+        self._strikes: Dict[int, int] = {}
+        self._last_seen: Dict[int, float] = {}
+        self._last_stats: Dict[int, dict] = {}
+        self._fragments_dropped = 0
+        self._lag_dropped = 0
+        self._fragments = 0
+        self._env_steps = 0
+        self._version = 0
+        self._channel_bytes = 0
+        self._frag_channels: Dict[int, Any] = {}
+        self._weight_channels: Dict[int, Any] = {}
+        self._stop_evt = threading.Event()
+        self._collector: Optional[threading.Thread] = None
+        self._lag_sum = 0.0
+        self._lag_max = 0
+        self._ledger = GoodputLedger(run=f"sebulba-{id(self) & 0xffff:04x}")
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self):
+        """Initial weights broadcast (synchronous — every runner samples
+        under version 0, never an unseeded policy), channel wiring, then the
+        collector pipeline."""
+        import ray_tpu
+
+        from ray_tpu.rllib.algorithm import jax_to_numpy
+
+        params = jax_to_numpy(self._learner.get_params())
+        if self._transport() == "channel":
+            import uuid
+
+            from ray_tpu.experimental.channel.shared_memory_channel import (
+                ShmChannel,
+            )
+            from ray_tpu.experimental.channel.xla_tensor_channel import (
+                XlaTensorChannel,
+            )
+
+            import pickle
+
+            tag = uuid.uuid4().hex[:8]
+            # size the weights slot from the REAL payload (4x headroom for
+            # optimizer-era growth) — an undersized slot would raise
+            # ChannelFull on every broadcast and freeze runners at v0
+            wts_cap = max(8 << 20,
+                          4 * len(pickle.dumps(params, protocol=5)))
+            for idx, r in self._runners.items():
+                frag = XlaTensorChannel(f"seb-frag-{tag}-{idx}")
+                # weights ride a PLAIN single-slot shm channel: no
+                # communicator rendezvous, so a busy runner can never
+                # deadlock the learner's broadcast (the write just times
+                # out and that broadcast is skipped — staleness-tolerant)
+                wts = ShmChannel(num_readers=1, capacity=wts_cap,
+                                 name=f"seb-wts-{tag}-{idx}")
+                frag.register_reader(0)  # driver side reads fragments
+                self._frag_channels[idx] = frag
+                self._weight_channels[idx] = wts
+                ray_tpu.get(r.attach_channels.remote(frag, wts))
+        params_ref = ray_tpu.put(params)
+        ray_tpu.get([r.set_weights.remote(params_ref, 0)
+                     for r in self._runners.values()])
+        with self._lock:
+            for idx in self._runners:
+                self._last_seen[idx] = time.monotonic()
+        for idx in list(self._runners):
+            for _ in range(self._depth):
+                self._submit(idx)
+        self._ledger.start(bucket="input_wait")
+        self._collector = threading.Thread(
+            target=self._collect_loop, name="rl-sebulba-collector",
+            daemon=True)
+        self._collector.start()
+        return self
+
+    def stop(self):
+        self._stop_evt.set()
+        if self._collector is not None:
+            self._collector.join(timeout=10.0)
+        try:
+            self._ledger.stop()
+        except Exception:  # noqa: BLE001 — double-stop during teardown is harmless
+            pass
+        for ch in list(self._frag_channels.values()) + \
+                list(self._weight_channels.values()):
+            try:
+                ch.destroy()
+            except Exception:  # noqa: BLE001 — best-effort shm teardown; the segment dies with the process anyway
+                pass
+
+    # -- sampling plane (collector thread) -----------------------------------
+
+    def _transport(self) -> str:
+        return getattr(self._cfg, "fragment_transport", "object")
+
+    def _submit(self, idx: int):
+        runner = self._runners.get(idx)
+        if runner is None:
+            return
+        to_channel = self._transport() == "channel"
+        ref = runner.sample.remote(None, None, to_channel)
+        with self._lock:
+            self._inflight[ref] = idx
+
+    def _collect_loop(self):
+        if self._transport() == "channel":
+            self._collect_channels()
+        else:
+            self._collect_objects()
+
+    def _deliver(self, idx: int, frag: Dict[str, Any]):
+        """Common receive-side bookkeeping + the bounded (blocking) put."""
+        with self._lock:
+            # a late fragment from an already-dropped runner is still worth
+            # learning from, but must NOT resurrect its stats bookkeeping —
+            # a stale entry would skew episode_reward_mean forever
+            if idx in self._runners:
+                self._strikes.pop(idx, None)
+                self._last_seen[idx] = time.monotonic()
+                self._last_stats[idx] = frag.get("episode_stats", {})
+        flight_recorder.record(
+            "rl", "fragment",
+            {"runner": idx, "version": frag.get("policy_version", -1)})
+        while not self._stop_evt.is_set():
+            try:
+                self._queue.put((idx, frag), timeout=0.5)
+                break
+            except _queue.Full:
+                continue
+        runtime_metrics.set_rl_queue_depth(self._queue.qsize())
+
+    def _collect_objects(self):
+        import ray_tpu
+
+        while not self._stop_evt.is_set():
+            with self._lock:
+                pending = list(self._inflight)
+            if not pending:
+                if not self._runners:
+                    return  # every runner dead: train_iteration raises
+                time.sleep(0.02)
+                continue
+            ready, _ = ray_tpu.wait(pending, num_returns=1, timeout=0.5)
+            if not ready:
+                continue
+            ref = ready[0]
+            with self._lock:
+                idx = self._inflight.pop(ref, None)
+            if idx is None:
+                continue
+            try:
+                frag = ray_tpu.get(ref)
+            except Exception as e:  # noqa: BLE001
+                self._on_sample_failure(idx, e)
+                continue
+            # resubmit BEFORE the (possibly blocking) queue put: the runner
+            # keeps sampling while this fragment waits for the learner
+            self._submit(idx)
+            self._deliver(idx, frag)
+
+    def _collect_channels(self):
+        """Channel transport: fragments are read from the per-runner
+        single-slot channels INDEPENDENTLY of the sample stubs — a write
+        blocks its runner until this side reads, so waiting for the stub
+        first would deadlock the communicator rendezvous.  Stubs only drive
+        resubmission and failure detection."""
+        import ray_tpu
+
+        while not self._stop_evt.is_set():
+            progressed = False
+            for idx, chan in list(self._frag_channels.items()):
+                if idx not in self._runners:
+                    continue
+                try:
+                    frag = chan.read(timeout=0.05)
+                except TimeoutError:
+                    continue
+                except Exception as e:  # noqa: BLE001
+                    # a non-timeout read failure desyncs the single-slot
+                    # channel (meta consumed, leaves undelivered) — the
+                    # runner would block in its send forever, so retrying
+                    # here can never heal it: poison the runner, keep the
+                    # survivors feeding the learner
+                    self._poison_runner(idx, e)
+                    continue
+                self._channel_bytes += max(
+                    chan.last_read_nbytes,
+                    sum(v.nbytes for v in frag.values()
+                        if isinstance(v, np.ndarray)))
+                self._deliver(idx, frag)
+                progressed = True
+            # reap finished stubs: resubmit on success, strike on failure
+            with self._lock:
+                pending = list(self._inflight)
+            if not pending and not self._runners:
+                return
+            ready, _ = ray_tpu.wait(pending, num_returns=len(pending),
+                                    timeout=0) if pending else ([], [])
+            for ref in ready:
+                with self._lock:
+                    idx = self._inflight.pop(ref, None)
+                if idx is None:
+                    continue
+                try:
+                    ray_tpu.get(ref)
+                except Exception as e:  # noqa: BLE001
+                    self._on_sample_failure(idx, e)
+                    continue
+                self._submit(idx)
+                progressed = True
+            if not progressed:
+                time.sleep(0.005)
+
+    def _poison_runner(self, idx: int, err: Exception):
+        """Drop a runner whose transport can no longer deliver (desynced
+        channel): one fragment charged, runner removed, survivors unaffected.
+        Exactly-once with the stub path: _on_sample_failure skips runners
+        already removed."""
+        if idx not in self._runners:
+            return
+        with self._lock:
+            self._fragments_dropped += 1
+            self._strikes[idx] = _STRIKE_LIMIT
+        flight_recorder.record("rl", "fragment_dropped",
+                               {"runner": idx, "poisoned": True,
+                                "error": str(err)[:120]})
+        self._drop_runner(idx, err)
+
+    def _on_sample_failure(self, idx: int, err: Exception):
+        """One failed in-flight sample = one fragment dropped, exactly once
+        (the ref left _inflight before we got here).  A DEAD runner is
+        dropped immediately — no resubmit probes, so its in-flight fragment
+        is the only one ever charged; transient task failures resubmit and
+        a persistent offender is dropped after the strike limit."""
+        from ray_tpu._private.task_spec import (
+            ActorDiedError,
+            ActorUnavailableError,
+        )
+
+        if idx not in self._runners:
+            return  # already dropped/poisoned — its fragments are accounted
+        dead = isinstance(err, (ActorDiedError, ActorUnavailableError))
+        with self._lock:
+            self._fragments_dropped += 1
+            n = _STRIKE_LIMIT if dead else self._strikes.get(idx, 0) + 1
+            self._strikes[idx] = n
+        flight_recorder.record("rl", "fragment_dropped",
+                               {"runner": idx, "strike": n, "dead": dead,
+                                "error": str(err)[:120]})
+        if n >= _STRIKE_LIMIT:
+            self._drop_runner(idx, err)
+        else:
+            logger.warning("sebulba: failed fragment from runner %d (%s); "
+                           "resubmitting (strike %d/%d)", idx, err, n,
+                           _STRIKE_LIMIT)
+            self._submit(idx)
+
+    def _drop_runner(self, idx: int, err: Exception):
+        runner = self._runners.pop(idx, None)
+        with self._lock:
+            self._strikes.pop(idx, None)
+            self._last_stats.pop(idx, None)
+            self._last_seen.pop(idx, None)
+        logger.error("sebulba: runner %d dropped for good (%s)", idx, err)
+        if runner is not None and self._on_runner_dropped is not None:
+            try:
+                self._on_runner_dropped(runner)
+            except Exception:  # noqa: BLE001 — cleanup callback must not kill the collector thread
+                logger.warning("sebulba: on_runner_dropped failed",
+                               exc_info=True)
+
+    # -- learner plane --------------------------------------------------------
+
+    def _next_fragment(self, timeout: float):
+        """Pop the next fragment, dropping over-stale ones; ``input_wait``
+        seconds accrue on the ledger while the queue is empty."""
+        deadline = time.monotonic() + timeout
+        max_lag = getattr(self._cfg, "max_policy_lag", None)
+        while True:
+            # drain already-delivered fragments before declaring the group
+            # dead — buffered work is still perfectly consumable
+            if (not self._runners and not self._inflight
+                    and self._queue.empty()):
+                raise RuntimeError("sebulba: every EnvRunner is dead")
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"sebulba: no fragment within {timeout:.0f}s "
+                    f"(stalled runners: {self.stalled_runners()})")
+            self._ledger.mark("input_wait")
+            try:
+                idx, frag = self._queue.get(timeout=min(remaining, 1.0))
+            except _queue.Empty:
+                for s_idx in self.stalled_runners():
+                    flight_recorder.record("rl", "runner_stall",
+                                           {"runner": s_idx})
+                continue
+            finally:
+                runtime_metrics.set_rl_queue_depth(self._queue.qsize())
+            lag = max(0, self._version - int(frag.get("policy_version", 0)))
+            if max_lag is not None and lag > max_lag:
+                with self._lock:
+                    self._lag_dropped += 1
+                continue
+            return idx, frag, lag
+
+    def train_iteration(self, timeout: float = 120.0) -> Dict[str, Any]:
+        """Consume one fragment, update, maybe broadcast.  Returns the
+        algorithm-standard metric dict."""
+        idx, frag, lag = self._next_fragment(timeout)
+        self._ledger.mark("productive_step")
+        runtime_metrics.observe_rl_policy_lag(lag)
+        # raw fragment straight in: learner.device_batch drops metadata
+        stats = self._learner.update(frag)
+        self._version += 1
+        n = int(frag["rewards"].shape[0] * frag["rewards"].shape[1])
+        self._env_steps += n
+        self._fragments += 1
+        self._lag_sum += lag
+        self._lag_max = max(self._lag_max, lag)
+        runtime_metrics.add_rl_env_steps("sebulba", n)
+        flight_recorder.record("rl", "learner_update",
+                               {"version": self._version, "runner": idx,
+                                "lag": lag})
+        if self._version % max(
+                1, int(self._cfg.broadcast_interval_updates)) == 0:
+            self._broadcast()
+        self._ledger.mark("input_wait")
+        try:
+            self._ledger.publish()
+        except Exception:  # noqa: BLE001 — goodput KV publish is telemetry; never stall the learner on it
+            pass
+        with self._lock:
+            ep = list(self._last_stats.values())
+        rewards = [s["episode_reward_mean"] for s in ep
+                   if s.get("episodes_total")]
+        return {
+            "episode_reward_mean": float(np.mean(rewards)) if rewards else 0.0,
+            "episodes_total": float(sum(s.get("episodes_total", 0)
+                                        for s in ep)),
+            "num_env_steps_sampled": self._env_steps,
+            "policy_lag": lag,
+            "policy_lag_mean": self._lag_sum / max(self._fragments, 1),
+            "sample_queue_depth": self._queue.qsize(),
+            "fragments_consumed": self._fragments,
+            "fragments_dropped": self._fragments_dropped,
+            **stats,
+        }
+
+    def _broadcast(self):
+        import ray_tpu
+
+        from ray_tpu.rllib.algorithm import jax_to_numpy
+
+        params = jax_to_numpy(self._learner.get_params())
+        if self._transport() == "channel":
+            for idx, ch in list(self._weight_channels.items()):
+                if idx not in self._runners:
+                    continue
+                try:
+                    # single-slot: a runner that hasn't consumed the last
+                    # broadcast just skips this one (staleness-tolerant)
+                    ch.write((params, self._version), timeout=0.05)
+                except TimeoutError:
+                    pass
+                except Exception as e:  # noqa: BLE001 — a dying runner's channel must not stall the fan-out, but a deterministic failure (ChannelFull) must be LOUD or runners freeze at v0 silently
+                    logger.error("sebulba: weights broadcast to runner %d "
+                                 "failed (%s) — it keeps sampling under "
+                                 "stale weights", idx, e)
+            return
+        params_ref = ray_tpu.put(params)
+        # snapshot: the collector thread pops dead runners concurrently
+        for r in list(self._runners.values()):
+            # fire-and-forget: a failed set_weights surfaces on the runner's
+            # next sample, which is where death is handled anyway
+            r.set_weights.remote(params_ref, self._version)
+
+    # -- observability --------------------------------------------------------
+
+    def stalled_runners(self, threshold_s: float = 10.0) -> List[int]:
+        """Runner indices with no fragment for ``threshold_s`` — the hook
+        state.diagnose() folds (via the recorder events this feeds)."""
+        now = time.monotonic()
+        with self._lock:
+            return [idx for idx, t in self._last_seen.items()
+                    if idx in self._runners and now - t > threshold_s]
+
+    def goodput(self) -> dict:
+        return self._ledger.snapshot()
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            inflight = len(self._inflight)
+        return {
+            "learner_version": self._version,
+            "env_steps": self._env_steps,
+            "fragments_consumed": self._fragments,
+            "fragments_dropped": self._fragments_dropped,
+            "lag_dropped": self._lag_dropped,
+            "policy_lag_mean": self._lag_sum / max(self._fragments, 1),
+            "policy_lag_max": self._lag_max,
+            "sample_queue_depth": self._queue.qsize(),
+            "sample_queue_capacity": self._capacity,
+            "inflight": inflight,
+            "alive_runners": len(self._runners),
+            "channel_bytes": self._channel_bytes,
+        }
