@@ -739,6 +739,14 @@ def _bench_serving(on_tpu: bool) -> dict:
             for t in threads:
                 t.join()
             wall = time.perf_counter() - bench_t0
+            # device telemetry: utilization snapshot while the app is
+            # still up (engines drop out of the fold on teardown)
+            try:
+                from ray_tpu.util import state as _state
+
+                util_snap = _state.utilization()
+            except Exception:  # noqa: BLE001 — snapshot is enrichment
+                util_snap = None
         finally:
             if serve_up:
                 serve.shutdown()
@@ -784,6 +792,13 @@ def _bench_serving(on_tpu: bool) -> dict:
         slo_snap = _slo_snapshot()
         slo_dep = next(iter((slo_snap.get("deployments") or {}).values()),
                        {})
+        # device telemetry: serving tok/s normalized per chip (one local
+        # replica here — n_chips is the device count only on real TPU)
+        from ray_tpu._private import device_telemetry
+
+        tok_per_chip = device_telemetry.note_serving_rate(
+            "serve-bench", agg,
+            n_chips=jax.local_device_count() if on_tpu else 1)
         return {
             # spec-dec A/B rows (engine-direct, equal-output greedy):
             # acceptance rate, effective tok/s per chip, speedup
@@ -797,6 +812,8 @@ def _bench_serving(on_tpu: bool) -> dict:
             "inter_token_sketch_s": slo_dep.get("itl"),
             "slo": slo_snap,
             "aggregate_tok_per_sec": round(agg, 1),
+            "tok_per_sec_per_chip": round(tok_per_chip, 1),
+            "utilization": util_snap,
             "steady_1s_peak_tok_per_sec": round(steady_rate, 1),
             "engine_direct_tok_per_sec": direct["tok_per_sec"],
             "proxy_overhead_pct_steady": round(
@@ -1529,6 +1546,22 @@ def _specdec_snapshot() -> dict:
         return {"error": str(e)[:200]}
 
 
+def _device_telemetry_snapshot() -> dict:
+    """Device-telemetry families recorded during the benches (HBM gauges,
+    engine utilization, jit-compile counts/seconds, MFU, tok/s-per-chip)
+    plus the compile watch's per-program tallies and a fresh per-device
+    HBM snapshot — the chip-level block of BENCH_*.json."""
+    try:
+        from ray_tpu._private import device_telemetry, runtime_metrics
+
+        snap = runtime_metrics.device_telemetry_snapshot()
+        snap["compile_watch"] = device_telemetry.compile_snapshot()
+        snap["hbm"] = device_telemetry.hbm_snapshot()
+        return snap
+    except Exception as e:  # noqa: BLE001
+        return {"error": str(e)[:200]}
+
+
 def _slo_snapshot() -> dict:
     """Serving SLO fold of THIS process's ledger (the serving benches run
     local-mode, so ingress + replicas share the process): per deployment,
@@ -1677,28 +1710,50 @@ def main():
         jax.block_until_ready(state)
         dt = time.perf_counter() - t0
         loss = float(metrics["loss"])
+        # device telemetry: XLA's own per-step FLOPs figure
+        # (lower().cost_analysis(), cached per program) — the cross-check
+        # against the analytic flops_per_token() count below
+        from ray_tpu._private import device_telemetry
+
+        xla_flops = device_telemetry.jit_flops(step_fn, state, tokens,
+                                               key="bench_headline_step")
         # free the llama state BEFORE the extra benches — the MoE model
         # needs the HBM the 1B params+moments occupy
         import gc
 
         del state, metrics, tokens, step_fn, init_fn
         gc.collect()
-        return dt, loss
+        return dt, loss, xla_flops
 
     headline, alive = _run_guarded(_headline, 3600.0 if on_tpu else 900.0)
     ledger.stop()
     partial = not alive
     if isinstance(headline, tuple):
-        dt, loss = headline
+        dt, loss, xla_flops = headline
         tokens_per_step = batch * seq
         tokens_per_sec = tokens_per_step * steps / dt
         model_flops = flops_per_token(cfg, seq) * tokens_per_sec
         peak = _peak_flops(jax.devices()[0])
         mfu = model_flops / peak
+        # device telemetry: ray_tpu_train_mfu_ratio{run} gauge + the
+        # XLA cost-analysis cross-check of the analytic FLOPs count
+        from ray_tpu._private import device_telemetry
+
+        analytic_step_flops = flops_per_token(cfg, seq) * tokens_per_step
+        device_telemetry.note_train_step(
+            "bench_llama1b", model_flops=analytic_step_flops,
+            wall_s=dt / steps, peak=peak)
         extra = {
             "tokens_per_sec": round(tokens_per_sec, 1),
             "step_time_s": round(dt / steps, 4),
             "final_loss": round(loss, 4),
+            "mfu_accounting": {
+                "analytic_step_flops": analytic_step_flops,
+                "xla_cost_analysis_flops": xla_flops,
+                "flops_ratio_xla_over_analytic": round(
+                    xla_flops / analytic_step_flops, 3)
+                if xla_flops else None,
+            },
         }
     else:  # headline itself died (relay outage mid-compile/mid-loop)
         mfu, extra = 0.0, {"headline_error": headline.get("error")}
@@ -1749,6 +1804,7 @@ def main():
         "kv_handoff": _kv_handoff_snapshot(),
         "specdec": _specdec_snapshot(),
         "slo": _slo_snapshot(),
+        "device_telemetry": _device_telemetry_snapshot(),
         "static_analysis": _static_analysis_snapshot(),
     })
 

@@ -1,0 +1,125 @@
+"""Per-step booking-cost microbench for the device telemetry layer.
+
+``EngineTelemetry.note_step`` runs once per engine step right after the
+lock is released — it must stay invisible next to a multi-ms decode step.
+The disabled path is one attribute read + None check inside ``step()``.
+This bench measures both, plus the ``state.utilization()`` fold over a
+16-replica fleet, and enforces the ISSUE 16 budgets:
+
+  - enabled note_step           < 10 µs (DEVICE_TELEMETRY_ENABLED_NS)
+  - disabled per-step check     < 1 µs  (DEVICE_TELEMETRY_DISABLED_NS)
+  - 16-replica utilization fold < 50 ms (DEVICE_TELEMETRY_FOLD_MS)
+
+(CI-loose budgets: they catch order-of-magnitude regressions — a flush
+that stops throttling, a fold that starts walking live arrays — not
+scheduler noise.  Idle-host figures: enabled ~1 µs amortized, disabled
+~0.05 µs, 16-way fold well under 1 ms.)
+
+Prints one JSON line:
+  {"metric": "device_telemetry_overhead", "value": <enabled ns/step>, ...}
+Exit status 1 if any budget is exceeded.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def _bench(fn, n: int = 100_000) -> float:
+    """ns per call, best of 3 runs (min defends against CI noise)."""
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            fn()
+        best = min(best, (time.perf_counter() - t0) / n)
+    return best * 1e9
+
+
+class _DisabledEngine:
+    """The exact shape of the disabled path inside an engine step."""
+
+    __slots__ = ("_telemetry",)
+
+    def __init__(self):
+        self._telemetry = None
+
+    def step_tail(self):
+        tel = self._telemetry
+        if tel is not None:  # pragma: no cover — never taken here
+            tel.note_step()
+
+
+def run() -> dict:
+    from ray_tpu._private import device_telemetry
+
+    out: dict = {}
+
+    # -- enabled path: note_step with the default throttled flush ----------
+    # (includes the amortized gauge flush every flush_interval and the 10x
+    # slower HBM walk — the realistic per-step cost, not just the store)
+    tel = device_telemetry.EngineTelemetry(
+        "bench-dep", weights_bytes=1 << 20, kv_pool_bytes=1 << 20)
+
+    def enabled_step():
+        tel.note_step(active_slots=3, max_slots=8, free_blocks=20,
+                      total_blocks=31, pending=2, prefill_spent=128,
+                      prefill_budget=256, busy_s=0.004,
+                      now=time.monotonic())
+
+    out["note_step_enabled_ns"] = round(_bench(enabled_step), 1)
+
+    # -- disabled path: attribute read + None check ------------------------
+    eng = _DisabledEngine()
+    out["step_disabled_ns"] = round(_bench(eng.step_tail), 1)
+
+    # -- 16-replica fold: the state.utilization() aggregation cost ---------
+    rows = []
+    for r in range(16):
+        rows.append({
+            "engine": "paged", "deployment": f"dep{r % 4}",
+            "replica": f"replica-{r:02x}",
+            "slots": {"active": r % 8, "max": 8, "free": 8 - r % 8},
+            "kv_blocks": {"total": 255, "free": 255 - 4 * r,
+                          "used": 4 * r},
+            "pending": r % 3, "duty_cycle": 0.5,
+        })
+    t0 = time.perf_counter()
+    folds = 100
+    for _ in range(folds):
+        folded = device_telemetry.fold_utilization_rows(rows)
+    out["fold_16_ms"] = round((time.perf_counter() - t0) / folds * 1e3, 3)
+    out["fold_16_deployments"] = len(folded["deployments"])
+    return out
+
+
+def main() -> int:
+    enabled_budget = float(
+        os.environ.get("DEVICE_TELEMETRY_ENABLED_NS", 10_000))
+    disabled_budget = float(
+        os.environ.get("DEVICE_TELEMETRY_DISABLED_NS", 1_000))
+    fold_budget = float(os.environ.get("DEVICE_TELEMETRY_FOLD_MS", 50))
+    extra = run()
+    ok = (extra["note_step_enabled_ns"] <= enabled_budget
+          and extra["step_disabled_ns"] <= disabled_budget
+          and extra["fold_16_ms"] <= fold_budget)
+    out = {
+        "metric": "device_telemetry_overhead",
+        "value": extra["note_step_enabled_ns"],
+        "unit": "ns",
+        "budget_enabled_ns": enabled_budget,
+        "budget_disabled_ns": disabled_budget,
+        "budget_fold_ms": fold_budget,
+        "ok": ok,
+        "extra": extra,
+    }
+    print(json.dumps(out))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    sys.exit(main())

@@ -209,6 +209,29 @@ class RayTpuConfig:
     # burn rate above this is reported as a breach by state.serving_slo()
     # (1.0 = consuming error budget exactly as fast as the SLO allows)
     serve_slo_burn_alert: float = 1.0
+    # --- device telemetry (_private/device_telemetry.py) ---
+    # master switch for the chip-level observability layer: per-device HBM
+    # gauges, per-deployment engine utilization/headroom gauges, the
+    # process-wide jit-compile watch and the MFU gauges.  Off => engines
+    # never attach a telemetry recorder (the per-step cost is one attribute
+    # read + None check) and the layer books NOTHING
+    device_telemetry_enabled: bool = True
+    # engine-step gauge flush throttle: note_step() updates plain slots
+    # every step and flushes bound gauges at most this often
+    device_telemetry_flush_interval_s: float = 0.5
+    # compile-observer heartbeat: while this process is alive the telemetry
+    # heartbeat thread re-pushes metrics at this period so a replica stuck
+    # in a long jit compile reports stale-but-present gauges instead of
+    # being swept by the GCS's silent-reporter gauge expiry
+    device_telemetry_heartbeat_s: float = 5.0
+    # compile-storm detector (state.diagnose): this many observed
+    # traces/compiles of the SAME program inside the window names the
+    # program and its callers in the diagnose report
+    compile_storm_threshold: int = 5
+    compile_storm_window_s: float = 60.0
+    # replica-side utilization publish period (KV row per replica:
+    # free slots/blocks, duty cycle, HBM split — the autoscaler's input)
+    utilization_publish_interval_s: float = 2.0
     # --- lock-order witness (_private/analysis/lock_witness.py) ---
     # test/chaos-lane knob: locks built through make_lock/make_rlock become
     # lockdep-style witnesses that record per-thread acquisition stacks,

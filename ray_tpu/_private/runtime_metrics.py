@@ -480,6 +480,73 @@ RL_POLICY_LAG = Histogram(
     "ratios correct the rest)",
     boundaries=[0.0, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0], tag_keys=())
 
+# -- device telemetry (_private/device_telemetry.py, ISSUE 16) --------------
+# The chip-level observability pillar: per-device HBM live bytes (device
+# memory stats on TPU, live-arrays fallback on CPU hosts), the paged
+# engine's HBM split (weights vs KV pool vs transient activations), the
+# per-deployment utilization/headroom gauges the SLO-feedback autoscaler
+# scales on (ROADMAP item 1), the process-wide jit-compile watch, and the
+# MFU/roofline gauges.  Everything here is recorded OUTSIDE engine locks
+# (the note_step values are captured under the lock into locals and booked
+# after release, same discipline as the PhaseRecorder stamps).
+DEVICE_HBM_BYTES = Gauge(
+    "ray_tpu_device_hbm_bytes",
+    "Per-device HBM bytes by kind: used = live bytes in use (device "
+    "memory_stats where available, summed live-array bytes on hosts "
+    "without allocator stats), limit = allocator capacity (0 when the "
+    "backend does not report one)",
+    tag_keys=("device", "kind"))
+ENGINE_HBM_BYTES = Gauge(
+    "ray_tpu_engine_hbm_bytes",
+    "Paged-engine HBM breakdown per deployment: weights = model parameter "
+    "bytes, kv_pool = paged KV-cache pool bytes (draft pool included under "
+    "speculative decoding), transient = device live bytes minus weights "
+    "and pool (activations, staging buffers; clamped at zero)",
+    tag_keys=("deployment", "segment"))
+ENGINE_SLOT_OCCUPANCY = Gauge(
+    "ray_tpu_engine_slot_occupancy_ratio",
+    "Decode slot occupancy per deployment: active slots / max_batch "
+    "(headroom = 1 - occupancy; the autoscaler's decode-pool signal)",
+    tag_keys=("deployment",))
+ENGINE_KV_OCCUPANCY = Gauge(
+    "ray_tpu_engine_kv_block_occupancy_ratio",
+    "KV block-pool occupancy per deployment: (total - free) / total "
+    "blocks (1.0 means the next allocation preempts)",
+    tag_keys=("deployment",))
+ENGINE_PREFILL_SPEND = Gauge(
+    "ray_tpu_engine_prefill_budget_spend_ratio",
+    "Fraction of the chunked-prefill token budget spent on the last "
+    "engine step (sustained 1.0 = prefill-bound; 0 = decode-only steps)",
+    tag_keys=("deployment",))
+ENGINE_STEP_DUTY = Gauge(
+    "ray_tpu_engine_step_duty_cycle",
+    "Engine step duty cycle per deployment: device-dispatch seconds over "
+    "wall seconds since the previous step ended (1.0 = the engine loop "
+    "never idles; low values with queued work indicate a stalled loop)",
+    tag_keys=("deployment",))
+JIT_COMPILES = Counter(
+    "ray_tpu_jit_compiles_total",
+    "XLA backend compiles observed by the process-wide compile watch, by "
+    "program (instrumented call sites name their program; unattributed "
+    "compiles book under '_jax')",
+    tag_keys=("program",))
+JIT_COMPILE_SECONDS = Counter(
+    "ray_tpu_jit_compile_seconds_total",
+    "Seconds spent in XLA backend compilation, by program (same "
+    "attribution as ray_tpu_jit_compiles_total)",
+    tag_keys=("program",))
+TRAIN_MFU = Gauge(
+    "ray_tpu_train_mfu_ratio",
+    "Model FLOPs utilization per train run: model FLOPs/s (cost_analysis "
+    "per program, cached) over the device's peak FLOPs/s",
+    tag_keys=("run",))
+SERVE_TOKENS_PER_CHIP = Gauge(
+    "ray_tpu_serve_tokens_per_chip_per_s",
+    "Serving throughput normalized per chip (aggregate decoded tokens/s "
+    "divided by the chips the deployment occupies) — the headline "
+    "cost-per-token comparison figure",
+    tag_keys=("deployment",))
+
 FAMILIES = (
     SCHEDULE_LATENCY, PENDING_TASKS, SPILLBACKS,
     WORKER_SPAWN_LATENCY, WORKER_SPAWNS, WORKER_SPAWN_TIMEOUTS,
@@ -512,6 +579,11 @@ FAMILIES = (
     DATA_INGEST_BACKPRESSURE, DATA_INGEST_WAIT,
     TRAIN_SNAPSHOT_BYTES, TRAIN_SNAPSHOT_STALL, TRAIN_SNAPSHOT_INFLIGHT,
     RL_ENV_STEPS, RL_SAMPLE_QUEUE_DEPTH, RL_POLICY_LAG,
+    DEVICE_HBM_BYTES, ENGINE_HBM_BYTES,
+    ENGINE_SLOT_OCCUPANCY, ENGINE_KV_OCCUPANCY,
+    ENGINE_PREFILL_SPEND, ENGINE_STEP_DUTY,
+    JIT_COMPILES, JIT_COMPILE_SECONDS,
+    TRAIN_MFU, SERVE_TOKENS_PER_CHIP,
 )
 
 # ---------------------------------------------------------------------------
@@ -1134,6 +1206,79 @@ def rl_snapshot() -> dict:
         s, cnt = float(st[1]), float(st[2])
         out["policy_lag"] = {"count": cnt, "sum": s,
                              "mean": (s / cnt) if cnt else 0.0}
+    return out
+
+
+def set_device_hbm(device: str, used: int, limit: int) -> None:
+    _bound(DEVICE_HBM_BYTES, device=device, kind="used").set(used)
+    if limit > 0:
+        _bound(DEVICE_HBM_BYTES, device=device, kind="limit").set(limit)
+
+
+def record_engine_hbm(deployment: str, weights: int, kv_pool: int,
+                      transient: int) -> None:
+    _bound(ENGINE_HBM_BYTES, deployment=deployment,
+           segment="weights").set(weights)
+    _bound(ENGINE_HBM_BYTES, deployment=deployment,
+           segment="kv_pool").set(kv_pool)
+    _bound(ENGINE_HBM_BYTES, deployment=deployment,
+           segment="transient").set(max(0, transient))
+
+
+def record_engine_utilization(deployment: str, slot_occupancy: float,
+                              kv_occupancy: float, prefill_spend: float,
+                              duty_cycle: float) -> None:
+    _bound(ENGINE_SLOT_OCCUPANCY, deployment=deployment).set(slot_occupancy)
+    _bound(ENGINE_KV_OCCUPANCY, deployment=deployment).set(kv_occupancy)
+    _bound(ENGINE_PREFILL_SPEND, deployment=deployment).set(prefill_spend)
+    _bound(ENGINE_STEP_DUTY, deployment=deployment).set(duty_cycle)
+
+
+def inc_jit_compile(program: str, seconds: float) -> None:
+    _bound(JIT_COMPILES, program=program).inc()
+    if seconds > 0:
+        _bound(JIT_COMPILE_SECONDS, program=program).inc(seconds)
+
+
+def set_train_mfu(run: str, ratio: float) -> None:
+    _bound(TRAIN_MFU, run=run).set(ratio)
+
+
+def set_serve_tokens_per_chip(deployment: str, tok_per_s: float) -> None:
+    _bound(SERVE_TOKENS_PER_CHIP, deployment=deployment).set(tok_per_s)
+
+
+def device_telemetry_snapshot() -> dict:
+    """Process-local device-telemetry accounting for bench.py and the perf
+    gates: per-device HBM gauges, per-deployment engine HBM split and
+    utilization gauges, jit-compile counts/seconds per program, and the
+    MFU / tok-per-chip gauges.  Hermetic — this process's points only."""
+    out: dict = {"device_hbm": {}, "engine_hbm": {}, "utilization": {},
+                 "jit_compiles": {}, "jit_compile_seconds": {},
+                 "train_mfu": {}, "serve_tokens_per_chip": {}}
+    for tags_key, v in dict(DEVICE_HBM_BYTES._points).items():
+        t = dict(tags_key)
+        out["device_hbm"].setdefault(
+            t.get("device", "?"), {})[t.get("kind", "?")] = v
+    for tags_key, v in dict(ENGINE_HBM_BYTES._points).items():
+        t = dict(tags_key)
+        out["engine_hbm"].setdefault(
+            t.get("deployment", "?"), {})[t.get("segment", "?")] = v
+    for gauge, key in ((ENGINE_SLOT_OCCUPANCY, "slot_occupancy"),
+                       (ENGINE_KV_OCCUPANCY, "kv_occupancy"),
+                       (ENGINE_PREFILL_SPEND, "prefill_spend"),
+                       (ENGINE_STEP_DUTY, "duty_cycle")):
+        for tags_key, v in dict(gauge._points).items():
+            dep = dict(tags_key).get("deployment", "?")
+            out["utilization"].setdefault(dep, {})[key] = v
+    for tags_key, v in dict(JIT_COMPILES._points).items():
+        out["jit_compiles"][dict(tags_key).get("program", "?")] = v
+    for tags_key, v in dict(JIT_COMPILE_SECONDS._points).items():
+        out["jit_compile_seconds"][dict(tags_key).get("program", "?")] = v
+    for tags_key, v in dict(TRAIN_MFU._points).items():
+        out["train_mfu"][dict(tags_key).get("run", "?")] = v
+    for tags_key, v in dict(SERVE_TOKENS_PER_CHIP._points).items():
+        out["serve_tokens_per_chip"][dict(tags_key).get("deployment", "?")] = v
     return out
 
 

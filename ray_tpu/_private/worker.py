@@ -1097,7 +1097,8 @@ class CoreWorker:
                 f = frame
                 while f is not None and len(chain) < 20:
                     code = f.f_code
-                    chain.append(f"{code.co_filename}:{code.co_qualname}")
+                    qual = getattr(code, "co_qualname", code.co_name)
+                    chain.append(f"{code.co_filename}:{qual}")
                     f = f.f_back
                 key = "\n".join(reversed(chain))
                 counts[key] = counts.get(key, 0) + 1

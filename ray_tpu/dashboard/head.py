@@ -24,6 +24,7 @@ Endpoints:
   GET /api/goodput          train wall-clock by bucket per run [?run=]
   GET /api/slo              serving SLO report: percentiles, burn rates, breaches
   GET /api/recent_requests  newest completed serve requests [?limit=&tenant=]
+  GET /api/utilization      device telemetry: per-replica slot/KV headroom [?deployment=]
   GET /metrics              Prometheus exposition of cluster metrics
 """
 
@@ -218,6 +219,13 @@ class DashboardHead:
             # breach list.  ?deployment=<name> narrows.
             dep = (query or {}).get("deployment", [None])[0]
             return state.serving_slo(dep)
+        if path == "/api/utilization":
+            # device telemetry: per-deployment replica rows (free decode
+            # slots, free KV blocks, duty cycle, HBM split) + summed
+            # headroom — the autoscaler's input surface.  ?deployment=
+            # narrows.
+            dep = (query or {}).get("deployment", [None])[0]
+            return state.utilization(dep)
         if path == "/api/recent_requests":
             # overload forensics: newest completed requests cluster-wide
             # [?limit=&deployment=&tenant=]

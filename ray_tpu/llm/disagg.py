@@ -89,6 +89,11 @@ class PrefillServer:
         digest["qlen"] = self._inflight
         return digest
 
+    def utilization(self) -> Optional[Dict[str, Any]]:
+        """Device-telemetry row (replica publish / state.utilization())."""
+        util = getattr(self._engine, "utilization", None)
+        return util() if util is not None else None
+
     def queue_depth(self) -> int:
         return self._inflight
 

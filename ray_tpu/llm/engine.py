@@ -37,6 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ray_tpu._private.analysis.lock_witness import make_lock
+from ray_tpu._private import device_telemetry
 from ray_tpu.llm.config import GenerationConfig, LLMConfig
 from ray_tpu.models import llama
 from ray_tpu.ops.rope import rope_frequencies
@@ -267,6 +268,12 @@ class JaxLLMEngine:
         self._inflight = None
         # monotonic ts of the last traced step's phase spans (rate limit)
         self._last_phase_span = float("-inf")
+        # serving deployment name (set via the replica's set_slo_label
+        # threading); assigning one attaches device telemetry.  None
+        # (direct engine use) keeps the disabled path: one attribute
+        # read + None check per step.
+        self._slo_label: Optional[str] = None
+        self._telemetry: Optional[device_telemetry.EngineTelemetry] = None
 
         # params are an ARGUMENT of the jitted programs, never a closure:
         # captured closures lower as inline constants, and a real model's
@@ -281,6 +288,50 @@ class JaxLLMEngine:
 
     def _build_tp_mesh(self, tp: int):
         return build_tp_mesh(self.cfg, tp)
+
+    # -- device telemetry ----------------------------------------------
+
+    @property
+    def slo_label(self) -> Optional[str]:
+        return self._slo_label
+
+    @slo_label.setter
+    def slo_label(self, name: Optional[str]) -> None:
+        self._slo_label = name
+        if name is None:
+            self._telemetry = None
+            return
+        self._telemetry = device_telemetry.engine_telemetry_for(
+            name,
+            weights_bytes=device_telemetry.tree_nbytes(self.params),
+            kv_pool_bytes=device_telemetry.tree_nbytes(self.cache))
+        if self._telemetry is not None:
+            device_telemetry.register_utilization_object(
+                f"{name}:{id(self):x}", self)
+
+    def utilization(self) -> dict:
+        """Exact engine bookkeeping for ``state.utilization()``.  The
+        static cache has no block pool — KV occupancy is slot occupancy
+        (a slot owns its full max_seq stripe for its lifetime)."""
+        with self._lock:
+            active = sum(1 for r in self._slot_req if r is not None)
+            pending = len(self._pending)
+        row = {
+            "engine": "static",
+            "deployment": self._slo_label,
+            "slots": {"active": active, "max": self.max_batch,
+                      "free": self.max_batch - active},
+            "kv_blocks": {"total": self.max_batch, "free":
+                          self.max_batch - active, "used": active},
+            "pending": pending,
+        }
+        tel = self._telemetry
+        if tel is not None:
+            rates = tel.rates()
+            row["duty_cycle"] = rates["duty_cycle"]
+            row["rates"] = rates
+            row["hbm"] = tel.hbm_split()
+        return row
 
     # -- jitted programs ------------------------------------------------
 
@@ -414,6 +465,9 @@ class JaxLLMEngine:
         traced = rec.active and now - self._last_phase_span >= 0.2
         if traced:
             self._last_phase_span = now
+        # device telemetry: one attribute read + None check when disabled
+        tel = self._telemetry
+        tel_active = tel_pending = 0
         with self._lock:
             before = {id(r): len(r.out_tokens)
                       for r in self._requests.values()}
@@ -479,7 +533,21 @@ class JaxLLMEngine:
             else:
                 self._collect_inflight_locked()
             emitted = self._gather_emitted_locked(before)
+            if tel is not None:
+                # captured under the lock into locals; booked after
+                # release next to rec.emit() (PhaseRecorder discipline)
+                tel_active = sum(1 for r in self._slot_req
+                                 if r is not None)
+                tel_pending = len(self._pending)
         rec.emit()
+        if tel is not None:
+            t_end = time.monotonic()
+            tel.note_step(
+                active_slots=tel_active, max_slots=self.max_batch,
+                free_blocks=self.max_batch - tel_active,
+                total_blocks=self.max_batch, pending=tel_pending,
+                prefill_spent=0, prefill_budget=0,
+                busy_s=t_end - now, now=t_end)
         return emitted
 
     def _book_chunk_locked(self, em_dev, active):

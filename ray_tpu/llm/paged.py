@@ -40,6 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ray_tpu._private.analysis.lock_witness import make_lock
+from ray_tpu._private import device_telemetry
 from ray_tpu.llm.config import GenerationConfig, LLMConfig
 from ray_tpu.llm.engine import (
     _MAX_STOP_IDS,
@@ -497,7 +498,15 @@ class PagedJaxLLMEngine:
         # serving SLO layer: the hosting deployment's name, set via the
         # replica's set_slo_label threading (serve/_private/replica.py).
         # None (direct engine use) books no lifecycle stages at all.
-        self.slo_label: Optional[str] = None
+        # Assigning a name also attaches device telemetry (slo_label is a
+        # property) — the disabled path is self._telemetry staying None.
+        self._slo_label: Optional[str] = None
+        self._telemetry: Optional[device_telemetry.EngineTelemetry] = None
+        # chunked-prefill budget spend, tracked per step for telemetry
+        self._tel_prefill_budget = (config.prefill_token_budget
+                                    or config.prefill_budget_tokens
+                                    or config.prefill_chunk)
+        self._tel_prefill_spent = 0
         # one decode chunk may stay IN FLIGHT while the host books the
         # previous chunk's tokens: the readback of chunk N overlaps chunk
         # N+1's device compute, hiding the dispatch+fence round trip
@@ -625,6 +634,59 @@ class PagedJaxLLMEngine:
             # layer's per-request acceptance rows (bounded)
             self._spec_finished: "collections.OrderedDict[int, Tuple[int, int]]" = (
                 collections.OrderedDict())
+
+    # -- device telemetry ----------------------------------------------
+
+    @property
+    def slo_label(self) -> Optional[str]:
+        return self._slo_label
+
+    @slo_label.setter
+    def slo_label(self, name: Optional[str]) -> None:
+        self._slo_label = name
+        if name is None:
+            self._telemetry = None
+            return
+        kv_bytes = device_telemetry.tree_nbytes(self.pool)
+        if self._spec is not None:
+            kv_bytes += device_telemetry.tree_nbytes(self._draft_pool)
+        self._telemetry = device_telemetry.engine_telemetry_for(
+            name,
+            weights_bytes=device_telemetry.tree_nbytes(self.params),
+            kv_pool_bytes=kv_bytes)
+        if self._telemetry is not None:
+            # local-mode / engine-direct utilization surface; serve
+            # replicas additionally publish rows to the GCS KV
+            device_telemetry.register_utilization_object(
+                f"{name}:{id(self):x}", self)
+
+    def utilization(self) -> dict:
+        """Exact engine bookkeeping for ``state.utilization()``: slot and
+        KV-block occupancy read from the live structures under the lock,
+        plus the step-derived rates and HBM split when telemetry is
+        attached.  Block 0 is the sink (never allocated), so capacity is
+        ``num_blocks - 1``."""
+        with self._lock:
+            active = sum(1 for r in self._slot_req if r is not None)
+            free = self.blocks.num_free()
+            pending = len(self._pending)
+        total = self.num_blocks - 1
+        row = {
+            "engine": "paged",
+            "deployment": self._slo_label,
+            "slots": {"active": active, "max": self.max_batch,
+                      "free": self.max_batch - active},
+            "kv_blocks": {"total": total, "free": free,
+                          "used": total - free},
+            "pending": pending,
+        }
+        tel = self._telemetry
+        if tel is not None:
+            rates = tel.rates()
+            row["duty_cycle"] = rates["duty_cycle"]
+            row["rates"] = rates
+            row["hbm"] = tel.hbm_split()
+        return row
 
     # -- jitted programs ------------------------------------------------
 
@@ -1040,6 +1102,7 @@ class PagedJaxLLMEngine:
         budget = (self.config.prefill_token_budget
                   or self.config.prefill_budget_tokens
                   or self.config.prefill_chunk)
+        self._tel_prefill_budget = budget
         progress = True
         while budget > 0 and progress:
             # round-robin over mid-prefill slots, one chunk each, until
@@ -1109,6 +1172,7 @@ class PagedJaxLLMEngine:
                     self._first_pending.append((slot, req, ids))
                     self._dirty = True
                 budget -= take
+                self._tel_prefill_spent += take
 
     def _emit_locked(self, req: _PagedReq, token: int):
         req.out_tokens.append(token)
@@ -1367,7 +1431,11 @@ class PagedJaxLLMEngine:
         traced = rec.active and now - self._last_phase_span >= 0.2
         if traced:
             self._last_phase_span = now
+        # device telemetry: one attribute read + None check when disabled
+        tel = self._telemetry
+        tel_active = tel_free = tel_pending = 0
         with self._lock:
+            self._tel_prefill_spent = 0
             before = self._emit_snapshot_locked()
             if self._pending or any(
                     r is not None and not self._decode_ready(r)
@@ -1451,7 +1519,23 @@ class PagedJaxLLMEngine:
             else:
                 self._drain_locked()
             emitted = self._gather_emitted_locked(before)
+            if tel is not None:
+                # captured under the lock into locals; booked after
+                # release next to rec.emit() (PhaseRecorder discipline)
+                tel_active = sum(1 for r in self._slot_req
+                                 if r is not None)
+                tel_free = self.blocks.num_free()
+                tel_pending = len(self._pending)
         rec.emit()
+        if tel is not None:
+            t_end = time.monotonic()
+            tel.note_step(
+                active_slots=tel_active, max_slots=self.max_batch,
+                free_blocks=tel_free, total_blocks=self.num_blocks - 1,
+                pending=tel_pending,
+                prefill_spent=self._tel_prefill_spent,
+                prefill_budget=self._tel_prefill_budget,
+                busy_s=t_end - now, now=t_end)
         return emitted
 
     def _spec_step_locked(self, table, active: List[int]):

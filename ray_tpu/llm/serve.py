@@ -90,6 +90,31 @@ class LLMServer:
             except Exception:  # noqa: BLE001 — static engine variants
                 pass
 
+    def utilization(self) -> Optional[Dict[str, Any]]:
+        """Device-telemetry utilization row for the hosting replica's
+        publish loop and the local-mode fold (state.utilization()): the
+        base engine's exact bookkeeping, plus any live adapter engines'
+        rows under ``adapters``.  ``None`` when the engine variant has no
+        utilization surface."""
+        base = getattr(self._engine, "utilization", None)
+        row = base() if base is not None else None
+        if row is None:
+            return None
+        with self._engines_lock:
+            extras = [(m, e) for m, e in self._engines.items()
+                      if m is not None]
+        adapters = {}
+        for model, eng in extras:
+            try:
+                adapters[model] = eng.utilization()
+            except Exception:  # noqa: BLE001 — engine variants without one
+                pass
+        if adapters:
+            row["adapters"] = adapters
+        if self._slo_label is not None:
+            row["deployment"] = self._slo_label
+        return row
+
     def prefix_digest(self) -> Dict[str, Any]:
         """Cache-aware routing surface (serve/handle.py): the base engine's
         prefix-chain digest plus the adapter ids this replica has loaded

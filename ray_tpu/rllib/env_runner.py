@@ -65,18 +65,32 @@ class EnvRunner:
         self._weights_version = -1
         self._fragment_channel = None
         self._weights_channel = None
-        self._policy_traces = 0
         self._jit_policy = None
+        # compile counting rides the process-wide compile watch
+        # (device_telemetry): note_trace() books from inside the traced
+        # function, so the watch's per-program trace count IS the compile
+        # count.  The program name carries the runner identity — several
+        # runners in one process each trace their own jit instance, and a
+        # shared name would cross-count them; the base offset guards
+        # against id() reuse.
+        self._trace_program = f"rllib.env_runner.policy:{id(self):x}"
+        self._trace_base = 0
         if inference == "jit":
             import jax
 
+            from ray_tpu._private import device_telemetry
+
             def policy(params, obs):
-                # host-side counter bumps ONLY while tracing: the compiled
-                # program never re-enters Python, so this counts compiles
-                self._policy_traces += 1
+                # books ONLY while tracing: the compiled program never
+                # re-enters Python, so the watch counts compiles
+                device_telemetry.note_trace(
+                    self._trace_program,
+                    shape_key=getattr(obs, "shape", None))
                 return self._module.forward(params, obs)
 
             self._jit_policy = jax.jit(policy)
+            self._trace_base = device_telemetry.trace_count(
+                self._trace_program)
 
     # -- Sebulba weight plane ------------------------------------------------
 
@@ -93,10 +107,15 @@ class EnvRunner:
         return self._weights_version
 
     def compile_count(self) -> int:
-        """Times the jitted policy function was TRACED (jit cache misses).
-        Stays at 1 across any number of set_weights calls — the regression
-        surface for the params-as-arguments contract."""
-        return self._policy_traces
+        """Times THIS runner's jitted policy function was traced (jit
+        cache misses), read from the process-wide compile watch minus the
+        base recorded at init.  Stays at 1 across any number of
+        set_weights calls — the regression surface for the
+        params-as-arguments contract."""
+        from ray_tpu._private import device_telemetry
+
+        return (device_telemetry.trace_count(self._trace_program)
+                - self._trace_base)
 
     def attach_channels(self, fragment_channel=None, weights_channel=None):
         """Wire the single-slot channels for streamed fragments / weight

@@ -52,6 +52,17 @@ class ServeReplica:
         if hasattr(self._callable, "prefix_digest"):
             threading.Thread(target=self._publish_digest_loop, daemon=True,
                              name="serve-prefix-digest").start()
+        # device telemetry: a callable exposing utilization() gets its
+        # slot/KV occupancy row published to the GCS KV (util: prefix) so
+        # state.utilization() can name every replica's free slots/blocks —
+        # the SLO-feedback autoscaler's input surface (ROADMAP item 1)
+        from ray_tpu._private import device_telemetry
+
+        if (hasattr(self._callable, "utilization")
+                and device_telemetry.enabled()):
+            threading.Thread(target=self._publish_utilization_loop,
+                             daemon=True,
+                             name="serve-utilization").start()
         # serving SLO layer: thread the deployment name into the hosted
         # callable so engine-side lifecycle stages (queue_wait, prefill,
         # decode) book under it (llm/serve.py set_slo_label); callables
@@ -124,6 +135,47 @@ class ServeReplica:
                     "models": list(digest.get("models") or ()),
                     "qlen": digest.get("qlen"),
                 })}, timeout=5)
+            except Exception:  # noqa: BLE001 — publication is best-effort
+                continue
+
+    def _publish_utilization_loop(self):
+        """Per-replica utilization rows to the GCS KV (device telemetry).
+        Same discipline as the digest loop: outside every engine lock,
+        best-effort end to end, keyed by actor id so a restarted replica
+        writes a fresh row instead of racing the old one."""
+        import json
+
+        from ray_tpu._private import device_telemetry
+        from ray_tpu._private.config import global_config
+
+        try:
+            import ray_tpu
+
+            actor_id = ray_tpu.get_runtime_context().actor_id
+            if actor_id is None:
+                # local mode: state.utilization() folds the in-process
+                # provider registry instead (engines register on attach)
+                return
+            key = device_telemetry.util_kv_key(
+                self._app, self._deployment, actor_id.hex())
+            from ray_tpu._private.worker import get_global_worker
+
+            gcs = get_global_worker().gcs
+        except Exception:  # noqa: BLE001
+            return
+        interval = global_config().utilization_publish_interval_s
+        while not self._digest_stop.wait(interval):
+            try:
+                row = self._callable.utilization()
+                if row is None:
+                    continue
+                row = dict(row)
+                row.setdefault("deployment", self._deployment)
+                row["app"] = self._app
+                row["replica"] = actor_id.hex()
+                row["ts"] = time.time()
+                gcs.call("KVPut", {"key": key, "value": json.dumps(row)},
+                         timeout=5)
             except Exception:  # noqa: BLE001 — publication is best-effort
                 continue
 

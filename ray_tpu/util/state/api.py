@@ -564,6 +564,14 @@ class StateApiClient:
                     continue
             report["stacks"] = stacks
 
+        # -- compile watch: storm detector (device telemetry) -------------
+        # N traces/compiles of one program inside the storm window name
+        # the program and its callers — a shape-churn workload surfaces
+        # here before it surfaces as missing throughput
+        from ray_tpu._private import device_telemetry
+
+        report["compile_storm"] = device_telemetry.storm_report()
+
         # -- 4. lock-order witness (test/chaos lanes) ---------------------
         # when RAY_TPU_lock_witness_enabled=1 the driver's own witnessed
         # locks have been building the acquired-while-holding graph; any
@@ -676,6 +684,97 @@ class StateApiClient:
         if tenant is not None:
             rows = [r for r in rows if r.get("tenant") == tenant]
         return rows[-limit:]
+
+    # -- device telemetry (chip-level observability) --------------------
+
+    def utilization(self, deployment: Optional[str] = None) -> dict:
+        """Cluster utilization snapshot (device telemetry): per
+        deployment, every replica's free decode slots, free KV blocks,
+        duty cycle, and HBM split, plus summed headroom — free slots and
+        free blocks per deployment are THE SLO-feedback autoscaler's
+        inputs (ROADMAP item 1).  Folds GCS-published replica rows
+        (serve/_private/replica.py utilization loop) with this process's
+        locally registered engines (local-testing-mode serve apps and
+        engine-direct benches publish nowhere, but still fold here)."""
+        import json
+
+        from ray_tpu._private import device_telemetry
+
+        rows: List[dict] = []
+        try:
+            keys = self._w.gcs.call(
+                "KVKeys",
+                {"prefix": device_telemetry.UTIL_KV_PREFIX}) or []
+            blobs = self._w.gcs.call("KVMultiGet", {"keys": keys}) or {}
+            for blob in blobs.values():
+                if not blob:
+                    continue
+                try:
+                    rows.append(json.loads(blob))
+                except Exception:  # noqa: BLE001 — one bad row, not all
+                    continue
+        except Exception:  # noqa: BLE001 — KV unreachable: local rows only
+            pass
+        rows.extend(device_telemetry.local_utilization_rows())
+        snap = device_telemetry.fold_utilization_rows(rows)
+        if deployment is not None:
+            snap["deployments"] = {
+                k: v for k, v in snap["deployments"].items()
+                if k == deployment}
+        return snap
+
+    def profile(self, pid: int, node_id=None, duration_s: float = 2.0,
+                mode: str = "auto") -> dict:
+        """On-demand profiler capture of one worker (device telemetry):
+        a jax.profiler XPlane trace where the target's backend supports
+        it, else the pure-Python sampling profile (sys._current_frames
+        over the worker RPC thread, like PR 6's FlightRecorderTail).
+        Returns the artifact path plus the trace_ids active on the
+        worker around the capture window (flight-recorder tail), so a
+        chip-level capture cross-links to ``state.get_trace()``."""
+        if mode not in ("auto", "jax", "cpu"):
+            raise ValueError(f"mode must be auto|jax|cpu (got {mode!r})")
+        result: dict = {"pid": pid, "mode": None, "artifact": None}
+        if mode in ("auto", "jax"):
+            try:
+                rep = self.jax_profile(pid, node_id=node_id,
+                                       duration_s=duration_s)
+                files = rep.get("files") or []
+                if files or mode == "jax":
+                    result["mode"] = "jax"
+                    result["artifact"] = files[0] if files \
+                        else rep.get("logdir")
+                    result["logdir"] = rep.get("logdir")
+                    result["files"] = files
+            except Exception:  # noqa: BLE001 — fall back to sampling
+                if mode == "jax":
+                    raise
+        if result["mode"] is None:
+            import json
+            import os
+            import tempfile
+
+            rep = self.cpu_profile(pid, node_id=node_id,
+                                   duration_s=duration_s)
+            fd, path = tempfile.mkstemp(
+                prefix=f"ray_tpu_profile_{pid}_", suffix=".json")
+            with os.fdopen(fd, "w") as f:
+                json.dump(rep, f, indent=1)
+            result["mode"] = "cpu"
+            result["artifact"] = path
+            result["samples"] = rep.get("samples")
+        try:
+            tids: List[str] = []
+            for row in self.flight_recorder(pid=pid,
+                                            seconds=duration_s + 30):
+                for e in row.get("entries") or []:
+                    t = e.get("trace_id")
+                    if t and t not in tids:
+                        tids.append(t)
+            result["trace_ids"] = tids[-16:]
+        except Exception:  # noqa: BLE001 — cross-link is enrichment only
+            result["trace_ids"] = []
+        return result
 
     def _agent_call_by_pid(self, method: str, payload: dict, *, pid,
                            node_id, timeout: float) -> dict:
@@ -835,3 +934,25 @@ def cpu_profile(pid, node_id=None, duration_s: float = 5.0):
 
 def jax_profile(pid, node_id=None, duration_s: float = 3.0, logdir=None):
     return _client().jax_profile(pid, node_id, duration_s, logdir)
+
+
+def utilization(deployment=None):
+    try:
+        client = _client()
+    except RuntimeError:
+        # no cluster connection: fold this process's registered engines
+        # (local-testing-mode serve apps, engine-direct benches)
+        from ray_tpu._private import device_telemetry
+
+        snap = device_telemetry.local_utilization()
+        if deployment is not None:
+            snap["deployments"] = {
+                k: v for k, v in snap["deployments"].items()
+                if k == deployment}
+        return snap
+    return client.utilization(deployment)
+
+
+def profile(pid, node_id=None, duration_s: float = 2.0,
+            mode: str = "auto"):
+    return _client().profile(pid, node_id, duration_s, mode)

@@ -204,14 +204,20 @@ def test_prefix_cache_metric_families_exposed():
                  "ray_tpu_kv_handoff_latency_seconds",
                  "ray_tpu_serve_disagg_queue_depth"):
         assert want in names, want
+    # counters accumulate across the test session (other tier-1 tests run
+    # real handoffs in-process), so assert against prior + booked
+    hits0 = rm.prefix_cache_snapshot()["hits"].get("hbm", 0)
+    bytes0 = rm.kv_handoff_snapshot().get("object", {}).get("bytes_total", 0)
     rm.add_prefix_cache_hits("hbm", 3)
     rm.add_prefix_cache_misses(2)
     rm.add_prefix_cache_evictions("host", 1)
     rm.record_kv_handoff("object", 1024, 0.01)
     rm.set_disagg_queue_depth("prefill", 4)
     text = prometheus_text(collect_local())
-    assert 'ray_tpu_serve_prefix_cache_hits_total{tier="hbm"} 3' in text
-    assert 'ray_tpu_kv_handoff_bytes_total{transport="object"} 1024' in text
+    assert (f'ray_tpu_serve_prefix_cache_hits_total{{tier="hbm"}} '
+            f'{hits0 + 3}') in text
+    assert (f'ray_tpu_kv_handoff_bytes_total{{transport="object"}} '
+            f'{bytes0 + 1024}') in text
     assert 'ray_tpu_serve_disagg_queue_depth{stage="prefill"} 4' in text
     snap = rm.prefix_cache_snapshot()
     assert snap["hits"]["hbm"] >= 3 and snap["misses"] >= 2
