@@ -1,0 +1,161 @@
+"""Overhead microbench for the metrics history store + watch engine.
+
+The history fold rides (rate-limited) on ReportMetrics pushes inside the
+GCS and the watch tick rides the GCS health loop, so both must stay cheap
+and — critically — the ``metrics_history_enabled=False`` path must add
+essentially nothing to ReportMetrics (one attribute read + None check).
+This bench measures:
+
+  fold_us             — one history fold of a ~60-series cluster aggregate
+  fold_due_ns         — the per-push gate (clock read + compare)
+  tick_per_rule_us_8  — watch-tick cost per rule at 8 rules
+  tick_per_rule_us_64 — watch-tick cost per rule at 64 rules (same
+                        families: flat-in-rule-count means the ratio of
+                        the two per-rule costs stays ~1)
+  report_disabled_ns  — full HandleReportMetrics with the layer disabled
+  disabled_guard_ns   — the disabled path's entire addition (attr + None)
+  cap_*               — history bytes after adversarial tagset churn vs
+                        the configured cap (counter-enforced: the meter is
+                        pure counting, no wall clock)
+
+Prints one JSON document; exit 1 if any gate fails.  Budgets are CI-loose
+(order-of-magnitude guards); tests/test_perf_smoke.py enforces them.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def _bench(fn, n: int = 2000) -> float:
+    """Seconds per call, best of 3 (min defends against CI noise)."""
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            fn()
+        best = min(best, (time.perf_counter() - t0) / n)
+    return best
+
+
+def _aggregate(n_counters: int = 20, n_gauges: int = 20,
+               n_sketches: int = 20, scale: float = 1.0):
+    pts = []
+    for i in range(n_counters):
+        pts.append({"name": f"bench_ctr_{i}", "kind": "counter",
+                    "tags": {"k": "v"}, "value": 100.0 * scale})
+    for i in range(n_gauges):
+        pts.append({"name": f"bench_gauge_{i}", "kind": "gauge",
+                    "tags": {"k": "v"}, "value": scale})
+    for i in range(n_sketches):
+        pts.append({"name": f"bench_sk_{i}", "kind": "sketch",
+                    "tags": {"k": "v"}, "accuracy": 0.01,
+                    "bins": [[j, int(scale)] for j in range(40)],
+                    "zero": 0, "count": int(40 * scale),
+                    "sum": 40.0 * scale, "min": 0.1, "max": 10.0})
+    return pts
+
+
+def run() -> dict:
+    from ray_tpu._private.config import RayTpuConfig
+    from ray_tpu._private.metrics_history import (
+        MetricsHistory, WatchEngine, WatchRule)
+
+    out = {}
+
+    # -- fold cost (amortized per-push cost is fold_us / pushes-per-fold;
+    # the gate below is what every non-folding push pays) ------------------
+    cfg = RayTpuConfig(metrics_history_fold_interval_s=0.0)
+    fake = {"t": 1_000_000.0}
+    hist = MetricsHistory(cfg, clock=lambda: fake["t"],
+                          wall=lambda: fake["t"])
+    scale = {"n": 0}
+
+    def one_fold():
+        scale["n"] += 1
+        fake["t"] += 1.0
+        hist.fold(_aggregate(scale=float(scale["n"])))
+
+    out["fold_us"] = round(_bench(one_fold, n=300) * 1e6, 2)
+
+    cfg2 = RayTpuConfig(metrics_history_fold_interval_s=3600.0)
+    hist2 = MetricsHistory(cfg2)
+    hist2.fold(_aggregate())
+    out["fold_due_ns"] = round(_bench(hist2.fold_due, n=100_000) * 1e9, 1)
+
+    # -- watch tick: per-rule cost flat in rule count at fixed families ----
+    def tick_cost(n_rules: int) -> float:
+        eng = WatchEngine(hist, config=cfg,
+                          clock=lambda: fake["t"], wall=lambda: fake["t"])
+        for i in range(n_rules):
+            eng.add_rule(WatchRule(
+                name=f"r{i}", kind="threshold",
+                family=f"bench_gauge_{i % 20}", threshold=1e12,
+                window_s=300.0))
+        return _bench(lambda: eng.tick(reporter_ages={}), n=50) / n_rules
+
+    out["tick_per_rule_us_8"] = round(tick_cost(8) * 1e6, 2)
+    out["tick_per_rule_us_64"] = round(tick_cost(64) * 1e6, 2)
+    out["tick_flatness"] = round(
+        out["tick_per_rule_us_64"] / max(out["tick_per_rule_us_8"], 1e-9),
+        3)
+
+    # -- disabled path ------------------------------------------------------
+    from ray_tpu._private.gcs import GcsServer
+
+    gcs = GcsServer(config=RayTpuConfig(metrics_history_enabled=False))
+    try:
+        assert gcs.history is None and gcs.watch is None
+        payload = {"reporter": "bench", "points": _aggregate(),
+                   "time": time.time()}
+        out["report_disabled_ns"] = round(
+            _bench(lambda: gcs.HandleReportMetrics(payload), n=2000) * 1e9,
+            1)
+        # the disabled path's ENTIRE addition to ReportMetrics: one
+        # attribute read + None check (then the `and` short-circuits)
+        out["disabled_guard_ns"] = round(
+            _bench(lambda: gcs.history is not None and None,
+                   n=100_000) * 1e9, 1)
+    finally:
+        gcs.shutdown()
+
+    # -- byte cap under adversarial tagset churn (counter-enforced) --------
+    cap_cfg = RayTpuConfig(metrics_history_fold_interval_s=0.0,
+                           metrics_history_max_bytes=256 * 1024)
+    cap_hist = MetricsHistory(cap_cfg, clock=lambda: fake["t"],
+                              wall=lambda: fake["t"])
+    for i in range(5000):
+        fake["t"] += 0.5
+        cap_hist.fold([{"name": "bench_churn", "kind": "counter",
+                        "tags": {"victim": f"t{i}"}, "value": float(i)},
+                       {"name": "bench_churn_sk", "kind": "sketch",
+                        "tags": {"victim": f"t{i}"}, "accuracy": 0.01,
+                        "bins": [[j, 1] for j in range(64)], "zero": 0,
+                        "count": 64, "sum": 64.0, "min": 0.1, "max": 9.0}])
+    out["cap_bytes"] = cap_hist.bytes_estimate()
+    out["cap_max_bytes"] = cap_hist.max_bytes
+    out["cap_ok"] = out["cap_bytes"] <= out["cap_max_bytes"]
+    out["cap_series"] = cap_hist.series_count()
+    out["cap_evictions"] = cap_hist.stats()["evictions"]
+    return out
+
+
+def main() -> int:
+    extra = run()
+    ok = (extra["fold_us"] < 5_000
+          and extra["fold_due_ns"] < 2_000
+          and extra["tick_flatness"] < 3.0
+          and extra["disabled_guard_ns"] < 1_000
+          and extra["cap_ok"])
+    print(json.dumps({"metric": "watch_overhead",
+                      "value": extra["fold_us"], "unit": "us",
+                      "ok": ok, "extra": extra}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    sys.exit(main())

@@ -232,6 +232,35 @@ class RayTpuConfig:
     # replica-side utilization publish period (KV row per replica:
     # free slots/blocks, duty cycle, HBM split — the autoscaler's input)
     utilization_publish_interval_s: float = 2.0
+    # --- metrics history + watch engine (_private/metrics_history.py) ---
+    # master switch for the in-GCS time-series store and the watch-rule
+    # engine.  Off => the GCS constructs NEITHER (history/watch stay None)
+    # and the only addition to ReportMetrics is one attribute read + None
+    # check (benchmarks/watch_overhead_bench.py gates it)
+    metrics_history_enabled: bool = True
+    # cheap per-push gate: the GCS folds the cluster aggregate into the
+    # history at most this often (pushes in between pay one clock read)
+    metrics_history_fold_interval_s: float = 5.0
+    # raw ring: bucket width and trailing retention (default 10s for 15min)
+    metrics_history_raw_step_s: float = 10.0
+    metrics_history_raw_retention_s: float = 900.0
+    # rollup ring: coarse buckets for the long view (default 60s for 4h)
+    metrics_history_rollup_step_s: float = 60.0
+    metrics_history_rollup_retention_s: float = 14400.0
+    # hard global byte cap on the whole history store, counter-enforced;
+    # exceeded => whole tagsets are LRU-evicted (oldest fold first), so
+    # adversarial tag churn degrades coverage, never memory
+    metrics_history_max_bytes: int = 8 * 1024**2
+    # shrink-only per-family retention overrides:
+    # "family=seconds,family2=seconds" (caps BOTH rings for that family)
+    metrics_history_family_retention: str = ""
+    # watch engine: rule evaluation on the GCS health tick.  ANDed with
+    # metrics_history_enabled (rules read the history store)
+    watch_rules_enabled: bool = True
+    # ship the built-in rule pack (kv occupancy, queue growth, input wait,
+    # compile storm, straggler lag, goodput drop, dead reporter, serve
+    # burn); off => only explicitly added rules run
+    watch_builtin_rules_enabled: bool = True
     # --- lock-order witness (_private/analysis/lock_witness.py) ---
     # test/chaos-lane knob: locks built through make_lock/make_rlock become
     # lockdep-style witnesses that record per-thread acquisition stacks,

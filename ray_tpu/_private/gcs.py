@@ -118,9 +118,9 @@ class Pubsub:
     """
 
     # control channels fanned out through the raylet relay tree (node
-    # lifecycle + drain notices; ACTOR:*/PG:* stay flat — their subscriber
-    # sets are owners, not the whole cluster)
-    TREE_CHANNELS = ("NODE",)
+    # lifecycle + drain notices + watch-rule alerts; ACTOR:*/PG:* stay
+    # flat — their subscriber sets are owners, not the whole cluster)
+    TREE_CHANNELS = ("NODE", "ALERT")
 
     def __init__(self, pool: ClientPool, config: Optional[RayTpuConfig] = None):
         self._subs: Dict[str, List[Tuple[Tuple[str, int], str]]] = {}
@@ -276,6 +276,27 @@ class GcsServer:
         self.scheduler = ClusterResourceScheduler()
         self.task_events: deque = deque(maxlen=self.config.task_events_max_buffer)
         self.metrics_by_reporter: Dict[str, dict] = {}
+        # counters/histograms/sketches of EVICTED reporters, folded here so
+        # cluster counters never step backwards under worker churn (the
+        # "events that HAPPENED — they stay" invariant); keyed like the
+        # CollectMetrics aggregate
+        self._retired_metrics: Dict[tuple, dict] = {}
+        # metrics history + watch engine (ISSUE 17): disabled => both stay
+        # None and ReportMetrics pays one attribute read + None check
+        self.history = None
+        self.watch = None
+        if self.config.metrics_history_enabled:
+            from ray_tpu._private.metrics_history import (
+                MetricsHistory, WatchEngine, builtin_rules)
+
+            self.history = MetricsHistory(self.config)
+            if self.config.watch_rules_enabled:
+                self.watch = WatchEngine(
+                    self.history, config=self.config,
+                    on_transition=self._on_watch_transition)
+                if self.config.watch_builtin_rules_enabled:
+                    for rule in builtin_rules(self.config):
+                        self.watch.add_rule(rule)
         # cluster event log (reference: dashboard/modules/event/ +
         # src/ray/gcs/gcs_server event aggregation): bounded ring of
         # structured events surfaced by the dashboard and the state API
@@ -675,6 +696,45 @@ class GcsServer:
                 self._mark_node_dead(
                     nid, "drained" if state == "DRAINING"
                     else "missed health checks")
+            self._watch_tick()
+
+    def _watch_tick(self):
+        """History fold + watch-rule evaluation on the GCS tick: history
+        keeps advancing (and absence rules keep firing) even when no
+        reporter pushes arrive."""
+        hist = self.history
+        if hist is not None and hist.fold_due():
+            try:
+                hist.fold(self.HandleCollectMetrics({}))
+                runtime_metrics.set_history_footprint(
+                    hist.bytes_estimate(), hist.series_count())
+            except Exception:  # noqa: BLE001
+                logger.exception("GCS: metrics-history fold failed")
+        if self.watch is not None:
+            now = time.monotonic()
+            with self._lock:
+                ages = {r: now - s.get("recv", now)
+                        for r, s in self.metrics_by_reporter.items()}
+            try:
+                self.watch.tick(reporter_ages=ages)
+            except Exception:  # noqa: BLE001
+                logger.exception("GCS: watch tick failed")
+
+    def _on_watch_transition(self, rule, transition: dict):
+        """A watch alert fired or cleared: count it, put it in the cluster
+        event log, and fan it out on the ALERT tree channel for any
+        control-plane subscriber (autoscaler, serve controller)."""
+        state = transition["state"]
+        runtime_metrics.inc_watch_alert(transition["rule"], state)
+        severity = transition["severity"] if state == "firing" else "INFO"
+        self._record_event(
+            severity, "watch",
+            f"watch rule {transition['rule']} {state} "
+            f"({transition['key']}: {transition['value']:.4g} vs "
+            f"threshold {transition['threshold']:.4g})",
+            rule=transition["rule"], key=transition["key"], state=state,
+            value=transition["value"], threshold=transition["threshold"])
+        self.pubsub.publish("ALERT", transition)
 
     # ------------------------------------------------------------------
     # Jobs
@@ -1230,12 +1290,60 @@ class GcsServer:
                 "points": req["points"], "time": req.get("time"),
                 "recv": time.monotonic(),
             }
-            # bound memory across worker churn: evict stalest reporters
+            # bound memory across worker churn: evict stalest reporters —
+            # but counters/histograms/sketches are events that HAPPENED,
+            # so fold them into the retired baseline first (cluster
+            # counters must never step backwards because a reporter aged
+            # out; gauges die with their reporter, as they should)
             while len(self.metrics_by_reporter) > 512:
                 stalest = min(self.metrics_by_reporter,
                               key=lambda r: self.metrics_by_reporter[r]["time"] or 0)
-                del self.metrics_by_reporter[stalest]
+                self._retire_reporter_locked(
+                    self.metrics_by_reporter.pop(stalest))
+        hist = self.history
+        if hist is not None and hist.fold_due():
+            # the actual fold is rate-limited (fold_due is one clock read
+            # per push) and runs OUTSIDE the lock: CollectMetrics takes it
+            # again briefly for the snapshot, then aggregation and the
+            # history fold are lock-free
+            hist.fold(self.HandleCollectMetrics({}))
+            runtime_metrics.set_history_footprint(
+                hist.bytes_estimate(), hist.series_count())
         return True
+
+    def _retire_reporter_locked(self, snap: dict) -> None:
+        """Fold an evicted reporter's cumulative points into the retired
+        baseline (same merge semantics and keys as HandleCollectMetrics;
+        gauges excluded — a gone reporter must stop asserting them)."""
+        for p in snap.get("points", ()):
+            kind = p.get("kind")
+            if kind == "gauge":
+                continue
+            key = (p["name"], tuple(sorted(p.get("tags", {}).items())),
+                   tuple(p.get("boundaries") or ()), p.get("accuracy"))
+            cur = self._retired_metrics.get(key)
+            if cur is None:
+                self._retired_metrics[key] = dict(p)
+            elif kind == "counter":
+                cur["value"] += p["value"]
+            elif kind == "histogram":
+                cur["buckets"] = [a + b for a, b in
+                                  zip(cur["buckets"], p["buckets"])]
+                cur["sum"] += p["sum"]
+                cur["count"] += p["count"]
+            elif kind == "sketch":
+                bins = dict((int(i), int(c)) for i, c in cur.get("bins", ()))
+                for i, c in p.get("bins", ()):
+                    bins[int(i)] = bins.get(int(i), 0) + int(c)
+                cur["bins"] = sorted(bins.items())
+                cur["zero"] = cur.get("zero", 0) + p.get("zero", 0)
+                cur["sum"] += p["sum"]
+                if cur.get("count") and p.get("count"):
+                    cur["min"] = min(cur["min"], p["min"])
+                    cur["max"] = max(cur["max"], p["max"])
+                elif p.get("count"):
+                    cur["min"], cur["max"] = p["min"], p["max"]
+                cur["count"] = cur.get("count", 0) + p.get("count", 0)
 
     # gauges from reporters silent this long are dropped from the aggregate:
     # a dead node/worker must stop asserting its last chip counts / store
@@ -1251,11 +1359,20 @@ class GcsServer:
                 (s.get("time") or 0.0, s.get("recv", 0.0), s["points"])
                 for s in self.metrics_by_reporter.values()
             ]
+            # evicted reporters' cumulative counters/histograms/sketches
+            # seed the aggregate (shallow copies: every merge below
+            # REBINDS fields, never mutates the baseline's lists in place)
+            retired = [dict(p) for p in self._retired_metrics.values()]
         gauge_cutoff = time.monotonic() - max(
             self._GAUGE_STALE_S,
             10 * global_config().metrics_report_interval_s)
         agg: dict = {}
         gauge_time: dict = {}
+        for p in retired:
+            key = (p["name"], tuple(sorted(p.get("tags", {}).items())),
+                   tuple(p.get("boundaries") or ()), p.get("accuracy"))
+            agg[key] = p
+            gauge_time[key] = float("-inf")
         for report_time, recv_time, points in snapshots:
             stale = recv_time < gauge_cutoff
             for p in points:
@@ -1296,6 +1413,42 @@ class GcsServer:
                     cur["value"] = p["value"]
                     gauge_time[key] = report_time
         return list(agg.values())
+
+    # ------------------------------------------------------------------
+    # Metrics history + watch engine (_private/metrics_history.py)
+    # ------------------------------------------------------------------
+
+    def HandleMetricHistory(self, req):
+        """Query the in-GCS time-series store (state.metric_history /
+        /api/metric_history): family + optional tags/window/step, plus an
+        optional operator (rate/delta/avg_over_time/quantile_over_time)."""
+        if self.history is None:
+            return {"enabled": False, "series": []}
+        return self.history.query_api(req or {})
+
+    def HandleListAlerts(self, req):
+        """Active watch alerts + rules + recent transitions
+        (state.alerts / /api/alerts)."""
+        if self.watch is None:
+            return {"enabled": False, "alerts": [], "rules": [],
+                    "transitions": []}
+        return self.watch.report(rule=(req or {}).get("rule"))
+
+    def HandleAddWatchRule(self, req):
+        """Register (or replace, by name) a watch rule from a dict — the
+        contract the future autoscaler/controller uses to install its own
+        signals."""
+        if self.watch is None:
+            return False
+        from ray_tpu._private.metrics_history import WatchRule
+
+        self.watch.add_rule(WatchRule.from_dict(req["rule"]))
+        return True
+
+    def HandleRemoveWatchRule(self, req):
+        if self.watch is None:
+            return False
+        return self.watch.remove_rule(req["name"])
 
 
 class _LocalGcsChannel:

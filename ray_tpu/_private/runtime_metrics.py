@@ -547,6 +547,25 @@ SERVE_TOKENS_PER_CHIP = Gauge(
     "cost-per-token comparison figure",
     tag_keys=("deployment",))
 
+# -- metrics history + watch engine (_private/metrics_history.py) -----------
+# The in-GCS time-series store and declarative alert rules (ISSUE 17).
+# Alert transitions are counted (not gauged) so Prometheus increase() sees
+# every firing even between scrapes; the history footprint gauges are the
+# byte-cap observability surface (the cap itself is enforced in-store).
+WATCH_ALERTS = Counter(
+    "ray_tpu_watch_alerts_total",
+    "Watch-rule alert transitions by rule and state (firing = breach held "
+    "past for_s, cleared = recovery held past clear_for_s)",
+    tag_keys=("rule", "state"))
+METRICS_HISTORY_BYTES = Gauge(
+    "ray_tpu_metrics_history_bytes",
+    "Estimated bytes held by the GCS metrics-history store (counter-"
+    "enforced against metrics_history_max_bytes by LRU tagset eviction)")
+METRICS_HISTORY_SERIES = Gauge(
+    "ray_tpu_metrics_history_series",
+    "(family, tagset) series currently retained by the GCS metrics-"
+    "history store")
+
 FAMILIES = (
     SCHEDULE_LATENCY, PENDING_TASKS, SPILLBACKS,
     WORKER_SPAWN_LATENCY, WORKER_SPAWNS, WORKER_SPAWN_TIMEOUTS,
@@ -584,6 +603,7 @@ FAMILIES = (
     ENGINE_PREFILL_SPEND, ENGINE_STEP_DUTY,
     JIT_COMPILES, JIT_COMPILE_SECONDS,
     TRAIN_MFU, SERVE_TOKENS_PER_CHIP,
+    WATCH_ALERTS, METRICS_HISTORY_BYTES, METRICS_HISTORY_SERIES,
 )
 
 # ---------------------------------------------------------------------------
@@ -599,6 +619,8 @@ _spilled_bytes = STORE_SPILLED_BYTES.with_tags()
 _restored_bytes = STORE_RESTORED_BYTES.with_tags()
 _spawn_timeouts = WORKER_SPAWN_TIMEOUTS.with_tags()
 _zygote_fallbacks = ZYGOTE_FALLBACKS.with_tags()
+_history_bytes = METRICS_HISTORY_BYTES.with_tags()
+_history_series = METRICS_HISTORY_SERIES.with_tags()
 
 # dynamic-tag recorders are bound once per tag-set and cached; the key
 # spaces are small (rpc method names, op × world-size, deployment names)
@@ -812,6 +834,15 @@ def set_gcs_sink_sizes(task_events: int, reporters: int, events: int) -> None:
     _bound(GCS_SINK_SIZE, sink="task_events").set(task_events)
     _bound(GCS_SINK_SIZE, sink="metric_reporters").set(reporters)
     _bound(GCS_SINK_SIZE, sink="cluster_events").set(events)
+
+
+def inc_watch_alert(rule: str, state: str) -> None:
+    _bound(WATCH_ALERTS, rule=rule, state=state).inc()
+
+
+def set_history_footprint(nbytes: int, nseries: int) -> None:
+    _history_bytes.set(float(nbytes))
+    _history_series.set(float(nseries))
 
 
 def add_stored_bytes(n: int) -> None:

@@ -8,7 +8,7 @@ contract here: ``generate_configs(out_dir, metrics_url)`` materializes
     out_dir/prometheus.yml
     out_dir/grafana/provisioning/datasources/ray_tpu.yml
     out_dir/grafana/provisioning/dashboards/ray_tpu.yml
-    out_dir/grafana/dashboards/{cluster,serve,slo,events,runtime}.json
+    out_dir/grafana/dashboards/{cluster,serve,slo,events,runtime,watch}.json
 
 against the core metric names exported by the dashboard head's /metrics
 (see head.py core_metrics_text): ray_tpu_nodes, ray_tpu_actors,
@@ -159,6 +159,57 @@ def runtime_dashboard() -> dict:
     ])
 
 
+def _sparkline(panel_id: int, title: str, expr: str, x: int, y: int,
+               unit: str = "short") -> dict:
+    """Compact stat-with-sparkline: the history-panel shape for the watch
+    dashboard's at-a-glance signal row."""
+    p = _panel(panel_id, title, [expr], x, y, kind="stat", unit=unit)
+    p["gridPos"] = {"h": 4, "w": 6, "x": x, "y": y}
+    p["options"] = {"graphMode": "area", "colorMode": "value",
+                    "reduceOptions": {"calcs": ["lastNotNull"]}}
+    return p
+
+
+def watch_dashboard() -> dict:
+    """Watch rules + metrics history (_private/metrics_history.py): alert
+    transition rates per rule, the history store's footprint against its
+    byte cap, and sparkline history panels for every built-in rule-pack
+    signal.  The same series are queryable without Prometheus at
+    /api/metric_history (the in-GCS history store); these panels are the
+    external-Grafana rendering of them."""
+    return _dashboard("ray-tpu-watch", "ray_tpu watch & history", [
+        _panel(1, "Watch alerts firing/cleared by rule",
+               ['increase(ray_tpu_watch_alerts_total{state="firing"}[10m])',
+                'increase(ray_tpu_watch_alerts_total{state="cleared"}[10m])'],
+               0, 0),
+        _panel(2, "History store footprint (bytes under the hard cap)",
+               ["ray_tpu_metrics_history_bytes",
+                "ray_tpu_metrics_history_series"], 12, 0, unit="bytes"),
+        # sparkline row: the built-in rule pack's signals
+        _sparkline(3, "KV block occupancy",
+                   "ray_tpu_engine_kv_block_occupancy_ratio", 0, 8,
+                   unit="percentunit"),
+        _sparkline(4, "Decode queue depth",
+                   "ray_tpu_serve_disagg_queue_depth", 6, 8),
+        _sparkline(5, "Input-wait fraction",
+                   "rate(ray_tpu_data_ingest_wait_seconds_total[5m])",
+                   12, 8, unit="percentunit"),
+        _sparkline(6, "JIT compiles/s",
+                   "rate(ray_tpu_jit_compiles_total[5m])", 18, 8),
+        _sparkline(7, "Straggler lag",
+                   "ray_tpu_collective_straggler_lag_seconds", 0, 12,
+                   unit="s"),
+        _sparkline(8, "Goodput ratio", "ray_tpu_train_goodput_ratio",
+                   6, 12, unit="percentunit"),
+        _sparkline(9, "Serve availability burn (5m)",
+                   'ray_tpu_serve_slo_burn_rate{window="5m",'
+                   'objective="availability"}', 12, 12),
+        _sparkline(10, "Live metric reporters",
+                   'ray_tpu_gcs_sink_size{sink="metric_reporters"}',
+                   18, 12),
+    ])
+
+
 def generate_configs(out_dir: str, metrics_url: str) -> Dict[str, str]:
     """Write all configs; returns {name: path}."""
     host_port = metrics_url.split("//", 1)[-1].rstrip("/")
@@ -212,7 +263,8 @@ def generate_configs(out_dir: str, metrics_url: str) -> Dict[str, str]:
                        ("serve", serve_dashboard()),
                        ("slo", slo_dashboard()),
                        ("events", events_dashboard()),
-                       ("runtime", runtime_dashboard())):
+                       ("runtime", runtime_dashboard()),
+                       ("watch", watch_dashboard())):
         p = os.path.join(dash_dir, f"{name}.json")
         with open(p, "w") as f:
             json.dump(dash, f, indent=2)

@@ -234,6 +234,27 @@ class DashboardHead:
                 limit=int(q.get("limit", ["100"])[0]),
                 deployment=q.get("deployment", [None])[0],
                 tenant=q.get("tenant", [None])[0])
+        if path == "/api/metric_history":
+            # in-GCS time-series of the cluster metric aggregate
+            # [?family=&tags=<json>&window_s=&step_s=&op=&q=]; without
+            # family: retained families + store stats
+            import json as _json
+
+            q = query or {}
+            tags_raw = q.get("tags", [None])[0]
+            window = q.get("window_s", [None])[0]
+            step = q.get("step_s", [None])[0]
+            return state.metric_history(
+                family=q.get("family", [None])[0],
+                tags=_json.loads(tags_raw) if tags_raw else None,
+                window_s=float(window) if window else None,
+                step_s=float(step) if step else None,
+                op=q.get("op", [None])[0],
+                q=float(q.get("q", ["0.99"])[0]))
+        if path == "/api/alerts":
+            # watch-engine state: active alerts, rules, recent
+            # transitions [?rule=<name> narrows]
+            return state.alerts((query or {}).get("rule", [None])[0])
         if path == "/api/events":
             return state.list_cluster_events()
         if path == "/api/serve":

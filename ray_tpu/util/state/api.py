@@ -723,6 +723,45 @@ class StateApiClient:
                 if k == deployment}
         return snap
 
+    # -- metrics history + watch alerts (_private/metrics_history.py) --
+
+    def metric_history(self, family: Optional[str] = None,
+                       tags: Optional[dict] = None,
+                       window_s: Optional[float] = None,
+                       step_s: Optional[float] = None,
+                       op: Optional[str] = None,
+                       q: float = 0.99) -> dict:
+        """Trailing time-series of the cluster metric aggregate, straight
+        from the in-GCS history store: per matching (family, tagset) a
+        two-resolution sample list (counters as per-bucket deltas — never
+        negative across restarts/evictions; gauges last-wins; sketches as
+        per-bucket delta sketches whose window merge is lossless).  With
+        ``op`` one of rate / delta / avg_over_time / quantile_over_time
+        (``q`` sets the quantile) the GCS also evaluates the operator per
+        series.  No ``family`` lists the retained families + store
+        stats."""
+        req: dict = {"family": family, "tags": tags, "window_s": window_s,
+                     "step_s": step_s}
+        if op:
+            req["op"] = op
+            req["q"] = q
+        return self._w.gcs.call("MetricHistory", req) or {}
+
+    def alerts(self, rule: Optional[str] = None) -> dict:
+        """Watch-engine state: active alerts (pending/firing/clearing,
+        firing first), the installed rule definitions, and the recent
+        firing/cleared transition log.  ``rule`` filters to one rule."""
+        return self._w.gcs.call("ListAlerts", {"rule": rule}) or {}
+
+    def add_watch_rule(self, rule: dict) -> bool:
+        """Install (or replace, by name) a declarative watch rule — the
+        same contract the built-in pack uses; see
+        metrics_history.WatchRule for the field grammar."""
+        return bool(self._w.gcs.call("AddWatchRule", {"rule": rule}))
+
+    def remove_watch_rule(self, name: str) -> bool:
+        return bool(self._w.gcs.call("RemoveWatchRule", {"name": name}))
+
     def profile(self, pid: int, node_id=None, duration_s: float = 2.0,
                 mode: str = "auto") -> dict:
         """On-demand profiler capture of one worker (device telemetry):
@@ -918,6 +957,23 @@ def goodput(run=None):
 
 def serving_slo(deployment=None):
     return _client().serving_slo(deployment)
+
+
+def metric_history(family=None, tags=None, window_s=None, step_s=None,
+                   op=None, q: float = 0.99):
+    return _client().metric_history(family, tags, window_s, step_s, op, q)
+
+
+def alerts(rule=None):
+    return _client().alerts(rule)
+
+
+def add_watch_rule(rule: dict):
+    return _client().add_watch_rule(rule)
+
+
+def remove_watch_rule(name: str):
+    return _client().remove_watch_rule(name)
 
 
 def recent_requests(limit: int = 100, deployment=None, tenant=None):

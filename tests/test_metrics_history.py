@@ -1,0 +1,480 @@
+"""Metrics history + watch engine (ISSUE 17): bounded in-GCS time-series
+retention with Prometheus-increase counter semantics, query operators,
+declarative watch rules with hysteresis, and the control-plane wiring
+(retired-reporter baseline, ALERT pubsub, event log, state handlers).
+
+Everything here drives injectable clocks or directly-constructed GCS
+servers — no sleeps, no wall-clock races."""
+
+import math
+
+from ray_tpu._private.config import RayTpuConfig
+from ray_tpu._private.latency_sketch import LatencySketch
+from ray_tpu._private.metrics_history import (MetricsHistory, WatchEngine,
+                                              WatchRule, avg_over_time,
+                                              builtin_rules, delta,
+                                              quantile_over_time, rate)
+
+
+class _Clock:
+    """One fake time source injected as both monotonic and wall clock."""
+
+    def __init__(self, t=1_000_000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _hist(clock, **overrides):
+    cfg = RayTpuConfig(metrics_history_fold_interval_s=0.0, **overrides)
+    return MetricsHistory(cfg, clock=clock, wall=clock)
+
+
+def _ctr(value, name="t_requests_total", tags=None):
+    return {"name": name, "kind": "counter", "tags": tags or {"job": "a"},
+            "value": float(value)}
+
+
+def _gauge(value, name="t_queue_depth", tags=None):
+    return {"name": name, "kind": "gauge", "tags": tags or {"job": "a"},
+            "value": float(value)}
+
+
+# ---------------------------------------------------------------------------
+# History store: counter semantics, rings, retention, cap
+# ---------------------------------------------------------------------------
+
+
+def test_counter_deltas_never_negative_across_reset_and_eviction():
+    """The acceptance invariant: rate()/delta() stay correct (and never
+    negative) when the cluster counter total steps DOWN — a reporter
+    restart or eviction.  Prometheus increase semantics: the post-reset
+    total IS the delta."""
+    clock = _Clock()
+    h = _hist(clock)
+    totals = [100.0, 200.0, 350.0,
+              40.0,    # restart: total collapses; books 40, not -310
+              90.0, 140.0]
+    for v in totals:
+        h.fold([_ctr(v)])
+        clock.t += 10.0
+    (s,) = h.query("t_requests_total")
+    booked = [v for _, v in s["samples"]]
+    assert all(v >= 0 for v in booked), booked
+    # increases: 100, 150, 40 (reset), 50, 50 — the first fold is baseline
+    assert sum(booked) == 390.0
+    assert delta(s) == 390.0
+    assert rate(s) > 0
+    # span covers 5 booked buckets x 10s
+    assert math.isclose(rate(s), 390.0 / 50.0)
+
+
+def test_gauge_last_wins_and_rollup_resolution():
+    clock = _Clock()
+    h = _hist(clock)
+    # two gauge folds inside ONE raw bucket: last write wins
+    h.fold([_gauge(3.0)])
+    clock.t += 2.0
+    h.fold([_gauge(7.0)])
+    (s,) = h.query("t_queue_depth")
+    assert s["resolution"] == "raw" and s["samples"][-1][1] == 7.0
+    # a window wider than raw retention (900s) switches to the rollup
+    # ring, as does an explicit step at or above the rollup step
+    clock.t += 2_000.0
+    h.fold([_gauge(9.0)])
+    (roll,) = h.query("t_queue_depth", window_s=4_000.0)
+    assert roll["resolution"] == "rollup" and roll["step_s"] == 60.0
+    assert [v for _, v in roll["samples"]] == [7.0, 9.0]
+    (roll2,) = h.query("t_queue_depth", step_s=60.0)
+    assert roll2["resolution"] == "rollup"
+    # raw ring pruned to its 900s horizon: only the newest raw sample left
+    (raw,) = h.query("t_queue_depth")
+    assert [v for _, v in raw["samples"]] == [9.0]
+
+
+def test_per_family_retention_override_shrinks_only():
+    clock = _Clock()
+    h = _hist(clock, metrics_history_family_retention=
+              "t_queue_depth=60,bogus=notanumber")
+    for _ in range(30):
+        h.fold([_gauge(1.0), _ctr(5.0)])
+        clock.t += 10.0
+    (g,) = h.query("t_queue_depth")
+    (c,) = h.query("t_requests_total")
+    # override caps the queried window at 60s (6 raw buckets); the
+    # counter family keeps the full default retention
+    assert len(g["samples"]) <= 7
+    assert len(c["samples"]) > 7
+
+
+def test_byte_cap_holds_under_tagset_churn_counter_enforced():
+    """Adversarial tagset churn: the hard byte cap LRU-evicts whole
+    tagsets; the meter is pure counting (no wall clock)."""
+    clock = _Clock()
+    h = _hist(clock, metrics_history_max_bytes=128 * 1024)
+    for i in range(3_000):
+        clock.t += 1.0
+        h.fold([_ctr(float(i), tags={"victim": f"t{i}"})])
+    assert h.bytes_estimate() <= h.max_bytes
+    assert h.stats()["evictions"] > 0
+    assert h.series_count() < 3_000
+    # survivors are the most recently folded tagsets (LRU order)
+    surviving = {s["tags"]["victim"]
+                 for s in h.query("t_requests_total", window_s=10_000.0)}
+    assert f"t{2_999}" in surviving and "t0" not in surviving
+
+
+def test_quantile_over_time_matches_replayed_stream():
+    """Acceptance: quantile_over_time over N buckets equals the quantile
+    of a fresh sketch replayed with the SAME combined observation stream,
+    within 2% — the per-bucket delta-bins reconstruction is lossless."""
+    clock = _Clock()
+    h = _hist(clock)
+    cumulative = LatencySketch(relative_accuracy=0.01)
+    replay = LatencySketch(relative_accuracy=0.01)
+    # skewed latencies spread over 12 folds; the REPORTED sketch is
+    # cumulative (like a real reporter), the history books bucket deltas
+    for fold_i in range(12):
+        for j in range(200):
+            v = 0.001 * (1 + (fold_i * 200 + j) % 97) ** 1.5
+            cumulative.add(v)
+            replay.add(v)
+        pt = cumulative.to_point()
+        pt.update({"name": "t_latency", "kind": "sketch",
+                   "tags": {"job": "a"}})
+        h.fold([pt])
+        clock.t += 10.0
+    (s,) = h.query("t_latency")
+    for q in (0.5, 0.9, 0.99):
+        got = quantile_over_time(s, q)
+        want = replay.quantile(q)
+        assert abs(got - want) / want < 0.02, (q, got, want)
+    assert delta(s) == float(replay.count)
+    assert math.isclose(avg_over_time(s), replay.sum / replay.count,
+                        rel_tol=1e-9)
+
+
+def test_histogram_fold_and_operators():
+    clock = _Clock()
+    h = _hist(clock)
+    for i, (count, tot) in enumerate([(10, 5.0), (30, 11.0), (60, 26.0)]):
+        h.fold([{"name": "t_h", "kind": "histogram", "tags": {},
+                 "boundaries": (1.0,), "buckets": [count, 0],
+                 "count": count, "sum": tot}])
+        clock.t += 10.0
+    (s,) = h.query("t_h")
+    assert delta(s) == 50.0                       # 60 - 10 (first = baseline)
+    assert math.isclose(avg_over_time(s), 21.0 / 50.0)
+
+
+# ---------------------------------------------------------------------------
+# Watch engine: hysteresis, absence, burn parity
+# ---------------------------------------------------------------------------
+
+
+def test_watch_threshold_firing_and_hysteresis_clear():
+    """Acceptance: injected-clock walk through the full machine —
+    breach < for_s stays pending (no transition), sustained breach fires
+    once, recovery < clear_for_s keeps it firing (hysteresis), sustained
+    recovery clears once."""
+    clock = _Clock()
+    h = _hist(clock)
+    transitions = []
+    eng = WatchEngine(h, config=RayTpuConfig(), clock=clock, wall=clock,
+                      on_transition=lambda r, t: transitions.append(t))
+    eng.add_rule(WatchRule(name="qd_high", kind="threshold",
+                           family="t_queue_depth", threshold=5.0,
+                           window_s=120.0, for_s=20.0, clear_for_s=20.0))
+
+    def step(value, dt=10.0):
+        h.fold([_gauge(value)])
+        out = eng.tick(reporter_ages={})
+        clock.t += dt
+        return out
+
+    assert step(1.0) == []                       # ok
+    assert step(9.0) == []                       # breach -> pending
+    assert step(2.0) == []                       # recovered before for_s: ok
+    assert eng.alerts() == []                    # pending-never-fired forgot
+    assert step(9.0) == []                       # pending again (t0)
+    assert step(9.0) == []                       # held 10s < for_s
+    fired = step(9.0)                            # held 20s >= for_s: FIRES
+    assert [t["state"] for t in fired] == ["firing"]
+    assert eng.alerts()[0]["state"] == "firing"
+    assert step(1.0) == []                       # below -> clearing
+    assert step(9.0) == []                       # flap back: firing again
+    assert eng.alerts()[0]["state"] == "firing"
+    assert step(1.0) == []                       # clearing (t0)
+    assert step(1.0) == []                       # held 10s < clear_for_s
+    cleared = step(1.0)                          # held 20s: CLEARS
+    assert [t["state"] for t in cleared] == ["cleared"]
+    assert eng.alerts() == []
+    # exactly one firing + one cleared transition end to end
+    assert [t["state"] for t in transitions] == ["firing", "cleared"]
+    rep = eng.report(rule="qd_high")
+    assert rep["ticks"] == 11 and len(rep["transitions"]) == 2
+
+
+def test_absence_rule_fires_per_dead_reporter():
+    clock = _Clock()
+    h = _hist(clock)
+    eng = WatchEngine(h, config=RayTpuConfig(), clock=clock, wall=clock)
+    eng.add_rule(WatchRule(name="dead", kind="absence", threshold=60.0))
+    assert eng.tick(reporter_ages={"node:a": 5.0, "node:b": 10.0}) == []
+    fired = eng.tick(reporter_ages={"node:a": 5.0, "node:b": 120.0})
+    assert [(t["rule"], t["key"], t["state"]) for t in fired] == \
+        [("dead", "node:b", "firing")]
+    # reporter comes back: clears immediately (clear_for_s=0)
+    cleared = eng.tick(reporter_ages={"node:a": 5.0, "node:b": 1.0})
+    assert [(t["key"], t["state"]) for t in cleared] == \
+        [("node:b", "cleared")]
+
+
+def test_rate_rule_on_counter_growth():
+    clock = _Clock()
+    h = _hist(clock)
+    eng = WatchEngine(h, config=RayTpuConfig(), clock=clock, wall=clock)
+    eng.add_rule(WatchRule(name="growth", kind="rate",
+                           family="t_requests_total", threshold=5.0,
+                           window_s=120.0))
+    total = 0.0
+    fired = []
+    for inc in (10.0, 10.0, 10.0, 200.0):        # 1/s, then 20/s
+        total += inc
+        h.fold([_ctr(total)])
+        fired = eng.tick(reporter_ages={})
+        clock.t += 10.0
+    assert [t["state"] for t in fired] == ["firing"]
+    assert fired[0]["value"] > 5.0
+
+
+def test_builtin_pack_and_rule_roundtrip():
+    rules = builtin_rules(RayTpuConfig())
+    names = {r.name for r in rules}
+    assert {"kv_block_occupancy_high", "decode_queue_depth_growth",
+            "input_wait_fraction_high", "compile_storm",
+            "straggler_lag_high", "goodput_drop", "dead_reporter",
+            "serve_availability_burn"} <= names
+    for r in rules:
+        assert WatchRule.from_dict(r.to_dict()) == r
+    # from_dict ignores unknown keys (forward compat for the RPC surface)
+    r = WatchRule.from_dict({"name": "x", "threshold": 2.0,
+                             "group_by": ["a"], "unknown_field": 1})
+    assert r.group_by == ("a",) and r.threshold == 2.0
+
+
+def test_serve_burn_rule_matches_bespoke_slo_computation():
+    """Acceptance: the PR 9 serve availability burn signal re-expressed
+    as a declarative burn WatchRule over the history store reproduces the
+    bespoke slo.py multiwindow computation within tolerance."""
+    from ray_tpu.serve._private import slo
+
+    clock = _Clock(t=2_000_000.0)
+    h = _hist(clock)
+    cfg = RayTpuConfig()
+    eng = WatchEngine(h, config=cfg, clock=clock, wall=clock)
+    (burn_rule,) = [r for r in builtin_rules(cfg)
+                    if r.name == "serve_availability_burn"]
+    # make it fire on any burn so the transition carries the signal value
+    burn_rule.threshold = 1e-9
+    burn_rule.clear_for_s = 0.0
+    eng.add_rule(burn_rule)
+
+    # replay one request stream BOTH ways: slo._Windows buckets and the
+    # history's counter series (status=ok/error per deployment) — all
+    # events land well inside the 5m window so bucket-edge rounding
+    # differences between the two implementations can't bite
+    win = slo._Windows()
+    ok_total = err_total = 0.0
+    fam = "ray_tpu_serve_slo_requests_total"
+    # baseline fold BEFORE any traffic so every event lands as a delta
+    # (the history's first sight of a counter books only the baseline)
+    h.fold([
+        {"name": fam, "kind": "counter", "value": 0.0,
+         "tags": {"deployment": "dep", "status": "ok"}},
+        {"name": fam, "kind": "counter", "value": 0.0,
+         "tags": {"deployment": "dep", "status": "error"}},
+    ])
+    clock.t += 10.0
+    for i in range(9):
+        bad = i % 3 == 0                          # 1/3 error rate
+        ok_total += 0.0 if bad else 1.0
+        err_total += 1.0 if bad else 0.0
+        win.record(clock.t, bad)
+        h.fold([
+            {"name": fam, "kind": "counter", "value": ok_total,
+             "tags": {"deployment": "dep", "status": "ok"}},
+            {"name": fam, "kind": "counter", "value": err_total,
+             "tags": {"deployment": "dep", "status": "error"}},
+        ])
+        clock.t += 10.0
+
+    expected = slo._window_burn_rates(
+        {"availability": win.buckets},
+        {"slo_availability": cfg.serve_slo_availability}, clock.t)
+    exp_short = expected["availability"]["5m"]
+    exp_long = expected["availability"]["1h"]
+
+    fired = eng.tick(reporter_ages={})
+    assert [t["state"] for t in fired] == ["firing"]
+    got = fired[0]["value"]                      # min(short, long) burn
+    assert fired[0]["key"] == "deployment=dep"
+    assert exp_short > 0 and exp_long > 0
+    assert abs(got - min(exp_short, exp_long)) / min(exp_short, exp_long) \
+        < 0.02, (got, expected)
+
+
+# ---------------------------------------------------------------------------
+# GCS wiring: retired baseline, staleness, handlers, ALERT fan-out
+# ---------------------------------------------------------------------------
+
+
+def _push(gcs, reporter, points, t):
+    gcs.HandleReportMetrics({"reporter": reporter, "points": points,
+                             "time": t})
+
+
+def test_reporter_eviction_preserves_counter_totals_513():
+    """Regression (ISSUE 17 satellite): the 513th reporter evicts the
+    stalest, but its counters/histograms/sketches fold into the retired
+    baseline — the cluster aggregate NEVER steps backwards."""
+    from ray_tpu._private.gcs import GcsServer
+
+    gcs = GcsServer(config=RayTpuConfig(metrics_history_enabled=False))
+    try:
+        sk = LatencySketch(relative_accuracy=0.01)
+        sk.add(0.5)
+        skpt = sk.to_point()
+        for i in range(513):
+            pts = [
+                {"name": "t_total", "kind": "counter", "tags": {},
+                 "value": 1.0},
+                {"name": "t_hist", "kind": "histogram", "tags": {},
+                 "boundaries": (1.0,), "buckets": [1, 0], "count": 1,
+                 "sum": 0.5},
+                dict(skpt, name="t_sk", kind="sketch", tags={}),
+            ]
+            _push(gcs, f"w{i}", pts, t=float(i))
+        assert len(gcs.metrics_by_reporter) == 512
+        agg = {p["name"]: p for p in gcs.HandleCollectMetrics({})}
+        assert agg["t_total"]["value"] == 513.0
+        assert agg["t_hist"]["count"] == 513 and agg["t_hist"]["sum"] == \
+            513 * 0.5
+        assert agg["t_sk"]["count"] == 513
+        # evict 100 more: the baseline keeps absorbing, totals keep growing
+        for i in range(513, 613):
+            _push(gcs, f"w{i}", [{"name": "t_total", "kind": "counter",
+                                  "tags": {}, "value": 1.0}], t=float(i))
+        agg = {p["name"]: p for p in gcs.HandleCollectMetrics({})}
+        assert agg["t_total"]["value"] == 613.0
+        assert agg["t_hist"]["count"] == 513
+    finally:
+        gcs.shutdown()
+
+
+def test_gauge_staleness_cutoff_injected_clock():
+    """Direct HandleCollectMetrics coverage (ISSUE 17 satellite): a
+    reporter whose recv age exceeds the staleness cutoff loses its GAUGES
+    from the aggregate while its counters still sum; the newest-wins rule
+    among fresh reporters is unaffected."""
+    import time as _time
+
+    from ray_tpu._private.gcs import GcsServer
+
+    gcs = GcsServer(config=RayTpuConfig(metrics_history_enabled=False))
+    try:
+        pts = lambda g, c: [  # noqa: E731 — tiny local factory
+            {"name": "t_g", "kind": "gauge", "tags": {}, "value": g},
+            {"name": "t_c", "kind": "counter", "tags": {}, "value": c}]
+        _push(gcs, "stale", pts(111.0, 5.0), t=100.0)
+        _push(gcs, "old_fresh", pts(222.0, 5.0), t=200.0)
+        _push(gcs, "new_fresh", pts(333.0, 5.0), t=300.0)
+        # inject the clock effect: age the stale reporter's recv far past
+        # the cutoff (max(30, 10 * report_interval) seconds)
+        with gcs._lock:
+            gcs.metrics_by_reporter["stale"]["recv"] = \
+                _time.monotonic() - 10_000.0
+        agg = {p["name"]: p for p in gcs.HandleCollectMetrics({})}
+        # stale gauge dropped; newest fresh report (by push time) wins
+        assert agg["t_g"]["value"] == 333.0
+        # stale counters are events that HAPPENED: all three still sum
+        assert agg["t_c"]["value"] == 15.0
+        # flip recency: if the OTHER fresh reporter is newest, it wins
+        with gcs._lock:
+            gcs.metrics_by_reporter["old_fresh"]["time"] = 400.0
+        agg = {p["name"]: p for p in gcs.HandleCollectMetrics({})}
+        assert agg["t_g"]["value"] == 222.0
+    finally:
+        gcs.shutdown()
+
+
+def test_gcs_history_handlers_and_alert_fanout():
+    """End to end through the GCS: pushes fold into the history on the
+    ReportMetrics path, HandleMetricHistory answers queries + operators,
+    an installed rule fires on the watch tick, and the transition lands
+    in the event log, the watch counter, and the ALERT pubsub channel."""
+    from ray_tpu._private.gcs import GcsServer
+
+    gcs = GcsServer(config=RayTpuConfig(
+        metrics_history_fold_interval_s=0.0,
+        watch_builtin_rules_enabled=False))
+    try:
+        assert gcs.history is not None and gcs.watch is not None
+        published = []
+        orig_publish = gcs.pubsub.publish
+        gcs.pubsub.publish = lambda ch, data: (
+            published.append((ch, data)), orig_publish(ch, data))
+        total = 0.0
+        import time as _time
+        for _ in range(3):
+            total += 50.0
+            _push(gcs, "w0", [_ctr(total, name="t_flow")], t=_time.time())
+        # families listing + series query + rate operator via the handler
+        listing = gcs.HandleMetricHistory({})
+        assert listing["enabled"] and "t_flow" in listing["families"]
+        res = gcs.HandleMetricHistory({"family": "t_flow", "op": "rate",
+                                       "window_s": 300.0})
+        assert res["op"] == "rate" and res["results"][0]["value"] > 0
+        assert res["series"][0]["kind"] == "counter"
+        # install a rule over the RPC surface and drive the GCS tick
+        assert gcs.HandleAddWatchRule({"rule": {
+            "name": "flow_seen", "kind": "threshold", "family": "t_flow",
+            "op": ">", "threshold": 0.0, "window_s": 300.0}})
+        gcs._watch_tick()
+        rep = gcs.HandleListAlerts({})
+        assert rep["enabled"]
+        assert any(a["rule"] == "flow_seen" and a["state"] == "firing"
+                   for a in rep["alerts"])
+        assert any(t["rule"] == "flow_seen" for t in rep["transitions"])
+        # transition fanned out: ALERT pubsub + cluster event log
+        assert [ch for ch, _ in published] == ["ALERT"]
+        assert published[0][1]["rule"] == "flow_seen"
+        events = gcs.HandleListEvents({"source": "watch"})
+        assert any("flow_seen" in e["message"] for e in events)
+        # rule filter + removal over the RPC surface
+        only = gcs.HandleListAlerts({"rule": "flow_seen"})
+        assert [r["name"] for r in only["rules"]] == ["flow_seen"]
+        assert gcs.HandleRemoveWatchRule({"name": "flow_seen"})
+        assert gcs.HandleListAlerts({})["rules"] == []
+    finally:
+        gcs.shutdown()
+
+
+def test_disabled_path_books_nothing():
+    from ray_tpu._private.gcs import GcsServer
+
+    gcs = GcsServer(config=RayTpuConfig(metrics_history_enabled=False))
+    try:
+        assert gcs.history is None and gcs.watch is None
+        _push(gcs, "w0", [_ctr(1.0)], t=0.0)
+        assert gcs.HandleMetricHistory({}) == {"enabled": False,
+                                               "series": []}
+        rep = gcs.HandleListAlerts({})
+        assert rep == {"enabled": False, "alerts": [], "rules": [],
+                       "transitions": []}
+        assert not gcs.HandleAddWatchRule({"rule": {"name": "x"}})
+        assert not gcs.HandleRemoveWatchRule({"name": "x"})
+        gcs._watch_tick()                        # no-op, must not raise
+    finally:
+        gcs.shutdown()

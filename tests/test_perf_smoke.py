@@ -58,6 +58,64 @@ def test_device_telemetry_overhead_under_budget():
     assert extra["fold_16_deployments"] == 4, extra
 
 
+def test_watch_overhead_under_budget():
+    """Metrics-history + watch-engine budget gates (ISSUE 17).  The fold
+    rides (rate-limited) on ReportMetrics inside the GCS and the watch
+    tick rides the health loop, so both are budget-gated:
+
+      - one fold of a ~60-series cluster aggregate < 20 ms (idle-host
+        ~1 ms; amortized per-push cost is this divided by pushes-per-fold,
+        and every non-folding push pays only the fold_due gate < 2 µs);
+      - watch-tick cost per rule stays flat in rule count at fixed
+        families (64-rule per-rule cost within 3x of 8-rule — i.e. no
+        superlinear scan);
+      - the disabled path (metrics_history_enabled=False) books NOTHING
+        (gcs.history is None) and its entire addition to ReportMetrics —
+        one attribute read + None check — costs < 1 µs;
+      - the global history byte cap HOLDS under adversarial tagset churn
+        (5000 unique tagsets vs a 256 KiB cap), counter-enforced: the
+        byte meter is pure counting, no wall clock anywhere."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from benchmarks.watch_overhead_bench import run
+
+    extra = run()
+    assert extra["fold_us"] < 20_000, extra
+    assert extra["fold_due_ns"] < 2_000, extra
+    assert extra["tick_flatness"] < 3.0, extra
+    assert extra["report_disabled_ns"] < 50_000, extra
+    assert extra["disabled_guard_ns"] < 1_000, extra
+    assert extra["cap_ok"], extra
+    assert extra["cap_evictions"] > 0, extra
+
+
+def test_bench_diff_report_nonblocking():
+    """Non-blocking perf-trend report step (ISSUE 17 satellite): when at
+    least two BENCH_r*.json snapshots exist, run tools/bench_diff.py over
+    the newest pair and PRINT the report — visibility, not a gate.  A
+    regression verdict must not fail the lane (that's a human call on
+    snapshot data from heterogeneous boxes); only a crash in bench_diff
+    itself — a real bug in the tool — fails."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import glob
+
+    from tools.bench_diff import run
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    snaps = sorted(glob.glob(os.path.join(repo, "BENCH_r*.json")))
+    if len(snaps) < 2:
+        import pytest
+        pytest.skip("need two BENCH_r*.json snapshots to diff")
+    report = run(snaps[-2], snaps[-1])
+    assert report["old"] == snaps[-2] and report["new"] == snaps[-1]
+    print(f"bench_diff {os.path.basename(report['old'])} -> "
+          f"{os.path.basename(report['new'])}: {report['changed']} metrics "
+          f"changed, {len(report['regressions'])} regressions "
+          f"(non-blocking)")
+    for section, rows in sorted(report["sections"].items()):
+        for r in rows:
+            print(f"  [{section}] {r}")
+
+
 def test_data_ingest_overhead_zero_copy_and_wait_budget():
     """Data-plane budget gates (ISSUE 13), all counter/ratio-based:
 
