@@ -1381,6 +1381,204 @@ def _bench_checkpoint() -> dict:
     return out
 
 
+def _bench_ingress_fairness(on_tpu: bool) -> dict:
+    """Tenant-fair ingress control plane (ISSUE 18): two measurements of
+    the proxy tier with a synthetic streaming deployment (no model — this
+    section costs the control plane, not the chip).
+
+    **Scale-out SSE**: N_scale (1024 TPU / 1000 CPU) concurrent SSE
+    clients through ``serve.start_ingress()`` (2 proxies behind the
+    rendezvous splice tier) vs a 32-client reference — the acceptance
+    gate is client-observed p99 inter-frame latency within 2x of the
+    32-client figure, plus zero failed streams.
+
+    **Fair vs unfair A/B**: a 24-thread flood tenant against one paying
+    tenant through a deliberately tiny proxy (2 handle threads) — once
+    with admission OFF (the flood and the paying tenant share the WFQ at
+    equal weight, queue up to the backlog) and once ON (flood
+    rate-limited to its token bucket with 429+Retry-After, paying tenant
+    at 8x weight).  Reports the paying tenant's p50/p99 and the flood's
+    refusal counts in both runs."""
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from ray_tpu import serve
+    from ray_tpu._private.config import (RayTpuConfig, global_config,
+                                         set_global_config)
+    from ray_tpu.serve._private import admission
+    from ray_tpu.serve._private import proxy as proxy_mod
+    from ray_tpu.serve._private import slo
+
+    saved_cfg = global_config()
+
+    @serve.deployment(name="ingress-bench")
+    class Streamer:
+        def __call__(self, request):
+            if (request or {}).get("stream"):
+                def gen():
+                    for i in range(6):
+                        time.sleep(0.002)
+                        yield [i]
+                return gen()
+            time.sleep(0.005)             # unary: 5ms of "work"
+            return {"ok": True}
+
+    def post(base, payload, tenant, timeout=120):
+        body = json.dumps(payload).encode()
+        req = urllib.request.Request(
+            base, data=body, headers={"Content-Type": "application/json",
+                                      "x-tenant": tenant})
+        return urllib.request.urlopen(req, timeout=timeout)
+
+    out: dict = {}
+    try:
+        h = serve.run(Streamer.bind(), name="ingress-bench-app",
+                      _local_testing_mode=True)
+        serve.add_route("/ib", h)
+
+        # -- scale-out SSE through the tier ------------------------------
+        host, port = serve.start_ingress(num_proxies=2)
+        base = f"http://{host}:{port}/ib"
+
+        def sse_round(n):
+            results: dict = {}
+
+            def one(i):
+                try:
+                    t0 = time.perf_counter()
+                    arr = []
+                    with post(base, {"stream": True},
+                              f"t{i % 4}") as resp:
+                        for raw in resp:
+                            line = raw.decode("utf-8", "replace").strip()
+                            if line.startswith("data:") and \
+                                    "[DONE]" not in line:
+                                arr.append(time.perf_counter())
+                    results[i] = (t0, arr)
+                except Exception:  # noqa: BLE001 — count, don't kill
+                    results[i] = None
+            threads = [threading.Thread(target=one, args=(i,))
+                       for i in range(n)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            ok = [v for v in results.values() if v and len(v[1]) >= 2]
+            itls = []
+            for _t0, arr in ok:
+                itls.extend(b - a for a, b in zip(arr, arr[1:]))
+            return {
+                "clients": n,
+                "failed": sum(1 for v in results.values() if v is None),
+                "completed": len(ok),
+                "wall_s": round(wall, 2),
+                "itl_s": _percentiles(itls, ps=(50, 99)),
+            }
+
+        ref = sse_round(32)
+        n_scale = 1024 if on_tpu else 1000
+        scale = sse_round(n_scale)
+        ref_p99 = ref["itl_s"].get("p99")
+        scale_p99 = scale["itl_s"].get("p99")
+        ratio = (scale_p99 / max(ref_p99, 1e-9)
+                 if ref_p99 and scale_p99 else None)
+        out["sse_scale"] = {
+            "reference_32": ref, "scaled": scale,
+            "proxies": 2,
+            "itl_p99_ratio": round(ratio, 3) if ratio else None,
+            "itl_p99_ratio_ok": bool(ratio is not None and ratio <= 2.0
+                                     and scale["failed"] == 0),
+        }
+        serve.stop_ingress()
+
+        # -- fair vs unfair A/B ------------------------------------------
+        def ab_round(admission_on):
+            if admission_on:
+                # rate sized so the paced paying tenant (~25/s) never
+                # touches its bucket while 24 flood threads blow through
+                # theirs and eat 429s
+                set_global_config(RayTpuConfig(
+                    serve_admission_tenant_rate=50.0,
+                    serve_admission_tenant_burst=8.0,
+                    serve_admission_weights="paying=8,flood=1",
+                    serve_admission_backlog=256))
+            else:
+                set_global_config(RayTpuConfig(
+                    serve_admission_enabled=False,
+                    serve_admission_backlog=256))
+            admission.reset_controller()
+            # tiny proxy: 2 handle threads so the flood actually queues
+            p = proxy_mod._AsyncProxy("127.0.0.1", 0, max_handle_threads=2)
+            phost, pport = p.address
+            pbase = f"http://{phost}:{pport}/ib"
+            stop = threading.Event()
+            flood_stats = {"ok": 0, "429": 0, "503": 0}
+            flock = threading.Lock()
+
+            def flood():
+                while not stop.is_set():
+                    try:
+                        with post(pbase, {"x": 1}, "flood", timeout=30):
+                            pass
+                        k = "ok"
+                    except urllib.error.HTTPError as e:
+                        k = str(e.code) if e.code in (429, 503) else "ok"
+                    except Exception:  # noqa: BLE001
+                        k = "ok"
+                    with flock:
+                        flood_stats[k] = flood_stats.get(k, 0) + 1
+            floods = [threading.Thread(target=flood) for _ in range(24)]
+            for t in floods:
+                t.start()
+            lat = []
+            try:
+                time.sleep(0.3)            # let the flood build a queue
+                for _ in range(20):
+                    t0 = time.perf_counter()
+                    try:
+                        with post(pbase, {"x": 1}, "paying", timeout=60):
+                            pass
+                        lat.append(time.perf_counter() - t0)
+                    except Exception:  # noqa: BLE001
+                        pass
+                    time.sleep(0.02)       # paced well under its bucket
+            finally:
+                stop.set()
+                for t in floods:
+                    t.join(timeout=30)
+                p.stop()
+            return {
+                "paying_latency_s": _percentiles(lat, ps=(50, 99)),
+                "paying_completed": len(lat),
+                "flood": dict(flood_stats),
+            }
+
+        out["ab"] = {"admission_off": ab_round(False),
+                     "admission_on": ab_round(True)}
+        gate = admission.get_controller()
+        if gate is not None:
+            out["ab"]["gate"] = gate.snapshot()
+        return out
+    except Exception as e:  # noqa: BLE001
+        out["error"] = str(e)[:200]
+        return out
+    finally:
+        set_global_config(saved_cfg)
+        admission.reset_controller()
+        try:
+            serve.stop_ingress()
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            serve.delete("ingress-bench-app")
+            slo.reset_ledger()
+        except Exception:  # noqa: BLE001
+            pass
+
+
 def _bench_control_plane() -> dict:
     """GCS<->raylet sync + pubsub fan-out cost vs cluster size (ISSUE 8):
     in-process mega-cluster harness (real GCS, skeleton raylets) at
@@ -1772,6 +1970,7 @@ def main():
         ("llm_decode", lambda: _bench_llm_decode(on_tpu), 900.0),
         ("serving", lambda: _bench_serving(on_tpu), 900.0),
         ("serving_disagg", lambda: _bench_serving_disagg(on_tpu), 900.0),
+        ("ingress_fairness", lambda: _bench_ingress_fairness(on_tpu), 900.0),
         ("core_perf", _bench_core_perf, 600.0),
         ("rl_throughput", _bench_rl_throughput, 600.0),
         ("data_ingest", _bench_data_ingest, 600.0),

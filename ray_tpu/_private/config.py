@@ -209,6 +209,58 @@ class RayTpuConfig:
     # burn rate above this is reported as a breach by state.serving_slo()
     # (1.0 = consuming error budget exactly as fast as the SLO allows)
     serve_slo_burn_alert: float = 1.0
+    # --- serve: tenant-fair ingress admission (serve/_private/admission.py) --
+    # master switch for the ingress admission gate: per-tenant token-rate
+    # buckets, weighted-fair queueing and burn-rate load shedding at the
+    # proxy.  Off => every request is admitted unconditionally and the gate
+    # books NOTHING (byte-identical metric surface, perf-smoke pinned)
+    serve_admission_enabled: bool = True
+    # per-tenant token bucket: sustained admissions/s and burst capacity.
+    # rate <= 0 disables rate limiting (fair queueing + shedding still
+    # apply); a tenant over its bucket gets 429 + Retry-After
+    serve_admission_tenant_rate: float = 0.0
+    serve_admission_tenant_burst: float = 32.0
+    # weighted-fair queueing weights, "tenant=weight,tenant2=weight"; tenants
+    # not listed get weight 1.0.  Under saturation admitted work is
+    # interleaved in weight proportion; an idle tenant never blocks others
+    # (work conservation)
+    serve_admission_weights: str = ""
+    # burn-rate shed threshold: when the target deployment's short-window
+    # availability burn exceeds this, new requests are shed with 503 +
+    # Retry-After before the queue collapses.  <= 0 disables burn shedding
+    serve_admission_shed_burn: float = 8.0
+    # per-tenant admitted-but-not-finished cap: a tenant at its in-flight
+    # ceiling is shed with 503 (protects the proxy from a single tenant
+    # consuming every handle thread).  <= 0 disables
+    serve_admission_max_inflight: int = 0
+    # Retry-After floor (seconds) on 503 shed responses (429 responses
+    # compute the exact bucket refill time instead)
+    serve_admission_retry_after_s: float = 1.0
+    # bounded fair backlog behind the proxy's handle threads: admitted
+    # work beyond the running threads queues in weighted-fair order up to
+    # this deep, past which requests are shed with 503 + Retry-After (the
+    # executor queue can never grow unboundedly)
+    serve_admission_backlog: int = 128
+    # --- serve: ingress tier (serve/_private/ingress.py) ---
+    # proxy replicas started by serve.start_ingress() behind one front
+    # endpoint; connections pin to a proxy by peer address (rendezvous
+    # hash), so SSE streams and reconnects keep session affinity
+    serve_ingress_proxies: int = 2
+    # --- serve: SLO-feedback pool autoscaler (pool_autoscaler.py) ---
+    # master switch for the controller-side loop that subscribes to watch
+    # ALERT transitions (serve_ttft_burn / serve_itl_burn) and actuates
+    # prefill/decode pool replica counts
+    serve_pool_autoscaler_enabled: bool = True
+    # replicas added per firing burn alert, and the cooldown between
+    # actuations on the same pool (hysteresis against alert flapping)
+    serve_pool_scale_step: int = 1
+    serve_pool_scale_cooldown_s: float = 30.0
+    serve_pool_min_replicas: int = 1
+    serve_pool_max_replicas: int = 8
+    # scale-down guard: a pool is only shrunk while its alert is clear AND
+    # the PR 16 utilization fold shows mean duty cycle below this headroom
+    # threshold (never shrink a busy pool on a quiet alert alone)
+    serve_pool_scale_down_headroom: float = 0.5
     # --- device telemetry (_private/device_telemetry.py) ---
     # master switch for the chip-level observability layer: per-device HBM
     # gauges, per-deployment engine utilization/headroom gauges, the

@@ -499,6 +499,54 @@ def quantile_over_time(series: dict, q: float) -> float:
 
 
 # ---------------------------------------------------------------------------
+# Burn rate — THE authoritative implementation
+# ---------------------------------------------------------------------------
+# One definition shared by the watch engine's burn rules AND the serving SLO
+# ledger (serve/_private/slo.py delegates here): burn = breach-fraction over
+# a trailing window divided by the error budget 1 - availability.  >1 means
+# the budget is being consumed faster than the SLO allows (SRE workbook
+# convention).  The ≤2% parity the two paths were originally tested against
+# is now structural — there is exactly one implementation to drift.
+
+
+def burn_rate(bad: float, total: float, availability: float) -> float:
+    """Error-budget burn rate from windowed bad/total counts."""
+    if total <= 0:
+        return 0.0
+    budget = max(1.0 - float(availability), 1e-9)
+    return (bad / total) / budget
+
+
+def fold_window_counts(buckets: Dict[int, List[int]], bucket_s: float,
+                       window_s: float, now_wall: float) -> List[int]:
+    """[bad, total] over the trailing window from absolute-wall-clock-
+    indexed ``{bucket_idx: [bad, total]}`` buckets (the slo.py ledger
+    shape; absolute indices are what make per-process buckets sum
+    cluster-wide)."""
+    lo = int((now_wall - window_s) // bucket_s)
+    bad = total = 0
+    for idx, (b, t) in buckets.items():
+        if idx > lo:
+            bad += b
+            total += t
+    return [bad, total]
+
+
+def sketch_bad_count(bins: Dict[int, int], threshold: float,
+                     accuracy: float) -> int:
+    """Observations strictly above ``threshold`` in a delta-sketch's bins
+    (bin i covers (gamma^(i-1), gamma^i]), within the sketch's relative-
+    accuracy bound: the bin straddling the threshold counts as good, so a
+    latency target between bin edges under-counts by at most one bin's
+    width (≤ 2*accuracy relative)."""
+    if threshold <= 0 or not bins:
+        return sum(bins.values())
+    gamma = (1.0 + accuracy) / (1.0 - accuracy)
+    i_thr = math.ceil(math.log(threshold) / math.log(gamma))
+    return sum(c for i, c in bins.items() if i > i_thr)
+
+
+# ---------------------------------------------------------------------------
 # Watch rules
 # ---------------------------------------------------------------------------
 
@@ -517,10 +565,16 @@ class WatchRule:
                   ``1 - availability``; fires when the smaller of the two
                   burns crosses ``threshold`` (both-windows AND, the
                   multiwindow page/ticket shape)
+      sketch_burn — multiwindow burn over a SKETCH family: bad = fraction
+                  of the window's observations above ``bad_threshold``
+                  (read straight off the delta-sketch bins, within the
+                  sketch's accuracy bound), same both-windows AND shape.
+                  The latency-SLO counterpart of ``burn`` — e.g. TTFT
+                  observations over the target / budget.
 
     ``tags`` subset-selects series; ``bad_tags`` (burn only) selects the
     numerator series among them (values may be tuples of accepted values);
-    ``group_by`` (burn only) splits the evaluation into one alert per
+    ``group_by`` (burn kinds) splits the evaluation into one alert per
     distinct value combination of those tag keys.  ``for_s`` delays firing
     until the breach has held that long; ``clear_for_s`` delays the clear
     symmetrically (hysteresis — a flapping signal pins neither direction).
@@ -535,6 +589,7 @@ class WatchRule:
     window_s: float = 300.0
     long_window_s: Optional[float] = None
     bad_tags: Optional[Dict[str, Any]] = None
+    bad_threshold: Optional[float] = None
     availability: Optional[float] = None
     group_by: Tuple[str, ...] = ()
     for_s: float = 0.0
@@ -551,7 +606,8 @@ class WatchRule:
             "name": self.name, "kind": self.kind, "family": self.family,
             "tags": self.tags, "op": self.op, "threshold": self.threshold,
             "window_s": self.window_s, "long_window_s": self.long_window_s,
-            "bad_tags": self.bad_tags, "availability": self.availability,
+            "bad_tags": self.bad_tags, "bad_threshold": self.bad_threshold,
+            "availability": self.availability,
             "group_by": list(self.group_by), "for_s": self.for_s,
             "clear_for_s": self.clear_for_s, "severity": self.severity,
             "description": self.description,
@@ -649,6 +705,36 @@ def builtin_rules(config: Optional[RayTpuConfig] = None) -> List[WatchRule]:
             description="serving availability error budget burning "
                         "faster than the SLO allows over both the 5m and "
                         "1h windows"),
+        # latency-SLO burn rules over the ingress sketches — the signals
+        # the pool autoscaler actuates on (TTFT burn -> scale the prefill
+        # pool, ITL burn -> decode pool; serve/_private/pool_autoscaler.py
+        # keys on these rule names).  Thresholds come from the global SLO
+        # targets; per-deployment slo_config overrides need a re-added
+        # rule with the deployment's target as bad_threshold
+        WatchRule(
+            name="serve_ttft_burn", kind="sketch_burn",
+            family="ray_tpu_serve_ttft_seconds",
+            bad_threshold=cfg.serve_slo_ttft_ms / 1e3,
+            availability=cfg.serve_slo_availability,
+            threshold=cfg.serve_slo_burn_alert,
+            window_s=300.0, long_window_s=3600.0,
+            group_by=("deployment",), clear_for_s=60.0,
+            severity="WARNING",
+            description="TTFT error budget burning faster than the SLO "
+                        "allows over both windows: prefill capacity "
+                        "behind demand"),
+        WatchRule(
+            name="serve_itl_burn", kind="sketch_burn",
+            family="ray_tpu_serve_itl_seconds",
+            bad_threshold=cfg.serve_slo_itl_ms / 1e3,
+            availability=cfg.serve_slo_availability,
+            threshold=cfg.serve_slo_burn_alert,
+            window_s=300.0, long_window_s=3600.0,
+            group_by=("deployment",), clear_for_s=60.0,
+            severity="WARNING",
+            description="inter-token latency error budget burning faster "
+                        "than the SLO allows over both windows: decode "
+                        "capacity behind demand"),
     ]
 
 
@@ -734,7 +820,7 @@ class WatchEngine:
             return dict(reporter_ages or {})
         if self.history is None or rule.family is None:
             return {}
-        if rule.kind == "burn":
+        if rule.kind in ("burn", "sketch_burn"):
             return self._evaluate_burn(rule, wall)
         series = self.history.query(rule.family, rule.tags,
                                     window_s=rule.window_s, now=wall)
@@ -768,6 +854,7 @@ class WatchEngine:
             gk = ",".join(f"{k}={s['tags'].get(k, '')}"
                           for k in rule.group_by) or "_"
             groups.setdefault(gk, []).append(s)
+        availability = 1.0 - budget
         out: Dict[str, float] = {}
         for gk, members in groups.items():
             burns = []
@@ -775,12 +862,24 @@ class WatchEngine:
                 lo = wall - win
                 bad = total = 0.0
                 for s in members:
-                    d = sum(v if not isinstance(v, dict) else v["count"]
-                            for t, v in s["samples"] if t + s["step_s"] > lo)
-                    total += d
-                    if _tags_match(s["tags"], rule.bad_tags):
-                        bad += d
-                burns.append((bad / total / budget) if total > 0 else 0.0)
+                    in_win = [v for t, v in s["samples"]
+                              if t + s["step_s"] > lo]
+                    if rule.kind == "sketch_burn":
+                        # delta-sketch buckets: total = observations, bad
+                        # = observations above the latency target (read
+                        # off the log bins)
+                        acc = float(s.get("accuracy") or 0.01)
+                        for v in in_win:
+                            total += v["count"]
+                            bad += sketch_bad_count(
+                                v["bins"], rule.bad_threshold or 0.0, acc)
+                    else:
+                        d = sum(v if not isinstance(v, dict) else v["count"]
+                                for v in in_win)
+                        total += d
+                        if _tags_match(s["tags"], rule.bad_tags):
+                            bad += d
+                burns.append(burn_rate(bad, total, availability))
             out[gk] = min(burns)
         return out
 

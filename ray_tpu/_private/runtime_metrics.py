@@ -382,6 +382,23 @@ SERVE_SPECDEC_ACCEPTED = Counter(
     "Drafted tokens accepted by target verification (each one is a decode "
     "token emitted without its own target forward pass)",
     tag_keys=("deployment",))
+# tenant-fair ingress admission (serve/_private/admission.py).  Booked ONLY
+# when serve_admission_enabled — the disabled path books nothing and the
+# metric surface is byte-identical (perf-smoke pinned).  decision is a tiny
+# fixed set: admit / throttle (per-tenant token bucket exhausted, 429) /
+# shed (burn-rate or capacity shed, 503).  Tenant ids are the same bounded
+# operator-assigned set the SLO layer caps.
+SERVE_ADMISSION = Counter(
+    "ray_tpu_serve_admission_total",
+    "Ingress admission decisions per tenant (admit / throttle = token "
+    "bucket exhausted -> 429 + Retry-After / shed = burn-rate or capacity "
+    "refusal -> 503 + Retry-After)",
+    tag_keys=("tenant", "decision"))
+SERVE_TENANT_QUEUE_DEPTH = Gauge(
+    "ray_tpu_serve_tenant_queue_depth",
+    "Admitted-but-unfinished ingress requests per tenant (the weighted-"
+    "fair scheduler's live backlog view)",
+    tag_keys=("tenant",))
 SERVE_SLO_BURN_RATE = Gauge(
     "ray_tpu_serve_slo_burn_rate",
     "SLO error-budget burn rate per deployment, objective (ttft / itl / "
@@ -592,6 +609,7 @@ FAMILIES = (
     KV_HANDOFF_BYTES, KV_HANDOFF_LATENCY, SERVE_DISAGG_QUEUE_DEPTH,
     SERVE_TTFT, SERVE_ITL, SERVE_STAGE_SECONDS, SERVE_ROUTE_DECISIONS,
     SERVE_SLO_REQUESTS, SERVE_SLO_BURN_RATE,
+    SERVE_ADMISSION, SERVE_TENANT_QUEUE_DEPTH,
     SERVE_SPECDEC_PROPOSED, SERVE_SPECDEC_ACCEPTED,
     DATA_ROWS, DATA_BACKPRESSURE,
     DATA_INGEST_ROWS, DATA_INGEST_BYTES, DATA_INGEST_BUFFER,
@@ -1053,6 +1071,26 @@ def set_slo_burn_rate(deployment: str, window: str, objective: str,
                       rate: float) -> None:
     _bound(SERVE_SLO_BURN_RATE, deployment=deployment, window=window,
            objective=objective).set(rate)
+
+
+def inc_admission(tenant: str, decision: str) -> None:
+    _bound(SERVE_ADMISSION, tenant=tenant, decision=decision).inc()
+
+
+def set_tenant_queue_depth(tenant: str, n: int) -> None:
+    _bound(SERVE_TENANT_QUEUE_DEPTH, tenant=tenant).set(n)
+
+
+def admission_snapshot() -> dict:
+    """Process-local admission forensics: decision counts by (tenant,
+    decision).  Hermetic — this process's counters only; used by the
+    benches and the disabled-path byte-identity perf-smoke gate."""
+    out: dict = {}
+    for tags_key, v in dict(SERVE_ADMISSION._points).items():
+        tags = dict(tags_key)
+        key = (tags.get("tenant", "?"), tags.get("decision", "?"))
+        out[key] = out.get(key, 0.0) + v
+    return out
 
 
 def route_decision_snapshot() -> dict:

@@ -532,6 +532,9 @@ class CoreWorker:
         # restarted GCS (or a transient-failure eviction, gcs.py Pubsub
         # 3-strike rule) cannot silently orphan a live subscriber
         self._subscriptions: set = set()
+        # ALERT channel fan-in: watch transition dicts delivered to every
+        # registered callback (register_alert_handler)
+        self._alert_handlers: list = []
         self._sub_lock = make_lock("CoreWorker._sub_lock")
         threading.Thread(target=self._resubscribe_loop, daemon=True,
                          name="pubsub-resubscribe").start()
@@ -1145,8 +1148,23 @@ class CoreWorker:
         threading.Thread(target=run, daemon=True, name="jax-profiler").start()
         return RpcServer.DELAYED_REPLY
 
+    def register_alert_handler(self, cb) -> None:
+        """Subscribe this worker to the tree-pubsub ALERT channel and
+        deliver every watch transition dict to ``cb`` (the serve
+        controller's pool autoscaler rides this; handlers must not
+        block — they run on the pubsub dispatch path)."""
+        self._alert_handlers.append(cb)
+        self._gcs_subscribe("ALERT")
+
     def HandlePubsubMessage(self, req):
         channel, message = req["channel"], req["message"]
+        if channel == "ALERT":
+            for cb in list(self._alert_handlers):
+                try:
+                    cb(message)
+                except Exception:  # noqa: BLE001 — one bad handler must not
+                    logger.exception("alert handler failed")  # drop the rest
+            return True
         if channel == "WORKER_LOGS":
             if self.log_to_driver and not self.shutting_down:
                 # echo only this job's workers (unattributed lines — a worker

@@ -25,6 +25,7 @@ Endpoints:
   GET /api/slo              serving SLO report: percentiles, burn rates, breaches
   GET /api/recent_requests  newest completed serve requests [?limit=&tenant=]
   GET /api/utilization      device telemetry: per-replica slot/KV headroom [?deployment=]
+  GET /api/ingress          admission gate + proxy tier + pool-autoscaler actuations
   GET /metrics              Prometheus exposition of cluster metrics
 """
 
@@ -255,6 +256,11 @@ class DashboardHead:
             # watch-engine state: active alerts, rules, recent
             # transitions [?rule=<name> narrows]
             return state.alerts((query or {}).get("rule", [None])[0])
+        if path == "/api/ingress":
+            # ingress control plane: admission gate (weights, per-tenant
+            # inflight), scale-out tier backends, pool-autoscaler
+            # pools + recent actuations
+            return state.ingress()
         if path == "/api/events":
             return state.list_cluster_events()
         if path == "/api/serve":

@@ -38,6 +38,9 @@ __all__ = [
     "DeploymentHandle",
     "DeploymentResponse",
     "batch",
+    "start_ingress",
+    "stop_ingress",
+    "build_proxy_deployment",
 ]
 
 
@@ -64,3 +67,29 @@ def start_rpc_proxy(host: str = "127.0.0.1", port: int = 0):
     from ray_tpu.serve._private.rpc_proxy import start_rpc_proxy as _start
 
     return _start(host, port)
+
+
+def start_ingress(num_proxies=None, host: str = "127.0.0.1", port: int = 0):
+    """Start N HTTP proxies behind one session-affine endpoint and return
+    the tier's (host, port).  Scale-out alternative to start_http_proxy:
+    SSE clients keep per-client affinity through the rendezvous-hash
+    splice tier while admission (429/503 + Retry-After) runs per proxy."""
+    from ray_tpu.serve._private.ingress import start_ingress as _start
+
+    return _start(num_proxies, host, port)
+
+
+def stop_ingress():
+    """Stop the ingress tier and its local proxies."""
+    from ray_tpu.serve._private.ingress import stop_ingress as _stop
+
+    _stop()
+
+
+def build_proxy_deployment(num_replicas: int = 2, routes=None,
+                           name: str = "http-proxy"):
+    """The HTTP proxy as a first-class serve deployment: drain, health
+    checks and the utilization surface apply to the proxy tier itself."""
+    from ray_tpu.serve._private.ingress import build_proxy_deployment as _b
+
+    return _b(num_replicas, routes, name)

@@ -79,6 +79,24 @@ class ServeController:
         self._starting: set = set()            # (app, dep) with a start in flight
         self._start_backoff: Dict[tuple, float] = {}  # (app, dep, hash) -> retry-at
         self._start_fails: Dict[tuple, int] = {}      # (app, dep, hash) -> streak
+        # SLO-feedback pool autoscaler (serve/_private/pool_autoscaler.py):
+        # burn alerts on the ALERT pubsub channel actuate prefill/decode
+        # replica counts through scale_deployment; the reconcile tick
+        # drives its headroom-guarded scale-down pass
+        from ray_tpu.serve._private.pool_autoscaler import (
+            PoolAutoscaler, utilization_headroom)
+
+        self._pool_autoscaler = PoolAutoscaler(
+            actuate=self._scale_by_name, current=self._replicas_by_name,
+            headroom_source=utilization_headroom)
+        if self._pool_autoscaler.enabled:
+            try:
+                from ray_tpu._private.worker import get_global_worker
+
+                get_global_worker().register_alert_handler(
+                    self._pool_autoscaler.on_alert)
+            except Exception:  # noqa: BLE001 — no worker (unit-test
+                pass           # construction): alerts just never arrive
         self._thread = threading.Thread(target=self._reconcile_loop, daemon=True,
                                         name="serve-reconcile")
         self._thread.start()
@@ -139,6 +157,50 @@ class ServeController:
                              timeout=2, retry_deadline=0.0)
         except Exception:  # noqa: BLE001 — cleanup is best-effort
             pass
+
+    def scale_deployment(self, app_name: str, deployment_name: str,
+                         num_replicas: int) -> bool:
+        """Set a deployment's replica count (the pool autoscaler's
+        actuator).  When the deployment carries an autoscaling_config the
+        count also becomes its min_replicas floor — the queue-depth
+        autoscaler may add capacity on top but can no longer undo a
+        burn-driven scale-up on its next tick."""
+        with self._lock:
+            cfg = self._desired.get(app_name, {}).get(deployment_name)
+            if cfg is None:
+                return False
+            n = max(0, int(num_replicas))
+            cfg["num_replicas"] = n
+            ac = cfg.get("autoscaling_config")
+            if ac:
+                ac["min_replicas"] = n
+                ac["max_replicas"] = max(int(ac.get("max_replicas", n)), n)
+            self._version += 1
+        return True
+
+    def _find_app(self, deployment_name: str):
+        with self._lock:
+            for app, deps in self._desired.items():
+                if deployment_name in deps:
+                    return app
+        return None
+
+    def _scale_by_name(self, deployment_name: str, num_replicas: int):
+        app = self._find_app(deployment_name)
+        if app is None:
+            raise KeyError(f"no deployment named {deployment_name!r}")
+        self.scale_deployment(app, deployment_name, num_replicas)
+
+    def _replicas_by_name(self, deployment_name: str) -> int:
+        app = self._find_app(deployment_name)
+        if app is None:
+            raise KeyError(f"no deployment named {deployment_name!r}")
+        with self._lock:
+            return int(self._desired[app][deployment_name].get(
+                "num_replicas", 1))
+
+    def pool_autoscaler_report(self) -> dict:
+        return self._pool_autoscaler.snapshot()
 
     def get_version(self) -> int:
         return self._version
@@ -232,6 +294,7 @@ class ServeController:
             try:
                 self._reconcile()
                 self._autoscale()
+                self._pool_autoscaler.tick()
             except Exception:  # noqa: BLE001
                 logger.exception("serve reconcile error")
             time.sleep(0.1)
@@ -564,6 +627,17 @@ class ServeController:
             with self._lock:
                 if self._desired.get(app, {}).get(dep):
                     self._desired[app][dep]["num_replicas"] = desired_n
+
+
+def get_controller_if_exists():
+    """The controller handle if one is running, else None — read-only
+    surfaces (state.ingress()) must not boot a control plane."""
+    import ray_tpu
+
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+    except Exception:  # noqa: BLE001 — none running
+        return None
 
 
 def get_or_create_controller():

@@ -18,6 +18,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import math
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
@@ -69,12 +70,25 @@ class _AsyncProxy:
     """One event loop + bounded executor serving all proxy connections."""
 
     def __init__(self, host: str, port: int, max_handle_threads: int = 64):
+        from ray_tpu._private.config import global_config
+        from ray_tpu.serve._private import admission
+
         self._host = host
         self._port = port
         self._loop = asyncio.new_event_loop()
         self._pool = ThreadPoolExecutor(
             max_workers=max_handle_threads, thread_name_prefix="proxy-handle"
         )
+        # weighted-fair admitted-work scheduler over the pool: beyond
+        # max_handle_threads running calls, work queues in WFQ order up to
+        # a bounded backlog, past which submit raises Saturated -> 503 +
+        # Retry-After (never the old unbounded executor queue)
+        cfg = global_config()
+        self._fair = admission.FairExecutor(
+            self._pool, max_running=max_handle_threads,
+            backlog=int(cfg.serve_admission_backlog),
+            weights=admission.parse_weights(cfg.serve_admission_weights),
+            retry_after_s=float(cfg.serve_admission_retry_after_s))
         self._server: Optional[asyncio.base_events.Server] = None
         self._boot_error: Optional[BaseException] = None
         started = threading.Event()
@@ -185,6 +199,7 @@ class _AsyncProxy:
     def _response(status: int, body: bytes, content_type: str = "application/json",
                   keep_alive: bool = True, extra_headers=None) -> bytes:
         reason = {200: "OK", 404: "Not Found", 400: "Bad Request",
+                  429: "Too Many Requests", 503: "Service Unavailable",
                   500: "Internal Server Error"}.get(status, "OK")
         conn = "keep-alive" if keep_alive else "close"
         extras = "".join(f"{k}: {v}\r\n" for k, v in (extra_headers or ()))
@@ -236,7 +251,7 @@ class _AsyncProxy:
 
     async def _dispatch(self, writer, method: str, target: str,
                         headers: Dict[str, str], body: bytes) -> bool:
-        from ray_tpu.serve._private import slo
+        from ray_tpu.serve._private import admission, slo
         from ray_tpu.util import tracing
 
         path = target.split("?")[0]
@@ -272,19 +287,36 @@ class _AsyncProxy:
         # ingress request gets a tracker carrying the tenant id (x-tenant
         # header / request-dict field / default); the NOOP tracker makes
         # the disabled path one empty call per hook
+        deployment = self._deployment_of(handle)
+        tenant = slo.extract_tenant(headers=headers, payload=payload)
         tracker = slo.start_request(
-            self._deployment_of(handle),
-            tenant=slo.extract_tenant(headers=headers, payload=payload),
+            deployment, tenant=tenant,
             trace_id=ctx3[0] if ctx3 else None)
 
+        # tenant-fair admission gate (serve/_private/admission.py): a
+        # refusal is a terminal `shed` on the tracker plus 429/503 +
+        # Retry-After to the client — BEFORE any queueing.  Disabled ->
+        # gate is None and this is one None check
+        gate = admission.get_controller()
+        if gate is not None:
+            verdict = gate.decide(tenant, deployment)
+            if not verdict.admitted:
+                tracker.shed()
+                await self._refuse(writer, verdict.status, verdict.decision,
+                                   verdict.retry_after_s, trace_headers)
+                return True
+
         if isinstance(payload, dict) and payload.get("stream"):
-            await self._dispatch_stream(writer, handle, payload,
-                                        ctx3=ctx3,
-                                        trace_headers=trace_headers,
-                                        tracker=tracker)
+            try:
+                await self._dispatch_stream(writer, handle, payload,
+                                            ctx3=ctx3,
+                                            trace_headers=trace_headers,
+                                            tracker=tracker)
+            finally:
+                if gate is not None:
+                    gate.release(tenant)
             return False  # SSE ends with connection close (no chunked TE)
 
-        loop = asyncio.get_running_loop()
         t_queued = time.perf_counter()
 
         def call():
@@ -298,17 +330,45 @@ class _AsyncProxy:
                 return handle.remote(payload).result(timeout_s=_HANDLE_TIMEOUT_S)
 
         try:
-            result = await loop.run_in_executor(self._pool, call)
-            tracker.finish("ok")
-            out = json.dumps(result, default=str).encode()
-            writer.write(self._response(200, out, extra_headers=trace_headers))
-        except Exception as e:  # noqa: BLE001
-            tracker.finish("error")
-            writer.write(self._response(
-                500, json.dumps({"error": str(e)}).encode(),
-                extra_headers=trace_headers))
+            try:
+                fut = self._fair.submit(tenant, call)
+            except admission.Saturated as e:
+                # every handle thread busy AND the fair backlog full:
+                # shed now instead of queueing unboundedly (the old
+                # silent latency cliff)
+                tracker.shed()
+                await self._refuse(writer, 503, "saturated",
+                                   e.retry_after_s, trace_headers)
+                return True
+            try:
+                result = await asyncio.wrap_future(fut)
+                tracker.finish("ok")
+                out = json.dumps(result, default=str).encode()
+                writer.write(self._response(200, out,
+                                            extra_headers=trace_headers))
+            except Exception as e:  # noqa: BLE001
+                tracker.finish("error")
+                writer.write(self._response(
+                    500, json.dumps({"error": str(e)}).encode(),
+                    extra_headers=trace_headers))
+        finally:
+            if gate is not None:
+                gate.release(tenant)
         await writer.drain()
         return True
+
+    async def _refuse(self, writer, status: int, reason: str,
+                      retry_after_s: float, trace_headers) -> None:
+        """429/503 refusal with the Retry-After contract: integral
+        seconds, floored at 1 so a compliant client always backs off."""
+        ra = 1 if not math.isfinite(retry_after_s) else \
+            max(1, math.ceil(min(retry_after_s, 3600.0)))
+        hdrs = list(trace_headers or ()) + [("Retry-After", str(ra))]
+        writer.write(self._response(
+            status,
+            json.dumps({"error": reason, "retry_after_s": ra}).encode(),
+            extra_headers=hdrs))
+        await writer.drain()
 
     async def _dispatch_stream(self, writer, handle, payload, ctx3=None,
                                trace_headers=None, tracker=None):
@@ -435,6 +495,7 @@ class _AsyncProxy:
     async def _dispatch_asgi(self, writer, handle, prefix, method, target,
                              headers, body, ctx3=None,
                              trace_headers=None) -> bool:
+        from ray_tpu.serve._private import admission, slo
         from ray_tpu.util import tracing
 
         path = target.split("?")[0]
@@ -443,7 +504,6 @@ class _AsyncProxy:
         request = {"method": method, "path": sub_path, "root_path":
                    prefix.rstrip("/"), "query": query, "headers": headers,
                    "body": body}
-        loop = asyncio.get_running_loop()
 
         def call():
             with tracing.activate_span(
@@ -452,7 +512,17 @@ class _AsyncProxy:
                 return handle.remote(request).result(timeout_s=_HANDLE_TIMEOUT_S)
 
         try:
-            resp = await loop.run_in_executor(self._pool, call)
+            # ASGI forwards ride the same fair executor (tenant from the
+            # headers only — the body is opaque to the proxy here), so a
+            # saturated pool answers 503 instead of queueing unboundedly
+            try:
+                fut = self._fair.submit(
+                    slo.extract_tenant(headers=headers), call)
+            except admission.Saturated as e:
+                await self._refuse(writer, 503, "saturated",
+                                   e.retry_after_s, trace_headers)
+                return True
+            resp = await asyncio.wrap_future(fut)
             rbody = resp.get("body", b"")
             reserved = ("content-length", "connection", "transfer-encoding")
             if trace_headers:

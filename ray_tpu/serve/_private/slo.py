@@ -202,39 +202,38 @@ class _Windows:
         b[1] += 1
 
     def counts(self, now_wall: float, window_s: float) -> List[int]:
-        lo = int((now_wall - window_s) // _BUCKET_S)
-        bad = total = 0
-        for idx, (b, t) in self.buckets.items():
-            if idx > lo:
-                bad += b
-                total += t
-        return [bad, total]
+        from ray_tpu._private import metrics_history
+
+        return metrics_history.fold_window_counts(
+            self.buckets, _BUCKET_S, window_s, now_wall)
 
     def serialize(self) -> List[List[int]]:
         return [[idx, b, t] for idx, (b, t) in sorted(self.buckets.items())]
 
 
 def _burn(bad: int, total: int, availability: float) -> float:
-    if total <= 0:
-        return 0.0
-    budget = max(1.0 - float(availability), 1e-9)
-    return (bad / total) / budget
+    """Delegates to THE burn implementation (metrics_history.burn_rate) —
+    the watch engine's burn rules and this ledger share one definition by
+    construction; the old ≤2% parity test is now a regression pin on the
+    window folds, not on two formulas."""
+    from ray_tpu._private import metrics_history
+
+    return metrics_history.burn_rate(bad, total, availability)
 
 
 def _window_burn_rates(window_buckets: Dict[str, Dict[int, List[int]]],
                        targets: Dict[str, float], now_wall: float) -> dict:
     """{objective: {window_name: burn}} from folded absolute buckets."""
+    from ray_tpu._private import metrics_history
+
     out: dict = {}
     for objective, buckets in window_buckets.items():
         per = out.setdefault(objective, {})
         for wname, wsec in WINDOWS.items():
-            lo = int((now_wall - wsec) // _BUCKET_S)
-            bad = total = 0
-            for idx, (b, t) in buckets.items():
-                if idx > lo:
-                    bad += b
-                    total += t
-            per[wname] = _burn(bad, total, targets["slo_availability"])
+            bad, total = metrics_history.fold_window_counts(
+                buckets, _BUCKET_S, wsec, now_wall)
+            per[wname] = metrics_history.burn_rate(
+                bad, total, targets["slo_availability"])
             per.setdefault("_counts", {})[wname] = [bad, total]
     return out
 
@@ -455,6 +454,14 @@ class ServingSLOLedger:
                 # failure of the deployment
                 self._win(tr.deployment, "availability").record(
                     now_wall, tr.status != "ok")
+            if tr.status in ("ok", "error"):
+                # admitted-work failure signal: sheds are excluded so the
+                # admission gate's burn breaker (which 503s everyone on
+                # this) cannot latch on its own refusals — one tenant
+                # eating 429s must not starve the tenants that WERE
+                # admitted
+                self._win(tr.deployment, "service").record(
+                    now_wall, tr.status == "error")
             st = self._status.setdefault(
                 tr.deployment, {}).setdefault(tr.tenant, {})
             st[tr.status] = st.get(tr.status, 0) + 1

@@ -1012,3 +1012,34 @@ def utilization(deployment=None):
 def profile(pid, node_id=None, duration_s: float = 2.0,
             mode: str = "auto"):
     return _client().profile(pid, node_id, duration_s, mode)
+
+
+def ingress() -> dict:
+    """Ingress control-plane view: this process's admission gate
+    (weights, per-tenant inflight), the local scale-out tier (backends,
+    live splices) and — when a serve controller is reachable — the pool
+    autoscaler's pools and recent actuations.  Reads only state that
+    already exists; never constructs the admission singleton."""
+    from ray_tpu.serve._private import admission as adm
+    from ray_tpu.serve._private import ingress as ing
+
+    out: dict = {"admission": None, "tier": None, "pool_autoscaler": None}
+    gate = adm._controller
+    if gate is not None:
+        out["admission"] = gate.snapshot()
+    tier = ing.get_tier()
+    if tier is not None:
+        out["tier"] = {"address": list(tier.address),
+                       "backends": [list(b) for b in tier.backends()],
+                       "connections": tier._conns}
+    try:
+        import ray_tpu
+        from ray_tpu.serve._private.controller import get_controller_if_exists
+
+        ctrl = get_controller_if_exists()
+        if ctrl is not None:
+            out["pool_autoscaler"] = ray_tpu.get(
+                ctrl.pool_autoscaler_report.remote())
+    except Exception:  # noqa: BLE001 — no controller: local view only
+        pass
+    return out

@@ -1,0 +1,657 @@
+"""Tenant-fair ingress control plane (ISSUE 18): token buckets, WFQ
+invariants, the admission gate, burn isolation between tenants, and the
+scale-out ingress tier.  Everything drives injected clocks or in-process
+servers — no wall sleeps on any hot assertion path."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+import pytest
+
+from ray_tpu._private.config import RayTpuConfig
+from ray_tpu.serve._private.admission import (AdmissionController,
+                                              FairExecutor, Saturated,
+                                              TokenBucket, WFQ,
+                                              parse_weights)
+
+
+class _Clock:
+    def __init__(self, t=1_000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# token bucket
+# ---------------------------------------------------------------------------
+
+
+def test_token_bucket_burst_then_refill():
+    clock = _Clock()
+    b = TokenBucket(rate=2.0, burst=4.0, clock=clock)
+    assert [b.take() for _ in range(5)] == [True] * 4 + [False]
+    # exact Retry-After: 1 token at 2 tokens/s = 0.5s
+    assert b.retry_after() == pytest.approx(0.5)
+    clock.t += 0.5
+    assert b.take()
+    assert not b.take()
+
+
+def test_token_bucket_caps_at_burst_and_zero_rate_never_refills():
+    clock = _Clock()
+    b = TokenBucket(rate=10.0, burst=3.0, clock=clock)
+    clock.t += 100.0                      # long idle: still only burst
+    assert [b.take() for _ in range(4)] == [True, True, True, False]
+    z = TokenBucket(rate=0.0, burst=2.0, clock=clock)
+    assert z.take() and z.take() and not z.take()
+    assert z.retry_after() == float("inf")
+
+
+def test_parse_weights_drops_malformed():
+    assert parse_weights("a=4,b=1") == {"a": 4.0, "b": 1.0}
+    assert parse_weights("a=4,junk,=2,c=x,d=-1, e =2") == {"a": 4.0,
+                                                          "e": 2.0}
+    assert parse_weights("") == {}
+    assert parse_weights(None) == {}
+
+
+# ---------------------------------------------------------------------------
+# WFQ invariants
+# ---------------------------------------------------------------------------
+
+
+def test_wfq_weight_proportional_service_under_saturation():
+    """Both tenants permanently backlogged: service counts converge to
+    the weight ratio (the fairness half of the acceptance test)."""
+    q = WFQ({"heavy": 4.0, "light": 1.0})
+    for i in range(50):
+        q.push("heavy", ("h", i))
+        q.push("light", ("l", i))
+    served = {"heavy": 0, "light": 0}
+    for _ in range(50):
+        tenant, _item = q.pop()
+        served[tenant] += 1
+    assert served == {"heavy": 40, "light": 10}
+
+
+def test_wfq_work_conservation_idle_tenant_reserves_nothing():
+    """Only one tenant has queued work: it gets EVERY slot regardless of
+    weights — an idle tenant's share is redistributed, not reserved."""
+    q = WFQ({"a": 1.0, "b": 100.0})
+    for i in range(10):
+        q.push("a", i)
+    got = [q.pop() for _ in range(10)]
+    assert all(t == "a" for t, _ in got)
+    assert q.pop() is None
+
+
+def test_wfq_returning_tenant_gets_no_idle_credit():
+    """A tenant that slept while others drained the queue re-enters at
+    the CURRENT virtual time: its backlog does not leapfrog tenants that
+    kept the system busy."""
+    q = WFQ({"sleeper": 1.0, "worker": 1.0})
+    q.push("sleeper", 0)
+    assert q.pop()[0] == "sleeper"        # vtime advances past sleeper's ft
+    for i in range(5):
+        q.push("worker", i)
+    # sleeper returns after idling; FIFO-fair interleave, no burst of 5
+    q.push("sleeper", 1)
+    order = [q.pop()[0] for _ in range(6)]
+    assert order.count("sleeper") == 1
+    # equal weights, same re-entry vtime: sleeper lands mid-pack, not
+    # ahead of every queued worker item
+    assert order[0] == "worker"
+
+
+def test_wfq_interleaves_rather_than_head_of_line():
+    """4:1 weights give the heavy tenant runs of ~4, not the entire
+    backlog first (no head-of-line starvation for the light tenant)."""
+    q = WFQ({"heavy": 4.0, "light": 1.0})
+    for i in range(20):
+        q.push("heavy", i)
+    for i in range(5):
+        q.push("light", i)
+    first10 = [q.pop()[0] for _ in range(10)]
+    assert "light" in first10             # served well before heavy drains
+
+
+# ---------------------------------------------------------------------------
+# FairExecutor: bounded backlog, saturation, fair drain
+# ---------------------------------------------------------------------------
+
+
+def test_fair_executor_runs_under_capacity_and_delivers_results():
+    pool = ThreadPoolExecutor(max_workers=4)
+    fx = FairExecutor(pool, max_running=4, backlog=8)
+    futs = [fx.submit("t", lambda i=i: i * i) for i in range(4)]
+    assert [f.result(timeout=10) for f in futs] == [0, 1, 4, 9]
+    assert fx.depth() == (0, 0)
+    pool.shutdown()
+
+
+def test_fair_executor_bounded_backlog_raises_saturated():
+    """The satellite fix: beyond max_running + backlog the executor sheds
+    with a Retry-After instead of queueing unboundedly."""
+    pool = ThreadPoolExecutor(max_workers=2)
+    gate = threading.Event()
+    fx = FairExecutor(pool, max_running=2, backlog=3, retry_after_s=2.5)
+    blocked = [fx.submit("t", gate.wait) for _ in range(2)]   # fill slots
+    queued = [fx.submit("t", gate.wait) for _ in range(3)]    # fill backlog
+    assert fx.depth() == (2, 3)
+    with pytest.raises(Saturated) as ei:
+        fx.submit("t", gate.wait)
+    assert ei.value.retry_after_s == 2.5
+    gate.set()
+    for f in blocked + queued:
+        assert f.result(timeout=10)
+    assert fx.depth() == (0, 0)
+    pool.shutdown()
+
+
+def test_fair_executor_drains_backlog_in_weight_order():
+    """With slots saturated, queued work drains 4:1 by tenant weight —
+    completion hands the slot to the fair queue, no scheduler thread."""
+    pool = ThreadPoolExecutor(max_workers=1)
+    fx = FairExecutor(pool, max_running=1, backlog=64,
+                      weights={"heavy": 4.0, "light": 1.0})
+    order = []
+    lock = threading.Lock()
+    gate = threading.Event()
+
+    def work(tenant):
+        gate.wait(10)
+        with lock:
+            order.append(tenant)
+
+    first = fx.submit("x", lambda: gate.wait(10))   # occupy the one slot
+    futs = []
+    for i in range(8):
+        futs.append(fx.submit("heavy", lambda: work("heavy")))
+        futs.append(fx.submit("light", lambda: work("light")))
+    gate.set()
+    first.result(timeout=10)
+    for f in futs:
+        f.result(timeout=10)
+    # first 5 drained: 4 heavy to 1 light (weight proportion)
+    assert order[:5].count("heavy") == 4, order
+    pool.shutdown()
+
+
+def test_fair_executor_propagates_exceptions():
+    pool = ThreadPoolExecutor(max_workers=1)
+    fx = FairExecutor(pool, max_running=1, backlog=2)
+
+    def boom():
+        raise ValueError("nope")
+
+    with pytest.raises(ValueError):
+        fx.submit("t", boom).result(timeout=10)
+    assert fx.depth() == (0, 0)
+    pool.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# admission controller (injected clock + burn source)
+# ---------------------------------------------------------------------------
+
+
+def _gate(clock, burn=0.0, **over):
+    cfg = RayTpuConfig(**over)
+    return AdmissionController(config=cfg, clock=clock,
+                               burn_source=lambda dep: burn)
+
+
+def test_admission_rate_limit_429_with_exact_retry_after():
+    clock = _Clock()
+    g = _gate(clock, serve_admission_tenant_rate=2.0,
+              serve_admission_tenant_burst=2.0)
+    assert g.decide("acme").admitted
+    assert g.decide("acme").admitted
+    v = g.decide("acme")
+    assert (v.admitted, v.decision, v.status) == (False, "throttle", 429)
+    assert v.retry_after_s == pytest.approx(0.5)   # 1 token @ 2/s
+    # an unrelated tenant has its own bucket
+    assert g.decide("other").admitted
+    clock.t += 0.5
+    assert g.decide("acme").admitted
+
+
+def test_admission_inflight_cap_sheds_503():
+    clock = _Clock()
+    g = _gate(clock, serve_admission_max_inflight=2,
+              serve_admission_retry_after_s=3.0)
+    assert g.decide("acme").admitted
+    assert g.decide("acme").admitted
+    v = g.decide("acme")
+    assert (v.decision, v.status, v.retry_after_s) == ("shed", 503, 3.0)
+    g.release("acme")
+    assert g.decide("acme").admitted
+    assert g.snapshot()["inflight"] == {"acme": 2}
+
+
+def test_admission_burn_shed_and_ttl_cache():
+    clock = _Clock()
+    burn = {"v": 0.0}
+    g = AdmissionController(
+        config=RayTpuConfig(serve_admission_shed_burn=4.0),
+        clock=clock, burn_source=lambda dep: burn["v"])
+    assert g.decide("t", deployment="llm").admitted
+    burn["v"] = 9.0
+    # cached read within the TTL: still admitted
+    assert g.decide("t", deployment="llm").admitted
+    clock.t += 1.0                        # TTL (0.5s) expires
+    v = g.decide("t", deployment="llm")
+    assert (v.decision, v.status) == ("shed", 503)
+    burn["v"] = 0.0
+    clock.t += 1.0                        # window drained: admission reopens
+    assert g.decide("t", deployment="llm").admitted
+
+
+def test_admission_broken_burn_source_fails_open():
+    clock = _Clock()
+
+    def broken(dep):
+        raise RuntimeError("ledger gone")
+
+    g = AdmissionController(config=RayTpuConfig(), clock=clock,
+                            burn_source=broken)
+    assert g.decide("t", deployment="llm").admitted
+
+
+def test_ledger_burn_ignores_sheds_counts_errors():
+    """Feedback-loop guard: the gate's default burn source is the
+    admitted-work ("service") burn.  A flood of shed terminals — the
+    gate's own refusals — must not move it, while errors on admitted
+    requests must; otherwise refusing one abusive tenant inflates the
+    availability burn past ``serve_admission_shed_burn`` and the breaker
+    503s the innocent tenants too (refusals begetting refusals)."""
+    from ray_tpu.serve._private import admission, slo
+
+    clock = _Clock(t=1_700_000_000.0)
+    led = slo.ServingSLOLedger(clock=clock, wall=clock)
+    saved = slo._ledger
+    slo._ledger = led
+    try:
+        for _ in range(200):
+            led.start_request("llm", tenant="abuser").shed()
+        for _ in range(10):
+            led.start_request("llm", tenant="victim").finish("ok")
+        clock.t += 1.0
+        assert admission._ledger_burn("llm") == 0.0
+        # the user-visible availability SLO still counts the sheds — the
+        # two signals are deliberately different views of the same ledger
+        assert led.burn_rates("llm")["availability"]["5m"] > 1.0
+        for _ in range(10):
+            led.start_request("llm", tenant="victim").finish("error")
+        clock.t += 1.0
+        assert admission._ledger_burn("llm") > 1.0
+    finally:
+        slo._ledger = saved
+
+
+def test_admission_books_decision_counters_and_queue_gauge():
+    from ray_tpu._private import runtime_metrics
+
+    clock = _Clock()
+    before = runtime_metrics.admission_snapshot()
+    g = _gate(clock, serve_admission_tenant_rate=1.0,
+              serve_admission_tenant_burst=1.0)
+    g.decide("m-acme")
+    g.decide("m-acme")                    # throttled
+    after = runtime_metrics.admission_snapshot()
+
+    def delta(tenant, decision):
+        k = (tenant, decision)
+        return after.get(k, 0) - before.get(k, 0)
+
+    assert delta("m-acme", "admit") == 1
+    assert delta("m-acme", "throttle") == 1
+
+
+def test_disabled_gate_returns_none_and_books_nothing():
+    """serve_admission_enabled=False: the proxy's whole admission path is
+    one None check, and the admission metric families never move."""
+    from ray_tpu._private import runtime_metrics
+    from ray_tpu._private.config import global_config, set_global_config
+    from ray_tpu.serve._private import admission
+
+    saved = global_config()
+    admission.reset_controller()
+    set_global_config(RayTpuConfig(serve_admission_enabled=False))
+    try:
+        before = runtime_metrics.admission_snapshot()
+        assert admission.get_controller() is None
+        assert runtime_metrics.admission_snapshot() == before
+    finally:
+        set_global_config(saved)
+        admission.reset_controller()
+
+
+# ---------------------------------------------------------------------------
+# PR 9 tenant-extraction matrix against the gate: the identity slo.py
+# extracts is the identity the gate accounts under
+# ---------------------------------------------------------------------------
+
+
+def test_extraction_matrix_drives_admission_accounting():
+    from ray_tpu.serve._private import slo
+
+    clock = _Clock()
+    g = _gate(clock)
+    cases = [
+        (dict(headers={"x-tenant": "acme"}), "acme"),
+        (dict(headers={"x-tenant": "acme"}, payload={"tenant": "p"}),
+         "acme"),                                  # header wins
+        (dict(payload={"tenant": "p"}), "p"),
+        (dict(kwargs={"tenant": "k"}), "k"),
+        (dict(kwargs={"request": {"tenant": "nested"}}), "nested"),
+        (dict(), slo.DEFAULT_TENANT),
+        (dict(payload={"tenant": 123}), slo.DEFAULT_TENANT),  # non-string
+    ]
+    for kw, expect in cases:
+        tenant = slo.extract_tenant(**kw)
+        assert tenant == expect
+        assert g.decide(tenant).admitted
+    # hostile 500-char header: capped identity is what gets accounted
+    hostile = slo.extract_tenant(headers={"x-tenant": "x" * 500})
+    assert len(hostile) == 64
+    g.decide(hostile)
+    inflight = g.snapshot()["inflight"]
+    assert hostile in inflight and all(len(t) <= 64 for t in inflight)
+    assert inflight["acme"] == 2
+
+
+# ---------------------------------------------------------------------------
+# abuse isolation (tier-1 acceptance): an abusive tenant cannot move
+# another tenant's burn rate
+# ---------------------------------------------------------------------------
+
+
+def test_abusive_tenant_cannot_move_victims_burn_rate():
+    """Abuser floods far over its admission rate; victim sends a steady
+    trickle.  The per-(deployment,tenant) burn over the terminal-status
+    stream the gate produces fires ONLY the abuser's subkey — the
+    victim's error budget is untouched (refusals land on the refused
+    tenant, never the queue everyone shares)."""
+    from ray_tpu._private.metrics_history import (MetricsHistory,
+                                                  WatchEngine, WatchRule)
+
+    clock = _Clock(t=2_000_000.0)
+    g = _gate(clock, serve_admission_tenant_rate=1.0,
+              serve_admission_tenant_burst=2.0)
+    hist = MetricsHistory(RayTpuConfig(metrics_history_fold_interval_s=0.0),
+                          clock=clock, wall=clock)
+    eng = WatchEngine(hist, config=RayTpuConfig(), clock=clock, wall=clock)
+    eng.add_rule(WatchRule(
+        name="tenant_burn", kind="burn",
+        family="ray_tpu_serve_slo_requests_total",
+        bad_tags={"status": ("error", "shed")},
+        availability=0.99, threshold=1e-9,
+        window_s=300.0, long_window_s=3600.0,
+        group_by=("deployment", "tenant"), clear_for_s=0.0))
+
+    fam = "ray_tpu_serve_slo_requests_total"
+    counts = {}                            # (tenant, status) -> total
+
+    def record(tenant, status):
+        counts[(tenant, status)] = counts.get((tenant, status), 0) + 1
+
+    def fold():
+        hist.fold([{"name": fam, "kind": "counter", "value": float(v),
+                    "tags": {"deployment": "llm", "tenant": t,
+                             "status": s}}
+                   for (t, s), v in counts.items()])
+
+    # baseline fold so every later event books as a delta
+    for t in ("abuser", "victim"):
+        for s in ("ok", "shed"):
+            counts[(t, s)] = 0
+    fold()
+    clock.t += 10.0
+    for _step in range(12):
+        for _ in range(20):                # 20x over the admitted rate
+            v = g.decide("abuser", deployment="llm")
+            record("abuser", "ok" if v.admitted else "shed")
+            if v.admitted:
+                g.release("abuser")
+        v = g.decide("victim", deployment="llm")
+        record("victim", "ok" if v.admitted else "shed")
+        if v.admitted:
+            g.release("victim")
+        fold()
+        clock.t += 10.0
+
+    # the victim's steady 0.1 rps trickle was never refused
+    assert counts[("victim", "shed")] == 0
+    assert counts[("abuser", "shed")] > 100
+    fired = eng.tick(reporter_ages={})
+    keys = {t["key"] for t in fired if t["state"] == "firing"}
+    assert "deployment=llm,tenant=abuser" in keys
+    assert not any("tenant=victim" in k for k in keys), fired
+
+
+# ---------------------------------------------------------------------------
+# proxy integration: 429/503 + Retry-After on the wire, shed terminals
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def local_serve():
+    from ray_tpu import serve
+    from ray_tpu.serve._private import admission, slo
+
+    slo.reset_ledger()
+    admission.reset_controller()
+    yield serve
+    serve.shutdown()
+    admission.reset_controller()
+    slo.reset_ledger()
+
+
+def _post(url, payload, tenant=None):
+    body = json.dumps(payload).encode()
+    req = urllib.request.Request(url, data=body, method="POST",
+                                 headers={"Content-Type":
+                                          "application/json"})
+    if tenant:
+        req.add_header("x-tenant", tenant)
+    return urllib.request.urlopen(req, timeout=10)
+
+
+def test_proxy_throttles_429_with_retry_after_header(local_serve):
+    from ray_tpu._private.config import global_config, set_global_config
+    from ray_tpu.serve._private import slo
+
+    saved = global_config()
+    # near-zero refill so in-test wall time cannot mint extra tokens
+    set_global_config(RayTpuConfig(serve_admission_tenant_rate=0.01,
+                                   serve_admission_tenant_burst=2.0))
+    try:
+        serve = local_serve
+
+        @serve.deployment(name="echo-adm")
+        def echo(x):
+            return {"ok": True}
+
+        h = serve.run(echo.bind(), name="adm-app",
+                      _local_testing_mode=True)
+        serve.add_route("/adm", h)
+        host, port = serve.start_http_proxy(port=0)
+        url = f"http://{host}:{port}/adm"
+        statuses = []
+        retry_after = None
+        for _ in range(6):
+            try:
+                with _post(url, {"x": 1}, tenant="flood") as resp:
+                    statuses.append(resp.status)
+            except urllib.error.HTTPError as e:
+                statuses.append(e.code)
+                retry_after = e.headers.get("Retry-After")
+                body = json.loads(e.read().decode())
+                assert body["error"] == "throttle"
+        assert statuses.count(200) == 2          # burst of 2
+        assert statuses.count(429) == 4
+        assert retry_after is not None and int(retry_after) >= 1
+        # refusals booked shed terminals against the refused tenant
+        rows = [r for r in slo.get_ledger().recent()
+                if r["deployment"] == "echo-adm"]
+        sheds = [r for r in rows if r["status"] == "shed"]
+        assert len(sheds) == 4
+        assert all(r["tenant"] == "flood" for r in sheds)
+    finally:
+        set_global_config(saved)
+
+
+def test_proxy_burn_shed_503(local_serve, monkeypatch):
+    from ray_tpu.serve._private import admission
+
+    serve = local_serve
+
+    @serve.deployment(name="echo-burn")
+    def echo(x):
+        return {"ok": True}
+
+    h = serve.run(echo.bind(), name="burn-app", _local_testing_mode=True)
+    serve.add_route("/burn", h)
+    host, port = serve.start_http_proxy(port=0)
+    url = f"http://{host}:{port}/burn"
+    with _post(url, {"x": 1}, tenant="t") as resp:
+        assert resp.status == 200
+    gate = admission.get_controller()
+    assert gate is not None
+    gate._burn_source = lambda dep: 99.0         # inject a burning budget
+    gate._burn_cache.clear()
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(url, {"x": 1}, tenant="t")
+    assert ei.value.code == 503
+    assert ei.value.headers.get("Retry-After") is not None
+    assert json.loads(ei.value.read().decode())["error"] == "shed"
+    gate._burn_source = lambda dep: 0.0          # budget recovers
+    gate._burn_cache.clear()
+    with _post(url, {"x": 1}, tenant="t") as resp:
+        assert resp.status == 200
+
+
+# ---------------------------------------------------------------------------
+# ingress tier: rendezvous affinity + byte splice + drain semantics
+# ---------------------------------------------------------------------------
+
+
+def test_rendezvous_stability_and_minimal_remap():
+    from ray_tpu.serve._private.ingress import _rendezvous
+
+    backends = [("10.0.0.1", 1), ("10.0.0.2", 2), ("10.0.0.3", 3)]
+    keys = [f"client-{i}" for i in range(200)]
+    before = {k: _rendezvous(k, backends) for k in keys}
+    # stable: same key, same backend
+    assert all(_rendezvous(k, backends) == before[k] for k in keys)
+    # removing one backend remaps ONLY that backend's clients
+    survivors = backends[:2]
+    moved = 0
+    for k in keys:
+        after = _rendezvous(k, survivors)
+        if before[k] in survivors:
+            assert after == before[k]
+        else:
+            moved += 1
+    assert moved == sum(1 for k in keys if before[k] == backends[2])
+
+
+def test_ingress_tier_splices_and_pins_clients():
+    """End-to-end through the tier: HTTP round trips reach a live proxy
+    backend, and one client address always lands on the same backend."""
+    from ray_tpu import serve
+    from ray_tpu.serve._private import slo
+    from ray_tpu.serve._private.ingress import IngressTier
+
+    slo.reset_ledger()
+    try:
+        @serve.deployment(name="tier-echo")
+        def echo(x):
+            return {"pong": x}
+
+        h = serve.run(echo.bind(), name="tier-app",
+                      _local_testing_mode=True)
+        serve.add_route("/tier", h)
+        hp1 = serve.start_http_proxy(port=0)
+        tier = IngressTier(backends=[hp1])
+        try:
+            host, port = tier.address
+            for i in range(3):
+                with _post(f"http://{host}:{port}/tier", {"x": i}) as r:
+                    assert r.status == 200
+                    assert json.loads(r.read().decode())["pong"] == \
+                        {"x": i}
+            # same client IP -> deterministic pick
+            p1 = tier.pick("127.0.0.1")
+            assert p1 == tier.pick("127.0.0.1")
+            # drain semantics: dropping the backend stops NEW picks
+            tier.set_backends([])
+            assert tier.pick("127.0.0.1") is None
+        finally:
+            tier.stop()
+    finally:
+        serve.shutdown()
+        slo.reset_ledger()
+
+
+def test_start_ingress_scales_out_and_serves_sse(monkeypatch):
+    """serve.start_ingress(): N proxies behind one endpoint; plain and
+    SSE-streaming requests complete through the splice tier."""
+    from ray_tpu import serve
+    from ray_tpu.serve._private import ingress as ing
+    from ray_tpu.serve._private import slo
+
+    slo.reset_ledger()
+    try:
+        @serve.deployment(name="sse-tier")
+        class Streamer:
+            def __call__(self, request):
+                def gen():
+                    for i in range(5):
+                        yield [i]
+                return gen()
+
+        h = serve.run(Streamer.bind(), name="sse-tier-app",
+                      _local_testing_mode=True)
+        serve.add_route("/sse", h)
+        host, port = serve.start_ingress(num_proxies=2)
+        tier = ing.get_tier()
+        assert tier is not None and len(tier.backends()) == 2
+        with _post(f"http://{host}:{port}/sse",
+                   {"stream": True, "tenant": "s"}) as resp:
+            assert resp.status == 200
+            body = resp.read().decode()
+        assert body.count("data:") >= 5
+        assert "[DONE]" in body
+    finally:
+        serve.stop_ingress()
+        serve.shutdown()
+        slo.reset_ledger()
+
+
+def test_proxy_server_utilization_row_folds():
+    """ProxyServer's utilization() row feeds the PR 16 fold: handle
+    threads as slots, fair backlog as pending."""
+    from ray_tpu._private.device_telemetry import fold_utilization_rows
+    from ray_tpu.serve._private.ingress import ProxyServer
+
+    ps = ProxyServer()
+    try:
+        row = ps.utilization()
+        assert row["slots"]["max"] > 0
+        assert row["slots"]["free"] == row["slots"]["max"]
+        assert row["pending"] == 0 and row["duty_cycle"] == 0.0
+        folded = fold_utilization_rows([dict(
+            row, app="ingress", replica="r0", ts=time.time())])
+        dep = folded["deployments"]["http-proxy"]
+        assert dep["mean_duty_cycle"] == 0.0
+        assert dep["total_slots"] == row["slots"]["max"]
+    finally:
+        ps.shutdown()

@@ -455,3 +455,27 @@ def test_sebulba_sample_loop_lease_rpc_budget():
             algo.stop()
     finally:
         ray_tpu.shutdown()
+
+
+def test_ingress_admission_overhead_and_byte_identity():
+    """Admission-gate budget gates (ISSUE 18).  The gate's decide() runs
+    once per ingress request ahead of any handle work:
+
+      - warm admitted decide() < 5 µs (two metric bookings, bucket take,
+        inflight bookkeeping, cached burn compare); the full
+        decide()+release() round trip < 10 µs;
+      - the refusal verdict (throttle + exact Retry-After) < 5 µs;
+      - a WFQ push+pop cycle at a steady 64-deep backlog < 10 µs;
+      - serve_admission_enabled=False: get_controller() is one None
+        check (< 1 µs) and the admission metric families book NOTHING
+        (byte-identical surface, asserted not measured)."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from benchmarks.ingress_overhead_bench import run
+
+    extra = run()
+    assert extra["decide_admit_ns"] < 5_000, extra
+    assert extra["cycle_ns"] < 10_000, extra
+    assert extra["decide_throttle_ns"] < 5_000, extra
+    assert extra["wfq_cycle_ns"] < 10_000, extra
+    assert extra["disabled_lookup_ns"] < 1_000, extra
+    assert extra["booked_disabled"] == 0, extra

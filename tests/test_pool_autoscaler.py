@@ -1,0 +1,310 @@
+"""SLO-feedback pool autoscaling (ISSUE 18): burn alerts actuate the
+disaggregated prefill/decode pools, with cooldown hysteresis and a
+utilization-headroom scale-down guard.  Includes the end-to-end
+acceptance path: an injected latency breach drives a REAL WatchEngine's
+sketch-burn rule into a firing transition that scales the CORRECT pool
+(TTFT -> prefill, ITL -> decode).  Injected clocks throughout."""
+
+import threading
+
+from ray_tpu._private.config import RayTpuConfig
+from ray_tpu._private.latency_sketch import LatencySketch
+from ray_tpu._private.metrics_history import (MetricsHistory, WatchEngine,
+                                              builtin_rules)
+from ray_tpu.serve._private.pool_autoscaler import (PoolAutoscaler,
+                                                    RULE_POOL,
+                                                    _subkey_tags)
+
+
+class _Clock:
+    def __init__(self, t=1_000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class _Fleet:
+    """Recording actuator: replica counts plus the actuation log."""
+
+    def __init__(self, counts=None):
+        self.counts = dict(counts or {})
+        self.log = []
+
+    def actuate(self, dep, n):
+        self.log.append((dep, n))
+        self.counts[dep] = n
+
+    def current(self, dep):
+        return self.counts[dep]
+
+
+def _scaler(fleet, clock, duty=None, **over):
+    cfg = RayTpuConfig(serve_pool_scale_cooldown_s=30.0, **over)
+    return PoolAutoscaler(actuate=fleet.actuate, current=fleet.current,
+                          config=cfg, clock=clock,
+                          headroom_source=lambda dep: duty)
+
+
+def _firing(rule, dep="llm", value=5.0):
+    return {"rule": rule, "key": f"deployment={dep}", "state": "firing",
+            "value": value, "threshold": 1.0, "severity": "WARNING",
+            "time": 0.0, "description": ""}
+
+
+def _cleared(rule, dep="llm"):
+    return {"rule": rule, "key": f"deployment={dep}", "state": "cleared",
+            "value": 0.0, "threshold": 1.0, "severity": "WARNING",
+            "time": 0.0, "description": ""}
+
+
+def test_subkey_parse():
+    assert _subkey_tags("deployment=llm") == {"deployment": "llm"}
+    assert _subkey_tags("deployment=llm,tenant=a") == {
+        "deployment": "llm", "tenant": "a"}
+    assert _subkey_tags("_") == {}
+    assert _subkey_tags("") == {}
+
+
+def test_ttft_burn_scales_prefill_itl_scales_decode():
+    clock = _Clock()
+    fleet = _Fleet({"llm-prefill": 2, "llm-decode": 2})
+    sc = _scaler(fleet, clock)
+    sc.on_alert(_firing("serve_ttft_burn"))
+    assert fleet.log == [("llm-prefill", 3)]
+    sc.on_alert(_firing("serve_itl_burn"))
+    assert fleet.log == [("llm-prefill", 3), ("llm-decode", 3)]
+    # unmapped rules are ignored
+    sc.on_alert(_firing("goodput_drop"))
+    assert len(fleet.log) == 2
+
+
+def test_cooldown_prevents_scale_up_thrash_and_max_clamps():
+    clock = _Clock()
+    fleet = _Fleet({"llm-prefill": 7})
+    sc = _scaler(fleet, clock, serve_pool_max_replicas=8)
+    sc.on_alert(_firing("serve_ttft_burn"))
+    assert fleet.counts["llm-prefill"] == 8
+    # immediate re-fire inside the cooldown: no second actuation
+    sc.on_alert(_firing("serve_ttft_burn"))
+    assert len(fleet.log) == 1
+    clock.t += 31.0
+    sc.on_alert(_firing("serve_ttft_burn"))
+    assert fleet.counts["llm-prefill"] == 8      # clamped at max
+    assert len(fleet.log) == 1                   # no-op not recorded
+
+
+def test_scale_down_needs_clear_cooldown_and_headroom():
+    clock = _Clock()
+    fleet = _Fleet({"llm-decode": 2})
+    sc = _scaler(fleet, clock, serve_pool_max_replicas=8)
+    sc.on_alert(_firing("serve_itl_burn"))
+    assert fleet.counts["llm-decode"] == 3
+    sc._headroom_source = lambda dep: 0.1        # plenty of headroom...
+    sc.tick()
+    assert fleet.counts["llm-decode"] == 3       # ...but still firing
+    sc.on_alert(_cleared("serve_itl_burn"))
+    sc.tick()
+    assert fleet.counts["llm-decode"] == 3       # cleared, but in cooldown
+    clock.t += 31.0
+    sc.tick()
+    assert fleet.counts["llm-decode"] == 2       # clear + cool + idle
+    clock.t += 31.0
+    sc._headroom_source = lambda dep: 0.9        # busy pool
+    sc.tick()
+    assert fleet.counts["llm-decode"] == 2       # quiet alert, busy chips
+
+
+def test_unknown_duty_cycle_never_shrinks():
+    clock = _Clock()
+    fleet = _Fleet({"llm-prefill": 4})
+    sc = _scaler(fleet, clock, duty=None)
+    sc.on_alert(_firing("serve_ttft_burn"))
+    sc.on_alert(_cleared("serve_ttft_burn"))
+    clock.t += 1000.0
+    sc.tick()
+    assert fleet.counts["llm-prefill"] == 5      # up once, never down
+
+
+def test_min_replicas_floor_holds():
+    clock = _Clock()
+    fleet = _Fleet({"llm-decode": 1})
+    sc = _scaler(fleet, clock, duty=0.0, serve_pool_min_replicas=1)
+    sc.on_alert(_firing("serve_itl_burn"))
+    sc.on_alert(_cleared("serve_itl_burn"))
+    for _ in range(5):
+        clock.t += 100.0
+        sc.tick()
+    assert fleet.counts["llm-decode"] == 1       # back at the floor, stays
+
+
+def test_disabled_autoscaler_is_inert():
+    clock = _Clock()
+    fleet = _Fleet({"llm-prefill": 2})
+    sc = _scaler(fleet, clock, serve_pool_autoscaler_enabled=False)
+    sc.on_alert(_firing("serve_ttft_burn"))
+    sc.tick()
+    assert fleet.log == []
+
+
+def test_actuation_failure_does_not_kill_intake():
+    clock = _Clock()
+    calls = []
+
+    def flaky(dep, n):
+        calls.append((dep, n))
+        raise RuntimeError("controller unreachable")
+
+    sc = PoolAutoscaler(actuate=flaky, current=lambda d: 2,
+                        config=RayTpuConfig(), clock=clock,
+                        headroom_source=lambda d: None)
+    sc.on_alert(_firing("serve_ttft_burn"))
+    assert calls == [("llm-prefill", 3)]
+    # failed actuation left no cooldown: the next alert retries
+    sc.on_alert(_firing("serve_ttft_burn"))
+    assert len(calls) == 2
+    assert sc.snapshot()["actuations"] == []
+
+
+def test_snapshot_reports_pools_and_actuations():
+    clock = _Clock()
+    fleet = _Fleet({"llm-prefill": 2})
+    sc = _scaler(fleet, clock)
+    sc.on_alert(_firing("serve_ttft_burn", value=3.3))
+    snap = sc.snapshot()
+    assert snap["enabled"] is True
+    assert snap["pools"]["llm-prefill"]["firing"] is True
+    (act,) = snap["actuations"]
+    assert (act["deployment"], act["from"], act["to"]) == \
+        ("llm-prefill", 2, 3)
+    assert "serve_ttft_burn" in act["reason"]
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: injected latency breach -> sketch-burn rule -> ALERT
+# transition -> the CORRECT pool scales (tier-1 acceptance)
+# ---------------------------------------------------------------------------
+
+
+def _breach_end_to_end(family, rule_name, expect_pool):
+    """Fold a cumulative latency sketch whose observations all exceed the
+    SLO target into the history store, tick a real WatchEngine carrying
+    the builtin rule pack, and feed every transition to the autoscaler."""
+    clock = _Clock(t=3_000_000.0)
+    cfg = RayTpuConfig()
+    hist = MetricsHistory(RayTpuConfig(metrics_history_fold_interval_s=0.0),
+                          clock=clock, wall=clock)
+    eng = WatchEngine(hist, config=cfg, clock=clock, wall=clock)
+    (rule,) = [r for r in builtin_rules(cfg) if r.name == rule_name]
+    rule.clear_for_s = 0.0
+    eng.add_rule(rule)
+    # target: ttft 2000ms / itl 200ms (config defaults); breach with 10x
+    bad_latency = {"ray_tpu_serve_ttft_seconds": 20.0,
+                   "ray_tpu_serve_itl_seconds": 2.0}[family]
+
+    cumulative = LatencySketch(relative_accuracy=0.01)
+    pt = cumulative.to_point()
+    pt.update({"name": family, "kind": "sketch",
+               "tags": {"deployment": "llm"}})
+    hist.fold([pt])                       # baseline fold before traffic
+    clock.t += 10.0
+    for _ in range(6):
+        for _ in range(20):
+            cumulative.add(bad_latency)
+        pt = cumulative.to_point()
+        pt.update({"name": family, "kind": "sketch",
+                   "tags": {"deployment": "llm"}})
+        hist.fold([pt])
+        clock.t += 10.0
+
+    fleet = _Fleet({"llm-prefill": 1, "llm-decode": 1})
+    sc = _scaler(fleet, clock)
+    fired = eng.tick(reporter_ages={})
+    assert [t["state"] for t in fired] == ["firing"], fired
+    assert fired[0]["rule"] == rule_name
+    assert fired[0]["key"] == "deployment=llm"
+    for t in fired:
+        sc.on_alert(t)
+    other = ({"llm-prefill", "llm-decode"} - {expect_pool}).pop()
+    assert fleet.counts[expect_pool] == 2, fleet.log
+    assert fleet.counts[other] == 1
+    return fleet
+
+
+def test_e2e_ttft_breach_scales_prefill_pool():
+    _breach_end_to_end("ray_tpu_serve_ttft_seconds", "serve_ttft_burn",
+                       "llm-prefill")
+
+
+def test_e2e_itl_breach_scales_decode_pool():
+    _breach_end_to_end("ray_tpu_serve_itl_seconds", "serve_itl_burn",
+                       "llm-decode")
+
+
+def test_e2e_latency_within_target_stays_quiet():
+    """The inverse: the same traffic volume under the SLO target fires
+    nothing and scales nothing."""
+    clock = _Clock(t=3_000_000.0)
+    cfg = RayTpuConfig()
+    hist = MetricsHistory(RayTpuConfig(metrics_history_fold_interval_s=0.0),
+                          clock=clock, wall=clock)
+    eng = WatchEngine(hist, config=cfg, clock=clock, wall=clock)
+    (rule,) = [r for r in builtin_rules(cfg)
+               if r.name == "serve_ttft_burn"]
+    eng.add_rule(rule)
+    cumulative = LatencySketch(relative_accuracy=0.01)
+    pt = cumulative.to_point()
+    pt.update({"name": "ray_tpu_serve_ttft_seconds", "kind": "sketch",
+               "tags": {"deployment": "llm"}})
+    hist.fold([pt])
+    clock.t += 10.0
+    for _ in range(6):
+        for _ in range(20):
+            cumulative.add(0.05)          # 50ms TTFT, target 2000ms
+        pt = cumulative.to_point()
+        pt.update({"name": "ray_tpu_serve_ttft_seconds", "kind": "sketch",
+                   "tags": {"deployment": "llm"}})
+        hist.fold([pt])
+        clock.t += 10.0
+    assert eng.tick(reporter_ages={}) == []
+
+
+# ---------------------------------------------------------------------------
+# controller actuator: burn scale-ups out-rank the queue-depth autoscaler
+# ---------------------------------------------------------------------------
+
+
+def test_scale_deployment_raises_queue_autoscaler_floor():
+    """scale_deployment() bumps num_replicas AND the autoscaling_config
+    min_replicas floor, so the queue-depth autoscaler cannot undo a
+    burn-driven scale-up on its next tick."""
+    from ray_tpu.serve._private.controller import ServeController
+
+    c = object.__new__(ServeController)        # no threads, no cluster
+    c._lock = threading.RLock()
+    c._version = 0
+    c._desired = {"app": {"llm-decode": {
+        "name": "llm-decode", "num_replicas": 2,
+        "autoscaling_config": {"min_replicas": 1, "max_replicas": 4,
+                               "target_ongoing_requests": 2}}}}
+    assert c.scale_deployment("app", "llm-decode", 6)
+    cfg = c._desired["app"]["llm-decode"]
+    assert cfg["num_replicas"] == 6
+    assert cfg["autoscaling_config"]["min_replicas"] == 6
+    assert cfg["autoscaling_config"]["max_replicas"] == 6   # raised to fit
+    assert c._version == 1
+    # name-based wrappers used by the autoscaler callables
+    assert c._replicas_by_name("llm-decode") == 6
+    c._scale_by_name("llm-decode", 3)
+    assert c._replicas_by_name("llm-decode") == 3
+    assert c.scale_deployment("app", "missing", 2) is False
+
+
+def test_rule_pool_mapping_is_exactly_the_builtin_pack():
+    """The autoscaler keys on the builtin rule names — a rename in either
+    place must break loudly here."""
+    cfg = RayTpuConfig()
+    names = {r.name for r in builtin_rules(cfg)}
+    assert set(RULE_POOL) <= names
+    assert RULE_POOL == {"serve_ttft_burn": "prefill",
+                        "serve_itl_burn": "decode"}
