@@ -1036,6 +1036,164 @@ def _bench_serving_disagg(on_tpu: bool) -> dict:
                 "trace": traceback.format_exc()[-400:]}
 
 
+def _bench_kv_migration(on_tpu: bool) -> dict:
+    """Live KV migration microbench (ISSUE 19): streaming clients on a
+    source server, every live stream force-migrated mid-decode to a
+    destination server.  Reports the client-visible pause (max
+    inter-chunk gap per migrated stream, p50/p99 — the stall bound the
+    "total" phase histogram tracks), per-phase latency means, handoff
+    bytes + effective bus bandwidth, and the outcome counts (every
+    stream must land in migrated/fallback, never lost)."""
+    import threading
+
+    from ray_tpu._private import runtime_metrics
+    from ray_tpu.llm import LLMConfig
+    from ray_tpu.llm.serve import LLMServer
+    from ray_tpu.models.llama import LlamaConfig, init_params
+    from ray_tpu.serve._private import kv_migration
+
+    try:
+        if on_tpu:
+            mcfg = LlamaConfig(
+                vocab_size=32768, dim=2048, n_layers=16, n_heads=16,
+                n_kv_heads=8, ffn_dim=8192, max_seq_len=1024,
+                param_dtype=jnp.bfloat16)
+            n_clients, new_tokens, plen = 16, 128, 192
+            lkw = dict(max_batch_size=n_clients, block_size=32,
+                       prefill_chunk=128, decode_chunk=16,
+                       max_seq_len=1024)
+        else:
+            mcfg = LlamaConfig.tiny()
+            n_clients, new_tokens, plen = 6, 40, 16
+            lkw = dict(max_batch_size=n_clients, block_size=8,
+                       prefill_chunk=16, decode_chunk=4, max_seq_len=64)
+        params = init_params(mcfg, jax.random.PRNGKey(0))
+        lcfg = LLMConfig(model_config=mcfg, kv_cache="paged", **lkw)
+        src = LLMServer(lcfg, params=params)
+        dst = LLMServer(lcfg, params=params)
+
+        moved = {"bytes": 0}
+
+        class MeasuringDest(kv_migration.LocalDest):
+            def import_migration(self, handoff, allow_recompute=False):
+                moved["bytes"] += (handoff["k"].nbytes
+                                   + handoff["v"].nbytes)
+                return super().import_migration(
+                    handoff, allow_recompute=allow_recompute)
+
+        prompts = [[(7 * i + j) % 90 + 33 for j in range(plen)]
+                   for i in range(n_clients)]
+        stamps: dict = {}
+        counts: dict = {}
+
+        def one(i):
+            ts = stamps[i] = []
+            n = 0
+            try:
+                for toks in src.generate_stream(
+                        prompts[i], max_new_tokens=new_tokens):
+                    ts.append(time.perf_counter())
+                    n += len(toks)
+            except Exception:  # noqa: BLE001 — count, don't kill
+                pass
+            counts[i] = n
+
+        try:
+            # warm both engines (compiles outside the measured window);
+            # one concurrent round on the source covers every decode
+            # batch shape 1..n so the measured round doesn't stall on
+            # recompilation mid-stream
+            src.generate(prompts[0], max_new_tokens=2)
+            dst.generate(prompts[0], max_new_tokens=2)
+            warm = [threading.Thread(target=lambda i=i: src.generate(
+                prompts[i], max_new_tokens=4)) for i in range(n_clients)]
+            for t in warm:
+                t.start()
+            for t in warm:
+                t.join()
+            if not on_tpu:
+                # a warm micro-engine steps in ~100 µs and finishes every
+                # stream before a sweep can catch it mid-decode; pace it
+                # to something TPU-shaped so the migration window is real
+                eng, orig_step = src._engine, type(src._engine).step
+
+                def paced(decode=True):
+                    time.sleep(0.004)
+                    return orig_step(eng, decode)
+
+                eng.step = paced
+            m0 = runtime_metrics.kv_migration_snapshot()
+            threads = [threading.Thread(target=one, args=(i,))
+                       for i in range(n_clients)]
+            for t in threads:
+                t.start()
+            # wait until most streams are simultaneously exportable
+            # (prefill done, >= 1 token out) — tiny streams never all
+            # align perfectly, so sweep whatever is live at that instant
+            # with the source loop parked (it takes _engines_lock every
+            # iteration), catching each mid-decode
+            want = max(2, n_clients - 2)
+            deadline = time.time() + 30
+            while (len(src.migratable_streams()) < want
+                   and time.time() < deadline):
+                time.sleep(0.001)
+            dests = [MeasuringDest(dst)]
+            outcomes = {"migrated": 0, "fallback": 0, "skipped": 0}
+            t_mig0 = time.perf_counter()
+            with src._engines_lock:
+                for rid in src.migratable_streams():
+                    outcomes[kv_migration.migrate_stream(
+                        src, rid, dests, reason="manual")] += 1
+            t_mig1 = time.perf_counter()
+            if not on_tpu:
+                del src._engine.step
+            for t in threads:
+                t.join()
+            m1 = runtime_metrics.kv_migration_snapshot()
+        finally:
+            src.shutdown()
+            dst.shutdown()
+
+        # client-visible migration stall: for each stream, the widest
+        # inter-chunk gap whose span overlaps the migration sweep window
+        # (gaps elsewhere are ordinary decode pacing, not migration cost)
+        gaps = []
+        for ts in stamps.values():
+            over = [b - a for a, b in zip(ts, ts[1:])
+                    if b >= t_mig0 and a <= t_mig1]
+            if over:
+                gaps.append(max(over))
+        phases = {}
+        for ph, d1 in m1["phases"].items():
+            d0 = m0["phases"].get(ph, {"count": 0, "sum_s": 0.0})
+            cnt = d1["count"] - d0["count"]
+            if cnt:
+                phases[ph] = {
+                    "count": cnt,
+                    "mean_s": round((d1["sum_s"] - d0["sum_s"]) / cnt, 6)}
+        xf = phases.get("transfer") or {}
+        xfer_s = xf.get("mean_s", 0.0) * xf.get("count", 0)
+        return {
+            "clients": n_clients, "new_tokens": new_tokens,
+            "outcomes": outcomes,
+            "complete_streams": sum(
+                1 for n in counts.values() if n == new_tokens),
+            "pause_s": _percentiles(gaps, ps=(50, 99)),
+            "phases": phases,
+            "handoff_bytes": moved["bytes"],
+            "handoff_busbw_gbps": round(
+                moved["bytes"] / xfer_s / 1e9, 3) if xfer_s else None,
+            "note": ("in-process source/destination pair; pause_s is the "
+                     "max inter-chunk gap a streaming client saw around "
+                     "its mid-decode migration"),
+        }
+    except Exception as e:  # noqa: BLE001
+        import traceback
+
+        return {"error": (str(e) or repr(e))[:200],
+                "trace": traceback.format_exc()[-400:]}
+
+
 _CORE_PERF_SCRIPT = r"""
 import json, os, time
 os.environ["JAX_PLATFORMS"] = "cpu"
@@ -1712,6 +1870,21 @@ def _kv_handoff_snapshot() -> dict:
         return {"error": str(e)[:200]}
 
 
+def _kv_migration_snapshot() -> dict:
+    """Live-migration accounting (kv_migration bench + any drain traffic
+    during the round): outcome counts per reason, per-phase latency."""
+    try:
+        from ray_tpu._private import runtime_metrics
+
+        snap = runtime_metrics.kv_migration_snapshot()
+        # JSON-safe: outcome keys are (reason, outcome) tuples
+        snap["outcomes"] = {f"{r}/{o}": v
+                            for (r, o), v in snap["outcomes"].items()}
+        return snap
+    except Exception as e:  # noqa: BLE001
+        return {"error": str(e)[:200]}
+
+
 def _ingest_snapshot() -> dict:
     """Data-plane ingest counters recorded in THIS process (rows, view vs
     copied bytes, buffer-empty waits, backpressure events)."""
@@ -1970,6 +2143,7 @@ def main():
         ("llm_decode", lambda: _bench_llm_decode(on_tpu), 900.0),
         ("serving", lambda: _bench_serving(on_tpu), 900.0),
         ("serving_disagg", lambda: _bench_serving_disagg(on_tpu), 900.0),
+        ("kv_migration", lambda: _bench_kv_migration(on_tpu), 900.0),
         ("ingress_fairness", lambda: _bench_ingress_fairness(on_tpu), 900.0),
         ("core_perf", _bench_core_perf, 600.0),
         ("rl_throughput", _bench_rl_throughput, 600.0),
@@ -2001,6 +2175,7 @@ def main():
         "rl": _rl_snapshot(),
         "prefix_cache": _prefix_cache_snapshot(),
         "kv_handoff": _kv_handoff_snapshot(),
+        "kv_migration": _kv_migration_snapshot(),
         "specdec": _specdec_snapshot(),
         "slo": _slo_snapshot(),
         "device_telemetry": _device_telemetry_snapshot(),

@@ -261,6 +261,30 @@ class RayTpuConfig:
     # the PR 16 utilization fold shows mean duty cycle below this headroom
     # threshold (never shrink a busy pool on a quiet alert alone)
     serve_pool_scale_down_headroom: float = 0.5
+    # --- serve: live KV migration (serve/_private/kv_migration.py) ---
+    # master switch for decode->decode stream migration: the controller's
+    # migrate-first drain path and the queue-depth rebalance trigger.
+    # Off => draining replicas wait out their streams (the PR 4 behavior)
+    # and the engine/serve layers book NOTHING migration-related
+    serve_migration_enabled: bool = True
+    # handoff transport: "object" ships KV host arrays through the actor
+    # call payload (plasma); "channel" stages them through an
+    # XlaTensorChannel like the P/D handoff (adds int8 on-wire option)
+    serve_migration_transport: str = "object"
+    # rebalance trigger: migrate streams off a replica only when the
+    # queue-depth gap between the hottest and coldest replica of a
+    # deployment exceeds this many requests...
+    serve_migration_rebalance_threshold: int = 8
+    # ...for this many consecutive planner ticks (hysteresis: a
+    # transient burst never triggers a migration storm)
+    serve_migration_rebalance_ticks: int = 3
+    # per-replica migration-rate cap (token bucket, streams/second):
+    # bounds how fast rebalancing can move streams off any one replica,
+    # so planner oscillation can never thrash the pool
+    serve_migration_max_rate_per_s: float = 4.0
+    # max streams moved per rebalance actuation (drain evacuation is
+    # never capped — it must empty the replica)
+    serve_migration_rebalance_batch: int = 2
     # --- device telemetry (_private/device_telemetry.py) ---
     # master switch for the chip-level observability layer: per-device HBM
     # gauges, per-deployment engine utilization/headroom gauges, the
@@ -333,6 +357,15 @@ class RayTpuConfig:
     # want to preempt ONE node of a cluster pass the same spec to that
     # node's Raylet directly (testing_preemption_notice=...) instead.
     testing_preemption_notice: str = ""
+    # Deterministic fault injection for live KV migration
+    # (serve/_private/kv_migration.py), chaos-style like
+    # testing_preemption_notice: "<phase>:<mode>" where phase is one of
+    # export / transfer / import / splice and mode is "fail" (the phase
+    # raises) or "refuse" (import only: the destination reports
+    # no-capacity).  e.g. "import:fail" — every import attempt dies, so
+    # migration must degrade to the next candidate / recompute / local
+    # restore with zero dropped streams.  Empty disables.
+    testing_migration_fault: str = ""
 
     def __post_init__(self):
         for f in fields(self):

@@ -325,6 +325,27 @@ KV_HANDOFF_LATENCY = Histogram(
     "plain transport tag (one observation per handoff — the authoritative "
     "count); export gather + transfer enqueue under <transport>_export",
     boundaries=_LATENCY_BOUNDS, tag_keys=("transport",))
+# decode -> decode live KV migration (serve/_private/kv_migration.py).
+# Booked ONLY when a migration actually runs — serve_migration_enabled off
+# (or simply no migration traffic) books nothing and the engine step is
+# byte-identical (perf-smoke pinned).  reason = why the stream moved
+# (drain / rebalance / manual); outcome = migrated (KV moved, splice ok) /
+# fallback (a phase failed and the stream survived via next-candidate,
+# recompute, or local restore) / lost (no recovery path left — must stay
+# 0 in every chaos lane).
+SERVE_KV_MIGRATIONS = Counter(
+    "ray_tpu_serve_kv_migrations_total",
+    "Live stream migrations between decode replicas (reason = drain / "
+    "rebalance / manual; outcome = migrated / fallback = a phase failed "
+    "and the stream survived via recompute-or-retry / lost)",
+    tag_keys=("reason", "outcome"))
+SERVE_KV_MIGRATION_LATENCY = Histogram(
+    "ray_tpu_serve_kv_migration_latency_seconds",
+    "Wall time of one live-migration phase (export = drain + KV gather on "
+    "the source, transfer = handoff staging, import = destination scatter "
+    "+ draft re-seed, splice = waiter relay install, total = source-pause "
+    "to resumed decode — the client-visible stall bound)",
+    boundaries=_LATENCY_BOUNDS, tag_keys=("phase",))
 SERVE_DISAGG_QUEUE_DEPTH = Gauge(
     "ray_tpu_serve_disagg_queue_depth",
     "Live requests per disaggregated serving stage (prefill = queued + "
@@ -607,6 +628,7 @@ FAMILIES = (
     SERVE_PREFIX_CACHE_HITS, SERVE_PREFIX_CACHE_MISSES,
     SERVE_PREFIX_CACHE_EVICTIONS,
     KV_HANDOFF_BYTES, KV_HANDOFF_LATENCY, SERVE_DISAGG_QUEUE_DEPTH,
+    SERVE_KV_MIGRATIONS, SERVE_KV_MIGRATION_LATENCY,
     SERVE_TTFT, SERVE_ITL, SERVE_STAGE_SECONDS, SERVE_ROUTE_DECISIONS,
     SERVE_SLO_REQUESTS, SERVE_SLO_BURN_RATE,
     SERVE_ADMISSION, SERVE_TENANT_QUEUE_DEPTH,
@@ -1038,6 +1060,19 @@ def set_disagg_queue_depth(stage: str, n: int) -> None:
     _bound(SERVE_DISAGG_QUEUE_DEPTH, stage=stage).set(n)
 
 
+def record_kv_migration(reason: str, outcome: str) -> None:
+    """One live-migration attempt reaching a terminal outcome.  Callers
+    only exist on the migration path — no migration traffic books
+    nothing (the documented invariant the perf smoke pins)."""
+    _bound(SERVE_KV_MIGRATIONS, reason=reason, outcome=outcome).inc(1)
+
+
+def observe_kv_migration_phase(phase: str, seconds: float) -> None:
+    """Wall time of one migration phase (export / transfer / import /
+    splice) or the whole source-pause -> resumed-decode span (total)."""
+    _bound(SERVE_KV_MIGRATION_LATENCY, phase=phase).observe(seconds)
+
+
 # -- serving SLO layer ------------------------------------------------------
 
 
@@ -1176,6 +1211,26 @@ def kv_handoff_snapshot() -> dict:
             d["mean_latency_s"] = lat / n
         if lat > 0 and d.get("bytes_total"):
             d["effective_gbps"] = d["bytes_total"] / lat / 1e9
+    return out
+
+
+def kv_migration_snapshot() -> dict:
+    """Process-local live-migration accounting for bench.py and the perf
+    tests: outcome counts per reason plus per-phase latency count / sum /
+    mean.  Hermetic — this process's counters only."""
+    out: dict = {"outcomes": {}, "phases": {}}
+    for tags_key, v in dict(SERVE_KV_MIGRATIONS._points).items():
+        t = dict(tags_key)
+        key = (t.get("reason", "?"), t.get("outcome", "?"))
+        out["outcomes"][key] = out["outcomes"].get(key, 0.0) + v
+    for p in SERVE_KV_MIGRATION_LATENCY._snapshot():
+        ph = p["tags"].get("phase", "?")
+        d = out["phases"].setdefault(ph, {"count": 0, "sum_s": 0.0})
+        d["count"] += p["count"]
+        d["sum_s"] += p["sum"]
+    for d in out["phases"].values():
+        if d["count"]:
+            d["mean_s"] = d["sum_s"] / d["count"]
     return out
 
 

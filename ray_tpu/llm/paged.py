@@ -1053,10 +1053,16 @@ class PagedJaxLLMEngine:
             return False
         return not req.spec_enabled or req.draft_prefill_pos >= plen
 
-    def _draft_prefill_chunk_locked(self, req: _PagedReq):
+    def _draft_prefill_chunk_locked(self, req: _PagedReq,
+                                    seq: Optional[Sequence[int]] = None):
         """Dispatch one draft prefill chunk (same pow2 chunk geometry and
-        fixed table width as the target — block_size is shared)."""
-        plen = len(req.prompt)
+        fixed table width as the target — block_size is shared).  ``seq``
+        overrides the sequence being prefilled (default: the prompt): a
+        mid-decode migration import re-seeds the draft over
+        prompt + generated history so the draft can propose from the
+        resume position."""
+        seq = req.prompt if seq is None else seq
+        plen = len(seq)
         remaining = plen - req.draft_prefill_pos
         c = min(self.config.prefill_chunk,
                 _bucket_pow2(_pad_to(remaining, self.bs), lo=self.bs))
@@ -1067,7 +1073,7 @@ class PagedJaxLLMEngine:
             f"have {len(req.draft_blocks)} (draft admission reserve bug)")
         take = min(c, remaining)
         tokens = np.zeros((1, c), np.int32)
-        tokens[0, :take] = req.prompt[p0:p0 + take]
+        tokens[0, :take] = seq[p0:p0 + take]
         table = np.zeros((1, self._prefill_w), np.int32)
         table[0, :len(req.draft_blocks)] = req.draft_blocks
         self._draft_pool = self._draft_prefill(
@@ -1633,19 +1639,27 @@ class PagedJaxLLMEngine:
     # -- disaggregated prefill/decode handoff ---------------------------
 
     def export_request(self, request_id: int) -> Dict:
-        """Export a prefill-complete request's KV blocks + first sampled
-        token and release its slot (the prefill stage of a disaggregated
-        deployment).  The request's registered prompt blocks stay revivable
-        in this engine's prefix cache, so the prefill replica keeps serving
-        chain hits for the prompt it just handed off.
+        """Export a request's live KV blocks + emitted-token history and
+        release its slot.  Two callers: the prefill stage of a
+        disaggregated deployment (export right after prefill, history is
+        the single first token) and live KV migration (export mid-decode:
+        the in-flight chunk is drained first — the same argument as
+        ``cancel_request`` — and the handoff carries everything the
+        destination needs to resume at the exact position).  The
+        request's registered prompt blocks stay revivable in this
+        engine's prefix cache, so the source keeps serving chain hits
+        for the prompt it just handed off.
 
-        Returns {prompt, first_token, k, v, block_size}: k/v are host
-        arrays [L, nblocks, block_size, kv_dim] covering exactly the
-        prompt.  Raises if the request isn't in the exportable state
-        (prefill incomplete, or already finished — a 1-token budget
-        completes on the first emit and frees its partial block)."""
+        Returns {prompt, first_token, k, v, block_size, emitted, gen}:
+        k/v are host arrays [L, nblocks, block_size, kv_dim] covering
+        exactly the live positions (prompt + generated-so-far), emitted
+        is the full output-token history, and gen carries the sampling /
+        stop / budget state.  Raises if the request isn't in the
+        exportable state (prefill incomplete, or already finished — a
+        1-token budget completes on the first emit and frees its partial
+        block)."""
         with self._lock:
-            self._drain_locked()  # resolve the final chunk's sampled token
+            self._drain_locked()  # resolve the in-flight chunk's tokens
             req = self._requests.get(request_id)
             if req is None or req.done or req.slot < 0:
                 raise KeyError(
@@ -1659,26 +1673,43 @@ class PagedJaxLLMEngine:
             if not req.out_tokens:
                 raise RuntimeError(
                     f"request {request_id} first token unresolved")
-            blocks = list(req.blocks)
+            # the KV pool covers positions 0..lengths-1; mid-decode the
+            # block list may run ahead of that (decode_block_margin), so
+            # export only the live cover — the destination re-validates
+            # against the same formula
+            live = int(self._lengths[req.slot])
+            nb_live = max(1, math.ceil(live / self.bs))
+            blocks = list(req.blocks)[:nb_live]
             barr = jnp.asarray(np.asarray(blocks, np.int32))
             # one gather program + readback; [L, nb, bs, D]
             k = np.asarray(self.pool["k"][:, barr])
             v = np.asarray(self.pool["v"][:, barr])
+            g = req.gen
             out = {"prompt": list(req.prompt),
                    "first_token": int(req.out_tokens[0]),
-                   "k": k, "v": v, "block_size": self.bs}
+                   "k": k, "v": v, "block_size": self.bs,
+                   "emitted": [int(t) for t in req.out_tokens],
+                   "gen": {"max_new_tokens": g.max_new_tokens,
+                           "temperature": g.temperature,
+                           "top_k": g.top_k, "seed": g.seed,
+                           "stop_token_ids": list(g.stop_token_ids)}}
             req.done = True
             self._free_slot_locked(req)
             del self._requests[request_id]
             return out
 
     def import_request(self, prompt: Sequence[int], first_token: int,
-                       k, v, gen: Optional[GenerationConfig] = None):
+                       k, v, gen: Optional[GenerationConfig] = None,
+                       emitted: Optional[Sequence[int]] = None):
         """Admit a request directly into the decode state from handed-off
-        KV (the decode stage of a disaggregated deployment): allocates
-        pool blocks, scatters the KV in, registers the prompt's chain for
-        prefix sharing, and emits ``first_token`` as the request's first
-        output token.
+        KV: allocates pool blocks, scatters the KV in, registers the
+        prompt's chain for prefix sharing, and resumes decode.  Two
+        callers: the decode stage of a disaggregated deployment
+        (``emitted`` omitted — ``first_token`` is emitted as the
+        request's first output token) and live KV migration (``emitted``
+        is the source's full output history — decode resumes at position
+        prompt+len(emitted)-1 and the history is NOT re-emitted, the
+        source already streamed it).
 
         Returns {request_id, emitted, done} or None when no slot/blocks
         are free right now — the caller falls back to a plain
@@ -1689,15 +1720,24 @@ class PagedJaxLLMEngine:
         plen = len(prompt)
         if plen == 0:
             raise ValueError("empty prompt")
+        if emitted is not None and not emitted:
+            raise ValueError("emitted history must hold >= 1 token")
+        resume = emitted is not None
+        hist = [int(t) for t in emitted] if resume else [int(first_token)]
+        # live positions covered by the handoff KV: prompt plus every
+        # emitted token except the last (whose KV is written by the NEXT
+        # decode step, exactly as in the monolithic flow)
+        live = plen + len(hist) - 1
         if plen + gen.max_new_tokens > self.max_seq:
             raise ValueError(
                 f"prompt ({plen}) + max_new_tokens ({gen.max_new_tokens})"
                 f" exceeds max_seq_len {self.max_seq}")
         nb = int(k.shape[1])
-        if nb != math.ceil(plen / self.bs):
+        if nb != max(1, math.ceil(live / self.bs)):
             raise ValueError(
-                f"handoff covers {nb} blocks but a {plen}-token prompt "
-                f"needs {math.ceil(plen / self.bs)} at block_size {self.bs}")
+                f"handoff covers {nb} blocks but {live} live tokens "
+                f"need {max(1, math.ceil(live / self.bs))} at block_size "
+                f"{self.bs}")
         with self._lock:
             slot = next((s for s in range(self.max_batch)
                          if self._slot_req[s] is None), None)
@@ -1727,7 +1767,7 @@ class PagedJaxLLMEngine:
             self._requests[req.request_id] = req
             self._slot_req[slot] = req
             self.blocks.register(req.prompt, req.blocks)
-            self._lengths[slot] = plen
+            self._lengths[slot] = live
             # seed the DRAFT model's KV for the handed-off prefix by
             # recomputing it at draft size (the handoff carries only the
             # target's KV — draft layers/dims differ, so there is nothing
@@ -1735,27 +1775,35 @@ class PagedJaxLLMEngine:
             # decode at acceptance-rate ~0: the draft's attention span
             # over the prompt would be garbage.  Chunked like ordinary
             # draft prefill; draft-pool exhaustion degrades to plain
-            # decode exactly as elsewhere.
+            # decode exactly as elsewhere.  A mid-decode migration
+            # re-seeds over prompt + history so the draft covers every
+            # live position, not just the prompt.
             if self._spec is not None:
                 req.spec_enabled = True
-                dcover = _prefill_plan(plen, 0, self.config.prefill_chunk,
-                                       self.bs)
+                dseq = list(prompt) + hist[:-1]
+                dcover = _prefill_plan(len(dseq), 0,
+                                       self.config.prefill_chunk, self.bs)
                 dfresh = self.draft_blocks.alloc(dcover + 1)
                 if dfresh is None:
                     req.spec_enabled = False
                 else:
                     req.draft_blocks = dfresh
-                    while req.draft_prefill_pos < plen:
-                        self._draft_prefill_chunk_locked(req)
-            self._next_tok[slot] = first_token
+                    while req.draft_prefill_pos < len(dseq):
+                        self._draft_prefill_chunk_locked(req, seq=dseq)
+            self._next_tok[slot] = hist[-1]
             self._slot_temp[slot] = gen.temperature
             self._slot_topk[slot] = gen.top_k
             self._dirty = True
-            # the prefill stage sampled this token; it counts as output
-            # token #1 exactly as in the monolithic flow
-            self._emit_locked(req, int(first_token))
+            # the source sampled these tokens; they count toward the
+            # output budget exactly as in the monolithic flow.  The
+            # history prefix is pre-seeded WITHOUT emission (a resumed
+            # stream's client already has it); only the last token runs
+            # the emit/done transition.
+            req.out_tokens = hist[:-1]
+            self._emit_locked(req, hist[-1])
             return {"request_id": req.request_id,
-                    "emitted": [int(first_token)], "done": req.done}
+                    "emitted": [] if resume else [int(first_token)],
+                    "done": req.done}
 
     def _emit_snapshot_locked(self) -> Dict[int, int]:
         return {id(r): len(r.out_tokens) for r in self._requests.values()}

@@ -56,6 +56,11 @@ class LLMServer:
         self._engine_order: list = []  # adapter LRU (base never evicted)
         self._adapters: Dict[str, Any] = dict(lora_adapters or {})
         self._engines_lock = threading.Lock()
+        # held by the _run loop across each step + token-apply pair, and
+        # by export/cancel across their engine drain + waiter reconcile:
+        # a drain landing between a step's gather and its apply would
+        # otherwise double-deliver the step's delta (see _reap_drained)
+        self._step_lock = threading.Lock()
         self._cv = threading.Condition()
         self._done: Dict[Any, List[int]] = {}
         self._waiters: Dict[Any, List[int]] = {}
@@ -67,6 +72,15 @@ class LLMServer:
         # must not recreate the popped waiter entry as a leaked _done row
         # (guarded by _cv's lock; bounded by the clear-cap below)
         self._aborted: set = set()
+        # wkeys mid-migration (serve/_private/kv_migration.py): their
+        # engine request is being (or has been) exported away, so the
+        # _run loop must neither re-apply their history nor declare them
+        # done when the rid leaves the engine — the splice relay owns
+        # their buffer lifecycle (guarded by _cv's lock)
+        self._migrating: set = set()
+        # mig_id -> import result memo (idempotent migration retries;
+        # guarded by _cv's lock, bounded)
+        self._mig_imports: Dict[str, Any] = {}
         self._stop = False
         self._error: Optional[BaseException] = None
         self._loop = threading.Thread(target=self._run, daemon=True,
@@ -228,14 +242,30 @@ class LLMServer:
         abandoned mid-decode).  Best-effort: a request that finished in
         the race just cleans its unclaimed buffers."""
         model, gen_id, rid = wkey
+        if model is None:
+            # the cancel's drain resolves the in-flight chunk for EVERY
+            # slot — run it atomically vs the loop's step+apply and
+            # reconcile bystander buffers after (see _reap_drained)
+            with self._step_lock:
+                try:
+                    cancel = getattr(self._engine, "cancel_request", None)
+                    if cancel is not None:
+                        cancel(rid)
+                except Exception:  # noqa: BLE001 — abort must never mask the close
+                    pass
+                with self._cv:
+                    self._waiters.pop(wkey, None)
+                    self._done.pop(wkey, None)
+                    self._aborted.add(wkey)
+                    if len(self._aborted) > 4096:  # backstop
+                        self._aborted.clear()
+                self._reap_drained()
+            return
         try:
-            if model is None:
-                eng = self._engine
-            else:
-                with self._engines_lock:
-                    eng = (self._engines.get(model)
-                           if self._engine_gen.get(model, 0) == gen_id
-                           else None)
+            with self._engines_lock:
+                eng = (self._engines.get(model)
+                       if self._engine_gen.get(model, 0) == gen_id
+                       else None)
             cancel = getattr(eng, "cancel_request", None)
             if cancel is not None:
                 cancel(rid)
@@ -343,34 +373,364 @@ class LLMServer:
                     continue
                 worked = True
                 gen_id = self._engine_gen.get(key, 0)
-                try:
-                    emitted = engine.step()
-                except BaseException as e:  # noqa: BLE001 — fail waiters, not hang
-                    with self._cv:
-                        self._error = e
-                        self._cv.notify_all()
-                    return
-                if emitted:
-                    with self._cv:
-                        for rid, toks in emitted.items():
-                            wk = (key, gen_id, rid)
-                            if wk in self._aborted:
-                                self._aborted.discard(wk)
-                                continue
-                            self._waiters.setdefault(wk, []).extend(toks)
-                        with engine._lock:
-                            live = set(engine._requests)
-                        for wkey in list(self._waiters):
-                            if (wkey[0] == key and wkey[1] == gen_id
-                                    and wkey[2] not in live):
-                                buf = self._waiters.pop(wkey)
-                                if wkey in self._aborted:
-                                    self._aborted.discard(wkey)
-                                else:
-                                    self._done[wkey] = buf
-                        self._cv.notify_all()
+                # step + apply are one atomic unit vs export/cancel
+                # drains: a drain between the step's snapshot-delta
+                # gather and this apply would reconcile the buffer to
+                # full history and then have the stale delta re-appended
+                with self._step_lock:
+                    try:
+                        emitted = engine.step()
+                    except BaseException as e:  # noqa: BLE001 — fail waiters, not hang
+                        with self._cv:
+                            self._error = e
+                            self._cv.notify_all()
+                        return
+                    if emitted:
+                        with self._cv:
+                            for rid, toks in emitted.items():
+                                wk = (key, gen_id, rid)
+                                if wk in self._migrating:
+                                    # an export is reconciling this
+                                    # stream's history into its buffer —
+                                    # these tokens are already part of
+                                    # the handoff
+                                    continue
+                                if wk in self._aborted:
+                                    self._aborted.discard(wk)
+                                    continue
+                                self._waiters.setdefault(wk, []).extend(
+                                    toks)
+                            with engine._lock:
+                                live = set(engine._requests)
+                            for wkey in list(self._waiters):
+                                if (wkey[0] == key and wkey[1] == gen_id
+                                        and wkey[2] not in live
+                                        and wkey not in self._migrating):
+                                    buf = self._waiters.pop(wkey)
+                                    if wkey in self._aborted:
+                                        self._aborted.discard(wkey)
+                                    else:
+                                        self._done[wkey] = buf
+                            self._cv.notify_all()
             if not worked:
                 time.sleep(0.002)
+
+    # -- live KV migration (serve/_private/kv_migration.py) -------------
+    #
+    # A live stream moves between decode replicas in phases: the SOURCE
+    # exports the engine request (export_stream — the slot and KV blocks
+    # free immediately), the handoff travels to the DESTINATION
+    # (import_migration — scatter + draft re-seed, or recompute), and the
+    # source installs a relay (_splice) that keeps feeding the client's
+    # ORIGINAL waiter buffer from the destination's continuation stream
+    # (resume_stream).  The client's _iter_tokens never observes the
+    # switch; the source lingers only as a thin byte relay until its
+    # spliced streams finish — its engine is empty.
+
+    def migratable_streams(self) -> List[int]:
+        """Base-engine request ids currently in the exportable state
+        (prefill complete, >= 1 token emitted).  Adapter streams are not
+        listed — they carry no base-pool KV and resume on a destination
+        by recompute through the planner's recompute path."""
+        eng = self._engine
+        if not hasattr(eng, "export_request"):
+            return []
+        out: List[int] = []
+        with eng._lock:
+            for rid, req in eng._requests.items():
+                if (not req.done and req.slot >= 0
+                        and req.prefill_pos >= len(req.prompt)
+                        and req.out_tokens):
+                    out.append(rid)
+        return out
+
+    def export_stream(self, rid: int) -> Dict[str, Any]:
+        """Source-side migration export: drain + export ``rid`` from the
+        base engine and reconcile the waiter buffer with the handoff's
+        authoritative token history (the export's drain may resolve
+        tokens the _run loop never gathered; marking the wkey migrating
+        first makes the reconcile race-free against the loop).  On ANY
+        failure the stream is healed back to normal operation — tokens
+        re-synced from the engine, migration mark dropped — and the
+        error re-raised for the planner's retry ladder."""
+        wkey = (None, 0, rid)
+        with self._cv:
+            self._migrating.add(wkey)
+        with self._step_lock:
+            try:
+                h = self._engine.export_request(rid)
+            except BaseException:
+                # export refused/died: the request may still be live in
+                # the engine.  Re-sync the waiter buffer from engine
+                # truth (the loop skipped emissions while the wkey was
+                # marked) and hand the stream back to the normal path.
+                with self._cv:
+                    self._migrating.discard(wkey)
+                    if wkey not in self._aborted:
+                        with self._engine._lock:
+                            req = self._engine._requests.get(rid)
+                            hist = (list(req.out_tokens)
+                                    if req is not None and not req.done
+                                    else None)
+                        if hist is not None:
+                            buf = self._waiters.setdefault(wkey, [])
+                            if len(hist) > len(buf):
+                                buf.extend(hist[len(buf):])
+                        self._cv.notify_all()
+                self._reap_drained()  # rid, if the drain completed it
+                raise
+            h["model"] = None
+            with self._cv:
+                if wkey in self._aborted:
+                    # client vanished during the export — nothing to
+                    # splice
+                    self._migrating.discard(wkey)
+                else:
+                    buf = self._waiters.setdefault(wkey, [])
+                    if len(h["emitted"]) > len(buf):
+                        buf.extend(h["emitted"][len(buf):])
+                        self._cv.notify_all()
+            # OTHER streams: the drain resolved their in-flight chunk
+            # (and may have completed some) — reconcile before the loop
+            # resumes stepping
+            self._reap_drained()
+        return h
+
+    def _reap_drained(self) -> None:
+        """Reconcile waiter buffers after an export/cancel drain.  The
+        drain resolves the in-flight decode chunk for EVERY slot, and
+        ``step()`` reports tokens as a snapshot delta taken at step
+        entry — tokens a drain appended to ``out_tokens`` are invisible
+        to all future deltas, so without this sync bystander streams
+        silently lose one chunk.  A waiter buffer is always a prefix of
+        its request's ``out_tokens`` (both are append-only, the loop
+        extends from snapshot diffs), so topping up is bit-exact.
+        Requests the drain COMPLETED are also moved to done here: once
+        every slot is free ``has_work`` goes false and the loop would
+        never gather them, hanging their consumers.  Mid-migration wkeys
+        are skipped (their splice relay owns the buffer); aborted wkeys
+        just clear their mark."""
+        with self._cv:
+            with self._engine._lock:
+                dead, live = [], []
+                for rid, req in list(self._engine._requests.items()):
+                    wk = (None, 0, rid)
+                    if wk in self._migrating:
+                        continue
+                    if req.done:
+                        dead.append((wk, list(req.out_tokens)))
+                        del self._engine._requests[rid]
+                    elif req.out_tokens:
+                        live.append((wk, list(req.out_tokens)))
+            for wk, hist in live:
+                if wk in self._aborted:
+                    continue
+                buf = self._waiters.setdefault(wk, [])
+                if len(hist) > len(buf):
+                    buf.extend(hist[len(buf):])
+            for wk, hist in dead:
+                if wk in self._aborted:
+                    self._aborted.discard(wk)
+                    self._waiters.pop(wk, None)
+                    continue
+                buf = self._waiters.setdefault(wk, [])
+                if len(hist) > len(buf):
+                    buf.extend(hist[len(buf):])
+                self._done[wk] = self._waiters.pop(wk)
+            if dead or live:
+                self._cv.notify_all()
+
+    @staticmethod
+    def _handoff_gen(handoff: Dict[str, Any],
+                     max_new_tokens: Optional[int] = None):
+        g = handoff["gen"]
+        return GenerationConfig(
+            max_new_tokens=(g["max_new_tokens"] if max_new_tokens is None
+                            else max_new_tokens),
+            temperature=g["temperature"], top_k=g["top_k"],
+            seed=g.get("seed", 0),
+            stop_token_ids=tuple(g["stop_token_ids"]))
+
+    def import_migration(self, handoff: Dict[str, Any],
+                         allow_recompute: bool = False):
+        """Destination-side migration import.  Tries the exact-resume KV
+        import first (zero recompute); ``allow_recompute`` falls back to
+        re-prefilling prompt + history as a fresh request with the
+        remaining token budget (bit-equal for greedy decode — emitted
+        history is never re-emitted either way).  Returns
+        {wkey, done, mode} or None when this replica can't take the
+        stream right now (no slot / no blocks) — the planner tries the
+        next candidate.
+
+        Idempotent under retry: the handoff's ``mig_id`` keys a bounded
+        result memo, so a planner retrying after a lost reply gets the
+        FIRST import's stream back instead of forking a duplicate."""
+        mig_id = handoff.get("mig_id")
+        if mig_id is not None:
+            with self._cv:
+                prev = self._mig_imports.get(mig_id)
+            if prev is not None:
+                return prev
+        model = handoff.get("model")
+        emitted = [int(t) for t in handoff["emitted"]]
+        res = None
+        if (not model and handoff.get("k") is not None
+                and hasattr(self._engine, "import_request")):
+            try:
+                res = self._engine.import_request(
+                    handoff["prompt"], handoff["first_token"],
+                    handoff["k"], handoff["v"], self._handoff_gen(handoff),
+                    emitted=emitted)
+            except ValueError:
+                # geometry mismatch (block size / max_seq) — recompute
+                # is the only road
+                res = None
+        if res is not None:
+            wkey = (None, 0, res["request_id"])
+            out = {"wkey": list(wkey), "done": bool(res["done"]),
+                   "mode": "import"}
+            with self._cv:
+                self._active_waiters.add(wkey)
+                if res["done"]:
+                    # budget/stop boundary hit exactly at the handoff:
+                    # the continuation stream is empty but must exist
+                    self._done[wkey] = []
+                    self._cv.notify_all()
+                self._memo_import_locked(mig_id, out)
+            return out
+        if not allow_recompute:
+            return None
+        out = self._recompute_resume(model, handoff)
+        if out is not None:
+            with self._cv:
+                self._memo_import_locked(mig_id, out)
+        return out
+
+    def _memo_import_locked(self, mig_id, result) -> None:
+        if mig_id is None:
+            return
+        self._mig_imports[mig_id] = result
+        while len(self._mig_imports) > 1024:  # bounded retry memo
+            self._mig_imports.pop(next(iter(self._mig_imports)))
+
+    def _recompute_resume(self, model: Optional[str],
+                          handoff: Dict[str, Any]):
+        """Resume a migrated stream WITHOUT its KV: re-prefill
+        prompt + emitted history as a fresh request whose budget is the
+        remaining tokens (PR 7's degraded-handoff path; the prefix cache
+        usually absorbs most of the re-prefill).  History is the new
+        prompt's tail, so nothing is ever re-emitted."""
+        hist = [int(t) for t in handoff["emitted"]]
+        g = handoff["gen"]
+        remaining = int(g["max_new_tokens"]) - len(hist)
+        if remaining <= 0 or (hist and hist[-1] in g["stop_token_ids"]):
+            return {"wkey": None, "done": True, "mode": "recompute"}
+        gen = self._handoff_gen(handoff, max_new_tokens=remaining)
+        wkey = self._submit(model, list(handoff["prompt"]) + hist, gen)
+        with self._cv:
+            self._active_waiters.add(wkey)
+        return {"wkey": list(wkey), "done": False, "mode": "recompute"}
+
+    def resume_stream(self, wkey):
+        """Destination-side continuation stream for a migrated-in
+        request: yields only tokens decoded AFTER the handoff point
+        (the source already streamed the history)."""
+        yield from self._iter_tokens(tuple(wkey))
+
+    def cancel_stream(self, wkey) -> None:
+        """Abort a migrated-in stream (the source's client vanished, or
+        a splice fallback abandoned this destination)."""
+        self._abort_wkey(tuple(wkey))
+
+    def _splice(self, rid: int, pull, cancel_remote,
+                handoff: Dict[str, Any]) -> threading.Thread:
+        """Install the waiter-splice for an exported stream: a relay
+        thread feeds the client's ORIGINAL waiter buffer (old wkey) from
+        ``pull`` — an iterator of continuation chunks from the migration
+        destination (or a local restore).  If the destination dies
+        mid-relay, the stream degrades once to local recompute from
+        prompt + delivered history (the survivor in that case is this
+        replica): zero client-visible drops, at re-prefill cost."""
+        wkey = (None, 0, rid)
+        hist = [int(t) for t in handoff["emitted"]]
+        g = dict(handoff["gen"])
+
+        def run():
+            from ray_tpu._private import runtime_metrics
+
+            it = pull
+            fell_back = False
+            while True:
+                try:
+                    for chunk in it:
+                        toks = [int(t) for t in chunk]
+                        with self._cv:
+                            if wkey in self._aborted:
+                                for fn in (getattr(it, "close", None),
+                                           cancel_remote):
+                                    try:
+                                        if fn is not None:
+                                            fn()
+                                    except Exception:  # noqa: BLE001 — abort cleanup is best-effort
+                                        pass
+                                self._migrating.discard(wkey)
+                                return
+                            hist.extend(toks)
+                            self._waiters.setdefault(wkey, []).extend(toks)
+                            self._cv.notify_all()
+                    break  # destination stream completed cleanly
+                except Exception:  # noqa: BLE001 — dest died mid-relay: degrade, don't drop
+                    if fell_back:
+                        break  # local fallback failed too: terminate below
+                    fell_back = True
+                    runtime_metrics.record_kv_migration(
+                        handoff.get("reason", "manual"), "fallback")
+                    remaining = int(g["max_new_tokens"]) - len(hist)
+                    if remaining <= 0:
+                        break
+                    try:
+                        new_wkey = self._submit(
+                            None, list(handoff["prompt"]) + hist,
+                            self._handoff_gen(handoff,
+                                              max_new_tokens=remaining))
+                    except Exception:  # noqa: BLE001 — even local admission failed
+                        break
+                    it = self._iter_tokens(new_wkey)
+            with self._cv:
+                self._migrating.discard(wkey)
+                if wkey not in self._aborted:
+                    self._done[wkey] = self._waiters.pop(wkey, [])
+                    self._cv.notify_all()
+
+        t = threading.Thread(target=run, daemon=True,
+                             name="kv-migration-splice")
+        t.start()
+        return t
+
+    def _finish_migrated(self, rid: int) -> None:
+        """Terminate an exported stream whose continuation is EMPTY (the
+        budget/stop boundary landed exactly on the handoff): the waiter
+        buffer already holds the full history, so just finish it."""
+        wkey = (None, 0, rid)
+        with self._cv:
+            self._migrating.discard(wkey)
+            if wkey not in self._aborted:
+                self._done[wkey] = self._waiters.pop(wkey, [])
+                self._cv.notify_all()
+
+    def evacuate_streams(self, dests=None, reason: str = "drain",
+                         max_streams: Optional[int] = None,
+                         dest_servers=None) -> Dict[str, int]:
+        """Migrate this server's live base-engine streams to ``dests``
+        (replica actor-id hexes; ``dest_servers`` takes in-process
+        LLMServer objects for local mode and tests).  The planner's
+        entry point for drain evacuation and rebalancing; every stream
+        survives — worst case it stays here via local restore."""
+        from ray_tpu.serve._private import kv_migration
+
+        return kv_migration.evacuate(self, dests or [], reason=reason,
+                                     max_streams=max_streams,
+                                     dest_servers=dest_servers)
 
     def shutdown(self):
         self._stop = True

@@ -89,6 +89,12 @@ class ServeController:
         self._pool_autoscaler = PoolAutoscaler(
             actuate=self._scale_by_name, current=self._replicas_by_name,
             headroom_source=utilization_headroom)
+        # live KV migration (serve/_private/kv_migration.py): the drain
+        # path evacuates streams to survivors instead of waiting them
+        # out, and the reconcile tick runs the queue-depth rebalance
+        from ray_tpu.serve._private.kv_migration import MigrationPlanner
+
+        self._migration = MigrationPlanner(submit=self._start_pool.submit)
         if self._pool_autoscaler.enabled:
             try:
                 from ray_tpu._private.worker import get_global_worker
@@ -295,9 +301,24 @@ class ServeController:
                 self._reconcile()
                 self._autoscale()
                 self._pool_autoscaler.tick()
+                self._rebalance_tick()
             except Exception:  # noqa: BLE001
                 logger.exception("serve reconcile error")
             time.sleep(0.1)
+
+    def _rebalance_tick(self):
+        """Queue-depth-divergence rebalance (kv_migration.MigrationPlanner):
+        paced internally to 1 Hz, hysteresis and the per-replica rate cap
+        live in the planner.  The snapshot copy keeps the lock hold
+        trivial; the planner's RPCs all run off this thread's lock."""
+        if not self._migration.enabled:
+            return
+        with self._lock:
+            snapshot = {(app, dep): [r["h"] for r in recs]
+                        for app, deps in self._replicas.items()
+                        for dep, recs in deps.items() if len(recs) >= 2}
+        if snapshot:
+            self._migration.rebalance_tick(snapshot)
 
     def _reconcile(self):
         import ray_tpu
@@ -500,7 +521,16 @@ class ServeController:
         digest-TTL window) — and AGAIN after the kill (the replica's publish
         thread keeps running through the drain and would otherwise re-create
         the row as its last in-flight requests change the depth, orphaning
-        one KV row per drained replica forever)."""
+        one KV row per drained replica forever).
+
+        Migrate-first (serve/_private/kv_migration.py): when the
+        deployment still has live replicas, each draining replica is
+        asked — off this thread; the caller holds the lock — to evacuate
+        its in-flight decode streams onto the survivors before the
+        wait-out drain runs its course.  The drain machinery itself is
+        unchanged: an evacuated replica reaches queue_len 0 in seconds
+        instead of after its longest generation, which is what makes the
+        pool autoscaler's scale-down fast."""
         now = time.monotonic()
         keys = {}
         if app is not None and dep is not None:
@@ -516,6 +546,13 @@ class ServeController:
             [r["h"], now + float(r.get("grace", 20.0)), 0, keys.get(id(r))]
             for r in recs)
         self._del_digest_rows(keys.values())
+        if app is not None and dep is not None and self._migration.enabled:
+            survivors = [s["h"]._actor_id.hex()
+                         for s in self._replicas.get(app, {}).get(dep, [])]
+            if survivors:
+                self._start_pool.submit(
+                    self._migration.evacuate_replicas, app, dep,
+                    [r["h"] for r in recs], survivors)
 
     @staticmethod
     def _del_digest_rows(keys):

@@ -50,6 +50,18 @@ def digest_kv_key(app: str, deployment: str, actor_hex: str) -> str:
     return f"{DIGEST_KV_PREFIX}{app}:{deployment}:{actor_hex}"
 
 
+# GCS KV namespace for replicas mid-evacuation (the KV-migration planner
+# writes a row at evacuation start and deletes it when the replica's
+# streams have moved): routers consult it so a migration pause is never
+# booked as a death (mark_dead), and new prompts stop routing to the
+# evacuating replica (its digest row is deleted alongside)
+MIGRATING_KV_PREFIX = "servemig:"
+
+
+def migration_kv_key(app: str, deployment: str, actor_hex: str) -> str:
+    return f"{MIGRATING_KV_PREFIX}{app}:{deployment}:{actor_hex}"
+
+
 def _extract_prompt(args: tuple, kwargs: dict):
     """(prompt_token_ids | None, model_id | None) from a handle call.
 
@@ -146,6 +158,12 @@ class _Router:
         # dead replica stays the digest winner and every resubmit would
         # re-route straight back to it.
         self._dead: Dict[str, float] = {}
+        # replicas marked mid-evacuation by the KV-migration planner
+        # (servemig:* rows): consulted by mark_dead so a deliberate
+        # migration pause is never booked as a death (TTL-cached; only
+        # fetched when a caller actually reports a death)
+        self._migrating: set = set()
+        self._migrating_ts = float("-inf")
 
     def _refresh(self):
         import ray_tpu
@@ -360,14 +378,45 @@ class _Router:
     def mark_dead(self, replica):
         """A caller saw this replica die mid-call: exclude it from routing
         until the controller's live set reflects the death (the marks
-        self-expire, so a restarted actor id isn't shunned forever)."""
+        self-expire, so a restarted actor id isn't shunned forever).
+
+        Deliberate evacuation is NOT death: a replica mid-KV-migration
+        pauses its streams long enough for a caller to misread the stall,
+        and booking the 30 s shun would blackhole a healthy replica (it
+        serves again the moment the handoff completes).  The migration
+        planner marks evacuating replicas in the GCS KV (servemig:*);
+        marked replicas skip the shun — the probe cache is still dropped,
+        since a paused replica's cached depth is stale either way."""
         try:
             hex_ = replica._actor_id.hex()
         except AttributeError:
             return
+        migrating = hex_ in self._fetch_migrating()
         with self._lock:
-            self._dead[hex_] = time.monotonic()
+            if not migrating:
+                self._dead[hex_] = time.monotonic()
             self._qcache.pop(hex_, None)
+
+    def _fetch_migrating(self) -> set:
+        """TTL-cached set of this deployment's replicas currently marked
+        evacuating (``servemig:`` rows written by the KV-migration
+        planner).  Only consulted from mark_dead, so the fetch stays off
+        the per-request routing path."""
+        now = time.monotonic()
+        if now - self._migrating_ts < 2.0:
+            return self._migrating
+        self._migrating_ts = now
+        try:
+            from ray_tpu._private.worker import get_global_worker
+
+            gcs = get_global_worker().gcs
+            prefix = f"{MIGRATING_KV_PREFIX}{self._app}:{self._dep}:"
+            keys = gcs.call("KVKeys", {"prefix": prefix},
+                            timeout=2, retry_deadline=0.0) or []
+            self._migrating = {k[len(prefix):] for k in keys}
+        except Exception:  # noqa: BLE001 — no GCS (local mode): nothing is marked
+            self._migrating = set()
+        return self._migrating
 
     def invalidate(self):
         with self._lock:

@@ -1,0 +1,693 @@
+"""Live KV migration (ISSUE 19): evacuate / rebalance decode replicas
+without killing a single stream.
+
+Tier-1 pins:
+  - engine mid-decode export/import: bit-equal greedy resume at the
+    exact position, source slot + blocks freed at export, handoff
+    covers exactly the live block cover;
+  - server-level forced migration of an ACTIVE stream: the consumer
+    iterating generate_stream observes zero interruption and bit-equal
+    output while the stream moves to another in-process server;
+  - chaos (testing_migration_fault): a fault injected at every phase
+    (export / transfer / import / splice) degrades to
+    outcome="fallback" with zero client-visible drops;
+  - drain evacuation under many live streams: every stream survives,
+    bit-equal;
+  - destination death mid-relay: the splice degrades once to local
+    recompute from prompt + delivered history;
+  - import idempotency: a retried handoff (same mig_id) returns the
+    FIRST import's stream instead of forking a duplicate;
+  - mark_dead migration exemption (handle.py): death shuns for 30 s,
+    deliberate evacuation does not;
+  - planner mechanics: evacuate_replicas deletes the victim's digest
+    row at evacuation start (warm prompts route to the destination),
+    rebalance hysteresis needs N consecutive diverged ticks, and the
+    per-replica token bucket caps the exit rate.
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu._private import runtime_metrics
+from ray_tpu._private.config import global_config
+from ray_tpu.llm import GenerationConfig, LLMConfig, PagedJaxLLMEngine
+from ray_tpu.llm.serve import LLMServer
+from ray_tpu.models.llama import LlamaConfig, init_params
+from ray_tpu.serve._private import kv_migration
+
+# fp32 micro model (same rationale as test_specdec.py: resume parity
+# must not hinge on bf16 rounding order)
+_CFG_KW = dict(vocab_size=64, dim=64, n_layers=2, n_heads=2, n_kv_heads=2,
+               ffn_dim=128, max_seq_len=96, compute_dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def tiny_cfg():
+    return LlamaConfig.tiny(**_CFG_KW)
+
+
+@pytest.fixture(scope="module")
+def tiny_params(tiny_cfg):
+    return init_params(tiny_cfg, jax.random.PRNGKey(0))
+
+
+def _lcfg(cfg, **kw):
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("max_seq_len", 96)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("prefill_chunk", 16)
+    kw.setdefault("decode_chunk", 4)
+    return LLMConfig(model_config=cfg, **kw)
+
+
+def _gen(**kw):
+    kw.setdefault("max_new_tokens", 10)
+    return GenerationConfig(**kw)
+
+
+def _prompts(lens, seed=3):
+    rs = np.random.RandomState(seed)
+    return [list(rs.randint(1, 63, size=n)) for n in lens]
+
+
+@pytest.fixture(scope="module")
+def ref_engine(tiny_cfg, tiny_params):
+    return PagedJaxLLMEngine(_lcfg(tiny_cfg), params=tiny_params)
+
+
+@pytest.fixture(scope="module")
+def servers(tiny_cfg, tiny_params):
+    """A source/destination LLMServer pair, reused across tests (every
+    migration test leaves both engines idle)."""
+    src = LLMServer(_lcfg(tiny_cfg), params=tiny_params)
+    dst = LLMServer(_lcfg(tiny_cfg), params=tiny_params)
+    yield src, dst
+    src.shutdown()
+    dst.shutdown()
+
+
+def _snapshot():
+    return runtime_metrics.kv_migration_snapshot()
+
+
+def _outcome_delta(before, after):
+    out = {}
+    for k, v in after["outcomes"].items():
+        d = v - before["outcomes"].get(k, 0.0)
+        if d:
+            out[k] = d
+    return out
+
+
+def _consume(server, prompt, collected, done_evt, **kw):
+    """Consumer thread body: iterate generate_stream into ``collected``."""
+    def run():
+        try:
+            for chunk in server.generate_stream(prompt, **kw):
+                collected.extend(chunk)
+        finally:
+            done_evt.set()
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t
+
+
+def _wait_tokens(collected, n, timeout=60.0):
+    deadline = time.monotonic() + timeout
+    while len(collected) < n:
+        assert time.monotonic() < deadline, (
+            f"stream stalled at {len(collected)}/{n} tokens")
+        time.sleep(0.005)
+
+
+class _slow_steps:
+    """Throttle an engine's step so a forced migration deterministically
+    catches the stream MID-decode: a warm micro-engine steps in well
+    under a millisecond and would otherwise race the test to the budget
+    boundary."""
+
+    def __init__(self, server, delay=0.03):
+        self._eng = server._engine
+        self._delay = delay
+
+    def __enter__(self):
+        orig = type(self._eng).step
+        eng, delay = self._eng, self._delay
+
+        def slow(decode=True):
+            time.sleep(delay)
+            return orig(eng, decode)
+
+        eng.step = slow
+        return self
+
+    def __exit__(self, *exc):
+        del self._eng.step
+
+
+class _frozen_loop:
+    """Freeze a server's decode loop (it takes _engines_lock every
+    iteration) so a forced migration deterministically catches the
+    stream MID-decode — at most one in-flight step plus the export's
+    drain can still resolve.  Nothing on the migration path takes
+    _engines_lock for base-engine streams, so the evacuation proceeds
+    while the loop is parked."""
+
+    def __init__(self, server):
+        self._server = server
+
+    def __enter__(self):
+        self._server._engines_lock.acquire()
+
+    def __exit__(self, *exc):
+        self._server._engines_lock.release()
+
+
+# -- engine layer ------------------------------------------------------------
+
+
+@pytest.mark.timeout(240)
+def test_engine_middecode_export_import_bit_equal(tiny_cfg, tiny_params,
+                                                  ref_engine):
+    """The tentpole's engine contract: export mid-decode (slot + blocks
+    free immediately, handoff covers exactly the live block cover),
+    import resumes at the exact position with the history NOT
+    re-emitted, and the stitched output is bit-equal to an unmigrated
+    greedy decode."""
+    prompt = _prompts([21], seed=11)[0]
+    want = ref_engine.generate([prompt], _gen(max_new_tokens=12))[0]
+
+    src = PagedJaxLLMEngine(_lcfg(tiny_cfg), params=tiny_params)
+    free0 = src.blocks.num_free()
+    rid = src.add_request(prompt, _gen(max_new_tokens=12))
+    emitted = []
+    while len(emitted) < 5:
+        for _rid, toks in src.step().items():
+            emitted.extend(toks)
+    h = src.export_request(rid)
+
+    # the handoff's history extends what the step loop already gathered
+    assert h["emitted"][:len(emitted)] == emitted
+    # live cover only: prompt + history minus the last token (its KV is
+    # written by the NEXT decode step)
+    live = len(prompt) + len(h["emitted"]) - 1
+    nb = max(1, -(-live // 8))
+    assert h["k"].shape[1] == nb and h["v"].shape[1] == nb
+    # source forgot the request and its resources are back in the pool
+    with src._lock:
+        assert rid not in src._requests
+        assert all(r is None for r in src._slot_req)
+    assert src.blocks.num_free() == free0
+
+    dst = PagedJaxLLMEngine(_lcfg(tiny_cfg), params=tiny_params)
+    res = dst.import_request(h["prompt"], h["first_token"], h["k"], h["v"],
+                             _gen(max_new_tokens=12), emitted=h["emitted"])
+    assert res is not None
+    # resume mode: history is never re-delivered
+    assert res["emitted"] == []
+    toks = list(h["emitted"])
+    while dst.has_work():
+        for _rid, t in dst.step().items():
+            toks.extend(t)
+    for _rid, t in dst.flush().items():
+        toks.extend(t)
+    assert toks == want
+
+
+def test_engine_import_validates_block_cover(tiny_cfg, tiny_params):
+    """A handoff whose KV doesn't cover the live positions is refused
+    loudly (geometry error), not scattered as garbage."""
+    prompt = _prompts([17], seed=12)[0]
+    src = PagedJaxLLMEngine(_lcfg(tiny_cfg), params=tiny_params)
+    rid = src.add_request(prompt, _gen(max_new_tokens=16))
+    emitted = []
+    while len(emitted) < 4:
+        for _rid, toks in src.step().items():
+            emitted.extend(toks)
+    h = src.export_request(rid)
+    dst = PagedJaxLLMEngine(_lcfg(tiny_cfg), params=tiny_params)
+    with pytest.raises(ValueError, match="blocks"):
+        dst.import_request(h["prompt"], h["first_token"],
+                           h["k"][:, :1], h["v"][:, :1],
+                           _gen(max_new_tokens=16), emitted=h["emitted"])
+
+
+# -- server layer: the tier-1 acceptance -------------------------------------
+
+
+@pytest.mark.timeout(240)
+def test_server_forced_middecode_migration_zero_interruption(
+        servers, ref_engine):
+    """A consumer iterating generate_stream sees bit-equal output with
+    zero interruption while the stream is forcibly migrated mid-decode
+    to another server; the source's engine slot and blocks free; both
+    new metric families book."""
+    src, dst = servers
+    prompt = _prompts([19], seed=21)[0]
+    want = ref_engine.generate([prompt], _gen(max_new_tokens=24))[0]
+    before = _snapshot()
+
+    collected, done = [], threading.Event()
+    with _slow_steps(src):
+        t = _consume(src, prompt, collected, done, max_new_tokens=24)
+        _wait_tokens(collected, 3)
+
+        with _frozen_loop(src):
+            out = src.evacuate_streams(dest_servers=[dst])
+    assert out == {"migrated": 1, "fallback": 0, "skipped": 0}
+
+    assert done.wait(120), "migrated stream never finished"
+    t.join(5)
+    assert collected == want
+
+    # source engine is empty (slot freed at export)
+    with src._engine._lock:
+        assert not src._engine._requests
+        assert all(r is None for r in src._engine._slot_req)
+
+    after = _snapshot()
+    assert _outcome_delta(before, after) == {("drain", "migrated"): 1.0}
+    for phase in ("export", "transfer", "import", "splice", "total"):
+        d = (after["phases"].get(phase, {}).get("count", 0)
+             - before["phases"].get(phase, {}).get("count", 0))
+        assert d >= 1, f"phase {phase} booked no latency point"
+
+
+@pytest.mark.timeout(240)
+def test_export_drain_preserves_bystander_streams(servers, ref_engine):
+    """Migrating ONE stream must not cost its batch-mates a token: the
+    export's drain resolves the in-flight decode chunk for EVERY slot,
+    and step() reports snapshot deltas — without the post-drain
+    reconcile (paired with _step_lock) bystanders silently lose that
+    chunk and their streams complete short with a hole in the middle."""
+    src, dst = servers
+    prompts = _prompts([11, 14, 17], seed=77)
+    # budget 40: the earliest-admitted stream runs ~3 steps ahead of the
+    # last one's 2nd token, and freeze + export still resolve up to two
+    # more chunks — the victim must stay well inside its budget
+    wants = [ref_engine.generate([p], _gen(max_new_tokens=40))[0]
+             for p in prompts]
+
+    cols = [[] for _ in prompts]
+    dones = [threading.Event() for _ in prompts]
+    with _slow_steps(src):
+        threads = [_consume(src, p, c, d, max_new_tokens=40)
+                   for p, c, d in zip(prompts, cols, dones)]
+        for c in cols:
+            _wait_tokens(c, 2)
+        with _frozen_loop(src):
+            rids = src.migratable_streams()
+            assert len(rids) == 3
+            out = kv_migration.migrate_stream(
+                src, rids[0], [kv_migration.LocalDest(dst)],
+                reason="manual")
+    assert out == "migrated"
+    for d in dones:
+        assert d.wait(120), "a stream never finished"
+    for t in threads:
+        t.join(5)
+    # the migrated stream AND both bystanders are bit-equal — the
+    # drained chunk reached every waiter exactly once
+    assert cols == wants
+
+    with src._engine._lock:
+        assert len(src._engine._requests) == 0
+
+
+@pytest.mark.timeout(240)
+@pytest.mark.parametrize("fault", [
+    "export:fail", "transfer:fail", "import:fail", "import:refuse",
+    "splice:fail"])
+def test_chaos_fault_every_phase_falls_back_zero_drops(
+        servers, ref_engine, fault):
+    """testing_migration_fault at each phase: the migration books
+    outcome="fallback" and the client stream still completes bit-equal
+    — the stream either keeps decoding on the source (export fault) or
+    comes back via local restore (every later phase)."""
+    src, dst = servers
+    prompt = _prompts([15], seed=hash(fault) % 1000)[0]
+    want = ref_engine.generate([prompt], _gen(max_new_tokens=32))[0]
+    before = _snapshot()
+
+    collected, done = [], threading.Event()
+    cfg = global_config()
+    with _slow_steps(src):
+        t = _consume(src, prompt, collected, done, max_new_tokens=32)
+        _wait_tokens(collected, 2)
+
+        cfg.testing_migration_fault = fault
+        try:
+            with _frozen_loop(src):
+                out = src.evacuate_streams(dest_servers=[dst])
+        finally:
+            cfg.testing_migration_fault = ""
+    assert out == {"migrated": 0, "fallback": 1, "skipped": 0}
+
+    assert done.wait(120), f"stream never finished under {fault}"
+    t.join(5)
+    assert collected == want, f"dropped/corrupted tokens under {fault}"
+    assert _outcome_delta(before, _snapshot()) == {("drain", "fallback"): 1.0}
+
+
+@pytest.mark.timeout(600)
+def test_drain_evacuation_many_live_streams_zero_drops(
+        tiny_cfg, tiny_params):
+    """Migrate-first drain under a full engine of live streams: every
+    stream survives bit-equal (migrated or local-restored — never
+    lost)."""
+    n = 32
+    cfg = _lcfg(tiny_cfg, max_batch_size=n)
+    ref = PagedJaxLLMEngine(cfg, params=tiny_params)
+    prompts = _prompts(list(range(4, 4 + n)), seed=5)
+    wants = ref.generate(prompts, _gen(max_new_tokens=16))
+
+    src = LLMServer(cfg, params=tiny_params)
+    dst = LLMServer(cfg, params=tiny_params)
+    try:
+        cols = [[] for _ in range(n)]
+        evts = [threading.Event() for _ in range(n)]
+        with _slow_steps(src, delay=0.01):
+            threads = [
+                _consume(src, prompts[i], cols[i], evts[i],
+                         max_new_tokens=16)
+                for i in range(n)]
+            for c in cols:
+                _wait_tokens(c, 1, timeout=240)
+
+            with _frozen_loop(src):
+                out = src.evacuate_streams(dest_servers=[dst])
+        # short-budget streams may finish during the sweep ("skipped");
+        # nothing may be lost
+        assert out["migrated"] + out["fallback"] + out["skipped"] > 0
+        assert sum(out.values()) == sum(
+            out.get(k, 0) for k in ("migrated", "fallback", "skipped"))
+
+        for i, (evt, t) in enumerate(zip(evts, threads)):
+            assert evt.wait(240), f"stream {i} never finished"
+            t.join(5)
+        assert cols == wants
+        with src._engine._lock:
+            assert not src._engine._requests, "drain left live source slots"
+    finally:
+        src.shutdown()
+        dst.shutdown()
+
+
+@pytest.mark.timeout(240)
+def test_splice_dest_death_midrelay_degrades_to_local_recompute(
+        servers, ref_engine):
+    """The destination dies AFTER a clean import, mid-relay: the splice
+    degrades once to local recompute from prompt + delivered history —
+    zero client-visible drops, one extra fallback booked by the relay."""
+    src, dst = servers
+    prompt = _prompts([13], seed=41)[0]
+    want = ref_engine.generate([prompt], _gen(max_new_tokens=32))[0]
+    before = _snapshot()
+
+    class DyingDest(kv_migration.LocalDest):
+        """Imports cleanly, then the continuation stream dies after the
+        first relayed chunk."""
+
+        def resume_iter(self, wkey):
+            inner = super().resume_iter(wkey)
+
+            def gen():
+                yield next(inner)
+                inner.close()
+                raise RuntimeError("destination replica died mid-relay")
+            return gen()
+
+    collected, done = [], threading.Event()
+    with _slow_steps(src):
+        t = _consume(src, prompt, collected, done, max_new_tokens=32)
+        _wait_tokens(collected, 2)
+
+        with _frozen_loop(src):
+            rids = src.migratable_streams()
+            assert len(rids) == 1
+            outcome = kv_migration.migrate_stream(src, rids[0],
+                                                  [DyingDest(dst)])
+    assert outcome == "migrated"  # the phase machine saw a clean splice
+
+    assert done.wait(120), "stream never finished after dest death"
+    t.join(5)
+    assert collected == want
+
+    delta = _outcome_delta(before, _snapshot())
+    # one clean migration booked by the phase machine, one fallback
+    # booked by the relay when the destination died
+    assert delta == {("manual", "migrated"): 1.0,
+                     ("manual", "fallback"): 1.0}
+
+
+@pytest.mark.timeout(240)
+def test_import_is_idempotent_under_mig_id_retry(servers, ref_engine):
+    """A planner retrying a lost import reply must get the FIRST
+    import's stream back (mig_id memo) — never a duplicated decode."""
+    src, dst = servers
+    prompt = _prompts([12], seed=51)[0]
+    want = ref_engine.generate([prompt], _gen(max_new_tokens=24))[0]
+
+    collected, done = [], threading.Event()
+    with _slow_steps(src):
+        t = _consume(src, prompt, collected, done, max_new_tokens=24)
+        _wait_tokens(collected, 2)
+        with _frozen_loop(src):
+            rids = src.migratable_streams()
+            h = src.export_stream(rids[0])
+    h["mig_id"] = "retry-test-1"
+
+    with dst._engine._lock:
+        n0 = len(dst._engine._requests)
+    r1 = dst.import_migration(dict(h))
+    r2 = dst.import_migration(dict(h))  # the retry
+    assert r1 is not None and r2 == r1
+    with dst._engine._lock:
+        assert len(dst._engine._requests) <= n0 + 1, (
+            "retry forked the stream")
+
+    # finish the client stream through the normal splice
+    src._splice(rids[0], dst.resume_stream(r1["wkey"]),
+                lambda: dst.cancel_stream(r1["wkey"]), h)
+    assert done.wait(120)
+    t.join(5)
+    assert collected == want
+
+
+def test_recompute_resume_exact_budget_boundary(servers):
+    """A handoff whose history already exhausts the budget (or ends on a
+    stop token) resumes as an empty, already-done continuation — not a
+    negative-budget submit."""
+    src, _dst = servers
+    handoff = {"model": None, "prompt": [1, 2, 3], "first_token": 7,
+               "emitted": [7, 8, 9], "mig_id": None,
+               "gen": {"max_new_tokens": 3, "temperature": 0.0,
+                       "top_k": 0, "seed": 0, "stop_token_ids": []}}
+    out = src.import_migration(handoff, allow_recompute=True)
+    assert out == {"wkey": None, "done": True, "mode": "recompute"}
+    stopped = dict(handoff)
+    stopped["gen"] = dict(handoff["gen"], max_new_tokens=10,
+                          stop_token_ids=[9])
+    out = src.import_migration(stopped, allow_recompute=True)
+    assert out == {"wkey": None, "done": True, "mode": "recompute"}
+
+
+# -- handle.py: mark_dead migration exemption (satellite) --------------------
+
+
+class _FakeId:
+    def __init__(self, hex_):
+        self._hex = hex_
+
+    def hex(self):
+        return self._hex
+
+
+class _FakeReplica:
+    def __init__(self, hex_):
+        self._actor_id = _FakeId(hex_)
+
+
+def test_mark_dead_shuns_death_but_not_migration(monkeypatch):
+    """Death books the 30 s shun; a replica marked evacuating
+    (servemig:* row) does NOT get shunned — it serves again the moment
+    the handoff completes.  Both drop the stale probe-cache entry."""
+    import ray_tpu.serve.handle as H
+
+    r = H._Router("app", "dep")
+    monkeypatch.setattr(r, "_fetch_migrating", lambda: {"bb"})
+    r._qcache = {"aa": (3, time.monotonic()), "bb": (3, time.monotonic())}
+
+    r.mark_dead(_FakeReplica("aa"))
+    assert "aa" in r._dead and "aa" not in r._qcache
+
+    r.mark_dead(_FakeReplica("bb"))
+    assert "bb" not in r._dead, "migration-paused replica was shunned"
+    assert "bb" not in r._qcache, "stale depth survived the pause"
+
+
+def test_router_fetch_migrating_reads_servemig_rows(monkeypatch):
+    import ray_tpu._private.worker as worker_mod
+    import ray_tpu.serve.handle as H
+
+    class _GCS:
+        def call(self, method, payload, **kw):
+            assert method == "KVKeys"
+            prefix = f"{H.MIGRATING_KV_PREFIX}app:dep:"
+            assert payload["prefix"] == prefix
+            return [prefix + "cafe", prefix + "f00d"]
+
+    class _W:
+        gcs = _GCS()
+
+    monkeypatch.setattr(worker_mod, "get_global_worker", lambda: _W())
+    r = H._Router("app", "dep")
+    assert r._fetch_migrating() == {"cafe", "f00d"}
+    # TTL cache: a second read within 2 s never hits the GCS
+    monkeypatch.setattr(worker_mod, "get_global_worker",
+                        lambda: (_ for _ in ()).throw(AssertionError))
+    assert r._fetch_migrating() == {"cafe", "f00d"}
+
+
+# -- planner: digest-row lifecycle, hysteresis, rate cap ---------------------
+
+
+class _FakeRemoteMethod:
+    def __init__(self, rec, name):
+        self._rec, self._name = rec, name
+
+    def remote(self, *args, **kwargs):
+        self._rec.append((self._name,) + args)
+        return ("ref", self._name, args)
+
+
+class _FakeVictim:
+    def __init__(self, hex_, rec):
+        self._actor_id = _FakeId(hex_)
+        self._rec = rec
+
+    @property
+    def handle_request(self):
+        return _FakeRemoteMethod(self._rec, "handle_request")
+
+
+def test_planner_evacuation_deletes_digest_row_first(monkeypatch):
+    """Satellite regression: the victim's serveprefix:* digest row is
+    KVDel'd at evacuation START (routers stop choosing it for warm
+    prompts immediately), the servemig:* marker brackets the evacuation,
+    and the evacuate RPC targets only the survivors."""
+    import ray_tpu
+    from ray_tpu.serve.handle import digest_kv_key, migration_kv_key
+
+    ops, calls = [], []
+    monkeypatch.setattr(kv_migration, "_kv_put",
+                        lambda k, v: ops.append(("put", k)))
+    monkeypatch.setattr(kv_migration, "_kv_del",
+                        lambda k: ops.append(("del", k)))
+    monkeypatch.setattr(ray_tpu, "get",
+                        lambda ref, timeout=None: {"migrated": 2,
+                                                   "fallback": 0,
+                                                   "skipped": 0})
+    planner = kv_migration.MigrationPlanner()
+    victim = _FakeVictim("v1", calls)
+    planner.evacuate_replicas("app", "dep", [victim], ["v1", "s1", "s2"])
+
+    mkey = migration_kv_key("app", "dep", "v1")
+    dkey = digest_kv_key("app", "dep", "v1")
+    assert ops == [("put", mkey), ("del", dkey), ("del", mkey)]
+    assert calls == [
+        ("handle_request", "evacuate_streams", (["s1", "s2"], "drain"), {})]
+
+
+def test_warm_prompt_routes_to_destination_after_row_delete():
+    """Once the victim's digest row is gone, a warm prompt's chain only
+    matches the destination — the router sends it there."""
+    import ray_tpu.serve.handle as H
+    from ray_tpu._private.prefix_hash import prefix_chain_hashes
+
+    r = H._Router("app", "dep")
+    r._refresh = lambda: None
+    r._digest_ts = time.monotonic() + 3600  # digests planted, not fetched
+    victim, dest = _FakeReplica("v1"), _FakeReplica("d1")
+    r._replicas = [victim, dest]
+    warm = list(range(1, 33))
+    # only the DESTINATION holds the chain: the victim's row was deleted
+    # at evacuation start
+    r._digests = {"d1": {"held": set(prefix_chain_hashes(warm, 8)),
+                         "block_size": 8, "models": set(), "v": 1,
+                         "qlen": 0}}
+    for _ in range(8):
+        assert r.choose_replica((), {"prompt": warm}) is dest
+
+
+def test_planner_rebalance_hysteresis_and_batch(monkeypatch):
+    """Divergence must persist serve_migration_rebalance_ticks
+    consecutive ticks before actuation; the move is capped at
+    serve_migration_rebalance_batch streams and resets the streak."""
+    monkeypatch.setattr(
+        kv_migration, "_fetch_qlens",
+        lambda app, dep: {"hot": 20.0, "cold": 1.0})
+    subs = []
+    planner = kv_migration.MigrationPlanner(
+        submit=lambda fn, *a: subs.append(a))
+    snap = {("app", "dep"): [_FakeReplica("hot"), _FakeReplica("cold")]}
+    cfg = global_config()
+    assert cfg.serve_migration_rebalance_ticks == 3
+    for expect in (0, 0, cfg.serve_migration_rebalance_batch):
+        planner._next_tick = 0.0  # collapse the 1 Hz pacing
+        assert planner.rebalance_tick(snap) == expect
+    (app, dep, hot, cold, n), = subs
+    assert (app, dep, n) == ("app", "dep",
+                             cfg.serve_migration_rebalance_batch)
+    assert hot._actor_id.hex() == "hot" and cold._actor_id.hex() == "cold"
+
+    # converged depths reset the streak: divergence must re-accumulate
+    monkeypatch.setattr(kv_migration, "_fetch_qlens",
+                        lambda app, dep: {"hot": 2.0, "cold": 1.0})
+    planner._next_tick = 0.0
+    assert planner.rebalance_tick(snap) == 0
+    monkeypatch.setattr(kv_migration, "_fetch_qlens",
+                        lambda app, dep: {"hot": 20.0, "cold": 1.0})
+    planner._next_tick = 0.0
+    assert planner.rebalance_tick(snap) == 0  # streak restarted at 1
+
+
+def test_planner_rebalance_disabled_is_inert(monkeypatch):
+    monkeypatch.setattr(
+        kv_migration, "_fetch_qlens",
+        lambda app, dep: {"hot": 50.0, "cold": 0.0})
+    cfg = global_config()
+    saved = cfg.serve_migration_enabled
+    cfg.serve_migration_enabled = False
+    try:
+        planner = kv_migration.MigrationPlanner(
+            submit=lambda *a: pytest.fail("disabled planner actuated"))
+        snap = {("app", "dep"): [_FakeReplica("hot"),
+                                 _FakeReplica("cold")]}
+        for _ in range(5):
+            planner._next_tick = 0.0
+            assert planner.rebalance_tick(snap) == 0
+    finally:
+        cfg.serve_migration_enabled = saved
+
+
+def test_planner_rate_cap_token_bucket():
+    """The per-replica token bucket: burst = one second's worth, then
+    the refill rate gates further exits — planner oscillation can never
+    thrash a replica."""
+    planner = kv_migration.MigrationPlanner()
+    # full bucket at rate 2/s: first ask drains the burst
+    assert planner._rate_allow("r", 5, 2.0) == 2
+    assert planner._rate_allow("r", 5, 2.0) == 0
+    # simulate 1 s of refill without sleeping
+    tokens, t0 = planner._bucket["r"]
+    planner._bucket["r"] = (tokens, t0 - 1.0)
+    assert planner._rate_allow("r", 5, 2.0) == 2
+    # rate 0 still allows the floor-1 burst exactly once
+    assert planner._rate_allow("z", 5, 0.0) == 1
+    assert planner._rate_allow("z", 5, 0.0) == 0

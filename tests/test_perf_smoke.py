@@ -479,3 +479,55 @@ def test_ingress_admission_overhead_and_byte_identity():
     assert extra["wfq_cycle_ns"] < 10_000, extra
     assert extra["disabled_lookup_ns"] < 1_000, extra
     assert extra["booked_disabled"] == 0, extra
+
+
+def test_kv_migration_quiet_path_budget_and_books_nothing():
+    """Live KV migration (ISSUE 19) costs a serving path with no
+    migration traffic NOTHING measurable and books NOTHING:
+
+      - neither new family (ray_tpu_serve_kv_migrations_total /
+        ray_tpu_serve_kv_migration_latency_seconds) gains a point from
+        ordinary serving — recorders only exist on the migration path;
+      - the only addition to the hot emission loop is a set-membership
+        check against the (empty) migrating-wkey set — gated < 1 µs,
+        orders of magnitude under the ~ms engine step;
+      - the engine step path itself is untouched: a served stream's
+        greedy output stays byte-identical to the bare engine's.
+    """
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu._private import runtime_metrics as rtm
+    from ray_tpu.llm import GenerationConfig, LLMConfig, PagedJaxLLMEngine
+    from ray_tpu.llm.serve import LLMServer
+    from ray_tpu.models.llama import LlamaConfig, init_params
+
+    cfg = LlamaConfig.tiny(vocab_size=48, dim=32, n_layers=1, n_heads=2,
+                           n_kv_heads=1, ffn_dim=64, max_seq_len=48,
+                           compute_dtype=jnp.float32)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    lcfg = LLMConfig(model_config=cfg, max_batch_size=2, max_seq_len=48,
+                     block_size=8, prefill_chunk=16, decode_chunk=4)
+    before = rtm.kv_migration_snapshot()
+
+    server = LLMServer(lcfg, params=params)
+    try:
+        prompt = list(np.random.RandomState(7).randint(1, 47, size=9))
+        served = server.generate(prompt, max_new_tokens=8)
+        # micro-gate the added per-emission branch on the live server
+        migrating, wk = server._migrating, (None, 0, 12345)
+        n = 100_000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            hot = wk in migrating
+        dt_ns = (time.perf_counter() - t0) / n * 1e9
+        assert hot is False and dt_ns < 1_000, dt_ns
+    finally:
+        server.shutdown()
+    bare = PagedJaxLLMEngine(lcfg, params=params)
+    assert served == bare.generate(
+        [prompt], GenerationConfig(max_new_tokens=8))[0]
+    assert rtm.kv_migration_snapshot() == before

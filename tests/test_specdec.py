@@ -481,6 +481,52 @@ def test_import_request_seeds_draft_kv(tiny_cfg, tiny_params):
     assert stats["acceptance_rate"] > 0.5, stats
 
 
+@pytest.mark.timeout(240)
+def test_middecode_migration_of_speculating_stream(tiny_cfg, tiny_params):
+    """Live-migration composition (ISSUE 19): a stream SPECULATING
+    mid-decode exports with its full token history and resumes on
+    another speculative engine with the draft KV re-seeded over
+    prompt + history — greedy bit-parity holds across the move and the
+    destination keeps speculating at high acceptance, not ~0."""
+    spec = SpeculativeConfig(draft_model_config=tiny_cfg,
+                             num_speculative_tokens=3)
+    prompt = _prompts([19], seed=37)[0]
+    plain = PagedJaxLLMEngine(_lcfg(tiny_cfg, max_batch_size=2),
+                              params=tiny_params)
+    want = plain.generate([prompt], _gen(max_new_tokens=14))[0]
+
+    src = PagedJaxLLMEngine(_lcfg(tiny_cfg, spec, max_batch_size=2),
+                            params=tiny_params, draft_params=tiny_params)
+    rid = src.add_request(prompt, _gen(max_new_tokens=14))
+    emitted = []
+    while len(emitted) < 5:
+        for _rid, t in src.step().items():
+            emitted.extend(t)
+    assert src.specdec_stats()["proposed"] > 0  # it WAS speculating
+    h = src.export_request(rid)
+    assert h["emitted"][:len(emitted)] == emitted
+    with src._lock:
+        assert rid not in src._requests  # slot freed at export
+
+    dst = PagedJaxLLMEngine(_lcfg(tiny_cfg, spec, max_batch_size=2),
+                            params=tiny_params, draft_params=tiny_params)
+    res = dst.import_request(h["prompt"], h["first_token"], h["k"], h["v"],
+                             _gen(max_new_tokens=14), emitted=h["emitted"])
+    assert res is not None
+    assert res["emitted"] == []  # history is never re-delivered
+    toks = list(h["emitted"])
+    while dst.has_work():
+        for _rid, t in dst.step().items():
+            toks.extend(t)
+    for _rid, t in dst.flush().items():
+        toks.extend(t)
+    assert toks == want
+    stats = dst.specdec_stats()
+    assert stats["proposed"] > 0
+    # draft KV re-seeded over prompt + history: acceptance stays high
+    assert stats["acceptance_rate"] > 0.5, stats
+
+
 # -- config / factory edges --------------------------------------------------
 
 
