@@ -187,6 +187,30 @@ def tree_nbytes(tree) -> int:
         return 0
 
 
+def tree_nbytes_per_device(tree) -> int:
+    """Per-DEVICE byte footprint of a pytree of (possibly sharded)
+    arrays: each leaf contributes its largest single-device shard, so a
+    tensor-sharded leaf counts size/N while a replicated leaf counts full
+    size.  This is what an engine must feed hbm_split() — tree_nbytes of
+    a mesh-sharded pool is the GLOBAL size and over-reports every
+    device's engine-owned HBM by the sharding degree.  Metadata only (no
+    host transfer); unsharded arrays fall back to ``nbytes``."""
+    try:
+        import jax
+
+        total = 0
+        for leaf in jax.tree_util.tree_leaves(tree):
+            shards = getattr(leaf, "addressable_shards", None)
+            if shards:
+                total += max(int(getattr(s.data, "nbytes", 0) or 0)
+                             for s in shards)
+            else:
+                total += int(getattr(leaf, "nbytes", 0) or 0)
+        return total
+    except Exception:  # noqa: BLE001
+        return 0
+
+
 # ---------------------------------------------------------------------------
 # Compile watch
 # ---------------------------------------------------------------------------

@@ -403,6 +403,23 @@ SERVE_SPECDEC_ACCEPTED = Counter(
     "Drafted tokens accepted by target verification (each one is a decode "
     "token emitted without its own target forward pass)",
     tag_keys=("deployment",))
+# planner-routed tensor-parallel serving collectives (llm/paged.py): the
+# per-layer decode/verify/prefill allreduces of a TP-sharded engine, by
+# the algorithm the α-β planner chose.  Booked ONLY when the engine is
+# sharded with planned collectives on — the single-device / disabled path
+# books nothing and the metric surface stays byte-identical (tier-1
+# pinned).  seconds are the α-β model's attribution (host timing cannot
+# see inside the async dispatch pipeline without fencing it).
+SERVE_TP_COLLECTIVE_SECONDS = Counter(
+    "ray_tpu_serve_tp_collective_seconds",
+    "Modeled seconds spent in planner-routed tensor-parallel serving "
+    "collectives (α-β cost x dispatched collective count)",
+    tag_keys=("deployment", "algorithm"))
+SERVE_TP_COLLECTIVE_BYTES = Counter(
+    "ray_tpu_serve_tp_collective_bytes_total",
+    "Logical bytes moved through planner-routed tensor-parallel serving "
+    "collectives (2 per-layer allreduces per dispatched program)",
+    tag_keys=("deployment", "algorithm"))
 # tenant-fair ingress admission (serve/_private/admission.py).  Booked ONLY
 # when serve_admission_enabled — the disabled path books nothing and the
 # metric surface is byte-identical (perf-smoke pinned).  decision is a tiny
@@ -633,6 +650,7 @@ FAMILIES = (
     SERVE_SLO_REQUESTS, SERVE_SLO_BURN_RATE,
     SERVE_ADMISSION, SERVE_TENANT_QUEUE_DEPTH,
     SERVE_SPECDEC_PROPOSED, SERVE_SPECDEC_ACCEPTED,
+    SERVE_TP_COLLECTIVE_SECONDS, SERVE_TP_COLLECTIVE_BYTES,
     DATA_ROWS, DATA_BACKPRESSURE,
     DATA_INGEST_ROWS, DATA_INGEST_BYTES, DATA_INGEST_BUFFER,
     DATA_INGEST_BACKPRESSURE, DATA_INGEST_WAIT,
@@ -1243,6 +1261,37 @@ def add_specdec_tokens(deployment: str, proposed: int,
         _bound(SERVE_SPECDEC_PROPOSED, deployment=deployment).inc(proposed)
     if accepted > 0:
         _bound(SERVE_SPECDEC_ACCEPTED, deployment=deployment).inc(accepted)
+
+
+def observe_tp_collective(deployment: str, algorithm: str, *,
+                          seconds: float, nbytes: int) -> None:
+    """One TP-sharded engine dispatch's planner-routed collectives
+    (llm/paged.py): modeled seconds + logical bytes by chosen algorithm.
+    Callers only exist when the engine is sharded with planned
+    collectives on — the single-device path books nothing."""
+    if nbytes > 0:
+        _bound(SERVE_TP_COLLECTIVE_BYTES, deployment=deployment,
+               algorithm=algorithm).inc(nbytes)
+    if seconds > 0:
+        _bound(SERVE_TP_COLLECTIVE_SECONDS, deployment=deployment,
+               algorithm=algorithm).inc(seconds)
+
+
+def tp_collective_snapshot() -> dict:
+    """Process-local TP serving-collective accounting for bench.py and
+    the tier-1 pins: {deployment: {algorithm: {bytes, seconds}}}."""
+    out: dict = {}
+    for tags_key, v in dict(SERVE_TP_COLLECTIVE_BYTES._points).items():
+        t = dict(tags_key)
+        row = out.setdefault(t.get("deployment", "?"), {}).setdefault(
+            t.get("algorithm", "?"), {"bytes": 0.0, "seconds": 0.0})
+        row["bytes"] += v
+    for tags_key, v in dict(SERVE_TP_COLLECTIVE_SECONDS._points).items():
+        t = dict(tags_key)
+        row = out.setdefault(t.get("deployment", "?"), {}).setdefault(
+            t.get("algorithm", "?"), {"bytes": 0.0, "seconds": 0.0})
+        row["seconds"] += v
+    return out
 
 
 def specdec_snapshot() -> dict:

@@ -121,6 +121,27 @@ class LLMConfig:
     tensor_parallel_size: int = 1
     pipeline_parallel_size: int = 1
     data_parallel_size: int = 1
+    # pre-built jax.sharding.Mesh override for the engine.  None (default)
+    # builds one from tensor/pipeline_parallel_size over the first visible
+    # devices; pass a mesh to pin WHICH devices a replica shards over
+    # (e.g. a placement-group slice).  Must carry a "tensor" axis of size
+    # tensor_parallel_size (and "pipeline" of pipeline_parallel_size).
+    mesh: Optional[Any] = None
+    # --- tensor-parallel collective routing (paged engine, tp > 1) ---
+    # route the per-layer decode allreduces through the α-β collective
+    # planner as EXPLICIT shard_map programs (flat psum / ring / tree
+    # chosen per message size and link class, decision metered into
+    # ray_tpu_collective_plan_total).  False = GSPMD's implicit psum
+    # (identical numerics for flat/ring; no plan metrics, no overlap).
+    tp_planned_collectives: bool = True
+    # chain each planned collective through lax.optimization_barrier so
+    # XLA's scheduler overlaps it with the next layer's compute (identity
+    # numerics — the A/B is bit-equal; same mechanism as make_train_step's
+    # bucketed gradient sync).  Only meaningful with planned collectives.
+    tp_overlap_collectives: bool = True
+    # force one algorithm ("flat" | "ring" | "tree") instead of planning —
+    # a test/bench hook; None = plan per message size.
+    tp_collective_algorithm: Optional[str] = None
     # serving
     num_replicas: int = 1
     chips_per_replica: Optional[int] = None

@@ -117,7 +117,7 @@ def build_tp_mesh(cfg, tp: int):
     return build_engine_mesh(cfg, tp, 1)
 
 
-def build_engine_mesh(cfg, tp: int, pp: int):
+def build_engine_mesh(cfg, tp: int, pp: int, mesh=None):
     """Validate the TP × PP degrees and build a `pipeline`×`tensor` mesh.
 
     TP=PP=1 stays mesh-free (single-device fast path).  PP shards the
@@ -128,11 +128,25 @@ def build_engine_mesh(cfg, tp: int, pp: int):
     layer scan crosses stage boundaries with XLA-inserted transfers of the
     [B, D] activation (tiny for decode); stages run sequentially within
     one step — PP here buys MEMORY reach, microbatch overlap is the
-    training path's job (parallel/pipeline.py)."""
-    if tp <= 1 and pp <= 1:
+    training path's job (parallel/pipeline.py).
+
+    ``mesh`` (LLMConfig.mesh): a caller-built mesh pinning WHICH devices
+    the replica shards over — validated against the degrees (axis sizes
+    must match) and the model's divisibility, then used as-is."""
+    if tp <= 1 and pp <= 1 and mesh is None:
         return None
+    if mesh is not None:
+        shape = dict(mesh.shape)
+        if shape.get("tensor", 1) != max(tp, 1):
+            raise ValueError(
+                f"config.mesh tensor axis is {shape.get('tensor', 1)} but "
+                f"tensor_parallel_size={tp} — the degrees must agree")
+        if shape.get("pipeline", 1) != max(pp, 1):
+            raise ValueError(
+                f"config.mesh pipeline axis is {shape.get('pipeline', 1)} "
+                f"but pipeline_parallel_size={pp}")
     devices = jax.devices()
-    if len(devices) < tp * pp:
+    if mesh is None and len(devices) < tp * pp:
         raise ValueError(
             f"tensor_parallel_size={tp} x pipeline_parallel_size={pp} needs "
             f"{tp * pp} devices but only {len(devices)} visible device(s) — "
@@ -150,6 +164,8 @@ def build_engine_mesh(cfg, tp: int, pp: int):
                 raise ValueError(
                     f"tensor_parallel_size={tp} does not divide model "
                     f"{name}={dim}")
+    if mesh is not None:
+        return mesh
     from ray_tpu.parallel.mesh import MeshSpec
 
     return MeshSpec(pipeline=pp, tensor=tp).build(devices[:tp * pp])
@@ -231,7 +247,8 @@ class JaxLLMEngine:
         # the engine; here TP is a jax mesh axis and GSPMD partitions the
         # prefill/decode programs from the param + cache shardings alone)
         pp = config.pipeline_parallel_size
-        self.mesh = build_engine_mesh(cfg, config.tensor_parallel_size, pp)
+        self.mesh = build_engine_mesh(cfg, config.tensor_parallel_size, pp,
+                                      mesh=getattr(config, "mesh", None))
         self.cache = llama.init_kv_cache(cfg, self.max_batch, self.max_seq)
         if self.mesh is not None:
             from ray_tpu.parallel.mesh import shard_pytree

@@ -466,7 +466,8 @@ class PagedJaxLLMEngine:
         )
 
         pp = config.pipeline_parallel_size
-        self.mesh = build_engine_mesh(cfg, config.tensor_parallel_size, pp)
+        self.mesh = build_engine_mesh(cfg, config.tensor_parallel_size, pp,
+                                      mesh=config.mesh)
         self.pool = llama.init_paged_kv_cache(cfg, nb, self.bs)
         if self.mesh is not None:
             from ray_tpu.parallel.mesh import shard_pytree
@@ -475,9 +476,30 @@ class PagedJaxLLMEngine:
                 self.params,
                 pp_param_specs(llama.inference_param_specs(cfg), pp),
                 self.mesh)
+            # the paged pool shards on the folded kv-head dim, matching
+            # wk/wv's column sharding: each rank's cache scatter/gather
+            # touches only its own head group — no resharding anywhere in
+            # the decode dataflow.  The block table, BlockManager,
+            # admission, prefix cache, and scheduling all stay host-side
+            # and replicated: one logical engine over N devices.
             self.pool = shard_pytree(
                 self.pool, pp_cache_spec(llama.paged_kv_cache_spec(), pp),
                 self.mesh)
+        # --- planner-routed TP collectives (tentpole, ISSUE 20) ---------
+        # decode's per-layer allreduces are KiB-scale and latency-bound —
+        # the α-β planner's flat/tree regime.  Plan once per program kind
+        # at init (message sizes are static: B and chunk geometry are
+        # compile-time), route the chosen algorithm into the jitted
+        # programs as explicit shard_map collectives, and meter the
+        # decision.  PP keeps GSPMD's implicit path (the layer scan spans
+        # stages; an explicit island per stage boundary buys nothing).
+        self._tp_plan = None          # llama.TPPlan for decode chunks
+        self._tp_verify_plan = None   # ... for the spec-verify window
+        self._tp_prefill_plan = None  # ... for prefill chunks
+        self._tp_collectives = None   # {kind: plan_explain row} (bench)
+        if (self.mesh is not None and config.tensor_parallel_size > 1
+                and pp <= 1 and config.tp_planned_collectives):
+            self._init_tp_planning()
 
         # host slot state (mirrors the static engine)
         self._slot_req: List[Optional[_PagedReq]] = [None] * self.max_batch
@@ -605,16 +627,23 @@ class PagedJaxLLMEngine:
                                              prefix_caching=False)
             self._draft_pool = llama.init_paged_kv_cache(dcfg, dnb, self.bs)
             if self.mesh is not None:
+                from jax.sharding import PartitionSpec as P
+
                 from ray_tpu.parallel.mesh import shard_pytree
 
+                # the draft stays single-chip: REPLICATE its params and
+                # pool over the mesh (each device runs the tiny draft
+                # redundantly).  Draft messages are so small that
+                # allreduce α would dominate any sharding win — zero
+                # collectives in every draft program, while the target's
+                # verify window runs fully sharded.
+                rep = jax.tree_util.tree_map(
+                    lambda _: P(), llama.inference_param_specs(dcfg),
+                    is_leaf=lambda x: isinstance(x, P))
                 self._draft_params = shard_pytree(
-                    self._draft_params,
-                    pp_param_specs(llama.inference_param_specs(dcfg), pp),
-                    self.mesh)
+                    self._draft_params, rep, self.mesh)
                 self._draft_pool = shard_pytree(
-                    self._draft_pool,
-                    pp_cache_spec(llama.paged_kv_cache_spec(), pp),
-                    self.mesh)
+                    self._draft_pool, {"k": P(), "v": P()}, self.mesh)
             self._d_spec = None  # device mirror of per-slot spec enable
             # draft chunked prefill: same chunk/table geometry as the
             # target (block_size is shared, so the fixed width carries)
@@ -647,12 +676,20 @@ class PagedJaxLLMEngine:
         if name is None:
             self._telemetry = None
             return
-        kv_bytes = device_telemetry.tree_nbytes(self.pool)
+        # per-DEVICE bytes, not logical: a tp=N pool puts 1/N of its bytes
+        # on each chip (the draft pool is replicated — full size per
+        # device).  tree_nbytes of a sharded array counts GLOBAL bytes;
+        # feeding that into hbm_split over-reported each device's
+        # engine-owned HBM by N× on sharded replicas, making chip
+        # telemetry and the disagg router's free-HBM digests lie.
+        kv_bytes = device_telemetry.tree_nbytes_per_device(self.pool)
         if self._spec is not None:
-            kv_bytes += device_telemetry.tree_nbytes(self._draft_pool)
+            kv_bytes += device_telemetry.tree_nbytes_per_device(
+                self._draft_pool)
         self._telemetry = device_telemetry.engine_telemetry_for(
             name,
-            weights_bytes=device_telemetry.tree_nbytes(self.params),
+            weights_bytes=device_telemetry.tree_nbytes_per_device(
+                self.params),
             kv_pool_bytes=kv_bytes)
         if self._telemetry is not None:
             # local-mode / engine-direct utilization surface; serve
@@ -686,7 +723,103 @@ class PagedJaxLLMEngine:
             row["duty_cycle"] = rates["duty_cycle"]
             row["rates"] = rates
             row["hbm"] = tel.hbm_split()
+        if self.mesh is not None:
+            # mesh-aware view: KV/weights bytes PER DEVICE (the pool
+            # shards its kv-head dim over "tensor"), plus the planned
+            # collective decisions — what bench.py's busbw column and the
+            # disagg digests read
+            row["tp"] = {
+                "degree": self.config.tensor_parallel_size,
+                "pipeline": self.config.pipeline_parallel_size,
+                "mesh_devices": int(np.asarray(self.mesh.devices).size),
+                "mesh_shape": {k: int(v)
+                               for k, v in dict(self.mesh.shape).items()
+                               if int(v) > 1},
+                "kv_bytes_per_device":
+                    device_telemetry.tree_nbytes_per_device(self.pool),
+                "weights_bytes_per_device":
+                    device_telemetry.tree_nbytes_per_device(self.params),
+                "planned_collectives": self._tp_collectives,
+            }
         return row
+
+    # -- planner-routed TP collectives ---------------------------------
+
+    def _init_tp_planning(self):
+        """Plan the per-layer decode/verify/prefill allreduces through the
+        PR 10 α-β planner and stash per-kind :class:`llama.TPPlan` routing
+        for the jitted programs.
+
+        Message sizes are compile-time constants (every dispatch pads to
+        ``max_batch`` and the chunk geometry is fixed), so one decision
+        per kind covers steady state: zero plan lookups in the hot loop.
+        Each decision is metered into ``ray_tpu_collective_plan_total``
+        (algorithm + reason — flat/tree's "latency_bound" is decode's
+        regime) and the full ``plan_explain`` row is kept for
+        ``utilization()`` and bench.py's busbw column."""
+        from ray_tpu.util.collective import planner as _planner
+        from ray_tpu.util.collective.compression import CompressionSpec
+
+        config, cfg = self.config, self.cfg
+        axes = list(self.mesh.axis_names)
+        dev_arr = np.asarray(self.mesh.devices)
+        index = [0] * dev_arr.ndim
+        index[axes.index("tensor")] = slice(None)
+        tdevs = dev_arr[tuple(index)].ravel().tolist()
+        topo = _planner.topology_for_devices(tdevs)
+        # scheme "none" + hierarchical None = algorithm-only planning (no
+        # quantization codec); min_bytes 0 because decode messages are
+        # KiB-scale — the 64 KiB training default would force everything
+        # stock before the cost model ever ran
+        spec = CompressionSpec(scheme="none", min_bytes=0)
+        allowed = ("flat", "ring", "tree")
+        itemsize = jnp.dtype(cfg.compute_dtype).itemsize
+        k = (int(config.speculative_config.num_speculative_tokens)
+             if config.speculative_config is not None else 0)
+        # the reduced payload is the [*, dim] partial-sum output of the
+        # attn/FFN projections, in compute dtype
+        kinds = {"decode": self.max_batch * cfg.dim * itemsize,
+                 "prefill": config.prefill_chunk * cfg.dim * itemsize}
+        if k:
+            kinds["verify"] = self.max_batch * (k + 1) * cfg.dim * itemsize
+        forced = config.tp_collective_algorithm
+        rows = {}
+        plans = {}
+        for kind, nbytes in kinds.items():
+            row = _planner.plan_explain(nbytes, topo, spec, allowed=allowed)
+            if forced is not None:
+                row = dict(row, chosen=forced, reason="forced")
+            _planner.record_plan(row["chosen"], row["reason"])
+            rows[kind] = row
+            plans[kind] = llama.TPPlan(
+                mesh=self.mesh, algorithm=row["chosen"],
+                overlap=config.tp_overlap_collectives)
+        self._tp_collectives = rows
+        self._tp_plan = plans["decode"]
+        self._tp_prefill_plan = plans["prefill"]
+        self._tp_verify_plan = plans.get("verify")
+
+    def _book_tp_collectives(self, kind: str, programs: int = 1,
+                             nbytes_each: Optional[int] = None):
+        """Meter one dispatch's planned TP collectives: 2 allreduces per
+        layer per program (attn-out + FFN-down), bytes exact from the
+        message size (``nbytes_each`` overrides the planned size for
+        short prefill chunks), seconds from the α-β model (a modeled
+        attribution — per-collective device timing isn't observable from
+        the host without fencing the async dispatch pipeline).  The
+        unsharded / planning-disabled path books NOTHING."""
+        rows = self._tp_collectives
+        row = rows.get(kind) if rows is not None else None
+        if row is None:
+            return
+        from ray_tpu._private import runtime_metrics
+
+        n = 2 * self.cfg.n_layers * programs
+        cost = row["modeled_cost_s"].get(row["chosen"]) or 0.0
+        runtime_metrics.observe_tp_collective(
+            self.slo_label or "engine", row["chosen"], seconds=n * cost,
+            nbytes=n * (nbytes_each if nbytes_each is not None
+                        else row["nbytes"]))
 
     # -- jitted programs ------------------------------------------------
 
@@ -701,7 +834,8 @@ class PagedJaxLLMEngine:
             logits, pool = llama.decode_step_paged(
                 self.cfg, params, tokens, pool, table, lengths,
                 rope_cache=self._rope, use_kernel=self._use_kernel,
-                mesh=self.mesh, kernel_interpret=self._kernel_interpret)
+                mesh=self.mesh, kernel_interpret=self._kernel_interpret,
+                tp_plan=self._tp_plan)
             key, sub = jax.random.split(key)
             ids = _sample(logits, sub, temps, top_ks)
             emitted = jnp.where(active > 0, ids, -1)
@@ -724,7 +858,8 @@ class PagedJaxLLMEngine:
         """One chunk; also samples the token at chunk-local position
         ``sample_idx`` (the caller uses it only on the final chunk)."""
         logits, pool = llama.prefill_chunk_paged(
-            self.cfg, params, tokens, pool, table, p0, rope_cache=self._rope)
+            self.cfg, params, tokens, pool, table, p0, rope_cache=self._rope,
+            tp_plan=self._tp_prefill_plan)
         key, sub = jax.random.split(key)
         ids = _sample(logits[:, sample_idx], sub, temp, top_k)
         return ids, pool, key
@@ -793,7 +928,8 @@ class PagedJaxLLMEngine:
         window = jnp.concatenate([tokens[:, None], drafted.T], axis=1)
         logits, pool = llama.decode_window_paged(
             self.cfg, params, window, pool, table, lengths,
-            rope_cache=self._rope, pos_limit=self.max_seq)
+            rope_cache=self._rope, pos_limit=self.max_seq,
+            tp_plan=self._tp_verify_plan)
         # per-position target distributions under each slot's sampling
         # params — exactly what non-speculative _sample would draw from
         pdist = jax.vmap(lambda lg: _sample_dist(lg, temps, top_ks),
@@ -1152,6 +1288,11 @@ class PagedJaxLLMEngine:
                     jnp.int32(sample_idx), self._d_key,
                     jnp.asarray([req.gen.temperature], np.float32),
                     jnp.asarray([req.gen.top_k], np.int32))
+                if self._tp_collectives is not None:
+                    self._book_tp_collectives(
+                        "prefill",
+                        nbytes_each=c * self.cfg.dim
+                        * jnp.dtype(self.cfg.compute_dtype).itemsize)
                 req.prefill_pos = p0 + take
                 # the draft tracks the target's prefill frontier
                 while (req.spec_enabled
@@ -1509,6 +1650,7 @@ class PagedJaxLLMEngine:
                             self._d_active, self._d_remaining,
                             self._d_stops, self._d_key,
                             self._d_temp, self._d_topk, chunk)
+                    self._book_tp_collectives("decode", chunk)
                     prev, self._inflight = (self._inflight,
                                             (em_dev, active, (), None))
                 if prev is not None:
@@ -1575,6 +1717,7 @@ class PagedJaxLLMEngine:
                     jnp.asarray(table), self._d_lengths, self._d_active,
                     self._d_remaining, self._d_stops, self._d_key,
                     self._d_temp, self._d_topk, k + 1)
+            self._book_tp_collectives("decode", k + 1)
             return em_dev, None, ()
         # the draft table reuses the TARGET table's bucketed width:
         # block counts track each other (same ensure/trim formulas),
@@ -1596,6 +1739,7 @@ class PagedJaxLLMEngine:
                 jnp.asarray(table), self._d_lengths, self._d_active,
                 self._d_remaining, self._d_stops, self._d_key,
                 self._d_temp, self._d_topk, self._d_spec)
+        self._book_tp_collectives("verify")
         return em_dev, acc_dev, spec_slots
 
     def flush(self) -> Dict[int, List[int]]:
@@ -1657,7 +1801,15 @@ class PagedJaxLLMEngine:
         stop / budget state.  Raises if the request isn't in the
         exportable state (prefill incomplete, or already finished — a
         1-token budget completes on the first emit and frees its partial
-        block)."""
+        block).
+
+        Tensor-parallel engines export the same payload: the gather
+        below reads the kv-head-sharded pool and ``np.asarray`` on the
+        result assembles the FULL logical blocks on host (an all-gather
+        over the mesh, paid once per handoff, not per step).  The
+        handoff is therefore geometry-invariant — k/v carry no trace of
+        the source's TP degree, so single↔sharded and 2-way↔4-way
+        migrations all interoperate; the importer re-shards on entry."""
         with self._lock:
             self._drain_locked()  # resolve the in-flight chunk's tokens
             req = self._requests.get(request_id)
@@ -1715,7 +1867,18 @@ class PagedJaxLLMEngine:
         are free right now — the caller falls back to a plain
         ``add_request`` (recompute; the prefix cache usually absorbs most
         of it).  Never queues: a queued import would pin host copies of
-        KV that recompute could regenerate."""
+        KV that recompute could regenerate.
+
+        On a tensor-parallel engine the scatter program writes into the
+        kv-head-sharded pool, so the full-logical host blocks from
+        ``export_request`` are re-sharded on entry — each device keeps
+        only its kv-head slice.  Because the exported payload is
+        geometry-invariant, a mixed fleet (single-device prefill tier,
+        sharded decode tier, or rebalancing between TP degrees) hands
+        off without a resharding step in between; when this engine has
+        no free slot/blocks the usual None → ``add_request`` recompute
+        fallback applies unchanged, so mixed handoff never drops a
+        request."""
         gen = gen or GenerationConfig()
         plen = len(prompt)
         if plen == 0:

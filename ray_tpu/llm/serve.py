@@ -335,6 +335,15 @@ class LLMServer:
             cfg = self._config
             if spec_cfg is not self._config.speculative_config:
                 cfg = dataclasses.replace(cfg, speculative_config=spec_cfg)
+            # tensor-parallel replicas: the merged-weight adapter engine
+            # must shard over the SAME mesh as the base engine — a fresh
+            # mesh built from tensor_parallel_size over "first visible
+            # devices" could pick different chips than a placement-group
+            # pinned base, double-committing HBM on one slice while the
+            # reserved one idles
+            base_mesh = getattr(self._engine, "mesh", None)
+            if base_mesh is not None and cfg.mesh is None:
+                cfg = dataclasses.replace(cfg, mesh=base_mesh)
             dparams = self._draft_params
             if spec_cfg is not None and draft_adapter is not None:
                 dparams = merge_lora(self._draft_params, draft_adapter)

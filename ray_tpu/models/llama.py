@@ -509,6 +509,103 @@ def paged_kv_cache_spec() -> Dict[str, P]:
     return {"k": spec, "v": spec}
 
 
+@dataclasses.dataclass(frozen=True)
+class TPPlan:
+    """Planner-routed tensor-parallel collectives for the paged inference
+    programs.
+
+    When a ``TPPlan`` is passed to ``decode_step_paged`` /
+    ``decode_window_paged`` / ``prefill_chunk_paged``, the two per-layer
+    partial-sum reductions (attention output @ wo and FFN @ w_down) run as
+    EXPLICIT shard_map programs executing the α-β planner's chosen
+    algorithm instead of GSPMD's implicit psum: ``flat`` (one fused psum —
+    the latency-bound small-message winner), ``ring`` (psum_scatter +
+    all_gather, bandwidth-optimal), or ``tree`` (recursive
+    halving-doubling via ppermute, pow2 worlds).  ``flat`` and ``ring``
+    are bit-identical to the implicit-psum path (same per-rank partials,
+    same summation order); ``tree`` pairs ranks differently and may
+    differ in float ULPs.
+
+    ``overlap`` chains each collective's output through a scalar token
+    with ``lax.optimization_barrier`` — identity numerics, but the
+    explicit stage boundary lets XLA's latency-hiding scheduler start the
+    next layer's compute under the allreduce, exactly as
+    ``make_train_step`` does for bucketed gradient syncs.
+    """
+
+    mesh: Any
+    algorithm: str = "flat"
+    overlap: bool = True
+    axis: str = "tensor"
+
+
+def _tp_allreduce_local(v, axis: str, world: int, algorithm: str):
+    """In-shard_map allreduce of a partial sum ``v`` by the planned
+    algorithm.  Ring/tree operate on the trailing (feature) dim, which the
+    engine-mesh validation guarantees divides by the world size."""
+    if world <= 1:
+        return v
+    if algorithm == "ring":
+        s = lax.psum_scatter(v, axis, scatter_dimension=v.ndim - 1,
+                             tiled=True)
+        return lax.all_gather(s, axis, axis=v.ndim - 1, tiled=True)
+    if algorithm == "tree" and not (world & (world - 1)):
+        # recursive halving-doubling over the flattened payload (adapted
+        # from xla_group.build_tree_allreduce): log2(n) pairwise halving
+        # rounds, then doubling in bit order
+        shp = v.shape
+        cur = v.reshape(-1)
+        idx = lax.axis_index(axis)
+        mask = world // 2
+        perms = []
+        while mask >= 1:
+            perms.append([(i, i ^ mask) for i in range(world)])
+            mask //= 2
+        for perm in perms:
+            m = perm[0][0] ^ perm[0][1]
+            half = cur.shape[0] // 2
+            lo, hi = cur[:half], cur[half:]
+            bit = (idx & m) != 0
+            send = jnp.where(bit, lo, hi)
+            keep = jnp.where(bit, hi, lo)
+            cur = keep + lax.ppermute(send, axis, perm)
+        for perm in reversed(perms):
+            m = perm[0][0] ^ perm[0][1]
+            bit = (idx & m) != 0
+            recv = lax.ppermute(cur, axis, perm)
+            cur = jnp.where(bit, jnp.concatenate([recv, cur]),
+                            jnp.concatenate([cur, recv]))
+        return cur.reshape(shp)
+    return lax.psum(v, axis)
+
+
+def _tp_out_proj(a, w, tp_plan: Optional["TPPlan"], token):
+    """Output projection ``a @ w`` with the contraction dim sharded over
+    the tensor axis.  ``tp_plan=None``: plain matmul (GSPMD inserts the
+    psum implicitly).  Otherwise the per-rank partial matmul + planned
+    allreduce run explicitly under shard_map, and when overlapping the
+    result is chained through ``token`` (optimization_barrier — identity
+    numerics, explicit stage boundary).  Returns (out, token)."""
+    if tp_plan is None:
+        return a @ w, token
+    mesh, axis = tp_plan.mesh, tp_plan.axis
+    world = int(mesh.shape.get(axis, 1))
+    if world <= 1:
+        return a @ w, token
+    from ray_tpu.util.jax_compat import shard_map as _shard_map
+
+    def body(a_, w_):
+        return _tp_allreduce_local(a_ @ w_, axis, world, tp_plan.algorithm)
+
+    a_spec = P(*([None] * (a.ndim - 1) + [axis]))
+    out = _shard_map(body, mesh=mesh, in_specs=(a_spec, P(axis, None)),
+                     out_specs=P(*([None] * a.ndim)),
+                     check_rep=False)(a, w)
+    if token is not None:
+        out, token = lax.optimization_barrier((out, token))
+    return out, token
+
+
 def _paged_attend(cfg: LlamaConfig, q, ck, cv, span_mask):
     """GQA attention of q [B, T, nh, hd] against gathered spans ck/cv
     [B, S, kv, hd]; span_mask [B, T, S] True = visible."""
@@ -547,7 +644,8 @@ def decode_step_paged(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
                       lengths: jnp.ndarray,
                       rope_cache: Optional[tuple] = None,
                       use_kernel: bool = False, mesh=None,
-                      kernel_interpret: bool = False):
+                      kernel_interpret: bool = False,
+                      tp_plan: Optional[TPPlan] = None):
     """One-token decode for every slot, KV in a paged pool.
 
     tokens [B] int32; table [B, W] block ids covering each slot's sequence
@@ -557,7 +655,9 @@ def decode_step_paged(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
     (measured on v5e b32: 5.2 vs 5.3 ms/token-step at span 256, 8.0 vs 17.4
     at span 1024 — benchmarks/paged_bisect.py).  With ``mesh``, the kernel
     runs under shard_map with kv heads sharded over the "tensor" axis, so
-    it composes with TP.  Returns (logits [B, V] fp32, updated pool).
+    it composes with TP.  With ``tp_plan``, the per-layer partial-sum
+    reductions route through the planner's chosen algorithm explicitly
+    (see :class:`TPPlan`).  Returns (logits [B, V] fp32, updated pool).
     """
     if rope_cache is None:
         cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
@@ -575,12 +675,16 @@ def decode_step_paged(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
         span_mask = (jnp.arange(w * bs)[None, None, :]
                      <= lengths[:, None, None])  # [B, 1, W*bs]
     x = jnp.take(params["embed"], tokens, axis=0).astype(cdt)
+    overlap = tp_plan is not None and tp_plan.overlap
 
     def body(carry, inp):
         # pool rides the CARRY; the scalar layer id fuses into every
         # gather/scatter's index vector, so no [li] slice is materialized
         # and no per-step restack happens (see module comment)
-        x, pk_all, pv_all = carry
+        if overlap:
+            x, pk_all, pv_all, tok = carry
+        else:
+            (x, pk_all, pv_all), tok = carry, None
         lp, li = inp
         h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
         q = (h @ lp["wq"].astype(cdt)).reshape(b, 1, cfg.n_heads, cfg.head_dim)
@@ -612,15 +716,23 @@ def decode_step_paged(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
             cv = pv_all[li, table].reshape(b, w * bs, cfg.n_kv_heads,
                                            cfg.head_dim)
             attn = _paged_attend(cfg, q, ck, cv, span_mask)[:, 0]
-        x = x + (attn.astype(cdt) @ lp["wo"].astype(cdt))
+        out, tok = _tp_out_proj(attn.astype(cdt), lp["wo"].astype(cdt),
+                                tp_plan, tok)
+        x = x + out
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-        ffn = (jax.nn.silu(h @ lp["w_gate"].astype(cdt))
-               * (h @ lp["w_up"].astype(cdt))) @ lp["w_down"].astype(cdt)
-        return (x + ffn, pk_all, pv_all), None
+        gated = (jax.nn.silu(h @ lp["w_gate"].astype(cdt))
+                 * (h @ lp["w_up"].astype(cdt)))
+        ffn, tok = _tp_out_proj(gated, lp["w_down"].astype(cdt),
+                                tp_plan, tok)
+        carry = (x + ffn, pk_all, pv_all)
+        return (carry + (tok,) if overlap else carry), None
 
-    (x, ks, vs), _ = lax.scan(
-        body, (x, pool["k"], pool["v"]),
-        (params["layers"], jnp.arange(cfg.n_layers)))
+    carry0 = (x, pool["k"], pool["v"])
+    if overlap:
+        carry0 = carry0 + (jnp.zeros((), cfg.compute_dtype),)
+    carry, _ = lax.scan(
+        body, carry0, (params["layers"], jnp.arange(cfg.n_layers)))
+    x, ks, vs = carry[:3]
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     logits = (x @ head.astype(cdt)).astype(jnp.float32)
@@ -631,7 +743,8 @@ def decode_window_paged(cfg: LlamaConfig, params: Params,
                         tokens: jnp.ndarray, pool: Dict[str, jnp.ndarray],
                         table: jnp.ndarray, lengths: jnp.ndarray,
                         rope_cache: Optional[tuple] = None,
-                        pos_limit: Optional[int] = None):
+                        pos_limit: Optional[int] = None,
+                        tp_plan: Optional[TPPlan] = None):
     """Multi-token decode window for every slot (speculative verification).
 
     tokens [B, T]: per-slot window starting at positions ``lengths[b]``
@@ -672,9 +785,13 @@ def decode_window_paged(cfg: LlamaConfig, params: Params,
     span_mask = (jnp.arange(w * bs)[None, None, :]
                  <= positions[:, :, None])  # [B, T, W*bs] causal
     x = jnp.take(params["embed"], tokens, axis=0).astype(cdt)
+    overlap = tp_plan is not None and tp_plan.overlap
 
     def body(carry, inp):
-        x, pk_all, pv_all = carry
+        if overlap:
+            x, pk_all, pv_all, tok = carry
+        else:
+            (x, pk_all, pv_all), tok = carry, None
         lp, li = inp
         h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
         q = (h @ lp["wq"].astype(cdt)).reshape(b, t, cfg.n_heads,
@@ -697,15 +814,23 @@ def decode_window_paged(cfg: LlamaConfig, params: Params,
         cv = pv_all[li, table].reshape(b, w * bs, cfg.n_kv_heads,
                                        cfg.head_dim)
         attn = _paged_attend(cfg, q, ck, cv, span_mask)
-        x = x + (attn.astype(cdt) @ lp["wo"].astype(cdt))
+        out, tok = _tp_out_proj(attn.astype(cdt), lp["wo"].astype(cdt),
+                                tp_plan, tok)
+        x = x + out
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-        ffn = (jax.nn.silu(h @ lp["w_gate"].astype(cdt))
-               * (h @ lp["w_up"].astype(cdt))) @ lp["w_down"].astype(cdt)
-        return (x + ffn, pk_all, pv_all), None
+        gated = (jax.nn.silu(h @ lp["w_gate"].astype(cdt))
+                 * (h @ lp["w_up"].astype(cdt)))
+        ffn, tok = _tp_out_proj(gated, lp["w_down"].astype(cdt),
+                                tp_plan, tok)
+        carry = (x + ffn, pk_all, pv_all)
+        return (carry + (tok,) if overlap else carry), None
 
-    (x, ks, vs), _ = lax.scan(
-        body, (x, pool["k"], pool["v"]),
-        (params["layers"], jnp.arange(cfg.n_layers)))
+    carry0 = (x, pool["k"], pool["v"])
+    if overlap:
+        carry0 = carry0 + (jnp.zeros((), cfg.compute_dtype),)
+    carry, _ = lax.scan(
+        body, carry0, (params["layers"], jnp.arange(cfg.n_layers)))
+    x, ks, vs = carry[:3]
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     logits = (x @ head.astype(cdt)).astype(jnp.float32)
@@ -715,7 +840,8 @@ def decode_window_paged(cfg: LlamaConfig, params: Params,
 def prefill_chunk_paged(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
                         pool: Dict[str, jnp.ndarray], table: jnp.ndarray,
                         p0: jnp.ndarray,
-                        rope_cache: Optional[tuple] = None):
+                        rope_cache: Optional[tuple] = None,
+                        tp_plan: Optional[TPPlan] = None):
     """Prefill ONE chunk of a single sequence into its pool blocks.
 
     tokens [1, C] (C a multiple of block_size; tail garbage-padded — padded
@@ -742,9 +868,14 @@ def prefill_chunk_paged(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
     span_mask = (jnp.arange(w * bs)[None, None, :]
                  <= positions[None, :, None])  # [1, C, W*bs] causal
     x = jnp.take(params["embed"], tokens, axis=0).astype(cdt)
+    overlap = tp_plan is not None and tp_plan.overlap
 
     def body(carry, inp):
-        x, pk_all, pv_all = carry  # pools [L, NB, bs, kv*hd] as carry
+        # pools [L, NB, bs, kv*hd] ride the carry
+        if overlap:
+            x, pk_all, pv_all, tok = carry
+        else:
+            (x, pk_all, pv_all), tok = carry, None
         lp, li = inp
         h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
         q = (h @ lp["wq"].astype(cdt)).reshape(b, c, cfg.n_heads, cfg.head_dim)
@@ -760,15 +891,23 @@ def prefill_chunk_paged(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
         ck = pk_all[li, table].reshape(b, w * bs, cfg.n_kv_heads, cfg.head_dim)
         cv = pv_all[li, table].reshape(b, w * bs, cfg.n_kv_heads, cfg.head_dim)
         attn = _paged_attend(cfg, q, ck, cv, span_mask)
-        x = x + (attn.astype(cdt) @ lp["wo"].astype(cdt))
+        out, tok = _tp_out_proj(attn.astype(cdt), lp["wo"].astype(cdt),
+                                tp_plan, tok)
+        x = x + out
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-        ffn = (jax.nn.silu(h @ lp["w_gate"].astype(cdt))
-               * (h @ lp["w_up"].astype(cdt))) @ lp["w_down"].astype(cdt)
-        return (x + ffn, pk_all, pv_all), None
+        gated = (jax.nn.silu(h @ lp["w_gate"].astype(cdt))
+                 * (h @ lp["w_up"].astype(cdt)))
+        ffn, tok = _tp_out_proj(gated, lp["w_down"].astype(cdt),
+                                tp_plan, tok)
+        carry = (x + ffn, pk_all, pv_all)
+        return (carry + (tok,) if overlap else carry), None
 
-    (x, ks, vs), _ = lax.scan(
-        body, (x, pool["k"], pool["v"]),
-        (params["layers"], jnp.arange(cfg.n_layers)))
+    carry0 = (x, pool["k"], pool["v"])
+    if overlap:
+        carry0 = carry0 + (jnp.zeros((), cfg.compute_dtype),)
+    carry, _ = lax.scan(
+        body, carry0, (params["layers"], jnp.arange(cfg.n_layers)))
+    x, ks, vs = carry[:3]
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     logits = (x @ head.astype(cdt)).astype(jnp.float32)

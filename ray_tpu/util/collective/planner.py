@@ -168,6 +168,28 @@ class Topology:
         return True
 
 
+def topology_for_devices(devices, intra_link: Optional[str] = None) -> Topology:
+    """Topology for an in-program device group — e.g. one mesh axis of a
+    tensor-parallel serving engine.  Latency domains come from each
+    device's ``slice_index`` (TPU multislice) falling back to
+    ``process_index``; the intra link defaults to ICI when every member is
+    a TPU and host loopback otherwise (CPU test meshes), so the α-β model
+    prices decode's small latency-bound collectives on the link class
+    they actually cross."""
+    devs = list(devices)
+    if intra_link is None:
+        intra_link = (LINK_ICI if devs and all(
+            getattr(d, "platform", "") == "tpu" for d in devs)
+            else LINK_HOST)
+    sids = []
+    for d in devs:
+        sid = getattr(d, "slice_index", None)
+        if sid is None:
+            sid = getattr(d, "process_index", 0)
+        sids.append(sid)
+    return Topology.from_slice_ids(sids or (0,), intra_link=intra_link)
+
+
 # ---------------------------------------------------------------------------
 # α-β cost model.  t(algorithm) = steps·α + bytes_on_slowest_link·β.  The
 # model only needs to ORDER the candidates correctly per regime; absolute

@@ -150,6 +150,67 @@ def test_unaligned_refusal_reason_is_counted():
     assert 'reason="unaligned_slices"' in text
 
 
+# ---------------------------------------------------------------------------
+# small-message (serving-decode) regime: the paged engine's per-layer TP
+# allreduces are KiB-scale — one hidden-state row per in-flight slot — and
+# latency-bound on any link class.  These pin that the planner NEVER picks
+# ring down there (ring pays (world-1) α hops for bandwidth the message
+# can't use) so the engine's plan-once-at-init routing stays in the
+# flat/tree family.  ISSUE 20 satellite.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("world", [2, 4, 8])
+@pytest.mark.parametrize("kib", [1, 4, 16, 32])
+def test_serving_decode_sizes_never_ring(world, kib):
+    t = pl.Topology.flat(world, link=pl.LINK_ICI)
+    plan = pl.plan_allreduce(kib << 10, t, _LOSSLESS)
+    assert plan.algorithm in (comp.ALG_FLAT, comp.ALG_TREE), plan
+    assert plan.reason == "latency_bound"
+    # small worlds: tree's log2(world) rounds equal ring's hop count with
+    # the same per-byte slope, so flat's single fused op must win outright
+    if world <= 4:
+        assert plan.algorithm == comp.ALG_FLAT, plan
+
+
+@pytest.mark.parametrize("world", [2, 4, 8])
+def test_serving_decode_modeled_costs_order(world):
+    """The α-β model itself must rank flat ≤ ring at KiB sizes — the
+    engine surfaces these modeled costs as its bench busbw column, so the
+    ordering is load-bearing beyond the argmin."""
+    t = pl.Topology.flat(world, link=pl.LINK_ICI)
+    costs = pl.plan_explain(2 << 10, t, _LOSSLESS,
+                            allowed=("flat", "ring", "tree"))["modeled_cost_s"]
+    assert costs["flat"] < costs["ring"]
+    if world > 2 and "tree" in costs:
+        assert costs["tree"] < costs["ring"]
+
+
+def test_choose_plan_decode_sizes_latency_bound():
+    """The world-count convenience entry agrees at decode sizes: KiB-scale
+    over any world stays in the latency-bound flat/tree family."""
+    for world in (2, 4, 8):
+        plan = comp.choose_plan(4 << 10, world, _LOSSLESS)
+        assert plan.algorithm in (comp.ALG_FLAT, comp.ALG_TREE), plan
+        assert plan.reason == "latency_bound"
+
+
+def test_topology_for_devices_host_link():
+    """topology_for_devices (the serving engine's entry point): CPU
+    devices form one latency domain on the HOST link class; the planner
+    still lands flat/latency_bound at decode sizes there."""
+    import jax
+
+    devs = jax.devices()[:2]
+    t = pl.topology_for_devices(devs)
+    assert t.world_size == len(devs)
+    assert t.num_slices == 1
+    assert t.intra_link == pl.LINK_HOST  # CPU: no ICI between virtuals
+    plan = pl.plan_allreduce(2 << 10, t, _LOSSLESS)
+    assert plan.algorithm == comp.ALG_FLAT
+    assert plan.reason == "latency_bound"
+
+
 def test_stock_reasons():
     t = pl.Topology.flat(8)
     assert pl.plan_allreduce(1 << 20, t, None).reason == "no_spec"

@@ -316,6 +316,32 @@ def test_planner_decision_budget():
     assert per < 5e-6, f"cached plan decision {per * 1e6:.2f}µs > 5µs budget"
 
 
+def test_serving_decode_plan_cache_budget():
+    """TP serving hot-loop gate (ISSUE 20): the paged engine plans its
+    per-layer allreduces ONCE at init (decode message sizes are
+    compile-time constants), so a steady-state decode step pays at most a
+    cached plan lookup and zero plan RPCs.  Gate the cached KiB-scale
+    decision at the same 5 µs budget as the training-size one — and pin
+    that re-planning the exact serving (nbytes, topo, spec, allowed)
+    tuple is a dict hit, not a re-derivation."""
+    import time
+
+    from ray_tpu.util.collective import compression as comp
+    from ray_tpu.util.collective import planner as pl
+
+    topo = pl.Topology.flat(4, link=pl.LINK_ICI)
+    spec = comp.CompressionSpec(scheme="none", min_bytes=0)
+    allowed = ("flat", "ring", "tree")
+    first = pl.plan_allreduce(2 << 10, topo, spec, allowed=allowed)
+    assert pl.plan_allreduce(2 << 10, topo, spec, allowed=allowed) is first
+    n = 5000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        pl.plan_allreduce(2 << 10, topo, spec, allowed=allowed)
+    per = (time.perf_counter() - t0) / n
+    assert per < 5e-6, f"cached decode plan {per * 1e6:.2f}µs > 5µs budget"
+
+
 def test_overlap_off_emits_zero_new_metric_families():
     """Overlap/planner off (the defaults) books NOTHING into the new
     ray_tpu_collective_plan_total family — fused-step metric output stays
